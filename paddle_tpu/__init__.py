@@ -1,0 +1,67 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built on JAX/XLA/Pallas/pjit.
+
+Public surface mirrors `python/paddle/__init__.py:487` of the reference (~356
+symbols): tensor ops, nn, optimizer, amp, autograd, io, jit, static, distributed,
+device, profiler, vision/audio/text, incubate.  Architecture is TPU-first (see
+SURVEY.md §7): XLA is the compiler/executor, GSPMD mesh-sharding is the
+distributed backend, Pallas kernels are the fused-op library.
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+# float64/int64 are first-class dtypes in the reference; enable x64 so dtype
+# semantics match (default dtype stays float32 — see framework.get_default_dtype).
+_jax.config.update("jax_enable_x64", True)
+
+from . import framework  # noqa: E402
+from .framework import (  # noqa: E402
+    bfloat16, bool_, complex64, complex128, float16, float32, float64,
+    get_default_dtype, get_device, get_flags, int8, int16, int32, int64,
+    seed, set_default_dtype, set_device, set_flags, uint8,
+)
+from .tensor import Tensor, to_tensor, is_tensor  # noqa: E402
+from .tensor import Parameter as _Parameter  # noqa: E402
+from . import ops  # noqa: E402
+from .ops import *  # noqa: E402,F401,F403
+from . import autograd  # noqa: E402
+from .autograd import grad, no_grad, enable_grad, set_grad_enabled, is_grad_enabled  # noqa: E402
+from .autograd import backward as _backward  # noqa: E402
+
+# subpackage namespaces (populated in later import stages of the build)
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import device  # noqa: E402
+from . import linalg  # noqa: E402
+from .serialization import save, load  # noqa: E402
+from . import metric  # noqa: E402
+
+CPUPlace = lambda: "cpu"  # noqa: E731 — place objects are strings on TPU build
+TPUPlace = lambda idx=0: f"tpu:{idx}"  # noqa: E731
+CUDAPlace = lambda idx=0: f"gpu:{idx}"  # noqa: E731
+
+__version__ = "0.1.0"
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for compiled "
+        "execution (XLA plays the static-graph executor's role)"
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+def device_count():
+    return framework.device_count()

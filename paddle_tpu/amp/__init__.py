@@ -1,0 +1,202 @@
+"""AMP (python/paddle/amp/ parity: auto_cast.py:270 amp_guard, grad_scaler.py).
+
+TPU-native: bfloat16 is the native MXU dtype, so O1/O2 with dtype='bfloat16'
+needs no loss scaling at all (GradScaler becomes a transparent pass-through by
+default, matching how the reference's scaler disables itself for bf16).  The
+cast hooks live in tensor.apply_op (the dispatch point), mirroring the
+reference's eager_amp_auto_cast.h insertion in the generated ad_func.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from .. import framework
+from ..tensor import Tensor
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler", "AmpScaler",
+           "is_float16_supported", "is_bfloat16_supported", "white_list", "black_list"]
+
+
+class _AmpState:
+    def __init__(self, enable, dtype, level, custom_white_list, custom_black_list):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+        self.custom_white_list = frozenset(custom_white_list or ())
+        self.custom_black_list = frozenset(custom_black_list or ())
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast parity (dtype defaults to bfloat16 on TPU)."""
+    st = framework.get_state()
+    prev = st.amp_state
+    st.amp_state = _AmpState(enable, dtype, level, custom_white_list, custom_black_list) if enable else None
+    try:
+        yield
+    finally:
+        st.amp_state = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None, master_grad=False, excluded_layers=None):
+    """O2: cast model params to low precision + enable master weights."""
+    from ..nn.layer import Layer
+
+    single_model = isinstance(models, Layer)
+    models_list = [models] if single_model else list(models)
+    if level == "O2":
+        excluded = excluded_layers or []
+        for m in models_list:
+            for layer in m.sublayers(include_self=True):
+                if any(isinstance(layer, e if isinstance(e, type) else type(e)) for e in excluded):
+                    continue
+                # keep norms in fp32 (reference O2 behavior)
+                from ..nn.common import LayerNorm, RMSNorm, _BatchNormBase
+                if isinstance(layer, (LayerNorm, RMSNorm, _BatchNormBase)):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and framework.is_floating_dtype(p.dtype):
+                        p._data = p._data.astype(framework.to_jax_dtype(dtype))
+        if optimizers is not None:
+            opts = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+            for o in opts:
+                o._multi_precision = True if master_weight is None else master_weight
+    if optimizers is None:
+        return models if single_model else models_list
+    return (models if single_model else models_list), optimizers
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    """Dynamic loss scaling (python/paddle/amp/grad_scaler.py:576 parity).
+
+    On TPU with bf16 this is a pass-through (enable=False is the sane default
+    there); for fp16 experiments the full dynamic-scale algorithm is active.
+    """
+
+    def __init__(self, enable=True, init_loss_scaling=2.0**16, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000, decr_every_n_nan_or_inf=1,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every = incr_every_n_steps
+        self._decr_every = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_init_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        import jax.numpy as jnp
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad._data * inv
+            p.grad._data = g
+        finite = [jnp.all(jnp.isfinite(p.grad._data)) for p in params if p.grad is not None]
+        if finite:
+            self._found_inf = not bool(jnp.all(jnp.stack(finite)))
+        else:
+            self._found_inf = False
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps, "enable": self._enable}
+
+    def load_state_dict(self, state):
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("good_steps", 0)
+        self._bad_steps = state.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def white_list():
+    from ..tensor import _AMP_WHITE
+    return {"float16": {"O1": set(_AMP_WHITE), "O2": set(_AMP_WHITE)},
+            "bfloat16": {"O1": set(_AMP_WHITE), "O2": set(_AMP_WHITE)}}
+
+
+def black_list():
+    from ..tensor import _AMP_BLACK
+    return {"float16": {"O1": set(_AMP_BLACK), "O2": set(_AMP_BLACK)},
+            "bfloat16": {"O1": set(_AMP_BLACK), "O2": set(_AMP_BLACK)}}
+
+
+def debugging_enable_operator_stats_collection():
+    return None
+
+
+def debugging_disable_operator_stats_collection():
+    return None

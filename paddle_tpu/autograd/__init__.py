@@ -1,0 +1,224 @@
+"""paddle.autograd parity: backward, grad, no_grad, PyLayer, jacobian/hessian.
+
+Reference: paddle/fluid/eager/backward.cc (engine — implemented in tensor.py),
+eager/pylayer (PyLayer), python/paddle/autograd/autograd.py (jacobian/hessian).
+The functional jacobian/hessian are TPU-native: they delegate to jax.jacfwd /
+jax.jacrev / jax.hessian over a functionalized view of the tape graph.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+from ..tensor import Tensor, apply_op, backward, grad, to_tensor
+
+__all__ = [
+    "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled", "PyLayer", "PyLayerContext", "jacobian", "hessian",
+    "vjp", "jvp",
+]
+
+
+class no_grad:
+    """Context manager AND decorator (paddle.no_grad parity)."""
+
+    def __enter__(self):
+        self._cm = framework.no_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with framework.no_grad_guard():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._cm = framework.enable_grad_guard()
+        return self._cm.__enter__()
+
+    def __exit__(self, *exc):
+        return self._cm.__exit__(*exc)
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with framework.enable_grad_guard():
+                return fn(*args, **kwargs)
+        return wrapper
+
+
+@contextlib.contextmanager
+def set_grad_enabled(mode: bool):
+    with framework._grad_mode(mode):
+        yield
+
+
+def is_grad_enabled():
+    return framework.is_grad_enabled()
+
+
+# ---------------------------------------------------------------------------
+# PyLayer — custom forward/backward (eager/pylayer parity)
+# ---------------------------------------------------------------------------
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Subclass with static forward(ctx, ...) and backward(ctx, *grads)."""
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+        with framework.no_grad_guard():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+
+        if not framework.is_grad_enabled() or not any(
+            not args[i].stop_gradient for i in tensor_pos
+        ):
+            return outs
+
+        # Build a custom pullback that calls the user's backward.
+        inputs = tuple(args[i] for i in tensor_pos)
+
+        def pullback(cts):
+            if not isinstance(cts, tuple):
+                cts = (cts,)
+            grads_in = cls.backward(ctx, *[Tensor(c) for c in cts])
+            if not isinstance(grads_in, (tuple, list)):
+                grads_in = (grads_in,)
+            raw = []
+            gi = iter(grads_in)
+            for i in tensor_pos:
+                g = next(gi, None)
+                raw.append(None if g is None else (g._data if isinstance(g, Tensor) else jnp.asarray(g)))
+            return tuple(raw)
+
+        from ..tensor import TapeNode
+
+        wrapped = [Tensor(o._data if isinstance(o, Tensor) else o, stop_gradient=False) for o in out_list]
+        node = TapeNode(cls.__name__, pullback, inputs, tuple(wrapped))
+        for idx, o in enumerate(wrapped):
+            o._node = node
+            o._out_idx = idx
+        return tuple(wrapped) if multi else wrapped[0]
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Functional autodiff (python/paddle/autograd/autograd.py + incubate/autograd)
+# ---------------------------------------------------------------------------
+
+
+def _functionalize(func):
+    """Wrap a Tensor->Tensor function as a raw jax function."""
+
+    def raw_fn(*raws):
+        outs = func(*[Tensor(r, stop_gradient=False) for r in raws])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+        return outs._data if isinstance(outs, Tensor) else outs
+
+    return raw_fn
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian parity for the (func, inputs) functional form is
+    jax.jacrev; the tensor form computes J of ys wrt xs via repeated backward."""
+    if callable(ys):
+        func, inputs = ys, xs
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        raw = _functionalize(func)
+        jac = jax.jacrev(raw, argnums=tuple(range(len(inputs))))(*[t._data for t in inputs])
+        if len(inputs) == 1:
+            jac = jac[0]
+            return Tensor(jac)
+        return tuple(Tensor(j) for j in jac)
+    # Tensor form: ys is output tensor, xs input tensor(s)
+    single = not isinstance(xs, (list, tuple))
+    xs_list = [xs] if single else list(xs)
+    y_flat = ys.reshape([-1]) if ys.ndim else ys.reshape([1])
+    rows = []
+    n = y_flat.shape[0]
+    for i in range(n):
+        gs = grad([y_flat[i]], xs_list, retain_graph=True, allow_unused=True)
+        rows.append([g._data.reshape(-1) if g is not None else jnp.zeros(int(jnp.prod(jnp.asarray(x.shape)))) for g, x in zip(gs, xs_list)])
+    outs = []
+    for j in range(len(xs_list)):
+        outs.append(Tensor(jnp.stack([r[j] for r in rows])))
+    return outs[0] if single else tuple(outs)
+
+
+def hessian(func, inputs, batch_axis=None):
+    if not callable(func):
+        raise TypeError("hessian expects a callable")
+    inputs_list = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    raw = _functionalize(func)
+    h = jax.hessian(raw, argnums=tuple(range(len(inputs_list))))(*[t._data for t in inputs_list])
+    if len(inputs_list) == 1:
+        return Tensor(h[0][0] if isinstance(h, tuple) else h)
+    return h
+
+
+def vjp(func, xs, v=None):
+    """paddle.incubate.autograd.vjp parity → jax.vjp."""
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = _functionalize(func)
+    out, pull = jax.vjp(raw, *[t._data for t in xs_list])
+    if v is None:
+        v_raw = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        v_raw = jax.tree_util.tree_map(lambda t: t._data if isinstance(t, Tensor) else t, v)
+    grads = pull(v_raw)
+    wrap = lambda o: jax.tree_util.tree_map(Tensor, o)
+    return wrap(out), wrap(grads if len(xs_list) > 1 else grads[0])
+
+
+def jvp(func, xs, v=None):
+    xs_list = xs if isinstance(xs, (list, tuple)) else [xs]
+    raw = _functionalize(func)
+    primals = [t._data for t in xs_list]
+    if v is None:
+        tangents = [jnp.ones_like(p) for p in primals]
+    else:
+        v_list = v if isinstance(v, (list, tuple)) else [v]
+        tangents = [t._data if isinstance(t, Tensor) else t for t in v_list]
+    out, tan = jax.jvp(raw, tuple(primals), tuple(tangents))
+    wrap = lambda o: jax.tree_util.tree_map(Tensor, o)
+    return wrap(out), wrap(tan)

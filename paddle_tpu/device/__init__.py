@@ -1,0 +1,155 @@
+"""paddle.device parity (python/paddle/device/__init__.py).
+
+Devices are JAX/PJRT devices; streams/events are XLA-managed, so the stream API
+is a semantic no-op kept for source compatibility (every op already runs async
+on the TPU's single compute stream, with dispatch-order dependencies)."""
+
+from __future__ import annotations
+
+import jax
+
+from .. import framework
+from ..framework import get_device, set_device  # noqa: F401
+
+__all__ = ["set_device", "get_device", "get_all_device_type", "get_available_device",
+           "get_available_custom_device", "is_compiled_with_cinn", "is_compiled_with_cuda",
+           "is_compiled_with_rocm", "is_compiled_with_xpu", "is_compiled_with_custom_device",
+           "synchronize", "device_count", "Stream", "Event", "current_stream", "stream_guard",
+           "set_stream", "cuda", "get_device_properties"]
+
+
+def get_all_device_type():
+    return ["cpu"] + ([jax.default_backend()] if jax.default_backend() != "cpu" else [])
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_cinn():
+    return True  # XLA plays CINN's role
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def is_compiled_with_custom_device(device_type=None):
+    return device_type in ("tpu", "axon")
+
+
+def synchronize(device=None):
+    for d in jax.devices():
+        try:
+            jax.device_put(0.0, d).block_until_ready()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+def device_count():
+    return jax.device_count()
+
+
+def get_device_properties(device=None):
+    d = jax.devices()[0]
+    class _Props:
+        name = getattr(d, "device_kind", str(d))
+        total_memory = None
+        multi_processor_count = None
+    return _Props()
+
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    return _current_stream
+
+
+class stream_guard:
+    def __init__(self, stream):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class cuda:
+    """paddle.device.cuda namespace stub — no CUDA in the TPU build."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def is_available():
+        return False
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return 0

@@ -1,0 +1,267 @@
+"""Global framework state: dtypes, default device, RNG, grad mode, flags.
+
+Reference parity: paddle/phi/common/data_type.h (dtype set), python/paddle/base/framework.py
+(set_flags/get_flags, _dygraph_tracer grad mode), paddle/phi/core/generator.h (RNG
+Generator).  TPU-native design: dtypes map 1:1 onto jax.numpy dtypes (bfloat16 is
+first-class — it is the TPU MXU native type); the RNG is a counter-based stateful wrapper
+over JAX's splittable threefry keys so the user-facing API is Paddle-like (`paddle.seed`)
+while every draw stays functional underneath (safe under jit tracing).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# dtype system
+# ---------------------------------------------------------------------------
+
+# Canonical names follow the reference's phi::DataType set (no float8 in that
+# snapshot; we still expose fp8 aliases since TPU v5+ supports them natively).
+_DTYPE_MAP = {
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+}
+
+_REVERSE_DTYPE_MAP = {np.dtype(v): k for k, v in _DTYPE_MAP.items()}
+
+# Short aliases used throughout paddle code.
+float32 = "float32"
+float64 = "float64"
+float16 = "float16"
+bfloat16 = "bfloat16"
+int8 = "int8"
+int16 = "int16"
+int32 = "int32"
+int64 = "int64"
+uint8 = "uint8"
+bool_ = "bool"
+complex64 = "complex64"
+complex128 = "complex128"
+
+FLOAT_DTYPES = ("float16", "bfloat16", "float32", "float64")
+INT_DTYPES = ("int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64")
+
+
+def convert_dtype(dtype):
+    """Normalize any dtype spec (str / np / jnp) to the canonical string name."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype in _DTYPE_MAP:
+            return dtype
+        raise ValueError(f"Unknown dtype {dtype!r}")
+    try:
+        return _REVERSE_DTYPE_MAP[np.dtype(dtype)]
+    except Exception as e:  # noqa: BLE001
+        raise ValueError(f"Unknown dtype {dtype!r}") from e
+
+
+def to_jax_dtype(dtype):
+    """Canonical string / np dtype → jnp dtype class (None passes through)."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        return _DTYPE_MAP[dtype]
+    return np.dtype(dtype)
+
+
+def is_floating_dtype(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return d in FLOAT_DTYPES or d in ("complex64", "complex128")
+
+
+# ---------------------------------------------------------------------------
+# Global state
+# ---------------------------------------------------------------------------
+
+
+class _GlobalState(threading.local):
+    def __init__(self):
+        self.default_dtype = "float32"
+        self.grad_enabled = True
+        self.amp_state = None  # set by paddle_tpu.amp.auto_cast
+        self.device = None  # lazily resolved; "tpu"/"cpu"/"gpu"
+        # When set (by the jit engine), RNG draws fold this traced key instead of
+        # the global generator, so dropout masks are fresh per compiled step.
+        self.trace_key = None
+        self.trace_key_count = 0
+        self.flags = {
+            "FLAGS_check_nan_inf": bool(int(os.environ.get("FLAGS_check_nan_inf", "0"))),
+            "FLAGS_cudnn_deterministic": False,
+            "FLAGS_use_fused_kernels": True,
+            "FLAGS_pallas_interpret": False,
+            "FLAGS_embedding_deterministic": False,
+        }
+
+
+_state = _GlobalState()
+
+
+def get_state() -> _GlobalState:
+    return _state
+
+
+def set_default_dtype(dtype):
+    _state.default_dtype = convert_dtype(dtype)
+
+
+def get_default_dtype() -> str:
+    return _state.default_dtype
+
+
+def set_flags(flags: dict):
+    """paddle.set_flags parity (base/framework.py set_flags)."""
+    for k, v in flags.items():
+        _state.flags[k] = v
+
+
+def get_flags(keys=None):
+    if keys is None:
+        return dict(_state.flags)
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _state.flags.get(k) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# Grad mode
+# ---------------------------------------------------------------------------
+
+
+def is_grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+@contextlib.contextmanager
+def _grad_mode(enabled: bool):
+    prev = _state.grad_enabled
+    _state.grad_enabled = enabled
+    try:
+        yield
+    finally:
+        _state.grad_enabled = prev
+
+
+def no_grad_guard():
+    return _grad_mode(False)
+
+
+def enable_grad_guard():
+    return _grad_mode(True)
+
+
+# ---------------------------------------------------------------------------
+# RNG: Paddle-style stateful seed over JAX functional keys
+# ---------------------------------------------------------------------------
+
+
+class Generator:
+    """Counter-based RNG state (reference: phi/core/generator.h Generator).
+
+    Holds a root JAX key; every `next_key()` derives a fresh fold so eager code
+    gets Paddle's "global implicit RNG" UX.  Under jit tracing the caller should
+    thread keys explicitly; ops accept an optional `key=` for that.
+    """
+
+    def __init__(self, seed: int = 0):
+        self._seed = seed
+        self._count = 0
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._count = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        self._count += 1
+        return jax.random.fold_in(jax.random.PRNGKey(self._seed), self._count)
+
+    def get_state(self):
+        return (self._seed, self._count)
+
+    def set_state(self, state):
+        self._seed, self._count = state
+
+
+_default_generator = Generator(seed=np.random.randint(0, 2**31 - 1))
+
+
+def seed(value: int):
+    """paddle.seed parity."""
+    _default_generator.manual_seed(value)
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_rng_key():
+    if _state.trace_key is not None:
+        _state.trace_key_count += 1
+        return jax.random.fold_in(_state.trace_key, _state.trace_key_count)
+    return _default_generator.next_key()
+
+
+# ---------------------------------------------------------------------------
+# Device control (python/paddle/device/__init__.py set_device parity)
+# ---------------------------------------------------------------------------
+
+
+def set_device(device: str):
+    """Accepts "tpu", "cpu", "gpu", or "tpu:0" style strings."""
+    _state.device = device.split(":")[0]
+    return _state.device
+
+
+def get_device() -> str:
+    if _state.device is None:
+        _state.device = jax.default_backend()
+    plat = _state.device
+    return f"{plat}:0"
+
+
+def default_backend() -> str:
+    return jax.default_backend()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role in the TPU build.
+    return True
+
+
+def device_count() -> int:
+    return jax.device_count()

@@ -1,0 +1,177 @@
+"""Fused kernels: Pallas on TPU, pure-XLA reference path elsewhere.
+
+Reference analog: paddle/phi/kernels/fusion/ (fused_rope, fused_layernorm,
+fused_bias_act, flash_attn via third_party/flashattn).  On TPU the hot ops are
+Pallas kernels (pallas.py); on CPU (tests, 8-virtual-device mesh) we use the
+jnp reference implementations, which XLA fuses well anyway.
+
+Dispatch rule: use Pallas when running on a real TPU backend and shapes are
+tile-aligned; otherwise the reference path.  FLAGS_use_fused_kernels=False
+forces the reference path everywhere.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .. import framework
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _use_pallas() -> bool:
+    return _on_tpu() and framework.get_state().flags.get("FLAGS_use_fused_kernels", True)
+
+
+# ---------------------------------------------------------------------------
+# RMSNorm
+# ---------------------------------------------------------------------------
+
+
+def rms_norm_reference(x, weight=None, epsilon=1e-6):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(dt)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+def rms_norm(x, weight=None, epsilon=1e-6):
+    if _use_pallas() and x.ndim >= 2 and x.shape[-1] % 128 == 0 and weight is not None:
+        from .pallas_norm import rms_norm_pallas
+
+        try:
+            return rms_norm_pallas(x, weight, epsilon)
+        except Exception:  # noqa: BLE001 — fall back on any lowering issue
+            pass
+    return rms_norm_reference(x, weight, epsilon)
+
+
+# ---------------------------------------------------------------------------
+# Attention (B, S, H, D) — paddle flash_attention layout
+# ---------------------------------------------------------------------------
+
+
+def attention_reference(q, k, v, mask=None, causal=False, scale=None):
+    dt = q.dtype
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / (d**0.5)
+    # (B, S, H, D) -> (B, H, S, D)
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    # grouped-query attention: repeat kv heads if fewer than q heads
+    hq, hk = qt.shape[1], kt.shape[1]
+    if hk != hq:
+        kt = jnp.repeat(kt, hq // hk, axis=1)
+        vt = jnp.repeat(vt, hq // hk, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qt, kt, preferred_element_type=jnp.float32) * scale
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def attention(q, k, v, mask=None, causal=False, scale=None):
+    if (
+        _use_pallas()
+        and mask is None
+        and q.shape[-1] in (64, 128, 256)
+        and q.shape[1] % 128 == 0
+        and k.shape[1] % 128 == 0
+    ):
+        from .pallas_attention import flash_attention_pallas
+
+        try:
+            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+        except Exception:  # noqa: BLE001
+            pass
+    return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+# ---------------------------------------------------------------------------
+# Rotary position embedding (reference: fused_rope_kernel.cu /
+# incubate/nn/functional/fused_rotary_position_embedding.py)
+# ---------------------------------------------------------------------------
+
+
+def apply_rotary_emb(x, cos, sin, rotate_half_style="neox"):
+    """x: (B, S, H, D); cos/sin: (S, D) or (1, S, 1, D)."""
+    if cos.ndim == 2:
+        cos = cos[None, :, None, :]
+        sin = sin[None, :, None, :]
+    cos = cos.astype(x.dtype)
+    sin = sin.astype(x.dtype)
+    if rotate_half_style == "neox":
+        d2 = x.shape[-1] // 2
+        x1, x2 = x[..., :d2], x[..., d2:]
+        rotated = jnp.concatenate([-x2, x1], axis=-1)
+    else:  # GPT-J interleaved
+        x1 = x[..., ::2]
+        x2 = x[..., 1::2]
+        rotated = jnp.stack([-x2, x1], axis=-1).reshape(x.shape)
+    return x * cos + rotated * sin
+
+
+def fused_rotary_position_embedding(q, k, v=None, sin=None, cos=None, position_ids=None,
+                                    use_neox_rotary_style=True):
+    style = "neox" if use_neox_rotary_style else "gptj"
+    if position_ids is not None:
+        cos = jnp.take(cos.reshape(cos.shape[-2], cos.shape[-1]), position_ids, axis=0)[:, :, None, :]
+        sin = jnp.take(sin.reshape(sin.shape[-2], sin.shape[-1]), position_ids, axis=0)[:, :, None, :]
+    outs = [apply_rotary_emb(q, cos, sin, style)]
+    if k is not None:
+        outs.append(apply_rotary_emb(k, cos, sin, style))
+    if v is not None:
+        outs.append(apply_rotary_emb(v, cos, sin, style))
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused bias+activation (reference: fused_bias_act_kernel.cu)
+# ---------------------------------------------------------------------------
+
+
+def fused_bias_act(x, bias=None, act="gelu"):
+    if bias is not None:
+        x = x + bias
+    if act == "gelu":
+        return jax.nn.gelu(x)
+    if act in ("silu", "swish"):
+        return jax.nn.silu(x)
+    if act == "relu":
+        return jax.nn.relu(x)
+    if act == "swiglu":
+        a, b = jnp.split(x, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    if act in (None, "none", "identity"):
+        return x
+    raise ValueError(f"unknown act {act}")
+
+
+def swiglu(x, y=None):
+    """reference: phi swiglu op (fused_ops) — silu(x) * y."""
+    if y is None:
+        a, b = jnp.split(x, 2, axis=-1)
+        return jax.nn.silu(a) * b
+    return jax.nn.silu(x) * y

@@ -1,0 +1,4 @@
+"""paddle.linalg namespace (re-exports; python/paddle/tensor/linalg.py parity)."""
+
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__  # noqa: F401

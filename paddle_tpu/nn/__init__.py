@@ -1,0 +1,25 @@
+"""paddle.nn parity namespace."""
+
+from __future__ import annotations
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer import Layer, LayerDict, LayerList, ParamAttr, ParameterList, Sequential  # noqa: F401
+from .common import *  # noqa: F401,F403
+from .transformer import (  # noqa: F401
+    MultiHeadAttention, Transformer, TransformerDecoder, TransformerDecoderLayer,
+    TransformerEncoder, TransformerEncoderLayer,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from ..tensor import Parameter  # noqa: F401
+
+from . import common as _common
+
+__all__ = (
+    ["Layer", "LayerList", "LayerDict", "ParameterList", "Sequential", "ParamAttr",
+     "Parameter", "functional", "initializer",
+     "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
+     "TransformerDecoderLayer", "TransformerDecoder", "Transformer",
+     "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+    + list(_common.__all__)
+)

@@ -1,0 +1,92 @@
+"""Gradient clipping (python/paddle/nn/clip.py parity)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+    # functional form used by the jitted train step: grads is a flat list of raw
+    # arrays; returns clipped raws. Eager path wraps this.
+    def clip_raw(self, raw_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def clip_raw(self, raw_grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max) for g in raw_grads]
+
+    def __call__(self, params_grads):
+        raws = self.clip_raw([g._data if g is not None else None for _, g in params_grads])
+        return [(p, None if r is None else Tensor(r)) for (p, _), r in zip(params_grads, raws)]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def clip_raw(self, raw_grads):
+        out = []
+        for g in raw_grads:
+            if g is None:
+                out.append(None)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+    def __call__(self, params_grads):
+        raws = self.clip_raw([g._data if g is not None else None for _, g in params_grads])
+        return [(p, None if r is None else Tensor(r)) for (p, _), r in zip(params_grads, raws)]
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip.  In hybrid-parallel runs the per-axis partial norms are
+    combined by the distributed optimizer (HybridParallelOptimizer analog,
+    fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py) — under
+    GSPMD this falls out automatically because grads are global arrays."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def clip_raw(self, raw_grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in raw_grads if g is not None]
+        if not sq:
+            return raw_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None else (g.astype(jnp.float32) * scale).astype(g.dtype) for g in raw_grads]
+
+    def __call__(self, params_grads):
+        raws = self.clip_raw([g._data if g is not None else None for _, g in params_grads])
+        return [(p, None if r is None else Tensor(r)) for (p, _), r in zip(params_grads, raws)]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters]) if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros(()))
+    norms = [jnp.linalg.norm(p.grad._data.reshape(-1).astype(jnp.float32), ord=norm_type) for p in params]
+    total = jnp.linalg.norm(jnp.stack(norms), ord=norm_type)
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data = (p.grad._data.astype(jnp.float32) * scale).astype(p.grad._data.dtype)
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    for p in (parameters if isinstance(parameters, (list, tuple)) else [parameters]):
+        if p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)

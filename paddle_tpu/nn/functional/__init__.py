@@ -1,0 +1,1214 @@
+"""paddle.nn.functional parity (python/paddle/nn/functional/).
+
+All functions are thin pure-JAX ops dispatched through apply_op (tape + AMP).
+The attention entry points (flash_attention / scaled_dot_product_attention)
+route to the Pallas kernels in paddle_tpu.kernels on TPU (reference analog:
+phi/kernels/gpu/flash_attn_kernel.cu:324 wrapping third_party/flashattn).
+"""
+
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import framework
+from ...framework import convert_dtype, to_jax_dtype
+from ...tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    # activations
+    "relu", "relu6", "relu_", "gelu", "silu", "swish", "sigmoid", "tanh",
+    "softmax", "log_softmax", "softplus", "softsign", "softshrink", "hardshrink",
+    "leaky_relu", "elu", "selu", "celu", "prelu", "rrelu", "hardsigmoid",
+    "hardswish", "hardtanh", "mish", "tanhshrink", "thresholded_relu", "glu",
+    "gumbel_softmax", "maxout", "log_sigmoid",
+    # linear & embedding
+    "linear", "embedding", "one_hot", "bilinear",
+    # norm
+    "layer_norm", "rms_norm", "batch_norm", "instance_norm", "group_norm",
+    "normalize", "local_response_norm",
+    # dropout
+    "dropout", "dropout2d", "dropout3d", "alpha_dropout", "feature_alpha_dropout",
+    # conv & pool
+    "conv1d", "conv2d", "conv3d", "conv1d_transpose", "conv2d_transpose",
+    "conv3d_transpose", "avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+    "max_pool2d", "max_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_max_pool2d", "interpolate", "upsample", "pixel_shuffle", "unfold", "pad",
+    # losses
+    "cross_entropy", "softmax_with_cross_entropy", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "l1_loss", "nll_loss",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_embedding_loss",
+    "ctc_loss", "hinge_embedding_loss", "poisson_nll_loss", "triplet_margin_loss",
+    "sigmoid_focal_loss", "square_error_cost", "log_loss",
+    # attention
+    "scaled_dot_product_attention", "flash_attention", "sdp_kernel",
+    # misc
+    "cosine_similarity", "pairwise_distance", "label_smooth", "sequence_mask",
+    "temporal_shift", "pixel_unshuffle", "channel_shuffle", "fold",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# activations
+# ---------------------------------------------------------------------------
+
+
+def relu(x, name=None):
+    return apply_op("relu", jax.nn.relu, _t(x))
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def relu6(x, name=None):
+    return apply_op("relu6", jax.nn.relu6, _t(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), _t(x))
+
+
+def silu(x, name=None):
+    return apply_op("silu", jax.nn.silu, _t(x))
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+def sigmoid(x, name=None):
+    return apply_op("sigmoid", jax.nn.sigmoid, _t(x))
+
+
+def log_sigmoid(x, name=None):
+    return apply_op("log_sigmoid", jax.nn.log_sigmoid, _t(x))
+
+
+def tanh(x, name=None):
+    return apply_op("tanh", jnp.tanh, _t(x))
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    x = _t(x)
+    if dtype is not None:
+        x = apply_op("cast", lambda a: a.astype(to_jax_dtype(convert_dtype(dtype))), x)
+    return apply_op("softmax", lambda a: jax.nn.softmax(a, axis=axis), x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op("log_softmax", lambda a: jax.nn.log_softmax(a, axis=axis), _t(x))
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    return apply_op("softplus", lambda a: jnp.where(a * beta > threshold, a, jax.nn.softplus(a * beta) / beta), _t(x))
+
+
+def softsign(x, name=None):
+    return apply_op("softsign", jax.nn.soft_sign, _t(x))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op("softshrink", lambda a: jnp.where(a > threshold, a - threshold, jnp.where(a < -threshold, a + threshold, 0.0)), _t(x))
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op("hardshrink", lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), _t(x))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), _t(x))
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op("elu", lambda a: jax.nn.elu(a, alpha), _t(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), _t(x))
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op("celu", lambda a: jax.nn.celu(a, alpha), _t(x))
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    x, weight = _t(x), _t(weight)
+
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+
+    return apply_op("prelu", f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    x = _t(x)
+    if training:
+        k = framework.next_rng_key()
+        slope = jax.random.uniform(k, tuple(x.shape), minval=lower, maxval=upper)
+        return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, slope.astype(a.dtype) * a), x)
+    mid = (lower + upper) / 2.0
+    return apply_op("rrelu", lambda a: jnp.where(a >= 0, a, mid * a), x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), _t(x))
+
+
+def hardswish(x, name=None):
+    return apply_op("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, _t(x))
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op("hardtanh", lambda a: jnp.clip(a, min, max), _t(x))
+
+
+def mish(x, name=None):
+    return apply_op("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), _t(x))
+
+
+def tanhshrink(x, name=None):
+    return apply_op("tanhshrink", lambda a: a - jnp.tanh(a), _t(x))
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply_op("thresholded_relu", lambda a: jnp.where(a > threshold, a, value), _t(x))
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply_op("glu", f, _t(x))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    x = _t(x)
+    k = framework.next_rng_key()
+
+    def f(a):
+        g = jax.random.gumbel(k, a.shape, dtype=a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            y_hard = jax.nn.one_hot(jnp.argmax(y, axis=axis), a.shape[axis], axis=axis, dtype=a.dtype)
+            y = y_hard + y - jax.lax.stop_gradient(y)
+        return y
+
+    return apply_op("gumbel_softmax", f, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        shape = list(a.shape)
+        ch = shape[axis]
+        shape[axis:axis + 1] = [groups, ch // groups]
+        return jnp.max(a.reshape(shape), axis=axis + 1 if axis >= 0 else axis)
+    return apply_op("maxout", f, _t(x))
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b); W stored [in, out] like the reference (nn/layer/common.py Linear)."""
+    x, weight = _t(x), _t(weight)
+    if bias is not None:
+        bias = _t(bias)
+        return apply_op("linear", lambda a, w, b: jnp.matmul(a, w) + b, x, weight, bias)
+    return apply_op("linear", lambda a, w: jnp.matmul(a, w), x, weight)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = _t(x), _t(weight)
+
+    def f(i, w):
+        out = jnp.take(w, i, axis=0)
+        if padding_idx is not None:
+            mask = (i == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+
+    return apply_op("embedding", lambda i, w: f(i, w), x, weight, nondiff=(0,))
+
+
+def one_hot(x, num_classes, name=None):
+    x = _t(x)
+    return apply_op("one_hot", lambda i: jax.nn.one_hot(i, num_classes, dtype=to_jax_dtype(framework.get_default_dtype())), x, nondiff=(0,))
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = _t(x1), _t(x2), _t(weight)
+
+    def f(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+
+    if bias is not None:
+        return apply_op("bilinear", f, x1, x2, weight, _t(bias))
+    return apply_op("bilinear", f, x1, x2, weight)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-05, name=None):
+    x = _t(x)
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) else tuple(normalized_shape)
+    axes = tuple(range(x.ndim - len(ns), x.ndim))
+
+    def f(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """Fused RMSNorm (reference: phi/kernels/fusion/gpu/fused_layernorm + rms);
+    routes to the Pallas kernel on TPU via paddle_tpu.kernels."""
+    from ...kernels import rms_norm as _kernel_rms_norm
+
+    x = _t(x)
+    if weight is not None:
+        return apply_op("rms_norm", lambda a, w: _kernel_rms_norm(a, w, epsilon), x, _t(weight))
+    return apply_op("rms_norm", lambda a: _kernel_rms_norm(a, None, epsilon), x)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-05, data_format="NCHW", use_global_stats=None, name=None):
+    x = _t(x)
+    rm, rv = _t(running_mean), _t(running_var)
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    bshape = [1] * x.ndim
+    bshape[ch_axis] = x.shape[ch_axis]
+
+    use_batch_stats = training and not use_global_stats
+
+    def f(a, *wb):
+        if use_batch_stats:
+            # stats computed INSIDE the recorded op so the vjp includes the
+            # d(mean)/dx and d(var)/dx terms (true batch-norm gradient)
+            mean_use = jnp.mean(a, axis=reduce_axes)
+            var_use = jnp.var(a, axis=reduce_axes)
+        else:
+            mean_use, var_use = rm._data, rv._data
+        out = (a - mean_use.reshape(bshape)) * jax.lax.rsqrt(var_use.reshape(bshape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(bshape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(bshape)
+        return out, mean_use, var_use
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    out, mean_t, var_t = apply_op("batch_norm", f, *args)
+    if use_batch_stats:
+        # update running stats in place (stateful buffer semantics), detached
+        rm._data = momentum * rm._data + (1 - momentum) * mean_t._data
+        rv._data = momentum * rv._data + (1 - momentum) * var_t._data
+    return out
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-05, data_format="NCHW", name=None):
+    x = _t(x)
+    axes = tuple(range(2, x.ndim))
+
+    def f(a, *wb):
+        mean = jnp.mean(a, axis=axes, keepdims=True)
+        var = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        i = 0
+        shape = [1, a.shape[1]] + [1] * (a.ndim - 2)
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("instance_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-05, weight=None, bias=None, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(a, *wb):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c = a.shape[0], a.shape[1]
+        g = num_groups
+        spatial = a.shape[2:]
+        ar = a.reshape(n, g, c // g, *spatial)
+        axes = tuple(range(2, ar.ndim))
+        mean = jnp.mean(ar, axis=axes, keepdims=True)
+        var = jnp.var(ar, axis=axes, keepdims=True)
+        out = ((ar - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(_t(weight))
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("group_norm", f, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply_op(
+        "normalize",
+        lambda a: a / jnp.maximum(jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p), epsilon),
+        _t(x),
+    )
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(a):
+        sq = jnp.square(a)
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        c = a.shape[ch_axis]
+        sq_m = jnp.moveaxis(sq, ch_axis, -1)
+        pad = [(0, 0)] * (sq_m.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(sq_m, pad)
+        win = sum(jax.lax.slice_in_dim(padded, i, i + c, axis=-1) for i in range(size))
+        denom = (k + alpha * win) ** beta
+        return a / jnp.moveaxis(denom, -1, ch_axis)
+
+    return apply_op("local_response_norm", f, x)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None, key=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and p > 0.0:
+            return apply_op("dropout_infer", lambda a: a * (1.0 - p), x)
+        return apply_op("dropout_id", lambda a: a, x)
+    if key is None:
+        key = framework.next_rng_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            for i in range(len(shape)):
+                if i not in axes:
+                    shape[i] = 1
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+
+    return apply_op("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (2, 3) if data_format == "NCHW" else (1, 2)
+    # drop whole channels: mask over (N, C)
+    keep_axes = (0, 1) if data_format == "NCHW" else (0, 3)
+    drop_axis = [i for i in range(4) if i not in keep_axes]
+    return dropout(x, p=p, axis=list(keep_axes), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    keep_axes = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p=p, axis=list(keep_axes), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = _t(x)
+    if not training or p == 0.0:
+        return apply_op("dropout_id", lambda a: a, x)
+    key = framework.next_rng_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        a_coef = (q + alpha_p**2 * q * p) ** -0.5
+        b_coef = -a_coef * alpha_p * p
+        return (a_coef * jnp.where(keep, a, alpha_p) + b_coef).astype(a.dtype)
+
+    return apply_op("alpha_dropout", f, x)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    return alpha_dropout(x, p, training)
+
+
+# ---------------------------------------------------------------------------
+# conv / pool — MXU path: lowered to lax.conv_general_dilated
+# ---------------------------------------------------------------------------
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    return (v,) * n
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, nd,
+             transpose=False, output_padding=0):
+    x, weight = _t(x), _t(weight)
+    stride = _pair(stride, nd)
+    dilation = _pair(dilation, nd)
+    out_pad = _pair(output_padding, nd)
+
+    channel_first = data_format.startswith("NC")
+    spatial = {1: "H", 2: "HW", 3: "DHW"}[nd]
+    # paddle weights are [out, in/g, *k] (OI layout) for every data_format
+    if channel_first:
+        dn = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
+    else:
+        dn = (f"N{spatial}C", f"OI{spatial}", f"N{spatial}C")
+
+    if isinstance(padding, str):
+        padding_lax = padding.upper()  # "SAME" / "VALID"
+        pad_pairs = None
+    else:
+        p = _pair(padding, nd)
+        if len(p) == nd:
+            pad_pairs = [(int(pp), int(pp)) for pp in p]
+        else:
+            pad_pairs = [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(nd)]
+        padding_lax = pad_pairs
+
+    def f(a, w, *b):
+        if transpose:
+            # Transposed conv as input-dilated conv (the VJP formulation —
+            # exact control over output_padding + groups).  Paddle weight
+            # layout is [in, out/groups, *k]; regroup to OIHW with O=out.
+            if pad_pairs is None:
+                raise ValueError("string padding unsupported for conv_transpose")
+            k_spatial = w.shape[2:]
+            cin, cog = w.shape[0], w.shape[1]
+            wg = w.reshape((groups, cin // groups, cog) + k_spatial)
+            wg = jnp.swapaxes(wg, 1, 2)  # (g, out/g, in/g, *k)
+            w_oihw = wg.reshape((groups * cog, cin // groups) + k_spatial)
+            w_oihw = jnp.flip(w_oihw, axis=tuple(range(2, 2 + nd)))
+            tp = []
+            for i in range(nd):
+                k_eff = dilation[i] * (k_spatial[i] - 1) + 1
+                lo, hi = pad_pairs[i]
+                tp.append((k_eff - 1 - lo, k_eff - 1 - hi + out_pad[i]))
+            lhs = a if channel_first else jnp.moveaxis(a, -1, 1)
+            out = jax.lax.conv_general_dilated(
+                lhs, w_oihw, window_strides=(1,) * nd, padding=tp,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=(f"NC{spatial}", f"OI{spatial}", f"NC{spatial}"),
+                feature_group_count=groups,
+            )
+            if not channel_first:
+                out = jnp.moveaxis(out, 1, -1)
+        else:
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=stride, padding=padding_lax,
+                rhs_dilation=dilation, dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=jnp.float32 if a.dtype == jnp.bfloat16 else None,
+            )
+            out = out.astype(a.dtype)
+        if b:
+            ch_axis = dn[2].index("C")
+            shape = [1] * out.ndim
+            shape[ch_axis] = b[0].shape[0]
+            out = out + b[0].reshape(shape)
+        return out
+
+    args = [x, weight]
+    if bias is not None:
+        args.append(_t(bias))
+    return apply_op("conv%dd" % nd, f, *args)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCL", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 1, transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 2, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0, groups=1, dilation=1, data_format="NCDHW", name=None):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3, transpose=True, output_padding=output_padding)
+
+
+def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    x = _t(x)
+    kernel = _pair(kernel, nd)
+    stride = _pair(stride if stride is not None else kernel, nd)
+    pad = _pair(padding, nd)
+    channel_first = data_format.startswith("NC")
+
+    window = (1, 1) + kernel if channel_first else (1,) + kernel + (1,)
+    strides = (1, 1) + stride if channel_first else (1,) + stride + (1,)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad) if channel_first else ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+
+    def f(a):
+        if mode == "max":
+            init = -jnp.inf
+            out = jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+        else:
+            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
+            if exclusive and any(p > 0 for p in pad):
+                ones = jnp.ones_like(a)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+                out = summed / counts
+            else:
+                out = summed / float(np.prod(kernel))
+        return out.astype(a.dtype)
+
+    return apply_op(f"{mode}_pool{nd}d", f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max", ceil_mode, data_format="NCL")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode, data_format=data_format)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode, data_format=data_format)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 1, "avg", ceil_mode, exclusive, data_format="NCL")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive, data_format=data_format)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive, data_format=data_format)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    x = _t(x)
+    out_l = output_size if isinstance(output_size, int) else output_size[0]
+
+    def f(a):
+        l = a.shape[-1]
+        return jnp.mean(a.reshape(*a.shape[:-1], out_l, l // out_l), axis=-1)
+
+    return apply_op("adaptive_avg_pool1d", f, x)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    x = _t(x)
+    oh, ow = _pair(output_size, 2)
+
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        n, c, h, w = a.shape
+        if oh is None or (h % oh == 0 and w % ow == 0):
+            out = jnp.mean(a.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+        else:
+            # general adaptive pooling via interpolation-style bucketing
+            out = jnp.stack([
+                jnp.stack([
+                    jnp.mean(a[:, :, int(np.floor(i * h / oh)):int(np.ceil((i + 1) * h / oh)),
+                              int(np.floor(j * w / ow)):int(np.ceil((j + 1) * w / ow))], axis=(2, 3))
+                    for j in range(ow)], axis=-1)
+                for i in range(oh)], axis=-2)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply_op("adaptive_avg_pool2d", f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    x = _t(x)
+    oh, ow = _pair(output_size, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        return jnp.max(a.reshape(n, c, oh, h // oh, ow, w // ow), axis=(3, 5))
+
+    return apply_op("adaptive_max_pool2d", f, x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(a):
+        channel_first = data_format.startswith("NC")
+        if channel_first:
+            spatial = a.shape[2:]
+        else:
+            spatial = a.shape[1:-1]
+        if size is not None:
+            new_spatial = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+            new_spatial = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+        if channel_first:
+            new_shape = a.shape[:2] + new_spatial
+        else:
+            new_shape = (a.shape[0],) + new_spatial + (a.shape[-1],)
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        return jax.image.resize(a, new_shape, method=method).astype(a.dtype)
+
+    return apply_op("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    x = _t(x)
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c // (r * r), r, r, h, w)
+        out = jnp.transpose(out, (0, 1, 4, 2, 5, 3))
+        return out.reshape(n, c // (r * r), h * r, w * r)
+
+    return apply_op("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    x = _t(x)
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        out = a.reshape(n, c, h // r, r, w // r, r)
+        out = jnp.transpose(out, (0, 1, 3, 5, 2, 4))
+        return out.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(a):
+        n, c, h, w = a.shape
+        return a.reshape(n, groups, c // groups, h, w).swapaxes(1, 2).reshape(n, c, h, w)
+
+    return apply_op("channel_shuffle", f, x)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = _t(x)
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+    d = _pair(dilations, 2)
+
+    def f(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])))
+        oh = (h + 2 * p[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (w + 2 * p[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        patches = []
+        for i in range(k[0]):
+            for j in range(k[1]):
+                patches.append(a_p[:, :, i * d[0]:i * d[0] + oh * s[0]:s[0], j * d[1]:j * d[1] + ow * s[1]:s[1]])
+        out = jnp.stack(patches, axis=2)  # n, c, k*k, oh, ow
+        return out.reshape(n, c * k[0] * k[1], oh * ow)
+
+    return apply_op("unfold", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = _t(x)
+    oh, ow = _pair(output_sizes, 2)
+    k = _pair(kernel_sizes, 2)
+    s = _pair(strides, 2)
+    p = _pair(paddings, 2)
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (k[0] * k[1])
+        nh = (oh + 2 * p[0] - k[0]) // s[0] + 1
+        nw = (ow + 2 * p[1] - k[1]) // s[1] + 1
+        a_r = a.reshape(n, c, k[0], k[1], nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * p[0], ow + 2 * p[1]), dtype=a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                out = out.at[:, :, i:i + nh * s[0]:s[0], j:j + nw * s[1]:s[1]].add(a_r[:, :, i, j])
+        return out[:, :, p[0]:p[0] + oh, p[1]:p[1] + ow]
+
+    return apply_op("fold", f, x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    x = _t(x)
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = list(pad)
+
+    def f(a):
+        nd = a.ndim
+        if len(pad) == 2 * nd:
+            pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle semantics: pad applies to last len(pad)//2 spatial dims
+            # in data_format order, innermost-last order like torch
+            n_spatial = len(pad) // 2
+            pairs = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                dims = list(range(2, 2 + n_spatial))
+            else:
+                dims = list(range(1, 1 + n_spatial))
+            for idx, dim in enumerate(reversed(dims)):
+                pairs[dim] = (pad[2 * idx], pad[2 * idx + 1])
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, pairs, mode="constant", constant_values=value)
+        return jnp.pad(a, pairs, mode=jmode)
+
+    return apply_op("pad", f, x)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+
+def _reduce_loss(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = _t(input), _t(label)
+
+    def f(logits, *rest):
+        i = 0
+        if soft_label:
+            lbl = rest[i]; i += 1
+        else:
+            lbl = label._data
+        w = rest[i] if weight is not None else None
+        logp = jax.nn.log_softmax(logits, axis=axis) if use_softmax else jnp.log(jnp.maximum(logits, 1e-30))
+        n_class = logits.shape[axis]
+        if soft_label:
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_class
+            out = -jnp.sum(soft * logp, axis=axis)
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            squeeze = lbl_i.ndim == logits.ndim and lbl_i.shape[axis] == 1
+            if squeeze:
+                lbl_i = jnp.squeeze(lbl_i, axis=axis)
+            valid = lbl_i != ignore_index
+            lbl_safe = jnp.where(valid, lbl_i, 0)
+            picked = jnp.take_along_axis(logp, lbl_safe[..., None], axis=axis)[..., 0] if axis in (-1, logits.ndim - 1) else \
+                jnp.take_along_axis(logp, jnp.expand_dims(lbl_safe, axis), axis=axis).squeeze(axis)
+            if label_smoothing > 0:
+                smooth = -jnp.mean(logp, axis=axis)
+                out = -(1 - label_smoothing) * picked + label_smoothing * smooth
+            else:
+                out = -picked
+            if w is not None:
+                wt = jnp.take(w, lbl_safe)
+                out = out * wt
+                out = jnp.where(valid, out, 0.0)
+                if reduction == "mean":
+                    # normalize by the sum of applied weights (reference semantics)
+                    denom = jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+                    return jnp.sum(out) / denom
+            else:
+                out = jnp.where(valid, out, 0.0)
+                if reduction == "mean":
+                    denom = jnp.maximum(jnp.sum(valid.astype(out.dtype)), 1.0)
+                    return jnp.sum(out) / denom
+        return _reduce_loss(out, reduction)
+
+    args = [input]
+    if soft_label:
+        args.append(label)
+    if weight is not None:
+        args.append(_t(weight))
+    return apply_op("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    loss = apply_op("unsqueeze", lambda a: jnp.expand_dims(a, axis), loss)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    input, label = _t(input), _t(label)
+
+    def f(p, y, *w):
+        eps = 1e-12
+        out = -(y * jnp.log(jnp.maximum(p, eps)) + (1 - y) * jnp.log(jnp.maximum(1 - p, eps)))
+        if w:
+            out = out * w[0]
+        return _reduce_loss(out, reduction)
+
+    args = [input, label] + ([_t(weight)] if weight is not None else [])
+    return apply_op("binary_cross_entropy", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None, name=None):
+    logit, label = _t(logit), _t(label)
+
+    def f(z, y, *rest):
+        i = 0
+        w = rest[i] if weight is not None else None
+        if weight is not None:
+            i += 1
+        pw = rest[i] if pos_weight is not None else None
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            out = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            out = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            out = out * w
+        return _reduce_loss(out, reduction)
+
+    args = [logit, label]
+    if weight is not None:
+        args.append(_t(weight))
+    if pos_weight is not None:
+        args.append(_t(pos_weight))
+    return apply_op("bce_with_logits", f, *args)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op("mse_loss", lambda a, b: _reduce_loss(jnp.square(a - b), reduction), _t(input), _t(label))
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op("l1_loss", lambda a, b: _reduce_loss(jnp.abs(a - b), reduction), _t(input), _t(label))
+
+
+def square_error_cost(input, label):
+    return apply_op("square_error_cost", lambda a, b: jnp.square(a - b), _t(input), _t(label))
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        "log_loss",
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        _t(input), _t(label),
+    )
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    input, label = _t(input), _t(label)
+
+    def f(logp, *w):
+        lbl = label._data.astype(jnp.int32)
+        valid = lbl != ignore_index
+        lbl_safe = jnp.where(valid, lbl, 0)
+        out = -jnp.take_along_axis(logp, lbl_safe[:, None], axis=1)[:, 0]
+        if w:
+            wt = jnp.take(w[0], lbl_safe)
+            out = out * wt
+            if reduction == "mean":
+                return jnp.sum(jnp.where(valid, out, 0.0)) / jnp.maximum(jnp.sum(jnp.where(valid, wt, 0.0)), 1e-12)
+        out = jnp.where(valid, out, 0.0)
+        if reduction == "mean":
+            return jnp.sum(out) / jnp.maximum(jnp.sum(valid.astype(out.dtype)), 1.0)
+        return _reduce_loss(out, reduction)
+
+    args = [input] + ([_t(weight)] if weight is not None else [])
+    return apply_op("nll_loss", f, *args)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        out = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta
+        # paddle: huber-style with delta; matches smooth_l1 when delta=1
+        out = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce_loss(out, reduction)
+
+    return apply_op("smooth_l1_loss", f, _t(input), _t(label))
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def f(logp, y):
+        out = y * (jnp.log(jnp.maximum(y, 1e-12)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(out) / logp.shape[0]
+        return _reduce_loss(out, reduction)
+
+    return apply_op("kl_div", f, _t(input), _t(label))
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    def f(a, b, y):
+        return _reduce_loss(jnp.maximum(0.0, -y * (a - b) + margin), reduction)
+
+    return apply_op("margin_ranking_loss", f, _t(input), _t(other), _t(label))
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / (jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1) + 1e-12)
+        out = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce_loss(out, reduction)
+
+    return apply_op("cosine_embedding_loss", f, _t(input1), _t(input2), _t(label))
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        out = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce_loss(out, reduction)
+
+    return apply_op("hinge_embedding_loss", f, _t(input), _t(label))
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, reduction="mean", name=None):
+    def f(a, y):
+        if log_input:
+            out = jnp.exp(a) - y * a
+        else:
+            out = a - y * jnp.log(a + epsilon)
+        return _reduce_loss(out, reduction)
+
+    return apply_op("poisson_nll_loss", f, _t(input), _t(label))
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        return _reduce_loss(jnp.maximum(0.0, dp - dn + margin), reduction)
+
+    return apply_op("triplet_margin_loss", f, _t(input), _t(positive), _t(negative))
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        out = a_t * ((1 - p_t) ** gamma) * ce
+        if n:
+            out = out / n[0]
+        return _reduce_loss(out, reduction)
+
+    args = [_t(logit), _t(label)] + ([_t(normalizer)] if normalizer is not None else [])
+    return apply_op("sigmoid_focal_loss", f, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    # log_probs: (T, B, C) paddle layout
+    lp = _t(log_probs)
+    lbl = _t(labels)
+    il = _t(input_lengths)
+    ll = _t(label_lengths)
+
+    def f(logits):
+        import optax
+
+        # optax expects (B, T, C) with logit inputs and padded labels (B, S)
+        x = jnp.transpose(logits, (1, 0, 2))
+        b, t, c = x.shape
+        labels_arr = lbl._data
+        if labels_arr.ndim == 1:
+            labels_arr = labels_arr[None]
+        logit_pad = (jnp.arange(t)[None, :] >= il._data[:, None]).astype(x.dtype)
+        label_pad = (jnp.arange(labels_arr.shape[1])[None, :] >= ll._data[:, None]).astype(x.dtype)
+        per_seq = optax.ctc_loss(x, logit_pad, labels_arr, label_pad, blank_id=blank)
+        return _reduce_loss(per_seq / jnp.maximum(ll._data.astype(per_seq.dtype), 1.0) if reduction == "mean" else per_seq, reduction)
+
+    return apply_op("ctc_loss", f, lp)
+
+
+# ---------------------------------------------------------------------------
+# attention — TPU hot path
+# ---------------------------------------------------------------------------
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, name=None):
+    """(B, S, H, D) layout like the reference (nn/functional/flash_attention.py:410)."""
+    from ...kernels import attention as _attn
+
+    q, k, v = _t(query), _t(key), _t(value)
+    args = [q, k, v]
+    if attn_mask is not None:
+        args.append(_t(attn_mask))
+
+        def f(qq, kk, vv, mm):
+            return _attn(qq, kk, vv, mask=mm, causal=is_causal)
+    else:
+        def f(qq, kk, vv):
+            return _attn(qq, kk, vv, mask=None, causal=is_causal)
+
+    out = apply_op("scaled_dot_product_attention", f, *args)
+    if dropout_p > 0.0 and training:
+        out = dropout(out, p=dropout_p, training=training)
+    return out
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    fixed_seed_offset=None, rng_name="", training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, None, dropout, causal, training)
+    return out, None
+
+
+class sdp_kernel:
+    def __init__(self, **kwargs):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_op("cosine_similarity", f, _t(x1), _t(x2))
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return apply_op(
+        "pairwise_distance",
+        lambda a, b: jnp.linalg.norm(a - b + epsilon, ord=p, axis=-1, keepdims=keepdim),
+        _t(x), _t(y),
+    )
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = _t(label)
+
+    def f(y, *pd):
+        n = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / n
+
+    args = [label] + ([_t(prior_dist)] if prior_dist is not None else [])
+    return apply_op("label_smooth", f, *args)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    x = _t(x)
+    ml = maxlen if maxlen is not None else int(np.asarray(x._data).max())
+    return apply_op(
+        "sequence_mask",
+        lambda l: (jnp.arange(ml)[None, :] < l[..., None]).astype(to_jax_dtype(convert_dtype(dtype))),
+        x, nondiff=(0,),
+    )
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    x = _t(x)
+
+    def f(a):
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        ar = a.reshape(n, seg_num, c, h, w)
+        fold_ = int(c * shift_ratio)
+        left = jnp.concatenate([ar[:, 1:, :fold_], jnp.zeros_like(ar[:, :1, :fold_])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(ar[:, :1, fold_:2 * fold_]), ar[:, :-1, fold_:2 * fold_]], axis=1)
+        mid = ar[:, :, 2 * fold_:]
+        return jnp.concatenate([left, right, mid], axis=2).reshape(nt, c, h, w)
+
+    return apply_op("temporal_shift", f, x)

@@ -1,0 +1,124 @@
+"""Op namespace + Tensor method/operator patching.
+
+Single source of op definitions; this module plays the role of the reference's
+YAML→codegen pipeline output (phi/api/yaml + eager_math_op_patch.cc): each op is
+defined once and exposed as (a) a paddle_tpu.* function, (b) a Tensor method,
+(c) an operator overload.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply_op, to_tensor
+from . import creation, linalg, manipulation, math, random  # noqa: F401
+from .creation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+__all__ = (
+    list(creation.__all__) + list(math.__all__) + list(manipulation.__all__)
+    + list(linalg.__all__) + list(random.__all__)
+)
+
+
+def _swap(fn):
+    return lambda self, other: fn(other, self)
+
+
+def _patch_tensor_methods():
+    T = Tensor
+    # arithmetic operators
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(o, s)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: math.subtract(o, s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(o, s)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: math.divide(o, s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__rmod__ = lambda s, o: math.mod(o, s)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: math.pow(o, s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__invert__ = lambda s: math.logical_not(s)
+    T.__and__ = lambda s, o: math.bitwise_and(s, o)
+    T.__or__ = lambda s, o: math.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: math.bitwise_xor(s, o)
+    # comparisons
+    T.__eq__ = lambda s, o: math.equal(s, o)
+    T.__ne__ = lambda s, o: math.not_equal(s, o)
+    T.__lt__ = lambda s, o: math.less_than(s, o)
+    T.__le__ = lambda s, o: math.less_equal(s, o)
+    T.__gt__ = lambda s, o: math.greater_than(s, o)
+    T.__ge__ = lambda s, o: math.greater_equal(s, o)
+
+    # methods: every op whose first arg is a tensor
+    method_sources = [creation, math, manipulation, linalg, random]
+    skip = {"zeros", "ones", "full", "empty", "arange", "linspace", "logspace",
+            "eye", "meshgrid", "rand", "randn", "randint", "randperm", "uniform",
+            "normal", "standard_normal", "tril_indices", "triu_indices",
+            "broadcast_shape", "as_tensor", "log_normal", "binomial", "scatter_nd"}
+    for mod in method_sources:
+        for name in mod.__all__:
+            if name in skip or hasattr(T, name):
+                continue
+            setattr(T, name, getattr(mod, name))
+
+    # paddle-specific method aliases
+    T.astype = lambda s, dtype: manipulation.cast(s, dtype)
+    T.cast = lambda s, dtype: manipulation.cast(s, dtype)
+    T.dim = lambda s: s.ndim
+    T.add_ = lambda s, o: _inplace(s, math.add(s, o))
+    T.subtract_ = lambda s, o: _inplace(s, math.subtract(s, o))
+    T.multiply_ = lambda s, o: _inplace(s, math.multiply(s, o))
+    T.divide_ = lambda s, o: _inplace(s, math.divide(s, o))
+    T.clip_ = lambda s, min=None, max=None: _inplace(s, math.clip(s, min, max))
+    T.scale_ = lambda s, scale=1.0, bias=0.0, bias_after_scale=True, act=None: _inplace(
+        s, math.scale(s, scale, bias, bias_after_scale))
+    T.zero_ = lambda s: _inplace(s, creation.zeros_like(s))
+    T.fill_ = lambda s, v: _inplace(s, creation.full_like(s, v))
+    T.exp_ = lambda s: _inplace(s, math.exp(s))
+    T.sqrt_ = lambda s: _inplace(s, math.sqrt(s))
+    T.rsqrt_ = lambda s: _inplace(s, math.rsqrt(s))
+    T.tanh_ = lambda s: _inplace(s, math.tanh(s))
+    T.remainder_ = lambda s, o: _inplace(s, math.mod(s, o))
+    T.floor_ = lambda s: _inplace(s, math.floor(s))
+    T.ceil_ = lambda s: _inplace(s, math.ceil(s))
+    T.round_ = lambda s: _inplace(s, math.round(s))
+    T.abs_ = lambda s: _inplace(s, math.abs(s))
+    T.sigmoid_ = lambda s: _inplace(s, math.sigmoid(s))
+
+    @property
+    def _T(s):
+        return manipulation.transpose(s, list(range(s.ndim))[::-1])
+
+    T.T = _T
+
+    @property
+    def _mT(s):
+        perm = list(range(s.ndim))
+        perm[-1], perm[-2] = perm[-2], perm[-1]
+        return manipulation.transpose(s, perm)
+
+    T.mT = _mT
+
+
+def _inplace(t, out):
+    t._data, t._node, t._out_idx = out._data, out._node, out._out_idx
+    if out._node is not None:
+        outs = list(out._node.outputs)
+        outs[out._out_idx] = t
+        out._node.outputs = tuple(outs)
+    return t
+
+
+_patch_tensor_methods()

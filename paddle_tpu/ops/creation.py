@@ -1,0 +1,161 @@
+"""Tensor creation ops (python/paddle/tensor/creation.py parity)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype, to_jax_dtype
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "zeros", "ones", "full", "empty", "zeros_like", "ones_like", "full_like",
+    "empty_like", "arange", "linspace", "logspace", "eye", "diag", "diagflat",
+    "tril", "triu", "meshgrid", "assign", "clone", "tril_indices", "triu_indices",
+    "complex", "as_tensor",
+]
+
+
+def _dt(dtype):
+    return to_jax_dtype(convert_dtype(dtype) if dtype is not None else framework.get_default_dtype())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), dtype=_dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), dtype=_dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        if isinstance(fill_value, bool):
+            dtype = "bool"
+        elif isinstance(fill_value, int):
+            dtype = framework.get_default_dtype()
+        else:
+            dtype = framework.get_default_dtype()
+    return Tensor(jnp.full(_shape(shape), fill_value, dtype=_dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype=dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.zeros_like(x._data, dtype=to_jax_dtype(convert_dtype(dtype)) if dtype else None))
+
+
+def ones_like(x, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.ones_like(x._data, dtype=to_jax_dtype(convert_dtype(dtype)) if dtype else None))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=to_jax_dtype(convert_dtype(dtype)) if dtype else None))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype=dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange expects python scalars")
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        dtype = "int64" if all(isinstance(v, int) for v in (start, end, step)) else framework.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=_dt(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(float(start), float(stop), int(num), dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(int(num_rows), num_columns if num_columns is None else int(num_columns), dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    if x.ndim == 1 and padding_value != 0:
+        return apply_op(
+            "diag",
+            lambda a: jnp.where(jnp.eye(a.shape[0], dtype=bool), 0, padding_value).astype(a.dtype)
+            + jnp.diag(a, k=offset),
+            x,
+        )
+    return apply_op("diag", lambda a: jnp.diag(a, k=offset), x)
+
+
+def diagflat(x, offset=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return apply_op("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return apply_op("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    return apply_op("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=_dt(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = [to_tensor(a) if not isinstance(a, Tensor) else a for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    return apply_op("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *tensors)
+
+
+def assign(x, output=None):
+    x = to_tensor(x) if not isinstance(x, Tensor) else x
+    out = apply_op("assign", lambda a: a + 0, x)
+    if output is not None:
+        output._data = out._data
+        output._node = out._node
+        output._out_idx = out._out_idx
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def complex(real, imag, name=None):
+    return apply_op("complex", jnp.complex_ if False else (lambda r, i: r + 1j * i), real, imag)
+
+
+def as_tensor(data, dtype=None):
+    return to_tensor(data, dtype=dtype)

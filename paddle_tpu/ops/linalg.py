@@ -1,0 +1,223 @@
+"""Linear algebra ops (python/paddle/tensor/linalg.py + paddle.linalg parity).
+
+matmul/einsum are the MXU hot path: dispatched through apply_op so AMP can keep
+them in bfloat16 (the reference's analog is legacy_ops.yaml:649 matmul with its
+MatmulSpmdInferForward sharding rule; here GSPMD infers sharding from operands).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "einsum", "norm", "dist", "cholesky", "inverse",
+    "det", "slogdet", "svd", "qr", "eig", "eigh", "eigvals", "eigvalsh",
+    "solve", "triangular_solve", "cholesky_solve", "lstsq", "matrix_power",
+    "matrix_rank", "pinv", "lu", "tensordot", "multi_dot", "cond", "cov",
+    "corrcoef", "l2_normalize", "householder_product", "matrix_exp", "vander",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = _t(x), _t(y)
+
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply_op("matmul", f, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def einsum(equation, *operands):
+    tensors = [_t(o) for o in operands]
+    return apply_op("einsum", lambda *xs: jnp.einsum(equation, *xs), *tensors)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = _t(x)
+    if p is None:
+        p = 2 if axis is not None or x.ndim == 1 else "fro"
+
+    def f(a):
+        if p == "fro":
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.sum(jnp.linalg.svd(a, compute_uv=False), axis=-1, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        return jnp.sum(jnp.abs(a) ** p, axis=ax, keepdims=keepdim) ** (1.0 / p)
+
+    return apply_op("norm", f, x)
+
+
+def dist(x, y, p=2, name=None):
+    return norm(apply_op("sub", jnp.subtract, _t(x), _t(y)), p=float(p))
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12, name=None):
+    return apply_op("l2_normalize", lambda a: a / jnp.maximum(jnp.linalg.norm(a, axis=axis, keepdims=True), epsilon), _t(x))
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply_op("cholesky", f, _t(x))
+
+
+def inverse(x, name=None):
+    return apply_op("inverse", jnp.linalg.inv, _t(x))
+
+
+def det(x, name=None):
+    return apply_op("det", jnp.linalg.det, _t(x))
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logabs = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logabs])
+    return apply_op("slogdet", f, _t(x))
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), _t(x))
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), _t(x))
+
+
+def eig(x, name=None):
+    x = _t(x)
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    x = _t(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), _t(x))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), _t(x))
+
+
+def solve(x, y, name=None):
+    return apply_op("solve", jnp.linalg.solve, _t(x), _t(y))
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular)
+    return apply_op("triangular_solve", f, _t(x), _t(y))
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, l):
+        return jax.scipy.linalg.cho_solve((l, not upper), b)
+    return apply_op("cholesky_solve", f, _t(x), _t(y))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    x, y = _t(x), _t(y)
+    sol, res, rank_, sv = np.linalg.lstsq(np.asarray(x._data), np.asarray(y._data), rcond=rcond)
+    return (Tensor(jnp.asarray(sol)), Tensor(jnp.asarray(res)), Tensor(jnp.asarray(rank_)), Tensor(jnp.asarray(sv)))
+
+
+def matrix_power(x, n, name=None):
+    return apply_op("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), _t(x))
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, rtol=tol), _t(x))
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op("pinv", lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian), _t(x))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = _t(x)
+    lu_, piv = jax.scipy.linalg.lu_factor(x._data)
+    out = (Tensor(lu_), Tensor(piv.astype(jnp.int32) + 1))
+    if get_infos:
+        return out + (Tensor(jnp.zeros((), dtype=jnp.int32)),)
+    return out
+
+
+def tensordot(x, y, axes=2, name=None):
+    x, y = _t(x), _t(y)
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = ax.tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(a.tolist() if isinstance(a, Tensor) else a) if isinstance(a, (list, tuple, Tensor)) else a for a in ax)
+    return apply_op("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def multi_dot(x, name=None):
+    tensors = [_t(i) for i in x]
+    return apply_op("multi_dot", lambda *xs: jnp.linalg.multi_dot(list(xs)), *tensors)
+
+
+def cond(x, p=None, name=None):
+    return apply_op("cond", lambda a: jnp.linalg.cond(a, p=p), _t(x))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply_op("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), _t(x))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), _t(x))
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        return jax.scipy.linalg.lu(a)[0] if False else _householder(a, t)
+    def _householder(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros(i, a.dtype), jnp.ones(1, a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
+        return q[:, :n]
+    return apply_op("householder_product", f, _t(x), _t(tau))
+
+
+def matrix_exp(x, name=None):
+    return apply_op("matrix_exp", jax.scipy.linalg.expm, _t(x))
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return apply_op("vander", lambda a: jnp.vander(a, N=n, increasing=increasing), _t(x))

@@ -1,0 +1,585 @@
+"""Shape / indexing / search ops (python/paddle/tensor/{manipulation,search}.py parity)."""
+
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype, to_jax_dtype
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "reshape", "reshape_", "transpose", "flatten", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "cast", "cast_",
+    "gather", "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "index_add", "index_put", "masked_select",
+    "masked_fill", "roll", "flip", "rot90", "unbind", "unstack",
+    "repeat_interleave", "take_along_axis", "put_along_axis", "moveaxis",
+    "swapaxes", "t", "as_complex", "as_real", "argmax", "argmin", "argsort",
+    "sort", "topk", "nonzero", "unique", "unique_consecutive", "searchsorted",
+    "kthvalue", "mode", "bucketize", "slice", "strided_slice", "shard_index",
+    "numel", "rank", "shape", "tolist", "flatten_", "tensor_split", "view",
+    "view_as", "atleast_1d", "atleast_2d", "atleast_3d", "diag_embed",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    x = _t(x)
+    shp = _static_shape(shape)
+    return apply_op("reshape", lambda a: a.reshape(shp), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def transpose(x, perm=None, name=None):
+    x = _t(x)
+    p = tuple(perm) if perm is not None else None
+    return apply_op("transpose", lambda a: jnp.transpose(a, p), x)
+
+
+def t(x, name=None):
+    x = _t(x)
+    if x.ndim < 2:
+        return apply_op("t", lambda a: a, x)
+    return apply_op("t", lambda a: a.T, x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op("moveaxis", lambda a: jnp.moveaxis(a, source, destination), _t(x))
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), _t(x))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    x = _t(x)
+    nd = x.ndim
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    shp = x.shape[:s] + [int(np.prod(x.shape[s:e + 1] or [1]))] + x.shape[e + 1:]
+    return apply_op("flatten", lambda a: a.reshape(tuple(shp)), x)
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    out = flatten(x, start_axis, stop_axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def squeeze(x, axis=None, name=None):
+    x = _t(x)
+    if axis is None:
+        return apply_op("squeeze", lambda a: jnp.squeeze(a), x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    ax = tuple(a for a in ax if x.shape[a] == 1)
+    return apply_op("squeeze", lambda a: jnp.squeeze(a, axis=ax) if ax else a, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    x = _t(x)
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    def f(a):
+        out = a
+        for d in sorted([d % (out.ndim + len(ax)) if d < 0 else d for d in ax]):
+            out = jnp.expand_dims(out, d)
+        return out
+    return apply_op("unsqueeze", f, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def concat(x, axis=0, name=None):
+    tensors = [_t(i) for i in x]
+    ax = int(axis._data) if isinstance(axis, Tensor) else axis
+    return apply_op("concat", lambda *xs: jnp.concatenate(xs, axis=ax), *tensors)
+
+
+def stack(x, axis=0, name=None):
+    tensors = [_t(i) for i in x]
+    return apply_op("stack", lambda *xs: jnp.stack(xs, axis=axis), *tensors)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    x = _t(x)
+    ax = int(axis._data) if isinstance(axis, Tensor) else axis
+    dim = x.shape[ax]
+    if isinstance(num_or_sections, int):
+        if dim % num_or_sections != 0:
+            raise ValueError(
+                f"paddle.split: axis {ax} size {dim} is not divisible by "
+                f"num={num_or_sections}; pass a sections list instead")
+        sections = [dim // num_or_sections] * num_or_sections
+    else:
+        sections = [dim // len(num_or_sections) if s in (-1, None) else int(s) for s in num_or_sections]
+        rem = dim - sum(s for s in sections)
+        # resolve a single -1
+        raw = list(num_or_sections)
+        if any(s in (-1, None) for s in raw):
+            known = sum(int(s) for s in raw if s not in (-1, None))
+            sections = [int(s) if s not in (-1, None) else dim - known for s in raw]
+    offsets = np.cumsum([0] + sections[:-1])
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, int(o), int(o) + int(s), axis=ax) for o, s in zip(offsets, sections))
+    return list(apply_op("split", f, x))
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    x = _t(x)
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis))
+    return list(apply_op("tensor_split", f, x))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0, name=None):
+    x = _t(x)
+    n = x.shape[axis]
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return list(apply_op("unbind", f, x))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _static_shape(repeat_times)
+    return apply_op("tile", lambda a: jnp.tile(a, reps), _t(x))
+
+
+def expand(x, shape, name=None):
+    x = _t(x)
+    shp = list(_static_shape(shape))
+    cur = x.shape
+    for i in range(1, len(cur) + 1):
+        if shp[-i] == -1:
+            shp[-i] = cur[-i]
+    return apply_op("expand", lambda a: jnp.broadcast_to(a, tuple(shp)), x)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = [_t(i) for i in inputs]
+    return list(apply_op("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *tensors))
+
+
+def cast(x, dtype, name=None):
+    x = _t(x)
+    jd = to_jax_dtype(convert_dtype(dtype))
+    return apply_op("cast", lambda a: a.astype(jd), x)
+
+
+def cast_(x, dtype, name=None):
+    out = cast(x, dtype)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+# -- indexing ---------------------------------------------------------------
+
+
+def gather(x, index, axis=0, name=None):
+    x, index = _t(x), _t(index)
+    ax = int(axis._data) if isinstance(axis, Tensor) else axis
+    return apply_op("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=ax), x, index, nondiff=(1,))
+
+
+def gather_nd(x, index, name=None):
+    x, index = _t(x), _t(index)
+    def f(a, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a[idx]
+    return apply_op("gather_nd", f, x, index, nondiff=(1,))
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+
+    def f_zero(a, i, u):
+        # paddle scatter overwrite=False semantics: zero the rows then add
+        i = i.reshape(-1)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply_op("scatter", f if overwrite else f_zero, x, index, updates, nondiff=(1,))
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    out = scatter(x, index, updates, overwrite)
+    x._data, x._node, x._out_idx = out._data, out._node, out._out_idx
+    return x
+
+
+def scatter_nd(index, updates, shape, name=None):
+    index, updates = _t(index), _t(updates)
+    shp = _static_shape(shape)
+    def f(i, u):
+        zeros = jnp.zeros(shp, dtype=u.dtype)
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return zeros.at[idx].add(u)
+    return apply_op("scatter_nd", f, index, updates, nondiff=(0,))
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    x, index, updates = _t(x), _t(index), _t(updates)
+    def f(a, i, u):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return a.at[idx].add(u)
+    return apply_op("scatter_nd_add", f, x, index, updates, nondiff=(1,))
+
+
+def index_select(x, index, axis=0, name=None):
+    x, index = _t(x), _t(index)
+    return apply_op("index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index, nondiff=(1,))
+
+
+def index_sample(x, index):
+    x, index = _t(x), _t(index)
+    return apply_op("index_sample", lambda a, i: jnp.take_along_axis(a, i, axis=1), x, index, nondiff=(1,))
+
+
+def index_add(x, index, axis, value, name=None):
+    x, index, value = _t(x), _t(index), _t(value)
+    def f(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op("index_add", f, x, index, value, nondiff=(1,))
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    x = _t(x)
+    idx = tuple(i._data if isinstance(i, Tensor) else i for i in indices)
+    value = _t(value)
+    def f(a, v):
+        return a.at[idx].add(v) if accumulate else a.at[idx].set(v)
+    return apply_op("index_put", f, x, value)
+
+
+def masked_select(x, mask, name=None):
+    x, mask = _t(x), _t(mask)
+    # Dynamic output shape — eager only (not jit-traceable), like the reference op.
+    arr = np.asarray(x._data)[np.asarray(mask._data)]
+    return Tensor(jnp.asarray(arr))
+
+
+def masked_fill(x, mask, value, name=None):
+    x, mask = _t(x), _t(mask)
+    v = value._data if isinstance(value, Tensor) else value
+    return apply_op("masked_fill", lambda a, m: jnp.where(m, v, a), x, mask, nondiff=(1,))
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op("roll", lambda a: jnp.roll(a, shifts, axis=axis), _t(x))
+
+
+def flip(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return apply_op("flip", lambda a: jnp.flip(a, axis=ax), _t(x))
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), _t(x))
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    x = _t(x)
+    r = repeats._data if isinstance(repeats, Tensor) else repeats
+    return apply_op("repeat_interleave", lambda a: jnp.repeat(a, r, axis=axis), x)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    arr, indices = _t(arr), _t(indices)
+    return apply_op("take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices, nondiff=(1,))
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    arr, indices = _t(arr), _t(indices)
+    values = _t(values)
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
+        if reduce == "add":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False, mode="add") if hasattr(jnp, "put_along_axis") else _put(a, i, v, "add")
+        return _put(a, i, v, "assign")
+    def _put(a, i, v, mode):
+        a_m = jnp.moveaxis(a, axis, -1)
+        i_m = jnp.moveaxis(i, axis, -1)
+        v_m = jnp.moveaxis(v, axis, -1)
+        idx_grid = jnp.indices(i_m.shape[:-1])
+        full_idx = tuple(g[..., None] * jnp.ones_like(i_m) for g in idx_grid) + (i_m,)
+        out = a_m.at[full_idx].add(v_m) if mode == "add" else a_m.at[full_idx].set(v_m)
+        return jnp.moveaxis(out, -1, axis)
+    return apply_op("put_along_axis", f, arr, indices, values, nondiff=(1,))
+
+
+def as_complex(x, name=None):
+    return apply_op("as_complex", lambda a: jax.lax.complex(a[..., 0], a[..., 1]), _t(x))
+
+
+def as_real(x, name=None):
+    return apply_op("as_real", lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1), _t(x))
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op("atleast_1d", jnp.atleast_1d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op("atleast_2d", jnp.atleast_2d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op("atleast_3d", jnp.atleast_3d, _t(x)) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    x = _t(input)
+    def f(a):
+        n = a.shape[-1]
+        out = jnp.zeros(a.shape + (n + abs(offset),), dtype=a.dtype)
+        eye_idx = jnp.arange(n)
+        out = out.at[..., eye_idx, eye_idx + max(offset, 0)].set(a) if offset >= 0 else \
+            out.at[..., eye_idx - offset, eye_idx].set(a)
+        # place dims
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # build permutation mapping last two dims to d1, d2
+        target = [None] * nd
+        target[d1] = nd - 2
+        target[d2] = nd - 1
+        it = iter(perm)
+        for i in range(nd):
+            if target[i] is None:
+                target[i] = next(it)
+        return jnp.transpose(out, tuple(np.argsort(np.argsort(target)) if False else target))
+    return apply_op("diag_embed", f, x)
+
+
+# -- search -----------------------------------------------------------------
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op("argmax", lambda a: jnp.argmax(a, axis=axis, keepdims=keepdim).astype(to_jax_dtype(convert_dtype(dtype))), _t(x))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return apply_op("argmin", lambda a: jnp.argmin(a, axis=axis, keepdims=keepdim).astype(to_jax_dtype(convert_dtype(dtype))), _t(x))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        idx = jnp.argsort(a, axis=axis, stable=stable or True)
+        if descending:
+            idx = jnp.flip(idx, axis=axis)
+        return idx
+    return apply_op("argsort", f, _t(x))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        if descending:
+            s = jnp.flip(s, axis=axis)
+        return s
+    return apply_op("sort", f, _t(x))
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    x = _t(x)
+    kk = int(k._data) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = axis % a.ndim
+        a_m = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(a_m, kk)
+        else:
+            v, i = jax.lax.top_k(-a_m, kk)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i.astype(jnp.int64), -1, ax)
+    return apply_op("topk", f, x)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis, stable=True)
+        v = jnp.take(s, k - 1, axis=axis)
+        ix = jnp.take(i, k - 1, axis=axis).astype(jnp.int64)
+        if keepdim:
+            v = jnp.expand_dims(v, axis)
+            ix = jnp.expand_dims(ix, axis)
+        return v, ix
+    return apply_op("kthvalue", f, x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = _t(x)
+    arr = np.asarray(x._data)
+    from scipy import stats  # available via numpy ecosystem; fallback below
+    try:
+        m = stats.mode(arr, axis=axis, keepdims=keepdim)
+        return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+    except Exception:  # noqa: BLE001
+        raise NotImplementedError("mode requires scipy")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = _t(x)
+    nz = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(n[:, None])) for n in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    res = np.unique(np.asarray(x._data), return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    x = _t(x)
+    arr = np.asarray(x._data)
+    if axis is None:
+        arr = arr.reshape(-1)
+        keep = np.concatenate([[True], arr[1:] != arr[:-1]])
+        out = arr[keep]
+        outs = [Tensor(jnp.asarray(out))]
+        if return_inverse:
+            inv = np.cumsum(keep) - 1
+            outs.append(Tensor(jnp.asarray(inv)))
+        if return_counts:
+            idx = np.flatnonzero(keep)
+            counts = np.diff(np.append(idx, arr.size))
+            outs.append(Tensor(jnp.asarray(counts)))
+        return outs[0] if len(outs) == 1 else tuple(outs)
+    raise NotImplementedError
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    s, v = _t(sorted_sequence), _t(values)
+    side = "right" if right else "left"
+    def f(a, b):
+        if a.ndim == 1:
+            out = jnp.searchsorted(a, b, side=side)
+        else:
+            out = jax.vmap(lambda aa, bb: jnp.searchsorted(aa, bb, side=side))(a.reshape(-1, a.shape[-1]), b.reshape(-1, b.shape[-1])).reshape(b.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply_op("searchsorted", f, s, v)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    x = _t(input)
+    size = index_num // nshards
+    def f(i):
+        shard = i // size
+        return jnp.where(shard == shard_id, i % size, ignore_value)
+    return apply_op("shard_index", f, x)
+
+
+def slice(input, axes, starts, ends):
+    x = _t(input)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        st = int(st._data) if isinstance(st, Tensor) else int(st)
+        en = int(en._data) if isinstance(en, Tensor) else int(en)
+        idx[ax] = builtins.slice(st, en)
+    idx = tuple(idx)
+    return apply_op("slice", lambda a: a[idx], x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    x = _t(x)
+    idx = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = builtins.slice(int(st), int(en), int(sd))
+    idx = tuple(idx)
+    return apply_op("strided_slice", lambda a: a[idx], x)
+
+
+# -- metadata ---------------------------------------------------------------
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(int(np.prod(_t(x).shape)) if _t(x).shape else 1, dtype=jnp.int64))
+
+
+def rank(input):
+    return Tensor(jnp.asarray(_t(input).ndim, dtype=jnp.int32))
+
+
+def shape(input):
+    return Tensor(jnp.asarray(_t(input).shape, dtype=jnp.int32))
+
+
+def tolist(x):
+    return _t(x).tolist()

@@ -1,0 +1,391 @@
+"""Math / reduction / logic ops (python/paddle/tensor/{math,logic,stat}.py parity).
+
+Every op is a thin pure-JAX function dispatched through `apply_op`, which records
+the autograd tape and applies AMP casts — the analog of the generated
+paddle::experimental API (phi/api/yaml/generator/api_gen.py output).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype, to_jax_dtype
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = []  # populated below
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _export(name, fn):
+    globals()[name] = fn
+    __all__.append(name)
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# unary elementwise
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "exp": jnp.exp, "expm1": jnp.expm1, "log": jnp.log, "log2": jnp.log2,
+    "log10": jnp.log10, "log1p": jnp.log1p, "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt, "abs": jnp.abs, "sign": jnp.sign,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan, "asin": jnp.arcsin,
+    "acos": jnp.arccos, "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh,
+    "tanh": jnp.tanh, "asinh": jnp.arcsinh, "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh, "floor": jnp.floor, "ceil": jnp.ceil,
+    "round": jnp.round, "trunc": jnp.trunc, "frac": lambda x: x - jnp.trunc(x),
+    "square": jnp.square, "reciprocal": lambda x: 1.0 / x,
+    "neg": jnp.negative, "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv, "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma, "i0": jax.scipy.special.i0,
+    "i1": jax.scipy.special.i1, "sigmoid": jax.nn.sigmoid,
+    "logit": jax.scipy.special.logit, "angle": jnp.angle, "conj": jnp.conj,
+    "real": jnp.real, "imag": jnp.imag, "rad2deg": jnp.rad2deg,
+    "deg2rad": jnp.deg2rad, "exponential_": None,
+}
+
+for _name, _jfn in _UNARY.items():
+    if _jfn is None:
+        continue
+    def _make(nm, jfn):
+        def fn(x, name=None):
+            return apply_op(nm, jfn, _t(x))
+        fn.__name__ = nm
+        return fn
+    _export(_name, _make(_name, _jfn))
+
+_export("isnan", lambda x, name=None: apply_op("isnan", jnp.isnan, _t(x)))
+_export("isinf", lambda x, name=None: apply_op("isinf", jnp.isinf, _t(x)))
+_export("isfinite", lambda x, name=None: apply_op("isfinite", jnp.isfinite, _t(x)))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply_op("nan_to_num", lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), _t(x))
+
+
+_export("nan_to_num", nan_to_num)
+
+
+# ---------------------------------------------------------------------------
+# binary elementwise
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add, "subtract": jnp.subtract, "multiply": jnp.multiply,
+    "divide": jnp.divide, "floor_divide": jnp.floor_divide,
+    "mod": jnp.mod, "remainder": jnp.mod, "floor_mod": jnp.mod,
+    "pow": jnp.power, "maximum": jnp.maximum, "minimum": jnp.minimum,
+    "fmax": jnp.fmax, "fmin": jnp.fmin, "atan2": jnp.arctan2,
+    "logaddexp": jnp.logaddexp, "hypot": jnp.hypot,
+    "gcd": jnp.gcd, "lcm": jnp.lcm, "heaviside": jnp.heaviside,
+    "copysign": jnp.copysign, "nextafter": jnp.nextafter,
+    "ldexp": jnp.ldexp, "inner": jnp.inner, "outer": jnp.outer,
+    "kron": jnp.kron,
+}
+
+for _name, _jfn in _BINARY.items():
+    def _make2(nm, jfn):
+        def fn(x, y, name=None):
+            return apply_op(nm, jfn, _t(x), _t(y))
+        fn.__name__ = nm
+        return fn
+    _export(_name, _make2(_name, _jfn))
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = scale, bias
+    if isinstance(s, Tensor):
+        s = s._data
+    if bias_after_scale:
+        out = apply_op("scale", lambda a: a * s + b, _t(x))
+    else:
+        out = apply_op("scale", lambda a: (a + b) * s, _t(x))
+    return out
+
+
+_export("scale", scale)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply_op("lerp", lambda a, b, w: a + w * (b - a), _t(x), _t(y), weight)
+    return apply_op("lerp", lambda a, b: a + weight * (b - a), _t(x), _t(y))
+
+
+_export("lerp", lerp)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._data if isinstance(min, Tensor) else min
+    hi = max._data if isinstance(max, Tensor) else max
+    return apply_op("clip", lambda a: jnp.clip(a, lo, hi), _t(x))
+
+
+_export("clip", clip)
+
+
+def add_n(inputs, name=None):
+    inputs = [_t(i) for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
+    return apply_op("add_n", lambda *xs: sum(xs[1:], xs[0]), *inputs)
+
+
+_export("add_n", add_n)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply_op("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), _t(x))
+
+
+_export("stanh", stanh)
+
+
+def multiplex(inputs, index, name=None):
+    inputs = [_t(i) for i in inputs]
+    idx = _t(index)
+    return apply_op(
+        "multiplex",
+        lambda ix, *xs: jnp.stack(xs, 0)[ix.reshape(-1), jnp.arange(xs[0].shape[0])],
+        idx, *inputs, nondiff=(0,),
+    )
+
+
+_export("multiplex", multiplex)
+
+
+# ---------------------------------------------------------------------------
+# reductions
+# ---------------------------------------------------------------------------
+
+
+def _norm_axis(axis):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return axis if axis is None else int(axis)
+
+
+def _make_reduce(nm, jfn, dtype_arg=False):
+    def fn(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = _t(x)
+        ax = _norm_axis(axis)
+        kw = {}
+        if dtype_arg and dtype is not None:
+            kw["dtype"] = to_jax_dtype(convert_dtype(dtype))
+        return apply_op(nm, lambda a: jfn(a, axis=ax, keepdims=keepdim, **kw), x)
+    fn.__name__ = nm
+    return fn
+
+
+_export("sum", _make_reduce("sum", jnp.sum, dtype_arg=True))
+_export("mean", _make_reduce("mean", jnp.mean))
+_export("prod", _make_reduce("prod", jnp.prod, dtype_arg=True))
+_export("max", _make_reduce("max", jnp.max))
+_export("min", _make_reduce("min", jnp.min))
+_export("amax", _make_reduce("amax", jnp.max))
+_export("amin", _make_reduce("amin", jnp.min))
+_export("nansum", _make_reduce("nansum", jnp.nansum, dtype_arg=True))
+_export("nanmean", _make_reduce("nanmean", jnp.nanmean))
+_export("all", _make_reduce("all", jnp.all))
+_export("any", _make_reduce("any", jnp.any))
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("std", lambda a: jnp.std(a, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), _t(x))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op("var", lambda a: jnp.var(a, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim), _t(x))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op("median", lambda a: jnp.median(a, axis=_norm_axis(axis), keepdims=keepdim), _t(x))
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op("nanmedian", lambda a: jnp.nanmedian(a, axis=_norm_axis(axis), keepdims=keepdim), _t(x))
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply_op("quantile", lambda a: jnp.quantile(a, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim, method=interpolation), _t(x))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=_norm_axis(axis), keepdims=keepdim), _t(x))
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_op("count_nonzero", lambda a: jnp.count_nonzero(a, axis=_norm_axis(axis), keepdims=keepdim), _t(x))
+
+
+for _n in ("std", "var", "median", "nanmedian", "quantile", "logsumexp", "count_nonzero"):
+    _export(_n, globals()[_n])
+
+
+# ---------------------------------------------------------------------------
+# cumulative
+# ---------------------------------------------------------------------------
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = _t(x)
+    ax = axis
+    if ax is None:
+        return apply_op("cumsum", lambda a: jnp.cumsum(a.reshape(-1)), x)
+    return apply_op("cumsum", lambda a: jnp.cumsum(a, axis=ax), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = _t(x)
+    if dim is None:
+        return apply_op("cumprod", lambda a: jnp.cumprod(a.reshape(-1)), x)
+    return apply_op("cumprod", lambda a: jnp.cumprod(a, axis=dim), x)
+
+
+def _cum_extreme(nm, cmp):
+    def fn(x, axis=None, dtype="int64", name=None):
+        x = _t(x)
+        flat = axis is None
+        ax = 0 if flat else axis
+        def f(a):
+            if flat:
+                a = a.reshape(-1)
+            iota = jax.lax.broadcasted_iota(jnp.int64, a.shape, ax if ax >= 0 else a.ndim + ax)
+            def combine(l, r):
+                lv, li = l
+                rv, ri = r
+                take_r = cmp(rv, lv)
+                return jnp.where(take_r, rv, lv), jnp.where(take_r, ri, li)
+            return jax.lax.associative_scan(combine, (a, iota), axis=ax)
+        return apply_op(nm, f, x)
+    fn.__name__ = nm
+    return fn
+
+
+cummax = _cum_extreme("cummax", lambda r, l: r >= l)
+cummin = _cum_extreme("cummin", lambda r, l: r <= l)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = _t(x)
+    ax = axis
+    def f(a):
+        if ax is None:
+            a = a.reshape(-1)
+            axis_ = 0
+        else:
+            axis_ = ax
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=axis_)
+    return apply_op("logcumsumexp", f, x)
+
+
+for _n in ("cumsum", "cumprod", "cummax", "cummin", "logcumsumexp"):
+    _export(_n, globals()[_n])
+
+
+# ---------------------------------------------------------------------------
+# comparisons & logic
+# ---------------------------------------------------------------------------
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "less_than": jnp.less, "less_equal": jnp.less_equal,
+    "greater_than": jnp.greater, "greater_equal": jnp.greater_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and, "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+}
+
+for _name, _jfn in _CMP.items():
+    def _makec(nm, jfn):
+        def fn(x, y, name=None):
+            return apply_op(nm, jfn, _t(x), _t(y))
+        fn.__name__ = nm
+        return fn
+    _export(_name, _makec(_name, _jfn))
+
+_export("logical_not", lambda x, name=None: apply_op("logical_not", jnp.logical_not, _t(x)))
+_export("bitwise_not", lambda x, name=None: apply_op("bitwise_not", jnp.bitwise_not, _t(x)))
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("isclose", lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _t(x), _t(y))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op("allclose", lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan), _t(x), _t(y))
+
+
+def equal_all(x, y, name=None):
+    return apply_op("equal_all", lambda a, b: jnp.array_equal(a, b), _t(x), _t(y))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = _t(condition)
+    if x is None and y is None:
+        import jax.numpy as _j
+        nz = np.nonzero(np.asarray(condition._data))
+        return tuple(Tensor(jnp.asarray(n)) for n in nz)
+    return apply_op("where", lambda c, a, b: jnp.where(c, a, b), condition, _t(x), _t(y), nondiff=(0,))
+
+
+for _n in ("isclose", "allclose", "equal_all", "where"):
+    _export(_n, globals()[_n])
+
+
+# ---------------------------------------------------------------------------
+# misc math
+# ---------------------------------------------------------------------------
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), _t(x))
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op("diagonal", lambda a: jnp.diagonal(a, offset=offset, axis1=axis1, axis2=axis2), _t(x))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return apply_op("diff", lambda a: jnp.diff(a, n=n, axis=axis), _t(x))
+
+
+def cross(x, y, axis=9, name=None):
+    ax = axis if axis != 9 else -1
+    return apply_op("cross", lambda a, b: jnp.cross(a, b, axis=ax), _t(x), _t(y))
+
+
+def dot(x, y, name=None):
+    return apply_op("dot", lambda a, b: jnp.sum(a * b, axis=-1), _t(x), _t(y))
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    x = _t(x)
+    arr = np.asarray(x._data)
+    lo, hi = (arr.min(), arr.max()) if min == 0 and max == 0 else (min, max)
+    h, _ = np.histogram(arr, bins=bins, range=(lo, hi))
+    return Tensor(jnp.asarray(h, dtype=jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = _t(x)
+    if weights is not None:
+        return apply_op("bincount", lambda i, w: jnp.bincount(i, weights=w, minlength=minlength, length=None), x, _t(weights), nondiff=(0,))
+    arr = np.asarray(x._data)
+    return Tensor(jnp.asarray(np.bincount(arr, minlength=minlength)))
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+for _n in ("trace", "diagonal", "diff", "cross", "dot", "histogram", "bincount", "broadcast_shape"):
+    _export(_n, globals()[_n])

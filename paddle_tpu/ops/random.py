@@ -1,0 +1,141 @@
+"""Random ops over the Paddle-style global Generator (framework.Generator).
+
+Each draw folds the global key (eager UX parity with paddle.seed); every op also
+accepts key= so compiled/jitted code can thread keys functionally (the TPU-native
+way — jax splittable threefry; see SURVEY.md C47 RNG control for the distributed
+per-mesh-axis analog in distributed/random.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype, to_jax_dtype
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal", "standard_normal",
+    "randperm", "multinomial", "bernoulli", "poisson", "uniform_", "normal_", "exponential_",
+    "binomial", "log_normal", "standard_gamma",
+]
+
+
+def _key(key=None):
+    if key is not None:
+        return key
+    return framework.next_rng_key()
+
+
+def _dt(dtype):
+    return to_jax_dtype(convert_dtype(dtype) if dtype is not None else framework.get_default_dtype())
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None, key=None):
+    return Tensor(jax.random.uniform(_key(key), _shape(shape), dtype=_dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None, key=None):
+    return Tensor(jax.random.uniform(_key(key), _shape(shape), dtype=_dt(dtype), minval=min, maxval=max))
+
+
+def randn(shape, dtype=None, name=None, key=None):
+    return Tensor(jax.random.normal(_key(key), _shape(shape), dtype=_dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None, key=None):
+    return randn(shape, dtype=dtype, key=key)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None, key=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_key(key), shp) * s + m)
+    shp = _shape(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(_key(key), shp) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None, key=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(key), _shape(shape), low, high, dtype=_dt(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None, key=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(key), tuple(x.shape), low, high, dtype=x._data.dtype if dtype is None else _dt(dtype)))
+
+
+def randperm(n, dtype="int64", name=None, key=None):
+    return Tensor(jax.random.permutation(_key(key), n).astype(_dt(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None, key=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    logits = jnp.log(jnp.maximum(x._data, 1e-30))
+    k = _key(key)
+    if replacement:
+        out = jax.random.categorical(k, logits, axis=-1, shape=(logits.shape[:-1] and (*logits.shape[:-1], num_samples)) or (num_samples,))
+        if logits.ndim > 1:
+            out = out.reshape(*logits.shape[:-1], num_samples)
+    else:
+        # Gumbel top-k trick for sampling without replacement.
+        g = jax.random.gumbel(k, logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def bernoulli(x, name=None, key=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jax.random.bernoulli(_key(key), x._data).astype(x._data.dtype))
+
+
+def poisson(x, name=None, key=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jax.random.poisson(_key(key), x._data).astype(x._data.dtype))
+
+
+def binomial(count, prob, name=None, key=None):
+    count = count if isinstance(count, Tensor) else to_tensor(count)
+    prob = prob if isinstance(prob, Tensor) else to_tensor(prob)
+    return Tensor(jax.random.binomial(_key(key), count._data, prob._data).astype(jnp.int64))
+
+
+def standard_gamma(x, name=None, key=None):
+    x = x if isinstance(x, Tensor) else to_tensor(x)
+    return Tensor(jax.random.gamma(_key(key), x._data))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None, key=None):
+    return Tensor(jnp.exp(jax.random.normal(_key(key), _shape(shape or [1])) * std + mean))
+
+
+# in-place variants (rebind data)
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None, key=None):
+    x._data = jax.random.uniform(_key(key), tuple(x.shape), dtype=x._data.dtype, minval=min, maxval=max)
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None, key=None):
+    x._data = jax.random.normal(_key(key), tuple(x.shape), dtype=x._data.dtype) * std + mean
+    return x
+
+
+def exponential_(x, lam=1.0, name=None, key=None):
+    x._data = jax.random.exponential(_key(key), tuple(x.shape), dtype=x._data.dtype) / lam
+    return x

@@ -1,0 +1,444 @@
+"""Optimizers (python/paddle/optimizer/ parity: optimizer.py:91 base, adamw.py:32).
+
+Design: each optimizer defines a *functional* per-parameter update rule
+(`_update_raw`) over raw jax arrays + a state dict of accumulator arrays.  The
+eager `step()` applies it in place (dygraph parity); the jit engine
+(paddle_tpu.jit.TrainStep) calls the same rule inside a compiled, donated
+train step — one rule, two execution modes, like the reference's shared phi
+kernels between dygraph and static.
+
+Master weights: with multi_precision=True (AMP O2 parity), a float32 copy is
+kept in the state and the bf16/fp16 param is re-derived each step — the
+reference's master-weight mechanic (optimizer.py _multi_precision logic).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..tensor import Parameter, Tensor
+from . import lr as lr  # noqa: PLC0414
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "AdamMax",
+           "RMSProp", "Adadelta", "Lamb", "lr", "LRScheduler"]
+
+
+class Optimizer:
+    _accum_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        self._lr = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._step_count = 0
+        # state: param-id -> {accum_name: raw array}
+        self._state: dict[int, dict] = {}
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._lr, LRScheduler):
+            return float(self._lr())
+        return float(self._lr)
+
+    def set_lr(self, value):
+        if isinstance(self._lr, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._lr = value
+
+    @property
+    def _learning_rate(self):
+        return self._lr
+
+    # -- state -------------------------------------------------------------
+    def _init_param_state(self, p: Parameter) -> dict:
+        state = {}
+        raw = p._data
+        needs_master = self._multi_precision and raw.dtype in (jnp.float16, jnp.bfloat16)
+        if needs_master:
+            state["master_weight"] = raw.astype(jnp.float32)
+        for name in self._accum_names:
+            state[name] = jnp.zeros_like(state.get("master_weight", raw))
+        return state
+
+    def _get_state(self, p: Parameter) -> dict:
+        s = self._state.get(id(p))
+        if s is None:
+            s = self._init_param_state(p)
+            self._state[id(p)] = s
+        return s
+
+    # -- update rule (override) ---------------------------------------------
+    def _update_raw(self, param, grad, state, lr, step):
+        """param/grad: raw float arrays (master precision); state: dict of raw
+        arrays; returns (new_param, new_state)."""
+        raise NotImplementedError
+
+    # -- regularization -----------------------------------------------------
+    def _wd_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (int, float)):
+            return float(wd)
+        coeff = getattr(wd, "_coeff", None)  # L2Decay object parity
+        return float(coeff) if coeff is not None else 0.0
+
+    def _l2_into_grad(self) -> bool:
+        # classic L2 regularization (grad += wd * param); AdamW overrides to use
+        # decoupled decay instead.
+        return True
+
+    # -- eager step ---------------------------------------------------------
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        params = self._parameter_list
+        if params is None:
+            raise ValueError("optimizer constructed without parameters; pass parameters=")
+        params = [p for p in params if isinstance(p, Parameter) and p.trainable]
+        grads = [p.grad._data if p.grad is not None else None for p in params]
+
+        if self._grad_clip is not None:
+            live = [g for g in grads if g is not None]
+            clipped = self._grad_clip.clip_raw(live)
+            it = iter(clipped)
+            grads = [next(it) if g is not None else None for g in grads]
+
+        lr_val = self.get_lr()
+        wd = self._wd_coeff()
+        self._step_count += 1
+        for p, g in zip(params, grads):
+            if g is None:
+                continue
+            state = self._get_state(p)
+            master = state.get("master_weight")
+            w = master if master is not None else p._data
+            g = g.astype(w.dtype)
+            if wd and self._l2_into_grad() and getattr(p, "regularizer", None) is None:
+                g = g + wd * w
+            p_lr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            new_w, new_state = self._update_raw(w, g, state, p_lr, self._step_count)
+            if master is not None:
+                new_state["master_weight"] = new_w
+                p._data = new_w.astype(p._data.dtype)
+            else:
+                p._data = new_w
+            self._state[id(p)] = new_state
+
+    def clear_grad(self, set_to_zero=False):
+        if self._parameter_list:
+            for p in self._parameter_list:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- checkpoint ----------------------------------------------------------
+    def state_dict(self):
+        out = {"_step_count": self._step_count}
+        if isinstance(self._lr, LRScheduler):
+            out["LR_Scheduler"] = self._lr.state_dict()
+        if self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
+                s = self._state.get(id(p))
+                if s is None:
+                    continue
+                for k, v in s.items():
+                    out[f"{p.name}_{k}"] = Tensor(v)
+        return out
+
+    def set_state_dict(self, state):
+        self._step_count = int(state.get("_step_count", 0))
+        if isinstance(self._lr, LRScheduler) and "LR_Scheduler" in state:
+            self._lr.set_state_dict(state["LR_Scheduler"])
+        if self._parameter_list:
+            for p in self._parameter_list:
+                s = {}
+                for name in self._accum_names + ("master_weight",):
+                    k = f"{p.name}_{name}"
+                    if k in state:
+                        v = state[k]
+                        s[name] = v._data if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if s:
+                    self._state[id(p)] = s
+
+    # -- functional API for the jit engine ----------------------------------
+    def functional_init(self, raw_params: list):
+        """Build accumulator state for a flat list of raw params."""
+        states = []
+        for raw in raw_params:
+            s = {}
+            needs_master = self._multi_precision and raw.dtype in (jnp.float16, jnp.bfloat16)
+            if needs_master:
+                s["master_weight"] = raw.astype(jnp.float32)
+            for name in self._accum_names:
+                s[name] = jnp.zeros_like(s.get("master_weight", raw))
+            states.append(s)
+        return {"step": jnp.zeros((), jnp.int32), "param_states": states}
+
+    def functional_apply(self, raw_params: list, raw_grads: list, opt_state, lr=None):
+        """Pure update: returns (new_params, new_state).  Called under jit."""
+        step = opt_state["step"] + 1
+        lr_val = self.get_lr() if lr is None else lr
+        wd = self._wd_coeff()
+        if self._grad_clip is not None:
+            raw_grads = self._grad_clip.clip_raw(raw_grads)
+        new_params, new_states = [], []
+        for w0, g, s in zip(raw_params, raw_grads, opt_state["param_states"]):
+            if g is None:
+                new_params.append(w0)
+                new_states.append(s)
+                continue
+            master = s.get("master_weight")
+            w = master if master is not None else w0
+            g = g.astype(w.dtype)
+            if wd and self._l2_into_grad():
+                g = g + wd * w
+            new_w, new_s = self._update_raw(w, g, s, lr_val, step)
+            if master is not None:
+                new_s["master_weight"] = new_w
+                new_params.append(new_w.astype(w0.dtype))
+            else:
+                new_params.append(new_w)
+            new_states.append(new_s)
+        return new_params, {"step": step, "param_states": new_states}
+
+    # paddle API compat
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def _apply_optimize(self, loss, startup_program, params_grads):
+        self.step()
+
+
+class SGD(Optimizer):
+    def _update_raw(self, w, g, s, lr, step):
+        return w - lr * g, s
+
+
+class Momentum(Optimizer):
+    _accum_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_raw(self, w, g, s, lr, step):
+        v = self._momentum * s["velocity"] + g
+        if self._nesterov:
+            new_w = w - lr * (g + self._momentum * v)
+        else:
+            new_w = w - lr * v
+        return new_w, {**s, "velocity": v}
+
+
+class Adagrad(Optimizer):
+    _accum_names = ("moment",)
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_raw(self, w, g, s, lr, step):
+        m = s["moment"] + jnp.square(g)
+        return w - lr * g / (jnp.sqrt(m) + self._epsilon), {**s, "moment": m}
+
+
+class Adam(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _update_raw(self, w, g, s, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * s["moment1"] + (1 - b1) * g
+        v = b2 * s["moment2"] + (1 - b2) * jnp.square(g)
+        step_f = jnp.asarray(step, dtype=w.dtype) if not isinstance(step, int) else step
+        bc1 = 1 - b1**step_f if isinstance(step, int) else 1 - jnp.power(jnp.asarray(b1, w.dtype), step_f)
+        bc2 = 1 - b2**step_f if isinstance(step, int) else 1 - jnp.power(jnp.asarray(b2, w.dtype), step_f)
+        m_hat = m / bc1
+        v_hat = v / bc2
+        new_w = w - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        return new_w, {**s, "moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py:32)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name=name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _l2_into_grad(self):
+        return False
+
+    def _update_raw(self, w, g, s, lr, step, decay=True):
+        if decay:
+            w = w * (1.0 - lr * self._wd_coeff())
+        return super()._update_raw(w, g, s, lr, step)
+
+    def step(self):
+        # same as base but honoring apply_decay_param_fun per param
+        params = [p for p in (self._parameter_list or []) if isinstance(p, Parameter) and p.trainable]
+        grads = [p.grad._data if p.grad is not None else None for p in params]
+        if self._grad_clip is not None:
+            live = [g for g in grads if g is not None]
+            clipped = self._grad_clip.clip_raw(live)
+            it = iter(clipped)
+            grads = [next(it) if g is not None else None for g in grads]
+        lr_val = self.get_lr()
+        self._step_count += 1
+        for p, g in zip(params, grads):
+            if g is None:
+                continue
+            state = self._get_state(p)
+            master = state.get("master_weight")
+            w = master if master is not None else p._data
+            g = g.astype(w.dtype)
+            decay = True
+            if self._apply_decay_param_fun is not None:
+                decay = self._apply_decay_param_fun(p.name)
+            p_lr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            if self._lr_ratio is not None:
+                p_lr = p_lr * self._lr_ratio(p)
+            new_w, new_state = self._update_raw(w, g, state, p_lr, self._step_count, decay=decay)
+            if master is not None:
+                new_state["master_weight"] = new_w
+                p._data = new_w.astype(p._data.dtype)
+            else:
+                p._data = new_w
+            self._state[id(p)] = new_state
+
+
+class AdamMax(Optimizer):
+    _accum_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _update_raw(self, w, g, s, lr, step):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * s["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * s["inf_norm"], jnp.abs(g))
+        step_f = step if isinstance(step, int) else jnp.asarray(step, w.dtype)
+        bc1 = 1 - b1**step_f if isinstance(step, int) else 1 - jnp.power(jnp.asarray(b1, w.dtype), step_f)
+        new_w = w - lr / bc1 * m / (u + self._epsilon)
+        return new_w, {**s, "moment": m, "inf_norm": u}
+
+
+Adamax = AdamMax
+
+
+class RMSProp(Optimizer):
+    _accum_names = ("mean_square", "mean_grad", "momentum_acc")
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._rho, self._epsilon, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _update_raw(self, w, g, s, lr, step):
+        ms = self._rho * s["mean_square"] + (1 - self._rho) * jnp.square(g)
+        if self._centered:
+            mg = self._rho * s["mean_grad"] + (1 - self._rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._epsilon)
+        else:
+            mg = s["mean_grad"]
+            denom = jnp.sqrt(ms + self._epsilon)
+        mom = self._momentum * s["momentum_acc"] + lr * g / denom
+        return w - mom, {**s, "mean_square": ms, "mean_grad": mg, "momentum_acc": mom}
+
+
+class Adadelta(Optimizer):
+    _accum_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name=name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_raw(self, w, g, s, lr, step):
+        asg = self._rho * s["avg_squared_grad"] + (1 - self._rho) * jnp.square(g)
+        update = jnp.sqrt(s["avg_squared_update"] + self._epsilon) / jnp.sqrt(asg + self._epsilon) * g
+        asu = self._rho * s["avg_squared_update"] + (1 - self._rho) * jnp.square(update)
+        return w - lr * update, {**s, "avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class Lamb(Optimizer):
+    _accum_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_raw(self, w, g, s, lr, step, decay=True):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * s["moment1"] + (1 - b1) * g
+        v = b2 * s["moment2"] + (1 - b2) * jnp.square(g)
+        step_f = step if isinstance(step, int) else jnp.asarray(step, w.dtype)
+        bc1 = 1 - b1**step_f if isinstance(step, int) else 1 - jnp.power(jnp.asarray(b1, w.dtype), step_f)
+        bc2 = 1 - b2**step_f if isinstance(step, int) else 1 - jnp.power(jnp.asarray(b2, w.dtype), step_f)
+        r = (m / bc1) / (jnp.sqrt(v / bc2) + self._epsilon)
+        if decay:
+            r = r + self._lamb_wd * w
+        w_norm = jnp.linalg.norm(w)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return w - lr * trust * r, {**s, "moment1": m, "moment2": v}
+
+    def step(self):
+        params = [p for p in (self._parameter_list or []) if isinstance(p, Parameter) and p.trainable]
+        grads = [p.grad._data if p.grad is not None else None for p in params]
+        if self._grad_clip is not None:
+            live = [g for g in grads if g is not None]
+            clipped = self._grad_clip.clip_raw(live)
+            it = iter(clipped)
+            grads = [next(it) if g is not None else None for g in grads]
+        lr_val = self.get_lr()
+        self._step_count += 1
+        for p, g in zip(params, grads):
+            if g is None:
+                continue
+            state = self._get_state(p)
+            master = state.get("master_weight")
+            w = master if master is not None else p._data
+            g = g.astype(w.dtype)
+            decay = True
+            if self._exclude_fn is not None:
+                decay = not self._exclude_fn(p.name)
+            new_w, new_state = self._update_raw(w, g, state, lr_val, self._step_count, decay=decay)
+            if master is not None:
+                new_state["master_weight"] = new_w
+                p._data = new_w.astype(p._data.dtype)
+            else:
+                p._data = new_w
+            self._state[id(p)] = new_state

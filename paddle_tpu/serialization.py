@@ -1,0 +1,78 @@
+"""paddle.save / paddle.load (python/paddle/framework/io.py:650,893 parity).
+
+Pickle-protocol-4 nested state dicts with Tensors stored as numpy arrays
+(bfloat16 goes through ml_dtypes, which numpy understands via jax).  Large
+checkpoint use goes through paddle_tpu.distributed.checkpoint (Orbax-style
+sharded async save) — this module is the single-process path.
+"""
+
+from __future__ import annotations
+
+import io as _io
+import os
+import pickle
+
+import numpy as np
+
+from .tensor import Parameter, Tensor, to_tensor
+
+
+class _TensorPayload:
+    """Pickle-stable wrapper (keeps bf16 via raw bytes + dtype name)."""
+
+    def __init__(self, array: np.ndarray):
+        self.dtype = str(array.dtype)
+        self.shape = array.shape
+        self.data = array.tobytes()
+
+    def to_numpy(self):
+        import ml_dtypes  # noqa: F401 — registers bfloat16 etc. with numpy
+
+        return np.frombuffer(self.data, dtype=np.dtype(self.dtype)).reshape(self.shape)
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPayload):
+        arr = obj.to_numpy()
+        return arr if return_numpy else to_tensor(arr)
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)
+
+
+def save_to_buffer(obj, protocol=4) -> bytes:
+    buf = _io.BytesIO()
+    pickle.dump(_pack(obj), buf, protocol=protocol)
+    return buf.getvalue()
+
+
+def load_from_buffer(data: bytes, return_numpy=False):
+    return _unpack(pickle.loads(data), return_numpy=return_numpy)

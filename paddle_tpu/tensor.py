@@ -1,0 +1,580 @@
+"""Eager Tensor + tape autograd + single-point op dispatch.
+
+Reference parity (design, not translation):
+  - Tensor: paddle/phi/core/dense_tensor.h:41 DenseTensor + pybind eager tensor
+    (paddle/fluid/pybind/eager_method.cc).  Here a Tensor is a thin mutable handle
+    over an immutable `jax.Array` — rebinding `.data` replaces the value, so the
+    "in-place" Paddle APIs become copy-on-write (safe under XLA's functional model).
+  - Autograd engine: paddle/fluid/eager/grad_node_info.h:168 GradNodeBase +
+    backward.cc:104 RunBackward.  TPU-native twist: instead of hand-written grad
+    kernels per op, every dispatched op records the `jax.vjp` pullback closure at
+    forward time (the closure holds the residuals — the analog of TensorWrapper,
+    eager/tensor_wrapper.h).  `Tensor.backward()` runs reverse topological order
+    over recorded nodes, exactly like RunBackward's in-degree queue.
+  - Dispatch point: paddle/phi/api/lib (generated experimental::op) — AMP casts and
+    stop_gradient logic hook in here (eager_amp_auto_cast.h analog).
+
+Everything under `jax.jit` traces through this same machinery (the tape records
+tracers), which is how dygraph-to-static works without an AST transpiler.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import framework
+from .framework import convert_dtype, to_jax_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor", "apply_op", "is_tensor"]
+
+
+# ---------------------------------------------------------------------------
+# Tape node
+# ---------------------------------------------------------------------------
+
+
+class TapeNode:
+    """One recorded op: pullback closure + differentiable input tensors + outputs.
+
+    Analog of an eager GradNode (grad_node_info.h:168); `pullback` plays the role
+    of the generated grad-op call, `inputs` the Edges, `outputs` the forward outs
+    whose cotangents seed this node.
+    """
+
+    __slots__ = ("pullback", "inputs", "outputs", "name")
+
+    def __init__(self, name, pullback, inputs, outputs):
+        self.name = name
+        self.pullback = pullback
+        self.inputs = inputs  # tuple[Tensor] — differentiable inputs, in order
+        self.outputs = outputs  # tuple[Tensor]
+
+
+def _float0_zero(raw):
+    return np.zeros(raw.shape, dtype=jax.dtypes.float0)
+
+
+def _is_float(raw) -> bool:
+    return jnp.issubdtype(raw.dtype, jnp.floating) or jnp.issubdtype(
+        raw.dtype, jnp.complexfloating
+    )
+
+
+# ---------------------------------------------------------------------------
+# Tensor
+# ---------------------------------------------------------------------------
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    """Paddle-style eager tensor over a `jax.Array`."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "_node", "_out_idx", "name",
+                 "persistable", "_hooks", "__weakref__")
+
+    def __init__(self, data, stop_gradient=True, name=None):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = jnp.asarray(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self._node = None
+        self._out_idx = 0
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+        self.persistable = False
+        self._hooks = None
+
+    # -- value plumbing ----------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        if isinstance(value, Tensor):
+            value = value._data
+        self._data = value
+
+    def __jax_array__(self):
+        return self._data
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def dtype(self):
+        return framework._REVERSE_DTYPE_MAP[np.dtype(self._data.dtype)]
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def place(self):
+        try:
+            dev = list(self._data.devices())[0]
+            return f"{dev.platform}:{dev.id}"
+        except Exception:  # noqa: BLE001 — tracers have no device
+            return "traced"
+
+    @property
+    def is_leaf(self):
+        return self._node is None
+
+    def numel(self):
+        return self.size
+
+    def element_size(self):
+        return np.dtype(self._data.dtype).itemsize
+
+    def numpy(self):
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def detach(self):
+        t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
+        return t
+
+    def detach_(self):
+        self._node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self):
+        return apply_op("clone", lambda x: x + 0, self)
+
+    def pin_memory(self):
+        return self
+
+    def cpu(self):
+        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]), self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # Accepts dtype or device strings; device moves are no-ops intra-host.
+        for a in list(args) + list(kwargs.values()):
+            try:
+                return self.astype(convert_dtype(a))
+            except (ValueError, TypeError):
+                continue
+        return self
+
+    def block_until_ready(self):
+        if hasattr(self._data, "block_until_ready"):
+            self._data.block_until_ready()
+        return self
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph=False):
+        backward(self, grad_tensor=grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero=False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    def register_hook(self, hook):
+        # Gradient hooks fire when backward() deposits this tensor's grad.
+        # Stored on the tensor itself so the hook's lifetime is the tensor's.
+        if self._hooks is None:
+            self._hooks = []
+        self._hooks.append(hook)
+        hooks = self._hooks
+        idx = len(hooks) - 1
+
+        class _Handle:
+            def remove(self_h):
+                hooks[idx] = None
+
+        return _Handle()
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        try:
+            val = np.asarray(self._data)
+            body = np.array2string(val, precision=8, separator=", ", threshold=40)
+        except Exception:  # noqa: BLE001
+            body = f"<traced {self._data.aval if hasattr(self._data, 'aval') else self._data}>"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype}, "
+            f"stop_gradient={self.stop_gradient},\n       {body})"
+        )
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __format__(self, spec):
+        if self.ndim == 0:
+            return format(self.item(), spec)
+        return repr(self)
+
+    def __getitem__(self, index):
+        index = _unwrap_index(index)
+        return apply_op("slice", lambda x: x[index], self)
+
+    def __setitem__(self, index, value):
+        index = _unwrap_index(index)
+        if isinstance(value, Tensor):
+            out = apply_op(
+                "set_value", lambda x, v: x.at[index].set(v.astype(x.dtype)), self, value
+            )
+        else:
+            out = apply_op("set_value", lambda x: x.at[index].set(value), self)
+        # Copy-on-write in-place: rebind this handle to the new value/node.
+        self._data = out._data
+        self._node = out._node
+        self._out_idx = out._out_idx
+        if out._node is not None:
+            # make the node's output list point at self so backward reaches us
+            outs = list(out._node.outputs)
+            outs[out._out_idx] = self
+            out._node.outputs = tuple(outs)
+
+    # Arithmetic operators are patched in by paddle_tpu.ops (single source for
+    # op definitions — the "one YAML, many artifacts" idea from phi/api/yaml).
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/base/framework.py Parameter parity)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
+                 "placements", "_sharding_axes", "need_clip")
+
+    def __init__(self, data, name=None, trainable=True):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+        # GSPMD sharding annotation: PartitionSpec-like tuple over global mesh
+        # axes, set by distributed parallel layers (see distributed/mp_layers).
+        self.placements = None
+        self._sharding_axes = None
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _unwrap_index(index):
+    if isinstance(index, Tensor):
+        return index._data
+    if isinstance(index, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in index)
+    if isinstance(index, list) and any(isinstance(i, Tensor) for i in index):
+        return [i._data if isinstance(i, Tensor) else i for i in index]
+    return index
+
+
+# ---------------------------------------------------------------------------
+# to_tensor
+# ---------------------------------------------------------------------------
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity (python/paddle/tensor/creation.py)."""
+    if isinstance(data, Tensor):
+        raw = data._data
+    elif isinstance(data, (jax.Array, jax.core.Tracer)):
+        raw = data
+    else:
+        arr = np.asarray(data)
+        if arr.dtype == np.float64 and dtype is None:
+            # Paddle's to_tensor keeps python floats at default dtype.
+            if isinstance(data, (numbers.Number, list, tuple)):
+                arr = arr.astype(to_jax_dtype(framework.get_default_dtype()))
+        raw = jnp.asarray(arr)
+    if dtype is not None:
+        raw = raw.astype(to_jax_dtype(convert_dtype(dtype)))
+    return Tensor(raw, stop_gradient=stop_gradient)
+
+
+# ---------------------------------------------------------------------------
+# The dispatch point
+# ---------------------------------------------------------------------------
+
+_AMP_WHITE = frozenset({
+    "matmul", "mm", "bmm", "einsum", "linear", "conv2d", "conv1d", "conv3d",
+    "conv2d_transpose", "flash_attention", "scaled_dot_product_attention",
+})
+_AMP_BLACK = frozenset({
+    "softmax_with_cross_entropy", "cross_entropy", "exp", "log", "log_softmax",
+    "mean", "sum", "norm", "softmax", "layer_norm", "rms_norm", "square", "pow",
+    "l2_normalize", "log_sigmoid", "logsumexp",
+})
+
+
+def _amp_cast_args(name, tensors_raw):
+    amp = framework.get_state().amp_state
+    if amp is None or not amp.enable:
+        return tensors_raw
+    target = to_jax_dtype(amp.dtype)
+    if amp.level == "O2":
+        # pure low-precision except black list
+        if name in _AMP_BLACK or name in amp.custom_black_list:
+            cast = jnp.float32
+        else:
+            cast = target
+    else:  # O1
+        if name in amp.custom_black_list or name in _AMP_BLACK:
+            cast = jnp.float32
+        elif name in _AMP_WHITE or name in amp.custom_white_list:
+            cast = target
+        else:
+            return tensors_raw
+    out = []
+    for r in tensors_raw:
+        if r is not None and _is_float(r) and r.dtype != cast and r.dtype != jnp.float64:
+            out.append(r.astype(cast))
+        else:
+            out.append(r)
+    return out
+
+
+def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), **kwargs):
+    """Execute `fn` over raw arrays, wrap outputs, record the tape node.
+
+    `fn` must be a pure JAX function over the raw values of `args` (Tensors are
+    unwrapped positionally; non-Tensor args pass through).  `kwargs` are static
+    and must already be closed over by callers that need them (we forward them).
+    `nondiff`: positions of Tensor args to treat as constants (e.g. int indices).
+    """
+    raws = [a._data if isinstance(a, Tensor) else a for a in args]
+
+    # positions of differentiable tensor inputs
+    diff_pos = [
+        i
+        for i, a in enumerate(args)
+        if isinstance(a, Tensor) and i not in nondiff and _is_float(raws[i])
+    ]
+
+    # AMP: cast differentiable float inputs per op lists
+    if framework.get_state().amp_state is not None and diff_pos:
+        cast_raws = _amp_cast_args(name, [raws[i] for i in diff_pos])
+        for p, r in zip(diff_pos, cast_raws):
+            raws[p] = r
+
+    need_grad = framework.is_grad_enabled() and any(
+        not args[i].stop_gradient for i in diff_pos
+    )
+
+    if not need_grad:
+        outs = fn(*raws, **kwargs)
+        return _wrap_outputs(outs, stop_gradient=True)
+
+    def pure(*diff_raws):
+        full = list(raws)
+        for p, r in zip(diff_pos, diff_raws):
+            full[p] = r
+        return fn(*full, **kwargs)
+
+    out_raws, pullback = jax.vjp(pure, *[raws[p] for p in diff_pos])
+    wrapped = _wrap_outputs(out_raws, stop_gradient=False)
+    out_list = wrapped if isinstance(wrapped, tuple) else (wrapped,)
+    node = TapeNode(name, pullback, tuple(args[p] for p in diff_pos), out_list)
+    for idx, o in enumerate(out_list):
+        if isinstance(o, Tensor):
+            o._node = node
+            o._out_idx = idx
+    return wrapped
+
+
+def _wrap_outputs(outs, stop_gradient):
+    if isinstance(outs, (tuple, list)):
+        return tuple(
+            Tensor(o, stop_gradient=stop_gradient or not _is_float(o))
+            if isinstance(o, (jax.Array, jax.core.Tracer, np.ndarray))
+            else o
+            for o in outs
+        )
+    return Tensor(outs, stop_gradient=stop_gradient or not _is_float(outs))
+
+
+# ---------------------------------------------------------------------------
+# backward — reverse topological sweep (backward.cc:104 RunBackward analog)
+# ---------------------------------------------------------------------------
+
+
+def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
+    if tensor._node is None:
+        if not tensor.stop_gradient:
+            g = jnp.ones_like(tensor._data) if grad_tensor is None else (
+                grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+            )
+            _deposit_grad(tensor, g)
+        return
+
+    if grad_tensor is None:
+        seed_grad = jnp.ones_like(tensor._data)
+    else:
+        seed_grad = grad_tensor._data if isinstance(grad_tensor, Tensor) else jnp.asarray(grad_tensor)
+
+    # Topological order over nodes (DFS, iterative).
+    topo: list[TapeNode] = []
+    visited: set[int] = set()
+    stack: list[tuple[TapeNode, bool]] = [(tensor._node, False)]
+    while stack:
+        node, processed = stack.pop()
+        if processed:
+            topo.append(node)
+            continue
+        if id(node) in visited:
+            continue
+        visited.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            if inp._node is not None and id(inp._node) not in visited:
+                stack.append((inp._node, False))
+
+    # cotangent accumulation keyed by tensor identity
+    cotangents: dict[int, Any] = {id(tensor): seed_grad}
+
+    for node in reversed(topo):
+        out_cts = []
+        for o in node.outputs:
+            ct = cotangents.get(id(o))
+            if ct is None:
+                if _is_float(o._data):
+                    ct = jnp.zeros_like(o._data)
+                else:
+                    ct = _float0_zero(o._data)
+            out_cts.append(ct)
+        # jax.vjp pullback takes cotangents matching the fn output structure
+        if len(node.outputs) == 1:
+            in_cts = node.pullback(out_cts[0])
+        else:
+            in_cts = node.pullback(tuple(out_cts))
+        for inp, ct in zip(node.inputs, in_cts):
+            if ct is None or (hasattr(ct, "dtype") and ct.dtype == jax.dtypes.float0):
+                continue
+            if ct.dtype != inp._data.dtype:
+                ct = ct.astype(inp._data.dtype)
+            prev = cotangents.get(id(inp))
+            cotangents[id(inp)] = ct if prev is None else prev + ct
+        if not retain_graph:
+            node.pullback = None  # free residuals ASAP
+
+    # Deposit grads on leaves (and any tensor that wants grad).
+    all_tensors: dict[int, Tensor] = {id(tensor): tensor}
+    for node in topo:
+        for t in node.inputs:
+            all_tensors[id(t)] = t
+        for t in node.outputs:
+            all_tensors[id(t)] = t
+    for tid, ct in cotangents.items():
+        t = all_tensors.get(tid)
+        if t is None or t.stop_gradient:
+            continue
+        if t._node is None or tid == id(tensor):
+            _deposit_grad(t, ct)
+
+    if not retain_graph:
+        for node in topo:
+            node.inputs = ()
+            node.outputs = ()
+        tensor._node = None
+
+
+def _deposit_grad(t: Tensor, raw):
+    hooks = t._hooks
+    if hooks:
+        g = Tensor(raw)
+        for h in hooks:
+            if h is None:
+                continue
+            r = h(g)
+            if r is not None:
+                g = r if isinstance(r, Tensor) else Tensor(r)
+        raw = g._data
+    if t.grad is None:
+        t.grad = Tensor(raw, stop_gradient=True, name=t.name + "@GRAD")
+    else:
+        t.grad = Tensor(t.grad._data + raw, stop_gradient=True, name=t.name + "@GRAD")
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False, allow_unused=False):
+    """paddle.grad parity (functional gradient of outputs wrt inputs)."""
+    if create_graph:
+        # Higher-order AD through the eager tape is not supported; the
+        # functional API (paddle.autograd.jacobian/hessian/vjp/jvp) composes
+        # jax transforms and handles arbitrary order.
+        raise NotImplementedError(
+            "create_graph=True is not supported on the eager tape; use "
+            "paddle.autograd.jacobian/hessian (jax-native, any order) instead")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    grad_outputs = (
+        grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs] * len(outputs)
+    )
+    # Save/restore .grad so paddle.grad doesn't clobber training state.
+    saved = [(t, t.grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+    prev_sg = [t.stop_gradient for t in inputs]
+    for t in inputs:
+        t.stop_gradient = False
+    try:
+        for o, g in zip(outputs, grad_outputs):
+            backward(o, grad_tensor=g, retain_graph=True)
+        results = []
+        for t in inputs:
+            if t.grad is None:
+                if not allow_unused:
+                    raise RuntimeError(f"Input tensor {t.name} is unused in the graph")
+                results.append(None)
+            else:
+                results.append(t.grad)
+        return results
+    finally:
+        for (t, g), sg in zip(saved, prev_sg):
+            t.grad = g
+            t.stop_gradient = sg
+        if not retain_graph:
+            for o in outputs:
+                o._node = None

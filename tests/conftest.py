@@ -1,0 +1,29 @@
+"""Test config: force an 8-virtual-device CPU platform BEFORE jax imports.
+
+This mirrors the reference's distributed-test strategy (SURVEY.md §4: localhost
+multi-process NCCL) mapped to TPU-style testing: a virtual 8-device CPU mesh
+exercises every sharding/collective path without hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # override ambient axon/tpu setting
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    np.random.seed(2024)
+    paddle.seed(2024)
+    yield

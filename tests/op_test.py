@@ -1,0 +1,86 @@
+"""OpTest harness (reference: test/legacy_test/eager_op_test.py:381 OpTest).
+
+Checks an op against a numpy reference in BOTH execution modes (eager dispatch
+and jit-compiled), and checks analytic gradients against central finite
+differences — the reference's check_output/check_grad contract."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, atol=1e-4, rtol=5e-4, kwargs=None):
+    # default tolerances sized for float32 + XLA's fast transcendental
+    # approximations (the reference keeps the same idea in
+    # test/white_list/op_accuracy_white_list.py)
+    """inputs: list of numpy arrays. op_fn takes Tensors; np_fn takes numpy."""
+    kwargs = kwargs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    out = op_fn(*tensors, **kwargs)
+    expected = np_fn(*inputs, **kwargs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    exps = expected if isinstance(expected, (tuple, list)) else [expected]
+    for o, e in zip(outs, exps):
+        np.testing.assert_allclose(np.asarray(o.numpy(), dtype=np.float64),
+                                   np.asarray(e, dtype=np.float64), atol=atol, rtol=rtol)
+
+    # compiled mode: same op under jax.jit over raw arrays
+    def raw_fn(*raws):
+        ts = [Tensor(r, stop_gradient=True) for r in raws]
+        o = op_fn(*ts, **kwargs)
+        if isinstance(o, (tuple, list)):
+            return tuple(x._data for x in o)
+        return o._data
+
+    jitted = jax.jit(raw_fn)(*[t._data for t in tensors])
+    jouts = jitted if isinstance(jitted, tuple) else [jitted]
+    for o, e in zip(jouts, exps):
+        np.testing.assert_allclose(np.asarray(o, dtype=np.float64),
+                                   np.asarray(e, dtype=np.float64), atol=atol, rtol=rtol)
+
+
+def check_grad(op_fn, inputs, grad_inputs=None, eps=1e-3, atol=1e-2, rtol=1e-2,
+               kwargs=None, reduce_out=True):
+    """Numeric-vs-analytic gradient check (float64 for stability)."""
+    kwargs = kwargs or {}
+    inputs = [np.asarray(a, dtype=np.float64) for a in inputs]
+    grad_pos = list(range(len(inputs))) if grad_inputs is None else grad_inputs
+
+    def scalar_fn(*arrs):
+        ts = [paddle.to_tensor(a, stop_gradient=False) for a in arrs]
+        out = op_fn(*ts, **kwargs)
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return out.sum() if reduce_out else out
+
+    # analytic via the tape
+    ts = [paddle.to_tensor(a, stop_gradient=False) for a in inputs]
+    out = op_fn(*ts, **kwargs)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    loss = out.sum() if reduce_out else out
+    loss.backward()
+    analytic = [ts[i].grad.numpy() if ts[i].grad is not None else np.zeros_like(inputs[i]) for i in grad_pos]
+
+    # numeric central differences
+    for gi, pos in enumerate(grad_pos):
+        base = inputs[pos]
+        num = np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = num.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            lo_args = [a.copy() for a in inputs]
+            lo_args[pos] = base.copy()
+            f_hi = float(scalar_fn(*[base if k == pos else inputs[k] for k in range(len(inputs))]).numpy())
+            flat[j] = orig - eps
+            f_lo = float(scalar_fn(*[base if k == pos else inputs[k] for k in range(len(inputs))]).numpy())
+            flat[j] = orig
+            num_flat[j] = (f_hi - f_lo) / (2 * eps)
+        np.testing.assert_allclose(analytic[gi], num, atol=atol, rtol=rtol,
+                                   err_msg=f"grad mismatch for input {pos}")

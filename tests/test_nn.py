@@ -1,0 +1,449 @@
+"""nn.Layer system, layers, losses, optimizer, amp, io (SURVEY.md L6 parity)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+class TestLayerSystem:
+    def test_parameters_and_state_dict(self):
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = nn.Linear(4, 8)
+                self.fc2 = nn.Linear(8, 2)
+
+            def forward(self, x):
+                return self.fc2(F.relu(self.fc1(x)))
+
+        net = Net()
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+        sd = net.state_dict()
+        net2 = Net()
+        net2.set_state_dict(sd)
+        np.testing.assert_array_equal(net2.fc1.weight.numpy(), net.fc1.weight.numpy())
+
+    def test_train_eval_mode(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([100, 100])
+        d.eval()
+        np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+        d.train()
+        out = d(x).numpy()
+        assert (out == 0).any() and out.max() == pytest.approx(2.0)
+
+    def test_sequential_layerlist(self):
+        seq = nn.Sequential(nn.Linear(3, 4), nn.ReLU(), nn.Linear(4, 2))
+        out = seq(paddle.randn([5, 3]))
+        assert out.shape == [5, 2]
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        assert len(ll.parameters()) == 6
+
+    def test_hooks(self):
+        lin = nn.Linear(2, 2)
+        calls = []
+        h = lin.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+        lin(paddle.ones([1, 2]))
+        h.remove()
+        lin(paddle.ones([1, 2]))
+        assert len(calls) == 1
+
+    def test_to_dtype(self):
+        lin = nn.Linear(2, 2)
+        lin.to(dtype="bfloat16")
+        assert lin.weight.dtype == "bfloat16"
+
+
+class TestLayers:
+    def test_linear_matches_numpy(self):
+        lin = nn.Linear(4, 3)
+        x = np.random.randn(2, 4).astype(np.float32)
+        exp = x @ lin.weight.numpy() + lin.bias.numpy()
+        np.testing.assert_allclose(lin(paddle.to_tensor(x)).numpy(), exp, atol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4, padding_idx=0)
+        out = emb(paddle.to_tensor(np.array([[1, 0, 3]])))
+        assert out.shape == [1, 3, 4]
+        np.testing.assert_array_equal(out.numpy()[0, 1], np.zeros(4))
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = paddle.randn([4, 8])
+        out = ln(x).numpy()
+        np.testing.assert_allclose(out.mean(-1), 0, atol=1e-5)
+        np.testing.assert_allclose(out.std(-1), 1, atol=1e-2)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = np.random.randn(4, 8).astype(np.float32)
+        out = rn(paddle.to_tensor(x)).numpy()
+        exp = x / np.sqrt((x**2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(out, exp, atol=1e-5)
+
+    def test_batchnorm_running_stats(self):
+        bn = nn.BatchNorm1D(4)
+        x = paddle.randn([16, 4]) * 3 + 1
+        bn.train()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), 0)
+        bn.eval()
+        out = bn(x)
+        assert out.shape == [16, 4]
+
+    def test_conv2d(self):
+        conv = nn.Conv2D(3, 8, 3, padding=1)
+        out = conv(paddle.randn([2, 3, 16, 16]))
+        assert out.shape == [2, 8, 16, 16]
+        out2 = nn.Conv2D(3, 8, 3, stride=2)(paddle.randn([2, 3, 16, 16]))
+        assert out2.shape == [2, 8, 7, 7]
+
+    def test_conv_grad(self):
+        conv = nn.Conv2D(2, 4, 3)
+        x = paddle.randn([1, 2, 8, 8])
+        loss = conv(x).sum()
+        loss.backward()
+        assert conv.weight.grad is not None
+        assert conv.weight.grad.shape == [4, 2, 3, 3]
+
+    def test_pools(self):
+        x = paddle.randn([2, 3, 8, 8])
+        assert nn.MaxPool2D(2)(x).shape == [2, 3, 4, 4]
+        assert nn.AvgPool2D(2)(x).shape == [2, 3, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [2, 3, 1, 1]
+
+    def test_multihead_attention(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = paddle.randn([2, 5, 16])
+        out = mha(x, x, x)
+        assert out.shape == [2, 5, 16]
+
+    def test_transformer(self):
+        model = nn.Transformer(d_model=16, nhead=2, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32)
+        src = paddle.randn([2, 4, 16])
+        tgt = paddle.randn([2, 3, 16])
+        out = model(src, tgt)
+        assert out.shape == [2, 3, 16]
+
+    def test_attention_causal_matches_reference(self):
+        from paddle_tpu.kernels import attention_reference
+        q = np.random.randn(1, 4, 2, 8).astype(np.float32)
+        out = F.scaled_dot_product_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q), is_causal=True)
+        # row 0 attends only to itself -> equals v row 0
+        np.testing.assert_allclose(out.numpy()[:, 0], q[:, 0], atol=1e-5)
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, 2, 1, 4])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels))
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        exp = -np.log(p[np.arange(4), labels]).mean()
+        np.testing.assert_allclose(loss.numpy(), exp, rtol=1e-5)
+
+    def test_cross_entropy_ignore_index(self):
+        logits = np.random.randn(4, 5).astype(np.float32)
+        labels = np.array([0, -100, 1, -100])
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels), ignore_index=-100)
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        exp = -np.log(p[[0, 2], [0, 1]]).mean()
+        np.testing.assert_allclose(loss.numpy(), exp, rtol=1e-5)
+
+    def test_cross_entropy_soft_label(self):
+        logits = np.random.randn(3, 4).astype(np.float32)
+        soft = np.random.dirichlet(np.ones(4), 3).astype(np.float32)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(soft), soft_label=True)
+        logp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+        np.testing.assert_allclose(loss.numpy(), -(soft * logp).sum(-1).mean(), rtol=1e-4)
+
+    def test_mse_bce(self):
+        a, b = np.random.rand(3, 2).astype(np.float32), np.random.rand(3, 2).astype(np.float32)
+        np.testing.assert_allclose(
+            F.mse_loss(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+            ((a - b) ** 2).mean(), rtol=1e-5)
+        np.testing.assert_allclose(
+            F.binary_cross_entropy(paddle.to_tensor(a), paddle.to_tensor((b > 0.5).astype(np.float32))).numpy(),
+            -(np.where(b > 0.5, np.log(a), np.log(1 - a))).mean(), rtol=1e-4)
+
+
+class TestOptimizers:
+    def _quadratic(self, opt_cls, steps=60, **kw):
+        w = paddle.to_tensor(np.array([3.0, -2.0], dtype=np.float32), stop_gradient=False)
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(w._data)
+        opt = opt_cls(parameters=[p], **kw)
+        for _ in range(steps):
+            loss = (p * p).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return np.abs(p.numpy()).max()
+
+    def test_sgd(self):
+        assert self._quadratic(paddle.optimizer.SGD, learning_rate=0.1) < 0.01
+
+    def test_momentum(self):
+        assert self._quadratic(paddle.optimizer.Momentum, steps=120,
+                               learning_rate=0.05, momentum=0.9) < 0.05
+
+    def test_adam(self):
+        assert self._quadratic(paddle.optimizer.Adam, steps=100, learning_rate=0.3) < 0.05
+
+    def test_adamw_decay(self):
+        assert self._quadratic(paddle.optimizer.AdamW, steps=100, learning_rate=0.3,
+                               weight_decay=0.01) < 0.05
+
+    def test_adamw_matches_manual(self):
+        from paddle_tpu.tensor import Parameter
+        w0 = np.array([1.0, 2.0], dtype=np.float32)
+        p = Parameter(w0.copy())
+        opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+        g = np.array([0.5, -0.5], dtype=np.float32)
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        # manual decoupled adamw step 1
+        w = w0 * (1 - 0.1 * 0.1)
+        m = 0.1 * g
+        v = 0.001 * g * g
+        m_hat = m / (1 - 0.9)
+        v_hat = v / (1 - 0.999)
+        exp = w - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+        np.testing.assert_allclose(p.numpy(), exp, rtol=1e-5)
+
+    def test_master_weights_bf16(self):
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(paddle.ones([4], dtype="bfloat16")._data)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=[p], multi_precision=True)
+        p.grad = paddle.ones([4], dtype="bfloat16")
+        opt.step()
+        state = opt._state[id(p)]
+        assert state["master_weight"].dtype == np.float32
+        assert p.dtype == "bfloat16"
+
+    def test_grad_clip_global_norm(self):
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(np.zeros(4, dtype=np.float32))
+        clip = nn.ClipGradByGlobalNorm(1.0)
+        opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p], grad_clip=clip)
+        p.grad = paddle.to_tensor(np.full(4, 10.0, dtype=np.float32))
+        opt.step()
+        np.testing.assert_allclose(np.linalg.norm(p.numpy()), 1.0, rtol=1e-5)
+
+    def test_lr_scheduler(self):
+        sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+        from paddle_tpu.tensor import Parameter
+        opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[Parameter(np.zeros(1, np.float32))])
+        lrs = []
+        for _ in range(5):
+            lrs.append(opt.get_lr())
+            sched.step()
+        np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    def test_warmup(self):
+        s = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+        vals = []
+        for _ in range(12):
+            vals.append(s())
+            s.step()
+        assert vals[0] == 0.0 and abs(vals[5] - 0.05) < 1e-6 and vals[11] == pytest.approx(0.1)
+
+    def test_state_dict_roundtrip(self):
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(np.ones(3, np.float32))
+        p.name = "w"
+        opt = paddle.optimizer.Adam(parameters=[p])
+        p.grad = paddle.to_tensor(np.ones(3, np.float32))
+        opt.step()
+        sd = opt.state_dict()
+        p2 = Parameter(np.ones(3, np.float32))
+        p2.name = "w"
+        opt2 = paddle.optimizer.Adam(parameters=[p2])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == 1
+        np.testing.assert_allclose(opt2._state[id(p2)]["moment1"], opt._state[id(p)]["moment1"])
+
+
+class TestAmp:
+    def test_auto_cast_o1(self):
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            a = paddle.randn([4, 4])
+            out = paddle.matmul(a, a)
+            assert out.dtype == "bfloat16"
+            s = F.softmax(out)  # black-ish: computed in fp32
+            assert s.dtype == "float32"
+
+    def test_o2_decorate(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.AdamW(parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+        assert model.weight.dtype == "bfloat16"
+        assert opt._multi_precision
+
+    def test_grad_scaler_fp16_flow(self):
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(np.ones(2, np.float32))
+        opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = (paddle.to_tensor([1.0], stop_gradient=False) * 0).sum()
+        sp = Parameter(np.array([2.0], np.float32))
+        loss = (sp * sp).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        np.testing.assert_allclose(sp.grad.numpy(), [4.0 * 1024], rtol=1e-6)
+        opt2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=[sp])
+        scaler.step(opt2)
+        np.testing.assert_allclose(sp.numpy(), [2.0 - 0.4], rtol=1e-5)
+
+
+class TestIO:
+    def test_dataloader(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        dl = DataLoader(DS(), batch_size=4, shuffle=False, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3] and y.shape == [4]
+
+    def test_dataloader_workers_and_shuffle(self):
+        from paddle_tpu.io import DataLoader, TensorDataset
+        ds = TensorDataset([paddle.arange(20, dtype="float32"), paddle.arange(20, dtype="float32")])
+        dl = DataLoader(ds, batch_size=5, shuffle=True, num_workers=2)
+        seen = np.sort(np.concatenate([b[0].numpy().reshape(-1) for b in dl]))
+        np.testing.assert_array_equal(seen, np.arange(20))
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import DistributedBatchSampler
+
+        class DS:
+            def __len__(self):
+                return 10
+        s0 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=0)
+        s1 = DistributedBatchSampler(DS(), batch_size=2, num_replicas=2, rank=1)
+        i0 = [i for b in s0 for i in b]
+        i1 = [i for b in s1 for i in b]
+        assert len(set(i0) & set(i1)) == 0
+        assert len(i0) == len(i1) == 5
+
+    def test_save_load(self, tmp_path):
+        model = nn.Linear(3, 3)
+        opt = paddle.optimizer.Adam(parameters=model.parameters())
+        path = str(tmp_path / "ckpt.pdparams")
+        paddle.save({"model": model.state_dict(), "opt": opt.state_dict()}, path)
+        loaded = paddle.load(path)
+        np.testing.assert_array_equal(loaded["model"]["weight"].numpy(), model.weight.numpy())
+
+    def test_save_load_bf16(self, tmp_path):
+        t = paddle.ones([3], dtype="bfloat16")
+        path = str(tmp_path / "t.pd")
+        paddle.save({"t": t}, path)
+        loaded = paddle.load(path)
+        assert loaded["t"].dtype == "bfloat16"
+
+
+class TestReviewRegressions:
+    """Regression tests for the round-1 code-review findings."""
+
+    def test_batchnorm_training_grad_is_true_gradient(self):
+        # batch stats must be differentiated through (not constants)
+        import jax
+        import jax.numpy as jnp
+        x_np = np.random.randn(8, 4).astype(np.float32)
+        bn = nn.BatchNorm1D(4)
+        bn.train()
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        (bn(x) ** 2).sum().backward()
+
+        def ref(a):
+            mean = jnp.mean(a, axis=0)
+            var = jnp.var(a, axis=0)
+            out = (a - mean) / jnp.sqrt(var + 1e-5)
+            return (out ** 2).sum()
+
+        g_ref = np.asarray(jax.grad(ref)(x_np))
+        np.testing.assert_allclose(x.grad.numpy(), g_ref, atol=1e-3)
+
+    def test_split_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            paddle.split(paddle.ones([10, 2]), 3, axis=0)
+
+    def test_dropout_downscale_in_infer(self):
+        x = paddle.ones([4, 4])
+        out = F.dropout(x, p=0.5, training=False, mode="downscale_in_infer")
+        np.testing.assert_allclose(out.numpy(), 0.5 * np.ones((4, 4)))
+
+    def test_conv2d_transpose_output_padding_and_groups(self):
+        x = paddle.randn([1, 4, 5, 5])
+        w = paddle.randn([4, 2, 3, 3])  # [in, out/groups, k, k], groups=2 -> out=4
+        out = F.conv2d_transpose(x, w, stride=2, padding=1, output_padding=1, groups=2)
+        # out = (5-1)*2 - 2*1 + 3 + 1 = 10
+        assert out.shape == [1, 4, 10, 10]
+
+    def test_conv2d_transpose_matches_conv_vjp(self):
+        import jax
+        import jax.numpy as jnp
+        x_np = np.random.randn(1, 3, 8, 8).astype(np.float32)
+        w_np = np.random.randn(2, 3, 3, 3).astype(np.float32)  # fwd conv weight [out=2,in=3,k,k]
+
+        def fwd(a):
+            return jax.lax.conv_general_dilated(
+                a, jnp.asarray(w_np), (2, 2), [(1, 1), (1, 1)],
+                dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+        y = np.asarray(fwd(jnp.asarray(x_np)))
+        # conv_transpose == VJP of the strided conv wrt its input; the fwd conv
+        # weight [O=2,I=3,k,k] reads directly as paddle's [in=2, out/g=3, k, k]
+        _, vjp = jax.vjp(fwd, jnp.asarray(x_np))
+        expected = np.asarray(vjp(jnp.asarray(y))[0])
+        out = F.conv2d_transpose(paddle.to_tensor(y), paddle.to_tensor(w_np),
+                                 stride=2, padding=1, output_padding=1)
+        np.testing.assert_allclose(out.numpy(), expected, atol=2e-4)
+
+    def test_weighted_cross_entropy_mean(self):
+        logits = np.random.randn(4, 3).astype(np.float32)
+        labels = np.array([0, 1, 2, 1])
+        w = np.array([1.0, 2.0, 0.5], dtype=np.float32)
+        loss = F.cross_entropy(paddle.to_tensor(logits), paddle.to_tensor(labels),
+                               weight=paddle.to_tensor(w))
+        p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        per = -np.log(p[np.arange(4), labels]) * w[labels]
+        np.testing.assert_allclose(loss.numpy(), per.sum() / w[labels].sum(), rtol=1e-5)
+
+    def test_register_hook_no_global_leak(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        h = x.register_hook(lambda g: g * 3)
+        h.remove()
+        (x * 2).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0])
+
+    def test_grad_create_graph_raises(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = x * x
+        with pytest.raises(NotImplementedError):
+            paddle.grad(y, x, create_graph=True)
+
+    def test_lamb_exclude_fn(self):
+        from paddle_tpu.tensor import Parameter
+        p = Parameter(np.ones(2, np.float32))
+        p.name = "norm.weight"
+        opt = paddle.optimizer.Lamb(learning_rate=0.0, parameters=[p],
+                                    lamb_weight_decay=0.5,
+                                    exclude_from_weight_decay_fn=lambda n: "norm" in n)
+        p.grad = paddle.to_tensor(np.ones(2, np.float32))
+        w_before = p.numpy().copy()
+        opt.step()
+        np.testing.assert_allclose(p.numpy(), w_before)  # lr=0 and no decay applied

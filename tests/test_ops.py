@@ -1,0 +1,314 @@
+"""Op correctness vs numpy + numeric grads (OpTest-style, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+
+def _r(*shape):
+    return np.random.randn(*shape).astype(np.float32)
+
+
+class TestUnaryOps:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("exp", np.exp), ("log", np.log), ("sqrt", np.sqrt), ("abs", np.abs),
+        ("sin", np.sin), ("cos", np.cos), ("tanh", np.tanh), ("floor", np.floor),
+        ("ceil", np.ceil), ("square", np.square), ("sign", np.sign),
+    ])
+    def test_unary(self, name, np_fn):
+        x = np.abs(_r(3, 4)) + 0.5
+        check_output(getattr(paddle, name), np_fn, [x])
+
+    @pytest.mark.parametrize("name", ["exp", "log", "sqrt", "tanh", "sigmoid", "square"])
+    def test_unary_grad(self, name):
+        x = (np.abs(np.random.randn(3, 4)) + 0.5).astype(np.float64)
+        check_grad(getattr(paddle, name), [x])
+
+
+class TestBinaryOps:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+        ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ])
+    def test_binary(self, name, np_fn):
+        check_output(getattr(paddle, name), np_fn, [_r(3, 4), np.abs(_r(3, 4)) + 1.0])
+
+    def test_broadcast(self):
+        check_output(paddle.add, np.add, [_r(3, 1, 4), _r(5, 1)])
+
+    @pytest.mark.parametrize("name", ["add", "multiply", "divide"])
+    def test_binary_grad(self, name):
+        x = np.random.randn(2, 3)
+        y = np.abs(np.random.randn(2, 3)) + 1.0
+        check_grad(getattr(paddle, name), [x, y])
+
+
+class TestReductions:
+    @pytest.mark.parametrize("name,np_fn", [
+        ("sum", np.sum), ("mean", np.mean), ("max", np.max), ("min", np.min),
+    ])
+    def test_full_reduce(self, name, np_fn):
+        check_output(getattr(paddle, name), np_fn, [_r(3, 4)])
+
+    def test_axis_keepdim(self):
+        x = _r(2, 3, 4)
+        check_output(lambda t: paddle.sum(t, axis=1, keepdim=True),
+                     lambda a: np.sum(a, axis=1, keepdims=True), [x])
+        check_output(lambda t: paddle.mean(t, axis=[0, 2]),
+                     lambda a: np.mean(a, axis=(0, 2)), [x])
+
+    def test_logsumexp(self):
+        from scipy.special import logsumexp as sls
+        x = _r(3, 4)
+        check_output(lambda t: paddle.logsumexp(t, axis=1),
+                     lambda a: sls(a, axis=1), [x])
+
+    def test_cumsum(self):
+        x = _r(3, 4)
+        check_output(lambda t: paddle.cumsum(t, axis=1),
+                     lambda a: np.cumsum(a, axis=1), [x])
+        check_grad(lambda t: paddle.cumsum(t, axis=0), [np.random.randn(3, 2)])
+
+
+class TestMatmul:
+    def test_matmul(self):
+        check_output(paddle.matmul, np.matmul, [_r(3, 4), _r(4, 5)])
+
+    def test_matmul_transpose(self):
+        check_output(lambda a, b: paddle.matmul(a, b, transpose_y=True),
+                     lambda a, b: a @ b.T, [_r(3, 4), _r(5, 4)])
+
+    def test_batched(self):
+        check_output(paddle.matmul, np.matmul, [_r(2, 3, 4), _r(2, 4, 5)])
+
+    def test_matmul_grad(self):
+        check_grad(paddle.matmul, [np.random.randn(3, 4), np.random.randn(4, 2)])
+
+    def test_einsum(self):
+        check_output(lambda a, b: paddle.einsum("bij,bjk->bik", a, b),
+                     lambda a, b: np.einsum("bij,bjk->bik", a, b),
+                     [_r(2, 3, 4), _r(2, 4, 5)])
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        x = _r(2, 3, 4)
+        check_output(lambda t: paddle.reshape(t, [6, 4]), lambda a: a.reshape(6, 4), [x])
+        check_output(lambda t: paddle.transpose(t, [2, 0, 1]), lambda a: a.transpose(2, 0, 1), [x])
+
+    def test_concat_split_stack(self):
+        xs = [_r(2, 3), _r(2, 3)]
+        check_output(lambda a, b: paddle.concat([a, b], axis=0), lambda a, b: np.concatenate([a, b], 0), xs)
+        check_output(lambda a, b: paddle.stack([a, b], axis=1), lambda a, b: np.stack([a, b], 1), xs)
+        x = _r(4, 6)
+        outs = paddle.split(paddle.to_tensor(x), 3, axis=1)
+        np.testing.assert_allclose(outs[1].numpy(), x[:, 2:4])
+        outs = paddle.split(paddle.to_tensor(x), [1, 2, -1], axis=1)
+        assert outs[2].shape == [4, 3]
+
+    def test_squeeze_unsqueeze_tile(self):
+        x = _r(2, 1, 3)
+        check_output(lambda t: paddle.squeeze(t, axis=1), lambda a: np.squeeze(a, 1), [x])
+        check_output(lambda t: paddle.unsqueeze(t, axis=0), lambda a: a[None], [x])
+        check_output(lambda t: paddle.tile(t, [2, 2, 1]), lambda a: np.tile(a, (2, 2, 1)), [x])
+
+    def test_gather_scatter(self):
+        x = _r(5, 3)
+        idx = np.array([0, 2, 4])
+        check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                     lambda a: a[idx], [x])
+        upd = _r(3, 3)
+        out = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx), paddle.to_tensor(upd))
+        exp = x.copy()
+        exp[idx] = upd
+        np.testing.assert_allclose(out.numpy(), exp, rtol=1e-6)
+
+    def test_slicing(self):
+        x = _r(4, 5)
+        t = paddle.to_tensor(x)
+        np.testing.assert_allclose(t[1:3, ::2].numpy(), x[1:3, ::2])
+        np.testing.assert_allclose(t[-1].numpy(), x[-1])
+        t2 = paddle.to_tensor(x.copy())
+        t2[0] = 7.0
+        assert np.allclose(t2.numpy()[0], 7.0)
+
+    def test_slice_grad_flows(self):
+        x = paddle.to_tensor(_r(4, 5), stop_gradient=False)
+        y = x[1:3].sum()
+        y.backward()
+        g = x.grad.numpy()
+        assert g[1:3].sum() == pytest.approx(10.0)
+        assert g[0].sum() == 0
+
+    def test_take_along_put_along(self):
+        x = _r(3, 4)
+        idx = np.argsort(x, axis=1)[:, :2]
+        check_output(lambda t: paddle.take_along_axis(t, paddle.to_tensor(idx), axis=1),
+                     lambda a: np.take_along_axis(a, idx, 1), [x])
+
+
+class TestSearchSort:
+    def test_argmax_topk_sort(self):
+        x = _r(3, 5)
+        check_output(lambda t: paddle.argmax(t, axis=1), lambda a: np.argmax(a, 1), [x])
+        v, i = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+        exp = np.sort(x, axis=1)[:, ::-1][:, :2]
+        np.testing.assert_allclose(v.numpy(), exp, rtol=1e-6)
+        check_output(lambda t: paddle.sort(t, axis=1), lambda a: np.sort(a, 1), [x])
+
+    def test_unique_nonzero(self):
+        x = np.array([1, 3, 1, 2, 3])
+        u = paddle.unique(paddle.to_tensor(x))
+        np.testing.assert_array_equal(u.numpy(), [1, 2, 3])
+        nz = paddle.nonzero(paddle.to_tensor(np.array([0, 1, 0, 2])))
+        np.testing.assert_array_equal(nz.numpy(), [[1], [3]])
+
+
+class TestLogicWhere:
+    def test_compare(self):
+        x, y = _r(3, 4), _r(3, 4)
+        check_output(lambda a, b: paddle.greater_than(a, b), lambda a, b: a > b, [x, y])
+        check_output(lambda a, b: paddle.where(paddle.greater_than(a, b), a, b),
+                     lambda a, b: np.where(a > b, a, b), [x, y])
+
+    def test_operator_overloads(self):
+        a = paddle.to_tensor([1.0, 2.0])
+        b = paddle.to_tensor([3.0, 4.0])
+        np.testing.assert_allclose((a + b * 2 - 1 / b).numpy(), [1 + 6 - 1 / 3, 2 + 8 - 0.25], rtol=1e-6)
+        assert bool((a < b).all())
+
+
+class TestLinalg:
+    def test_norm(self):
+        x = _r(3, 4)
+        check_output(lambda t: paddle.norm(t), lambda a: np.linalg.norm(a), [x])
+        check_output(lambda t: paddle.norm(t, p=2, axis=1), lambda a: np.linalg.norm(a, 2, axis=1), [x])
+
+    def test_solve_inv(self):
+        a = _r(4, 4) + 4 * np.eye(4, dtype=np.float32)
+        b = _r(4, 2)
+        check_output(paddle.linalg.solve, np.linalg.solve, [a, b], atol=1e-4)
+        check_output(paddle.linalg.inverse, np.linalg.inv, [a], atol=1e-4)
+
+    def test_svd_qr(self):
+        a = _r(4, 3)
+        u, s, vt = paddle.linalg.svd(paddle.to_tensor(a))
+        np.testing.assert_allclose(np.asarray(u.numpy()) @ np.diag(s.numpy()) @ vt.numpy(),
+                                   a, atol=1e-4)
+
+
+class TestCreationRandom:
+    def test_creation(self):
+        assert paddle.zeros([2, 3]).shape == [2, 3]
+        assert paddle.full([2], 7).numpy().tolist() == [7.0, 7.0]
+        assert paddle.arange(2, 10, 2).numpy().tolist() == [2, 4, 6, 8]
+        assert paddle.eye(3).numpy().trace() == 3.0
+        np.testing.assert_array_equal(paddle.tril(paddle.ones([3, 3])).numpy(),
+                                      np.tril(np.ones((3, 3))))
+
+    def test_random_reproducible(self):
+        paddle.seed(42)
+        a = paddle.randn([3, 3]).numpy()
+        paddle.seed(42)
+        b = paddle.randn([3, 3]).numpy()
+        np.testing.assert_array_equal(a, b)
+        assert abs(paddle.rand([1000]).numpy().mean() - 0.5) < 0.05
+
+    def test_randperm_randint(self):
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+        r = paddle.randint(0, 5, [100]).numpy()
+        assert r.min() >= 0 and r.max() < 5
+
+
+class TestAutogradEngine:
+    def test_chain(self):
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        y = paddle.exp(paddle.sin(x) * 2)
+        y.backward()
+        expected = np.exp(np.sin(2.0) * 2) * 2 * np.cos(2.0)
+        np.testing.assert_allclose(x.grad.numpy(), [expected], rtol=1e-5)
+
+    def test_accumulation_and_clear(self):
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient_blocks(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        y = (x * 2).detach()
+        z = y * 3
+        assert z.stop_gradient
+
+    def test_no_grad(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient
+
+    def test_paddle_grad(self):
+        x = paddle.to_tensor([3.0], stop_gradient=False)
+        y = x * x
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), [6.0])
+        assert x.grad is None  # paddle.grad must not clobber .grad
+
+    def test_multi_output_op_grad(self):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3), stop_gradient=False)
+        a, b, c = paddle.split(x, 3, axis=1)
+        (a.sum() + (c * 2).sum()).backward()
+        np.testing.assert_allclose(x.grad.numpy(), [[1, 0, 2], [1, 0, 2]])
+
+    def test_pylayer(self):
+        from paddle_tpu.autograd import PyLayer
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, grad):
+                return grad * 2
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        y = Double.apply(x)
+        y.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+    def test_register_hook(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        seen = []
+        x.register_hook(lambda g: seen.append(g.numpy()) or g * 2)
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [6.0])
+        assert len(seen) == 1
+
+    def test_jacobian_hessian(self):
+        from paddle_tpu.autograd import hessian, jacobian
+
+        x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+        j = jacobian(lambda t: (t * t).sum(), x)
+        np.testing.assert_allclose(np.asarray(j.numpy()).reshape(-1), [2.0, 4.0])
+        h = hessian(lambda t: (t * t * t).sum(), x)
+        np.testing.assert_allclose(np.diag(np.asarray(h.numpy())), [6.0, 12.0], rtol=1e-5)
+
+
+class TestDtypes:
+    def test_cast_astype(self):
+        x = paddle.to_tensor([1.5, 2.5])
+        assert x.astype("int32").dtype == "int32"
+        assert paddle.cast(x, "float64").dtype == "float64"
+        assert x.astype("bfloat16").dtype == "bfloat16"
+
+    def test_bfloat16_math(self):
+        a = paddle.ones([4, 4], dtype="bfloat16")
+        b = paddle.matmul(a, a)
+        assert b.dtype == "bfloat16"
+        np.testing.assert_allclose(b.astype("float32").numpy(), 4 * np.ones((4, 4)))

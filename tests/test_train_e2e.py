@@ -1,0 +1,191 @@
+"""End-to-end correctness slice (BASELINE.md config 1): a tiny 2-layer
+transformer LM trains — data → forward → loss → backward → optimizer →
+checkpoint — in BOTH eager and fully-compiled (TrainStep) modes, and both
+modes agree."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+VOCAB, SEQ, DIM = 50, 16, 32
+
+
+class TinyLM(nn.Layer):
+    """ERNIE-tiny-style 2-layer transformer LM (paddle.nn.Transformer building
+    blocks; reference config: BASELINE.json configs[0])."""
+
+    def __init__(self):
+        super().__init__()
+        self.embed = nn.Embedding(VOCAB, DIM)
+        self.pos_embed = nn.Embedding(SEQ, DIM)
+        enc_layer = nn.TransformerEncoderLayer(
+            d_model=DIM, nhead=4, dim_feedforward=DIM * 4, dropout=0.0,
+            activation="gelu", normalize_before=True)
+        self.encoder = nn.TransformerEncoder(enc_layer, num_layers=2)
+        self.norm = nn.LayerNorm(DIM)
+        self.head = nn.Linear(DIM, VOCAB)
+
+    def forward(self, tokens):
+        pos = paddle.arange(tokens.shape[1], dtype="int64")
+        h = self.embed(tokens) + self.pos_embed(pos)
+        causal = paddle.to_tensor(
+            np.triu(np.full((tokens.shape[1], tokens.shape[1]), -1e9, np.float32), k=1))
+        h = self.encoder(h, src_mask=causal)
+        return self.head(self.norm(h))
+
+
+def _batch(bs=8):
+    x = np.random.randint(0, VOCAB, (bs, SEQ + 1))
+    return x[:, :-1], x[:, 1:]
+
+
+def _loss_fn(model, tokens, labels):
+    logits = model(tokens)
+    return F.cross_entropy(logits.reshape([-1, VOCAB]), labels.reshape([-1]))
+
+
+class TestEagerTraining:
+    def test_loss_decreases(self):
+        model = TinyLM()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+        losses = []
+        np.random.seed(0)
+        xb, yb = _batch()
+        tx, ty = paddle.to_tensor(xb), paddle.to_tensor(yb)
+        for _ in range(30):
+            loss = _loss_fn(model, tx, ty)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.5, losses
+        assert losses[0] > 3.0  # ~ln(50)
+
+    def test_checkpoint_resume(self, tmp_path):
+        model = TinyLM()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        xb, yb = _batch()
+        tx, ty = paddle.to_tensor(xb), paddle.to_tensor(yb)
+        for _ in range(3):
+            loss = _loss_fn(model, tx, ty)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        path = str(tmp_path / "ckpt")
+        paddle.save(model.state_dict(), path + ".pdparams")
+        paddle.save(opt.state_dict(), path + ".pdopt")
+
+        model2 = TinyLM()
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model2.parameters())
+        model2.set_state_dict(paddle.load(path + ".pdparams"))
+        opt2.set_state_dict(paddle.load(path + ".pdopt"))
+        l1 = float(_loss_fn(model, tx, ty).numpy())
+        l2 = float(_loss_fn(model2, tx, ty).numpy())
+        assert l1 == pytest.approx(l2, rel=1e-6)
+        assert opt2._step_count == 3
+
+
+class TestCompiledTraining:
+    def test_trainstep_matches_eager(self):
+        paddle.seed(7)
+        model_a = TinyLM()
+        model_b = TinyLM()
+        model_b.set_state_dict(model_a.state_dict())
+
+        opt_a = paddle.optimizer.SGD(learning_rate=0.1, parameters=model_a.parameters())
+        opt_b = paddle.optimizer.SGD(learning_rate=0.1, parameters=model_b.parameters())
+
+        np.random.seed(1)
+        xb, yb = _batch(4)
+        tx, ty = paddle.to_tensor(xb), paddle.to_tensor(yb)
+
+        # eager steps
+        eager_losses = []
+        for _ in range(3):
+            loss = _loss_fn(model_a, tx, ty)
+            loss.backward()
+            opt_a.step()
+            opt_a.clear_grad()
+            eager_losses.append(float(loss.numpy()))
+
+        # compiled steps
+        step = paddle.jit.TrainStep(model_b, _loss_fn, opt_b)
+        compiled_losses = [float(step(tx, ty).numpy()) for _ in range(3)]
+
+        np.testing.assert_allclose(eager_losses, compiled_losses, rtol=2e-4, atol=1e-5)
+        for pa, pb in zip(model_a.parameters(), model_b.parameters()):
+            np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=2e-3, atol=2e-5)
+
+    def test_trainstep_decreases_loss(self):
+        model = TinyLM()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+        step = paddle.jit.TrainStep(model, _loss_fn, opt)
+        np.random.seed(2)
+        xb, yb = _batch()
+        tx, ty = paddle.to_tensor(xb), paddle.to_tensor(yb)
+        losses = [float(step(tx, ty).numpy()) for _ in range(30)]
+        assert losses[-1] < losses[0] * 0.5
+
+
+class TestToStatic:
+    def test_to_static_forward(self):
+        model = TinyLM()
+        model.eval()
+        xb, _ = _batch(2)
+        tx = paddle.to_tensor(xb)
+        eager_out = model(tx).numpy()
+        static_model = paddle.jit.to_static(model)
+        static_out = static_model(tx).numpy()
+        np.testing.assert_allclose(eager_out, static_out, rtol=2e-4, atol=1e-5)
+
+    def test_to_static_function(self):
+        @paddle.jit.to_static
+        def f(a, b):
+            return paddle.matmul(a, b) + 1
+
+        x = paddle.randn([3, 4])
+        y = paddle.randn([4, 5])
+        np.testing.assert_allclose(
+            f(x, y).numpy(), (paddle.matmul(x, y) + 1).numpy(), rtol=1e-5)
+
+    def test_to_static_respects_weight_updates(self):
+        lin = nn.Linear(2, 2)
+        static = paddle.jit.to_static(lin)
+        x = paddle.ones([1, 2])
+        out1 = static(x).numpy()
+        lin.weight._data = lin.weight._data * 2
+        lin.bias._data = lin.bias._data * 2
+        out2 = static(x).numpy()
+        np.testing.assert_allclose(out2, out1 * 2, rtol=1e-5)
+
+    def test_jit_save_load(self, tmp_path):
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model.eval()
+        path = str(tmp_path / "model")
+        spec = [paddle.jit.InputSpec([1, 4], "float32")]
+        paddle.jit.save(model, path, input_spec=spec)
+        loaded = paddle.jit.load(path)
+        x = paddle.randn([1, 4])
+        np.testing.assert_allclose(loaded(x).numpy(), model(x).numpy(), rtol=1e-5)
+
+
+class TestAmpTraining:
+    def test_bf16_amp_training(self):
+        model = TinyLM()
+        opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
+        np.random.seed(3)
+        xb, yb = _batch(4)
+        tx, ty = paddle.to_tensor(xb), paddle.to_tensor(yb)
+        losses = []
+        for _ in range(10):
+            with paddle.amp.auto_cast(dtype="bfloat16"):
+                loss = _loss_fn(model, tx, ty)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0]
