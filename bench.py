@@ -1,0 +1,97 @@
+"""Benchmark: Llama training throughput on the available device.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Metric follows BASELINE.json ("PaddleNLP Llama tokens/sec/chip"); vs_baseline is
+achieved-MFU / 0.40 (the north-star 40% MFU target), so 1.0 == target met.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# bf16 peak FLOP/s per chip; ordered most-specific-first for substring match
+_PEAK_FLOPS = (
+    ("v6e", 918e12), ("v6", 918e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5litepod", 197e12), ("v5p", 459e12), ("v5", 459e12), ("v4", 275e12),
+)
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in _PEAK_FLOPS:
+        if k in kind:
+            return v
+    if device.platform == "tpu":
+        return 459e12  # assume v5p-class
+    return 0.0  # CPU: MFU not meaningful
+
+
+def main():
+    from paddle_tpu.models import llama
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.distributed.parallelize import ShardedTrainState
+    from paddle_tpu.optimizer.functional import AdamW
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+
+    if on_tpu:
+        # ~700M-param Llama-3-style model, bf16, remat on — representative of
+        # the 8B recipe's per-chip compute, sized to fit one chip's HBM with
+        # full fp32 AdamW state (params+master+m+v = 14 bytes/param).
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16, num_key_value_heads=8,
+            max_position_embeddings=4096, dtype=jnp.bfloat16, remat=True)
+        B, S, steps = 4, 2048, 10
+    else:
+        cfg = LlamaConfig.tiny()
+        B, S, steps = 4, 64, 3
+
+    mesh = mesh_lib.make_mesh(data=1)
+    st = ShardedTrainState(cfg, llama, mesh,
+                           AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
+    params, opt_state = st.init(jax.random.PRNGKey(0))
+    tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S + 1))
+    batch = st.shard_batch(llama.lm_batch_from_tokens(
+        jnp.asarray(tokens, dtype=jnp.int32)))
+
+    # warmup/compile.  NB: force completion via host transfer (float()), not
+    # block_until_ready — remote-execution backends (axon tunnel) can report
+    # ready before the computation has finished.
+    params, opt_state, m = st.step(params, opt_state, batch)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, m = st.step(params, opt_state, batch)
+    final_loss = float(m["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * steps / dt
+    peak = _peak_flops(dev)
+    mfu = (tokens_per_sec * llama.flops_per_token(cfg, S) / peak) if peak else 0.0
+    print(json.dumps({
+        "metric": "llama_train_tokens_per_sec_per_chip",
+        "value": round(tokens_per_sec, 2),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "extra": {
+            "device": getattr(dev, "device_kind", dev.platform),
+            "mfu": round(mfu, 4),
+            "model_params": llama.num_params(cfg),
+            "batch": B, "seq": S, "steps": steps,
+            "loss": final_loss,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

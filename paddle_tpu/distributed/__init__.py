@@ -12,8 +12,29 @@ import os
 
 import jax
 
+from . import mesh  # noqa: F401
+from .mesh import make_mesh, set_global_mesh, get_global_mesh  # noqa: F401
+from . import collective  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, all_reduce, all_gather, reduce_scatter,
+    alltoall, broadcast, scatter, reduce, barrier, send, recv,
+)
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import (  # noqa: F401
+    ProcessMesh, Shard, Replicate, Partial, shard_tensor, reshard,
+    dtensor_from_fn, shard_layer,
+)
+from . import fleet  # noqa: F401
+from . import mp_layers  # noqa: F401
+from . import parallelize  # noqa: F401
+from .parallelize import ShardedTrainState  # noqa: F401
+
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
-           "ParallelEnv"]
+           "ParallelEnv", "ReduceOp", "Group", "new_group", "all_reduce",
+           "all_gather", "reduce_scatter", "alltoall", "broadcast", "scatter",
+           "reduce", "barrier", "send", "recv", "ProcessMesh", "Shard",
+           "Replicate", "Partial", "shard_tensor", "reshard", "fleet",
+           "dtensor_from_fn", "shard_layer", "make_mesh", "ShardedTrainState"]
 
 _initialized = False
 

@@ -1,0 +1,186 @@
+"""Semi-auto parallel API — paddle.distributed.auto_parallel parity.
+
+Reference: shard_tensor distributed/auto_parallel/api.py:86, DistTensor
+phi/core/distributed/auto_parallel/dist_tensor.h:26, TensorDistAttr
+dist_attr.h:74, ProcessMesh process_mesh.h:31, ReshardFunction
+reshard_function.h:29 ({p,r,s}-to-{p,r,s} reshard rules).
+
+TPU-native: this IS jax.sharding.  ProcessMesh -> Mesh, TensorDistAttr
+placements -> PartitionSpec, shard_tensor -> device_put(NamedSharding),
+reshard -> device_put with a new sharding (XLA emits the collective), and
+SPMD rule inference (matmul.cc spmd_rules) -> GSPMD propagation inside jit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "dtensor_from_fn", "reshard", "shard_layer", "get_placements"]
+
+
+class Placement:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Shard(Placement):
+    """Shard along tensor dim `dim` over the corresponding mesh axis."""
+    dim: int
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Replicate(Placement):
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+
+@dataclasses.dataclass(frozen=True)
+class Partial(Placement):
+    """Pending-reduction placement (reference: partial status in dist_attr).
+    XLA has no user-visible partial state outside jit; resharding a Partial
+    applies the reduction immediately."""
+    reduce_type: str = "sum"
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+
+class ProcessMesh:
+    """Reference process_mesh.h:31 — an N-D array of device ids with axis names."""
+
+    def __init__(self, mesh: Union[Sequence, np.ndarray], dim_names: Optional[List[str]] = None):
+        arr = np.asarray(mesh)
+        self._shape = list(arr.shape)
+        self._ids = arr.reshape(-1).tolist()
+        self._dim_names = dim_names or [f"d{i}" for i in range(arr.ndim)]
+        devices = jax.devices()
+        dev_arr = np.asarray([devices[i] for i in self._ids]).reshape(arr.shape)
+        self._jax_mesh = Mesh(dev_arr, tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @property
+    def process_ids(self):
+        return self._ids
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def mesh(self):
+        return self._jax_mesh
+
+    def get_dim_size(self, name):
+        return self._shape[self._dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self._shape}, dim_names={self._dim_names})"
+
+
+def _placements_to_spec(placements: Sequence[Placement], ndim: int,
+                        pmesh: ProcessMesh) -> P:
+    parts: List[Any] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            axis_name = pmesh.dim_names[mesh_dim]
+            cur = parts[pl.dim]
+            if cur is None:
+                parts[pl.dim] = axis_name
+            elif isinstance(cur, tuple):
+                parts[pl.dim] = cur + (axis_name,)
+            else:
+                parts[pl.dim] = (cur, axis_name)
+    return P(*parts)
+
+
+def shard_tensor(data, mesh: ProcessMesh, placements: Sequence[Placement],
+                 dtype=None, stop_gradient: bool = True):
+    """Place a tensor on the mesh with the given placements -> jax.Array with
+    a NamedSharding (the DistTensor analog)."""
+    raw = getattr(data, "_data", data)
+    raw = jnp.asarray(raw)
+    spec = _placements_to_spec(placements, raw.ndim, mesh)
+    out = jax.device_put(raw, NamedSharding(mesh.mesh, spec))
+    if hasattr(data, "_data"):
+        data.data = out
+        return data
+    return out
+
+
+def dtensor_from_fn(fn, mesh: ProcessMesh, placements: Sequence[Placement],
+                    *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def reshard(dist_tensor, mesh: ProcessMesh, placements: Sequence[Placement]):
+    """Change placements — XLA inserts the needed collective (the reference's
+    ReshardFunction table: p2r=allreduce, s2r=allgather, r2s=slice...)."""
+    raw = getattr(dist_tensor, "_data", dist_tensor)
+    spec = _placements_to_spec(placements, raw.ndim, mesh)
+    out = jax.device_put(raw, NamedSharding(mesh.mesh, spec))
+    if hasattr(dist_tensor, "_data"):
+        dist_tensor.data = out
+        return dist_tensor
+    return out
+
+
+def get_placements(arr) -> List[Placement]:
+    """Recover placement objects from a NamedSharding-ed jax.Array."""
+    raw = getattr(arr, "_data", arr)
+    sh = raw.sharding
+    if not isinstance(sh, NamedSharding):
+        return [Replicate()]
+    out: List[Placement] = []
+    for mesh_dim, name in enumerate(sh.mesh.axis_names):
+        placed = Replicate()
+        for tdim, part in enumerate(sh.spec):
+            names = part if isinstance(part, tuple) else (part,)
+            if name in [n for n in names if n]:
+                placed = Shard(tdim)
+        out.append(placed)
+    return out
+
+
+def shard_layer(layer, process_mesh: ProcessMesh, shard_fn=None,
+                input_fn=None, output_fn=None):
+    """Apply shard_fn(name, layer, mesh) to each sublayer's params in place."""
+    if shard_fn is None:
+        def shard_fn(name, sublayer, mesh):  # replicate by default
+            for p in sublayer.parameters(include_sublayers=False):
+                shard_tensor(p, mesh, [Replicate()] * len(mesh.shape))
+    for name, sub in layer.named_sublayers(include_self=True):
+        shard_fn(name, sub, process_mesh)
+    return layer

@@ -1,0 +1,298 @@
+"""Collective communication API — paddle.distributed.* parity, GSPMD-native.
+
+Reference parity: python/paddle/distributed/communication/*.py (all_reduce,
+all_gather, reduce_scatter, alltoall, broadcast, scatter, send/recv, stream.*)
+over C++ ProcessGroupNCCL (SURVEY.md C20/C21).
+
+TPU-native semantics: there are no process groups — a **Group is a mesh axis**.
+In the single-controller JAX model, "rank i's tensor" is shard i of a global
+`jax.Array` laid out over that axis.  Each collective here is implemented as a
+`shard_map` over the group's mesh axis using XLA collectives (psum, all_gather,
+ppermute, all_to_all) compiled onto ICI.  The same functions work unchanged
+inside a user's own `shard_map`/jit (pass `axis_name=`), which is the hot path;
+the eager wrappers below exist for API/UX parity and for tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from . import mesh as mesh_lib
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+@dataclasses.dataclass
+class Group:
+    """A communicator = one mesh axis.  Reference: paddle Group objects from
+    distributed/collective.py:176 new_group; here ranks index shards."""
+    mesh: Mesh
+    axis: str
+    id: int = 0
+
+    @property
+    def nranks(self) -> int:
+        return int(self.mesh.shape[self.axis])
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return rank
+
+    @property
+    def ranks(self) -> List[int]:
+        return list(range(self.nranks))
+
+
+_GROUPS: List[Group] = []
+
+
+def new_group(ranks: Optional[Sequence[int]] = None, backend: Optional[str] = None,
+              mesh: Optional[Mesh] = None, axis: Optional[str] = None) -> Group:
+    """Create a group over a mesh axis.  Default: a 1-axis mesh over all (or
+    the given) devices — the world group."""
+    if mesh is None:
+        devices = jax.devices()
+        if ranks is not None:
+            devices = [devices[r] for r in ranks]
+        mesh = Mesh(np.asarray(devices), ("group",))
+        axis = "group"
+    axis = axis or mesh.axis_names[0]
+    g = Group(mesh=mesh, axis=axis, id=len(_GROUPS))
+    _GROUPS.append(g)
+    return g
+
+
+def _world_group() -> Group:
+    gm = mesh_lib.get_global_mesh()
+    if gm is not None:
+        return Group(mesh=gm, axis=gm.axis_names[0])
+    return new_group()
+
+
+def _resolve(group: Optional[Group]) -> Group:
+    return group if group is not None else _world_group()
+
+
+def _raw(x):
+    data = getattr(x, "_data", x)
+    return jnp.asarray(data)
+
+
+def _rewrap(x, out):
+    if hasattr(x, "_data"):
+        x.data = out
+        return x
+    return out
+
+
+def _sharded_over(arr, g: Group):
+    """View the leading dim of `arr` as the per-rank dim, laid out over g.axis."""
+    spec = P(g.axis)
+    return jax.device_put(arr, NamedSharding(g.mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# Functional collectives (usable inside user shard_map with axis_name=...)
+# ---------------------------------------------------------------------------
+
+
+def psum(x, axis_name: str):
+    return jax.lax.psum(x, axis_name)
+
+
+def pmax(x, axis_name: str):
+    return jax.lax.pmax(x, axis_name)
+
+def pmin(x, axis_name: str):
+    return jax.lax.pmin(x, axis_name)
+
+
+def pmean(x, axis_name: str):
+    return jax.lax.pmean(x, axis_name)
+
+
+def all_gather_in(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return jax.lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter_in(x, axis_name: str, axis: int = 0):
+    return jax.lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all_in(x, axis_name: str, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(x, axis_name, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)
+
+
+def ppermute(x, axis_name: str, perm):
+    return jax.lax.ppermute(x, axis_name, perm=perm)
+
+
+# ---------------------------------------------------------------------------
+# Eager API (paddle.distributed.* signatures)
+#
+# Convention: the tensor's LEADING dim is the rank dim when the semantics need
+# per-rank data (all_gather output, scatter input, alltoall); for all_reduce /
+# broadcast the tensor is the same shape on every rank (replicated result).
+# ---------------------------------------------------------------------------
+
+
+_REDUCERS = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.AVG: jax.lax.pmean,
+}
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """Sum/replicate over the group.  Rank-sharded leading dim -> reduced full
+    value on every shard.  If the group has one rank, identity."""
+    g = _resolve(group)
+    x = _raw(tensor)
+    if g.nranks == 1:
+        return _rewrap(tensor, x)
+    if op == ReduceOp.PROD:
+        def f(s):
+            return jnp.exp(jax.lax.psum(jnp.log(s), g.axis))  # pragma: no cover
+    else:
+        red = _REDUCERS[op]
+
+        def f(s):
+            return red(s, g.axis)
+    n = g.nranks
+    assert x.shape[0] % n == 0, (
+        f"all_reduce eager semantics: leading dim {x.shape[0]} is the rank "
+        f"dim and must be divisible by group size {n}")
+    xs = _sharded_over(x, g)
+    # shape-preserving like the reference's in-place all_reduce: every rank
+    # block of the leading dim ends up holding the reduction
+    out = jax.jit(shard_map(f, mesh=g.mesh, in_specs=P(g.axis),
+                            out_specs=P(g.axis)))(xs)
+    return _rewrap(tensor, out)
+
+
+def all_gather(tensor_list, tensor, group: Optional[Group] = None, sync_op=True):
+    """Gather each rank-shard into a python list (paddle fills tensor_list)."""
+    g = _resolve(group)
+    x = _raw(tensor)
+    n = g.nranks
+    if n == 1:
+        tensor_list.append(_rewrap(None, x) if not hasattr(tensor, "_data")
+                           else type(tensor)(x))
+        return tensor_list
+    assert x.shape[0] % n == 0
+    xs = _sharded_over(x, g)
+    out = jax.jit(shard_map(
+        lambda s: jax.lax.all_gather(s, g.axis, axis=0, tiled=True),
+        mesh=g.mesh, in_specs=P(g.axis), out_specs=P(), check_vma=False))(xs)
+    per = out.shape[0] // n
+    for i in range(n):
+        piece = out[i * per:(i + 1) * per]
+        tensor_list.append(type(tensor)(piece) if hasattr(tensor, "_data") else piece)
+    return tensor_list
+
+
+def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op=True):
+    g = _resolve(group)
+    x = _raw(tensor_or_tensor_list) if not isinstance(tensor_or_tensor_list, (list, tuple)) \
+        else jnp.concatenate([_raw(t) for t in tensor_or_tensor_list], axis=0)
+    if g.nranks == 1:
+        return _rewrap(tensor, x)
+    xs = _sharded_over(x, g)
+    out = jax.jit(shard_map(
+        lambda s: jax.lax.psum_scatter(s, g.axis, scatter_dimension=0, tiled=True),
+        mesh=g.mesh, in_specs=P(g.axis), out_specs=P(g.axis)))(xs)
+    return _rewrap(tensor, out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group: Optional[Group] = None,
+             sync_op=True):
+    g = _resolve(group)
+    if isinstance(in_tensor_list, (list, tuple)):
+        x = jnp.stack([_raw(t) for t in in_tensor_list], axis=0)
+    else:
+        x = _raw(in_tensor_list)
+    n = g.nranks
+    if n == 1:
+        out = x
+    else:
+        xs = _sharded_over(x, g)
+        out = jax.jit(shard_map(
+            lambda s: jax.lax.all_to_all(s, g.axis, split_axis=0, concat_axis=0,
+                                         tiled=True),
+            mesh=g.mesh, in_specs=P(g.axis), out_specs=P(g.axis)))(xs)
+    if out_tensor_list is not None:
+        per = out.shape[0] // n
+        for i in range(n):
+            out_tensor_list.append(out[i * per:(i + 1) * per])
+        return out_tensor_list
+    return out
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None, sync_op=True):
+    """Every shard gets rank-src's value.  Leading dim = rank dim."""
+    g = _resolve(group)
+    x = _raw(tensor)
+    n = g.nranks
+    if n == 1:
+        return _rewrap(tensor, x)
+    assert x.shape[0] % n == 0
+    per = x.shape[0] // n
+    src_block = jax.lax.dynamic_slice_in_dim(x, src * per, per, axis=0)
+    out = jnp.tile(src_block, (n,) + (1,) * (x.ndim - 1))
+    return _rewrap(tensor, out)
+
+
+def scatter(tensor, tensor_list=None, src: int = 0,
+            group: Optional[Group] = None, sync_op=True):
+    g = _resolve(group)
+    if tensor_list is not None:
+        stacked = jnp.stack([_raw(t) for t in tensor_list], axis=0)
+    else:
+        stacked = _raw(tensor)
+    n = g.nranks
+    per = stacked.shape[0] // n
+    # each "rank" keeps its slice; we return the sharded global array
+    out = _sharded_over(stacked.reshape((n * per,) + stacked.shape[2:])
+                        if tensor_list is not None else stacked, g)
+    return _rewrap(tensor, out)
+
+
+def reduce(tensor, dst: int = 0, op=ReduceOp.SUM, group: Optional[Group] = None,
+           sync_op=True):
+    return all_reduce(tensor, op=op, group=group)  # result visible to dst too
+
+
+def barrier(group: Optional[Group] = None):
+    jax.effects_barrier()
+
+
+def send(tensor, dst: int = 0, group=None, sync_op=True):  # pragma: no cover
+    raise NotImplementedError(
+        "point-to-point send/recv map to ppermute inside shard_map on TPU; "
+        "use distributed.pipeline (ppermute-based) instead")
+
+
+def recv(tensor, src: int = 0, group=None, sync_op=True):  # pragma: no cover
+    raise NotImplementedError(
+        "point-to-point send/recv map to ppermute inside shard_map on TPU; "
+        "use distributed.pipeline (ppermute-based) instead")

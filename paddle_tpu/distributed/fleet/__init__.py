@@ -1,0 +1,195 @@
+"""paddle.distributed.fleet parity — hybrid-parallel facade over one Mesh.
+
+Reference: fleet/fleet.py:99 Fleet (init:169), fleet/base/topology.py:60
+CommunicateTopology / :173 HybridCommunicateGroup,
+fleet/base/distributed_strategy.py:121 DistributedStrategy.
+
+TPU-native: fleet.init builds ONE jax Mesh from the hybrid_configs degrees and
+installs it as the global mesh; the per-axis "communication groups" of the
+reference become views over mesh axes (collective.Group).  distributed_model /
+distributed_optimizer don't wrap with reducers/hooks — data/grad placement is
+GSPMD sharding, so they return annotation helpers instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from .. import mesh as mesh_lib
+from ..collective import Group
+from ...optimizer.functional import AdamW
+
+__all__ = ["DistributedStrategy", "CommunicateTopology", "HybridCommunicateGroup",
+           "init", "get_hybrid_communicate_group", "distributed_model",
+           "distributed_optimizer", "worker_num", "worker_index"]
+
+
+class DistributedStrategy:
+    """Knob bag (reference backs this with distributed_strategy.proto)."""
+
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = {}
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = {}
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = {}
+        self.pipeline_configs: Dict[str, Any] = {"accumulate_steps": 1}
+        self.find_unused_parameters = False
+        self.gradient_merge = False
+        self.gradient_merge_configs: Dict[str, Any] = {}
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class CommunicateTopology:
+    """Reference topology.py:60 — axis-name -> degree cartesian topology."""
+
+    def __init__(self, hybrid_group_names=None, dims=None):
+        self._names = list(hybrid_group_names or
+                           ["data", "pipe", "sharding", "sep", "model"])
+        self._dims = list(dims or [1] * len(self._names))
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **kwargs):
+        coords = [kwargs[n] for n in self._names]
+        return int(np.ravel_multi_index(coords, self._dims))
+
+    def get_coord(self, rank):
+        return dict(zip(self._names, np.unravel_index(rank, self._dims)))
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:173 — per-axis group accessors over the mesh."""
+
+    def __init__(self, topology: CommunicateTopology, mesh=None):
+        self._topo = topology
+        self._mesh = mesh
+
+    def _axis_group(self, axis: str) -> Optional[Group]:
+        if self._mesh is not None and axis in self._mesh.axis_names:
+            return Group(mesh=self._mesh, axis=axis)
+        return None
+
+    def topology(self):
+        return self._topo
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._topo.get_dim("data")
+
+    def get_model_parallel_world_size(self):
+        return self._topo.get_dim("model")
+
+    def get_pipe_parallel_world_size(self):
+        return self._topo.get_dim("pipe")
+
+    def get_sharding_parallel_world_size(self):
+        return self._topo.get_dim("sharding")
+
+    def get_sep_parallel_world_size(self):
+        return self._topo.get_dim("sep")
+
+    # groups (mesh-axis views)
+    def get_data_parallel_group(self):
+        return self._axis_group("data")
+
+    def get_model_parallel_group(self):
+        return self._axis_group("model")
+
+    def get_pipe_parallel_group(self):
+        return self._axis_group("pipe")
+
+    def get_sharding_parallel_group(self):
+        return self._axis_group("sharding")
+
+    def get_sep_parallel_group(self):
+        return self._axis_group("sep")
+
+    # single-controller: this process sees the whole mesh; rank-in-group is a
+    # per-shard notion that only exists inside shard_map (lax.axis_index)
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    @property
+    def mesh(self):
+        return self._mesh
+
+
+_HCG: Optional[HybridCommunicateGroup] = None
+_STRATEGY: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    """fleet.init — build the global Mesh from strategy.hybrid_configs."""
+    global _HCG, _STRATEGY
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    degrees = {
+        "data": int(hc.get("dp_degree", 1)),
+        "pipe": int(hc.get("pp_degree", 1)),
+        "sharding": int(hc.get("sharding_degree", 1)),
+        "sep": int(hc.get("sep_degree", 1)),
+        "model": int(hc.get("mp_degree", 1)),
+    }
+    n_need = int(np.prod(list(degrees.values())))
+    n_have = jax.device_count()
+    if n_need == 1:
+        degrees["data"] = n_have  # pure DP over all devices by default
+    mesh = mesh_lib.make_mesh(
+        data=degrees["data"], pipe=degrees["pipe"], sharding=degrees["sharding"],
+        sep=degrees["sep"], model=degrees["model"])
+    mesh_lib.set_global_mesh(mesh)
+    topo = CommunicateTopology(dims=[degrees[n] for n in
+                                     ["data", "pipe", "sharding", "sep", "model"]])
+    _HCG = HybridCommunicateGroup(topo, mesh)
+    _STRATEGY = strategy
+    return _HCG
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _HCG
+
+
+def worker_num():
+    return jax.process_count()
+
+
+def worker_index():
+    return jax.process_index()
+
+
+def distributed_model(model):
+    """Reference fleet/model.py:30 — wraps by parallel mode.  GSPMD needs no
+    wrapper: sharding annotations do the work.  Returned unchanged."""
+    return model
+
+
+def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = None):
+    """Reference hybrid_parallel_optimizer.py:251.  Functional optimizers are
+    already hybrid-safe (grad psum + ZeRO come from shardings)."""
+    return optimizer

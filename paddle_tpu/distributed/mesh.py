@@ -1,0 +1,132 @@
+"""Device mesh + logical-axis sharding rules — the GSPMD backbone.
+
+Reference parity: fleet's 5-axis hybrid topology
+(fleet/base/topology.py:60 CommunicateTopology, axes
+["data","pipe","sharding","sep","model"]) and the semi-auto ProcessMesh
+(phi/core/distributed/auto_parallel/process_mesh.h:31).  There are no process
+groups here: a mesh axis IS the group, and collectives are inserted by XLA
+(GSPMD) from sharding annotations — SURVEY.md §5 "ProcessGroup -> Mesh axis".
+
+Axis semantics (same names as the reference topology):
+  data     — data parallel (gradient psum)
+  sharding — ZeRO: optimizer-state/grad/param sharding; also folds into batch
+  sep      — sequence/context parallel (ring attention, Ulysses)
+  model    — tensor parallel (Megatron row/col)
+  pipe     — pipeline parallel (shard_map + ppermute schedule)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH_AXES = ("data", "pipe", "sharding", "sep", "model")
+
+# Logical param/activation axis -> mesh axis (GSPMD rules table).  The analog
+# of the reference's per-op SPMD rules (static/operators/dist_matmul.py etc.)
+# collapsed into one table, because XLA propagates shardings through ops.
+LOGICAL_RULES: Dict[str, Optional[str]] = {
+    "vocab": "model",       # VocabParallelEmbedding / column-parallel lm_head
+    "heads": "model",       # column-parallel qkv, row-parallel out-proj
+    "mlp": "model",         # column-parallel gate/up, row-parallel down
+    "embed": None,          # replicated across model axis (fsdp may override)
+    "layer": None,          # stacked-layer axis; pipeline shards it via shard_map
+    "batch": ("data", "sharding"),  # global batch over dp x zero axes
+    "seq": "sep",           # sequence parallel
+    "expert": "expert",     # expert parallel (MoE meshes add this axis)
+    None: None,
+}
+
+_GLOBAL_MESH: Optional[Mesh] = None
+
+
+def make_mesh(data: int = 1, pipe: int = 1, sharding: int = 1, sep: int = 1,
+              model: int = 1, devices: Optional[Sequence[Any]] = None,
+              extra_axes: Optional[Dict[str, int]] = None) -> Mesh:
+    """Create a named device mesh.  Axis order puts `model` innermost so TP
+    collectives ride the fastest ICI links (scaling-book layout rule)."""
+    sizes = {"data": data, "pipe": pipe, "sharding": sharding, "sep": sep,
+             "model": model}
+    if extra_axes:
+        sizes.update(extra_axes)
+    axes = [a for a, n in sizes.items() if n > 1] or ["data"]
+    shape = [sizes.get(a, 1) for a in axes]
+    if devices is None:
+        devices = jax.devices()
+    need = int(np.prod(shape))
+    if need > len(devices):
+        raise ValueError(f"mesh needs {need} devices, have {len(devices)}")
+    dev = np.asarray(devices[:need]).reshape(shape)
+    return Mesh(dev, tuple(axes))
+
+
+def set_global_mesh(mesh: Mesh) -> None:
+    global _GLOBAL_MESH
+    _GLOBAL_MESH = mesh
+
+
+def get_global_mesh() -> Optional[Mesh]:
+    return _GLOBAL_MESH
+
+
+def _rule_for(logical: Optional[str], mesh: Mesh, rules=None):
+    rules = rules or LOGICAL_RULES
+    mesh_axis = rules.get(logical, None)
+    if mesh_axis is None:
+        return None
+    if isinstance(mesh_axis, tuple):
+        present = tuple(a for a in mesh_axis if a in mesh.axis_names)
+        return present if present else None
+    return mesh_axis if mesh_axis in mesh.axis_names else None
+
+
+def logical_to_spec(axes: Sequence[Optional[str]], mesh: Mesh, rules=None) -> P:
+    """Map a tuple of logical axis names to a PartitionSpec for `mesh`."""
+    return P(*[_rule_for(a, mesh, rules) for a in axes])
+
+
+def tree_shardings(axes_tree, mesh: Mesh, rules=None):
+    """Map a pytree of logical-axes tuples to NamedShardings."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, logical_to_spec(axes, mesh, rules)),
+        axes_tree,
+        is_leaf=lambda x: isinstance(x, tuple) and all(
+            a is None or isinstance(a, str) for a in x),
+    )
+
+
+def zero_shard_spec(spec: P, shape, mesh: Mesh, axis: str = "sharding") -> P:
+    """ZeRO sharding: add `axis` to the first unsharded, divisible dimension.
+
+    Applied to optimizer state (stage 1), grads (stage 2) or params (stage 3) —
+    the reference's DygraphShardingOptimizer / GroupShardedStage2/3
+    (SURVEY.md C28) expressed as a sharding annotation.
+    """
+    if axis not in mesh.axis_names or mesh.shape[axis] == 1:
+        return spec
+    n = mesh.shape[axis]
+    parts = list(spec) + [None] * (len(shape) - len(spec))
+    for i, (p, d) in enumerate(zip(parts, shape)):
+        if p is None and d % n == 0:
+            parts[i] = axis
+            return P(*parts)
+        if p is not None:
+            used = p if isinstance(p, tuple) else (p,)
+            if axis in used:
+                return spec  # already sharded on this axis
+    return spec
+
+
+def zero_tree_shardings(param_specs, params_shape_tree, mesh: Mesh,
+                        axis: str = "sharding"):
+    """Apply zero_shard_spec across a pytree of PartitionSpecs."""
+    return jax.tree.map(
+        lambda s, shp: NamedSharding(
+            mesh, zero_shard_spec(s.spec if isinstance(s, NamedSharding) else s,
+                                  shp.shape, mesh, axis)),
+        param_specs, params_shape_tree,
+        is_leaf=lambda x: isinstance(x, (P, NamedSharding)),
+    )

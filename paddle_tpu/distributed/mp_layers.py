@@ -1,0 +1,298 @@
+"""Tensor-parallel (Megatron) + sequence-parallel layers — GSPMD-native.
+
+Reference parity: fleet/layers/mpu/mp_layers.py — VocabParallelEmbedding:44,
+ColumnParallelLinear:312, RowParallelLinear:524, ParallelCrossEntropy:729;
+fleet/utils/sequence_parallel_utils.py — ScatterOp:83 / AllGatherOp:109 /
+ReduceScatterOp:125, ColumnSequenceParallelLinear:228,
+RowSequenceParallelLinear:340; RNG tracker fleet/layers/mpu/random.py:34.
+
+TPU-native design: a "parallel layer" is an ordinary layer whose weight is
+device_put with a NamedSharding over the `model` mesh axis and whose
+activations carry `lax.with_sharding_constraint`s.  The collectives of the
+reference (identity-fwd/allreduce-bwd, allgather, reduce_scatter) are
+inserted by GSPMD where the annotations demand them — including the
+sequence-parallel allgather/reduce-scatter pair around row/col linears.
+Works both under jit and eagerly (jax executes sharded eager ops SPMD).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+from .. import framework
+from ..nn.layer import Layer
+from ..nn import initializer as I
+from ..nn import functional as F
+from ..tensor import Tensor
+
+__all__ = ["VocabParallelEmbedding", "ColumnParallelLinear", "RowParallelLinear",
+           "ParallelCrossEntropy", "ColumnSequenceParallelLinear",
+           "RowSequenceParallelLinear", "ScatterOp", "AllGatherOp",
+           "ReduceScatterOp", "RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "mark_as_sequence_parallel_parameter"]
+
+
+def _mesh():
+    m = mesh_lib.get_global_mesh()
+    if m is None:
+        raise RuntimeError("call fleet.init(...) (or set_global_mesh) first")
+    return m
+
+
+def _tp_size():
+    m = _mesh()
+    return int(m.shape.get("model", 1))
+
+
+def _shard_param(p: Tensor, spec: P):
+    m = _mesh()
+    if all(a is None or (isinstance(a, str) and a not in m.axis_names)
+           for a in spec):
+        return p
+    p.data = jax.device_put(p.data, NamedSharding(m, spec))
+    return p
+
+
+def _constrain(x, spec: P):
+    m = _mesh()
+    names = [a for a in jax.tree.leaves(tuple(spec)) if isinstance(a, str)]
+    if any(n not in m.axis_names for n in names):
+        return x
+    return jax.lax.with_sharding_constraint(x, NamedSharding(m, spec))
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over `model`.  Reference
+    mp_layers.py:44 masks out-of-range ids and allreduces; GSPMD derives the
+    same program from the weight sharding."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 mp_group=None, name=None):
+        super().__init__()
+        self._num = num_embeddings
+        self._dim = embedding_dim
+        self.weight = self.create_parameter(
+            [num_embeddings, embedding_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, P("model", None))
+
+    def forward(self, x):
+        out = F.embedding(x, self.weight)
+        return out
+
+
+class ColumnParallelLinear(Layer):
+    """y = x @ W[:, shard] — output-dim sharded.  Reference mp_layers.py:312.
+    gather_output=True adds an allgather (sharding constraint to replicated)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        assert out_features % _tp_size() == 0, (
+            f"out_features {out_features} not divisible by mp degree {_tp_size()}")
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, P(None, "model"))
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+        if self.bias is not None:
+            _shard_param(self.bias, P("model"))
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec = (None,) * (y.data.ndim - 1)
+        if self.gather_output:
+            y.data = _constrain(y.data, P(*spec, None))
+        else:
+            y.data = _constrain(y.data, P(*spec, "model"))
+        return y
+
+
+class RowParallelLinear(Layer):
+    """y = x[shard] @ W[shard, :] (+allreduce).  Reference mp_layers.py:524."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__()
+        assert in_features % _tp_size() == 0
+        self.in_features = in_features
+        self.out_features = out_features
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _shard_param(self.weight, P("model", None))
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True) if has_bias else None
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        spec = (None,) * (y.data.ndim - 1)
+        y.data = _constrain(y.data, P(*spec, None))  # psum folded by GSPMD
+        return y
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax CE over a vocab-sharded logits tensor.  Reference
+    mp_layers.py:729 (c_softmax_with_cross_entropy op)."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index=-100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        logits = input.data if hasattr(input, "_data") else input
+        labels = label.data if hasattr(label, "_data") else label
+        logits = logits.astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+        ll = jnp.take_along_axis(logits, labels[..., None].astype(jnp.int32),
+                                 axis=-1)
+        loss = (logz - ll)[..., 0]
+        if self.ignore_index is not None:
+            valid = labels != self.ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+        return Tensor(loss[..., None], stop_gradient=False) \
+            if hasattr(input, "_data") else loss[..., None]
+
+
+# ---------------------------------------------------------------------------
+# Sequence parallel (TP-SP, reference sequence_parallel_utils.py)
+# ---------------------------------------------------------------------------
+
+
+def ScatterOp(x, axis=0):
+    """Split along seq dim over model axis (sequence_parallel_utils.py:83)."""
+    raw = getattr(x, "_data", x)
+    spec = [None] * raw.ndim
+    spec[axis] = "model"
+    out = _constrain(raw, P(*spec))
+    if hasattr(x, "_data"):
+        x.data = out
+        return x
+    return out
+
+
+def GatherOp(x, axis=0):
+    raw = getattr(x, "_data", x)
+    out = _constrain(raw, P(*([None] * raw.ndim)))
+    if hasattr(x, "_data"):
+        x.data = out
+        return x
+    return out
+
+
+AllGatherOp = GatherOp
+
+
+def ReduceScatterOp(x, axis=0):
+    """Partial-sum -> scatter over seq dim (sequence_parallel_utils.py:125).
+    Under GSPMD the reduce and the scatter fuse into one reduce_scatter."""
+    return ScatterOp(x, axis=axis)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Input arrives seq-sharded; allgather seq before the column matmul
+    (reference :228).  The allgather is the constraint transition."""
+
+    def forward(self, x):
+        raw = getattr(x, "_data", x)
+        full = _constrain(raw, P(*([None] * raw.ndim)))
+        if hasattr(x, "_data"):
+            x.data = full
+        y = F.linear(x, self.weight, self.bias)
+        spec = (None,) * (y.data.ndim - 1)
+        y.data = _constrain(y.data, P(*spec, "model"))
+        return y
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Output leaves seq-sharded via reduce_scatter (reference :340)."""
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        nd = y.data.ndim
+        spec = [None] * nd
+        spec[0] = "model"  # seq-major layout: (S, B, E) in the reference
+        y.data = _constrain(y.data, P(*spec))
+        return y
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference :190 registers allreduce hooks for SP params; with GSPMD the
+    gradient reduction is derived from shardings, so this only tags."""
+    param.is_sequence_parallel = True
+    return param
+
+
+# ---------------------------------------------------------------------------
+# Model-parallel RNG tracker (reference mpu/random.py:34)
+# ---------------------------------------------------------------------------
+
+
+class RNGStatesTracker:
+    """Named RNG states so dropout can be replicated (global seed) or distinct
+    (local seed) across TP ranks — reference RNGStatesTracker."""
+
+    def __init__(self):
+        self._states = {}
+
+    def reset(self):
+        self._states.clear()
+
+    def add(self, name, seed):
+        if name in self._states:
+            raise ValueError(f"seed name {name} already exists")
+        self._states[name] = (int(seed), 0)  # framework.Generator state
+
+    def get_states_tracker(self):
+        return dict(self._states)
+
+    def set_states_tracker(self, states):
+        self._states = dict(states)
+
+    class _Guard:
+        def __init__(self, tracker, name):
+            self.tracker, self.name = tracker, name
+
+        def __enter__(self):
+            gen = framework.default_generator()
+            self._saved = gen.get_state()
+            gen.set_state(self.tracker._states[self.name])
+            return self
+
+        def __exit__(self, *a):
+            gen = framework.default_generator()
+            self.tracker._states[self.name] = gen.get_state()
+            gen.set_state(self._saved)
+
+    def rng_state(self, name="model-parallel-rng"):
+        if name not in self._states:
+            raise ValueError(f"seed name {name} not added")
+        return RNGStatesTracker._Guard(self, name)
+
+
+_RNG_STATE_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _RNG_STATE_TRACKER
+
+
+def model_parallel_random_seed(seed: int = 2024):
+    """Reference mpu/random.py:88 — global seed + per-rank local seed."""
+    _RNG_STATE_TRACKER.reset()
+    local = seed + 2718  # single-controller: one local stream
+    _RNG_STATE_TRACKER.add("global_seed", seed)
+    _RNG_STATE_TRACKER.add("local_seed", local)

@@ -32,6 +32,21 @@ def _use_pallas() -> bool:
     return _on_tpu() and framework.get_state().flags.get("FLAGS_use_fused_kernels", True)
 
 
+_warned_fallbacks = set()
+
+
+def _warn_pallas_fallback(name: str) -> None:
+    """One-time warning so a silently-degraded hot path is visible."""
+    if name not in _warned_fallbacks:
+        _warned_fallbacks.add(name)
+        import warnings
+
+        warnings.warn(
+            f"pallas kernel '{name}' failed to lower; using the XLA reference "
+            f"path (slower). Set FLAGS_use_fused_kernels=False to silence.",
+            RuntimeWarning, stacklevel=3)
+
+
 # ---------------------------------------------------------------------------
 # RMSNorm
 # ---------------------------------------------------------------------------
@@ -50,12 +65,12 @@ def rms_norm_reference(x, weight=None, epsilon=1e-6):
 
 def rms_norm(x, weight=None, epsilon=1e-6):
     if _use_pallas() and x.ndim >= 2 and x.shape[-1] % 128 == 0 and weight is not None:
-        from .pallas_norm import rms_norm_pallas
+        from .pallas_norm import rms_norm_pallas  # broken module should fail loudly
 
         try:
             return rms_norm_pallas(x, weight, epsilon)
         except Exception:  # noqa: BLE001 — fall back on any lowering issue
-            pass
+            _warn_pallas_fallback("rms_norm")
     return rms_norm_reference(x, weight, epsilon)
 
 
@@ -96,16 +111,16 @@ def attention(q, k, v, mask=None, causal=False, scale=None):
     if (
         _use_pallas()
         and mask is None
-        and q.shape[-1] in (64, 128, 256)
+        and q.shape[-1] % 128 == 0
         and q.shape[1] % 128 == 0
         and k.shape[1] % 128 == 0
     ):
-        from .pallas_attention import flash_attention_pallas
+        from .pallas_attention import flash_attention_pallas  # fail loudly if broken
 
         try:
             return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
         except Exception:  # noqa: BLE001
-            pass
+            _warn_pallas_fallback("attention")
     return attention_reference(q, k, v, mask=mask, causal=causal, scale=scale)
 
 

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: FlashAttention-style fused attention (fwd) + custom VJP.
+
+Reference analog: phi/kernels/gpu/flash_attn_kernel.cu:324 (wraps the vendored
+third_party/flashattn CUDA library).  TPU-native version: an online-softmax
+tiled kernel — q blocks stay resident in VMEM, k/v blocks stream from HBM, the
+(S,S) score matrix never materializes.  Backward recomputes attention from the
+saved (q,k,v) (flash-style residual strategy: O(S·D) residuals, not O(S²));
+the recompute runs as plain XLA ops which fuse well on the MXU.
+
+Layout contract: (B, S, H, D) — the paddle flash_attention layout
+(python/paddle/nn/functional/flash_attention.py:125 in the reference).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# np scalars, not Python literals: under jax_enable_x64 a Python float lowers
+# to an f64 constant, which Mosaic cannot truncate (tpu.truncf legalization).
+_NEG_INF = np.float32(-1e30)
+_TINY = np.float32(1e-30)
+# index-map constants must stay i32 under jax_enable_x64 (Mosaic requirement)
+_0 = np.int32(0)
+
+
+_LANES = 128
+
+
+def _lanes(x, width):
+    """Broadcast/repeat a (bq, 128) lane-replicated value to (bq, width)."""
+    if width == _LANES:
+        return x
+    return pltpu.repeat(x, width // _LANES, axis=1)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, bq: int, bk: int, nk: int):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # skip blocks strictly above the diagonal when causal
+    run = (not causal) or (iq * bq + bq - 1 >= ik * bk)
+
+    @pl.when(run)
+    def _compute():
+        D = q_ref.shape[-1]
+        q = q_ref[0].astype(jnp.float32)   # (bq, D)
+        k = k_ref[0].astype(jnp.float32)   # (bk, D)
+        v = v_ref[0].astype(jnp.float32)   # (bk, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            cols = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_prev = m_scr[...]                              # (bq, 128) lane-replicated
+        m_cur = jax.lax.broadcast_in_dim(
+            jnp.max(s, axis=-1), (bq, _LANES), (0,))
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - _lanes(m_new, bk))               # (bq, bk)
+        alpha = jnp.exp(m_prev - m_new)                  # (bq, 128)
+        l_cur = jax.lax.broadcast_in_dim(
+            jnp.sum(p, axis=-1), (bq, _LANES), (0,))
+        l_scr[...] = l_scr[...] * alpha + l_cur
+        acc_scr[...] = acc_scr[...] * _lanes(alpha, D) + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        D = o_ref.shape[-1]
+        l = _lanes(jnp.maximum(l_scr[...], _TINY), D)
+        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def _block(n, pref):
+    b = min(pref, n)
+    while n % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _flash_fwd(q, k, v, scale, causal, bq=512, bk=512):
+    """q,k,v: (BH, S, D) same head count (GQA pre-expanded)."""
+    BH, S, D = q.shape
+    bq = _block(S, bq)
+    bk = _block(S, bk)
+    nq, nk = S // bq, S // bk
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, _0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+    )(q, k, v)
+
+
+def _reference(q, k, v, scale, causal):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
+    if causal:
+        S = q.shape[1]
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        s = jnp.where(mask, s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal)
+
+
+def _flash_f(q, k, v, scale, causal):
+    return _flash_fwd(q, k, v, scale, causal), (q, k, v)
+
+
+def _flash_b(scale, causal, res, g):
+    q, k, v = res
+    # recompute-based backward (O(S^2) compute, O(S·D) memory residuals)
+    def f(q, k, v):
+        return _reference(q, k, v, scale, causal)
+    _, vjp = jax.vjp(f, q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_f, _flash_b)
+
+
+def flash_attention_pallas(q, k, v, causal=True, scale=None):
+    """q: (B, S, Hq, D); k,v: (B, S, Hkv, D).  Returns (B, S, Hq, D)."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if D % 128 != 0 or S % 128 != 0:
+        # lane-replication layout needs D,S multiples of 128; use the XLA path
+        qt = jnp.swapaxes(q, 1, 2).reshape(B * Hq, S, D)
+        rep = Hq // Hkv
+        kt = jnp.swapaxes(jnp.repeat(k, rep, axis=2), 1, 2).reshape(B * Hq, S, D)
+        vt = jnp.swapaxes(jnp.repeat(v, rep, axis=2), 1, 2).reshape(B * Hq, S, D)
+        out = _reference(qt, kt, vt, float(scale), bool(causal))
+        return jnp.swapaxes(out.reshape(B, Hq, S, D), 1, 2).astype(q.dtype)
+    if Hkv != Hq:
+        rep = Hq // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    to_bh = lambda x: jnp.swapaxes(x, 1, 2).reshape(B * Hq, S, D)  # noqa: E731
+    out = _flash(to_bh(q), to_bh(k), to_bh(v), float(scale), bool(causal))
+    return jnp.swapaxes(out.reshape(B, Hq, S, D), 1, 2)

@@ -1,0 +1,12 @@
+"""Model zoo — TPU-native functional models (the flagship training path).
+
+Reference analog: the reference framework itself ships no LLMs (they live in
+PaddleNLP), but its headline benchmark configs (BASELINE.md) are Llama-3 /
+ERNIE / MoE pretraining.  Here the model zoo is part of the framework: each
+model is a pure-functional JAX program (params pytree + apply fn) with logical
+sharding axes, so the same definition runs eager (via nn.Layer wrappers),
+single-chip jit, or any GSPMD mesh layout (dp/tp/sp/pp/ep) unchanged.
+"""
+
+from . import llama  # noqa: F401
+from .llama import LlamaConfig  # noqa: F401

@@ -1,0 +1,136 @@
+"""Functional optimizers — the compiled (pjit) training path.
+
+Reference parity: optimizer/adamw.py:32 AdamW with multi_precision master
+weights, plus the hybrid-parallel global-grad-norm clip
+(fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:251).
+
+TPU-native design: optimizer state is a pytree that shards exactly like the
+params (ZeRO-1/2/3 fall out of sharding annotations on this state — SURVEY.md
+§7 "ZeRO = sharded optimizer states annotations").  Update is a pure function,
+so it lives inside the same jit as fwd/bwd and XLA fuses it into the gradient
+reduction epilogue.  Master weights: params may be bf16, state keeps fp32
+copies (the multi_precision story of the reference).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class AdamWState(NamedTuple):
+    step: jnp.ndarray        # scalar int32
+    m: Any                   # pytree like params, fp32
+    v: Any                   # pytree like params, fp32
+    master: Any              # fp32 param copies (None per-leaf when param is fp32)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamW:
+    learning_rate: Any = 1e-3          # float or callable(step) -> float
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 0.01
+    grad_clip_norm: Optional[float] = None   # global-norm clip (ClipGradByGlobalNorm)
+    multi_precision: bool = True
+
+    # -- state ------------------------------------------------------------
+    def init(self, params) -> AdamWState:
+        zeros = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        if self.multi_precision:
+            master = jax.tree.map(
+                lambda p: p.astype(jnp.float32) if p.dtype != jnp.float32 else p,
+                params)
+        else:
+            master = jax.tree.map(lambda p: p, params)
+        return AdamWState(step=jnp.zeros((), jnp.int32), m=zeros,
+                          v=jax.tree.map(jnp.copy, zeros), master=master)
+
+    def _lr(self, step):
+        lr = self.learning_rate
+        return lr(step) if callable(lr) else lr
+
+    # -- update -----------------------------------------------------------
+    def update(self, grads, state: AdamWState, params):
+        """Returns (new_params, new_state).  All math fp32 on master weights."""
+        step = state.step + 1
+        if self.grad_clip_norm is not None:
+            gnorm = global_norm(grads)
+            scale = jnp.minimum(1.0, self.grad_clip_norm / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+
+        b1, b2 = self.beta1, self.beta2
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        lr = self._lr(step)
+
+        def upd(g, m, v, w):
+            g = g.astype(jnp.float32)
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g)
+            mh = m / c1
+            vh = v / c2
+            w = w - lr * (mh / (jnp.sqrt(vh) + self.epsilon) + self.weight_decay * w)
+            return m, v, w
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_m = treedef.flatten_up_to(state.m)
+        flat_v = treedef.flatten_up_to(state.v)
+        flat_w = treedef.flatten_up_to(state.master)
+        out = [upd(g, m, v, w) for g, m, v, w in zip(flat_g, flat_m, flat_v, flat_w)]
+        new_m = treedef.unflatten([o[0] for o in out])
+        new_v = treedef.unflatten([o[1] for o in out])
+        new_master = treedef.unflatten([o[2] for o in out])
+
+        flat_p = treedef.flatten_up_to(params)
+        new_params = treedef.unflatten(
+            [w.astype(p.dtype) for w, p in zip([o[2] for o in out], flat_p)])
+        return new_params, AdamWState(step=step, m=new_m, v=new_v, master=new_master)
+
+
+def global_norm(tree) -> jnp.ndarray:
+    leaves = jax.tree.leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))
+
+
+@dataclasses.dataclass(frozen=True)
+class SGDM:
+    """Functional SGD with momentum (reference optimizer/momentum.py analog)."""
+    learning_rate: Any = 1e-2
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+
+    def init(self, params):
+        return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def update(self, grads, state, params):
+        lr = self.learning_rate
+        lr = lr(None) if callable(lr) else lr
+
+        def upd(g, s, p):
+            g = g.astype(jnp.float32) + self.weight_decay * p.astype(jnp.float32)
+            s = self.momentum * s + g
+            return s, (p.astype(jnp.float32) - lr * s).astype(p.dtype)
+
+        flat_g, treedef = jax.tree.flatten(grads)
+        flat_s = treedef.flatten_up_to(state)
+        flat_p = treedef.flatten_up_to(params)
+        out = [upd(g, s, p) for g, s, p in zip(flat_g, flat_s, flat_p)]
+        return (treedef.unflatten([o[1] for o in out]),
+                treedef.unflatten([o[0] for o in out]))
+
+
+def cosine_schedule(base_lr: float, warmup_steps: int, total_steps: int,
+                    min_ratio: float = 0.1):
+    """LRScheduler analog (optimizer/lr.py CosineAnnealingDecay + LinearWarmup)."""
+    def lr(step):
+        step = step.astype(jnp.float32) if hasattr(step, "astype") else float(step)
+        warm = base_lr * step / max(warmup_steps, 1)
+        prog = jnp.clip((step - warmup_steps) / max(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = base_lr * (min_ratio + (1 - min_ratio) * 0.5 * (1 + jnp.cos(jnp.pi * prog)))
+        return jnp.where(step < warmup_steps, warm, cos)
+    return lr

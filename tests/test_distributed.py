@@ -1,0 +1,195 @@
+"""Distributed API: collectives, auto_parallel, fleet, TP/SP layers.
+
+All on the 8-virtual-device CPU mesh (SURVEY.md §4 test strategy).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet, mesh as mesh_lib, mp_layers
+
+
+@pytest.fixture(autouse=True)
+def _reset_mesh():
+    yield
+    mesh_lib.set_global_mesh(None)
+
+
+class TestCollectives:
+    def test_all_reduce_values(self):
+        g = dist.new_group()
+        n = g.nranks
+        assert n == 8
+        x = np.ones((n, 2), np.float32) * np.arange(n)[:, None]
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, group=g)
+        got = np.asarray(t.data)
+        np.testing.assert_allclose(
+            got, np.full((n, 2), sum(range(n)), np.float32))
+
+    def test_all_reduce_max(self):
+        g = dist.new_group()
+        n = g.nranks
+        x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        t = paddle.to_tensor(x)
+        dist.all_reduce(t, op=dist.ReduceOp.MAX, group=g)
+        np.testing.assert_allclose(np.asarray(t.data),
+                                   np.full((n, 1), n - 1, np.float32))
+
+    def test_all_gather(self):
+        g = dist.new_group()
+        n = g.nranks
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        t = paddle.to_tensor(x)
+        outs = []
+        dist.all_gather(outs, t, group=g)
+        assert len(outs) == n
+        for i in range(n):
+            np.testing.assert_allclose(np.asarray(outs[i].data), x[i:i+1])
+
+    def test_reduce_scatter(self):
+        g = dist.new_group()
+        n = g.nranks
+        x = np.ones((n * n, 2), np.float32)
+        t = paddle.to_tensor(np.zeros((n, 2), np.float32))
+        dist.reduce_scatter(t, paddle.to_tensor(x), group=g)
+        got = np.asarray(t.data)
+        np.testing.assert_allclose(got, np.full((n, 2), n, np.float32))
+
+    def test_alltoall(self):
+        g = dist.new_group()
+        n = g.nranks
+        x = np.arange(n * n, dtype=np.float32).reshape(n * n, 1)
+        out = dist.alltoall(jnp.asarray(x), group=g)
+        got = np.asarray(out).reshape(n, n)
+        want = np.arange(n * n).reshape(n, n).T  # transpose of rank-block matrix
+        np.testing.assert_allclose(got, want)
+
+    def test_broadcast(self):
+        g = dist.new_group()
+        n = g.nranks
+        x = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        t = paddle.to_tensor(x)
+        dist.broadcast(t, src=3, group=g)
+        got = np.asarray(t.data)
+        np.testing.assert_allclose(got, np.tile(x[3:4], (n, 1)))
+
+
+class TestAutoParallel:
+    def test_shard_tensor_and_placements(self):
+        pm = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["x", "y"])
+        arr = jnp.zeros((8, 16))
+        out = dist.shard_tensor(arr, pm, [dist.Shard(0), dist.Shard(1)])
+        from jax.sharding import NamedSharding
+        assert isinstance(out.sharding, NamedSharding)
+        assert out.sharding.spec == jax.sharding.PartitionSpec("x", "y")
+        pl = dist.auto_parallel.get_placements(out)
+        assert pl[0] == dist.Shard(0) and pl[1] == dist.Shard(1)
+
+    def test_reshard(self):
+        pm = dist.ProcessMesh(np.arange(8), ["x"])
+        arr = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+        sharded = dist.shard_tensor(arr, pm, [dist.Shard(0)])
+        repl = dist.reshard(sharded, pm, [dist.Replicate()])
+        np.testing.assert_allclose(np.asarray(repl), np.asarray(arr))
+        assert not [a for a in repl.sharding.spec if a is not None]
+
+    def test_shard_tensor_on_paddle_tensor(self):
+        pm = dist.ProcessMesh(np.arange(8), ["x"])
+        t = paddle.to_tensor(np.zeros((8, 2), np.float32))
+        out = dist.shard_tensor(t, pm, [dist.Shard(0)])
+        assert out is t
+        assert "x" in str(t.data.sharding.spec)
+
+
+class TestFleet:
+    def test_init_topology_groups(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 2}
+        hcg = fleet.init(is_collective=True, strategy=strategy)
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_sharding_parallel_world_size() == 2
+        g = hcg.get_model_parallel_group()
+        assert g is not None and g.nranks == 2
+        assert mesh_lib.get_global_mesh() is not None
+
+    def test_init_default_pure_dp(self):
+        hcg = fleet.init(is_collective=True)
+        assert hcg.get_data_parallel_world_size() == 8
+
+
+class TestMPLayers:
+    def _fleet_tp4(self):
+        s = fleet.DistributedStrategy()
+        s.hybrid_configs = {"dp_degree": 2, "mp_degree": 4}
+        return fleet.init(strategy=s)
+
+    def test_column_row_roundtrip_matches_dense(self):
+        self._fleet_tp4()
+        paddle.seed(0)
+        col = mp_layers.ColumnParallelLinear(16, 32, gather_output=False,
+                                             has_bias=True)
+        row = mp_layers.RowParallelLinear(32, 16, input_is_parallel=True,
+                                          has_bias=True)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        y = row(col(x))
+        # dense reference with the same weights
+        W1 = np.asarray(col.weight.data)
+        b1 = np.asarray(col.bias.data)
+        W2 = np.asarray(row.weight.data)
+        b2 = np.asarray(row.bias.data)
+        want = (np.asarray(x.data) @ W1 + b1) @ W2 + b2
+        np.testing.assert_allclose(np.asarray(y.data), want, rtol=1e-5, atol=1e-5)
+
+    def test_vocab_parallel_embedding(self):
+        self._fleet_tp4()
+        emb = mp_layers.VocabParallelEmbedding(64, 16)
+        ids = paddle.to_tensor(np.random.randint(0, 64, (2, 8)))
+        out = emb(ids)
+        assert out.shape == [2, 8, 16]
+
+    def test_parallel_cross_entropy(self):
+        self._fleet_tp4()
+        ce = mp_layers.ParallelCrossEntropy()
+        logits = paddle.to_tensor(np.random.randn(2, 8, 64).astype(np.float32),
+                                  stop_gradient=False)
+        labels = paddle.to_tensor(np.random.randint(0, 64, (2, 8)))
+        loss = ce(logits, labels)
+        assert np.isfinite(np.asarray(loss.data)).all()
+
+    def test_sequence_parallel_linears(self):
+        self._fleet_tp4()
+        col = mp_layers.ColumnSequenceParallelLinear(16, 32, gather_output=False)
+        row = mp_layers.RowSequenceParallelLinear(32, 16, input_is_parallel=True)
+        x = paddle.to_tensor(np.random.randn(8, 2, 16).astype(np.float32))  # (S,B,E)
+        x = mp_layers.ScatterOp(x, axis=0)
+        y = row(col(x))
+        assert y.shape == [8, 2, 16]
+
+    def test_rng_tracker(self):
+        mp_layers.model_parallel_random_seed(1234)
+        tr = mp_layers.get_rng_state_tracker()
+        with tr.rng_state("global_seed"):
+            a = paddle.randn([4])
+        with tr.rng_state("global_seed"):
+            b = paddle.randn([4])
+        # continuing the same stream -> different draws
+        assert not np.allclose(np.asarray(a.data), np.asarray(b.data))
+
+
+class TestZeroShardSpec:
+    def test_adds_axis_first_divisible(self):
+        from jax.sharding import PartitionSpec as P
+        mesh = mesh_lib.make_mesh(data=2, sharding=4)
+        spec = mesh_lib.zero_shard_spec(P(None, None), (8, 6), mesh)
+        assert spec == P("sharding", None)
+        spec2 = mesh_lib.zero_shard_spec(P(None, None), (6, 8), mesh)
+        assert spec2 == P(None, "sharding")
+        spec3 = mesh_lib.zero_shard_spec(P(None,), (7,), mesh)
+        assert spec3 == P(None,)
