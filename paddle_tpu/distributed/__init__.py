@@ -28,13 +28,18 @@ from . import fleet  # noqa: F401
 from . import mp_layers  # noqa: F401
 from . import parallelize  # noqa: F401
 from .parallelize import ShardedTrainState  # noqa: F401
+from . import context_parallel  # noqa: F401
+from .context_parallel import (  # noqa: F401
+    ring_attention, ulysses_attention, context_parallel_attention,
+)
 
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
            "ParallelEnv", "ReduceOp", "Group", "new_group", "all_reduce",
            "all_gather", "reduce_scatter", "alltoall", "broadcast", "scatter",
            "reduce", "barrier", "send", "recv", "ProcessMesh", "Shard",
            "Replicate", "Partial", "shard_tensor", "reshard", "fleet",
-           "dtensor_from_fn", "shard_layer", "make_mesh", "ShardedTrainState"]
+           "dtensor_from_fn", "shard_layer", "make_mesh", "ShardedTrainState",
+           "ring_attention", "ulysses_attention", "context_parallel_attention"]
 
 _initialized = False
 

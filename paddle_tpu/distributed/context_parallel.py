@@ -1,0 +1,187 @@
+"""Context / sequence parallelism: ring attention + Ulysses (the `sep` axis).
+
+The reference RESERVED a `sep` topology axis (fleet/base/topology.py:63,183 and
+the fused dp-sep group at topology.py:237) but shipped no layer that consumes
+it — only Megatron TP-SP (fleet/utils/sequence_parallel_utils.py) exists there
+(SURVEY.md §5 "Long-context").  This module implements the missing capability
+TPU-natively:
+
+  * **Ring attention** — q stays put, k/v chunks rotate around the `sep` ring
+    via `jax.lax.ppermute` (ICI neighbor exchange); partial attention outputs
+    merge with the online-softmax (max/sum-rescale) rule, so the full (S,S)
+    score matrix never exists and sequence length scales linearly with the
+    number of chips.  (Liu et al., Ring Attention with Blockwise Transformers.)
+  * **Ulysses** — all-to-all swaps the sharded axis from sequence to heads
+    (`jax.lax.all_to_all` over `sep`), runs ordinary flash attention on the
+    full sequence for H/n heads, and swaps back.  (DeepSpeed-Ulysses.)
+
+Both are written as *local* functions over a named axis (usable inside any
+`shard_map`) plus a global wrapper that installs the shard_map over the
+standard mesh (batch over data×sharding, seq over sep, heads over model).
+AD works through both: the transpose of `ppermute` is the reverse permute and
+the transpose of `all_to_all` is `all_to_all`, so `jax.grad` of the wrapper is
+itself a ring/all-to-all program — no custom VJP needed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+_NEG_INF = np.float32(-1e30)
+_TINY = np.float32(1e-30)
+
+
+def _expand_gqa(q, k, v):
+    hq, hk = q.shape[2], k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# Ring attention (local form — call inside shard_map over `axis_name`)
+# ---------------------------------------------------------------------------
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = True,
+                   scale: Optional[float] = None):
+    """Blockwise ring attention over a named mesh axis.
+
+    q: local chunk (B, S/n, Hq, D); k/v: (B, S/n, Hkv, D) in the paddle
+    flash-attention layout, sequence sharded contiguously over `axis_name`
+    (chunk i = rank i's slice).  GQA k/v rotate at their narrow Hkv width —
+    ppermute bytes are the cost ring attention must hide, so heads expand
+    *after* each permute, locally.  Returns the local chunk (B, S/n, Hq, D).
+    """
+    B, Sq, H, D = q.shape
+    Hkv = k.shape[2]
+    rep = H // Hkv
+    Sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    n = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+
+    # (B, H, S, D) f32 compute layout
+    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * np.float32(scale)
+    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
+    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+
+    rows = idx * Sq + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+    cols_local = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+    # kv chunks travel to the NEXT rank each step: after t steps this rank
+    # holds the chunk originally owned by rank (idx - t) mod n.
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        m, l, acc, kc, vc = carry
+        ke = jnp.repeat(kc, rep, axis=1) if rep > 1 else kc
+        ve = jnp.repeat(vc, rep, axis=1) if rep > 1 else vc
+        s = jnp.einsum("bhqd,bhkd->bhqk", qt, ke,
+                       preferred_element_type=jnp.float32)
+        if causal:
+            src = jax.lax.rem(idx - t + n, n)
+            cols = src * Sk + cols_local
+            s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m, m_cur)
+        p = jnp.exp(s - m_new[..., None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, ve, preferred_element_type=jnp.float32)
+        kc = jax.lax.ppermute(kc, axis_name, fwd_perm)
+        vc = jax.lax.ppermute(vc, axis_name, fwd_perm)
+        return (m_new, l, acc, kc, vc), None
+
+    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sq), jnp.float32)
+    a0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    (m, l, acc, _, _), _ = jax.lax.scan(
+        jax.checkpoint(step), (m0, l0, a0, kt, vt), jnp.arange(n))
+
+    out = acc / jnp.maximum(l, _TINY)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Ulysses all-to-all attention (local form)
+# ---------------------------------------------------------------------------
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
+                      scale: Optional[float] = None):
+    """DeepSpeed-Ulysses: all-to-all seq<->head swap over `axis_name`.
+
+    q, k, v: local chunks (B, S/n, H, D) with the (local) head counts
+    divisible by the axis size.  Inside: (B, S, H/n, D) full-sequence
+    attention (flash kernel eligible), then the inverse all-to-all restores
+    sequence sharding.  GQA k/v travel at their narrow Hkv width when
+    divisible (the local attention handles the head-group expansion).
+    """
+    from ..kernels import attention as _local_attention
+
+    n = jax.lax.psum(1, axis_name)
+    if k.shape[2] % n:
+        k, v = _expand_gqa(q, k, v)
+    a2a = functools.partial(jax.lax.all_to_all, axis_name=axis_name, tiled=True)
+    # split heads (axis 2) across the group, gather sequence (axis 1)
+    q = a2a(q, split_axis=2, concat_axis=1)
+    k = a2a(k, split_axis=2, concat_axis=1)
+    v = a2a(v, split_axis=2, concat_axis=1)
+    out = _local_attention(q, k, v, causal=causal, scale=scale)
+    return a2a(out, split_axis=1, concat_axis=2)
+
+
+# ---------------------------------------------------------------------------
+# Global wrapper: shard_map over the standard mesh layout
+# ---------------------------------------------------------------------------
+
+
+def _batch_spec_axes(mesh: Mesh):
+    axes = tuple(a for a in ("data", "sharding") if a in mesh.axis_names)
+    return axes if axes else None
+
+
+def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
+                               impl: str = "ring", causal: bool = True,
+                               scale: Optional[float] = None,
+                               seq_axis: str = "sep"):
+    """Attention with the sequence dimension sharded over `seq_axis`.
+
+    q: (B, S, Hq, D), k/v: (B, S, Hkv, D) global arrays (may already carry
+    shardings; GSPMD reshards to the shard_map in_specs as needed).  Falls back
+    to plain fused attention when the mesh has no sep axis.
+    """
+    mesh = mesh or mesh_lib.get_global_mesh()
+    if (mesh is None or seq_axis not in mesh.axis_names
+            or mesh.shape[seq_axis] == 1):
+        from ..kernels import attention as _local_attention
+        return _local_attention(q, k, v, causal=causal, scale=scale)
+
+    if impl == "ulysses":
+        # the LOCAL head count (after any model-axis sharding) must split
+        # evenly over the sep axis; otherwise ring still works
+        tp = mesh.shape.get("model", 1)
+        local_hq = q.shape[2] // tp
+        if local_hq % mesh.shape[seq_axis] or q.shape[2] % tp:
+            impl = "ring"
+    local = ring_attention if impl == "ring" else ulysses_attention
+    fn = functools.partial(local, axis_name=seq_axis, causal=causal, scale=scale)
+
+    b = _batch_spec_axes(mesh)
+    h = "model" if "model" in mesh.axis_names else None
+    spec = P(b, seq_axis, h, None)
+    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_vma=False)(q, k, v)

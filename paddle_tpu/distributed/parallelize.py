@@ -26,6 +26,21 @@ class ShardedTrainState:
 
     def __init__(self, config, model, mesh: Mesh, optimizer: Optional[AdamW] = None,
                  zero_stage: int = 1, rules=None, donate: bool = True):
+        import dataclasses
+
+        mesh_lib.set_global_mesh(mesh)
+        # a live sep axis means context parallelism: default to ring attention
+        # (the layer that consumes the reference's reserved-but-unused sep axis)
+        if (dataclasses.is_dataclass(config)
+                and getattr(config, "context_parallel", "n/a") is None
+                and "sep" in mesh.axis_names and mesh.shape["sep"] > 1):
+            config = dataclasses.replace(config, context_parallel="ring")
+        # thread the mesh explicitly so a later ShardedTrainState (which
+        # resets the global mesh) cannot alter this state's attention
+        if (dataclasses.is_dataclass(config)
+                and getattr(config, "context_parallel", None)
+                and getattr(config, "mesh", "n/a") is None):
+            config = dataclasses.replace(config, mesh=mesh)
         self.config = config
         self.model = model          # module with init_params/loss_fn/param_logical_axes
         self.mesh = mesh

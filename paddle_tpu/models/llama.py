@@ -55,6 +55,12 @@ class LlamaConfig:
     # training-time knobs
     remat: bool = True           # jax.checkpoint each block (HBM <-> FLOPs trade)
     scan_layers: bool = True     # lax.scan over stacked blocks
+    # context parallelism over the mesh `sep` axis: None | "ring" | "ulysses"
+    # (the capability the reference reserved but never implemented — SURVEY.md §5)
+    context_parallel: Optional[str] = None
+    # explicit mesh for context-parallel shard_map (set by ShardedTrainState;
+    # falls back to the global mesh when None)
+    mesh: Any = None
 
     @property
     def hd(self) -> int:
@@ -213,7 +219,16 @@ def _block(c: LlamaConfig, x, lp, cos, sin, attn_mask):
     v = (h @ lp["wv"]).reshape(B, S, Hkv, D)
     q = _apply_rope(q, cos, sin)
     k = _apply_rope(k, cos, sin)
-    attn = kernels.attention(q, k, v, mask=attn_mask, causal=True)
+    if c.context_parallel:
+        from ..distributed.context_parallel import context_parallel_attention
+        if attn_mask is not None:
+            raise ValueError(
+                "context_parallel attention is pure causal; attn_mask is not "
+                "supported — disable context_parallel or drop the mask")
+        attn = context_parallel_attention(
+            q, k, v, mesh=c.mesh, impl=c.context_parallel, causal=True)
+    else:
+        attn = kernels.attention(q, k, v, mask=attn_mask, causal=True)
     x = x + (attn.reshape(B, S, Hq * D) @ lp["wo"])
 
     h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
