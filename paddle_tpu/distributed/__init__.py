@@ -32,6 +32,8 @@ from . import context_parallel  # noqa: F401
 from .context_parallel import (  # noqa: F401
     ring_attention, ulysses_attention, context_parallel_attention,
 )
+from . import pipeline  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401
 
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
            "ParallelEnv", "ReduceOp", "Group", "new_group", "all_reduce",
@@ -39,7 +41,8 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
            "reduce", "barrier", "send", "recv", "ProcessMesh", "Shard",
            "Replicate", "Partial", "shard_tensor", "reshard", "fleet",
            "dtensor_from_fn", "shard_layer", "make_mesh", "ShardedTrainState",
-           "ring_attention", "ulysses_attention", "context_parallel_attention"]
+           "ring_attention", "ulysses_attention", "context_parallel_attention",
+           "pipeline_apply"]
 
 _initialized = False
 

@@ -40,6 +40,15 @@ _NEG_INF = np.float32(-1e30)
 _TINY = np.float32(1e-30)
 
 
+def _pvary_like(val, ref):
+    """Cast `val` to carry the same varying-manual-axes (vma) type as `ref` —
+    needed for scan carries created fresh inside (nested) shard_map bodies."""
+    want = getattr(jax.typeof(ref), "vma", frozenset())
+    have = getattr(jax.typeof(val), "vma", frozenset())
+    need = tuple(a for a in want if a not in have)
+    return jax.lax.pcast(val, need, to="varying") if need else val
+
+
 def _expand_gqa(q, k, v):
     hq, hk = q.shape[2], k.shape[2]
     if hk != hq:
@@ -105,9 +114,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         vc = jax.lax.ppermute(vc, axis_name, fwd_perm)
         return (m_new, l, acc, kc, vc), None
 
-    m0 = jnp.full((B, H, Sq), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, Sq), jnp.float32)
-    a0 = jnp.zeros((B, H, Sq, D), jnp.float32)
+    m0 = _pvary_like(jnp.full((B, H, Sq), _NEG_INF, jnp.float32), qt)
+    l0 = _pvary_like(jnp.zeros((B, H, Sq), jnp.float32), qt)
+    a0 = _pvary_like(jnp.zeros((B, H, Sq, D), jnp.float32), qt)
     (m, l, acc, _, _), _ = jax.lax.scan(
         jax.checkpoint(step), (m0, l0, a0, kt, vt), jnp.arange(n))
 
@@ -154,6 +163,19 @@ def _batch_spec_axes(mesh: Mesh):
     return axes if axes else None
 
 
+def manual_axes_in_context() -> frozenset:
+    """Mesh axes already manual (inside an enclosing shard_map), else empty."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is None or not am.axis_names:
+            return frozenset()
+        return frozenset(
+            a for a, t in zip(am.axis_names, am.axis_types)
+            if t == jax.sharding.AxisType.Manual)
+    except Exception:  # noqa: BLE001 — no context mesh
+        return frozenset()
+
+
 def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
                                impl: str = "ring", causal: bool = True,
                                scale: Optional[float] = None,
@@ -164,6 +186,15 @@ def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
     shardings; GSPMD reshards to the shard_map in_specs as needed).  Falls back
     to plain fused attention when the mesh has no sep axis.
     """
+    # inside an enclosing shard_map that already made seq_axis manual (the
+    # pipeline composes this way), run the local collective form directly
+    if seq_axis in manual_axes_in_context():
+        am = jax.sharding.get_abstract_mesh()
+        if impl == "ulysses" and q.shape[2] % am.shape[seq_axis]:
+            impl = "ring"  # same downgrade as the global wrapper below
+        local = ring_attention if impl == "ring" else ulysses_attention
+        return local(q, k, v, axis_name=seq_axis, causal=causal, scale=scale)
+
     mesh = mesh or mesh_lib.get_global_mesh()
     if (mesh is None or seq_axis not in mesh.axis_names
             or mesh.shape[seq_axis] == 1):
@@ -181,7 +212,11 @@ def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
     fn = functools.partial(local, axis_name=seq_axis, causal=causal, scale=scale)
 
     b = _batch_spec_axes(mesh)
-    h = "model" if "model" in mesh.axis_names else None
+    tp = mesh.shape.get("model", 1)
+    # heads shard over model only when the NARROW (kv) head count divides tp;
+    # otherwise both replicate — q-sharded with kv-replicated would break the
+    # GQA group alignment inside the local kernels
+    h = "model" if tp > 1 and k.shape[2] % tp == 0 else None
     spec = P(b, seq_axis, h, None)
     return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                      out_specs=spec, check_vma=False)(q, k, v)

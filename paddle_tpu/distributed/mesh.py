@@ -33,7 +33,7 @@ LOGICAL_RULES: Dict[str, Optional[str]] = {
     "heads": "model",       # column-parallel qkv, row-parallel out-proj
     "mlp": "model",         # column-parallel gate/up, row-parallel down
     "embed": None,          # replicated across model axis (fsdp may override)
-    "layer": None,          # stacked-layer axis; pipeline shards it via shard_map
+    "layer": "pipe",        # stacked-layer axis; each pipeline stage owns L/P layers
     "batch": ("data", "sharding"),  # global batch over dp x zero axes
     "seq": "sep",           # sequence parallel
     "expert": "expert",     # expert parallel (MoE meshes add this axis)

@@ -36,9 +36,8 @@ class ShardedTrainState:
                 and "sep" in mesh.axis_names and mesh.shape["sep"] > 1):
             config = dataclasses.replace(config, context_parallel="ring")
         # thread the mesh explicitly so a later ShardedTrainState (which
-        # resets the global mesh) cannot alter this state's attention
+        # resets the global mesh) cannot alter this state's attention/pipeline
         if (dataclasses.is_dataclass(config)
-                and getattr(config, "context_parallel", None)
                 and getattr(config, "mesh", "n/a") is None):
             config = dataclasses.replace(config, mesh=mesh)
         self.config = config

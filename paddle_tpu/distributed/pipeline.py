@@ -1,0 +1,136 @@
+"""Pipeline parallelism over the `pipe` mesh axis — single-jit SPMD schedule.
+
+Reference analog: fleet.meta_parallel.PipelineParallel
+(fleet/meta_parallel/pipeline_parallel.py:132, 1F1B at :387, interleaved at
+:822,1016) and the P2P layer (pp_utils/p2p_communication.py:302) built on
+NCCL batch_isend_irecv.  The TPU has no NCCL p2p; the idiomatic design
+(SURVEY.md §7 "Hard parts") runs the WHOLE microbatch schedule inside one jit:
+
+  * layer-stacked params are sharded over `pipe` (each stage owns L/P layers),
+  * activations move stage-to-stage with `jax.lax.ppermute` (neighbor ICI hop),
+  * a `lax.scan` shift-register executes M + P - 1 ticks (GPipe-style fill/
+    drain; XLA overlaps the ppermute with the next tick's compute),
+  * `shard_map` is MANUAL only over `pipe` — every other axis stays `auto`,
+    so tensor/sequence/data sharding inside a stage is still pure GSPMD.
+
+Backward is just `jax.grad` through the scan: the transpose of ppermute is the
+reverse rotation, so AD materializes the reverse schedule automatically — the
+1F1B runtime the reference hand-codes in Python falls out of the autodiff.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+
+
+def _stage_param_specs(stacked_params, axis: str):
+    """P(axis) on the leading (layer) dim of every leaf."""
+    return jax.tree.map(lambda _: P(axis), stacked_params)
+
+
+def num_stages(mesh: Mesh, axis: str = "pipe") -> int:
+    return mesh.shape.get(axis, 1) if axis in mesh.axis_names else 1
+
+
+def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
+                   mesh: Optional[Mesh] = None, axis: str = "pipe",
+                   n_micro: Optional[int] = None, remat: bool = True,
+                   manual_axes: Sequence[str] = (),
+                   x_spec: Optional[P] = None,
+                   extras_specs: Optional[Sequence[P]] = None):
+    """Run `x` through L stacked layers, pipelined over the `axis` mesh axis.
+
+    block_fn(h, layer_params, *extras) -> h'   (one transformer block)
+    stacked_params: pytree with leading layer dim L on every leaf (L % P == 0)
+    x: (B, ...) activations; microbatched along B (B % n_micro == 0)
+    extras: replicated side inputs (rope tables, masks, ...)
+
+    manual_axes: additional mesh axes to make manual inside the stage body —
+    used to compose with ring/Ulysses attention, whose `sep` collectives must
+    see a manual axis.  When set, x_spec (spec of x WITHOUT the microbatch
+    dim, e.g. P(None, 'sep', None) for seq-sharded activations) and
+    extras_specs describe how those inputs are sharded over the manual axes.
+
+    Returns activations shaped like x.  With no live pipe axis this reduces to
+    a plain lax.scan over layers.
+    """
+    mesh = mesh or mesh_lib.get_global_mesh()
+    pp = num_stages(mesh, axis) if mesh is not None else 1
+
+    if remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    def local_layers(stage_params, h, *ex):
+        def body(carry, lp):
+            return block_fn(carry, lp, *ex), None
+        out, _ = jax.lax.scan(body, h, stage_params)
+        return out
+
+    if pp <= 1:
+        return local_layers(stacked_params, x, *extras)
+
+    M = n_micro or pp
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    mb = jnp.reshape(x, (M, B // M) + x.shape[1:])
+
+    def pipe_local(stage_params, mbs, *ex):
+        # manual over `axis` only: stage_params leaves arrive as (L/P, ...)
+        idx = jax.lax.axis_index(axis)
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        is_last = idx == pp - 1
+
+        def tick(carry, t):
+            state, outs = carry
+            inp = jax.lax.dynamic_index_in_dim(
+                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            h = jnp.where(idx == 0, inp, state)
+            y = local_layers(stage_params, h, *ex)
+            oi = t - (pp - 1)
+            upd = jax.lax.dynamic_update_index_in_dim(
+                outs, y, jnp.clip(oi, 0, M - 1), 0)
+            outs = jnp.where((oi >= 0) & is_last, upd, outs)
+            state = jax.lax.ppermute(y, axis, fwd)
+            return (state, outs), None
+
+        # mark the carries varying over every manual axis (vma scan typing);
+        # seq-sharded inputs are already sep-varying, so only cast the rest
+        vary = (axis,) + tuple(a for a in manual_axes if a != axis)
+
+        def pcast_to(val):
+            cur = getattr(jax.typeof(val), "vma", frozenset())
+            need = tuple(a for a in vary if a not in cur)
+            return jax.lax.pcast(val, need, to="varying") if need else val
+
+        state0 = pcast_to(jnp.zeros_like(mbs[0]))
+        outs0 = pcast_to(jnp.zeros_like(mbs))
+        (_, outs), _ = jax.lax.scan(
+            tick, (state0, outs0), jnp.arange(M + pp - 1))
+        # broadcast the last stage's buffer to the whole pipe axis
+        return jax.lax.psum(jnp.where(is_last, outs, 0.0), axis)
+
+    # manual over `axis` (+ any requested manual_axes, e.g. 'sep' for ring
+    # attention inside stages); every other mesh axis stays automatic, so
+    # GSPMD still lays out TP/DP inside stages
+    pspec = _stage_param_specs(stacked_params, axis)
+    rep = P()
+    mb_spec = P(None, *x_spec) if x_spec is not None else rep
+    ex_specs = tuple(extras_specs) if extras_specs else tuple(rep for _ in extras)
+    out = shard_map(
+        pipe_local, mesh=mesh,
+        in_specs=(pspec, mb_spec) + ex_specs,
+        # check_vma=True is REQUIRED for collectives under partial-manual
+        # shard_map (vma tracking proves the psum'd output is pipe-invariant)
+        out_specs=mb_spec, check_vma=True,
+        axis_names=frozenset({axis}) | frozenset(manual_axes),
+    )(stacked_params, mb, *extras)
+    return jnp.reshape(out, x.shape)

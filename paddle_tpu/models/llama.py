@@ -58,9 +58,11 @@ class LlamaConfig:
     # context parallelism over the mesh `sep` axis: None | "ring" | "ulysses"
     # (the capability the reference reserved but never implemented — SURVEY.md §5)
     context_parallel: Optional[str] = None
-    # explicit mesh for context-parallel shard_map (set by ShardedTrainState;
-    # falls back to the global mesh when None)
+    # explicit mesh for context-parallel / pipeline shard_map (set by
+    # ShardedTrainState; falls back to the global mesh when None)
     mesh: Any = None
+    # pipeline microbatch count (defaults to the pipe-axis size)
+    pp_microbatches: Optional[int] = None
 
     @property
     def hd(self) -> int:
@@ -251,17 +253,43 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
         cos, sin = cos_full[positions], sin_full[positions]
 
     blk = functools.partial(_block, c)
-    if c.remat:
-        blk = jax.checkpoint(blk, static_argnums=())
 
-    if c.scan_layers:
-        def body(carry, lp):
-            return blk(carry, lp, cos, sin, attn_mask), None
-        x, _ = jax.lax.scan(body, x, params["blocks"])
+    from ..distributed import pipeline as pipe_lib
+    # pipeline engages only via an EXPLICIT config.mesh (ShardedTrainState
+    # threads it); the global mesh must not silently reroute plain forwards
+    mesh = c.mesh
+    pp = pipe_lib.num_stages(mesh) if mesh is not None else 1
+
+    if pp > 1:
+        # 1F1B-by-autodiff microbatch pipeline over the pipe axis (C27 analog)
+        if attn_mask is not None:
+            raise ValueError("pipeline parallel forward does not take attn_mask")
+        from jax.sharding import PartitionSpec as P
+        sep_live = (c.context_parallel
+                    and "sep" in mesh.axis_names and mesh.shape["sep"] > 1)
+        if sep_live:
+            # sep goes manual alongside pipe: activations + rope tables enter
+            # seq-sharded and ring attention runs its local collective form
+            manual, x_spec = ("sep",), P(None, "sep", None)
+            ex_specs = (P("sep", None), P("sep", None))
+        else:
+            manual, x_spec, ex_specs = (), None, None
+        x = pipe_lib.pipeline_apply(
+            lambda h, lp, cos, sin: blk(h, lp, cos, sin, None),
+            params["blocks"], x, extras=(cos, sin), mesh=mesh,
+            n_micro=c.pp_microbatches, remat=c.remat,
+            manual_axes=manual, x_spec=x_spec, extras_specs=ex_specs)
     else:
-        for i in range(c.num_hidden_layers):
-            lp = jax.tree.map(lambda a: a[i], params["blocks"])
-            x = blk(x, lp, cos, sin, attn_mask)
+        if c.remat:
+            blk = jax.checkpoint(blk, static_argnums=())
+        if c.scan_layers:
+            def body(carry, lp):
+                return blk(carry, lp, cos, sin, attn_mask), None
+            x, _ = jax.lax.scan(body, x, params["blocks"])
+        else:
+            for i in range(c.num_hidden_layers):
+                lp = jax.tree.map(lambda a: a[i], params["blocks"])
+                x = blk(x, lp, cos, sin, attn_mask)
 
     x = kernels.rms_norm(x, params["final_norm"].astype(jnp.float32), c.rms_norm_eps)
     head = (params["embed"]["weight"].T if c.tie_word_embeddings
