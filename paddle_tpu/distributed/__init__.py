@@ -34,6 +34,11 @@ from .context_parallel import (  # noqa: F401
 )
 from . import pipeline  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import (  # noqa: F401
+    MoEConfig, MoELayer, NaiveGate, SwitchGate, GShardGate,
+    moe_ffn, top_k_gating, global_scatter, global_gather,
+)
 
 __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
            "ParallelEnv", "ReduceOp", "Group", "new_group", "all_reduce",
@@ -42,7 +47,9 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
            "Replicate", "Partial", "shard_tensor", "reshard", "fleet",
            "dtensor_from_fn", "shard_layer", "make_mesh", "ShardedTrainState",
            "ring_attention", "ulysses_attention", "context_parallel_attention",
-           "pipeline_apply"]
+           "pipeline_apply", "MoEConfig", "MoELayer", "NaiveGate", "SwitchGate",
+           "GShardGate", "moe_ffn", "top_k_gating", "global_scatter",
+           "global_gather"]
 
 _initialized = False
 

@@ -1,0 +1,298 @@
+"""Mixture-of-Experts with expert parallelism — TPU-native (C29).
+
+Reference parity: `incubate/distributed/models/moe/moe_layer.py:263 MoELayer`
+(all-to-all dispatch at :107-156), gates under `moe/gate/` (gshard_gate.py,
+switch_gate.py, naive_gate.py), and the `global_scatter`/`global_gather` ops
+(`distributed/utils/moe_utils.py:20,146`).
+
+TPU-native design (SURVEY.md §7 step 5):
+  - Experts are STACKED on a leading axis of the expert weights, sharded over
+    the mesh's ``expert`` axis.  Token dispatch is the GShard einsum form:
+    ``dispatch (N, X, C) x tokens (N, E) -> (X, C, E)``.  When tokens are
+    batch-sharded and experts expert-sharded, XLA lowers that einsum to the
+    all-to-all the reference implements by hand with global_scatter — no
+    manual comm code on the hot path.
+  - Gating (top-1 switch / top-2 gshard) is dense one-hot math: no sorting,
+    no dynamic shapes — everything tiles onto the MXU/VPU.
+  - Capacity-factor token dropping, load-balance aux loss (GShard eq.(4)),
+    router z-loss (ST-MoE) are all fused into the gating computation.
+  - `global_scatter`/`global_gather` are also provided explicitly (shard_map +
+    lax.all_to_all over the expert axis) for API parity and for users who
+    want manual expert parallelism.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..nn.layer import Layer as _Layer
+
+
+# ---------------------------------------------------------------------------
+# Gating
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int
+    top_k: int = 2                      # 1 = switch, 2 = gshard
+    # C = ceil(k*N/X * factor); None = drop-free (C = N, NaiveGate semantics)
+    capacity_factor: Optional[float] = 1.25
+    min_capacity: int = 4
+    aux_loss_weight: float = 0.01       # GShard load-balance loss weight
+    z_loss_weight: float = 1e-3         # router logit z-loss (ST-MoE)
+    normalize_top_k: bool = True        # renormalize top-k gate weights
+    gate_dtype: Any = jnp.float32
+
+
+def compute_capacity(num_tokens: int, cfg: MoEConfig) -> int:
+    if cfg.capacity_factor is None:
+        # drop-free: a token occupies at most one slot per expert (top-k picks
+        # are distinct experts), so N slots per expert covers the worst case
+        return num_tokens
+    cap = int(np.ceil(cfg.top_k * num_tokens / cfg.num_experts
+                      * cfg.capacity_factor))
+    return max(cap, cfg.min_capacity)
+
+
+def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None):
+    """GShard/Switch gating from router logits.
+
+    logits: (N, X) float. Returns (dispatch (N, X, C) bool-ish float,
+    combine (N, X, C) float, aux_loss scalar).
+
+    Reference: gshard_gate.py / switch_gate.py top-k + capacity logic; here the
+    position-in-expert is a cumsum over one-hot masks (static shapes, no sort).
+    """
+    N, X = logits.shape
+    C = capacity if capacity is not None else compute_capacity(N, cfg)
+    logits = logits.astype(cfg.gate_dtype)
+    probs = jax.nn.softmax(logits, axis=-1)                    # (N, X)
+
+    gate_vals, expert_idx = jax.lax.top_k(probs, cfg.top_k)    # (N, k)
+    if cfg.normalize_top_k and cfg.top_k > 1:
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)
+
+    # position of each (token, slot) within its expert's capacity buffer:
+    # slot-major priority — all slot-0 picks rank before any slot-1 pick,
+    # matching GShard's "top-1 tokens first" drop policy.
+    counts = jnp.zeros((X,), cfg.gate_dtype)
+    dispatch = jnp.zeros((N, X, C), cfg.gate_dtype)
+    combine = jnp.zeros((N, X, C), cfg.gate_dtype)
+    for j in range(cfg.top_k):
+        m = jax.nn.one_hot(expert_idx[:, j], X, dtype=cfg.gate_dtype)  # (N, X)
+        pos = jnp.cumsum(m, axis=0) - 1.0 + counts[None, :]            # (N, X)
+        counts = counts + m.sum(axis=0)
+        keep = m * (pos < C)                                           # (N, X)
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
+                                dtype=cfg.gate_dtype)                  # (N, X, C)
+        d = keep[..., None] * pos_oh
+        dispatch = dispatch + d
+        combine = combine + gate_vals[:, j][:, None, None] * d
+
+    # GShard eq.(4) load-balance loss: X * sum_x f_x * p_x where f_x is the
+    # fraction of tokens whose TOP-1 pick is x and p_x the mean router prob.
+    top1 = jax.nn.one_hot(expert_idx[:, 0], X, dtype=cfg.gate_dtype)
+    f = top1.mean(axis=0)
+    p = probs.mean(axis=0)
+    aux = cfg.aux_loss_weight * X * jnp.sum(f * p)
+    if cfg.z_loss_weight:
+        z = jax.nn.logsumexp(logits, axis=-1)
+        aux = aux + cfg.z_loss_weight * jnp.mean(z * z)
+    return dispatch, combine, aux
+
+
+# ---------------------------------------------------------------------------
+# Functional MoE FFN (the hot path used by models)
+# ---------------------------------------------------------------------------
+
+
+def init_moe_ffn_params(key, hidden: int, intermediate: int, cfg: MoEConfig,
+                        dtype=jnp.bfloat16, std: float = 0.02):
+    """Expert weights stacked on a leading (X,) axis + router. SwiGLU experts."""
+    X, E, F = cfg.num_experts, hidden, intermediate
+    ks = jax.random.split(key, 4)
+    n = lambda k, s: (std * jax.random.normal(k, s, jnp.float32)).astype(dtype)
+    return {
+        "router": (std * jax.random.normal(ks[0], (E, X), jnp.float32)),
+        "w_gate": n(ks[1], (X, E, F)),
+        "w_up": n(ks[2], (X, E, F)),
+        "w_down": n(ks[3], (X, F, E)),
+    }
+
+
+def moe_ffn_logical_axes():
+    """Logical sharding axes (mesh.LOGICAL_RULES maps expert->expert axis,
+    mlp->model axis: expert parallel composes with tensor parallel)."""
+    return {
+        "router": (None, None),
+        "w_gate": ("expert", "embed", "mlp"),
+        "w_up": ("expert", "embed", "mlp"),
+        "w_down": ("expert", "mlp", "embed"),
+    }
+
+
+def moe_ffn(x, p, cfg: MoEConfig):
+    """MoE SwiGLU FFN.  x: (B, S, E) -> (out (B, S, E), aux_loss).
+
+    The three einsums below ARE the reference's global_scatter -> expert FFN ->
+    global_gather pipeline (moe_layer.py:107-156): under GSPMD, with x
+    batch-sharded and weights expert-sharded, XLA inserts the all-to-alls.
+    """
+    B, S, E = x.shape
+    N = B * S
+    tok = x.reshape(N, E)
+    logits = tok.astype(cfg.gate_dtype) @ p["router"]
+    dispatch, combine, aux = top_k_gating(logits, cfg)
+    d = dispatch.astype(x.dtype)
+    xp = jnp.einsum("nxc,ne->xce", d, tok)                     # all-to-all in
+    g = jnp.einsum("xce,xef->xcf", xp, p["w_gate"])
+    u = jnp.einsum("xce,xef->xcf", xp, p["w_up"])
+    h = (jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u)
+    eo = jnp.einsum("xcf,xfe->xce", h, p["w_down"])
+    out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), eo)  # all-to-all out
+    return out.reshape(B, S, E), aux
+
+
+# ---------------------------------------------------------------------------
+# Explicit expert-parallel primitives (reference moe_utils.py parity)
+# ---------------------------------------------------------------------------
+
+
+def global_scatter(x, local_count=None, global_count=None, *, mesh: Mesh,
+                   axis: str = "expert"):
+    """Reference `global_scatter` (moe_utils.py:20): every rank holds its own
+    tokens bucketed by destination expert; the exchange hands each expert's
+    buckets to the rank that owns that expert.
+
+    x: (R, X, C, ...) global — dim0 = source rank (sharded over `axis`),
+    dim1 = all X experts, dim2 = per-rank capacity.  Returns
+    (R, X//R, C*R, ...): each rank now owns X//R experts with the capacity
+    blocks of all R source ranks concatenated.  counts args are accepted for
+    API parity; the TPU form is dense/static so they are unused.
+    """
+    del local_count, global_count
+    n = mesh.shape[axis]
+
+    def f(b):
+        b = b[0]  # (X, C, ...)
+        out = jax.lax.all_to_all(b, axis, split_axis=0, concat_axis=1,
+                                 tiled=True)
+        return out[None]
+
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)(x)
+
+
+def global_gather(x, local_count=None, global_count=None, *, mesh: Mesh,
+                  axis: str = "expert"):
+    """Inverse of global_scatter (moe_utils.py:146): (R, X//R, C*R, ...) ->
+    (R, X, C, ...) — expert outputs return to the token-owning ranks."""
+    del local_count, global_count
+
+    def f(b):
+        b = b[0]
+        out = jax.lax.all_to_all(b, axis, split_axis=1, concat_axis=0,
+                                 tiled=True)
+        return out[None]
+
+    spec = P(axis, *([None] * (x.ndim - 1)))
+    return shard_map(f, mesh=mesh, in_specs=spec, out_specs=spec)(x)
+
+
+# ---------------------------------------------------------------------------
+# Eager MoELayer (paddle.incubate.distributed.models.moe.MoELayer parity)
+# ---------------------------------------------------------------------------
+
+
+class NaiveGate:
+    """Plain top-k softmax gate, no capacity drop (naive_gate.py parity)."""
+
+    def __init__(self, d_model, num_experts, top_k=2):
+        self.cfg = MoEConfig(num_experts=num_experts, top_k=top_k,
+                             capacity_factor=None, aux_loss_weight=0.0,
+                             z_loss_weight=0.0)
+
+
+class SwitchGate:
+    """Top-1 gate with capacity (switch_gate.py parity)."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.25):
+        self.cfg = MoEConfig(num_experts=num_experts, top_k=1,
+                             capacity_factor=capacity_factor)
+
+
+class GShardGate:
+    """Top-2 gate with capacity + balance loss (gshard_gate.py parity)."""
+
+    def __init__(self, d_model, num_experts, capacity_factor=1.25):
+        self.cfg = MoEConfig(num_experts=num_experts, top_k=2,
+                             capacity_factor=capacity_factor)
+
+
+class MoELayer(_Layer):
+    """Eager-API MoE layer over nn.Layer experts (moe_layer.py:263 parity).
+
+    A real nn.Layer: the router is a registered Parameter, the experts are
+    registered sublayers, and the whole dispatch -> expert -> combine path is
+    built from tape-recorded ops (tensor.apply_op), so `loss.backward()`
+    reaches router and expert weights.  `gate` is one of NaiveGate/SwitchGate/
+    GShardGate or an MoEConfig.  `last_aux_loss` is a differentiable Tensor —
+    add it to the training loss.
+    """
+
+    def __init__(self, d_model, experts, gate=None, mesh: Optional[Mesh] = None,
+                 name=None):
+        from ..nn.layer import LayerList
+        from ..nn import initializer as I
+
+        super().__init__(name_scope=name)
+        self.d_model = d_model
+        self.experts = LayerList(list(experts))
+        cfg = gate.cfg if hasattr(gate, "cfg") else gate
+        self.cfg = cfg or MoEConfig(num_experts=len(self.experts))
+        if self.cfg.num_experts != len(self.experts):
+            raise ValueError("gate num_experts != len(experts)")
+        self.mesh = mesh
+        self.router = self.create_parameter(
+            [d_model, self.cfg.num_experts],
+            default_initializer=I.Normal(std=0.02))
+        self.last_aux_loss = None
+
+    def forward(self, x):
+        from .. import ops
+        from ..tensor import apply_op, to_tensor
+
+        x = to_tensor(x) if not hasattr(x, "_data") else x
+        B, S, E = x.shape
+        N = B * S
+        tok = ops.reshape(x, [N, E])
+        cfg = self.cfg
+
+        def gating(tok_raw, router_raw):
+            logits = tok_raw.astype(cfg.gate_dtype) @ router_raw
+            return top_k_gating(logits, cfg)
+
+        dispatch, combine, aux = apply_op("moe_gating", gating, tok, self.router)
+        xp = apply_op(
+            "moe_dispatch",
+            lambda d, t: jnp.einsum("nxc,ne->xce", d.astype(t.dtype), t),
+            dispatch, tok)
+        eo = ops.stack([expert(xp[i]) for i, expert in enumerate(self.experts)],
+                       axis=0)
+        out = apply_op(
+            "moe_combine",
+            lambda c, e: jnp.einsum("nxc,xce->ne", c.astype(e.dtype), e),
+            combine, eo)
+        self.last_aux_loss = aux
+        return ops.reshape(out, [B, S, E])

@@ -10,3 +10,5 @@ single-chip jit, or any GSPMD mesh layout (dp/tp/sp/pp/ep) unchanged.
 
 from . import llama  # noqa: F401
 from .llama import LlamaConfig  # noqa: F401
+from . import moe_llama  # noqa: F401
+from .moe_llama import MoELlamaConfig  # noqa: F401

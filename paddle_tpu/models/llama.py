@@ -113,8 +113,11 @@ def _normal(key, shape, std, dtype):
     return (std * jax.random.normal(key, shape, dtype=jnp.float32)).astype(dtype)
 
 
-def init_params(config: LlamaConfig, key=None, seed: int = 0):
-    """Initialize the parameter pytree (truncated-normal-free, scaled-normal init)."""
+def init_params(config: LlamaConfig, key=None, seed: int = 0, init_ffn: bool = True):
+    """Initialize the parameter pytree (truncated-normal-free, scaled-normal init).
+
+    init_ffn=False skips the dense FFN weights — used by variants (MoE) that
+    replace the FFN, so multi-GB dense experts are never materialized."""
     if key is None:
         key = jax.random.PRNGKey(seed)
     c = config
@@ -137,12 +140,13 @@ def init_params(config: LlamaConfig, key=None, seed: int = 0):
             "wk": blk(ks[2], (L, E, Hkv * D)),
             "wv": blk(ks[3], (L, E, Hkv * D)),
             "wo": blk(ks[4], (L, Hq * D, E)),
-            "w_gate": blk(ks[5], (L, E, F)),
-            "w_up": blk(ks[6], (L, E, F)),
-            "w_down": blk(ks[7], (L, F, E)),
         },
         "final_norm": jnp.ones((E,), dtype=jnp.float32),
     }
+    if init_ffn:
+        params["blocks"]["w_gate"] = blk(ks[5], (L, E, F))
+        params["blocks"]["w_up"] = blk(ks[6], (L, E, F))
+        params["blocks"]["w_down"] = blk(ks[7], (L, F, E))
     if not c.tie_word_embeddings:
         params["lm_head"] = _normal(ks[8], (E, V), std, c.dtype)
     return params
@@ -209,8 +213,13 @@ def _apply_rope(x, cos, sin):
 # ---------------------------------------------------------------------------
 
 
-def _block(c: LlamaConfig, x, lp, cos, sin, attn_mask):
-    """One transformer block. x: (B, S, E); lp: this layer's param slice."""
+def _block(c: LlamaConfig, x, lp, cos, sin, attn_mask, ffn_fn=None):
+    """One transformer block. x: (B, S, E); lp: this layer's param slice.
+
+    `ffn_fn(h, lp) -> (out, aux_loss)` overrides the dense SwiGLU FFN — the
+    hook the MoE variant (models/moe_llama.py) plugs its expert FFN into.
+    Returns (x, aux_loss) where aux is 0 for the dense path.
+    """
     B, S, E = x.shape
     D, Hq, Hkv = c.hd, c.num_attention_heads, c.num_key_value_heads
 
@@ -235,14 +244,22 @@ def _block(c: LlamaConfig, x, lp, cos, sin, attn_mask):
 
     h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
                          c.rms_norm_eps).astype(x.dtype)
+    if ffn_fn is not None:
+        out, aux = ffn_fn(h, lp)
+        return x + out.astype(x.dtype), aux
     gate = h @ lp["w_gate"]
     up = h @ lp["w_up"]
     mlp = (jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) @ lp["w_down"]
-    return x + mlp.astype(x.dtype)
+    return x + mlp.astype(x.dtype), jnp.float32(0.0)
 
 
-def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=None):
-    """input_ids: (B, S) int32 -> logits (B, S, V) float32."""
+def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=None,
+            ffn_fn=None, return_aux_loss=False):
+    """input_ids: (B, S) int32 -> logits (B, S, V) float32.
+
+    `ffn_fn` replaces the dense FFN per block (see _block); aux losses from it
+    accumulate across layers and are returned when `return_aux_loss`.
+    """
     c = config
     x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
     S = input_ids.shape[1]
@@ -252,7 +269,7 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
     else:
         cos, sin = cos_full[positions], sin_full[positions]
 
-    blk = functools.partial(_block, c)
+    blk = functools.partial(_block, c, ffn_fn=ffn_fn)
 
     from ..distributed import pipeline as pipe_lib
     # pipeline engages only via an EXPLICIT config.mesh (ShardedTrainState
@@ -264,6 +281,10 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
         # 1F1B-by-autodiff microbatch pipeline over the pipe axis (C27 analog)
         if attn_mask is not None:
             raise ValueError("pipeline parallel forward does not take attn_mask")
+        if ffn_fn is not None:
+            raise NotImplementedError(
+                "custom/MoE FFN under pipeline parallelism is not supported "
+                "yet — use a mesh without a pipe axis for MoE models")
         from jax.sharding import PartitionSpec as P
         sep_live = (c.context_parallel
                     and "sep" in mesh.axis_names and mesh.shape["sep"] > 1)
@@ -275,26 +296,35 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
         else:
             manual, x_spec, ex_specs = (), None, None
         x = pipe_lib.pipeline_apply(
-            lambda h, lp, cos, sin: blk(h, lp, cos, sin, None),
+            lambda h, lp, cos, sin: blk(h, lp, cos, sin, None)[0],
             params["blocks"], x, extras=(cos, sin), mesh=mesh,
             n_micro=c.pp_microbatches, remat=c.remat,
             manual_axes=manual, x_spec=x_spec, extras_specs=ex_specs)
+        aux_total = jnp.float32(0.0)
     else:
         if c.remat:
             blk = jax.checkpoint(blk, static_argnums=())
         if c.scan_layers:
             def body(carry, lp):
-                return blk(carry, lp, cos, sin, attn_mask), None
-            x, _ = jax.lax.scan(body, x, params["blocks"])
+                h, aux = carry
+                h, a = blk(h, lp, cos, sin, attn_mask)
+                return (h, aux + a), None
+            (x, aux_total), _ = jax.lax.scan(
+                body, (x, jnp.float32(0.0)), params["blocks"])
         else:
+            aux_total = jnp.float32(0.0)
             for i in range(c.num_hidden_layers):
                 lp = jax.tree.map(lambda a: a[i], params["blocks"])
-                x = blk(x, lp, cos, sin, attn_mask)
+                x, a = blk(x, lp, cos, sin, attn_mask)
+                aux_total = aux_total + a
 
     x = kernels.rms_norm(x, params["final_norm"].astype(jnp.float32), c.rms_norm_eps)
     head = (params["embed"]["weight"].T if c.tie_word_embeddings
             else params["lm_head"])
-    return (x @ head.astype(x.dtype)).astype(jnp.float32)
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    if return_aux_loss:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(params, batch, config: LlamaConfig):

@@ -1,0 +1,115 @@
+"""MoE Llama (Mixtral-style) — expert-parallel flagship variant.
+
+Reference parity: the reference trains MoE models through
+`incubate/distributed/models/moe/moe_layer.py` (all-to-all dispatch) stacked
+into its Llama/GPT trunks; gates under `moe/gate/`.  Here the dense SwiGLU FFN
+of each block is replaced by `distributed.moe.moe_ffn` — expert weights carry
+an ``expert`` logical axis so GSPMD lays them over the mesh's expert axis and
+inserts the token all-to-alls (SURVEY.md §7 step 5).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..distributed import moe as moe_lib
+from . import llama as llama_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class MoELlamaConfig(llama_lib.LlamaConfig):
+    num_experts: int = 8
+    moe_top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    router_z_loss_weight: float = 1e-3
+
+    @property
+    def moe(self) -> moe_lib.MoEConfig:
+        return moe_lib.MoEConfig(
+            num_experts=self.num_experts, top_k=self.moe_top_k,
+            capacity_factor=self.capacity_factor,
+            aux_loss_weight=self.aux_loss_weight,
+            z_loss_weight=self.router_z_loss_weight)
+
+    @staticmethod
+    def tiny(vocab_size: int = 256, num_experts: int = 4) -> "MoELlamaConfig":
+        return MoELlamaConfig(
+            vocab_size=vocab_size, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, dtype=jnp.float32, remat=False,
+            num_experts=num_experts, capacity_factor=2.0)
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoELlamaConfig":
+        return MoELlamaConfig(
+            vocab_size=32000, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            rope_theta=1e6, num_experts=8, moe_top_k=2)
+
+
+def init_params(config: MoELlamaConfig, key=None, seed: int = 0):
+    """Llama trunk params with per-layer MoE FFN (experts stacked on axis 1)."""
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    c = config
+    kd, km = jax.random.split(key)
+    params = llama_lib.init_params(c, kd, init_ffn=False)
+    blocks = params["blocks"]
+    L, E, F, X = c.num_hidden_layers, c.hidden_size, c.intermediate_size, c.num_experts
+    std = 0.02
+    ks = jax.random.split(km, 4)
+    n = lambda k, s: (std * jax.random.normal(k, s, jnp.float32)).astype(c.dtype)
+    blocks["router"] = std * jax.random.normal(ks[0], (L, E, X), jnp.float32)
+    blocks["w_gate"] = n(ks[1], (L, X, E, F))
+    blocks["w_up"] = n(ks[2], (L, X, E, F))
+    blocks["w_down"] = n(ks[3], (L, X, F, E))
+    return params
+
+
+def param_logical_axes(config: MoELlamaConfig):
+    axes = llama_lib.param_logical_axes(config)
+    axes["blocks"]["router"] = ("layer", None, None)
+    axes["blocks"]["w_gate"] = ("layer", "expert", "embed", "mlp")
+    axes["blocks"]["w_up"] = ("layer", "expert", "embed", "mlp")
+    axes["blocks"]["w_down"] = ("layer", "expert", "mlp", "embed")
+    return axes
+
+
+def forward(params, input_ids, config: MoELlamaConfig, positions=None,
+            attn_mask=None, return_aux_loss=False):
+    """input_ids (B, S) -> logits (B, S, V) fp32 [+ total router aux loss].
+
+    Reuses the llama trunk verbatim — only the per-block FFN is swapped for
+    the expert FFN via llama.forward's ffn_fn hook."""
+    moe_cfg = config.moe
+
+    def ffn(h, lp):
+        return moe_lib.moe_ffn(h, lp, moe_cfg)
+
+    return llama_lib.forward(
+        params, input_ids, config, positions=positions, attn_mask=attn_mask,
+        ffn_fn=ffn, return_aux_loss=return_aux_loss)
+
+
+def loss_fn(params, batch, config: MoELlamaConfig):
+    """Causal-LM loss + router aux losses (batch: input_ids/labels, -100=ignore)."""
+    logits, aux = forward(params, batch["input_ids"], config,
+                          return_aux_loss=True)
+    labels = batch["labels"]
+    valid = labels != -100
+    safe = jnp.where(valid, labels, 0)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, logz - ll, 0.0)
+    count = jnp.maximum(valid.sum(), 1)
+    return nll.sum() / count + aux
+
+
+def num_params(config: MoELlamaConfig) -> int:
+    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(
+        jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
