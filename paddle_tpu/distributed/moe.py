@@ -57,7 +57,10 @@ class MoEConfig:
 def compute_capacity(num_tokens: int, cfg: MoEConfig) -> int:
     if cfg.capacity_factor is None:
         # drop-free: a token occupies at most one slot per expert (top-k picks
-        # are distinct experts), so N slots per expert covers the worst case
+        # are distinct experts), so N slots per expert covers the worst case.
+        # NOTE: dispatch/combine are then (N, X, N) — O(N^2 X) memory, fine for
+        # the eval/debug use NaiveGate serves but not for training at scale;
+        # use a finite capacity_factor on the hot path.
         return num_tokens
     cap = int(np.ceil(cfg.top_k * num_tokens / cfg.num_experts
                       * cfg.capacity_factor))
@@ -183,6 +186,10 @@ def global_scatter(x, local_count=None, global_count=None, *, mesh: Mesh,
     """
     del local_count, global_count
     n = mesh.shape[axis]
+    if x.shape[0] != n or x.shape[1] % n:
+        raise ValueError(
+            f"global_scatter expects x.shape[0] == mesh['{axis}'] size ({n}) "
+            f"and experts dim divisible by it; got {x.shape}")
 
     def f(b):
         b = b[0]  # (X, C, ...)
@@ -251,8 +258,7 @@ class MoELayer(_Layer):
     add it to the training loss.
     """
 
-    def __init__(self, d_model, experts, gate=None, mesh: Optional[Mesh] = None,
-                 name=None):
+    def __init__(self, d_model, experts, gate=None, name=None):
         from ..nn.layer import LayerList
         from ..nn import initializer as I
 
@@ -263,7 +269,6 @@ class MoELayer(_Layer):
         self.cfg = cfg or MoEConfig(num_experts=len(self.experts))
         if self.cfg.num_experts != len(self.experts):
             raise ValueError("gate num_experts != len(experts)")
-        self.mesh = mesh
         self.router = self.create_parameter(
             [d_model, self.cfg.num_experts],
             default_initializer=I.Normal(std=0.02))

@@ -1,0 +1,34 @@
+"""paddle.incubate parity namespace.
+
+Reference: python/paddle/incubate/ — fused-op APIs (nn/functional/
+fused_transformer.py, fused_rotary_position_embedding.py, fused_rms_norm),
+functional autodiff (autograd/functional.py:22 vjp, :80 jvp), ASP 2:4 sparsity
+(asp/asp.py), MoE models (distributed/models/moe/moe_layer.py).
+
+On TPU the "fused" ops are Pallas kernels or XLA-fused jnp programs from
+paddle_tpu.kernels — same API, compiler-native fusion.
+"""
+
+from __future__ import annotations
+
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401
+from . import distributed  # noqa: F401
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal-masked softmax (incubate.softmax_mask_fuse_upper_triangle)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply_op
+    from ..nn.functional import _t
+
+    def f(v):
+        import jax
+
+        s = v.shape[-1]
+        m = jnp.tril(jnp.ones((s, s), bool))
+        return jax.nn.softmax(jnp.where(m, v, -jnp.inf), axis=-1)
+
+    return apply_op("softmax_mask_fuse_upper_triangle", f, _t(x))

@@ -1,0 +1,125 @@
+"""incubate.asp — 2:4 structured sparsity (reference: incubate/asp/asp.py,
+supported_layer_list.py, utils.py).
+
+The reference prunes weights to the NVIDIA 2:4 pattern for sparse tensor
+cores.  TPUs have no 2:4 hardware path, but the pruning/masking workflow is
+kept: masks are computed the same way (best 2-of-4 by magnitude) and applied
+as elementwise multiplies that XLA fuses into the consuming matmul — the
+workflow (prune -> finetune -> export) is portable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+_masks: Dict[int, object] = {}
+_excluded: Dict[int, set] = {}
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(getattr(x, "data", x))
+    return float((arr != 0).sum() / arr.size)
+
+
+def _mask_2to4_1d(v: np.ndarray) -> np.ndarray:
+    """Keep the 2 largest |v| of every 4 along the last axis."""
+    n = v.shape[-1]
+    pad = (-n) % 4
+    if pad:
+        v = np.concatenate([v, np.zeros(v.shape[:-1] + (pad,), v.dtype)], -1)
+    g = np.abs(v).reshape(v.shape[:-1] + (-1, 4))
+    order = np.argsort(g, axis=-1)
+    mask = np.ones_like(g, dtype=bool)
+    np.put_along_axis(mask, order[..., :2], False, axis=-1)
+    mask = mask.reshape(v.shape)
+    return mask[..., :n] if pad else mask
+
+
+def create_mask(tensor, func_name: str = "mask_1d", n: int = 2, m: int = 4):
+    """2:4 mask with the same (n, m) meaning as the reference's
+    CheckMethod/MaskAlgo (asp/utils.py): keep n of every m by magnitude."""
+    arr = np.asarray(getattr(tensor, "data", tensor))
+    if (n, m) != (2, 4):
+        k = m - n
+        pad = (-arr.shape[-1]) % m
+        v = np.concatenate([arr, np.zeros(arr.shape[:-1] + (pad,), arr.dtype)], -1) if pad else arr
+        g = np.abs(v).reshape(v.shape[:-1] + (-1, m))
+        order = np.argsort(g, axis=-1)
+        mask = np.ones_like(g, dtype=bool)
+        np.put_along_axis(mask, order[..., :k], False, axis=-1)
+        mask = mask.reshape(v.shape)
+        return mask[..., :arr.shape[-1]] if pad else mask
+    return _mask_2to4_1d(arr)
+
+
+def check_sparsity(tensor, n: int = 2, m: int = 4, func_name="check_1d") -> bool:
+    arr = np.asarray(getattr(tensor, "data", tensor))
+    pad = (-arr.shape[-1]) % m
+    if pad:
+        arr = np.concatenate([arr, np.zeros(arr.shape[:-1] + (pad,), arr.dtype)], -1)
+    g = (arr != 0).reshape(arr.shape[:-1] + (-1, m))
+    return bool((g.sum(-1) <= n).all())
+
+
+def prune_model(model, n: int = 2, m: int = 4, mask_algo: str = "mask_1d",
+                with_mask: bool = True):
+    """Prune every supported Linear weight in `model` to n:m sparsity and
+    register masks so optimizer steps can re-apply them (asp.py prune_model)."""
+    from ...nn.layer import Layer
+    from ...tensor import to_tensor
+
+    pruned = {}
+    pairs = []
+    for name, layer in _iter_layers(model):
+        if id(layer) in _excluded.get(id(model), set()):
+            continue
+        w = getattr(layer, "weight", None)
+        if w is None or getattr(w, "ndim", 0) != 2:
+            continue
+        mask = create_mask(w, func_name=mask_algo, n=n, m=m)
+        w.data = jnp.asarray(np.asarray(w.data) * mask)
+        pruned[f"{name}.weight"] = mask
+        pairs.append((w, jnp.asarray(mask, w.data.dtype)))
+    _masks[id(model)] = pairs
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply every registered ASP mask after the
+    update (asp.py decorate) — keeps pruned slots at zero through training."""
+    raw_step = optimizer.step
+
+    def step(*a, **kw):
+        out = raw_step(*a, **kw)
+        for pairs in _masks.values():
+            for w, mask in pairs:
+                w.data = w.data * mask
+        return out
+
+    optimizer.step = step
+    return optimizer
+
+
+def set_excluded_layers(model, layer_names):
+    ex = _excluded.setdefault(id(model), set())
+    lookup = dict(_iter_layers(model))
+    for n in layer_names:
+        if n in lookup:
+            ex.add(id(lookup[n]))
+
+
+def reset_excluded_layers(model=None):
+    if model is None:
+        _excluded.clear()
+    else:
+        _excluded.pop(id(model), None)
+
+
+def _iter_layers(model, prefix=""):
+    out = [(prefix or "model", model)]
+    for name, sub in getattr(model, "_sub_layers", {}).items():
+        out.extend(_iter_layers(sub, f"{prefix}.{name}" if prefix else name))
+    return out

@@ -1,0 +1,33 @@
+"""incubate.autograd — functional autodiff (reference:
+incubate/autograd/functional.py:22 vjp, :80 jvp; Jacobian/Hessian classes).
+
+TPU-native: thin re-exports of the jax.vjp/jvp/jacobian-backed implementations
+in paddle_tpu.autograd (C46)."""
+
+from ...autograd import (  # noqa: F401
+    vjp, jvp, jacobian, hessian, grad, no_grad,
+)
+
+# reference exposes class-style lazy Jacobian/Hessian too; the function forms
+# cover the API (autograd.py:450,544) — alias the names
+Jacobian = jacobian
+Hessian = hessian
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """incubate.autograd.forward_grad — JVP with default-ones tangents."""
+    return jvp(lambda *xs: outputs, inputs, grad_inputs)
+
+
+def enable_prim():
+    """Reference toggles primitive-op lowering for the static AD engine; the
+    TPU build always differentiates through jax primitives — no-op."""
+    return None
+
+
+def disable_prim():
+    return None
+
+
+def prim_enabled():
+    return True

@@ -1,0 +1,7 @@
+"""incubate.distributed.models.moe parity — re-exports the TPU-native MoE
+stack (distributed/moe.py).  Reference: moe_layer.py:263 MoELayer + gate/."""
+
+from .....distributed.moe import (  # noqa: F401
+    MoEConfig, MoELayer, NaiveGate, SwitchGate, GShardGate,
+    moe_ffn, top_k_gating, global_scatter, global_gather,
+)

@@ -1,0 +1,211 @@
+"""incubate.nn — fused transformer layers (reference:
+python/paddle/incubate/nn/layer/fused_transformer.py).
+
+Each layer is a thin tape-aware module over paddle_tpu.kernels; on TPU the
+compute lowers to Pallas flash-attention / fused-norm kernels, elsewhere to
+XLA-fused jnp.  "Fused" here means one traced subgraph per layer — XLA fuses
+the epilogues the reference hand-wrote as CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from ...tensor import apply_op
+from ... import kernels
+from . import functional  # noqa: F401
+
+
+class FusedLinear(Layer):
+    """incubate.nn.FusedLinear — linear whose bias/act epilogue fuses into the
+    matmul (on TPU: XLA does this natively; kept for API parity)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self.transpose_weight = transpose_weight
+        shape = ([out_features, in_features] if transpose_weight
+                 else [in_features, out_features])
+        self.weight = self.create_parameter(shape, attr=weight_attr,
+                                            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter([out_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from . import functional as F
+
+        return F.fused_linear(x, self.weight, self.bias,
+                              transpose_weight=self.transpose_weight)
+
+
+class FusedMultiHeadAttention(Layer):
+    """incubate.nn.FusedMultiHeadAttention (fused_transformer.py) — pre/post-LN
+    MHA block: LN -> qkv proj -> flash attention -> out proj -> residual."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.0,
+                 attn_dropout_rate=0.0, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim % num_heads:
+            raise ValueError("embed_dim must divide num_heads")
+        self.embed_dim, self.num_heads = embed_dim, num_heads
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.dropout_rate = dropout_rate
+        self.qkv_weight = self.create_parameter(
+            [embed_dim, 3 * embed_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.qkv_bias = self.create_parameter([3 * embed_dim], attr=bias_attr,
+                                              is_bias=True)
+        self.out_weight = self.create_parameter(
+            [embed_dim, embed_dim], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.out_bias = self.create_parameter([embed_dim], attr=bias_attr,
+                                              is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([embed_dim], is_bias=True)
+
+    def forward(self, x, attn_mask=None):
+        H = self.num_heads
+        eps = self.epsilon
+        pre = self.normalize_before
+
+        def f(xv, qkvw, qkvb, ow, ob, s, b, mask=None):
+            B, S, E = xv.shape
+            D = E // H
+            h = xv
+            if pre:
+                mu = h.mean(-1, keepdims=True)
+                var = ((h - mu) ** 2).mean(-1, keepdims=True)
+                h = (h - mu) * jax.lax.rsqrt(var + eps) * s + b
+            qkv = h @ qkvw + qkvb
+            q, k, v = jnp.split(qkv.reshape(B, S, 3 * H, D), 3, axis=2)
+            attn = kernels.attention(q, k, v, mask=mask)
+            out = attn.reshape(B, S, E) @ ow + ob
+            out = xv + out
+            if not pre:
+                mu = out.mean(-1, keepdims=True)
+                var = ((out - mu) ** 2).mean(-1, keepdims=True)
+                out = (out - mu) * jax.lax.rsqrt(var + eps) * s + b
+            return out
+
+        args = [x, self.qkv_weight, self.qkv_bias, self.out_weight,
+                self.out_bias, self.ln_scale, self.ln_bias]
+        if attn_mask is not None:
+            args.append(attn_mask)
+        return apply_op("fused_multi_head_attention", f, *args)
+
+
+class FusedFeedForward(Layer):
+    """incubate.nn.FusedFeedForward — LN -> linear -> act -> linear -> residual."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.epsilon = epsilon
+        self.activation = activation
+        self.w1 = self.create_parameter([d_model, dim_feedforward],
+                                        attr=weight_attr,
+                                        default_initializer=I.XavierNormal())
+        self.b1 = self.create_parameter([dim_feedforward], is_bias=True)
+        self.w2 = self.create_parameter([dim_feedforward, d_model],
+                                        attr=weight_attr,
+                                        default_initializer=I.XavierNormal())
+        self.b2 = self.create_parameter([d_model], is_bias=True)
+        self.ln_scale = self.create_parameter(
+            [d_model], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter([d_model], is_bias=True)
+
+    def forward(self, x):
+        eps, pre, act = self.epsilon, self.normalize_before, self.activation
+
+        def f(xv, w1, b1, w2, b2, s, b):
+            h = xv
+            if pre:
+                mu = h.mean(-1, keepdims=True)
+                var = ((h - mu) ** 2).mean(-1, keepdims=True)
+                h = (h - mu) * jax.lax.rsqrt(var + eps) * s + b
+            h = kernels.fused_bias_act(h @ w1, b1, act=act)
+            out = xv + (h @ w2 + b2)
+            if not pre:
+                mu = out.mean(-1, keepdims=True)
+                var = ((out - mu) ** 2).mean(-1, keepdims=True)
+                out = (out - mu) * jax.lax.rsqrt(var + eps) * s + b
+            return out
+
+        return apply_op("fused_feedforward", f, x, self.w1, self.b1, self.w2,
+                        self.b2, self.ln_scale, self.ln_bias)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """incubate.nn.FusedTransformerEncoderLayer = fused MHA + fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.self_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None):
+        return self.ffn(self.self_attn(src, attn_mask=src_mask))
+
+
+class FusedEcMoe(Layer):
+    """incubate.nn.FusedEcMoe (fused_ec_moe.py) — expert-choice MoE FFN:
+    experts pick their top-C tokens (capacity-perfect, drop by construction)."""
+
+    def __init__(self, hidden_size, inter_size, num_experts, act_type="gelu",
+                 capacity_per_expert=None, weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.num_experts = num_experts
+        self.act_type = act_type
+        self.capacity = capacity_per_expert
+        self.gate = self.create_parameter(
+            [hidden_size, num_experts], default_initializer=I.Normal(std=0.02))
+        self.w1 = self.create_parameter(
+            [num_experts, hidden_size, inter_size],
+            default_initializer=I.Normal(std=0.02))
+        self.b1 = self.create_parameter([num_experts, 1, inter_size], is_bias=True)
+        self.w2 = self.create_parameter(
+            [num_experts, inter_size, hidden_size],
+            default_initializer=I.Normal(std=0.02))
+        self.b2 = self.create_parameter([num_experts, 1, hidden_size], is_bias=True)
+
+    def forward(self, x, gate_logits=None):
+        act = self.act_type
+        cap = self.capacity
+
+        def f(xv, gw, w1, b1, w2, b2):
+            B, S, E = xv.shape
+            N = B * S
+            X = w1.shape[0]
+            C = cap or max(1, (2 * N) // X)
+            tok = xv.reshape(N, E)
+            scores = jax.nn.softmax(tok.astype(jnp.float32) @ gw, axis=-1)  # (N, X)
+            # expert choice: each expert takes its top-C tokens
+            g, idx = jax.lax.top_k(scores.T, C)                  # (X, C)
+            xp = jnp.take(tok, idx.reshape(-1), axis=0).reshape(X, C, E)
+            h = kernels.fused_bias_act(
+                jnp.einsum("xce,xef->xcf", xp, w1) + b1, act=act)
+            eo = jnp.einsum("xcf,xfe->xce", h, w2) + b2
+            weighted = eo * g[..., None].astype(eo.dtype)
+            out = jnp.zeros((N, E), eo.dtype).at[idx.reshape(-1)].add(
+                weighted.reshape(X * C, E))
+            return out.reshape(B, S, E)
+
+        return apply_op("fused_ec_moe", f, x, self.gate, self.w1, self.b1,
+                        self.w2, self.b2)

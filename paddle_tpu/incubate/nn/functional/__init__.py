@@ -1,0 +1,244 @@
+"""incubate.nn.functional — fused-op functional API.
+
+Reference: python/paddle/incubate/nn/functional/ (fused_rotary_position_
+embedding.py, fused_rms_norm.py, fused_layer_norm.py, fused_transformer.py,
+swiglu, fused_linear, fused_bias_act).  Backed by paddle_tpu.kernels (Pallas
+on TPU, XLA-fused jnp elsewhere); tape-aware via tensor.apply_op.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ....tensor import Tensor, apply_op, to_tensor
+from .... import kernels
+
+__all__ = [
+    "fused_rotary_position_embedding", "fused_rms_norm", "fused_layer_norm",
+    "fused_bias_act", "fused_linear", "fused_linear_activation", "swiglu",
+    "fused_dropout_add", "fused_multi_head_attention", "fused_feedforward",
+    "variable_length_memory_efficient_attention", "masked_multihead_attention",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None,
+                                    use_neox_rotary_style=True):
+    """Reference: incubate/nn/functional/fused_rotary_position_embedding.py
+    (CUDA fused_rope_kernel.cu).  (B, S, H, D) layout."""
+    args = [a for a in (q, k, v) if a is not None]
+    n = len(args)
+    ts = [_t(a) for a in args]
+    sin_t, cos_t = _t(sin), _t(cos)
+    pos = position_ids if position_ids is None else _t(position_ids)
+
+    def f(*raw):
+        qkv = raw[:n]
+        s, c = raw[n], raw[n + 1]
+        p = raw[n + 2] if pos is not None else None
+        return kernels.fused_rotary_position_embedding(
+            qkv[0], qkv[1] if n > 1 else None, qkv[2] if n > 2 else None,
+            sin=s, cos=c, position_ids=p,
+            use_neox_rotary_style=use_neox_rotary_style)
+
+    extra = [sin_t, cos_t] + ([pos] if pos is not None else [])
+    return apply_op("fused_rope", f, *ts, *extra,
+                    nondiff=(len(ts) + 2,) if pos is not None else ())
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, bias=None, residual=None,
+                   quant_scale=-1, **_ignored):
+    """Reference: fused_rms_norm (phi fusion kernel).  Optional residual+bias
+    pre-add, then RMSNorm — returns (out, residual_out) when residual given."""
+    xs = [_t(x), _t(norm_weight)]
+    has_b = norm_bias is not None
+    has_res = residual is not None
+    has_bias = bias is not None
+    if has_b:
+        xs.append(_t(norm_bias))
+    if has_bias:
+        xs.append(_t(bias))
+    if has_res:
+        xs.append(_t(residual))
+
+    def f(*raw):
+        i = 2
+        nb = raw[i] if has_b else None
+        i += has_b
+        bb = raw[i] if has_bias else None
+        i += has_bias
+        res = raw[i] if has_res else None
+        h = raw[0]
+        if bb is not None:
+            h = h + bb
+        if res is not None:
+            h = h + res
+        out = kernels.rms_norm(h, raw[1], epsilon)
+        if nb is not None:
+            out = out + nb
+        if has_res:
+            return out, h
+        return out
+
+    return apply_op("fused_rms_norm", f, *xs)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=-1, bias=None, residual=None, **_ignored):
+    """Reference: fused_layernorm_kernel.cu — residual+bias add + LayerNorm."""
+    xs = [_t(x), _t(norm_weight), _t(norm_bias)]
+    has_res = residual is not None
+    has_bias = bias is not None
+    if has_bias:
+        xs.append(_t(bias))
+    if has_res:
+        xs.append(_t(residual))
+
+    def f(*raw):
+        i = 3
+        bb = raw[i] if has_bias else None
+        i += has_bias
+        res = raw[i] if has_res else None
+        h = raw[0]
+        if bb is not None:
+            h = h + bb
+        if res is not None:
+            h = h + res
+        hf = h.astype(jnp.float32)
+        mu = hf.mean(-1, keepdims=True)
+        var = ((hf - mu) ** 2).mean(-1, keepdims=True)
+        out = ((hf - mu) * jax.lax.rsqrt(var + epsilon)).astype(h.dtype)
+        out = out * raw[1] + raw[2]
+        if has_res:
+            return out, h
+        return out
+
+    return apply_op("fused_layer_norm", f, *xs)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **_ignored):
+    xs = [_t(x)]
+    if bias is not None:
+        xs.append(_t(bias))
+
+    def f(*raw):
+        return kernels.fused_bias_act(raw[0], raw[1] if bias is not None else None,
+                                      act=act_method)
+
+    return apply_op("fused_bias_act", f, *xs)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    xs = [_t(x), _t(weight)]
+    if bias is not None:
+        xs.append(_t(bias))
+
+    def f(*raw):
+        w = raw[1].T if transpose_weight else raw[1]
+        y = raw[0] @ w
+        if bias is not None:
+            y = y + raw[2]
+        return y
+
+    return apply_op("fused_linear", f, *xs)
+
+
+def fused_linear_activation(x, y, bias, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    xs = [_t(x), _t(y), _t(bias)]
+
+    def f(a, w, b):
+        a = a.T if trans_x else a
+        w = w.T if trans_y else w
+        return kernels.fused_bias_act(a @ w, b, act=activation)
+
+    return apply_op("fused_linear_activation", f, *xs)
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        return apply_op("swiglu", lambda a: kernels.swiglu(a), _t(x))
+    return apply_op("swiglu", lambda a, b: kernels.swiglu(a, b), _t(x), _t(y))
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    """Reference: fused_dropout_add op — dropout(x) + y in one kernel."""
+    from ....nn import functional as NF
+
+    return NF.dropout(_t(x), p=p, training=training, mode=mode) + _t(y)
+
+
+def fused_multi_head_attention(x, qkv_weight, qkv_bias, linear_weight,
+                               linear_bias, num_heads=None, attn_mask=None,
+                               **kwargs):
+    """Functional form (fused_transformer.py fused_multi_head_attention) —
+    qkv proj -> flash attention -> out proj.  qkv_weight is either the
+    reference (3, H, D, E) layout (num_heads inferred) or (E, 3E) with
+    `num_heads` passed explicitly."""
+    if hasattr(qkv_weight, "ndim") and qkv_weight.ndim == 4:
+        num_heads = qkv_weight.shape[1]
+    if num_heads is None:
+        raise ValueError("num_heads required for 2-D qkv_weight")
+    H = num_heads
+    xs = [_t(x), _t(qkv_weight), _t(qkv_bias), _t(linear_weight), _t(linear_bias)]
+    if attn_mask is not None:
+        xs.append(_t(attn_mask))
+
+    def f(xv, qkvw, qkvb, ow, ob, mask=None):
+        B, S, E = xv.shape
+        D = E // H
+        if qkvw.ndim == 4:  # reference layout (3, H, D, E)
+            qkv = jnp.einsum("bse,thde->bsthd", xv, qkvw).reshape(B, S, 3 * E)
+        else:               # (E, 3E), columns [q|k|v]
+            qkv = xv @ qkvw
+        qkv = qkv + qkvb.reshape(-1)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, D)
+        k = k.reshape(B, S, H, D)
+        v = v.reshape(B, S, H, D)
+        attn = kernels.attention(q, k, v, mask=mask)
+        return attn.reshape(B, S, E) @ ow.reshape(E, E) + ob
+
+    return apply_op("fused_multi_head_attention_fn", f, *xs)
+
+
+def fused_feedforward(x, linear1_weight, linear1_bias, linear2_weight,
+                      linear2_bias, *args, activation="relu", **kwargs):
+    xs = [_t(x), _t(linear1_weight), _t(linear1_bias), _t(linear2_weight),
+          _t(linear2_bias)]
+
+    def f(xv, w1, b1, w2, b2):
+        h = kernels.fused_bias_act(xv @ w1, b1, act=activation)
+        return xv + h @ w2 + b2
+
+    return apply_op("fused_feedforward_fn", f, *xs)
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False):
+    """Reference: incubate memory_efficient_attention — maps to the same
+    flash-attention kernel (padding masks express variable length)."""
+    q, k, v = _t(query), _t(key), _t(value)
+    xs = [q, k, v] + ([_t(mask)] if mask is not None else [])
+
+    def f(q, k, v, m=None):
+        # (B, H, S, D) reference layout -> kernels layout (B, S, H, D)
+        qt, kt, vt = (jnp.swapaxes(a, 1, 2) for a in (q, k, v))
+        out = kernels.attention(qt, kt, vt, mask=m, causal=causal, scale=scale)
+        return jnp.swapaxes(out, 1, 2)
+
+    return apply_op("var_len_mem_eff_attention", f, *xs)
+
+
+def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
+    raise NotImplementedError(
+        "masked_multihead_attention (decode-phase CUDA kernel) — use "
+        "models.llama generation path; planned for the serving runtime")

@@ -327,11 +327,9 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
     return logits
 
 
-def loss_fn(params, batch, config: LlamaConfig):
-    """Causal-LM loss.  batch: {"input_ids": (B,S), "labels": (B,S)} with -100 = ignore."""
-    logits = forward(params, batch["input_ids"], config)
-    labels = batch["labels"]
-    valid = labels != -100
+def masked_ce_loss(logits, labels, ignore_index: int = -100):
+    """Token-masked cross entropy shared by all LM variants."""
+    valid = labels != ignore_index
     safe = jnp.where(valid, labels, 0)
     logz = jax.nn.logsumexp(logits, axis=-1)
     ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
@@ -340,14 +338,21 @@ def loss_fn(params, batch, config: LlamaConfig):
     return nll.sum() / count
 
 
+def loss_fn(params, batch, config: LlamaConfig):
+    """Causal-LM loss.  batch: {"input_ids": (B,S), "labels": (B,S)} with -100 = ignore."""
+    logits = forward(params, batch["input_ids"], config)
+    return masked_ce_loss(logits, batch["labels"])
+
+
 def lm_batch_from_tokens(tokens):
     """Next-token-prediction batch from a (B, S+1) token block."""
     return {"input_ids": tokens[:, :-1], "labels": tokens[:, 1:]}
 
 
-def num_params(config: LlamaConfig) -> int:
+def num_params(config: LlamaConfig, init_fn=None) -> int:
+    init = init_fn or init_params
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(
-        jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+        jax.eval_shape(lambda: init(config, jax.random.PRNGKey(0)))))
 
 
 def flops_per_token(config: LlamaConfig, seq_len: int) -> float:

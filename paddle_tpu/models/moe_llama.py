@@ -100,16 +100,8 @@ def loss_fn(params, batch, config: MoELlamaConfig):
     """Causal-LM loss + router aux losses (batch: input_ids/labels, -100=ignore)."""
     logits, aux = forward(params, batch["input_ids"], config,
                           return_aux_loss=True)
-    labels = batch["labels"]
-    valid = labels != -100
-    safe = jnp.where(valid, labels, 0)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, logz - ll, 0.0)
-    count = jnp.maximum(valid.sum(), 1)
-    return nll.sum() / count + aux
+    return llama_lib.masked_ce_loss(logits, batch["labels"]) + aux
 
 
 def num_params(config: MoELlamaConfig) -> int:
-    return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(
-        jax.eval_shape(lambda: init_params(config, jax.random.PRNGKey(0)))))
+    return llama_lib.num_params(config, init_fn=init_params)

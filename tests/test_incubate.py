@@ -1,0 +1,160 @@
+"""incubate namespace: fused ops, fused layers, ASP 2:4 sparsity, autodiff."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate as incubate
+import paddle_tpu.incubate.nn.functional as IF
+
+
+class TestFusedFunctional:
+    def test_fused_rms_norm_matches_manual(self):
+        x = np.random.randn(2, 8, 16).astype(np.float32)
+        w = np.random.randn(16).astype(np.float32)
+        out = IF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w))
+        ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w
+        np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-5)
+
+    def test_fused_rms_norm_residual_returns_pair(self):
+        x = np.random.randn(2, 4, 8).astype(np.float32)
+        r = np.random.randn(2, 4, 8).astype(np.float32)
+        w = np.ones(8, np.float32)
+        out, res = IF.fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                                     residual=paddle.to_tensor(r))
+        np.testing.assert_allclose(np.asarray(res.data), x + r, rtol=1e-6)
+
+    def test_fused_layer_norm(self):
+        x = np.random.randn(3, 10).astype(np.float32)
+        s = np.random.rand(10).astype(np.float32)
+        b = np.random.randn(10).astype(np.float32)
+        out = IF.fused_layer_norm(paddle.to_tensor(x), paddle.to_tensor(s),
+                                  paddle.to_tensor(b))
+        mu, var = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+        ref = (x - mu) / np.sqrt(var + 1e-5) * s + b
+        np.testing.assert_allclose(np.asarray(out.data), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_swiglu(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        y = np.random.randn(4, 8).astype(np.float32)
+        out = IF.swiglu(paddle.to_tensor(x), paddle.to_tensor(y))
+        sig = 1 / (1 + np.exp(-x))
+        np.testing.assert_allclose(np.asarray(out.data), x * sig * y, rtol=1e-5)
+
+    def test_fused_rope_grad_flows(self):
+        q = paddle.to_tensor(np.random.randn(1, 4, 2, 8).astype(np.float32),
+                             stop_gradient=False)
+        t = np.arange(4)[:, None] / 10 ** (np.arange(4)[None, :] / 4)
+        cos = paddle.to_tensor(np.cos(np.concatenate([t, t], -1))[None, :, None, :].astype(np.float32))
+        sin = paddle.to_tensor(np.sin(np.concatenate([t, t], -1))[None, :, None, :].astype(np.float32))
+        out = IF.fused_rotary_position_embedding(q, sin=sin, cos=cos)
+        out.sum().backward()
+        assert q.grad is not None
+        # rotation preserves norm per (pos, head) pair
+        np.testing.assert_allclose(
+            np.asarray((out * out).sum().data),
+            np.asarray((q.detach() * q.detach()).sum().data), rtol=1e-5)
+
+    def test_fused_linear_activation(self):
+        x = np.random.randn(4, 8).astype(np.float32)
+        w = np.random.randn(8, 6).astype(np.float32)
+        b = np.random.randn(6).astype(np.float32)
+        out = IF.fused_linear_activation(paddle.to_tensor(x), paddle.to_tensor(w),
+                                         paddle.to_tensor(b), activation="relu")
+        np.testing.assert_allclose(np.asarray(out.data),
+                                   np.maximum(x @ w + b, 0), rtol=1e-5)
+
+
+class TestFusedLayers:
+    def test_fused_mha_trains(self):
+        import paddle_tpu.incubate.nn as inn
+
+        layer = inn.FusedMultiHeadAttention(16, 4)
+        x = paddle.to_tensor(np.random.randn(2, 6, 16).astype(np.float32),
+                             stop_gradient=False)
+        y = layer(x)
+        assert tuple(y.shape) == (2, 6, 16)
+        y.mean().backward()
+        assert layer.qkv_weight.grad is not None
+
+    def test_fused_encoder_layer(self):
+        import paddle_tpu.incubate.nn as inn
+
+        layer = inn.FusedTransformerEncoderLayer(16, 4, 32)
+        x = paddle.to_tensor(np.random.randn(2, 5, 16).astype(np.float32))
+        y = layer(x)
+        assert tuple(y.shape) == (2, 5, 16)
+
+    def test_fused_ec_moe(self):
+        import paddle_tpu.incubate.nn as inn
+
+        layer = inn.FusedEcMoe(16, 32, num_experts=4, act_type="gelu")
+        x = paddle.to_tensor(np.random.randn(2, 8, 16).astype(np.float32),
+                             stop_gradient=False)
+        y = layer(x)
+        assert tuple(y.shape) == (2, 8, 16)
+        y.mean().backward()
+        assert layer.gate.grad is not None
+        assert layer.w1.grad is not None
+
+
+class TestASP:
+    def test_create_mask_2_4(self):
+        w = np.random.randn(8, 16).astype(np.float32)
+        mask = incubate.asp.create_mask(w)
+        assert mask.shape == w.shape
+        assert incubate.asp.check_sparsity(w * mask)
+        # exactly half survive
+        assert mask.sum() == w.size // 2
+        # kept entries are the 2 largest |.| of each group of 4
+        g = np.abs(w).reshape(8, 4, 4)
+        kept = np.abs(w * mask).reshape(8, 4, 4)
+        np.testing.assert_allclose(kept.sum(-1),
+                                   np.sort(g, -1)[..., 2:].sum(-1), rtol=1e-6)
+
+    def test_prune_model_and_decorate(self):
+        import paddle_tpu.nn as nn
+        import paddle_tpu.optimizer as opt
+
+        model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 8))
+        masks = incubate.asp.prune_model(model)
+        assert len(masks) == 2
+        for l in (model[0], model[2]):
+            assert incubate.asp.check_sparsity(np.asarray(l.weight.data))
+        optimizer = opt.SGD(learning_rate=0.1, parameters=model.parameters())
+        optimizer = incubate.asp.decorate(optimizer)
+        x = paddle.to_tensor(np.random.randn(4, 16).astype(np.float32))
+        loss = model(x).mean()
+        loss.backward()
+        optimizer.step()
+        # sparsity survives the update
+        for l in (model[0], model[2]):
+            assert incubate.asp.check_sparsity(np.asarray(l.weight.data))
+
+    def test_density(self):
+        assert incubate.asp.calculate_density(np.ones((4, 4))) == 1.0
+
+
+class TestIncubateMisc:
+    def test_softmax_mask_fuse_upper_triangle(self):
+        x = np.random.randn(2, 2, 4, 4).astype(np.float32)
+        out = incubate.softmax_mask_fuse_upper_triangle(paddle.to_tensor(x))
+        o = np.asarray(out.data)
+        # upper triangle masked -> rows sum to 1 over allowed cols
+        np.testing.assert_allclose(o.sum(-1), np.ones_like(o.sum(-1)), rtol=1e-5)
+        assert (o[..., 0, 1:] == 0).all()
+
+    def test_moe_namespace_alias(self):
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        from paddle_tpu.distributed import MoELayer as M2
+
+        assert MoELayer is M2
+
+    def test_incubate_autograd(self):
+        out, g = incubate.autograd.vjp(
+            lambda x: x * x, paddle.to_tensor(np.array([2.0], np.float32)))
+        np.testing.assert_allclose(np.asarray(g.data), [4.0])
+        np.testing.assert_allclose(np.asarray(out.data), [4.0])
