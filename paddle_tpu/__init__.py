@@ -41,6 +41,10 @@ from . import linalg  # noqa: E402
 from .serialization import save, load  # noqa: E402
 from . import metric  # noqa: E402
 from . import incubate  # noqa: E402
+from . import vision  # noqa: E402
+from . import hapi  # noqa: E402
+from .hapi import Model  # noqa: E402
+from .hapi.summary import summary  # noqa: E402
 
 CPUPlace = lambda: "cpu"  # noqa: E731 — place objects are strings on TPU build
 TPUPlace = lambda idx=0: f"tpu:{idx}"  # noqa: E731

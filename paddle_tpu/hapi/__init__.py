@@ -1,0 +1,6 @@
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler,
+)
+from . import summary as _summary_mod  # noqa: F401
+from .summary import summary  # noqa: F401

@@ -1,0 +1,262 @@
+"""High-level paddle.Model (reference: python/paddle/hapi/model.py:1048 Model,
+fit at :1750) — prepare/fit/evaluate/predict/save/load over an nn.Layer.
+
+TPU-native: train/eval steps are plain eager tape steps (each op jit-cached);
+inputs batch through paddle_tpu.io.DataLoader; device transfer is implicit in
+jnp (device_put on first op).  The dygraph/static dual engine of the reference
+collapses — XLA is always the executor.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .. import serialization
+from ..metric import Metric
+from ..tensor import Tensor, to_tensor
+from . import callbacks as cbs
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        ms = _to_list(metrics)
+        for m in ms:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} must be a paddle_tpu.metric.Metric")
+        self._metrics = ms
+        return self
+
+    # -- single-batch ops (train_batch hapi parity) ------------------------
+    def train_batch(self, inputs, labels=None, update=True, loss_scale=1.0):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*[_as_tensor(x) for x in inputs])
+        losses = self._compute_loss(outputs, labels)
+        total = losses[0]
+        for l in losses[1:]:
+            total = total + l
+        if loss_scale != 1.0:
+            total = total * loss_scale  # grad accumulation: mean over micro-batches
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = [float(np.asarray(l.data)) for l in losses]
+        m_res = self._update_metrics(outputs, labels)
+        return (metrics, m_res) if m_res else metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        with no_grad():
+            inputs = _to_list(inputs)
+            labels = _to_list(labels)
+            outputs = self.network(*[_as_tensor(x) for x in inputs])
+            losses = self._compute_loss(outputs, labels) if self._loss else []
+            metrics = [float(np.asarray(l.data)) for l in losses]
+            m_res = self._update_metrics(outputs, labels)
+        return (metrics, m_res) if m_res else metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..autograd import no_grad
+
+        with no_grad():
+            inputs = _to_list(inputs)
+            outputs = self.network(*[_as_tensor(x) for x in inputs])
+        return [np.asarray(o.data) if isinstance(o, Tensor) else np.asarray(o)
+                for o in _to_list(outputs)]
+
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        res = self._loss(*(outs + [_as_tensor(l) for l in labels]))
+        return _to_list(res)
+
+    def _update_metrics(self, outputs, labels):
+        res = {}
+        outs = _to_list(outputs)
+        for m in self._metrics:
+            args = m.compute(*(outs + [_as_tensor(l) for l in labels])) \
+                if hasattr(m, "compute") and m.compute is not None else outs
+            m.update(*[np.asarray(getattr(a, "data", a)) for a in _to_list(args)])
+            res[m.name() if callable(getattr(m, "name", None)) else str(m)] = \
+                m.accumulate()
+        return res
+
+    # -- loops -------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle, drop_last,
+                                  num_workers)
+        eval_loader = (_as_loader(eval_data, batch_size, False, False,
+                                  num_workers) if eval_data is not None else None)
+        cblist = cbs.CallbackList(_to_list(callbacks) or
+                                  ([cbs.ProgBarLogger(log_freq, verbose)]))
+        cblist.set_model(self)
+        cblist.on_train_begin()
+        history = {"loss": []}
+        step_count = 0
+        for epoch in range(epochs):
+            cblist.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                xs, ys = _split_batch(batch)
+                logs = {"step": step}
+                cblist.on_train_batch_begin(step, logs)
+                # gradient accumulation: step the optimizer every N batches
+                update = (step + 1) % accumulate_grad_batches == 0
+                out = self.train_batch(xs, ys, update=update,
+                                       loss_scale=1.0 / accumulate_grad_batches)
+                loss_vals = out[0] if isinstance(out, tuple) else out
+                logs["loss"] = loss_vals
+                if isinstance(out, tuple):
+                    logs.update(out[1])
+                cblist.on_train_batch_end(step, logs)
+                history["loss"].append(loss_vals[0])
+                step_count += 1
+                if num_iters is not None and step_count >= num_iters:
+                    break
+            epoch_logs = dict(logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_res = self.evaluate(eval_loader, verbose=0)
+                epoch_logs.update({f"eval_{k}": v for k, v in eval_res.items()})
+            cblist.on_epoch_end(epoch, epoch_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(os.path.join(save_dir, str(epoch)))
+            if self.stop_training or (num_iters is not None
+                                      and step_count >= num_iters):
+                break
+        cblist.on_train_end()
+        if save_dir:
+            self.save(os.path.join(save_dir, "final"))
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        cblist = cbs.CallbackList(_to_list(callbacks))
+        cblist.set_model(self)
+        cblist.on_eval_begin()
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        seen = 0
+        for batch in loader:
+            xs, ys = _split_batch(batch)
+            out = self.eval_batch(xs, ys)
+            loss_vals = out[0] if isinstance(out, tuple) else out
+            if loss_vals:
+                losses.append(loss_vals[0])
+            seen += batch_size
+            if num_samples is not None and seen >= num_samples:
+                break
+        res = {}
+        if losses:
+            res["loss"] = [float(np.mean(losses))]
+        for m in self._metrics:
+            res[m.name() if callable(getattr(m, "name", None)) else str(m)] = \
+                m.accumulate()
+        cblist.on_eval_end(res)
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        # with declared input specs, only that many leading elements are fed
+        # (reference hapi uses self._inputs the same way); otherwise the whole
+        # batch tuple is treated as inputs
+        n_in = len(_to_list(self._inputs)) if self._inputs is not None else None
+        outs = []
+        for batch in loader:
+            xs, _ = _split_batch(batch, labeled=False)
+            if n_in is not None:
+                xs = xs[:n_in]
+            outs.append(self.predict_batch(xs))
+        n_out = len(outs[0]) if outs else 0
+        grouped = [[o[i] for o in outs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g) for g in grouped]
+        return grouped
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        serialization.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None and \
+                hasattr(self._optimizer, "state_dict"):
+            serialization.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        state = serialization.load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if (not reset_optimizer and self._optimizer is not None
+                and os.path.exists(opt_path)
+                and hasattr(self._optimizer, "set_state_dict")):
+            self._optimizer.set_state_dict(serialization.load(opt_path))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size, dtypes=dtype)
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else to_tensor(np.asarray(x))
+
+
+def _split_batch(batch, labeled=True):
+    """(x, y) | [x, y] | x -> (inputs list, labels list)."""
+    if isinstance(batch, (list, tuple)):
+        if not labeled or len(batch) == 1:
+            return _to_list(batch if len(batch) > 1 else batch[0]), []
+        return _to_list(batch[0]), _to_list(batch[1])
+    return [batch], []
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    from ..io import DataLoader, Dataset
+
+    if data is None:
+        raise ValueError("data is required")
+    if isinstance(data, DataLoader):
+        return data
+    if hasattr(data, "__getitem__") and hasattr(data, "__len__"):
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          drop_last=drop_last, num_workers=num_workers)
+    return data  # assume iterable of batches

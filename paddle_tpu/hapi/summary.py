@@ -1,0 +1,38 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Parameter-count summary.  Returns {'total_params': N, 'trainable_params': N}
+    and prints a per-layer table like the reference."""
+    rows = []
+
+    def walk(layer, prefix):
+        own = 0
+        for name, p in layer._parameters.items():
+            if p is None:
+                continue
+            own += int(np.prod(p.shape))
+        if own:
+            rows.append((prefix or type(layer).__name__,
+                         type(layer).__name__, own))
+        for name, sub in layer._sub_layers.items():
+            walk(sub, f"{prefix}.{name}" if prefix else name)
+
+    walk(net, "")
+    total = sum(r[2] for r in rows)
+    trainable = 0
+    for p in net.parameters():
+        if getattr(p, "trainable", True):
+            trainable += int(np.prod(p.shape))
+    w = max([len(r[0]) for r in rows] + [10])
+    print(f"{'Layer':{w}}  {'Type':18}  Params")
+    print("-" * (w + 30))
+    for name, t, n in rows:
+        print(f"{name:{w}}  {t:18}  {n:,}")
+    print("-" * (w + 30))
+    print(f"Total params: {total:,}")
+    return {"total_params": total, "trainable_params": trainable}

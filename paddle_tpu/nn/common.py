@@ -168,8 +168,10 @@ class _BatchNormBase(Layer):
         self.weight = self.create_parameter([num_features], attr=weight_attr,
                                             default_initializer=I.Constant(1.0)) if weight_attr is not False else None
         self.bias = self.create_parameter([num_features], attr=bias_attr, is_bias=True) if bias_attr is not False else None
-        self.register_buffer("_mean", to_tensor(jnp.zeros(num_features)))
-        self.register_buffer("_variance", to_tensor(jnp.ones(num_features)))
+        # explicit f32: under jax_enable_x64 bare zeros() would be f64 and
+        # poison activation dtypes through the eval path
+        self.register_buffer("_mean", to_tensor(jnp.zeros(num_features, jnp.float32)))
+        self.register_buffer("_variance", to_tensor(jnp.ones(num_features, jnp.float32)))
 
     def forward(self, x):
         return F.batch_norm(

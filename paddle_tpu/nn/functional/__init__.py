@@ -325,7 +325,9 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
             mean_use = jnp.mean(a, axis=reduce_axes)
             var_use = jnp.var(a, axis=reduce_axes)
         else:
-            mean_use, var_use = rm._data, rv._data
+            stat_t = a.dtype if jnp.issubdtype(a.dtype, jnp.floating) else jnp.float32
+            mean_use = rm._data.astype(stat_t)
+            var_use = rv._data.astype(stat_t)
         out = (a - mean_use.reshape(bshape)) * jax.lax.rsqrt(var_use.reshape(bshape) + epsilon)
         i = 0
         if weight is not None:

@@ -1,0 +1,43 @@
+"""paddle.vision parity (reference: python/paddle/vision/, ~14.6k LoC —
+datasets, transforms, models, ops).  SURVEY.md C48.
+
+TPU notes: transforms produce contiguous float32/uint8 numpy (host-side, feed
+into jax.device_put batches); models are eager nn.Layers whose convs lower to
+XLA convolutions on the MXU (NCHW layout like the reference API; XLA picks the
+TPU-native layout internally)."""
+
+from __future__ import annotations
+
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import ops  # noqa: F401
+
+from .models import (  # noqa: F401
+    LeNet, AlexNet, VGG, vgg11, vgg13, vgg16, vgg19,
+    ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2,
+    MobileNetV1, mobilenet_v1, MobileNetV2, mobilenet_v2,
+    SqueezeNet, squeezenet1_0, squeezenet1_1,
+    DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x1_0, shufflenet_v2_swish,
+)
+
+_image_backend = "pil"
+
+
+def set_image_backend(backend: str) -> None:
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor"):
+        raise ValueError(f"unsupported image backend {backend}")
+    _image_backend = backend
+
+
+def get_image_backend() -> str:
+    return _image_backend
+
+
+def image_load(path, backend=None):
+    from PIL import Image
+
+    return Image.open(path)

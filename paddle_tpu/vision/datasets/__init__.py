@@ -1,0 +1,227 @@
+"""vision.datasets parity (reference: python/paddle/vision/datasets/ — MNIST,
+FashionMNIST, Cifar10/100, Flowers, VOC2012, DatasetFolder/ImageFolder).
+
+Zero-egress environment: `download=True` raises with instructions; datasets
+parse the standard on-disk formats (IDX for MNIST, pickled batches for CIFAR,
+image directory trees for ImageFolder).  FakeData provides a synthetic
+drop-in for tests/benchmarks."""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "FakeData"]
+
+
+def _no_download(name):
+    raise RuntimeError(
+        f"{name}: automatic download is unavailable in this environment "
+        f"(no network egress). Place the standard files locally and pass "
+        f"their paths (image_path/label_path or data_file).")
+
+
+class MNIST(Dataset):
+    """IDX-format MNIST (reference vision/datasets/mnist.py).
+
+    mode: 'train' | 'test'.  Files are the standard idx3/idx1 (optionally
+    .gz).  Returns (image, label); image is HWC uint8 numpy unless transform
+    says otherwise."""
+
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        if image_path is None or label_path is None:
+            if download:
+                _no_download(type(self).NAME)
+            raise ValueError("image_path and label_path are required "
+                             "(no auto-download in this environment)")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+        if len(self.images) != len(self.labels):
+            raise ValueError("image/label count mismatch")
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if str(path).endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            if magic != 2051:
+                raise ValueError(f"bad idx3 magic {magic}")
+            data = np.frombuffer(f.read(n * rows * cols), dtype=np.uint8)
+        return data.reshape(n, rows, cols, 1)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            if magic != 2049:
+                raise ValueError(f"bad idx1 magic {magic}")
+            return np.frombuffer(f.read(n), dtype=np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the python-pickle tar.gz (reference cifar.py)."""
+
+    _num_classes = 10
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        if data_file is None:
+            if download:
+                _no_download("cifar10")
+            raise ValueError("data_file (cifar-10-python.tar.gz) required")
+        self.transform = transform
+        names = ([f"data_batch_{i}" for i in range(1, 6)] if mode == "train"
+                 else ["test_batch"])
+        if self._num_classes == 100:
+            names = ["train"] if mode == "train" else ["test"]
+        xs, ys = [], []
+        with tarfile.open(data_file, "r:*") as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(m), encoding="bytes")
+                    xs.append(np.asarray(d[b"data"], np.uint8))
+                    key = b"labels" if b"labels" in d else b"fine_labels"
+                    ys.append(np.asarray(d[key], np.int64))
+        if not xs:
+            raise ValueError(f"no batches found in {data_file}")
+        self.data = np.concatenate(xs).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.labels = np.concatenate(ys)
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _num_classes = 100
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+class DatasetFolder(Dataset):
+    """class-per-subdirectory loader (reference vision/datasets/folder.py)."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.loader = loader or self._pil_loader
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        if not classes:
+            raise RuntimeError(f"no class folders in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            for dirpath, _, files in sorted(os.walk(os.path.join(root, c))):
+                for fn in sorted(files):
+                    path = os.path.join(dirpath, fn)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fn.lower().endswith(extensions))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+
+    @staticmethod
+    def _pil_loader(path):
+        from PIL import Image
+
+        with open(path, "rb") as f:
+            return Image.open(f).convert("RGB")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """flat image-list loader (no labels) — reference folder.py ImageFolder."""
+
+    def __init__(self, root, loader=None, extensions=IMG_EXTENSIONS,
+                 transform=None, is_valid_file=None):
+        self.transform = transform
+        self.loader = loader or DatasetFolder._pil_loader
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fn in sorted(files):
+                path = os.path.join(dirpath, fn)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fn.lower().endswith(extensions))
+                if ok:
+                    self.samples.append(path)
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class FakeData(Dataset):
+    """Synthetic labelled images — the test/bench stand-in for the download-
+    able datasets (no reference analog needed; SURVEY.md §4 fake-device
+    spirit)."""
+
+    def __init__(self, size=100, image_shape=(3, 32, 32), num_classes=10,
+                 transform=None, seed=0):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self._rng = np.random.default_rng(seed)
+        self._images = self._rng.integers(
+            0, 256, (size,) + self.image_shape, dtype=np.uint8)
+        self._labels = self._rng.integers(0, num_classes, size).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self._images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self._labels[idx]
+
+    def __len__(self):
+        return self.size

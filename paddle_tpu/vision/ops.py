@@ -1,0 +1,171 @@
+"""vision.ops parity (reference: python/paddle/vision/ops.py — nms,
+roi_align, roi_pool, box utilities, deform_conv2d).
+
+TPU note: detection post-processing (nms) is host-side numpy — dynamic output
+sizes don't belong under jit; roi_align/roi_pool are pure-jnp gather programs
+that XLA vectorizes."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply_op, to_tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou",
+           "deform_conv2d", "DeformConv2D"]
+
+
+def _raw(x):
+    return np.asarray(x.data) if isinstance(x, Tensor) else np.asarray(x)
+
+
+def box_area(boxes):
+    b = _raw(boxes)
+    return to_tensor((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def box_iou(boxes1, boxes2):
+    a, b = _raw(boxes1), _raw(boxes2)
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = np.maximum(a[:, None, :2], b[None, :, :2])
+    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = np.clip(rb - lt, 0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return to_tensor(inter / (area1[:, None] + area2[None] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Reference vision/ops.py nms: greedy suppression, optional per-category."""
+    b = _raw(boxes)
+    n = len(b)
+    s = _raw(scores) if scores is not None else np.arange(n, 0, -1, dtype=np.float32)
+
+    def _greedy(idxs):
+        order = idxs[np.argsort(-s[idxs], kind="stable")]
+        keep = []
+        suppressed = np.zeros(n, bool)
+        for i in order:
+            if suppressed[i]:
+                continue
+            keep.append(i)
+            xx1 = np.maximum(b[i, 0], b[order, 0])
+            yy1 = np.maximum(b[i, 1], b[order, 1])
+            xx2 = np.minimum(b[i, 2], b[order, 2])
+            yy2 = np.minimum(b[i, 3], b[order, 3])
+            w = np.clip(xx2 - xx1, 0, None)
+            h = np.clip(yy2 - yy1, 0, None)
+            inter = w * h
+            a_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+            a_o = (b[order, 2] - b[order, 0]) * (b[order, 3] - b[order, 1])
+            iou = inter / (a_i + a_o - inter + 1e-10)
+            suppressed[order[iou > iou_threshold]] = True
+            suppressed[i] = False
+        return np.asarray(keep, np.int64)
+
+    if category_idxs is None:
+        keep = _greedy(np.arange(n))
+    else:
+        cidx = _raw(category_idxs)
+        cats = categories if categories is not None else np.unique(cidx)
+        parts = [
+            _greedy(np.flatnonzero(cidx == c)) for c in cats
+        ]
+        keep = np.concatenate([p for p in parts if len(p)]) if parts else \
+            np.empty(0, np.int64)
+        keep = keep[np.argsort(-s[keep], kind="stable")]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return to_tensor(keep)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign: bilinear sampling on a regular grid inside each box."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xs = [x if isinstance(x, Tensor) else to_tensor(x),
+          boxes if isinstance(boxes, Tensor) else to_tensor(boxes)]
+    bn = _raw(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    ratio = 1 if sampling_ratio <= 0 else sampling_ratio
+
+    def f(feat, rois):
+        off = 0.5 if aligned else 0.0
+        rois = rois.astype(jnp.float32) * spatial_scale - off
+        H, W = feat.shape[2], feat.shape[3]
+
+        def one(bi, roi):
+            x1, y1, x2, y2 = roi
+            rh = jnp.maximum(y2 - y1, 1e-4) / ph
+            rw = jnp.maximum(x2 - x1, 1e-4) / pw
+            # sample `ratio` points per bin per dim, average
+            iy = (jnp.arange(ph * ratio) + 0.5) / ratio
+            ix = (jnp.arange(pw * ratio) + 0.5) / ratio
+            ys = y1 + iy * rh
+            xcs = x1 + ix * rw
+            y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xcs), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            wy = jnp.clip(ys - y0, 0, 1)
+            wx = jnp.clip(xcs - x0, 0, 1)
+            y0 = y0.astype(jnp.int32)
+            x0 = x0.astype(jnp.int32)
+            fm = feat[bi]  # (C, H, W)
+            top = fm[:, y0][:, :, x0] * (1 - wx) + fm[:, y0][:, :, x1i] * wx
+            bot = fm[:, y1i][:, :, x0] * (1 - wx) + fm[:, y1i][:, :, x1i] * wx
+            vals = top * (1 - wy[:, None]) + bot * wy[:, None]  # (C, phr, pwr)
+            C = vals.shape[0]
+            vals = vals.reshape(C, ph, ratio, pw, ratio).mean((2, 4))
+            return vals
+
+        return jax.vmap(one)(jnp.asarray(batch_idx), rois)
+
+    return apply_op("roi_align", f, *xs)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """RoIPool: max over bins (quantized)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    feat = _raw(x)
+    rois = _raw(boxes) * spatial_scale
+    bn = _raw(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    N, C, H, W = feat.shape
+    out = np.zeros((len(rois), C, ph, pw), feat.dtype)
+    for r, (bi, roi) in enumerate(zip(batch_idx, rois)):
+        x1, y1, x2, y2 = np.round(roi).astype(np.int64)
+        # clamp to the feature map; negative starts would wrap as slices
+        x1 = int(np.clip(x1, 0, W - 1))
+        y1 = int(np.clip(y1, 0, H - 1))
+        x2 = int(np.clip(x2, x1 + 1, W))
+        y2 = int(np.clip(y2, y1 + 1, H))
+        hs = np.linspace(y1, y2, ph + 1).astype(np.int64)
+        ws = np.linspace(x1, x2, pw + 1).astype(np.int64)
+        for i in range(ph):
+            for j in range(pw):
+                ys, ye = hs[i], max(hs[i + 1], hs[i] + 1)
+                xs_, xe = ws[j], max(ws[j + 1], ws[j] + 1)
+                patch = feat[bi, :, min(ys, H - 1):min(ye, H),
+                             min(xs_, W - 1):min(xe, W)]
+                if patch.size:
+                    out[r, :, i, j] = patch.max((1, 2))
+    return to_tensor(out)
+
+
+def deform_conv2d(*args, **kwargs):
+    raise NotImplementedError(
+        "deform_conv2d: data-dependent gather conv — planned as a Pallas "
+        "kernel; use roi_align/standard convs meanwhile")
+
+
+class DeformConv2D:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("DeformConv2D — see deform_conv2d")

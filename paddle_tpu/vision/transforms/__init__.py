@@ -1,0 +1,13 @@
+from .transforms import (  # noqa: F401
+    BaseTransform, Compose, ToTensor, Normalize, Resize, CenterCrop,
+    RandomCrop, RandomHorizontalFlip, RandomVerticalFlip, RandomResizedCrop,
+    RandomRotation, Pad, Transpose, Grayscale, BrightnessTransform,
+    ContrastTransform, SaturationTransform, HueTransform, ColorJitter,
+    RandomErasing,
+)
+from . import functional  # noqa: F401
+from .functional import (  # noqa: F401
+    to_tensor, normalize, resize, crop, center_crop, hflip, vflip, pad,
+    rotate, adjust_brightness, adjust_contrast, adjust_hue, to_grayscale,
+    erase,
+)

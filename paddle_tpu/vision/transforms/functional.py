@@ -1,0 +1,271 @@
+"""vision.transforms.functional parity (reference:
+python/paddle/vision/transforms/functional.py + functional_pil/_cv2/_tensor).
+
+Host-side preprocessing: accepts PIL.Image or numpy HWC arrays, returns the
+same kind (to_tensor converts to CHW float32 numpy / Tensor).  This stays off
+the TPU on purpose — input pipelines run on CPU and feed device_put batches.
+"""
+
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+
+def _is_pil(img):
+    try:
+        from PIL import Image
+
+        return isinstance(img, Image.Image)
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def _to_np(img) -> np.ndarray:
+    """HWC uint8/float numpy view of a PIL image or array."""
+    if _is_pil(img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        return arr
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    return arr
+
+
+def _like(img, arr: np.ndarray):
+    """Return arr as the same kind as img (PIL in -> PIL out)."""
+    if _is_pil(img):
+        from PIL import Image
+
+        if arr.shape[2] == 1:
+            arr = arr[:, :, 0]
+        return Image.fromarray(arr.astype(np.uint8) if arr.dtype != np.uint8
+                               else arr)
+    return arr
+
+
+def to_tensor(pic, data_format="CHW"):
+    """uint8 HWC [0,255] -> float32 CHW [0,1]; float input passes through
+    unscaled (reference functional.py to_tensor semantics)."""
+    raw = _to_np(pic)
+    arr = raw.astype(np.float32)
+    if raw.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        shape = (-1, 1, 1)
+    else:
+        shape = (1, 1, -1)
+    return (arr - mean.reshape(shape)) / std.reshape(shape)
+
+
+def _interp_resize(arr: np.ndarray, h: int, w: int, interpolation="bilinear"):
+    """Pure-numpy separable resize (nearest / bilinear)."""
+    H, W, C = arr.shape
+    if interpolation == "nearest":
+        yi = np.clip((np.arange(h) + 0.5) * H / h, 0, H - 1).astype(np.int64)
+        xi = np.clip((np.arange(w) + 0.5) * W / w, 0, W - 1).astype(np.int64)
+        return arr[yi][:, xi]
+    # bilinear, half-pixel centers
+    fy = (np.arange(h) + 0.5) * H / h - 0.5
+    fx = (np.arange(w) + 0.5) * W / w - 0.5
+    y0 = np.clip(np.floor(fy), 0, H - 1).astype(np.int64)
+    x0 = np.clip(np.floor(fx), 0, W - 1).astype(np.int64)
+    y1 = np.clip(y0 + 1, 0, H - 1)
+    x1 = np.clip(x0 + 1, 0, W - 1)
+    wy = np.clip(fy - y0, 0.0, 1.0)[:, None, None]
+    wx = np.clip(fx - x0, 0.0, 1.0)[None, :, None]
+    a = arr.astype(np.float32)
+    top = a[y0][:, x0] * (1 - wx) + a[y0][:, x1] * wx
+    bot = a[y1][:, x0] * (1 - wx) + a[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    if np.issubdtype(arr.dtype, np.floating):
+        return out.astype(arr.dtype)
+    return np.clip(np.round(out), 0, 255).astype(arr.dtype)
+
+
+def resize(img, size, interpolation="bilinear"):
+    if _is_pil(img):
+        from PIL import Image
+
+        modes = {"nearest": Image.NEAREST, "bilinear": Image.BILINEAR,
+                 "bicubic": Image.BICUBIC, "lanczos": Image.LANCZOS}
+        if isinstance(size, int):
+            w, h = img.size
+            if w < h:
+                ow, oh = size, int(size * h / w)
+            else:
+                oh, ow = size, int(size * w / h)
+        else:
+            oh, ow = size
+        return img.resize((ow, oh), modes.get(interpolation, Image.BILINEAR))
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    if isinstance(size, int):
+        if W < H:
+            ow, oh = size, int(size * H / W)
+        else:
+            oh, ow = size, int(size * W / H)
+    else:
+        oh, ow = size
+    return _interp_resize(arr, oh, ow, interpolation)
+
+
+def crop(img, top, left, height, width):
+    if _is_pil(img):
+        return img.crop((left, top, left + width, top + height))
+    return _to_np(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    th, tw = output_size
+    top = max(0, (H - th) // 2)
+    left = max(0, (W - tw) // 2)
+    return crop(img, top, left, th, tw)
+
+
+def hflip(img):
+    if _is_pil(img):
+        from PIL import Image
+
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return _to_np(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    if _is_pil(img):
+        from PIL import Image
+
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    return _to_np(img)[::-1].copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4  # left, top, right, bottom
+    elif len(padding) == 2:
+        padding = (padding[0], padding[1], padding[0], padding[1])
+    l, t, r, b = padding
+    arr = _to_np(img)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    out = np.pad(arr, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
+    return _like(img, out)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    if _is_pil(img):
+        return img.rotate(angle, expand=expand, center=center, fillcolor=fill)
+    # numpy path: inverse-map rotation (nearest or bilinear), optional expand
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    cy, cx = ((H - 1) / 2, (W - 1) / 2) if center is None else center
+    th = np.deg2rad(angle)
+    if expand:
+        # epsilon guards fp fuzz (cos(90 deg) ~ 6e-17 would bump ceil by 1)
+        oh = int(np.ceil(abs(H * np.cos(th)) + abs(W * np.sin(th)) - 1e-7))
+        ow = int(np.ceil(abs(H * np.sin(th)) + abs(W * np.cos(th)) - 1e-7))
+        ocy, ocx = (oh - 1) / 2, (ow - 1) / 2
+    else:
+        oh, ow, ocy, ocx = H, W, cy, cx
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    ys = cy + (yy - ocy) * np.cos(th) - (xx - ocx) * np.sin(th)
+    xs = cx + (yy - ocy) * np.sin(th) + (xx - ocx) * np.cos(th)
+    out = np.full((oh, ow) + arr.shape[2:], fill, dtype=arr.dtype)
+    if interpolation == "bilinear":
+        y0 = np.floor(ys)
+        x0 = np.floor(xs)
+        wy = (ys - y0)[..., None]
+        wx = (xs - x0)[..., None]
+        ok = (y0 >= 0) & (y0 < H - 1) & (x0 >= 0) & (x0 < W - 1)
+        y0c = np.clip(y0, 0, H - 2).astype(np.int64)
+        x0c = np.clip(x0, 0, W - 2).astype(np.int64)
+        a = arr.astype(np.float32)
+        val = (a[y0c, x0c] * (1 - wy) * (1 - wx) + a[y0c, x0c + 1] * (1 - wy) * wx
+               + a[y0c + 1, x0c] * wy * (1 - wx) + a[y0c + 1, x0c + 1] * wy * wx)
+        if not np.issubdtype(arr.dtype, np.floating):
+            val = np.clip(np.round(val), 0, 255)
+        out[ok] = val[ok].astype(arr.dtype)
+    else:
+        yi = np.round(ys).astype(np.int64)
+        xi = np.round(xs).astype(np.int64)
+        ok = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+        out[ok] = arr[yi[ok], xi[ok]]
+    return out
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_np(img).astype(np.float32) * brightness_factor
+    return _like(img, np.clip(arr, 0, 255))
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_np(img).astype(np.float32)
+    mean = arr.mean()
+    out = (arr - mean) * contrast_factor + mean
+    return _like(img, np.clip(out, 0, 255))
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _to_np(img).astype(np.float32)
+    gray = arr.mean(axis=2, keepdims=True)
+    out = gray + (arr - gray) * saturation_factor
+    return _like(img, np.clip(out, 0, 255))
+
+
+def adjust_hue(img, hue_factor):
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    if _is_pil(img):
+        hsv = np.asarray(img.convert("HSV")).copy()
+        hsv[..., 0] = (hsv[..., 0].astype(np.int32) + int(hue_factor * 255)) % 256
+        from PIL import Image
+
+        return Image.fromarray(hsv, "HSV").convert(img.mode)
+    arr = _to_np(img)
+    from PIL import Image
+
+    pil = Image.fromarray(arr.astype(np.uint8).squeeze())
+    return np.asarray(adjust_hue(pil, hue_factor))
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_np(img).astype(np.float32)
+    gray = (0.299 * arr[..., 0] + 0.587 * arr[..., 1] + 0.114 * arr[..., 2]
+            if arr.shape[2] >= 3 else arr[..., 0])
+    out = np.repeat(gray[:, :, None], num_output_channels, axis=2)
+    return _like(img, np.clip(out, 0, 255))
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Reference functional.erase — fill region with value(s) v.  PIL input
+    returns PIL; inplace only applies to writable ndarray input."""
+    pil_in = _is_pil(img)
+    arr = _to_np(img) if pil_in else np.asarray(img)
+    chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+    writable = (not pil_in) and inplace and getattr(img, "flags", None) is not None \
+        and img.flags.writeable
+    out = arr if writable else arr.copy()
+    if chw:
+        out[:, i:i + h, j:j + w] = v
+    else:
+        out[i:i + h, j:j + w] = v
+    return _like(img, out) if pil_in else out

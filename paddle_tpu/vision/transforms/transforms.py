@@ -1,0 +1,283 @@
+"""vision.transforms class API (reference:
+python/paddle/vision/transforms/transforms.py — BaseTransform + ~25 classes)."""
+
+from __future__ import annotations
+
+import numbers
+import random
+from typing import Sequence
+
+import numpy as np
+
+from . import functional as F
+
+
+class BaseTransform:
+    """Reference BaseTransform: keys-aware __call__ over (image, ...) tuples."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def __call__(self, inputs):
+        if isinstance(inputs, tuple):
+            keys = tuple(self.keys) + ("",) * (len(inputs) - len(self.keys))
+            return tuple(self._apply_image(x) if k == "image" else x
+                         for x, k in zip(inputs, keys))
+        return self._apply_image(inputs)
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        super().__init__(keys)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return F.to_tensor(img, self.data_format)
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        super().__init__(keys)
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        return F.normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size, self.interpolation = size, interpolation
+
+    def _apply_image(self, img):
+        return F.resize(img, self.size, self.interpolation)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        super().__init__(keys)
+        self.size = size
+
+    def _apply_image(self, img):
+        return F.center_crop(img, self.size)
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0,
+                 padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+        self.pad_if_needed = pad_if_needed
+        self.fill, self.padding_mode = fill, padding_mode
+
+    def _apply_image(self, img):
+        if self.padding is not None:
+            img = F.pad(img, self.padding, self.fill, self.padding_mode)
+        arr = F._to_np(img)
+        H, W = arr.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (H < th or W < tw):
+            img = F.pad(img, (max(0, tw - W), max(0, th - H)), self.fill,
+                        self.padding_mode)
+            arr = F._to_np(img)
+            H, W = arr.shape[:2]
+        top = random.randint(0, max(0, H - th))
+        left = random.randint(0, max(0, W - tw))
+        return F.crop(img, top, left, th, tw)
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return F.hflip(img) if random.random() < self.prob else img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return F.vflip(img) if random.random() < self.prob else img
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        super().__init__(keys)
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale, self.ratio = scale, ratio
+        self.interpolation = interpolation
+
+    def _apply_image(self, img):
+        arr = F._to_np(img)
+        H, W = arr.shape[:2]
+        area = H * W
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = np.exp(random.uniform(np.log(self.ratio[0]),
+                                       np.log(self.ratio[1])))
+            w = int(round(np.sqrt(target * ar)))
+            h = int(round(np.sqrt(target / ar)))
+            if 0 < w <= W and 0 < h <= H:
+                top = random.randint(0, H - h)
+                left = random.randint(0, W - w)
+                return F.resize(F.crop(img, top, left, h, w), self.size,
+                                self.interpolation)
+        return F.resize(F.center_crop(img, min(H, W)), self.size,
+                        self.interpolation)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.interpolation, self.expand = interpolation, expand
+        self.center, self.fill = center, fill
+
+    def _apply_image(self, img):
+        angle = random.uniform(*self.degrees)
+        return F.rotate(img, angle, self.interpolation, self.expand,
+                        self.center, self.fill)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        super().__init__(keys)
+        self.order = order
+
+    def _apply_image(self, img):
+        return F._to_np(img).transpose(self.order)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        f = random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return F.adjust_hue(img, random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.ts = [BrightnessTransform(brightness), ContrastTransform(contrast),
+                   SaturationTransform(saturation), HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = list(range(4))
+        random.shuffle(order)
+        for i in order:
+            img = self.ts[i]._apply_image(img)
+        return img
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = F._to_np(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+        H, W = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0], arr.shape[1])
+        area = H * W
+        for _ in range(10):
+            target = random.uniform(*self.scale) * area
+            ar = random.uniform(*self.ratio)
+            h = int(round(np.sqrt(target * ar)))
+            w = int(round(np.sqrt(target / ar)))
+            if h < H and w < W:
+                i = random.randint(0, H - h)
+                j = random.randint(0, W - w)
+                # erase via F.erase on the original so PIL in -> PIL out
+                return F.erase(img, i, j, h, w, self.value, self.inplace)
+        return img

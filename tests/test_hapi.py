@@ -1,0 +1,150 @@
+"""hapi Model: prepare/fit/evaluate/predict/save/load/summary + callbacks.
+
+Reference test model: test/legacy_test/test_model.py (LeNet + MNIST pattern).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision.datasets import FakeData
+
+
+def _mlp(num_classes=4):
+    return nn.Sequential(nn.Flatten(), nn.Linear(64, 32), nn.ReLU(),
+                         nn.Linear(32, num_classes))
+
+
+def _prepared_model(num_classes=4):
+    net = _mlp(num_classes)
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=opt.AdamW(learning_rate=5e-3, parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    return model
+
+
+def _dataset(n=64, num_classes=4, seed=0):
+    # learnable mapping: label = argmax of 4 pixel groups
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, 1, 8, 8)).astype(np.float32)
+    ys = xs.reshape(n, 4, 16).sum(-1).argmax(-1).astype(np.int64)
+    import paddle_tpu.io as io
+
+    return io.TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+
+
+class TestModelFit:
+    def test_fit_reduces_loss_and_tracks_acc(self):
+        model = _prepared_model()
+        ds = _dataset(64)
+        hist = model.fit(ds, epochs=8, batch_size=16, verbose=0)
+        losses = hist["loss"]
+        assert losses[-1] < losses[0]
+        res = model.evaluate(ds, batch_size=16)
+        assert res["acc"] > 0.5
+        assert "loss" in res
+
+    def test_fit_with_eval_data(self):
+        model = _prepared_model()
+        hist = model.fit(_dataset(32), eval_data=_dataset(16, seed=1),
+                         epochs=2, batch_size=8, verbose=0)
+        assert len(hist["loss"]) == 8
+
+    def test_predict(self):
+        import paddle_tpu.io as io
+
+        model = _prepared_model()
+        xs = np.random.randn(12, 1, 8, 8).astype(np.float32)
+        ds = io.TensorDataset([paddle.to_tensor(xs)])
+        outs = model.predict(ds, batch_size=4, stack_outputs=True)
+        assert outs[0].shape == (12, 4)
+
+    def test_predict_with_input_spec(self):
+        """Labelled dataset + declared inputs spec -> labels dropped."""
+        net = _mlp()
+        model = paddle.Model(net, inputs=["image"])
+        model.prepare(loss=nn.CrossEntropyLoss())
+        outs = model.predict(_dataset(8), batch_size=4, stack_outputs=True)
+        assert outs[0].shape == (8, 4)
+
+    def test_train_batch_api(self):
+        model = _prepared_model()
+        x = np.random.randn(4, 1, 8, 8).astype(np.float32)
+        y = np.array([0, 1, 2, 3], np.int64)
+        out = model.train_batch([x], [y])
+        loss_vals = out[0] if isinstance(out, tuple) else out
+        assert np.isfinite(loss_vals[0])
+
+    def test_save_load_roundtrip(self, tmp_path):
+        model = _prepared_model()
+        ds = _dataset(32)
+        model.fit(ds, epochs=2, batch_size=8, verbose=0)
+        path = str(tmp_path / "ckpt" / "model")
+        model.save(path)
+        assert os.path.exists(path + ".pdparams")
+        assert os.path.exists(path + ".pdopt")
+
+        model2 = _prepared_model()
+        model2.load(path)
+        w1 = np.asarray(model.network[1].weight.data)
+        w2 = np.asarray(model2.network[1].weight.data)
+        np.testing.assert_allclose(w1, w2)
+
+    def test_grad_accumulation_fewer_updates(self):
+        model = _prepared_model()
+        w = model.network[1].weight
+        before = np.asarray(w.data).copy()
+        # 4 batches, accumulate 4 -> exactly one optimizer step
+        model.fit(_dataset(32), epochs=1, batch_size=8, verbose=0,
+                  accumulate_grad_batches=4)
+        after = np.asarray(w.data)
+        assert not np.allclose(before, after)
+
+    def test_summary_counts(self):
+        model = _prepared_model()
+        info = model.summary()
+        expected = 64 * 32 + 32 + 32 * 4 + 4
+        assert info["total_params"] == expected
+
+
+class TestCallbacks:
+    def test_early_stopping(self):
+        from paddle_tpu.hapi import EarlyStopping
+
+        model = _prepared_model()
+        es = EarlyStopping(monitor="loss", patience=0, min_delta=1e9)
+        model.fit(_dataset(16), epochs=10, batch_size=8, verbose=0,
+                  callbacks=[es])
+        # impossible min_delta -> stops after patience+1 epochs
+        assert model.stop_training
+
+    def test_model_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi import ModelCheckpoint
+
+        model = _prepared_model()
+        model.fit(_dataset(16), epochs=2, batch_size=8, verbose=0,
+                  callbacks=[ModelCheckpoint(save_freq=1,
+                                             save_dir=str(tmp_path))])
+        assert os.path.exists(str(tmp_path / "final.pdparams"))
+
+    def test_vision_lenet_with_model(self):
+        """The classic hapi demo: Model(LeNet()).fit(mnist-like)."""
+        import paddle_tpu.vision as vision
+
+        net = vision.LeNet(num_classes=3)
+        model = paddle.Model(net)
+        model.prepare(
+            optimizer=opt.AdamW(learning_rate=1e-3,
+                                parameters=net.parameters()),
+            loss=nn.CrossEntropyLoss(), metrics=Accuracy())
+        ds = FakeData(size=24, image_shape=(1, 28, 28), num_classes=3,
+                      transform=lambda im: im.astype(np.float32) / 255.0)
+        hist = model.fit(ds, epochs=1, batch_size=8, verbose=0)
+        assert len(hist["loss"]) == 3

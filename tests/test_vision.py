@@ -1,0 +1,240 @@
+"""vision package: transforms, datasets, model zoo forward/train, ops."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.vision as vision
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData, MNIST, DatasetFolder
+
+
+class TestTransforms:
+    def test_to_tensor_scales(self):
+        img = np.full((8, 6, 3), 255, np.uint8)
+        out = T.to_tensor(img)
+        assert out.shape == (3, 8, 6)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_normalize(self):
+        chw = np.ones((3, 4, 4), np.float32)
+        out = T.normalize(chw, mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5])
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_resize_shapes(self):
+        img = np.random.randint(0, 255, (16, 32, 3), np.uint8)
+        assert T.resize(img, (8, 8)).shape == (8, 8, 3)
+        # int size resizes the short edge keeping aspect
+        assert T.resize(img, 8).shape == (8, 16, 3)
+
+    def test_resize_bilinear_constant(self):
+        img = np.full((10, 10, 1), 7.0, np.float32)
+        out = T.resize(img, (5, 4))
+        np.testing.assert_allclose(out, 7.0, rtol=1e-6)
+
+    def test_center_crop_and_flip(self):
+        img = np.arange(25, dtype=np.uint8).reshape(5, 5, 1)
+        c = T.center_crop(img, 3)
+        assert c.shape == (3, 3, 1)
+        assert c[1, 1, 0] == img[2, 2, 0]
+        f = T.hflip(img)
+        assert f[0, 0, 0] == img[0, 4, 0]
+
+    def test_compose_pipeline(self):
+        tr = T.Compose([
+            T.Resize((16, 16)), T.RandomHorizontalFlip(0.5),
+            T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3)])
+        img = np.random.randint(0, 255, (20, 24, 3), np.uint8)
+        out = tr(img)
+        assert out.shape == (3, 16, 16)
+        assert out.dtype == np.float32
+
+    def test_pad_and_rotation(self):
+        img = np.ones((4, 4, 1), np.uint8)
+        p = T.pad(img, 2)
+        assert np.asarray(p).shape == (8, 8, 1)
+        r = T.functional.rotate(img, 90)
+        assert r.shape == (4, 4, 1)
+
+    def test_tuple_passthrough_keeps_label(self):
+        img = np.random.randint(0, 255, (8, 8, 3), np.uint8)
+        out = T.ToTensor()((img, 7))
+        assert isinstance(out, tuple) and out[1] == 7
+        assert out[0].shape == (3, 8, 8)
+
+    def test_resize_float_preserves_values(self):
+        img = np.random.rand(10, 10, 3)  # float64 in [0,1]
+        out = T.resize(img, (5, 5))
+        assert out.dtype == np.float64
+        assert 0.0 < out.mean() < 1.0
+        assert not np.all(np.isin(out, [0.0, 1.0]))
+
+    def test_rotate_expand_numpy(self):
+        img = np.ones((10, 20, 1), np.uint8)
+        out = T.functional.rotate(img, 90, expand=True)
+        assert out.shape[:2] == (20, 10)
+
+    def test_random_erasing_pil_stays_pil(self):
+        from PIL import Image
+
+        pil = Image.fromarray(np.random.randint(0, 255, (16, 16, 3), np.uint8))
+        out = T.RandomErasing(prob=1.0)(pil)
+        assert isinstance(out, Image.Image)
+
+    def test_color_jitter_runs(self):
+        img = np.random.randint(0, 255, (8, 8, 3), np.uint8)
+        out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+        assert np.asarray(out).shape == (8, 8, 3)
+
+    def test_pil_roundtrip(self):
+        from PIL import Image
+
+        pil = Image.fromarray(np.random.randint(0, 255, (12, 12, 3), np.uint8))
+        out = T.resize(pil, (6, 6))
+        assert out.size == (6, 6)
+        t = T.to_tensor(out)
+        assert t.shape == (3, 6, 6)
+
+
+class TestDatasets:
+    def test_fake_data_with_dataloader(self):
+        import paddle_tpu.io as io
+
+        ds = FakeData(size=20, image_shape=(1, 8, 8), num_classes=3)
+        assert len(ds) == 20
+        loader = io.DataLoader(ds, batch_size=4, shuffle=True)
+        batches = list(loader)
+        assert len(batches) == 5
+        xb, yb = batches[0]
+        assert tuple(np.asarray(xb).shape) == (4, 1, 8, 8)
+
+    def test_mnist_idx_parser(self, tmp_path):
+        import gzip
+        import struct
+
+        imgs = np.random.randint(0, 255, (5, 28, 28), np.uint8)
+        labels = np.arange(5, dtype=np.uint8)
+        ip = tmp_path / "img.idx3.gz"
+        lp = tmp_path / "lab.idx1.gz"
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 5, 28, 28) + imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, 5) + labels.tobytes())
+        ds = MNIST(image_path=str(ip), label_path=str(lp))
+        assert len(ds) == 5
+        img, lab = ds[3]
+        assert img.shape == (28, 28, 1)
+        assert lab == 3
+
+    def test_dataset_folder(self, tmp_path):
+        from PIL import Image
+
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                Image.fromarray(
+                    np.random.randint(0, 255, (8, 8, 3), np.uint8)).save(
+                    d / f"{i}.png")
+        ds = DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, label = ds[0]
+        assert label == 0
+
+    def test_download_raises(self):
+        with pytest.raises((RuntimeError, ValueError)):
+            MNIST(download=True)
+
+
+class TestModels:
+    def test_lenet_trains(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+
+        model = vision.LeNet()
+        optim = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        x = paddle.to_tensor(np.random.randn(4, 1, 28, 28).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+        losses = []
+        for _ in range(5):
+            loss = F.cross_entropy(model(x), y)
+            loss.backward()
+            optim.step()
+            optim.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+
+    @pytest.mark.parametrize("ctor,size", [
+        (lambda: vision.resnet18(num_classes=10), 32),
+        (lambda: vision.resnet50(num_classes=10), 32),
+        (lambda: vision.mobilenet_v2(num_classes=10), 32),
+        (lambda: vision.squeezenet1_1(num_classes=10), 64),
+        (lambda: vision.shufflenet_v2_x0_25(num_classes=10), 32),
+        (lambda: vision.densenet121(num_classes=10), 32),
+    ])
+    def test_model_forward_shapes(self, ctor, size):
+        model = ctor()
+        model.eval()
+        x = paddle.to_tensor(
+            np.random.randn(2, 3, size, size).astype(np.float32))
+        out = model(x)
+        assert tuple(out.shape) == (2, 10)
+
+    def test_vgg_forward(self):
+        model = vision.vgg11(num_classes=7)
+        model.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype(np.float32))
+        assert tuple(model(x).shape) == (1, 7)
+
+    def test_resnet_train_step(self):
+        import paddle_tpu.nn.functional as F
+        import paddle_tpu.optimizer as opt
+
+        model = vision.resnet18(num_classes=4)
+        optim = opt.SGD(learning_rate=0.01, parameters=model.parameters())
+        x = paddle.to_tensor(np.random.randn(2, 3, 32, 32).astype(np.float32))
+        y = paddle.to_tensor(np.array([0, 1], np.int64))
+        loss = F.cross_entropy(model(x), y)
+        loss.backward()
+        optim.step()
+        assert model.conv1.weight.grad is not None
+
+
+class TestVisionOps:
+    def test_nms_suppresses_overlap(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                         np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = vision.ops.nms(boxes, 0.5, scores=scores)
+        np.testing.assert_array_equal(np.asarray(keep.data), [0, 2])
+
+    def test_nms_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32)
+        scores = np.array([0.9, 0.8], np.float32)
+        cats = np.array([0, 1])
+        keep = vision.ops.nms(boxes, 0.5, scores=scores, category_idxs=cats,
+                              categories=[0, 1])
+        assert len(np.asarray(keep.data)) == 2  # different categories kept
+
+    def test_box_iou(self):
+        a = np.array([[0, 0, 10, 10]], np.float32)
+        b = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        iou = np.asarray(vision.ops.box_iou(a, b).data)
+        np.testing.assert_allclose(iou[0, 0], 1.0)
+        assert 0.1 < iou[0, 1] < 0.2
+
+    def test_roi_align_shape_and_constant(self):
+        feat = np.full((1, 2, 16, 16), 3.0, np.float32)
+        rois = np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32)
+        out = vision.ops.roi_align(feat, rois, np.array([2]), 4)
+        assert tuple(out.shape) == (2, 2, 4, 4)
+        np.testing.assert_allclose(np.asarray(out.data), 3.0, rtol=1e-5)
+
+    def test_roi_pool_shape(self):
+        feat = np.random.randn(1, 3, 16, 16).astype(np.float32)
+        rois = np.array([[0, 0, 8, 8]], np.float32)
+        out = vision.ops.roi_pool(feat, rois, np.array([1]), 2)
+        assert tuple(out.shape) == (1, 3, 2, 2)
