@@ -43,6 +43,7 @@ from . import metric  # noqa: E402
 from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
 from . import hapi  # noqa: E402
+from . import distribution  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi.summary import summary  # noqa: E402
 

@@ -1,0 +1,113 @@
+"""Bernoulli / ContinuousBernoulli (reference: distribution/bernoulli.py,
+continuous_bernoulli.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, Distribution, _fv, _key, _shape, _wrap
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs = _fv(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.bernoulli(
+            _key(), self.probs, shp).astype(self.probs.dtype))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Gumbel-softmax style relaxed sample (reference rsample w/ temp)."""
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp, self.probs.dtype, 1e-6, 1 - 1e-6)
+        logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        z = (logits + jnp.log(u) - jnp.log1p(-u)) / temperature
+        return _wrap(jax.nn.sigmoid(z))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return _wrap(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Bernoulli):
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            q = jnp.clip(other.probs, 1e-7, 1 - 1e-7)
+            return _wrap(p * (jnp.log(p) - jnp.log(q))
+                         + (1 - p) * (jnp.log1p(-p) - jnp.log1p(-q)))
+        return super().kl_divergence(other)
+
+
+class ContinuousBernoulli(Distribution):
+    """Reference continuous_bernoulli.py — CB(lambda) on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = _fv(probs)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _outside(self):
+        return (self.probs < self._lims[0]) | (self.probs > self._lims[1])
+
+    def _log_norm(self):
+        """log C(lambda) with the Taylor patch near 0.5 (reference _cont_bern_log_norm)."""
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        safe = jnp.where(self._outside(), p, 0.25)  # keep grads finite at 0.5
+        x = 1 - 2 * safe
+        ln = jnp.log(2 * jnp.arctanh(x) / x)
+        taylor = jnp.log(2.0) + 4 / 3 * (p - 0.5) ** 2
+        return jnp.where(self._outside(), ln, taylor)
+
+    @property
+    def mean(self):
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        m = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        taylor = 0.5 + (p - 0.5) / 3
+        return _wrap(jnp.where(self._outside(), m, taylor))
+
+    @property
+    def variance(self):
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        v = p * (p - 1) / (1 - 2 * p) ** 2 + 1 / (2 * jnp.arctanh(1 - 2 * p)) ** 2
+        taylor = 1 / 12 - (p - 0.5) ** 2 / 15
+        return _wrap(jnp.where(self._outside(), v, taylor))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp, self.probs.dtype, 1e-6, 1 - 1e-6)
+        return self.icdf(u)
+
+    def icdf(self, value):
+        v = _fv(value)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        # F^-1(u) = log(1 + u*(2p-1)/(1-p)) / (log p - log(1-p))
+        out = (jnp.log1p(v * (2 * p - 1) / (1 - p)) /
+               (jnp.log(p) - jnp.log1p(-p)))
+        return _wrap(jnp.where(self._outside(), out, v))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        return _wrap(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) + self._log_norm())
+
+    def entropy(self):
+        # -E[log p(x)] = -(mean*log p + (1-mean)*log(1-p) + log C)
+        p = jnp.clip(self.probs, 1e-6, 1 - 1e-6)
+        m = jnp.asarray(self.mean._data)
+        return _wrap(-(m * jnp.log(p) + (1 - m) * jnp.log1p(-p)
+                       + self._log_norm()))

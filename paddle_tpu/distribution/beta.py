@@ -1,0 +1,59 @@
+"""Beta (reference: distribution/beta.py) — via two Gammas (implicit reparam)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _fv, _key, _shape, _wrap
+
+
+def _betaln(a, b):
+    return jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b)
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _fv(alpha)
+        self.beta = _fv(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.alpha / (self.alpha + self.beta),
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return _wrap(jnp.broadcast_to(
+            self.alpha * self.beta / (s ** 2 * (s + 1)), self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        ga = jax.random.gamma(_key(), jnp.broadcast_to(self.alpha, shp))
+        gb = jax.random.gamma(_key(), jnp.broadcast_to(self.beta, shp))
+        return _wrap(ga / (ga + gb))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        return _wrap((self.alpha - 1) * jnp.log(v)
+                     + (self.beta - 1) * jnp.log1p(-v)
+                     - _betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        a = jnp.broadcast_to(self.alpha, self.batch_shape)
+        b = jnp.broadcast_to(self.beta, self.batch_shape)
+        dg = jax.lax.digamma
+        return _wrap(_betaln(a, b) - (a - 1) * dg(a) - (b - 1) * dg(b)
+                     + (a + b - 2) * dg(a + b))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Beta):
+            dg = jax.lax.digamma
+            a1, b1, a2, b2 = self.alpha, self.beta, other.alpha, other.beta
+            s1 = a1 + b1
+            return _wrap(_betaln(a2, b2) - _betaln(a1, b1)
+                         + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                         + (a2 - a1 + b2 - b1) * dg(s1))
+        return super().kl_divergence(other)

@@ -1,0 +1,69 @@
+"""Binomial (reference: distribution/binomial.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(total_count)
+        self.probs = _fv(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.total_count * self.probs,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            self.total_count * self.probs * (1 - self.probs),
+            self.batch_shape))
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        n = int(jnp.max(self.total_count))
+        u = jax.random.uniform(_key(), (n,) + shp, self.probs.dtype)
+        k = jnp.arange(n, dtype=self.probs.dtype).reshape(
+            (n,) + (1,) * len(shp))
+        draws = ((u < self.probs) & (k < self.total_count)).sum(0)
+        return _wrap(draws.astype(self.probs.dtype))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _fv(value)
+        n = jnp.broadcast_to(self.total_count, self.batch_shape).astype(v.dtype)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        logc = (jax.lax.lgamma(n + 1) - jax.lax.lgamma(v + 1)
+                - jax.lax.lgamma(n - v + 1))
+        return _wrap(logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        n = int(jnp.max(self.total_count))
+        k = jnp.arange(n + 1, dtype=self.probs.dtype)
+        kshape = k.reshape((n + 1,) + (1,) * len(self.batch_shape))
+        logp = jnp.asarray(self.log_prob(
+            jnp.broadcast_to(kshape, (n + 1,) + self.batch_shape))._data)
+        valid = kshape <= self.total_count
+        p = jnp.where(valid, jnp.exp(logp), 0.0)
+        return _wrap(-(p * jnp.where(valid, logp, 0.0)).sum(0))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Binomial):
+            if not bool(jnp.all(self.total_count == other.total_count)):
+                raise NotImplementedError(
+                    "KL between Binomials with different total_count has no "
+                    "closed form (supports differ)")
+            p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+            q = jnp.clip(other.probs, 1e-7, 1 - 1e-7)
+            n = self.total_count
+            return _wrap(n * (p * jnp.log(p / q)
+                              + (1 - p) * jnp.log((1 - p) / (1 - q))))
+        return super().kl_divergence(other)

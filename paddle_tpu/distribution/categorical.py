@@ -1,0 +1,116 @@
+"""Categorical / Multinomial (reference: distribution/categorical.py,
+multinomial.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _v, _wrap
+
+
+class Categorical(Distribution):
+    """Parameterized by (unnormalized) logits like the reference (which takes
+    `logits` that it normalizes by sum — here softmax-normalized)."""
+
+    def __init__(self, logits=None, probs=None, name=None):
+        if (logits is None) == (probs is None):
+            raise ValueError("pass exactly one of logits/probs")
+        if probs is not None:
+            p = _fv(probs)
+            p = p / p.sum(-1, keepdims=True)
+            self.logits = jnp.log(jnp.clip(p, 1e-12, None))
+        else:
+            self.logits = _fv(logits)
+        self._probs = jax.nn.softmax(self.logits, -1)
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _wrap(self._probs)
+
+    @property
+    def num_events(self):
+        return self.logits.shape[-1]
+
+    @property
+    def mean(self):
+        return _wrap(jnp.sum(self._probs * jnp.arange(self.num_events,
+                                                      dtype=self._probs.dtype), -1))
+
+    @property
+    def variance(self):
+        k = jnp.arange(self.num_events, dtype=self._probs.dtype)
+        m = jnp.sum(self._probs * k, -1, keepdims=True)
+        return _wrap(jnp.sum(self._probs * (k - m) ** 2, -1))
+
+    def sample(self, shape=()):
+        shp = _shape(shape)
+        out = jax.random.categorical(
+            _key(), self.logits, axis=-1,
+            shape=shp + self.batch_shape)
+        return _wrap(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _v(value).astype(jnp.int32)
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _wrap(jnp.take_along_axis(
+            jnp.broadcast_to(logp, v.shape + (self.num_events,)),
+            v[..., None], axis=-1)[..., 0])
+
+    def probabilities(self, value=None):
+        return self.probs
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _wrap(-jnp.sum(self._probs * logp, -1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Categorical):
+            lp = jax.nn.log_softmax(self.logits, -1)
+            lq = jax.nn.log_softmax(other.logits, -1)
+            return _wrap(jnp.sum(self._probs * (lp - lq), -1))
+        return super().kl_divergence(other)
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        p = _fv(probs)
+        self._probs = p / p.sum(-1, keepdims=True)
+        super().__init__(self._probs.shape[:-1], self._probs.shape[-1:])
+
+    @property
+    def probs(self):
+        return _wrap(self._probs)
+
+    @property
+    def mean(self):
+        return _wrap(self.total_count * self._probs)
+
+    @property
+    def variance(self):
+        return _wrap(self.total_count * self._probs * (1 - self._probs))
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        logits = jnp.log(jnp.clip(self._probs, 1e-12, None))
+        draws = jax.random.categorical(
+            _key(), logits, axis=-1, shape=(self.total_count,) + shp)
+        K = self._probs.shape[-1]
+        counts = jax.nn.one_hot(draws, K, dtype=jnp.float32).sum(0)
+        return _wrap(counts)
+
+    def log_prob(self, value):
+        v = _fv(value)
+        logp = jnp.log(jnp.clip(self._probs, 1e-12, None))
+        coeff = (jax.lax.lgamma(jnp.asarray(self.total_count + 1.0))
+                 - jax.lax.lgamma(v + 1.0).sum(-1))
+        return _wrap(coeff + (v * logp).sum(-1))
+
+    def entropy(self):
+        # exact entropy has no closed form; Monte-Carlo like the reference's
+        # fallback is overkill — use the standard sum approximation via samples
+        n = 256
+        s = _v(self.sample((n,)))
+        return _wrap(-_v(self.log_prob(s)).mean(0))

@@ -1,0 +1,56 @@
+"""Cauchy (reference: distribution/cauchy.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _fv(loc)
+        self.scale = _fv(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy has no variance")
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp, self.loc.dtype, 1e-7, 1 - 1e-7)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (u - 0.5)))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-math.log(math.pi) - jnp.log(self.scale)
+                     - jnp.log1p(z ** 2))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(math.log(4 * math.pi)
+                                      + jnp.log(self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        v = _fv(value)
+        return _wrap(jnp.arctan((v - self.loc) / self.scale) / math.pi + 0.5)
+
+    def icdf(self, value):
+        v = _fv(value)
+        return _wrap(self.loc + self.scale * jnp.tan(math.pi * (v - 0.5)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Cauchy):
+            # closed form (Chyzak & Nielsen 2019)
+            num = (self.scale + other.scale) ** 2 + (self.loc - other.loc) ** 2
+            den = 4 * self.scale * other.scale
+            return _wrap(jnp.log(num / den))
+        return super().kl_divergence(other)

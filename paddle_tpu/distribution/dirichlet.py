@@ -1,0 +1,58 @@
+"""Dirichlet (reference: distribution/dirichlet.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _fv, _key, _shape, _wrap
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration, name=None):
+        self.concentration = _fv(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _wrap(self.concentration
+                     / self.concentration.sum(-1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = self.concentration.sum(-1, keepdims=True)
+        m = self.concentration / a0
+        return _wrap(m * (1 - m) / (a0 + 1))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape + self.event_shape
+        g = jax.random.gamma(_key(), jnp.broadcast_to(self.concentration, shp))
+        return _wrap(g / g.sum(-1, keepdims=True))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        a = self.concentration
+        return _wrap(((a - 1) * jnp.log(v)).sum(-1)
+                     + jax.lax.lgamma(a.sum(-1))
+                     - jax.lax.lgamma(a).sum(-1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = a.sum(-1)
+        K = a.shape[-1]
+        lnB = jax.lax.lgamma(a).sum(-1) - jax.lax.lgamma(a0)
+        dg = jax.lax.digamma
+        return _wrap(lnB + (a0 - K) * dg(a0) - ((a - 1) * dg(a)).sum(-1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Dirichlet):
+            a, b = self.concentration, other.concentration
+            a0 = a.sum(-1, keepdims=True)
+            dg = jax.lax.digamma
+            t = ((a - b) * (dg(a) - dg(a0))).sum(-1)
+            return _wrap(t + jax.lax.lgamma(b).sum(-1)
+                         - jax.lax.lgamma(a).sum(-1)
+                         + jax.lax.lgamma(a0[..., 0])
+                         - jax.lax.lgamma(b.sum(-1)))
+        return super().kl_divergence(other)

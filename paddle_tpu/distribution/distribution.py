@@ -1,0 +1,131 @@
+"""Distribution base (reference: distribution/distribution.py Distribution,
+exponential_family.py ExponentialFamily)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..tensor import Tensor, to_tensor
+
+
+def _v(x):
+    """Raw jnp value of a Tensor/array/scalar."""
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+def _fv(x):
+    """Float raw value (ints promoted to default float dtype)."""
+    r = _v(x)
+    if not jnp.issubdtype(r.dtype, jnp.floating):
+        r = r.astype(jnp.float32)
+    return r
+
+
+def _wrap(x):
+    return Tensor(x)
+
+
+def _key():
+    return framework.next_rng_key()
+
+
+def _shape(sample_shape) -> tuple:
+    if sample_shape is None:
+        return ()
+    if isinstance(sample_shape, (int, np.integer)):
+        return (int(sample_shape),)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt(_v(self.variance)))
+
+    def sample(self, shape=()):
+        """Non-differentiable draw (stops gradients)."""
+        return _wrap(jax.lax.stop_gradient(_v(self.rsample(shape))))
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _wrap(jnp.exp(_v(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        # base case: no pairwise formula on the class — kl.kl_divergence (the
+        # registry entry point) is responsible for dispatch, so raising here
+        # keeps method-super() chains from recursing back into it
+        raise NotImplementedError(
+            f"no KL formula between {type(self).__name__} and "
+            f"{type(other).__name__}; use distribution.register_kl")
+
+    def _extend_shape(self, sample_shape):
+        return _shape(sample_shape) + self._batch_shape + self._event_shape
+
+
+class ExponentialFamily(Distribution):
+    """Reference exponential_family.py: entropy via the Bregman divergence of
+    the log-normalizer.  Subclasses define natural params + log_normalizer;
+    here entropy is computed with autodiff on _log_normalizer when a subclass
+    provides it (same trick as the reference's _entropy)."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    @property
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        nparams = tuple(jnp.asarray(p) for p in self._natural_parameters)
+        lg = self._log_normalizer(*nparams)  # elementwise over batch
+        # d(log_normalizer)/d(natural params), elementwise via grad-of-sum
+        grads = jax.grad(lambda ps: jnp.sum(self._log_normalizer(*ps)))(nparams)
+        ent = lg - self._mean_carrier_measure
+        for p, g in zip(nparams, grads):
+            ent = ent - p * g
+        return _wrap(ent)

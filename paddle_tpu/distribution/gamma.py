@@ -1,0 +1,97 @@
+"""Gamma / Chi2 / Exponential (reference: distribution/gamma.py, chi2.py,
+exponential.py).  jax.random.gamma is pathwise-differentiable (implicit
+reparameterization), so rsample is a true rsample."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _fv, _key, _shape, _v, _wrap
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _fv(concentration)
+        self.rate = _fv(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.concentration / self.rate,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.concentration / self.rate ** 2,
+                                      self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        g = jax.random.gamma(_key(), jnp.broadcast_to(self.concentration, shp))
+        return _wrap(g / self.rate)
+
+    def log_prob(self, value):
+        v = _fv(value)
+        a, b = self.concentration, self.rate
+        return _wrap(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                     - jax.lax.lgamma(a))
+
+    def entropy(self):
+        a, b = jnp.broadcast_to(self.concentration, self.batch_shape), \
+            jnp.broadcast_to(self.rate, self.batch_shape)
+        return _wrap(a - jnp.log(b) + jax.lax.lgamma(a)
+                     + (1 - a) * jax.lax.digamma(a))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Gamma):
+            a1, b1 = self.concentration, self.rate
+            a2, b2 = other.concentration, other.rate
+            return _wrap((a1 - a2) * jax.lax.digamma(a1)
+                         - jax.lax.lgamma(a1) + jax.lax.lgamma(a2)
+                         + a2 * (jnp.log(b1) - jnp.log(b2))
+                         + a1 * (b2 - b1) / b1)
+        return super().kl_divergence(other)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _fv(df)
+        self.df = df
+        super().__init__(df / 2, jnp.full_like(df, 0.5))
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _fv(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(1 / self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(1 / self.rate ** 2)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp, self.rate.dtype, 1e-9, 1.0)
+        return _wrap(-jnp.log(u) / self.rate)
+
+    def log_prob(self, value):
+        v = _fv(value)
+        return _wrap(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return _wrap(1 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        return _wrap(-jnp.expm1(-self.rate * _fv(value)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Exponential):
+            r = self.rate / other.rate
+            return _wrap(jnp.log(r) + other.rate / self.rate - 1)
+        return super().kl_divergence(other)

@@ -1,0 +1,57 @@
+"""Geometric (reference: distribution/geometric.py — support {0, 1, 2, ...},
+number of failures before first success)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _fv(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return _wrap((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return _wrap((1 - self.probs) / self.probs ** 2)
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.sqrt((1 - self.probs)) / self.probs)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp, self.probs.dtype, 1e-9, 1.0)
+        return _wrap(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    rsample = sample  # discrete: no pathwise gradient (reference also samples)
+
+    def log_prob(self, value):
+        v = _fv(value)
+        return _wrap(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    def pmf(self, k):
+        return _wrap(jnp.exp(self.log_prob(k)._data))
+
+    def entropy(self):
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        q = 1 - p
+        return _wrap(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+    def cdf(self, value):
+        v = _fv(value)
+        return _wrap(1 - jnp.power(1 - self.probs, jnp.floor(v) + 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Geometric):
+            p, q = self.probs, other.probs
+            return _wrap(jnp.log(p / q)
+                         + (1 - p) / p * jnp.log((1 - p) / (1 - q)))
+        return super().kl_divergence(other)

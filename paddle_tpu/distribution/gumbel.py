@@ -1,0 +1,47 @@
+"""Gumbel (reference: distribution/gumbel.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+_EULER = 0.57721566490153286060
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _fv(loc)
+        self.scale = _fv(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc + self.scale * _EULER,
+                                      self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            (math.pi ** 2 / 6) * self.scale ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        g = jax.random.gumbel(_key(), shp, self.loc.dtype)
+        return _wrap(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        v = _fv(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(-z - jnp.exp(-z) - jnp.log(self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(jnp.log(self.scale) + 1 + _EULER,
+                                      self.batch_shape))
+
+    def cdf(self, value):
+        z = (_fv(value) - self.loc) / self.scale
+        return _wrap(jnp.exp(-jnp.exp(-z)))

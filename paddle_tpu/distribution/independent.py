@@ -1,0 +1,46 @@
+"""Independent (reference: distribution/independent.py) — reinterprets batch
+dims as event dims (log_prob sums over them)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _v, _wrap
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        if self._rank > len(base.batch_shape):
+            raise ValueError("reinterpreted rank exceeds base batch rank")
+        shape = base.batch_shape + base.event_shape
+        split = len(base.batch_shape) - self._rank
+        super().__init__(shape[:split],
+                         shape[split:])
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _v(self.base.log_prob(value))
+        if self._rank:
+            lp = lp.sum(tuple(range(-self._rank, 0)))
+        return _wrap(lp)
+
+    def entropy(self):
+        e = _v(self.base.entropy())
+        if self._rank:
+            e = e.sum(tuple(range(-self._rank, 0)))
+        return _wrap(e)

@@ -1,0 +1,40 @@
+"""kl_divergence / register_kl (reference: distribution/kl.py — dispatch table
+with MRO-aware lookup)."""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple, Type
+
+from .distribution import Distribution
+
+_KL_REGISTRY: Dict[Tuple[type, type], Callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator: register a KL implementation for (p_cls, q_cls)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def _lookup(p_cls, q_cls):
+    best = None
+    best_score = None
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if issubclass(p_cls, pc) and issubclass(q_cls, qc):
+            score = (p_cls.__mro__.index(pc), q_cls.__mro__.index(qc))
+            if best_score is None or score < best_score:
+                best, best_score = fn, score
+    return best
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """KL(p || q).  Tries the registry, then p's own kl_divergence override
+    (whose super() chain ends in Distribution raising NotImplementedError)."""
+    fn = _lookup(type(p), type(q))
+    if fn is not None:
+        return fn(p, q)
+    return type(p).kl_divergence(p, q)

@@ -1,0 +1,66 @@
+"""Laplace (reference: distribution/laplace.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _fv(loc)
+        self.scale = _fv(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(2 * self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _wrap(jnp.broadcast_to(math.sqrt(2) * self.scale,
+                                      self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp, self.loc.dtype, -0.5 + 1e-7,
+                               0.5 - 1e-7)
+        return _wrap(self.loc - self.scale * jnp.sign(u)
+                     * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        return _wrap(-jnp.abs(v - self.loc) / self.scale
+                     - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _wrap(jnp.broadcast_to(1 + jnp.log(2 * self.scale),
+                                      self.batch_shape))
+
+    def cdf(self, value):
+        v = _fv(value)
+        z = (v - self.loc) / self.scale
+        return _wrap(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        v = _fv(value) - 0.5
+        return _wrap(self.loc - self.scale * jnp.sign(v)
+                     * jnp.log1p(-2 * jnp.abs(v)))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Laplace):
+            # log(b2/b1) + |u1-u2|/b2 + (b1/b2) e^{-|u1-u2|/b1} - 1
+            d = jnp.abs(self.loc - other.loc)
+            return _wrap(jnp.log(other.scale / self.scale)
+                         + d / other.scale
+                         + (self.scale / other.scale) * jnp.exp(-d / self.scale)
+                         - 1)
+        return super().kl_divergence(other)

@@ -1,0 +1,88 @@
+"""MultivariateNormal (reference: distribution/multivariate_normal.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _fv(loc)
+        given = sum(x is not None for x in
+                    (covariance_matrix, precision_matrix, scale_tril))
+        if given != 1:
+            raise ValueError("pass exactly one of covariance_matrix/"
+                             "precision_matrix/scale_tril")
+        if scale_tril is not None:
+            self._tril = _fv(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_fv(covariance_matrix))
+        else:
+            prec = _fv(precision_matrix)
+            self._tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        super().__init__(jnp.broadcast_shapes(
+            self.loc.shape[:-1], self._tril.shape[:-2]), self.loc.shape[-1:])
+
+    @property
+    def scale_tril(self):
+        return _wrap(self._tril)
+
+    @property
+    def covariance_matrix(self):
+        return _wrap(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc,
+                                      self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(
+            jnp.sum(self._tril ** 2, -1),
+            self.batch_shape + self.event_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(_key(), shp, self.loc.dtype)
+        return _wrap(self.loc + jnp.einsum("...ij,...j->...i", self._tril, eps))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        d = v - self.loc
+        # solve L y = d
+        y = jax.scipy.linalg.solve_triangular(self._tril, d[..., None],
+                                              lower=True)[..., 0]
+        k = self.loc.shape[-1]
+        half_logdet = jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                           axis2=-1)).sum(-1)
+        return _wrap(-0.5 * (y ** 2).sum(-1) - half_logdet
+                     - 0.5 * k * math.log(2 * math.pi))
+
+    def entropy(self):
+        k = self.loc.shape[-1]
+        half_logdet = jnp.log(jnp.diagonal(self._tril, axis1=-2,
+                                           axis2=-1)).sum(-1)
+        e = 0.5 * k * (1 + math.log(2 * math.pi)) + half_logdet
+        return _wrap(jnp.broadcast_to(e, self.batch_shape))
+
+    def kl_divergence(self, other):
+        if isinstance(other, MultivariateNormal):
+            k = self.loc.shape[-1]
+            L1, L2 = self._tril, other._tril
+            M = jax.scipy.linalg.solve_triangular(L2, L1, lower=True)
+            tr = (M ** 2).sum((-2, -1))
+            d = other.loc - self.loc
+            y = jax.scipy.linalg.solve_triangular(L2, d[..., None],
+                                                  lower=True)[..., 0]
+            maha = (y ** 2).sum(-1)
+            ld1 = jnp.log(jnp.diagonal(L1, axis1=-2, axis2=-1)).sum(-1)
+            ld2 = jnp.log(jnp.diagonal(L2, axis1=-2, axis2=-1)).sum(-1)
+            return _wrap(0.5 * (tr + maha - k) + ld2 - ld1)
+        return super().kl_divergence(other)

@@ -1,0 +1,86 @@
+"""Normal / LogNormal (reference: distribution/normal.py, lognormal.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _v, _wrap
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _fv(loc)
+        self.scale = _fv(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _wrap(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(_key(), shp, self.loc.dtype)
+        return _wrap(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _fv(value)
+        var = self.scale ** 2
+        return _wrap(-((v - self.loc) ** 2) / (2 * var)
+                     - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return _wrap(jnp.broadcast_to(e, self.batch_shape))
+
+    def cdf(self, value):
+        v = _fv(value)
+        return _wrap(0.5 * (1 + jax.lax.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+    def icdf(self, value):
+        v = _fv(value)
+        return _wrap(self.loc + self.scale * math.sqrt(2)
+                     * jax.lax.erf_inv(2 * v - 1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Normal):
+            var_ratio = (self.scale / other.scale) ** 2
+            t1 = ((self.loc - other.loc) / other.scale) ** 2
+            return _wrap(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+        return super().kl_divergence(other)
+
+
+class LogNormal(Distribution):
+    """exp(Normal(loc, scale)) — reference lognormal.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.base = Normal(loc, scale)
+        self.loc = self.base.loc
+        self.scale = self.base.scale
+        super().__init__(self.base.batch_shape)
+
+    @property
+    def mean(self):
+        return _wrap(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+    @property
+    def variance(self):
+        s2 = self.scale ** 2
+        return _wrap((jnp.exp(s2) - 1) * jnp.exp(2 * self.loc + s2))
+
+    def rsample(self, shape=()):
+        return _wrap(jnp.exp(_v(self.base.rsample(shape))))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        return _wrap(_v(self.base.log_prob(jnp.log(v))) - jnp.log(v))
+
+    def entropy(self):
+        return _wrap(_v(self.base.entropy()) + self.loc)

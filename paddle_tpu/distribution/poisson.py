@@ -1,0 +1,50 @@
+"""Poisson (reference: distribution/poisson.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _fv, _key, _shape, _v, _wrap
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate, name=None):
+        self.rate = _fv(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return _wrap(self.rate)
+
+    @property
+    def variance(self):
+        return _wrap(self.rate)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return _wrap(jax.random.poisson(_key(), self.rate, shp)
+                     .astype(self.rate.dtype))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _fv(value)
+        return _wrap(v * jnp.log(self.rate) - self.rate
+                     - jax.lax.lgamma(v + 1))
+
+    def entropy(self):
+        # series approximation like the reference (exact for moderate rate via
+        # summation over support up to a cutoff)
+        kmax = 64
+        k = jnp.arange(kmax, dtype=self.rate.dtype)
+        r = self.rate[..., None]
+        logp = k * jnp.log(r) - r - jax.lax.lgamma(k + 1)
+        p = jnp.exp(logp)
+        return _wrap(-(p * logp).sum(-1))
+
+    def kl_divergence(self, other):
+        if isinstance(other, Poisson):
+            r1, r2 = self.rate, other.rate
+            return _wrap(r1 * jnp.log(r1 / r2) - r1 + r2)
+        return super().kl_divergence(other)

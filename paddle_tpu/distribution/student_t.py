@@ -1,0 +1,58 @@
+"""StudentT (reference: distribution/student_t.py)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _key, _shape, _wrap
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _fv(df)
+        self.loc = _fv(loc)
+        self.scale = _fv(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _wrap(jnp.where(self.df > 1,
+                               jnp.broadcast_to(self.loc, self.batch_shape),
+                               jnp.nan))
+
+    @property
+    def variance(self):
+        v = jnp.where(self.df > 2,
+                      self.scale ** 2 * self.df / (self.df - 2), jnp.inf)
+        return _wrap(jnp.broadcast_to(jnp.where(self.df > 1, v, jnp.nan),
+                                      self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        z = jax.random.normal(_key(), shp, self.loc.dtype)
+        g = jax.random.gamma(_key(), jnp.broadcast_to(self.df / 2, shp)) * 2
+        return _wrap(self.loc + self.scale * z * jnp.sqrt(self.df / g))
+
+    def log_prob(self, value):
+        v = _fv(value)
+        d = self.df
+        z = (v - self.loc) / self.scale
+        lg = jax.lax.lgamma
+        return _wrap(lg((d + 1) / 2) - lg(d / 2)
+                     - 0.5 * jnp.log(d * math.pi) - jnp.log(self.scale)
+                     - (d + 1) / 2 * jnp.log1p(z ** 2 / d))
+
+    def entropy(self):
+        d = jnp.broadcast_to(self.df, self.batch_shape)
+        s = jnp.broadcast_to(self.scale, self.batch_shape)
+        lg, dg = jax.lax.lgamma, jax.lax.digamma
+        return _wrap((d + 1) / 2 * (dg((d + 1) / 2) - dg(d / 2))
+                     + 0.5 * jnp.log(d) + _lbeta(d / 2, 0.5) + jnp.log(s))
+
+
+def _lbeta(a, b):
+    return (jax.lax.lgamma(a) + jax.lax.lgamma(b) - jax.lax.lgamma(a + b))

@@ -1,0 +1,309 @@
+"""Transforms (reference: distribution/transform.py — 13 Transform classes
+with forward/inverse/log_det_jacobian).
+
+TPU-native: forward_log_det_jacobian uses jax.jacfwd-free closed forms; every
+transform is a pure jnp function pair."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .distribution import _fv, _v, _wrap
+
+__all__ = ["Type", "Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform"]
+
+
+class Type:
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+    # how many rightmost dims the jacobian acts on (0 = elementwise)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+
+    def forward(self, x):
+        return _wrap(self._forward(_fv(x)))
+
+    def inverse(self, y):
+        return _wrap(self._inverse(_fv(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _wrap(self._forward_log_det_jacobian(_fv(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        yv = _fv(y)
+        return _wrap(-self._forward_log_det_jacobian(self._inverse(yv)))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # right-inverse (positive branch), like the reference
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _fv(loc)
+        self.scale = _fv(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _fv(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(jnp.clip(y, -1 + 1e-7, 1 - 1e-7))
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        return jnp.log(y)  # up to an additive constant (reference semantics)
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zpad = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1)
+        one_minus = jnp.concatenate([jnp.ones_like(z[..., :1]), 1 - z], -1)
+        return zpad * jnp.cumprod(one_minus, -1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        rem = 1 - jnp.cumsum(y_crop, -1)
+        rem_shift = jnp.concatenate(
+            [jnp.ones_like(rem[..., :1]), rem[..., :-1]], -1)
+        z = y_crop / jnp.clip(rem_shift, 1e-30, None)
+        offset = y.shape[-1] - 1 - jnp.arange(y.shape[-1] - 1, dtype=y.dtype)
+        return jnp.log(z / jnp.clip(1 - z, 1e-30, None)) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        one_minus = jnp.concatenate([jnp.ones_like(z[..., :1]), 1 - z[..., :-1]], -1)
+        rem = jnp.cumprod(one_minus, -1)
+        return (jnp.log(z) + jnp.log1p(-z) + jnp.log(rem)).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if int(np.prod(self.in_event_shape)) != int(np.prod(self.out_event_shape)):
+            raise ValueError("element count mismatch")
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self._rank = int(reinterpreted_batch_rank)
+        self._domain_event_rank = base._domain_event_rank + self._rank
+        self._codomain_event_rank = base._codomain_event_rank + self._rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return ld.sum(tuple(range(-self._rank, 0))) if self._rank else ld
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._domain_event_rank = max(
+            [t._domain_event_rank for t in self.transforms] + [0])
+        self._codomain_event_rank = max(
+            [t._codomain_event_rank for t in self.transforms] + [0])
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        # each step's jacobian is summed down to this chain's domain event
+        # rank so mixed-rank chains (elementwise + simplex ops) add scalars
+        # to scalars instead of broadcasting
+        ld = None
+        for t in self.transforms:
+            step = t._forward_log_det_jacobian(x)
+            extra = self._domain_event_rank - t._domain_event_rank
+            if extra > 0:
+                step = step.sum(tuple(range(-extra, 0)))
+            ld = step if ld is None else ld + step
+            x = t._forward(x)
+        return ld
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Apply a different transform along `axis` slices (reference StackTransform)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = axis
+
+    def _map(self, fn_name, x):
+        parts = []
+        n = len(self.transforms)
+        for i, t in enumerate(self.transforms):
+            sl = jnp.take(x, i, axis=self.axis)
+            parts.append(getattr(t, fn_name)(sl))
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)

@@ -1,0 +1,53 @@
+"""TransformedDistribution (reference: distribution/transformed_distribution.py)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _fv, _v, _wrap
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        base_event = base.batch_shape + base.event_shape
+        out_shape = self._chain.forward_shape(base_event)
+        # event rank grows to cover the chain's codomain event rank
+        ev = max(len(base.event_shape), self._chain._codomain_event_rank)
+        super().__init__(out_shape[:len(out_shape) - ev],
+                         out_shape[len(out_shape) - ev:])
+
+    def sample(self, shape=()):
+        x = _v(self.base.sample(shape))
+        return _wrap(self._chain._forward(x))
+
+    def rsample(self, shape=()):
+        x = _v(self.base.rsample(shape))
+        return _wrap(self._chain._forward(x))
+
+    def log_prob(self, value):
+        # reverse sweep with event-rank bookkeeping (the standard
+        # change-of-variables algorithm: each jacobian is summed down to the
+        # event rank it acts within, then the base log_prob is summed over any
+        # dims the transforms reinterpreted as event dims)
+        def sum_rightmost(a, n):
+            return a.sum(tuple(range(-n, 0))) if n > 0 else a
+
+        y = _fv(value)
+        event_rank = len(self.event_shape)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            event_rank += t._domain_event_rank - t._codomain_event_rank
+            ld = t._forward_log_det_jacobian(x)
+            lp = lp - sum_rightmost(ld, event_rank - t._domain_event_rank)
+            y = x
+        base_lp = _v(self.base.log_prob(y))
+        lp = lp + sum_rightmost(base_lp,
+                                event_rank - len(self.base.event_shape))
+        return _wrap(lp)

@@ -106,6 +106,7 @@ class EarlyStopping(Callback):
         self.patience = patience
         self.min_delta = abs(min_delta)
         self.baseline = baseline
+        self.save_best_model = save_best_model
         self.wait = 0
         if mode == "max" or (mode == "auto" and "acc" in monitor):
             self.better = lambda new, best: new > best + self.min_delta
@@ -130,6 +131,9 @@ class EarlyStopping(Callback):
         if self.better(v, self.best):
             self.best = v
             self.wait = 0
+            save_dir = getattr(self.model, "_save_dir", None)
+            if self.save_best_model and save_dir:
+                self.model.save(os.path.join(save_dir, "best_model"))
         else:
             self.wait += 1
             if self.wait > self.patience:

@@ -35,6 +35,7 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics: List[Metric] = []
+        self._save_dir = None
         self.stop_training = False
 
     # -- setup -------------------------------------------------------------
@@ -121,6 +122,7 @@ class Model:
         cblist = cbs.CallbackList(_to_list(callbacks) or
                                   ([cbs.ProgBarLogger(log_freq, verbose)]))
         cblist.set_model(self)
+        self._save_dir = save_dir  # callbacks (EarlyStopping best-model) use it
         cblist.on_train_begin()
         history = {"loss": []}
         step_count = 0
@@ -146,6 +148,12 @@ class Model:
                 step_count += 1
                 if num_iters is not None and step_count >= num_iters:
                     break
+            # flush a trailing partial accumulation so its gradients neither
+            # leak into the next epoch nor get dropped at train end
+            if accumulate_grad_batches > 1 and \
+                    (step + 1) % accumulate_grad_batches != 0:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
             epoch_logs = dict(logs)
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
                 eval_res = self.evaluate(eval_loader, verbose=0)
@@ -177,7 +185,10 @@ class Model:
             loss_vals = out[0] if isinstance(out, tuple) else out
             if loss_vals:
                 losses.append(loss_vals[0])
-            seen += batch_size
+            # count actual samples (loader batch size may differ from the arg)
+            first = xs[0] if xs else None
+            seen += (len(first) if first is not None and hasattr(first, "__len__")
+                     else batch_size)
             if num_samples is not None and seen >= num_samples:
                 break
         res = {}

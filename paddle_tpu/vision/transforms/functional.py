@@ -177,7 +177,9 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     # numpy path: inverse-map rotation (nearest or bilinear), optional expand
     arr = _to_np(img)
     H, W = arr.shape[:2]
-    cy, cx = ((H - 1) / 2, (W - 1) / 2) if center is None else center
+    # center follows the PIL (x, y) convention on both paths
+    cy, cx = ((H - 1) / 2, (W - 1) / 2) if center is None else \
+        (center[1], center[0])
     th = np.deg2rad(angle)
     if expand:
         # epsilon guards fp fuzz (cos(90 deg) ~ 6e-17 would bump ceil by 1)
