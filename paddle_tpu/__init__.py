@@ -44,6 +44,8 @@ from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
 from . import hapi  # noqa: E402
 from . import distribution  # noqa: E402
+from . import static  # noqa: E402
+from . import profiler  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi.summary import summary  # noqa: E402
 
@@ -59,10 +61,10 @@ def disable_static(place=None):
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dynamic-first; use paddle_tpu.jit.to_static for compiled "
-        "execution (XLA plays the static-graph executor's role)"
-    )
+    """Static-graph mode: build programs under static.program_guard.  The
+    eager API keeps working (capture rides on op dispatch), so this toggles
+    nothing globally — kept for source compatibility."""
+    return None
 
 
 def in_dynamic_mode():

@@ -107,6 +107,9 @@ class _GlobalState(threading.local):
         # the global generator, so dropout masks are fresh per compiled step.
         self.trace_key = None
         self.trace_key_count = 0
+        # When set (by static.program_guard), tensor.apply_op records every op
+        # into this Program so Executor.run can replay it under one jit.
+        self.capture_program = None
         self.flags = {
             "FLAGS_check_nan_inf": bool(int(os.environ.get("FLAGS_check_nan_inf", "0"))),
             "FLAGS_cudnn_deterministic": False,

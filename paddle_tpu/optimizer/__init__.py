@@ -210,6 +210,14 @@ class Optimizer:
 
     # paddle API compat
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from .. import framework as _fw
+
+        cap = _fw.get_state().capture_program
+        if cap is not None:
+            # static-graph mode: register the train target; Executor.run
+            # computes grads by jax.grad over the replayed program
+            cap._mark_train(self, loss)
+            return None, None
         loss.backward()
         self.step()
         return None, None

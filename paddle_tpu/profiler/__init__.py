@@ -1,0 +1,255 @@
+"""paddle.profiler parity (reference: python/paddle/profiler/profiler.py:349
+Profiler with CLOSED/READY/RECORD/RECORD_AND_RETURN scheduler states,
+RecordEvent spans, export_chrome_tracing, profiler_statistic summaries,
+timer.py ips benchmark; SURVEY.md C40).
+
+TPU-native: device tracing is jax.profiler (XPlane -> TensorBoard/Perfetto),
+host spans are jax.profiler.TraceAnnotation + a light host-event recorder that
+feeds the chrome-trace exporter and the summary table.  CUPTI's role is played
+by XLA's built-in instrumentation — nothing to dynload.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import enum
+import json
+import os
+import threading
+import time
+from typing import Callable, List, Optional
+
+from .timer import Timer  # noqa: F401
+
+_global_timer = Timer()
+
+from . import utils  # noqa: E402,F401
+from .utils import RecordEvent, benchmark  # noqa: E402,F401
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    XPU = 2
+    CUSTOM_DEVICE = 3
+    TPU = 4
+
+
+class SortedKeys(enum.Enum):
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Reference make_scheduler: step_num -> state machine."""
+    cycle = closed + ready + record
+
+    def fn(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return fn
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready handler writing chrome://tracing JSON."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.json")
+        prof._export_chrome(path)
+        prof._last_export = path
+
+    return handler
+
+
+def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
+    # the TPU-native "protobuf" is the XPlane dump jax.profiler writes
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        prof._last_export = dir_name
+
+    return handler
+
+
+class Profiler:
+    """Scheduler-driven profiler (profiler.py:349).
+
+    targets are advisory (XLA traces whatever backend runs); `timer_only=True`
+    reproduces the lightweight ips benchmark mode."""
+
+    def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        if scheduler is None:
+            self._scheduler = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, (tuple, list)):
+            start, end = scheduler
+            self._scheduler = (lambda step: ProfilerState.RECORD_AND_RETURN
+                               if step == end - 1 else (
+                                   ProfilerState.RECORD
+                                   if start <= step < end
+                                   else ProfilerState.CLOSED))
+        else:
+            self._scheduler = scheduler
+        self._on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._step = 0
+        self._state = ProfilerState.CLOSED
+        self._jax_tracing = False
+        self._tmpdir = None
+        self._last_export = None
+        self.timer = Timer()
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        self.timer.begin()
+        self._transition(self._scheduler(self._step))
+        return self
+
+    def stop(self):
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            self._stop_record()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        self.timer.step(num_samples)
+        from .utils import _host_events
+
+        _host_events.step_mark(self._step)
+        prev = self._state
+        self._step += 1
+        new = self._scheduler(self._step)
+        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
+                (prev is ProfilerState.RECORD_AND_RETURN
+                 or new is ProfilerState.CLOSED):
+            self._stop_record()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+        self._transition(new)
+
+    def _transition(self, state: ProfilerState):
+        if state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
+                and not self._jax_tracing and not self.timer_only:
+            self._start_record()
+        self._state = state
+
+    def _start_record(self):
+        from .utils import _host_events
+
+        _host_events.clear()
+        _host_events.enable()
+        if self._jax_tracing:
+            return
+        try:
+            import tempfile
+
+            import jax
+
+            self._tmpdir = tempfile.mkdtemp(prefix="paddle_tpu_prof_")
+            jax.profiler.start_trace(self._tmpdir)
+            self._jax_tracing = True
+        except Exception:  # noqa: BLE001 — host events still collected
+            self._jax_tracing = False
+
+    def _stop_record(self):
+        from .utils import _host_events
+
+        _host_events.disable()
+        if self._jax_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # noqa: BLE001
+                pass
+            self._jax_tracing = False
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- export / summary --------------------------------------------------
+    def _export_chrome(self, path):
+        from .utils import _host_events
+
+        events = [{
+            "name": e.name, "ph": "X", "cat": "host",
+            "ts": e.t0 * 1e6, "dur": (e.t1 - e.t0) * 1e6,
+            "pid": os.getpid(), "tid": e.tid,
+        } for e in _host_events.events]
+        with open(path, "w") as f:
+            json.dump({"traceEvents": events,
+                       "note": ("device timeline lives in the jax.profiler "
+                                "XPlane dump"),
+                       "xplane_dir": self._tmpdir}, f)
+        return path
+
+    def export(self, path, format="json"):
+        return self._export_chrome(path)
+
+    def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
+                thread_sep=False, time_unit="ms"):
+        """Aggregated host-span table (profiler_statistic.py analog)."""
+        from .utils import _host_events
+
+        agg = {}
+        for e in _host_events.events:
+            a = agg.setdefault(e.name, [0.0, 0, 0.0, float("inf")])
+            dur = (e.t1 - e.t0) * 1e3
+            a[0] += dur
+            a[1] += 1
+            a[2] = max(a[2], dur)
+            a[3] = min(a[3], dur)
+        rows = sorted(agg.items(), key=lambda kv: -kv[1][0])
+        unit = {"ms": 1.0, "us": 1e3, "s": 1e-3}[time_unit]
+        lines = [f"{'Name':40}  {'Calls':>6}  {'Total(' + time_unit + ')':>12}"
+                 f"  {'Avg':>10}  {'Max':>10}  {'Min':>10}"]
+        for name, (tot, n, mx, mn) in rows:
+            lines.append(f"{name[:40]:40}  {n:>6}  {tot * unit:>12.3f}"
+                         f"  {tot / n * unit:>10.3f}  {mx * unit:>10.3f}"
+                         f"  {mn * unit:>10.3f}")
+        table = "\n".join(lines)
+        print(table)
+        return table
+
+
+def profiler_pure(*a, **k):  # pragma: no cover — reference-internal helper
+    raise NotImplementedError
+
+
+def load_profiler_result(filename: str):
+    with open(filename) as f:
+        return json.load(f)

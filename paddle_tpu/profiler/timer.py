@@ -1,0 +1,72 @@
+"""Throughput timer (reference: profiler/timer.py — ips/step statistics
+driving the `benchmark()` API)."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+
+class _Stat:
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self.total = 0.0
+        self.count = 0
+        self.samples = 0
+        self._max = 0.0
+        self._min = float("inf")
+
+    def update(self, dt: float, samples: Optional[int]):
+        self.total += dt
+        self.count += 1
+        if samples:
+            self.samples += samples
+        self._max = max(self._max, dt)
+        self._min = min(self._min, dt)
+
+    @property
+    def avg(self):
+        return self.total / max(self.count, 1)
+
+    @property
+    def ips(self):
+        if self.total <= 0:
+            return 0.0
+        base = self.samples if self.samples else self.count
+        return base / self.total
+
+
+class Timer:
+    def __init__(self):
+        self.reader_cost = _Stat()
+        self.batch_cost = _Stat()
+        self._last = None
+        self._reader_t0 = None
+
+    def begin(self):
+        self._last = time.perf_counter()
+
+    def before_reader(self):
+        self._reader_t0 = time.perf_counter()
+
+    def after_reader(self):
+        if self._reader_t0 is not None:
+            self.reader_cost.update(time.perf_counter() - self._reader_t0, None)
+            self._reader_t0 = None
+
+    def step(self, num_samples: Optional[int] = None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self.batch_cost.update(now - self._last, num_samples)
+        self._last = now
+
+    def step_info(self, unit="samples"):
+        bc = self.batch_cost
+        return (f"avg batch_cost {bc.avg * 1e3:.2f} ms, "
+                f"ips {bc.ips:.2f} {unit}/s")
+
+    @property
+    def ips(self):
+        return self.batch_cost.ips

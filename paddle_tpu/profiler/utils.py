@@ -1,0 +1,114 @@
+"""RecordEvent + host event recorder (reference: profiler/utils.py:38
+RecordEvent over C++ HostEventRecorder; here a lock-light in-process list +
+jax.named_scope so spans also land inside the XLA trace)."""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import threading
+import time
+from typing import List, Optional
+
+
+class _HostEvent:
+    __slots__ = ("name", "t0", "t1", "tid")
+
+    def __init__(self, name, t0, t1, tid):
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+        self.tid = tid
+
+
+class _HostEventRecorder:
+    def __init__(self):
+        self.events: List[_HostEvent] = []
+        self._enabled = False
+        self._lock = threading.Lock()
+
+    def enable(self):
+        self._enabled = True
+
+    def disable(self):
+        self._enabled = False
+
+    def clear(self):
+        with self._lock:
+            self.events = []
+
+    def add(self, name, t0, t1):
+        if self._enabled:
+            with self._lock:
+                self.events.append(_HostEvent(name, t0, t1,
+                                              threading.get_ident()))
+
+    def step_mark(self, step):
+        self.add(f"ProfileStep#{step}", time.perf_counter(),
+                 time.perf_counter())
+
+
+_host_events = _HostEventRecorder()
+
+
+class RecordEvent:
+    """Context manager / decorator marking a host span (utils.py:38).
+
+    Inside jit traces it degrades to jax.named_scope so the span name shows
+    up in the XLA HLO metadata and the device profile."""
+
+    def __init__(self, name: str, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._scope = None
+
+    def begin(self):
+        self._t0 = time.perf_counter()
+        try:
+            import jax
+
+            self._scope = jax.named_scope(self.name)
+            self._scope.__enter__()
+        except Exception:  # noqa: BLE001
+            self._scope = None
+
+    def end(self):
+        if self._scope is not None:
+            self._scope.__exit__(None, None, None)
+            self._scope = None
+        if self._t0 is not None:
+            _host_events.add(self.name, self._t0, time.perf_counter())
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def __call__(self, fn):
+        @functools.wraps(fn)
+        def wrapped(*a, **k):
+            with RecordEvent(self.name):
+                return fn(*a, **k)
+
+        return wrapped
+
+
+@contextlib.contextmanager
+def record_function(name):
+    with RecordEvent(name):
+        yield
+
+
+def benchmark():
+    """Reference utils.benchmark() — returns the global step Timer."""
+    from . import _global_timer
+
+    return _global_timer
+
+
+def wrap_optimizers():  # pragma: no cover — reference hooks optimizer classes
+    return None

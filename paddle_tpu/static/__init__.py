@@ -1,0 +1,444 @@
+"""paddle.static parity — Program/Executor/data over XLA (SURVEY.md C14/C15).
+
+Reference architecture: ProgramDesc built op-by-op (base/framework.py:5529
+Program, :2733 Operator), executed by StandaloneExecutor/InterpreterCore
+(new_executor/standalone_executor.cc:158, program_interpreter.cc:99) with a
+per-(program, shape) instruction cache (base/executor.py:816 _ExecutorCache).
+
+TPU-native redesign: under `program_guard`, every dispatched op is RECORDED
+into the Program (tensor.apply_op capture hook) while still executing eagerly
+on sample values — graph build doubles as shape inference.  `Executor.run`
+replays the recorded op list as one pure function and hands it to `jax.jit`:
+XLA plays the roles of instruction scheduler, stream assigner, fusion pass
+and GC all at once.  Cached per (program, feed shapes/dtypes) exactly like
+_ExecutorCache.  `minimize` captures (optimizer, loss); run() then computes
+grads with jax.grad over the SAME replayed function — the static backward
+pass is autodiff-on-replay, not a second recorded program.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..framework import convert_dtype, to_jax_dtype
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "Program", "program_guard", "default_main_program",
+    "default_startup_program", "data", "Executor", "InputSpec", "name_scope",
+    "save", "load", "save_inference_model", "load_inference_model",
+    "serialize_program", "deserialize_program", "cpu_places", "cuda_places",
+    "xpu_places", "global_scope", "scope_guard", "Scope",
+]
+
+from ..jit import InputSpec  # noqa: E402  (same spec type as jit)
+
+
+class _OpRecord:
+    __slots__ = ("name", "fn", "arg_specs", "kwargs", "outs")
+
+    def __init__(self, name, fn, arg_specs, kwargs, outs):
+        self.name = name
+        self.fn = fn
+        self.arg_specs = arg_specs    # list of ("v", tensor) | ("c", const)
+        self.kwargs = kwargs
+        self.outs = outs              # tuple of output Tensors (identity keys)
+
+
+class Program:
+    """A recorded op-list (the ProgramDesc analog — but ops are jax closures)."""
+
+    def __init__(self):
+        self.ops: List[_OpRecord] = []
+        self.placeholders: Dict[str, Tensor] = {}
+        self.placeholder_shapes: Dict[str, tuple] = {}  # declared (None dims kept)
+        self._train: Optional[Tuple[Any, Tensor]] = None  # (optimizer, loss)
+        self.random_seed = None
+        self._cache: Dict[Any, Any] = {}
+
+    # -- capture hook (called from tensor.apply_op) ------------------------
+    def _record(self, name, fn, args, kwargs, outs):
+        specs = []
+        for a in args:
+            if isinstance(a, Tensor):
+                specs.append(("v", a))
+            else:
+                specs.append(("c", a))
+        self.ops.append(_OpRecord(name, fn, specs, dict(kwargs), tuple(
+            o for o in outs if isinstance(o, Tensor))))
+
+    def _mark_train(self, optimizer, loss):
+        self._train = (optimizer, loss)
+        self._cache.clear()
+
+    # -- replay ------------------------------------------------------------
+    def _replay(self, feed_raws: Dict[str, Any], param_raws=None, params=None):
+        """Execute the op list purely.  env maps id(tensor) -> raw value."""
+        env: Dict[int, Any] = {}
+        ph_names = {id(t): n for n, t in self.placeholders.items()}
+        for name, ph in self.placeholders.items():
+            if name in feed_raws:
+                env[id(ph)] = feed_raws[name]
+        if params is not None:
+            for p, raw in zip(params, param_raws):
+                env[id(p)] = raw
+
+        def val(spec):
+            kind, v = spec
+            if kind == "c":
+                return v
+            i = id(v)
+            if i in env:
+                return env[i]
+            if i in ph_names:
+                # a silently-defaulted placeholder would bake its zero sample
+                # into the compiled executable as a constant
+                raise KeyError(
+                    f"feed target '{ph_names[i]}' was not fed "
+                    f"(reference: 'feed_target not found' error)")
+            return v._data  # parameter / captured constant: current value
+
+        for op in self.ops:
+            raws = [val(s) for s in op.arg_specs]
+            outs = op.fn(*raws, **op.kwargs)
+            if not isinstance(outs, (tuple, list)):
+                outs = (outs,)
+            for t, r in zip(op.outs, outs):
+                env[id(t)] = r
+        return env
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        p = Program.__new__(Program)
+        p.ops = list(self.ops)
+        p.placeholders = dict(self.placeholders)
+        p.placeholder_shapes = dict(self.placeholder_shapes)
+        p._train = None if for_test else self._train
+        p.random_seed = self.random_seed
+        p._cache = {}
+        return p
+
+    def all_parameters(self):
+        return [t for t in self._externals()
+                if getattr(t, "trainable", None) is not None]
+
+    def _externals(self):
+        """Tensors read by ops but produced outside the program (parameters,
+        buffers, captured constants).  These become jit ARGUMENTS at replay —
+        closure capture would bake them into the executable as constants and
+        silently freeze parameter updates."""
+        seen, out = set(), []
+        produced = {id(o) for op in self.ops for o in op.outs}
+        phs = {id(t) for t in self.placeholders.values()}
+        for op in self.ops:
+            for kind, v in op.arg_specs:
+                i = id(v)
+                if kind == "v" and i not in produced and i not in phs \
+                        and i not in seen:
+                    seen.add(i)
+                    out.append(v)
+        return out
+
+    def list_vars(self):
+        return list(self.placeholders.values()) + [
+            o for op in self.ops for o in op.outs]
+
+
+_default_main = Program()
+_default_startup = Program()
+_guard_stack: List[Tuple[Program, Program]] = []
+
+
+def default_main_program() -> Program:
+    return _default_main
+
+
+def default_startup_program() -> Program:
+    return _default_startup
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        global _default_main, _default_startup
+        _guard_stack.append((_default_main, _default_startup))
+        _default_main, _default_startup = self.main, self.startup
+        framework.get_state().capture_program = self.main
+        return self
+
+    def __exit__(self, *exc):
+        global _default_main, _default_startup
+        _default_main, _default_startup = _guard_stack.pop()
+        framework.get_state().capture_program = (
+            _default_main if _guard_stack else None)
+        return False
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Placeholder variable (reference: static/input.py data).  Returns a
+    sample-valued Tensor (None/-1 dims -> 1) registered as a feed target."""
+    prog = framework.get_state().capture_program or _default_main
+    concrete = tuple(1 if (d is None or d == -1) else int(d) for d in shape)
+    jdt = to_jax_dtype(convert_dtype(dtype))
+    t = Tensor(jnp.zeros(concrete, jdt), stop_gradient=True, name=name)
+    prog.placeholders[name] = t
+    prog.placeholder_shapes[name] = tuple(shape)  # keep None dims for export
+    return t
+
+
+class Scope:
+    def __init__(self):
+        self.vars = {}
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+def cpu_places(device_count=None):
+    return ["cpu"]
+
+
+def cuda_places(device_ids=None):
+    return ["gpu:0"]
+
+
+def xpu_places(device_ids=None):
+    return ["xpu:0"]
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+
+class Executor:
+    """The StandaloneExecutor analog: compiles + caches replays per program
+    and feed signature (reference executor.py:1036 Executor, :816 cache)."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, feed_var_name=None,
+            fetch_var_name=None, scope=None, return_numpy=True):
+        program = program or _default_main
+        feed = feed or {}
+        fetch_list = fetch_list or []
+        if not isinstance(fetch_list, (list, tuple)):
+            fetch_list = [fetch_list]
+        fetch_list = [self._resolve(program, f) for f in fetch_list]
+        # startup/empty programs: nothing to do (params init eagerly)
+        if not program.ops and not fetch_list:
+            return []
+
+        feed_raws = {k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                     for k, v in feed.items()}
+        sig = (tuple(sorted((k, tuple(r.shape), str(r.dtype))
+                            for k, r in feed_raws.items())),
+               tuple(id(f) for f in fetch_list))
+
+        if program._train is not None:
+            return self._run_train(program, feed_raws, fetch_list, sig,
+                                   return_numpy)
+
+        ext = program._externals()
+        compiled = program._cache.get(sig)
+        if compiled is None:
+            fetch_ids = [id(f) for f in fetch_list]
+            fetch_consts = [f._data for f in fetch_list]
+
+            def pure(feed_raws, ext_raws):
+                env = program._replay(feed_raws, ext_raws, ext)
+                return [env[i] if i in env else c
+                        for i, c in zip(fetch_ids, fetch_consts)]
+
+            compiled = jax.jit(pure)
+            program._cache[sig] = compiled
+        outs = compiled(feed_raws, [t._data for t in ext])
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    def _run_train(self, program, feed_raws, fetch_list, sig, return_numpy):
+        optimizer, loss = program._train
+        # static-mode Paddle often builds optimizers without parameters=;
+        # they come from the program itself
+        if optimizer._parameter_list is None:
+            optimizer._parameter_list = program.all_parameters()
+        params = [p for p in optimizer._parameter_list]
+        if not params:
+            raise ValueError(
+                "minimize() captured no trainable parameters — pass "
+                "parameters= to the optimizer or use nn.Layer parameters "
+                "inside the program")
+        param_ids = {id(p) for p in params}
+        other = [t for t in program._externals() if id(t) not in param_ids]
+        fetch_ids = [id(f) for f in fetch_list]
+        fetch_consts = [f._data for f in fetch_list]
+        loss_id = id(loss)
+
+        compiled = program._cache.get(sig)
+        if compiled is None:
+            def pure(feed_raws, param_raws, other_raws):
+                env = program._replay(feed_raws, list(param_raws)
+                                      + list(other_raws), params + other)
+                fetches = [env[i] if i in env else c
+                           for i, c in zip(fetch_ids, fetch_consts)]
+                return env[loss_id], fetches
+
+            # one compiled pass: loss grads + pre-update fetches (has_aux)
+            compiled = jax.jit(jax.value_and_grad(
+                lambda pr, fr, orr: pure(fr, pr, orr), has_aux=True))
+            program._cache[sig] = compiled
+
+        param_raws = [p._data for p in params]
+        other_raws = [t._data for t in other]
+        (_, outs), grads = compiled(param_raws, feed_raws, other_raws)
+        # hand grads to the eager optimizer (hybrid: compiled fwd/bwd, eager
+        # update — the reference's static optimizer ops collapse to this)
+        for p, g in zip(params, grads):
+            p.grad = Tensor(g) if p.grad is None else Tensor(
+                p.grad._data + g)
+        optimizer.step()
+        optimizer.clear_grad()
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        return [Tensor(o) for o in outs]
+
+    @staticmethod
+    def _resolve(program, f):
+        """Accept fetch-list entries by variable name (legacy idiom)."""
+        if not isinstance(f, str):
+            return f
+        if f in program.placeholders:
+            return program.placeholders[f]
+        for t in program.list_vars():
+            if getattr(t, "name", None) == f:
+                return t
+        raise ValueError(f"fetch target '{f}' not found in program")
+
+    def close(self):
+        return None
+
+
+# -- inference model save/load (reference: static/io.py) --------------------
+
+
+def serialize_program(feed_vars, fetch_vars, program=None):
+    import pickle
+
+    program = program or _default_main
+    return pickle.dumps({"n_feed": len(feed_vars), "n_fetch": len(fetch_vars)})
+
+
+def deserialize_program(data):
+    import pickle
+
+    return pickle.loads(data)
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None):
+    """StableHLO export of the replay function (reference save_inference_model
+    writes ProgramDesc+params; here the artifact is a serialized XLA export +
+    params pickle)."""
+    import os
+    import pickle
+
+    from jax import export as jax_export
+
+    program = program or _default_main
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = (fetch_vars if isinstance(fetch_vars, (list, tuple))
+                  else [fetch_vars])
+    names = [next(n for n, t in program.placeholders.items() if t is fv)
+             for fv in feed_vars]
+    fetch_ids = [id(f) for f in fetch_vars]
+
+    def pure(*arg_raws):
+        env = program._replay(dict(zip(names, arg_raws)))
+        return [env.get(i, f._data) for i, f in zip(fetch_ids, fetch_vars)]
+
+    args_abs = [jax.ShapeDtypeStruct(tuple(fv.shape),
+                                     fv._data.dtype) for fv in feed_vars]
+    exported = jax_export.export(jax.jit(pure))(*args_abs)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({"feed_names": names}, f)
+
+
+def load_inference_model(path_prefix, executor=None):
+    import pickle
+
+    from jax import export as jax_export
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+
+    class _InferProgram:
+        def __init__(self):
+            self.exported = exported
+
+    prog = _InferProgram()
+    names = meta["feed_names"]
+
+    def run(feed):
+        outs = exported.call(*[jnp.asarray(feed[n]) for n in names])
+        return [np.asarray(o) for o in outs]
+
+    prog.run = run
+    return [prog, names, None]
+
+
+def save(program, model_path, protocol=4):
+    import pickle
+
+    params = {i: np.asarray(p._data)
+              for i, p in enumerate(program.all_parameters())}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    for i, p in enumerate(program.all_parameters()):
+        if i in params:
+            p.data = jnp.asarray(params[i])

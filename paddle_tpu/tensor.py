@@ -406,7 +406,12 @@ def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), *
 
     if not need_grad:
         outs = fn(*raws, **kwargs)
-        return _wrap_outputs(outs, stop_gradient=True)
+        wrapped = _wrap_outputs(outs, stop_gradient=True)
+        cap = framework.get_state().capture_program
+        if cap is not None:
+            out_list = wrapped if isinstance(wrapped, tuple) else (wrapped,)
+            cap._record(name, fn, args, kwargs, out_list)
+        return wrapped
 
     def pure(*diff_raws):
         full = list(raws)
@@ -422,6 +427,9 @@ def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), *
         if isinstance(o, Tensor):
             o._node = node
             o._out_idx = idx
+    cap = framework.get_state().capture_program
+    if cap is not None:
+        cap._record(name, fn, args, kwargs, out_list)
     return wrapped
 
 

@@ -1,0 +1,196 @@
+"""static Program/Executor (capture-and-replay over jax.jit) + profiler.
+
+Reference patterns: test/legacy_test static-graph tests (program_guard +
+Executor.run) and profiler tests."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.static as static
+
+
+class TestStaticForward:
+    def test_data_and_run(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            y = x * 2.0 + 1.0
+        exe = static.Executor()
+        feed = {"x": np.arange(8, dtype=np.float32).reshape(2, 4)}
+        out, = exe.run(prog, feed=feed, fetch_list=[y])
+        np.testing.assert_allclose(out, feed["x"] * 2 + 1)
+
+    def test_layer_in_program(self):
+        lin = nn.Linear(4, 3)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 4], "float32")
+            out = F.softmax(lin(x))
+        exe = static.Executor()
+        feed = {"x": np.random.randn(5, 4).astype(np.float32)}
+        got, = exe.run(prog, feed=feed, fetch_list=[out])
+        assert got.shape == (5, 3)
+        np.testing.assert_allclose(got.sum(-1), 1.0, rtol=1e-5)
+        # parameter updates are visible to subsequent runs (no stale capture)
+        lin.weight.data = lin.weight.data * 0.0
+        got2, = exe.run(prog, feed=feed, fetch_list=[out])
+        np.testing.assert_allclose(got2, 1.0 / 3, rtol=1e-5)
+
+    def test_shape_cache_per_feed(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 2], "float32")
+            y = x.sum()
+        exe = static.Executor()
+        for n in (1, 3, 7):
+            out, = exe.run(prog, feed={"x": np.ones((n, 2), np.float32)},
+                           fetch_list=[y])
+            np.testing.assert_allclose(out, 2.0 * n)
+
+    def test_program_clone_for_test(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 2], "float32")
+            y = x + 1.0
+        test_prog = prog.clone(for_test=True)
+        exe = static.Executor()
+        out, = exe.run(test_prog, feed={"x": np.zeros((1, 2), np.float32)},
+                       fetch_list=[y])
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_guard_restores_state(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            assert static.default_main_program() is prog
+        assert static.default_main_program() is not prog
+        # eager ops outside the guard are not captured
+        n_ops = len(prog.ops)
+        _ = paddle.to_tensor(np.ones(2)) * 3
+        assert len(prog.ops) == n_ops
+
+
+class TestStaticTraining:
+    def test_minimize_trains_linear_regression(self):
+        lin = nn.Linear(3, 1)
+        sgd = opt.SGD(learning_rate=0.1, parameters=lin.parameters())
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [None, 3], "float32")
+            yt = static.data("y", [None, 1], "float32")
+            pred = lin(x)
+            loss = ((pred - yt) ** 2).mean()
+            sgd.minimize(loss)
+        exe = static.Executor()
+        rng = np.random.default_rng(0)
+        w_true = np.array([[1.0], [-2.0], [0.5]], np.float32)
+        losses = []
+        for _ in range(60):
+            xb = rng.normal(size=(16, 3)).astype(np.float32)
+            yb = xb @ w_true
+            lv, = exe.run(prog, feed={"x": xb, "y": yb}, fetch_list=[loss])
+            losses.append(float(lv))
+        assert losses[-1] < 0.01 * losses[0]
+        np.testing.assert_allclose(np.asarray(lin.weight.data), w_true,
+                                   atol=0.1)
+
+    def test_startup_program_noop(self):
+        exe = static.Executor()
+        assert exe.run(static.default_startup_program()) == []
+
+
+class TestInferenceModel:
+    def test_save_load_inference_model(self, tmp_path):
+        lin = nn.Linear(4, 2)
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [2, 4], "float32")
+            out = lin(x)
+        path = str(tmp_path / "infer" / "model")
+        static.save_inference_model(path, [x], [out],
+                                    program=prog)
+        assert os.path.exists(path + ".pdmodel")
+
+        loaded, feed_names, _ = static.load_inference_model(path)
+        xv = np.random.randn(2, 4).astype(np.float32)
+        got = loaded.run({"x": xv})[0]
+        ref = xv @ np.asarray(lin.weight.data) + np.asarray(lin.bias.data)
+        np.testing.assert_allclose(got, ref, rtol=1e-5)
+
+
+class TestProfiler:
+    def test_record_event_and_summary(self):
+        import paddle_tpu.profiler as profiler
+
+        prof = profiler.Profiler(timer_only=True)
+        prof.start()
+        with profiler.RecordEvent("forward"):
+            _ = paddle.to_tensor(np.ones((64, 64))) @ paddle.to_tensor(
+                np.ones((64, 64)))
+        prof.step(num_samples=64)
+        with profiler.RecordEvent("forward"):
+            pass
+        prof.step(num_samples=64)
+        prof.stop()
+        assert prof.timer.ips > 0
+
+    def test_scheduler_states(self):
+        import paddle_tpu.profiler as profiler
+
+        sch = profiler.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+        states = [sch(i) for i in range(5)]
+        assert states[0] == profiler.ProfilerState.CLOSED
+        assert states[1] == profiler.ProfilerState.READY
+        assert states[2] == profiler.ProfilerState.RECORD
+        assert states[3] == profiler.ProfilerState.RECORD_AND_RETURN
+        assert states[4] == profiler.ProfilerState.CLOSED
+
+    def test_chrome_trace_export(self, tmp_path):
+        import paddle_tpu.profiler as profiler
+
+        prof = profiler.Profiler(
+            scheduler=(0, 2),
+            on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)),
+            timer_only=False)
+        prof.start()
+        for i in range(3):
+            with profiler.RecordEvent("step_work"):
+                _ = paddle.to_tensor(np.ones(8)) + 1
+            prof.step()
+        prof.stop()
+        files = os.listdir(tmp_path)
+        assert files, "no chrome trace written"
+        import json
+
+        with open(tmp_path / files[0]) as f:
+            data = json.load(f)
+        names = {e["name"] for e in data["traceEvents"]}
+        assert "step_work" in names
+
+    def test_summary_table(self):
+        import paddle_tpu.profiler as profiler
+
+        prof = profiler.Profiler(timer_only=False)
+        prof.start()
+        with profiler.RecordEvent("matmul_span"):
+            pass
+        table = prof.summary()
+        prof.stop()
+        assert "matmul_span" in table
+
+    def test_timer_ips(self):
+        from paddle_tpu.profiler.timer import Timer
+
+        t = Timer()
+        t.begin()
+        import time as _time
+
+        for _ in range(3):
+            _time.sleep(0.01)
+            t.step(num_samples=10)
+        assert 100 < t.ips < 1100
