@@ -82,10 +82,14 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     n = jax.lax.psum(1, axis_name)
     idx = jax.lax.axis_index(axis_name)
 
-    # (B, H, S, D) f32 compute layout
-    qt = jnp.swapaxes(q, 1, 2).astype(jnp.float32) * np.float32(scale)
-    kt = jnp.swapaxes(k, 1, 2).astype(jnp.float32)
-    vt = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
+    # q: (B, Hkv, rep, S, D) f32 grouped layout — the GQA group rides as a
+    # free dot_general dimension, so k/v are never expanded to Hq width.
+    # k/v stay in their input dtype: ppermute bytes are the ring's cost, and
+    # the MXU multiplies bf16 natively with f32 accumulation.
+    qg = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * np.float32(scale)
+          ).reshape(B, Hkv, rep, Sq, D)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
 
     rows = idx * Sq + jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
     cols_local = jax.lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
@@ -95,10 +99,9 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
     def step(carry, t):
         m, l, acc, kc, vc = carry
-        ke = jnp.repeat(kc, rep, axis=1) if rep > 1 else kc
-        ve = jnp.repeat(vc, rep, axis=1) if rep > 1 else vc
-        s = jnp.einsum("bhqd,bhkd->bhqk", qt, ke,
-                       preferred_element_type=jnp.float32)
+        s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kc,
+                       preferred_element_type=jnp.float32
+                       ).reshape(B, H, Sq, Sk)
         if causal:
             src = jax.lax.rem(idx - t + n, n)
             cols = src * Sk + cols_local
@@ -109,14 +112,15 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         alpha = jnp.exp(m - m_new)
         l = l * alpha + jnp.sum(p, axis=-1)
         acc = acc * alpha[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p, ve, preferred_element_type=jnp.float32)
+            "bgrqk,bgkd->bgrqd", p.reshape(B, Hkv, rep, Sq, Sk), vc,
+            preferred_element_type=jnp.float32).reshape(B, H, Sq, D)
         kc = jax.lax.ppermute(kc, axis_name, fwd_perm)
         vc = jax.lax.ppermute(vc, axis_name, fwd_perm)
         return (m_new, l, acc, kc, vc), None
 
-    m0 = _pvary_like(jnp.full((B, H, Sq), _NEG_INF, jnp.float32), qt)
-    l0 = _pvary_like(jnp.zeros((B, H, Sq), jnp.float32), qt)
-    a0 = _pvary_like(jnp.zeros((B, H, Sq, D), jnp.float32), qt)
+    m0 = _pvary_like(jnp.full((B, H, Sq), _NEG_INF, jnp.float32), qg)
+    l0 = _pvary_like(jnp.zeros((B, H, Sq), jnp.float32), qg)
+    a0 = _pvary_like(jnp.zeros((B, H, Sq, D), jnp.float32), qg)
     (m, l, acc, _, _), _ = jax.lax.scan(
         jax.checkpoint(step), (m0, l0, a0, kt, vt), jnp.arange(n))
 

@@ -28,6 +28,13 @@ class ShardedTrainState:
                  zero_stage: int = 1, rules=None, donate: bool = True):
         import dataclasses
 
+        if zero_stage not in (0, 1, 2, 3):
+            raise ValueError(
+                f"zero_stage must be 0..3, got {zero_stage} "
+                "(0: replicated; 1: shard optimizer state; 2: + shard grads; "
+                "3: + shard params, gather-on-use)")
+        self.zero_stage = zero_stage
+
         mesh_lib.set_global_mesh(mesh)
         # a live sep axis means context parallelism: default to ring attention
         # (the layer that consumes the reference's reserved-but-unused sep axis)
@@ -51,11 +58,18 @@ class ShardedTrainState:
         pshape = jax.eval_shape(lambda: model.init_params(config, jax.random.PRNGKey(0)))
         self._pshape = pshape
 
+        zshard = functools.partial(
+            mesh_lib.zero_tree_shardings, mesh=mesh, axis="sharding")
+        if zero_stage >= 3:
+            # stage 3 (FSDP / GroupShardedStage3, group_sharded_stage3.py:59):
+            # the STORED params are sharded over the zero axis too; XLA
+            # all-gathers each weight at its use site and reduce-scatters its
+            # gradient — prefetch/overlap is the XLA scheduler's job.
+            self.param_shardings = zshard(self.param_shardings, pshape)
+
         # optimizer state shardings: m/v/master follow params, then ZeRO-shard
         opt_shape = jax.eval_shape(self.optimizer.init, pshape)
         if zero_stage >= 1:
-            zshard = functools.partial(
-                mesh_lib.zero_tree_shardings, mesh=mesh, axis="sharding")
             m_sh = zshard(jax.tree.map(lambda s: s, self.param_shardings), pshape)
             self.opt_shardings = type(opt_shape)(
                 step=NamedSharding(mesh, P()),
@@ -65,6 +79,14 @@ class ShardedTrainState:
                 step=NamedSharding(mesh, P()),
                 m=self.param_shardings, v=self.param_shardings,
                 master=self.param_shardings)
+
+        # stage 2 (GroupShardedOptimizerStage2, group_sharded_optimizer_stage2
+        # .py:53): constrain grads to the zero-sharded layout so GSPMD lowers
+        # the data-parallel all-reduce to reduce-scatter and the optimizer
+        # update runs on 1/N of every gradient.
+        self._grad_shardings = (
+            zshard(jax.tree.map(lambda s: s, self.param_shardings), pshape)
+            if zero_stage >= 2 else None)
 
         self.batch_sharding = NamedSharding(
             mesh, mesh_lib.logical_to_spec(("batch", "seq"), mesh, self.rules))
@@ -80,8 +102,18 @@ class ShardedTrainState:
             init_fn,
             out_shardings=(self.param_shardings, self.opt_shardings))
 
+        grad_sh = self._grad_shardings
+        # models may provide a custom grad path (e.g. llama's hand-scheduled
+        # 1F1B pipeline); it falls back to value_and_grad internally
+        loss_and_grads = getattr(model, "loss_and_grads", None)
+
         def step_fn(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch, config)
+            if loss_and_grads is not None:
+                loss, grads = loss_and_grads(params, batch, config)
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch, config)
+            if grad_sh is not None:
+                grads = jax.lax.with_sharding_constraint(grads, grad_sh)
             params, opt_state = opt.update(grads, opt_state, params)
             return params, opt_state, {"loss": loss,
                                        "grad_norm": _gnorm(grads)}

@@ -1,4 +1,4 @@
-"""Pipeline parallelism over the `pipe` mesh axis — single-jit SPMD schedule.
+"""Pipeline parallelism over the `pipe` mesh axis — single-jit SPMD schedules.
 
 Reference analog: fleet.meta_parallel.PipelineParallel
 (fleet/meta_parallel/pipeline_parallel.py:132, 1F1B at :387, interleaved at
@@ -8,20 +8,32 @@ NCCL batch_isend_irecv.  The TPU has no NCCL p2p; the idiomatic design
 
   * layer-stacked params are sharded over `pipe` (each stage owns L/P layers),
   * activations move stage-to-stage with `jax.lax.ppermute` (neighbor ICI hop),
-  * a `lax.scan` shift-register executes M + P - 1 ticks (GPipe-style fill/
-    drain; XLA overlaps the ppermute with the next tick's compute),
   * `shard_map` is MANUAL only over `pipe` — every other axis stays `auto`,
     so tensor/sequence/data sharding inside a stage is still pure GSPMD.
 
-Backward is just `jax.grad` through the scan: the transpose of ppermute is the
-reverse rotation, so AD materializes the reverse schedule automatically — the
-1F1B runtime the reference hand-codes in Python falls out of the autodiff.
+Two schedules, mirroring the reference's FThenB / 1F1B pair:
+
+  * ``pipeline_apply`` — GPipe wavefront (`lax.scan` shift register), with
+    optional INTERLEAVED virtual stages (stage s owns layer chunks
+    s, s+P, s+2P, …; one unified scan of V·M + P − 1 ticks, so the bubble is
+    P−1 ticks regardless of V·M — the reference's interleaved 1F1B bubble,
+    pipeline_parallel.py:822).  Differentiable: `jax.grad` through the scan
+    materializes the reverse schedule (activation stash = M microbatches,
+    GPipe's memory profile; use remat to trade).
+  * ``pipeline_1f1b`` — a hand-scheduled one-forward-one-backward train step
+    that computes grads ITSELF (no autodiff through the schedule).  Each
+    stage stashes at most P microbatch activations (the 1F1B memory bound;
+    asserted by tests), recomputes the stage forward at the backward tick
+    (recompute-everything 1F1B, like the reference's
+    enable_recompute+pp), and accumulates param grads in-register.  Costs
+    one extra stage-forward per tick vs GPipe-by-AD — it trades compute for
+    the O(P) activation bound, which is what you want at long S / deep L.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -40,18 +52,47 @@ def num_stages(mesh: Mesh, axis: str = "pipe") -> int:
     return mesh.shape.get(axis, 1) if axis in mesh.axis_names else 1
 
 
+def _vma(val):
+    return tuple(getattr(jax.typeof(val), "vma", frozenset()))
+
+
+def _pcast_to(val, vary):
+    cur = getattr(jax.typeof(val), "vma", frozenset())
+    need = tuple(a for a in vary if a not in cur)
+    return jax.lax.pcast(val, need, to="varying") if need else val
+
+
+def _wrap_block(block_fn, returns_aux: bool):
+    """Normalize block_fn to always return (h, aux_scalar)."""
+    if returns_aux:
+        return block_fn
+
+    def fn(h, lp, *ex):
+        return block_fn(h, lp, *ex), jnp.float32(0.0)
+
+    return fn
+
+
 def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
                    mesh: Optional[Mesh] = None, axis: str = "pipe",
                    n_micro: Optional[int] = None, remat: bool = True,
                    manual_axes: Sequence[str] = (),
                    x_spec: Optional[P] = None,
-                   extras_specs: Optional[Sequence[P]] = None):
+                   extras_specs: Optional[Sequence[P]] = None,
+                   virtual_stages: int = 1,
+                   returns_aux: bool = False):
     """Run `x` through L stacked layers, pipelined over the `axis` mesh axis.
 
-    block_fn(h, layer_params, *extras) -> h'   (one transformer block)
-    stacked_params: pytree with leading layer dim L on every leaf (L % P == 0)
+    block_fn(h, layer_params, *extras) -> h'  (or (h', aux) if returns_aux)
+    stacked_params: pytree with leading layer dim L on every leaf
+                    (L % (P * virtual_stages) == 0)
     x: (B, ...) activations; microbatched along B (B % n_micro == 0)
     extras: replicated side inputs (rope tables, masks, ...)
+
+    virtual_stages=V > 1 interleaves: stage s owns layer chunks s, s+P, …,
+    s+(V-1)P and microbatches re-enter stage 0 after each chunk round — one
+    scan of V·M + P − 1 ticks (bubble P−1 ticks, the interleaved-schedule
+    profile of pipeline_parallel.py:822 — V× less bubble per unit work).
 
     manual_axes: additional mesh axes to make manual inside the stage body —
     used to compose with ring/Ulysses attention, whose `sep` collectives must
@@ -59,78 +100,332 @@ def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
     dim, e.g. P(None, 'sep', None) for seq-sharded activations) and
     extras_specs describe how those inputs are sharded over the manual axes.
 
-    Returns activations shaped like x.  With no live pipe axis this reduces to
-    a plain lax.scan over layers.
+    Returns activations shaped like x (plus the summed aux loss when
+    returns_aux).  With no live pipe axis this reduces to a plain lax.scan
+    over layers.
     """
     mesh = mesh or mesh_lib.get_global_mesh()
     pp = num_stages(mesh, axis) if mesh is not None else 1
+    blk = _wrap_block(block_fn, returns_aux)
 
     if remat:
-        block_fn = jax.checkpoint(block_fn)
+        blk = jax.checkpoint(blk)
 
     def local_layers(stage_params, h, *ex):
         def body(carry, lp):
-            return block_fn(carry, lp, *ex), None
-        out, _ = jax.lax.scan(body, h, stage_params)
-        return out
+            h, aux = carry
+            h, a = blk(h, lp, *ex)
+            return (h, _pcast_to(aux + a, _vma(h))), None
+        aux0 = _pcast_to(jnp.float32(0.0), _vma(h))
+        (out, aux), _ = jax.lax.scan(body, (h, aux0), stage_params)
+        return out, aux
 
     if pp <= 1:
-        return local_layers(stacked_params, x, *extras)
+        out, aux = local_layers(stacked_params, x, *extras)
+        return (out, aux) if returns_aux else out
+
+    V = virtual_stages
+    M = n_micro or pp
+    B = x.shape[0]
+    if B % M:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    L = jax.tree.leaves(stacked_params)[0].shape[0]
+    if L % (pp * V):
+        raise ValueError(f"layers {L} not divisible by stages*virtual {pp}*{V}")
+    if V > 1 and M < pp:
+        raise ValueError(
+            f"interleaved schedule needs n_micro >= stages ({M} < {pp})")
+    mb = jnp.reshape(x, (M, B // M) + x.shape[1:])
+    # (V, P, Lc, ...): chunk c = v*P + s holds consecutive layers, owned by
+    # stage c % P — the interleaved round-robin assignment
+    chunked = jax.tree.map(
+        lambda a: jnp.reshape(a, (V, pp, L // (pp * V)) + a.shape[1:]),
+        stacked_params)
+
+    def pipe_local(stage_params, mbs, *ex):
+        # manual over `axis` only: stage_params leaves arrive as (V, 1, Lc, ...)
+        stage_params = jax.tree.map(lambda a: a[:, 0], stage_params)
+        idx = jax.lax.axis_index(axis)
+        fwd = [(i, (i + 1) % pp) for i in range(pp)]
+        is_last = idx == pp - 1
+        T = V * M + pp - 1
+
+        def tick(carry, t):
+            state, outs, wrap, aux_acc = carry
+            r = t - idx                       # local step: chunk v, microbatch m
+            valid = (r >= 0) & (r < V * M)
+            v = jnp.clip(r // M, 0, V - 1)
+            m = jnp.clip(r % M, 0, M - 1)
+            inp = jax.lax.dynamic_index_in_dim(mbs, m, 0, keepdims=False)
+            if V > 1:
+                wrapped = jax.lax.dynamic_index_in_dim(wrap, m, 0, keepdims=False)
+                inp = jnp.where(v == 0, inp, wrapped)
+            h = jnp.where(idx == 0, inp, state)
+            sp_v = jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(a, v, 0, keepdims=False),
+                stage_params)
+            y, a = local_layers(sp_v, h, *ex)
+            aux_acc = aux_acc + jnp.where(valid, a, 0.0)
+            # last stage, last chunk: collect final outputs
+            done = valid & is_last & (v == V - 1)
+            outs = jnp.where(
+                done, jax.lax.dynamic_update_index_in_dim(outs, y, m, 0), outs)
+            state = jax.lax.ppermute(jnp.where(valid, y, 0.0), axis, fwd)
+            if V > 1:
+                # stage 0 receives chunk v<V-1 outputs from stage P-1 and
+                # queues them for the next round (the interleave wrap-around)
+                r_send = (t + 1) - idx - pp   # sender's local step this arrival
+                arr = ((idx == 0) & (r_send >= 0) & (r_send < V * M)
+                       & (r_send // M < V - 1))
+                m_send = jnp.clip(r_send % M, 0, M - 1)
+                wrap = jnp.where(
+                    arr,
+                    jax.lax.dynamic_update_index_in_dim(wrap, state, m_send, 0),
+                    wrap)
+            return (state, outs, wrap, aux_acc), None
+
+        vary = (axis,) + tuple(a for a in manual_axes if a != axis)
+        state0 = _pcast_to(jnp.zeros_like(mbs[0]), vary)
+        outs0 = _pcast_to(jnp.zeros_like(mbs), vary)
+        # the wrap-around queue exists only for interleaved schedules — keep
+        # the default GPipe scan free of the dead (M, ...) carry
+        wrap0 = (_pcast_to(jnp.zeros_like(mbs), vary) if V > 1
+                 else jnp.zeros((), mbs.dtype))
+        aux0 = _pcast_to(jnp.float32(0.0), vary)
+        (_, outs, _, aux_acc), _ = jax.lax.scan(
+            tick, (state0, outs0, wrap0, aux0), jnp.arange(T))
+        # broadcast the last stage's buffer to the whole pipe axis; aux is a
+        # per-(stage, shard) partial sum — reduce over EVERY manual axis
+        outs = jax.lax.psum(jnp.where(is_last, outs, 0.0), axis)
+        for a in vary:
+            aux_acc = jax.lax.psum(aux_acc, a)
+        return outs, aux_acc
+
+    # manual over `axis` (+ any requested manual_axes, e.g. 'sep' for ring
+    # attention inside stages); every other mesh axis stays automatic, so
+    # GSPMD still lays out TP/DP inside stages
+    pspec = jax.tree.map(lambda _: P(None, axis), chunked)
+    rep = P()
+    mb_spec = P(None, *x_spec) if x_spec is not None else rep
+    ex_specs = tuple(extras_specs) if extras_specs else tuple(rep for _ in extras)
+    out, aux = shard_map(
+        pipe_local, mesh=mesh,
+        in_specs=(pspec, mb_spec) + ex_specs,
+        # check_vma=True is REQUIRED for collectives under partial-manual
+        # shard_map (vma tracking proves the psum'd output is pipe-invariant)
+        out_specs=(mb_spec, P()), check_vma=True,
+        axis_names=frozenset({axis}) | frozenset(manual_axes),
+    )(chunked, mb, *extras)
+    out = jnp.reshape(out, x.shape)
+    return (out, aux) if returns_aux else out
+
+
+# ---------------------------------------------------------------------------
+# 1F1B train schedule — hand-rolled grads, ≤ P stashed microbatches per stage
+# ---------------------------------------------------------------------------
+
+
+def pipeline_1f1b(block_fn, head_fn, stacked_params, head_params, x, labels,
+                  extras: Sequence[Any] = (), mesh: Optional[Mesh] = None,
+                  axis: str = "pipe", n_micro: Optional[int] = None,
+                  remat: bool = True, manual_axes: Sequence[str] = (),
+                  x_spec: Optional[P] = None,
+                  extras_specs: Optional[Sequence[P]] = None,
+                  labels_spec: Optional[P] = None,
+                  aux_scale: float = 0.0, returns_aux: bool = False):
+    """One-forward-one-backward pipelined train step (reference 1F1B,
+    pipeline_parallel.py:387), computed WITHOUT autodiff through the
+    schedule: per-stage activation stash is a (P, ...) ring buffer — the
+    1F1B in-flight bound — and the stage backward recomputes its forward
+    from the stashed input (recompute-1F1B).
+
+    block_fn(h, layer_params, *extras) -> h' (or (h', aux) if returns_aux)
+    head_fn(y, head_params, labels_mb) -> scalar loss CONTRIBUTION of one
+        microbatch (caller folds any 1/tokens normalization in).
+    x: (B, ...) block-stack input (embeddings); labels: (B, ...) int labels.
+
+    Returns (loss, aux_total, (d_stacked, d_head, dx)) — dx is the cotangent
+    w.r.t. x (backprop it into the embedding outside).  Schedule length is
+    2(M+P-1) ticks; per tick every stage runs one fused fwd(+head)+vjp, so
+    it trades ~2x stage compute vs GPipe-by-AD for the O(P) memory bound.
+    """
+    mesh = mesh or mesh_lib.get_global_mesh()
+    pp = num_stages(mesh, axis) if mesh is not None else 1
+    blk = _wrap_block(block_fn, returns_aux)
+    if remat:
+        blk = jax.checkpoint(blk)
+
+    def local_layers(stage_params, h, *ex):
+        def body(carry, lp):
+            h, aux = carry
+            h, a = blk(h, lp, *ex)
+            return (h, _pcast_to(aux + a, _vma(h))), None
+        aux0 = _pcast_to(jnp.float32(0.0), _vma(h))
+        (out, aux), _ = jax.lax.scan(body, (h, aux0), stage_params)
+        return out, aux
+
+    if pp <= 1:
+        def full(params, hp, h):
+            y, aux = local_layers(params, h, *extras)
+            return head_fn(y, hp, labels) + aux_scale * aux, aux
+        loss, vjp, aux = jax.vjp(full, stacked_params, head_params, x,
+                                 has_aux=True)
+        dsp, dhp, dx = vjp(jnp.float32(1.0))
+        return loss, aux, (dsp, dhp, dx)
 
     M = n_micro or pp
     B = x.shape[0]
     if B % M:
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if M < pp:
+        raise ValueError(f"1F1B needs n_micro >= stages ({M} < {pp})")
     mb = jnp.reshape(x, (M, B // M) + x.shape[1:])
+    lb = jnp.reshape(labels, (M, B // M) + labels.shape[1:])
+    T = 2 * (M + pp - 1)
 
-    def pipe_local(stage_params, mbs, *ex):
-        # manual over `axis` only: stage_params leaves arrive as (L/P, ...)
+    def pipe_local(stage_params, hp, mbs, lbls, *ex):
         idx = jax.lax.axis_index(axis)
-        fwd = [(i, (i + 1) % pp) for i in range(pp)]
         is_last = idx == pp - 1
+        w = pp - 1 - idx                       # warmup forwards at this stage
+        fwd_perm = [(i, (i + 1) % pp) for i in range(pp)]
+        bwd_perm = [((i + 1) % pp, i) for i in range(pp)]
 
-        def tick(carry, t):
-            state, outs = carry
-            inp = jax.lax.dynamic_index_in_dim(
-                mbs, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            h = jnp.where(idx == 0, inp, state)
-            y = local_layers(stage_params, h, *ex)
-            oi = t - (pp - 1)
-            upd = jax.lax.dynamic_update_index_in_dim(
-                outs, y, jnp.clip(oi, 0, M - 1), 0)
-            outs = jnp.where((oi >= 0) & is_last, upd, outs)
-            state = jax.lax.ppermute(y, axis, fwd)
-            return (state, outs), None
+        vary_all = (axis,) + tuple(a for a in manual_axes if a != axis)
+        # make hp device-varying up front: head grads are then computed
+        # LOCALLY by the vjp (no implicit psum), which keeps the head's
+        # lax.cond below legal — a psum inside a stage-divergent branch
+        # would deadlock.  The explicit psum happens once, after the scan.
+        hp_v = jax.tree.map(lambda a: _pcast_to(a, vary_all), hp)
 
-        # mark the carries varying over every manual axis (vma scan typing);
-        # seq-sharded inputs are already sep-varying, so only cast the rest
+        def stage_fwd(sp, h):
+            y, aux = local_layers(sp, h, *ex)
+            # pin outputs to the full varying set so the vjp cotangents
+            # (which depend on the device-varying schedule) type-check
+            return _pcast_to(y, vary_all), _pcast_to(aux, vary_all)
+
+        def sched_F(stage, f):
+            """Tick of the f-th forward at `stage` (Megatron 1F1B timing)."""
+            ws = pp - 1 - stage
+            return jnp.where(f < ws, stage + f, 2 * pp - 2 - stage + 2 * (f - ws))
+
+        def tick(carry, u):
+            (fcnt, bcnt, acnt, act_in, g_in, stash,
+             gsp, ghp, loss_acc, aux_acc, dxb) = carry
+            fwd_valid = (fcnt < M) & (u == sched_F(idx, fcnt))
+            bwd_valid = (bcnt < M) & (u == 2 * pp - 1 - idx + 2 * bcnt)
+            # arrivals: stage>0 receives exactly when stage-1 forwarded last
+            # tick; stage 0 "receives" its own input microbatch at fwd ticks
+            arr_valid = jnp.where(
+                idx > 0,
+                (acnt < M) & (u == sched_F(idx - 1, acnt) + 1),
+                fwd_valid)
+            arr_val = jnp.where(
+                idx > 0, act_in,
+                jax.lax.dynamic_index_in_dim(
+                    mbs, jnp.clip(fcnt, 0, M - 1), 0, keepdims=False))
+            slot_in = jnp.where(idx > 0, acnt, fcnt) % pp
+            stash = jnp.where(
+                arr_valid,
+                jax.lax.dynamic_update_index_in_dim(stash, arr_val, slot_in, 0),
+                stash)
+
+            h_fwd = jax.lax.dynamic_index_in_dim(
+                stash, fcnt % pp, 0, keepdims=False)
+            h_bwd = jax.lax.dynamic_index_in_dim(
+                stash, bcnt % pp, 0, keepdims=False)
+            # fwd and bwd never fire on the same tick, so ONE fused
+            # fwd(+head) + vjp serves both: fwd ticks use y, bwd ticks the grads
+            h_sel = jnp.where(bwd_valid, h_bwd, h_fwd)
+            lbl_sel = jax.lax.dynamic_index_in_dim(
+                lbls, jnp.clip(bcnt, 0, M - 1), 0, keepdims=False)
+            (y, aux), vjp = jax.vjp(stage_fwd, stage_params, h_sel)
+            f32 = jnp.float32
+
+            # the head (hidden->vocab projection + loss) only matters on the
+            # LAST stage's backward ticks; a cond skips its cost everywhere
+            # else (it is often the single largest matmul in the model)
+            def _with_head(_):
+                hl, hvjp = jax.vjp(
+                    lambda yy, hpp: head_fn(yy, hpp, lbl_sel), y, hp_v)
+                dy, dhp = hvjp(_pcast_to(f32(1.0), vary_all))
+                return hl, dy, dhp
+
+            def _no_head(_):
+                return (_pcast_to(f32(0.0), vary_all),
+                        _pcast_to(jnp.zeros_like(y), vary_all),
+                        jax.tree.map(
+                            lambda a: _pcast_to(jnp.zeros_like(a), vary_all),
+                            hp_v))
+
+            hl, dy_head, dhp = jax.lax.cond(
+                bwd_valid & is_last, _with_head, _no_head, None)
+
+            cot_y = _pcast_to(
+                jnp.where(bwd_valid, jnp.where(is_last, dy_head, g_in), 0.0),
+                vary_all)
+            cot_aux = _pcast_to(
+                jnp.where(bwd_valid, f32(aux_scale), f32(0.0)), vary_all)
+            dsp, dh = vjp((cot_y, cot_aux))
+            # masked cotangents already zero the grads on non-bwd ticks
+            gsp = jax.tree.map(jnp.add, gsp, dsp)
+            ghp = jax.tree.map(jnp.add, ghp, dhp)
+            loss_acc = loss_acc + hl  # zero off the last stage's bwd ticks
+            aux_acc = aux_acc + jnp.where(bwd_valid, aux, 0.0)
+            dxb = jnp.where(
+                bwd_valid & (idx == 0),
+                jax.lax.dynamic_update_index_in_dim(
+                    dxb, dh, jnp.clip(bcnt, 0, M - 1), 0),
+                dxb)
+            act_in = jax.lax.ppermute(jnp.where(fwd_valid, y, 0.0), axis, fwd_perm)
+            g_in = jax.lax.ppermute(jnp.where(bwd_valid, dh, 0.0), axis, bwd_perm)
+            return (fcnt + fwd_valid, bcnt + bwd_valid, acnt + arr_valid,
+                    act_in, g_in, stash, gsp, ghp, loss_acc, aux_acc, dxb), None
+
         vary = (axis,) + tuple(a for a in manual_axes if a != axis)
+        pc = functools.partial(_pcast_to, vary=vary)
+        i32 = jnp.int32
+        stash0 = pc(jnp.zeros((pp,) + mbs.shape[1:], mbs.dtype))
+        carry0 = (pc(i32(0)), pc(i32(0)), pc(i32(0)),
+                  pc(jnp.zeros_like(mbs[0])), pc(jnp.zeros_like(mbs[0])),
+                  stash0,
+                  jax.tree.map(lambda a: pc(jnp.zeros_like(a)), stage_params),
+                  # ghp accumulates LOCAL (varying) head grads — hp was pcast
+                  # to varying so the cond'd head vjp never psums; the
+                  # explicit reduction happens after the scan
+                  jax.tree.map(lambda a: pc(jnp.zeros_like(a)), hp),
+                  pc(jnp.float32(0.0)), pc(jnp.float32(0.0)),
+                  pc(jnp.zeros_like(mbs)))
+        (_, _, _, _, _, _, gsp, ghp, loss_acc, aux_acc, dxb), _ = jax.lax.scan(
+            tick, carry0, jnp.arange(T))
 
-        def pcast_to(val):
-            cur = getattr(jax.typeof(val), "vma", frozenset())
-            need = tuple(a for a in vary if a not in cur)
-            return jax.lax.pcast(val, need, to="varying") if need else val
+        # NB on reductions: stage_params enter this manual region INVARIANT
+        # over the non-pipe manual axes, and vma-aware AD already psums the
+        # cotangent of an invariant input over those axes — gsp comes out of
+        # the vjp reduced over them (and stays per-stage over pipe, as its
+        # P(axis) out_spec requires).  hp was explicitly pcast to varying, so
+        # its grads ARE local and need the full psum here, as do the primal
+        # accumulators (loss, aux) and the stage-0-owned dx buffer.
+        red = [axis] + [a for a in manual_axes if a != axis]
+        loss = loss_acc
+        aux = aux_acc
+        for a in red:
+            loss = jax.lax.psum(loss, a)
+            aux = jax.lax.psum(aux, a)
+            ghp = jax.tree.map(lambda g, a=a: jax.lax.psum(g, a), ghp)
+        dxb = jax.lax.psum(jnp.where(idx == 0, dxb, 0.0), axis)
+        return loss, aux, gsp, ghp, dxb
 
-        state0 = pcast_to(jnp.zeros_like(mbs[0]))
-        outs0 = pcast_to(jnp.zeros_like(mbs))
-        (_, outs), _ = jax.lax.scan(
-            tick, (state0, outs0), jnp.arange(M + pp - 1))
-        # broadcast the last stage's buffer to the whole pipe axis
-        return jax.lax.psum(jnp.where(is_last, outs, 0.0), axis)
-
-    # manual over `axis` (+ any requested manual_axes, e.g. 'sep' for ring
-    # attention inside stages); every other mesh axis stays automatic, so
-    # GSPMD still lays out TP/DP inside stages
     pspec = _stage_param_specs(stacked_params, axis)
     rep = P()
+    hspec = jax.tree.map(lambda _: rep, head_params)
     mb_spec = P(None, *x_spec) if x_spec is not None else rep
+    lb_spec = P(None, *labels_spec) if labels_spec is not None else rep
     ex_specs = tuple(extras_specs) if extras_specs else tuple(rep for _ in extras)
-    out = shard_map(
+    loss, aux, gsp, ghp, dxb = shard_map(
         pipe_local, mesh=mesh,
-        in_specs=(pspec, mb_spec) + ex_specs,
-        # check_vma=True is REQUIRED for collectives under partial-manual
-        # shard_map (vma tracking proves the psum'd output is pipe-invariant)
-        out_specs=mb_spec, check_vma=True,
+        in_specs=(pspec, hspec, mb_spec, lb_spec) + ex_specs,
+        out_specs=(P(), P(), pspec, hspec, mb_spec), check_vma=True,
         axis_names=frozenset({axis}) | frozenset(manual_axes),
-    )(stacked_params, mb, *extras)
-    return jnp.reshape(out, x.shape)
+    )(stacked_params, head_params, mb, lb, *extras)
+    dx = jnp.reshape(dxb, x.shape)
+    return loss + aux_scale * aux, aux, (gsp, ghp, dx)

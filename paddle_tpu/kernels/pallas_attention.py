@@ -1,14 +1,28 @@
-"""Pallas TPU kernel: FlashAttention-style fused attention (fwd) + custom VJP.
+"""Pallas TPU kernels: FlashAttention fused attention, forward AND backward.
 
-Reference analog: phi/kernels/gpu/flash_attn_kernel.cu:324 (wraps the vendored
-third_party/flashattn CUDA library).  TPU-native version: an online-softmax
-tiled kernel — q blocks stay resident in VMEM, k/v blocks stream from HBM, the
-(S,S) score matrix never materializes.  Backward recomputes attention from the
-saved (q,k,v) (flash-style residual strategy: O(S·D) residuals, not O(S²));
-the recompute runs as plain XLA ops which fuse well on the MXU.
+Reference analog: phi/kernels/gpu/flash_attn_kernel.cu:324 and
+phi/kernels/gpu/flash_attn_grad_kernel.cu (the reference wraps the vendored
+third_party/flashattn CUDA library for both directions).  TPU-native version:
+
+* forward — online-softmax tiled kernel; q blocks stay resident in VMEM, k/v
+  blocks stream from HBM, the (S,S) score matrix never materializes.  Saves
+  the per-row logsumexp (O(S) residual) for the backward.
+* backward — two tiled kernels with O(S·D) residuals (q, k, v, o, lse):
+  a dq kernel (grid over q blocks, streaming k/v) and a dk/dv kernel (grid
+  over k blocks, streaming q/do).  Scores are recomputed per block in the
+  transposed (bk, bq) orientation so the saved lse / delta rows broadcast
+  along sublanes for free (the splash-attention trick).  Nothing of size
+  (S, S) is ever materialized in either direction.
+
+GQA runs at Hkv width end to end: k/v are NEVER expanded with jnp.repeat —
+the kernels map query-head h to kv-head h // rep in the BlockSpec index maps,
+and the dk/dv kernel accumulates over the rep query heads of each group
+directly in its VMEM accumulator.
 
 Layout contract: (B, S, H, D) — the paddle flash_attention layout
 (python/paddle/nn/functional/flash_attention.py:125 in the reference).
+Pallas path needs S % 128 == 0 and D % 128 == 0; anything else takes the
+XLA fallback (still GQA-grouped, no repeat).
 """
 
 from __future__ import annotations
@@ -28,8 +42,8 @@ _TINY = np.float32(1e-30)
 # index-map constants must stay i32 under jax_enable_x64 (Mosaic requirement)
 _0 = np.int32(0)
 
-
 _LANES = 128
+_SUBLANES = 8  # f32 sublane tile; lse/delta rows are replicated to this
 
 
 def _lanes(x, width):
@@ -39,7 +53,12 @@ def _lanes(x, width):
     return pltpu.repeat(x, width // _LANES, axis=1)
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
                 *, scale: float, causal: bool, bq: int, bk: int, nk: int):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
@@ -82,8 +101,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
     @pl.when(ik == nk - 1)
     def _finalize():
         D = o_ref.shape[-1]
-        l = _lanes(jnp.maximum(l_scr[...], _TINY), D)
-        o_ref[0] = (acc_scr[...] / l).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[...], _TINY)
+        o_ref[0] = (acc_scr[...] / _lanes(l, D)).astype(o_ref.dtype)
+        lse_ref[0] = m_scr[...] + jnp.log(l)
 
 
 def _block(n, pref):
@@ -93,12 +113,18 @@ def _block(n, pref):
     return max(b, 1)
 
 
-def _flash_fwd(q, k, v, scale, causal, bq=512, bk=512):
-    """q,k,v: (BH, S, D) same head count (GQA pre-expanded)."""
+def _flash_fwd(q, k, v, scale, causal, rep, bq=512, bk=512):
+    """q: (BHq, S, D); k/v: (BHkv, S, D) with BHq == BHkv * rep.
+
+    Returns (o, lse128) where lse128 is (BHq, S, 128) lane-replicated f32.
+    """
     BH, S, D = q.shape
     bq = _block(S, bq)
     bk = _block(S, bk)
     nq, nk = S // bq, S // bk
+    # index-map arithmetic must stay i32 under jax_enable_x64 — a Python int
+    # operand promotes to i64, which Mosaic cannot convert (recursion bug)
+    _r = np.int32(rep)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, nk=nk)
     return pl.pallas_call(
@@ -106,11 +132,17 @@ def _flash_fwd(q, k, v, scale, causal, bq=512, bk=512):
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, _0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // _r, j, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // _r, j, _0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
+            pl.BlockSpec((1, bq, _LANES), lambda b, i, j: (b, i, _0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, _LANES), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, _LANES), jnp.float32),
             pltpu.VMEM((bq, _LANES), jnp.float32),
@@ -119,7 +151,178 @@ def _flash_fwd(q, k, v, scale, causal, bq=512, bk=512):
     )(q, k, v)
 
 
+# ---------------------------------------------------------------------------
+# backward: dq kernel — grid over q blocks, stream k/v
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref, dq_ref, acc_scr,
+               *, scale: float, causal: bool, bq: int, bk: int, nk: int):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    run = (not causal) or (iq * bq + bq - 1 >= ik * bk)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)    # (bq, D)
+        k = k_ref[0].astype(jnp.float32)    # (bk, D)
+        v = v_ref[0].astype(jnp.float32)    # (bk, D)
+        do = do_ref[0].astype(jnp.float32)  # (bq, D)
+        lse = lse_ref[0][:1]                # (1, bq) — broadcasts over sublanes
+        delta = dl_ref[0][:1]               # (1, bq)
+        # transposed orientation: (bk, bq) so lse/delta rows broadcast free
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bk, bq)
+        if causal:
+            kpos = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+            st = jnp.where(qpos >= kpos, st, _NEG_INF)
+        pt = jnp.exp(st - lse)                            # (bk, bq)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, bq)
+        dst = pt * (dpt - delta) * scale                  # (bk, bq)
+        acc_scr[...] += jax.lax.dot_general(
+            dst, k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq, D)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[...].astype(dq_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# backward: dk/dv kernel — grid over k blocks, stream q/do over the whole
+# query-head group (rep heads × nq blocks); accumulates at Hkv width.
+# ---------------------------------------------------------------------------
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, scale: float, causal: bool, bq: int, bk: int,
+                nq: int, nt: int):
+    jk = pl.program_id(1)
+    t = pl.program_id(2)
+    iq = jax.lax.rem(t, np.int32(nq))
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # skip q blocks entirely above the diagonal (they never see this k block)
+    run = jnp.logical_or(not causal, iq * bq + bq - 1 >= jk * bk)
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)    # (bq, D)
+        k = k_ref[0].astype(jnp.float32)    # (bk, D)
+        v = v_ref[0].astype(jnp.float32)    # (bk, D)
+        do = do_ref[0].astype(jnp.float32)  # (bq, D)
+        lse = lse_ref[0][:1]                # (1, bq)
+        delta = dl_ref[0][:1]               # (1, bq)
+        st = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bk, bq)
+        if causal:
+            kpos = jk * bk + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0)
+            qpos = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1)
+            st = jnp.where(qpos >= kpos, st, _NEG_INF)
+        pt = jnp.exp(st - lse)                            # (bk, bq)
+        dv_scr[...] += jax.lax.dot_general(
+            pt, do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, D)
+        dpt = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, bq)
+        dst = pt * (dpt - delta) * scale                  # (bk, bq)
+        dk_scr[...] += jax.lax.dot_general(
+            dst, q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bk, D)
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale, causal, rep, bq=512, bk=512):
+    """All of q/o/do: (BHq, S, D); k/v: (BHkv, S, D); lse: (BHq, S) f32."""
+    BH, S, D = q.shape
+    BHkv = k.shape[0]
+    bq = _block(S, bq)
+    bk = _block(S, bk)
+    nq, nk = S // bq, S // bk
+    _r, _nq = np.int32(rep), np.int32(nq)  # keep index maps i32 (see _flash_fwd)
+
+    # O(S) per-row residual work in plain XLA (fuses into one pass)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    lse_r = jnp.broadcast_to(lse[:, None, :], (BH, _SUBLANES, S))
+    dl_r = jnp.broadcast_to(delta[:, None, :], (BH, _SUBLANES, S))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nk=nk),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // _r, j, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b // _r, j, _0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
+            pl.BlockSpec((1, _SUBLANES, bq), lambda b, i, j: (b, _0, i)),
+            pl.BlockSpec((1, _SUBLANES, bq), lambda b, i, j: (b, _0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, _0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+    )(q, k, v, do, lse_r, dl_r)
+
+    nt = nq * rep
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nq=nq, nt=nt),
+        grid=(BHkv, nk, nt),
+        in_specs=[
+            pl.BlockSpec((1, bq, D),
+                         lambda b, j, t: (b * _r + t // _nq, t % _nq, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, _0)),
+            pl.BlockSpec((1, bq, D),
+                         lambda b, j, t: (b * _r + t // _nq, t % _nq, _0)),
+            pl.BlockSpec((1, _SUBLANES, bq),
+                         lambda b, j, t: (b * _r + t // _nq, _0, t % _nq)),
+            pl.BlockSpec((1, _SUBLANES, bq),
+                         lambda b, j, t: (b * _r + t // _nq, _0, t % _nq)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, _0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, t: (b, j, _0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BHkv, S, D), k.dtype),
+            jax.ShapeDtypeStruct((BHkv, S, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+    )(q, k, v, do, lse_r, dl_r)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom-vjp wrapper + XLA fallback
+# ---------------------------------------------------------------------------
+
+
 def _reference(q, k, v, scale, causal):
+    """Same-head-count (BH, S, D) reference; kept for kernel tests."""
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32), k.astype(jnp.float32)) * scale
     if causal:
         S = q.shape[1]
@@ -129,22 +332,38 @@ def _reference(q, k, v, scale, causal):
     return jnp.einsum("bqk,bkd->bqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, scale, causal):
-    return _flash_fwd(q, k, v, scale, causal)
+def _xla_attention(q, k, v, scale, causal):
+    """(B, S, H, D) XLA fallback.  GQA stays grouped — dot_general carries the
+    `rep` axis as a free lhs dimension, so Hkv-wide k/v are never repeated."""
+    B, S, Hq, D = q.shape
+    Hkv = k.shape[2]
+    rep = Hq // Hkv
+    qg = q.reshape(B, S, Hkv, rep, D).astype(jnp.float32)
+    s = jnp.einsum("bqhrd,bkhd->bhrqk", qg, k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        s = jnp.where(mask[None, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhrqk,bkhd->bqhrd", p, v.astype(jnp.float32))
+    return o.reshape(B, S, Hq, D).astype(q.dtype)
 
 
-def _flash_f(q, k, v, scale, causal):
-    return _flash_fwd(q, k, v, scale, causal), (q, k, v)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, rep):
+    o, _ = _flash_fwd(q, k, v, scale, causal, rep)
+    return o
 
 
-def _flash_b(scale, causal, res, g):
-    q, k, v = res
-    # recompute-based backward (O(S^2) compute, O(S·D) memory residuals)
-    def f(q, k, v):
-        return _reference(q, k, v, scale, causal)
-    _, vjp = jax.vjp(f, q, k, v)
-    return vjp(g)
+def _flash_f(q, k, v, scale, causal, rep):
+    o, lse128 = _flash_fwd(q, k, v, scale, causal, rep)
+    # keep only lane 0 as the O(S) residual
+    return o, (q, k, v, o, lse128[:, :, 0])
+
+
+def _flash_b(scale, causal, rep, res, g):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, g, scale, causal, rep)
 
 
 _flash.defvjp(_flash_f, _flash_b)
@@ -154,20 +373,15 @@ def flash_attention_pallas(q, k, v, causal=True, scale=None):
     """q: (B, S, Hq, D); k,v: (B, S, Hkv, D).  Returns (B, S, Hq, D)."""
     B, S, Hq, D = q.shape
     Hkv = k.shape[2]
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must be a multiple of Hkv={Hkv}")
+    rep = Hq // Hkv
     if scale is None:
         scale = 1.0 / (D ** 0.5)
     if D % 128 != 0 or S % 128 != 0:
         # lane-replication layout needs D,S multiples of 128; use the XLA path
-        qt = jnp.swapaxes(q, 1, 2).reshape(B * Hq, S, D)
-        rep = Hq // Hkv
-        kt = jnp.swapaxes(jnp.repeat(k, rep, axis=2), 1, 2).reshape(B * Hq, S, D)
-        vt = jnp.swapaxes(jnp.repeat(v, rep, axis=2), 1, 2).reshape(B * Hq, S, D)
-        out = _reference(qt, kt, vt, float(scale), bool(causal))
-        return jnp.swapaxes(out.reshape(B, Hq, S, D), 1, 2).astype(q.dtype)
-    if Hkv != Hq:
-        rep = Hq // Hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    to_bh = lambda x: jnp.swapaxes(x, 1, 2).reshape(B * Hq, S, D)  # noqa: E731
-    out = _flash(to_bh(q), to_bh(k), to_bh(v), float(scale), bool(causal))
+        return _xla_attention(q, k, v, float(scale), bool(causal))
+    to_bh = lambda x, h: jnp.swapaxes(x, 1, 2).reshape(B * h, S, D)  # noqa: E731
+    out = _flash(to_bh(q, Hq), to_bh(k, Hkv), to_bh(v, Hkv),
+                 float(scale), bool(causal), rep)
     return jnp.swapaxes(out.reshape(B, Hq, S, D), 1, 2)

@@ -63,6 +63,12 @@ class LlamaConfig:
     mesh: Any = None
     # pipeline microbatch count (defaults to the pipe-axis size)
     pp_microbatches: Optional[int] = None
+    # pipeline schedule: "gpipe" (AD through the wavefront scan) or "1f1b"
+    # (hand-scheduled one-forward-one-backward; <=P stashed microbatches —
+    # reference fleet/meta_parallel/pipeline_parallel.py:387)
+    pp_schedule: str = "gpipe"
+    # interleaved virtual stages per device (pipeline_parallel.py:822)
+    pp_virtual_stages: int = 1
 
     @property
     def hd(self) -> int:
@@ -281,10 +287,6 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
         # 1F1B-by-autodiff microbatch pipeline over the pipe axis (C27 analog)
         if attn_mask is not None:
             raise ValueError("pipeline parallel forward does not take attn_mask")
-        if ffn_fn is not None:
-            raise NotImplementedError(
-                "custom/MoE FFN under pipeline parallelism is not supported "
-                "yet — use a mesh without a pipe axis for MoE models")
         from jax.sharding import PartitionSpec as P
         sep_live = (c.context_parallel
                     and "sep" in mesh.axis_names and mesh.shape["sep"] > 1)
@@ -295,12 +297,12 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
             ex_specs = (P("sep", None), P("sep", None))
         else:
             manual, x_spec, ex_specs = (), None, None
-        x = pipe_lib.pipeline_apply(
-            lambda h, lp, cos, sin: blk(h, lp, cos, sin, None)[0],
+        x, aux_total = pipe_lib.pipeline_apply(
+            lambda h, lp, cos, sin: blk(h, lp, cos, sin, None),
             params["blocks"], x, extras=(cos, sin), mesh=mesh,
             n_micro=c.pp_microbatches, remat=c.remat,
-            manual_axes=manual, x_spec=x_spec, extras_specs=ex_specs)
-        aux_total = jnp.float32(0.0)
+            manual_axes=manual, x_spec=x_spec, extras_specs=ex_specs,
+            virtual_stages=c.pp_virtual_stages, returns_aux=True)
     else:
         if c.remat:
             blk = jax.checkpoint(blk, static_argnums=())
@@ -347,6 +349,83 @@ def loss_fn(params, batch, config: LlamaConfig):
 def lm_batch_from_tokens(tokens):
     """Next-token-prediction batch from a (B, S+1) token block."""
     return {"input_ids": tokens[:, :-1], "labels": tokens[:, 1:]}
+
+
+def loss_and_grads(params, batch, config: LlamaConfig, ffn_fn=None,
+                   ignore_index: int = -100):
+    """(loss, grads) — routes to the hand-scheduled 1F1B pipeline when
+    config.pp_schedule == '1f1b' on a live pipe mesh (reference 1F1B,
+    fleet/meta_parallel/pipeline_parallel.py:387); otherwise plain
+    jax.value_and_grad(loss_fn)."""
+    c = config
+    from ..distributed import pipeline as pipe_lib
+    mesh = c.mesh
+    pp = pipe_lib.num_stages(mesh) if mesh is not None else 1
+    if pp <= 1 or c.pp_schedule != "1f1b":
+        lf = loss_fn if ffn_fn is None else functools.partial(
+            _loss_fn_with_ffn, ffn_fn=ffn_fn)
+        return jax.value_and_grad(lf)(params, batch, c)
+
+    from jax.sharding import PartitionSpec as P
+    ids, labels = batch["input_ids"], batch["labels"]
+    S = ids.shape[1]
+    cos_full, sin_full = _rope_tables(c.hd, c.max_position_embeddings, c.rope_theta)
+    cos, sin = cos_full[:S], sin_full[:S]
+
+    def embed_fn(ep):
+        return jnp.take(ep["weight"], ids, axis=0)
+
+    x, embed_vjp = jax.vjp(embed_fn, params["embed"])
+
+    blk = functools.partial(_block, c, ffn_fn=ffn_fn)
+    denom = jnp.maximum(jnp.sum(labels != ignore_index), 1).astype(jnp.float32)
+    tied = c.tie_word_embeddings
+    head_params = {"final_norm": params["final_norm"]}
+    head_params["head_w"] = (params["embed"]["weight"] if tied
+                             else params["lm_head"])
+
+    def head_fn(y, hp, lbl):
+        """Per-microbatch loss CONTRIBUTION: token nll sum / global denom."""
+        yn = kernels.rms_norm(y, hp["final_norm"].astype(jnp.float32),
+                              c.rms_norm_eps)
+        w = hp["head_w"].T if tied else hp["head_w"]
+        logits = (yn @ w.astype(yn.dtype)).astype(jnp.float32)
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        ll = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(valid, logz - ll, 0.0)) / denom
+
+    sep_live = (c.context_parallel
+                and "sep" in mesh.axis_names and mesh.shape["sep"] > 1)
+    if sep_live:
+        manual, x_spec, lbl_spec = ("sep",), P(None, "sep", None), P(None, "sep")
+        ex_specs = (P("sep", None), P("sep", None))
+    else:
+        manual, x_spec, lbl_spec, ex_specs = (), None, None, None
+
+    loss, _aux, (dblocks, dhp, dx) = pipe_lib.pipeline_1f1b(
+        lambda h, lp, cos, sin: blk(h, lp, cos, sin, None),
+        head_fn, params["blocks"], head_params, x, labels,
+        extras=(cos, sin), mesh=mesh, n_micro=c.pp_microbatches,
+        remat=c.remat, manual_axes=manual, x_spec=x_spec,
+        extras_specs=ex_specs, labels_spec=lbl_spec,
+        aux_scale=1.0, returns_aux=True)
+
+    (dembed,) = embed_vjp(dx)
+    grads = {"embed": dembed, "blocks": dblocks,
+             "final_norm": dhp["final_norm"]}
+    if tied:
+        grads["embed"] = {"weight": dembed["weight"] + dhp["head_w"]}
+    else:
+        grads["lm_head"] = dhp["head_w"]
+    return loss, grads
+
+
+def _loss_fn_with_ffn(params, batch, config, ffn_fn=None):
+    logits, aux = forward(params, batch["input_ids"], config,
+                          ffn_fn=ffn_fn, return_aux_loss=True)
+    return masked_ce_loss(logits, batch["labels"]) + aux
 
 
 def num_params(config: LlamaConfig, init_fn=None) -> int:

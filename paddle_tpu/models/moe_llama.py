@@ -103,5 +103,20 @@ def loss_fn(params, batch, config: MoELlamaConfig):
     return llama_lib.masked_ce_loss(logits, batch["labels"]) + aux
 
 
+def loss_and_grads(params, batch, config: MoELlamaConfig):
+    """(loss, grads) with the 1F1B pipeline when pp_schedule='1f1b' — the
+    expert FFN rides the same ffn_fn hook, so MoE composes with pipeline
+    parallelism (the reference forbids exactly this pairing)."""
+    moe_cfg = config.moe
+
+    def ffn(h, lp):
+        return moe_lib.moe_ffn(h, lp, moe_cfg)
+
+    return llama_lib.loss_and_grads(params, batch, config, ffn_fn=ffn)
+
+
 def num_params(config: MoELlamaConfig) -> int:
     return llama_lib.num_params(config, init_fn=init_params)
+
+
+lm_batch_from_tokens = llama_lib.lm_batch_from_tokens

@@ -341,3 +341,229 @@ class TestPipelineParallel:
             l0 = l0 or float(m["loss"])
         assert np.isfinite(float(m["loss"]))
         assert float(m["loss"]) < l0
+
+
+class TestZeroStages:
+    """ZeRO 0/1/2/3 — reference: fleet/meta_parallel/sharding/
+    group_sharded_optimizer_stage2.py:53 and group_sharded_stage3.py:59."""
+
+    def _train(self, zero_stage, steps=3):
+        from paddle_tpu.models import llama
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        from paddle_tpu.optimizer.functional import AdamW
+        mesh = mesh_lib.make_mesh(data=2, sharding=4)
+        cfg = LlamaConfig.tiny()
+        st = ShardedTrainState(cfg, llama, mesh, AdamW(learning_rate=1e-3),
+                               zero_stage=zero_stage)
+        params, opt = st.init(jax.random.PRNGKey(0))
+        toks = np.random.default_rng(7).integers(0, cfg.vocab_size, (8, 33))
+        batch = st.shard_batch(
+            llama.lm_batch_from_tokens(jnp.asarray(toks, jnp.int32)))
+        losses = []
+        for _ in range(steps):
+            params, opt, m = st.step(params, opt, batch)
+            losses.append(float(m["loss"]))
+        return st, params, opt, losses
+
+    def test_invalid_stage_rejected(self):
+        from paddle_tpu.models import llama
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        mesh = mesh_lib.make_mesh(data=2, sharding=4)
+        with pytest.raises(ValueError, match="zero_stage"):
+            ShardedTrainState(LlamaConfig.tiny(), llama, mesh, zero_stage=4)
+
+    def test_loss_parity_across_stages(self):
+        ref = self._train(0)[3]
+        for stage in (1, 2, 3):
+            got = self._train(stage)[3]
+            np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+    def test_stage3_param_memory_inverse_n(self):
+        """Stage-3 stored params occupy ~1/N of stage-0 bytes per device."""
+        def local_bytes(tree):
+            return sum(
+                x.addressable_shards[0].data.size * x.dtype.itemsize
+                for x in jax.tree.leaves(tree))
+
+        _, p0, o0, _ = self._train(0, steps=1)
+        _, p3, o3, _ = self._train(3, steps=1)
+        n = 4  # sharding axis size
+        b0, b3 = local_bytes(p0), local_bytes(p3)
+        assert b3 < b0 / (n / 2), f"params not sharded: {b0} -> {b3}"
+        m0 = local_bytes(o0.m) + local_bytes(o0.v) + local_bytes(o0.master)
+        m3 = local_bytes(o3.m) + local_bytes(o3.v) + local_bytes(o3.master)
+        assert m3 < m0 / (n / 2), f"opt state not sharded: {m0} -> {m3}"
+
+    def test_stage2_constrains_grads(self):
+        """Stage >= 2 pins every gradient leaf to the zero-sharded layout
+        (the reduce-scatter form is then the TPU partitioner's lowering; the
+        CPU backend keeps all-reduce+slice, so assert on the constraint)."""
+        from paddle_tpu.models import llama
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        mesh = mesh_lib.make_mesh(data=2, sharding=4)
+        st1 = ShardedTrainState(LlamaConfig.tiny(), llama, mesh, zero_stage=1)
+        st2 = ShardedTrainState(LlamaConfig.tiny(), llama, mesh, zero_stage=2)
+        assert st1._grad_shardings is None
+        assert st2._grad_shardings is not None
+        specs = {s.spec for s in jax.tree.leaves(st2._grad_shardings)}
+        assert any("sharding" in str(sp) for sp in specs)
+
+
+class TestPipelineSchedules:
+    """1F1B + interleaved schedules — reference pipeline_parallel.py:387,822."""
+
+    def _llama_setup(self):
+        import dataclasses
+        from paddle_tpu.models import llama
+        from paddle_tpu.models.llama import LlamaConfig
+        cfg = LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        toks = np.random.default_rng(5).integers(0, cfg.vocab_size, (4, 33))
+        batch = llama.lm_batch_from_tokens(jnp.asarray(toks, jnp.int32))
+        return dataclasses, llama, cfg, params, batch
+
+    def test_interleaved_forward_parity(self):
+        dc, llama, cfg, params, batch = self._llama_setup()
+        mesh = mesh_lib.make_mesh(pipe=2)
+        # tiny() has 2 layers; interleave needs L % (P*V) == 0 -> V=1 w/ P=2
+        # use a 4-layer config for V=2
+        cfg4 = dc.replace(cfg, num_hidden_layers=4)
+        params4 = llama.init_params(cfg4, jax.random.PRNGKey(0))
+        base4 = float(llama.loss_fn(params4, batch, cfg4))
+        cfg_v = dc.replace(cfg4, mesh=mesh, pp_microbatches=2,
+                           pp_virtual_stages=2)
+        got = float(llama.loss_fn(params4, batch, cfg_v))
+        np.testing.assert_allclose(got, base4, rtol=1e-5)
+
+    def test_1f1b_loss_and_grads_parity(self):
+        dc, llama, cfg, params, batch = self._llama_setup()
+        loss_ref, grads_ref = jax.value_and_grad(llama.loss_fn)(
+            params, batch, cfg)
+        mesh = mesh_lib.make_mesh(pipe=2, model=2)
+        cfg_pp = dc.replace(cfg, mesh=mesh, pp_microbatches=2,
+                            pp_schedule="1f1b")
+        loss, grads = llama.loss_and_grads(params, batch, cfg_pp)
+        np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+        flat_r, _ = jax.tree_util.tree_flatten(grads_ref)
+        flat_g, _ = jax.tree_util.tree_flatten(grads)
+        for a, b in zip(flat_g, flat_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_1f1b_stash_bounded_by_stages(self):
+        """The 1F1B activation stash is (P, ...) — independent of n_micro."""
+        from paddle_tpu.distributed import pipeline as pipe
+        mesh = mesh_lib.make_mesh(pipe=4)
+        mesh_lib.set_global_mesh(mesh)
+        rng = np.random.default_rng(0)
+        L, Dm, B = 4, 8, 16
+        Ws = jnp.asarray(rng.standard_normal((L, Dm, Dm)) * 0.3, jnp.float32)
+        x = jnp.asarray(rng.standard_normal((B, Dm)), jnp.float32)
+        lbl = jnp.asarray(rng.integers(0, Dm, (B,)), jnp.int32)
+
+        def block(h, W):
+            return jnp.tanh(h @ W)
+
+        def head(y, hp, lb):
+            ll = jnp.take_along_axis(jax.nn.log_softmax(y @ hp),
+                                     lb[..., None], axis=-1)
+            return -jnp.sum(ll) / B
+
+        Wh = jnp.asarray(rng.standard_normal((Dm, Dm)) * 0.3, jnp.float32)
+        P_ = 4
+
+        def scan_carry_avals(jaxpr):
+            found = []
+
+            def walk(jpr):
+                for eqn in jpr.eqns:
+                    if eqn.primitive.name == "scan":
+                        nc = eqn.params["num_carry"]
+                        found.append([v.aval for v in eqn.invars[
+                            eqn.params["num_consts"]:
+                            eqn.params["num_consts"] + nc]])
+                    for val in eqn.params.values():
+                        leaves = jax.tree.leaves(
+                            val, is_leaf=lambda x: hasattr(x, "eqns")
+                            or hasattr(x, "jaxpr"))
+                        for sub in leaves:
+                            if hasattr(sub, "jaxpr"):   # ClosedJaxpr
+                                walk(sub.jaxpr)
+                            elif hasattr(sub, "eqns"):  # Jaxpr
+                                walk(sub)
+            walk(jaxpr.jaxpr)
+            return found
+
+        for M in (8, 16):
+            jaxpr = jax.make_jaxpr(
+                lambda Ws, Wh, x, M=M: pipe.pipeline_1f1b(
+                    block, head, Ws, Wh, x, lbl, mesh=mesh, n_micro=M,
+                    remat=False))(Ws, Wh, x)
+            carries = scan_carry_avals(jaxpr)
+            assert carries, "no scan found in 1F1B jaxpr"
+            ticks = max(carries, key=len)  # the tick scan has the big carry
+            mb_elems = (B // M) * Dm
+            # activation-sized carries: stash (P, mb), act/grad wires (mb),
+            # and the M-sized IO buffer dxb.  Nothing else may scale with M.
+            m_sized = [a for a in ticks
+                       if a.shape and int(np.prod(a.shape)) >= M * mb_elems
+                       and a.shape[0] == M]
+            assert len(m_sized) == 1, f"extra M-sized carries: {m_sized}"
+            stash = [a for a in ticks if a.shape and a.shape[0] == P_
+                     and int(np.prod(a.shape)) == P_ * mb_elems]
+            assert stash, "stash buffer not (P, ...)-shaped"
+        # loss parity across M while stash stays (P, ...)
+        l4 = pipe.pipeline_1f1b(block, head, Ws, Wh, x, lbl, mesh=mesh,
+                                n_micro=4, remat=False)[0]
+        l16 = pipe.pipeline_1f1b(block, head, Ws, Wh, x, lbl, mesh=mesh,
+                                 n_micro=16, remat=False)[0]
+        np.testing.assert_allclose(float(l4), float(l16), rtol=1e-5)
+
+    def test_moe_llama_trains_under_pipeline(self):
+        """MoE + pipeline — the pairing the reference rejects (llama.py:285
+        analog removed this round)."""
+        import dataclasses
+        from paddle_tpu.models import moe_llama
+        from paddle_tpu.models.moe_llama import MoELlamaConfig
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        from paddle_tpu.optimizer.functional import AdamW
+        mesh = mesh_lib.make_mesh(pipe=2, extra_axes={"expert": 2})
+        cfg = MoELlamaConfig.tiny()
+        st = ShardedTrainState(cfg, moe_llama, mesh, AdamW(learning_rate=1e-3))
+        params, opt = st.init(jax.random.PRNGKey(0))
+        toks = np.random.default_rng(9).integers(0, cfg.vocab_size, (8, 17))
+        batch = st.shard_batch(
+            moe_llama.lm_batch_from_tokens(jnp.asarray(toks, jnp.int32)))
+        l0 = None
+        for _ in range(3):
+            params, opt, m = st.step(params, opt, batch)
+            l0 = l0 or float(m["loss"])
+        assert np.isfinite(float(m["loss"]))
+        assert float(m["loss"]) < l0
+
+    def test_moe_llama_1f1b(self):
+        import dataclasses
+        from paddle_tpu.models import moe_llama
+        from paddle_tpu.models.moe_llama import MoELlamaConfig
+        cfg = MoELlamaConfig.tiny()
+        params = moe_llama.init_params(cfg, jax.random.PRNGKey(0))
+        toks = np.random.default_rng(11).integers(0, cfg.vocab_size, (4, 17))
+        batch = moe_llama.lm_batch_from_tokens(jnp.asarray(toks, jnp.int32))
+        # MoE routes per microbatch under a pipeline, so the reference is
+        # the GPipe-pipelined loss (same microbatching), grads by AD through
+        # the wavefront scan — 1F1B must reproduce them exactly
+        mesh = mesh_lib.make_mesh(pipe=2)
+        cfg_gp = dataclasses.replace(cfg, mesh=mesh, pp_microbatches=2)
+        loss_ref, grads_ref = jax.value_and_grad(moe_llama.loss_fn)(
+            params, batch, cfg_gp)
+        cfg_pp = dataclasses.replace(cfg_gp, pp_schedule="1f1b")
+        loss, grads = moe_llama.loss_and_grads(params, batch, cfg_pp)
+        np.testing.assert_allclose(float(loss), float(loss_ref), rtol=1e-5)
+        flat_r, _ = jax.tree_util.tree_flatten(grads_ref)
+        flat_g, _ = jax.tree_util.tree_flatten(grads)
+        for a, b in zip(flat_g, flat_r):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
