@@ -33,7 +33,8 @@ from .context_parallel import (  # noqa: F401
     ring_attention, ulysses_attention, context_parallel_attention,
 )
 from . import pipeline  # noqa: F401
-from .pipeline import pipeline_apply  # noqa: F401
+from .pipeline import pipeline_apply, pipeline_1f1b  # noqa: F401
+from . import checkpoint  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import (  # noqa: F401
     MoEConfig, MoELayer, NaiveGate, SwitchGate, GShardGate,

@@ -1,0 +1,311 @@
+"""Distributed launcher + elastic supervisor.
+
+Reference parity: `python/paddle/distributed/launch/main.py:18` (the
+`python -m paddle.distributed.launch` CLI), the collective controller
+(`launch/controllers/collective.py:37`), the rendezvous master
+(`launch/controllers/master.py:73,186`) and the elastic manager
+(`fleet/elastic/manager.py:126`).  TPU-native mapping:
+
+  * one worker process per host-local chip set; env rendezvous hands each
+    worker its (rank, world_size, coordinator) and `init_parallel_env` turns
+    that into `jax.distributed.initialize` — the JAX coordination service is
+    the "master" the reference implements by hand over etcd/TCP,
+  * a tiny TCP KV store (`KVStore`) covers the multi-node barrier/rendezvous
+    the reference's master.py does (node discovery before the JAX
+    coordinator exists),
+  * per-rank logs go to `<log_dir>/workerlog.<rank>` (reference layout),
+  * the supervisor watches children; on a worker death it tears the job down
+    and — with `--elastic` — relaunches the whole gang up to
+    `--max_restarts` times, exporting PADDLE_RESTART_COUNT so training
+    scripts resume from their latest checkpoint
+    (`distributed.checkpoint.latest_step`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+__all__ = ["LaunchConfig", "Controller", "KVStore", "KVClient", "main"]
+
+
+# ---------------------------------------------------------------------------
+# KV store — the rendezvous "master" (reference launch/controllers/master.py)
+# ---------------------------------------------------------------------------
+
+
+class _KVHandler(socketserver.StreamRequestHandler):
+    def handle(self):
+        store: Dict[str, str] = self.server.kv  # type: ignore[attr-defined]
+        cond: threading.Condition = self.server.cond  # type: ignore[attr-defined]
+        line = self.rfile.readline().decode().strip()
+        if not line:
+            return
+        op, _, rest = line.partition(" ")
+        if op == "SET":
+            key, _, val = rest.partition(" ")
+            with cond:
+                store[key] = val
+                cond.notify_all()
+            self.wfile.write(b"OK\n")
+        elif op == "GET":
+            with cond:
+                val = store.get(rest)
+            self.wfile.write((f"{val}\n" if val is not None else "\n").encode())
+        elif op == "WAIT":  # WAIT <timeout> <key>
+            tmo_s, _, key = rest.partition(" ")
+            deadline = time.time() + float(tmo_s)
+            with cond:
+                while key not in store and time.time() < deadline:
+                    cond.wait(timeout=0.1)
+                val = store.get(key)
+            self.wfile.write((f"{val}\n" if val is not None else "\n").encode())
+        elif op == "INCR":  # returns post-increment value
+            with cond:
+                cur = int(store.get(rest, "0")) + 1
+                store[rest] = str(cur)
+                cond.notify_all()
+            self.wfile.write(f"{cur}\n".encode())
+
+
+class KVStore:
+    """Threaded TCP KV server for node rendezvous (SET/GET/WAIT/INCR)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        socketserver.ThreadingTCPServer.allow_reuse_address = True
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _KVHandler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.kv = {}          # type: ignore[attr-defined]
+        self._srv.cond = threading.Condition()  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def endpoint(self) -> str:
+        h, p = self._srv.server_address[:2]
+        return f"{h}:{p}"
+
+    def shutdown(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class KVClient:
+    def __init__(self, endpoint: str, connect_timeout: float = 300.0):
+        host, _, port = endpoint.rpartition(":")
+        self._addr = (host, int(port))
+        self._connect_timeout = connect_timeout
+
+    def _rt(self, line: str) -> str:
+        # the master may come up AFTER this node (normal under real cluster
+        # schedulers) — retry refused connections within the rendezvous window
+        deadline = time.time() + self._connect_timeout
+        while True:
+            try:
+                with socket.create_connection(self._addr, timeout=30) as s:
+                    s.sendall((line + "\n").encode())
+                    return s.makefile().readline().strip()
+            except (ConnectionRefusedError, ConnectionResetError, OSError):
+                if time.time() >= deadline:
+                    raise
+                time.sleep(0.2)
+
+    def set(self, key: str, val: str):
+        self._rt(f"SET {key} {val}")
+
+    def get(self, key: str) -> Optional[str]:
+        out = self._rt(f"GET {key}")
+        return out or None
+
+    def wait(self, key: str, timeout: float = 60.0) -> Optional[str]:
+        out = self._rt(f"WAIT {timeout} {key}")
+        return out or None
+
+    def incr(self, key: str) -> int:
+        return int(self._rt(f"INCR {key}"))
+
+
+# ---------------------------------------------------------------------------
+# controller
+# ---------------------------------------------------------------------------
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclasses.dataclass
+class LaunchConfig:
+    nproc_per_node: int = 1
+    nnodes: int = 1
+    node_rank: int = 0
+    master: Optional[str] = None      # host:port of the KV store (multi-node)
+    log_dir: str = "log"
+    elastic: bool = False
+    max_restarts: int = 3
+    poll_interval: float = 0.2
+    stop_grace: float = 10.0
+
+
+class Controller:
+    """Spawn the local worker gang, watch it, restart on failure (elastic).
+
+    Reference: launch/controllers/collective.py:37 (CollectiveController
+    .build_pod + watch loop) and fleet/elastic/manager.py:126.
+    """
+
+    def __init__(self, config: LaunchConfig):
+        self.c = config
+        self._kv: Optional[KVStore] = None
+
+    # -- rendezvous ---------------------------------------------------------
+
+    def _rendezvous(self, round_: int = 0) -> str:
+        """Agree on the JAX coordinator address; returns 'host:port'.
+
+        `round_` namespaces the KV keys so every elastic restart is a fresh
+        rendezvous (a stale coordinator from the dead generation must not be
+        reused).  The node-0 KV store is created once and reused across
+        rounds — rebinding the master port would race the old listener.
+        """
+        c = self.c
+        if c.nnodes <= 1:
+            return f"127.0.0.1:{_free_port()}"
+        if c.node_rank == 0:
+            if self._kv is None:
+                host, _, port = (c.master or "").rpartition(":")
+                self._kv = KVStore(host or "0.0.0.0", int(port or 0))
+            kv = KVClient(self._kv.endpoint if not c.master else c.master)
+            coord = f"{socket.gethostname()}:{_free_port()}"
+            kv.set(f"coordinator/{round_}", coord)
+        else:
+            kv = KVClient(c.master)
+            coord = kv.wait(f"coordinator/{round_}", timeout=300)
+            if not coord:
+                raise TimeoutError("rendezvous: no coordinator published "
+                                   f"at {c.master} within 300s")
+        n = kv.incr(f"joined/{round_}")
+        if n == c.nnodes:
+            kv.set(f"all_joined/{round_}", "1")
+        if not kv.wait(f"all_joined/{round_}", timeout=300):
+            raise TimeoutError(f"rendezvous: {n}/{c.nnodes} nodes joined")
+        return coord
+
+    # -- spawn/watch --------------------------------------------------------
+
+    def _spawn(self, argv: Sequence[str], coord: str,
+               restart: int) -> List[subprocess.Popen]:
+        c = self.c
+        os.makedirs(c.log_dir, exist_ok=True)
+        world = c.nnodes * c.nproc_per_node
+        procs = []
+        for local_rank in range(c.nproc_per_node):
+            rank = c.node_rank * c.nproc_per_node + local_rank
+            env = dict(os.environ)
+            env.update({
+                # paddle names (reference launch/job/pod env contract)
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(world),
+                "PADDLE_LOCAL_RANK": str(local_rank),
+                "PADDLE_MASTER": coord,
+                "PADDLE_RESTART_COUNT": str(restart),
+                # generic + jax names
+                "RANK": str(rank), "LOCAL_RANK": str(local_rank),
+                "WORLD_SIZE": str(world),
+                "JAX_COORDINATOR_ADDRESS": coord,
+                "JAX_NUM_PROCESSES": str(world),
+                "JAX_PROCESS_ID": str(rank),
+            })
+            log = open(os.path.join(c.log_dir, f"workerlog.{rank}"), "ab")
+            log.write(f"==== restart {restart} ====\n".encode())
+            log.flush()
+            procs.append(subprocess.Popen(
+                list(argv), env=env, stdout=log, stderr=subprocess.STDOUT))
+        return procs
+
+    def _stop(self, procs: List[subprocess.Popen]):
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + self.c.stop_grace
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    def _watch(self, procs: List[subprocess.Popen]) -> int:
+        """0 when every worker exits cleanly; first bad rc otherwise."""
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = [rc for rc in codes if rc not in (None, 0)]
+            if bad:
+                self._stop(procs)
+                return bad[0]
+            if all(rc == 0 for rc in codes):
+                return 0
+            time.sleep(self.c.poll_interval)
+
+    def run(self, argv: Sequence[str]) -> int:
+        c = self.c
+        restart = 0
+        try:
+            while True:
+                coord = self._rendezvous(restart)
+                procs = self._spawn(argv, coord, restart)
+                rc = self._watch(procs)
+                if rc == 0:
+                    return 0
+                if not c.elastic or restart >= c.max_restarts:
+                    return rc
+                restart += 1
+                print(f"[launch] worker failed rc={rc}; elastic restart "
+                      f"{restart}/{c.max_restarts}", file=sys.stderr)
+        finally:
+            if self._kv is not None:
+                self._kv.shutdown()
+
+
+def main(args: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.distributed.launch",
+        description="Launch a distributed training job "
+                    "(reference: paddle.distributed.launch)")
+    ap.add_argument("--nproc_per_node", type=int,
+                    default=int(os.environ.get("PADDLE_NPROC_PER_NODE", "1")))
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node_rank", type=int, default=0)
+    ap.add_argument("--master", default=None,
+                    help="host:port of the rendezvous KV store (multi-node)")
+    ap.add_argument("--log_dir", default="log")
+    ap.add_argument("--elastic", action="store_true",
+                    help="restart the gang on worker failure")
+    ap.add_argument("--max_restarts", type=int, default=3)
+    ap.add_argument("training_script")
+    ap.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    ns = ap.parse_args(args)
+
+    cfg = LaunchConfig(
+        nproc_per_node=ns.nproc_per_node, nnodes=ns.nnodes,
+        node_rank=ns.node_rank, master=ns.master, log_dir=ns.log_dir,
+        elastic=ns.elastic, max_restarts=ns.max_restarts)
+    if ns.training_script.endswith(".py"):
+        argv = [sys.executable, ns.training_script, *ns.training_script_args]
+    else:  # arbitrary executable
+        argv = [ns.training_script, *ns.training_script_args]
+    return Controller(cfg).run(argv)

@@ -139,6 +139,29 @@ class ShardedTrainState:
         return jax.tree.map(
             lambda x: jax.device_put(jnp.asarray(x), self.batch_sharding), batch)
 
+    # -- distributed checkpoint (reshard-on-load) ---------------------------
+
+    def save(self, path: str, params, opt_state, step: Optional[int] = None,
+             extra: Optional[dict] = None) -> None:
+        """Shard-by-shard save of (params, opt_state) — see
+        distributed.checkpoint; loadable under ANY mesh/zero-stage."""
+        from . import checkpoint as ckpt
+
+        meta = dict(extra or {})
+        if step is not None:
+            meta["step"] = int(step)
+        ckpt.save_state(path, {"params": params, "opt": opt_state}, extra=meta)
+
+    def restore(self, path: str):
+        """Load a checkpoint RESHARDED onto this state's mesh/zero layout."""
+        from . import checkpoint as ckpt
+
+        opt_shape = jax.eval_shape(self.optimizer.init, self._pshape)
+        tmpl = {"params": self._pshape, "opt": opt_shape}
+        shardings = {"params": self.param_shardings, "opt": self.opt_shardings}
+        out = ckpt.load_state(path, tmpl, shardings)
+        return out["params"], out["opt"]
+
 
 def _gnorm(tree):
     return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32)))

@@ -73,6 +73,20 @@ def _wrap_block(block_fn, returns_aux: bool):
     return fn
 
 
+def _make_local_layers(blk):
+    """Per-stage layer stack: scan blk over the local layer slice, summing
+    aux (shared by both schedules)."""
+    def local_layers(stage_params, h, *ex):
+        def body(carry, lp):
+            h, aux = carry
+            h, a = blk(h, lp, *ex)
+            return (h, _pcast_to(aux + a, _vma(h))), None
+        aux0 = _pcast_to(jnp.float32(0.0), _vma(h))
+        (out, aux), _ = jax.lax.scan(body, (h, aux0), stage_params)
+        return out, aux
+    return local_layers
+
+
 def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
                    mesh: Optional[Mesh] = None, axis: str = "pipe",
                    n_micro: Optional[int] = None, remat: bool = True,
@@ -111,14 +125,7 @@ def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
     if remat:
         blk = jax.checkpoint(blk)
 
-    def local_layers(stage_params, h, *ex):
-        def body(carry, lp):
-            h, aux = carry
-            h, a = blk(h, lp, *ex)
-            return (h, _pcast_to(aux + a, _vma(h))), None
-        aux0 = _pcast_to(jnp.float32(0.0), _vma(h))
-        (out, aux), _ = jax.lax.scan(body, (h, aux0), stage_params)
-        return out, aux
+    local_layers = _make_local_layers(blk)
 
     if pp <= 1:
         out, aux = local_layers(stacked_params, x, *extras)
@@ -381,8 +388,7 @@ def pipeline_1f1b(block_fn, head_fn, stacked_params, head_params, x, labels,
             return (fcnt + fwd_valid, bcnt + bwd_valid, acnt + arr_valid,
                     act_in, g_in, stash, gsp, ghp, loss_acc, aux_acc, dxb), None
 
-        vary = (axis,) + tuple(a for a in manual_axes if a != axis)
-        pc = functools.partial(_pcast_to, vary=vary)
+        pc = functools.partial(_pcast_to, vary=vary_all)
         i32 = jnp.int32
         stash0 = pc(jnp.zeros((pp,) + mbs.shape[1:], mbs.dtype))
         carry0 = (pc(i32(0)), pc(i32(0)), pc(i32(0)),

@@ -2,8 +2,8 @@
 
 Pickle-protocol-4 nested state dicts with Tensors stored as numpy arrays
 (bfloat16 goes through ml_dtypes, which numpy understands via jax).  Large
-checkpoint use goes through paddle_tpu.distributed.checkpoint (Orbax-style
-sharded async save) — this module is the single-process path.
+checkpoint use goes through paddle_tpu.distributed.checkpoint (per-shard
+.npy files + reshard-on-load) — this module is the single-process path.
 """
 
 from __future__ import annotations

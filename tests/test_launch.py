@@ -1,0 +1,139 @@
+"""Launcher / elastic supervisor — reference launch/main.py, elastic/manager.py."""
+
+import os
+import subprocess
+import sys
+import textwrap
+import time
+
+import pytest
+
+from paddle_tpu.distributed.launch import (
+    Controller, KVClient, KVStore, LaunchConfig)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestKVStore:
+    def test_set_get_wait_incr(self):
+        srv = KVStore()
+        try:
+            kv = KVClient(srv.endpoint)
+            assert kv.get("a") is None
+            kv.set("a", "1")
+            assert kv.get("a") == "1"
+            assert kv.incr("n") == 1
+            assert kv.incr("n") == 2
+            t0 = time.time()
+            assert kv.wait("missing", timeout=0.3) is None
+            assert time.time() - t0 >= 0.25
+            assert kv.wait("a", timeout=1.0) == "1"
+        finally:
+            srv.shutdown()
+
+
+class TestController:
+    def _script(self, tmp_path, body):
+        p = tmp_path / "worker.py"
+        p.write_text(textwrap.dedent(body))
+        return str(p)
+
+    def test_env_contract_and_logs(self, tmp_path):
+        script = self._script(tmp_path, """
+            import json, os, sys
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            out = {k: os.environ[k] for k in (
+                "PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM", "PADDLE_LOCAL_RANK",
+                "PADDLE_MASTER", "RANK", "WORLD_SIZE",
+                "JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID")}
+            print(json.dumps(out))
+        """)
+        cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "log"))
+        rc = Controller(cfg).run([sys.executable, script])
+        assert rc == 0
+        import json
+        logs = sorted(os.listdir(tmp_path / "log"))
+        assert logs == ["workerlog.0", "workerlog.1"]
+        for i, name in enumerate(logs):
+            lines = (tmp_path / "log" / name).read_text().splitlines()
+            env = json.loads(lines[-1])
+            assert env["PADDLE_TRAINER_ID"] == str(i)
+            assert env["WORLD_SIZE"] == "2" == env["PADDLE_TRAINERS_NUM"]
+            assert env["PADDLE_MASTER"] == env["JAX_COORDINATOR_ADDRESS"]
+
+    def test_failure_propagates_rc(self, tmp_path):
+        script = self._script(tmp_path, """
+            import os, sys
+            sys.exit(7 if os.environ["RANK"] == "1" else 0)
+        """)
+        cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "log"))
+        assert Controller(cfg).run([sys.executable, script]) == 7
+
+    def test_elastic_survives_killed_worker(self, tmp_path):
+        """2-proc gang; rank 1 kills itself on the first launch; the elastic
+        supervisor restarts the gang and training resumes from the step
+        counter 'checkpoint' — the VERDICT e2e criterion."""
+        script = self._script(tmp_path, """
+            import os, signal, sys, time
+            ckdir = sys.argv[1]
+            rank = os.environ["RANK"]
+            restart = int(os.environ["PADDLE_RESTART_COUNT"])
+            # resume from latest 'checkpoint'
+            done = sorted(int(f.split("_")[1]) for f in os.listdir(ckdir)
+                          if f.startswith("step_")) if os.path.isdir(ckdir) else []
+            start = (done[-1] + 1) if done else 0
+            os.makedirs(ckdir, exist_ok=True)
+            for step in range(start, 6):
+                if step == 3 and rank == "1" and restart == 0:
+                    os.kill(os.getpid(), signal.SIGKILL)  # simulated crash
+                if rank == "0":
+                    open(os.path.join(ckdir, f"step_{step}"), "w").close()
+                time.sleep(0.02)
+        """)
+        ck = str(tmp_path / "ck")
+        cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "log"),
+                           elastic=True, max_restarts=2)
+        rc = Controller(cfg).run([sys.executable, script, ck])
+        assert rc == 0
+        steps = sorted(int(f.split("_")[1]) for f in os.listdir(ck))
+        assert steps[-1] == 5  # reached the end after the restart
+        log0 = (tmp_path / "log" / "workerlog.0").read_text()
+        assert "==== restart 1 ====" in log0
+
+    def test_elastic_gives_up_after_max_restarts(self, tmp_path):
+        script = self._script(tmp_path, "import sys; sys.exit(3)\n")
+        cfg = LaunchConfig(nproc_per_node=1, log_dir=str(tmp_path / "log"),
+                           elastic=True, max_restarts=1)
+        assert Controller(cfg).run([sys.executable, script]) == 3
+
+
+class TestMultiNodeRendezvous:
+    def test_two_node_rendezvous_agrees_on_coordinator(self, tmp_path):
+        """Run two Controller.run's (as threads) for nnodes=2 — both gangs
+        must receive the SAME coordinator address from the KV master."""
+        import threading
+        from paddle_tpu.distributed.launch import _free_port
+        script = tmp_path / "w.py"
+        script.write_text(
+            "import os,sys\n"
+            "print('COORD', os.environ['JAX_COORDINATOR_ADDRESS'])\n")
+        port = _free_port()
+        master = f"127.0.0.1:{port}"
+        rcs = {}
+
+        def node(rank):
+            cfg = LaunchConfig(nproc_per_node=1, nnodes=2, node_rank=rank,
+                               master=master,
+                               log_dir=str(tmp_path / f"log{rank}"))
+            rcs[rank] = Controller(cfg).run([sys.executable, str(script)])
+
+        t0 = threading.Thread(target=node, args=(0,))
+        t1 = threading.Thread(target=node, args=(1,))
+        t0.start(); time.sleep(0.2); t1.start()
+        t0.join(60); t1.join(60)
+        assert rcs == {0: 0, 1: 0}
+        c0 = (tmp_path / "log0" / "workerlog.0").read_text()
+        c1 = (tmp_path / "log1" / "workerlog.1").read_text()
+        coord0 = [l for l in c0.splitlines() if l.startswith("COORD")][-1]
+        coord1 = [l for l in c1.splitlines() if l.startswith("COORD")][-1]
+        assert coord0 == coord1
