@@ -1,0 +1,350 @@
+"""Op registry — the single-source op table (SURVEY C10).
+
+Reference analog: `paddle/phi/api/yaml/ops.yaml` + `paddle/phi/api/yaml/
+backward.yaml` and their generators, which produce the C++ API, VJP rules and
+per-op test coverage.  Under JAX the API surface and VJPs come from jnp/XLA,
+so the registry's job shrinks to what still needs a single source of truth:
+
+  * which PUBLIC binding implements each op (name -> namespace path, checked
+    by tests so the table cannot rot),
+  * the supported dtypes + per-dtype tolerances (drives the GENERATED
+    dtype x mode numeric sweep in tests/test_op_registry.py — the analog of
+    the reference OpTest running every op across places/dtypes,
+    test/legacy_test/eager_op_test.py:381),
+  * whether the op is differentiable (grad sweep) and its sampler (valid
+    example inputs, respecting each op's domain),
+  * the GSPMD sharding class (elementwise/broadcast/reduce/contract/gather/
+    shape) — documentation of how the op partitions; XLA derives the actual
+    propagation rule.
+
+Registering is additive metadata: impls stay the existing hand-written jnp
+compositions in ops/* and nn/functional.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["OpDef", "register", "get", "all_ops", "REGISTRY"]
+
+_FLOATS = ("float32", "float16", "bfloat16")
+
+
+@dataclasses.dataclass(frozen=True)
+class OpDef:
+    name: str                      # public path under paddle_tpu, e.g. "exp"
+    dtypes: Tuple[str, ...] = _FLOATS
+    has_vjp: bool = True           # include in the grad sweep
+    sample: Optional[Callable] = None   # rng -> (args, kwargs)
+    # per-dtype (rtol, atol) overrides for the low-precision sweep
+    tol: Optional[Dict[str, Tuple[float, float]]] = None
+    sharding: str = "elementwise"  # gspmd class: elementwise | broadcast |
+    #                                reduce | contract | gather | shape | rng
+
+
+REGISTRY: Dict[str, OpDef] = {}
+
+
+def register(name: str, **kw) -> OpDef:
+    if name in REGISTRY:
+        raise ValueError(f"op '{name}' already registered")
+    op = OpDef(name=name, **kw)
+    REGISTRY[name] = op
+    return op
+
+
+def get(name: str) -> OpDef:
+    return REGISTRY[name]
+
+
+def all_ops():
+    return list(REGISTRY.values())
+
+
+# ---------------------------------------------------------------------------
+# samplers — produce (args, kwargs) of NUMPY float32 arrays; the sweep casts
+# them to the dtype under test
+# ---------------------------------------------------------------------------
+
+
+def _u(shape=(4, 8)):
+    def f(rng):
+        return (rng.standard_normal(shape).astype(np.float32),), {}
+    return f
+
+
+def _u_pos(shape=(4, 8), lo=0.1, hi=3.0):
+    def f(rng):
+        return (rng.uniform(lo, hi, shape).astype(np.float32),), {}
+    return f
+
+
+def _u_unit(shape=(4, 8), eps=0.05):
+    def f(rng):
+        return (rng.uniform(-1 + eps, 1 - eps, shape).astype(np.float32),), {}
+    return f
+
+
+def _u01(shape=(4, 8), eps=0.05):
+    def f(rng):
+        return (rng.uniform(eps, 1 - eps, shape).astype(np.float32),), {}
+    return f
+
+
+def _b(shape=(4, 8)):
+    def f(rng):
+        return (rng.standard_normal(shape).astype(np.float32),
+                rng.standard_normal(shape).astype(np.float32)), {}
+    return f
+
+
+def _b_pos(shape=(4, 8)):
+    def f(rng):
+        return (rng.uniform(0.2, 3.0, shape).astype(np.float32),
+                rng.uniform(0.2, 3.0, shape).astype(np.float32)), {}
+    return f
+
+
+def _mat(m=4, k=8, n=4):
+    def f(rng):
+        return (rng.standard_normal((m, k)).astype(np.float32) / np.sqrt(k),
+                rng.standard_normal((k, n)).astype(np.float32) / np.sqrt(k)), {}
+    return f
+
+
+def _spd(n=4):
+    def f(rng):
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        return (a @ a.T + n * np.eye(n, dtype=np.float32),), {}
+    return f
+
+
+def _sq(n=4):
+    def f(rng):
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        return (a + n * np.eye(n, dtype=np.float32),), {}
+    return f
+
+
+def _ints(shape=(4, 8), hi=8):
+    def f(rng):
+        return (rng.integers(0, hi, shape).astype(np.int32),
+                rng.integers(0, hi, shape).astype(np.int32)), {}
+    return f
+
+
+def _bools(shape=(4, 8)):
+    def f(rng):
+        return (rng.integers(0, 2, shape).astype(bool),
+                rng.integers(0, 2, shape).astype(bool)), {}
+    return f
+
+
+_BF = {"bfloat16": (1e-1, 1e-1), "float16": (3e-2, 3e-2)}
+_LOOSE = {"bfloat16": (2e-1, 2e-1), "float16": (6e-2, 6e-2)}
+
+
+def _reg_many(names, **kw):
+    for n in names:
+        register(n, **kw)
+
+
+# -- elementwise unary ------------------------------------------------------
+
+_reg_many(
+    ["abs", "neg", "sign", "ceil", "floor", "round", "trunc", "frac",
+     "sin", "cos", "tanh", "sigmoid", "erf", "sinh", "cosh",
+     "deg2rad", "rad2deg", "square", "stanh"],
+    sample=_u(), tol=_BF)
+_reg_many(["exp", "expm1"], sample=_u(), tol=_LOOSE)
+_reg_many(["tan"], sample=_u_unit(), tol=_LOOSE)
+_reg_many(["asin", "acos", "atan", "atanh", "erfinv"],
+          sample=_u_unit(), tol=_LOOSE)
+register("asinh", sample=_u(), tol=_BF)
+register("acosh", sample=_u_pos(lo=1.1, hi=4.0), tol=_LOOSE)
+_reg_many(["sqrt", "rsqrt", "log", "log2", "log10", "log1p", "lgamma",
+           "digamma", "reciprocal"],
+          sample=_u_pos(), tol=_LOOSE)
+register("logit", sample=_u01(), tol=_LOOSE)
+_reg_many(["i0", "i1"], sample=_u_pos(hi=2.0), tol=_LOOSE,
+          dtypes=("float32",))
+_reg_many(["isnan", "isinf", "isfinite"], sample=_u(), has_vjp=False)
+register("nan_to_num", sample=_u(), tol=_BF)
+
+# -- elementwise binary -----------------------------------------------------
+
+_reg_many(["add", "subtract", "multiply", "maximum", "minimum",
+           "fmax", "fmin", "copysign"],
+          sample=_b(), tol=_BF, sharding="broadcast")
+_reg_many(["divide", "atan2", "hypot", "logaddexp"],
+          sample=_b_pos(), tol=_LOOSE, sharding="broadcast")
+_reg_many(["pow", "heaviside"], sample=_b_pos(), tol=_LOOSE,
+          sharding="broadcast")
+# modulo is discontinuous: a low-precision rounding of x/y across an integer
+# boundary flips the result by |y|, so only f32 is swept
+_reg_many(["mod", "remainder", "floor_mod", "floor_divide"],
+          sample=_b_pos(), has_vjp=False, dtypes=("float32",),
+          sharding="broadcast")
+register("nextafter", sample=_b(), has_vjp=False, dtypes=("float32",),
+         sharding="broadcast")
+register("lerp", tol=_BF, sharding="broadcast",
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.standard_normal((4, 8)).astype(np.float32),
+                              np.float32(0.3)), {}))
+
+# -- comparisons / logical / bitwise ---------------------------------------
+
+_reg_many(["equal", "not_equal", "greater_than", "greater_equal",
+           "less_than", "less_equal", "isclose"],
+          sample=_b(), has_vjp=False, sharding="broadcast")
+_reg_many(["logical_and", "logical_or", "logical_xor"],
+          sample=_bools(), has_vjp=False, dtypes=("bool",),
+          sharding="broadcast")
+register("logical_not", has_vjp=False, dtypes=("bool",),
+         sample=lambda rng: ((rng.integers(0, 2, (4, 8)).astype(bool),), {}))
+_reg_many(["bitwise_and", "bitwise_or", "bitwise_xor"],
+          sample=_ints(), has_vjp=False, dtypes=("int32",),
+          sharding="broadcast")
+register("bitwise_not", has_vjp=False, dtypes=("int32",),
+         sample=lambda rng: ((rng.integers(0, 8, (4, 8)).astype(np.int32),), {}))
+_reg_many(["gcd", "lcm"], sample=_ints(), has_vjp=False, dtypes=("int32",),
+          sharding="broadcast")
+
+# -- reductions -------------------------------------------------------------
+
+_reg_many(["sum", "mean", "max", "min", "amax", "amin", "logsumexp",
+           "nansum", "nanmean"],
+          sample=_u(), tol=_LOOSE, sharding="reduce")
+register("prod", sample=_u_pos(lo=0.5, hi=1.5), tol=_LOOSE, sharding="reduce")
+_reg_many(["std", "var"], sample=_u(), tol=_LOOSE, sharding="reduce")
+_reg_many(["median", "nanmedian"], sample=_u(), has_vjp=False,
+          tol=_LOOSE, sharding="reduce")
+# quantile interpolates between order statistics — rank flips under rounding
+register("quantile", has_vjp=False, dtypes=("float32",), sharding="reduce",
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"q": 0.5}))
+_reg_many(["any", "all"], has_vjp=False, dtypes=("bool",), sharding="reduce",
+          sample=lambda rng: ((rng.integers(0, 2, (4, 8)).astype(bool),), {}))
+register("count_nonzero", sample=_u(), has_vjp=False, sharding="reduce")
+_reg_many(["cumsum", "logcumsumexp"], sample=_u(), tol=_LOOSE,
+          sharding="reduce")
+register("cumprod", tol=_LOOSE, sharding="reduce",
+         sample=lambda rng: ((rng.uniform(0.5, 1.5, (4, 8)).astype(np.float32),),
+                             {"dim": 1}))
+
+# -- contractions -----------------------------------------------------------
+
+_reg_many(["matmul", "mm"], sample=_mat(), tol=_LOOSE, sharding="contract")
+register("bmm", tol=_LOOSE, sharding="contract",
+         sample=lambda rng: ((rng.standard_normal((2, 4, 8)).astype(np.float32),
+                              rng.standard_normal((2, 8, 4)).astype(np.float32)),
+                             {}))
+register("dot", tol=_LOOSE, sharding="contract",
+         sample=lambda rng: ((rng.standard_normal(8).astype(np.float32),
+                              rng.standard_normal(8).astype(np.float32)), {}))
+_reg_many(["inner", "outer"], sample=lambda rng: (
+    (rng.standard_normal(6).astype(np.float32),
+     rng.standard_normal(6).astype(np.float32)), {}),
+    tol=_LOOSE, sharding="contract")
+register("kron", sample=_b(shape=(2, 3)), tol=_LOOSE, sharding="contract")
+
+# -- manipulation (shape class: dtype-independent data movement) ------------
+
+register("reshape", has_vjp=True, sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"shape": [8, 4]}))
+register("transpose", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"perm": [1, 0]}))
+_reg_many(["t", "flatten"], sample=_u(), tol=_BF, sharding="shape")
+register("flip", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"axis": 1}))
+register("roll", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"shifts": 2, "axis": 1}))
+register("tile", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"repeat_times": [2, 1]}))
+register("broadcast_to", sharding="broadcast", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((1, 8)).astype(np.float32),),
+                             {"shape": [4, 8]}))
+_reg_many(["tril", "triu", "diag", "diagonal"], sample=_u(shape=(5, 5)),
+          tol=_BF, sharding="shape")
+register("squeeze", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 1, 8)).astype(np.float32),),
+                             {"axis": 1}))
+register("unsqueeze", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"axis": 1}))
+register("moveaxis", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((2, 3, 4)).astype(np.float32),),
+                             {"source": 0, "destination": 2}))
+register("rot90", sharding="shape", tol=_BF, sample=_u(shape=(4, 4)))
+register("repeat_interleave", sharding="shape", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"repeats": 2, "axis": 0}))
+register("masked_fill", sharding="broadcast", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.integers(0, 2, (4, 8)).astype(bool),
+                              np.float32(0.0)), {}))
+register("where", sharding="broadcast", tol=_BF,
+         sample=lambda rng: ((rng.integers(0, 2, (4, 8)).astype(bool),
+                              rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.standard_normal((4, 8)).astype(np.float32)),
+                             {}))
+register("clip", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"min": -0.5, "max": 0.5}))
+register("scale", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),),
+                             {"scale": 2.0, "bias": 1.0}))
+
+# -- gather / scatter -------------------------------------------------------
+
+register("gather", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((6, 3)).astype(np.float32),
+                              rng.integers(0, 6, (4,)).astype(np.int32)), {}))
+register("index_select", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((6, 3)).astype(np.float32),
+                              rng.integers(0, 6, (4,)).astype(np.int32)), {}))
+register("take_along_axis", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.integers(0, 8, (4, 2)).astype(np.int64)),
+                             {"axis": 1}))
+register("index_sample", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.integers(0, 8, (4, 2)).astype(np.int32)), {}))
+
+# -- linalg -----------------------------------------------------------------
+
+register("cholesky", sample=_spd(), dtypes=("float32",), sharding="contract")
+_reg_many(["inverse", "det", "slogdet", "matrix_exp"], sample=_sq(),
+          dtypes=("float32",), sharding="contract")
+register("trace", sample=_u(shape=(5, 5)), tol=_LOOSE, sharding="reduce")
+register("norm", sample=_u(), tol=_LOOSE, sharding="reduce")
+register("solve", dtypes=("float32",), sharding="contract",
+         sample=lambda rng: ((_sq()(rng)[0][0],
+                              rng.standard_normal((4, 2)).astype(np.float32)),
+                             {}))
+_reg_many(["qr", "svd", "eigh", "pinv"], sample=_sq(), dtypes=("float32",),
+          has_vjp=False, sharding="contract")
+register("matrix_power", dtypes=("float32",), sharding="contract",
+         sample=lambda rng: ((_sq()(rng)[0][0],), {"n": 2}))
+
+# -- nn.functional activations (paths with dots resolve namespaces) ---------
+
+_reg_many(
+    ["nn.functional." + n for n in
+     ["relu", "relu6", "gelu", "silu", "elu", "selu", "leaky_relu",
+      "hardtanh", "hardsigmoid", "hardswish", "hardshrink", "softshrink",
+      "tanhshrink", "softplus", "softsign", "mish", "swish", "celu"]],
+    sample=_u(), tol=_LOOSE)
+_reg_many(["nn.functional.softmax", "nn.functional.log_softmax"],
+          sample=_u(), tol=_LOOSE, sharding="reduce")
+register("nn.functional.normalize", sample=_u(), tol=_LOOSE,
+         sharding="reduce")
+register("nn.functional.glu", sample=_u(), tol=_LOOSE)

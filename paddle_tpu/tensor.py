@@ -407,6 +407,7 @@ def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), *
     if not need_grad:
         outs = fn(*raws, **kwargs)
         wrapped = _wrap_outputs(outs, stop_gradient=True)
+        _check_nan_inf(name, wrapped)
         cap = framework.get_state().capture_program
         if cap is not None:
             out_list = wrapped if isinstance(wrapped, tuple) else (wrapped,)
@@ -421,6 +422,7 @@ def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), *
 
     out_raws, pullback = jax.vjp(pure, *[raws[p] for p in diff_pos])
     wrapped = _wrap_outputs(out_raws, stop_gradient=False)
+    _check_nan_inf(name, wrapped)
     out_list = wrapped if isinstance(wrapped, tuple) else (wrapped,)
     node = TapeNode(name, pullback, tuple(args[p] for p in diff_pos), out_list)
     for idx, o in enumerate(out_list):
@@ -431,6 +433,28 @@ def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), *
     if cap is not None:
         cap._record(name, fn, args, kwargs, out_list)
     return wrapped
+
+
+def _check_nan_inf(name, wrapped):
+    """FLAGS_check_nan_inf: raise on non-finite op outputs.
+
+    Reference checks every kernel output when the flag is set
+    (paddle/fluid/eager/nan_inf_utils.h:38).  Eager (concrete) values raise
+    immediately with the op name; traced values (inside jit/capture) are
+    skipped — use jax debug tooling for compiled NaN hunts.
+    """
+    if not framework.get_state().flags.get("FLAGS_check_nan_inf"):
+        return
+    outs = wrapped if isinstance(wrapped, tuple) else (wrapped,)
+    for o in outs:
+        if not (isinstance(o, Tensor) and _is_float(o._data)):
+            continue
+        if isinstance(o._data, jax.core.Tracer):
+            continue
+        if not bool(jnp.all(jnp.isfinite(o._data))):
+            raise FloatingPointError(
+                f"[FLAGS_check_nan_inf] op '{name}' produced NaN/Inf "
+                f"(shape {tuple(o._data.shape)}, dtype {o._data.dtype})")
 
 
 def _wrap_outputs(outs, stop_gradient):

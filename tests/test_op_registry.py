@@ -1,0 +1,139 @@
+"""Generated op sweep from the registry (SURVEY C10).
+
+The analog of the reference OpTest running every op across places/dtypes/
+modes (test/legacy_test/eager_op_test.py:381): every registered op is
+resolved to its public binding and swept over its declared dtypes; float
+results are compared against the float32 run, and differentiable ops get a
+finite-gradient check.  FLAGS_check_nan_inf gets a positive + negative test.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import registry
+
+
+def _resolve(name):
+    obj = paddle
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _run(op, dtype, rng):
+    fn = _resolve(op.name)
+    args, kwargs = op.sample(rng)
+    targs = [paddle.to_tensor(a.astype(dtype)
+                              if a.dtype.kind == "f" else a)
+             if isinstance(a, np.ndarray) else a
+             for a in args]
+    out = fn(*targs, **kwargs)
+    return out, targs
+
+
+def _first_tensor(out):
+    if isinstance(out, (tuple, list)):
+        for o in out:
+            if hasattr(o, "numpy"):
+                return o
+        return None
+    return out if hasattr(out, "numpy") else None
+
+
+class TestRegistryIntegrity:
+    def test_at_least_100_ops(self):
+        assert len(registry.all_ops()) >= 100, len(registry.all_ops())
+
+    def test_every_op_resolves_to_public_binding(self):
+        for op in registry.all_ops():
+            fn = _resolve(op.name)
+            assert callable(fn), op.name
+
+    def test_sharding_classes_are_known(self):
+        allowed = {"elementwise", "broadcast", "reduce", "contract",
+                   "gather", "shape", "rng"}
+        for op in registry.all_ops():
+            assert op.sharding in allowed, (op.name, op.sharding)
+
+
+@pytest.mark.parametrize("op", registry.all_ops(), ids=lambda o: o.name)
+class TestGeneratedSweep:
+    def test_dtype_sweep(self, op):
+        """fp16/bf16 runs must track the fp32 run within declared tolerance
+        and preserve the input dtype class."""
+        base, _ = _run(op, "float32", np.random.default_rng(0))
+        base_t = _first_tensor(base)
+        for dtype in op.dtypes:
+            if dtype == "float32":
+                continue
+            out, _ = _run(op, dtype, np.random.default_rng(0))
+            out_t = _first_tensor(out)
+            if base_t is None or out_t is None:
+                continue
+            got = np.asarray(out_t.numpy(), dtype=np.float64)
+            want = np.asarray(base_t.numpy(), dtype=np.float64)
+            if dtype in ("float16", "bfloat16"):
+                rtol, atol = (op.tol or {}).get(dtype, (5e-2, 5e-2))
+                np.testing.assert_allclose(got, want, rtol=rtol, atol=atol,
+                                           err_msg=f"{op.name}[{dtype}]")
+            else:
+                np.testing.assert_array_equal(got, want,
+                                              err_msg=f"{op.name}[{dtype}]")
+
+    def test_grads_finite(self, op):
+        """Differentiable ops: backward produces finite grads in every
+        declared float dtype (catches NaN-at-boundary VJPs)."""
+        if not op.has_vjp:
+            pytest.skip("non-differentiable")
+        for dtype in op.dtypes:
+            if dtype not in ("float32", "float16", "bfloat16"):
+                continue
+            rng = np.random.default_rng(1)
+            fn = _resolve(op.name)
+            args, kwargs = op.sample(rng)
+            targs = [paddle.to_tensor(a.astype(dtype), stop_gradient=False)
+                     if isinstance(a, np.ndarray) and a.dtype.kind == "f"
+                     else (paddle.to_tensor(a) if isinstance(a, np.ndarray)
+                           else a)
+                     for a in args]
+            out = fn(*targs, **kwargs)
+            out_t = _first_tensor(out)
+            if out_t is None or out_t.stop_gradient:
+                continue
+            loss = paddle.sum(out_t * out_t)
+            loss.backward()
+            for t in targs:
+                if hasattr(t, "grad") and t.grad is not None:
+                    g = np.asarray(t.grad.numpy(), dtype=np.float64)
+                    assert np.isfinite(g).all(), f"{op.name}[{dtype}] grad"
+
+
+@pytest.fixture
+def _flag():
+    """Set FLAGS_check_nan_inf for one test, restoring the prior value."""
+    def setter(value):
+        paddle.set_flags({"FLAGS_check_nan_inf": value})
+    prior = paddle.get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]
+    yield setter
+    paddle.set_flags({"FLAGS_check_nan_inf": prior})
+
+
+class TestNanInfFlag:
+    def test_raises_on_nan(self, _flag):
+        _flag(True)
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        with pytest.raises(FloatingPointError, match="log"):
+            paddle.log(x)  # log(-1) = nan
+
+    def test_silent_when_off(self, _flag):
+        _flag(False)
+        x = paddle.to_tensor(np.array([1.0, -1.0], np.float32))
+        y = paddle.log(x)
+        assert np.isnan(np.asarray(y.numpy())).any()
+
+    def test_clean_ops_pass(self, _flag):
+        _flag(True)
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        y = paddle.exp(x) + paddle.sqrt(x)
+        assert np.isfinite(np.asarray(y.numpy())).all()
