@@ -44,6 +44,11 @@ from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
 from . import hapi  # noqa: E402
 from . import distribution  # noqa: E402
+from . import sparse  # noqa: E402
+from . import geometric  # noqa: E402
+from . import quantization  # noqa: E402
+from . import audio  # noqa: E402
+from . import text  # noqa: E402
 from . import static  # noqa: E402
 from . import profiler  # noqa: E402
 from .hapi import Model  # noqa: E402

@@ -1,0 +1,5 @@
+"""paddle.audio — DSP feature domain library (SURVEY C48; reference
+python/paddle/audio/)."""
+
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

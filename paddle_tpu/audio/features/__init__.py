@@ -1,0 +1,123 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram / LogMel / MFCC
+layers (reference python/paddle/audio/features/layers.py:24,106,206,309).
+
+TPU-native: framing is one strided gather, the DFT is a (win, 2F) matmul
+against a precomputed real/imag basis, mel and DCT are further matmuls —
+the whole feature stack is MXU-friendly and jit/grad-safe (no FFT runtime
+dependency on the device).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn.layer import Layer
+from ...tensor import Tensor, to_tensor
+from ..functional import (compute_fbank_matrix, create_dct, get_window,
+                          power_to_db)
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+def _frame(x, frame_length, hop_length, center, pad_mode):
+    if center:
+        pad = frame_length // 2
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    n = 1 + (x.shape[-1] - frame_length) // hop_length
+    idx = (jnp.arange(n)[:, None] * hop_length
+           + jnp.arange(frame_length)[None, :])
+    return x[..., idx]  # (..., n_frames, frame_length)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        win = get_window(window, self.win_length, dtype=dtype)._data
+        if self.win_length < n_fft:  # center-pad the window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            win = jnp.pad(win, (lpad, n_fft - self.win_length - lpad))
+        self._win = win
+        k = np.arange(1 + n_fft // 2)[:, None]
+        t = np.arange(n_fft)[None, :]
+        ang = -2 * np.pi * k * t / n_fft
+        self._cos = jnp.asarray(np.cos(ang).T, jnp.float32)  # (n_fft, F)
+        self._sin = jnp.asarray(np.sin(ang).T, jnp.float32)
+
+    def forward(self, x):
+        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        frames = _frame(raw.astype(jnp.float32), self.n_fft, self.hop,
+                        self.center, self.pad_mode)
+        frames = frames * self._win
+        re = frames @ self._cos
+        im = frames @ self._sin
+        mag2 = re * re + im * im            # (..., n_frames, F)
+        spec = jnp.power(jnp.maximum(mag2, 1e-30), self.power / 2.0)
+        return to_tensor(jnp.swapaxes(spec, -1, -2))  # (..., F, n_frames)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm: Union[str, float] = "slaney",
+                 dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.fbank_matrix = compute_fbank_matrix(
+            sr=sr, n_fft=n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max,
+            htk=htk, norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)._data
+        mel = self.fbank_matrix._data @ spec
+        return to_tensor(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 **mel_kwargs):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(sr=sr, **mel_kwargs)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return power_to_db(mel, ref_value=self.ref_value, amin=self.amin,
+                           top_db=self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_mels: int = 64,
+                 **logmel_kwargs):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(sr=sr, n_mels=n_mels,
+                                                     **logmel_kwargs)
+        self.dct_matrix = create_dct(n_mfcc=n_mfcc, n_mels=n_mels)
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)._data
+        out = jnp.swapaxes(
+            jnp.swapaxes(logmel, -1, -2) @ self.dct_matrix._data, -1, -2)
+        return to_tensor(out)

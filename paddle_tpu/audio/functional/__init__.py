@@ -1,0 +1,158 @@
+"""paddle.audio.functional — mel/DCT/window DSP primitives (SURVEY C48).
+
+Reference: python/paddle/audio/functional/{functional.py,window.py}.
+TPU-native: everything is jnp (STFT frames batch into one big matmul with
+the DFT/mel bases — MXU work, not a CPU resampler in the loop).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, to_tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct", "get_window"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def hz_to_mel(freq, htk: bool = False):
+    """Reference audio/functional/functional.py:22 (slaney default)."""
+    scalar = isinstance(freq, (int, float))
+    f = jnp.asarray(freq, jnp.float32) if scalar else _raw(freq)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mels = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                              / min_log_hz) / logstep,
+                        mels)
+    return float(out) if scalar else to_tensor(out)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = isinstance(mel, (int, float))
+    m = jnp.asarray(mel, jnp.float32) if scalar else _raw(mel)
+    if htk:
+        out = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        freqs = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        out = jnp.where(m >= min_log_mel,
+                        min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                        freqs)
+    return float(out) if scalar else to_tensor(out)
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype: str = "float32"):
+    lo = hz_to_mel(float(f_min), htk)
+    hi = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(lo, hi, n_mels)
+    return to_tensor(_raw(mel_to_hz(to_tensor(mels), htk)).astype(dtype))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype: str = "float32"):
+    return to_tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2).astype(dtype))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype: str = "float32"):
+    """(n_mels, 1 + n_fft//2) triangular mel filterbank
+    (functional.py:186)."""
+    if f_max is None:
+        f_max = sr / 2.0
+    fft_f = _raw(fft_frequencies(sr, n_fft))
+    mel_f = _raw(mel_frequencies(n_mels + 2, f_min, f_max, htk))
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0.0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        weights = weights / jnp.maximum(
+            1e-10, jnp.linalg.norm(weights, ord=norm, axis=-1, keepdims=True))
+    return to_tensor(weights.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    """10*log10(S/ref) with clamp (functional.py:259)."""
+    x = _raw(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+    if top_db is not None:
+        if top_db < 0:
+            raise ValueError("top_db must be non-negative")
+        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+    return to_tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype: str = "float32"):
+    """(n_mels, n_mfcc) DCT-II basis (functional.py:303)."""
+    n = jnp.arange(n_mels, dtype=jnp.float64)
+    k = jnp.arange(n_mfcc, dtype=jnp.float64)[:, None]
+    dct = jnp.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    elif norm == "ortho":
+        dct = dct * jnp.sqrt(2.0 / n_mels)
+        dct = dct.at[0].multiply(1.0 / jnp.sqrt(2.0))
+    else:
+        raise ValueError(f"unsupported norm {norm}")
+    return to_tensor(dct.T.astype(dtype))
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype: str = "float32"):
+    """hann/hamming/blackman/bartlett/kaiser/gaussian/taylor subset of
+    window.py:335."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    M = win_length + (0 if fftbins else -1) + 1 if not fftbins else win_length
+    sym_m = win_length if fftbins else win_length
+    n = np.arange(win_length)
+    L = win_length if fftbins else win_length - 1
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * n / L)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * n / L)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * n / L)
+             + 0.08 * np.cos(4 * np.pi * n / L))
+    elif name == "bartlett":
+        w = 1.0 - np.abs(2 * n / L - 1.0)
+    elif name == "kaiser":
+        beta = args[0] if args else 12.0
+        w = np.i0(beta * np.sqrt(np.clip(
+            1 - (2 * n / L - 1.0) ** 2, 0, None))) / np.i0(beta)
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((n - L / 2.0) / std) ** 2)
+    else:
+        raise ValueError(f"unsupported window {name}")
+    return to_tensor(jnp.asarray(w, dtype=jnp.dtype(dtype)))

@@ -1,0 +1,203 @@
+"""Cost model + parallel-config auto-tuner (SURVEY C49 / C32 planner).
+
+Reference analog: `python/paddle/distributed/auto_tuner/tuner.py:19` (search
+over dp/mp/pp/sharding candidates), `auto_tuner/prune.py` (memory/validity
+pruning) and the static auto-parallel cost model
+(`auto_parallel/static/cost_model.py`).  The reference tunes by LAUNCHING
+trial runs; a TPU mesh is predictable enough to rank analytically first —
+this module builds the roofline estimate (MXU time + ICI collective time +
+pipeline bubble + HBM fit) for every legal mesh factorization and returns
+the ranked plans.  `measure=` hooks a callable for trial-run refinement of
+the top-k, which is the reference's behavior.
+
+The arithmetic follows the public scaling-book recipe: collective cost =
+bytes x (axis-1)/axis / ICI bandwidth; pipeline bubble = (p-1)/(m+p-1);
+ZeRO-3 adds a param all-gather per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Callable, List, Optional
+
+__all__ = ["ChipSpec", "Plan", "CostModel", "AutoTuner", "V5E", "V5P"]
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipSpec:
+    """Per-chip hardware numbers (bf16 peak, HBM, ICI per direction)."""
+    name: str
+    peak_flops: float          # bf16 FLOP/s
+    hbm_bytes: float
+    ici_bw: float              # bytes/s per link direction
+    mxu_efficiency: float = 0.55   # achievable fraction of peak on big GEMMs
+
+
+V5E = ChipSpec("v5e", 197e12, 16e9, 4.5e10)
+V5P = ChipSpec("v5p", 459e12, 95e9, 9e10)
+
+
+@dataclasses.dataclass
+class Plan:
+    data: int
+    sharding: int
+    model: int
+    pipe: int
+    sep: int
+    zero_stage: int
+    micro_batches: int
+    step_time: float           # seconds (estimated)
+    mem_bytes: float           # per-chip bytes (estimated)
+    breakdown: dict
+
+    @property
+    def mesh_sizes(self):
+        return {"data": self.data, "sharding": self.sharding,
+                "model": self.model, "pipe": self.pipe, "sep": self.sep}
+
+
+class CostModel:
+    """Analytic roofline for one transformer train step on a mesh."""
+
+    def __init__(self, chip: ChipSpec):
+        self.chip = chip
+
+    # -- model arithmetic ---------------------------------------------------
+    @staticmethod
+    def _stats(c):
+        E, F, V, L = (c.hidden_size, c.intermediate_size, c.vocab_size,
+                      c.num_hidden_layers)
+        D, Hq, Hkv = c.hd, c.num_attention_heads, c.num_key_value_heads
+        layer = E * Hq * D + 2 * E * Hkv * D + Hq * D * E + 3 * E * F
+        n_params = L * layer + 2 * E * V + E  # + embeds/head/norms
+        return n_params, layer
+
+    def estimate(self, config, n_tokens_global: int, seq: int, sizes: dict,
+                 zero_stage: int, micro_batches: int) -> Optional[Plan]:
+        """Step-time + memory for one mesh plan; None when it cannot run."""
+        c = self.chip
+        dp = sizes["data"] * sizes["sharding"]
+        tp, pp, sp = sizes["model"], sizes["pipe"], sizes["sep"]
+        chips = dp * tp * pp * sp
+        N, layer_params = self._stats(config)
+        E, L, S = config.hidden_size, config.num_hidden_layers, seq
+        if L % pp or config.num_attention_heads % tp or S % sp:
+            return None
+        if n_tokens_global % (dp * micro_batches * S):
+            return None
+        B_local = n_tokens_global // (dp * S)           # sequences per dp rank
+        mb_seqs = B_local // micro_batches
+        if mb_seqs == 0:
+            return None
+
+        # ---- memory (bytes/chip): bf16 params + f32 master+m+v (14 B/param
+        # replicated; ZeRO divides the f32 trio, stage 3 also the bf16 copy)
+        shard = sizes["sharding"] if zero_stage >= 1 else 1
+        p_local = N / (tp * pp)
+        opt_b = 12 * p_local / shard
+        par_b = 2 * p_local / (shard if zero_stage >= 3 else 1)
+        grad_b = 2 * p_local / (shard if zero_stage >= 2 else 1)
+        # activations: remat keeps ~2 live layer activations per microbatch
+        # in flight; pp stages hold up to `pp` microbatches (1F1B bound)
+        act_per_layer = 2 * mb_seqs * (S // sp) * E * 4
+        act_b = act_per_layer * 2 * max(pp, 1) + 2 * mb_seqs * (S // sp) * config.vocab_size * 4 / max(tp, 1)
+        mem = opt_b + par_b + grad_b + act_b
+        if mem > c.hbm_bytes * 0.92:
+            return None
+
+        # ---- compute time: 6N + attention flops per token
+        attn = L * 2 * S * config.num_attention_heads * config.hd
+        flops_tok = 6.0 * (N + attn / 3)  # fwd+bwd, causal-averaged
+        t_compute = (n_tokens_global * flops_tok) / (
+            chips * c.peak_flops * c.mxu_efficiency)
+
+        # ---- collectives (per step, overlapped factor 0.5 vs compute)
+        def ring(bytes_, axis):
+            return 0.0 if axis <= 1 else 2 * bytes_ * (axis - 1) / axis / c.ici_bw
+
+        # grad reduce over dp (bf16 grads once per step)
+        t_dp = ring(2 * p_local / (1 if zero_stage < 2 else 1), dp)
+        # tp: 4 allreduces of activations per layer per microbatch chunk
+        act_bytes = 2 * mb_seqs * (S // sp) * E
+        t_tp = micro_batches * L / pp * 4 * ring(act_bytes, tp)
+        # sp ring: kv bytes circulate once per layer
+        kv_bytes = 2 * 2 * mb_seqs * (S // sp) * config.num_key_value_heads * config.hd
+        t_sp = 0.0 if sp <= 1 else micro_batches * (L / pp) * (sp - 1) * kv_bytes / c.ici_bw
+        # zero-3 param all-gather (bf16 params once fwd + once bwd)
+        t_z3 = ring(2 * 2 * p_local, shard) if zero_stage >= 3 else 0.0
+        t_comm = 0.5 * (t_dp + t_tp + t_sp + t_z3)  # partial overlap
+
+        # ---- pipeline bubble
+        bubble = (pp - 1) / (micro_batches + pp - 1) if pp > 1 else 0.0
+        t = (t_compute + t_comm) / max(1e-9, 1 - bubble)
+        return Plan(sizes["data"], sizes["sharding"], tp, pp, sp, zero_stage,
+                    micro_batches, t, mem,
+                    {"compute": t_compute, "comm": t_comm, "bubble": bubble,
+                     "mem_opt": opt_b, "mem_act": act_b})
+
+
+def _factorizations(n: int, axes: int):
+    """All ordered tuples of `axes` divisors with product n."""
+    if axes == 1:
+        yield (n,)
+        return
+    for d in range(1, n + 1):
+        if n % d == 0:
+            for rest in _factorizations(n // d, axes - 1):
+                yield (d,) + rest
+
+
+class AutoTuner:
+    """Enumerate legal plans, prune by memory, rank by estimated step time
+    (reference auto_tuner/tuner.py:19 search loop + prune.py)."""
+
+    def __init__(self, chip: ChipSpec = V5P,
+                 zero_stages=(1, 2, 3), max_tp: int = 8,
+                 micro_batch_candidates=(1, 2, 4, 8, 16)):
+        self.cost = CostModel(chip)
+        self.zero_stages = zero_stages
+        self.max_tp = max_tp
+        self.mb_cands = micro_batch_candidates
+
+    def tune(self, config, n_chips: int, global_batch: int, seq: int,
+             use_sep: bool = False, top_k: int = 5,
+             measure: Optional[Callable[[Plan], float]] = None) -> List[Plan]:
+        n_tokens = global_batch * seq
+        plans: List[Plan] = []
+        for (dp, sh, tp, pp, sp) in _factorizations(n_chips, 5):
+            if tp > self.max_tp or (sp > 1 and not use_sep):
+                continue
+            sizes = {"data": dp, "sharding": sh, "model": tp,
+                     "pipe": pp, "sep": sp}
+            for z in self.zero_stages:
+                if z >= 1 and sh == 1 and z != min(self.zero_stages):
+                    continue  # zero stages differ only via the sharding axis
+                for mb in self.mb_cands:
+                    if pp > 1 and mb < pp:
+                        continue  # 1F1B needs m >= p
+                    p = self.cost.estimate(config, n_tokens, seq, sizes, z, mb)
+                    if p is not None:
+                        plans.append(p)
+        plans.sort(key=lambda p: p.step_time)
+        # dedupe identical mesh+schedule keeping the fastest
+        seen, uniq = set(), []
+        for p in plans:
+            key = (p.data, p.sharding, p.model, p.pipe, p.sep, p.zero_stage,
+                   p.micro_batches)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(p)
+        uniq = uniq[:max(top_k, 1)]
+        if measure is not None:  # trial-run refinement, reference-style
+            timed = [(measure(p), p) for p in uniq]
+            timed.sort(key=lambda tp_: tp_[0])
+            for t, p in timed:
+                p.step_time = t
+            uniq = [p for _, p in timed]
+        if not uniq:
+            raise RuntimeError(
+                f"no parallel plan fits: model does not fit {n_chips} x "
+                f"{self.cost.chip.name} ({self.cost.chip.hbm_bytes/1e9:.0f} GB)"
+                " — add chips, raise zero_stage options, or shrink the batch")
+        return uniq

@@ -116,6 +116,10 @@ class _GlobalState(threading.local):
             "FLAGS_use_fused_kernels": True,
             "FLAGS_pallas_interpret": False,
             "FLAGS_embedding_deterministic": False,
+            # record op fn/args on the tape for grad(create_graph=True)
+            # replay; disable to shed the extra references on memory-bound
+            # eager jobs (higher-order grad then raises)
+            "FLAGS_enable_double_grad": True,
         }
 
 

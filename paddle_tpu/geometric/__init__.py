@@ -1,0 +1,121 @@
+"""paddle.geometric — graph message passing + segment ops (SURVEY C48).
+
+Reference: python/paddle/geometric/message_passing/send_recv.py:36
+(send_u_recv), :187 (send_ue_recv), send_uv, and geometric/math.py segment
+ops.  TPU-native: gather + `jax.ops.segment_*` — static shapes (out_size /
+num_segments must be concrete under jit), fully differentiable, and XLA
+lowers the scatter-reduce onto the VPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply_op
+
+__all__ = [
+    "send_u_recv", "send_ue_recv", "send_uv",
+    "segment_sum", "segment_mean", "segment_min", "segment_max",
+]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(x):
+    from ..tensor import to_tensor
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # composed below
+    "min": jax.ops.segment_min,
+    "max": jax.ops.segment_max,
+}
+
+
+def _segment(reduce_op, data, seg_ids, num_segments):
+    if reduce_op == "mean":
+        s = jax.ops.segment_sum(data, seg_ids, num_segments=num_segments)
+        n = jax.ops.segment_sum(jnp.ones(seg_ids.shape, data.dtype), seg_ids,
+                                num_segments=num_segments)
+        return s / jnp.maximum(n, 1).reshape(
+            (-1,) + (1,) * (data.ndim - 1))
+    out = _SEG[reduce_op](data, seg_ids, num_segments=num_segments)
+    if reduce_op in ("min", "max"):
+        # empty segments come back +/-inf; the reference zeroes them
+        out = jnp.where(jnp.isfinite(out), out, 0).astype(data.dtype)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum",
+                out_size: Optional[int] = None, name=None):
+    """Gather x[src] along edges, reduce onto dst (send_recv.py:36)."""
+    if reduce_op not in ("sum", "mean", "min", "max"):
+        raise ValueError(f"unsupported reduce_op {reduce_op}")
+    xt, st, dt = _t(x), _t(src_index), _t(dst_index)
+    n_out = int(out_size) if out_size is not None else int(xt.shape[0])
+
+    def f(xr, sr, dr):
+        msg = jnp.take(xr, sr, axis=0)
+        return _segment(reduce_op, msg, dr, n_out)
+
+    return apply_op("send_u_recv", f, xt, st, dt, nondiff=(1, 2))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size: Optional[int] = None, name=None):
+    """x[src] (op) y_edge, reduced onto dst (send_recv.py:187)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op}")
+    if reduce_op not in ("sum", "mean", "min", "max"):
+        raise ValueError(f"unsupported reduce_op {reduce_op}")
+    xt, yt, st, dt = _t(x), _t(y), _t(src_index), _t(dst_index)
+    n_out = int(out_size) if out_size is not None else int(xt.shape[0])
+
+    def f(xr, yr, sr, dr):
+        msg = ops[message_op](jnp.take(xr, sr, axis=0), yr)
+        return _segment(reduce_op, msg, dr, n_out)
+
+    return apply_op("send_ue_recv", f, xt, yt, st, dt, nondiff=(2, 3))
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message x[src] (op) y[dst] (send_recv.py send_uv)."""
+    ops = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}
+    if message_op not in ops:
+        raise ValueError(f"unsupported message_op {message_op}")
+    xt, yt, st, dt = _t(x), _t(y), _t(src_index), _t(dst_index)
+
+    def f(xr, yr, sr, dr):
+        return ops[message_op](jnp.take(xr, sr, axis=0),
+                               jnp.take(yr, dr, axis=0))
+
+    return apply_op("send_uv", f, xt, yt, st, dt, nondiff=(2, 3))
+
+
+def _segment_api(reduce_op):
+    def op(data, segment_ids, name=None):
+        dt, st = _t(data), _t(segment_ids)
+        n = int(jnp.max(st._data)) + 1 if st._data.size else 0
+
+        def f(dr, sr):
+            return _segment(reduce_op, dr, sr, n)
+
+        return apply_op(f"segment_{reduce_op}", f, dt, st, nondiff=(1,))
+    op.__name__ = f"segment_{reduce_op}"
+    return op
+
+
+segment_sum = _segment_api("sum")
+segment_mean = _segment_api("mean")
+segment_min = _segment_api("min")
+segment_max = _segment_api("max")

@@ -1,8 +1,10 @@
 """High-level paddle.Model (reference: python/paddle/hapi/model.py:1048 Model,
 fit at :1750) — prepare/fit/evaluate/predict/save/load over an nn.Layer.
 
-TPU-native: train/eval steps are plain eager tape steps (each op jit-cached);
-inputs batch through paddle_tpu.io.DataLoader; device transfer is implicit in
+TPU-native: the default train path is ONE fused XLA step per batch
+(jit.TrainStep: forward+backward+update, donated buffers); per-batch metrics,
+gradient accumulation and AMP contexts fall back to the eager tape step.
+Inputs batch through paddle_tpu.io.DataLoader; device transfer is implicit in
 jnp (device_put on first op).  The dygraph/static dual engine of the reference
 collapses — XLA is always the executor.
 """
@@ -47,6 +49,9 @@ class Model:
             if not isinstance(m, Metric):
                 raise TypeError(f"metric {m} must be a paddle_tpu.metric.Metric")
         self._metrics = ms
+        # a new optimizer/loss invalidates any fused step built for the old
+        self._jit_step = None
+        self._jit_step_nin = None
         return self
 
     # -- single-batch ops (train_batch hapi parity) ------------------------
@@ -54,6 +59,27 @@ class Model:
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        # hot path: one fused XLA step (jit.TrainStep) whenever the eager
+        # machinery isn't needed — no per-batch metrics over outputs, no
+        # gradient accumulation, no AMP context.  Metrics/accumulation fall
+        # back to the tape step below.
+        from .. import framework as _fw
+
+        eligible = (update and loss_scale == 1.0 and not self._metrics
+                    and _fw.get_state().amp_state is None)
+        if eligible:
+            step = self._fused_step(len(inputs))
+            if step is not None:
+                loss = step(*[_as_tensor(x) for x in inputs + labels])
+                return [float(np.asarray(getattr(loss, "data", loss)))]
+        elif getattr(self, "_jit_step", None):
+            # the fused step owns the optimizer moments; silently switching
+            # to the eager path would restart Adam/momentum state mid-run
+            raise RuntimeError(
+                "this Model already trained with the fused step; cannot mix "
+                "in eager batches (metrics/grad-accumulation/AMP) mid-run — "
+                "call prepare() again to reset, or set those options before "
+                "the first fit()")
         outputs = self.network(*[_as_tensor(x) for x in inputs])
         losses = self._compute_loss(outputs, labels)
         total = losses[0]
@@ -68,6 +94,41 @@ class Model:
         metrics = [float(np.asarray(l.data)) for l in losses]
         m_res = self._update_metrics(outputs, labels)
         return (metrics, m_res) if m_res else metrics
+
+    def _fused_step(self, n_in):
+        """Build (once) a jit.TrainStep over network+loss+optimizer.
+
+        NB: the fused step owns a functional optimizer state; a fit() that
+        mixes fused and eager batches would desync them, which is why every
+        eligibility condition is checked per batch above."""
+        cached = getattr(self, "_jit_step", None)
+        if cached is not None:
+            if getattr(self, "_jit_step_nin", None) != n_in and cached:
+                raise RuntimeError(
+                    "input arity changed after fused training began; "
+                    "re-prepare() the Model to rebuild the step")
+            return cached or None
+        from .. import jit
+
+        loss_obj = self._loss
+        if loss_obj is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+
+        def loss_fn(model, *batch):
+            outs = _to_list(model(*batch[:n_in]))
+            losses = _to_list(loss_obj(*(outs + list(batch[n_in:]))))
+            total = losses[0]
+            for l in losses[1:]:
+                total = total + l
+            return total
+
+        try:
+            self._jit_step = jit.TrainStep(self.network, loss_fn,
+                                           self._optimizer)
+        except Exception:  # noqa: BLE001 — exotic models keep the eager path
+            self._jit_step = False
+        self._jit_step_nin = n_in
+        return self._jit_step or None
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()

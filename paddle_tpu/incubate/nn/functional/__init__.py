@@ -238,7 +238,52 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
     return apply_op("var_len_mem_eff_attention", f, *xs)
 
 
-def masked_multihead_attention(x, cache_kv=None, *args, **kwargs):
-    raise NotImplementedError(
-        "masked_multihead_attention (decode-phase CUDA kernel) — use "
-        "models.llama generation path; planned for the serving runtime")
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               sequence_lengths=None, rotary_tensor=None,
+                               seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False, out_scale=-1,
+                               **_unsupported):
+    """Decode-phase attention with KV cache — one new token per sequence.
+
+    Reference: incubate/nn/functional/masked_multihead_attention.py wrapping
+    phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu.  TPU-native:
+    jnp composition (dynamic cache update + masked softmax) that XLA fuses;
+    the batched-decode serving path in models/generation.py uses the same
+    math with a lax.scan loop.
+
+    x: (B, 3*H*D) fused qkv of the CURRENT step.
+    cache_kv: (2, B, H, max_seq, D); slot `t` = current length (from
+        `sequence_lengths` (B,) or (B,1); defaults to 0).
+    bias: (3*H*D,) qkv bias; src_mask: broadcastable additive mask over the
+        cache axis, e.g. (B, 1, 1, max_seq).
+    rotary_tensor: (B, 1, 1, max_seq, D) [cos; sin] interleaved convention of
+        the reference when rotary_emb_dims > 0 — rotary applied to q/k.
+    Returns (out (B, H*D), updated cache_kv).
+    """
+    if out_scale != -1:
+        raise NotImplementedError("quantized out_scale path not supported")
+    xt, ct = _t(x), _t(cache_kv)
+    exts = []
+    if bias is not None:
+        exts.append(_t(bias))
+    if src_mask is not None:
+        exts.append(_t(src_mask))
+    if sequence_lengths is not None:
+        exts.append(_t(sequence_lengths))
+    if rotary_tensor is not None:
+        exts.append(_t(rotary_tensor))
+    flags = (bias is not None, src_mask is not None,
+             sequence_lengths is not None, rotary_tensor is not None)
+
+    def f(xr, cr, *extra):
+        it = iter(extra)
+        b = next(it) if flags[0] else None
+        sm = next(it) if flags[1] else None
+        sl = next(it) if flags[2] else None
+        rot = next(it) if flags[3] else None
+        return kernels.masked_multihead_attention_reference(
+            xr, cr, bias=b, src_mask=sm, sequence_lengths=sl,
+            rotary_tensor=rot, rotary_emb_dims=rotary_emb_dims,
+            use_neox_rotary_style=use_neox_rotary_style)
+
+    return apply_op("masked_multihead_attention", f, xt, ct, *exts)

@@ -264,8 +264,43 @@ def default_collate_fn(batch):
     return batch
 
 
+class _MPUnpicklable(Exception):
+    """Dataset/collate not picklable for spawned workers."""
+
+
+def _mp_worker_main(payload, worker_id, idx_q, out_q):
+    # loader workers do HOST-side work only — pin them to the CPU platform
+    # before anything imports jax (env alone is not enough: a wedged TPU
+    # plugin can block the first dispatch even when unselected)
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:  # noqa: BLE001 — jax-free datasets don't need this
+        pass
+    import pickle
+
+    dataset, collate, init_fn = pickle.loads(payload)
+    if init_fn is not None:
+        init_fn(worker_id)
+    while True:
+        item = idx_q.get()
+        if item is None:
+            break
+        bid, indices = item
+        try:
+            out_q.put((bid, None, collate([dataset[i] for i in indices])))
+        except Exception as e:  # noqa: BLE001
+            out_q.put((bid, f"{type(e).__name__}: {e}", None))
+
+
 class DataLoader:
-    """Background-thread prefetching loader (reference: io/reader.py:216)."""
+    """Prefetching loader (reference: io/reader.py:216): num_workers=0 is
+    synchronous, otherwise SPAWNED worker processes fetch and collate
+    (map-style datasets; iterable datasets use a prefetch thread)."""
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
@@ -275,6 +310,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
         self.prefetch_factor = max(prefetch_factor, 2)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -310,6 +346,15 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._gen_batches()
             return
+        if not self._iterable_mode:
+            # multiprocess workers (reference: io/dataloader/dataloader_iter.py
+            # :358 _DataLoaderIterMultiProcess) — real parallelism for
+            # Python-bound datasets so the device feed never starves
+            try:
+                yield from self._mp_batches()
+                return
+            except (_MPUnpicklable, ImportError):
+                pass  # unpicklable dataset/collate: thread prefetch below
         # background prefetch thread (double buffering toward the device feed)
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor * max(self.num_workers, 1))
         sentinel = object()
@@ -333,3 +378,59 @@ class DataLoader:
                     raise error_holder[0]
                 break
             yield item
+
+    def _mp_batches(self):
+        """Spawned worker processes fetch+collate batches; the parent
+        reorders by batch id so iteration order matches num_workers=0.
+
+        Spawn (not fork): forking after XLA's thread pools exist can
+        deadlock; spawned workers import only the dataset's module."""
+        import multiprocessing as mp
+        import pickle
+
+        ctx = mp.get_context("spawn")
+        batches = list(self.batch_sampler)
+        try:
+            payload = pickle.dumps(
+                (self.dataset, self.collate_fn, self.worker_init_fn))
+        except Exception as e:  # noqa: BLE001
+            raise _MPUnpicklable(str(e)) from e
+        idx_q = ctx.Queue()
+        out_q = ctx.Queue(maxsize=self.prefetch_factor * self.num_workers)
+        for i, b in enumerate(batches):
+            idx_q.put((i, list(b)))
+        workers = []
+        for wid in range(self.num_workers):
+            idx_q.put(None)  # one sentinel per worker
+            w = ctx.Process(target=_mp_worker_main,
+                            args=(payload, wid, idx_q, out_q), daemon=True)
+            w.start()
+            workers.append(w)
+        try:
+            import queue as _queue
+            pending = {}
+            want = 0
+            got = 0
+            while got < len(batches):
+                try:
+                    bid, err, data = out_q.get(timeout=5.0)
+                except _queue.Empty:
+                    dead = [w.exitcode for w in workers
+                            if not w.is_alive() and w.exitcode != 0]
+                    if dead:
+                        raise RuntimeError(
+                            f"DataLoader worker died (exit codes {dead}) "
+                            "before finishing its batches")
+                    continue
+                got += 1
+                if err is not None:
+                    raise RuntimeError(f"DataLoader worker failed: {err}")
+                pending[bid] = data
+                while want in pending:
+                    yield pending.pop(want)
+                    want += 1
+        finally:
+            for w in workers:
+                if w.is_alive():
+                    w.terminate()
+                w.join()

@@ -1,0 +1,129 @@
+"""Token-file dataset over the native (C++) data-IO core.
+
+Reference analog: the C++ DataFeed/Dataset pipeline
+(paddle/fluid/framework/data_feed.cc InMemoryDataFeed, data_set.cc shuffle)
+— the file-ingestion + shuffle capability the Python-level DataLoader lacks.
+A flat binary file of fixed-width token rows (the standard pretraining
+pack format) is mmap'd in C++ (native/dataio.cpp); epochs shuffle with a
+seeded Fisher-Yates; batches come back as ready int32 numpy blocks, so the
+accelerator feed never waits on a Python inner loop.  Falls back to a
+numpy memmap when no toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Iterator, Optional
+
+import numpy as np
+
+from .. import native
+
+__all__ = ["TokenFileDataset", "write_token_file"]
+
+
+def write_token_file(path: str, tokens: np.ndarray) -> str:
+    """Pack a (rows, row_len) int array into the flat binary format."""
+    arr = np.ascontiguousarray(tokens)
+    if arr.dtype not in (np.int32, np.uint16):
+        arr = arr.astype(np.int32)
+    arr.tofile(path)
+    return path
+
+
+class TokenFileDataset:
+    """Iterable over shuffled (batch, row_len) int32 batches of a packed
+    token file.  Deterministic per (seed, epoch)."""
+
+    def __init__(self, path: str, row_len: int, batch_size: int,
+                 dtype: str = "int32", shuffle: bool = True, seed: int = 0,
+                 drop_last: bool = False):
+        self.path = path
+        self.row_len = int(row_len)
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.drop_last = drop_last
+        self.itemsize = {"int32": 4, "uint16": 2}[dtype]
+        self._dtype = dtype
+        self._epoch = 0
+        self._lib = native.load("dataio")
+        if self._lib is not None:
+            self._bind(self._lib)
+            self._h = self._lib.dataio_open(
+                path.encode(), self.row_len, self.itemsize)
+            if not self._h:
+                raise FileNotFoundError(f"cannot open token file {path}")
+            self._n = self._lib.dataio_num_rows(self._h)
+            self._sampler = self._lib.dataio_sampler_new(self._h, self.seed)
+        else:  # pure-numpy fallback (no toolchain)
+            self._mm = np.memmap(path, dtype=self._dtype, mode="r")
+            self._n = self._mm.shape[0] // self.row_len
+            self._h = self._sampler = None
+
+    @staticmethod
+    def _bind(lib):
+        lib.dataio_open.restype = ctypes.c_void_p
+        lib.dataio_open.argtypes = [ctypes.c_char_p, ctypes.c_int64,
+                                    ctypes.c_int]
+        lib.dataio_num_rows.restype = ctypes.c_int64
+        lib.dataio_num_rows.argtypes = [ctypes.c_void_p]
+        lib.dataio_sampler_new.restype = ctypes.c_void_p
+        lib.dataio_sampler_new.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.dataio_sampler_epoch.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                             ctypes.c_int]
+        lib.dataio_next_batch.restype = ctypes.c_int64
+        lib.dataio_next_batch.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                          ctypes.c_int64, ctypes.c_void_p]
+        lib.dataio_gather.restype = ctypes.c_int64
+        lib.dataio_gather.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_int64, ctypes.c_void_p]
+        lib.dataio_sampler_free.argtypes = [ctypes.c_void_p]
+        lib.dataio_close.argtypes = [ctypes.c_void_p]
+
+    def __len__(self):
+        q, r = divmod(self._n, self.batch_size)
+        return q if (self.drop_last or r == 0) else q + 1
+
+    @property
+    def num_rows(self) -> int:
+        return int(self._n)
+
+    def set_epoch(self, epoch: int):
+        self._epoch = int(epoch)
+
+    def __iter__(self) -> Iterator[np.ndarray]:
+        if self._lib is not None:
+            self._lib.dataio_sampler_epoch(
+                self._sampler, self._epoch, 1 if self.shuffle else 0)
+            while True:
+                out = np.empty((self.batch_size, self.row_len), np.int32)
+                got = self._lib.dataio_next_batch(
+                    self._h, self._sampler, self.batch_size,
+                    out.ctypes.data_as(ctypes.c_void_p))
+                if got <= 0:
+                    break
+                if got < self.batch_size and self.drop_last:
+                    break
+                yield out[:got]
+        else:
+            order = np.arange(self._n)
+            if self.shuffle:
+                np.random.default_rng(
+                    self.seed ^ (0x9E3779B9 * (self._epoch + 1))).shuffle(order)
+            data = self._mm.reshape(self._n, self.row_len)
+            for i in range(0, self._n, self.batch_size):
+                idx = order[i:i + self.batch_size]
+                if len(idx) < self.batch_size and self.drop_last:
+                    break
+                yield np.asarray(data[idx], np.int32)
+        self._epoch += 1
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        if lib is not None:
+            if getattr(self, "_sampler", None):
+                lib.dataio_sampler_free(self._sampler)
+            if getattr(self, "_h", None):
+                lib.dataio_close(self._h)

@@ -190,3 +190,73 @@ def swiglu(x, y=None):
         a, b = jnp.split(x, 2, axis=-1)
         return jax.nn.silu(a) * b
     return jax.nn.silu(x) * y
+
+
+# ---------------------------------------------------------------------------
+# Decode-phase masked multi-head attention (reference:
+# phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu)
+# ---------------------------------------------------------------------------
+
+
+def masked_multihead_attention_reference(x, cache_kv, bias=None, src_mask=None,
+                                         sequence_lengths=None,
+                                         rotary_tensor=None,
+                                         rotary_emb_dims=0,
+                                         use_neox_rotary_style=False):
+    """x: (B, 3*H*D) fused qkv, one step; cache_kv: (2, B, H, M, D).
+
+    Returns (out (B, H*D), updated cache (2, B, H, M, D)).
+    """
+    B = x.shape[0]
+    _, _, H, M, D = cache_kv.shape
+    if bias is not None:
+        x = x + bias.astype(x.dtype)
+    qkv = x.reshape(B, 3, H, D)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]          # (B, H, D)
+    if sequence_lengths is None:
+        t = jnp.zeros((B,), jnp.int32)
+    else:
+        t = sequence_lengths.reshape(B).astype(jnp.int32)
+
+    if rotary_emb_dims and rotary_tensor is not None:
+        # rotary_tensor: (B, 1, 1, M, D) — cos in [..., :D//2], sin in the
+        # mirrored half; gather this step's row per sequence
+        rot = rotary_tensor.reshape(B, M, rotary_tensor.shape[-1])
+        row = jnp.take_along_axis(rot, t[:, None, None], axis=1)[:, 0]  # (B, Dr)
+        d2 = row.shape[-1] // 2
+        cos, sin = row[:, None, :d2], row[:, None, d2:]
+
+        if use_neox_rotary_style:
+            def rope(u):  # half-split pairing: (x_i, x_{i+d/2})
+                u1, u2 = u[..., :d2], u[..., d2:]
+                return jnp.concatenate(
+                    [u1 * cos - u2 * sin, u2 * cos + u1 * sin], axis=-1
+                ).astype(u.dtype)
+        else:
+            def rope(u):  # GPT-J interleaved pairing: (x_{2i}, x_{2i+1})
+                u1, u2 = u[..., 0::2], u[..., 1::2]
+                out = jnp.stack(
+                    [u1 * cos - u2 * sin, u2 * cos + u1 * sin], axis=-1)
+                return out.reshape(u.shape).astype(u.dtype)
+
+        q, k = rope(q), rope(k)
+
+    # scatter this step's k/v at slot t per sequence
+    slot = t[:, None, None, None]                      # (B,1,1,1)
+    pos = jax.lax.broadcasted_iota(jnp.int32, (B, H, M, D), 2)
+    ck = jnp.where(pos == slot, k[:, :, None, :].astype(cache_kv.dtype),
+                   cache_kv[0])
+    cv = jnp.where(pos == slot, v[:, :, None, :].astype(cache_kv.dtype),
+                   cache_kv[1])
+
+    scale = 1.0 / (D ** 0.5)
+    s = jnp.einsum("bhd,bhmd->bhm", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    mpos = jax.lax.broadcasted_iota(jnp.int32, (B, H, M), 2)
+    s = jnp.where(mpos <= t[:, None, None], s, -1e30)
+    if src_mask is not None:
+        s = s + src_mask.astype(jnp.float32).reshape(B, 1, M)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhm,bhmd->bhd", p, cv.astype(jnp.float32))
+    out = o.reshape(B, H * D).astype(x.dtype)
+    return out, jnp.stack([ck, cv])

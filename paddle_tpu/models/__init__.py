@@ -12,3 +12,4 @@ from . import llama  # noqa: F401
 from .llama import LlamaConfig  # noqa: F401
 from . import moe_llama  # noqa: F401
 from .moe_llama import MoELlamaConfig  # noqa: F401
+from . import generation  # noqa: F401

@@ -1,0 +1,164 @@
+"""Decode path: KV-cache generation for the Llama family.
+
+Reference analog: the decode-phase attention kernel the reference ships as
+CUDA (`masked_multihead_attention`, phi/kernels/fusion/gpu/
+masked_multihead_attention_kernel.cu, surfaced at
+incubate/nn/functional/masked_multihead_attention.py) plus PaddleNLP's
+generation loop over the inference predictor
+(fluid/inference/api/analysis_predictor.h:94).
+
+TPU-native design: a STATIC-shape KV cache (L, B, max_len, Hkv, D) updated
+with `lax.dynamic_update_slice`, decode loop as `lax.scan` — one compiled
+program for the whole generation, no per-token retrace.  GQA attends at Hkv
+width via grouped einsum (no head expansion).  Sampling (greedy /
+temperature / top-k / top-p) is jittable and keyed.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import kernels
+from . import llama as llama_lib
+
+
+def init_kv_cache(config, batch: int, max_len: int):
+    """Zeroed (L, B, max_len, Hkv, D) k/v buffers in the model dtype."""
+    c = config
+    shape = (c.num_hidden_layers, batch, max_len, c.num_key_value_heads, c.hd)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+def _cache_attention(q, ck, cv, pos):
+    """q: (B, S, Hq, D) at positions [pos, pos+S); ck/cv: (B, M, Hkv, D)
+    full cache (already containing this step's k/v).  Causal over the cache
+    prefix: query i attends to cache slots j <= pos + i."""
+    B, S, Hq, D = q.shape
+    M, Hkv = ck.shape[1], ck.shape[2]
+    rep = Hq // Hkv
+    scale = 1.0 / (D ** 0.5)
+    qg = q.reshape(B, S, Hkv, rep, D).astype(jnp.float32) * scale
+    s = jnp.einsum("bqhrd,bkhd->bhrqk", qg, ck.astype(jnp.float32))
+    qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (S, M), 0)
+    kpos = jax.lax.broadcasted_iota(jnp.int32, (S, M), 1)
+    s = jnp.where((kpos <= qpos)[None, None, None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhrqk,bkhd->bqhrd", p, cv.astype(jnp.float32))
+    return o.reshape(B, S, Hq, D).astype(q.dtype)
+
+
+def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None):
+    """One block in cached mode.  ck/cv: (B, M, Hkv, D); returns updated."""
+    B, S, E = x.shape
+    D, Hq, Hkv = c.hd, c.num_attention_heads, c.num_key_value_heads
+    h = kernels.rms_norm(x, lp["input_norm"].astype(jnp.float32),
+                         c.rms_norm_eps).astype(x.dtype)
+    q = (h @ lp["wq"]).reshape(B, S, Hq, D)
+    k = (h @ lp["wk"]).reshape(B, S, Hkv, D)
+    v = (h @ lp["wv"]).reshape(B, S, Hkv, D)
+    q = llama_lib._apply_rope(q, cos, sin)
+    k = llama_lib._apply_rope(k, cos, sin)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    attn = _cache_attention(q, ck, cv, pos)
+    x = x + (attn.reshape(B, S, Hq * D) @ lp["wo"])
+    h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
+                         c.rms_norm_eps).astype(x.dtype)
+    if ffn_fn is not None:
+        out, _aux = ffn_fn(h, lp)
+        return x + out.astype(x.dtype), ck, cv
+    gate = h @ lp["w_gate"]
+    up = h @ lp["w_up"]
+    return x + ((jax.nn.silu(gate) * up) @ lp["w_down"]).astype(x.dtype), ck, cv
+
+
+def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None):
+    """Cached forward for prefill (S>=1) or decode (S=1) at offset `pos`.
+
+    Returns (logits (B, S, V) f32, updated cache)."""
+    c = config
+    x = jnp.take(params["embed"]["weight"], input_ids, axis=0)
+    S = input_ids.shape[1]
+    cos_f, sin_f = llama_lib._rope_tables(c.hd, c.max_position_embeddings,
+                                          c.rope_theta)
+    d2 = cos_f.shape[-1]
+    cos = jax.lax.dynamic_slice(cos_f, (pos, 0), (S, d2))
+    sin = jax.lax.dynamic_slice(sin_f, (pos, 0), (S, d2))
+
+    def body(x, layer):
+        lp, ck, cv = layer
+        x, ck, cv = _block_with_cache(c, x, lp, cos, sin, ck, cv, pos,
+                                      ffn_fn=ffn_fn)
+        return x, (ck, cv)
+
+    x, (ck_new, cv_new) = jax.lax.scan(
+        body, x, (params["blocks"], cache["k"], cache["v"]))
+    x = kernels.rms_norm(x, params["final_norm"].astype(jnp.float32),
+                         c.rms_norm_eps)
+    head = (params["embed"]["weight"].T if c.tie_word_embeddings
+            else params["lm_head"])
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits, {"k": ck_new, "v": cv_new}
+
+
+def sample_logits(logits, key, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0):
+    """Jittable sampling: greedy (temperature == 0) / temperature /
+    top-k / nucleus.  logits: (B, V) f32 -> (B,) int32."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.float32(max(temperature, 1e-6))
+    V = logits.shape[-1]
+    if top_k and top_k < V:
+        kth = jnp.sort(logits, axis=-1)[:, V - top_k][:, None]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # smallest set whose mass >= top_p: keep while cum - p < top_p
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1)[:, None]
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id"))
+def generate(params, input_ids, config, max_new_tokens: int,
+             temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
+             eos_id: Optional[int] = None, key: Optional[Any] = None):
+    """Prefill + scan-decode.  input_ids: (B, S) equal-length prompts.
+
+    Returns (B, max_new_tokens) int32 — after eos (when given), positions
+    are padded with eos.  One compiled program; cache is static-shaped
+    S + max_new_tokens."""
+    c = config
+    B, S = input_ids.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    cache = init_kv_cache(c, B, S + max_new_tokens)
+    logits, cache = forward_with_cache(params, input_ids, c, cache, 0)
+    next_tok = sample_logits(logits[:, -1], key, temperature, top_k, top_p)
+
+    def step(carry, i):
+        cache, tok, done, key = carry
+        key, sub = jax.random.split(key)
+        logits, cache = forward_with_cache(
+            params, tok[:, None], c, cache, S + i)
+        nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (cache, nxt, done, key), tok
+
+    done0 = (jnp.zeros((B,), bool) if eos_id is None
+             else (next_tok == eos_id))
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (cache, next_tok, done0, key), jnp.arange(1, max_new_tokens))
+    out = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+    return out

@@ -1,0 +1,44 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The compute path is JAX/XLA/Pallas; these are the AROUND-the-compiler pieces
+the reference implements in C++ (data feed, IO) — see native/dataio.cpp.
+Libraries build on first use with the in-image toolchain and cache next to
+the sources; every user has a pure-Python fallback, so a missing compiler
+degrades gracefully.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIBS = {}
+
+
+def load(name: str):
+    """Load (building if needed) lib<name>.so from this directory; returns
+    the ctypes CDLL or None when no toolchain is available."""
+    with _LOCK:
+        if name in _LIBS:
+            return _LIBS[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        lib = os.path.join(_DIR, f"lib{name}.so")
+        if (not os.path.exists(lib)
+                or os.path.getmtime(lib) < os.path.getmtime(src)):
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
+                   "-o", lib + ".tmp"]
+            try:
+                subprocess.run(cmd, check=True, capture_output=True)
+                os.replace(lib + ".tmp", lib)
+            except (OSError, subprocess.CalledProcessError):
+                _LIBS[name] = None
+                return None
+        try:
+            _LIBS[name] = ctypes.CDLL(lib)
+        except OSError:
+            _LIBS[name] = None
+        return _LIBS[name]

@@ -1,0 +1,255 @@
+"""paddle.quantization — QAT + PTQ (SURVEY C43; reference
+python/paddle/quantization/{qat.py,ptq.py,config.py,quanter,observers}).
+
+TPU-native mapping: int8 fake-quant is plain jnp math that XLA fuses into
+the surrounding matmul; the straight-through estimator is
+`x + stop_gradient(q(x) - x)` on the eager tape.  Layout and API mirror the
+reference: a `QuantConfig` maps layer types to quanter/observer factories,
+`QAT.quantize` swaps matching sublayers for quantized wrappers with
+trainable fake-quanters, `PTQ.quantize` inserts observers for calibration,
+and `.convert` freezes scales into int8 weights + dequant scales.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Dict, Optional, Type
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn.layer import Layer
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "QuantConfig", "QAT", "PTQ",
+    "FakeQuanterWithAbsMaxObserver", "AbsmaxObserver", "QuantedLinear",
+    "quanter",
+]
+
+
+def _absmax(x, axis=None):
+    return jnp.max(jnp.abs(x), axis=axis) if axis is not None else jnp.max(jnp.abs(x))
+
+
+def _fake_quant(raw, scale, bits=8):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9) / qmax
+    return jnp.clip(jnp.round(raw / s), -qmax - 1, qmax) * s
+
+
+class BaseQuanter(Layer):
+    bits = 8
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Moving-absmax fake quanter with STE (reference
+    quanter/abs_max.py FakeQuanterWithAbsMaxObserver)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 **_unused):
+        super().__init__()
+        self._rate = moving_rate
+        self.bits = bit_length
+        self._scale = None  # running absmax (python-held float)
+
+    def scales(self):
+        return to_tensor(np.float32(self._scale if self._scale else 0.0))
+
+    def forward(self, x):
+        import jax as _jax
+        xt = x if isinstance(x, Tensor) else to_tensor(x)
+        raw = xt._data
+        if not isinstance(raw, _jax.core.Tracer):  # eager: update running max
+            cur = float(_absmax(raw))
+            self._scale = (cur if self._scale is None
+                           else self._rate * self._scale + (1 - self._rate) * cur)
+        scale = jnp.float32(self._scale if self._scale is not None else 1.0)
+        q = Tensor(_fake_quant(raw, scale, self.bits), stop_gradient=True)
+        # straight-through estimator: q and xt.detach() are both constants,
+        # so d(out)/d(x) == identity while the VALUE is the quantized one
+        return xt + (q - xt.detach())
+
+
+class BaseObserver(Layer):
+    bits = 8
+
+    def scales(self):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Calibration observer: tracks the max |x| seen (reference
+    observers/abs_max.py AbsmaxObserver) — forward is identity."""
+
+    def __init__(self, quant_bits: int = 8, **_unused):
+        super().__init__()
+        self.bits = quant_bits
+        self._max = 0.0
+
+    def scales(self):
+        return to_tensor(np.float32(self._max))
+
+    def forward(self, x):
+        import jax as _jax
+        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        if not isinstance(raw, _jax.core.Tracer):
+            self._max = max(self._max, float(_absmax(raw)))
+        return x
+
+
+def quanter(name):
+    """Decorator parity shim (reference quantization/factory.py)."""
+    def deco(cls):
+        return cls
+    return deco
+
+
+class QuantConfig:
+    """Maps layer types to (activation, weight) quanter factories
+    (reference quantization/config.py QuantConfig)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._default = (activation, weight)
+        self._by_type: Dict[Type, tuple] = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._by_type[t] = (activation, weight)
+
+    def _lookup(self, layer):
+        for t, cfg in self._by_type.items():
+            if isinstance(layer, t):
+                return cfg
+        if any(self._default):
+            return self._default
+        return None
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized activations + weights (reference
+    nn/quant/qat/linear.py QuantedLinear)."""
+
+    def __init__(self, linear, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.weight = linear.weight
+        self.bias = linear.bias
+        self.activation_quanter = act_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        w = self.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return nn.functional.linear(x, w, self.bias)
+
+
+class _ConvertedLinear(Layer):
+    """Inference form: int8 weight + per-tensor dequant scale."""
+
+    def __init__(self, qlinear):
+        super().__init__()
+        w = qlinear.weight._data
+        scale = float(jnp.max(jnp.abs(w)))
+        qmax = 127.0
+        s = max(scale, 1e-9) / qmax
+        self.w_int8 = to_tensor(
+            jnp.clip(jnp.round(w / s), -128, 127).astype(jnp.int8))
+        self.weight_scale = to_tensor(np.float32(s))
+        self.bias = qlinear.bias
+
+    def forward(self, x):
+        w = self.w_int8._data.astype(jnp.float32) * self.weight_scale._data
+        return nn.functional.linear(x, Tensor(w), self.bias)
+
+
+_DEFAULT_TYPES = (nn.Linear,)
+
+
+def _swap(model, make_wrapper):
+    for name, sub in list(model._sub_layers.items()):
+        replaced = make_wrapper(sub)
+        if replaced is not None:
+            model._sub_layers[name] = replaced
+        else:
+            _swap(sub, make_wrapper)
+    return model
+
+
+class QAT:
+    """Quantization-aware training driver (reference quantization/qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            if not isinstance(layer, _DEFAULT_TYPES):
+                return None
+            cfg = self._config._lookup(layer)
+            if cfg is None:
+                return None
+            act_f, w_f = cfg
+            return QuantedLinear(layer,
+                                 act_f() if act_f else None,
+                                 w_f() if w_f else None)
+
+        return _swap(model, wrap)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            if isinstance(layer, QuantedLinear):
+                return _ConvertedLinear(layer)
+            return None
+
+        return _swap(model, wrap)
+
+
+class PTQ:
+    """Post-training quantization driver (reference quantization/ptq.py):
+    quantize() inserts observers, run calibration batches, convert()."""
+
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            if not isinstance(layer, _DEFAULT_TYPES):
+                return None
+            cfg = self._config._lookup(layer)
+            if cfg is None:
+                return None
+            act_f, w_f = cfg
+            return QuantedLinear(layer,
+                                 act_f() if act_f else None,
+                                 w_f() if w_f else None)
+
+        return _swap(model, wrap)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def wrap(layer):
+            if isinstance(layer, QuantedLinear):
+                return _ConvertedLinear(layer)
+            return None
+
+        return _swap(model, wrap)

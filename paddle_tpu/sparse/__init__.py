@@ -1,0 +1,347 @@
+"""paddle.sparse — COO/CSR tensors + ops (SURVEY C48 / reference
+python/paddle/sparse/).
+
+TPU-native design: XLA has no sparse kernels, so sparse storage is
+STATIC-SHAPE arrays — COO rides `jax.experimental.sparse.BCOO` (indices
+(nnz, ndim) + values (nnz,), padded/coalesced, differentiable dot), CSR
+stores (crows, cols, values) directly.  Sparsity-preserving unary math
+(f(0) == 0) runs on the value array alone — O(nnz) elementwise on the VPU;
+`matmul` lowers through `bcoo_dot_general` (gather + MXU segments); anything
+requiring pattern algebra (union add) concatenates indices and coalesces.
+
+This mirrors the reference API surface (sparse/creation.py:72,187, unary.py,
+binary.py, matmul.py) on the paddle Tensor wrapper.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..tensor import Tensor, to_tensor
+from . import nn  # noqa: F401  (re-export subpackage)
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "sparse_coo_tensor",
+    "sparse_csr_tensor", "is_same_shape",
+    "sin", "tan", "asin", "atan", "sinh", "tanh", "asinh", "atanh",
+    "sqrt", "square", "log1p", "abs", "neg", "expm1", "rad2deg", "deg2rad",
+    "pow", "cast", "coalesce", "add", "subtract", "multiply", "divide",
+    "matmul", "masked_matmul", "transpose", "reshape", "sum", "to_dense",
+]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor over a BCOO core (indices (nnz, ndim), values)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface -----------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self):
+        return to_tensor(self._bcoo.indices.T)  # paddle: (ndim, nnz)
+
+    def values(self):
+        return to_tensor(self._bcoo.data)
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def to_dense(self):
+        return to_tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        dense = self._bcoo.todense()
+        return _dense_to_csr(dense)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def numpy(self):
+        return np.asarray(self._bcoo.todense())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def matmul(self, other):
+        return matmul(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix: crows (rows+1,), cols (nnz,), values (nnz,)."""
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = jnp.asarray(crows, jnp.int32)
+        self._cols = jnp.asarray(cols, jnp.int32)
+        self._values = jnp.asarray(values)
+        self._shape = tuple(int(d) for d in shape)
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def crows(self):
+        return to_tensor(self._crows)
+
+    def cols(self):
+        return to_tensor(self._cols)
+
+    def values(self):
+        return to_tensor(self._values)
+
+    def nnz(self):
+        return int(self._values.shape[0])
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_coo(self) -> SparseCooTensor:
+        rows = jnp.repeat(jnp.arange(self._shape[0]),
+                          jnp.diff(self._crows),
+                          total_repeat_length=self._values.shape[0])
+        idx = jnp.stack([rows, self._cols], axis=1)
+        return SparseCooTensor(jsparse.BCOO((self._values, idx),
+                                            shape=self._shape))
+
+    to_sparse_coo = to_coo
+
+    def to_dense(self):
+        return self.to_coo().to_dense()
+
+    def numpy(self):
+        return np.asarray(self.to_dense()._data)
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Reference: sparse/creation.py:72.  indices: (ndim, nnz)."""
+    idx = jnp.asarray(_raw(indices), jnp.int32)
+    vals = _raw(values)
+    if dtype is not None:
+        from ..framework import convert_dtype, to_jax_dtype
+        vals = vals.astype(to_jax_dtype(convert_dtype(dtype)))
+    if shape is None:
+        shape = tuple(int(d) for d in (jnp.max(idx, axis=1) + 1))
+    bcoo = jsparse.BCOO((vals, idx.T), shape=tuple(shape))
+    return SparseCooTensor(bcoo.sum_duplicates())
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """Reference: sparse/creation.py:187."""
+    vals = _raw(values)
+    if dtype is not None:
+        from ..framework import convert_dtype, to_jax_dtype
+        vals = vals.astype(to_jax_dtype(convert_dtype(dtype)))
+    return SparseCsrTensor(_raw(crows), _raw(cols), vals, shape)
+
+
+def _dense_to_csr(dense) -> SparseCsrTensor:
+    d = np.asarray(dense)
+    assert d.ndim == 2, "CSR supports 2-D"
+    rows, cols = np.nonzero(d)
+    crows = np.zeros(d.shape[0] + 1, np.int32)
+    np.add.at(crows, rows + 1, 1)
+    crows = np.cumsum(crows).astype(np.int32)
+    return SparseCsrTensor(crows, cols.astype(np.int32), d[rows, cols],
+                           d.shape)
+
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+# -- unary (sparsity-preserving: f(0) == 0 -> value-only map) ---------------
+
+
+def _unary(fname, fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            b = x._bcoo
+            return SparseCooTensor(
+                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(x._crows, x._cols, fn(x._values), x._shape)
+        raise TypeError(f"sparse.{fname} expects a sparse tensor")
+    op.__name__ = fname
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+tan = _unary("tan", jnp.tan)
+asin = _unary("asin", jnp.arcsin)
+atan = _unary("atan", jnp.arctan)
+sinh = _unary("sinh", jnp.sinh)
+tanh = _unary("tanh", jnp.tanh)
+asinh = _unary("asinh", jnp.arcsinh)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+log1p = _unary("log1p", jnp.log1p)
+abs = _unary("abs", jnp.abs)  # noqa: A001 — paddle name
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+
+
+def pow(x, factor, name=None):  # noqa: A001 — paddle name
+    return _unary("pow", lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    from ..framework import convert_dtype, to_jax_dtype
+    vd = (to_jax_dtype(convert_dtype(value_dtype))
+          if value_dtype is not None else None)
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        idx = (b.indices.astype(to_jax_dtype(convert_dtype(index_dtype)))
+               if index_dtype is not None else b.indices)
+        vals = b.data.astype(vd) if vd is not None else b.data
+        return SparseCooTensor(jsparse.BCOO((vals, idx), shape=b.shape))
+    vals = x._values.astype(vd) if vd is not None else x._values
+    return SparseCsrTensor(x._crows, x._cols, vals, x._shape)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+# -- binary ------------------------------------------------------------------
+
+
+def _to_coo(x):
+    return x.to_coo() if isinstance(x, SparseCsrTensor) else x
+
+
+def _union(x, y, fn_y):
+    """Union-pattern combine: concat indices, apply fn to y's values, sum."""
+    bx, by = _to_coo(x)._bcoo, _to_coo(y)._bcoo
+    idx = jnp.concatenate([bx.indices, by.indices], axis=0)
+    vals = jnp.concatenate([bx.data, fn_y(by.data)], axis=0)
+    return SparseCooTensor(
+        jsparse.BCOO((vals, idx), shape=bx.shape).sum_duplicates())
+
+
+def add(x, y, name=None):
+    if isinstance(y, (Tensor, jnp.ndarray)):  # sparse + dense -> dense
+        return to_tensor(_to_coo(x)._bcoo.todense() + _raw(y))
+    return _union(x, y, lambda v: v)
+
+
+def subtract(x, y, name=None):
+    return _union(x, y, jnp.negative)
+
+
+def _same_pattern_combine(x, y, fn):
+    bx = _to_coo(x).coalesce()._bcoo
+    by = _to_coo(y).coalesce()._bcoo
+    # paddle requires identical sparsity for mul/div; dense fallback keeps
+    # the semantics when patterns differ
+    if bx.indices.shape == by.indices.shape:
+        return SparseCooTensor(jsparse.BCOO(
+            (fn(bx.data, by.data), bx.indices), shape=bx.shape))
+    d = fn(bx.todense(), by.todense())
+    return sparse_coo_tensor(jnp.stack(jnp.nonzero(d)), d[jnp.nonzero(d)],
+                             shape=bx.shape)
+
+
+def multiply(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return _unary("scale", lambda v: v * y)(x)
+    return _same_pattern_combine(x, y, jnp.multiply)
+
+
+def divide(x, y, name=None):
+    if isinstance(y, (int, float)):
+        return _unary("scale", lambda v: v / y)(x)
+    return _same_pattern_combine(x, y, jnp.divide)
+
+
+# -- matmul / layout ---------------------------------------------------------
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense (spmm) or sparse @ sparse (-> dense @)."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xb = _to_coo(x)._bcoo
+        if isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+            return to_tensor(xb.todense() @ _to_coo(y)._bcoo.todense())
+        return to_tensor(xb @ _raw(y))
+    # dense @ sparse
+    yb = _to_coo(y)._bcoo
+    return to_tensor(_raw(x) @ yb.todense())
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(dense @ dense) sampled at `mask`'s sparsity (SDDMM)."""
+    out = _raw(x) @ _raw(y)
+    b = _to_coo(mask)._bcoo
+    vals = out[tuple(b.indices.T)]
+    return SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def transpose(x, perm, name=None):
+    b = _to_coo(x)._bcoo
+    idx = b.indices[:, jnp.asarray(perm)]
+    shape = tuple(b.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((b.data, idx), shape=shape))
+
+
+def reshape(x, shape, name=None):
+    b = _to_coo(x)._bcoo
+    flat = jnp.ravel_multi_index(tuple(b.indices.T), b.shape, mode="clip")
+    shape = tuple(int(s) for s in shape)
+    new_idx = jnp.stack(jnp.unravel_index(flat, shape), axis=1)
+    return SparseCooTensor(jsparse.BCOO((b.data, new_idx), shape=shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    b = _to_coo(x)._bcoo
+    if axis is None:
+        out = jnp.sum(b.data)
+        return to_tensor(out if not keepdim else
+                         out.reshape((1,) * len(b.shape)))
+    return to_tensor(jnp.sum(b.todense(), axis=axis, keepdims=keepdim))
+
+
+def to_dense(x):
+    return x.to_dense()

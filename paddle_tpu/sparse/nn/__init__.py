@@ -1,0 +1,68 @@
+"""paddle.sparse.nn — activations on sparse tensors (reference
+python/paddle/sparse/nn/layer/activation.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...nn.layer import Layer
+
+__all__ = ["ReLU", "LeakyReLU", "ReLU6", "Softmax", "functional"]
+
+
+class _ValueAct(Layer):
+    def forward(self, x):
+        from .. import _unary
+        return _unary(self._name, self._fn)(x)
+
+
+class ReLU(_ValueAct):
+    _name, _fn = "relu", staticmethod(jax.nn.relu)
+
+
+class ReLU6(_ValueAct):
+    _name, _fn = "relu6", staticmethod(jax.nn.relu6)
+
+
+class LeakyReLU(_ValueAct):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        from .. import _unary
+        return _unary("leaky_relu",
+                      lambda v: jax.nn.leaky_relu(v, self._slope))(x)
+
+
+class Softmax(Layer):
+    """Row softmax over stored values only (zeros act as -inf) — reference
+    sparse/nn/layer/activation.py Softmax semantics for 2-D CSR/COO."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise ValueError("sparse Softmax supports axis=-1")
+
+    def forward(self, x):
+        from .. import SparseCsrTensor, SparseCooTensor, _to_coo
+        if isinstance(x, SparseCsrTensor):
+            coo = x.to_coo()
+            as_csr = True
+        else:
+            coo = _to_coo(x).coalesce()
+            as_csr = False
+        b = coo._bcoo
+        rows = b.indices[:, 0]
+        nrows = b.shape[0]
+        vmax = jax.ops.segment_max(b.data, rows, num_segments=nrows)
+        e = jnp.exp(b.data - vmax[rows])
+        denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+        vals = e / denom[rows]
+        out = SparseCooTensor(jsparse.BCOO((vals, b.indices), shape=b.shape))
+        return out.to_sparse_csr() if as_csr else out
+
+
+from . import functional  # noqa: E402,F401

@@ -1,0 +1,28 @@
+"""paddle.sparse.nn.functional — functional forms."""
+
+from __future__ import annotations
+
+import jax
+
+__all__ = ["relu", "relu6", "leaky_relu", "softmax"]
+
+
+def relu(x, name=None):
+    from ... import _unary
+    return _unary("relu", jax.nn.relu)(x)
+
+
+def relu6(x, name=None):
+    from ... import _unary
+    return _unary("relu6", jax.nn.relu6)(x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from ... import _unary
+    return _unary("leaky_relu",
+                  lambda v: jax.nn.leaky_relu(v, negative_slope))(x)
+
+
+def softmax(x, axis=-1, name=None):
+    from .. import Softmax
+    return Softmax(axis=axis)(x)

@@ -46,13 +46,22 @@ class TapeNode:
     whose cotangents seed this node.
     """
 
-    __slots__ = ("pullback", "inputs", "outputs", "name")
+    __slots__ = ("pullback", "inputs", "outputs", "name",
+                 "fn", "args", "kwargs", "args_data")
 
-    def __init__(self, name, pullback, inputs, outputs):
+    def __init__(self, name, pullback, inputs, outputs,
+                 fn=None, args=None, kwargs=None, args_data=None):
         self.name = name
         self.pullback = pullback
         self.inputs = inputs  # tuple[Tensor] — differentiable inputs, in order
         self.outputs = outputs  # tuple[Tensor]
+        # forward replay record (create_graph / higher-order AD): the op fn,
+        # its full arg list (Tensor refs for env lookup) and the raw values
+        # captured at record time (mutation-safe)
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.args_data = args_data
 
 
 def _float0_zero(raw):
@@ -424,7 +433,13 @@ def apply_op(name: str, fn: Callable, *args: Any, nondiff: Sequence[int] = (), *
     wrapped = _wrap_outputs(out_raws, stop_gradient=False)
     _check_nan_inf(name, wrapped)
     out_list = wrapped if isinstance(wrapped, tuple) else (wrapped,)
-    node = TapeNode(name, pullback, tuple(args[p] for p in diff_pos), out_list)
+    if framework.get_state().flags.get("FLAGS_enable_double_grad", True):
+        node = TapeNode(name, pullback, tuple(args[p] for p in diff_pos),
+                        out_list, fn=fn, args=tuple(args),
+                        kwargs=dict(kwargs), args_data=tuple(raws))
+    else:  # lighter nodes: no replay record -> no create_graph support
+        node = TapeNode(name, pullback, tuple(args[p] for p in diff_pos),
+                        out_list)
     for idx, o in enumerate(out_list):
         if isinstance(o, Tensor):
             o._node = node
@@ -473,7 +488,10 @@ def _wrap_outputs(outs, stop_gradient):
 # ---------------------------------------------------------------------------
 
 
-def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
+def backward(tensor: Tensor, grad_tensor=None, retain_graph=False,
+             deposit_ids=None):
+    """deposit_ids: extra tensor ids whose .grad must be populated even if
+    they are not leaves — paddle.grad() wrt intermediate tensors."""
     if tensor._node is None:
         if not tensor.stop_gradient:
             g = jnp.ones_like(tensor._data) if grad_tensor is None else (
@@ -543,7 +561,8 @@ def backward(tensor: Tensor, grad_tensor=None, retain_graph=False):
         t = all_tensors.get(tid)
         if t is None or t.stop_gradient:
             continue
-        if t._node is None or tid == id(tensor):
+        if (t._node is None or tid == id(tensor)
+                or (deposit_ids and tid in deposit_ids)):
             _deposit_grad(t, ct)
 
     if not retain_graph:
@@ -570,15 +589,115 @@ def _deposit_grad(t: Tensor, raw):
         t.grad = Tensor(t.grad._data + raw, stop_gradient=True, name=t.name + "@GRAD")
 
 
+def _forward_topo(outputs, stop_ids=frozenset()):
+    """Tape nodes reachable from `outputs`, in forward (execution) order.
+
+    Traversal does NOT descend past tensors in `stop_ids` (the requested
+    differentiation inputs): their producers must not be replayed, or the
+    replay would recompute them from captured constants and sever the
+    dependence on the traced input values."""
+    topo, visited, stack = [], set(), [o._node for o in outputs if o._node]
+    while stack:
+        n = stack.pop()
+        if id(n) in visited:
+            continue
+        visited.add(id(n))
+        topo.append(n)
+        for t in n.inputs:
+            if t._node is not None and id(t) not in stop_ids:
+                stack.append(t._node)
+    # reverse DFS discovery, then stable re-sort so every node appears after
+    # all producers of its inputs
+    order, placed = [], set()
+    pending = list(reversed(topo))
+    while pending:
+        progressed = False
+        rest = []
+        for n in pending:
+            ready = all(t._node is None or id(t._node) in placed
+                        for t in n.inputs)
+            if ready:
+                order.append(n)
+                placed.add(id(n))
+                progressed = True
+            else:
+                rest.append(n)
+        if not progressed:  # cycle cannot happen on a tape; defensive
+            order.extend(rest)
+            break
+        pending = rest
+    return order
+
+
+def _replay_fn(outputs, inputs):
+    """Rebuild the recorded forward as a PURE function of `inputs`' raws —
+    the bridge from the eager tape to jax transforms (higher-order AD).
+
+    Returns (h, used): `used[i]` says whether inputs[i] actually feeds the
+    replayed graph (the allow_unused contract needs it)."""
+    input_ids = {id(t): i for i, t in enumerate(inputs)}
+    order = _forward_topo(outputs, stop_ids=frozenset(input_ids))
+    for n in order:
+        if n.fn is None:
+            raise NotImplementedError(
+                f"tape node '{n.name}' has no replayable forward record")
+    used = [False] * len(inputs)
+    for n in order:
+        for a in n.args:
+            if isinstance(a, Tensor) and id(a) in input_ids:
+                used[input_ids[id(a)]] = True
+    for i, t in enumerate(inputs):
+        if any(t is o for o in outputs):
+            used[i] = True
+
+    def h(*in_raws):
+        env = {id(t): r for t, r in zip(inputs, in_raws)}
+        for n in order:
+            call = [env.get(id(a), d) if isinstance(a, Tensor) else a
+                    for a, d in zip(n.args, n.args_data)]
+            outs = n.fn(*call, **n.kwargs)
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for t, r in zip(n.outputs, outs):
+                # never clobber a requested input: a multi-output producer
+                # reached through a sibling tensor must not recompute it
+                if isinstance(t, Tensor) and id(t) not in input_ids:
+                    env[id(t)] = r
+        return tuple(env.get(id(o), o._data) for o in outputs)
+
+    return h, used
+
+
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=False, allow_unused=False):
     """paddle.grad parity (functional gradient of outputs wrt inputs)."""
+    outputs_l = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if create_graph:
-        # Higher-order AD through the eager tape is not supported; the
-        # functional API (paddle.autograd.jacobian/hessian/vjp/jvp) composes
-        # jax transforms and handles arbitrary order.
-        raise NotImplementedError(
-            "create_graph=True is not supported on the eager tape; use "
-            "paddle.autograd.jacobian/hessian (jax-native, any order) instead")
+        # Higher-order AD: replay the tape as a pure jax function and take
+        # its vjp THROUGH apply_op, so the returned grads carry tape nodes
+        # themselves (differentiable again, to any order).  Reference:
+        # double_grad / higher-order GradNode chains (eager/backward.cc).
+        # (retain_graph is moot here: replay never consumes the tape, which
+        # matches create_graph implying retain_graph in the reference.)
+        h, used = _replay_fn(outputs_l, inputs_l)
+        if not all(used) and not allow_unused:
+            bad = [t.name for t, u in zip(inputs_l, used) if not u]
+            raise RuntimeError(f"Input tensor(s) {bad} unused in the graph "
+                               "(pass allow_unused=True for None grads)")
+        gos = (grad_outputs if isinstance(grad_outputs, (list, tuple))
+               else [grad_outputs] * len(outputs_l))
+        seeds = [g._data if isinstance(g, Tensor)
+                 else (jnp.ones_like(o._data) if g is None else jnp.asarray(g))
+                 for o, g in zip(outputs_l, gos)]
+
+        def gfun(*in_raws):
+            _, pull = jax.vjp(h, *in_raws)
+            out = pull(tuple(seeds))
+            # single-input: return a leaf so tape cotangent seeding matches
+            return out if len(out) > 1 else out[0]
+
+        res = apply_op("grad", gfun, *inputs_l)
+        res = list(res) if isinstance(res, tuple) else [res]
+        return [r if u else None for r, u in zip(res, used)]
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     grad_outputs = (
@@ -592,8 +711,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, create_graph=Fa
     for t in inputs:
         t.stop_gradient = False
     try:
+        want = {id(t) for t in inputs}
         for o, g in zip(outputs, grad_outputs):
-            backward(o, grad_tensor=g, retain_graph=True)
+            backward(o, grad_tensor=g, retain_graph=True, deposit_ids=want)
         results = []
         for t in inputs:
             if t.grad is None:

@@ -1,0 +1,94 @@
+"""paddle.text — sequence decoding utilities (SURVEY C48; reference
+python/paddle/text/viterbi_decode.py).
+
+TPU-native: the Viterbi forward pass is a `lax.scan` over time with a
+batched max-plus recurrence — jittable, static shapes, on the VPU.  The
+reference's dataset downloaders (text/datasets) are out of scope for an
+offline build; load corpora through paddle_tpu.io datasets instead.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _raw(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Highest-scoring tag path under a linear-chain CRF.
+
+    potentials: (B, S, T) emissions; transition_params: (T, T);
+    lengths: (B,).  Returns (scores (B,), paths (B, S) int64) — positions
+    at or past each sequence's length are 0, like the reference kernel
+    (phi/kernels/cpu/viterbi_decode_kernel.cc).  With
+    include_bos_eos_tag, the last tag is BOS and the second-to-last is EOS
+    (transition row/column convention of the reference).
+    """
+    em = _raw(potentials).astype(jnp.float32)
+    trans = _raw(transition_params).astype(jnp.float32)
+    lens = _raw(lengths).astype(jnp.int32)
+    B, S, T = em.shape
+
+    alpha0 = em[:, 0]
+    if include_bos_eos_tag:
+        alpha0 = alpha0 + trans[-1][None, :]
+    ident = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None, :], (B, T))
+
+    def step(alpha, t):
+        scores = alpha[:, :, None] + trans[None, :, :]   # (B, from, to)
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        alpha_new = jnp.max(scores, axis=1) + em[:, t]
+        active = (t < lens)[:, None]
+        # finished sequences freeze their alpha; their backpointer is the
+        # identity so the backtrace carries the final tag through unchanged
+        return (jnp.where(active, alpha_new, alpha),
+                jnp.where(active, best_prev, ident))
+
+    if S > 1:
+        alpha, bps = jax.lax.scan(
+            lambda a, t: step(a, t), alpha0, jnp.arange(1, S))
+    else:
+        alpha, bps = alpha0, jnp.zeros((0, B, T), jnp.int32)
+
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, -2][None, :]
+
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+
+    def backtrace(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag  # carry: tag at t-1; emit: tag at t
+
+    if S > 1:
+        first_tag, emitted = jax.lax.scan(backtrace, last_tag, bps,
+                                          reverse=True)
+        paths = jnp.concatenate(
+            [first_tag[:, None], jnp.swapaxes(emitted, 0, 1)], axis=1)
+    else:
+        paths = last_tag[:, None]
+
+    pos = jnp.arange(S)[None, :]
+    paths = jnp.where(pos < lens[:, None], paths, 0).astype(jnp.int64)
+    return to_tensor(scores), to_tensor(paths)
+
+
+class ViterbiDecoder:
+    """Layer form (reference text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def __call__(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,112 @@
+"""paddle.audio + paddle.text parity tests (SURVEY C48)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.audio import features, functional as AF
+
+
+class TestAudioFunctional:
+    def test_mel_scale_canonical_points(self):
+        # slaney: 1000 Hz == mel 15 (3 mels per 200 Hz below 1 kHz)
+        assert AF.hz_to_mel(1000.0) == pytest.approx(15.0)
+        assert AF.mel_to_hz(15.0) == pytest.approx(1000.0, rel=1e-5)
+        # htk formula: 2595*log10(1 + f/700)
+        assert AF.hz_to_mel(1000.0, htk=True) == pytest.approx(
+            2595 * np.log10(1 + 1000 / 700), rel=1e-5)
+        # roundtrip
+        f = np.array([123.0, 440.0, 3200.0], np.float32)
+        back = AF.mel_to_hz(AF.hz_to_mel(paddle.to_tensor(f)))
+        np.testing.assert_allclose(np.asarray(back.numpy()), f, rtol=1e-4)
+
+    def test_fbank_rows_are_triangles_that_cover(self):
+        fb = np.asarray(AF.compute_fbank_matrix(
+            sr=16000, n_fft=512, n_mels=40, norm=1.0).numpy())
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all()
+        np.testing.assert_allclose(fb.sum(axis=1), 1.0, rtol=1e-4)
+
+    def test_power_to_db_clamps(self):
+        s = paddle.to_tensor(np.array([1.0, 0.1, 1e-12], np.float32))
+        db = np.asarray(AF.power_to_db(s, top_db=30.0).numpy())
+        assert db[0] == pytest.approx(0.0)
+        assert db[1] == pytest.approx(-10.0, abs=1e-4)
+        assert db[2] == pytest.approx(-30.0)  # clamped by top_db
+
+    def test_dct_is_orthonormal(self):
+        d = np.asarray(AF.create_dct(n_mfcc=8, n_mels=8).numpy())
+        np.testing.assert_allclose(d.T @ d, np.eye(8), atol=1e-5)
+
+    def test_windows(self):
+        h = np.asarray(AF.get_window("hann", 8).numpy())
+        np.testing.assert_allclose(
+            h, 0.5 - 0.5 * np.cos(2 * np.pi * np.arange(8) / 8), atol=1e-6)
+        for name in ("hamming", "blackman", "bartlett", ("kaiser", 8.0),
+                     ("gaussian", 2.0)):
+            w = np.asarray(AF.get_window(name, 16).numpy())
+            assert w.shape == (16,) and np.isfinite(w).all()
+
+
+class TestAudioFeatures:
+    def test_spectrogram_peak_at_tone(self):
+        sr, n_fft = 16000, 512
+        t = np.arange(sr) / sr
+        wav = np.sin(2 * np.pi * 440 * t).astype(np.float32)[None]
+        spec = features.Spectrogram(n_fft=n_fft)(paddle.to_tensor(wav))
+        f = np.asarray(spec.numpy())[0]
+        assert f.shape[0] == 1 + n_fft // 2
+        assert f.mean(axis=1).argmax() == round(440 * n_fft / sr)
+
+    def test_mel_logmel_mfcc_shapes(self):
+        wav = np.random.default_rng(0).standard_normal((2, 8000)).astype(
+            np.float32)
+        x = paddle.to_tensor(wav)
+        mel = features.MelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+        assert list(mel.shape)[:2] == [2, 64]
+        logmel = features.LogMelSpectrogram(sr=16000, n_fft=512, n_mels=64)(x)
+        assert np.isfinite(np.asarray(logmel.numpy())).all()
+        mfcc = features.MFCC(sr=16000, n_mfcc=20, n_mels=64, n_fft=512)(x)
+        assert list(mfcc.shape)[:2] == [2, 20]
+
+
+class TestViterbi:
+    def _brute(self, em, trans, length, bos_eos):
+        import itertools
+        T = em.shape[-1]
+        best, path = -1e30, None
+        for tags in itertools.product(range(T), repeat=length):
+            s = em[0, tags[0]] + (trans[-1, tags[0]] if bos_eos else 0)
+            for i in range(1, length):
+                s += trans[tags[i - 1], tags[i]] + em[i, tags[i]]
+            if bos_eos:
+                s += trans[tags[-1], -2]
+            if s > best:
+                best, path = s, tags
+        return best, list(path)
+
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_matches_brute_force(self, bos_eos):
+        rng = np.random.default_rng(3)
+        B, S, T = 3, 5, 4
+        em = rng.standard_normal((B, S, T)).astype(np.float32)
+        trans = rng.standard_normal((T, T)).astype(np.float32)
+        lens = np.array([5, 3, 1], np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            paddle.to_tensor(em), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=bos_eos)
+        scores = np.asarray(scores.numpy())
+        paths = np.asarray(paths.numpy())
+        for b in range(B):
+            want_s, want_p = self._brute(em[b], trans, int(lens[b]), bos_eos)
+            assert scores[b] == pytest.approx(want_s, rel=1e-5)
+            assert paths[b, :lens[b]].tolist() == want_p
+            assert (paths[b, lens[b]:] == 0).all()
+
+    def test_decoder_layer(self):
+        rng = np.random.default_rng(4)
+        em = paddle.to_tensor(rng.standard_normal((1, 4, 3)).astype(np.float32))
+        trans = paddle.to_tensor(rng.standard_normal((3, 3)).astype(np.float32))
+        dec = paddle.text.ViterbiDecoder(trans)
+        s, p = dec(em, paddle.to_tensor(np.array([4], np.int64)))
+        assert p.shape == [1, 4]

@@ -1,0 +1,92 @@
+"""Cost model + auto-tuner (reference auto_tuner/tuner.py, cost_model)."""
+
+import pytest
+
+from paddle_tpu.distributed.auto_tuner import (
+    AutoTuner, ChipSpec, CostModel, V5E, V5P)
+from paddle_tpu.models.llama import LlamaConfig
+
+
+def _llama8b():
+    return LlamaConfig(
+        vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8)
+
+
+class TestCostModel:
+    def test_plan_fields_and_memory_scaling(self):
+        cm = CostModel(V5P)
+        cfg = _llama8b()
+        base = cm.estimate(cfg, n_tokens_global=64 * 8192, seq=8192,
+                           sizes={"data": 8, "sharding": 8, "model": 1,
+                                  "pipe": 1, "sep": 1},
+                           zero_stage=1, micro_batches=1)
+        z3 = cm.estimate(cfg, n_tokens_global=64 * 8192, seq=8192,
+                         sizes={"data": 8, "sharding": 8, "model": 1,
+                                "pipe": 1, "sep": 1},
+                         zero_stage=3, micro_batches=1)
+        assert base is not None and z3 is not None
+        assert z3.mem_bytes < base.mem_bytes  # zero-3 shards more state
+
+    def test_infeasible_returns_none(self):
+        cm = CostModel(V5E)  # 16 GB: 8B model replicated cannot fit
+        cfg = _llama8b()
+        p = cm.estimate(cfg, n_tokens_global=8 * 8192, seq=8192,
+                        sizes={"data": 8, "sharding": 1, "model": 1,
+                               "pipe": 1, "sep": 1},
+                        zero_stage=0, micro_batches=1)
+        assert p is None
+
+    def test_pipeline_bubble_grows_with_stages(self):
+        cm = CostModel(V5P)
+        cfg = _llama8b()
+        kw = dict(n_tokens_global=64 * 8192, seq=8192, zero_stage=1,
+                  micro_batches=8)
+        p2 = cm.estimate(cfg, sizes={"data": 4, "sharding": 2, "model": 4,
+                                     "pipe": 2, "sep": 1}, **kw)
+        p8 = cm.estimate(cfg, sizes={"data": 1, "sharding": 2, "model": 4,
+                                     "pipe": 8, "sep": 1}, **kw)
+        assert p2 is not None and p8 is not None
+        assert p8.breakdown["bubble"] > p2.breakdown["bubble"]
+
+
+class TestAutoTuner:
+    def test_8b_on_64_v5p_returns_feasible_ranked_plans(self):
+        plans = AutoTuner(V5P).tune(_llama8b(), n_chips=64,
+                                    global_batch=128, seq=8192)
+        assert plans and len(plans) <= 5
+        times = [p.step_time for p in plans]
+        assert times == sorted(times)
+        for p in plans:
+            assert p.mem_bytes < V5P.hbm_bytes
+            sizes = p.mesh_sizes
+            total = 1
+            for v in sizes.values():
+                total *= v
+            assert total == 64
+
+    def test_no_fit_raises_actionable(self):
+        tiny_chip = ChipSpec("toy", 1e12, 2e9, 1e10)  # 2 GB HBM
+        with pytest.raises(RuntimeError, match="no parallel plan fits"):
+            AutoTuner(tiny_chip, zero_stages=(0,)).tune(
+                _llama8b(), n_chips=2, global_batch=2, seq=8192)
+
+    def test_single_chip_tiny_model(self):
+        plans = AutoTuner(V5E).tune(LlamaConfig.tiny(), n_chips=1,
+                                    global_batch=8, seq=64)
+        assert plans[0].mesh_sizes == {"data": 1, "sharding": 1, "model": 1,
+                                       "pipe": 1, "sep": 1}
+
+    def test_measure_hook_reranks(self):
+        plans = AutoTuner(V5P).tune(
+            _llama8b(), 64, 128, 8192, top_k=3,
+            measure=lambda p: float(p.model))  # pretend bigger tp is slower
+        tps = [p.model for p in plans]
+        assert tps == sorted(tps)
+
+    def test_sep_plans_only_when_requested(self):
+        plans = AutoTuner(V5P).tune(_llama8b(), 64, 128, 8192, top_k=20)
+        assert all(p.sep == 1 for p in plans)
+        plans_sep = AutoTuner(V5P).tune(_llama8b(), 64, 128, 8192,
+                                        use_sep=True, top_k=50)
+        assert any(p.sep > 1 for p in plans_sep)

@@ -84,6 +84,7 @@ class TestTrainStateResume:
                                  AdamW(learning_rate=1e-3),
                                  zero_stage=zero_stage)
 
+    @pytest.mark.slow
     def test_resume_on_smaller_mesh_and_other_zero_stage(self, tmp_path):
         """Train 2 steps on 8 devices (zero-3), save, resume on 4 devices
         (zero-1): losses must continue identically vs no interruption."""

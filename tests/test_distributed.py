@@ -239,6 +239,7 @@ class TestContextParallel:
         for a, b in zip(g, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
 
+    @pytest.mark.slow
     def test_llama_train_step_with_sep_axis(self):
         """e2e: ShardedTrainState on a dp2 x sep4 mesh auto-enables ring attention."""
         import jax
@@ -258,6 +259,7 @@ class TestContextParallel:
         params, opt, m = st.step(params, opt, batch)
         assert np.isfinite(float(m["loss"]))
 
+    @pytest.mark.slow
     def test_sep_loss_matches_single_device(self):
         """Ring-attention training loss == single-device loss (same init/batch)."""
         import jax
@@ -304,6 +306,7 @@ class TestPipelineParallel:
         out = pipeline_apply(block, (W, bb), x, mesh=mesh, n_micro=4)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
+    @pytest.mark.slow
     def test_llama_pipeline_loss_matches_single_device(self):
         import jax
         import jax.numpy as jnp
@@ -320,6 +323,7 @@ class TestPipelineParallel:
         pp = float(llama.loss_fn(params, batch, cfg_pp))
         np.testing.assert_allclose(pp, base, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_train_step_4d_hybrid(self):
         """dp x pp x tp train step through ShardedTrainState."""
         import jax
@@ -374,12 +378,14 @@ class TestZeroStages:
         with pytest.raises(ValueError, match="zero_stage"):
             ShardedTrainState(LlamaConfig.tiny(), llama, mesh, zero_stage=4)
 
+    @pytest.mark.slow
     def test_loss_parity_across_stages(self):
         ref = self._train(0)[3]
         for stage in (1, 2, 3):
             got = self._train(stage)[3]
             np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.slow
     def test_stage3_param_memory_inverse_n(self):
         """Stage-3 stored params occupy ~1/N of stage-0 bytes per device."""
         def local_bytes(tree):
@@ -425,6 +431,7 @@ class TestPipelineSchedules:
         batch = llama.lm_batch_from_tokens(jnp.asarray(toks, jnp.int32))
         return dataclasses, llama, cfg, params, batch
 
+    @pytest.mark.slow
     def test_interleaved_forward_parity(self):
         dc, llama, cfg, params, batch = self._llama_setup()
         mesh = mesh_lib.make_mesh(pipe=2)
@@ -438,6 +445,7 @@ class TestPipelineSchedules:
         got = float(llama.loss_fn(params4, batch, cfg_v))
         np.testing.assert_allclose(got, base4, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_1f1b_loss_and_grads_parity(self):
         dc, llama, cfg, params, batch = self._llama_setup()
         loss_ref, grads_ref = jax.value_and_grad(llama.loss_fn)(
@@ -453,6 +461,7 @@ class TestPipelineSchedules:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_1f1b_stash_bounded_by_stages(self):
         """The 1F1B activation stash is (P, ...) — independent of n_micro."""
         from paddle_tpu.distributed import pipeline as pipe
@@ -522,6 +531,7 @@ class TestPipelineSchedules:
                                  n_micro=16, remat=False)[0]
         np.testing.assert_allclose(float(l4), float(l16), rtol=1e-5)
 
+    @pytest.mark.slow
     def test_moe_llama_trains_under_pipeline(self):
         """MoE + pipeline — the pairing the reference rejects (llama.py:285
         analog removed this round)."""
@@ -544,6 +554,7 @@ class TestPipelineSchedules:
         assert np.isfinite(float(m["loss"]))
         assert float(m["loss"]) < l0
 
+    @pytest.mark.slow
     def test_moe_llama_1f1b(self):
         import dataclasses
         from paddle_tpu.models import moe_llama

@@ -134,6 +134,7 @@ class TestCallbacks:
                                              save_dir=str(tmp_path))])
         assert os.path.exists(str(tmp_path / "final.pdparams"))
 
+    @pytest.mark.slow
     def test_vision_lenet_with_model(self):
         """The classic hapi demo: Model(LeNet()).fit(mnist-like)."""
         import paddle_tpu.vision as vision
@@ -148,3 +149,38 @@ class TestCallbacks:
                       transform=lambda im: im.astype(np.float32) / 255.0)
         hist = model.fit(ds, epochs=1, batch_size=8, verbose=0)
         assert len(hist["loss"]) == 3
+
+
+class TestFusedTrainPath:
+    def test_fit_without_metrics_uses_fused_step(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi.model import Model
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=paddle.nn.MSELoss())
+        x = np.random.default_rng(0).standard_normal((32, 4)).astype("float32")
+        y = (x[:, :2] * 2).astype("float32")
+        hist = m.fit(list(zip(x, y)), batch_size=8, epochs=3, verbose=0)
+        assert getattr(m, "_jit_step", None)  # fused path engaged
+        assert hist["loss"][-1] < hist["loss"][0]
+
+    def test_metrics_fall_back_to_eager(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi.model import Model
+        from paddle_tpu.metric import Accuracy
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 3))
+        m = Model(net)
+        m.prepare(optimizer=paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()),
+            loss=paddle.nn.CrossEntropyLoss(), metrics=Accuracy())
+        x = np.random.default_rng(1).standard_normal((16, 4)).astype("float32")
+        y = np.random.default_rng(2).integers(0, 3, (16, 1)).astype("int64")
+        m.fit(list(zip(x, y)), batch_size=8, epochs=1, verbose=0)
+        assert getattr(m, "_jit_step", None) is None  # eager path kept

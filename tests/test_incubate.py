@@ -69,6 +69,7 @@ class TestFusedFunctional:
 
 
 class TestFusedLayers:
+    @pytest.mark.slow
     def test_fused_mha_trains(self):
         import paddle_tpu.incubate.nn as inn
 
@@ -88,6 +89,7 @@ class TestFusedLayers:
         y = layer(x)
         assert tuple(y.shape) == (2, 5, 16)
 
+    @pytest.mark.slow
     def test_fused_ec_moe(self):
         import paddle_tpu.incubate.nn as inn
 

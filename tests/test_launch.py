@@ -69,6 +69,7 @@ class TestController:
         cfg = LaunchConfig(nproc_per_node=2, log_dir=str(tmp_path / "log"))
         assert Controller(cfg).run([sys.executable, script]) == 7
 
+    @pytest.mark.slow
     def test_elastic_survives_killed_worker(self, tmp_path):
         """2-proc gang; rank 1 kills itself on the first launch; the elastic
         supervisor restarts the gang and training resumes from the step
@@ -108,6 +109,7 @@ class TestController:
 
 
 class TestMultiNodeRendezvous:
+    @pytest.mark.slow
     def test_two_node_rendezvous_agrees_on_coordinator(self, tmp_path):
         """Run two Controller.run's (as threads) for nnodes=2 — both gangs
         must receive the SAME coordinator address from the KV master."""

@@ -57,6 +57,7 @@ class TestForward:
         ids = jnp.zeros((1, 4), dtype=jnp.int32)
         assert llama.forward(params, ids, c).shape == (1, 4, c.vocab_size)
 
+    @pytest.mark.slow
     def test_remat_matches(self):
         c = tiny()
         c_remat = LlamaConfig(**{**c.__dict__, "remat": True})
@@ -105,10 +106,10 @@ class TestLoss:
 
 class TestShardedTraining:
     @pytest.mark.parametrize("layout", [
-        dict(data=8),
-        dict(data=2, model=4),
-        dict(data=2, sharding=2, model=2),
-        dict(data=2, model=2, sep=2),
+        dict(data=2, sharding=2, model=2),  # hybrid — default-run coverage
+        pytest.param(dict(data=8), marks=pytest.mark.slow),
+        pytest.param(dict(data=2, model=4), marks=pytest.mark.slow),
+        pytest.param(dict(data=2, model=2, sep=2), marks=pytest.mark.slow),
     ])
     def test_train_step_layouts(self, layout):
         c = tiny()
@@ -122,6 +123,7 @@ class TestShardedTraining:
         assert np.isfinite(float(metrics["loss"]))
         assert np.isfinite(float(metrics["grad_norm"]))
 
+    @pytest.mark.slow
     def test_tp_matches_single_device(self):
         """The same step on dp=1 mesh vs tp=4 mesh gives the same loss."""
         c = tiny()

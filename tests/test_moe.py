@@ -69,6 +69,7 @@ class TestGating:
 
 
 class TestMoEFFN:
+    @pytest.mark.slow
     def test_matches_dense_expert_loop(self):
         """Einsum dispatch == looping over experts on undropped tokens."""
         cfg = moe_lib.MoEConfig(num_experts=4, top_k=2, capacity_factor=8.0,
@@ -95,6 +96,7 @@ class TestMoEFFN:
         np.testing.assert_allclose(np.asarray(out.reshape(-1, 16)), ref,
                                    rtol=2e-4, atol=2e-5)
 
+    @pytest.mark.slow
     def test_expert_parallel_matches_single_device(self):
         """Same numerics with experts sharded over an 8-way expert mesh axis."""
         cfg = moe_lib.MoEConfig(num_experts=8, top_k=2, capacity_factor=4.0)
@@ -135,6 +137,7 @@ class TestMoELayer:
         assert tuple(y.shape) == (2, 8, 16)
         assert float(layer.last_aux_loss) > 0.0
 
+    @pytest.mark.slow
     def test_backward_reaches_router_and_experts(self):
         import paddle_tpu.nn as nn
 
@@ -163,6 +166,7 @@ class TestMoELayer:
 
 
 class TestMoELlama:
+    @pytest.mark.slow
     def test_forward_and_loss(self):
         cfg = MoELlamaConfig.tiny()
         params = moe_llama.init_params(cfg, seed=0)
@@ -174,6 +178,7 @@ class TestMoELlama:
         loss = moe_llama.loss_fn(params, batch, cfg)
         assert np.isfinite(float(loss))
 
+    @pytest.mark.slow
     def test_train_step_reduces_loss_on_mesh(self):
         """EP+DP sharded train state drives the loss down on a tiny corpus."""
         from paddle_tpu.distributed.parallelize import ShardedTrainState

@@ -81,6 +81,7 @@ class TestGeneratedSweep:
                 np.testing.assert_array_equal(got, want,
                                               err_msg=f"{op.name}[{dtype}]")
 
+    @pytest.mark.slow
     def test_grads_finite(self, op):
         """Differentiable ops: backward produces finite grads in every
         declared float dtype (catches NaN-at-boundary VJPs)."""

@@ -312,3 +312,68 @@ class TestDtypes:
         b = paddle.matmul(a, a)
         assert b.dtype == "bfloat16"
         np.testing.assert_allclose(b.astype("float32").numpy(), 4 * np.ones((4, 4)))
+
+
+class TestCreateGraph:
+    """Higher-order AD on the eager tape via replay (reference double_grad /
+    eager/backward.cc higher-order GradNode chains)."""
+
+    def test_second_and_third_order(self):
+        x = paddle.to_tensor(np.array([1.5, -2.0], np.float32),
+                             stop_gradient=False)
+        y = x * x * x
+        (g1,) = paddle.grad(y, x, create_graph=True)
+        np.testing.assert_allclose(np.asarray(g1.numpy()),
+                                   3 * np.array([1.5, -2.0]) ** 2, rtol=1e-6)
+        (g2,) = paddle.grad(g1, x, create_graph=True)
+        np.testing.assert_allclose(np.asarray(g2.numpy()),
+                                   6 * np.array([1.5, -2.0]), rtol=1e-6)
+        (g3,) = paddle.grad(g2, x)
+        np.testing.assert_allclose(np.asarray(g3.numpy()), [6.0, 6.0],
+                                   rtol=1e-6)
+
+    def test_backward_through_grad(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32), stop_gradient=False)
+        z = paddle.sin(x) * x
+        (gz,) = paddle.grad(z, x, create_graph=True)
+        loss = paddle.sum(gz * gz)
+        loss.backward()
+        s, c = np.sin(2.0), np.cos(2.0)
+        want = 2 * (s + 2 * c) * (2 * c - 2 * s)
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), [want],
+                                   rtol=1e-5)
+
+    def test_multi_input_create_graph(self):
+        a = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        b = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+        y = a * a * b
+        ga, gb = paddle.grad(y, [a, b], create_graph=True)
+        np.testing.assert_allclose(np.asarray(ga.numpy()), [6.0])
+        np.testing.assert_allclose(np.asarray(gb.numpy()), [1.0])
+        (gab,) = paddle.grad(ga, b)  # d2y/dadb = 2a
+        np.testing.assert_allclose(np.asarray(gab.numpy()), [2.0])
+
+    def test_grad_wrt_intermediate_tensor(self):
+        """Non-leaf inputs must get real grads, both paths (review find)."""
+        x = paddle.to_tensor([2.0], stop_gradient=False)
+        z = x * 2
+        y = z * z
+        (ge,) = paddle.grad(y, z)  # eager path
+        np.testing.assert_allclose(np.asarray(ge.numpy()), [8.0])
+        x2 = paddle.to_tensor([2.0], stop_gradient=False)
+        z2 = x2 * 2
+        y2 = z2 * z2
+        (g,) = paddle.grad(y2, z2, create_graph=True)
+        np.testing.assert_allclose(np.asarray(g.numpy()), [8.0])
+        (g2,) = paddle.grad(g, z2)
+        np.testing.assert_allclose(np.asarray(g2.numpy()), [2.0])
+
+    def test_create_graph_allow_unused(self):
+        x = paddle.to_tensor([1.0], stop_gradient=False)
+        u = paddle.to_tensor([5.0], stop_gradient=False)
+        y = x * x
+        with pytest.raises(RuntimeError, match="unused"):
+            paddle.grad(y, [x, u], create_graph=True)
+        gx, gu = paddle.grad(y, [x, u], create_graph=True, allow_unused=True)
+        assert gu is None
+        np.testing.assert_allclose(np.asarray(gx.numpy()), [2.0])

@@ -1,0 +1,232 @@
+"""paddle.sparse / paddle.geometric / paddle.quantization parity tests
+(reference python/paddle/{sparse,geometric,quantization}; SURVEY C43/C48)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestSparseCoo:
+    def _coo(self):
+        indices = [[0, 0, 1, 2], [0, 2, 1, 3]]
+        values = [1.0, 2.0, -3.0, 4.0]
+        return paddle.sparse.sparse_coo_tensor(indices, values, shape=[3, 4])
+
+    def test_create_and_dense(self):
+        sp = self._coo()
+        want = np.zeros((3, 4), np.float32)
+        want[0, 0], want[0, 2], want[1, 1], want[2, 3] = 1, 2, -3, 4
+        np.testing.assert_array_equal(sp.to_dense().numpy(), want)
+        assert sp.nnz() == 4 and sp.is_sparse_coo()
+
+    def test_coalesce_sums_duplicates(self):
+        sp = paddle.sparse.sparse_coo_tensor(
+            [[0, 0], [1, 1]], [2.0, 3.0], shape=[2, 2])
+        assert sp.nnz() == 1
+        assert float(sp.to_dense().numpy()[0, 1]) == 5.0
+
+    def test_unary_on_values_only(self):
+        sp = self._coo()
+        out = paddle.sparse.sin(sp)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   np.sin(sp.to_dense().numpy()), rtol=1e-6)
+        out = paddle.sparse.abs(sp)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   np.abs(sp.to_dense().numpy()))
+
+    def test_add_union_pattern(self):
+        a = paddle.sparse.sparse_coo_tensor([[0], [0]], [1.0], shape=[2, 2])
+        b = paddle.sparse.sparse_coo_tensor([[0, 1], [0, 1]], [2.0, 5.0],
+                                            shape=[2, 2])
+        out = paddle.sparse.add(a, b)
+        want = np.array([[3.0, 0.0], [0.0, 5.0]], np.float32)
+        np.testing.assert_array_equal(out.to_dense().numpy(), want)
+        sub = paddle.sparse.subtract(b, a)
+        np.testing.assert_array_equal(
+            sub.to_dense().numpy(), np.array([[1, 0], [0, 5]], np.float32))
+
+    def test_multiply_same_pattern(self):
+        a = self._coo()
+        out = paddle.sparse.multiply(a, a)
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   a.to_dense().numpy() ** 2)
+
+    def test_matmul_vs_dense(self):
+        rng = np.random.default_rng(0)
+        d = rng.standard_normal((4, 5)).astype(np.float32)
+        sp = self._coo()
+        out = paddle.sparse.matmul(sp, paddle.to_tensor(d))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   sp.to_dense().numpy() @ d, rtol=1e-5)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((3, 4)).astype(np.float32)
+        y = rng.standard_normal((4, 3)).astype(np.float32)
+        mask = paddle.sparse.sparse_coo_tensor([[0, 2], [1, 2]], [1.0, 1.0],
+                                               shape=[3, 3])
+        out = paddle.sparse.masked_matmul(paddle.to_tensor(x),
+                                          paddle.to_tensor(y), mask)
+        dense = x @ y
+        got = out.to_dense().numpy()
+        assert got[0, 1] == pytest.approx(dense[0, 1], rel=1e-5)
+        assert got[2, 2] == pytest.approx(dense[2, 2], rel=1e-5)
+        assert got[1, 1] == 0.0
+
+    def test_csr_roundtrip_and_softmax(self):
+        sp = self._coo()
+        csr = sp.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_array_equal(csr.to_dense().numpy(),
+                                      sp.to_dense().numpy())
+        sm = paddle.sparse.nn.functional.softmax(csr)
+        d = sm.to_dense().numpy()
+        # row 0 has two entries -> softmax over them, zeros stay zero
+        np.testing.assert_allclose(
+            d[0, [0, 2]], np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum(),
+            rtol=1e-5)
+        assert d[0, 1] == 0.0
+
+    def test_transpose_reshape_sum(self):
+        sp = self._coo()
+        tr = paddle.sparse.transpose(sp, [1, 0])
+        np.testing.assert_array_equal(tr.to_dense().numpy(),
+                                      sp.to_dense().numpy().T)
+        rs = paddle.sparse.reshape(sp, [4, 3])
+        np.testing.assert_array_equal(rs.to_dense().numpy(),
+                                      sp.to_dense().numpy().reshape(4, 3))
+        assert float(paddle.sparse.sum(sp).numpy()) == pytest.approx(4.0)
+
+
+class TestGeometric:
+    def test_send_u_recv_matches_reference_doc(self):
+        x = paddle.to_tensor(np.array([[0, 2, 3], [1, 4, 5], [2, 6, 7]],
+                                      np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+        out = paddle.geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        # reference docstring example result
+        want = np.array([[0, 2, 3], [2, 8, 10], [1, 4, 5]], np.float32)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), want)
+
+    @pytest.mark.parametrize("op", ["sum", "mean", "min", "max"])
+    def test_send_u_recv_reduce_ops(self, op):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((5, 3)).astype(np.float32)
+        src = np.array([0, 1, 2, 3, 4, 0], np.int32)
+        dst = np.array([1, 1, 2, 0, 0, 3], np.int32)
+        out = np.asarray(paddle.geometric.send_u_recv(
+            paddle.to_tensor(x), paddle.to_tensor(src),
+            paddle.to_tensor(dst), reduce_op=op).numpy())
+        want = np.zeros((5, 3), np.float32)
+        groups = {}
+        for s, d in zip(src, dst):
+            groups.setdefault(d, []).append(x[s])
+        f = {"sum": np.sum, "mean": np.mean, "min": np.min, "max": np.max}[op]
+        for d, msgs in groups.items():
+            want[d] = f(np.stack(msgs), axis=0)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+    def test_send_ue_recv_and_send_uv(self):
+        x = paddle.to_tensor(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+        y = paddle.to_tensor(np.array([[10.0, 10.0], [20.0, 20.0]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1], np.int32))
+        dst = paddle.to_tensor(np.array([1, 0], np.int32))
+        out = paddle.geometric.send_ue_recv(x, y, src, dst,
+                                            message_op="add", reduce_op="sum")
+        want = np.array([[23.0, 24.0], [11.0, 12.0]], np.float32)
+        np.testing.assert_array_equal(np.asarray(out.numpy()), want)
+        uv = paddle.geometric.send_uv(x, x, src, dst, message_op="mul")
+        np.testing.assert_array_equal(np.asarray(uv.numpy()),
+                                      np.array([[3, 8], [3, 8]], np.float32))
+
+    def test_segment_ops_and_grad(self):
+        data = paddle.to_tensor(
+            np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]], np.float32),
+            stop_gradient=False)
+        ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+        s = paddle.geometric.segment_sum(data, ids)
+        np.testing.assert_array_equal(np.asarray(s.numpy()),
+                                      np.array([[4, 6], [5, 6]], np.float32))
+        m = paddle.geometric.segment_mean(data, ids)
+        np.testing.assert_array_equal(np.asarray(m.numpy()),
+                                      np.array([[2, 3], [5, 6]], np.float32))
+        loss = paddle.sum(s * s)
+        loss.backward()
+        assert np.isfinite(np.asarray(data.grad.numpy())).all()
+
+
+class TestQuantization:
+    def _model(self):
+        paddle.seed(0)
+
+        class M(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = paddle.nn.Linear(8, 16)
+                self.fc2 = paddle.nn.Linear(16, 4)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        return M()
+
+    def test_qat_quantize_swaps_linears_and_stays_close(self):
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig, QuantedLinear)
+        model = self._model()
+        cfg = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                          weight=FakeQuanterWithAbsMaxObserver)
+        qat = QAT(cfg)
+        qmodel = qat.quantize(model)
+        assert isinstance(qmodel.fc1, QuantedLinear)
+        assert isinstance(qmodel.fc2, QuantedLinear)
+        x = paddle.to_tensor(np.random.default_rng(1).standard_normal(
+            (4, 8)).astype(np.float32))
+        fp = np.asarray(model(x).numpy())
+        q = np.asarray(qmodel(x).numpy())
+        assert np.abs(fp - q).max() < 0.15 * (np.abs(fp).max() + 1e-6) + 0.1
+
+    def test_qat_gradients_flow_through_ste(self):
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig)
+        model = self._model()
+        qmodel = QAT(QuantConfig(
+            activation=FakeQuanterWithAbsMaxObserver,
+            weight=FakeQuanterWithAbsMaxObserver)).quantize(model)
+        x = paddle.to_tensor(np.random.default_rng(2).standard_normal(
+            (4, 8)).astype(np.float32))
+        loss = paddle.sum(qmodel(x) ** 2)
+        loss.backward()
+        g = qmodel.fc1.weight.grad
+        assert g is not None and np.abs(np.asarray(g.numpy())).sum() > 0
+
+    def test_convert_produces_int8_weights(self):
+        from paddle_tpu.quantization import (
+            FakeQuanterWithAbsMaxObserver, QAT, QuantConfig)
+        model = self._model()
+        qat = QAT(QuantConfig(activation=None,
+                              weight=FakeQuanterWithAbsMaxObserver))
+        qmodel = qat.quantize(model)
+        infer = qat.convert(qmodel)
+        assert str(infer.fc1.w_int8.dtype).lower().endswith("int8")
+        x = paddle.to_tensor(np.random.default_rng(3).standard_normal(
+            (4, 8)).astype(np.float32))
+        fp = np.asarray(model(x).numpy())
+        qi = np.asarray(infer(x).numpy())
+        assert np.abs(fp - qi).max() < 0.15 * (np.abs(fp).max() + 1e-6) + 0.1
+
+    def test_ptq_calibration_sets_scales(self):
+        from paddle_tpu.quantization import (
+            AbsmaxObserver, PTQ, QuantConfig)
+        model = self._model()
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver, weight=None))
+        qm = ptq.quantize(model)
+        rng = np.random.default_rng(4)
+        for _ in range(3):
+            qm(paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32)))
+        assert float(qm.fc1.activation_quanter.scales().numpy()) > 0
+        infer = ptq.convert(qm)
+        x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        assert np.isfinite(np.asarray(infer(x).numpy())).all()

@@ -1,0 +1,71 @@
+"""Native C++ data-IO core (native/dataio.cpp) via TokenFileDataset."""
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+from paddle_tpu.io.token_dataset import TokenFileDataset, write_token_file
+
+
+@pytest.fixture(scope="module")
+def packed(tmp_path_factory):
+    d = tmp_path_factory.mktemp("tok")
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 32000, (103, 16)).astype(np.int32)
+    path = str(d / "train.bin")
+    write_token_file(path, data)
+    return path, data
+
+
+class TestTokenFileDataset:
+    def test_native_lib_builds(self):
+        assert native.load("dataio") is not None, "g++ toolchain expected"
+
+    def test_rows_and_batches(self, packed):
+        path, data = packed
+        ds = TokenFileDataset(path, row_len=16, batch_size=8, shuffle=False)
+        assert ds.num_rows == 103
+        batches = list(ds)
+        assert sum(b.shape[0] for b in batches) == 103
+        np.testing.assert_array_equal(np.concatenate(batches), data)
+
+    def test_shuffle_deterministic_and_complete(self, packed):
+        path, data = packed
+        a = TokenFileDataset(path, 16, 8, shuffle=True, seed=7)
+        b = TokenFileDataset(path, 16, 8, shuffle=True, seed=7)
+        ca = np.concatenate(list(a))
+        cb = np.concatenate(list(b))
+        np.testing.assert_array_equal(ca, cb)      # same seed+epoch
+        assert not np.array_equal(ca, data)        # actually shuffled
+        # a permutation of the rows, nothing lost
+        np.testing.assert_array_equal(
+            np.sort(ca.sum(axis=1)), np.sort(data.sum(axis=1)))
+        # next epoch: different order
+        cc = np.concatenate(list(a))
+        assert not np.array_equal(ca, cc)
+
+    def test_uint16_widening(self, tmp_path):
+        data = np.random.default_rng(1).integers(0, 60000, (10, 4)).astype(
+            np.uint16)
+        path = str(tmp_path / "u16.bin")
+        write_token_file(path, data)
+        ds = TokenFileDataset(path, 4, 4, dtype="uint16", shuffle=False)
+        out = np.concatenate(list(ds))
+        assert out.dtype == np.int32
+        np.testing.assert_array_equal(out, data.astype(np.int32))
+
+    def test_drop_last(self, packed):
+        path, _ = packed
+        ds = TokenFileDataset(path, 16, 8, shuffle=False, drop_last=True)
+        batches = list(ds)
+        assert all(b.shape == (8, 16) for b in batches)
+        assert sum(b.shape[0] for b in batches) == 96
+
+    def test_python_fallback_matches_native(self, packed, monkeypatch):
+        path, data = packed
+        native_out = np.concatenate(list(
+            TokenFileDataset(path, 16, 8, shuffle=False)))
+        monkeypatch.setattr(native, "load", lambda name: None)
+        fallback = TokenFileDataset(path, 16, 8, shuffle=False)
+        np.testing.assert_array_equal(
+            np.concatenate(list(fallback)), native_out)

@@ -49,6 +49,7 @@ def _loss_fn(model, tokens, labels):
 
 
 class TestEagerTraining:
+    @pytest.mark.slow
     def test_loss_decreases(self):
         model = TinyLM()
         opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
@@ -65,6 +66,7 @@ class TestEagerTraining:
         assert losses[-1] < losses[0] * 0.5, losses
         assert losses[0] > 3.0  # ~ln(50)
 
+    @pytest.mark.slow
     def test_checkpoint_resume(self, tmp_path):
         model = TinyLM()
         opt = paddle.optimizer.AdamW(learning_rate=1e-3, parameters=model.parameters())
@@ -90,6 +92,7 @@ class TestEagerTraining:
 
 
 class TestCompiledTraining:
+    @pytest.mark.slow
     def test_trainstep_matches_eager(self):
         paddle.seed(7)
         model_a = TinyLM()
@@ -120,6 +123,7 @@ class TestCompiledTraining:
         for pa, pb in zip(model_a.parameters(), model_b.parameters()):
             np.testing.assert_allclose(pa.numpy(), pb.numpy(), rtol=2e-3, atol=2e-5)
 
+    @pytest.mark.slow
     def test_trainstep_decreases_loss(self):
         model = TinyLM()
         opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())
@@ -132,6 +136,7 @@ class TestCompiledTraining:
 
 
 class TestToStatic:
+    @pytest.mark.slow
     def test_to_static_forward(self):
         model = TinyLM()
         model.eval()
@@ -174,6 +179,7 @@ class TestToStatic:
 
 
 class TestAmpTraining:
+    @pytest.mark.slow
     def test_bf16_amp_training(self):
         model = TinyLM()
         opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=model.parameters())

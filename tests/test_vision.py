@@ -168,12 +168,17 @@ class TestModels:
         assert losses[-1] < losses[0]
 
     @pytest.mark.parametrize("ctor,size", [
-        (lambda: vision.resnet18(num_classes=10), 32),
-        (lambda: vision.resnet50(num_classes=10), 32),
-        (lambda: vision.mobilenet_v2(num_classes=10), 32),
-        (lambda: vision.squeezenet1_1(num_classes=10), 64),
-        (lambda: vision.shufflenet_v2_x0_25(num_classes=10), 32),
-        (lambda: vision.densenet121(num_classes=10), 32),
+        (lambda: vision.resnet18(num_classes=10), 32),  # default-run smoke
+        pytest.param(lambda: vision.resnet50(num_classes=10), 32,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: vision.mobilenet_v2(num_classes=10), 32,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: vision.squeezenet1_1(num_classes=10), 64,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: vision.shufflenet_v2_x0_25(num_classes=10), 32,
+                     marks=pytest.mark.slow),
+        pytest.param(lambda: vision.densenet121(num_classes=10), 32,
+                     marks=pytest.mark.slow),
     ])
     def test_model_forward_shapes(self, ctor, size):
         model = ctor()
@@ -183,12 +188,14 @@ class TestModels:
         out = model(x)
         assert tuple(out.shape) == (2, 10)
 
+    @pytest.mark.slow
     def test_vgg_forward(self):
         model = vision.vgg11(num_classes=7)
         model.eval()
         x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype(np.float32))
         assert tuple(model(x).shape) == (1, 7)
 
+    @pytest.mark.slow
     def test_resnet_train_step(self):
         import paddle_tpu.nn.functional as F
         import paddle_tpu.optimizer as opt
