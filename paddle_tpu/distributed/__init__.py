@@ -35,6 +35,11 @@ from .context_parallel import (  # noqa: F401
 from . import pipeline  # noqa: F401
 from .pipeline import pipeline_apply, pipeline_1f1b  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
+from . import message_bus  # noqa: F401
+from . import rpc  # noqa: F401
+from . import fleet_executor  # noqa: F401
+from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import (  # noqa: F401
     MoEConfig, MoELayer, NaiveGate, SwitchGate, GShardGate,
@@ -50,7 +55,8 @@ __all__ = ["init_parallel_env", "get_rank", "get_world_size", "is_initialized",
            "ring_attention", "ulysses_attention", "context_parallel_attention",
            "pipeline_apply", "MoEConfig", "MoELayer", "NaiveGate", "SwitchGate",
            "GShardGate", "moe_ffn", "top_k_gating", "global_scatter",
-           "global_gather"]
+           "global_gather", "rpc", "launch", "fleet_executor",
+           "FleetExecutor", "TaskNode"]
 
 _initialized = False
 

@@ -1,0 +1,275 @@
+"""Message bus: framed peer-to-peer byte transport.
+
+The one transport under the fleet executor (C34), `distributed.rpc` (C36) and
+the parameter server (C35) — the role brpc plays in the reference
+(`fluid/distributed/fleet_executor/message_bus.cc`,
+`fluid/distributed/rpc/rpc_agent.cc`).  The hot implementation is native C++
+(`native/messagebus.cpp`, loaded via ctypes); a pure-Python socket fallback
+keeps every feature working when no toolchain is available.
+
+A `MessageBus(my_id)` listens on a TCP port; peers are registered with
+`add_peer(peer_id, "host:port")`; `send(peer, bytes)` delivers one frame;
+`recv(timeout)` pops `(src_id, bytes)` from the receive queue.  Frames are
+opaque — layers above pickle whatever they need.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import queue
+import socket
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from .. import native
+
+__all__ = ["MessageBus"]
+
+_HDR = struct.Struct("<qq")  # (src_id, payload_len) little-endian int64 pair
+
+
+def _split_endpoint(endpoint: str) -> Tuple[str, int]:
+    host, _, port = endpoint.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+class _NativeBus:
+    def __init__(self, lib, host: str, port: int):
+        self._lib = lib
+        lib.mb_create.restype = ctypes.c_void_p
+        lib.mb_create.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.mb_port.argtypes = [ctypes.c_void_p]
+        lib.mb_add_peer.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                    ctypes.c_char_p, ctypes.c_int]
+        lib.mb_send.argtypes = [ctypes.c_void_p, ctypes.c_longlong,
+                                ctypes.c_longlong, ctypes.c_char_p,
+                                ctypes.c_longlong]
+        lib.mb_recv.restype = ctypes.c_longlong
+        lib.mb_recv.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_longlong),
+                                ctypes.POINTER(ctypes.c_void_p), ctypes.c_int]
+        lib.mb_free.argtypes = [ctypes.c_void_p]
+        lib.mb_stop.argtypes = [ctypes.c_void_p]
+        lib.mb_destroy.argtypes = [ctypes.c_void_p]
+        self._h = lib.mb_create(host.encode(), port)
+        if not self._h:
+            raise OSError(f"messagebus: cannot bind {host}:{port}")
+        self.port = lib.mb_port(self._h)
+        self._recv_mu = threading.Lock()  # serialize recv for safe teardown
+
+    def add_peer(self, peer_id: int, host: str, port: int):
+        if self._h is None:
+            raise ConnectionError("message bus is stopped")
+        self._lib.mb_add_peer(self._h, peer_id, host.encode(), port)
+
+    def send(self, my_id: int, peer_id: int, payload: bytes) -> int:
+        if self._h is None:
+            return -2  # stopped: report like a send failure, never pass NULL
+        return self._lib.mb_send(self._h, my_id, peer_id, payload,
+                                 len(payload))
+
+    def recv(self, timeout_ms: int):
+        src = ctypes.c_longlong()
+        buf = ctypes.c_void_p()
+        with self._recv_mu:
+            if self._h is None:
+                return -2, None, None
+            n = self._lib.mb_recv(self._h, ctypes.byref(src),
+                                  ctypes.byref(buf), timeout_ms)
+        if n < 0:
+            return int(n), None, None
+        data = ctypes.string_at(buf, n)
+        self._lib.mb_free(buf)
+        return int(n), int(src.value), data
+
+    def stop(self):
+        h, self._h = self._h, None
+        if h:
+            self._lib.mb_stop(h)
+            with self._recv_mu:  # no recv can still be inside the lib now
+                self._lib.mb_destroy(h)
+
+
+class _PyBus:
+    """Pure-Python fallback with the same framing (interops with native)."""
+
+    def __init__(self, host: str, port: int):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(128)
+        self.port = self._srv.getsockname()[1]
+        self._queue: "queue.Queue" = queue.Queue()
+        self._peers: Dict[int, Tuple[str, int]] = {}
+        self._conns: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._send_mu = threading.Lock()
+        self._stop = threading.Event()
+        self._readers = []
+        self._acceptor = threading.Thread(target=self._accept_loop, daemon=True)
+        self._acceptor.start()
+        self.connect_timeout = 30.0
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            t = threading.Thread(target=self._reader, args=(conn,), daemon=True)
+            t.start()
+            self._readers.append(t)
+
+    def _reader(self, conn: socket.socket):
+        try:
+            while True:
+                hdr = self._read_exact(conn, _HDR.size)
+                if hdr is None:
+                    return
+                src, n = _HDR.unpack(hdr)
+                payload = self._read_exact(conn, n) if n else b""
+                if payload is None:
+                    return
+                self._queue.put((src, payload))
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _read_exact(conn, n: int) -> Optional[bytes]:
+        chunks = []
+        while n > 0:
+            try:
+                b = conn.recv(n)
+            except OSError:
+                return None
+            if not b:
+                return None
+            chunks.append(b)
+            n -= len(b)
+        return b"".join(chunks)
+
+    def _peer_lock(self, peer_id: int) -> threading.Lock:
+        # per-peer send locks (mirrors the native Peer::send_mu): a slow
+        # connect to one dead peer must not stall sends to healthy peers
+        with self._send_mu:
+            lock = self._peer_locks.get(peer_id)
+            if lock is None:
+                lock = self._peer_locks[peer_id] = threading.Lock()
+            return lock
+
+    def add_peer(self, peer_id: int, host: str, port: int):
+        with self._peer_lock(peer_id):
+            with self._send_mu:
+                moved = self._peers.get(peer_id) != (host, port)
+                conn = self._conns.pop(peer_id, None) if moved else None
+                self._peers[peer_id] = (host, port)
+            if conn is not None:
+                conn.close()
+
+    def send(self, my_id: int, peer_id: int, payload: bytes) -> int:
+        with self._peer_lock(peer_id):
+            with self._send_mu:
+                addr = self._peers.get(peer_id)
+                conn = self._conns.get(peer_id)
+            if addr is None:
+                return -1
+            for _attempt in range(2):
+                if conn is None:
+                    conn = self._connect(addr)
+                    if conn is None:
+                        return -2
+                    with self._send_mu:
+                        self._conns[peer_id] = conn
+                try:
+                    conn.sendall(_HDR.pack(my_id, len(payload)) + payload)
+                    return 0
+                except OSError:
+                    with self._send_mu:
+                        self._conns.pop(peer_id, None)
+                    conn.close()
+                    conn = None
+            return -2
+
+    def _connect(self, addr) -> Optional[socket.socket]:
+        deadline = time.time() + self.connect_timeout
+        while True:
+            try:
+                conn = socket.create_connection(addr, timeout=30)
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return conn
+            except OSError:
+                if time.time() >= deadline:
+                    return None
+                time.sleep(0.1)
+
+    def recv(self, timeout_ms: int):
+        try:
+            src, data = self._queue.get(timeout=timeout_ms / 1000.0)
+            return len(data), src, data
+        except queue.Empty:
+            return (-2, None, None) if self._stop.is_set() else (-1, None, None)
+
+    def stop(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._send_mu:
+            for conn in self._conns.values():
+                conn.close()
+            self._conns.clear()
+
+
+class MessageBus:
+    """Named-peer frame transport (native C++ with Python fallback)."""
+
+    def __init__(self, my_id: int, host: str = "127.0.0.1", port: int = 0,
+                 backend: str = "auto"):
+        self.my_id = int(my_id)
+        self.host = host
+        lib = native.load("messagebus") if backend in ("auto", "native") else None
+        if backend == "native" and lib is None:
+            raise RuntimeError("native messagebus unavailable (no toolchain)")
+        if lib is not None:
+            self._impl = _NativeBus(lib, host, port)
+            self.backend = "native"
+        else:
+            self._impl = _PyBus(host, port)
+            self.backend = "python"
+        self._stopped = False
+
+    @property
+    def port(self) -> int:
+        return self._impl.port
+
+    @property
+    def endpoint(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def add_peer(self, peer_id: int, endpoint: str):
+        host, port = _split_endpoint(endpoint)
+        self._impl.add_peer(int(peer_id), host, port)
+
+    def send(self, peer_id: int, payload: bytes):
+        rc = self._impl.send(self.my_id, int(peer_id), payload)
+        if rc == -1:
+            raise KeyError(f"messagebus: unknown peer {peer_id}")
+        if rc != 0:
+            raise ConnectionError(
+                f"messagebus: send to peer {peer_id} failed (rc={rc})")
+
+    def recv(self, timeout: float = 10.0) -> Optional[Tuple[int, bytes]]:
+        """(src_id, payload) or None on timeout; None after stop() too."""
+        n, src, data = self._impl.recv(int(timeout * 1000))
+        if n < 0:
+            return None
+        return src, data
+
+    def stop(self):
+        if not self._stopped:
+            self._stopped = True
+            self._impl.stop()

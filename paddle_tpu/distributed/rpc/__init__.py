@@ -1,0 +1,229 @@
+"""paddle.distributed.rpc — async RPC between named workers (C36).
+
+Reference parity: `python/paddle/distributed/rpc/rpc.py` (init_rpc /
+rpc_sync / rpc_async / shutdown / get_worker_info over a brpc RpcAgent,
+`fluid/distributed/rpc/rpc_agent.h`).  TPU-native mapping: the transport is
+the framed TCP `MessageBus` (native C++, `native/messagebus.cpp`); the
+rendezvous master is the launcher's `KVStore` (the role TCPStore plays in the
+reference); callables and payloads travel as cloudpickle so lambdas and
+closures work cross-process.
+
+Worker model: one RPC worker per process.  `init_rpc` rendezvouses all
+workers at the master endpoint, exchanges (name, rank, ip, port), and starts
+a dispatcher thread + a small executor pool.  `rpc_sync/rpc_async(to, fn,
+args, kwargs)` run `fn` on the destination worker and return the result (or
+re-raise the remote exception, traceback text attached).  `shutdown()` is a
+barrier through the master, so no worker tears its bus down while a peer
+still awaits a response.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional
+
+try:
+    import cloudpickle as _pickle
+except ImportError:  # pragma: no cover - cloudpickle is in the image
+    import pickle as _pickle  # type: ignore[no-redef]
+
+from ..launch import KVClient, KVStore
+from ..message_bus import MessageBus
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos",
+           "get_current_worker_info", "WorkerInfo"]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_TIMEOUT = 120.0
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 master_endpoint: str):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.master_endpoint = master_endpoint
+        self.store: Optional[KVStore] = None
+        if rank == 0:
+            host, _, port = master_endpoint.rpartition(":")
+            self.store = KVStore(host or "127.0.0.1", int(port or 0))
+            if not port or int(port) == 0:  # ephemeral master: publish via env
+                os.environ["PADDLE_MASTER_ENDPOINT"] = self.store.endpoint
+                self.master_endpoint = self.store.endpoint
+        self.kv = KVClient(self.master_endpoint)
+
+        self.bus = MessageBus(rank)
+        self.kv.set(f"rpc/worker/{rank}",
+                    f"{name}|{self.bus.host}|{self.bus.port}")
+        self.workers: Dict[str, WorkerInfo] = {}
+        by_rank: Dict[int, WorkerInfo] = {}
+        for r in range(world_size):
+            raw = self.kv.wait(f"rpc/worker/{r}", timeout=300)
+            if not raw:
+                raise TimeoutError(f"rpc rendezvous: worker {r} never joined")
+            wname, ip, port_s = raw.split("|")
+            if wname in self.workers:
+                raise ValueError(f"worker name {wname!r} is not unique")
+            info = WorkerInfo(wname, r, ip, int(port_s))
+            self.workers[wname] = info
+            by_rank[r] = info
+            self.bus.add_peer(r, f"{ip}:{port_s}")
+        self.by_rank = by_rank
+
+        self._req_id = itertools.count()
+        self._pending: Dict[int, Future] = {}
+        self._pending_mu = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(os.environ.get("PADDLE_RPC_WORKERS", "4")),
+            thread_name_prefix=f"rpc-{name}")
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, daemon=True, name=f"rpc-recv-{name}")
+        self._stop = threading.Event()
+        self._dispatcher.start()
+
+    # -- wire ---------------------------------------------------------------
+
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            got = self.bus.recv(timeout=0.2)
+            if got is None:
+                continue
+            src, payload = got
+            try:
+                msg = _pickle.loads(payload)
+            except Exception:  # noqa: BLE001 — corrupt frame: drop
+                continue
+            kind = msg[0]
+            if kind == "req":
+                self._pool.submit(self._run_request, src, msg)
+            elif kind == "resp":
+                _, req_id, ok, value = msg
+                with self._pending_mu:
+                    fut = self._pending.pop(req_id, None)
+                if fut is not None:
+                    if ok:
+                        fut.set_result(value)
+                    else:
+                        fut.set_exception(value)
+
+    def _run_request(self, src: int, msg):
+        _, req_id, fn, args, kwargs = msg
+        try:
+            out = ("resp", req_id, True, fn(*args, **(kwargs or {})))
+        except BaseException as e:  # noqa: BLE001 — ship it back to caller
+            import traceback
+            e.remote_traceback = traceback.format_exc()  # type: ignore[attr-defined]
+            out = ("resp", req_id, False, e)
+        try:
+            self.bus.send(src, _pickle.dumps(out))
+        except (ConnectionError, KeyError):
+            pass  # caller went away (shutdown/elastic restart)
+
+    def submit(self, to: str, fn, args, kwargs) -> Future:
+        if to not in self.workers:
+            raise ValueError(
+                f"unknown rpc worker {to!r}; known: {sorted(self.workers)}")
+        req_id = next(self._req_id)
+        fut: Future = Future()
+        with self._pending_mu:
+            self._pending[req_id] = fut
+        payload = _pickle.dumps(("req", req_id, fn, tuple(args or ()),
+                                 dict(kwargs or {})))
+        self.bus.send(self.workers[to].rank, payload)
+        return fut
+
+    # -- teardown -----------------------------------------------------------
+
+    def barrier(self, key: str, timeout: float = 300.0):
+        n = self.kv.incr(f"rpc/barrier/{key}")
+        if n == self.world_size:
+            self.kv.set(f"rpc/barrier_done/{key}", "1")
+        if not self.kv.wait(f"rpc/barrier_done/{key}", timeout=timeout):
+            raise TimeoutError(f"rpc barrier {key}: {n}/{self.world_size}")
+
+    def stop(self):
+        self._stop.set()
+        self._dispatcher.join(timeout=5)
+        self._pool.shutdown(wait=True)
+        self.bus.stop()
+        if self.store is not None:
+            self.store.shutdown()
+
+
+_agent: Optional[_Agent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Join the RPC gang as worker `name` (reference rpc.py:init_rpc).
+
+    rank/world_size/master default to the launcher's env contract
+    (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER).
+    """
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("init_rpc called twice (call shutdown() first)")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0")) if rank is None else rank
+    world_size = (int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+                  if world_size is None else world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:0")
+    _agent = _Agent(name, rank, world_size, master_endpoint)
+    _agent.barrier("init")
+    return _agent
+
+
+def _require_agent() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("rpc not initialized; call init_rpc() first")
+    return _agent
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = _DEFAULT_TIMEOUT):
+    """Run `fn(*args, **kwargs)` on worker `to`; returns a Future whose
+    `.wait()`/`.result()` yields the value or re-raises the remote error."""
+    fut = _require_agent().submit(to, fn, args, kwargs)
+    fut.wait = lambda t=timeout: fut.result(  # type: ignore[attr-defined]
+        timeout=None if t in (None, -1) else t)
+    return fut
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None,
+             timeout: float = _DEFAULT_TIMEOUT):
+    return _require_agent().submit(to, fn, args, kwargs).result(
+        timeout=None if timeout in (None, -1) else timeout)
+
+
+def shutdown():
+    """Barrier, then tear down the agent (reference rpc.py:shutdown)."""
+    global _agent
+    if _agent is None:
+        return
+    _agent.barrier("shutdown")
+    # _Agent.stop's pool.shutdown(wait=True) drains any responses this
+    # worker still owes before the bus goes down
+    _agent.stop()
+    _agent = None
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    return _require_agent().workers[name]
+
+
+def get_all_worker_infos():
+    a = _require_agent()
+    return [a.by_rank[r] for r in sorted(a.by_rank)]
+
+
+def get_current_worker_info() -> WorkerInfo:
+    a = _require_agent()
+    return a.by_rank[a.rank]

@@ -29,8 +29,8 @@ def load(name: str):
         lib = os.path.join(_DIR, f"lib{name}.so")
         if (not os.path.exists(lib)
                 or os.path.getmtime(lib) < os.path.getmtime(src)):
-            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", src,
-                   "-o", lib + ".tmp"]
+            cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+                   src, "-o", lib + ".tmp"]
             try:
                 subprocess.run(cmd, check=True, capture_output=True)
                 os.replace(lib + ".tmp", lib)

@@ -1,0 +1,318 @@
+// Message bus: length-prefixed frames over TCP between named peers.
+//
+// TPU-native equivalent of the reference's brpc-based message bus
+// (paddle/fluid/distributed/fleet_executor/message_bus.cc and the brpc
+// channel underneath paddle/fluid/distributed/rpc/rpc_agent.cc) — the one
+// transport shared by the fleet executor (interceptor messages), the RPC
+// layer and the parameter-server client/server.  Payloads are opaque bytes
+// (Python pickles on top); the bus only moves frames:
+//
+//     [int64 src_id][int64 payload_len][payload bytes]
+//
+// Design: one listener thread accepts connections; each inbound connection
+// gets a reader thread that pushes complete frames onto a single
+// mutex+condvar receive queue (mb_recv pops with a timeout).  Outbound
+// connections are created lazily per peer on first send, with a bounded
+// connect-retry window so a peer that comes up late (normal under cluster
+// schedulers) does not fail the first send.  All functions are thread-safe.
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Frame {
+  int64_t src;
+  std::vector<uint8_t> data;
+};
+
+struct Peer {
+  std::string host;
+  int port = 0;
+  int fd = -1;
+  std::mutex send_mu;
+};
+
+struct Bus {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> readers;
+  std::vector<int> reader_fds;
+  std::mutex readers_mu;
+
+  std::mutex q_mu;
+  std::condition_variable q_cv;
+  std::deque<Frame> queue;
+
+  std::mutex peers_mu;
+  std::map<int64_t, Peer*> peers;
+  int connect_timeout_ms = 30000;
+
+  ~Bus() {
+    for (auto& kv : peers) delete kv.second;
+  }
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void reader_loop(Bus* bus, int fd) {
+  for (;;) {
+    int64_t hdr[2];
+    if (!read_exact(fd, hdr, sizeof(hdr))) break;
+    int64_t len = hdr[1];
+    if (len < 0 || len > (int64_t{1} << 40)) break;  // corrupt frame
+    Frame f;
+    f.src = hdr[0];
+    f.data.resize(static_cast<size_t>(len));
+    if (len > 0 && !read_exact(fd, f.data.data(), f.data.size())) break;
+    {
+      std::lock_guard<std::mutex> lk(bus->q_mu);
+      bus->queue.push_back(std::move(f));
+    }
+    bus->q_cv.notify_one();
+  }
+  // deregister BEFORE closing so mb_stop never shutdown()s a recycled fd
+  {
+    std::lock_guard<std::mutex> lk(bus->readers_mu);
+    auto& v = bus->reader_fds;
+    v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+  }
+  ::close(fd);
+}
+
+void accept_loop(Bus* bus) {
+  for (;;) {
+    sockaddr_in addr{};
+    socklen_t alen = sizeof(addr);
+    int fd = ::accept(bus->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+    if (fd < 0) {
+      if (bus->stop.load()) return;
+      if (errno == EINTR) continue;
+      return;
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(bus->readers_mu);
+    if (bus->stop.load()) {
+      ::close(fd);
+      return;
+    }
+    bus->reader_fds.push_back(fd);
+    bus->readers.emplace_back(reader_loop, bus, fd);
+  }
+}
+
+int connect_to(const std::string& host, int port, int timeout_ms) {
+  auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    std::string port_s = std::to_string(port);
+    int fd = -1;
+    if (::getaddrinfo(host.c_str(), port_s.c_str(), &hints, &res) == 0) {
+      fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 && ::connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+        ::close(fd);
+        fd = -1;
+      }
+      ::freeaddrinfo(res);
+    }
+    if (fd >= 0) {
+      int one = 1;
+      ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      return fd;
+    }
+    if (std::chrono::steady_clock::now() >= deadline) return -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* mb_create(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  addr.sin_addr.s_addr =
+      (host && *host) ? ::inet_addr(host) : htonl(INADDR_ANY);
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  Bus* bus = new Bus();
+  bus->listen_fd = fd;
+  bus->port = ntohs(addr.sin_port);
+  bus->accept_thread = std::thread(accept_loop, bus);
+  return bus;
+}
+
+int mb_port(void* h) { return static_cast<Bus*>(h)->port; }
+
+void mb_set_connect_timeout(void* h, int timeout_ms) {
+  static_cast<Bus*>(h)->connect_timeout_ms = timeout_ms;
+}
+
+int mb_add_peer(void* h, long long peer_id, const char* host, int port) {
+  Bus* bus = static_cast<Bus*>(h);
+  std::lock_guard<std::mutex> lk(bus->peers_mu);
+  Peer*& p = bus->peers[peer_id];
+  if (p == nullptr) p = new Peer();
+  // send_mu keeps us from closing the fd under a concurrent mb_send
+  // mid-write (same peers_mu -> send_mu order as mb_stop: no deadlock)
+  std::lock_guard<std::mutex> slk(p->send_mu);
+  if (p->host != host || p->port != port) {
+    if (p->fd >= 0) {  // peer moved (elastic restart): drop the stale conn
+      ::close(p->fd);
+      p->fd = -1;
+    }
+    p->host = host;
+    p->port = port;
+  }
+  return 0;
+}
+
+// 0 on success, -1 unknown peer, -2 connect/send failure.
+int mb_send(void* h, long long my_id, long long peer_id, const void* data,
+            long long len) {
+  Bus* bus = static_cast<Bus*>(h);
+  Peer* p = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(bus->peers_mu);
+    auto it = bus->peers.find(peer_id);
+    if (it == bus->peers.end()) return -1;
+    p = it->second;
+  }
+  std::lock_guard<std::mutex> lk(p->send_mu);
+  for (int attempt = 0; attempt < 2; ++attempt) {
+    if (p->fd < 0) {
+      p->fd = connect_to(p->host, p->port, bus->connect_timeout_ms);
+      if (p->fd < 0) return -2;
+    }
+    int64_t hdr[2] = {my_id, len};
+    if (write_exact(p->fd, hdr, sizeof(hdr)) &&
+        (len == 0 || write_exact(p->fd, data, static_cast<size_t>(len)))) {
+      return 0;
+    }
+    ::close(p->fd);  // stale half-open conn (peer restarted): reconnect once
+    p->fd = -1;
+  }
+  return -2;
+}
+
+// Returns payload length (>=0) with *src / *data set (caller must mb_free
+// *data), -1 on timeout, -2 after shutdown.
+long long mb_recv(void* h, long long* src, void** data, int timeout_ms) {
+  Bus* bus = static_cast<Bus*>(h);
+  std::unique_lock<std::mutex> lk(bus->q_mu);
+  bus->q_cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                     [&] { return !bus->queue.empty() || bus->stop.load(); });
+  if (bus->queue.empty()) return bus->stop.load() ? -2 : -1;
+  Frame f = std::move(bus->queue.front());
+  bus->queue.pop_front();
+  lk.unlock();
+  *src = f.src;
+  void* buf = ::malloc(f.data.size() ? f.data.size() : 1);
+  if (!f.data.empty()) std::memcpy(buf, f.data.data(), f.data.size());
+  *data = buf;
+  return static_cast<long long>(f.data.size());
+}
+
+void mb_free(void* p) { ::free(p); }
+
+// Two-phase teardown: mb_stop wakes every blocked mb_recv (they return -2)
+// and joins all threads; mb_destroy frees the bus once the caller knows no
+// thread can still be inside an mb_* call on this handle.
+void mb_stop(void* h) {
+  Bus* bus = static_cast<Bus*>(h);
+  bus->stop.store(true);
+  ::shutdown(bus->listen_fd, SHUT_RDWR);
+  ::close(bus->listen_fd);
+  if (bus->accept_thread.joinable()) bus->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> lk(bus->peers_mu);
+    for (auto& kv : bus->peers) {
+      std::lock_guard<std::mutex> slk(kv.second->send_mu);
+      if (kv.second->fd >= 0) {
+        ::shutdown(kv.second->fd, SHUT_RDWR);
+        ::close(kv.second->fd);
+        kv.second->fd = -1;
+      }
+    }
+  }
+  std::vector<std::thread> readers;
+  {
+    // shutdown under the lock; join OUTSIDE it so an exiting reader can
+    // deregister its fd (it takes readers_mu) without deadlocking us
+    std::lock_guard<std::mutex> lk(bus->readers_mu);
+    for (int fd : bus->reader_fds) ::shutdown(fd, SHUT_RDWR);
+    readers.swap(bus->readers);
+  }
+  for (auto& t : readers)
+    if (t.joinable()) t.join();
+  bus->q_cv.notify_all();
+}
+
+void mb_destroy(void* h) { delete static_cast<Bus*>(h); }
+
+}  // extern "C"

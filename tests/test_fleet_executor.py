@@ -1,0 +1,169 @@
+"""Fleet executor (C34): interceptor runtime with credit flow control.
+
+Reference behavior: fluid/distributed/fleet_executor/ (carrier, compute/
+source/sink/amplifier interceptors, DATA_IS_READY / DATA_IS_USELESS credits,
+message bus between carriers).
+"""
+
+import threading
+
+import pytest
+
+from paddle_tpu.distributed.fleet_executor import FleetExecutor, TaskNode
+from paddle_tpu.distributed.message_bus import MessageBus
+
+
+def _chain(*nodes, buff=2):
+    for up, down in zip(nodes, nodes[1:]):
+        up.add_downstream_task(down.task_id, buff)
+        down.add_upstream_task(up.task_id, buff)
+    return list(nodes)
+
+
+def test_pipeline_microbatches_in_order():
+    M = 8
+    src = TaskNode(0, kind="source", max_run_times=M, feed=lambda i: i)
+    sq = TaskNode(1, kind="compute", max_run_times=M,
+                  run_fn=lambda i, ins: ins[0] ** 2)
+    neg = TaskNode(2, kind="compute", max_run_times=M,
+                   run_fn=lambda i, ins: -ins[1])
+    sink = TaskNode(3, kind="sink", max_run_times=M)
+    results = FleetExecutor(_chain(src, sq, neg, sink)).run(timeout=30)
+    assert results[3] == [-(i ** 2) for i in range(M)]
+
+
+def test_credit_bounds_in_flight():
+    M, BUFF = 12, 2
+    mu = threading.Lock()
+    state = {"in_flight": 0, "max_in_flight": 0}
+
+    def produced(i):
+        with mu:
+            state["in_flight"] += 1
+            state["max_in_flight"] = max(state["max_in_flight"],
+                                         state["in_flight"])
+        return i
+
+    def consume(i, ins):
+        with mu:
+            state["in_flight"] -= 1
+        return ins[0]
+
+    src = TaskNode(0, kind="source", max_run_times=M, feed=produced)
+    slow = TaskNode(1, kind="compute", max_run_times=M, run_fn=consume)
+    sink = TaskNode(2, kind="sink", max_run_times=M)
+    FleetExecutor(_chain(src, slow, sink, buff=BUFF)).run(timeout=30)
+    # source may run at most BUFF ahead of the consumer
+    assert state["max_in_flight"] <= BUFF + 1, state
+
+
+def test_amplifier_gradient_merge_pattern():
+    """Amplifier fires run_fn every run_per_steps scopes (gradient merge)."""
+    M, K = 8, 4
+    fired = []
+
+    def merge(i, ins):
+        fired.append(i)
+        return ins[1]
+
+    src = TaskNode(0, kind="source", max_run_times=M, feed=lambda i: i)
+    fwd = TaskNode(1, kind="compute", max_run_times=M,
+                   run_fn=lambda i, ins: ins[0] + 100)
+    amp = TaskNode(2, kind="amplifier", max_run_times=M, run_fn=merge,
+                   run_per_steps=K, run_at_offset=K - 1)
+    sink = TaskNode(3, kind="sink", max_run_times=M)
+    results = FleetExecutor(_chain(src, fwd, amp, sink)).run(timeout=30)
+    assert fired == [K - 1, 2 * K - 1]
+    assert results[3] == [i + 100 for i in range(M)]
+
+
+def test_compute_error_propagates():
+    def boom(i, ins):
+        if i == 2:
+            raise RuntimeError("stage exploded")
+        return ins[0]
+
+    src = TaskNode(0, kind="source", max_run_times=4, feed=lambda i: i)
+    mid = TaskNode(1, kind="compute", max_run_times=4, run_fn=boom)
+    sink = TaskNode(2, kind="sink", max_run_times=4)
+    with pytest.raises(RuntimeError, match="stage exploded"):
+        FleetExecutor(_chain(src, mid, sink)).run(timeout=30)
+
+
+def test_graph_validation():
+    a = TaskNode(0, kind="source", max_run_times=1)
+    b = TaskNode(1, kind="sink", max_run_times=1)
+    a.add_downstream_task(1, 2)  # missing matching upstream edge on b
+    with pytest.raises(ValueError, match="missing the matching"):
+        FleetExecutor([a, b])
+    with pytest.raises(ValueError, match="at least one sink"):
+        FleetExecutor([TaskNode(0, kind="source", max_run_times=1)])
+
+
+def test_sinks_on_both_ranks():
+    """A sink-hosting carrier must not finish early on a remote DONE."""
+    M = 5
+    bus0, bus1 = MessageBus(0), MessageBus(1)
+    bus0.add_peer(1, bus1.endpoint)
+    bus1.add_peer(0, bus0.endpoint)
+    try:
+        def build_nodes():
+            src = TaskNode(0, rank=0, kind="source", max_run_times=M,
+                           feed=lambda i: i)
+            fast = TaskNode(1, rank=0, kind="sink", max_run_times=M)
+            slow = TaskNode(2, rank=1, kind="compute", max_run_times=M,
+                            run_fn=lambda i, ins: ins[0] * 10)
+            far = TaskNode(3, rank=1, kind="sink", max_run_times=M)
+            src.add_downstream_task(1, 2)
+            fast.add_upstream_task(0, 2)
+            src.add_downstream_task(2, 2)
+            slow.add_upstream_task(0, 2)
+            slow.add_downstream_task(3, 2)
+            far.add_upstream_task(2, 2)
+            return [src, fast, slow, far]
+
+        ex0 = FleetExecutor(build_nodes(), rank=0, bus=bus0)
+        ex1 = FleetExecutor(build_nodes(), rank=1, bus=bus1)
+        out = {}
+        t = threading.Thread(target=lambda: out.setdefault(0, ex0.run(60)))
+        t.start()
+        res1 = ex1.run(timeout=60)
+        t.join(timeout=60)
+        assert res1[3] == [10 * i for i in range(M)]   # own sink complete
+        assert out[0][1] == list(range(M))             # rank0's own sink too
+    finally:
+        bus0.stop()
+        bus1.stop()
+
+
+def test_two_carriers_over_message_bus():
+    """Stages split across two ranks in one process, wired by real buses."""
+    M = 6
+    bus0, bus1 = MessageBus(0), MessageBus(1)
+    bus0.add_peer(1, bus1.endpoint)
+    bus1.add_peer(0, bus0.endpoint)
+    try:
+        def build_nodes():
+            src = TaskNode(0, rank=0, kind="source", max_run_times=M,
+                           feed=lambda i: i)
+            double = TaskNode(1, rank=0, kind="compute", max_run_times=M,
+                              run_fn=lambda i, ins: ins[0] * 2)
+            plus = TaskNode(2, rank=1, kind="compute", max_run_times=M,
+                            run_fn=lambda i, ins: ins[1] + 5)
+            sink = TaskNode(3, rank=1, kind="sink", max_run_times=M)
+            return _chain(src, double, plus, sink)
+
+        ex0 = FleetExecutor(build_nodes(), rank=0, bus=bus0)
+        ex1 = FleetExecutor(build_nodes(), rank=1, bus=bus1)
+
+        out = {}
+        t = threading.Thread(target=lambda: out.setdefault(0, ex0.run(60)))
+        t.start()
+        res1 = ex1.run(timeout=60)
+        t.join(timeout=60)
+        assert res1[3] == [2 * i + 5 for i in range(M)]
+        # rank 0 hosts no sink; its run() returns after the DONE broadcast
+        assert 0 in out and out[0].get(3) == res1[3]
+    finally:
+        bus0.stop()
+        bus1.stop()
