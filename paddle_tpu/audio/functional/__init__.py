@@ -133,8 +133,6 @@ def get_window(window: Union[str, tuple], win_length: int,
         name, *args = window
     else:
         name, args = window, []
-    M = win_length + (0 if fftbins else -1) + 1 if not fftbins else win_length
-    sym_m = win_length if fftbins else win_length
     n = np.arange(win_length)
     L = win_length if fftbins else win_length - 1
     if name == "hann":

@@ -116,8 +116,9 @@ class CostModel:
         def ring(bytes_, axis):
             return 0.0 if axis <= 1 else 2 * bytes_ * (axis - 1) / axis / c.ici_bw
 
-        # grad reduce over dp (bf16 grads once per step)
-        t_dp = ring(2 * p_local / (1 if zero_stage < 2 else 1), dp)
+        # grad reduce over dp (bf16 grads once per step); with ZeRO-2+ each
+        # rank only reduces its 1/shard slice of the gradients
+        t_dp = ring(2 * p_local / (1 if zero_stage < 2 else shard), dp)
         # tp: 4 allreduces of activations per layer per microbatch chunk
         act_bytes = 2 * mb_seqs * (S // sp) * E
         t_tp = micro_batches * L / pp * 4 * ring(act_bytes, tp)

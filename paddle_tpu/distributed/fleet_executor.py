@@ -38,6 +38,7 @@ DATA_IS_USELESS = "DATA_IS_USELESS"
 START = "START"
 STOP = "STOP"
 DONE = "DONE"  # sink -> executor completion signal
+ERR = "ERR"    # interceptor failure, broadcast to every carrier
 
 
 @dataclasses.dataclass
@@ -273,6 +274,12 @@ class Carrier:
                     no_own_sinks = self._sinks_total == 0
                 if no_own_sinks:
                     self._done.set()
+            elif msg.message_type == ERR:
+                # remote interceptor failed: surface ITS error here instead
+                # of timing out with no diagnosis
+                if self.error is None:
+                    self.error = msg.payload
+                self._done.set()
             else:
                 ic = self.interceptors.get(msg.dst_id)
                 if ic is not None:
@@ -317,6 +324,20 @@ class Carrier:
 
     def on_error(self, task_id: int, err: BaseException):
         self.error = err
+        if self.bus is not None:
+            for r in {rk for rk in self.task_rank.values()
+                      if rk != self.rank}:
+                try:
+                    payload = err
+                    try:
+                        pickle.dumps(err)
+                    except Exception:  # noqa: BLE001 — unpicklable error
+                        payload = RuntimeError(
+                            f"task {task_id} failed: {err!r}")
+                    self.bus.send(r, pickle.dumps(InterceptorMessage(
+                        task_id, -1, ERR, payload=payload)))
+                except (ConnectionError, KeyError):
+                    pass
         self._done.set()
 
     def wait(self, timeout: float = 300.0) -> Dict[int, List[Any]]:

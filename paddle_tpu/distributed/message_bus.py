@@ -32,7 +32,7 @@ _HDR = struct.Struct("<qq")  # (src_id, payload_len) little-endian int64 pair
 
 def _split_endpoint(endpoint: str) -> Tuple[str, int]:
     host, _, port = endpoint.rpartition(":")
-    return host or "127.0.0.1", int(port)
+    return host or "127.0.0.1", int(port or 0)
 
 
 class _NativeBus:
@@ -57,39 +57,71 @@ class _NativeBus:
         if not self._h:
             raise OSError(f"messagebus: cannot bind {host}:{port}")
         self.port = lib.mb_port(self._h)
-        self._recv_mu = threading.Lock()  # serialize recv for safe teardown
+        # in-flight call guard: stop() may only mb_destroy once no thread
+        # can still be inside the library on this handle
+        self._calls = 0
+        self._cv = threading.Condition()
+
+    def _enter(self):
+        with self._cv:
+            if self._h is None:
+                return None
+            self._calls += 1
+            return self._h
+
+    def _exit(self):
+        with self._cv:
+            self._calls -= 1
+            if self._calls == 0:
+                self._cv.notify_all()
 
     def add_peer(self, peer_id: int, host: str, port: int):
-        if self._h is None:
+        h = self._enter()
+        if h is None:
             raise ConnectionError("message bus is stopped")
-        self._lib.mb_add_peer(self._h, peer_id, host.encode(), port)
+        try:
+            self._lib.mb_add_peer(h, peer_id, host.encode(), port)
+        finally:
+            self._exit()
 
     def send(self, my_id: int, peer_id: int, payload: bytes) -> int:
-        if self._h is None:
+        h = self._enter()
+        if h is None:
             return -2  # stopped: report like a send failure, never pass NULL
-        return self._lib.mb_send(self._h, my_id, peer_id, payload,
-                                 len(payload))
+        try:
+            return self._lib.mb_send(h, my_id, peer_id, payload, len(payload))
+        finally:
+            self._exit()
 
     def recv(self, timeout_ms: int):
-        src = ctypes.c_longlong()
-        buf = ctypes.c_void_p()
-        with self._recv_mu:
-            if self._h is None:
-                return -2, None, None
-            n = self._lib.mb_recv(self._h, ctypes.byref(src),
+        h = self._enter()
+        if h is None:
+            return -2, None, None
+        try:
+            src = ctypes.c_longlong()
+            buf = ctypes.c_void_p()
+            n = self._lib.mb_recv(h, ctypes.byref(src),
                                   ctypes.byref(buf), timeout_ms)
-        if n < 0:
-            return int(n), None, None
-        data = ctypes.string_at(buf, n)
-        self._lib.mb_free(buf)
-        return int(n), int(src.value), data
+            if n < 0:
+                return int(n), None, None
+            data = ctypes.string_at(buf, n)
+            self._lib.mb_free(buf)
+            return int(n), int(src.value), data
+        finally:
+            self._exit()
 
     def stop(self):
-        h, self._h = self._h, None
-        if h:
-            self._lib.mb_stop(h)
-            with self._recv_mu:  # no recv can still be inside the lib now
-                self._lib.mb_destroy(h)
+        with self._cv:
+            h, self._h = self._h, None  # new calls refused from here on
+        if h is None:
+            return
+        # wakes blocked recvs (-2) and aborts in-flight connect retries; the
+        # bus stays allocated so threads already inside the lib are safe
+        self._lib.mb_stop(h)
+        with self._cv:
+            while self._calls:
+                self._cv.wait()
+            self._lib.mb_destroy(h)
 
 
 class _PyBus:

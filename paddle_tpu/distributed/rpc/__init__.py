@@ -32,7 +32,7 @@ except ImportError:  # pragma: no cover - cloudpickle is in the image
     import pickle as _pickle  # type: ignore[no-redef]
 
 from ..launch import KVClient, KVStore
-from ..message_bus import MessageBus
+from ..message_bus import MessageBus, _split_endpoint
 
 __all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
            "get_worker_info", "get_all_worker_infos",
@@ -52,9 +52,9 @@ class _Agent:
         self.master_endpoint = master_endpoint
         self.store: Optional[KVStore] = None
         if rank == 0:
-            host, _, port = master_endpoint.rpartition(":")
-            self.store = KVStore(host or "127.0.0.1", int(port or 0))
-            if not port or int(port) == 0:  # ephemeral master: publish via env
+            host, port = _split_endpoint(master_endpoint)
+            self.store = KVStore(host, port)
+            if port == 0:  # ephemeral master: publish via env
                 os.environ["PADDLE_MASTER_ENDPOINT"] = self.store.endpoint
                 self.master_endpoint = self.store.endpoint
         self.kv = KVClient(self.master_endpoint)
@@ -122,11 +122,18 @@ class _Agent:
             e.remote_traceback = traceback.format_exc()  # type: ignore[attr-defined]
             out = ("resp", req_id, False, e)
         try:
-            self.bus.send(src, _pickle.dumps(out))
+            payload = _pickle.dumps(out)
+        except Exception as e:  # noqa: BLE001 — unpicklable result/exception:
+            # the caller must still get a response, not a silent timeout
+            payload = _pickle.dumps(("resp", req_id, False, RuntimeError(
+                f"rpc: response of {getattr(fn, '__name__', fn)!r} is not "
+                f"picklable: {e!r}")))
+        try:
+            self.bus.send(src, payload)
         except (ConnectionError, KeyError):
             pass  # caller went away (shutdown/elastic restart)
 
-    def submit(self, to: str, fn, args, kwargs) -> Future:
+    def submit(self, to: str, fn, args, kwargs):
         if to not in self.workers:
             raise ValueError(
                 f"unknown rpc worker {to!r}; known: {sorted(self.workers)}")
@@ -136,8 +143,23 @@ class _Agent:
             self._pending[req_id] = fut
         payload = _pickle.dumps(("req", req_id, fn, tuple(args or ()),
                                  dict(kwargs or {})))
-        self.bus.send(self.workers[to].rank, payload)
-        return fut
+        try:
+            self.bus.send(self.workers[to].rank, payload)
+        except BaseException:
+            with self._pending_mu:
+                self._pending.pop(req_id, None)
+            raise
+        return req_id, fut
+
+    def result_of(self, req_id: int, fut: Future, timeout):
+        """Future.result with cleanup: a timed-out/abandoned request must
+        not leave its entry in _pending for the agent's lifetime."""
+        try:
+            return fut.result(timeout=timeout)
+        except BaseException:
+            with self._pending_mu:
+                self._pending.pop(req_id, None)
+            raise
 
     # -- teardown -----------------------------------------------------------
 
@@ -191,16 +213,19 @@ def rpc_async(to: str, fn, args=None, kwargs=None,
               timeout: float = _DEFAULT_TIMEOUT):
     """Run `fn(*args, **kwargs)` on worker `to`; returns a Future whose
     `.wait()`/`.result()` yields the value or re-raises the remote error."""
-    fut = _require_agent().submit(to, fn, args, kwargs)
-    fut.wait = lambda t=timeout: fut.result(  # type: ignore[attr-defined]
-        timeout=None if t in (None, -1) else t)
+    agent = _require_agent()
+    req_id, fut = agent.submit(to, fn, args, kwargs)
+    fut.wait = lambda t=timeout: agent.result_of(  # type: ignore[attr-defined]
+        req_id, fut, timeout=None if t in (None, -1) else t)
     return fut
 
 
 def rpc_sync(to: str, fn, args=None, kwargs=None,
              timeout: float = _DEFAULT_TIMEOUT):
-    return _require_agent().submit(to, fn, args, kwargs).result(
-        timeout=None if timeout in (None, -1) else timeout)
+    agent = _require_agent()
+    req_id, fut = agent.submit(to, fn, args, kwargs)
+    return agent.result_of(req_id, fut,
+                           timeout=None if timeout in (None, -1) else timeout)
 
 
 def shutdown():

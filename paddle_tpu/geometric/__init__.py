@@ -48,8 +48,13 @@ def _segment(reduce_op, data, seg_ids, num_segments):
             (-1,) + (1,) * (data.ndim - 1))
     out = _SEG[reduce_op](data, seg_ids, num_segments=num_segments)
     if reduce_op in ("min", "max"):
-        # empty segments come back +/-inf; the reference zeroes them
-        out = jnp.where(jnp.isfinite(out), out, 0).astype(data.dtype)
+        # empty segments come back as the dtype's +/-identity (inf for
+        # floats, INT_MIN/MAX for ints); the reference zeroes them — detect
+        # emptiness by count so integer dtypes zero correctly too
+        n = jax.ops.segment_sum(jnp.ones(seg_ids.shape, jnp.int32), seg_ids,
+                                num_segments=num_segments)
+        empty = (n == 0).reshape((-1,) + (1,) * (data.ndim - 1))
+        out = jnp.where(empty, jnp.zeros((), data.dtype), out)
     return out
 
 

@@ -262,6 +262,25 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
     """
     if out_scale != -1:
         raise NotImplementedError("quantized out_scale path not supported")
+    # reference signature defaults (masked_multihead_attention.py) — passing
+    # one of these AT its default changes nothing and must not raise; any
+    # other value selects a quantized path we do not implement
+    ref_defaults = {"compute_dtype": "default", "quant_round_type": 1,
+                    "quant_max_bound": 127.0, "quant_min_bound": -127.0}
+
+    def _at_default(k, v):
+        if v is None:
+            return True
+        d = ref_defaults.get(k)
+        return (d is not None and isinstance(v, (str, int, float))
+                and v == d)
+
+    passed = {k: v for k, v in _unsupported.items() if not _at_default(k, v)}
+    if passed:
+        # quant-scale tensors etc. would silently change numerics if ignored
+        raise NotImplementedError(
+            f"masked_multihead_attention: unsupported arguments "
+            f"{sorted(passed)} (quantized cache paths are not implemented)")
     xt, ct = _t(x), _t(cache_kv)
     exts = []
     if bias is not None:

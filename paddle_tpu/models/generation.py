@@ -148,8 +148,10 @@ def generate(params, input_ids, config, max_new_tokens: int,
     def step(carry, i):
         cache, tok, done, key = carry
         key, sub = jax.random.split(key)
+        # `tok` was sampled at step i-1 and occupies sequence slot S+i-1:
+        # that's both its cache slot and its RoPE position
         logits, cache = forward_with_cache(
-            params, tok[:, None], c, cache, S + i)
+            params, tok[:, None], c, cache, S + i - 1)
         nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)

@@ -151,10 +151,12 @@ void accept_loop(Bus* bus) {
   }
 }
 
-int connect_to(const std::string& host, int port, int timeout_ms) {
+int connect_to(const std::atomic<bool>& stop, const std::string& host,
+               int port, int timeout_ms) {
   auto deadline =
       std::chrono::steady_clock::now() + std::chrono::milliseconds(timeout_ms);
   for (;;) {
+    if (stop.load()) return -1;  // bus stopping: abandon the retry window
     addrinfo hints{};
     hints.ai_family = AF_INET;
     hints.ai_socktype = SOCK_STREAM;
@@ -246,7 +248,8 @@ int mb_send(void* h, long long my_id, long long peer_id, const void* data,
   std::lock_guard<std::mutex> lk(p->send_mu);
   for (int attempt = 0; attempt < 2; ++attempt) {
     if (p->fd < 0) {
-      p->fd = connect_to(p->host, p->port, bus->connect_timeout_ms);
+      p->fd = connect_to(bus->stop, p->host, p->port,
+                         bus->connect_timeout_ms);
       if (p->fd < 0) return -2;
     }
     int64_t hdr[2] = {my_id, len};

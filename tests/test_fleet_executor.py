@@ -136,6 +136,46 @@ def test_sinks_on_both_ranks():
         bus1.stop()
 
 
+def test_remote_error_reaches_other_carrier():
+    """A failing stage on rank 1 must fail rank 0's wait() with the real
+    error, not a 300s TimeoutError."""
+    M = 4
+    bus0, bus1 = MessageBus(0), MessageBus(1)
+    bus0.add_peer(1, bus1.endpoint)
+    bus1.add_peer(0, bus0.endpoint)
+    try:
+        def build_nodes():
+            src = TaskNode(0, rank=0, kind="source", max_run_times=M,
+                           feed=lambda i: i)
+            def boom(i, ins):
+                raise RuntimeError("remote stage exploded")
+            bad = TaskNode(1, rank=1, kind="compute", max_run_times=M,
+                           run_fn=boom)
+            sink = TaskNode(2, rank=1, kind="sink", max_run_times=M)
+            return _chain(src, bad, sink)
+
+        ex0 = FleetExecutor(build_nodes(), rank=0, bus=bus0)
+        ex1 = FleetExecutor(build_nodes(), rank=1, bus=bus1)
+        err0 = {}
+
+        def run0():
+            try:
+                ex0.run(timeout=30)
+            except BaseException as e:  # noqa: BLE001
+                err0["e"] = e
+
+        t = threading.Thread(target=run0)
+        t.start()
+        with pytest.raises(RuntimeError, match="remote stage exploded"):
+            ex1.run(timeout=30)
+        t.join(timeout=30)
+        assert isinstance(err0.get("e"), RuntimeError), err0
+        assert "remote stage exploded" in str(err0["e"])
+    finally:
+        bus0.stop()
+        bus1.stop()
+
+
 def test_two_carriers_over_message_bus():
     """Stages split across two ranks in one process, wired by real buses."""
     M = 6

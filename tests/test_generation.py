@@ -53,22 +53,39 @@ class TestKVCache:
         np.testing.assert_allclose(np.asarray(got), np.asarray(full[:, 8:12]),
                                    rtol=2e-4, atol=2e-4)
 
+    def _greedy_reference(self, cfg, params, ids, n):
+        seq = ids
+        for _ in range(n):
+            nxt = jnp.argmax(llama.forward(params, seq, cfg)[:, -1], -1)
+            seq = jnp.concatenate([seq, nxt[:, None].astype(jnp.int32)], 1)
+        return seq[:, ids.shape[1]:]
+
+    def test_greedy_matches_uncached_chain(self, tiny):
+        """Regression: decode positions were off by one (cache slot S+i vs
+        S+i-1), which only a multi-token uncached-parity check catches."""
+        cfg, params = tiny
+        ids = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 6)),
+            jnp.int32)
+        a = generation.generate(params, ids, cfg, max_new_tokens=5)
+        np.testing.assert_array_equal(
+            np.asarray(a),
+            np.asarray(self._greedy_reference(cfg, params, ids, 5)))
+
     @pytest.mark.slow
     def test_greedy_generate_deterministic_and_consistent(self, tiny):
         cfg, params = tiny
-        ids = jnp.asarray(
-            np.random.default_rng(2).integers(0, cfg.vocab_size, (2, 6)),
-            jnp.int32)
-        a = generation.generate(params, ids, cfg, max_new_tokens=5)
-        b = generation.generate(params, ids, cfg, max_new_tokens=5)
-        assert a.shape == (2, 5)
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
-        # greedy == argmax chain through the uncached forward
-        seq = ids
-        for _ in range(5):
-            nxt = jnp.argmax(llama.forward(params, seq, cfg)[:, -1], -1)
-            seq = jnp.concatenate([seq, nxt[:, None].astype(jnp.int32)], 1)
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(seq[:, 6:]))
+        for seed in range(1, 5):  # multiple prompts: parity is not seed luck
+            ids = jnp.asarray(
+                np.random.default_rng(seed).integers(0, cfg.vocab_size, (2, 6)),
+                jnp.int32)
+            a = generation.generate(params, ids, cfg, max_new_tokens=5)
+            b = generation.generate(params, ids, cfg, max_new_tokens=5)
+            assert a.shape == (2, 5)
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            np.testing.assert_array_equal(
+                np.asarray(a),
+                np.asarray(self._greedy_reference(cfg, params, ids, 5)))
 
     @pytest.mark.slow
     def test_sampling_modes_run(self, tiny):
@@ -134,6 +151,17 @@ class TestMaskedMHA:
         with pytest.raises(NotImplementedError):
             paddle.incubate.nn.functional.masked_multihead_attention(
                 x, cache, out_scale=2.0)
+        # reference kwargs passed AT their defaults change nothing -> run
+        out2, _ = paddle.incubate.nn.functional.masked_multihead_attention(
+            x, cache, bias=bias, compute_dtype="default", quant_round_type=1,
+            quant_max_bound=127.0, quant_min_bound=-127.0)
+        np.testing.assert_array_equal(np.asarray(out2.numpy()),
+                                      np.asarray(out.numpy()))
+        # a real quant-scale tensor must raise, not silently de-quantize
+        with pytest.raises(NotImplementedError, match="qkv_out_scale"):
+            paddle.incubate.nn.functional.masked_multihead_attention(
+                x, cache, qkv_out_scale=paddle.to_tensor(
+                    np.ones(3 * H * D, np.float32)))
 
 
 class TestServedArtifact:

@@ -10,6 +10,7 @@ import socket
 import subprocess
 import sys
 import textwrap
+import threading
 
 import pytest
 
@@ -96,6 +97,11 @@ def test_rpc_single_process_roundtrip():
         assert info.name == "solo" and info.rank == 0
         with pytest.raises(ValueError, match="unknown rpc worker"):
             rpc.rpc_sync("nobody", divmod, args=(1, 1))
+        # unpicklable result must produce an error response, not a timeout
+        with pytest.raises(RuntimeError, match="not picklable"):
+            rpc.rpc_sync("solo", threading.Lock, timeout=15)
+        # pending-table cleanup on timeout/error paths
+        assert not rpc._agent._pending
         with pytest.raises(RuntimeError, match="init_rpc called twice"):
             rpc.init_rpc("solo2", 0, 1, "127.0.0.1:0")
     finally:

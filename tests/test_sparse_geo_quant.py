@@ -156,6 +156,21 @@ class TestGeometric:
         loss.backward()
         assert np.isfinite(np.asarray(data.grad.numpy())).all()
 
+    def test_segment_min_max_integer_empty_segments(self):
+        """Regression: empty segments must zero for int dtypes too (the
+        isfinite-based zeroing was a float-only no-op)."""
+        data = paddle.to_tensor(np.array([5, 7, 9], np.int32))
+        ids = paddle.to_tensor(np.array([0, 0, 2], np.int32))
+        mx = np.asarray(paddle.geometric.segment_max(data, ids).numpy())
+        np.testing.assert_array_equal(mx, np.array([7, 0, 9], np.int32))
+        mn = np.asarray(paddle.geometric.segment_min(data, ids).numpy())
+        np.testing.assert_array_equal(mn, np.array([5, 0, 9], np.int32))
+        # float path unchanged
+        fx = paddle.to_tensor(np.array([5.0, 7.0, 9.0], np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(paddle.geometric.segment_max(fx, ids).numpy()),
+            np.array([7.0, 0.0, 9.0], np.float32))
+
 
 class TestQuantization:
     def _model(self):
