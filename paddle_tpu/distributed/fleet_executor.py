@@ -264,7 +264,17 @@ class Carrier:
             if got is None:
                 continue
             _, payload = got
-            msg: InterceptorMessage = pickle.loads(payload)
+            try:
+                msg: InterceptorMessage = pickle.loads(payload)
+            except Exception as e:  # noqa: BLE001 — e.g. an ERR whose
+                # exception class dumps fine but fails to unpickle here; a
+                # dead bus thread would reinstate the silent-timeout failure
+                if self.error is None:
+                    self.error = RuntimeError(
+                        f"carrier {self.rank}: undecodable inter-carrier "
+                        f"frame ({e!r})")
+                self._done.set()
+                continue
             if msg.message_type == DONE:
                 # a remote rank's sinks finished; merge its results.  Only a
                 # carrier with NO sinks of its own finishes on this signal —
@@ -307,38 +317,41 @@ class Carrier:
             self._sinks_pending -= 1
             finished = self._sinks_pending <= 0
         if finished:
-            if self.bus is not None:
-                # release carriers that host no sink (their wait() blocks on
-                # this DONE, mirroring the reference's barrier-on-completion);
-                # carry ALL local sink results so remote waiters see them
-                with self._mu:
-                    payload = dict(self._sink_results)
-                for r in {rk for rk in self.task_rank.values()
-                          if rk != self.rank}:
-                    try:
-                        self.bus.send(r, pickle.dumps(InterceptorMessage(
-                            task_id, -1, DONE, payload=payload)))
-                    except (ConnectionError, KeyError):
-                        pass
+            # release carriers that host no sink (their wait() blocks on
+            # this DONE, mirroring the reference's barrier-on-completion);
+            # carry ALL local sink results so remote waiters see them
+            with self._mu:
+                payload = dict(self._sink_results)
+            # broadcast before releasing the local wait(): on this success
+            # path every peer connection is already established (no stall
+            # risk), and a caller tearing the bus down right after wait()
+            # returns must not cut the DONE off
+            self._broadcast(InterceptorMessage(task_id, -1, DONE,
+                                               payload=payload))
             self._done.set()
+
+    def _broadcast(self, msg: InterceptorMessage):
+        """Best-effort send to every other carrier's rank."""
+        if self.bus is None:
+            return
+        frame = pickle.dumps(msg)
+        for r in {rk for rk in self.task_rank.values() if rk != self.rank}:
+            try:
+                self.bus.send(r, frame)
+            except (ConnectionError, KeyError):
+                pass
 
     def on_error(self, task_id: int, err: BaseException):
         self.error = err
-        if self.bus is not None:
-            for r in {rk for rk in self.task_rank.values()
-                      if rk != self.rank}:
-                try:
-                    payload = err
-                    try:
-                        pickle.dumps(err)
-                    except Exception:  # noqa: BLE001 — unpicklable error
-                        payload = RuntimeError(
-                            f"task {task_id} failed: {err!r}")
-                    self.bus.send(r, pickle.dumps(InterceptorMessage(
-                        task_id, -1, ERR, payload=payload)))
-                except (ConnectionError, KeyError):
-                    pass
+        # unblock the local wait() FIRST: broadcasting can stall for a full
+        # connect-retry window per unreachable peer
         self._done.set()
+        try:
+            pickle.dumps(err)
+            payload = err
+        except Exception:  # noqa: BLE001 — unpicklable error
+            payload = RuntimeError(f"task {task_id} failed: {err!r}")
+        self._broadcast(InterceptorMessage(task_id, -1, ERR, payload=payload))
 
     def wait(self, timeout: float = 300.0) -> Dict[int, List[Any]]:
         if not self._done.wait(timeout):

@@ -1,0 +1,642 @@
+"""Parameter server (C35): sharded sparse/dense tables for recsys training.
+
+Reference parity: `paddle/fluid/distributed/ps/` — `PSServer`/`PSClient`
+(service/server.h:63, ps_client.h:64), `MemorySparseTable`
+(table/memory_sparse_table.cc), SGD rules (table/sparse_sgd_rule.cc:
+naive/adagrad/adam), geo-async (table/memory_sparse_geo_table.cc) and the
+`the_one_ps.py` runtime facade.  TPU-native mapping:
+
+  * tables live in native C++ (`native/pstable.cpp`, bucketed hash map with
+    per-bucket locks + per-slot SGD rules; numpy fallback when no
+    toolchain),
+  * transport is the `distributed.rpc` layer (itself on the native message
+    bus) instead of brpc — `PSClient` shards ids over servers by
+    `id %% num_servers` (the reference's `get_sparse_shard` modulo scheme)
+    and scatters pull/push with `rpc_async`,
+  * the dense path holds whole parameter blocks per table (reference
+    memory_dense_table),
+  * geo-async: workers accumulate local deltas and push merged deltas every
+    `geo_steps` trains; the server adds them in (reference geo table
+    semantics),
+  * `fleet.init_server()/run_server()/init_worker()/stop_worker()` facade
+    reads the PaddleCloud env contract (TRAINING_ROLE) like the_one_ps.
+
+The TPU angle: embedding tables this large never fit HBM; workers pull the
+rows a batch touches into a dense jnp array (MXU-friendly), run the jitted
+dense model on TPU, and push sparse grads back — the same CPU-PS +
+accelerator-dense split the reference's heterogeneous PS targets.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ... import native
+
+__all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
+           "SparseEmbedding", "init_server", "run_server", "init_worker",
+           "stop_worker", "is_server", "is_worker"]
+
+
+# ---------------------------------------------------------------------------
+# tables (native with numpy fallback)
+# ---------------------------------------------------------------------------
+
+
+def _lib():
+    lib = native.load("pstable")
+    if lib is not None and not getattr(lib, "_pst_typed", False):
+        i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+        f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+        lib.pst_create.restype = ctypes.c_void_p
+        lib.pst_create.argtypes = [ctypes.c_int, ctypes.c_char_p,
+                                   ctypes.c_float, ctypes.c_float]
+        lib.pst_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
+        lib.pst_push.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
+        lib.pst_assign.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
+        lib.pst_size.restype = ctypes.c_longlong
+        lib.pst_size.argtypes = [ctypes.c_void_p]
+        lib.pst_export.restype = ctypes.c_longlong
+        lib.pst_export.argtypes = [ctypes.c_void_p, i64p, f32p,
+                                   ctypes.c_longlong]
+        lib.pst_save.restype = ctypes.c_int
+        lib.pst_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pst_load.restype = ctypes.c_int
+        lib.pst_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pst_destroy.argtypes = [ctypes.c_void_p]
+        lib.pdt_create.restype = ctypes.c_void_p
+        lib.pdt_create.argtypes = [ctypes.c_longlong, ctypes.c_char_p,
+                                   ctypes.c_float]
+        lib.pdt_pull.argtypes = [ctypes.c_void_p, f32p]
+        lib.pdt_push.argtypes = [ctypes.c_void_p, f32p]
+        lib.pdt_assign.argtypes = [ctypes.c_void_p, f32p]
+        lib.pdt_destroy.argtypes = [ctypes.c_void_p]
+        lib._pst_typed = True
+    return lib
+
+
+class _NumpyRuleMixin:
+    """The same per-slot SGD rules as native/pstable.cpp, in numpy."""
+
+    def _init_opt_state(self, shape):
+        if self.optimizer == "adagrad":
+            return {"g2": np.zeros(shape, np.float32)}
+        if self.optimizer == "adam":
+            return {"m": np.zeros(shape, np.float32),
+                    "v": np.zeros(shape, np.float32),
+                    "b1p": np.float32(1.0), "b2p": np.float32(1.0)}
+        return {}
+
+    def _apply(self, w, g, st):
+        if self.optimizer == "adagrad":
+            st["g2"] += g * g
+            w -= self.lr * g / (np.sqrt(st["g2"]) + 1e-8)
+        elif self.optimizer == "adam":
+            b1, b2 = 0.9, 0.999
+            st["b1p"] = np.float32(st["b1p"] * b1)
+            st["b2p"] = np.float32(st["b2p"] * b2)
+            st["m"] = b1 * st["m"] + (1 - b1) * g
+            st["v"] = b2 * st["v"] + (1 - b2) * g * g
+            mhat = st["m"] / (1 - st["b1p"])
+            vhat = st["v"] / (1 - st["b2p"])
+            w -= self.lr * mhat / (np.sqrt(vhat) + 1e-8)
+        else:
+            w -= self.lr * g
+
+
+class SparseTable(_NumpyRuleMixin):
+    """Lazy-init sparse embedding table (memory_sparse_table.cc analog)."""
+
+    def __init__(self, dim: int, optimizer: str = "sgd", lr: float = 0.01,
+                 initial_range: float = 0.0, backend: str = "auto"):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unsupported sparse optimizer {optimizer!r}")
+        self.dim, self.optimizer, self.lr = dim, optimizer, lr
+        self.initial_range = initial_range
+        lib = _lib() if backend in ("auto", "native") else None
+        if backend == "native" and lib is None:
+            raise RuntimeError("native pstable unavailable (no toolchain)")
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.pst_create(dim, optimizer.encode(), lr,
+                                     initial_range)
+            self.backend = "native"
+        else:
+            self._rows: Dict[int, np.ndarray] = {}
+            self._opt_state: Dict[int, dict] = {}
+            self._mu = threading.Lock()
+            self.backend = "python"
+
+    # deterministic per-id init, matching native splitmix64 only in spirit
+    def _init_row(self, id_: int) -> np.ndarray:
+        if self.initial_range == 0.0:
+            return np.zeros(self.dim, np.float32)
+        rng = np.random.default_rng(np.uint64(id_) + np.uint64(0x51A9B2C3))
+        return ((2.0 * rng.random(self.dim) - 1.0)
+                * self.initial_range).astype(np.float32)
+
+    def pull(self, ids) -> np.ndarray:
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        out = np.empty((ids.size, self.dim), np.float32)
+        if self._lib is not None:
+            self._lib.pst_pull(self._h, ids, ids.size, out)
+            return out
+        with self._mu:
+            for i, id_ in enumerate(ids):
+                r = self._rows.get(int(id_))
+                if r is None:
+                    r = self._rows[int(id_)] = self._init_row(int(id_))
+                    self._opt_state[int(id_)] = self._init_opt_state(
+                        (self.dim,))
+                out[i] = r
+        return out
+
+    def push(self, ids, grads):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(
+            ids.size, self.dim)
+        if self._lib is not None:
+            self._lib.pst_push(self._h, ids, ids.size, grads)
+            return
+        with self._mu:
+            for i, id_ in enumerate(ids):
+                if int(id_) not in self._rows:
+                    self._rows[int(id_)] = self._init_row(int(id_))
+                    self._opt_state[int(id_)] = self._init_opt_state(
+                        (self.dim,))
+                self._apply(self._rows[int(id_)], grads[i],
+                            self._opt_state[int(id_)])
+
+    def assign(self, ids, vals):
+        """Overwrite weights (no optimizer step) — geo merge / load."""
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        vals = np.ascontiguousarray(vals, np.float32).reshape(
+            ids.size, self.dim)
+        if self._lib is not None:
+            self._lib.pst_assign(self._h, ids, ids.size, vals)
+            return
+        with self._mu:
+            for i, id_ in enumerate(ids):
+                self._rows[int(id_)] = vals[i].copy()
+                self._opt_state.setdefault(
+                    int(id_), self._init_opt_state((self.dim,)))
+
+    def __len__(self) -> int:
+        if self._lib is not None:
+            return int(self._lib.pst_size(self._h))
+        with self._mu:
+            return len(self._rows)
+
+    def export(self):
+        """(ids (N,), weights (N, dim)) snapshot of every touched row."""
+        if self._lib is not None:
+            n = len(self)
+            ids = np.empty(n, np.int64)
+            out = np.empty((n, self.dim), np.float32)
+            n = int(self._lib.pst_export(self._h, ids, out, n))
+            return ids[:n], out[:n]
+        with self._mu:
+            ids = np.fromiter(self._rows, np.int64, len(self._rows))
+        return ids, self.pull(ids)
+
+    def save(self, path: str):
+        """Weights-only snapshot (optimizer slots rebuild on demand, like
+        the reference's converter-based save).  The native format is the
+        C++ binary layout; the fallback writes npz with the same content."""
+        if self._lib is not None:
+            rc = self._lib.pst_save(self._h, path.encode())
+            if rc != 0:
+                raise OSError(f"pst_save({path}) failed rc={rc}")
+            return
+        ids, w = self.export()
+        with open(path, "wb") as f:
+            np.savez(f, ids=ids, w=w)
+
+    def load(self, path: str):
+        if self._lib is not None:
+            rc = self._lib.pst_load(self._h, path.encode())
+            if rc != 0:
+                raise OSError(f"pst_load({path}) failed rc={rc} "
+                              f"(missing file or dim mismatch)")
+            return
+        with np.load(path) as z:
+            if z["w"].shape[1] != self.dim:
+                raise OSError(f"pst_load({path}): dim mismatch")
+            self.assign(z["ids"], z["w"])
+
+
+class DenseTable(_NumpyRuleMixin):
+    """One flat parameter block (memory_dense_table.cc analog)."""
+
+    def __init__(self, size: int, optimizer: str = "sgd", lr: float = 0.01,
+                 backend: str = "auto"):
+        if optimizer not in ("sgd", "adagrad", "adam"):
+            raise ValueError(f"unsupported dense optimizer {optimizer!r}")
+        self.size, self.optimizer, self.lr = int(size), optimizer, lr
+        lib = _lib() if backend in ("auto", "native") else None
+        if backend == "native" and lib is None:
+            raise RuntimeError("native pstable unavailable (no toolchain)")
+        self._lib = lib
+        if lib is not None:
+            self._h = lib.pdt_create(self.size, optimizer.encode(), lr)
+            self.backend = "native"
+        else:
+            self._w = np.zeros(self.size, np.float32)
+            self._st = self._init_opt_state((self.size,))
+            self._mu = threading.Lock()
+            self.backend = "python"
+
+    def pull(self) -> np.ndarray:
+        out = np.empty(self.size, np.float32)
+        if self._lib is not None:
+            self._lib.pdt_pull(self._h, out)
+            return out
+        with self._mu:
+            out[:] = self._w
+        return out
+
+    def push(self, grad):
+        grad = np.ascontiguousarray(grad, np.float32).ravel()
+        if grad.size != self.size:
+            raise ValueError(f"dense push size {grad.size} != {self.size}")
+        if self._lib is not None:
+            self._lib.pdt_push(self._h, grad)
+            return
+        with self._mu:
+            self._apply(self._w, grad, self._st)
+
+    def assign(self, vals):
+        vals = np.ascontiguousarray(vals, np.float32).ravel()
+        if vals.size != self.size:
+            raise ValueError(f"dense assign size {vals.size} != {self.size}")
+        if self._lib is not None:
+            self._lib.pdt_assign(self._h, vals)
+            return
+        with self._mu:
+            self._w[:] = vals
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class PSServer:
+    """Hosts tables; methods are what PSClient invokes (over rpc or
+    directly in local mode).  Reference: ps/service/server.h:63."""
+
+    def __init__(self):
+        self._sparse: Dict[int, SparseTable] = {}
+        self._dense: Dict[int, DenseTable] = {}
+
+    def create_sparse_table(self, table_id: int, dim: int, **kw):
+        self._sparse[table_id] = SparseTable(dim, **kw)
+
+    def create_dense_table(self, table_id: int, size: int, **kw):
+        self._dense[table_id] = DenseTable(size, **kw)
+
+    def pull_sparse(self, table_id: int, ids) -> np.ndarray:
+        return self._sparse[table_id].pull(ids)
+
+    def push_sparse(self, table_id: int, ids, grads):
+        self._sparse[table_id].push(ids, grads)
+
+    def push_sparse_delta(self, table_id: int, ids, deltas):
+        """Geo-async merge: w[id] += delta (no optimizer state)."""
+        t = self._sparse[table_id]
+        cur = t.pull(ids)
+        t.assign(ids, cur + np.asarray(deltas, np.float32))
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        return self._dense[table_id].pull()
+
+    def push_dense(self, table_id: int, grad):
+        self._dense[table_id].push(grad)
+
+    def save(self, dirname: str):
+        os.makedirs(dirname, exist_ok=True)
+        for tid, t in self._sparse.items():
+            t.save(os.path.join(dirname, f"sparse_{tid}.bin"))
+        for tid, t in self._dense.items():
+            np.save(os.path.join(dirname, f"dense_{tid}.npy"), t.pull())
+
+    def load(self, dirname: str):
+        for tid, t in self._sparse.items():
+            p = os.path.join(dirname, f"sparse_{tid}.bin")
+            if os.path.exists(p):
+                t.load(p)
+        for tid, t in self._dense.items():
+            p = os.path.join(dirname, f"dense_{tid}.npy")
+            if os.path.exists(p):
+                t.assign(np.load(p))
+
+
+# the server singleton rpc-dispatched functions act on (one per process,
+# like the reference's server instance behind brpc)
+_SERVER: Optional[PSServer] = None
+
+
+def _server() -> PSServer:
+    if _SERVER is None:
+        raise RuntimeError("this process runs no PSServer (call init_server)")
+    return _SERVER
+
+
+def _rpc_create_sparse(table_id, dim, kw):
+    _server().create_sparse_table(table_id, dim, **kw)
+
+
+def _rpc_create_dense(table_id, size, kw):
+    _server().create_dense_table(table_id, size, **kw)
+
+
+def _rpc_pull_sparse(table_id, ids):
+    return _server().pull_sparse(table_id, ids)
+
+
+def _rpc_push_sparse(table_id, ids, grads):
+    _server().push_sparse(table_id, ids, grads)
+
+
+def _rpc_push_sparse_delta(table_id, ids, deltas):
+    _server().push_sparse_delta(table_id, ids, deltas)
+
+
+def _rpc_pull_dense(table_id):
+    return _server().pull_dense(table_id)
+
+
+def _rpc_push_dense(table_id, grad):
+    _server().push_dense(table_id, grad)
+
+
+def _rpc_save(dirname):
+    _server().save(dirname)
+
+
+def _rpc_load(dirname):
+    _server().load(dirname)
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class PSClient:
+    """Shards ids over servers by `id %% num_servers` and scatters
+    pull/push (reference ps_client.h:64 + get_sparse_shard modulo).
+
+    `servers`: list of rpc worker names (remote mode) OR PSServer objects
+    (local mode, single process — used by tests and by SparseEmbedding's
+    default); `geo_steps > 0` switches push_sparse into geo-async delta
+    accumulation flushed every geo_steps trains.
+    """
+
+    def __init__(self, servers: Sequence, geo_steps: int = 0):
+        if not servers:
+            raise ValueError("PSClient needs at least one server")
+        self.servers = list(servers)
+        self.remote = isinstance(self.servers[0], str)
+        self.geo_steps = geo_steps
+        self._geo_acc: Dict[int, Dict[int, np.ndarray]] = {}
+        self._geo_count = 0
+        self._dense_home: Dict[int, int] = {}
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _call(self, server_idx: int, fn, *args, wait=True):
+        assert self.remote, "local mode calls server methods directly"
+        from .. import rpc
+        if wait:
+            return rpc.rpc_sync(self.servers[server_idx], fn, args=args)
+        return rpc.rpc_async(self.servers[server_idx], fn, args=args)
+
+    def _shard(self, ids: np.ndarray):
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        home = (ids % len(self.servers)).astype(np.int64)
+        return ids, home
+
+    # -- tables -------------------------------------------------------------
+
+    def create_sparse_table(self, table_id: int, dim: int, **kw):
+        for i, s in enumerate(self.servers):
+            if self.remote:
+                self._call(i, _rpc_create_sparse, table_id, dim, kw)
+            else:
+                s.create_sparse_table(table_id, dim, **kw)
+        self._geo_acc.setdefault(table_id, {})
+
+    def create_dense_table(self, table_id: int, size: int, **kw):
+        # dense blocks live whole on one server, round-robin by table id
+        home = table_id % len(self.servers)
+        self._dense_home[table_id] = home
+        if self.remote:
+            self._call(home, _rpc_create_dense, table_id, size, kw)
+        else:
+            self.servers[home].create_dense_table(table_id, size, **kw)
+
+    # -- sparse -------------------------------------------------------------
+
+    def pull_sparse(self, table_id: int, ids) -> np.ndarray:
+        ids, home = self._shard(ids)
+        out = np.empty((ids.size, 0), np.float32)
+        futures = []
+        for si in range(len(self.servers)):
+            mask = home == si
+            if not mask.any():
+                futures.append(None)
+                continue
+            if self.remote:
+                futures.append((mask, self._call(
+                    si, _rpc_pull_sparse, table_id, ids[mask], wait=False)))
+            else:
+                futures.append((mask, self.servers[si].pull_sparse(
+                    table_id, ids[mask])))
+        for item in futures:
+            if item is None:
+                continue
+            mask, rows = item
+            if self.remote:
+                rows = rows.wait()
+            if out.shape[1] == 0:
+                out = np.empty((ids.size, rows.shape[1]), np.float32)
+            out[mask] = rows
+        return out
+
+    def push_sparse(self, table_id: int, ids, grads):
+        """Sync mode: apply the server-side SGD rule now.  Geo mode
+        (geo_steps > 0): accumulate -lr*grad deltas locally, flush every
+        geo_steps pushes."""
+        if self.geo_steps > 0:
+            self._geo_accumulate(table_id, ids, grads)
+            return
+        ids, home = self._shard(ids)
+        grads = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
+        futs = []
+        for si in range(len(self.servers)):
+            mask = home == si
+            if not mask.any():
+                continue
+            if self.remote:
+                futs.append(self._call(si, _rpc_push_sparse, table_id,
+                                       ids[mask], grads[mask], wait=False))
+            else:
+                self.servers[si].push_sparse(table_id, ids[mask], grads[mask])
+        for f in futs:
+            f.wait()
+
+    def _geo_accumulate(self, table_id: int, ids, grads):
+        acc = self._geo_acc.setdefault(table_id, {})
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        grads = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
+        # local SGD step becomes the delta the server adds in (geo tables
+        # carry no optimizer state server-side)
+        for i, id_ in enumerate(ids):
+            d = acc.get(int(id_))
+            delta = -0.01 * grads[i]
+            acc[int(id_)] = delta if d is None else d + delta
+        self._geo_count += 1
+        if self._geo_count >= self.geo_steps:
+            self.geo_flush()
+
+    def geo_flush(self):
+        """Push accumulated deltas; servers merge w += delta."""
+        for table_id, acc in self._geo_acc.items():
+            if not acc:
+                continue
+            ids = np.fromiter(acc, np.int64, len(acc))
+            deltas = np.stack([acc[int(i)] for i in ids])
+            sids, home = self._shard(ids)
+            for si in range(len(self.servers)):
+                mask = home == si
+                if not mask.any():
+                    continue
+                if self.remote:
+                    self._call(si, _rpc_push_sparse_delta, table_id,
+                               sids[mask], deltas[mask])
+                else:
+                    self.servers[si].push_sparse_delta(
+                        table_id, sids[mask], deltas[mask])
+            acc.clear()
+        self._geo_count = 0
+
+    # -- dense --------------------------------------------------------------
+
+    def pull_dense(self, table_id: int) -> np.ndarray:
+        home = self._dense_home[table_id]
+        if self.remote:
+            return self._call(home, _rpc_pull_dense, table_id)
+        return self.servers[home].pull_dense(table_id)
+
+    def push_dense(self, table_id: int, grad):
+        home = self._dense_home[table_id]
+        if self.remote:
+            self._call(home, _rpc_push_dense, table_id, grad)
+        else:
+            self.servers[home].push_dense(table_id, grad)
+
+    # -- persistence --------------------------------------------------------
+
+    def save(self, dirname: str):
+        for si in range(len(self.servers)):
+            d = os.path.join(dirname, f"server_{si}")
+            if self.remote:
+                self._call(si, _rpc_save, d)
+            else:
+                self.servers[si].save(d)
+
+    def load(self, dirname: str):
+        for si in range(len(self.servers)):
+            d = os.path.join(dirname, f"server_{si}")
+            if self.remote:
+                self._call(si, _rpc_load, d)
+            else:
+                self.servers[si].load(d)
+
+
+# ---------------------------------------------------------------------------
+# embedding helper: the worker-side TPU data flow
+# ---------------------------------------------------------------------------
+
+
+class SparseEmbedding:
+    """PS-backed embedding lookup for the training hot loop.
+
+    `lookup(ids)` pulls the touched rows as a dense (N, dim) jnp array
+    (device-placed, MXU-ready); `push_grad(ids, grad)` sends sparse grads
+    back.  This is the reference's distributed embedding
+    (`fleet.utils.ps_util` / c_embedding-over-PS) expressed TPU-first: the
+    sparse side stays on host CPU, only dense batch slices touch the chip.
+    """
+
+    def __init__(self, client: PSClient, table_id: int, dim: int, **kw):
+        self.client, self.table_id, self.dim = client, table_id, dim
+        client.create_sparse_table(table_id, dim, **kw)
+
+    def lookup(self, ids):
+        import jax.numpy as jnp
+        ids = np.asarray(ids)
+        rows = self.client.pull_sparse(self.table_id, ids.ravel())
+        return jnp.asarray(rows.reshape(*ids.shape, self.dim))
+
+    def push_grad(self, ids, grad):
+        ids = np.asarray(ids).ravel()
+        g = np.asarray(grad, np.float32).reshape(ids.size, self.dim)
+        self.client.push_sparse(self.table_id, ids, g)
+
+
+# ---------------------------------------------------------------------------
+# the_one_ps-style fleet facade (PaddleCloud env contract)
+# ---------------------------------------------------------------------------
+
+
+def is_server() -> bool:
+    return os.environ.get("TRAINING_ROLE", "").upper() == "PSERVER"
+
+
+def is_worker() -> bool:
+    return os.environ.get("TRAINING_ROLE", "TRAINER").upper() == "TRAINER"
+
+
+def init_server(load_dir: Optional[str] = None) -> PSServer:
+    """Create this process's PSServer (reference fleet.init_server)."""
+    global _SERVER
+    if _SERVER is None:
+        _SERVER = PSServer()
+    if load_dir:
+        _SERVER.load(load_dir)
+    return _SERVER
+
+
+def run_server(name: Optional[str] = None, rank: Optional[int] = None,
+               world_size: Optional[int] = None,
+               master_endpoint: Optional[str] = None):
+    """Join the rpc gang as a server and serve until rpc.shutdown()
+    (reference fleet.run_server blocks in brpc)."""
+    from .. import rpc
+    init_server()
+    rpc.init_rpc(name or f"ps_server_{os.environ.get('PADDLE_TRAINER_ID', 0)}",
+                 rank, world_size, master_endpoint)
+    rpc.shutdown()  # barrier-blocks until every worker is done, then exits
+
+
+def init_worker(server_names: List[str], geo_steps: int = 0,
+                name: Optional[str] = None, rank: Optional[int] = None,
+                world_size: Optional[int] = None,
+                master_endpoint: Optional[str] = None) -> PSClient:
+    """Join the rpc gang as a trainer; returns the PSClient."""
+    from .. import rpc
+    rpc.init_rpc(name or f"trainer_{os.environ.get('PADDLE_TRAINER_ID', 0)}",
+                 rank, world_size, master_endpoint)
+    return PSClient(server_names, geo_steps=geo_steps)
+
+
+def stop_worker():
+    from .. import rpc
+    rpc.shutdown()

@@ -1,0 +1,318 @@
+// Parameter-server tables: sharded sparse + dense embedding storage with
+// per-slot SGD update rules, behind a C ABI for ctypes.
+//
+// TPU-native equivalent of the reference's C++ PS tables
+// (paddle/fluid/distributed/ps/table/memory_sparse_table.cc,
+// memory_dense_table.cc) and SGD rules (sparse_sgd_rule.cc: naive /
+// adagrad / adam).  The Python PSServer hosts these tables and serves
+// pull/push over the RPC layer; ids hash-shard across servers the way the
+// reference's get_sparse_shard does (key % shard_num).
+//
+// Sparse rows initialize lazily on first pull (uniform in
+// [-initial_range, initial_range], seeded per id so every server/restart
+// agrees).  Internally the table is bucketed (SHARDS-way) with per-bucket
+// mutexes so concurrent pulls/pushes from the RPC worker pool scale.
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kShards = 32;
+
+enum class Rule { kNaive, kAdagrad, kAdam };
+
+struct Opt {
+  Rule rule = Rule::kNaive;
+  float lr = 0.01f;
+  float initial_range = 0.0f;
+  float initial_g2sum = 0.0f;  // adagrad epsilon seed
+  float beta1 = 0.9f, beta2 = 0.999f, eps = 1e-8f;
+};
+
+// per-row payload: [w(dim)] [slots...]  (adagrad: g2sum(dim); adam:
+// m(dim) v(dim) beta1_pow beta2_pow)
+int slot_floats(Rule r, int dim) {
+  switch (r) {
+    case Rule::kNaive:
+      return 0;
+    case Rule::kAdagrad:
+      return dim;
+    case Rule::kAdam:
+      return 2 * dim + 2;
+  }
+  return 0;
+}
+
+// splitmix64: deterministic per-id init so every shard/restart agrees
+uint64_t mix(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+void init_row(float* w, int dim, int64_t id, const Opt& o) {
+  if (o.initial_range == 0.0f) {
+    std::memset(w, 0, sizeof(float) * static_cast<size_t>(dim));
+    return;
+  }
+  uint64_t s = mix(static_cast<uint64_t>(id) + 0x51a9b2c3d4e5f601ull);
+  for (int i = 0; i < dim; ++i) {
+    s = mix(s);
+    float u = static_cast<float>(s >> 11) * (1.0f / 9007199254740992.0f);
+    w[i] = (2.0f * u - 1.0f) * o.initial_range;
+  }
+}
+
+void apply_rule(float* row, const float* g, int dim, const Opt& o) {
+  float* w = row;
+  switch (o.rule) {
+    case Rule::kNaive: {
+      for (int i = 0; i < dim; ++i) w[i] -= o.lr * g[i];
+      break;
+    }
+    case Rule::kAdagrad: {
+      float* g2 = row + dim;
+      for (int i = 0; i < dim; ++i) {
+        g2[i] += g[i] * g[i];
+        w[i] -= o.lr * g[i] /
+                (std::sqrt(g2[i] + o.initial_g2sum) + o.eps);
+      }
+      break;
+    }
+    case Rule::kAdam: {
+      float* m = row + dim;
+      float* v = row + 2 * dim;
+      float& b1p = row[3 * dim];
+      float& b2p = row[3 * dim + 1];
+      b1p *= o.beta1;
+      b2p *= o.beta2;
+      for (int i = 0; i < dim; ++i) {
+        m[i] = o.beta1 * m[i] + (1 - o.beta1) * g[i];
+        v[i] = o.beta2 * v[i] + (1 - o.beta2) * g[i] * g[i];
+        float mhat = m[i] / (1 - b1p);
+        float vhat = v[i] / (1 - b2p);
+        w[i] -= o.lr * mhat / (std::sqrt(vhat) + o.eps);
+      }
+      break;
+    }
+  }
+}
+
+struct SparseTable {
+  int dim;
+  Opt opt;
+  int row_floats;
+  std::unordered_map<int64_t, std::vector<float>> shard[kShards];
+  std::mutex mu[kShards];
+
+  std::vector<float>& row(int64_t id) {
+    int s = static_cast<int>((static_cast<uint64_t>(id)) % kShards);
+    auto& m = shard[s];
+    auto it = m.find(id);
+    if (it == m.end()) {
+      std::vector<float> r(static_cast<size_t>(row_floats), 0.0f);
+      init_row(r.data(), dim, id, opt);
+      if (opt.rule == Rule::kAdam) {
+        r[static_cast<size_t>(3 * dim)] = 1.0f;      // beta1_pow
+        r[static_cast<size_t>(3 * dim) + 1] = 1.0f;  // beta2_pow
+      }
+      it = m.emplace(id, std::move(r)).first;
+    }
+    return it->second;
+  }
+};
+
+Opt parse_opt(const char* name, float lr, float initial_range) {
+  Opt o;
+  o.lr = lr;
+  o.initial_range = initial_range;
+  std::string n(name ? name : "sgd");
+  if (n == "adagrad")
+    o.rule = Rule::kAdagrad;
+  else if (n == "adam")
+    o.rule = Rule::kAdam;
+  else
+    o.rule = Rule::kNaive;
+  return o;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pst_create(int dim, const char* optimizer, float lr,
+                 float initial_range) {
+  auto* t = new SparseTable();
+  t->dim = dim;
+  t->opt = parse_opt(optimizer, lr, initial_range);
+  t->row_floats = dim + slot_floats(t->opt.rule, dim);
+  return t;
+}
+
+// gather rows for n ids into out (n x dim, row-major)
+void pst_pull(void* h, const int64_t* ids, int n, float* out) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int i = 0; i < n; ++i) {
+    int s = static_cast<int>(static_cast<uint64_t>(ids[i]) % kShards);
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    const auto& r = t->row(ids[i]);
+    std::memcpy(out + static_cast<size_t>(i) * t->dim, r.data(),
+                sizeof(float) * static_cast<size_t>(t->dim));
+  }
+}
+
+// apply the SGD rule per id with its gradient row (n x dim); duplicate ids
+// apply sequentially (the reference accumulates per occurrence too)
+void pst_push(void* h, const int64_t* ids, int n, const float* grads) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int i = 0; i < n; ++i) {
+    int s = static_cast<int>(static_cast<uint64_t>(ids[i]) % kShards);
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    auto& r = t->row(ids[i]);
+    apply_rule(r.data(), grads + static_cast<size_t>(i) * t->dim, t->dim,
+               t->opt);
+  }
+}
+
+// overwrite weights (no optimizer update) — geo-merge / load paths
+void pst_assign(void* h, const int64_t* ids, int n, const float* vals) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int i = 0; i < n; ++i) {
+    int s = static_cast<int>(static_cast<uint64_t>(ids[i]) % kShards);
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    auto& r = t->row(ids[i]);
+    std::memcpy(r.data(), vals + static_cast<size_t>(i) * t->dim,
+                sizeof(float) * static_cast<size_t>(t->dim));
+  }
+}
+
+long long pst_size(void* h) {
+  auto* t = static_cast<SparseTable*>(h);
+  long long n = 0;
+  for (int s = 0; s < kShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    n += static_cast<long long>(t->shard[s].size());
+  }
+  return n;
+}
+
+// export all (id, w) pairs; ids/out sized by pst_size()*  — caller
+// allocates.  Returns rows written.
+long long pst_export(void* h, int64_t* ids, float* out, long long cap) {
+  auto* t = static_cast<SparseTable*>(h);
+  long long n = 0;
+  for (int s = 0; s < kShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    for (auto& kv : t->shard[s]) {
+      if (n >= cap) return n;
+      ids[n] = kv.first;
+      std::memcpy(out + static_cast<size_t>(n) * t->dim, kv.second.data(),
+                  sizeof(float) * static_cast<size_t>(t->dim));
+      ++n;
+    }
+  }
+  return n;
+}
+
+// binary save/load: [int32 dim][int64 count]([int64 id][float w*dim])*
+// (weights only — optimizer slots rebuild on demand, like the reference's
+// converter-based save)
+int pst_save(void* h, const char* path) {
+  auto* t = static_cast<SparseTable*>(h);
+  FILE* f = std::fopen(path, "wb");
+  if (!f) return -1;
+  int32_t dim = t->dim;
+  int64_t count = pst_size(h);
+  std::fwrite(&dim, sizeof(dim), 1, f);
+  std::fwrite(&count, sizeof(count), 1, f);
+  for (int s = 0; s < kShards; ++s) {
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    for (auto& kv : t->shard[s]) {
+      std::fwrite(&kv.first, sizeof(int64_t), 1, f);
+      std::fwrite(kv.second.data(), sizeof(float),
+                  static_cast<size_t>(dim), f);
+    }
+  }
+  std::fclose(f);
+  return 0;
+}
+
+int pst_load(void* h, const char* path) {
+  auto* t = static_cast<SparseTable*>(h);
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  int32_t dim = 0;
+  int64_t count = 0;
+  if (std::fread(&dim, sizeof(dim), 1, f) != 1 || dim != t->dim ||
+      std::fread(&count, sizeof(count), 1, f) != 1) {
+    std::fclose(f);
+    return -2;
+  }
+  std::vector<float> w(static_cast<size_t>(dim));
+  for (int64_t i = 0; i < count; ++i) {
+    int64_t id;
+    if (std::fread(&id, sizeof(id), 1, f) != 1 ||
+        std::fread(w.data(), sizeof(float), static_cast<size_t>(dim), f) !=
+            static_cast<size_t>(dim)) {
+      std::fclose(f);
+      return -2;
+    }
+    pst_assign(h, &id, 1, w.data());
+  }
+  std::fclose(f);
+  return 0;
+}
+
+void pst_destroy(void* h) { delete static_cast<SparseTable*>(h); }
+
+// ---- dense table: one contiguous parameter block with the same rules ----
+
+void* pdt_create(long long size, const char* optimizer, float lr) {
+  // a dense table is one flat parameter block: a single row of `size`
+  auto* t = new SparseTable();
+  t->opt = parse_opt(optimizer, lr, 0.0f);
+  t->dim = static_cast<int>(size);
+  t->row_floats = t->dim + slot_floats(t->opt.rule, t->dim);
+  int64_t id = 0;
+  std::lock_guard<std::mutex> lk(t->mu[0]);
+  t->shard[0].emplace(id, std::vector<float>(
+      static_cast<size_t>(t->row_floats), 0.0f));
+  if (t->opt.rule == Rule::kAdam) {
+    auto& r = t->shard[0][0];
+    r[static_cast<size_t>(3 * t->dim)] = 1.0f;
+    r[static_cast<size_t>(3 * t->dim) + 1] = 1.0f;
+  }
+  return t;
+}
+
+void pdt_pull(void* h, float* out) {
+  auto* t = static_cast<SparseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu[0]);
+  std::memcpy(out, t->shard[0][0].data(),
+              sizeof(float) * static_cast<size_t>(t->dim));
+}
+
+void pdt_push(void* h, const float* grad) {
+  auto* t = static_cast<SparseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu[0]);
+  apply_rule(t->shard[0][0].data(), grad, t->dim, t->opt);
+}
+
+void pdt_assign(void* h, const float* vals) {
+  auto* t = static_cast<SparseTable*>(h);
+  std::lock_guard<std::mutex> lk(t->mu[0]);
+  std::memcpy(t->shard[0][0].data(), vals,
+              sizeof(float) * static_cast<size_t>(t->dim));
+}
+
+void pdt_destroy(void* h) { delete static_cast<SparseTable*>(h); }
+
+}  // extern "C"

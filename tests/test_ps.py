@@ -1,0 +1,229 @@
+"""Parameter server (C35): tables, SGD rules, sharding client, geo mode.
+
+Reference behavior: fluid/distributed/ps/ (memory_sparse_table,
+sparse_sgd_rule naive/adagrad/adam, get_sparse_shard modulo sharding,
+geo-async delta merge, the_one_ps fleet facade).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import (
+    DenseTable, PSClient, PSServer, SparseEmbedding, SparseTable)
+
+BACKENDS = ["python", "native"]
+
+
+def _mk(backend, **kw):
+    try:
+        return SparseTable(8, backend=backend, **kw)
+    except RuntimeError:
+        pytest.skip("no native toolchain")
+
+
+class TestSparseTable:
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_lazy_zero_init_and_pull(self, backend):
+        t = _mk(backend)
+        rows = t.pull(np.array([3, 9, 3]))
+        assert rows.shape == (3, 8)
+        np.testing.assert_array_equal(rows, 0)
+        assert len(t) == 2  # distinct ids touched
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_deterministic_random_init(self, backend):
+        a = _mk(backend, initial_range=0.1)
+        b = _mk(backend, initial_range=0.1)
+        ra, rb = a.pull(np.array([7, 123456789])), b.pull(np.array([7, 123456789]))
+        np.testing.assert_array_equal(ra, rb)  # same id -> same init
+        assert (np.abs(ra) <= 0.1).all() and np.abs(ra).max() > 0
+
+    @pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
+    def test_native_matches_python_rules(self, optimizer):
+        tn = _mk("native", optimizer=optimizer, lr=0.05)
+        tp = SparseTable(8, backend="python", optimizer=optimizer, lr=0.05)
+        rng = np.random.default_rng(0)
+        ids = np.array([1, 5, 9, 5])
+        for _ in range(5):
+            g = rng.normal(size=(4, 8)).astype(np.float32)
+            tn.push(ids, g)
+            tp.push(ids, g)
+        np.testing.assert_allclose(tn.pull(ids), tp.pull(ids),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_save_load_roundtrip(self, backend, tmp_path):
+        t = _mk(backend, optimizer="adagrad", lr=0.1)
+        ids = np.array([2, 4, 6])
+        t.push(ids, np.ones((3, 8), np.float32))
+        path = str(tmp_path / "table.bin")
+        t.save(path)
+        t2 = _mk(backend, optimizer="adagrad", lr=0.1)
+        t2.load(path)
+        np.testing.assert_array_equal(t2.pull(ids), t.pull(ids))
+        assert len(t2) == 3
+
+    def test_bad_optimizer_rejected(self):
+        with pytest.raises(ValueError, match="unsupported sparse optimizer"):
+            SparseTable(4, optimizer="rmsprop")
+
+
+class TestDenseTable:
+    @pytest.mark.parametrize("backend", BACKENDS)
+    def test_adam_matches_numpy_reference(self, backend):
+        try:
+            t = DenseTable(16, optimizer="adam", lr=0.01, backend=backend)
+        except RuntimeError:
+            pytest.skip("no native toolchain")
+        w = np.zeros(16, np.float32)
+        m = np.zeros(16); v = np.zeros(16); b1p = b2p = 1.0
+        rng = np.random.default_rng(1)
+        for _ in range(10):
+            g = rng.normal(size=16).astype(np.float32)
+            t.push(g)
+            b1p *= 0.9; b2p *= 0.999
+            m = 0.9 * m + 0.1 * g
+            v = 0.999 * v + 0.001 * g * g
+            w -= 0.01 * (m / (1 - b1p)) / (np.sqrt(v / (1 - b2p)) + 1e-8)
+        np.testing.assert_allclose(t.pull(), w, rtol=1e-4, atol=1e-6)
+
+
+class TestPSClientLocal:
+    def test_sharded_pull_push_matches_single_server(self):
+        many = PSClient([PSServer(), PSServer(), PSServer()])
+        one = PSClient([PSServer()])
+        for c in (many, one):
+            c.create_sparse_table(0, 8, optimizer="sgd", lr=0.1)
+        ids = np.arange(17)
+        g = np.random.default_rng(2).normal(size=(17, 8)).astype(np.float32)
+        many.push_sparse(0, ids, g)
+        one.push_sparse(0, ids, g)
+        np.testing.assert_allclose(many.pull_sparse(0, ids),
+                                   one.pull_sparse(0, ids), rtol=1e-6)
+        # each server only holds its modulo shard
+        sizes = [len(s._sparse[0]) for s in many.servers]
+        assert sum(sizes) == 17 and all(sz > 0 for sz in sizes)
+
+    def test_dense_table_home_and_update(self):
+        c = PSClient([PSServer(), PSServer()])
+        c.create_dense_table(3, 4, optimizer="sgd", lr=0.5)
+        c.push_dense(3, np.array([1, 2, 3, 4], np.float32))
+        np.testing.assert_allclose(c.pull_dense(3), [-0.5, -1, -1.5, -2])
+
+    def test_geo_async_delta_merge(self):
+        c = PSClient([PSServer(), PSServer()], geo_steps=3)
+        c.create_sparse_table(0, 4, optimizer="sgd", lr=0.01)
+        ids = np.array([1, 2])
+        g = np.ones((2, 4), np.float32)
+        c.push_sparse(0, ids, g)  # accumulated, not yet visible
+        np.testing.assert_array_equal(c.pull_sparse(0, ids), 0)
+        c.push_sparse(0, ids, g)
+        c.push_sparse(0, ids, g)  # 3rd push triggers the flush
+        np.testing.assert_allclose(c.pull_sparse(0, ids),
+                                   np.full((2, 4), -0.03), rtol=1e-5)
+
+    def test_save_load_across_clients(self, tmp_path):
+        c = PSClient([PSServer(), PSServer()])
+        c.create_sparse_table(0, 4, optimizer="sgd", lr=1.0)
+        ids = np.arange(6)
+        c.push_sparse(0, ids, np.ones((6, 4), np.float32))
+        c.save(str(tmp_path))
+        c2 = PSClient([PSServer(), PSServer()])
+        c2.create_sparse_table(0, 4, optimizer="sgd", lr=1.0)
+        c2.load(str(tmp_path))
+        np.testing.assert_array_equal(c2.pull_sparse(0, ids),
+                                      c.pull_sparse(0, ids))
+
+
+class TestSparseEmbeddingTraining:
+    def test_embedding_regression_loss_decreases(self):
+        """The worker-side TPU data flow: pull rows -> jitted dense compute
+        -> push sparse grads."""
+        import jax
+        import jax.numpy as jnp
+
+        client = PSClient([PSServer(), PSServer()])
+        emb = SparseEmbedding(client, table_id=0, dim=8, optimizer="adagrad",
+                              lr=0.5, initial_range=0.05)
+        rng = np.random.default_rng(3)
+        n_ids, B = 40, 16
+        proj = jnp.asarray(rng.normal(size=(8,)).astype(np.float32))
+        targets = rng.normal(size=n_ids).astype(np.float32)
+
+        @jax.jit
+        def loss_and_grad(rows, y):
+            def f(r):
+                return jnp.mean((r @ proj - y) ** 2)
+            return jax.value_and_grad(f)(rows)
+
+        losses = []
+        for step in range(30):
+            ids = rng.integers(0, n_ids, B)
+            rows = emb.lookup(ids)
+            y = jnp.asarray(targets[ids])
+            loss, grad = loss_and_grad(rows, y)
+            emb.push_grad(ids, np.asarray(grad))
+            losses.append(float(loss))
+        assert losses[-1] < 0.3 * losses[0], losses
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PS_SCRIPT = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from paddle_tpu.distributed import ps
+
+    role, master = sys.argv[1], sys.argv[2]
+    if role == "server":
+        os.environ["TRAINING_ROLE"] = "PSERVER"
+        assert ps.is_server()
+        ps.run_server(name="ps0", rank=0, world_size=2,
+                      master_endpoint=master)   # blocks until shutdown
+        print("PS_SERVER_DONE")
+    else:
+        client = ps.init_worker(["ps0"], name="trainer0", rank=1,
+                                world_size=2, master_endpoint=master)
+        client.create_sparse_table(0, 4, optimizer="sgd", lr=0.1)
+        ids = np.array([3, 7, 11])
+        client.push_sparse(0, ids, np.ones((3, 4), np.float32))
+        got = client.pull_sparse(0, ids)
+        np.testing.assert_allclose(got, -0.1, rtol=1e-6)
+        client.create_dense_table(1, 8, optimizer="sgd", lr=1.0)
+        client.push_dense(1, np.arange(8, dtype=np.float32))
+        np.testing.assert_allclose(client.pull_dense(1),
+                                   -np.arange(8, dtype=np.float32))
+        ps.stop_worker()
+        print("PS_WORKER_DONE")
+""").format(repo=REPO)
+
+
+@pytest.mark.slow
+def test_ps_across_processes(tmp_path):
+    def _free_port():
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    master = f"127.0.0.1:{_free_port()}"
+    script = tmp_path / "ps_node.py"
+    script.write_text(PS_SCRIPT)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    server = subprocess.Popen(
+        [sys.executable, str(script), "server", master],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    worker = subprocess.Popen(
+        [sys.executable, str(script), "worker", master],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True, env=env)
+    wout, _ = worker.communicate(timeout=180)
+    sout, _ = server.communicate(timeout=60)
+    assert worker.returncode == 0, f"worker:\n{wout}"
+    assert server.returncode == 0, f"server:\n{sout}"
+    assert "PS_WORKER_DONE" in wout and "PS_SERVER_DONE" in sout
