@@ -58,6 +58,7 @@ def _lib():
                                    ctypes.c_float, ctypes.c_float]
         lib.pst_pull.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
         lib.pst_push.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
+        lib.pst_add.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
         lib.pst_assign.argtypes = [ctypes.c_void_p, i64p, ctypes.c_int, f32p]
         lib.pst_size.restype = ctypes.c_longlong
         lib.pst_size.argtypes = [ctypes.c_void_p]
@@ -172,8 +173,25 @@ class SparseTable(_NumpyRuleMixin):
                 self._apply(self._rows[int(id_)], grads[i],
                             self._opt_state[int(id_)])
 
+    def add(self, ids, deltas):
+        """w[id] += delta atomically (geo-async merge)."""
+        ids = np.ascontiguousarray(ids, np.int64).ravel()
+        deltas = np.ascontiguousarray(deltas, np.float32).reshape(
+            ids.size, self.dim)
+        if self._lib is not None:
+            self._lib.pst_add(self._h, ids, ids.size, deltas)
+            return
+        with self._mu:
+            for i, id_ in enumerate(ids):
+                r = self._rows.get(int(id_))
+                if r is None:
+                    r = self._rows[int(id_)] = self._init_row(int(id_))
+                    self._opt_state[int(id_)] = self._init_opt_state(
+                        (self.dim,))
+                r += deltas[i]
+
     def assign(self, ids, vals):
-        """Overwrite weights (no optimizer step) — geo merge / load."""
+        """Overwrite weights (no optimizer step) — load path."""
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         vals = np.ascontiguousarray(vals, np.float32).reshape(
             ids.size, self.dim)
@@ -185,6 +203,15 @@ class SparseTable(_NumpyRuleMixin):
                 self._rows[int(id_)] = vals[i].copy()
                 self._opt_state.setdefault(
                     int(id_), self._init_opt_state((self.dim,)))
+
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            self._h = None
+            try:
+                lib.pst_destroy(h)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
 
     def __len__(self) -> int:
         if self._lib is not None:
@@ -244,6 +271,10 @@ class DenseTable(_NumpyRuleMixin):
         self._lib = lib
         if lib is not None:
             self._h = lib.pdt_create(self.size, optimizer.encode(), lr)
+            if not self._h:
+                raise ValueError(
+                    f"dense table size {self.size} out of range for the "
+                    f"native backend (must be in [1, (2^31-4)/3])")
             self.backend = "native"
         else:
             self._w = np.zeros(self.size, np.float32)
@@ -280,6 +311,15 @@ class DenseTable(_NumpyRuleMixin):
         with self._mu:
             self._w[:] = vals
 
+    def __del__(self):
+        lib, h = getattr(self, "_lib", None), getattr(self, "_h", None)
+        if lib is not None and h:
+            self._h = None
+            try:
+                lib.pdt_destroy(h)
+            except Exception:  # noqa: BLE001 — interpreter teardown
+                pass
+
 
 # ---------------------------------------------------------------------------
 # server
@@ -307,10 +347,9 @@ class PSServer:
         self._sparse[table_id].push(ids, grads)
 
     def push_sparse_delta(self, table_id: int, ids, deltas):
-        """Geo-async merge: w[id] += delta (no optimizer state)."""
-        t = self._sparse[table_id]
-        cur = t.pull(ids)
-        t.assign(ids, cur + np.asarray(deltas, np.float32))
+        """Geo-async merge: w[id] += delta (no optimizer state).  Atomic
+        per row — concurrent trainer flushes for the same id both land."""
+        self._sparse[table_id].add(ids, deltas)
 
     def pull_dense(self, table_id: int) -> np.ndarray:
         return self._dense[table_id].pull()
@@ -406,6 +445,7 @@ class PSClient:
         self.geo_steps = geo_steps
         self._geo_acc: Dict[int, Dict[int, np.ndarray]] = {}
         self._geo_count = 0
+        self._table_lr: Dict[int, float] = {}
         self._dense_home: Dict[int, int] = {}
 
     # -- plumbing -----------------------------------------------------------
@@ -430,6 +470,7 @@ class PSClient:
                 self._call(i, _rpc_create_sparse, table_id, dim, kw)
             else:
                 s.create_sparse_table(table_id, dim, **kw)
+        self._table_lr[table_id] = kw.get("lr", 0.01)
         self._geo_acc.setdefault(table_id, {})
 
     def create_dense_table(self, table_id: int, size: int, **kw):
@@ -495,11 +536,12 @@ class PSClient:
         acc = self._geo_acc.setdefault(table_id, {})
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         grads = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
-        # local SGD step becomes the delta the server adds in (geo tables
-        # carry no optimizer state server-side)
+        # local SGD step at the table's configured lr becomes the delta the
+        # server adds in (geo tables carry no optimizer state server-side)
+        lr = self._table_lr.get(table_id, 0.01)
         for i, id_ in enumerate(ids):
             d = acc.get(int(id_))
-            delta = -0.01 * grads[i]
+            delta = -lr * grads[i]
             acc[int(id_)] = delta if d is None else d + delta
         self._geo_count += 1
         if self._geo_count >= self.geo_steps:

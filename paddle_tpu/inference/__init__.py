@@ -1,0 +1,239 @@
+"""paddle.inference — the deployment Predictor API (C39).
+
+Reference parity: `paddle/fluid/inference/api/analysis_predictor.h:94`
+(AnalysisPredictor) and the `paddle.inference` Python surface
+(Config / create_predictor / get_input_handle / run / get_output_handle,
+python/paddle/inference/__init__.py).  TPU-native mapping: the optimized
+artifact is the StableHLO export written by `paddle_tpu.jit.save` — XLA is
+the 274-pass analysis/optimization pipeline, so Config's IR/memory switches
+are accepted-and-ignored (XLA always optimizes); the predictor AOT-loads
+the artifact once and every `run()` is a cached compiled call.
+
+A minimal HTTP JSON serving loop (`serve`) stands in for the reference's
+C/Go serving surface: POST {"inputs": [[...], ...]} -> {"outputs": [...]}.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "InferTensor",
+           "serve", "PlaceType"]
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"   # accepted for API parity; maps to the default device
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class Config:
+    """Predictor configuration (reference inference/api/paddle_analysis_config.h).
+
+    Graph-optimization and memory switches exist for source compatibility;
+    XLA already performs those passes, so they are recorded but change
+    nothing.  `set_model(path_prefix)` points at a `jit.save` artifact
+    (path without the .pdmodel/.pdparams/.stablehlo suffixes).
+    """
+
+    def __init__(self, model_prefix: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # reference two-arg form Config(prog_file, params_file): both point
+        # at the same jit.save prefix in this build
+        self._model_prefix = None
+        self._device = None
+        self._switches: Dict[str, object] = {}
+        if model_prefix:
+            self.set_model(model_prefix)
+
+    def set_model(self, prefix: str, params: Optional[str] = None):
+        self._model_prefix = (prefix[:-len(".pdmodel")]
+                              if prefix.endswith(".pdmodel") else prefix)
+
+    def model_dir(self) -> Optional[str]:
+        return self._model_prefix
+
+    def set_device(self, device: str):
+        self._device = device
+
+    # accepted-for-parity switches (XLA optimizes unconditionally)
+    def enable_use_gpu(self, memory_pool_mb: int = 100, device_id: int = 0):
+        self._device = PlaceType.GPU
+
+    def disable_gpu(self):
+        self._device = PlaceType.CPU
+
+    def switch_ir_optim(self, flag: bool = True):
+        self._switches["ir_optim"] = flag
+
+    def enable_memory_optim(self, flag: bool = True):
+        self._switches["memory_optim"] = flag
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._switches["cpu_threads"] = n
+
+    def disable_glog_info(self):
+        self._switches["glog"] = False
+
+    def summary(self) -> str:
+        return json.dumps({"model": self._model_prefix,
+                           "device": self._device,
+                           "switches": self._switches}, indent=2)
+
+
+class InferTensor:
+    """Input/output handle (reference paddle_infer::Tensor)."""
+
+    def __init__(self, name: str, shape: Optional[Sequence[int]] = None,
+                 dtype: str = "float32"):
+        self.name = name
+        self._shape = list(shape) if shape is not None else None
+        self._dtype = dtype
+        self._data: Optional[np.ndarray] = None
+
+    def reshape(self, shape: Sequence[int]):
+        self._shape = list(shape)
+
+    def copy_from_cpu(self, arr):
+        arr = np.asarray(arr)
+        self._data = arr
+        self._shape = list(arr.shape)
+        self._dtype = str(arr.dtype)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._data is None:
+            raise RuntimeError(f"tensor {self.name!r} holds no data yet "
+                               f"(run() the predictor first)")
+        return np.asarray(self._data)
+
+    def shape(self) -> List[int]:
+        return list(self._shape or [])
+
+    def type(self) -> str:
+        return self._dtype
+
+
+class Predictor:
+    """AOT predictor over a jit.save StableHLO artifact (AnalysisPredictor
+    analog: load -> (XLA-)optimized graph -> zero-overhead repeat runs)."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+
+        if not config.model_dir():
+            raise ValueError("Config has no model path (set_model)")
+        self._layer = jit.load(config.model_dir())
+        meta = self._layer._meta
+        if not meta.get("stablehlo"):
+            raise ValueError(
+                f"artifact {config.model_dir()!r} has no compiled graph "
+                f"(re-export with jit.save(..., input_spec=...)); "
+                f"export_error={meta.get('export_error')}")
+        spec = meta.get("input_spec") or []
+        self._inputs: Dict[str, InferTensor] = {}
+        self._input_order: List[str] = []
+        for i, s in enumerate(spec):
+            name = s.get("name") or f"input_{i}"
+            self._inputs[name] = InferTensor(name, s.get("shape"),
+                                             s.get("dtype", "float32"))
+            self._input_order.append(name)
+        self._outputs: Dict[str, InferTensor] = {}
+        self._output_order: List[str] = []
+
+    # -- reference API ------------------------------------------------------
+
+    def get_input_names(self) -> List[str]:
+        return list(self._input_order)
+
+    def get_input_handle(self, name: str) -> InferTensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        return list(self._output_order)
+
+    def get_output_handle(self, name: str) -> InferTensor:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        """Execute the compiled graph.  Either pre-fill the input handles
+        (reference style) or pass arrays positionally; returns the output
+        arrays (and fills the output handles)."""
+        if inputs is not None:
+            if len(inputs) != len(self._input_order):
+                raise ValueError(
+                    f"run() got {len(inputs)} inputs, model takes "
+                    f"{len(self._input_order)} ({self._input_order}); a "
+                    f"partial list would silently reuse stale handle data")
+            for name, arr in zip(self._input_order, inputs):
+                self._inputs[name].copy_from_cpu(arr)
+        args = []
+        for name in self._input_order:
+            h = self._inputs[name]
+            if h._data is None:
+                raise RuntimeError(f"input {name!r} not set")
+            args.append(h._data)
+        out = self._layer.forward(*args)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        arrays = [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
+                  for o in outs]
+        self._output_order = [f"output_{i}" for i in range(len(arrays))]
+        self._outputs = {}
+        for name, arr in zip(self._output_order, arrays):
+            h = InferTensor(name, arr.shape, str(arr.dtype))
+            h._data = arr
+            self._outputs[name] = h
+        return arrays
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0):
+    """Minimal HTTP JSON endpoint over a predictor.
+
+    POST / with {"inputs": [array, ...]} (nested lists; one entry per input
+    in get_input_names() order, dtype taken from the exported spec) returns
+    {"outputs": [array, ...]}.  Returns (server, thread); call
+    server.shutdown() to stop.  Stands in for the reference's serving
+    surface (inference/capi_exp, paddle serving) at demo scale.
+    """
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    lock = threading.Lock()  # predictor handles are stateful: serialize
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n) or b"{}")
+                raw = req["inputs"]
+                spec_dtypes = [predictor.get_input_handle(nm).type()
+                               for nm in predictor.get_input_names()]
+                arrays = [np.asarray(a, dtype=np.dtype(dt))
+                          for a, dt in zip(raw, spec_dtypes)]
+                with lock:
+                    outs = predictor.run(arrays)
+                body = json.dumps(
+                    {"outputs": [o.tolist() for o in outs]}).encode()
+                self.send_response(200)
+            except Exception as e:  # noqa: BLE001 — report to the client
+                body = json.dumps({"error": repr(e)}).encode()
+                self.send_response(400)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t

@@ -182,7 +182,20 @@ void pst_push(void* h, const int64_t* ids, int n, const float* grads) {
   }
 }
 
-// overwrite weights (no optimizer update) — geo-merge / load paths
+// w[id] += delta under the bucket lock (atomic geo-async merge; the
+// reference geo table merges under its table lock too)
+void pst_add(void* h, const int64_t* ids, int n, const float* deltas) {
+  auto* t = static_cast<SparseTable*>(h);
+  for (int i = 0; i < n; ++i) {
+    int s = static_cast<int>(static_cast<uint64_t>(ids[i]) % kShards);
+    std::lock_guard<std::mutex> lk(t->mu[s]);
+    auto& r = t->row(ids[i]);
+    const float* d = deltas + static_cast<size_t>(i) * t->dim;
+    for (int j = 0; j < t->dim; ++j) r[static_cast<size_t>(j)] += d[j];
+  }
+}
+
+// overwrite weights (no optimizer update) — load path
 void pst_assign(void* h, const int64_t* ids, int n, const float* vals) {
   auto* t = static_cast<SparseTable*>(h);
   for (int i = 0; i < n; ++i) {
@@ -276,7 +289,10 @@ void pst_destroy(void* h) { delete static_cast<SparseTable*>(h); }
 // ---- dense table: one contiguous parameter block with the same rules ----
 
 void* pdt_create(long long size, const char* optimizer, float lr) {
-  // a dense table is one flat parameter block: a single row of `size`
+  // a dense table is one flat parameter block: a single row of `size`.
+  // row_floats is int-indexed (adam slots reach 3*dim+2), so reject sizes
+  // the int math cannot represent instead of silently wrapping.
+  if (size <= 0 || size > ((1LL << 31) - 4) / 3) return nullptr;
   auto* t = new SparseTable();
   t->opt = parse_opt(optimizer, lr, 0.0f);
   t->dim = static_cast<int>(size);

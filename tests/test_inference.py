@@ -1,0 +1,102 @@
+"""paddle.inference Predictor + serving loop (C39).
+
+Reference behavior: inference/api/analysis_predictor.h + the paddle.inference
+Python API (Config, create_predictor, handles, run).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+
+
+@pytest.fixture(scope="module")
+def artifact(tmp_path_factory):
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = paddle.nn.Linear(8, 16)
+            self.fc2 = paddle.nn.Linear(16, 3)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    paddle.seed(0)
+    net = Net()
+    prefix = str(tmp_path_factory.mktemp("infer") / "net")
+    paddle.jit.save(net, prefix, input_spec=[
+        paddle.static.InputSpec([2, 8], "float32", name="x")])
+    x = np.random.default_rng(0).normal(size=(2, 8)).astype(np.float32)
+    want = np.asarray(net(paddle.to_tensor(x)).numpy())
+    return prefix, x, want
+
+
+class TestPredictor:
+    def test_reference_handle_api(self, artifact):
+        prefix, x, want = artifact
+        config = inference.Config(prefix)
+        config.switch_ir_optim(True)       # accepted; XLA optimizes anyway
+        config.enable_memory_optim()
+        predictor = inference.create_predictor(config)
+        assert predictor.get_input_names() == ["x"]
+        h = predictor.get_input_handle("x")
+        h.reshape([2, 8])
+        h.copy_from_cpu(x)
+        predictor.run()
+        names = predictor.get_output_names()
+        assert len(names) == 1
+        out = predictor.get_output_handle(names[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+    def test_positional_run_and_repeat(self, artifact):
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        for _ in range(3):  # repeated cached runs
+            (out,) = predictor.run([x])
+            np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+        with pytest.raises(ValueError, match="got 0 inputs"):
+            predictor.run([])
+
+    def test_config_validation(self, artifact, tmp_path):
+        with pytest.raises(ValueError, match="no model path"):
+            inference.create_predictor(inference.Config())
+        # artifact without a compiled graph (no input_spec at save)
+        net = paddle.nn.Linear(2, 2)
+        prefix = str(tmp_path / "nograph")
+        paddle.jit.save(net, prefix)
+        with pytest.raises(ValueError, match="no compiled graph"):
+            inference.create_predictor(inference.Config(prefix))
+
+    def test_pdmodel_suffix_accepted(self, artifact):
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(
+            inference.Config(prefix + ".pdmodel"))
+        (out,) = predictor.run([x])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+class TestServe:
+    def test_http_json_roundtrip(self, artifact):
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        srv, _ = inference.serve(predictor)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            body = json.dumps({"inputs": [x.tolist()]}).encode()
+            req = urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as resp:
+                payload = json.loads(resp.read())
+            np.testing.assert_allclose(np.asarray(payload["outputs"][0]),
+                                       want, rtol=1e-4, atol=1e-4)
+            # malformed request reports an error, doesn't kill the server
+            bad = urllib.request.Request(url, data=b"{}", headers={})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=30)
+            assert ei.value.code == 400
+        finally:
+            srv.shutdown()

@@ -118,7 +118,8 @@ class TestPSClientLocal:
 
     def test_geo_async_delta_merge(self):
         c = PSClient([PSServer(), PSServer()], geo_steps=3)
-        c.create_sparse_table(0, 4, optimizer="sgd", lr=0.01)
+        # non-default lr: geo deltas must use the table's configured lr
+        c.create_sparse_table(0, 4, optimizer="sgd", lr=0.1)
         ids = np.array([1, 2])
         g = np.ones((2, 4), np.float32)
         c.push_sparse(0, ids, g)  # accumulated, not yet visible
@@ -126,7 +127,36 @@ class TestPSClientLocal:
         c.push_sparse(0, ids, g)
         c.push_sparse(0, ids, g)  # 3rd push triggers the flush
         np.testing.assert_allclose(c.pull_sparse(0, ids),
-                                   np.full((2, 4), -0.03), rtol=1e-5)
+                                   np.full((2, 4), -0.3), rtol=1e-5)
+
+    def test_concurrent_geo_merges_both_land(self):
+        """push_sparse_delta is atomic per row: two trainers flushing the
+        same id concurrently must not lose either delta."""
+        import threading
+
+        srv = PSServer()
+        srv.create_sparse_table(0, 4, optimizer="sgd")
+        ids = np.array([7] * 50)
+        delta = np.full((50, 4), 0.5, np.float32)
+
+        def flush():
+            for _ in range(20):
+                srv.push_sparse_delta(0, ids, delta)
+
+        ts = [threading.Thread(target=flush) for _ in range(4)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        np.testing.assert_allclose(
+            srv.pull_sparse(0, np.array([7]))[0],
+            np.full(4, 4 * 20 * 50 * 0.5), rtol=1e-6)
+
+    def test_dense_native_size_guard(self):
+        try:
+            DenseTable(4, backend="native")
+        except RuntimeError:
+            pytest.skip("no native toolchain")
+        with pytest.raises(ValueError, match="out of range"):
+            DenseTable(2 ** 31, backend="native")
 
     def test_save_load_across_clients(self, tmp_path):
         c = PSClient([PSServer(), PSServer()])
