@@ -39,8 +39,8 @@ import numpy as np
 from ... import native
 
 __all__ = ["SparseTable", "DenseTable", "PSServer", "PSClient",
-           "SparseEmbedding", "init_server", "run_server", "init_worker",
-           "stop_worker", "is_server", "is_worker"]
+           "SparseEmbedding", "HeterTrainer", "init_server", "run_server",
+           "init_worker", "stop_worker", "is_server", "is_worker"]
 
 
 # ---------------------------------------------------------------------------
@@ -351,6 +351,9 @@ class PSServer:
         per row — concurrent trainer flushes for the same id both land."""
         self._sparse[table_id].add(ids, deltas)
 
+    def table_lr(self, table_id: int) -> float:
+        return self._sparse[table_id].lr
+
     def pull_dense(self, table_id: int) -> np.ndarray:
         return self._dense[table_id].pull()
 
@@ -404,6 +407,10 @@ def _rpc_push_sparse(table_id, ids, grads):
 
 def _rpc_push_sparse_delta(table_id, ids, deltas):
     _server().push_sparse_delta(table_id, ids, deltas)
+
+
+def _rpc_table_lr(table_id):
+    return _server().table_lr(table_id)
 
 
 def _rpc_pull_dense(table_id):
@@ -537,8 +544,17 @@ class PSClient:
         ids = np.ascontiguousarray(ids, np.int64).ravel()
         grads = np.ascontiguousarray(grads, np.float32).reshape(ids.size, -1)
         # local SGD step at the table's configured lr becomes the delta the
-        # server adds in (geo tables carry no optimizer state server-side)
-        lr = self._table_lr.get(table_id, 0.01)
+        # server adds in (geo tables carry no optimizer state server-side).
+        # A client that did not create the table (re-attached worker) asks
+        # the server once — every trainer must step at the SAME lr.
+        lr = self._table_lr.get(table_id)
+        if lr is None:
+            if self.remote:
+                lr = self._call(table_id % len(self.servers), _rpc_table_lr,
+                                table_id)
+            else:
+                lr = self.servers[0].table_lr(table_id)
+            self._table_lr[table_id] = lr
         for i, id_ in enumerate(ids):
             d = acc.get(int(id_))
             delta = -lr * grads[i]
@@ -682,3 +698,6 @@ def init_worker(server_names: List[str], geo_steps: int = 0,
 def stop_worker():
     from .. import rpc
     rpc.shutdown()
+
+
+from .heter import HeterTrainer  # noqa: E402  (C50: CPU sparse + TPU dense)

@@ -1,0 +1,79 @@
+"""Heterogeneous PS training (C50): CPU-hosted embeddings + TPU dense net.
+
+Reference parity: the heterogeneous parameter server
+(`paddle/fluid/framework/fleet/heter_context.h`, `ps/service/heter_client.cc`
+/ `heter_server.cc`, BoxPS/HeterPS `box_wrapper.cu`): CPU machines hold the
+huge sparse embedding tables, accelerator machines run the dense network,
+and a heter pipeline moves the looked-up rows between them each step.
+
+TPU-native mapping: the sparse half IS the `distributed.ps` stack (tables on
+host/PS processes, reached through PSClient); the dense half is one jitted
+XLA program on the TPU.  `HeterTrainer.step` is the pipeline:
+
+    ids -> PSClient.pull_sparse (host/CPU)              # sparse pull
+        -> jitted value_and_grad over (dense params, rows) on TPU
+        -> dense update on device (functional AdamW, donated)
+        -> PSClient.push_sparse with the row gradients  # sparse push
+
+Only the (B, dim) looked-up block ever touches the chip, so table size is
+bounded by PS host memory, not HBM — the exact capacity split the
+reference's heter PS exists for.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...optimizer.functional import AdamW
+from . import PSClient
+
+__all__ = ["HeterTrainer"]
+
+
+class HeterTrainer:
+    """Joint sparse(PS)/dense(TPU) training step.
+
+    dense_apply(dense_params, rows, batch) -> scalar loss, where `rows` is
+    the (B, dim) embedding block for the batch's ids.  Dense params update
+    on device with functional AdamW; sparse rows update server-side with
+    the table's own SGD rule.
+    """
+
+    def __init__(self, client: PSClient, table_id: int, dim: int,
+                 dense_params, dense_apply: Callable,
+                 dense_optimizer: Optional[AdamW] = None,
+                 table_kwargs: Optional[dict] = None):
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        client.create_sparse_table(table_id, dim, **(table_kwargs or {}))
+        self.dense_params = jax.tree_util.tree_map(jnp.asarray, dense_params)
+        self.opt = dense_optimizer or AdamW(learning_rate=1e-3)
+        self.opt_state = self.opt.init(self.dense_params)
+
+        def _step(params, opt_state, rows, batch):
+            def loss_of(p, r):
+                return dense_apply(p, r, batch)
+
+            loss, (gp, gr) = jax.value_and_grad(
+                loss_of, argnums=(0, 1))(params, rows)
+            new_params, new_state = self.opt.update(gp, opt_state, params)
+            return loss, new_params, new_state, gr
+
+        # no donation: with fp32 dense params the AdamW master weights
+        # alias the param buffers, and donating both would donate one
+        # buffer twice; the dense half here is small by construction
+        self._step = jax.jit(_step)
+
+    def step(self, ids, batch) -> float:
+        """One heter pipeline step; returns the loss."""
+        ids = np.asarray(ids).ravel()
+        rows = jnp.asarray(self.client.pull_sparse(self.table_id, ids))
+        loss, self.dense_params, self.opt_state, grow = self._step(
+            self.dense_params, self.opt_state, rows, batch)
+        self.client.push_sparse(self.table_id, ids, np.asarray(grow))
+        return float(loss)

@@ -100,7 +100,9 @@ class InferTensor:
         self._shape = list(shape)
 
     def copy_from_cpu(self, arr):
-        arr = np.asarray(arr)
+        # a real copy (reference paddle_infer::Tensor semantics): the caller
+        # may reuse its staging buffer for the next batch before run()
+        arr = np.array(arr, copy=True)
         self._data = arr
         self._shape = list(arr.shape)
         self._dtype = str(arr.dtype)
@@ -182,11 +184,15 @@ class Predictor:
         arrays = [np.asarray(o.numpy() if hasattr(o, "numpy") else o)
                   for o in outs]
         self._output_order = [f"output_{i}" for i in range(len(arrays))]
-        self._outputs = {}
+        # update handles IN PLACE: reference predictors let callers cache
+        # get_output_handle once and re-read it after every run()
         for name, arr in zip(self._output_order, arrays):
-            h = InferTensor(name, arr.shape, str(arr.dtype))
+            h = self._outputs.get(name)
+            if h is None:
+                h = self._outputs[name] = InferTensor(name)
             h._data = arr
-            self._outputs[name] = h
+            h._shape = list(arr.shape)
+            h._dtype = str(arr.dtype)
         return arrays
 
 
@@ -213,8 +219,13 @@ def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0):
                 n = int(self.headers.get("Content-Length", "0"))
                 req = json.loads(self.rfile.read(n) or b"{}")
                 raw = req["inputs"]
+                names = predictor.get_input_names()
+                if len(raw) != len(names):
+                    raise ValueError(
+                        f"expected {len(names)} inputs {names}, "
+                        f"got {len(raw)}")
                 spec_dtypes = [predictor.get_input_handle(nm).type()
-                               for nm in predictor.get_input_names()]
+                               for nm in names]
                 arrays = [np.asarray(a, dtype=np.dtype(dt))
                           for a, dt in zip(raw, spec_dtypes)]
                 with lock:

@@ -71,6 +71,29 @@ class TestPredictor:
         with pytest.raises(ValueError, match="no compiled graph"):
             inference.create_predictor(inference.Config(prefix))
 
+    def test_cached_output_handle_updates_across_runs(self, artifact):
+        """Reference usage: fetch handles once, loop copy_from/run/copy_to."""
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        hin = predictor.get_input_handle("x")
+        hin.copy_from_cpu(x)
+        predictor.run()
+        hout = predictor.get_output_handle(predictor.get_output_names()[0])
+        np.testing.assert_allclose(hout.copy_to_cpu(), want,
+                                   rtol=1e-5, atol=1e-5)
+        hin.copy_from_cpu(2 * x)   # new batch through the SAME handles
+        predictor.run()
+        assert not np.allclose(hout.copy_to_cpu(), want)
+
+    def test_copy_from_cpu_actually_copies(self, artifact):
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        staging = x.copy()
+        predictor.get_input_handle("x").copy_from_cpu(staging)
+        staging[:] = 999.0  # caller reuses its buffer before run()
+        (out,) = predictor.run()
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
     def test_pdmodel_suffix_accepted(self, artifact):
         prefix, x, want = artifact
         predictor = inference.create_predictor(
@@ -98,5 +121,11 @@ class TestServe:
             with pytest.raises(urllib.error.HTTPError) as ei:
                 urllib.request.urlopen(bad, timeout=30)
             assert ei.value.code == 400
+            # wrong input COUNT (extra inputs) must 400, not truncate
+            extra = urllib.request.Request(url, data=json.dumps(
+                {"inputs": [x.tolist(), x.tolist()]}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(extra, timeout=30)
+            assert ei.value.code == 400 and b"expected 1" in ei.value.read()
         finally:
             srv.shutdown()

@@ -57,6 +57,61 @@ class TestRegistryIntegrity:
             assert op.sharding in allowed, (op.name, op.sharding)
 
 
+def _sharding_sample(per_class=3):
+    """A stratified sample of ops per GSPMD class whose first sample arg is
+    an even-leading-dim float array (shardable over a 2-device axis)."""
+    rng = np.random.default_rng(0)
+    by_class = {}
+    for op in registry.all_ops():
+        if op.sharding in ("shape", "rng") or op.sample is None:
+            continue
+        args, _ = op.sample(rng)
+        if (args and isinstance(args[0], np.ndarray)
+                and args[0].dtype.kind == "f" and args[0].ndim >= 1
+                and args[0].shape[0] % 2 == 0):
+            by_class.setdefault(op.sharding, [])
+            if len(by_class[op.sharding]) < per_class:
+                by_class[op.sharding].append(op)
+    return [op for ops in by_class.values() for op in ops]
+
+
+@pytest.mark.parametrize("op", _sharding_sample(), ids=lambda o: o.name)
+class TestShardingSweep:
+    """Sharded-input correctness per GSPMD class (the sharding half of the
+    reference OpTest matrix): the op must produce the single-device result
+    when its first input arrives sharded over a mesh axis, and elementwise
+    ops must PRESERVE the sharding (no silent all-gather)."""
+
+    def test_sharded_input_matches_dense(self, op):
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        if len(jax.devices()) < 2:  # e.g. a single real TPU chip: the
+            pytest.skip("sharding sweep needs >= 2 devices")  # 1-dev axis
+            # would be fully replicated and fail the propagation assert
+        rng = np.random.default_rng(1)
+        args, kwargs = op.sample(rng)
+        fn = _resolve(op.name)
+        dense = fn(*[paddle.to_tensor(a) if isinstance(a, np.ndarray) else a
+                     for a in args], **kwargs)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+        spec = P(*(["x"] + [None] * (args[0].ndim - 1)))
+        sharded0 = paddle.to_tensor(jax.device_put(
+            args[0], NamedSharding(mesh, spec)))
+        rest = [paddle.to_tensor(a) if isinstance(a, np.ndarray) else a
+                for a in args[1:]]
+        out = fn(sharded0, *rest, **kwargs)
+        dt, ot = _first_tensor(dense), _first_tensor(out)
+        if dt is None:
+            return
+        np.testing.assert_allclose(
+            np.asarray(ot.numpy(), np.float32),
+            np.asarray(dt.numpy(), np.float32), rtol=1e-5, atol=1e-5)
+        if op.sharding == "elementwise" and ot._data.ndim == args[0].ndim:
+            assert not ot._data.sharding.is_fully_replicated, (
+                f"{op.name}: elementwise op gathered its sharded input")
+
+
 @pytest.mark.parametrize("op", registry.all_ops(), ids=lambda o: o.name)
 class TestGeneratedSweep:
     def test_dtype_sweep(self, op):

@@ -129,6 +129,18 @@ class TestPSClientLocal:
         np.testing.assert_allclose(c.pull_sparse(0, ids),
                                    np.full((2, 4), -0.3), rtol=1e-5)
 
+    def test_geo_lr_synced_for_reattached_client(self):
+        """A client that did not create the table must geo-step at the
+        table's configured lr, fetched from the server (not 0.01)."""
+        servers = [PSServer()]
+        creator = PSClient(servers, geo_steps=1)
+        creator.create_sparse_table(0, 4, optimizer="sgd", lr=0.5)
+        rejoined = PSClient(servers, geo_steps=1)  # skips create
+        ids = np.array([1])
+        rejoined.push_sparse(0, ids, np.ones((1, 4), np.float32))
+        np.testing.assert_allclose(rejoined.pull_sparse(0, ids),
+                                   np.full((1, 4), -0.5), rtol=1e-6)
+
     def test_concurrent_geo_merges_both_land(self):
         """push_sparse_delta is atomic per row: two trainers flushing the
         same id concurrently must not lose either delta."""
