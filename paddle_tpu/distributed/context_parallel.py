@@ -64,7 +64,7 @@ def _expand_gqa(q, k, v):
 
 
 def ring_attention(q, k, v, axis_name: str, causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None, mask=None):
     """Blockwise ring attention over a named mesh axis.
 
     q: local chunk (B, S/n, Hq, D); k/v: (B, S/n, Hkv, D) in the paddle
@@ -72,6 +72,12 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
     (chunk i = rank i's slice).  GQA k/v rotate at their narrow Hkv width —
     ppermute bytes are the cost ring attention must hide, so heads expand
     *after* each permute, locally.  Returns the local chunk (B, S/n, Hq, D).
+
+    mask: optional (S/n, S) LOCAL-rows x GLOBAL-cols slice of an (S, S)
+    attention mask (bool keep-mask or additive float); each ring step
+    dynamically slices the column block belonging to the k/v chunk
+    currently held, so arbitrary (document/blockwise) masks compose with
+    the ring without ever materializing (S, S) per device pair.
     """
     B, Sq, H, D = q.shape
     Hkv = k.shape[2]
@@ -102,10 +108,16 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         s = jnp.einsum("bgrqd,bgkd->bgrqk", qg, kc,
                        preferred_element_type=jnp.float32
                        ).reshape(B, H, Sq, Sk)
+        src = jax.lax.rem(idx - t + n, n)
         if causal:
-            src = jax.lax.rem(idx - t + n, n)
             cols = src * Sk + cols_local
             s = jnp.where((rows >= cols)[None, None], s, _NEG_INF)
+        if mask is not None:
+            blk = jax.lax.dynamic_slice(mask, (0, src * Sk), (Sq, Sk))
+            if mask.dtype == jnp.bool_:
+                s = jnp.where(blk[None, None], s, _NEG_INF)
+            else:
+                s = s + blk.astype(s.dtype)[None, None]
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m, m_cur)
         p = jnp.exp(s - m_new[..., None])
@@ -125,6 +137,10 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
         jax.checkpoint(step), (m0, l0, a0, kt, vt), jnp.arange(n))
 
     out = acc / jnp.maximum(l, _TINY)[..., None]
+    if mask is not None:
+        # a fully-masked row never saw a real score (m still at the -1e30
+        # floor): return 0 for it instead of a uniform average of v
+        out = jnp.where((m <= _NEG_INF / 2)[..., None], 0.0, out)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
@@ -134,7 +150,7 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
-                      scale: Optional[float] = None):
+                      scale: Optional[float] = None, mask=None):
     """DeepSpeed-Ulysses: all-to-all seq<->head swap over `axis_name`.
 
     q, k, v: local chunks (B, S/n, H, D) with the (local) head counts
@@ -142,6 +158,8 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     attention (flash kernel eligible), then the inverse all-to-all restores
     sequence sharding.  GQA k/v travel at their narrow Hkv width when
     divisible (the local attention handles the head-group expansion).
+    mask: optional full (S, S) mask (replicated — after the all-to-all the
+    whole sequence is local, so it applies directly).
     """
     from ..kernels import attention as _local_attention
 
@@ -153,7 +171,7 @@ def ulysses_attention(q, k, v, axis_name: str, causal: bool = True,
     q = a2a(q, split_axis=2, concat_axis=1)
     k = a2a(k, split_axis=2, concat_axis=1)
     v = a2a(v, split_axis=2, concat_axis=1)
-    out = _local_attention(q, k, v, causal=causal, scale=scale)
+    out = _local_attention(q, k, v, causal=causal, scale=scale, mask=mask)
     return a2a(out, split_axis=1, concat_axis=2)
 
 
@@ -183,27 +201,51 @@ def manual_axes_in_context() -> frozenset:
 def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
                                impl: str = "ring", causal: bool = True,
                                scale: Optional[float] = None,
-                               seq_axis: str = "sep"):
+                               seq_axis: str = "sep", mask=None):
     """Attention with the sequence dimension sharded over `seq_axis`.
 
     q: (B, S, Hq, D), k/v: (B, S, Hkv, D) global arrays (may already carry
     shardings; GSPMD reshards to the shard_map in_specs as needed).  Falls back
     to plain fused attention when the mesh has no sep axis.
+
+    mask: optional GLOBAL (S, S) attention mask (bool keep-mask or additive
+    float).  Under ring its rows shard with q and each ring step slices the
+    matching column block; under ulysses it applies whole after the
+    all-to-all.  Batched/per-head masks are not supported sharded — express
+    those as (S, S) document masks or run without the sep axis.
     """
+    in_manual = seq_axis in manual_axes_in_context()
+    if mask is not None and mask.ndim != 2:
+        raise ValueError(
+            f"context-parallel attention takes a 2D (S, S) mask, got shape "
+            f"{tuple(mask.shape)}; batched/per-head masks only work without "
+            f"the sep axis")
+    if (mask is not None and not in_manual
+            and mask.shape != (q.shape[1],) * 2):
+        # in the manual (already-sharded) path below the caller passes LOCAL
+        # chunks — (S/n, S) for ring — so the global square check only
+        # applies to the global wrapper
+        raise ValueError(
+            f"context-parallel attention takes a global (S, S) mask, got "
+            f"shape {tuple(mask.shape)} for S={q.shape[1]}")
+
     # inside an enclosing shard_map that already made seq_axis manual (the
-    # pipeline composes this way), run the local collective form directly
-    if seq_axis in manual_axes_in_context():
+    # pipeline composes this way), run the local collective form directly.
+    # NB here q/k/v (and any mask) are already LOCAL chunks of the caller's
+    # making: ring wants mask rows local, ulysses wants the full mask.
+    if in_manual:
         am = jax.sharding.get_abstract_mesh()
         if impl == "ulysses" and q.shape[2] % am.shape[seq_axis]:
             impl = "ring"  # same downgrade as the global wrapper below
         local = ring_attention if impl == "ring" else ulysses_attention
-        return local(q, k, v, axis_name=seq_axis, causal=causal, scale=scale)
+        return local(q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
+                     mask=mask)
 
     mesh = mesh or mesh_lib.get_global_mesh()
     if (mesh is None or seq_axis not in mesh.axis_names
             or mesh.shape[seq_axis] == 1):
         from ..kernels import attention as _local_attention
-        return _local_attention(q, k, v, causal=causal, scale=scale)
+        return _local_attention(q, k, v, causal=causal, scale=scale, mask=mask)
 
     if impl == "ulysses":
         # the LOCAL head count (after any model-axis sharding) must split
@@ -222,5 +264,11 @@ def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
     # GQA group alignment inside the local kernels
     h = "model" if tp > 1 and k.shape[2] % tp == 0 else None
     spec = P(b, seq_axis, h, None)
-    return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_vma=False)(q, k, v)
+    if mask is None:
+        return shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, check_vma=False)(q, k, v)
+    # ring: mask rows ride with q over seq_axis; ulysses sees it whole
+    mask_spec = P(seq_axis, None) if local is ring_attention else P(None, None)
+    return shard_map(lambda q_, k_, v_, m_: fn(q_, k_, v_, mask=m_),
+                     mesh=mesh, in_specs=(spec, spec, spec, mask_spec),
+                     out_specs=spec, check_vma=False)(q, k, v, mask)

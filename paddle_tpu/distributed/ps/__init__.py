@@ -335,9 +335,26 @@ class PSServer:
         self._dense: Dict[int, DenseTable] = {}
 
     def create_sparse_table(self, table_id: int, dim: int, **kw):
+        """Idempotent: a table that already exists with the same dim is
+        KEPT (a second/re-attached trainer must not wipe trained rows);
+        a dim mismatch is a config error and raises."""
+        existing = self._sparse.get(table_id)
+        if existing is not None:
+            if existing.dim != dim:
+                raise ValueError(
+                    f"sparse table {table_id} exists with dim "
+                    f"{existing.dim}, requested {dim}")
+            return
         self._sparse[table_id] = SparseTable(dim, **kw)
 
     def create_dense_table(self, table_id: int, size: int, **kw):
+        existing = self._dense.get(table_id)
+        if existing is not None:
+            if existing.size != size:
+                raise ValueError(
+                    f"dense table {table_id} exists with size "
+                    f"{existing.size}, requested {size}")
+            return
         self._dense[table_id] = DenseTable(size, **kw)
 
     def pull_sparse(self, table_id: int, ids) -> np.ndarray:

@@ -1,0 +1,126 @@
+"""SelectedRows + StringTensor — the non-dense tensor types of C1.
+
+Reference: `paddle/phi/core/selected_rows.h:27` (rows/value/height — the
+container embedding gradients use so only touched rows materialize) and
+`paddle/phi/core/string_tensor.h:33` (host-side pstring tensor used by the
+text/tokenizer path).  TPU-native mapping: SelectedRows keeps (rows, value)
+as device arrays — scattering to dense (`to_dense`) is one `segment_sum`
+and stays jittable; `merge()` compacts duplicate rows eagerly on host (its
+output size is data-dependent, which XLA cannot express — jitted code
+should use `to_dense` and keep accumulation in segment_sum form instead).
+StringTensor is host-only by design (XLA has no string dtype; the reference
+pins it to CPU for the same reason) and wraps a numpy unicode array.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["SelectedRows", "StringTensor"]
+
+
+class SelectedRows:
+    """Sparse row-set: `value[i]` is the data for logical row `rows[i]` of a
+    dense (height, *value.shape[1:]) tensor.  Duplicate row ids are allowed
+    (gradient accumulation semantics) until `merge()`."""
+
+    def __init__(self, rows, value, height: int):
+        self.rows = jnp.asarray(rows, jnp.int64)
+        self.value = value.value if isinstance(value, SelectedRows) else (
+            value._data if hasattr(value, "_data") else jnp.asarray(value))
+        if self.rows.shape[0] != self.value.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and value "
+                f"({self.value.shape[0]}) leading dims must match")
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    def set_height(self, height: int):
+        self.height = int(height)
+
+    def to_dense(self):
+        """Scatter-add to the dense (height, ...) tensor (one segment_sum —
+        jittable, duplicate rows accumulate like the reference's
+        merge+scatter)."""
+        from .tensor import Tensor
+
+        dense = jax.ops.segment_sum(self.value,
+                                    self.rows.astype(jnp.int32),
+                                    num_segments=self.height)
+        return Tensor(dense)
+
+    def merge(self) -> "SelectedRows":
+        """Combine duplicate rows (reference scatter::MergeAdd).  Eager and
+        host-synced: the merged row count is data-dependent, so this cannot
+        run under jit — use `to_dense` on traced paths."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True,
+                               size=self.rows.shape[0],
+                               fill_value=jnp.int64(self.height))
+        merged = jax.ops.segment_sum(self.value, inv.astype(jnp.int32),
+                                     num_segments=uniq.shape[0])
+        keep = np.asarray(uniq) < self.height  # drop the fill slots
+        return SelectedRows(np.asarray(uniq)[keep],
+                            np.asarray(merged)[keep], self.height)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"rows={np.asarray(self.rows).tolist()}, "
+                f"value shape={tuple(self.value.shape)})")
+
+
+class StringTensor:
+    """Host-side string tensor (reference string_tensor.h; dtype pstring).
+
+    XLA has no string dtype — the reference likewise pins StringTensor to
+    CPU and only the tokenizer ops consume it.  Backed by a numpy unicode
+    array; supports the surface the reference's faster-tokenizer path
+    needs: shape/indexing/equality, lower/upper, and numpy round-trip.
+    """
+
+    def __init__(self, data: Union[np.ndarray, Sequence]):
+        self._data = np.asarray(data, dtype=np.str_)
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def dtype(self) -> str:
+        return "pstring"
+
+    @property
+    def place(self) -> str:
+        return "cpu"  # always host, like the reference
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return StringTensor(out) if isinstance(out, np.ndarray) else str(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        other = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data == other)
+
+    # elementwise __eq__ (numpy semantics) => not hashable, like np.ndarray
+    __hash__ = None
+
+    def lower(self) -> "StringTensor":
+        return StringTensor(np.char.lower(self._data))
+
+    def upper(self) -> "StringTensor":
+        return StringTensor(np.char.upper(self._data))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data!r})"

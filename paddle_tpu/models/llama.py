@@ -238,12 +238,14 @@ def _block(c: LlamaConfig, x, lp, cos, sin, attn_mask, ffn_fn=None):
     k = _apply_rope(k, cos, sin)
     if c.context_parallel:
         from ..distributed.context_parallel import context_parallel_attention
-        if attn_mask is not None:
+        if attn_mask is not None and attn_mask.ndim != 2:
             raise ValueError(
-                "context_parallel attention is pure causal; attn_mask is not "
-                "supported — disable context_parallel or drop the mask")
+                "context_parallel attention composes with a global (S, S) "
+                "mask only (rows shard with q around the ring); batched/"
+                "per-head masks need context_parallel disabled")
         attn = context_parallel_attention(
-            q, k, v, mesh=c.mesh, impl=c.context_parallel, causal=True)
+            q, k, v, mesh=c.mesh, impl=c.context_parallel, causal=True,
+            mask=attn_mask)
     else:
         attn = kernels.attention(q, k, v, mask=attn_mask, causal=True)
     x = x + (attn.reshape(B, S, Hq * D) @ lp["wo"])

@@ -226,6 +226,82 @@ class TestContextParallel:
         out = context_parallel_attention(q, k, v, mesh=mesh, impl="ulysses", causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
+    def test_ring_with_document_mask(self):
+        """Custom (S, S) masks compose with the ring: rows shard with q,
+        columns slice per ring step (previously rejected outright)."""
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.context_parallel import context_parallel_attention
+        from paddle_tpu.kernels import attention_reference
+        mesh = mesh_lib.make_mesh(sep=4)
+        q, k, v = self._data()
+        S = q.shape[1]
+        # block-diagonal document mask: two docs of S/2 tokens
+        doc = np.arange(S) // (S // 2)
+        keep = jnp.asarray(doc[:, None] == doc[None, :])
+        ref = attention_reference(q, k, v, causal=True,
+                                  mask=keep[None, None])
+        for impl in ("ring", "ulysses"):
+            out = context_parallel_attention(q, k, v, mesh=mesh, impl=impl,
+                                             causal=True, mask=keep)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, err_msg=impl)
+        # additive float masks too
+        add = jnp.where(keep, 0.0, -1e9).astype(jnp.float32)
+        out = context_parallel_attention(q, k, v, mesh=mesh, impl="ring",
+                                         causal=True, mask=add)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+        # batched masks are still rejected with a clear error
+        with pytest.raises(ValueError, match=r"\(S, S\) mask"):
+            context_parallel_attention(q, k, v, mesh=mesh, causal=True,
+                                       mask=jnp.ones((2, 1, S, S), bool))
+
+    def test_mask_inside_enclosing_shard_map(self):
+        """The manual-axes path takes LOCAL mask chunks — (S/n, S) rows for
+        ring — and must not trip the global square-shape check."""
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed.context_parallel import context_parallel_attention
+        from paddle_tpu.kernels import attention_reference
+        mesh = mesh_lib.make_mesh(sep=4)
+        q, k, v = self._data(B=2, S=32, Hq=4, Hkv=4, D=8)
+        S = q.shape[1]
+        doc = np.arange(S) // (S // 2)
+        keep = jnp.asarray(doc[:, None] == doc[None, :])
+        spec = P(None, "sep", None, None)
+
+        def local(q_, k_, v_, m_):
+            return context_parallel_attention(q_, k_, v_, causal=True,
+                                              mask=m_)
+
+        out = shard_map(local, mesh=mesh,
+                        in_specs=(spec, spec, spec, P("sep", None)),
+                        out_specs=spec, check_vma=False)(q, k, v, keep)
+        ref = attention_reference(q, k, v, causal=True, mask=keep[None, None])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5)
+
+    @pytest.mark.slow
+    def test_ring_mask_gradients(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.context_parallel import context_parallel_attention
+        from paddle_tpu.kernels import attention_reference
+        mesh = mesh_lib.make_mesh(sep=4)
+        q, k, v = self._data(B=2, S=32, Hq=4, Hkv=4, D=8)
+        S = q.shape[1]
+        doc = np.arange(S) // (S // 4)
+        keep = jnp.asarray(doc[:, None] == doc[None, :])
+        g = jax.grad(lambda q, k, v: context_parallel_attention(
+            q, k, v, mesh=mesh, impl="ring", causal=True,
+            mask=keep).sum(), argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(lambda q, k, v: attention_reference(
+            q, k, v, causal=True,
+            mask=keep[None, None]).sum(), argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-5)
+
     def test_ring_gradients(self):
         import jax
         from paddle_tpu.distributed.context_parallel import context_parallel_attention

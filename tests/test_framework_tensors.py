@@ -1,0 +1,68 @@
+"""SelectedRows + StringTensor (C1's non-dense tensor types).
+
+Reference behavior: phi/core/selected_rows.h (rows/value/height, merge-add,
+scatter to dense) and phi/core/string_tensor.h (host-pinned pstring).
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import SelectedRows, StringTensor
+
+
+class TestSelectedRows:
+    def test_to_dense_scatters_and_accumulates(self):
+        sr = SelectedRows(rows=[1, 3, 1],
+                          value=np.array([[1., 2.], [3., 4.], [10., 20.]],
+                                         np.float32),
+                          height=5)
+        assert sr.shape == (5, 2)
+        dense = np.asarray(sr.to_dense().numpy())
+        np.testing.assert_array_equal(
+            dense, [[0, 0], [11, 22], [0, 0], [3, 4], [0, 0]])
+
+    def test_merge_combines_duplicate_rows(self):
+        sr = SelectedRows(rows=[4, 0, 4],
+                          value=np.array([[1.], [5.], [2.]], np.float32),
+                          height=6)
+        m = sr.merge()
+        order = np.argsort(np.asarray(m.rows))
+        np.testing.assert_array_equal(np.asarray(m.rows)[order], [0, 4])
+        np.testing.assert_allclose(np.asarray(m.value)[order],
+                                   [[5.], [3.]])
+        # merged form scatters to the same dense tensor
+        np.testing.assert_array_equal(np.asarray(m.to_dense().numpy()),
+                                      np.asarray(sr.to_dense().numpy()))
+
+    def test_validation_and_height(self):
+        with pytest.raises(ValueError, match="leading dims"):
+            SelectedRows(rows=[0, 1], value=np.zeros((3, 2), np.float32),
+                         height=4)
+        sr = SelectedRows(rows=[0], value=np.ones((1, 2), np.float32),
+                          height=2)
+        sr.set_height(7)
+        assert sr.shape == (7, 2)
+
+    def test_accepts_tensor_value(self):
+        v = paddle.to_tensor(np.ones((2, 3), np.float32))
+        sr = SelectedRows(rows=[0, 2], value=v, height=4)
+        assert np.asarray(sr.to_dense().numpy()).sum() == 6
+
+
+class TestStringTensor:
+    def test_basic_surface(self):
+        st = StringTensor(["Hello", "World"])
+        assert st.shape == (2,) and st.dtype == "pstring"
+        assert st.place == "cpu"  # host-pinned like the reference
+        assert st[0] == "Hello" and len(st) == 2
+        np.testing.assert_array_equal(
+            st.lower().numpy(), np.array(["hello", "world"]))
+        np.testing.assert_array_equal(st == ["Hello", "x"], [True, False])
+
+    def test_nd_and_slicing(self):
+        st = StringTensor(np.array([["a", "bb"], ["ccc", "d"]]))
+        assert st.shape == (2, 2)
+        row = st[0]
+        assert isinstance(row, StringTensor)
+        np.testing.assert_array_equal(row.numpy(), ["a", "bb"])

@@ -129,6 +129,23 @@ class TestPSClientLocal:
         np.testing.assert_allclose(c.pull_sparse(0, ids),
                                    np.full((2, 4), -0.3), rtol=1e-5)
 
+    def test_recreate_keeps_trained_rows(self):
+        """A second trainer creating the same table must NOT wipe it."""
+        srv = PSServer()
+        a = PSClient([srv])
+        a.create_sparse_table(0, 4, optimizer="sgd", lr=1.0)
+        ids = np.array([1, 2])
+        a.push_sparse(0, ids, np.ones((2, 4), np.float32))
+        before = a.pull_sparse(0, ids)
+        b = PSClient([srv])
+        b.create_sparse_table(0, 4, optimizer="sgd", lr=1.0)  # idempotent
+        np.testing.assert_array_equal(b.pull_sparse(0, ids), before)
+        with pytest.raises(ValueError, match="exists with dim"):
+            b.create_sparse_table(0, 8)
+        a.create_dense_table(1, 6)
+        with pytest.raises(ValueError, match="exists with size"):
+            a.create_dense_table(1, 12)
+
     def test_geo_lr_synced_for_reattached_client(self):
         """A client that did not create the table must geo-step at the
         table's configured lr, fetched from the server (not 0.01)."""

@@ -168,7 +168,10 @@ class TestModels:
         assert losses[-1] < losses[0]
 
     @pytest.mark.parametrize("ctor,size", [
-        (lambda: vision.resnet18(num_classes=10), 32),  # default-run smoke
+        # lenet_trains is the default-suite conv smoke; the model zoo's
+        # forward shapes all run under --full
+        pytest.param(lambda: vision.resnet18(num_classes=10), 32,
+                     marks=pytest.mark.slow),
         pytest.param(lambda: vision.resnet50(num_classes=10), 32,
                      marks=pytest.mark.slow),
         pytest.param(lambda: vision.mobilenet_v2(num_classes=10), 32,
