@@ -236,6 +236,15 @@ def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
     if in_manual:
         am = jax.sharding.get_abstract_mesh()
         if impl == "ulysses" and q.shape[2] % am.shape[seq_axis]:
+            if mask is not None:
+                # the two impls take DIFFERENT local mask layouts (ring:
+                # (S/n, S) rows; ulysses: full (S, S)) — a silent downgrade
+                # would misread the caller's mask on every rank but 0
+                raise ValueError(
+                    "ulysses head count does not divide the sep axis and a "
+                    "mask was passed; cannot downgrade to ring (its local "
+                    "mask layout differs) — pass impl='ring' with (S/n, S) "
+                    "mask rows instead")
             impl = "ring"  # same downgrade as the global wrapper below
         local = ring_attention if impl == "ring" else ulysses_attention
         return local(q, k, v, axis_name=seq_axis, causal=causal, scale=scale,
@@ -247,6 +256,12 @@ def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
         from ..kernels import attention as _local_attention
         return _local_attention(q, k, v, causal=causal, scale=scale, mask=mask)
 
+    if impl == "ulysses" and mask is not None:
+        # ring applies masks blockwise (never materializes (S, S) scores);
+        # ulysses would fall off the flash path entirely (kernels.attention
+        # takes the Pallas kernel only when mask is None) and build the full
+        # score matrix — exactly what long-context parallelism must avoid
+        impl = "ring"
     if impl == "ulysses":
         # the LOCAL head count (after any model-axis sharding) must split
         # evenly over the sep axis; otherwise ring still works

@@ -334,26 +334,38 @@ class PSServer:
         self._sparse: Dict[int, SparseTable] = {}
         self._dense: Dict[int, DenseTable] = {}
 
+    @staticmethod
+    def _check_same_config(kind, table_id, existing, requested):
+        for name, have in existing.items():
+            want = requested.get(name, have)
+            if want != have:
+                raise ValueError(
+                    f"{kind} table {table_id} exists with {name}={have!r}, "
+                    f"requested {want!r} — a re-attaching trainer must use "
+                    f"the table's original configuration")
+
     def create_sparse_table(self, table_id: int, dim: int, **kw):
-        """Idempotent: a table that already exists with the same dim is
-        KEPT (a second/re-attached trainer must not wipe trained rows);
-        a dim mismatch is a config error and raises."""
+        """Idempotent: a table that already exists with the SAME config is
+        KEPT (a second/re-attached trainer must not wipe trained rows); any
+        config mismatch — dim, optimizer, lr, initial_range — raises."""
         existing = self._sparse.get(table_id)
         if existing is not None:
-            if existing.dim != dim:
-                raise ValueError(
-                    f"sparse table {table_id} exists with dim "
-                    f"{existing.dim}, requested {dim}")
+            self._check_same_config(
+                "sparse", table_id,
+                {"dim": existing.dim, "optimizer": existing.optimizer,
+                 "lr": existing.lr, "initial_range": existing.initial_range},
+                dict(kw, dim=dim))
             return
         self._sparse[table_id] = SparseTable(dim, **kw)
 
     def create_dense_table(self, table_id: int, size: int, **kw):
         existing = self._dense.get(table_id)
         if existing is not None:
-            if existing.size != size:
-                raise ValueError(
-                    f"dense table {table_id} exists with size "
-                    f"{existing.size}, requested {size}")
+            self._check_same_config(
+                "dense", table_id,
+                {"size": existing.size, "optimizer": existing.optimizer,
+                 "lr": existing.lr},
+                dict(kw, size=size))
             return
         self._dense[table_id] = DenseTable(size, **kw)
 

@@ -113,6 +113,12 @@ class StringTensor:
         other = other._data if isinstance(other, StringTensor) else other
         return np.asarray(self._data == other)
 
+    def __ne__(self, other):
+        # explicit elementwise __ne__: Python's default (`not __eq__`) would
+        # raise on the multi-element ndarray __eq__ returns
+        other = other._data if isinstance(other, StringTensor) else other
+        return np.asarray(self._data != other)
+
     # elementwise __eq__ (numpy semantics) => not hashable, like np.ndarray
     __hash__ = None
 

@@ -59,6 +59,7 @@ class TestStringTensor:
         np.testing.assert_array_equal(
             st.lower().numpy(), np.array(["hello", "world"]))
         np.testing.assert_array_equal(st == ["Hello", "x"], [True, False])
+        np.testing.assert_array_equal(st != ["Hello", "x"], [False, True])
 
     def test_nd_and_slicing(self):
         st = StringTensor(np.array([["a", "bb"], ["ccc", "d"]]))

@@ -142,6 +142,10 @@ class TestPSClientLocal:
         np.testing.assert_array_equal(b.pull_sparse(0, ids), before)
         with pytest.raises(ValueError, match="exists with dim"):
             b.create_sparse_table(0, 8)
+        with pytest.raises(ValueError, match="exists with optimizer"):
+            b.create_sparse_table(0, 4, optimizer="adam", lr=1.0)
+        with pytest.raises(ValueError, match="exists with lr"):
+            b.create_sparse_table(0, 4, optimizer="sgd", lr=0.5)
         a.create_dense_table(1, 6)
         with pytest.raises(ValueError, match="exists with size"):
             a.create_dense_table(1, 12)
