@@ -334,10 +334,15 @@ class PSServer:
         self._sparse: Dict[int, SparseTable] = {}
         self._dense: Dict[int, DenseTable] = {}
 
+    # constructor defaults — omitted kwargs in a re-attach compare against
+    # THESE (what the same call would have created), not the existing value
+    _SPARSE_DEFAULTS = {"optimizer": "sgd", "lr": 0.01, "initial_range": 0.0}
+    _DENSE_DEFAULTS = {"optimizer": "sgd", "lr": 0.01}
+
     @staticmethod
-    def _check_same_config(kind, table_id, existing, requested):
+    def _check_same_config(kind, table_id, existing, requested, defaults):
         for name, have in existing.items():
-            want = requested.get(name, have)
+            want = requested.get(name, defaults.get(name, have))
             if want != have:
                 raise ValueError(
                     f"{kind} table {table_id} exists with {name}={have!r}, "
@@ -354,7 +359,7 @@ class PSServer:
                 "sparse", table_id,
                 {"dim": existing.dim, "optimizer": existing.optimizer,
                  "lr": existing.lr, "initial_range": existing.initial_range},
-                dict(kw, dim=dim))
+                dict(kw, dim=dim), self._SPARSE_DEFAULTS)
             return
         self._sparse[table_id] = SparseTable(dim, **kw)
 
@@ -365,7 +370,7 @@ class PSServer:
                 "dense", table_id,
                 {"size": existing.size, "optimizer": existing.optimizer,
                  "lr": existing.lr},
-                dict(kw, size=size))
+                dict(kw, size=size), self._DENSE_DEFAULTS)
             return
         self._dense[table_id] = DenseTable(size, **kw)
 

@@ -13,3 +13,5 @@ from .llama import LlamaConfig  # noqa: F401
 from . import moe_llama  # noqa: F401
 from .moe_llama import MoELlamaConfig  # noqa: F401
 from . import generation  # noqa: F401
+from . import bert  # noqa: F401
+from .bert import BertConfig  # noqa: F401

@@ -1,0 +1,201 @@
+"""BERT-family encoder: bidirectional transformer with MLM/NSP heads.
+
+Reference analog: PaddleNLP's BERT over the reference framework's
+`nn.TransformerEncoder` (python/paddle/nn/layer/transformer.py:443) — the
+encoder model family the reference serves besides decoder LMs.  TPU-native
+design mirrors models/llama.py: functional pytree params, one jittable
+forward, `lax.scan` over layer params so XLA compiles ONE block body
+(compile time stays flat in depth), learned position embeddings, post-LN
+(the BERT convention).  Padding masks run the XLA attention path (the
+Pallas flash kernel currently dispatches only for mask=None and D%128==0;
+BERT's D=64 takes XLA either way, where the mask fuses into the softmax).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import kernels
+
+__all__ = ["BertConfig", "init_params", "forward", "mlm_loss_fn",
+           "num_params"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: object = jnp.float32
+    remat: bool = False
+
+    @property
+    def hd(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "BertConfig":
+        return BertConfig(vocab_size=vocab_size, hidden_size=64,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          intermediate_size=128, max_position_embeddings=64)
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def large() -> "BertConfig":
+        return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                          num_attention_heads=16, intermediate_size=4096)
+
+
+def _normal(key, shape, std, dtype):
+    return (jax.random.normal(key, shape, jnp.float32) * std).astype(dtype)
+
+
+def init_params(config: BertConfig, key=None, seed: int = 0):
+    c = config
+    if key is None:
+        key = jax.random.PRNGKey(seed)
+    ks = jax.random.split(key, 8)
+    E, L = c.hidden_size, c.num_hidden_layers
+    std = 0.02
+
+    def blk(k, shape):
+        return _normal(k, shape, std, c.dtype)
+
+    bk = jax.random.split(ks[7], 6)
+    # stacked (L, ...) leaves: forward scans over layers
+    blocks = {
+        "wqkv": blk(bk[0], (L, E, 3 * E)),
+        "wo": blk(bk[1], (L, E, E)),
+        "w_in": blk(bk[2], (L, E, c.intermediate_size)),
+        "w_out": blk(bk[3], (L, c.intermediate_size, E)),
+        "b_qkv": jnp.zeros((L, 3 * E), c.dtype),
+        "b_o": jnp.zeros((L, E), c.dtype),
+        "b_in": jnp.zeros((L, c.intermediate_size), c.dtype),
+        "b_out": jnp.zeros((L, E), c.dtype),
+        "ln1_g": jnp.ones((L, E), jnp.float32),
+        "ln1_b": jnp.zeros((L, E), jnp.float32),
+        "ln2_g": jnp.ones((L, E), jnp.float32),
+        "ln2_b": jnp.zeros((L, E), jnp.float32),
+    }
+    return {
+        "tok_embed": blk(ks[0], (c.vocab_size, E)),
+        "pos_embed": blk(ks[1], (c.max_position_embeddings, E)),
+        "type_embed": blk(ks[2], (c.type_vocab_size, E)),
+        "embed_ln_g": jnp.ones((E,), jnp.float32),
+        "embed_ln_b": jnp.zeros((E,), jnp.float32),
+        "blocks": blocks,
+        "pooler_w": blk(ks[3], (E, E)),
+        "pooler_b": jnp.zeros((E,), c.dtype),
+        # MLM head: transform + decoder bias (weights tied to tok_embed)
+        "mlm_w": blk(ks[4], (E, E)),
+        "mlm_b": jnp.zeros((E,), c.dtype),
+        "mlm_ln_g": jnp.ones((E,), jnp.float32),
+        "mlm_ln_b": jnp.zeros((E,), jnp.float32),
+        "mlm_bias": jnp.zeros((c.vocab_size,), jnp.float32),
+        "nsp_w": blk(ks[5], (E, 2)),
+        "nsp_b": jnp.zeros((2,), c.dtype),
+    }
+
+
+def _ln(x, g, b, eps):
+    # f32 statistics regardless of activation dtype (XLA fuses this chain)
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    out = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (out * g.astype(jnp.float32) + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _block(c: BertConfig, x, lp, attn_mask):
+    B, S, E = x.shape
+    H, D = c.num_attention_heads, c.hd
+    qkv = x @ lp["wqkv"] + lp["b_qkv"]
+    q, k, v = (a.reshape(B, S, H, D) for a in jnp.split(qkv, 3, axis=-1))
+    attn = kernels.attention(q, k, v, mask=attn_mask, causal=False)
+    x = _ln(x + (attn.reshape(B, S, E) @ lp["wo"] + lp["b_o"]),
+            lp["ln1_g"], lp["ln1_b"], c.layer_norm_eps)
+    h = jax.nn.gelu(x @ lp["w_in"] + lp["b_in"], approximate=True)
+    return _ln(x + (h @ lp["w_out"] + lp["b_out"]),
+               lp["ln2_g"], lp["ln2_b"], c.layer_norm_eps)
+
+
+def forward(params, input_ids, config: BertConfig, token_type_ids=None,
+            attention_mask=None):
+    """Encoder forward.
+
+    attention_mask: (B, S) 1/0 padding mask (HF/Paddle convention) or None.
+    Returns (sequence_output (B, S, E), pooled_output (B, E)).
+    """
+    c = config
+    B, S = input_ids.shape
+    x = jnp.take(params["tok_embed"], input_ids, axis=0)
+    x = x + params["pos_embed"][None, :S]
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros_like(input_ids)
+    x = x + jnp.take(params["type_embed"], token_type_ids, axis=0)
+    x = _ln(x, params["embed_ln_g"], params["embed_ln_b"], c.layer_norm_eps)
+
+    mask = None
+    if attention_mask is not None:
+        # (B, S) keep-mask -> (B, 1, 1, S) bool over the key axis
+        mask = attention_mask.astype(bool)[:, None, None, :]
+
+    body = functools.partial(_block, c, attn_mask=mask)
+    if c.remat:
+        body = jax.checkpoint(body)
+
+    def scan_body(h, lp):
+        return body(h, lp), None
+
+    x, _ = jax.lax.scan(scan_body, x, params["blocks"])
+    pooled = jnp.tanh(x[:, 0] @ params["pooler_w"] + params["pooler_b"])
+    return x, pooled
+
+
+def mlm_loss_fn(params, batch, config: BertConfig):
+    """Masked-LM + NSP loss.  batch: dict with input_ids, labels
+    (-100 = unmasked), optional token_type_ids / attention_mask /
+    next_sentence_label."""
+    seq, pooled = forward(params, batch["input_ids"], config,
+                          batch.get("token_type_ids"),
+                          batch.get("attention_mask"))
+    h = jax.nn.gelu(seq @ params["mlm_w"] + params["mlm_b"],
+                    approximate=True)
+    h = _ln(h, params["mlm_ln_g"], params["mlm_ln_b"],
+            config.layer_norm_eps)
+    logits = (h @ params["tok_embed"].T.astype(h.dtype)
+              ).astype(jnp.float32) + params["mlm_bias"]
+    labels = batch["labels"]
+    valid = labels != -100
+    lse = jax.nn.logsumexp(logits, axis=-1)
+    picked = jnp.take_along_axis(
+        logits, jnp.maximum(labels, 0)[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, lse - picked, 0.0)
+    loss = nll.sum() / jnp.maximum(valid.sum(), 1)
+    nsp = batch.get("next_sentence_label")
+    if nsp is not None:
+        nsp_logits = (pooled @ params["nsp_w"] + params["nsp_b"]
+                      ).astype(jnp.float32)
+        nsp_lse = jax.nn.logsumexp(nsp_logits, axis=-1)
+        nsp_picked = jnp.take_along_axis(
+            nsp_logits, nsp[:, None], axis=-1)[..., 0]
+        loss = loss + jnp.mean(nsp_lse - nsp_picked)
+    return loss
+
+
+def num_params(config: BertConfig) -> int:
+    shapes = jax.eval_shape(lambda: init_params(config))
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(shapes))

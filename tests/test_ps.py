@@ -146,6 +146,10 @@ class TestPSClientLocal:
             b.create_sparse_table(0, 4, optimizer="adam", lr=1.0)
         with pytest.raises(ValueError, match="exists with lr"):
             b.create_sparse_table(0, 4, optimizer="sgd", lr=0.5)
+        # an OMITTED kwarg means the constructor default, and the existing
+        # table (lr=1.0) differs from it — must raise, not silently bind
+        with pytest.raises(ValueError, match="exists with lr"):
+            b.create_sparse_table(0, 4)
         a.create_dense_table(1, 6)
         with pytest.raises(ValueError, match="exists with size"):
             a.create_dense_table(1, 12)
