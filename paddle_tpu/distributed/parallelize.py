@@ -88,8 +88,11 @@ class ShardedTrainState:
             zshard(jax.tree.map(lambda s: s, self.param_shardings), pshape)
             if zero_stage >= 2 else None)
 
+        # length-1 spec: shard ONLY the leading (batch) dim, leaving any
+        # trailing dims unsharded — valid for batch leaves of every rank
+        # (ids (B,S), per-example labels (B,), pixel batches (B,H,W,C), ...)
         self.batch_sharding = NamedSharding(
-            mesh, mesh_lib.logical_to_spec(("batch", "seq"), mesh, self.rules))
+            mesh, mesh_lib.logical_to_spec(("batch",), mesh, self.rules))
 
         loss_fn = model.loss_fn
         opt = self.optimizer
@@ -118,11 +121,13 @@ class ShardedTrainState:
             return params, opt_state, {"loss": loss,
                                        "grad_norm": _gnorm(grads)}
 
+        # batch_sharding applies as a PYTREE PREFIX: every leaf of whatever
+        # batch structure the model's loss_fn takes (input_ids/labels/
+        # attention_mask/token_type_ids/...) shards batch-dim over dp x zero
         self.step = jax.jit(
             step_fn,
             in_shardings=(self.param_shardings, self.opt_shardings,
-                          {"input_ids": self.batch_sharding,
-                           "labels": self.batch_sharding}),
+                          self.batch_sharding),
             out_shardings=(self.param_shardings, self.opt_shardings, None),
             donate_argnums=(0, 1) if donate else ())
 
@@ -131,9 +136,7 @@ class ShardedTrainState:
 
         self.eval_step = jax.jit(
             eval_fn,
-            in_shardings=(self.param_shardings,
-                          {"input_ids": self.batch_sharding,
-                           "labels": self.batch_sharding}))
+            in_shardings=(self.param_shardings, self.batch_sharding))
 
     def shard_batch(self, batch):
         return jax.tree.map(

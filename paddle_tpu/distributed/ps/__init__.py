@@ -335,9 +335,18 @@ class PSServer:
         self._dense: Dict[int, DenseTable] = {}
 
     # constructor defaults — omitted kwargs in a re-attach compare against
-    # THESE (what the same call would have created), not the existing value
-    _SPARSE_DEFAULTS = {"optimizer": "sgd", "lr": 0.01, "initial_range": 0.0}
-    _DENSE_DEFAULTS = {"optimizer": "sgd", "lr": 0.01}
+    # THESE (what the same call would have created), not the existing
+    # value; derived from the signatures so they cannot drift
+    import inspect as _inspect
+    _SPARSE_DEFAULTS = {
+        n: p.default for n, p in
+        _inspect.signature(SparseTable.__init__).parameters.items()
+        if n in ("optimizer", "lr", "initial_range")}
+    _DENSE_DEFAULTS = {
+        n: p.default for n, p in
+        _inspect.signature(DenseTable.__init__).parameters.items()
+        if n in ("optimizer", "lr")}
+    del _inspect
 
     @staticmethod
     def _check_same_config(kind, table_id, existing, requested, defaults):

@@ -151,7 +151,12 @@ def forward(params, input_ids, config: BertConfig, token_type_ids=None,
     mask = None
     if attention_mask is not None:
         # (B, S) keep-mask -> (B, 1, 1, S) bool over the key axis
-        mask = attention_mask.astype(bool)[:, None, None, :]
+        keep = attention_mask.astype(bool)
+        # a fully-padded row would make every key -inf -> NaN softmax whose
+        # backward poisons ALL gradients; attend uniformly instead (those
+        # outputs are pad positions the loss ignores anyway)
+        keep = keep | ~keep.any(axis=-1, keepdims=True)
+        mask = keep[:, None, None, :]
 
     body = functools.partial(_block, c, attn_mask=mask)
     if c.remat:
@@ -197,5 +202,45 @@ def mlm_loss_fn(params, batch, config: BertConfig):
 
 
 def num_params(config: BertConfig) -> int:
-    shapes = jax.eval_shape(lambda: init_params(config))
-    return sum(int(x.size) for x in jax.tree_util.tree_leaves(shapes))
+    from . import llama
+    return llama.num_params(config, init_fn=init_params)
+
+
+def loss_fn(params, batch, config: BertConfig):
+    """ShardedTrainState-compatible alias (same module interface as llama)."""
+    return mlm_loss_fn(params, batch, config)
+
+
+def param_logical_axes(config: BertConfig):
+    """Logical sharding axes per parameter (llama.param_logical_axes
+    vocabulary: vocab/embed/mlp/heads/layer/None -> mesh.LOGICAL_RULES)."""
+    return {
+        "tok_embed": ("vocab", "embed"),
+        "pos_embed": (None, "embed"),
+        "type_embed": (None, "embed"),
+        "embed_ln_g": (None,),
+        "embed_ln_b": (None,),
+        "blocks": {
+            "wqkv": ("layer", "embed", "heads"),
+            "wo": ("layer", "heads", "embed"),
+            "w_in": ("layer", "embed", "mlp"),
+            "w_out": ("layer", "mlp", "embed"),
+            "b_qkv": ("layer", "heads"),
+            "b_o": ("layer", None),
+            "b_in": ("layer", "mlp"),
+            "b_out": ("layer", None),
+            "ln1_g": ("layer", None),
+            "ln1_b": ("layer", None),
+            "ln2_g": ("layer", None),
+            "ln2_b": ("layer", None),
+        },
+        "pooler_w": ("embed", "embed"),
+        "pooler_b": (None,),
+        "mlm_w": ("embed", "embed"),
+        "mlm_b": (None,),
+        "mlm_ln_g": (None,),
+        "mlm_ln_b": (None,),
+        "mlm_bias": ("vocab",),
+        "nsp_w": ("embed", None),
+        "nsp_b": (None,),
+    }
