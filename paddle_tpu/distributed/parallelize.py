@@ -88,10 +88,12 @@ class ShardedTrainState:
             zshard(jax.tree.map(lambda s: s, self.param_shardings), pshape)
             if zero_stage >= 2 else None)
 
-        # length-1 spec: shard ONLY the leading (batch) dim, leaving any
-        # trailing dims unsharded — valid for batch leaves of every rank
-        # (ids (B,S), per-example labels (B,), pixel batches (B,H,W,C), ...)
+        # rank-aware batch shardings: rank>=2 leaves (ids, masks, pixels)
+        # shard (batch, seq) so a sep-axis run receives pre-sharded
+        # sequences; rank-1 leaves (per-example labels) shard batch only
         self.batch_sharding = NamedSharding(
+            mesh, mesh_lib.logical_to_spec(("batch", "seq"), mesh, self.rules))
+        self._batch_sharding_1d = NamedSharding(
             mesh, mesh_lib.logical_to_spec(("batch",), mesh, self.rules))
 
         loss_fn = model.loss_fn
@@ -121,26 +123,63 @@ class ShardedTrainState:
             return params, opt_state, {"loss": loss,
                                        "grad_norm": _gnorm(grads)}
 
-        # batch_sharding applies as a PYTREE PREFIX: every leaf of whatever
-        # batch structure the model's loss_fn takes (input_ids/labels/
-        # attention_mask/token_type_ids/...) shards batch-dim over dp x zero
-        self.step = jax.jit(
-            step_fn,
-            in_shardings=(self.param_shardings, self.opt_shardings,
-                          self.batch_sharding),
-            out_shardings=(self.param_shardings, self.opt_shardings, None),
-            donate_argnums=(0, 1) if donate else ())
+        # the batch position's in_shardings are built per batch STRUCTURE at
+        # first call (generic over whatever leaves the model's loss_fn
+        # takes: input_ids/labels/attention_mask/...), rank-aware per leaf
+        self._step_fn, self._eval_fn = step_fn, None
+        self._donate = donate
+        self._step_cache = {}
+        self._eval_cache = {}
 
         def eval_fn(params, batch):
             return loss_fn(params, batch, config)
 
-        self.eval_step = jax.jit(
-            eval_fn,
-            in_shardings=(self.param_shardings, self.batch_sharding))
+        self._eval_fn = eval_fn
+
+    def _leaf_sharding(self, x):
+        return (self.batch_sharding if jnp.ndim(x) >= 2
+                else self._batch_sharding_1d)
+
+    def _batch_shardings(self, batch):
+        return jax.tree.map(
+            lambda x: self._leaf_sharding(x), batch)
+
+    @staticmethod
+    def _batch_key(batch):
+        # structure AND per-leaf rank (rank decides the leaf's sharding)
+        return (jax.tree_util.tree_structure(batch),
+                tuple(jnp.ndim(x) for x in jax.tree_util.tree_leaves(batch)))
+
+    def step(self, params, opt_state, batch):
+        """Jitted train step; specializes (and caches) per batch pytree
+        structure so any batch dict the model's loss_fn accepts works."""
+        key = self._batch_key(batch)
+        jitted = self._step_cache.get(key)
+        if jitted is None:
+            jitted = self._step_cache[key] = jax.jit(
+                self._step_fn,
+                in_shardings=(self.param_shardings, self.opt_shardings,
+                              self._batch_shardings(batch)),
+                out_shardings=(self.param_shardings, self.opt_shardings,
+                               None),
+                donate_argnums=(0, 1) if self._donate else ())
+        return jitted(params, opt_state, batch)
+
+    def eval_step(self, params, batch):
+        key = self._batch_key(batch)
+        jitted = self._eval_cache.get(key)
+        if jitted is None:
+            jitted = self._eval_cache[key] = jax.jit(
+                self._eval_fn,
+                in_shardings=(self.param_shardings,
+                              self._batch_shardings(batch)))
+        return jitted(params, batch)
 
     def shard_batch(self, batch):
         return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x), self.batch_sharding), batch)
+            lambda x: jax.device_put(jnp.asarray(x),
+                                     self._leaf_sharding(jnp.asarray(x))),
+            batch)
 
     # -- distributed checkpoint (reshard-on-load) ---------------------------
 

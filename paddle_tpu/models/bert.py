@@ -197,7 +197,16 @@ def mlm_loss_fn(params, batch, config: BertConfig):
         nsp_lse = jax.nn.logsumexp(nsp_logits, axis=-1)
         nsp_picked = jnp.take_along_axis(
             nsp_logits, nsp[:, None], axis=-1)[..., 0]
-        loss = loss + jnp.mean(nsp_lse - nsp_picked)
+        nsp_nll = nsp_lse - nsp_picked
+        am = batch.get("attention_mask")
+        if am is not None:
+            # fully-padded rows (ragged last batch) are not sentences:
+            # exclude them from the NSP mean, like labels=-100 does for MLM
+            row_ok = am.astype(bool).any(axis=-1)
+            loss = loss + (jnp.where(row_ok, nsp_nll, 0.0).sum()
+                           / jnp.maximum(row_ok.sum(), 1))
+        else:
+            loss = loss + jnp.mean(nsp_nll)
     return loss
 
 

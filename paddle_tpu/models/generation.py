@@ -33,10 +33,11 @@ def init_kv_cache(config, batch: int, max_len: int):
     return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
 
 
-def _cache_attention(q, ck, cv, pos):
-    """q: (B, S, Hq, D) at positions [pos, pos+S); ck/cv: (B, M, Hkv, D)
+def _cache_attention(q, ck, cv, pos, slot_mask=None):
+    """q: (B, S, Hq, D) at cache slots [pos, pos+S); ck/cv: (B, M, Hkv, D)
     full cache (already containing this step's k/v).  Causal over the cache
-    prefix: query i attends to cache slots j <= pos + i."""
+    prefix: query i attends to cache slots j <= pos + i.  slot_mask: optional
+    (B, M) keep-mask excluding left-pad slots (variable-length batches)."""
     B, S, Hq, D = q.shape
     M, Hkv = ck.shape[1], ck.shape[2]
     rep = Hq // Hkv
@@ -45,14 +46,20 @@ def _cache_attention(q, ck, cv, pos):
     s = jnp.einsum("bqhrd,bkhd->bhrqk", qg, ck.astype(jnp.float32))
     qpos = pos + jax.lax.broadcasted_iota(jnp.int32, (S, M), 0)
     kpos = jax.lax.broadcasted_iota(jnp.int32, (S, M), 1)
-    s = jnp.where((kpos <= qpos)[None, None, None], s, -1e30)
+    keep = (kpos <= qpos)[None]                       # (1, S, M)
+    if slot_mask is not None:
+        keep = keep & slot_mask[:, None, :]           # (B, S, M)
+    s = jnp.where(keep[:, None, None], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bhrqk,bkhd->bqhrd", p, cv.astype(jnp.float32))
     return o.reshape(B, S, Hq, D).astype(q.dtype)
 
 
-def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None):
-    """One block in cached mode.  ck/cv: (B, M, Hkv, D); returns updated."""
+def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None,
+                      slot_mask=None):
+    """One block in cached mode.  ck/cv: (B, M, Hkv, D); returns updated.
+    cos/sin are (S, D/2) shared or (B, S, D/2) per-row tables — llama's
+    _apply_rope handles both."""
     B, S, E = x.shape
     D, Hq, Hkv = c.hd, c.num_attention_heads, c.num_key_value_heads
     h = kernels.rms_norm(x, lp["input_norm"].astype(jnp.float32),
@@ -64,7 +71,7 @@ def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None):
     k = llama_lib._apply_rope(k, cos, sin)
     ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
     cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-    attn = _cache_attention(q, ck, cv, pos)
+    attn = _cache_attention(q, ck, cv, pos, slot_mask=slot_mask)
     x = x + (attn.reshape(B, S, Hq * D) @ lp["wo"])
     h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
                          c.rms_norm_eps).astype(x.dtype)
@@ -76,8 +83,12 @@ def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None):
     return x + ((jax.nn.silu(gate) * up) @ lp["w_down"]).astype(x.dtype), ck, cv
 
 
-def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None):
-    """Cached forward for prefill (S>=1) or decode (S=1) at offset `pos`.
+def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None,
+                       positions=None, slot_mask=None):
+    """Cached forward for prefill (S>=1) or decode (S=1) at cache offset
+    `pos`.  positions: optional (B, S) PER-ROW rope positions (left-padded
+    variable-length batches, where cache slot != sequence position);
+    slot_mask: optional (B, M) keep-mask over cache slots.
 
     Returns (logits (B, S, V) f32, updated cache)."""
     c = config
@@ -86,13 +97,17 @@ def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None):
     cos_f, sin_f = llama_lib._rope_tables(c.hd, c.max_position_embeddings,
                                           c.rope_theta)
     d2 = cos_f.shape[-1]
-    cos = jax.lax.dynamic_slice(cos_f, (pos, 0), (S, d2))
-    sin = jax.lax.dynamic_slice(sin_f, (pos, 0), (S, d2))
+    if positions is None:
+        cos = jax.lax.dynamic_slice(cos_f, (pos, 0), (S, d2))
+        sin = jax.lax.dynamic_slice(sin_f, (pos, 0), (S, d2))
+    else:
+        cos = jnp.take(cos_f, positions, axis=0)   # (B, S, d2)
+        sin = jnp.take(sin_f, positions, axis=0)
 
     def body(x, layer):
         lp, ck, cv = layer
         x, ck, cv = _block_with_cache(c, x, lp, cos, sin, ck, cv, pos,
-                                      ffn_fn=ffn_fn)
+                                      ffn_fn=ffn_fn, slot_mask=slot_mask)
         return x, (ck, cv)
 
     x, (ck_new, cv_new) = jax.lax.scan(
@@ -131,8 +146,12 @@ def sample_logits(logits, key, temperature: float = 1.0, top_k: int = 0,
     "config", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id"))
 def generate(params, input_ids, config, max_new_tokens: int,
              temperature: float = 0.0, top_k: int = 0, top_p: float = 1.0,
-             eos_id: Optional[int] = None, key: Optional[Any] = None):
-    """Prefill + scan-decode.  input_ids: (B, S) equal-length prompts.
+             eos_id: Optional[int] = None, key: Optional[Any] = None,
+             attention_mask=None):
+    """Prefill + scan-decode.  input_ids: (B, S) prompts — equal-length, or
+    LEFT-padded variable-length with `attention_mask` (B, S) marking real
+    tokens (HF/PaddleNLP convention; left padding keeps every row's last
+    real token in the final column, so one gather serves all rows).
 
     Returns (B, max_new_tokens) int32 — after eos (when given), positions
     are padded with eos.  One compiled program; cache is static-shaped
@@ -142,16 +161,37 @@ def generate(params, input_ids, config, max_new_tokens: int,
     if key is None:
         key = jax.random.PRNGKey(0)
     cache = init_kv_cache(c, B, S + max_new_tokens)
-    logits, cache = forward_with_cache(params, input_ids, c, cache, 0)
+
+    positions = slot_mask = None
+    pos_last = None
+    if attention_mask is not None:
+        am = attention_mask.astype(jnp.int32)
+        # rope position of column j = (# real tokens before j); pad columns
+        # clamp to 0 (their k/v are excluded by slot_mask anyway)
+        positions = jnp.maximum(jnp.cumsum(am, axis=1) - 1, 0)
+        pos_last = positions[:, -1]                    # (B,) last real pos
+        # static full-length slot mask: prompt slots follow the mask,
+        # generated slots (>= S) are always real
+        slot_mask = jnp.concatenate(
+            [am.astype(bool),
+             jnp.ones((B, max_new_tokens), bool)], axis=1)
+
+    logits, cache = forward_with_cache(params, input_ids, c, cache, 0,
+                                       positions=positions,
+                                       slot_mask=slot_mask)
     next_tok = sample_logits(logits[:, -1], key, temperature, top_k, top_p)
 
     def step(carry, i):
         cache, tok, done, key = carry
         key, sub = jax.random.split(key)
-        # `tok` was sampled at step i-1 and occupies sequence slot S+i-1:
-        # that's both its cache slot and its RoPE position
+        # `tok` was sampled at step i-1 and occupies CACHE slot S+i-1; its
+        # rope position is S+i-1 for dense prompts, last_real_pos+i when
+        # left-padded
+        step_positions = (None if pos_last is None
+                          else (pos_last + i)[:, None])
         logits, cache = forward_with_cache(
-            params, tok[:, None], c, cache, S + i - 1)
+            params, tok[:, None], c, cache, S + i - 1,
+            positions=step_positions, slot_mask=slot_mask)
         nxt = sample_logits(logits[:, -1], sub, temperature, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)

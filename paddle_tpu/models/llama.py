@@ -204,12 +204,15 @@ def _rope_tables(head_dim: int, max_pos: int, theta: float):
 
 
 def _apply_rope(x, cos, sin):
-    """x: (B, S, H, D); cos/sin: (S, D/2) — GPT-NeoX-style half rotation."""
+    """x: (B, S, H, D); cos/sin: (S, D/2) shared tables, or (B, S, D/2)
+    per-row tables (left-padded decode) — GPT-NeoX-style half rotation."""
     d2 = x.shape[-1] // 2
     xf = x.astype(jnp.float32)
     x1, x2 = xf[..., :d2], xf[..., d2:]
-    cos = cos[None, :, None, :]
-    sin = sin[None, :, None, :]
+    if cos.ndim == 2:
+        cos, sin = cos[None], sin[None]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
     return jnp.concatenate(
         [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
 

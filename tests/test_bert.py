@@ -173,6 +173,14 @@ class TestShardedBert:
         assert np.isfinite(float(loss))
         for g in jax.tree_util.tree_leaves(grads):
             assert np.isfinite(np.asarray(g)).all()
+        # NSP: a fully-padded row is excluded from the sentence mean —
+        # its (garbage) pooled output must not shift the loss
+        batch_nsp = dict(batch, next_sentence_label=jnp.asarray([1, 0],
+                                                                jnp.int32))
+        with_pad = float(bert.mlm_loss_fn(params, batch_nsp, cfg))
+        solo = {k: v[:1] for k, v in batch_nsp.items()}
+        only_real = float(bert.mlm_loss_fn(params, solo, cfg))
+        np.testing.assert_allclose(with_pad, only_real, rtol=1e-5)
 
 
 def test_num_params_and_configs():

@@ -87,6 +87,28 @@ class TestKVCache:
                 np.asarray(a),
                 np.asarray(self._greedy_reference(cfg, params, ids, 5)))
 
+    def test_left_padded_batch_matches_per_row(self, tiny):
+        """Variable-length prompts (left-padded + attention_mask) must
+        generate exactly what each row generates alone, unpadded."""
+        cfg, params = tiny
+        rng = np.random.default_rng(5)
+        lens = [4, 7]
+        S = max(lens)
+        ids = np.zeros((2, S), np.int32)
+        mask = np.zeros((2, S), np.int32)
+        rows = [rng.integers(0, cfg.vocab_size, n) for n in lens]
+        for b, (n, row) in enumerate(zip(lens, rows)):
+            ids[b, S - n:] = row          # LEFT padding
+            mask[b, S - n:] = 1
+        batched = np.asarray(generation.generate(
+            params, jnp.asarray(ids), cfg, max_new_tokens=5,
+            attention_mask=jnp.asarray(mask)))
+        for b, row in enumerate(rows):
+            solo = np.asarray(generation.generate(
+                params, jnp.asarray(row[None, :], jnp.int32), cfg,
+                max_new_tokens=5))
+            np.testing.assert_array_equal(batched[b], solo[0])
+
     @pytest.mark.slow
     def test_sampling_modes_run(self, tiny):
         cfg, params = tiny
