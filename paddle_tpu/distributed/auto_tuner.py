@@ -21,7 +21,8 @@ import dataclasses
 import itertools
 from typing import Callable, List, Optional
 
-__all__ = ["ChipSpec", "Plan", "CostModel", "AutoTuner", "V5E", "V5P"]
+__all__ = ["ChipSpec", "Plan", "CostModel", "AutoTuner",
+           "auto_parallelize", "V5E", "V5P"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -202,3 +203,48 @@ class AutoTuner:
                 f"{self.cost.chip.name} ({self.cost.chip.hbm_bytes/1e9:.0f} GB)"
                 " — add chips, raise zero_stage options, or shrink the batch")
         return uniq
+
+
+def auto_parallelize(config, model, n_chips: Optional[int] = None,
+                     global_batch: int = 8, seq: Optional[int] = None,
+                     chip: Optional[ChipSpec] = None, use_sep: bool = False,
+                     optimizer=None, devices=None, **tuner_kw):
+    """Plan -> Mesh -> ShardedTrainState in one call (the C32 planner loop:
+    reference Engine.prepare + planner_v2 choose a dist-attr assignment;
+    here the AutoTuner ranks mesh factorizations and the winner becomes the
+    GSPMD layout).
+
+    Returns (state, plan).  `devices` defaults to jax.devices(); `chip`
+    defaults by device kind (v5e/v5p table) falling back to V5E numbers.
+    """
+    import jax
+
+    from . import mesh as mesh_lib
+    from .parallelize import ShardedTrainState
+
+    devices = list(devices if devices is not None else jax.devices())
+    n_chips = n_chips or len(devices)
+    if len(devices) < n_chips:
+        raise ValueError(f"need {n_chips} devices, have {len(devices)}")
+    if chip is None:
+        kind = getattr(devices[0], "device_kind", "").lower()
+        chip = V5P if "v5p" in kind else V5E
+    seq = seq or getattr(config, "max_position_embeddings", 2048)
+
+    tuner = AutoTuner(chip=chip, **tuner_kw)
+    best = tuner.tune(config, n_chips, global_batch, seq,
+                      use_sep=use_sep, top_k=1)[0]
+    mesh = mesh_lib.make_mesh(devices=devices[:n_chips], **best.mesh_sizes)
+    if best.pipe > 1:
+        # thread the plan's SCHEDULE into the config too — the cost model
+        # ranked this plan at `micro_batches` microbatches with a 1F1B
+        # bubble; running at the default (= pp) would make the winner
+        # slower than plans it beat
+        import dataclasses as _dc
+        if getattr(config, "pp_microbatches", "n/a") is None:
+            config = _dc.replace(config, pp_microbatches=best.micro_batches)
+        if getattr(config, "pp_schedule", None) == "gpipe":
+            config = _dc.replace(config, pp_schedule="1f1b")
+    state = ShardedTrainState(config, model, mesh, optimizer,
+                              zero_stage=best.zero_stage)
+    return state, best

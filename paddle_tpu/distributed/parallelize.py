@@ -137,7 +137,11 @@ class ShardedTrainState:
         self._eval_fn = eval_fn
 
     def _leaf_sharding(self, x):
-        return (self.batch_sharding if jnp.ndim(x) >= 2
+        import numpy as np
+        # exactly rank-2 leaves are (batch, seq) — ids, masks, labels;
+        # other ranks ((B,) scalars-per-example, (B,H,W,C) pixels whose
+        # dim 1 is NOT a sequence) shard the batch dim only
+        return (self.batch_sharding if np.ndim(x) == 2
                 else self._batch_sharding_1d)
 
     def _batch_shardings(self, batch):
@@ -176,9 +180,11 @@ class ShardedTrainState:
         return jitted(params, batch)
 
     def shard_batch(self, batch):
+        # _leaf_sharding reads only np.ndim — no transfer; one device_put
         return jax.tree.map(
-            lambda x: jax.device_put(jnp.asarray(x),
-                                     self._leaf_sharding(jnp.asarray(x))),
+            lambda x: jax.device_put(x if hasattr(x, "ndim")
+                                     else jnp.asarray(x),
+                                     self._leaf_sharding(x)),
             batch)
 
     # -- distributed checkpoint (reshard-on-load) ---------------------------

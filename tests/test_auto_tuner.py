@@ -90,3 +90,31 @@ class TestAutoTuner:
         plans_sep = AutoTuner(V5P).tune(_llama8b(), 64, 128, 8192,
                                         use_sep=True, top_k=50)
         assert any(p.sep > 1 for p in plans_sep)
+
+
+class TestAutoParallelize:
+    def test_plan_to_state_end_to_end(self):
+        """The planner loop: tune -> mesh -> ShardedTrainState -> one step."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from paddle_tpu.distributed.auto_tuner import auto_parallelize
+        from paddle_tpu.models import llama
+
+        cfg = LlamaConfig.tiny()
+        state, plan = auto_parallelize(
+            cfg, llama, n_chips=8, global_batch=8, seq=64, chip=V5E,
+            max_tp=2)
+        sizes = plan.mesh_sizes
+        assert np.prod(list(sizes.values())) == 8
+        # make_mesh drops size-1 axes; the live axes must match the plan
+        assert dict(state.mesh.shape) == {k: v for k, v in sizes.items()
+                                          if v > 1}
+        assert state.zero_stage == plan.zero_stage
+        params, opt = state.init(jax.random.PRNGKey(0))
+        toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 65))
+        batch = state.shard_batch(llama.lm_batch_from_tokens(
+            jnp.asarray(toks, jnp.int32)))
+        params, opt, m = state.step(params, opt, batch)
+        assert np.isfinite(float(m["loss"]))
