@@ -243,7 +243,8 @@ def auto_parallelize(config, model, n_chips: Optional[int] = None,
         import dataclasses as _dc
         if getattr(config, "pp_microbatches", "n/a") is None:
             config = _dc.replace(config, pp_microbatches=best.micro_batches)
-        if getattr(config, "pp_schedule", None) == "gpipe":
+        if getattr(config, "pp_schedule", "n/a") is None:
+            # None = unset; an EXPLICIT "gpipe" is the user's pin and stays
             config = _dc.replace(config, pp_schedule="1f1b")
     state = ShardedTrainState(config, model, mesh, optimizer,
                               zero_stage=best.zero_stage)

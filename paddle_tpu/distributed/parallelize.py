@@ -88,9 +88,9 @@ class ShardedTrainState:
             zshard(jax.tree.map(lambda s: s, self.param_shardings), pshape)
             if zero_stage >= 2 else None)
 
-        # rank-aware batch shardings: rank>=2 leaves (ids, masks, pixels)
-        # shard (batch, seq) so a sep-axis run receives pre-sharded
-        # sequences; rank-1 leaves (per-example labels) shard batch only
+        # rank-aware batch shardings — see _leaf_sharding: rank-2/3 leaves
+        # treat dim 1 as the sequence (ids, masks, per-token labels) and
+        # shard (batch, seq); other ranks shard the batch dim only
         self.batch_sharding = NamedSharding(
             mesh, mesh_lib.logical_to_spec(("batch", "seq"), mesh, self.rules))
         self._batch_sharding_1d = NamedSharding(
@@ -138,10 +138,11 @@ class ShardedTrainState:
 
     def _leaf_sharding(self, x):
         import numpy as np
-        # exactly rank-2 leaves are (batch, seq) — ids, masks, labels;
-        # other ranks ((B,) scalars-per-example, (B,H,W,C) pixels whose
-        # dim 1 is NOT a sequence) shard the batch dim only
-        return (self.batch_sharding if np.ndim(x) == 2
+        # heuristic: rank-2/3 leaves treat dim 1 as the sequence ((B,S) ids
+        # and masks, (B,S,V) soft labels / per-token weights) and shard
+        # (batch, seq); rank-1 per-example scalars and rank-4+ leaves
+        # ((B,H,W,C) pixels, whose dim 1 is NOT a sequence) shard batch only
+        return (self.batch_sharding if np.ndim(x) in (2, 3)
                 else self._batch_sharding_1d)
 
     def _batch_shardings(self, batch):
@@ -180,12 +181,16 @@ class ShardedTrainState:
         return jitted(params, batch)
 
     def shard_batch(self, batch):
-        # _leaf_sharding reads only np.ndim — no transfer; one device_put
-        return jax.tree.map(
-            lambda x: jax.device_put(x if hasattr(x, "ndim")
-                                     else jnp.asarray(x),
-                                     self._leaf_sharding(x)),
-            batch)
+        # _leaf_sharding reads only np.ndim — no transfer; one device_put.
+        # Leaves may be np/jax arrays, python lists, or paddle Tensors
+        # (device_put rejects Tensor directly — unwrap the raw array).
+        def put(x):
+            raw = getattr(x, "_data", x)
+            if not hasattr(raw, "ndim"):
+                raw = jnp.asarray(raw)
+            return jax.device_put(raw, self._leaf_sharding(raw))
+
+        return jax.tree.map(put, batch)
 
     # -- distributed checkpoint (reshard-on-load) ---------------------------
 

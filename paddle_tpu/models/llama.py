@@ -66,7 +66,9 @@ class LlamaConfig:
     # pipeline schedule: "gpipe" (AD through the wavefront scan) or "1f1b"
     # (hand-scheduled one-forward-one-backward; <=P stashed microbatches —
     # reference fleet/meta_parallel/pipeline_parallel.py:387)
-    pp_schedule: str = "gpipe"
+    # None = unset (runs as gpipe; auto_parallelize may choose 1f1b);
+    # set "gpipe"/"1f1b" explicitly to pin the schedule
+    pp_schedule: Optional[str] = None
     # interleaved virtual stages per device (pipeline_parallel.py:822)
     pp_virtual_stages: int = 1
 

@@ -133,7 +133,11 @@ _export("clip", clip)
 
 def add_n(inputs, name=None):
     inputs = [_t(i) for i in (inputs if isinstance(inputs, (list, tuple)) else [inputs])]
-    return apply_op("add_n", lambda *xs: sum(xs[1:], xs[0]), *inputs)
+    # NB builtins.sum, NOT this module's reduce `sum` (which _export binds
+    # into globals and whose second positional arg is `axis`)
+    import builtins
+    return apply_op("add_n",
+                    lambda *xs: builtins.sum(xs[1:], xs[0]), *inputs)
 
 
 _export("add_n", add_n)

@@ -89,9 +89,13 @@ def multinomial(x, num_samples=1, replacement=False, name=None, key=None):
     logits = jnp.log(jnp.maximum(x._data, 1e-30))
     k = _key(key)
     if replacement:
-        out = jax.random.categorical(k, logits, axis=-1, shape=(logits.shape[:-1] and (*logits.shape[:-1], num_samples)) or (num_samples,))
-        if logits.ndim > 1:
-            out = out.reshape(*logits.shape[:-1], num_samples)
+        # jax categorical's `shape` must be broadcast-compatible with the
+        # BATCH shape as a suffix: draw (num_samples, *batch), then move
+        # the sample axis last (paddle layout)
+        batch = logits.shape[:-1]
+        out = jax.random.categorical(k, logits, axis=-1,
+                                     shape=(num_samples, *batch))
+        out = jnp.moveaxis(out, 0, -1)
     else:
         # Gumbel top-k trick for sampling without replacement.
         g = jax.random.gumbel(k, logits.shape)

@@ -348,3 +348,230 @@ _reg_many(["nn.functional.softmax", "nn.functional.log_softmax"],
 register("nn.functional.normalize", sample=_u(), tol=_LOOSE,
          sharding="reduce")
 register("nn.functional.glu", sample=_u(), tol=_LOOSE)
+
+
+# -- tranche 2: creation / manipulation / search / linalg / complex / rng ---
+# (round-3 expansion toward the reference's full ops.yaml surface)
+
+
+def _static(*args, **kw):
+    """Sampler for ops whose example inputs are fixed python values."""
+    def f(rng):
+        return args, dict(kw)
+    return f
+
+
+def _perm(shape=(4, 8)):
+    """Distinct integer-valued floats: ordering-based ops (sort/topk/...)
+    give identical results in every float dtype (no ties, exact values)."""
+    def f(rng):
+        n = int(np.prod(shape))
+        return (rng.permutation(n).reshape(shape).astype(np.float32),), {}
+    return f
+
+
+def _listof(n=2, shape=(4, 8)):
+    def f(rng):
+        return ([rng.standard_normal(shape).astype(np.float32)
+                 for _ in range(n)],), {}
+    return f
+
+
+def _one(shape=(4, 8), **kw):
+    def f(rng):
+        return (rng.standard_normal(shape).astype(np.float32),), dict(kw)
+    return f
+
+
+# creation (shape class: no dtype numerics to sweep, binding + run checked)
+register("zeros", sample=_static((3, 4)), has_vjp=False, sharding="shape")
+register("ones", sample=_static((3, 4)), has_vjp=False, sharding="shape")
+register("full", sample=_static((3, 4), 2.5), has_vjp=False, sharding="shape")
+register("eye", sample=_static(4), has_vjp=False, sharding="shape")
+register("arange", sample=_static(0, 8, 2), has_vjp=False, sharding="shape")
+register("linspace", sample=_static(0.0, 1.0, 5), has_vjp=False,
+         sharding="shape")
+register("logspace", sample=_static(0.0, 2.0, 5), has_vjp=False,
+         sharding="shape")
+register("zeros_like", sample=_u(), has_vjp=False, sharding="shape")
+register("ones_like", sample=_u(), has_vjp=False, sharding="shape")
+register("full_like", sample=_one(fill_value=1.5), has_vjp=False,
+         sharding="shape", tol=_BF)
+register("tril_indices", sample=_static(4, 4), has_vjp=False,
+         dtypes=("float32",), sharding="shape")
+register("triu_indices", sample=_static(4, 4), has_vjp=False,
+         dtypes=("float32",), sharding="shape")
+register("vander", sample=_u_pos(shape=(5,), hi=2.0), has_vjp=False,
+         tol=_LOOSE, sharding="shape")
+
+# manipulation over lists / shapes
+register("concat", sample=_listof(), tol=_BF, sharding="shape")
+register("stack", sample=_listof(), tol=_BF, sharding="shape")
+register("add_n", sample=_listof(3), tol=_BF, sharding="elementwise")
+register("broadcast_tensors", sample=_listof(2), tol=_BF,
+         sharding="broadcast", has_vjp=False)
+register("meshgrid", tol=_BF, has_vjp=False, sharding="shape",
+         sample=lambda rng: ((rng.standard_normal(3).astype(np.float32),
+                              rng.standard_normal(4).astype(np.float32)), {}))
+register("split", sample=_one(num_or_sections=2), tol=_BF, sharding="shape")
+register("chunk", sample=_one(chunks=2), tol=_BF, sharding="shape")
+register("tensor_split", sample=_one(num_or_indices=2), tol=_BF,
+         sharding="shape", has_vjp=False)
+register("unstack", sample=_u(), tol=_BF, sharding="shape")
+register("unbind", sample=_u(), tol=_BF, sharding="shape")
+register("expand", tol=_BF, sharding="broadcast",
+         sample=lambda rng: ((rng.standard_normal((1, 8)).astype(np.float32),
+                              (4, 8)), {}))
+register("expand_as", tol=_BF, sharding="broadcast",
+         sample=lambda rng: ((rng.standard_normal((1, 8)).astype(np.float32),
+                              rng.standard_normal((4, 8)).astype(np.float32)),
+                             {}))
+register("swapaxes", sample=_one(axis0=0, axis1=1), tol=_BF, sharding="shape")
+register("diff", sample=_u(), tol=_BF, sharding="shape")
+register("cast", sample=_one(dtype="float32"), has_vjp=False,
+         sharding="elementwise")
+register("clone", sample=_u(), tol=_BF, sharding="elementwise")
+register("assign", sample=_u(), tol=_BF, sharding="elementwise")
+register("numel", sample=_u(), has_vjp=False, sharding="reduce")
+register("rank", sample=_u(), has_vjp=False, sharding="reduce")
+_reg_many(["atleast_1d", "atleast_2d", "atleast_3d"], sample=_u(),
+          has_vjp=False, tol=_BF, sharding="shape")
+
+# indexing / scatter-gather
+register("gather_nd", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.integers(0, 4, (3, 1)).astype(np.int64)),
+                             {}))
+register("scatter", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((6, 8)).astype(np.float32),
+                              np.array([0, 2, 4], np.int64),
+                              rng.standard_normal((3, 8)).astype(np.float32)),
+                             {}))
+register("scatter_nd_add", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((6, 8)).astype(np.float32),
+                              rng.integers(0, 6, (3, 1)).astype(np.int64),
+                              rng.standard_normal((3, 8)).astype(np.float32)),
+                             {}))
+register("scatter_nd", sharding="gather", tol=_BF, has_vjp=False,
+         sample=lambda rng: ((rng.integers(0, 6, (3, 1)).astype(np.int64),
+                              rng.standard_normal((3, 8)).astype(np.float32),
+                              (6, 8)), {}))
+register("put_along_axis", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.integers(0, 8, (4, 8)).astype(np.int64),
+                              rng.standard_normal((4, 8)).astype(np.float32)),
+                             {"axis": 1}))
+register("index_add", sharding="gather", tol=_BF,
+         sample=lambda rng: ((rng.standard_normal((6, 8)).astype(np.float32),
+                              np.array([1, 3], np.int64), 0,
+                              rng.standard_normal((2, 8)).astype(np.float32)),
+                             {}))
+register("masked_select", sharding="gather", has_vjp=False,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              rng.integers(0, 2, (4, 8)).astype(bool)), {}))
+register("nonzero", sample=_u(), has_vjp=False, sharding="gather")
+register("bucketize", sharding="gather", has_vjp=False,
+         sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                              np.sort(rng.standard_normal(10)
+                                      ).astype(np.float32)), {}))
+register("searchsorted", sharding="gather", has_vjp=False,
+         sample=lambda rng: ((np.sort(rng.standard_normal(10)
+                                      ).astype(np.float32),
+                              rng.standard_normal((4, 8)).astype(np.float32)),
+                             {}))
+
+# search / ordering (permutation samplers: tie-free in every dtype)
+register("sort", sample=_perm(), tol=_BF, sharding="reduce")
+register("argsort", sample=_perm(), has_vjp=False, sharding="reduce")
+register("argmax", sample=_perm(), has_vjp=False, sharding="reduce")
+register("argmin", sample=_perm(), has_vjp=False, sharding="reduce")
+register("topk", has_vjp=False, sharding="reduce", tol=_BF,
+         sample=lambda rng: ((rng.permutation(32).reshape(4, 8)
+                              .astype(np.float32), 3), {}))
+register("kthvalue", has_vjp=False, sharding="reduce", tol=_BF,
+         sample=lambda rng: ((rng.permutation(32).reshape(4, 8)
+                              .astype(np.float32), 3), {}))
+register("unique", sample=_perm(shape=(8,)), has_vjp=False,
+         dtypes=("float32",), sharding="reduce")
+register("unique_consecutive", sample=_perm(shape=(8,)), has_vjp=False,
+         dtypes=("float32",), sharding="reduce")
+register("bincount", has_vjp=False, dtypes=("float32",), sharding="reduce",
+         sample=lambda rng: ((rng.integers(0, 6, (16,)).astype(np.int64),),
+                             {}))
+register("histogram", has_vjp=False, dtypes=("float32",), sharding="reduce",
+         sample=lambda rng: ((rng.standard_normal((16,)).astype(np.float32),),
+                             {"bins": 8, "min": -3, "max": 3}))
+_reg_many(["cummax", "cummin"], sample=_perm(), has_vjp=False, tol=_BF,
+          sharding="reduce")
+register("allclose", sample=_b(), has_vjp=False, sharding="reduce")
+register("equal_all", sample=_b(), has_vjp=False, sharding="reduce")
+register("mode", sample=_perm(), has_vjp=False, dtypes=("float32",),
+         sharding="reduce")
+
+# linalg tranche 2
+register("cross", tol=_LOOSE, sharding="contract",
+         sample=lambda rng: ((rng.standard_normal((4, 3)).astype(np.float32),
+                              rng.standard_normal((4, 3)).astype(np.float32)),
+                             {}))
+register("dist", sample=_b(), tol=_LOOSE, sharding="reduce")
+register("multi_dot", sample=_listof(3, shape=(4, 4)), tol=_LOOSE,
+         sharding="contract")
+register("tensordot", tol=_LOOSE, sharding="contract",
+         sample=lambda rng: ((rng.standard_normal((4, 6)).astype(np.float32),
+                              rng.standard_normal((6, 5)).astype(np.float32)),
+                             {"axes": 1}))
+register("triangular_solve", dtypes=("float32",), sharding="contract",
+         sample=lambda rng: ((np.triu(rng.standard_normal((4, 4))
+                                      + 4 * np.eye(4)).astype(np.float32),
+                              rng.standard_normal((4, 2)).astype(np.float32)),
+                             {}))
+register("cholesky_solve", dtypes=("float32",), sharding="contract",
+         sample=lambda rng: (
+             (rng.standard_normal((4, 2)).astype(np.float32),
+              np.linalg.cholesky(
+                  (lambda a: a @ a.T + 4 * np.eye(4))(
+                      rng.standard_normal((4, 4)))).astype(np.float32)), {}))
+_reg_many(["eig", "eigvals"], sample=_sq(), has_vjp=False,
+          dtypes=("float32",), sharding="contract")
+register("eigvalsh", sample=_spd(), dtypes=("float32",), has_vjp=False,
+         sharding="contract")
+register("lstsq", has_vjp=False, dtypes=("float32",), sharding="contract",
+         sample=lambda rng: ((rng.standard_normal((6, 4)).astype(np.float32),
+                              rng.standard_normal((6, 2)).astype(np.float32)),
+                             {}))
+register("lu", sample=_sq(), has_vjp=False, dtypes=("float32",),
+         sharding="contract")
+register("matrix_rank", sample=_sq(), has_vjp=False, dtypes=("float32",),
+         sharding="contract")
+register("corrcoef", sample=_u(shape=(4, 16)), has_vjp=False,
+         dtypes=("float32",), sharding="reduce")
+register("cov", sample=_u(shape=(4, 16)), tol=_LOOSE, sharding="reduce")
+
+# complex views (fp32 only: complex dtypes don't sweep)
+register("as_complex", dtypes=("float32",), sharding="elementwise",
+         sample=_u(shape=(4, 8, 2)))
+_reg_many(["real", "imag", "conj", "angle"], sample=_u(),
+          dtypes=("float32",), has_vjp=False, sharding="elementwise")
+register("complex", sample=_b(), dtypes=("float32",), has_vjp=False,
+         sharding="elementwise")
+
+# rng ops: fp32 smoke only (draws differ per call; nothing to compare)
+register("bernoulli", sample=_u01(), has_vjp=False, dtypes=("float32",),
+         sharding="rng")
+register("multinomial", has_vjp=False, dtypes=("float32",), sharding="rng",
+         sample=lambda rng: ((rng.uniform(0.1, 1, (4, 8)).astype(np.float32),),
+                             {"num_samples": 2, "replacement": True}))
+register("poisson", sample=_u_pos(hi=4.0), has_vjp=False,
+         dtypes=("float32",), sharding="rng")
+register("rand", sample=_static((3, 4)), has_vjp=False,
+         dtypes=("float32",), sharding="rng")
+register("randn", sample=_static((3, 4)), has_vjp=False,
+         dtypes=("float32",), sharding="rng")
+register("randint", sample=_static(0, 10, (3, 4)), has_vjp=False,
+         dtypes=("float32",), sharding="rng")
+register("randperm", sample=_static(8), has_vjp=False,
+         dtypes=("float32",), sharding="rng")
+register("uniform", sample=_static((3, 4)), has_vjp=False,
+         dtypes=("float32",), sharding="rng")
+register("standard_normal", sample=_static((3, 4)), has_vjp=False,
+         dtypes=("float32",), sharding="rng")

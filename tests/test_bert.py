@@ -153,6 +153,12 @@ class TestShardedBert:
                 rng.integers(0, 2, 8), jnp.int32)})
         params, opt, m = st.step(params, opt, full)
         assert np.isfinite(float(m["loss"]))
+        # shard_batch accepts paddle Tensor leaves too (unwraps raw arrays)
+        import paddle_tpu as paddle
+        tb = st.shard_batch({"input_ids": paddle.to_tensor(ids),
+                             "labels": paddle.to_tensor(labels)})
+        params, opt, m = st.step(params, opt, tb)
+        assert np.isfinite(float(m["loss"]))
 
     def test_fully_padded_row_keeps_grads_finite(self):
         """An all-zero attention_mask row must not poison gradients with

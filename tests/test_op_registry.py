@@ -21,13 +21,19 @@ def _resolve(name):
     return obj
 
 
+def _cast_arg(a, dtype):
+    if isinstance(a, np.ndarray):
+        return paddle.to_tensor(a.astype(dtype) if a.dtype.kind == "f"
+                                else a)
+    if isinstance(a, list):  # list-of-arrays ops (concat/stack/add_n/...)
+        return [_cast_arg(x, dtype) for x in a]
+    return a
+
+
 def _run(op, dtype, rng):
     fn = _resolve(op.name)
     args, kwargs = op.sample(rng)
-    targs = [paddle.to_tensor(a.astype(dtype)
-                              if a.dtype.kind == "f" else a)
-             if isinstance(a, np.ndarray) else a
-             for a in args]
+    targs = [_cast_arg(a, dtype) for a in args]
     out = fn(*targs, **kwargs)
     return out, targs
 
@@ -148,18 +154,27 @@ class TestGeneratedSweep:
             rng = np.random.default_rng(1)
             fn = _resolve(op.name)
             args, kwargs = op.sample(rng)
-            targs = [paddle.to_tensor(a.astype(dtype), stop_gradient=False)
-                     if isinstance(a, np.ndarray) and a.dtype.kind == "f"
-                     else (paddle.to_tensor(a) if isinstance(a, np.ndarray)
-                           else a)
-                     for a in args]
+
+            def diff_arg(a):
+                if isinstance(a, np.ndarray):
+                    if a.dtype.kind == "f":
+                        return paddle.to_tensor(a.astype(dtype),
+                                                stop_gradient=False)
+                    return paddle.to_tensor(a)
+                if isinstance(a, list):  # concat/stack/add_n/multi_dot
+                    return [diff_arg(x) for x in a]
+                return a
+
+            targs = [diff_arg(a) for a in args]
             out = fn(*targs, **kwargs)
             out_t = _first_tensor(out)
             if out_t is None or out_t.stop_gradient:
                 continue
             loss = paddle.sum(out_t * out_t)
             loss.backward()
-            for t in targs:
+            flat = [t for a in targs
+                    for t in (a if isinstance(a, list) else [a])]
+            for t in flat:
                 if hasattr(t, "grad") and t.grad is not None:
                     g = np.asarray(t.grad.numpy(), dtype=np.float64)
                     assert np.isfinite(g).all(), f"{op.name}[{dtype}] grad"
