@@ -41,6 +41,7 @@ from . import rpc  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from .fleet_executor import FleetExecutor, TaskNode  # noqa: F401
 from . import ps  # noqa: F401
+from .spawn import spawn  # noqa: F401
 from . import moe  # noqa: F401
 from .moe import (  # noqa: F401
     MoEConfig, MoELayer, NaiveGate, SwitchGate, GShardGate,

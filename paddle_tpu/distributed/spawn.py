@@ -1,0 +1,84 @@
+"""paddle.distributed.spawn — in-Python multi-process launch (C33 sibling).
+
+Reference parity: `python/paddle/distributed/spawn.py:454` (spawn(func,
+args, nprocs) starting one training process per device with the env
+contract set).  TPU-native mapping: each child gets the launcher's env
+contract (PADDLE_TRAINER_ID / RANK / JAX_COORDINATOR_ADDRESS ...) so
+`init_parallel_env` / `rpc.init_rpc` work unchanged; processes use the
+`spawn` start method (fork is unsafe once a JAX backend is live).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+from typing import Optional, Sequence
+
+__all__ = ["spawn"]
+
+
+def _child(func, args, rank, nprocs, coord, env_extra):
+    os.environ.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nprocs),
+        "PADDLE_LOCAL_RANK": str(rank),
+        "PADDLE_MASTER": coord,
+        "RANK": str(rank), "LOCAL_RANK": str(rank),
+        "WORLD_SIZE": str(nprocs),
+        "JAX_COORDINATOR_ADDRESS": coord,
+        "JAX_NUM_PROCESSES": str(nprocs),
+        "JAX_PROCESS_ID": str(rank),
+        **(env_extra or {}),
+    })
+    func(*args)
+
+
+def spawn(func, args: Sequence = (), nprocs: int = 1,
+          join: bool = True, env: Optional[dict] = None,
+          timeout: Optional[float] = None):
+    """Run `func(*args)` in `nprocs` fresh processes with the distributed
+    env contract set (reference spawn.py).  Returns the context (list of
+    processes) when join=False; raises if any child exits nonzero."""
+    with socket.socket() as s:
+        # NB probe-then-release has an inherent TOCTOU window before rank0
+        # binds the coordinator (same as the launcher's _free_port and the
+        # reference's get_free_port); SO_REUSEADDR at least lets rank0
+        # rebind through TIME_WAIT remnants
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+    ctx = mp.get_context("spawn")
+    procs = [ctx.Process(target=_child,
+                         args=(func, tuple(args), rank, nprocs, coord, env),
+                         daemon=False)
+             for rank in range(nprocs)]
+    for p in procs:
+        p.start()
+    if not join:
+        return procs
+    # watch loop (launcher-style): the FIRST failure dooms the gang — a
+    # sequential join(None) would hang forever on a sibling blocked waiting
+    # for the dead worker (e.g. rank1 waiting on rank0's coordinator)
+    import time
+    deadline = None if timeout is None else time.time() + timeout
+    failed = []
+    while True:
+        codes = [p.exitcode for p in procs]
+        failed = [(r, rc) for r, rc in enumerate(codes)
+                  if rc not in (None, 0)]
+        if failed or all(rc == 0 for rc in codes):
+            break
+        if deadline is not None and time.time() > deadline:
+            failed = [(r, "timeout") for r, rc in enumerate(codes)
+                      if rc is None]
+            break
+        time.sleep(0.1)
+    if failed:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        for p in procs:
+            p.join(5)
+        raise RuntimeError(f"spawn: workers failed: {failed}")
+    return procs

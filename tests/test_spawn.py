@@ -1,0 +1,61 @@
+"""distributed.spawn (reference spawn.py): env contract + failure modes.
+
+Slow-marked: multiprocessing-spawn children re-import the pytest main
+module (conftest -> jax), ~15s per gang on this box."""
+
+import os
+
+import pytest
+
+
+def _worker_writes_env(path):
+    with open(os.path.join(path, f"rank{os.environ['PADDLE_TRAINER_ID']}"),
+              "w") as f:
+        f.write(f"{os.environ['RANK']}/{os.environ['WORLD_SIZE']}"
+                f"/{os.environ['PADDLE_MASTER']}")
+
+
+def _worker_fails():
+    raise SystemExit(3)
+
+
+@pytest.mark.slow
+def test_spawn_sets_env_contract(tmp_path):
+    from paddle_tpu.distributed import spawn
+
+    spawn(_worker_writes_env, args=(str(tmp_path),), nprocs=2, timeout=120)
+    got = sorted(p.name for p in tmp_path.iterdir())
+    assert got == ["rank0", "rank1"]
+    r0 = (tmp_path / "rank0").read_text().split("/")
+    r1 = (tmp_path / "rank1").read_text().split("/")
+    assert r0[0] == "0" and r1[0] == "1"
+    assert r0[1] == r1[1] == "2"
+    assert r0[2] == r1[2]  # same coordinator address
+
+
+@pytest.mark.slow
+def test_spawn_surfaces_worker_failure():
+    from paddle_tpu.distributed import spawn
+
+    with pytest.raises(RuntimeError, match="workers failed"):
+        spawn(_worker_fails, nprocs=2, timeout=120)
+
+
+def _worker_rank_dependent():
+    import os, time
+    if os.environ["RANK"] == "0":
+        raise SystemExit(3)
+    time.sleep(60)  # sibling blocked (e.g. waiting on rank0's coordinator)
+
+
+@pytest.mark.slow
+def test_spawn_first_failure_dooms_hung_gang():
+    """A dead worker must fail the gang promptly even with timeout=None —
+    a sequential join(None) would hang on the blocked sibling."""
+    import time
+    from paddle_tpu.distributed import spawn
+
+    t0 = time.time()
+    with pytest.raises(RuntimeError, match="workers failed"):
+        spawn(_worker_rank_dependent, nprocs=2, timeout=None)
+    assert time.time() - t0 < 45  # nowhere near the sibling's 60s sleep
