@@ -1,0 +1,39 @@
+"""Export a model, load it with the inference Predictor, serve over HTTP.
+
+Usage:  python examples/serve_model.py
+"""
+import json
+import tempfile
+import urllib.request
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+
+
+def main():
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                               paddle.nn.Linear(32, 3))
+    prefix = tempfile.mkdtemp() + "/model"
+    paddle.jit.save(net, prefix, input_spec=[
+        paddle.static.InputSpec([1, 8], "float32", name="x")])
+    print("exported StableHLO artifact:", prefix + ".stablehlo")
+
+    predictor = inference.create_predictor(inference.Config(prefix))
+    srv, _ = inference.serve(predictor)
+    url = f"http://127.0.0.1:{srv.server_address[1]}/"
+    x = np.random.default_rng(0).normal(size=(1, 8)).astype(np.float32)
+    req = urllib.request.Request(
+        url, data=json.dumps({"inputs": [x.tolist()]}).encode())
+    out = json.loads(urllib.request.urlopen(req, timeout=30).read())
+    print("served prediction:", out["outputs"][0])
+    np.testing.assert_allclose(out["outputs"][0],
+                               np.asarray(net(paddle.to_tensor(x)).numpy()),
+                               rtol=1e-4, atol=1e-4)
+    srv.shutdown()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

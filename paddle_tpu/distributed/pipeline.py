@@ -48,6 +48,34 @@ def _stage_param_specs(stacked_params, axis: str):
     return jax.tree.map(lambda _: P(axis), stacked_params)
 
 
+def _half(dt):
+    return dt in (jnp.bfloat16, jnp.float16)
+
+
+def _cpu_needs_f32(mesh, axis, manual_axes, *trees):
+    """XLA's CPU SPMD partitioner check-fails (hlo_instruction.cc 'Invalid
+    binary instruction opcode copy') on half-precision programs under
+    partial-manual shard_map when another mesh axis stays auto — the tp x pp
+    composition (AD/GSPMD-inserted bf16 collectives trigger it, so no local
+    wrapper can help).  The virtual CPU mesh is a correctness harness:
+    upcast the whole pipelined computation to f32 there.  Real TPU runs the
+    native dtype.  `trees`: every input whose leaves could be half (a half
+    PARAM with f32 activations still produces half AD collectives)."""
+    if jax.default_backend() != "cpu":
+        return False
+    if not any(_half(l.dtype) for t in trees for l in jax.tree.leaves(t)
+               if hasattr(l, "dtype")):
+        return False
+    return any(mesh.shape[a] > 1 for a in mesh.axis_names
+               if a != axis and a not in manual_axes)
+
+
+def _upcast_tree(tree):
+    return jax.tree.map(
+        lambda a: a.astype(jnp.float32)
+        if hasattr(a, "dtype") and _half(a.dtype) else a, tree)
+
+
 def num_stages(mesh: Mesh, axis: str = "pipe") -> int:
     return mesh.shape.get(axis, 1) if axis in mesh.axis_names else 1
 
@@ -142,6 +170,12 @@ def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
     if V > 1 and M < pp:
         raise ValueError(
             f"interleaved schedule needs n_micro >= stages ({M} < {pp})")
+    out_dtype = x.dtype
+    if _cpu_needs_f32(mesh, axis, manual_axes, x, stacked_params,
+                      list(extras)):
+        x = x.astype(jnp.float32)
+        stacked_params = _upcast_tree(stacked_params)
+        extras = tuple(_upcast_tree(list(extras)))
     mb = jnp.reshape(x, (M, B // M) + x.shape[1:])
     # (V, P, Lc, ...): chunk c = v*P + s holds consecutive layers, owned by
     # stage c % P — the interleaved round-robin assignment
@@ -223,7 +257,7 @@ def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
         out_specs=(mb_spec, P()), check_vma=True,
         axis_names=frozenset({axis}) | frozenset(manual_axes),
     )(chunked, mb, *extras)
-    out = jnp.reshape(out, x.shape)
+    out = jnp.reshape(out, x.shape).astype(out_dtype)
     return (out, aux) if returns_aux else out
 
 
@@ -286,6 +320,15 @@ def pipeline_1f1b(block_fn, head_fn, stacked_params, head_params, x, labels,
         raise ValueError(f"batch {B} not divisible by {M} microbatches")
     if M < pp:
         raise ValueError(f"1F1B needs n_micro >= stages ({M} < {pp})")
+    in_dtypes = None
+    if _cpu_needs_f32(mesh, axis, manual_axes, x, stacked_params,
+                      head_params, list(extras)):
+        in_dtypes = (jax.tree.map(lambda a: a.dtype, stacked_params),
+                     jax.tree.map(lambda a: a.dtype, head_params), x.dtype)
+        x = x.astype(jnp.float32)
+        stacked_params = _upcast_tree(stacked_params)
+        head_params = _upcast_tree(head_params)
+        extras = tuple(_upcast_tree(list(extras)))
     mb = jnp.reshape(x, (M, B // M) + x.shape[1:])
     lb = jnp.reshape(labels, (M, B // M) + labels.shape[1:])
     T = 2 * (M + pp - 1)
@@ -434,4 +477,9 @@ def pipeline_1f1b(block_fn, head_fn, stacked_params, head_params, x, labels,
         axis_names=frozenset({axis}) | frozenset(manual_axes),
     )(stacked_params, head_params, mb, lb, *extras)
     dx = jnp.reshape(dxb, x.shape)
+    if in_dtypes is not None:  # cpu-f32 harness: grads back to param dtypes
+        sp_dt, hp_dt, x_dt = in_dtypes
+        gsp = jax.tree.map(lambda g, d: g.astype(d), gsp, sp_dt)
+        ghp = jax.tree.map(lambda g, d: g.astype(d), ghp, hp_dt)
+        dx = dx.astype(x_dt)
     return loss + aux_scale * aux, aux, (gsp, ghp, dx)

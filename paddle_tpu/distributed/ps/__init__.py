@@ -397,6 +397,10 @@ class PSServer:
     def table_lr(self, table_id: int) -> float:
         return self._sparse[table_id].lr
 
+    def sparse_table_size(self, table_id: int) -> int:
+        """Rows materialized so far (lazy init: only touched ids exist)."""
+        return len(self._sparse[table_id])
+
     def pull_dense(self, table_id: int) -> np.ndarray:
         return self._dense[table_id].pull()
 

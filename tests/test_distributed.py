@@ -507,6 +507,36 @@ class TestPipelineSchedules:
         batch = llama.lm_batch_from_tokens(jnp.asarray(toks, jnp.int32))
         return dataclasses, llama, cfg, params, batch
 
+    def test_bf16_tp_pp_mesh_trains(self):
+        """Regression: bf16 + tensor x pipeline mesh hard-crashed XLA's CPU
+        SPMD partitioner ('Invalid binary instruction opcode copy'); the
+        pipeline now runs its CPU harness in f32 (TPU keeps bf16)."""
+        import dataclasses
+        from paddle_tpu.models import llama
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        from paddle_tpu.optimizer.functional import AdamW
+
+        cfg = LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=32,
+            pp_microbatches=2, dtype=jnp.bfloat16)
+        mesh = mesh_lib.make_mesh(model=2, pipe=2)
+        losses = {}
+        for sched in (None, "1f1b"):
+            c = dataclasses.replace(cfg, pp_schedule=sched)
+            st = ShardedTrainState(c, llama, mesh, AdamW(learning_rate=1e-3),
+                                   zero_stage=1)
+            params, opt = st.init(jax.random.PRNGKey(0))
+            toks = np.random.default_rng(0).integers(0, 256, (4, 33))
+            batch = st.shard_batch(llama.lm_batch_from_tokens(
+                jnp.asarray(toks, jnp.int32)))
+            params, opt, m = st.step(params, opt, batch)
+            losses[sched] = float(m["loss"])
+            assert np.isfinite(losses[sched])
+        np.testing.assert_allclose(losses[None], losses["1f1b"], rtol=5e-2)
+
     @pytest.mark.slow
     def test_interleaved_forward_parity(self):
         dc, llama, cfg, params, batch = self._llama_setup()

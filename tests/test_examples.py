@@ -1,0 +1,26 @@
+"""The examples/ scripts stay runnable (subprocess smoke, slow-marked:
+each child re-imports jax)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("script,args", [
+    ("train_llama.py", ["--steps", "3", "--batch", "4", "--seq", "32"]),
+    ("recsys_ps.py", []),
+    ("serve_model.py", []),
+])
+def test_example_runs(script, args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=REPO)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert out.returncode == 0, f"{script}:\n{out.stdout}\n{out.stderr}"
