@@ -31,13 +31,15 @@ from __future__ import annotations
 import json
 import os
 import re
+import shutil
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["save_state", "load_state", "latest_step", "step_dir"]
+__all__ = ["save_state", "load_state", "latest_step", "step_dir",
+           "CheckpointManager"]
 
 _VERSION = 1
 
@@ -245,3 +247,110 @@ def latest_step(root: str) -> Optional[int]:
             s = int(m.group(1))
             best = s if best is None or s > best else best
     return best
+
+
+class CheckpointManager:
+    """Async training-loop checkpointing with retention (reference
+    auto-checkpoint, base/incubate/checkpoint/auto_checkpoint.py, and the
+    orbax CheckpointManager pattern the TPU ecosystem standardizes on).
+
+    `save(step, tree)` snapshots device arrays to host immediately (one
+    blocking device->host copy) and writes the checkpoint on a background
+    thread, so the train loop never stalls on disk IO; `keep` bounds how
+    many complete checkpoints remain (oldest pruned after each successful
+    save).  `wait()` drains pending writes (call before exit);
+    `restore(template)` loads the newest complete step.
+    """
+
+    def __init__(self, root: str, keep: int = 3, save_interval: int = 1):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self.root = root
+        self.keep = keep
+        self.save_interval = max(1, save_interval)
+        self._executor = None
+        self._pending = []
+        self._errors: list = []
+
+    # -- plumbing -----------------------------------------------------------
+
+    def _pool(self):
+        if self._executor is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt")
+        return self._executor
+
+    def _write(self, step: int, host_tree, extra):
+        try:
+            save_state(step_dir(self.root, step), host_tree, extra=extra)
+            self._prune()
+        except BaseException as e:  # noqa: BLE001 — surfaced on next call
+            self._errors.append(e)
+
+    def _prune(self):
+        steps = sorted(
+            int(m.group(1)) for m in (
+                re.fullmatch(r"step_(\d+)", n)
+                for n in os.listdir(self.root)) if m)
+        complete = [s for s in steps if os.path.exists(
+            os.path.join(step_dir(self.root, s), "metadata.json"))]
+        for s in complete[:-self.keep] if len(complete) > self.keep else []:
+            shutil.rmtree(step_dir(self.root, s), ignore_errors=True)
+
+    def _raise_pending_errors(self):
+        if self._errors:
+            e = self._errors[0]
+            self._errors = []
+            raise RuntimeError("async checkpoint write failed") from e
+
+    # -- API ----------------------------------------------------------------
+
+    def should_save(self, step: int) -> bool:
+        return step % self.save_interval == 0
+
+    def save(self, step: int, tree, extra: Optional[Dict] = None,
+             block: bool = False):
+        """Snapshot now, write in the background (or inline when block).
+
+        Multi-process runs save SYNCHRONOUSLY through save_state directly:
+        its cross-host barrier must run on the main thread (a background
+        barrier would interleave with training collectives and deadlock),
+        and per-shard addressable writes must not be gathered.  Async mode
+        is the single-process path: the device->host copy happens up front
+        so the caller may donate/overwrite device buffers immediately
+        (the gathered-to-host layout is fine there — load_state reshards
+        on load)."""
+        self._raise_pending_errors()
+        if jax.process_count() > 1 or block:
+            if jax.process_count() > 1:
+                save_state(step_dir(self.root, step), tree, extra=extra)
+                self._prune()
+            else:
+                self._write(step, jax.tree.map(np.asarray, tree), extra)
+            self._raise_pending_errors()
+            return None
+        host_tree = jax.tree.map(lambda a: np.asarray(a), tree)
+        fut = self._pool().submit(self._write, step, host_tree, extra)
+        self._pending.append(fut)
+        self._pending = [f for f in self._pending if not f.done()]
+        return fut
+
+    def wait(self):
+        """Drain pending writes; re-raise the first background failure."""
+        for f in list(self._pending):
+            f.result()
+        self._pending = []
+        self._raise_pending_errors()
+
+    def latest_step(self) -> Optional[int]:
+        return latest_step(self.root)
+
+    def restore(self, template, shardings=None, step: Optional[int] = None):
+        """Load `step` (default: newest complete) into template's structure."""
+        self.wait()
+        s = self.latest_step() if step is None else step
+        if s is None:
+            raise FileNotFoundError(f"no complete checkpoint under {self.root}")
+        return load_state(step_dir(self.root, s), template,
+                          shardings=shardings), s

@@ -170,9 +170,10 @@ def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
     if V > 1 and M < pp:
         raise ValueError(
             f"interleaved schedule needs n_micro >= stages ({M} < {pp})")
+    cpu_f32 = _cpu_needs_f32(mesh, axis, manual_axes, x, stacked_params,
+                             list(extras))
     out_dtype = x.dtype
-    if _cpu_needs_f32(mesh, axis, manual_axes, x, stacked_params,
-                      list(extras)):
+    if cpu_f32:
         x = x.astype(jnp.float32)
         stacked_params = _upcast_tree(stacked_params)
         extras = tuple(_upcast_tree(list(extras)))
@@ -257,7 +258,9 @@ def pipeline_apply(block_fn, stacked_params, x, extras: Sequence[Any] = (),
         out_specs=(mb_spec, P()), check_vma=True,
         axis_names=frozenset({axis}) | frozenset(manual_axes),
     )(chunked, mb, *extras)
-    out = jnp.reshape(out, x.shape).astype(out_dtype)
+    out = jnp.reshape(out, x.shape)
+    if cpu_f32:  # only undo the harness upcast — a block_fn that widens
+        out = out.astype(out_dtype)  # its output dtype keeps doing so
     return (out, aux) if returns_aux else out
 
 

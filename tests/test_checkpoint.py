@@ -117,3 +117,48 @@ class TestTrainStateResume:
             p4, o4, m = st4.step(p4, o4, batch4)
             got.append(float(m["loss"]))
         np.testing.assert_allclose(got, base, rtol=2e-5, atol=2e-6)
+
+
+class TestCheckpointManager:
+    """Async auto-checkpointing with retention (reference auto_checkpoint)."""
+
+    def _tree(self, v):
+        import jax.numpy as jnp
+        return {"w": jnp.full((4, 4), float(v)), "b": jnp.full((4,), float(v))}
+
+    def test_async_save_restore_and_retention(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path), keep=2, save_interval=2)
+        assert mgr.should_save(4) and not mgr.should_save(3)
+        for step in (2, 4, 6, 8):
+            mgr.save(step, self._tree(step), extra={"step": step})
+        mgr.wait()
+        # retention: only the newest 2 complete checkpoints remain
+        import os
+        kept = sorted(n for n in os.listdir(tmp_path) if n.startswith("step_"))
+        assert kept == ["step_00000006", "step_00000008"], kept
+        (restored, s) = mgr.restore(self._tree(0))
+        assert s == 8
+        np.testing.assert_array_equal(np.asarray(restored["w"]), 8.0)
+        # snapshot semantics: the device buffers may be DELETED (donation)
+        # right after save() returns — the write must not touch them
+        t = self._tree(10)
+        mgr.save(10, t)
+        for leaf in t.values():
+            leaf.delete()
+        mgr.wait()
+        (restored, s) = mgr.restore(self._tree(0))
+        assert s == 10
+        np.testing.assert_array_equal(np.asarray(restored["w"]), 10.0)
+
+    def test_blocking_save_and_error_surface(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+        mgr = CheckpointManager(str(tmp_path / "ck"), keep=1)
+        mgr.save(1, self._tree(1), block=True)
+        assert mgr.latest_step() == 1
+        with pytest.raises(ValueError, match="keep must be"):
+            CheckpointManager(str(tmp_path), keep=0)
+        with pytest.raises(FileNotFoundError, match="no complete checkpoint"):
+            CheckpointManager(str(tmp_path / "empty")).restore(self._tree(0))
