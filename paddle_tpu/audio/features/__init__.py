@@ -3,8 +3,12 @@ layers (reference python/paddle/audio/features/layers.py:24,106,206,309).
 
 TPU-native: framing is one strided gather, the DFT is a (win, 2F) matmul
 against a precomputed real/imag basis, mel and DCT are further matmuls —
-the whole feature stack is MXU-friendly and jit/grad-safe (no FFT runtime
-dependency on the device).
+the whole feature stack is MXU-friendly and jit/grad-safe with NO complex
+intermediates (some TPU plugins have no complex-dtype support at all, so
+a `signal.stft`-based path would not differentiate on-device).  Parity with
+`paddle_tpu.signal.stft` — which the reference's features call
+(python/paddle/audio/features/layers.py:100) — is pinned by
+tests/test_fft_signal.py::TestSpectrogramStftParity.
 """
 
 from __future__ import annotations
@@ -58,15 +62,20 @@ class Spectrogram(Layer):
         self._sin = jnp.asarray(np.sin(ang).T, jnp.float32)
 
     def forward(self, x):
-        raw = x._data if isinstance(x, Tensor) else jnp.asarray(x)
-        frames = _frame(raw.astype(jnp.float32), self.n_fft, self.hop,
-                        self.center, self.pad_mode)
-        frames = frames * self._win
-        re = frames @ self._cos
-        im = frames @ self._sin
-        mag2 = re * re + im * im            # (..., n_frames, F)
-        spec = jnp.power(jnp.maximum(mag2, 1e-30), self.power / 2.0)
-        return to_tensor(jnp.swapaxes(spec, -1, -2))  # (..., F, n_frames)
+        from ...tensor import apply_op
+        xt = x if isinstance(x, Tensor) else to_tensor(x)
+
+        def f(raw):
+            frames = _frame(raw.astype(jnp.float32), self.n_fft, self.hop,
+                            self.center, self.pad_mode)
+            frames = frames * self._win
+            re = frames @ self._cos
+            im = frames @ self._sin
+            mag2 = re * re + im * im        # (..., n_frames, F)
+            spec = jnp.power(jnp.maximum(mag2, 1e-30), self.power / 2.0)
+            return jnp.swapaxes(spec, -1, -2)  # (..., F, n_frames)
+
+        return apply_op("spectrogram", f, xt)
 
 
 class MelSpectrogram(Layer):
@@ -87,9 +96,10 @@ class MelSpectrogram(Layer):
             htk=htk, norm=norm, dtype=dtype)
 
     def forward(self, x):
-        spec = self._spectrogram(x)._data
-        mel = self.fbank_matrix._data @ spec
-        return to_tensor(mel)
+        from ...tensor import apply_op
+        spec = self._spectrogram(x)
+        return apply_op("mel_fbank",
+                        lambda s: self.fbank_matrix._data @ s, spec)
 
 
 class LogMelSpectrogram(Layer):
@@ -117,7 +127,10 @@ class MFCC(Layer):
         self.dct_matrix = create_dct(n_mfcc=n_mfcc, n_mels=n_mels)
 
     def forward(self, x):
-        logmel = self._log_melspectrogram(x)._data
-        out = jnp.swapaxes(
-            jnp.swapaxes(logmel, -1, -2) @ self.dct_matrix._data, -1, -2)
-        return to_tensor(out)
+        from ...tensor import apply_op
+        logmel = self._log_melspectrogram(x)
+        return apply_op(
+            "mfcc_dct",
+            lambda lm: jnp.swapaxes(
+                jnp.swapaxes(lm, -1, -2) @ self.dct_matrix._data, -1, -2),
+            logmel)

@@ -99,14 +99,19 @@ def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
 def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
                 top_db: Optional[float] = 80.0):
     """10*log10(S/ref) with clamp (functional.py:259)."""
-    x = _raw(spect)
-    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
-    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
-    if top_db is not None:
-        if top_db < 0:
-            raise ValueError("top_db must be non-negative")
-        log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
-    return to_tensor(log_spec)
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+    from ...tensor import Tensor, apply_op
+    xt = spect if isinstance(spect, Tensor) else to_tensor(_raw(spect))
+
+    def f(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, jnp.max(log_spec) - top_db)
+        return log_spec
+
+    return apply_op("power_to_db", f, xt)
 
 
 def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",

@@ -1,0 +1,329 @@
+"""paddle.fft — discrete Fourier transform family.
+
+Reference: `python/paddle/fft.py:1` (fft/ifft/rfft/irfft/hfft/ihfft, the 2-D and
+N-D variants, fftfreq/rfftfreq, fftshift/ifftshift).  The reference lowers to
+cuFFT/onemkl kernels (`paddle/phi/kernels/gpu/fft_kernel.cu`); here every
+transform is `jnp.fft.*`, which XLA lowers to its native FFT HLO — jit-able,
+differentiable (FFT is linear, so VJPs are again FFTs), and shardable over
+batch axes.  All transforms dispatch through `apply_op` so the eager tape, AMP
+black-listing (complex inputs are never downcast) and NaN checks apply.
+
+Semantics parity notes:
+  * real input to c2c transforms is promoted to complex (reference behavior);
+  * `norm` in {"backward", "ortho", "forward"} as in the reference;
+  * `n`/`s` crop or zero-pad the transformed axes before the transform
+    (reference `_resize_fft_input`) — jnp.fft does this natively;
+  * hfft/ihfft follow the reference's "hermitian symmetry in the signal
+    domain" convention: hfft(x, n) == irfft(conj(x), n) scaled for forward.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+# Some experimental TPU plugins (the axon PJRT plugin) have NO complex-dtype
+# support: even `astype(complex64)` is UNIMPLEMENTED.  Mainline XLA:TPU
+# decomposes complex into real pairs, so jnp.fft is the right primary path;
+# on complex-less backends we fall back to a host numpy compute for concrete
+# (eager) inputs — complex results then live on the CPU device, real results
+# return to the default device.  Tracing/differentiating FFTs on such a
+# backend raises a typed error instead of an opaque UNIMPLEMENTED.
+_COMPLEX_OK: Optional[bool] = None
+
+
+def _complex_ok() -> bool:
+    global _COMPLEX_OK
+    if _COMPLEX_OK is None:
+        try:
+            from jax._src import xla_bridge as _xb
+            # The axon plugin must be detected by NAME: merely attempting a
+            # complex op poisons its stream (later real ops fail too).
+            if "axon" in _xb.get_backend().platform_version.lower():
+                _COMPLEX_OK = False
+            else:
+                np.asarray(jnp.zeros((1,), jnp.complex64) + jnp.asarray(1j))
+                _COMPLEX_OK = True
+        except Exception:
+            _COMPLEX_OK = False
+    return _COMPLEX_OK
+
+
+def _device_fft(name, jfn, nfn, *arrays):
+    """jfn(*arrays) on complex-capable backends; host nfn fallback otherwise.
+
+    jfn/nfn are closures over the static params (n/s/axes/norm); nfn receives
+    numpy arrays and may use np.fft freely.
+    """
+    if _complex_ok():
+        return jfn(*arrays)
+    if any(isinstance(a, jax.core.Tracer) for a in arrays):
+        raise RuntimeError(
+            f"paddle_tpu.fft.{name}: the active backend "
+            f"('{jax.default_backend()}') has no complex-dtype support, so "
+            "FFT ops cannot be traced (jit/grad) on it. Run the op outside "
+            "jit (the eager host fallback applies automatically), or move "
+            "the computation to the CPU backend.")
+    host = []
+    for a in arrays:
+        h = np.asarray(a)
+        if h.dtype not in (np.float32, np.float64, np.complex64,
+                           np.complex128):
+            # bf16/f16 (np.fft can't take them) and ints promote to f32
+            h = h.astype(np.float32)
+        host.append(h)
+    res = nfn(*host)
+    # single precision result unless the input was genuinely double
+    single = host[0].dtype not in (np.float64, np.complex128)
+    if np.iscomplexobj(res):
+        res = res.astype(np.complex64 if single else np.complex128)
+        return jax.device_put(res, jax.devices("cpu")[0])
+    res = np.asarray(res).astype(np.float32 if single else np.float64)
+    return jnp.asarray(res)
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _apply_fft_op(name, f, *tensors):
+    """apply_op, but on complex-less backends take the no-grad path.
+
+    apply_op builds the VJP eagerly (jax.vjp traces `f`) whenever an input
+    requires grad, which would hit the tracer error above on a plain forward
+    pass.  FFT grads are impossible on such a backend anyway, so detach —
+    with a one-time warning so training code doesn't silently lose the tape.
+    """
+    from . import framework
+    if not _complex_ok() and framework.is_grad_enabled() and any(
+            isinstance(t, Tensor) and not t.stop_gradient for t in tensors):
+        import warnings
+        warnings.warn(
+            f"paddle_tpu.fft.{name}: backend "
+            f"'{jax.default_backend()}' has no complex support; the op ran "
+            "via the host fallback and its output is DETACHED from the "
+            "autograd tape (no gradient will flow). Run on the CPU backend "
+            "for differentiable FFTs.", RuntimeWarning, stacklevel=3)
+        with framework.no_grad_guard():
+            return apply_op(name, f, *tensors)
+    return apply_op(name, f, *tensors)
+
+
+def _check_norm(norm):
+    if norm not in _NORMS:
+        raise ValueError(
+            f"Unexpected norm: {norm!r}. Norm should be 'forward', 'backward' "
+            "or 'ortho'")
+    return norm
+
+
+def _check_n(n):
+    if n is not None and (not isinstance(n, int) or n <= 0):
+        raise ValueError(f"Invalid FFT argument n({n}), it should be a "
+                         "positive integer.")
+
+
+def _check_s_axes(x, s, axes):
+    if s is not None:
+        if any((not isinstance(v, int)) or v <= 0 for v in s):
+            raise ValueError(f"Invalid FFT argument s({s}), it should be a "
+                             "sequence of positive integers.")
+    if axes is not None:
+        nd = x.ndim
+        for a in axes:
+            if not isinstance(a, int) or not -nd <= a < nd:
+                raise ValueError(
+                    f"Invalid FFT axis {a} for input with {nd} dimensions")
+        norm_axes = [a % nd for a in axes]
+        if len(set(norm_axes)) != len(norm_axes):
+            raise ValueError(f"FFT axes {axes} contains duplicates")
+    if s is not None and axes is not None and len(s) != len(axes):
+        raise ValueError(
+            f"Length of s ({len(s)}) must match length of axes ({len(axes)})")
+
+
+def _promote_c(a):
+    if not jnp.issubdtype(a.dtype, jnp.complexfloating):
+        a = a.astype(jnp.complex128 if a.dtype == jnp.float64
+                     else jnp.complex64)
+    return a
+
+
+def _fft_1d(name, x, n, axis, norm, promote=False):
+    x = _t(x)
+    _check_n(n)
+    _check_norm(norm)
+    jfn, nfn = getattr(jnp.fft, name), getattr(np.fft, name)
+
+    def f(a):
+        return _device_fft(
+            name,
+            lambda v: jfn(_promote_c(v) if promote else v,
+                          n=n, axis=axis, norm=norm),
+            lambda h: nfn(h, n=n, axis=axis, norm=norm), a)
+
+    return _apply_fft_op(name, f, x)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_1d("fft", x, n, axis, norm, promote=True)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_1d("ifft", x, n, axis, norm, promote=True)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_1d("rfft", x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_1d("irfft", x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_1d("hfft", x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft_1d("ihfft", x, n, axis, norm)
+
+
+def _fft_nd(name, x, s, axes, norm, promote=False):
+    x = _t(x)
+    _check_s_axes(x, s, axes)
+    _check_norm(norm)
+    jfn, nfn = getattr(jnp.fft, name), getattr(np.fft, name)
+
+    def f(a):
+        return _device_fft(
+            name,
+            lambda v: jfn(_promote_c(v) if promote else v,
+                          s=s, axes=axes, norm=norm),
+            lambda h: nfn(h, s=s, axes=axes, norm=norm), a)
+
+    return _apply_fft_op(name, f, x)
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_nd("fftn", x, s, axes, norm, promote=True)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_nd("ifftn", x, s, axes, norm, promote=True)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_nd("rfftn", x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fft_nd("irfftn", x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    # reference fftn_c2r: hermitian-input N-D transform = irfftn of conj with
+    # inverted normalization; the last transformed axis carries the symmetry
+    x = _t(x)
+    _check_s_axes(x, s, axes)
+    _check_norm(norm)
+    inv = {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+
+    def f(a):
+        return _device_fft(
+            "hfftn",
+            lambda v: jnp.fft.irfftn(jnp.conj(v), s=s, axes=axes, norm=inv),
+            lambda h: np.fft.irfftn(np.conj(h), s=s, axes=axes, norm=inv), a)
+
+    return _apply_fft_op("hfftn", f, x)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    x = _t(x)
+    _check_s_axes(x, s, axes)
+    _check_norm(norm)
+    inv = {"backward": "forward", "forward": "backward", "ortho": "ortho"}[norm]
+
+    def f(a):
+        return _device_fft(
+            "ihfftn",
+            lambda v: jnp.conj(jnp.fft.rfftn(v, s=s, axes=axes, norm=inv)),
+            lambda h: np.conj(np.fft.rfftn(h, s=s, axes=axes, norm=inv)), a)
+
+    return _apply_fft_op("ihfftn", f, x)
+
+
+def _as_2d(s, axes, fn):
+    if axes is not None and len(axes) != 2:
+        raise ValueError(f"Invalid FFT axes {axes}: 2-D transforms take "
+                         "exactly two axes")
+    if s is not None and len(s) != 2:
+        raise ValueError(f"Invalid FFT argument s ({s}): 2-D transforms take "
+                         "a length-2 shape")
+    return fn
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _as_2d(s, axes, fftn)(x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _as_2d(s, axes, ifftn)(x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _as_2d(s, axes, rfftn)(x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _as_2d(s, axes, irfftn)(x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _as_2d(s, axes, hfftn)(x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _as_2d(s, axes, ihfftn)(x, s, axes, norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    if n <= 0:
+        raise ValueError(f"Invalid FFT argument n({n}), it should be a "
+                         "positive integer.")
+    from .framework import get_default_dtype, to_jax_dtype
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    return to_tensor(jnp.fft.fftfreq(n, d).astype(dt))
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    if n <= 0:
+        raise ValueError(f"Invalid FFT argument n({n}), it should be a "
+                         "positive integer.")
+    from .framework import get_default_dtype, to_jax_dtype
+    dt = to_jax_dtype(dtype or get_default_dtype())
+    return to_tensor(jnp.fft.rfftfreq(n, d).astype(dt))
+
+
+def fftshift(x, axes=None, name=None):
+    x = _t(x)
+    return apply_op("fftshift", lambda a: jnp.fft.fftshift(a, axes=axes), x)
+
+
+def ifftshift(x, axes=None, name=None):
+    x = _t(x)
+    return apply_op("ifftshift", lambda a: jnp.fft.ifftshift(a, axes=axes), x)

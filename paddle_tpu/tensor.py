@@ -338,6 +338,20 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
             # Paddle's to_tensor keeps python floats at default dtype.
             if isinstance(data, (numbers.Number, list, tuple)):
                 arr = arr.astype(to_jax_dtype(framework.get_default_dtype()))
+        if np.iscomplexobj(arr):
+            # python complex scalars/lists follow the default dtype's
+            # complex analog (paddle parity: float32 -> complex64)
+            if (arr.dtype == np.complex128 and dtype is None
+                    and isinstance(data, (numbers.Number, list, tuple))
+                    and framework.get_default_dtype() == "float32"):
+                arr = arr.astype(np.complex64)
+            # complex-less backends (axon TPU plugin): host the array on CPU
+            from .fft import _complex_ok
+            if not _complex_ok():
+                raw = jax.device_put(arr, jax.devices("cpu")[0])
+                if dtype is not None:
+                    raw = raw.astype(to_jax_dtype(convert_dtype(dtype)))
+                return Tensor(raw, stop_gradient=stop_gradient)
         raw = jnp.asarray(arr)
     if dtype is not None:
         raw = raw.astype(to_jax_dtype(convert_dtype(dtype)))
@@ -379,7 +393,10 @@ def _amp_cast_args(name, tensors_raw):
             return tensors_raw
     out = []
     for r in tensors_raw:
-        if r is not None and _is_float(r) and r.dtype != cast and r.dtype != jnp.float64:
+        # complex inputs (fft/signal ops) never cast: bf16 has no complex analog
+        if (r is not None and _is_float(r)
+                and not jnp.issubdtype(r.dtype, jnp.complexfloating)
+                and r.dtype != cast and r.dtype != jnp.float64):
             out.append(r.astype(cast))
         else:
             out.append(r)

@@ -1,0 +1,284 @@
+"""paddle.fft + paddle.signal parity vs numpy/scipy conventions.
+
+Mirrors the reference's test/fft/test_fft.py strategy: every transform is
+checked against np.fft on shared inputs across norms/axes/n, plus analytic
+gradient checks (FFT is linear: d/dx sum|F x|^2 must be finite and match
+numeric grad) and stft/istft round-trip reconstruction.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+RNG = np.random.default_rng(7)
+
+
+def _x(shape=(3, 16)):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+def _cx(shape=(3, 16)):
+    return (RNG.standard_normal(shape) +
+            1j * RNG.standard_normal(shape)).astype(np.complex64)
+
+
+class TestFft1D:
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft_matches_numpy(self, norm):
+        x = _cx()
+        got = paddle.fft.fft(paddle.to_tensor(x), norm=norm).numpy()
+        np.testing.assert_allclose(got, np.fft.fft(x, norm=norm), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_fft_real_input_promotes(self):
+        x = _x()
+        got = paddle.fft.fft(paddle.to_tensor(x))
+        assert got.numpy().dtype == np.complex64
+        np.testing.assert_allclose(got.numpy(), np.fft.fft(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    @pytest.mark.parametrize("n", [8, 16, 24])
+    def test_fft_n_crops_or_pads(self, n):
+        x = _cx()
+        got = paddle.fft.fft(paddle.to_tensor(x), n=n).numpy()
+        np.testing.assert_allclose(got, np.fft.fft(x, n=n), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_ifft_roundtrip(self):
+        x = _cx()
+        got = paddle.fft.ifft(paddle.fft.fft(paddle.to_tensor(x))).numpy()
+        np.testing.assert_allclose(got, x, rtol=1e-4, atol=1e-4)
+
+    @pytest.mark.parametrize("fn,nfn", [("rfft", np.fft.rfft),
+                                        ("ihfft", lambda a: np.conj(
+                                            np.fft.rfft(a)) / a.shape[-1])])
+    def test_r2c(self, fn, nfn):
+        x = _x()
+        got = getattr(paddle.fft, fn)(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(got, nfn(x), rtol=1e-4, atol=1e-4)
+
+    def test_irfft_hfft(self):
+        x = _cx((3, 9))
+        np.testing.assert_allclose(
+            paddle.fft.irfft(paddle.to_tensor(x)).numpy(),
+            np.fft.irfft(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.fft.hfft(paddle.to_tensor(x)).numpy(),
+            np.fft.hfft(x), rtol=1e-3, atol=1e-3)
+
+    def test_axis_argument(self):
+        x = _cx((4, 8))
+        np.testing.assert_allclose(
+            paddle.fft.fft(paddle.to_tensor(x), axis=0).numpy(),
+            np.fft.fft(x, axis=0), rtol=1e-4, atol=1e-4)
+
+    def test_bad_norm_raises(self):
+        with pytest.raises(ValueError, match="orm"):
+            paddle.fft.fft(paddle.to_tensor(_x()), norm="bogus")
+
+    def test_bad_n_raises(self):
+        with pytest.raises(ValueError, match="positive"):
+            paddle.fft.fft(paddle.to_tensor(_x()), n=-3)
+
+
+class TestFftND:
+    @pytest.mark.parametrize("norm", ["backward", "ortho", "forward"])
+    def test_fft2(self, norm):
+        x = _cx((2, 8, 8))
+        np.testing.assert_allclose(
+            paddle.fft.fft2(paddle.to_tensor(x), norm=norm).numpy(),
+            np.fft.fft2(x, norm=norm), rtol=1e-4, atol=1e-4)
+
+    def test_fftn_axes_s(self):
+        x = _cx((2, 8, 6))
+        np.testing.assert_allclose(
+            paddle.fft.fftn(paddle.to_tensor(x), s=(4, 8),
+                            axes=(1, 2)).numpy(),
+            np.fft.fftn(x, s=(4, 8), axes=(1, 2)), rtol=1e-4, atol=1e-4)
+
+    def test_rfftn_irfftn_roundtrip(self):
+        x = _x((2, 8, 8))
+        spec = paddle.fft.rfftn(paddle.to_tensor(x))
+        back = paddle.fft.irfftn(spec).numpy()
+        np.testing.assert_allclose(back, x, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(spec.numpy(), np.fft.rfftn(x),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_hfftn_matches_hfft_on_last_axis(self):
+        x = _cx((3, 9))
+        np.testing.assert_allclose(
+            paddle.fft.hfftn(paddle.to_tensor(x), axes=(-1,)).numpy(),
+            np.fft.hfft(x), rtol=1e-3, atol=1e-3)
+
+    def test_hfftn_all_axes_is_fft_then_hfft(self):
+        x = _cx((3, 9))
+        want = np.fft.hfft(np.fft.fft(x, axis=0), axis=-1)
+        np.testing.assert_allclose(
+            paddle.fft.hfftn(paddle.to_tensor(x)).numpy(), want,
+            rtol=1e-3, atol=1e-3)
+
+    def test_duplicate_axes_raise(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            paddle.fft.fftn(paddle.to_tensor(_cx((4, 4))), axes=(0, 0))
+
+    def test_fft2_wrong_axes_len_raises(self):
+        with pytest.raises(ValueError, match="two axes"):
+            paddle.fft.fft2(paddle.to_tensor(_cx((4, 4))), axes=(0, 1, 2))
+
+
+class TestHelpers:
+    def test_fftfreq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, d=0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5), rtol=1e-6)
+
+    def test_rfftfreq(self):
+        np.testing.assert_allclose(paddle.fft.rfftfreq(9, d=2.0).numpy(),
+                                   np.fft.rfftfreq(9, 2.0), rtol=1e-6)
+
+    def test_fftshift_roundtrip(self):
+        x = _x((5, 6))
+        s = paddle.fft.fftshift(paddle.to_tensor(x))
+        np.testing.assert_allclose(s.numpy(), np.fft.fftshift(x))
+        np.testing.assert_allclose(
+            paddle.fft.ifftshift(s).numpy(), x)
+
+
+class TestFftGrads:
+    def test_fft_power_spectrum_grad(self):
+        """d/dx sum|fft(x)|^2 == 2*N*x by Parseval — the canonical fft vjp."""
+        x = paddle.to_tensor(_x((16,)), stop_gradient=False)
+        spec = paddle.fft.fft(x)
+        loss = paddle.sum(paddle.abs(spec) ** 2)
+        loss.backward()
+        n = 16
+        np.testing.assert_allclose(x.grad.numpy(), 2 * n * x.numpy(),
+                                   rtol=1e-3, atol=1e-3)
+
+    def test_irfft_grad_finite(self):
+        x = paddle.to_tensor(_x((3, 9)), stop_gradient=False)
+        out = paddle.fft.irfft(paddle.fft.rfft(x))
+        paddle.sum(out * out).backward()
+        assert np.isfinite(x.grad.numpy()).all()
+
+
+class TestFrameOverlapAdd:
+    def test_frame_last_axis(self):
+        x = _x((2, 20))
+        f = paddle.signal.frame(paddle.to_tensor(x), 8, 4).numpy()
+        assert f.shape == (2, 8, 4)
+        for i in range(4):
+            np.testing.assert_allclose(f[:, :, i], x[:, i * 4: i * 4 + 8])
+
+    def test_frame_axis0(self):
+        x = _x((20, 3))
+        f = paddle.signal.frame(paddle.to_tensor(x), 8, 4, axis=0).numpy()
+        assert f.shape == (4, 8, 3)
+        for i in range(4):
+            np.testing.assert_allclose(f[i], x[i * 4: i * 4 + 8])
+
+    def test_overlap_add_inverts_frame_sum(self):
+        # frames of a constant-1 signal overlap-add to the coverage count
+        x = np.ones((1, 20), np.float32)
+        f = paddle.signal.frame(paddle.to_tensor(x), 8, 4)
+        y = paddle.signal.overlap_add(f, 4).numpy()
+        # positions covered by k frames sum to k
+        assert y.shape == (1, 20)
+        np.testing.assert_allclose(y[0, 8:12], 2.0)  # interior coverage
+
+    def test_overlap_add_axis0(self):
+        fr = _x((4, 8, 3))  # (n_frames, frame_length, batch)
+        y = paddle.signal.overlap_add(paddle.to_tensor(fr), 4, axis=0)
+        assert tuple(y.shape) == (20, 3)
+        y2 = paddle.signal.overlap_add(
+            paddle.to_tensor(np.moveaxis(fr, (0, 1), (2, 1))), 4, axis=-1)
+        np.testing.assert_allclose(y.numpy(), y2.numpy().T, rtol=1e-6,
+                                   atol=1e-6)
+
+    def test_frame_too_long_raises(self):
+        with pytest.raises(ValueError, match="frame_length"):
+            paddle.signal.frame(paddle.to_tensor(_x((2, 4))), 8, 2)
+
+
+class TestStft:
+    def test_stft_shape_onesided(self):
+        x = paddle.to_tensor(_x((2, 64)))
+        s = paddle.signal.stft(x, n_fft=16)
+        assert tuple(s.shape) == (2, 9, 17)  # center pads 8 each side
+        assert s.numpy().dtype == np.complex64
+
+    def test_stft_matches_manual_dft(self):
+        x = _x((64,))
+        s = paddle.signal.stft(paddle.to_tensor(x), n_fft=16, hop_length=8,
+                               center=False).numpy()
+        # manual: frames of length 16 every 8, rfft each
+        want = np.stack([np.fft.rfft(x[i * 8: i * 8 + 16])
+                         for i in range(7)], axis=-1)
+        np.testing.assert_allclose(s, want, rtol=1e-3, atol=1e-3)
+
+    def test_stft_istft_roundtrip(self):
+        x = _x((2, 128))
+        win = paddle.to_tensor(np.hanning(32).astype(np.float32))
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=32,
+                                  hop_length=8, window=win)
+        back = paddle.signal.istft(spec, n_fft=32, hop_length=8, window=win,
+                                   length=128)
+        np.testing.assert_allclose(back.numpy(), x, rtol=1e-3, atol=1e-3)
+
+    def test_stft_grad_flows(self):
+        x = paddle.to_tensor(_x((64,)), stop_gradient=False)
+        s = paddle.signal.stft(x, n_fft=16)
+        loss = paddle.sum(paddle.abs(s) ** 2)
+        loss.backward()
+        g = x.grad.numpy()
+        assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+    def test_onesided_complex_input_raises(self):
+        with pytest.raises(ValueError, match="onesided"):
+            paddle.signal.stft(paddle.to_tensor(_cx((64,))), n_fft=16)
+
+    def test_istft_wrong_fft_size_raises(self):
+        with pytest.raises(ValueError, match="fft_size"):
+            paddle.signal.istft(paddle.to_tensor(_cx((2, 7, 5))), n_fft=16)
+
+
+class TestSpectrogramStftParity:
+    """audio.features.Spectrogram (real matmul-DFT, complex-free for TPU
+    plugins without complex support) must equal |signal.stft|^power."""
+
+    def test_spectrogram_equals_stft_magnitude(self):
+        x = _x((2, 400))
+        spec_layer = paddle.audio.features.Spectrogram(
+            n_fft=64, hop_length=16, window="hann", power=2.0)
+        got = spec_layer(paddle.to_tensor(x)).numpy()
+        # get_window('hann') is the periodic hann window
+        win = paddle.to_tensor(
+            (0.5 - 0.5 * np.cos(2 * np.pi * np.arange(64) / 64))
+            .astype(np.float32))
+        S = paddle.signal.stft(paddle.to_tensor(x), n_fft=64, hop_length=16,
+                               window=win).numpy()
+        want = np.abs(S) ** 2
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-3)
+
+
+class TestJitAndRegistry:
+    def test_fft_under_jit(self):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(a):
+            return jnp.abs(jnp.fft.fft(a))
+
+        x = _x((16,))
+        got = paddle.fft.fft(paddle.to_tensor(x))
+        np.testing.assert_allclose(np.abs(got.numpy()), f(x), rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_registry_has_fft_ops(self):
+        from paddle_tpu.ops import registry
+        names = {o.name for o in registry.all_ops()}
+        for want in ["fft.fft", "fft.rfftn", "fft.fftshift", "signal.stft",
+                     "signal.istft", "signal.frame", "signal.overlap_add"]:
+            assert want in names, want
