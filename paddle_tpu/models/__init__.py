@@ -15,3 +15,5 @@ from .moe_llama import MoELlamaConfig  # noqa: F401
 from . import generation  # noqa: F401
 from . import bert  # noqa: F401
 from .bert import BertConfig  # noqa: F401
+from . import dit  # noqa: F401
+from .dit import DiTConfig  # noqa: F401
