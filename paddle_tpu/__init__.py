@@ -51,6 +51,7 @@ from . import audio  # noqa: E402
 from . import text  # noqa: E402
 from . import fft  # noqa: E402
 from . import signal  # noqa: E402
+from . import utils  # noqa: E402
 from . import static  # noqa: E402
 from . import profiler  # noqa: E402
 from . import inference  # noqa: E402

@@ -138,9 +138,13 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = True,
 
     out = acc / jnp.maximum(l, _TINY)[..., None]
     if mask is not None:
-        # a fully-masked row never saw a real score (m still at the -1e30
-        # floor): return 0 for it instead of a uniform average of v
-        out = jnp.where((m <= _NEG_INF / 2)[..., None], 0.0, out)
+        # a fully-masked row never saw a real score.  Detect it for BOTH
+        # mask encodings with one threshold: bool masks leave m at the
+        # -1e30 floor, additive "-1e9" masks leave m ~ -1e9 — while any
+        # real row has m of order |q.k| (<< 1e8).  The same convention is
+        # applied in kernels.attention_reference so ring and local paths
+        # agree on degenerate rows (return 0, not NaN / uniform avg of v).
+        out = jnp.where((m <= -1e8)[..., None], 0.0, out)
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 

@@ -21,12 +21,28 @@ from . import mesh as mesh_lib
 from ..optimizer.functional import AdamW
 
 
+
+def _leaf_name(path):
+    """Innermost dict key on a tree path (None for positional leaves)."""
+    for p in reversed(path):
+        k = getattr(p, "key", None)
+        if k is not None:
+            return k
+    return None
+
+
 class ShardedTrainState:
     """Bundle of (params, opt_state) shardings + jitted step/init functions."""
 
     def __init__(self, config, model, mesh: Mesh, optimizer: Optional[AdamW] = None,
-                 zero_stage: int = 1, rules=None, donate: bool = True):
+                 zero_stage: int = 1, rules=None, donate: bool = True,
+                 seq_leaves=None):
         import dataclasses
+
+        # seq_leaves: optional iterable of batch-dict keys whose dim 1 IS a
+        # sequence (sharded over the sep axis); None = rank heuristic (see
+        # _leaf_sharding)
+        self._seq_leaves = frozenset(seq_leaves) if seq_leaves is not None else None
 
         if zero_stage not in (0, 1, 2, 3):
             raise ValueError(
@@ -136,18 +152,25 @@ class ShardedTrainState:
 
         self._eval_fn = eval_fn
 
-    def _leaf_sharding(self, x):
+    def _leaf_sharding(self, x, name=None):
         import numpy as np
         # heuristic: rank-2/3 leaves treat dim 1 as the sequence ((B,S) ids
         # and masks, (B,S,V) soft labels / per-token weights) and shard
         # (batch, seq); rank-1 per-example scalars and rank-4+ leaves
-        # ((B,H,W,C) pixels, whose dim 1 is NOT a sequence) shard batch only
+        # ((B,H,W,C) pixels, whose dim 1 is NOT a sequence) shard batch only.
+        # The heuristic misfires on rank-2/3 leaves whose dim 1 is NOT a
+        # sequence ((B, num_classes) soft targets, (B, 2) spans) — pass
+        # seq_leaves={names...} to the constructor to name the sequence
+        # leaves explicitly and shard everything else batch-only.
+        if self._seq_leaves is not None:
+            return (self.batch_sharding if name in self._seq_leaves
+                    else self._batch_sharding_1d)
         return (self.batch_sharding if np.ndim(x) in (2, 3)
                 else self._batch_sharding_1d)
 
     def _batch_shardings(self, batch):
-        return jax.tree.map(
-            lambda x: self._leaf_sharding(x), batch)
+        return jax.tree_util.tree_map_with_path(
+            lambda path, x: self._leaf_sharding(x, _leaf_name(path)), batch)
 
     @staticmethod
     def _batch_key(batch):
@@ -184,13 +207,14 @@ class ShardedTrainState:
         # _leaf_sharding reads only np.ndim — no transfer; one device_put.
         # Leaves may be np/jax arrays, python lists, or paddle Tensors
         # (device_put rejects Tensor directly — unwrap the raw array).
-        def put(x):
+        def put(path, x):
             raw = getattr(x, "_data", x)
             if not hasattr(raw, "ndim"):
                 raw = jnp.asarray(raw)
-            return jax.device_put(raw, self._leaf_sharding(raw))
+            return jax.device_put(raw,
+                                  self._leaf_sharding(raw, _leaf_name(path)))
 
-        return jax.tree.map(put, batch)
+        return jax.tree_util.tree_map_with_path(put, batch)
 
     # -- distributed checkpoint (reshard-on-load) ---------------------------
 

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -77,6 +78,18 @@ class Config:
     def set_cpu_math_library_num_threads(self, n: int):
         self._switches["cpu_threads"] = n
 
+    def set_optim_cache_dir(self, path: str):
+        """Persistent compile cache across process restarts (reference
+        AnalysisConfig::SetOptimCacheDir) — maps to JAX's persistent
+        compilation cache, so the predictor's XLA executable is AOT-reused
+        by the next process instead of recompiled.
+
+        NB the JAX compilation cache is PROCESS-GLOBAL: every XLA compile
+        in this process (not just this predictor's) lands in `path` once a
+        predictor is built from this config — intended for dedicated
+        serving processes."""
+        self._switches["optim_cache_dir"] = path
+
     def disable_glog_info(self):
         self._switches["glog"] = False
 
@@ -129,6 +142,28 @@ class Predictor:
 
         if not config.model_dir():
             raise ValueError("Config has no model path (set_model)")
+        cache_dir = config._switches.get("optim_cache_dir")
+        if cache_dir:
+            import os
+            import jax as _jax
+            try:  # persistent XLA executable cache (survives restarts)
+                _jax.config.update("jax_compilation_cache_dir",
+                                   os.path.abspath(cache_dir))
+                _jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes", 0)
+                _jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs", 0.0)
+                # the cache object is created lazily ONCE per process; a
+                # dir set after the first compile needs an explicit reset
+                from jax._src import compilation_cache as _cc
+                _cc.reset_cache()
+            except Exception as e:  # older jax without these knobs
+                import warnings
+                warnings.warn(
+                    f"set_optim_cache_dir({cache_dir!r}) could not enable "
+                    f"the persistent compile cache on this jax: {e!r}; "
+                    "the predictor will recompile per process.",
+                    RuntimeWarning)
         self._layer = jit.load(config.model_dir())
         meta = self._layer._meta
         if not meta.get("stablehlo"):
@@ -200,42 +235,182 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0):
-    """Minimal HTTP JSON endpoint over a predictor.
+class _ClientError(ValueError):
+    """Request-side fault -> HTTP 400 (anything else is a 500)."""
+
+
+class DynamicBatcher:
+    """Dynamic micro-batching for a fixed-shape compiled predictor.
+
+    The exported executable takes a FIXED batch B (XLA static shapes), so
+    the server coalesces concurrent requests: rows from queued requests are
+    concatenated along dim 0, padded to B with the first row, run ONCE, and
+    the per-request slices handed back.  This is the TPU analog of the
+    reference serving stack's dynamic batching — one compiled program,
+    maximum occupancy under concurrent load.
+    """
+
+    def __init__(self, predictor: Predictor, max_batch: int,
+                 wait_ms: float = 3.0, log_len: int = 1024):
+        import collections
+        self._pred = predictor
+        self.max_batch = max_batch
+        self._wait = wait_ms / 1000.0
+        self._cv = threading.Condition()
+        self._queue: List[dict] = []
+        self._stop = False
+        # bounded: a long-running server must not leak one dict per batch
+        self.batch_log = collections.deque(maxlen=log_len)
+        # trailing dims per input from the exported spec: each request is
+        # validated BEFORE enqueueing so one malformed request cannot sink
+        # the co-batched strangers' requests with a 500
+        self._tails = [tuple(predictor.get_input_handle(nm).shape()[1:])
+                       for nm in predictor.get_input_names()]
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def submit(self, arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        rows = arrays[0].shape[0] if arrays[0].ndim else 1
+        if rows < 1:
+            raise _ClientError("request must carry at least one row")
+        for j, a in enumerate(arrays):
+            if a.ndim == 0 or a.shape[0] != rows:
+                raise _ClientError(
+                    "all inputs must share a leading batch dim for "
+                    "batched serving")
+            if tuple(a.shape[1:]) != self._tails[j]:
+                raise _ClientError(
+                    f"input {j} has per-row shape {tuple(a.shape[1:])}, "
+                    f"model expects {self._tails[j]}")
+        if rows > self.max_batch:
+            raise _ClientError(
+                f"request batch {rows} exceeds the compiled max batch "
+                f"{self.max_batch}; split the request")
+        item = {"arrays": arrays, "rows": rows,
+                "event": threading.Event(), "result": None, "error": None}
+        with self._cv:
+            self._queue.append(item)
+            self._cv.notify()
+        item["event"].wait()
+        if item["error"] is not None:
+            raise item["error"]
+        return item["result"]
+
+    def shutdown(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout=2)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._queue and not self._stop:
+                    self._cv.wait()
+                if self._stop and not self._queue:
+                    return
+                # small coalescing window: let concurrent requests pile up
+                deadline = time.monotonic() + self._wait
+                while (sum(i["rows"] for i in self._queue) < self.max_batch
+                       and not self._stop):
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._cv.wait(timeout=left)
+                batch, used = [], 0
+                while self._queue and (
+                        used + self._queue[0]["rows"] <= self.max_batch):
+                    it = self._queue.pop(0)
+                    batch.append(it)
+                    used += it["rows"]
+            if not batch:
+                continue
+            try:
+                n_in = len(batch[0]["arrays"])
+                cat = [np.concatenate([it["arrays"][j] for it in batch])
+                       for j in range(n_in)]
+                pad = self.max_batch - used
+                if pad:
+                    cat = [np.concatenate(
+                        [c, np.repeat(c[:1], pad, axis=0)]) for c in cat]
+                outs = self._pred.run(cat)
+                self.batch_log.append({"requests": len(batch), "rows": used})
+                off = 0
+                for it in batch:
+                    r = it["rows"]
+                    it["result"] = [o[off:off + r] for o in outs]
+                    off += r
+            except Exception as e:  # noqa: BLE001
+                for it in batch:
+                    it["error"] = e
+            finally:
+                for it in batch:
+                    it["event"].set()
+
+
+def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0,
+          batching: bool = True, batch_wait_ms: float = 3.0,
+          max_body_bytes: int = 64 * 1024 * 1024):
+    """HTTP JSON endpoint over a predictor (reference serving surface,
+    inference/capi_exp + analysis_predictor.h:94).
 
     POST / with {"inputs": [array, ...]} (nested lists; one entry per input
-    in get_input_names() order, dtype taken from the exported spec) returns
-    {"outputs": [array, ...]}.  Returns (server, thread); call
-    server.shutdown() to stop.  Stands in for the reference's serving
-    surface (inference/capi_exp, paddle serving) at demo scale.
+    in get_input_names() order, dtype from the exported spec) returns
+    {"outputs": [array, ...]}.  Concurrent requests are dynamically
+    micro-batched into the compiled batch size (batching=False serializes
+    instead).  Client faults return 400; server faults 500; bodies above
+    `max_body_bytes` are rejected with 413.  Returns (server, thread);
+    server.shutdown() stops both the HTTP loop and the batcher.
     """
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-    lock = threading.Lock()  # predictor handles are stateful: serialize
+    names = predictor.get_input_names()
+    spec_dtypes = [predictor.get_input_handle(nm).type() for nm in names]
+    batcher = None
+    if batching and names:
+        spec_shape = predictor.get_input_handle(names[0]).shape()
+        if spec_shape and spec_shape[0] and spec_shape[0] > 0:
+            batcher = DynamicBatcher(predictor, int(spec_shape[0]),
+                                     wait_ms=batch_wait_ms)
+    lock = threading.Lock()  # non-batched path: handles are stateful
 
     class Handler(BaseHTTPRequestHandler):
         def do_POST(self):
+            status = 200
             try:
                 n = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(n) or b"{}")
-                raw = req["inputs"]
-                names = predictor.get_input_names()
+                if n > max_body_bytes:
+                    self.send_response(413)
+                    self.end_headers()
+                    return
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    raw = req["inputs"]
+                except (json.JSONDecodeError, KeyError, TypeError) as e:
+                    raise _ClientError(f"bad request body: {e!r}")
                 if len(raw) != len(names):
-                    raise ValueError(
+                    raise _ClientError(
                         f"expected {len(names)} inputs {names}, "
                         f"got {len(raw)}")
-                spec_dtypes = [predictor.get_input_handle(nm).type()
-                               for nm in names]
-                arrays = [np.asarray(a, dtype=np.dtype(dt))
-                          for a, dt in zip(raw, spec_dtypes)]
-                with lock:
-                    outs = predictor.run(arrays)
+                try:
+                    arrays = [np.asarray(a, dtype=np.dtype(dt))
+                              for a, dt in zip(raw, spec_dtypes)]
+                except (ValueError, TypeError) as e:
+                    raise _ClientError(f"bad input arrays: {e!r}")
+                if batcher is not None:
+                    outs = batcher.submit(arrays)
+                else:
+                    with lock:
+                        outs = predictor.run(arrays)
                 body = json.dumps(
                     {"outputs": [o.tolist() for o in outs]}).encode()
-                self.send_response(200)
-            except Exception as e:  # noqa: BLE001 — report to the client
+            except _ClientError as e:
+                body = json.dumps({"error": str(e)}).encode()
+                status = 400
+            except Exception as e:  # noqa: BLE001 — server-side fault
                 body = json.dumps({"error": repr(e)}).encode()
-                self.send_response(400)
+                status = 500
+            self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
@@ -245,6 +420,15 @@ def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0):
             pass
 
     srv = ThreadingHTTPServer((host, port), Handler)
+    if batcher is not None:
+        srv._batcher = batcher
+        _orig_shutdown = srv.shutdown
+
+        def _shutdown():
+            batcher.shutdown()
+            _orig_shutdown()
+
+        srv.shutdown = _shutdown
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, t

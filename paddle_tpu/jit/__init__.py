@@ -50,6 +50,22 @@ def _is_arraylike(x):
     return isinstance(x, (jax.Array, np.ndarray)) or hasattr(x, "aval")
 
 
+class _DynMarker:
+    """Sentinel marking a traced-array position in a flattened arg list."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<dyn>"
+
+
+_DYN = _DynMarker()
+
+
 class _RngThread:
     """Thread a fresh RNG key through traced code (dropout etc.)."""
 
@@ -84,8 +100,10 @@ class StaticFunction:
         if not isinstance(self._layer, Layer):
             self._layer = None
         self._input_spec = input_spec
-        self._jitted = None
-        self._train_mode = None
+        # python-scalar specialization (dy2static parity: non-tensor args are
+        # CONSTANTS of the traced program, so ints may drive shapes/ranges):
+        # one compiled program per (train_mode, tree structure, static leaves)
+        self._cache = {}
 
     @property
     def _params_and_buffers(self):
@@ -95,10 +113,12 @@ class StaticFunction:
         buffers = [b for _, b in self._layer.named_buffers() if b is not None]
         return params, buffers
 
-    def _build(self):
+    def _build(self, treedef, static_leaves):
+        """Compile for one (tree structure, static python leaves) signature.
+        `static_leaves[i] is _DYN` marks a traced array position."""
         fn = self._fn
 
-        def pure(param_raws, buffer_raws, key, arg_raws, kwarg_raws):
+        def pure(param_raws, buffer_raws, key, dyn_leaves):
             params, buffers = self._params_and_buffers
             old_p = [p._data for p in params]
             old_b = [b._data for b in buffers]
@@ -111,6 +131,10 @@ class StaticFunction:
                     p._data = r
                 for b, r in zip(buffers, buffer_raws):
                     b._data = r
+                it = iter(dyn_leaves)
+                leaves = [next(it) if s is _DYN else s for s in static_leaves]
+                arg_raws, kwarg_raws = jax.tree_util.tree_unflatten(
+                    treedef, leaves)
                 args = jax.tree_util.tree_map(
                     lambda x: Tensor(x, stop_gradient=True) if _is_arraylike(x) else x, arg_raws,
                     is_leaf=_is_arraylike)
@@ -135,16 +159,26 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         train_mode = self._layer.training if self._layer is not None else False
-        if self._jitted is None or train_mode != self._train_mode:
-            self._jitted = self._build()
-            self._train_mode = train_mode
+        arg_raws = jax.tree_util.tree_map(_unwrap, args, is_leaf=lambda x: isinstance(x, Tensor))
+        kwarg_raws = jax.tree_util.tree_map(_unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
+        leaves, treedef = jax.tree_util.tree_flatten((arg_raws, kwarg_raws))
+        dyn_leaves = [l for l in leaves if _is_arraylike(l)]
+        static_leaves = tuple(_DYN if _is_arraylike(l) else l for l in leaves)
+        try:
+            cache_key = (train_mode, treedef, static_leaves)
+            hash(cache_key)
+        except TypeError:  # unhashable static leaf: don't cache, just build
+            cache_key = None
+        jitted = self._cache.get(cache_key) if cache_key is not None else None
+        if jitted is None:
+            jitted = self._build(treedef, static_leaves)
+            if cache_key is not None:
+                self._cache[cache_key] = jitted
         params, buffers = self._params_and_buffers
         param_raws = [p._data for p in params]
         buffer_raws = [b._data for b in buffers]
-        arg_raws = jax.tree_util.tree_map(_unwrap, args, is_leaf=lambda x: isinstance(x, Tensor))
-        kwarg_raws = jax.tree_util.tree_map(_unwrap, kwargs, is_leaf=lambda x: isinstance(x, Tensor))
         key = framework.next_rng_key()
-        out_raw, new_b = self._jitted(param_raws, buffer_raws, key, arg_raws, kwarg_raws)
+        out_raw, new_b = jitted(param_raws, buffer_raws, key, dyn_leaves)
         for b, r in zip(buffers, new_b):
             b._data = r
         return jax.tree_util.tree_map(
@@ -316,7 +350,11 @@ def save(layer, path, input_spec=None, **configs):
                 param_raws = [p._data for p in params]
                 buffer_raws = [b._data for b in buffers]
                 key = jax.random.PRNGKey(0)
-                out, _ = static._build()(param_raws, buffer_raws, key, arg_raws, {})
+                leaves, treedef = jax.tree_util.tree_flatten(
+                    (tuple(arg_raws), {}))
+                jitted = static._build(treedef,
+                                       tuple(_DYN for _ in leaves))
+                out, _ = jitted(param_raws, buffer_raws, key, leaves)
                 return out
 
             exported = jax_export.export(jax.jit(pure_infer))(*args_abs)
@@ -338,17 +376,22 @@ class TranslatedLayer(Layer):
         self._state = state
         self._meta = meta
         self._exported = None
+        self._call = None
         if meta.get("stablehlo"):
             from jax import export as jax_export
 
             with open(path + ".stablehlo", "rb") as f:
                 self._exported = jax_export.deserialize(f.read())
+            # jit the exported call ONCE: repeat runs reuse the compiled
+            # executable, and the compile lands in jax's (optionally
+            # persistent — inference.Config.set_optim_cache_dir) cache
+            self._call = jax.jit(self._exported.call)
 
     def forward(self, *args):
         if self._exported is None:
             raise RuntimeError("no compiled graph saved; re-save with input_spec")
         raws = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
-        out = self._exported.call(*raws)
+        out = self._call(*raws)
         return jax.tree_util.tree_map(lambda x: Tensor(x), out)
 
     def state_dict(self, *a, **k):

@@ -102,7 +102,15 @@ def attention_reference(q, k, v, mask=None, causal=False, scale=None):
             logits = jnp.where(mask, logits, -jnp.inf)
         else:
             logits = logits + mask.astype(logits.dtype)
-    probs = jax.nn.softmax(logits, axis=-1).astype(dt)
+    if mask is not None:
+        # fully-masked rows return 0 under EITHER mask encoding (bool ->
+        # row max -inf; additive -1e9 -> row max ~ -1e9), matching
+        # distributed.context_parallel.ring_attention's convention
+        dead = jnp.max(logits, axis=-1, keepdims=True) <= -1e8
+        probs = jax.nn.softmax(jnp.where(dead, 0.0, logits), axis=-1)
+        probs = jnp.where(dead, 0.0, probs).astype(dt)
+    else:
+        probs = jax.nn.softmax(logits, axis=-1).astype(dt)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vt)
     return jnp.swapaxes(out, 1, 2)
 

@@ -243,8 +243,13 @@ int pst_save(void* h, const char* path) {
   FILE* f = std::fopen(path, "wb");
   if (!f) return -1;
   int32_t dim = t->dim;
-  int64_t count = pst_size(h);
+  // write a placeholder count, COUNT THE ROWS ACTUALLY WRITTEN under the
+  // per-shard locks, then seek back and patch the header: a concurrent push
+  // between a size() snapshot and the shard walk can otherwise make the
+  // header disagree with the body (load would drop rows or fail)
+  int64_t count = 0;
   std::fwrite(&dim, sizeof(dim), 1, f);
+  long count_pos = std::ftell(f);
   std::fwrite(&count, sizeof(count), 1, f);
   for (int s = 0; s < kShards; ++s) {
     std::lock_guard<std::mutex> lk(t->mu[s]);
@@ -252,8 +257,11 @@ int pst_save(void* h, const char* path) {
       std::fwrite(&kv.first, sizeof(int64_t), 1, f);
       std::fwrite(kv.second.data(), sizeof(float),
                   static_cast<size_t>(dim), f);
+      ++count;
     }
   }
+  std::fseek(f, count_pos, SEEK_SET);
+  std::fwrite(&count, sizeof(count), 1, f);
   std::fclose(f);
   return 0;
 }

@@ -475,15 +475,38 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
     return apply_op("kthvalue", f, x)
 
 
+def _mode_1d(a):
+    """Reference semantics (test/legacy_test/test_mode_op.py:29): among the
+    most frequent values pick the smallest; the index is the LAST original
+    position of that value (stable argsort order)."""
+    si = np.argsort(a, kind="stable")
+    sa = a[si]
+    new_run = np.concatenate([[True], sa[1:] != sa[:-1]])
+    run_ids = np.cumsum(new_run) - 1
+    counts = np.bincount(run_ids)
+    best = int(np.argmax(counts))     # first max -> smallest value
+    end = int(np.flatnonzero(run_ids == best)[-1])
+    return sa[end], si[end]
+
+
 def mode(x, axis=-1, keepdim=False, name=None):
+    """(values, indices) of the most frequent element along `axis`
+    (reference python/paddle/tensor/search.py mode + mode_kernel)."""
     x = _t(x)
     arr = np.asarray(x._data)
-    from scipy import stats  # available via numpy ecosystem; fallback below
-    try:
-        m = stats.mode(arr, axis=axis, keepdims=keepdim)
-        return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
-    except Exception:  # noqa: BLE001
-        raise NotImplementedError("mode requires scipy")
+    ax = axis % arr.ndim if arr.ndim else 0
+    moved = np.moveaxis(arr, ax, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=arr.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i in range(flat.shape[0]):
+        vals[i], idxs[i] = _mode_1d(flat[i])
+    vals = vals.reshape(moved.shape[:-1])
+    idxs = idxs.reshape(moved.shape[:-1])
+    if keepdim:
+        vals = np.expand_dims(vals, ax)
+        idxs = np.expand_dims(idxs, ax)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
 
 
 def nonzero(x, as_tuple=False, name=None):
@@ -518,7 +541,26 @@ def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None, 
             counts = np.diff(np.append(idx, arr.size))
             outs.append(Tensor(jnp.asarray(counts)))
         return outs[0] if len(outs) == 1 else tuple(outs)
-    raise NotImplementedError
+    # N-D path: dedupe consecutive SLICES along `axis`
+    # (reference unique_consecutive_kernel axis branch)
+    ax = axis % arr.ndim
+    moved = np.moveaxis(arr, ax, 0)
+    if moved.shape[0] == 0:
+        keep = np.zeros((0,), bool)
+    else:
+        diff = np.any(moved[1:] != moved[:-1],
+                      axis=tuple(range(1, moved.ndim)))
+        keep = np.concatenate([[True], diff])
+    out = np.moveaxis(moved[keep], 0, ax)
+    outs = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(jnp.asarray(inv)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, moved.shape[0]))
+        outs.append(Tensor(jnp.asarray(counts)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):

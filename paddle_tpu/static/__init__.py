@@ -34,10 +34,11 @@ __all__ = [
     "default_startup_program", "data", "Executor", "InputSpec", "name_scope",
     "save", "load", "save_inference_model", "load_inference_model",
     "serialize_program", "deserialize_program", "cpu_places", "cuda_places",
-    "xpu_places", "global_scope", "scope_guard", "Scope",
+    "xpu_places", "global_scope", "scope_guard", "Scope", "nn",
 ]
 
 from ..jit import InputSpec  # noqa: E402  (same spec type as jit)
+from . import nn  # noqa: E402  (cond/while_loop/case/switch_case)
 
 
 class _OpRecord:

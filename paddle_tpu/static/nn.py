@@ -1,0 +1,148 @@
+"""paddle.static.nn — data-dependent control flow for compiled programs.
+
+Reference: the dy2static AST transformer pipeline
+(`python/paddle/jit/dy2static/program_translator.py:313` and the
+`*_transformer.py` passes) rewrites python `if`/`while` over tensor values
+into `cond`/`while_loop` ops.  Trace-based `to_static` cannot rewrite the
+AST; instead the same ops are exposed DIRECTLY, lax-backed:
+
+    paddle.static.nn.cond(pred, true_fn, false_fn)     -> lax.cond
+    paddle.static.nn.while_loop(cond_fn, body_fn, vars) -> lax.while_loop
+    paddle.static.nn.case / switch_case                 -> lax.switch
+
+and a python `if tensor:` inside a traced function raises an actionable
+error pointing here (tensor.Tensor.__bool__).  Everything works eagerly
+too (the ops simply execute the taken branch), so code is portable between
+dygraph and to_static — the same contract the reference's
+paddle.static.nn.cond (python/paddle/static/nn/control_flow.py:934) gives.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, to_tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _wrap_tree(raws):
+    return jax.tree_util.tree_map(
+        lambda r: Tensor(r, stop_gradient=True)
+        if isinstance(r, (jax.Array, jax.core.Tracer)) else r, raws)
+
+
+def _unwrap_tree(vals):
+    return jax.tree_util.tree_map(
+        lambda v: v._data if isinstance(v, Tensor) else v, vals,
+        is_leaf=lambda v: isinstance(v, Tensor))
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
+         return_names=None):
+    """Run `true_fn()` or `false_fn()` depending on scalar boolean `pred`
+    (reference python/paddle/static/nn/control_flow.py:934).  Both branches
+    must return the same structure/shapes/dtypes (checked by lax.cond)."""
+    p = _unwrap(pred)
+    p = jnp.asarray(p)
+    if p.size != 1:
+        raise ValueError(
+            f"cond() pred must be a scalar boolean, got shape {p.shape}")
+    p = p.reshape(()).astype(jnp.bool_)
+
+    def tb(_):
+        return _unwrap_tree(true_fn())
+
+    def fb(_):
+        return _unwrap_tree(false_fn())
+
+    out = jax.lax.cond(p, tb, fb, operand=None)
+    return _wrap_tree(out)
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
+               is_test: bool = False, name=None):
+    """lax-backed while loop (reference control_flow.py:1330).  `cond_fn` and
+    `body_fn` take the loop vars positionally; shapes/dtypes must be loop
+    invariant (XLA's compiled-loop contract — the same restriction the
+    reference's static while_loop has on its block vars)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise TypeError("while_loop loop_vars must be a non-empty list")
+    init = tuple(_unwrap_tree(v) for v in loop_vars)
+
+    def c(vs):
+        out = cond_fn(*_wrap_tree(vs))
+        return jnp.asarray(_unwrap(out)).reshape(()).astype(jnp.bool_)
+
+    def b(vs):
+        out = body_fn(*_wrap_tree(vs))
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        raws = tuple(_unwrap_tree(v) for v in out)
+        # keep each carry's dtype loop-invariant: python-scalar promotion
+        # (x64 ints) must not silently retype the loop vars
+        return tuple(
+            jnp.asarray(r).astype(i.dtype)
+            if hasattr(i, "dtype") and jnp.asarray(r).dtype != i.dtype else r
+            for r, i in zip(raws, init))
+
+    final = jax.lax.while_loop(c, b, init)
+    return list(_wrap_tree(final))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First-match-wins dispatch (reference control_flow.py:1580): pairs of
+    (scalar bool Tensor, fn).  Lowered to nested lax.cond."""
+    if not pred_fn_pairs:
+        raise ValueError("case() needs at least one (pred, fn) pair")
+    for pr, fn in pred_fn_pairs:
+        if not callable(fn):
+            raise TypeError("case() fns must be callable")
+
+    def build(pairs):
+        if not pairs:
+            if default is None:
+                # reference behavior: last fn is the fallback
+                return lambda: pred_fn_pairs[-1][1]()
+            return default
+        (pr, fn), rest = pairs[0], pairs[1:]
+        return lambda: cond(pr, fn, build(rest))
+
+    return build(list(pred_fn_pairs))()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Indexed dispatch -> lax.switch (reference control_flow.py:1718).
+    `branch_fns`: dict {int: fn} or list of (int, fn) or list of fns."""
+    idx = jnp.asarray(_unwrap(branch_index)).reshape(()).astype(jnp.int32)
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(k), f) for k, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    # map arbitrary integer keys (negative included) onto dense switch
+    # indices via an offset table; unknown keys -> default
+    lo, hi = min(keys), max(keys)
+    table = {k: i for i, (k, _) in enumerate(items)}
+    branches = [lambda _, f=f: _unwrap_tree(f()) for f in fns]
+    branches.append(lambda _: _unwrap_tree(default()))
+    dense = jnp.full((hi - lo + 1,), len(fns), jnp.int32)
+    for k, i in table.items():
+        dense = dense.at[k - lo].set(i)
+    safe = jnp.clip(idx - lo, 0, hi - lo)
+    sel = jnp.where((idx >= lo) & (idx <= hi), dense[safe], len(fns))
+    out = jax.lax.switch(sel, branches, None)
+    return _wrap_tree(out)

@@ -239,15 +239,43 @@ class Tensor:
         )
 
     def __bool__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            raise TypeError(
+                "A Tensor's truth value is data-dependent and this code is "
+                "being traced for compilation (to_static/jit), where python "
+                "`if`/`while` over tensor values cannot branch. Use "
+                "paddle.static.nn.cond(pred, true_fn, false_fn) / "
+                "paddle.static.nn.while_loop(cond_fn, body_fn, vars) "
+                "(reference dy2static's ifelse/while transformers, "
+                "python/paddle/jit/dy2static/program_translator.py:313), or "
+                "mark the function @paddle.jit.not_to_static.")
         return bool(self._data)
 
     def __int__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            raise TypeError(
+                "int(Tensor) requires a concrete value but this code is "
+                "being traced for compilation. Pass the value as a python "
+                "int argument instead (to_static specializes on python "
+                "scalars), or keep it a Tensor and use tensor ops.")
         return int(self._data)
 
     def __float__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            raise TypeError(
+                "float(Tensor) requires a concrete value but this code is "
+                "being traced for compilation. Pass it as a python float "
+                "argument (to_static specializes on python scalars), or "
+                "keep it a Tensor and use tensor ops.")
         return float(self._data)
 
     def __index__(self):
+        if isinstance(self._data, jax.core.Tracer):
+            raise TypeError(
+                "Using a Tensor as a python index requires a concrete value "
+                "but this code is being traced for compilation. Use python "
+                "ints for shapes/indices (to_static specializes on them) or "
+                "tensor indexing ops (gather/index_select).")
         return int(self._data)
 
     def __hash__(self):

@@ -1,0 +1,23 @@
+"""paddle.utils parity surface (python/paddle/utils/__init__.py).
+
+The load-bearing member is `cpp_extension` — the custom-kernel extension
+API (reference `paddle/phi/api/ext/op_meta_info.h:943` PD_BUILD_OP +
+`python/paddle/utils/cpp_extension/cpp_extension.py`), re-designed for TPU:
+custom ops are Pallas/JAX functions (device path) or C++ host kernels
+(compiled + bridged via jax.pure_callback), registered into the same op
+table and dispatched through `apply_op` so tape/AMP/jit work unchanged.
+"""
+
+from . import cpp_extension  # noqa: F401
+from .cpp_extension import CustomOp, get_custom_op, load, register_custom_op  # noqa: F401
+
+__all__ = ["cpp_extension", "register_custom_op", "get_custom_op", "load",
+           "CustomOp"]
+
+
+def try_import(name):  # paddle.utils.try_import parity
+    import importlib
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"Failed to import {name}: {e}") from e

@@ -1,0 +1,257 @@
+"""Custom-kernel extension API (SURVEY C-custom-op; VERDICT r3 missing #2).
+
+Reference: users extend PaddlePaddle with external kernels through
+`PD_BUILD_OP` (`paddle/phi/api/ext/op_meta_info.h:943`) and build them with
+`python/paddle/utils/cpp_extension/cpp_extension.py` (setup/load).  The op
+then behaves like a built-in: dispatched through the eager API, AMP lists,
+autograd, and usable inside compiled programs.
+
+TPU-native re-design — two tiers, one registration point:
+
+1. `register_custom_op(name, fn, vjp=..., ...)` — the DEVICE path.  `fn` is
+   any JAX-traceable callable (jnp composition or a Pallas kernel).  An
+   optional user vjp makes it differentiable even when fn itself is not
+   (e.g. a fwd-only Pallas kernel).  The op is:
+     * dispatched through `tensor.apply_op` (eager tape, AMP cast lists,
+       FLAGS_check_nan_inf — identical treatment to built-ins),
+     * registered into `ops.registry` (the dtype/grad/sharding test sweep
+       picks it up when a `sample` is provided),
+     * bound as `paddle_tpu.<name>` and as a `Tensor` method.
+
+2. `load(name, sources=...)` — the HOST path, the literal cpp_extension
+   analog.  C++ sources are compiled with the in-image toolchain
+   (g++ -shared -fPIC), exported symbols use a plain C ABI
+   (`extern "C" void op(const float* in, float* out, const int64_t* shape,
+   int64_t ndim)`), and the kernel is bridged into JAX with
+   `jax.pure_callback`, so it works eagerly AND inside jit (XLA inserts the
+   host transfer; on TPU this is a device->host->device round trip — use
+   tier 1/Pallas for hot ops).  A vjp may be supplied (another C++ kernel or
+   any python fn) to make it differentiable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["register_custom_op", "get_custom_op", "load", "CustomOp",
+           "CppExtension"]
+
+_CUSTOM_OPS = {}
+_LOCK = threading.Lock()
+
+
+class CustomOp:
+    """A registered custom op: callable over Tensors, dispatching through
+    apply_op (so tape/AMP/flags apply) with the user's fn (+ optional vjp)."""
+
+    def __init__(self, name: str, fn: Callable, vjp: Optional[Callable],
+                 nondiff: Sequence[int] = ()):
+        self.name = name
+        self._raw_fn = fn
+        if vjp is not None:
+            # user-supplied gradient: custom_vjp with residuals = all inputs.
+            # vjp signature: vjp(cotangent, *primal_inputs) -> grads tuple
+            # (one per differentiable input, None allowed).
+            cfn = jax.custom_vjp(fn)
+
+            def fwd(*args):
+                return fn(*args), args
+
+            def bwd(res, ct):
+                grads = vjp(ct, *res)
+                if not isinstance(grads, (tuple, list)):
+                    grads = (grads,)
+                out = tuple(
+                    jnp.zeros_like(a) if g is None else g
+                    for g, a in zip(grads, res))
+                return out
+
+            cfn.defvjp(fwd, bwd)
+            self.fn = cfn
+        else:
+            self.fn = fn
+        self.nondiff = tuple(nondiff)
+
+    def __call__(self, *args, **kwargs):
+        from ..tensor import Tensor, apply_op, to_tensor
+        targs = [a if isinstance(a, Tensor) or not isinstance(
+            a, (np.ndarray, jnp.ndarray, float, int, list)) else to_tensor(a)
+            for a in args]
+        if kwargs:
+            import functools
+            f = functools.partial(self.fn, **kwargs)
+        else:
+            f = self.fn
+        return apply_op(self.name, f, *targs, nondiff=self.nondiff)
+
+
+def register_custom_op(name: str, fn: Optional[Callable] = None, *,
+                       vjp: Optional[Callable] = None,
+                       sharding: str = "elementwise",
+                       dtypes: Tuple[str, ...] = ("float32",),
+                       sample: Optional[Callable] = None,
+                       tol: Optional[dict] = None,
+                       nondiff: Sequence[int] = (),
+                       bind_tensor_method: bool = True):
+    """Register a custom device op.  Usable as a decorator:
+
+        @register_custom_op("fused_bias_gelu", vjp=my_vjp)
+        def fused_bias_gelu(x, b): ...        # jnp or Pallas
+
+    After registration `paddle_tpu.fused_bias_gelu(t)` dispatches through the
+    framework op path, differentiates (user vjp or JAX AD), runs under jit,
+    and — when `sample` is given — joins the generated registry sweep like
+    any built-in (the analog of the reference's custom-op OpTest hook,
+    test/custom_op/test_custom_relu_op_setup.py)."""
+
+    def deco(f):
+        import paddle_tpu as _pt
+        from ..ops import registry
+        from ..tensor import Tensor
+
+        if name in _CUSTOM_OPS:
+            raise ValueError(f"custom op '{name}' already registered")
+        if hasattr(_pt, name):
+            raise ValueError(
+                f"custom op '{name}' collides with an existing "
+                f"paddle_tpu attribute")
+        op = CustomOp(name, f, vjp, nondiff=nondiff)
+        with _LOCK:
+            _CUSTOM_OPS[name] = op
+            registry.register(name, dtypes=dtypes, has_vjp=True,
+                              sample=sample, tol=tol, sharding=sharding)
+            setattr(_pt, name, op)
+            if bind_tensor_method and not hasattr(Tensor, name):
+                setattr(Tensor, name, lambda self, *a, **k: op(self, *a, **k))
+        return op
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def get_custom_op(name: str) -> CustomOp:
+    return _CUSTOM_OPS[name]
+
+
+# ---------------------------------------------------------------------------
+# Tier 2: C++ host kernels (the literal cpp_extension)
+# ---------------------------------------------------------------------------
+
+
+class CppExtension:
+    """Build-spec record (API parity with reference CppExtension; here it
+    just carries sources/flags for load())."""
+
+    def __init__(self, sources, extra_compile_args=None):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+def _compile(name: str, sources, extra_cflags, build_directory):
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    lib_path = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    stale = (not os.path.exists(lib_path) or any(
+        os.path.getmtime(lib_path) < os.path.getmtime(s) for s in srcs))
+    if stale:
+        cmd = (["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread"]
+               + list(extra_cflags or []) + srcs + ["-o", lib_path + ".tmp"])
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            raise RuntimeError(
+                f"cpp_extension build failed for '{name}':\n{e.stderr}") from e
+        os.replace(lib_path + ".tmp", lib_path)
+    return ctypes.CDLL(lib_path)
+
+
+class _CppKernel:
+    """One exported C symbol bridged into JAX via pure_callback.
+
+    C ABI: extern "C" void sym(const T* in..., T* out,
+                               const int64_t* shape, int64_t ndim)
+    with all inputs sharing the (broadcasted) output shape — the elementwise
+    contract covers the vast majority of reference custom ops (custom_relu
+    etc.); richer ops can be registered as python fns over this bridge."""
+
+    def __init__(self, cdll, symbol: str, n_inputs: int, dtype=np.float32):
+        self._f = getattr(cdll, symbol)
+        self._f.restype = None
+        self.n_inputs = n_inputs
+        self.dtype = np.dtype(dtype)
+
+    def _host(self, *arrays):
+        if len(arrays) != self.n_inputs:
+            raise TypeError(
+                f"kernel takes {self.n_inputs} input(s), got {len(arrays)} "
+                "(a wrong arity would pass garbage pointers to the C ABI)")
+        arrays = [np.ascontiguousarray(a, dtype=self.dtype) for a in arrays]
+        out = np.empty_like(arrays[0])
+        shape = np.asarray(arrays[0].shape, dtype=np.int64)
+        argp = [a.ctypes.data_as(ctypes.c_void_p) for a in arrays]
+        self._f(*argp, out.ctypes.data_as(ctypes.c_void_p),
+                shape.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                ctypes.c_int64(len(shape)))
+        return out
+
+    def __call__(self, *arrays):
+        if len(arrays) != self.n_inputs:
+            raise TypeError(
+                f"kernel takes {self.n_inputs} input(s), got {len(arrays)}")
+        if not any(isinstance(a, jax.core.Tracer) for a in arrays):
+            # eager: call the C kernel directly — works on every backend,
+            # including plugins without host-callback support (axon)
+            return jnp.asarray(self._host(*[np.asarray(a) for a in arrays]))
+        spec = jax.ShapeDtypeStruct(arrays[0].shape, self.dtype)
+        return jax.pure_callback(self._host, spec, *arrays,
+                                 vmap_method="sequential")
+
+
+def load(name: str, sources=None, *, functions=None,
+         extra_cflags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None, verbose: bool = False,
+         register: bool = True, vjps=None, dtype=np.float32):
+    """Compile C++ `sources` and expose exported kernels as framework ops
+    (reference cpp_extension.load, python/paddle/utils/cpp_extension/
+    cpp_extension.py:120).
+
+    `functions`: {symbol_name: n_inputs} of C symbols to bridge (required —
+    there is no ELF introspection here).  Each becomes a registered custom
+    op named `symbol_name` (register=False returns plain callables instead).
+    `vjps`: optional {symbol_name: vjp_fn} gradients.
+
+    Returns a namespace object with one attribute per function."""
+    if not sources:
+        raise ValueError("load() needs at least one C++ source file")
+    if not functions:
+        raise ValueError(
+            "load() needs functions={symbol: n_inputs} naming the "
+            "extern \"C\" kernels to expose")
+    cdll = _compile(name, sources, extra_cflags, build_directory)
+
+    class _NS:
+        pass
+
+    ns = _NS()
+    for sym, n_in in functions.items():
+        kern = _CppKernel(cdll, sym, n_in, dtype=dtype)
+        if register:
+            op = register_custom_op(sym, kern,
+                                    vjp=(vjps or {}).get(sym),
+                                    dtypes=(np.dtype(dtype).name,))
+            setattr(ns, sym, op)
+        else:
+            setattr(ns, sym, kern)
+    return ns

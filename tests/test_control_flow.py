@@ -1,0 +1,165 @@
+"""static.nn control flow (cond/while_loop/case/switch_case) + to_static
+python-scalar specialization + the actionable trace-time branching error
+(reference dy2static transformers, jit/dy2static/program_translator.py:313)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+class TestCond:
+    def test_eager_both_branches(self):
+        x = paddle.to_tensor(np.float32(3.0))
+        hi = snn.cond(x > 2, lambda: x * 2, lambda: x - 1)
+        lo = snn.cond(x < 2, lambda: x * 2, lambda: x - 1)
+        assert float(hi) == 6.0 and float(lo) == 2.0
+
+    def test_inside_to_static(self):
+        @paddle.jit.to_static
+        def f(a):
+            return snn.cond(paddle.sum(a) > 0,
+                            lambda: a * 2, lambda: a * -1)
+
+        pos = np.ones((3,), np.float32)
+        neg = -np.ones((3,), np.float32)
+        np.testing.assert_allclose(f(paddle.to_tensor(pos)).numpy(), 2 * pos)
+        np.testing.assert_allclose(f(paddle.to_tensor(neg)).numpy(), pos)
+
+    def test_pytree_outputs(self):
+        x = paddle.to_tensor(np.float32(1.0))
+        out = snn.cond(x > 0, lambda: (x, x * 2), lambda: (x - 1, x))
+        assert float(out[0]) == 1.0 and float(out[1]) == 2.0
+
+    def test_nonscalar_pred_raises(self):
+        with pytest.raises(ValueError, match="scalar"):
+            snn.cond(paddle.to_tensor(np.ones((3,), np.float32)),
+                     lambda: 1, lambda: 2)
+
+    def test_grad_flows_through_taken_branch(self):
+        x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
+        # eager path: cond over concrete pred inside the autograd tape
+        y = snn.cond(x > 1, lambda: x * x * 3.0, lambda: x)
+        # cond returns a detached wrapper around raw lax.cond output in
+        # traced mode; eagerly the branch result is concrete — grads are
+        # checked through jax.grad on the traced form instead:
+        g = jax.grad(lambda v: jax.lax.cond(v > 1, lambda a: a * a * 3.0,
+                                            lambda a: a, v))(2.0)
+        assert g == 12.0
+        assert float(y) == 12.0
+
+
+class TestWhileLoop:
+    def test_eager_sum_to_ten(self):
+        i = paddle.to_tensor(np.int32(0))
+        s = paddle.to_tensor(np.float32(0.0))
+        i2, s2 = snn.while_loop(lambda i, s: i < 10,
+                                lambda i, s: (i + 1, s + 2.0), [i, s])
+        assert int(i2) == 10 and float(s2) == 20.0
+
+    def test_inside_to_static(self):
+        @paddle.jit.to_static
+        def f(x):
+            def c(it, v):
+                return it < 5
+
+            def b(it, v):
+                return it + 1, v * 1.5
+
+            it, v = snn.while_loop(c, b, [paddle.to_tensor(np.int32(0)), x])
+            return v
+
+        got = f(paddle.to_tensor(np.float32(1.0)))
+        np.testing.assert_allclose(float(got), 1.5 ** 5, rtol=1e-5)
+
+    def test_empty_vars_raises(self):
+        with pytest.raises(TypeError, match="non-empty"):
+            snn.while_loop(lambda: True, lambda: (), [])
+
+
+class TestCaseSwitch:
+    def test_case_first_match(self):
+        x = paddle.to_tensor(np.float32(5.0))
+        out = snn.case([(x < 3, lambda: x * 0), (x < 10, lambda: x * 2)],
+                       default=lambda: x * 3)
+        assert float(out) == 10.0
+
+    def test_case_default(self):
+        x = paddle.to_tensor(np.float32(50.0))
+        out = snn.case([(x < 3, lambda: x * 0), (x < 10, lambda: x * 2)],
+                       default=lambda: x * 3)
+        assert float(out) == 150.0
+
+    def test_switch_case_dict(self):
+        idx = paddle.to_tensor(np.int32(2))
+        out = snn.switch_case(idx, {1: lambda: paddle.to_tensor(10.0),
+                                    2: lambda: paddle.to_tensor(20.0)},
+                              default=lambda: paddle.to_tensor(-1.0))
+        assert float(out) == 20.0
+
+    def test_switch_case_negative_keys(self):
+        out = snn.switch_case(
+            paddle.to_tensor(np.int32(-1)),
+            {-1: lambda: paddle.to_tensor(111.0),
+             0: lambda: paddle.to_tensor(222.0)},
+            default=lambda: paddle.to_tensor(-9.0))
+        assert float(out) == 111.0
+
+    def test_switch_case_default_on_missing(self):
+        idx = paddle.to_tensor(np.int32(7))
+        out = snn.switch_case(idx, {1: lambda: paddle.to_tensor(10.0),
+                                    2: lambda: paddle.to_tensor(20.0)},
+                              default=lambda: paddle.to_tensor(-1.0))
+        assert float(out) == -1.0
+
+
+class TestTraceTimeErrors:
+    def test_python_if_over_tensor_raises_actionable(self):
+        @paddle.jit.to_static
+        def f(a):
+            if paddle.sum(a) > 0:  # data-dependent python branch
+                return a * 2
+            return a
+
+        with pytest.raises(TypeError, match="static.nn.cond"):
+            f(paddle.to_tensor(np.ones((3,), np.float32)))
+
+    def test_python_int_of_traced_tensor_raises(self):
+        @paddle.jit.to_static
+        def f(a):
+            return a.reshape([int(paddle.sum(a)), 1])
+
+        with pytest.raises(TypeError, match="python int"):
+            f(paddle.to_tensor(np.ones((4,), np.float32)))
+
+
+class TestPythonScalarSpecialization:
+    def test_int_arg_drives_shapes(self):
+        """dy2static parity: python ints are compile-time constants, so
+        they may drive shapes — each value gets its own program."""
+        calls = {"n": 0}
+
+        @paddle.jit.to_static
+        def f(a, k):
+            calls["n"] += 1  # traced once per (structure, static leaves)
+            return a.reshape([k, -1])
+
+        x = paddle.to_tensor(np.arange(12, dtype=np.float32))
+        assert tuple(f(x, 3).shape) == (3, 4)
+        assert tuple(f(x, 4).shape) == (4, 3)
+        assert tuple(f(x, 3).shape) == (3, 4)   # cached: no retrace
+        assert calls["n"] == 2
+
+    def test_string_mode_arg(self):
+        @paddle.jit.to_static
+        def f(a, mode):
+            if mode == "double":     # python branch over a STATIC python str
+                return a * 2
+            return a * 3
+
+        x = paddle.to_tensor(np.ones((2,), np.float32))
+        np.testing.assert_allclose(f(x, "double").numpy(), [2, 2])
+        np.testing.assert_allclose(f(x, "triple").numpy(), [3, 3])

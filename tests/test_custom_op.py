@@ -1,0 +1,248 @@
+"""Custom-kernel extension API (reference PD_BUILD_OP + cpp_extension;
+VERDICT r3 item 4): register a custom Pallas/JAX op with a user vjp, check
+numeric grad, use inside jit, sharded call on the 8-device mesh, and the
+C++ host-kernel load() path."""
+
+import math
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import (get_custom_op, load,
+                                            register_custom_op)
+
+
+def _registered(name):
+    from paddle_tpu.ops import registry
+    return name in registry.REGISTRY
+
+
+@pytest.fixture(scope="module")
+def swiglu_op():
+    """A fused swiglu custom op with a hand-written vjp, Pallas-backed on
+    TPU and jnp elsewhere (the shape a real extension kernel would take)."""
+    if _registered("custom_swiglu"):
+        return get_custom_op("custom_swiglu")
+
+    def fwd_impl(x, g):
+        return jax.nn.silu(g) * x
+
+    def vjp_impl(ct, x, g):
+        sig = jax.nn.sigmoid(g)
+        silu = g * sig
+        d_silu = sig + silu * (1 - sig)
+        return ct * silu, ct * x * d_silu
+
+    return register_custom_op(
+        "custom_swiglu", fwd_impl, vjp=vjp_impl, sharding="elementwise",
+        dtypes=("float32", "bfloat16"),
+        sample=lambda rng: ((rng.standard_normal((4, 8)).astype(np.float32),
+                             rng.standard_normal((4, 8)).astype(np.float32)),
+                            {}),
+        tol={"bfloat16": (1e-1, 1e-1)})
+
+
+class TestRegisterCustomOp:
+    def test_call_matches_reference(self, swiglu_op):
+        rng = np.random.default_rng(0)
+        x, g = (rng.standard_normal((4, 8)).astype(np.float32)
+                for _ in range(2))
+        out = paddle.custom_swiglu(paddle.to_tensor(x), paddle.to_tensor(g))
+        want = (g * (1 / (1 + np.exp(-g)))) * x
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+
+    def test_tensor_method_bound(self, swiglu_op):
+        rng = np.random.default_rng(1)
+        x = paddle.to_tensor(rng.standard_normal((3, 5)).astype(np.float32))
+        g = paddle.to_tensor(rng.standard_normal((3, 5)).astype(np.float32))
+        np.testing.assert_allclose(x.custom_swiglu(g).numpy(),
+                                   paddle.custom_swiglu(x, g).numpy())
+
+    def test_registered_in_op_table(self, swiglu_op):
+        assert _registered("custom_swiglu")
+
+    def test_user_vjp_matches_numeric_grad(self, swiglu_op):
+        rng = np.random.default_rng(2)
+        x0 = rng.standard_normal((4, 6)).astype(np.float32)
+        g0 = rng.standard_normal((4, 6)).astype(np.float32)
+        x = paddle.to_tensor(x0, stop_gradient=False)
+        g = paddle.to_tensor(g0, stop_gradient=False)
+        out = paddle.custom_swiglu(x, g)
+        loss = paddle.sum(out * out)
+        loss.backward()
+
+        def f(xa, ga):
+            s = (ga * (1 / (1 + np.exp(-ga)))) * xa
+            return (s * s).sum()
+
+        eps = 1e-3
+        for t, a0, other in ((x, x0, g0), (g, g0, x0)):
+            num = np.zeros_like(a0)
+            it = np.nditer(a0, flags=["multi_index"])
+            for _ in it:
+                i = it.multi_index
+                ap, am = a0.copy(), a0.copy()
+                ap[i] += eps
+                am[i] -= eps
+                if t is x:
+                    num[i] = (f(ap, g0) - f(am, g0)) / (2 * eps)
+                else:
+                    num[i] = (f(x0, ap) - f(x0, am)) / (2 * eps)
+            np.testing.assert_allclose(t.grad.numpy(), num, rtol=2e-2,
+                                       atol=2e-2)
+
+    def test_double_registration_raises(self, swiglu_op):
+        with pytest.raises(ValueError, match="already registered"):
+            register_custom_op("custom_swiglu", lambda x: x)
+
+    def test_collision_with_builtin_raises(self):
+        with pytest.raises(ValueError, match="collides"):
+            register_custom_op("matmul", lambda x: x)
+
+    def test_inside_jit(self, swiglu_op):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        g = rng.standard_normal((4, 8)).astype(np.float32)
+
+        @jax.jit
+        def step(a, b):
+            return swiglu_op.fn(a, b).sum()
+
+        got = float(step(x, g))
+        want = float(((g * (1 / (1 + np.exp(-g)))) * x).sum())
+        assert abs(got - want) < 1e-3
+
+    def test_sharded_call_preserves_layout(self, swiglu_op):
+        if len(jax.devices()) < 2:
+            pytest.skip("needs multi-device mesh")
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        mesh = Mesh(np.array(jax.devices()[:2]), ("x",))
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        g = rng.standard_normal((4, 8)).astype(np.float32)
+        sh = NamedSharding(mesh, P("x", None))
+        xs = paddle.to_tensor(jax.device_put(x, sh))
+        gs = paddle.to_tensor(jax.device_put(g, sh))
+        out = paddle.custom_swiglu(xs, gs)
+        want = (g * (1 / (1 + np.exp(-g)))) * x
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+        assert not out._data.sharding.is_fully_replicated, (
+            "elementwise custom op gathered its sharded input")
+
+    def test_pallas_backed_op_on_cpu_interpret(self):
+        """A REAL Pallas kernel as the custom-op impl (interpret mode works
+        on CPU; on TPU the same kernel compiles to Mosaic)."""
+        if _registered("pallas_double"):
+            op = get_custom_op("pallas_double")
+        else:
+            from jax.experimental import pallas as pl
+
+            def kernel(x_ref, o_ref):
+                o_ref[...] = x_ref[...] * 2.0
+
+            def pallas_double_impl(x):
+                return pl.pallas_call(
+                    kernel,
+                    out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    interpret=jax.default_backend() != "tpu")(x)
+
+            op = register_custom_op(
+                "pallas_double", pallas_double_impl,
+                vjp=lambda ct, x: (ct * 2.0,))
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32),
+                             stop_gradient=False)
+        out = paddle.pallas_double(x)
+        np.testing.assert_allclose(out.numpy(),
+                                   np.arange(8, dtype=np.float32) * 2)
+        paddle.sum(out).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(8, 2.0,
+                                                           np.float32))
+
+
+CPP_SRC = textwrap.dedent("""
+    #include <cstdint>
+    #include <cmath>
+    extern "C" void cpp_gelu(const float* in, float* out,
+                             const int64_t* shape, int64_t ndim) {
+        int64_t n = 1;
+        for (int64_t i = 0; i < ndim; ++i) n *= shape[i];
+        for (int64_t i = 0; i < n; ++i) {
+            float x = in[i];
+            out[i] = 0.5f * x * (1.0f + std::erf(x * 0.70710678f));
+        }
+    }
+    extern "C" void cpp_axpb(const float* a, const float* b, float* out,
+                             const int64_t* shape, int64_t ndim) {
+        int64_t n = 1;
+        for (int64_t i = 0; i < ndim; ++i) n *= shape[i];
+        for (int64_t i = 0; i < n; ++i) out[i] = 2.0f * a[i] + b[i];
+    }
+""")
+
+
+@pytest.fixture(scope="module")
+def cpp_ops(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "my_ops.cpp"
+    src.write_text(CPP_SRC)
+    return load("my_ops", sources=[str(src)],
+                functions={"cpp_gelu": 1, "cpp_axpb": 2},
+                build_directory=str(d),
+                vjps={"cpp_gelu": lambda ct, x: (
+                    ct * (0.5 * (1 + jax.scipy.special.erf(x / np.sqrt(2)))
+                          + x * jnp.exp(-x * x / 2) / np.sqrt(2 * np.pi)),)})
+
+
+class TestCppExtensionLoad:
+    def test_cpp_kernel_matches_python(self, cpp_ops):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        out = paddle.cpp_gelu(paddle.to_tensor(x))
+        want = 0.5 * x * (1 + np.vectorize(math.erf)(x * 0.70710678))
+        np.testing.assert_allclose(out.numpy(), want, rtol=1e-4, atol=1e-4)
+
+    def test_two_input_kernel(self, cpp_ops):
+        rng = np.random.default_rng(6)
+        a = rng.standard_normal((3, 4)).astype(np.float32)
+        b = rng.standard_normal((3, 4)).astype(np.float32)
+        out = paddle.cpp_axpb(paddle.to_tensor(a), paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), 2 * a + b, rtol=1e-6)
+
+    def test_cpp_kernel_under_jit(self, cpp_ops):
+        x = np.linspace(-2, 2, 16, dtype=np.float32)
+
+        @jax.jit
+        def f(v):
+            return get_custom_op("cpp_gelu").fn(v)
+
+        got = np.asarray(f(x))
+        want = 0.5 * x * (1 + np.vectorize(math.erf)(x * 0.70710678))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_cpp_kernel_grad_via_user_vjp(self, cpp_ops):
+        x = paddle.to_tensor(np.linspace(-1, 1, 8, dtype=np.float32),
+                             stop_gradient=False)
+        out = paddle.cpp_gelu(x)
+        paddle.sum(out).backward()
+        g = x.grad.numpy()
+        xs = np.linspace(-1, 1, 8, dtype=np.float32)
+        eps = 1e-3
+        gelu = lambda v: 0.5 * v * (1 + np.vectorize(math.erf)(
+            v * 0.70710678))
+        num = (gelu(xs + eps) - gelu(xs - eps)) / (2 * eps)
+        np.testing.assert_allclose(g, num, rtol=2e-2, atol=2e-2)
+
+    def test_missing_functions_arg_raises(self, tmp_path):
+        with pytest.raises(ValueError, match="functions"):
+            load("nope", sources=["x.cpp"])
+
+    def test_build_error_is_actionable(self, tmp_path):
+        bad = tmp_path / "bad.cpp"
+        bad.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            load("bad_ext", sources=[str(bad)], functions={"f": 1},
+                 build_directory=str(tmp_path))

@@ -255,6 +255,32 @@ class TestContextParallel:
             context_parallel_attention(q, k, v, mesh=mesh, causal=True,
                                        mask=jnp.ones((2, 1, S, S), bool))
 
+    def test_fully_masked_rows_agree_across_impls_and_encodings(self):
+        """Degenerate (fully-masked) rows return 0 — identically for bool
+        and additive (-1e9) masks, in both the ring and the local kernel
+        (ADVICE r3: three different behaviors previously)."""
+        import jax.numpy as jnp
+        from paddle_tpu.distributed.context_parallel import context_parallel_attention
+        from paddle_tpu.kernels import attention_reference
+        mesh = mesh_lib.make_mesh(sep=4)
+        q, k, v = self._data()
+        S = q.shape[1]
+        keep = jnp.ones((S, S), bool).at[5, :].set(False)  # row 5: no keys
+        add = jnp.where(keep, 0.0, -1e9).astype(jnp.float32)
+        outs = [attention_reference(q, k, v, mask=keep[None, None]),
+                attention_reference(q, k, v, mask=add[None, None]),
+                context_parallel_attention(q, k, v, mesh=mesh, impl="ring",
+                                           causal=False, mask=keep),
+                context_parallel_attention(q, k, v, mesh=mesh, impl="ring",
+                                           causal=False, mask=add)]
+        for i, o in enumerate(outs):
+            arr = np.asarray(o)
+            assert np.isfinite(arr).all(), f"impl {i} produced NaN"
+            np.testing.assert_allclose(arr[:, 5], 0.0, atol=1e-6,
+                                       err_msg=f"impl {i}")
+            np.testing.assert_allclose(arr, np.asarray(outs[0]), atol=2e-5,
+                                       err_msg=f"impl {i}")
+
     def test_mask_inside_enclosing_shard_map(self):
         """The manual-axes path takes LOCAL mask chunks — (S/n, S) rows for
         ring — and must not trip the global square-shape check."""
@@ -398,6 +424,30 @@ class TestPipelineParallel:
         cfg_pp = dataclasses.replace(cfg, mesh=mesh, pp_microbatches=2)
         pp = float(llama.loss_fn(params, batch, cfg_pp))
         np.testing.assert_allclose(pp, base, rtol=1e-5)
+
+    def test_seq_leaves_override(self):
+        """seq_leaves names the sequence leaves explicitly: a (B, C) soft
+        target stops being mis-sharded over the sep axis (ADVICE r3)."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models import llama
+        from paddle_tpu.models.llama import LlamaConfig
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        from paddle_tpu.optimizer.functional import AdamW
+        mesh = mesh_lib.make_mesh(sep=2, data=2)
+        cfg = LlamaConfig.tiny()
+        st = ShardedTrainState(cfg, llama, mesh, AdamW(learning_rate=1e-3),
+                               seq_leaves={"input_ids", "labels"})
+        batch = {
+            "input_ids": np.zeros((4, 32), np.int32),
+            "labels": np.zeros((4, 32), np.int32),
+            "soft_targets": np.zeros((4, 3), np.float32),  # dim1 != seq
+        }
+        sharded = st.shard_batch(batch)
+        spec_ids = sharded["input_ids"].sharding.spec
+        spec_soft = sharded["soft_targets"].sharding.spec
+        assert "sep" in str(spec_ids), spec_ids
+        assert "sep" not in str(spec_soft), spec_soft
 
     @pytest.mark.slow
     def test_train_step_4d_hybrid(self):

@@ -129,3 +129,136 @@ class TestServe:
             assert ei.value.code == 400 and b"expected 1" in ei.value.read()
         finally:
             srv.shutdown()
+
+    def test_concurrent_requests_are_batched(self, tmp_path):
+        """N concurrent single-row requests coalesce into shared compiled
+        runs (dynamic micro-batching): every response is row-correct and
+        at least one executed batch carries multiple requests."""
+        import threading
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = paddle.nn.Linear(4, 2)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        paddle.seed(1)
+        net = Net()
+        prefix = str(tmp_path / "batched")
+        paddle.jit.save(net, prefix, input_spec=[
+            paddle.static.InputSpec([4, 4], "float32", name="x")])
+        predictor = inference.create_predictor(inference.Config(prefix))
+        srv, _ = inference.serve(predictor, batch_wait_ms=50.0)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            rng = np.random.default_rng(3)
+            rows = rng.normal(size=(8, 1, 4)).astype(np.float32)
+            want = np.asarray(net(paddle.to_tensor(
+                rows.reshape(8, 4))).numpy())
+            results = [None] * 8
+            errs = []
+
+            def call(i):
+                try:
+                    req = urllib.request.Request(url, data=json.dumps(
+                        {"inputs": [rows[i].tolist()]}).encode())
+                    with urllib.request.urlopen(req, timeout=60) as resp:
+                        results[i] = np.asarray(
+                            json.loads(resp.read())["outputs"][0])
+                except Exception as e:  # noqa: BLE001
+                    errs.append(e)
+
+            ts = [threading.Thread(target=call, args=(i,)) for i in range(8)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=120)
+            assert not errs, errs
+            for i in range(8):
+                np.testing.assert_allclose(results[i][0], want[i],
+                                           rtol=1e-4, atol=1e-4)
+            log = srv._batcher.batch_log
+            assert any(e["requests"] > 1 for e in log), log
+            assert sum(e["requests"] for e in log) == 8
+        finally:
+            srv.shutdown()
+
+    def test_bad_row_shape_is_client_error_and_isolated(self, artifact):
+        """A request with wrong trailing dims gets a 400 and must not sink
+        co-batched well-formed requests."""
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        srv, _ = inference.serve(predictor, batch_wait_ms=40.0)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            import threading
+            codes = {}
+
+            def call(tag, arr):
+                req = urllib.request.Request(url, data=json.dumps(
+                    {"inputs": [arr.tolist()]}).encode())
+                try:
+                    with urllib.request.urlopen(req, timeout=60) as r:
+                        codes[tag] = r.status
+                except urllib.error.HTTPError as e:
+                    codes[tag] = e.code
+
+            good = x[:1]
+            bad = np.zeros((1, 5), np.float32)  # model expects (*, 8)
+            ts = [threading.Thread(target=call, args=("good", good)),
+                  threading.Thread(target=call, args=("bad", bad))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60)
+            assert codes["good"] == 200, codes
+            assert codes["bad"] == 400, codes
+        finally:
+            srv.shutdown()
+
+    def test_oversized_request_rejected_413(self, artifact):
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        srv, _ = inference.serve(predictor, max_body_bytes=64)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            big = urllib.request.Request(url, data=b"x" * 1024)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(big, timeout=30)
+            assert ei.value.code == 413
+        finally:
+            srv.shutdown()
+
+    def test_batch_larger_than_compiled_max_is_client_error(self, artifact):
+        prefix, x, want = artifact
+        predictor = inference.create_predictor(inference.Config(prefix))
+        srv, _ = inference.serve(predictor)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            too_big = np.zeros((5, 8), np.float32)  # compiled batch is 2
+            req = urllib.request.Request(url, data=json.dumps(
+                {"inputs": [too_big.tolist()]}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=30)
+            assert ei.value.code == 400
+            assert b"exceeds the compiled max batch" in ei.value.read()
+        finally:
+            srv.shutdown()
+
+
+class TestOptimCacheDir:
+    def test_persistent_cache_populated(self, artifact, tmp_path):
+        prefix, x, want = artifact
+        cache = tmp_path / "aot_cache"
+        cfg = inference.Config(prefix)
+        cfg.set_optim_cache_dir(str(cache))
+        predictor = inference.create_predictor(cfg)
+        (out,) = predictor.run([x])
+        np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+        import jax as _jax
+        # restore the global knob so later tests are unaffected
+        _jax.config.update("jax_compilation_cache_dir", None)
+        assert cache.exists() and any(cache.iterdir()), (
+            "persistent compile cache was not populated")
