@@ -1,0 +1,142 @@
+"""paddle.audio.backends — audio IO (reference
+python/paddle/audio/backends/{wave_backend.py:37,89,168,init_backend.py:37}).
+
+The built-in backend is the stdlib-`wave` PCM16 backend, exactly like the
+reference's default; `set_backend` accepts any registered backend module
+exposing info/load/save (the reference's paddleaudio hook becomes a plain
+registration here — no version sniffing needed)."""
+
+from __future__ import annotations
+
+import wave as _wave
+from dataclasses import dataclass
+from typing import List, Tuple, Union
+
+import numpy as np
+
+from ...tensor import Tensor, to_tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend", "register_backend"]
+
+
+@dataclass
+class AudioInfo:
+    """(reference backends/backend.py AudioInfo)"""
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+class _WaveBackend:
+    """PCM16 WAV via the stdlib wave module (wave_backend.py)."""
+
+    name = "wave_backend"
+
+    @staticmethod
+    def info(filepath) -> AudioInfo:
+        with _wave.open(str(filepath), "rb") as f:
+            return AudioInfo(sample_rate=f.getframerate(),
+                             num_samples=f.getnframes(),
+                             num_channels=f.getnchannels(),
+                             bits_per_sample=8 * f.getsampwidth(),
+                             encoding="PCM_S")
+
+    @staticmethod
+    def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+             normalize: bool = True, channels_first: bool = True
+             ) -> Tuple[Tensor, int]:
+        file_obj = filepath if hasattr(filepath, "read") else open(
+            str(filepath), "rb")
+        try:
+            f = _wave.open(file_obj)
+        except _wave.Error as e:
+            file_obj.close()
+            raise NotImplementedError(
+                f"only PCM16 WAV is supported by the wave backend ({e}); "
+                "register a richer backend via "
+                "paddle.audio.backends.register_backend") from e
+        channels = f.getnchannels()
+        sr = f.getframerate()
+        frames = f.getnframes()
+        content = f.readframes(frames)
+        file_obj.close()
+        arr = np.frombuffer(content, dtype=np.int16)
+        if normalize:
+            arr = arr.astype(np.float32) / 2.0 ** 15
+        wavef = arr.reshape(frames, channels)
+        if num_frames != -1:
+            wavef = wavef[frame_offset:frame_offset + num_frames, :]
+        elif frame_offset:
+            wavef = wavef[frame_offset:, :]
+        # normalize=False returns native int16 PCM (reference contract)
+        t = to_tensor(wavef)
+        if channels_first:
+            from ... import ops
+            t = ops.transpose(t, [1, 0])
+        return t, sr
+
+    @staticmethod
+    def save(filepath, src: Tensor, sample_rate: int,
+             channels_first: bool = True, encoding: str = "PCM_16",
+             bits_per_sample: int = 16):
+        if bits_per_sample != 16 or encoding != "PCM_16":
+            raise ValueError("wave backend writes PCM_16 only")
+        arr = np.asarray(src.numpy() if isinstance(src, Tensor) else src)
+        if arr.ndim == 1:
+            arr = arr[None, :]
+        if not channels_first:
+            arr = arr.T
+        if np.issubdtype(arr.dtype, np.floating):
+            arr = np.clip(arr, -1.0, 1.0)
+            arr = (arr * (2 ** 15 - 1)).astype(np.int16)
+        with _wave.open(str(filepath), "wb") as f:
+            f.setnchannels(arr.shape[0])
+            f.setsampwidth(2)
+            f.setframerate(int(sample_rate))
+            f.writeframes(arr.T.reshape(-1).tobytes())
+
+
+_BACKENDS = {"wave_backend": _WaveBackend}
+_CURRENT = "wave_backend"
+
+
+def register_backend(name: str, backend) -> None:
+    """Register a backend object exposing info/load/save."""
+    _BACKENDS[name] = backend
+
+
+def list_available_backends() -> List[str]:
+    return sorted(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    return _CURRENT
+
+
+def set_backend(backend_name: str):
+    global _CURRENT
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"backend {backend_name!r} is not registered; available: "
+            f"{list_available_backends()}")
+    _CURRENT = backend_name
+
+
+def info(filepath) -> AudioInfo:
+    return _BACKENDS[_CURRENT].info(filepath)
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True):
+    return _BACKENDS[_CURRENT].load(filepath, frame_offset, num_frames,
+                                    normalize, channels_first)
+
+
+def save(filepath, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: int = 16):
+    return _BACKENDS[_CURRENT].save(filepath, src, sample_rate,
+                                    channels_first, encoding,
+                                    bits_per_sample)

@@ -19,6 +19,7 @@ from ..tensor import Tensor, apply_op
 __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_min", "segment_max",
+    "sample_neighbors", "reindex_graph",
 ]
 
 
@@ -124,3 +125,99 @@ segment_sum = _segment_api("sum")
 segment_mean = _segment_api("mean")
 segment_min = _segment_api("min")
 segment_max = _segment_api("max")
+
+
+# ---------------------------------------------------------------------------
+# Graph sampling (reference geometric/sampling/neighbors.py:23,
+# geometric/reindex.py:25) — GNN data-pipeline ops.  Like the reference's
+# CPU kernels these run HOST-side (numpy): sampling produces ragged,
+# data-dependent shapes that have no business inside an XLA program; the
+# sampled subgraph then feeds the jit-ed message-passing ops above.
+# ---------------------------------------------------------------------------
+
+# stateful sampler RNG: PERSISTS across calls (each minibatch draws a fresh
+# subgraph) and re-seeds exactly when paddle.seed() changes the global seed
+_SAMPLER_RNG = [None, None]  # [seed_at_creation, np.random.Generator]
+
+
+def _sampler_rng():
+    import numpy as np
+    from .. import framework
+    seed = framework.default_generator().initial_seed()
+    if _SAMPLER_RNG[1] is None or _SAMPLER_RNG[0] != seed:
+        _SAMPLER_RNG[0] = seed
+        _SAMPLER_RNG[1] = np.random.default_rng(seed)
+    return _SAMPLER_RNG[1]
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1, eids=None,
+                     return_eids=False, perm_buffer=None, name=None):
+    """Sample up to `sample_size` neighbors per input node from a CSC graph
+    (reference geometric/sampling/neighbors.py:23).
+
+    row/colptr: CSC components; input_nodes: nodes to sample for.
+    Returns (out_neighbors, out_count) and out_eids when return_eids.
+    """
+    import numpy as np
+    from ..tensor import to_tensor
+    from .. import framework
+
+    rown = np.asarray(_raw(row)).reshape(-1)
+    cp = np.asarray(_raw(colptr)).reshape(-1)
+    nodes = np.asarray(_raw(input_nodes)).reshape(-1)
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    eidn = np.asarray(_raw(eids)).reshape(-1) if eids is not None else None
+    rng = _sampler_rng()
+    neigh, counts, out_eids = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(beg, end)
+        else:
+            idx = beg + rng.choice(deg, size=sample_size, replace=False)
+        neigh.append(rown[idx])
+        counts.append(len(idx))
+        if eidn is not None:
+            out_eids.append(eidn[idx])
+    out_n = to_tensor(np.concatenate(neigh) if neigh
+                      else np.zeros((0,), rown.dtype))
+    out_c = to_tensor(np.asarray(counts, np.int32))
+    if return_eids:
+        return out_n, out_c, to_tensor(
+            np.concatenate(out_eids) if out_eids
+            else np.zeros((0,), eidn.dtype))
+    return out_n, out_c
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Reindex sampled nodes to a dense 0..n-1 id space (reference
+    geometric/reindex.py:25).  Returns (reindex_src, reindex_dst,
+    out_nodes): out_nodes = input nodes then first-seen-order new
+    neighbors; reindex_src maps `neighbors`; reindex_dst repeats each input
+    node's new id `count` times."""
+    import numpy as np
+    from ..tensor import to_tensor
+
+    xs = np.asarray(_raw(x)).reshape(-1)
+    nb = np.asarray(_raw(neighbors)).reshape(-1)
+    ct = np.asarray(_raw(count)).reshape(-1)
+    if ct.sum() != nb.size:
+        raise ValueError(
+            f"count sums to {int(ct.sum())} but neighbors has {nb.size} "
+            "entries")
+    table = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    src = np.empty_like(nb)
+    for i, v in enumerate(nb):
+        j = table.get(int(v))
+        if j is None:
+            j = len(out_nodes)
+            table[int(v)] = j
+            out_nodes.append(v)
+        src[i] = j
+    dst = np.repeat(np.arange(xs.size), ct).astype(nb.dtype)
+    return (to_tensor(src), to_tensor(dst),
+            to_tensor(np.asarray(out_nodes, xs.dtype)))

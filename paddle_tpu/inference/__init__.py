@@ -289,6 +289,8 @@ class DynamicBatcher:
         item = {"arrays": arrays, "rows": rows,
                 "event": threading.Event(), "result": None, "error": None}
         with self._cv:
+            if self._stop:
+                raise RuntimeError("server is shutting down")
             self._queue.append(item)
             self._cv.notify()
         item["event"].wait()
@@ -425,8 +427,11 @@ def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0,
         _orig_shutdown = srv.shutdown
 
         def _shutdown():
-            batcher.shutdown()
+            # HTTP loop first: no new submissions can arrive once it stops,
+            # so the batcher drains cleanly (reverse order could strand a
+            # late submit() waiting on an event nobody will set)
             _orig_shutdown()
+            batcher.shutdown()
 
         srv.shutdown = _shutdown
     t = threading.Thread(target=srv.serve_forever, daemon=True)

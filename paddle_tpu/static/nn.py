@@ -48,14 +48,20 @@ def _unwrap_tree(vals):
 def cond(pred, true_fn: Callable, false_fn: Callable, name=None,
          return_names=None):
     """Run `true_fn()` or `false_fn()` depending on scalar boolean `pred`
-    (reference python/paddle/static/nn/control_flow.py:934).  Both branches
-    must return the same structure/shapes/dtypes (checked by lax.cond)."""
+    (reference python/paddle/static/nn/control_flow.py:934).
+
+    Eager (concrete pred): the taken branch executes DIRECTLY, so eager
+    autograd flows through its ops unchanged — matching the reference's
+    dygraph cond.  Traced (to_static/jit): lowers to lax.cond; both
+    branches must return matching structures/shapes/dtypes."""
     p = _unwrap(pred)
     p = jnp.asarray(p)
     if p.size != 1:
         raise ValueError(
             f"cond() pred must be a scalar boolean, got shape {p.shape}")
     p = p.reshape(()).astype(jnp.bool_)
+    if not isinstance(p, jax.core.Tracer):
+        return true_fn() if bool(p) else false_fn()
 
     def tb(_):
         return _unwrap_tree(true_fn())
@@ -76,6 +82,15 @@ def while_loop(cond_fn: Callable, body_fn: Callable, loop_vars: Sequence,
     if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
         raise TypeError("while_loop loop_vars must be a non-empty list")
     init = tuple(_unwrap_tree(v) for v in loop_vars)
+    # eager (all concrete): run a python loop over the user fns directly so
+    # the eager tape records every body op (reference dygraph while_loop)
+    if not any(isinstance(r, jax.core.Tracer)
+               for r in jax.tree_util.tree_leaves(init)):
+        vars_now = list(loop_vars)
+        while bool(jnp.asarray(_unwrap(cond_fn(*vars_now))).reshape(())):
+            out = body_fn(*vars_now)
+            vars_now = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_now
 
     def c(vs):
         out = cond_fn(*_wrap_tree(vs))
@@ -128,21 +143,21 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
         items = sorted((int(k), f) for k, f in branch_fns)
     else:
         items = list(enumerate(branch_fns))
-    keys = [k for k, _ in items]
     fns = [f for _, f in items]
     if default is None:
         default = fns[-1]
+    if not isinstance(idx, jax.core.Tracer):
+        # eager: dispatch directly (tape flows through the taken branch)
+        fn = dict(items).get(int(idx), default)
+        return fn()
 
-    # map arbitrary integer keys (negative included) onto dense switch
-    # indices via an offset table; unknown keys -> default
-    lo, hi = min(keys), max(keys)
-    table = {k: i for i, (k, _) in enumerate(items)}
+    # traced: O(len(keys)) scalar compare chain selects the dense branch
+    # index (arbitrary — negative, sparse — integer keys; no O(range)
+    # lookup table)
     branches = [lambda _, f=f: _unwrap_tree(f()) for f in fns]
     branches.append(lambda _: _unwrap_tree(default()))
-    dense = jnp.full((hi - lo + 1,), len(fns), jnp.int32)
-    for k, i in table.items():
-        dense = dense.at[k - lo].set(i)
-    safe = jnp.clip(idx - lo, 0, hi - lo)
-    sel = jnp.where((idx >= lo) & (idx <= hi), dense[safe], len(fns))
+    sel = jnp.full((), len(fns), jnp.int32)
+    for i, (k, _) in enumerate(items):
+        sel = jnp.where(idx == k, jnp.int32(i), sel)
     out = jax.lax.switch(sel, branches, None)
     return _wrap_tree(out)

@@ -161,6 +161,18 @@ class Tensor:
     def tolist(self):
         return np.asarray(self._data).tolist()
 
+    def set_value(self, value):
+        """In-place value assignment (reference Tensor.set_value,
+        python/paddle/tensor/manipulation.py): shape must match; dtype is
+        preserved."""
+        raw = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(raw.shape) != tuple(self._data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: tensor is "
+                f"{tuple(self._data.shape)}, value is {tuple(raw.shape)}")
+        self._data = raw.astype(self._data.dtype)
+        return self
+
     def detach(self):
         t = Tensor(self._data, stop_gradient=True, name=self.name + ".detach")
         return t

@@ -14,7 +14,9 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor, to_tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+
+from . import datasets  # noqa: E402,F401
 
 
 def _raw(x):

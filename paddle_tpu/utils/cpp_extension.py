@@ -118,17 +118,17 @@ def register_custom_op(name: str, fn: Optional[Callable] = None, *,
         from ..ops import registry
         from ..tensor import Tensor
 
-        if name in _CUSTOM_OPS:
-            raise ValueError(f"custom op '{name}' already registered")
-        if hasattr(_pt, name):
-            raise ValueError(
-                f"custom op '{name}' collides with an existing "
-                f"paddle_tpu attribute")
         op = CustomOp(name, f, vjp, nondiff=nondiff)
-        with _LOCK:
-            _CUSTOM_OPS[name] = op
+        with _LOCK:  # checks AND mutations under one lock, registry first
+            if name in _CUSTOM_OPS:
+                raise ValueError(f"custom op '{name}' already registered")
+            if hasattr(_pt, name):
+                raise ValueError(
+                    f"custom op '{name}' collides with an existing "
+                    f"paddle_tpu attribute")
             registry.register(name, dtypes=dtypes, has_vjp=True,
                               sample=sample, tol=tol, sharding=sharding)
+            _CUSTOM_OPS[name] = op
             setattr(_pt, name, op)
             if bind_tensor_method and not hasattr(Tensor, name):
                 setattr(Tensor, name, lambda self, *a, **k: op(self, *a, **k))

@@ -40,16 +40,23 @@ class TestCond:
                      lambda: 1, lambda: 2)
 
     def test_grad_flows_through_taken_branch(self):
+        """Eager cond executes the taken branch directly, so the autograd
+        tape records its ops: d(3x^2)/dx at 2 = 12."""
         x = paddle.to_tensor(np.float32(2.0), stop_gradient=False)
-        # eager path: cond over concrete pred inside the autograd tape
         y = snn.cond(x > 1, lambda: x * x * 3.0, lambda: x)
-        # cond returns a detached wrapper around raw lax.cond output in
-        # traced mode; eagerly the branch result is concrete — grads are
-        # checked through jax.grad on the traced form instead:
-        g = jax.grad(lambda v: jax.lax.cond(v > 1, lambda a: a * a * 3.0,
-                                            lambda a: a, v))(2.0)
-        assert g == 12.0
         assert float(y) == 12.0
+        y.backward()
+        assert float(x.grad) == pytest.approx(12.0)
+
+    def test_grad_flows_through_eager_while_loop(self):
+        x = paddle.to_tensor(np.float32(1.5), stop_gradient=False)
+        i, v = snn.while_loop(lambda i, v: i < 3,
+                              lambda i, v: (i + 1, v * x),
+                              [paddle.to_tensor(np.int32(0)),
+                               paddle.to_tensor(np.float32(1.0))])
+        # v = x^3 -> dv/dx = 3 x^2 = 6.75
+        v.backward()
+        assert float(x.grad) == pytest.approx(3 * 1.5 ** 2, rel=1e-5)
 
 
 class TestWhileLoop:

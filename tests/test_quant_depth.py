@@ -1,0 +1,145 @@
+"""Quantization depth (VERDICT r3 weak #2 / next #7): per-channel +
+moving-average observers, QuantedConv2D, and the weight-only-int8 path
+consumed by inference.Predictor."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, FakeQuanterChannelWiseAbsMax,
+    FakeQuanterWithAbsMaxObserver, MovingAverageAbsmaxObserver,
+    PerChannelAbsmaxObserver, QuantConfig, QuantedConv2D, QuantedLinear)
+
+
+class ConvNet(paddle.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = paddle.nn.Conv2D(3, 8, 3, padding=1)
+        self.fc = paddle.nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.conv(x))
+        h = paddle.nn.functional.adaptive_avg_pool2d(h, 4)
+        return self.fc(paddle.flatten(h, 1))
+
+
+def _x(b=2):
+    return np.random.default_rng(0).normal(size=(b, 3, 8, 8)).astype(
+        np.float32) * 0.5
+
+
+class TestObservers:
+    def test_per_channel_scales_shape(self):
+        obs = PerChannelAbsmaxObserver(quant_axis=0)
+        w = np.zeros((4, 3), np.float32)
+        w[1] = 10.0  # one outlier channel
+        w[2] = 0.1
+        obs(paddle.to_tensor(w))
+        s = obs.scales().numpy().reshape(-1)
+        assert s.shape == (4,)
+        assert s[1] == pytest.approx(10.0) and s[2] == pytest.approx(0.1)
+
+    def test_per_channel_running_max(self):
+        obs = PerChannelAbsmaxObserver(quant_axis=0)
+        obs(paddle.to_tensor(np.array([[1.0], [5.0]], np.float32)))
+        obs(paddle.to_tensor(np.array([[3.0], [2.0]], np.float32)))
+        s = obs.scales().numpy().reshape(-1)
+        np.testing.assert_allclose(s, [3.0, 5.0])
+
+    def test_moving_average_observer_smooths_outlier(self):
+        obs = MovingAverageAbsmaxObserver(moving_rate=0.9)
+        for _ in range(5):
+            obs(paddle.to_tensor(np.ones((4,), np.float32)))
+        steady = float(obs.scales().numpy())
+        obs(paddle.to_tensor(100 * np.ones((4,), np.float32)))
+        after = float(obs.scales().numpy())
+        assert after < 100 * 0.2, "EMA should damp a single outlier batch"
+        assert after > steady
+
+
+class TestQuantedConv2D:
+    def test_qat_swaps_conv_and_linear(self):
+        net = ConvNet()
+        q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                        weight=FakeQuanterChannelWiseAbsMax)
+        qnet = QAT(q).quantize(net)
+        assert isinstance(qnet.conv, QuantedConv2D)
+        assert isinstance(qnet.fc, QuantedLinear)
+
+    def test_qat_forward_close_and_trainable(self):
+        paddle.seed(3)
+        net = ConvNet()
+        x = paddle.to_tensor(_x())
+        ref = net(x).numpy()
+        q = QuantConfig(activation=FakeQuanterWithAbsMaxObserver,
+                        weight=FakeQuanterChannelWiseAbsMax)
+        qnet = QAT(q).quantize(net)
+        out = qnet(x)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=0.25, atol=0.25)
+        # STE: grads flow to the original weights
+        loss = paddle.sum(out * out)
+        loss.backward()
+        assert qnet.conv.weight.grad is not None
+        assert np.isfinite(qnet.conv.weight.grad.numpy()).all()
+
+    def test_per_channel_beats_per_tensor_with_outlier_channel(self):
+        """The motivating case: one huge output channel destroys per-tensor
+        int8 resolution for the small channels."""
+        paddle.seed(4)
+        lin = paddle.nn.Linear(16, 8)
+        w = lin.weight.numpy().copy()
+        w[:, 0] *= 100.0  # outlier output channel
+        lin.weight.set_value(w)
+        x = paddle.to_tensor(np.random.default_rng(5).normal(
+            size=(4, 16)).astype(np.float32))
+        ref = lin(x).numpy()
+
+        def err(weight_quanter):
+            q = QuantConfig(activation=None, weight=weight_quanter)
+            qnet = QAT(q).quantize(lin)
+            got = qnet(x).numpy()
+            # compare on the small channels (1..7)
+            return np.abs(got[:, 1:] - ref[:, 1:]).max()
+
+        e_tensor = err(FakeQuanterWithAbsMaxObserver)
+        e_channel = err(lambda: FakeQuanterChannelWiseAbsMax(quant_axis=-1))
+        assert e_channel < e_tensor / 4, (e_channel, e_tensor)
+
+
+class TestPTQToPredictor:
+    def test_ptq_convert_serve_parity(self, tmp_path):
+        """The full weight-only-int8 deployment path: PTQ calibrate ->
+        convert (int8 weights + per-channel scales) -> jit.save ->
+        Predictor -> parity within int8 tolerance."""
+        paddle.seed(6)
+        net = ConvNet()
+        xs = [_x() for _ in range(4)]
+        ref = net(paddle.to_tensor(xs[0])).numpy()
+
+        cfg = QuantConfig(activation=MovingAverageAbsmaxObserver,
+                          weight=lambda: PerChannelAbsmaxObserver(
+                              quant_axis=0))
+        ptq = PTQ(cfg)
+        qnet = ptq.quantize(net)
+        for x in xs:  # calibration passes
+            qnet(paddle.to_tensor(x))
+        deployed = ptq.convert(qnet)
+
+        # int8 weights actually stored
+        assert deployed.conv.w_int8.numpy().dtype == np.int8
+        assert deployed.fc.w_int8.numpy().dtype == np.int8
+        # per-channel conv scales: one per output channel
+        assert deployed.conv.weight_scale.numpy().size == 8
+
+        out = deployed(paddle.to_tensor(xs[0])).numpy()
+        np.testing.assert_allclose(out, ref, rtol=0.1, atol=0.1)
+
+        prefix = str(tmp_path / "q_net")
+        paddle.jit.save(deployed, prefix, input_spec=[
+            paddle.static.InputSpec([2, 3, 8, 8], "float32", name="x")])
+        pred = inference.create_predictor(inference.Config(prefix))
+        (served,) = pred.run([xs[0]])
+        np.testing.assert_allclose(served, out, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(served, ref, rtol=0.1, atol=0.1)

