@@ -193,7 +193,18 @@ class AutoTuner:
                 uniq.append(p)
         uniq = uniq[:max(top_k, 1)]
         if measure is not None:  # trial-run refinement, reference-style
-            timed = [(measure(p), p) for p in uniq]
+            timed, errors = [], []
+            for p in uniq:
+                try:
+                    timed.append((measure(p), p))
+                except Exception as e:  # noqa: BLE001 — a failed trial
+                    # prunes its candidate (reference behavior), it must
+                    # not sink the plans that measured fine
+                    errors.append((p, e))
+            if not timed:
+                raise RuntimeError(
+                    "every trial-run candidate failed; first error: "
+                    f"{errors[0][1]!r}") from errors[0][1]
             timed.sort(key=lambda tp_: tp_[0])
             for t, p in timed:
                 p.step_time = t
@@ -224,7 +235,8 @@ def _thread_pp_plan(config, best: "Plan", global_batch: int, seq: int,
         reserved = best.mem_bytes - best.breakdown.get("mem_act", 0.0)
         schedule, _ = pick_pp_schedule(config, best.pipe,
                                        best.micro_batches, seq, mb_seqs,
-                                       chip, reserved_bytes=reserved)
+                                       chip, reserved_bytes=reserved,
+                                       sp=best.sep)
         config = _dc.replace(config, pp_schedule=schedule)
     return config
 
@@ -290,7 +302,7 @@ def tune_with_trials(config, model, n_chips: int, global_batch: int,
 
 def pick_pp_schedule(config, pp: int, micro_batches: int, seq: int,
                      mb_seqs: int, chip: "ChipSpec" = V5E,
-                     reserved_bytes: Optional[float] = None):
+                     reserved_bytes: Optional[float] = None, sp: int = 1):
     """Analytic GPipe-by-AD vs recompute-1F1B default per (S, L, P, M)
     (VERDICT r3 weak #5; the tradeoff distributed/pipeline.py documents).
 
@@ -302,15 +314,16 @@ def pick_pp_schedule(config, pp: int, micro_batches: int, seq: int,
 
     `reserved_bytes`: the plan's non-activation memory (params + optimizer
     + grads) — the stash budget is what remains of HBM after it; without it
-    a flat half-HBM budget is assumed.
+    a flat half-HBM budget is assumed.  `sp`: live sep-axis size (the
+    sequence is S//sp per shard).  Activations are priced at 4 B/element,
+    the SAME accounting CostModel.estimate validated the plan's HBM fit
+    with — a cheaper dtype here could approve a gpipe stash the fit check
+    never covered.
 
     Returns (schedule, details) with the stash estimates so callers can
     log the decision."""
-    import numpy as np
-
     E = config.hidden_size
-    itemsize = np.dtype(config.dtype).itemsize
-    act = mb_seqs * seq * E * itemsize          # stage boundary act / microbatch
+    act = mb_seqs * (seq // max(sp, 1)) * E * 4.0  # boundary act / microbatch
     resid = 2 * act                             # live remat residuals
     gpipe_stash = micro_batches * act + resid
     f1b_stash = pp * act + resid

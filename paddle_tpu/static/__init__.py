@@ -35,10 +35,13 @@ __all__ = [
     "save", "load", "save_inference_model", "load_inference_model",
     "serialize_program", "deserialize_program", "cpu_places", "cuda_places",
     "xpu_places", "global_scope", "scope_guard", "Scope", "nn",
+    "passes", "apply_pass",
 ]
 
 from ..jit import InputSpec  # noqa: E402  (same spec type as jit)
 from . import nn  # noqa: E402  (cond/while_loop/case/switch_case)
+from . import passes  # noqa: E402  (DCE/fold/fuse over recorded programs)
+from .passes import apply_pass  # noqa: E402
 
 
 class _OpRecord:
@@ -62,6 +65,7 @@ class Program:
         self._train: Optional[Tuple[Any, Tensor]] = None  # (optimizer, loss)
         self.random_seed = None
         self._cache: Dict[Any, Any] = {}
+        self._removed_outputs: set = set()   # op outputs deleted by passes
 
     # -- capture hook (called from tensor.apply_op) ------------------------
     def _record(self, name, fn, args, kwargs, outs):
@@ -125,6 +129,7 @@ class Program:
         p._train = None if for_test else self._train
         p.random_seed = self.random_seed
         p._cache = {}
+        p._removed_outputs = set(getattr(self, "_removed_outputs", ()))
         return p
 
     def all_parameters(self):
@@ -151,6 +156,11 @@ class Program:
     def list_vars(self):
         return list(self.placeholders.values()) + [
             o for op in self.ops for o in op.outs]
+
+    def apply_pass(self, names, fetch_list=None):
+        """Return a transformed clone (static.passes: DCE/fold/fuse)."""
+        from .passes import apply_pass as _apply
+        return _apply(self, names, fetch_list=fetch_list)
 
 
 _default_main = Program()
@@ -259,6 +269,12 @@ class Executor:
         if not isinstance(fetch_list, (list, tuple)):
             fetch_list = [fetch_list]
         fetch_list = [self._resolve(program, f) for f in fetch_list]
+        removed = getattr(program, "_removed_outputs", ())
+        for f in fetch_list:
+            if id(f) in removed:
+                raise KeyError(
+                    f"fetch target {getattr(f, 'name', f)!r} was removed by "
+                    "a graph pass (re-run apply_pass with it in fetch_list)")
         # startup/empty programs: nothing to do (params init eagerly)
         if not program.ops and not fetch_list:
             return []

@@ -1,0 +1,207 @@
+"""Graph passes over record-replay Programs (SURVEY C14 depth).
+
+Reference analog: the IR pass pipeline (`paddle/fluid/framework/ir/*_pass.cc`,
+applied via build_strategy / `paddle.static.apply_build_strategy`) — ~274
+passes doing fusion/DCE/folding on ProgramDesc graphs.  Under XLA the heavy
+rewriting (fusion, layout, CSE) happens in the compiler, so the pass story
+shrinks to what still pays off at the RECORD level:
+
+  * dead_code_elimination — ops whose outputs never reach a fetch target
+    are dropped (fewer records to trace, and a cloned-for-test program
+    sheds its training-only tail);
+  * constant_folding — ops with no transitive placeholder/parameter
+    dependency are dropped outright: their captured output values (the
+    eager values observed at record time) ARE the constants, and replay's
+    environment falls back to them automatically;
+  * fuse_elementwise — chains of single-consumer records merge into one
+    record (one python dispatch + one closure at trace time instead of N;
+    XLA would fuse the math anyway — this trims record/trace overhead).
+
+Passes are registered by name (`register_pass`) and applied with
+`apply_pass(program, names, fetch_list=...)` or
+`Program.apply_pass(...)`; they return a TRANSFORMED CLONE (the input
+program is untouched), mirroring the reference's pass immutability.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["register_pass", "apply_pass", "list_passes"]
+
+PASS_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_pass(name: str):
+    """Register a pass.  The registered callable CLONES its input, applies
+    the transform, records removed output ids (so a later fetch of a
+    removed tensor errors instead of returning a stale sample value), and
+    clears the clone's compile cache — direct calls are as safe as
+    apply_pass."""
+    def deco(fn):
+        def wrapped(program, fetch_list=None):
+            out = program.clone()
+            before = {id(o) for op in out.ops for o in op.outs}
+            res = fn(out, fetch_list=fetch_list) or out
+            after = {id(o) for op in res.ops for o in op.outs}
+            res._removed_outputs = (
+                getattr(program, "_removed_outputs", set())
+                | (before - after))
+            res._cache.clear()
+            return res
+        wrapped.__name__ = fn.__name__
+        wrapped.__doc__ = fn.__doc__
+        PASS_REGISTRY[name] = wrapped
+        return wrapped
+    return deco
+
+
+def list_passes() -> List[str]:
+    return sorted(PASS_REGISTRY)
+
+
+def apply_pass(program, names, fetch_list: Optional[Sequence] = None):
+    """Apply one pass (or a list, in order); returns a transformed clone."""
+    if isinstance(names, str):
+        names = [names]
+    out = program
+    for n in names:
+        if n not in PASS_REGISTRY:
+            raise ValueError(
+                f"unknown pass {n!r}; available: {list_passes()}")
+        out = PASS_REGISTRY[n](out, fetch_list=fetch_list)
+    return out
+
+
+def _target_ids(program, fetch_list):
+    """Ids of tensors that must stay computable.  String entries resolve
+    by tensor name (the same names Executor.run accepts); an unresolvable
+    name raises rather than silently making EVERY op dead."""
+    ids = set()
+    if fetch_list:
+        by_name = {getattr(t, "name", None): t for t in program.list_vars()}
+        for f in fetch_list:
+            if isinstance(f, str):
+                t = by_name.get(f)
+                if t is None:
+                    raise ValueError(
+                        f"fetch target {f!r} not found in program")
+                ids.add(id(t))
+            else:
+                ids.add(id(f))
+    if program._train is not None:
+        ids.add(id(program._train[1]))           # the loss
+    if not ids and program.ops:
+        ids |= {id(o) for o in program.ops[-1].outs}
+    return ids
+
+
+@register_pass("dead_code_elimination")
+def dead_code_elimination(program, fetch_list=None):
+    """Drop ops whose outputs never reach a fetch target (reference
+    ir/graph passes' DCE; here a reverse liveness sweep over records)."""
+    live = _target_ids(program, fetch_list)
+    kept = []
+    for op in reversed(program.ops):
+        if any(id(o) in live for o in op.outs):
+            kept.append(op)
+            for kind, v in op.arg_specs:
+                if kind == "v":
+                    live.add(id(v))
+    program.ops = list(reversed(kept))
+    return program
+
+
+@register_pass("constant_folding")
+def constant_folding(program, fetch_list=None):
+    """Drop ops with no transitive placeholder/parameter dependency: the
+    output tensors already carry their record-time values, which replay's
+    value environment falls back to — i.e. the fold result is the captured
+    constant (reference constant_folding_pass.cc, without re-execution)."""
+    ph = {id(t) for t in program.placeholders.values()}
+    produced = {id(o) for op in program.ops for o in op.outs}
+    variable = set(ph)                            # grows with kept ops' outs
+
+    def is_variable(spec):
+        kind, v = spec
+        if kind != "v":
+            return False
+        i = id(v)
+        if i in variable:
+            return True
+        if i in produced:
+            return False     # produced by a FOLDED op: captured constant
+        # external tensor: parameters and registered buffers carry
+        # persistable=True (the reference pass likewise only folds
+        # non-persistable vars) and may change between replays; plain
+        # captured tensors (to_tensor/full results) are frozen constants
+        return bool(getattr(v, "persistable", False))
+
+    kept = []
+    for op in program.ops:
+        if any(is_variable(s) for s in op.arg_specs):
+            kept.append(op)
+            variable.update(id(o) for o in op.outs)
+    program.ops = kept
+    return program
+
+
+@register_pass("fuse_elementwise")
+def fuse_elementwise(program, fetch_list=None):
+    """Merge A->B record chains where A has one output consumed ONLY by B
+    (and A's output is not itself a fetch target) into a single record
+    whose fn composes the two closures."""
+    targets = _target_ids(program, fetch_list)
+    ops = list(program.ops)
+
+    def consumers(tid):
+        return [j for j, op in enumerate(ops) if op is not None
+                and any(k == "v" and id(v) == tid for k, v in op.arg_specs)]
+
+    # one backward sweep: fusing op[i] into its (later) single consumer
+    # leaves indices > i already-final, so no global restart is needed —
+    # O(n^2) worst case from the consumer lookups, not O(n^3)
+    for i in range(len(ops) - 2, -1, -1):
+        a = ops[i]
+        if a is None or len(a.outs) != 1:
+            continue
+        out_id = id(a.outs[0])
+        if out_id in targets:
+            continue
+        cons = consumers(out_id)
+        if len(cons) != 1 or cons[0] <= i:
+            continue
+        fused = _fuse_pair(a, ops[cons[0]], out_id)
+        if fused is None:
+            continue
+        ops[cons[0]] = fused
+        ops[i] = None
+    program.ops = [op for op in ops if op is not None]
+    return program
+
+
+def _fuse_pair(a, b, a_out_id):
+    """One record computing b(fn... a(...) ...): arg list = a's args + b's
+    non-a args, positions rewired inside the closure."""
+    from . import _OpRecord
+
+    n_a = len(a.arg_specs)
+    b_map = []                                   # per b-arg: ("a",) | index
+    fused_specs = list(a.arg_specs)
+    for kind, v in b.arg_specs:
+        if kind == "v" and id(v) == a_out_id:
+            b_map.append(("a",))
+        else:
+            b_map.append(("i", len(fused_specs)))
+            fused_specs.append((kind, v))
+
+    a_fn, a_kwargs, b_fn, b_kwargs = a.fn, a.kwargs, b.fn, b.kwargs
+
+    def fused_fn(*raws):
+        a_out = a_fn(*raws[:n_a], **a_kwargs)
+        if isinstance(a_out, (tuple, list)):
+            a_out = a_out[0]
+        b_args = [a_out if m[0] == "a" else raws[m[1]] for m in b_map]
+        return b_fn(*b_args, **b_kwargs)
+
+    return _OpRecord(f"{a.name}+{b.name}", fused_fn, fused_specs, {}, b.outs)

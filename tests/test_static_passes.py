@@ -1,0 +1,180 @@
+"""Graph passes over recorded Programs (C14 depth: the reference IR-pass
+pipeline's record-level remainder — DCE / constant folding / fusion)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+def _build(with_dead=True, with_const=True):
+    """x -> relu -> *2 (fetch); plus a dead branch and a const subexpr."""
+    prog = static.Program()
+    with static.program_guard(prog):
+        x = static.data("x", [4, 8], "float32")
+        h = paddle.nn.functional.relu(x)
+        out = h * 2.0
+        if with_dead:
+            dead = paddle.exp(x) + 1.0          # never fetched
+        if with_const:
+            c = paddle.full([4, 8], 3.0) * 2.0  # creation-rooted const chain
+            out = out + c
+    return prog, out
+
+
+class TestDCE:
+    def test_drops_dead_branch_and_replays_identically(self):
+        prog, out = _build()
+        n0 = len(prog.ops)
+        opt = prog.apply_pass("dead_code_elimination", fetch_list=[out])
+        assert len(opt.ops) < n0
+        assert len(prog.ops) == n0              # input program untouched
+        exe = static.Executor()
+        x = np.random.default_rng(0).normal(size=(4, 8)).astype(np.float32)
+        want = exe.run(prog, feed={"x": x}, fetch_list=[out])[0]
+        got = exe.run(opt, feed={"x": x}, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        names = [op.name for op in opt.ops]
+        assert "exp" not in names               # the dead branch is gone
+
+    def test_unknown_pass_raises(self):
+        prog, out = _build()
+        with pytest.raises(ValueError, match="unknown pass"):
+            prog.apply_pass("fuse_everything")
+
+    def test_string_fetch_resolves_by_name(self):
+        prog, out = _build()
+        n0 = len(prog.ops)
+        opt = prog.apply_pass("dead_code_elimination",
+                              fetch_list=[out.name])
+        assert 0 < len(opt.ops) < n0
+
+    def test_unknown_string_fetch_raises(self):
+        prog, out = _build()
+        with pytest.raises(ValueError, match="not found"):
+            prog.apply_pass("dead_code_elimination",
+                            fetch_list=["no_such_tensor"])
+
+    def test_fetching_removed_tensor_raises(self):
+        """A tensor whose producer a pass deleted must ERROR at fetch, not
+        silently return its record-time sample value."""
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            h = paddle.exp(x)
+            out = paddle.tanh(h + 1.0)
+        opt = prog.apply_pass("fuse_elementwise", fetch_list=[out])
+        exe = static.Executor()
+        xv = np.random.default_rng(7).normal(size=(4, 8)).astype(np.float32)
+        exe.run(opt, feed={"x": xv}, fetch_list=[out])  # fine
+        with pytest.raises(KeyError, match="removed by a graph pass"):
+            exe.run(opt, feed={"x": xv}, fetch_list=[h])
+
+    def test_direct_pass_call_does_not_mutate_input(self):
+        from paddle_tpu.static.passes import dead_code_elimination
+        prog, out = _build()
+        n0 = len(prog.ops)
+        pruned = dead_code_elimination(prog, fetch_list=[out])
+        assert len(prog.ops) == n0 and len(pruned.ops) < n0
+
+
+class TestConstantFolding:
+    def test_placeholder_free_ops_fold_away(self):
+        prog, out = _build(with_dead=False, with_const=True)
+        opt = prog.apply_pass("constant_folding", fetch_list=[out])
+        # the const-chain multiply folded (full itself is not a record);
+        # the ops touching x stayed
+        assert len(opt.ops) == len(prog.ops) - 1
+        exe = static.Executor()
+        x = np.random.default_rng(1).normal(size=(4, 8)).astype(np.float32)
+        want = exe.run(prog, feed={"x": x}, fetch_list=[out])[0]
+        got = exe.run(opt, feed={"x": x}, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_param_dependent_ops_do_not_fold(self):
+        """Ops reading externals (parameters may change between replays)
+        must survive folding."""
+        prog = static.Program()
+        lin = paddle.nn.Linear(8, 4)
+        with static.program_guard(prog):
+            x = static.data("x", [2, 8], "float32")
+            out = lin(x)
+        opt = prog.apply_pass("constant_folding", fetch_list=[out])
+        assert len(opt.ops) == len(prog.ops)
+
+
+class TestFuseElementwise:
+    def test_chain_fuses_and_matches(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            out = paddle.tanh(paddle.exp(x * 0.5) + 1.0)
+        n0 = len(prog.ops)
+        opt = prog.apply_pass("fuse_elementwise", fetch_list=[out])
+        assert len(opt.ops) < n0
+        assert len(opt.ops) == 1                # whole chain -> one record
+        assert "+" in opt.ops[0].name
+        exe = static.Executor()
+        x = np.random.default_rng(2).normal(size=(4, 8)).astype(np.float32)
+        want = exe.run(prog, feed={"x": x}, fetch_list=[out])[0]
+        got = exe.run(opt, feed={"x": x}, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_multi_consumer_not_fused(self):
+        prog = static.Program()
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            h = paddle.exp(x)
+            out = h + h * 2.0                   # h has two consumers
+        opt = prog.apply_pass("fuse_elementwise", fetch_list=[out])
+        exe = static.Executor()
+        xv = np.random.default_rng(3).normal(size=(4, 8)).astype(np.float32)
+        want = exe.run(prog, feed={"x": xv}, fetch_list=[out])[0]
+        got = exe.run(opt, feed={"x": xv}, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+        names = [op.name for op in opt.ops]
+        assert any(n.startswith("exp") for n in names)  # exp not consumed-once
+
+
+class TestPipelineOfPasses:
+    def test_all_passes_in_order(self):
+        prog, out = _build()
+        opt = prog.apply_pass(
+            ["dead_code_elimination", "constant_folding",
+             "fuse_elementwise"], fetch_list=[out])
+        assert len(opt.ops) < len(prog.ops)
+        exe = static.Executor()
+        x = np.random.default_rng(4).normal(size=(4, 8)).astype(np.float32)
+        want = exe.run(prog, feed={"x": x}, fetch_list=[out])[0]
+        got = exe.run(opt, feed={"x": x}, fetch_list=[out])[0]
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    def test_list_passes(self):
+        assert {"dead_code_elimination", "constant_folding",
+                "fuse_elementwise"} <= set(static.passes.list_passes())
+
+    def test_training_program_keeps_loss(self):
+        """DCE on a train-marked program must keep everything feeding the
+        loss."""
+        prog = static.Program()
+        lin = paddle.nn.Linear(8, 1)
+        opt_ = paddle.optimizer.SGD(learning_rate=0.1,
+                                    parameters=lin.parameters())
+        with static.program_guard(prog):
+            x = static.data("x", [4, 8], "float32")
+            y = static.data("y", [4, 1], "float32")
+            loss = paddle.nn.functional.mse_loss(lin(x), y)
+            opt_.minimize(loss)
+        pruned = prog.apply_pass("dead_code_elimination")
+        assert pruned._train is not None
+        exe = static.Executor()
+        rng = np.random.default_rng(5)
+        xv = rng.normal(size=(4, 8)).astype(np.float32)
+        yv = rng.normal(size=(4, 1)).astype(np.float32)
+        l0 = exe.run(pruned, feed={"x": xv, "y": yv},
+                     fetch_list=[loss])[0]
+        for _ in range(5):
+            l1 = exe.run(pruned, feed={"x": xv, "y": yv},
+                         fetch_list=[loss])[0]
+        assert float(l1) < float(l0)
