@@ -11,6 +11,12 @@ from .transformer import (  # noqa: F401
     TransformerEncoder, TransformerEncoderLayer,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+from .rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    SimpleRNN, LSTM, GRU,
+)
+# bind the functional forms over the submodule attribute of the same name
+from .rnn import rnn, birnn, split_states, concat_states  # noqa: F401
 from ..tensor import Parameter  # noqa: F401
 
 from . import common as _common
@@ -20,6 +26,9 @@ __all__ = (
      "Parameter", "functional", "initializer",
      "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
      "TransformerDecoderLayer", "TransformerDecoder", "Transformer",
-     "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]
+     "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",
+     "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
+     "SimpleRNN", "LSTM", "GRU",
+     "rnn", "birnn", "split_states", "concat_states"]
     + list(_common.__all__)
 )

@@ -1214,3 +1214,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
         return jnp.concatenate([left, right, mid], axis=2).reshape(nt, c, h, w)
 
     return apply_op("temporal_shift", f, x)
+
+
+from .rnn import simple_rnn_cell, lstm_cell, gru_cell  # noqa: F401,E402
+
+__all__ += ["simple_rnn_cell", "lstm_cell", "gru_cell"]

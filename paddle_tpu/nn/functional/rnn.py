@@ -1,0 +1,80 @@
+"""Functional single-step RNN cell recurrences.
+
+Reference math: python/paddle/nn/layer/rnn.py:813 (SimpleRNNCell.forward),
+:966 (LSTMCell.forward), :1125 (GRUCell.forward).  Exposed as functionals so
+(a) the op-registry dtype/grad sweeps cover the cell math like any other op,
+and (b) the eager cells and nn.rnn's lax.scan recurrence share ONE
+implementation — the scan traces these same pure steps, so per-step eager
+results and the compiled sequence are bit-identical.
+
+Gate conventions (matching the reference exactly):
+  * simple:  h' = act(x W_ih^T + b_ih + h W_hh^T + b_hh)
+  * lstm:    gates split 4 -> [i, f, g, o];  c' = sig(f)c + sig(i)tanh(g);
+             h' = sig(o) tanh(c')
+  * gru:     x/h gates split 3 -> [r, z, c];  r = sig(x_r+h_r);
+             z = sig(x_z+h_z);  c = tanh(x_c + r*h_c);  h' = z*h + (1-z)*c
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor import apply_op
+
+__all__ = ["simple_rnn_cell", "lstm_cell", "gru_cell"]
+
+
+def _simple_rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, activation="tanh"):
+    g = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        g = g + b_ih
+    if b_hh is not None:
+        g = g + b_hh
+    return jnp.tanh(g) if activation == "tanh" else jax.nn.relu(g)
+
+
+def _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih
+    if b_hh is not None:
+        gates = gates + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c2 = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h2 = jax.nn.sigmoid(o) * jnp.tanh(c2)
+    return h2, c2
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x @ w_ih.T
+    if b_ih is not None:
+        xg = xg + b_ih
+    hg = h @ w_hh.T
+    if b_hh is not None:
+        hg = hg + b_hh
+    x_r, x_z, x_c = jnp.split(xg, 3, axis=-1)
+    h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(x_r + h_r)
+    z = jax.nn.sigmoid(x_z + h_z)
+    c = jnp.tanh(x_c + r * h_c)
+    return (h - c) * z + c
+
+
+def simple_rnn_cell(x, h, weight_ih, weight_hh, bias_ih=None, bias_hh=None,
+                    activation="tanh"):
+    """One vanilla-RNN step: returns the new hidden state (batch, hidden)."""
+    return apply_op("simple_rnn_cell", _simple_rnn_step, x, h, weight_ih,
+                    weight_hh, bias_ih, bias_hh, activation=activation)
+
+
+def lstm_cell(x, h, c, weight_ih, weight_hh, bias_ih=None, bias_hh=None):
+    """One LSTM step: returns (new_h, new_c)."""
+    return apply_op("lstm_cell", _lstm_step, x, h, c, weight_ih, weight_hh,
+                    bias_ih, bias_hh)
+
+
+def gru_cell(x, h, weight_ih, weight_hh, bias_ih=None, bias_hh=None):
+    """One GRU step: returns the new hidden state (batch, hidden)."""
+    return apply_op("gru_cell", _gru_step, x, h, weight_ih, weight_hh,
+                    bias_ih, bias_hh)

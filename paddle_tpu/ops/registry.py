@@ -619,3 +619,33 @@ register("signal.istft", dtypes=("float32",), sharding="reduce",
          sample=lambda rng: ((rng.standard_normal((2, 5, 7))
                               .astype(np.float32),),
                              {"n_fft": 8}))
+
+
+# --- recurrent cell steps (nn/functional/rnn.py; reference nn/layer/rnn.py
+# SimpleRNNCell/LSTMCell/GRUCell forward math) -------------------------------
+
+
+def _rnn_cell_sample(kind):
+    gates = {"simple": 1, "gru": 3, "lstm": 4}[kind]
+
+    def f(rng):
+        b, i, h = 4, 8, 6
+        x = rng.standard_normal((b, i)).astype(np.float32)
+        hs = rng.standard_normal((b, h)).astype(np.float32)
+        w_ih = (0.3 * rng.standard_normal((gates * h, i))).astype(np.float32)
+        w_hh = (0.3 * rng.standard_normal((gates * h, h))).astype(np.float32)
+        b_ih = (0.1 * rng.standard_normal((gates * h,))).astype(np.float32)
+        b_hh = (0.1 * rng.standard_normal((gates * h,))).astype(np.float32)
+        if kind == "lstm":
+            c = rng.standard_normal((b, h)).astype(np.float32)
+            return (x, hs, c, w_ih, w_hh, b_ih, b_hh), {}
+        return (x, hs, w_ih, w_hh, b_ih, b_hh), {}
+    return f
+
+
+register("nn.functional.simple_rnn_cell", sample=_rnn_cell_sample("simple"),
+         tol=_LOOSE, sharding="contract")
+register("nn.functional.lstm_cell", sample=_rnn_cell_sample("lstm"),
+         tol=_LOOSE, sharding="contract")
+register("nn.functional.gru_cell", sample=_rnn_cell_sample("gru"),
+         tol=_LOOSE, sharding="contract")

@@ -1,0 +1,426 @@
+"""Recurrent family tests (reference test/legacy_test/test_rnn_cells*.py,
+test_rnn_op.py analog): numpy parity for every cell, scan-vs-eager grad
+parity, masking semantics, wrappers, stacked nets, sharding, e2e training.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+
+def _sig(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _np_simple(x, h, wi, wh, bi, bh):
+    return np.tanh(x @ wi.T + bi + h @ wh.T + bh)
+
+
+def _np_lstm(x, h, c, wi, wh, bi, bh):
+    g = x @ wi.T + bi + h @ wh.T + bh
+    i, f, gg, o = np.split(g, 4, axis=-1)
+    c2 = _sig(f) * c + _sig(i) * np.tanh(gg)
+    h2 = _sig(o) * np.tanh(c2)
+    return h2, c2
+
+
+def _np_gru(x, h, wi, wh, bi, bh):
+    xg = x @ wi.T + bi
+    hg = h @ wh.T + bh
+    xr, xz, xc = np.split(xg, 3, axis=-1)
+    hr, hz, hc = np.split(hg, 3, axis=-1)
+    r, z = _sig(xr + hr), _sig(xz + hz)
+    c = np.tanh(xc + r * hc)
+    return z * h + (1 - z) * c
+
+
+def _cell_arrays(cell):
+    return [p.numpy() for p in
+            (cell.weight_ih, cell.weight_hh, cell.bias_ih, cell.bias_hh)]
+
+
+class TestCells:
+    def test_simple_rnn_cell_parity(self):
+        cell = nn.SimpleRNNCell(8, 6)
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        out, new_h = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        ref = _np_simple(x, h0, *_cell_arrays(cell))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(new_h.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_relu_activation(self):
+        cell = nn.SimpleRNNCell(8, 6, activation="relu")
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        out, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        wi, wh, bi, bh = _cell_arrays(cell)
+        ref = np.maximum(x @ wi.T + bi + h0 @ wh.T + bh, 0)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_lstm_cell_parity(self):
+        cell = nn.LSTMCell(8, 6)
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        c0 = np.random.randn(4, 6).astype("float32")
+        out, (h, c) = cell(paddle.to_tensor(x),
+                           (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        rh, rc = _np_lstm(x, h0, c0, *_cell_arrays(cell))
+        np.testing.assert_allclose(h.numpy(), rh, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), rc, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(out.numpy(), rh, rtol=1e-5, atol=1e-5)
+
+    def test_gru_cell_parity(self):
+        cell = nn.GRUCell(8, 6)
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        out, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        ref = _np_gru(x, h0, *_cell_arrays(cell))
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_no_bias(self):
+        cell = nn.GRUCell(8, 6, bias_ih_attr=False, bias_hh_attr=False)
+        assert cell.bias_ih is None and cell.bias_hh is None
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        out, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        wi, wh = cell.weight_ih.numpy(), cell.weight_hh.numpy()
+        z = np.zeros(18, "float32")
+        ref = _np_gru(x, h0, wi, wh, z, z)
+        np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+    def test_default_initial_states(self):
+        cell = nn.LSTMCell(8, 6)
+        x = np.random.randn(4, 8).astype("float32")
+        out, (h, c) = cell(paddle.to_tensor(x))
+        z = np.zeros((4, 6), "float32")
+        rh, rc = _np_lstm(x, z, z, *_cell_arrays(cell))
+        np.testing.assert_allclose(h.numpy(), rh, rtol=1e-5, atol=1e-5)
+
+    def test_hidden_size_validation(self):
+        with pytest.raises(ValueError):
+            nn.LSTMCell(8, 0)
+        with pytest.raises(ValueError):
+            nn.SimpleRNNCell(8, 6, activation="gelu")
+
+
+def _np_rnn(cell_fn, x_btd, states, seq_len=None, reverse=False):
+    """Reference rnn() semantics in numpy: outputs unmasked, states frozen
+    past each row's end (rnn.py:141), reverse flips inputs+mask+outputs."""
+    B, T = x_btd.shape[:2]
+    xs = np.swapaxes(x_btd, 0, 1)
+    mask = None
+    if seq_len is not None:
+        mask = (np.arange(T)[:, None] < np.asarray(seq_len)[None, :]).astype(
+            x_btd.dtype)
+    if reverse:
+        xs = xs[::-1]
+        mask = mask[::-1] if mask is not None else None
+    outs = []
+    for t in range(T):
+        o, new = cell_fn(xs[t], states)
+        if mask is not None:
+            m = mask[t][:, None]
+            new = tuple(m * n + (1 - m) * s for n, s in zip(new, states)) \
+                if isinstance(new, tuple) else m * new + (1 - m) * states
+        states = new
+        outs.append(o)
+    out = np.stack(outs[::-1] if reverse else outs, axis=1)
+    return out, states
+
+
+class TestRnnFunction:
+    def test_lstm_sequence_parity(self):
+        cell = nn.LSTMCell(8, 6)
+        wi, wh, bi, bh = _cell_arrays(cell)
+        x = np.random.randn(4, 5, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        c0 = np.random.randn(4, 6).astype("float32")
+
+        def np_cell(xt, st):
+            h, c = _np_lstm(xt, st[0], st[1], wi, wh, bi, bh)
+            return h, (h, c)
+
+        ref_out, (rh, rc) = _np_rnn(np_cell, x, (h0, c0))
+        out, (h, c) = nn.RNN(cell)(paddle.to_tensor(x),
+                                   (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), rh, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), rc, rtol=1e-4, atol=1e-5)
+
+    def test_sequence_length_freezes_states(self):
+        cell = nn.GRUCell(8, 6)
+        wi, wh, bi, bh = _cell_arrays(cell)
+        x = np.random.randn(4, 5, 8).astype("float32")
+        h0 = np.zeros((4, 6), "float32")
+        seq = np.array([5, 3, 1, 4], "int32")
+
+        def np_cell(xt, st):
+            h = _np_gru(xt, st, wi, wh, bi, bh)
+            return h, h
+
+        ref_out, ref_h = _np_rnn(np_cell, x, h0, seq_len=seq)
+        out, h = nn.rnn(cell, paddle.to_tensor(x), paddle.to_tensor(h0),
+                        sequence_length=paddle.to_tensor(seq))
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), ref_h, rtol=1e-4, atol=1e-5)
+
+    def test_reverse_with_mask(self):
+        cell = nn.SimpleRNNCell(8, 6)
+        wi, wh, bi, bh = _cell_arrays(cell)
+        x = np.random.randn(3, 5, 8).astype("float32")
+        h0 = np.zeros((3, 6), "float32")
+        seq = np.array([2, 5, 3], "int32")
+
+        def np_cell(xt, st):
+            h = _np_simple(xt, st, wi, wh, bi, bh)
+            return h, h
+
+        ref_out, ref_h = _np_rnn(np_cell, x, h0, seq_len=seq, reverse=True)
+        out, h = nn.rnn(cell, paddle.to_tensor(x), paddle.to_tensor(h0),
+                        sequence_length=paddle.to_tensor(seq), is_reverse=True)
+        np.testing.assert_allclose(out.numpy(), ref_out, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), ref_h, rtol=1e-4, atol=1e-5)
+
+    def test_time_major(self):
+        cell = nn.GRUCell(8, 6)
+        x = np.random.randn(5, 4, 8).astype("float32")  # (T, B, D)
+        out_tm, h_tm = nn.rnn(cell, paddle.to_tensor(x), time_major=True)
+        out_bm, h_bm = nn.rnn(cell,
+                              paddle.to_tensor(np.swapaxes(x, 0, 1).copy()))
+        np.testing.assert_allclose(out_tm.numpy(),
+                                   np.swapaxes(out_bm.numpy(), 0, 1),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h_tm.numpy(), h_bm.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_scan_grads_match_stepwise_eager(self):
+        """The scan vjp must equal per-step eager tape grads."""
+        cell = nn.LSTMCell(4, 3)
+        x = np.random.randn(2, 6, 4).astype("float32")
+        h0 = np.zeros((2, 3), "float32")
+        c0 = np.zeros((2, 3), "float32")
+
+        out, _ = nn.rnn(cell, paddle.to_tensor(x),
+                        (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        (out * out).sum().backward()
+        scan_grads = [p.grad.numpy().copy() for p in cell.parameters()]
+        for p in cell.parameters():
+            p.clear_grad()
+
+        st = (paddle.to_tensor(h0), paddle.to_tensor(c0))
+        outs = []
+        for t in range(6):
+            o, st = cell(paddle.to_tensor(x[:, t]), st)
+            outs.append(o)
+        loss = sum((o * o).sum() for o in outs)
+        loss.backward()
+        eager_grads = [p.grad.numpy() for p in cell.parameters()]
+        for a, b in zip(scan_grads, eager_grads):
+            np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)
+
+    def test_custom_user_cell(self):
+        """rnn() accepts any RNNCellBase whose forward uses eager ops."""
+        class Decay(nn.RNNCellBase):
+            def __init__(self):
+                super().__init__()
+                self.w = self.create_parameter((3,),
+                                               default_initializer=nn.initializer.Constant(0.5))
+
+            def forward(self, inputs, states=None):
+                if states is None:
+                    states = self.get_initial_states(inputs, self.state_shape)
+                h = states * self.w + inputs
+                return h, h
+
+            @property
+            def state_shape(self):
+                return (3,)
+
+        cell = Decay()
+        x = np.random.randn(2, 4, 3).astype("float32")
+        out, h = nn.rnn(cell, paddle.to_tensor(x))
+        ref_h = np.zeros((2, 3), "float32")
+        refs = []
+        for t in range(4):
+            ref_h = ref_h * 0.5 + x[:, t]
+            refs.append(ref_h)
+        np.testing.assert_allclose(out.numpy(), np.stack(refs, 1),
+                                   rtol=1e-5, atol=1e-6)
+        (out.sum()).backward()
+        assert cell.w.grad is not None
+
+
+class TestBiRNN:
+    def test_birnn_concat(self):
+        cf, cb = nn.GRUCell(8, 6), nn.GRUCell(8, 6)
+        x = np.random.randn(4, 5, 8).astype("float32")
+        xt = paddle.to_tensor(x)
+        out, (sf, sb) = nn.BiRNN(cf, cb)(xt)
+        of, _ = nn.rnn(cf, xt)
+        ob, _ = nn.rnn(cb, xt, is_reverse=True)
+        np.testing.assert_allclose(
+            out.numpy(),
+            np.concatenate([of.numpy(), ob.numpy()], axis=-1),
+            rtol=1e-5, atol=1e-6)
+
+    def test_input_size_mismatch(self):
+        with pytest.raises(ValueError):
+            nn.BiRNN(nn.GRUCell(8, 6), nn.GRUCell(4, 6))
+
+
+class TestStateSplit:
+    def test_round_trip_single(self):
+        s = paddle.to_tensor(np.random.randn(4, 3, 5).astype("float32"))
+        parts = nn.split_states(s, bidirectional=True, state_components=1)
+        assert len(parts) == 2 and isinstance(parts[0], tuple)
+        back = nn.concat_states(parts, bidirectional=True, state_components=1)
+        np.testing.assert_allclose(back.numpy(), s.numpy())
+
+    def test_round_trip_lstm(self):
+        h = paddle.to_tensor(np.random.randn(2, 3, 5).astype("float32"))
+        c = paddle.to_tensor(np.random.randn(2, 3, 5).astype("float32"))
+        parts = nn.split_states((h, c), bidirectional=False,
+                                state_components=2)
+        assert len(parts) == 2 and len(parts[0]) == 2
+        bh, bc = nn.concat_states(parts, bidirectional=False,
+                                  state_components=2)
+        np.testing.assert_allclose(bh.numpy(), h.numpy())
+        np.testing.assert_allclose(bc.numpy(), c.numpy())
+
+
+class TestStackedNets:
+    def test_lstm_shapes_and_states(self):
+        net = nn.LSTM(8, 6, num_layers=2, direction="bidirect")
+        x = np.random.randn(4, 5, 8).astype("float32")
+        out, (h, c) = net(paddle.to_tensor(x))
+        assert list(out.shape) == [4, 5, 12]
+        assert list(h.shape) == [4, 4, 6] and list(c.shape) == [4, 4, 6]
+
+    def test_single_layer_matches_rnn_wrapper(self):
+        net = nn.GRU(8, 6)
+        x = np.random.randn(4, 5, 8).astype("float32")
+        out, h = net(paddle.to_tensor(x))
+        cell = net[0].cell
+        ref_out, ref_h = nn.rnn(cell, paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref_out.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(h.numpy(), ref_h.numpy()[None],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_two_layer_composition(self):
+        net = nn.SimpleRNN(8, 6, num_layers=2)
+        x = np.random.randn(4, 5, 8).astype("float32")
+        out, h = net(paddle.to_tensor(x))
+        o1, h1 = nn.rnn(net[0].cell, paddle.to_tensor(x))
+        o2, h2 = nn.rnn(net[1].cell, o1)
+        np.testing.assert_allclose(out.numpy(), o2.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            h.numpy(), np.stack([h1.numpy(), h2.numpy()]),
+            rtol=1e-5, atol=1e-6)
+
+    def test_initial_states_round_trip(self):
+        net = nn.LSTM(8, 6, num_layers=2)
+        x = np.random.randn(4, 5, 8).astype("float32")
+        h0 = np.random.randn(2, 4, 6).astype("float32")
+        c0 = np.random.randn(2, 4, 6).astype("float32")
+        out, (h, c) = net(paddle.to_tensor(x),
+                          (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        assert list(h.shape) == [2, 4, 6]
+
+    def test_dropout_only_in_train(self):
+        net = nn.LSTM(8, 6, num_layers=2, dropout=0.5)
+        x = paddle.to_tensor(np.random.randn(4, 5, 8).astype("float32"))
+        net.eval()
+        a, _ = net(x)
+        b, _ = net(x)
+        np.testing.assert_allclose(a.numpy(), b.numpy())
+        net.train()
+        c, _ = net(x)
+        assert not np.allclose(a.numpy(), c.numpy())
+
+    def test_variable_length_batch(self):
+        net = nn.GRU(8, 6, num_layers=2, direction="bidirect")
+        x = np.random.randn(4, 7, 8).astype("float32")
+        seq = paddle.to_tensor(np.array([7, 4, 2, 6], "int32"))
+        out, h = net(paddle.to_tensor(x), sequence_length=seq)
+        assert list(out.shape) == [4, 7, 12]
+        assert list(h.shape) == [4, 4, 6]
+
+    def test_bad_direction(self):
+        with pytest.raises(ValueError):
+            nn.LSTM(8, 6, direction="diagonal")
+
+
+class TestCompiledAndSharded:
+    def test_jit_compiles_lstm(self):
+        net = nn.LSTM(8, 6)
+        step = paddle.jit.to_static(
+            lambda t: net(t)[0].sum())
+        x = paddle.to_tensor(np.random.randn(4, 5, 8).astype("float32"))
+        eager = net(x)[0].sum().numpy()
+        compiled = step(x).numpy()
+        np.testing.assert_allclose(compiled, eager, rtol=1e-5, atol=1e-5)
+
+    def test_dp_sharded_batch(self):
+        import paddle_tpu.distributed as dist
+        net = nn.LSTM(8, 6)
+        pm = dist.ProcessMesh(np.arange(8), ["x"])
+        x = np.random.randn(8, 5, 8).astype("float32")
+        xs = dist.shard_tensor(paddle.to_tensor(x), pm, [dist.Shard(0)])
+        out, (h, c) = net(xs)
+        ref, _ = net(paddle.to_tensor(x))
+        np.testing.assert_allclose(out.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestTrainE2E:
+    def test_bilstm_sequence_labeling_conll(self):
+        """BiLSTM tagger trains on Conll05st (synthetic): loss drops."""
+        from paddle_tpu.text.datasets import Conll05st
+
+        ds = Conll05st(n_synthetic=24)
+        V = len(ds.word_dict)
+        L = len(ds.label_dict)
+        T = 8
+
+        def pad(seq, val=0):
+            seq = list(seq)[:T]
+            return seq + [val] * (T - len(seq))
+
+        words = np.array([pad(it[0]) for it in ds._items], "int32")
+        labels = np.array([pad(it[-1]) for it in ds._items], "int32")
+        lengths = np.array([min(len(it[0]), T) for it in ds._items], "int32")
+
+        class Tagger(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, 16)
+                self.lstm = nn.LSTM(16, 16, direction="bidirect")
+                self.head = nn.Linear(32, L)
+
+            def forward(self, w, lens):
+                x = self.emb(w)
+                o, _ = self.lstm(x, sequence_length=lens)
+                return self.head(o)
+
+        model = Tagger()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=model.parameters())
+        losses = []
+        wt = paddle.to_tensor(words)
+        lt = paddle.to_tensor(labels)
+        lent = paddle.to_tensor(lengths)
+        for _ in range(8):
+            logits = model(wt, lent)
+            loss = F.cross_entropy(
+                logits.reshape([-1, L]), lt.reshape([-1]))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        assert losses[-1] < losses[0] * 0.8, losses
