@@ -58,6 +58,13 @@ class _WaveBackend:
                 f"only PCM16 WAV is supported by the wave backend ({e}); "
                 "register a richer backend via "
                 "paddle.audio.backends.register_backend") from e
+        if f.getsampwidth() != 2:
+            width = f.getsampwidth()
+            file_obj.close()
+            raise NotImplementedError(
+                f"only PCM16 WAV is supported by the wave backend "
+                f"(got sample width {width} bytes); register a richer "
+                "backend via paddle.audio.backends.register_backend")
         channels = f.getnchannels()
         sr = f.getframerate()
         frames = f.getnframes()

@@ -248,6 +248,12 @@ class DynamicBatcher:
     the per-request slices handed back.  This is the TPU analog of the
     reference serving stack's dynamic batching — one compiled program,
     maximum occupancy under concurrent load.
+
+    ASSUMES the exported graph is row-independent along dim 0 (true for
+    standard inference forwards): co-batched strangers and padding rows
+    must not influence each other's outputs.  For models with cross-batch
+    computation (e.g. batch statistics at inference time), start the
+    server with ``serve(..., batching=False)``.
     """
 
     def __init__(self, predictor: Predictor, max_batch: int,
@@ -359,8 +365,9 @@ def serve(predictor: Predictor, host: str = "127.0.0.1", port: int = 0,
     POST / with {"inputs": [array, ...]} (nested lists; one entry per input
     in get_input_names() order, dtype from the exported spec) returns
     {"outputs": [array, ...]}.  Concurrent requests are dynamically
-    micro-batched into the compiled batch size (batching=False serializes
-    instead).  Client faults return 400; server faults 500; bodies above
+    micro-batched into the compiled batch size — this assumes the model is
+    row-independent along the batch dim (see DynamicBatcher); pass
+    batching=False to serialize requests instead.  Client faults return 400; server faults 500; bodies above
     `max_body_bytes` are rejected with 413.  Returns (server, thread);
     server.shutdown() stops both the HTTP loop and the batcher.
     """

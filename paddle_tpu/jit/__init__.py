@@ -165,7 +165,10 @@ class StaticFunction:
         dyn_leaves = [l for l in leaves if _is_arraylike(l)]
         static_leaves = tuple(_DYN if _is_arraylike(l) else l for l in leaves)
         try:
-            cache_key = (train_mode, treedef, static_leaves)
+            # include leaf types: 1, 1.0 and True hash equal but specialize
+            # to different programs (dtype promotion differs)
+            cache_key = (train_mode, treedef, static_leaves,
+                         tuple(type(l) for l in static_leaves))
             hash(cache_key)
         except TypeError:  # unhashable static leaf: don't cache, just build
             cache_key = None
@@ -173,6 +176,8 @@ class StaticFunction:
         if jitted is None:
             jitted = self._build(treedef, static_leaves)
             if cache_key is not None:
+                if len(self._cache) >= 512:  # varying python scalars would
+                    self._cache.pop(next(iter(self._cache)))  # leak programs
                 self._cache[cache_key] = jitted
         params, buffers = self._params_and_buffers
         param_raws = [p._data for p in params]

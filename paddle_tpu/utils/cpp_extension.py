@@ -198,6 +198,13 @@ class _CppKernel:
                 f"kernel takes {self.n_inputs} input(s), got {len(arrays)} "
                 "(a wrong arity would pass garbage pointers to the C ABI)")
         arrays = [np.ascontiguousarray(a, dtype=self.dtype) for a in arrays]
+        for i, a in enumerate(arrays[1:], 1):
+            if a.shape != arrays[0].shape:
+                raise ValueError(
+                    f"input {i} shape {a.shape} != input 0 shape "
+                    f"{arrays[0].shape}: the elementwise C ABI requires all "
+                    "inputs to share the output shape (a mismatch would read "
+                    "past the smaller buffer)")
         out = np.empty_like(arrays[0])
         shape = np.asarray(arrays[0].shape, dtype=np.int64)
         argp = [a.ctypes.data_as(ctypes.c_void_p) for a in arrays]

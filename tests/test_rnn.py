@@ -424,3 +424,24 @@ class TestTrainE2E:
             opt.clear_grad()
             losses.append(float(loss.numpy()))
         assert losses[-1] < losses[0] * 0.8, losses
+
+
+class TestStaticCapture:
+    def test_program_capture_and_replay(self):
+        """Only the outer 'rnn' op may be recorded — per-step cell ops carry
+        scan tracers and must not leak into a captured Program."""
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [4, 5, 8], "float32")
+            net = nn.LSTM(8, 6)
+            y, _ = net(x)
+        ops = [op.name for op in main.ops]
+        assert "rnn" in ops, ops
+        assert "lstm_cell" not in ops, ops
+        exe = static.Executor()
+        out = exe.run(main,
+                      feed={"x": np.random.randn(4, 5, 8).astype("float32")},
+                      fetch_list=[y])
+        assert out[0].shape == (4, 5, 6)
