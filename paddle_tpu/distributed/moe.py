@@ -52,6 +52,9 @@ class MoEConfig:
     z_loss_weight: float = 1e-3         # router logit z-loss (ST-MoE)
     normalize_top_k: bool = True        # renormalize top-k gate weights
     gate_dtype: Any = jnp.float32
+    # "einsum" | "scatter" | None (auto: scatter once the one-hot dispatch
+    # tensor would exceed _EINSUM_DISPATCH_LIMIT bytes)
+    dispatch_mode: Optional[str] = None
 
 
 def compute_capacity(num_tokens: int, cfg: MoEConfig) -> int:
@@ -67,14 +70,20 @@ def compute_capacity(num_tokens: int, cfg: MoEConfig) -> int:
     return max(cap, cfg.min_capacity)
 
 
-def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None):
-    """GShard/Switch gating from router logits.
+def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None):
+    """Index-form GShard/Switch gating — the single source of routing truth.
 
-    logits: (N, X) float. Returns (dispatch (N, X, C) bool-ish float,
-    combine (N, X, C) float, aux_loss scalar).
+    logits: (N, X) float.  Returns (expert_idx (N, k) int32, pos (N, k) int32
+    position within the expert's capacity buffer, keep (N, k) 0/1 float,
+    gate_vals (N, k) float, aux_loss scalar, C).
 
-    Reference: gshard_gate.py / switch_gate.py top-k + capacity logic; here the
-    position-in-expert is a cumsum over one-hot masks (static shapes, no sort).
+    Position-in-expert is a cumsum over one-hot masks (static shapes, no
+    sort), slot-major priority — all slot-0 picks rank before any slot-1
+    pick, matching GShard's "top-1 tokens first" drop policy.  Memory is
+    O(N·X): nothing of size C is materialized here, which is what lets the
+    scatter dispatch below scale past the one-hot form's N·X·C wall
+    (reference hits the same wall differently: its all-to-all buffers are
+    count-sized, moe_utils.py:20).
     """
     N, X = logits.shape
     C = capacity if capacity is not None else compute_capacity(N, cfg)
@@ -86,22 +95,16 @@ def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None):
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(-1, keepdims=True), 1e-9)
 
-    # position of each (token, slot) within its expert's capacity buffer:
-    # slot-major priority — all slot-0 picks rank before any slot-1 pick,
-    # matching GShard's "top-1 tokens first" drop policy.
     counts = jnp.zeros((X,), cfg.gate_dtype)
-    dispatch = jnp.zeros((N, X, C), cfg.gate_dtype)
-    combine = jnp.zeros((N, X, C), cfg.gate_dtype)
+    poss, keeps = [], []
     for j in range(cfg.top_k):
         m = jax.nn.one_hot(expert_idx[:, j], X, dtype=cfg.gate_dtype)  # (N, X)
         pos = jnp.cumsum(m, axis=0) - 1.0 + counts[None, :]            # (N, X)
         counts = counts + m.sum(axis=0)
-        keep = m * (pos < C)                                           # (N, X)
-        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), C,
-                                dtype=cfg.gate_dtype)                  # (N, X, C)
-        d = keep[..., None] * pos_oh
-        dispatch = dispatch + d
-        combine = combine + gate_vals[:, j][:, None, None] * d
+        poss.append((pos * m).sum(-1).astype(jnp.int32))
+        keeps.append(((pos < C) * m).sum(-1).astype(cfg.gate_dtype))
+    pos = jnp.stack(poss, axis=1)                              # (N, k)
+    keep = jnp.stack(keeps, axis=1)                            # (N, k)
 
     # GShard eq.(4) load-balance loss: X * sum_x f_x * p_x where f_x is the
     # fraction of tokens whose TOP-1 pick is x and p_x the mean router prob.
@@ -112,6 +115,27 @@ def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None):
     if cfg.z_loss_weight:
         z = jax.nn.logsumexp(logits, axis=-1)
         aux = aux + cfg.z_loss_weight * jnp.mean(z * z)
+    return expert_idx.astype(jnp.int32), pos, keep, gate_vals, aux, C
+
+
+def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None):
+    """One-hot GShard/Switch gating (reference gshard_gate.py/switch_gate.py).
+
+    logits: (N, X) float. Returns (dispatch (N, X, C) bool-ish float,
+    combine (N, X, C) float, aux_loss scalar).  Built from `gating_indices`
+    so both dispatch forms share one routing decision.
+    """
+    N, X = logits.shape
+    expert_idx, pos, keep, gate_vals, aux, C = gating_indices(
+        logits, cfg, capacity)
+    dispatch = jnp.zeros((N, X, C), cfg.gate_dtype)
+    combine = jnp.zeros((N, X, C), cfg.gate_dtype)
+    for j in range(cfg.top_k):
+        d = (keep[:, j, None, None]
+             * jax.nn.one_hot(expert_idx[:, j], X, dtype=cfg.gate_dtype)[:, :, None]
+             * jax.nn.one_hot(pos[:, j], C, dtype=cfg.gate_dtype)[:, None, :])
+        dispatch = dispatch + d
+        combine = combine + gate_vals[:, j][:, None, None] * d
     return dispatch, combine, aux
 
 
@@ -145,25 +169,69 @@ def moe_ffn_logical_axes():
     }
 
 
-def moe_ffn(x, p, cfg: MoEConfig):
-    """MoE SwiGLU FFN.  x: (B, S, E) -> (out (B, S, E), aux_loss).
+# above this many bytes of one-hot dispatch tensor, auto mode switches to
+# the scatter dispatch (the 16G-HBM v5e hits the wall around 8k tokens with
+# X=8: N·X·C·4B·2 tensors ~ 2.6G at N=16k)
+_EINSUM_DISPATCH_LIMIT = 64 * 1024 * 1024
 
-    The three einsums below ARE the reference's global_scatter -> expert FFN ->
-    global_gather pipeline (moe_layer.py:107-156): under GSPMD, with x
-    batch-sharded and weights expert-sharded, XLA inserts the all-to-alls.
-    """
-    B, S, E = x.shape
-    N = B * S
-    tok = x.reshape(N, E)
-    logits = tok.astype(cfg.gate_dtype) @ p["router"]
-    dispatch, combine, aux = top_k_gating(logits, cfg)
-    d = dispatch.astype(x.dtype)
-    xp = jnp.einsum("nxc,ne->xce", d, tok)                     # all-to-all in
+
+def _expert_ffn(xp, p):
+    """SwiGLU over stacked expert buffers xp (X, C, E) -> (X, C, E)."""
     g = jnp.einsum("xce,xef->xcf", xp, p["w_gate"])
     u = jnp.einsum("xce,xef->xcf", xp, p["w_up"])
     h = (jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u)
-    eo = jnp.einsum("xcf,xfe->xce", h, p["w_down"])
-    out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), eo)  # all-to-all out
+    return jnp.einsum("xcf,xfe->xce", h, p["w_down"])
+
+
+def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None):
+    """MoE SwiGLU FFN.  x: (B, S, E) -> (out (B, S, E), aux_loss).
+
+    Two dispatch forms sharing one routing decision (`gating_indices`):
+
+    * "einsum" — GShard one-hot form.  The dispatch/combine einsums ARE the
+      reference's global_scatter -> expert FFN -> global_gather pipeline
+      (moe_layer.py:107-156): under GSPMD, with x batch-sharded and weights
+      expert-sharded, XLA inserts the all-to-alls.  Costs O(N·X·C) memory
+      and MACs for the routing itself.
+    * "scatter" — index form: tokens scatter-add straight into the (X, C, E)
+      expert buffers and gather back out, O(k·N·E) routing cost and no
+      (N, X, C) tensor at all — this is what removes the reference's (and
+      round-4's) single-chip token ceiling.
+
+    Identical routing, drops and numerics (parity-pinned in tests); auto
+    mode picks scatter once the one-hot tensors would exceed
+    _EINSUM_DISPATCH_LIMIT bytes.
+    """
+    B, S, E = x.shape
+    N = B * S
+    X = cfg.num_experts
+    tok = x.reshape(N, E)
+    logits = tok.astype(cfg.gate_dtype) @ p["router"]
+    mode = dispatch or cfg.dispatch_mode
+    if mode is None:
+        C = compute_capacity(N, cfg)
+        onehot_bytes = 2 * N * X * C * jnp.dtype(cfg.gate_dtype).itemsize
+        mode = "scatter" if onehot_bytes > _EINSUM_DISPATCH_LIMIT else "einsum"
+    if mode == "einsum":
+        dispatch_t, combine, aux = top_k_gating(logits, cfg)
+        d = dispatch_t.astype(x.dtype)
+        xp = jnp.einsum("nxc,ne->xce", d, tok)                 # all-to-all in
+        eo = _expert_ffn(xp, p)
+        out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), eo)
+    elif mode == "scatter":
+        e, pos, keep, gates, aux, C = gating_indices(logits, cfg)
+        vals = (keep[..., None] * tok[:, None, :]).astype(x.dtype)  # (N, k, E)
+        # every kept (token, slot) owns a distinct (expert, pos) cell; drops
+        # have pos >= C and fall out of bounds -> dropped by scatter mode
+        xp = jnp.zeros((X, C, E), x.dtype).at[e, pos].add(
+            vals, mode="drop", unique_indices=True)
+        eo = _expert_ffn(xp, p)
+        gath = eo[e, jnp.minimum(pos, C - 1)]                  # (N, k, E)
+        w = (gates * keep).astype(x.dtype)[..., None]
+        out = (gath * w).sum(axis=1)
+    else:
+        raise ValueError(f"unknown dispatch mode {mode!r} "
+                         "(expected 'einsum' or 'scatter')")
     return out.reshape(B, S, E), aux
 
 

@@ -3,5 +3,5 @@ stack (distributed/moe.py).  Reference: moe_layer.py:263 MoELayer + gate/."""
 
 from .....distributed.moe import (  # noqa: F401
     MoEConfig, MoELayer, NaiveGate, SwitchGate, GShardGate,
-    moe_ffn, top_k_gating, global_scatter, global_gather,
+    moe_ffn, top_k_gating, gating_indices, global_scatter, global_gather,
 )

@@ -27,6 +27,7 @@ class MoELlamaConfig(llama_lib.LlamaConfig):
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
     router_z_loss_weight: float = 1e-3
+    moe_dispatch: "str | None" = None   # "einsum" | "scatter" | None (auto)
 
     @property
     def moe(self) -> moe_lib.MoEConfig:
@@ -34,7 +35,8 @@ class MoELlamaConfig(llama_lib.LlamaConfig):
             num_experts=self.num_experts, top_k=self.moe_top_k,
             capacity_factor=self.capacity_factor,
             aux_loss_weight=self.aux_loss_weight,
-            z_loss_weight=self.router_z_loss_weight)
+            z_loss_weight=self.router_z_loss_weight,
+            dispatch_mode=self.moe_dispatch)
 
     @staticmethod
     def tiny(vocab_size: int = 256, num_experts: int = 4) -> "MoELlamaConfig":
@@ -120,3 +122,14 @@ def num_params(config: MoELlamaConfig) -> int:
 
 
 lm_batch_from_tokens = llama_lib.lm_batch_from_tokens
+
+
+def flops_per_token(config: MoELlamaConfig, seq_len: int) -> float:
+    """Training FLOPs/token under the ACTIVE-params 6N convention (Switch/
+    GShard accounting): a token pays only for the top_k experts it visits,
+    plus the router; attention terms match the dense trunk."""
+    c = config
+    moe_delta = c.num_hidden_layers * (
+        3 * c.hidden_size * c.intermediate_size * (c.moe_top_k - 1)
+        + c.hidden_size * c.num_experts)
+    return llama_lib.flops_per_token(c, seq_len) + 6.0 * moe_delta
