@@ -171,7 +171,8 @@ def moe_ffn_logical_axes():
 
 # above this many bytes of one-hot dispatch tensor, auto mode switches to
 # the scatter dispatch (the 16G-HBM v5e hits the wall around 8k tokens with
-# X=8: N·X·C·4B·2 tensors ~ 2.6G at N=16k)
+# X=8: at N=16k, C=5120 each of dispatch+combine is N·X·C·4B ~ 2.7G, ~5.4G
+# for the pair)
 _EINSUM_DISPATCH_LIMIT = 64 * 1024 * 1024
 
 

@@ -1,0 +1,179 @@
+"""Spatial-transformer functionals: affine_grid + grid_sample.
+
+Reference: python/paddle/nn/functional/vision.py:26 (affine_grid), :130
+(grid_sample) — there they dispatch to cuDNN/CPU kernels; here both are
+pure jnp gather/FMA compositions, so XLA fuses the interpolation weights
+into the gathers and the whole sampler differentiates through x AND grid.
+
+Conventions (verified against the reference docstring examples):
+  * grid coords are (x, y[, z]) in [-1, 1], x indexes width.
+  * align_corners=True maps -1/+1 to pixel CENTERS of the corner pixels;
+    False treats pixels as 1-wide cells (-1/+1 are the outer edges).
+  * padding_mode: zeros (OOB reads contribute 0), border (clamp),
+    reflection (mirror, then clamp).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor import apply_op
+
+__all__ = ["affine_grid", "grid_sample"]
+
+
+def _base_coords(size, align_corners, dtype):
+    if align_corners:
+        return jnp.linspace(-1.0, 1.0, size, dtype=dtype)
+    step = 2.0 / size
+    return jnp.arange(size, dtype=dtype) * step + (step * 0.5 - 1.0)
+
+
+def _affine_grid_impl(theta, out_shape, align_corners):
+    dt = theta.dtype
+    if theta.ndim != 3 or theta.shape[1:] not in ((2, 3), (3, 4)):
+        raise ValueError(
+            f"theta should be of shape [N, 2, 3] or [N, 3, 4], got "
+            f"{tuple(theta.shape)}")
+    if theta.shape[1] == 2:
+        _, _, H, W = out_shape
+        xs = _base_coords(W, align_corners, dt)
+        ys = _base_coords(H, align_corners, dt)
+        gx, gy = jnp.meshgrid(xs, ys, indexing="xy")      # (H, W)
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)  # (H, W, 3)
+        return jnp.einsum("hwi,nki->nhwk", base, theta)
+    _, _, D, H, W = out_shape
+    xs = _base_coords(W, align_corners, dt)
+    ys = _base_coords(H, align_corners, dt)
+    zs = _base_coords(D, align_corners, dt)
+    gz, gy, gx = jnp.meshgrid(zs, ys, xs, indexing="ij")  # (D, H, W)
+    base = jnp.stack([gx, gy, gz, jnp.ones_like(gx)], axis=-1)
+    return jnp.einsum("dhwi,nki->ndhwk", base, theta)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """theta (N, 2, 3) + out_shape [N, C, H, W] -> sampling grid (N, H, W, 2);
+    theta (N, 3, 4) + [N, C, D, H, W] -> (N, D, H, W, 3).
+
+    Reference: nn/functional/vision.py:26.
+    """
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(v) for v in out_shape.numpy().tolist()]
+    else:
+        out_shape = [int(v) for v in out_shape]
+    return apply_op("affine_grid", _affine_grid_impl, theta,
+                    out_shape=out_shape, align_corners=bool(align_corners))
+
+
+def _unnormalize(coord, size, align_corners):
+    if align_corners:
+        return (coord + 1.0) * 0.5 * (size - 1)
+    return ((coord + 1.0) * size - 1.0) * 0.5
+
+
+def _reflect(x, lo, hi):
+    rng = hi - lo
+    if rng <= 0:
+        return jnp.full_like(x, lo)
+    period = 2.0 * rng
+    x = jnp.abs((x - lo) % period)
+    return jnp.where(x > rng, period - x, x) + lo
+
+
+def _resolve_coord(g, size, align_corners, padding_mode):
+    """Unnormalized, padding-resolved coordinate + in-bounds flag source."""
+    c = _unnormalize(g, size, align_corners)
+    if padding_mode == "border":
+        c = jnp.clip(c, 0, size - 1)
+    elif padding_mode == "reflection":
+        if align_corners:
+            c = _reflect(c, 0.0, float(size - 1))
+        else:
+            c = _reflect(c, -0.5, size - 0.5)
+        c = jnp.clip(c, 0, size - 1)
+    return c
+
+
+def _gather_nd(x, idxs, sizes):
+    """x (N, C, *sizes); idxs: list of (N, *out) int arrays (one per spatial
+    dim) -> (N, C, *out) with OOB indices pre-masked by the caller."""
+    flat = idxs[0]
+    for i, sz in zip(idxs[1:], sizes[1:]):
+        flat = flat * sz + i
+    N = x.shape[0]
+    C = x.shape[1]
+    xf = x.reshape(N, C, -1)
+    ff = flat.reshape(N, 1, -1)
+    out = jnp.take_along_axis(xf, jnp.broadcast_to(ff, (N, C, ff.shape[-1])),
+                              axis=2)
+    return out.reshape((N, C) + idxs[0].shape[1:])
+
+
+def _grid_sample_impl(x, grid, mode, padding_mode, align_corners):
+    nd = x.ndim - 2                       # spatial dims: 2 or 3
+    sizes = x.shape[2:]                   # (H, W) or (D, H, W)
+    # grid channels are (x, y[, z]) = (W, H[, D]) — reverse to match dims
+    coords = [grid[..., nd - 1 - d] for d in range(nd)]  # per-dim, out shape
+    zeros = padding_mode == "zeros"
+
+    rs = [_resolve_coord(c, sizes[d], align_corners, padding_mode)
+          for d, c in enumerate(coords)]
+
+    if mode == "nearest":
+        idxs, mask = [], None
+        for d, c in enumerate(rs):
+            i = jnp.round(c)
+            ib = (i >= 0) & (i <= sizes[d] - 1)
+            mask = ib if mask is None else (mask & ib)
+            idxs.append(jnp.clip(i, 0, sizes[d] - 1).astype(jnp.int32))
+        v = _gather_nd(x, idxs, sizes)
+        if zeros:
+            v = v * mask[:, None].astype(x.dtype)
+        return v
+
+    # bilinear/trilinear: 2^nd corners
+    lo, wlo = [], []
+    for c in rs:
+        f = jnp.floor(c)
+        lo.append(f)
+        wlo.append(1.0 - (c - f))         # weight of the low corner
+    out = None
+    for corner in range(1 << nd):
+        idxs, w, mask = [], None, None
+        for d in range(nd):
+            hi = (corner >> d) & 1
+            i = lo[d] + hi
+            wd = (1.0 - wlo[d]) if hi else wlo[d]
+            ib = (i >= 0) & (i <= sizes[d] - 1)
+            mask = ib if mask is None else (mask & ib)
+            w = wd if w is None else w * wd
+            idxs.append(jnp.clip(i, 0, sizes[d] - 1).astype(jnp.int32))
+        v = _gather_nd(x, idxs, sizes)
+        if zeros:
+            w = w * mask.astype(w.dtype)
+        out = v * w[:, None].astype(x.dtype) if out is None \
+            else out + v * w[:, None].astype(x.dtype)
+    return out
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x (N, C, H, W) at grid (N, Ho, Wo, 2) -> (N, C, Ho, Wo);
+    5-D x (N, C, D, H, W) + grid (N, Do, Ho, Wo, 3) -> (N, C, Do, Ho, Wo).
+
+    Reference: nn/functional/vision.py:130.  Differentiable in x and grid.
+    """
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"mode should be 'bilinear' or 'nearest', got {mode}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            "padding_mode should be 'zeros', 'border' or 'reflection', "
+            f"got {padding_mode}")
+    nd = len(x.shape) - 2
+    if len(grid.shape) != nd + 2 or grid.shape[-1] != nd:
+        raise ValueError(
+            f"grid shape {tuple(grid.shape)} does not match x shape "
+            f"{tuple(x.shape)}: expected (N, *out_sizes, {nd})")
+    return apply_op("grid_sample", _grid_sample_impl, x, grid, mode=mode,
+                    padding_mode=padding_mode,
+                    align_corners=bool(align_corners))

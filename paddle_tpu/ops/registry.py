@@ -649,3 +649,17 @@ register("nn.functional.lstm_cell", sample=_rnn_cell_sample("lstm"),
          tol=_LOOSE, sharding="contract")
 register("nn.functional.gru_cell", sample=_rnn_cell_sample("gru"),
          tol=_LOOSE, sharding="contract")
+
+
+# --- spatial transformers (nn/functional/vision.py; reference
+# nn/functional/vision.py:26,130) --------------------------------------------
+
+register("nn.functional.affine_grid", sharding="broadcast",
+         sample=lambda rng: ((rng.standard_normal((2, 2, 3))
+                              .astype(np.float32),),
+                             {"out_shape": [2, 3, 4, 5]}))
+register("nn.functional.grid_sample", sharding="gather", tol=_LOOSE,
+         sample=lambda rng: ((rng.standard_normal((2, 3, 5, 6))
+                              .astype(np.float32),
+                              (rng.standard_normal((2, 4, 4, 2)) * 0.9)
+                              .astype(np.float32)), {}))

@@ -1,0 +1,122 @@
+"""affine_grid / grid_sample parity vs torch (independent oracle; the
+reference's kernels match torch semantics for these ops) + grad flow.
+Reference: python/paddle/nn/functional/vision.py:26,130.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+torch = pytest.importorskip("torch")
+
+
+def _t(a):
+    return torch.from_numpy(np.asarray(a))
+
+
+class TestAffineGrid:
+    @pytest.mark.parametrize("align", [True, False])
+    def test_2d_matches_torch(self, align):
+        theta = np.random.randn(3, 2, 3).astype("float32")
+        ours = F.affine_grid(paddle.to_tensor(theta), [3, 4, 5, 6],
+                             align_corners=align).numpy()
+        ref = torch.nn.functional.affine_grid(
+            _t(theta), (3, 4, 5, 6), align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("align", [True, False])
+    def test_3d_matches_torch(self, align):
+        theta = np.random.randn(2, 3, 4).astype("float32")
+        ours = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5, 6],
+                             align_corners=align).numpy()
+        ref = torch.nn.functional.affine_grid(
+            _t(theta), (2, 3, 4, 5, 6), align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+    def test_reference_docstring_example(self):
+        theta = paddle.to_tensor(
+            np.array([[[-0.7, -0.4, 0.3], [0.6, 0.5, 1.5]]], "float32"))
+        y = F.affine_grid(theta, [1, 2, 3, 3], align_corners=False)
+        np.testing.assert_allclose(
+            y.numpy()[0, 0, 0], [1.0333333, 0.76666665], rtol=1e-5)
+
+    def test_bad_theta_shape(self):
+        with pytest.raises(ValueError):
+            F.affine_grid(paddle.to_tensor(np.zeros((1, 4, 3), "float32")),
+                          [1, 1, 2, 2])
+
+
+class TestGridSample2D:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    @pytest.mark.parametrize("align", [True, False])
+    def test_matches_torch(self, mode, pad, align):
+        rng = np.random.default_rng(hash((mode, pad, align)) % 2**31)
+        x = rng.standard_normal((2, 3, 5, 7)).astype("float32")
+        # grid reaching well outside [-1, 1] to exercise padding
+        grid = (rng.standard_normal((2, 4, 6, 2)) * 1.5).astype("float32")
+        ours = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             mode=mode, padding_mode=pad,
+                             align_corners=align).numpy()
+        ref = torch.nn.functional.grid_sample(
+            _t(x), _t(grid), mode=mode, padding_mode=pad,
+            align_corners=align).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+    def test_identity_resample(self):
+        x = paddle.to_tensor(np.random.randn(2, 3, 5, 7).astype("float32"))
+        th = paddle.to_tensor(
+            np.tile(np.array([[1, 0, 0], [0, 1, 0]], "float32"), (2, 1, 1)))
+        for ac in (True, False):
+            g = F.affine_grid(th, [2, 3, 5, 7], align_corners=ac)
+            out = F.grid_sample(x, g, align_corners=ac)
+            np.testing.assert_allclose(out.numpy(), x.numpy(),
+                                       rtol=1e-4, atol=1e-5)
+
+    def test_grad_flows_to_x_and_grid(self):
+        x = paddle.to_tensor(np.random.randn(1, 2, 4, 4).astype("float32"),
+                             stop_gradient=False)
+        grid = paddle.to_tensor(
+            (np.random.rand(1, 3, 3, 2) * 1.6 - 0.8).astype("float32"),
+            stop_gradient=False)
+        out = F.grid_sample(x, grid)
+        (out * out).sum().backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+        assert grid.grad is not None and np.isfinite(grid.grad.numpy()).all()
+        assert np.abs(grid.grad.numpy()).sum() > 0
+
+    def test_validation(self):
+        x = paddle.to_tensor(np.zeros((1, 1, 2, 2), "float32"))
+        g = paddle.to_tensor(np.zeros((1, 2, 2, 2), "float32"))
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, mode="bicubic")
+        with pytest.raises(ValueError):
+            F.grid_sample(x, g, padding_mode="wrap")
+
+
+class TestGridSample3D:
+    @pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+    @pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+    def test_matches_torch(self, mode, pad):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((2, 2, 3, 4, 5)).astype("float32")
+        grid = (rng.standard_normal((2, 2, 3, 4, 3)) * 1.4).astype("float32")
+        ours = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                             mode=mode, padding_mode=pad,
+                             align_corners=True).numpy()
+        ref = torch.nn.functional.grid_sample(
+            _t(x), _t(grid), mode=mode, padding_mode=pad,
+            align_corners=True).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_grid_rank_mismatch_raises():
+    x = paddle.to_tensor(np.zeros((1, 1, 2, 2), "float32"))
+    g3 = paddle.to_tensor(np.zeros((1, 2, 2, 2, 3), "float32"))
+    with pytest.raises(ValueError):
+        F.grid_sample(x, g3)
+    g_bad = paddle.to_tensor(np.zeros((1, 2, 2, 3), "float32"))
+    with pytest.raises(ValueError):
+        F.grid_sample(x, g_bad)
