@@ -41,10 +41,16 @@ def _raw(x):
 
 
 class SparseCooTensor:
-    """COO sparse tensor over a BCOO core (indices (nnz, ndim), values)."""
+    """COO sparse tensor over a BCOO core (indices (nnz, ndim), values).
 
-    def __init__(self, bcoo: jsparse.BCOO):
+    When produced by a differentiable sparse op, `_values_t` carries the
+    tape-linked values Tensor so that `.values()` (and anything chained on
+    it) participates in backward; the BCOO itself holds raw arrays.
+    """
+
+    def __init__(self, bcoo: jsparse.BCOO, values_t=None):
         self._bcoo = bcoo
+        self._values_t = values_t
 
     # -- paddle surface -----------------------------------------------------
     @property
@@ -59,12 +65,24 @@ class SparseCooTensor:
         return to_tensor(self._bcoo.indices.T)  # paddle: (ndim, nnz)
 
     def values(self):
+        if self._values_t is not None:
+            return self._values_t
         return to_tensor(self._bcoo.data)
 
     def nnz(self):
         return int(self._bcoo.nse)
 
     def to_dense(self):
+        if self._values_t is not None:
+            # keep the tape link: scatter the taped values into the dense
+            # result so conv -> to_dense -> loss backprops to the weights
+            from ..tensor import apply_op
+            idx = tuple(np.asarray(self._bcoo.indices).T)
+            shape = tuple(self._bcoo.shape)
+            return apply_op(
+                "sparse_to_dense",
+                lambda v: jnp.zeros(shape, v.dtype).at[idx].add(v),
+                self._values_t)
         return to_tensor(self._bcoo.todense())
 
     def to_sparse_csr(self):
@@ -193,11 +211,15 @@ def is_same_shape(x, y) -> bool:
 
 
 def _unary(fname, fn):
+    from ..tensor import apply_op
+
     def op(x, name=None):
         if isinstance(x, SparseCooTensor):
             b = x._bcoo
+            out = apply_op(f"sparse_{fname}", fn, x.values())
             return SparseCooTensor(
-                jsparse.BCOO((fn(b.data), b.indices), shape=b.shape))
+                jsparse.BCOO((out._data, b.indices), shape=b.shape),
+                values_t=out)
         if isinstance(x, SparseCsrTensor):
             return SparseCsrTensor(x._crows, x._cols, fn(x._values), x._shape)
         raise TypeError(f"sparse.{fname} expects a sparse tensor")

@@ -9,7 +9,9 @@ from jax.experimental import sparse as jsparse
 
 from ...nn.layer import Layer
 
-__all__ = ["ReLU", "LeakyReLU", "ReLU6", "Softmax", "functional"]
+__all__ = ["ReLU", "LeakyReLU", "ReLU6", "Softmax", "functional",
+           "Conv2D", "Conv3D", "SubmConv2D", "SubmConv3D",
+           "BatchNorm", "SyncBatchNorm", "MaxPool3D"]
 
 
 class _ValueAct(Layer):
@@ -66,3 +68,7 @@ class Softmax(Layer):
 
 
 from . import functional  # noqa: E402,F401
+from .layer_conv import (  # noqa: E402,F401
+    Conv2D, Conv3D, SubmConv2D, SubmConv3D,
+    BatchNorm, SyncBatchNorm, MaxPool3D,
+)

@@ -4,7 +4,9 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["relu", "relu6", "leaky_relu", "softmax"]
+__all__ = ["relu", "relu6", "leaky_relu", "softmax",
+           "conv3d", "subm_conv3d", "conv2d", "subm_conv2d",
+           "max_pool3d"]
 
 
 def relu(x, name=None):
@@ -26,3 +28,8 @@ def leaky_relu(x, negative_slope=0.01, name=None):
 def softmax(x, axis=-1, name=None):
     from .. import Softmax
     return Softmax(axis=axis)(x)
+
+
+from .conv import (  # noqa: E402,F401
+    conv3d, subm_conv3d, conv2d, subm_conv2d, max_pool3d,
+)

@@ -1,0 +1,239 @@
+"""Sparse convolution / pooling functionals (gather-scatter over COO sites).
+
+Reference: python/paddle/sparse/nn/functional/conv.py (conv3d/subm_conv3d,
+conv2d/subm_conv2d), pooling.py (max_pool3d).  The reference lowers to
+gather-gemm-scatter CUDA kernels over a precomputed "rulebook" (offset ->
+(input row, output row) pairs); here the rulebook is built eagerly in numpy
+from the concrete COO indices, and the value computation is ONE tape op:
+a static python loop over kernel offsets of gather -> (m, Cin) @ (Cin,
+Cout) -> scatter-add, which XLA fuses per offset.  Gradients flow to
+values, weight and bias through the op's vjp; indices are structural.
+
+Layout matches the reference: x is a hybrid SparseCooTensor with indices
+over (N, *spatial) and dense channel values (nnz, C); kernels are
+channels-last (*kernel_sizes, Cin, Cout); data_format NDHWC / NHWC only.
+"""
+
+from __future__ import annotations
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ....tensor import Tensor, apply_op
+
+__all__ = ["conv3d", "subm_conv3d", "conv2d", "subm_conv2d", "max_pool3d"]
+
+
+def _tuple(v, n, name):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    v = tuple(int(e) for e in v)
+    if len(v) != n:
+        raise ValueError(f"{name} should have {n} elements, got {v}")
+    return v
+
+
+def _check_input(x, n_sp, op):
+    from ... import SparseCooTensor
+    if not isinstance(x, SparseCooTensor):
+        raise ValueError(f"{op} expects a SparseCooTensor input")
+    b = x._bcoo
+    if b.indices.shape[1] != n_sp + 1 or b.data.ndim != 2:
+        raise ValueError(
+            f"{op} expects hybrid COO indices over (N, {n_sp} spatial dims) "
+            f"with dense channels; got indices over {b.indices.shape[1]} "
+            f"dims, values ndim {b.data.ndim}")
+    return b
+
+
+def _rulebook(idx, sp_shape, out_sp, ksizes, stride, padding, dilation,
+              subm):
+    """Offset -> (input rows, output site keys); then unify the output-site
+    set.  All-numpy over concrete indices (the reference's rulebook build,
+    sparse/gpu/conv_kernel.cu, done host-side)."""
+    n_sp = len(ksizes)
+    batch = idx[:, 0].astype(np.int64)
+    coords = idx[:, 1:].astype(np.int64)                       # (nnz, n_sp)
+
+    def key_of(b, c):                                          # linearize
+        k = b
+        for d in range(n_sp):
+            k = k * out_sp[d] + c[:, d]
+        return k
+
+    sel_rows, out_keys = [], []
+    for off in itertools.product(*[range(k) for k in ksizes]):
+        num = coords + np.array([padding[d] - off[d] * dilation[d]
+                                 for d in range(n_sp)])
+        q, r = np.divmod(num, np.array(stride))
+        ok = (r == 0).all(1)
+        for d in range(n_sp):
+            ok &= (q[:, d] >= 0) & (q[:, d] < out_sp[d])
+        rows = np.nonzero(ok)[0]
+        sel_rows.append(rows)
+        out_keys.append(key_of(batch[rows], q[rows]))
+
+    if subm:
+        site_keys = key_of(batch, coords)                      # out == in
+        order = np.argsort(site_keys, kind="stable")
+        skeys = site_keys[order]
+        out_ids = []
+        for oi in range(len(sel_rows)):
+            pos = np.searchsorted(skeys, out_keys[oi])
+            pos_c = np.minimum(pos, len(skeys) - 1) if len(skeys) else pos
+            found = (pos < len(skeys)) & (skeys[pos_c] == out_keys[oi])
+            sel_rows[oi] = sel_rows[oi][found]
+            out_ids.append(order[pos[found]])
+        uniq = site_keys
+    else:
+        allk = np.concatenate(out_keys) if out_keys else np.zeros(0, np.int64)
+        uniq, inv = np.unique(allk, return_inverse=True)
+        out_ids, p = [], 0
+        for oi in range(len(sel_rows)):
+            m = len(sel_rows[oi])
+            out_ids.append(inv[p:p + m])
+            p += m
+
+    # un-linearize the unique output keys back to coordinates
+    rem = uniq.copy()
+    cols = []
+    for d in reversed(range(n_sp)):
+        rem, c = np.divmod(rem, out_sp[d])
+        cols.append(c)
+    out_idx = np.stack([rem] + cols[::-1], axis=1).astype(np.int32)
+    return sel_rows, out_ids, out_idx
+
+
+def _sparse_conv(x, weight, bias, stride, padding, dilation, groups, subm,
+                 n_sp, op):
+    from ... import SparseCooTensor
+    if groups != 1:
+        raise NotImplementedError(f"{op}: only groups=1 is supported")
+    b = _check_input(x, n_sp, op)
+    w = weight._data if isinstance(weight, Tensor) else jnp.asarray(weight)
+    if w.ndim != n_sp + 2:
+        raise ValueError(f"{op} kernel must be (*k_sizes, Cin, Cout), got "
+                         f"shape {tuple(w.shape)}")
+    ksizes = tuple(int(s) for s in w.shape[:n_sp])
+    stride = _tuple(stride, n_sp, "stride")
+    padding = _tuple(padding, n_sp, "padding")
+    dilation = _tuple(dilation, n_sp, "dilation")
+    if subm:
+        if any(s != 1 for s in stride):
+            raise ValueError(f"{op}: submanifold conv requires stride 1 "
+                             "(output sites are the input sites)")
+        # the reference ALWAYS centers the subm kernel: paddings are reset
+        # to kernel/2 regardless of the caller's value
+        # (paddle/phi/kernels/funcs/sparse/convolution.h:146
+        # ResetSubmKernelSizeAndStrides)
+        padding = tuple(dilation[d] * (ksizes[d] - 1) // 2
+                        for d in range(n_sp))
+    shape = x.shape
+    sp_shape = shape[1:-1]
+    if subm:
+        out_sp = tuple(sp_shape)
+    else:
+        out_sp = tuple(
+            (sp_shape[d] + 2 * padding[d]
+             - dilation[d] * (ksizes[d] - 1) - 1) // stride[d] + 1
+            for d in range(n_sp))
+    idx = np.asarray(b.indices)
+    sel_rows, out_ids, out_idx = _rulebook(
+        idx, sp_shape, out_sp, ksizes, stride, padding, dilation, subm)
+    n_out = out_idx.shape[0]
+    cout = int(w.shape[-1])
+    K = int(np.prod(ksizes))
+
+    def fn(vals, w, bias):
+        wf = w.reshape(K, w.shape[-2], w.shape[-1])
+        out = jnp.zeros((n_out, cout), vals.dtype)
+        for oi in range(K):
+            if len(sel_rows[oi]) == 0:
+                continue
+            contrib = vals[sel_rows[oi]] @ wf[oi].astype(vals.dtype)
+            out = out.at[out_ids[oi]].add(contrib)
+        if bias is not None:
+            out = out + bias.astype(vals.dtype)
+        return out
+
+    out_vals = apply_op(f"sparse_{op}", fn, x.values(), weight, bias)
+    out_shape = (shape[0], *out_sp, cout)
+    return SparseCooTensor(jsparse.BCOO(
+        (out_vals._data, jnp.asarray(out_idx)), shape=out_shape),
+        values_t=out_vals)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Reference sparse/nn/functional/conv.py conv3d."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse conv3d only supports NDHWC")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        subm=False, n_sp=3, op="conv3d")
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: output sites == input sites (no dilation of the
+    active set across layers).  Reference subm_conv3d."""
+    if data_format != "NDHWC":
+        raise ValueError("sparse subm_conv3d only supports NDHWC")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        subm=True, n_sp=3, op="subm_conv3d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NHWC", name=None):
+    if data_format != "NHWC":
+        raise ValueError("sparse conv2d only supports NHWC")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        subm=False, n_sp=2, op="conv2d")
+
+
+def subm_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NHWC", key=None, name=None):
+    if data_format != "NHWC":
+        raise ValueError("sparse subm_conv2d only supports NHWC")
+    return _sparse_conv(x, weight, bias, stride, padding, dilation, groups,
+                        subm=True, n_sp=2, op="subm_conv2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Max pooling over active sites only (reference sparse pooling.py:
+    windows with no active input produce no output site)."""
+    from ... import SparseCooTensor
+    if data_format != "NDHWC":
+        raise ValueError("sparse max_pool3d only supports NDHWC")
+    if ceil_mode:
+        raise NotImplementedError("sparse max_pool3d: ceil_mode")
+    b = _check_input(x, 3, "max_pool3d")
+    ksizes = _tuple(kernel_size, 3, "kernel_size")
+    stride = _tuple(stride if stride is not None else kernel_size, 3,
+                    "stride")
+    padding = _tuple(padding, 3, "padding")
+    shape = x.shape
+    sp_shape = shape[1:-1]
+    out_sp = tuple((sp_shape[d] + 2 * padding[d] - ksizes[d]) // stride[d] + 1
+                   for d in range(3))
+    idx = np.asarray(b.indices)
+    sel_rows, out_ids, out_idx = _rulebook(
+        idx, sp_shape, out_sp, ksizes, stride, padding, (1, 1, 1), False)
+    n_out = out_idx.shape[0]
+    C = int(b.data.shape[-1])
+
+    def fn(vals):
+        out = jnp.full((n_out, C), -jnp.inf, vals.dtype)
+        for oi in range(len(sel_rows)):
+            if len(sel_rows[oi]) == 0:
+                continue
+            out = out.at[out_ids[oi]].max(vals[sel_rows[oi]])
+        return out
+
+    out_vals = apply_op("sparse_max_pool3d", fn, x.values())
+    return SparseCooTensor(jsparse.BCOO(
+        (out_vals._data, jnp.asarray(out_idx)),
+        shape=(shape[0], *out_sp, C)), values_t=out_vals)

@@ -1,0 +1,212 @@
+"""Sparse NN layers: conv, norm, pooling.
+
+Reference: python/paddle/sparse/nn/layer/conv.py:27 (_Conv3D/_Conv2D,
+Conv3D:239, SubmConv3D:509, Conv2D:374, SubmConv2D:649), norm.py:99
+(BatchNorm), :305 (SyncBatchNorm), pooling.py:75 (MaxPool3D).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...nn import initializer as I
+from ...nn.layer import Layer
+from ...tensor import apply_op
+from . import functional as F
+
+
+def _tuple(v, n):
+    return (int(v),) * n if isinstance(v, (int, np.integer)) \
+        else tuple(int(e) for e in v)
+
+
+class _ConvND(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, subm=False, key=None,
+                 padding_mode="zeros", weight_attr=None, bias_attr=None,
+                 data_format="NDHWC", n_sp=3):
+        super().__init__()
+        if padding_mode != "zeros":
+            raise NotImplementedError(
+                "sparse conv only supports padding_mode='zeros'")
+        if groups != 1:
+            raise NotImplementedError("sparse conv only supports groups=1")
+        self.in_channels = in_channels
+        self.out_channels = out_channels
+        self._n_sp = n_sp
+        self._kernel_size = _tuple(kernel_size, n_sp)
+        self._stride = _tuple(stride, n_sp)
+        self._padding = _tuple(padding, n_sp)
+        self._dilation = _tuple(dilation, n_sp)
+        self._subm = subm
+        self._data_format = data_format
+        fan_in = in_channels * int(np.prod(self._kernel_size))
+        std = 1.0 / math.sqrt(fan_in)
+        self.weight = self.create_parameter(
+            (*self._kernel_size, in_channels, out_channels), weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter(
+            (out_channels,), bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, x):
+        fn = {(3, False): F.conv3d, (3, True): F.subm_conv3d,
+              (2, False): F.conv2d, (2, True): F.subm_conv2d}[
+                  (self._n_sp, self._subm)]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  data_format=self._data_format)
+
+
+class Conv3D(_ConvND):
+    """Reference sparse/nn/layer/conv.py:239."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, None,
+                         padding_mode, weight_attr, bias_attr, data_format, 3)
+
+
+class SubmConv3D(_ConvND):
+    """Reference sparse/nn/layer/conv.py:509."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, key,
+                         padding_mode, weight_attr, bias_attr, data_format, 3)
+
+
+class Conv2D(_ConvND):
+    """Reference sparse/nn/layer/conv.py:374."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, False, None,
+                         padding_mode, weight_attr, bias_attr, data_format, 2)
+
+
+class SubmConv2D(_ConvND):
+    """Reference sparse/nn/layer/conv.py:649."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, True, key,
+                         padding_mode, weight_attr, bias_attr, data_format, 2)
+
+
+class BatchNorm(Layer):
+    """Batch norm over the dense channel values of a sparse tensor — the
+    nnz sites are the batch (reference sparse/nn/layer/norm.py:99, which
+    reuses dense BN over the value tensor the same way).
+    """
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), weight_attr, default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            (num_features,), bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", jnp.zeros((num_features,), jnp.float32))
+        self.register_buffer("_variance",
+                             jnp.ones((num_features,), jnp.float32))
+
+    def forward(self, x):
+        from .. import SparseCooTensor
+        from jax.experimental import sparse as jsparse
+
+        b = x._bcoo
+        vals = x.values()
+        use_running = (self._use_global_stats
+                       or (self._use_global_stats is None
+                           and not self.training))
+        if use_running:
+            mean, var = self._mean._data, self._variance._data
+        else:
+            # running-stat update happens outside the recorded op (no grad);
+            # the NORMALIZING stats are recomputed INSIDE fn so the vjp
+            # carries the d(mean)/dx and d(var)/dx terms (same reasoning as
+            # the dense batch_norm, nn/functional/__init__.py batch_norm)
+            raw = vals._data.astype(jnp.float32)
+            m = self._momentum
+            self._mean._data = (m * self._mean._data
+                                + (1 - m) * raw.mean(axis=0))
+            self._variance._data = (m * self._variance._data
+                                    + (1 - m) * raw.var(axis=0))
+            mean = var = None
+
+        def fn(v, w, bias):
+            vf = v.astype(jnp.float32)
+            mu = mean if mean is not None else vf.mean(axis=0)
+            vr = var if var is not None else vf.var(axis=0)
+            vn = (vf - mu) / jnp.sqrt(vr + self._epsilon)
+            return (vn * w + bias).astype(v.dtype)
+
+        out = apply_op("sparse_batch_norm", fn, vals, self.weight, self.bias)
+        return SparseCooTensor(jsparse.BCOO((out._data, b.indices),
+                                            shape=b.shape), values_t=out)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Reference sparse/nn/layer/norm.py:305.  Under pjit/GSPMD the batch
+    statistics are computed over the GLOBAL value set automatically (XLA
+    inserts the cross-device reductions), so sync == plain BatchNorm here.
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, BatchNorm) and not isinstance(layer,
+                                                           SyncBatchNorm):
+            new = SyncBatchNorm(layer.weight.shape[0],
+                                momentum=layer._momentum,
+                                epsilon=layer._epsilon,
+                                use_global_stats=layer._use_global_stats)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            # the learned running stats must survive conversion
+            # (reference nn/layer/norm.py:1755 copies both buffers)
+            new._mean._data = layer._mean._data
+            new._variance._data = layer._variance._data
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class MaxPool3D(Layer):
+    """Reference sparse/nn/layer/pooling.py:75."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        if return_mask:
+            raise NotImplementedError("sparse MaxPool3D: return_mask")
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.max_pool3d(x, self._kernel_size, self._stride,
+                            self._padding, self._ceil_mode,
+                            self._data_format)

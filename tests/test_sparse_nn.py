@@ -1,0 +1,268 @@
+"""paddle.sparse.nn tests — conv/pool/norm parity vs dense math on
+densified inputs + a tiny point-cloud training loop.
+Reference: python/paddle/sparse/nn/layer/{conv,norm,pooling}.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _cloud(seed=0, n=24, batch=2, size=6, ch=3):
+    rng = np.random.default_rng(seed)
+    idx = np.unique(np.stack([
+        rng.integers(0, batch, n), rng.integers(0, size, n),
+        rng.integers(0, size, n), rng.integers(0, size, n)]), axis=1)
+    vals = rng.standard_normal((idx.shape[1], ch)).astype("float32")
+    x = sparse.sparse_coo_tensor(idx, vals,
+                                 shape=[batch, size, size, size, ch])
+    dense = np.zeros((batch, size, size, size, ch), "float32")
+    dense[tuple(idx)] = vals
+    return x, dense, idx
+
+
+def _dense_conv(dense, w, stride=1, padding=1, nd=3):
+    fmt = ("NDHWC", "DHWIO", "NDHWC") if nd == 3 else ("NHWC", "HWIO", "NHWC")
+    s = (stride,) * nd if isinstance(stride, int) else stride
+    p = [(padding, padding)] * nd if isinstance(padding, int) else padding
+    return np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(dense), w, window_strides=s, padding=p,
+        dimension_numbers=fmt))
+
+
+class TestSparseConv:
+    def test_conv3d_matches_dense(self):
+        x, dense, _ = _cloud()
+        conv = sparse.nn.Conv3D(3, 4, 3, padding=1, bias_attr=False)
+        out = conv(x).to_dense().numpy()
+        ref = _dense_conv(dense, conv.weight._data)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_conv3d_stride2(self):
+        x, dense, _ = _cloud()
+        conv = sparse.nn.Conv3D(3, 4, 2, stride=2, bias_attr=False)
+        out = conv(x).to_dense().numpy()
+        ref = _dense_conv(dense, conv.weight._data, stride=2, padding=0)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv3d_keeps_sites(self):
+        x, dense, idx = _cloud()
+        conv = sparse.nn.SubmConv3D(3, 4, 3, padding=1, bias_attr=False)
+        out = conv(x)
+        assert out.nnz() == x.nnz()
+        ref = _dense_conv(dense, conv.weight._data)
+        np.testing.assert_allclose(out.values().numpy(), ref[tuple(idx)],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_conv2d_matches_dense(self):
+        rng = np.random.default_rng(1)
+        idx = np.unique(np.stack([rng.integers(0, 2, 15),
+                                  rng.integers(0, 5, 15),
+                                  rng.integers(0, 5, 15)]), axis=1)
+        vals = rng.standard_normal((idx.shape[1], 3)).astype("float32")
+        x = sparse.sparse_coo_tensor(idx, vals, shape=[2, 5, 5, 3])
+        dense = np.zeros((2, 5, 5, 3), "float32")
+        dense[tuple(idx)] = vals
+        conv = sparse.nn.Conv2D(3, 4, 3, padding=1, bias_attr=False)
+        out = conv(x).to_dense().numpy()
+        ref = _dense_conv(dense, conv.weight._data, nd=2)
+        np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv2d(self):
+        rng = np.random.default_rng(2)
+        idx = np.unique(np.stack([rng.integers(0, 1, 10),
+                                  rng.integers(0, 5, 10),
+                                  rng.integers(0, 5, 10)]), axis=1)
+        vals = rng.standard_normal((idx.shape[1], 2)).astype("float32")
+        x = sparse.sparse_coo_tensor(idx, vals, shape=[1, 5, 5, 2])
+        out = sparse.nn.SubmConv2D(2, 3, 3, padding=1)(x)
+        assert out.nnz() == x.nnz() and out.shape == [1, 5, 5, 3]
+
+    def test_bias_applied_at_active_sites(self):
+        x, _, _ = _cloud()
+        conv = sparse.nn.SubmConv3D(3, 4, 3, padding=1)
+        no_b = sparse.nn.SubmConv3D(3, 4, 3, padding=1, bias_attr=False)
+        no_b.weight._data = conv.weight._data
+        d = conv(x).values().numpy() - no_b(x).values().numpy()
+        np.testing.assert_allclose(
+            d, np.broadcast_to(conv.bias.numpy(), d.shape),
+            rtol=1e-5, atol=1e-6)
+
+    def test_grad_reaches_weight_and_values(self):
+        x, _, _ = _cloud()
+        conv = sparse.nn.SubmConv3D(3, 4, 3, padding=1)
+        out = conv(x)
+        (out.values() ** 2).sum().backward()
+        assert conv.weight.grad is not None
+        assert np.abs(conv.weight.grad.numpy()).sum() > 0
+        assert conv.bias.grad is not None
+
+    def test_subm_stride_rejected(self):
+        x, _, _ = _cloud()
+        with pytest.raises(ValueError):
+            sparse.nn.SubmConv3D(3, 4, 3, stride=2)(x)
+
+    def test_dense_input_rejected(self):
+        conv = sparse.nn.Conv3D(3, 4, 3)
+        with pytest.raises(ValueError):
+            conv(paddle.to_tensor(np.zeros((1, 4, 4, 4, 3), "float32")))
+
+
+class TestSparsePoolNorm:
+    def test_max_pool3d_matches_dense_on_positive(self):
+        x, dense, _ = _cloud()
+        xp = sparse.sparse_coo_tensor(
+            np.asarray(x._bcoo.indices.T), np.abs(x._bcoo.data) + 0.1,
+            shape=x.shape)
+        dp = np.zeros_like(dense)
+        dp[tuple(np.asarray(x._bcoo.indices.T))] = np.asarray(xp._bcoo.data)
+        out = sparse.nn.MaxPool3D(2, stride=2)(xp)
+        ref = np.asarray(jax.lax.reduce_window(
+            jnp.asarray(dp), -jnp.inf, jax.lax.max,
+            (1, 2, 2, 2, 1), (1, 2, 2, 2, 1), "VALID"))
+        od = out.to_dense().numpy()
+        active = od != 0
+        np.testing.assert_allclose(od[active], ref[active],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_batch_norm_train_and_eval(self):
+        x, _, _ = _cloud(ch=4)
+        bn = sparse.nn.BatchNorm(4)
+        out = bn(x)
+        v = out.values().numpy()
+        np.testing.assert_allclose(v.mean(0), 0, atol=1e-4)
+        np.testing.assert_allclose(v.std(0), 1, atol=1e-2)
+        assert np.abs(bn._variance.numpy() - 1).sum() > 0  # stats updated
+        bn.eval()
+        v2 = bn(x).values().numpy()
+        assert not np.allclose(v, v2)
+
+    def test_batch_norm_grads(self):
+        x, _, _ = _cloud(ch=4)
+        bn = sparse.nn.BatchNorm(4)
+        (bn(x).values() ** 2).sum().backward()
+        assert bn.weight.grad is not None and bn.bias.grad is not None
+
+    def test_sync_batch_norm_convert(self):
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = sparse.nn.SubmConv3D(3, 4, 3, padding=1)
+                self.bn = sparse.nn.BatchNorm(4)
+
+        net = sparse.nn.SyncBatchNorm.convert_sync_batchnorm(Net())
+        assert isinstance(net.bn, sparse.nn.SyncBatchNorm)
+
+    def test_relu_on_conv_output(self):
+        x, _, _ = _cloud()
+        out = sparse.nn.ReLU()(sparse.nn.SubmConv3D(3, 4, 3, padding=1)(x))
+        assert (out.values().numpy() >= 0).all()
+
+
+class TestPointCloudTraining:
+    def test_tiny_pointnet_trains(self):
+        """SubmConv -> BN -> ReLU -> pool -> dense head: loss decreases on a
+        2-class synthetic point-cloud set (the reference sparse.nn demo
+        workload shape)."""
+        import paddle_tpu.nn.functional as NF
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.c1 = sparse.nn.SubmConv3D(3, 8, 3, padding=1)
+                self.bn1 = sparse.nn.BatchNorm(8)
+                self.act = sparse.nn.ReLU()
+                self.pool = sparse.nn.MaxPool3D(2, stride=2)
+                self.head = paddle.nn.Linear(8, 2)
+
+            def forward(self, x):
+                h = self.act(self.bn1(self.c1(x)))
+                h = self.pool(h)
+                # global mean over active sites per batch row
+                idx = h._bcoo.indices[:, 0]
+                vals = h.values()
+                from paddle_tpu.tensor import apply_op
+                pooled = apply_op(
+                    "seg_mean",
+                    lambda v: jax.ops.segment_sum(v, idx, num_segments=2)
+                    / jnp.maximum(jax.ops.segment_sum(
+                        jnp.ones((v.shape[0], 1), v.dtype), idx,
+                        num_segments=2), 1.0),
+                    vals)
+                return self.head(pooled)
+
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters())
+        clouds = []
+        for s in range(4):
+            x, _, idx = _cloud(seed=s, n=30)
+            y = np.array([0, 1], "int64")
+            clouds.append((x, paddle.to_tensor(y)))
+        losses = []
+        for _ in range(6):
+            tot = 0.0
+            for x, y in clouds:
+                logits = net(x)
+                loss = NF.cross_entropy(logits, y)
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                tot += float(loss.numpy())
+            losses.append(tot)
+        assert losses[-1] < losses[0] * 0.9, losses
+
+
+class TestReviewRegressions:
+    def test_subm_padding_is_always_centered(self):
+        """Reference resets subm paddings to kernel//2 regardless of the
+        caller's value (phi/kernels/funcs/sparse/convolution.h:146)."""
+        x, dense, idx = _cloud()
+        c0 = sparse.nn.SubmConv3D(3, 4, 3, padding=0, bias_attr=False)
+        c1 = sparse.nn.SubmConv3D(3, 4, 3, padding=1, bias_attr=False)
+        c1.weight._data = c0.weight._data
+        np.testing.assert_allclose(c0(x).values().numpy(),
+                                   c1(x).values().numpy())
+        ref = _dense_conv(dense, c0.weight._data, padding=1)
+        np.testing.assert_allclose(c0(x).values().numpy(), ref[tuple(idx)],
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_to_dense_backprops_to_weight(self):
+        x, _, _ = _cloud()
+        conv = sparse.nn.Conv3D(3, 4, 3, padding=1)
+        out = conv(x).to_dense()
+        (out * out).sum().backward()
+        assert conv.weight.grad is not None
+        assert np.abs(conv.weight.grad.numpy()).sum() > 0
+
+    def test_batch_norm_value_grad_has_centering_terms(self):
+        """True BN gradient: per-channel sum of dL/dx vanishes when dL/dy
+        is constant (the d(mean)/dx term cancels it)."""
+        x, _, _ = _cloud(ch=4)
+        bn = sparse.nn.BatchNorm(4)
+        xv = x.values()
+        xv.stop_gradient = False
+        x._values_t = xv
+        out = bn(x)
+        out.values().sum().backward()
+        g = xv.grad.numpy()
+        np.testing.assert_allclose(g.sum(axis=0), np.zeros(4), atol=1e-4)
+
+    def test_sync_convert_preserves_running_stats(self):
+        bn = sparse.nn.BatchNorm(4)
+        x, _, _ = _cloud(ch=4)
+        bn(x)  # update stats
+        m, v = bn._mean.numpy().copy(), bn._variance.numpy().copy()
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.bn = bn
+
+        net = sparse.nn.SyncBatchNorm.convert_sync_batchnorm(Net())
+        np.testing.assert_allclose(net.bn._mean.numpy(), m)
+        np.testing.assert_allclose(net.bn._variance.numpy(), v)
