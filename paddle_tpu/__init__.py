@@ -42,6 +42,7 @@ from .serialization import save, load  # noqa: E402
 from . import metric  # noqa: E402
 from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
+from . import hub  # noqa: E402
 from . import hapi  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402

@@ -75,6 +75,8 @@ class Categorical(Distribution):
 
 class Multinomial(Distribution):
     def __init__(self, total_count, probs, name=None):
+        if int(total_count) < 1:
+            raise ValueError("total_count should be >= 1")
         self.total_count = int(total_count)
         p = _fv(probs)
         self._probs = p / p.sum(-1, keepdims=True)
@@ -108,9 +110,23 @@ class Multinomial(Distribution):
                  - jax.lax.lgamma(v + 1.0).sum(-1))
         return _wrap(coeff + (v * logp).sum(-1))
 
+    def prob(self, value):
+        return _wrap(jnp.exp(_v(self.log_prob(value))))
+
     def entropy(self):
-        # exact entropy has no closed form; Monte-Carlo like the reference's
-        # fallback is overkill — use the standard sum approximation via samples
-        n = 256
-        s = _v(self.sample((n,)))
-        return _wrap(-_v(self.log_prob(s)).mean(0))
+        """Exact entropy via the Binomial-marginal decomposition the
+        reference uses (multinomial.py:166): H = n*H(p) - log(n!) +
+        sum_i E[log X_i!], X_i ~ Binomial(n, p_i), the expectation an
+        exact sum over the support 1..n."""
+        import jax.lax as lax
+        p = self._probs
+        n = float(self.total_count)
+        cat_ent = -(jnp.where(p > 0, p * jnp.log(p), 0.0)).sum(-1)
+        s = jnp.arange(1, self.total_count + 1, dtype=p.dtype)
+        s = s.reshape((-1,) + (1,) * p.ndim)               # (n, ..1.., 1)
+        logp = jnp.where(p > 0, jnp.log(p), -jnp.inf)
+        log1mp = jnp.log1p(-jnp.minimum(p, 1 - 1e-7))
+        log_pmf = (lax.lgamma(jnp.asarray(n + 1.0)) - lax.lgamma(s + 1.0)
+                   - lax.lgamma(n - s + 1.0) + s * logp + (n - s) * log1mp)
+        corr = (jnp.exp(log_pmf) * lax.lgamma(s + 1.0)).sum((0, -1))
+        return _wrap(n * cat_ent - lax.lgamma(jnp.asarray(n + 1.0)) + corr)

@@ -84,3 +84,15 @@ class LogNormal(Distribution):
 
     def entropy(self):
         return _wrap(_v(self.base.entropy()) + self.loc)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def probs(self, value):
+        return _wrap(jnp.exp(_v(self.log_prob(value))))
+
+    def kl_divergence(self, other):
+        if isinstance(other, LogNormal):
+            # KL is invariant under the shared exp transform -> normal KL
+            return self.base.kl_divergence(other.base)
+        return super().kl_divergence(other)

@@ -1,0 +1,105 @@
+"""paddle.hub — list / help / load model entrypoints from a hubconf.py.
+
+Reference: python/paddle/hub.py (facade) + python/paddle/hapi/hub.py
+(implementation).  The reference resolves github/gitee specs by
+downloading a tarball; this environment has zero egress, so:
+
+  * source='local'  — fully supported: repo_dir is a directory containing
+    `hubconf.py`; its public callables are the entrypoints.
+  * source='github' / 'gitee' — resolved ONLY against an existing local
+    cache (populated out of band, e.g. a pre-seeded ~/.cache/paddle/hub or
+    a `git clone` done while online); a cache miss raises with the exact
+    path it looked for, instead of attempting a download.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+_builtin_list = list
+
+
+def _hub_cache_dir():
+    root = os.environ.get("PADDLE_HUB_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle", "hub")
+    return root
+
+
+def _parse_repo_info(repo):
+    if ":" in repo:
+        repo_info, ref = repo.split(":")
+    else:
+        repo_info, ref = repo, "main"
+    owner, name = repo_info.split("/")
+    return owner, name, ref
+
+
+def _resolve_dir(repo_dir, source, force_reload):
+    if source == "local":
+        if not os.path.isdir(repo_dir):
+            raise ValueError(f"local repo dir not found: {repo_dir}")
+        return repo_dir
+    owner, name, ref = _parse_repo_info(repo_dir)
+    cached = os.path.join(_hub_cache_dir(), f"{owner}_{name}_{ref}")
+    if os.path.isdir(cached):
+        return cached
+    raise RuntimeError(
+        f"hub cache miss for {repo_dir!r} ({source}): this build has no "
+        f"network egress; place the repo at {cached} (or pass a local "
+        "path with source='local')")
+
+
+def _import_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.isfile(path):
+        raise RuntimeError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        f"paddle_tpu_hubconf_{abs(hash(repo_dir))}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("github", "gitee", "local"):
+        raise ValueError(
+            f'Unknown source: "{source}". Allowed values: "github" | '
+            '"gitee" | "local".')
+
+
+def _entry(mod, name):
+    fn = getattr(mod, name, None)
+    if fn is None or not callable(fn):
+        raise RuntimeError(f"Cannot find callable {name} in hubconf")
+    return fn
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """All public callable entrypoint names in the repo's hubconf.py."""
+    _check_source(source)
+    mod = _import_hubconf(_resolve_dir(repo_dir, source, force_reload))
+    return [f for f in dir(mod)
+            if callable(getattr(mod, f)) and not f.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Docstring of the named entrypoint."""
+    _check_source(source)
+    mod = _import_hubconf(_resolve_dir(repo_dir, source, force_reload))
+    return _entry(mod, model).__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Call the named entrypoint with **kwargs and return its result."""
+    _check_source(source)
+    mod = _import_hubconf(_resolve_dir(repo_dir, source, force_reload))
+    return _entry(mod, model)(**kwargs)

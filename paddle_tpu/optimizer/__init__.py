@@ -23,7 +23,7 @@ from ..tensor import Parameter, Tensor
 from . import lr as lr  # noqa: PLC0414
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "AdamMax",
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "AdamMax", "LBFGS",
            "RMSProp", "Adadelta", "Lamb", "lr", "LRScheduler"]
 
 
@@ -450,3 +450,6 @@ class Lamb(Optimizer):
             else:
                 p._data = new_w
             self._state[id(p)] = new_state
+
+
+from .lbfgs import LBFGS  # noqa: E402,F401

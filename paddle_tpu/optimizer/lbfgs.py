@@ -1,0 +1,216 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py:1).
+
+Classic limited-memory BFGS with the two-loop recursion over a flattened
+parameter vector, optional strong-Wolfe line search, closure-based step()
+(the closure re-evaluates loss + grads, like the reference's).  Eager-only
+by nature — each iteration re-runs the user's forward/backward.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import Optimizer
+from ..tensor import Parameter
+
+__all__ = ["LBFGS"]
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2); standard
+    safeguarded formula (Nocedal & Wright eq. 3.59)."""
+    if bounds is not None:
+        lo, hi = bounds
+    else:
+        lo, hi = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_sq = d1 ** 2 - g1 * g2
+    if d2_sq >= 0:
+        d2 = d2_sq ** 0.5
+        if x1 <= x2:
+            xm = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            xm = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(xm, lo), hi)
+    return (lo + hi) / 2.0
+
+
+class LBFGS(Optimizer):
+    """Reference optimizer/lbfgs.py — step(closure) minimizes the closure."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self._max_iter = max_iter
+        self._max_eval = max_eval if max_eval is not None \
+            else max_iter * 5 // 4
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history_size = history_size
+        self._line_search_fn = line_search_fn
+        self._s_hist: list = []
+        self._y_hist: list = []
+        self._rho: list = []
+        self._prev_grad = None
+        self._n_evals = 0
+
+    # -- flat views ---------------------------------------------------------
+    def _params(self):
+        ps = [p for p in (self._parameter_list or [])
+              if isinstance(p, Parameter) and p.trainable]
+        if not ps:
+            raise ValueError("LBFGS requires parameters=")
+        return ps
+
+    def _flat_grad(self, params):
+        gs = []
+        for p in params:
+            g = p.grad._data if p.grad is not None \
+                else jnp.zeros_like(p._data)
+            if self._wd_coeff():
+                g = g + self._wd_coeff() * p._data
+            gs.append(g.astype(jnp.float32).reshape(-1))
+        return jnp.concatenate(gs)
+
+    def _flat_params(self, params):
+        return jnp.concatenate(
+            [p._data.astype(jnp.float32).reshape(-1) for p in params])
+
+    def _assign(self, params, flat):
+        off = 0
+        for p in params:
+            n = int(jnp.prod(jnp.asarray(p._data.shape))) if p._data.ndim \
+                else 1
+            p._data = flat[off:off + n].reshape(p._data.shape).astype(
+                p._data.dtype)
+            off += n
+
+    # -- direction ----------------------------------------------------------
+    def _two_loop(self, grad):
+        q = grad
+        alphas = []
+        for s, y, rho in zip(reversed(self._s_hist), reversed(self._y_hist),
+                             reversed(self._rho)):
+            a = rho * jnp.vdot(s, q)
+            alphas.append(a)
+            q = q - a * y
+        if self._y_hist:
+            y, s = self._y_hist[-1], self._s_hist[-1]
+            gamma = jnp.vdot(s, y) / jnp.maximum(jnp.vdot(y, y), 1e-10)
+            q = q * gamma
+        for (s, y, rho), a in zip(
+                zip(self._s_hist, self._y_hist, self._rho),
+                reversed(alphas)):
+            b = rho * jnp.vdot(y, q)
+            q = q + s * (a - b)
+        return -q
+
+    # -- line search --------------------------------------------------------
+    def _eval(self, closure, params, x, t, d):
+        self._assign(params, x + t * d)
+        loss = closure()
+        self._n_evals += 1
+        g = self._flat_grad(params)
+        return float(loss.numpy() if hasattr(loss, "numpy") else loss), g
+
+    def _strong_wolfe(self, closure, params, x, t, d, f0, g0,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        gtd0 = float(jnp.vdot(g0, d))
+        f_prev, t_prev, g_prev = f0, 0.0, g0
+        for ls in range(max_ls):
+            f_new, g_new = self._eval(closure, params, x, t, d)
+            gtd = float(jnp.vdot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or (ls > 0 and f_new >= f_prev):
+                return self._zoom(closure, params, x, d, f0, gtd0,
+                                  t_prev, f_prev, g_prev, t, f_new, g_new,
+                                  c1, c2)
+            if abs(gtd) <= -c2 * gtd0:
+                return t, f_new, g_new
+            if gtd >= 0:
+                return self._zoom(closure, params, x, d, f0, gtd0,
+                                  t, f_new, g_new, t_prev, f_prev, g_prev,
+                                  c1, c2)
+            t_prev, f_prev, g_prev = t, f_new, g_new
+            t = min(2 * t, 10.0)
+        return t, f_new, g_new
+
+    def _zoom(self, closure, params, x, d, f0, gtd0, t_lo, f_lo, g_lo,
+              t_hi, f_hi, g_hi, c1, c2, max_zoom=10):
+        for _ in range(max_zoom):
+            t = _cubic_interpolate(
+                t_lo, f_lo, float(jnp.vdot(g_lo, d)),
+                t_hi, f_hi, float(jnp.vdot(g_hi, d)))
+            if abs(t_hi - t_lo) < 1e-9:
+                break
+            f_new, g_new = self._eval(closure, params, x, t, d)
+            gtd = float(jnp.vdot(g_new, d))
+            if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
+                t_hi, f_hi, g_hi = t, f_new, g_new
+            else:
+                if abs(gtd) <= -c2 * gtd0:
+                    return t, f_new, g_new
+                if gtd * (t_hi - t_lo) >= 0:
+                    t_hi, f_hi, g_hi = t_lo, f_lo, g_lo
+                t_lo, f_lo, g_lo = t, f_new, g_new
+        return t_lo, f_lo, g_lo
+
+    # -- step ---------------------------------------------------------------
+    def step(self, closure=None):
+        """Run up to max_iter L-BFGS iterations; `closure` re-evaluates the
+        loss (clearing and re-accumulating grads) and returns it."""
+        if closure is None:
+            raise ValueError(
+                "LBFGS.step requires a closure that re-evaluates the loss")
+        params = self._params()
+        orig_loss = closure()
+        self._n_evals = 1
+        loss = float(orig_loss.numpy()
+                     if hasattr(orig_loss, "numpy") else orig_loss)
+        grad = self._flat_grad(params)
+        if float(jnp.abs(grad).max()) <= self._tol_grad:
+            return orig_loss
+        lr = self.get_lr()
+
+        for it in range(self._max_iter):
+            d = -grad if not self._y_hist else self._two_loop(grad)
+            x = self._flat_params(params)
+            gtd = float(jnp.vdot(grad, d))
+            if gtd > -self._tol_change:
+                break
+            # first iteration: scale like the reference/torch
+            t = min(1.0, 1.0 / float(jnp.abs(grad).sum())) * lr if it == 0 \
+                and not self._y_hist else lr
+
+            if self._line_search_fn == "strong_wolfe":
+                t, f_new, g_new = self._strong_wolfe(
+                    closure, params, x, t, d, loss, grad)
+            else:
+                f_new, g_new = self._eval(closure, params, x, t, d)
+
+            s = (self._flat_params(params) - x)
+            y = g_new - grad
+            ys = float(jnp.vdot(y, s))
+            if ys > 1e-10:
+                if len(self._s_hist) >= self._history_size:
+                    self._s_hist.pop(0)
+                    self._y_hist.pop(0)
+                    self._rho.pop(0)
+                self._s_hist.append(s)
+                self._y_hist.append(y)
+                self._rho.append(1.0 / ys)
+
+            grad_change = float(jnp.abs(g_new).max())
+            step_change = float(jnp.abs(s).max())
+            loss_change = abs(f_new - loss)
+            loss, grad = f_new, g_new
+            if (grad_change <= self._tol_grad
+                    or step_change <= self._tol_change
+                    or loss_change < self._tol_change
+                    or self._n_evals >= self._max_eval):
+                break
+        self._step_count += 1
+        return orig_loss

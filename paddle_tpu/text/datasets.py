@@ -23,7 +23,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imdb", "Conll05st", "Imikolov", "UciHousing"]
+__all__ = ["Imdb", "Conll05st", "Imikolov", "UciHousing",
+           "WMT14", "WMT16", "Movielens"]
 
 
 def _synth_rng(seed):
@@ -273,6 +274,200 @@ class UciHousing(Dataset):
     def __getitem__(self, i):
         row = self.data[i]
         return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+BOS, EOS, UNK = "<s>", "<e>", "<unk>"
+BOS_IDX, EOS_IDX, UNK_IDX = 0, 1, 2
+
+
+class _WMTBase(Dataset):
+    """Shared machinery for WMT14/WMT16 (reference text/datasets/wmt14.py:40,
+    wmt16.py).  data_file: a plain text file of "src<TAB>trg" sentence pairs
+    (one per line); None -> synthetic parallel corpus (target = reversed
+    source over a shared toy vocabulary).  Items: (src_ids, trg_ids,
+    trg_ids_next) int64 arrays; ids 0/1/2 are <s>/<e>/<unk>.
+    """
+
+    def _build(self, pairs, src_dict_size, trg_dict_size):
+        def vocab(sents, size):
+            from collections import Counter
+            cnt = Counter(w for s in sents for w in s)
+            words = [w for w, _ in cnt.most_common()]
+            if size > 0:
+                words = words[:max(0, size - 3)]
+            d = {BOS: BOS_IDX, EOS: EOS_IDX, UNK: UNK_IDX}
+            for w in words:
+                d[w] = len(d)
+            return d
+
+        srcs = [p[0] for p in pairs]
+        trgs = [p[1] for p in pairs]
+        self.src_dict = vocab(srcs, src_dict_size)
+        self.trg_dict = vocab(trgs, trg_dict_size)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        for s, t in zip(srcs, trgs):
+            si = [self.src_dict.get(w, UNK_IDX) for w in s]
+            ti = [self.trg_dict.get(w, UNK_IDX) for w in t]
+            self.src_ids.append(np.array(si, np.int64))
+            self.trg_ids.append(np.array([BOS_IDX] + ti, np.int64))
+            self.trg_ids_next.append(np.array(ti + [EOS_IDX], np.int64))
+
+    def _load_pairs(self, data_file, mode, n_synthetic, seed):
+        pairs = []
+        if data_file is None:
+            rng = _synth_rng(seed)
+            vocab = ["ich", "du", "haus", "hund", "buch", "rot", "blau",
+                     "geht", "sieht", "klein"]
+            for _ in range(n_synthetic):
+                n = int(rng.integers(3, 9))
+                src = [str(w) for w in rng.choice(vocab, size=n)]
+                pairs.append((src, src[::-1]))
+        else:
+            with open(data_file, errors="ignore") as f:
+                for line in f:
+                    if "\t" not in line:
+                        continue
+                    s, t = line.rstrip("\n").split("\t")[:2]
+                    pairs.append((s.split(), t.split()))
+        return pairs
+
+    def __getitem__(self, idx):
+        return (self.src_ids[idx], self.trg_ids[idx], self.trg_ids_next[idx])
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT14(_WMTBase):
+    """Reference text/datasets/wmt14.py:40."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = -1, n_synthetic: int = 80):
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(f"mode must be train|test|gen, got {mode}")
+        self.mode = mode
+        seed = {"train": 10, "test": 11, "gen": 12}[mode]
+        pairs = self._load_pairs(data_file, mode, n_synthetic, seed)
+        self._build(pairs, dict_size, dict_size)
+
+    def get_dict(self, reverse=False):
+        """(src_dict, trg_dict); reverse -> id-to-word maps."""
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(_WMTBase):
+    """Reference text/datasets/wmt16.py (en-de, separate dict sizes)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 src_dict_size: int = -1, trg_dict_size: int = -1,
+                 lang: str = "en", n_synthetic: int = 80):
+        if mode not in ("train", "test", "val"):
+            raise ValueError(f"mode must be train|test|val, got {mode}")
+        self.mode = mode
+        self.lang = lang
+        seed = {"train": 20, "test": 21, "val": 22}[mode]
+        pairs = self._load_pairs(data_file, mode, n_synthetic, seed)
+        self._build(pairs, src_dict_size, trg_dict_size)
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py:106).
+
+    data_file: a directory (or .tar-style layout) holding users.dat,
+    movies.dat, ratings.dat in the `::`-separated MovieLens format; None ->
+    synthetic users/movies/ratings.  Items match the reference tuple:
+    (user_id, gender, age, job, movie_id, categories, title, rating) —
+    each a np.array, category/title entries variable-length.
+    """
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed: int = 0,
+                 n_synthetic: int = 120):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train|test, got {mode}")
+        rng = _synth_rng(rand_seed)
+        if data_file is None:
+            cats = ["Action", "Comedy", "Drama", "Horror", "Sci-Fi"]
+            words = ["the", "of", "night", "return", "story", "city",
+                     "dream", "last"]
+            users = [(u + 1, rng.choice(["M", "F"]),
+                      int(rng.choice(self.AGES)), int(rng.integers(0, 21)))
+                     for u in range(16)]
+            movies = []
+            for m in range(24):
+                n_c = int(rng.integers(1, 3))
+                n_w = int(rng.integers(1, 4))
+                movies.append((m + 1,
+                               list(rng.choice(cats, n_c, replace=False)),
+                               " ".join(rng.choice(words, n_w))))
+            ratings = [(int(rng.integers(0, 16)) + 1,
+                        int(rng.integers(0, 24)) + 1,
+                        float(rng.integers(1, 6)))
+                       for _ in range(n_synthetic)]
+        else:
+            users, movies, ratings = self._parse_dir(data_file)
+
+        cat_dict: Dict[str, int] = {}
+        title_dict: Dict[str, int] = {}
+        for _, cs, title in movies:
+            for c in cs:
+                cat_dict.setdefault(c, len(cat_dict))
+            for w in title.split():
+                title_dict.setdefault(w.lower(), len(title_dict))
+        self.categories_dict = cat_dict
+        self.movie_title_dict = title_dict
+        user_info = {u[0]: u for u in users}
+        movie_info = {m[0]: m for m in movies}
+        self.max_movie_id = max(movie_info) if movie_info else 0
+        self.max_user_id = max(user_info) if user_info else 0
+
+        data = []
+        for uid, mid, rating in ratings:
+            if uid not in user_info or mid not in movie_info:
+                continue
+            _, gender, age, job = user_info[uid]
+            _, cs, title = movie_info[mid]
+            data.append((
+                np.array([uid], np.int64),
+                np.array([0 if gender == "M" else 1], np.int64),
+                np.array([self.AGES.index(age)], np.int64),
+                np.array([job], np.int64),
+                np.array([mid], np.int64),
+                np.array([cat_dict[c] for c in cs], np.int64),
+                np.array([title_dict[w.lower()] for w in title.split()],
+                         np.int64),
+                np.array([rating], np.float32),
+            ))
+        is_test = rng.random(len(data)) < test_ratio
+        self.data = [d for d, t in zip(data, is_test)
+                     if t == (mode == "test")]
+
+    @staticmethod
+    def _parse_dir(path):
+        def rows(name):
+            with open(os.path.join(path, name), errors="ignore") as f:
+                return [line.rstrip("\n").split("::") for line in f if line.strip()]
+        users = [(int(r[0]), r[1], int(r[2]), int(r[3]))
+                 for r in rows("users.dat")]
+        movies = [(int(r[0]), r[2].split("|"), r[1]) for r in rows("movies.dat")]
+        ratings = [(int(r[0]), int(r[1]), float(r[2]))
+                   for r in rows("ratings.dat")]
+        return users, movies, ratings
+
+    def __getitem__(self, idx):
+        return self.data[idx]
 
     def __len__(self):
         return len(self.data)

@@ -17,3 +17,5 @@ from .shufflenet import (  # noqa: F401
     shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
     shufflenet_v2_x2_0, shufflenet_v2_swish,
 )
+from .googlenet import GoogLeNet, googlenet  # noqa: F401
+from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401

@@ -1,0 +1,112 @@
+"""GoogLeNet / Inception v1 (reference: python/paddle/vision/models/
+googlenet.py:107).  Same API: forward returns [out, aux1, aux2] logits."""
+
+from __future__ import annotations
+
+from ... import nn
+from ... import ops
+
+__all__ = ["GoogLeNet", "googlenet"]
+
+
+class _ConvReLU(nn.Layer):
+    def __init__(self, c_in, c_out, k, stride=1):
+        super().__init__()
+        self.conv = nn.Conv2D(c_in, c_out, k, stride=stride,
+                              padding=(k - 1) // 2)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.conv(x))
+
+
+class Inception(nn.Layer):
+    """Four-branch v1 block (reference googlenet.py:67)."""
+
+    def __init__(self, c_in, f1, f3r, f3, f5r, f5, proj):
+        super().__init__()
+        self.b1 = _ConvReLU(c_in, f1, 1)
+        self.b3 = nn.Sequential(_ConvReLU(c_in, f3r, 1), _ConvReLU(f3r, f3, 3))
+        self.b5 = nn.Sequential(_ConvReLU(c_in, f5r, 1), _ConvReLU(f5r, f5, 5))
+        self.bp = nn.Sequential(nn.MaxPool2D(3, stride=1, padding=1),
+                                _ConvReLU(c_in, proj, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b3(x), self.b5(x), self.bp(x)],
+                          axis=1)
+
+
+class _AuxHead(nn.Layer):
+    def __init__(self, c_in, num_classes, drop_p):
+        super().__init__()
+        self.pool = nn.AvgPool2D(5, stride=3)
+        self.conv = _ConvReLU(c_in, 128, 1)
+        self.fc1 = nn.Linear(1152, 1024)
+        self.act = nn.ReLU()
+        self.drop = nn.Dropout(drop_p, mode="downscale_in_infer")
+        self.fc2 = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.conv(self.pool(x))
+        x = ops.flatten(x, start_axis=1)
+        x = self.drop(self.act(self.fc1(x)))
+        return self.fc2(x)
+
+
+class GoogLeNet(nn.Layer):
+    """Reference googlenet.py:107 — returns [out, out1, out2]."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvReLU(3, 64, 7, stride=2), nn.MaxPool2D(3, stride=2),
+            _ConvReLU(64, 64, 1), _ConvReLU(64, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.i3a = Inception(192, 64, 96, 128, 16, 32, 32)
+        self.i3b = Inception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = nn.MaxPool2D(3, stride=2)
+        self.i4a = Inception(480, 192, 96, 208, 16, 48, 64)
+        self.i4b = Inception(512, 160, 112, 224, 24, 64, 64)
+        self.i4c = Inception(512, 128, 128, 256, 24, 64, 64)
+        self.i4d = Inception(512, 112, 144, 288, 32, 64, 64)
+        self.i4e = Inception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = nn.MaxPool2D(3, stride=2)
+        self.i5a = Inception(832, 256, 160, 320, 32, 128, 128)
+        self.i5b = Inception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.gap = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.4, mode="downscale_in_infer")
+            self.head = nn.Linear(1024, num_classes)
+            self.aux1 = _AuxHead(512, num_classes, 0.7)
+            self.aux2 = _AuxHead(528, num_classes, 0.7)
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.i3b(self.i3a(x)))
+        a4 = self.i4a(x)
+        x = self.i4c(self.i4b(a4))
+        d4 = self.i4d(x)
+        x = self.pool4(self.i4e(d4))
+        x = self.i5b(self.i5a(x))
+        out, out1, out2 = x, a4, d4
+        if self.with_pool:
+            out = self.gap(out)
+        if self.num_classes > 0:
+            out = ops.flatten(self.drop(out), start_axis=1)
+            out = self.head(out)
+            out1 = self.aux1(out1)
+            out2 = self.aux2(out2)
+        return [out, out1, out2]
+
+
+def googlenet(pretrained=False, **kwargs):
+    """Reference googlenet.py:233 factory (pretrained weights are not
+    bundled — zero-egress environment; load via set_state_dict)."""
+    if pretrained:
+        raise ValueError(
+            "pretrained weights are not bundled in paddle_tpu; load a local "
+            "checkpoint with model.set_state_dict(paddle.load(path))")
+    return GoogLeNet(**kwargs)

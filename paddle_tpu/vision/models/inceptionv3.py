@@ -1,0 +1,187 @@
+"""Inception v3 (reference: python/paddle/vision/models/inceptionv3.py:488).
+
+Blocks follow the reference channel plan exactly (stem :36, A :90, B :166,
+C :217, D :323, E :389); every conv is conv + BatchNorm + ReLU.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ... import ops
+from ...nn.layer import ParamAttr
+from ...nn import initializer as I
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class _CBR(nn.Layer):
+    """conv + bn + relu (the reference's ConvNormActivation)."""
+
+    def __init__(self, c_in, c_out, k, stride=1, padding=0):
+        super().__init__()
+        self.conv = nn.Conv2D(c_in, c_out, k, stride=stride, padding=padding,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(c_out)
+        self.act = nn.ReLU()
+
+    def forward(self, x):
+        return self.act(self.bn(self.conv(x)))
+
+
+class InceptionStem(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.c1 = _CBR(3, 32, 3, stride=2)
+        self.c2 = _CBR(32, 32, 3)
+        self.c3 = _CBR(32, 64, 3, padding=1)
+        self.pool1 = nn.MaxPool2D(3, stride=2)
+        self.c4 = _CBR(64, 80, 1)
+        self.c5 = _CBR(80, 192, 3)
+        self.pool2 = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        x = self.pool1(self.c3(self.c2(self.c1(x))))
+        return self.pool2(self.c5(self.c4(x)))
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, c_in, pool_features):
+        super().__init__()
+        self.b1 = _CBR(c_in, 64, 1)
+        self.b5 = nn.Sequential(_CBR(c_in, 48, 1), _CBR(48, 64, 5, padding=2))
+        self.b3d = nn.Sequential(_CBR(c_in, 64, 1), _CBR(64, 96, 3, padding=1),
+                                 _CBR(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _CBR(c_in, pool_features, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b5(x), self.b3d(x), self.bp(x)],
+                          axis=1)
+
+
+class InceptionB(nn.Layer):
+    """Grid reduction 35->17 (reference :166)."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = _CBR(c_in, 384, 3, stride=2)
+        self.b3d = nn.Sequential(_CBR(c_in, 64, 1), _CBR(64, 96, 3, padding=1),
+                                 _CBR(96, 96, 3, stride=2))
+        self.bp = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b3d(x), self.bp(x)], axis=1)
+
+
+class InceptionC(nn.Layer):
+    """Factorized 7x7 block (reference :217)."""
+
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = _CBR(c_in, 192, 1)
+        self.b7 = nn.Sequential(
+            _CBR(c_in, c7, 1),
+            _CBR(c7, c7, (1, 7), padding=(0, 3)),
+            _CBR(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            _CBR(c_in, c7, 1),
+            _CBR(c7, c7, (7, 1), padding=(3, 0)),
+            _CBR(c7, c7, (1, 7), padding=(0, 3)),
+            _CBR(c7, c7, (7, 1), padding=(3, 0)),
+            _CBR(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _CBR(c_in, 192, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                          axis=1)
+
+
+class InceptionD(nn.Layer):
+    """Grid reduction 17->8 (reference :323)."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = nn.Sequential(_CBR(c_in, 192, 1), _CBR(192, 320, 3, stride=2))
+        self.b7x3 = nn.Sequential(
+            _CBR(c_in, 192, 1),
+            _CBR(192, 192, (1, 7), padding=(0, 3)),
+            _CBR(192, 192, (7, 1), padding=(3, 0)),
+            _CBR(192, 192, 3, stride=2))
+        self.bp = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b7x3(x), self.bp(x)], axis=1)
+
+
+class InceptionE(nn.Layer):
+    """Expanded-filter-bank block (reference :389)."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = _CBR(c_in, 320, 1)
+        self.b3_stem = _CBR(c_in, 384, 1)
+        self.b3_a = _CBR(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _CBR(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = nn.Sequential(_CBR(c_in, 448, 1),
+                                      _CBR(448, 384, 3, padding=1))
+        self.b3d_a = _CBR(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _CBR(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                _CBR(c_in, 192, 1))
+
+    def forward(self, x):
+        s3 = self.b3_stem(x)
+        s3d = self.b3d_stem(x)
+        return ops.concat(
+            [self.b1(x),
+             ops.concat([self.b3_a(s3), self.b3_b(s3)], axis=1),
+             ops.concat([self.b3d_a(s3d), self.b3d_b(s3d)], axis=1),
+             self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Reference inceptionv3.py:488."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = InceptionStem()
+        blocks = []
+        for c_in, pf in zip((192, 256, 288), (32, 64, 64)):
+            blocks.append(InceptionA(c_in, pf))
+        blocks.append(InceptionB(288))
+        for c_in, c7 in zip((768,) * 4, (128, 160, 160, 192)):
+            blocks.append(InceptionC(c_in, c7))
+        blocks.append(InceptionD(768))
+        blocks.extend([InceptionE(1280), InceptionE(2048)])
+        self.blocks = nn.Sequential(*blocks)
+        if with_pool:
+            self.avg_pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2, mode="downscale_in_infer")
+            stdv = 1.0 / math.sqrt(2048.0)
+            self.fc = nn.Linear(
+                2048, num_classes,
+                weight_attr=ParamAttr(initializer=I.Uniform(-stdv, stdv)))
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avg_pool(x)
+        if self.num_classes > 0:
+            x = ops.reshape(x, [-1, 2048])
+            x = self.fc(self.dropout(x))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    """Reference inceptionv3.py:601 factory."""
+    if pretrained:
+        raise ValueError(
+            "pretrained weights are not bundled in paddle_tpu; load a local "
+            "checkpoint with model.set_state_dict(paddle.load(path))")
+    return InceptionV3(**kwargs)

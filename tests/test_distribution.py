@@ -311,3 +311,49 @@ class TestTransforms:
         x = np.array([0.5, -0.5, 1.0], np.float32)
         np.testing.assert_allclose(float(_np(ind.log_prob(x))),
                                    _np(base.log_prob(x)).sum(), rtol=1e-5)
+
+
+class TestLogNormalMultinomialDepth:
+    def test_lognormal_kl_matches_mc(self):
+        from paddle_tpu.distribution import LogNormal, kl_divergence
+        paddle.seed(3)
+        a, b = LogNormal(0.3, 0.8), LogNormal(-0.2, 1.1)
+        kl = float(kl_divergence(a, b).numpy())
+        s = a.sample((100000,))
+        mc = float((a.log_prob(s).numpy() - b.log_prob(s).numpy()).mean())
+        assert abs(kl - mc) < 0.05, (kl, mc)
+
+    def test_lognormal_sample_moments(self):
+        from paddle_tpu.distribution import LogNormal
+        paddle.seed(4)
+        d = LogNormal(0.1, 0.4)
+        s = d.sample((200000,)).numpy()
+        assert abs(s.mean() - float(d.mean.numpy())) < 0.01
+        p = d.probs(paddle.to_tensor(np.array(1.5, "float32"))).numpy()
+        lp = d.log_prob(paddle.to_tensor(np.array(1.5, "float32"))).numpy()
+        np.testing.assert_allclose(p, np.exp(lp), rtol=1e-5)
+
+    def test_multinomial_entropy_exact(self):
+        import itertools, math
+        from paddle_tpu.distribution import Multinomial
+        n, p = 4, np.array([0.2, 0.5, 0.3])
+        m = Multinomial(n, p.astype("float32"))
+        H = float(m.entropy().numpy())
+        bf = 0.0
+        for c in itertools.product(range(n + 1), repeat=3):
+            if sum(c) != n:
+                continue
+            logpmf = (math.lgamma(n + 1)
+                      - sum(math.lgamma(x + 1) for x in c)
+                      + sum(x * math.log(q) for x, q in zip(c, p)))
+            bf -= math.exp(logpmf) * logpmf
+        assert abs(H - bf) < 1e-4, (H, bf)
+
+    def test_multinomial_prob_and_validation(self):
+        from paddle_tpu.distribution import Multinomial
+        m = Multinomial(3, np.array([0.5, 0.5], "float32"))
+        v = np.array([2.0, 1.0], "float32")
+        np.testing.assert_allclose(m.prob(v).numpy(),
+                                   np.exp(m.log_prob(v).numpy()), rtol=1e-6)
+        with pytest.raises(ValueError):
+            Multinomial(0, np.array([0.5, 0.5], "float32"))

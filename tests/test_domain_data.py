@@ -187,3 +187,59 @@ class TestAudioBackends:
         path.write_bytes(b"not a wav file at all")
         with pytest.raises(NotImplementedError, match="PCM16"):
             paddle.audio.load(str(path))
+
+
+class TestWmtMovielens:
+    def test_wmt14_triplets(self):
+        from paddle_tpu.text.datasets import WMT14
+        ds = WMT14(mode="train", dict_size=20)
+        src, trg, trg_next = ds[0]
+        assert src.dtype == np.int64
+        assert trg[0] == 0                      # <s>
+        assert trg_next[-1] == 1                # <e>
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+        sd, td = ds.get_dict()
+        assert sd["<unk>"] == 2
+        rid, _ = ds.get_dict(reverse=True)
+        assert rid[2] == "<unk>"
+        assert len(sd) <= 20
+
+    def test_wmt14_file_based(self, tmp_path):
+        from paddle_tpu.text.datasets import WMT14
+        f = tmp_path / "pairs.txt"
+        f.write_text("hello world\thallo welt\nbye now\ttschuess jetzt\n")
+        ds = WMT14(data_file=str(f), dict_size=50)
+        assert len(ds) == 2
+        sd, td = ds.get_dict()
+        assert "hello" in sd and "hallo" in td
+
+    def test_wmt16_separate_dicts(self):
+        from paddle_tpu.text.datasets import WMT16
+        ds = WMT16(mode="val", src_dict_size=15, trg_dict_size=18)
+        assert len(ds.src_dict) <= 15 and len(ds.trg_dict) <= 18
+        d = ds.get_dict("en")
+        assert d is ds.src_dict
+
+    def test_movielens_items(self):
+        from paddle_tpu.text.datasets import Movielens
+        tr = Movielens(mode="train")
+        te = Movielens(mode="test")
+        assert len(tr) > 0 and len(te) > 0
+        item = tr[0]
+        assert len(item) == 8
+        uid, gender, age, job, mid, cats, title, rating = item
+        assert gender[0] in (0, 1)
+        assert 0 <= age[0] < 7
+        assert 1.0 <= rating[0] <= 5.0
+        assert cats.dtype == np.int64 and len(cats) >= 1
+
+    def test_movielens_file_based(self, tmp_path):
+        from paddle_tpu.text.datasets import Movielens
+        (tmp_path / "users.dat").write_text("1::M::25::4\n2::F::35::7\n")
+        (tmp_path / "movies.dat").write_text(
+            "1::Toy Story::Animation|Comedy\n2::Heat::Action\n")
+        (tmp_path / "ratings.dat").write_text(
+            "1::1::5::978300760\n2::2::3::978302109\n1::2::4::978301968\n")
+        ds = Movielens(data_file=str(tmp_path), mode="train", test_ratio=0.0)
+        assert len(ds) == 3
+        assert ds.categories_dict["Animation"] >= 0

@@ -1,0 +1,115 @@
+"""LBFGS optimizer + paddle.hub tests.
+Reference: python/paddle/optimizer/lbfgs.py, python/paddle/hub.py.
+"""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Parameter
+
+
+class TestLBFGS:
+    def test_rosenbrock_strong_wolfe(self):
+        p = Parameter(np.array([-1.2, 1.0], "float32"))
+        opt = paddle.optimizer.LBFGS(parameters=[p],
+                                     line_search_fn="strong_wolfe")
+
+        def closure():
+            p.clear_grad()
+            x, y = p[0], p[1]
+            loss = (1 - x) ** 2 + 100 * (y - x * x) ** 2
+            loss.backward()
+            return loss
+
+        for _ in range(20):
+            opt.step(closure)
+        np.testing.assert_allclose(p.numpy(), [1.0, 1.0], atol=1e-3)
+
+    def test_linear_regression_matches_lstsq(self):
+        rng = np.random.default_rng(0)
+        A = rng.standard_normal((50, 4)).astype("float32")
+        b = rng.standard_normal(50).astype("float32")
+        w = Parameter(np.zeros(4, "float32"))
+        opt = paddle.optimizer.LBFGS(parameters=[w])
+        At, bt = paddle.to_tensor(A), paddle.to_tensor(b)
+
+        def closure():
+            w.clear_grad()
+            r = At.matmul(w) - bt
+            loss = (r * r).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(10):
+            opt.step(closure)
+        ref = np.linalg.lstsq(A, b, rcond=None)[0]
+        assert np.abs(w.numpy() - ref).max() < 1e-3
+
+    def test_requires_closure(self):
+        p = Parameter(np.zeros(2, "float32"))
+        opt = paddle.optimizer.LBFGS(parameters=[p])
+        with pytest.raises(ValueError):
+            opt.step()
+
+    def test_bad_line_search_name(self):
+        with pytest.raises(ValueError):
+            paddle.optimizer.LBFGS(parameters=[], line_search_fn="wolfe")
+
+    def test_layer_training(self):
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        net = paddle.nn.Linear(3, 1)
+        x = paddle.to_tensor(np.random.randn(20, 3).astype("float32"))
+        y = paddle.to_tensor(np.random.randn(20, 1).astype("float32"))
+        opt = paddle.optimizer.LBFGS(parameters=net.parameters(),
+                                     line_search_fn="strong_wolfe")
+
+        def closure():
+            net.clear_gradients()
+            loss = F.mse_loss(net(x), y)
+            loss.backward()
+            return loss
+
+        l0 = float(closure().numpy())
+        for _ in range(5):
+            opt.step(closure)
+        assert float(closure().numpy()) < l0 * 0.9
+
+
+class TestHub:
+    @pytest.fixture()
+    def repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "import paddle_tpu as paddle\n\n"
+            "def tiny_mlp(hidden=8):\n"
+            "    \"\"\"A tiny MLP entrypoint.\"\"\"\n"
+            "    return paddle.nn.Sequential(\n"
+            "        paddle.nn.Linear(4, hidden), paddle.nn.ReLU(),\n"
+            "        paddle.nn.Linear(hidden, 2))\n\n"
+            "_private = lambda: None\n")
+        return str(tmp_path)
+
+    def test_list(self, repo):
+        ents = paddle.hub.list(repo, source="local")
+        assert "tiny_mlp" in ents and "_private" not in ents
+
+    def test_help(self, repo):
+        assert "tiny MLP" in paddle.hub.help(repo, "tiny_mlp", source="local")
+
+    def test_load_with_kwargs(self, repo):
+        m = paddle.hub.load(repo, "tiny_mlp", source="local", hidden=16)
+        out = m(paddle.to_tensor(np.random.randn(3, 4).astype("float32")))
+        assert list(out.shape) == [3, 2]
+
+    def test_bad_source(self, repo):
+        with pytest.raises(ValueError):
+            paddle.hub.list(repo, source="svn")
+
+    def test_github_cache_miss_message(self):
+        with pytest.raises(RuntimeError, match="no network egress"):
+            paddle.hub.load("someone/repo:main", "x")
+
+    def test_missing_entry(self, repo):
+        with pytest.raises(RuntimeError, match="Cannot find callable"):
+            paddle.hub.load(repo, "nope", source="local")

@@ -248,3 +248,45 @@ class TestVisionOps:
         rois = np.array([[0, 0, 8, 8]], np.float32)
         out = vision.ops.roi_pool(feat, rois, np.array([1]), 2)
         assert tuple(out.shape) == (1, 3, 2, 2)
+
+
+class TestInceptionFamily:
+    def test_googlenet_heads(self):
+        from paddle_tpu.vision.models import googlenet
+        m = googlenet(num_classes=10)
+        x = paddle.to_tensor(np.random.randn(1, 3, 224, 224).astype("float32"))
+        out, o1, o2 = m(x)
+        assert list(out.shape) == [1, 10]
+        assert list(o1.shape) == [1, 10] and list(o2.shape) == [1, 10]
+
+    @pytest.mark.slow
+    def test_googlenet_trains(self):
+        from paddle_tpu.vision.models import GoogLeNet
+        import paddle_tpu.nn.functional as F
+        m = GoogLeNet(num_classes=4)
+        opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=m.parameters())
+        x = paddle.to_tensor(np.random.randn(2, 3, 224, 224).astype("float32"))
+        y = paddle.to_tensor(np.array([0, 1], "int64"))
+        out, o1, o2 = m(x)
+        loss = (F.cross_entropy(out, y) + 0.3 * F.cross_entropy(o1, y)
+                + 0.3 * F.cross_entropy(o2, y))
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss.numpy()))
+
+    @pytest.mark.slow
+    def test_inception_v3_forward(self):
+        from paddle_tpu.vision.models import inception_v3
+        m = inception_v3(num_classes=6)
+        m.eval()
+        x = paddle.to_tensor(np.random.randn(1, 3, 299, 299).astype("float32"))
+        out = m(x)
+        assert list(out.shape) == [1, 6]
+
+    def test_pretrained_raises(self):
+        from paddle_tpu.vision.models import googlenet, inception_v3
+        with pytest.raises(ValueError):
+            googlenet(pretrained=True)
+        with pytest.raises(ValueError):
+            inception_v3(pretrained=True)
