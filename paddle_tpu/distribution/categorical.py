@@ -110,9 +110,6 @@ class Multinomial(Distribution):
                  - jax.lax.lgamma(v + 1.0).sum(-1))
         return _wrap(coeff + (v * logp).sum(-1))
 
-    def prob(self, value):
-        return _wrap(jnp.exp(_v(self.log_prob(value))))
-
     def entropy(self):
         """Exact entropy via the Binomial-marginal decomposition the
         reference uses (multinomial.py:166): H = n*H(p) - log(n!) +

@@ -85,9 +85,6 @@ class LogNormal(Distribution):
     def entropy(self):
         return _wrap(_v(self.base.entropy()) + self.loc)
 
-    def sample(self, shape=()):
-        return self.rsample(shape)
-
     def probs(self, value):
         return _wrap(jnp.exp(_v(self.log_prob(value))))
 

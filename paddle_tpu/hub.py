@@ -20,7 +20,6 @@ import sys
 __all__ = ["list", "help", "load"]
 
 MODULE_HUBCONF = "hubconf.py"
-_builtin_list = list
 
 
 def _hub_cache_dir():

@@ -141,11 +141,11 @@ class LBFGS(Optimizer):
     def _zoom(self, closure, params, x, d, f0, gtd0, t_lo, f_lo, g_lo,
               t_hi, f_hi, g_hi, c1, c2, max_zoom=10):
         for _ in range(max_zoom):
+            if abs(t_hi - t_lo) < 1e-9:
+                break
             t = _cubic_interpolate(
                 t_lo, f_lo, float(jnp.vdot(g_lo, d)),
                 t_hi, f_hi, float(jnp.vdot(g_hi, d)))
-            if abs(t_hi - t_lo) < 1e-9:
-                break
             f_new, g_new = self._eval(closure, params, x, t, d)
             gtd = float(jnp.vdot(g_new, d))
             if f_new > f0 + c1 * t * gtd0 or f_new >= f_lo:
@@ -156,6 +156,9 @@ class LBFGS(Optimizer):
                 if gtd * (t_hi - t_lo) >= 0:
                     t_hi, f_hi, g_hi = t_lo, f_lo, g_lo
                 t_lo, f_lo, g_lo = t, f_new, g_new
+        # params may sit at the last trial point — put them at the returned
+        # one so loss/grad/history stay consistent (torch's final _add_grad)
+        self._assign(params, x + t_lo * d)
         return t_lo, f_lo, g_lo
 
     # -- step ---------------------------------------------------------------
