@@ -49,14 +49,29 @@ _COMPLEX_OK: Optional[bool] = None
 def _complex_ok() -> bool:
     global _COMPLEX_OK
     if _COMPLEX_OK is None:
+        # The axon plugin must be detected by NAME: merely attempting a
+        # complex op poisons its stream (later real ops fail too).  The
+        # check uses a private API — contain ITS failure so a jax upgrade
+        # can't poison the gate on mainline backends.
         try:
             from jax._src import xla_bridge as _xb
-            # The axon plugin must be detected by NAME: merely attempting a
-            # complex op poisons its stream (later real ops fail too).
-            if "axon" in _xb.get_backend().platform_version.lower():
+            pv = _xb.get_backend().platform_version.lower()
+        except Exception:
+            pv = ""
+        try:
+            if "axon" in pv:
                 _COMPLEX_OK = False
-            else:
-                np.asarray(jnp.zeros((1,), jnp.complex64) + jnp.asarray(1j))
+            elif jax.default_backend() in ("cpu", "gpu", "cuda", "rocm",
+                                           "tpu"):
+                # mainline XLA backends all support complex (TPU decomposes
+                # into real pairs) — decide by name, never by probing: a
+                # probe that first runs INSIDE a jit trace raises and would
+                # cache False for the whole process
+                _COMPLEX_OK = True
+            else:  # unknown plugin: probe OUTSIDE any trace context
+                with jax.ensure_compile_time_eval():
+                    np.asarray(jnp.zeros((1,), jnp.complex64)
+                               + jnp.asarray(1j))
                 _COMPLEX_OK = True
         except Exception:
             _COMPLEX_OK = False

@@ -182,30 +182,52 @@ class _CppKernel:
 
     C ABI: extern "C" void sym(const T* in..., T* out,
                                const int64_t* shape, int64_t ndim)
-    with all inputs sharing the (broadcasted) output shape — the elementwise
-    contract covers the vast majority of reference custom ops (custom_relu
-    etc.); richer ops can be registered as python fns over this bridge."""
+    where `shape` is INPUT 0's shape (all inputs must share it — the check
+    below guards the pointer contract).  By default the output also has
+    input 0's shape/dtype (the elementwise contract, covering the
+    reference's custom_relu-class ops); a `shape_fn(*input_shapes) ->
+    out_shape` / `dtype_fn(*input_dtypes) -> out_dtype` pair lets a kernel
+    produce a differently-shaped/typed output (reductions etc.) — the
+    analog of the reference's SetInferShapeFn/SetInferDtypeFn
+    (paddle/phi/api/ext/op_meta_info.h).  The output buffer is
+    zero-initialized so accumulate-style kernels are safe."""
 
-    def __init__(self, cdll, symbol: str, n_inputs: int, dtype=np.float32):
+    def __init__(self, cdll, symbol: str, n_inputs: int, dtype=np.float32,
+                 shape_fn: Optional[Callable] = None,
+                 dtype_fn: Optional[Callable] = None):
         self._f = getattr(cdll, symbol)
         self._f.restype = None
         self.n_inputs = n_inputs
         self.dtype = np.dtype(dtype)
+        self.shape_fn = shape_fn
+        self.dtype_fn = dtype_fn
+
+    def _out_spec(self, shapes, dtypes):
+        shape = tuple(self.shape_fn(*shapes)) if self.shape_fn \
+            else tuple(shapes[0])
+        dtype = np.dtype(self.dtype_fn(*dtypes)) if self.dtype_fn \
+            else self.dtype
+        return shape, dtype
 
     def _host(self, *arrays):
         if len(arrays) != self.n_inputs:
             raise TypeError(
                 f"kernel takes {self.n_inputs} input(s), got {len(arrays)} "
                 "(a wrong arity would pass garbage pointers to the C ABI)")
+        # spec from PRE-cast dtypes so dtype_fn sees what the jit path's
+        # tracer spec saw (the C kernel itself still computes in self.dtype)
+        in_dtypes = [np.asarray(a).dtype for a in arrays]
         arrays = [np.ascontiguousarray(a, dtype=self.dtype) for a in arrays]
         for i, a in enumerate(arrays[1:], 1):
             if a.shape != arrays[0].shape:
                 raise ValueError(
                     f"input {i} shape {a.shape} != input 0 shape "
-                    f"{arrays[0].shape}: the elementwise C ABI requires all "
-                    "inputs to share the output shape (a mismatch would read "
-                    "past the smaller buffer)")
-        out = np.empty_like(arrays[0])
+                    f"{arrays[0].shape}: the C ABI passes input 0's shape "
+                    "for all inputs (a mismatch would read past the smaller "
+                    "buffer)")
+        out_shape, out_dtype = self._out_spec(
+            [a.shape for a in arrays], in_dtypes)
+        out = np.zeros(out_shape, out_dtype)
         shape = np.asarray(arrays[0].shape, dtype=np.int64)
         argp = [a.ctypes.data_as(ctypes.c_void_p) for a in arrays]
         self._f(*argp, out.ctypes.data_as(ctypes.c_void_p),
@@ -221,7 +243,9 @@ class _CppKernel:
             # eager: call the C kernel directly — works on every backend,
             # including plugins without host-callback support (axon)
             return jnp.asarray(self._host(*[np.asarray(a) for a in arrays]))
-        spec = jax.ShapeDtypeStruct(arrays[0].shape, self.dtype)
+        out_shape, out_dtype = self._out_spec(
+            [a.shape for a in arrays], [a.dtype for a in arrays])
+        spec = jax.ShapeDtypeStruct(out_shape, out_dtype)
         return jax.pure_callback(self._host, spec, *arrays,
                                  vmap_method="sequential")
 
@@ -229,7 +253,8 @@ class _CppKernel:
 def load(name: str, sources=None, *, functions=None,
          extra_cflags: Optional[Sequence[str]] = None,
          build_directory: Optional[str] = None, verbose: bool = False,
-         register: bool = True, vjps=None, dtype=np.float32):
+         register: bool = True, vjps=None, dtype=np.float32,
+         shape_fns=None, dtype_fns=None):
     """Compile C++ `sources` and expose exported kernels as framework ops
     (reference cpp_extension.load, python/paddle/utils/cpp_extension/
     cpp_extension.py:120).
@@ -238,6 +263,11 @@ def load(name: str, sources=None, *, functions=None,
     there is no ELF introspection here).  Each becomes a registered custom
     op named `symbol_name` (register=False returns plain callables instead).
     `vjps`: optional {symbol_name: vjp_fn} gradients.
+    `shape_fns` / `dtype_fns`: optional {symbol_name: fn} output-spec
+    inference — `shape_fn(*input_shapes) -> out_shape`,
+    `dtype_fn(*input_dtypes) -> out_dtype` (reference SetInferShapeFn /
+    SetInferDtypeFn, paddle/phi/api/ext/op_meta_info.h); without one the
+    output mirrors input 0.
 
     Returns a namespace object with one attribute per function."""
     if not sources:
@@ -253,7 +283,9 @@ def load(name: str, sources=None, *, functions=None,
 
     ns = _NS()
     for sym, n_in in functions.items():
-        kern = _CppKernel(cdll, sym, n_in, dtype=dtype)
+        kern = _CppKernel(cdll, sym, n_in, dtype=dtype,
+                          shape_fn=(shape_fns or {}).get(sym),
+                          dtype_fn=(dtype_fns or {}).get(sym))
         if register:
             op = register_custom_op(sym, kern,
                                     vjp=(vjps or {}).get(sym),

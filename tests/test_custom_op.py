@@ -246,3 +246,56 @@ class TestCppExtensionLoad:
         with pytest.raises(RuntimeError, match="build failed"):
             load("bad_ext", sources=[str(bad)], functions={"f": 1},
                  build_directory=str(tmp_path))
+
+
+class TestShapeInference:
+    """Tier-2 kernels with non-elementwise outputs via shape_fns/dtype_fns
+    (reference SetInferShapeFn/SetInferDtypeFn, phi/api/ext/op_meta_info.h)."""
+
+    @pytest.fixture(scope="class")
+    def rowsum_ns(self, tmp_path_factory):
+        src = tmp_path_factory.mktemp("ext") / "rowsum.cc"
+        src.write_text(r'''
+#include <cstdint>
+extern "C" void my_rowsum(const float* in, float* out,
+                          const int64_t* shape, int64_t ndim) {
+  int64_t rows = shape[0], cols = 1;
+  for (int64_t d = 1; d < ndim; ++d) cols *= shape[d];
+  for (int64_t i = 0; i < rows; ++i)
+    for (int64_t j = 0; j < cols; ++j)
+      out[i] += in[i * cols + j];
+}
+''')
+        from paddle_tpu.utils import cpp_extension as cpp
+
+        def rowsum_vjp(ct, x):
+            import jax.numpy as jnp
+            return (jnp.broadcast_to(ct[:, None], x.shape),)
+
+        return cpp.load(
+            "rowsum_ext", sources=[str(src)],
+            functions={"my_rowsum": 1},
+            shape_fns={"my_rowsum": lambda s: (s[0],)},
+            vjps={"my_rowsum": rowsum_vjp},
+            build_directory=str(tmp_path_factory.mktemp("build")))
+
+    def test_matches_numpy(self, rowsum_ns):
+        x = np.random.randn(5, 7).astype("float32")
+        out = paddle.my_rowsum(paddle.to_tensor(x))
+        assert list(out.shape) == [5]
+        np.testing.assert_allclose(out.numpy(), x.sum(1), rtol=1e-6)
+
+    def test_differentiates_via_vjp(self, rowsum_ns):
+        x = paddle.to_tensor(np.random.randn(4, 3).astype("float32"),
+                             stop_gradient=False)
+        out = paddle.my_rowsum(x)
+        (out * paddle.to_tensor(np.arange(4, dtype="float32"))).sum().backward()
+        expect = np.broadcast_to(np.arange(4, dtype="float32")[:, None],
+                                 (4, 3))
+        np.testing.assert_allclose(x.grad.numpy(), expect, rtol=1e-6)
+
+    def test_under_jit(self, rowsum_ns):
+        import jax
+        x = np.random.randn(6, 2).astype("float32")
+        fn = jax.jit(lambda a: rowsum_ns.my_rowsum._raw_fn(a))
+        np.testing.assert_allclose(np.asarray(fn(x)), x.sum(1), rtol=1e-6)

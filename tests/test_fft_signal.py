@@ -282,3 +282,27 @@ class TestJitAndRegistry:
         for want in ["fft.fft", "fft.rfftn", "fft.fftshift", "signal.stft",
                      "signal.istft", "signal.frame", "signal.overlap_add"]:
             assert want in names, want
+
+
+def test_cold_gate_fft_traces_under_jit():
+    """Regression: the complex-support gate must not be probed inside a jit
+    trace (a cold probe there raised and cached False for the process,
+    breaking every later fft call on complex-capable backends)."""
+    import subprocess, sys, os
+    code = (
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from paddle_tpu.tensor import Tensor\n"
+        "from paddle_tpu import fft\n"
+        "x = np.random.randn(4, 8).astype('float32')\n"
+        "out = jax.jit(lambda r: fft.irfft(Tensor(r))._data)(x)\n"
+        "assert out.shape == (4, 14)\n"
+        "assert fft._COMPLEX_OK is True\n"
+        "print('cold-gate ok')\n")
+    env = dict(os.environ)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, env=env, timeout=240)
+    assert "cold-gate ok" in r.stdout, r.stderr[-800:]
