@@ -164,6 +164,7 @@ class TestSparsePoolNorm:
 
 
 class TestPointCloudTraining:
+    @pytest.mark.slow
     def test_tiny_pointnet_trains(self):
         """SubmConv -> BN -> ReLU -> pool -> dense head: loss decreases on a
         2-class synthetic point-cloud set (the reference sparse.nn demo

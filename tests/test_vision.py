@@ -251,6 +251,7 @@ class TestVisionOps:
 
 
 class TestInceptionFamily:
+    @pytest.mark.slow
     def test_googlenet_heads(self):
         from paddle_tpu.vision.models import googlenet
         m = googlenet(num_classes=10)
