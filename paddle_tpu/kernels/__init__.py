@@ -268,3 +268,30 @@ def masked_multihead_attention_reference(x, cache_kv, bias=None, src_mask=None,
     o = jnp.einsum("bhm,bhmd->bhd", p, cv.astype(jnp.float32))
     out = o.reshape(B, H * D).astype(x.dtype)
     return out, jnp.stack([ck, cv])
+
+
+# ---------------------------------------------------------------------------
+# fused adaLN modulate (DiT conditioning): LN + x*(1+scale)+shift
+# ---------------------------------------------------------------------------
+
+
+def adaln_modulate_reference(x, shift, scale, epsilon=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    xn = (xf - mu) * jax.lax.rsqrt(var + epsilon)
+    out = (xn * (1.0 + scale.astype(jnp.float32)[:, None, :])
+           + shift.astype(jnp.float32)[:, None, :])
+    return out.astype(x.dtype)
+
+
+def adaln_modulate(x, shift, scale, epsilon=1e-6):
+    """x (B, N, E); shift/scale (B, E) -> LN(x)*(1+scale)+shift in x.dtype."""
+    if _use_pallas() and x.ndim == 3 and x.shape[-1] % 128 == 0:
+        from .pallas_norm import adaln_modulate_pallas
+
+        try:
+            return adaln_modulate_pallas(x, shift, scale, epsilon)
+        except Exception:  # noqa: BLE001 — fall back on any lowering issue
+            _warn_pallas_fallback("adaln_modulate")
+    return adaln_modulate_reference(x, shift, scale, epsilon)

@@ -73,3 +73,65 @@ def _bwd(epsilon, res, g):
 
 
 rms_norm_pallas.defvjp(lambda x, w, eps=1e-6: _fwd(x, w, eps), _bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused adaLN modulate: LayerNorm (non-affine) + x*(1+scale)+shift in ONE
+# HBM round trip — the DiT block's per-image conditioning
+# (models/dit.py _modulate; reference analog fused_layernorm with
+# residual/bias fusions, phi/kernels/fusion/fused_layernorm_kernel.cu)
+# ---------------------------------------------------------------------------
+
+
+def _adaln_kernel(x_ref, sh_ref, sc_ref, o_ref, eps: float):
+    x = x_ref[...].astype(jnp.float32)                  # (1, bn, E)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x - mu), axis=-1, keepdims=True)
+    xn = (x - mu) * jax.lax.rsqrt(var + eps)
+    sh = sh_ref[...].astype(jnp.float32)                # (1, 1, E)
+    sc = sc_ref[...].astype(jnp.float32)
+    o_ref[...] = (xn * (1.0 + sc) + sh).astype(o_ref.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def adaln_modulate_pallas(x, shift, scale, epsilon: float = 1e-6):
+    """x (B, N, E) any float dtype; shift/scale (B, E).  Output in x.dtype:
+    LN(x) * (1 + scale) + shift with f32 statistics."""
+    return _adaln_fwd(x, shift, scale, epsilon)[0]
+
+
+def _adaln_fwd(x, shift, scale, epsilon):
+    B, N, E = x.shape
+    bn = _rows_grid(N)
+    out = pl.pallas_call(
+        functools.partial(_adaln_kernel, eps=epsilon),
+        grid=(B, N // bn),
+        in_specs=[
+            pl.BlockSpec((1, bn, E), lambda b, n: (b, n, _0)),
+            pl.BlockSpec((1, 1, E), lambda b, n: (b, _0, _0)),
+            pl.BlockSpec((1, 1, E), lambda b, n: (b, _0, _0)),
+        ],
+        out_specs=pl.BlockSpec((1, bn, E), lambda b, n: (b, n, _0)),
+        out_shape=jax.ShapeDtypeStruct((B, N, E), x.dtype),
+    )(x, shift.reshape(B, 1, E), scale.reshape(B, 1, E))
+    return out, (x, shift, scale)
+
+
+def _adaln_bwd(epsilon, res, g):
+    x, shift, scale = res
+    xf = x.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mu), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(var + epsilon)
+    xn = (xf - mu) * inv
+    dsh = jnp.sum(gf, axis=1).astype(shift.dtype)
+    dsc = jnp.sum(gf * xn, axis=1).astype(scale.dtype)
+    gl = gf * (1.0 + scale.astype(jnp.float32)[:, None, :])
+    dx = inv * (gl - jnp.mean(gl, axis=-1, keepdims=True)
+                - xn * jnp.mean(gl * xn, axis=-1, keepdims=True))
+    return dx.astype(x.dtype), dsh, dsc
+
+
+adaln_modulate_pallas.defvjp(
+    lambda x, sh, sc, eps=1e-6: _adaln_fwd(x, sh, sc, eps), _adaln_bwd)

@@ -57,6 +57,7 @@ class DiTConfig:
     dtype: Any = jnp.bfloat16
     remat: bool = True
     scan_layers: bool = True
+    fused_adaln: bool = False     # Pallas LN+modulate (bench A/Bs on chip)
     mesh: Any = None              # threaded by ShardedTrainState
 
     @property
@@ -235,7 +236,10 @@ def _block(x, c_vec, bp, config: DiTConfig):
     sh1, sc1, g1, sh2, sc2, g2 = [
         s.astype(dt)[:, None, :] for s in jnp.split(mod, 6, axis=-1)]
 
-    h = _layernorm(x).astype(dt) * (1 + sc1) + sh1
+    if cfg.fused_adaln:
+        h = kernels.adaln_modulate(x, sh1[:, 0], sc1[:, 0])
+    else:
+        h = _layernorm(x).astype(dt) * (1 + sc1) + sh1
     q = (h @ bp["wq"] + bp["b_qkv"][0].astype(dt)).reshape(B, N, H, D)
     k = (h @ bp["wk"] + bp["b_qkv"][1].astype(dt)).reshape(B, N, H, D)
     v = (h @ bp["wv"] + bp["b_qkv"][2].astype(dt)).reshape(B, N, H, D)
@@ -243,7 +247,10 @@ def _block(x, c_vec, bp, config: DiTConfig):
     a = a.reshape(B, N, E) @ bp["wo"] + bp["b_o"].astype(dt)
     x = x + g1 * a
 
-    h = _layernorm(x).astype(dt) * (1 + sc2) + sh2
+    if cfg.fused_adaln:
+        h = kernels.adaln_modulate(x, sh2[:, 0], sc2[:, 0])
+    else:
+        h = _layernorm(x).astype(dt) * (1 + sc2) + sh2
     h = jax.nn.gelu(h @ bp["w_mlp1"] + bp["b_mlp1"].astype(dt),
                     approximate=True)
     h = h @ bp["w_mlp2"] + bp["b_mlp2"].astype(dt)

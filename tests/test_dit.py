@@ -148,3 +148,31 @@ class TestAccounting:
     def test_zoo_configs(self):
         assert DiTConfig.XL_2().hidden_size == 1152
         assert DiTConfig.B_2().num_patches == 256
+
+
+def test_fused_adaln_matches_plain(monkeypatch):
+    """fused_adaln=True must be numerically equivalent to the composition —
+    with the PALLAS kernel actually executing (interpret mode + forced
+    dispatcher gate), not the CPU fallback."""
+    import dataclasses
+    import functools
+    from jax.experimental import pallas as pl
+    from paddle_tpu import kernels
+    from paddle_tpu.models import dit
+
+    monkeypatch.setattr(pl, "pallas_call",
+                        functools.partial(pl.pallas_call, interpret=True))
+    monkeypatch.setattr(kernels, "_use_pallas", lambda: True)
+
+    cfg = dataclasses.replace(dit.DiTConfig.tiny(), dtype=jnp.float32)
+    cfg_f = dataclasses.replace(cfg, fused_adaln=True)
+    params = dit.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, cfg.in_channels, cfg.image_size,
+                                         cfg.image_size)), jnp.float32)
+    t = jnp.asarray([3, 7], jnp.int32)
+    y = jnp.asarray([1, 2], jnp.int32)
+    a = dit.forward(params, x, t, y, cfg)
+    b = dit.forward(params, x, t, y, cfg_f)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=2e-4, atol=2e-4)
