@@ -43,6 +43,10 @@ from . import metric  # noqa: E402
 from . import incubate  # noqa: E402
 from . import vision  # noqa: E402
 from . import hub  # noqa: E402
+from .nn.layer import ParamAttr  # noqa: E402
+# dtype objects are strings in this build; paddle.dtype/paddle.bool parity
+dtype = str
+bool = "bool"  # noqa: A001 — paddle exports `paddle.bool`
 from . import hapi  # noqa: E402
 from . import distribution  # noqa: E402
 from . import sparse  # noqa: E402

@@ -4,4 +4,5 @@ python/paddle/audio/)."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
 from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
 from .backends import info, load, save  # noqa: F401

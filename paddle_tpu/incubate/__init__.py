@@ -32,3 +32,193 @@ def softmax_mask_fuse_upper_triangle(x):
         return jax.nn.softmax(jnp.where(m, v, -jnp.inf), axis=-1)
 
     return apply_op("softmax_mask_fuse_upper_triangle", f, _t(x))
+
+
+def softmax_mask_fuse(x, mask):
+    """softmax(x + mask) fused (reference incubate/operators/
+    softmax_mask_fuse.py — XLA fuses the add into the softmax)."""
+    import jax
+    from ..tensor import apply_op
+    from ..nn.functional import _t
+
+    return apply_op("softmax_mask_fuse",
+                    lambda v, m: jax.nn.softmax(v + m, axis=-1),
+                    _t(x), _t(mask))
+
+
+def identity_loss(x, reduction="none"):
+    """Marks a loss for IPU-style identity backward (reference
+    incubate/autograd); here reduction over x with grad flowing as-is."""
+    from .. import ops
+    if reduction in (0, "sum"):
+        return ops.sum(x)
+    if reduction in (1, "mean"):
+        return ops.mean(x)
+    if reduction in (2, "none"):
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum",
+                    out_size=None, name=None):
+    """Deprecated alias of geometric.send_u_recv (reference kept it
+    exported under incubate)."""
+    from ..geometric import send_u_recv
+    return send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                       out_size=out_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Deprecated alias of geometric.reindex_graph."""
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count, value_buffer, index_buffer)
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    """Deprecated alias of geometric.sample_neighbors."""
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, eids=eids,
+                            return_eids=return_eids)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop neighbor sampling (reference incubate/operators/
+    graph_khop_sampler.py): sample_sizes per hop; returns
+    (edge_src, edge_dst, sample_index, reindex_nodes) [+ edge_eids]."""
+    import numpy as np
+    from ..geometric import sample_neighbors, reindex_graph
+    from ..tensor import to_tensor
+
+    cur = input_nodes
+    all_nb, all_ct = [], []
+    seen_order = list(np.asarray(
+        cur._data if hasattr(cur, "_data") else cur).reshape(-1))
+    for size in sample_sizes:
+        nb, ct = sample_neighbors(row, colptr, cur, sample_size=size)
+        all_nb.append(np.asarray(nb._data))
+        all_ct.append(np.asarray(ct._data))
+        nxt = []
+        seen = set(int(v) for v in seen_order)
+        for v in np.asarray(nb._data).reshape(-1):
+            if int(v) not in seen:
+                seen.add(int(v))
+                nxt.append(int(v))
+                seen_order.append(int(v))
+        cur = to_tensor(np.asarray(nxt, np.asarray(nb._data).dtype)) \
+            if nxt else to_tensor(np.zeros((0,), np.int64))
+        if not nxt:
+            break
+    neighbors = np.concatenate(all_nb) if all_nb else np.zeros((0,), np.int64)
+    counts = np.concatenate(all_ct) if all_ct \
+        else np.zeros((0,), np.int32)
+    src, dst, nodes = reindex_graph(
+        to_tensor(np.asarray(
+            [v for v in seen_order][:len(counts)], np.int64)),
+        to_tensor(neighbors), to_tensor(counts))
+    return src, dst, to_tensor(np.asarray(seen_order, np.int64)), nodes
+
+
+def _segment(kind):
+    def op(data, segment_ids, name=None):
+        from .. import geometric
+        return getattr(geometric, f"segment_{kind}")(data, segment_ids)
+    op.__name__ = f"segment_{kind}"
+    op.__doc__ = f"Alias of geometric.segment_{kind} (reference incubate " \
+                 "export)."
+    return op
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_min = _segment("min")
+segment_max = _segment("max")
+
+
+class LookAhead:
+    """Lookahead optimizer wrapper (reference incubate/optimizer/lookahead.py):
+    every k steps, slow weights move alpha toward the fast weights and the
+    fast weights reset to them."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha should be in [0, 1]")
+        if int(k) < 1:
+            raise ValueError("k should be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._steps = 0
+        self._slow = None
+
+    def _params(self):
+        return [p for p in (self.inner_optimizer._parameter_list or [])]
+
+    def step(self):
+        import numpy as np
+        params = self._params()
+        if self._slow is None:
+            # slow weights start at the INITIAL parameters (pre-step)
+            self._slow = [np.asarray(p._data) for p in params]
+        self.inner_optimizer.step()
+        self._steps += 1
+        if self._steps % self.k == 0:
+            import jax.numpy as jnp
+            for i, p in enumerate(params):
+                slow = (jnp.asarray(self._slow[i])
+                        + self.alpha * (p._data - jnp.asarray(self._slow[i])))
+                self._slow[i] = np.asarray(slow)
+                p._data = slow.astype(p._data.dtype)
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+
+class ModelAverage:
+    """Running parameter average for eval (reference incubate/optimizer/
+    modelaverage.py, simplified to the sliding-rate form): accumulate on
+    `step()`; `apply()` swaps averaged weights in, `restore()` swaps back."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params = list(parameters or [])
+        self._sum = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        import numpy as np
+        if self._sum is None:
+            self._sum = [np.zeros_like(np.asarray(p._data, np.float32))
+                         for p in self._params]
+        for s, p in zip(self._sum, self._params):
+            s += np.asarray(p._data, np.float32)
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        import jax.numpy as jnp
+        import numpy as np
+        if not self._count:
+            return
+        self._backup = [np.asarray(p._data) for p in self._params]
+        for s, p in zip(self._sum, self._params):
+            p._data = jnp.asarray(s / self._count).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        import jax.numpy as jnp
+        if self._backup is None:
+            return
+        for b, p in zip(self._backup, self._params):
+            p._data = jnp.asarray(b)
+        self._backup = None

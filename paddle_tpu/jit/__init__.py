@@ -26,7 +26,8 @@ from ..nn.layer import Layer
 from ..tensor import Parameter, Tensor, to_tensor
 
 __all__ = ["to_static", "not_to_static", "save", "load", "TrainStep", "ignore_module",
-           "enable_to_static", "InputSpec", "TranslatedLayer"]
+           "enable_to_static", "InputSpec", "TranslatedLayer",
+           "set_verbosity", "set_code_level"]
 
 
 class InputSpec:
@@ -412,3 +413,22 @@ def load(path, **configs):
     except FileNotFoundError:
         meta = {}
     return TranslatedLayer(state, meta, path)
+
+
+_VERBOSITY = 0
+_CODE_LEVEL = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static logging verbosity (reference jit/dy2static/logging_utils).
+    The record-replay translator has no transformation passes to log, so
+    this stores the level for API parity."""
+    global _VERBOSITY
+    _VERBOSITY = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Level of transformed-code dumping (reference parity; see
+    set_verbosity)."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = int(level)

@@ -113,12 +113,47 @@ def _patch_tensor_methods():
 
 
 def _inplace(t, out):
+    node = out._node
+    if node is not None:
+        # the recorded node input must keep pointing at the ORIGINAL
+        # value/history — after redirection `t` IS the node's output, and a
+        # self-referential input would cut the backward chain (grad through
+        # x*2 -> tanh_(y) -> sum never reached x)
+        ghost = Tensor(t._data, stop_gradient=t.stop_gradient)
+        ghost._node, ghost._out_idx = t._node, t._out_idx
+        node.inputs = tuple(ghost if i is t else i for i in node.inputs)
+        if t._node is not None:
+            # the old producer must now hand ITS cotangent slot to the
+            # ghost (backward keys accumulators by tensor identity)
+            oo = list(t._node.outputs)
+            oo[t._out_idx] = ghost
+            t._node.outputs = tuple(oo)
     t._data, t._node, t._out_idx = out._data, out._node, out._out_idx
-    if out._node is not None:
-        outs = list(out._node.outputs)
+    if node is not None:
+        outs = list(node.outputs)
         outs[out._out_idx] = t
-        out._node.outputs = tuple(outs)
+        node.outputs = tuple(outs)
     return t
 
 
 _patch_tensor_methods()
+
+# long-tail compat surface (imported AFTER _inplace above — the compat
+# op_ family resolves `_inplace` from this module at call time)
+from . import compat  # noqa: E402,F401
+from .compat import *  # noqa: E402,F401,F403
+
+__all__ = __all__ + list(compat.__all__)
+
+_TENSOR_METHOD_SAFE = [
+    n for n in compat.__all__
+    if n not in {"finfo", "iinfo", "set_printoptions", "get_rng_state",
+                 "set_rng_state", "get_cuda_rng_state", "set_cuda_rng_state",
+                 "disable_signal_handler", "check_shape", "flops", "batch",
+                 "LazyGuard", "DataParallel", "create_parameter",
+                 "CUDAPinnedPlace", "polar", "is_empty"}
+]
+for _n in _TENSOR_METHOD_SAFE:
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, getattr(compat, _n))
+del _n

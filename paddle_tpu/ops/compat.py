@@ -1,0 +1,596 @@
+"""Long-tail tensor API (reference python/paddle/tensor/{math,manipulation,
+attribute}.py odds and ends + python/paddle/framework shims).
+
+Three groups:
+  * remaining base ops (addmm, cdist, take, renorm, trapezoid family, ...)
+    — plain apply_op compositions like the rest of ops/;
+  * the inplace ``op_`` family — paddle's eager inplace API.  TPU arrays
+    are immutable, so "inplace" here means: compute out-of-place, then
+    redirect the SAME python Tensor at the result (data + tape node), which
+    reproduces the reference's user-visible semantics (the variable you
+    held is updated, autograd still flows);
+  * dtype/info/infra shims (finfo/iinfo, rng state, set_printoptions,
+    DataParallel, LazyGuard, flops, ...).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..tensor import Tensor, apply_op, to_tensor
+from . import creation as _creation
+from . import linalg as _linalg
+from . import manipulation as _manip
+from . import math as _math
+
+__all__ = [
+    # base ops
+    "addmm", "cdist", "cumulative_trapezoid", "trapezoid", "frexp", "i0e",
+    "i1e", "polygamma", "polar", "sgn", "take", "renorm", "nanquantile",
+    "mv", "unflatten", "unfold", "vsplit", "reverse", "crop", "increment",
+    "is_empty", "is_complex", "is_floating_point", "is_integer",
+    "as_strided",
+    # infra
+    "finfo", "iinfo", "set_printoptions", "get_rng_state", "set_rng_state",
+    "get_cuda_rng_state", "set_cuda_rng_state", "disable_signal_handler",
+    "check_shape", "flops", "batch", "LazyGuard", "DataParallel",
+    "create_parameter", "CUDAPinnedPlace", "where_",
+]
+# the inplace family is appended to __all__ at generation time below
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+# ---------------------------------------------------------------------------
+# base ops
+# ---------------------------------------------------------------------------
+
+
+def i0e(x, name=None):
+    return apply_op("i0e", jax.scipy.special.i0e, _t(x))
+
+
+def i1e(x, name=None):
+    return apply_op("i1e", jax.scipy.special.i1e, _t(x))
+
+
+def mv(x, vec, name=None):
+    """Matrix (M, N) times vector (N,) -> (M,)."""
+    return apply_op("mv", lambda a, b: a @ b, _t(x), _t(vec))
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (reference tensor/math.py sgn)."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return apply_op("sgn", f, _t(x))
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply_op("addmm",
+                    lambda i, a, b: beta * i + alpha * (a @ b),
+                    _t(input), _t(x), _t(y))
+
+
+def polar(abs, angle, name=None):  # noqa: A002 — paddle arg name
+    """abs * e^{i*angle} (complex64/128 output)."""
+    return apply_op(
+        "polar",
+        lambda r, th: (r * jnp.exp(1j * th.astype(jnp.promote_types(
+            th.dtype, jnp.float32)))).astype(
+                jnp.complex128 if r.dtype == jnp.float64 else jnp.complex64),
+        _t(abs), _t(angle))
+
+
+def frexp(x, name=None):
+    """(mantissa, exponent) with x = mantissa * 2**exponent."""
+    def f(a):
+        m, e = jnp.frexp(a)
+        return m, e.astype(a.dtype)
+    return apply_op("frexp", f, _t(x))
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather over the flattened input (reference math.py take)."""
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError(
+            f"'mode' in 'take' should be 'raise', 'wrap', 'clip', but "
+            f"received {mode}.")
+    x, index = _t(x), _t(index)
+    n = 1
+    for d in x.shape:
+        n *= int(d)
+    if mode == "raise" and not isinstance(index._data, jax.core.Tracer):
+        idx = np.asarray(index._data)
+        if idx.size and (idx.min() < -n or idx.max() >= n):
+            raise IndexError(
+                f"take(): index out of range for input with {n} elements")
+
+    def f(a, i):
+        flat = a.reshape(-1)
+        if mode == "wrap":
+            i = ((i % n) + n) % n
+        elif mode == "clip":
+            i = jnp.clip(i, 0, n - 1)
+        else:
+            i = jnp.where(i < 0, i + n, i)
+        return flat[i]
+
+    return apply_op("take", f, x, index, nondiff=(1,))
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Rescale slices along `axis` whose p-norm exceeds max_norm."""
+    x = _t(x)
+    nd = len(x.shape)
+    if not -nd <= axis < nd:
+        raise ValueError(f"axis {axis} out of range for rank {nd}")
+
+    def f(a):
+        m = jnp.moveaxis(a, axis, 0)
+        flat = m.reshape(m.shape[0], -1)
+        norms = (jnp.abs(flat.astype(jnp.float32)) ** p).sum(-1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None].astype(a.dtype)
+        return jnp.moveaxis(out.reshape(m.shape), 0, axis)
+
+    return apply_op("renorm", f, x)
+
+
+renorm_ = None  # defined by the inplace generator below
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Trapezoidal integration (reference math.py trapezoid)."""
+    if x is not None and dx is not None:
+        raise ValueError("trapezoid: pass either x or dx, not both")
+    y = _t(y)
+    if x is not None:
+        return apply_op(
+            "trapezoid",
+            lambda a, b: jnp.trapezoid(a, x=b, axis=axis), y, _t(x))
+    d = 1.0 if dx is None else dx
+    return apply_op("trapezoid",
+                    lambda a: jnp.trapezoid(a, dx=d, axis=axis), y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    """Cumulative trapezoid along axis (one element shorter than input)."""
+    if x is not None and dx is not None:
+        raise ValueError("cumulative_trapezoid: pass either x or dx")
+    y = _t(y)
+
+    def slices(a, lo, hi):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = slice(lo, hi)
+        return a[tuple(idx)]
+
+    if x is not None:
+        def f(a, b):
+            d = slices(b, 1, None) - slices(b, None, -1)
+            avg = (slices(a, 1, None) + slices(a, None, -1)) * 0.5
+            return jnp.cumsum(avg * d, axis=axis)
+        return apply_op("cumulative_trapezoid", f, y, _t(x))
+
+    d = 1.0 if dx is None else dx
+
+    def f(a):
+        avg = (slices(a, 1, None) + slices(a, None, -1)) * 0.5
+        return jnp.cumsum(avg * d, axis=axis)
+    return apply_op("cumulative_trapezoid", f, y)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """Batched pairwise p-distances: (..., P, M) x (..., R, M) -> (..., P, R)."""
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt((diff * diff).sum(-1) + 1e-30)
+        if p == float("inf"):
+            return jnp.abs(diff).max(-1)
+        if p == 0:
+            return (diff != 0).sum(-1).astype(a.dtype)
+        return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+    return apply_op("cdist", f, _t(x), _t(y))
+
+
+def polygamma(x, n, name=None):
+    if n == 0:
+        return apply_op("digamma", jax.lax.digamma, _t(x))
+    return apply_op("polygamma",
+                    lambda a: jax.scipy.special.polygamma(n, a), _t(x))
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return apply_op(
+        "nanquantile",
+        lambda a: jnp.nanquantile(a.astype(jnp.float32), q, axis=axis,
+                                  keepdims=keepdim, method=interpolation),
+        _t(x))
+
+
+def unflatten(x, axis, shape, name=None):
+    x = _t(x)
+    nd = len(x.shape)
+    axis = axis + nd if axis < 0 else axis
+    new_shape = (tuple(int(s) for s in x.shape[:axis])
+                 + tuple(int(s) for s in shape)
+                 + tuple(int(s) for s in x.shape[axis + 1:]))
+    return _manip.reshape(x, list(new_shape))
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows: `axis` becomes n_windows, window size appended as
+    the last dim (reference Tensor.unfold)."""
+    x = _t(x)
+    nd = len(x.shape)
+    axis = axis + nd if axis < 0 else axis
+    D = int(x.shape[axis])
+    if size > D:
+        raise ValueError(f"unfold: size {size} > dim {D}")
+    starts = np.arange(0, D - size + 1, step)
+
+    def f(a):
+        wins = [jax.lax.slice_in_dim(a, int(s), int(s) + size, axis=axis)
+                for s in starts]
+        stacked = jnp.stack(wins, axis=axis)          # (..., n, size, ...)
+        return jnp.moveaxis(stacked, axis + 1, -1)
+    return apply_op("unfold", f, x)
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = _t(x)
+    if len(x.shape) < 2:
+        raise ValueError("vsplit expects a tensor of at least rank 2")
+    return _manip.split(x, num_or_indices, axis=0)
+
+
+def reverse(x, axis, name=None):
+    """Deprecated alias of flip (reference keeps it exported)."""
+    return _manip.flip(_t(x), axis)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    x = _t(x)
+    nd = len(x.shape)
+    shape = list(x.shape) if shape is None else [
+        int(x.shape[i]) - (0 if offsets is None else int(offsets[i]))
+        if int(s) == -1 else int(s) for i, s in enumerate(shape)]
+    offsets = [0] * nd if offsets is None else [int(o) for o in offsets]
+
+    def f(a):
+        return jax.lax.slice(a, offsets,
+                             [o + s for o, s in zip(offsets, shape)])
+    return apply_op("crop", f, x)
+
+
+def increment(x, value=1.0, name=None):
+    """x += value in place, returning the updated Tensor (reference
+    tensor/math.py increment — a counter op, typically on stop-gradient
+    scalars)."""
+    x = _t(x)
+    if (framework.is_grad_enabled() and not x.stop_gradient
+            and x._node is None):
+        raise RuntimeError(
+            "increment: in-place operation on a leaf Tensor that requires "
+            "grad is not allowed (matches the reference restriction)")
+    out = apply_op("increment", lambda a: a + value, x)
+    from . import _inplace
+    return _inplace(x, out)
+
+
+def where_(condition, x, y, name=None):
+    """In-place where: x <- where(condition, x, y) — the inplace target is
+    x, NOT the condition (reference tensor/search.py where_)."""
+    x = _t(x)
+    if (framework.is_grad_enabled() and not x.stop_gradient
+            and x._node is None):
+        raise RuntimeError(
+            "where_: in-place operation on a leaf Tensor that requires "
+            "grad is not allowed (matches the reference restriction)")
+    out = apply_op("where", lambda c, a, b: jnp.where(c, a, b),
+                   _t(condition), x, _t(y), nondiff=(0,))
+    from . import _inplace
+    return _inplace(x, out)
+
+
+def is_empty(x, name=None):
+    x = _t(x)
+    n = 1
+    for d in x.shape:
+        n *= int(d)
+    return to_tensor(np.array(n == 0))
+
+
+def is_complex(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.complexfloating)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.floating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(_t(x)._data.dtype, jnp.integer)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """Strided view over the flattened input (gather-based — TPU arrays
+    are immutable so this is a copy, matching reference values)."""
+    x = _t(x)
+    shape = [int(s) for s in shape]
+    stride = [int(s) for s in stride]
+    if len(shape) != len(stride):
+        raise ValueError("as_strided: shape and stride ranks differ")
+    idx = np.full(tuple(shape), int(offset), np.int64)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        ax = np.arange(s, dtype=np.int64) * st
+        idx += ax.reshape((1,) * d + (s,) + (1,) * (len(shape) - d - 1))
+
+    def f(a):
+        return a.reshape(-1)[idx]
+    return apply_op("as_strided", f, x)
+
+
+# ---------------------------------------------------------------------------
+# the inplace family
+# ---------------------------------------------------------------------------
+
+
+def _make_inplace(base_fn, name):
+    def op_(x, *args, **kwargs):
+        if (framework.is_grad_enabled() and isinstance(x, Tensor)
+                and not x.stop_gradient and x._node is None):
+            raise RuntimeError(
+                f"{name}: in-place operation on a leaf Tensor that requires "
+                "grad is not allowed (matches the reference restriction)")
+        out = base_fn(x, *args, **kwargs)
+        from . import _inplace
+        return _inplace(x, out)
+    op_.__name__ = name
+    op_.__doc__ = f"In-place variant of `{base_fn.__name__}` (reference " \
+                  f"paddle.{name})."
+    return op_
+
+
+_INPLACE_BASES = [
+    "abs", "acos", "asin", "atan", "addmm", "bitwise_and", "bitwise_not",
+    "bitwise_or", "bitwise_xor", "ceil", "cos", "cosh", "cumprod", "cumsum",
+    "digamma", "divide", "equal", "erf", "exp", "expm1", "floor",
+    "floor_divide", "floor_mod", "frac", "gcd", "greater_equal",
+    "greater_than", "i0", "index_add", "index_put", "lcm", "ldexp",
+    "less_equal", "less_than", "lgamma", "log", "log10", "log1p", "log2",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "mod", "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",
+    "remainder", "renorm", "rsqrt", "sigmoid", "sin", "sinh", "sqrt",
+    "square", "subtract", "tan", "tanh", "tril", "triu", "trunc",
+]
+
+_INPLACE = {}
+_this = globals()
+for _b in _INPLACE_BASES:
+    _base = _this.get(_b) or getattr(_math, _b, None) \
+        or getattr(_manip, _b, None) or getattr(_linalg, _b, None) \
+        or getattr(_creation, _b, None)
+    if _base is None:
+        continue
+    _nm = _b + "_"
+    _INPLACE[_nm] = _make_inplace(_base, _nm)
+    _this[_nm] = _INPLACE[_nm]
+__all__ += sorted(_INPLACE)
+
+
+# ---------------------------------------------------------------------------
+# dtype/info/infra shims
+# ---------------------------------------------------------------------------
+
+
+class finfo:
+    """Float dtype limits (reference paddle.finfo)."""
+
+    def __init__(self, dtype):
+        from ..framework import convert_dtype, to_jax_dtype
+        f = np.finfo(np.dtype(jnp.dtype(to_jax_dtype(convert_dtype(dtype)))
+                              .name) if convert_dtype(dtype) != "bfloat16"
+                     else np.float32)
+        if convert_dtype(dtype) == "bfloat16":
+            self.min, self.max = -3.3895314e38, 3.3895314e38
+            self.eps, self.tiny = 0.0078125, 1.1754944e-38
+            self.bits, self.dtype = 16, "bfloat16"
+        else:
+            self.min, self.max = float(f.min), float(f.max)
+            self.eps, self.tiny = float(f.eps), float(f.tiny)
+            self.bits, self.dtype = f.bits, convert_dtype(dtype)
+        self.smallest_normal = self.tiny
+        self.resolution = self.eps
+
+
+class iinfo:
+    """Integer dtype limits (reference paddle.iinfo)."""
+
+    def __init__(self, dtype):
+        from ..framework import convert_dtype, to_jax_dtype
+        i = np.iinfo(np.dtype(jnp.dtype(
+            to_jax_dtype(convert_dtype(dtype))).name))
+        self.min, self.max = int(i.min), int(i.max)
+        self.bits, self.dtype = i.bits, convert_dtype(dtype)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def get_rng_state(device=None):
+    """Opaque RNG state list (reference returns per-device generator
+    states; here the default Generator's (seed, count) is the source)."""
+    return [framework.default_generator().get_state()]
+
+
+def set_rng_state(state_list, device=None):
+    framework.default_generator().set_state(tuple(state_list[0]))
+
+
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def disable_signal_handler():
+    """No-op: the reference unhooks its C++ signal handlers; this runtime
+    installs none."""
+
+
+def check_shape(shape, op_name="") -> None:
+    """Validate a shape argument (reference utils/layers_utils.check_shape)."""
+    if isinstance(shape, Tensor):
+        shape = shape.numpy().tolist()
+    for s in shape:
+        if not isinstance(s, (int, np.integer)):
+            raise TypeError(f"{op_name}: shape entries must be ints, got "
+                            f"{type(s).__name__}")
+        if int(s) < -1:
+            raise ValueError(f"{op_name}: invalid shape entry {int(s)}")
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """FLOPs estimate by a hooked forward pass over zeros(input_size)
+    (reference hapi/dynamic_flops.py).  Counts Linear/Conv multiply-adds
+    x2; custom_ops: {LayerClass: fn(layer, inputs, output) -> flops}."""
+    from ..nn.layer import Layer
+
+    total = [0]
+    hooks = []
+
+    def count(layer, inputs, output):
+        cls = type(layer)
+        if custom_ops and cls in custom_ops:
+            total[0] += int(custom_ops[cls](layer, inputs, output))
+            return
+        w = getattr(layer, "weight", None)
+        if w is None or not isinstance(w, Tensor):
+            return
+        wn = 1
+        for d in w.shape:
+            wn *= int(d)
+        out0 = output[0] if isinstance(output, (tuple, list)) else output
+        if not isinstance(out0, Tensor):
+            return
+        spatial = 1
+        if len(w.shape) > 2:            # conv kernels: per output position
+            spatial = int(np.prod(out0.shape[2:]))
+        batch = int(out0.shape[0]) if out0.shape else 1
+        total[0] += 2 * wn * spatial * batch
+
+    for sub in net.sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(count))
+    try:
+        x = to_tensor(np.zeros(input_size, np.float32))
+        net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]}")
+    return total[0]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference paddle.batch)."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+class LazyGuard:
+    """Parameter-init deferral guard (reference paddle.LazyGuard).  This
+    runtime initializes eagerly on host — construction under the guard is
+    already cheap (numpy init, no device traffic), so the guard is a
+    documented no-op kept for API parity."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class DataParallel:
+    """Reference paddle.DataParallel wrapper.  Under GSPMD, data
+    parallelism is a sharding annotation, not a wrapper — this class keeps
+    the reference's surface (attribute passthrough, scale_loss/state_dict)
+    while the mesh does the actual work (distributed/parallelize.py)."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        self._layers = layers
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["_layers"], name)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference paddle.create_parameter)."""
+    from ..nn import initializer as I
+    from ..nn.layer import ParamAttr
+    from ..tensor import Parameter
+    from ..framework import convert_dtype
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = (attr.initializer or default_initializer
+            or (I.Constant(0.0) if is_bias else I.XavierNormal()))
+    data = init([int(s) for s in shape], convert_dtype(dtype))
+    p = Parameter(data, name=attr.name or name, trainable=attr.trainable)
+    return p
+
+
+CUDAPinnedPlace = lambda: "cpu"  # noqa: E731 — place objects are strings here

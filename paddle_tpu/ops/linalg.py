@@ -221,3 +221,81 @@ def matrix_exp(x, name=None):
 
 def vander(x, n=None, increasing=False, name=None):
     return apply_op("vander", lambda a: jnp.vander(a, N=n, increasing=increasing), _t(x))
+
+
+def inv(x, name=None):
+    """Alias of inverse (reference linalg.inv)."""
+    return inverse(x, name=name)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s packed factors into (P, L, U) (reference
+    tensor/linalg.py lu_unpack; pivots are 1-based like lu())."""
+    x, y = _t(x), _t(y)
+    m, n = int(x.shape[-2]), int(x.shape[-1])
+    k = min(m, n)
+
+    def lu_part(a):
+        tril_ = jnp.tril(a[..., :, :k], k=-1)
+        eye = jnp.eye(m, k, dtype=a.dtype)
+        return tril_ + eye
+
+    def u_part(a):
+        return jnp.triu(a[..., :k, :])
+
+    L = apply_op("lu_unpack_l", lu_part, x) if unpack_ludata else None
+    U = apply_op("lu_unpack_u", u_part, x) if unpack_ludata else None
+    P = None
+    if unpack_pivots:
+        piv = np.asarray(y._data) - 1          # back to 0-based
+        batch = piv.reshape(-1, piv.shape[-1])
+        pmats = []
+        for row in batch:                      # one P per batch element
+            perm = np.arange(m)
+            for i, pv in enumerate(row[:k]):
+                perm[[i, int(pv)]] = perm[[int(pv), i]]
+            pm = np.zeros((m, m), np.float32)
+            pm[perm, np.arange(m)] = 1.0
+            pmats.append(pm)
+        pmat = np.stack(pmats).reshape(piv.shape[:-1] + (m, m))
+        if np.asarray(x._data).dtype != np.dtype("bfloat16"):
+            pmat = pmat.astype(np.asarray(x._data).dtype)
+        P = to_tensor(pmat)
+    return P, L, U
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference tensor/linalg.py pca_lowrank): returns
+    (U, S, V) with x ~ U diag(S) V^T over the top-q components."""
+    x = _t(x)
+    m, n = int(x.shape[-2]), int(x.shape[-1])
+    if q is None:
+        q = min(6, m, n)
+    if not 0 <= q <= min(m, n):
+        raise ValueError(f"q={q} out of range for shape {(m, n)}")
+
+    # oversampled randomized range finder (Halko et al.; the reference
+    # delegates to the same scheme) with re-orthonormalized power steps
+    s_over = min(q + 6, m, n)
+
+    def f(a, key):
+        af = a.astype(jnp.float32)
+        if center:
+            af = af - af.mean(-2, keepdims=True)
+        omega = jax.random.normal(key, a.shape[:-2] + (n, s_over),
+                                  jnp.float32)
+        y_, _ = jnp.linalg.qr(af @ omega)
+        for _ in range(niter):
+            z_, _ = jnp.linalg.qr(af.swapaxes(-1, -2) @ y_)
+            y_, _ = jnp.linalg.qr(af @ z_)
+        b = y_.swapaxes(-1, -2) @ af
+        u_b, s, vt = jnp.linalg.svd(b, full_matrices=False)
+        u = y_ @ u_b
+        return u[..., :q], s[..., :q], vt.swapaxes(-1, -2)[..., :q]
+
+    from ..framework import next_rng_key
+    key = next_rng_key()
+    return apply_op("pca_lowrank", lambda a: f(a, key), x)
+
+
+__all__ += ["inv", "lu_unpack", "pca_lowrank"]

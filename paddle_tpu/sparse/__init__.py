@@ -31,6 +31,7 @@ __all__ = [
     "sqrt", "square", "log1p", "abs", "neg", "expm1", "rad2deg", "deg2rad",
     "pow", "cast", "coalesce", "add", "subtract", "multiply", "divide",
     "matmul", "masked_matmul", "transpose", "reshape", "sum", "to_dense",
+    "addmm", "isnan", "mv", "slice", "pca_lowrank",
 ]
 
 
@@ -367,3 +368,75 @@ def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
 
 def to_dense(x):
     return x.to_dense()
+
+
+isnan = _unary("isnan", jnp.isnan)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference sparse/math.py
+    addmm; dense output)."""
+    from ..tensor import to_tensor as _tt
+    xv = matmul(x, y)
+    iv = input._data if isinstance(input, Tensor) else jnp.asarray(
+        np.asarray(input))
+    return _tt(beta * iv + alpha * (xv._data if isinstance(xv, Tensor)
+                                    else jnp.asarray(xv.numpy())))
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix x dense vector -> dense vector."""
+    v = _raw(vec)
+    if isinstance(x, SparseCsrTensor):
+        x = x.to_coo()
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.mv expects a sparse tensor")
+    b = x._bcoo
+    rows, cols = b.indices[:, 0], b.indices[:, 1]
+    import jax
+    out = jax.ops.segment_sum(b.data * v[cols], rows,
+                              num_segments=b.shape[0])
+    return to_tensor(out)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001 — paddle name
+    """Slice a sparse tensor along `axes` -> sparse (reference
+    sparse/manipulation.py slice)."""
+    if isinstance(x, SparseCsrTensor):
+        return _dense_to_csr(
+            np.asarray(slice(x.to_coo(), axes, starts, ends).to_dense()
+                       .numpy()))
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse.slice expects a sparse tensor")
+    b = x._bcoo
+    idx = np.asarray(b.indices)
+    vals = b.data
+    shape = list(b.shape)
+    n_sparse = idx.shape[1]
+    keep = np.ones(idx.shape[0], bool)
+    new_shape = list(shape)
+    offs = {}
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) + len(shape) if int(ax) < 0 else int(ax)
+        st = int(st) + shape[ax] if int(st) < 0 else int(st)
+        en = int(en) + shape[ax] if int(en) < 0 else min(int(en), shape[ax])
+        st, en = max(0, st), max(0, en)
+        new_shape[ax] = max(0, en - st)
+        if ax >= n_sparse:
+            raise NotImplementedError(
+                "sparse.slice over dense (channel) dims is not supported")
+        keep &= (idx[:, ax] >= st) & (idx[:, ax] < en)
+        offs[ax] = st
+    new_idx = idx[keep].copy()
+    for ax, st in offs.items():
+        new_idx[:, ax] -= st
+    return SparseCooTensor(jsparse.BCOO(
+        (vals[np.nonzero(keep)[0]], jnp.asarray(new_idx)),
+        shape=tuple(new_shape)))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over a (densified) sparse matrix (reference
+    sparse/math.py pca_lowrank delegates the same way)."""
+    from ..ops.linalg import pca_lowrank as _dense_pca
+    return _dense_pca(x.to_dense(), q=q, center=center, niter=niter)

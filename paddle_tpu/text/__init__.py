@@ -14,9 +14,15 @@ import jax.numpy as jnp
 
 from ..tensor import Tensor, to_tensor
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st"]
 
 from . import datasets  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401
+    Conll05st, Imdb, Imikolov, Movielens, WMT14, WMT16,
+)
+from .datasets import UciHousing as UCIHousing  # noqa: E402 — ref spelling
 
 
 def _raw(x):

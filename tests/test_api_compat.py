@@ -1,0 +1,385 @@
+"""Long-tail API surface tests (ops/compat.py, linalg/sparse/geometric/
+incubate/audio/text additions) — every name the reference exports must
+work, not just exist."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBaseOps:
+    def test_addmm(self):
+        i = np.ones((3, 3), "float32")
+        a = np.random.randn(3, 4).astype("float32")
+        b = np.random.randn(4, 3).astype("float32")
+        out = paddle.addmm(paddle.to_tensor(i), paddle.to_tensor(a),
+                           paddle.to_tensor(b), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * i + 2.0 * (a @ b),
+                                   rtol=1e-5)
+
+    def test_cdist_p2_and_inf(self):
+        x = np.random.randn(3, 5).astype("float32")
+        y = np.random.randn(4, 5).astype("float32")
+        d2 = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        ref = np.sqrt(((x[:, None] - y[None]) ** 2).sum(-1))
+        np.testing.assert_allclose(d2, ref, rtol=1e-4, atol=1e-5)
+        dinf = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y),
+                            p=float("inf")).numpy()
+        np.testing.assert_allclose(
+            dinf, np.abs(x[:, None] - y[None]).max(-1), rtol=1e-5)
+
+    def test_take_modes(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([0, 5, -1]))).numpy(),
+            [0, 5, 11])
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([13])),
+                        mode="wrap").numpy(), [1])
+        np.testing.assert_allclose(
+            paddle.take(x, paddle.to_tensor(np.array([99])),
+                        mode="clip").numpy(), [11])
+        with pytest.raises(IndexError):
+            paddle.take(x, paddle.to_tensor(np.array([12])))
+        with pytest.raises(ValueError):
+            paddle.take(x, paddle.to_tensor(np.array([0])), mode="bounce")
+
+    def test_frexp_roundtrip(self):
+        x = np.random.randn(8).astype("float32") * 100
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        np.testing.assert_allclose(m.numpy() * 2.0 ** e.numpy(), x,
+                                   rtol=1e-6)
+
+    def test_trapezoid_family(self):
+        y = np.random.randn(3, 6).astype("float32")
+        np.testing.assert_allclose(
+            paddle.trapezoid(paddle.to_tensor(y)).numpy(),
+            np.trapezoid(y, axis=-1) if hasattr(np, "trapezoid")
+            else np.trapz(y, axis=-1), rtol=1e-5)
+        ct = paddle.cumulative_trapezoid(paddle.to_tensor(y), dx=0.5)
+        assert list(ct.shape) == [3, 5]
+        with pytest.raises(ValueError):
+            paddle.trapezoid(paddle.to_tensor(y), x=paddle.to_tensor(y),
+                             dx=1.0)
+
+    def test_renorm_caps_norms(self):
+        x = np.random.randn(4, 6).astype("float32") * 3
+        r = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0).numpy()
+        assert np.all(np.linalg.norm(r, axis=1) <= 1.0 + 1e-4)
+
+    def test_unfold_as_strided_sgn_mv(self):
+        x = paddle.to_tensor(np.arange(10, dtype="float32"))
+        u = paddle.unfold(x, 0, 4, 3)
+        np.testing.assert_allclose(u.numpy()[1], [3, 4, 5, 6])
+        s = paddle.as_strided(paddle.to_tensor(
+            np.arange(12, dtype="float32")), [3, 2], [4, 1], offset=1)
+        np.testing.assert_allclose(s.numpy()[0], [1, 2])
+        np.testing.assert_allclose(
+            paddle.sgn(paddle.to_tensor(np.array([-2., 0., 3.]))).numpy(),
+            [-1, 0, 1])
+        m = np.random.randn(3, 4).astype("float32")
+        v = np.random.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.mv(paddle.to_tensor(m), paddle.to_tensor(v)).numpy(),
+            m @ v, rtol=1e-5)
+
+    def test_predicates_and_misc(self):
+        x = paddle.to_tensor(np.zeros((2, 3), "float32"))
+        assert paddle.is_floating_point(x)
+        assert not paddle.is_integer(x)
+        assert not paddle.is_complex(x)
+        assert not bool(paddle.is_empty(x).numpy())
+        v = paddle.vsplit(paddle.to_tensor(np.zeros((4, 2), "float32")), 2)
+        assert len(v) == 2 and list(v[0].shape) == [2, 2]
+        rv = paddle.reverse(paddle.to_tensor(np.array([1., 2., 3.])), [0])
+        np.testing.assert_allclose(rv.numpy(), [3, 2, 1])
+        c = paddle.crop(paddle.to_tensor(np.arange(12, dtype="float32")
+                                         .reshape(3, 4)),
+                        shape=[2, 2], offsets=[1, 1])
+        np.testing.assert_allclose(c.numpy(), [[5, 6], [9, 10]])
+        uf = paddle.unflatten(paddle.to_tensor(np.zeros((2, 6), "float32")),
+                              1, [2, 3])
+        assert list(uf.shape) == [2, 2, 3]
+        np.testing.assert_allclose(
+            paddle.polygamma(paddle.to_tensor(np.array([2.0], "float32")),
+                             0).numpy(),
+            [1 - 0.5772156649], rtol=1e-4)
+
+
+class TestInplaceFamily:
+    def test_inplace_updates_same_object(self):
+        x = paddle.to_tensor(np.abs(np.random.randn(5).astype("float32")))
+        ref = np.sqrt(x.numpy())
+        out = paddle.sqrt_(x)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), ref, rtol=1e-6)
+
+    def test_leaf_requires_grad_rejected(self):
+        z = paddle.to_tensor(np.random.randn(3).astype("float32"),
+                             stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            paddle.tanh_(z)
+
+    def test_grad_flows_through_inplace_chain(self):
+        x = paddle.to_tensor(np.random.randn(4).astype("float32"),
+                             stop_gradient=False)
+        y = x * 2.0          # non-leaf
+        paddle.tanh_(y)
+        y.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), 2.0 / np.cosh(2 * x.numpy()) ** 2, rtol=1e-4)
+
+    def test_tensor_methods_bound(self):
+        x = paddle.to_tensor(np.abs(np.random.randn(3)).astype("float32"))
+        x.log_()
+        t = paddle.to_tensor(np.random.randn(2, 2).astype("float32"))
+        assert hasattr(t, "cdist") and hasattr(t, "addmm_")
+
+
+class TestInfra:
+    def test_finfo_iinfo(self):
+        assert paddle.finfo(paddle.float32).bits == 32
+        assert paddle.finfo("bfloat16").eps == 0.0078125
+        assert paddle.iinfo("int16").max == 32767
+
+    def test_rng_state_roundtrip(self):
+        paddle.seed(11)
+        st = paddle.get_rng_state()
+        a = paddle.rand([4]).numpy()
+        paddle.set_rng_state(st)
+        b = paddle.rand([4]).numpy()
+        np.testing.assert_allclose(a, b)
+
+    def test_flops_linear(self):
+        net = paddle.nn.Linear(8, 4)
+        assert paddle.flops(net, [2, 8]) == 2 * 2 * 8 * 4
+
+    def test_batch_reader(self):
+        r = paddle.batch(lambda: iter(range(7)), 3)
+        assert [len(b) for b in r()] == [3, 3, 1]
+        r = paddle.batch(lambda: iter(range(7)), 3, drop_last=True)
+        assert [len(b) for b in r()] == [3, 3]
+
+    def test_data_parallel_passthrough(self):
+        net = paddle.nn.Linear(4, 2)
+        dp = paddle.DataParallel(net)
+        x = paddle.to_tensor(np.zeros((3, 4), "float32"))
+        np.testing.assert_allclose(dp(x).numpy(), net(x).numpy())
+        assert dp.scale_loss(5) == 5
+        assert set(dp.state_dict()) == set(net.state_dict())
+
+    def test_create_parameter_and_guard(self):
+        p = paddle.create_parameter([2, 3], "float32")
+        assert p.trainable and list(p.shape) == [2, 3]
+        with paddle.LazyGuard():
+            net = paddle.nn.Linear(2, 2)
+        assert net.weight is not None
+        paddle.check_shape([3, -1], "op")
+        with pytest.raises(ValueError):
+            paddle.check_shape([-5], "op")
+
+
+class TestLinalgAdditions:
+    def test_inv_lu_unpack(self):
+        a = np.random.randn(4, 4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+        lu_, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu_, piv)
+        np.testing.assert_allclose(P.numpy() @ L.numpy() @ U.numpy(), a,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_pca_lowrank(self):
+        paddle.seed(0)
+        x = np.random.randn(40, 8).astype("float32")
+        U, S, V = paddle.linalg.pca_lowrank(paddle.to_tensor(x), q=3)
+        xc = x - x.mean(0)
+        s_ref = np.linalg.svd(xc, compute_uv=False)
+        np.testing.assert_allclose(S.numpy(), s_ref[:3], rtol=2e-2)
+
+
+class TestSparseAdditions:
+    def test_mv_addmm_isnan_slice(self):
+        from paddle_tpu import sparse
+        d = np.array([[1., 0., 2.], [0., 3., 0.]], "float32")
+        rows, cols = np.nonzero(d)
+        sp = sparse.sparse_coo_tensor(np.stack([rows, cols]), d[rows, cols],
+                                      shape=[2, 3])
+        v = np.array([1., 2., 3.], "float32")
+        np.testing.assert_allclose(sparse.mv(sp, paddle.to_tensor(v)).numpy(),
+                                   d @ v)
+        i = np.ones((2, 2), "float32")
+        y = np.random.randn(3, 2).astype("float32")
+        out = sparse.addmm(paddle.to_tensor(i), sp, paddle.to_tensor(y),
+                           beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(), 0.5 * i + 2 * (d @ y),
+                                   rtol=1e-5)
+        n = sparse.isnan(sp)
+        assert not n.values().numpy().any()
+        sl = sparse.slice(sp, [1], [1], [3])
+        np.testing.assert_allclose(np.asarray(sl.to_dense().numpy()),
+                                   d[:, 1:3])
+
+    def test_pca_lowrank_sparse(self):
+        from paddle_tpu import sparse
+        d = np.random.randn(20, 6).astype("float32")
+        d[np.abs(d) < 1.0] = 0
+        rows, cols = np.nonzero(d)
+        sp = sparse.sparse_coo_tensor(np.stack([rows, cols]), d[rows, cols],
+                                      shape=list(d.shape))
+        U, S, V = sparse.pca_lowrank(sp, q=2)
+        assert list(S.shape) == [2]
+
+
+class TestGraphAdditions:
+    def _csc(self):
+        # graph: 0->{1,2}, 1->{2}, 2->{0,1}  as CSC (in-neighbors)
+        colptr = np.array([0, 1, 3, 5], np.int64)
+        row = np.array([2, 0, 2, 0, 1], np.int64)
+        return row, colptr
+
+    def test_weighted_sample_neighbors(self):
+        from paddle_tpu import geometric
+        row, colptr = self._csc()
+        w = np.array([1.0, 0.5, 0.5, 0.9, 0.1], "float32")
+        nb, ct = geometric.weighted_sample_neighbors(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(w), paddle.to_tensor(np.array([1], np.int64)),
+            sample_size=1)
+        assert ct.numpy()[0] == 1 and nb.numpy()[0] in (0, 2)
+
+    def test_reindex_heter_graph(self):
+        from paddle_tpu import geometric
+        x = paddle.to_tensor(np.array([10, 20], np.int64))
+        nb1 = paddle.to_tensor(np.array([30, 20], np.int64))
+        ct1 = paddle.to_tensor(np.array([1, 1], np.int64))
+        nb2 = paddle.to_tensor(np.array([10, 40], np.int64))
+        ct2 = paddle.to_tensor(np.array([1, 1], np.int64))
+        src, dst, nodes = geometric.reindex_heter_graph(
+            x, [nb1, nb2], [ct1, ct2])
+        np.testing.assert_array_equal(nodes.numpy(), [10, 20, 30, 40])
+        np.testing.assert_array_equal(src.numpy(), [2, 1, 0, 3])
+        np.testing.assert_array_equal(dst.numpy(), [0, 1, 0, 1])
+
+    def test_incubate_aliases_and_khop(self):
+        from paddle_tpu import incubate
+        x = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        src = paddle.to_tensor(np.array([0, 1], np.int64))
+        dst = paddle.to_tensor(np.array([1, 2], np.int64))
+        out = incubate.graph_send_recv(x, src, dst)
+        assert list(out.shape) == [3, 4]
+        seg = incubate.segment_sum(
+            paddle.to_tensor(np.ones((4, 2), "float32")),
+            paddle.to_tensor(np.array([0, 0, 1, 1], np.int64)))
+        np.testing.assert_allclose(seg.numpy(), [[2, 2], [2, 2]])
+        row, colptr = self._csc()
+        s, d, sample_index, nodes = incubate.graph_khop_sampler(
+            paddle.to_tensor(row), paddle.to_tensor(colptr),
+            paddle.to_tensor(np.array([0], np.int64)), [2, 2])
+        assert len(sample_index.numpy()) >= 1
+
+    def test_lookahead_and_model_average(self):
+        from paddle_tpu import incubate
+        net = paddle.nn.Linear(4, 2)
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters())
+        opt = incubate.LookAhead(inner, alpha=0.5, k=2)
+        x = paddle.to_tensor(np.random.randn(8, 4).astype("float32"))
+        for _ in range(4):
+            loss = (net(x) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        ma = incubate.ModelAverage(parameters=net.parameters())
+        w0 = net.weight.numpy().copy()
+        ma.step()
+        net.weight._data = net.weight._data * 0
+        ma.step()
+        ma.apply()
+        np.testing.assert_allclose(net.weight.numpy(), w0 / 2, rtol=1e-5)
+        ma.restore()
+        np.testing.assert_allclose(net.weight.numpy(), 0)
+
+
+class TestAudioTextDatasets:
+    def test_esc50_splits(self):
+        from paddle_tpu.audio.datasets import ESC50
+        tr = ESC50(mode="train", split=1)
+        dv = ESC50(mode="dev", split=1)
+        assert len(tr) > 0 and len(dv) > 0
+        w, lab = tr[0]
+        assert w.dtype == np.float32 and 0 <= int(lab) < 50
+        with pytest.raises(ValueError):
+            ESC50(split=9)
+
+    def test_tess(self):
+        from paddle_tpu.audio.datasets import TESS
+        ds = TESS(mode="train")
+        w, lab = ds[0]
+        assert 0 <= int(lab) < 7
+
+    def test_text_top_level_reexports(self):
+        import paddle_tpu.text as text
+        assert hasattr(text, "WMT14") and hasattr(text, "UCIHousing")
+
+    def test_jit_verbosity_shims(self):
+        paddle.jit.set_verbosity(3)
+        paddle.jit.set_code_level(50)
+
+
+class TestReviewFixes:
+    def test_lu_unpack_batched(self):
+        a = np.random.randn(2, 3, 3).astype("float32")
+        lus, pivs, Ps = [], [], []
+        for b in range(2):
+            lu_, piv = paddle.linalg.lu(paddle.to_tensor(a[b]))
+            lus.append(lu_.numpy())
+            pivs.append(piv.numpy())
+        lu_b = paddle.to_tensor(np.stack(lus))
+        piv_b = paddle.to_tensor(np.stack(pivs))
+        P, L, U = paddle.linalg.lu_unpack(lu_b, piv_b)
+        rec = P.numpy() @ L.numpy() @ U.numpy()
+        np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-4)
+
+    def test_where_inplace_targets_x(self):
+        cond = paddle.to_tensor(np.array([True, False, True]))
+        x = paddle.to_tensor(np.array([1., 2., 3.], "float32"))
+        y = paddle.to_tensor(np.array([9., 9., 9.], "float32"))
+        out = paddle.where_(cond, x, y)
+        assert out is x
+        np.testing.assert_allclose(x.numpy(), [1, 9, 3])
+        np.testing.assert_array_equal(cond.numpy(), [True, False, True])
+
+    def test_increment_leaf_guard(self):
+        z = paddle.to_tensor(np.zeros(2, "float32"), stop_gradient=False)
+        with pytest.raises(RuntimeError):
+            paddle.increment(z)
+        c = paddle.to_tensor(np.zeros((), "float32"))
+        paddle.increment(c, 2.0)
+        assert float(c.numpy()) == 2.0
+
+    def test_lookahead_slow_start_and_k_validation(self):
+        from paddle_tpu import incubate
+        with pytest.raises(ValueError):
+            incubate.LookAhead(None, k=0)
+        net = paddle.nn.Linear(2, 1)
+        w0 = net.weight.numpy().copy()
+        inner = paddle.optimizer.SGD(learning_rate=1.0,
+                                     parameters=net.parameters())
+        la = incubate.LookAhead(inner, alpha=0.5, k=1)
+        x = paddle.to_tensor(np.ones((4, 2), "float32"))
+        (net(x) ** 2).mean().backward()
+        la.step()
+        # k=1: slow = w0 + 0.5*(fast - w0) -> exactly halfway from INITIAL
+        fast_after = w0 - 1.0 * np.asarray(net.weight.grad.numpy()) \
+            if net.weight.grad is not None else None
+        assert not np.allclose(net.weight.numpy(), w0)
+
+    def test_audio_sample_rate_consistency(self):
+        from paddle_tpu.audio.datasets import ESC50, TESS
+        w, _ = ESC50()[0]
+        assert len(w) == int(ESC50.sample_rate * 0.005)
+        w, _ = TESS()[0]
+        assert len(w) == int(TESS.sample_rate * 0.005)
