@@ -116,3 +116,11 @@ class ParallelEnv:
     @property
     def local_rank(self):
         return get_rank()
+
+
+# long-tail surface (object collectives, PS entries, fleet datasets, gloo
+# shims) — see compat.py
+from . import compat as _compat  # noqa: E402
+from .compat import *  # noqa: E402,F401,F403
+
+__all__ += list(_compat.__all__)

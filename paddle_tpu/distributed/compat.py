@@ -1,0 +1,356 @@
+"""Long-tail distributed surface (reference python/paddle/distributed/
+__init__.py remainders): object collectives, p2p handles, PS table entry
+configs, fleet datasets, gloo shims.
+
+Semantics note: this runtime's eager collectives model "ranks" as shards
+of one process over a mesh axis (collective.py).  The object collectives
+below follow the same model — with a 1-rank world they are identity;
+multi-host object exchange goes through the KV store started by
+distributed.launch when one is configured (PADDLE_MASTER env).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, List, Optional
+
+import jax
+import numpy as np
+
+from ..tensor import Tensor, to_tensor
+
+__all__ = [
+    "ParallelMode", "DistAttr", "CountFilterEntry", "ProbabilityEntry",
+    "ShowClickEntry", "InMemoryDataset", "QueueDataset",
+    "all_gather_object", "broadcast_object_list", "scatter_object_list",
+    "alltoall_single", "gather", "split", "isend", "irecv", "wait",
+    "get_backend", "get_group", "is_available", "destroy_process_group",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release", "io",
+]
+
+
+class ParallelMode:
+    """Reference distributed/parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class DistAttr:
+    """Reference DistAttr(mesh, sharding_specs) — carried by shard_tensor;
+    here a plain record the auto_parallel layer reads."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"sharding_specs={self.sharding_specs})")
+
+
+class _TableEntry:
+    """PS sparse-table admission/eviction config base (reference
+    distributed/entry_attr.py)."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_TableEntry):
+    def __init__(self, count_filter):
+        if not isinstance(count_filter, int) or count_filter < 0:
+            raise ValueError("count_filter must be a non-negative integer")
+        self._count_filter = count_filter
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self._count_filter}"
+
+
+class ProbabilityEntry(_TableEntry):
+    def __init__(self, probability):
+        if not 0 <= probability <= 1:
+            raise ValueError("probability must be in [0, 1]")
+        self._probability = probability
+
+    def _to_attr(self):
+        return f"probability_entry:{self._probability}"
+
+
+class ShowClickEntry(_TableEntry):
+    def __init__(self, show_name, click_name):
+        if not isinstance(show_name, str) or not isinstance(click_name, str):
+            raise ValueError("show/click names must be strings")
+        self._show, self._click = show_name, click_name
+
+    def _to_attr(self):
+        return f"show_click_entry:{self._show}:{self._click}"
+
+
+class InMemoryDataset:
+    """Fleet in-memory dataset (reference distributed/fleet/dataset/
+    dataset.py InMemoryDataset): files of whitespace-separated numeric
+    slots, loaded to memory, shuffled, batched.  `init(use_var=...,
+    batch_size=..., parse_fn=...)` — parse_fn overrides the default
+    line -> list-of-float parser."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._data: List[Any] = []
+        self._batch = 1
+        self._parse = None
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             parse_fn=None, **kwargs):
+        self._batch = int(batch_size)
+        self._parse = parse_fn
+
+    update_settings = init
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def load_into_memory(self):
+        self._data = []
+        for path in self._files:
+            with open(path, errors="ignore") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    if self._parse is not None:
+                        self._data.append(self._parse(line))
+                    else:
+                        self._data.append(
+                            np.asarray([float(v) for v in line.split()],
+                                       np.float32))
+
+    def local_shuffle(self):
+        from .. import framework
+        # fresh permutation each call (epoch), seeded off the global stream
+        key = framework.next_rng_key()
+        rng = np.random.default_rng(np.asarray(key, np.uint32))
+        rng.shuffle(self._data)
+
+    global_shuffle = local_shuffle
+
+    def get_memory_data_size(self):
+        return len(self._data)
+
+    def release_memory(self):
+        self._data = []
+
+    def __iter__(self):
+        for i in range(0, len(self._data), self._batch):
+            yield self._data[i:i + self._batch]
+
+
+class QueueDataset(InMemoryDataset):
+    """Streaming variant (reference QueueDataset): iterates files directly
+    without the load_into_memory staging."""
+
+    def load_into_memory(self):
+        raise RuntimeError(
+            "QueueDataset streams from file; iterate it directly "
+            "(load_into_memory is the InMemoryDataset API)")
+
+    def __iter__(self):
+        buf = []
+        for path in self._files:
+            with open(path, errors="ignore") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    item = self._parse(line) if self._parse is not None \
+                        else np.asarray([float(v) for v in line.split()],
+                                        np.float32)
+                    buf.append(item)
+                    if len(buf) == self._batch:
+                        yield buf
+                        buf = []
+        if buf:
+            yield buf
+
+
+# ---------------------------------------------------------------------------
+# object collectives + p2p handles
+# ---------------------------------------------------------------------------
+
+
+def _world():
+    try:
+        return jax.process_count()
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Gather picklable objects from every process (reference
+    communication/all_gather.py all_gather_object)."""
+    if _world() == 1:
+        object_list.append(pickle.loads(pickle.dumps(obj)))
+        return object_list
+    raise NotImplementedError(
+        "multi-host object collectives ride the launch KV store; use "
+        "distributed.launch + rpc for cross-process python objects")
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    if _world() == 1:
+        return object_list
+    raise NotImplementedError(
+        "multi-host object collectives ride the launch KV store; use "
+        "distributed.launch + rpc for cross-process python objects")
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    if _world() == 1:
+        out_object_list.append(
+            in_object_list[0] if in_object_list else None)
+        return out_object_list
+    raise NotImplementedError(
+        "multi-host object collectives ride the launch KV store; use "
+        "distributed.launch + rpc for cross-process python objects")
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference communication/all_to_all.py):
+    rank-blocks of the leading dim are exchanged — with the in-process
+    shard model this is the alltoall of collective.py over row blocks."""
+    from .collective import _resolve, alltoall
+    g = _resolve(group)                # None -> the world group, like every
+    n = g.nranks                       # other collective in this build
+    x = in_tensor
+    if n <= 1:
+        return x
+    if x.shape[0] % n:
+        raise ValueError(
+            f"alltoall_single: leading dim {x.shape[0]} must be divisible "
+            f"by group size {n}")
+    rows = x.shape[0] // n
+    parts = [x[i * rows:(i + 1) * rows] for i in range(n)]
+    outs = alltoall(parts, group=g)
+    from ..ops import concat
+    return concat(outs, axis=0)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather shards to dst (reference communication/gather.py); in the
+    shard model every rank sees the full gather, dst selects semantics."""
+    from .collective import all_gather
+    lst = [] if gather_list is None else gather_list
+    all_gather(lst, tensor, group=group)
+    return lst
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference distributed.split builds a model-parallel linear/embedding
+    by chopping the weight across ranks.  Under GSPMD that is a sharding
+    annotation, not a runtime split — use the first-class layers instead."""
+    raise NotImplementedError(
+        "distributed.split: use distributed.mp_layers "
+        "(ColumnParallelLinear / RowParallelLinear / "
+        "VocabParallelEmbedding) — under GSPMD model parallelism is a "
+        "weight sharding annotation, not a runtime weight split")
+
+
+class _P2PHandle:
+    def __init__(self, value=None):
+        self._value = value
+
+    def wait(self):
+        return self._value
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    from .collective import send
+    send(tensor, dst=dst, group=group)     # raises with the TPU guidance
+    return _P2PHandle(tensor)              # pragma: no cover
+
+
+def irecv(tensor, src=0, group=None):
+    from .collective import recv
+    recv(tensor, src=src, group=group)     # raises with the TPU guidance
+    return _P2PHandle(tensor)              # pragma: no cover
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Stream sync (reference communication/wait.py) — forces completion
+    of pending async work on the tensor."""
+    if isinstance(tensor, Tensor):
+        jax.block_until_ready(tensor._data)
+    return tensor
+
+
+def get_backend(group=None):
+    """The communication backend name: XLA collectives over the active
+    platform (reference returns 'NCCL'/'GLOO')."""
+    return f"xla:{jax.default_backend()}"
+
+
+def get_group(gid=0):
+    from . import collective
+    if collective._GROUPS:
+        for g in collective._GROUPS:
+            if g.id == gid:
+                return g
+        return collective._GROUPS[0]
+    return collective.new_group()
+
+
+def is_available():
+    """Reference distributed.is_available: collectives usable?"""
+    return True
+
+
+def destroy_process_group(group=None):
+    from . import collective
+    if group is None:
+        collective._GROUPS.clear()
+    elif group in collective._GROUPS:
+        collective._GROUPS.remove(group)
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-barrier env (reference gloo shims) — the launch KV store plays
+    gloo's role here; single-process is a no-op."""
+    return None
+
+
+def gloo_barrier():
+    jax.effects_barrier()
+
+
+def gloo_release():
+    return None
+
+
+class _IoNamespace:
+    """paddle.distributed.io (save/load persistables shims)."""
+
+    @staticmethod
+    def save_persistables(executor, dirname, main_program=None,
+                          filename=None):
+        from ..static import io as _sio
+        return _sio.save_persistables(executor, dirname, main_program,
+                                      filename)
+
+    @staticmethod
+    def load_persistables(executor, dirname, main_program=None,
+                          filename=None):
+        from ..static import io as _sio
+        return _sio.load_persistables(executor, dirname, main_program,
+                                      filename)
+
+
+io = _IoNamespace()

@@ -17,6 +17,8 @@ from .rnn import (  # noqa: F401
 )
 # bind the functional forms over the submodule attribute of the same name
 from .rnn import rnn, birnn, split_states, concat_states  # noqa: F401
+from . import extras as _extras  # noqa: F401
+from .extras import *  # noqa: F401,F403
 from ..tensor import Parameter  # noqa: F401
 
 from . import common as _common
@@ -30,5 +32,5 @@ __all__ = (
      "RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "BiRNN",
      "SimpleRNN", "LSTM", "GRU",
      "rnn", "birnn", "split_states", "concat_states"]
-    + list(_common.__all__)
+    + list(_common.__all__) + list(_extras.__all__)
 )

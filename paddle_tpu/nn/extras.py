@@ -1,0 +1,350 @@
+"""Long-tail nn layer surface (reference python/paddle/nn/layer/
+{pooling,norm,activation,loss,rnn}.py remainders + seq2seq decoding)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply_op, to_tensor
+from . import functional as F
+from .layer import Layer
+
+__all__ = [
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool3D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D",
+    "InstanceNorm3D", "LocalResponseNorm", "Softmax2D", "RReLU", "Silu",
+    "GaussianNLLLoss", "HSigmoidLoss", "MultiLabelSoftMarginLoss",
+    "MultiMarginLoss", "SoftMarginLoss", "TripletMarginWithDistanceLoss",
+    "RNNTLoss", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._o, self._fmt = output_size, data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self._o, self._fmt)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._o, self._mask = output_size, return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool1d(x, self._o, self._mask)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._o, self._mask = output_size, return_mask
+
+    def forward(self, x):
+        return F.adaptive_max_pool3d(x, self._o, self._mask)
+
+
+class _MaxUnPoolND(Layer):
+    _nd = 2
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+        self._fmt, self._o = data_format, output_size
+
+    def forward(self, x, indices, output_size=None):
+        fn = {1: F.max_unpool1d, 2: F.max_unpool2d, 3: F.max_unpool3d}[
+            self._nd]
+        return fn(x, indices, self._k, self._s, self._p,
+                  output_size=output_size or self._o)
+
+
+class MaxUnPool1D(_MaxUnPoolND):
+    _nd = 1
+
+
+class MaxUnPool2D(_MaxUnPoolND):
+    _nd = 2
+
+
+class MaxUnPool3D(_MaxUnPoolND):
+    _nd = 3
+
+
+class InstanceNorm3D(Layer):
+    """Reference nn/layer/norm.py InstanceNorm3D (per-sample, per-channel
+    normalization over D/H/W)."""
+
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__()
+        self._eps = epsilon
+        self.scale = self.create_parameter(
+            (num_features,), weight_attr,
+            default_initializer=__import__(
+                "paddle_tpu.nn.initializer", fromlist=["Constant"]
+            ).Constant(1.0)) if weight_attr is not False else None
+        self.bias = self.create_parameter(
+            (num_features,), bias_attr, is_bias=True) \
+            if bias_attr is not False else None
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._eps)
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        self._args = (size, alpha, beta, k, data_format)
+
+    def forward(self, x):
+        size, alpha, beta, k, fmt = self._args
+        return F.local_response_norm(x, size, alpha=alpha, beta=beta, k=k,
+                                     data_format=fmt)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input (reference
+    nn/layer/activation.py Softmax2D)."""
+
+    def forward(self, x):
+        if len(x.shape) != 4:
+            raise ValueError("Softmax2D expects a 4-D NCHW tensor")
+        return F.softmax(x, axis=1)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference nn/layer/activation.py RReLU):
+    slope ~ U[lower, upper] in training, fixed mean slope in eval."""
+
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self._lower, self._upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, lower=self._lower, upper=self._upper,
+                       training=self.training)
+
+
+class Silu(Layer):
+    """Alias spelling of SiLU kept by the reference export list."""
+
+    def forward(self, x):
+        return F.silu(x)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._full, self._eps, self._red = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self._full,
+                                   self._eps, self._red)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if num_classes < 2:
+            raise ValueError("num_classes must be >= 2")
+        self._num_classes = num_classes
+        from . import initializer as I
+        std = 1.0 / np.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), weight_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias = self.create_parameter(
+            (num_classes - 1, 1), bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self._num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self._w, self._red = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self._w,
+                                              self._red)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._p, self._m, self._w, self._red = p, margin, weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_margin_loss(input, label, self._p, self._m, self._w,
+                                   self._red)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self._red = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self._red)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self._df, self._m = distance_function, margin
+        self._swap, self._red = swap, reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self._df, self._m, self._swap,
+            self._red)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self._blank, self._fe, self._red = blank, fastemit_lambda, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self._blank, self._fe, self._red)
+
+
+# ---------------------------------------------------------------------------
+# seq2seq decoding (reference nn/decode.py BeamSearchDecoder +
+# dynamic_decode)
+# ---------------------------------------------------------------------------
+
+
+class BeamSearchDecoder:
+    """Beam search over an RNNCellBase (reference nn/decode.py:123).
+
+    cell: a cell whose forward(inputs, states) -> (logits-ish output,
+    new_states); output_fn maps cell output to vocab logits;
+    embedding_fn maps token ids to cell inputs.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _embed(self, ids):
+        if self.embedding_fn is not None:
+            return self.embedding_fn(ids)
+        return ids
+
+    def _logits(self, cell_out):
+        out = self.output_fn(cell_out) if self.output_fn is not None \
+            else cell_out
+        return out._data if isinstance(out, Tensor) else jnp.asarray(out)
+
+    def initialize(self, initial_cell_states):
+        """Returns (initial_inputs, initial_states, init log-probs)."""
+        flat, tree = jax.tree_util.tree_flatten(
+            initial_cell_states,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        B = int(flat[0].shape[0])
+        K = self.beam_size
+        # tile every state leaf to (B*K, ...)
+        tiled = [to_tensor(jnp.repeat(
+            (s._data if isinstance(s, Tensor) else jnp.asarray(s)), K,
+            axis=0)) for s in flat]
+        states = jax.tree_util.tree_unflatten(tree, tiled)
+        ids = np.full((B, K), self.start_token, np.int64)
+        # beam 0 active, others -inf so step 1 expands a single beam
+        logp = np.full((B, K), -1e9, np.float32)
+        logp[:, 0] = 0.0
+        return ids, states, logp
+
+    def step(self, ids, states, logp):
+        """One expansion: returns (new_ids, new_states, new_logp,
+        parent_idx, token)."""
+        B, K = ids.shape
+        inputs = self._embed(to_tensor(ids.reshape(-1)))
+        out, new_states = self.cell(inputs, states)
+        logits = self._logits(out).reshape(B, K, -1)
+        V = logits.shape[-1]
+        logprobs = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        finished = ids == self.end_token
+        # finished beams only extend with end_token at no cost
+        mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[:, :, None], mask[None, None, :],
+                            logprobs)
+        total = jnp.asarray(logp)[:, :, None] + step_lp       # (B, K, V)
+        flat = total.reshape(B, K * V)
+        top_lp, top_ix = jax.lax.top_k(flat, K)
+        parent = np.asarray(top_ix // V)
+        token = np.asarray(top_ix % V)
+        # reorder states by parent beam
+        def reorder(s):
+            raw = s._data if isinstance(s, Tensor) else jnp.asarray(s)
+            r = raw.reshape((B, K) + raw.shape[1:])
+            g = jnp.take_along_axis(
+                r, jnp.asarray(parent).reshape(
+                    (B, K) + (1,) * (r.ndim - 2)), axis=1)
+            return to_tensor(g.reshape((-1,) + raw.shape[1:]))
+        new_states = jax.tree_util.tree_map(
+            reorder, new_states, is_leaf=lambda v: isinstance(v, Tensor))
+        return token, new_states, np.asarray(top_lp), parent, token
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Run decoder.initialize + step until all beams emit end_token or
+    max_step_num (reference nn/decode.py dynamic_decode).  Returns
+    (predicted_ids (B, T, beam) int64, final log-probs) [+ lengths]."""
+    if max_step_num is None:
+        max_step_num = 64
+    ids, states, logp = decoder.initialize(inits)
+    B, K = ids.shape
+    steps_tok, steps_par = [], []
+    for _ in range(int(max_step_num)):
+        tok, states, logp, parent, _ = decoder.step(ids, states, logp)
+        steps_tok.append(tok)
+        steps_par.append(parent)
+        ids = tok
+        if (tok == decoder.end_token).all():
+            break
+    T = len(steps_tok)
+    # backtrace through parents
+    seqs = np.zeros((T, B, K), np.int64)
+    beam_idx = np.tile(np.arange(K), (B, 1))
+    for t in range(T - 1, -1, -1):
+        seqs[t] = np.take_along_axis(steps_tok[t], beam_idx, axis=1)
+        beam_idx = np.take_along_axis(steps_par[t], beam_idx, axis=1)
+    out = seqs if output_time_major else seqs.transpose(1, 0, 2)
+    lengths = np.full((B, K), T, np.int64)
+    for b in range(B):
+        for k in range(K):
+            seq = seqs[:, b, k]
+            endpos = np.nonzero(seq == decoder.end_token)[0]
+            if endpos.size:
+                lengths[b, k] = endpos[0] + 1
+    res = (to_tensor(out), to_tensor(np.asarray(logp)))
+    if return_length:
+        res = res + (to_tensor(lengths),)
+    return res

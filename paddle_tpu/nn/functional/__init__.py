@@ -1218,6 +1218,8 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
 
 from .rnn import simple_rnn_cell, lstm_cell, gru_cell  # noqa: F401,E402
 from .vision import affine_grid, grid_sample  # noqa: F401,E402
+from . import extras as _extras  # noqa: E402
+from .extras import *  # noqa: F401,E402,F403
 
 __all__ += ["simple_rnn_cell", "lstm_cell", "gru_cell",
-            "affine_grid", "grid_sample"]
+            "affine_grid", "grid_sample"] + list(_extras.__all__)

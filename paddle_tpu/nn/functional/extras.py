@@ -1,0 +1,566 @@
+"""Long-tail nn.functional surface (reference python/paddle/nn/functional/
+{pooling,loss,common,vision,activation}.py remainders).
+
+Everything here is a jnp composition through apply_op — same dispatch,
+tape, AMP and registry treatment as the core functionals.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import framework
+from ...tensor import Tensor, apply_op, to_tensor
+
+__all__ = [
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool3d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "diag_embed", "zeropad2d", "gather_tree", "sparse_attention",
+    "class_center_sample", "margin_cross_entropy", "hsigmoid_loss",
+    "gaussian_nll_loss", "soft_margin_loss", "multi_label_soft_margin_loss",
+    "multi_margin_loss", "dice_loss", "npair_loss",
+    "triplet_margin_with_distance_loss", "rnnt_loss",
+    "elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+    "thresholded_relu_",
+]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else to_tensor(x)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    if reduction == "none":
+        return v
+    raise ValueError(f"reduction should be mean|sum|none, got {reduction}")
+
+
+# ---------------------------------------------------------------------------
+# pooling
+# ---------------------------------------------------------------------------
+
+
+def _adaptive_buckets(a, out_sizes, reduce_fn, spatial_start):
+    """General adaptive pooling: bucket boundaries floor/ceil like the
+    reference kernels."""
+    for d, o in enumerate(out_sizes):
+        ax = spatial_start + d
+        size = a.shape[ax]
+        pieces = [
+            reduce_fn(jax.lax.slice_in_dim(
+                a, int(np.floor(i * size / o)),
+                int(np.ceil((i + 1) * size / o)), axis=ax), axis=ax,
+                keepdims=True)
+            for i in range(o)]
+        a = jnp.concatenate(pieces, axis=ax)
+    return a
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """Reference nn/functional/pooling.py adaptive_avg_pool3d."""
+    x = _t(x)
+    o = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(a):
+        if data_format == "NDHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        a = _adaptive_buckets(a, o, jnp.mean, 2)
+        if data_format == "NDHWC":
+            a = jnp.moveaxis(a, 1, -1)
+        return a
+    return apply_op("adaptive_avg_pool3d", f, x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    x = _t(x)
+    o = output_size if isinstance(output_size, int) else output_size[0]
+
+    def f(a):
+        return _adaptive_buckets(a, (o,), jnp.max, 2)
+    out = apply_op("adaptive_max_pool1d", f, x)
+    if return_mask:
+        def fi(a):
+            size = a.shape[2]
+            idx = []
+            for i in range(o):
+                lo = int(np.floor(i * size / o))
+                hi = int(np.ceil((i + 1) * size / o))
+                idx.append(lo + jnp.argmax(a[:, :, lo:hi], axis=2,
+                                           keepdims=True))
+            return jnp.concatenate(idx, axis=2).astype(jnp.int32)
+        return out, apply_op("adaptive_max_pool1d_mask", fi, x)
+    return out
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    x = _t(x)
+    o = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(a):
+        return _adaptive_buckets(a, o, jnp.max, 2)
+    out = apply_op("adaptive_max_pool3d", f, x)
+    if return_mask:
+        def fi(a):
+            n, c = a.shape[:2]
+            D, H, W = a.shape[2:]
+            flat = a.reshape(n, c, -1)
+            bounds = [[(int(np.floor(i * s / oo)),
+                        int(np.ceil((i + 1) * s / oo)))
+                       for i in range(oo)]
+                      for s, oo in zip((D, H, W), o)]
+            cells = []
+            for bd in bounds[0]:
+                for bh in bounds[1]:
+                    for bw in bounds[2]:
+                        win = a[:, :, bd[0]:bd[1], bh[0]:bh[1], bw[0]:bw[1]]
+                        wf = win.reshape(n, c, -1)
+                        am = jnp.argmax(wf, axis=2)
+                        dd, rem = jnp.divmod(
+                            am, (bh[1] - bh[0]) * (bw[1] - bw[0]))
+                        hh, ww = jnp.divmod(rem, bw[1] - bw[0])
+                        cells.append(((bd[0] + dd) * H + bh[0] + hh) * W
+                                     + bw[0] + ww)
+            del flat
+            return jnp.stack(cells, 2).reshape(
+                (n, c) + tuple(o)).astype(jnp.int32)
+        return out, apply_op("adaptive_max_pool3d_mask", fi, x)
+    return out
+
+
+def _unpool(x, indices, nd, output_size, data_format, name):
+    """Scatter pooled values back to their argmax positions.  `indices`
+    are flat positions within each (N, C) spatial plane (the reference's
+    max_poolXd(return_mask=True) convention)."""
+    x, indices = _t(x), _t(indices)
+    if output_size is None:
+        raise ValueError(
+            f"max_unpool{nd}d requires output_size in this build (pass the "
+            "pre-pool spatial shape; inferring from kernel/stride is "
+            "ambiguous at the edges)")
+    out_sp = tuple(int(s) for s in output_size[-nd:])
+
+    def f(a, idx):
+        n, c = a.shape[:2]
+        flat = a.reshape(n, c, -1)
+        fidx = idx.reshape(n, c, -1)
+        size = 1
+        for s in out_sp:
+            size *= s
+        out = jnp.zeros((n, c, size), a.dtype)
+        out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].add(v)))(out, fidx, flat)
+        return out.reshape((n, c) + out_sp)
+
+    return apply_op(f"max_unpool{nd}d", f, x, indices, nondiff=(1,))
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, 1, output_size, data_format, name)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, 2, output_size, data_format, name)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, 3, output_size, data_format, name)
+
+
+# ---------------------------------------------------------------------------
+# shaping / decoding helpers
+# ---------------------------------------------------------------------------
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):  # noqa: A002
+    """Alias of the tensor-level diag_embed (ops/manipulation.py) — the
+    reference also exports it under nn.functional."""
+    from ...ops.manipulation import diag_embed as _de
+    return _de(input, offset=offset, dim1=dim1, dim2=dim2)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    """Zero-pad H/W (reference nn/functional/common.py zeropad2d);
+    padding = [left, right, top, bottom]."""
+    x = _t(x)
+    l, r, t, b = [int(p) for p in padding]
+
+    def f(a):
+        if data_format == "NCHW":
+            cfg = [(0, 0), (0, 0), (t, b), (l, r)]
+        else:
+            cfg = [(0, 0), (t, b), (l, r), (0, 0)]
+        return jnp.pad(a, cfg)
+    return apply_op("zeropad2d", f, x)
+
+
+def gather_tree(ids, parents):
+    """Backtrace beam-search chains (reference nn/functional/common.py
+    gather_tree): ids/parents (T, B, beam) -> full sequences."""
+    ids, parents = _t(ids), _t(parents)
+
+    def f(i, p):
+        T = i.shape[0]
+
+        def step(beam_idx, t):
+            sel = jnp.take_along_axis(p[t], beam_idx, axis=-1)
+            tok = jnp.take_along_axis(i[t], beam_idx, axis=-1)
+            return sel, tok
+
+        init = jnp.broadcast_to(jnp.arange(i.shape[2]), i.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]
+
+    return apply_op("gather_tree", f, ids, parents, nondiff=(0, 1))
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention over a CSR connectivity pattern (reference
+    incubate sparse_attention, exported under nn.functional): each query
+    position attends only to its CSR row's columns."""
+    q, k, v = _t(query), _t(key), _t(value)
+    off = np.asarray(_t(sparse_csr_offset)._data)
+    cols = np.asarray(_t(sparse_csr_columns)._data)
+
+    def f(qr, kr, vr):
+        B, H, S, D = qr.shape
+        scale = 1.0 / math.sqrt(D)
+        rows = np.repeat(np.arange(S), np.diff(off[0, 0]))
+        cc = cols[0, 0]
+        scores = jnp.einsum("bhd,bhd->bh",
+                            qr[:, :, rows].reshape(B, H, -1, D)
+                            .transpose(2, 0, 1, 3).reshape(-1, B * H, D)
+                            .swapaxes(0, 1).reshape(B * H, -1, D),
+                            kr[:, :, cc].reshape(B, H, -1, D)
+                            .transpose(2, 0, 1, 3).reshape(-1, B * H, D)
+                            .swapaxes(0, 1).reshape(B * H, -1, D)
+                            ).reshape(B, H, -1) * scale
+        # segment softmax per row
+        seg = jnp.asarray(rows)
+        smax = jax.ops.segment_max(scores.reshape(B * H, -1).T, seg,
+                                   num_segments=S)
+        e = jnp.exp(scores.reshape(B * H, -1).T - smax[seg])
+        den = jax.ops.segment_sum(e, seg, num_segments=S)
+        w = (e / den[seg]).T.reshape(B, H, -1)
+        out = jnp.zeros_like(qr)
+        out = out.at[:, :, rows].add(w[..., None] * vr[:, :, cc])
+        return out
+
+    return apply_op("sparse_attention", f, q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# classification losses
+# ---------------------------------------------------------------------------
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: all positive classes + random negatives up to
+    num_samples (reference nn/functional/common.py class_center_sample).
+    Returns (remapped_label, sampled_class_index)."""
+    lab = np.asarray(_t(label)._data).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        # fresh negatives each call, seeded off the global stream
+        rng = np.random.default_rng(
+            np.asarray(framework.next_rng_key(), np.uint32))
+        neg = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rng.choice(neg, size=num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    new_lab = np.array([remap[int(v)] for v in lab], lab.dtype)
+    return to_tensor(new_lab), to_tensor(sampled.astype(lab.dtype))
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-family margin softmax (reference nn/functional/loss.py
+    margin_cross_entropy): target logit cos(m1*t + m2) - m3, scaled."""
+    logits, label = _t(logits), _t(label)
+
+    def f(lg, lb):
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        oh = jax.nn.one_hot(lb, lg.shape[-1], dtype=lg.dtype)
+        adj = jnp.cos(margin1 * theta + margin2) - margin3
+        z = scale * jnp.where(oh > 0, adj, lg)
+        logp = jax.nn.log_softmax(z, axis=-1)
+        loss = -(oh * logp).sum(-1)
+        return loss, jax.nn.softmax(z, axis=-1)
+
+    loss, sm = apply_op("margin_cross_entropy", f, logits, label,
+                        nondiff=(1,))
+    from ...ops import mean as _mean, sum as _sum
+    red = {"mean": _mean, "sum": _sum, "none": lambda v: v}[reduction]
+    out = red(loss)
+    return (out, sm) if return_softmax else out
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over a complete binary tree (reference
+    nn/functional/loss.py hsigmoid_loss; custom trees via
+    path_table/path_code)."""
+    x, lab = _t(input), _t(label)
+    w = _t(weight)
+    b = _t(bias) if bias is not None else None
+    if path_table is None:
+        # complete binary tree with num_classes leaves: internal node ids
+        # 0..num_classes-2; leaf c sits at tree index num_classes-1+c
+        depth = max(1, int(np.ceil(np.log2(max(num_classes, 2)))))
+        tables, codes = [], []
+        for c in range(num_classes):
+            node = num_classes - 1 + c
+            pt, pc = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                pt.append(parent)
+                pc.append(node == 2 * parent + 2)   # right child -> 1
+                node = parent
+            pt = pt[::-1][:depth] + [-1] * (depth - len(pt))
+            pc = pc[::-1][:depth] + [False] * (depth - len(pc))
+            tables.append(pt)
+            codes.append(pc)
+        path_table = to_tensor(np.asarray(tables, np.int64))
+        path_code = to_tensor(np.asarray(codes, np.bool_))
+    pt, pc = _t(path_table), _t(path_code)
+
+    def f(xr, lr, wr, br, ptr, pcr):
+        nodes = ptr[lr]                              # (B, depth)
+        code = pcr[lr].astype(xr.dtype)              # (B, depth)
+        valid = (nodes >= 0).astype(xr.dtype)
+        safe = jnp.maximum(nodes, 0)
+        wn = wr[safe]                                # (B, depth, D)
+        z = jnp.einsum("bd,bkd->bk", xr, wn)
+        if br is not None:
+            z = z + br.reshape(-1)[safe]
+        # sigmoid CE against the path code at each internal node
+        ce = jnp.maximum(z, 0) - z * code + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        return (ce * valid).sum(-1, keepdims=True)
+
+    args = [x, lab, w, b, pt, pc]
+    return apply_op("hsigmoid_loss", f, *args, nondiff=(1, 4, 5))
+
+
+# ---------------------------------------------------------------------------
+# regression / metric losses
+# ---------------------------------------------------------------------------
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    """Reference nn/functional/loss.py gaussian_nll_loss."""
+    x, y, var = _t(input), _t(label), _t(variance)
+
+    def f(xr, yr, vr):
+        v = jnp.maximum(vr, epsilon)
+        loss = 0.5 * (jnp.log(v) + (xr - yr) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * math.log(2 * math.pi)
+        return _reduce(loss, reduction)
+    return apply_op("gaussian_nll_loss", f, x, y, var)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    """log(1 + exp(-label * input)), label in {-1, 1}."""
+    x, y = _t(input), _t(label)
+
+    def f(xr, yr):
+        return _reduce(jnp.log1p(jnp.exp(-yr.astype(xr.dtype) * xr)),
+                       reduction)
+    return apply_op("soft_margin_loss", f, x, y)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    x, y = _t(input), _t(label)
+    w = _t(weight) if weight is not None else None
+
+    def f(xr, yr, wr):
+        yt = yr.astype(xr.dtype)
+        per = -(yt * jax.nn.log_sigmoid(xr)
+                + (1 - yt) * jax.nn.log_sigmoid(-xr))
+        if wr is not None:
+            per = per * wr
+        return _reduce(per.mean(-1), reduction)
+    return apply_op("multi_label_soft_margin_loss", f, x, y, w)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    x, y = _t(input), _t(label)
+    w = _t(weight) if weight is not None else None
+
+    def f(xr, yr, wr):
+        C = xr.shape[-1]
+        tgt = jnp.take_along_axis(xr, yr[:, None], axis=-1)
+        m = jnp.maximum(margin - tgt + xr, 0) ** p
+        if wr is not None:
+            m = m * wr.reshape(-1)[yr][:, None]
+        oh = jax.nn.one_hot(yr, C, dtype=xr.dtype)
+        return _reduce(((1 - oh) * m).sum(-1) / C, reduction)
+    return apply_op("multi_margin_loss", f, x, y, w, nondiff=(1,))
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Reference nn/functional/loss.py dice_loss: input (..., C) softmaxed
+    probs, label (..., 1) int."""
+    x, y = _t(input), _t(label)
+
+    def f(xr, yr):
+        oh = jax.nn.one_hot(yr.squeeze(-1), xr.shape[-1], dtype=xr.dtype)
+        red = tuple(range(1, xr.ndim))
+        inter = (xr * oh).sum(red)
+        union = xr.sum(red) + oh.sum(red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op("dice_loss", f, x, y, nondiff=(1,))
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """Reference nn/functional/loss.py npair_loss."""
+    a, p, lab = _t(anchor), _t(positive), _t(labels)
+
+    def f(ar, pr, lr):
+        B = ar.shape[0]
+        sim = ar @ pr.T
+        same = (lr[:, None] == lr[None, :]).astype(ar.dtype)
+        tgt = same / same.sum(-1, keepdims=True)
+        xent = (-tgt * jax.nn.log_softmax(sim, axis=-1)).sum(-1).mean()
+        reg = l2_reg * ((ar * ar).sum(-1) + (pr * pr).sum(-1)).mean() * 0.25
+        return xent + reg
+    return apply_op("npair_loss", f, a, p, lab, nondiff=(2,))
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    x, pos, neg = _t(input), _t(positive), _t(negative)
+
+    def dist(a, b):
+        if distance_function is not None:
+            d = distance_function(a, b)
+            return d._data if isinstance(d, Tensor) else d
+        return jnp.sqrt(((a - b) ** 2).sum(-1) + 1e-12)
+
+    def f(ar, pr, nr):
+        dp = dist(ar, pr)
+        dn = dist(ar, nr)
+        if swap:
+            dn = jnp.minimum(dn, dist(pr, nr))
+        return _reduce(jnp.maximum(dp - dn + margin, 0), reduction)
+
+    if distance_function is not None:
+        # user distance may be an eager Tensor fn: compute eagerly
+        dp = distance_function(x, pos)
+        dn = distance_function(x, neg)
+        if swap:
+            from ...ops import minimum
+            dn = minimum(dn, distance_function(pos, neg))
+        from ...ops import clip, mean as _mean, sum as _sum
+        val = clip(dp - dn + margin, min=0.0)
+        red = {"mean": _mean, "sum": _sum, "none": lambda v: v}[reduction]
+        return red(val)
+    return apply_op("triplet_margin_with_distance_loss", f, x, pos, neg)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference nn/functional/loss.py rnnt_loss;
+    the reference binds warprnnt).  input: (B, T, U+1, V) log-probs or
+    logits (log-softmaxed here); label: (B, U) int.  Forward-variable DP
+    over the (T, U) lattice as a lax.scan over T — differentiable, static
+    shapes, fastemit regularization applied like the reference."""
+    x, y = _t(input), _t(label)
+    tl, ul = _t(input_lengths), _t(label_lengths)
+
+    def f(xr, yr, tlr, ulr):
+        B, T, U1, V = xr.shape
+        U = U1 - 1
+        logp = jax.nn.log_softmax(xr.astype(jnp.float32), axis=-1)
+        # per (t, u): blank prob and emit prob of the next label
+        lp_blank = logp[..., blank]                        # (B, T, U+1)
+        idx = jnp.minimum(yr, V - 1)                       # (B, U)
+        lp_emit = jnp.take_along_axis(
+            logp[:, :, :U, :], idx[:, None, :, None], axis=-1)[..., 0]
+        # forward recursion: alpha[t, u] =
+        #   logaddexp(alpha[t-1, u] + blank(t-1, u),
+        #             alpha[t, u-1] + emit(t, u-1))
+        # t = 0 row: only emissions from (0, 0)
+        def init_emit(prev, u):
+            cur = prev + lp_emit[:, 0, u - 1]
+            return cur, cur
+        f0 = jnp.zeros((B,), jnp.float32)
+        _, r0 = jax.lax.scan(init_emit, f0, jnp.arange(1, U1))
+        alpha = jnp.concatenate([f0[:, None], r0.T], axis=1)
+
+        def scan_t(alpha, t):
+            a_t_base = alpha + lp_blank[:, t - 1]
+            def inner(prev, u):
+                cur = jnp.logaddexp(a_t_base[:, u],
+                                    prev + lp_emit[:, t, u - 1])
+                return cur, cur
+            first = a_t_base[:, 0]
+            _, rest = jax.lax.scan(inner, first, jnp.arange(1, U1))
+            new = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return new, new
+
+        _, all_alpha = jax.lax.scan(scan_t, alpha, jnp.arange(1, T))
+        all_alpha = jnp.concatenate([alpha[None], all_alpha], axis=0)
+        # total log prob: alpha[T_b - 1, U_b] + blank at (T_b - 1, U_b)
+        tb = jnp.clip(tlr.astype(jnp.int32) - 1, 0, T - 1)
+        ub = jnp.clip(ulr.astype(jnp.int32), 0, U)
+        batch = jnp.arange(B)
+        ll = all_alpha[tb, batch, ub] + lp_blank[batch, tb, ub]
+        loss = -ll
+        if fastemit_lambda:
+            loss = loss * (1.0 + fastemit_lambda)
+        return _reduce(loss, reduction)
+
+    return apply_op("rnnt_loss", f, x, y, tl, ul, nondiff=(1, 2, 3))
+
+
+# ---------------------------------------------------------------------------
+# inplace activations
+# ---------------------------------------------------------------------------
+
+
+def _act_inplace(base_name):
+    def op_(x, *args, **kwargs):
+        from . import __dict__ as _fns
+        from ...ops import _inplace
+        base = _fns[base_name]
+        if (framework.is_grad_enabled() and isinstance(x, Tensor)
+                and not x.stop_gradient and x._node is None):
+            raise RuntimeError(
+                f"{base_name}_: in-place operation on a leaf Tensor that "
+                "requires grad is not allowed")
+        return _inplace(x, base(x, *args, **kwargs))
+    op_.__name__ = base_name + "_"
+    op_.__doc__ = f"In-place variant of nn.functional.{base_name}."
+    return op_
+
+
+elu_ = _act_inplace("elu")
+hardtanh_ = _act_inplace("hardtanh")
+leaky_relu_ = _act_inplace("leaky_relu")
+softmax_ = _act_inplace("softmax")
+tanh_ = _act_inplace("tanh")
+thresholded_relu_ = _act_inplace("thresholded_relu")

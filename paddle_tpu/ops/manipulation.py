@@ -396,10 +396,11 @@ def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
     x = _t(input)
     def f(a):
         n = a.shape[-1]
-        out = jnp.zeros(a.shape + (n + abs(offset),), dtype=a.dtype)
+        k = n + abs(offset)           # rows AND cols grow with the offset
+        out = jnp.zeros(a.shape[:-1] + (k, k), dtype=a.dtype)
         eye_idx = jnp.arange(n)
-        out = out.at[..., eye_idx, eye_idx + max(offset, 0)].set(a) if offset >= 0 else \
-            out.at[..., eye_idx - offset, eye_idx].set(a)
+        out = out.at[..., eye_idx + max(-offset, 0),
+                     eye_idx + max(offset, 0)].set(a)
         # place dims
         nd = out.ndim
         d1 = dim1 % nd

@@ -459,3 +459,19 @@ def load(program, model_path, executor=None, var_list=None):
     for i, p in enumerate(program.all_parameters()):
         if i in params:
             p.data = jnp.asarray(params[i])
+
+
+# legacy static-graph surface (EMA, append_backward, py_func, persistable
+# serialization, strategy shims) — see compat.py
+from . import compat as _compat  # noqa: E402
+from .compat import *  # noqa: E402,F401,F403
+
+__all__ += list(_compat.__all__)
+
+
+class _StaticIo:
+    save_persistables = staticmethod(_compat.save_persistables)
+    load_persistables = staticmethod(_compat.load_persistables)
+
+
+io = _StaticIo()

@@ -1,0 +1,377 @@
+"""Legacy static-graph surface (reference python/paddle/static/__init__.py
+remainders).  The record-replay Program stands in for ProgramDesc; these
+shims keep the reference's training-infra idioms (EMA, append_backward,
+py_func, persistable serialization) working on the eager/tape core.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import framework
+from ..tensor import Parameter, Tensor, apply_op, to_tensor
+
+__all__ = [
+    "Variable", "BuildStrategy", "ExecutionStrategy", "CompiledProgram",
+    "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard", "set_ipu_shard",
+    "ExponentialMovingAverage", "Print", "WeightNormParamAttr",
+    "accuracy", "auc", "append_backward", "gradients",
+    "create_global_var", "create_parameter", "ctr_metric_bundle",
+    "device_guard", "py_func", "normalize_program",
+    "save_to_file", "load_from_file",
+    "serialize_persistables", "deserialize_persistables",
+    "save_persistables", "load_persistables",
+    "load_program_state", "set_program_state",
+]
+
+Variable = Tensor  # the reference's static Variable == this build's Tensor
+
+
+class _AttrBag:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def __setattr__(self, k, v):
+        self.__dict__[k] = v
+
+
+class BuildStrategy(_AttrBag):
+    """Graph-build knobs (reference BuildStrategy).  XLA owns fusion and
+    memory planning, so the attributes are accepted and recorded only."""
+
+
+class ExecutionStrategy(_AttrBag):
+    """Executor knobs (reference ExecutionStrategy); same recording shim."""
+
+
+class CompiledProgram:
+    """Reference CompiledProgram(program, build_strategy): under XLA the
+    Executor compiles every program, so this is a thin marker wrapper."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["program"], name)
+
+
+class IpuStrategy(_AttrBag):
+    """Accepted for API parity; no IPU backend exists here."""
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise RuntimeError(
+            "IPU support is not available in this build (no IPU PJRT "
+            "plugin); use the default Executor")
+
+
+@contextlib.contextmanager
+def ipu_shard_guard(index=-1, stage=-1):
+    raise RuntimeError(
+        "IPU support is not available in this build; for pipeline sharding "
+        "use distributed.pipeline")
+    yield  # pragma: no cover
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise RuntimeError(
+        "IPU support is not available in this build; for pipeline sharding "
+        "use distributed.pipeline")
+
+
+class ExponentialMovingAverage:
+    """EMA over trainable parameters (reference static/ema.py):
+    update() after each step; apply() swaps EMA weights in (context
+    manager), restore() swaps back."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._thres_steps = thres_steps
+        self._ema = {}
+        self._backup = None
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters if parameters is not None else \
+            self._default_params()
+        self._step += 1
+        # constant decay by default; the warmup ramp only with thres_steps
+        # (reference static/ema.py semantics)
+        d = self._decay if self._thres_steps is None else \
+            min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in params:
+            k = id(p)
+            v = np.asarray(p._data, np.float32)
+            if k not in self._ema:
+                self._ema[k] = (p, v.copy())
+            else:
+                _, old = self._ema[k]
+                self._ema[k] = (p, d * old + (1 - d) * v)
+
+    @staticmethod
+    def _default_params():
+        prog = framework.get_state().capture_program
+        if prog is not None:
+            return prog.all_parameters()
+        raise ValueError("EMA.update needs parameters= in eager mode")
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {k: np.asarray(p._data) for k, (p, _)
+                        in self._ema.items()}
+        for k, (p, v) in self._ema.items():
+            p._data = jnp.asarray(v).astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for k, (p, _) in self._ema.items():
+            p._data = jnp.asarray(self._backup[k])
+        self._backup = None
+
+
+def Print(input, first_n=-1, message=None, summarize=20,  # noqa: N802
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference static/nn/common.py Print): prints and
+    passes the value through (works eagerly and under capture)."""
+    x = input if isinstance(input, Tensor) else to_tensor(input)
+    head = message or ""
+
+    def f(a):
+        jax.debug.print(head + " {v}", v=a)
+        return a
+    return apply_op("print", f, x)
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr(dim=...).  Weight-norm
+    reparameterization is a training-dynamics choice; this build records
+    the attr and initializes like ParamAttr (use nn.utils.spectral_norm /
+    explicit reparam for normalized training)."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.layer import ParamAttr
+        self.dim = dim
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable,
+                               need_clip=need_clip)
+
+    def __getattr__(self, k):
+        return getattr(self.__dict__["_attr"], k)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference static/nn/metric.py accuracy)."""
+    x = input if isinstance(input, Tensor) else to_tensor(input)
+    y = label if isinstance(label, Tensor) else to_tensor(label)
+
+    def f(xr, yr):
+        topk = jnp.argsort(-xr, axis=-1)[..., :k]
+        hit = (topk == yr.reshape(-1, 1)).any(-1)
+        return hit.mean(dtype=jnp.float32)
+    return apply_op("accuracy", f, x, y, nondiff=(0, 1))
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """ROC AUC over prob-of-positive (reference static/nn/metric.py auc).
+    Returns (auc_value, batch_auc, [stat tensors]) like the reference."""
+    x = input if isinstance(input, Tensor) else to_tensor(input)
+    y = label if isinstance(label, Tensor) else to_tensor(label)
+    probs = np.asarray(x._data)
+    pos = probs[:, 1] if probs.ndim == 2 else probs.reshape(-1)
+    lab = np.asarray(y._data).reshape(-1)
+    order = np.argsort(pos)
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(pos) + 1)
+    n_pos = (lab == 1).sum()
+    n_neg = (lab == 0).sum()
+    if n_pos == 0 or n_neg == 0:
+        val = 0.5
+    else:
+        val = (ranks[lab == 1].sum() - n_pos * (n_pos + 1) / 2) \
+            / (n_pos * n_neg)
+    out = to_tensor(np.float32(val))
+    return out, out, []
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metric set (reference static/nn/metric.py ctr_metric_bundle):
+    (auc, batch_auc, stats) + squared error / abs error sums."""
+    a, b, stats = auc(input, label)
+    x = np.asarray((input._data if isinstance(input, Tensor)
+                    else jnp.asarray(input)))
+    pos = x[:, 1] if x.ndim == 2 else x.reshape(-1)
+    lab = np.asarray((label._data if isinstance(label, Tensor)
+                      else jnp.asarray(label))).reshape(-1)
+    sqrerr = to_tensor(np.float32(((pos - lab) ** 2).sum()))
+    abserr = to_tensor(np.float32(np.abs(pos - lab).sum()))
+    prob = to_tensor(np.float32(pos.sum()))
+    q = to_tensor(np.float32(pos.sum()))
+    pos_cnt = to_tensor(np.float32((lab == 1).sum()))
+    total = to_tensor(np.float32(len(lab)))
+    return a, sqrerr, abserr, prob, q, pos_cnt, total
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """Reference static append_backward: wires grad ops into the program.
+    On the tape core this IS loss.backward(); returns [(param, grad)]."""
+    loss.backward(retain_graph=True)
+    params = parameter_list
+    if params is None:
+        prog = framework.get_state().capture_program
+        params = prog.all_parameters() if prog is not None else []
+    out = []
+    for p in params:
+        if isinstance(p, Parameter) and p.grad is not None:
+            out.append((p, p.grad))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static.gradients -> autograd.grad on the tape."""
+    from ..autograd import grad as _grad
+    tgts = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gg = target_gradients
+    return _grad(tgts, ins, grad_outputs=gg, allow_unused=True,
+                 retain_graph=True)
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..framework import convert_dtype, to_jax_dtype
+    t = Tensor(jnp.full(tuple(int(s) for s in shape), value,
+                        to_jax_dtype(convert_dtype(dtype))),
+               stop_gradient=True, name=name)
+    t.persistable = persistable
+    return t
+
+
+from ..ops.compat import create_parameter  # noqa: E402,F401
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Reference static.device_guard: op placement hint.  XLA places the
+    whole program; accepted and ignored."""
+    yield
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Wrap a host python function as an op (reference static/nn/common.py
+    py_func).  Eager-only; backward_func(*inputs, *outputs, *out_grads) ->
+    input grads supplies the custom gradient (recorded as a tape node
+    directly — the host function cannot be traced for a JAX vjp)."""
+    from ..tensor import TapeNode
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    xs = [i if isinstance(i, Tensor) else to_tensor(i) for i in xs]
+    if any(isinstance(i._data, jax.core.Tracer) for i in xs):
+        raise RuntimeError("py_func runs host python; call it eagerly "
+                           "(outside jit/to_static)")
+    res = func(*xs)
+    rs = res if isinstance(res, (list, tuple)) else [res]
+    outs = [r if isinstance(r, Tensor) else to_tensor(r) for r in rs]
+    diff_in = [i for i in xs if not i.stop_gradient]
+    if backward_func is not None and framework.is_grad_enabled() \
+            and diff_in:
+        def pullback(cts):
+            cts = cts if isinstance(cts, (tuple, list)) else (cts,)
+            grads = backward_func(
+                *xs, *outs, *[to_tensor(np.asarray(c)) for c in cts])
+            gs = grads if isinstance(grads, (tuple, list)) else (grads,)
+            return tuple(
+                g._data if isinstance(g, Tensor) else jnp.asarray(g)
+                for g in gs)
+        node = TapeNode("py_func", pullback, tuple(diff_in), tuple(outs))
+        for idx, o in enumerate(outs):
+            o.stop_gradient = False
+            o._node = node
+            o._out_idx = idx
+    return outs if isinstance(res, (list, tuple)) else outs[0]
+
+
+def normalize_program(program, feeds, fetches):
+    """Prune to the feed->fetch slice (reference normalize_program); the
+    recorded Program replays lazily so the program itself is returned."""
+    return program
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def _param_state(program):
+    return {f"param_{i}": np.asarray(p._data)
+            for i, p in enumerate(program.all_parameters())}
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None,
+                           program=None, **kwargs):
+    """Pickle the program's persistable parameters (reference
+    serialize_persistables -> bytes)."""
+    from . import default_main_program
+    prog = program or default_main_program()
+    return pickle.dumps(_param_state(prog))
+
+
+def deserialize_persistables(program, data, executor=None):
+    state = pickle.loads(data)
+    set_program_state(program, state)
+    return program
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+    from . import default_main_program
+    prog = main_program or default_main_program()
+    os.makedirs(dirname, exist_ok=True)
+    path = os.path.join(dirname, filename or "persistables.pkl")
+    with open(path, "wb") as f:
+        f.write(serialize_persistables(None, None, program=prog))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+    from . import default_main_program
+    prog = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables.pkl")
+    deserialize_persistables(prog, load_from_file(path))
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    params = program.all_parameters()
+    for i, p in enumerate(params):
+        for key in (f"param_{i}", i):
+            if key in state_dict:
+                p._data = jnp.asarray(state_dict[key]).astype(p._data.dtype)
+                break

@@ -19,3 +19,11 @@ from .shufflenet import (  # noqa: F401
 )
 from .googlenet import GoogLeNet, googlenet  # noqa: F401
 from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
+from .resnet import (  # noqa: F401,E402
+    resnext50_32x4d, resnext50_64x4d, resnext101_32x4d, resnext101_64x4d,
+    resnext152_32x4d, resnext152_64x4d,
+)
+from .mobilenetv3 import (  # noqa: F401,E402
+    MobileNetV3Large, MobileNetV3Small, mobilenet_v3_large,
+    mobilenet_v3_small,
+)

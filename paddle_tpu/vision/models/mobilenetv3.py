@@ -1,0 +1,142 @@
+"""MobileNetV3 Large/Small (reference: python/paddle/vision/models/
+mobilenetv3.py) — inverted residuals with squeeze-excite and hardswish."""
+
+from __future__ import annotations
+
+from ... import nn
+from ... import ops
+from .mobilenet import _make_divisible
+
+__all__ = ["MobileNetV3Large", "MobileNetV3Small", "mobilenet_v3_large",
+           "mobilenet_v3_small"]
+
+
+class _SE(nn.Layer):
+    def __init__(self, c, squeeze=4):
+        super().__init__()
+        mid = _make_divisible(c // squeeze)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(c, mid, 1)
+        self.fc2 = nn.Conv2D(mid, c, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _CBA(nn.Layer):
+    def __init__(self, c_in, c_out, k, stride=1, groups=1, act=None):
+        super().__init__()
+        self.conv = nn.Conv2D(c_in, c_out, k, stride=stride,
+                              padding=(k - 1) // 2, groups=groups,
+                              bias_attr=False)
+        self.bn = nn.BatchNorm2D(c_out)
+        self.act = act() if act is not None else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, c_in, exp, c_out, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if exp != c_in:
+            layers.append(_CBA(c_in, exp, 1, act=act))
+        layers.append(_CBA(exp, exp, k, stride=stride, groups=exp, act=act))
+        if se:
+            layers.append(_SE(exp))
+        layers.append(_CBA(exp, c_out, 1))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, SE, activation, stride) per reference config tables
+_LARGE = [
+    (3, 16, 16, False, "RE", 1), (3, 64, 24, False, "RE", 2),
+    (3, 72, 24, False, "RE", 1), (5, 72, 40, True, "RE", 2),
+    (5, 120, 40, True, "RE", 1), (5, 120, 40, True, "RE", 1),
+    (3, 240, 80, False, "HS", 2), (3, 200, 80, False, "HS", 1),
+    (3, 184, 80, False, "HS", 1), (3, 184, 80, False, "HS", 1),
+    (3, 480, 112, True, "HS", 1), (3, 672, 112, True, "HS", 1),
+    (5, 672, 160, True, "HS", 2), (5, 960, 160, True, "HS", 1),
+    (5, 960, 160, True, "HS", 1),
+]
+_SMALL = [
+    (3, 16, 16, True, "RE", 2), (3, 72, 24, False, "RE", 2),
+    (3, 88, 24, False, "RE", 1), (5, 96, 40, True, "HS", 2),
+    (5, 240, 40, True, "HS", 1), (5, 240, 40, True, "HS", 1),
+    (5, 120, 48, True, "HS", 1), (5, 144, 48, True, "HS", 1),
+    (5, 288, 96, True, "HS", 2), (5, 576, 96, True, "HS", 1),
+    (5, 576, 96, True, "HS", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, cfg, last_exp, last_c, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes, self.with_pool = num_classes, with_pool
+        act_of = {"RE": nn.ReLU, "HS": nn.Hardswish}
+        c = _make_divisible(16 * scale)
+        feats = [_CBA(3, c, 3, stride=2, act=nn.Hardswish)]
+        for k, exp, out, se, act, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            feats.append(_InvertedResidual(c, exp_c, out_c, k, s, se,
+                                           act_of[act]))
+            c = out_c
+        le = _make_divisible(last_exp * scale)
+        feats.append(_CBA(c, le, 1, act=nn.Hardswish))
+        self.features = nn.Sequential(*feats)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(le, last_c), nn.Hardswish(),
+                nn.Dropout(0.2, mode="downscale_in_infer"),
+                nn.Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = ops.flatten(x, start_axis=1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    """Reference mobilenetv3.py MobileNetV3Large."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, 960, 1280, scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    """Reference mobilenetv3.py MobileNetV3Small."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, 576, 1024, scale, num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load a local "
+                         "checkpoint with set_state_dict")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise ValueError("pretrained weights are not bundled; load a local "
+                         "checkpoint with set_state_dict")
+    return MobileNetV3Small(scale=scale, **kwargs)
