@@ -663,3 +663,59 @@ register("nn.functional.grid_sample", sharding="gather", tol=_LOOSE,
                               .astype(np.float32),
                               (rng.standard_normal((2, 4, 4, 2)) * 0.9)
                               .astype(np.float32)), {}))
+
+
+# --- round-5 long-tail ops (ops/compat.py, nn/functional/extras.py) --------
+
+register("addmm", sample=lambda rng: (
+    (rng.standard_normal((4, 4)).astype(np.float32),
+     rng.standard_normal((4, 6)).astype(np.float32),
+     rng.standard_normal((6, 4)).astype(np.float32)), {}),
+    tol=_LOOSE, sharding="contract")
+register("cdist", sample=lambda rng: (
+    (rng.standard_normal((4, 6)).astype(np.float32),
+     rng.standard_normal((8, 6)).astype(np.float32)), {}),
+    tol=_LOOSE, sharding="contract")
+register("mv", sample=lambda rng: (
+    (rng.standard_normal((4, 6)).astype(np.float32),
+     rng.standard_normal((6,)).astype(np.float32)), {}),
+    tol=_LOOSE, sharding="contract")
+register("sgn", sample=_u(), sharding="elementwise")
+register("i0e", sample=_u(), tol=_LOOSE, sharding="elementwise")
+register("i1e", sample=_u(), tol=_LOOSE, sharding="elementwise")
+register("trapezoid", sample=_u(), tol=_LOOSE, sharding="reduce")
+register("cumulative_trapezoid", sample=_u(), tol=_LOOSE, sharding="reduce")
+register("renorm", sharding="reduce", tol=_LOOSE,
+         sample=lambda rng: ((rng.standard_normal((4, 8))
+                              .astype(np.float32),),
+                             {"p": 2.0, "axis": 0, "max_norm": 1.0}))
+register("unflatten", sharding="shape",
+         sample=lambda rng: ((rng.standard_normal((4, 6))
+                              .astype(np.float32),),
+                             {"axis": 1, "shape": [2, 3]}))
+register("unfold", sharding="gather",
+         sample=lambda rng: ((rng.standard_normal((4, 12))
+                              .astype(np.float32),),
+                             {"axis": 1, "size": 4, "step": 2}))
+register("nn.functional.adaptive_avg_pool3d", tol=_LOOSE, sharding="reduce",
+         sample=lambda rng: ((rng.standard_normal((2, 3, 4, 4, 4))
+                              .astype(np.float32),), {"output_size": 2}))
+register("nn.functional.adaptive_max_pool3d", tol=_LOOSE, sharding="reduce",
+         sample=lambda rng: ((rng.standard_normal((2, 3, 4, 4, 4))
+                              .astype(np.float32),), {"output_size": 2}))
+register("nn.functional.zeropad2d", sharding="shape",
+         sample=lambda rng: ((rng.standard_normal((2, 3, 4, 4))
+                              .astype(np.float32),),
+                             {"padding": [1, 1, 1, 1]}))
+register("nn.functional.soft_margin_loss", tol=_LOOSE, sharding="reduce",
+         sample=lambda rng: ((rng.standard_normal((4, 8))
+                              .astype(np.float32),
+                              np.sign(rng.standard_normal((4, 8)))
+                              .astype(np.float32)), {}))
+register("nn.functional.gaussian_nll_loss", tol=_LOOSE, sharding="reduce",
+         sample=lambda rng: ((rng.standard_normal((4, 8))
+                              .astype(np.float32),
+                              rng.standard_normal((4, 8))
+                              .astype(np.float32),
+                              (np.abs(rng.standard_normal((4, 8))) + 0.5)
+                              .astype(np.float32)), {}))
