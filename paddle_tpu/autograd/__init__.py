@@ -79,10 +79,19 @@ class PyLayerContext:
         self.materialize_grads = True
 
     def save_for_backward(self, *tensors):
+        # capture the ACTIVE hooks at save time: backward usually runs
+        # after the with-block exits, so unpack must use the same pair
+        hooks = saved_tensors_hooks._active
+        self._saved_hooks = hooks
+        if hooks is not None:
+            tensors = tuple(hooks[0](t) for t in tensors)
         self._saved = tensors
 
     @property
     def saved_tensor(self):
+        hooks = getattr(self, "_saved_hooks", None)
+        if hooks is not None:
+            return tuple(hooks[1](t) for t in self._saved)
         return self._saved
 
     def saved_tensors(self):
@@ -222,3 +231,27 @@ def jvp(func, xs, v=None):
     out, tan = jax.jvp(raw, tuple(primals), tuple(tangents))
     wrap = lambda o: jax.tree_util.tree_map(Tensor, o)
     return wrap(out), wrap(tan)
+
+
+class saved_tensors_hooks:
+    """Reference autograd/saved_tensors_hooks: pack/unpack hooks over
+    tensors saved for backward.  The tape saves residuals inside jax.vjp
+    closures (opaque to python), so the hooks apply to the PyLayer save
+    path: PyLayerContext.save_for_backward packs, saved_tensor unpacks."""
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active = (self.pack_hook, self.unpack_hook)
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = None
+        return False
+
+
+__all__ += ["saved_tensors_hooks"]

@@ -153,3 +153,33 @@ class cuda:
     @staticmethod
     def memory_allocated(device=None):
         return 0
+
+
+IPUPlace = lambda *a: "ipu"    # noqa: E731 — place objects are strings here
+XPUPlace = lambda *a: "xpu"    # noqa: E731
+
+
+def get_all_custom_device_type():
+    """Custom (plugin) device types registered with the runtime (reference
+    device/__init__.py) — PJRT plugins beyond cpu/gpu/tpu."""
+    import jax
+    builtin = {"cpu", "gpu", "cuda", "rocm", "tpu"}
+    try:
+        plats = {d.platform for d in jax.devices()}
+    except Exception:  # noqa: BLE001
+        plats = set()
+    return sorted(plats - builtin)
+
+
+def get_cudnn_version():
+    """None: no cuDNN in an XLA/TPU build (reference returns the int
+    version on CUDA installs)."""
+    return None
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+__all__ += ["IPUPlace", "XPUPlace", "get_all_custom_device_type",
+            "get_cudnn_version", "is_compiled_with_ipu"]

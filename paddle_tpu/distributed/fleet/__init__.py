@@ -193,3 +193,168 @@ def distributed_optimizer(optimizer, strategy: Optional[DistributedStrategy] = N
     """Reference hybrid_parallel_optimizer.py:251.  Functional optimizers are
     already hybrid-safe (grad psum + ZeRO come from shardings)."""
     return optimizer
+
+
+class Role:
+    """Reference fleet/base/role_maker.py Role constants."""
+
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+    COORDINATOR = 5
+
+
+class PaddleCloudRoleMaker:
+    """Env-driven role maker (reference role_maker.py PaddleCloudRoleMaker):
+    reads the PADDLE_* env contract written by distributed.launch."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        import os
+        self._is_collective = is_collective
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        self._role = Role.WORKER \
+            if os.environ.get("TRAINING_ROLE", "TRAINER") != "PSERVER" \
+            else Role.SERVER
+
+    def _is_worker(self):
+        return self._role == Role.WORKER
+
+    is_worker = _is_worker
+
+    def _is_server(self):
+        return self._role == Role.SERVER
+
+    is_server = _is_server
+
+    def _worker_index(self):
+        return self._rank
+
+    worker_index = _worker_index
+
+    def _worker_num(self):
+        return self._size
+
+    worker_num = _worker_num
+
+    def _role_id(self):
+        return self._rank
+
+    def _get_trainer_endpoints(self):
+        import os
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit-args role maker (reference UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=False, current_id=0, role=None,
+                 worker_num=1, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective)
+        self._rank = int(current_id)
+        self._size = int(worker_num)
+        self._role = role if role is not None else Role.WORKER
+        self._server_endpoints = list(server_endpoints or [])
+
+
+class UtilBase:
+    """Reference fleet/utils UtilBase: small cross-worker helpers; the
+    in-process build executes them locally."""
+
+    def all_reduce(self, input, mode="sum"):
+        import numpy as np
+        return np.asarray(input)
+
+    def barrier(self, comm_world="worker"):
+        import jax
+        jax.effects_barrier()
+
+    def get_file_shard(self, files):
+        import os
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        size = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        return [f for i, f in enumerate(files) if i % size == rank]
+
+    def print_on_rank(self, message, rank_id=0):
+        import os
+        if int(os.environ.get("PADDLE_TRAINER_ID", "0")) == int(rank_id):
+            print(message)
+
+
+class _SlotGen:
+    """Base for the slot data generators (reference fleet/data_generator):
+    subclass and implement generate_sample(line) -> iterator of
+    (slot_name, values) lists; run_from_memory/files drive it."""
+
+    def __init__(self):
+        self._batch = 1
+
+    def set_batch(self, batch_size):
+        self._batch = int(batch_size)
+
+    def generate_sample(self, line):
+        raise NotImplementedError(
+            "implement generate_sample(line) returning an iterator")
+
+    def run_from_memory(self, lines):
+        out = []
+        for line in lines:
+            for rec in self.generate_sample(line)():
+                out.append(rec)
+        return out
+
+    def run_from_files(self, files):
+        lines = []
+        for path in files:
+            with open(path, errors="ignore") as f:
+                lines += [ln.rstrip("\n") for ln in f]
+        return self.run_from_memory(lines)
+
+
+class MultiSlotDataGenerator(_SlotGen):
+    """Values are numeric lists (reference MultiSlotDataGenerator)."""
+
+
+class MultiSlotStringDataGenerator(_SlotGen):
+    """Values are string lists (reference MultiSlotStringDataGenerator)."""
+
+
+class Fleet:
+    """The reference `fleet.Fleet` facade class.  The module-level
+    functions in this package (init/worker_num/...) are the working API —
+    this class binds them for users who instantiate `Fleet()` directly."""
+
+    def __init__(self):
+        self._role_maker = None
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=is_collective)
+        return init(role_maker=role_maker, is_collective=is_collective,
+                    strategy=strategy)
+
+    def is_first_worker(self):
+        return worker_index() == 0
+
+    def worker_index(self):
+        return worker_index()
+
+    def worker_num(self):
+        return worker_num()
+
+    def is_worker(self):
+        return self._role_maker is None or self._role_maker._is_worker()
+
+    def is_server(self):
+        return self._role_maker is not None and self._role_maker._is_server()
+
+    @property
+    def util(self):
+        return UtilBase()
+
+
+__all__ += ["Fleet", "Role", "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+            "UtilBase", "MultiSlotDataGenerator",
+            "MultiSlotStringDataGenerator"]

@@ -12,7 +12,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...nn.layer import Layer
+from ...nn.layer import Layer, ParameterList
 from ...nn import initializer as I
 from ...tensor import apply_op
 from ... import kernels
@@ -209,3 +209,104 @@ class FusedEcMoe(Layer):
 
         return apply_op("fused_ec_moe", f, x, self.gate, self.w1, self.b1,
                         self.w2, self.b2)
+
+
+class FusedDropoutAdd(Layer):
+    """dropout(x) + y in one op (reference incubate/nn/layer/
+    fused_dropout_add.py)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p, self.mode = p, mode
+
+    def forward(self, x, y):
+        from . import functional as IF
+        return IF.fused_dropout_add(x, y, p=self.p, training=self.training,
+                                    mode=self.mode)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """(x + bias) -> dropout -> + residual -> LN (reference incubate/nn/
+    layer/fused_transformer.py FusedBiasDropoutResidualLayerNorm)."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        self._p, self._eps = dropout_rate, epsilon
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), weight_attr, default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x, residual):
+        from . import functional as IF
+        return IF.fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self._p, ln_epsilon=self._eps,
+            training=self.training)
+
+
+class FusedMultiTransformer(Layer):
+    """Stacked fused transformer (reference incubate/nn/layer/
+    fused_transformer.py FusedMultiTransformer): owns per-layer packed
+    weights, forwards through functional.fused_multi_transformer."""
+
+    def __init__(self, embed_dim, num_heads, dim_feedforward,
+                 dropout_rate=0.0, activation="gelu", normalize_before=True,
+                 num_layers=1, epsilon=1e-5, name=None, **kwargs):
+        super().__init__()
+        from ...nn import initializer as I
+        import math as _m
+        std = 0.02
+        n = I.Normal(std=std)
+        z = I.Constant(0.0)
+        o = I.Constant(1.0)
+        self._eps, self._act = epsilon, activation
+        self._pre = normalize_before
+        self._nh = num_heads
+        self._p = dropout_rate
+        D, F_ = embed_dim, dim_feedforward
+        mk = self.create_parameter
+        self.ln_scales = ParameterList(
+            [mk((D,), default_initializer=o) for _ in range(num_layers)])
+        self.ln_biases = ParameterList(
+            [mk((D,), is_bias=True) for _ in range(num_layers)])
+        self.qkv_weights = ParameterList(
+            [mk((D, 3 * D), default_initializer=n) for _ in range(num_layers)])
+        self.qkv_biases = ParameterList(
+            [mk((3 * D,), is_bias=True) for _ in range(num_layers)])
+        self.linear_weights = ParameterList(
+            [mk((D, D), default_initializer=n) for _ in range(num_layers)])
+        self.linear_biases = ParameterList(
+            [mk((D,), is_bias=True) for _ in range(num_layers)])
+        self.ffn_ln_scales = ParameterList(
+            [mk((D,), default_initializer=o) for _ in range(num_layers)])
+        self.ffn_ln_biases = ParameterList(
+            [mk((D,), is_bias=True) for _ in range(num_layers)])
+        self.ffn1_weights = ParameterList(
+            [mk((D, F_), default_initializer=n) for _ in range(num_layers)])
+        self.ffn1_biases = ParameterList(
+            [mk((F_,), is_bias=True) for _ in range(num_layers)])
+        self.ffn2_weights = ParameterList(
+            [mk((F_, D), default_initializer=n) for _ in range(num_layers)])
+        self.ffn2_biases = ParameterList(
+            [mk((D,), is_bias=True) for _ in range(num_layers)])
+
+    def forward(self, x, attn_mask=None, caches=None, time_step=None):
+        from . import functional as IF
+        return IF.fused_multi_transformer(
+            x, list(self.ln_scales), list(self.ln_biases),
+            list(self.qkv_weights), list(self.qkv_biases),
+            list(self.linear_weights), list(self.linear_biases),
+            list(self.ffn_ln_scales), list(self.ffn_ln_biases),
+            list(self.ffn1_weights), list(self.ffn1_biases),
+            list(self.ffn2_weights), list(self.ffn2_biases),
+            pre_layer_norm=self._pre, epsilon=self._eps,
+            attn_mask=attn_mask, activation=self._act,
+            dropout_rate=self._p, num_heads=self._nh,
+            training=self.training)

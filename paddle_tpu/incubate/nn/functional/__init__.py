@@ -19,6 +19,8 @@ __all__ = [
     "fused_bias_act", "fused_linear", "fused_linear_activation", "swiglu",
     "fused_dropout_add", "fused_multi_head_attention", "fused_feedforward",
     "variable_length_memory_efficient_attention", "masked_multihead_attention",
+    "fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",
+    "fused_ec_moe", "fused_multi_transformer",
 ]
 
 
@@ -306,3 +308,131 @@ def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
             use_neox_rotary_style=use_neox_rotary_style)
 
     return apply_op("masked_multihead_attention", f, xt, ct, *exts)
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """matmul + bias in one op (reference incubate fused_matmul_bias;
+    XLA fuses the add into the GEMM epilogue)."""
+    def f(xr, yr, br):
+        a = xr.T if transpose_x else xr
+        b = yr.T if transpose_y else yr
+        out = a @ b
+        return out if br is None else out + br
+    return apply_op("fused_matmul_bias", f, _t(x), _t(y),
+                    _t(bias) if bias is not None else None)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode="upscale_in_train",
+        name=None):
+    """(x + bias) -> dropout -> + residual -> LayerNorm, one fused op
+    (reference incubate/nn/functional/fused_transformer.py)."""
+    from ....nn.functional import dropout as _dropout
+    h = _t(x)
+    if bias is not None:
+        h = h + _t(bias)
+    h = _dropout(h, p=dropout_rate, training=training, mode=mode)
+    h = h + _t(residual)
+
+    def f(hr, sr, br):
+        mu = hr.astype(jnp.float32).mean(-1, keepdims=True)
+        var = hr.astype(jnp.float32).var(-1, keepdims=True)
+        out = (hr.astype(jnp.float32) - mu) * jax.lax.rsqrt(var + ln_epsilon)
+        if sr is not None:
+            out = out * sr
+        if br is not None:
+            out = out + br
+        return out.astype(hr.dtype)
+    return apply_op("fused_bias_dropout_residual_ln", f, h,
+                    _t(ln_scale) if ln_scale is not None else None,
+                    _t(ln_bias) if ln_bias is not None else None)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Expert-choice style fused MoE FFN (reference incubate fused_ec_moe):
+    dense per-expert batched matmuls weighted by softmax(gate)."""
+    def f(xr, gr, w0, b0, w1, b1):
+        B, S, D = xr.shape
+        probs = jax.nn.softmax(gr, axis=-1)            # (B, S, E)
+        h = jnp.einsum("bsd,edf->bsef", xr, w0) + b0   # (B, S, E, F)
+        h = jax.nn.gelu(h) if act_type == "gelu" else jax.nn.relu(h)
+        o = jnp.einsum("bsef,efd->bsed", h, w1) + b1   # (B, S, E, D)
+        return jnp.einsum("bse,bsed->bsd", probs, o)
+    return apply_op("fused_ec_moe", f, _t(x), _t(gate), _t(bmm0_weight),
+                    _t(bmm0_bias), _t(bmm1_weight), _t(bmm1_bias))
+
+
+def fused_multi_transformer(x, ln_scales, ln_biases, qkv_weights, qkv_biases,
+                            linear_weights, linear_biases, ffn_ln_scales,
+                            ffn_ln_biases, ffn1_weights, ffn1_biases,
+                            ffn2_weights, ffn2_biases, pre_layer_norm=True,
+                            epsilon=1e-5, cache_kvs=None, time_step=None,
+                            attn_mask=None, dropout_rate=0.0,
+                            activation="gelu", training=False,
+                            mode="upscale_in_train", ring_id=-1,
+                            num_heads=None, name=None):
+    """Stacked fused transformer blocks (reference incubate
+    fused_multi_transformer): per-layer pre-LN attention + FFN over the
+    packed per-layer weight lists."""
+    from ....nn.functional import layer_norm as _ln
+    from .... import kernels as _kernels
+
+    from ....nn.functional import dropout as _dropout
+
+    h = _t(x)
+    L = len(qkv_weights)
+    for i in range(L):
+        def ln(t, s, b):
+            return apply_op(
+                "fused_mt_ln",
+                lambda tr, sr, br: ((tr.astype(jnp.float32)
+                                     - tr.astype(jnp.float32).mean(-1, keepdims=True))
+                                    * jax.lax.rsqrt(
+                                        tr.astype(jnp.float32).var(-1, keepdims=True)
+                                        + epsilon) * sr + br).astype(tr.dtype),
+                t, _t(s), _t(b))
+        inp = ln(h, ln_scales[i], ln_biases[i]) if pre_layer_norm else h
+
+        def attn(tr, wr, br, ow, ob):
+            B, S, D = tr.shape
+            qkv = tr @ wr + br                       # (B, S, 3D)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            nh = num_heads if num_heads else (8 if D % 8 == 0 else 1)
+            if D % nh:
+                raise ValueError(
+                    f"embed_dim {D} not divisible by num_heads {nh}")
+            hd = D // nh
+            q = q.reshape(B, S, nh, hd)
+            k = k.reshape(B, S, nh, hd)
+            v = v.reshape(B, S, nh, hd)
+            sc = jnp.einsum("bsnd,btnd->bnst", q, k) / jnp.sqrt(hd)
+            w = jax.nn.softmax(sc, axis=-1)
+            o = jnp.einsum("bnst,btnd->bsnd", w, v).reshape(B, S, D)
+            return o @ ow + ob
+        a = apply_op("fused_mt_attn", attn, inp, _t(qkv_weights[i]),
+                     _t(qkv_biases[i]), _t(linear_weights[i]),
+                     _t(linear_biases[i]))
+        if dropout_rate:
+            a = _dropout(a, p=dropout_rate, training=training, mode=mode)
+        h = h + a
+        if not pre_layer_norm:      # post-LN: normalize AFTER the residual
+            h = ln(h, ln_scales[i], ln_biases[i])
+        inp2 = ln(h, ffn_ln_scales[i], ffn_ln_biases[i]) if pre_layer_norm \
+            else h
+
+        def ffn(tr, w1, b1, w2, b2):
+            m = tr @ w1 + b1
+            m = jax.nn.gelu(m) if activation == "gelu" else jax.nn.relu(m)
+            return m @ w2 + b2
+        f = apply_op("fused_mt_ffn", ffn, inp2, _t(ffn1_weights[i]),
+                     _t(ffn1_biases[i]), _t(ffn2_weights[i]),
+                     _t(ffn2_biases[i]))
+        if dropout_rate:
+            f = _dropout(f, p=dropout_rate, training=training, mode=mode)
+        h = h + f
+        if not pre_layer_norm:
+            h = ln(h, ffn_ln_scales[i], ffn_ln_biases[i])
+    return h

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 from . import functional  # noqa: F401
+from . import utils  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer import Layer, LayerDict, LayerList, ParamAttr, ParameterList, Sequential  # noqa: F401
 from .common import *  # noqa: F401,F403
@@ -25,7 +26,7 @@ from . import common as _common
 
 __all__ = (
     ["Layer", "LayerList", "LayerDict", "ParameterList", "Sequential", "ParamAttr",
-     "Parameter", "functional", "initializer",
+     "Parameter", "functional", "initializer", "utils",
      "MultiHeadAttention", "TransformerEncoderLayer", "TransformerEncoder",
      "TransformerDecoderLayer", "TransformerDecoder", "Transformer",
      "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue",

@@ -165,3 +165,31 @@ def set_global_initializer(weight_init, bias_init=None):
 
 _global_weight_init = None
 _global_bias_init = None
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsample kernel init for transposed conv (reference
+    nn/initializer/Bilinear.py): weight shape (C_out, C_in, k, k)."""
+
+    def __call__(self, shape, dtype="float32"):
+        import numpy as np
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer expects a 4-D shape")
+        k = shape[-1]
+        if shape[-2] != k:
+            raise ValueError("Bilinear initializer expects square kernels")
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        og = np.ogrid[:k, :k]
+        filt = ((1 - np.abs(og[0] / f - c))
+                * (1 - np.abs(og[1] / f - c))).astype(np.float32)
+        w = np.zeros(shape, np.float32)
+        for i in range(shape[0]):
+            for j in range(shape[1]):
+                w[i, j] = filt
+        from .. import framework
+        return jnp.asarray(w).astype(
+            framework.to_jax_dtype(framework.convert_dtype(dtype)))
+
+
+

@@ -1,0 +1,173 @@
+"""paddle.nn.utils (reference python/paddle/nn/utils/): weight/spectral
+norm reparameterizations, grad clipping helpers, parameter<->vector."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Parameter, Tensor, apply_op, to_tensor
+
+__all__ = [
+    "weight_norm", "remove_weight_norm", "spectral_norm",
+    "clip_grad_norm_", "clip_grad_value_",
+    "parameters_to_vector", "vector_to_parameters",
+]
+
+
+def _norm_except(w, dim):
+    """L2 norm over all dims except `dim` (paddle weight_norm convention)."""
+    if dim is None:
+        return jnp.sqrt((w * w).sum())
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt((w * w).sum(axes, keepdims=True))
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparameterize `layer.name` as g * v/||v|| (reference
+    nn/utils/weight_norm_hook.py).  Registers `name`_g / `name`_v
+    Parameters and a pre-forward hook that rebuilds `name` from them."""
+    w = getattr(layer, name)
+    raw = w._data
+    g = Parameter(np.asarray(_norm_except(raw, dim)))
+    v = Parameter(np.asarray(raw))
+    layer.add_parameter(name + "_g", g)
+    layer.add_parameter(name + "_v", v)
+    # the base weight is now derived — drop it from the parameter store
+    layer._parameters.pop(name, None)
+
+    def hook(lyr, inputs):
+        # taped op: grads flow to g and v through the derived weight
+        derived = apply_op(
+            "weight_norm",
+            lambda vr, gr: vr * (gr / jnp.maximum(_norm_except(vr, dim),
+                                                  1e-12)),
+            v, g)
+        object.__setattr__(lyr, name, derived)
+        return None
+
+    handle = layer.register_forward_pre_hook(hook)
+    layer._weight_norm_state = (name, dim, handle)
+    hook(layer, None)       # make `name` available immediately
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g * v/||v|| back into a plain Parameter (reference
+    remove_weight_norm)."""
+    state = getattr(layer, "_weight_norm_state", None)
+    if state is None or state[0] != name:
+        raise ValueError(f"weight_norm was not applied to '{name}'")
+    _, dim, handle = state
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    n = _norm_except(v._data, dim)
+    w = Parameter(np.asarray(v._data * (g._data / jnp.maximum(n, 1e-12))))
+    handle.remove()
+    layer._parameters.pop(name + "_g", None)
+    layer._parameters.pop(name + "_v", None)
+    object.__delattr__(layer, name) if name in layer.__dict__ else None
+    layer.add_parameter(name, w)
+    del layer._weight_norm_state
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Divide the weight by its largest singular value, estimated by power
+    iteration on each forward (reference nn/utils/spectral_norm_hook.py)."""
+    w = getattr(layer, name)
+    raw = w._data
+    if dim is None:
+        dim = 1 if type(layer).__name__.endswith("Transpose") else 0
+    mat = jnp.moveaxis(raw, dim, 0).reshape(raw.shape[dim], -1)
+    rng = np.random.default_rng(0)
+    u0 = rng.standard_normal(mat.shape[0]).astype(np.float32)
+    layer.register_buffer(name + "_u",
+                          to_tensor(u0 / (np.linalg.norm(u0) + eps)))
+    orig = Parameter(np.asarray(raw))
+    layer.add_parameter(name + "_orig", orig)
+    layer._parameters.pop(name, None)
+
+    def hook(lyr, inputs):
+        # power iteration on raw values (buffer update, no grad) ...
+        wv = orig._data
+        m = jnp.moveaxis(wv, dim, 0).reshape(wv.shape[dim], -1)
+        u = getattr(lyr, name + "_u")._data
+        # vvec must exist even with n_power_iterations=0 (frozen estimate)
+        vvec = m.T @ u
+        vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
+        for _ in range(n_power_iterations):
+            u = m @ vvec
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+            vvec = m.T @ u
+            vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
+        getattr(lyr, name + "_u")._data = u
+        # ... then a taped division so grads flow to the orig weight
+        derived = apply_op(
+            "spectral_norm",
+            lambda w_: w_ / jnp.maximum(
+                u @ jnp.moveaxis(w_, dim, 0).reshape(w_.shape[dim], -1)
+                @ vvec, eps),
+            orig)
+        object.__setattr__(lyr, name, derived)
+        return None
+
+    layer.register_forward_pre_hook(hook)
+    hook(layer, None)
+    return layer
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    """Clip the GLOBAL grad norm in place; returns the pre-clip norm
+    (reference nn/utils/clip_grad_norm_.py)."""
+    params = [p for p in (parameters if isinstance(parameters, (list, tuple))
+                          else [parameters])
+              if isinstance(p, Tensor) and p.grad is not None]
+    if not params:
+        return to_tensor(np.float32(0.0))
+    grads = [p.grad._data.astype(jnp.float32) for p in params]
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.asarray([jnp.abs(g).max() for g in grads]))
+    else:
+        total = jnp.sum(jnp.asarray(
+            [jnp.sum(jnp.abs(g) ** norm_type) for g in grads])) \
+            ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of order {norm_type} is non-finite")
+    coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p.grad._data = (p.grad._data.astype(jnp.float32)
+                        * coef).astype(p.grad._data.dtype)
+    return to_tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp every grad element into [-clip_value, clip_value] in place
+    (reference nn/utils/clip_grad_value_.py)."""
+    clip_value = float(clip_value)
+    for p in (parameters if isinstance(parameters, (list, tuple))
+              else [parameters]):
+        if isinstance(p, Tensor) and p.grad is not None:
+            p.grad._data = jnp.clip(p.grad._data, -clip_value, clip_value)
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one vector (reference
+    transform_parameters.py)."""
+    ps = list(parameters)
+    return to_tensor(jnp.concatenate(
+        [p._data.reshape(-1) for p in ps]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Write a flat vector back into the parameter list (in place)."""
+    raw = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    off = 0
+    for p in parameters:
+        n = int(np.prod(p._data.shape)) if p._data.ndim else 1
+        p._data = raw[off:off + n].reshape(p._data.shape).astype(
+            p._data.dtype)
+        off += n

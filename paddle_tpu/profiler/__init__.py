@@ -253,3 +253,21 @@ def profiler_pure(*a, **k):  # pragma: no cover — reference-internal helper
 def load_profiler_result(filename: str):
     with open(filename) as f:
         return json.load(f)
+
+
+class SummaryView:
+    """Reference profiler/profiler.py SummaryView constants (which summary
+    tables summary() prints)."""
+
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+

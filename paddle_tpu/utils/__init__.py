@@ -21,3 +21,67 @@ def try_import(name):  # paddle.utils.try_import parity
         return importlib.import_module(name)
     except ImportError as e:
         raise ImportError(f"Failed to import {name}: {e}") from e
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Deprecation decorator (reference utils/deprecated.py): warns once
+    on first call, forwards to the wrapped function."""
+    import functools
+    import warnings
+
+    def deco(fn):
+        warned = []
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API '{fn.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level == 2:          # hard-removed: raise on EVERY call
+                raise RuntimeError(msg)
+            if not warned:
+                warned.append(1)
+                warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return wrapper
+    return deco
+
+
+def require_version(min_version, max_version=None):
+    """Version gate (reference utils/install_check.py require_version) —
+    checks this package's version string."""
+    from .. import __version__ as ver
+
+    def key(v):
+        return tuple(int(x) for x in str(v).split(".")[:3] if x.isdigit())
+    if key(ver) < key(min_version):
+        raise Exception(
+            f"installed version {ver} < required minimum {min_version}")
+    if max_version is not None and key(ver) > key(max_version):
+        raise Exception(
+            f"installed version {ver} > required maximum {max_version}")
+
+
+def run_check():
+    """Smoke-check the install (reference utils/install_check.py): one
+    small matmul + grad on the default device, printing the verdict."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    y = x.matmul(x)
+    y.sum().backward()
+    assert x.grad is not None
+    print("PaddlePaddle(TPU build) is installed successfully!")
+
+
+try:
+    from .. import __all__ as _pkg_all  # noqa: F401
+    __all__ += ["deprecated", "require_version", "run_check"]
+except Exception:  # pragma: no cover
+    pass

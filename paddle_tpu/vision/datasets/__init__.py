@@ -19,7 +19,8 @@ import numpy as np
 
 from ...io import Dataset
 
-__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+__all__ = ["Flowers", "VOC2012",
+           "MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
            "ImageFolder", "FakeData"]
 
 
@@ -225,3 +226,90 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.size
+
+
+class Flowers(Dataset):
+    """Oxford Flowers-102 (reference vision/datasets/flowers.py).
+    data_file: directory of <label>/<img>.npy or .png files (or None for
+    synthetic 32x32 RGB).  Items: (image HWC uint8 | CHW float via
+    transform, label int64)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False,
+                 backend=None, n_synthetic=40):
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(f"mode must be train|valid|test, got {mode}")
+        self.transform = transform
+        self._items = []
+        if data_file is None:
+            rng = np.random.default_rng(
+                {"train": 102, "valid": 103, "test": 104}[mode])
+            for i in range(n_synthetic):
+                lab = i % 102
+                base = np.full((32, 32, 3), 40 + (lab * 2) % 160, np.uint8)
+                noise = rng.integers(0, 40, (32, 32, 3), dtype=np.uint8)
+                self._items.append((base + noise, lab))
+        else:
+            import os
+            for lab_name in sorted(os.listdir(data_file)):
+                d = os.path.join(data_file, lab_name)
+                if not os.path.isdir(d):
+                    continue
+                for f in sorted(os.listdir(d)):
+                    p = os.path.join(d, f)
+                    if f.endswith(".npy"):
+                        self._items.append((np.load(p), int(lab_name)))
+        self._items = [(im, np.int64(lab)) for im, lab in self._items]
+
+    def __getitem__(self, idx):
+        im, lab = self._items[idx]
+        if self.transform is not None:
+            im = self.transform(im)
+        return im, lab
+
+    def __len__(self):
+        return len(self._items)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference vision/datasets/voc2012.py).
+    data_file: a directory with JPEGImages/ + SegmentationClass/ pairs as
+    .npy; None -> synthetic (image, mask) pairs.  Items: (image HWC uint8,
+    mask HW int64)."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None, n_synthetic=20):
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(f"mode must be train|valid|test, got {mode}")
+        self.transform = transform
+        self._items = []
+        if data_file is None:
+            rng = np.random.default_rng(
+                {"train": 201, "valid": 202, "test": 203}[mode])
+            for _ in range(n_synthetic):
+                img = rng.integers(0, 255, (32, 32, 3), dtype=np.uint8)
+                mask = np.zeros((32, 32), np.int64)
+                x0, y0 = rng.integers(4, 16, 2)
+                cls = int(rng.integers(1, 21))
+                mask[y0:y0 + 12, x0:x0 + 12] = cls
+                self._items.append((img, mask))
+        else:
+            import os
+            jdir = os.path.join(data_file, "JPEGImages")
+            sdir = os.path.join(data_file, "SegmentationClass")
+            for f in sorted(os.listdir(jdir)):
+                if not f.endswith(".npy"):
+                    continue
+                m = os.path.join(sdir, f)
+                if os.path.exists(m):
+                    self._items.append((np.load(os.path.join(jdir, f)),
+                                        np.load(m).astype(np.int64)))
+
+    def __getitem__(self, idx):
+        im, mask = self._items[idx]
+        if self.transform is not None:
+            im = self.transform(im)
+        return im, mask
+
+    def __len__(self):
+        return len(self._items)

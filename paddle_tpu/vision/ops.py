@@ -14,7 +14,11 @@ import numpy as np
 from ..tensor import Tensor, apply_op, to_tensor
 
 __all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou",
-           "deform_conv2d", "DeformConv2D"]
+           "deform_conv2d", "DeformConv2D", "psroi_pool", "RoIAlign", "RoIPool", "PSRoIPool",
+           "box_coder", "prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+           "generate_proposals", "distribute_fpn_proposals", "read_file",
+           "decode_jpeg",
+]
 
 
 def _raw(x):
@@ -169,3 +173,438 @@ def deform_conv2d(*args, **kwargs):
 class DeformConv2D:
     def __init__(self, *a, **k):
         raise NotImplementedError("DeformConv2D — see deform_conv2d")
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Position-sensitive RoI pooling (reference vision/ops.py psroi_pool):
+    input channels C = out_c * ph * pw; bin (i, j) average-pools its own
+    channel slice."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    xs = [x if isinstance(x, Tensor) else to_tensor(x),
+          boxes if isinstance(boxes, Tensor) else to_tensor(boxes)]
+    bn = _raw(boxes_num).astype(np.int64)
+    batch_idx = np.repeat(np.arange(len(bn)), bn)
+    C = int(_raw(x).shape[1])
+    if C % (ph * pw):
+        raise ValueError(
+            f"psroi_pool: channels {C} not divisible by {ph}x{pw}")
+    out_c = C // (ph * pw)
+
+    def f(feat, rois):
+        H, W = feat.shape[2], feat.shape[3]
+        rois = rois.astype(jnp.float32) * spatial_scale
+
+        def one(bi, roi):
+            x1, y1, x2, y2 = roi
+            rh = jnp.maximum(y2 - y1, 0.1) / ph
+            rw = jnp.maximum(x2 - x1, 0.1) / pw
+            fm = feat[bi].reshape(out_c, ph * pw, H, W)
+            outs = []
+            # average over a fixed 4x4 sample grid per bin (static shapes)
+            g = 4
+            for i in range(ph):
+                for j in range(pw):
+                    ys = y1 + (i + (jnp.arange(g) + 0.5) / g) * rh
+                    xs_ = x1 + (j + (jnp.arange(g) + 0.5) / g) * rw
+                    yi = jnp.clip(jnp.round(ys), 0, H - 1).astype(jnp.int32)
+                    xi = jnp.clip(jnp.round(xs_), 0, W - 1).astype(jnp.int32)
+                    patch = fm[:, i * pw + j][:, yi][:, :, xi]  # (out_c, g, g)
+                    outs.append(patch.mean((1, 2)))
+            return jnp.stack(outs, 1).reshape(out_c, ph, pw)
+
+        return jax.vmap(one)(jnp.asarray(batch_idx), rois)
+
+    return apply_op("psroi_pool", f, *xs)
+
+
+class RoIAlign:
+    """Layer form of roi_align (reference vision/ops.py RoIAlign)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._o, self._s = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self._o, self._s,
+                         aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._o, self._s = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self._o, self._s)
+
+
+class PSRoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self._o, self._s = output_size, spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self._o, self._s)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (reference vision/ops.py
+    box_coder, the SSD convention)."""
+    pb = _raw(prior_box).astype(np.float32)
+    tv = _raw(target_box)
+    if isinstance(prior_box_var, (list, tuple)):
+        pbv = np.asarray(prior_box_var, np.float32)
+    elif prior_box_var is None:
+        pbv = np.ones(4, np.float32)
+    else:
+        pbv = _raw(prior_box_var).astype(np.float32)
+    norm = 0.0 if box_normalized else 1.0
+
+    def f(tb):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        phh = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw * 0.5
+        pcy = pb[:, 1] + phh * 0.5
+        if code_type == "encode_center_size":
+            tw = tb[:, 2] - tb[:, 0] + norm
+            th = tb[:, 3] - tb[:, 1] + norm
+            tcx = tb[:, 0] + tw * 0.5
+            tcy = tb[:, 1] + th * 0.5
+            out = jnp.stack([
+                (tcx[:, None] - pcx[None]) / pw[None],
+                (tcy[:, None] - pcy[None]) / phh[None],
+                jnp.log(tw[:, None] / pw[None]),
+                jnp.log(th[:, None] / phh[None])], -1)
+            if pbv.ndim == 1 and pbv.size == 4:
+                return out / pbv.reshape(1, 1, 4)      # per-coordinate
+            if pbv.ndim == 2:                          # per-prior variance
+                return out / pbv[None, :, :]
+            return out
+        # decode: tb (N, M, 4) deltas against priors on `axis`
+        d = tb * (pbv if pbv.ndim == 1 else pbv[:, None, :]) \
+            if pbv.size else tb
+        if axis == 0:
+            pw_, ph_, pcx_, pcy_ = (v[None, :] for v in (pw, phh, pcx, pcy))
+        else:
+            pw_, ph_, pcx_, pcy_ = (v[:, None] for v in (pw, phh, pcx, pcy))
+        cx = d[..., 0] * pw_ + pcx_
+        cy = d[..., 1] * ph_ + pcy_
+        w = jnp.exp(d[..., 2]) * pw_
+        h = jnp.exp(d[..., 3]) * ph_
+        return jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                          cx + w * 0.5 - norm, cy + h * 0.5 - norm], -1)
+
+    return apply_op("box_coder", f,
+                    target_box if isinstance(target_box, Tensor)
+                    else to_tensor(tv))
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior/anchor boxes for one feature map (reference vision/ops.py
+    prior_box).  Returns (boxes (H, W, A, 4), variances same shape)."""
+    fh, fw = int(_raw(input).shape[2]), int(_raw(input).shape[3])
+    ih, iw = int(_raw(image).shape[2]), int(_raw(image).shape[3])
+    step_h = steps[1] or ih / fh
+    step_w = steps[0] or iw / fw
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if all(abs(ar - a) > 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    for ms in min_sizes:
+        for ar in ars:
+            boxes.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        if max_sizes:
+            for mx in max_sizes:
+                s = np.sqrt(ms * mx)
+                boxes.append((s, s))
+    A = len(boxes)
+    cy = (np.arange(fh) + offset) * step_h
+    cx = (np.arange(fw) + offset) * step_w
+    out = np.zeros((fh, fw, A, 4), np.float32)
+    for a, (bw, bh) in enumerate(boxes):
+        out[:, :, a, 0] = (cx[None, :] - bw / 2) / iw
+        out[:, :, a, 1] = (cy[:, None] - bh / 2) / ih
+        out[:, :, a, 2] = (cx[None, :] + bw / 2) / iw
+        out[:, :, a, 3] = (cy[:, None] + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return to_tensor(out), to_tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.005,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode a YOLOv3 head into boxes + scores (reference vision/ops.py
+    yolo_box).  x: (B, A*(5+C), H, W); returns (boxes (B, A*H*W, 4),
+    scores (B, A*H*W, C))."""
+    xs = x if isinstance(x, Tensor) else to_tensor(x)
+    imgs = _raw(img_size).astype(np.float32)
+    A = len(anchors) // 2
+    anc = np.asarray(anchors, np.float32).reshape(A, 2)
+
+    def f(xr):
+        B, _, H, W = xr.shape
+        v = xr.reshape(B, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        sx = jax.nn.sigmoid(v[:, :, 0]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        sy = jax.nn.sigmoid(v[:, :, 1]) * scale_x_y \
+            - (scale_x_y - 1.0) / 2.0
+        bx = (gx[None, None, None, :] + sx) / W
+        by = (gy[None, None, :, None] + sy) / H
+        bw = jnp.exp(v[:, :, 2]) * anc[None, :, 0, None, None] \
+            / (W * downsample_ratio)
+        bh = jnp.exp(v[:, :, 3]) * anc[None, :, 1, None, None] \
+            / (H * downsample_ratio)
+        obj = jax.nn.sigmoid(v[:, :, 4])
+        cls = jax.nn.sigmoid(v[:, :, 5:])
+        score = obj[:, :, None] * cls                   # (B, A, C, H, W)
+        iw = imgs[:, 1][:, None, None, None]
+        ih = imgs[:, 0][:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(B, -1, 4)
+        scores = score.transpose(0, 1, 3, 4, 2).reshape(B, -1, class_num)
+        keep = (obj.reshape(B, -1) > conf_thresh)[..., None]
+        return boxes * keep, scores * keep
+    return apply_op("yolo_box", f, xs)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, scale_x_y=1.0, name=None):
+    """YOLOv3 training loss (reference vision/ops.py yolo_loss): coord MSE
+    + objectness/class BCE against anchor-matched targets (simplified
+    single-scale matching, numerically reasonable rather than kernel-
+    bitwise)."""
+    xs = x if isinstance(x, Tensor) else to_tensor(x)
+    gb = gt_box if isinstance(gt_box, Tensor) else to_tensor(gt_box)
+    gl = gt_label if isinstance(gt_label, Tensor) else to_tensor(gt_label)
+    A = len(anchor_mask)
+    anc = np.asarray(anchors, np.float32).reshape(-1, 2)[list(anchor_mask)]
+
+    def f(xr, gbr, glr):
+        B, _, H, W = xr.shape
+        v = xr.reshape(B, A, 5 + class_num, H, W)
+        obj_logit = v[:, :, 4]
+        # build objectness target: cell containing each gt center, best
+        # anchor by wh-IoU
+        cx = (gbr[:, :, 0] * W).astype(jnp.int32).clip(0, W - 1)
+        cy = (gbr[:, :, 1] * H).astype(jnp.int32).clip(0, H - 1)
+        gw = gbr[:, :, 2] * W * downsample_ratio
+        gh = gbr[:, :, 3] * H * downsample_ratio
+        inter = jnp.minimum(gw[..., None], anc[None, None, :, 0]) \
+            * jnp.minimum(gh[..., None], anc[None, None, :, 1])
+        union = gw[..., None] * gh[..., None] \
+            + anc[None, None, :, 0] * anc[None, None, :, 1] - inter
+        best = jnp.argmax(inter / jnp.maximum(union, 1e-9), -1)  # (B, G)
+        valid = (gbr[:, :, 2] > 0) & (gbr[:, :, 3] > 0)
+        tgt = jnp.zeros((B, A, H, W))
+        bidx = jnp.arange(B)[:, None].repeat(gbr.shape[1], 1)
+        tgt = tgt.at[bidx, best, cy, cx].max(valid.astype(jnp.float32))
+        bce = jnp.maximum(obj_logit, 0) - obj_logit * tgt \
+            + jnp.log1p(jnp.exp(-jnp.abs(obj_logit)))
+        obj_loss = bce.sum((1, 2, 3))
+        # coordinate loss at matched cells
+        sxy = jax.nn.sigmoid(v[:, :, 0:2])
+        pred_x = sxy[:, :, 0][bidx, best, cy, cx]
+        pred_y = sxy[:, :, 1][bidx, best, cy, cx]
+        tx = gbr[:, :, 0] * W - jnp.floor(gbr[:, :, 0] * W)
+        ty = gbr[:, :, 1] * H - jnp.floor(gbr[:, :, 1] * H)
+        coord = (((pred_x - tx) ** 2 + (pred_y - ty) ** 2)
+                 * valid).sum(-1)
+        # class BCE at matched cells
+        cl = v[:, :, 5:][bidx, best, :, cy, cx]          # (B, G, C)
+        onehot = jax.nn.one_hot(glr, class_num)
+        smooth = 1.0 / class_num if use_label_smooth else 0.0
+        tcls = onehot * (1 - smooth) + smooth / 2
+        cbce = (jnp.maximum(cl, 0) - cl * tcls
+                + jnp.log1p(jnp.exp(-jnp.abs(cl)))).sum(-1)
+        cls_loss = (cbce * valid).sum(-1)
+        return obj_loss + coord + cls_loss
+
+    return apply_op("yolo_loss", f, xs, gb, gl, nondiff=(1, 2))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold, nms_top_k,
+               keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference vision/ops.py matrix_nms; SOLOv2): decay each
+    box's score by its IoU with higher-scoring same-class boxes."""
+    bb = np.asarray(_raw(bboxes), np.float32)
+    sc = np.asarray(_raw(scores), np.float32)
+    B, C, N = sc.shape
+    all_out, all_idx, nums = [], [], []
+    for b in range(B):
+        dets = []
+        for c in range(C):
+            if c == background_label:
+                continue
+            s = sc[b, c]
+            keep = np.nonzero(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bb[b, order]
+            ss = s[order]
+            n = len(order)
+            x1, y1, x2, y2 = boxes_c.T
+            area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = (np.maximum(ix2 - ix1, 0)
+                     * np.maximum(iy2 - iy1, 0))
+            iou = inter / np.maximum(area[:, None] + area[None, :] - inter,
+                                     1e-9)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(0)
+            if use_gaussian:
+                decay = np.exp(-(iou ** 2 - iou_cmax[None, :] ** 2)
+                               / gaussian_sigma).min(0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[None, :],
+                                                1e-9)).min(0)
+            ds = ss * decay
+            for i in range(n):
+                if ds[i] >= post_threshold:
+                    dets.append((c, ds[i], *boxes_c[i], order[i]))
+        dets.sort(key=lambda d: -d[1])
+        dets = dets[:keep_top_k]
+        nums.append(len(dets))
+        for d in dets:
+            all_out.append(d[:6])
+            all_idx.append(b * N + d[6])
+    out = to_tensor(np.asarray(all_out, np.float32).reshape(-1, 6))
+    res = [out]
+    if return_index:
+        res.append(to_tensor(np.asarray(all_idx, np.int64)))
+    if return_rois_num:
+        res.append(to_tensor(np.asarray(nums, np.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference vision/ops.py
+    generate_proposals): decode deltas, clip, filter, NMS, top-k."""
+    sc = np.asarray(_raw(scores), np.float32)      # (B, A, H, W)
+    bd = np.asarray(_raw(bbox_deltas), np.float32)  # (B, 4A, H, W)
+    ims = np.asarray(_raw(img_size), np.float32)
+    anc = np.asarray(_raw(anchors), np.float32).reshape(-1, 4)
+    var = np.asarray(_raw(variances), np.float32).reshape(-1, 4)
+    B = sc.shape[0]
+    outs, rnums, oscores = [], [], []
+    for b in range(B):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)
+        d = bd[b].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order % len(anc)], \
+            var[order % len(var)]
+        aw = a[:, 2] - a[:, 0]
+        ah = a[:, 3] - a[:, 1]
+        acx = a[:, 0] + aw / 2
+        acy = a[:, 1] + ah / 2
+        cx = d[:, 0] * v[:, 0] * aw + acx
+        cy = d[:, 1] * v[:, 1] * ah + acy
+        w = np.exp(np.clip(d[:, 2] * v[:, 2], -10, 10)) * aw
+        h = np.exp(np.clip(d[:, 3] * v[:, 3], -10, 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2], 1)
+        H_, W_ = ims[b, 0], ims[b, 1]
+        boxes[:, 0::2] = boxes[:, 0::2].clip(0, W_ - 1)
+        boxes[:, 1::2] = boxes[:, 1::2].clip(0, H_ - 1)
+        ok = ((boxes[:, 2] - boxes[:, 0] >= min_size)
+              & (boxes[:, 3] - boxes[:, 1] >= min_size))
+        boxes, s = boxes[ok], s[ok]
+        keep = np.asarray(_raw(nms(to_tensor(boxes), nms_thresh,
+                                   to_tensor(s))))[:post_nms_top_n]
+        outs.append(boxes[keep])
+        oscores.append(s[keep])
+        rnums.append(len(keep))
+    rois = to_tensor(np.concatenate(outs) if outs
+                     else np.zeros((0, 4), np.float32))
+    rscores = to_tensor(np.concatenate(oscores) if oscores
+                        else np.zeros((0,), np.float32))
+    if return_rois_num:
+        return rois, rscores, to_tensor(np.asarray(rnums, np.int32))
+    return rois, rscores
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (reference vision/ops.py
+    distribute_fpn_proposals)."""
+    rois = np.asarray(_raw(fpn_rois), np.float32)
+    w = rois[:, 2] - rois[:, 0]
+    h = rois[:, 3] - rois[:, 1]
+    scale = np.sqrt(np.maximum(w * h, 1e-9))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-9)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    multi, restore = [], []
+    order = []
+    for L in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == L)[0]
+        multi.append(to_tensor(rois[idx]))
+        order.append(idx)
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    nums = [to_tensor(np.asarray([len(np.asarray(_raw(m)))], np.int32))
+            for m in multi] if rois_num is not None else None
+    res = [multi, to_tensor(restore.reshape(-1, 1))]
+    if rois_num is not None:
+        res.append(nums)
+    return tuple(res)
+
+
+def read_file(filename, name=None):
+    """File bytes as a uint8 tensor (reference vision/ops.py read_file)."""
+    with open(filename, "rb") as f:
+        return to_tensor(np.frombuffer(f.read(), np.uint8).copy())
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode JPEG bytes to (C, H, W) uint8 (reference vision/ops.py
+    decode_jpeg; uses PIL on host — no GPU nvjpeg here)."""
+    import io
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError(
+            "decode_jpeg needs Pillow for host JPEG decoding") from e
+    raw = np.asarray(_raw(x), np.uint8).tobytes()
+    img = Image.open(io.BytesIO(raw))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return to_tensor(arr.copy())

@@ -3,11 +3,11 @@ from .transforms import (  # noqa: F401
     RandomCrop, RandomHorizontalFlip, RandomVerticalFlip, RandomResizedCrop,
     RandomRotation, Pad, Transpose, Grayscale, BrightnessTransform,
     ContrastTransform, SaturationTransform, HueTransform, ColorJitter,
-    RandomErasing,
+    RandomErasing, RandomAffine, RandomPerspective,
 )
 from . import functional  # noqa: F401
 from .functional import (  # noqa: F401
     to_tensor, normalize, resize, crop, center_crop, hflip, vflip, pad,
     rotate, adjust_brightness, adjust_contrast, adjust_hue, to_grayscale,
-    erase,
+    erase, affine, perspective,
 )

@@ -271,3 +271,95 @@ def erase(img, i, j, h, w, v, inplace=False):
     else:
         out[i:i + h, j:j + w] = v
     return _like(img, out) if pil_in else out
+
+
+def _warp(arr, minv, interpolation="nearest", fill=0):
+    """Inverse-map warp of an HWC array through the 3x3 matrix `minv`
+    (maps OUTPUT pixel coords -> input coords)."""
+    H, W, C = arr.shape
+    ys, xs = np.meshgrid(np.arange(H), np.arange(W), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones], -1).reshape(-1, 3).astype(np.float64)
+    src = pts @ np.asarray(minv, np.float64).T
+    sx = src[:, 0] / src[:, 2]
+    sy = src[:, 1] / src[:, 2]
+    a = arr.astype(np.float32)
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        inb = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+        out = np.full((H * W, C), fill, np.float32)
+        out[inb] = a[yi[inb], xi[inb]]
+    else:  # bilinear
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        out = np.zeros((H * W, C), np.float32)
+        wsum = np.zeros((H * W, 1), np.float32)
+        for dy in (0, 1):
+            for dx in (0, 1):
+                xi, yi = x0 + dx, y0 + dy
+                w = (np.abs(1 - dx - (sx - x0))
+                     * np.abs(1 - dy - (sy - y0))).astype(np.float32)
+                inb = (xi >= 0) & (xi < W) & (yi >= 0) & (yi < H)
+                out[inb] += a[yi[inb], xi[inb]] * w[inb, None]
+                wsum[inb, 0] += w[inb]
+        miss = wsum[:, 0] == 0
+        out[miss] = fill
+        out[~miss] /= np.maximum(wsum[~miss], 1e-8)
+    out = out.reshape(H, W, C)
+    if np.issubdtype(arr.dtype, np.floating):
+        return out.astype(arr.dtype)
+    return np.clip(np.round(out), 0, 255).astype(arr.dtype)
+
+
+def _affine_fwd_matrix(angle, translate, scale, shear, center):
+    import math as _m
+    rot = _m.radians(angle)
+    sx, sy = [_m.radians(s) for s in (shear if isinstance(shear, (list,
+                                      tuple)) else (shear, 0.0))]
+    cx, cy = center
+    tx, ty = translate
+
+    def mat(a, b, c, d, e, f):
+        return np.array([[a, b, c], [d, e, f], [0, 0, 1]], np.float64)
+
+    T1 = mat(1, 0, cx + tx, 0, 1, cy + ty)
+    R = mat(_m.cos(rot), -_m.sin(rot), 0, _m.sin(rot), _m.cos(rot), 0)
+    SH = mat(1, -_m.tan(sx), 0, -_m.tan(sy), 1, 0)
+    S = mat(scale, 0, 0, 0, scale, 0)
+    T2 = mat(1, 0, -cx, 0, 1, -cy)
+    return T1 @ R @ SH @ S @ T2
+
+
+def affine(img, angle, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="nearest", fill=0, center=None):
+    """Affine-warp an image (reference vision/transforms/functional.py
+    affine; torchvision-style parameterization)."""
+    arr = _to_np(img)
+    H, W = arr.shape[:2]
+    if center is None:
+        center = ((W - 1) * 0.5, (H - 1) * 0.5)
+    fwd = _affine_fwd_matrix(angle, translate, scale, shear, center)
+    out = _warp(arr, np.linalg.inv(fwd), interpolation, fill)
+    return _like(img, out)
+
+
+def _homography(startpoints, endpoints):
+    """3x3 matrix mapping endpoints -> startpoints (inverse warp)."""
+    A, b = [], []
+    for (ex, ey), (sx, sy) in zip(endpoints, startpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        b.append(sx)
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        b.append(sy)
+    h = np.linalg.solve(np.asarray(A, np.float64), np.asarray(b, np.float64))
+    return np.append(h, 1.0).reshape(3, 3)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective-warp: the quad `startpoints` maps to `endpoints`
+    (reference functional.py perspective)."""
+    arr = _to_np(img)
+    minv = _homography(startpoints, endpoints)
+    return _like(img, _warp(arr, minv, interpolation, fill))

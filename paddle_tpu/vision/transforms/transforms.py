@@ -281,3 +281,66 @@ class RandomErasing(BaseTransform):
                 # erase via F.erase on the original so PIL in -> PIL out
                 return F.erase(img, i, j, h, w, self.value, self.inplace)
         return img
+
+
+class RandomAffine(BaseTransform):
+    """Reference transforms.py RandomAffine: random rotation/translation/
+    scale/shear inside the given ranges."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            if degrees < 0:
+                raise ValueError("degrees must be non-negative")
+            degrees = (-degrees, degrees)
+        self.degrees = degrees
+        self.translate, self.scale_rng, self.shear = translate, scale, shear
+        self.interpolation, self.fill, self.center = interpolation, fill, center
+
+    def _apply_image(self, img):
+        arr = F._to_np(img)
+        H, W = arr.shape[:2]
+        angle = random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = random.uniform(-self.translate[0], self.translate[0]) * W
+            ty = random.uniform(-self.translate[1], self.translate[1]) * H
+        sc = random.uniform(*self.scale_rng) if self.scale_rng else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif isinstance(self.shear, numbers.Number):
+            sh = (random.uniform(-self.shear, self.shear), 0.0)
+        else:
+            lo, hi = self.shear[0], self.shear[1]
+            sh = (random.uniform(lo, hi), 0.0) if len(self.shear) == 2 \
+                else (random.uniform(self.shear[0], self.shear[1]),
+                      random.uniform(self.shear[2], self.shear[3]))
+        return F.affine(img, angle, (tx, ty), sc, sh, self.interpolation,
+                        self.fill, self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Reference transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob, self.distortion = prob, distortion_scale
+        self.interpolation, self.fill = interpolation, fill
+
+    def _apply_image(self, img):
+        if random.random() >= self.prob:
+            return img
+        arr = F._to_np(img)
+        H, W = arr.shape[:2]
+        d = self.distortion
+        hw, hh = int(W * d / 2), int(H * d / 2)
+
+        def jitter(x, y):
+            return (x + random.randint(-hw, hw) if hw else x,
+                    y + random.randint(-hh, hh) if hh else y)
+
+        start = [(0, 0), (W - 1, 0), (W - 1, H - 1), (0, H - 1)]
+        end = [jitter(*p) for p in start]
+        return F.perspective(img, start, end, self.interpolation, self.fill)

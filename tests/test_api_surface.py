@@ -379,6 +379,19 @@ def test_full_export_parity_vs_reference():
                                               paddle.text),
         ("geometric/__init__.py", paddle.geometric),
         ("incubate/__init__.py", paddle.incubate),
+        ("vision/transforms/__init__.py", paddle.vision.transforms),
+        ("vision/ops.py", paddle.vision.ops),
+        ("vision/datasets/__init__.py", paddle.vision.datasets),
+        ("nn/utils/__init__.py", nn.utils),
+        ("utils/__init__.py", paddle.utils),
+        ("autograd/__init__.py", paddle.autograd),
+        ("device/__init__.py", paddle.device),
+        ("profiler/__init__.py", paddle.profiler),
+        ("incubate/nn/__init__.py", paddle.incubate.nn),
+        ("incubate/nn/functional/__init__.py",
+         paddle.incubate.nn.functional),
+        ("distributed/fleet/__init__.py", paddle.distributed.fleet),
+        ("audio/functional/__init__.py", paddle.audio.functional),
     ]
     missing = {}
     for rel, mod in checks:
@@ -437,3 +450,213 @@ def test_shuffle_differs_across_calls():
     first = list(ds._data)
     ds.local_shuffle()
     assert list(ds._data) != first  # fresh permutation each epoch
+
+
+class TestSecondarySurface:
+    def test_nn_utils_weight_norm_roundtrip(self):
+        lin = nn.Linear(4, 3)
+        w0 = lin.weight.numpy().copy()
+        nn.utils.weight_norm(lin, "weight", dim=0)
+        x = paddle.to_tensor(np.random.randn(2, 4).astype("float32"))
+        out1 = lin(x).numpy()
+        (lin(x) ** 2).sum().backward()
+        assert lin.weight_g.grad is not None and lin.weight_v.grad is not None
+        nn.utils.remove_weight_norm(lin)
+        np.testing.assert_allclose(lin.weight.numpy(), w0, rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(lin(x).numpy(), out1, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_nn_utils_spectral_norm_unit_sigma(self):
+        lin = nn.Linear(6, 6)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=5)
+        _ = lin(paddle.to_tensor(np.random.randn(2, 6).astype("float32")))
+        s = np.linalg.svd(lin.weight.numpy(), compute_uv=False)
+        assert abs(s[0] - 1.0) < 0.05
+
+    def test_clip_and_vector_helpers(self):
+        p = paddle.to_tensor(np.random.randn(5).astype("float32"),
+                             stop_gradient=False)
+        (p * p).sum().backward()
+        pre = np.linalg.norm(p.grad.numpy())
+        total = nn.utils.clip_grad_norm_([p], 0.1)
+        np.testing.assert_allclose(float(total.numpy()), pre, rtol=1e-4)
+        assert np.linalg.norm(p.grad.numpy()) <= 0.1 + 1e-5
+        net = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(net.parameters())
+        nn.utils.vector_to_parameters(vec * 2, net.parameters())
+        assert vec.shape[0] == 8
+
+    def test_transform_affine_invariants(self):
+        from paddle_tpu.vision.transforms import functional as TF
+        img = (np.arange(5 * 7 * 3) % 255).reshape(5, 7, 3).astype("uint8")
+        np.testing.assert_array_equal(TF.affine(img, 0.0), img)
+        t = TF.affine(img, 0.0, (1, 0))
+        np.testing.assert_array_equal(t[:, 1:], img[:, :-1])
+        np.testing.assert_array_equal(TF.affine(img, 180.0),
+                                      img[::-1, ::-1])
+        pts = [(0, 0), (6, 0), (6, 4), (0, 4)]
+        np.testing.assert_array_equal(TF.perspective(img, pts, pts), img)
+
+    def test_yolo_and_boxes(self):
+        from paddle_tpu.vision import ops as V
+        x = np.zeros((1, 7, 2, 2), "float32")
+        bx, sc = V.yolo_box(paddle.to_tensor(x),
+                            paddle.to_tensor(np.array([[64, 64]])),
+                            anchors=[16, 16], class_num=2, conf_thresh=0.0,
+                            downsample_ratio=32)
+        assert list(bx.shape) == [1, 4, 4]
+        bb = np.array([[[0, 0, 10, 10], [0, 0, 10, 10]]], "float32")
+        ss = np.array([[[0.9, 0.85]]], "float32")
+        out, nums = V.matrix_nms(paddle.to_tensor(bb), paddle.to_tensor(ss),
+                                 0.1, 0.0, 10, 10, background_label=-1)
+        assert int(nums.numpy()[0]) == 2
+        dets = out.numpy()
+        assert dets[0, 1] >= dets[1, 1]
+
+    def test_psroi_pool_constant(self):
+        from paddle_tpu.vision import ops as V
+        feat = np.ones((1, 8, 8, 8), "float32") * 3.0
+        out = V.psroi_pool(paddle.to_tensor(feat),
+                           paddle.to_tensor(np.array([[0., 0., 7., 7.]],
+                                                     "float32")),
+                           paddle.to_tensor(np.array([1])), 2)
+        np.testing.assert_allclose(out.numpy(), 3.0)
+
+    def test_datasets_and_read_file(self, tmp_path):
+        from paddle_tpu.vision import ops as V
+        from paddle_tpu.vision.datasets import Flowers, VOC2012
+        im, lab = Flowers()[0]
+        assert im.shape == (32, 32, 3) and 0 <= int(lab) < 102
+        im, mask = VOC2012(mode="valid")[0]
+        assert mask.shape == (32, 32)
+        f = tmp_path / "b.bin"
+        f.write_bytes(b"\x01\x02")
+        np.testing.assert_array_equal(V.read_file(str(f)).numpy(), [1, 2])
+
+    def test_incubate_fused(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        from paddle_tpu.incubate.nn import (FusedDropoutAdd,
+                                            FusedMultiTransformer)
+        a = paddle.to_tensor(np.random.randn(3, 4).astype("float32"))
+        w = paddle.to_tensor(np.random.randn(4, 5).astype("float32"))
+        b = paddle.to_tensor(np.random.randn(5).astype("float32"))
+        np.testing.assert_allclose(
+            IF.fused_matmul_bias(a, w, b).numpy(),
+            a.numpy() @ w.numpy() + b.numpy(), rtol=1e-5)
+        x3 = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        res = paddle.to_tensor(np.random.randn(2, 4, 8).astype("float32"))
+        ln = IF.fused_bias_dropout_residual_layer_norm(x3, res,
+                                                       dropout_rate=0.0)
+        np.testing.assert_allclose(ln.numpy().mean(-1), 0, atol=1e-5)
+        o = FusedMultiTransformer(16, 2, 32, num_layers=1)(
+            paddle.to_tensor(np.random.randn(2, 5, 16).astype("float32")))
+        assert list(o.shape) == [2, 5, 16]
+        np.testing.assert_allclose(
+            FusedDropoutAdd(p=0.0)(x3, res).numpy(),
+            x3.numpy() + res.numpy(), rtol=1e-5)
+
+    def test_fleet_surface(self):
+        from paddle_tpu.distributed import fleet
+        rm = fleet.PaddleCloudRoleMaker()
+        assert rm.is_worker() and not rm.is_server()
+        assert fleet.UtilBase().get_file_shard(["a", "b"]) == ["a", "b"]
+
+        class Gen(fleet.MultiSlotDataGenerator):
+            def generate_sample(self, line):
+                def it():
+                    yield [("s", [float(line)])]
+                return it
+
+        assert Gen().run_from_memory(["3"]) == [[("s", [3.0])]]
+
+    def test_shims(self):
+        paddle.utils.require_version("0.0.1")
+        assert paddle.device.get_cudnn_version() is None
+        assert paddle.profiler.SummaryView.KernelView == 4
+        init = paddle.nn.initializer.Bilinear()
+        w = init([2, 2, 4, 4])
+        assert w.shape == (2, 2, 4, 4)
+
+
+class TestSecondaryReviewFixes:
+    def test_psroi_channel_major(self):
+        from paddle_tpu.vision import ops as V
+        # channel c, bin k reads input channel c*ph*pw + k (R-FCN layout)
+        ph = pw = 2
+        out_c = 2
+        C = out_c * ph * pw
+        feat = np.zeros((1, C, 4, 4), "float32")
+        for ch in range(C):
+            feat[0, ch] = ch
+        out = V.psroi_pool(paddle.to_tensor(feat),
+                           paddle.to_tensor(np.array([[0., 0., 3., 3.]],
+                                                     "float32")),
+                           paddle.to_tensor(np.array([1])), 2)
+        o = out.numpy()[0]
+        for c in range(out_c):
+            for i in range(ph):
+                for j in range(pw):
+                    assert o[c, i, j] == c * ph * pw + i * pw + j
+
+    def test_saved_tensors_hooks_after_exit(self):
+        from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+        unpacked = []
+
+        class Double(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * 2
+
+            @staticmethod
+            def backward(ctx, g):
+                (x,) = ctx.saved_tensor
+                return g * 2
+
+        with saved_tensors_hooks(lambda t: ("packed", t),
+                                 lambda p: (unpacked.append(1), p[1])[1]):
+            x = paddle.to_tensor(np.ones(2, "float32"),
+                                 stop_gradient=False)
+            y = Double.apply(x)
+        y.sum().backward()          # backward AFTER the with-block
+        assert unpacked and np.allclose(x.grad.numpy(), 2)
+
+    def test_box_coder_encode_any_prior_count(self):
+        from paddle_tpu.vision import ops as V
+        priors = np.array([[0., 0., 10., 10.], [5., 5., 15., 15.],
+                           [0., 0., 20., 20.]], "float32")
+        targets = np.array([[1., 1., 9., 9.]], "float32")
+        out = V.box_coder(paddle.to_tensor(priors), [0.1, 0.1, 0.2, 0.2],
+                          paddle.to_tensor(targets))
+        assert list(out.shape) == [1, 3, 4]
+        # manual check against prior 0: tc=5, pc=5, pw=10 -> dx = 0/0.1
+        np.testing.assert_allclose(out.numpy()[0, 0, 0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(out.numpy()[0, 0, 2],
+                                   np.log(0.8) / 0.2, rtol=1e-5)
+
+    def test_deprecated_level2_every_call(self):
+        @paddle.utils.deprecated(level=2)
+        def gone():
+            return 1
+
+        for _ in range(2):
+            with pytest.raises(RuntimeError):
+                gone()
+
+    def test_spectral_norm_zero_iters(self):
+        lin = nn.Linear(4, 4)
+        nn.utils.spectral_norm(lin, "weight", n_power_iterations=0)
+        out = lin(paddle.to_tensor(np.random.randn(2, 4).astype("float32")))
+        assert np.isfinite(out.numpy()).all()
+
+    def test_fused_mt_num_heads_respected(self):
+        from paddle_tpu.incubate.nn import FusedMultiTransformer
+        with pytest.raises(ValueError):
+            m = FusedMultiTransformer(18, 4, 32, num_layers=1)
+            m(paddle.to_tensor(np.random.randn(1, 3, 18).astype("float32")))
+        m = FusedMultiTransformer(16, 2, 32, num_layers=1,
+                                  normalize_before=False)
+        o = m(paddle.to_tensor(np.random.randn(1, 3, 16).astype("float32")))
+        # post-LN: output is layer-normalized
+        np.testing.assert_allclose(o.numpy().mean(-1), 0, atol=1e-4)
