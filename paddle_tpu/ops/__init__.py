@@ -157,3 +157,44 @@ for _n in _TENSOR_METHOD_SAFE:
     if not hasattr(Tensor, _n):
         setattr(Tensor, _n, getattr(compat, _n))
 del _n
+
+
+# Tensor-method parity stragglers (reference tensor/__init__.py
+# tensor_method_func): a few names are module-level factories/predicates
+# the reference ALSO binds as methods, plus inplace variants whose bases
+# live outside the compat generator's search set.
+def _bind_method_stragglers():
+    from ..tensor import is_tensor as _is_tensor
+    from .compat import _make_inplace
+
+    if not hasattr(Tensor, "is_tensor"):
+        Tensor.is_tensor = lambda self: _is_tensor(self)
+    if "create_tensor" not in globals():
+        def _create_tensor(dtype="float32", *a, **k):
+            from .creation import zeros
+            return zeros([0], dtype=dtype)
+        globals()["create_tensor"] = _create_tensor
+        __all__.append("create_tensor")
+    # factories bind as STATIC methods (self must not become `shape`)
+    _static = {"broadcast_shape", "create_tensor", "create_parameter"}
+    for fact in ("broadcast_shape", "create_tensor", "scatter_nd", "polar",
+                 "is_empty", "create_parameter"):
+        fn = globals().get(fact) or getattr(compat, fact, None)
+        if fn is not None and not hasattr(Tensor, fact):
+            setattr(Tensor, fact,
+                    staticmethod(fn) if fact in _static else fn)
+    for base_name in ("erfinv", "lerp", "reciprocal", "put_along_axis"):
+        base = globals().get(base_name)
+        if base is None:
+            continue
+        nm = base_name + "_"
+        if nm not in globals():
+            op_ = _make_inplace(base, nm)
+            globals()[nm] = op_
+            __all__.append(nm)
+        if not hasattr(Tensor, nm):
+            setattr(Tensor, nm, globals()[nm])
+
+
+_bind_method_stragglers()
+del _bind_method_stragglers

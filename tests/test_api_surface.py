@@ -660,3 +660,42 @@ class TestSecondaryReviewFixes:
         o = m(paddle.to_tensor(np.random.randn(1, 3, 16).astype("float32")))
         # post-LN: output is layer-normalized
         np.testing.assert_allclose(o.numpy().mean(-1), 0, atol=1e-4)
+
+
+def test_tensor_method_parity_vs_reference():
+    """Every name in the reference's tensor_method_func and
+    magic_method_func lists resolves on this Tensor."""
+    import ast
+    import os
+
+    path = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.isfile(path):
+        pytest.skip("reference checkout not present")
+    tree = ast.parse(open(path, errors="ignore").read())
+    names = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in (
+                        "tensor_method_func", "magic_method_func"):
+                    names += [e.value for e in node.value.elts
+                              if isinstance(e, ast.Constant)]
+    missing = sorted(n for n in set(names)
+                     if not hasattr(paddle.Tensor, n))
+    assert not missing, missing
+
+
+def test_inplace_stragglers_work():
+    y = paddle.to_tensor(np.array([0.0, 1.0], "float32"))
+    y.lerp_(paddle.to_tensor(np.array([1.0, 2.0], "float32")), 0.5)
+    np.testing.assert_allclose(y.numpy(), [0.5, 1.5])
+    z = paddle.to_tensor(np.array([2.0, 4.0], "float32"))
+    z.reciprocal_()
+    np.testing.assert_allclose(z.numpy(), [0.5, 0.25])
+    assert paddle.to_tensor(np.zeros(2)).is_tensor()
+
+
+def test_create_parameter_method_is_static():
+    t = paddle.to_tensor(np.zeros(2, "float32"))
+    p = t.create_parameter([2, 3], "float32")
+    assert list(p.shape) == [2, 3] and p.trainable
