@@ -160,12 +160,12 @@ del _n
 
 
 # Tensor-method parity stragglers (reference tensor/__init__.py
-# tensor_method_func): a few names are module-level factories/predicates
-# the reference ALSO binds as methods, plus inplace variants whose bases
-# live outside the compat generator's search set.
+# tensor_method_func): names that are module-level factories/predicates
+# the reference ALSO binds as methods.  The erfinv_/lerp_/reciprocal_/
+# put_along_axis_ inplace family is generated by compat's _INPLACE_BASES
+# like every other op_.
 def _bind_method_stragglers():
     from ..tensor import is_tensor as _is_tensor
-    from .compat import _make_inplace
 
     if not hasattr(Tensor, "is_tensor"):
         Tensor.is_tensor = lambda self: _is_tensor(self)
@@ -183,17 +183,6 @@ def _bind_method_stragglers():
         if fn is not None and not hasattr(Tensor, fact):
             setattr(Tensor, fact,
                     staticmethod(fn) if fact in _static else fn)
-    for base_name in ("erfinv", "lerp", "reciprocal", "put_along_axis"):
-        base = globals().get(base_name)
-        if base is None:
-            continue
-        nm = base_name + "_"
-        if nm not in globals():
-            op_ = _make_inplace(base, nm)
-            globals()[nm] = op_
-            __all__.append(nm)
-        if not hasattr(Tensor, nm):
-            setattr(Tensor, nm, globals()[nm])
 
 
 _bind_method_stragglers()

@@ -374,6 +374,7 @@ _INPLACE_BASES = [
     "mod", "multiply", "nan_to_num", "neg", "not_equal", "polygamma", "pow",
     "remainder", "renorm", "rsqrt", "sigmoid", "sin", "sinh", "sqrt",
     "square", "subtract", "tan", "tanh", "tril", "triu", "trunc",
+    "erfinv", "lerp", "reciprocal", "put_along_axis",
 ]
 
 _INPLACE = {}

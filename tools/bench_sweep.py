@@ -1,0 +1,151 @@
+"""One-shot chip tuning sweep (run manually when real TPU time is
+available; bench.py stays the driver's single-line benchmark).
+
+Usage:  python tools/bench_sweep.py [llama|dit|moe|all]
+
+Measures, on the real chip:
+  * llama: B x S grid around the headline shape (B2/S8192 was the round-3
+    62.1% MFU point) to re-find the MFU peak after code drift;
+  * dit:   fused-adaLN on/off x head layouts (9x128 vs 16x72) x batch;
+  * moe:   scatter vs einsum dispatch x token counts (8k/16k/32k).
+
+Prints one JSON line per point; nothing here is driver-consumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+STEPS = 8
+
+
+def _timed(st, params, opt_state, batch, steps=STEPS):
+    params, opt_state, m = st.step(params, opt_state, batch)
+    float(m["loss"])                       # force completion (axon-safe)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, m = st.step(params, opt_state, batch)
+    final = float(m["loss"])
+    return time.perf_counter() - t0, final
+
+
+def _emit(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def sweep_llama():
+    from paddle_tpu.models import llama
+    from paddle_tpu.models.llama import LlamaConfig
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.distributed.parallelize import ShardedTrainState
+    from paddle_tpu.optimizer.functional import AdamW
+
+    # the bench.py headline config (697M; r3 peak 62.1% MFU at B2/S8192)
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=12, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=16384, dtype=jnp.bfloat16, remat=True)
+    mesh = mesh_lib.make_mesh(data=1)
+    for B, S in ((2, 8192), (4, 4096), (2, 4096), (1, 16384), (4, 8192)):
+        try:
+            st = ShardedTrainState(
+                dataclasses.replace(cfg, max_position_embeddings=max(S, 8192)),
+                llama, mesh, AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
+            params, opt = st.init(jax.random.PRNGKey(0))
+            toks = np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (B, S + 1))
+            batch = st.shard_batch(llama.lm_batch_from_tokens(
+                jnp.asarray(toks, jnp.int32)))
+            dt, loss = _timed(st, params, opt, batch)
+            _emit(kind="llama", B=B, S=S,
+                  tok_s=round(B * S * STEPS / dt, 1), loss=loss)
+        except Exception as e:  # noqa: BLE001 — OOMs expected at the edges
+            _emit(kind="llama", B=B, S=S, error=repr(e)[:160])
+
+
+def sweep_dit():
+    from paddle_tpu.models import dit
+    from paddle_tpu.models.dit import DiTConfig
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.distributed.parallelize import ShardedTrainState
+    from paddle_tpu.optimizer.functional import AdamW
+
+    mesh = mesh_lib.make_mesh(data=1)
+    for heads, fused, B in ((9, False, 128), (9, True, 128),
+                            (16, False, 128), (16, True, 128),
+                            (9, True, 256)):
+        try:
+            cfg = dataclasses.replace(DiTConfig.XL_2(), num_heads=heads,
+                                      fused_adaln=fused)
+            st = ShardedTrainState(cfg, dit, mesh,
+                                   AdamW(learning_rate=1e-4,
+                                         grad_clip_norm=1.0))
+            params, opt = st.init(jax.random.PRNGKey(0))
+            rng = np.random.default_rng(0)
+            imgs = jnp.asarray(rng.standard_normal(
+                (B, cfg.in_channels, cfg.image_size, cfg.image_size)),
+                jnp.float32)
+            labs = jnp.asarray(rng.integers(0, cfg.num_classes, (B,)),
+                               jnp.int32)
+            batch = st.shard_batch(dit.dit_batch(
+                imgs, labs, jax.random.PRNGKey(1), cfg))
+            dt, loss = _timed(st, params, opt, batch)
+            _emit(kind="dit", heads=heads, fused_adaln=fused, B=B,
+                  img_s=round(B * STEPS / dt, 2), loss=loss)
+        except Exception as e:  # noqa: BLE001
+            _emit(kind="dit", heads=heads, fused_adaln=fused, B=B,
+                  error=repr(e)[:160])
+
+
+def sweep_moe():
+    from paddle_tpu.models import llama, moe_llama
+    from paddle_tpu.models.moe_llama import MoELlamaConfig
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.distributed.parallelize import ShardedTrainState
+    from paddle_tpu.optimizer.functional import AdamW
+
+    mesh = mesh_lib.make_mesh(data=1)
+    base = MoELlamaConfig(
+        vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
+        max_position_embeddings=16384, dtype=jnp.bfloat16, remat=True,
+        num_experts=8, moe_top_k=2)
+    for disp, B, S in (("scatter", 2, 8192), ("einsum", 2, 4096),
+                       ("scatter", 2, 16384), ("scatter", 4, 8192)):
+        try:
+            cfg = dataclasses.replace(base, moe_dispatch=disp)
+            st = ShardedTrainState(cfg, moe_llama, mesh,
+                                   AdamW(learning_rate=1e-4,
+                                         grad_clip_norm=1.0))
+            params, opt = st.init(jax.random.PRNGKey(0))
+            toks = np.random.default_rng(0).integers(
+                0, cfg.vocab_size, (B, S + 1))
+            batch = st.shard_batch(llama.lm_batch_from_tokens(
+                jnp.asarray(toks, jnp.int32)))
+            dt, loss = _timed(st, params, opt, batch)
+            tok_s = B * S * STEPS / dt
+            mfu_flops = moe_llama.flops_per_token(cfg, S) * tok_s
+            _emit(kind="moe", dispatch=disp, B=B, S=S,
+                  tok_s=round(tok_s, 1),
+                  mfu_v5e=round(mfu_flops / 197e12, 4), loss=loss)
+        except Exception as e:  # noqa: BLE001
+            _emit(kind="moe", dispatch=disp, B=B, S=S,
+                  error=repr(e)[:160])
+
+
+if __name__ == "__main__":
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which in ("llama", "all"):
+        sweep_llama()
+    if which in ("dit", "all"):
+        sweep_dit()
+    if which in ("moe", "all"):
+        sweep_moe()
