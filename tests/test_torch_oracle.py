@@ -1,0 +1,207 @@
+"""Independent-oracle parity: round-5 ops vs torch (CPU).  The reference's
+kernels match torch semantics for these ops, so torch is a reference-
+equivalent oracle that shares no code with this repo."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu import nn
+
+torch = pytest.importorskip("torch")
+
+
+def _t(a):
+    return torch.from_numpy(np.asarray(a))
+
+
+class TestRnnCellsVsTorch:
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(8, 6)
+        tcell = torch.nn.LSTMCell(8, 6)
+        with torch.no_grad():
+            tcell.weight_ih.copy_(_t(cell.weight_ih.numpy()))
+            tcell.weight_hh.copy_(_t(cell.weight_hh.numpy()))
+            tcell.bias_ih.copy_(_t(cell.bias_ih.numpy()))
+            tcell.bias_hh.copy_(_t(cell.bias_hh.numpy()))
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        c0 = np.random.randn(4, 6).astype("float32")
+        _, (h, c) = cell(paddle.to_tensor(x),
+                         (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+        th, tc = tcell(_t(x), (_t(h0), _t(c0)))
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_gru_cell(self):
+        """paddle/torch GRU differ ONLY in where b_hh enters the candidate:
+        both compute c = tanh(x_c + r * (h W_c^T + b_hc)) — identical when
+        weights are shared, so torch oracles the repo's gate math."""
+        cell = nn.GRUCell(8, 6)
+        tcell = torch.nn.GRUCell(8, 6)
+        with torch.no_grad():
+            tcell.weight_ih.copy_(_t(cell.weight_ih.numpy()))
+            tcell.weight_hh.copy_(_t(cell.weight_hh.numpy()))
+            tcell.bias_ih.copy_(_t(cell.bias_ih.numpy()))
+            tcell.bias_hh.copy_(_t(cell.bias_hh.numpy()))
+        x = np.random.randn(4, 8).astype("float32")
+        h0 = np.random.randn(4, 6).astype("float32")
+        h, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+        th = tcell(_t(x), _t(h0))
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_lstm_sequence(self):
+        net = nn.LSTM(5, 4)
+        tnet = torch.nn.LSTM(5, 4, batch_first=True)
+        cell = net[0].cell
+        with torch.no_grad():
+            tnet.weight_ih_l0.copy_(_t(cell.weight_ih.numpy()))
+            tnet.weight_hh_l0.copy_(_t(cell.weight_hh.numpy()))
+            tnet.bias_ih_l0.copy_(_t(cell.bias_ih.numpy()))
+            tnet.bias_hh_l0.copy_(_t(cell.bias_hh.numpy()))
+        x = np.random.randn(3, 7, 5).astype("float32")
+        out, (h, c) = net(paddle.to_tensor(x))
+        tout, (th, tc) = tnet(_t(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestOpsVsTorch:
+    def test_max_unpool2d(self):
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        tp, tidx = torch.nn.functional.max_pool2d(_t(x), 2,
+                                                  return_indices=True)
+        up = F.max_unpool2d(paddle.to_tensor(tp.numpy()),
+                            paddle.to_tensor(tidx.numpy()), 2,
+                            output_size=[8, 8])
+        tup = torch.nn.functional.max_unpool2d(tp, tidx, 2)
+        np.testing.assert_allclose(up.numpy(), tup.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_adaptive_avg_pool3d(self):
+        x = np.random.randn(2, 3, 7, 9, 5).astype("float32")
+        ours = F.adaptive_avg_pool3d(paddle.to_tensor(x), (2, 3, 2))
+        ref = torch.nn.functional.adaptive_avg_pool3d(_t(x), (2, 3, 2))
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_cdist(self):
+        x = np.random.randn(2, 5, 4).astype("float32")
+        y = np.random.randn(2, 7, 4).astype("float32")
+        for p in (1.0, 2.0, 3.0):
+            ours = paddle.cdist(paddle.to_tensor(x), paddle.to_tensor(y),
+                                p=p)
+            ref = torch.cdist(_t(x), _t(y), p=p)
+            np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_diag_embed_offsets(self):
+        x = np.random.randn(2, 3, 4).astype("float32")
+        for off in (-2, -1, 0, 1, 2):
+            ours = F.diag_embed(paddle.to_tensor(x), offset=off)
+            ref = torch.diag_embed(_t(x), offset=off)
+            np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_renorm(self):
+        x = np.random.randn(4, 6).astype("float32") * 3
+        ours = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0)
+        ref = torch.renorm(_t(x), 2.0, 0, 1.0)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_unfold(self):
+        x = np.random.randn(3, 10).astype("float32")
+        ours = paddle.unfold(paddle.to_tensor(x), 1, 4, 2)
+        ref = _t(x).unfold(1, 4, 2)
+        np.testing.assert_allclose(ours.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_i0e_i1e(self):
+        x = np.random.randn(16).astype("float32") * 3
+        np.testing.assert_allclose(
+            paddle.i0e(paddle.to_tensor(x)).numpy(),
+            torch.special.i0e(_t(x)).numpy(), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.i1e(paddle.to_tensor(x)).numpy(),
+            torch.special.i1e(_t(x)).numpy(), rtol=1e-5, atol=1e-6)
+
+
+class TestLossesVsTorch:
+    def test_soft_margin(self):
+        x = np.random.randn(4, 6).astype("float32")
+        y = np.sign(np.random.randn(4, 6)).astype("float32")
+        np.testing.assert_allclose(
+            F.soft_margin_loss(paddle.to_tensor(x),
+                               paddle.to_tensor(y)).numpy(),
+            torch.nn.functional.soft_margin_loss(_t(x), _t(y)).numpy(),
+            rtol=1e-5, atol=1e-6)
+
+    def test_multi_margin(self):
+        x = np.random.randn(5, 7).astype("float32")
+        y = np.random.randint(0, 7, 5)
+        for p in (1, 2):
+            np.testing.assert_allclose(
+                F.multi_margin_loss(paddle.to_tensor(x),
+                                    paddle.to_tensor(y), p=p).numpy(),
+                torch.nn.functional.multi_margin_loss(_t(x), _t(y),
+                                                      p=p).numpy(),
+                rtol=1e-5, atol=1e-6)
+
+    def test_multi_label_soft_margin(self):
+        x = np.random.randn(4, 6).astype("float32")
+        y = (np.random.rand(4, 6) > 0.5).astype("float32")
+        np.testing.assert_allclose(
+            F.multi_label_soft_margin_loss(paddle.to_tensor(x),
+                                           paddle.to_tensor(y)).numpy(),
+            torch.nn.functional.multilabel_soft_margin_loss(
+                _t(x), _t(y)).numpy(), rtol=1e-5, atol=1e-6)
+
+    def test_gaussian_nll(self):
+        x = np.random.randn(8).astype("float32")
+        y = np.random.randn(8).astype("float32")
+        v = (np.abs(np.random.randn(8)) + 0.3).astype("float32")
+        for full in (False, True):
+            np.testing.assert_allclose(
+                F.gaussian_nll_loss(paddle.to_tensor(x),
+                                    paddle.to_tensor(y),
+                                    paddle.to_tensor(v),
+                                    full=full).numpy(),
+                torch.nn.functional.gaussian_nll_loss(
+                    _t(x), _t(y), _t(v), full=full).numpy(),
+                rtol=1e-5, atol=1e-6)
+
+    def test_triplet_margin_with_distance(self):
+        a = np.random.randn(5, 8).astype("float32")
+        p = np.random.randn(5, 8).astype("float32")
+        n = np.random.randn(5, 8).astype("float32")
+        for swap in (False, True):
+            np.testing.assert_allclose(
+                F.triplet_margin_with_distance_loss(
+                    paddle.to_tensor(a), paddle.to_tensor(p),
+                    paddle.to_tensor(n), swap=swap).numpy(),
+                torch.nn.functional.triplet_margin_loss(
+                    _t(a), _t(p), _t(n), swap=swap).numpy(),
+                rtol=1e-4, atol=1e-5)
+
+    def test_clip_grad_norm_matches_torch(self):
+        w = np.random.randn(6).astype("float32")
+        g = np.random.randn(6).astype("float32") * 5
+
+        p = paddle.to_tensor(w.copy(), stop_gradient=False)
+        (p * paddle.to_tensor(g)).sum().backward()
+        total = nn.utils.clip_grad_norm_([p], 1.0)
+
+        tp = torch.tensor(w, requires_grad=True)
+        (tp * _t(g)).sum().backward()
+        ttotal = torch.nn.utils.clip_grad_norm_([tp], 1.0)
+        np.testing.assert_allclose(float(total.numpy()), float(ttotal),
+                                   rtol=1e-4)
+        np.testing.assert_allclose(p.grad.numpy(), tp.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)

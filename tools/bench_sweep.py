@@ -16,25 +16,29 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# reuse bench.py's timing loop (the float(loss) axon-completion workaround
+# lives there) and its per-chip peak-FLOPs table; importing bench runs its
+# backend probe once, which is exactly right for a manual chip session
+import bench  # noqa: E402
 
 STEPS = 8
 
 
 def _timed(st, params, opt_state, batch, steps=STEPS):
-    params, opt_state, m = st.step(params, opt_state, batch)
-    float(m["loss"])                       # force completion (axon-safe)
-    t0 = time.perf_counter()
-    for _ in range(steps):
-        params, opt_state, m = st.step(params, opt_state, batch)
-    final = float(m["loss"])
-    return time.perf_counter() - t0, final
+    assert steps == STEPS  # every throughput formula below assumes STEPS
+    return bench._timed_steps(st, params, opt_state, batch, steps)
+
+
+def _peak():
+    return bench._peak_flops(jax.devices()[0]) or 197e12
 
 
 def _emit(**kw):
@@ -65,8 +69,10 @@ def sweep_llama():
             batch = st.shard_batch(llama.lm_batch_from_tokens(
                 jnp.asarray(toks, jnp.int32)))
             dt, loss = _timed(st, params, opt, batch)
-            _emit(kind="llama", B=B, S=S,
-                  tok_s=round(B * S * STEPS / dt, 1), loss=loss)
+            tok_s = B * S * STEPS / dt
+            _emit(kind="llama", B=B, S=S, tok_s=round(tok_s, 1),
+                  mfu=round(llama.flops_per_token(cfg, S) * tok_s
+                            / _peak(), 4), loss=loss)
         except Exception as e:  # noqa: BLE001 — OOMs expected at the edges
             _emit(kind="llama", B=B, S=S, error=repr(e)[:160])
 
@@ -118,7 +124,10 @@ def sweep_moe():
         num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
         max_position_embeddings=16384, dtype=jnp.bfloat16, remat=True,
         num_experts=8, moe_top_k=2)
-    for disp, B, S in (("scatter", 2, 8192), ("einsum", 2, 4096),
+    # scatter and einsum at MATCHING shapes so dispatch cost separates
+    # from shape cost; einsum beyond 8k tokens OOMs (that is the point)
+    for disp, B, S in (("einsum", 2, 4096), ("scatter", 2, 4096),
+                       ("einsum", 2, 8192), ("scatter", 2, 8192),
                        ("scatter", 2, 16384), ("scatter", 4, 8192)):
         try:
             cfg = dataclasses.replace(base, moe_dispatch=disp)
@@ -135,7 +144,7 @@ def sweep_moe():
             mfu_flops = moe_llama.flops_per_token(cfg, S) * tok_s
             _emit(kind="moe", dispatch=disp, B=B, S=S,
                   tok_s=round(tok_s, 1),
-                  mfu_v5e=round(mfu_flops / 197e12, 4), loss=loss)
+                  mfu=round(mfu_flops / _peak(), 4), loss=loss)
         except Exception as e:  # noqa: BLE001
             _emit(kind="moe", dispatch=disp, B=B, S=S,
                   error=repr(e)[:160])
@@ -143,6 +152,9 @@ def sweep_moe():
 
 if __name__ == "__main__":
     which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    if which not in ("llama", "dit", "moe", "all"):
+        sys.exit(f"usage: python tools/bench_sweep.py [llama|dit|moe|all] "
+                 f"(got {which!r})")
     if which in ("llama", "all"):
         sweep_llama()
     if which in ("dit", "all"):
