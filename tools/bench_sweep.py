@@ -32,9 +32,9 @@ import bench  # noqa: E402
 STEPS = 8
 
 
-def _timed(st, params, opt_state, batch, steps=STEPS):
-    assert steps == STEPS  # every throughput formula below assumes STEPS
-    return bench._timed_steps(st, params, opt_state, batch, steps)
+def _timed(st, params, opt_state, batch):
+    # fixed STEPS: every throughput formula below assumes it
+    return bench._timed_steps(st, params, opt_state, batch, STEPS)
 
 
 def _peak():
