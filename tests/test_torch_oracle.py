@@ -16,15 +16,20 @@ def _t(a):
     return torch.from_numpy(np.asarray(a))
 
 
+def _copy_cell(tmod, cell, sfx=""):
+    """Copy a paddle cell's 4 packed params onto a torch RNN module."""
+    with torch.no_grad():
+        getattr(tmod, f"weight_ih{sfx}").copy_(_t(cell.weight_ih.numpy()))
+        getattr(tmod, f"weight_hh{sfx}").copy_(_t(cell.weight_hh.numpy()))
+        getattr(tmod, f"bias_ih{sfx}").copy_(_t(cell.bias_ih.numpy()))
+        getattr(tmod, f"bias_hh{sfx}").copy_(_t(cell.bias_hh.numpy()))
+
+
 class TestRnnCellsVsTorch:
     def test_lstm_cell(self):
         cell = nn.LSTMCell(8, 6)
         tcell = torch.nn.LSTMCell(8, 6)
-        with torch.no_grad():
-            tcell.weight_ih.copy_(_t(cell.weight_ih.numpy()))
-            tcell.weight_hh.copy_(_t(cell.weight_hh.numpy()))
-            tcell.bias_ih.copy_(_t(cell.bias_ih.numpy()))
-            tcell.bias_hh.copy_(_t(cell.bias_hh.numpy()))
+        _copy_cell(tcell, cell)
         x = np.random.randn(4, 8).astype("float32")
         h0 = np.random.randn(4, 6).astype("float32")
         c0 = np.random.randn(4, 6).astype("float32")
@@ -42,11 +47,7 @@ class TestRnnCellsVsTorch:
         weights are shared, so torch oracles the repo's gate math."""
         cell = nn.GRUCell(8, 6)
         tcell = torch.nn.GRUCell(8, 6)
-        with torch.no_grad():
-            tcell.weight_ih.copy_(_t(cell.weight_ih.numpy()))
-            tcell.weight_hh.copy_(_t(cell.weight_hh.numpy()))
-            tcell.bias_ih.copy_(_t(cell.bias_ih.numpy()))
-            tcell.bias_hh.copy_(_t(cell.bias_hh.numpy()))
+        _copy_cell(tcell, cell)
         x = np.random.randn(4, 8).astype("float32")
         h0 = np.random.randn(4, 6).astype("float32")
         h, _ = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
@@ -57,12 +58,7 @@ class TestRnnCellsVsTorch:
     def test_lstm_sequence(self):
         net = nn.LSTM(5, 4)
         tnet = torch.nn.LSTM(5, 4, batch_first=True)
-        cell = net[0].cell
-        with torch.no_grad():
-            tnet.weight_ih_l0.copy_(_t(cell.weight_ih.numpy()))
-            tnet.weight_hh_l0.copy_(_t(cell.weight_hh.numpy()))
-            tnet.bias_ih_l0.copy_(_t(cell.bias_ih.numpy()))
-            tnet.bias_hh_l0.copy_(_t(cell.bias_hh.numpy()))
+        _copy_cell(tnet, net[0].cell, "_l0")
         x = np.random.randn(3, 7, 5).astype("float32")
         out, (h, c) = net(paddle.to_tensor(x))
         tout, (th, tc) = tnet(_t(x))
@@ -204,4 +200,41 @@ class TestLossesVsTorch:
         np.testing.assert_allclose(float(total.numpy()), float(ttotal),
                                    rtol=1e-4)
         np.testing.assert_allclose(p.grad.numpy(), tp.grad.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestStackedRnnVsTorch:
+    def test_bidirectional_two_layer_lstm(self):
+        """Pins output values AND the (num_layers*dirs, B, H) state packing
+        order against torch (paddle uses the same convention)."""
+        net = nn.LSTM(5, 4, num_layers=2, direction="bidirect")
+        tnet = torch.nn.LSTM(5, 4, num_layers=2, bidirectional=True,
+                             batch_first=True)
+        # copy weights: paddle layer l holds BiRNN(cell_fw, cell_bw)
+        for layer in range(2):
+            bi = net[layer]
+            for d, cell in ((0, bi.cell_fw), (1, bi.cell_bw)):
+                _copy_cell(tnet, cell,
+                           f"_l{layer}" + ("_reverse" if d else ""))
+        x = np.random.randn(3, 6, 5).astype("float32")
+        out, (h, c) = net(paddle.to_tensor(x))
+        tout, (th, tc) = tnet(_t(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(c.numpy(), tc.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_two_layer_gru(self):
+        net = nn.GRU(5, 4, num_layers=2)
+        tnet = torch.nn.GRU(5, 4, num_layers=2, batch_first=True)
+        for layer in range(2):
+            _copy_cell(tnet, net[layer].cell, f"_l{layer}")
+        x = np.random.randn(2, 7, 5).astype("float32")
+        out, h = net(paddle.to_tensor(x))
+        tout, th = tnet(_t(x))
+        np.testing.assert_allclose(out.numpy(), tout.detach().numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
                                    rtol=1e-4, atol=1e-5)
