@@ -102,8 +102,15 @@ def bench_dit(dev, on_tpu):
         # head layout: 9 heads x 128 = 1152 (head_dim 128 rides the Pallas
         # flash kernel + MXU tiling; 16x72 measured 44.0% MFU, 9x128 45.9%).
         # Full remat: measured B=32..64 without remat OOM 16G HBM.
-        cfg = dataclasses.replace(DiTConfig.XL_2(), num_heads=9)
-        B, steps = 128, 10
+        # attn_impl="xla": at N=256 tokens the (B,H,N,N) scores are small
+        # and XLA's fused softmax beats the flash kernel's grid overhead —
+        # chip A/B measured 138.4 img/s (xla) vs 134.4 (flash); fused_qkv
+        # measured SLOWER (125/116) — the per-layer weight concat isn't free.
+        cfg = dataclasses.replace(DiTConfig.XL_2(), num_heads=9,
+                                  attn_impl="xla")
+        # B sweep on chip: 128 -> 138.4 img/s, 160 -> 139.0 (50.2% MFU),
+        # 192 -> 134.2, 224 OOM
+        B, steps = 160, 10
     else:
         cfg = DiTConfig.tiny()
         B, steps = 4, 3
@@ -174,11 +181,15 @@ def bench_moe(dev, on_tpu):
     from paddle_tpu.optimizer.functional import AdamW
 
     if on_tpu:
-        # Mixtral-style 8-expert top-2 slice (~640M params incl. experts)
+        # Mixtral-style 8-expert top-2 slice (~640M params incl. experts).
+        # Head layout 8x128 (not 16x64): same H*D, but head_dim 128 rides
+        # the flash kernel's lane tile natively — chip A/B measured 40.4k
+        # tok/s / 40.6% MFU vs 31.8k / 32.1% for 16x64 (whose D=64 pays the
+        # pad-to-128 attention overhead).
         cfg = MoELlamaConfig(
             vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-            num_hidden_layers=8, num_attention_heads=16,
-            num_key_value_heads=8, max_position_embeddings=8192,
+            num_hidden_layers=8, num_attention_heads=8,
+            num_key_value_heads=4, max_position_embeddings=8192,
             dtype=jnp.bfloat16, remat=True, num_experts=8, moe_top_k=2,
             moe_dispatch="scatter")
         # scatter dispatch (no (N,X,C) one-hot tensors) lifts the round-4

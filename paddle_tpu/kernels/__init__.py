@@ -119,9 +119,9 @@ def attention(q, k, v, mask=None, causal=False, scale=None):
     if (
         _use_pallas()
         and mask is None
-        and q.shape[-1] % 128 == 0
+        and q.shape[-1] >= 64
+        and q.shape[1] == k.shape[1]  # flash folds (B,S,H,D)->(B*H,S,D)
         and q.shape[1] % 128 == 0
-        and k.shape[1] % 128 == 0
     ):
         from .pallas_attention import flash_attention_pallas  # fail loudly if broken
 

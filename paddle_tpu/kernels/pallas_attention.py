@@ -21,8 +21,11 @@ directly in its VMEM accumulator.
 
 Layout contract: (B, S, H, D) — the paddle flash_attention layout
 (python/paddle/nn/functional/flash_attention.py:125 in the reference).
-Pallas path needs S % 128 == 0 and D % 128 == 0; anything else takes the
-XLA fallback (still GQA-grouped, no repeat).
+Pallas path needs S % 128 == 0 and D % 128 == 0.  64 <= D < 128 (GQA
+slices, small hidden sizes) zero-pads D to the 128-lane tile and still rides
+the tiled kernels — the XLA fallback would materialize the (S,S) scores,
+which at S=8k is 8 GB.  Anything else takes the XLA fallback (still
+GQA-grouped, no repeat).
 """
 
 from __future__ import annotations
@@ -389,6 +392,19 @@ def flash_attention_pallas(q, k, v, causal=True, scale=None):
     rep = Hq // Hkv
     if scale is None:
         scale = 1.0 / (D ** 0.5)
+    if D % 128 != 0 and D >= 64 and S % 128 == 0:
+        # Sub-tile head dims (64/96 are common: GQA slices, small hidden
+        # sizes) ride the flash kernel by zero-padding D to the 128-lane
+        # tile: padded q.k columns add 0 to every logit and padded v columns
+        # yield all-zero output channels, sliced off below.  The softmax
+        # scale is already fixed to 1/sqrt(D_true).  Costs <=2x attention
+        # FLOPs but keeps O(S) memory — the XLA fallback materializes the
+        # (B,H,S,S) score matrix, which at S=8k is 8 GB and OOMs the chip.
+        pad = ((0, 0),) * 3 + ((0, (-D) % 128),)
+        out = flash_attention_pallas(
+            jnp.pad(q, pad), jnp.pad(k, pad), jnp.pad(v, pad),
+            causal=causal, scale=scale)
+        return out[..., :D]
     if D % 128 != 0 or S % 128 != 0:
         # lane-replication layout needs D,S multiples of 128; use the XLA path
         return _xla_attention(q, k, v, float(scale), bool(causal))

@@ -58,6 +58,10 @@ class DiTConfig:
     remat: bool = True
     scan_layers: bool = True
     fused_adaln: bool = False     # Pallas LN+modulate (bench A/Bs on chip)
+    attn_impl: str = "auto"       # "auto" (flash when aligned) | "xla":
+    #   at N=256 tokens the (B,H,N,N) score tensor is small and XLA's fused
+    #   softmax can beat the flash kernel's grid overhead — bench A/Bs on chip
+    fused_qkv: bool = False       # one (E,3E) matmul instead of three (E,E)
     mesh: Any = None              # threaded by ShardedTrainState
 
     @property
@@ -240,10 +244,23 @@ def _block(x, c_vec, bp, config: DiTConfig):
         h = kernels.adaln_modulate(x, sh1[:, 0], sc1[:, 0])
     else:
         h = _layernorm(x).astype(dt) * (1 + sc1) + sh1
-    q = (h @ bp["wq"] + bp["b_qkv"][0].astype(dt)).reshape(B, N, H, D)
-    k = (h @ bp["wk"] + bp["b_qkv"][1].astype(dt)).reshape(B, N, H, D)
-    v = (h @ bp["wv"] + bp["b_qkv"][2].astype(dt)).reshape(B, N, H, D)
-    a = kernels.attention(q, k, v, causal=False)            # (B, N, H, D)
+    if cfg.fused_qkv:
+        # one (E, 3E) matmul: XLA won't merge three separate-param matmuls,
+        # and the per-layer weight concat is trivial next to the token matmul
+        wqkv = jnp.concatenate([bp["wq"], bp["wk"], bp["wv"]], axis=-1)
+        qkv = h @ wqkv + bp["b_qkv"].reshape(-1).astype(dt)
+        q, k, v = [s.reshape(B, N, H, D) for s in jnp.split(qkv, 3, axis=-1)]
+    else:
+        q = (h @ bp["wq"] + bp["b_qkv"][0].astype(dt)).reshape(B, N, H, D)
+        k = (h @ bp["wk"] + bp["b_qkv"][1].astype(dt)).reshape(B, N, H, D)
+        v = (h @ bp["wv"] + bp["b_qkv"][2].astype(dt)).reshape(B, N, H, D)
+    if cfg.attn_impl == "xla":
+        a = kernels.attention_reference(q, k, v, causal=False)
+    elif cfg.attn_impl == "auto":
+        a = kernels.attention(q, k, v, causal=False)        # (B, N, H, D)
+    else:
+        raise ValueError(
+            f"attn_impl must be 'auto' or 'xla', got {cfg.attn_impl!r}")
     a = a.reshape(B, N, E) @ bp["wo"] + bp["b_o"].astype(dt)
     x = x + g1 * a
 

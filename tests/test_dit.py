@@ -61,6 +61,31 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                    rtol=2e-3, atol=2e-3)
 
+    def test_attn_impl_and_fused_qkv_match_baseline(self):
+        """The two bench A/B knobs are numerics-preserving: fused (E,3E)
+        qkv must reproduce the separate matmuls (pins b_qkv packing order),
+        and attn_impl='xla' must match the auto path."""
+        import dataclasses
+        params = dit.init_params(CFG, seed=1)
+        params["blocks"]["w_mod"] = (
+            jax.random.normal(jax.random.PRNGKey(2),
+                              params["blocks"]["w_mod"].shape) * 0.02)
+        params["final"]["w"] = (
+            jax.random.normal(jax.random.PRNGKey(3),
+                              params["final"]["w"].shape) * 0.02)
+        b = _batch(CFG)
+        base = dit.forward(params, b["images"], b["timesteps"], b["labels"],
+                           CFG)
+        for kw in ({"fused_qkv": True}, {"attn_impl": "xla"},
+                   {"fused_qkv": True, "attn_impl": "xla"}):
+            out = dit.forward(params, b["images"], b["timesteps"],
+                              b["labels"], dataclasses.replace(CFG, **kw))
+            np.testing.assert_allclose(np.asarray(out), np.asarray(base),
+                                       rtol=2e-5, atol=2e-5, err_msg=str(kw))
+        with pytest.raises(ValueError, match="attn_impl"):
+            dit.forward(params, b["images"], b["timesteps"], b["labels"],
+                        dataclasses.replace(CFG, attn_impl="pallas"))
+
     def test_schedule_monotone(self):
         ab = np.asarray(dit.alpha_bars(CFG))
         assert ab[0] == 1.0

@@ -140,6 +140,28 @@ class TestFlashAttentionPallasPath:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=2e-3)
 
+    @pytest.mark.parametrize("dpad", [64, 96])
+    def test_padded_head_dim_rides_flash(self, dpad):
+        """D=64/96 (sub-128-lane) zero-pads onto the tiled kernel instead of
+        falling back to the (S,S)-materializing XLA path: parity + O(S·D)
+        residuals.  Any user model with head_dim 64/96 takes this path; the
+        fallback at S=8k allocates an 8 GB score tensor and OOMs the chip."""
+        B, S, Hq, Hkv = 1, 256, 4, 2
+        rng = np.random.default_rng(7)
+        q = jnp.asarray(rng.standard_normal((B, S, Hq, dpad)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, S, Hkv, dpad)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, S, Hkv, dpad)), jnp.float32)
+        got = pallas_attention.flash_attention_pallas(q, k, v, causal=True)
+        want = kernels.attention_reference(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        _, f_vjp = jax.vjp(
+            lambda q, k, v: pallas_attention.flash_attention_pallas(
+                q, k, v, causal=True), q, k, v)
+        assert all(x.size <= S * 128 * Hq * B
+                   for x in jax.tree_util.tree_leaves(f_vjp)
+                   if hasattr(x, "size")), "padded path saved an (S,S) residual"
+
     def test_no_sxs_residual(self):
         """The backward's saved residuals are O(S·D): q,k,v,o + an O(S) lse —
         nothing of size (S,S)."""
