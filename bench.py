@@ -36,7 +36,8 @@ try:
     _PROBE_TIMEOUT = float(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
 except ValueError:
     _PROBE_TIMEOUT = 240.0
-_BACKEND = _probe_backend(_PROBE_TIMEOUT)
+# --sub children inherit the parent's probe result instead of re-probing
+_BACKEND = os.environ.get("BENCH_BACKEND") or _probe_backend(_PROBE_TIMEOUT)
 if _BACKEND != "tpu":
     # fall back to CPU before the first in-process jax import/device touch
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -121,21 +122,20 @@ def bench_dit(dev, on_tpu):
         (B, cfg.in_channels, cfg.image_size, cfg.image_size)), jnp.float32)
     labels = jnp.asarray(rng.integers(0, cfg.num_classes, (B,)), jnp.int32)
 
-    _states = {}
-
     def run(c, n_steps):
-        # one state per config so the A/B winner's compiled step is REUSED
-        # for the timed run (no second XL/2 compile)
-        key = c.fused_adaln
-        if key not in _states:
-            st = ShardedTrainState(
-                c, dit, mesh, AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
-            params, opt_state = st.init(jax.random.PRNGKey(0))
-            batch = st.shard_batch(
-                dit.dit_batch(images, labels, jax.random.PRNGKey(1), c))
-            _states[key] = (st, params, opt_state, batch)
-        st, params, opt_state, batch = _states[key]
-        return _timed_steps(st, params, opt_state, batch, n_steps)
+        # fresh state per run, freed before the next one: two XL/2 states
+        # (params + AdamW each ~9.5 GB) cannot coexist in 16 GB HBM, so the
+        # A/B pays a recompile per leg instead of holding both
+        import gc
+        st = ShardedTrainState(
+            c, dit, mesh, AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
+        params, opt_state = st.init(jax.random.PRNGKey(0))
+        batch = st.shard_batch(
+            dit.dit_batch(images, labels, jax.random.PRNGKey(1), c))
+        out = _timed_steps(st, params, opt_state, batch, n_steps)
+        del st, params, opt_state, batch
+        gc.collect()
+        return out
 
     fused_note = "off"
     if on_tpu:
@@ -224,6 +224,33 @@ def bench_moe(dev, on_tpu):
     }
 
 
+def _run_sub(name: str, timeout: float = 1500.0) -> dict:
+    """Run `python bench.py --sub {name}` and parse its one-line JSON."""
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--sub", name],
+            capture_output=True, text=True, timeout=timeout,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            env={**os.environ, "BENCH_BACKEND": _BACKEND})
+        if out.returncode != 0:
+            return {"error": f"rc={out.returncode} {out.stderr.strip()[-300:]}"}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"error": f"sub-bench {name} timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001
+        return {"error": repr(e)[:300]}
+
+
+def _sub_main(name: str) -> None:
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    fn = {"dit": bench_dit, "moe": bench_moe}[name]
+    try:
+        print(json.dumps(fn(dev, on_tpu)))
+    except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
+        print(json.dumps({"error": repr(e)[:300]}))
+
+
 def main():
     from paddle_tpu.models import llama
     from paddle_tpu.models.llama import LlamaConfig
@@ -266,21 +293,17 @@ def main():
     mfu = (tokens_per_sec * llama.flops_per_token(cfg, S) / peak) if peak else 0.0
     llama_params = llama.num_params(cfg)
 
-    # free the llama state (params+opt ~ 10 GB) before the DiT bench inits
+    # free the llama state (params+opt ~ 10 GB) before the sub-benches
     del params, opt_state, batch, st
     import gc
     gc.collect()
 
-    try:
-        dit_extra = bench_dit(dev, on_tpu)
-    except Exception as e:  # noqa: BLE001 — DiT must not sink the headline
-        dit_extra = {"error": repr(e)[:300]}
-    gc.collect()
-
-    try:
-        moe_extra = bench_moe(dev, on_tpu)
-    except Exception as e:  # noqa: BLE001
-        moe_extra = {"error": repr(e)[:300]}
+    # each sub-bench runs in its OWN process: device buffers are truly
+    # released between flagships (in-process, residue from the llama run
+    # surfaced as INVALID_ARGUMENT/OOM on the axon backend) and one
+    # flagship failing cannot poison the next
+    dit_extra = _run_sub("dit")
+    moe_extra = _run_sub("moe")
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -316,6 +339,9 @@ if __name__ == "__main__":
         }))
 
     try:
+        if len(sys.argv) >= 3 and sys.argv[1] == "--sub":
+            _sub_main(sys.argv[2])
+            sys.exit(0)
         main()
     except KeyboardInterrupt as e:
         _diag_line(e)

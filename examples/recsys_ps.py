@@ -4,6 +4,11 @@ Usage:  python examples/recsys_ps.py
 The sparse half lives on parameter servers (host memory); only the rows a
 batch touches reach the device — the heterogeneous capacity split.
 """
+import os
+import sys
+
+# allow running from a source checkout without installing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax.numpy as jnp
 import numpy as np
 

@@ -2,6 +2,11 @@
 
 Usage:  python examples/serve_model.py
 """
+import os
+import sys
+
+# allow running from a source checkout without installing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import json
 import tempfile
 import urllib.request

@@ -4,6 +4,11 @@ Usage:  python examples/train_llama.py [--steps N]
 Runs on whatever devices jax sees (one TPU chip, or the 8-virtual-device
 CPU mesh under JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8).
 """
+import os
+import sys
+
+# allow running from a source checkout without installing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import argparse
 
 import jax

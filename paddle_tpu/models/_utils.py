@@ -1,0 +1,25 @@
+"""Shared model-building helpers."""
+
+from __future__ import annotations
+
+import jax
+
+
+def apply_remat(fn, policy: str = "full"):
+    """Wrap a block fn in jax.checkpoint under the named remat policy.
+
+    "full" recomputes the whole block in backward; "save_attn" additionally
+    saves tensors tagged `checkpoint_name(x, "attn_out")` so the backward
+    recompute skips the qkv matmuls and the attention forward (O(S*E)/block
+    extra HBM).  Chip note: on 16 GB v5e "full" measured faster for both
+    flagships (see ARCHITECTURE.md round-5 notes); "save_attn" is for
+    larger-HBM parts.
+    """
+    if policy == "save_attn":
+        return jax.checkpoint(
+            fn, static_argnums=(),
+            policy=jax.checkpoint_policies.save_only_these_names("attn_out"))
+    if policy == "full":
+        return jax.checkpoint(fn, static_argnums=())
+    raise ValueError(
+        f"remat_policy must be 'full' or 'save_attn', got {policy!r}")

@@ -33,6 +33,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 
 from .. import kernels
@@ -56,6 +57,9 @@ class DiTConfig:
     num_train_timesteps: int = 1000
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    # "full" | "save_attn" (save per-block attention outputs so the backward
+    # recompute skips qkv matmuls + attention; O(N*E)/block extra HBM)
+    remat_policy: str = "full"
     scan_layers: bool = True
     fused_adaln: bool = False     # Pallas LN+modulate (bench A/Bs on chip)
     attn_impl: str = "auto"       # "auto" (flash when aligned) | "xla":
@@ -261,6 +265,8 @@ def _block(x, c_vec, bp, config: DiTConfig):
     else:
         raise ValueError(
             f"attn_impl must be 'auto' or 'xla', got {cfg.attn_impl!r}")
+    # no-op unless the enclosing jax.checkpoint uses the save_attn policy
+    a = checkpoint_name(a, "attn_out")
     a = a.reshape(B, N, E) @ bp["wo"] + bp["b_o"].astype(dt)
     x = x + g1 * a
 
@@ -299,7 +305,8 @@ def forward(params, x_t, t, y, config: DiTConfig):
 
     block = functools.partial(_block, config=c)
     if c.remat:
-        block = jax.checkpoint(block, static_argnums=())
+        from ._utils import apply_remat
+        block = apply_remat(block, c.remat_policy)
     if c.scan_layers:
         def body(x, bp):
             return block(x, c_vec, bp), None

@@ -29,6 +29,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 import numpy as np
 
 from .. import kernels
@@ -54,6 +55,10 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     # training-time knobs
     remat: bool = True           # jax.checkpoint each block (HBM <-> FLOPs trade)
+    # "full" recomputes the whole block in backward; "save_attn" additionally
+    # saves each block's attention output (O(S*E)/block HBM) so the backward
+    # recompute skips the qkv matmuls and the attention forward entirely
+    remat_policy: str = "full"
     scan_layers: bool = True     # lax.scan over stacked blocks
     # context parallelism over the mesh `sep` axis: None | "ring" | "ulysses"
     # (the capability the reference reserved but never implemented — SURVEY.md §5)
@@ -253,6 +258,8 @@ def _block(c: LlamaConfig, x, lp, cos, sin, attn_mask, ffn_fn=None):
             mask=attn_mask)
     else:
         attn = kernels.attention(q, k, v, mask=attn_mask, causal=True)
+    # no-op unless the enclosing jax.checkpoint uses the save_attn policy
+    attn = checkpoint_name(attn, "attn_out")
     x = x + (attn.reshape(B, S, Hq * D) @ lp["wo"])
 
     h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
@@ -294,6 +301,13 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
         # 1F1B-by-autodiff microbatch pipeline over the pipe axis (C27 analog)
         if attn_mask is not None:
             raise ValueError("pipeline parallel forward does not take attn_mask")
+        if c.remat and c.remat_policy != "full":
+            # pipeline_apply owns its own per-microbatch remat; named-save
+            # policies are not threaded through it — fail instead of
+            # silently training under a different policy than requested
+            raise ValueError(
+                f"remat_policy={c.remat_policy!r} is not supported under "
+                f"pipeline parallelism; use 'full'")
         from jax.sharding import PartitionSpec as P
         sep_live = (c.context_parallel
                     and "sep" in mesh.axis_names and mesh.shape["sep"] > 1)
@@ -312,7 +326,8 @@ def forward(params, input_ids, config: LlamaConfig, positions=None, attn_mask=No
             virtual_stages=c.pp_virtual_stages, returns_aux=True)
     else:
         if c.remat:
-            blk = jax.checkpoint(blk, static_argnums=())
+            from ._utils import apply_remat
+            blk = apply_remat(blk, c.remat_policy)
         if c.scan_layers:
             def body(carry, lp):
                 h, aux = carry

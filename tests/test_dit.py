@@ -61,6 +61,21 @@ class TestForward:
         np.testing.assert_allclose(np.asarray(o1), np.asarray(o2),
                                    rtol=2e-3, atol=2e-3)
 
+    def test_remat_save_attn_policy_matches(self):
+        import dataclasses
+        c_full = dataclasses.replace(CFG, remat=True)
+        c_sa = dataclasses.replace(CFG, remat=True, remat_policy="save_attn")
+        params = dit.init_params(CFG, seed=1)
+        params["blocks"]["w_mod"] = (
+            jax.random.normal(jax.random.PRNGKey(2),
+                              params["blocks"]["w_mod"].shape) * 0.02)
+        b = _batch(CFG)
+        g1 = jax.grad(dit.loss_fn)(params, b, c_full)
+        g2 = jax.grad(dit.loss_fn)(params, b, c_sa)
+        for x, y in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(x), np.asarray(y),
+                                       rtol=1e-5, atol=1e-6)
+
     def test_attn_impl_and_fused_qkv_match_baseline(self):
         """The two bench A/B knobs are numerics-preserving: fused (E,3E)
         qkv must reproduce the separate matmuls (pins b_qkv packing order),

@@ -69,6 +69,26 @@ class TestForward:
         for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5)
 
+    def test_remat_save_attn_policy_matches(self):
+        """save_attn (checkpoint_name'd attention outputs kept, qkv+attention
+        skipped in the backward recompute) is numerics-identical to full."""
+        c = tiny()
+        params = llama.init_params(c, seed=3)
+        ids = jnp.array(np.random.randint(0, c.vocab_size, (1, 8)), dtype=jnp.int32)
+        batch = {"input_ids": ids, "labels": ids}
+        c_full = LlamaConfig(**{**c.__dict__, "remat": True})
+        c_sa = LlamaConfig(**{**c.__dict__, "remat": True,
+                              "remat_policy": "save_attn"})
+        g1 = jax.grad(llama.loss_fn)(params, batch, c_full)
+        g2 = jax.grad(llama.loss_fn)(params, batch, c_sa)
+        for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+        with pytest.raises(ValueError, match="remat_policy"):
+            llama.loss_fn(params, batch,
+                          LlamaConfig(**{**c.__dict__, "remat": True,
+                                         "remat_policy": "bogus"}))
+
 
 class TestLoss:
     def test_ignore_index(self):

@@ -6,8 +6,9 @@ Usage:  python tools/bench_sweep.py [llama|dit|moe|all]
 Measures, on the real chip:
   * llama: B x S grid around the headline shape (B2/S8192 was the round-3
     62.1% MFU point) to re-find the MFU peak after code drift;
-  * dit:   fused-adaLN on/off x head layouts (9x128 vs 16x72) x batch;
-  * moe:   scatter vs einsum dispatch x token counts (8k/16k/32k).
+  * dit:   attn impl (xla vs flash) x fused-adaLN x head layouts x batch;
+  * moe:   scatter vs einsum dispatch x token counts (8k/16k/32k) x head
+    layout (8x128 Mixtral-style vs 16x64 whose D=64 pads to the lane tile).
 
 Prints one JSON line per point; nothing here is driver-consumed.
 """
@@ -85,12 +86,17 @@ def sweep_dit():
     from paddle_tpu.optimizer.functional import AdamW
 
     mesh = mesh_lib.make_mesh(data=1)
-    for heads, fused, B in ((9, False, 128), (9, True, 128),
-                            (16, False, 128), (16, True, 128),
-                            (9, True, 256)):
+    # r5 chip session: 9x128 + fused adaLN + attn_impl=xla + B160 won
+    # (139.0 img/s, 50.2% MFU); flash attn 134.4; fused_qkv slower (125);
+    # B=192 regressed, B=224 OOM
+    for heads, fused, attn, B in ((9, True, "xla", 160),
+                                  (9, True, "auto", 160),
+                                  (9, False, "xla", 160),
+                                  (16, True, "xla", 160),
+                                  (9, True, "xla", 128)):
         try:
             cfg = dataclasses.replace(DiTConfig.XL_2(), num_heads=heads,
-                                      fused_adaln=fused)
+                                      fused_adaln=fused, attn_impl=attn)
             st = ShardedTrainState(cfg, dit, mesh,
                                    AdamW(learning_rate=1e-4,
                                          grad_clip_norm=1.0))
@@ -104,11 +110,11 @@ def sweep_dit():
             batch = st.shard_batch(dit.dit_batch(
                 imgs, labs, jax.random.PRNGKey(1), cfg))
             dt, loss = _timed(st, params, opt, batch)
-            _emit(kind="dit", heads=heads, fused_adaln=fused, B=B,
-                  img_s=round(B * STEPS / dt, 2), loss=loss)
+            _emit(kind="dit", heads=heads, fused_adaln=fused, attn=attn,
+                  B=B, img_s=round(B * STEPS / dt, 2), loss=loss)
         except Exception as e:  # noqa: BLE001
-            _emit(kind="dit", heads=heads, fused_adaln=fused, B=B,
-                  error=repr(e)[:160])
+            _emit(kind="dit", heads=heads, fused_adaln=fused, attn=attn,
+                  B=B, error=repr(e)[:160])
 
 
 def sweep_moe():
@@ -119,18 +125,26 @@ def sweep_moe():
     from paddle_tpu.optimizer.functional import AdamW
 
     mesh = mesh_lib.make_mesh(data=1)
+    # r5 chip winner: 8x128 heads (40.4k tok/s / 40.6% MFU at B2/S8192
+    # scatter vs 31.8k / 32.1% for 16x64)
     base = MoELlamaConfig(
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,
-        num_hidden_layers=8, num_attention_heads=16, num_key_value_heads=8,
+        num_hidden_layers=8, num_attention_heads=8, num_key_value_heads=4,
         max_position_embeddings=16384, dtype=jnp.bfloat16, remat=True,
         num_experts=8, moe_top_k=2)
     # scatter and einsum at MATCHING shapes so dispatch cost separates
-    # from shape cost; einsum beyond 8k tokens OOMs (that is the point)
-    for disp, B, S in (("einsum", 2, 4096), ("scatter", 2, 4096),
-                       ("einsum", 2, 8192), ("scatter", 2, 8192),
-                       ("scatter", 2, 16384), ("scatter", 4, 8192)):
+    # from shape cost; the 16x64 point tracks the padded-D attention tax
+    for disp, B, S, hq, hkv in (("einsum", 2, 4096, 8, 4),
+                                ("scatter", 2, 4096, 8, 4),
+                                ("einsum", 2, 8192, 8, 4),
+                                ("scatter", 2, 8192, 8, 4),
+                                ("scatter", 2, 8192, 16, 8),
+                                ("scatter", 2, 16384, 8, 4),
+                                ("scatter", 4, 8192, 8, 4)):
         try:
-            cfg = dataclasses.replace(base, moe_dispatch=disp)
+            cfg = dataclasses.replace(base, moe_dispatch=disp,
+                                      num_attention_heads=hq,
+                                      num_key_value_heads=hkv)
             st = ShardedTrainState(cfg, moe_llama, mesh,
                                    AdamW(learning_rate=1e-4,
                                          grad_clip_norm=1.0))
@@ -142,11 +156,12 @@ def sweep_moe():
             dt, loss = _timed(st, params, opt, batch)
             tok_s = B * S * STEPS / dt
             mfu_flops = moe_llama.flops_per_token(cfg, S) * tok_s
-            _emit(kind="moe", dispatch=disp, B=B, S=S,
+            _emit(kind="moe", dispatch=disp, B=B, S=S, heads=f"{hq}x{cfg.hidden_size//hq}",
                   tok_s=round(tok_s, 1),
                   mfu=round(mfu_flops / _peak(), 4), loss=loss)
         except Exception as e:  # noqa: BLE001
             _emit(kind="moe", dispatch=disp, B=B, S=S,
+                  heads=f"{hq}x{base.hidden_size//hq}",
                   error=repr(e)[:160])
 
 
