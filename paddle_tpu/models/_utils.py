@@ -9,11 +9,14 @@ def apply_remat(fn, policy: str = "full"):
     """Wrap a block fn in jax.checkpoint under the named remat policy.
 
     "full" recomputes the whole block in backward; "save_attn" additionally
-    saves tensors tagged `checkpoint_name(x, "attn_out")` so the backward
-    recompute skips the qkv matmuls and the attention forward (O(S*E)/block
-    extra HBM).  Chip note: on 16 GB v5e "full" measured faster for both
-    flagships (see ARCHITECTURE.md round-5 notes); "save_attn" is for
-    larger-HBM parts.
+    saves tensors tagged `checkpoint_name(x, "attn_out")` (O(S*E)/block
+    extra HBM) so recompute of attn_out's CONSUMERS (the wo projection and
+    everything downstream in the block) starts from the saved value.  Note
+    the attention VJP itself still rematerializes its residuals — q/k/v and
+    the qkv matmuls are recomputed either way — which is why on 16 GB v5e
+    "full" measured faster for both flagships (see ARCHITECTURE.md round-5
+    notes); "save_attn" only pays off where HBM is plentiful and the
+    post-attention segment dominates recompute.
     """
     if policy == "save_attn":
         return jax.checkpoint(

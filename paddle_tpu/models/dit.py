@@ -57,8 +57,9 @@ class DiTConfig:
     num_train_timesteps: int = 1000
     dtype: Any = jnp.bfloat16
     remat: bool = True
-    # "full" | "save_attn" (save per-block attention outputs so the backward
-    # recompute skips qkv matmuls + attention; O(N*E)/block extra HBM)
+    # "full" | "save_attn" (save per-block attention outputs; consumers
+    # resume from them but attention VJP residuals still rematerialize —
+    # see models/_utils.apply_remat; O(N*E)/block extra HBM)
     remat_policy: str = "full"
     scan_layers: bool = True
     fused_adaln: bool = False     # Pallas LN+modulate (bench A/Bs on chip)

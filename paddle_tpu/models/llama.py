@@ -56,8 +56,9 @@ class LlamaConfig:
     # training-time knobs
     remat: bool = True           # jax.checkpoint each block (HBM <-> FLOPs trade)
     # "full" recomputes the whole block in backward; "save_attn" additionally
-    # saves each block's attention output (O(S*E)/block HBM) so the backward
-    # recompute skips the qkv matmuls and the attention forward entirely
+    # saves each block's attention output (O(S*E)/block HBM) so recompute of
+    # its consumers starts there (attention VJP residuals still rematerialize
+    # — see models/_utils.apply_remat)
     remat_policy: str = "full"
     scan_layers: bool = True     # lax.scan over stacked blocks
     # context parallelism over the mesh `sep` axis: None | "ring" | "ulysses"

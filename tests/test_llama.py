@@ -72,13 +72,13 @@ class TestForward:
     def test_remat_save_attn_policy_matches(self):
         """save_attn (checkpoint_name'd attention outputs kept, qkv+attention
         skipped in the backward recompute) is numerics-identical to full."""
+        import dataclasses
         c = tiny()
         params = llama.init_params(c, seed=3)
         ids = jnp.array(np.random.randint(0, c.vocab_size, (1, 8)), dtype=jnp.int32)
         batch = {"input_ids": ids, "labels": ids}
-        c_full = LlamaConfig(**{**c.__dict__, "remat": True})
-        c_sa = LlamaConfig(**{**c.__dict__, "remat": True,
-                              "remat_policy": "save_attn"})
+        c_full = dataclasses.replace(c, remat=True)
+        c_sa = dataclasses.replace(c, remat=True, remat_policy="save_attn")
         g1 = jax.grad(llama.loss_fn)(params, batch, c_full)
         g2 = jax.grad(llama.loss_fn)(params, batch, c_sa)
         for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
@@ -86,8 +86,8 @@ class TestForward:
                                        rtol=1e-5, atol=1e-6)
         with pytest.raises(ValueError, match="remat_policy"):
             llama.loss_fn(params, batch,
-                          LlamaConfig(**{**c.__dict__, "remat": True,
-                                         "remat_policy": "bogus"}))
+                          dataclasses.replace(c, remat=True,
+                                              remat_policy="bogus"))
 
 
 class TestLoss:
