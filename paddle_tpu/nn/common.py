@@ -390,32 +390,32 @@ class _PoolNd(Layer):
 
 class MaxPool1D(_PoolNd):
     def forward(self, x):
-        return F.max_pool1d(x, self.k, self.s, self.p)
+        return F.max_pool1d(x, self.k, self.s, self.p, **self.kw)
 
 
 class MaxPool2D(_PoolNd):
     def forward(self, x):
-        return F.max_pool2d(x, self.k, self.s, self.p)
+        return F.max_pool2d(x, self.k, self.s, self.p, **self.kw)
 
 
 class MaxPool3D(_PoolNd):
     def forward(self, x):
-        return F.max_pool3d(x, self.k, self.s, self.p)
+        return F.max_pool3d(x, self.k, self.s, self.p, **self.kw)
 
 
 class AvgPool1D(_PoolNd):
     def forward(self, x):
-        return F.avg_pool1d(x, self.k, self.s, self.p)
+        return F.avg_pool1d(x, self.k, self.s, self.p, **self.kw)
 
 
 class AvgPool2D(_PoolNd):
     def forward(self, x):
-        return F.avg_pool2d(x, self.k, self.s, self.p)
+        return F.avg_pool2d(x, self.k, self.s, self.p, **self.kw)
 
 
 class AvgPool3D(_PoolNd):
     def forward(self, x):
-        return F.avg_pool3d(x, self.k, self.s, self.p)
+        return F.avg_pool3d(x, self.k, self.s, self.p, **self.kw)
 
 
 class AdaptiveAvgPool1D(Layer):

@@ -428,7 +428,9 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW
         pad = [(0, 0)] * (sq_m.ndim - 1) + [(size // 2, (size - 1) // 2)]
         padded = jnp.pad(sq_m, pad)
         win = sum(jax.lax.slice_in_dim(padded, i, i + c, axis=-1) for i in range(size))
-        denom = (k + alpha * win) ** beta
+        # reference (nn/functional/norm.py:601-615) zero-pads then avg-pools,
+        # so every window divides by `size` — the torch alpha/n convention
+        denom = (k + alpha * win / size) ** beta
         return a / jnp.moveaxis(denom, -1, ch_axis)
 
     return apply_op("local_response_norm", f, x)
@@ -608,44 +610,134 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
     return _conv_nd(x, weight, bias, stride, padding, dilation, groups, data_format, 3, transpose=True, output_padding=output_padding)
 
 
-def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False, exclusive=True, data_format="NCHW"):
+def _pool_out_extra(in_sizes, kernel, stride, pad, ceil_mode):
+    """Per-dim (out_size, extra_right_pad).  ceil_mode keeps the trailing
+    partial window (reference pooling.cc convention: the last window must
+    start inside input+left-pad)."""
+    outs, extras = [], []
+    for S, k, s, p in zip(in_sizes, kernel, stride, pad):
+        if ceil_mode:
+            o = -(-(S + 2 * p - k) // s) + 1
+            if (o - 1) * s >= S + p:
+                o -= 1
+        else:
+            o = (S + 2 * p - k) // s + 1
+        outs.append(o)
+        extras.append(max((o - 1) * s + k - S - 2 * p, 0))
+    return outs, extras
+
+
+def _max_pool_with_mask(a, kernel, stride, pad, outs):
+    """Gather-based max pool returning (out, mask); mask uses the reference's
+    flattened row-major index over the UNPADDED spatial dims (torch-equal).
+    Memory O(prod(kernel)) x output — the eager return_mask path only; the
+    plain pool stays on reduce_window."""
+    nd = len(kernel)
+    S = a.shape[2:]
+    pos_d, valid_d = [], []
+    for d in range(nd):
+        pos = (np.arange(outs[d])[:, None] * stride[d] - pad[d]
+               + np.arange(kernel[d])[None, :])          # (O_d, k_d)
+        valid_d.append((pos >= 0) & (pos < S[d]))
+        pos_d.append(np.clip(pos, 0, S[d] - 1))
+    vals = a
+    for d in range(nd):
+        vals = jnp.take(vals, jnp.asarray(pos_d[d]), axis=2 + 2 * d)
+    # (N, C, O1, k1, O2, k2, ...) -> (N, C, O..., prod(k))
+    perm = (0, 1) + tuple(2 + 2 * d for d in range(nd)) + \
+        tuple(3 + 2 * d for d in range(nd))
+    vals = vals.transpose(perm).reshape(
+        a.shape[:2] + tuple(outs) + (int(np.prod(kernel)),))
+    strides_flat = [int(np.prod(S[d + 1:])) for d in range(nd)]
+    flat = np.zeros([1] * (2 * nd), np.int64)
+    valid = np.ones([1] * (2 * nd), bool)
+    for d in range(nd):
+        sh = [1] * (2 * nd)
+        sh[2 * d], sh[2 * d + 1] = pos_d[d].shape
+        flat = flat + pos_d[d].reshape(sh) * strides_flat[d]
+        valid = valid & valid_d[d].reshape(sh)
+    perm2 = tuple(2 * d for d in range(nd)) + \
+        tuple(2 * d + 1 for d in range(nd))
+    flat = flat.transpose(perm2).reshape(tuple(outs) + (-1,))
+    valid = valid.transpose(perm2).reshape(tuple(outs) + (-1,))
+    vals = jnp.where(jnp.asarray(valid), vals, -jnp.inf)
+    wi = jnp.argmax(vals, axis=-1)
+    out = jnp.take_along_axis(vals, wi[..., None], axis=-1)[..., 0]
+    mask = jnp.take_along_axis(
+        jnp.broadcast_to(jnp.asarray(flat), vals.shape),
+        wi[..., None], axis=-1)[..., 0]
+    return out.astype(a.dtype), mask.astype(jnp.int32)
+
+
+def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False,
+             exclusive=True, data_format="NCHW", return_mask=False,
+             divisor_override=None):
     x = _t(x)
     kernel = _pair(kernel, nd)
     stride = _pair(stride if stride is not None else kernel, nd)
     pad = _pair(padding, nd)
     channel_first = data_format.startswith("NC")
 
-    window = (1, 1) + kernel if channel_first else (1,) + kernel + (1,)
-    strides = (1, 1) + stride if channel_first else (1,) + stride + (1,)
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad) if channel_first else ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    def to_cf(a):
+        return a if channel_first else jnp.moveaxis(a, -1, 1)
 
     def f(a):
+        acf = to_cf(a)
+        outs, extras = _pool_out_extra(acf.shape[2:], kernel, stride, pad,
+                                       ceil_mode)
+        # ceil_mode's trailing partial window = asymmetric extra right pad
+        sp_pads = tuple((p, p + e) for p, e in zip(pad, extras))
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        pads = ((0, 0), (0, 0)) + sp_pads
         if mode == "max":
-            init = -jnp.inf
-            out = jax.lax.reduce_window(a, init, jax.lax.max, window, strides, pads)
+            out = jax.lax.reduce_window(acf, -jnp.inf, jax.lax.max, window,
+                                        strides, pads)
         else:
-            summed = jax.lax.reduce_window(a, 0.0, jax.lax.add, window, strides, pads)
-            if exclusive and any(p > 0 for p in pad):
-                ones = jnp.ones_like(a)
-                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+            summed = jax.lax.reduce_window(acf, 0.0, jax.lax.add, window,
+                                           strides, pads)
+            if divisor_override is not None:
+                out = summed / float(divisor_override)
+            elif exclusive and (any(p > 0 for p in pad)
+                                or any(e > 0 for e in extras)):
+                ones = jnp.ones_like(acf)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                               window, strides, pads)
                 out = summed / counts
             else:
                 out = summed / float(np.prod(kernel))
-        return out.astype(a.dtype)
+        out = out.astype(a.dtype)
+        return out if channel_first else jnp.moveaxis(out, 1, -1)
 
+    if return_mask and mode == "max":
+        # value through the differentiable reduce_window path; the int32
+        # mask as a separate non-diff op (nondiff -> stop_gradient output)
+        def f_mask(a):
+            acf = to_cf(a)
+            outs, _ = _pool_out_extra(acf.shape[2:], kernel, stride, pad,
+                                      ceil_mode)
+            _, mask = _max_pool_with_mask(acf, kernel, stride, pad, outs)
+            return mask if channel_first else jnp.moveaxis(mask, 1, -1)
+
+        out = apply_op(f"max_pool{nd}d", f, x)
+        mask = apply_op(f"max_pool{nd}d_mask", f_mask, x, nondiff=(0,))
+        return out, mask
     return apply_op(f"{mode}_pool{nd}d", f, x)
 
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCL", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 1, "max", ceil_mode, data_format="NCL")
+    return _pool_nd(x, kernel_size, stride, padding, 1, "max", ceil_mode,
+                    data_format="NCL", return_mask=return_mask)
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode, data_format=data_format)
+    return _pool_nd(x, kernel_size, stride, padding, 2, "max", ceil_mode,
+                    data_format=data_format, return_mask=return_mask)
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode, data_format=data_format)
+    return _pool_nd(x, kernel_size, stride, padding, 3, "max", ceil_mode,
+                    data_format=data_format, return_mask=return_mask)
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
@@ -653,11 +745,15 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode
 
 
 def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", ceil_mode, exclusive, data_format=data_format)
+    return _pool_nd(x, kernel_size, stride, padding, 2, "avg", ceil_mode,
+                    exclusive, data_format=data_format,
+                    divisor_override=divisor_override)
 
 
 def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, divisor_override=None, data_format="NCDHW", name=None):
-    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", ceil_mode, exclusive, data_format=data_format)
+    return _pool_nd(x, kernel_size, stride, padding, 3, "avg", ceil_mode,
+                    exclusive, data_format=data_format,
+                    divisor_override=divisor_override)
 
 
 def adaptive_avg_pool1d(x, output_size, name=None):
@@ -707,28 +803,106 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     return apply_op("adaptive_max_pool2d", f, x)
 
 
+def _src_coords(S, O, align_corners, align_mode):
+    """Reference coordinate conventions (interpolate_kernel.h): align_corners
+    -> endpoints map exactly; else align_mode 0 = half-pixel (the torch
+    align_corners=False convention), align_mode 1 = asymmetric src=dst*scale."""
+    i = np.arange(O, dtype=np.float64)
+    if align_corners:
+        return i * (S - 1) / max(O - 1, 1)
+    if align_mode == 1:
+        return i * S / O
+    # half-pixel; NOT clipped here — linear clamps (reference/torch), cubic
+    # keeps negative src and border-replicates its taps instead
+    return (i + 0.5) * S / O - 0.5
+
+
+def _resize_axis(a, axis, O, mode, align_corners, align_mode):
+    """Separable 1-D resize along `axis` (weights are static numpy)."""
+    S = a.shape[axis]
+    if mode == "nearest":
+        if align_corners:
+            # round-half-UP: the reference casts ratio*i + 0.5 (np.round's
+            # half-to-even would pick the other pixel at every tie)
+            idx = np.floor(np.arange(O) * (S - 1) / max(O - 1, 1) + 0.5)
+        else:
+            # legacy asymmetric floor — torch 'nearest' (not nearest-exact)
+            idx = np.floor(np.arange(O) * S / O)
+        return jnp.take(a, jnp.asarray(idx.astype(np.int64)), axis=axis)
+    if mode == "area":
+        # adaptive-average windows [floor(i*S/O), ceil((i+1)*S/O))
+        starts = np.floor(np.arange(O) * S / O).astype(np.int64)
+        ends = np.ceil((np.arange(O) + 1) * S / O).astype(np.int64)
+        cs = jnp.cumsum(a, axis=axis)
+        zero = jnp.take(cs, jnp.asarray([0]), axis=axis) * 0
+        cs = jnp.concatenate([zero, cs], axis=axis)
+        hi = jnp.take(cs, jnp.asarray(ends), axis=axis)
+        lo = jnp.take(cs, jnp.asarray(starts), axis=axis)
+        shape = [1] * a.ndim
+        shape[axis] = O
+        n = jnp.asarray((ends - starts).astype(np.float32)).reshape(shape)
+        return (hi - lo) / n
+    src = _src_coords(S, O, align_corners, align_mode)
+    if mode == "linear":
+        src = np.clip(src, 0.0, S - 1)
+        lo = np.clip(np.floor(src), 0, S - 1).astype(np.int64)
+        hi = np.minimum(lo + 1, S - 1)
+        w = (src - lo).astype(np.float32)
+        shape = [1] * a.ndim
+        shape[axis] = O
+        wj = jnp.asarray(w).reshape(shape).astype(a.dtype)
+        return (jnp.take(a, jnp.asarray(lo), axis=axis) * (1 - wj)
+                + jnp.take(a, jnp.asarray(hi), axis=axis) * wj)
+    if mode == "cubic":
+        # Keys cubic-convolution kernel, A=-0.75 (cubic_interp1d in the
+        # reference's interpolate_kernel.h; torch matches)
+        A = -0.75
+        base = np.floor(src).astype(np.int64)
+        t = (src - base).astype(np.float64)
+        w = [
+            ((A * (t + 1) - 5 * A) * (t + 1) + 8 * A) * (t + 1) - 4 * A,
+            ((A + 2) * t - (A + 3)) * t * t + 1,
+            ((A + 2) * (1 - t) - (A + 3)) * (1 - t) * (1 - t) + 1,
+            ((A * (2 - t) - 5 * A) * (2 - t) + 8 * A) * (2 - t) - 4 * A,
+        ]
+        out = 0
+        shape = [1] * a.ndim
+        shape[axis] = O
+        for k in range(4):
+            idx = np.clip(base + k - 1, 0, S - 1)
+            wk = jnp.asarray(w[k].astype(np.float32)).reshape(shape)
+            out = out + jnp.take(a, jnp.asarray(idx), axis=axis) * wk
+        return out.astype(a.dtype)
+    raise ValueError(f"unsupported interpolate mode {mode!r}")
+
+
 def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
                 align_mode=0, data_format="NCHW", name=None):
     x = _t(x)
+    per_dim = {"nearest": "nearest", "linear": "linear", "bilinear": "linear",
+               "trilinear": "linear", "bicubic": "cubic", "area": "area"}
+    if mode not in per_dim:
+        raise ValueError(f"unsupported interpolate mode {mode!r}")
 
     def f(a):
         channel_first = data_format.startswith("NC")
-        if channel_first:
-            spatial = a.shape[2:]
-        else:
-            spatial = a.shape[1:-1]
+        spatial = a.shape[2:] if channel_first else a.shape[1:-1]
         if size is not None:
             new_spatial = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
         else:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
             new_spatial = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
-        if channel_first:
-            new_shape = a.shape[:2] + new_spatial
-        else:
-            new_shape = (a.shape[0],) + new_spatial + (a.shape[-1],)
-        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
-                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
-        return jax.image.resize(a, new_shape, method=method).astype(a.dtype)
+        if len(new_spatial) != len(spatial):
+            raise ValueError(
+                f"interpolate size/scale_factor must cover all "
+                f"{len(spatial)} spatial dims, got {len(new_spatial)}")
+        out = a
+        for d, O in enumerate(new_spatial):
+            axis = (2 + d) if channel_first else (1 + d)
+            if out.shape[axis] != O or per_dim[mode] != "nearest":
+                out = _resize_axis(out, axis, O, per_dim[mode],
+                                   align_corners, align_mode)
+        return out.astype(a.dtype)
 
     return apply_op("interpolate", f, x)
 

@@ -238,3 +238,231 @@ class TestStackedRnnVsTorch:
                                    rtol=1e-4, atol=1e-5)
         np.testing.assert_allclose(h.numpy(), th.detach().numpy(),
                                    rtol=1e-4, atol=1e-5)
+
+
+class TestConvPoolNormVsTorch:
+    """Conv / pool / norm / resize / pad families vs torch (the highest-
+    traffic user ops after matmul; reference kernels match torch semantics)."""
+
+    def test_conv2d_groups_stride_dilation(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 4, 10, 9)).astype("float32")
+        w = rng.standard_normal((6, 2, 3, 3)).astype("float32")
+        b = rng.standard_normal((6,)).astype("float32")
+        got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                       paddle.to_tensor(b), stride=2, padding=1,
+                       dilation=2, groups=2)
+        ref = torch.nn.functional.conv2d(_t(x), _t(w), _t(b), stride=2,
+                                         padding=1, dilation=2, groups=2)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_conv2d_transpose_output_padding(self):
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((2, 4, 7, 5)).astype("float32")
+        w = rng.standard_normal((4, 3, 3, 3)).astype("float32")
+        got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, padding=1, output_padding=1)
+        ref = torch.nn.functional.conv_transpose2d(_t(x), _t(w), stride=2,
+                                                   padding=1,
+                                                   output_padding=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_batch_norm_training_updates_stats(self):
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((4, 3, 5, 5)).astype("float32")
+        wt = rng.standard_normal((3,)).astype("float32")
+        bs = rng.standard_normal((3,)).astype("float32")
+        rm = np.zeros((3,), "float32")
+        rv = np.ones((3,), "float32")
+        p_rm, p_rv = paddle.to_tensor(rm.copy()), paddle.to_tensor(rv.copy())
+        got = F.batch_norm(paddle.to_tensor(x), p_rm, p_rv,
+                           paddle.to_tensor(wt), paddle.to_tensor(bs),
+                           training=True, momentum=0.9)
+        t_rm, t_rv = _t(rm.copy()), _t(rv.copy())
+        # paddle momentum m: running = m*running + (1-m)*batch == torch 1-m
+        ref = torch.nn.functional.batch_norm(
+            _t(x), t_rm, t_rv, _t(wt), _t(bs), training=True, momentum=0.1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(p_rm.numpy(), t_rm.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        # running VAR diverges by convention: the reference updates with the
+        # BIASED batch variance (batch_norm_kernel.cc /= N*sample_size, no
+        # N-1), torch with unbiased — pin the paddle convention directly
+        bvar = x.transpose(1, 0, 2, 3).reshape(3, -1).var(axis=1)  # biased
+        np.testing.assert_allclose(p_rv.numpy(), 0.9 * rv + 0.1 * bvar,
+                                   rtol=1e-4, atol=1e-5)
+        n = x.size // 3
+        np.testing.assert_allclose(
+            t_rv.numpy(), 0.9 * rv + 0.1 * bvar * n / (n - 1),
+            rtol=1e-4, atol=1e-5)  # confirm torch really is unbiased
+
+    def test_group_and_instance_norm(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((2, 6, 4, 4)).astype("float32")
+        wt = rng.standard_normal((6,)).astype("float32")
+        bs = rng.standard_normal((6,)).astype("float32")
+        got = F.group_norm(paddle.to_tensor(x), 3,
+                           weight=paddle.to_tensor(wt),
+                           bias=paddle.to_tensor(bs))
+        ref = torch.nn.functional.group_norm(_t(x), 3, _t(wt), _t(bs))
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        got_i = F.instance_norm(paddle.to_tensor(x),
+                                weight=paddle.to_tensor(wt),
+                                bias=paddle.to_tensor(bs))
+        ref_i = torch.nn.functional.instance_norm(_t(x), weight=_t(wt),
+                                                  bias=_t(bs))
+        np.testing.assert_allclose(got_i.numpy(), ref_i.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_local_response_norm(self):
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((2, 7, 5, 5)).astype("float32")
+        got = F.local_response_norm(paddle.to_tensor(x), size=5,
+                                    alpha=1e-3, beta=0.6, k=1.5)
+        ref = torch.nn.functional.local_response_norm(
+            _t(x), size=5, alpha=1e-3, beta=0.6, k=1.5)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_avg_pool2d_ceil_exclusive(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((2, 3, 7, 7)).astype("float32")
+        got = F.avg_pool2d(paddle.to_tensor(x), kernel_size=3, stride=2,
+                           padding=1, ceil_mode=True, exclusive=True)
+        ref = torch.nn.functional.avg_pool2d(
+            _t(x), 3, stride=2, padding=1, ceil_mode=True,
+            count_include_pad=False)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_pool_ceil_mode_changes_output_size(self):
+        """8x8, k3 s2 p0: floor -> 3x3, ceil -> 4x4 (the trailing partial
+        window is kept) — shapes AND values must match torch."""
+        rng = np.random.default_rng(12)
+        x = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        for ceil in (False, True):
+            got = F.max_pool2d(paddle.to_tensor(x), 3, stride=2,
+                               ceil_mode=ceil)
+            ref = torch.nn.functional.max_pool2d(_t(x), 3, stride=2,
+                                                 ceil_mode=ceil)
+            assert tuple(got.shape) == tuple(ref.shape), f"ceil={ceil}"
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-6, atol=1e-7)
+            got_a = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2,
+                                 ceil_mode=ceil, exclusive=True)
+            ref_a = torch.nn.functional.avg_pool2d(
+                _t(x), 3, stride=2, ceil_mode=ceil, count_include_pad=False)
+            assert tuple(got_a.shape) == tuple(ref_a.shape)
+            np.testing.assert_allclose(got_a.numpy(), ref_a.numpy(),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_avg_pool2d_divisor_override(self):
+        rng = np.random.default_rng(13)
+        x = rng.standard_normal((1, 2, 6, 6)).astype("float32")
+        got = F.avg_pool2d(paddle.to_tensor(x), 2, stride=2,
+                           divisor_override=3)
+        ref = torch.nn.functional.avg_pool2d(_t(x), 2, stride=2,
+                                             divisor_override=3)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_max_pool2d_with_indices(self):
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 3, 8, 6)).astype("float32")
+        got, idx = F.max_pool2d(paddle.to_tensor(x), kernel_size=2,
+                                stride=2, return_mask=True)
+        ref, ridx = torch.nn.functional.max_pool2d(
+            _t(x), 2, stride=2, return_indices=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-7)
+        np.testing.assert_array_equal(idx.numpy(), ridx.numpy())
+
+    def test_interpolate_modes(self):
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((2, 3, 5, 7)).astype("float32")
+        for size in ([10, 13], [3, 4]):       # up- and down-sampling
+            for mode, align in (("nearest", False), ("bilinear", False),
+                                ("bilinear", True), ("bicubic", False),
+                                ("bicubic", True), ("area", False)):
+                got = F.interpolate(paddle.to_tensor(x), size=size,
+                                    mode=mode, align_corners=align)
+                kw = ({} if mode in ("nearest", "area")
+                      else {"align_corners": align})
+                ref = torch.nn.functional.interpolate(
+                    _t(x), size=tuple(size), mode=mode, **kw)
+                np.testing.assert_allclose(
+                    got.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4,
+                    err_msg=f"{mode} align_corners={align} size={size}")
+
+    def test_interpolate_1d_and_3d(self):
+        rng = np.random.default_rng(14)
+        x1 = rng.standard_normal((2, 3, 9)).astype("float32")
+        got = F.interpolate(paddle.to_tensor(x1), size=[15], mode="linear",
+                            data_format="NCW")
+        ref = torch.nn.functional.interpolate(_t(x1), size=15, mode="linear",
+                                              align_corners=False)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+        x3 = rng.standard_normal((1, 2, 4, 5, 6)).astype("float32")
+        got = F.interpolate(paddle.to_tensor(x3), size=[7, 8, 9],
+                            mode="trilinear", data_format="NCDHW")
+        ref = torch.nn.functional.interpolate(
+            _t(x3), size=(7, 8, 9), mode="trilinear", align_corners=False)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_pad_modes(self):
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((2, 3, 5, 6)).astype("float32")
+        for mode in ("reflect", "replicate", "circular"):
+            got = F.pad(paddle.to_tensor(x), [1, 2, 2, 1], mode=mode)
+            ref = torch.nn.functional.pad(_t(x), (1, 2, 2, 1), mode=mode)
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-6, atol=1e-7, err_msg=mode)
+
+    def test_pixel_shuffle_roundtrip(self):
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((2, 12, 4, 5)).astype("float32")
+        got = F.pixel_shuffle(paddle.to_tensor(x), 2)
+        ref = torch.nn.functional.pixel_shuffle(_t(x), 2)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-7)
+        back = F.pixel_unshuffle(paddle.to_tensor(ref.numpy()), 2)
+        rback = torch.nn.functional.pixel_unshuffle(ref, 2)
+        np.testing.assert_allclose(back.numpy(), rback.numpy(),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_kl_div(self):
+        rng = np.random.default_rng(10)
+        logp = np.log(rng.dirichlet(np.ones(6), size=(4,)).astype("float32"))
+        target = rng.dirichlet(np.ones(6), size=(4,)).astype("float32")
+        got = F.kl_div(paddle.to_tensor(logp), paddle.to_tensor(target),
+                       reduction="mean")
+        ref = torch.nn.functional.kl_div(_t(logp), _t(target),
+                                         reduction="mean")
+        np.testing.assert_allclose(float(got), float(ref),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_ctc_loss_per_sample(self):
+        rng = np.random.default_rng(11)
+        T, B, C, L = 12, 3, 5, 4
+        logits = rng.standard_normal((T, B, C)).astype("float32")
+        logp = torch.log_softmax(_t(logits), dim=-1).numpy()
+        labels = rng.integers(1, C, (B, L)).astype("int32")
+        in_len = np.array([12, 10, 9], "int64")
+        lab_len = np.array([4, 3, 2], "int64")
+        got = F.ctc_loss(paddle.to_tensor(logp),
+                         paddle.to_tensor(labels),
+                         paddle.to_tensor(in_len),
+                         paddle.to_tensor(lab_len),
+                         blank=0, reduction="none")
+        ref = torch.nn.functional.ctc_loss(
+            _t(logp), _t(labels.astype("int64")), _t(in_len), _t(lab_len),
+            blank=0, reduction="none")
+        np.testing.assert_allclose(np.asarray(got.numpy()).reshape(-1),
+                                   ref.numpy().reshape(-1),
+                                   rtol=1e-4, atol=1e-4)
