@@ -683,8 +683,8 @@ def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False,
 
     def f(a):
         acf = to_cf(a)
-        outs, extras = _pool_out_extra(acf.shape[2:], kernel, stride, pad,
-                                       ceil_mode)
+        _, extras = _pool_out_extra(acf.shape[2:], kernel, stride, pad,
+                                    ceil_mode)
         # ceil_mode's trailing partial window = asymmetric extra right pad
         sp_pads = tuple((p, p + e) for p, e in zip(pad, extras))
         window = (1, 1) + kernel
@@ -803,21 +803,24 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
     return apply_op("adaptive_max_pool2d", f, x)
 
 
-def _src_coords(S, O, align_corners, align_mode):
+def _src_coords(S, O, align_corners, align_mode, scale=None):
     """Reference coordinate conventions (interpolate_kernel.h): align_corners
     -> endpoints map exactly; else align_mode 0 = half-pixel (the torch
-    align_corners=False convention), align_mode 1 = asymmetric src=dst*scale."""
+    align_corners=False convention), align_mode 1 = asymmetric src=dst*ratio.
+    A user-provided scale_factor sets ratio = 1/scale directly (the torch
+    default / reference behavior) instead of recomputing S/O."""
     i = np.arange(O, dtype=np.float64)
     if align_corners:
         return i * (S - 1) / max(O - 1, 1)
+    ratio = (S / O) if scale is None else (1.0 / scale)
     if align_mode == 1:
-        return i * S / O
+        return i * ratio
     # half-pixel; NOT clipped here — linear clamps (reference/torch), cubic
     # keeps negative src and border-replicates its taps instead
-    return (i + 0.5) * S / O - 0.5
+    return (i + 0.5) * ratio - 0.5
 
 
-def _resize_axis(a, axis, O, mode, align_corners, align_mode):
+def _resize_axis(a, axis, O, mode, align_corners, align_mode, scale=None):
     """Separable 1-D resize along `axis` (weights are static numpy)."""
     S = a.shape[axis]
     if mode == "nearest":
@@ -827,7 +830,8 @@ def _resize_axis(a, axis, O, mode, align_corners, align_mode):
             idx = np.floor(np.arange(O) * (S - 1) / max(O - 1, 1) + 0.5)
         else:
             # legacy asymmetric floor — torch 'nearest' (not nearest-exact)
-            idx = np.floor(np.arange(O) * S / O)
+            ratio = (S / O) if scale is None else (1.0 / scale)
+            idx = np.minimum(np.floor(np.arange(O) * ratio), S - 1)
         return jnp.take(a, jnp.asarray(idx.astype(np.int64)), axis=axis)
     if mode == "area":
         # adaptive-average windows [floor(i*S/O), ceil((i+1)*S/O))
@@ -842,7 +846,7 @@ def _resize_axis(a, axis, O, mode, align_corners, align_mode):
         shape[axis] = O
         n = jnp.asarray((ends - starts).astype(np.float32)).reshape(shape)
         return (hi - lo) / n
-    src = _src_coords(S, O, align_corners, align_mode)
+    src = _src_coords(S, O, align_corners, align_mode, scale)
     if mode == "linear":
         src = np.clip(src, 0.0, S - 1)
         lo = np.clip(np.floor(src), 0, S - 1).astype(np.int64)
@@ -889,9 +893,13 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
         spatial = a.shape[2:] if channel_first else a.shape[1:-1]
         if size is not None:
             new_spatial = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
-        else:
+        scales = [None] * len(spatial)
+        if size is None:
             sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
             new_spatial = tuple(int(s * f_) for s, f_ in zip(spatial, sf))
+            # the given scale drives the coordinate ratio (1/scale), NOT a
+            # recomputed S/O — torch default / reference behavior
+            scales = [float(f_) for f_ in sf]
         if len(new_spatial) != len(spatial):
             raise ValueError(
                 f"interpolate size/scale_factor must cover all "
@@ -901,7 +909,7 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=F
             axis = (2 + d) if channel_first else (1 + d)
             if out.shape[axis] != O or per_dim[mode] != "nearest":
                 out = _resize_axis(out, axis, O, per_dim[mode],
-                                   align_corners, align_mode)
+                                   align_corners, align_mode, scales[d])
         return out.astype(a.dtype)
 
     return apply_op("interpolate", f, x)
@@ -1232,6 +1240,12 @@ def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8, red
             out = jnp.exp(a) - y * a
         else:
             out = a - y * jnp.log(a + epsilon)
+        if full:
+            # Stirling correction, applied only where label > 1 (loss.py:1591)
+            safe = jnp.where(y > 1, y, 2.0)
+            stirling = (safe * jnp.log(safe) - safe
+                        + 0.5 * jnp.log(2 * _math.pi * safe))
+            out = out + jnp.where(y > 1, stirling, 0.0)
         return _reduce_loss(out, reduction)
 
     return apply_op("poisson_nll_loss", f, _t(input), _t(label))

@@ -398,6 +398,28 @@ class TestConvPoolNormVsTorch:
                     got.numpy(), ref.numpy(), rtol=1e-4, atol=1e-4,
                     err_msg=f"{mode} align_corners={align} size={size}")
 
+    def test_interpolate_scale_factor_drives_ratio(self):
+        """A user scale_factor sets the coordinate ratio to 1/scale directly
+        (torch default), not a recomputed S/O — differs whenever
+        int(S*scale) != S*scale exactly."""
+        rng = np.random.default_rng(15)
+        x = rng.standard_normal((2, 3, 7, 6)).astype("float32")
+        for mode in ("nearest", "bilinear", "bicubic"):
+            kw = {} if mode == "nearest" else {"align_corners": False}
+            got = F.interpolate(paddle.to_tensor(x), scale_factor=1.5,
+                                mode=mode)
+            ref = torch.nn.functional.interpolate(_t(x), scale_factor=1.5,
+                                                  mode=mode, **kw)
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-4, atol=1e-4, err_msg=mode)
+        got = F.interpolate(paddle.to_tensor(x), scale_factor=0.6,
+                            mode="bilinear")
+        ref = torch.nn.functional.interpolate(_t(x), scale_factor=0.6,
+                                              mode="bilinear",
+                                              align_corners=False)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+
     def test_interpolate_1d_and_3d(self):
         rng = np.random.default_rng(14)
         x1 = rng.standard_normal((2, 3, 9)).astype("float32")
@@ -466,3 +488,184 @@ class TestConvPoolNormVsTorch:
         np.testing.assert_allclose(np.asarray(got.numpy()).reshape(-1),
                                    ref.numpy().reshape(-1),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestMoreLossesVsTorch:
+    """Loss-convention parity: these are the silent-corruption ops (a wrong
+    scale/term trains anyway, just worse) — pin each against torch."""
+
+    def test_cross_entropy_weight_ignore_smoothing(self):
+        rng = np.random.default_rng(20)
+        logits = rng.standard_normal((6, 5)).astype("float32")
+        labels = rng.integers(0, 5, (6,)).astype("int64")
+        labels[2] = -100
+        w = (rng.random(5) + 0.5).astype("float32")
+        got = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(labels),
+                              weight=paddle.to_tensor(w), ignore_index=-100)
+        ref = torch.nn.functional.cross_entropy(
+            _t(logits), _t(labels), weight=_t(w), ignore_index=-100)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+        lab2 = np.abs(labels) % 5
+        got = F.cross_entropy(paddle.to_tensor(logits),
+                              paddle.to_tensor(lab2), label_smoothing=0.2)
+        ref = torch.nn.functional.cross_entropy(_t(logits), _t(lab2),
+                                                label_smoothing=0.2)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+    def test_smooth_l1_delta_is_huber(self):
+        """paddle smooth_l1_loss(delta) follows the HUBER formula (no /beta
+        normalization) — the oracle is torch.huber_loss, NOT torch.smooth_l1."""
+        rng = np.random.default_rng(21)
+        x = rng.standard_normal((4, 3)).astype("float32")
+        y = rng.standard_normal((4, 3)).astype("float32")
+        got = F.smooth_l1_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                               delta=0.7)
+        ref = torch.nn.functional.huber_loss(_t(x), _t(y), delta=0.7)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    def test_bce_with_logits_pos_weight(self):
+        rng = np.random.default_rng(22)
+        lg = rng.standard_normal((4, 3)).astype("float32")
+        tgt = rng.random((4, 3)).astype("float32")
+        pw = (rng.random(3) + 0.5).astype("float32")
+        got = F.binary_cross_entropy_with_logits(
+            paddle.to_tensor(lg), paddle.to_tensor(tgt),
+            pos_weight=paddle.to_tensor(pw))
+        ref = torch.nn.functional.binary_cross_entropy_with_logits(
+            _t(lg), _t(tgt), pos_weight=_t(pw))
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+    def test_poisson_nll_full_stirling(self):
+        rng = np.random.default_rng(23)
+        x = np.abs(rng.standard_normal((4, 3))).astype("float32")
+        y = (np.abs(rng.standard_normal((4, 3))) * 3).astype("float32")
+        got = F.poisson_nll_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 log_input=False, full=True)
+        ref = torch.nn.functional.poisson_nll_loss(_t(x), _t(y),
+                                                   log_input=False, full=True)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+    def test_nll_loss_2d(self):
+        rng = np.random.default_rng(24)
+        lp = torch.log_softmax(
+            _t(rng.standard_normal((2, 4, 3, 3)).astype("float32")), 1)
+        lab = rng.integers(0, 4, (2, 3, 3)).astype("int64")
+        got = F.nll_loss(paddle.to_tensor(lp.numpy()), paddle.to_tensor(lab))
+        ref = torch.nn.functional.nll_loss(lp, _t(lab))
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-5)
+
+    def test_embedding_style_losses(self):
+        rng = np.random.default_rng(25)
+        a, p, n = (rng.standard_normal((5, 8)).astype("float32")
+                   for _ in range(3))
+        got = F.triplet_margin_loss(paddle.to_tensor(a), paddle.to_tensor(p),
+                                    paddle.to_tensor(n), margin=0.5)
+        ref = torch.nn.functional.triplet_margin_loss(_t(a), _t(p), _t(n),
+                                                      margin=0.5)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+        x1, x2 = (rng.standard_normal((6,)).astype("float32")
+                  for _ in range(2))
+        lab = np.sign(rng.standard_normal(6)).astype("float32")
+        got = F.margin_ranking_loss(paddle.to_tensor(x1),
+                                    paddle.to_tensor(x2),
+                                    paddle.to_tensor(lab), margin=0.3)
+        ref = torch.nn.functional.margin_ranking_loss(_t(x1), _t(x2),
+                                                      _t(lab), margin=0.3)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+        e1 = rng.standard_normal((4, 6)).astype("float32")
+        e2 = rng.standard_normal((4, 6)).astype("float32")
+        yy = np.array([1, -1, 1, -1], "float32")
+        got = F.cosine_embedding_loss(paddle.to_tensor(e1),
+                                      paddle.to_tensor(e2),
+                                      paddle.to_tensor(yy), margin=0.2)
+        ref = torch.nn.functional.cosine_embedding_loss(_t(e1), _t(e2),
+                                                        _t(yy), margin=0.2)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+        h = rng.standard_normal((8,)).astype("float32")
+        hy = np.sign(rng.standard_normal(8)).astype("float32")
+        got = F.hinge_embedding_loss(paddle.to_tensor(h),
+                                     paddle.to_tensor(hy), margin=0.8)
+        ref = torch.nn.functional.hinge_embedding_loss(_t(h), _t(hy),
+                                                       margin=0.8)
+        np.testing.assert_allclose(float(got), float(ref), rtol=1e-4)
+
+
+class TestLinalgVsTorch:
+    """Dense linalg vs torch (LAPACK-backed on both sides).  Decompositions
+    with sign/phase ambiguity are checked by reconstruction instead."""
+
+    def test_solve_det_slogdet(self):
+        rng = np.random.default_rng(30)
+        A = rng.standard_normal((3, 5, 5)).astype("float32")
+        B = rng.standard_normal((3, 5, 2)).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.solve(paddle.to_tensor(A),
+                                paddle.to_tensor(B)).numpy(),
+            torch.linalg.solve(_t(A), _t(B)).numpy(), rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(
+            paddle.linalg.det(paddle.to_tensor(A)).numpy(),
+            torch.linalg.det(_t(A)).numpy(), rtol=1e-4, atol=1e-5)
+        sign, logdet = paddle.linalg.slogdet(paddle.to_tensor(A))
+        rsign, rlog = torch.linalg.slogdet(_t(A))
+        np.testing.assert_allclose(sign.numpy(), rsign.numpy(), atol=1e-6)
+        np.testing.assert_allclose(logdet.numpy(), rlog.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_cholesky_pinv(self):
+        rng = np.random.default_rng(31)
+        A = rng.standard_normal((3, 5, 5)).astype("float32")
+        S = A @ A.transpose(0, 2, 1) + 5 * np.eye(5, dtype="float32")
+        np.testing.assert_allclose(
+            paddle.linalg.cholesky(paddle.to_tensor(S)).numpy(),
+            torch.linalg.cholesky(_t(S)).numpy(), rtol=1e-4, atol=1e-5)
+        B = rng.standard_normal((3, 5, 2)).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.pinv(paddle.to_tensor(B)).numpy(),
+            torch.linalg.pinv(_t(B)).numpy(), rtol=1e-3, atol=1e-4)
+
+    def test_norm_conventions(self):
+        """Vector norms (flat / per-axis) oracle vs torch; the axis-PAIR
+        p-norm is the reference's documented entrywise flattened-vector
+        convention (tensor/linalg.py:487 'treats the matrix as flattened
+        vector'), NOT torch's induced matrix norm — oracle is numpy."""
+        rng = np.random.default_rng(32)
+        M = rng.standard_normal((4, 6)).astype("float32")
+        for p in (1, 2, 3, np.inf):
+            np.testing.assert_allclose(
+                float(paddle.linalg.norm(paddle.to_tensor(M), p=p)),
+                float(torch.linalg.vector_norm(_t(M).flatten(), ord=p)),
+                rtol=1e-5)
+        for p in (1, 2, np.inf):
+            np.testing.assert_allclose(
+                paddle.linalg.norm(paddle.to_tensor(M), p=p, axis=1).numpy(),
+                torch.linalg.vector_norm(_t(M), ord=p, dim=1).numpy(),
+                rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            float(paddle.linalg.norm(paddle.to_tensor(M), p="fro",
+                                     axis=[0, 1])),
+            float(np.sqrt((M ** 2).sum())), rtol=1e-5)
+        np.testing.assert_allclose(
+            float(paddle.linalg.norm(paddle.to_tensor(M), p=3, axis=[0, 1])),
+            float((np.abs(M) ** 3).sum() ** (1 / 3)), rtol=1e-5)
+
+    def test_decompositions_reconstruct(self):
+        rng = np.random.default_rng(33)
+        A = rng.standard_normal((4, 6)).astype("float32")
+        u, s, vh = paddle.linalg.svd(paddle.to_tensor(A), full_matrices=False)
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        np.testing.assert_allclose(rec, A, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(
+            s.numpy(), torch.linalg.svdvals(_t(A)).numpy(),
+            rtol=1e-4, atol=1e-5)  # singular values are unambiguous
+        q, r = paddle.linalg.qr(paddle.to_tensor(A))
+        np.testing.assert_allclose(q.numpy() @ r.numpy(), A,
+                                   rtol=1e-4, atol=1e-4)
+        S = A @ A.T + 5 * np.eye(4, dtype="float32")
+        w, v = paddle.linalg.eigh(paddle.to_tensor(S))
+        np.testing.assert_allclose(
+            v.numpy() @ np.diag(w.numpy()) @ v.numpy().T, S,
+            rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(
+            w.numpy(), torch.linalg.eigvalsh(_t(S)).numpy(),
+            rtol=1e-4, atol=1e-4)
