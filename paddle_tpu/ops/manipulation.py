@@ -353,19 +353,28 @@ def take_along_axis(arr, indices, axis, broadcast=True, name=None):
 def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
     arr, indices = _t(arr), _t(indices)
     values = _t(values)
+    if reduce not in ("assign", "add", "mul", "multiply"):
+        raise ValueError(
+            f"put_along_axis reduce must be 'assign', 'add', 'mul' or "
+            f"'multiply', got {reduce!r}")
+
     def f(a, i, v):
+        # reference manipulation.py:4648 — reduce applies INTO the existing
+        # values (include_self semantics); .at[] accumulates duplicates
         v = jnp.broadcast_to(v, i.shape).astype(a.dtype)
-        if reduce == "add":
-            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False, mode="add") if hasattr(jnp, "put_along_axis") else _put(a, i, v, "add")
-        return _put(a, i, v, "assign")
-    def _put(a, i, v, mode):
         a_m = jnp.moveaxis(a, axis, -1)
         i_m = jnp.moveaxis(i, axis, -1)
         v_m = jnp.moveaxis(v, axis, -1)
         idx_grid = jnp.indices(i_m.shape[:-1])
         full_idx = tuple(g[..., None] * jnp.ones_like(i_m) for g in idx_grid) + (i_m,)
-        out = a_m.at[full_idx].add(v_m) if mode == "add" else a_m.at[full_idx].set(v_m)
+        if reduce == "add":
+            out = a_m.at[full_idx].add(v_m)
+        elif reduce in ("mul", "multiply"):
+            out = a_m.at[full_idx].multiply(v_m)
+        else:
+            out = a_m.at[full_idx].set(v_m)
         return jnp.moveaxis(out, -1, axis)
+
     return apply_op("put_along_axis", f, arr, indices, values, nondiff=(1,))
 
 

@@ -669,3 +669,203 @@ class TestLinalgVsTorch:
         np.testing.assert_allclose(
             w.numpy(), torch.linalg.eigvalsh(_t(S)).numpy(),
             rtol=1e-4, atol=1e-4)
+
+
+class TestIndexSortStatsVsTorch:
+    """Index/scatter + sort/quantile/stats conventions vs torch."""
+
+    def test_put_along_axis_reduce_modes(self):
+        rng = np.random.default_rng(40)
+        x = rng.standard_normal((4, 7)).astype("float32")
+        ti = rng.integers(0, 4, (2, 7)).astype("int64")
+        # per-column duplicate-free indices for 'assign': scatter's
+        # duplicate-update order is undefined in BOTH torch and JAX
+        ti_uniq = np.stack([rng.permutation(4)[:2] for _ in range(7)],
+                           axis=1).astype("int64")
+        vv = rng.standard_normal((2, 7)).astype("float32")
+        for red, tred in (("assign", None), ("add", "sum"),
+                          ("mul", "prod"), ("multiply", "prod")):
+            ix = ti_uniq if red == "assign" else ti
+            got = paddle.put_along_axis(
+                paddle.to_tensor(x), paddle.to_tensor(ix),
+                paddle.to_tensor(vv), 0, reduce=red)
+            ref = (_t(x).scatter(0, _t(ix), _t(vv)) if tred is None
+                   else _t(x).scatter_reduce(0, _t(ix), _t(vv), reduce=tred))
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-5, atol=1e-6, err_msg=red)
+        with pytest.raises(ValueError, match="put_along_axis reduce"):
+            paddle.put_along_axis(paddle.to_tensor(x), paddle.to_tensor(ti),
+                                  paddle.to_tensor(vv), 0, reduce="bogus")
+
+    def test_index_family(self):
+        rng = np.random.default_rng(41)
+        x = rng.standard_normal((4, 7)).astype("float32")
+        idx = np.array([0, 2], "int64")
+        src = rng.standard_normal((2, 7)).astype("float32")
+        np.testing.assert_allclose(
+            paddle.index_add(paddle.to_tensor(x), paddle.to_tensor(idx), 0,
+                             paddle.to_tensor(src)).numpy(),
+            _t(x).index_add(0, _t(idx), _t(src)).numpy(),
+            rtol=1e-5, atol=1e-6)
+        reps = np.array([1, 2, 0, 3], "int64")
+        np.testing.assert_allclose(
+            paddle.repeat_interleave(paddle.to_tensor(x),
+                                     paddle.to_tensor(reps), axis=0).numpy(),
+            torch.repeat_interleave(_t(x), _t(reps), dim=0).numpy())
+        sb = np.sort(rng.standard_normal(6).astype("float32"))
+        vals = rng.standard_normal((3,)).astype("float32")
+        np.testing.assert_array_equal(
+            paddle.searchsorted(paddle.to_tensor(sb),
+                                paddle.to_tensor(vals)).numpy(),
+            torch.searchsorted(_t(sb), _t(vals)).numpy())
+        np.testing.assert_array_equal(
+            paddle.bucketize(paddle.to_tensor(vals),
+                             paddle.to_tensor(sb)).numpy(),
+            torch.bucketize(_t(vals), _t(sb)).numpy())
+
+    def test_quantile_interpolations(self):
+        rng = np.random.default_rng(42)
+        x = rng.standard_normal((4, 7)).astype("float32")
+        for interp in ("linear", "lower", "higher", "nearest", "midpoint"):
+            got = paddle.quantile(paddle.to_tensor(x), 0.37, axis=1,
+                                  interpolation=interp)
+            ref = torch.quantile(_t(x), 0.37, dim=1, interpolation=interp)
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-5, atol=1e-6, err_msg=interp)
+
+    def test_stats_conventions(self):
+        rng = np.random.default_rng(43)
+        x = rng.standard_normal((4, 7)).astype("float32")
+        # paddle std/var default UNBIASED (matches torch default)
+        np.testing.assert_allclose(
+            paddle.std(paddle.to_tensor(x), axis=1).numpy(),
+            _t(x).std(dim=1).numpy(), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.var(paddle.to_tensor(x), axis=1, unbiased=False).numpy(),
+            _t(x).var(dim=1, unbiased=False).numpy(), rtol=1e-5, atol=1e-6)
+        xn = x.copy()
+        xn[1, 2] = np.nan
+        xn[1, 5] = np.nan
+        np.testing.assert_allclose(
+            paddle.nanmedian(paddle.to_tensor(xn), axis=1).numpy(),
+            _t(xn).nanmedian(dim=1).values.numpy())
+        np.testing.assert_array_equal(
+            paddle.histogram(paddle.to_tensor(x), bins=6, min=-2,
+                             max=2).numpy(),
+            torch.histc(_t(x), bins=6, min=-2, max=2).numpy())
+        np.testing.assert_allclose(
+            paddle.logcumsumexp(paddle.to_tensor(x), axis=1).numpy(),
+            torch.logcumsumexp(_t(x), dim=1).numpy(), rtol=1e-5, atol=1e-6)
+        g, gi = paddle.kthvalue(paddle.to_tensor(x), 3, axis=1)
+        r = _t(x).kthvalue(3, dim=1)
+        np.testing.assert_allclose(g.numpy(), r.values.numpy())
+        np.testing.assert_array_equal(gi.numpy(), r.indices.numpy())
+
+
+class TestConvMiscVsTorch:
+    """conv1d/3d/transpose, im2col, einsum, parameterized activations."""
+
+    def test_conv1d_conv3d_groups(self):
+        rng = np.random.default_rng(50)
+        x3 = rng.standard_normal((2, 4, 9)).astype("float32")
+        w3 = rng.standard_normal((6, 2, 3)).astype("float32")
+        got = F.conv1d(paddle.to_tensor(x3), paddle.to_tensor(w3), stride=2,
+                       padding=2, dilation=2, groups=2)
+        ref = torch.nn.functional.conv1d(_t(x3), _t(w3), stride=2, padding=2,
+                                         dilation=2, groups=2)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        x5 = rng.standard_normal((1, 4, 5, 6, 7)).astype("float32")
+        w5 = rng.standard_normal((3, 4, 2, 2, 2)).astype("float32")
+        got = F.conv3d(paddle.to_tensor(x5), paddle.to_tensor(w5), stride=2,
+                       padding=1)
+        ref = torch.nn.functional.conv3d(_t(x5), _t(w5), stride=2, padding=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-4)
+        xt = rng.standard_normal((2, 4, 6)).astype("float32")
+        wt = rng.standard_normal((4, 3, 3)).astype("float32")
+        got = F.conv1d_transpose(paddle.to_tensor(xt), paddle.to_tensor(wt),
+                                 stride=2, padding=1, output_padding=1)
+        ref = torch.nn.functional.conv_transpose1d(
+            _t(xt), _t(wt), stride=2, padding=1, output_padding=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_im2col_roundtrip(self):
+        rng = np.random.default_rng(51)
+        xi = rng.standard_normal((2, 3, 8, 8)).astype("float32")
+        got = F.unfold(paddle.to_tensor(xi), kernel_sizes=3, strides=2,
+                       paddings=1, dilations=1)
+        ref = torch.nn.functional.unfold(_t(xi), 3, stride=2, padding=1,
+                                         dilation=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+        cols = rng.standard_normal((2, 27, 16)).astype("float32")
+        got = F.fold(paddle.to_tensor(cols), output_sizes=[8, 8],
+                     kernel_sizes=3, strides=2, paddings=1)
+        ref = torch.nn.functional.fold(_t(cols), (8, 8), 3, stride=2,
+                                       padding=1)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_parameterized_activations(self):
+        rng = np.random.default_rng(52)
+        x = (rng.standard_normal((4, 6)) * 8).astype("float32")
+        px = paddle.to_tensor(x)
+        tx = _t(x)
+        for got, ref in (
+            (F.softplus(px, beta=2, threshold=10),
+             torch.nn.functional.softplus(tx, beta=2, threshold=10)),
+            (F.elu(px, alpha=0.7), torch.nn.functional.elu(tx, alpha=0.7)),
+            (F.celu(px, alpha=0.9), torch.nn.functional.celu(tx, alpha=0.9)),
+            (F.selu(px), torch.nn.functional.selu(tx)),
+            (F.softshrink(px, 0.7), torch.nn.functional.softshrink(tx, 0.7)),
+            (F.hardtanh(px, -0.5, 0.8),
+             torch.nn.functional.hardtanh(tx, -0.5, 0.8)),
+            (F.mish(px), torch.nn.functional.mish(tx)),
+            (F.hardswish(px), torch.nn.functional.hardswish(tx)),
+            (F.hardsigmoid(px), torch.nn.functional.hardsigmoid(tx)),
+            (F.glu(px, axis=1), torch.nn.functional.glu(tx, dim=1)),
+            (F.normalize(px, p=3, axis=1),
+             torch.nn.functional.normalize(tx, p=3, dim=1)),
+        ):
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_embedding_padding_idx_zeroes_forward(self):
+        """Reference convention (nn/functional/input.py:142: 'pad all-zero
+        data'): the padding_idx row is ZERO in the forward output — unlike
+        torch, where padding_idx only masks gradients."""
+        rng = np.random.default_rng(53)
+        emb = rng.standard_normal((10, 4)).astype("float32")
+        ids = np.array([[1, 2, 3], [2, 2, 5]], "int64")
+        out = F.embedding(paddle.to_tensor(ids), paddle.to_tensor(emb),
+                          padding_idx=2).numpy()
+        np.testing.assert_allclose(out[ids == 2], 0.0)
+        np.testing.assert_allclose(out[0, 0], emb[1], rtol=1e-6)
+
+    def test_bilinear_prelu_pairwise(self):
+        rng = np.random.default_rng(54)
+        b1 = rng.standard_normal((5, 3)).astype("float32")
+        b2 = rng.standard_normal((5, 4)).astype("float32")
+        W = rng.standard_normal((6, 3, 4)).astype("float32")
+        bb = rng.standard_normal((6,)).astype("float32")
+        got = F.bilinear(paddle.to_tensor(b1), paddle.to_tensor(b2),
+                         paddle.to_tensor(W),
+                         paddle.to_tensor(bb.reshape(1, -1)))
+        ref = torch.nn.functional.bilinear(_t(b1), _t(b2), _t(W), _t(bb))
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-4, atol=1e-5)
+        xi = rng.standard_normal((2, 3, 4, 4)).astype("float32")
+        alphas = np.array([0.1, 0.2, 0.3], "float32")
+        got = F.prelu(paddle.to_tensor(xi), paddle.to_tensor(alphas))
+        ref = torch.nn.functional.prelu(_t(xi), _t(alphas))
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+        u = rng.standard_normal((4, 6)).astype("float32")
+        v = rng.standard_normal((4, 6)).astype("float32")
+        got = F.pairwise_distance(paddle.to_tensor(u), paddle.to_tensor(v),
+                                  p=3)
+        ref = torch.nn.functional.pairwise_distance(_t(u), _t(v), p=3)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-5)
