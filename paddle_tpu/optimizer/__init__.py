@@ -27,6 +27,17 @@ __all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "Adam", "AdamW", "AdamMax"
            "RMSProp", "Adadelta", "Lamb", "lr", "LRScheduler"]
 
 
+
+def _updatable(p):
+    """Reference optimizers update ANY tensor with stop_gradient=False, not
+    just Parameters (optimizer.py accepts plain tensors in `parameters`) —
+    filtering to Parameter silently no-ops user code like
+    `SGD(parameters=[paddle.to_tensor(w, stop_gradient=False)])`."""
+    if isinstance(p, Parameter):
+        return p.trainable
+    return isinstance(p, Tensor) and not p.stop_gradient
+
+
 class Optimizer:
     _accum_names: tuple = ()
 
@@ -95,21 +106,25 @@ class Optimizer:
         # decoupled decay instead.
         return True
 
-    # -- eager step ---------------------------------------------------------
-    @jax.named_scope("optimizer_step")
-    def step(self):
-        params = self._parameter_list
-        if params is None:
+    def _live_params_and_grads(self):
+        """Updatable params + their (possibly clipped) raw grads.  Shared by
+        every eager step() so parameter-eligibility / clipping changes land
+        in ONE place."""
+        if self._parameter_list is None:
             raise ValueError("optimizer constructed without parameters; pass parameters=")
-        params = [p for p in params if isinstance(p, Parameter) and p.trainable]
+        params = [p for p in self._parameter_list if _updatable(p)]
         grads = [p.grad._data if p.grad is not None else None for p in params]
-
         if self._grad_clip is not None:
             live = [g for g in grads if g is not None]
             clipped = self._grad_clip.clip_raw(live)
             it = iter(clipped)
             grads = [next(it) if g is not None else None for g in grads]
+        return params, grads
 
+    # -- eager step ---------------------------------------------------------
+    @jax.named_scope("optimizer_step")
+    def step(self):
+        params, grads = self._live_params_and_grads()
         lr_val = self.get_lr()
         wd = self._wd_coeff()
         self._step_count += 1
@@ -122,7 +137,7 @@ class Optimizer:
             g = g.astype(w.dtype)
             if wd and self._l2_into_grad() and getattr(p, "regularizer", None) is None:
                 g = g + wd * w
-            p_lr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            p_lr = lr_val * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             new_w, new_state = self._update_raw(w, g, state, p_lr, self._step_count)
             if master is not None:
                 new_state["master_weight"] = new_w
@@ -310,13 +325,7 @@ class AdamW(Adam):
 
     def step(self):
         # same as base but honoring apply_decay_param_fun per param
-        params = [p for p in (self._parameter_list or []) if isinstance(p, Parameter) and p.trainable]
-        grads = [p.grad._data if p.grad is not None else None for p in params]
-        if self._grad_clip is not None:
-            live = [g for g in grads if g is not None]
-            clipped = self._grad_clip.clip_raw(live)
-            it = iter(clipped)
-            grads = [next(it) if g is not None else None for g in grads]
+        params, grads = self._live_params_and_grads()
         lr_val = self.get_lr()
         self._step_count += 1
         for p, g in zip(params, grads):
@@ -329,7 +338,7 @@ class AdamW(Adam):
             decay = True
             if self._apply_decay_param_fun is not None:
                 decay = self._apply_decay_param_fun(p.name)
-            p_lr = lr_val * p.optimize_attr.get("learning_rate", 1.0)
+            p_lr = lr_val * getattr(p, "optimize_attr", {}).get("learning_rate", 1.0)
             if self._lr_ratio is not None:
                 p_lr = p_lr * self._lr_ratio(p)
             new_w, new_state = self._update_raw(w, g, state, p_lr, self._step_count, decay=decay)
@@ -424,13 +433,7 @@ class Lamb(Optimizer):
         return w - lr * trust * r, {**s, "moment1": m, "moment2": v}
 
     def step(self):
-        params = [p for p in (self._parameter_list or []) if isinstance(p, Parameter) and p.trainable]
-        grads = [p.grad._data if p.grad is not None else None for p in params]
-        if self._grad_clip is not None:
-            live = [g for g in grads if g is not None]
-            clipped = self._grad_clip.clip_raw(live)
-            it = iter(clipped)
-            grads = [next(it) if g is not None else None for g in grads]
+        params, grads = self._live_params_and_grads()
         lr_val = self.get_lr()
         self._step_count += 1
         for p, g in zip(params, grads):

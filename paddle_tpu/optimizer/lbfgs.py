@@ -11,7 +11,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from . import Optimizer
-from ..tensor import Parameter
+from . import _updatable
 
 __all__ = ["LBFGS"]
 
@@ -60,8 +60,7 @@ class LBFGS(Optimizer):
 
     # -- flat views ---------------------------------------------------------
     def _params(self):
-        ps = [p for p in (self._parameter_list or [])
-              if isinstance(p, Parameter) and p.trainable]
+        ps = [p for p in (self._parameter_list or []) if _updatable(p)]
         if not ps:
             raise ValueError("LBFGS requires parameters=")
         return ps

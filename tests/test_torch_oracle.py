@@ -869,3 +869,70 @@ class TestConvMiscVsTorch:
         ref = torch.nn.functional.pairwise_distance(_t(u), _t(v), p=3)
         np.testing.assert_allclose(got.numpy(), ref.numpy(),
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestOptimizersVsTorch:
+    """Single/multi-step update-math parity.  Params are PLAIN tensors with
+    stop_gradient=False (not nn Parameters) — pinning the reference behavior
+    that optimizers update any trainable tensor, which a Parameter-only
+    filter silently no-ops."""
+
+    W0 = np.linspace(-1, 1, 6).astype("float32").reshape(2, 3)
+
+    def _run_paddle(self, name, kw, steps=3):
+        p = paddle.to_tensor(self.W0.copy())
+        p.stop_gradient = False
+        opt = getattr(paddle.optimizer, name)(parameters=[p], **kw)
+        for _ in range(steps):
+            loss = (p * p).sum() * 0.5 + (p.sum() * 0.1)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return p.numpy()
+
+    def _run_torch(self, cls, kw, steps=3):
+        p = torch.nn.Parameter(torch.from_numpy(self.W0.copy()))
+        opt = cls([p], **kw)
+        for _ in range(steps):
+            opt.zero_grad()
+            loss = (p * p).sum() * 0.5 + (p.sum() * 0.1)
+            loss.backward()
+            opt.step()
+        return p.detach().numpy()
+
+    @pytest.mark.parametrize("pname,pkw,tcls,tkw", [
+        ("SGD", dict(learning_rate=0.1), "SGD", dict(lr=0.1)),
+        ("Momentum", dict(learning_rate=0.1, momentum=0.9), "SGD",
+         dict(lr=0.1, momentum=0.9)),
+        ("Momentum", dict(learning_rate=0.1, momentum=0.9,
+                          use_nesterov=True), "SGD",
+         dict(lr=0.1, momentum=0.9, nesterov=True)),
+        ("Adam", dict(learning_rate=0.01), "Adam", dict(lr=0.01)),
+        ("AdamW", dict(learning_rate=0.01, weight_decay=0.1), "AdamW",
+         dict(lr=0.01, weight_decay=0.1)),
+        ("Adamax", dict(learning_rate=0.01), "Adamax", dict(lr=0.01)),
+        ("Adagrad", dict(learning_rate=0.05), "Adagrad", dict(lr=0.05)),
+        ("Adadelta", dict(learning_rate=1.0, rho=0.9), "Adadelta",
+         dict(lr=1.0, rho=0.9)),
+    ])
+    def test_update_math_matches_torch(self, pname, pkw, tcls, tkw):
+        got = self._run_paddle(pname, pkw)
+        ref = self._run_torch(getattr(torch.optim, tcls), tkw)
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_rmsprop_reference_epsilon_convention(self):
+        """paddle RMSProp puts epsilon INSIDE the sqrt (rmsprop kernel:
+        g / sqrt(ms + eps)); torch puts it outside — numpy is the oracle."""
+        w = self.W0.copy()
+        ms = np.zeros_like(w)
+        for _ in range(3):
+            g = w + 0.1
+            ms = 0.9 * ms + 0.1 * g * g
+            w = w - 0.01 * g / np.sqrt(ms + 1e-6)
+        got = self._run_paddle("RMSProp", dict(learning_rate=0.01, rho=0.9))
+        np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+    def test_plain_tensor_actually_updates(self):
+        """Regression: SGD over a plain to_tensor must change its values."""
+        got = self._run_paddle("SGD", dict(learning_rate=0.1), steps=1)
+        assert not np.allclose(got, self.W0)
