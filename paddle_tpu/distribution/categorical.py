@@ -10,24 +10,33 @@ from .distribution import Distribution, _fv, _key, _shape, _v, _wrap
 
 
 class Categorical(Distribution):
-    """Parameterized by (unnormalized) logits like the reference (which takes
-    `logits` that it normalizes by sum — here softmax-normalized)."""
+    """Reference semantics (distribution/categorical.py): `logits` are
+    UNNORMALIZED PROBABILITIES — probs/log_prob/sample divide by the sum
+    (:122 `self.logits / dist_sum`), while entropy/kl_divergence use the
+    softmax of logits (:226-269).  Both conventions are reproduced."""
 
     def __init__(self, logits=None, probs=None, name=None):
         if (logits is None) == (probs is None):
             raise ValueError("pass exactly one of logits/probs")
         if probs is not None:
+            # probs= extension: store log-probs as logits so BOTH families
+            # (sum-normalize and softmax) recover exactly the given p
             p = _fv(probs)
             p = p / p.sum(-1, keepdims=True)
-            self.logits = jnp.log(jnp.clip(p, 1e-12, None))
+            self.logits = jnp.log(jnp.clip(p, 1e-37, None))
+            self._prob = p
         else:
             self.logits = _fv(logits)
+            # sum-normalized (sampling/probs/log_prob family)
+            self._prob = self.logits / self.logits.sum(-1, keepdims=True)
+        self._logp = jnp.log(jnp.clip(self._prob, 1e-37, None))
+        # softmax-normalized (entropy/kl family)
         self._probs = jax.nn.softmax(self.logits, -1)
         super().__init__(self.logits.shape[:-1])
 
     @property
     def probs(self):
-        return _wrap(self._probs)
+        return _wrap(self._prob)
 
     @property
     def num_events(self):
@@ -35,27 +44,27 @@ class Categorical(Distribution):
 
     @property
     def mean(self):
-        return _wrap(jnp.sum(self._probs * jnp.arange(self.num_events,
-                                                      dtype=self._probs.dtype), -1))
+        # moments follow the SAMPLING distribution (_prob), so empirical
+        # sample statistics match mean/variance
+        return _wrap(jnp.sum(self._prob * jnp.arange(self.num_events,
+                                                     dtype=self._prob.dtype), -1))
 
     @property
     def variance(self):
-        k = jnp.arange(self.num_events, dtype=self._probs.dtype)
-        m = jnp.sum(self._probs * k, -1, keepdims=True)
-        return _wrap(jnp.sum(self._probs * (k - m) ** 2, -1))
+        k = jnp.arange(self.num_events, dtype=self._prob.dtype)
+        m = jnp.sum(self._prob * k, -1, keepdims=True)
+        return _wrap(jnp.sum(self._prob * (k - m) ** 2, -1))
 
     def sample(self, shape=()):
         shp = _shape(shape)
         out = jax.random.categorical(
-            _key(), self.logits, axis=-1,
-            shape=shp + self.batch_shape)
+            _key(), self._logp, axis=-1, shape=shp + self.batch_shape)
         return _wrap(out.astype(jnp.int64))
 
     def log_prob(self, value):
         v = _v(value).astype(jnp.int32)
-        logp = jax.nn.log_softmax(self.logits, -1)
         return _wrap(jnp.take_along_axis(
-            jnp.broadcast_to(logp, v.shape + (self.num_events,)),
+            jnp.broadcast_to(self._logp, v.shape + (self.num_events,)),
             v[..., None], axis=-1)[..., 0])
 
     def probabilities(self, value=None):

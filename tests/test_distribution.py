@@ -97,10 +97,23 @@ class TestLogProbVsScipy:
         np.testing.assert_allclose(got, st.multivariate_normal(mu, cov).logpdf(x),
                                    rtol=1e-4)
 
-    def test_categorical(self):
-        logits = np.log(np.array([0.2, 0.3, 0.5], np.float32))
-        got = _np(D.Categorical(logits=logits).log_prob(np.array([0, 2])))
+    def test_categorical_reference_conventions(self):
+        """Reference categorical.py: `logits` are unnormalized probabilities;
+        probs/log_prob divide by the sum (:122) while entropy/kl use
+        softmax(logits) (:226-269) — both conventions pinned."""
+        raw = np.array([0.4, 0.6, 1.0], np.float32)  # sums to 2
+        d = D.Categorical(logits=raw)
+        got = _np(d.log_prob(np.array([0, 2])))
         np.testing.assert_allclose(got, np.log([0.2, 0.5]), rtol=1e-5)
+        np.testing.assert_allclose(_np(d.probs), raw / raw.sum(), rtol=1e-6)
+        sm = np.exp(raw) / np.exp(raw).sum()
+        np.testing.assert_allclose(float(d.entropy()),
+                                   float(-(sm * np.log(sm)).sum()), rtol=1e-5)
+        q = D.Categorical(logits=np.array([1.0, 1.0, 2.0], np.float32))
+        smq = np.exp([1.0, 1.0, 2.0]) / np.exp([1.0, 1.0, 2.0]).sum()
+        np.testing.assert_allclose(
+            float(D.kl_divergence(d, q)),
+            float((sm * (np.log(sm) - np.log(smq))).sum()), rtol=1e-5)
 
 
 class TestMomentsAndSampling:
@@ -172,8 +185,10 @@ class TestKL:
         (lambda: D.Laplace(0.0, 1.0), lambda: D.Laplace(0.5, 2.0)),
         (lambda: D.Dirichlet(np.array([2.0, 3.0], np.float32)),
          lambda: D.Dirichlet(np.array([1.0, 1.5], np.float32))),
-        (lambda: D.Categorical(logits=np.log(np.array([0.3, 0.7], np.float32))),
-         lambda: D.Categorical(logits=np.log(np.array([0.6, 0.4], np.float32)))),
+        # Categorical excluded here: the reference's sampling/log_prob use
+        # sum-normalized probs while its KL uses softmax(logits) — the two
+        # conventions disagree, so closed-form-vs-MC cannot match (see
+        # TestLogProbVsScipy.test_categorical_reference_conventions)
         (lambda: D.Bernoulli(0.3), lambda: D.Bernoulli(0.6)),
         (lambda: D.Geometric(0.4), lambda: D.Geometric(0.7)),
         (lambda: D.Poisson(2.0), lambda: D.Poisson(4.0)),

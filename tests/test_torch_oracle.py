@@ -936,3 +936,67 @@ class TestOptimizersVsTorch:
         """Regression: SGD over a plain to_tensor must change its values."""
         got = self._run_paddle("SGD", dict(learning_rate=0.1), steps=1)
         assert not np.allclose(got, self.W0)
+
+
+class TestDistributionsVsTorch:
+    """log_prob/entropy parity for the continuous/discrete families whose
+    semantics torch shares (Categorical is excluded: the reference's
+    sum-normalization is paddle-specific, pinned in test_distribution.py)."""
+
+    def test_log_prob_and_entropy(self):
+        import torch.distributions as td
+        P = paddle.distribution
+        rng = np.random.default_rng(60)
+        v = rng.standard_normal(5).astype("float32")
+        pos = (np.abs(rng.standard_normal(5)) + 0.5).astype("float32")
+        counts = np.array([0.0, 1, 2, 5, 9], "float32")
+        cases = [
+            (P.Normal(0.5, 1.3), td.Normal(0.5, 1.3), v, True),
+            (P.Laplace(0.2, 0.8), td.Laplace(0.2, 0.8), v, True),
+            (P.Gumbel(0.1, 1.1), td.Gumbel(0.1, 1.1), v, True),
+            (P.Cauchy(0.0, 1.5), td.Cauchy(0.0, 1.5), v, True),
+            (P.Exponential(1.7), td.Exponential(1.7), pos, True),
+            (P.Gamma(2.0, 1.5), td.Gamma(2.0, 1.5), pos, True),
+            (P.Beta(2.0, 3.0), td.Beta(2.0, 3.0),
+             (pos / (pos.max() + 1)).clip(0.05, 0.95), True),
+            (P.LogNormal(0.1, 0.9), td.LogNormal(0.1, 0.9), pos, True),
+            (P.StudentT(5.0, 0.1, 1.2), td.StudentT(5.0, 0.1, 1.2), v, True),
+            (P.Geometric(0.3), td.Geometric(0.3), counts, True),
+            (P.Poisson(2.5), td.Poisson(2.5), counts, False),  # torch: no H
+        ]
+        for pd, rd, x, check_ent in cases:
+            name = type(pd).__name__
+            np.testing.assert_allclose(
+                pd.log_prob(paddle.to_tensor(x)).numpy(),
+                rd.log_prob(_t(x)).numpy(), rtol=1e-4, atol=1e-5,
+                err_msg=name)
+            if check_ent:
+                np.testing.assert_allclose(
+                    np.asarray(pd.entropy().numpy()),
+                    np.asarray(rd.entropy().numpy()), rtol=1e-4, atol=1e-5,
+                    err_msg=name)
+
+    def test_kl_closed_forms(self):
+        import torch.distributions as td
+        P = paddle.distribution
+        for (p1, q1), (p2, q2) in [
+            ((P.Normal(0.0, 1.0), P.Normal(0.5, 2.0)),
+             (td.Normal(0.0, 1.0), td.Normal(0.5, 2.0))),
+            ((P.Beta(2.0, 3.0), P.Beta(1.0, 1.0)),
+             (td.Beta(2.0, 3.0), td.Beta(1.0, 1.0))),
+            ((P.Gamma(2.0, 1.0), P.Gamma(3.0, 2.0)),
+             (td.Gamma(2.0, 1.0), td.Gamma(3.0, 2.0))),
+        ]:
+            np.testing.assert_allclose(
+                float(P.kl_divergence(p1, q1)),
+                float(td.kl_divergence(p2, q2)), rtol=1e-4)
+
+    def test_multinomial_log_prob(self):
+        import torch.distributions as td
+        probs = np.array([0.2, 0.3, 0.5], "float32")
+        m1 = paddle.distribution.Multinomial(5, paddle.to_tensor(probs))
+        m2 = td.Multinomial(5, probs=_t(probs))
+        xm = np.array([1.0, 2, 2], "float32")
+        np.testing.assert_allclose(
+            float(m1.log_prob(paddle.to_tensor(xm))),
+            float(m2.log_prob(_t(xm))), rtol=1e-5)
