@@ -95,6 +95,10 @@ class GradScaler:
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
+        # id(optimizer) -> "unscaled" | "stepped"; absent = initial.
+        # Mirrors the reference's per-optimizer _optimizer_states so one
+        # scaler can drive several optimizers per iteration (GAN pattern)
+        self._opt_state: dict = {}
         self._bad_steps = 0
         self._found_inf = False
 
@@ -112,38 +116,59 @@ class GradScaler:
             return var
         return var * self._scale
 
-    def unscale_(self, optimizer):
-        if not self._enable:
-            return
+    def _do_unscale(self, optimizer):
         import jax.numpy as jnp
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
-        found = False
         for p in params:
             if p.grad is None:
                 continue
-            g = p.grad._data * inv
-            p.grad._data = g
+            p.grad._data = p.grad._data * inv
         finite = [jnp.all(jnp.isfinite(p.grad._data)) for p in params if p.grad is not None]
         if finite:
-            self._found_inf = not bool(jnp.all(jnp.stack(finite)))
-        else:
-            self._found_inf = False
+            # OR-accumulate across the optimizers unscaled this iteration
+            self._found_inf = self._found_inf or not bool(
+                jnp.all(jnp.stack(finite)))
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        st = self._opt_state.get(id(optimizer))
+        if st == "unscaled":
+            raise RuntimeError(
+                "unscale_() has already been called since the last update().")
+        if st == "stepped":
+            raise RuntimeError("unscale_() is being called after step().")
+        self._do_unscale(optimizer)
+        self._opt_state[id(optimizer)] = "unscaled"
 
     def step(self, optimizer):
+        """Reference grad_scaler.py:716 — step() only applies (or skips) the
+        optimizer update; the loss-scale adjustment happens in the SEPARATE
+        update() call.  Grads are unscaled once per optimizer per iteration
+        (an explicit prior unscale_() is honored, not repeated), and a second
+        step() on the same optimizer without update() raises."""
         if not self._enable:
             optimizer.step()
             return
-        self.unscale_(optimizer)
+        st = self._opt_state.get(id(optimizer))
+        if st == "stepped":
+            raise RuntimeError(
+                "step() has already been called since the last update().")
+        if st is None:
+            self._do_unscale(optimizer)
         if not self._found_inf:
             optimizer.step()
-        self.update()
+        self._opt_state[id(optimizer)] = "stepped"
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)
+        self.update()
 
     def update(self):
+        self._opt_state.clear()
         if not (self._enable and self._dynamic):
+            self._found_inf = False
             return
         if self._found_inf:
             self._bad_steps += 1
@@ -157,6 +182,7 @@ class GradScaler:
             if self._good_steps >= self._incr_every:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
+        self._found_inf = False
 
     def state_dict(self):
         return {"scale": self._scale, "incr_ratio": self._incr_ratio,

@@ -24,19 +24,19 @@ class Categorical(Distribution):
             p = _fv(probs)
             p = p / p.sum(-1, keepdims=True)
             self.logits = jnp.log(jnp.clip(p, 1e-37, None))
-            self._prob = p
+            self._sum_probs = p
         else:
             self.logits = _fv(logits)
             # sum-normalized (sampling/probs/log_prob family)
-            self._prob = self.logits / self.logits.sum(-1, keepdims=True)
-        self._logp = jnp.log(jnp.clip(self._prob, 1e-37, None))
+            self._sum_probs = self.logits / self.logits.sum(-1, keepdims=True)
+        self._logp = jnp.log(jnp.clip(self._sum_probs, 1e-37, None))
         # softmax-normalized (entropy/kl family)
-        self._probs = jax.nn.softmax(self.logits, -1)
+        self._softmax_probs = jax.nn.softmax(self.logits, -1)
         super().__init__(self.logits.shape[:-1])
 
     @property
     def probs(self):
-        return _wrap(self._prob)
+        return _wrap(self._sum_probs)
 
     @property
     def num_events(self):
@@ -44,16 +44,16 @@ class Categorical(Distribution):
 
     @property
     def mean(self):
-        # moments follow the SAMPLING distribution (_prob), so empirical
+        # moments follow the SAMPLING distribution (_sum_probs), so empirical
         # sample statistics match mean/variance
-        return _wrap(jnp.sum(self._prob * jnp.arange(self.num_events,
-                                                     dtype=self._prob.dtype), -1))
+        return _wrap(jnp.sum(self._sum_probs * jnp.arange(self.num_events,
+                                                     dtype=self._sum_probs.dtype), -1))
 
     @property
     def variance(self):
-        k = jnp.arange(self.num_events, dtype=self._prob.dtype)
-        m = jnp.sum(self._prob * k, -1, keepdims=True)
-        return _wrap(jnp.sum(self._prob * (k - m) ** 2, -1))
+        k = jnp.arange(self.num_events, dtype=self._sum_probs.dtype)
+        m = jnp.sum(self._sum_probs * k, -1, keepdims=True)
+        return _wrap(jnp.sum(self._sum_probs * (k - m) ** 2, -1))
 
     def sample(self, shape=()):
         shp = _shape(shape)
@@ -72,13 +72,13 @@ class Categorical(Distribution):
 
     def entropy(self):
         logp = jax.nn.log_softmax(self.logits, -1)
-        return _wrap(-jnp.sum(self._probs * logp, -1))
+        return _wrap(-jnp.sum(self._softmax_probs * logp, -1))
 
     def kl_divergence(self, other):
         if isinstance(other, Categorical):
             lp = jax.nn.log_softmax(self.logits, -1)
             lq = jax.nn.log_softmax(other.logits, -1)
-            return _wrap(jnp.sum(self._probs * (lp - lq), -1))
+            return _wrap(jnp.sum(self._softmax_probs * (lp - lq), -1))
         return super().kl_divergence(other)
 
 

@@ -302,6 +302,61 @@ class TestAmp:
         scaler.step(opt2)
         np.testing.assert_allclose(sp.numpy(), [2.0 - 0.4], rtol=1e-5)
 
+    def test_grad_scaler_update_cadence(self):
+        """Reference grad_scaler.py:716 contract: step() never adjusts the
+        scale (update() does, every incr_every_n_steps good steps), and a
+        second step() without update() raises."""
+        p = paddle.to_tensor(np.ones(3, np.float32))
+        p.stop_gradient = False
+        opt = paddle.optimizer.SGD(parameters=[p], learning_rate=0.1)
+        sc = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   incr_every_n_steps=2,
+                                   decr_every_n_nan_or_inf=1)
+        scales = []
+        for _ in range(5):
+            loss = (p * p).sum()
+            sc.scale(loss).backward()
+            sc.step(opt)
+            sc.update()
+            opt.clear_grad()
+            scales.append(sc.state_dict()["scale"])
+        assert scales == [1024.0, 2048.0, 2048.0, 4096.0, 4096.0], scales
+        loss = (p * p).sum()
+        sc.scale(loss).backward()
+        sc.step(opt)
+        with pytest.raises(RuntimeError, match="update"):
+            sc.step(opt)
+
+    def test_grad_scaler_multi_optimizer_and_explicit_unscale(self):
+        """Per-optimizer step state (GAN pattern: two step() per update())
+        and unscale-once (explicit unscale_ before step must not divide the
+        grads by the scale twice)."""
+        pa = paddle.to_tensor(np.ones(2, np.float32))
+        pa.stop_gradient = False
+        pb = paddle.to_tensor(np.ones(2, np.float32))
+        pb.stop_gradient = False
+        oa = paddle.optimizer.SGD(parameters=[pa], learning_rate=0.1)
+        ob = paddle.optimizer.SGD(parameters=[pb], learning_rate=0.1)
+        sc = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = (pa * pa).sum() + (pb * pb).sum()
+        sc.scale(loss).backward()
+        sc.step(oa)
+        sc.step(ob)        # second optimizer in the same iteration: legal
+        sc.update()
+        np.testing.assert_allclose(pa.numpy(), [0.8, 0.8], rtol=1e-6)
+        np.testing.assert_allclose(pb.numpy(), [0.8, 0.8], rtol=1e-6)
+        oa.clear_grad(); ob.clear_grad()
+        # explicit unscale_ then clip then step: grads unscaled exactly once
+        loss = (pa * pa).sum()
+        sc.scale(loss).backward()
+        sc.unscale_(oa)
+        np.testing.assert_allclose(pa.grad.numpy(), [1.6, 1.6], rtol=1e-6)
+        sc.step(oa)
+        np.testing.assert_allclose(pa.numpy(), [0.8 - 0.16] * 2, rtol=1e-5)
+        with pytest.raises(RuntimeError, match="unscale_"):
+            sc.unscale_(oa)
+        sc.update()
+
 
 class TestIO:
     def test_dataloader(self):

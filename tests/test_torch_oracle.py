@@ -1000,3 +1000,43 @@ class TestDistributionsVsTorch:
         np.testing.assert_allclose(
             float(m1.log_prob(paddle.to_tensor(xm))),
             float(m2.log_prob(_t(xm))), rtol=1e-5)
+
+
+class TestLRSchedulersVsTorch:
+    def test_decay_curves_match(self):
+        L = paddle.optimizer.lr
+
+        def run_paddle(s, steps=12):
+            out = []
+            for _ in range(steps):
+                out.append(float(s()))
+                s.step()
+            return np.array(out)
+
+        def run_torch(cls, kw, steps=12):
+            p = torch.nn.Parameter(torch.zeros(1))
+            opt = torch.optim.SGD([p], lr=0.1)
+            s = cls(opt, **kw)
+            out = []
+            for _ in range(steps):
+                out.append(opt.param_groups[0]["lr"])
+                opt.step()
+                s.step()
+            return np.array(out)
+
+        TL = torch.optim.lr_scheduler
+        for name, ps, tc, tkw in [
+            ("step", L.StepDecay(0.1, step_size=4, gamma=0.5), TL.StepLR,
+             dict(step_size=4, gamma=0.5)),
+            ("multistep", L.MultiStepDecay(0.1, milestones=[3, 7], gamma=0.1),
+             TL.MultiStepLR, dict(milestones=[3, 7], gamma=0.1)),
+            ("exp", L.ExponentialDecay(0.1, gamma=0.9), TL.ExponentialLR,
+             dict(gamma=0.9)),
+            ("cosine", L.CosineAnnealingDecay(0.1, T_max=10),
+             TL.CosineAnnealingLR, dict(T_max=10)),
+            ("linear", L.LinearLR(0.1, total_steps=8, start_factor=0.25,
+                                  end_factor=1.0), TL.LinearLR,
+             dict(start_factor=0.25, end_factor=1.0, total_iters=8)),
+        ]:
+            np.testing.assert_allclose(run_paddle(ps), run_torch(tc, tkw),
+                                       rtol=1e-6, atol=1e-9, err_msg=name)
