@@ -224,7 +224,9 @@ def bench_moe(dev, on_tpu):
             dtype=jnp.bfloat16, remat=True, num_experts=8, moe_top_k=2,
             moe_dispatch="scatter")
         # scatter dispatch (no (N,X,C) one-hot tensors) lifts the round-4
-        # 8k-token/chip ceiling: run the llama headline shape B2/S8192
+        # 8k-token/chip ceiling: run the llama headline shape B2/S8192.
+        # capacity_factor stays at the 1.25 training default — cf=1.0
+        # measured 44.1k tok/s / 44.4% MFU but drops more tokens
         B, S, steps = 2, 8192, 10
     else:
         cfg = MoELlamaConfig.tiny()
