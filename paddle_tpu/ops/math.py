@@ -46,7 +46,7 @@ _UNARY = {
     "erfinv": jax.scipy.special.erfinv, "lgamma": jax.scipy.special.gammaln,
     "digamma": jax.scipy.special.digamma, "i0": jax.scipy.special.i0,
     "i1": jax.scipy.special.i1, "sigmoid": jax.nn.sigmoid,
-    "logit": jax.scipy.special.logit, "angle": jnp.angle, "conj": jnp.conj,
+    "angle": jnp.angle, "conj": jnp.conj,
     "real": jnp.real, "imag": jnp.imag, "rad2deg": jnp.rad2deg,
     "deg2rad": jnp.deg2rad, "exponential_": None,
 }
@@ -60,6 +60,21 @@ for _name, _jfn in _UNARY.items():
         fn.__name__ = nm
         return fn
     _export(_name, _make(_name, _jfn))
+
+def _logit(x, eps=None, name=None):
+    """Reference tensor/math.py:5166 — x clamped to [eps, 1-eps] first when
+    eps is given; eps=None leaves out-of-range inputs to produce NaN."""
+    x = _t(x)
+
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jax.scipy.special.logit(a)
+
+    return apply_op("logit", f, x)
+
+
+_export("logit", _logit)
 
 _export("isnan", lambda x, name=None: apply_op("isnan", jnp.isnan, _t(x)))
 _export("isinf", lambda x, name=None: apply_op("isinf", jnp.isinf, _t(x)))

@@ -1040,3 +1040,47 @@ class TestLRSchedulersVsTorch:
         ]:
             np.testing.assert_allclose(run_paddle(ps), run_torch(tc, tkw),
                                        rtol=1e-6, atol=1e-9, err_msg=name)
+
+
+class TestSpecialFunctionsVsTorch:
+    def test_special_functions(self):
+        rng = np.random.default_rng(70)
+        x = rng.standard_normal((3, 4)).astype("float32")
+        pos = (np.abs(x) + 0.1).astype("float32")
+        u = (rng.random((3, 4)) * 0.98 + 0.01).astype("float32")
+        for name, arg, ref in (
+            ("erf", x, torch.erf(_t(x))),
+            ("erfinv", np.clip(x, -0.99, 0.99),
+             torch.erfinv(_t(np.clip(x, -0.99, 0.99)))),
+            ("lgamma", pos, torch.lgamma(_t(pos))),
+            ("digamma", pos, torch.digamma(_t(pos))),
+            ("log1p", pos, torch.log1p(_t(pos))),
+            ("logit", u, torch.logit(_t(u))),
+            ("i0", x, torch.special.i0(_t(x))),
+            ("i1", x, torch.special.i1(_t(x))),
+        ):
+            got = getattr(paddle, name)(paddle.to_tensor(arg))
+            np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                       rtol=1e-5, atol=1e-6, err_msg=name)
+        # reference logit(eps) clamps to [eps, 1-eps] (tensor/math.py:5166)
+        got = paddle.logit(paddle.to_tensor(u), eps=0.2)
+        ref = torch.logit(_t(u), eps=0.2)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.polygamma(paddle.to_tensor(pos), 1).numpy(),
+            torch.special.polygamma(1, _t(pos)).numpy(),
+            rtol=1e-4, atol=1e-5)
+
+    def test_complex_ops(self):
+        rng = np.random.default_rng(71)
+        c = (rng.standard_normal((3, 4))
+             + 1j * rng.standard_normal((3, 4))).astype("complex64")
+        np.testing.assert_allclose(
+            paddle.angle(paddle.to_tensor(c)).numpy(), np.angle(c),
+            rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            paddle.conj(paddle.to_tensor(c)).numpy(), c.conj())
+        np.testing.assert_allclose(
+            paddle.abs(paddle.to_tensor(c)).numpy(), np.abs(c),
+            rtol=1e-6, atol=1e-7)
