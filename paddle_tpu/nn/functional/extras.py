@@ -15,6 +15,7 @@ import numpy as np
 
 from ... import framework
 from ...tensor import Tensor, apply_op, to_tensor
+from . import _pair
 
 __all__ = [
     "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool3d",
@@ -137,16 +138,27 @@ def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return out
 
 
-def _unpool(x, indices, nd, output_size, data_format, name):
+def _unpool(x, indices, nd, output_size, data_format, name,
+            kernel_size=None, stride=None, padding=0):
     """Scatter pooled values back to their argmax positions.  `indices`
     are flat positions within each (N, C) spatial plane (the reference's
-    max_poolXd(return_mask=True) convention)."""
+    max_poolXd(return_mask=True) convention).  When output_size is None it
+    is inferred as (in-1)*stride + kernel - 2*pad per dim (the reference's
+    _unpool_output_size, pooling.py:695)."""
     x, indices = _t(x), _t(indices)
-    if output_size is None:
+    if not data_format.startswith("NC"):
+        # the scatter body assumes (N, C, *spatial); the reference rejects
+        # channels-last here too (pooling.py:974 "should be 'NCHW'")
         raise ValueError(
-            f"max_unpool{nd}d requires output_size in this build (pass the "
-            "pre-pool spatial shape; inferring from kernel/stride is "
-            "ambiguous at the edges)")
+            f"max_unpool{nd}d supports channels-first data_format only, "
+            f"got {data_format!r}")
+    if output_size is None:
+        k = _pair(kernel_size, nd)
+        st = _pair(stride if stride is not None else kernel_size, nd)
+        pd = _pair(padding, nd)
+        sp = x.shape[-nd:]
+        output_size = [(int(sp[d]) - 1) * st[d] + k[d] - 2 * pd[d]
+                       for d in range(nd)]
     out_sp = tuple(int(s) for s in output_size[-nd:])
 
     def f(a, idx):
@@ -166,17 +178,20 @@ def _unpool(x, indices, nd, output_size, data_format, name):
 
 def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCL", output_size=None, name=None):
-    return _unpool(x, indices, 1, output_size, data_format, name)
+    return _unpool(x, indices, 1, output_size, data_format, name,
+                   kernel_size, stride, padding)
 
 
 def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCHW", output_size=None, name=None):
-    return _unpool(x, indices, 2, output_size, data_format, name)
+    return _unpool(x, indices, 2, output_size, data_format, name,
+                   kernel_size, stride, padding)
 
 
 def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                  data_format="NCDHW", output_size=None, name=None):
-    return _unpool(x, indices, 3, output_size, data_format, name)
+    return _unpool(x, indices, 3, output_size, data_format, name,
+                   kernel_size, stride, padding)
 
 
 # ---------------------------------------------------------------------------

@@ -69,6 +69,40 @@ class TestRnnCellsVsTorch:
 
 
 class TestOpsVsTorch:
+    def test_max_unpool2d_default_output_size(self):
+        """output_size=None infers (in-1)*stride + kernel - 2*pad per dim
+        (reference pooling.py:695) — must match torch's default."""
+        x = np.random.randn(2, 3, 8, 8).astype("float32")
+        tp, tidx = torch.nn.functional.max_pool2d(_t(x), 2,
+                                                  return_indices=True)
+        up = F.max_unpool2d(paddle.to_tensor(tp.numpy()),
+                            paddle.to_tensor(tidx.numpy()), 2)
+        tup = torch.nn.functional.max_unpool2d(tp, tidx, 2)
+        np.testing.assert_allclose(up.numpy(), tup.numpy(),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_pool_mask_static_roundtrip(self):
+        """return_mask + unpool + interpolate survive to_static record-replay
+        (the mask op is a second non-diff record)."""
+        import paddle_tpu.nn.functional as PF
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.conv = nn.Conv2D(3, 4, 3, padding=1)
+
+            def forward(self, x):
+                h = PF.relu(self.conv(x))
+                out, mask = PF.max_pool2d(h, 2, return_mask=True)
+                h2 = PF.interpolate(out, scale_factor=2.0, mode="bilinear")
+                return h2 + PF.max_unpool2d(out, mask, 2)
+
+        net = Net()
+        x = paddle.to_tensor(np.random.randn(2, 3, 8, 8).astype("float32"))
+        eager = net(x).numpy()
+        got = paddle.jit.to_static(net)(x).numpy()
+        np.testing.assert_allclose(got, eager, rtol=1e-5, atol=1e-6)
+
     def test_max_unpool2d(self):
         x = np.random.randn(2, 3, 8, 8).astype("float32")
         tp, tidx = torch.nn.functional.max_pool2d(_t(x), 2,
