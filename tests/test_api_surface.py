@@ -40,8 +40,11 @@ class TestFunctionalExtras:
         ref = np.zeros((1, 1, 4, 4), "float32")
         ref.reshape(-1)[[5, 7, 13, 15]] = [5, 7, 13, 15]
         np.testing.assert_allclose(up.numpy(), ref)
-        with pytest.raises(ValueError):
-            F.max_unpool2d(pooled, idx, 2)
+        # output_size=None infers (in-1)*stride + kernel - 2*pad = 4x4
+        up2 = F.max_unpool2d(pooled, idx, 2)
+        np.testing.assert_allclose(up2.numpy(), ref)
+        with pytest.raises(ValueError, match="channels-first"):
+            F.max_unpool2d(pooled, idx, 2, data_format="NHWC")
 
     def test_diag_embed(self):
         d = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
