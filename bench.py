@@ -265,6 +265,94 @@ def bench_moe(dev, on_tpu):
     }
 
 
+def bench_decode(dev, on_tpu):
+    """Serving decode throughput: paged (block-paged KV + Pallas paged
+    attention) vs the dense static-cache decode, same model/batch/steps.
+    Prefill runs ONCE outside the clock for both paths — the timed loop is
+    greedy decode steps only, so the headline `decode_tokens_per_sec` is
+    the LLMEngine's per-token cost with a full batch."""
+    import jax as _jax
+    from paddle_tpu.models import generation, llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        # the training flagship's shape (~700M, head_dim 128 rides the
+        # kernels' lane tile); decode-heavy split: short prompt, long tail
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            dtype=jnp.bfloat16, remat=False)
+        B, S, new_tokens, page_size = 8, 128, 128, 64
+    else:
+        cfg = LlamaConfig.tiny()
+        B, S, new_tokens, page_size = 2, 8, 4, 4
+
+    params = llama.init_params(cfg, _jax.random.PRNGKey(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (B, S)), jnp.int32)
+    total = S + new_tokens
+
+    # shared prefill (outside the clock): dense cache + pages scattered
+    cache = generation.PagedKVCache(
+        cfg, num_pages=1 + B * (-(-total // page_size)),
+        page_size=page_size, max_slots=B,
+        pages_per_seq=-(-total // page_size))
+    for _ in range(B):
+        cache.ensure_capacity(cache.acquire_slot(), total)
+    dense0 = generation.init_kv_cache(cfg, B, total)
+    logits0, dense0 = generation.forward_with_cache(params, ids, cfg,
+                                                    dense0, 0)
+    pools0 = generation.scatter_prefill_into_pages(
+        {"k": dense0["k"][:, :, :S], "v": dense0["v"][:, :, :S]},
+        cache.pools, cache.page_table, S)
+    tok0 = jnp.argmax(logits0[:, -1], -1).astype(jnp.int32)
+
+    paged_step = _jax.jit(lambda tok, ctx, k, v: generation.forward_paged_decode(
+        params, tok, cfg, {"k": k, "v": v}, cache.page_table, ctx))
+    dense_step = _jax.jit(lambda tok, c_k, c_v, pos: generation.forward_with_cache(
+        params, tok[:, None], cfg, {"k": c_k, "v": c_v}, pos))
+
+    def run_paged():
+        tok, k, v = tok0, pools0["k"], pools0["v"]
+        for i in range(new_tokens):
+            ctx = jnp.full((B,), S + i, jnp.int32)
+            lg, p = paged_step(tok, ctx, k, v)
+            k, v = p["k"], p["v"]
+            tok = jnp.argmax(lg, -1).astype(jnp.int32)
+        return tok
+
+    def run_dense():
+        tok, ck, cv = tok0, dense0["k"], dense0["v"]
+        for i in range(new_tokens):
+            lg, c = dense_step(tok, ck, cv, jnp.int32(S + i))
+            ck, cv = c["k"], c["v"]
+            tok = jnp.argmax(lg[:, -1], -1).astype(jnp.int32)
+        return tok
+
+    def timed(fn):
+        np.asarray(fn())          # compile + warm; host transfer = complete
+        t0 = time.perf_counter()
+        np.asarray(fn())
+        return time.perf_counter() - t0
+
+    dt_paged = timed(run_paged)
+    dt_dense = timed(run_dense)
+    paged_tps = B * new_tokens / dt_paged
+    dense_tps = B * new_tokens / dt_dense
+    return {
+        "metric": "decode_tokens_per_sec",
+        "value": round(paged_tps, 2),
+        "unit": "tokens/sec",
+        "paged_tokens_per_sec": round(paged_tps, 2),
+        "dense_tokens_per_sec": round(dense_tps, 2),
+        "paged_vs_dense": round(paged_tps / dense_tps, 3),
+        "batch": B, "prompt": S, "new_tokens": new_tokens,
+        "page_size": page_size,
+        "model_params": llama.num_params(cfg),
+    }
+
+
 def _run_sub(name: str, timeout: "float | None" = None) -> dict:
     """Run `python bench.py --sub {name}` and parse its one-line JSON."""
     if timeout is None:
@@ -290,7 +378,7 @@ def _run_sub(name: str, timeout: "float | None" = None) -> dict:
 def _sub_main(name: str) -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    fn = {"dit": bench_dit, "moe": bench_moe}[name]
+    fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
     except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
@@ -353,6 +441,7 @@ def main():
     # flagship failing cannot poison the next
     dit_extra = _run_sub("dit")
     moe_extra = _run_sub("moe")
+    decode_extra = _run_sub("decode")
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -374,6 +463,8 @@ def main():
             "dit": dit_extra,
             # BASELINE config 5 (MoE expert-parallel)
             "moe": moe_extra,
+            # serving decode throughput: paged KV + Pallas paged attention
+            "decode": decode_extra,
         },
     }))
 

@@ -1,0 +1,70 @@
+"""Serve a Llama-family model with continuous batching + paged KV cache.
+
+Starts an LLMEngine over a tiny model, exposes the batched HTTP endpoint,
+fires concurrent requests at it, and checks the streamed-back tokens match
+the offline greedy `generate()` chain.
+
+Usage:  python examples/serve_llm.py
+"""
+import os
+import sys
+
+# allow running from a source checkout without installing
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import json
+import threading
+import urllib.request
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference import LLMEngine, serve_llm
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+def main():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    engine = LLMEngine(params, cfg, num_slots=2, page_size=8, max_seq_len=64)
+    srv, _ = serve_llm(engine)
+    url = f"http://127.0.0.1:{srv.server_address[1]}/"
+    print("serving on", url)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in (4, 6, 5)]
+    results = [None] * len(prompts)
+
+    def post(i):
+        req = urllib.request.Request(url, data=json.dumps(
+            {"prompt": prompts[i], "max_new_tokens": 8}).encode())
+        results[i] = json.loads(
+            urllib.request.urlopen(req, timeout=120).read())["tokens"]
+
+    # 3 concurrent requests share 2 decode slots: the third is admitted the
+    # moment a slot frees up (continuous batching), not after a full drain
+    threads = [threading.Thread(target=post, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    for p, got in zip(prompts, results):
+        want = np.asarray(generation.generate(
+            params, jnp.asarray([p], jnp.int32), cfg,
+            max_new_tokens=8))[0].tolist()
+        assert got == want, (got, want)
+        print("served tokens:", got)
+
+    stats = json.loads(urllib.request.urlopen(url + "stats",
+                                              timeout=30).read())
+    print("engine stats:", stats)
+    srv.shutdown()
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

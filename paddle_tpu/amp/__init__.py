@@ -95,9 +95,12 @@ class GradScaler:
         self._decr_every = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
-        # id(optimizer) -> "unscaled" | "stepped"; absent = initial.
-        # Mirrors the reference's per-optimizer _optimizer_states so one
-        # scaler can drive several optimizers per iteration (GAN pattern)
+        # id(optimizer) -> {"state": "unscaled" | "stepped",
+        #                   "found_inf": bool}; absent = initial.  Mirrors
+        # the reference's per-optimizer _optimizer_states so one scaler can
+        # drive several optimizers per iteration (GAN pattern) — each
+        # optimizer's step() is skipped ONLY by its own overflow
+        # (grad_scaler.py:341 resets _found_inf per _unscale)
         self._opt_state: dict = {}
         self._bad_steps = 0
         self._found_inf = False
@@ -117,6 +120,9 @@ class GradScaler:
         return var * self._scale
 
     def _do_unscale(self, optimizer):
+        """Unscale this optimizer's grads; records found_inf PER OPTIMIZER
+        (one optimizer's overflow must not skip another's step — the GAN
+        two-optimizer pattern)."""
         import jax.numpy as jnp
         params = optimizer._parameter_list or []
         inv = 1.0 / self._scale
@@ -125,41 +131,45 @@ class GradScaler:
                 continue
             p.grad._data = p.grad._data * inv
         finite = [jnp.all(jnp.isfinite(p.grad._data)) for p in params if p.grad is not None]
-        if finite:
-            # OR-accumulate across the optimizers unscaled this iteration
-            self._found_inf = self._found_inf or not bool(
-                jnp.all(jnp.stack(finite)))
+        found = bool(finite) and not bool(jnp.all(jnp.stack(finite)))
+        self._opt_state.setdefault(id(optimizer), {})["found_inf"] = found
+        # update()'s scale decision: OR over the optimizers unscaled this
+        # iteration (the scale is shared, so ANY overflow means it is too
+        # high — documented convention; the reference keys off the last
+        # unscale, which under-reacts when only an earlier one overflowed)
+        self._found_inf = self._found_inf or found
 
     def unscale_(self, optimizer):
         if not self._enable:
             return
-        st = self._opt_state.get(id(optimizer))
+        st = self._opt_state.get(id(optimizer), {}).get("state")
         if st == "unscaled":
             raise RuntimeError(
                 "unscale_() has already been called since the last update().")
         if st == "stepped":
             raise RuntimeError("unscale_() is being called after step().")
         self._do_unscale(optimizer)
-        self._opt_state[id(optimizer)] = "unscaled"
+        self._opt_state[id(optimizer)]["state"] = "unscaled"
 
     def step(self, optimizer):
         """Reference grad_scaler.py:716 — step() only applies (or skips) the
         optimizer update; the loss-scale adjustment happens in the SEPARATE
         update() call.  Grads are unscaled once per optimizer per iteration
-        (an explicit prior unscale_() is honored, not repeated), and a second
-        step() on the same optimizer without update() raises."""
+        (an explicit prior unscale_() is honored, not repeated), a second
+        step() on the same optimizer without update() raises, and the skip
+        decision consults only THIS optimizer's found_inf."""
         if not self._enable:
             optimizer.step()
             return
-        st = self._opt_state.get(id(optimizer))
+        st = self._opt_state.get(id(optimizer), {}).get("state")
         if st == "stepped":
             raise RuntimeError(
                 "step() has already been called since the last update().")
         if st is None:
             self._do_unscale(optimizer)
-        if not self._found_inf:
+        if not self._opt_state[id(optimizer)]["found_inf"]:
             optimizer.step()
-        self._opt_state[id(optimizer)] = "stepped"
+        self._opt_state[id(optimizer)]["state"] = "stepped"
 
     def minimize(self, optimizer, scaled_loss):
         self.step(optimizer)

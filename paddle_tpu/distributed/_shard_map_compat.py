@@ -1,0 +1,48 @@
+"""`shard_map` import shim.
+
+Newer jax exposes `jax.shard_map` (kwargs: check_vma, axis_names); older
+releases ship `jax.experimental.shard_map.shard_map` (kwargs: check_rep,
+auto).  Call sites in this package use the new spelling; on older jax this
+module adapts: check_vma -> check_rep, axis_names (the MANUAL axes) ->
+auto (every other mesh axis).
+"""
+
+from __future__ import annotations
+
+try:  # jax >= 0.6
+    from jax import shard_map  # type: ignore[attr-defined]
+except ImportError:
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None,
+                  axis_names=None, **kw):
+        import jax as _jax
+
+        auto = frozenset()
+        if axis_names is not None:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            if auto:
+                kw["auto"] = auto
+        if check_vma is not None:
+            # partial-auto + check_rep is unsupported on legacy jax; without
+            # auto the flag maps straight through
+            kw["check_rep"] = False if auto else check_vma
+        mapped = _legacy_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                   out_specs=out_specs, **kw)
+        if auto:
+            # legacy _shard_map_impl raises NotImplementedError for partial
+            # auto when called EAGERLY; the jit partitioning path supports it
+            mapped = _jax.jit(mapped)
+        return mapped
+
+try:  # jax >= 0.6: avals carry the vma (varying-manual-axes) set
+    from jax import typeof
+except ImportError:
+    def typeof(x):
+        """Older jax has no jax.typeof and no vma tracking; callers read
+        `.vma` via getattr-with-default, so the plain aval is the right
+        no-op stand-in."""
+        import jax.core
+        return jax.core.get_aval(x)
+
+__all__ = ["shard_map", "typeof"]

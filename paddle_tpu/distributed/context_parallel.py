@@ -31,7 +31,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+from ._shard_map_compat import shard_map, typeof
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_lib
@@ -43,8 +43,8 @@ _TINY = np.float32(1e-30)
 def _pvary_like(val, ref):
     """Cast `val` to carry the same varying-manual-axes (vma) type as `ref` —
     needed for scan carries created fresh inside (nested) shard_map bodies."""
-    want = getattr(jax.typeof(ref), "vma", frozenset())
-    have = getattr(jax.typeof(val), "vma", frozenset())
+    want = getattr(typeof(ref), "vma", frozenset())
+    have = getattr(typeof(val), "vma", frozenset())
     need = tuple(a for a in want if a not in have)
     return jax.lax.pcast(val, need, to="varying") if need else val
 
@@ -198,6 +198,14 @@ def manual_axes_in_context() -> frozenset:
         return frozenset(
             a for a, t in zip(am.axis_names, am.axis_types)
             if t == jax.sharding.AxisType.Manual)
+    except AttributeError:
+        # older jax: no abstract-mesh tracking, but the named axes in scope
+        # inside a shard_map/pmap body ARE its manual axes
+        try:
+            from jax._src import core as _core
+            return frozenset(_core.get_axis_env().axis_sizes)
+        except Exception:  # noqa: BLE001 — no axis env
+            return frozenset()
     except Exception:  # noqa: BLE001 — no context mesh
         return frozenset()
 
@@ -238,8 +246,12 @@ def context_parallel_attention(q, k, v, mesh: Optional[Mesh] = None,
     # NB here q/k/v (and any mask) are already LOCAL chunks of the caller's
     # making: ring wants mask rows local, ulysses wants the full mask.
     if in_manual:
-        am = jax.sharding.get_abstract_mesh()
-        if impl == "ulysses" and q.shape[2] % am.shape[seq_axis]:
+        try:
+            n_sep = jax.sharding.get_abstract_mesh().shape[seq_axis]
+        except AttributeError:  # older jax: read the in-scope axis env
+            from jax._src import core as _core
+            n_sep = _core.get_axis_env().axis_sizes[seq_axis]
+        if impl == "ulysses" and q.shape[2] % n_sep:
             if mask is not None:
                 # the two impls take DIFFERENT local mask layouts (ring:
                 # (S/n, S) rows; ulysses: full (S, S)) — a silent downgrade

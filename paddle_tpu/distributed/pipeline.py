@@ -37,7 +37,7 @@ from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from ._shard_map_compat import shard_map, typeof
 from jax.sharding import Mesh, PartitionSpec as P
 
 from . import mesh as mesh_lib
@@ -93,13 +93,15 @@ def num_stages(mesh: Mesh, axis: str = "pipe") -> int:
 
 
 def _vma(val):
-    return tuple(getattr(jax.typeof(val), "vma", frozenset()))
+    return tuple(getattr(typeof(val), "vma", frozenset()))
 
 
 def _pcast_to(val, vary):
-    cur = getattr(jax.typeof(val), "vma", frozenset())
+    cur = getattr(typeof(val), "vma", frozenset())
     need = tuple(a for a in vary if a not in cur)
-    return jax.lax.pcast(val, need, to="varying") if need else val
+    if not need or not hasattr(jax.lax, "pcast"):
+        return val  # legacy jax: no vma types, nothing to cast
+    return jax.lax.pcast(val, need, to="varying")
 
 
 def _wrap_block(block_fn, returns_aux: bool):

@@ -11,9 +11,12 @@ from .distribution import Distribution, _fv, _key, _shape, _v, _wrap
 
 class Categorical(Distribution):
     """Reference semantics (distribution/categorical.py): `logits` are
-    UNNORMALIZED PROBABILITIES — probs/log_prob/sample divide by the sum
-    (:122 `self.logits / dist_sum`), while entropy/kl_divergence use the
-    softmax of logits (:226-269).  Both conventions are reproduced."""
+    UNNORMALIZED PROBABILITIES for probs/log_prob, which divide by the sum
+    (:122 `self.logits / dist_sum`) — while sample() draws from
+    softmax(logits) (Distribution._logits_to_probs, distribution.py:255-265,
+    via multinomial) and entropy/kl_divergence also use the softmax
+    (:226-269).  Both conventions are reproduced; for `probs=` input the two
+    families coincide (stored logits are log-probs)."""
 
     def __init__(self, logits=None, probs=None, name=None):
         if (logits is None) == (probs is None):
@@ -27,7 +30,7 @@ class Categorical(Distribution):
             self._sum_probs = p
         else:
             self.logits = _fv(logits)
-            # sum-normalized (sampling/probs/log_prob family)
+            # sum-normalized (probs/log_prob family; sampling uses softmax)
             self._sum_probs = self.logits / self.logits.sum(-1, keepdims=True)
         self._logp = jnp.log(jnp.clip(self._sum_probs, 1e-37, None))
         # softmax-normalized (entropy/kl family)
@@ -44,21 +47,23 @@ class Categorical(Distribution):
 
     @property
     def mean(self):
-        # moments follow the SAMPLING distribution (_sum_probs), so empirical
-        # sample statistics match mean/variance
-        return _wrap(jnp.sum(self._sum_probs * jnp.arange(self.num_events,
-                                                     dtype=self._sum_probs.dtype), -1))
+        # moments follow the SAMPLING distribution (softmax of logits), so
+        # empirical sample statistics match mean/variance
+        return _wrap(jnp.sum(self._softmax_probs * jnp.arange(
+            self.num_events, dtype=self._softmax_probs.dtype), -1))
 
     @property
     def variance(self):
-        k = jnp.arange(self.num_events, dtype=self._sum_probs.dtype)
-        m = jnp.sum(self._sum_probs * k, -1, keepdims=True)
-        return _wrap(jnp.sum(self._sum_probs * (k - m) ** 2, -1))
+        k = jnp.arange(self.num_events, dtype=self._softmax_probs.dtype)
+        m = jnp.sum(self._softmax_probs * k, -1, keepdims=True)
+        return _wrap(jnp.sum(self._softmax_probs * (k - m) ** 2, -1))
 
     def sample(self, shape=()):
+        # reference Categorical.sample: multinomial over softmax(logits)
+        # (_logits_to_probs) — NOT the sum-normalized probs/log_prob family
         shp = _shape(shape)
         out = jax.random.categorical(
-            _key(), self._logp, axis=-1, shape=shp + self.batch_shape)
+            _key(), self.logits, axis=-1, shape=shp + self.batch_shape)
         return _wrap(out.astype(jnp.int64))
 
     def log_prob(self, value):

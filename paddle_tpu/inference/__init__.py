@@ -23,7 +23,16 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "InferTensor",
-           "serve", "PlaceType"]
+           "serve", "PlaceType", "LLMEngine", "serve_llm"]
+
+
+def __getattr__(name):
+    # lazy: the LLM engine pulls in the model stack, which plain
+    # Config/Predictor users never touch
+    if name in ("LLMEngine", "serve_llm"):
+        from . import llm_engine
+        return getattr(llm_engine, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 class PlaceType:

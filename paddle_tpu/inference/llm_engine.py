@@ -1,0 +1,404 @@
+"""Continuous-batching LLM serving engine over the paged KV cache.
+
+The reference serves generation through a one-request-at-a-time predictor
+loop (PaddleNLP over analysis_predictor.h:94).  Production TPU serving
+(the Gemma-on-TPU study, arxiv 2605.25645) gets its throughput from
+*continuous batching*: a fixed-width decode batch whose rows (slots) are
+re-filled from a request queue the moment a sequence finishes, instead of
+waiting for the whole batch to drain.
+
+Engine anatomy:
+  * `PagedKVCache` (models/generation.py) — page pools + page tables;
+    each admitted request owns a decode slot and that slot's pages.
+  * admission — pending requests enter free slots mid-flight; the prompt
+    is prefilled through the dense flash path (bucketed to the next
+    power-of-two length, so a handful of compiled programs cover all
+    prompt lengths) and scattered into the slot's pages.
+  * decode — ONE jitted step advances every active slot through the
+    Pallas paged-attention kernel; empty slots point at the reserved
+    scratch page and their logits are ignored.
+  * eviction — on EOS or max_new_tokens the slot's pages return to the
+    free pool and the slot re-enters admission.
+
+Pages for prompt+max_new_tokens are reserved at admission (a request
+either fits or stays queued) — reservation keeps the engine deadlock-free
+without preemption; preemption/swap is the next step up, not built here.
+"""
+
+from __future__ import annotations
+
+import collections
+import functools
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import generation
+
+__all__ = ["LLMEngine", "serve_llm"]
+
+
+class _Request:
+    """One queued/in-flight generation request."""
+
+    def __init__(self, prompt, max_new_tokens: int, eos_id: Optional[int]):
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("prompt must hold at least one token")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_id = eos_id
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self._event = threading.Event()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes; returns the generated tokens
+        (ending at eos_id when one was hit)."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("generation did not finish in time")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+
+class _SlotState:
+    def __init__(self, req: _Request, last_tok: int, ctx: int):
+        self.req = req
+        self.last_tok = last_tok    # sampled, not yet in the cache
+        self.ctx = ctx              # tokens currently cached
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class LLMEngine:
+    """Continuous-batching generation engine (queue -> slots -> tokens).
+
+    `num_slots` is the decode batch width (one compiled decode program);
+    `num_pages` bounds resident KV memory — when smaller than worst-case
+    num_slots occupancy, requests queue until pages free up.
+    """
+
+    def __init__(self, params, config, num_slots: int = 4,
+                 page_size: int = 16, max_seq_len: Optional[int] = None,
+                 num_pages: Optional[int] = None, temperature: float = 0.0,
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+        self.params = params
+        self.config = config
+        self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
+        self.max_seq_len = int(max_seq_len or config.max_position_embeddings)
+        if self.max_seq_len > config.max_position_embeddings:
+            # past the rope table jnp.take would silently clamp positions —
+            # wrong tokens with no diagnostic
+            raise ValueError(
+                f"max_seq_len={self.max_seq_len} exceeds the model's "
+                f"max_position_embeddings={config.max_position_embeddings}")
+        pages_per_seq = -(-self.max_seq_len // page_size)
+        if num_pages is None:
+            num_pages = 1 + num_slots * pages_per_seq   # full provisioning
+        self.cache = generation.PagedKVCache(
+            config, num_pages=num_pages, page_size=page_size,
+            max_slots=num_slots, pages_per_seq=pages_per_seq)
+        self._pending: collections.deque = collections.deque()
+        self._slots: dict[int, _SlotState] = {}
+        self._key = jax.random.PRNGKey(seed)
+        self._cv = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+        self.stats = {"admitted": 0, "completed": 0, "decode_steps": 0,
+                      "decode_tokens": 0}
+
+        cfg = config
+
+        # pools are DONATED: the caller always replaces cache.pools with the
+        # result, so XLA updates the page pool in place instead of copying
+        # the whole (L, P, ps, Hkv, D) cache every token (donation is a
+        # no-op on CPU, where jax ignores it with a one-time warning)
+        @functools.partial(jax.jit, donate_argnums=(4, 5))
+        def _decode(params, tok, ctx, page_table, k_pool, v_pool):
+            return generation.forward_paged_decode(
+                params, tok, cfg, {"k": k_pool, "v": v_pool},
+                page_table, ctx)
+
+        self._decode = _decode
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def _prefill(params, ids, k_pool, v_pool, pt_row, true_len):
+            # ids: (1, Sb) RIGHT-padded to the bucket; causal attention
+            # keeps positions < true_len independent of the padding, and
+            # padded positions scatter into the scratch page
+            dense = generation.init_kv_cache(cfg, 1, ids.shape[1])
+            logits, dense = generation.forward_with_cache(
+                params, ids, cfg, dense, 0)
+            pools = generation.scatter_prefill_into_pages(
+                dense, {"k": k_pool, "v": v_pool}, pt_row, ids.shape[1],
+                true_len=true_len[None])
+            last = jnp.take_along_axis(
+                logits, jnp.reshape(true_len - 1, (1, 1, 1)), axis=1)[:, 0]
+            return last, pools["k"], pools["v"]
+
+        self._prefill = _prefill
+
+    # -- client surface -----------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None) -> _Request:
+        req = _Request(prompt, max_new_tokens, eos_id)
+        total = req.prompt.size + req.max_new_tokens
+        if total > self.max_seq_len:
+            raise ValueError(
+                f"prompt+max_new_tokens={total} exceeds engine "
+                f"max_seq_len={self.max_seq_len}")
+        if self.cache.pages_needed(total) > self.cache.num_pages - 1:
+            raise ValueError(
+                f"request needs {self.cache.pages_needed(total)} pages but "
+                f"the pool only holds {self.cache.num_pages - 1}")
+        with self._cv:
+            if self._stop:
+                raise RuntimeError("engine is stopped")
+            self._pending.append(req)
+            self._cv.notify()
+        return req
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int,
+                 eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> List[List[int]]:
+        """Synchronous convenience: submit all prompts and wait.  With the
+        background loop running (start()/serve_llm) this only waits — the
+        loop thread owns the cache; driving step() from a second thread
+        would race slot/page allocation."""
+        reqs = [self.submit(p, max_new_tokens, eos_id) for p in prompts]
+        if self._thread is None:
+            while not all(r.done() for r in reqs):
+                if not self.step():
+                    break  # no progress possible (errors already recorded)
+            timeout = 0
+        return [r.result(timeout=timeout) for r in reqs]
+
+    # -- engine loop --------------------------------------------------------
+
+    def has_work(self) -> bool:
+        return bool(self._pending or self._slots)
+
+    def step(self) -> bool:
+        """One engine iteration: admit pending requests into free slots,
+        advance every active slot one token, evict finished sequences.
+        Returns True when any work was done."""
+        admitted = self._admit()
+        decoded = self._decode_step()
+        return admitted or decoded
+
+    def start(self):
+        """Run the engine loop in a background thread (serving mode)."""
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def shutdown(self):
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        # fail anything still queued/in flight so waiters unblock
+        err = RuntimeError("engine shut down")
+        for req in list(self._pending):
+            req.error = err
+            req._event.set()
+        self._pending.clear()
+        for slot in list(self._slots):
+            st = self._slots.pop(slot)
+            st.req.error = err
+            st.req._event.set()
+            self.cache.release_slot(slot)
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                while not self._stop and not self.has_work():
+                    self._cv.wait()
+                if self._stop:
+                    return
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — fail in-flight requests
+                with self._cv:
+                    for slot in list(self._slots):
+                        st = self._slots.pop(slot)
+                        st.req.error = e
+                        st.req._event.set()
+                        self.cache.release_slot(slot)
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_key(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def _sample(self, logits):
+        return generation.sample_logits(
+            logits, self._next_key(), self.temperature, self.top_k,
+            self.top_p)
+
+    def _admit(self) -> bool:
+        cache = self.cache
+        admitted = False
+        while True:
+            with self._cv:
+                if not self._pending or cache.free_slot_count == 0:
+                    break
+                req = self._pending[0]
+                total = req.prompt.size + req.max_new_tokens
+                if cache.pages_needed(total) > cache.free_page_count:
+                    break  # head-of-line waits for pages (no reordering)
+                self._pending.popleft()
+            slot = cache.acquire_slot()
+            cache.ensure_capacity(slot, total)  # reserve at admission
+            S = req.prompt.size
+            # clamp the bucket to the rope table (non-power-of-2
+            # max_position_embeddings would otherwise over-slice it)
+            Sb = min(_bucket(S), self.config.max_position_embeddings)
+            ids = np.zeros((1, Sb), np.int32)
+            ids[0, :S] = req.prompt
+            last, k_pool, v_pool = self._prefill(
+                self.params, jnp.asarray(ids), cache.pools["k"],
+                cache.pools["v"], cache.page_table[slot][None],
+                jnp.int32(S))
+            cache.pools = {"k": k_pool, "v": v_pool}
+            tok = int(np.asarray(self._sample(last))[0])
+            req.tokens.append(tok)
+            self.stats["admitted"] += 1
+            if (req.eos_id is not None and tok == req.eos_id) \
+                    or req.max_new_tokens == 1:
+                self._finish(slot, req)
+            else:
+                self._slots[slot] = _SlotState(req, tok, ctx=S)
+            admitted = True
+        return admitted
+
+    def _decode_step(self) -> bool:
+        if not self._slots:
+            return False
+        cache = self.cache
+        B = cache.max_slots
+        toks = np.zeros((B,), np.int32)
+        ctx = np.zeros((B,), np.int32)   # empty slots hit the scratch page
+        for slot, st in self._slots.items():
+            # the incoming token lands at cache index st.ctx — make sure
+            # that index's page exists (mid-decode page allocation)
+            cache.ensure_capacity(slot, st.ctx + 1)
+            toks[slot] = st.last_tok
+            ctx[slot] = st.ctx
+        logits, pools = self._decode(
+            self.params, jnp.asarray(toks), jnp.asarray(ctx),
+            cache.page_table, cache.pools["k"], cache.pools["v"])
+        cache.pools = pools
+        nxt = np.asarray(self._sample(logits))
+        self.stats["decode_steps"] += 1
+        self.stats["decode_tokens"] += len(self._slots)
+        for slot in list(self._slots):
+            st = self._slots[slot]
+            st.ctx += 1
+            tok = int(nxt[slot])
+            st.req.tokens.append(tok)
+            st.last_tok = tok
+            if (st.req.eos_id is not None and tok == st.req.eos_id) \
+                    or len(st.req.tokens) >= st.req.max_new_tokens:
+                del self._slots[slot]
+                self._finish(slot, st.req)
+        return True
+
+    def _finish(self, slot: int, req: _Request):
+        self.cache.release_slot(slot)
+        self.stats["completed"] += 1
+        req._event.set()
+
+
+def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
+              max_body_bytes: int = 8 * 1024 * 1024,
+              request_timeout: float = 300.0):
+    """HTTP JSON generation endpoint over a continuous-batching engine.
+
+    POST / with {"prompt": [token ids], "max_new_tokens": N,
+    "eos_id": optional} returns {"tokens": [...]}.  Concurrent requests
+    share the engine's decode batch (continuous batching), so throughput
+    scales with occupancy, not request count.  GET /stats returns engine
+    counters.  Returns (server, thread); server.shutdown() stops the HTTP
+    loop AND the engine."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    engine.start()
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, status: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path.rstrip("/") == "/stats":
+                self._reply(200, dict(engine.stats,
+                                      free_pages=engine.cache.free_page_count,
+                                      free_slots=engine.cache.free_slot_count))
+            else:
+                self._reply(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                if n > max_body_bytes:
+                    self._reply(413, {"error": "body too large"})
+                    return
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = req["prompt"]
+                    max_new = int(req.get("max_new_tokens", 16))
+                    eos_id = req.get("eos_id")
+                except (json.JSONDecodeError, KeyError, TypeError,
+                        ValueError) as e:
+                    self._reply(400, {"error": f"bad request body: {e!r}"})
+                    return
+                try:
+                    handle = engine.submit(prompt, max_new, eos_id)
+                except (ValueError, RuntimeError) as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                toks = handle.result(timeout=request_timeout)
+                self._reply(200, {"tokens": toks})
+            except Exception as e:  # noqa: BLE001 — server-side fault
+                self._reply(500, {"error": repr(e)})
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    _orig_shutdown = srv.shutdown
+
+    def _shutdown():
+        _orig_shutdown()
+        engine.shutdown()
+
+    srv.shutdown = _shutdown
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t

@@ -133,6 +133,33 @@ def attention(q, k, v, mask=None, causal=False, scale=None):
 
 
 # ---------------------------------------------------------------------------
+# Paged decode attention (serving path: block-paged KV + page tables)
+# ---------------------------------------------------------------------------
+
+
+def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
+    """Decode attention over a block-paged KV cache.  q: (B, Hq, D);
+    k_pool/v_pool: (num_pages, page_size, Hkv, D); page_table:
+    (B, pages_per_seq) i32; lengths: (B,) i32 valid tokens per sequence.
+
+    Pallas kernel on TPU; the SAME kernel through the Pallas interpreter on
+    CPU (tier-1 tests exercise the real grid/index-map logic), with the
+    dense-gather XLA path as the fallback."""
+    from .pallas_paged_attention import (paged_attention_pallas,
+                                         paged_attention_reference)
+
+    if framework.get_state().flags.get("FLAGS_use_fused_kernels", True):
+        try:
+            return paged_attention_pallas(q, k_pool, v_pool, page_table,
+                                          lengths, scale=scale,
+                                          interpret=not _on_tpu())
+        except Exception:  # noqa: BLE001 — fall back on any lowering issue
+            _warn_pallas_fallback("paged_attention")
+    return paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                                     scale=scale)
+
+
+# ---------------------------------------------------------------------------
 # Rotary position embedding (reference: fused_rope_kernel.cu /
 # incubate/nn/functional/fused_rotary_position_embedding.py)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,173 @@
+"""Pallas TPU kernel: paged decode attention over a block-paged KV cache.
+
+Reference analog: the reference's decode kernel (`masked_multihead_attention`,
+phi/kernels/fusion/gpu/masked_multihead_attention_kernel.cu) runs over a
+dense per-sequence cache; production TPU serving replaces that with *paged*
+KV (Ragged Paged Attention, arxiv 2604.15464): k/v live in fixed-size pages
+drawn from a shared pool, and each sequence owns a page table.  Memory is
+allocated in O(page_size) quanta — no per-sequence max-length reservation —
+and attention compute scales with each sequence's ACTUAL length, not the
+static batch max.
+
+Layout contract:
+  q:        (B, Hq, D)                 one decode step per sequence
+  k_pool:   (num_pages, page_size, Hkv, D)   shared page pool
+  v_pool:   (num_pages, page_size, Hkv, D)
+  page_table: (B, pages_per_seq) int32 — page_table[b, j] is the pool page
+              holding tokens [j*page_size, (j+1)*page_size) of sequence b
+  lengths:  (B,) int32 — valid tokens per sequence (the current step's k/v
+              already written); slot m of sequence b is live iff m < lengths[b]
+
+Page-table invariants (enforced by the PagedKVCache manager):
+  * entries for j < ceil(lengths[b]/page_size) are distinct allocated pages;
+  * entries BEYOND the used range must still be VALID pool indices (the
+    manager repeats the last allocated page) — the kernel's BlockSpec index
+    map reads them for skipped grid steps, and repeating the previous index
+    lets the Pallas pipeline skip the re-fetch entirely.
+
+Kernel shape: grid (B, Hkv, pages_per_seq), page loop innermost; the page
+table and lengths ride scalar prefetch (pltpu.PrefetchScalarGridSpec) so
+BlockSpec index maps can chase page indirections.  GQA runs at Hkv width:
+the q block for (b, h) is that kv-head's `rep` query heads, and one
+(rep, page_size) score tile feeds an online-softmax accumulator — pages
+past lengths[b] are skipped with pl.when, so per-sequence work is
+O(actual_len / page_size) pages, not O(pages_per_seq).
+
+`interpret=True` runs the same kernel through the Pallas interpreter
+(pattern of pallas_attention.py tests) so CPU tier-1 tests exercise it; the
+`paged_attention` wrapper picks interpret mode automatically off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# np scalars, not Python literals (see pallas_attention.py: f64 constants
+# break Mosaic under jax_enable_x64)
+_NEG_INF = np.float32(-1e30)
+_TINY = np.float32(1e-30)
+_0 = np.int32(0)
+
+_LANES = 128
+
+
+def _paged_kernel(lengths_ref, pt_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_scr, l_scr, acc_scr, *, scale: float, page_size: int,
+                  pages_per_seq: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = lengths_ref[b]
+    # skip pages entirely past this sequence's context: compute per sequence
+    # is ceil(length/page_size) pages, not pages_per_seq
+    @pl.when(j * page_size < length)
+    def _compute():
+        rep = q_ref.shape[2]
+        q = q_ref[0, 0]                                   # (rep, D)
+        k = k_ref[0, :, 0]                                # (ps, D)
+        v = v_ref[0, :, 0]                                # (ps, D)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (rep, ps) f32
+        slot = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rep, page_size), 1)
+        s = jnp.where(slot < length, s, _NEG_INF)
+        m_prev = m_scr[...]                               # (rep, 128)
+        m_cur = jax.lax.broadcast_in_dim(
+            jnp.max(s, axis=-1), m_prev.shape, (0,))
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, :1])                     # (rep, ps)
+        alpha = jnp.exp(m_prev - m_new)                   # (rep, 128)
+        l_scr[...] = l_scr[...] * alpha + jax.lax.broadcast_in_dim(
+            jnp.sum(p, axis=-1), m_prev.shape, (0,))
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (rep, D)
+        m_scr[...] = m_new
+
+    @pl.when(j == pages_per_seq - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...][:, :1], _TINY)
+        o_ref[0, 0] = (acc_scr[...] / l).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(q, k_pool, v_pool, page_table, lengths,
+                           scale=None, interpret=False):
+    """Decode attention over paged KV.  q: (B, Hq, D); k_pool/v_pool:
+    (P, ps, Hkv, D); page_table: (B, pages_per_seq) i32; lengths: (B,) i32.
+    Returns (B, Hq, D) in q.dtype."""
+    B, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pool.shape
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must be a multiple of Hkv={Hkv}")
+    rep = Hq // Hkv
+    pages_per_seq = page_table.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    qg = q.reshape(B, Hkv, rep, D)
+    kernel = functools.partial(
+        _paged_kernel, scale=float(scale), page_size=ps,
+        pages_per_seq=pages_per_seq)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                 # lengths, page_table
+        grid=(B, Hkv, pages_per_seq),
+        in_specs=[
+            pl.BlockSpec((1, 1, rep, D),
+                         lambda b, h, j, lens, pt: (b, h, _0, _0)),
+            # page indirection: the block index along the pool's page axis
+            # comes from the prefetched page table
+            pl.BlockSpec((1, ps, 1, D),
+                         lambda b, h, j, lens, pt: (pt[b, j], _0, h, _0)),
+            pl.BlockSpec((1, ps, 1, D),
+                         lambda b, h, j, lens, pt: (pt[b, j], _0, h, _0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, rep, D),
+                               lambda b, h, j, lens, pt: (b, h, _0, _0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, _LANES), jnp.float32),
+            pltpu.VMEM((rep, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, rep, D), q.dtype),
+        interpret=interpret,
+    )(lengths.astype(jnp.int32), page_table.astype(jnp.int32),
+      qg, k_pool, v_pool)
+    return out.reshape(B, Hq, D)
+
+
+def paged_attention_reference(q, k_pool, v_pool, page_table, lengths,
+                              scale=None):
+    """Dense XLA reference: gather the page table into a contiguous cache and
+    run masked attention — the oracle for the kernel and the fallback path."""
+    B, Hq, D = q.shape
+    _, ps, Hkv, _ = k_pool.shape
+    rep = Hq // Hkv
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    M = page_table.shape[1] * ps
+    ck = k_pool[page_table].reshape(B, M, Hkv, D)
+    cv = v_pool[page_table].reshape(B, M, Hkv, D)
+    qg = q.reshape(B, Hkv, rep, D).astype(jnp.float32) * scale
+    s = jnp.einsum("bhrd,bkhd->bhrk", qg, ck.astype(jnp.float32))
+    slot = jax.lax.broadcasted_iota(jnp.int32, (B, M), 1)
+    keep = slot < lengths[:, None]                     # (B, M)
+    s = jnp.where(keep[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bhrk,bkhd->bhrd", p, cv.astype(jnp.float32))
+    return o.reshape(B, Hq, D).astype(q.dtype)

@@ -69,8 +69,13 @@ def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None,
     v = (h @ lp["wv"]).reshape(B, S, Hkv, D)
     q = llama_lib._apply_rope(q, cos, sin)
     k = llama_lib._apply_rope(k, cos, sin)
-    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
-    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+    # pos may be a python int (one compile per prefill) OR a traced i32
+    # scalar (serving decode loops reuse ONE compiled step across
+    # positions); index tuples must be type-homogeneous under x64
+    z = jnp.int32(0)
+    p = jnp.asarray(pos, jnp.int32)
+    ck = jax.lax.dynamic_update_slice(ck, k.astype(ck.dtype), (z, p, z, z))
+    cv = jax.lax.dynamic_update_slice(cv, v.astype(cv.dtype), (z, p, z, z))
     attn = _cache_attention(q, ck, cv, pos, slot_mask=slot_mask)
     x = x + (attn.reshape(B, S, Hq * D) @ lp["wo"])
     h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
@@ -98,8 +103,9 @@ def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None,
                                           c.rope_theta)
     d2 = cos_f.shape[-1]
     if positions is None:
-        cos = jax.lax.dynamic_slice(cos_f, (pos, 0), (S, d2))
-        sin = jax.lax.dynamic_slice(sin_f, (pos, 0), (S, d2))
+        start = (jnp.asarray(pos, jnp.int32), jnp.int32(0))
+        cos = jax.lax.dynamic_slice(cos_f, start, (S, d2))
+        sin = jax.lax.dynamic_slice(sin_f, start, (S, d2))
     else:
         cos = jnp.take(cos_f, positions, axis=0)   # (B, S, d2)
         sin = jnp.take(sin_f, positions, axis=0)
@@ -204,3 +210,239 @@ def generate(params, input_ids, config, max_new_tokens: int,
         step, (cache, next_tok, done0, key), jnp.arange(1, max_new_tokens))
     out = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache: block-paged pools + page tables (the serving decode path)
+# ---------------------------------------------------------------------------
+
+
+def init_paged_kv_pools(config, num_pages: int, page_size: int):
+    """Zeroed (L, num_pages, page_size, Hkv, D) k/v page pools."""
+    c = config
+    shape = (c.num_hidden_layers, num_pages, page_size,
+             c.num_key_value_heads, c.hd)
+    return {"k": jnp.zeros(shape, c.dtype), "v": jnp.zeros(shape, c.dtype)}
+
+
+class PagedKVCache:
+    """Host-side page allocator over device-side page pools.
+
+    HBM is carved into `num_pages` pages of `page_size` tokens; a sequence
+    occupying a decode *slot* owns ceil(len/page_size) pages listed in its
+    page-table row.  Pages are allocated on demand (`ensure_capacity`) and
+    reclaimed on eviction (`release_slot`) — memory scales with the tokens
+    actually resident, not num_slots * max_len.
+
+    Page-table invariants (the Pallas kernel relies on these):
+      * page 0 is RESERVED scratch — never allocated; empty slots point
+        every entry (and their writes) at it;
+      * entries past a slot's allocated range repeat the last allocated
+        page, so skipped grid steps index a valid page and the Pallas
+        pipeline elides the re-fetch.
+    """
+
+    def __init__(self, config, num_pages: int, page_size: int,
+                 max_slots: int, pages_per_seq: int):
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is reserved)")
+        self.config = config
+        self.page_size = int(page_size)
+        self.num_pages = int(num_pages)
+        self.pages_per_seq = int(pages_per_seq)
+        self.max_slots = int(max_slots)
+        self.pools = init_paged_kv_pools(config, num_pages, page_size)
+        self.page_table = jnp.zeros((max_slots, pages_per_seq), jnp.int32)
+        self._free_pages = list(range(num_pages - 1, 0, -1))  # page 0 reserved
+        self._free_slots = list(range(max_slots - 1, -1, -1))
+        self._slot_pages: dict[int, list] = {}
+
+    @property
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def free_slot_count(self) -> int:
+        return len(self._free_slots)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-max(int(n_tokens), 0) // self.page_size)
+
+    def acquire_slot(self) -> int:
+        if not self._free_slots:
+            raise RuntimeError("no free decode slots")
+        slot = self._free_slots.pop()
+        self._slot_pages[slot] = []
+        return slot
+
+    def ensure_capacity(self, slot: int, n_tokens: int) -> None:
+        """Grow slot's page list to cover n_tokens, updating its page-table
+        row.  Raises RuntimeError when the pool is exhausted (callers queue
+        the request instead of admitting it)."""
+        pages = self._slot_pages[slot]
+        need = self.pages_needed(n_tokens)
+        if need > self.pages_per_seq:
+            raise RuntimeError(
+                f"{n_tokens} tokens exceed pages_per_seq={self.pages_per_seq}"
+                f" * page_size={self.page_size}")
+        if need <= len(pages):
+            return
+        if need - len(pages) > len(self._free_pages):
+            raise RuntimeError("page pool exhausted")
+        while len(pages) < need:
+            pages.append(self._free_pages.pop())
+        row = pages + [pages[-1]] * (self.pages_per_seq - len(pages))
+        self.page_table = self.page_table.at[slot].set(
+            jnp.asarray(row, jnp.int32))
+
+    def release_slot(self, slot: int) -> None:
+        self._free_pages.extend(self._slot_pages.pop(slot))
+        self._free_slots.append(slot)
+        self.page_table = self.page_table.at[slot].set(0)
+
+
+def scatter_prefill_into_pages(cache, pools, page_table, seq_len: int,
+                               true_len=None):
+    """Scatter a dense prefill cache {"k","v"}: (L, B, S, Hkv, D) into the
+    page pools.  Token j of row b lands at (page_table[b, j//ps], j%ps).
+    true_len: optional (B,) — right-padded rows scatter positions >=
+    true_len[b] into the reserved scratch page 0 instead."""
+    ps = pools["k"].shape[2]
+    B = cache["k"].shape[1]
+    j = jnp.arange(seq_len, dtype=jnp.int32)
+    pidx = jnp.take_along_axis(page_table,
+                               jnp.broadcast_to((j // ps)[None], (B, seq_len)),
+                               axis=1)                      # (B, S)
+    if true_len is not None:
+        pidx = jnp.where(j[None] < true_len[:, None], pidx, 0)
+    poff = jnp.broadcast_to((j % ps)[None], (B, seq_len))
+    return {
+        "k": pools["k"].at[:, pidx, poff].set(
+            cache["k"].astype(pools["k"].dtype)),
+        "v": pools["v"].at[:, pidx, poff].set(
+            cache["v"].astype(pools["v"].dtype)),
+    }
+
+
+def _block_paged(c, x, lp, cos, sin, kp, vp, page_table, ctx, ffn_fn=None):
+    """One block in paged-decode mode.  x: (B, 1, E); kp/vp: one layer's
+    (P, ps, Hkv, D) pools; ctx: (B,) tokens already cached per slot — the
+    step's k/v are written at slot ctx, then attention runs over ctx+1
+    tokens through the paged kernel."""
+    B = x.shape[0]
+    D, Hq, Hkv = c.hd, c.num_attention_heads, c.num_key_value_heads
+    ps = kp.shape[1]
+    h = kernels.rms_norm(x, lp["input_norm"].astype(jnp.float32),
+                         c.rms_norm_eps).astype(x.dtype)
+    q = (h @ lp["wq"]).reshape(B, 1, Hq, D)
+    k = (h @ lp["wk"]).reshape(B, 1, Hkv, D)
+    v = (h @ lp["wv"]).reshape(B, 1, Hkv, D)
+    q = llama_lib._apply_rope(q, cos, sin)
+    k = llama_lib._apply_rope(k, cos, sin)
+    pidx = jnp.take_along_axis(page_table, (ctx // ps)[:, None], axis=1)[:, 0]
+    poff = ctx % ps
+    kp = kp.at[pidx, poff].set(k[:, 0].astype(kp.dtype))
+    vp = vp.at[pidx, poff].set(v[:, 0].astype(vp.dtype))
+    attn = kernels.paged_attention(q[:, 0], kp, vp, page_table, ctx + 1)
+    x = x + (attn.reshape(B, 1, Hq * D) @ lp["wo"])
+    h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
+                         c.rms_norm_eps).astype(x.dtype)
+    if ffn_fn is not None:
+        out, _aux = ffn_fn(h, lp)
+        return x + out.astype(x.dtype), kp, vp
+    gate = h @ lp["w_gate"]
+    up = h @ lp["w_up"]
+    return x + ((jax.nn.silu(gate) * up) @ lp["w_down"]).astype(x.dtype), kp, vp
+
+
+def forward_paged_decode(params, tok, config, pools, page_table, ctx,
+                         ffn_fn=None):
+    """One decode step for every slot over the paged cache.  tok: (B,) the
+    token sampled last step; ctx: (B,) tokens already cached per slot (the
+    new token occupies slot ctx at rope position ctx).
+
+    Returns (logits (B, V) f32, updated pools)."""
+    c = config
+    x = jnp.take(params["embed"]["weight"], tok[:, None], axis=0)  # (B, 1, E)
+    cos_f, sin_f = llama_lib._rope_tables(c.hd, c.max_position_embeddings,
+                                          c.rope_theta)
+    cos = jnp.take(cos_f, ctx, axis=0)[:, None]                    # (B, 1, d2)
+    sin = jnp.take(sin_f, ctx, axis=0)[:, None]
+
+    def body(x, layer):
+        lp, kp, vp = layer
+        x, kp, vp = _block_paged(c, x, lp, cos, sin, kp, vp, page_table, ctx,
+                                 ffn_fn=ffn_fn)
+        return x, (kp, vp)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], pools["k"], pools["v"]))
+    x = kernels.rms_norm(x, params["final_norm"].astype(jnp.float32),
+                         c.rms_norm_eps)
+    head = (params["embed"]["weight"].T if c.tie_word_embeddings
+            else params["lm_head"])
+    logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
+    return logits[:, 0], {"k": k_new, "v": v_new}
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "config", "max_new_tokens", "temperature", "top_k", "top_p", "eos_id"))
+def _generate_paged_core(params, input_ids, k_pool, v_pool, page_table, key,
+                         config, max_new_tokens, temperature, top_k, top_p,
+                         eos_id):
+    c = config
+    B, S = input_ids.shape
+    # prefill through the dense cached forward (flash-style attention over
+    # the prompt), then scatter the prompt's k/v into pages
+    dense = init_kv_cache(c, B, S)
+    logits, dense = forward_with_cache(params, input_ids, c, dense, 0)
+    pools = scatter_prefill_into_pages(dense, {"k": k_pool, "v": v_pool},
+                                       page_table, S)
+    next_tok = sample_logits(logits[:, -1], key, temperature, top_k, top_p)
+
+    def step(carry, i):
+        pools, tok, done, key = carry
+        key, sub = jax.random.split(key)
+        ctx = jnp.full((B,), S, jnp.int32) + i - 1
+        logits, pools = forward_paged_decode(params, tok, c, pools,
+                                             page_table, ctx)
+        nxt = sample_logits(logits, sub, temperature, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, eos_id, nxt)
+            done = done | (nxt == eos_id)
+        return (pools, nxt, done, key), tok
+
+    done0 = (jnp.zeros((B,), bool) if eos_id is None
+             else (next_tok == eos_id))
+    (_, last, _, _), toks = jax.lax.scan(
+        step, (pools, next_tok, done0, key), jnp.arange(1, max_new_tokens))
+    return jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+
+
+def generate_paged(params, input_ids, config, max_new_tokens: int,
+                   page_size: int = 16, temperature: float = 0.0,
+                   top_k: int = 0, top_p: float = 1.0,
+                   eos_id: Optional[int] = None, key: Optional[Any] = None):
+    """`generate()` over a block-paged KV cache: prefill lands in pages, the
+    decode scan runs the Pallas paged-attention kernel.  Token-exact with
+    `generate()` for greedy decoding (same math, paged layout).
+
+    Single-shot generation knows its max length, so all pages are allocated
+    up front through the PagedKVCache allocator; the continuous-batching
+    engine (paddle_tpu.inference.LLMEngine) allocates them on demand
+    instead.  Equal-length prompts only (the engine handles ragged prompts
+    by per-request prefill)."""
+    B, S = input_ids.shape
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    total = S + max_new_tokens
+    pages_per_seq = -(-total // page_size)
+    cache = PagedKVCache(config, num_pages=1 + B * pages_per_seq,
+                         page_size=page_size, max_slots=B,
+                         pages_per_seq=pages_per_seq)
+    for _ in range(B):
+        cache.ensure_capacity(cache.acquire_slot(), total)
+    return _generate_paged_core(
+        params, input_ids, cache.pools["k"], cache.pools["v"],
+        cache.page_table, key, config, max_new_tokens, temperature, top_k,
+        top_p, eos_id)

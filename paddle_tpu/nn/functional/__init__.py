@@ -683,8 +683,8 @@ def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False,
 
     def f(a):
         acf = to_cf(a)
-        _, extras = _pool_out_extra(acf.shape[2:], kernel, stride, pad,
-                                    ceil_mode)
+        outs, extras = _pool_out_extra(acf.shape[2:], kernel, stride, pad,
+                                       ceil_mode)
         # ceil_mode's trailing partial window = asymmetric extra right pad
         sp_pads = tuple((p, p + e) for p, e in zip(pad, extras))
         window = (1, 1) + kernel
@@ -704,6 +704,19 @@ def _pool_nd(x, kernel, stride, padding, nd, mode, ceil_mode=False,
                 counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
                                                window, strides, pads)
                 out = summed / counts
+            elif any(e > 0 for e in extras):
+                # exclusive=False with ceil_mode overhang: the reference
+                # clips each window end to input+pad before the divisor
+                # (pooling.cc:74-84 hend=min(hstart+k, H+pad)), so trailing
+                # partial windows divide by kernel volume minus the
+                # overhang — padding still counts, the overhang does not
+                div = np.float32(1.0)
+                for d, (S, k, s, p, o) in enumerate(zip(
+                        acf.shape[2:], kernel, stride, pad, outs)):
+                    c = np.minimum(k, S + 2 * p
+                                   - np.arange(o) * s).astype(np.float32)
+                    div = div * c.reshape((o,) + (1,) * (len(outs) - 1 - d))
+                out = summed / jnp.asarray(div)[None, None]
             else:
                 out = summed / float(np.prod(kernel))
         out = out.astype(a.dtype)

@@ -286,7 +286,7 @@ class TestContextParallel:
         ring — and must not trip the global square-shape check."""
         import jax
         import jax.numpy as jnp
-        from jax import shard_map
+        from paddle_tpu.distributed._shard_map_compat import shard_map
         from jax.sharding import PartitionSpec as P
         from paddle_tpu.distributed.context_parallel import context_parallel_attention
         from paddle_tpu.kernels import attention_reference

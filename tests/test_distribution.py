@@ -98,8 +98,9 @@ class TestLogProbVsScipy:
                                    rtol=1e-4)
 
     def test_categorical_reference_conventions(self):
-        """Reference categorical.py: `logits` are unnormalized probabilities;
-        probs/log_prob divide by the sum (:122) while entropy/kl use
+        """Reference categorical.py: `logits` are unnormalized probabilities
+        for probs/log_prob, which divide by the sum (:122), while sample()
+        (via _logits_to_probs, distribution.py:255-265) and entropy/kl use
         softmax(logits) (:226-269) — both conventions pinned."""
         raw = np.array([0.4, 0.6, 1.0], np.float32)  # sums to 2
         d = D.Categorical(logits=raw)
@@ -109,6 +110,11 @@ class TestLogProbVsScipy:
         sm = np.exp(raw) / np.exp(raw).sum()
         np.testing.assert_allclose(float(d.entropy()),
                                    float(-(sm * np.log(sm)).sum()), rtol=1e-5)
+        # sampling follows softmax(logits), not the sum-normalized probs
+        paddle.seed(0)
+        s = _np(d.sample((20000,)))
+        freq = np.bincount(s.astype(np.int64), minlength=3) / s.size
+        np.testing.assert_allclose(freq, sm, atol=0.02)
         q = D.Categorical(logits=np.array([1.0, 1.0, 2.0], np.float32))
         smq = np.exp([1.0, 1.0, 2.0]) / np.exp([1.0, 1.0, 2.0]).sum()
         np.testing.assert_allclose(
@@ -185,9 +191,10 @@ class TestKL:
         (lambda: D.Laplace(0.0, 1.0), lambda: D.Laplace(0.5, 2.0)),
         (lambda: D.Dirichlet(np.array([2.0, 3.0], np.float32)),
          lambda: D.Dirichlet(np.array([1.0, 1.5], np.float32))),
-        # Categorical excluded here: the reference's sampling/log_prob use
-        # sum-normalized probs while its KL uses softmax(logits) — the two
-        # conventions disagree, so closed-form-vs-MC cannot match (see
+        # Categorical excluded here: the reference's log_prob uses
+        # sum-normalized probs while its sampling/KL use softmax(logits) —
+        # the MC estimate goes through log_prob, so the two conventions
+        # disagree and closed-form-vs-MC cannot match (see
         # TestLogProbVsScipy.test_categorical_reference_conventions)
         (lambda: D.Bernoulli(0.3), lambda: D.Bernoulli(0.6)),
         (lambda: D.Geometric(0.4), lambda: D.Geometric(0.7)),

@@ -15,6 +15,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ("train_llama.py", ["--steps", "3", "--batch", "4", "--seq", "32"]),
     ("recsys_ps.py", []),
     ("serve_model.py", []),
+    ("serve_llm.py", []),
 ])
 def test_example_runs(script, args):
     env = dict(os.environ, JAX_PLATFORMS="cpu",

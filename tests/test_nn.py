@@ -357,6 +357,30 @@ class TestAmp:
             sc.unscale_(oa)
         sc.update()
 
+    def test_grad_scaler_found_inf_is_per_optimizer(self):
+        """GAN pattern: optimizer A overflows, optimizer B is finite — B's
+        step must still apply (found_inf is tracked per optimizer, reference
+        grad_scaler.py:341 resets it at each _unscale), while A's step is
+        skipped and update() still backs the shared scale off."""
+        pa = paddle.to_tensor(np.ones(2, np.float32))
+        pa.stop_gradient = False
+        pb = paddle.to_tensor(np.ones(2, np.float32))
+        pb.stop_gradient = False
+        oa = paddle.optimizer.SGD(parameters=[pa], learning_rate=0.1)
+        ob = paddle.optimizer.SGD(parameters=[pb], learning_rate=0.1)
+        sc = paddle.amp.GradScaler(init_loss_scaling=1024.0,
+                                   decr_every_n_nan_or_inf=1)
+        loss = (pa * pa).sum() + (pb * pb).sum()
+        sc.scale(loss).backward()
+        pa.grad._data = pa.grad._data * np.float32("inf")  # poison A only
+        sc.step(oa)   # A overflowed: skipped
+        sc.step(ob)   # B finite: must step
+        sc.update()
+        np.testing.assert_allclose(pa.numpy(), [1.0, 1.0], rtol=1e-6)
+        np.testing.assert_allclose(pb.numpy(), [0.8, 0.8], rtol=1e-6)
+        # ANY overflow this iteration backs off the shared scale
+        assert sc.state_dict()["scale"] == 512.0
+
 
 class TestIO:
     def test_dataloader(self):

@@ -1,0 +1,319 @@
+"""Paged KV-cache decode stack: Pallas kernel vs the dense oracle,
+generate_paged() parity with generate(), and the continuous-batching
+LLMEngine (admission / eviction / page reclamation)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.kernels import pallas_paged_attention as ppa
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _paged_case(seed, B, Hq, Hkv, D, page_size, pages_per_seq, dtype):
+    """Random pools with a SHUFFLED page assignment + ragged lengths."""
+    rng = np.random.default_rng(seed)
+    P = B * pages_per_seq + 1
+    q = jnp.asarray(rng.standard_normal((B, Hq, D)), dtype)
+    k = jnp.asarray(rng.standard_normal((P, page_size, Hkv, D)), dtype)
+    v = jnp.asarray(rng.standard_normal((P, page_size, Hkv, D)), dtype)
+    perm = rng.permutation(P - 1)[: B * pages_per_seq] + 1  # page 0 reserved
+    pt = jnp.asarray(perm.reshape(B, pages_per_seq), jnp.int32)
+    M = pages_per_seq * page_size
+    lens = jnp.asarray(rng.integers(1, M + 1, (B,)), jnp.int32)
+    return q, k, v, pt, lens
+
+
+class TestPagedKernel:
+    @pytest.mark.parametrize("page_size,rep", [(4, 1), (4, 2), (8, 4),
+                                               (16, 2)])
+    def test_matches_gather_reference(self, page_size, rep):
+        """Interpret-mode kernel vs the dense gather reference across page
+        sizes and GQA ratios, on ragged lengths."""
+        Hkv, D = 2, 16
+        q, k, v, pt, lens = _paged_case(
+            page_size + rep, 3, Hkv * rep, Hkv, D, page_size, 5, jnp.float32)
+        got = ppa.paged_attention_pallas(q, k, v, pt, lens, interpret=True)
+        want = ppa.paged_attention_reference(q, k, v, pt, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_matches_dense_cache_attention_oracle(self):
+        """The paged kernel must agree with the DENSE decode-path oracle
+        (_cache_attention) when the pages are materialized into a contiguous
+        cache — the equivalence the whole paged stack rests on."""
+        B, Hkv, rep, D, ps, pps = 2, 2, 2, 16, 4, 4
+        q, k, v, pt, lens = _paged_case(0, B, Hkv * rep, Hkv, D, ps, pps,
+                                        jnp.float32)
+        got = ppa.paged_attention_pallas(q, k, v, pt, lens, interpret=True)
+        # gather pages into the dense (B, M, Hkv, D) cache layout
+        M = pps * ps
+        ck = k[pt].reshape(B, M, Hkv, D)
+        cv = v[pt].reshape(B, M, Hkv, D)
+        slot_mask = (jnp.arange(M)[None] < lens[:, None])
+        want = generation._cache_attention(
+            q[:, None], ck, cv, pos=M - 1, slot_mask=slot_mask)[:, 0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_length_one_and_full(self):
+        """Edge lengths: a single live token and a completely full table."""
+        B, Hkv, rep, D, ps, pps = 2, 1, 2, 8, 4, 3
+        q, k, v, pt, _ = _paged_case(7, B, Hkv * rep, Hkv, D, ps, pps,
+                                     jnp.float32)
+        for lens in ([1, 1], [ps * pps, ps * pps], [1, ps * pps]):
+            lens = jnp.asarray(lens, jnp.int32)
+            got = ppa.paged_attention_pallas(q, k, v, pt, lens,
+                                             interpret=True)
+            want = ppa.paged_attention_reference(q, k, v, pt, lens)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=2e-5, atol=2e-5)
+
+    def test_dispatcher_reference_fallback(self):
+        """kernels.paged_attention with fused kernels disabled routes to the
+        gather reference."""
+        from paddle_tpu import framework, kernels
+        q, k, v, pt, lens = _paged_case(3, 2, 4, 2, 8, 4, 3, jnp.float32)
+        flags = framework.get_state().flags
+        prev = flags.get("FLAGS_use_fused_kernels", True)
+        try:
+            flags["FLAGS_use_fused_kernels"] = False
+            got = kernels.paged_attention(q, k, v, pt, lens)
+        finally:
+            flags["FLAGS_use_fused_kernels"] = prev
+        want = ppa.paged_attention_reference(q, k, v, pt, lens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestPagedKVCache:
+    def test_alloc_free_and_invariants(self):
+        cfg = LlamaConfig.tiny()
+        cache = generation.PagedKVCache(cfg, num_pages=6, page_size=4,
+                                        max_slots=2, pages_per_seq=3)
+        assert cache.free_page_count == 5  # page 0 reserved
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 5)        # 2 pages
+        assert cache.free_page_count == 3
+        row = np.asarray(cache.page_table)[a]
+        assert (row > 0).all()             # never the reserved page
+        assert row[2] == row[1]            # tail repeats the last page
+        assert len(set(row[:2])) == 2      # distinct allocated pages
+        cache.ensure_capacity(a, 5)        # idempotent
+        assert cache.free_page_count == 3
+        b = cache.acquire_slot()
+        cache.ensure_capacity(b, 12)       # 3 pages
+        assert cache.free_page_count == 0
+        with pytest.raises(RuntimeError, match="no free decode slots"):
+            cache.acquire_slot()
+        cache.release_slot(a)
+        assert cache.free_page_count == 2  # A's pages reclaimed
+        assert (np.asarray(cache.page_table)[a] == 0).all()
+        c = cache.acquire_slot()
+        with pytest.raises(RuntimeError, match="exhausted"):
+            cache.ensure_capacity(c, 12)   # needs 3 pages, only 2 free
+
+    def test_pool_exhaustion_raises(self):
+        cfg = LlamaConfig.tiny()
+        cache = generation.PagedKVCache(cfg, num_pages=3, page_size=4,
+                                        max_slots=1, pages_per_seq=4)
+        s = cache.acquire_slot()
+        with pytest.raises(RuntimeError, match="exhausted"):
+            cache.ensure_capacity(s, 12)   # 3 pages > 2 free
+
+
+class TestGeneratePaged:
+    @pytest.mark.parametrize("page_size", [4, 16, 5])
+    def test_greedy_token_exact_vs_generate(self, tiny, page_size):
+        cfg, params = tiny
+        for seed in range(3):
+            ids = jnp.asarray(np.random.default_rng(seed).integers(
+                0, cfg.vocab_size, (2, 6)), jnp.int32)
+            want = generation.generate(params, ids, cfg, max_new_tokens=5)
+            got = generation.generate_paged(params, ids, cfg,
+                                            max_new_tokens=5,
+                                            page_size=page_size)
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    def test_eos_padding_matches_generate(self, tiny):
+        cfg, params = tiny
+        ids = jnp.asarray(np.random.default_rng(4).integers(
+            0, cfg.vocab_size, (1, 4)), jnp.int32)
+        base = np.asarray(generation.generate(params, ids, cfg,
+                                              max_new_tokens=6))
+        eos = int(base[0, 2])
+        want = generation.generate(params, ids, cfg, max_new_tokens=6,
+                                   eos_id=eos)
+        got = generation.generate_paged(params, ids, cfg, max_new_tokens=6,
+                                        page_size=4, eos_id=eos)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    @pytest.mark.slow
+    def test_sampling_modes_run(self, tiny):
+        cfg, params = tiny
+        ids = jnp.asarray(np.random.default_rng(3).integers(
+            0, cfg.vocab_size, (1, 4)), jnp.int32)
+        out = generation.generate_paged(
+            params, ids, cfg, max_new_tokens=3, page_size=4,
+            temperature=0.8, top_k=5, key=jax.random.PRNGKey(7))
+        arr = np.asarray(out)
+        assert arr.shape == (1, 3)
+        assert (0 <= arr).all() and (arr < cfg.vocab_size).all()
+
+
+class TestLLMEngine:
+    def test_continuous_batching_matches_generate(self, tiny):
+        """More requests than slots: late requests are admitted mid-decode
+        as slots free up, and every stream matches the offline greedy
+        chain."""
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        rng = np.random.default_rng(0)
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=4,
+                        max_seq_len=32)
+        prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+                   for n in (5, 3, 7)]
+        outs = eng.generate(prompts, max_new_tokens=6)
+        for p, got in zip(prompts, outs):
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=6))[0].tolist()
+            assert got == want
+        assert eng.stats["completed"] == 3
+        # all pages reclaimed after eviction
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        assert eng.cache.free_slot_count == 2
+
+    def test_admit_and_evict_mid_decode(self, tiny):
+        """Drive step() by hand: B is admitted while A decodes; A's eviction
+        reclaims pages that C then reuses."""
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        rng = np.random.default_rng(1)
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=4,
+                        max_seq_len=16)
+        a = eng.submit(rng.integers(0, cfg.vocab_size, 4).tolist(),
+                       max_new_tokens=6)
+        eng.step()                       # admit A (prefill + first decodes)
+        assert eng.stats["admitted"] == 1
+        pages_with_a = eng.cache.free_page_count
+        b = eng.submit(rng.integers(0, cfg.vocab_size, 6).tolist(),
+                       max_new_tokens=8)
+        eng.step()                       # admits B while A is mid-decode
+        assert eng.stats["admitted"] == 2
+        free_both_active = eng.cache.free_page_count
+        assert free_both_active < pages_with_a
+        while not a.done():
+            eng.step()
+        assert len(a.result(timeout=0)) == 6
+        assert not b.done()              # B still decoding after A evicted
+        # A's pages are back in the pool while B keeps decoding
+        assert eng.cache.free_page_count > free_both_active
+        c = eng.submit(rng.integers(0, cfg.vocab_size, 4).tolist(),
+                       max_new_tokens=2)
+        while not (b.done() and c.done()):
+            eng.step()
+        assert len(b.result(timeout=0)) == 8
+        assert len(c.result(timeout=0)) == 2
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+
+    def test_eos_stops_stream(self, tiny):
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        ids = np.random.default_rng(4).integers(0, cfg.vocab_size, 4)
+        base = np.asarray(generation.generate(
+            params, jnp.asarray([ids], jnp.int32), cfg,
+            max_new_tokens=6))[0]
+        eos = int(base[2])
+        eng = LLMEngine(params, cfg, num_slots=1, page_size=4,
+                        max_seq_len=16)
+        got = eng.generate([ids.tolist()], max_new_tokens=6, eos_id=eos)[0]
+        first = int(np.argmax(base == eos))  # eos may repeat earlier too
+        assert got == base[:first + 1].tolist()  # ends AT the first eos
+
+    def test_request_validation(self, tiny):
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=1, page_size=4,
+                        max_seq_len=8)
+        with pytest.raises(ValueError, match="max_seq_len"):
+            eng.submit(list(range(6)), max_new_tokens=6)
+        with pytest.raises(ValueError, match="at least one token"):
+            eng.submit([], max_new_tokens=2)
+        # max_seq_len beyond the rope table would silently clamp positions
+        with pytest.raises(ValueError, match="max_position_embeddings"):
+            LLMEngine(params, cfg, num_slots=1, page_size=4,
+                      max_seq_len=cfg.max_position_embeddings + 1)
+
+    def test_prefill_bucket_clamped_to_rope_table(self, tiny):
+        """A prompt whose pow2 bucket exceeds a non-power-of-2
+        max_position_embeddings must still prefill (bucket clamps to the
+        rope table) and match the offline greedy chain."""
+        import dataclasses
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        cfg48 = dataclasses.replace(cfg, max_position_embeddings=48)
+        eng = LLMEngine(params, cfg48, num_slots=1, page_size=8,
+                        max_seq_len=48)
+        prompt = np.random.default_rng(3).integers(
+            0, cfg.vocab_size, 40).tolist()  # _bucket(40)=64 > 48
+        got = eng.generate([prompt], max_new_tokens=4)[0]
+        want = np.asarray(generation.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg48,
+            max_new_tokens=4))[0].tolist()
+        assert got == want
+
+    def test_generate_waits_when_background_loop_owns_engine(self, tiny):
+        """With the background loop running, generate() must only wait —
+        a second driver thread would race slot/page allocation."""
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=4,
+                        max_seq_len=32)
+        eng.start()
+        try:
+            prompt = np.random.default_rng(5).integers(
+                0, cfg.vocab_size, 5).tolist()
+            got = eng.generate([prompt], max_new_tokens=4, timeout=120)[0]
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([prompt], jnp.int32), cfg,
+                max_new_tokens=4))[0].tolist()
+            assert got == want
+        finally:
+            eng.shutdown()
+
+    def test_served_endpoint(self, tiny):
+        """serve_llm round-trip: HTTP tokens == offline greedy chain."""
+        import json
+        import urllib.request
+        from paddle_tpu.inference import LLMEngine, serve_llm
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=8,
+                        max_seq_len=32)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            prompt = np.random.default_rng(2).integers(
+                0, cfg.vocab_size, 5).tolist()
+            req = urllib.request.Request(url, data=json.dumps(
+                {"prompt": prompt, "max_new_tokens": 4}).encode())
+            out = json.loads(urllib.request.urlopen(req, timeout=120).read())
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([prompt], jnp.int32), cfg,
+                max_new_tokens=4))[0].tolist()
+            assert out["tokens"] == want
+            stats = json.loads(urllib.request.urlopen(
+                url + "stats", timeout=30).read())
+            assert stats["completed"] >= 1
+        finally:
+            srv.shutdown()

@@ -373,6 +373,28 @@ class TestConvPoolNormVsTorch:
         np.testing.assert_allclose(got.numpy(), ref.numpy(),
                                    rtol=1e-5, atol=1e-6)
 
+    def test_avg_pool_ceil_inclusive_divisor_clip(self):
+        """exclusive=False + ceil_mode: trailing partial windows divide by
+        the window clipped to input+pad (reference pooling.cc:74-84), not by
+        the full kernel volume — torch count_include_pad=True matches."""
+        rng = np.random.default_rng(6)
+        x = rng.standard_normal((2, 3, 7, 7)).astype("float32")
+        got = F.avg_pool2d(paddle.to_tensor(x), 3, stride=2, padding=1,
+                           ceil_mode=True, exclusive=False)
+        ref = torch.nn.functional.avg_pool2d(
+            _t(x), 3, stride=2, padding=1, ceil_mode=True,
+            count_include_pad=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+        x3 = rng.standard_normal((1, 2, 5, 7, 6)).astype("float32")
+        got = F.avg_pool3d(paddle.to_tensor(x3), 3, stride=2, padding=1,
+                           ceil_mode=True, exclusive=False)
+        ref = torch.nn.functional.avg_pool3d(
+            _t(x3), 3, stride=2, padding=1, ceil_mode=True,
+            count_include_pad=True)
+        np.testing.assert_allclose(got.numpy(), ref.numpy(),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_pool_ceil_mode_changes_output_size(self):
         """8x8, k3 s2 p0: floor -> 3x3, ceil -> 4x4 (the trailing partial
         window is kept) — shapes AND values must match torch."""
