@@ -204,7 +204,13 @@ def bench_dit(dev, on_tpu):
 def bench_moe(dev, on_tpu):
     """MoE Llama training throughput (BASELINE config 5: expert-parallel
     MoE).  Single-chip: experts colocated, same GShard dispatch path that
-    shards over the `expert` mesh axis multi-chip."""
+    shards over the `expert` mesh axis multi-chip.
+
+    Headline: the dropless "gmm" dispatch (Pallas grouped matmul — no
+    capacity padding, no token drops).  The capacity-based scatter mode
+    runs as a comparison leg; its dropped_fraction and both throughputs
+    land in the extra dict."""
+    import dataclasses
     from paddle_tpu.models import llama, moe_llama
     from paddle_tpu.models.moe_llama import MoELlamaConfig
     from paddle_tpu.distributed import mesh as mesh_lib
@@ -222,14 +228,14 @@ def bench_moe(dev, on_tpu):
             num_hidden_layers=8, num_attention_heads=8,
             num_key_value_heads=4, max_position_embeddings=8192,
             dtype=jnp.bfloat16, remat=True, num_experts=8, moe_top_k=2,
-            moe_dispatch="scatter")
-        # scatter dispatch (no (N,X,C) one-hot tensors) lifts the round-4
-        # 8k-token/chip ceiling: run the llama headline shape B2/S8192.
-        # capacity_factor stays at the 1.25 training default — cf=1.0
-        # measured 44.1k tok/s / 44.4% MFU but drops more tokens
+            moe_dispatch="gmm")
+        # gmm is dropless: compute scales with the actual per-expert load
+        # instead of capacity padding (scatter at cf=1.25 pays ~25% extra
+        # expert FLOPs and still drops overflow).  Same headline shape
+        # B2/S8192 the scatter mode unlocked (no (N,X,C) one-hot tensors).
         B, S, steps = 2, 8192, 10
     else:
-        cfg = MoELlamaConfig.tiny()
+        cfg = dataclasses.replace(MoELlamaConfig.tiny(), moe_dispatch="gmm")
         B, S, steps = 4, 64, 3
 
     mesh = mesh_lib.make_mesh(data=1)
@@ -247,11 +253,25 @@ def bench_moe(dev, on_tpu):
         gc.collect()
         return out
 
+    # comparison leg: capacity-based scatter dispatch, same everything else
+    scatter_cfg = dataclasses.replace(cfg, moe_dispatch="scatter")
+    dt_scatter, _, _ = _run_with_unroll(run, scatter_cfg, on_tpu)
     dt, final_loss, layers_note = _run_with_unroll(run, cfg, on_tpu)
     tok_per_sec = B * S * steps / dt
     peak = _peak_flops(dev)
     mfu = (tok_per_sec * moe_llama.flops_per_token(cfg, S) / peak) \
         if peak else 0.0
+
+    # dropped_fraction of the capacity-based mode at this shape (init
+    # params; gmm drops nothing by construction)
+    try:
+        ids = jnp.asarray(tokens[:, :-1], jnp.int32)
+        stats = jax.jit(lambda p, i: moe_llama.routing_stats(
+            p, i, scatter_cfg))(moe_llama.init_params(scatter_cfg, seed=0),
+                                ids)
+        dropped = round(float(stats["dropped_fraction"]), 4)
+    except Exception as e:  # noqa: BLE001 — stats must not kill the bench
+        dropped = f"error: {e!r:.80}"
     return {
         "metric": "moe_train_tokens_per_sec_per_chip",
         "value": round(tok_per_sec, 2),
@@ -259,6 +279,11 @@ def bench_moe(dev, on_tpu):
         # ACTIVE-params 6N convention (top_k experts + router per token)
         "mfu": round(mfu, 4),
         "dispatch": cfg.moe_dispatch or "auto",
+        "dispatch_compare": {
+            "gmm": round(tok_per_sec, 2),
+            "scatter": round(B * S * steps / dt_scatter, 2),
+        },
+        "scatter_dropped_fraction": dropped,
         "layers": layers_note,
         "experts": cfg.num_experts, "top_k": cfg.moe_top_k,
         "batch": B, "seq": S, "steps": steps, "loss": final_loss,

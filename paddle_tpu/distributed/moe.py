@@ -52,8 +52,10 @@ class MoEConfig:
     z_loss_weight: float = 1e-3         # router logit z-loss (ST-MoE)
     normalize_top_k: bool = True        # renormalize top-k gate weights
     gate_dtype: Any = jnp.float32
-    # "einsum" | "scatter" | None (auto: scatter once the one-hot dispatch
-    # tensor would exceed _EINSUM_DISPATCH_LIMIT bytes)
+    # "einsum" | "scatter" | "gmm" | None (auto: "gmm" when capacity_factor
+    # is None — dropless needs no capacity buffers at all — else scatter
+    # once the one-hot dispatch tensor would exceed _EINSUM_DISPATCH_LIMIT
+    # bytes)
     dispatch_mode: Optional[str] = None
 
 
@@ -61,13 +63,16 @@ def compute_capacity(num_tokens: int, cfg: MoEConfig) -> int:
     if cfg.capacity_factor is None:
         # drop-free: a token occupies at most one slot per expert (top-k picks
         # are distinct experts), so N slots per expert covers the worst case.
-        # NOTE: dispatch/combine are then (N, X, N) — O(N^2 X) memory, fine for
-        # the eval/debug use NaiveGate serves but not for training at scale;
-        # use a finite capacity_factor on the hot path.
+        # The einsum path's dispatch/combine are then (N, X, N) — O(N^2 X)
+        # memory; auto dispatch routes capacity_factor=None to the gmm mode,
+        # which needs no capacity buffers at all.
         return num_tokens
     cap = int(np.ceil(cfg.top_k * num_tokens / cfg.num_experts
                       * cfg.capacity_factor))
-    return max(cap, cfg.min_capacity)
+    # a token occupies at most one slot per expert, so capacity beyond N
+    # buys nothing: clamp keeps large capacity_factor configs from
+    # allocating (N, X, C>N) dispatch tensors bigger than N ever fills
+    return min(max(cap, cfg.min_capacity), num_tokens)
 
 
 def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None):
@@ -118,24 +123,53 @@ def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None):
     return expert_idx.astype(jnp.int32), pos, keep, gate_vals, aux, C
 
 
-def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None):
+def routing_metrics(keep, top_k: int):
+    """Aux metrics from a (N, k) keep mask: how many (token, slot) picks
+    the capacity buffers actually admitted.  The capacity-based modes used
+    to drop overflow tokens silently; `dropped_fraction` makes the loss
+    visible (bench.py reports it in the moe extra dict)."""
+    routed = jnp.float32(keep.shape[0] * top_k)
+    kept = keep.astype(jnp.float32).sum()
+    return {
+        "dropped_count": routed - kept,
+        "routed_count": routed,
+        "dropped_fraction": (routed - kept) / jnp.maximum(routed, 1.0),
+    }
+
+
+def _one_hot_dispatch(expert_idx, pos, keep, gate_vals, X: int, C: int,
+                      dtype):
+    """(N, X, C) dispatch/combine one-hots from `gating_indices` outputs —
+    the single construction both `top_k_gating` and the einsum moe_ffn
+    branch share (parity depends on there being exactly one copy)."""
+    N, k = expert_idx.shape
+    dispatch = jnp.zeros((N, X, C), dtype)
+    combine = jnp.zeros((N, X, C), dtype)
+    for j in range(k):
+        d = (keep[:, j, None, None]
+             * jax.nn.one_hot(expert_idx[:, j], X, dtype=dtype)[:, :, None]
+             * jax.nn.one_hot(pos[:, j], C, dtype=dtype)[:, None, :])
+        dispatch = dispatch + d
+        combine = combine + gate_vals[:, j][:, None, None] * d
+    return dispatch, combine
+
+
+def top_k_gating(logits, cfg: MoEConfig, capacity: Optional[int] = None,
+                 return_metrics: bool = False):
     """One-hot GShard/Switch gating (reference gshard_gate.py/switch_gate.py).
 
     logits: (N, X) float. Returns (dispatch (N, X, C) bool-ish float,
-    combine (N, X, C) float, aux_loss scalar).  Built from `gating_indices`
-    so both dispatch forms share one routing decision.
+    combine (N, X, C) float, aux_loss scalar[, metrics dict when
+    `return_metrics` — see `routing_metrics`]).  Built from
+    `gating_indices` so both dispatch forms share one routing decision.
     """
     N, X = logits.shape
     expert_idx, pos, keep, gate_vals, aux, C = gating_indices(
         logits, cfg, capacity)
-    dispatch = jnp.zeros((N, X, C), cfg.gate_dtype)
-    combine = jnp.zeros((N, X, C), cfg.gate_dtype)
-    for j in range(cfg.top_k):
-        d = (keep[:, j, None, None]
-             * jax.nn.one_hot(expert_idx[:, j], X, dtype=cfg.gate_dtype)[:, :, None]
-             * jax.nn.one_hot(pos[:, j], C, dtype=cfg.gate_dtype)[:, None, :])
-        dispatch = dispatch + d
-        combine = combine + gate_vals[:, j][:, None, None] * d
+    dispatch, combine = _one_hot_dispatch(expert_idx, pos, keep, gate_vals,
+                                          X, C, cfg.gate_dtype)
+    if return_metrics:
+        return dispatch, combine, aux, routing_metrics(keep, cfg.top_k)
     return dispatch, combine, aux
 
 
@@ -184,10 +218,54 @@ def _expert_ffn(xp, p):
     return jnp.einsum("xcf,xfe->xce", h, p["w_down"])
 
 
-def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None):
-    """MoE SwiGLU FFN.  x: (B, S, E) -> (out (B, S, E), aux_loss).
+def _gmm_expert_ffn(tok, p, cfg: MoEConfig, expert_idx, gate_vals):
+    """Dropless expert FFN via the Pallas grouped matmul.
 
-    Two dispatch forms sharing one routing decision (`gating_indices`):
+    tok: (N, E); expert_idx/gate_vals: (N, k) from `gating_indices`.  The
+    (token, slot) pairs are stably sorted by destination expert, scattered
+    into the kernel's tile-aligned layout (`make_layout`), run through
+    three GMMs (SwiGLU), and gathered back — compute scales with actual
+    tokens per expert, nothing is dropped.
+    """
+    from ..kernels import pallas_grouped_matmul as pgmm
+
+    N, E = tok.shape
+    k = cfg.top_k
+    X = cfg.num_experts
+    eflat = expert_idx.reshape(N * k)
+    # stable argsort: tokens within an expert stay in (token, slot) order
+    order = jnp.argsort(eflat, stable=True)                    # (N*k,)
+    group_sizes = jnp.zeros((X,), jnp.int32).at[eflat].add(1)
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(group_sizes)])
+    layout = pgmm.make_layout(group_sizes, N * k)
+    g_sorted = eflat[order]
+    rank = jnp.arange(N * k, dtype=jnp.int32) - offs[g_sorted]
+    dest = layout.starts[g_sorted] + rank                      # (N*k,)
+    x_pad = jnp.zeros((layout.padded_rows, E), tok.dtype).at[dest].set(
+        tok[order // k], unique_indices=True)
+
+    run = functools.partial(pgmm.gmm, group_sizes=group_sizes,
+                            padded_rows=layout.padded_rows,
+                            tile_m=layout.tile_m)
+    g = run(x_pad, p["w_gate"])
+    u = run(x_pad, p["w_up"])
+    h = (jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u)
+    o_pad = run(h, p["w_down"])
+
+    y_sorted = o_pad[dest]                                     # (N*k, E)
+    y = jnp.zeros_like(y_sorted).at[order].set(y_sorted,
+                                               unique_indices=True)
+    w = gate_vals.astype(tok.dtype)[..., None]                 # (N, k, 1)
+    return (y.reshape(N, k, E) * w).sum(axis=1)
+
+
+def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None,
+            return_metrics: bool = False):
+    """MoE SwiGLU FFN.  x: (B, S, E) -> (out (B, S, E), aux_loss[,
+    metrics dict when `return_metrics` — see `routing_metrics`]).
+
+    Three dispatch forms sharing one routing decision (`gating_indices`):
 
     * "einsum" — GShard one-hot form.  The dispatch/combine einsums ARE the
       reference's global_scatter -> expert FFN -> global_gather pipeline
@@ -198,9 +276,16 @@ def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None):
       expert buffers and gather back out, O(k·N·E) routing cost and no
       (N, X, C) tensor at all — this is what removes the reference's (and
       round-4's) single-chip token ceiling.
+    * "gmm" — DROPLESS: tokens sort by destination expert and the expert
+      FFN runs as a ragged Pallas grouped matmul
+      (kernels/pallas_grouped_matmul.py).  No capacity buffers, no
+      capacity padding, no token dropping; compute scales with the actual
+      per-expert load.  Capacity settings are ignored.
 
-    Identical routing, drops and numerics (parity-pinned in tests); auto
-    mode picks scatter once the one-hot tensors would exceed
+    einsum/scatter are parity-pinned in tests (identical routing, drops
+    and numerics); gmm matches them token-exactly whenever capacity drops
+    nothing.  Auto mode picks gmm when `capacity_factor is None` (the
+    dropless contract), else scatter once the one-hot tensors would exceed
     _EINSUM_DISPATCH_LIMIT bytes.
     """
     B, S, E = x.shape
@@ -210,17 +295,22 @@ def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None):
     logits = tok.astype(cfg.gate_dtype) @ p["router"]
     mode = dispatch or cfg.dispatch_mode
     if mode is None:
-        C = compute_capacity(N, cfg)
-        onehot_bytes = 2 * N * X * C * jnp.dtype(cfg.gate_dtype).itemsize
-        mode = "scatter" if onehot_bytes > _EINSUM_DISPATCH_LIMIT else "einsum"
+        if cfg.capacity_factor is None:
+            mode = "gmm"
+        else:
+            C = compute_capacity(N, cfg)
+            onehot_bytes = 2 * N * X * C * jnp.dtype(cfg.gate_dtype).itemsize
+            mode = ("scatter" if onehot_bytes > _EINSUM_DISPATCH_LIMIT
+                    else "einsum")
+    e, pos, keep, gates, aux, C = gating_indices(logits, cfg)
     if mode == "einsum":
-        dispatch_t, combine, aux = top_k_gating(logits, cfg)
-        d = dispatch_t.astype(x.dtype)
-        xp = jnp.einsum("nxc,ne->xce", d, tok)                 # all-to-all in
+        dispatch_t, combine = _one_hot_dispatch(e, pos, keep, gates, X, C,
+                                                cfg.gate_dtype)
+        xp = jnp.einsum("nxc,ne->xce", dispatch_t.astype(x.dtype),
+                        tok)                                   # all-to-all in
         eo = _expert_ffn(xp, p)
         out = jnp.einsum("nxc,xce->ne", combine.astype(x.dtype), eo)
     elif mode == "scatter":
-        e, pos, keep, gates, aux, C = gating_indices(logits, cfg)
         vals = (keep[..., None] * tok[:, None, :]).astype(x.dtype)  # (N, k, E)
         # every kept (token, slot) owns a distinct (expert, pos) cell; drops
         # have pos >= C and fall out of bounds -> dropped by scatter mode
@@ -230,9 +320,14 @@ def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None):
         gath = eo[e, jnp.minimum(pos, C - 1)]                  # (N, k, E)
         w = (gates * keep).astype(x.dtype)[..., None]
         out = (gath * w).sum(axis=1)
+    elif mode == "gmm":
+        out = _gmm_expert_ffn(tok, p, cfg, e, gates)
+        keep = jnp.ones_like(keep)                             # dropless
     else:
         raise ValueError(f"unknown dispatch mode {mode!r} "
-                         "(expected 'einsum' or 'scatter')")
+                         "(expected 'einsum', 'scatter' or 'gmm')")
+    if return_metrics:
+        return out.reshape(B, S, E), aux, routing_metrics(keep, cfg.top_k)
     return out.reshape(B, S, E), aux
 
 
@@ -324,7 +419,8 @@ class MoELayer(_Layer):
     built from tape-recorded ops (tensor.apply_op), so `loss.backward()`
     reaches router and expert weights.  `gate` is one of NaiveGate/SwitchGate/
     GShardGate or an MoEConfig.  `last_aux_loss` is a differentiable Tensor —
-    add it to the training loss.
+    add it to the training loss.  `last_dropped_fraction` reports the
+    (token, slot) picks the capacity buffers rejected on the last forward.
     """
 
     def __init__(self, d_model, experts, gate=None, name=None):
@@ -342,6 +438,7 @@ class MoELayer(_Layer):
             [d_model, self.cfg.num_experts],
             default_initializer=I.Normal(std=0.02))
         self.last_aux_loss = None
+        self.last_dropped_fraction = None
 
     def forward(self, x):
         from .. import ops
@@ -369,4 +466,8 @@ class MoELayer(_Layer):
             lambda c, e: jnp.einsum("nxc,xce->ne", c.astype(e.dtype), e),
             combine, eo)
         self.last_aux_loss = aux
+        # dispatch.sum() counts admitted (token, slot) picks out of N*k
+        self.last_dropped_fraction = apply_op(
+            "moe_drop_stats",
+            lambda d: 1.0 - d.sum() / jnp.float32(N * cfg.top_k), dispatch)
         return ops.reshape(out, [B, S, E])

@@ -160,6 +160,28 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
 
 
 # ---------------------------------------------------------------------------
+# Grouped matmul (dropless MoE dispatch: ragged per-expert FFN)
+# ---------------------------------------------------------------------------
+
+
+def grouped_matmul(lhs, rhs, group_sizes):
+    """Ragged grouped matmul: out[m] = lhs[m] @ rhs[g(m)].  lhs: (M, K)
+    rows sorted by group (group g contiguous), rhs: (X, K, N),
+    group_sizes: (X,) i32 summing to M.
+
+    Pallas kernel on TPU; the SAME kernel through the Pallas interpreter
+    on CPU; XLA one-matmul-per-group dense form when shapes aren't
+    tile-servable or FLAGS_use_fused_kernels=False.  Differentiable on
+    every path (custom_vjp: dgrad = GMM vs transposed weights, wgrad =
+    per-group transposed GMM)."""
+    from .pallas_grouped_matmul import grouped_matmul as _gmm
+
+    impl = None if framework.get_state().flags.get(
+        "FLAGS_use_fused_kernels", True) else "dense"
+    return _gmm(lhs, rhs, group_sizes, impl=impl)
+
+
+# ---------------------------------------------------------------------------
 # Rotary position embedding (reference: fused_rope_kernel.cu /
 # incubate/nn/functional/fused_rotary_position_embedding.py)
 # ---------------------------------------------------------------------------

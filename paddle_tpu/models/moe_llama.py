@@ -24,10 +24,14 @@ from . import llama as llama_lib
 class MoELlamaConfig(llama_lib.LlamaConfig):
     num_experts: int = 8
     moe_top_k: int = 2
-    capacity_factor: float = 1.25
+    # None = dropless: auto dispatch then runs the Pallas grouped-matmul
+    # ("gmm") path, which needs no capacity buffers at all
+    capacity_factor: "float | None" = 1.25
     aux_loss_weight: float = 0.01
     router_z_loss_weight: float = 1e-3
-    moe_dispatch: "str | None" = None   # "einsum" | "scatter" | None (auto)
+    # "einsum" | "scatter" | "gmm" | None (auto: gmm when capacity_factor
+    # is None, else scatter/einsum by dispatch-tensor size)
+    moe_dispatch: "str | None" = None
 
     @property
     def moe(self) -> moe_lib.MoEConfig:
@@ -96,6 +100,31 @@ def forward(params, input_ids, config: MoELlamaConfig, positions=None,
     return llama_lib.forward(
         params, input_ids, config, positions=positions, attn_mask=attn_mask,
         ffn_fn=ffn, return_aux_loss=return_aux_loss)
+
+
+def routing_stats(params, input_ids, config: MoELlamaConfig):
+    """Routing health of a full forward: summed router aux loss plus the
+    fraction of (token, slot) picks the capacity buffers dropped.
+
+    Rides the trunk's aux channel with a packed [aux, dropped, routed]
+    vector — the python layer loop (scan_layers=False) sums any aux shape,
+    so the trunk needs no changes.  Returns {"aux_loss", "dropped_fraction"}
+    as f32 scalars; gmm dispatch reports 0 dropped by construction.
+    """
+    c = dataclasses.replace(config, scan_layers=False, remat=False)
+    moe_cfg = c.moe
+
+    def ffn(h, lp):
+        out, aux, m = moe_lib.moe_ffn(h, lp, moe_cfg, return_metrics=True)
+        return out, jnp.stack([aux.astype(jnp.float32),
+                               m["dropped_count"], m["routed_count"]])
+
+    _, vec = llama_lib.forward(params, input_ids, c, ffn_fn=ffn,
+                               return_aux_loss=True)
+    return {
+        "aux_loss": vec[0],
+        "dropped_fraction": vec[1] / jnp.maximum(vec[2], 1.0),
+    }
 
 
 def loss_fn(params, batch, config: MoELlamaConfig):

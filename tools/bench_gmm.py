@@ -1,0 +1,124 @@
+"""Grouped-matmul micro-benchmark (run manually when real TPU time is
+available; bench.py stays the driver's single-line benchmark).
+
+Usage:  python tools/bench_gmm.py [N_TOKENS]
+
+Times one MoE SwiGLU expert-FFN step (forward + backward) two ways over a
+sweep of expert-imbalance ratios:
+
+  * gmm    — the dropless Pallas grouped matmul
+    (kernels/pallas_grouped_matmul.py): compute scales with the ACTUAL
+    per-expert token counts.
+  * padded — the capacity-padded batched einsum the einsum/scatter
+    dispatch modes run: every expert pays for C = max(tokens per expert)
+    rows, so imbalance inflates compute linearly (a 4x-hot expert makes
+    every other expert pad 4x).
+
+The imbalance ratio r is the hottest expert's share of all tokens
+(r = 1/X is perfectly balanced; r = 1.0 routes everything to one expert).
+Prints one JSON line per point; nothing here is driver-consumed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from paddle_tpu.kernels import pallas_grouped_matmul as pg  # noqa: E402
+
+STEPS = 10
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+def _group_sizes(n_tokens: int, num_experts: int, ratio: float):
+    """Hottest expert takes `ratio` of the tokens, rest spread evenly."""
+    hot = int(n_tokens * ratio)
+    rest = (n_tokens - hot) // (num_experts - 1)
+    sizes = [hot] + [rest] * (num_experts - 1)
+    sizes[-1] += n_tokens - sum(sizes)
+    return jnp.asarray(sizes, jnp.int32)
+
+
+def _swiglu_gmm(x, w_gate, w_up, w_down, gs):
+    g = pg.grouped_matmul(x, w_gate, gs)
+    u = pg.grouped_matmul(x, w_up, gs)
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
+    return pg.grouped_matmul(h, w_down, gs)
+
+
+def _swiglu_padded(xp, w_gate, w_up, w_down):
+    """Capacity-padded batched einsum form (xp: (X, C, E))."""
+    g = jnp.einsum("xce,xef->xcf", xp, w_gate)
+    u = jnp.einsum("xce,xef->xcf", xp, w_up)
+    h = jax.nn.silu(g.astype(jnp.float32)).astype(u.dtype) * u
+    return jnp.einsum("xcf,xfe->xce", h, w_down)
+
+
+def _time(f, *args):
+    jax.block_until_ready(f(*args))                # compile + warm
+    t = time.perf_counter()
+    for _ in range(STEPS):
+        out = f(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t) / STEPS
+
+
+def main():
+    if _on_tpu():
+        N, E, F, X = 16384, 1024, 2816, 8          # the moe bench shape
+        dtype = jnp.bfloat16
+    else:
+        N, E, F, X = 1024, 64, 128, 4
+        dtype = jnp.float32
+    if len(sys.argv) > 1:
+        N = int(sys.argv[1])
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(N, E)), dtype)
+    w_gate = jnp.asarray(rng.normal(size=(X, E, F)) * 0.02, dtype)
+    w_up = jnp.asarray(rng.normal(size=(X, E, F)) * 0.02, dtype)
+    w_down = jnp.asarray(rng.normal(size=(X, F, E)) * 0.02, dtype)
+
+    for ratio in sorted({1.0 / X, 2.0 / X, min(4.0 / X, 1.0), 1.0}):
+        gs = _group_sizes(N, X, ratio)
+        C = int(gs.max())
+
+        gmm_step = jax.jit(jax.grad(
+            lambda a: _swiglu_gmm(a, w_gate, w_up, w_down, gs)
+            .astype(jnp.float32).sum()))
+        dt_gmm = _time(gmm_step, x)
+
+        # the padded path's dispatch cost is excluded: this measures the
+        # expert-FFN compute alone, which is where capacity padding hurts
+        xp = jnp.zeros((X, C, E), dtype)
+        offs = np.concatenate([[0], np.cumsum(np.asarray(gs))])
+        for g in range(X):
+            xp = xp.at[g, : int(gs[g])].set(x[offs[g]:offs[g + 1]])
+        pad_step = jax.jit(jax.grad(
+            lambda a: _swiglu_padded(a, w_gate, w_up, w_down)
+            .astype(jnp.float32).sum()))
+        dt_pad = _time(pad_step, xp)
+
+        print(json.dumps({
+            "imbalance_ratio": round(ratio, 3),
+            "group_sizes": np.asarray(gs).tolist(),
+            "capacity_rows": X * C,
+            "actual_rows": N,
+            "gmm_tokens_per_sec": round(N / dt_gmm),
+            "padded_tokens_per_sec": round(N / dt_pad),
+            "gmm_vs_padded": round(dt_pad / dt_gmm, 3),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()
