@@ -378,6 +378,28 @@ def bench_decode(dev, on_tpu):
     }
 
 
+def _run_graphlint(timeout: float = 900.0) -> dict:
+    """Finding counts from `tools/graphlint.py --json` (CPU subprocess —
+    lint only traces, no chip needed) so BENCH rounds track Graph Doctor
+    status alongside perf numbers.  rc=1 means findings, still parseable."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "graphlint.py")
+    try:
+        out = subprocess.run(
+            [sys.executable, script, "--json"],
+            capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if out.returncode not in (0, 1):
+            return {"error": f"rc={out.returncode} "
+                             f"{out.stderr.strip()[-300:]}"}
+        d = json.loads(out.stdout.strip().splitlines()[-1])
+        return {"ok": d["ok"], "counts": d["counts"]}
+    except subprocess.TimeoutExpired:
+        return {"error": f"graphlint timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — lint must not kill the bench
+        return {"error": repr(e)[:300]}
+
+
 def _run_sub(name: str, timeout: "float | None" = None) -> dict:
     """Run `python bench.py --sub {name}` and parse its one-line JSON."""
     if timeout is None:
@@ -490,6 +512,9 @@ def main():
             "moe": moe_extra,
             # serving decode throughput: paged KV + Pallas paged attention
             "decode": decode_extra,
+            # Graph Doctor finding counts over the shipped models
+            # (tools/graphlint.py --json; tracks lint drift across rounds)
+            "graphlint": _run_graphlint(),
         },
     }))
 

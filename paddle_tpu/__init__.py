@@ -60,6 +60,7 @@ from . import utils  # noqa: E402
 from . import static  # noqa: E402
 from . import profiler  # noqa: E402
 from . import inference  # noqa: E402
+from . import analysis  # noqa: E402  (Graph Doctor: jaxpr lint framework)
 from .framework_tensors import SelectedRows, StringTensor  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi.summary import summary  # noqa: E402

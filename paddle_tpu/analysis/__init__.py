@@ -1,0 +1,39 @@
+"""Graph Doctor — jaxpr/HLO static analysis for paddle_tpu models.
+
+The analysis half of the reference's IR pass pipeline (~274 passes over
+ProgramDesc/PIR graphs, `paddle/fluid/framework/ir/*_pass.cc`, SURVEY C14),
+rebuilt where it belongs under XLA: over jaxprs.  `static/passes.py` holds
+the record-level *rewrite* passes (DCE / folding / fusion); this package
+holds the *analysis* passes that only diagnose — the lints that catch
+silent f64 promotion, missed buffer donation, replicated giant
+intermediates, and recompile churn before a TPU bill does (the TPU-MLIR /
+MPK lesson: typed IR-level analysis is where correctness and cost
+diagnostics belong).
+
+Three entry points:
+
+  * library:  ``paddle_tpu.analysis.analyze(fn, *args)`` -> ``Report``
+  * CLI:      ``python tools/graphlint.py`` lints the shipped bench models
+  * pytest:   ``tests/test_graphlint.py`` keeps the shipped models clean
+
+Checkers (see `checkers.py` for codes): dtype_promotion, donation,
+sharding, recompile_hazard, cost, dead_code.  Suppress per call with
+``analyze(..., suppress=["DTYPE_*"])`` or per code/process with
+``with analysis.suppressions("COST_*"): ...``.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    CheckContext, Finding, Report, Severity, analyze, analyze_jaxpr,
+    aval_bytes, iter_eqns, iter_jaxprs, list_checkers, register_checker,
+    suppressions,
+)
+from . import cost  # noqa: F401
+from . import checkers as _checkers  # noqa: F401 — registers the shipped set
+
+__all__ = [
+    "CheckContext", "Finding", "Report", "Severity", "analyze",
+    "analyze_jaxpr", "aval_bytes", "iter_eqns", "iter_jaxprs",
+    "list_checkers", "register_checker", "suppressions", "cost",
+]

@@ -1,0 +1,466 @@
+"""The shipped Graph Doctor checkers.
+
+Six checker families over a ClosedJaxpr (see core.iter_eqns for the
+recursive walk).  Severity policy: WARNING = costs real TPU time/HBM or
+risks silent wrong numerics; INFO = worth knowing, fine to ship.
+
+  dtype_promotion    DTYPE_F64_PROMOTION, DTYPE_WEAK_F64, DTYPE_F64_INPUT
+  donation           DONATION_MISSING
+  sharding           SHARD_REPLICATED, SHARD_GAP
+  recompile_hazard   RECOMPILE_CONST_CAPTURE, RECOMPILE_SHAPE_POLY,
+                     RECOMPILE_MUTABLE_CLOSURE
+  cost               COST_SUMMARY, COST_HOTSPOT
+  dead_code          DEAD_CODE, CONST_SUBGRAPH
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List
+
+import numpy as np
+
+import jax
+
+from . import cost as cost_lib
+from .core import (
+    CheckContext, Finding, Severity, aval_bytes, fmt_aval, fmt_bytes,
+    format_path, is_array_var, iter_eqns, iter_jaxprs, register_checker,
+    sub_jaxprs, _as_open,
+)
+
+_WIDE_FLOATS = ("float64", "complex128")
+
+
+def _dtype(v) -> str:
+    return str(getattr(getattr(v, "aval", None), "dtype", ""))
+
+
+def _weak(v) -> bool:
+    return bool(getattr(getattr(v, "aval", None), "weak_type", False))
+
+
+# ---------------------------------------------------------------------------
+# 1. dtype promotion: silent f64/c128 creep (x64 is globally ON in this
+#    package for reference dtype parity, so one leaked np.float64 scalar
+#    doubles the width of everything downstream of it)
+# ---------------------------------------------------------------------------
+
+
+@register_checker("dtype_promotion")
+def check_dtype_promotion(ctx: CheckContext):
+    findings: List[Finding] = []
+    jaxpr = ctx.closed_jaxpr.jaxpr
+    for i, v in enumerate(jaxpr.invars):
+        if _dtype(v) in _WIDE_FLOATS:
+            findings.append(Finding(
+                Severity.INFO, "DTYPE_F64_INPUT", "<top>",
+                f"input {ctx.invar_name(v)} is {_dtype(v)} "
+                f"({fmt_aval(v.aval)}) — TPUs have no f64 units; every op "
+                "touching it emulates in software",
+                "cast to float32/bfloat16 at the boundary unless f64 is "
+                "numerically required"))
+    for eqn, path, _w in iter_eqns(ctx.closed_jaxpr):
+        for ov in eqn.outvars:
+            dt = _dtype(ov)
+            if dt not in _WIDE_FLOATS:
+                continue
+            in_dts = [_dtype(v) for v in eqn.invars if _dtype(v)]
+            # the PROMOTION POINT: a wide output none of whose inputs was
+            # already wide-and-strong.  Downstream wide eqns inherit a wide
+            # input and stay silent — one finding per leak, not per use.
+            strong_wide_in = any(
+                d in _WIDE_FLOATS and not _weak(v)
+                for d, v in zip(in_dts, [v for v in eqn.invars if _dtype(v)]))
+            if strong_wide_in:
+                continue
+            if _weak(ov):
+                findings.append(Finding(
+                    Severity.INFO, "DTYPE_WEAK_F64", format_path(path, eqn),
+                    f"weak-typed {dt} scalar (a Python number leaked into "
+                    f"the graph) at {eqn.primitive.name}",
+                    "wrap the scalar in jnp.float32(...) or an array of the "
+                    "intended dtype"))
+                continue
+            narrow = [d for d in in_dts if d not in _WIDE_FLOATS]
+            findings.append(Finding(
+                Severity.WARNING, "DTYPE_F64_PROMOTION",
+                format_path(path, eqn),
+                f"{eqn.primitive.name} promotes "
+                f"{'/'.join(sorted(set(narrow))) or 'constants'} -> {dt} "
+                f"({fmt_aval(ov.aval)})",
+                "find the f64 operand (np.float64 scalar, np.array default "
+                "dtype, or an explicit astype) and pin it to float32"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 2. donation: large read-write args to jitted regions that are not donated
+#    get COPIED every step (params, optimizer state, KV pools)
+# ---------------------------------------------------------------------------
+
+
+def _aval_key(v):
+    a = v.aval
+    return (tuple(a.shape), str(a.dtype))
+
+
+@register_checker("donation")
+def check_donation(ctx: CheckContext):
+    findings: List[Finding] = []
+    thresh = ctx.opt("donation_min_bytes")
+    for eqn, path, _w in iter_eqns(ctx.closed_jaxpr):
+        if eqn.primitive.name != "pjit":
+            continue
+        donated = eqn.params.get("donated_invars")
+        if donated is None:
+            continue
+        out_pool: dict = {}
+        for ov in eqn.outvars:
+            if is_array_var(ov):
+                k = _aval_key(ov)
+                out_pool[k] = out_pool.get(k, 0) + 1
+
+        def take(k):
+            if out_pool.get(k, 0) > 0:
+                out_pool[k] -= 1
+                return True
+            return False
+
+        # donated invars claim their matching outputs first: a donated
+        # params arg must not leave its aval free to accuse a twin
+        undonated = []
+        for v, don in zip(eqn.invars, donated):
+            if not is_array_var(v):
+                continue
+            if don:
+                take(_aval_key(v))
+            else:
+                undonated.append(v)
+        for v in undonated:
+            if aval_bytes(v.aval) < thresh:
+                continue
+            if take(_aval_key(v)):
+                findings.append(Finding(
+                    Severity.WARNING, "DONATION_MISSING",
+                    format_path(path, eqn),
+                    f"jitted fn {eqn.params.get('name', '?')!r}: arg "
+                    f"{ctx.invar_name(v)} ({fmt_aval(v.aval)}, "
+                    f"{fmt_bytes(aval_bytes(v.aval))}) matches an output "
+                    "but is not donated — XLA keeps both buffers live and "
+                    "copies the update",
+                    "add its position to donate_argnums in jax.jit "
+                    "(read-write step args: params, opt state, KV pools)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 3. sharding: under a >1-device mesh, big intermediates never reached by
+#    any sharded value (or any with_sharding_constraint) end up replicated
+#    on every device; replicating an already-sharded value is an all-gather
+# ---------------------------------------------------------------------------
+
+
+def _sharding_is_sharded(s) -> bool:
+    try:
+        return not s.is_fully_replicated
+    except Exception:  # noqa: BLE001 — UnspecifiedValue / AUTO
+        return False
+
+
+def _arg_taint(ctx: CheckContext) -> List[bool]:
+    leaves = jax.tree_util.tree_leaves((ctx.args, ctx.kwargs))
+    taint = []
+    for x in leaves:
+        s = getattr(x, "sharding", None)
+        taint.append(bool(s is not None and _sharding_is_sharded(s)))
+    invars = ctx.closed_jaxpr.jaxpr.invars
+    if len(taint) != len(invars):       # static args / captured consts
+        taint = (taint + [False] * len(invars))[:len(invars)]
+    return taint
+
+
+# eqns GSPMD propagates a sharding BACKWARD through cheaply (a constraint
+# on a cast/transpose of x effectively shards x too)
+_BWD_PROP_PRIMS = frozenset({
+    "convert_element_type", "transpose", "reshape", "copy", "squeeze",
+    "expand_dims", "sharding_constraint",
+})
+
+
+@register_checker("sharding")
+def check_sharding(ctx: CheckContext):
+    mesh = ctx.mesh
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return []
+    thresh = ctx.opt("sharding_min_bytes")
+    findings: List[Finding] = []
+
+    def walk(jaxpr, invar_taint, path) -> List[bool]:
+        jaxpr = _as_open(jaxpr)
+        tainted = {v for v, t in zip(jaxpr.invars, invar_taint) if t}
+        big_repl = set()            # big replicated vars seen (for dedup)
+        deferred = []               # (var, eqn, path) candidate reports
+
+        def is_t(v):
+            return is_array_var(v) and v in tainted
+
+        for eqn in jaxpr.eqns:
+            in_t = any(is_t(v) for v in eqn.invars)
+            prim = eqn.primitive.name
+            if prim == "sharding_constraint":
+                sh = eqn.params.get("sharding")
+                if _sharding_is_sharded(sh):
+                    tainted.update(eqn.outvars)
+                elif in_t and aval_bytes(eqn.outvars[0].aval) >= thresh:
+                    findings.append(Finding(
+                        Severity.WARNING, "SHARD_GAP",
+                        format_path(path, eqn),
+                        "with_sharding_constraint re-replicates a sharded "
+                        f"{fmt_aval(eqn.outvars[0].aval)} "
+                        f"({fmt_bytes(aval_bytes(eqn.outvars[0].aval))}) — "
+                        "an implicit all-gather on every device",
+                        "constrain to a sharded PartitionSpec, or drop the "
+                        "constraint and let GSPMD propagate"))
+                continue
+            if prim == "pjit":
+                inner = eqn.params["jaxpr"]
+                in_sh = eqn.params.get("in_shardings") or ()
+                sub_in = []
+                for i, v in enumerate(eqn.invars):
+                    t = is_t(v)
+                    if i < len(in_sh) and _sharding_is_sharded(in_sh[i]):
+                        t = True
+                    sub_in.append(t)
+                out_t = walk(inner, sub_in,
+                             path + (f"pjit:{eqn.params.get('name', '')}",))
+                out_sh = eqn.params.get("out_shardings") or ()
+                for i, ov in enumerate(eqn.outvars):
+                    t = out_t[i] if i < len(out_t) else False
+                    if i < len(out_sh) and _sharding_is_sharded(out_sh[i]):
+                        t = True
+                    if t:
+                        tainted.add(ov)
+            else:
+                subs = list(sub_jaxprs(eqn))
+                sub_out_t = False
+                for label, sj, _w in subs:
+                    oj = _as_open(sj)
+                    ot = walk(oj, [in_t] * len(oj.invars),
+                              path + (prim, label))
+                    sub_out_t = sub_out_t or any(ot)
+                if in_t or sub_out_t:
+                    tainted.update(v for v in eqn.outvars if is_array_var(v))
+            # candidate: big tensor no sharded value reaches.  Report only
+            # the CREATION point (consumers of a flagged var stay silent)
+            # and only after the backward pass below clears constraints
+            # applied downstream (GSPMD propagates shardings backward too).
+            inherits = any(v in big_repl for v in eqn.invars
+                           if is_array_var(v))
+            for ov in eqn.outvars:
+                if not is_array_var(ov) or ov in tainted:
+                    continue
+                nb = aval_bytes(ov.aval)
+                if nb >= thresh:
+                    big_repl.add(ov)
+                    if not inherits:
+                        deferred.append((ov, eqn, path))
+        # backward sweep: inputs of sharded sharding_constraints (and of
+        # cheap view chains above them) count as sharded
+        btaint = set()
+        for eqn in reversed(jaxpr.eqns):
+            prim = eqn.primitive.name
+            if prim == "sharding_constraint" and _sharding_is_sharded(
+                    eqn.params.get("sharding")):
+                btaint.update(v for v in eqn.invars if is_array_var(v))
+            elif prim in _BWD_PROP_PRIMS and any(
+                    v in btaint for v in eqn.outvars if is_array_var(v)):
+                btaint.update(v for v in eqn.invars if is_array_var(v))
+        for ov, eqn, p in deferred:
+            if ov in btaint:
+                continue
+            findings.append(Finding(
+                Severity.WARNING, "SHARD_REPLICATED",
+                format_path(p, eqn),
+                f"{fmt_aval(ov.aval)} ({fmt_bytes(aval_bytes(ov.aval))}) "
+                "is reached by no sharded input or "
+                "with_sharding_constraint — GSPMD will replicate it on "
+                f"all {mesh.size} devices",
+                "add jax.lax.with_sharding_constraint with a sharded "
+                "PartitionSpec, or derive it from a sharded value"))
+        return [is_t(v) or v in btaint if is_array_var(v) else False
+                for v in jaxpr.outvars]
+
+    walk(ctx.closed_jaxpr.jaxpr, _arg_taint(ctx), ())
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 4. recompile hazards: captured array constants (baked into the program),
+#    mutable Python closures (silently NOT retraced), and shape-polymorphic
+#    call sites (one compile per distinct signature)
+# ---------------------------------------------------------------------------
+
+
+def _unwrap(fn):
+    seen = 0
+    while seen < 8:
+        seen += 1
+        if isinstance(fn, functools.partial):
+            fn = fn.func
+            continue
+        inner = getattr(fn, "__wrapped__", None)
+        if inner is not None and inner is not fn:
+            fn = inner
+            continue
+        break
+    return fn
+
+
+@register_checker("recompile_hazard")
+def check_recompile_hazard(ctx: CheckContext):
+    findings: List[Finding] = []
+    thresh = ctx.opt("const_capture_min_bytes")
+    for c in ctx.closed_jaxpr.consts:
+        nb = getattr(c, "nbytes", 0) or 0
+        if nb >= thresh:
+            findings.append(Finding(
+                Severity.WARNING, "RECOMPILE_CONST_CAPTURE", "<top>",
+                f"captured array constant {np.shape(c)} "
+                f"{np.result_type(c)} ({fmt_bytes(int(nb))}) is baked into "
+                "the compiled program — a new value means a new trace, and "
+                "the constant bloats every executable that embeds it",
+                "pass it as an argument (jit caches on shape/dtype, not "
+                "value) or construct it inside the function"))
+    fn = _unwrap(ctx.fn) if ctx.fn is not None else None
+    closure = getattr(fn, "__closure__", None) or ()
+    for cell in closure:
+        try:
+            val = cell.cell_contents
+        except ValueError:
+            continue
+        if isinstance(val, (list, dict, set, bytearray)):
+            findings.append(Finding(
+                Severity.INFO, "RECOMPILE_MUTABLE_CLOSURE", "<top>",
+                f"closure captures a mutable {type(val).__name__} — jit "
+                "traced its current contents; later mutation will NOT "
+                "retrigger tracing (silently stale) ",
+                "capture immutable values, or pass it as a (static) "
+                "argument"))
+    sigs = {s for s in ctx.probe_signatures}
+    if len(sigs) > 1:
+        findings.append(Finding(
+            Severity.WARNING, "RECOMPILE_SHAPE_POLY", "<top>",
+            f"compile-cache probe: {len(sigs)} distinct arg signatures "
+            f"across {len(ctx.probe_signatures)} call sites — each one "
+            "compiles (and caches) a separate executable",
+            "pad/bucket dynamic dims to a fixed menu of shapes (the engine "
+            "buckets prompt lengths to powers of two for exactly this)"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 5. cost: top-k heaviest eqns (static FLOPs/bytes roll-up -> profiler)
+# ---------------------------------------------------------------------------
+
+
+@register_checker("cost")
+def check_cost(ctx: CheckContext):
+    top_k = ctx.opt("cost_top_k")
+    est = cost_lib.estimate(ctx.closed_jaxpr, top_k=top_k)
+    findings = [Finding(
+        Severity.INFO, "COST_SUMMARY", "<top>",
+        f"~{est['total_flops']:.3g} FLOPs, ~{fmt_bytes(est['total_bytes'])} "
+        "operand traffic per call (static estimate, scan lengths included)",
+        "profiler.static_cost(fn, *args) returns the same roll-up as data")]
+    for c in est["top"]:
+        if c["flops"] <= 0 and c["bytes"] <= 0:
+            continue
+        findings.append(Finding(
+            Severity.INFO, "COST_HOTSPOT", c["path"],
+            f"{c['primitive']}: ~{c['flops']:.3g} FLOPs, "
+            f"{fmt_bytes(c['bytes'])}"
+            + (f" (x{c['weight']} scan trips)" if c["weight"] > 1 else ""),
+            ""))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 6. dead / constant subgraphs (jaxpr-level analog of static/passes.py's
+#    dead_code_elimination + constant_folding record passes)
+# ---------------------------------------------------------------------------
+
+# value-creation prims that are trivially folded/streamed by XLA: a
+# const-only zeros/iota is idiomatic, not a finding
+_CREATION_PRIMS = frozenset({
+    "broadcast_in_dim", "iota", "reshape", "convert_element_type",
+    "transpose", "squeeze", "expand_dims", "concatenate", "slice",
+    "broadcast", "copy", "device_put",
+})
+
+
+@register_checker("dead_code")
+def check_dead_code(ctx: CheckContext):
+    findings: List[Finding] = []
+    const_thresh = ctx.opt("const_subgraph_min_bytes")
+    for jaxpr, path, _w in iter_jaxprs(ctx.closed_jaxpr):
+        # -- dead eqns: reverse liveness from this jaxpr's outvars ---------
+        live = {v for v in jaxpr.outvars if is_array_var(v)}
+        keep = [False] * len(jaxpr.eqns)
+        for i in range(len(jaxpr.eqns) - 1, -1, -1):
+            eqn = jaxpr.eqns[i]
+            if eqn.effects or any(is_array_var(v) and v in live
+                                  for v in eqn.outvars):
+                keep[i] = True
+                live.update(v for v in eqn.invars if is_array_var(v))
+        for i, eqn in enumerate(jaxpr.eqns):
+            if not keep[i]:
+                out = (fmt_aval(eqn.outvars[0].aval) if eqn.outvars
+                       else "(no outputs)")
+                # cheap dead eqns (AD partial-eval routinely strands a few
+                # small ops; XLA DCEs them for free) are INFO; dead eqns
+                # doing real compute or holding real memory are WARNING
+                fl = cost_lib.eqn_flops(eqn) + sum(
+                    c["flops"] for sj in
+                    (s for _l, s, _w in sub_jaxprs(eqn))
+                    for c in cost_lib.per_eqn_costs(sj))
+                nb = max((aval_bytes(v.aval) for v in eqn.outvars
+                          if is_array_var(v)), default=0)
+                heavy = (fl >= ctx.opt("dead_code_min_flops")
+                         or nb >= ctx.opt("dead_code_min_bytes"))
+                findings.append(Finding(
+                    Severity.WARNING if heavy else Severity.INFO,
+                    "DEAD_CODE", format_path(path, eqn),
+                    f"{eqn.primitive.name} output {out} never reaches an "
+                    "output — traced, compiled, and (until XLA DCE) "
+                    "scheduled for nothing"
+                    + (f" (~{fl:.3g} FLOPs)" if heavy and fl else ""),
+                    "drop the computation, or return/consume its result"))
+        # -- const subgraphs: forward taint from invars --------------------
+        varying = {v for v in jaxpr.invars if is_array_var(v)}
+        for i, eqn in enumerate(jaxpr.eqns):
+            if not keep[i]:
+                continue        # already reported as dead
+            dep_varying = any(is_array_var(v) and v in varying
+                              for v in eqn.invars)
+            if dep_varying or eqn.effects:
+                varying.update(v for v in eqn.outvars if is_array_var(v))
+                continue
+            # const-only eqn: flag when it does real compute or makes a
+            # big buffer; pure creation prims are left to XLA folding
+            prim = eqn.primitive.name
+            out_nb = max((aval_bytes(v.aval) for v in eqn.outvars
+                          if is_array_var(v)), default=0)
+            heavy = prim in ("dot_general", "conv_general_dilated")
+            if heavy or (out_nb >= const_thresh
+                         and prim not in _CREATION_PRIMS):
+                out = (fmt_aval(eqn.outvars[0].aval) if eqn.outvars
+                       else "(no outputs)")
+                findings.append(Finding(
+                    Severity.INFO, "CONST_SUBGRAPH", format_path(path, eqn),
+                    f"{prim} ({out}) depends only "
+                    "on constants — recomputed at every trace, folded into "
+                    "the executable as frozen data",
+                    "hoist it out of the traced function (compute once, "
+                    "pass as an argument)"))
+    return findings

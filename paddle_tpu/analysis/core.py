@@ -1,0 +1,438 @@
+"""Graph Doctor core: Finding/Report types, checker registry, jaxpr walker.
+
+Reference analog: the *analysis* half of the reference IR pass pipeline
+(~274 passes over ProgramDesc/PIR graphs in `paddle/fluid/framework/ir/`,
+SURVEY C14).  `static/passes.py` reproduces the rewrite half at the record
+level; this package is the analysis half at the JAXPR level — the typed IR
+we actually traffic in (kernels, moe, generation, engine).  Checkers walk a
+`ClosedJaxpr` (recursing into pjit/scan/cond/while/custom-vjp sub-jaxprs)
+and emit structured `Finding` diagnostics instead of rewriting anything.
+
+Registry mirrors `static/passes.py`: `register_checker(name)` /
+`list_checkers()` / `analyze(fn, *args)`, plus per-call (`suppress=`) and
+per-code (`suppressions(...)` context) suppression, matched exactly or by
+`"PREFIX*"` glob.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import enum
+import fnmatch
+import functools
+from typing import Any, Callable, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+from jax.extend import core as jex_core
+
+__all__ = [
+    "Severity", "Finding", "Report", "register_checker", "list_checkers",
+    "analyze", "analyze_jaxpr", "suppressions", "iter_eqns", "iter_jaxprs",
+    "aval_bytes", "CheckContext",
+]
+
+_DropVar = getattr(jax._src.core, "DropVar", ())
+
+
+class Severity(enum.IntEnum):
+    INFO = 1
+    WARNING = 2
+    ERROR = 3
+
+    def __str__(self):  # "warning", for reports / JSON
+        return self.name.lower()
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: where (eqn_path), what (code/message), what to do."""
+
+    severity: Severity
+    code: str
+    eqn_path: str
+    message: str
+    suggestion: str = ""
+    checker: str = ""
+
+    def to_dict(self) -> dict:
+        return {"severity": str(self.severity), "code": self.code,
+                "eqn_path": self.eqn_path, "message": self.message,
+                "suggestion": self.suggestion, "checker": self.checker}
+
+    def __str__(self):
+        tag = {"info": "I", "warning": "W", "error": "E"}[str(self.severity)]
+        s = f"[{tag}] {self.code} @ {self.eqn_path}: {self.message}"
+        if self.suggestion:
+            s += f"  -> {self.suggestion}"
+        return s
+
+
+class Report:
+    """Ordered findings (most severe first) + suppression accounting."""
+
+    def __init__(self, findings: Sequence[Finding], suppressed: int = 0,
+                 checkers: Sequence[str] = ()):
+        self.findings = sorted(findings, key=lambda f: (-f.severity, f.code))
+        self.suppressed = suppressed
+        self.checkers = tuple(checkers)
+
+    def __iter__(self) -> Iterator[Finding]:
+        return iter(self.findings)
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    @property
+    def codes(self) -> set:
+        return {f.code for f in self.findings}
+
+    def by_code(self, code: str) -> List[Finding]:
+        return [f for f in self.findings if fnmatch.fnmatch(f.code, code)]
+
+    def counts(self) -> Dict[str, int]:
+        out = {"error": 0, "warning": 0, "info": 0}
+        for f in self.findings:
+            out[str(f.severity)] += 1
+        return out
+
+    def ok(self, fail_on: Severity = Severity.WARNING) -> bool:
+        """True when no finding is at/above `fail_on` (after suppression)."""
+        return all(f.severity < fail_on for f in self.findings)
+
+    def to_json(self) -> dict:
+        return {"findings": [f.to_dict() for f in self.findings],
+                "counts": self.counts(), "suppressed": self.suppressed,
+                "checkers": list(self.checkers)}
+
+    def __str__(self):
+        if not self.findings:
+            body = "clean — no findings"
+        else:
+            body = "\n".join(str(f) for f in self.findings)
+        c = self.counts()
+        return (f"{body}\n-- {c['error']} error(s), {c['warning']} "
+                f"warning(s), {c['info']} info, {self.suppressed} suppressed")
+
+
+# ---------------------------------------------------------------------------
+# Checker registry (mirrors static/passes.py's PASS_REGISTRY)
+# ---------------------------------------------------------------------------
+
+CHECKER_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_checker(name: str):
+    """Register a checker: `fn(ctx: CheckContext) -> Iterable[Finding]`."""
+    def deco(fn):
+        CHECKER_REGISTRY[name] = fn
+        fn._checker_name = name
+        return fn
+    return deco
+
+
+def list_checkers() -> List[str]:
+    return sorted(CHECKER_REGISTRY)
+
+
+# -- suppression (per-call arg + process-wide context) ----------------------
+
+_GLOBAL_SUPPRESS: set = set()
+
+
+@contextlib.contextmanager
+def suppressions(*codes: str):
+    """Process-wide suppression of finding codes (exact or "PREFIX*" glob)
+    for the duration of the context — the per-code half of the suppression
+    story; `analyze(..., suppress=[...])` is the per-call half."""
+    added = set(codes) - _GLOBAL_SUPPRESS
+    _GLOBAL_SUPPRESS.update(added)
+    try:
+        yield
+    finally:
+        _GLOBAL_SUPPRESS.difference_update(added)
+
+
+def _is_suppressed(finding: "Finding", patterns: Iterable[str]) -> bool:
+    """Pattern syntax: "CODE", "PREFIX*", or "CODE@pathglob" scoping the
+    suppression to eqn paths matching the glob."""
+    for p in patterns:
+        code_pat, _, path_pat = p.partition("@")
+        if not (finding.code == code_pat
+                or fnmatch.fnmatch(finding.code, code_pat)):
+            continue
+        if not path_pat or fnmatch.fnmatch(finding.eqn_path, path_pat):
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Jaxpr walking
+# ---------------------------------------------------------------------------
+
+# primitives whose param jaxprs run under different semantics (per-grid-step
+# kernels); recursing into them would mis-count cost and mis-read liveness
+_OPAQUE_PRIMS = frozenset({"pallas_call", "custom_partitioning"})
+
+
+def _as_open(j):
+    return j.jaxpr if isinstance(j, jex_core.ClosedJaxpr) else j
+
+
+def _eqn_label(eqn) -> str:
+    name = eqn.params.get("name") if isinstance(eqn.params, dict) else None
+    if isinstance(name, str) and name:
+        return f"{eqn.primitive.name}:{name}"
+    return eqn.primitive.name
+
+
+def sub_jaxprs(eqn) -> Iterator[Tuple[str, Any, int]]:
+    """(label, sub-jaxpr, weight) under an eqn.  weight is the static trip
+    count the body runs per call of the parent (scan length; 1 elsewhere —
+    `while` trip counts are unknowable statically)."""
+    if eqn.primitive.name in _OPAQUE_PRIMS:
+        return
+    p = eqn.params
+    if eqn.primitive.name == "scan":
+        yield "body", p["jaxpr"], int(p.get("length", 1))
+        return
+    if eqn.primitive.name == "while":
+        yield "cond", p["cond_jaxpr"], 1
+        yield "body", p["body_jaxpr"], 1
+        return
+    if eqn.primitive.name == "cond":
+        for i, b in enumerate(p["branches"]):
+            yield f"branch{i}", b, 1
+        return
+    for k, v in p.items():
+        if isinstance(v, (jex_core.Jaxpr, jex_core.ClosedJaxpr)):
+            yield k, v, 1
+        elif isinstance(v, (tuple, list)) and v and all(
+                isinstance(x, (jex_core.Jaxpr, jex_core.ClosedJaxpr))
+                for x in v):
+            for i, x in enumerate(v):
+                yield f"{k}[{i}]", x, 1
+
+
+def iter_eqns(jaxpr, path: Tuple[str, ...] = (), weight: int = 1,
+              max_depth: int = 32):
+    """Yield (eqn, path, weight) over a (Closed)Jaxpr, recursing into
+    sub-jaxprs.  `weight` multiplies up static trip counts (scan length)."""
+    jaxpr = _as_open(jaxpr)
+    if max_depth <= 0:
+        return
+    for eqn in jaxpr.eqns:
+        yield eqn, path, weight
+        for label, sub, w in sub_jaxprs(eqn):
+            yield from iter_eqns(sub, path + (_eqn_label(eqn), label),
+                                 weight * w, max_depth - 1)
+
+
+def iter_jaxprs(jaxpr, path: Tuple[str, ...] = (), weight: int = 1,
+                max_depth: int = 32):
+    """Yield (open_jaxpr, path, weight) for the jaxpr and every sub-jaxpr."""
+    jaxpr = _as_open(jaxpr)
+    yield jaxpr, path, weight
+    if max_depth <= 0:
+        return
+    for eqn in jaxpr.eqns:
+        for label, sub, w in sub_jaxprs(eqn):
+            yield from iter_jaxprs(sub, path + (_eqn_label(eqn), label),
+                                   weight * w, max_depth - 1)
+
+
+def format_path(path: Tuple[str, ...], eqn=None) -> str:
+    parts = list(path)
+    if eqn is not None:
+        parts.append(_eqn_label(eqn))
+    return "/".join(parts) if parts else "<top>"
+
+
+def aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    try:
+        return int(np.prod(shape, dtype=np.int64)) * np.dtype(dtype).itemsize
+    except Exception:  # noqa: BLE001 — abstract/opaque dtypes
+        return 0
+
+
+def is_array_var(v) -> bool:
+    return isinstance(v, jex_core.Var) and not isinstance(v, _DropVar)
+
+
+def fmt_aval(aval) -> str:
+    shape = getattr(aval, "shape", ())
+    dtype = getattr(aval, "dtype", "?")
+    return f"{np.dtype(dtype).name if dtype != '?' else '?'}" \
+           f"[{','.join(str(d) for d in shape)}]"
+
+
+def fmt_bytes(n: int) -> str:
+    if n >= 1 << 30:
+        return f"{n / (1 << 30):.2f} GiB"
+    if n >= 1 << 20:
+        return f"{n / (1 << 20):.2f} MiB"
+    if n >= 1 << 10:
+        return f"{n / (1 << 10):.1f} KiB"
+    return f"{n} B"
+
+
+# ---------------------------------------------------------------------------
+# Analysis entry points
+# ---------------------------------------------------------------------------
+
+_DEFAULT_OPTIONS = {
+    # byte thresholds: below these a copy / replica is considered noise
+    "donation_min_bytes": 1 << 20,
+    "sharding_min_bytes": 1 << 20,
+    "const_capture_min_bytes": 1 << 20,
+    "const_subgraph_min_bytes": 1 << 16,
+    # dead eqns below BOTH of these are INFO (XLA DCEs them for free);
+    # at/above either they warn — dead heavy compute is a real bug
+    "dead_code_min_flops": 1e5,
+    "dead_code_min_bytes": 1 << 16,
+    "cost_top_k": 5,
+    # at most this many findings per (checker, code) pair
+    "max_findings_per_code": 8,
+}
+
+
+@dataclasses.dataclass
+class CheckContext:
+    """Everything a checker may inspect.  `fn`/`args` are None when entering
+    through analyze_jaxpr (jaxpr-only checkers must tolerate that)."""
+
+    closed_jaxpr: Any
+    fn: Optional[Callable] = None
+    args: Tuple = ()
+    kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    mesh: Any = None
+    # abstract (shape, dtype) signatures of extra call sites, for the
+    # compile-cache probe (see checkers.check_recompile_hazard)
+    probe_signatures: List[Tuple] = dataclasses.field(default_factory=list)
+    options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # flat-invar index -> human arg path ("args[0]['blocks']['wq']")
+    arg_names: Dict[int, str] = dataclasses.field(default_factory=dict)
+
+    def opt(self, key: str, default=None):
+        if key in self.options:
+            return self.options[key]
+        return _DEFAULT_OPTIONS.get(key, default)
+
+    def invar_name(self, var) -> str:
+        """Human name for a top-level invar, or a positional fallback."""
+        invars = self.closed_jaxpr.jaxpr.invars
+        for i, v in enumerate(invars):
+            if v is var:
+                return self.arg_names.get(i, f"arg#{i}")
+        return "<non-toplevel>"
+
+
+def _arg_signature(args, kwargs) -> Tuple:
+    """The abstract signature jit keys its compile cache on: per-leaf
+    (shape, dtype) + the pytree structure."""
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = tuple((tuple(np.shape(x)), str(jnp_result_type(x))) for x in leaves)
+    return (str(treedef), sig)
+
+
+def jnp_result_type(x):
+    try:
+        return jax.numpy.result_type(x)
+    except Exception:  # noqa: BLE001
+        return type(x).__name__
+
+
+def _arg_name_map(args, kwargs) -> Dict[int, str]:
+    try:
+        flat, _ = jax.tree_util.tree_flatten_with_path((args, kwargs))
+    except Exception:  # noqa: BLE001
+        return {}
+    names = {}
+    for i, (path, _x) in enumerate(flat):
+        label = jax.tree_util.keystr(path)
+        # keystr of (args, kwargs): "[0][2]['blocks']['wq']" — rewrite the
+        # leading tuple index into args[...] / kwargs[...]
+        if label.startswith("[0]"):
+            label = "args" + label[3:]
+        elif label.startswith("[1]"):
+            label = "kwargs" + label[3:]
+        names[i] = label
+    return names
+
+
+def _run_checkers(ctx: CheckContext, checkers, suppress) -> Report:
+    names = list_checkers() if checkers is None else list(checkers)
+    findings: List[Finding] = []
+    for name in names:
+        if name not in CHECKER_REGISTRY:
+            raise ValueError(
+                f"unknown checker {name!r}; available: {list_checkers()}")
+        for f in CHECKER_REGISTRY[name](ctx):
+            if not f.checker:
+                f = dataclasses.replace(f, checker=name)
+            findings.append(f)
+    patterns = set(suppress) | _GLOBAL_SUPPRESS
+    kept, suppressed = [], 0
+    per_code: Dict[Tuple[str, str], int] = {}
+    cap = ctx.opt("max_findings_per_code")
+    for f in sorted(findings, key=lambda f: -f.severity):
+        if _is_suppressed(f, patterns):
+            suppressed += 1
+            continue
+        key = (f.checker, f.code)
+        per_code[key] = per_code.get(key, 0) + 1
+        if cap and per_code[key] > cap:
+            continue
+        kept.append(f)
+    for (checker, code), n in per_code.items():
+        if cap and n > cap:
+            kept.append(Finding(
+                Severity.INFO, code, "<report>",
+                f"{n - cap} further {code} finding(s) truncated "
+                f"(max_findings_per_code={cap})", checker=checker))
+    return Report(kept, suppressed=suppressed, checkers=names)
+
+
+def analyze_jaxpr(closed_jaxpr, checkers: Optional[Sequence[str]] = None,
+                  suppress: Sequence[str] = (), mesh=None,
+                  options: Optional[dict] = None) -> Report:
+    """Run checkers over an already-traced ClosedJaxpr."""
+    ctx = CheckContext(closed_jaxpr=closed_jaxpr, mesh=mesh,
+                       options=dict(options or {}))
+    return _run_checkers(ctx, checkers, suppress)
+
+
+def analyze(fn, *args, checkers: Optional[Sequence[str]] = None,
+            suppress: Sequence[str] = (), mesh=None,
+            probe_args: Optional[Sequence[Tuple]] = None,
+            options: Optional[dict] = None, static_argnums=(),
+            **kwargs) -> Report:
+    """Trace `fn(*args, **kwargs)` to a jaxpr and run every registered
+    checker (or the named subset) over it.
+
+    fn may be plain or jit-wrapped — a jitted fn traces to a `pjit` eqn
+    carrying donation/sharding metadata, which the donation and sharding
+    checkers read.  Args may be concrete arrays or `jax.ShapeDtypeStruct`s
+    (nothing is executed; `analyze` only traces).
+
+    probe_args: optional extra argument tuples representing other call
+    sites of the same fn; differing abstract signatures are reported as
+    recompile hazards (each signature compiles separately).
+    suppress: per-call finding-code suppressions (exact or "PREFIX*").
+    """
+    traced = functools.partial(fn, **kwargs) if kwargs else fn
+    closed = jax.make_jaxpr(traced, static_argnums=static_argnums)(*args)
+    sigs = [_arg_signature(args, kwargs)]
+    for extra in (probe_args or ()):
+        sigs.append(_arg_signature(tuple(extra), {}))
+    ctx = CheckContext(
+        closed_jaxpr=closed, fn=fn, args=args, kwargs=kwargs, mesh=mesh,
+        probe_signatures=sigs, options=dict(options or {}),
+        arg_names=_arg_name_map(args, kwargs))
+    return _run_checkers(ctx, checkers, suppress)

@@ -1,0 +1,153 @@
+"""Static per-eqn FLOPs/bytes roll-up over jaxprs.
+
+The analysis-side analog of the reference's cost-model passes (the
+auto-tuner's op cost tables); here the numbers come straight from eqn
+shapes.  Conventions:
+
+  * dot_general: 2 * batch * M * N * K
+  * conv_general_dilated: 2 * prod(out) * prod(kernel_spatial) * Cin / groups
+  * everything else: max(prod(in), prod(out)) — one flop per element
+  * bytes: sum of operand + result nbytes (a proxy for HBM traffic; XLA
+    fusion will beat this, but the *ranking* of heavy eqns survives)
+  * scan bodies multiply by the static trip count; `while` bodies count
+    once (trip counts are not static); both `cond` branches count (upper
+    bound); pallas_call is opaque — kernels register their own FLOPs
+    formulas via `register_pallas_flops` (see paddle_tpu/kernels).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .core import aval_bytes, format_path, iter_eqns
+
+__all__ = ["eqn_flops", "eqn_bytes", "per_eqn_costs", "estimate",
+           "register_pallas_flops"]
+
+# substring of the pallas kernel name -> fn(eqn) -> flops
+_PALLAS_FLOPS: Dict[str, Callable] = {}
+
+
+def register_pallas_flops(name_substr: str, fn: Callable) -> None:
+    """Register a FLOPs estimator for pallas_call eqns whose kernel name
+    contains `name_substr`.  `fn(eqn) -> float` sees the raw eqn (shapes
+    via eqn.invars/outvars avals)."""
+    _PALLAS_FLOPS[name_substr] = fn
+
+
+def _pallas_kernel_name(eqn) -> str:
+    """Kernel-name string registrations match against: the bare 'name'
+    param AND 'name_and_src_info' (which carries the source path), joined —
+    so both fn-name keys ('_gmm_kernel') and file keys
+    ('pallas_attention.py') keep matching across jax versions that
+    populate either param."""
+    name = eqn.params.get("name")
+    info = eqn.params.get("name_and_src_info", "")
+    return f"{name if isinstance(name, str) else ''} {info}"
+
+
+def _numel(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    return int(np.prod(shape, dtype=np.int64))
+
+
+def _dot_general_flops(eqn) -> float:
+    (contract, batch) = eqn.params["dimension_numbers"]
+    (lc, rc), (lb, _rb) = contract, batch
+    lhs, rhs = eqn.invars[0].aval, eqn.invars[1].aval
+    k = int(np.prod([lhs.shape[d] for d in lc], dtype=np.int64)) or 1
+    b = int(np.prod([lhs.shape[d] for d in lb], dtype=np.int64)) or 1
+    m = _numel(lhs) // max(k * b, 1)
+    n = _numel(rhs) // max(k * b, 1)
+    return 2.0 * b * m * n * k
+
+
+def _conv_flops(eqn) -> float:
+    out = eqn.outvars[0].aval
+    rhs = eqn.invars[1].aval           # kernel: (O, I/g, *spatial) in XLA dnums
+    groups = int(eqn.params.get("feature_group_count", 1) or 1)
+    # per output element: one MAC per kernel element per input channel slice
+    kernel_elems = _numel(rhs) // max(rhs.shape[0], 1)
+    return 2.0 * _numel(out) * kernel_elems / max(groups, 1)
+
+
+def eqn_flops(eqn) -> float:
+    """Estimated FLOPs of one eqn (containers and opaque kernels -> 0
+    unless a pallas estimator is registered)."""
+    prim = eqn.primitive.name
+    try:
+        if prim == "dot_general":
+            return _dot_general_flops(eqn)
+        if prim == "conv_general_dilated":
+            return _conv_flops(eqn)
+        if prim == "pallas_call":
+            ce = eqn.params.get("cost_estimate")
+            if ce is not None and getattr(ce, "flops", None):
+                return float(ce.flops)
+            name = _pallas_kernel_name(eqn)
+            for sub, fn in _PALLAS_FLOPS.items():
+                if sub in name:
+                    return float(fn(eqn))
+            return 0.0
+        if prim in ("pjit", "scan", "while", "cond", "custom_jvp_call",
+                    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+                    "checkpoint", "closed_call", "core_call", "named_call"):
+            return 0.0  # containers: cost lives in their sub-eqns
+        ins = max((_numel(v.aval) for v in eqn.invars
+                   if hasattr(v, "aval")), default=0)
+        outs = max((_numel(v.aval) for v in eqn.outvars
+                    if hasattr(v, "aval")), default=0)
+        return float(max(ins, outs))
+    except Exception:  # noqa: BLE001 — cost must never break analysis
+        return 0.0
+
+
+def eqn_bytes(eqn) -> int:
+    try:
+        return sum(aval_bytes(v.aval) for v in list(eqn.invars)
+                   + list(eqn.outvars) if hasattr(v, "aval"))
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def per_eqn_costs(closed_jaxpr, max_depth: int = 32) -> List[dict]:
+    """[{primitive, path, flops, bytes, weight}] over all eqns, with scan
+    trip counts multiplied in.  Container eqns contribute 0 themselves."""
+    out = []
+    for eqn, path, weight in iter_eqns(closed_jaxpr, max_depth=max_depth):
+        fl, by = eqn_flops(eqn), eqn_bytes(eqn)
+        if fl or by:
+            out.append({
+                "primitive": eqn.primitive.name,
+                "path": format_path(path, eqn),
+                "flops": fl * weight,
+                "bytes": by * weight,
+                "weight": weight,
+            })
+    return out
+
+
+def estimate(fn_or_jaxpr, *args, top_k: Optional[int] = None, **kwargs):
+    """Roll up {total_flops, total_bytes, top} for a callable (traced with
+    *args) or an already-closed jaxpr.  `top` holds the top_k heaviest
+    eqns by FLOPs (ties broken by bytes) — the profiler's static view."""
+    import jax
+
+    if args or kwargs or callable(fn_or_jaxpr):
+        import functools
+        traced = (functools.partial(fn_or_jaxpr, **kwargs) if kwargs
+                  else fn_or_jaxpr)
+        closed = jax.make_jaxpr(traced)(*args)
+    else:
+        closed = fn_or_jaxpr
+    costs = per_eqn_costs(closed)
+    costs.sort(key=lambda c: (-c["flops"], -c["bytes"]))
+    return {
+        "total_flops": float(sum(c["flops"] for c in costs)),
+        "total_bytes": int(sum(c["bytes"] for c in costs)),
+        "top": costs[: (top_k or 5)],
+    }

@@ -75,7 +75,8 @@ def compute_capacity(num_tokens: int, cfg: MoEConfig) -> int:
     return min(max(cap, cfg.min_capacity), num_tokens)
 
 
-def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None):
+def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None,
+                   need_positions: bool = True):
     """Index-form GShard/Switch gating — the single source of routing truth.
 
     logits: (N, X) float.  Returns (expert_idx (N, k) int32, pos (N, k) int32
@@ -89,6 +90,12 @@ def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None):
     scatter dispatch below scale past the one-hot form's N·X·C wall
     (reference hits the same wall differently: its all-to-all buffers are
     count-sized, moe_utils.py:20).
+
+    need_positions=False skips the cumsum subgraph entirely and returns
+    trivial pos (zeros) / keep (ones): the dropless gmm dispatch neither
+    drops tokens nor uses capacity slots, and the Graph Doctor
+    (paddle_tpu.analysis, DEAD_CODE) showed the k one_hot+cumsum chains
+    being traced dead on every gmm step.
     """
     N, X = logits.shape
     C = capacity if capacity is not None else compute_capacity(N, cfg)
@@ -100,16 +107,21 @@ def gating_indices(logits, cfg: MoEConfig, capacity: Optional[int] = None):
         gate_vals = gate_vals / jnp.maximum(
             gate_vals.sum(-1, keepdims=True), 1e-9)
 
-    counts = jnp.zeros((X,), cfg.gate_dtype)
-    poss, keeps = [], []
-    for j in range(cfg.top_k):
-        m = jax.nn.one_hot(expert_idx[:, j], X, dtype=cfg.gate_dtype)  # (N, X)
-        pos = jnp.cumsum(m, axis=0) - 1.0 + counts[None, :]            # (N, X)
-        counts = counts + m.sum(axis=0)
-        poss.append((pos * m).sum(-1).astype(jnp.int32))
-        keeps.append(((pos < C) * m).sum(-1).astype(cfg.gate_dtype))
-    pos = jnp.stack(poss, axis=1)                              # (N, k)
-    keep = jnp.stack(keeps, axis=1)                            # (N, k)
+    if need_positions:
+        counts = jnp.zeros((X,), cfg.gate_dtype)
+        poss, keeps = [], []
+        for j in range(cfg.top_k):
+            m = jax.nn.one_hot(expert_idx[:, j], X,
+                               dtype=cfg.gate_dtype)                   # (N, X)
+            pos = jnp.cumsum(m, axis=0) - 1.0 + counts[None, :]        # (N, X)
+            counts = counts + m.sum(axis=0)
+            poss.append((pos * m).sum(-1).astype(jnp.int32))
+            keeps.append(((pos < C) * m).sum(-1).astype(cfg.gate_dtype))
+        pos = jnp.stack(poss, axis=1)                          # (N, k)
+        keep = jnp.stack(keeps, axis=1)                        # (N, k)
+    else:
+        pos = jnp.zeros_like(expert_idx)
+        keep = jnp.ones(expert_idx.shape, cfg.gate_dtype)
 
     # GShard eq.(4) load-balance loss: X * sum_x f_x * p_x where f_x is the
     # fraction of tokens whose TOP-1 pick is x and p_x the mean router prob.
@@ -302,7 +314,10 @@ def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None,
             onehot_bytes = 2 * N * X * C * jnp.dtype(cfg.gate_dtype).itemsize
             mode = ("scatter" if onehot_bytes > _EINSUM_DISPATCH_LIMIT
                     else "einsum")
-    e, pos, keep, gates, aux, C = gating_indices(logits, cfg)
+    # gmm is dropless: skip tracing the capacity-position subgraph it
+    # would never read (flagged by the Graph Doctor as dead code)
+    e, pos, keep, gates, aux, C = gating_indices(
+        logits, cfg, need_positions=(mode != "gmm"))
     if mode == "einsum":
         dispatch_t, combine = _one_hot_dispatch(e, pos, keep, gates, X, C,
                                                 cfg.gate_dtype)
@@ -322,7 +337,7 @@ def moe_ffn(x, p, cfg: MoEConfig, dispatch: Optional[str] = None,
         out = (gath * w).sum(axis=1)
     elif mode == "gmm":
         out = _gmm_expert_ffn(tok, p, cfg, e, gates)
-        keep = jnp.ones_like(keep)                             # dropless
+        # keep is already all-ones (need_positions=False): dropless
     else:
         raise ValueError(f"unknown dispatch mode {mode!r} "
                          "(expected 'einsum', 'scatter' or 'gmm')")

@@ -178,9 +178,11 @@ class ShardedTrainState:
         return (jax.tree_util.tree_structure(batch),
                 tuple(jnp.ndim(x) for x in jax.tree_util.tree_leaves(batch)))
 
-    def step(self, params, opt_state, batch):
-        """Jitted train step; specializes (and caches) per batch pytree
-        structure so any batch dict the model's loss_fn accepts works."""
+    def jitted_step(self, batch):
+        """The jitted train step specialized to this batch's pytree
+        structure, built lazily and cached — step() calls it; the Graph
+        Doctor (`paddle_tpu.analysis`, tools/graphlint.py) lints it
+        directly so diagnostics cover the exact artifact that runs."""
         key = self._batch_key(batch)
         jitted = self._step_cache.get(key)
         if jitted is None:
@@ -191,7 +193,12 @@ class ShardedTrainState:
                 out_shardings=(self.param_shardings, self.opt_shardings,
                                None),
                 donate_argnums=(0, 1) if self._donate else ())
-        return jitted(params, opt_state, batch)
+        return jitted
+
+    def step(self, params, opt_state, batch):
+        """Jitted train step; specializes (and caches) per batch pytree
+        structure so any batch dict the model's loss_fn accepts works."""
+        return self.jitted_step(batch)(params, opt_state, batch)
 
     def eval_step(self, params, batch):
         key = self._batch_key(batch)

@@ -209,14 +209,33 @@ class LLMEngine:
         self._thread = threading.Thread(target=self._loop, daemon=True)
         self._thread.start()
 
-    def shutdown(self):
+    def shutdown(self, timeout: float = 10.0):
         with self._cv:
             self._stop = True
             self._cv.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=10)
+            # a mid-step thread owns the cache: releasing slots/pages under
+            # it would hand the same pages to two sequences.  Re-join once
+            # (a long decode step can outlive the first timeout), then
+            # REFUSE to touch slot/page state while it is still alive.
+            self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                err = RuntimeError("engine shut down (step thread wedged)")
+                with self._cv:
+                    for req in list(self._pending):
+                        req.error = err
+                        req._event.set()
+                    self._pending.clear()
+                raise RuntimeError(
+                    f"engine step thread still running after "
+                    f"{2 * timeout:.0f}s; queued requests were failed but "
+                    "slots/pages were NOT released (the thread owns them) — "
+                    "retry shutdown() once it finishes its step")
             self._thread = None
-        # fail anything still queued/in flight so waiters unblock
+        # thread is gone (or never ran): fail anything still queued or in
+        # flight so waiters unblock, and reclaim the slots
         err = RuntimeError("engine shut down")
         for req in list(self._pending):
             req.error = err
@@ -244,6 +263,33 @@ class LLMEngine:
                         st.req.error = e
                         st.req._event.set()
                         self.cache.release_slot(slot)
+                    # _decode donates the pools too: recover them so the
+                    # engine can admit new work after a failed step
+                    self._recover_pools(e)
+
+    def _recover_pools(self, cause: BaseException) -> bool:
+        """If a failed donated dispatch consumed the k/v pools, re-zero
+        them and fail every in-flight slot (their cached KV is gone).
+        Returns True when recovery ran.  No-op while the buffers are
+        alive (CPU, or a failure before dispatch)."""
+        cache = self.cache
+        try:
+            dead = any(getattr(a, "is_deleted", lambda: False)()
+                       for a in (cache.pools["k"], cache.pools["v"]))
+        except Exception:  # noqa: BLE001 — treat unknown state as dead
+            dead = True
+        if not dead:
+            return False
+        err = RuntimeError(f"KV pools lost to a failed donated dispatch "
+                           f"({cause!r:.120}); slot state was reset")
+        for slot in list(self._slots):
+            st = self._slots.pop(slot)
+            st.req.error = err
+            st.req._event.set()
+            cache.release_slot(slot)
+        cache.pools = generation.init_paged_kv_pools(
+            self.config, cache.num_pages, cache.page_size)
+        return True
 
     # -- internals ----------------------------------------------------------
 
@@ -269,19 +315,39 @@ class LLMEngine:
                     break  # head-of-line waits for pages (no reordering)
                 self._pending.popleft()
             slot = cache.acquire_slot()
-            cache.ensure_capacity(slot, total)  # reserve at admission
-            S = req.prompt.size
-            # clamp the bucket to the rope table (non-power-of-2
-            # max_position_embeddings would otherwise over-slice it)
-            Sb = min(_bucket(S), self.config.max_position_embeddings)
-            ids = np.zeros((1, Sb), np.int32)
-            ids[0, :S] = req.prompt
-            last, k_pool, v_pool = self._prefill(
-                self.params, jnp.asarray(ids), cache.pools["k"],
-                cache.pools["v"], cache.page_table[slot][None],
-                jnp.int32(S))
-            cache.pools = {"k": k_pool, "v": v_pool}
-            tok = int(np.asarray(self._sample(last))[0])
+            try:
+                cache.ensure_capacity(slot, total)  # reserve at admission
+                S = req.prompt.size
+                # clamp the bucket to the rope table (non-power-of-2
+                # max_position_embeddings would otherwise over-slice it)
+                Sb = min(_bucket(S), self.config.max_position_embeddings)
+                ids = np.zeros((1, Sb), np.int32)
+                ids[0, :S] = req.prompt
+                last, k_pool, v_pool = self._prefill(
+                    self.params, jnp.asarray(ids), cache.pools["k"],
+                    cache.pools["v"], cache.page_table[slot][None],
+                    jnp.int32(S))
+                cache.pools = {"k": k_pool, "v": v_pool}
+                tok = int(np.asarray(self._sample(last))[0])
+            except Exception as e:  # noqa: BLE001 — admission must not leak
+                # the request left _pending but never reached _slots: without
+                # cleanup the slot and its reserved pages leak forever and
+                # result() blocks until timeout.  Release both, resolve the
+                # handle with the error, and keep admitting — a per-request
+                # failure (e.g. a prefill OOM at this bucket size) must not
+                # wedge the engine.
+                self._slots.pop(slot, None)
+                if slot in cache._slot_pages:
+                    cache.release_slot(slot)
+                req.error = e
+                req._event.set()
+                # _prefill DONATES the pools: a dispatch that fails after
+                # donation has already consumed them (TPU; CPU ignores
+                # donation), and every later prefill/decode would die on
+                # deleted buffers.  Re-zero the pools and fail the slots
+                # whose KV lived in them.
+                self._recover_pools(e)
+                continue
             req.tokens.append(tok)
             self.stats["admitted"] += 1
             if (req.eos_id is not None and tok == req.eos_id) \

@@ -85,7 +85,11 @@ def _block_with_cache(c, x, lp, cos, sin, ck, cv, pos, ffn_fn=None,
         return x + out.astype(x.dtype), ck, cv
     gate = h @ lp["w_gate"]
     up = h @ lp["w_up"]
-    return x + ((jax.nn.silu(gate) * up) @ lp["w_down"]).astype(x.dtype), ck, cv
+    # silu in fp32, matching the train path (llama._block): bf16 decode
+    # must not drift from bf16 training numerics
+    mlp = (jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) \
+        @ lp["w_down"]
+    return x + mlp.astype(x.dtype), ck, cv
 
 
 def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None,
@@ -352,7 +356,9 @@ def _block_paged(c, x, lp, cos, sin, kp, vp, page_table, ctx, ffn_fn=None):
         return x + out.astype(x.dtype), kp, vp
     gate = h @ lp["w_gate"]
     up = h @ lp["w_up"]
-    return x + ((jax.nn.silu(gate) * up) @ lp["w_down"]).astype(x.dtype), kp, vp
+    mlp = (jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) \
+        @ lp["w_down"]
+    return x + mlp.astype(x.dtype), kp, vp
 
 
 def forward_paged_decode(params, tok, config, pools, page_table, ctx,

@@ -24,7 +24,7 @@ from .timer import Timer  # noqa: F401
 _global_timer = Timer()
 
 from . import utils  # noqa: E402,F401
-from .utils import RecordEvent, benchmark  # noqa: E402,F401
+from .utils import RecordEvent, benchmark, static_cost  # noqa: E402,F401
 
 
 class ProfilerState(enum.Enum):

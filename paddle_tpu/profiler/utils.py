@@ -110,5 +110,17 @@ def benchmark():
     return _global_timer
 
 
+def static_cost(fn, *args, top_k: int = 5, **kwargs):
+    """Static FLOPs/bytes roll-up of `fn(*args)` from its jaxpr — the
+    Graph Doctor's cost pass (analysis/cost.py) surfaced through the
+    profiler: {"total_flops", "total_bytes", "top": [heaviest eqns]}.
+    Nothing executes; scan trip counts are multiplied in.  Pairs with the
+    runtime summary() table: this is the *per-compile* view, that one the
+    *per-run* view."""
+    from ..analysis import cost as cost_lib
+
+    return cost_lib.estimate(fn, *args, top_k=top_k, **kwargs)
+
+
 def wrap_optimizers():  # pragma: no cover — reference hooks optimizer classes
     return None

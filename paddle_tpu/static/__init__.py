@@ -162,6 +162,56 @@ class Program:
         from .passes import apply_pass as _apply
         return _apply(self, names, fetch_list=fetch_list)
 
+    def _check_fetchable(self, fetch_targets):
+        """A fetch of a pass-removed tensor must error, not return the
+        stale sample value (shared by Executor.run and lint)."""
+        removed = getattr(self, "_removed_outputs", ())
+        for f in fetch_targets:
+            if id(f) in removed:
+                raise KeyError(
+                    f"fetch target {getattr(f, 'name', f)!r} was removed by "
+                    "a graph pass (re-run apply_pass with it in fetch_list)")
+
+    def _replay_fn(self, fetch_targets):
+        """(pure, externals) where pure(feed_raws, ext_raws) replays the op
+        list and returns the fetched raws — the ONE closure Executor.run
+        jits and Program.lint analyzes (sharing it keeps run and lint on
+        the same graph)."""
+        self._check_fetchable(fetch_targets)
+        ext = self._externals()
+        fetch_ids = [id(f) for f in fetch_targets]
+        fetch_consts = [f._data for f in fetch_targets]
+
+        def pure(feed_raws, ext_raws):
+            env = self._replay(feed_raws, ext_raws, ext)
+            return [env[i] if i in env else c
+                    for i, c in zip(fetch_ids, fetch_consts)]
+
+        return pure, ext
+
+    def lint(self, feed=None, fetch_list=None, **analyze_kwargs):
+        """Run the Graph Doctor (paddle_tpu.analysis) over this program's
+        replay function — the jaxpr-level *analysis* counterpart of
+        apply_pass's record-level *rewrite* passes.  `feed` defaults to
+        each placeholder's recorded sample value (shapes are what matter —
+        nothing executes); `fetch_list` defaults to the last op's outputs,
+        like the passes' target rule.  Extra kwargs (checkers=, suppress=,
+        options=, ...) pass through to analysis.analyze; returns a Report.
+        """
+        from .. import analysis
+
+        feed = dict(feed or {})
+        for name, ph in self.placeholders.items():
+            feed.setdefault(name, ph)
+        feed_raws = {k: (v._data if isinstance(v, Tensor) else jnp.asarray(v))
+                     for k, v in feed.items()}
+        targets = [Executor._resolve(self, f) for f in (fetch_list or [])]
+        if not targets and self.ops:
+            targets = list(self.ops[-1].outs)
+        pure, ext = self._replay_fn(targets)
+        return analysis.analyze(pure, feed_raws, [t._data for t in ext],
+                                **analyze_kwargs)
+
 
 _default_main = Program()
 _default_startup = Program()
@@ -269,12 +319,7 @@ class Executor:
         if not isinstance(fetch_list, (list, tuple)):
             fetch_list = [fetch_list]
         fetch_list = [self._resolve(program, f) for f in fetch_list]
-        removed = getattr(program, "_removed_outputs", ())
-        for f in fetch_list:
-            if id(f) in removed:
-                raise KeyError(
-                    f"fetch target {getattr(f, 'name', f)!r} was removed by "
-                    "a graph pass (re-run apply_pass with it in fetch_list)")
+        program._check_fetchable(fetch_list)
         # startup/empty programs: nothing to do (params init eagerly)
         if not program.ops and not fetch_list:
             return []
@@ -289,19 +334,13 @@ class Executor:
             return self._run_train(program, feed_raws, fetch_list, sig,
                                    return_numpy)
 
-        ext = program._externals()
         compiled = program._cache.get(sig)
         if compiled is None:
-            fetch_ids = [id(f) for f in fetch_list]
-            fetch_consts = [f._data for f in fetch_list]
-
-            def pure(feed_raws, ext_raws):
-                env = program._replay(feed_raws, ext_raws, ext)
-                return [env[i] if i in env else c
-                        for i, c in zip(fetch_ids, fetch_consts)]
-
+            pure, ext = program._replay_fn(fetch_list)
             compiled = jax.jit(pure)
             program._cache[sig] = compiled
+        else:
+            ext = program._externals()
         outs = compiled(feed_raws, [t._data for t in ext])
         if return_numpy:
             return [np.asarray(o) for o in outs]

@@ -21,6 +21,12 @@ Passes are registered by name (`register_pass`) and applied with
 `apply_pass(program, names, fetch_list=...)` or
 `Program.apply_pass(...)`; they return a TRANSFORMED CLONE (the input
 program is untouched), mirroring the reference's pass immutability.
+
+The ANALYSIS half of the reference pipeline (diagnose, don't rewrite)
+lives in `paddle_tpu.analysis` (the Graph Doctor) over jaxprs — same
+registry shape (`register_checker`/`list_checkers`/`analyze`), structured
+`Finding`s instead of transforms; `Program.lint()` runs those checkers
+over a recorded program's replay function.
 """
 
 from __future__ import annotations

@@ -1,0 +1,550 @@
+"""Graph Doctor (paddle_tpu.analysis) tests.
+
+Every shipped checker trips on a seeded-bad snippet with its expected
+Finding code, suppression/registry mechanics behave, and — the acceptance
+bar — the shipped bench models (llama, moe_llama gmm + scatter,
+generate_paged, the LLMEngine decode step) lint clean at WARNING level via
+the same target builders tools/graphlint.py uses.
+"""
+
+import functools
+import importlib.util
+import os
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu  # noqa: F401 — x64 on, same dtype world as the library
+from paddle_tpu import analysis
+from paddle_tpu.analysis import Finding, Severity
+
+# thresholds scaled down so KB-sized test tensors trip the checkers
+OPTS = {
+    "donation_min_bytes": 1 << 10,
+    "sharding_min_bytes": 1 << 10,
+    "const_capture_min_bytes": 1 << 10,
+    "const_subgraph_min_bytes": 64,
+    "dead_code_min_flops": 1e4,
+    "dead_code_min_bytes": 1 << 12,
+}
+
+
+def warnings_of(report, code):
+    return [f for f in report.by_code(code)
+            if f.severity >= Severity.WARNING]
+
+
+# ---------------------------------------------------------------------------
+# seeded-bad snippets: one per checker, each with its expected code
+# ---------------------------------------------------------------------------
+
+
+class TestDtypePromotion:
+    def test_f64_upcast_flagged(self):
+        def bad(x):
+            return (x * np.float64(2.0)).sum()
+
+        r = analysis.analyze(bad, jnp.ones((8, 8), jnp.float32),
+                             options=OPTS)
+        assert warnings_of(r, "DTYPE_F64_PROMOTION")
+
+    def test_explicit_astype_flagged(self):
+        def bad(x):
+            return x.astype(jnp.float64).sum()
+
+        r = analysis.analyze(bad, jnp.ones((8, 8), jnp.float32),
+                             options=OPTS)
+        assert warnings_of(r, "DTYPE_F64_PROMOTION")
+
+    def test_f64_input_is_info_not_warning(self):
+        def fine(x):
+            return x.sum()
+
+        r = analysis.analyze(fine, jnp.ones((4,), jnp.float64),
+                             options=OPTS)
+        assert r.by_code("DTYPE_F64_INPUT")
+        assert not warnings_of(r, "DTYPE_*")
+
+    def test_f32_model_clean(self):
+        def fine(x):
+            return jax.nn.softmax(x.astype(jnp.float32) * 2.0).sum()
+
+        r = analysis.analyze(fine, jnp.ones((8, 8), jnp.bfloat16),
+                             options=OPTS)
+        assert not r.by_code("DTYPE_*")
+
+
+class TestDonation:
+    def _params(self):
+        return {"w": jnp.ones((64, 64), jnp.float32)}
+
+    def test_undonated_update_step_flagged(self):
+        @jax.jit
+        def step(p, g):
+            return jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+        r = analysis.analyze(step, self._params(), self._params(),
+                             options=OPTS)
+        hits = warnings_of(r, "DONATION_MISSING")
+        assert hits and "args[0]" in hits[0].message
+
+    def test_donated_step_clean(self):
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def step(p, g):
+            return jax.tree.map(lambda a, b: a - 0.1 * b, p, g)
+
+        r = analysis.analyze(step, self._params(), self._params(),
+                             options=OPTS)
+        assert not r.by_code("DONATION_MISSING")
+
+    def test_small_args_not_flagged(self):
+        @jax.jit
+        def step(p):
+            return p + 1.0
+
+        r = analysis.analyze(step, jnp.ones((4,), jnp.float32),
+                             options=OPTS)
+        assert not r.by_code("DONATION_MISSING")
+
+
+class TestSharding:
+    def setup_method(self, _m):
+        from jax.sharding import Mesh
+        self.mesh = Mesh(np.array(jax.devices()[:2]), ("data",))
+
+    def _sharded_input(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(
+            jnp.ones((8, 64), jnp.float32),
+            NamedSharding(self.mesh, P("data", None)))
+
+    def test_replicated_big_intermediate_flagged(self):
+        @jax.jit
+        def bad(x):
+            big = jnp.zeros((64, 64), jnp.float32)
+            return x.sum() + (big @ big.T).sum()
+
+        r = analysis.analyze(bad, self._sharded_input(), mesh=self.mesh,
+                             options=OPTS)
+        assert warnings_of(r, "SHARD_REPLICATED")
+
+    def test_constrained_intermediate_clean(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        @jax.jit
+        def good(x):
+            big = jax.lax.with_sharding_constraint(
+                jnp.zeros((64, 64), jnp.float32),
+                NamedSharding(mesh, P("data", None)))
+            return x.sum() + (big @ big.T).sum()
+
+        r = analysis.analyze(good, self._sharded_input(), mesh=mesh,
+                             options=OPTS)
+        assert not r.by_code("SHARD_REPLICATED")
+
+    def test_replicating_constraint_is_gap(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = self.mesh
+
+        @jax.jit
+        def gap(x):
+            y = jax.lax.with_sharding_constraint(
+                x * 2.0, NamedSharding(mesh, P(None, None)))
+            return y.sum()
+
+        r = analysis.analyze(gap, self._sharded_input(), mesh=mesh,
+                             options=OPTS)
+        assert warnings_of(r, "SHARD_GAP")
+
+    def test_inert_without_mesh(self):
+        @jax.jit
+        def bad(x):
+            return jnp.zeros((64, 64), jnp.float32).sum() + x.sum()
+
+        r = analysis.analyze(bad, jnp.ones((8,)), options=OPTS)
+        assert not r.by_code("SHARD_*")
+
+
+class TestRecompileHazard:
+    def test_const_capture_flagged(self):
+        big = jnp.ones((64, 64), jnp.float32)  # 16 KiB > 1 KiB threshold
+
+        def f(x):
+            return x + big.sum()
+
+        r = analysis.analyze(f, jnp.ones((4,), jnp.float32), options=OPTS)
+        assert warnings_of(r, "RECOMPILE_CONST_CAPTURE")
+
+    def test_shape_poly_probe_flagged(self):
+        def f(x):
+            return x.sum()
+
+        r = analysis.analyze(
+            f, jnp.ones((8,), jnp.float32), options=OPTS,
+            probe_args=[(jnp.ones((16,), jnp.float32),),
+                        (jnp.ones((32,), jnp.float32),)])
+        assert warnings_of(r, "RECOMPILE_SHAPE_POLY")
+
+    def test_same_signature_probe_clean(self):
+        def f(x):
+            return x.sum()
+
+        r = analysis.analyze(f, jnp.ones((8,), jnp.float32), options=OPTS,
+                             probe_args=[(jnp.ones((8,), jnp.float32),)])
+        assert not r.by_code("RECOMPILE_SHAPE_POLY")
+
+    def test_mutable_closure_noted(self):
+        cfg = {"scale": 2.0}
+
+        def f(x):
+            return x * cfg["scale"]
+
+        r = analysis.analyze(f, jnp.ones((4,), jnp.float32), options=OPTS)
+        assert r.by_code("RECOMPILE_MUTABLE_CLOSURE")
+
+
+class TestCost:
+    def test_summary_and_hotspots(self):
+        def f(a, b):
+            return jnp.tanh(a @ b).sum()
+
+        a = jnp.ones((32, 16), jnp.float32)
+        b = jnp.ones((16, 8), jnp.float32)
+        r = analysis.analyze(f, a, b, options=OPTS)
+        assert r.by_code("COST_SUMMARY")
+        hot = r.by_code("COST_HOTSPOT")
+        assert hot and "dot_general" in hot[0].message
+
+    def test_dot_flops_exact(self):
+        from paddle_tpu.analysis import cost as cost_lib
+
+        est = cost_lib.estimate(lambda a, b: a @ b,
+                                jnp.ones((32, 16)), jnp.ones((16, 8)))
+        assert est["top"][0]["flops"] == 2.0 * 32 * 16 * 8
+
+    def test_scan_multiplies_trip_count(self):
+        from paddle_tpu.analysis import cost as cost_lib
+
+        def f(x):
+            def body(c, _):
+                return c @ c, None
+            c, _ = jax.lax.scan(body, x, None, length=7)
+            return c
+
+        est = cost_lib.estimate(f, jnp.ones((8, 8)))
+        assert est["total_flops"] == 7 * 2.0 * 8 * 8 * 8
+
+    def test_profiler_static_cost(self):
+        from paddle_tpu import profiler
+
+        est = profiler.static_cost(lambda a: (a @ a).sum(),
+                                   jnp.ones((16, 16)))
+        assert est["total_flops"] > 0 and est["top"]
+
+
+class TestDeadConst:
+    def test_dead_heavy_output_flagged(self):
+        def bad(x, w):
+            dead = x @ w          # ~2*64^3 flops, never used
+            return x.sum()
+
+        r = analysis.analyze(bad, jnp.ones((64, 64), jnp.float32),
+                             jnp.ones((64, 64), jnp.float32), options=OPTS)
+        assert warnings_of(r, "DEAD_CODE")
+
+    def test_dead_cheap_op_is_info(self):
+        def meh(x):
+            _unused = x[0] + 1.0
+            return x.sum()
+
+        r = analysis.analyze(meh, jnp.ones((8,), jnp.float32),
+                             options=OPTS)
+        dead = r.by_code("DEAD_CODE")
+        assert dead and all(f.severity == Severity.INFO for f in dead)
+
+    def test_const_subgraph_flagged(self):
+        c1 = jnp.ones((8, 8), jnp.float32)
+        c2 = jnp.ones((8, 8), jnp.float32)
+
+        def f(x):
+            return x.sum() + (c1 @ c2).sum()
+
+        r = analysis.analyze(f, jnp.ones((4,), jnp.float32), options=OPTS)
+        assert r.by_code("CONST_SUBGRAPH")
+
+    def test_live_graph_clean(self):
+        def f(x, w):
+            return (x @ w).sum()
+
+        r = analysis.analyze(f, jnp.ones((16, 16), jnp.float32),
+                             jnp.ones((16, 16), jnp.float32), options=OPTS)
+        assert not r.by_code("DEAD_CODE")
+        assert not r.by_code("CONST_SUBGRAPH")
+
+
+# ---------------------------------------------------------------------------
+# framework mechanics: registry, suppressions, report
+# ---------------------------------------------------------------------------
+
+
+class TestFramework:
+    def test_shipped_checkers_registered(self):
+        have = set(analysis.list_checkers())
+        assert {"dtype_promotion", "donation", "sharding",
+                "recompile_hazard", "cost", "dead_code"} <= have
+
+    def test_unknown_checker_raises(self):
+        with pytest.raises(ValueError, match="unknown checker"):
+            analysis.analyze(lambda x: x, jnp.ones(3), checkers=["nope"])
+
+    def test_custom_checker_registers_and_runs(self):
+        name = "test_always_fires"
+
+        @analysis.register_checker(name)
+        def chk(ctx):
+            yield Finding(Severity.ERROR, "TEST_FIRE", "<top>", "boom")
+
+        try:
+            r = analysis.analyze(lambda x: x + 1, jnp.ones(3),
+                                 checkers=[name])
+            assert r.by_code("TEST_FIRE") and not r.ok(Severity.ERROR)
+        finally:
+            del analysis.core.CHECKER_REGISTRY[name]
+
+    def test_per_call_suppression(self):
+        def bad(x):
+            return (x * np.float64(2.0)).sum()
+
+        x = jnp.ones((8, 8), jnp.float32)
+        r = analysis.analyze(bad, x, options=OPTS,
+                             suppress=["DTYPE_F64_PROMOTION"])
+        assert not r.by_code("DTYPE_F64_PROMOTION") and r.suppressed >= 1
+        r = analysis.analyze(bad, x, options=OPTS, suppress=["DTYPE_*"])
+        assert not r.by_code("DTYPE_*")
+
+    def test_path_scoped_suppression(self):
+        def bad(x):
+            return (x * np.float64(2.0)).sum()
+
+        x = jnp.ones((8, 8), jnp.float32)
+        r = analysis.analyze(bad, x, options=OPTS,
+                             suppress=["DTYPE_F64_PROMOTION@nomatch/*"])
+        assert r.by_code("DTYPE_F64_PROMOTION")  # wrong path: still fires
+        r = analysis.analyze(bad, x, options=OPTS,
+                             suppress=["DTYPE_F64_PROMOTION@*"])
+        assert not r.by_code("DTYPE_F64_PROMOTION")
+
+    def test_process_wide_suppression_context(self):
+        def bad(x):
+            return (x * np.float64(2.0)).sum()
+
+        x = jnp.ones((8, 8), jnp.float32)
+        with analysis.suppressions("DTYPE_*"):
+            assert not analysis.analyze(bad, x, options=OPTS).by_code(
+                "DTYPE_*")
+        assert analysis.analyze(bad, x, options=OPTS).by_code("DTYPE_*")
+
+    def test_report_json_and_ok(self):
+        def bad(x):
+            return (x * np.float64(2.0)).sum()
+
+        r = analysis.analyze(bad, jnp.ones((8, 8), jnp.float32),
+                             options=OPTS)
+        j = r.to_json()
+        assert j["counts"]["warning"] >= 1
+        assert any(f["code"] == "DTYPE_F64_PROMOTION" for f in j["findings"])
+        assert not r.ok(Severity.WARNING) and r.ok(Severity.ERROR)
+
+    def test_analyze_jaxpr_entry(self):
+        closed = jax.make_jaxpr(lambda x: x.astype(jnp.float64).sum())(
+            jnp.ones((8, 8), jnp.float32))
+        r = analysis.analyze_jaxpr(closed, options=OPTS)
+        assert r.by_code("DTYPE_F64_PROMOTION")
+
+    def test_shape_dtype_struct_args(self):
+        # lint without materializing params: tracing needs shapes only
+        r = analysis.analyze(
+            lambda p, g: jax.tree.map(lambda a, b: a - b, p, g),
+            jax.ShapeDtypeStruct((64, 64), jnp.float32),
+            jax.ShapeDtypeStruct((64, 64), jnp.float32), options=OPTS)
+        assert isinstance(r, analysis.Report)
+
+
+# ---------------------------------------------------------------------------
+# static.Program bridge
+# ---------------------------------------------------------------------------
+
+
+class TestProgramLint:
+    def test_program_lint_runs(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", (4, 8), "float32")
+            y = paddle.matmul(x, paddle.ones((8, 8), "float32"))
+            z = paddle.nn.functional.relu(y)
+        r = main.lint(fetch_list=[z])
+        assert isinstance(r, analysis.Report)
+        assert not warnings_of(r, "DEAD_CODE")
+
+    def test_program_lint_rejects_pass_removed_fetch(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+
+        main, startup = static.Program(), static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", (4, 8), "float32")
+            dead = paddle.nn.functional.relu(x)   # not in fetch_list
+            z = paddle.matmul(x, paddle.ones((8, 8), "float32"))
+        pruned = main.apply_pass("dead_code_elimination", fetch_list=[z])
+        with pytest.raises(KeyError, match="removed by"):
+            pruned.lint(fetch_list=[dead])
+
+
+# ---------------------------------------------------------------------------
+# LLMEngine satellites: admission leak + shutdown join race
+# ---------------------------------------------------------------------------
+
+
+class TestEngineHardening:
+    def _engine(self):
+        from paddle_tpu.inference import LLMEngine
+        from paddle_tpu.models import llama
+
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+        return LLMEngine(params, cfg, num_slots=2, page_size=4,
+                         max_seq_len=16)
+
+    def test_admission_failure_releases_slot_and_pages(self):
+        eng = self._engine()
+        free_slots0 = eng.cache.free_slot_count
+        free_pages0 = eng.cache.free_page_count
+
+        def boom(*a, **k):
+            raise RuntimeError("prefill exploded")
+
+        eng._prefill = boom
+        req = eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.step()
+        with pytest.raises(RuntimeError, match="prefill exploded"):
+            req.result(timeout=5)
+        assert eng.cache.free_slot_count == free_slots0
+        assert eng.cache.free_page_count == free_pages0
+        assert not eng._slots and not eng._pending
+
+    def test_admission_failure_does_not_wedge_later_requests(self):
+        eng = self._engine()
+        real_prefill = eng._prefill
+        calls = {"n": 0}
+
+        def flaky(*a, **k):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("transient")
+            return real_prefill(*a, **k)
+
+        eng._prefill = flaky
+        bad = eng.submit([1, 2, 3], max_new_tokens=2)
+        good = eng.submit([4, 5], max_new_tokens=2)
+        while eng.has_work():
+            if not eng.step():
+                break
+        with pytest.raises(RuntimeError, match="transient"):
+            bad.result(timeout=5)
+        assert len(good.result(timeout=5)) == 2
+
+    def test_failed_donated_dispatch_recovers_pools(self):
+        # on TPU a _prefill/_decode that fails AFTER dispatch has already
+        # consumed the donated pools; simulate by deleting them (CPU
+        # ignores donation, so the buffers stay alive in normal runs)
+        eng = self._engine()
+        free_pages0 = eng.cache.free_page_count
+        slot = eng.cache.acquire_slot()
+        eng.cache.ensure_capacity(slot, 8)
+        victim = _mk_request()
+        eng._slots[slot] = type(
+            "S", (), {"req": victim, "last_tok": 0, "ctx": 4})()
+        eng.cache.pools["k"].delete()
+        eng.cache.pools["v"].delete()
+        assert eng._recover_pools(RuntimeError("boom"))
+        assert not eng.cache.pools["k"].is_deleted()
+        with pytest.raises(RuntimeError, match="KV pools lost"):
+            victim.result(timeout=5)
+        assert not eng._slots
+        assert eng.cache.free_page_count == free_pages0
+        # fresh pools admit new work end-to-end
+        out = eng.generate([[1, 2]], max_new_tokens=2, timeout=60)
+        assert len(out[0]) == 2
+
+    def test_recover_pools_noop_while_alive(self):
+        eng = self._engine()
+        assert not eng._recover_pools(RuntimeError("x"))
+
+    def test_shutdown_refuses_release_while_thread_alive(self):
+        eng = self._engine()
+        release = threading.Event()
+        t = threading.Thread(target=release.wait, daemon=True)
+        t.start()
+        eng._thread = t  # stand-in for a wedged step thread
+        eng._pending.append(_mk_request())
+        slots_before = dict(eng._slots)
+        with pytest.raises(RuntimeError, match="NOT released"):
+            eng.shutdown(timeout=0.05)
+        assert eng._slots == slots_before   # untouched while thread lives
+        assert not eng._pending             # but waiters were unblocked
+        release.set()
+        t.join(timeout=5)
+        eng.shutdown(timeout=1)             # retry completes cleanly
+        assert eng._thread is None
+
+    def test_clean_shutdown_still_works(self):
+        eng = self._engine()
+        eng.start()
+        out = eng.generate([[1, 2]], max_new_tokens=2, timeout=60)
+        assert len(out[0]) == 2
+        eng.shutdown()
+        assert eng._thread is None
+
+
+def _mk_request():
+    from paddle_tpu.inference import llm_engine
+    return llm_engine._Request([1], 1, None)
+
+
+# ---------------------------------------------------------------------------
+# the acceptance bar: shipped bench models lint clean (same targets the
+# tools/graphlint.py CLI runs; SHIPPED_SUPPRESSIONS documents exceptions)
+# ---------------------------------------------------------------------------
+
+
+def _load_graphlint():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "graphlint.py")
+    spec = importlib.util.spec_from_file_location("graphlint", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_graphlint = _load_graphlint()
+
+
+@pytest.mark.parametrize("target", sorted(_graphlint.TARGETS))
+def test_shipped_model_lints_clean(target):
+    fn, args, extra = _graphlint.TARGETS[target]()
+    report = analysis.analyze(
+        fn, *args, suppress=list(_graphlint.SHIPPED_SUPPRESSIONS),
+        mesh=extra.get("mesh"))
+    bad = [str(f) for f in report if f.severity >= Severity.WARNING]
+    assert report.ok(Severity.WARNING), \
+        f"{target} has undocumented findings:\n" + "\n".join(bad)

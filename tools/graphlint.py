@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""graphlint — run the Graph Doctor (paddle_tpu.analysis) over the shipped
+bench models end to end.
+
+Targets (default: all):
+  llama              ShardedTrainState train step, LlamaConfig.tiny
+  moe_llama_gmm      MoE train step, dropless Pallas grouped-matmul dispatch
+  moe_llama_scatter  MoE train step, capacity-based scatter dispatch
+  generate_paged     paged-KV single-shot generation (prefill + decode scan)
+  engine_decode      LLMEngine's jitted continuous-batching decode step
+  engine_prefill     LLMEngine's jitted admission prefill
+
+Usage:
+  python tools/graphlint.py [targets...] [--json] [--verbose]
+                            [--suppress CODE[@pathglob]]... [--fail-on LEVEL]
+
+Exit code is 0 when every target is clean at --fail-on (default: warning)
+after suppressions, 1 otherwise.  --json emits one machine-readable object
+(finding lists + counts per target) so BENCH rounds can track finding
+counts alongside perf numbers.
+
+Suppression syntax (same as analysis.analyze(suppress=...)):
+  DTYPE_F64_PROMOTION          exact code
+  DTYPE_*                      code glob
+  DEAD_CODE@*scan/body*        code scoped to eqn paths matching the glob
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _train_target(model_name, **cfg_overrides):
+    import dataclasses
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import llama, moe_llama
+    from paddle_tpu.distributed import mesh as mesh_lib
+    from paddle_tpu.distributed.parallelize import ShardedTrainState
+    from paddle_tpu.optimizer.functional import AdamW
+
+    model = {"llama": llama, "moe_llama": moe_llama}[model_name]
+    cfg = (llama.LlamaConfig.tiny() if model_name == "llama"
+           else moe_llama.MoELlamaConfig.tiny())
+    if cfg_overrides:
+        cfg = dataclasses.replace(cfg, **cfg_overrides)
+    mesh = mesh_lib.make_mesh(data=1)
+    st = ShardedTrainState(cfg, model, mesh,
+                           AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
+    params, opt_state = st.init(jax.random.PRNGKey(0))
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 17))
+    batch = st.shard_batch(model.lm_batch_from_tokens(
+        jnp.asarray(toks, jnp.int32)))
+    return st.jitted_step(batch), (params, opt_state, batch), {"mesh": mesh}
+
+
+def target_llama():
+    return _train_target("llama")
+
+
+def target_moe_llama_gmm():
+    return _train_target("moe_llama", moe_dispatch="gmm")
+
+
+def target_moe_llama_scatter():
+    return _train_target("moe_llama", moe_dispatch="scatter")
+
+
+def _tiny_llama():
+    import jax
+    from paddle_tpu.models import llama
+    cfg = llama.LlamaConfig.tiny()
+    return cfg, llama.init_params(cfg, jax.random.PRNGKey(0))
+
+
+def target_generate_paged():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import generation
+
+    cfg, params = _tiny_llama()
+    B, S, new, ps = 2, 8, 4, 4
+    total = S + new
+    pps = -(-total // ps)
+    cache = generation.PagedKVCache(cfg, num_pages=1 + B * pps, page_size=ps,
+                                    max_slots=B, pages_per_seq=pps)
+    for _ in range(B):
+        cache.ensure_capacity(cache.acquire_slot(), total)
+    fn = functools.partial(
+        generation._generate_paged_core, config=cfg, max_new_tokens=new,
+        temperature=0.0, top_k=0, top_p=1.0, eos_id=None)
+    ids = jnp.zeros((B, S), jnp.int32)
+    args = (params, ids, cache.pools["k"], cache.pools["v"],
+            cache.page_table, jax.random.PRNGKey(0))
+    return fn, args, {}
+
+
+def _engine():
+    from paddle_tpu.inference import LLMEngine
+    cfg, params = _tiny_llama()
+    return LLMEngine(params, cfg, num_slots=2, page_size=4, max_seq_len=16), \
+        params
+
+
+def target_engine_decode():
+    import jax.numpy as jnp
+    eng, params = _engine()
+    toks = jnp.zeros((2,), jnp.int32)
+    ctx = jnp.zeros((2,), jnp.int32)
+    args = (params, toks, ctx, eng.cache.page_table,
+            eng.cache.pools["k"], eng.cache.pools["v"])
+    return eng._decode, args, {}
+
+
+def target_engine_prefill():
+    import jax.numpy as jnp
+    eng, params = _engine()
+    # probe the power-of-two prompt buckets the engine compiles: distinct
+    # bucket widths are EXPECTED recompiles — assert there are exactly the
+    # bucketed signatures, nothing shape-polymorphic beyond them
+    ids8 = jnp.zeros((1, 8), jnp.int32)
+    args = (params, ids8, eng.cache.pools["k"], eng.cache.pools["v"],
+            eng.cache.page_table[0][None], jnp.int32(5))
+    return eng._prefill, args, {}
+
+
+TARGETS = {
+    "llama": target_llama,
+    "moe_llama_gmm": target_moe_llama_gmm,
+    "moe_llama_scatter": target_moe_llama_scatter,
+    "generate_paged": target_generate_paged,
+    "engine_decode": target_engine_decode,
+    "engine_prefill": target_engine_prefill,
+}
+
+# documented suppressions for the shipped models (none today: dead
+# AD-partial-eval residue lints as INFO, below the warning gate).  Add
+# entries as "CODE@pathglob" with a comment justifying each.
+SHIPPED_SUPPRESSIONS: tuple = ()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="lint the shipped bench models with paddle_tpu.analysis")
+    ap.add_argument("targets", nargs="*", choices=[[], *TARGETS],
+                    default=[], help="targets (default: all)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object instead of text")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print INFO findings")
+    ap.add_argument("--suppress", action="append", default=[],
+                    metavar="CODE[@pathglob]",
+                    help="suppress a finding code (repeatable)")
+    ap.add_argument("--fail-on", default="warning",
+                    choices=["info", "warning", "error"],
+                    help="lowest severity that fails the lint")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu import analysis
+
+    fail_on = analysis.Severity[args.fail_on.upper()]
+    suppress = list(SHIPPED_SUPPRESSIONS) + list(args.suppress)
+    names = list(args.targets) or list(TARGETS)
+    out, all_ok = {}, True
+    for name in names:
+        fn, call_args, extra = TARGETS[name]()
+        report = analysis.analyze(fn, *call_args, suppress=suppress,
+                                  mesh=extra.get("mesh"))
+        ok = report.ok(fail_on)
+        all_ok &= ok
+        out[name] = dict(report.to_json(), ok=ok)
+        if not args.as_json:
+            shown = [f for f in report
+                     if args.verbose or f.severity >= analysis.Severity.WARNING]
+            print(f"== {name}: {'clean' if ok else 'FINDINGS'} "
+                  f"({report.counts()}, {report.suppressed} suppressed)")
+            for f in shown:
+                print(f"   {f}")
+    if args.as_json:
+        counts = {k: out[k]["counts"] for k in out}
+        print(json.dumps({"targets": out, "counts": counts, "ok": all_ok}))
+    elif all_ok:
+        print(f"graphlint: all {len(names)} target(s) clean at "
+              f">={args.fail_on}")
+    return 0 if all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
