@@ -375,7 +375,32 @@ def bench_decode(dev, on_tpu):
         "batch": B, "prompt": S, "new_tokens": new_tokens,
         "page_size": page_size,
         "model_params": llama.num_params(cfg),
+        "engine_lifecycle": _engine_lifecycle_counters(),
     }
+
+
+def _engine_lifecycle_counters():
+    """LLMEngine preemption/lifecycle counters on a deliberately
+    undersized page pool (2 slots whose worst case exceeds the pool, so
+    the admit-on-demand scheduler must preempt and resume) — surfaced
+    alongside the decode throughput headline to track the serving rung."""
+    import jax as _jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(1))
+    eng = LLMEngine(params, cfg, num_slots=2, page_size=4, max_seq_len=16,
+                    num_pages=5)   # below 2-slot worst case -> preemption
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, 8).tolist()
+               for _ in range(3)]
+    eng.generate(prompts, max_new_tokens=4)
+    snap = eng.stats_snapshot()
+    return {k: snap[k] for k in ("preemptions", "swapped_in", "resumed",
+                                 "cancelled", "timed_out", "queue_depth",
+                                 "completed")}
 
 
 def _run_graphlint(timeout: float = 900.0) -> dict:

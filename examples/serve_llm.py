@@ -28,10 +28,17 @@ from paddle_tpu.models.llama import LlamaConfig
 def main():
     cfg = LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
-    engine = LLMEngine(params, cfg, num_slots=2, page_size=8, max_seq_len=64)
+    # a bounded queue (QueueFull -> HTTP 503 + Retry-After) and a page pool
+    # sized for the EXPECTED footprint: under pressure the engine preempts
+    # a victim (swap to host / resume later) instead of refusing admission
+    engine = LLMEngine(params, cfg, num_slots=2, page_size=8, max_seq_len=64,
+                       max_pending=32, preempt_mode="swap")
     srv, _ = serve_llm(engine)
     url = f"http://127.0.0.1:{srv.server_address[1]}/"
     print("serving on", url)
+
+    with urllib.request.urlopen(url + "healthz", timeout=30) as resp:
+        print("healthz:", json.loads(resp.read()))
 
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, n).tolist() for n in (4, 6, 5)]
@@ -58,6 +65,15 @@ def main():
             max_new_tokens=8))[0].tolist()
         assert got == want, (got, want)
         print("served tokens:", got)
+
+    # request lifecycle: deadlines are enforced every engine step, and a
+    # cancelled/expired request frees its slot+pages immediately
+    doomed = engine.submit(prompts[0], max_new_tokens=40, deadline=600.0)
+    doomed.cancel()
+    try:
+        doomed.result(timeout=30)
+    except Exception as e:  # RequestCancelled
+        print("cancelled request resolved with:", type(e).__name__)
 
     stats = json.loads(urllib.request.urlopen(url + "stats",
                                               timeout=30).read())

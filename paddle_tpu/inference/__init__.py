@@ -23,15 +23,20 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "InferTensor",
-           "serve", "PlaceType", "LLMEngine", "serve_llm"]
+           "serve", "PlaceType", "LLMEngine", "serve_llm", "QueueFull",
+           "RequestCancelled", "DeadlineExceeded", "faults"]
 
 
 def __getattr__(name):
     # lazy: the LLM engine pulls in the model stack, which plain
     # Config/Predictor users never touch
-    if name in ("LLMEngine", "serve_llm"):
+    if name in ("LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
+                "DeadlineExceeded"):
         from . import llm_engine
         return getattr(llm_engine, name)
+    if name == "faults":
+        import importlib
+        return importlib.import_module(".faults", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 

@@ -1,0 +1,284 @@
+"""Fault injection + invariant checking for the preemptible LLMEngine.
+
+A preemptible engine is only trustworthy if every failure path — dispatch
+errors on donated pools, page-allocation OOM, deadlines, cancellation,
+shutdown — provably leaks nothing.  Happy-path tests cannot show that;
+this harness can:
+
+  * the engine calls ``fire(point, ...)`` at NAMED injection points
+    (`FAULT_POINTS`) wrapped around prefill dispatch, decode dispatch,
+    page allocation, sampling, and the swap-out/swap-in paths;
+  * a `FaultSchedule` is a list of deterministic `FaultRule`s — "fail the
+    3rd decode dispatch", "OOM every page allocation for slot 2", "fail
+    the 1st prefill AND consume the donated pools" (simulating a TPU
+    dispatch that dies after donation);
+  * `check_invariants` is asserted after every schedule: zero leaked
+    pages/slots, live (non-donated-away) pools, every submitted handle
+    resolved exactly once, and the engine still able to serve a fresh
+    request.
+
+`tests/test_engine_chaos.py` runs the shipped schedules plus seeded
+random ones (`random_schedule`); `tools/chaos_llm.py` is the soak CLI.
+"""
+
+from __future__ import annotations
+
+import collections
+import random
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["FAULT_POINTS", "InjectedFault", "InvariantViolation",
+           "FaultRule", "FaultInjector", "random_schedule", "drive",
+           "check_invariants", "run_schedule"]
+
+# the engine's named injection points, in rough lifecycle order
+FAULT_POINTS = ("prefill", "decode", "page_alloc", "sample",
+                "swap_out", "swap_in")
+
+# points where a `consume_pools` rule is meaningful: the engine passes its
+# (to-be-donated or read) pools in the fire() context there
+_DISPATCH_POINTS = ("prefill", "decode", "swap_out", "swap_in")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by the injector at a scheduled point.  A RuntimeError so the
+    page-allocation path treats an injected OOM exactly like a real
+    pool-exhausted condition."""
+
+
+class InvariantViolation(AssertionError):
+    """check_invariants found a leak or an unresolved/double-resolved
+    handle."""
+
+
+class FaultRule:
+    """One deterministic fault: fire at the `nth` matching visit of
+    `point` (1-based, counted per rule after the slot filter), or on
+    EVERY matching visit (`always=True`, e.g. "OOM every allocation for
+    slot 2").  `consume_pools=True` deletes the pool buffers before
+    raising — simulating a TPU dispatch that fails AFTER consuming its
+    donated arguments, which is the nastiest real-world failure the
+    engine must recover from."""
+
+    def __init__(self, point: str, nth: int = 1,
+                 slot: Optional[int] = None, always: bool = False,
+                 consume_pools: bool = False):
+        if point not in FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r}; "
+                             f"one of {FAULT_POINTS}")
+        self.point = point
+        self.nth = int(nth)
+        self.slot = slot
+        self.always = bool(always)
+        self.consume_pools = bool(consume_pools)
+        self.seen = 0     # matching visits
+        self.fired = 0
+
+    def matches(self, point: str, ctx: Dict) -> bool:
+        if point != self.point:
+            return False
+        if self.slot is not None and ctx.get("slot") != self.slot:
+            return False
+        self.seen += 1
+        if self.always:
+            return True
+        return self.fired == 0 and self.seen == self.nth
+
+    def __repr__(self):
+        bits = [self.point]
+        if self.always:
+            bits.append("always")
+        else:
+            bits.append(f"nth={self.nth}")
+        if self.slot is not None:
+            bits.append(f"slot={self.slot}")
+        if self.consume_pools:
+            bits.append("consume_pools")
+        return f"FaultRule({', '.join(bits)})"
+
+    def to_dict(self) -> dict:
+        return {"point": self.point, "nth": self.nth, "slot": self.slot,
+                "always": self.always, "consume_pools": self.consume_pools}
+
+
+class FaultInjector:
+    """Deterministic fault schedule.  Install via
+    ``LLMEngine(..., faults=FaultInjector(rules))`` (or set
+    ``engine.faults``); the engine calls `fire` at each injection point
+    and a matching rule raises `InjectedFault` there."""
+
+    def __init__(self, rules: Sequence[FaultRule] = ()):
+        self.rules = list(rules)
+        self.visits: collections.Counter = collections.Counter()
+        self.fired: List[dict] = []
+
+    def fire(self, point: str, engine=None, pools=None, **ctx) -> None:
+        self.visits[point] += 1
+        for rule in self.rules:
+            if not rule.matches(point, ctx):
+                continue
+            rule.fired += 1
+            self.fired.append({"point": point,
+                               "visit": self.visits[point],
+                               "rule": repr(rule),
+                               "slot": ctx.get("slot")})
+            if rule.consume_pools and pools is not None:
+                for arr in pools.values():
+                    try:
+                        arr.delete()   # simulate donation consuming it
+                    except Exception:  # noqa: BLE001 — already deleted etc.
+                        pass
+            raise InjectedFault(
+                f"injected fault at {point!r} "
+                f"(visit {self.visits[point]}, {rule!r})")
+
+
+def random_schedule(seed: int, max_rules: int = 2) -> List[FaultRule]:
+    """Deterministic pseudo-random schedule for soak runs: 1..max_rules
+    rules over random points/visits, with a slice of always-OOM-per-slot
+    and consume-donated-pools variants."""
+    rng = random.Random(seed)
+    rules = []
+    for _ in range(rng.randint(1, max_rules)):
+        point = rng.choice(FAULT_POINTS)
+        if point == "page_alloc" and rng.random() < 0.35:
+            rules.append(FaultRule(point, slot=rng.randrange(3),
+                                   always=True))
+            continue
+        consume = point in _DISPATCH_POINTS and rng.random() < 0.3
+        rules.append(FaultRule(point, nth=rng.randint(1, 8),
+                               consume_pools=consume))
+    return rules
+
+
+def drive(engine, handles: Sequence = (), max_steps: int = 5000) -> int:
+    """Step the engine until every handle resolves (bounded).  Returns the
+    number of steps taken; a stall (no progress with unresolved handles)
+    simply stops — check_invariants will report the unresolved handles."""
+    steps = 0
+    while any(not h.done() for h in handles) and steps < max_steps:
+        try:
+            progressed = engine.step()
+        except Exception:  # noqa: BLE001 — step() handles its own faults;
+            progressed = True          # a backstop escape still made work
+        steps += 1
+        if not progressed:
+            break
+    return steps
+
+
+def check_invariants(engine, handles: Sequence = (), probe: bool = True,
+                     raise_on_violation: bool = True,
+                     probe_timeout: float = 120.0) -> dict:
+    """Assert the engine leaked nothing.  Call once quiesced (all handles
+    resolved — see `drive`).  Checks:
+
+      * zero leaked slots: no in-flight slots, no pending requests, every
+        decode slot back in the free list;
+      * zero leaked pages: free pages + slot-held pages are EXACTLY pages
+        1..num_pages-1, each once (page 0 reserved, never allocated);
+      * pools live: the k/v buffers were not donated away and lost;
+      * every submitted handle resolved exactly once;
+      * the engine still serves: a fresh 1-token request completes.
+
+    Returns a report dict; raises InvariantViolation on any breach unless
+    raise_on_violation=False."""
+    cache = engine.cache
+    violations: List[str] = []
+
+    if engine._pending:
+        violations.append(f"{len(engine._pending)} requests still pending")
+    if engine._slots:
+        violations.append(f"slots still in flight: {sorted(engine._slots)}")
+    held = [p for pages in cache._slot_pages.values() for p in pages]
+    if cache._slot_pages:
+        violations.append(
+            f"slot page lists not reclaimed: {dict(cache._slot_pages)}")
+    pages = sorted(cache._free_pages + held)
+    if pages != list(range(1, cache.num_pages)):
+        violations.append(
+            f"page accounting broken: free+held={pages} != "
+            f"1..{cache.num_pages - 1} (leak or double-free)")
+    slots = sorted(cache._free_slots + list(cache._slot_pages))
+    if slots != list(range(cache.max_slots)):
+        violations.append(
+            f"slot accounting broken: free+held={slots} != "
+            f"0..{cache.max_slots - 1}")
+    for side in ("k", "v"):
+        arr = cache.pools[side]
+        if getattr(arr, "is_deleted", lambda: False)():
+            violations.append(f"{side} pool was donated away and never "
+                              "recovered")
+
+    for i, h in enumerate(handles):
+        if not h.done():
+            violations.append(f"handle {i} never resolved")
+        elif h.resolutions != 1:
+            violations.append(
+                f"handle {i} resolved {h.resolutions} times (want 1)")
+        elif h.error is None and not h.tokens:
+            violations.append(f"handle {i} resolved empty without error")
+
+    probe_tokens = None
+    if probe and not violations:
+        saved, engine.faults = engine.faults, None
+        try:
+            h = engine.submit([1], max_new_tokens=1)
+            if engine._thread is not None:
+                probe_tokens = h.result(timeout=probe_timeout)
+            else:
+                drive(engine, [h])
+                probe_tokens = h.result(timeout=0)
+            if len(probe_tokens) != 1:
+                violations.append(
+                    f"fresh probe returned {probe_tokens!r}, want 1 token")
+        except Exception as e:  # noqa: BLE001
+            violations.append(f"engine cannot serve a fresh request: {e!r}")
+        finally:
+            engine.faults = saved
+
+    report = {
+        "ok": not violations,
+        "violations": violations,
+        "free_pages": cache.free_page_count,
+        "free_slots": cache.free_slot_count,
+        "num_pages": cache.num_pages,
+        "probe_tokens": probe_tokens,
+        "stats": engine.stats_snapshot(),
+    }
+    if violations and raise_on_violation:
+        raise InvariantViolation("; ".join(violations))
+    return report
+
+
+def run_schedule(make_engine: Callable[[], object],
+                 rules: Sequence[FaultRule],
+                 requests: Sequence[Tuple[Sequence[int], int]],
+                 probe: bool = True, max_steps: int = 5000) -> dict:
+    """Build a fresh engine, install the schedule, submit the workload
+    ((prompt, max_new_tokens) pairs), drive to quiescence, and run the
+    invariant checker.  Returns the invariant report extended with the
+    schedule, the faults actually fired, and the final counters.  Raises
+    InvariantViolation on any leak."""
+    injector = FaultInjector(rules)
+    engine = make_engine()
+    engine.faults = injector
+    handles = []
+    rejected = 0
+    for prompt, max_new in requests:
+        try:
+            handles.append(engine.submit(prompt, max_new))
+        except (ValueError, RuntimeError):
+            rejected += 1      # QueueFull / validation — resolved by refusal
+    steps = drive(engine, handles, max_steps=max_steps)
+    report = check_invariants(engine, handles, probe=probe)
+    report.update({
+        "schedule": [r.to_dict() for r in rules],
+        "fired": list(injector.fired),
+        "requests": len(handles),
+        "rejected": rejected,
+        "completed": sum(1 for h in handles if h.error is None),
+        "failed": sum(1 for h in handles if h.error is not None),
+        "steps": steps,
+    })
+    return report

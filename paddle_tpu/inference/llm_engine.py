@@ -2,27 +2,48 @@
 
 The reference serves generation through a one-request-at-a-time predictor
 loop (PaddleNLP over analysis_predictor.h:94).  Production TPU serving
-(the Gemma-on-TPU study, arxiv 2605.25645) gets its throughput from
-*continuous batching*: a fixed-width decode batch whose rows (slots) are
-re-filled from a request queue the moment a sequence finishes, instead of
-waiting for the whole batch to drain.
+(the Gemma-on-TPU study, arxiv 2605.25645; Ragged Paged Attention, arxiv
+2604.15464) gets its throughput from *continuous batching* and its memory
+efficiency from *admitting on demand and preempting under pressure*
+instead of reserving worst-case pages up front.
 
 Engine anatomy:
   * `PagedKVCache` (models/generation.py) — page pools + page tables;
     each admitted request owns a decode slot and that slot's pages.
-  * admission — pending requests enter free slots mid-flight; the prompt
-    is prefilled through the dense flash path (bucketed to the next
-    power-of-two length, so a handful of compiled programs cover all
-    prompt lengths) and scattered into the slot's pages.
+  * admission — pending requests enter free slots mid-flight; only the
+    PROMPT's pages are reserved (admit-on-demand).  The prompt is
+    prefilled through the dense flash path (bucketed to the next
+    power-of-two length) and scattered into the slot's pages.
   * decode — ONE jitted step advances every active slot through the
     Pallas paged-attention kernel; empty slots point at the reserved
-    scratch page and their logits are ignored.
-  * eviction — on EOS or max_new_tokens the slot's pages return to the
-    free pool and the slot re-enters admission.
+    scratch page and their logits are ignored.  The incoming token's page
+    is allocated on demand, and may FAIL under pressure.
+  * preemption — when mid-decode allocation fails, a victim is picked
+    (`victim_policy`: "latest" admitted, or "fewest_tokens" generated),
+    its pages are released, and the request re-enters the HEAD of the
+    pending deque carrying either a host copy of its KV pages
+    (`preempt_mode="swap"`: gather at preempt, scatter back on resume) or
+    nothing (`preempt_mode="recompute"`: prompt + generated-so-far is
+    re-prefilled through the same bucketed prefill path on resume).  The
+    LAST runnable sequence is never preempted — and a single request's
+    worst case is validated against the pool at submit() — so forward
+    progress is deadlock-free.
+  * eviction — on EOS / max_new_tokens / cancel() / deadline expiry the
+    slot's pages return to the free pool and the slot re-enters admission.
 
-Pages for prompt+max_new_tokens are reserved at admission (a request
-either fits or stays queued) — reservation keeps the engine deadlock-free
-without preemption; preemption/swap is the next step up, not built here.
+Request lifecycle: `submit()` returns a handle with `result()`, `done()`
+and `cancel()`; per-request deadlines are enforced at every step()
+boundary (queued or mid-decode -> `DeadlineExceeded`); the pending queue
+is bounded (`max_pending`) and overflow raises a typed `QueueFull`
+(HTTP 503 + Retry-After in serve_llm).  `serve_llm` maps a `result()`
+timeout to HTTP 504 AND cancels the request so its slot/pages free
+immediately instead of starving the batch until max_new_tokens.
+
+Every failure path is exercised by the fault-injection harness in
+`paddle_tpu.inference.faults`: the engine calls `faults.fire(point, ...)`
+at named injection points (prefill / decode / page_alloc / sample /
+swap_out / swap_in) and the harness's invariant checker proves no pages,
+slots or handles leak under any schedule.
 """
 
 from __future__ import annotations
@@ -30,6 +51,7 @@ from __future__ import annotations
 import collections
 import functools
 import threading
+import time
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -39,13 +61,48 @@ import jax.numpy as jnp
 
 from ..models import generation
 
-__all__ = ["LLMEngine", "serve_llm"]
+__all__ = ["LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
+           "DeadlineExceeded"]
+
+
+class QueueFull(RuntimeError):
+    """submit() refused: the bounded pending queue is at capacity.
+    serve_llm maps this to HTTP 503 with a Retry-After header."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled before it finished."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request's deadline expired before it finished."""
+
+
+class _ResumeState:
+    """What a preempted request needs to re-enter a slot: decode position,
+    the sampled-but-not-yet-cached token, how many pages it held, and (swap
+    mode only) host copies of those pages' KV."""
+
+    __slots__ = ("ctx", "last_tok", "n_pages", "host_k", "host_v")
+
+    def __init__(self, ctx: int, last_tok: int, n_pages: int,
+                 host_k=None, host_v=None):
+        self.ctx = ctx
+        self.last_tok = last_tok
+        self.n_pages = n_pages
+        self.host_k = host_k
+        self.host_v = host_v
 
 
 class _Request:
     """One queued/in-flight generation request."""
 
-    def __init__(self, prompt, max_new_tokens: int, eos_id: Optional[int]):
+    def __init__(self, prompt, max_new_tokens: int, eos_id: Optional[int],
+                 deadline: Optional[float] = None):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -53,8 +110,14 @@ class _Request:
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.eos_id = eos_id
+        self.deadline = (None if deadline is None
+                         else time.monotonic() + float(deadline))
         self.tokens: List[int] = []
         self.error: Optional[BaseException] = None
+        self.cancelled = False
+        self.resolutions = 0        # invariant: exactly 1 once done()
+        self._resume: Optional[_ResumeState] = None
+        self._engine: Optional["LLMEngine"] = None
         self._event = threading.Event()
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
@@ -69,12 +132,43 @@ class _Request:
     def done(self) -> bool:
         return self._event.is_set()
 
+    def cancel(self) -> None:
+        """Cancel the request: a queued one resolves immediately with
+        RequestCancelled; an in-flight one is evicted (pages released) at
+        the next step() boundary.  No-op once done."""
+        eng = self._engine
+        if eng is None:
+            self.cancelled = True
+            return
+        with eng._cv:
+            if self.done():
+                return
+            self.cancelled = True
+            try:
+                eng._pending.remove(self)
+            except ValueError:
+                eng._cv.notify_all()   # in flight: wake the loop to evict
+                return
+            eng.stats["cancelled"] += 1
+            self._resolve(RequestCancelled("request cancelled"))
+
+    def _resolve(self, error: Optional[BaseException] = None) -> None:
+        # counts EVERY call, even redundant ones, so the invariant checker
+        # can prove each handle resolved exactly once
+        self.resolutions += 1
+        if self._event.is_set():
+            return
+        self.error = error
+        self._event.set()
+
 
 class _SlotState:
-    def __init__(self, req: _Request, last_tok: int, ctx: int):
+    def __init__(self, req: _Request, last_tok: int, ctx: int,
+                 admit_seq: int):
         self.req = req
         self.last_tok = last_tok    # sampled, not yet in the cache
         self.ctx = ctx              # tokens currently cached
+        self.admit_seq = admit_seq  # admission order (victim policy)
 
 
 def _bucket(n: int, lo: int = 8) -> int:
@@ -89,13 +183,25 @@ class LLMEngine:
 
     `num_slots` is the decode batch width (one compiled decode program);
     `num_pages` bounds resident KV memory — when smaller than worst-case
-    num_slots occupancy, requests queue until pages free up.
+    num_slots occupancy the engine admits on demand and PREEMPTS under
+    pressure (see module docstring), so a pool sized for the *expected*
+    footprint still serves the worst case correctly, just slower.
+
+    preempt_mode: "swap" (KV pages copied to host at preempt, scattered
+    back on resume) or "recompute" (prompt+generated re-prefilled on
+    resume).  victim_policy: "latest" (latest-admitted) or "fewest_tokens"
+    (least work lost).  max_pending bounds the queue (QueueFull beyond).
+    faults: an optional paddle_tpu.inference.faults.FaultInjector.
     """
 
     def __init__(self, params, config, num_slots: int = 4,
                  page_size: int = 16, max_seq_len: Optional[int] = None,
                  num_pages: Optional[int] = None, temperature: float = 0.0,
-                 top_k: int = 0, top_p: float = 1.0, seed: int = 0):
+                 top_k: int = 0, top_p: float = 1.0, seed: int = 0,
+                 max_pending: Optional[int] = None,
+                 preempt_mode: str = "swap",
+                 victim_policy: str = "latest",
+                 faults=None):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -106,6 +212,14 @@ class LLMEngine:
             raise ValueError(
                 f"max_seq_len={self.max_seq_len} exceeds the model's "
                 f"max_position_embeddings={config.max_position_embeddings}")
+        if preempt_mode not in ("swap", "recompute"):
+            raise ValueError(f"unknown preempt_mode {preempt_mode!r}")
+        if victim_policy not in ("latest", "fewest_tokens"):
+            raise ValueError(f"unknown victim_policy {victim_policy!r}")
+        self.preempt_mode = preempt_mode
+        self.victim_policy = victim_policy
+        self.max_pending = None if max_pending is None else int(max_pending)
+        self.faults = faults
         pages_per_seq = -(-self.max_seq_len // page_size)
         if num_pages is None:
             num_pages = 1 + num_slots * pages_per_seq   # full provisioning
@@ -114,12 +228,15 @@ class LLMEngine:
             max_slots=num_slots, pages_per_seq=pages_per_seq)
         self._pending: collections.deque = collections.deque()
         self._slots: dict[int, _SlotState] = {}
+        self._admit_seq = 0
         self._key = jax.random.PRNGKey(seed)
         self._cv = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
         self.stats = {"admitted": 0, "completed": 0, "decode_steps": 0,
-                      "decode_tokens": 0}
+                      "decode_tokens": 0, "preemptions": 0, "swapped_in": 0,
+                      "resumed": 0, "cancelled": 0, "timed_out": 0,
+                      "failed": 0}
 
         cfg = config
 
@@ -152,23 +269,57 @@ class LLMEngine:
 
         self._prefill = _prefill
 
+        # swap path: page gather (preempt) reads the pools — NOT donated;
+        # page scatter (resume) replaces them — donated like decode.  idx
+        # is padded to a fixed pages_per_seq with the reserved page 0, so
+        # one compiled program covers every page count
+        @jax.jit
+        def _swap_out(k_pool, v_pool, idx):
+            out = generation.gather_kv_pages(
+                {"k": k_pool, "v": v_pool}, idx)
+            return out["k"], out["v"]
+
+        self._swap_out = _swap_out
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _swap_in(k_pool, v_pool, idx, host_k, host_v):
+            pools = generation.scatter_kv_pages(
+                {"k": k_pool, "v": v_pool}, idx,
+                {"k": host_k, "v": host_v})
+            return pools["k"], pools["v"]
+
+        self._swap_in = _swap_in
+
     # -- client surface -----------------------------------------------------
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
-               eos_id: Optional[int] = None) -> _Request:
-        req = _Request(prompt, max_new_tokens, eos_id)
+               eos_id: Optional[int] = None,
+               deadline: Optional[float] = None) -> _Request:
+        """Queue a request.  deadline: seconds from now; once expired the
+        request resolves with DeadlineExceeded at the next step() boundary,
+        whether still queued or mid-decode.  Raises QueueFull when the
+        bounded pending queue is at capacity."""
+        req = _Request(prompt, max_new_tokens, eos_id, deadline=deadline)
         total = req.prompt.size + req.max_new_tokens
         if total > self.max_seq_len:
             raise ValueError(
                 f"prompt+max_new_tokens={total} exceeds engine "
                 f"max_seq_len={self.max_seq_len}")
         if self.cache.pages_needed(total) > self.cache.num_pages - 1:
+            # the preemption guarantee rests on this: a LONE sequence must
+            # always be able to grow to its worst case
             raise ValueError(
                 f"request needs {self.cache.pages_needed(total)} pages but "
                 f"the pool only holds {self.cache.num_pages - 1}")
         with self._cv:
             if self._stop:
                 raise RuntimeError("engine is stopped")
+            if (self.max_pending is not None
+                    and len(self._pending) >= self.max_pending):
+                raise QueueFull(
+                    f"pending queue is full ({self.max_pending} requests)",
+                    retry_after=1.0)
+            req._engine = self
             self._pending.append(req)
             self._cv.notify()
         return req
@@ -189,18 +340,34 @@ class LLMEngine:
             timeout = 0
         return [r.result(timeout=timeout) for r in reqs]
 
+    def stats_snapshot(self) -> dict:
+        """Copy of the counters taken under self._cv (every counter write
+        holds the lock, so no torn multi-counter updates) plus queue/pool
+        gauges.  The gauges are instantaneous reads: slot/page state is
+        owned lock-free by the step thread, so a gauge can be one step
+        fresher than the counters next to it."""
+        with self._cv:
+            snap = dict(self.stats)
+            snap["queue_depth"] = len(self._pending)
+            snap["free_pages"] = self.cache.free_page_count
+            snap["free_slots"] = self.cache.free_slot_count
+        return snap
+
     # -- engine loop --------------------------------------------------------
 
     def has_work(self) -> bool:
         return bool(self._pending or self._slots)
 
     def step(self) -> bool:
-        """One engine iteration: admit pending requests into free slots,
-        advance every active slot one token, evict finished sequences.
-        Returns True when any work was done."""
+        """One engine iteration: reap cancelled/expired requests, admit
+        pending requests into free slots (resuming preempted ones first —
+        they re-enter at the queue head), advance every active slot one
+        token (preempting victims when page allocation fails), evict
+        finished sequences.  Returns True when any work was done."""
+        reaped = self._reap()
         admitted = self._admit()
         decoded = self._decode_step()
-        return admitted or decoded
+        return reaped or admitted or decoded
 
     def start(self):
         """Run the engine loop in a background thread (serving mode)."""
@@ -225,8 +392,7 @@ class LLMEngine:
                 err = RuntimeError("engine shut down (step thread wedged)")
                 with self._cv:
                     for req in list(self._pending):
-                        req.error = err
-                        req._event.set()
+                        req._resolve(err)
                     self._pending.clear()
                 raise RuntimeError(
                     f"engine step thread still running after "
@@ -235,17 +401,18 @@ class LLMEngine:
                     "retry shutdown() once it finishes its step")
             self._thread = None
         # thread is gone (or never ran): fail anything still queued or in
-        # flight so waiters unblock, and reclaim the slots
+        # flight so waiters unblock, and reclaim the slots.  Under _cv: a
+        # client thread's cancel() also removes/resolves pending requests,
+        # and racing it here would double-resolve a handle.
         err = RuntimeError("engine shut down")
-        for req in list(self._pending):
-            req.error = err
-            req._event.set()
-        self._pending.clear()
-        for slot in list(self._slots):
-            st = self._slots.pop(slot)
-            st.req.error = err
-            st.req._event.set()
-            self.cache.release_slot(slot)
+        with self._cv:
+            for req in list(self._pending):
+                req._resolve(err)
+            self._pending.clear()
+            for slot in list(self._slots):
+                st = self._slots.pop(slot)
+                st.req._resolve(err)
+                self.cache.release_slot(slot)
 
     def _loop(self):
         while True:
@@ -256,16 +423,10 @@ class LLMEngine:
                     return
             try:
                 self.step()
-            except Exception as e:  # noqa: BLE001 — fail in-flight requests
-                with self._cv:
-                    for slot in list(self._slots):
-                        st = self._slots.pop(slot)
-                        st.req.error = e
-                        st.req._event.set()
-                        self.cache.release_slot(slot)
-                    # _decode donates the pools too: recover them so the
-                    # engine can admit new work after a failed step
-                    self._recover_pools(e)
+            except Exception as e:  # noqa: BLE001 — backstop: step()
+                # handles its own dispatch faults; anything escaping is an
+                # engine bug — fail in-flight work so waiters unblock
+                self._fail_inflight(e)
 
     def _recover_pools(self, cause: BaseException) -> bool:
         """If a failed donated dispatch consumed the k/v pools, re-zero
@@ -283,15 +444,16 @@ class LLMEngine:
         err = RuntimeError(f"KV pools lost to a failed donated dispatch "
                            f"({cause!r:.120}); slot state was reset")
         for slot in list(self._slots):
-            st = self._slots.pop(slot)
-            st.req.error = err
-            st.req._event.set()
-            cache.release_slot(slot)
+            self._evict(slot, err, "failed")
         cache.pools = generation.init_paged_kv_pools(
             self.config, cache.num_pages, cache.page_size)
         return True
 
     # -- internals ----------------------------------------------------------
+
+    def _fire(self, point: str, **ctx) -> None:
+        if self.faults is not None:
+            self.faults.fire(point, engine=self, **ctx)
 
     def _next_key(self):
         self._key, sub = jax.random.split(self._key)
@@ -302,82 +464,264 @@ class LLMEngine:
             logits, self._next_key(), self.temperature, self.top_k,
             self.top_p)
 
+    def _reap(self) -> bool:
+        """Resolve cancelled and past-deadline requests, queued or in
+        flight, releasing any slot/pages they hold."""
+        now = time.monotonic()
+        did = False
+        with self._cv:
+            for req in list(self._pending):
+                if req.cancelled:
+                    err = RequestCancelled("request cancelled")
+                    key = "cancelled"
+                elif req.deadline is not None and now >= req.deadline:
+                    err = DeadlineExceeded("deadline expired while queued")
+                    key = "timed_out"
+                else:
+                    continue
+                self._pending.remove(req)
+                self.stats[key] += 1
+                req._resolve(err)
+                did = True
+        for slot in list(self._slots):
+            st = self._slots.get(slot)
+            if st is None:
+                continue
+            if st.req.cancelled:
+                self._evict(slot, RequestCancelled("request cancelled"),
+                            "cancelled")
+                did = True
+            elif st.req.deadline is not None and now >= st.req.deadline:
+                self._evict(slot, DeadlineExceeded(
+                    f"deadline expired after {len(st.req.tokens)} tokens"),
+                    "timed_out")
+                did = True
+        return did
+
+    def _evict(self, slot: int, err: BaseException, stat_key: str) -> None:
+        st = self._slots.pop(slot)
+        self.cache.release_slot(slot)
+        with self._cv:
+            self.stats[stat_key] += 1
+        st.req._resolve(err)
+
+    def _pick_victim(self) -> int:
+        if self.victim_policy == "fewest_tokens":
+            # least work lost; tie -> latest admitted
+            return min(self._slots, key=lambda s: (
+                len(self._slots[s].req.tokens), -self._slots[s].admit_seq))
+        return max(self._slots, key=lambda s: self._slots[s].admit_seq)
+
+    def _preempt(self, slot: int) -> None:
+        """Release a victim's pages and re-queue it at the HEAD of the
+        pending deque, carrying a host copy of its KV pages (swap mode) or
+        nothing (recompute mode)."""
+        cache = self.cache
+        st = self._slots.pop(slot)
+        pages = list(cache._slot_pages[slot])
+        rs = _ResumeState(ctx=st.ctx, last_tok=st.last_tok,
+                          n_pages=len(pages))
+        try:
+            if self.preempt_mode == "swap":
+                self._fire("swap_out", slot=slot, pools=cache.pools)
+                idx = np.zeros((cache.pages_per_seq,), np.int32)
+                idx[:len(pages)] = pages
+                hk, hv = self._swap_out(cache.pools["k"], cache.pools["v"],
+                                        jnp.asarray(idx))
+                rs.host_k = np.asarray(hk)   # device -> host RAM
+                rs.host_v = np.asarray(hv)
+        except Exception as e:  # noqa: BLE001 — a failed swap-out loses the
+            # victim's KV: fail that request, keep the engine serving
+            cache.release_slot(slot)
+            with self._cv:
+                self.stats["failed"] += 1
+            st.req._resolve(e)
+            self._recover_pools(e)
+            return
+        cache.release_slot(slot)
+        st.req._resume = rs
+        with self._cv:
+            self._pending.appendleft(st.req)
+            self.stats["preemptions"] += 1
+
     def _admit(self) -> bool:
         cache = self.cache
-        admitted = False
+        progress = False
         while True:
             with self._cv:
                 if not self._pending or cache.free_slot_count == 0:
                     break
                 req = self._pending[0]
-                total = req.prompt.size + req.max_new_tokens
-                if cache.pages_needed(total) > cache.free_page_count:
+                rs = req._resume
+                need = (rs.n_pages if rs is not None
+                        else cache.pages_needed(req.prompt.size))
+                if need > cache.free_page_count:
                     break  # head-of-line waits for pages (no reordering)
                 self._pending.popleft()
             slot = cache.acquire_slot()
+            self._admit_seq += 1
+            if req.cancelled:   # cancelled between submit and admission
+                cache.release_slot(slot)
+                with self._cv:
+                    self.stats["cancelled"] += 1
+                req._resolve(RequestCancelled("request cancelled"))
+                progress = True
+                continue
             try:
-                cache.ensure_capacity(slot, total)  # reserve at admission
-                S = req.prompt.size
-                # clamp the bucket to the rope table (non-power-of-2
-                # max_position_embeddings would otherwise over-slice it)
-                Sb = min(_bucket(S), self.config.max_position_embeddings)
-                ids = np.zeros((1, Sb), np.int32)
-                ids[0, :S] = req.prompt
-                last, k_pool, v_pool = self._prefill(
-                    self.params, jnp.asarray(ids), cache.pools["k"],
-                    cache.pools["v"], cache.page_table[slot][None],
-                    jnp.int32(S))
-                cache.pools = {"k": k_pool, "v": v_pool}
-                tok = int(np.asarray(self._sample(last))[0])
+                if rs is not None:
+                    self._resume_into(slot, req, rs)
+                else:
+                    self._prefill_into(slot, req)
             except Exception as e:  # noqa: BLE001 — admission must not leak
-                # the request left _pending but never reached _slots: without
-                # cleanup the slot and its reserved pages leak forever and
-                # result() blocks until timeout.  Release both, resolve the
-                # handle with the error, and keep admitting — a per-request
-                # failure (e.g. a prefill OOM at this bucket size) must not
-                # wedge the engine.
+                # the request left _pending but never (or only briefly)
+                # reached _slots: without cleanup the slot and its pages
+                # leak forever and result() blocks until timeout.  Release
+                # both, resolve the handle with the error, and keep
+                # admitting — a per-request failure (e.g. a prefill OOM at
+                # this bucket size) must not wedge the engine.
                 self._slots.pop(slot, None)
                 if slot in cache._slot_pages:
                     cache.release_slot(slot)
-                req.error = e
-                req._event.set()
-                # _prefill DONATES the pools: a dispatch that fails after
-                # donation has already consumed them (TPU; CPU ignores
-                # donation), and every later prefill/decode would die on
-                # deleted buffers.  Re-zero the pools and fail the slots
-                # whose KV lived in them.
+                with self._cv:
+                    self.stats["failed"] += 1
+                req._resolve(e)
+                # _prefill/_swap_in DONATE the pools: a dispatch that fails
+                # after donation has already consumed them (TPU; CPU
+                # ignores donation), and every later prefill/decode would
+                # die on deleted buffers.  Re-zero the pools and fail the
+                # slots whose KV lived in them.
                 self._recover_pools(e)
-                continue
-            req.tokens.append(tok)
+            progress = True
+        return progress
+
+    def _prefill_into(self, slot: int, req: _Request) -> None:
+        """Fresh admission: reserve the prompt's pages only (admit-on-
+        demand), prefill, sample the first token."""
+        cache = self.cache
+        S = req.prompt.size
+        self._fire("page_alloc", slot=slot, n_tokens=S)
+        cache.ensure_capacity(slot, S)
+        # clamp the bucket to the rope table (non-power-of-2
+        # max_position_embeddings would otherwise over-slice it)
+        Sb = min(_bucket(S), self.config.max_position_embeddings)
+        ids = np.zeros((1, Sb), np.int32)
+        ids[0, :S] = req.prompt
+        self._fire("prefill", slot=slot, pools=cache.pools)
+        last, k_pool, v_pool = self._prefill(
+            self.params, jnp.asarray(ids), cache.pools["k"],
+            cache.pools["v"], cache.page_table[slot][None], jnp.int32(S))
+        cache.pools = {"k": k_pool, "v": v_pool}
+        self._fire("sample", slot=slot)
+        tok = int(np.asarray(self._sample(last))[0])
+        req.tokens.append(tok)
+        with self._cv:
             self.stats["admitted"] += 1
-            if (req.eos_id is not None and tok == req.eos_id) \
-                    or req.max_new_tokens == 1:
-                self._finish(slot, req)
-            else:
-                self._slots[slot] = _SlotState(req, tok, ctx=S)
-            admitted = True
-        return admitted
+        if (req.eos_id is not None and tok == req.eos_id) \
+                or req.max_new_tokens == 1:
+            self._finish(slot, req)
+        else:
+            self._slots[slot] = _SlotState(req, tok, ctx=S,
+                                           admit_seq=self._admit_seq)
+
+    def _resume_into(self, slot: int, req: _Request,
+                     rs: _ResumeState) -> None:
+        """Re-admit a preempted request: reallocate its page count, then
+        either scatter the host KV copy back (swap) or re-prefill
+        prompt+generated-so-far (recompute).  Token-exact either way: the
+        cache ends bit-identical (swap) or recomputed through the same
+        prefill math the fresh path uses (recompute)."""
+        cache = self.cache
+        self._fire("page_alloc", slot=slot,
+                   n_tokens=rs.n_pages * cache.page_size)
+        cache.ensure_capacity(slot, rs.n_pages * cache.page_size)
+        if rs.host_k is not None:
+            self._fire("swap_in", slot=slot, pools=cache.pools)
+            idx = np.zeros((cache.pages_per_seq,), np.int32)
+            pages = cache._slot_pages[slot]
+            idx[:len(pages)] = pages
+            k_pool, v_pool = self._swap_in(
+                cache.pools["k"], cache.pools["v"], jnp.asarray(idx),
+                jnp.asarray(rs.host_k), jnp.asarray(rs.host_v))
+            cache.pools = {"k": k_pool, "v": v_pool}
+            with self._cv:
+                self.stats["swapped_in"] += 1
+        else:
+            # recompute-on-resume: the cached part is prompt + all
+            # generated tokens except the pending one (ctx tokens total);
+            # re-prefill it through the same bucketed path admission uses
+            ids_np = np.concatenate(
+                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
+            Sb = min(_bucket(rs.ctx), self.config.max_position_embeddings)
+            ids = np.zeros((1, Sb), np.int32)
+            ids[0, :rs.ctx] = ids_np
+            self._fire("prefill", slot=slot, pools=cache.pools)
+            _last, k_pool, v_pool = self._prefill(
+                self.params, jnp.asarray(ids), cache.pools["k"],
+                cache.pools["v"], cache.page_table[slot][None],
+                jnp.int32(rs.ctx))
+            cache.pools = {"k": k_pool, "v": v_pool}
+        with self._cv:
+            self.stats["resumed"] += 1
+        req._resume = None
+        self._slots[slot] = _SlotState(req, rs.last_tok, ctx=rs.ctx,
+                                       admit_seq=self._admit_seq)
 
     def _decode_step(self) -> bool:
         if not self._slots:
             return False
         cache = self.cache
+        # on-demand page allocation: the incoming token lands at cache
+        # index st.ctx — under pressure, preempt a victim and retry.
+        # Never the last runnable sequence (its worst case was validated
+        # at submit), so a lone request always completes.
+        for slot in sorted(self._slots):
+            if slot not in self._slots:
+                continue        # preempted as a victim earlier in the pass
+            st = self._slots[slot]
+            while True:
+                try:
+                    self._fire("page_alloc", slot=slot, n_tokens=st.ctx + 1)
+                    cache.ensure_capacity(slot, st.ctx + 1)
+                    break
+                except RuntimeError as e:
+                    if len(self._slots) == 1:
+                        # last runnable: a pool too small for one sequence
+                        # is rejected at submit(), so this is an injected
+                        # or configuration fault — fail the request rather
+                        # than deadlock
+                        self._evict(slot, e, "failed")
+                        break
+                    victim = self._pick_victim()
+                    self._preempt(victim)
+                    if victim == slot or slot not in self._slots:
+                        # preempted ourselves — or a failed swap-out
+                        # recovered the pools and failed this slot too
+                        break
+        if not self._slots:
+            return True         # every slot preempted/evicted this pass
         B = cache.max_slots
         toks = np.zeros((B,), np.int32)
         ctx = np.zeros((B,), np.int32)   # empty slots hit the scratch page
         for slot, st in self._slots.items():
-            # the incoming token lands at cache index st.ctx — make sure
-            # that index's page exists (mid-decode page allocation)
-            cache.ensure_capacity(slot, st.ctx + 1)
             toks[slot] = st.last_tok
             ctx[slot] = st.ctx
-        logits, pools = self._decode(
-            self.params, jnp.asarray(toks), jnp.asarray(ctx),
-            cache.page_table, cache.pools["k"], cache.pools["v"])
-        cache.pools = pools
-        nxt = np.asarray(self._sample(logits))
-        self.stats["decode_steps"] += 1
-        self.stats["decode_tokens"] += len(self._slots)
+        try:
+            self._fire("decode", pools=cache.pools)
+            logits, pools = self._decode(
+                self.params, jnp.asarray(toks), jnp.asarray(ctx),
+                cache.page_table, cache.pools["k"], cache.pools["v"])
+            cache.pools = pools
+            self._fire("sample")
+            nxt = np.asarray(self._sample(logits))
+        except Exception as e:  # noqa: BLE001 — dispatch/sampling fault:
+            # the donated pools may be consumed and this step's KV writes
+            # are suspect.  Fail every in-flight request, recover the
+            # pools, keep serving the queue.
+            self._fail_inflight(e)
+            return True
+        with self._cv:
+            self.stats["decode_steps"] += 1
+            self.stats["decode_tokens"] += len(self._slots)
         for slot in list(self._slots):
             st = self._slots[slot]
             st.ctx += 1
@@ -390,10 +734,16 @@ class LLMEngine:
                 self._finish(slot, st.req)
         return True
 
+    def _fail_inflight(self, e: BaseException) -> None:
+        for slot in list(self._slots):
+            self._evict(slot, e, "failed")
+        self._recover_pools(e)
+
     def _finish(self, slot: int, req: _Request):
         self.cache.release_slot(slot)
-        self.stats["completed"] += 1
-        req._event.set()
+        with self._cv:
+            self.stats["completed"] += 1
+        req._resolve()
 
 
 def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
@@ -402,30 +752,46 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
     """HTTP JSON generation endpoint over a continuous-batching engine.
 
     POST / with {"prompt": [token ids], "max_new_tokens": N,
-    "eos_id": optional} returns {"tokens": [...]}.  Concurrent requests
-    share the engine's decode batch (continuous batching), so throughput
-    scales with occupancy, not request count.  GET /stats returns engine
-    counters.  Returns (server, thread); server.shutdown() stops the HTTP
-    loop AND the engine."""
+    "eos_id": optional, "deadline": optional seconds} returns
+    {"tokens": [...]}.  Concurrent requests share the engine's decode
+    batch (continuous batching), so throughput scales with occupancy, not
+    request count.
+
+    Failure surface: a full pending queue replies 503 with a Retry-After
+    header; a request that misses `request_timeout` replies 504 AND is
+    cancelled so its slot/pages free immediately (it must not starve the
+    batch until max_new_tokens); GET /healthz replies 200 only while the
+    engine's step thread is alive; GET /stats returns a locked snapshot
+    of the engine counters.  Returns (server, thread); server.shutdown()
+    stops the HTTP loop AND the engine."""
     import json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     engine.start()
 
     class Handler(BaseHTTPRequestHandler):
-        def _reply(self, status: int, payload: dict):
+        def _reply(self, status: int, payload: dict, headers=None):
             body = json.dumps(payload).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):
-            if self.path.rstrip("/") == "/stats":
-                self._reply(200, dict(engine.stats,
-                                      free_pages=engine.cache.free_page_count,
-                                      free_slots=engine.cache.free_slot_count))
+            path = self.path.rstrip("/")
+            if path == "/stats":
+                self._reply(200, engine.stats_snapshot())
+            elif path == "/healthz":
+                t = engine._thread
+                alive = (t is not None and t.is_alive()
+                         and not engine._stop)
+                self._reply(200 if alive else 503,
+                            {"ok": alive,
+                             "step_thread_alive": bool(t and t.is_alive()),
+                             "stopped": engine._stop})
             else:
                 self._reply(404, {"error": "unknown path"})
 
@@ -440,16 +806,33 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
                     prompt = req["prompt"]
                     max_new = int(req.get("max_new_tokens", 16))
                     eos_id = req.get("eos_id")
+                    deadline = req.get("deadline")
                 except (json.JSONDecodeError, KeyError, TypeError,
                         ValueError) as e:
                     self._reply(400, {"error": f"bad request body: {e!r}"})
                     return
                 try:
-                    handle = engine.submit(prompt, max_new, eos_id)
+                    handle = engine.submit(prompt, max_new, eos_id,
+                                           deadline=deadline)
+                except QueueFull as e:
+                    retry = max(1, int(-(-e.retry_after // 1)))
+                    self._reply(503, {"error": str(e)},
+                                headers={"Retry-After": str(retry)})
+                    return
                 except (ValueError, RuntimeError) as e:
                     self._reply(400, {"error": str(e)})
                     return
-                toks = handle.result(timeout=request_timeout)
+                try:
+                    toks = handle.result(timeout=request_timeout)
+                except TimeoutError as e:
+                    # covers both the wait timeout and an engine-side
+                    # DeadlineExceeded; cancel so the slot/pages free NOW
+                    handle.cancel()
+                    self._reply(504, {"error": f"generation timed out: {e}"})
+                    return
+                except RequestCancelled as e:
+                    self._reply(409, {"error": str(e)})
+                    return
                 self._reply(200, {"tokens": toks})
             except Exception as e:  # noqa: BLE001 — server-side fault
                 self._reply(500, {"error": repr(e)})

@@ -328,6 +328,28 @@ def scatter_prefill_into_pages(cache, pools, page_table, seq_len: int,
     }
 
 
+def gather_kv_pages(pools, page_idx):
+    """Copy the listed pages out of the pools: {"k","v"} each
+    (L, len(page_idx), page_size, Hkv, D).  The engine's preempt/swap path
+    gathers a victim's pages here and moves them to host RAM."""
+    return {"k": jnp.take(pools["k"], page_idx, axis=1),
+            "v": jnp.take(pools["v"], page_idx, axis=1)}
+
+
+def scatter_kv_pages(pools, page_idx, page_kv):
+    """Inverse of gather_kv_pages: write page copies back at page_idx (the
+    swap-in path, after fresh pages were allocated for a resumed sequence).
+    Duplicate indices — page_idx padded to a fixed length with 0 — may only
+    alias the reserved scratch page 0, whose contents are never read as
+    real data."""
+    return {
+        "k": pools["k"].at[:, page_idx].set(
+            page_kv["k"].astype(pools["k"].dtype)),
+        "v": pools["v"].at[:, page_idx].set(
+            page_kv["v"].astype(pools["v"].dtype)),
+    }
+
+
 def _block_paged(c, x, lp, cos, sin, kp, vp, page_table, ctx, ffn_fn=None):
     """One block in paged-decode mode.  x: (B, 1, E); kp/vp: one layer's
     (P, ps, Hkv, D) pools; ctx: (B,) tokens already cached per slot — the

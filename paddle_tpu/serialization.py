@@ -58,8 +58,12 @@ def save(obj, path, protocol=4, **configs):
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
+    # write-then-rename (same atomic pattern as distributed/checkpoint.py):
+    # a crash mid-write leaves the old checkpoint intact, never a torn file
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
         pickle.dump(_pack(obj), f, protocol=protocol)
+    os.replace(tmp, path)
 
 
 def load(path, return_numpy=False, **configs):

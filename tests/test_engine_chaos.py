@@ -1,0 +1,422 @@
+"""Preemptible serving: request lifecycle (cancel / deadline / bounded
+queue), preempt-then-resume correctness, the HTTP failure surface
+(503/504/healthz), and the fault-injection chaos suite with the
+zero-leak invariant checker (paddle_tpu/inference/faults.py)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference import (DeadlineExceeded, LLMEngine, QueueFull,
+                                  RequestCancelled, serve_llm)
+from paddle_tpu.inference import faults as F
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _workload(cfg, seed=1, n=4):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, cfg.vocab_size,
+                          int(rng.integers(2, 9))).tolist(),
+             int(rng.integers(2, 7))) for _ in range(n)]
+
+
+class TestLifecycle:
+    def test_cancel_while_queued(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg, num_slots=1)
+        a = eng.submit([1, 2, 3], max_new_tokens=4)
+        b = eng.submit([4, 5], max_new_tokens=4)
+        b.cancel()                    # resolves immediately (still queued)
+        assert b.done()
+        with pytest.raises(RequestCancelled):
+            b.result(timeout=0)
+        while not a.done():
+            eng.step()
+        assert len(a.result(timeout=0)) == 4
+        assert eng.stats["cancelled"] == 1
+        F.check_invariants(eng, [a, b])
+
+    def test_cancel_while_decoding(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        a = eng.submit([1, 2, 3, 4], max_new_tokens=8)
+        eng.step()                    # admit + first decode
+        assert not a.done()
+        a.cancel()                    # in flight: evicted at next step
+        eng.step()
+        assert a.done()
+        with pytest.raises(RequestCancelled):
+            a.result(timeout=0)
+        assert eng.stats["cancelled"] == 1
+        # the cancelled request's slot/pages freed immediately
+        assert eng.cache.free_slot_count == 2
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        F.check_invariants(eng, [a])
+
+    def test_cancel_done_request_is_noop(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        a = eng.submit([1, 2], max_new_tokens=2)
+        while not a.done():
+            eng.step()
+        toks = a.result(timeout=0)
+        a.cancel()
+        assert a.result(timeout=0) == toks     # still the tokens, no error
+        assert a.resolutions == 1
+
+    def test_deadline_while_queued(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg, num_slots=1)
+        a = eng.submit([1, 2, 3], max_new_tokens=8)     # occupies the slot
+        b = eng.submit([4, 5], max_new_tokens=4, deadline=0.0)
+        eng.step()                    # reap runs before admission
+        assert b.done()
+        with pytest.raises(DeadlineExceeded):
+            b.result(timeout=0)
+        assert eng.stats["timed_out"] == 1
+        while not a.done():
+            eng.step()
+        a.result(timeout=0)
+        F.check_invariants(eng, [a, b])
+
+    def test_deadline_mid_decode(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        a = eng.submit([1, 2, 3, 4], max_new_tokens=8, deadline=0.15)
+        eng.step()                    # admit
+        time.sleep(0.2)
+        eng.step()                    # deadline reaped, slot evicted
+        assert a.done()
+        with pytest.raises(DeadlineExceeded):
+            a.result(timeout=0)
+        assert eng.stats["timed_out"] == 1
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        F.check_invariants(eng, [a])
+
+    def test_queue_full_raises_typed(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg, num_slots=1, max_pending=1)
+        a = eng.submit([1, 2], max_new_tokens=3)    # queued (nothing steps)
+        with pytest.raises(QueueFull) as ei:
+            eng.submit([3, 4], max_new_tokens=3)
+        assert ei.value.retry_after > 0
+        while not a.done():
+            eng.step()
+        F.check_invariants(eng, [a])
+
+    def test_submit_after_shutdown(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        eng.shutdown()
+        with pytest.raises(RuntimeError, match="stopped"):
+            eng.submit([1, 2], max_new_tokens=2)
+
+    def test_shutdown_fails_queued_and_inflight(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg, num_slots=1)
+        a = eng.submit([1, 2, 3], max_new_tokens=8)
+        b = eng.submit([4, 5], max_new_tokens=4)
+        eng.step()                    # a in flight, b queued
+        eng.shutdown()
+        for h in (a, b):
+            assert h.done() and h.resolutions == 1
+            with pytest.raises(RuntimeError, match="shut down"):
+                h.result(timeout=0)
+        assert eng.cache.free_slot_count == 1
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+
+
+class TestPreemption:
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_preempt_resume_token_exact(self, tiny, mode):
+        """A pool sized BELOW concurrent worst-case must still complete
+        every request token-exactly vs the single-request generate_paged()
+        baseline, with >= 1 preemption actually observed."""
+        cfg, params = tiny
+        rng = np.random.default_rng(0)
+        # 2 slots, worst case 3 pages each = 6 > the 4 the pool holds
+        eng = _engine(params, cfg, num_pages=5, preempt_mode=mode)
+        prompts = [rng.integers(0, cfg.vocab_size, 8).tolist()
+                   for _ in range(3)]
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for p, got in zip(prompts, outs):
+            want = np.asarray(generation.generate_paged(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=4, page_size=4))[0].tolist()
+            assert got == want
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["resumed"] >= 1
+        if mode == "swap":
+            assert eng.stats["swapped_in"] >= 1
+        else:
+            assert eng.stats["swapped_in"] == 0
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        F.check_invariants(eng)
+
+    def test_victim_policy_fewest_tokens(self, tiny):
+        cfg, params = tiny
+        rng = np.random.default_rng(2)
+        eng = _engine(params, cfg, num_pages=5,
+                      victim_policy="fewest_tokens")
+        prompts = [rng.integers(0, cfg.vocab_size, 8).tolist()
+                   for _ in range(3)]
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for p, got in zip(prompts, outs):
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=4))[0].tolist()
+            assert got == want
+        assert eng.stats["preemptions"] >= 1
+        F.check_invariants(eng)
+
+    def test_never_preempts_last_runnable(self, tiny):
+        """A lone request on a minimal pool completes with ZERO
+        preemptions — the guarantee that makes the scheduler
+        deadlock-free."""
+        cfg, params = tiny
+        eng = _engine(params, cfg, num_slots=1, num_pages=4)  # exactly fits
+        out = eng.generate([[1, 2, 3, 4, 5, 6, 7, 8]], max_new_tokens=4)[0]
+        assert len(out) == 4
+        assert eng.stats["preemptions"] == 0
+        F.check_invariants(eng)
+
+    def test_admission_reserves_prompt_only(self, tiny):
+        """Admit-on-demand: right after admission a request holds pages
+        for its PROMPT, not prompt+max_new_tokens."""
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        eng.submit([1, 2, 3, 4], max_new_tokens=8)   # worst case 3 pages
+        eng.step()   # admit (1 page for the 4-token prompt) + 1 decode
+        used = eng.cache.num_pages - 1 - eng.cache.free_page_count
+        assert used == 2    # prompt page + the on-demand decode page
+
+
+class TestServeFailureSurface:
+    def test_timeout_replies_504_and_cancels(self, tiny):
+        """A request missing request_timeout gets 504 and is CANCELLED —
+        its slot frees immediately instead of decoding to max_new_tokens."""
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=1, page_size=8,
+                        max_seq_len=64)
+        srv, _ = serve_llm(eng, request_timeout=0.05)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            req = urllib.request.Request(url, data=json.dumps(
+                {"prompt": [1, 2, 3], "max_new_tokens": 60}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=60)
+            assert ei.value.code == 504
+            # the cancel frees the slot: the engine must accept and finish
+            # fresh work promptly
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                snap = eng.stats_snapshot()
+                if snap["cancelled"] >= 1 and snap["free_slots"] == 1:
+                    break
+                time.sleep(0.05)
+            snap = eng.stats_snapshot()
+            assert snap["cancelled"] >= 1
+            assert snap["free_slots"] == 1
+            assert snap["free_pages"] == eng.cache.num_pages - 1
+        finally:
+            srv.shutdown()
+
+    def test_queue_full_replies_503_with_retry_after(self, tiny):
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=1, page_size=8,
+                        max_seq_len=64, max_pending=1)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            import threading
+
+            def fire_and_forget():
+                req = urllib.request.Request(url, data=json.dumps(
+                    {"prompt": [1, 2, 3], "max_new_tokens": 60}).encode())
+                try:
+                    urllib.request.urlopen(req, timeout=120).read()
+                except urllib.error.HTTPError:
+                    pass   # failed by shutdown at test end
+            t1 = threading.Thread(target=fire_and_forget)
+            t1.start()
+            # wait until the first request occupies the lone slot
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if eng.stats_snapshot()["admitted"] >= 1:
+                    break
+                time.sleep(0.02)
+            t2 = threading.Thread(target=fire_and_forget)  # fills the queue
+            t2.start()
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if eng.stats_snapshot()["queue_depth"] >= 1:
+                    break
+                time.sleep(0.02)
+            req = urllib.request.Request(url, data=json.dumps(
+                {"prompt": [7, 8], "max_new_tokens": 4}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=60)
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+        finally:
+            srv.shutdown()
+
+    def test_healthz(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/healthz"
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                payload = json.loads(resp.read())
+            assert resp.status == 200 and payload["ok"]
+            eng.shutdown()        # step thread gone -> endpoint degrades
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url, timeout=30)
+            assert ei.value.code == 503
+        finally:
+            srv.shutdown()
+
+    def test_deadline_param_maps_504(self, tiny):
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=1, page_size=8,
+                        max_seq_len=64)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            req = urllib.request.Request(url, data=json.dumps(
+                {"prompt": [1, 2, 3], "max_new_tokens": 60,
+                 "deadline": 0.05}).encode())
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=60)
+            assert ei.value.code == 504
+            assert eng.stats_snapshot()["timed_out"] >= 1
+        finally:
+            srv.shutdown()
+
+
+# -- chaos: deterministic fault schedules + the invariant checker ----------
+
+# every shipped schedule runs on a pool small enough to ALSO exercise
+# preemption under the injected fault (num_pages=5 < 2-slot worst case)
+SHIPPED_SCHEDULES = [
+    ("decode_3rd_dispatch", "swap",
+     [("decode", dict(nth=3))]),
+    ("decode_3rd_dispatch_consumes_donated_pools", "swap",
+     [("decode", dict(nth=3, consume_pools=True))]),
+    ("prefill_1st_dispatch", "swap",
+     [("prefill", dict(nth=1))]),
+    ("prefill_2nd_dispatch_consumes_donated_pools", "recompute",
+     [("prefill", dict(nth=2, consume_pools=True))]),
+    ("oom_every_alloc_slot_0", "swap",
+     [("page_alloc", dict(slot=0, always=True))]),
+    ("oom_every_alloc_slot_1", "recompute",
+     [("page_alloc", dict(slot=1, always=True))]),
+    ("sampling_2nd", "swap",
+     [("sample", dict(nth=2))]),
+    ("swap_out_1st", "swap",
+     [("swap_out", dict(nth=1))]),
+    ("swap_in_1st_consumes_donated_pools", "swap",
+     [("swap_in", dict(nth=1, consume_pools=True))]),
+    ("double_fault_prefill_then_decode", "swap",
+     [("prefill", dict(nth=2)), ("decode", dict(nth=4))]),
+]
+
+
+class TestChaos:
+    def _make(self, params, cfg, mode):
+        return lambda: _engine(params, cfg, num_pages=5, preempt_mode=mode)
+
+    @pytest.mark.parametrize(
+        "name,mode,spec", SHIPPED_SCHEDULES,
+        ids=[s[0] for s in SHIPPED_SCHEDULES])
+    def test_shipped_schedule(self, tiny, name, mode, spec):
+        cfg, params = tiny
+        rules = [F.FaultRule(point, **kw) for point, kw in spec]
+        report = F.run_schedule(self._make(params, cfg, mode), rules,
+                                _workload(cfg))
+        assert report["ok"], report["violations"]
+        assert report["fired"], "schedule never fired — it tests nothing"
+        # every handle resolved: completions + failures cover the workload
+        assert report["completed"] + report["failed"] == report["requests"]
+
+    def test_fault_free_schedule_all_complete(self, tiny):
+        cfg, params = tiny
+        report = F.run_schedule(self._make(params, cfg, "swap"), [],
+                                _workload(cfg))
+        assert report["ok"] and report["failed"] == 0
+        assert report["stats"]["preemptions"] >= 1   # pool pressure alone
+
+    def test_random_schedules_smoke(self, tiny):
+        cfg, params = tiny
+        for seed in range(12):
+            rules = F.random_schedule(seed)
+            mode = "swap" if seed % 2 else "recompute"
+            report = F.run_schedule(self._make(params, cfg, mode), rules,
+                                    _workload(cfg, seed=seed))
+            assert report["ok"], (seed, report["violations"])
+
+    @pytest.mark.slow
+    def test_random_schedules_soak(self, tiny):
+        """>= 200 seeded random schedules (acceptance criterion); each must
+        leave zero leaks and a serving-capable engine."""
+        cfg, params = tiny
+        for seed in range(200):
+            rules = F.random_schedule(seed)
+            mode = "swap" if seed % 2 else "recompute"
+            report = F.run_schedule(self._make(params, cfg, mode), rules,
+                                    _workload(cfg, seed=seed))
+            assert report["ok"], (seed, report["violations"])
+
+    def test_injected_oom_respects_last_runnable(self, tiny):
+        """OOM-every-allocation for one slot must fail ONLY requests that
+        land in it, never deadlock, never leak."""
+        cfg, params = tiny
+        rules = [F.FaultRule("page_alloc", slot=0, always=True)]
+        report = F.run_schedule(self._make(params, cfg, "swap"), rules,
+                                _workload(cfg))
+        assert report["ok"]
+        assert report["failed"] >= 1
+
+
+class TestInvariantChecker:
+    def test_detects_leaked_slot(self, tiny):
+        """The checker itself must catch a leak: acquire a slot behind the
+        engine's back and verify the violation trips."""
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        eng.cache.acquire_slot()
+        with pytest.raises(F.InvariantViolation, match="slot"):
+            F.check_invariants(eng)
+
+    def test_detects_double_resolution(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        h = eng.submit([1, 2], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        h._resolve()     # simulate an engine bug double-resolving
+        with pytest.raises(F.InvariantViolation, match="resolved 2 times"):
+            F.check_invariants(eng, [h])

@@ -9,6 +9,8 @@ Targets (default: all):
   generate_paged     paged-KV single-shot generation (prefill + decode scan)
   engine_decode      LLMEngine's jitted continuous-batching decode step
   engine_prefill     LLMEngine's jitted admission prefill
+  engine_swap_out    LLMEngine's preemption page-gather (KV -> host)
+  engine_swap_in     LLMEngine's resume page-scatter (host -> fresh pages)
 
 Usage:
   python tools/graphlint.py [targets...] [--json] [--verbose]
@@ -133,6 +135,31 @@ def target_engine_prefill():
     return eng._prefill, args, {}
 
 
+def target_engine_swap_out():
+    # preemption swap path: gather a victim's KV pages for the host copy
+    # (reads the pools — correctly NOT donated)
+    import jax.numpy as jnp
+    eng, params = _engine()
+    idx = jnp.zeros((eng.cache.pages_per_seq,), jnp.int32)
+    args = (eng.cache.pools["k"], eng.cache.pools["v"], idx)
+    return eng._swap_out, args, {}
+
+
+def target_engine_swap_in():
+    # resume path: scatter the host KV copy back into fresh pages (the
+    # pools are donated, like the decode step)
+    import jax
+    import jax.numpy as jnp
+    eng, params = _engine()
+    pool = eng.cache.pools["k"]
+    idx = jnp.zeros((eng.cache.pages_per_seq,), jnp.int32)
+    host = jax.ShapeDtypeStruct(
+        (pool.shape[0], eng.cache.pages_per_seq) + pool.shape[2:],
+        pool.dtype)
+    args = (eng.cache.pools["k"], eng.cache.pools["v"], idx, host, host)
+    return eng._swap_in, args, {}
+
+
 TARGETS = {
     "llama": target_llama,
     "moe_llama_gmm": target_moe_llama_gmm,
@@ -140,6 +167,8 @@ TARGETS = {
     "generate_paged": target_generate_paged,
     "engine_decode": target_engine_decode,
     "engine_prefill": target_engine_prefill,
+    "engine_swap_out": target_engine_swap_out,
+    "engine_swap_in": target_engine_swap_in,
 }
 
 # documented suppressions for the shipped models (none today: dead
