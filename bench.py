@@ -418,7 +418,8 @@ def _run_graphlint(timeout: float = 900.0) -> dict:
             return {"error": f"rc={out.returncode} "
                              f"{out.stderr.strip()[-300:]}"}
         d = json.loads(out.stdout.strip().splitlines()[-1])
-        return {"ok": d["ok"], "counts": d["counts"]}
+        return {"ok": d["ok"], "counts": d["counts"],
+                "mem_peak_bytes": d.get("mem_peak_bytes", {})}
     except subprocess.TimeoutExpired:
         return {"error": f"graphlint timed out after {timeout:.0f}s"}
     except Exception as e:  # noqa: BLE001 — lint must not kill the bench
@@ -514,6 +515,8 @@ def main():
     dit_extra = _run_sub("dit")
     moe_extra = _run_sub("moe")
     decode_extra = _run_sub("decode")
+    graphlint_extra = _run_graphlint()
+    graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -539,7 +542,10 @@ def main():
             "decode": decode_extra,
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
-            "graphlint": _run_graphlint(),
+            "graphlint": graphlint_extra,
+            # per-model static memory peak (jaxpr liveness walker) so
+            # BENCH_*.json tracks the footprint trend round over round
+            "graphlint_mem_peak_bytes": graphlint_mem_peaks,
         },
     }))
 

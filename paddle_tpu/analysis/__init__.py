@@ -2,38 +2,56 @@
 
 The analysis half of the reference's IR pass pipeline (~274 passes over
 ProgramDesc/PIR graphs, `paddle/fluid/framework/ir/*_pass.cc`, SURVEY C14),
-rebuilt where it belongs under XLA: over jaxprs.  `static/passes.py` holds
-the record-level *rewrite* passes (DCE / folding / fusion); this package
-holds the *analysis* passes that only diagnose — the lints that catch
-silent f64 promotion, missed buffer donation, replicated giant
-intermediates, and recompile churn before a TPU bill does (the TPU-MLIR /
-MPK lesson: typed IR-level analysis is where correctness and cost
-diagnostics belong).
+rebuilt where it belongs under XLA — in TWO tiers:
 
-Three entry points:
+  tier 1 (jaxpr):  `analyze(fn, *args)` traces and walks the ClosedJaxpr;
+                   catches silent f64 promotion, missed donation,
+                   replicated intermediates, recompile churn, dead code,
+                   cost hotspots, and static memory-liveness peaks —
+                   attributable to eqn paths, no compilation needed.
+  tier 2 (HLO):    `analyze_hlo(fn, *args)` lowers ONCE and lints the
+                   COMPILED artifact — fusion breaks, combinable
+                   collectives, materialized transposes, and buffer-
+                   assignment memory (what jaxprs structurally cannot
+                   see).  `core.merge_reports` joins both tiers.
 
-  * library:  ``paddle_tpu.analysis.analyze(fn, *args)`` -> ``Report``
-  * CLI:      ``python tools/graphlint.py`` lints the shipped bench models
-  * pytest:   ``tests/test_graphlint.py`` keeps the shipped models clean
+On top of findings, `fixes.suggest_fixes(report)` emits concrete patch
+suggestions (exact donate_argnums, constraint insertion points, dtype
+cast sites, bucket-menu edits) — `tools/graphlint.py --fix` prints them.
 
-Checkers (see `checkers.py` for codes): dtype_promotion, donation,
-sharding, recompile_hazard, cost, dead_code.  Suppress per call with
-``analyze(..., suppress=["DTYPE_*"])`` or per code/process with
-``with analysis.suppressions("COST_*"): ...``.
+Suppression: per call (``analyze(..., suppress=["DTYPE_*"])``), per
+process (``with analysis.suppressions(...)``), or per project via a
+`.graphlintrc` file (``config=load_rcfile(find_rcfile())``) which can
+also override finding severities.  Precedence: severity overrides apply
+first; rc and per-call suppressions are unioned.
+
+Three surfaces: the library (`analysis.analyze` / `analyze_hlo` /
+`profiler.static_cost` / `profiler.static_memory`), the CLI
+(``tools/graphlint.py`` — ``--fix``, ``--baseline``, ``--json``), and
+pytest (``tests/test_graphlint*.py`` keep the shipped models clean).
 """
 
 from __future__ import annotations
 
 from .core import (  # noqa: F401
     CheckContext, Finding, Report, Severity, analyze, analyze_jaxpr,
-    aval_bytes, iter_eqns, iter_jaxprs, list_checkers, register_checker,
-    suppressions,
+    aval_bytes, find_rcfile, iter_eqns, iter_jaxprs, list_checkers,
+    load_rcfile, merge_reports, register_checker, suppressions,
 )
 from . import cost  # noqa: F401
-from . import checkers as _checkers  # noqa: F401 — registers the shipped set
+from . import checkers as _checkers  # noqa: F401 — registers the jaxpr set
+from . import memory  # noqa: F401 — registers the memory checker
+from .hlo import (  # noqa: F401
+    analyze_hlo, lint_bucket_menu, list_hlo_checkers, register_hlo_checker,
+)
+from . import hlo  # noqa: F401
+from . import fixes  # noqa: F401
 
 __all__ = [
     "CheckContext", "Finding", "Report", "Severity", "analyze",
-    "analyze_jaxpr", "aval_bytes", "iter_eqns", "iter_jaxprs",
-    "list_checkers", "register_checker", "suppressions", "cost",
+    "analyze_jaxpr", "analyze_hlo", "aval_bytes", "find_rcfile",
+    "iter_eqns", "iter_jaxprs", "lint_bucket_menu", "list_checkers",
+    "list_hlo_checkers", "load_rcfile", "merge_reports",
+    "register_checker", "register_hlo_checker", "suppressions", "cost",
+    "memory", "hlo", "fixes",
 ]

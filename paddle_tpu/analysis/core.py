@@ -31,7 +31,8 @@ from jax.extend import core as jex_core
 __all__ = [
     "Severity", "Finding", "Report", "register_checker", "list_checkers",
     "analyze", "analyze_jaxpr", "suppressions", "iter_eqns", "iter_jaxprs",
-    "aval_bytes", "CheckContext",
+    "aval_bytes", "CheckContext", "load_rcfile", "find_rcfile",
+    "merge_reports",
 ]
 
 _DropVar = getattr(jax._src.core, "DropVar", ())
@@ -48,7 +49,11 @@ class Severity(enum.IntEnum):
 
 @dataclasses.dataclass(frozen=True)
 class Finding:
-    """One diagnostic: where (eqn_path), what (code/message), what to do."""
+    """One diagnostic: where (eqn_path), what (code/message), what to do.
+
+    `data` carries machine-readable specifics (exact argnums, byte counts,
+    suggested bucket menus) for consumers like fixes.suggest_fixes — the
+    human message stays prose, the patch generator reads data."""
 
     severity: Severity
     code: str
@@ -56,11 +61,13 @@ class Finding:
     message: str
     suggestion: str = ""
     checker: str = ""
+    data: dict = dataclasses.field(default_factory=dict, compare=False)
 
     def to_dict(self) -> dict:
         return {"severity": str(self.severity), "code": self.code,
                 "eqn_path": self.eqn_path, "message": self.message,
-                "suggestion": self.suggestion, "checker": self.checker}
+                "suggestion": self.suggestion, "checker": self.checker,
+                "data": dict(self.data)}
 
     def __str__(self):
         tag = {"info": "I", "warning": "W", "error": "E"}[str(self.severity)]
@@ -153,6 +160,117 @@ def suppressions(*codes: str):
         yield
     finally:
         _GLOBAL_SUPPRESS.difference_update(added)
+
+
+# -- project config (.graphlintrc) ------------------------------------------
+#
+# Project-level suppression + severity-override config, loaded by
+# tools/graphlint.py and static.Program.lint() (and any caller passing
+# config=load_rcfile(...) to analyze).  Two keys:
+#
+#   suppress = ["DTYPE_*", "DEAD_CODE@*scan/body*"]   # same syntax as
+#                                                     # analyze(suppress=)
+#   [severity]                                        # code (or glob) ->
+#   RECOMPILE_CONST_CAPTURE = "info"                  # info|warning|error
+#
+# Precedence: severity overrides apply FIRST (so a code demoted to "info"
+# stops failing the WARNING gate), then rc suppressions and per-call
+# suppressions are UNIONED — a per-call suppress can only add to the rc
+# file, never un-suppress it.  Format: TOML subset (sections, strings,
+# single-line string arrays, comments) or a JSON object.
+
+
+def _parse_toml_subset(text: str) -> dict:
+    """Tiny TOML reader for the rc schema above (py3.10 has no tomllib):
+    [section] headers, key = "str" | ["a", "b"] | number | true/false."""
+    import ast
+
+    out: dict = {}
+    section = out
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line.startswith("[") and line.endswith("]"):
+            section = out.setdefault(line[1:-1].strip(), {})
+            continue
+        key, eq, val = line.partition("=")
+        if not eq:
+            raise ValueError(f"unparseable .graphlintrc line: {raw!r}")
+        val = val.split("#", 1)[0].strip() if not val.strip().startswith(
+            ("'", '"', "[")) else val.strip()
+        if val in ("true", "false"):
+            parsed = val == "true"
+        else:
+            try:
+                parsed = ast.literal_eval(val)
+            except (ValueError, SyntaxError) as e:
+                raise ValueError(
+                    f"unparseable .graphlintrc value: {raw!r}") from e
+        section[key.strip().strip('"').strip("'")] = parsed
+    return out
+
+
+def load_rcfile(path: str) -> dict:
+    """Load a .graphlintrc (TOML subset or JSON) into
+    {"suppress": [...], "severity": {CODE: "info"|"warning"|"error"}}."""
+    import json
+
+    with open(path) as f:
+        text = f.read()
+    raw = (json.loads(text) if text.lstrip().startswith("{")
+           else _parse_toml_subset(text))
+    cfg = {"suppress": list(raw.get("suppress", ())),
+           "severity": dict(raw.get("severity", {}))}
+    for code, level in cfg["severity"].items():
+        if str(level).upper() not in Severity.__members__:
+            raise ValueError(
+                f".graphlintrc severity for {code!r} must be one of "
+                f"info/warning/error, got {level!r}")
+    return cfg
+
+
+def find_rcfile(start: Optional[str] = None) -> Optional[str]:
+    """Nearest .graphlintrc walking up from `start` (default: cwd)."""
+    import os
+
+    d = os.path.abspath(start or os.getcwd())
+    while True:
+        cand = os.path.join(d, ".graphlintrc")
+        if os.path.isfile(cand):
+            return cand
+        parent = os.path.dirname(d)
+        if parent == d:
+            return None
+        d = parent
+
+
+def _apply_severity_overrides(findings: List["Finding"],
+                              overrides: Dict[str, str]) -> List["Finding"]:
+    if not overrides:
+        return findings
+    out = []
+    for f in findings:
+        for pat, level in overrides.items():
+            if f.code == pat or fnmatch.fnmatch(f.code, pat):
+                f = dataclasses.replace(
+                    f, severity=Severity[str(level).upper()])
+                break
+        out.append(f)
+    return out
+
+
+def merge_reports(*reports: "Report") -> "Report":
+    """Concatenate reports (e.g. the jaxpr tier + the HLO tier of one
+    target) into one, keeping suppression accounting."""
+    findings: List[Finding] = []
+    suppressed = 0
+    checkers: List[str] = []
+    for r in reports:
+        findings.extend(r.findings)
+        suppressed += r.suppressed
+        checkers.extend(c for c in r.checkers if c not in checkers)
+    return Report(findings, suppressed=suppressed, checkers=checkers)
 
 
 def _is_suppressed(finding: "Finding", patterns: Iterable[str]) -> bool:
@@ -299,6 +417,31 @@ _DEFAULT_OPTIONS = {
     "cost_top_k": 5,
     # at most this many findings per (checker, code) pair
     "max_findings_per_code": 8,
+    # memory checker (analysis/memory.py): peak above this warns; None
+    # keeps MEM_PEAK informational (the default — budgets are per-chip)
+    "mem_peak_budget_bytes": None,
+    "memory_top_k": 3,
+    # HLO tier (analysis/hlo.py) --------------------------------------
+    # unfused elementwise chains shorter than this, or on arrays smaller
+    # than fusion_min_bytes, are noise (XLA fuses what pays on-chip)
+    "fusion_chain_min": 4,
+    "fusion_min_bytes": 1 << 20,
+    # materialized transposes/copies below this are cheap shuffles
+    "layout_min_bytes": 1 << 20,
+    # adjacent same-group collectives smaller than this combine for free
+    "collective_min_bytes": 1 << 10,
+    # buffer-assignment temp bytes > ratio * (live args+outs) warns once
+    # both exceed the floor — temporaries dominating a program is how a
+    # "fits easily" model OOMs at 2x batch
+    "mem_temp_bloat_ratio": 4.0,
+    "mem_temp_min_bytes": 8 << 20,
+    # recompile probe: this many distinct arg signatures are EXPECTED
+    # (the engine's prefill bucket menu); only more than this warns
+    "expected_signatures": 1,
+    # bucket-menu lint: lengths in the upper bucket within slack*lower
+    # edge "straddle" the edge (a near-duplicate compile + pad waste)
+    "bucket_straddle_slack": 1.25,
+    "bucket_align": 4,
 }
 
 
@@ -366,7 +509,8 @@ def _arg_name_map(args, kwargs) -> Dict[int, str]:
     return names
 
 
-def _run_checkers(ctx: CheckContext, checkers, suppress) -> Report:
+def _run_checkers(ctx: CheckContext, checkers, suppress,
+                  config: Optional[dict] = None) -> Report:
     names = list_checkers() if checkers is None else list(checkers)
     findings: List[Finding] = []
     for name in names:
@@ -377,7 +521,20 @@ def _run_checkers(ctx: CheckContext, checkers, suppress) -> Report:
             if not f.checker:
                 f = dataclasses.replace(f, checker=name)
             findings.append(f)
-    patterns = set(suppress) | _GLOBAL_SUPPRESS
+    return finalize_findings(findings, names, ctx, suppress, config)
+
+
+def finalize_findings(findings: List[Finding], names: Sequence[str],
+                      ctx, suppress: Sequence[str],
+                      config: Optional[dict] = None) -> Report:
+    """Shared report assembly (jaxpr tier and HLO tier): apply
+    .graphlintrc severity overrides, then suppression (per-call UNION rc
+    file UNION process-wide context), then the per-code cap."""
+    config = config or {}
+    findings = _apply_severity_overrides(findings,
+                                         config.get("severity", {}))
+    patterns = (set(suppress) | set(config.get("suppress", ()))
+                | _GLOBAL_SUPPRESS)
     kept, suppressed = [], 0
     per_code: Dict[Tuple[str, str], int] = {}
     cap = ctx.opt("max_findings_per_code")
@@ -401,17 +558,19 @@ def _run_checkers(ctx: CheckContext, checkers, suppress) -> Report:
 
 def analyze_jaxpr(closed_jaxpr, checkers: Optional[Sequence[str]] = None,
                   suppress: Sequence[str] = (), mesh=None,
-                  options: Optional[dict] = None) -> Report:
+                  options: Optional[dict] = None,
+                  config: Optional[dict] = None) -> Report:
     """Run checkers over an already-traced ClosedJaxpr."""
     ctx = CheckContext(closed_jaxpr=closed_jaxpr, mesh=mesh,
                        options=dict(options or {}))
-    return _run_checkers(ctx, checkers, suppress)
+    return _run_checkers(ctx, checkers, suppress, config)
 
 
 def analyze(fn, *args, checkers: Optional[Sequence[str]] = None,
             suppress: Sequence[str] = (), mesh=None,
             probe_args: Optional[Sequence[Tuple]] = None,
             options: Optional[dict] = None, static_argnums=(),
+            config: Optional[dict] = None,
             **kwargs) -> Report:
     """Trace `fn(*args, **kwargs)` to a jaxpr and run every registered
     checker (or the named subset) over it.
@@ -423,8 +582,10 @@ def analyze(fn, *args, checkers: Optional[Sequence[str]] = None,
 
     probe_args: optional extra argument tuples representing other call
     sites of the same fn; differing abstract signatures are reported as
-    recompile hazards (each signature compiles separately).
+    recompile hazards (each signature compiles separately) unless the
+    `expected_signatures` option covers them (the engine's bucket menu).
     suppress: per-call finding-code suppressions (exact or "PREFIX*").
+    config: a load_rcfile() dict (severity overrides + rc suppressions).
     """
     traced = functools.partial(fn, **kwargs) if kwargs else fn
     closed = jax.make_jaxpr(traced, static_argnums=static_argnums)(*args)
@@ -435,4 +596,4 @@ def analyze(fn, *args, checkers: Optional[Sequence[str]] = None,
         closed_jaxpr=closed, fn=fn, args=args, kwargs=kwargs, mesh=mesh,
         probe_signatures=sigs, options=dict(options or {}),
         arg_names=_arg_name_map(args, kwargs))
-    return _run_checkers(ctx, checkers, suppress)
+    return _run_checkers(ctx, checkers, suppress, config)

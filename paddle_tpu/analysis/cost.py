@@ -8,7 +8,11 @@ shapes.  Conventions:
   * conv_general_dilated: 2 * prod(out) * prod(kernel_spatial) * Cin / groups
   * everything else: max(prod(in), prod(out)) — one flop per element
   * bytes: sum of operand + result nbytes (a proxy for HBM traffic; XLA
-    fusion will beat this, but the *ranking* of heavy eqns survives)
+    fusion will beat this, but the *ranking* of heavy eqns survives) —
+    EXCEPT indexed copies (gather/scatter/dynamic slices), which count
+    only the bytes that move (2x slice/updates + indices): the engine's
+    KV page-swap path reads pages, not the whole pool.  Kernels may
+    register precise pallas bytes via `register_pallas_bytes`
   * scan bodies multiply by the static trip count; `while` bodies count
     once (trip counts are not static); both `cond` branches count (upper
     bound); pallas_call is opaque — kernels register their own FLOPs
@@ -24,10 +28,12 @@ import numpy as np
 from .core import aval_bytes, format_path, iter_eqns
 
 __all__ = ["eqn_flops", "eqn_bytes", "per_eqn_costs", "estimate",
-           "register_pallas_flops"]
+           "register_pallas_flops", "register_pallas_bytes"]
 
 # substring of the pallas kernel name -> fn(eqn) -> flops
 _PALLAS_FLOPS: Dict[str, Callable] = {}
+# substring of the pallas kernel name -> fn(eqn) -> bytes
+_PALLAS_BYTES: Dict[str, Callable] = {}
 
 
 def register_pallas_flops(name_substr: str, fn: Callable) -> None:
@@ -35,6 +41,14 @@ def register_pallas_flops(name_substr: str, fn: Callable) -> None:
     contains `name_substr`.  `fn(eqn) -> float` sees the raw eqn (shapes
     via eqn.invars/outvars avals)."""
     _PALLAS_FLOPS[name_substr] = fn
+
+
+def register_pallas_bytes(name_substr: str, fn: Callable) -> None:
+    """Register a BYTES (HBM traffic) estimator for pallas_call eqns —
+    the generic rule sums full operand avals, which wildly overstates a
+    kernel that random-accesses a big pool (paged attention touches
+    pages_per_seq pages, not the whole pool)."""
+    _PALLAS_BYTES[name_substr] = fn
 
 
 def _pallas_kernel_name(eqn) -> str:
@@ -97,6 +111,9 @@ def eqn_flops(eqn) -> float:
                     "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
                     "checkpoint", "closed_call", "core_call", "named_call"):
             return 0.0  # containers: cost lives in their sub-eqns
+        if prim in _DATA_MOVEMENT_PRIMS:
+            return 0.0  # pure copies (engine swap gather/scatter): the
+            # cost is bytes, not flops — see eqn_bytes
         ins = max((_numel(v.aval) for v in eqn.invars
                    if hasattr(v, "aval")), default=0)
         outs = max((_numel(v.aval) for v in eqn.outvars
@@ -106,8 +123,43 @@ def eqn_flops(eqn) -> float:
         return 0.0
 
 
+# compute-free indexed copies.  Their HBM traffic is what MOVES (slice /
+# updates + indices), not the operand pool: the engine's KV-swap path
+# (generation.gather_kv_pages / scatter_kv_pages over an (L, P, ps, Hkv,
+# D) pool) copies pages_per_seq pages, and summing whole-pool avals would
+# misrank it as the most expensive eqn in the serving path.  scatter-add
+# and friends stay on the generic rule (they do compute).
+_DATA_MOVEMENT_PRIMS = frozenset({
+    "gather", "scatter", "dynamic_slice", "dynamic_update_slice",
+})
+
+
+def _moved_bytes(eqn) -> int:
+    prim = eqn.primitive.name
+    if prim in ("gather", "dynamic_slice"):
+        moved = sum(aval_bytes(v.aval) for v in eqn.outvars
+                    if hasattr(v, "aval"))
+        idx = sum(aval_bytes(v.aval) for v in eqn.invars[1:]
+                  if hasattr(v, "aval"))
+    else:       # scatter: (operand, indices, updates); dus: (op, update, *)
+        upd = eqn.invars[2] if prim == "scatter" else eqn.invars[1]
+        moved = aval_bytes(upd.aval) if hasattr(upd, "aval") else 0
+        idx = (aval_bytes(eqn.invars[1].aval)
+               if prim == "scatter" and hasattr(eqn.invars[1], "aval")
+               else 0)
+    return 2 * moved + idx          # read source + write destination
+
+
 def eqn_bytes(eqn) -> int:
     try:
+        prim = eqn.primitive.name
+        if prim == "pallas_call":
+            name = _pallas_kernel_name(eqn)
+            for sub, fn in _PALLAS_BYTES.items():
+                if sub in name:
+                    return int(fn(eqn))
+        elif prim in _DATA_MOVEMENT_PRIMS:
+            return _moved_bytes(eqn)
         return sum(aval_bytes(v.aval) for v in list(eqn.invars)
                    + list(eqn.outvars) if hasattr(v, "aval"))
     except Exception:  # noqa: BLE001

@@ -1,0 +1,170 @@
+"""`--fix`: turn findings into concrete patch suggestions.
+
+The Graph Doctor diagnoses; this module prescribes.  `suggest_fixes`
+reads the structured `Finding.data` the checkers attach (exact argnums,
+byte counts, suggested bucket menus) and emits `Patch` objects whose
+`diff` is a unified-diff-STYLE snippet — not a literal patch against a
+file (the lint runs on traced functions, not source text), but the exact
+edit to make, named precisely enough to paste:
+
+    DONATION_MISSING        the donate_argnums=(...) tuple to add, with
+                            the exact argnums
+    SHARD_REPLICATED        the with_sharding_constraint insertion point
+    DTYPE_F64_PROMOTION /   the dtype-cast site (astype / jnp.float32
+    DTYPE_WEAK_F64 / INPUT  wrapper)
+    RECOMPILE_CONST_CAPTURE hoist-to-argument rewrite
+    RECOMPILE_BUCKET_MISS   the prefill_buckets menu edit
+
+`tools/graphlint.py --fix` prints these after the findings; the
+reference's pass pipeline APPLIES its rewrites — here the rewrite half
+stays with the human (jaxprs have no source locations to edit safely),
+but the suggestion is mechanical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+from .core import Finding, Report, fmt_bytes
+
+__all__ = ["Patch", "suggest_fixes", "format_patches"]
+
+
+@dataclasses.dataclass
+class Patch:
+    """One suggested edit: which findings it settles, and the edit."""
+
+    title: str
+    codes: List[str]
+    eqn_paths: List[str]
+    diff: str                   # unified-diff-style snippet
+    note: str = ""
+
+    def to_dict(self) -> dict:
+        return {"title": self.title, "codes": list(self.codes),
+                "eqn_paths": list(self.eqn_paths), "diff": self.diff,
+                "note": self.note}
+
+    def __str__(self):
+        lines = [f"fix: {self.title}"]
+        if self.note:
+            lines.append(f"  {self.note}")
+        lines += ["  " + ln for ln in self.diff.splitlines()]
+        return "\n".join(lines)
+
+
+def _donation_patches(findings: List[Finding]) -> List[Patch]:
+    """Group DONATION_MISSING per pjit EQN (eqn_path disambiguates two
+    jitted fns that share a __name__); one patch naming ALL argnums
+    (donate_argnums is one tuple per jit call)."""
+    by_fn: Dict[tuple, List[Finding]] = {}
+    for f in findings:
+        key = (f.eqn_path, str(f.data.get("jit_name", "?")))
+        by_fn.setdefault(key, []).append(f)
+    out = []
+    for (_path, fn_name), fs in by_fn.items():
+        argnums = sorted({f.data["argnum"] for f in fs
+                          if f.data.get("argnum") is not None})
+        args = ", ".join(f.data.get("arg", "?") for f in fs)
+        nbytes = sum(int(f.data.get("bytes", 0)) for f in fs)
+        if not argnums:
+            continue
+        tup = "(" + ", ".join(str(a) for a in argnums) + ",)" \
+            if len(argnums) == 1 else \
+            "(" + ", ".join(str(a) for a in argnums) + ")"
+        diff = (f"--- {fn_name} (copies {fmt_bytes(nbytes)}/step)\n"
+                f"+++ {fn_name} (updates in place)\n"
+                f"-@jax.jit\n"
+                f"+@functools.partial(jax.jit, donate_argnums={tup})\n"
+                f" def {fn_name}(...):")
+        out.append(Patch(
+            title=f"donate argnums {tup} of {fn_name!r}",
+            codes=["DONATION_MISSING"],
+            eqn_paths=[f.eqn_path for f in fs], diff=diff,
+            note=f"read-write args {args} aval-match outputs; donation "
+                 "lets XLA reuse their buffers instead of copying"))
+    return out
+
+
+def _shard_patch(f: Finding) -> Patch:
+    shape = f.message.split(" ", 1)[0]
+    diff = (" big = <the value created at the flagged eqn>\n"
+            "+big = jax.lax.with_sharding_constraint(\n"
+            "+    big, NamedSharding(mesh, P('data', None)))  "
+            "# pick the axis that matches its producers")
+    return Patch(
+        title=f"shard the replicated {shape} at {f.eqn_path}",
+        codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
+        note="any sharded PartitionSpec reaching the value stops GSPMD "
+             "from replicating it on every device")
+
+
+def _dtype_patch(f: Finding) -> Patch:
+    if f.code == "DTYPE_WEAK_F64":
+        diff = ("-y = x * 2.0                  # Python float leaks f64\n"
+                "+y = x * jnp.float32(2.0)")
+        note = "wrap leaked Python scalars in the intended dtype"
+    elif f.code == "DTYPE_F64_INPUT":
+        diff = ("-fn(x_f64)\n"
+                "+fn(x_f64.astype(jnp.float32))  # cast at the boundary")
+        note = "TPUs emulate f64 in software; cast inputs unless f64 is "\
+               "numerically required"
+    else:
+        diff = ("-wide = op(a, b)              # promotes to float64\n"
+                "+wide = op(a, b.astype(jnp.float32))")
+        note = "pin the f64 operand (np scalar / np.array default dtype /"\
+               " explicit astype) at the eqn path above"
+    return Patch(title=f"cast at {f.eqn_path} ({f.code})", codes=[f.code],
+                 eqn_paths=[f.eqn_path], diff=diff, note=note)
+
+
+def _const_capture_patch(f: Finding) -> Patch:
+    diff = ("-TABLE = jnp.asarray(...)        # captured: baked into the\n"
+            "-def fn(x): return x @ TABLE     # executable at trace time\n"
+            "+def fn(x, table): return x @ table  # jit caches shape/dtype")
+    return Patch(
+        title="pass the captured constant as an argument",
+        codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
+        note="a new value then reuses the compiled program instead of "
+             "retracing (and the executable stops embedding the data)")
+
+
+def _bucket_patch(f: Finding) -> Patch:
+    menu = f.data.get("menu")
+    suggested = f.data.get("suggested_menu")
+    if suggested is None:
+        diff = f"+prefill_buckets = {menu} + [<bucket covering the " \
+               f"length in the finding>]"
+    else:
+        diff = (f"-prefill_buckets = {menu}\n"
+                f"+prefill_buckets = {suggested}")
+    return Patch(
+        title="edit the prefill bucket menu",
+        codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
+        note="pass prefill_buckets=... to LLMEngine (and re-lint with "
+             "expected_prompt_lens to confirm the straddle is gone)")
+
+
+def suggest_fixes(report: Report) -> List[Patch]:
+    """Patches for every fixable finding in the report, most impactful
+    first (donation > sharding > dtype > recompile)."""
+    fixable = [f for f in report]
+    patches: List[Patch] = []
+    patches += _donation_patches(
+        [f for f in fixable if f.code == "DONATION_MISSING"])
+    patches += [_shard_patch(f) for f in fixable
+                if f.code == "SHARD_REPLICATED"]
+    patches += [_dtype_patch(f) for f in fixable
+                if f.code.startswith("DTYPE_")]
+    patches += [_const_capture_patch(f) for f in fixable
+                if f.code == "RECOMPILE_CONST_CAPTURE"]
+    patches += [_bucket_patch(f) for f in fixable
+                if f.code == "RECOMPILE_BUCKET_MISS"]
+    return patches
+
+
+def format_patches(patches: List[Patch]) -> str:
+    if not patches:
+        return "no auto-fixable findings"
+    return "\n\n".join(str(p) for p in patches)

@@ -1,0 +1,595 @@
+"""Graph Doctor tier 2: lint the COMPILED artifact, not just the trace.
+
+Jaxprs (tier 1, `checkers.py`) are pre-XLA: they cannot see fusion,
+layout, buffer-assignment, or collective-combining decisions — which is
+where TPU performance is actually won or lost (the TPU-MLIR / MPK lesson:
+lowering-level analysis catches what trace-level analysis structurally
+cannot).  This module lowers a target ONCE (`jax.jit(fn).lower(*args)`),
+keeps both artifacts —
+
+  * the StableHLO module text (pre-optimization, metadata-rich), and
+  * the optimized HLO text + `compiled.memory_analysis()` buffer stats —
+
+and runs a second checker registry over them:
+
+  fusion       FUSION_BREAK       chains of unfused elementwise ops in the
+                                  optimized module (each one a full HBM
+                                  round-trip a fused loop would elide)
+  collective   COLLECTIVE_SEQ     independent same-group all-reduce/
+                                  all-gathers that could combine into one
+  layout       LAYOUT_TRANSPOSE   materialized transposes / layout copies
+                                  that survived compilation on big arrays
+  hlo_memory   MEM_PEAK           buffer-assignment peak (args+temps+outs)
+               MEM_TEMP_BLOAT     temporaries dwarfing the live args/outs
+
+Nothing executes — `.lower()` + `.compile()` only.  Checkers parse the
+HLO *text* (the stable, version-tolerant surface; the in-memory HLO API
+is private and churns), so every finding degrades gracefully: a parse
+miss means a silent pass, never a crash.
+
+`lint_bucket_menu` is the shape-poly probe grown into menu planning: the
+LLMEngine hands it the prefill bucket menu plus an expected workload's
+prompt lengths, and lengths that STRADDLE a bucket edge (9 tokens riding
+a 16-wide compile next to 8-token traffic) come back as
+RECOMPILE_BUCKET_MISS with the concrete menu edit that merges them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+
+from .core import (
+    Finding, Report, Severity, finalize_findings, fmt_bytes,
+    _DEFAULT_OPTIONS,
+)
+
+__all__ = [
+    "analyze_hlo", "register_hlo_checker", "list_hlo_checkers",
+    "HLOContext", "lint_bucket_menu", "lower_target",
+]
+
+HLO_CHECKER_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_hlo_checker(name: str):
+    """Register an HLO-tier checker: fn(ctx: HLOContext) -> findings."""
+    def deco(fn):
+        HLO_CHECKER_REGISTRY[name] = fn
+        fn._checker_name = name
+        return fn
+    return deco
+
+
+def list_hlo_checkers() -> List[str]:
+    return sorted(HLO_CHECKER_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# HLO text parsing (optimized module)
+# ---------------------------------------------------------------------------
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16, "f8e4m3fn": 1, "f8e5m2": 1, "s4": 1, "u4": 1,
+}
+
+_SHAPE_RE = re.compile(r"^([a-z][a-z0-9]*)\[([\d,]*)\]")
+
+
+def shape_bytes(shape_str: str) -> int:
+    """Bytes of one HLO shape string ("f32[2,16]{1,0}"); tuples -> 0."""
+    m = _SHAPE_RE.match(shape_str)
+    if not m:
+        return 0
+    nbytes = _DTYPE_BYTES.get(m.group(1), 0)
+    for d in m.group(2).split(","):
+        if d:
+            nbytes *= int(d)
+    return nbytes
+
+
+@dataclasses.dataclass
+class HloInstr:
+    name: str
+    op: str
+    shape: str
+    nbytes: int
+    operands: List[str]          # referenced %names (instrs + computations)
+    op_name: str                 # metadata op_name ("" when absent)
+    comp: str
+    # typed operands as written: [(shape_str, %name)] — layout checks
+    # compare these {minor-to-major} braces against the result's
+    typed_operands: List[Tuple[str, str]] = dataclasses.field(
+        default_factory=list)
+
+    def layout(self) -> str:
+        return _layout_of(self.shape)
+
+
+def _layout_of(shape_str: str) -> str:
+    """The {minor-to-major} brace content of an HLO shape string."""
+    m = re.search(r"\{([\d,]*)\}", shape_str)
+    return m.group(1) if m else ""
+
+
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*.*\{")
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%([\w.\-]+)\s*=\s*(\S+)\s+([\w\-]+)\((.*)$")
+_METADATA_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def parse_hlo(text: str) -> Dict[str, List[HloInstr]]:
+    """{computation_name: [instrs]} for an optimized-HLO module dump.
+    Fusion computations keep their ``fused_`` names; callers use
+    `fused_computations` to exclude them."""
+    comps: Dict[str, List[HloInstr]] = {}
+    cur: Optional[str] = None
+    for line in text.splitlines():
+        if cur is None:
+            m = _COMP_RE.match(line.strip())
+            if m:
+                cur = m.group(2)
+                comps[cur] = []
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        m = _INSTR_RE.match(line)
+        if not m:
+            continue
+        name, shape, op, rest = m.groups()
+        meta = _METADATA_RE.search(rest)
+        # operand refs: %names before any metadata={...} block
+        op_part = rest.split("metadata=", 1)[0]
+        operands = re.findall(r"%([\w.\-]+)", op_part)
+        typed = re.findall(r"(\S+\[[^\]]*\](?:\{[\d,]*\})?)\s+%([\w.\-]+)",
+                           op_part)
+        comps[cur].append(HloInstr(
+            name=name, op=op, shape=shape, nbytes=shape_bytes(shape),
+            operands=operands, op_name=meta.group(1) if meta else "",
+            comp=cur, typed_operands=typed))
+    return comps
+
+
+def fused_computations(comps: Dict[str, List[HloInstr]]) -> set:
+    """Computations that run INSIDE a fusion (their instrs cost nothing
+    individually): named `fused_*` or referenced by a fusion's calls=."""
+    fused = {c for c in comps if c.startswith("fused_")}
+    for instrs in comps.values():
+        for ins in instrs:
+            if ins.op == "fusion":
+                fused.update(o for o in ins.operands if o in comps)
+    return fused
+
+
+# ---------------------------------------------------------------------------
+# context + entry point
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HLOContext:
+    """What HLO-tier checkers may inspect.  `optimized`/`memory_stats`
+    are None when compilation was skipped or failed (checkers needing
+    them must silently pass)."""
+
+    stablehlo: str
+    optimized: Optional[str] = None
+    memory_stats: Any = None
+    comps: Optional[Dict[str, List[HloInstr]]] = None
+    fn: Optional[Callable] = None
+    args: Tuple = ()
+    options: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def opt(self, key: str, default=None):
+        if key in self.options:
+            return self.options[key]
+        return _DEFAULT_OPTIONS.get(key, default)
+
+
+def lower_target(fn, *args, compile: bool = True, **kwargs):
+    """Lower (and optionally compile) once: returns
+    (stablehlo_text, optimized_text | None, memory_stats | None).
+    `fn` may already be jitted (uses its .lower) or plain (wrapped)."""
+    jfn = fn if hasattr(fn, "lower") else jax.jit(fn)
+    lowered = jfn.lower(*args, **kwargs)
+    stablehlo = lowered.as_text()
+    optimized = stats = None
+    if compile:
+        compiled = lowered.compile()
+        optimized = compiled.as_text()
+        try:
+            stats = compiled.memory_analysis()
+        except Exception:  # noqa: BLE001 — not all backends implement it
+            stats = None
+    return stablehlo, optimized, stats
+
+
+def analyze_hlo(fn, *args, checkers: Optional[Sequence[str]] = None,
+                suppress: Sequence[str] = (),
+                options: Optional[dict] = None,
+                config: Optional[dict] = None,
+                compile: bool = True, **kwargs) -> Report:
+    """Tier-2 analysis: lower `fn(*args)` once and run the HLO checker
+    registry over the StableHLO + optimized HLO + buffer stats.
+
+    Composes with tier 1 via `core.merge_reports(analyze(...),
+    analyze_hlo(...))` — tools/graphlint.py does exactly that per target.
+    """
+    stablehlo, optimized, stats = lower_target(
+        fn, *args, compile=compile, **kwargs)
+    return analyze_hlo_text(
+        stablehlo, optimized, memory_stats=stats, checkers=checkers,
+        suppress=suppress, options=options, config=config, fn=fn,
+        args=args)
+
+
+def analyze_hlo_text(stablehlo: str, optimized: Optional[str] = None,
+                     memory_stats: Any = None,
+                     checkers: Optional[Sequence[str]] = None,
+                     suppress: Sequence[str] = (),
+                     options: Optional[dict] = None,
+                     config: Optional[dict] = None,
+                     fn=None, args=()) -> Report:
+    """Run the HLO checkers over already-obtained artifacts (a saved
+    `.compile().as_text()` dump, a cross-compiled module, a test
+    fixture).  `analyze_hlo` is this plus the lowering."""
+    ctx = HLOContext(
+        stablehlo=stablehlo, optimized=optimized, memory_stats=memory_stats,
+        comps=parse_hlo(optimized) if optimized else None,
+        fn=fn, args=tuple(args), options=dict(options or {}))
+    names = list_hlo_checkers() if checkers is None else list(checkers)
+    findings: List[Finding] = []
+    for name in names:
+        if name not in HLO_CHECKER_REGISTRY:
+            raise ValueError(f"unknown HLO checker {name!r}; "
+                             f"available: {list_hlo_checkers()}")
+        for f in HLO_CHECKER_REGISTRY[name](ctx):
+            if not f.checker:
+                f = dataclasses.replace(f, checker=name)
+            findings.append(f)
+    return finalize_findings(findings, names, ctx, suppress, config)
+
+
+# ---------------------------------------------------------------------------
+# checker 1: FUSION_BREAK — unfused elementwise chains
+# ---------------------------------------------------------------------------
+
+_ELEMENTWISE = frozenset({
+    "add", "subtract", "multiply", "divide", "maximum", "minimum", "power",
+    "tanh", "exponential", "log", "negate", "abs", "rsqrt", "sqrt",
+    "logistic", "sign", "floor", "ceil", "round-nearest-even", "cosine",
+    "sine", "expm1", "log-plus-one", "select", "compare", "and", "or",
+    "xor", "not", "clamp",
+})
+
+# ops that forward a value without compute: a chain may thread through
+# them (optimization_barrier lowers to tuple/opt-barrier/get-tuple-element)
+_PASS_THROUGH = frozenset({
+    "bitcast", "copy", "tuple", "get-tuple-element", "opt-barrier",
+})
+
+
+@register_hlo_checker("fusion")
+def check_fusion(ctx: HLOContext):
+    if not ctx.comps:
+        return
+    min_b = ctx.opt("fusion_min_bytes")
+    min_len = ctx.opt("fusion_chain_min")
+    fused = fused_computations(ctx.comps)
+    n_fusions = sum(1 for instrs in ctx.comps.values()
+                    for i in instrs if i.op == "fusion")
+    for cname, instrs in ctx.comps.items():
+        if cname in fused:
+            continue
+        by_name = {i.name: i for i in instrs}
+
+        def resolve(name, depth=0):
+            """Follow pass-through ops back to a real producer."""
+            ins = by_name.get(name)
+            while ins is not None and ins.op in _PASS_THROUGH and depth < 8:
+                nxt = next((o for o in ins.operands if o in by_name), None)
+                if nxt is None:
+                    return ins
+                ins = by_name.get(nxt)
+                depth += 1
+            return ins
+
+        # longest unfused-elementwise chain ending at each instr
+        nodes = [i for i in instrs
+                 if i.op in _ELEMENTWISE and i.nbytes >= min_b]
+        node_names = {i.name for i in nodes}
+        chain: Dict[str, List[str]] = {}
+        for ins in instrs:            # program order = topological order
+            if ins.name not in node_names:
+                continue
+            best: List[str] = []
+            for o in ins.operands:
+                src = resolve(o)
+                if src is not None and src.name in chain \
+                        and len(chain[src.name]) > len(best):
+                    best = chain[src.name]
+            chain[ins.name] = best + [ins.name]
+        best_chain: List[str] = max(chain.values(), key=len, default=[])
+        if len(best_chain) >= min_len:
+            ops = [by_name[n].op for n in best_chain]
+            head = by_name[best_chain[0]]
+            yield Finding(
+                Severity.WARNING, "FUSION_BREAK", f"hlo:{cname}",
+                f"chain of {len(best_chain)} UNFUSED elementwise ops "
+                f"({'->'.join(ops[:6])}{'...' if len(ops) > 6 else ''}) on "
+                f"{head.shape.split('{')[0]} ({fmt_bytes(head.nbytes)}) — "
+                f"each op is a full HBM read+write a fused loop would "
+                f"elide (module has {n_fusions} fusions)",
+                "remove optimization_barrier/custom-call boundaries "
+                "between them, or restructure so XLA can fuse the chain",
+                data={"chain": [by_name[n].op for n in best_chain],
+                      "bytes": head.nbytes, "computation": cname,
+                      "fusions_in_module": n_fusions})
+
+
+# ---------------------------------------------------------------------------
+# checker 2: COLLECTIVE_SEQ — combinable adjacent collectives (StableHLO
+# tier: deterministic, pre-combiner; suggests combining at the SOURCE)
+# ---------------------------------------------------------------------------
+
+_COLLECTIVE_RE = re.compile(
+    r'%(\S+)\s*=\s*"stablehlo\.(all_reduce|all_gather|reduce_scatter)"'
+    r"\(([^)]*)\)")
+_REPLICA_RE = re.compile(r"replica_groups\s*=\s*dense<([^>]*)>")
+_RESULT_TY_RE = re.compile(r"->\s*tensor<([^>]+)>")
+
+
+@register_hlo_checker("collective")
+def check_collective(ctx: HLOContext):
+    min_b = ctx.opt("collective_min_bytes")
+    # SSA def-use over the whole module: value id -> collective ids it
+    # (transitively) depends on.  Dependent collectives cannot combine.
+    deps: Dict[str, set] = {}
+    coll: List[dict] = []          # in program order
+    lines = ctx.stablehlo.splitlines()
+    for ln, line in enumerate(lines):
+        s = line.strip()
+        # multi-result ops print as "%5:3 = ..." and are referenced as
+        # "%5#0" — track everything under the base id so a collective
+        # feeding a while/sort result still counts as a dependency
+        m = re.match(r"%([\w]+)(?::\d+)?\s*=", s)
+        if not m:
+            continue
+        rid = m.group(1)
+        operands = re.findall(r"%([\w#]+)", s.split("=", 1)[1])
+        d: set = set()
+        for o in operands:
+            d |= deps.get(o.split("#", 1)[0], set())
+        cm = _COLLECTIVE_RE.match(s)
+        if cm:
+            groups = _REPLICA_RE.search(s)
+            # the reduction region spans lines; the result type lives on
+            # the region's closing "}) : (...) -> tensor<...>" line
+            ty = _RESULT_TY_RE.search(s)
+            for look in lines[ln + 1:ln + 12]:
+                if ty is not None:
+                    break
+                if ") : (" in look or look.strip().startswith("}) :"):
+                    ty = _RESULT_TY_RE.search(look)
+                    break
+            nbytes = 0
+            if ty:
+                parts = ty.group(1).split("x")
+                nbytes = _DTYPE_BYTES.get(parts[-1], 0)
+                for p in parts[:-1]:
+                    if p.isdigit():
+                        nbytes *= int(p)
+            coll.append({"id": rid, "kind": cm.group(2),
+                         "groups": groups.group(1) if groups else "",
+                         "bytes": nbytes, "deps": set(d)})
+            d = d | {rid}
+        deps[rid] = d
+    by_key: Dict[Tuple[str, str], List[dict]] = {}
+    for c in coll:
+        by_key.setdefault((c["kind"], c["groups"]), []).append(c)
+    for (kind, groups), ops in by_key.items():
+        # greedy batch: later ops join unless they depend on a member
+        batch: List[dict] = []
+        for c in ops:
+            if all(b["id"] not in c["deps"] for b in batch):
+                batch.append(c)
+        total = sum(c["bytes"] for c in batch)
+        if len(batch) >= 2 and total >= min_b:
+            yield Finding(
+                Severity.WARNING, "COLLECTIVE_SEQ", f"stablehlo:{kind}",
+                f"{len(batch)} independent {kind} ops over identical "
+                f"replica groups ({fmt_bytes(total)} total) — each pays "
+                "its own latency + launch; one combined collective "
+                "moves the same bytes once",
+                "combine at the source: flatten+concatenate the operands "
+                "and issue one "
+                + {"all_reduce": "jax.lax.psum",
+                   "all_gather": "jax.lax.all_gather",
+                   "reduce_scatter": "jax.lax.psum_scatter"}[kind]
+                + " (a tuple psum still lowers to one collective per "
+                "leaf; XLA's combiner pass may batch small ones, but "
+                "upstream combining is guaranteed)",
+                data={"kind": kind, "count": len(batch), "bytes": total})
+
+
+# ---------------------------------------------------------------------------
+# checker 3: LAYOUT_TRANSPOSE — materialized transposes / layout copies
+# ---------------------------------------------------------------------------
+
+
+@register_hlo_checker("layout")
+def check_layout(ctx: HLOContext):
+    """Physical relayouts that survived compilation.  Two shapes:
+
+    * a `copy` whose operand {minor-to-major} layout differs from its
+      result's — the layout-assignment pass materializing a relayout
+      (counted even inside fusions: the copy is the fusion's real work);
+    * a standalone `transpose` at non-fused scope — a data shuffle no
+      consumer absorbed (a transpose folded into dot dimension numbers
+      or fused into a loop never appears standalone).
+    """
+    if not ctx.comps:
+        return
+    min_b = ctx.opt("layout_min_bytes")
+    fused = fused_computations(ctx.comps)
+    for cname, instrs in ctx.comps.items():
+        for ins in instrs:
+            if ins.nbytes < min_b:
+                continue
+            relayout = (ins.op == "copy" and ins.typed_operands
+                        and _layout_of(ins.typed_operands[0][0])
+                        != ins.layout())
+            standalone_t = ins.op == "transpose" and cname not in fused
+            if not (relayout or standalone_t):
+                continue
+            user_written = any(t in ins.op_name.lower()
+                               for t in ("transpose", "swapaxes", "permute"))
+            who = ("a user-written transpose XLA could not fold into its "
+                   "consumer" if user_written else
+                   "a compiler-inserted layout change (two consumers want "
+                   "different physical layouts)")
+            yield Finding(
+                Severity.WARNING, "LAYOUT_TRANSPOSE",
+                f"hlo:{cname}/{ins.op_name or ins.name}",
+                f"materialized {'relayout copy' if relayout else ins.op} "
+                f"of {ins.shape.split('{')[0]} ({fmt_bytes(ins.nbytes)}) "
+                f"survived compilation — {who}; on TPU this is a full "
+                "relayout through HBM on the hot path",
+                "reorder the einsum/dot dims so the transpose folds into "
+                "dimension numbers, or keep the tensor in one layout "
+                "end-to-end",
+                data={"op": ins.op, "bytes": ins.nbytes,
+                      "op_name": ins.op_name, "relayout": relayout,
+                      "user_written": user_written})
+
+
+# ---------------------------------------------------------------------------
+# checker 4: MEM_PEAK / MEM_TEMP_BLOAT — buffer-assignment ground truth
+# ---------------------------------------------------------------------------
+
+
+@register_hlo_checker("hlo_memory")
+def check_hlo_memory(ctx: HLOContext):
+    st = ctx.memory_stats
+    if st is None:
+        return
+    arg = int(getattr(st, "argument_size_in_bytes", 0) or 0)
+    out = int(getattr(st, "output_size_in_bytes", 0) or 0)
+    temp = int(getattr(st, "temp_size_in_bytes", 0) or 0)
+    alias = int(getattr(st, "alias_size_in_bytes", 0) or 0)
+    peak = arg + out - alias + temp
+    data = {"argument_size_in_bytes": arg, "output_size_in_bytes": out,
+            "temp_size_in_bytes": temp, "alias_size_in_bytes": alias,
+            "peak_bytes": peak}
+    budget = ctx.opt("mem_peak_budget_bytes")
+    over = budget is not None and peak > int(budget)
+    yield Finding(
+        Severity.WARNING if over else Severity.INFO, "MEM_PEAK",
+        "hlo:<buffer-assignment>",
+        f"compiled peak ~{fmt_bytes(peak)} (args {fmt_bytes(arg)} "
+        f"[{fmt_bytes(alias)} aliased] + temps {fmt_bytes(temp)} + "
+        f"outputs {fmt_bytes(out)})"
+        + (f" — exceeds the configured budget {fmt_bytes(int(budget))}"
+           if over else ""),
+        ("donate read-write args, shard the model, or rematerialize "
+         "the biggest liveness peak" if over else ""),
+        data=data)
+    ratio = ctx.opt("mem_temp_bloat_ratio")
+    floor = ctx.opt("mem_temp_min_bytes")
+    live_io = max(arg + out - alias, 1)
+    if temp >= floor and temp > ratio * live_io:
+        yield Finding(
+            Severity.WARNING, "MEM_TEMP_BLOAT", "hlo:<buffer-assignment>",
+            f"temporaries ({fmt_bytes(temp)}) are {temp / live_io:.1f}x "
+            f"the live args+outputs ({fmt_bytes(live_io)}) — the program's "
+            "footprint is dominated by intermediates buffer assignment "
+            "could not elide",
+            "rematerialize (jax.checkpoint) the producing region, fuse "
+            "reductions into producers, or donate buffers so XLA can "
+            "reuse them; profiler.static_memory attributes the peak to "
+            "an eqn path",
+            data=data)
+
+
+# ---------------------------------------------------------------------------
+# bucket-menu lint (the shape-poly probe grown into menu planning)
+# ---------------------------------------------------------------------------
+
+
+def lint_bucket_menu(menu: Sequence[int], workload_lens: Sequence[int],
+                     suppress: Sequence[str] = (),
+                     options: Optional[dict] = None,
+                     config: Optional[dict] = None) -> Report:
+    """Lint a prefill bucket menu against an expected workload.
+
+    Every distinct bucket is one compiled executable; every token of
+    padding is wasted prefill compute.  A workload whose lengths STRADDLE
+    a bucket edge (all lengths in the upper bucket sit within
+    `bucket_straddle_slack` * the lower edge) pays BOTH costs for nothing:
+    near-identical requests compile twice and the longer ones pad nearly
+    2x.  Emits RECOMPILE_BUCKET_MISS with the concrete menu edit (merge
+    the two buckets into one sized to the real lengths, aligned to
+    `bucket_align`).  LLMEngine runs this at construction when handed
+    `expected_prompt_lens`.
+    """
+    ctx = HLOContext(stablehlo="", options=dict(options or {}))
+    menu = sorted(set(int(b) for b in menu))
+    findings: List[Finding] = []
+    if not menu:
+        raise ValueError("bucket menu is empty")
+    by_bucket: Dict[int, List[int]] = {}
+    for n in workload_lens:
+        n = int(n)
+        b = next((b for b in menu if b >= n), None)
+        if b is None:
+            findings.append(Finding(
+                Severity.WARNING, "RECOMPILE_BUCKET_MISS", "<menu>",
+                f"workload length {n} exceeds the largest bucket "
+                f"{menu[-1]} — the request cannot be served by any "
+                "compiled prefill",
+                f"extend the menu past {n} (e.g. append "
+                f"{_round_up(n, ctx.opt('bucket_align'))})",
+                data={"menu": menu, "length": n}))
+            continue
+        by_bucket.setdefault(b, []).append(n)
+    used = sorted(by_bucket)
+    slack = float(ctx.opt("bucket_straddle_slack"))
+    align = int(ctx.opt("bucket_align"))
+    for lo, hi in zip(used, used[1:]):
+        if menu.index(hi) != menu.index(lo) + 1:
+            continue                # not adjacent in the menu
+        hi_lens = by_bucket[hi]
+        if max(hi_lens) > slack * lo:
+            continue                # genuinely longer traffic, not straddle
+        merged = sorted(by_bucket[lo] + hi_lens)
+        new_b = _round_up(max(merged), align)
+        # widen lo -> new_b so the whole straddle group shares ONE
+        # compile; hi (and everything above) stays in the menu — unused
+        # buckets compile lazily so keeping them is free, and dropping
+        # the top bucket would shrink the menu's coverage (the engine
+        # validates max(menu) >= max_seq_len and would reject the edit)
+        suggested = sorted((set(menu) - {lo}) | {new_b})
+        findings.append(Finding(
+            Severity.WARNING, "RECOMPILE_BUCKET_MISS", "<menu>",
+            f"prompt lengths {merged} straddle the {lo}/{hi} bucket edge: "
+            f"lengths {sorted(hi_lens)} pay a {hi}-wide prefill "
+            f"({hi / max(hi_lens):.2f}x padding) one compile apart from "
+            f"their {lo}-bucket neighbours",
+            f"widen bucket {lo} to {new_b} so the straddle group shares "
+            f"one executable: prefill_buckets={suggested} "
+            f"(<={new_b / max(min(merged), 1):.2f}x padding)",
+            data={"menu": menu, "straddle_lens": merged,
+                  "edge": [lo, hi], "suggested_menu": suggested}))
+    return finalize_findings(findings, ["bucket_menu"], ctx, suppress,
+                             config)
+
+
+def _round_up(n: int, align: int) -> int:
+    align = max(1, int(align))
+    return -(-int(n) // align) * align

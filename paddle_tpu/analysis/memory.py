@@ -1,0 +1,259 @@
+"""Static memory-liveness estimation over jaxprs.
+
+The jaxpr half of the Graph Doctor's memory story (the HLO half reads
+XLA's buffer assignment via `compiled.memory_analysis()` in `hlo.py`).
+Jaxprs are pre-buffer-assignment, so this walker can only ESTIMATE peak
+live bytes — but unlike the compiled number it is attributable: the peak
+comes with the `eqn_path` that produced it, so "your step peaks at 31 GiB"
+becomes "the attention residuals inside `scan:layers/body` do".
+
+Model (documented so the 2x-of-XLA acceptance bound is interpretable):
+
+  * a value is live from the eqn that creates it to its last use;
+  * NON-donated top-level args stay live for the whole program (the
+    caller owns the buffer; XLA cannot reuse it) — donated args may
+    ALIAS an output: at their last use they free BEFORE the eqn's
+    outputs materialize, which is exactly what donation buys;
+  * a traced jitted fn is one top-level pjit eqn: the walker descends
+    into it with that eqn's `donated_invars` mask, so the estimate is
+    the jitted program's, not the trivial wrapper's;
+  * jaxpr outvars stay live to the end (they are the result);
+  * scan/while bodies reuse one iteration's buffers across trips (memory
+    does NOT scale with trip count — only the stacked ys do, and those
+    are the scan eqn's outvars); the body's internal peak is measured
+    recursively and added at the scan point;
+  * `cond` takes the max across branches; pallas_call is opaque (its
+    scratch is per-grid-step and registered kernels account their own
+    cost) — operands/results are already counted.
+
+XLA's fusion will beat these numbers (fused producers never materialize);
+buffer assignment's padding/alignment will worsen them.  Empirically the
+estimate lands within ~2x of `temp_size + output_size + aliased args`
+for the shipped models, which is enough to rank models, catch a
+temp-bloat regression in CI, and attribute it to source.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from .core import (
+    CheckContext, Finding, Severity, aval_bytes, fmt_bytes, format_path,
+    is_array_var, register_checker, sub_jaxprs, _as_open,
+)
+
+__all__ = ["MemoryEstimate", "estimate", "jaxpr_memory"]
+
+
+@dataclasses.dataclass
+class MemoryEstimate:
+    """Static peak-live-bytes estimate with attribution."""
+
+    peak_bytes: int
+    peak_path: str              # eqn_path live at the peak
+    args_bytes: int             # all top-level args (donated + not)
+    donated_bytes: int          # of which donated (die at last use)
+    consts_bytes: int           # captured constants (always live)
+    out_bytes: int              # program outputs
+    top: List[dict] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {"peak_bytes": self.peak_bytes, "peak_path": self.peak_path,
+                "args_bytes": self.args_bytes,
+                "donated_bytes": self.donated_bytes,
+                "consts_bytes": self.consts_bytes,
+                "out_bytes": self.out_bytes, "top": list(self.top)}
+
+
+def _var_bytes(v) -> int:
+    return aval_bytes(v.aval) if is_array_var(v) else 0
+
+
+def _walk(jaxpr, donated: List[bool], path: Tuple[str, ...],
+          record: Optional[List[Tuple[int, str]]], depth: int,
+          ) -> Tuple[int, str, int]:
+    """Peak live bytes of one (open) jaxpr, its invars counted as live.
+
+    Returns (peak, peak_path, invars_bytes).  `donated[i]` marks invars
+    that may die at last use; non-donated invars and the jaxpr's outvars
+    are pinned.  `record` (top level only) collects (live_bytes, path)
+    samples for the top-k table.
+    """
+    jaxpr = _as_open(jaxpr)
+    eqns = jaxpr.eqns
+    n = len(eqns)
+
+    # last use index per var (invars + produced); pinned vars use `n`
+    pinned = set()
+    donated_set = set()
+    for v, d in zip(jaxpr.invars, donated):
+        if not is_array_var(v):
+            continue
+        if d:
+            donated_set.add(v)
+        else:
+            pinned.add(v)
+    pinned.update(v for v in jaxpr.outvars if is_array_var(v))
+    last_use: Dict[Any, int] = {}
+    for i, eqn in enumerate(eqns):
+        for v in eqn.invars:
+            if is_array_var(v):
+                last_use[v] = i
+
+    invars_b = sum(_var_bytes(v) for v in jaxpr.invars)
+    live = invars_b
+    peak, peak_path = live, format_path(path) + ":<args>"
+    if record is not None:
+        record.append((live, peak_path))
+
+    for i, eqn in enumerate(eqns):
+        # donated args at their LAST use free before the outputs
+        # materialize — the output may alias their buffer (what
+        # donate_argnums buys); everything else stays live while the
+        # eqn reads it
+        for v in eqn.invars:
+            if is_array_var(v) and v in donated_set and v not in pinned \
+                    and last_use.get(v) == i:
+                live -= _var_bytes(v)
+                pinned.add(v)
+        out_b = sum(_var_bytes(v) for v in eqn.outvars)
+        live += out_b
+        eqn_label = format_path(path, eqn)
+        attr = eqn_label            # where a new peak is attributed
+
+        # recurse: the body's internal temporaries spike live memory at
+        # this point.  Body invars alias eqn invars (already counted), so
+        # subtract them from the sub-peak; pjit bodies keep their own
+        # donation mask, loop bodies reuse one iteration's buffers.
+        sub_extra = 0
+        if depth > 0:
+            for sublabel, sub, _w in sub_jaxprs(eqn):
+                sub_open = _as_open(sub)
+                mask = eqn.params.get("donated_invars") \
+                    if eqn.primitive.name == "pjit" else None
+                if mask is None or len(mask) != len(sub_open.invars):
+                    mask = [True] * len(sub_open.invars)
+                sp, spp, sb = _walk(
+                    sub, list(mask),
+                    path + (eqn_label.split("/")[-1], sublabel),
+                    None, depth - 1)
+                extra = max(0, sp - sb)
+                if extra > sub_extra:
+                    sub_extra = extra
+                    if live + extra > peak:
+                        attr = spp  # attribute into the body
+
+        cand = live + sub_extra
+        if record is not None:
+            record.append((cand, eqn_label))
+        if cand > peak:
+            peak, peak_path = cand, attr
+
+        # free values whose last use was this eqn (incl. dead outvars)
+        for v in eqn.invars:
+            if is_array_var(v) and v not in pinned \
+                    and last_use.get(v) == i:
+                live -= _var_bytes(v)
+                pinned.add(v)      # freed once, never again
+        for v in eqn.outvars:
+            if is_array_var(v) and v not in pinned \
+                    and last_use.get(v, i) == i:
+                live -= _var_bytes(v)
+                pinned.add(v)
+    return peak, peak_path, invars_b
+
+
+def jaxpr_memory(closed_jaxpr, donated_invars: Optional[List[bool]] = None,
+                 top_k: int = 3, max_depth: int = 16) -> MemoryEstimate:
+    """Estimate peak live bytes of an already-traced ClosedJaxpr.
+
+    donated_invars: per-invar donation mask.  When None and the jaxpr is
+    a single top-level pjit eqn (a traced jitted fn), the mask is read
+    off that eqn's `donated_invars` — the common `analyze(jitted_fn, ...)`
+    shape; otherwise nothing is donated (conservative).
+    """
+    jaxpr = closed_jaxpr.jaxpr
+    consts_b = sum(int(getattr(c, "nbytes", 0) or 0)
+                   for c in closed_jaxpr.consts)
+    donated = donated_invars
+    path: Tuple[str, ...] = ()
+    if donated is None:
+        donated = [False] * len(jaxpr.invars)
+        # a traced jitted fn is one pjit eqn wrapping everything: walk
+        # the INNER program under that eqn's donation mask (the outer
+        # wrapper would hide both the donation and the real liveness)
+        if len(jaxpr.eqns) == 1 and jaxpr.eqns[0].primitive.name == "pjit":
+            eqn = jaxpr.eqns[0]
+            inner = eqn.params.get("jaxpr")
+            mask = eqn.params.get("donated_invars")
+            if inner is not None:
+                inner_open = _as_open(inner)
+                if mask is None or len(mask) != len(inner_open.invars):
+                    mask = [False] * len(inner_open.invars)
+                consts_b += sum(
+                    int(getattr(c, "nbytes", 0) or 0)
+                    for c in getattr(inner, "consts", ()))
+                jaxpr, donated = inner_open, list(mask)
+                path = (f"pjit:{eqn.params.get('name', '')}",)
+    record: List[Tuple[int, str]] = []
+    peak, peak_path, _ = _walk(jaxpr, donated, path, record, max_depth)
+    record.sort(key=lambda t: -t[0])
+    seen, top = set(), []
+    for b, p in record:
+        if p in seen:
+            continue
+        seen.add(p)
+        top.append({"live_bytes": int(b), "path": p})
+        if len(top) >= top_k:
+            break
+    return MemoryEstimate(
+        peak_bytes=int(peak + consts_b), peak_path=peak_path,
+        args_bytes=sum(_var_bytes(v) for v in jaxpr.invars),
+        donated_bytes=sum(_var_bytes(v)
+                          for v, d in zip(jaxpr.invars, donated) if d),
+        consts_bytes=int(consts_b),
+        out_bytes=sum(_var_bytes(v) for v in jaxpr.outvars),
+        top=top)
+
+
+def estimate(fn_or_jaxpr, *args, top_k: int = 3, **kwargs) -> dict:
+    """profiler.static_memory: trace `fn(*args)` (or take a ClosedJaxpr)
+    and return the MemoryEstimate as a dict.  Nothing executes."""
+    if args or kwargs or callable(fn_or_jaxpr):
+        import functools
+        traced = (functools.partial(fn_or_jaxpr, **kwargs) if kwargs
+                  else fn_or_jaxpr)
+        closed = jax.make_jaxpr(traced)(*args)
+    else:
+        closed = fn_or_jaxpr
+    return jaxpr_memory(closed, top_k=top_k).to_dict()
+
+
+# ---------------------------------------------------------------------------
+# checker: MEM_PEAK (INFO always — the number every report should carry;
+# WARNING when a budget is configured and exceeded)
+# ---------------------------------------------------------------------------
+
+
+@register_checker("memory")
+def check_memory(ctx: CheckContext):
+    est = jaxpr_memory(ctx.closed_jaxpr, top_k=ctx.opt("memory_top_k"))
+    budget = ctx.opt("mem_peak_budget_bytes")
+    over = budget is not None and est.peak_bytes > int(budget)
+    msg = (f"static peak live ~{fmt_bytes(est.peak_bytes)} at "
+           f"{est.peak_path} (args {fmt_bytes(est.args_bytes)}, "
+           f"{fmt_bytes(est.donated_bytes)} donated; outputs "
+           f"{fmt_bytes(est.out_bytes)})")
+    if over:
+        msg += f" — exceeds the configured budget {fmt_bytes(int(budget))}"
+    yield Finding(
+        Severity.WARNING if over else Severity.INFO, "MEM_PEAK",
+        est.peak_path, msg,
+        ("donate read-write args, shard or re-materialize the live set at "
+         "the peak path, or raise the budget" if over else
+         "profiler.static_memory(fn, *args) returns the same estimate "
+         "as data"),
+        data=est.to_dict())

@@ -171,11 +171,19 @@ class _SlotState:
         self.admit_seq = admit_seq  # admission order (victim policy)
 
 
-def _bucket(n: int, lo: int = 8) -> int:
-    b = lo
-    while b < n:
+def default_prefill_buckets(max_seq_len: int, rope_len: int,
+                            lo: int = 8) -> List[int]:
+    """The engine's default prefill compile menu: powers of two from `lo`
+    up to max_seq_len, the top bucket clamped to the rope table (a
+    non-power-of-2 max_position_embeddings would otherwise over-slice
+    it).  Every distinct bucket is one compiled prefill executable."""
+    menu, b = [], lo
+    while True:
+        menu.append(min(b, rope_len))
+        if b >= max_seq_len:
+            break
         b *= 2
-    return b
+    return sorted(set(menu))
 
 
 class LLMEngine:
@@ -192,6 +200,17 @@ class LLMEngine:
     resume).  victim_policy: "latest" (latest-admitted) or "fewest_tokens"
     (least work lost).  max_pending bounds the queue (QueueFull beyond).
     faults: an optional paddle_tpu.inference.faults.FaultInjector.
+
+    prefill_buckets: the prefill COMPILE MENU — every prompt (and every
+    recompute-resume) right-pads to the smallest bucket >= its length,
+    so each distinct bucket is exactly one compiled prefill executable.
+    Default: powers of two up to max_seq_len (top clamped to the rope
+    table).  expected_prompt_lens: an optional workload sample; when
+    given, the menu is LINTED at construction (analysis.lint_bucket_menu)
+    and lengths straddling a bucket edge raise a RECOMPILE_BUCKET_MISS
+    warning carrying the suggested menu edit (`engine.bucket_report`
+    holds the full report; `prefill_probe_args()` feeds the same menu to
+    the Graph Doctor's shape-poly probe).
     """
 
     def __init__(self, params, config, num_slots: int = 4,
@@ -201,7 +220,9 @@ class LLMEngine:
                  max_pending: Optional[int] = None,
                  preempt_mode: str = "swap",
                  victim_policy: str = "latest",
-                 faults=None):
+                 faults=None,
+                 prefill_buckets: Optional[Sequence[int]] = None,
+                 expected_prompt_lens: Optional[Sequence[int]] = None):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -220,6 +241,36 @@ class LLMEngine:
         self.victim_policy = victim_policy
         self.max_pending = None if max_pending is None else int(max_pending)
         self.faults = faults
+        rope_len = config.max_position_embeddings
+        if prefill_buckets is None:
+            self.prefill_buckets = default_prefill_buckets(
+                self.max_seq_len, rope_len)
+        else:
+            self.prefill_buckets = sorted({int(b) for b in prefill_buckets})
+            if not self.prefill_buckets:
+                raise ValueError("prefill_buckets must not be empty")
+            if self.prefill_buckets[-1] < self.max_seq_len:
+                raise ValueError(
+                    f"largest prefill bucket {self.prefill_buckets[-1]} < "
+                    f"max_seq_len={self.max_seq_len}: a worst-case resume "
+                    "could not re-prefill")
+            if self.prefill_buckets[-1] > rope_len:
+                raise ValueError(
+                    f"prefill bucket {self.prefill_buckets[-1]} exceeds the "
+                    f"rope table (max_position_embeddings={rope_len})")
+        self.bucket_report = None
+        if expected_prompt_lens is not None:
+            from .. import analysis
+
+            self.bucket_report = analysis.lint_bucket_menu(
+                self.prefill_buckets, expected_prompt_lens,
+                options={"bucket_align": max(4, int(page_size))})
+            for f in self.bucket_report:
+                if f.severity >= analysis.Severity.WARNING:
+                    import warnings
+
+                    warnings.warn(f"LLMEngine bucket menu: {f}",
+                                  stacklevel=2)
         pages_per_seq = -(-self.max_seq_len // page_size)
         if num_pages is None:
             num_pages = 1 + num_slots * pages_per_seq   # full provisioning
@@ -289,6 +340,37 @@ class LLMEngine:
             return pools["k"], pools["v"]
 
         self._swap_in = _swap_in
+
+    def _bucket_for(self, n: int) -> int:
+        """Smallest menu bucket >= n (exists: the menu covers
+        max_seq_len, and submit() validates n <= max_seq_len)."""
+        for b in self.prefill_buckets:
+            if b >= n:
+                return b
+        return self.prefill_buckets[-1]
+
+    def prefill_probe_args(self) -> List[tuple]:
+        """One abstract `_prefill` arg tuple per menu bucket — the Graph
+        Doctor's shape-poly probe: `analysis.analyze(engine._prefill,
+        *args[0], probe_args=args[1:], options={"expected_signatures":
+        len(engine.prefill_buckets)})` passes while the menu's compiles
+        are the ONLY distinct signatures.  The gate is COUNT-based: to
+        lint real traffic, probe the real call sites TOGETHER with this
+        full menu (any signature outside the menu then exceeds the
+        expected count and fires RECOMPILE_SHAPE_POLY)."""
+        pools = self.cache.pools
+        out = []
+        for b in self.prefill_buckets:
+            out.append((
+                self.params,
+                jax.ShapeDtypeStruct((1, b), jnp.int32),
+                jax.ShapeDtypeStruct(pools["k"].shape, pools["k"].dtype),
+                jax.ShapeDtypeStruct(pools["v"].shape, pools["v"].dtype),
+                jax.ShapeDtypeStruct((1, self.cache.pages_per_seq),
+                                     jnp.int32),
+                jax.ShapeDtypeStruct((), jnp.int32),
+            ))
+        return out
 
     # -- client surface -----------------------------------------------------
 
@@ -601,9 +683,10 @@ class LLMEngine:
         S = req.prompt.size
         self._fire("page_alloc", slot=slot, n_tokens=S)
         cache.ensure_capacity(slot, S)
-        # clamp the bucket to the rope table (non-power-of-2
-        # max_position_embeddings would otherwise over-slice it)
-        Sb = min(_bucket(S), self.config.max_position_embeddings)
+        # menu lookup (the default menu's top bucket is clamped to the
+        # rope table — a non-pow2 max_position_embeddings would
+        # otherwise over-slice it)
+        Sb = self._bucket_for(S)
         ids = np.zeros((1, Sb), np.int32)
         ids[0, :S] = req.prompt
         self._fire("prefill", slot=slot, pools=cache.pools)
@@ -651,7 +734,7 @@ class LLMEngine:
             # re-prefill it through the same bucketed path admission uses
             ids_np = np.concatenate(
                 [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
-            Sb = min(_bucket(rs.ctx), self.config.max_position_embeddings)
+            Sb = self._bucket_for(rs.ctx)
             ids = np.zeros((1, Sb), np.int32)
             ids[0, :rs.ctx] = ids_np
             self._fire("prefill", slot=slot, pools=cache.pools)

@@ -389,6 +389,20 @@ def _register_graphlint_costs() -> None:
         max_len = pt.shape[1] * kp.shape[1]
         return 4.0 * B * hkv * rep * D * max_len
 
+    def _paged_bytes(eqn):
+        # the kernel reads each sequence's TABLE pages (scalar-prefetched
+        # page table), never the whole (P, ps, Hkv, D) pool the generic
+        # whole-aval rule would charge — the pool is sized for worst-case
+        # occupancy, the traffic is sized for the batch's pages
+        q, kp = eqn.invars[2].aval, eqn.invars[3].aval
+        pt = eqn.invars[1].aval
+        B, pps = pt.shape
+        _P, ps, hkv, D = kp.shape
+        kv_read = 2 * B * pps * ps * hkv * D * _np.dtype(kp.dtype).itemsize
+        q_io = 2 * int(_np.prod(q.shape, dtype=_np.int64)) \
+            * _np.dtype(q.dtype).itemsize
+        return float(kv_read + q_io + 4 * B * (pps + 1))
+
     def _gmm(eqn):
         # x (Mp, K) @ per-group w (X, K, N) -> (Mp, N): dense-equivalent
         x = next(v.aval for v in eqn.invars if len(v.aval.shape) == 2
@@ -414,6 +428,7 @@ def _register_graphlint_costs() -> None:
     _cost.register_pallas_flops("_dq_kernel", _attention_file)
     _cost.register_pallas_flops("_dkv_kernel", _attention_file)
     _cost.register_pallas_flops("_paged_kernel", _paged)
+    _cost.register_pallas_bytes("_paged_kernel", _paged_bytes)
     _cost.register_pallas_flops("_gmm_kernel", _gmm)
     _cost.register_pallas_flops("_tgmm_kernel", _tgmm)
     _cost.register_pallas_flops("pallas_norm.py", _norm_file)

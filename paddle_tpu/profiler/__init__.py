@@ -24,7 +24,9 @@ from .timer import Timer  # noqa: F401
 _global_timer = Timer()
 
 from . import utils  # noqa: E402,F401
-from .utils import RecordEvent, benchmark, static_cost  # noqa: E402,F401
+from .utils import (  # noqa: E402,F401
+    RecordEvent, benchmark, static_cost, static_memory,
+)
 
 
 class ProfilerState(enum.Enum):

@@ -197,9 +197,17 @@ class Program:
         nothing executes); `fetch_list` defaults to the last op's outputs,
         like the passes' target rule.  Extra kwargs (checkers=, suppress=,
         options=, ...) pass through to analysis.analyze; returns a Report.
+
+        The nearest `.graphlintrc` (walking up from cwd) is auto-loaded
+        for project suppressions/severity overrides unless an explicit
+        `config=` is passed; per-call `suppress=` unions on top of it.
         """
         from .. import analysis
 
+        if "config" not in analyze_kwargs:
+            rc = analysis.find_rcfile()
+            if rc is not None:
+                analyze_kwargs["config"] = analysis.load_rcfile(rc)
         feed = dict(feed or {})
         for name, ph in self.placeholders.items():
             feed.setdefault(name, ph)

@@ -544,7 +544,8 @@ def test_shipped_model_lints_clean(target):
     fn, args, extra = _graphlint.TARGETS[target]()
     report = analysis.analyze(
         fn, *args, suppress=list(_graphlint.SHIPPED_SUPPRESSIONS),
-        mesh=extra.get("mesh"))
+        mesh=extra.get("mesh"), probe_args=extra.get("probe_args"),
+        options=extra.get("options"))
     bad = [str(f) for f in report if f.severity >= Severity.WARNING]
     assert report.ok(Severity.WARNING), \
         f"{target} has undocumented findings:\n" + "\n".join(bad)

@@ -266,7 +266,7 @@ class TestLLMEngine:
         eng = LLMEngine(params, cfg48, num_slots=1, page_size=8,
                         max_seq_len=48)
         prompt = np.random.default_rng(3).integers(
-            0, cfg.vocab_size, 40).tolist()  # _bucket(40)=64 > 48
+            0, cfg.vocab_size, 40).tolist()  # pow2 bucket 64 clamps to 48
         got = eng.generate([prompt], max_new_tokens=4)[0]
         want = np.asarray(generation.generate(
             params, jnp.asarray([prompt], jnp.int32), cfg48,

@@ -1,6 +1,8 @@
 #!/usr/bin/env python
 """graphlint — run the Graph Doctor (paddle_tpu.analysis) over the shipped
-bench models end to end.
+bench models end to end, BOTH tiers: the jaxpr walk (trace-level) and the
+HLO pass (each target lowered + compiled once; fusion / collective /
+layout / buffer-assignment findings the trace cannot see).
 
 Targets (default: all):
   llama              ShardedTrainState train step, LlamaConfig.tiny
@@ -8,18 +10,33 @@ Targets (default: all):
   moe_llama_scatter  MoE train step, capacity-based scatter dispatch
   generate_paged     paged-KV single-shot generation (prefill + decode scan)
   engine_decode      LLMEngine's jitted continuous-batching decode step
-  engine_prefill     LLMEngine's jitted admission prefill
+  engine_prefill     LLMEngine's jitted admission prefill (the bucket menu
+                     rides the shape-poly probe: its compiles are expected)
   engine_swap_out    LLMEngine's preemption page-gather (KV -> host)
   engine_swap_in     LLMEngine's resume page-scatter (host -> fresh pages)
 
 Usage:
-  python tools/graphlint.py [targets...] [--json] [--verbose]
-                            [--suppress CODE[@pathglob]]... [--fail-on LEVEL]
+  python tools/graphlint.py [targets...] [--json] [--verbose] [--fix]
+                            [--suppress CODE[@pathglob]]... [--fail-on LVL]
+                            [--no-hlo] [--config RC]
+                            [--baseline B.json | --write-baseline B.json]
 
 Exit code is 0 when every target is clean at --fail-on (default: warning)
 after suppressions, 1 otherwise.  --json emits one machine-readable object
-(finding lists + counts per target) so BENCH rounds can track finding
-counts alongside perf numbers.
+(finding lists + counts + jaxpr-tier mem_peak_bytes per target) so BENCH
+rounds can track lint drift and the memory-peak trend alongside perf.
+
+--fix prints concrete patch suggestions (exact donate_argnums, constraint
+insertion points, bucket-menu edits) for the fixable findings.
+
+--baseline B.json flips to DIFF mode for CI: exit 0 while no target grows
+a finding code (or escalates one's severity) beyond the stored snapshot,
+exit 1 listing what is new; pre-existing findings don't re-fail the run.
+--write-baseline records the current state.
+
+A `.graphlintrc` at the repo root (or --config PATH) adds project-level
+suppressions and severity overrides; per-call --suppress flags stack on
+top (union — flags cannot un-suppress the rc file).
 
 Suppression syntax (same as analysis.analyze(suppress=...)):
   DTYPE_F64_PROMOTION          exact code
@@ -124,15 +141,15 @@ def target_engine_decode():
 
 
 def target_engine_prefill():
-    import jax.numpy as jnp
     eng, params = _engine()
-    # probe the power-of-two prompt buckets the engine compiles: distinct
-    # bucket widths are EXPECTED recompiles — assert there are exactly the
-    # bucketed signatures, nothing shape-polymorphic beyond them
-    ids8 = jnp.zeros((1, 8), jnp.int32)
-    args = (params, ids8, eng.cache.pools["k"], eng.cache.pools["v"],
-            eng.cache.page_table[0][None], jnp.int32(5))
-    return eng._prefill, args, {}
+    # the prefill bucket menu IS the compile plan: probe every bucket's
+    # signature and tell the shape-poly checker exactly that many are
+    # EXPECTED — the lint then fails only if something shape-polymorphic
+    # leaks past the bucketing (a new signature outside the menu)
+    probes = eng.prefill_probe_args()
+    return eng._prefill, probes[0], {
+        "probe_args": probes[1:],
+        "options": {"expected_signatures": len(eng.prefill_buckets)}}
 
 
 def target_engine_swap_out():
@@ -177,6 +194,39 @@ TARGETS = {
 SHIPPED_SUPPRESSIONS: tuple = ()
 
 
+def _severity_rank(s: str) -> int:
+    return {"info": 1, "warning": 2, "error": 3}.get(s, 0)
+
+
+def _baseline_snapshot(out: dict) -> dict:
+    """{target: {code: worst_severity}} — what --write-baseline stores
+    and --baseline diffs against."""
+    snap = {}
+    for name, rep in out.items():
+        codes: dict = {}
+        for f in rep["findings"]:
+            if _severity_rank(f["severity"]) > _severity_rank(
+                    codes.get(f["code"], "")):
+                codes[f["code"]] = f["severity"]
+        snap[name] = {"codes": codes}
+    return snap
+
+
+def _baseline_diff(current: dict, baseline: dict) -> list:
+    """New finding codes (or severity escalations) vs the snapshot."""
+    news = []
+    for name, cur in current.items():
+        base = baseline.get("targets", baseline).get(name, {}).get(
+            "codes", {})
+        for code, sev in cur["codes"].items():
+            if code not in base:
+                news.append(f"{name}: NEW code {code} ({sev})")
+            elif _severity_rank(sev) > _severity_rank(base[code]):
+                news.append(f"{name}: {code} escalated "
+                            f"{base[code]} -> {sev}")
+    return news
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="lint the shipped bench models with paddle_tpu.analysis")
@@ -192,21 +242,53 @@ def main(argv=None) -> int:
     ap.add_argument("--fail-on", default="warning",
                     choices=["info", "warning", "error"],
                     help="lowest severity that fails the lint")
+    ap.add_argument("--fix", action="store_true",
+                    help="print patch suggestions for fixable findings")
+    ap.add_argument("--no-hlo", action="store_true",
+                    help="skip the HLO tier (no lowering/compiling)")
+    ap.add_argument("--config", default=None, metavar="RC",
+                    help=".graphlintrc path (default: repo root)")
+    ap.add_argument("--baseline", default=None, metavar="B.json",
+                    help="diff mode: fail only on NEW codes vs snapshot")
+    ap.add_argument("--write-baseline", default=None, metavar="B.json",
+                    help="store the current findings as the snapshot")
     args = ap.parse_args(argv)
 
     from paddle_tpu import analysis
 
+    rc_path = args.config or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".graphlintrc")
+    config = analysis.load_rcfile(rc_path) if os.path.isfile(rc_path) \
+        else None
+
     fail_on = analysis.Severity[args.fail_on.upper()]
     suppress = list(SHIPPED_SUPPRESSIONS) + list(args.suppress)
     names = list(args.targets) or list(TARGETS)
-    out, all_ok = {}, True
+    out, mem_peaks, all_ok = {}, {}, True
     for name in names:
         fn, call_args, extra = TARGETS[name]()
-        report = analysis.analyze(fn, *call_args, suppress=suppress,
-                                  mesh=extra.get("mesh"))
+        report = analysis.analyze(
+            fn, *call_args, suppress=suppress, mesh=extra.get("mesh"),
+            probe_args=extra.get("probe_args"),
+            options=extra.get("options"), config=config)
+        if not args.no_hlo:
+            report = analysis.merge_reports(report, analysis.analyze_hlo(
+                fn, *call_args, suppress=suppress,
+                options=extra.get("options"), config=config))
         ok = report.ok(fail_on)
         all_ok &= ok
-        out[name] = dict(report.to_json(), ok=ok)
+        # jaxpr-tier static memory peak (the attributable estimate; the
+        # HLO tier's MEM_PEAK carries the compiled ground truth)
+        for f in report.by_code("MEM_PEAK"):
+            if f.checker == "memory":
+                mem_peaks[name] = int(f.data.get("peak_bytes", 0))
+                break
+        out[name] = dict(report.to_json(), ok=ok,
+                         mem_peak_bytes=mem_peaks.get(name))
+        patches = analysis.fixes.suggest_fixes(report) if args.fix else []
+        if args.fix:
+            out[name]["fixes"] = [p.to_dict() for p in patches]
         if not args.as_json:
             shown = [f for f in report
                      if args.verbose or f.severity >= analysis.Severity.WARNING]
@@ -214,9 +296,32 @@ def main(argv=None) -> int:
                   f"({report.counts()}, {report.suppressed} suppressed)")
             for f in shown:
                 print(f"   {f}")
+            if patches:
+                print(analysis.fixes.format_patches(patches))
+
+    snap = _baseline_snapshot(out)
+    if args.write_baseline:
+        with open(args.write_baseline, "w") as f:
+            json.dump({"targets": snap}, f, indent=1, sort_keys=True)
+        if not args.as_json:
+            print(f"graphlint: baseline written to {args.write_baseline}")
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        news = _baseline_diff(snap, baseline)
+        if args.as_json:
+            print(json.dumps({"targets": out, "new_vs_baseline": news,
+                              "ok": not news}))
+        else:
+            for n in news:
+                print(f"baseline: {n}")
+            print(f"graphlint: {'no new codes' if not news else f'{len(news)} NEW finding code(s)'} vs {args.baseline}")
+        return 1 if news else 0
+
     if args.as_json:
         counts = {k: out[k]["counts"] for k in out}
-        print(json.dumps({"targets": out, "counts": counts, "ok": all_ok}))
+        print(json.dumps({"targets": out, "counts": counts,
+                          "mem_peak_bytes": mem_peaks, "ok": all_ok}))
     elif all_ok:
         print(f"graphlint: all {len(names)} target(s) clean at "
               f">={args.fail_on}")
