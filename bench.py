@@ -53,21 +53,12 @@ if _BACKEND != "tpu":
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
-# bf16 peak FLOP/s per chip; ordered most-specific-first for substring match
-_PEAK_FLOPS = (
-    ("v6e", 918e12), ("v6", 918e12), ("v5 lite", 197e12), ("v5e", 197e12),
-    ("v5litepod", 197e12), ("v5p", 459e12), ("v5", 459e12), ("v4", 275e12),
-)
-
 
 def _peak_flops(device) -> float:
-    kind = getattr(device, "device_kind", "").lower()
-    for k, v in _PEAK_FLOPS:
-        if k in kind:
-            return v
-    if device.platform == "tpu":
-        return 459e12  # assume v5p-class
-    return 0.0  # CPU: MFU not meaningful
+    # one chip table, one truth: paddle_tpu.obs.mfu owns it (0.0 on CPU)
+    from paddle_tpu.obs import mfu as obs_mfu
+
+    return obs_mfu.device_peak_flops(device)
 
 
 
@@ -98,13 +89,17 @@ def _run_with_unroll(run, cfg, on_tpu):
     return dt, loss, note
 
 
-def _timed_steps(st, params, opt_state, batch, steps):
+def _timed_steps(st, params, opt_state, batch, steps, on_warm=None):
     """Compile+warm once, then time `steps` steps.  Completion is forced via
     a host transfer (float(loss)), NOT block_until_ready — remote-execution
     backends (axon tunnel) can report ready before the computation finishes.
-    Returns (dt_seconds, final_loss)."""
+    `on_warm` fires between the warmup step and the clock (the recompile
+    sentinel baselines its cache-size snapshot there).  Returns
+    (dt_seconds, final_loss)."""
     params, opt_state, m = st.step(params, opt_state, batch)
     float(m["loss"])
+    if on_warm is not None:
+        on_warm()
     t0 = time.perf_counter()
     for _ in range(steps):
         params, opt_state, m = st.step(params, opt_state, batch)
@@ -365,6 +360,7 @@ def bench_decode(dev, on_tpu):
     dt_dense = timed(run_dense)
     paged_tps = B * new_tokens / dt_paged
     dense_tps = B * new_tokens / dt_dense
+    lifecycle, latency = _engine_lifecycle_counters()
     return {
         "metric": "decode_tokens_per_sec",
         "value": round(paged_tps, 2),
@@ -375,15 +371,23 @@ def bench_decode(dev, on_tpu):
         "batch": B, "prompt": S, "new_tokens": new_tokens,
         "page_size": page_size,
         "model_params": llama.num_params(cfg),
-        "engine_lifecycle": _engine_lifecycle_counters(),
+        "engine_lifecycle": lifecycle,
+        # per-request latency percentiles (TTFT / inter-token) from the
+        # same forced-preemption engine run — the router/placement
+        # signals the ROADMAP's multi-tenant item needs
+        "request_latency": latency,
     }
 
 
 def _engine_lifecycle_counters():
-    """LLMEngine preemption/lifecycle counters on a deliberately
-    undersized page pool (2 slots whose worst case exceeds the pool, so
-    the admit-on-demand scheduler must preempt and resume) — surfaced
-    alongside the decode throughput headline to track the serving rung."""
+    """LLMEngine preemption/lifecycle counters + request latency
+    percentiles on a deliberately undersized page pool (2 slots whose
+    worst case exceeds the pool, so the admit-on-demand scheduler must
+    preempt and resume) — surfaced alongside the decode throughput
+    headline to track the serving rung.  Returns (counters, latency):
+    latency carries TTFT and inter-token p50/p99 in ms, derived from the
+    engine's per-request lifecycle histograms (raw-sample window, not
+    bucket interpolation)."""
     import jax as _jax
     from paddle_tpu.inference import LLMEngine
     from paddle_tpu.models import llama as _llama
@@ -398,9 +402,19 @@ def _engine_lifecycle_counters():
                for _ in range(3)]
     eng.generate(prompts, max_new_tokens=4)
     snap = eng.stats_snapshot()
-    return {k: snap[k] for k in ("preemptions", "swapped_in", "resumed",
-                                 "cancelled", "timed_out", "queue_depth",
-                                 "completed")}
+    counters = {k: snap[k] for k in ("preemptions", "swapped_in", "resumed",
+                                     "cancelled", "timed_out", "queue_depth",
+                                     "completed")}
+
+    lat = eng.latency_snapshot()
+
+    def ms(key):
+        d = lat[key]
+        return {"p50_ms": round(d["p50"] * 1e3, 3),
+                "p99_ms": round(d["p99"] * 1e3, 3), "n": d["n"]}
+
+    latency = {"ttft": ms("ttft_s"), "inter_token": ms("inter_token_s")}
+    return counters, latency
 
 
 def _run_graphlint(timeout: float = 900.0) -> dict:
@@ -490,13 +504,35 @@ def main():
     tokens = np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S + 1))
     import gc
 
+    from paddle_tpu.obs import mfu as obs_mfu
+
+    # measured-vs-static info for the final timed run (overwritten per
+    # _run_with_unroll leg, so it reflects the leg the headline uses)
+    obs_info = {}
+
     def run(c):
         st = ShardedTrainState(c, llama, mesh,
                                AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
         params, opt_state = st.init(jax.random.PRNGKey(0))
         batch = st.shard_batch(llama.lm_batch_from_tokens(
             jnp.asarray(tokens, dtype=jnp.int32)))
-        out = _timed_steps(st, params, opt_state, batch, steps)
+        step_fn = st.jitted_step(batch)
+        # jaxpr-counted FLOPs of ONE step (the cost pass's number — it
+        # can differ from the 6N headline formula; that delta is signal)
+        try:
+            obs_info["flops_per_step"] = obs_mfu.static_flops(
+                step_fn, params, opt_state, batch)
+            obs_info.pop("flops_error", None)   # stale error from a
+            # failed unrolled leg must not outlive a fallback success
+        except Exception as e:  # noqa: BLE001 — cost must not kill bench
+            obs_info["flops_per_step"] = None
+            obs_info["flops_error"] = repr(e)[:200]
+        sentinel = obs_mfu.RecompileSentinel().watch("llama_train_step",
+                                                     step_fn)
+        out = _timed_steps(st, params, opt_state, batch, steps,
+                           on_warm=sentinel.check)
+        sentinel.check()
+        obs_info["recompiles"] = sentinel.counts()["llama_train_step"]
         # free the state (params+opt ~ 10 GB) before the sub-benches
         del st, params, opt_state, batch
         gc.collect()
@@ -507,6 +543,8 @@ def main():
     peak = _peak_flops(dev)
     mfu = (tokens_per_sec * llama.flops_per_token(cfg, S) / peak) if peak else 0.0
     llama_params = llama.num_params(cfg)
+    runtime = obs_mfu.runtime_report(
+        dt / steps, obs_info.get("flops_per_step") or 0.0, peak_flops=peak)
 
     # each sub-bench runs in its OWN process: device buffers are truly
     # released between flagships (in-process, residue from the llama run
@@ -534,6 +572,20 @@ def main():
             # PaLM-appendix convention: 6N + full 12·L·H·D·S attention term,
             # NO causal 1/2 discount (state it so the MFU is unambiguous)
             "flops_convention": "PaLM 6N + 12LHDS, no causal discount",
+            # measured-vs-static (paddle_tpu.obs.mfu): runtime MFU uses
+            # the cost pass's jaxpr-counted FLOPs (vs the 6N headline);
+            # cost_model_ratio = measured / predicted step time (~1 means
+            # the static model is placement-trustworthy; None on CPU)
+            "runtime_mfu": round(runtime["runtime_mfu"], 4),
+            "cost_model_ratio": (
+                None if runtime["cost_model_ratio"] is None
+                else round(runtime["cost_model_ratio"], 3)),
+            "flops_per_step_static": obs_info.get("flops_per_step"),
+            "flops_error": obs_info.get("flops_error"),
+            "measured_step_s": round(dt / steps, 4),
+            # post-warmup compile-cache misses of the timed step (the
+            # recompile sentinel; anything >0 poisons the timing)
+            "recompiles": obs_info.get("recompiles"),
             # BASELINE config 4 (conv+attention diffusion flagship)
             "dit": dit_extra,
             # BASELINE config 5 (MoE expert-parallel)

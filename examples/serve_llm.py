@@ -78,6 +78,19 @@ def main():
     stats = json.loads(urllib.request.urlopen(url + "stats",
                                               timeout=30).read())
     print("engine stats:", stats)
+
+    # the same counters as Prometheus text, plus the latency histograms
+    # (TTFT / inter-token / queue-wait) a scraper ingests — /stats and
+    # /metrics are rendered from one registry and cannot drift
+    with urllib.request.urlopen(url + "metrics", timeout=30) as resp:
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        metrics = resp.read().decode()
+    print("metrics sample:")
+    for line in metrics.splitlines():
+        if line.startswith(("llm_ttft_seconds_count",
+                            "llm_inter_token_seconds_count",
+                            "llm_completed_total")):
+            print(" ", line)
     srv.shutdown()
     print("OK")
 

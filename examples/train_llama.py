@@ -1,8 +1,17 @@
 """Train a Llama-style model with the auto-parallelize planner.
 
-Usage:  python examples/train_llama.py [--steps N]
+Usage:  python examples/train_llama.py [--steps N] [--trace out.json]
 Runs on whatever devices jax sees (one TPU chip, or the 8-virtual-device
 CPU mesh under JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8).
+
+Telemetry rides the hapi ObsCallback (paddle_tpu.obs): each step is a
+fenced `train_step` span in its own step lane, the recompile sentinel
+watches the jitted step for post-warmup cache misses, and the run ends
+with a per-span summary table plus a measured-vs-static report —
+runtime MFU (measured step time x cost-pass FLOPs / chip peak) and
+`cost_model_ratio` (measured / predicted step time).  `--trace out.json`
+exports the spans as Chrome/Perfetto JSON (load in ui.perfetto.dev, or
+summarize with tools/trace_summary.py).
 """
 import os
 import sys
@@ -16,8 +25,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from paddle_tpu.distributed.auto_tuner import auto_parallelize, V5E
+from paddle_tpu.hapi.callbacks import ObsCallback
 from paddle_tpu.models import llama
 from paddle_tpu.models.llama import LlamaConfig
+from paddle_tpu.obs import mfu as obs_mfu
+from paddle_tpu.obs import trace as obs_trace
 
 
 def main():
@@ -25,6 +37,8 @@ def main():
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="export a Chrome/Perfetto trace of the run")
     args = ap.parse_args()
 
     cfg = LlamaConfig(vocab_size=1024, hidden_size=256, intermediate_size=512,
@@ -36,14 +50,51 @@ def main():
           f"est {plan.step_time*1e3:.1f} ms/step")
     params, opt = state.init(jax.random.PRNGKey(0))
     rng = np.random.default_rng(0)
+
+    # the training-side telemetry hookup: spans + step-time histogram +
+    # recompile sentinel, driven through the hapi callback protocol
+    obs = ObsCallback(export_path=args.trace,
+                      fence_of=lambda logs: logs.get("metrics"))
+    obs.on_train_begin()
+    watched = False
+    flops_per_step = None
     for step in range(args.steps):
         toks = rng.integers(0, cfg.vocab_size, (args.batch, args.seq + 1))
         batch = state.shard_batch(llama.lm_batch_from_tokens(
             jnp.asarray(toks, jnp.int32)))
+        if not watched:
+            # one batch structure -> one jitted executable: watch it for
+            # post-warmup recompiles and price it once with the cost pass
+            obs.watch("llama_train_step", state.jitted_step(batch))
+            try:
+                flops_per_step = obs_mfu.static_flops(
+                    state.jitted_step(batch), params, opt, batch)
+            except Exception as e:  # noqa: BLE001 — cost must not kill
+                print(f"static cost unavailable: {e!r:.120}")
+            watched = True
+        obs.on_train_batch_begin(step)
         params, opt, m = state.step(params, opt, batch)
+        obs.on_train_batch_end(step, logs={"metrics": m})
         if step % 5 == 0 or step == args.steps - 1:
             print(f"step {step:3d}  loss {float(m['loss']):.4f}  "
                   f"gnorm {float(m['grad_norm']):.3f}")
+    obs.on_train_end()
+
+    summ = obs.step_summary()
+    print(obs_trace.format_summary(
+        obs_trace.summarize(obs.tracer.events())))
+    if summ["steps"] and flops_per_step is not None:
+        report = obs_mfu.runtime_report(summ["mean_step_s"], flops_per_step)
+        ratio = report["cost_model_ratio"]
+        print(f"measured {summ['mean_step_s']*1e3:.1f} ms/step "
+              f"(p99 {summ['p99_step_s']*1e3:.1f})  "
+              f"runtime MFU {report['runtime_mfu']:.3f}  "
+              f"cost_model_ratio "
+              f"{'n/a (no peak for this backend)' if ratio is None else f'{ratio:.2f}'}  "
+              f"recompiles {obs.sentinel.counts()}")
+    if args.trace:
+        print(f"trace written to {args.trace} "
+              f"(summarize: python tools/trace_summary.py {args.trace})")
 
 
 if __name__ == "__main__":

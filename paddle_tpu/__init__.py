@@ -61,6 +61,7 @@ from . import static  # noqa: E402
 from . import profiler  # noqa: E402
 from . import inference  # noqa: E402
 from . import analysis  # noqa: E402  (Graph Doctor: jaxpr lint framework)
+from . import obs  # noqa: E402  (runtime telemetry: spans/metrics/MFU)
 from .framework_tensors import SelectedRows, StringTensor  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .hapi.summary import summary  # noqa: E402

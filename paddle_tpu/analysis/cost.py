@@ -28,7 +28,7 @@ import numpy as np
 from .core import aval_bytes, format_path, iter_eqns
 
 __all__ = ["eqn_flops", "eqn_bytes", "per_eqn_costs", "estimate",
-           "register_pallas_flops", "register_pallas_bytes"]
+           "total_flops", "register_pallas_flops", "register_pallas_bytes"]
 
 # substring of the pallas kernel name -> fn(eqn) -> flops
 _PALLAS_FLOPS: Dict[str, Callable] = {}
@@ -201,5 +201,13 @@ def estimate(fn_or_jaxpr, *args, top_k: Optional[int] = None, **kwargs):
     return {
         "total_flops": float(sum(c["flops"] for c in costs)),
         "total_bytes": int(sum(c["bytes"] for c in costs)),
-        "top": costs[: (top_k or 5)],
+        # top_k=0 means NO top list (not the default 5)
+        "top": costs[:5] if top_k is None else costs[:top_k],
     }
+
+
+def total_flops(fn_or_jaxpr, *args, **kwargs) -> float:
+    """Just the FLOPs roll-up of one target — the per-target lookup
+    obs.mfu joins with measured step times (runtime MFU /
+    cost_model_ratio).  Same tracing rules as `estimate`."""
+    return estimate(fn_or_jaxpr, *args, top_k=0, **kwargs)["total_flops"]

@@ -1,5 +1,6 @@
 """hapi callbacks (reference: python/paddle/hapi/callbacks.py — Callback,
-ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler, VisualDL)."""
+ProgBarLogger, ModelCheckpoint, EarlyStopping, LRScheduler, VisualDL) +
+ObsCallback, the training-loop hookup for paddle_tpu.obs telemetry."""
 
 from __future__ import annotations
 
@@ -138,6 +139,94 @@ class EarlyStopping(Callback):
             self.wait += 1
             if self.wait > self.patience:
                 self.model.stop_training = True
+
+
+class ObsCallback(Callback):
+    """Span-trace + metrics + recompile-sentinel instrumentation for a
+    training loop (paddle_tpu.obs on the hapi callback protocol).
+
+    Per train batch: opens step lane N (`tracer.step_mark`), wraps the
+    step in a `train_step` span — fenced on `fence_of(logs)` when given,
+    so the span times the device compute rather than the async enqueue —
+    records the step time into the `train_step_seconds` histogram, and
+    runs the recompile sentinel (`watch(name, jitted_fn)` targets; a
+    post-warmup cache miss raises RecompileWarning + a tracer event).
+    On train end: exports the chrome trace to `export_path` if set.
+
+    Works under `Model.fit(callbacks=[...])` or driven manually around
+    any step loop (examples/train_llama.py does the latter)."""
+
+    def __init__(self, tracer=None, registry=None, export_path=None,
+                 fence_of=None):
+        super().__init__()
+        from ..obs import metrics as obs_metrics
+        from ..obs import mfu as obs_mfu
+        from ..obs import trace as obs_trace
+
+        self.tracer = tracer if tracer is not None else obs_trace.get_tracer()
+        self.registry = registry if registry is not None \
+            else obs_metrics.Registry()
+        self.export_path = export_path
+        self.fence_of = fence_of
+        self.sentinel = obs_mfu.RecompileSentinel(
+            tracer=self.tracer, registry=self.registry)
+        self._h_step = self.registry.histogram(
+            "train_step_seconds", "wall time per train batch (fenced)")
+        self._span = None
+        self._was_enabled = None
+
+    def watch(self, name, jitted_fn) -> "ObsCallback":
+        """Register a jitted target with the recompile sentinel."""
+        self.sentinel.watch(name, jitted_fn)
+        return self
+
+    def on_train_begin(self, logs=None):
+        self._was_enabled = self.tracer.enabled
+        self.tracer.enable()
+
+    def on_train_batch_begin(self, step, logs=None):
+        self.tracer.step_mark(step)
+        self._span = self.tracer.span("train_step", step=step)
+        self._span.__enter__()
+        self._t0 = time.perf_counter()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._span is None:
+            return
+        # fence BEFORE timing: histogram and span must both cover the
+        # device compute, not the async enqueue (works with the tracer
+        # disabled too — the histogram is always live)
+        fence = self.fence_of(logs) if self.fence_of and logs else None
+        if fence is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(fence)
+            except Exception:  # noqa: BLE001 — fencing must not kill
+                pass           # the train loop
+        self._h_step.observe(time.perf_counter() - self._t0)
+        self._span.__exit__(None, None, None)
+        self._span = None
+        self.sentinel.check()
+
+    def on_train_end(self, logs=None):
+        if self.export_path:
+            self.tracer.export_chrome(self.export_path)
+        if self._was_enabled is False:
+            self.tracer.disable()
+
+    def step_summary(self) -> dict:
+        """{mean_step_s, p50_step_s, p99_step_s, steps} over the recent
+        raw-sample window — what runtime-MFU reports consume."""
+        from ..obs import metrics as obs_metrics
+
+        samples = self._h_step.samples()
+        return {
+            "steps": len(samples),
+            "mean_step_s": (sum(samples) / len(samples)) if samples else 0.0,
+            "p50_step_s": obs_metrics.percentile(samples, 0.5),
+            "p99_step_s": obs_metrics.percentile(samples, 0.99),
+        }
 
 
 class LRScheduler(Callback):

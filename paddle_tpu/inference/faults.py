@@ -179,6 +179,11 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         1..num_pages-1, each once (page 0 reserved, never allocated);
       * pools live: the k/v buffers were not donated away and lost;
       * every submitted handle resolved exactly once;
+      * metrics registry consistency: every accepted request landed in
+        EXACTLY one terminal counter (accepted == completed + cancelled
+        + timed_out + failed + still-queued + in-flight), and the
+        stats_snapshot values match the registry counters /metrics
+        renders (the two surfaces share storage and must not drift);
       * the engine still serves: a fresh 1-token request completes.
 
     Returns a report dict; raises InvariantViolation on any breach unless
@@ -209,6 +214,44 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         if getattr(arr, "is_deleted", lambda: False)():
             violations.append(f"{side} pool was donated away and never "
                               "recovered")
+
+    # metrics registry consistency.  Counters and registry values are
+    # read in ONE pass under engine._cv (every counter write holds it),
+    # so the snapshot cannot tear against a concurrent step thread.  The
+    # strict terminal-counter identity is only decidable at quiescence —
+    # mid-flight, a slot leaves engine._slots (lock-free, step-thread
+    # owned) strictly before its terminal counter lands — so it is
+    # asserted exactly when the leak checks above found the engine
+    # quiesced, which is how every chaos schedule calls this.
+    registry = getattr(engine, "metrics", None)
+    with engine._cv:
+        snap = dict(engine.stats)
+        quiesced = not engine._pending and not engine._slots
+        reg_vals = {}
+        if registry is not None:
+            for key in ("accepted", "admitted", "completed", "cancelled",
+                        "timed_out", "failed", "preemptions"):
+                counter = registry.get(f"llm_{key}_total")
+                reg_vals[key] = (None if counter is None
+                                 else int(counter.value))
+    if "accepted" in snap and quiesced:
+        outcomes = (snap["completed"] + snap["cancelled"]
+                    + snap["timed_out"] + snap["failed"])
+        if snap["accepted"] != outcomes:
+            violations.append(
+                f"metrics identity broken: accepted={snap['accepted']} != "
+                f"completed+cancelled+timed_out+failed={outcomes} (a "
+                "request leaked out of, or was double-counted into, the "
+                "terminal counters)")
+    if registry is not None:
+        for key, val in reg_vals.items():
+            if val is None:
+                violations.append(f"registry missing counter "
+                                  f"llm_{key}_total")
+            elif key in snap and val != snap[key]:
+                violations.append(
+                    f"/stats and /metrics drifted: {key}={snap[key]} vs "
+                    f"llm_{key}_total={val}")
 
     for i, h in enumerate(handles):
         if not h.done():

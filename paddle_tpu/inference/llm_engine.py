@@ -44,11 +44,23 @@ Every failure path is exercised by the fault-injection harness in
 at named injection points (prefill / decode / page_alloc / sample /
 swap_out / swap_in) and the harness's invariant checker proves no pages,
 slots or handles leak under any schedule.
+
+Telemetry (paddle_tpu.obs): every lifecycle counter lives in a metrics
+Registry (`engine.metrics`) — `stats_snapshot()` (the /stats JSON) and
+`GET /metrics` (Prometheus text) read the SAME storage, so the two
+surfaces cannot drift.  Per-request latency metrics are derived from
+lifecycle timestamps: queue wait (submit -> admission), TTFT (submit ->
+first token), inter-token gaps, and tokens/sec.  The step loop is span-
+instrumented (admit / prefill / decode_step / sample / preempt /
+swap_out / swap_in) against `engine.tracer` — a no-op single branch
+until the tracer is enabled, with `block_until_ready` fencing on the
+dispatch results so spans time the compute, not the enqueue.
 """
 
 from __future__ import annotations
 
 import collections
+import collections.abc
 import functools
 import threading
 import time
@@ -60,6 +72,8 @@ import jax
 import jax.numpy as jnp
 
 from ..models import generation
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 
 __all__ = ["LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
            "DeadlineExceeded"]
@@ -112,6 +126,12 @@ class _Request:
         self.eos_id = eos_id
         self.deadline = (None if deadline is None
                          else time.monotonic() + float(deadline))
+        # lifecycle timestamps (monotonic): the per-request latency
+        # metrics — queue wait, TTFT, inter-token — derive from these
+        self.t_submit = time.monotonic()
+        self.t_admit: Optional[float] = None
+        self.t_first_token: Optional[float] = None
+        self.t_last_token: Optional[float] = None
         self.tokens: List[int] = []
         self.error: Optional[BaseException] = None
         self.cancelled = False
@@ -171,6 +191,59 @@ class _SlotState:
         self.admit_seq = admit_seq  # admission order (victim policy)
 
 
+class _StatsDict(collections.abc.MutableMapping):
+    """The engine's legacy counter dict, backed by registry Counters.
+
+    Call sites keep writing `stats["completed"] += 1`; each key is ONE
+    `llm_<key>_total` Counter in the metrics registry, so /stats JSON
+    and /metrics Prometheus text read identical storage and cannot
+    drift.  (Keys already ending in `_total` keep their name:
+    "steps_total" -> `llm_steps_total`.)"""
+
+    _HELP = {
+        "accepted": "requests accepted by submit() (queued or better)",
+        "admitted": "fresh admissions prefillled into a slot",
+        "completed": "requests finished with tokens",
+        "decode_steps": "batched decode dispatches",
+        "decode_tokens": "tokens produced by decode dispatches",
+        "preemptions": "victims evicted under page pressure",
+        "swapped_in": "preempted requests resumed via host-KV scatter",
+        "resumed": "preempted requests re-admitted (either mode)",
+        "cancelled": "requests resolved by cancellation",
+        "timed_out": "requests resolved by deadline expiry",
+        "failed": "requests resolved with an engine/dispatch error",
+        "steps_total": "engine step() iterations",
+    }
+
+    def __init__(self, registry: obs_metrics.Registry,
+                 keys: Sequence[str]):
+        self._registry = registry
+        self._counters = {}
+        for k in keys:
+            self._counters[k] = self._make(k)
+
+    def _make(self, key: str) -> obs_metrics.Counter:
+        name = f"llm_{key}" if key.endswith("_total") else f"llm_{key}_total"
+        return self._registry.counter(name, self._HELP.get(key, ""))
+
+    def __getitem__(self, key: str) -> int:
+        return int(self._counters[key].value)
+
+    def __setitem__(self, key: str, value) -> None:
+        if key not in self._counters:
+            self._counters[key] = self._make(key)
+        self._counters[key].set(value)
+
+    def __delitem__(self, key: str) -> None:
+        raise TypeError("engine stats counters cannot be removed")
+
+    def __iter__(self):
+        return iter(self._counters)
+
+    def __len__(self) -> int:
+        return len(self._counters)
+
+
 def default_prefill_buckets(max_seq_len: int, rope_len: int,
                             lo: int = 8) -> List[int]:
     """The engine's default prefill compile menu: powers of two from `lo`
@@ -200,6 +273,10 @@ class LLMEngine:
     resume).  victim_policy: "latest" (latest-admitted) or "fewest_tokens"
     (least work lost).  max_pending bounds the queue (QueueFull beyond).
     faults: an optional paddle_tpu.inference.faults.FaultInjector.
+    tracer: a paddle_tpu.obs.Tracer (default: the process-wide tracer,
+    disabled until enabled — instrumentation is then a no-op branch).
+    metrics: a paddle_tpu.obs.Registry (default: a fresh per-engine
+    registry; serve_llm's GET /metrics renders it).
 
     prefill_buckets: the prefill COMPILE MENU — every prompt (and every
     recompute-resume) right-pads to the smallest bucket >= its length,
@@ -222,7 +299,9 @@ class LLMEngine:
                  victim_policy: str = "latest",
                  faults=None,
                  prefill_buckets: Optional[Sequence[int]] = None,
-                 expected_prompt_lens: Optional[Sequence[int]] = None):
+                 expected_prompt_lens: Optional[Sequence[int]] = None,
+                 tracer: Optional[obs_trace.Tracer] = None,
+                 metrics: Optional[obs_metrics.Registry] = None):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -284,10 +363,50 @@ class LLMEngine:
         self._cv = threading.Condition()
         self._stop = False
         self._thread: Optional[threading.Thread] = None
-        self.stats = {"admitted": 0, "completed": 0, "decode_steps": 0,
-                      "decode_tokens": 0, "preemptions": 0, "swapped_in": 0,
-                      "resumed": 0, "cancelled": 0, "timed_out": 0,
-                      "failed": 0}
+        self._t_start = time.monotonic()
+        self.tracer = tracer if tracer is not None else obs_trace.get_tracer()
+        self.metrics = metrics if metrics is not None \
+            else obs_metrics.Registry()
+        if self.metrics.get("llm_accepted_total") is not None:
+            # a shared registry would silently merge both engines'
+            # counters and rebind the state gauges to the last engine —
+            # corrupted numbers, no error.  Fail fast instead: one
+            # registry per engine; a router aggregates per-replica
+            # renders, it does not pool storage.
+            raise ValueError(
+                "metrics registry already serves another LLMEngine; "
+                "give each engine its own Registry")
+        self.stats = _StatsDict(self.metrics, (
+            "accepted", "admitted", "completed", "decode_steps",
+            "decode_tokens", "preemptions", "swapped_in", "resumed",
+            "cancelled", "timed_out", "failed", "steps_total"))
+        reg = self.metrics
+        self._h_queue_wait = reg.histogram(
+            "llm_queue_wait_seconds", "submit() -> slot admission")
+        self._h_ttft = reg.histogram(
+            "llm_ttft_seconds", "submit() -> first generated token")
+        self._h_itl = reg.histogram(
+            "llm_inter_token_seconds",
+            "gap between consecutive tokens of one request (includes "
+            "preemption/requeue time: the latency the CLIENT sees)")
+        self._h_tps = reg.histogram(
+            "llm_request_tokens_per_sec",
+            "per completed request: tokens / (finish - admission)",
+            buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
+                     5000, 10000))
+        # gauges read engine state lazily at render/snapshot time (the
+        # slot/page structures are owned lock-free by the step thread, so
+        # a gauge can be one step fresher than the counters next to it)
+        reg.gauge("llm_queue_depth", "pending requests").set_function(
+            lambda: len(self._pending))
+        reg.gauge("llm_slots_in_flight", "occupied decode slots"
+                  ).set_function(lambda: len(self._slots))
+        reg.gauge("llm_free_pages", "KV pages in the free pool"
+                  ).set_function(lambda: self.cache.free_page_count)
+        reg.gauge("llm_free_slots", "free decode slots").set_function(
+            lambda: self.cache.free_slot_count)
+        reg.gauge("llm_uptime_seconds", "seconds since engine construction"
+                  ).set_function(lambda: time.monotonic() - self._t_start)
 
         cfg = config
 
@@ -403,6 +522,10 @@ class LLMEngine:
                     retry_after=1.0)
             req._engine = self
             self._pending.append(req)
+            # every accepted request ends in EXACTLY one terminal counter
+            # (completed/cancelled/timed_out/failed) — the registry
+            # identity faults.check_invariants asserts
+            self.stats["accepted"] += 1
             self._cv.notify()
         return req
 
@@ -423,17 +546,38 @@ class LLMEngine:
         return [r.result(timeout=timeout) for r in reqs]
 
     def stats_snapshot(self) -> dict:
-        """Copy of the counters taken under self._cv (every counter write
-        holds the lock, so no torn multi-counter updates) plus queue/pool
-        gauges.  The gauges are instantaneous reads: slot/page state is
-        owned lock-free by the step thread, so a gauge can be one step
-        fresher than the counters next to it."""
+        """SOURCE OF TRUTH for engine counters: a copy taken under
+        self._cv (every counter write holds the lock, so no torn
+        multi-counter updates) plus queue/pool gauges, `uptime_s`, and
+        `steps_total`.  The counters are read from the metrics registry
+        — the same storage `GET /metrics` renders, so the JSON and
+        Prometheus surfaces cannot drift.  The gauges are instantaneous
+        reads: slot/page state is owned lock-free by the step thread, so
+        a gauge can be one step fresher than the counters next to it."""
         with self._cv:
             snap = dict(self.stats)
             snap["queue_depth"] = len(self._pending)
             snap["free_pages"] = self.cache.free_page_count
             snap["free_slots"] = self.cache.free_slot_count
+            snap["uptime_s"] = time.monotonic() - self._t_start
         return snap
+
+    def latency_snapshot(self) -> dict:
+        """Per-request latency percentiles over the recent raw-sample
+        window (exact, not bucket-interpolated): {"ttft_s",
+        "inter_token_s", "queue_wait_s", "tokens_per_sec"} each carrying
+        {p50, p99, n}.  The public face of the lifecycle histograms —
+        bench.py and routers consume this, not the private fields."""
+        out = {}
+        for key, hist in (("ttft_s", self._h_ttft),
+                          ("inter_token_s", self._h_itl),
+                          ("queue_wait_s", self._h_queue_wait),
+                          ("tokens_per_sec", self._h_tps)):
+            samples = hist.samples()
+            out[key] = {"p50": obs_metrics.percentile(samples, 0.5),
+                        "p99": obs_metrics.percentile(samples, 0.99),
+                        "n": len(samples)}
+        return out
 
     # -- engine loop --------------------------------------------------------
 
@@ -446,9 +590,11 @@ class LLMEngine:
         they re-enter at the queue head), advance every active slot one
         token (preempting victims when page allocation fails), evict
         finished sequences.  Returns True when any work was done."""
-        reaped = self._reap()
-        admitted = self._admit()
-        decoded = self._decode_step()
+        self.stats["steps_total"] += 1
+        with self.tracer.span("engine_step"):
+            reaped = self._reap()
+            admitted = self._admit()
+            decoded = self._decode_step()
         return reaped or admitted or decoded
 
     def start(self):
@@ -474,6 +620,7 @@ class LLMEngine:
                 err = RuntimeError("engine shut down (step thread wedged)")
                 with self._cv:
                     for req in list(self._pending):
+                        self.stats["failed"] += 1
                         req._resolve(err)
                     self._pending.clear()
                 raise RuntimeError(
@@ -489,10 +636,14 @@ class LLMEngine:
         err = RuntimeError("engine shut down")
         with self._cv:
             for req in list(self._pending):
+                # terminal-counter identity (accepted == sum of outcomes)
+                # holds through shutdown: force-resolved counts as failed
+                self.stats["failed"] += 1
                 req._resolve(err)
             self._pending.clear()
             for slot in list(self._slots):
                 st = self._slots.pop(slot)
+                self.stats["failed"] += 1
                 st.req._resolve(err)
                 self.cache.release_slot(slot)
 
@@ -583,6 +734,7 @@ class LLMEngine:
     def _evict(self, slot: int, err: BaseException, stat_key: str) -> None:
         st = self._slots.pop(slot)
         self.cache.release_slot(slot)
+        self.tracer.instant("evict", slot=slot, reason=stat_key)
         with self._cv:
             self.stats[stat_key] += 1
         st.req._resolve(err)
@@ -603,15 +755,20 @@ class LLMEngine:
         pages = list(cache._slot_pages[slot])
         rs = _ResumeState(ctx=st.ctx, last_tok=st.last_tok,
                           n_pages=len(pages))
+        self.tracer.instant("preempt", slot=slot, ctx=st.ctx,
+                            mode=self.preempt_mode)
         try:
             if self.preempt_mode == "swap":
-                self._fire("swap_out", slot=slot, pools=cache.pools)
-                idx = np.zeros((cache.pages_per_seq,), np.int32)
-                idx[:len(pages)] = pages
-                hk, hv = self._swap_out(cache.pools["k"], cache.pools["v"],
-                                        jnp.asarray(idx))
-                rs.host_k = np.asarray(hk)   # device -> host RAM
-                rs.host_v = np.asarray(hv)
+                with self.tracer.span("swap_out", slot=slot,
+                                      pages=len(pages)):
+                    self._fire("swap_out", slot=slot, pools=cache.pools)
+                    idx = np.zeros((cache.pages_per_seq,), np.int32)
+                    idx[:len(pages)] = pages
+                    hk, hv = self._swap_out(cache.pools["k"],
+                                            cache.pools["v"],
+                                            jnp.asarray(idx))
+                    rs.host_k = np.asarray(hk)   # device -> host RAM
+                    rs.host_v = np.asarray(hv)
         except Exception as e:  # noqa: BLE001 — a failed swap-out loses the
             # victim's KV: fail that request, keep the engine serving
             cache.release_slot(slot)
@@ -650,10 +807,12 @@ class LLMEngine:
                 progress = True
                 continue
             try:
-                if rs is not None:
-                    self._resume_into(slot, req, rs)
-                else:
-                    self._prefill_into(slot, req)
+                with self.tracer.span("admit", slot=slot,
+                                      resume=rs is not None):
+                    if rs is not None:
+                        self._resume_into(slot, req, rs)
+                    else:
+                        self._prefill_into(slot, req)
             except Exception as e:  # noqa: BLE001 — admission must not leak
                 # the request left _pending but never (or only briefly)
                 # reached _slots: without cleanup the slot and its pages
@@ -683,20 +842,33 @@ class LLMEngine:
         S = req.prompt.size
         self._fire("page_alloc", slot=slot, n_tokens=S)
         cache.ensure_capacity(slot, S)
+        if req.t_admit is None:     # first admission only (not resume)
+            req.t_admit = time.monotonic()
+            self._h_queue_wait.observe(req.t_admit - req.t_submit)
         # menu lookup (the default menu's top bucket is clamped to the
         # rope table — a non-pow2 max_position_embeddings would
         # otherwise over-slice it)
         Sb = self._bucket_for(S)
         ids = np.zeros((1, Sb), np.int32)
         ids[0, :S] = req.prompt
-        self._fire("prefill", slot=slot, pools=cache.pools)
-        last, k_pool, v_pool = self._prefill(
-            self.params, jnp.asarray(ids), cache.pools["k"],
-            cache.pools["v"], cache.page_table[slot][None], jnp.int32(S))
+        with self.tracer.span("prefill", slot=slot, tokens=S,
+                              bucket=Sb) as sp:
+            self._fire("prefill", slot=slot, pools=cache.pools)
+            last, k_pool, v_pool = self._prefill(
+                self.params, jnp.asarray(ids), cache.pools["k"],
+                cache.pools["v"], cache.page_table[slot][None],
+                jnp.int32(S))
+            sp.fence((last, k_pool))
         cache.pools = {"k": k_pool, "v": v_pool}
-        self._fire("sample", slot=slot)
-        tok = int(np.asarray(self._sample(last))[0])
+        with self.tracer.span("sample", slot=slot):
+            self._fire("sample", slot=slot)
+            tok = int(np.asarray(self._sample(last))[0])
         req.tokens.append(tok)
+        now = time.monotonic()
+        if req.t_first_token is None:
+            req.t_first_token = now
+            self._h_ttft.observe(now - req.t_submit)
+        req.t_last_token = now
         with self._cv:
             self.stats["admitted"] += 1
         if (req.eos_id is not None and tok == req.eos_id) \
@@ -718,13 +890,16 @@ class LLMEngine:
                    n_tokens=rs.n_pages * cache.page_size)
         cache.ensure_capacity(slot, rs.n_pages * cache.page_size)
         if rs.host_k is not None:
-            self._fire("swap_in", slot=slot, pools=cache.pools)
-            idx = np.zeros((cache.pages_per_seq,), np.int32)
-            pages = cache._slot_pages[slot]
-            idx[:len(pages)] = pages
-            k_pool, v_pool = self._swap_in(
-                cache.pools["k"], cache.pools["v"], jnp.asarray(idx),
-                jnp.asarray(rs.host_k), jnp.asarray(rs.host_v))
+            with self.tracer.span("swap_in", slot=slot,
+                                  pages=rs.n_pages) as sp:
+                self._fire("swap_in", slot=slot, pools=cache.pools)
+                idx = np.zeros((cache.pages_per_seq,), np.int32)
+                pages = cache._slot_pages[slot]
+                idx[:len(pages)] = pages
+                k_pool, v_pool = self._swap_in(
+                    cache.pools["k"], cache.pools["v"], jnp.asarray(idx),
+                    jnp.asarray(rs.host_k), jnp.asarray(rs.host_v))
+                sp.fence(k_pool)
             cache.pools = {"k": k_pool, "v": v_pool}
             with self._cv:
                 self.stats["swapped_in"] += 1
@@ -737,11 +912,14 @@ class LLMEngine:
             Sb = self._bucket_for(rs.ctx)
             ids = np.zeros((1, Sb), np.int32)
             ids[0, :rs.ctx] = ids_np
-            self._fire("prefill", slot=slot, pools=cache.pools)
-            _last, k_pool, v_pool = self._prefill(
-                self.params, jnp.asarray(ids), cache.pools["k"],
-                cache.pools["v"], cache.page_table[slot][None],
-                jnp.int32(rs.ctx))
+            with self.tracer.span("prefill", slot=slot, tokens=rs.ctx,
+                                  bucket=Sb, resume=True) as sp:
+                self._fire("prefill", slot=slot, pools=cache.pools)
+                _last, k_pool, v_pool = self._prefill(
+                    self.params, jnp.asarray(ids), cache.pools["k"],
+                    cache.pools["v"], cache.page_table[slot][None],
+                    jnp.int32(rs.ctx))
+                sp.fence(k_pool)
             cache.pools = {"k": k_pool, "v": v_pool}
         with self._cv:
             self.stats["resumed"] += 1
@@ -789,13 +967,17 @@ class LLMEngine:
             toks[slot] = st.last_tok
             ctx[slot] = st.ctx
         try:
-            self._fire("decode", pools=cache.pools)
-            logits, pools = self._decode(
-                self.params, jnp.asarray(toks), jnp.asarray(ctx),
-                cache.page_table, cache.pools["k"], cache.pools["v"])
+            with self.tracer.span("decode_step",
+                                  active=len(self._slots)) as sp:
+                self._fire("decode", pools=cache.pools)
+                logits, pools = self._decode(
+                    self.params, jnp.asarray(toks), jnp.asarray(ctx),
+                    cache.page_table, cache.pools["k"], cache.pools["v"])
+                sp.fence(logits)
             cache.pools = pools
-            self._fire("sample")
-            nxt = np.asarray(self._sample(logits))
+            with self.tracer.span("sample"):
+                self._fire("sample")
+                nxt = np.asarray(self._sample(logits))
         except Exception as e:  # noqa: BLE001 — dispatch/sampling fault:
             # the donated pools may be consumed and this step's KV writes
             # are suspect.  Fail every in-flight request, recover the
@@ -805,12 +987,16 @@ class LLMEngine:
         with self._cv:
             self.stats["decode_steps"] += 1
             self.stats["decode_tokens"] += len(self._slots)
+        now = time.monotonic()
         for slot in list(self._slots):
             st = self._slots[slot]
             st.ctx += 1
             tok = int(nxt[slot])
             st.req.tokens.append(tok)
             st.last_tok = tok
+            if st.req.t_last_token is not None:
+                self._h_itl.observe(now - st.req.t_last_token)
+            st.req.t_last_token = now
             if (st.req.eos_id is not None and tok == st.req.eos_id) \
                     or len(st.req.tokens) >= st.req.max_new_tokens:
                 del self._slots[slot]
@@ -826,6 +1012,10 @@ class LLMEngine:
         self.cache.release_slot(slot)
         with self._cv:
             self.stats["completed"] += 1
+        if req.t_admit is not None and req.tokens:
+            dur = time.monotonic() - req.t_admit
+            if dur > 0:
+                self._h_tps.observe(len(req.tokens) / dur)
         req._resolve()
 
 
@@ -845,28 +1035,44 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
     cancelled so its slot/pages free immediately (it must not starve the
     batch until max_new_tokens); GET /healthz replies 200 only while the
     engine's step thread is alive; GET /stats returns a locked snapshot
-    of the engine counters.  Returns (server, thread); server.shutdown()
-    stops the HTTP loop AND the engine."""
+    of the engine counters (Content-Type: application/json); GET /metrics
+    renders the same registry as Prometheus text exposition format
+    (Content-Type: text/plain; version=0.0.4) with the TTFT /
+    inter-token / queue-wait histograms.  Returns (server, thread);
+    server.shutdown() stops the HTTP loop AND the engine."""
     import json
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
     engine.start()
 
     class Handler(BaseHTTPRequestHandler):
-        def _reply(self, status: int, payload: dict, headers=None):
-            body = json.dumps(payload).encode()
+        def _reply_text(self, status: int, text: str, content_type: str,
+                        headers=None):
+            body = text.encode()
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
+        def _reply(self, status: int, payload: dict, headers=None):
+            self._reply_text(status, json.dumps(payload),
+                             "application/json", headers)
+
         def do_GET(self):
             path = self.path.rstrip("/")
             if path == "/stats":
                 self._reply(200, engine.stats_snapshot())
+            elif path == "/metrics":
+                reg = getattr(engine, "metrics", None)
+                if reg is None:
+                    self._reply(404, {"error": "engine has no metrics "
+                                               "registry"})
+                    return
+                self._reply_text(200, reg.render(),
+                                 "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":
                 t = engine._thread
                 alive = (t is not None and t.is_alive()

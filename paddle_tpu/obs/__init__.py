@@ -1,0 +1,46 @@
+"""paddle_tpu.obs — runtime telemetry: span tracing, metrics, measured MFU.
+
+Graph Doctor (paddle_tpu.analysis) predicts what a compiled program
+*should* cost — static FLOPs/bytes and liveness peaks.  This package
+measures what it *actually* costs, on one shared event spine:
+
+  * `obs.trace` — a low-overhead span tracer: `trace.span("prefill",
+    req_id=...)` context managers record monotonic wall times into a ring
+    buffer, with explicit `block_until_ready` fencing for device work
+    (async dispatch otherwise times the *enqueue*, not the compute).
+    Exportable as Chrome/Perfetto trace JSON; `profiler.Profiler` and the
+    LLMEngine both record into it.
+  * `obs.metrics` — counters, gauges, fixed-bucket histograms in a
+    `Registry`, rendered as Prometheus text (`GET /metrics` in serve_llm).
+    The engine's `/stats` JSON is sourced from the same registry, so the
+    two surfaces cannot drift.
+  * `obs.mfu` — closes the static/measured loop: runtime MFU from
+    measured step time + the cost pass's FLOPs, `cost_model_ratio`
+    (measured / predicted) per jitted target, and a `RecompileSentinel`
+    that counts compile-cache misses per fn and warns when a target
+    recompiles after warmup.
+
+When tracing is disabled (the default) every instrumentation point is a
+single attribute check returning a shared no-op span — safe to leave in
+hot loops.
+"""
+
+from __future__ import annotations
+
+from . import trace  # noqa: F401
+from . import metrics  # noqa: F401
+from . import mfu  # noqa: F401
+from .trace import (  # noqa: F401
+    Tracer, get_tracer, load_trace, summarize,
+)
+from .metrics import Registry, Counter, Gauge, Histogram  # noqa: F401
+from .mfu import (  # noqa: F401
+    RecompileSentinel, RecompileWarning, device_peak_flops, runtime_report,
+)
+
+__all__ = [
+    "trace", "metrics", "mfu", "Tracer", "get_tracer", "load_trace",
+    "summarize", "Registry", "Counter", "Gauge", "Histogram",
+    "RecompileSentinel", "RecompileWarning", "device_peak_flops",
+    "runtime_report",
+]

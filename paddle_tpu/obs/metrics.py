@@ -1,0 +1,282 @@
+"""Metrics registry: counters, gauges, fixed-bucket histograms.
+
+Prometheus-shaped (`render()` emits text exposition format 0.0.4, served
+by `serve_llm`'s `GET /metrics`) but deliberately small: no label
+cardinality explosion, no timestamps, no client library.  Conventions:
+
+  * Counters are cumulative; `set()` exists so the engine's legacy
+    `stats[...] = n` writes can be backed by the registry (the /stats
+    JSON and /metrics text then read the SAME storage and cannot drift).
+  * Histograms use fixed bucket edges with Prometheus `le` semantics
+    (inclusive upper bound, cumulative counts, +Inf implicit).  They
+    also keep a bounded ring of RAW samples (`samples()`), because
+    percentiles interpolated from coarse buckets are too blunt for the
+    TTFT/ITL numbers bench.py reports — the ring gives exact p50/p99
+    over the recent window.
+  * Gauges may wrap a callable (`Gauge.set_function`) so render-time
+    reads instantaneous engine state (queue depth, free pages) without
+    the engine pushing on every step.
+"""
+
+from __future__ import annotations
+
+import bisect
+import collections
+import math
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry",
+           "DEFAULT_LATENCY_BUCKETS", "percentile"]
+
+# seconds; spans queue-wait through long decode tails
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    # non-finite first: int(nan/-inf) raises, and a dead gauge rendering
+    # NaN must not take the whole /metrics scrape down with it
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(int(f)) if f == int(f) else repr(f)
+
+
+def _fmt_labels(labels: Optional[dict]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Optional[dict] = None):
+        self.name = name
+        self.help = help
+        self.labels = dict(labels) if labels else None
+        self._lock = threading.Lock()
+
+    def sample_lines(self) -> List[str]:  # pragma: no cover — abstract
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def set(self, v: float) -> None:
+        """Absolute write — for registry-backed legacy counter dicts."""
+        with self._lock:
+            self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def sample_lines(self) -> List[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self._value)}"]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name, help="", labels=None):
+        super().__init__(name, help, labels)
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._value = float(v)
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    def set_function(self, fn: Callable[[], float]) -> "Gauge":
+        """Read the gauge from `fn()` at render/value time (instantaneous
+        engine state without push-on-every-step)."""
+        self._fn = fn
+        return self
+
+    @property
+    def value(self) -> float:
+        if self._fn is not None:
+            try:
+                return float(self._fn())
+            except Exception:  # noqa: BLE001 — a dying engine must not
+                return float("nan")  # take /metrics down with it
+        return self._value
+
+    def sample_lines(self) -> List[str]:
+        return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self.value)}"]
+
+
+class Histogram(_Metric):
+    """Fixed-bucket histogram with Prometheus `le` semantics plus a
+    bounded raw-sample ring for exact recent percentiles."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets: Sequence[float] =
+                 DEFAULT_LATENCY_BUCKETS, labels=None,
+                 sample_window: int = 4096):
+        super().__init__(name, help, labels)
+        edges = sorted(float(b) for b in buckets)
+        if not edges:
+            raise ValueError("histogram needs at least one bucket edge")
+        self.edges: Tuple[float, ...] = tuple(edges)
+        self._counts = [0] * (len(edges) + 1)   # +1: the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._samples: collections.deque = collections.deque(
+            maxlen=sample_window)
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        # le is an INCLUSIVE upper bound: v == edge lands in that bucket
+        i = bisect.bisect_left(self.edges, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            self._samples.append(v)
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def _cumulative(self, counts: List[int]) -> Dict[float, int]:
+        out, cum = {}, 0
+        for edge, c in zip(self.edges, counts):
+            cum += c
+            out[edge] = cum
+        out[math.inf] = cum + counts[-1]
+        return out
+
+    def bucket_counts(self) -> Dict[float, int]:
+        """Cumulative counts per `le` edge (+Inf included) — the exact
+        numbers the text format exposes."""
+        with self._lock:
+            counts = list(self._counts)
+        return self._cumulative(counts)
+
+    def samples(self) -> List[float]:
+        with self._lock:
+            return list(self._samples)
+
+    def percentile(self, q: float) -> float:
+        """Exact percentile over the recent raw-sample window (NOT a
+        bucket interpolation)."""
+        return percentile(self.samples(), q)
+
+    def sample_lines(self) -> List[str]:
+        # ONE snapshot under the lock: a concurrent observe() must not
+        # let the exposed _count disagree with the +Inf bucket (the
+        # Prometheus histogram invariant scrapers rely on)
+        with self._lock:
+            counts = list(self._counts)
+            total_sum, total_count = self._sum, self._count
+        lines = []
+        base = dict(self.labels) if self.labels else {}
+        for edge, cum in self._cumulative(counts).items():
+            lines.append(f"{self.name}_bucket"
+                         f"{_fmt_labels({**base, 'le': _fmt(edge)})} {cum}")
+        lines.append(f"{self.name}_sum{_fmt_labels(self.labels)} "
+                     f"{_fmt(total_sum)}")
+        lines.append(f"{self.name}_count{_fmt_labels(self.labels)} "
+                     f"{total_count}")
+        return lines
+
+
+def percentile(values: Iterable[float], q: float) -> float:
+    vals = sorted(values)
+    if not vals:
+        return 0.0
+    k = (len(vals) - 1) * float(q)
+    lo = int(k)
+    hi = min(lo + 1, len(vals) - 1)
+    return vals[lo] + (vals[hi] - vals[lo]) * (k - lo)
+
+
+class Registry:
+    """Named metric store; one per engine (or per process for training).
+    Metric families share a name; labeled children are distinguished by
+    their label dict."""
+
+    def __init__(self):
+        self._metrics: "collections.OrderedDict[tuple, _Metric]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    def _get_or_make(self, cls, name, help, labels, **kw):
+        key = (name, tuple(sorted((labels or {}).items())))
+        with self._lock:
+            m = self._metrics.get(key)
+            if m is None:
+                m = cls(name, help, labels=labels, **kw)
+                self._metrics[key] = m
+            elif not isinstance(m, cls):
+                raise ValueError(f"metric {name!r} already registered as "
+                                 f"{m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labels: Optional[dict] = None) -> Counter:
+        return self._get_or_make(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Optional[dict] = None) -> Gauge:
+        return self._get_or_make(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS,
+                  labels: Optional[dict] = None,
+                  sample_window: int = 4096) -> Histogram:
+        return self._get_or_make(Histogram, name, help, labels,
+                                 buckets=buckets,
+                                 sample_window=sample_window)
+
+    def get(self, name: str, labels: Optional[dict] = None):
+        key = (name, tuple(sorted((labels or {}).items())))
+        return self._metrics.get(key)
+
+    def collect(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def render(self) -> str:
+        """Prometheus text exposition format 0.0.4.  Families sharing a
+        name emit HELP/TYPE once, then every child's samples."""
+        by_family: "collections.OrderedDict[str, List[_Metric]]" = \
+            collections.OrderedDict()
+        for m in self.collect():
+            by_family.setdefault(m.name, []).append(m)
+        lines = []
+        for name, family in by_family.items():
+            head = family[0]
+            if head.help:
+                lines.append(f"# HELP {name} {head.help}")
+            lines.append(f"# TYPE {name} {head.kind}")
+            for m in family:
+                lines.extend(m.sample_lines())
+        return "\n".join(lines) + "\n"

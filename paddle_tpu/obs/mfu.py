@@ -1,0 +1,173 @@
+"""Measured-vs-static: runtime MFU, cost-model ratio, recompile sentinel.
+
+Graph Doctor's cost pass (analysis/cost.py) counts the FLOPs a jitted
+target *should* execute; the tracer measures how long it *did* take.
+This module joins the two, per jitted target:
+
+  * `runtime_report(...)` -> {flops_per_step, predicted_step_s,
+    measured_step_s, runtime_mfu, cost_model_ratio}.  `runtime_mfu` is
+    achieved FLOP/s over the chip's peak (jaxpr-counted FLOPs, so it can
+    differ from a 6N-formula MFU — that difference is signal, not
+    error).  `cost_model_ratio` is measured / predicted step time: ~1
+    means the static model is trustworthy for placement decisions, >>1
+    means the target is nowhere near compute-bound (or the model is
+    missing a term) — the gate the ROADMAP's autotuner/mega-kernel work
+    wants before trusting static numbers.
+  * `RecompileSentinel` watches jitted fns' compile caches and warns
+    (python warning + tracer instant event + registry counter) when a
+    target recompiles AFTER warmup — the runtime companion to the
+    static RECOMPILE_* lints: those predict hazards, this catches the
+    ones that actually fire in production.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import Callable, Dict, Optional
+
+__all__ = ["PEAK_FLOPS_BY_KIND", "device_peak_flops", "runtime_report",
+           "RecompileSentinel", "RecompileWarning"]
+
+# bf16 peak FLOP/s per chip; ordered most-specific-first for substring
+# match on device_kind (bench.py delegates here — one table, one truth)
+PEAK_FLOPS_BY_KIND = (
+    ("v6e", 918e12), ("v6", 918e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v5litepod", 197e12), ("v5p", 459e12), ("v5", 459e12), ("v4", 275e12),
+)
+
+
+def device_peak_flops(device=None) -> float:
+    """Peak bf16 FLOP/s of `device` (default: jax.devices()[0]).
+    Returns 0.0 for CPU — MFU is not meaningful there and callers must
+    treat 0 as "no peak known"."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for k, v in PEAK_FLOPS_BY_KIND:
+        if k in kind:
+            return v
+    if getattr(device, "platform", None) == "tpu":
+        return 459e12  # assume v5p-class
+    return 0.0
+
+
+def runtime_report(measured_step_s: float, flops_per_step: float,
+                   peak_flops: Optional[float] = None,
+                   device=None) -> dict:
+    """Join one measured step time with its static FLOPs count.
+
+    With no known peak (CPU): runtime_mfu = 0.0 and cost_model_ratio =
+    None rather than a fabricated number."""
+    if peak_flops is None:
+        peak_flops = device_peak_flops(device)
+    measured_step_s = float(measured_step_s)
+    flops_per_step = float(flops_per_step)
+    out = {
+        "flops_per_step": flops_per_step,
+        "measured_step_s": measured_step_s,
+        "predicted_step_s": None,
+        "runtime_mfu": 0.0,
+        "cost_model_ratio": None,
+    }
+    if peak_flops > 0 and measured_step_s > 0:
+        predicted = flops_per_step / peak_flops
+        out["predicted_step_s"] = predicted
+        out["runtime_mfu"] = flops_per_step / measured_step_s / peak_flops
+        if predicted > 0:
+            out["cost_model_ratio"] = measured_step_s / predicted
+    return out
+
+
+def static_flops(fn, *args, **kwargs) -> float:
+    """jaxpr-counted FLOPs of one call of `fn(*args)` (the cost pass's
+    roll-up; nothing executes)."""
+    from ..analysis import cost as cost_lib
+
+    return cost_lib.total_flops(fn, *args, **kwargs)
+
+
+class RecompileWarning(UserWarning):
+    """A watched jitted target recompiled after warmup."""
+
+
+def _cache_size(fn) -> Optional[int]:
+    """Compile-cache entry count of a jitted fn, or None when this jax
+    doesn't expose it (sentinel goes inert, never wrong)."""
+    try:
+        get = getattr(fn, "_cache_size", None)
+        return None if get is None else int(get())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+class _Watch:
+    __slots__ = ("fn", "baseline", "recompiles")
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.baseline: Optional[int] = None
+        self.recompiles = 0
+
+
+class RecompileSentinel:
+    """Counts compile-cache misses per watched jitted fn.
+
+    `watch(name, jitted_fn)` registers a target; call `check()` once per
+    step.  The first check snapshots the cache size as the warmup
+    baseline (the initial compile is expected); any LATER growth counts
+    as a recompile and emits a RecompileWarning, a tracer instant event
+    ("recompile"), and bumps the registry counter
+    `recompiles_total{fn=...}`.  Steady-state steps are silent — 50 warm
+    steps must not produce a single event (pinned by tests/test_obs.py).
+    """
+
+    def __init__(self, tracer=None, registry=None):
+        self._watches: Dict[str, _Watch] = {}
+        self.tracer = tracer
+        self.registry = registry
+
+    def watch(self, name: str, jitted_fn: Callable) -> "RecompileSentinel":
+        self._watches[name] = _Watch(jitted_fn)
+        return self
+
+    def check(self) -> Dict[str, int]:
+        """One step boundary: compare each watched fn's cache size to its
+        baseline; fire on growth.  Returns {name: new_misses_this_check}.
+        """
+        fired = {}
+        for name, w in self._watches.items():
+            n = _cache_size(w.fn)
+            if n is None:
+                continue
+            if w.baseline is None:
+                w.baseline = n         # warmup compile(s): expected
+                continue
+            if n > w.baseline:
+                miss = n - w.baseline
+                w.baseline = n
+                w.recompiles += miss
+                fired[name] = miss
+                self._emit(name, miss, w.recompiles)
+        return fired
+
+    def _emit(self, name: str, miss: int, total: int) -> None:
+        warnings.warn(
+            f"jitted target {name!r} recompiled after warmup "
+            f"(+{miss} cache entr{'y' if miss == 1 else 'ies'}, "
+            f"{total} total): a shape/dtype/static-arg changed mid-run — "
+            f"see the RECOMPILE_* lints for the static-side hazard list",
+            RecompileWarning, stacklevel=3)
+        if self.tracer is not None:
+            self.tracer.instant("recompile", fn=name, misses=miss,
+                                total=total)
+        if self.registry is not None:
+            self.registry.counter(
+                "recompiles_total",
+                "post-warmup compile-cache misses per jitted target",
+                labels={"fn": name}).inc(miss)
+
+    def counts(self) -> Dict[str, int]:
+        """{name: post-warmup recompiles so far} for every watched fn."""
+        return {name: w.recompiles for name, w in self._watches.items()}

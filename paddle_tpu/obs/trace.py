@@ -1,0 +1,317 @@
+"""Span tracer: ring-buffered wall-time spans with device fencing.
+
+Design constraints, in order:
+
+  1. Disabled cost ~ zero.  `Tracer.span()` is ONE branch when disabled,
+     returning a shared no-op context manager — no allocation, no clock
+     read.  Instrumentation can therefore live permanently inside the
+     engine's decode loop.
+  2. Honest device timing.  jax dispatch is async: closing a span right
+     after `fn(...)` times the *enqueue*.  A span carrying a fence value
+     (`sp.fence(arrays)`) calls `jax.block_until_ready` on it before
+     taking the closing timestamp, so the span covers the compute.  On
+     CPU interpret paths execution is synchronous and the fence is a
+     cheap no-op — but keep it: the same code path must time correctly
+     on a real chip.
+  3. Bounded memory.  Events land in a `deque(maxlen=capacity)`; a
+     long-running server overwrites its oldest spans instead of growing.
+
+Spans record (name, t0, t1, thread, step, attrs).  `step` is the
+current profiler step lane — `step_mark(n)` (called by
+`profiler.Profiler.step()` and the hapi ObsCallback) assigns subsequent
+spans on that thread to step `n`, which the Chrome exporter renders as
+per-step lanes instead of one flat track.
+
+Export: `export_chrome(path)` writes chrome://tracing / Perfetto JSON
+(`ph:"X"` complete events in microseconds); `load_trace(path)` reads it
+back; `summarize(events_or_path)` aggregates per-name totals and
+percentiles — the table `tools/trace_summary.py` prints.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+__all__ = ["SpanEvent", "Tracer", "get_tracer", "set_tracer", "load_trace",
+           "summarize", "format_summary"]
+
+
+class SpanEvent:
+    """One recorded span (ph="X") or instant (ph="i"); times are
+    `time.perf_counter()` seconds."""
+
+    __slots__ = ("name", "t0", "t1", "tid", "step", "attrs", "ph")
+
+    def __init__(self, name, t0, t1, tid, step=None, attrs=None, ph="X"):
+        self.name = name
+        self.t0 = t0
+        self.t1 = t1
+        self.tid = tid
+        self.step = step
+        self.attrs = attrs
+        self.ph = ph
+
+    @property
+    def dur(self) -> float:
+        return self.t1 - self.t0
+
+    def __repr__(self):
+        return (f"SpanEvent({self.name!r}, dur={self.dur * 1e3:.3f}ms, "
+                f"step={self.step})")
+
+
+class _NoopSpan:
+    """Shared do-nothing span returned while the tracer is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence(self, value):
+        return self
+
+    def set(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "attrs", "_t0", "_fence")
+
+    def __init__(self, tracer: "Tracer", name: str, attrs: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self._fence = None
+
+    def fence(self, value) -> "_Span":
+        """Block on `value` (any pytree of jax arrays) before the closing
+        timestamp, so the span covers the device compute, not the
+        enqueue."""
+        self._fence = value
+        return self
+
+    def set(self, **attrs) -> "_Span":
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._fence is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._fence)
+            except Exception:  # noqa: BLE001 — a deleted/donated buffer
+                pass           # must not turn a trace span into a crash
+        self._tracer._record_span(self.name, self._t0, time.perf_counter(),
+                                  self.attrs)
+        return False
+
+
+class Tracer:
+    """Ring-buffered span recorder.  Disabled by default: `span()` /
+    `instant()` cost one branch until `enable()` is called."""
+
+    def __init__(self, capacity: int = 65536, enabled: bool = False):
+        self.enabled = bool(enabled)
+        self._events: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        # step lanes are PER-THREAD: the training thread's step_mark must
+        # not pull the engine thread's spans into its lane
+        self._steps = threading.local()
+
+    @property
+    def _step(self) -> Optional[int]:
+        return getattr(self._steps, "v", None)
+
+    # -- control ------------------------------------------------------------
+
+    def enable(self) -> "Tracer":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "Tracer":
+        self.enabled = False
+        return self
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+        self._steps = threading.local()   # stale lanes die with the ring
+
+    # -- recording ----------------------------------------------------------
+
+    def span(self, name: str, **attrs):
+        """Context manager timing a host span.  `with tr.span("prefill",
+        slot=3) as sp: ... sp.fence(logits)`.  No-op when disabled."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name, attrs or None)
+
+    def instant(self, name: str, **attrs) -> None:
+        """Zero-duration marker event (warnings, recompiles, preemptions)."""
+        if not self.enabled:
+            return
+        t = time.perf_counter()
+        ev = SpanEvent(name, t, t, threading.get_ident(), self._step,
+                       attrs or None, ph="i")
+        with self._lock:
+            self._events.append(ev)
+
+    def record(self, name: str, t0: float, t1: float,
+               attrs: Optional[dict] = None) -> None:
+        """Record an externally-timed span (profiler RecordEvent feeds
+        this).  No-op when disabled."""
+        if not self.enabled:
+            return
+        self._record_span(name, t0, t1, attrs)
+
+    def _record_span(self, name, t0, t1, attrs) -> None:
+        ev = SpanEvent(name, t0, t1, threading.get_ident(), self._step,
+                       attrs)
+        with self._lock:
+            self._events.append(ev)
+
+    def step_mark(self, step: int) -> None:
+        """Open step lane `step` ON THIS THREAD: its subsequent spans
+        carry it, and the Chrome exporter groups them into per-step
+        tracks.  Other threads' spans keep their thread lanes."""
+        if not self.enabled:
+            return
+        self._steps.v = int(step)
+        self.instant(f"ProfileStep#{step}", step=int(step))
+
+    # -- reading ------------------------------------------------------------
+
+    def events(self) -> List[SpanEvent]:
+        with self._lock:
+            return list(self._events)
+
+    def export_chrome(self, path: Optional[str] = None,
+                      extra: Optional[dict] = None) -> Union[str, dict]:
+        """Chrome/Perfetto trace JSON.  Spans recorded inside a step lane
+        get `tid = step` (with thread_name metadata "step N") so the
+        viewer shows one lane per profiler step; un-stepped spans keep
+        their real thread id.  Returns the path (when given) or the
+        trace dict."""
+        pid = os.getpid()
+        events = []
+        lanes: Dict[int, str] = {}
+        for e in self.events():
+            if e.step is not None:
+                tid, lane = int(e.step), f"step {e.step}"
+            else:
+                tid, lane = int(e.tid % 2 ** 31), f"thread {e.tid}"
+            lanes.setdefault(tid, lane)
+            ev = {"name": e.name, "ph": e.ph, "cat": "host",
+                  "ts": e.t0 * 1e6, "pid": pid, "tid": tid}
+            if e.ph == "X":
+                ev["dur"] = (e.t1 - e.t0) * 1e6
+            else:
+                ev["s"] = "t"      # instant scope: thread
+            if e.attrs:
+                ev["args"] = dict(e.attrs)
+            events.append(ev)
+        for tid, lane in sorted(lanes.items()):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": tid, "args": {"name": lane}})
+        trace = {"traceEvents": events}
+        if extra:
+            trace.update(extra)
+        if path is None:
+            return trace
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+
+# the process-wide default tracer: the engine, the profiler, and the hapi
+# callback all record here unless handed their own instance — ONE event
+# spine, so a single export interleaves serving and training spans
+_default = Tracer()
+
+
+def get_tracer() -> Tracer:
+    return _default
+
+
+def set_tracer(tracer: Tracer) -> Tracer:
+    """Swap the process default (tests isolate themselves with this).
+    Returns the previous tracer."""
+    global _default
+    prev, _default = _default, tracer
+    return prev
+
+
+def load_trace(path: str) -> List[dict]:
+    """Read back an exported Chrome trace: the raw traceEvents list."""
+    with open(path) as f:
+        data = json.load(f)
+    return data["traceEvents"] if isinstance(data, dict) else data
+
+
+def summarize(events_or_path) -> Dict[str, dict]:
+    """Per-span-name aggregate over complete ("X") events: {name:
+    {count, total_s, mean_s, p50_s, p90_s, p99_s, max_s}}.  Accepts a
+    trace path, the loaded traceEvents list, or `Tracer.events()`."""
+    if isinstance(events_or_path, str):
+        events_or_path = load_trace(events_or_path)
+    durs: Dict[str, List[float]] = {}
+    for e in events_or_path:
+        if isinstance(e, SpanEvent):
+            if e.ph != "X":
+                continue
+            name, dur = e.name, e.dur
+        else:
+            if e.get("ph") != "X":
+                continue
+            name, dur = e["name"], e.get("dur", 0.0) * 1e-6
+        durs.setdefault(name, []).append(dur)
+    from .metrics import percentile
+
+    out = {}
+    for name, ds in durs.items():
+        ds.sort()
+        out[name] = {
+            "count": len(ds),
+            "total_s": sum(ds),
+            "mean_s": sum(ds) / len(ds),
+            "p50_s": percentile(ds, 0.50),
+            "p90_s": percentile(ds, 0.90),
+            "p99_s": percentile(ds, 0.99),
+            "max_s": ds[-1],
+        }
+    return out
+
+
+def format_summary(summary: Dict[str, dict], time_unit: str = "ms") -> str:
+    """Fixed-width table of `summarize()` output, heaviest total first."""
+    unit = {"s": 1.0, "ms": 1e3, "us": 1e6}[time_unit]
+    u = time_unit
+    lines = [f"{'span':28}  {'count':>7}  {'total(' + u + ')':>12}  "
+             f"{'mean':>10}  {'p50':>10}  {'p90':>10}  {'p99':>10}  "
+             f"{'max':>10}"]
+    for name, s in sorted(summary.items(), key=lambda kv: -kv[1]["total_s"]):
+        lines.append(
+            f"{name[:28]:28}  {s['count']:>7}  {s['total_s'] * unit:>12.3f}"
+            f"  {s['mean_s'] * unit:>10.3f}  {s['p50_s'] * unit:>10.3f}"
+            f"  {s['p90_s'] * unit:>10.3f}  {s['p99_s'] * unit:>10.3f}"
+            f"  {s['max_s'] * unit:>10.3f}")
+    return "\n".join(lines)

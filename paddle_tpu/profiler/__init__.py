@@ -145,11 +145,14 @@ class Profiler:
 
     def step(self, num_samples: Optional[int] = None):
         self.timer.step(num_samples)
+        prev = self._state
+        self._step += 1
+        # mark AFTER the increment: the marker opens step lane N for the
+        # spans that follow, so the chrome export shows per-step lanes
+        # instead of one flat track
         from .utils import _host_events
 
         _host_events.step_mark(self._step)
-        prev = self._state
-        self._step += 1
         new = self._scheduler(self._step)
         if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) and \
                 (prev is ProfilerState.RECORD_AND_RETURN
@@ -166,9 +169,15 @@ class Profiler:
         self._state = state
 
     def _start_record(self):
+        from ..obs import trace as obs_trace
         from .utils import _host_events
 
-        _host_events.clear()
+        # remember whether someone else (engine tracing, ObsCallback) had
+        # the shared spine on: leaving RECORD must restore their switch,
+        # not silence them
+        self._tracer_was_enabled = obs_trace.get_tracer().enabled
+        if not self._tracer_was_enabled:
+            _host_events.clear()    # fresh profiler session owns the ring
         _host_events.enable()
         if self._jax_tracing:
             return
@@ -186,7 +195,8 @@ class Profiler:
     def _stop_record(self):
         from .utils import _host_events
 
-        _host_events.disable()
+        if not getattr(self, "_tracer_was_enabled", False):
+            _host_events.disable()
         if self._jax_tracing:
             try:
                 import jax
@@ -205,18 +215,14 @@ class Profiler:
 
     # -- export / summary --------------------------------------------------
     def _export_chrome(self, path):
-        from .utils import _host_events
+        # the obs tracer IS the host-event store: export its ring (span
+        # nesting, step lanes, engine spans if serving shares the spine)
+        from ..obs import trace as obs_trace
 
-        events = [{
-            "name": e.name, "ph": "X", "cat": "host",
-            "ts": e.t0 * 1e6, "dur": (e.t1 - e.t0) * 1e6,
-            "pid": os.getpid(), "tid": e.tid,
-        } for e in _host_events.events]
-        with open(path, "w") as f:
-            json.dump({"traceEvents": events,
-                       "note": ("device timeline lives in the jax.profiler "
-                                "XPlane dump"),
-                       "xplane_dir": self._tmpdir}, f)
+        obs_trace.get_tracer().export_chrome(path, extra={
+            "note": ("device timeline lives in the jax.profiler "
+                     "XPlane dump"),
+            "xplane_dir": self._tmpdir})
         return path
 
     def export(self, path, format="json"):

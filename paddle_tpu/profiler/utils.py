@@ -1,14 +1,19 @@
 """RecordEvent + host event recorder (reference: profiler/utils.py:38
-RecordEvent over C++ HostEventRecorder; here a lock-light in-process list +
-jax.named_scope so spans also land inside the XLA trace)."""
+RecordEvent over C++ HostEventRecorder).
+
+The recorder is an adapter over the paddle_tpu.obs span tracer — the
+profiler, the LLMEngine, and the hapi ObsCallback all record into ONE
+event spine, so a single chrome export interleaves training spans with
+serving spans.  RecordEvent additionally opens jax.named_scope so span
+names land inside the XLA HLO metadata and the device profile."""
 
 from __future__ import annotations
 
 import contextlib
 import functools
-import threading
 import time
-from typing import List, Optional
+
+from ..obs import trace as _obs_trace
 
 
 class _HostEvent:
@@ -22,30 +27,36 @@ class _HostEvent:
 
 
 class _HostEventRecorder:
-    def __init__(self):
-        self.events: List[_HostEvent] = []
-        self._enabled = False
-        self._lock = threading.Lock()
+    """Back-compat shim: the historical `_host_events` surface
+    (enable/disable/clear/add/events) now delegates to the process-wide
+    obs tracer.  Enabling a Profiler therefore enables the shared
+    tracer — by design: one spine, one switch."""
+
+    @property
+    def _tracer(self) -> "_obs_trace.Tracer":
+        return _obs_trace.get_tracer()
 
     def enable(self):
-        self._enabled = True
+        self._tracer.enable()
 
     def disable(self):
-        self._enabled = False
+        self._tracer.disable()
 
     def clear(self):
-        with self._lock:
-            self.events = []
+        self._tracer.clear()
 
     def add(self, name, t0, t1):
-        if self._enabled:
-            with self._lock:
-                self.events.append(_HostEvent(name, t0, t1,
-                                              threading.get_ident()))
+        self._tracer.record(name, t0, t1)
 
     def step_mark(self, step):
-        self.add(f"ProfileStep#{step}", time.perf_counter(),
-                 time.perf_counter())
+        self._tracer.step_mark(step)
+
+    @property
+    def events(self):
+        """Complete ("X") spans in the legacy 4-field shape (summary()
+        consumes this; step marks are instants and aggregate nowhere)."""
+        return [_HostEvent(e.name, e.t0, e.t1, e.tid)
+                for e in self._tracer.events() if e.ph == "X"]
 
 
 _host_events = _HostEventRecorder()

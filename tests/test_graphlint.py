@@ -9,6 +9,7 @@ the same target builders tools/graphlint.py uses.
 
 import functools
 import importlib.util
+import json
 import os
 import threading
 
@@ -549,3 +550,17 @@ def test_shipped_model_lints_clean(target):
     bad = [str(f) for f in report if f.severity >= Severity.WARNING]
     assert report.ok(Severity.WARNING), \
         f"{target} has undocumented findings:\n" + "\n".join(bad)
+
+
+def test_baseline_gate_tier1(capsys):
+    """graphlint --baseline rides the tier-1 entrypoint: a change that
+    grows a NEW finding code (or escalates one) on any shipped target
+    fails here, alongside the unit tests, without waiting for a bench
+    round.  jaxpr tier only — the HLO tier's compile budget lives in
+    test_graphlint_hlo.py."""
+    baseline = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "GRAPHLINT_BASELINE.json")
+    rc = _graphlint.main(["--baseline", baseline, "--no-hlo", "--json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, ("new graphlint finding codes vs baseline:\n"
+                     + "\n".join(out["new_vs_baseline"]))

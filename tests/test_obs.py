@@ -1,0 +1,405 @@
+"""Runtime telemetry (paddle_tpu.obs): span tracer semantics + chrome
+export round-trip, metrics registry / Prometheus rendering, per-request
+TTFT / inter-token derivation from a scripted LLMEngine run, the
+recompile sentinel, and the serving HTTP surface (Content-Type headers,
+/metrics exposition)."""
+
+import importlib.util
+import json
+import os
+import re
+import time
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu import obs
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import mfu as obs_mfu
+from paddle_tpu.obs import trace as obs_trace
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    path = os.path.join(_REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ---------------------------------------------------------------------------
+# tracer
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_disabled_span_is_shared_noop(self):
+        tr = obs.Tracer()
+        s1, s2 = tr.span("a"), tr.span("b", x=1)
+        assert s1 is s2          # ONE shared no-op object: no allocation
+        with s1 as sp:
+            sp.fence(jnp.zeros(2)).set(k=1)
+        tr.instant("marker")
+        tr.step_mark(3)
+        assert tr.events() == []
+
+    def test_span_nesting_and_durations(self):
+        tr = obs.Tracer(enabled=True)
+        with tr.span("outer"):
+            with tr.span("inner"):
+                time.sleep(0.005)
+        inner, outer = tr.events()   # inner closes (and lands) first
+        assert (inner.name, outer.name) == ("inner", "outer")
+        assert outer.t0 <= inner.t0 and inner.t1 <= outer.t1
+        assert outer.dur >= inner.dur >= 0.005
+
+    def test_ring_buffer_bounds_memory(self):
+        tr = obs.Tracer(capacity=8, enabled=True)
+        for i in range(20):
+            tr.instant(f"e{i}")
+        evs = tr.events()
+        assert len(evs) == 8 and evs[-1].name == "e19"
+
+    def test_fence_records_after_device_work(self):
+        tr = obs.Tracer(enabled=True)
+        x = jnp.ones((256, 256))
+        f = jax.jit(lambda a: a @ a)
+        with tr.span("mm") as sp:
+            sp.fence(f(x))
+        (ev,) = tr.events()
+        assert ev.dur > 0
+
+    def test_export_roundtrip_summary_matches(self, tmp_path):
+        tr = obs.Tracer(enabled=True)
+        tr.record("prefill", 1.0, 1.5)
+        tr.record("decode", 2.0, 2.25)
+        tr.record("decode", 3.0, 3.5)
+        tr.instant("evict", slot=1)
+        path = tr.export_chrome(str(tmp_path / "t.json"))
+        direct = obs.summarize(tr.events())
+        loaded = obs.summarize(obs.load_trace(path))
+        assert set(direct) == set(loaded) == {"prefill", "decode"}
+        for name in direct:
+            assert loaded[name]["count"] == direct[name]["count"]
+            assert loaded[name]["total_s"] == pytest.approx(
+                direct[name]["total_s"], abs=1e-9)
+        assert direct["decode"]["total_s"] == pytest.approx(0.75)
+        assert direct["decode"]["max_s"] == pytest.approx(0.5)
+
+    def test_step_marks_become_lanes(self, tmp_path):
+        tr = obs.Tracer(enabled=True)
+        tr.step_mark(0)
+        with tr.span("work"):
+            pass
+        tr.step_mark(1)
+        with tr.span("work"):
+            pass
+        trace = tr.export_chrome()
+        lanes = {e["tid"] for e in trace["traceEvents"]
+                 if e.get("ph") == "X" and e["name"] == "work"}
+        assert lanes == {0, 1}   # per-step lanes, not one flat track
+        names = {e["args"]["name"] for e in trace["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert {"step 0", "step 1"} <= names
+
+    def test_step_lane_is_thread_local(self):
+        import threading
+
+        tr = obs.Tracer(enabled=True)
+        tr.step_mark(5)               # training thread opens lane 5
+
+        def engine_side():
+            with tr.span("decode_step"):
+                pass
+
+        t = threading.Thread(target=engine_side)
+        t.start()
+        t.join()
+        by_name = {e.name: e for e in tr.events() if e.ph == "X"}
+        # the other thread's span keeps its thread lane — it must NOT be
+        # pulled into the training thread's step lane
+        assert by_name["decode_step"].step is None
+        tr.clear()
+        with tr.span("later"):
+            pass
+        (ev,) = [e for e in tr.events() if e.ph == "X"]
+        assert ev.step is None        # clear() kills stale lanes too
+
+    def test_trace_summary_cli(self, tmp_path, capsys):
+        tr = obs.Tracer(enabled=True)
+        tr.record("train_step", 0.0, 0.125)
+        tr.record("train_step", 0.0, 0.375)
+        path = tr.export_chrome(str(tmp_path / "t.json"))
+        tool = _load_tool("trace_summary")
+        assert tool.main([path]) == 0
+        table = capsys.readouterr().out
+        assert "train_step" in table and "p99" in table
+        assert tool.main([path, "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert d["train_step"]["count"] == 2
+        assert d["train_step"]["total_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+# "name{labels} value" with the label block optional
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+="[^"]*"'
+    r'(,[a-zA-Z0-9_]+="[^"]*")*\})? (\+Inf|-?[0-9.e+-]+|NaN)$')
+
+
+class TestMetrics:
+    def test_histogram_bucket_edges_are_inclusive(self):
+        h = obs_metrics.Histogram("h_seconds", buckets=(1.0, 2.0, 5.0))
+        h.observe(1.0)           # le="1" is an INCLUSIVE upper bound
+        assert h.bucket_counts() == {1.0: 1, 2.0: 1, 5.0: 1, float("inf"): 1}
+        h.observe(1.0000001)     # just past the edge -> next bucket
+        assert h.bucket_counts()[1.0] == 1
+        assert h.bucket_counts()[2.0] == 2
+        h.observe(7.0)           # beyond the last edge -> +Inf only
+        counts = h.bucket_counts()
+        assert counts[5.0] == 2 and counts[float("inf")] == 3
+        assert h.count == 3 and h.sum == pytest.approx(9.0000001)
+
+    def test_histogram_render_is_cumulative_prometheus(self):
+        reg = obs.Registry()
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0))
+        for v in (0.05, 0.5, 2.0):
+            h.observe(v)
+        text = reg.render()
+        assert '# TYPE lat_seconds histogram' in text
+        assert 'lat_seconds_bucket{le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{le="1"} 2' in text
+        assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+        assert 'lat_seconds_count 3' in text
+
+    def test_render_lines_are_valid_exposition(self):
+        reg = obs.Registry()
+        reg.counter("c_total", "a counter").inc(2)
+        reg.gauge("g", "a gauge").set(1.5)
+        reg.counter("labeled_total", "with labels",
+                    labels={"fn": "step"}).inc()
+        reg.histogram("h_seconds", buckets=(1,)).observe(0.5)
+        for line in reg.render().strip().splitlines():
+            if line.startswith("#"):
+                assert line.startswith(("# HELP ", "# TYPE "))
+            else:
+                assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+
+    def test_gauge_function_reads_lazily(self):
+        reg = obs.Registry()
+        state = {"n": 1}
+        reg.gauge("depth").set_function(lambda: state["n"])
+        assert "depth 1" in reg.render()
+        state["n"] = 7
+        assert "depth 7" in reg.render()
+
+    def test_registry_kind_clash_rejected(self):
+        reg = obs.Registry()
+        reg.counter("x_total")
+        with pytest.raises(ValueError):
+            reg.gauge("x_total")
+
+    def test_histogram_raw_percentiles(self):
+        h = obs_metrics.Histogram("h", buckets=(1e9,), sample_window=512)
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.percentile(0.5) == pytest.approx(50.5)
+        assert h.percentile(0.99) == pytest.approx(99.01)
+        assert h.percentile(1.0) == 100.0
+
+
+# ---------------------------------------------------------------------------
+# engine telemetry: TTFT / ITL derivation, snapshot truth, /metrics HTTP
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    from paddle_tpu.models import llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _mk_engine(tiny, **kw):
+    from paddle_tpu.inference import LLMEngine
+
+    cfg, params = tiny
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    return LLMEngine(params, cfg, **kw)
+
+
+class TestEngineTelemetry:
+    def test_ttft_and_itl_derivation_scripted(self, tiny):
+        tr = obs.Tracer(enabled=True)
+        eng = _mk_engine(tiny, tracer=tr)
+        prompts = [[1, 2, 3], [4, 5]]
+        new = 4
+        out = eng.generate(prompts, max_new_tokens=new)
+        assert [len(o) for o in out] == [new, new]
+        # TTFT: one sample per request, = first-token time - submit time
+        ttft = eng._h_ttft.samples()
+        assert len(ttft) == len(prompts) and all(v > 0 for v in ttft)
+        # ITL: every token after the first is one gap observation
+        itl = eng._h_itl.samples()
+        assert len(itl) == len(prompts) * (new - 1)
+        assert all(v >= 0 for v in itl)
+        # queue wait: one per admission; tokens/sec: one per completion
+        assert len(eng._h_queue_wait.samples()) == len(prompts)
+        tps = eng._h_tps.samples()
+        assert len(tps) == len(prompts) and all(v > 0 for v in tps)
+        # the span spine saw the whole lifecycle
+        names = {e.name for e in tr.events()}
+        assert {"engine_step", "admit", "prefill", "decode_step",
+                "sample"} <= names
+
+    def test_snapshot_gains_uptime_and_steps(self, tiny):
+        eng = _mk_engine(tiny)
+        eng.generate([[1, 2]], max_new_tokens=4)
+        snap = eng.stats_snapshot()
+        assert snap["uptime_s"] > 0
+        assert snap["steps_total"] >= 2   # admit step + >=1 decode-only step
+        # /stats is sourced from the registry: identical storage
+        for key in ("accepted", "admitted", "completed"):
+            counter = eng.metrics.get(f"llm_{key}_total")
+            assert counter is not None
+            assert int(counter.value) == snap[key]
+
+    def test_invariants_include_registry_consistency(self, tiny):
+        from paddle_tpu.inference import faults
+
+        eng = _mk_engine(tiny)
+        h = eng.submit([1, 2, 3], max_new_tokens=2)
+        faults.drive(eng, [h])
+        report = faults.check_invariants(eng, [h], probe=False)
+        assert report["ok"]
+        # the check has teeth: a drifted terminal counter is a violation
+        eng.stats["completed"] += 1
+        with pytest.raises(faults.InvariantViolation,
+                           match="metrics identity"):
+            faults.check_invariants(eng, [h], probe=False)
+
+    def test_http_content_types_and_prometheus(self, tiny):
+        from paddle_tpu.inference import serve_llm
+
+        eng = _mk_engine(tiny, max_pending=8)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            req = urllib.request.Request(url, data=json.dumps(
+                {"prompt": [1, 2, 3], "max_new_tokens": 3}).encode())
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                assert json.loads(resp.read())["tokens"]
+            with urllib.request.urlopen(url + "stats", timeout=30) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                assert json.loads(r.read())["completed"] >= 1
+            with urllib.request.urlopen(url + "healthz", timeout=30) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                assert json.loads(r.read())["ok"] is True
+            with urllib.request.urlopen(url + "metrics", timeout=30) as r:
+                ctype = r.headers["Content-Type"]
+                assert ctype.startswith("text/plain")
+                assert "version=0.0.4" in ctype
+                body = r.read().decode()
+        finally:
+            srv.shutdown()
+        # live-run histograms are populated and the text is valid
+        assert "# TYPE llm_ttft_seconds histogram" in body
+        assert "# TYPE llm_inter_token_seconds histogram" in body
+        counts = {m.group(1): float(m.group(2)) for m in re.finditer(
+            r"^llm_(\w+_seconds)_count (\S+)$", body, re.M)}
+        assert counts["ttft_seconds"] >= 1
+        assert counts["inter_token_seconds"] >= 1
+        for line in body.strip().splitlines():
+            if not line.startswith("#"):
+                assert _SAMPLE_RE.match(line), f"bad sample line: {line!r}"
+
+
+# ---------------------------------------------------------------------------
+# recompile sentinel + measured-vs-static
+# ---------------------------------------------------------------------------
+
+
+class TestRecompileSentinel:
+    def test_fires_on_shape_change_silent_when_warm(self):
+        tr = obs.Tracer(enabled=True)
+        reg = obs.Registry()
+        sent = obs.RecompileSentinel(tracer=tr, registry=reg)
+        f = jax.jit(lambda x: x * 2)
+        sent.watch("f", f)
+        f(jnp.zeros((4,)))
+        assert sent.check() == {}       # warmup compile: baselined, silent
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", obs.RecompileWarning)
+            for _ in range(50):         # warm steps: not a single event
+                f(jnp.zeros((4,)))
+                assert sent.check() == {}
+        f(jnp.zeros((5,)))              # shape change -> cache miss
+        with pytest.warns(obs.RecompileWarning, match="'f' recompiled"):
+            fired = sent.check()
+        assert fired == {"f": 1} and sent.counts() == {"f": 1}
+        c = reg.get("recompiles_total", labels={"fn": "f"})
+        assert c is not None and c.value == 1
+        assert any(e.name == "recompile" for e in tr.events())
+
+    def test_runtime_report_joins_measured_and_static(self):
+        rep = obs.runtime_report(measured_step_s=0.002,
+                                 flops_per_step=197e9,
+                                 peak_flops=197e12)
+        # predicted 1 ms vs measured 2 ms: half the chip, 2x the model
+        assert rep["predicted_step_s"] == pytest.approx(1e-3)
+        assert rep["runtime_mfu"] == pytest.approx(0.5)
+        assert rep["cost_model_ratio"] == pytest.approx(2.0)
+        # no known peak (CPU): explicit "no number" over a fabricated one
+        rep = obs.runtime_report(0.002, 197e9, peak_flops=0.0)
+        assert rep["runtime_mfu"] == 0.0
+        assert rep["cost_model_ratio"] is None
+
+    def test_static_flops_matches_cost_pass(self):
+        from paddle_tpu.analysis import cost
+
+        def f(a, b):
+            return a @ b
+
+        a = jnp.zeros((8, 16))
+        b = jnp.zeros((16, 4))
+        want = cost.estimate(f, a, b)["total_flops"]
+        assert obs_mfu.static_flops(f, a, b) == want == 2 * 8 * 16 * 4
+
+
+class TestObsCallback:
+    def test_callback_records_fenced_steps_and_exports(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ObsCallback
+
+        tr = obs.Tracer(enabled=False)
+        path = str(tmp_path / "train.json")
+        cb = ObsCallback(tracer=tr, export_path=path,
+                         fence_of=lambda logs: logs.get("out"))
+        f = jax.jit(lambda x: (x * 2).sum())
+        cb.watch("f", f)
+        cb.on_train_begin()
+        assert tr.enabled            # the callback owns the switch
+        for step in range(3):
+            cb.on_train_batch_begin(step)
+            out = f(jnp.ones((8,)))
+            cb.on_train_batch_end(step, logs={"out": out})
+        cb.on_train_end()
+        assert not tr.enabled        # restored to the pre-train state
+        assert cb.step_summary()["steps"] == 3
+        assert cb.sentinel.counts() == {"f": 0}
+        summary = obs.summarize(obs.load_trace(path))
+        assert summary["train_step"]["count"] == 3

@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""trace_summary — per-span time/percentile table from an exported trace.
+
+Consumes the Chrome/Perfetto JSON the obs tracer writes (engine spans via
+`LLMEngine(tracer=...)`, training spans via the hapi ObsCallback /
+`examples/train_llama.py --trace`, profiler spans via
+`profiler.export_chrome_tracing`) and prints count / total / mean / p50 /
+p90 / p99 / max per span name, heaviest total first.
+
+Usage:
+  python tools/trace_summary.py TRACE.json [--unit ms|us|s] [--json]
+          [--top N]
+
+--json emits the aggregate as one machine-readable object instead of the
+table (same shape as paddle_tpu.obs.summarize)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-span summary table of an exported chrome trace")
+    ap.add_argument("trace", help="trace JSON written by "
+                    "Tracer.export_chrome / export_chrome_tracing")
+    ap.add_argument("--unit", default="ms", choices=["s", "ms", "us"])
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit one JSON object instead of the table")
+    ap.add_argument("--top", type=int, default=None, metavar="N",
+                    help="only the N heaviest span names by total time")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.obs import trace as obs_trace
+
+    summary = obs_trace.summarize(args.trace)
+    if args.top is not None:
+        keep = sorted(summary, key=lambda k: -summary[k]["total_s"])
+        summary = {k: summary[k] for k in keep[: args.top]}
+    if args.as_json:
+        print(json.dumps(summary, sort_keys=True))
+    elif not summary:
+        print("no complete spans in trace (nothing recorded, or only "
+              "instant events)")
+    else:
+        print(obs_trace.format_summary(summary, time_unit=args.unit))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
