@@ -417,24 +417,44 @@ def _engine_lifecycle_counters():
     return counters, latency
 
 
-def _run_graphlint(timeout: float = 900.0) -> dict:
-    """Finding counts from `tools/graphlint.py --json` (CPU subprocess —
-    lint only traces, no chip needed) so BENCH rounds track Graph Doctor
-    status alongside perf numbers.  rc=1 means findings, still parseable."""
+def _run_graphlint(timeout: float = 900.0, rewrite_tier: bool = True,
+                   ) -> dict:
+    """Finding counts from `tools/graphlint.py --json --fix --apply`
+    (CPU subprocess — lint traces, the rewrite tier evaluates tiny probe
+    models) so BENCH rounds track Graph Doctor status AND what the
+    verified rewrites buy (eqn / static FLOPs / bytes deltas per model)
+    alongside perf numbers.  rc=1 means findings/rollbacks, still
+    parseable.  If the rewrite tier blows the timeout, retry LINT-ONLY
+    so the round keeps counts/mem_peak (the always-available baseline)
+    and only the rewrite deltas are lost."""
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "tools", "graphlint.py")
+    argv = [sys.executable, script, "--json"]
+    if rewrite_tier:
+        argv += ["--fix", "--apply"]
     try:
         out = subprocess.run(
-            [sys.executable, script, "--json"],
-            capture_output=True, text=True, timeout=timeout,
+            argv, capture_output=True, text=True, timeout=timeout,
             env={**os.environ, "JAX_PLATFORMS": "cpu"})
         if out.returncode not in (0, 1):
             return {"error": f"rc={out.returncode} "
                              f"{out.stderr.strip()[-300:]}"}
         d = json.loads(out.stdout.strip().splitlines()[-1])
+        rewrite = {}
+        for name, tgt in d.get("targets", {}).items():
+            rw = tgt.get("rewrite")
+            if rw:
+                rewrite[name] = {k: rw[k] for k in (
+                    "applied", "rolled_back", "ok", "eqns_before",
+                    "eqns_after", "flops_before", "flops_after",
+                    "bytes_before", "bytes_after") if k in rw}
         return {"ok": d["ok"], "counts": d["counts"],
-                "mem_peak_bytes": d.get("mem_peak_bytes", {})}
+                "mem_peak_bytes": d.get("mem_peak_bytes", {}),
+                "rewrite": rewrite if rewrite_tier else
+                {"error": "rewrite tier skipped: --fix --apply timed out"}}
     except subprocess.TimeoutExpired:
+        if rewrite_tier:
+            return _run_graphlint(timeout, rewrite_tier=False)
         return {"error": f"graphlint timed out after {timeout:.0f}s"}
     except Exception as e:  # noqa: BLE001 — lint must not kill the bench
         return {"error": repr(e)[:300]}
@@ -555,6 +575,7 @@ def main():
     decode_extra = _run_sub("decode")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
+    rewrite_extra = graphlint_extra.pop("rewrite", None)
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -598,6 +619,10 @@ def main():
             # per-model static memory peak (jaxpr liveness walker) so
             # BENCH_*.json tracks the footprint trend round over round
             "graphlint_mem_peak_bytes": graphlint_mem_peaks,
+            # rewrite tier (graphlint --fix --apply): per-model eqn count
+            # + static FLOPs/bytes before/after the verified passes —
+            # what closing the lint->transform loop buys each round
+            "rewrite": rewrite_extra,
         },
     }))
 

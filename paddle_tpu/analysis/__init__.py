@@ -46,12 +46,20 @@ from .hlo import (  # noqa: F401
 )
 from . import hlo  # noqa: F401
 from . import fixes  # noqa: F401
+from . import equiv  # noqa: F401
+from . import rewrite as rewrite_lib  # noqa: F401 — the module; the
+# next import shadows the `rewrite` attr with the entry-point function
+from .rewrite import (  # noqa: F401
+    RewriteAction, RewriteReport, list_rewrites, register_rewrite, rewrite,
+    rewrite_jaxpr,
+)
 
 __all__ = [
-    "CheckContext", "Finding", "Report", "Severity", "analyze",
-    "analyze_jaxpr", "analyze_hlo", "aval_bytes", "find_rcfile",
-    "iter_eqns", "iter_jaxprs", "lint_bucket_menu", "list_checkers",
-    "list_hlo_checkers", "load_rcfile", "merge_reports",
-    "register_checker", "register_hlo_checker", "suppressions", "cost",
-    "memory", "hlo", "fixes",
+    "CheckContext", "Finding", "Report", "RewriteAction", "RewriteReport",
+    "Severity", "analyze", "analyze_jaxpr", "analyze_hlo", "aval_bytes",
+    "equiv", "find_rcfile", "iter_eqns", "iter_jaxprs", "lint_bucket_menu",
+    "list_checkers", "list_hlo_checkers", "list_rewrites", "load_rcfile",
+    "merge_reports", "register_checker", "register_hlo_checker",
+    "register_rewrite", "rewrite", "rewrite_jaxpr", "rewrite_lib",
+    "suppressions", "cost", "memory", "hlo", "fixes",
 ]

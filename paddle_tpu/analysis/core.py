@@ -99,6 +99,13 @@ class Report:
     def by_code(self, code: str) -> List[Finding]:
         return [f for f in self.findings if fnmatch.fnmatch(f.code, code)]
 
+    def count(self, code: str,
+              min_severity: Optional["Severity"] = None) -> int:
+        """Findings matching a code glob (at/above min_severity) — the
+        rewrite tier's before/after comparisons use this."""
+        return sum(1 for f in self.by_code(code)
+                   if min_severity is None or f.severity >= min_severity)
+
     def counts(self) -> Dict[str, int]:
         out = {"error": 0, "warning": 0, "info": 0}
         for f in self.findings:

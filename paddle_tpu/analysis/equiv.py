@@ -1,0 +1,280 @@
+"""Machine-checked equivalence for jaxpr rewrites.
+
+The verification gate of the Graph Doctor's rewrite tier
+(`analysis/rewrite.py`): a rewrite is ACCEPTED only if the rewritten
+jaxpr evaluates equivalently to the original on probe inputs — forward
+always, gradients where the program is differentiable — and is REJECTED
+(rolled back by the engine) otherwise.  The reference pipeline trusts
+each IR pass by construction; here the passes operate on jaxprs we
+re-execute cheaply, so we buy trust by *checking*, not by proof review.
+
+Tolerance policy is dtype-tiered: integer/bool/token outputs must be
+EXACT; float outputs compare at the tolerance of the NARROWER of the two
+dtypes (a dtype-unification rewrite legitimately narrows f64->f32 — both
+sides are cast to the narrow dtype first, so "token-exact at matching
+dtype" is the bar, not bit-equality across widths).
+
+Nothing here knows about findings or passes — `verify()` takes two
+ClosedJaxprs and probe inputs.  The re-lint half of the acceptance gate
+(consumed finding gone, no new findings) lives with the engine in
+`rewrite.py`, which knows what was consumed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from .core import is_array_var
+
+__all__ = ["EquivResult", "make_probes", "verify", "tolerance_for"]
+
+
+# rtol/atol per float dtype — the narrower side of a comparison picks the
+# tier.  bf16 is generous: a fused kernel reassociates sums.
+_TOL = {
+    "float64": (1e-12, 1e-12),
+    "complex128": (1e-12, 1e-12),
+    "float32": (1e-5, 1e-6),
+    "complex64": (1e-5, 1e-6),
+    "float16": (1e-2, 1e-3),
+    "bfloat16": (2e-2, 1e-2),
+}
+
+_FLOATY = tuple(_TOL)
+
+
+def tolerance_for(*dtypes) -> Tuple[float, float]:
+    """(rtol, atol) of the loosest (narrowest) dtype among `dtypes`;
+    (0, 0) when none is floating — integer outputs must be exact."""
+    worst = (0.0, 0.0)
+    for dt in dtypes:
+        pair = _TOL.get(str(np.dtype(dt)))
+        if pair and pair > worst:
+            worst = pair
+    return worst
+
+
+@dataclasses.dataclass
+class EquivResult:
+    """Outcome of one original-vs-rewritten comparison."""
+
+    ok: bool
+    reason: str = ""
+    max_abs_err: float = 0.0
+    n_outputs: int = 0
+    grads_checked: bool = False
+    max_grad_err: float = 0.0
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def __str__(self):
+        s = "equivalent" if self.ok else f"NOT equivalent: {self.reason}"
+        return (f"{s} (fwd max|err| {self.max_abs_err:.3g} over "
+                f"{self.n_outputs} output(s)"
+                + (f", grad max|err| {self.max_grad_err:.3g}"
+                   if self.grads_checked else ", grads not checked") + ")")
+
+
+# ---------------------------------------------------------------------------
+# probe inputs
+# ---------------------------------------------------------------------------
+
+
+def _is_concrete(x) -> bool:
+    return isinstance(x, (np.ndarray, np.generic)) or (
+        isinstance(x, jax.Array) and not isinstance(
+            x, jax.core.Tracer))
+
+
+def make_probes(closed_jaxpr, args: Sequence = (), seed: int = 0,
+                ) -> List[Any]:
+    """One concrete value per top-level invar.  Flat `args` leaves that
+    are already concrete arrays are used as-is (they exercise the real
+    call site); abstract leaves (ShapeDtypeStructs) and missing
+    positions are synthesized from the invar avals — normal floats,
+    small non-negative ints (safe as indices/token ids), False bools."""
+    rng = np.random.default_rng(seed)
+    invars = closed_jaxpr.jaxpr.invars
+    flat = list(args) + [None] * (len(invars) - len(args))
+    out: List[Any] = []
+    for v, a in zip(invars, flat):
+        if a is not None and _is_concrete(a) \
+                and tuple(np.shape(a)) == tuple(v.aval.shape):
+            out.append(jnp.asarray(a))
+            continue
+        shape = tuple(v.aval.shape)
+        dt = np.dtype(v.aval.dtype)
+        # jnp.issubdtype, not dt.kind: ml_dtypes floats (bfloat16, fp8)
+        # report kind 'V' and must still get real-valued probes
+        if jnp.issubdtype(dt, jnp.floating):
+            val = rng.standard_normal(shape).astype(dt)
+        elif dt.kind == "c":
+            val = (rng.standard_normal(shape)
+                   + 1j * rng.standard_normal(shape)).astype(dt)
+        elif dt.kind == "b":
+            val = np.zeros(shape, dt)
+        else:       # ints/uints: small values are safe as indices/ids
+            val = rng.integers(0, 2, size=shape).astype(dt)
+        out.append(jnp.asarray(val))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# evaluation
+# ---------------------------------------------------------------------------
+
+
+def _eval(closed, probes) -> List[Any]:
+    # fresh copies per evaluation: a rewritten jaxpr carrying donation may
+    # consume its input buffers on accelerators; probes must stay reusable
+    fresh = [jnp.array(p, copy=True) for p in probes]
+    return jax.core.eval_jaxpr(closed.jaxpr, closed.consts, *fresh)
+
+
+def _float_positions(closed) -> Tuple[List[int], List[int]]:
+    """(differentiable invar idxs, float outvar idxs) of a ClosedJaxpr."""
+    ins = [i for i, v in enumerate(closed.jaxpr.invars)
+           if is_array_var(v) and str(v.aval.dtype) in _FLOATY]
+    outs = [i for i, v in enumerate(closed.jaxpr.outvars)
+            if hasattr(v, "aval") and str(v.aval.dtype) in _FLOATY]
+    return ins, outs
+
+
+def _probe_loss(closed, float_in, float_out, seed: int = 17):
+    """Scalar loss over the float outputs as a function of the float
+    inputs only — a fixed random linear functional, so grad errors in
+    any output element surface (a plain sum hides sign-symmetric bugs)."""
+    rng = np.random.default_rng(seed)
+    weights = {}
+
+    def loss(*fvals):
+        probes_full = list(loss.base)
+        for i, fv in zip(float_in, fvals):
+            probes_full[i] = fv
+        outs = jax.core.eval_jaxpr(closed.jaxpr, closed.consts,
+                                   *probes_full)
+        total = jnp.zeros((), jnp.float64)
+        for i in float_out:
+            o = outs[i]
+            if i not in weights:
+                weights[i] = jnp.asarray(
+                    rng.standard_normal(np.shape(o)), jnp.float64)
+            total = total + jnp.sum(jnp.real(o).astype(jnp.float64)
+                                    * weights[i])
+        return total
+
+    return loss, weights
+
+
+def _max_err(a, b) -> float:
+    try:
+        return float(jnp.max(jnp.abs(
+            jnp.asarray(a, jnp.float64) - jnp.asarray(b, jnp.float64))))
+    except Exception:  # noqa: BLE001 — non-numeric
+        return 0.0 if bool(jnp.all(a == b)) else float("inf")
+
+
+def verify(original, rewritten, probes: Optional[Sequence] = None,
+           check_grads: bool = True, seed: int = 0) -> EquivResult:
+    """Evaluate `original` vs `rewritten` (ClosedJaxprs with identical
+    invar signatures) on probe inputs; compare forward outputs at
+    dtype-tiered tolerance and, where differentiable, gradients of a
+    random linear probe loss.  Any structural/eval failure of the
+    REWRITTEN side is a rejection (the original is ground truth)."""
+    o_in, r_in = original.jaxpr.invars, rewritten.jaxpr.invars
+    if len(o_in) != len(r_in):
+        return EquivResult(False, reason=(
+            f"invar arity changed: {len(o_in)} -> {len(r_in)}"))
+    for i, (a, b) in enumerate(zip(o_in, r_in)):
+        if tuple(a.aval.shape) != tuple(b.aval.shape) \
+                or a.aval.dtype != b.aval.dtype:
+            return EquivResult(False, reason=(
+                f"invar {i} signature changed: {a.aval} -> {b.aval}"))
+    if len(original.jaxpr.outvars) != len(rewritten.jaxpr.outvars):
+        return EquivResult(False, reason=(
+            f"output arity changed: {len(original.jaxpr.outvars)} -> "
+            f"{len(rewritten.jaxpr.outvars)}"))
+
+    if probes is None:
+        probes = make_probes(original, seed=seed)
+    probes = list(probes)
+
+    ref = _eval(original, probes)
+    try:
+        got = _eval(rewritten, probes)
+    except Exception as e:  # noqa: BLE001 — rewritten side must run
+        return EquivResult(False, reason=f"rewritten jaxpr failed to "
+                                         f"evaluate: {type(e).__name__}: {e}")
+
+    max_err = 0.0
+    for i, (a, b) in enumerate(zip(ref, got)):
+        rtol, atol = tolerance_for(
+            getattr(a, "dtype", np.float64), getattr(b, "dtype", np.float64))
+        narrow = min((getattr(a, "dtype", None), getattr(b, "dtype", None)),
+                     key=lambda d: np.dtype(d).itemsize if d is not None
+                     else 99)
+        if np.shape(a) != np.shape(b):
+            return EquivResult(False, n_outputs=len(ref), reason=(
+                f"output {i} shape changed: "
+                f"{np.shape(a)} -> {np.shape(b)}"))
+        av = jnp.asarray(a).astype(narrow) if narrow is not None else a
+        bv = jnp.asarray(b).astype(narrow) if narrow is not None else b
+        if rtol == atol == 0.0:         # integer/bool: token-exact
+            if not bool(jnp.all(av == bv)):
+                return EquivResult(
+                    False, n_outputs=len(ref),
+                    max_abs_err=_max_err(av, bv),
+                    reason=f"integer output {i} differs (must be exact)")
+        elif not bool(jnp.allclose(jnp.asarray(av, jnp.float64),
+                                   jnp.asarray(bv, jnp.float64),
+                                   rtol=rtol, atol=atol, equal_nan=True)):
+            return EquivResult(
+                False, n_outputs=len(ref), max_abs_err=_max_err(av, bv),
+                reason=(f"float output {i} differs beyond "
+                        f"rtol={rtol:g}/atol={atol:g} of {narrow}"))
+        max_err = max(max_err, _max_err(av, bv))
+
+    res = EquivResult(True, n_outputs=len(ref), max_abs_err=max_err)
+    if not check_grads:
+        return res
+
+    float_in, float_out = _float_positions(original)
+    if not float_in or not float_out:
+        return res                      # not differentiable: fwd-only
+    try:
+        o_loss, _w = _probe_loss(original, float_in, float_out)
+        r_loss, _w2 = _probe_loss(rewritten, float_in, float_out)
+        # per-side copies: a donation-injected rewrite consumes its
+        # input buffers when the grad executes; probes must survive
+        o_loss.base = [jnp.array(p, copy=True) for p in probes]
+        r_loss.base = [jnp.array(p, copy=True) for p in probes]
+        argnums = tuple(range(len(float_in)))
+        g_ref = jax.grad(o_loss, argnums=argnums)(
+            *[jnp.array(probes[i], copy=True) for i in float_in])
+        g_got = jax.grad(r_loss, argnums=argnums)(
+            *[jnp.array(probes[i], copy=True) for i in float_in])
+    except Exception:  # noqa: BLE001 — opaque/non-differentiable regions
+        return res                      # fwd equivalence stands alone
+    g_err = 0.0
+    for i, (ga, gb) in enumerate(zip(g_ref, g_got)):
+        rtol, atol = tolerance_for(probes[float_in[i]].dtype)
+        rtol, atol = max(rtol, 1e-5), max(atol, 1e-6)
+        if not bool(jnp.allclose(jnp.asarray(ga, jnp.float64),
+                                 jnp.asarray(gb, jnp.float64),
+                                 rtol=rtol, atol=atol, equal_nan=True)):
+            return EquivResult(
+                False, n_outputs=len(ref), max_abs_err=max_err,
+                grads_checked=True, max_grad_err=_max_err(ga, gb),
+                reason=(f"gradient wrt float input #{float_in[i]} differs "
+                        f"beyond rtol={rtol:g}/atol={atol:g}"))
+        g_err = max(g_err, _max_err(ga, gb))
+    res.grads_checked = True
+    res.max_grad_err = g_err
+    return res

@@ -14,16 +14,23 @@ edit to make, named precisely enough to paste:
     DTYPE_WEAK_F64 / INPUT  wrapper)
     RECOMPILE_CONST_CAPTURE hoist-to-argument rewrite
     RECOMPILE_BUCKET_MISS   the prefill_buckets menu edit
+    LAYOUT_TRANSPOSE /      HLO-tier textual suggestions (no jaxpr eqn to
+    COLLECTIVE_SEQ          edit; same Patch schema so --json consumers
+                            see one shape for both tiers)
 
-`tools/graphlint.py --fix` prints these after the findings; the
-reference's pass pipeline APPLIES its rewrites — here the rewrite half
-stays with the human (jaxprs have no source locations to edit safely),
-but the suggestion is mechanical.
+`tools/graphlint.py --fix` prints these after the findings.  Patches
+dedupe by (kind, target) — linting one fn under two entry points emits
+ONE donate_argnums patch — and carry a stable `patch_id` in --json.
+Since the rewrite tier (`analysis/rewrite.py`, `--fix --apply`), the
+donation/dtype/dead-code/fusion families are also APPLIED mechanically
+at the jaxpr level with a verification gate; the suggestions here remain
+the human-readable source edit.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 from typing import Dict, List
 
 from .core import Finding, Report, fmt_bytes
@@ -40,9 +47,27 @@ class Patch:
     eqn_paths: List[str]
     diff: str                   # unified-diff-style snippet
     note: str = ""
+    target: str = ""            # identity when the title is generic
+
+    @property
+    def kind(self) -> str:
+        """The patch family — its primary finding code."""
+        return self.codes[0] if self.codes else "?"
+
+    @property
+    def patch_id(self) -> str:
+        """Stable id over (kind, target): the same fn linted under two
+        entry points dedupes to ONE patch, and --json consumers can key
+        on the id across runs.  Builders whose title names the edit
+        (donation) leave `target` empty; generic-title builders set it
+        to the site/edit so DISTINCT sites never collapse."""
+        return hashlib.sha1(
+            f"{self.kind}|{self.target or self.title}".encode()
+        ).hexdigest()[:12]
 
     def to_dict(self) -> dict:
-        return {"title": self.title, "codes": list(self.codes),
+        return {"patch_id": self.patch_id, "kind": self.kind,
+                "title": self.title, "codes": list(self.codes),
                 "eqn_paths": list(self.eqn_paths), "diff": self.diff,
                 "note": self.note}
 
@@ -127,7 +152,8 @@ def _const_capture_patch(f: Finding) -> Patch:
         title="pass the captured constant as an argument",
         codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
         note="a new value then reuses the compiled program instead of "
-             "retracing (and the executable stops embedding the data)")
+             "retracing (and the executable stops embedding the data)",
+        target=f.eqn_path)
 
 
 def _bucket_patch(f: Finding) -> Patch:
@@ -143,12 +169,72 @@ def _bucket_patch(f: Finding) -> Patch:
         title="edit the prefill bucket menu",
         codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
         note="pass prefill_buckets=... to LLMEngine (and re-lint with "
-             "expected_prompt_lens to confirm the straddle is gone)")
+             "expected_prompt_lens to confirm the straddle is gone)",
+        target=diff)
+
+
+def _layout_patch(f: Finding) -> Patch:
+    """HLO tier: a materialized transpose/relayout copy.  No jaxpr eqn
+    to edit — the patch is the dims reorder at the op_name's source."""
+    op_name = str(f.data.get("op_name") or f.eqn_path)
+    if f.data.get("user_written"):
+        diff = ("-out = x.transpose(...) @ w        # materialized shuffle\n"
+                "+out = jnp.einsum('...ij,jk->...ik', x, w)  "
+                "# let dot dims absorb it")
+        note = ("a user-written transpose survived compilation at "
+                f"{op_name}: reorder the einsum/dot dims so it folds "
+                "into dimension numbers")
+    else:
+        diff = (" # two consumers want different physical layouts of the\n"
+                " # same value; keep it in ONE layout end-to-end, e.g.\n"
+                "+x = jax.lax.with_sharding_constraint(x, ...)  "
+                "# or restructure the second consumer")
+        note = (f"compiler-inserted relayout at {op_name} "
+                f"({fmt_bytes(int(f.data.get('bytes', 0)))} through HBM)")
+    return Patch(title=f"eliminate the relayout at {op_name}",
+                 codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
+                 note=note)
+
+
+def _collective_patch(f: Finding) -> Patch:
+    """HLO tier: independent same-group collectives that could combine."""
+    kind = str(f.data.get("kind", "all_reduce"))
+    n = int(f.data.get("count", 2))
+    api = {"all_reduce": "jax.lax.psum",
+           "all_gather": "jax.lax.all_gather",
+           "reduce_scatter": "jax.lax.psum_scatter"}.get(kind, "jax.lax.psum")
+    diff = (f"-a = {api}(x, axis); b = {api}(y, axis)   # {n} launches\n"
+            f"+a, b = {api}((x, y), axis)               # one combined op")
+    return Patch(
+        title=f"combine {n} {kind} ops into one",
+        codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
+        note=f"{fmt_bytes(int(f.data.get('bytes', 0)))} total moves once "
+             "instead of paying per-op latency",
+        target=f.eqn_path)
+
+
+def _dedupe(patches: List[Patch]) -> List[Patch]:
+    """Drop identical (kind, target) patches — the same fn linted under
+    two entry points suggests the same donate_argnums tuple twice."""
+    seen: Dict[str, Patch] = {}
+    out = []
+    for p in patches:
+        prev = seen.get(p.patch_id)
+        if prev is not None:
+            # keep one patch; remember the extra eqn_paths it covers
+            prev.eqn_paths += [e for e in p.eqn_paths
+                               if e not in prev.eqn_paths]
+            continue
+        seen[p.patch_id] = p
+        out.append(p)
+    return out
 
 
 def suggest_fixes(report: Report) -> List[Patch]:
-    """Patches for every fixable finding in the report, most impactful
-    first (donation > sharding > dtype > recompile)."""
+    """Patches for every fixable finding in the report (BOTH tiers —
+    jaxpr and HLO findings share this one schema), most impactful first
+    (donation > sharding > dtype > fusion-adjacent HLO > recompile),
+    deduped by (kind, target) with a stable `patch_id`."""
     fixable = [f for f in report]
     patches: List[Patch] = []
     patches += _donation_patches(
@@ -157,11 +243,15 @@ def suggest_fixes(report: Report) -> List[Patch]:
                 if f.code == "SHARD_REPLICATED"]
     patches += [_dtype_patch(f) for f in fixable
                 if f.code.startswith("DTYPE_")]
+    patches += [_layout_patch(f) for f in fixable
+                if f.code == "LAYOUT_TRANSPOSE"]
+    patches += [_collective_patch(f) for f in fixable
+                if f.code == "COLLECTIVE_SEQ"]
     patches += [_const_capture_patch(f) for f in fixable
                 if f.code == "RECOMPILE_CONST_CAPTURE"]
     patches += [_bucket_patch(f) for f in fixable
                 if f.code == "RECOMPILE_BUCKET_MISS"]
-    return patches
+    return _dedupe(patches)
 
 
 def format_patches(patches: List[Patch]) -> str:

@@ -36,8 +36,15 @@ class ShardedTrainState:
 
     def __init__(self, config, model, mesh: Mesh, optimizer: Optional[AdamW] = None,
                  zero_stage: int = 1, rules=None, donate: bool = True,
-                 seq_leaves=None):
+                 seq_leaves=None, auto_donate_fix: Optional[bool] = None):
         import dataclasses
+
+        # auto_donate_fix: opt-in Graph Doctor rewrite hook — when the
+        # step is built WITHOUT donation (donate=False or a future config
+        # that forgets it), lint the jitted step for DONATION_MISSING and
+        # re-wrap with the exact donate_argnums fixes.py computes.  None
+        # defers to the FLAGS_auto_graph_rewrite framework flag.
+        self._auto_donate_fix = auto_donate_fix
 
         # seq_leaves: optional iterable of batch-dict keys whose dim 1 IS a
         # sequence (sharded over the sep axis); None = rank heuristic (see
@@ -182,18 +189,59 @@ class ShardedTrainState:
         """The jitted train step specialized to this batch's pytree
         structure, built lazily and cached — step() calls it; the Graph
         Doctor (`paddle_tpu.analysis`, tools/graphlint.py) lints it
-        directly so diagnostics cover the exact artifact that runs."""
+        directly so diagnostics cover the exact artifact that runs.
+        With `auto_donate_fix` (or FLAGS_auto_graph_rewrite) on, a step
+        built without donation is linted and re-wrapped with the exact
+        `donate_argnums` the fix suggests — the rewrite tier's donation
+        pass applied at the call site."""
         key = self._batch_key(batch)
         jitted = self._step_cache.get(key)
         if jitted is None:
-            jitted = self._step_cache[key] = jax.jit(
-                self._step_fn,
-                in_shardings=(self.param_shardings, self.opt_shardings,
-                              self._batch_shardings(batch)),
-                out_shardings=(self.param_shardings, self.opt_shardings,
-                               None),
-                donate_argnums=(0, 1) if self._donate else ())
+            jitted = self._build_step(batch,
+                                      (0, 1) if self._donate else ())
+            if not self._donate and self._autofix_enabled():
+                jitted = self._autodonate(jitted, batch) or jitted
+            self._step_cache[key] = jitted
         return jitted
+
+    def _build_step(self, batch, donate_argnums):
+        return jax.jit(
+            self._step_fn,
+            in_shardings=(self.param_shardings, self.opt_shardings,
+                          self._batch_shardings(batch)),
+            out_shardings=(self.param_shardings, self.opt_shardings,
+                           None),
+            donate_argnums=tuple(donate_argnums))
+
+    def _autofix_enabled(self) -> bool:
+        if self._auto_donate_fix is not None:
+            return bool(self._auto_donate_fix)
+        from .. import framework
+        return bool(framework.get_state().flags.get(
+            "FLAGS_auto_graph_rewrite", False))
+
+    def _autodonate(self, jitted, batch):
+        """Lint the freshly-built step abstractly (nothing executes) and,
+        when DONATION_MISSING names argnums, rebuild with them donated.
+        Any failure keeps the original step — this hook may only help."""
+        from .. import analysis
+        try:
+            pshape, oshape = jax.eval_shape(self.init,
+                                            jax.random.PRNGKey(0))
+            bshape = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                               jnp.asarray(x).dtype), batch)
+            rep = analysis.analyze(jitted, pshape, oshape, bshape,
+                                   checkers=["donation"])
+            argnums = sorted({
+                f.data.get("argnum")
+                for f in rep.by_code("DONATION_MISSING")
+                if f.data.get("argnum") is not None})
+            if not argnums:
+                return None
+            return self._build_step(batch, argnums)
+        except Exception:  # noqa: BLE001 — advisory hook, never fatal
+            return None
 
     def step(self, params, opt_state, batch):
         """Jitted train step; specializes (and caches) per batch pytree

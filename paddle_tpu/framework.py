@@ -120,6 +120,11 @@ class _GlobalState(threading.local):
             # replay; disable to shed the extra references on memory-bound
             # eager jobs (higher-order grad then raises)
             "FLAGS_enable_double_grad": True,
+            # opt-in: let Graph Doctor rewrite call sites apply VERIFIED
+            # fixes automatically (ShardedTrainState donation injection,
+            # Program.rewrite defaults) — off by default; the lint always
+            # runs, the transform only with consent
+            "FLAGS_auto_graph_rewrite": False,
         }
 
 

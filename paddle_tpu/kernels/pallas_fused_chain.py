@@ -1,0 +1,152 @@
+"""Generated fused-elementwise-chain kernels for the rewrite tier.
+
+`analysis/rewrite.py`'s fusion pass matches FUSION_BREAK findings back
+to jaxpr eqn spans and hands this module the span as a pure closure
+(`chain_fn(*same_shape_arrays) -> array`).  We emit it as ONE call:
+
+  * TPU, tile-aligned:   a generated Pallas kernel — rows blocked over
+                         the grid, whole chain evaluated in VMEM, one
+                         HBM read per input + one write for the output
+                         (the fusion XLA declined, now guaranteed);
+  * elsewhere/unaligned: the same pallas_call through the interpret
+                         path — identical eqn shape, so the rewritten
+                         jaxpr looks the same on CPU tests, the cost
+                         formula applies, and the call stays OPAQUE to
+                         the jaxpr checkers (a `mode="jit"` closure is
+                         available but its pjit eqn re-enters the
+                         donation checker's field of view).
+
+The kernel name carries the chain length (``_fused_chain<N>_kernel``) so
+the registered cost formula stays truthful: N flops per output element;
+bytes fall out of the generic operand+result rule, which for a fused
+elementwise call IS the real HBM traffic.
+
+Differentiation: `jax.custom_vjp` around the pallas path — forward runs
+the kernel, backward runs `jax.vjp` of the pure chain closure (exact,
+XLA-fused), so rewritten models keep training.
+"""
+
+from __future__ import annotations
+
+import functools
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from . import _on_tpu
+from ..analysis import cost as _cost
+
+__all__ = ["fused_elementwise_chain"]
+
+_BLOCK_ROWS = 256
+_0 = np.int32(0)        # index-map constants stay i32 under x64 (Mosaic)
+
+
+def _rows_block(n_rows: int) -> int:
+    block = min(_BLOCK_ROWS, max(n_rows, 1))
+    while n_rows % block:
+        block //= 2
+    return max(block, 1)
+
+
+def _make_kernel(chain_fn, n_inputs: int, n_ops: int):
+    def kernel(*refs):
+        ins, o_ref = refs[:n_inputs], refs[n_inputs]
+        o_ref[...] = chain_fn(*(r[...] for r in ins))
+
+    kernel.__name__ = f"_fused_chain{n_ops}_kernel"
+    return kernel
+
+
+def _pallas_chain(chain_fn, n_ops: int, interpret: bool):
+    def call(*xs):
+        shape, dtype = xs[0].shape, xs[0].dtype
+        last = shape[-1] if len(shape) else 1
+        flat = [x.reshape(-1, last) for x in xs]
+        rows = flat[0].shape[0]
+        br = _rows_block(rows)
+        kernel = _make_kernel(chain_fn, len(xs), n_ops)
+        out = pl.pallas_call(
+            kernel,
+            grid=(rows // br,),
+            in_specs=[pl.BlockSpec((br, last), lambda i: (i, _0))
+                      for _ in xs],
+            out_specs=pl.BlockSpec((br, last), lambda i: (i, _0)),
+            out_shape=jax.ShapeDtypeStruct((rows, last), dtype),
+            interpret=interpret,
+        )(*flat)
+        return out.reshape(shape)
+
+    return call
+
+
+def fused_elementwise_chain(chain_fn, n_ops: int, mode: str = "auto"):
+    """One fused call for an elementwise chain.
+
+    chain_fn: pure closure over same-shape/same-dtype arrays returning
+    one array of that shape.  n_ops: eqns in the chain (cost formula).
+    mode: "auto"/"pallas" (a pallas_call everywhere — compiled on TPU,
+    interpret elsewhere: opaque to the checkers, cost formula attached),
+    or "jit" (a named jitted closure; NOTE the resulting pjit eqn is
+    visible to the donation checker, so the rewrite engine's re-lint
+    gate may reject it when the chain input aval-matches the output).
+    """
+    if mode not in ("auto", "pallas", "jit"):
+        raise ValueError(f"fused chain mode must be auto/pallas/jit, "
+                         f"got {mode!r}")
+    on_tpu = _on_tpu()
+    if mode == "auto":
+        mode = "pallas"
+    if mode == "jit":
+        chain_fn.__name__ = f"fused_chain{n_ops}"
+        return jax.jit(chain_fn)
+
+    pallas_fwd = _pallas_chain(chain_fn, n_ops, interpret=not on_tpu)
+
+    @jax.custom_vjp
+    def fused(*xs):
+        return pallas_fwd(*xs)
+
+    def fwd(*xs):
+        return pallas_fwd(*xs), xs
+
+    def bwd(xs, g):
+        _out, pullback = jax.vjp(chain_fn, *xs)
+        return pullback(g)
+
+    fused.defvjp(fwd, bwd)
+
+    def call(*xs):
+        if on_tpu and (xs[0].ndim < 2 or xs[0].shape[-1] % 128):
+            # unaligned lane dim: the Mosaic path would pad; fall back
+            # to the jitted closure rather than lower something slower
+            f = jax.jit(chain_fn)
+            return f(*xs)
+        return fused(*xs)
+
+    return call
+
+
+# cost formula: the kernel name carries the chain length
+_CHAIN_RE = re.compile(r"fused_chain(\d+)")
+
+
+def _numel_out(eqn) -> int:
+    return max((int(np.prod(v.aval.shape, dtype=np.int64))
+                for v in eqn.outvars if hasattr(v, "aval")), default=0)
+
+
+def _fused_chain_flops(eqn) -> float:
+    name = str(eqn.params.get("name") or "") + " " + str(
+        eqn.params.get("name_and_src_info") or "")
+    m = _CHAIN_RE.search(name)
+    n_ops = int(m.group(1)) if m else 1
+    return float(n_ops * _numel_out(eqn))
+
+
+_cost.register_pallas_flops("fused_chain", _fused_chain_flops)
+# bytes: the generic pallas rule (sum of operand+result avals) is exact
+# for a fused elementwise call — one read per input, one write out

@@ -202,12 +202,48 @@ class Program:
         for project suppressions/severity overrides unless an explicit
         `config=` is passed; per-call `suppress=` unions on top of it.
         """
+        pure, call_args, analyze_kwargs = self._doctor_args(
+            feed, fetch_list, analyze_kwargs)
+        from .. import analysis
+        return analysis.analyze(pure, *call_args, **analyze_kwargs)
+
+    def rewrite(self, feed=None, fetch_list=None, passes=None,
+                **rewrite_kwargs):
+        """Run the Graph Doctor REWRITE tier (analysis/rewrite.py) over
+        this program's replay function — the jaxpr-engine counterpart of
+        `apply_pass`: where the record-level passes trim the op list,
+        this transforms the traced jaxpr itself (what actually compiles),
+        with every pass gated by the equivalence harness.
+
+        Returns `(rewritten_fn, RewriteReport)`; `rewritten_fn` takes
+        the feed dict of raw arrays (external tensors are bound in, like
+        Executor.run binds them) and carries the final jaxpr as
+        `.rewritten_jaxpr`.  See `passes.jaxpr_rewrite` for the
+        pass-registry-side bridge.
+        """
+        pure, call_args, kw = self._doctor_args(feed, fetch_list,
+                                                rewrite_kwargs)
+        from .. import analysis
+        fn, report = analysis.rewrite(pure, *call_args, passes=passes, **kw)
+        ext_raws = call_args[1]
+
+        def bound(feed_raws):
+            return fn(feed_raws, ext_raws)
+
+        bound.rewritten_jaxpr = fn.rewritten_jaxpr
+        bound.rewrite_report = report
+        return bound, report
+
+    def _doctor_args(self, feed, fetch_list, extra_kwargs):
+        """Shared lint/rewrite plumbing: default feed from placeholder
+        samples, fetch targets per the passes' rule, rcfile config."""
         from .. import analysis
 
-        if "config" not in analyze_kwargs:
+        extra_kwargs = dict(extra_kwargs)
+        if "config" not in extra_kwargs:
             rc = analysis.find_rcfile()
             if rc is not None:
-                analyze_kwargs["config"] = analysis.load_rcfile(rc)
+                extra_kwargs["config"] = analysis.load_rcfile(rc)
         feed = dict(feed or {})
         for name, ph in self.placeholders.items():
             feed.setdefault(name, ph)
@@ -217,8 +253,7 @@ class Program:
         if not targets and self.ops:
             targets = list(self.ops[-1].outs)
         pure, ext = self._replay_fn(targets)
-        return analysis.analyze(pure, feed_raws, [t._data for t in ext],
-                                **analyze_kwargs)
+        return pure, (feed_raws, [t._data for t in ext]), extra_kwargs
 
 
 _default_main = Program()

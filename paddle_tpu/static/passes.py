@@ -27,13 +27,21 @@ lives in `paddle_tpu.analysis` (the Graph Doctor) over jaxprs — same
 registry shape (`register_checker`/`list_checkers`/`analyze`), structured
 `Finding`s instead of transforms; `Program.lint()` runs those checkers
 over a recorded program's replay function.
+
+Since Graph Doctor grew its own REWRITE tier (`analysis/rewrite.py`),
+the jaxpr-level halves of `dead_code_elimination` and `fuse_elementwise`
+delegate there: `jaxpr_rewrite(program, ...)` (= `Program.rewrite()`)
+runs verified DCE/fusion/dtype/donation passes over the program's replay
+jaxpr — the level that actually compiles.  The record passes above
+remain useful for trimming what gets TRACED; the jaxpr engine transforms
+what got traced, with an equivalence gate the record level never had.
 """
 
 from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Sequence
 
-__all__ = ["register_pass", "apply_pass", "list_passes"]
+__all__ = ["register_pass", "apply_pass", "list_passes", "jaxpr_rewrite"]
 
 PASS_REGISTRY: Dict[str, Callable] = {}
 
@@ -102,10 +110,25 @@ def _target_ids(program, fetch_list):
     return ids
 
 
+def jaxpr_rewrite(program, feed=None, fetch_list=None, passes=None, **kw):
+    """Delegate to the jaxpr rewrite engine: run the VERIFIED Graph
+    Doctor passes (dce/dtype_cast/fusion/donation by default) over the
+    program's replay jaxpr.  Unlike the record passes this returns a
+    `(rewritten_fn, RewriteReport)` pair, not a Program — the jaxpr is
+    the compiled artifact, records are its recipe.  Equivalent to
+    `program.rewrite(...)`; registered here so pass-pipeline callers
+    find the bridge next to the record-level DCE/fusion it supersedes."""
+    return program.rewrite(feed=feed, fetch_list=fetch_list,
+                           passes=passes, **kw)
+
+
 @register_pass("dead_code_elimination")
 def dead_code_elimination(program, fetch_list=None):
     """Drop ops whose outputs never reach a fetch target (reference
-    ir/graph passes' DCE; here a reverse liveness sweep over records)."""
+    ir/graph passes' DCE; here a reverse liveness sweep over records).
+    Record-level only — `jaxpr_rewrite` / `Program.rewrite(passes=
+    ["dce"])` performs the same elimination on the traced jaxpr with a
+    verification gate."""
     live = _target_ids(program, fetch_list)
     kept = []
     for op in reversed(program.ops):
@@ -156,7 +179,10 @@ def constant_folding(program, fetch_list=None):
 def fuse_elementwise(program, fetch_list=None):
     """Merge A->B record chains where A has one output consumed ONLY by B
     (and A's output is not itself a fetch target) into a single record
-    whose fn composes the two closures."""
+    whose fn composes the two closures.  Record-level (trims python
+    dispatch at trace time); the jaxpr-level chain stitching with a real
+    fused kernel lives in `jaxpr_rewrite` / the rewrite tier's "fusion"
+    pass."""
     targets = _target_ids(program, fetch_list)
     ops = list(program.ops)
 

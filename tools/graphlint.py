@@ -17,8 +17,8 @@ Targets (default: all):
 
 Usage:
   python tools/graphlint.py [targets...] [--json] [--verbose] [--fix]
-                            [--suppress CODE[@pathglob]]... [--fail-on LVL]
-                            [--no-hlo] [--config RC]
+                            [--apply] [--suppress CODE[@pathglob]]...
+                            [--fail-on LVL] [--no-hlo] [--config RC]
                             [--baseline B.json | --write-baseline B.json]
 
 Exit code is 0 when every target is clean at --fail-on (default: warning)
@@ -28,6 +28,16 @@ rounds can track lint drift and the memory-peak trend alongside perf.
 
 --fix prints concrete patch suggestions (exact donate_argnums, constraint
 insertion points, bucket-menu edits) for the fixable findings.
+
+--fix --apply goes further: the rewrite tier (analysis/rewrite.py) runs
+over each target — dead-code elimination, dtype unification, fusion
+stitching, donation injection — every pass gated by the equivalence
+harness (probe-input forward match + re-lint) and ROLLED BACK on any
+mismatch.  The per-target RewriteReport (per-pass eqn deltas and static
+FLOPs/bytes deltas) lands in the JSON under "rewrite"; a rollback fails
+the run (that is the CI regression signal — a rewrite that used to
+verify no longer does).  This is a dry run over traced jaxprs: nothing
+edits your source; the report tells you what the passes would buy.
 
 --baseline B.json flips to DIFF mode for CI: exit 0 while no target grows
 a finding code (or escalates one's severity) beyond the stored snapshot,
@@ -198,9 +208,17 @@ def _severity_rank(s: str) -> int:
     return {"info": 1, "warning": 2, "error": 3}.get(s, 0)
 
 
+# bump when the snapshot schema changes; readers WARN (not crash) on
+# keys they don't know, so a newer tool's baseline still gates an older
+# checkout and vice versa
+BASELINE_SCHEMA_VERSION = 2
+_KNOWN_BASELINE_KEYS = {"schema_version", "targets"}
+_KNOWN_TARGET_KEYS = {"codes", "rewrite"}
+
+
 def _baseline_snapshot(out: dict) -> dict:
-    """{target: {code: worst_severity}} — what --write-baseline stores
-    and --baseline diffs against."""
+    """{target: {code: worst_severity}} (+ rewrite counters when --apply
+    ran) — what --write-baseline stores and --baseline diffs against."""
     snap = {}
     for name, rep in out.items():
         codes: dict = {}
@@ -209,7 +227,32 @@ def _baseline_snapshot(out: dict) -> dict:
                     codes.get(f["code"], "")):
                 codes[f["code"]] = f["severity"]
         snap[name] = {"codes": codes}
+        rw = rep.get("rewrite")
+        if rw is not None:
+            snap[name]["rewrite"] = {
+                "applied": len(rw.get("applied", ())),
+                "rolled_back": len(rw.get("rolled_back", ()))}
     return snap
+
+
+def _load_baseline(path: str) -> dict:
+    """Read a baseline snapshot, WARNING (never crashing) on unknown
+    keys — counters added by newer tool versions must not break older
+    checkouts reading the shipped file."""
+    with open(path) as f:
+        baseline = json.load(f)
+    unknown = sorted(set(baseline) - _KNOWN_BASELINE_KEYS -
+                     ({"targets"} if "targets" in baseline else
+                      set(baseline)))  # legacy: bare target map
+    for k in unknown:
+        print(f"graphlint: warning: unknown baseline key {k!r} "
+              f"(newer schema?) — ignored", file=sys.stderr)
+    for tname, tsnap in baseline.get("targets", {}).items():
+        if isinstance(tsnap, dict):
+            for k in sorted(set(tsnap) - _KNOWN_TARGET_KEYS):
+                print(f"graphlint: warning: unknown baseline key "
+                      f"{tname}.{k!r} — ignored", file=sys.stderr)
+    return baseline
 
 
 def _baseline_diff(current: dict, baseline: dict) -> list:
@@ -244,6 +287,12 @@ def main(argv=None) -> int:
                     help="lowest severity that fails the lint")
     ap.add_argument("--fix", action="store_true",
                     help="print patch suggestions for fixable findings")
+    ap.add_argument("--apply", action="store_true",
+                    help="with --fix: run the VERIFIED rewrite tier over "
+                         "each target (dry run on the traced jaxpr) and "
+                         "report per-pass eqn/static-cost deltas; a "
+                         "rewrite that fails verification rolls back AND "
+                         "fails the run")
     ap.add_argument("--no-hlo", action="store_true",
                     help="skip the HLO tier (no lowering/compiling)")
     ap.add_argument("--config", default=None, metavar="RC",
@@ -262,10 +311,12 @@ def main(argv=None) -> int:
     config = analysis.load_rcfile(rc_path) if os.path.isfile(rc_path) \
         else None
 
+    if args.apply:
+        args.fix = True
     fail_on = analysis.Severity[args.fail_on.upper()]
     suppress = list(SHIPPED_SUPPRESSIONS) + list(args.suppress)
     names = list(args.targets) or list(TARGETS)
-    out, mem_peaks, all_ok = {}, {}, True
+    out, mem_peaks, all_ok, apply_ok = {}, {}, True, True
     for name in names:
         fn, call_args, extra = TARGETS[name]()
         report = analysis.analyze(
@@ -289,6 +340,17 @@ def main(argv=None) -> int:
         patches = analysis.fixes.suggest_fixes(report) if args.fix else []
         if args.fix:
             out[name]["fixes"] = [p.to_dict() for p in patches]
+        rw = None
+        if args.apply:
+            # the rewrite tier, gated by the equivalence harness: grads
+            # are skipped here for CLI budget (tests/test_rewrite.py
+            # covers grad equivalence per pass); a rollback = regression
+            _newfn, rw = analysis.rewrite(
+                fn, *call_args, report=report,
+                options=extra.get("options"), suppress=suppress,
+                config=config, verify_grads=False)
+            apply_ok &= rw.ok
+            out[name]["rewrite"] = rw.to_json()
         if not args.as_json:
             shown = [f for f in report
                      if args.verbose or f.severity >= analysis.Severity.WARNING]
@@ -298,34 +360,45 @@ def main(argv=None) -> int:
                 print(f"   {f}")
             if patches:
                 print(analysis.fixes.format_patches(patches))
+            if rw is not None:
+                print(f"-- rewrite [{name}]: "
+                      f"{'ok' if rw.ok else 'VERIFICATION REGRESSED'}")
+                print("   " + str(rw).replace("\n", "\n   "))
 
     snap = _baseline_snapshot(out)
     if args.write_baseline:
         with open(args.write_baseline, "w") as f:
-            json.dump({"targets": snap}, f, indent=1, sort_keys=True)
+            json.dump({"schema_version": BASELINE_SCHEMA_VERSION,
+                       "targets": snap}, f, indent=1, sort_keys=True)
         if not args.as_json:
             print(f"graphlint: baseline written to {args.write_baseline}")
     if args.baseline:
-        with open(args.baseline) as f:
-            baseline = json.load(f)
+        baseline = _load_baseline(args.baseline)
         news = _baseline_diff(snap, baseline)
         if args.as_json:
             print(json.dumps({"targets": out, "new_vs_baseline": news,
-                              "ok": not news}))
+                              "ok": not news and apply_ok}))
         else:
             for n in news:
                 print(f"baseline: {n}")
             print(f"graphlint: {'no new codes' if not news else f'{len(news)} NEW finding code(s)'} vs {args.baseline}")
-        return 1 if news else 0
+            if not apply_ok:
+                print("graphlint: rewrite verification REGRESSED "
+                      "(see rollbacks above)")
+        return 1 if (news or not apply_ok) else 0
 
     if args.as_json:
         counts = {k: out[k]["counts"] for k in out}
         print(json.dumps({"targets": out, "counts": counts,
-                          "mem_peak_bytes": mem_peaks, "ok": all_ok}))
-    elif all_ok:
+                          "mem_peak_bytes": mem_peaks,
+                          "ok": all_ok and apply_ok}))
+    elif all_ok and apply_ok:
         print(f"graphlint: all {len(names)} target(s) clean at "
-              f">={args.fail_on}")
-    return 0 if all_ok else 1
+              f">={args.fail_on}"
+              + (" (rewrite tier verified)" if args.apply else ""))
+    elif not apply_ok:
+        print("graphlint: rewrite verification REGRESSED")
+    return 0 if (all_ok and apply_ok) else 1
 
 
 if __name__ == "__main__":
