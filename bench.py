@@ -460,6 +460,40 @@ def _run_graphlint(timeout: float = 900.0, rewrite_tier: bool = True,
         return {"error": repr(e)[:300]}
 
 
+def _run_spmd(timeout: float = 600.0) -> dict:
+    """extra.spmd: the SPMD propagation tier's verdict on the sharded
+    llama train step under a 2x2 (dp x tp) mesh — per-eqn sharding
+    coverage, priced collectives, and the comm-vs-compute roofline
+    (tools/graphlint.py --mesh, CPU subprocess with 8 forced host
+    devices).  Static only: nothing executes beyond tracing."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "graphlint.py")
+    argv = [sys.executable, script, "llama", "--mesh", "data=2,model=2",
+            "--no-hlo", "--json"]
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    flags = env.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout, env=env)
+        if out.returncode not in (0, 1):
+            return {"error": f"rc={out.returncode} "
+                             f"{out.stderr.strip()[-300:]}"}
+        d = json.loads(out.stdout.strip().splitlines()[-1])
+        sp = d.get("targets", {}).get("llama", {}).get("spmd")
+        if sp is None:
+            return {"error": "spmd tier did not run"}
+        sp.pop("rows", None)            # the per-eqn table is a CLI view
+        sp["collectives"] = sp.get("collectives", [])[:5]
+        return sp
+    except subprocess.TimeoutExpired:
+        return {"error": f"spmd lint timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — lint must not kill the bench
+        return {"error": repr(e)[:300]}
+
+
 def _run_sub(name: str, timeout: "float | None" = None) -> dict:
     """Run `python bench.py --sub {name}` and parse its one-line JSON."""
     if timeout is None:
@@ -576,6 +610,7 @@ def main():
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
+    spmd_extra = _run_spmd()
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -623,6 +658,11 @@ def main():
             # + static FLOPs/bytes before/after the verified passes —
             # what closing the lint->transform loop buys each round
             "rewrite": rewrite_extra,
+            # SPMD tier (graphlint --mesh data=2,model=2): predicted
+            # shardings + priced collectives + comm-vs-compute roofline
+            # for the sharded llama step — the static substrate the
+            # pod-scale partitioner work is measured against
+            "spmd": spmd_extra,
         },
     }))
 

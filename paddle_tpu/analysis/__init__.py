@@ -14,6 +14,14 @@ rebuilt where it belongs under XLA — in TWO tiers:
                    collectives, materialized transposes, and buffer-
                    assignment memory (what jaxprs structurally cannot
                    see).  `core.merge_reports` joins both tiers.
+  tier 3 (rewrite):`rewrite(fn, *args)` consumes findings and TRANSFORMS
+                   the jaxpr (dce/dtype/fusion/shard_constraint/
+                   donation), every pass gated by `equiv.verify`.
+  tier 4 (SPMD):   under `analyze(..., mesh=...)` with a >1-device mesh,
+                   `spmd.py` propagates PartitionSpecs per eqn and
+                   prices every implied collective (`comm_cost.py`) —
+                   SHARD_RESHARD / mesh-aware SHARD_REPLICATED /
+                   COLLECTIVE_BOUND roofline.
 
 On top of findings, `fixes.suggest_fixes(report)` emits concrete patch
 suggestions (exact donate_argnums, constraint insertion points, dtype
@@ -39,8 +47,10 @@ from .core import (  # noqa: F401
     load_rcfile, merge_reports, register_checker, suppressions,
 )
 from . import cost  # noqa: F401
+from . import comm_cost  # noqa: F401 — static collective cost model
 from . import checkers as _checkers  # noqa: F401 — registers the jaxpr set
 from . import memory  # noqa: F401 — registers the memory checker
+from . import spmd  # noqa: F401 — registers the mesh-aware SPMD tier
 from .hlo import (  # noqa: F401
     analyze_hlo, lint_bucket_menu, list_hlo_checkers, register_hlo_checker,
 )
@@ -61,5 +71,5 @@ __all__ = [
     "list_checkers", "list_hlo_checkers", "list_rewrites", "load_rcfile",
     "merge_reports", "register_checker", "register_hlo_checker",
     "register_rewrite", "rewrite", "rewrite_jaxpr", "rewrite_lib",
-    "suppressions", "cost", "memory", "hlo", "fixes",
+    "suppressions", "cost", "comm_cost", "memory", "hlo", "fixes", "spmd",
 ]

@@ -200,6 +200,15 @@ def check_sharding(ctx: CheckContext):
     mesh = ctx.mesh
     if mesh is None or getattr(mesh, "size", 1) <= 1:
         return []
+    # the mesh-aware SPMD tier (analysis/spmd.py) subsumes this taint
+    # walk — spec-precise SHARD_REPLICATED (exact PartitionSpec) and
+    # priced SHARD_GAP/SHARD_RESHARD — so when it runs IN THIS CALL the
+    # taint walk stands down rather than double-reporting the same
+    # sites; an explicit checkers=["sharding"] still gets it.
+    # `legacy_sharding_taint=True` forces the taint walk back on.
+    if "spmd" in ctx.active_checkers \
+            and not ctx.opt("legacy_sharding_taint"):
+        return []
     thresh = ctx.opt("sharding_min_bytes")
     findings: List[Finding] = []
 

@@ -1,0 +1,154 @@
+"""Static collective cost model — price a collective from mesh + chip.
+
+The comm-side analog of `analysis/cost.py`'s FLOPs roll-up: given a
+collective kind, the logical array it moves, and the mesh axes it runs
+over, return the bytes that actually cross ICI links and a time estimate
+from a per-chip-generation link-bandwidth table.  The SPMD tier
+(`analysis/spmd.py`) prices every implied collective this way and joins
+the total against the cost pass's FLOPs to produce the per-step
+comm-vs-compute roofline (`COLLECTIVE_BOUND`).
+
+Model assumptions (stated, not hidden — see ARCHITECTURE.md table):
+
+  * ring algorithms on one ICI axis: an all-gather of a FULL (logical)
+    array of B bytes over an axis of size n moves B*(n-1)/n bytes
+    through each chip's link -> t = B*(n-1)/(n*bw)
+  * reduce-scatter prices identically; all-reduce = reduce-scatter +
+    all-gather = 2x; all-to-all moves each chip's shard once ->
+    B*(n-1)/n^2; ppermute is one shard hop -> B/n
+  * multi-axis collectives (e.g. psum over ("data","sharding")) use the
+    PRODUCT of the axis sizes and the single-link bandwidth — a
+    conservative serial-ring bound (real pods overlap the axes)
+  * bandwidth is one-way per-link ICI, bytes/s, from the public chip
+    specs; CPU / unknown chips price at the v5e number so the roofline
+    is still comparable across rounds (the `chip` option overrides)
+  * latency per hop is a constant alpha added per (n-1) ring step —
+    negligible for MB-scale tensors, dominant for the KB-scale ones the
+    COLLECTIVE_SEQ lint wants combined.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "LINK_BW_BY_KIND", "CollectiveCost", "link_bandwidth", "chip_peak_flops",
+    "price_collective", "roofline",
+]
+
+# one-way ICI bandwidth per link, bytes/s; most-specific-first substring
+# match on the chip/device_kind string (same convention as
+# obs.mfu.PEAK_FLOPS_BY_KIND — one table style, two tables of truth)
+LINK_BW_BY_KIND: Tuple[Tuple[str, float], ...] = (
+    ("v6e", 90e9), ("v6", 90e9),
+    ("v5 lite", 45e9), ("v5e", 45e9), ("v5litepod", 45e9),
+    ("v5p", 90e9), ("v5", 90e9),
+    ("v4", 45e9),
+    ("v3", 70e9),
+)
+
+_DEFAULT_CHIP = "v5e"
+
+# per-hop launch/latency cost (s): ring collectives pay ~(n-1) of these;
+# the number only matters for small tensors, where it IS the cost
+_ALPHA_S = 1e-6
+
+
+def link_bandwidth(chip: Optional[str] = None) -> float:
+    """One-way per-link ICI bytes/s for a chip-kind string ("TPU v5
+    lite", "v4", ...).  Unknown/CPU chips price at the v5e number."""
+    kind = (chip or _DEFAULT_CHIP).lower()
+    for k, bw in LINK_BW_BY_KIND:
+        if k in kind:
+            return bw
+    return dict(LINK_BW_BY_KIND)["v5e"]
+
+
+def chip_peak_flops(chip: Optional[str] = None) -> float:
+    """bf16 peak FLOP/s for the chip string — obs.mfu's table, matched
+    the same way (lazy import: obs depends on analysis.cost)."""
+    from ..obs.mfu import PEAK_FLOPS_BY_KIND
+
+    kind = (chip or _DEFAULT_CHIP).lower()
+    for k, v in PEAK_FLOPS_BY_KIND:
+        if k in kind:
+            return v
+    return dict(PEAK_FLOPS_BY_KIND)["v5e"]
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveCost:
+    """One priced collective: what moves, over which axes, how long."""
+
+    kind: str                   # all_gather | reduce_scatter | all_reduce
+    #                             | all_to_all | ppermute
+    bytes: int                  # FULL logical array bytes (pre-shard)
+    axes: Tuple[str, ...]       # mesh axes the collective runs over
+    axis_size: int              # product of those axes' sizes
+    moved_bytes: int            # bytes through one chip's link(s)
+    seconds: float              # ring-model time estimate
+    path: str = ""              # eqn path that implied it
+    weight: int = 1             # scan trip multiplier already applied
+    reason: str = ""            # why it exists ("grad psum", "reshard")
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "bytes": int(self.bytes),
+                "axes": list(self.axes), "axis_size": int(self.axis_size),
+                "moved_bytes": int(self.moved_bytes),
+                "seconds": float(self.seconds), "path": self.path,
+                "weight": int(self.weight), "reason": self.reason}
+
+
+# moved-bytes fraction of the full array, as a function of axis size n
+_MOVED_FRAC = {
+    "all_gather": lambda n: (n - 1) / n,
+    "reduce_scatter": lambda n: (n - 1) / n,
+    "all_reduce": lambda n: 2.0 * (n - 1) / n,
+    "all_to_all": lambda n: (n - 1) / (n * n),
+    "ppermute": lambda n: 1.0 / n,
+}
+
+
+def price_collective(kind: str, nbytes: int, axes: Sequence[str],
+                     axis_sizes: Dict[str, int],
+                     chip: Optional[str] = None, path: str = "",
+                     weight: int = 1, reason: str = "") -> CollectiveCost:
+    """Price one collective of a FULL logical array of `nbytes` over the
+    named mesh `axes` (sizes from `axis_sizes`) on `chip`."""
+    n = 1
+    for a in axes:
+        n *= max(1, int(axis_sizes.get(a, 1)))
+    frac_fn = _MOVED_FRAC.get(kind, _MOVED_FRAC["all_reduce"])
+    moved = int(nbytes * frac_fn(max(n, 1)))
+    bw = link_bandwidth(chip)
+    secs = (moved / bw + _ALPHA_S * max(n - 1, 0)) * max(1, int(weight))
+    return CollectiveCost(
+        kind=kind, bytes=int(nbytes), axes=tuple(axes), axis_size=n,
+        moved_bytes=moved * max(1, int(weight)), seconds=secs, path=path,
+        weight=int(weight), reason=reason)
+
+
+def roofline(total_flops: float, collectives: Iterable[CollectiveCost],
+             mesh_size: int, chip: Optional[str] = None) -> dict:
+    """Join the cost pass's FLOPs with the priced collectives into one
+    comm-vs-compute verdict.  Compute time divides the program's TOTAL
+    FLOPs over the mesh (SPMD: every chip runs 1/n of the math); comm
+    time sums the ring estimates (serial bound — no overlap credit, so
+    `bound == "comm"` means comm CANNOT hide behind compute even with a
+    perfect scheduler at this mesh/chip)."""
+    coll = list(collectives)
+    t_comm = float(sum(c.seconds for c in coll))
+    peak = chip_peak_flops(chip)
+    t_compute = float(total_flops) / max(1, int(mesh_size)) / peak
+    denom = max(t_comm + t_compute, 1e-30)
+    return {
+        "chip": chip or _DEFAULT_CHIP,
+        "mesh_size": int(mesh_size),
+        "t_compute_s": t_compute,
+        "t_comm_s": t_comm,
+        "comm_fraction": t_comm / denom,
+        "bound": "comm" if t_comm > t_compute else "compute",
+        "n_collectives": len(coll),
+        "collective_bytes": int(sum(c.moved_bytes for c in coll)),
+    }

@@ -468,6 +468,10 @@ class CheckContext:
     options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     # flat-invar index -> human arg path ("args[0]['blocks']['wq']")
     arg_names: Dict[int, str] = dataclasses.field(default_factory=dict)
+    # the checker names actually running in THIS analyze call — checkers
+    # that defer to a sibling (the taint sharding walk stands down when
+    # the spmd tier runs) must consult this, not the global registry
+    active_checkers: Tuple[str, ...] = ()
 
     def opt(self, key: str, default=None):
         if key in self.options:
@@ -519,6 +523,7 @@ def _arg_name_map(args, kwargs) -> Dict[int, str]:
 def _run_checkers(ctx: CheckContext, checkers, suppress,
                   config: Optional[dict] = None) -> Report:
     names = list_checkers() if checkers is None else list(checkers)
+    ctx.active_checkers = tuple(names)
     findings: List[Finding] = []
     for name in names:
         if name not in CHECKER_REGISTRY:

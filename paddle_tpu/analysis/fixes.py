@@ -112,17 +112,60 @@ def _donation_patches(findings: List[Finding]) -> List[Patch]:
     return out
 
 
+def _pspec_repr(spec) -> str:
+    entries = ", ".join(
+        repr(tuple(e)) if isinstance(e, (list, tuple)) else repr(e)
+        if e is not None else "None" for e in spec)
+    return f"P({entries})"
+
+
 def _shard_patch(f: Finding) -> Patch:
     shape = f.message.split(" ", 1)[0]
-    diff = (" big = <the value created at the flagged eqn>\n"
-            "+big = jax.lax.with_sharding_constraint(\n"
-            "+    big, NamedSharding(mesh, P('data', None)))  "
-            "# pick the axis that matches its producers")
+    spec = f.data.get("spec")
+    target = f.data.get("target") or f.eqn_path
+    if spec is not None:
+        # the SPMD tier computed the exact spec: emit it verbatim (the
+        # same spec the shard_constraint rewrite pass injects)
+        diff = (f" big = <the value created at {target}>\n"
+                "+big = jax.lax.with_sharding_constraint(\n"
+                f"+    big, NamedSharding(mesh, {_pspec_repr(spec)}))")
+        note = (f"dim {f.data.get('dim')} divides mesh axis "
+                f"{f.data.get('axis')!r}; graphlint --fix --apply "
+                "injects (and verifies) this constraint mechanically")
+    else:
+        diff = (" big = <the value created at the flagged eqn>\n"
+                "+big = jax.lax.with_sharding_constraint(\n"
+                "+    big, NamedSharding(mesh, P('data', None)))  "
+                "# pick the axis that matches its producers")
+        note = ("any sharded PartitionSpec reaching the value stops GSPMD "
+                "from replicating it on every device")
     return Patch(
         title=f"shard the replicated {shape} at {f.eqn_path}",
+        codes=[f.code], eqn_paths=[f.eqn_path], diff=diff, note=note,
+        target=target)
+
+
+def _reshard_patch(f: Finding) -> Patch:
+    """SPMD tier: an eqn boundary whose operand/result specs disagree —
+    the patch names the implied collective and both layouts."""
+    kind = str(f.data.get("collective", "all_gather"))
+    src = f.data.get("src_spec")
+    dst = f.data.get("dst_spec")
+    lay = (f"-# producer layout {_pspec_repr(src)} vs consumer "
+           f"{_pspec_repr(dst)}\n" if src is not None and dst is not None
+           else "")
+    diff = (lay
+            + f"-y = <resharded here: implied {kind} of "
+            f"{fmt_bytes(int(f.data.get('bytes', 0)))}>\n"
+            "+# align the constraint/in_sharding with the producer's "
+            "layout,\n"
+            "+# or move the reshard off the per-step hot path")
+    return Patch(
+        title=f"eliminate the {kind} at {f.eqn_path}",
         codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
-        note="any sharded PartitionSpec reaching the value stops GSPMD "
-             "from replicating it on every device")
+        note="predicted by the SPMD propagation tier (analysis/spmd.py); "
+             "see COLLECTIVE_BOUND for what it costs per step",
+        target=f.eqn_path)
 
 
 def _dtype_patch(f: Finding) -> Patch:
@@ -241,6 +284,8 @@ def suggest_fixes(report: Report) -> List[Patch]:
         [f for f in fixable if f.code == "DONATION_MISSING"])
     patches += [_shard_patch(f) for f in fixable
                 if f.code == "SHARD_REPLICATED"]
+    patches += [_reshard_patch(f) for f in fixable
+                if f.code == "SHARD_RESHARD"]
     patches += [_dtype_patch(f) for f in fixable
                 if f.code.startswith("DTYPE_")]
     patches += [_layout_patch(f) for f in fixable

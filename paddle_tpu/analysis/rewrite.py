@@ -200,8 +200,10 @@ class _RewritePass:
 REWRITE_REGISTRY: Dict[str, _RewritePass] = {}
 
 # default order: shrink first (dce), then retype, then restructure, then
-# annotate — donation last so it sees the final pjit structure
-_DEFAULT_PASSES = ("dce", "dtype_cast", "fusion", "donation")
+# annotate — shard_constraint before donation (it rebuilds pjit bodies),
+# donation last so it sees the final pjit structure
+_DEFAULT_PASSES = ("dce", "dtype_cast", "fusion", "shard_constraint",
+                   "donation")
 
 
 def register_rewrite(name: str, consumes: Sequence[str]):
@@ -230,6 +232,9 @@ class RewriteContext:
     options: Dict[str, Any] = dataclasses.field(default_factory=dict)
     actions: List[RewriteAction] = dataclasses.field(default_factory=list)
     notes: List[str] = dataclasses.field(default_factory=list)
+    # the device mesh the program targets — the shard_constraint pass
+    # needs it to build NamedShardings; None for single-device programs
+    mesh: Any = None
 
     def opt(self, key: str, default=None):
         from .core import _DEFAULT_OPTIONS
@@ -957,6 +962,107 @@ def rewrite_fusion(ctx: RewriteContext):
 
 
 # ---------------------------------------------------------------------------
+# pass 5: sharding-constraint injection (mesh-aware retrace)
+# ---------------------------------------------------------------------------
+
+
+def _pspec_entries(spec) -> tuple:
+    """Finding data carries the spec as a JSON-ish list (entries None /
+    str / list-of-str) — normalize to PartitionSpec constructor args."""
+    out = []
+    for e in spec:
+        out.append(tuple(e) if isinstance(e, (list, tuple)) else e)
+    return tuple(out)
+
+
+class _ShardRules(_RetraceRules):
+    """inject: {eqn_path: pspec entries} — wrap that eqn's output in
+    with_sharding_constraint; drop: {eqn_path} — elide a re-replicating
+    sharding_constraint (identity on values, frees the all-gather)."""
+
+    def __init__(self, ctx: RewriteContext, mesh, inject, drop):
+        self.ctx = ctx
+        self.mesh = mesh
+        self.inject = dict(inject)
+        self.drop = set(drop)
+        self.hit: set = set()
+
+    def wants(self, sub_jaxpr, path) -> bool:
+        prefix = "/".join(path) + "/" if path else ""
+        return any(t.startswith(prefix)
+                   for t in (*self.inject, *self.drop))
+
+    def on_eqn(self, eqn, path, invals, plan, read):
+        p = format_path(path, eqn)
+        if p in self.drop and eqn.primitive.name == "sharding_constraint":
+            def elide():
+                self.hit.add(p)
+                self.ctx.act(
+                    "SHARD_GAP", p,
+                    "elided the re-replicating with_sharding_constraint "
+                    "(identity on values; frees the implied all-gather)")
+                return [read(eqn.invars[0])]
+
+            return ("compute", elide)
+        spec = self.inject.get(p)
+        if spec is None or self.mesh is None:
+            return None
+        if eqn.primitive.name in _OPAQUE_PRIMS \
+                or any(True for _ in _sub_closed_params(eqn)):
+            return None                 # constrain leaf eqns only
+
+        def constrain():
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            vals = [read(v) for v in eqn.invars]
+            outs = _bind_default(eqn, vals)
+            sh = NamedSharding(self.mesh, P(*_pspec_entries(spec)))
+            outs = [jax.lax.with_sharding_constraint(outs[0], sh)] \
+                + outs[1:]
+            self.hit.add(p)
+            self.ctx.act(
+                "SHARD_REPLICATED", p,
+                f"injected with_sharding_constraint(P{_pspec_entries(spec)!r}) "
+                f"at the replicated creation point",
+                spec=list(spec))
+            return outs
+
+        return ("compute", constrain)
+
+
+@register_rewrite("shard_constraint",
+                  consumes=("SHARD_REPLICATED", "SHARD_GAP"))
+def rewrite_shard_constraint(ctx: RewriteContext):
+    """Consume the SPMD tier's findings: inject the EXACT PartitionSpec
+    a mesh-aware SHARD_REPLICATED finding computed (data["spec"]) at its
+    creation point, and elide re-replicating constraints (SHARD_GAP) —
+    both via mesh-aware retrace.  Constraints are identity on values, so
+    the equivalence gate checks numerics while the re-lint gate checks
+    that the consumed findings actually disappeared (and no reshard
+    boundary appeared downstream of the new layout)."""
+    if ctx.mesh is None or getattr(ctx.mesh, "size", 1) <= 1:
+        ctx.notes.append("no multi-device mesh — nothing to constrain")
+        return None
+    inject = {f.eqn_path: f.data["spec"] for f in ctx.findings
+              if f.code == "SHARD_REPLICATED" and f.data.get("spec")
+              and _path_supported(f.eqn_path)}
+    drop = {f.eqn_path for f in ctx.findings
+            if f.code == "SHARD_GAP" and _path_supported(f.eqn_path)}
+    skipped = [f.eqn_path for f in ctx.findings
+               if not _path_supported(f.eqn_path)]
+    for s in skipped[:4]:
+        ctx.notes.append(f"shard site under unsupported container: {s}")
+    if not inject and not drop:
+        return None
+    rules = _ShardRules(ctx, ctx.mesh, inject, drop)
+    new_closed = _retrace(ctx.closed_jaxpr, rules)
+    if not rules.hit:
+        ctx.actions.clear()
+        return None
+    return new_closed
+
+
+# ---------------------------------------------------------------------------
 # the engine: gate every pass through equiv + re-lint, roll back failures
 # ---------------------------------------------------------------------------
 
@@ -994,7 +1100,7 @@ def rewrite_jaxpr(closed, report: Optional[Report] = None,
                   verify: bool = True, verify_grads: bool = True,
                   probes: Optional[Sequence] = None,
                   suppress: Sequence[str] = (),
-                  config: Optional[dict] = None):
+                  config: Optional[dict] = None, mesh=None):
     """Run the rewrite passes over an already-traced ClosedJaxpr.
 
     `report` seeds the pass gating (which findings exist) — pass the
@@ -1007,7 +1113,7 @@ def rewrite_jaxpr(closed, report: Optional[Report] = None,
     options = dict(options or {})
     if report is None:
         report = analyze_jaxpr(closed, options=options, suppress=suppress,
-                               config=config)
+                               config=config, mesh=mesh)
     names = list(passes) if passes is not None else list(_DEFAULT_PASSES)
     for n in names:
         if n not in REWRITE_REGISTRY:
@@ -1044,7 +1150,7 @@ def rewrite_jaxpr(closed, report: Optional[Report] = None,
                 name, "skipped", reason="no consumable findings", **base))
             continue
         ctx = RewriteContext(closed_jaxpr=current, findings=matched,
-                             options=options)
+                             options=options, mesh=mesh)
         try:
             candidate = p.fn(ctx)
         except Exception as e:  # noqa: BLE001 — a pass must never crash
@@ -1081,9 +1187,10 @@ def rewrite_jaxpr(closed, report: Optional[Report] = None,
             if before_lint is None:
                 before_lint = analyze_jaxpr(
                     current, options=options, suppress=suppress,
-                    config=config)
+                    config=config, mesh=mesh)
             after_lint = analyze_jaxpr(candidate, options=options,
-                                       suppress=suppress, config=config)
+                                       suppress=suppress, config=config,
+                                       mesh=mesh)
             ok, why = _relint_gate(p, before_lint, after_lint)
             if not ok:
                 outcome.status = "rolled_back"
@@ -1148,10 +1255,18 @@ def rewrite(fn, *args, passes: Optional[Sequence[str]] = None,
                 pass
 
     probes = equiv.make_probes(closed, flat_args) if verify else None
+    if mesh is not None and flat_args:
+        # the re-lint gate runs analyze_jaxpr (no concrete args): hand it
+        # the call site's input shardings so the spmd tier sees the same
+        # sharding world before and after each pass
+        from .spmd import spec_of_value
+        options = dict(options or {})
+        options.setdefault("spmd_in_specs",
+                           [spec_of_value(x) for x in flat_args])
     new_closed, rep = rewrite_jaxpr(
         closed, report=report, passes=passes, options=options,
         verify=verify, verify_grads=verify_grads, probes=probes,
-        suppress=suppress, config=config)
+        suppress=suppress, config=config, mesh=mesh)
 
     def rewritten(*a, **kw):
         if kw:

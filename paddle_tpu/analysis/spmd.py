@@ -1,0 +1,975 @@
+"""Graph Doctor tier 4: mesh-aware SPMD sharding propagation.
+
+The taint-based `sharding` checker (tier 1) answers "does any sharded
+value REACH this tensor"; this module answers the question GSPMD itself
+answers at compile time: "what `PartitionSpec` does every eqn's output
+carry, and which collectives does the program imply?"  It is an abstract
+interpreter over the ClosedJaxpr — per-var state is a tuple of mesh-axis
+sets (one per dim) plus a set of *partial* axes (pending psum, the way
+GSPMD models a dot whose contracting dim was sharded) — seeded from the
+actual arg shardings, pjit `in_shardings`/`out_shardings`, and every
+in-graph `sharding_constraint`, and propagated forward through per-prim
+rules (dot_general contraction -> partial, reduce over a sharded dim ->
+partial, reshape/transpose/broadcast dim maps, scan carry fixpoint, ...).
+
+Three finding families fall out:
+
+  SHARD_RESHARD     an eqn boundary whose operand/result specs disagree
+                    — the implied collective is NAMED (all-gather /
+                    all-to-all / reduce-scatter) and PRICED (bytes +
+                    ring-model seconds via `comm_cost`)
+  SHARD_REPLICATED  (mesh-aware) a large fully-replicated value whose
+                    dims are divisible by a free mesh axis — the finding
+                    carries the EXACT PartitionSpec to apply, which the
+                    `shard_constraint` rewrite pass injects verbatim
+  SHARD_GAP         a sharding_constraint that re-replicates a sharded
+                    value (the legacy code, now with the all-gather
+                    priced)
+  COLLECTIVE_BOUND  the per-step comm-vs-compute roofline: every implied
+                    collective (including the EXPECTED ones — the grad
+                    psum is not a bug, but it is a cost) summed against
+                    the cost pass's FLOPs at the chip's peak
+
+`propagate()` is the library surface (returns the per-eqn spec table +
+priced collectives); the `spmd` checker wires it into `analyze(...,
+mesh=...)`; `tools/graphlint.py --mesh dp=2,tp=4` is the CLI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from . import comm_cost
+from . import cost as cost_lib
+from .core import (
+    CheckContext, Finding, Severity, _as_open, _eqn_label, aval_bytes,
+    fmt_aval, fmt_bytes, format_path, is_array_var, register_checker,
+)
+
+__all__ = ["VSpec", "SpmdResult", "propagate", "spec_of_value",
+           "suggest_spec", "check_spmd"]
+
+_EMPTY: FrozenSet[str] = frozenset()
+
+
+# ---------------------------------------------------------------------------
+# value state
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class VSpec:
+    """Abstract sharding of one value: per-dim mesh-axis sets + pending
+    partial-sum axes (GSPMD's 'partial' annotation)."""
+
+    dims: Tuple[FrozenSet[str], ...]
+    partial: FrozenSet[str] = _EMPTY
+
+    @property
+    def is_replicated(self) -> bool:
+        return not self.partial and all(not d for d in self.dims)
+
+    @property
+    def sharded_axes(self) -> FrozenSet[str]:
+        out = set()
+        for d in self.dims:
+            out |= d
+        return frozenset(out)
+
+    def pspec(self) -> list:
+        """PartitionSpec-shaped list: None / axis / tuple per dim."""
+        out = []
+        for d in self.dims:
+            if not d:
+                out.append(None)
+            elif len(d) == 1:
+                out.append(next(iter(d)))
+            else:
+                out.append(tuple(sorted(d)))
+        return out
+
+    def __str__(self):
+        body = ", ".join("None" if p is None else repr(p)
+                         for p in self.pspec())
+        s = f"P({body})"
+        if self.partial:
+            s += f"+partial{sorted(self.partial)}"
+        return s
+
+
+def _repl(ndim: int) -> VSpec:
+    return VSpec(dims=(_EMPTY,) * ndim)
+
+
+def _from_pspec(pspec, ndim: int) -> VSpec:
+    """PartitionSpec (or list of entries) -> VSpec, padded to ndim."""
+    entries = list(pspec or ())[:ndim]
+    dims = []
+    for e in entries:
+        if e is None:
+            dims.append(_EMPTY)
+        elif isinstance(e, (tuple, list)):
+            dims.append(frozenset(a for a in e if a is not None))
+        else:
+            dims.append(frozenset({e}))
+    dims += [_EMPTY] * (ndim - len(dims))
+    return VSpec(dims=tuple(dims))
+
+
+def _dedupe_axes(dims: Sequence[FrozenSet[str]],
+                 partial: FrozenSet[str] = _EMPTY) -> VSpec:
+    """An axis may shard at most one dim: keep its FIRST use."""
+    seen: set = set()
+    out = []
+    for d in dims:
+        keep = frozenset(a for a in d if a not in seen)
+        seen |= keep
+        out.append(keep)
+    return VSpec(dims=tuple(out), partial=frozenset(partial - seen))
+
+
+def spec_of_value(x) -> Optional[list]:
+    """The PartitionSpec entries of a concrete array's NamedSharding
+    (None for unsharded/unknown values) — the arg-seeding helper."""
+    s = getattr(x, "sharding", None)
+    spec = getattr(s, "spec", None)
+    if spec is None:
+        return None
+    return list(spec)
+
+
+def _named_spec(sharding) -> Optional[list]:
+    spec = getattr(sharding, "spec", None)
+    return None if spec is None else list(spec)
+
+
+def suggest_spec(shape: Sequence[int], used_axes: FrozenSet[str],
+                 axis_sizes: Dict[str, int]) -> Optional[Tuple[int, str]]:
+    """(dim, axis) to shard a replicated value on: the largest free mesh
+    axis that evenly divides some dim (leftmost dim wins).  None when no
+    axis divides — the value is NOT provably shardable."""
+    free = sorted(((n, a) for a, n in axis_sizes.items()
+                   if n > 1 and a not in used_axes), reverse=True)
+    for n, axis in free:
+        for d, size in enumerate(shape):
+            if size >= n and size % n == 0:
+                return d, axis
+    return None
+
+
+# ---------------------------------------------------------------------------
+# result container
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SpmdResult:
+    """What `propagate` returns: per-eqn predicted shardings, the priced
+    collectives, the SHARD_* findings, and the roofline join."""
+
+    eqn_rows: List[dict]
+    collectives: List[comm_cost.CollectiveCost]
+    findings: List[Finding]
+    roofline: dict
+    mesh_axes: Dict[str, int]
+    chip: str
+
+    def summary(self, top_k: int = 8) -> dict:
+        coll = sorted(self.collectives, key=lambda c: -c.seconds)
+        return {
+            "mesh": dict(self.mesh_axes),
+            "chip": self.chip,
+            "n_eqns": len(self.eqn_rows),
+            "n_collectives": len(self.collectives),
+            "reshard_count": sum(1 for f in self.findings
+                                 if f.code == "SHARD_RESHARD"),
+            "collectives": [c.to_dict() for c in coll[:top_k]],
+            "roofline": dict(self.roofline),
+        }
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+# partial-sum passes through these unchanged (linear, shape-only, or
+# sum-reducing) — anything else materializes the psum first
+_PARTIAL_LINEAR = frozenset({
+    "add", "sub", "neg", "convert_element_type", "transpose", "reshape",
+    "broadcast_in_dim", "squeeze", "slice", "copy", "reduce_sum", "rev",
+    "real", "imag", "reduce_precision",
+})
+
+_REDUCE_PRIMS = {
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "reduce_xor", "argmax", "argmin",
+}
+
+_CREATION_PRIMS = frozenset({
+    "iota", "rng_bit_generator", "random_seed", "random_bits",
+    "random_wrap", "random_unwrap",
+})
+
+# containers recursed with operand specs when arities line up
+_GENERIC_CONTAINERS = frozenset({
+    "custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+    "remat", "checkpoint", "closed_call", "core_call", "named_call",
+    "custom_vjp_call_lifted",
+})
+
+
+def _ndim(v) -> int:
+    return len(getattr(getattr(v, "aval", None), "shape", ()) or ())
+
+
+class _Interp:
+    def __init__(self, mesh_axes: Dict[str, int], options, chip: str,
+                 min_bytes: int):
+        self.axis_sizes = dict(mesh_axes)
+        self.opt = options                      # callable(key) -> value
+        self.chip = chip
+        self.min_bytes = min_bytes
+        self.findings: List[Finding] = []
+        self.collectives: List[comm_cost.CollectiveCost] = []
+        self.eqn_rows: List[dict] = []
+        self._mute = 0                          # >0 during fixpoint runs
+        self._materialized: set = set()         # vars whose psum was priced
+
+    # -- recording ----------------------------------------------------------
+
+    def _collective(self, kind, nbytes, axes, path, weight, reason):
+        if self._mute or not axes or nbytes <= 0:
+            return None
+        c = comm_cost.price_collective(
+            kind, nbytes, sorted(axes), self.axis_sizes, chip=self.chip,
+            path=path, weight=weight, reason=reason)
+        self.collectives.append(c)
+        return c
+
+    def _find(self, severity, code, path, message, suggestion="", **data):
+        if self._mute:
+            return
+        self.findings.append(Finding(
+            severity, code, path, message, suggestion, checker="spmd",
+            data=data))
+
+    # -- partial materialization -------------------------------------------
+
+    def _materialize(self, spec: VSpec, var, path: str, weight: int,
+                     reason: str) -> VSpec:
+        """Price the pending psum of a partial value (once per var) and
+        return the full (non-partial) spec."""
+        if not spec.partial:
+            return spec
+        if var not in self._materialized:
+            if not self._mute:
+                self._materialized.add(var)
+            self._collective(
+                "all_reduce", aval_bytes(var.aval) if is_array_var(var)
+                else 0, spec.partial, path, weight, reason)
+        return VSpec(dims=spec.dims)
+
+    # -- reshard classification --------------------------------------------
+
+    def _classify_reshard(self, src: VSpec, dst: VSpec, nbytes: int,
+                          path: str, weight: int, who: str) -> List[str]:
+        """Collectives implied by forcing a value from `src` to `dst`
+        layout.  Returns the implied kinds (priced as a side effect)."""
+        kinds: List[str] = []
+        if src.partial:
+            scatter = src.partial & dst.sharded_axes
+            reduce_ = src.partial - scatter
+            if scatter:
+                self._collective("reduce_scatter", nbytes, scatter, path,
+                                 weight, f"{who}: partial -> sharded")
+                kinds.append("reduce_scatter")
+            if reduce_:
+                self._collective("all_reduce", nbytes, reduce_, path,
+                                 weight, f"{who}: partial -> full")
+                kinds.append("all_reduce")
+            src = VSpec(dims=src.dims)
+        moved, gathered = set(), set()
+        for i, axes in enumerate(src.dims):
+            for a in axes:
+                dst_dim = next((j for j, dd in enumerate(dst.dims)
+                                if a in dd), None)
+                if dst_dim is None:
+                    gathered.add(a)
+                elif dst_dim != i:
+                    moved.add(a)
+        if moved:
+            self._collective("all_to_all", nbytes, moved, path, weight,
+                             f"{who}: axis moved dims")
+            kinds.append("all_to_all")
+        if gathered:
+            self._collective("all_gather", nbytes, gathered, path, weight,
+                             f"{who}: axis unsharded")
+            kinds.append("all_gather")
+        return kinds
+
+    # -- elementwise join ---------------------------------------------------
+
+    def _join_elementwise(self, eqn, in_specs, path, weight) -> VSpec:
+        """Broadcast-aware join: output dim takes the first non-empty
+        operand axis set; a CONFLICT (two different non-empty sets) is a
+        resharding boundary — the minority operand gets gathered."""
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        out_nd = len(out_shape)
+        dims: List[FrozenSet[str]] = [_EMPTY] * out_nd
+        partial: set = set()
+        prim = eqn.primitive.name
+        n_partial = sum(1 for s in in_specs if s.partial)
+        partial_sets = {s.partial for s in in_specs if s.partial}
+        for pos, (v, spec) in enumerate(zip(eqn.invars, in_specs)):
+            if spec.partial:
+                # psum only distributes over ops it is linear in: +/-
+                # need EVERY operand partial over the SAME axes (a
+                # replicated addend would be summed n times); mul by one
+                # replicated factor scales each shard; div only when the
+                # pending sum is the NUMERATOR — sum_i(a_i/b) == a/b but
+                # sum_i(a/b_i) != a/sum_i(b_i)
+                if prim in ("add", "sub"):
+                    keep = (n_partial == len(in_specs)
+                            and len(partial_sets) == 1)
+                elif prim == "mul":
+                    keep = n_partial == 1
+                elif prim == "div":
+                    keep = n_partial == 1 and pos == 0
+                else:
+                    keep = False
+                if keep:
+                    partial |= spec.partial
+                else:
+                    spec = self._materialize(spec, v, path, weight,
+                                             f"{prim} consumes partial")
+            shape = tuple(getattr(getattr(v, "aval", None), "shape", ()))
+            off = out_nd - len(shape)
+            for i, axes in enumerate(spec.dims):
+                if not axes or shape[i] != out_shape[off + i]:
+                    continue            # size-1 broadcast contributes none
+                j = off + i
+                if not dims[j]:
+                    dims[j] = axes
+                elif dims[j] != axes:
+                    nb = aval_bytes(v.aval)
+                    self._collective("all_gather", nb, axes, path, weight,
+                                     f"{prim} operand layout conflict")
+                    if nb >= self.min_bytes:
+                        self._find(
+                            Severity.WARNING, "SHARD_RESHARD", path,
+                            f"{prim} operands disagree on dim {j} layout "
+                            f"({sorted(dims[j])} vs {sorted(axes)}) — "
+                            f"GSPMD all-gathers {fmt_bytes(nb)} to "
+                            "reconcile them",
+                            "constrain both operands to one PartitionSpec "
+                            "upstream of this eqn",
+                            collective="all_gather", bytes=nb,
+                            axes=sorted(axes))
+        return _dedupe_axes(dims, frozenset(partial))
+
+    # -- per-primitive rules ------------------------------------------------
+
+    def _apply(self, eqn, in_specs: List[VSpec], path_t: Tuple[str, ...],
+               weight: int) -> List[VSpec]:
+        prim = eqn.primitive.name
+        path = format_path(path_t, eqn)
+        p = eqn.params
+
+        if prim == "sharding_constraint":
+            dst_entries = _named_spec(p.get("sharding"))
+            src = in_specs[0]
+            nd = _ndim(eqn.outvars[0])
+            if dst_entries is None:
+                return [src]
+            dst = _from_pspec(dst_entries, nd)
+            nb = aval_bytes(eqn.outvars[0].aval)
+            kinds = self._classify_reshard(src, dst, nb, path, weight,
+                                           "sharding_constraint")
+            big = nb >= self.min_bytes
+            if big and dst.is_replicated and "all_gather" in kinds:
+                self._find(
+                    Severity.WARNING, "SHARD_GAP", path,
+                    "with_sharding_constraint re-replicates a sharded "
+                    f"{fmt_aval(eqn.outvars[0].aval)} ({fmt_bytes(nb)}) — "
+                    "an implicit all-gather on every device",
+                    "constrain to a sharded PartitionSpec, or drop the "
+                    "constraint and let GSPMD propagate",
+                    collective="all_gather", bytes=nb,
+                    src_spec=src.pspec(), dst_spec=dst.pspec())
+            elif big and ("all_to_all" in kinds or "all_gather" in kinds):
+                kind = ("all_to_all" if "all_to_all" in kinds
+                        else "all_gather")
+                self._find(
+                    Severity.WARNING, "SHARD_RESHARD", path,
+                    f"sharding_constraint reshards {src} -> {dst} on a "
+                    f"{fmt_aval(eqn.outvars[0].aval)} ({fmt_bytes(nb)}) "
+                    f"— an implied {kind}",
+                    "align the constraint with the producer's layout, or "
+                    "move the reshard off the hot path",
+                    collective=kind, bytes=nb, src_spec=src.pspec(),
+                    dst_spec=dst.pspec())
+            return [dst]
+
+        if prim == "pjit":
+            return self._apply_pjit(eqn, in_specs, path_t, weight)
+        if prim == "scan":
+            return self._apply_scan(eqn, in_specs, path_t, weight)
+        if prim == "cond":
+            return self._apply_cond(eqn, in_specs, path_t, weight)
+        if prim == "while":
+            return self._apply_while(eqn, in_specs, path_t, weight)
+        if prim in _GENERIC_CONTAINERS:
+            return self._apply_generic_container(eqn, in_specs, path_t,
+                                                 weight)
+
+        if prim == "dot_general":
+            return self._apply_dot(eqn, in_specs, path, weight)
+
+        if prim in _REDUCE_PRIMS:
+            spec = in_specs[0]
+            if prim != "reduce_sum":
+                spec = self._materialize(spec, eqn.invars[0], path, weight,
+                                         f"{prim} consumes partial")
+            axes_param = p.get("axes", ())
+            reduced = set(spec.partial)
+            dims = [d for i, d in enumerate(spec.dims)
+                    if i not in axes_param]
+            for i in axes_param:
+                if i < len(spec.dims):
+                    reduced |= spec.dims[i]
+            out = [_dedupe_axes(dims, frozenset(reduced))]
+            return out * len(eqn.outvars)
+
+        if prim == "transpose":
+            perm = p["permutation"]
+            spec = in_specs[0]
+            return [VSpec(dims=tuple(spec.dims[i] for i in perm),
+                          partial=spec.partial)]
+
+        if prim == "broadcast_in_dim":
+            bd = p["broadcast_dimensions"]
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            in_shape = tuple(getattr(eqn.invars[0].aval, "shape", ()))
+            dims = [_EMPTY] * len(out_shape)
+            spec = in_specs[0]
+            for i, j in enumerate(bd):
+                if i < len(spec.dims) and in_shape[i] == out_shape[j]:
+                    dims[j] = spec.dims[i]
+            return [_dedupe_axes(dims, spec.partial)]
+
+        if prim == "reshape":
+            return [self._apply_reshape(eqn, in_specs[0])]
+
+        if prim == "squeeze":
+            drop = set(p.get("dimensions", ()))
+            spec = in_specs[0]
+            dims = [d for i, d in enumerate(spec.dims) if i not in drop]
+            return [VSpec(dims=tuple(dims), partial=spec.partial)]
+
+        if prim in ("slice", "dynamic_slice"):
+            spec = in_specs[0]
+            in_shape = tuple(eqn.invars[0].aval.shape)
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            dims = tuple(d if in_shape[i] == out_shape[i] else _EMPTY
+                         for i, d in enumerate(spec.dims))
+            return [VSpec(dims=dims, partial=spec.partial)]
+
+        if prim == "dynamic_update_slice":
+            spec = self._materialize(in_specs[0], eqn.invars[0], path,
+                                     weight, "dus consumes partial")
+            return [spec]
+
+        if prim == "concatenate":
+            d = int(p["dimension"])
+            nd = _ndim(eqn.outvars[0])
+            dims = [_EMPTY] * nd
+            for spec in in_specs:
+                for i in range(min(nd, len(spec.dims))):
+                    if i != d and not dims[i]:
+                        dims[i] = spec.dims[i]
+            return [_dedupe_axes(dims)]
+
+        if prim == "pad":
+            spec = in_specs[0]
+            in_shape = tuple(eqn.invars[0].aval.shape)
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            dims = tuple(d if i < len(in_shape)
+                         and in_shape[i] == out_shape[i] else _EMPTY
+                         for i, d in enumerate(spec.dims))
+            return [VSpec(dims=dims)]
+
+        if prim in _CREATION_PRIMS or prim in ("pallas_call",
+                                               "custom_partitioning"):
+            return [_repl(_ndim(v)) for v in eqn.outvars]
+
+        # generic: broadcast-compatible elementwise join (covers the
+        # long tail of unary/binary math prims), else conservative
+        # replication with partials materialized
+        out_shape = tuple(getattr(getattr(eqn.outvars[0], "aval", None),
+                                  "shape", ()) or ())
+
+        def bcast_ok(v):
+            shape = tuple(getattr(getattr(v, "aval", None), "shape", ())
+                          or ())
+            if len(shape) > len(out_shape):
+                return False
+            off = len(out_shape) - len(shape)
+            return all(s in (1, out_shape[off + i])
+                       for i, s in enumerate(shape))
+
+        if len(eqn.outvars) == 1 and all(bcast_ok(v) for v in eqn.invars):
+            return [self._join_elementwise(eqn, in_specs, path, weight)]
+        for v, s in zip(eqn.invars, in_specs):
+            self._materialize(s, v, path, weight,
+                              f"{prim} (opaque) consumes partial")
+        return [_repl(_ndim(v)) for v in eqn.outvars]
+
+    # -- structured rules ---------------------------------------------------
+
+    def _apply_dot(self, eqn, in_specs, path, weight) -> List[VSpec]:
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        lhs, rhs = in_specs[0], in_specs[1]
+        lhs = self._materialize(lhs, eqn.invars[0], path, weight,
+                                "dot consumes partial")
+        rhs = self._materialize(rhs, eqn.invars[1], path, weight,
+                                "dot consumes partial")
+        partial: set = set()
+        for i, j in zip(lc, rc):
+            la = lhs.dims[i] if i < len(lhs.dims) else _EMPTY
+            ra = rhs.dims[j] if j < len(rhs.dims) else _EMPTY
+            if la and ra and la != ra:
+                nb = aval_bytes(eqn.invars[1].aval)
+                self._collective("all_to_all", nb, ra, path, weight,
+                                 "dot contracting layout conflict")
+                if nb >= self.min_bytes:
+                    self._find(
+                        Severity.WARNING, "SHARD_RESHARD", path,
+                        "dot_general contracting dims carry different "
+                        f"axes ({sorted(la)} vs {sorted(ra)}) — GSPMD "
+                        f"reshards {fmt_bytes(nb)} to align them",
+                        "shard both operands' contracting dims the same "
+                        "way (or neither)",
+                        collective="all_to_all", bytes=nb,
+                        axes=sorted(ra))
+                ra = la
+            partial |= la | ra
+        batch = []
+        for i, j in zip(lb, rb):
+            la = lhs.dims[i] if i < len(lhs.dims) else _EMPTY
+            ra = rhs.dims[j] if j < len(rhs.dims) else _EMPTY
+            batch.append(la or ra)
+        lfree = [lhs.dims[i] for i in range(len(lhs.dims))
+                 if i not in lc and i not in lb]
+        rfree = [rhs.dims[j] for j in range(len(rhs.dims))
+                 if j not in rc and j not in rb]
+        return [_dedupe_axes(batch + lfree + rfree, frozenset(partial))]
+
+    def _apply_reshape(self, eqn, spec: VSpec) -> VSpec:
+        in_shape = tuple(eqn.invars[0].aval.shape)
+        out_shape = tuple(eqn.outvars[0].aval.shape)
+        if in_shape == out_shape:
+            return spec
+        dims = [_EMPTY] * len(out_shape)
+        i = j = 0
+        while i < len(in_shape) and j < len(out_shape):
+            if in_shape[i] == out_shape[j]:
+                dims[j] = spec.dims[i] if i < len(spec.dims) else _EMPTY
+                i += 1
+                j += 1
+                continue
+            # split or merge group: assign the group's first in-dim axes
+            # to the group's first out-dim (major-dim sharding survives
+            # a merge/split whose major extent is unchanged)
+            ip, jp, isz, osz = i, j, in_shape[i], out_shape[j]
+            while isz != osz and ip + 1 <= len(in_shape) \
+                    and jp + 1 <= len(out_shape):
+                if isz < osz and ip + 1 < len(in_shape):
+                    ip += 1
+                    isz *= in_shape[ip]
+                elif osz < isz and jp + 1 < len(out_shape):
+                    jp += 1
+                    osz *= out_shape[jp]
+                else:
+                    break
+            axes = spec.dims[i] if i < len(spec.dims) else _EMPTY
+            group_n = 1
+            for a in axes:
+                group_n *= self.axis_sizes.get(a, 1)
+            if axes and out_shape[j] % max(group_n, 1) == 0:
+                dims[j] = axes
+            i, j = ip + 1, jp + 1
+        return _dedupe_axes(dims, spec.partial)
+
+    def _apply_pjit(self, eqn, in_specs, path_t, weight) -> List[VSpec]:
+        sub = eqn.params["jaxpr"]
+        in_sh = eqn.params.get("in_shardings") or ()
+        out_sh = eqn.params.get("out_shardings") or ()
+        path = format_path(path_t, eqn)
+        sub_in: List[VSpec] = []
+        for i, (v, spec) in enumerate(zip(eqn.invars, in_specs)):
+            decl = _named_spec(in_sh[i]) if i < len(in_sh) else None
+            if decl is not None:
+                want = _from_pspec(decl, _ndim(v))
+                if spec.dims != want.dims or spec.partial:
+                    nb = aval_bytes(getattr(v, "aval", None)) \
+                        if hasattr(v, "aval") else 0
+                    kinds = self._classify_reshard(
+                        spec, want, nb, path, weight, "pjit in_sharding")
+                    if nb >= self.min_bytes and (
+                            "all_gather" in kinds or "all_to_all" in kinds):
+                        self._find(
+                            Severity.WARNING, "SHARD_RESHARD", path,
+                            f"pjit arg {i} arrives as {spec} but the jit "
+                            f"declares {want} ({fmt_bytes(nb)} resharded "
+                            "at the call boundary)",
+                            "make the caller's layout match in_shardings "
+                            "(or relax the declaration)",
+                            collective=kinds[0], bytes=nb, argnum=i,
+                            src_spec=spec.pspec(), dst_spec=want.pspec())
+                spec = want
+            sub_in.append(spec)
+        sub_out = self.walk(_as_open(sub), sub_in,
+                            path_t + (_eqn_label(eqn), "jaxpr"), weight)
+        outs: List[VSpec] = []
+        for i, ov in enumerate(eqn.outvars):
+            decl = _named_spec(out_sh[i]) if i < len(out_sh) else None
+            got = sub_out[i] if i < len(sub_out) else _repl(_ndim(ov))
+            if decl is not None:
+                want = _from_pspec(decl, _ndim(ov))
+                if got.dims != want.dims or got.partial:
+                    self._classify_reshard(
+                        got, want, aval_bytes(ov.aval), path, weight,
+                        "pjit out_sharding")
+                got = want
+            outs.append(got)
+        return outs
+
+    def _apply_scan(self, eqn, in_specs, path_t, weight) -> List[VSpec]:
+        p = eqn.params
+        nc, nk = p["num_consts"], p["num_carry"]
+        length = int(p.get("length", 1) or 1)
+        body = _as_open(p["jaxpr"])
+        consts = in_specs[:nc]
+        carry = [VSpec(dims=s.dims) for s in in_specs[nc:nc + nk]]
+        xs = [VSpec(dims=s.dims[1:] if s.dims else ())
+              for s in in_specs[nc + nk:]]
+        sub_path = path_t + (_eqn_label(eqn), "body")
+        self._mute += 1
+        try:
+            for _ in range(4):          # carry fixpoint (meet = intersect)
+                outs = self.walk(body, consts + carry + xs, sub_path, weight)
+                nxt = [VSpec(dims=tuple(
+                    a & b for a, b in zip(c.dims, o.dims)))
+                    for c, o in zip(carry, outs[:nk])]
+                if nxt == carry:
+                    break
+                carry = nxt
+        finally:
+            self._mute -= 1
+        outs = self.walk(body, consts + carry + xs, sub_path,
+                         weight * length)
+        carry_out = [VSpec(dims=tuple(a & b for a, b in
+                                      zip(c.dims, o.dims)))
+                     for c, o in zip(carry, outs[:nk])]
+        ys = [VSpec(dims=(_EMPTY,) + o.dims) for o in outs[nk:]]
+        return carry_out + ys
+
+    def _apply_cond(self, eqn, in_specs, path_t, weight) -> List[VSpec]:
+        branches = eqn.params["branches"]
+        ops = in_specs[1:]
+        all_outs = []
+        for i, b in enumerate(branches):
+            sub = _as_open(b)
+            all_outs.append(self.walk(
+                sub, list(ops)[:len(sub.invars)],
+                path_t + (_eqn_label(eqn), f"branch{i}"), weight))
+        outs = []
+        for i, ov in enumerate(eqn.outvars):
+            specs = [o[i] for o in all_outs if i < len(o)]
+            if not specs:
+                outs.append(_repl(_ndim(ov)))
+                continue
+            dims = specs[0].dims
+            for s in specs[1:]:
+                dims = tuple(a & b for a, b in zip(dims, s.dims))
+            outs.append(VSpec(dims=dims))
+        return outs
+
+    def _apply_while(self, eqn, in_specs, path_t, weight) -> List[VSpec]:
+        p = eqn.params
+        cn, bn = p.get("cond_nconsts", 0), p.get("body_nconsts", 0)
+        body = _as_open(p["body_jaxpr"])
+        carry = [VSpec(dims=s.dims) for s in in_specs[cn + bn:]]
+        bconsts = in_specs[cn:cn + bn]
+        sub_path = path_t + (_eqn_label(eqn), "body")
+        self._mute += 1
+        try:
+            for _ in range(4):
+                outs = self.walk(body, bconsts + carry, sub_path, weight)
+                nxt = [VSpec(dims=tuple(a & b for a, b in
+                                        zip(c.dims, o.dims)))
+                       for c, o in zip(carry, outs)]
+                if nxt == carry:
+                    break
+                carry = nxt
+        finally:
+            self._mute -= 1
+        self.walk(_as_open(p["cond_jaxpr"]),
+                  in_specs[:cn] + carry,
+                  path_t + (_eqn_label(eqn), "cond"), weight)
+        outs = self.walk(body, bconsts + carry, sub_path, weight)
+        return [VSpec(dims=tuple(a & b for a, b in zip(c.dims, o.dims)))
+                for c, o in zip(carry, outs)]
+
+    def _apply_generic_container(self, eqn, in_specs, path_t,
+                                 weight) -> List[VSpec]:
+        from jax.extend import core as jex_core
+
+        subs = [(k, v) for k, v in eqn.params.items()
+                if isinstance(v, (jex_core.Jaxpr, jex_core.ClosedJaxpr))]
+        for key, sub in subs:
+            oj = _as_open(sub)
+            if len(oj.invars) == len(eqn.invars) \
+                    and len(oj.outvars) == len(eqn.outvars):
+                return self.walk(oj, in_specs,
+                                 path_t + (_eqn_label(eqn), key), weight)
+        path = format_path(path_t, eqn)
+        for v, s in zip(eqn.invars, in_specs):
+            self._materialize(s, v, path, weight, "opaque container")
+        return [_repl(_ndim(v)) for v in eqn.outvars]
+
+    # -- the walk -----------------------------------------------------------
+
+    def walk(self, jaxpr, in_specs: List[VSpec],
+             path_t: Tuple[str, ...] = (), weight: int = 1) -> List[VSpec]:
+        jaxpr = _as_open(jaxpr)
+        env: Dict[Any, VSpec] = {}
+        for cv in jaxpr.constvars:
+            env[cv] = _repl(_ndim(cv))
+        for iv, s in zip(jaxpr.invars, list(in_specs)
+                         + [None] * max(0, len(jaxpr.invars)
+                                        - len(in_specs))):
+            env[iv] = s if s is not None else _repl(_ndim(iv))
+
+        def read(v):
+            if is_array_var(v):
+                return env.get(v, _repl(_ndim(v)))
+            return _repl(_ndim(v))      # literals are replicated
+
+        candidates: List[Tuple[Any, Any, str]] = []   # (var, eqn, path)
+        big_repl: set = set()
+        for eqn in jaxpr.eqns:
+            specs_in = [read(v) for v in eqn.invars]
+            try:
+                outs = self._apply(eqn, specs_in, path_t, weight)
+            except Exception:  # noqa: BLE001 — a rule miss must not kill lint
+                outs = [_repl(_ndim(v)) for v in eqn.outvars]
+            if len(outs) < len(eqn.outvars):
+                outs = list(outs) + [_repl(_ndim(v))
+                                     for v in eqn.outvars[len(outs):]]
+            inherits = any(v in big_repl for v in eqn.invars
+                           if is_array_var(v))
+            # an explicit replicating constraint is the user's call (and
+            # already SHARD_GAP when it undoes a sharding) — not a
+            # replication CANDIDATE
+            constrained = eqn.primitive.name == "sharding_constraint"
+            for ov, spec in zip(eqn.outvars, outs):
+                if is_array_var(ov):
+                    env[ov] = spec
+                    nb = aval_bytes(ov.aval)
+                    if spec.is_replicated and nb >= self.min_bytes \
+                            and not constrained and not self._mute:
+                        big_repl.add(ov)
+                        if not inherits:
+                            candidates.append(
+                                (ov, eqn, format_path(path_t, eqn)))
+            if not self._mute:
+                row_specs = [str(env[ov]) for ov in eqn.outvars
+                             if is_array_var(ov)]
+                self.eqn_rows.append({
+                    "path": format_path(path_t, eqn),
+                    "primitive": eqn.primitive.name,
+                    "out_specs": row_specs,
+                    "bytes": max((aval_bytes(ov.aval)
+                                  for ov in eqn.outvars
+                                  if is_array_var(ov)), default=0),
+                })
+        # backward sweep: values a later SHARDED constraint (or a cheap
+        # view chain above one) reaches are effectively sharded — GSPMD
+        # propagates constraints backward; don't accuse them
+        btaint: set = set()
+        for eqn in reversed(jaxpr.eqns):
+            prim = eqn.primitive.name
+            if prim == "sharding_constraint":
+                spec = _named_spec(eqn.params.get("sharding"))
+                if spec is not None and any(e is not None for e in spec):
+                    btaint.update(v for v in eqn.invars if is_array_var(v))
+            elif prim in ("convert_element_type", "transpose", "reshape",
+                          "copy", "squeeze", "broadcast_in_dim") and any(
+                    v in btaint for v in eqn.outvars if is_array_var(v)):
+                btaint.update(v for v in eqn.invars if is_array_var(v))
+        for ov, eqn, path in candidates:
+            if ov in btaint:
+                continue
+            pick = suggest_spec(tuple(ov.aval.shape), _EMPTY,
+                                self.axis_sizes)
+            if pick is None:
+                continue                # not provably shardable
+            d, axis = pick
+            pspec = [None] * _ndim(ov)
+            pspec[d] = axis
+            nb = aval_bytes(ov.aval)
+            self._find(
+                Severity.WARNING, "SHARD_REPLICATED", path,
+                f"{fmt_aval(ov.aval)} ({fmt_bytes(nb)}) is fully "
+                f"replicated under the mesh — dim {d} divides evenly "
+                f"over mesh axis {axis!r} ({self.axis_sizes[axis]} ways)",
+                "apply jax.lax.with_sharding_constraint with "
+                f"PartitionSpec{tuple(pspec)!r}",
+                spec=pspec, dim=d, axis=axis, bytes=nb,
+                # shape-qualified site identity: two same-named eqns at
+                # one path (e.g. two broadcast_in_dim in one jaxpr) must
+                # not dedupe-collapse their patches
+                target=f"{path} {fmt_aval(ov.aval)}")
+        # materialize partial outvars of the TOP scope only (inner scopes
+        # hand their partials to the caller)
+        if not path_t:
+            for ov in jaxpr.outvars:
+                if is_array_var(ov) and env.get(ov) is not None:
+                    env[ov] = self._materialize(
+                        env[ov], ov, "<out>", weight, "program output")
+        return [read(v) for v in jaxpr.outvars]
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+
+def _mesh_axis_sizes(mesh) -> Dict[str, int]:
+    try:
+        return {str(a): int(n) for a, n in dict(mesh.shape).items()}
+    except Exception:  # noqa: BLE001
+        return {}
+
+
+def _default_chip(options_opt) -> str:
+    chip = options_opt("spmd_chip")
+    if chip:
+        return str(chip)
+    try:
+        import jax
+
+        d = jax.devices()[0]
+        if getattr(d, "platform", "") == "tpu":
+            return getattr(d, "device_kind", "tpu")
+    except Exception:  # noqa: BLE001
+        pass
+    return comm_cost._DEFAULT_CHIP
+
+
+def propagate(closed_jaxpr, mesh, in_specs: Optional[Sequence] = None,
+              options: Optional[dict] = None,
+              chip: Optional[str] = None) -> SpmdResult:
+    """Run the SPMD abstract interpreter over a ClosedJaxpr under `mesh`.
+
+    `in_specs`: optional per-invar PartitionSpec entry lists (e.g. from
+    `spec_of_value` on the real call args); None entries (and a None
+    list) mean replicated/unknown — pjit `in_shardings` inside the graph
+    still seed those.  Returns the per-eqn spec table, priced
+    collectives, SHARD_* findings, and the comm-vs-compute roofline.
+    """
+    from .core import CheckContext as _CC
+
+    opt_ctx = _CC(closed_jaxpr=closed_jaxpr, options=dict(options or {}))
+    axis_sizes = _mesh_axis_sizes(mesh)
+    chip = chip or _default_chip(opt_ctx.opt)
+    interp = _Interp(axis_sizes, opt_ctx.opt, chip,
+                     int(opt_ctx.opt("sharding_min_bytes")))
+    jaxpr = closed_jaxpr.jaxpr
+    seeds: List[VSpec] = []
+    for i, v in enumerate(jaxpr.invars):
+        entries = None
+        if in_specs is not None and i < len(in_specs):
+            entries = in_specs[i]
+        seeds.append(_from_pspec(entries, _ndim(v)) if entries is not None
+                     else _repl(_ndim(v)))
+    interp.walk(jaxpr, seeds)
+    est = cost_lib.estimate(closed_jaxpr, top_k=0)
+    mesh_size = 1
+    for n in axis_sizes.values():
+        mesh_size *= max(1, n)
+    roof = comm_cost.roofline(est["total_flops"], interp.collectives,
+                              mesh_size, chip=chip)
+    return SpmdResult(
+        eqn_rows=interp.eqn_rows, collectives=interp.collectives,
+        findings=interp.findings, roofline=roof, mesh_axes=axis_sizes,
+        chip=chip)
+
+
+@register_checker("spmd")
+def check_spmd(ctx: CheckContext):
+    """The tier-4 checker: SHARD_RESHARD / mesh-aware SHARD_REPLICATED /
+    SHARD_GAP from the propagation walk, plus ONE COLLECTIVE_BOUND
+    roofline finding (WARNING when the step is comm-bound at this
+    mesh/chip) and an INFO SPMD_SUMMARY carrying the table sizes."""
+    mesh = ctx.mesh
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return []
+    import jax
+
+    in_specs = None
+    if ctx.args or ctx.kwargs:
+        leaves = jax.tree_util.tree_leaves((ctx.args, ctx.kwargs))
+        in_specs = [spec_of_value(x) for x in leaves]
+    declared = ctx.opt("spmd_in_specs")
+    if declared is not None:
+        # explicit seed specs (ShardedTrainState.spmd_report, the rewrite
+        # tier's re-lint gate) fill what the args cannot say: abstract
+        # ShapeDtypeStruct args carry no .sharding
+        declared = list(declared)
+        if in_specs is None:
+            in_specs = declared
+        else:
+            in_specs = [a if a is not None else (declared[i] if
+                                                 i < len(declared) else None)
+                        for i, a in enumerate(in_specs)]
+    if in_specs is not None:
+        n = len(ctx.closed_jaxpr.jaxpr.invars)
+        in_specs = (in_specs + [None] * n)[:n]
+    res = propagate(ctx.closed_jaxpr, mesh, in_specs=in_specs,
+                    options=ctx.options)
+    findings = list(res.findings)
+    roof = res.roofline
+    comm_bound = (roof["bound"] == "comm"
+                  and roof["collective_bytes"]
+                  >= ctx.opt("collective_min_bytes"))
+    top = sorted(res.collectives, key=lambda c: -c.seconds)[:5]
+    findings.append(Finding(
+        Severity.WARNING if comm_bound else Severity.INFO,
+        "COLLECTIVE_BOUND", "<top>",
+        f"static roofline on {res.chip} x{roof['mesh_size']}: compute "
+        f"~{roof['t_compute_s'] * 1e3:.3g} ms vs collectives "
+        f"~{roof['t_comm_s'] * 1e3:.3g} ms "
+        f"({roof['n_collectives']} collective(s), "
+        f"{fmt_bytes(roof['collective_bytes'])} through ICI) — "
+        f"{roof['bound']}-bound",
+        ("grow per-chip batch/model work, or cut the biggest collective "
+         "(see data.collectives)" if comm_bound else ""),
+        data={"roofline": dict(roof),
+              "collectives": [c.to_dict() for c in top],
+              "mesh": dict(res.mesh_axes), "chip": res.chip}))
+    findings.append(Finding(
+        Severity.INFO, "SPMD_SUMMARY", "<top>",
+        f"predicted shardings for {len(res.eqn_rows)} eqn(s) under mesh "
+        f"{dict(res.mesh_axes)}; "
+        f"{sum(1 for f in res.findings if f.code == 'SHARD_RESHARD')} "
+        f"reshard boundary(ies), {len(res.collectives)} implied "
+        "collective(s)",
+        "spmd.propagate(jaxpr, mesh) returns the full per-eqn table",
+        data={"n_eqns": len(res.eqn_rows),
+              "rows": sorted(res.eqn_rows, key=lambda r: -r["bytes"])[:8]}))
+    return findings

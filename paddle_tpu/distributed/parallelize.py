@@ -243,6 +243,39 @@ class ShardedTrainState:
         except Exception:  # noqa: BLE001 — advisory hook, never fatal
             return None
 
+    def spmd_in_specs(self, batch) -> list:
+        """Flat per-invar PartitionSpec entry lists of the jitted step's
+        (params, opt_state, batch) signature — the seed the Graph
+        Doctor's SPMD tier (analysis/spmd.py) propagates from.  Exposed
+        so the analyzer prices THIS state's layout, not a guess."""
+        def entries(s):
+            spec = getattr(s, "spec", s)
+            return list(spec) if spec is not None else None
+
+        leaves = (jax.tree_util.tree_leaves(self.param_shardings)
+                  + jax.tree_util.tree_leaves(self.opt_shardings)
+                  + jax.tree_util.tree_leaves(self._batch_shardings(batch)))
+        return [entries(s) for s in leaves]
+
+    def spmd_report(self, batch, **kw):
+        """Run the Graph Doctor (including the mesh-aware SPMD tier)
+        over this state's jitted step, seeded with the state's own
+        param/opt/batch shardings.  Nothing executes — the step is
+        traced abstractly.  Returns an analysis.Report whose
+        COLLECTIVE_BOUND finding carries the comm-vs-compute roofline
+        and SPMD_SUMMARY the per-eqn predicted shardings."""
+        from .. import analysis
+
+        jitted = self.jitted_step(batch)
+        pshape, oshape = jax.eval_shape(self.init, jax.random.PRNGKey(0))
+        bshape = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x),
+                                           jnp.asarray(x).dtype), batch)
+        options = dict(kw.pop("options", None) or {})
+        options.setdefault("spmd_in_specs", self.spmd_in_specs(batch))
+        return analysis.analyze(jitted, pshape, oshape, bshape,
+                                mesh=self.mesh, options=options, **kw)
+
     def step(self, params, opt_state, batch):
         """Jitted train step; specializes (and caches) per batch pytree
         structure so any batch dict the model's loss_fn accepts works."""

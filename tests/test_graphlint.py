@@ -552,15 +552,33 @@ def test_shipped_model_lints_clean(target):
         f"{target} has undocumented findings:\n" + "\n".join(bad)
 
 
+def _baseline_path():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "GRAPHLINT_BASELINE.json")
+
+
 def test_baseline_gate_tier1(capsys):
     """graphlint --baseline rides the tier-1 entrypoint: a change that
     grows a NEW finding code (or escalates one) on any shipped target
     fails here, alongside the unit tests, without waiting for a bench
-    round.  jaxpr tier only — the HLO tier's compile budget lives in
-    test_graphlint_hlo.py."""
-    baseline = os.path.join(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))), "GRAPHLINT_BASELINE.json")
-    rc = _graphlint.main(["--baseline", baseline, "--no-hlo", "--json"])
+    round.  Mesh-less, so it gates in EVERY session (including
+    PADDLE_HOST_DEVICES=1); the SPMD tier's gate is the multidevice
+    test below.  jaxpr tier only — the HLO tier's compile budget lives
+    in test_graphlint_hlo.py."""
+    rc = _graphlint.main(["--baseline", _baseline_path(), "--no-hlo",
+                          "--json"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, ("new graphlint finding codes vs baseline:\n"
+                     + "\n".join(out["new_vs_baseline"]))
+
+
+@pytest.mark.multidevice(4)
+def test_baseline_gate_tier1_spmd(capsys):
+    """The same gate under the 2x2 mesh so the SPMD tier gates too — a
+    new SHARD_RESHARD (or a reshard-count regression vs the baseline's
+    per-target spmd counters) on a sharded train step fails CI."""
+    rc = _graphlint.main(["--baseline", _baseline_path(), "--no-hlo",
+                          "--json", "--mesh", "data=2,model=2"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0, ("new graphlint finding codes vs baseline:\n"
                      + "\n".join(out["new_vs_baseline"]))

@@ -1,0 +1,523 @@
+"""SPMD tier (Graph Doctor tier 4) tests: mesh-aware sharding
+propagation, the static collective cost model, and the verified
+shard_constraint rewrite pass.
+
+Seeded-bad snippet per new code (SHARD_RESHARD, mesh-aware
+SHARD_REPLICATED with the exact spec, COLLECTIVE_BOUND), propagation
+rules (elementwise/dot/scan/pjit), the comm_cost ring formulas, the
+rewrite pass's inject + gap-elision + corrupted-rollback behaviors, the
+ShardedTrainState exposure, and the graphlint --mesh baseline plumbing.
+
+The whole module opts into the forced multi-device host platform via the
+``multidevice`` marker (see conftest) — single-device sessions skip it.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu  # noqa: F401 — x64 on, same dtype world as the library
+from paddle_tpu import analysis
+from paddle_tpu.analysis import Severity, comm_cost, spmd
+
+pytestmark = pytest.mark.multidevice(4)
+
+OPTS = {"sharding_min_bytes": 1 << 10}
+
+
+def warnings_of(report, code):
+    return [f for f in report.by_code(code)
+            if f.severity >= Severity.WARNING]
+
+
+def _mesh1d(n=2):
+    return Mesh(np.array(jax.devices()[:n]), ("data",))
+
+
+def _sharded(mesh, shape=(8, 64), spec=P("data", None)):
+    return jax.device_put(jnp.ones(shape, jnp.float32),
+                          NamedSharding(mesh, spec))
+
+
+# ---------------------------------------------------------------------------
+# comm_cost: the ring formulas and tables
+# ---------------------------------------------------------------------------
+
+
+class TestCommCost:
+    def test_ring_fractions(self):
+        sizes = {"data": 4}
+        bw = comm_cost.link_bandwidth("v5e")
+        ag = comm_cost.price_collective("all_gather", 1 << 20, ["data"],
+                                        sizes)
+        assert ag.moved_bytes == int((1 << 20) * 3 / 4)
+        ar = comm_cost.price_collective("all_reduce", 1 << 20, ["data"],
+                                        sizes)
+        assert ar.moved_bytes == 2 * ag.moved_bytes
+        a2a = comm_cost.price_collective("all_to_all", 1 << 20, ["data"],
+                                         sizes)
+        assert a2a.moved_bytes == int((1 << 20) * 3 / 16)
+        assert ag.seconds > ag.moved_bytes / bw / 2  # bw term dominates
+
+    def test_multi_axis_uses_product(self):
+        c = comm_cost.price_collective(
+            "all_gather", 1 << 20, ["data", "model"],
+            {"data": 2, "model": 4})
+        assert c.axis_size == 8
+
+    def test_scan_weight_multiplies(self):
+        a = comm_cost.price_collective("all_reduce", 1 << 10, ["data"],
+                                       {"data": 2}, weight=1)
+        b = comm_cost.price_collective("all_reduce", 1 << 10, ["data"],
+                                       {"data": 2}, weight=7)
+        assert b.seconds == pytest.approx(7 * a.seconds)
+        assert b.moved_bytes == 7 * a.moved_bytes
+
+    def test_chip_table_substring_match(self):
+        assert comm_cost.link_bandwidth("TPU v5 lite") == \
+            comm_cost.link_bandwidth("v5e")
+        assert comm_cost.link_bandwidth("TPU v5p") > \
+            comm_cost.link_bandwidth("v5e")
+        # unknown chips price at the documented default, never 0
+        assert comm_cost.link_bandwidth("cpu") > 0
+        assert comm_cost.chip_peak_flops("TPU v4") == 275e12
+
+    def test_roofline_verdict(self):
+        big = [comm_cost.price_collective("all_reduce", 1 << 30, ["data"],
+                                          {"data": 2})]
+        r = comm_cost.roofline(1e6, big, mesh_size=2, chip="v5e")
+        assert r["bound"] == "comm" and r["comm_fraction"] > 0.99
+        r = comm_cost.roofline(1e15, [], mesh_size=2, chip="v5e")
+        assert r["bound"] == "compute" and r["t_comm_s"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# propagation rules (the abstract interpreter, via propagate())
+# ---------------------------------------------------------------------------
+
+
+class TestPropagate:
+    def test_elementwise_and_views_carry_spec(self):
+        mesh = _mesh1d()
+
+        def f(x):
+            return jnp.tanh(x * 2.0).T.reshape(64, 8)
+
+        closed = jax.make_jaxpr(f)(jnp.ones((8, 64), jnp.float32))
+        res = spmd.propagate(closed, mesh, in_specs=[["data", None]],
+                             options=OPTS)
+        # the transpose output must carry the axis on dim 1
+        rows = {r["path"]: r for r in res.eqn_rows}
+        t = next(r for p, r in rows.items() if "transpose" in p)
+        assert "'data'" in t["out_specs"][0]
+
+    def test_dot_contraction_goes_partial_and_prices_psum(self):
+        mesh = _mesh1d()
+
+        def f(a, b):
+            return a @ b                # contract a's dim1 (sharded)
+
+        closed = jax.make_jaxpr(f)(jnp.ones((8, 64), jnp.float32),
+                                   jnp.ones((64, 8), jnp.float32))
+        res = spmd.propagate(closed, mesh,
+                             in_specs=[[None, "data"], ["data", None]],
+                             options=OPTS)
+        kinds = {c.kind for c in res.collectives}
+        assert "all_reduce" in kinds    # the output materializes the psum
+        assert res.roofline["n_collectives"] >= 1
+
+    def test_scan_carry_fixpoint_keeps_sharding(self):
+        mesh = _mesh1d()
+
+        def f(c):
+            def body(carry, _):
+                return carry * 2.0, ()
+            out, _ = jax.lax.scan(body, c, None, length=5)
+            return out
+
+        closed = jax.make_jaxpr(f)(jnp.ones((8, 64), jnp.float32))
+        res = spmd.propagate(closed, mesh, in_specs=[["data", None]],
+                             options=OPTS)
+        scan_row = next(r for r in res.eqn_rows
+                        if r["primitive"] == "scan")
+        assert "'data'" in scan_row["out_specs"][0]
+
+    def test_pjit_in_shardings_seed_the_interior(self):
+        mesh = _mesh1d()
+        sh = NamedSharding(mesh, P("data", None))
+
+        @jax.jit
+        def f(x):
+            return x + 1.0
+
+        jf = jax.jit(f, in_shardings=(sh,))
+        closed = jax.make_jaxpr(jf)(jnp.ones((8, 64), jnp.float32))
+        res = spmd.propagate(closed, mesh, options=OPTS)
+        add_row = next(r for r in res.eqn_rows if "add" in r["path"])
+        assert "'data'" in add_row["out_specs"][0]
+
+
+# ---------------------------------------------------------------------------
+# seeded-bad snippets: one per new finding code
+# ---------------------------------------------------------------------------
+
+
+class TestSeededFindings:
+    def test_reshard_axis_move_flagged_and_priced(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def bad(x):
+            # producer shards dim 0; the constraint moves the axis to
+            # dim 1 -> an all-to-all of the whole array
+            y = jax.lax.with_sharding_constraint(
+                x * 2.0, NamedSharding(mesh, P(None, "data")))
+            return y.sum()
+
+        r = analysis.analyze(bad, _sharded(mesh), mesh=mesh, options=OPTS)
+        hits = warnings_of(r, "SHARD_RESHARD")
+        assert hits, str(r)
+        assert hits[0].data["collective"] == "all_to_all"
+        assert hits[0].data["bytes"] == 8 * 64 * 4
+
+    def test_mesh_aware_replicated_carries_exact_spec(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def bad(x):
+            big = jnp.zeros((64, 64), jnp.float32) + 1.0
+            return x.sum() + (big @ big.T).sum()
+
+        r = analysis.analyze(bad, _sharded(mesh), mesh=mesh, options=OPTS)
+        hits = warnings_of(r, "SHARD_REPLICATED")
+        assert hits
+        f = hits[0]
+        assert f.data["spec"] == ["data", None]
+        assert f.data["axis"] == "data" and f.data["dim"] == 0
+        assert f.data["target"].startswith(f.eqn_path)
+        assert 64 % 2 == 0              # divisibility is the proof
+
+    def test_indivisible_shape_is_not_accused(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def odd(x):
+            big = jnp.zeros((63, 63), jnp.float32) + 1.0  # 2 divides nothing
+            return x.sum() + big.sum()
+
+        r = analysis.analyze(odd, _sharded(mesh), mesh=mesh, options=OPTS)
+        assert not r.by_code("SHARD_REPLICATED")
+
+    def test_gap_is_priced_all_gather(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def gap(x):
+            y = jax.lax.with_sharding_constraint(
+                x * 2.0, NamedSharding(mesh, P(None, None)))
+            return y.sum()
+
+        r = analysis.analyze(gap, _sharded(mesh), mesh=mesh, options=OPTS)
+        hits = warnings_of(r, "SHARD_GAP")
+        assert hits and hits[0].data["collective"] == "all_gather"
+
+    def test_collective_bound_warns_when_comm_dominates(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def commy(x):
+            y = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, P(None, None)))  # big all-gather
+            return y.sum()
+
+        r = analysis.analyze(commy, _sharded(mesh, shape=(256, 1024)),
+                             mesh=mesh, options=OPTS)
+        bound = r.by_code("COLLECTIVE_BOUND")
+        assert bound and bound[0].severity >= Severity.WARNING
+        assert bound[0].data["roofline"]["bound"] == "comm"
+        assert bound[0].data["collectives"]        # priced, named, listed
+
+    def test_collective_bound_info_when_compute_dominates(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def compute_heavy(x):
+            return (x @ x.T @ x).sum()
+
+        r = analysis.analyze(compute_heavy, _sharded(mesh, (256, 256)),
+                             mesh=mesh, options=OPTS)
+        bound = r.by_code("COLLECTIVE_BOUND")
+        assert bound and bound[0].severity == Severity.INFO
+
+    def test_spmd_summary_reports_table(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def f(x):
+            return jnp.tanh(x).sum()
+
+        r = analysis.analyze(f, _sharded(mesh), mesh=mesh, options=OPTS)
+        s = r.by_code("SPMD_SUMMARY")
+        assert s and s[0].data["n_eqns"] >= 2 and s[0].data["rows"]
+
+    def test_inert_without_mesh_and_legacy_optin(self):
+        @jax.jit
+        def f(x):
+            return jnp.zeros((64, 64), jnp.float32).sum() + x.sum()
+
+        r = analysis.analyze(f, jnp.ones((8,)), options=OPTS)
+        assert not r.by_code("SHARD_*") and not r.by_code("COLLECTIVE_*")
+        # legacy taint walk still reachable behind the option
+        mesh = _mesh1d()
+        r = analysis.analyze(
+            f, _sharded(mesh, (8,), P("data")), mesh=mesh,
+            options=dict(OPTS, legacy_sharding_taint=True))
+        hits = warnings_of(r, "SHARD_REPLICATED")
+        assert hits and any(f_.checker == "sharding" for f_ in hits)
+
+
+# ---------------------------------------------------------------------------
+# the shard_constraint rewrite pass (inject / elide / rollback)
+# ---------------------------------------------------------------------------
+
+
+class TestShardConstraintRewrite:
+    def _bad(self, mesh):
+        @jax.jit
+        def bad(x):
+            big = jnp.zeros((64, 64), jnp.float32) + 1.0
+            return x.sum() + (big @ big.T).sum()
+
+        return bad
+
+    def test_injects_exact_spec_and_verifies(self):
+        mesh = _mesh1d()
+        bad = self._bad(mesh)
+        x = _sharded(mesh)
+        fn, rep = analysis.rewrite(bad, x, passes=["shard_constraint"],
+                                   options=OPTS, mesh=mesh)
+        (o,) = rep.outcomes
+        assert o.status == "applied", o.reason
+        assert rep.ok
+        acts = [a for a in o.actions if a.code == "SHARD_REPLICATED"]
+        assert acts and acts[0].data["spec"] == ["data", None]
+        # the injected constraint is in the rewritten jaxpr
+        prims = [e.primitive.name for e, _p, _w in
+                 analysis.iter_eqns(fn.rewritten_jaxpr)]
+        assert "sharding_constraint" in prims
+        assert float(fn(x)) == pytest.approx(float(bad(x)))
+
+    def test_elides_replicating_gap(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def gap(x):
+            y = jax.lax.with_sharding_constraint(
+                x * 2.0, NamedSharding(mesh, P(None, None)))
+            return y.sum()
+
+        x = _sharded(mesh)
+        fn, rep = analysis.rewrite(gap, x, passes=["shard_constraint"],
+                                   options=OPTS, mesh=mesh)
+        (o,) = rep.outcomes
+        assert o.status == "applied", o.reason
+        assert any(a.code == "SHARD_GAP" for a in o.actions)
+        assert float(fn(x)) == pytest.approx(float(gap(x)))
+
+    def test_corrupted_injection_rolls_back(self, monkeypatch):
+        """A shard_constraint pass whose injected 'constraint' perturbs
+        values must be REJECTED by the equivalence gate — the original
+        jaxpr survives."""
+        rewrite_lib = analysis.rewrite_lib
+
+        mesh = _mesh1d()
+        bad = self._bad(mesh)
+        x = _sharded(mesh)
+        monkeypatch.setattr(
+            rewrite_lib.jax.lax, "with_sharding_constraint",
+            lambda v, s: v * 1.25)
+        fn, rep = analysis.rewrite(bad, x, passes=["shard_constraint"],
+                                   options=OPTS, mesh=mesh)
+        (o,) = rep.outcomes
+        assert o.status == "rolled_back"
+        assert not rep.ok
+        assert bool(jnp.allclose(fn(x), bad(x)))
+
+    def test_skips_without_mesh(self):
+        mesh = _mesh1d()
+        bad = self._bad(mesh)
+        _fn, rep = analysis.rewrite(bad, jnp.ones((8, 64), jnp.float32),
+                                    passes=["shard_constraint"],
+                                    options=OPTS)
+        (o,) = rep.outcomes
+        assert o.status in ("skipped", "no-op")
+
+    def test_registered_in_default_pass_order(self):
+        assert "shard_constraint" in analysis.list_rewrites()
+        from paddle_tpu.analysis.rewrite import _DEFAULT_PASSES
+        assert "shard_constraint" in _DEFAULT_PASSES
+        assert _DEFAULT_PASSES.index("shard_constraint") < \
+            _DEFAULT_PASSES.index("donation")
+
+
+# ---------------------------------------------------------------------------
+# fixes: constraint patches carry the exact spec + site target
+# ---------------------------------------------------------------------------
+
+
+class TestFixes:
+    def test_replicated_patch_emits_exact_spec(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def bad(x):
+            big = jnp.zeros((64, 64), jnp.float32) + 1.0
+            return x.sum() + (big @ big.T).sum()
+
+        r = analysis.analyze(bad, _sharded(mesh), mesh=mesh, options=OPTS)
+        patches = analysis.fixes.suggest_fixes(r)
+        shard = [p for p in patches if p.kind == "SHARD_REPLICATED"]
+        assert shard
+        assert "P('data', None)" in shard[0].diff
+        assert shard[0].target           # dedupe-safe site identity
+
+    def test_distinct_sites_do_not_dedupe_collapse(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def two(x):
+            a = jnp.zeros((64, 64), jnp.float32) + 1.0
+            b = jnp.ones((128, 64), jnp.float32) * 3.0
+            return x.sum() + a.sum() + b.sum()
+
+        r = analysis.analyze(two, _sharded(mesh), mesh=mesh, options=OPTS)
+        patches = analysis.fixes.suggest_fixes(r)
+        shard = [p for p in patches if p.kind == "SHARD_REPLICATED"]
+        assert len(shard) == len({p.patch_id for p in shard})
+        assert len(shard) >= 2
+
+    def test_reshard_patch_names_collective(self):
+        mesh = _mesh1d()
+
+        @jax.jit
+        def bad(x):
+            y = jax.lax.with_sharding_constraint(
+                x * 2.0, NamedSharding(mesh, P(None, "data")))
+            return y.sum()
+
+        r = analysis.analyze(bad, _sharded(mesh), mesh=mesh, options=OPTS)
+        patches = analysis.fixes.suggest_fixes(r)
+        resh = [p for p in patches if p.kind == "SHARD_RESHARD"]
+        assert resh and "all_to_all" in resh[0].title
+
+
+# ---------------------------------------------------------------------------
+# ShardedTrainState exposure + graphlint --mesh plumbing
+# ---------------------------------------------------------------------------
+
+
+def _load_graphlint():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "graphlint.py")
+    spec = importlib.util.spec_from_file_location("graphlint_spmd", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestSurfaces:
+    @pytest.mark.multidevice(4)
+    def test_sharded_train_state_spmd_report(self, forced_mesh):
+        from paddle_tpu.models import llama
+        from paddle_tpu.distributed.parallelize import ShardedTrainState
+        from paddle_tpu.optimizer.functional import AdamW
+
+        cfg = llama.LlamaConfig.tiny()
+        st = ShardedTrainState(cfg, llama, forced_mesh,
+                               AdamW(learning_rate=1e-4,
+                                     grad_clip_norm=1.0))
+        toks = np.random.default_rng(0).integers(0, cfg.vocab_size,
+                                                 (4, 9))
+        batch = st.shard_batch(llama.lm_batch_from_tokens(
+            jnp.asarray(toks, jnp.int32)))
+        specs = st.spmd_in_specs(batch)
+        assert any(s and "model" in str(s) for s in specs)
+        rep = st.spmd_report(batch, checkers=["spmd"])
+        assert rep.by_code("SPMD_SUMMARY")
+        bound = rep.by_code("COLLECTIVE_BOUND")
+        assert bound and bound[0].data["roofline"]["n_collectives"] > 0
+        # the shipped sharded step has no reshard boundary
+        assert not rep.by_code("SHARD_RESHARD")
+
+    def test_mesh_spec_parsing_aliases(self):
+        gl = _load_graphlint()
+        assert gl._parse_mesh("dp=2,tp=4") == {"data": 2, "model": 4}
+        assert gl._parse_mesh("data=2,ep=2") == {"data": 2, "expert": 2}
+        with pytest.raises(SystemExit):
+            gl._parse_mesh("bogus=2")
+
+    def test_baseline_diff_catches_reshard_regression(self):
+        gl = _load_graphlint()
+        base = {"schema_version": 3, "targets": {
+            "llama": {"codes": {"COLLECTIVE_BOUND": "warning"},
+                      "spmd": {"reshard_count": 0, "bound": "comm"}}}}
+        cur = {"llama": {"codes": {"COLLECTIVE_BOUND": "warning"},
+                         "spmd": {"reshard_count": 2, "bound": "comm"}}}
+        news = gl._baseline_diff(cur, base)
+        assert any("SHARD_RESHARD count grew" in n for n in news)
+        # a NEW code fails too (the seeded-resharding-bug CI path)
+        cur2 = {"llama": {"codes": {"COLLECTIVE_BOUND": "warning",
+                                    "SHARD_RESHARD": "warning"},
+                          "spmd": {"reshard_count": 0}}}
+        assert any("SHARD_RESHARD" in n
+                   for n in gl._baseline_diff(cur2, base))
+
+    def test_seeded_resharding_bug_fails_baseline_gate(self, capsys,
+                                                       tmp_path):
+        """Acceptance: a seeded resharding bug is caught by
+        SHARD_RESHARD and fails the baseline gate — wire a corrupted
+        'train target' through the real graphlint diff path."""
+        gl = _load_graphlint()
+        mesh = _mesh1d()
+
+        def target_bad():
+            @jax.jit
+            def bad(x):
+                y = jax.lax.with_sharding_constraint(
+                    x * 2.0, NamedSharding(mesh, P(None, "data")))
+                return y.sum()
+
+            return bad, (_sharded(mesh),), {"mesh": mesh,
+                                            "options": dict(OPTS)}
+
+        old = dict(gl.TARGETS)
+        gl.TARGETS.clear()
+        gl.TARGETS["bad"] = target_bad
+        try:
+            baseline = tmp_path / "b.json"
+            baseline.write_text(json.dumps({
+                "schema_version": 3,
+                "targets": {"bad": {
+                    "codes": {"COLLECTIVE_BOUND": "warning",
+                              "SPMD_SUMMARY": "info",
+                              "COST_SUMMARY": "info",
+                              "COST_HOTSPOT": "info",
+                              "MEM_PEAK": "info"},
+                    "spmd": {"reshard_count": 0, "bound": "comm"}}}}))
+            rc = gl.main(["--baseline", str(baseline), "--no-hlo",
+                          "--json"])
+            out = json.loads(
+                capsys.readouterr().out.strip().splitlines()[-1])
+            assert rc == 1
+            assert any("SHARD_RESHARD" in n
+                       for n in out["new_vs_baseline"])
+        finally:
+            gl.TARGETS.clear()
+            gl.TARGETS.update(old)

@@ -67,6 +67,44 @@ os.environ.setdefault("JAX_PLATFORMS", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+# axis sizes for the train targets' mesh, set by --mesh (None = the
+# single-device default).  Accepts fleet-style aliases: dp=data,
+# tp=model, pp=pipe, sp=sep, zero=sharding, ep=expert.
+MESH_SIZES = None
+
+_MESH_ALIASES = {
+    "dp": "data", "tp": "model", "pp": "pipe", "sp": "sep",
+    "zero": "sharding", "ep": "expert",
+    "data": "data", "model": "model", "pipe": "pipe", "sep": "sep",
+    "sharding": "sharding", "expert": "expert",
+}
+
+
+def _parse_mesh(spec: str) -> dict:
+    """"dp=2,tp=4" -> {"data": 2, "model": 4}."""
+    sizes = {}
+    for part in spec.split(","):
+        key, eq, val = part.partition("=")
+        key = key.strip().lower()
+        try:
+            size = int(val)
+        except ValueError:
+            size = -1
+        if not eq or key not in _MESH_ALIASES or size < 1:
+            raise SystemExit(
+                f"graphlint: bad --mesh entry {part!r} (want "
+                f"axis=N, N >= 1, axis in {sorted(set(_MESH_ALIASES))})")
+        sizes[_MESH_ALIASES[key]] = size
+    return sizes
+
+
+def _mesh_devices(sizes: dict) -> int:
+    n = 1
+    for v in sizes.values():
+        n *= max(1, int(v))
+    return n
+
+
 def _train_target(model_name, **cfg_overrides):
     import dataclasses
     import numpy as np
@@ -82,11 +120,18 @@ def _train_target(model_name, **cfg_overrides):
            else moe_llama.MoELlamaConfig.tiny())
     if cfg_overrides:
         cfg = dataclasses.replace(cfg, **cfg_overrides)
-    mesh = mesh_lib.make_mesh(data=1)
+    sizes = dict(MESH_SIZES or {})
+    known = {k: sizes.pop(k) for k in
+             ("data", "pipe", "sharding", "sep", "model")
+             if k in sizes}
+    mesh = mesh_lib.make_mesh(**(known or {"data": 1}),
+                              extra_axes=sizes or None)
+    dpz = mesh.shape.get("data", 1) * mesh.shape.get("sharding", 1)
     st = ShardedTrainState(cfg, model, mesh,
                            AdamW(learning_rate=1e-4, grad_clip_norm=1.0))
     params, opt_state = st.init(jax.random.PRNGKey(0))
-    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 17))
+    toks = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (max(2, 2 * dpz), 17))
     batch = st.shard_batch(model.lm_batch_from_tokens(
         jnp.asarray(toks, jnp.int32)))
     return st.jitted_step(batch), (params, opt_state, batch), {"mesh": mesh}
@@ -208,12 +253,40 @@ def _severity_rank(s: str) -> int:
     return {"info": 1, "warning": 2, "error": 3}.get(s, 0)
 
 
+def _spmd_summary(report) -> "dict | None":
+    """Flatten the SPMD tier's findings (COLLECTIVE_BOUND roofline +
+    SPMD_SUMMARY table + SHARD_RESHARD count) into the per-target JSON
+    block bench.py's extra.spmd and the baseline snapshot consume.
+    None when the tier did not run (no --mesh / single-device mesh)."""
+    bound = next((f for f in report.findings
+                  if f.code == "COLLECTIVE_BOUND"), None)
+    summary = next((f for f in report.findings
+                    if f.code == "SPMD_SUMMARY"), None)
+    if bound is None or summary is None:
+        return None
+    roof = bound.data.get("roofline", {})
+    return {
+        "mesh": bound.data.get("mesh", {}),
+        "chip": bound.data.get("chip", ""),
+        "bound": roof.get("bound", ""),
+        "t_comm_ms": float(roof.get("t_comm_s", 0.0)) * 1e3,
+        "t_compute_ms": float(roof.get("t_compute_s", 0.0)) * 1e3,
+        "n_eqns": int(summary.data.get("n_eqns", 0)),
+        "n_collectives": int(roof.get("n_collectives", 0)),
+        "collective_bytes": int(roof.get("collective_bytes", 0)),
+        "reshard_count": sum(1 for f in report.findings
+                             if f.code == "SHARD_RESHARD"),
+        "collectives": list(bound.data.get("collectives", ())),
+        "rows": list(summary.data.get("rows", ())),
+    }
+
+
 # bump when the snapshot schema changes; readers WARN (not crash) on
 # keys they don't know, so a newer tool's baseline still gates an older
-# checkout and vice versa
-BASELINE_SCHEMA_VERSION = 2
-_KNOWN_BASELINE_KEYS = {"schema_version", "targets"}
-_KNOWN_TARGET_KEYS = {"codes", "rewrite"}
+# checkout and vice versa.  v3: per-target "spmd" counters (--mesh runs)
+BASELINE_SCHEMA_VERSION = 3
+_KNOWN_BASELINE_KEYS = {"schema_version", "targets", "mesh"}
+_KNOWN_TARGET_KEYS = {"codes", "rewrite", "spmd"}
 
 
 def _baseline_snapshot(out: dict) -> dict:
@@ -232,6 +305,11 @@ def _baseline_snapshot(out: dict) -> dict:
             snap[name]["rewrite"] = {
                 "applied": len(rw.get("applied", ())),
                 "rolled_back": len(rw.get("rolled_back", ()))}
+        sp = rep.get("spmd")
+        if sp is not None:
+            snap[name]["spmd"] = {
+                "reshard_count": int(sp.get("reshard_count", 0)),
+                "bound": sp.get("bound", "")}
     return snap
 
 
@@ -267,6 +345,18 @@ def _baseline_diff(current: dict, baseline: dict) -> list:
             elif _severity_rank(sev) > _severity_rank(base[code]):
                 news.append(f"{name}: {code} escalated "
                             f"{base[code]} -> {sev}")
+        # spmd tier: a reshard-count REGRESSION fails even when the code
+        # itself is already baselined (counts matter: each one is a
+        # collective on the hot path)
+        cur_sp = cur.get("spmd") or {}
+        base_sp = baseline.get("targets", baseline).get(name, {}).get(
+            "spmd") or {}
+        if cur_sp and base_sp and int(cur_sp.get("reshard_count", 0)) \
+                > int(base_sp.get("reshard_count", 0)):
+            news.append(
+                f"{name}: SHARD_RESHARD count grew "
+                f"{base_sp.get('reshard_count', 0)} -> "
+                f"{cur_sp.get('reshard_count', 0)}")
     return news
 
 
@@ -293,6 +383,12 @@ def main(argv=None) -> int:
                          "report per-pass eqn/static-cost deltas; a "
                          "rewrite that fails verification rolls back AND "
                          "fails the run")
+    ap.add_argument("--mesh", default=None, metavar="SPEC",
+                    help="run the train targets under a named mesh and "
+                         "enable the SPMD propagation tier, e.g. "
+                         "'dp=2,tp=4' or 'data=2,model=2' (forces the "
+                         "host-platform device count when jax is not "
+                         "yet initialized)")
     ap.add_argument("--no-hlo", action="store_true",
                     help="skip the HLO tier (no lowering/compiling)")
     ap.add_argument("--config", default=None, metavar="RC",
@@ -302,6 +398,26 @@ def main(argv=None) -> int:
     ap.add_argument("--write-baseline", default=None, metavar="B.json",
                     help="store the current findings as the snapshot")
     args = ap.parse_args(argv)
+
+    global MESH_SIZES
+    MESH_SIZES = None
+    if args.mesh:
+        sizes = _parse_mesh(args.mesh)
+        need = _mesh_devices(sizes)
+        if "jax" not in sys.modules:
+            flags = os.environ.get("XLA_FLAGS", "")
+            if "--xla_force_host_platform_device_count" not in flags:
+                os.environ["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{max(need, 8)}").strip()
+        import jax
+        if len(jax.devices()) < need:
+            print(f"graphlint: --mesh {args.mesh} needs {need} devices, "
+                  f"jax sees {len(jax.devices())} (set XLA_FLAGS "
+                  "--xla_force_host_platform_device_count before jax "
+                  "initializes)", file=sys.stderr)
+            return 2
+        MESH_SIZES = sizes
 
     from paddle_tpu import analysis
 
@@ -337,6 +453,9 @@ def main(argv=None) -> int:
                 break
         out[name] = dict(report.to_json(), ok=ok,
                          mem_peak_bytes=mem_peaks.get(name))
+        spmd_sum = _spmd_summary(report)
+        if spmd_sum is not None:
+            out[name]["spmd"] = spmd_sum
         patches = analysis.fixes.suggest_fixes(report) if args.fix else []
         if args.fix:
             out[name]["fixes"] = [p.to_dict() for p in patches]
@@ -346,7 +465,7 @@ def main(argv=None) -> int:
             # are skipped here for CLI budget (tests/test_rewrite.py
             # covers grad equivalence per pass); a rollback = regression
             _newfn, rw = analysis.rewrite(
-                fn, *call_args, report=report,
+                fn, *call_args, report=report, mesh=extra.get("mesh"),
                 options=extra.get("options"), suppress=suppress,
                 config=config, verify_grads=False)
             apply_ok &= rw.ok
@@ -358,6 +477,19 @@ def main(argv=None) -> int:
                   f"({report.counts()}, {report.suppressed} suppressed)")
             for f in shown:
                 print(f"   {f}")
+            if spmd_sum is not None:
+                print(f"-- spmd [{name}]: mesh {spmd_sum['mesh']}, "
+                      f"{spmd_sum['n_eqns']} eqn(s) annotated, "
+                      f"{spmd_sum['reshard_count']} reshard(s), "
+                      f"{spmd_sum['n_collectives']} collective(s), "
+                      f"{spmd_sum['bound']}-bound "
+                      f"(comm ~{spmd_sum['t_comm_ms']:.3g} ms vs compute "
+                      f"~{spmd_sum['t_compute_ms']:.3g} ms on "
+                      f"{spmd_sum['chip']})")
+                if args.verbose:
+                    for row in spmd_sum["rows"]:
+                        print(f"     {row['path']}: "
+                              f"{', '.join(row['out_specs'])}")
             if patches:
                 print(analysis.fixes.format_patches(patches))
             if rw is not None:
@@ -367,9 +499,11 @@ def main(argv=None) -> int:
 
     snap = _baseline_snapshot(out)
     if args.write_baseline:
+        doc = {"schema_version": BASELINE_SCHEMA_VERSION, "targets": snap}
+        if args.mesh:
+            doc["mesh"] = args.mesh
         with open(args.write_baseline, "w") as f:
-            json.dump({"schema_version": BASELINE_SCHEMA_VERSION,
-                       "targets": snap}, f, indent=1, sort_keys=True)
+            json.dump(doc, f, indent=1, sort_keys=True)
         if not args.as_json:
             print(f"graphlint: baseline written to {args.write_baseline}")
     if args.baseline:
