@@ -494,6 +494,29 @@ def _run_spmd(timeout: float = 600.0) -> dict:
         return {"error": repr(e)[:300]}
 
 
+def _run_router(timeout: float = 600.0) -> dict:
+    """extra.router: the fleet tier's micro-bench (tools/chaos_fleet.py
+    --bench, CPU subprocess over scripted engines — no model compute, so
+    the numbers isolate the ROUTER): placement overhead per submit
+    (least-loaded scoring + hop placement) and failover-to-first-token
+    latency under an injected replica death vs the no-death baseline."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "chaos_fleet.py")
+    argv = [sys.executable, script, "--bench", "--json"]
+    try:
+        out = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout,
+                             env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if out.returncode != 0:
+            return {"error": f"rc={out.returncode} "
+                             f"{out.stderr.strip()[-300:]}"}
+        return json.loads(out.stdout.strip().splitlines()[-1])
+    except subprocess.TimeoutExpired:
+        return {"error": f"router bench timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — must not kill the bench
+        return {"error": repr(e)[:300]}
+
+
 def _run_sub(name: str, timeout: "float | None" = None) -> dict:
     """Run `python bench.py --sub {name}` and parse its one-line JSON."""
     if timeout is None:
@@ -611,6 +634,7 @@ def main():
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
     spmd_extra = _run_spmd()
+    router_extra = _run_router()
 
     print(json.dumps({
         "metric": "llama_train_tokens_per_sec_per_chip",
@@ -663,6 +687,11 @@ def main():
             # for the sharded llama step — the static substrate the
             # pod-scale partitioner work is measured against
             "spmd": spmd_extra,
+            # fleet tier (tools/chaos_fleet.py --bench): placement
+            # overhead per submit + failover-to-first-token under an
+            # injected replica death (scripted engines — router-only
+            # numbers, no model compute in the measurement)
+            "router": router_extra,
         },
     }))
 

@@ -24,16 +24,27 @@ import numpy as np
 
 __all__ = ["Config", "Predictor", "create_predictor", "InferTensor",
            "serve", "PlaceType", "LLMEngine", "serve_llm", "QueueFull",
-           "RequestCancelled", "DeadlineExceeded", "faults"]
+           "RequestCancelled", "DeadlineExceeded", "EngineStopped",
+           "Router", "FleetHandle", "serve_fleet", "FleetQueueFull",
+           "NoHealthyReplica", "ReplicaDied", "RetriesExhausted",
+           "RouterStopped", "EngineSupervisor", "faults"]
 
 
 def __getattr__(name):
-    # lazy: the LLM engine pulls in the model stack, which plain
-    # Config/Predictor users never touch
+    # lazy: the LLM engine / fleet tier pull in the model stack, which
+    # plain Config/Predictor users never touch
     if name in ("LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
-                "DeadlineExceeded"):
+                "DeadlineExceeded", "EngineStopped"):
         from . import llm_engine
         return getattr(llm_engine, name)
+    if name in ("Router", "FleetHandle", "serve_fleet", "FleetQueueFull",
+                "NoHealthyReplica", "ReplicaDied", "RetriesExhausted",
+                "RouterStopped"):
+        from . import router
+        return getattr(router, name)
+    if name == "EngineSupervisor":
+        from . import supervisor
+        return supervisor.EngineSupervisor
     if name == "faults":
         import importlib
         return importlib.import_module(".faults", __name__)

@@ -25,15 +25,41 @@ from __future__ import annotations
 
 import collections
 import random
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-__all__ = ["FAULT_POINTS", "InjectedFault", "InvariantViolation",
-           "FaultRule", "FaultInjector", "random_schedule", "drive",
-           "check_invariants", "run_schedule"]
+import numpy as np
 
-# the engine's named injection points, in rough lifecycle order
-FAULT_POINTS = ("prefill", "decode", "page_alloc", "sample",
+from . import llm_engine as _llm
+
+__all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
+           "InjectedCrash", "InvariantViolation", "FaultRule",
+           "FaultInjector", "random_schedule", "drive", "check_invariants",
+           "run_schedule", "ScriptedEngine", "fleet_random_schedule",
+           "drive_fleet", "fleet_check_invariants", "fleet_run_schedule"]
+
+# the engine's named injection points, in rough lifecycle order ("step"
+# wraps the whole step loop: a crash=True rule there kills the step
+# THREAD, not just one request — replica death)
+FAULT_POINTS = ("step", "prefill", "decode", "page_alloc", "sample",
                 "swap_out", "swap_in")
+
+# the Router's named injection points — fleet-tier failure shapes.
+#   replica_death:    fired per replica on each health tick; a match makes
+#                     the router CRASH that replica at its next step (the
+#                     engine strands slots/handles exactly as a real dead
+#                     step thread would)
+#   health_flap:      fired inside each health probe; a match makes the
+#                     probe report unhealthy — a healthy replica gets
+#                     ejected and must earn reinstatement via canary
+#   stats_staleness:  fired inside each placement-score read; a match
+#                     makes the replica's gauges unreadable — the router
+#                     must deprioritize, not crash or eject
+#   slow_replica:     use with delay=...: the score read stalls (slow
+#                     stats RPC); the router keeps serving, placement
+#                     just pays the latency
+FLEET_FAULT_POINTS = ("replica_death", "slow_replica", "health_flap",
+                      "stats_staleness")
 
 # points where a `consume_pools` rule is meaningful: the engine passes its
 # (to-be-donated or read) pools in the fire() context there
@@ -46,6 +72,15 @@ class InjectedFault(RuntimeError):
     pool-exhausted condition."""
 
 
+class InjectedCrash(BaseException):
+    """Raised by a crash=True rule.  A BaseException ON PURPOSE: it
+    escapes every `except Exception` backstop in the engine — the step
+    thread dies mid-step with slots held and handles unresolved, which is
+    the replica-death shape the fleet tier (Router + EngineSupervisor)
+    exists to survive.  Single-engine schedules should not use it; there
+    is nothing above the engine to recover."""
+
+
 class InvariantViolation(AssertionError):
     """check_invariants found a leak or an unresolved/double-resolved
     handle."""
@@ -53,24 +88,36 @@ class InvariantViolation(AssertionError):
 
 class FaultRule:
     """One deterministic fault: fire at the `nth` matching visit of
-    `point` (1-based, counted per rule after the slot filter), or on
-    EVERY matching visit (`always=True`, e.g. "OOM every allocation for
-    slot 2").  `consume_pools=True` deletes the pool buffers before
+    `point` (1-based, counted per rule after the slot/replica filters),
+    or on EVERY matching visit (`always=True`, e.g. "OOM every allocation
+    for slot 2").  `consume_pools=True` deletes the pool buffers before
     raising — simulating a TPU dispatch that fails AFTER consuming its
     donated arguments, which is the nastiest real-world failure the
-    engine must recover from."""
+    engine must recover from.
+
+    Fleet extensions: `replica=` filters on the router-provided replica
+    id (fleet points) the way `slot=` filters engine points;
+    `crash=True` raises InjectedCrash (BaseException — kills the step
+    thread, replica death) instead of InjectedFault; `delay=` seconds
+    makes the rule SLEEP at the point instead of raising (a slow
+    replica, not a broken one)."""
 
     def __init__(self, point: str, nth: int = 1,
                  slot: Optional[int] = None, always: bool = False,
-                 consume_pools: bool = False):
-        if point not in FAULT_POINTS:
-            raise ValueError(f"unknown fault point {point!r}; "
-                             f"one of {FAULT_POINTS}")
+                 consume_pools: bool = False,
+                 replica: Optional[int] = None, crash: bool = False,
+                 delay: Optional[float] = None):
+        if point not in FAULT_POINTS and point not in FLEET_FAULT_POINTS:
+            raise ValueError(f"unknown fault point {point!r}; one of "
+                             f"{FAULT_POINTS + FLEET_FAULT_POINTS}")
         self.point = point
         self.nth = int(nth)
         self.slot = slot
         self.always = bool(always)
         self.consume_pools = bool(consume_pools)
+        self.replica = replica
+        self.crash = bool(crash)
+        self.delay = None if delay is None else float(delay)
         self.seen = 0     # matching visits
         self.fired = 0
 
@@ -78,6 +125,8 @@ class FaultRule:
         if point != self.point:
             return False
         if self.slot is not None and ctx.get("slot") != self.slot:
+            return False
+        if self.replica is not None and ctx.get("replica") != self.replica:
             return False
         self.seen += 1
         if self.always:
@@ -92,13 +141,21 @@ class FaultRule:
             bits.append(f"nth={self.nth}")
         if self.slot is not None:
             bits.append(f"slot={self.slot}")
+        if self.replica is not None:
+            bits.append(f"replica={self.replica}")
         if self.consume_pools:
             bits.append("consume_pools")
+        if self.crash:
+            bits.append("crash")
+        if self.delay is not None:
+            bits.append(f"delay={self.delay}")
         return f"FaultRule({', '.join(bits)})"
 
     def to_dict(self) -> dict:
         return {"point": self.point, "nth": self.nth, "slot": self.slot,
-                "always": self.always, "consume_pools": self.consume_pools}
+                "always": self.always, "consume_pools": self.consume_pools,
+                "replica": self.replica, "crash": self.crash,
+                "delay": self.delay}
 
 
 class FaultInjector:
@@ -121,13 +178,23 @@ class FaultInjector:
             self.fired.append({"point": point,
                                "visit": self.visits[point],
                                "rule": repr(rule),
-                               "slot": ctx.get("slot")})
+                               "slot": ctx.get("slot"),
+                               "replica": ctx.get("replica")})
+            if rule.delay is not None:
+                # slow, not broken: stall the caller and keep scanning —
+                # a delay rule composes with a raise rule at the same point
+                time.sleep(rule.delay)
+                continue
             if rule.consume_pools and pools is not None:
                 for arr in pools.values():
                     try:
                         arr.delete()   # simulate donation consuming it
                     except Exception:  # noqa: BLE001 — already deleted etc.
                         pass
+            if rule.crash:
+                raise InjectedCrash(
+                    f"injected CRASH at {point!r} "
+                    f"(visit {self.visits[point]}, {rule!r})")
             raise InjectedFault(
                 f"injected fault at {point!r} "
                 f"(visit {self.visits[point]}, {rule!r})")
@@ -322,6 +389,341 @@ def run_schedule(make_engine: Callable[[], object],
         "rejected": rejected,
         "completed": sum(1 for h in handles if h.error is None),
         "failed": sum(1 for h in handles if h.error is not None),
+        "steps": steps,
+    })
+    return report
+
+
+# -- scripted engine: the real scheduler at chaos-suite speed --------------
+
+class _ScriptedConfig:
+    """Minimal model config for a ScriptedEngine: just enough for the
+    paged-cache bookkeeping (1 layer, 1 KV head, head_dim 2 — a few KB of
+    pool, but real jax buffers so consume_pools rules and pool-recovery
+    behave exactly as on the full model)."""
+
+    num_hidden_layers = 1
+    num_key_value_heads = 1
+    hd = 2
+    dtype = np.float32
+    max_position_embeddings = 128
+
+    def __init__(self, vocab_size: int = 97):
+        self.vocab_size = int(vocab_size)
+
+
+def _script_next(seq: Sequence[int], vocab: int) -> int:
+    """The scripted model: next token = FNV-ish hash of the recent
+    history + position.  A pure function of (prompt, tokens so far), so
+    preemption resume (swap OR recompute), cross-replica retry, and the
+    single-engine reference all reproduce the identical chain."""
+    h = 2166136261
+    for t in list(seq)[-6:]:
+        h = ((h ^ (int(t) + 1)) * 16777619) % (1 << 32)
+    return (h + 7 * len(seq)) % vocab
+
+
+class ScriptedEngine(_llm.LLMEngine):
+    """The REAL LLMEngine scheduler with the model compute swapped for a
+    deterministic numpy script — no weights, no jit, no device dispatch.
+
+    Everything the fleet tier exercises is the genuine article: admission,
+    bucketing, page allocation, preemption (swap and recompute), deadlines,
+    cancellation, shutdown, the metrics registry, and every fault point.
+    Only the five compute callables (_prefill/_decode/_swap_out/_swap_in/
+    _sample) are replaced, which makes a step pure python — fast enough
+    that tier-1 can afford whole-fleet chaos schedules.
+
+    `reference_tokens()` is the token-exactness oracle: what a single
+    healthy engine produces for a prompt, hence what the fleet must
+    produce no matter which replicas died along the way."""
+
+    DEFAULT_VOCAB = 97
+
+    def __init__(self, num_slots: int = 2, page_size: int = 4,
+                 max_seq_len: int = 16, vocab: int = DEFAULT_VOCAB, **kw):
+        cfg = _ScriptedConfig(vocab)
+        super().__init__(None, cfg, num_slots=num_slots,
+                         page_size=page_size, max_seq_len=max_seq_len,
+                         **kw)
+        V = cfg.vocab_size
+
+        def fake_prefill(params, ids, k_pool, v_pool, pt_row, true_len):
+            n = int(true_len)
+            seq = [int(t) for t in np.asarray(ids)[0, :n]]
+            logits = np.zeros((1, V), np.float32)
+            logits[0, _script_next(seq, V)] = 1.0
+            return logits, k_pool, v_pool
+
+        def fake_decode(params, toks, ctx, page_table, k_pool, v_pool):
+            logits = np.zeros((self.cache.max_slots, V), np.float32)
+            for slot, st in self._slots.items():
+                seq = [int(t) for t in st.req.prompt] + list(st.req.tokens)
+                logits[slot, _script_next(seq, V)] = 1.0
+            return logits, {"k": k_pool, "v": v_pool}
+
+        self._prefill = fake_prefill
+        self._decode = fake_decode
+        self._swap_out = lambda k, v, idx: (np.zeros((1,), np.float32),
+                                            np.zeros((1,), np.float32))
+        self._swap_in = lambda k, v, idx, hk, hv: (k, v)
+        self._sample = lambda logits: np.argmax(np.asarray(logits), axis=-1)
+
+    @staticmethod
+    def reference_tokens(prompt: Sequence[int], max_new_tokens: int,
+                         eos_id: Optional[int] = None,
+                         vocab: int = DEFAULT_VOCAB) -> List[int]:
+        """What ONE healthy scripted engine generates for this request —
+        the fleet chaos suite's token-exactness reference."""
+        seq = [int(t) for t in prompt]
+        out: List[int] = []
+        for _ in range(int(max_new_tokens)):
+            t = _script_next(seq, vocab)
+            out.append(t)
+            seq.append(t)
+            if eos_id is not None and t == eos_id:
+                break
+        return out
+
+
+# -- fleet tier: schedules, driving, invariants ----------------------------
+
+def fleet_random_schedule(seed: int, n_replicas: int = 2,
+                          max_rules: int = 3):
+    """Deterministic pseudo-random FLEET schedule: per-replica engine
+    rules (including crash=True replica deaths at step/prefill/decode)
+    plus router-level rules (health flaps, stale stats, slow score
+    reads).  Returns (engine_rules: {replica_id: [FaultRule]},
+    router_rules: [FaultRule])."""
+    rng = random.Random(seed ^ 0x5EED)
+    engine_rules: Dict[int, List[FaultRule]] = \
+        {i: [] for i in range(n_replicas)}
+    router_rules: List[FaultRule] = []
+    for _ in range(rng.randint(1, max_rules)):
+        roll = rng.random()
+        rid = rng.randrange(n_replicas)
+        if roll < 0.35:
+            # replica death mid-step / mid-prefill / mid-decode
+            point = rng.choice(("step", "prefill", "decode"))
+            engine_rules[rid].append(
+                FaultRule(point, nth=rng.randint(1, 6), crash=True))
+        elif roll < 0.55:
+            # plain single-replica faults (the PR-4 shapes) inside a fleet
+            engine_rules[rid].extend(
+                random_schedule(rng.randrange(1 << 30)))
+        elif roll < 0.70:
+            router_rules.append(FaultRule(
+                "health_flap", replica=rid, nth=rng.randint(1, 4)))
+        elif roll < 0.85:
+            router_rules.append(FaultRule(
+                "stats_staleness", replica=rid, nth=rng.randint(1, 5),
+                always=rng.random() < 0.3))
+        else:
+            router_rules.append(FaultRule(
+                "slow_replica", replica=rid, nth=rng.randint(1, 4),
+                delay=0.01))
+    return engine_rules, router_rules
+
+
+def drive_fleet(router, handles: Sequence = (), max_steps: int = 20000,
+                timeout: float = 120.0, settle: bool = True) -> int:
+    """Drive a fleet until every fleet handle resolves (bounded), then —
+    faults disabled — let the fleet SETTLE: outstanding canaries finish,
+    flapped replicas reinstate, parked retries drain, every live engine
+    quiesces.  Manual mode pumps the router; threaded mode waits.
+    Returns pump steps taken (0 in threaded mode)."""
+    steps = 0
+    if getattr(router, "threaded", False):
+        deadline = time.monotonic() + timeout
+        for h in handles:
+            h._event.wait(max(0.01, deadline - time.monotonic()))
+    else:
+        while any(not h.done() for h in handles) and steps < max_steps:
+            router.pump()
+            steps += 1
+    if settle:
+        saved, router.faults = router.faults, None
+        try:
+            deadline = time.monotonic() + min(timeout, 30.0)
+            while time.monotonic() < deadline:
+                if not getattr(router, "threaded", False):
+                    router.pump()
+                if router.quiesced():
+                    break
+                time.sleep(0.002)
+        finally:
+            router.faults = saved
+    return steps
+
+
+def fleet_check_invariants(router, handles: Sequence = (), reference=None,
+                           probe: bool = True,
+                           raise_on_violation: bool = True,
+                           probe_timeout: float = 120.0) -> dict:
+    """Assert the FLEET leaked nothing.  Call once quiesced (see
+    `drive_fleet`).  Checks:
+
+      * every submitted fleet handle resolved EXACTLY once fleet-wide —
+        retries must never double-resolve, death must never strand;
+      * token-exactness: every successfully resolved handle (including
+        the retried ones, `len(h.hops) > 1`) matches `reference(h)` —
+        what a single healthy engine would have produced;
+      * per-replica zero leaks: `check_invariants` (pages/slots/pools/
+        counter identity) on every live replica's engine;
+      * fleet counter identity: accepted == completed + cancelled +
+        timed_out + failed;
+      * the fleet still serves: a fresh 1-token request through the
+        ROUTER completes (faults disabled for the probe).
+
+    `reference` is a callable handle -> expected token list (e.g. built
+    on ScriptedEngine.reference_tokens).  Returns a report dict; raises
+    InvariantViolation on any breach unless raise_on_violation=False."""
+    violations: List[str] = []
+
+    for i, h in enumerate(handles):
+        if not h.done():
+            violations.append(f"fleet handle {i} never resolved "
+                              f"(hops={h.hops})")
+        elif h.resolutions != 1:
+            violations.append(f"fleet handle {i} resolved {h.resolutions} "
+                              f"times (want 1; hops={h.hops})")
+        elif h.error is None and not h.tokens:
+            violations.append(f"fleet handle {i} resolved empty without "
+                              "error")
+    if reference is not None:
+        for i, h in enumerate(handles):
+            if h.done() and h.error is None and h.resolutions == 1:
+                want = list(reference(h))
+                if list(h.tokens) != want:
+                    violations.append(
+                        f"fleet handle {i} tokens diverge from the "
+                        f"single-engine reference (hops={h.hops}): "
+                        f"got {list(h.tokens)} want {want}")
+
+    for r in router.replicas:
+        if r.dead:
+            continue
+        rep = check_invariants(r.engine, probe=False,
+                               raise_on_violation=False)
+        if not rep["ok"]:
+            violations.append(f"replica {r.rid}: "
+                              f"{'; '.join(rep['violations'])}")
+
+    snap = router.stats_snapshot()
+    outcomes = (snap["completed"] + snap["cancelled"] + snap["timed_out"]
+                + snap["failed"])
+    if snap["accepted"] != outcomes:
+        violations.append(
+            f"fleet counter identity broken: accepted={snap['accepted']} "
+            f"!= completed+cancelled+timed_out+failed={outcomes} (a "
+            "request leaked out of, or was double-counted into, the "
+            "fleet terminal counters)")
+
+    probe_tokens = None
+    if probe and not violations:
+        # disable the ROUTER injector and every live replica's ENGINE
+        # injector: the probe proves the fleet serves once the fault
+        # storm stops, exactly like the single-engine checker
+        saved_router, router.faults = router.faults, None
+        saved_engines = [(r.engine, r.engine.faults)
+                         for r in router.replicas if not r.dead]
+        for eng, _ in saved_engines:
+            eng.faults = None
+        try:
+            h = router.submit([1], max_new_tokens=1)
+            if getattr(router, "threaded", False):
+                probe_tokens = h.result(timeout=probe_timeout)
+            else:
+                drive_fleet(router, [h], settle=False)
+                probe_tokens = h.result(timeout=0)
+            if len(probe_tokens) != 1:
+                violations.append(
+                    f"fleet probe returned {probe_tokens!r}, want 1 token")
+        except Exception as e:  # noqa: BLE001
+            violations.append(
+                f"fleet cannot serve a fresh request: {e!r}")
+        finally:
+            router.faults = saved_router
+            for eng, inj in saved_engines:
+                eng.faults = inj
+
+    report = {
+        "ok": not violations,
+        "violations": violations,
+        "probe_tokens": probe_tokens,
+        "stats": snap,
+        "replicas": {r.rid: {"state": r.state, "dead": r.dead,
+                             "rebuilds": r.rebuilds}
+                     for r in router.replicas},
+    }
+    if violations and raise_on_violation:
+        raise InvariantViolation("; ".join(violations))
+    return report
+
+
+def fleet_run_schedule(make_engine: Callable[[], object],
+                       engine_rules: Dict[int, Sequence[FaultRule]],
+                       router_rules: Sequence[FaultRule],
+                       requests: Sequence[Tuple[Sequence[int], int]],
+                       n_replicas: int = 2, max_hops: int = 3,
+                       probe: bool = True, threaded: bool = False,
+                       reference=None, max_steps: int = 20000,
+                       router_kw: Optional[dict] = None) -> dict:
+    """Build a fresh N-replica fleet (Router + EngineSupervisor over
+    `make_engine`), install the per-replica and router-level schedules,
+    submit the workload, drive to quiescence, and run the fleet
+    invariant checker.  Rebuilt replicas come from the same factory,
+    fault-free.  Returns the invariant report extended with schedule,
+    fired faults, retry/death counts.  Raises InvariantViolation on any
+    breach.  The router is shut down before returning."""
+    from .router import (Router, FleetQueueFull, NoHealthyReplica,
+                         RouterStopped)
+    from .supervisor import EngineSupervisor
+
+    engines = []
+    injectors = []
+    for i in range(n_replicas):
+        eng = make_engine()
+        inj = FaultInjector(list(engine_rules.get(i, ())))
+        eng.faults = inj
+        injectors.append(inj)
+        engines.append(eng)
+    router_injector = FaultInjector(list(router_rules))
+    kw = dict(max_hops=max_hops, backoff_base=0.01, backoff_max=0.25,
+              health_interval=0.005)
+    kw.update(router_kw or {})
+    router = Router(engines, supervisor=EngineSupervisor(make_engine),
+                    faults=router_injector, threaded=threaded, **kw)
+    handles, rejected = [], 0
+    try:
+        for prompt, max_new in requests:
+            try:
+                handles.append(router.submit(prompt, max_new))
+            except (FleetQueueFull, NoHealthyReplica, RouterStopped,
+                    ValueError):
+                rejected += 1   # resolved by refusal, never accepted
+            if not threaded:
+                router.pump()   # interleave placement with progress
+        steps = drive_fleet(router, handles, max_steps=max_steps)
+        report = fleet_check_invariants(router, handles,
+                                        reference=reference, probe=probe)
+    finally:
+        router.shutdown(timeout=10.0)
+    fired = list(router_injector.fired)
+    for i, inj in enumerate(injectors):
+        fired.extend({**f, "replica": i} for f in inj.fired)
+    report.update({
+        "schedule": {
+            "engines": {i: [r.to_dict() for r in engine_rules.get(i, ())]
+                        for i in range(n_replicas)},
+            "router": [r.to_dict() for r in router_rules],
+        },
+        "fired": fired,
+        "requests": len(handles),
+        "rejected": rejected,
+        "completed": sum(1 for h in handles if h.error is None),
+        "failed": sum(1 for h in handles if h.error is not None),
+        "retried": sum(1 for h in handles if len(h.hops) > 1),
         "steps": steps,
     })
     return report

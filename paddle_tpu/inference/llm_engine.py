@@ -76,7 +76,15 @@ from ..obs import metrics as obs_metrics
 from ..obs import trace as obs_trace
 
 __all__ = ["LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
-           "DeadlineExceeded"]
+           "DeadlineExceeded", "EngineStopped"]
+
+
+class EngineStopped(RuntimeError):
+    """submit() refused: the engine is shut down OR its step thread died.
+    Typed and immediate — enqueueing into a dead loop would hand back a
+    handle no thread will ever resolve, so result() would hang forever.
+    The fleet Router treats this as replica death (eject + place
+    elsewhere); serve_fleet maps it to HTTP 503."""
 
 
 class QueueFull(RuntimeError):
@@ -139,6 +147,10 @@ class _Request:
         self._resume: Optional[_ResumeState] = None
         self._engine: Optional["LLMEngine"] = None
         self._event = threading.Event()
+        # fired once, on the FIRST resolution (routers hook completion
+        # here instead of polling done()); exceptions are swallowed — a
+        # broken observer must not wedge the step thread
+        self._callbacks: List = []
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until the request finishes; returns the generated tokens
@@ -180,6 +192,11 @@ class _Request:
             return
         self.error = error
         self._event.set()
+        for cb in list(self._callbacks):
+            try:
+                cb(self)
+            except Exception:  # noqa: BLE001 — observer bug stays local
+                pass
 
 
 class _SlotState:
@@ -195,10 +212,11 @@ class _StatsDict(collections.abc.MutableMapping):
     """The engine's legacy counter dict, backed by registry Counters.
 
     Call sites keep writing `stats["completed"] += 1`; each key is ONE
-    `llm_<key>_total` Counter in the metrics registry, so /stats JSON
-    and /metrics Prometheus text read identical storage and cannot
+    `<prefix>_<key>_total` Counter in the metrics registry, so /stats
+    JSON and /metrics Prometheus text read identical storage and cannot
     drift.  (Keys already ending in `_total` keep their name:
-    "steps_total" -> `llm_steps_total`.)"""
+    "steps_total" -> `llm_steps_total`.)  The Router reuses this with
+    prefix="fleet" for its own counters."""
 
     _HELP = {
         "accepted": "requests accepted by submit() (queued or better)",
@@ -216,15 +234,19 @@ class _StatsDict(collections.abc.MutableMapping):
     }
 
     def __init__(self, registry: obs_metrics.Registry,
-                 keys: Sequence[str]):
+                 keys: Sequence[str], prefix: str = "llm",
+                 help: Optional[dict] = None):
         self._registry = registry
+        self._prefix = prefix
+        self._help = dict(self._HELP) if help is None else dict(help)
         self._counters = {}
         for k in keys:
             self._counters[k] = self._make(k)
 
     def _make(self, key: str) -> obs_metrics.Counter:
-        name = f"llm_{key}" if key.endswith("_total") else f"llm_{key}_total"
-        return self._registry.counter(name, self._HELP.get(key, ""))
+        name = (f"{self._prefix}_{key}" if key.endswith("_total")
+                else f"{self._prefix}_{key}_total")
+        return self._registry.counter(name, self._help.get(key, ""))
 
     def __getitem__(self, key: str) -> int:
         return int(self._counters[key].value)
@@ -233,6 +255,16 @@ class _StatsDict(collections.abc.MutableMapping):
         if key not in self._counters:
             self._counters[key] = self._make(key)
         self._counters[key].set(value)
+
+    def inc(self, key: str, n: int = 1) -> None:
+        """Atomic increment (Counter.inc holds the metric's lock).
+        `stats[k] += 1` is a separate read then absolute write — fine
+        under the engine's _cv, but the Router bumps counters from HTTP
+        handler, engine step, and health-tick threads concurrently,
+        where the read-modify-write loses counts."""
+        if key not in self._counters:
+            self._counters[key] = self._make(key)
+        self._counters[key].inc(n)
 
     def __delitem__(self, key: str) -> None:
         raise TypeError("engine stats counters cannot be removed")
@@ -514,7 +546,15 @@ class LLMEngine:
                 f"the pool only holds {self.cache.num_pages - 1}")
         with self._cv:
             if self._stop:
-                raise RuntimeError("engine is stopped")
+                raise EngineStopped("engine is stopped")
+            t = self._thread
+            if t is not None and not t.is_alive():
+                # the step thread CRASHED (it exits cleanly only via
+                # _stop, handled above): enqueueing would hand back a
+                # handle nothing will ever resolve
+                raise EngineStopped(
+                    "engine step thread died; the engine is stopped "
+                    "until a supervisor rebuilds it")
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
                 raise QueueFull(
@@ -584,6 +624,17 @@ class LLMEngine:
     def has_work(self) -> bool:
         return bool(self._pending or self._slots)
 
+    def alive(self) -> bool:
+        """Step-thread liveness, the signal the fleet Router's health
+        probes and the EngineSupervisor read: False once shut down OR
+        once a started step thread died (crash/stranded state).  An
+        engine that was never start()ed counts as alive — it is driven
+        by explicit step() calls."""
+        if self._stop:
+            return False
+        t = self._thread
+        return t is None or t.is_alive()
+
     def step(self) -> bool:
         """One engine iteration: reap cancelled/expired requests, admit
         pending requests into free slots (resuming preempted ones first —
@@ -591,6 +642,12 @@ class LLMEngine:
         token (preempting victims when page allocation fails), evict
         finished sequences.  Returns True when any work was done."""
         self.stats["steps_total"] += 1
+        # named fault point for the step loop itself: an InjectedFault
+        # here is caught by _loop's backstop (fails in-flight, keeps
+        # serving); an InjectedCrash (BaseException) escapes it and KILLS
+        # the step thread with handles stranded and slots held — the
+        # replica-death shape the fleet tier must survive
+        self._fire("step")
         with self.tracer.span("engine_step"):
             reaped = self._reap()
             admitted = self._admit()
@@ -617,7 +674,7 @@ class LLMEngine:
             if self._thread.is_alive():
                 self._thread.join(timeout=timeout)
             if self._thread.is_alive():
-                err = RuntimeError("engine shut down (step thread wedged)")
+                err = EngineStopped("engine shut down (step thread wedged)")
                 with self._cv:
                     for req in list(self._pending):
                         self.stats["failed"] += 1
@@ -632,8 +689,10 @@ class LLMEngine:
         # thread is gone (or never ran): fail anything still queued or in
         # flight so waiters unblock, and reclaim the slots.  Under _cv: a
         # client thread's cancel() also removes/resolves pending requests,
-        # and racing it here would double-resolve a handle.
-        err = RuntimeError("engine shut down")
+        # and racing it here would double-resolve a handle.  EngineStopped
+        # (a RuntimeError) so the fleet Router classifies these as replica
+        # death and retries the zero-token ones elsewhere.
+        err = EngineStopped("engine shut down")
         with self._cv:
             for req in list(self._pending):
                 # terminal-counter identity (accepted == sum of outcomes)
@@ -660,6 +719,14 @@ class LLMEngine:
                 # handles its own dispatch faults; anything escaping is an
                 # engine bug — fail in-flight work so waiters unblock
                 self._fail_inflight(e)
+            except BaseException:  # noqa: BLE001 — InjectedCrash (chaos)
+                # or interpreter teardown: the step thread dies RIGHT HERE
+                # with slots held and handles unresolved.  No cleanup by
+                # design — this is replica death, the shape the fleet
+                # supervisor must prove it recovers from (shutdown() on
+                # the dead engine resolves the strands; the Router
+                # re-places what is safely recoverable).
+                return
 
     def _recover_pools(self, cause: BaseException) -> bool:
         """If a failed donated dispatch consumed the k/v pools, re-zero

@@ -1,0 +1,998 @@
+"""Fleet-tier serving: a Router fronting N LLMEngine replicas.
+
+One LLMEngine is preemption-safe but mortal: a dead step thread takes
+every queued request with it.  The reference framework's answer is its
+fleet layer (paddle/fluid/distributed, ~56k LoC of brpc services); the
+TPU-native answer here is one Router object and three rules:
+
+  placement   — least-loaded: replicas are scored from their own obs
+                metrics GAUGES (`llm_queue_depth` + `llm_slots_in_flight`,
+                free pages as the tiebreak) — the same numbers
+                `GET /metrics` exposes, read from the registry, never
+                re-derived (the PR 6 signal plane is the source of truth).
+  health      — every replica is probed on a tick (step-thread liveness +
+                supervisor pool checks); a failing probe EJECTS the
+                replica from placement.  Reinstatement must be EARNED:
+                after an exponential backoff the router sends a canary
+                request through the replica and only a completed canary
+                returns it to rotation (a flapping replica pays a doubled
+                backoff per failed canary).
+  retry       — when a replica dies, its stranded requests resolve with
+                `EngineStopped`; the Router re-places a request iff NO
+                tokens were resolved (a partially-decoded request is not
+                safely retryable — it fails with a typed `ReplicaDied`,
+                never silently, never twice).  Each hop carries the
+                REMAINING deadline, and the retry budget (`max_hops`) is
+                decremented across hops; exhaustion is a typed
+                `RetriesExhausted`.  A retry that finds no capacity is
+                PARKED and re-placed by the health tick — accepted work
+                is never dropped on the floor.
+
+Backpressure composes upward: every healthy replica refusing with
+`QueueFull` makes `submit()` raise `FleetQueueFull` carrying the MINIMUM
+Retry-After among replicas (`serve_fleet` maps it to HTTP 503); zero
+healthy replicas raise `NoHealthyReplica`.  `drain()` stops placement,
+finishes in-flight work, and only then lets `shutdown()` stop the
+engines.
+
+Replica death is handled, not hidden: the health tick detects the dead
+step thread, `shutdown()` on the dead engine resolves every stranded
+handle, a sweep catches requests stranded mid-admission (crashed between
+queue and slot), and the `EngineSupervisor` rebuilds the replica from
+its factory and re-registers it under the same id — it then re-enters
+rotation through the same canary gate as any ejected replica.
+
+Chaos surface: the router fires the fleet fault points
+(`replica_death`, `health_flap`, `stats_staleness`, `slow_replica` —
+see inference/faults.py) at its health probes and score reads;
+`faults.fleet_check_invariants` proves no request is lost or
+double-resolved, retried outputs are token-exact against a single
+healthy engine, and every live replica leaks zero pages/slots.
+`tools/chaos_fleet.py` is the soak CLI; `tests/test_router_chaos.py`
+ships the deterministic schedules.
+
+Threading modes: `threaded=True` (serving) starts every engine's step
+thread plus a router health-tick thread; `threaded=False` (deterministic
+chaos schedules) runs nothing in the background — `pump()` executes one
+health tick and one step of every live replica.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import List, Optional, Sequence
+
+from . import faults as _faults
+from .llm_engine import (DeadlineExceeded, EngineStopped, LLMEngine,
+                         QueueFull, RequestCancelled, _StatsDict)
+from .supervisor import EngineSupervisor
+from ..obs import metrics as obs_metrics
+
+__all__ = ["Router", "Replica", "FleetHandle", "serve_fleet",
+           "FleetQueueFull", "NoHealthyReplica", "ReplicaDied",
+           "RetriesExhausted", "RouterStopped",
+           "HEALTHY", "EJECTED", "CANARY"]
+
+HEALTHY = "healthy"     # in placement rotation
+EJECTED = "ejected"     # out of rotation, waiting out its backoff
+CANARY = "canary"       # earning reinstatement via a probe request
+
+
+class FleetQueueFull(QueueFull):
+    """Every healthy replica refused with QueueFull: fleet-wide
+    backpressure.  retry_after is the MINIMUM across replicas — the
+    soonest any queue could drain.  serve_fleet maps this to HTTP 503
+    with a Retry-After header."""
+
+
+class NoHealthyReplica(RuntimeError):
+    """Zero replicas in rotation (all ejected/dead).  Transient when a
+    supervisor is rebuilding; serve_fleet maps it to HTTP 503."""
+
+    def __init__(self, message: str, retry_after: float = 1.0):
+        super().__init__(message)
+        self.retry_after = float(retry_after)
+
+
+class ReplicaDied(RuntimeError):
+    """Terminal: the serving replica died AFTER tokens were resolved, so
+    the request is not safely retryable (a blind retry could hand the
+    client a different chain than the tokens it may already have seen).
+    Typed and explicit — never a silent loss."""
+
+
+class RetriesExhausted(RuntimeError):
+    """Terminal: the request survived zero-token replica deaths but the
+    cross-hop retry budget ran out."""
+
+
+class RouterStopped(RuntimeError):
+    """submit() refused: the router is draining or shut down."""
+
+
+class FleetHandle:
+    """One fleet-level request: the client-facing handle whose lifetime
+    may span several engine-level hops.  Resolved EXACTLY once fleet-wide
+    (resolutions counts every attempt so fleet_check_invariants can prove
+    it); `hops` lists the replica ids tried in order."""
+
+    def __init__(self, router: "Router", prompt: Sequence[int],
+                 max_new_tokens: int, eos_id: Optional[int],
+                 deadline: Optional[float], max_hops: int):
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        # absolute, fixed at FLEET submission: every hop re-derives its
+        # remaining budget from this, so retries never get fresh time
+        self._deadline = (None if deadline is None
+                          else time.monotonic() + float(deadline))
+        self.hops_left = int(max_hops)
+        self.hops: List[int] = []
+        self.tokens: List[int] = []
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+        self.resolutions = 0
+        self._router = router
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+        self._hop = None            # current engine-level _Request
+        self._handled = None        # last hop whose resolution we consumed
+        self._is_parked = False
+
+    def remaining_deadline(self) -> Optional[float]:
+        if self._deadline is None:
+            return None
+        return self._deadline - time.monotonic()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the request finishes fleet-wide; returns the
+        generated tokens.  Raises the typed terminal error otherwise."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("fleet generation did not finish in time")
+        if self.error is not None:
+            raise self.error
+        return list(self.tokens)
+
+    def cancel(self) -> None:
+        """Cancel wherever the request currently is: a parked retry
+        resolves at the next tick; a placed hop is cancelled in its
+        engine (the resolution flows back through the router).  No-op
+        once done."""
+        with self._lock:
+            if self._event.is_set():
+                return
+            self.cancelled = True
+            hop, parked = self._hop, self._is_parked
+        if not parked and hop is not None:
+            hop.cancel()
+        # parked (or pre-attach): the next tick's parked sweep resolves it
+
+    def _resolve(self, error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            self.resolutions += 1
+            if self._event.is_set():
+                return
+            self.error = error
+            self._event.set()
+
+
+class Replica:
+    """One engine slot in the fleet: identity (stable across rebuilds),
+    health state machine, and the set of fleet handles currently placed
+    on it (the router's death-sweep source)."""
+
+    def __init__(self, rid: int, engine: LLMEngine):
+        self.rid = int(rid)
+        self.engine = engine
+        self.state = HEALTHY
+        self.dead = False          # torn down, awaiting rebuild/permanent
+        self.crashed = False       # manual-mode: step() raised InjectedCrash
+        self.backoff = 0.0
+        self.ejected_until = 0.0
+        self.canary = None         # in-flight canary _Request
+        self.canary_t0 = 0.0
+        self.inflight: set = set()
+        self.rebuilds = 0
+        self.deaths = 0
+
+    def thread_dead(self) -> bool:
+        """A started step thread that is no longer alive and was NOT
+        cleanly stopped — the crashed-replica signature."""
+        e = self.engine
+        t = e._thread
+        return t is not None and not t.is_alive() and not e._stop
+
+
+class Router:
+    """Least-loaded router over N LLMEngine replicas.  See the module
+    docstring for the placement/health/retry rules.
+
+    engines: the replicas (or pass factory=/num_replicas= to build them).
+    supervisor: EngineSupervisor used to rebuild dead replicas (defaults
+    to one over `factory` when given; None = dead replicas stay dead).
+    faults: optional FaultInjector fired at the fleet fault points.
+    max_hops: cross-replica retry budget per request.
+    threaded: True starts engine step threads + a health-tick thread;
+    False is the deterministic chaos mode driven by pump().
+    """
+
+    _STATS_KEYS = (
+        "accepted", "rejected", "placed", "retries", "parked", "completed",
+        "failed", "cancelled", "timed_out", "ejections", "reinstatements",
+        "canaries", "deaths", "rebuilds")
+    _STATS_HELP = {
+        "accepted": "fleet requests accepted (a FleetHandle exists)",
+        "rejected": "fleet submits refused (backpressure / no replica)",
+        "placed": "engine-level placements (hops), incl. retries",
+        "retries": "zero-token requests re-placed after replica death",
+        "parked": "retries parked for lack of capacity (placed later)",
+        "completed": "fleet requests resolved with tokens",
+        "failed": "fleet requests resolved with a terminal error",
+        "cancelled": "fleet requests resolved by cancellation",
+        "timed_out": "fleet requests resolved by deadline expiry",
+        "ejections": "replicas removed from placement by health probes",
+        "reinstatements": "replicas returned to rotation by a canary",
+        "canaries": "canary probe requests sent to ejected replicas",
+        "deaths": "replica deaths detected (dead step thread / crash)",
+        "rebuilds": "replicas rebuilt from the supervisor's factory",
+    }
+
+    def __init__(self, engines: Optional[Sequence[LLMEngine]] = None, *,
+                 factory=None, num_replicas: Optional[int] = None,
+                 supervisor: Optional[EngineSupervisor] = None,
+                 faults=None, max_hops: int = 3,
+                 health_interval: float = 0.05,
+                 backoff_base: float = 0.1, backoff_max: float = 5.0,
+                 canary_timeout: float = 30.0,
+                 engine_shutdown_timeout: float = 10.0,
+                 threaded: bool = True,
+                 metrics: Optional[obs_metrics.Registry] = None):
+        if engines is None:
+            if factory is None:
+                raise ValueError("pass engines= or factory=")
+            engines = [factory() for _ in range(num_replicas or 2)]
+        engines = list(engines)
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        if supervisor is None and factory is not None:
+            supervisor = EngineSupervisor(factory)
+        self.supervisor = supervisor
+        self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        self.faults = faults
+        self.max_hops = int(max_hops)
+        self.health_interval = float(health_interval)
+        self.backoff_base = float(backoff_base)
+        self.backoff_max = float(backoff_max)
+        self.canary_timeout = float(canary_timeout)
+        self.engine_shutdown_timeout = float(engine_shutdown_timeout)
+        self.threaded = bool(threaded)
+        self._lock = threading.RLock()
+        self._parked: collections.deque = collections.deque()
+        self._stopping = False
+        self._stop_health = False
+        self._health_thread: Optional[threading.Thread] = None
+        self.metrics = metrics if metrics is not None \
+            else obs_metrics.Registry()
+        self.stats = _StatsDict(self.metrics, self._STATS_KEYS,
+                                prefix="fleet", help=self._STATS_HELP)
+        reg = self.metrics
+        self._h_placement = reg.histogram(
+            "fleet_placement_seconds",
+            "submit() -> engine placement (score + hop submit)",
+            buckets=(1e-5, 5e-5, 1e-4, 5e-4, 1e-3, 5e-3, 0.01, 0.05, 0.1,
+                     0.5, 1.0))
+        reg.gauge("fleet_replicas", "replicas registered").set_function(
+            lambda: len(self.replicas))
+        reg.gauge("fleet_replicas_healthy", "replicas in placement rotation"
+                  ).set_function(lambda: sum(
+                      1 for r in self.replicas
+                      if r.state == HEALTHY and not r.dead))
+        reg.gauge("fleet_parked_now", "retries currently awaiting capacity"
+                  ).set_function(lambda: len(self._parked))
+        if self.threaded:
+            for r in self.replicas:
+                r.engine.start()
+            self._health_thread = threading.Thread(
+                target=self._health_loop, daemon=True)
+            self._health_thread.start()
+
+    # -- client surface -----------------------------------------------------
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               eos_id: Optional[int] = None,
+               deadline: Optional[float] = None,
+               max_hops: Optional[int] = None) -> FleetHandle:
+        """Place a request on the least-loaded healthy replica.  Raises
+        FleetQueueFull when EVERY healthy replica refuses (min
+        Retry-After attached), NoHealthyReplica when rotation is empty,
+        RouterStopped while draining, ValueError for requests no replica
+        could ever serve."""
+        if self._stopping:
+            raise RouterStopped("router is draining/stopped")
+        fh = FleetHandle(self, prompt, max_new_tokens, eos_id, deadline,
+                         self.max_hops if max_hops is None else max_hops)
+        t0 = time.monotonic()
+        try:
+            placed, retry_after, saw_queue_full = self._try_place(
+                fh, count_accepted=True)
+        except ValueError:
+            self.stats.inc("rejected")   # malformed for EVERY replica
+            raise
+        self._h_placement.observe(time.monotonic() - t0)
+        if placed:
+            return fh
+        self.stats.inc("rejected")
+        if saw_queue_full:
+            raise FleetQueueFull(
+                "every healthy replica is at queue capacity",
+                retry_after=retry_after if retry_after else 1.0)
+        raise NoHealthyReplica(
+            "no healthy replica available (all ejected, dead, or dying)")
+
+    def generate(self, prompts: Sequence[Sequence[int]],
+                 max_new_tokens: int, eos_id: Optional[int] = None,
+                 timeout: Optional[float] = None) -> List[List[int]]:
+        """Synchronous convenience mirroring LLMEngine.generate."""
+        handles = [self.submit(p, max_new_tokens, eos_id) for p in prompts]
+        if not self.threaded:
+            _faults.drive_fleet(self, handles, settle=False)
+            timeout = 0
+        return [h.result(timeout=timeout) for h in handles]
+
+    def stats_snapshot(self) -> dict:
+        with self._lock:
+            snap = dict(self.stats)
+            snap["replicas"] = len(self.replicas)
+            snap["healthy_replicas"] = sum(
+                1 for r in self.replicas
+                if r.state == HEALTHY and not r.dead)
+            snap["parked_now"] = len(self._parked)
+            snap["replica_states"] = {
+                r.rid: ("dead" if r.dead else r.state)
+                for r in self.replicas}
+        return snap
+
+    # -- placement ----------------------------------------------------------
+
+    def _fire(self, point: str, **ctx) -> None:
+        if self.faults is None:
+            return
+        try:
+            self.faults.fire(point, router=self, **ctx)
+        except _faults.InjectedCrash as e:
+            # crash=True on a ROUTER-level point: there is no step thread
+            # to kill here, and InjectedCrash is a BaseException that
+            # would sail past the health loop's backstop and silently
+            # kill the tick thread — degrade it to the typed fault every
+            # fire site already handles.
+            raise _faults.InjectedFault(str(e)) from e
+
+    def _score(self, r: Replica):
+        """Least-loaded placement score, SMALLER is better: (queue depth
+        + in-flight slots, -free pages), read from the replica's metrics
+        GAUGES — the same storage its /metrics endpoint renders.  A
+        replica whose stats are unreadable/stale (fault-injected or a
+        dying engine rendering NaN) scores worst-but-placeable: stale
+        telemetry must degrade placement, not crash it."""
+        stale = (math.inf, 0.0)
+        try:
+            # a slow_replica delay rule stalls HERE — the price of a slow
+            # stats read lands on placement latency, nothing breaks
+            self._fire("slow_replica", replica=r.rid)
+            self._fire("stats_staleness", replica=r.rid)
+        except _faults.InjectedFault:
+            return stale
+        try:
+            reg = r.engine.metrics
+            q = reg.get("llm_queue_depth").value
+            infl = reg.get("llm_slots_in_flight").value
+            free_p = reg.get("llm_free_pages").value
+        except Exception:  # noqa: BLE001 — unreadable registry == stale
+            return stale
+        if any(math.isnan(v) for v in (q, infl, free_p)):
+            return stale
+        return (q + infl, -free_p)
+
+    def _candidates(self) -> List[Replica]:
+        with self._lock:
+            cands = [r for r in self.replicas
+                     if r.state == HEALTHY and not r.dead]
+        return sorted(cands, key=self._score)
+
+    def _try_place(self, fh: FleetHandle, count_accepted: bool = False):
+        """Try each healthy replica best-score-first.  Returns (placed,
+        min_retry_after_or_None, saw_queue_full) — saw_queue_full
+        distinguishes genuine backpressure from a mass-death window
+        where every candidate died between probe and submit.  Engine
+        submits happen OUTSIDE the router lock: an engine callback
+        thread may hold an engine lock while waiting for the router
+        lock, so the reverse nesting is forbidden.  count_accepted=True
+        (first placement only, never retries) bumps `accepted` AFTER the
+        engine took the hop but BEFORE _attach can run an instantly-
+        resolving hop's callbacks: a terminal counter never lands ahead
+        of accepted, and a refused submit never needs a walk-back (the
+        counter stays monotonic for Prometheus rate())."""
+        retry_after = None
+        value_error = None
+        for r in self._candidates():
+            try:
+                hop = r.engine.submit(
+                    fh.prompt, fh.max_new_tokens, fh.eos_id,
+                    deadline=fh.remaining_deadline())
+            except QueueFull as e:
+                retry_after = (e.retry_after if retry_after is None
+                               else min(retry_after, e.retry_after))
+                continue
+            except EngineStopped:
+                # died between probe and submit; the tick will handle it
+                continue
+            except ValueError as e:
+                value_error = e     # malformed for this (hence any) replica
+                break
+            if count_accepted:
+                self.stats.inc("accepted")
+            self._attach(fh, r, hop)
+            return True, None, False
+        if value_error is not None:
+            raise value_error
+        return False, retry_after, retry_after is not None
+
+    def _attach(self, fh: FleetHandle, r: Replica, hop) -> None:
+        with fh._lock:
+            fh._hop = hop
+            fh.hops.append(r.rid)
+        with self._lock:
+            r.inflight.add(fh)
+            self.stats.inc("placed")
+        hop._callbacks.append(
+            lambda req, fh=fh, r=r: self._hop_resolved(fh, r, req))
+        if fh.cancelled:
+            hop.cancel()
+        if hop.done():
+            # resolved before the callback was registered: deliver
+            # manually (idempotent via the _handled guard)
+            self._hop_resolved(fh, r, hop)
+
+    # -- hop resolution / retry ---------------------------------------------
+
+    def _hop_resolved(self, fh: FleetHandle, r: Replica, req) -> None:
+        """Runs on the resolving thread (engine step thread, canceller,
+        or a dead engine's shutdown) — may be invoked more than once for
+        one hop (late callback registration); the _handled guard makes
+        it exactly-once per hop."""
+        with fh._lock:
+            if req is not fh._hop or req is fh._handled:
+                return
+            fh._handled = req
+        with self._lock:
+            r.inflight.discard(fh)
+        err = req.error
+        if err is None:
+            fh.tokens = list(req.tokens)
+            fh._resolve()
+            self.stats.inc("completed")
+        elif isinstance(err, RequestCancelled):
+            fh._resolve(err)
+            self.stats.inc("cancelled")
+        elif isinstance(err, DeadlineExceeded):
+            fh._resolve(err)
+            self.stats.inc("timed_out")
+        elif isinstance(err, EngineStopped):
+            self._retry_or_fail(fh, r, req)
+        else:
+            # an engine-level request fault (dispatch error, injected
+            # fault, pool loss) on a LIVE replica: passes through typed —
+            # the replica itself already recovered
+            fh._resolve(err)
+            self.stats.inc("failed")
+
+    def _retry_or_fail(self, fh: FleetHandle, r: Replica, req) -> None:
+        """Replica death resolution.  The retry-safety rules, in order:
+        tokens resolved -> terminal ReplicaDied; cancelled -> cancelled;
+        deadline gone -> DeadlineExceeded (the 504, exactly once); budget
+        gone or fleet stopping -> terminal; else decrement the budget and
+        re-place with the REMAINING deadline (parking if no capacity)."""
+        if req.tokens:
+            fh._resolve(ReplicaDied(
+                f"replica {r.rid} died after {len(req.tokens)} token(s) "
+                "were resolved; not safely retryable"))
+            self.stats.inc("failed")
+            return
+        if fh.cancelled:
+            fh._resolve(RequestCancelled("request cancelled"))
+            self.stats.inc("cancelled")
+            return
+        rem = fh.remaining_deadline()
+        if rem is not None and rem <= 0:
+            fh._resolve(DeadlineExceeded(
+                f"deadline expired during replica-death retry "
+                f"(hops={fh.hops})"))
+            self.stats.inc("timed_out")
+            return
+        if self._stopping:
+            fh._resolve(EngineStopped("fleet shut down"))
+            self.stats.inc("failed")
+            return
+        if fh.hops_left <= 0:
+            fh._resolve(RetriesExhausted(
+                f"replica died and the retry budget is exhausted "
+                f"(hops={fh.hops})"))
+            self.stats.inc("failed")
+            return
+        fh.hops_left -= 1
+        self.stats.inc("retries")
+        try:
+            placed, _, _ = self._try_place(fh)
+        except ValueError as e:
+            # heterogeneous fleet: no CURRENT candidate can hold the
+            # request (e.g. the one large-context replica just died) —
+            # terminal and typed, never a silently stranded handle
+            fh._resolve(e)
+            self.stats.inc("failed")
+            return
+        if not placed:
+            self._park(fh)
+
+    def _park(self, fh: FleetHandle) -> None:
+        with self._lock:
+            fh._is_parked = True
+            self._parked.append(fh)
+            self.stats.inc("parked")
+
+    def _drain_parked(self) -> None:
+        with self._lock:
+            if not self._parked:
+                return
+            batch = list(self._parked)
+            self._parked.clear()
+            for fh in batch:
+                fh._is_parked = False
+        for fh in batch:
+            if fh.done():
+                continue
+            if fh.cancelled:
+                fh._resolve(RequestCancelled("request cancelled"))
+                self.stats.inc("cancelled")
+                continue
+            rem = fh.remaining_deadline()
+            if rem is not None and rem <= 0:
+                fh._resolve(DeadlineExceeded(
+                    f"deadline expired while parked for retry "
+                    f"(hops={fh.hops})"))
+                self.stats.inc("timed_out")
+                continue
+            try:
+                placed, _, _ = self._try_place(fh)
+            except ValueError as e:
+                fh._resolve(e)          # no candidate can ever hold it
+                self.stats.inc("failed")
+                continue
+            if not placed:
+                with self._lock:        # re-park silently (no recount)
+                    fh._is_parked = True
+                    self._parked.append(fh)
+
+    # -- health: probes, ejection, canary, death ----------------------------
+
+    def tick(self) -> None:
+        """One health pass: death detection + probe/eject/canary state
+        machine per replica, then the parked-retry sweep.  The threaded
+        health loop calls this every `health_interval`; manual mode gets
+        it via pump()."""
+        now = time.monotonic()
+        for r in list(self.replicas):
+            self._maybe_inject_death(r)
+            self._tick_replica(r, now)
+        self._drain_parked()
+
+    def _maybe_inject_death(self, r: Replica) -> None:
+        try:
+            self._fire("replica_death", replica=r.rid)
+        except _faults.InjectedFault:
+            self.kill(r)
+
+    def kill(self, r: Replica) -> None:
+        """Arrange for replica `r` to CRASH at its next engine step (the
+        replica_death fault point's effect; also a test hook).  The step
+        thread dies exactly as a real mid-step crash would — slots held,
+        handles stranded — and the normal death path recovers."""
+        eng = r.engine
+        if eng.faults is None:
+            eng.faults = _faults.FaultInjector([])
+        eng.faults.rules.append(
+            _faults.FaultRule("step", nth=1, crash=True))
+        with eng._cv:
+            eng._cv.notify_all()    # wake an idle threaded loop
+
+    def _probe(self, r: Replica) -> bool:
+        try:
+            self._fire("health_flap", replica=r.rid)
+        except _faults.InjectedFault:
+            return False            # probe *reports* unhealthy — a flap
+        if not r.engine.alive():
+            return False
+        if self.supervisor is not None \
+                and self.supervisor._pools_deleted(r.engine):
+            # transient donation windows are invisible here in practice
+            # (the probe runs between steps); the supervisor's sticky
+            # double-read runs before any rebuild decision
+            return self.supervisor.check(r.engine) == "ok"
+        return True
+
+    def _detect_dead(self, r: Replica) -> bool:
+        return r.crashed or r.thread_dead()
+
+    def _tick_replica(self, r: Replica, now: float) -> None:
+        if r.dead:
+            return
+        if self._detect_dead(r):
+            self._handle_death(r)
+            return
+        if r.state == HEALTHY:
+            if not self._probe(r):
+                self._eject(r, now, double=False)
+        elif r.state == EJECTED:
+            if now >= r.ejected_until:
+                self._launch_canary(r, now)
+        elif r.state == CANARY:
+            hop = r.canary
+            if hop is None:
+                r.state = EJECTED
+            elif hop.done():
+                r.canary = None
+                if hop.error is None and hop.tokens:
+                    self._reinstate(r)
+                else:
+                    self._eject(r, now, double=True)
+            elif now - r.canary_t0 > self.canary_timeout:
+                hop.cancel()
+                r.canary = None
+                self._eject(r, now, double=True)
+
+    def _eject(self, r: Replica, now: float, double: bool) -> None:
+        with self._lock:
+            r.backoff = (min(max(r.backoff, self.backoff_base) * 2,
+                             self.backoff_max)
+                         if double else self.backoff_base)
+            r.ejected_until = now + r.backoff
+            r.state = EJECTED
+            self.stats.inc("ejections")
+
+    def _launch_canary(self, r: Replica, now: float) -> None:
+        """Reinstatement is earned: a 1-token probe must COMPLETE through
+        the ejected replica before it re-enters rotation."""
+        try:
+            hop = r.engine.submit([1], max_new_tokens=1)
+        except Exception:  # noqa: BLE001 — refused/stopped: deeper backoff
+            self._eject(r, now, double=True)
+            return
+        with self._lock:
+            r.canary = hop
+            r.canary_t0 = now
+            r.state = CANARY
+            self.stats.inc("canaries")
+
+    def _reinstate(self, r: Replica) -> None:
+        with self._lock:
+            r.state = HEALTHY
+            r.backoff = 0.0
+            self.stats.inc("reinstatements")
+
+    def _handle_death(self, r: Replica) -> None:
+        """The full replica-death path: eject + mark dead, tear the
+        engine down (resolving every handle it knows about), sweep the
+        hops stranded mid-admission, then rebuild through the supervisor
+        and re-register under the same replica id (re-entering rotation
+        via the canary gate)."""
+        with self._lock:
+            if r.dead:
+                return
+            r.dead = True
+            r.deaths += 1
+            r.state = EJECTED
+            r.canary = None
+            self.stats.inc("deaths")
+            self.stats.inc("ejections")
+            inflight = list(r.inflight)
+            r.inflight.clear()
+        # engine teardown OUTSIDE the router lock (resolutions run router
+        # callbacks which need it)
+        try:
+            r.engine.shutdown(timeout=self.engine_shutdown_timeout)
+        except Exception:  # noqa: BLE001 — wedged-thread shutdown already
+            pass           # failed the queued handles; proceed to rebuild
+        # sweep: a crash mid-admission strands a request in NEITHER
+        # _pending NOR _slots — engine shutdown cannot see it.  The
+        # router can: every fleet handle placed on this replica whose hop
+        # never resolved is force-resolved as replica death (the retry
+        # rules then requeue or fail it, never lose it).
+        for fh in inflight:
+            hop = fh._hop
+            if hop is not None and not hop.done():
+                hop._resolve(EngineStopped(
+                    f"replica {r.rid} died mid-request"))
+        if self.supervisor is None or self._stopping:
+            return
+        new = self.supervisor.rebuild(r.engine, start=self.threaded,
+                                      teardown=False)
+        if new is None:
+            return                  # rebuild budget exhausted: stays dead
+        now = time.monotonic()
+        with self._lock:
+            r.engine = new
+            r.dead = False
+            r.crashed = False
+            r.rebuilds += 1
+            r.state = EJECTED       # earns rotation via the canary gate
+            r.backoff = self.backoff_base
+            r.ejected_until = now + self.backoff_base
+            self.stats.inc("rebuilds")
+
+    # -- driving ------------------------------------------------------------
+
+    def _health_loop(self) -> None:
+        while not self._stop_health:
+            try:
+                self.tick()
+            except _faults.InjectedCrash:
+                pass           # BaseException — see _fire; never fatal here
+            except Exception:  # noqa: BLE001 — the health loop must
+                pass           # survive anything a probe throws
+            time.sleep(self.health_interval)
+
+    def pump(self) -> None:
+        """Manual-mode fleet iteration (threaded=False): one health tick,
+        then one step() of every live replica (mirroring each engine's
+        _loop semantics: an escaping Exception fails that replica's
+        in-flight work; an InjectedCrash IS replica death)."""
+        self.tick()
+        for r in list(self.replicas):
+            if r.dead:
+                continue
+            eng = r.engine
+            if eng._thread is not None:
+                continue            # threaded engine pumps itself
+            try:
+                if eng.has_work():
+                    eng.step()
+            except _faults.InjectedCrash:
+                r.crashed = True    # handled by the next tick
+            except Exception as e:  # noqa: BLE001 — _loop-equivalent
+                eng._fail_inflight(e)
+
+    def quiesced(self) -> bool:
+        """True when the fleet has no outstanding work anywhere: nothing
+        parked, no canary in flight, every live replica HEALTHY with an
+        idle engine, no unhandled death.  drive_fleet settles on this."""
+        with self._lock:
+            if self._parked:
+                return False
+            for r in self.replicas:
+                if r.dead:
+                    continue
+                if self._detect_dead(r):
+                    return False
+                if r.state != HEALTHY:
+                    return False
+                if r.engine.has_work():
+                    return False
+        return True
+
+    # -- drain / shutdown ---------------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> None:
+        """Graceful drain: stop NEW placement (submit raises
+        RouterStopped), keep the health/retry machinery running so
+        in-flight and parked work finishes, then terminally fail
+        whatever could not complete within the budget (typed, counted —
+        never silent)."""
+        self._stopping = True
+        deadline = time.monotonic() + timeout
+
+        def outstanding():
+            with self._lock:
+                if self._parked:
+                    return True
+                return any(r.inflight for r in self.replicas)
+
+        while outstanding() and time.monotonic() < deadline:
+            if self.threaded:
+                time.sleep(min(0.01, self.health_interval))
+            else:
+                self.pump()
+        with self._lock:
+            leftovers = list(self._parked)
+            self._parked.clear()
+        for fh in leftovers:
+            if not fh.done():
+                fh._resolve(EngineStopped(
+                    "fleet shut down while the request awaited retry"))
+                self.stats.inc("failed")
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """drain(), stop the health loop, shut every engine down (their
+        shutdowns resolve residual in-flight handles; the retry path sees
+        _stopping and fails them terminally), and sweep any hop stranded
+        mid-admission."""
+        self.drain(timeout)
+        self._stop_health = True
+        if self._health_thread is not None:
+            self._health_thread.join(timeout=5.0)
+            self._health_thread = None
+        for r in self.replicas:
+            try:
+                r.engine.shutdown(timeout=self.engine_shutdown_timeout)
+            except Exception:  # noqa: BLE001
+                pass
+            with self._lock:
+                inflight = list(r.inflight)
+                r.inflight.clear()
+            for fh in inflight:
+                hop = fh._hop
+                if hop is not None and not hop.done():
+                    hop._resolve(EngineStopped("fleet shut down"))
+        # final parked sweep: the health tick may have POPPED a parked
+        # batch right as drain() looked (in neither _parked nor any
+        # inflight set) and re-parked it after drain's snapshot — with
+        # the health loop now stopped, nothing else would ever resolve
+        # it, and an un-timed result() would hang forever
+        with self._lock:
+            leftovers = list(self._parked)
+            self._parked.clear()
+        for fh in leftovers:
+            if not fh.done():
+                fh._resolve(EngineStopped("fleet shut down"))
+                self.stats.inc("failed")
+
+
+def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
+                max_body_bytes: int = 8 * 1024 * 1024,
+                request_timeout: float = 300.0):
+    """HTTP entry over a fleet Router (the multi-replica serve_llm).
+
+    POST / with {"prompt": [...], "max_new_tokens": N, "eos_id"?,
+    "deadline"?} returns {"tokens": [...], "hops": [replica ids]}.
+    Failure surface: fleet backpressure (every replica QueueFull) and an
+    empty rotation reply 503 with Retry-After; deadline/timeout replies
+    504 AND cancels fleet-wide; a terminal replica-death error
+    (ReplicaDied / RetriesExhausted) replies 502 — the upstream died,
+    typed, never silent.
+
+    GET /healthz aggregates: 200 while >= 1 replica is in rotation, with
+    per-replica {state, alive, rebuilds}.  GET /metrics renders the
+    router's own registry PLUS every replica's engine registry stamped
+    with a {replica="<id>"} label (obs.metrics.render_merged) — one
+    scrape shows fleet counters and per-replica placement signals
+    side by side.  GET /stats is the JSON twin.
+
+    Returns (server, thread); server.shutdown() drains the router and
+    stops everything."""
+    import json
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if not router.threaded:
+        raise ValueError("serve_fleet needs a threaded Router "
+                         "(Router(..., threaded=True))")
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply_text(self, status, text, content_type, headers=None):
+            body = text.encode()
+            self.send_response(status)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _reply(self, status, payload, headers=None):
+            self._reply_text(status, json.dumps(payload),
+                             "application/json", headers)
+
+        def do_GET(self):
+            path = self.path.rstrip("/")
+            if path == "/stats":
+                self._reply(200, {
+                    "router": router.stats_snapshot(),
+                    "replicas": {
+                        str(r.rid): r.engine.stats_snapshot()
+                        for r in router.replicas},
+                })
+            elif path == "/metrics":
+                text = router.metrics.render() + obs_metrics.render_merged(
+                    [(str(r.rid), r.engine.metrics)
+                     for r in router.replicas], label="replica")
+                self._reply_text(200, text,
+                                 "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/healthz":
+                healthy = sum(1 for r in router.replicas
+                              if r.state == HEALTHY and not r.dead)
+                payload = {
+                    "ok": healthy >= 1 and not router._stopping,
+                    "healthy_replicas": healthy,
+                    "replicas": {
+                        str(r.rid): {
+                            "state": "dead" if r.dead else r.state,
+                            "alive": (not r.dead and r.engine.alive()),
+                            "rebuilds": r.rebuilds,
+                        } for r in router.replicas},
+                }
+                self._reply(200 if payload["ok"] else 503, payload)
+            else:
+                self._reply(404, {"error": "unknown path"})
+
+        def do_POST(self):
+            try:
+                n = int(self.headers.get("Content-Length", "0"))
+                if n > max_body_bytes:
+                    self._reply(413, {"error": "body too large"})
+                    return
+                try:
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    prompt = req["prompt"]
+                    max_new = int(req.get("max_new_tokens", 16))
+                    eos_id = req.get("eos_id")
+                    deadline = req.get("deadline")
+                except (json.JSONDecodeError, KeyError, TypeError,
+                        ValueError) as e:
+                    self._reply(400, {"error": f"bad request body: {e!r}"})
+                    return
+                try:
+                    handle = router.submit(prompt, max_new, eos_id,
+                                           deadline=deadline)
+                except (FleetQueueFull, NoHealthyReplica) as e:
+                    retry = max(1, int(-(-getattr(e, "retry_after", 1.0)
+                                         // 1)))
+                    self._reply(503, {"error": str(e)},
+                                headers={"Retry-After": str(retry)})
+                    return
+                except RouterStopped as e:
+                    self._reply(503, {"error": str(e)})
+                    return
+                except ValueError as e:
+                    self._reply(400, {"error": str(e)})
+                    return
+                try:
+                    toks = handle.result(timeout=request_timeout)
+                except (ReplicaDied, RetriesExhausted) as e:
+                    self._reply(502, {"error": str(e)})
+                    return
+                except EngineStopped as e:
+                    # resolved by fleet drain/shutdown mid-request: the
+                    # service is going away, not broken — 503 like every
+                    # other stop condition
+                    self._reply(503, {"error": str(e)})
+                    return
+                except TimeoutError as e:
+                    # wait timeout or DeadlineExceeded; cancel fleet-wide
+                    # so no replica keeps decoding for a gone client
+                    handle.cancel()
+                    self._reply(504, {"error": f"generation timed out: {e}"})
+                    return
+                except RequestCancelled as e:
+                    self._reply(409, {"error": str(e)})
+                    return
+                self._reply(200, {"tokens": toks, "hops": handle.hops})
+            except Exception as e:  # noqa: BLE001 — server-side fault
+                self._reply(500, {"error": repr(e)})
+
+        def log_message(self, *a):  # quiet
+            pass
+
+    srv = ThreadingHTTPServer((host, port), Handler)
+    _orig_shutdown = srv.shutdown
+
+    def _shutdown():
+        _orig_shutdown()
+        router.shutdown()
+
+    srv.shutdown = _shutdown
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t

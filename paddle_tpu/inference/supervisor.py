@@ -1,0 +1,125 @@
+"""EngineSupervisor — detect a dead replica, rebuild it from a factory.
+
+An LLMEngine is preemption-safe *within* one replica (PR 4): dispatch
+faults, OOM, deadlines and shutdown all provably leak nothing.  What it
+cannot survive is itself: a step thread killed mid-step (an
+InjectedCrash in chaos runs; a segfaulting kernel, an OOM-killed
+runtime, a wedged device in production) strands every queued and
+in-flight handle and holds the dead engine's slots forever.  The
+reference framework keeps ~56k LoC of fleet machinery for exactly this
+(paddle/fluid/distributed); this module is the minimal TPU-native
+analog:
+
+  * `check(engine)` classifies an engine: "ok", "dead_thread" (started
+    step thread no longer alive, not a clean stop), "pools_lost" (a k/v
+    pool buffer is deleted AND STAYS deleted across a recheck — the
+    in-step recovery path never ran or failed), or "stopped";
+  * `rebuild(engine)` tears the dead engine down — `shutdown()` on a
+    crashed engine resolves every stranded handle with `EngineStopped`
+    and reclaims slot accounting — and returns a fresh engine from the
+    factory, bounded by `max_rebuilds`.
+
+The fleet Router calls these from its health loop and re-registers the
+replacement under the same replica id; `supervise()` is the standalone
+one-shot (no router) convenience the tests exercise directly.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional
+
+__all__ = ["EngineSupervisor"]
+
+
+class EngineSupervisor:
+    """Rebuild policy for dead LLMEngine replicas.
+
+    factory: zero-arg callable returning a fresh, fault-free engine.
+    max_rebuilds: total rebuild budget across all replicas (None =
+    unbounded) — a crash-looping replica must not rebuild forever.
+    recheck_after: seconds between the two looks of the pools-lost
+    check (a donated dispatch deletes pools *transiently* mid-step on
+    TPU; only a deletion that persists is unrecoverable).
+    """
+
+    def __init__(self, factory: Callable[[], object],
+                 max_rebuilds: Optional[int] = 16,
+                 shutdown_timeout: float = 10.0,
+                 recheck_after: float = 0.05):
+        self.factory = factory
+        self.max_rebuilds = max_rebuilds
+        self.shutdown_timeout = float(shutdown_timeout)
+        self.recheck_after = float(recheck_after)
+        self.rebuilds = 0
+
+    # -- detection ----------------------------------------------------------
+
+    def _pools_deleted(self, engine) -> bool:
+        try:
+            pools = engine.cache.pools
+            return any(getattr(pools[s], "is_deleted", lambda: False)()
+                       for s in ("k", "v"))
+        except Exception:  # noqa: BLE001 — unreadable state counts as lost
+            return True
+
+    def check(self, engine) -> str:
+        """Classify an engine: 'ok' | 'stopped' | 'dead_thread' |
+        'pools_lost'.  Cheap enough for a health loop; the pools check
+        double-reads across `recheck_after` so a transient mid-dispatch
+        donation is never mistaken for an unrecoverable loss."""
+        if engine._stop:
+            return "stopped"
+        t = engine._thread
+        if t is not None and not t.is_alive():
+            return "dead_thread"
+        if self._pools_deleted(engine):
+            time.sleep(self.recheck_after)
+            if self._pools_deleted(engine):
+                return "pools_lost"
+        return "ok"
+
+    # -- recovery -----------------------------------------------------------
+
+    def rebuild(self, engine, start: bool = False, teardown: bool = True):
+        """Tear down a dead engine and return a replacement from the
+        factory, or None when the rebuild budget is exhausted.
+
+        `shutdown()` on the dead engine is the handle-resolution step:
+        every stranded queued/in-flight request resolves with
+        `EngineStopped` there, which is what lets the Router's retry
+        logic see them (requeue iff zero tokens) instead of losing them
+        silently.  teardown=False skips it when the caller already shut
+        the engine down (the Router's death path) — a WEDGED step thread
+        makes each shutdown block its full join timeout, and the single
+        health-tick thread must not pay that twice per death.  start=True
+        starts the replacement's step thread (threaded fleets); manual
+        fleets leave it to the pump."""
+        if self.max_rebuilds is not None \
+                and self.rebuilds >= self.max_rebuilds:
+            return None
+        if teardown:
+            try:
+                engine.shutdown(timeout=self.shutdown_timeout)
+            except Exception:  # noqa: BLE001 — a wedged step thread:
+                # shutdown already failed the queued handles; the slots
+                # stay with the zombie, the replacement engine gets a
+                # fresh pool anyway
+                pass
+        new = self.factory()
+        self.rebuilds += 1
+        if start:
+            new.start()
+        return new
+
+    def supervise(self, engine, start: bool = False):
+        """One-shot standalone supervision: check, and rebuild when the
+        verdict demands it.  Returns (verdict, engine) where `engine` is
+        the replacement on rebuild (or the original when 'ok'/'stopped'
+        or the budget is spent)."""
+        verdict = self.check(engine)
+        if verdict in ("dead_thread", "pools_lost"):
+            new = self.rebuild(engine, start=start)
+            if new is not None:
+                return verdict, new
+        return verdict, engine

@@ -33,7 +33,9 @@ from . import mfu  # noqa: F401
 from .trace import (  # noqa: F401
     Tracer, get_tracer, load_trace, summarize,
 )
-from .metrics import Registry, Counter, Gauge, Histogram  # noqa: F401
+from .metrics import (  # noqa: F401
+    Registry, Counter, Gauge, Histogram, render_merged,
+)
 from .mfu import (  # noqa: F401
     RecompileSentinel, RecompileWarning, device_peak_flops, runtime_report,
 )
@@ -41,6 +43,7 @@ from .mfu import (  # noqa: F401
 __all__ = [
     "trace", "metrics", "mfu", "Tracer", "get_tracer", "load_trace",
     "summarize", "Registry", "Counter", "Gauge", "Histogram",
+    "render_merged",
     "RecompileSentinel", "RecompileWarning", "device_peak_flops",
     "runtime_report",
 ]

@@ -27,7 +27,7 @@ import threading
 from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "Registry",
-           "DEFAULT_LATENCY_BUCKETS", "percentile"]
+           "DEFAULT_LATENCY_BUCKETS", "percentile", "render_merged"]
 
 # seconds; spans queue-wait through long decode tails
 DEFAULT_LATENCY_BUCKETS = (
@@ -54,6 +54,13 @@ def _fmt_labels(labels: Optional[dict]) -> str:
     return "{" + inner + "}"
 
 
+def _merge_labels(own: Optional[dict],
+                  extra: Optional[dict]) -> Optional[dict]:
+    if not extra:
+        return own
+    return {**(own or {}), **extra}
+
+
 class _Metric:
     kind = "untyped"
 
@@ -64,7 +71,8 @@ class _Metric:
         self.labels = dict(labels) if labels else None
         self._lock = threading.Lock()
 
-    def sample_lines(self) -> List[str]:  # pragma: no cover — abstract
+    def sample_lines(self, extra_labels: Optional[dict] = None
+                     ) -> List[str]:  # pragma: no cover — abstract
         raise NotImplementedError
 
 
@@ -88,8 +96,9 @@ class Counter(_Metric):
     def value(self) -> float:
         return self._value
 
-    def sample_lines(self) -> List[str]:
-        return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self._value)}"]
+    def sample_lines(self, extra_labels: Optional[dict] = None) -> List[str]:
+        labels = _merge_labels(self.labels, extra_labels)
+        return [f"{self.name}{_fmt_labels(labels)} {_fmt(self._value)}"]
 
 
 class Gauge(_Metric):
@@ -123,8 +132,9 @@ class Gauge(_Metric):
                 return float("nan")  # take /metrics down with it
         return self._value
 
-    def sample_lines(self) -> List[str]:
-        return [f"{self.name}{_fmt_labels(self.labels)} {_fmt(self.value)}"]
+    def sample_lines(self, extra_labels: Optional[dict] = None) -> List[str]:
+        labels = _merge_labels(self.labels, extra_labels)
+        return [f"{self.name}{_fmt_labels(labels)} {_fmt(self.value)}"]
 
 
 class Histogram(_Metric):
@@ -189,7 +199,7 @@ class Histogram(_Metric):
         bucket interpolation)."""
         return percentile(self.samples(), q)
 
-    def sample_lines(self) -> List[str]:
+    def sample_lines(self, extra_labels: Optional[dict] = None) -> List[str]:
         # ONE snapshot under the lock: a concurrent observe() must not
         # let the exposed _count disagree with the +Inf bucket (the
         # Prometheus histogram invariant scrapers rely on)
@@ -197,13 +207,14 @@ class Histogram(_Metric):
             counts = list(self._counts)
             total_sum, total_count = self._sum, self._count
         lines = []
-        base = dict(self.labels) if self.labels else {}
+        labels = _merge_labels(self.labels, extra_labels)
+        base = dict(labels) if labels else {}
         for edge, cum in self._cumulative(counts).items():
             lines.append(f"{self.name}_bucket"
                          f"{_fmt_labels({**base, 'le': _fmt(edge)})} {cum}")
-        lines.append(f"{self.name}_sum{_fmt_labels(self.labels)} "
+        lines.append(f"{self.name}_sum{_fmt_labels(labels)} "
                      f"{_fmt(total_sum)}")
-        lines.append(f"{self.name}_count{_fmt_labels(self.labels)} "
+        lines.append(f"{self.name}_count{_fmt_labels(labels)} "
                      f"{total_count}")
         return lines
 
@@ -216,6 +227,37 @@ def percentile(values: Iterable[float], q: float) -> float:
     lo = int(k)
     hi = min(lo + 1, len(vals) - 1)
     return vals[lo] + (vals[hi] - vals[lo]) * (k - lo)
+
+
+def render_merged(registries, label: str = "replica") -> str:
+    """One Prometheus text blob over SEVERAL registries: every sample line
+    from registry `name` gains a `{label="name"}` label, and families
+    sharing a metric name across registries emit HELP/TYPE exactly once.
+
+    This is how a fleet router exposes N per-replica engine registries on
+    a single `GET /metrics` without pooling their storage (each engine
+    keeps exclusive ownership of its counters — aggregation happens at
+    render time, never at write time).  `registries` is a dict (or
+    (name, Registry) iterable); names become label values, so keep them
+    low-cardinality (replica ids, not request ids)."""
+    items = registries.items() if hasattr(registries, "items") \
+        else list(registries)
+    families: "collections.OrderedDict[str, list]" = \
+        collections.OrderedDict()
+    for rname, reg in items:
+        extra = {label: rname}
+        for m in reg.collect():
+            fam = families.get(m.name)
+            if fam is None:
+                fam = families[m.name] = [m.help, m.kind, []]
+            fam[2].extend(m.sample_lines(extra_labels=extra))
+    lines = []
+    for name, (help_text, kind, samples) in families.items():
+        if help_text:
+            lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+    return "\n".join(lines) + "\n"
 
 
 class Registry:

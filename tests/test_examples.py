@@ -16,6 +16,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ("recsys_ps.py", []),
     ("serve_model.py", []),
     ("serve_llm.py", []),
+    ("serve_fleet.py", []),
 ])
 def test_example_runs(script, args):
     env = dict(os.environ, JAX_PLATFORMS="cpu",

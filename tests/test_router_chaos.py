@@ -1,0 +1,558 @@
+"""Fleet-tier serving chaos: Router placement / ejection / retry,
+EngineSupervisor rebuilds, fleet fault points, deadline propagation
+across hops, and the serve_fleet HTTP surface.
+
+Most schedules run on ScriptedEngine — the REAL LLMEngine scheduler with
+the model compute replaced by a deterministic numpy script (see
+paddle_tpu/inference/faults.py) — so tier-1 can afford whole-fleet chaos
+deterministically.  One tier-1 test drives a real tiny-llama fleet
+through a replica death to pin the jitted-dispatch integration."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import faults as F
+from paddle_tpu.inference.llm_engine import (DeadlineExceeded,
+                                             EngineStopped, LLMEngine,
+                                             RequestCancelled)
+from paddle_tpu.inference.router import (HEALTHY, FleetQueueFull,
+                                         NoHealthyReplica, ReplicaDied,
+                                         Router, RouterStopped, serve_fleet)
+from paddle_tpu.inference.supervisor import EngineSupervisor
+
+
+def _mk(**kw):
+    """Scripted-engine factory (fresh engine per call, fault-free)."""
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+
+    def make():
+        return F.ScriptedEngine(**kw)
+    return make
+
+
+def _ref(h):
+    return F.ScriptedEngine.reference_tokens(h.prompt, h.max_new_tokens,
+                                             h.eos_id)
+
+
+def _workload(seed=1, n=6):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
+                          int(rng.integers(2, 9))).tolist(),
+             int(rng.integers(2, 7))) for _ in range(n)]
+
+
+# -- deterministic fleet chaos schedules (the acceptance criterion) --------
+#
+# name -> (engine_rules {replica: [(point, kw)]}, router_rules [(point,
+# kw)], n_replicas, engine_kw).  Every schedule must leave the fleet
+# invariant-clean AND serving (fleet_check_invariants probes it).
+
+FLEET_SCHEDULES = {
+    "death_mid_prefill_r0": (
+        {0: [("prefill", dict(nth=1, crash=True))]}, [], 2, {}),
+    "death_mid_decode_r0": (
+        {0: [("decode", dict(nth=2, crash=True))]}, [], 2, {}),
+    "death_step_r1": (
+        {1: [("step", dict(nth=3, crash=True))]}, [], 2, {}),
+    "double_death_sequential": (
+        {0: [("prefill", dict(nth=1, crash=True))],
+         1: [("decode", dict(nth=3, crash=True))]}, [], 3, {}),
+    "health_flap_r1": (
+        {}, [("health_flap", dict(replica=1, nth=1))], 2, {}),
+    "health_flap_repeated_r0": (
+        {}, [("health_flap", dict(replica=0, nth=1)),
+             ("health_flap", dict(replica=0, nth=2))], 2, {}),
+    "slow_replica_r0": (
+        {}, [("slow_replica", dict(replica=0, nth=1, delay=0.03)),
+             ("slow_replica", dict(replica=0, nth=3, delay=0.03))], 2, {}),
+    "stats_staleness_r0_always": (
+        {}, [("stats_staleness", dict(replica=0, always=True))], 2, {}),
+    "preemption_storm_r0": (
+        # pool below the 2-slot worst case on BOTH replicas, plus an
+        # injected OOM storm on one slot of replica 0
+        {0: [("page_alloc", dict(slot=0, always=True))]}, [], 2,
+        dict(num_pages=5)),
+    "router_fired_replica_death": (
+        {}, [("replica_death", dict(replica=0, nth=2))], 2, {}),
+    "death_plus_engine_fault": (
+        {0: [("prefill", dict(nth=1, crash=True))],
+         1: [("decode", dict(nth=4))]}, [], 2, {}),
+}
+
+
+class TestFleetChaos:
+    @pytest.mark.parametrize("name", sorted(FLEET_SCHEDULES))
+    def test_shipped_fleet_schedule(self, name):
+        eng_spec, rtr_spec, n_replicas, engine_kw = FLEET_SCHEDULES[name]
+        engine_rules = {rid: [F.FaultRule(p, **kw) for p, kw in rules]
+                        for rid, rules in eng_spec.items()}
+        router_rules = [F.FaultRule(p, **kw) for p, kw in rtr_spec]
+        report = F.fleet_run_schedule(
+            _mk(**engine_kw), engine_rules, router_rules,
+            _workload(n=6), n_replicas=n_replicas, reference=_ref)
+        assert report["ok"], report["violations"]
+        if eng_spec or rtr_spec:
+            assert report["fired"], "schedule never fired — tests nothing"
+        assert report["completed"] + report["failed"] == report["requests"]
+        # the probe inside fleet_check_invariants already proved the
+        # fleet kept serving after the fault
+        assert report["probe_tokens"] is not None
+
+    def test_fault_free_fleet_all_complete(self):
+        report = F.fleet_run_schedule(_mk(), {}, [], _workload(n=8),
+                                      n_replicas=2, reference=_ref)
+        assert report["ok"] and report["failed"] == 0
+        assert report["completed"] == report["requests"]
+        # placement spread work over both replicas
+        assert report["stats"]["placed"] >= 8
+
+    def test_death_mid_prefill_retries_token_exact(self):
+        """A zero-token request stranded by replica death is re-placed
+        and finishes token-exact; deaths/rebuilds are counted."""
+        rules = {0: [F.FaultRule("prefill", nth=1, crash=True)]}
+        report = F.fleet_run_schedule(_mk(), rules, [], _workload(n=5),
+                                      n_replicas=2, reference=_ref)
+        assert report["ok"], report["violations"]
+        assert report["retried"] >= 1
+        assert report["stats"]["deaths"] == 1
+        assert report["stats"]["rebuilds"] == 1
+        assert report["failed"] == 0      # zero-token deaths all recovered
+
+    def test_death_mid_decode_is_typed_terminal(self):
+        """A request with tokens already resolved is NOT retried: it
+        fails with the typed ReplicaDied, exactly once."""
+        mk = _mk()
+        engines = [mk() for _ in range(2)]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("decode", nth=2, crash=True)])
+        router = Router(engines, supervisor=EngineSupervisor(mk),
+                        threaded=False, backoff_base=0.01,
+                        backoff_max=0.25)
+        handles = [router.submit(p, n) for p, n in _workload(n=4)]
+        F.drive_fleet(router, handles)
+        died = [h for h in handles
+                if isinstance(h.error, ReplicaDied)]
+        assert died, "no partially-decoded request hit replica death"
+        for h in died:
+            assert h.resolutions == 1
+        F.fleet_check_invariants(router, handles, reference=_ref)
+        router.shutdown()
+
+    @pytest.mark.parametrize("seed", range(8))
+    def test_random_fleet_schedules_smoke(self, seed):
+        engine_rules, router_rules = F.fleet_random_schedule(
+            seed, n_replicas=2)
+        report = F.fleet_run_schedule(
+            _mk(), engine_rules, router_rules, _workload(seed=seed),
+            n_replicas=2, reference=_ref)
+        assert report["ok"], (seed, report["violations"])
+
+    @pytest.mark.slow
+    def test_random_fleet_schedules_soak(self):
+        """200-seed fleet soak (acceptance criterion): every schedule
+        leaves zero leaks, exact tokens, and a serving fleet."""
+        for seed in range(200):
+            engine_rules, router_rules = F.fleet_random_schedule(
+                seed, n_replicas=2 + seed % 2)
+            report = F.fleet_run_schedule(
+                _mk(), engine_rules, router_rules, _workload(seed=seed),
+                n_replicas=2 + seed % 2, reference=_ref,
+                probe=seed % 5 == 0)
+            assert report["ok"], (seed, report["violations"])
+
+
+# -- placement ------------------------------------------------------------
+
+class TestPlacement:
+    def test_least_loaded_reads_registry_gauges(self):
+        """The router's score comes from the obs gauges: preloading
+        replica 0's queue steers placement to replica 1."""
+        mk = _mk()
+        engines = [mk(), mk()]
+        for _ in range(3):
+            engines[0].submit([1, 2], max_new_tokens=2)
+        router = Router(engines, supervisor=None, threaded=False)
+        h = router.submit([3, 4], max_new_tokens=2)
+        assert h.hops == [1]
+        F.drive_fleet(router, [h])
+        assert h.result(timeout=0) == _ref(h)
+        router.shutdown()
+
+    def test_placement_gauges_live_in_metrics(self):
+        """Satellite: queue depth / free pages / occupied slots are live
+        registry gauges — present in the /metrics render and matching
+        stats_snapshot, without polling JSON."""
+        eng = F.ScriptedEngine()
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        eng.submit([4, 5], max_new_tokens=4)
+        reg = eng.metrics
+        assert reg.get("llm_queue_depth").value == 2
+        assert reg.get("llm_slots_in_flight").value == 0
+        assert reg.get("llm_free_pages").value == eng.cache.num_pages - 1
+        eng.step()      # admits into slots
+        snap = eng.stats_snapshot()
+        assert reg.get("llm_queue_depth").value == snap["queue_depth"]
+        assert reg.get("llm_free_pages").value == snap["free_pages"]
+        assert reg.get("llm_slots_in_flight").value == 2
+        text = reg.render()
+        for name in ("llm_queue_depth", "llm_free_pages",
+                     "llm_slots_in_flight", "llm_free_slots"):
+            assert f"\n{name} " in "\n" + text, f"{name} not rendered"
+
+    def test_fleet_backpressure_503_min_retry_after(self):
+        """All healthy replicas QueueFull -> FleetQueueFull with the
+        minimum Retry-After; capacity freeing re-opens admission."""
+        mk = _mk(max_pending=1, num_slots=1)
+        router = Router([mk(), mk()], supervisor=None, threaded=False)
+        accepted = [router.submit([1, 2], 2) for _ in range(2)]
+        with pytest.raises(FleetQueueFull) as ei:
+            router.submit([9, 9], 2)
+        assert ei.value.retry_after > 0
+        assert router.stats["rejected"] == 1
+        F.drive_fleet(router, accepted)
+        h = router.submit([5, 6], 2)    # queues drained: accepted again
+        F.drive_fleet(router, [h])
+        F.fleet_check_invariants(router, accepted + [h], reference=_ref)
+        router.shutdown()
+
+    def test_no_healthy_replica_typed(self):
+        mk = _mk()
+        router = Router([mk(), mk()], supervisor=None, threaded=False,
+                        backoff_base=30.0)  # no reinstatement window
+        for r in router.replicas:
+            router.kill(r)
+            r.engine.submit([1], 1)     # give the crash a step to fire
+        for _ in range(10):
+            router.pump()
+        assert all(r.dead for r in router.replicas)
+        with pytest.raises(NoHealthyReplica):
+            router.submit([1, 2], 2)
+        router.shutdown()
+
+    def test_drain_finishes_inflight_then_refuses(self):
+        router = Router(factory=_mk(), num_replicas=2, threaded=False)
+        handles = [router.submit(p, n) for p, n in _workload(n=4)]
+        router.drain(timeout=30.0)
+        for h in handles:
+            assert h.done() and h.error is None
+            assert h.result(timeout=0) == _ref(h)
+        with pytest.raises(RouterStopped):
+            router.submit([1, 2], 2)
+        router.shutdown()
+
+    def test_cancel_parked_and_inflight(self):
+        """cancel() resolves a parked retry at the next tick and an
+        in-flight hop through its engine — exactly once either way."""
+        mk = _mk()
+        router = Router([mk(), mk()], supervisor=None, threaded=False)
+        a = router.submit([1, 2, 3], 4)
+        a.cancel()
+        router.pump()
+        assert a.done() and isinstance(a.error, RequestCancelled)
+        assert a.resolutions == 1
+        # parked path: sole replica dies (no supervisor), retry parks
+        router2 = Router([mk()], supervisor=None, threaded=False,
+                         backoff_base=30.0)
+        router2.replicas[0].engine.faults = F.FaultInjector(
+            [F.FaultRule("prefill", nth=1, crash=True)])
+        b = router2.submit([4, 5], 3)
+        for _ in range(8):
+            router2.pump()
+        assert not b.done() and b._is_parked
+        b.cancel()
+        router2.pump()
+        assert b.done() and isinstance(b.error, RequestCancelled)
+        assert b.resolutions == 1
+        router.shutdown()
+        router2.shutdown()
+
+
+# -- deadline propagation (satellite) --------------------------------------
+
+class TestDeadlinePropagation:
+    def test_retry_carries_remaining_deadline(self):
+        """The hop after a replica death carries the REMAINING deadline:
+        the engine-level absolute deadline stays pinned to the fleet
+        submission, it is never re-extended per hop."""
+        mk = _mk()
+        engines = [mk(), mk()]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("prefill", nth=1, crash=True)])
+        router = Router(engines, supervisor=EngineSupervisor(mk),
+                        threaded=False, backoff_base=0.01)
+        h = router.submit([1, 2, 3], 3, deadline=30.0)
+        fleet_abs = h._deadline
+        time.sleep(0.05)        # make "original vs remaining" observable
+        F.drive_fleet(router, [h])
+        assert h.hops == [0, 1]
+        assert h.result(timeout=0) == _ref(h)
+        hop_abs = h._hop.deadline     # second hop's engine-level deadline
+        assert hop_abs is not None
+        # remaining-deadline propagation == constant absolute deadline
+        assert abs(hop_abs - fleet_abs) < 0.05, (
+            "retry hop re-derived its deadline instead of carrying the "
+            f"remaining budget (fleet_abs={fleet_abs}, hop={hop_abs})")
+        F.fleet_check_invariants(router, [h], reference=_ref)
+        router.shutdown()
+
+    def test_expiry_mid_retry_maps_504_exactly_once(self):
+        """Replica dies, the retry parks (no capacity), the deadline
+        expires while parked: DeadlineExceeded exactly once."""
+        mk = _mk()
+        router = Router([mk()], supervisor=EngineSupervisor(mk),
+                        threaded=False, backoff_base=30.0)
+        router.replicas[0].engine.faults = F.FaultInjector(
+            [F.FaultRule("prefill", nth=1, crash=True)])
+        h = router.submit([1, 2, 3], 3, deadline=0.15)
+        for _ in range(8):      # death -> zero-token retry -> parked
+            router.pump()
+        assert not h.done()
+        time.sleep(0.2)         # expire while parked
+        router.pump()
+        assert h.done()
+        assert isinstance(h.error, DeadlineExceeded)
+        assert h.resolutions == 1
+        assert router.stats["timed_out"] == 1
+        router.shutdown()
+
+    def test_expired_before_placement_times_out_in_engine(self):
+        router = Router(factory=_mk(), num_replicas=1, threaded=False)
+        h = router.submit([1, 2], 4, deadline=0.0)
+        F.drive_fleet(router, [h])
+        assert isinstance(h.error, DeadlineExceeded)
+        assert h.resolutions == 1
+        router.shutdown()
+
+
+# -- EngineStopped (satellite) ---------------------------------------------
+
+class TestEngineStopped:
+    def test_submit_after_shutdown_raises_typed_immediately(self):
+        eng = F.ScriptedEngine()
+        eng.shutdown()
+        t0 = time.monotonic()
+        with pytest.raises(EngineStopped):
+            eng.submit([1, 2], max_new_tokens=2)
+        assert time.monotonic() - t0 < 0.5, "refusal must be immediate"
+
+    def test_submit_after_step_thread_death_raises_typed(self):
+        """A crashed step thread must refuse new work instead of
+        enqueueing into a dead loop; shutdown() then resolves the
+        stranded handle so result() cannot hang."""
+        eng = F.ScriptedEngine()
+        eng.faults = F.FaultInjector(
+            [F.FaultRule("step", nth=1, crash=True)])
+        eng.start()
+        h = eng.submit([1, 2], max_new_tokens=4)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            t = eng._thread
+            if t is not None and not t.is_alive():
+                break
+            time.sleep(0.01)
+        assert not eng._thread.is_alive(), "crash never fired"
+        with pytest.raises(EngineStopped):
+            eng.submit([3], max_new_tokens=1)
+        assert not h.done()       # stranded — the replica-death shape
+        eng.shutdown()
+        with pytest.raises(EngineStopped):
+            h.result(timeout=0)   # resolved, not hanging
+        assert h.resolutions == 1
+
+
+# -- supervisor ------------------------------------------------------------
+
+class TestSupervisor:
+    def test_detects_dead_thread_and_rebuilds(self):
+        mk = _mk()
+        sup = EngineSupervisor(mk)
+        eng = mk()
+        eng.faults = F.FaultInjector(
+            [F.FaultRule("step", nth=1, crash=True)])
+        eng.start()
+        eng.submit([1, 2], max_new_tokens=2)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and eng._thread.is_alive():
+            time.sleep(0.01)
+        verdict, new = sup.supervise(eng)
+        assert verdict == "dead_thread"
+        assert new is not eng
+        assert sup.rebuilds == 1
+        out = new.generate([[1, 2, 3]], max_new_tokens=2)[0]
+        assert out == F.ScriptedEngine.reference_tokens([1, 2, 3], 2)
+
+    def test_detects_unrecoverable_pools(self):
+        sup = EngineSupervisor(_mk(), recheck_after=0.0)
+        eng = F.ScriptedEngine()
+        assert sup.check(eng) == "ok"
+        for side in ("k", "v"):
+            eng.cache.pools[side].delete()
+        verdict, new = sup.supervise(eng)
+        assert verdict == "pools_lost"
+        assert new is not eng
+
+    def test_rebuild_budget_bounds_crash_loops(self):
+        sup = EngineSupervisor(_mk(), max_rebuilds=0)
+        eng = F.ScriptedEngine()
+        eng.shutdown()
+        assert sup.rebuild(eng) is None
+
+    def test_router_reinstates_rebuilt_replica_via_canary(self):
+        """Death -> rebuild -> canary -> back in rotation, all observable
+        in the fleet counters."""
+        mk = _mk()
+        engines = [mk(), mk()]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("step", nth=2, crash=True)])
+        router = Router(engines, supervisor=EngineSupervisor(mk),
+                        threaded=False, backoff_base=0.01)
+        handles = [router.submit(p, n) for p, n in _workload(n=5)]
+        F.drive_fleet(router, handles)
+        assert router.stats["deaths"] == 1
+        assert router.stats["rebuilds"] == 1
+        assert router.stats["reinstatements"] >= 1
+        assert router.replicas[0].state == HEALTHY
+        assert router.replicas[0].rebuilds == 1
+        F.fleet_check_invariants(router, handles, reference=_ref)
+        router.shutdown()
+
+
+# -- serve_fleet HTTP surface ----------------------------------------------
+
+def _post(url, payload, timeout=60):
+    req = urllib.request.Request(url + "/",
+                                 data=json.dumps(payload).encode())
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+class TestServeFleet:
+    def test_serves_healthz_metrics_and_failover(self):
+        mk = _mk()
+        router = Router(factory=mk, num_replicas=2, threaded=True,
+                        health_interval=0.01, backoff_base=0.02)
+        srv, _ = serve_fleet(router)
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            out = _post(url, {"prompt": [1, 2, 3], "max_new_tokens": 4})
+            assert out["tokens"] == \
+                F.ScriptedEngine.reference_tokens([1, 2, 3], 4)
+            assert out["hops"], "response must carry the hop trail"
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=30) as resp:
+                hz = json.loads(resp.read())
+            assert resp.status == 200 and hz["ok"]
+            assert hz["healthy_replicas"] == 2
+            with urllib.request.urlopen(url + "/metrics",
+                                        timeout=30) as resp:
+                text = resp.read().decode()
+            # per-replica labelled engine gauges + fleet counters on ONE
+            # scrape — the external-scheduler surface
+            assert 'llm_queue_depth{replica="0"}' in text
+            assert 'llm_free_pages{replica="1"}' in text
+            assert "fleet_placed_total" in text
+            assert "fleet_replicas_healthy" in text
+            # kill a replica mid-service: the fleet keeps answering
+            router.kill(router.replicas[0])
+            for i in range(6):
+                out = _post(url, {"prompt": [7, i], "max_new_tokens": 3})
+                assert out["tokens"] == \
+                    F.ScriptedEngine.reference_tokens([7, i], 3)
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if router.stats["rebuilds"] >= 1:
+                    break
+                time.sleep(0.02)
+            assert router.stats["deaths"] >= 1
+            assert router.stats["rebuilds"] >= 1
+            with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                stats = json.loads(r.read())
+            assert stats["router"]["deaths"] >= 1
+            assert set(stats["replicas"]) == {"0", "1"}
+        finally:
+            srv.shutdown()
+
+    def test_dead_fleet_replies_503_with_retry_after(self):
+        mk = _mk()
+        engines = [mk(), mk()]
+        router = Router(engines, supervisor=None, threaded=True,
+                        health_interval=0.01, backoff_base=30.0)
+        srv, _ = serve_fleet(router)
+        url = f"http://127.0.0.1:{srv.server_address[1]}"
+        try:
+            for r in router.replicas:
+                router.kill(r)
+                try:
+                    r.engine.submit([1], 1)   # a step for the crash
+                except EngineStopped:
+                    pass
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                if all(r.dead for r in router.replicas):
+                    break
+                time.sleep(0.02)
+            assert all(r.dead for r in router.replicas)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                _post(url, {"prompt": [1, 2], "max_new_tokens": 2})
+            assert ei.value.code == 503
+            assert int(ei.value.headers["Retry-After"]) >= 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "/healthz", timeout=30)
+            assert ei.value.code == 503
+        finally:
+            srv.shutdown()
+
+
+# -- real-engine fleet (jitted-dispatch integration pin) -------------------
+
+class TestRealEngineFleet:
+    @pytest.mark.slow
+    def test_real_tiny_llama_fleet_survives_replica_death(self):
+        """One real 2-replica tiny-llama fleet through a mid-prefill
+        death: retried output token-exact vs the single-engine dense
+        reference, zero leaks, fleet still serving.  Slow-tier: the
+        scripted schedules cover the scheduler; this pins the jitted-
+        dispatch integration (compiles on a cold cache)."""
+        import jax
+        import jax.numpy as jnp
+
+        from paddle_tpu.models import generation, llama
+        from paddle_tpu.models.llama import LlamaConfig
+
+        cfg = LlamaConfig.tiny()
+        params = llama.init_params(cfg, jax.random.PRNGKey(0))
+
+        def mk():
+            return LLMEngine(params, cfg, num_slots=2, page_size=4,
+                             max_seq_len=16)
+
+        engines = [mk(), mk()]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("prefill", nth=1, crash=True)])
+        router = Router(engines, supervisor=EngineSupervisor(mk),
+                        threaded=False, backoff_base=0.01)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab_size, 6).tolist()
+                   for _ in range(3)]
+        handles = [router.submit(p, 3) for p in prompts]
+        F.drive_fleet(router, handles)
+        assert router.stats["deaths"] == 1
+        assert any(len(h.hops) > 1 for h in handles)
+        for p, h in zip(prompts, handles):
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=3))[0].tolist()
+            assert h.result(timeout=0) == want
+        F.fleet_check_invariants(
+            router, handles,
+            reference=lambda h: np.asarray(generation.generate(
+                params, jnp.asarray([h.prompt], jnp.int32), cfg,
+                max_new_tokens=h.max_new_tokens))[0].tolist())
+        router.shutdown()

@@ -1,0 +1,191 @@
+#!/usr/bin/env python
+"""Fleet chaos soak + router micro-bench for the replica Router.
+
+Runs seeded random FLEET fault schedules (paddle_tpu.inference.faults.
+fleet_random_schedule) against an N-replica fleet of ScriptedEngines —
+the real LLMEngine scheduler with scripted compute, so replica deaths
+(crashed step threads), health flaps, stale stats and slow score reads
+all execute at chaos-suite speed — and asserts the fleet invariants
+after every schedule: every request resolved exactly once fleet-wide,
+retried outputs token-exact vs a single healthy engine, zero leaked
+pages/slots per live replica, fleet still serving a fresh probe.
+
+Usage:
+    python tools/chaos_fleet.py                    # 25 schedules, seed 0
+    python tools/chaos_fleet.py --schedules 200 --replicas 3
+    python tools/chaos_fleet.py --threaded         # background-thread mode
+    python tools/chaos_fleet.py --bench --json     # router micro-bench
+                                                   # (bench.py extra.router)
+
+--bench measures the two numbers the roadmap's fleet item is judged by:
+placement overhead per submit (score + hop placement, no model compute)
+and failover-to-first-token latency under an injected replica death
+(submit -> death mid-prefill -> health tick detects -> retry on the
+surviving replica -> token), against the no-death baseline.
+
+Exit code 1 when any schedule violates a fleet invariant.  CPU-only.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _percentiles(samples):
+    from paddle_tpu.obs import metrics as obs_metrics
+
+    return {"p50": obs_metrics.percentile(samples, 0.5),
+            "p99": obs_metrics.percentile(samples, 0.99),
+            "n": len(samples)}
+
+
+def run_bench(trials: int = 15) -> dict:
+    """Router micro-bench on 2-replica scripted fleets."""
+    from paddle_tpu.inference import faults as F
+    from paddle_tpu.inference.router import Router
+    from paddle_tpu.inference.supervisor import EngineSupervisor
+
+    def mk():
+        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+
+    # placement overhead: N submits through the scoring path (manual
+    # mode, drained between batches so queues stay comparable)
+    router = Router(factory=mk, num_replicas=2, threaded=False)
+    for batch in range(20):
+        hs = [router.submit([1, 2, batch], 1) for _ in range(10)]
+        F.drive_fleet(router, hs, settle=False)
+    placement = router.metrics.get("fleet_placement_seconds").samples()
+    router.shutdown()
+    place_us = {k: (round(v * 1e6, 2) if k != "n" else v)
+                for k, v in _percentiles(placement).items()}
+
+    # failover TTFT: threaded fleet, replica 0 dies mid-prefill of the
+    # measured request; the health tick must detect, retry on replica 1,
+    # and deliver.  Baseline: same fleet shape, no death.
+    def one_trial(inject_death: bool) -> float:
+        engines = [mk(), mk()]
+        if inject_death:
+            engines[0].faults = F.FaultInjector(
+                [F.FaultRule("prefill", nth=1, crash=True)])
+        router = Router(engines, supervisor=EngineSupervisor(mk),
+                        threaded=True, health_interval=0.005,
+                        backoff_base=0.02)
+        try:
+            t0 = time.monotonic()
+            h = router.submit([1, 2, 3], 1)
+            h.result(timeout=60)
+            dt = time.monotonic() - t0
+            if inject_death:
+                assert h.hops == [0, 1], h.hops
+            return dt
+        finally:
+            router.shutdown(timeout=10)
+
+    baseline = sorted(one_trial(False) for _ in range(trials))
+    failover = sorted(one_trial(True) for _ in range(trials))
+    return {
+        "placement_overhead_us": place_us,
+        "baseline_first_token_s": round(_percentiles(baseline)["p50"], 5),
+        "failover_first_token_s": round(_percentiles(failover)["p50"], 5),
+        "failover_trials": trials,
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--schedules", type=int, default=25)
+    ap.add_argument("--seed", type=int, default=0,
+                    help="base seed (schedule i uses seed+i)")
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--requests", type=int, default=6,
+                    help="requests per schedule")
+    ap.add_argument("--threaded", action="store_true",
+                    help="background step/health threads instead of the "
+                         "deterministic pump")
+    ap.add_argument("--probe-every", type=int, default=5,
+                    help="run the fleet serving probe every Nth schedule")
+    ap.add_argument("--bench", action="store_true",
+                    help="run the router micro-bench instead of the soak")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    if args.bench:
+        out = run_bench()
+        print(json.dumps(out, indent=None if args.json else 2))
+        return 0
+
+    import numpy as np
+
+    from paddle_tpu.inference import faults as F
+
+    def mk():
+        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+
+    def ref(h):
+        return F.ScriptedEngine.reference_tokens(
+            h.prompt, h.max_new_tokens, h.eos_id)
+
+    reports, violations = [], 0
+    totals = {"fired": 0, "completed": 0, "failed": 0, "retried": 0,
+              "deaths": 0, "rebuilds": 0, "ejections": 0}
+    for i in range(args.schedules):
+        seed = args.seed + i
+        engine_rules, router_rules = F.fleet_random_schedule(
+            seed, n_replicas=args.replicas)
+        rng = np.random.default_rng(seed)
+        workload = [(rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
+                                  int(rng.integers(2, 9))).tolist(),
+                     int(rng.integers(2, 7)))
+                    for _ in range(args.requests)]
+        try:
+            report = F.fleet_run_schedule(
+                mk, engine_rules, router_rules, workload,
+                n_replicas=args.replicas, threaded=args.threaded,
+                reference=ref, probe=i % args.probe_every == 0)
+        except F.InvariantViolation as e:
+            violations += 1
+            report = {"ok": False, "violations": str(e),
+                      "schedule": {
+                          "engines": {r: [x.to_dict() for x in rules]
+                                      for r, rules in engine_rules.items()},
+                          "router": [x.to_dict() for x in router_rules]}}
+        report["seed"] = seed
+        reports.append(report)
+        if report["ok"]:
+            for k in ("completed", "failed", "retried"):
+                totals[k] += report[k]
+            totals["fired"] += len(report["fired"])
+            for k in ("deaths", "rebuilds", "ejections"):
+                totals[k] += report["stats"][k]
+        status = "ok " if report["ok"] else "LEAK"
+        line = f"[{status}] seed={seed}"
+        if report["ok"]:
+            line += (f" fired={len(report['fired'])}"
+                     f" completed={report['completed']}"
+                     f" failed={report['failed']}"
+                     f" retried={report['retried']}"
+                     f" deaths={report['stats']['deaths']}"
+                     f" rebuilds={report['stats']['rebuilds']}")
+        else:
+            line += f" violations={report['violations']}"
+        print(line)
+
+    summary = {"schedules": args.schedules, "replicas": args.replicas,
+               "violations": violations, **totals}
+    if args.json:
+        print(json.dumps({"summary": summary, "reports": reports},
+                         indent=2, default=str))
+    else:
+        print("\nfleet invariant report:", json.dumps(summary))
+        print("zero losses" if violations == 0
+              else f"{violations} schedule(s) VIOLATED fleet invariants")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
