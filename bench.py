@@ -379,6 +379,97 @@ def bench_decode(dev, on_tpu):
     }
 
 
+def bench_ragged(dev, on_tpu):
+    """extra.ragged: the unified ragged step's A/B — decode tokens/sec
+    and inter-token p99 for streaming requests while a LONG prompt
+    prefills concurrently, three ways:
+
+      * decode_only — no long prompt; the baseline the acceptance bound
+        pins (chunked p99 under prefill must stay <= 1.5x this).
+      * chunked     — the long prompt enters as bounded chunks riding the
+        SAME ragged dispatch as the decode spans (the shipped default).
+      * one_shot    — chunk budget >= the prompt, so the whole prefill
+        lands in one step: the old two-dispatch world's head-of-line
+        stall, reproduced inside the unified step for the A/B.
+
+    All three run ONE attention dispatch per step — there is no bucket
+    menu and no separate prefill executable to compile."""
+    import time as _time
+    import jax as _jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            dtype=jnp.bfloat16, remat=False)
+        long_len, new_tokens, page_size, chunk, max_seq = 2048, 64, 64, \
+            256, 4096
+    else:
+        cfg = LlamaConfig.tiny()
+        # chunk=4 from the tools/bench_ragged.py sweep: best stream p99
+        # (the budget adds at most one row block per step here); 48
+        # decode tokens x 3 streams so p99 is a percentile, not the max
+        long_len, new_tokens, page_size, chunk, max_seq = 40, 48, 4, 4, 64
+
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(2))
+    rng = np.random.default_rng(0)
+    long_prompt = rng.integers(0, cfg.vocab_size, long_len).tolist()
+    shorts = [rng.integers(0, cfg.vocab_size, 3).tolist()
+              for _ in range(3 if not on_tpu else 2)]
+
+    def run(chunk_tokens, inject_long):
+        eng = LLMEngine(params, cfg, num_slots=4, page_size=page_size,
+                        max_seq_len=max_seq,
+                        prefill_chunk_tokens=chunk_tokens, block_q=4)
+        eng.generate([[1, 2, 3]], max_new_tokens=2)   # warm the executable
+        hs = [eng.submit(p, max_new_tokens=new_tokens) for p in shorts]
+        for _ in range(3):
+            eng.step()               # streams decoding before the burst
+        t0 = _time.perf_counter()
+        if inject_long:
+            hs.append(eng.submit(long_prompt, max_new_tokens=2))
+        while not all(h.done() for h in hs):
+            eng.step()
+        dt = _time.perf_counter() - t0
+        snap = eng.stats_snapshot()
+        itl = eng.latency_snapshot()["inter_token_s"]
+        eng.shutdown()
+        return {
+            "chunk_tokens": chunk_tokens,
+            "decode_tokens_per_sec": round(snap["decode_tokens"] / dt, 2),
+            "itl_p50_ms": round((itl["p50"] or 0.0) * 1e3, 3),
+            "itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
+            "prefill_chunks": snap["prefill_chunks"],
+            "dispatches": snap["steps_total"],
+        }
+
+    decode_only = run(chunk, inject_long=False)
+    chunked = run(chunk, inject_long=True)
+    one_shot = run(long_len, inject_long=True)
+    base99 = decode_only["itl_p99_ms"]
+    chunk99 = chunked["itl_p99_ms"]
+    return {
+        "workload": {"streams": len(shorts), "long_prompt": long_len,
+                     "new_tokens": new_tokens},
+        "decode_only": decode_only,
+        "chunked": chunked,
+        "one_shot": one_shot,
+        # acceptance bound: p99 under concurrent prefill vs decode-only
+        # (<= 1.5 means a long prompt cannot wreck in-flight latency)
+        "itl_p99_vs_decode_only": (round(chunk99 / base99, 3)
+                                   if base99 else None),
+        # the interleaving win: what one-shot prefill (the old world's
+        # head-of-line stall) costs relative to chunked
+        "one_shot_vs_chunked_p99": (round(one_shot["itl_p99_ms"]
+                                          / chunk99, 3)
+                                    if chunk99 else None),
+    }
+
+
 def _engine_lifecycle_counters():
     """LLMEngine preemption/lifecycle counters + request latency
     percentiles on a deliberately undersized page pool (2 slots whose
@@ -542,7 +633,8 @@ def _run_sub(name: str, timeout: "float | None" = None) -> dict:
 def _sub_main(name: str) -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
-    fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode}[name]
+    fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode,
+          "ragged": bench_ragged}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
     except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
@@ -630,6 +722,7 @@ def main():
     dit_extra = _run_sub("dit")
     moe_extra = _run_sub("moe")
     decode_extra = _run_sub("decode")
+    ragged_extra = _run_sub("ragged")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
@@ -672,6 +765,9 @@ def main():
             "moe": moe_extra,
             # serving decode throughput: paged KV + Pallas paged attention
             "decode": decode_extra,
+            # unified ragged prefill+decode: ITL-under-concurrent-prefill
+            # A/B (chunked vs one-shot vs decode-only baseline)
+            "ragged": ragged_extra,
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
             "graphlint": graphlint_extra,

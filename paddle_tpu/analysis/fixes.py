@@ -200,6 +200,9 @@ def _const_capture_patch(f: Finding) -> Patch:
 
 
 def _bucket_patch(f: Finding) -> Patch:
+    # DEPRECATED alongside lint_bucket_menu: LLMEngine's unified ragged
+    # step retired the menu, but saved reports carrying the code must
+    # still render a patch
     menu = f.data.get("menu")
     suggested = f.data.get("suggested_menu")
     if suggested is None:
@@ -211,8 +214,9 @@ def _bucket_patch(f: Finding) -> Patch:
     return Patch(
         title="edit the prefill bucket menu",
         codes=[f.code], eqn_paths=[f.eqn_path], diff=diff,
-        note="pass prefill_buckets=... to LLMEngine (and re-lint with "
-             "expected_prompt_lens to confirm the straddle is gone)",
+        note="edit the call site's bucket menu and re-run "
+             "lint_bucket_menu to confirm the straddle is gone (LLMEngine "
+             "itself no longer buckets: its ragged step is one signature)",
         target=diff)
 
 

@@ -528,6 +528,13 @@ def lint_bucket_menu(menu: Sequence[int], workload_lens: Sequence[int],
                      config: Optional[dict] = None) -> Report:
     """Lint a prefill bucket menu against an expected workload.
 
+    DEPRECATED: LLMEngine no longer buckets prefill at all — the unified
+    ragged step (kernels/pallas_ragged_attention.py) serves every prompt
+    length through ONE compiled signature, so there is no menu to plan.
+    The lint (and its RECOMPILE_BUCKET_MISS code + fix patch) stays
+    loadable for anything still bucketing static shapes by hand, and so
+    saved reports / `.graphlintrc` suppressions keep parsing.
+
     Every distinct bucket is one compiled executable; every token of
     padding is wasted prefill compute.  A workload whose lengths STRADDLE
     a bucket edge (all lengths in the upper bucket sit within
@@ -535,8 +542,7 @@ def lint_bucket_menu(menu: Sequence[int], workload_lens: Sequence[int],
     near-identical requests compile twice and the longer ones pad nearly
     2x.  Emits RECOMPILE_BUCKET_MISS with the concrete menu edit (merge
     the two buckets into one sized to the real lengths, aligned to
-    `bucket_align`).  LLMEngine runs this at construction when handed
-    `expected_prompt_lens`.
+    `bucket_align`).
     """
     ctx = HLOContext(stablehlo="", options=dict(options or {}))
     menu = sorted(set(int(b) for b in menu))

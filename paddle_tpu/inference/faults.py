@@ -40,9 +40,13 @@ __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
 
 # the engine's named injection points, in rough lifecycle order ("step"
 # wraps the whole step loop: a crash=True rule there kills the step
-# THREAD, not just one request — replica death)
-FAULT_POINTS = ("step", "prefill", "decode", "page_alloc", "sample",
-                "swap_out", "swap_in")
+# THREAD, not just one request — replica death).  "prefill" fires once
+# per prefill span scheduled into a ragged batch; "prefill_chunk" fires
+# right after it with the chunk's (tokens, start) context — a rule there
+# kills a request mid-chunked-prefill; "decode" fires once per unified
+# ragged dispatch (the ONE attention dispatch of a mixed step).
+FAULT_POINTS = ("step", "prefill", "prefill_chunk", "decode",
+                "page_alloc", "sample", "swap_out", "swap_in")
 
 # the Router's named injection points — fleet-tier failure shapes.
 #   replica_death:    fired per replica on each health tick; a match makes
@@ -63,7 +67,8 @@ FLEET_FAULT_POINTS = ("replica_death", "slow_replica", "health_flap",
 
 # points where a `consume_pools` rule is meaningful: the engine passes its
 # (to-be-donated or read) pools in the fire() context there
-_DISPATCH_POINTS = ("prefill", "decode", "swap_out", "swap_in")
+_DISPATCH_POINTS = ("prefill", "prefill_chunk", "decode", "swap_out",
+                    "swap_in")
 
 
 class InjectedFault(RuntimeError):
@@ -310,6 +315,16 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
                 f"completed+cancelled+timed_out+failed={outcomes} (a "
                 "request leaked out of, or was double-counted into, the "
                 "terminal counters)")
+    if "ragged_batch_tokens" in snap:
+        # every valid token of every ragged dispatch is either a decode
+        # span's token or part of a prefill chunk — counted in one place,
+        # so drift means a batch was built and accounted inconsistently
+        ragged = snap["ragged_batch_tokens"]
+        parts = snap.get("decode_tokens", 0) + snap.get("prefill_tokens", 0)
+        if ragged != parts:
+            violations.append(
+                f"ragged token identity broken: ragged_batch_tokens="
+                f"{ragged} != decode_tokens+prefill_tokens={parts}")
     if registry is not None:
         for key, val in reg_vals.items():
             if val is None:
@@ -428,11 +443,12 @@ class ScriptedEngine(_llm.LLMEngine):
     deterministic numpy script — no weights, no jit, no device dispatch.
 
     Everything the fleet tier exercises is the genuine article: admission,
-    bucketing, page allocation, preemption (swap and recompute), deadlines,
-    cancellation, shutdown, the metrics registry, and every fault point.
-    Only the five compute callables (_prefill/_decode/_swap_out/_swap_in/
-    _sample) are replaced, which makes a step pure python — fast enough
-    that tier-1 can afford whole-fleet chaos schedules.
+    chunked ragged scheduling, page allocation, preemption (swap and
+    recompute, including mid-prefill victims), deadlines, cancellation,
+    shutdown, the metrics registry, and every fault point.  Only the four
+    compute callables (_ragged/_swap_out/_swap_in/_sample) are replaced,
+    which makes a step pure python — fast enough that tier-1 can afford
+    whole-fleet chaos schedules.
 
     `reference_tokens()` is the token-exactness oracle: what a single
     healthy engine produces for a prompt, hence what the fleet must
@@ -448,22 +464,28 @@ class ScriptedEngine(_llm.LLMEngine):
                          **kw)
         V = cfg.vocab_size
 
-        def fake_prefill(params, ids, k_pool, v_pool, pt_row, true_len):
-            n = int(true_len)
-            seq = [int(t) for t in np.asarray(ids)[0, :n]]
-            logits = np.zeros((1, V), np.float32)
-            logits[0, _script_next(seq, V)] = 1.0
+        def fake_ragged(params, tok, row_page, row_off, row_pos,
+                        block_seq, block_qpos, span_len, ctx_len, span_pt,
+                        out_rows, k_pool, v_pool):
+            # logits row i belongs to span i of engine._batch_spans; only
+            # spans that SAMPLE (decode, or a chunk completing a fresh
+            # prefill) are consumed, and for those the scripted next
+            # token is a pure function of the tokens cached after the
+            # span — exactly what the real kernel's span-end logits see
+            logits = np.zeros((self._num_spans, V), np.float32)
+            for i, (slot, kind, n) in enumerate(self._batch_spans):
+                st = self._slots.get(slot)
+                if st is None:
+                    continue
+                if kind == "decode":
+                    seq = [int(t) for t in st.req.prompt] \
+                        + list(st.req.tokens)
+                else:
+                    seq = [int(t) for t in st.pending[:st.ctx + n]]
+                logits[i, _script_next(seq, V)] = 1.0
             return logits, k_pool, v_pool
 
-        def fake_decode(params, toks, ctx, page_table, k_pool, v_pool):
-            logits = np.zeros((self.cache.max_slots, V), np.float32)
-            for slot, st in self._slots.items():
-                seq = [int(t) for t in st.req.prompt] + list(st.req.tokens)
-                logits[slot, _script_next(seq, V)] = 1.0
-            return logits, {"k": k_pool, "v": v_pool}
-
-        self._prefill = fake_prefill
-        self._decode = fake_decode
+        self._ragged = fake_ragged
         self._swap_out = lambda k, v, idx: (np.zeros((1,), np.float32),
                                             np.zeros((1,), np.float32))
         self._swap_in = lambda k, v, idx, hk, hv: (k, v)

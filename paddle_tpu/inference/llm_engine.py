@@ -10,24 +10,34 @@ instead of reserving worst-case pages up front.
 Engine anatomy:
   * `PagedKVCache` (models/generation.py) — page pools + page tables;
     each admitted request owns a decode slot and that slot's pages.
-  * admission — pending requests enter free slots mid-flight; only the
-    PROMPT's pages are reserved (admit-on-demand).  The prompt is
-    prefilled through the dense flash path (bucketed to the next
-    power-of-two length) and scattered into the slot's pages.
-  * decode — ONE jitted step advances every active slot through the
-    Pallas paged-attention kernel; empty slots point at the reserved
-    scratch page and their logits are ignored.  The incoming token's page
-    is allocated on demand, and may FAIL under pressure.
-  * preemption — when mid-decode allocation fails, a victim is picked
+  * admission — pending requests enter free slots mid-flight with NO
+    dispatch of their own: a fresh request just starts its prompt as a
+    ragged prefill that the next unified step advances chunk by chunk.
+  * the unified ragged step — each step builds ONE ragged batch: every
+    decoding slot contributes a 1-token span and prefilling slots
+    contribute bounded chunks admitted under a per-step token budget
+    (`prefill_chunk_tokens`), all through ONE dispatch of the Pallas
+    ragged-attention kernel (kernels/pallas_ragged_attention.py) over
+    the paged pools.  The batch arrays are FIXED-SHAPE, so steady state
+    is O(1) compiled executables — there is no prefill bucket menu and
+    no per-prompt-length recompile class at all.  Prefill chunks
+    interleave with decode, so a long prompt never stalls other
+    requests' inter-token latency for more than one chunk.
+  * page allocation is on demand per span (chunk or decode token) and
+    may FAIL under pressure.
+  * preemption — when mid-step allocation fails, a victim is picked
     (`victim_policy`: "latest" admitted, or "fewest_tokens" generated),
     its pages are released, and the request re-enters the HEAD of the
     pending deque carrying either a host copy of its KV pages
-    (`preempt_mode="swap"`: gather at preempt, scatter back on resume) or
-    nothing (`preempt_mode="recompute"`: prompt + generated-so-far is
-    re-prefilled through the same bucketed prefill path on resume).  The
-    LAST runnable sequence is never preempted — and a single request's
-    worst case is validated against the pool at submit() — so forward
-    progress is deadlock-free.
+    (`preempt_mode="swap"`: gather at preempt, scatter back on resume)
+    or nothing (`preempt_mode="recompute"`: the whole context — prompt
+    plus generated-so-far — is simply appended to later ragged batches
+    as chunked spans; resume IS a ragged prefill).  Mid-prefill victims
+    are preemptible too: swap carries the chunks already cached,
+    recompute starts the prompt over.  The LAST runnable sequence is
+    never preempted — and a single request's worst case is validated
+    against the pool at submit() — so forward progress is
+    deadlock-free.
   * eviction — on EOS / max_new_tokens / cancel() / deadline expiry the
     slot's pages return to the free pool and the slot re-enters admission.
 
@@ -105,17 +115,25 @@ class DeadlineExceeded(TimeoutError):
 
 
 class _ResumeState:
-    """What a preempted request needs to re-enter a slot: decode position,
-    the sampled-but-not-yet-cached token, how many pages it held, and (swap
-    mode only) host copies of those pages' KV."""
+    """What a preempted request needs to re-enter a slot: cached-token
+    count, the sampled-but-not-yet-cached token (None mid-prefill), how
+    many pages it held, the not-yet-cached span still to prefill
+    (`pending`, None once prefill finished), whether finishing that
+    prefill should sample a first token, and (swap mode only) host copies
+    of the cached pages' KV.  In recompute mode ctx is 0 and `pending`
+    holds the WHOLE context — resume is just a ragged prefill."""
 
-    __slots__ = ("ctx", "last_tok", "n_pages", "host_k", "host_v")
+    __slots__ = ("ctx", "last_tok", "n_pages", "pending",
+                 "sample_on_finish", "host_k", "host_v")
 
-    def __init__(self, ctx: int, last_tok: int, n_pages: int,
+    def __init__(self, ctx: int, last_tok: Optional[int], n_pages: int,
+                 pending=None, sample_on_finish: bool = False,
                  host_k=None, host_v=None):
         self.ctx = ctx
         self.last_tok = last_tok
         self.n_pages = n_pages
+        self.pending = pending
+        self.sample_on_finish = sample_on_finish
         self.host_k = host_k
         self.host_v = host_v
 
@@ -200,12 +218,27 @@ class _Request:
 
 
 class _SlotState:
-    def __init__(self, req: _Request, last_tok: int, ctx: int,
-                 admit_seq: int):
+    """One occupied decode slot.  A slot is PREFILLING while `pending`
+    still holds uncached tokens (ctx < pending.size) and DECODING once
+    pending is None — then `last_tok` is the sampled-but-not-yet-cached
+    token the next 1-token span will write at position ctx."""
+
+    def __init__(self, req: _Request, admit_seq: int, ctx: int = 0,
+                 last_tok: Optional[int] = None, pending=None,
+                 sample_on_finish: bool = True):
         self.req = req
-        self.last_tok = last_tok    # sampled, not yet in the cache
-        self.ctx = ctx              # tokens currently cached
         self.admit_seq = admit_seq  # admission order (victim policy)
+        self.ctx = ctx              # tokens currently cached
+        self.last_tok = last_tok    # sampled, not yet in the cache
+        self.pending = pending      # np.int32 tokens still to prefill
+        # sample a first token when prefill completes?  True for fresh
+        # prompts; False for recompute-resume (its next token was already
+        # sampled before the preemption)
+        self.sample_on_finish = sample_on_finish
+
+    @property
+    def prefilling(self) -> bool:
+        return self.pending is not None and self.ctx < self.pending.size
 
 
 class _StatsDict(collections.abc.MutableMapping):
@@ -222,8 +255,12 @@ class _StatsDict(collections.abc.MutableMapping):
         "accepted": "requests accepted by submit() (queued or better)",
         "admitted": "fresh admissions prefillled into a slot",
         "completed": "requests finished with tokens",
-        "decode_steps": "batched decode dispatches",
-        "decode_tokens": "tokens produced by decode dispatches",
+        "decode_steps": "ragged dispatches advancing >=1 decoding slot",
+        "decode_tokens": "tokens produced by decode spans",
+        "prefill_chunks": "prefill chunk spans dispatched",
+        "prefill_tokens": "prompt/context tokens prefilled via chunks",
+        "ragged_batch_tokens": "total valid tokens across ragged "
+                               "dispatches (decode + prefill spans)",
         "preemptions": "victims evicted under page pressure",
         "swapped_in": "preempted requests resumed via host-KV scatter",
         "resumed": "preempted requests re-admitted (either mode)",
@@ -276,21 +313,6 @@ class _StatsDict(collections.abc.MutableMapping):
         return len(self._counters)
 
 
-def default_prefill_buckets(max_seq_len: int, rope_len: int,
-                            lo: int = 8) -> List[int]:
-    """The engine's default prefill compile menu: powers of two from `lo`
-    up to max_seq_len, the top bucket clamped to the rope table (a
-    non-power-of-2 max_position_embeddings would otherwise over-slice
-    it).  Every distinct bucket is one compiled prefill executable."""
-    menu, b = [], lo
-    while True:
-        menu.append(min(b, rope_len))
-        if b >= max_seq_len:
-            break
-        b *= 2
-    return sorted(set(menu))
-
-
 class LLMEngine:
     """Continuous-batching generation engine (queue -> slots -> tokens).
 
@@ -301,25 +323,25 @@ class LLMEngine:
     footprint still serves the worst case correctly, just slower.
 
     preempt_mode: "swap" (KV pages copied to host at preempt, scattered
-    back on resume) or "recompute" (prompt+generated re-prefilled on
-    resume).  victim_policy: "latest" (latest-admitted) or "fewest_tokens"
-    (least work lost).  max_pending bounds the queue (QueueFull beyond).
+    back on resume) or "recompute" (the whole context re-enters later
+    ragged batches as chunked prefill spans).  victim_policy: "latest"
+    (latest-admitted) or "fewest_tokens" (least work lost).  max_pending
+    bounds the queue (QueueFull beyond).
     faults: an optional paddle_tpu.inference.faults.FaultInjector.
     tracer: a paddle_tpu.obs.Tracer (default: the process-wide tracer,
     disabled until enabled — instrumentation is then a no-op branch).
     metrics: a paddle_tpu.obs.Registry (default: a fresh per-engine
     registry; serve_llm's GET /metrics renders it).
 
-    prefill_buckets: the prefill COMPILE MENU — every prompt (and every
-    recompute-resume) right-pads to the smallest bucket >= its length,
-    so each distinct bucket is exactly one compiled prefill executable.
-    Default: powers of two up to max_seq_len (top clamped to the rope
-    table).  expected_prompt_lens: an optional workload sample; when
-    given, the menu is LINTED at construction (analysis.lint_bucket_menu)
-    and lengths straddling a bucket edge raise a RECOMPILE_BUCKET_MISS
-    warning carrying the suggested menu edit (`engine.bucket_report`
-    holds the full report; `prefill_probe_args()` feeds the same menu to
-    the Graph Doctor's shape-poly probe).
+    prefill_chunk_tokens: the per-step TOKEN BUDGET for prefill chunks
+    riding the unified ragged batch alongside decode spans.  Smaller =
+    tighter inter-token latency for in-flight requests under concurrent
+    prefill; larger = faster time-to-first-token for new prompts.  The
+    ragged batch is sized at construction (num_slots decode rows plus
+    this budget, block_q-aligned), so the step stays ONE compiled
+    executable regardless of prompt lengths — there is no bucket menu.
+    block_q: the kernel's query row-block size; every span occupies
+    whole blocks (a decode span pads one block).
     """
 
     def __init__(self, params, config, num_slots: int = 4,
@@ -330,8 +352,8 @@ class LLMEngine:
                  preempt_mode: str = "swap",
                  victim_policy: str = "latest",
                  faults=None,
-                 prefill_buckets: Optional[Sequence[int]] = None,
-                 expected_prompt_lens: Optional[Sequence[int]] = None,
+                 prefill_chunk_tokens: int = 64,
+                 block_q: int = 8,
                  tracer: Optional[obs_trace.Tracer] = None,
                  metrics: Optional[obs_metrics.Registry] = None):
         self.params = params
@@ -352,36 +374,18 @@ class LLMEngine:
         self.victim_policy = victim_policy
         self.max_pending = None if max_pending is None else int(max_pending)
         self.faults = faults
-        rope_len = config.max_position_embeddings
-        if prefill_buckets is None:
-            self.prefill_buckets = default_prefill_buckets(
-                self.max_seq_len, rope_len)
-        else:
-            self.prefill_buckets = sorted({int(b) for b in prefill_buckets})
-            if not self.prefill_buckets:
-                raise ValueError("prefill_buckets must not be empty")
-            if self.prefill_buckets[-1] < self.max_seq_len:
-                raise ValueError(
-                    f"largest prefill bucket {self.prefill_buckets[-1]} < "
-                    f"max_seq_len={self.max_seq_len}: a worst-case resume "
-                    "could not re-prefill")
-            if self.prefill_buckets[-1] > rope_len:
-                raise ValueError(
-                    f"prefill bucket {self.prefill_buckets[-1]} exceeds the "
-                    f"rope table (max_position_embeddings={rope_len})")
-        self.bucket_report = None
-        if expected_prompt_lens is not None:
-            from .. import analysis
-
-            self.bucket_report = analysis.lint_bucket_menu(
-                self.prefill_buckets, expected_prompt_lens,
-                options={"bucket_align": max(4, int(page_size))})
-            for f in self.bucket_report:
-                if f.severity >= analysis.Severity.WARNING:
-                    import warnings
-
-                    warnings.warn(f"LLMEngine bucket menu: {f}",
-                                  stacklevel=2)
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        if self.prefill_chunk_tokens < 1:
+            raise ValueError("prefill_chunk_tokens must be >= 1")
+        self.block_q = int(block_q)
+        if self.block_q < 1:
+            raise ValueError("block_q must be >= 1")
+        # the ragged batch's fixed geometry: every decoding slot takes one
+        # row block, prefill chunks take ceil(budget / block_q) more —
+        # sized once here, so the unified step is ONE compiled executable
+        self._num_blocks = num_slots \
+            + -(-self.prefill_chunk_tokens // self.block_q)
+        self._num_spans = num_slots + 1      # + the padding span
         pages_per_seq = -(-self.max_seq_len // page_size)
         if num_pages is None:
             num_pages = 1 + num_slots * pages_per_seq   # full provisioning
@@ -410,7 +414,8 @@ class LLMEngine:
                 "give each engine its own Registry")
         self.stats = _StatsDict(self.metrics, (
             "accepted", "admitted", "completed", "decode_steps",
-            "decode_tokens", "preemptions", "swapped_in", "resumed",
+            "decode_tokens", "prefill_chunks", "prefill_tokens",
+            "ragged_batch_tokens", "preemptions", "swapped_in", "resumed",
             "cancelled", "timed_out", "failed", "steps_total"))
         reg = self.metrics
         self._h_queue_wait = reg.histogram(
@@ -442,34 +447,29 @@ class LLMEngine:
 
         cfg = config
 
-        # pools are DONATED: the caller always replaces cache.pools with the
-        # result, so XLA updates the page pool in place instead of copying
-        # the whole (L, P, ps, Hkv, D) cache every token (donation is a
-        # no-op on CPU, where jax ignores it with a one-time warning)
-        @functools.partial(jax.jit, donate_argnums=(4, 5))
-        def _decode(params, tok, ctx, page_table, k_pool, v_pool):
-            return generation.forward_paged_decode(
-                params, tok, cfg, {"k": k_pool, "v": v_pool},
-                page_table, ctx)
+        # THE unified step: one dispatch per engine iteration, decode
+        # spans and prefill chunks in the same ragged batch.  Pools are
+        # DONATED: the caller always replaces cache.pools with the
+        # result, so XLA updates the page pool in place instead of
+        # copying the whole (L, P, ps, Hkv, D) cache every token
+        # (donation is a no-op on CPU, where jax ignores it with a
+        # one-time warning).  All batch arrays are fixed-shape, so this
+        # compiles exactly once — no bucket menu, no recompiles.
+        @functools.partial(jax.jit, donate_argnums=(11, 12))
+        def _ragged(params, tok, row_page, row_off, row_pos, block_seq,
+                    block_qpos, span_len, ctx_len, span_pt, out_rows,
+                    k_pool, v_pool):
+            logits, pools = generation.forward_ragged(
+                params, tok, cfg, {"k": k_pool, "v": v_pool}, row_page,
+                row_off, row_pos, block_seq, block_qpos, span_len,
+                ctx_len, span_pt, out_rows)
+            return logits, pools["k"], pools["v"]
 
-        self._decode = _decode
-
-        @functools.partial(jax.jit, donate_argnums=(2, 3))
-        def _prefill(params, ids, k_pool, v_pool, pt_row, true_len):
-            # ids: (1, Sb) RIGHT-padded to the bucket; causal attention
-            # keeps positions < true_len independent of the padding, and
-            # padded positions scatter into the scratch page
-            dense = generation.init_kv_cache(cfg, 1, ids.shape[1])
-            logits, dense = generation.forward_with_cache(
-                params, ids, cfg, dense, 0)
-            pools = generation.scatter_prefill_into_pages(
-                dense, {"k": k_pool, "v": v_pool}, pt_row, ids.shape[1],
-                true_len=true_len[None])
-            last = jnp.take_along_axis(
-                logits, jnp.reshape(true_len - 1, (1, 1, 1)), axis=1)[:, 0]
-            return last, pools["k"], pools["v"]
-
-        self._prefill = _prefill
+        self._ragged = _ragged
+        # the span descriptors of the batch being dispatched, in logits
+        # row order: (slot, kind, n_tokens) — ScriptedEngine's fake
+        # compute and the one-dispatch tests read this
+        self._batch_spans: List[tuple] = []
 
         # swap path: page gather (preempt) reads the pools — NOT donated;
         # page scatter (resume) replaces them — donated like decode.  idx
@@ -492,36 +492,31 @@ class LLMEngine:
 
         self._swap_in = _swap_in
 
-    def _bucket_for(self, n: int) -> int:
-        """Smallest menu bucket >= n (exists: the menu covers
-        max_seq_len, and submit() validates n <= max_seq_len)."""
-        for b in self.prefill_buckets:
-            if b >= n:
-                return b
-        return self.prefill_buckets[-1]
-
-    def prefill_probe_args(self) -> List[tuple]:
-        """One abstract `_prefill` arg tuple per menu bucket — the Graph
-        Doctor's shape-poly probe: `analysis.analyze(engine._prefill,
-        *args[0], probe_args=args[1:], options={"expected_signatures":
-        len(engine.prefill_buckets)})` passes while the menu's compiles
-        are the ONLY distinct signatures.  The gate is COUNT-based: to
-        lint real traffic, probe the real call sites TOGETHER with this
-        full menu (any signature outside the menu then exceeds the
-        expected count and fires RECOMPILE_SHAPE_POLY)."""
+    def ragged_probe_args(self) -> tuple:
+        """The ONE abstract `_ragged` arg tuple — the Graph Doctor's
+        shape-poly probe.  Unlike the retired bucket menu (one compiled
+        prefill per bucket), the unified step has a single signature:
+        `analysis.analyze(engine._ragged, *engine.ragged_probe_args())`
+        must stay clean with the default expected_signatures=1."""
         pools = self.cache.pools
-        out = []
-        for b in self.prefill_buckets:
-            out.append((
-                self.params,
-                jax.ShapeDtypeStruct((1, b), jnp.int32),
-                jax.ShapeDtypeStruct(pools["k"].shape, pools["k"].dtype),
-                jax.ShapeDtypeStruct(pools["v"].shape, pools["v"].dtype),
-                jax.ShapeDtypeStruct((1, self.cache.pages_per_seq),
-                                     jnp.int32),
-                jax.ShapeDtypeStruct((), jnp.int32),
-            ))
-        return out
+        T = self._num_blocks * self.block_q
+        S = self._num_spans
+        i32 = jnp.int32
+        return (
+            self.params,
+            jax.ShapeDtypeStruct((T,), i32),                 # tok
+            jax.ShapeDtypeStruct((T,), i32),                 # row_page
+            jax.ShapeDtypeStruct((T,), i32),                 # row_off
+            jax.ShapeDtypeStruct((T,), i32),                 # row_pos
+            jax.ShapeDtypeStruct((self._num_blocks,), i32),  # block_seq
+            jax.ShapeDtypeStruct((self._num_blocks,), i32),  # block_qpos
+            jax.ShapeDtypeStruct((S,), i32),                 # span_len
+            jax.ShapeDtypeStruct((S,), i32),                 # ctx_len
+            jax.ShapeDtypeStruct((S, self.cache.pages_per_seq), i32),
+            jax.ShapeDtypeStruct((S,), i32),                 # out_rows
+            jax.ShapeDtypeStruct(pools["k"].shape, pools["k"].dtype),
+            jax.ShapeDtypeStruct(pools["v"].shape, pools["v"].dtype),
+        )
 
     # -- client surface -----------------------------------------------------
 
@@ -638,9 +633,11 @@ class LLMEngine:
     def step(self) -> bool:
         """One engine iteration: reap cancelled/expired requests, admit
         pending requests into free slots (resuming preempted ones first —
-        they re-enter at the queue head), advance every active slot one
-        token (preempting victims when page allocation fails), evict
-        finished sequences.  Returns True when any work was done."""
+        they re-enter at the queue head), then advance EVERY active slot
+        through ONE ragged dispatch — decode spans and prefill chunks in
+        the same batch (preempting victims when page allocation fails) —
+        and evict finished sequences.  Returns True when any work was
+        done."""
         self.stats["steps_total"] += 1
         # named fault point for the step loop itself: an InjectedFault
         # here is caught by _loop's backstop (fails in-flight, keeps
@@ -651,8 +648,8 @@ class LLMEngine:
         with self.tracer.span("engine_step"):
             reaped = self._reap()
             admitted = self._admit()
-            decoded = self._decode_step()
-        return reaped or admitted or decoded
+            stepped = self._ragged_step()
+        return reaped or admitted or stepped
 
     def start(self):
         """Run the engine loop in a background thread (serving mode)."""
@@ -815,17 +812,37 @@ class LLMEngine:
 
     def _preempt(self, slot: int) -> None:
         """Release a victim's pages and re-queue it at the HEAD of the
-        pending deque, carrying a host copy of its KV pages (swap mode) or
-        nothing (recompute mode)."""
+        pending deque, carrying a host copy of its KV pages (swap mode)
+        or nothing (recompute mode: the whole context re-enters as a
+        ragged prefill).  Mid-prefill victims are handled the same way —
+        swap carries the chunks already cached, recompute starts the
+        span over."""
         cache = self.cache
         st = self._slots.pop(slot)
         pages = list(cache._slot_pages[slot])
-        rs = _ResumeState(ctx=st.ctx, last_tok=st.last_tok,
-                          n_pages=len(pages))
+        if self.preempt_mode == "swap":
+            rs = _ResumeState(ctx=st.ctx, last_tok=st.last_tok,
+                              n_pages=len(pages), pending=st.pending,
+                              sample_on_finish=st.sample_on_finish)
+        elif st.pending is not None:
+            # recompute, mid-prefill: nothing sampled yet past `pending`;
+            # the whole span just re-prefills from scratch
+            rs = _ResumeState(ctx=0, last_tok=st.last_tok, n_pages=0,
+                              pending=st.pending,
+                              sample_on_finish=st.sample_on_finish)
+        else:
+            # recompute, decoding: the cached context is prompt + all
+            # generated tokens except the still-pending one — resume
+            # appends it to later ragged batches as chunked spans
+            ids = np.concatenate(
+                [st.req.prompt, np.asarray(st.req.tokens[:-1], np.int32)])
+            rs = _ResumeState(ctx=0, last_tok=st.last_tok, n_pages=0,
+                              pending=ids, sample_on_finish=False)
         self.tracer.instant("preempt", slot=slot, ctx=st.ctx,
-                            mode=self.preempt_mode)
+                            mode=self.preempt_mode,
+                            mid_prefill=st.prefilling)
         try:
-            if self.preempt_mode == "swap":
+            if self.preempt_mode == "swap" and pages:
                 with self.tracer.span("swap_out", slot=slot,
                                       pages=len(pages)):
                     self._fire("swap_out", slot=slot, pools=cache.pools)
@@ -851,6 +868,10 @@ class LLMEngine:
             self.stats["preemptions"] += 1
 
     def _admit(self) -> bool:
+        """Move pending requests into free slots.  Admission itself
+        dispatches NOTHING for fresh and recompute-resumed requests —
+        their tokens enter the next unified ragged batch as chunked
+        spans; only a swap-resume scatters its host KV copy back."""
         cache = self.cache
         progress = False
         while True:
@@ -859,8 +880,12 @@ class LLMEngine:
                     break
                 req = self._pending[0]
                 rs = req._resume
-                need = (rs.n_pages if rs is not None
-                        else cache.pages_needed(req.prompt.size))
+                if rs is not None and rs.host_k is not None:
+                    need = rs.n_pages
+                else:
+                    pend = (rs.pending if rs is not None else req.prompt)
+                    need = cache.pages_needed(
+                        min(pend.size, self.prefill_chunk_tokens))
                 if need > cache.free_page_count:
                     break  # head-of-line waits for pages (no reordering)
                 self._pending.popleft()
@@ -879,84 +904,50 @@ class LLMEngine:
                     if rs is not None:
                         self._resume_into(slot, req, rs)
                     else:
-                        self._prefill_into(slot, req)
+                        if req.t_admit is None:
+                            req.t_admit = time.monotonic()
+                            self._h_queue_wait.observe(
+                                req.t_admit - req.t_submit)
+                        self._slots[slot] = _SlotState(
+                            req, self._admit_seq, ctx=0,
+                            pending=req.prompt, sample_on_finish=True)
+                        with self._cv:
+                            self.stats["admitted"] += 1
             except Exception as e:  # noqa: BLE001 — admission must not leak
                 # the request left _pending but never (or only briefly)
                 # reached _slots: without cleanup the slot and its pages
                 # leak forever and result() blocks until timeout.  Release
                 # both, resolve the handle with the error, and keep
-                # admitting — a per-request failure (e.g. a prefill OOM at
-                # this bucket size) must not wedge the engine.
+                # admitting — a per-request failure must not wedge the
+                # engine.
                 self._slots.pop(slot, None)
                 if slot in cache._slot_pages:
                     cache.release_slot(slot)
                 with self._cv:
                     self.stats["failed"] += 1
                 req._resolve(e)
-                # _prefill/_swap_in DONATE the pools: a dispatch that fails
-                # after donation has already consumed them (TPU; CPU
-                # ignores donation), and every later prefill/decode would
-                # die on deleted buffers.  Re-zero the pools and fail the
-                # slots whose KV lived in them.
+                # _swap_in DONATES the pools: a dispatch that fails after
+                # donation has already consumed them (TPU; CPU ignores
+                # donation), and every later dispatch would die on
+                # deleted buffers.  Re-zero the pools and fail the slots
+                # whose KV lived in them.
                 self._recover_pools(e)
             progress = True
         return progress
 
-    def _prefill_into(self, slot: int, req: _Request) -> None:
-        """Fresh admission: reserve the prompt's pages only (admit-on-
-        demand), prefill, sample the first token."""
-        cache = self.cache
-        S = req.prompt.size
-        self._fire("page_alloc", slot=slot, n_tokens=S)
-        cache.ensure_capacity(slot, S)
-        if req.t_admit is None:     # first admission only (not resume)
-            req.t_admit = time.monotonic()
-            self._h_queue_wait.observe(req.t_admit - req.t_submit)
-        # menu lookup (the default menu's top bucket is clamped to the
-        # rope table — a non-pow2 max_position_embeddings would
-        # otherwise over-slice it)
-        Sb = self._bucket_for(S)
-        ids = np.zeros((1, Sb), np.int32)
-        ids[0, :S] = req.prompt
-        with self.tracer.span("prefill", slot=slot, tokens=S,
-                              bucket=Sb) as sp:
-            self._fire("prefill", slot=slot, pools=cache.pools)
-            last, k_pool, v_pool = self._prefill(
-                self.params, jnp.asarray(ids), cache.pools["k"],
-                cache.pools["v"], cache.page_table[slot][None],
-                jnp.int32(S))
-            sp.fence((last, k_pool))
-        cache.pools = {"k": k_pool, "v": v_pool}
-        with self.tracer.span("sample", slot=slot):
-            self._fire("sample", slot=slot)
-            tok = int(np.asarray(self._sample(last))[0])
-        req.tokens.append(tok)
-        now = time.monotonic()
-        if req.t_first_token is None:
-            req.t_first_token = now
-            self._h_ttft.observe(now - req.t_submit)
-        req.t_last_token = now
-        with self._cv:
-            self.stats["admitted"] += 1
-        if (req.eos_id is not None and tok == req.eos_id) \
-                or req.max_new_tokens == 1:
-            self._finish(slot, req)
-        else:
-            self._slots[slot] = _SlotState(req, tok, ctx=S,
-                                           admit_seq=self._admit_seq)
-
     def _resume_into(self, slot: int, req: _Request,
                      rs: _ResumeState) -> None:
-        """Re-admit a preempted request: reallocate its page count, then
-        either scatter the host KV copy back (swap) or re-prefill
-        prompt+generated-so-far (recompute).  Token-exact either way: the
-        cache ends bit-identical (swap) or recomputed through the same
-        prefill math the fresh path uses (recompute)."""
+        """Re-admit a preempted request.  Swap mode reallocates its page
+        count and scatters the host KV copy back (bit-identical cache);
+        recompute mode just installs the whole context as the slot's
+        pending span — the next ragged batches re-prefill it through the
+        SAME chunked math a fresh prompt uses, so both modes stay
+        token-exact."""
         cache = self.cache
-        self._fire("page_alloc", slot=slot,
-                   n_tokens=rs.n_pages * cache.page_size)
-        cache.ensure_capacity(slot, rs.n_pages * cache.page_size)
         if rs.host_k is not None:
+            self._fire("page_alloc", slot=slot,
+                       n_tokens=rs.n_pages * cache.page_size)
+            cache.ensure_capacity(slot, rs.n_pages * cache.page_size)
             with self.tracer.span("swap_in", slot=slot,
                                   pages=rs.n_pages) as sp:
                 self._fire("swap_in", slot=slot, pools=cache.pools)
@@ -970,78 +961,131 @@ class LLMEngine:
             cache.pools = {"k": k_pool, "v": v_pool}
             with self._cv:
                 self.stats["swapped_in"] += 1
-        else:
-            # recompute-on-resume: the cached part is prompt + all
-            # generated tokens except the pending one (ctx tokens total);
-            # re-prefill it through the same bucketed path admission uses
-            ids_np = np.concatenate(
-                [req.prompt, np.asarray(req.tokens[:-1], np.int32)])
-            Sb = self._bucket_for(rs.ctx)
-            ids = np.zeros((1, Sb), np.int32)
-            ids[0, :rs.ctx] = ids_np
-            with self.tracer.span("prefill", slot=slot, tokens=rs.ctx,
-                                  bucket=Sb, resume=True) as sp:
-                self._fire("prefill", slot=slot, pools=cache.pools)
-                _last, k_pool, v_pool = self._prefill(
-                    self.params, jnp.asarray(ids), cache.pools["k"],
-                    cache.pools["v"], cache.page_table[slot][None],
-                    jnp.int32(rs.ctx))
-                sp.fence(k_pool)
-            cache.pools = {"k": k_pool, "v": v_pool}
         with self._cv:
             self.stats["resumed"] += 1
         req._resume = None
-        self._slots[slot] = _SlotState(req, rs.last_tok, ctx=rs.ctx,
-                                       admit_seq=self._admit_seq)
+        self._slots[slot] = _SlotState(
+            req, self._admit_seq, ctx=rs.ctx, last_tok=rs.last_tok,
+            pending=rs.pending, sample_on_finish=rs.sample_on_finish)
 
-    def _decode_step(self) -> bool:
+    def _alloc_with_preemption(self, slot: int, n_tokens: int) -> bool:
+        """Grow `slot`'s pages to cover n_tokens, preempting victims under
+        pressure.  Never preempts the last runnable sequence (its worst
+        case was validated at submit), so a lone request always completes.
+        Returns False when `slot` itself was preempted or evicted."""
+        cache = self.cache
+        while True:
+            try:
+                self._fire("page_alloc", slot=slot, n_tokens=n_tokens)
+                cache.ensure_capacity(slot, n_tokens)
+                return True
+            except RuntimeError as e:
+                if len(self._slots) == 1:
+                    # last runnable: a pool too small for one sequence is
+                    # rejected at submit(), so this is an injected or
+                    # configuration fault — fail the request rather than
+                    # deadlock
+                    self._evict(slot, e, "failed")
+                    return False
+                victim = self._pick_victim()
+                self._preempt(victim)
+                if victim == slot or slot not in self._slots:
+                    # preempted ourselves — or a failed swap-out
+                    # recovered the pools and failed this slot too
+                    return False
+
+    def _ragged_step(self) -> bool:
+        """Advance every active slot through ONE unified ragged dispatch:
+        decoding slots contribute a 1-token span, prefilling slots
+        contribute chunks admitted under the per-step token budget."""
         if not self._slots:
             return False
         cache = self.cache
-        # on-demand page allocation: the incoming token lands at cache
-        # index st.ctx — under pressure, preempt a victim and retry.
-        # Never the last runnable sequence (its worst case was validated
-        # at submit), so a lone request always completes.
+        # -- 1. decode spans: allocate the incoming token's page ----------
+        decode_slots: List[int] = []
         for slot in sorted(self._slots):
-            if slot not in self._slots:
-                continue        # preempted as a victim earlier in the pass
+            st = self._slots.get(slot)
+            if st is None or st.prefilling:
+                continue        # preempted earlier in the pass / chunked
+            if self._alloc_with_preemption(slot, st.ctx + 1):
+                decode_slots.append(slot)
+        # -- 2. prefill chunks under the token budget ---------------------
+        # blocks are the real capacity: each decode span takes one, each
+        # chunk ceil(n / block_q); scheduling in admission order
+        blocks_free = self._num_blocks \
+            - sum(1 for s in decode_slots if s in self._slots)
+        budget = self.prefill_chunk_tokens
+        sched: dict[int, int] = {}
+        for slot in sorted((s for s in self._slots
+                            if self._slots[s].prefilling),
+                           key=lambda s: self._slots[s].admit_seq):
+            if budget <= 0 or blocks_free <= 0:
+                break
+            st = self._slots.get(slot)
+            if st is None or not st.prefilling:
+                continue
+            remaining = st.pending.size - st.ctx
+            n = min(remaining, budget, blocks_free * self.block_q)
+            try:
+                with self.tracer.span("prefill", slot=slot, tokens=n,
+                                      start=st.ctx):
+                    self._fire("prefill", slot=slot, pools=cache.pools)
+                    self._fire("prefill_chunk", slot=slot, tokens=n,
+                               start=st.ctx, pools=cache.pools)
+                    if not self._alloc_with_preemption(slot, st.ctx + n):
+                        continue
+            except Exception as e:  # noqa: BLE001 — a per-chunk injected
+                # fault fails THIS request; the rest of the batch and the
+                # engine keep going (a consume_pools rule still surfaces
+                # at the dispatch below and fails the whole step)
+                if slot in self._slots:
+                    self._evict(slot, e, "failed")
+                continue
+            sched[slot] = n
+            blocks_free -= -(-n // self.block_q)
+            budget -= n
+        # preemption during scheduling may have evicted earlier spans
+        decode_slots = [s for s in decode_slots if s in self._slots]
+        sched = {s: n for s, n in sched.items() if s in self._slots}
+        if not decode_slots and not sched:
+            return True     # allocation alone changed state this pass
+        # -- 3. build the fixed-shape ragged batch ------------------------
+        spans: List[generation.RaggedSpan] = []
+        self._batch_spans = []
+        for slot in decode_slots:
             st = self._slots[slot]
-            while True:
-                try:
-                    self._fire("page_alloc", slot=slot, n_tokens=st.ctx + 1)
-                    cache.ensure_capacity(slot, st.ctx + 1)
-                    break
-                except RuntimeError as e:
-                    if len(self._slots) == 1:
-                        # last runnable: a pool too small for one sequence
-                        # is rejected at submit(), so this is an injected
-                        # or configuration fault — fail the request rather
-                        # than deadlock
-                        self._evict(slot, e, "failed")
-                        break
-                    victim = self._pick_victim()
-                    self._preempt(victim)
-                    if victim == slot or slot not in self._slots:
-                        # preempted ourselves — or a failed swap-out
-                        # recovered the pools and failed this slot too
-                        break
-        if not self._slots:
-            return True         # every slot preempted/evicted this pass
-        B = cache.max_slots
-        toks = np.zeros((B,), np.int32)
-        ctx = np.zeros((B,), np.int32)   # empty slots hit the scratch page
-        for slot, st in self._slots.items():
-            toks[slot] = st.last_tok
-            ctx[slot] = st.ctx
+            spans.append(generation.RaggedSpan(
+                [st.last_tok], st.ctx + 1, cache._slot_pages[slot]))
+            self._batch_spans.append((slot, "decode", 1))
+        for slot, n in sched.items():
+            st = self._slots[slot]
+            spans.append(generation.RaggedSpan(
+                st.pending[st.ctx:st.ctx + n], st.ctx + n,
+                cache._slot_pages[slot]))
+            self._batch_spans.append((slot, "chunk", n))
+        batch = generation.build_ragged_batch(
+            spans, self._num_blocks, self._num_spans, self.block_q,
+            cache.page_size, cache.pages_per_seq)
+        # -- 4. ONE dispatch for the whole mixed batch --------------------
         try:
-            with self.tracer.span("decode_step",
-                                  active=len(self._slots)) as sp:
+            with self.tracer.span("decode_step", active=len(spans),
+                                  decode=len(decode_slots),
+                                  chunks=len(sched)) as sp:
                 self._fire("decode", pools=cache.pools)
-                logits, pools = self._decode(
-                    self.params, jnp.asarray(toks), jnp.asarray(ctx),
-                    cache.page_table, cache.pools["k"], cache.pools["v"])
+                logits, k_pool, v_pool = self._ragged(
+                    self.params, jnp.asarray(batch["tok"]),
+                    jnp.asarray(batch["row_page"]),
+                    jnp.asarray(batch["row_off"]),
+                    jnp.asarray(batch["row_pos"]),
+                    jnp.asarray(batch["block_seq"]),
+                    jnp.asarray(batch["block_qpos"]),
+                    jnp.asarray(batch["span_len"]),
+                    jnp.asarray(batch["ctx_len"]),
+                    jnp.asarray(batch["span_pt"]),
+                    jnp.asarray(batch["out_rows"]),
+                    cache.pools["k"], cache.pools["v"])
                 sp.fence(logits)
-            cache.pools = pools
+            cache.pools = {"k": k_pool, "v": v_pool}
             with self.tracer.span("sample"):
                 self._fire("sample")
                 nxt = np.asarray(self._sample(logits))
@@ -1051,17 +1095,42 @@ class LLMEngine:
             # pools, keep serving the queue.
             self._fail_inflight(e)
             return True
+        n_prefill_tokens = sum(sched.values())
         with self._cv:
-            self.stats["decode_steps"] += 1
-            self.stats["decode_tokens"] += len(self._slots)
+            if decode_slots:
+                self.stats["decode_steps"] += 1
+                self.stats["decode_tokens"] += len(decode_slots)
+            if sched:
+                self.stats["prefill_chunks"] += len(sched)
+                self.stats["prefill_tokens"] += n_prefill_tokens
+            self.stats["ragged_batch_tokens"] += (len(decode_slots)
+                                                  + n_prefill_tokens)
+        # -- 5. post-process each span's outcome --------------------------
         now = time.monotonic()
-        for slot in list(self._slots):
-            st = self._slots[slot]
-            st.ctx += 1
-            tok = int(nxt[slot])
+        for i, (slot, kind, n) in enumerate(self._batch_spans):
+            st = self._slots.get(slot)
+            if st is None:
+                continue
+            if kind == "chunk":
+                st.ctx += n
+                if st.prefilling:
+                    continue            # more chunks on later steps
+                if not st.sample_on_finish:
+                    # recompute-resume: its next token was sampled before
+                    # the preemption; decode continues with last_tok
+                    st.pending = None
+                    continue
+                st.pending = None
+                tok = int(nxt[i])
+            else:
+                st.ctx += 1
+                tok = int(nxt[i])
             st.req.tokens.append(tok)
             st.last_tok = tok
-            if st.req.t_last_token is not None:
+            if st.req.t_first_token is None:
+                st.req.t_first_token = now
+                self._h_ttft.observe(now - st.req.t_submit)
+            elif st.req.t_last_token is not None:
                 self._h_itl.observe(now - st.req.t_last_token)
             st.req.t_last_token = now
             if (st.req.eos_id is not None and tok == st.req.eos_id) \

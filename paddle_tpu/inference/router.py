@@ -701,6 +701,12 @@ class Router:
             self.stats.inc("ejections")
             inflight = list(r.inflight)
             r.inflight.clear()
+        # capture each stranded request's hop on THIS replica before
+        # teardown: shutdown resolutions trigger the retry path, which
+        # can re-place a handle onto a healthy replica and swap fh._hop
+        # under us — the sweep must resolve only the dead replica's hop
+        # objects, never a successor
+        stranded = [(fh, fh._hop) for fh in inflight]
         # engine teardown OUTSIDE the router lock (resolutions run router
         # callbacks which need it)
         try:
@@ -712,8 +718,7 @@ class Router:
         # router can: every fleet handle placed on this replica whose hop
         # never resolved is force-resolved as replica death (the retry
         # rules then requeue or fail it, never lose it).
-        for fh in inflight:
-            hop = fh._hop
+        for fh, hop in stranded:
             if hop is not None and not hop.done():
                 hop._resolve(EngineStopped(
                     f"replica {r.rid} died mid-request"))

@@ -160,6 +160,40 @@ def paged_attention(q, k_pool, v_pool, page_table, lengths, scale=None):
 
 
 # ---------------------------------------------------------------------------
+# Ragged prefill+decode attention (the unified serving dispatch: per-seq
+# query spans of arbitrary length over the same paged pools)
+# ---------------------------------------------------------------------------
+
+
+def ragged_attention(q, k_pool, v_pool, span_pt, block_seq, block_qpos,
+                     span_len, ctx_len, scale=None):
+    """Unified ragged attention over a block-paged KV cache: each sequence
+    contributes a query span of arbitrary length (1 = decode, chunk-size =
+    prefill).  q: (T, Hq, D) span-packed rows (spans start on block
+    boundaries); k_pool/v_pool: (num_pages, page_size, Hkv, D); span_pt:
+    (S, pages_per_seq) i32 page table per span; block_seq/block_qpos:
+    (T // block_q,) i32 row-block metadata; span_len/ctx_len: (S,) i32.
+
+    Pallas kernel on TPU; the SAME kernel through the Pallas interpreter on
+    CPU (tier-1 tests exercise the real grid/index-map logic), with the
+    dense-gather XLA path as the fallback."""
+    from .pallas_ragged_attention import (ragged_attention_pallas,
+                                          ragged_attention_reference)
+
+    if framework.get_state().flags.get("FLAGS_use_fused_kernels", True):
+        try:
+            return ragged_attention_pallas(q, k_pool, v_pool, span_pt,
+                                           block_seq, block_qpos, span_len,
+                                           ctx_len, scale=scale,
+                                           interpret=not _on_tpu())
+        except Exception:  # noqa: BLE001 — fall back on any lowering issue
+            _warn_pallas_fallback("ragged_attention")
+    return ragged_attention_reference(q, k_pool, v_pool, span_pt, block_seq,
+                                      block_qpos, span_len, ctx_len,
+                                      scale=scale)
+
+
+# ---------------------------------------------------------------------------
 # Grouped matmul (dropless MoE dispatch: ragged per-expert FFN)
 # ---------------------------------------------------------------------------
 
@@ -403,6 +437,32 @@ def _register_graphlint_costs() -> None:
             * _np.dtype(q.dtype).itemsize
         return float(kv_read + q_io + 4 * B * (pps + 1))
 
+    def _ragged(eqn):
+        # scalar-prefetch order: block_seq, block_qpos, span_len, ctx_len,
+        # span_pt, then q (T, Hkv, rep, D) and the pools (P, ps, Hkv, D).
+        # Upper bound: every query row attends the full per-span table
+        # (the kernel skips pages past each block's causal horizon)
+        q, kp = eqn.invars[5].aval, eqn.invars[6].aval
+        pt = eqn.invars[4].aval
+        T, hkv, rep, D = q.shape
+        max_len = pt.shape[1] * kp.shape[1]
+        return 4.0 * T * hkv * rep * D * max_len
+
+    def _ragged_bytes(eqn):
+        # KV traffic is per ROW-BLOCK: each of the T/block_q blocks reads
+        # its span's table pages (scalar-prefetched page table), never the
+        # whole pool; q/o move once each
+        q, kp = eqn.invars[5].aval, eqn.invars[6].aval
+        pt = eqn.invars[4].aval
+        T = q.shape[0]
+        S, pps = pt.shape
+        _P, ps, hkv, D = kp.shape
+        kv_read = 2 * S * pps * ps * hkv * D * _np.dtype(kp.dtype).itemsize
+        q_io = 2 * int(_np.prod(q.shape, dtype=_np.int64)) \
+            * _np.dtype(q.dtype).itemsize
+        meta = 4 * (2 * (T // max(1, ps)) + S * (pps + 2))
+        return float(kv_read + q_io + meta)
+
     def _gmm(eqn):
         # x (Mp, K) @ per-group w (X, K, N) -> (Mp, N): dense-equivalent
         x = next(v.aval for v in eqn.invars if len(v.aval.shape) == 2
@@ -429,6 +489,8 @@ def _register_graphlint_costs() -> None:
     _cost.register_pallas_flops("_dkv_kernel", _attention_file)
     _cost.register_pallas_flops("_paged_kernel", _paged)
     _cost.register_pallas_bytes("_paged_kernel", _paged_bytes)
+    _cost.register_pallas_flops("_ragged_kernel", _ragged)
+    _cost.register_pallas_bytes("_ragged_kernel", _ragged_bytes)
     _cost.register_pallas_flops("_gmm_kernel", _gmm)
     _cost.register_pallas_flops("_tgmm_kernel", _tgmm)
     _cost.register_pallas_flops("pallas_norm.py", _norm_file)

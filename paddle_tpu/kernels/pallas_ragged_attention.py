@@ -1,0 +1,236 @@
+"""Pallas TPU kernel: unified ragged prefill+decode attention over paged KV.
+
+The serving step used to be TWO dispatches: a bucketed static-shape flash
+prefill (one compiled executable per menu bucket, policed by the
+RECOMPILE_BUCKET_MISS lint) and the separate paged decode kernel.  The
+Ragged Paged Attention paper (arxiv 2604.15464) shows the TPU-native fix:
+ONE kernel over the paged pools where each sequence contributes a query
+span of *arbitrary* length — 1 token for a decoding sequence, a
+chunk-size span for a prefilling one, the whole context for a resume.
+A mixed batch is then a single dispatch with a single compiled shape, so
+prefill interleaves with decode and the whole prefill-bucket recompile
+class disappears.
+
+Layout contract (the RAGGED batch):
+  q:         (T, Hq, D)  — T query rows, laid out as consecutive per-seq
+             spans, each span starting on a `block_q` row boundary (the
+             builder pads the tail of every span's last block).
+  k_pool:    (num_pages, page_size, Hkv, D)   shared page pool
+  v_pool:    (num_pages, page_size, Hkv, D)
+  span_pt:   (S, pages_per_seq) int32 — page table row per SPAN; entry j
+             is the pool page holding context tokens
+             [j*page_size, (j+1)*page_size) of that span's sequence.
+  block_seq: (T // block_q,) int32 — which span each row-block belongs to
+  block_qpos:(T // block_q,) int32 — the block's first row's position
+             WITHIN its span (0, block_q, 2*block_q, ... per span)
+  span_len:  (S,) int32 — valid query rows in the span (0 = padding span)
+  ctx_len:   (S,) int32 — the sequence's TOTAL context length once this
+             span's k/v are in the pool (so the span's query row i sits
+             at absolute position ctx_len - span_len + i)
+
+Causality: query row i of span s attends to context slots
+j <= ctx_len[s] - span_len[s] + i — for span_len == 1 that is exactly the
+old decode kernel's `slot < lengths[b]` rule, and for a prefill chunk it
+is causal attention against everything already cached plus the chunk's
+own earlier rows (their k/v are scattered into the pool before the kernel
+runs).
+
+Kernel shape: grid (num_row_blocks, Hkv, pages_per_seq), page loop
+innermost; the block/span metadata and the span page tables ride scalar
+prefetch (pltpu.PrefetchScalarGridSpec) so BlockSpec index maps can chase
+the page indirections.  GQA runs at Hkv width: the q block for (b, h) is
+(block_q, rep, D) flattened to (block_q*rep, D) rows, and one
+(block_q*rep, page_size) score tile feeds an online-softmax accumulator.
+Pages past the block's causal horizon are skipped with pl.when, so
+per-block work is O(needed context / page_size) pages, not
+O(pages_per_seq); padding spans (span_len == 0) skip every page.
+
+`interpret=True` runs the same kernel through the Pallas interpreter so
+CPU tier-1 tests exercise the real grid/index-map logic; the
+`kernels.ragged_attention` wrapper picks interpret mode automatically
+off-TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# np scalars, not Python literals (f64 constants break Mosaic under
+# jax_enable_x64 — see pallas_attention.py)
+_NEG_INF = np.float32(-1e30)
+_TINY = np.float32(1e-30)
+_0 = np.int32(0)
+
+_LANES = 128
+
+
+def _ragged_kernel(bseq_ref, bqpos_ref, slen_ref, clen_ref, pt_ref,
+                   q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+                   scale: float, page_size: int, pages_per_seq: int,
+                   block_q: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+
+    s = bseq_ref[b]
+    q0 = bqpos_ref[b]           # block's first row position within its span
+    sl = slen_ref[s]
+    cl = clen_ref[s]
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal horizon of this block's LAST live row: context slots
+    # < cl - sl + min(q0 + block_q, sl) are the only ones any row needs;
+    # pages wholly past it are skipped (padding spans have cl == sl == 0,
+    # so they skip every page)
+    horizon = cl - sl + jnp.minimum(q0 + block_q, sl)
+
+    @pl.when(j * page_size < horizon)
+    def _compute():
+        rep = q_ref.shape[2]
+        rows = block_q * rep
+        q = q_ref[:, 0].reshape(rows, q_ref.shape[3])     # (bq*rep, D)
+        k = k_ref[0, :, 0]                                # (ps, D)
+        v = v_ref[0, :, 0]                                # (ps, D)
+        sc = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq*rep, ps)
+        # row r of the tile is query row r // rep of the block; its span
+        # position is q0 + r // rep, its absolute position cl - sl + that
+        qpos = q0 + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 0) // rep
+        slot = j * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, page_size), 1)
+        keep = (qpos < sl) & (slot <= cl - sl + qpos)
+        sc = jnp.where(keep, sc, _NEG_INF)
+        m_prev = m_scr[...]                               # (bq*rep, 128)
+        m_cur = jax.lax.broadcast_in_dim(
+            jnp.max(sc, axis=-1), m_prev.shape, (0,))
+        m_new = jnp.maximum(m_prev, m_cur)
+        # zero masked entries EXPLICITLY: a fully-dead row (span padding)
+        # has sc == m_new == -inf, where exp(sc - m_new) would be 1
+        p = jnp.where(keep, jnp.exp(sc - m_new[:, :1]), 0.0)  # (bq*rep, ps)
+        alpha = jnp.exp(m_prev - m_new)                   # (bq*rep, 128)
+        l_scr[...] = l_scr[...] * alpha + jax.lax.broadcast_in_dim(
+            jnp.sum(p, axis=-1), m_prev.shape, (0,))
+        acc_scr[...] = acc_scr[...] * alpha[:, :1] + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # (bq*rep, D)
+        m_scr[...] = m_new
+
+    @pl.when(j == pages_per_seq - 1)
+    def _finalize():
+        rep = q_ref.shape[2]
+        # dead rows (span padding) have l == 0 -> output 0, never read
+        l = jnp.maximum(l_scr[...][:, :1], _TINY)
+        o_ref[:, 0] = (acc_scr[...] / l).astype(o_ref.dtype).reshape(
+            block_q, rep, o_ref.shape[3])
+
+
+def ragged_attention_pallas(q, k_pool, v_pool, span_pt, block_seq,
+                            block_qpos, span_len, ctx_len, scale=None,
+                            interpret=False):
+    """Unified ragged prefill+decode attention.  q: (T, Hq, D) span-packed
+    query rows; k_pool/v_pool: (P, ps, Hkv, D); span_pt: (S, pages_per_seq)
+    i32; block_seq/block_qpos: (T // block_q,) i32; span_len/ctx_len: (S,)
+    i32.  Returns (T, Hq, D) in q.dtype (padding rows are zero)."""
+    T, Hq, D = q.shape
+    P, ps, Hkv, _ = k_pool.shape
+    if Hq % Hkv:
+        raise ValueError(f"Hq={Hq} must be a multiple of Hkv={Hkv}")
+    rep = Hq // Hkv
+    nb = block_seq.shape[0]
+    if T % nb:
+        raise ValueError(f"T={T} must be a multiple of num_blocks={nb}")
+    block_q = T // nb
+    pages_per_seq = span_pt.shape[1]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    qg = q.reshape(T, Hkv, rep, D)
+    kernel = functools.partial(
+        _ragged_kernel, scale=float(scale), page_size=ps,
+        pages_per_seq=pages_per_seq, block_q=block_q)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5,     # block_seq, block_qpos, span_len,
+        #                            ctx_len, span_pt
+        grid=(nb, Hkv, pages_per_seq),
+        in_specs=[
+            # axis-0 block index b selects query rows
+            # [b*block_q, (b+1)*block_q) — the b-th row block
+            pl.BlockSpec((block_q, 1, rep, D),
+                         lambda b, h, j, bs, bp, sl, cl, pt:
+                         (b, h, _0, _0)),
+            # page indirection: the block index along the pool's page axis
+            # comes from the prefetched per-span page table
+            pl.BlockSpec((1, ps, 1, D),
+                         lambda b, h, j, bs, bp, sl, cl, pt:
+                         (pt[bs[b], j], _0, h, _0)),
+            pl.BlockSpec((1, ps, 1, D),
+                         lambda b, h, j, bs, bp, sl, cl, pt:
+                         (pt[bs[b], j], _0, h, _0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, 1, rep, D),
+                               lambda b, h, j, bs, bp, sl, cl, pt:
+                               (b, h, _0, _0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q * rep, _LANES), jnp.float32),
+            pltpu.VMEM((block_q * rep, _LANES), jnp.float32),
+            pltpu.VMEM((block_q * rep, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((T, Hkv, rep, D), q.dtype),
+        interpret=interpret,
+    )(block_seq.astype(jnp.int32), block_qpos.astype(jnp.int32),
+      span_len.astype(jnp.int32), ctx_len.astype(jnp.int32),
+      span_pt.astype(jnp.int32), qg, k_pool, v_pool)
+    return out.reshape(T, Hq, D)
+
+
+def ragged_attention_reference(q, k_pool, v_pool, span_pt, block_seq,
+                               block_qpos, span_len, ctx_len, scale=None):
+    """Dense XLA reference: gather each span's page table into a contiguous
+    cache, expand per query row, run masked attention — the oracle for the
+    kernel and the fallback path.  Padding rows return zeros."""
+    T, Hq, D = q.shape
+    _, ps, Hkv, _ = k_pool.shape
+    rep = Hq // Hkv
+    nb = block_seq.shape[0]
+    bq = T // nb
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    S, pps = span_pt.shape
+    M = pps * ps
+    block_seq = block_seq.astype(jnp.int32)
+    block_qpos = block_qpos.astype(jnp.int32)
+    row_seq = jnp.repeat(block_seq, bq)                       # (T,)
+    row_qpos = (jnp.repeat(block_qpos, bq)
+                + jnp.arange(T, dtype=jnp.int32) % bq)        # (T,)
+    ck = k_pool[span_pt].reshape(S, M, Hkv, D)
+    cv = v_pool[span_pt].reshape(S, M, Hkv, D)
+    ckr = ck[row_seq]                                         # (T, M, Hkv, D)
+    cvr = cv[row_seq]
+    qg = q.reshape(T, Hkv, rep, D).astype(jnp.float32) * scale
+    s = jnp.einsum("thrd,tmhd->thrm", qg, ckr.astype(jnp.float32))
+    sl = span_len.astype(jnp.int32)[row_seq]                  # (T,)
+    cl = ctx_len.astype(jnp.int32)[row_seq]
+    slot = jax.lax.broadcasted_iota(jnp.int32, (T, M), 1)
+    keep = (row_qpos < sl)[:, None] & (slot <= (cl - sl + row_qpos)[:, None])
+    s = jnp.where(keep[:, None, None], s, _NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    # rows with no live slot (padding) would softmax to uniform: zero them
+    live = jnp.any(keep, axis=-1)[:, None, None, None]
+    o = jnp.einsum("thrm,tmhd->thrd", p, cvr.astype(jnp.float32))
+    o = jnp.where(live, o, 0.0)
+    return o.reshape(T, Hq, D).astype(q.dtype)

@@ -21,6 +21,7 @@ from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import kernels
 from . import llama as llama_lib
@@ -411,6 +412,221 @@ def forward_paged_decode(params, tok, config, pools, page_table, ctx,
             else params["lm_head"])
     logits = (x @ head.astype(x.dtype)).astype(jnp.float32)
     return logits[:, 0], {"k": k_new, "v": v_new}
+
+
+# ---------------------------------------------------------------------------
+# Ragged prefill+decode: one dispatch for a mixed batch of per-seq spans
+# ---------------------------------------------------------------------------
+
+
+class RaggedSpan:
+    """Host-side descriptor of one sequence's contribution to a ragged
+    step: `tokens` (the span's token ids — 1 for decode, a chunk for
+    prefill), `ctx_after` (the sequence's TOTAL cached length once this
+    span's k/v land in the pool), and `pages` (the slot's allocated page
+    list, covering ctx_after tokens)."""
+
+    __slots__ = ("tokens", "ctx_after", "pages")
+
+    def __init__(self, tokens, ctx_after: int, pages):
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.ctx_after = int(ctx_after)
+        self.pages = list(pages)
+
+
+def build_ragged_batch(spans, num_blocks: int, num_spans: int,
+                       block_q: int, page_size: int, pages_per_seq: int):
+    """Pack host-side span descriptors into the FIXED-SHAPE arrays one
+    ragged dispatch consumes (the fixed shapes are what keep the step at
+    O(1) compiled executables).  Spans are laid out consecutively, each
+    starting on a `block_q` row boundary; unused blocks belong to the
+    reserved padding span (index num_spans - 1, span_len 0, page 0).
+
+    Returns a dict of np arrays: tok/row_page/row_off/row_pos (T,),
+    block_seq/block_qpos (num_blocks,), span_len/ctx_len (num_spans,),
+    span_pt (num_spans, pages_per_seq), out_rows (num_spans,) — the row
+    index of each span's last valid token (sampling gathers these)."""
+    T = num_blocks * block_q
+    pad = num_spans - 1
+    if len(spans) > pad:
+        raise ValueError(f"{len(spans)} spans exceed num_spans-1={pad}")
+    tok = np.zeros((T,), np.int32)
+    row_page = np.zeros((T,), np.int32)     # padding rows -> scratch page 0
+    row_off = np.zeros((T,), np.int32)
+    row_pos = np.zeros((T,), np.int32)
+    block_seq = np.full((num_blocks,), pad, np.int32)
+    block_qpos = np.zeros((num_blocks,), np.int32)
+    span_len = np.zeros((num_spans,), np.int32)
+    ctx_len = np.zeros((num_spans,), np.int32)
+    span_pt = np.zeros((num_spans, pages_per_seq), np.int32)
+    out_rows = np.zeros((num_spans,), np.int32)
+    blk = 0
+    for i, sp in enumerate(spans):
+        L = sp.tokens.size
+        if L < 1:
+            raise ValueError("a ragged span must hold at least one token")
+        need_blocks = -(-L // block_q)
+        if blk + need_blocks > num_blocks:
+            raise ValueError(
+                f"span {i} ({L} tokens) does not fit: {blk} of "
+                f"{num_blocks} row blocks already used")
+        if sp.ctx_after < L:
+            raise ValueError(
+                f"span {i}: ctx_after={sp.ctx_after} < span length {L}")
+        if -(-sp.ctx_after // page_size) > len(sp.pages):
+            raise ValueError(
+                f"span {i}: {len(sp.pages)} pages cannot hold "
+                f"ctx_after={sp.ctx_after} tokens")
+        span_len[i] = L
+        ctx_len[i] = sp.ctx_after
+        row = np.asarray(sp.pages + [sp.pages[-1]] *
+                         (pages_per_seq - len(sp.pages)), np.int32)
+        span_pt[i] = row
+        r0 = blk * block_q
+        out_rows[i] = r0 + L - 1
+        pos = sp.ctx_after - L + np.arange(L, dtype=np.int32)
+        tok[r0:r0 + L] = sp.tokens
+        row_pos[r0:r0 + L] = pos
+        row_page[r0:r0 + L] = row[pos // page_size]
+        row_off[r0:r0 + L] = pos % page_size
+        for bi in range(need_blocks):
+            block_seq[blk + bi] = i
+            block_qpos[blk + bi] = bi * block_q
+        blk += need_blocks
+    return {"tok": tok, "row_page": row_page, "row_off": row_off,
+            "row_pos": row_pos, "block_seq": block_seq,
+            "block_qpos": block_qpos, "span_len": span_len,
+            "ctx_len": ctx_len, "span_pt": span_pt, "out_rows": out_rows}
+
+
+def _block_ragged(c, x, lp, cos, sin, kp, vp, row_page, row_off, span_pt,
+                  block_seq, block_qpos, span_len, ctx_len, ffn_fn=None):
+    """One block in ragged mode.  x: (T, E) span-packed rows; kp/vp: one
+    layer's (P, ps, Hkv, D) pools.  Each row's k/v is scattered at its
+    absolute position's (page, offset) BEFORE attention, so a prefill
+    chunk's later rows attend its earlier rows through the pool."""
+    T = x.shape[0]
+    D, Hq, Hkv = c.hd, c.num_attention_heads, c.num_key_value_heads
+    h = kernels.rms_norm(x, lp["input_norm"].astype(jnp.float32),
+                         c.rms_norm_eps).astype(x.dtype)
+    q = (h @ lp["wq"]).reshape(T, Hq, D)
+    k = (h @ lp["wk"]).reshape(T, Hkv, D)
+    v = (h @ lp["wv"]).reshape(T, Hkv, D)
+    # rope rides the per-row position tables; _apply_rope wants (B,S,H,D)
+    q = llama_lib._apply_rope(q[None], cos, sin)[0]
+    k = llama_lib._apply_rope(k[None], cos, sin)[0]
+    # padding rows target the reserved scratch page 0 (never read as data)
+    kp = kp.at[row_page, row_off].set(k.astype(kp.dtype))
+    vp = vp.at[row_page, row_off].set(v.astype(vp.dtype))
+    attn = kernels.ragged_attention(q, kp, vp, span_pt, block_seq,
+                                    block_qpos, span_len, ctx_len)
+    x = x + (attn.reshape(T, Hq * D) @ lp["wo"])
+    h = kernels.rms_norm(x, lp["post_norm"].astype(jnp.float32),
+                         c.rms_norm_eps).astype(x.dtype)
+    if ffn_fn is not None:
+        out, _aux = ffn_fn(h, lp)
+        return x + out.astype(x.dtype), kp, vp
+    gate = h @ lp["w_gate"]
+    up = h @ lp["w_up"]
+    # silu in fp32, matching the train path (see _block_with_cache)
+    mlp = (jax.nn.silu(gate.astype(jnp.float32)).astype(up.dtype) * up) \
+        @ lp["w_down"]
+    return x + mlp.astype(x.dtype), kp, vp
+
+
+def forward_ragged(params, tok, config, pools, row_page, row_off, row_pos,
+                   block_seq, block_qpos, span_len, ctx_len, span_pt,
+                   out_rows, ffn_fn=None):
+    """ONE unified dispatch over a ragged batch of per-seq spans: decode
+    tokens (span_len 1) and prefill chunks (span_len > 1) together.  tok:
+    (T,) span-packed token ids; row_page/row_off/row_pos: (T,) per-row
+    scatter/rope metadata; block/span arrays as built by
+    `build_ragged_batch`; pools: the paged {"k","v"} pools.
+
+    Returns (logits (num_spans, V) f32 — one row per span, taken at its
+    LAST valid token (out_rows) — and the updated pools)."""
+    c = config
+    x = jnp.take(params["embed"]["weight"], tok, axis=0)           # (T, E)
+    cos_f, sin_f = llama_lib._rope_tables(c.hd, c.max_position_embeddings,
+                                          c.rope_theta)
+    cos = jnp.take(cos_f, row_pos, axis=0)                         # (T, d2)
+    sin = jnp.take(sin_f, row_pos, axis=0)
+
+    def body(x, layer):
+        lp, kp, vp = layer
+        x, kp, vp = _block_ragged(c, x, lp, cos, sin, kp, vp, row_page,
+                                  row_off, span_pt, block_seq, block_qpos,
+                                  span_len, ctx_len, ffn_fn=ffn_fn)
+        return x, (kp, vp)
+
+    x, (k_new, v_new) = jax.lax.scan(
+        body, x, (params["blocks"], pools["k"], pools["v"]))
+    x = kernels.rms_norm(x, params["final_norm"].astype(jnp.float32),
+                         c.rms_norm_eps)
+    sel = jnp.take(x, out_rows, axis=0)                 # (num_spans, E)
+    head = (params["embed"]["weight"].T if c.tie_word_embeddings
+            else params["lm_head"])
+    logits = (sel @ head.astype(sel.dtype)).astype(jnp.float32)
+    return logits, {"k": k_new, "v": v_new}
+
+
+def generate_ragged(params, input_ids, config, max_new_tokens: int,
+                    page_size: int = 16, prefill_chunk_tokens: int = 8,
+                    block_q: int = 4):
+    """`generate()` through the unified ragged path: the prompt is
+    prefilled in bounded chunks and every decode token is a 1-token span,
+    all through `forward_ragged` — greedy only, equal-length prompts.
+
+    This is the functional proof that chunked ragged prefill + ragged
+    decode reproduces the dense `generate()` chain exactly; the
+    continuous-batching engine builds the same batches incrementally with
+    slots arriving and leaving mid-flight."""
+    B, S = input_ids.shape
+    ids = np.asarray(input_ids, np.int32)
+    total = S + max_new_tokens
+    pages_per_seq = -(-total // page_size)
+    cache = PagedKVCache(config, num_pages=1 + B * pages_per_seq,
+                         page_size=page_size, max_slots=B,
+                         pages_per_seq=pages_per_seq)
+    slots = [cache.acquire_slot() for _ in range(B)]
+    num_spans = B + 1
+    chunk = max(1, int(prefill_chunk_tokens))
+    num_blocks = B * -(-max(chunk, 1) // block_q)
+    pools = cache.pools
+
+    def dispatch(spans):
+        b = build_ragged_batch(spans, num_blocks, num_spans, block_q,
+                               page_size, pages_per_seq)
+        return forward_ragged(
+            params, jnp.asarray(b["tok"]), config, pools,
+            jnp.asarray(b["row_page"]), jnp.asarray(b["row_off"]),
+            jnp.asarray(b["row_pos"]), jnp.asarray(b["block_seq"]),
+            jnp.asarray(b["block_qpos"]), jnp.asarray(b["span_len"]),
+            jnp.asarray(b["ctx_len"]), jnp.asarray(b["span_pt"]),
+            jnp.asarray(b["out_rows"]))
+
+    logits = None
+    for c0 in range(0, S, chunk):
+        n = min(chunk, S - c0)
+        spans = []
+        for b_i in range(B):
+            cache.ensure_capacity(slots[b_i], c0 + n)
+            spans.append(RaggedSpan(ids[b_i, c0:c0 + n], c0 + n,
+                                    cache._slot_pages[slots[b_i]]))
+        logits, pools = dispatch(spans)
+    tok = np.asarray(jnp.argmax(logits[:B], axis=-1), np.int32)
+    out = [tok.copy()]
+    for step in range(1, max_new_tokens):
+        ctx = S + step
+        spans = []
+        for b_i in range(B):
+            cache.ensure_capacity(slots[b_i], ctx)
+            spans.append(RaggedSpan([int(tok[b_i])], ctx,
+                                    cache._slot_pages[slots[b_i]]))
+        logits, pools = dispatch(spans)
+        tok = np.asarray(jnp.argmax(logits[:B], axis=-1), np.int32)
+        out.append(tok.copy())
+    return jnp.asarray(np.stack(out, axis=1))
 
 
 @functools.partial(jax.jit, static_argnames=(

@@ -204,13 +204,17 @@ class TestPreemption:
 
     def test_admission_reserves_prompt_only(self, tiny):
         """Admit-on-demand: right after admission a request holds pages
-        for its PROMPT, not prompt+max_new_tokens."""
+        for its PROMPT (chunk), not prompt+max_new_tokens — and the first
+        decode token's page is only allocated on the NEXT ragged step."""
         cfg, params = tiny
         eng = _engine(params, cfg)
         eng.submit([1, 2, 3, 4], max_new_tokens=8)   # worst case 3 pages
-        eng.step()   # admit (1 page for the 4-token prompt) + 1 decode
+        eng.step()   # admit + the prompt's prefill chunk (1 page)
         used = eng.cache.num_pages - 1 - eng.cache.free_page_count
-        assert used == 2    # prompt page + the on-demand decode page
+        assert used == 1    # the 4-token prompt's page, nothing more
+        eng.step()   # first decode span allocates token 5's page
+        used = eng.cache.num_pages - 1 - eng.cache.free_page_count
+        assert used == 2
 
 
 class TestServeFailureSurface:
@@ -401,6 +405,77 @@ class TestChaos:
         assert report["failed"] >= 1
 
 
+# -- chaos: chunked prefill (prompts longer than the per-step budget) ------
+
+# chunk budget 3 over 5..9-token prompts: every prefill is multi-chunk, so
+# the injected fault / the pool pressure lands MID-prefill
+CHUNKED_SCHEDULES = [
+    ("chunk_dies_1st", "swap",
+     [("prefill_chunk", dict(nth=1))]),
+    ("chunk_dies_3rd_midway", "recompute",
+     [("prefill_chunk", dict(nth=3))]),
+    ("chunk_consumes_donated_pools", "recompute",
+     [("prefill_chunk", dict(nth=2, consume_pools=True))]),
+    ("chunk_then_decode_fault", "swap",
+     [("prefill_chunk", dict(nth=2)), ("decode", dict(nth=5))]),
+    ("oom_during_chunked_prefill", "swap",
+     [("page_alloc", dict(slot=1, nth=3))]),
+]
+
+
+class TestChunkedPrefillChaos:
+    def _make(self, params, cfg, mode):
+        return lambda: _engine(params, cfg, num_pages=5, preempt_mode=mode,
+                               prefill_chunk_tokens=3, block_q=2)
+
+    def _workload(self, cfg, seed=3, n=4):
+        rng = np.random.default_rng(seed)
+        return [(rng.integers(0, cfg.vocab_size,
+                              int(rng.integers(5, 10))).tolist(),
+                 int(rng.integers(2, 5))) for _ in range(n)]
+
+    @pytest.mark.parametrize(
+        "name,mode,spec", CHUNKED_SCHEDULES,
+        ids=[s[0] for s in CHUNKED_SCHEDULES])
+    def test_chunked_schedule(self, tiny, name, mode, spec):
+        """A request dying (or losing the pools, or getting preempted)
+        MID-prefill-chunk must leave zero leaked pages/slots and every
+        handle resolved exactly once."""
+        cfg, params = tiny
+        rules = [F.FaultRule(point, **kw) for point, kw in spec]
+        report = F.run_schedule(self._make(params, cfg, mode), rules,
+                                self._workload(cfg))
+        assert report["ok"], report["violations"]
+        assert report["fired"], "schedule never fired — it tests nothing"
+        assert report["completed"] + report["failed"] == report["requests"]
+        assert report["stats"]["prefill_chunks"] >= 2  # chunking happened
+
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_preempt_mid_prefill_chunk_token_exact(self, tiny, mode):
+        """Deterministic mid-prefill preemption: a slot whose prompt is
+        only half-cached is preempted directly, resumes in either mode,
+        and still matches the offline greedy chain."""
+        cfg, params = tiny
+        eng = _engine(params, cfg, preempt_mode=mode,
+                      prefill_chunk_tokens=4, block_q=2)
+        prompt = np.random.default_rng(7).integers(
+            0, cfg.vocab_size, 9).tolist()
+        h = eng.submit(prompt, max_new_tokens=4)
+        eng.step()                    # admit + first 4-token chunk
+        (slot, st), = eng._slots.items()
+        assert st.prefilling and st.ctx == 4
+        eng._preempt(slot)            # victim taken mid-prefill
+        assert eng.stats["preemptions"] == 1
+        while not h.done():
+            eng.step()
+        want = np.asarray(generation.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            max_new_tokens=4))[0].tolist()
+        assert list(h.result(timeout=5)) == want
+        assert eng.stats["resumed"] == 1
+        F.check_invariants(eng, [h])
+
+
 class TestInvariantChecker:
     def test_detects_leaked_slot(self, tiny):
         """The checker itself must catch a leak: acquire a slot behind the
@@ -419,4 +494,17 @@ class TestInvariantChecker:
             eng.step()
         h._resolve()     # simulate an engine bug double-resolving
         with pytest.raises(F.InvariantViolation, match="resolved 2 times"):
+            F.check_invariants(eng, [h])
+
+    def test_detects_ragged_token_identity_drift(self, tiny):
+        """ragged_batch_tokens must equal decode_tokens + prefill_tokens;
+        a scheduler that double-counts (or drops) a span must trip."""
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        h = eng.submit([1, 2, 3], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        eng.stats["ragged_batch_tokens"] += 1   # seed the drift
+        with pytest.raises(F.InvariantViolation,
+                           match="ragged token identity"):
             F.check_invariants(eng, [h])

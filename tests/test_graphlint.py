@@ -426,36 +426,37 @@ class TestEngineHardening:
         return LLMEngine(params, cfg, num_slots=2, page_size=4,
                          max_seq_len=16)
 
-    def test_admission_failure_releases_slot_and_pages(self):
+    def test_dispatch_failure_releases_slot_and_pages(self):
         eng = self._engine()
         free_slots0 = eng.cache.free_slot_count
         free_pages0 = eng.cache.free_page_count
 
         def boom(*a, **k):
-            raise RuntimeError("prefill exploded")
+            raise RuntimeError("ragged step exploded")
 
-        eng._prefill = boom
+        eng._ragged = boom
         req = eng.submit([1, 2, 3], max_new_tokens=4)
-        eng.step()
-        with pytest.raises(RuntimeError, match="prefill exploded"):
+        eng.step()   # admit + the failing unified dispatch
+        with pytest.raises(RuntimeError, match="ragged step exploded"):
             req.result(timeout=5)
         assert eng.cache.free_slot_count == free_slots0
         assert eng.cache.free_page_count == free_pages0
         assert not eng._slots and not eng._pending
 
-    def test_admission_failure_does_not_wedge_later_requests(self):
+    def test_dispatch_failure_does_not_wedge_later_requests(self):
         eng = self._engine()
-        real_prefill = eng._prefill
+        real_ragged = eng._ragged
         calls = {"n": 0}
 
         def flaky(*a, **k):
             calls["n"] += 1
             if calls["n"] == 1:
                 raise RuntimeError("transient")
-            return real_prefill(*a, **k)
+            return real_ragged(*a, **k)
 
-        eng._prefill = flaky
+        eng._ragged = flaky
         bad = eng.submit([1, 2, 3], max_new_tokens=2)
+        eng.step()   # bad rides the failing dispatch alone
         good = eng.submit([4, 5], max_new_tokens=2)
         while eng.has_work():
             if not eng.step():
@@ -465,7 +466,7 @@ class TestEngineHardening:
         assert len(good.result(timeout=5)) == 2
 
     def test_failed_donated_dispatch_recovers_pools(self):
-        # on TPU a _prefill/_decode that fails AFTER dispatch has already
+        # on TPU a _ragged step that fails AFTER dispatch has already
         # consumed the donated pools; simulate by deleting them (CPU
         # ignores donation, so the buffers stay alive in normal runs)
         eng = self._engine()

@@ -310,29 +310,20 @@ class TestStaticMemory:
 
 
 # ---------------------------------------------------------------------------
-# RECOMPILE_BUCKET_MISS (menu lint + engine construction wiring)
+# RECOMPILE_BUCKET_MISS (deprecated menu lint — the unified ragged step
+# retired the engine's bucket machinery, but the standalone lint + fix
+# patch stay loadable for saved reports and rc files) and the ragged
+# step's one-signature guarantee that replaced the menu
 # ---------------------------------------------------------------------------
 
 
-class TestBucketMenu:
+class TestBucketMenuDeprecated:
     def test_straddling_menu_flagged_with_edit(self):
         r = analysis.lint_bucket_menu([8, 16], [7, 9, 10])
         hits = warnings_of(r, "RECOMPILE_BUCKET_MISS")
         assert hits
-        # lo widens to cover the straddle group; the top bucket stays
-        # (coverage: the engine validates max(menu) >= max_seq_len)
         assert hits[0].data["suggested_menu"] == [12, 16]
         assert hits[0].data["edge"] == [8, 16]
-
-    def test_suggested_menu_is_engine_valid(self):
-        # the prescribed fix must not be rejected by the engine itself
-        with warnings.catch_warnings(record=True):
-            warnings.simplefilter("always")
-            eng = _tiny_engine(expected_prompt_lens=[7, 9, 10])
-        sugg = eng.bucket_report.by_code(
-            "RECOMPILE_BUCKET_MISS")[0].data["suggested_menu"]
-        eng2 = _tiny_engine(prefill_buckets=sugg)   # must construct
-        assert eng2.prefill_buckets == sugg
 
     def test_straddle_mid_menu_keeps_top_coverage(self):
         r = analysis.lint_bucket_menu([8, 16, 32, 64], [30, 33, 35])
@@ -348,51 +339,50 @@ class TestBucketMenu:
         r = analysis.lint_bucket_menu([8, 16], [40])
         assert warnings_of(r, "RECOMPILE_BUCKET_MISS")
 
-    def test_engine_lints_menu_at_construction(self):
-        with warnings.catch_warnings(record=True) as w:
-            warnings.simplefilter("always")
-            eng = _tiny_engine(expected_prompt_lens=[7, 9, 10])
-        assert any("RECOMPILE_BUCKET_MISS" in str(x.message) for x in w)
-        assert eng.bucket_report.by_code("RECOMPILE_BUCKET_MISS")
+    def test_engine_rejects_retired_bucket_args(self):
+        # the menu kwargs are GONE, not silently ignored
+        with pytest.raises(TypeError):
+            _tiny_engine(prefill_buckets=[8, 16])
+        with pytest.raises(TypeError):
+            _tiny_engine(expected_prompt_lens=[7, 9, 10])
 
-    def test_engine_clean_workload_no_warning(self):
-        with warnings.catch_warnings(record=True) as w:
-            warnings.simplefilter("always")
-            eng = _tiny_engine(expected_prompt_lens=[5, 6, 14])
-        assert not w
-        assert not len(eng.bucket_report)
+    def test_rcfile_suppressing_deprecated_code_still_loads(self, tmp_path):
+        # old rc files naming RECOMPILE_BUCKET_MISS must not crash the
+        # loader or the analyzer now that no checker emits the code
+        rc = tmp_path / ".graphlintrc"
+        rc.write_text('suppress = ["RECOMPILE_BUCKET_MISS"]\n'
+                      '[severity]\nRECOMPILE_BUCKET_MISS = "info"\n')
+        cfg = analysis.load_rcfile(str(rc))
+        r = analysis.analyze(lambda x: x * 2.0, jnp.ones((8,)), config=cfg)
+        assert r.ok(Severity.WARNING)
 
-    def test_engine_menu_validation(self):
-        with pytest.raises(ValueError, match="max_seq_len"):
-            _tiny_engine(prefill_buckets=[8])      # cannot cover resume
-        with pytest.raises(ValueError, match="rope table"):
-            _tiny_engine(prefill_buckets=[8, 256])  # past the rope table
 
-    def test_custom_menu_token_exact(self):
+class TestRaggedOneSignature:
+    def test_chunk_budget_token_exact(self):
+        # chunk size is a latency/throughput knob, never a token knob
+        eng_small = _tiny_engine(prefill_chunk_tokens=4, block_q=4)
         eng_default = _tiny_engine()
-        eng_wide = _tiny_engine(prefill_buckets=[16])   # one fat bucket
         prompts = [[1, 2, 3], [4, 5, 6, 7, 8, 9]]
-        a = eng_default.generate(prompts, max_new_tokens=4)
-        b = eng_wide.generate(prompts, max_new_tokens=4)
-        assert a == b      # right-padded prefill is length-independent
+        a = eng_small.generate(prompts, max_new_tokens=4)
+        b = eng_default.generate(prompts, max_new_tokens=4)
+        assert a == b
 
-    def test_probe_args_cover_menu(self):
+    def test_ragged_probe_single_signature(self):
         eng = _tiny_engine()
-        probes = eng.prefill_probe_args()
-        assert [p[1].shape[1] for p in probes] == eng.prefill_buckets
-        r = analysis.analyze(
-            eng._prefill, *probes[0], probe_args=probes[1:],
-            options={"expected_signatures": len(eng.prefill_buckets)})
+        r = analysis.analyze(eng._ragged, *eng.ragged_probe_args(),
+                             options={"expected_signatures": 1})
         assert not r.by_code("RECOMPILE_SHAPE_POLY")
 
-    def test_probe_beyond_menu_fires(self):
+    def test_second_signature_fires(self):
+        # the whole point of the unified step: ONE compiled signature.
+        # A differently-sized batch geometry is a real second compile and
+        # the shape-poly gate must see it.
         eng = _tiny_engine()
-        probes = eng.prefill_probe_args()
-        rogue = (probes[0][0], jax.ShapeDtypeStruct((1, 13), jnp.int32),
-                 *probes[0][2:])     # a signature outside the menu
+        other = _tiny_engine(prefill_chunk_tokens=16, block_q=4)
         r = analysis.analyze(
-            eng._prefill, *probes[0], probe_args=[*probes[1:], rogue],
-            options={"expected_signatures": len(eng.prefill_buckets)})
+            eng._ragged, *eng.ragged_probe_args(),
+            probe_args=[other.ragged_probe_args()],
+            options={"expected_signatures": 1})
         assert warnings_of(r, "RECOMPILE_SHAPE_POLY")
 
 
@@ -585,26 +575,22 @@ class TestServingCost:
             assert c["flops"] == 0
             assert c["bytes"] <= 3 * host_b   # 2x updates + indices
 
-    def _decode_pallas_costs(self, eng):
-        toks = jnp.zeros((2,), jnp.int32)
-        ctx = jnp.zeros((2,), jnp.int32)
-        closed = jax.make_jaxpr(eng._decode)(
-            eng.params, toks, ctx, eng.cache.page_table,
-            eng.cache.pools["k"], eng.cache.pools["v"])
+    def _ragged_pallas_costs(self, eng):
+        closed = jax.make_jaxpr(eng._ragged)(*eng.ragged_probe_args())
         return [c for c in analysis.cost.per_eqn_costs(closed)
                 if c["primitive"] == "pallas_call"]
 
-    def test_paged_attention_registered_flops_and_bytes(self):
+    def test_ragged_attention_registered_flops_and_bytes(self):
         eng = _tiny_engine()
-        pallas = self._decode_pallas_costs(eng)
-        assert pallas, "decode path lost its pallas paged-attention eqn"
+        pallas = self._ragged_pallas_costs(eng)
+        assert pallas, "unified step lost its pallas ragged-attention eqn"
         for c in pallas:
             assert c["flops"] > 0 and c["bytes"] > 0   # registered, not 0
-        # the registered bytes formula charges the pages a sequence READS
-        # (B * pages_per_seq), NOT the pool: a 4x bigger pool must not
-        # change the traffic estimate
+        # the registered bytes formula charges the pages each span's
+        # row-blocks READ (span tables x page size), NOT the pool: a
+        # bigger pool must not change the traffic estimate
         big = _tiny_engine(num_pages=33)
-        big_pallas = self._decode_pallas_costs(big)
+        big_pallas = self._ragged_pallas_costs(big)
         assert [c["bytes"] for c in big_pallas] == \
             [c["bytes"] for c in pallas]
         assert big.cache.pools["k"].nbytes > eng.cache.pools["k"].nbytes

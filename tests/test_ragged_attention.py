@@ -1,0 +1,341 @@
+"""Unified ragged prefill+decode attention: the Pallas kernel vs its
+gather reference AND the old paged kernel, `build_ragged_batch` layout
+invariants, `generate_ragged()` parity with dense `generate()` /
+`generate_paged()`, and the engine-level guarantees the unification buys:
+a mixed prefill+decode step is ONE attention dispatch, and the
+RecompileSentinel stays silent across mixed prompt lengths after warmup
+(steady state is O(1) compiled executables — no bucket menu)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.obs as obs
+from paddle_tpu.kernels import pallas_paged_attention as ppa
+from paddle_tpu.kernels import pallas_ragged_attention as pra
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _kernel_case(seed, spans_spec, Hq, Hkv, D, page_size, pages_per_seq,
+                 block_q):
+    """Random pools + a ragged batch from (span_len, ctx_len) specs.
+    Every span gets its own shuffled page-table row; q rows are random
+    (the batch builder's token/scatter columns are unused at kernel
+    level)."""
+    rng = np.random.default_rng(seed)
+    P = len(spans_spec) * pages_per_seq + 1
+    spans = []
+    for i, (L, ctx) in enumerate(spans_spec):
+        pages = (rng.permutation(P - 1)[:pages_per_seq] + 1).tolist()
+        spans.append(generation.RaggedSpan(np.zeros(L, np.int32), ctx,
+                                           pages[:-(-ctx // page_size)]))
+    num_blocks = sum(-(-L // block_q) for L, _ in spans_spec)
+    b = generation.build_ragged_batch(spans, num_blocks,
+                                      len(spans) + 1, block_q,
+                                      page_size, pages_per_seq)
+    T = num_blocks * block_q
+    q = jnp.asarray(rng.standard_normal((T, Hq, D)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((P, page_size, Hkv, D)),
+                    jnp.float32)
+    v = jnp.asarray(rng.standard_normal((P, page_size, Hkv, D)),
+                    jnp.float32)
+    arrs = {n: jnp.asarray(b[n]) for n in
+            ("span_pt", "block_seq", "block_qpos", "span_len", "ctx_len",
+             "out_rows")}
+    return q, k, v, arrs
+
+
+class TestRaggedKernel:
+    @pytest.mark.parametrize("page_size,rep,block_q",
+                             [(4, 1, 4), (4, 2, 2), (8, 4, 4), (16, 2, 8)])
+    def test_matches_gather_reference(self, page_size, rep, block_q):
+        """Interpret-mode kernel vs the dense gather reference on a MIXED
+        batch: decode spans (len 1), a mid-prefill chunk (cached context
+        behind it), and a fresh chunk, across page sizes / GQA ratios /
+        row-block sizes."""
+        Hkv, D = 2, 16
+        spec = [(1, 7), (5, 9), (3, 3), (1, 1)]
+        q, k, v, a = _kernel_case(page_size + rep, spec, Hkv * rep, Hkv,
+                                  D, page_size, 4, block_q)
+        got = pra.ragged_attention_pallas(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"], interpret=True)
+        want = pra.ragged_attention_reference(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_decode_spans_match_paged_kernel(self):
+        """A decode-only ragged batch IS the old workload: the unified
+        kernel must reproduce the paged decode kernel exactly on the
+        same pools (the engine's migration-safety guarantee)."""
+        Hkv, rep, D, ps, pps, bq = 2, 2, 16, 4, 4, 2
+        spec = [(1, 5), (1, 16), (1, 1)]
+        q, k, v, a = _kernel_case(11, spec, Hkv * rep, Hkv, D, ps, pps, bq)
+        got = pra.ragged_attention_pallas(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"], interpret=True)
+        rows = np.asarray(a["out_rows"])[:len(spec)]
+        old = ppa.paged_attention_pallas(
+            q[rows], k, v, a["span_pt"][:len(spec)],
+            a["ctx_len"][:len(spec)], interpret=True)
+        np.testing.assert_allclose(np.asarray(got)[rows], np.asarray(old),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_whole_prompt_span_is_causal_attention(self):
+        """One span carrying its WHOLE context (span_len == ctx_len, the
+        resume-as-ragged-prefill shape) must equal plain causal
+        attention over the span's rows."""
+        Hkv, rep, D, ps, bq = 2, 2, 8, 4, 4
+        L = 7
+        q, k, v, a = _kernel_case(3, [(L, L)], Hkv * rep, Hkv, D, ps, 3,
+                                  bq)
+        got = pra.ragged_attention_pallas(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"], interpret=True)
+        # dense oracle: gather the span's pages, causal-mask, softmax
+        pt = np.asarray(a["span_pt"])[0]
+        ck = np.asarray(k)[pt].reshape(-1, Hkv, D)[:L]     # (L, Hkv, D)
+        cv = np.asarray(v)[pt].reshape(-1, Hkv, D)[:L]
+        qf = np.asarray(q)[:L].reshape(L, Hkv, rep, D) / np.sqrt(D)
+        s = np.einsum("thrd,mhd->thrm", qf, ck)
+        s = np.where(np.tril(np.ones((L, L), bool))[:, None, None],
+                     s, -np.inf)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        want = np.einsum("thrm,mhd->thrd", p, cv).reshape(L, Hkv * rep, D)
+        np.testing.assert_allclose(np.asarray(got)[:L], want,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_padding_rows_are_zero(self):
+        """Rows past span_len — and whole padding spans — must come out
+        exactly zero (the engine ignores them, but NaNs would poison a
+        donated accumulation downstream)."""
+        Hkv, rep, D, ps, bq = 1, 2, 8, 4, 4
+        q, k, v, a = _kernel_case(5, [(3, 6), (1, 4)], Hkv * rep, Hkv, D,
+                                  ps, 3, bq)
+        got = np.asarray(pra.ragged_attention_pallas(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"], interpret=True))
+        assert np.isfinite(got).all()
+        assert (got[3] == 0).all()          # span 0 rows past len 3
+        assert (got[5:] == 0).all()         # span 1's block tail
+        ref = np.asarray(pra.ragged_attention_reference(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"]))
+        assert (ref[3] == 0).all() and (ref[5:] == 0).all()
+
+    def test_dispatcher_reference_fallback(self):
+        """kernels.ragged_attention with fused kernels disabled routes to
+        the gather reference."""
+        from paddle_tpu import framework, kernels
+        q, k, v, a = _kernel_case(9, [(1, 5), (4, 4)], 4, 2, 8, 4, 3, 4)
+        flags = framework.get_state().flags
+        prev = flags.get("FLAGS_use_fused_kernels", True)
+        try:
+            flags["FLAGS_use_fused_kernels"] = False
+            got = kernels.ragged_attention(
+                q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+                a["span_len"], a["ctx_len"])
+        finally:
+            flags["FLAGS_use_fused_kernels"] = prev
+        want = pra.ragged_attention_reference(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestBuildRaggedBatch:
+    def test_layout_invariants(self):
+        spans = [generation.RaggedSpan([5], 9, [3, 7, 7]),
+                 generation.RaggedSpan([1, 2, 3, 4, 5], 5, [2, 9])]
+        b = generation.build_ragged_batch(spans, num_blocks=4, num_spans=4,
+                                          block_q=2, page_size=4,
+                                          pages_per_seq=3)
+        # span 0: one block; span 1: three blocks (5 tokens / block_q 2)
+        np.testing.assert_array_equal(b["block_seq"], [0, 1, 1, 1])
+        np.testing.assert_array_equal(b["block_qpos"], [0, 0, 2, 4])
+        np.testing.assert_array_equal(b["span_len"][:2], [1, 5])
+        np.testing.assert_array_equal(b["ctx_len"][:2], [9, 5])
+        np.testing.assert_array_equal(b["out_rows"][:2], [0, 6])
+        # decode token of span 0 lands at position 8 = page idx 2 -> 7
+        assert b["row_page"][0] == 7 and b["row_off"][0] == 0
+        assert b["row_pos"][0] == 8
+        # span 1's rows scatter at positions 0..4 across pages [2, 9]
+        np.testing.assert_array_equal(b["row_page"][2:7], [2, 2, 2, 2, 9])
+        np.testing.assert_array_equal(b["row_off"][2:7], [0, 1, 2, 3, 0])
+        # padding rows target scratch page 0; unused blocks belong to the
+        # reserved padding span (num_spans - 1) with span_len 0
+        assert (b["row_page"][1] == 0) and (b["row_page"][7] == 0)
+        assert b["span_len"][3] == 0
+        # span_pt pads the tail with the last real page
+        np.testing.assert_array_equal(b["span_pt"][1], [2, 9, 9])
+
+    def test_rejects_overflow_and_empty(self):
+        mk = generation.RaggedSpan
+        with pytest.raises(ValueError, match="does not fit"):
+            generation.build_ragged_batch(
+                [mk([1, 2, 3], 3, [1])], num_blocks=1, num_spans=2,
+                block_q=2, page_size=4, pages_per_seq=1)
+        with pytest.raises(ValueError, match="exceed num_spans"):
+            generation.build_ragged_batch(
+                [mk([1], 1, [1]), mk([1], 1, [1])], num_blocks=4,
+                num_spans=2, block_q=2, page_size=4, pages_per_seq=1)
+        with pytest.raises(ValueError, match="cannot hold"):
+            generation.build_ragged_batch(
+                [mk([1], 9, [1])], num_blocks=2, num_spans=2, block_q=2,
+                page_size=4, pages_per_seq=3)
+        with pytest.raises(ValueError, match="at least one token"):
+            generation.build_ragged_batch(
+                [mk([], 1, [1])], num_blocks=2, num_spans=2, block_q=2,
+                page_size=4, pages_per_seq=1)
+
+
+class TestGenerateRagged:
+    @pytest.mark.parametrize("page_size,chunk,block_q",
+                             [(4, 5, 4), (16, 8, 4), (4, 1, 2)])
+    def test_token_exact_vs_dense_and_paged(self, tiny, page_size, chunk,
+                                            block_q):
+        """The whole functional chain — chunked ragged prefill + 1-token
+        ragged decode spans — reproduces dense generate() AND the paged
+        path exactly, greedy, across chunk budgets (chunk=1 is the
+        pathological all-chunks case)."""
+        cfg, params = tiny
+        for seed in range(2):
+            ids = jnp.asarray(np.random.default_rng(seed).integers(
+                0, cfg.vocab_size, (2, 7)), jnp.int32)
+            want = generation.generate(params, ids, cfg, max_new_tokens=5)
+            paged = generation.generate_paged(
+                params, ids, cfg, max_new_tokens=5, page_size=page_size)
+            got = generation.generate_ragged(
+                params, ids, cfg, max_new_tokens=5, page_size=page_size,
+                prefill_chunk_tokens=chunk, block_q=block_q)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(want))
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(paged))
+
+
+class TestEngineRagged:
+    def _engine(self, tiny, **kw):
+        from paddle_tpu.inference import LLMEngine
+        cfg, params = tiny
+        kw.setdefault("num_slots", 2)
+        kw.setdefault("page_size", 4)
+        kw.setdefault("max_seq_len", 32)
+        kw.setdefault("prefill_chunk_tokens", 4)
+        kw.setdefault("block_q", 2)
+        return LLMEngine(params, cfg, **kw)
+
+    def test_mixed_step_is_one_dispatch(self, tiny):
+        """THE acceptance bar: a step advancing a decoding slot AND a
+        prefilling slot issues exactly ONE attention dispatch, carrying
+        both span kinds in one ragged batch."""
+        cfg, params = tiny
+        eng = self._engine(tiny)
+        rng = np.random.default_rng(0)
+        a = eng.submit(rng.integers(0, cfg.vocab_size, 3).tolist(),
+                       max_new_tokens=8)
+        eng.step()                 # admit A + its whole 3-token chunk
+        eng.step()                 # A decodes
+        assert not eng._slots[
+            next(iter(eng._slots))].prefilling
+        b = eng.submit(rng.integers(0, cfg.vocab_size, 11).tolist(),
+                       max_new_tokens=4)
+        calls = {"n": 0}
+        real = eng._ragged
+
+        def counting(*args, **kw):
+            calls["n"] += 1
+            return real(*args, **kw)
+
+        eng._ragged = counting
+        snap0 = eng.stats_snapshot()
+        eng.step()                 # A's decode span + B's first chunk
+        assert calls["n"] == 1
+        kinds = sorted(k for _s, k, _n in eng._batch_spans)
+        assert kinds == ["chunk", "decode"]
+        snap1 = eng.stats_snapshot()
+        assert snap1["decode_tokens"] - snap0["decode_tokens"] == 1
+        assert snap1["prefill_chunks"] - snap0["prefill_chunks"] == 1
+        assert snap1["prefill_tokens"] - snap0["prefill_tokens"] == 4
+        assert (snap1["ragged_batch_tokens"]
+                - snap0["ragged_batch_tokens"]) == 5
+        eng._ragged = real
+        while not (a.done() and b.done()):
+            eng.step()
+        for h in (a, b):
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([h.prompt], jnp.int32), cfg,
+                max_new_tokens=h.max_new_tokens))[0].tolist()
+            assert list(h.result(timeout=5)) == want
+
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_chunked_preempt_resume_token_exact(self, tiny, mode):
+        """Chunked prefill under page pressure: prompts longer than the
+        chunk budget prefill across steps, get preempted (including
+        mid-prefill victims), resume in either mode, and still match the
+        offline greedy chain."""
+        cfg, params = tiny
+        rng = np.random.default_rng(1)
+        eng = self._engine(tiny, max_seq_len=16, num_pages=5,
+                           preempt_mode=mode)
+        prompts = [rng.integers(0, cfg.vocab_size, 9).tolist()
+                   for _ in range(3)]
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for p, got in zip(prompts, outs):
+            want = np.asarray(generation.generate(
+                params, jnp.asarray([p], jnp.int32), cfg,
+                max_new_tokens=4))[0].tolist()
+            assert got == want
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["prefill_chunks"] >= 3  # 9 tokens / budget 4
+        from paddle_tpu.inference import faults as F
+        F.check_invariants(eng)
+
+    def test_recompile_sentinel_silent_after_warmup(self, tiny):
+        """The bucket menu's recompile class is GONE: after the first
+        (warmup) compile, a workload mixing short prompts, long chunked
+        prompts, and preempt-resume drives the ONE ragged executable —
+        the sentinel must not see a single post-warmup recompile."""
+        cfg, params = tiny
+        eng = self._engine(tiny, max_seq_len=16, num_pages=5,
+                           preempt_mode="recompute")
+        sent = obs.RecompileSentinel(tracer=eng.tracer,
+                                     registry=obs.Registry())
+        sent.watch("ragged_step", eng._ragged)
+        rng = np.random.default_rng(2)
+        h = eng.submit(rng.integers(0, cfg.vocab_size, 2).tolist(),
+                       max_new_tokens=2)
+        eng.step()                       # warmup: the one compile
+        assert sent.check() == {}        # baselined, silent
+        handles = [h]
+        for n in (7, 3, 9, 5, 11):       # mixed lengths, some > budget,
+            handles.append(              # pool pressure -> preemption
+                eng.submit(rng.integers(0, cfg.vocab_size, n).tolist(),
+                           max_new_tokens=3))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", obs.RecompileWarning)
+            steps = 0
+            while any(not x.done() for x in handles) and steps < 500:
+                eng.step()
+                assert sent.check() == {}, \
+                    "post-warmup recompile in the unified ragged step"
+                steps += 1
+        assert all(x.done() for x in handles)
+        assert eng.stats["preemptions"] >= 1   # the workload DID churn
+        assert sent.counts() == {"ragged_step": 0}

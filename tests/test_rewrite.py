@@ -358,10 +358,11 @@ def _load_graphlint():
 
 _graphlint = _load_graphlint()
 
-# the ISSUE's representative set: train step, MoE gmm dispatch, engine
-# decode (+ generate_paged, whose scan-body dead code exercises the
-# recursive DCE); the full 8-target sweep runs in the bench round
-_GATE_TARGETS = ["llama", "moe_llama_gmm", "engine_decode",
+# the ISSUE's representative set: train step, MoE gmm dispatch, the
+# engine's unified ragged step (+ generate_paged, whose scan-body dead
+# code exercises the recursive DCE); the full sweep runs in the bench
+# round
+_GATE_TARGETS = ["llama", "moe_llama_gmm", "engine_ragged",
                  "generate_paged"]
 
 

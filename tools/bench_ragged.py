@@ -1,0 +1,104 @@
+"""Chunk-budget sweep for the unified ragged prefill+decode step (run
+manually; bench.py's extra.ragged stays the driver's single-line A/B).
+
+Usage:  python tools/bench_ragged.py [--budgets 4,8,16,40] [--long 40]
+                                     [--streams 2] [--new-tokens 16]
+
+Workload per point: `--streams` short requests decode continuously while
+one `--long`-token prompt prefills through the SAME unified ragged
+dispatch, its chunks bounded by the point's `prefill_chunk_tokens`
+budget.  The sweep exposes the knob's latency/throughput trade:
+
+  * small budget  -> tight inter-token p99 for the in-flight streams
+    (each step carries at most a small chunk) but later time-to-first-
+    token for the long prompt, and a smaller fixed batch (cheaper
+    steady-state steps).
+  * budget >= prompt -> the whole prefill lands in ONE step: fastest
+    TTFT for the long prompt, worst head-of-line stall for everyone
+    else — the old two-dispatch world's behavior, reproduced inside the
+    unified step.
+
+Every point is ONE compiled executable regardless of prompt length (the
+batch arrays are fixed-shape) — the sweep never recompiles mid-workload,
+which is the point of killing the bucket menu.  Prints one JSON line per
+budget; nothing here is driver-consumed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--budgets", default="4,8,16,40",
+                    help="comma-separated prefill_chunk_tokens points")
+    ap.add_argument("--long", type=int, default=40,
+                    help="long prompt length (tokens)")
+    ap.add_argument("--streams", type=int, default=2,
+                    help="concurrent short decoding requests")
+    ap.add_argument("--new-tokens", type=int, default=16,
+                    help="tokens each stream decodes")
+    ap.add_argument("--page-size", type=int, default=4)
+    ap.add_argument("--block-q", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import numpy as np
+    import jax
+
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    rng = np.random.default_rng(args.seed)
+    max_seq = max(64, args.long + 8)
+    long_prompt = rng.integers(0, cfg.vocab_size, args.long).tolist()
+    shorts = [rng.integers(0, cfg.vocab_size, 3).tolist()
+              for _ in range(args.streams)]
+
+    for budget in (int(b) for b in args.budgets.split(",")):
+        eng = LLMEngine(params, cfg, num_slots=args.streams + 2,
+                        page_size=args.page_size, max_seq_len=max_seq,
+                        prefill_chunk_tokens=budget,
+                        block_q=args.block_q)
+        eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the executable
+        hs = [eng.submit(p, max_new_tokens=args.new_tokens)
+              for p in shorts]
+        for _ in range(3):
+            eng.step()               # streams decoding before the burst
+        t0 = time.perf_counter()
+        lh = eng.submit(long_prompt, max_new_tokens=2)
+        while not lh.done() or not all(h.done() for h in hs):
+            eng.step()
+        dt = time.perf_counter() - t0
+        snap = eng.stats_snapshot()
+        lat = eng.latency_snapshot()
+        itl = lat["inter_token_s"]
+        eng.shutdown()
+        print(json.dumps({
+            "prefill_chunk_tokens": budget,
+            "long_ttft_ms": round((lh.t_first_token - lh.t_submit) * 1e3,
+                                  2),
+            "stream_itl_p50_ms": round((itl["p50"] or 0.0) * 1e3, 3),
+            "stream_itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
+            "decode_tokens_per_sec": round(snap["decode_tokens"] / dt, 2),
+            "prefill_chunks": snap["prefill_chunks"],
+            "ragged_batch_tokens": snap["ragged_batch_tokens"],
+            "steps": snap["steps_total"],
+            "wall_s": round(dt, 3),
+        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,14 +44,16 @@ def _percentiles(samples):
             "n": len(samples)}
 
 
-def run_bench(trials: int = 15) -> dict:
+def run_bench(trials: int = 15, prefill_chunk: int = 6) -> dict:
     """Router micro-bench on 2-replica scripted fleets."""
     from paddle_tpu.inference import faults as F
     from paddle_tpu.inference.router import Router
     from paddle_tpu.inference.supervisor import EngineSupervisor
 
     def mk():
-        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
+                                prefill_chunk_tokens=prefill_chunk,
+                                block_q=2)
 
     # placement overhead: N submits through the scoring path (manual
     # mode, drained between batches so queues stay comparable)
@@ -111,11 +113,15 @@ def main():
                     help="run the fleet serving probe every Nth schedule")
     ap.add_argument("--bench", action="store_true",
                     help="run the router micro-bench instead of the soak")
+    ap.add_argument("--prefill-chunk", type=int, default=6,
+                    help="prefill_chunk_tokens for every replica engine "
+                         "(small default -> multi-chunk prefills, so "
+                         "replica death mid-chunk is actually exercised)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
     if args.bench:
-        out = run_bench()
+        out = run_bench(prefill_chunk=args.prefill_chunk)
         print(json.dumps(out, indent=None if args.json else 2))
         return 0
 
@@ -124,7 +130,9 @@ def main():
     from paddle_tpu.inference import faults as F
 
     def mk():
-        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
+                                prefill_chunk_tokens=args.prefill_chunk,
+                                block_q=2)
 
     def ref(h):
         return F.ScriptedEngine.reference_tokens(

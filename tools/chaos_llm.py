@@ -40,6 +40,11 @@ def main():
                          "worst case, forcing preemption)")
     ap.add_argument("--requests", type=int, default=4,
                     help="requests per schedule")
+    ap.add_argument("--prefill-chunk", type=int, default=6,
+                    help="prefill_chunk_tokens: per-step token budget for "
+                         "prefill chunks riding the unified ragged batch "
+                         "(small by default so multi-chunk prefills — and "
+                         "mid-prefill faults/preemptions — actually occur)")
     ap.add_argument("--probe-every", type=int, default=5,
                     help="run the fresh-request serving probe every Nth "
                          "schedule (1 = always; probes dominate runtime)")
@@ -61,7 +66,8 @@ def main():
     def make_engine(mode):
         return lambda: LLMEngine(
             params, cfg, num_slots=args.slots, page_size=4, max_seq_len=16,
-            num_pages=args.num_pages, preempt_mode=mode)
+            num_pages=args.num_pages, preempt_mode=mode,
+            prefill_chunk_tokens=args.prefill_chunk, block_q=2)
 
     reports, violations = [], 0
     totals = {"fired": 0, "completed": 0, "failed": 0, "preemptions": 0,

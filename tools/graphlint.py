@@ -9,9 +9,9 @@ Targets (default: all):
   moe_llama_gmm      MoE train step, dropless Pallas grouped-matmul dispatch
   moe_llama_scatter  MoE train step, capacity-based scatter dispatch
   generate_paged     paged-KV single-shot generation (prefill + decode scan)
-  engine_decode      LLMEngine's jitted continuous-batching decode step
-  engine_prefill     LLMEngine's jitted admission prefill (the bucket menu
-                     rides the shape-poly probe: its compiles are expected)
+  engine_ragged      LLMEngine's ONE jitted unified step: decode spans and
+                     prefill chunks in the same ragged batch (single
+                     signature — expected_signatures defaults to 1)
   engine_swap_out    LLMEngine's preemption page-gather (KV -> host)
   engine_swap_in     LLMEngine's resume page-scatter (host -> fresh pages)
 
@@ -185,26 +185,13 @@ def _engine():
         params
 
 
-def target_engine_decode():
-    import jax.numpy as jnp
+def target_engine_ragged():
     eng, params = _engine()
-    toks = jnp.zeros((2,), jnp.int32)
-    ctx = jnp.zeros((2,), jnp.int32)
-    args = (params, toks, ctx, eng.cache.page_table,
-            eng.cache.pools["k"], eng.cache.pools["v"])
-    return eng._decode, args, {}
-
-
-def target_engine_prefill():
-    eng, params = _engine()
-    # the prefill bucket menu IS the compile plan: probe every bucket's
-    # signature and tell the shape-poly checker exactly that many are
-    # EXPECTED — the lint then fails only if something shape-polymorphic
-    # leaks past the bucketing (a new signature outside the menu)
-    probes = eng.prefill_probe_args()
-    return eng._prefill, probes[0], {
-        "probe_args": probes[1:],
-        "options": {"expected_signatures": len(eng.prefill_buckets)}}
+    # the unified ragged step replaced both the bucketed prefill menu and
+    # the separate decode dispatch: ONE fixed-shape signature serves every
+    # mix of prompt lengths, so the shape-poly gate expects exactly one
+    # compile (the default) — any second signature is a regression
+    return eng._ragged, eng.ragged_probe_args(), {}
 
 
 def target_engine_swap_out():
@@ -237,8 +224,7 @@ TARGETS = {
     "moe_llama_gmm": target_moe_llama_gmm,
     "moe_llama_scatter": target_moe_llama_scatter,
     "generate_paged": target_generate_paged,
-    "engine_decode": target_engine_decode,
-    "engine_prefill": target_engine_prefill,
+    "engine_ragged": target_engine_ragged,
     "engine_swap_out": target_engine_swap_out,
     "engine_swap_in": target_engine_swap_in,
 }
