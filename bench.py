@@ -470,6 +470,108 @@ def bench_ragged(dev, on_tpu):
     }
 
 
+def bench_specdec(dev, on_tpu):
+    """extra.specdec: speculative decoding A/B — emitted tokens/sec and
+    inter-token latency, speculative (n-gram prompt-lookup drafter
+    through ragged verify spans) vs plain decode, on two workloads:
+
+      * repetitive — greedy decoding of prompts whose continuation the
+        drafter can find in the request's own history (the acceptance-
+        friendly case: copy tasks, code, greedy cycles).  The
+        acceptance bound pins >= 1.5x emitted tokens/sec here.
+      * adversarial — temperature-1.0 sampling of random prompts: the
+        sampled continuation almost never repeats, so drafts are almost
+        all rejected — the floor case.  Speculation must not fall
+        below plain decode (rejected drafts cost verify rows inside the
+        decode span's already-padded block, not extra dispatches).
+
+    Both legs share one geometry with block_q = spec_k + 1, so a verify
+    span fills EXACTLY the padded row block a plain decode span already
+    occupies — the speculative batch is the same compiled shape and the
+    same row count as the plain one, and the drafts ride rows that were
+    previously padding.  One dispatch per step either way;
+    acceptance-rate reported from the obs gauge the router places on."""
+    import time as _time
+    import jax as _jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            dtype=jnp.bfloat16, remat=False)
+        new_tokens, page_size, max_seq, spec_k, streams = 128, 64, 4096, \
+            7, 4
+        prompt_len, block_q = 64, 8
+    else:
+        # chunk budget == block_q: one prefill block; spec_k=5 with
+        # block_q=6 keeps verify spans inside the decode span's block
+        cfg = LlamaConfig.tiny()
+        new_tokens, page_size, max_seq, spec_k, streams = 96, 4, 128, 5, 2
+        prompt_len, block_q = 8, 6
+
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(3))
+    rng = np.random.default_rng(0)
+    # repetitive: a short pattern repeated fills the prompt, so the
+    # drafter proposes from step one AND the greedy chain's own cycles
+    # keep feeding it (output-history lookup)
+    repetitive = []
+    for _ in range(streams):
+        pat = rng.integers(0, cfg.vocab_size, 3).tolist()
+        repetitive.append((pat * prompt_len)[:prompt_len])
+    adversarial = [rng.integers(0, cfg.vocab_size, prompt_len).tolist()
+                   for _ in range(streams)]
+
+    def run(k, prompts, temperature=0.0):
+        eng = LLMEngine(params, cfg, num_slots=streams,
+                        page_size=page_size, max_seq_len=max_seq,
+                        prefill_chunk_tokens=max(block_q, page_size),
+                        block_q=block_q, spec_k=k,
+                        temperature=temperature)
+        eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the executable
+        t0 = _time.perf_counter()
+        hs = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        while not all(h.done() for h in hs):
+            eng.step()
+        dt = _time.perf_counter() - t0
+        snap = eng.stats_snapshot()
+        itl = eng.latency_snapshot()["inter_token_s"]
+        accept = eng.metrics.get("llm_spec_acceptance_rate").value
+        # exact emitted count from the handles themselves (counters
+        # split first tokens / decode / verify and include the warmup)
+        emitted = sum(len(h.result(timeout=0)) for h in hs)
+        eng.shutdown()
+        return {
+            "tokens_per_sec": round(emitted / dt, 2),
+            "itl_p50_ms": round((itl["p50"] or 0.0) * 1e3, 3),
+            "itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
+            "steps": snap["steps_total"],
+            "acceptance_rate": round(accept, 4),
+            "spec_drafted": snap["spec_drafted"],
+            "spec_emitted": snap["spec_emitted"],
+        }
+
+    out = {"spec_k": spec_k,
+           "workload": {"streams": streams, "prompt": prompt_len,
+                        "new_tokens": new_tokens}}
+    for name, prompts, temp in (("repetitive", repetitive, 0.0),
+                                ("adversarial", adversarial, 1.0)):
+        plain = run(0, prompts, temp)
+        spec = run(spec_k, prompts, temp)
+        out[name] = {
+            "plain": plain, "spec": spec,
+            # the headline: emitted-token throughput, spec vs plain
+            "speedup": (round(spec["tokens_per_sec"]
+                              / plain["tokens_per_sec"], 3)
+                        if plain["tokens_per_sec"] else None),
+            "acceptance_rate": spec["acceptance_rate"],
+        }
+    return out
+
+
 def _engine_lifecycle_counters():
     """LLMEngine preemption/lifecycle counters + request latency
     percentiles on a deliberately undersized page pool (2 slots whose
@@ -634,7 +736,7 @@ def _sub_main(name: str) -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode,
-          "ragged": bench_ragged}[name]
+          "ragged": bench_ragged, "specdec": bench_specdec}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
     except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
@@ -723,6 +825,7 @@ def main():
     moe_extra = _run_sub("moe")
     decode_extra = _run_sub("decode")
     ragged_extra = _run_sub("ragged")
+    specdec_extra = _run_sub("specdec")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
@@ -768,6 +871,10 @@ def main():
             # unified ragged prefill+decode: ITL-under-concurrent-prefill
             # A/B (chunked vs one-shot vs decode-only baseline)
             "ragged": ragged_extra,
+            # speculative decoding A/B (n-gram drafter + ragged verify
+            # spans vs plain decode): emitted tokens/sec speedup +
+            # acceptance rate on repetitive and adversarial workloads
+            "specdec": specdec_extra,
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
             "graphlint": graphlint_extra,

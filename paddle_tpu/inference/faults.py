@@ -35,8 +35,9 @@ from . import llm_engine as _llm
 __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
            "InjectedCrash", "InvariantViolation", "FaultRule",
            "FaultInjector", "random_schedule", "drive", "check_invariants",
-           "run_schedule", "ScriptedEngine", "fleet_random_schedule",
-           "drive_fleet", "fleet_check_invariants", "fleet_run_schedule"]
+           "run_schedule", "ScriptedEngine", "EchoDrafter",
+           "fleet_random_schedule", "drive_fleet",
+           "fleet_check_invariants", "fleet_run_schedule"]
 
 # the engine's named injection points, in rough lifecycle order ("step"
 # wraps the whole step loop: a crash=True rule there kills the step
@@ -45,8 +46,13 @@ __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
 # right after it with the chunk's (tokens, start) context — a rule there
 # kills a request mid-chunked-prefill; "decode" fires once per unified
 # ragged dispatch (the ONE attention dispatch of a mixed step).
-FAULT_POINTS = ("step", "prefill", "prefill_chunk", "decode",
-                "page_alloc", "sample", "swap_out", "swap_in")
+# Speculative decoding adds "draft" (per decoding slot, before the
+# drafter proposes — a fault there fails that request, a consume_pools
+# rule poisons the step's dispatch) and "verify" (once per dispatch
+# carrying >= 1 verify span, before the accept/reject pass — a fault
+# there fails the step like a dispatch fault, mid-speculation).
+FAULT_POINTS = ("step", "prefill", "prefill_chunk", "draft", "decode",
+                "verify", "page_alloc", "sample", "swap_out", "swap_in")
 
 # the Router's named injection points — fleet-tier failure shapes.
 #   replica_death:    fired per replica on each health tick; a match makes
@@ -67,8 +73,8 @@ FLEET_FAULT_POINTS = ("replica_death", "slow_replica", "health_flap",
 
 # points where a `consume_pools` rule is meaningful: the engine passes its
 # (to-be-donated or read) pools in the fire() context there
-_DISPATCH_POINTS = ("prefill", "prefill_chunk", "decode", "swap_out",
-                    "swap_in")
+_DISPATCH_POINTS = ("prefill", "prefill_chunk", "draft", "decode",
+                    "verify", "swap_out", "swap_in")
 
 
 class InjectedFault(RuntimeError):
@@ -302,7 +308,8 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         reg_vals = {}
         if registry is not None:
             for key in ("accepted", "admitted", "completed", "cancelled",
-                        "timed_out", "failed", "preemptions"):
+                        "timed_out", "failed", "preemptions",
+                        "spec_drafted", "spec_accepted"):
                 counter = registry.get(f"llm_{key}_total")
                 reg_vals[key] = (None if counter is None
                                  else int(counter.value))
@@ -317,14 +324,46 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
                 "terminal counters)")
     if "ragged_batch_tokens" in snap:
         # every valid token of every ragged dispatch is either a decode
-        # span's token or part of a prefill chunk — counted in one place,
-        # so drift means a batch was built and accounted inconsistently
+        # span's token, part of a prefill chunk, or a speculative verify
+        # row — counted in one place, so drift means a batch was built
+        # and accounted inconsistently
         ragged = snap["ragged_batch_tokens"]
-        parts = snap.get("decode_tokens", 0) + snap.get("prefill_tokens", 0)
+        parts = (snap.get("decode_tokens", 0)
+                 + snap.get("prefill_tokens", 0)
+                 + snap.get("verify_tokens", 0))
         if ragged != parts:
             violations.append(
                 f"ragged token identity broken: ragged_batch_tokens="
-                f"{ragged} != decode_tokens+prefill_tokens={parts}")
+                f"{ragged} != decode_tokens+prefill_tokens+verify_tokens="
+                f"{parts}")
+    if "verify_tokens" in snap:
+        # speculative token identities: every dispatched verify row is an
+        # accepted draft, a rejected draft, or the span's one bonus row
+        # (whose logits sample the correction/bonus token); every draft
+        # is accepted or rejected exactly once; a span emits its accepted
+        # drafts plus the bonus token, minus anything cut by
+        # eos/max_new_tokens truncation.  The row-vs-verdict identity is
+        # only decidable at quiescence: verify_tokens lands with the
+        # dispatch accounting, the verdicts land after the accept/reject
+        # pass, so mid-step the rows legitimately lead.
+        rows = snap["verify_tokens"]
+        acc, rej = snap.get("spec_accepted", 0), snap.get("spec_rejected", 0)
+        bonus, drafted = snap.get("spec_bonus", 0), snap.get("spec_drafted", 0)
+        if quiesced and rows != acc + rej + bonus:
+            violations.append(
+                f"verify row identity broken: verify_tokens={rows} != "
+                f"spec_accepted+spec_rejected+spec_bonus="
+                f"{acc + rej + bonus}")
+        if drafted != acc + rej:
+            violations.append(
+                f"draft identity broken: spec_drafted={drafted} != "
+                f"spec_accepted+spec_rejected={acc + rej}")
+        if snap.get("spec_emitted", 0) > acc + bonus:
+            violations.append(
+                f"spec emission overflow: spec_emitted="
+                f"{snap['spec_emitted']} > spec_accepted+spec_bonus="
+                f"{acc + bonus} (a verify span emitted tokens it never "
+                "sampled)")
     if registry is not None:
         for key, val in reg_vals.items():
             if val is None:
@@ -411,6 +450,17 @@ def run_schedule(make_engine: Callable[[], object],
 
 # -- scripted engine: the real scheduler at chaos-suite speed --------------
 
+class EchoDrafter:
+    """Always-propose drafter for chaos/soak runs: proposes the
+    history's own head, so EVERY decode step carries a verify span and
+    the drafts are mostly rejected — the most chaotic case, since every
+    span rolls back under the injected faults and page pressure.
+    Duck-typed to generation.Drafter (propose(history, k)) without
+    importing the model stack."""
+
+    def propose(self, history, k):
+        return np.asarray(history[:k], np.int32)
+
 class _ScriptedConfig:
     """Minimal model config for a ScriptedEngine: just enough for the
     paged-cache bookkeeping (1 layer, 1 KV head, head_dim 2 — a few KB of
@@ -467,22 +517,32 @@ class ScriptedEngine(_llm.LLMEngine):
         def fake_ragged(params, tok, row_page, row_off, row_pos,
                         block_seq, block_qpos, span_len, ctx_len, span_pt,
                         out_rows, k_pool, v_pool):
-            # logits row i belongs to span i of engine._batch_spans; only
-            # spans that SAMPLE (decode, or a chunk completing a fresh
-            # prefill) are consumed, and for those the scripted next
-            # token is a pure function of the tokens cached after the
-            # span — exactly what the real kernel's span-end logits see
-            logits = np.zeros((self._num_spans, V), np.float32)
+            # logits rows [out_start, out_start+out_len) belong to span i
+            # of engine._batch_spans; only spans that SAMPLE (decode, a
+            # chunk completing a fresh prefill, or every row of a verify
+            # span) are consumed, and for those the scripted next token
+            # is a pure function of the tokens cached up to that row —
+            # exactly what the real kernel's per-row logits see
+            logits = np.zeros((self._num_out, V), np.float32)
             for i, (slot, kind, n) in enumerate(self._batch_spans):
                 st = self._slots.get(slot)
                 if st is None:
                     continue
+                o0, on = self._batch_out[i]
                 if kind == "decode":
-                    seq = [int(t) for t in st.req.prompt] \
+                    seqs = [[int(t) for t in st.req.prompt]
+                            + list(st.req.tokens)]
+                elif kind == "verify":
+                    # row j scores the next token after draft[:j] landed
+                    base = [int(t) for t in st.req.prompt] \
                         + list(st.req.tokens)
+                    draft = self._batch_drafts[slot]
+                    seqs = [base + [int(t) for t in draft[:j]]
+                            for j in range(on)]
                 else:
-                    seq = [int(t) for t in st.pending[:st.ctx + n]]
-                logits[i, _script_next(seq, V)] = 1.0
+                    seqs = [[int(t) for t in st.pending[:st.ctx + n]]]
+                for j, seq in enumerate(seqs):
+                    logits[o0 + j, _script_next(seq, V)] = 1.0
             return logits, k_pool, v_pool
 
         self._ragged = fake_ragged

@@ -225,7 +225,7 @@ class _SlotState:
 
     def __init__(self, req: _Request, admit_seq: int, ctx: int = 0,
                  last_tok: Optional[int] = None, pending=None,
-                 sample_on_finish: bool = True):
+                 sample_on_finish: bool = True, spec_k: int = 0):
         self.req = req
         self.admit_seq = admit_seq  # admission order (victim policy)
         self.ctx = ctx              # tokens currently cached
@@ -235,6 +235,10 @@ class _SlotState:
         # prompts; False for recompute-resume (its next token was already
         # sampled before the preemption)
         self.sample_on_finish = sample_on_finish
+        # adaptive speculative draft length for THIS slot, within
+        # [1, engine.spec_k]; reset to the engine default on (re)admission
+        # — a preempted slot resumes with speculation state reset
+        self.spec_k = spec_k
 
     @property
     def prefilling(self) -> bool:
@@ -260,7 +264,20 @@ class _StatsDict(collections.abc.MutableMapping):
         "prefill_chunks": "prefill chunk spans dispatched",
         "prefill_tokens": "prompt/context tokens prefilled via chunks",
         "ragged_batch_tokens": "total valid tokens across ragged "
-                               "dispatches (decode + prefill spans)",
+                               "dispatches (decode + prefill + verify "
+                               "spans)",
+        "verify_tokens": "rows dispatched in speculative verify spans "
+                         "(last token + drafts)",
+        "spec_steps": "speculative verify spans dispatched",
+        "spec_drafted": "draft tokens proposed into verify spans",
+        "spec_accepted": "draft tokens accepted by the verify pass",
+        "spec_rejected": "draft tokens rejected by the verify pass",
+        "spec_bonus": "verify-span bonus rows sampled (correction at the "
+                      "first rejection, or the free token after full "
+                      "acceptance; one per verify span)",
+        "spec_emitted": "tokens emitted by verify spans (accepted drafts "
+                        "+ the bonus/correction, minus any cut by "
+                        "eos/max_new_tokens)",
         "preemptions": "victims evicted under page pressure",
         "swapped_in": "preempted requests resumed via host-KV scatter",
         "resumed": "preempted requests re-admitted (either mode)",
@@ -342,6 +359,23 @@ class LLMEngine:
     executable regardless of prompt lengths — there is no bucket menu.
     block_q: the kernel's query row-block size; every span occupies
     whole blocks (a decode span pads one block).
+
+    spec_k: speculative decoding — the MAX draft tokens per decoding
+    slot per step (0 disables it; the default).  Each step the drafter
+    proposes up to k tokens per decoding slot, and the slot's span
+    becomes a (k+1)-row VERIFY span ([last_token] + drafts) through the
+    SAME ragged dispatch as prefill chunks — verifying k drafts costs
+    one span in one dispatch, not k steps.  The accept/reject pass is
+    greedy-exact at temperature 0 (accept the longest argmax-agreeing
+    prefix) and rejection sampling otherwise (the output DISTRIBUTION
+    matches non-speculative sampling exactly).  Rejected drafts roll
+    back by per-slot ctx truncation — pages are append-only, so the KV
+    they wrote is logically retired and overwritten in place.  k is
+    ADAPTIVE per slot within [1, spec_k] (grows on full acceptance,
+    shrinks on low), and the batch geometry is sized ONCE for spec_k,
+    so varying k never changes the compiled signature.
+    drafter: a generation.Drafter (default: NGramDrafter prompt-lookup —
+    no second model); ignored when spec_k == 0.
     """
 
     def __init__(self, params, config, num_slots: int = 4,
@@ -354,6 +388,8 @@ class LLMEngine:
                  faults=None,
                  prefill_chunk_tokens: int = 64,
                  block_q: int = 8,
+                 spec_k: int = 0,
+                 drafter=None,
                  tracer: Optional[obs_trace.Tracer] = None,
                  metrics: Optional[obs_metrics.Registry] = None):
         self.params = params
@@ -380,12 +416,29 @@ class LLMEngine:
         self.block_q = int(block_q)
         if self.block_q < 1:
             raise ValueError("block_q must be >= 1")
-        # the ragged batch's fixed geometry: every decoding slot takes one
-        # row block, prefill chunks take ceil(budget / block_q) more —
-        # sized once here, so the unified step is ONE compiled executable
-        self._num_blocks = num_slots \
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError("spec_k must be >= 0")
+        self._drafter = None
+        if self.spec_k > 0:
+            self._drafter = (drafter if drafter is not None
+                             else generation.NGramDrafter())
+        # the ragged batch's fixed geometry: every decoding slot takes
+        # ceil((1 + spec_k) / block_q) row blocks (1 decode token plus up
+        # to spec_k drafts to verify), prefill chunks take
+        # ceil(budget / block_q) more — sized ONCE here for the maximum
+        # k, so the unified step is ONE compiled executable regardless
+        # of how many drafts each slot carries on a given step
+        self._num_blocks = \
+            num_slots * -(-(1 + self.spec_k) // self.block_q) \
             + -(-self.prefill_chunk_tokens // self.block_q)
         self._num_spans = num_slots + 1      # + the padding span
+        # fixed logits-gather width: every slot's span may ask for up to
+        # 1 + spec_k out rows (a verify span needs ALL its rows); with
+        # speculation off this is exactly num_spans — the classic
+        # one-logits-row-per-span signature, unchanged
+        self._num_out = (self._num_spans if self.spec_k == 0
+                         else num_slots * (1 + self.spec_k) + 1)
         pages_per_seq = -(-self.max_seq_len // page_size)
         if num_pages is None:
             num_pages = 1 + num_slots * pages_per_seq   # full provisioning
@@ -415,7 +468,9 @@ class LLMEngine:
         self.stats = _StatsDict(self.metrics, (
             "accepted", "admitted", "completed", "decode_steps",
             "decode_tokens", "prefill_chunks", "prefill_tokens",
-            "ragged_batch_tokens", "preemptions", "swapped_in", "resumed",
+            "ragged_batch_tokens", "verify_tokens", "spec_steps",
+            "spec_drafted", "spec_accepted", "spec_rejected", "spec_bonus",
+            "spec_emitted", "preemptions", "swapped_in", "resumed",
             "cancelled", "timed_out", "failed", "steps_total"))
         reg = self.metrics
         self._h_queue_wait = reg.histogram(
@@ -431,6 +486,23 @@ class LLMEngine:
             "per completed request: tokens / (finish - admission)",
             buckets=(1, 2, 5, 10, 20, 50, 100, 200, 500, 1000, 2000,
                      5000, 10000))
+        self._h_accept = reg.histogram(
+            "llm_spec_accept_ratio",
+            "per verify span: accepted drafts / drafts proposed (the "
+            "per-slot acceptance signal; adaptive k feeds on this)",
+            buckets=(0.0, 0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8,
+                     0.9, 1.0))
+        # replica-level acceptance: cumulative accepted/drafted, 1.0
+        # (neutral) before any drafting — the fleet Router folds this
+        # into its placement score so low-acceptance replicas (which
+        # burn more verify rows per emitted token) lose placement
+        reg.gauge(
+            "llm_spec_acceptance_rate",
+            "cumulative speculative acceptance: accepted/drafted "
+            "(1.0 until the first draft)").set_function(
+            lambda: (self.stats["spec_accepted"]
+                     / self.stats["spec_drafted"])
+            if self.stats["spec_drafted"] else 1.0)
         # gauges read engine state lazily at render/snapshot time (the
         # slot/page structures are owned lock-free by the step thread, so
         # a gauge can be one step fresher than the counters next to it)
@@ -468,8 +540,16 @@ class LLMEngine:
         self._ragged = _ragged
         # the span descriptors of the batch being dispatched, in logits
         # row order: (slot, kind, n_tokens) — ScriptedEngine's fake
-        # compute and the one-dispatch tests read this
+        # compute and the one-dispatch tests read this.  _batch_out is
+        # the parallel (out_start, out_len) logits layout and
+        # _batch_drafts maps slot -> the drafts its verify span carries.
         self._batch_spans: List[tuple] = []
+        self._batch_out: List[tuple] = []
+        self._batch_drafts: dict = {}
+        # accept/reject randomness (rejection sampling + host-side
+        # temperature sampling while speculation is on); independent of
+        # the jax key chain the non-speculative path uses
+        self._spec_rng = np.random.default_rng(seed ^ 0x5bec)
 
         # swap path: page gather (preempt) reads the pools — NOT donated;
         # page scatter (resume) replaces them — donated like decode.  idx
@@ -497,7 +577,11 @@ class LLMEngine:
         shape-poly probe.  Unlike the retired bucket menu (one compiled
         prefill per bucket), the unified step has a single signature:
         `analysis.analyze(engine._ragged, *engine.ragged_probe_args())`
-        must stay clean with the default expected_signatures=1."""
+        must stay clean with the default expected_signatures=1.  With
+        speculation on, the SAME single signature covers verify spans:
+        the batch geometry is sized once for spec_k, and out_rows grows
+        to the fixed num_out — varying per-step k never adds a second
+        executable."""
         pools = self.cache.pools
         T = self._num_blocks * self.block_q
         S = self._num_spans
@@ -513,7 +597,7 @@ class LLMEngine:
             jax.ShapeDtypeStruct((S,), i32),                 # span_len
             jax.ShapeDtypeStruct((S,), i32),                 # ctx_len
             jax.ShapeDtypeStruct((S, self.cache.pages_per_seq), i32),
-            jax.ShapeDtypeStruct((S,), i32),                 # out_rows
+            jax.ShapeDtypeStruct((self._num_out,), i32),     # out_rows
             jax.ShapeDtypeStruct(pools["k"].shape, pools["k"].dtype),
             jax.ShapeDtypeStruct(pools["v"].shape, pools["v"].dtype),
         )
@@ -910,7 +994,8 @@ class LLMEngine:
                                 req.t_admit - req.t_submit)
                         self._slots[slot] = _SlotState(
                             req, self._admit_seq, ctx=0,
-                            pending=req.prompt, sample_on_finish=True)
+                            pending=req.prompt, sample_on_finish=True,
+                            spec_k=self.spec_k)
                         with self._cv:
                             self.stats["admitted"] += 1
             except Exception as e:  # noqa: BLE001 — admission must not leak
@@ -966,7 +1051,8 @@ class LLMEngine:
         req._resume = None
         self._slots[slot] = _SlotState(
             req, self._admit_seq, ctx=rs.ctx, last_tok=rs.last_tok,
-            pending=rs.pending, sample_on_finish=rs.sample_on_finish)
+            pending=rs.pending, sample_on_finish=rs.sample_on_finish,
+            spec_k=self.spec_k)
 
     def _alloc_with_preemption(self, slot: int, n_tokens: int) -> bool:
         """Grow `slot`'s pages to cover n_tokens, preempting victims under
@@ -994,26 +1080,75 @@ class LLMEngine:
                     # recovered the pools and failed this slot too
                     return False
 
+    def _draft_for(self, slot: int, st: _SlotState) -> Optional[np.ndarray]:
+        """Ask the drafter for this decoding slot's proposal, capped by
+        the slot's adaptive k, the request's remaining token budget, and
+        max_seq_len.  Returns None (plain decode span) when speculation
+        is off, the caps leave no room, or the drafter has nothing."""
+        if self._drafter is None:
+            return None
+        # page-budget cap: drafts ride the slot's SLACK (held pages +
+        # free pool) and never trigger preemption on their own — evicting
+        # a neighbour to make room for speculative rows would spend real
+        # work on maybe-tokens.  (The plain decode token still preempts
+        # under pressure, exactly as without speculation.)
+        cache = self.cache
+        headroom = ((len(cache._slot_pages[slot]) + cache.free_page_count)
+                    * cache.page_size - st.ctx - 1)
+        k_cap = min(st.spec_k, self.spec_k,
+                    st.req.max_new_tokens - len(st.req.tokens) - 1,
+                    self.max_seq_len - st.ctx - 1,
+                    headroom)
+        if k_cap < 1:
+            return None
+        history = np.concatenate(
+            [st.req.prompt, np.asarray(st.req.tokens, np.int32)])
+        draft = np.asarray(self._drafter.propose(history, k_cap),
+                           np.int32).reshape(-1)[:k_cap]
+        if draft.size == 0:
+            return None
+        if st.req.eos_id is not None:
+            # drafting past a proposed eos is wasted verify rows
+            hits = np.flatnonzero(draft == st.req.eos_id)
+            if hits.size:
+                draft = draft[:int(hits[0]) + 1]
+        return draft
+
     def _ragged_step(self) -> bool:
         """Advance every active slot through ONE unified ragged dispatch:
-        decoding slots contribute a 1-token span, prefilling slots
-        contribute chunks admitted under the per-step token budget."""
+        decoding slots contribute a 1-token span (or, with speculation
+        on, a (1+k)-row VERIFY span carrying the drafter's proposal),
+        prefilling slots contribute chunks admitted under the per-step
+        token budget."""
         if not self._slots:
             return False
         cache = self.cache
-        # -- 1. decode spans: allocate the incoming token's page ----------
-        decode_slots: List[int] = []
+        # -- 1. decode/verify spans: draft, then allocate the span's pages
+        decode_slots: List[tuple] = []      # (slot, draft-or-None)
         for slot in sorted(self._slots):
             st = self._slots.get(slot)
             if st is None or st.prefilling:
                 continue        # preempted earlier in the pass / chunked
-            if self._alloc_with_preemption(slot, st.ctx + 1):
-                decode_slots.append(slot)
+            try:
+                self._fire("draft", slot=slot, pools=cache.pools)
+                draft = self._draft_for(slot, st)
+            except Exception as e:  # noqa: BLE001 — a drafting fault
+                # fails THIS request; the batch and engine keep going (a
+                # consume_pools rule still surfaces at the dispatch
+                # below and fails the whole step)
+                if slot in self._slots:
+                    self._evict(slot, e, "failed")
+                continue
+            n_new = 1 + (0 if draft is None else int(draft.size))
+            if self._alloc_with_preemption(slot, st.ctx + n_new):
+                decode_slots.append((slot, draft))
         # -- 2. prefill chunks under the token budget ---------------------
-        # blocks are the real capacity: each decode span takes one, each
+        # blocks are the real capacity: each decode span takes
+        # ceil(rows / block_q) (1 row, or 1+k for a verify span), each
         # chunk ceil(n / block_q); scheduling in admission order
         blocks_free = self._num_blocks \
-            - sum(1 for s in decode_slots if s in self._slots)
+            - sum(-(-(1 + (0 if d is None else d.size)) // self.block_q)
+                  for s, d in decode_slots if s in self._slots)
         budget = self.prefill_chunk_tokens
         sched: dict[int, int] = {}
         for slot in sorted((s for s in self._slots
@@ -1045,18 +1180,30 @@ class LLMEngine:
             blocks_free -= -(-n // self.block_q)
             budget -= n
         # preemption during scheduling may have evicted earlier spans
-        decode_slots = [s for s in decode_slots if s in self._slots]
+        decode_slots = [(s, d) for s, d in decode_slots
+                        if s in self._slots]
         sched = {s: n for s, n in sched.items() if s in self._slots}
         if not decode_slots and not sched:
             return True     # allocation alone changed state this pass
         # -- 3. build the fixed-shape ragged batch ------------------------
         spans: List[generation.RaggedSpan] = []
         self._batch_spans = []
-        for slot in decode_slots:
+        self._batch_drafts = {}
+        for slot, draft in decode_slots:
             st = self._slots[slot]
-            spans.append(generation.RaggedSpan(
-                [st.last_tok], st.ctx + 1, cache._slot_pages[slot]))
-            self._batch_spans.append((slot, "decode", 1))
+            if draft is None:
+                spans.append(generation.RaggedSpan(
+                    [st.last_tok], st.ctx + 1, cache._slot_pages[slot]))
+                self._batch_spans.append((slot, "decode", 1))
+            else:
+                # verify span: [last_tok] + drafts, logits for EVERY row
+                # (row j scores the target's next token after draft[:j])
+                rows = 1 + int(draft.size)
+                spans.append(generation.RaggedSpan(
+                    np.concatenate([[st.last_tok], draft]),
+                    st.ctx + rows, cache._slot_pages[slot], n_out=rows))
+                self._batch_spans.append((slot, "verify", rows))
+                self._batch_drafts[slot] = draft
         for slot, n in sched.items():
             st = self._slots[slot]
             spans.append(generation.RaggedSpan(
@@ -1065,11 +1212,16 @@ class LLMEngine:
             self._batch_spans.append((slot, "chunk", n))
         batch = generation.build_ragged_batch(
             spans, self._num_blocks, self._num_spans, self.block_q,
-            cache.page_size, cache.pages_per_seq)
+            cache.page_size, cache.pages_per_seq, num_out=self._num_out)
+        self._batch_out = list(zip(batch["out_start"][:len(spans)],
+                                   batch["out_len"][:len(spans)]))
         # -- 4. ONE dispatch for the whole mixed batch --------------------
+        n_verify = sum(1 for _s, k, _n in self._batch_spans
+                       if k == "verify")
         try:
             with self.tracer.span("decode_step", active=len(spans),
-                                  decode=len(decode_slots),
+                                  decode=len(decode_slots) - n_verify,
+                                  verify=n_verify,
                                   chunks=len(sched)) as sp:
                 self._fire("decode", pools=cache.pools)
                 logits, k_pool, v_pool = self._ragged(
@@ -1086,9 +1238,25 @@ class LLMEngine:
                     cache.pools["k"], cache.pools["v"])
                 sp.fence(logits)
             cache.pools = {"k": k_pool, "v": v_pool}
+            # the verify point wraps the accept/reject pass's input: a
+            # fault here (incl. consume_pools on the freshly-swapped
+            # pools) fails the step exactly like a dispatch fault
+            if n_verify:
+                self._fire("verify", pools=cache.pools)
             with self.tracer.span("sample"):
                 self._fire("sample")
-                nxt = np.asarray(self._sample(logits))
+                if n_verify == 0:
+                    # no verify spans this step (speculation off, or the
+                    # drafter proposed nothing): sample on device — do
+                    # not pull the full (num_out, V) logits block to
+                    # host for nothing
+                    nxt = np.asarray(self._sample(logits))
+                    lg = None
+                else:
+                    # accept/reject (and sampling for plain spans) runs
+                    # host-side over the fixed-shape logits block
+                    nxt = None
+                    lg = np.asarray(logits)
         except Exception as e:  # noqa: BLE001 — dispatch/sampling fault:
             # the donated pools may be consumed and this step's KV writes
             # are suspect.  Fail every in-flight request, recover the
@@ -1096,20 +1264,36 @@ class LLMEngine:
             self._fail_inflight(e)
             return True
         n_prefill_tokens = sum(sched.values())
+        n_verify_rows = sum(n for _s, _k, n in self._batch_spans
+                            if _k == "verify")
         with self._cv:
+            # verify_tokens lands in the SAME locked block as
+            # ragged_batch_tokens so check_invariants' ragged identity
+            # (ragged == decode + prefill + verify) cannot tear against
+            # a concurrent step thread; the per-verdict counters follow
+            # in _commit_verify, so the row-vs-verdict identity is only
+            # decidable at quiescence (the checker gates it there)
             if decode_slots:
                 self.stats["decode_steps"] += 1
-                self.stats["decode_tokens"] += len(decode_slots)
+                self.stats["decode_tokens"] += len(decode_slots) - n_verify
+            if n_verify:
+                self.stats["verify_tokens"] += n_verify_rows
             if sched:
                 self.stats["prefill_chunks"] += len(sched)
                 self.stats["prefill_tokens"] += n_prefill_tokens
-            self.stats["ragged_batch_tokens"] += (len(decode_slots)
-                                                  + n_prefill_tokens)
+            self.stats["ragged_batch_tokens"] += (
+                len(decode_slots) - n_verify + n_verify_rows
+                + n_prefill_tokens)
         # -- 5. post-process each span's outcome --------------------------
         now = time.monotonic()
         for i, (slot, kind, n) in enumerate(self._batch_spans):
             st = self._slots.get(slot)
             if st is None:
+                continue
+            o0, on = self._batch_out[i]
+            if kind == "verify":
+                self._commit_verify(slot, st, lg[o0:o0 + on],
+                                    self._batch_drafts[slot], now)
                 continue
             if kind == "chunk":
                 st.ctx += n
@@ -1121,12 +1305,71 @@ class LLMEngine:
                     st.pending = None
                     continue
                 st.pending = None
-                tok = int(nxt[i])
+                tok = self._row_token(nxt, lg, o0)
             else:
                 st.ctx += 1
-                tok = int(nxt[i])
-            st.req.tokens.append(tok)
+                tok = self._row_token(nxt, lg, o0)
             st.last_tok = tok
+            self._emit_tokens(slot, st, [tok], now)
+        return True
+
+    def _row_token(self, nxt, lg, row: int) -> int:
+        """Next token for a plain (non-verify) span's logits row: the
+        device-sampled array when speculation is off, host sampling off
+        the pulled logits block otherwise."""
+        if nxt is not None:
+            return int(nxt[row])
+        if self.temperature == 0.0:
+            return int(np.argmax(lg[row]))
+        p = generation.filtered_probs(lg[row:row + 1], self.temperature,
+                                      self.top_k, self.top_p)[0]
+        return int(self._spec_rng.choice(p.size, p=p / p.sum()))
+
+    def _commit_verify(self, slot: int, st: _SlotState, rows, draft,
+                       now: float) -> None:
+        """Accept/reject one verify span and commit the outcome: emit the
+        accepted drafts + the correction/bonus token, advance ctx past
+        the ACCEPTED tokens only, and roll back the rejected tail (pure
+        length bookkeeping + trailing-page release — the kernel's
+        ctx_len masking never reads past the sequence length, and the
+        next span overwrites the stale rows in place)."""
+        k = int(draft.size)
+        if self.temperature == 0.0:
+            emitted, m = generation.verify_greedy(rows, draft)
+        else:
+            probs = generation.filtered_probs(
+                rows, self.temperature, self.top_k, self.top_p)
+            emitted, m = generation.verify_rejection(
+                probs, draft, self._spec_rng)
+        # adaptive k: grow on full acceptance, shrink on a bad span
+        if m == k:
+            st.spec_k = min(st.spec_k + 1, self.spec_k)
+        elif 2 * m < k:
+            st.spec_k = max(1, st.spec_k - 1)
+        # commit: last_tok + the m accepted drafts are now real cache
+        # content; the k - m rejected rows are logically retired
+        st.ctx += 1 + m
+        freed = self.cache.truncate_slot(slot, st.ctx)
+        if freed:
+            self.tracer.instant("spec_rollback", slot=slot, pages=freed)
+        st.last_tok = emitted[-1]
+        finished, n_emitted = self._emit_tokens(slot, st, emitted, now)
+        with self._cv:
+            self.stats["spec_steps"] += 1
+            self.stats["spec_drafted"] += k
+            self.stats["spec_accepted"] += m
+            self.stats["spec_rejected"] += k - m
+            self.stats["spec_bonus"] += 1
+            self.stats["spec_emitted"] += n_emitted
+        self._h_accept.observe(m / k if k else 1.0)
+
+    def _emit_tokens(self, slot: int, st: _SlotState, toks, now: float
+                     ) -> tuple:
+        """Append tokens to the request (same timestamp: they arrived in
+        one step), finishing at eos/max_new_tokens — any remaining
+        tokens are dropped.  Returns (finished, n_appended)."""
+        for j, tok in enumerate(toks):
+            st.req.tokens.append(int(tok))
             if st.req.t_first_token is None:
                 st.req.t_first_token = now
                 self._h_ttft.observe(now - st.req.t_submit)
@@ -1137,7 +1380,8 @@ class LLMEngine:
                     or len(st.req.tokens) >= st.req.max_new_tokens:
                 del self._slots[slot]
                 self._finish(slot, st.req)
-        return True
+                return True, j + 1
+        return False, len(toks)
 
     def _fail_inflight(self, e: BaseException) -> None:
         for slot in list(self._slots):

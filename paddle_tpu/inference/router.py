@@ -375,12 +375,17 @@ class Router:
 
     def _score(self, r: Replica):
         """Least-loaded placement score, SMALLER is better: (queue depth
-        + in-flight slots, -free pages), read from the replica's metrics
-        GAUGES — the same storage its /metrics endpoint renders.  A
-        replica whose stats are unreadable/stale (fault-injected or a
-        dying engine rendering NaN) scores worst-but-placeable: stale
+        + in-flight slots, -speculative acceptance rate, -free pages),
+        read from the replica's metrics GAUGES — the same storage its
+        /metrics endpoint renders.  Acceptance breaks load ties: a
+        low-acceptance replica burns more verify rows per emitted token
+        (its workload drafts badly there), so among equally-loaded
+        replicas the fleet learns to place where drafting works.
+        Replicas that never drafted read the neutral 1.0.  A replica
+        whose stats are unreadable/stale (fault-injected or a dying
+        engine rendering NaN) scores worst-but-placeable: stale
         telemetry must degrade placement, not crash it."""
-        stale = (math.inf, 0.0)
+        stale = (math.inf, 0.0, 0.0)
         try:
             # a slow_replica delay rule stalls HERE — the price of a slow
             # stats read lands on placement latency, nothing breaks
@@ -397,7 +402,16 @@ class Router:
             return stale
         if any(math.isnan(v) for v in (q, infl, free_p)):
             return stale
-        return (q + infl, -free_p)
+        accept = 1.0
+        try:
+            g = reg.get("llm_spec_acceptance_rate")
+            if g is not None:
+                v = g.value
+                if not math.isnan(v):
+                    accept = v
+        except Exception:  # noqa: BLE001 — acceptance is advisory only
+            pass
+        return (q + infl, -accept, -free_p)
 
     def _candidates(self) -> List[Replica]:
         with self._lock:

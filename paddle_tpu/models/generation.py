@@ -300,6 +300,27 @@ class PagedKVCache:
         self.page_table = self.page_table.at[slot].set(
             jnp.asarray(row, jnp.int32))
 
+    def truncate_slot(self, slot: int, n_tokens: int) -> int:
+        """Logically retire cached tokens past `n_tokens`: release the
+        slot's TRAILING pages no longer needed and shrink its page-table
+        row.  This is the speculative-decoding rollback — pages are
+        append-only by position, so rejected draft tokens are retired by
+        pure length bookkeeping: the kernel's ctx_len masking already
+        guarantees slots past the sequence length are never read, and the
+        next span overwrites them in place.  Returns the number of pages
+        released."""
+        pages = self._slot_pages[slot]
+        need = self.pages_needed(n_tokens)
+        freed = 0
+        while len(pages) > max(need, 1) and pages:
+            self._free_pages.append(pages.pop())
+            freed += 1
+        if freed:
+            row = pages + [pages[-1]] * (self.pages_per_seq - len(pages))
+            self.page_table = self.page_table.at[slot].set(
+                jnp.asarray(row, jnp.int32))
+        return freed
+
     def release_slot(self, slot: int) -> None:
         self._free_pages.extend(self._slot_pages.pop(slot))
         self._free_slots.append(slot)
@@ -422,32 +443,48 @@ def forward_paged_decode(params, tok, config, pools, page_table, ctx,
 class RaggedSpan:
     """Host-side descriptor of one sequence's contribution to a ragged
     step: `tokens` (the span's token ids — 1 for decode, a chunk for
-    prefill), `ctx_after` (the sequence's TOTAL cached length once this
-    span's k/v land in the pool), and `pages` (the slot's allocated page
-    list, covering ctx_after tokens)."""
+    prefill, last-token-plus-drafts for a speculative verify), `ctx_after`
+    (the sequence's TOTAL cached length once this span's k/v land in the
+    pool), `pages` (the slot's allocated page list, covering ctx_after
+    tokens), and `n_out` — how many of the span's TRAILING rows need
+    logits.  1 (the default) is the classic sample-the-next-token shape;
+    a verify span asks for all its rows (n_out == len(tokens)) so the
+    accept/reject pass can check every draft position."""
 
-    __slots__ = ("tokens", "ctx_after", "pages")
+    __slots__ = ("tokens", "ctx_after", "pages", "n_out")
 
-    def __init__(self, tokens, ctx_after: int, pages):
+    def __init__(self, tokens, ctx_after: int, pages, n_out: int = 1):
         self.tokens = np.asarray(tokens, np.int32).reshape(-1)
         self.ctx_after = int(ctx_after)
         self.pages = list(pages)
+        self.n_out = int(n_out)
 
 
 def build_ragged_batch(spans, num_blocks: int, num_spans: int,
-                       block_q: int, page_size: int, pages_per_seq: int):
+                       block_q: int, page_size: int, pages_per_seq: int,
+                       num_out: Optional[int] = None):
     """Pack host-side span descriptors into the FIXED-SHAPE arrays one
     ragged dispatch consumes (the fixed shapes are what keep the step at
     O(1) compiled executables).  Spans are laid out consecutively, each
     starting on a `block_q` row boundary; unused blocks belong to the
     reserved padding span (index num_spans - 1, span_len 0, page 0).
 
+    num_out sizes the fixed logits gather: each span claims `n_out`
+    CONSECUTIVE out entries (its trailing rows, oldest first); unused
+    entries point at row 0 and their logits are garbage the caller never
+    reads.  The default (None -> num_spans) with all-n_out-1 spans is
+    exactly the classic one-logits-row-per-span layout.
+
     Returns a dict of np arrays: tok/row_page/row_off/row_pos (T,),
     block_seq/block_qpos (num_blocks,), span_len/ctx_len (num_spans,),
-    span_pt (num_spans, pages_per_seq), out_rows (num_spans,) — the row
-    index of each span's last valid token (sampling gathers these)."""
+    span_pt (num_spans, pages_per_seq), out_rows (num_out,) — the row
+    indices whose logits the dispatch returns — plus host-side
+    out_start/out_len (num_spans,): span i's logits live at out rows
+    [out_start[i], out_start[i] + out_len[i])."""
     T = num_blocks * block_q
     pad = num_spans - 1
+    if num_out is None:
+        num_out = num_spans
     if len(spans) > pad:
         raise ValueError(f"{len(spans)} spans exceed num_spans-1={pad}")
     tok = np.zeros((T,), np.int32)
@@ -459,17 +496,27 @@ def build_ragged_batch(spans, num_blocks: int, num_spans: int,
     span_len = np.zeros((num_spans,), np.int32)
     ctx_len = np.zeros((num_spans,), np.int32)
     span_pt = np.zeros((num_spans, pages_per_seq), np.int32)
-    out_rows = np.zeros((num_spans,), np.int32)
+    out_rows = np.zeros((num_out,), np.int32)
+    out_start = np.zeros((num_spans,), np.int32)
+    out_len = np.zeros((num_spans,), np.int32)
     blk = 0
+    out = 0
     for i, sp in enumerate(spans):
         L = sp.tokens.size
         if L < 1:
             raise ValueError("a ragged span must hold at least one token")
+        n_out = getattr(sp, "n_out", 1)
+        if not 1 <= n_out <= L:
+            raise ValueError(f"span {i}: n_out={n_out} outside [1, {L}]")
         need_blocks = -(-L // block_q)
         if blk + need_blocks > num_blocks:
             raise ValueError(
                 f"span {i} ({L} tokens) does not fit: {blk} of "
                 f"{num_blocks} row blocks already used")
+        if out + n_out > num_out:
+            raise ValueError(
+                f"span {i} (n_out={n_out}) does not fit: {out} of "
+                f"{num_out} out rows already claimed")
         if sp.ctx_after < L:
             raise ValueError(
                 f"span {i}: ctx_after={sp.ctx_after} < span length {L}")
@@ -483,7 +530,11 @@ def build_ragged_batch(spans, num_blocks: int, num_spans: int,
                          (pages_per_seq - len(sp.pages)), np.int32)
         span_pt[i] = row
         r0 = blk * block_q
-        out_rows[i] = r0 + L - 1
+        out_start[i] = out
+        out_len[i] = n_out
+        out_rows[out:out + n_out] = r0 + L - n_out + np.arange(
+            n_out, dtype=np.int32)
+        out += n_out
         pos = sp.ctx_after - L + np.arange(L, dtype=np.int32)
         tok[r0:r0 + L] = sp.tokens
         row_pos[r0:r0 + L] = pos
@@ -496,7 +547,8 @@ def build_ragged_batch(spans, num_blocks: int, num_spans: int,
     return {"tok": tok, "row_page": row_page, "row_off": row_off,
             "row_pos": row_pos, "block_seq": block_seq,
             "block_qpos": block_qpos, "span_len": span_len,
-            "ctx_len": ctx_len, "span_pt": span_pt, "out_rows": out_rows}
+            "ctx_len": ctx_len, "span_pt": span_pt, "out_rows": out_rows,
+            "out_start": out_start, "out_len": out_len}
 
 
 def _block_ragged(c, x, lp, cos, sin, kp, vp, row_page, row_off, span_pt,
@@ -627,6 +679,152 @@ def generate_ragged(params, input_ids, config, max_new_tokens: int,
         tok = np.asarray(jnp.argmax(logits[:B], axis=-1), np.int32)
         out.append(tok.copy())
     return jnp.asarray(np.stack(out, axis=1))
+
+
+# ---------------------------------------------------------------------------
+# Speculative decoding: draft proposals + the verify-span accept/reject pass
+# ---------------------------------------------------------------------------
+
+
+class Drafter:
+    """Proposes up to k draft tokens for a decoding sequence.  The engine
+    packs [last_token] + proposal as ONE (k+1)-row ragged verify span
+    through the same unified dispatch as prefill chunks — verifying k
+    drafts costs one span, not k steps.  Implementations must be pure
+    functions of the history (preempt/resume replays them safely)."""
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        """history: (n,) int32 prompt + generated tokens so far (the last
+        entry is the sampled-but-not-yet-cached token).  Returns up to k
+        proposed continuation tokens (possibly empty -> no speculation
+        this step)."""
+        raise NotImplementedError
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup drafting: no second model.  Match the longest recent
+    suffix (ngram_max down to ngram_min tokens) of the sequence's own
+    prompt+output history against an EARLIER occurrence and propose the
+    tokens that followed it.  Free on repetitive continuations (copy
+    tasks, code, summaries quoting the prompt, greedy cycles); proposes
+    nothing when the history never repeats — the engine then falls back
+    to a plain 1-token decode span.
+
+    max_history bounds the scanned window (the TRAILING tokens): the
+    scan runs per decoding slot per step on the serial step thread, so
+    an unbounded window would grow drafting cost linearly with sequence
+    length.  Matches beyond the window are lost — the usual
+    prompt-lookup trade; raise it for very long quoted prompts."""
+
+    def __init__(self, ngram_max: int = 3, ngram_min: int = 1,
+                 max_history: int = 2048):
+        if ngram_min < 1 or ngram_max < ngram_min:
+            raise ValueError("need ngram_max >= ngram_min >= 1")
+        if max_history < 2:
+            raise ValueError("max_history must be >= 2")
+        self.ngram_max = int(ngram_max)
+        self.ngram_min = int(ngram_min)
+        self.max_history = int(max_history)
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        h = np.asarray(history, np.int32).reshape(-1)
+        if h.size > self.max_history:
+            h = h[-self.max_history:]
+        n = h.size
+        k = int(k)
+        if k < 1 or n < self.ngram_min + 1:
+            return np.zeros((0,), np.int32)
+        for g in range(min(self.ngram_max, n - 1), self.ngram_min - 1, -1):
+            suffix = h[n - g:]
+            # windows of length g ending strictly before the suffix
+            win = np.lib.stride_tricks.sliding_window_view(h[:n - 1], g)
+            hits = np.flatnonzero((win == suffix).all(axis=1))
+            if hits.size == 0:
+                continue
+            start = int(hits[-1]) + g     # most recent match's continuation
+            cont = h[start:start + k]
+            if cont.size:
+                return cont.astype(np.int32)
+        return np.zeros((0,), np.int32)
+
+
+def filtered_probs(logits, temperature: float, top_k: int = 0,
+                   top_p: float = 1.0) -> np.ndarray:
+    """Numpy mirror of `sample_logits`' temperature/top-k/top-p filtering:
+    the exact TARGET distribution the non-speculative sampler draws from,
+    row-wise.  logits: (N, V) f32 -> (N, V) probabilities."""
+    lg = np.asarray(logits, np.float64) / max(float(temperature), 1e-6)
+    N, V = lg.shape
+    if top_k and top_k < V:
+        kth = np.sort(lg, axis=-1)[:, V - top_k][:, None]
+        lg = np.where(lg < kth, -np.inf, lg)
+    if top_p < 1.0:
+        sorted_l = np.sort(lg, axis=-1)[:, ::-1]
+        e = np.exp(sorted_l - sorted_l[:, :1])
+        probs = e / e.sum(-1, keepdims=True)
+        cum = np.cumsum(probs, axis=-1)
+        # same keep rule as sample_logits: smallest set with mass >= top_p
+        keep = (cum - probs) < top_p
+        cutoff = np.min(np.where(keep, sorted_l, np.inf), axis=-1)[:, None]
+        lg = np.where(lg < cutoff, -np.inf, lg)
+    e = np.exp(lg - lg.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def verify_greedy(logits, draft) -> tuple:
+    """Greedy accept/reject over one verify span.  logits: (k+1, V) rows
+    for [last_token, d_1..d_k] (row j's logits are the target's next-token
+    scores AFTER d_1..d_j landed); draft: (k,) proposed tokens.
+
+    Accepts the longest prefix where argmax agrees, then emits the
+    target's own next token (the correction at the first disagreement, or
+    the bonus token after full acceptance).  Every emitted token equals
+    argmax given the true prefix, so greedy speculative decoding is
+    TOKEN-EXACT vs the non-speculative chain by construction.
+
+    Returns (emitted tokens: accepted drafts + 1, n_accepted)."""
+    lg = np.asarray(logits)
+    d = np.asarray(draft, np.int32).reshape(-1)
+    g = np.argmax(lg, axis=-1).astype(np.int32)
+    m = 0
+    while m < d.size and g[m] == d[m]:
+        m += 1
+    return [int(t) for t in d[:m]] + [int(g[m])], m
+
+
+def verify_rejection(probs, draft, rng) -> tuple:
+    """Rejection-sampling accept/reject over one verify span (temperature
+    sampling).  probs: (k+1, V) TARGET distributions (filtered_probs of
+    the verify logits); draft: (k,) tokens from a DETERMINISTIC drafter
+    (draft distribution q = a point mass, q(d_i) = 1); rng: numpy
+    Generator.
+
+    Standard speculative sampling: accept d_i with prob
+    min(1, p_i(d_i)/q(d_i)) = p_i(d_i); on the first rejection resample
+    from the residual max(p - q, 0) normalized — p with d_i zeroed.  The
+    emitted-token DISTRIBUTION is exactly the target's: P(x) =
+    q(x)min(1,p(x)) + P(reject)·residual(x) = p(x) for every x.  After
+    full acceptance the bonus token is drawn from the last row's p.
+
+    Returns (emitted tokens: accepted drafts + 1, n_accepted)."""
+    p = np.asarray(probs, np.float64)
+    d = np.asarray(draft, np.int32).reshape(-1)
+    V = p.shape[-1]
+    for i in range(d.size):
+        row = p[i]
+        if rng.random() < row[d[i]]:
+            continue
+        residual = row.copy()
+        residual[d[i]] = 0.0
+        tot = residual.sum()
+        if tot <= 0.0:
+            # p was (numerically) a point mass at the draft: accept
+            continue
+        nxt = int(rng.choice(V, p=residual / tot))
+        return [int(t) for t in d[:i]] + [nxt], i
+    row = p[d.size]
+    nxt = int(rng.choice(V, p=row / row.sum()))
+    return [int(t) for t in d] + [nxt], int(d.size)
 
 
 @functools.partial(jax.jit, static_argnames=(

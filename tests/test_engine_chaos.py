@@ -476,6 +476,73 @@ class TestChunkedPrefillChaos:
         F.check_invariants(eng, [h])
 
 
+# -- chaos: speculative decoding (draft/verify faults mid-speculation) -----
+
+# spec_k=3 over repetitive prompts on an undersized pool: every schedule
+# runs verify spans under page pressure, so faults and preemption land
+# MID-speculation; the checker proves no leak, no double-resolution, and
+# the spec token identities hold
+SPEC_SCHEDULES = [
+    ("draft_fault_2nd", "swap",
+     [("draft", dict(nth=2))]),
+    ("verify_fault_2nd", "recompute",
+     [("verify", dict(nth=2))]),
+    ("verify_consumes_donated_pools", "swap",
+     [("verify", dict(nth=1, consume_pools=True))]),
+    ("draft_consumes_pools_poisons_dispatch", "recompute",
+     [("draft", dict(nth=2, consume_pools=True))]),
+    ("oom_mid_speculation", "swap",
+     [("page_alloc", dict(slot=0, nth=4))]),
+]
+
+
+class TestSpecChaos:
+    # F.EchoDrafter: always proposes, so every decode step carries a
+    # verify span and mostly-rejected drafts roll back under the faults
+    def _make(self, params, cfg, mode):
+        return lambda: _engine(params, cfg, num_pages=5, preempt_mode=mode,
+                               prefill_chunk_tokens=3, block_q=2,
+                               spec_k=3, drafter=F.EchoDrafter())
+
+    def _workload(self, cfg, seed=4, n=4):
+        rng = np.random.default_rng(seed)
+        out = []
+        for _ in range(n):
+            base = rng.integers(0, cfg.vocab_size, 3).tolist()
+            out.append(((base * 3)[:8], int(rng.integers(3, 6))))
+        return out
+
+    @pytest.mark.parametrize(
+        "name,mode,spec", SPEC_SCHEDULES,
+        ids=[s[0] for s in SPEC_SCHEDULES])
+    def test_spec_schedule(self, tiny, name, mode, spec):
+        """Death/faults mid-speculation never leak pages or
+        double-resolve: the new draft/verify points fire, every handle
+        resolves exactly once, and the extended token identities
+        (verify rows == accepted + rejected + bonus) hold at
+        quiescence."""
+        cfg, params = tiny
+        rules = [F.FaultRule(point, **kw) for point, kw in spec]
+        report = F.run_schedule(self._make(params, cfg, mode), rules,
+                                self._workload(cfg))
+        assert report["ok"], report["violations"]
+        assert report["fired"], "schedule never fired — it tests nothing"
+        assert report["completed"] + report["failed"] == report["requests"]
+
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_fault_free_spec_under_pressure(self, tiny, mode):
+        """No injected faults: pool pressure alone preempts slots that
+        are actively speculating; resumes stay invariant-clean and
+        speculation keeps running after the churn."""
+        cfg, params = tiny
+        report = F.run_schedule(self._make(params, cfg, mode), [],
+                                self._workload(cfg, seed=9))
+        assert report["ok"], report["violations"]
+        assert report["failed"] == 0
+        assert report["stats"]["preemptions"] >= 1
+        assert report["stats"]["spec_steps"] >= 1
+
+
 class TestInvariantChecker:
     def test_detects_leaked_slot(self, tiny):
         """The checker itself must catch a leak: acquire a slot behind the
@@ -507,4 +574,19 @@ class TestInvariantChecker:
         eng.stats["ragged_batch_tokens"] += 1   # seed the drift
         with pytest.raises(F.InvariantViolation,
                            match="ragged token identity"):
+            F.check_invariants(eng, [h])
+
+    def test_detects_verify_row_identity_drift(self, tiny):
+        """verify_tokens must equal spec_accepted + spec_rejected +
+        spec_bonus; an accept/reject pass that loses or double-counts a
+        draft verdict must trip."""
+        cfg, params = tiny
+        eng = _engine(params, cfg, spec_k=2)
+        h = eng.submit([5, 6, 5, 6, 5, 6], max_new_tokens=6)
+        while not h.done():
+            eng.step()
+        assert eng.stats["verify_tokens"] >= 1
+        eng.stats["spec_accepted"] += 1         # seed the drift
+        with pytest.raises(F.InvariantViolation,
+                           match="identity broken"):
             F.check_invariants(eng, [h])

@@ -186,6 +186,38 @@ class TestPlacement:
         assert h.result(timeout=0) == _ref(h)
         router.shutdown()
 
+    def test_low_acceptance_replica_loses_placement(self):
+        """The speculative acceptance gauge feeds the placement score:
+        between two equally-loaded replicas, the one whose drafts keep
+        getting rejected (it burns verify rows for nothing) must lose
+        placement to the one drafting well."""
+        mk = _mk()
+        engines = [mk(), mk()]
+        # pin replica 0's cumulative acceptance low, replica 1's high —
+        # through the SAME counters the engine's verify pass bumps
+        engines[0].stats["spec_drafted"] = 100
+        engines[0].stats["spec_accepted"] = 5
+        engines[1].stats["spec_drafted"] = 100
+        engines[1].stats["spec_accepted"] = 90
+        assert engines[0].metrics.get(
+            "llm_spec_acceptance_rate").value == pytest.approx(0.05)
+        router = Router(engines, supervisor=None, threaded=False)
+        h = router.submit([3, 4], max_new_tokens=2)
+        assert h.hops == [1]
+        F.drive_fleet(router, [h])
+        assert h.result(timeout=0) == _ref(h)
+        # a replica that never drafted reads neutral 1.0 and still beats
+        # the bad drafter once both are idle again
+        engines[1].stats["spec_drafted"] = 0
+        engines[1].stats["spec_accepted"] = 0
+        assert engines[1].metrics.get(
+            "llm_spec_acceptance_rate").value == 1.0
+        h2 = router.submit([5, 6], max_new_tokens=2)
+        assert h2.hops == [1]
+        F.drive_fleet(router, [h2])
+        assert h2.result(timeout=0) == _ref(h2)
+        router.shutdown()
+
     def test_placement_gauges_live_in_metrics(self):
         """Satellite: queue depth / free pages / occupied slots are live
         registry gauges — present in the /metrics render and matching

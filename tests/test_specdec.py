@@ -1,0 +1,474 @@
+"""Speculative decoding through the unified ragged kernel: the n-gram
+prompt-lookup drafter, the verify-span accept/reject samplers (greedy
+token-exact; rejection sampling distribution-exact), engine-level
+draft->verify->commit with rollback (token-exact vs `generate()` AND vs
+the non-speculative engine, incl. preempt/resume both modes), adaptive-k
+reset on resume, the O(1)-executables guarantee across varying k, and
+the acceptance-rate obs surface."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.obs as obs
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.inference import faults as F
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _spec_engine(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("prefill_chunk_tokens", 4)
+    kw.setdefault("block_q", 2)
+    kw.setdefault("spec_k", 3)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _want(tiny, prompt, n):
+    cfg, params = tiny
+    return np.asarray(generation.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n))[0].tolist()
+
+
+# prompts whose suffix repeats: the prompt-lookup drafter proposes from
+# step one, and the tiny model's greedy chains cycle, so verify spans see
+# both acceptances and rejections
+def _prompts(cfg, seed=0, n=3):
+    rng = np.random.default_rng(seed)
+    out = [[7, 8, 9, 7, 8, 9, 7, 8]]
+    for _ in range(n - 1):
+        out.append(rng.integers(0, cfg.vocab_size, 6).tolist())
+    return out
+
+
+class TestNGramDrafter:
+    def test_copies_continuation_of_last_match(self):
+        d = generation.NGramDrafter(3, 1)
+        h = np.array([5, 6, 7, 8, 5, 6, 7], np.int32)
+        np.testing.assert_array_equal(d.propose(h, 4), [8, 5, 6, 7])
+        np.testing.assert_array_equal(d.propose(h, 2), [8, 5])
+
+    def test_prefers_longest_suffix_and_latest_occurrence(self):
+        d = generation.NGramDrafter(3, 1)
+        # suffix [1, 2] occurs twice; the LATEST match's continuation (9)
+        # wins over the earlier one (3)
+        h = np.array([1, 2, 3, 1, 2, 9, 1, 2], np.int32)
+        np.testing.assert_array_equal(d.propose(h, 1), [9])
+
+    def test_empty_when_no_repeat_or_no_room(self):
+        d = generation.NGramDrafter(3, 1)
+        assert d.propose(np.array([1, 2, 3], np.int32), 4).size == 0
+        assert d.propose(np.array([1, 2, 1], np.int32), 0).size == 0
+        assert d.propose(np.array([5], np.int32), 4).size == 0
+
+    def test_rejects_bad_ngram_bounds(self):
+        with pytest.raises(ValueError):
+            generation.NGramDrafter(ngram_max=1, ngram_min=2)
+        with pytest.raises(ValueError):
+            generation.NGramDrafter(ngram_max=2, ngram_min=0)
+
+
+class TestVerifyGreedy:
+    def test_accepts_longest_argmax_prefix(self):
+        lg = np.full((4, 8), -1.0, np.float32)
+        for row, top in enumerate((2, 3, 5, 1)):
+            lg[row, top] = 5.0
+        # drafts [2, 3, 4]: first two agree, third disagrees -> the
+        # correction (row 2's argmax) replaces it
+        emitted, m = generation.verify_greedy(lg, [2, 3, 4])
+        assert (emitted, m) == ([2, 3, 5], 2)
+        # full acceptance earns the bonus token from the last row
+        emitted, m = generation.verify_greedy(lg, [2, 3, 5])
+        assert (emitted, m) == ([2, 3, 5, 1], 3)
+        # immediate rejection still emits the correction
+        emitted, m = generation.verify_greedy(lg, [7])
+        assert (emitted, m) == ([2], 0)
+
+
+class TestVerifyRejection:
+    CHI2_999_DF7 = 24.32      # chi-square critical value, df=7, p=0.001
+
+    def _target(self, seed=3, V=8):
+        logits = np.random.default_rng(seed).standard_normal((1, V)) * 2
+        return generation.filtered_probs(logits.astype(np.float32), 1.0)
+
+    @pytest.mark.parametrize("draft_tok", [0, 1])
+    def test_emitted_distribution_matches_target(self, draft_tok):
+        """THE speculative-sampling theorem, empirically: with a
+        deterministic draft the emitted token's distribution must equal
+        the target's regardless of which token was drafted (chi-square
+        at p=0.001 on a small vocab, seeded)."""
+        p = self._target()
+        probs = np.concatenate([p, p])          # k=1 verify span
+        rng = np.random.default_rng(7)
+        n, V = 20000, p.shape[-1]
+        counts = np.zeros(V)
+        for _ in range(n):
+            emitted, _m = generation.verify_rejection(
+                probs, [draft_tok], rng)
+            counts[emitted[0]] += 1
+        expected = p[0] * n
+        chi2 = float(((counts - expected) ** 2 / expected).sum())
+        assert chi2 < self.CHI2_999_DF7, (chi2, counts, expected)
+
+    def test_full_acceptance_samples_bonus_from_last_row(self):
+        # point-mass targets: draft always accepted, bonus forced
+        p = np.zeros((3, 5))
+        p[0, 2] = p[1, 3] = p[2, 4] = 1.0
+        emitted, m = generation.verify_rejection(
+            p, [2, 3], np.random.default_rng(0))
+        assert (emitted, m) == ([2, 3, 4], 2)
+
+    def test_certain_rejection_resamples_residual(self):
+        p = np.zeros((2, 5))
+        p[0, 1] = p[1, 2] = 1.0
+        emitted, m = generation.verify_rejection(
+            p, [4], np.random.default_rng(0))   # p(4) = 0 -> reject
+        assert (emitted, m) == ([1], 0)
+
+
+class TestFilteredProbs:
+    def test_top_k_top_p_keep_rules_match_sample_logits(self):
+        """filtered_probs is the numpy mirror of sample_logits'
+        filtering: same temperature scale, same top-k cut, same smallest-
+        set-with-mass >= top_p nucleus rule."""
+        lg = np.array([[4.0, 3.0, 2.0, 1.0, 0.0, -1.0]], np.float32)
+        # top_k=3 keeps {0,1,2}
+        p = generation.filtered_probs(lg, 1.0, top_k=3)[0]
+        assert (p[3:] == 0).all() and p[:3].sum() == pytest.approx(1.0)
+        # top_p: sorted probs ~ [.64, .24, .09, ...]; top_p=0.7 keeps the
+        # smallest set reaching 0.7 = {0, 1}
+        p = generation.filtered_probs(lg, 1.0, top_p=0.7)[0]
+        assert (p[2:] == 0).all() and p[0] > p[1] > 0
+        # temperature flattens consistently
+        p_hot = generation.filtered_probs(lg, 2.0)[0]
+        p_cold = generation.filtered_probs(lg, 0.5)[0]
+        assert p_cold[0] > p_hot[0]
+        # greedy argmax equals the unfiltered max everywhere
+        np.testing.assert_allclose(
+            generation.filtered_probs(lg, 1.0)[0].sum(), 1.0)
+
+    def test_top_k1_is_point_mass(self):
+        lg = np.random.default_rng(0).standard_normal((4, 9)).astype(
+            np.float32)
+        p = generation.filtered_probs(lg, 1.0, top_k=1)
+        np.testing.assert_array_equal(p.argmax(-1), lg.argmax(-1))
+        np.testing.assert_allclose(p.max(-1), 1.0)
+
+
+class TestTruncateSlot:
+    def test_releases_trailing_pages_and_updates_table(self, tiny):
+        cfg, _ = tiny
+        cache = generation.PagedKVCache(cfg, num_pages=8, page_size=4,
+                                        max_slots=2, pages_per_seq=4)
+        slot = cache.acquire_slot()
+        cache.ensure_capacity(slot, 12)          # 3 pages
+        held = list(cache._slot_pages[slot])
+        assert len(held) == 3
+        freed = cache.truncate_slot(slot, 5)     # needs 2 pages
+        assert freed == 1
+        assert cache._slot_pages[slot] == held[:2]
+        assert held[2] in cache._free_pages
+        row = np.asarray(cache.page_table)[slot]
+        assert (row == held[:2] + [held[1]] * 2).all()
+        # idempotent + never drops below one page while tokens remain
+        assert cache.truncate_slot(slot, 5) == 0
+        assert cache.truncate_slot(slot, 1) == 1
+        assert len(cache._slot_pages[slot]) == 1
+        cache.release_slot(slot)
+        assert sorted(cache._free_pages) == list(range(1, 8))
+
+
+class TestBuildRaggedBatchOut:
+    def test_out_packing_for_verify_spans(self):
+        mk = generation.RaggedSpan
+        spans = [mk([5, 6, 7], 9, [3, 7, 7], n_out=3), mk([1], 5, [2, 9])]
+        b = generation.build_ragged_batch(spans, 4, 4, 2, 4, 3, num_out=6)
+        # span 0 claims its 3 rows (0..2), span 1 its last row (4)
+        np.testing.assert_array_equal(b["out_rows"], [0, 1, 2, 4, 0, 0])
+        np.testing.assert_array_equal(b["out_start"][:2], [0, 3])
+        np.testing.assert_array_equal(b["out_len"][:2], [3, 1])
+
+    def test_default_layout_unchanged(self):
+        mk = generation.RaggedSpan
+        spans = [mk([5], 9, [3, 7, 7]), mk([1, 2, 3, 4, 5], 5, [2, 9])]
+        b = generation.build_ragged_batch(spans, 4, 4, 2, 4, 3)
+        np.testing.assert_array_equal(b["out_rows"], [0, 6, 0, 0])
+        np.testing.assert_array_equal(b["out_start"][:2], [0, 1])
+        np.testing.assert_array_equal(b["out_len"][:2], [1, 1])
+
+    def test_rejects_out_overflow_and_bad_n_out(self):
+        mk = generation.RaggedSpan
+        with pytest.raises(ValueError, match="out rows"):
+            generation.build_ragged_batch(
+                [mk([1, 2], 2, [1], n_out=2), mk([3, 4], 2, [2], n_out=2)],
+                4, 4, 2, 4, 1, num_out=3)
+        with pytest.raises(ValueError, match="n_out"):
+            generation.build_ragged_batch(
+                [mk([1], 1, [1], n_out=2)], 2, 2, 2, 4, 1, num_out=4)
+
+
+GEOMETRIES = [
+    # (page_size, block_q, prefill_chunk_tokens, spec_k)
+    (4, 2, 4, 3),
+    (4, 4, 8, 4),
+    (8, 2, 6, 2),
+]
+
+
+class TestEngineSpecGreedy:
+    @pytest.mark.parametrize("page_size,block_q,chunk,k", GEOMETRIES)
+    def test_token_exact_vs_generate_and_plain_engine(self, tiny, page_size,
+                                                      block_q, chunk, k):
+        """THE acceptance gate: greedy speculative decoding reproduces
+        dense `generate()` AND the non-speculative engine exactly, with
+        speculation demonstrably exercised (drafts proposed AND
+        accepted)."""
+        cfg, params = tiny
+        prompts = _prompts(cfg, seed=page_size + k)
+        spec = _spec_engine(tiny, page_size=page_size, block_q=block_q,
+                            prefill_chunk_tokens=chunk, spec_k=k,
+                            num_slots=3)
+        plain = _spec_engine(tiny, page_size=page_size, block_q=block_q,
+                             prefill_chunk_tokens=chunk, spec_k=0,
+                             num_slots=3)
+        got = spec.generate(prompts, max_new_tokens=20)
+        base = plain.generate(prompts, max_new_tokens=20)
+        for p, g, b in zip(prompts, got, base):
+            want = _want(tiny, p, 20)
+            assert g == want, (p, g, want)
+            assert b == want
+        snap = spec.stats_snapshot()
+        assert snap["spec_steps"] >= 1
+        assert snap["spec_drafted"] >= 1
+        assert snap["spec_accepted"] >= 1      # cycles DO get accepted
+        assert plain.stats_snapshot()["spec_steps"] == 0
+        F.check_invariants(spec)
+        F.check_invariants(plain)
+
+    def test_speculation_reduces_dispatches(self, tiny):
+        """On a repetitive continuation the verify spans emit multiple
+        tokens per dispatch: the speculative engine must finish the same
+        workload in fewer ragged steps."""
+        prompts = [[7, 8, 9, 7, 8, 9, 7, 8]]
+        spec = _spec_engine(tiny, spec_k=4, num_slots=1)
+        plain = _spec_engine(tiny, spec_k=0, num_slots=1)
+        want = _want(tiny, prompts[0], 24)
+        assert spec.generate(prompts, max_new_tokens=24)[0] == want
+        assert plain.generate(prompts, max_new_tokens=24)[0] == want
+        s_steps = spec.stats_snapshot()["steps_total"]
+        p_steps = plain.stats_snapshot()["steps_total"]
+        assert s_steps < p_steps, (s_steps, p_steps)
+
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_preempt_resume_token_exact(self, tiny, mode):
+        """Page pressure mid-speculation: preempted slots resume in
+        either mode and the chain stays exact (speculation state is
+        per-slot and reset on resume, so replayed prefixes re-draft
+        deterministically)."""
+        cfg, params = tiny
+        eng = _spec_engine(tiny, max_seq_len=16, num_pages=5,
+                           preempt_mode=mode, spec_k=3, num_slots=2)
+        prompts = _prompts(cfg, seed=11, n=3)
+        prompts = [p[:8] for p in prompts]
+        outs = eng.generate(prompts, max_new_tokens=6)
+        for p, got in zip(prompts, outs):
+            assert got == _want(tiny, p, 6), (mode, p)
+        snap = eng.stats_snapshot()
+        assert snap["preemptions"] >= 1
+        F.check_invariants(eng)
+
+    def test_spec_state_resets_on_resume(self, tiny):
+        """A preempted slot resumes with its adaptive k RESET to the
+        engine default — drafting history does not survive preemption."""
+        eng = _spec_engine(tiny, spec_k=3, num_slots=2)
+        h = eng.submit([7, 8, 9, 7, 8, 9, 7, 8], max_new_tokens=8)
+        eng.step()                    # admit + prefill chunks
+        while not any(not st.prefilling for st in eng._slots.values()):
+            eng.step()
+        (slot, st), = eng._slots.items()
+        st.spec_k = 1                 # adapted down by a bad stretch
+        eng._preempt(slot)
+        assert eng.stats["preemptions"] == 1
+        # drive until re-admitted, then check the reset
+        while not eng._slots:
+            eng.step()
+        st2 = next(iter(eng._slots.values()))
+        assert st2.spec_k == eng.spec_k == 3
+        while not h.done():
+            eng.step()
+        assert list(h.result(timeout=5)) == _want(
+            tiny, [7, 8, 9, 7, 8, 9, 7, 8], 8)
+        F.check_invariants(eng, [h])
+
+    def test_eos_mid_draft_truncates_exactly(self, tiny):
+        """An eos accepted mid-verify ends the request exactly where the
+        non-speculative chain would — no tokens past eos leak out."""
+        prompt = [7, 8, 9, 7, 8, 9, 7, 8]
+        chain = _want(tiny, prompt, 20)
+        eos = chain[10]               # an eos the chain actually emits
+        plain_ref = chain[:chain.index(eos) + 1]
+        eng = _spec_engine(tiny, spec_k=4, num_slots=1)
+        h = eng.submit(prompt, max_new_tokens=20, eos_id=eos)
+        while not h.done():
+            eng.step()
+        assert list(h.result(timeout=5)) == plain_ref
+        F.check_invariants(eng, [h])
+
+    def test_max_new_tokens_never_overshot(self, tiny):
+        """Full acceptance near the budget must not emit past
+        max_new_tokens, and max_new_tokens == 1 degrades to a plain
+        decode span (k caps to zero)."""
+        prompt = [7, 8, 9, 7, 8, 9, 7, 8]
+        eng = _spec_engine(tiny, spec_k=4, num_slots=2)
+        hs = [eng.submit(prompt, max_new_tokens=n) for n in (1, 5)]
+        while not all(h.done() for h in hs):
+            eng.step()
+        for h, n in zip(hs, (1, 5)):
+            toks = list(h.result(timeout=5))
+            assert len(toks) == n
+            assert toks == _want(tiny, prompt, 20)[:n]
+        F.check_invariants(eng, hs)
+
+
+class TestEngineSpecTemperature:
+    def test_top_k1_temperature_path_is_deterministic_exact(self, tiny):
+        """temperature > 0 with top_k=1 drives the REJECTION-SAMPLING
+        code path end-to-end while staying deterministic (point-mass
+        targets): the output must equal the greedy chain and the plain
+        top_k=1 engine."""
+        cfg, params = tiny
+        prompts = _prompts(cfg, seed=5)
+        spec = _spec_engine(tiny, spec_k=3, num_slots=3,
+                            temperature=1.0, top_k=1)
+        outs = spec.generate(prompts, max_new_tokens=16)
+        for p, got in zip(prompts, outs):
+            assert got == _want(tiny, p, 16), p
+        snap = spec.stats_snapshot()
+        assert snap["spec_steps"] >= 1
+        F.check_invariants(spec)
+
+    @pytest.mark.slow
+    def test_distribution_matches_plain_sampling(self, tiny):
+        """Distribution gate (chi-square): the token at the first
+        verify-influenced position, sampled many times at temperature
+        1.0, must match the non-speculative engine's distribution.  The
+        drafter always proposes (a constant token) — speculative-sampling
+        exactness must hold REGARDLESS of what was drafted."""
+        cfg, params = tiny
+        prompt = [7, 8, 9, 7, 8, 9, 7, 8]
+
+        class ConstDrafter(generation.Drafter):
+            def propose(self, history, k):
+                return np.asarray([7], np.int32)
+
+        def collect(spec_k, seed, n=300):
+            eng = _spec_engine(tiny, spec_k=spec_k, num_slots=2,
+                               temperature=1.0, seed=seed,
+                               drafter=ConstDrafter() if spec_k else None)
+            toks = []
+            for i in range(n):
+                # max_new 3: position 1 is the first verify-influenced
+                # token (k caps at max_new - emitted - 1, so max_new 2
+                # would degrade every span to plain decode)
+                h = eng.submit(prompt, max_new_tokens=3)
+                while not h.done():
+                    eng.step()
+                toks.append(h.result(timeout=5)[1])
+            if spec_k:
+                assert eng.stats_snapshot()["spec_steps"] >= n // 2
+            return np.asarray(toks)
+
+        a = collect(4, seed=1)
+        b = collect(0, seed=2)
+        # two-sample chi-square over cells with enough mass
+        cells = sorted(set(a.tolist()) | set(b.tolist()))
+        ca = np.array([(a == c).sum() for c in cells], float)
+        cb = np.array([(b == c).sum() for c in cells], float)
+        keep = (ca + cb) >= 10
+        ca, cb = ca[keep], cb[keep]
+        tot = ca + cb
+        ea, eb = tot * ca.sum() / (len(a) + len(b)), \
+            tot * cb.sum() / (len(a) + len(b))
+        chi2 = float((((ca - ea) ** 2) / ea + ((cb - eb) ** 2) / eb).sum())
+        # generous: p=0.001 for the observed df (cells - 1)
+        from math import sqrt
+        df = max(len(ca) - 1, 1)
+        crit = df + 3.1 * sqrt(2 * df) + 6     # Wilson-Hilferty-ish bound
+        assert chi2 < crit, (chi2, crit, len(ca))
+
+
+class TestRecompileAndProbe:
+    def test_sentinel_silent_across_varying_k(self, tiny):
+        """O(1) executables WITH speculation: after the warmup compile, a
+        workload whose verify spans carry varying k (adaptive growth and
+        shrink, mixed with prefill chunks and plain decode) must not
+        recompile the unified step once."""
+        cfg, params = tiny
+        eng = _spec_engine(tiny, spec_k=4, num_slots=3)
+        sent = obs.RecompileSentinel(tracer=eng.tracer,
+                                     registry=obs.Registry())
+        sent.watch("ragged_step", eng._ragged)
+        h = eng.submit([1, 2], max_new_tokens=2)
+        eng.step()                       # warmup: the one compile
+        assert sent.check() == {}
+        handles = [h]
+        rng = np.random.default_rng(3)
+        for n in (8, 3, 9, 5):
+            handles.append(eng.submit(
+                ([7, 8, 9] * 4)[:n] if n % 2 else
+                rng.integers(0, cfg.vocab_size, n).tolist(),
+                max_new_tokens=12))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", obs.RecompileWarning)
+            steps = 0
+            while any(not x.done() for x in handles) and steps < 500:
+                eng.step()
+                assert sent.check() == {}, \
+                    "post-warmup recompile in the speculative ragged step"
+                steps += 1
+        assert all(x.done() for x in handles)
+        assert eng.stats["spec_steps"] >= 1
+        assert sent.counts() == {"ragged_step": 0}
+
+    def test_probe_args_cover_verify_spans(self, tiny):
+        """ragged_probe_args() reflects the speculative geometry (wider
+        out_rows, more row blocks) and the Graph Doctor's shape-poly
+        probe still sees exactly ONE compiled signature."""
+        from paddle_tpu import analysis
+        eng = _spec_engine(tiny, spec_k=4, num_slots=2)
+        args = eng.ragged_probe_args()
+        assert args[10].shape == (eng._num_out,)
+        assert eng._num_out == 2 * 5 + 1
+        assert args[5].shape == (eng._num_blocks,)
+        r = analysis.analyze(eng._ragged, *args)
+        assert not [f for f in r.findings
+                    if f.code.startswith("RECOMPILE")], r.findings
+
+    def test_acceptance_surfaces_in_metrics(self, tiny):
+        eng = _spec_engine(tiny, spec_k=3, num_slots=1)
+        eng.generate([[7, 8, 9, 7, 8, 9, 7, 8]], max_new_tokens=16)
+        g = eng.metrics.get("llm_spec_acceptance_rate")
+        assert 0.0 <= g.value <= 1.0
+        drafted = eng.stats_snapshot()["spec_drafted"]
+        assert drafted >= 1
+        text = eng.metrics.render()
+        assert "llm_spec_acceptance_rate" in text
+        assert "llm_spec_accept_ratio_bucket" in text
+        assert "llm_spec_drafted_total" in text

@@ -49,6 +49,12 @@ def main():
     ap.add_argument("--page-size", type=int, default=4)
     ap.add_argument("--block-q", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--spec-k", default="",
+                    help="comma-separated spec_k points (e.g. 0,2,4,8): "
+                         "sweep speculative draft depth instead of the "
+                         "chunk budget — repetitive-continuation "
+                         "workload, reports emitted tokens/sec + "
+                         "acceptance per point")
     args = ap.parse_args()
 
     import numpy as np
@@ -65,6 +71,47 @@ def main():
     long_prompt = rng.integers(0, cfg.vocab_size, args.long).tolist()
     shorts = [rng.integers(0, cfg.vocab_size, 3).tolist()
               for _ in range(args.streams)]
+
+    if args.spec_k:
+        # speculative draft-depth sweep: repetitive-continuation prompts
+        # (the drafter's friendly case) decode a long tail per point;
+        # block_q rides k+1 so every point keeps the verify span inside
+        # the decode span's padded row block (same rows as plain decode)
+        new_tokens = max(args.new_tokens, 64)
+        prompts = [(rng.integers(0, cfg.vocab_size, 3).tolist() * 4)[:8]
+                   for _ in range(args.streams)]
+        for k in (int(v) for v in args.spec_k.split(",")):
+            bq = max(args.block_q, k + 1)
+            eng = LLMEngine(params, cfg, num_slots=args.streams,
+                            page_size=args.page_size,
+                            max_seq_len=max(max_seq, 8 + new_tokens),
+                            prefill_chunk_tokens=bq, block_q=bq,
+                            spec_k=k)
+            eng.generate([[1, 2, 3]], max_new_tokens=2)   # warm
+            t0 = time.perf_counter()
+            hs = [eng.submit(p, max_new_tokens=new_tokens)
+                  for p in prompts]
+            while not all(h.done() for h in hs):
+                eng.step()
+            dt = time.perf_counter() - t0
+            snap = eng.stats_snapshot()
+            itl = eng.latency_snapshot()["inter_token_s"]
+            accept = eng.metrics.get("llm_spec_acceptance_rate").value
+            emitted = sum(len(h.result(timeout=0)) for h in hs)
+            eng.shutdown()
+            print(json.dumps({
+                "spec_k": k,
+                "block_q": bq,
+                "emitted_tokens_per_sec": round(emitted / dt, 2),
+                "acceptance_rate": round(accept, 4),
+                "spec_drafted": snap["spec_drafted"],
+                "spec_accepted": snap["spec_accepted"],
+                "stream_itl_p50_ms": round((itl["p50"] or 0.0) * 1e3, 3),
+                "stream_itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
+                "steps": snap["steps_total"],
+                "wall_s": round(dt, 3),
+            }))
+        return 0
 
     for budget in (int(b) for b in args.budgets.split(",")):
         eng = LLMEngine(params, cfg, num_slots=args.streams + 2,

@@ -45,6 +45,11 @@ def main():
                          "prefill chunks riding the unified ragged batch "
                          "(small by default so multi-chunk prefills — and "
                          "mid-prefill faults/preemptions — actually occur)")
+    ap.add_argument("--spec-k", type=int, default=0,
+                    help="speculative draft depth (0 = off): soak the "
+                         "draft->verify->commit path — an always-propose "
+                         "drafter keeps verify spans in every step, so "
+                         "rollback runs under every injected fault")
     ap.add_argument("--probe-every", type=int, default=5,
                     help="run the fresh-request serving probe every Nth "
                          "schedule (1 = always; probes dominate runtime)")
@@ -63,11 +68,14 @@ def main():
     cfg = LlamaConfig.tiny()
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
 
+    drafter = F.EchoDrafter() if args.spec_k else None
+
     def make_engine(mode):
         return lambda: LLMEngine(
             params, cfg, num_slots=args.slots, page_size=4, max_seq_len=16,
             num_pages=args.num_pages, preempt_mode=mode,
-            prefill_chunk_tokens=args.prefill_chunk, block_q=2)
+            prefill_chunk_tokens=args.prefill_chunk, block_q=2,
+            spec_k=args.spec_k, drafter=drafter)
 
     reports, violations = [], 0
     totals = {"fired": 0, "completed": 0, "failed": 0, "preemptions": 0,
