@@ -572,6 +572,86 @@ def bench_specdec(dev, on_tpu):
     return out
 
 
+def bench_obs_overhead(dev, on_tpu):
+    """extra.obs_overhead: what leaving the FULL observability layer on
+    costs the decode hot path — span tracer enabled, per-request
+    timeline registry enabled (one event per token per request), SLO
+    engine observing — vs everything disabled, same engine, same
+    workload.  Reported as the p50 inter-token latency ratio over
+    paired alternating trials (median of per-trial p50s, so one noisy
+    trial cannot fake a regression either way).  The acceptance pin is
+    < 2%: below that, request tracing is safe to leave on in soak runs
+    and production fleets, which is what makes `GET /debug/request/<id>`
+    and the flight recorder always-available rather than
+    opt-in-when-debugging."""
+    import statistics
+    import time as _time
+    import jax as _jax
+    from paddle_tpu import obs as _obs
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            dtype=jnp.bfloat16, remat=False)
+        new_tokens, page_size, max_seq, streams, trials = 96, 64, 4096, \
+            4, 3
+    else:
+        cfg = LlamaConfig.tiny()
+        new_tokens, page_size, max_seq, streams, trials = 48, 4, 64, 3, 5
+
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(4))
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab_size, 3).tolist()
+               for _ in range(streams)]
+
+    def run(traced: bool) -> float:
+        # traced = the WHOLE layer on: span tracer recording, request
+        # registry recording every lifecycle edge, SLO engine
+        # observing; off = all three disabled (the single-branch no-op
+        # paths production would pay anyway)
+        tracer = _obs.Tracer(enabled=traced, capacity=1 << 15)
+        reqreg = _obs.RequestRegistry(enabled=traced)
+        eng = LLMEngine(params, cfg, num_slots=streams,
+                        page_size=page_size, max_seq_len=max_seq,
+                        prefill_chunk_tokens=4, block_q=4,
+                        tracer=tracer, reqtrace=reqreg)
+        eng.slo.enabled = traced
+        eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the executable
+        hs = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
+        while not all(h.done() for h in hs):
+            eng.step()
+        itl = eng.latency_snapshot()["inter_token_s"]["p50"]
+        eng.shutdown()
+        return itl or 0.0
+
+    run(True)                       # warm both code paths once
+    run(False)
+    on_p50, off_p50 = [], []
+    for _ in range(trials):         # alternate so drift hits both legs
+        on_p50.append(run(True))
+        off_p50.append(run(False))
+    on_med = statistics.median(on_p50)
+    off_med = statistics.median(off_p50)
+    ratio = (on_med / off_med) if off_med else None
+    return {
+        "workload": {"streams": streams, "new_tokens": new_tokens,
+                     "trials": trials},
+        "itl_p50_traced_ms": round(on_med * 1e3, 4),
+        "itl_p50_untraced_ms": round(off_med * 1e3, 4),
+        # the acceptance pin: < 1.02 means full request tracing costs
+        # under 2% of decode ITL — safe to leave on in soaks
+        "itl_p50_ratio": (None if ratio is None else round(ratio, 4)),
+        "overhead_pct": (None if ratio is None
+                         else round((ratio - 1.0) * 100, 2)),
+        "bound_pct": 2.0,
+    }
+
+
 def _engine_lifecycle_counters():
     """LLMEngine preemption/lifecycle counters + request latency
     percentiles on a deliberately undersized page pool (2 slots whose
@@ -736,7 +816,8 @@ def _sub_main(name: str) -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode,
-          "ragged": bench_ragged, "specdec": bench_specdec}[name]
+          "ragged": bench_ragged, "specdec": bench_specdec,
+          "obs_overhead": bench_obs_overhead}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
     except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
@@ -826,6 +907,7 @@ def main():
     decode_extra = _run_sub("decode")
     ragged_extra = _run_sub("ragged")
     specdec_extra = _run_sub("specdec")
+    obs_overhead_extra = _run_sub("obs_overhead")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
@@ -875,6 +957,10 @@ def main():
             # spans vs plain decode): emitted tokens/sec speedup +
             # acceptance rate on repetitive and adversarial workloads
             "specdec": specdec_extra,
+            # observability-layer cost: decode ITL with full request
+            # tracing (span tracer + per-request timelines + SLO) on vs
+            # off — pinned < 2% so the layer stays on in soak runs
+            "obs_overhead": obs_overhead_extra,
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
             "graphlint": graphlint_extra,

@@ -410,6 +410,14 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         "probe_tokens": probe_tokens,
         "stats": engine.stats_snapshot(),
     }
+    if violations:
+        # black-box the leaking engine: the state the checker just
+        # caught is exactly what a post-mortem needs (no-op without an
+        # armed flight recorder; dump() never raises)
+        fl = getattr(engine, "flight", None)
+        if fl is not None:
+            fl.dump("invariant_violation",
+                    error=InvariantViolation("; ".join(violations)))
     if violations and raise_on_violation:
         raise InvariantViolation("; ".join(violations))
     return report

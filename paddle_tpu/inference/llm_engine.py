@@ -83,6 +83,8 @@ import jax.numpy as jnp
 
 from ..models import generation
 from ..obs import metrics as obs_metrics
+from ..obs import reqtrace as obs_reqtrace
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 
 __all__ = ["LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
@@ -139,10 +141,16 @@ class _ResumeState:
 
 
 class _Request:
-    """One queued/in-flight generation request."""
+    """One queued/in-flight generation request.  `req_id` keys the
+    request's timeline in the obs request registry (generated when the
+    caller brings none); `hop` is the fleet-level placement count this
+    engine-level attempt represents (0 = first placement — the Router
+    stamps retries with their hop index so a retried request's timeline
+    shows which events belong to which replica attempt)."""
 
     def __init__(self, prompt, max_new_tokens: int, eos_id: Optional[int],
-                 deadline: Optional[float] = None):
+                 deadline: Optional[float] = None,
+                 req_id: Optional[str] = None, hop: int = 0):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -150,6 +158,8 @@ class _Request:
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
         self.eos_id = eos_id
+        self.req_id = req_id or obs_reqtrace.new_request_id()
+        self.hop = int(hop)
         self.deadline = (None if deadline is None
                          else time.monotonic() + float(deadline))
         # lifecycle timestamps (monotonic): the per-request latency
@@ -349,6 +359,16 @@ class LLMEngine:
     disabled until enabled — instrumentation is then a no-op branch).
     metrics: a paddle_tpu.obs.Registry (default: a fresh per-engine
     registry; serve_llm's GET /metrics renders it).
+    name: the replica name stamped on request-timeline events (the
+    fleet Router overrides it with the replica id).
+    reqtrace: a paddle_tpu.obs.RequestRegistry (default: the process-
+    wide registry, shared with the Router so a retried request's hops
+    land in ONE timeline; GET /debug/request/<id> reads it).
+    flight: a paddle_tpu.obs.FlightRecorder — armed here, the engine
+    dumps a black-box frame when its step thread dies.
+    slo_objectives / slo_window_s: latency objectives for the per-
+    engine SLO engine (default obs.slo.DEFAULT_OBJECTIVES over a 60s
+    window); its gauges/burn rates render on /metrics and /stats.
 
     prefill_chunk_tokens: the per-step TOKEN BUDGET for prefill chunks
     riding the unified ragged batch alongside decode spans.  Smaller =
@@ -391,7 +411,12 @@ class LLMEngine:
                  spec_k: int = 0,
                  drafter=None,
                  tracer: Optional[obs_trace.Tracer] = None,
-                 metrics: Optional[obs_metrics.Registry] = None):
+                 metrics: Optional[obs_metrics.Registry] = None,
+                 name: Optional[str] = None,
+                 reqtrace: Optional[obs_reqtrace.RequestRegistry] = None,
+                 flight=None,
+                 slo_objectives=None,
+                 slo_window_s: float = 60.0):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -456,6 +481,10 @@ class LLMEngine:
         self.tracer = tracer if tracer is not None else obs_trace.get_tracer()
         self.metrics = metrics if metrics is not None \
             else obs_metrics.Registry()
+        self.replica_name = "engine" if name is None else str(name)
+        self.reqtrace = reqtrace if reqtrace is not None \
+            else obs_reqtrace.get_request_registry()
+        self.flight = None
         if self.metrics.get("llm_accepted_total") is not None:
             # a shared registry would silently merge both engines'
             # counters and rebind the state gauges to the last engine —
@@ -516,6 +545,17 @@ class LLMEngine:
             lambda: self.cache.free_slot_count)
         reg.gauge("llm_uptime_seconds", "seconds since engine construction"
                   ).set_function(lambda: time.monotonic() - self._t_start)
+        # per-engine SLO engine over the same lifecycle latencies the
+        # histograms record: rolling-window percentile gauges, burn
+        # rates, and violation counters land in THIS registry (so
+        # /metrics shows objective health next to the raw histograms)
+        # and stats_snapshot() carries report() for /stats
+        self.slo = obs_slo.SLOEngine(
+            objectives=(slo_objectives if slo_objectives is not None
+                        else obs_slo.DEFAULT_OBJECTIVES),
+            window_s=slo_window_s).register(reg)
+        if flight is not None:
+            flight.attach_engine(self)
 
         cfg = config
 
@@ -606,12 +646,17 @@ class LLMEngine:
 
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
-               deadline: Optional[float] = None) -> _Request:
+               deadline: Optional[float] = None,
+               req_id: Optional[str] = None, hop: int = 0) -> _Request:
         """Queue a request.  deadline: seconds from now; once expired the
         request resolves with DeadlineExceeded at the next step() boundary,
         whether still queued or mid-decode.  Raises QueueFull when the
-        bounded pending queue is at capacity."""
-        req = _Request(prompt, max_new_tokens, eos_id, deadline=deadline)
+        bounded pending queue is at capacity.  req_id/hop: the fleet
+        trace context — the Router threads a request's id and placement
+        count through retries so its cross-replica timeline stays one
+        ring; direct callers may omit both (a fresh id is generated)."""
+        req = _Request(prompt, max_new_tokens, eos_id, deadline=deadline,
+                       req_id=req_id, hop=hop)
         total = req.prompt.size + req.max_new_tokens
         if total > self.max_seq_len:
             raise ValueError(
@@ -625,17 +670,20 @@ class LLMEngine:
                 f"the pool only holds {self.cache.num_pages - 1}")
         with self._cv:
             if self._stop:
+                self._rq_event(req, "reject", reason="engine_stopped")
                 raise EngineStopped("engine is stopped")
             t = self._thread
             if t is not None and not t.is_alive():
                 # the step thread CRASHED (it exits cleanly only via
                 # _stop, handled above): enqueueing would hand back a
                 # handle nothing will ever resolve
+                self._rq_event(req, "reject", reason="step_thread_dead")
                 raise EngineStopped(
                     "engine step thread died; the engine is stopped "
                     "until a supervisor rebuilds it")
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
+                self._rq_event(req, "reject", reason="queue_full")
                 raise QueueFull(
                     f"pending queue is full ({self.max_pending} requests)",
                     retry_after=1.0)
@@ -645,6 +693,9 @@ class LLMEngine:
             # (completed/cancelled/timed_out/failed) — the registry
             # identity faults.check_invariants asserts
             self.stats["accepted"] += 1
+            self._rq_event(req, "submit", prompt_tokens=int(req.prompt.size),
+                           max_new_tokens=req.max_new_tokens,
+                           queue_depth=len(self._pending))
             self._cv.notify()
         return req
 
@@ -679,7 +730,67 @@ class LLMEngine:
             snap["free_pages"] = self.cache.free_page_count
             snap["free_slots"] = self.cache.free_slot_count
             snap["uptime_s"] = time.monotonic() - self._t_start
+        # objective health rides the same snapshot (/stats shows SLO
+        # verdicts next to the counters; the registry gauges are the
+        # Prometheus twin) — computed outside _cv: the SLO engine has
+        # its own lock and never touches engine state
+        snap["slo"] = self.slo.report()
         return snap
+
+    def state_digest(self) -> dict:
+        """A compact, JSON-safe digest of live engine state — the
+        flight recorder's "engine" section.  Read lock-free on purpose:
+        the dump path runs from dying threads and the router's death
+        tick, where taking engine._cv could deadlock against the very
+        thread being mourned.  A digest may therefore be one step stale;
+        a crash digest is exact (the step thread is gone, state is
+        frozen).  Against a LIVE step thread (health-ejection dumps),
+        iterating _slots/_pending can race a mutation and raise — retry
+        a few times rather than hand the recorder an empty engine
+        section for exactly the busy engines it matters on."""
+        last_err: Optional[BaseException] = None
+        for _ in range(4):
+            try:
+                slots = {}
+                for slot in list(self._slots):
+                    st = self._slots.get(slot)
+                    if st is None:
+                        continue
+                    slots[str(slot)] = {
+                        "req_id": st.req.req_id,
+                        "hop": st.req.hop,
+                        "ctx": int(st.ctx),
+                        "tokens": len(st.req.tokens),
+                        "prefilling": bool(st.prefilling),
+                        "admit_seq": int(st.admit_seq),
+                    }
+                pending_ids = [r.req_id for r in list(self._pending)]
+                return {
+                    "replica": self.replica_name,
+                    "slots": slots,
+                    "pending": len(pending_ids),
+                    "pending_req_ids": pending_ids,
+                    "free_pages": self.cache.free_page_count,
+                    "free_slots": self.cache.free_slot_count,
+                    "counters": dict(self.stats),
+                    "alive": self.alive(),
+                    "uptime_s": time.monotonic() - self._t_start,
+                }
+            except RuntimeError as e:   # mutated-during-iteration race
+                last_err = e
+        return {"replica": self.replica_name,
+                "error": f"digest raced a live step thread "
+                         f"({last_err!r:.120})",
+                "alive": self.alive()}
+
+    def _rq_event(self, req: _Request, name: str, **attrs) -> None:
+        """One request-timeline edge, stamped with this replica's name
+        and the request's hop.  One branch when the registry is
+        disabled."""
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(req.req_id, name, replica=self.replica_name,
+                     hop=req.hop, **attrs)
 
     def latency_snapshot(self) -> dict:
         """Per-request latency percentiles over the recent raw-sample
@@ -779,11 +890,16 @@ class LLMEngine:
                 # terminal-counter identity (accepted == sum of outcomes)
                 # holds through shutdown: force-resolved counts as failed
                 self.stats["failed"] += 1
+                self._rq_event(req, "resolve", outcome="engine_stopped",
+                               queued=True)
                 req._resolve(err)
             self._pending.clear()
             for slot in list(self._slots):
                 st = self._slots.pop(slot)
                 self.stats["failed"] += 1
+                self._rq_event(st.req, "resolve",
+                               outcome="engine_stopped",
+                               tokens=len(st.req.tokens))
                 st.req._resolve(err)
                 self.cache.release_slot(slot)
 
@@ -800,13 +916,19 @@ class LLMEngine:
                 # handles its own dispatch faults; anything escaping is an
                 # engine bug — fail in-flight work so waiters unblock
                 self._fail_inflight(e)
-            except BaseException:  # noqa: BLE001 — InjectedCrash (chaos)
-                # or interpreter teardown: the step thread dies RIGHT HERE
-                # with slots held and handles unresolved.  No cleanup by
-                # design — this is replica death, the shape the fleet
-                # supervisor must prove it recovers from (shutdown() on
-                # the dead engine resolves the strands; the Router
-                # re-places what is safely recoverable).
+            except BaseException as e:  # noqa: BLE001 — InjectedCrash
+                # (chaos) or interpreter teardown: the step thread dies
+                # RIGHT HERE with slots held and handles unresolved.  No
+                # cleanup by design — this is replica death, the shape
+                # the fleet supervisor must prove it recovers from
+                # (shutdown() on the dead engine resolves the strands;
+                # the Router re-places what is safely recoverable).  The
+                # ONE thing the dying thread does is drop the black box:
+                # the flight recorder dumps the pre-crash state digest,
+                # recent spans, and counters — dump() never raises.
+                fl = self.flight
+                if fl is not None:
+                    fl.dump("step_thread_death", error=e)
                 return
 
     def _recover_pools(self, cause: BaseException) -> bool:
@@ -862,6 +984,7 @@ class LLMEngine:
                     continue
                 self._pending.remove(req)
                 self.stats[key] += 1
+                self._rq_event(req, "resolve", outcome=key, queued=True)
                 req._resolve(err)
                 did = True
         for slot in list(self._slots):
@@ -885,6 +1008,8 @@ class LLMEngine:
         self.tracer.instant("evict", slot=slot, reason=stat_key)
         with self._cv:
             self.stats[stat_key] += 1
+        self._rq_event(st.req, "resolve", outcome=stat_key,
+                       tokens=len(st.req.tokens))
         st.req._resolve(err)
 
     def _pick_victim(self) -> int:
@@ -925,6 +1050,9 @@ class LLMEngine:
         self.tracer.instant("preempt", slot=slot, ctx=st.ctx,
                             mode=self.preempt_mode,
                             mid_prefill=st.prefilling)
+        self._rq_event(st.req, "preempt", slot=slot, ctx=st.ctx,
+                       mode=self.preempt_mode,
+                       mid_prefill=st.prefilling)
         try:
             if self.preempt_mode == "swap" and pages:
                 with self.tracer.span("swap_out", slot=slot,
@@ -990,14 +1118,16 @@ class LLMEngine:
                     else:
                         if req.t_admit is None:
                             req.t_admit = time.monotonic()
-                            self._h_queue_wait.observe(
-                                req.t_admit - req.t_submit)
+                            wait = req.t_admit - req.t_submit
+                            self._h_queue_wait.observe(wait)
+                            self.slo.observe("queue_wait", wait)
                         self._slots[slot] = _SlotState(
                             req, self._admit_seq, ctx=0,
                             pending=req.prompt, sample_on_finish=True,
                             spec_k=self.spec_k)
                         with self._cv:
                             self.stats["admitted"] += 1
+                        self._rq_event(req, "admit", slot=slot)
             except Exception as e:  # noqa: BLE001 — admission must not leak
                 # the request left _pending but never (or only briefly)
                 # reached _slots: without cleanup the slot and its pages
@@ -1053,6 +1183,9 @@ class LLMEngine:
             req, self._admit_seq, ctx=rs.ctx, last_tok=rs.last_tok,
             pending=rs.pending, sample_on_finish=rs.sample_on_finish,
             spec_k=self.spec_k)
+        self._rq_event(req, "resume", slot=slot, ctx=rs.ctx,
+                       mode=("swap" if rs.host_k is not None
+                             else "recompute"))
 
     def _alloc_with_preemption(self, slot: int, n_tokens: int) -> bool:
         """Grow `slot`'s pages to cover n_tokens, preempting victims under
@@ -1297,6 +1430,8 @@ class LLMEngine:
                 continue
             if kind == "chunk":
                 st.ctx += n
+                self._rq_event(st.req, "prefill_chunk", tokens=n,
+                               ctx=st.ctx)
                 if st.prefilling:
                     continue            # more chunks on later steps
                 if not st.sample_on_finish:
@@ -1306,9 +1441,11 @@ class LLMEngine:
                     continue
                 st.pending = None
                 tok = self._row_token(nxt, lg, o0)
+                self._rq_event(st.req, "prefill_done", ctx=st.ctx)
             else:
                 st.ctx += 1
                 tok = self._row_token(nxt, lg, o0)
+                self._rq_event(st.req, "decode", ctx=st.ctx)
             st.last_tok = tok
             self._emit_tokens(slot, st, [tok], now)
         return True
@@ -1354,6 +1491,8 @@ class LLMEngine:
             self.tracer.instant("spec_rollback", slot=slot, pages=freed)
         st.last_tok = emitted[-1]
         finished, n_emitted = self._emit_tokens(slot, st, emitted, now)
+        self._rq_event(st.req, "verify", drafted=k, accepted=m,
+                       emitted=n_emitted, ctx=st.ctx)
         with self._cv:
             self.stats["spec_steps"] += 1
             self.stats["spec_drafted"] += k
@@ -1373,8 +1512,11 @@ class LLMEngine:
             if st.req.t_first_token is None:
                 st.req.t_first_token = now
                 self._h_ttft.observe(now - st.req.t_submit)
+                self.slo.observe("ttft", now - st.req.t_submit, t=now)
             elif st.req.t_last_token is not None:
                 self._h_itl.observe(now - st.req.t_last_token)
+                self.slo.observe("inter_token",
+                                 now - st.req.t_last_token, t=now)
             st.req.t_last_token = now
             if (st.req.eos_id is not None and tok == st.req.eos_id) \
                     or len(st.req.tokens) >= st.req.max_new_tokens:
@@ -1396,6 +1538,8 @@ class LLMEngine:
             dur = time.monotonic() - req.t_admit
             if dur > 0:
                 self._h_tps.observe(len(req.tokens) / dur)
+        self._rq_event(req, "resolve", outcome="completed",
+                       tokens=len(req.tokens))
         req._resolve()
 
 
@@ -1405,10 +1549,15 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
     """HTTP JSON generation endpoint over a continuous-batching engine.
 
     POST / with {"prompt": [token ids], "max_new_tokens": N,
-    "eos_id": optional, "deadline": optional seconds} returns
-    {"tokens": [...]}.  Concurrent requests share the engine's decode
-    batch (continuous batching), so throughput scales with occupancy, not
-    request count.
+    "eos_id": optional, "deadline": optional seconds, "request_id":
+    optional trace id} returns {"tokens": [...], "request_id": "..."}.
+    The request id keys the request's obs timeline: `GET
+    /debug/request/<id>` returns the queryable lifecycle (submit ->
+    admit -> prefill chunks -> decode/verify steps -> preempt/resume ->
+    resolve) from the engine's RequestRegistry, 404 once evicted from
+    the LRU window.  Concurrent requests share the engine's decode
+    batch (continuous batching), so throughput scales with occupancy,
+    not request count.
 
     Failure surface: a full pending queue replies 503 with a Retry-After
     header; a request that misses `request_timeout` replies 504 AND is
@@ -1445,6 +1594,16 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
             path = self.path.rstrip("/")
             if path == "/stats":
                 self._reply(200, engine.stats_snapshot())
+            elif path.startswith("/debug/request/"):
+                rid = path.rsplit("/", 1)[1]
+                reg = getattr(engine, "reqtrace", None)
+                tl = None if reg is None else reg.to_dict(rid)
+                if tl is None:
+                    self._reply(404, {"error": f"unknown request id "
+                                               f"{rid!r} (never traced, "
+                                               "or evicted)"})
+                else:
+                    self._reply(200, tl)
             elif path == "/metrics":
                 reg = getattr(engine, "metrics", None)
                 if reg is None:
@@ -1476,13 +1635,17 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
                     max_new = int(req.get("max_new_tokens", 16))
                     eos_id = req.get("eos_id")
                     deadline = req.get("deadline")
+                    req_id = req.get("request_id")
+                    if req_id is not None:
+                        req_id = str(req_id)
                 except (json.JSONDecodeError, KeyError, TypeError,
                         ValueError) as e:
                     self._reply(400, {"error": f"bad request body: {e!r}"})
                     return
                 try:
                     handle = engine.submit(prompt, max_new, eos_id,
-                                           deadline=deadline)
+                                           deadline=deadline,
+                                           req_id=req_id)
                 except QueueFull as e:
                     retry = max(1, int(-(-e.retry_after // 1)))
                     self._reply(503, {"error": str(e)},
@@ -1502,7 +1665,8 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
                 except RequestCancelled as e:
                     self._reply(409, {"error": str(e)})
                     return
-                self._reply(200, {"tokens": toks})
+                self._reply(200, {"tokens": toks,
+                                  "request_id": handle.req_id})
             except Exception as e:  # noqa: BLE001 — server-side fault
                 self._reply(500, {"error": repr(e)})
 

@@ -70,6 +70,7 @@ from .llm_engine import (DeadlineExceeded, EngineStopped, LLMEngine,
                          QueueFull, RequestCancelled, _StatsDict)
 from .supervisor import EngineSupervisor
 from ..obs import metrics as obs_metrics
+from ..obs import reqtrace as obs_reqtrace
 
 __all__ = ["Router", "Replica", "FleetHandle", "serve_fleet",
            "FleetQueueFull", "NoHealthyReplica", "ReplicaDied",
@@ -121,10 +122,15 @@ class FleetHandle:
 
     def __init__(self, router: "Router", prompt: Sequence[int],
                  max_new_tokens: int, eos_id: Optional[int],
-                 deadline: Optional[float], max_hops: int):
+                 deadline: Optional[float], max_hops: int,
+                 req_id: Optional[str] = None):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        # the fleet trace context: every engine-level hop carries this
+        # id (and its hop index), so the request's whole cross-replica
+        # journey shares ONE timeline in the obs request registry
+        self.req_id = req_id or obs_reqtrace.new_request_id()
         # absolute, fixed at FLEET submission: every hop re-derives its
         # remaining budget from this, so retries never get fresh time
         self._deadline = (None if deadline is None
@@ -252,7 +258,8 @@ class Router:
                  canary_timeout: float = 30.0,
                  engine_shutdown_timeout: float = 10.0,
                  threaded: bool = True,
-                 metrics: Optional[obs_metrics.Registry] = None):
+                 metrics: Optional[obs_metrics.Registry] = None,
+                 reqtrace: Optional[obs_reqtrace.RequestRegistry] = None):
         if engines is None:
             if factory is None:
                 raise ValueError("pass engines= or factory=")
@@ -264,6 +271,16 @@ class Router:
             supervisor = EngineSupervisor(factory)
         self.supervisor = supervisor
         self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        self.reqtrace = reqtrace if reqtrace is not None \
+            else obs_reqtrace.get_request_registry()
+        # stamp each engine with its replica id AND the fleet's request
+        # registry: timelines key replica tracks on the name, and a
+        # request's engine-level edges must land in the SAME ring as
+        # the router's fleet edges — a custom `reqtrace=` that only
+        # reached the router would silently split every timeline in two
+        for r in self.replicas:
+            r.engine.replica_name = str(r.rid)
+            r.engine.reqtrace = self.reqtrace
         self.faults = faults
         self.max_hops = int(max_hops)
         self.health_interval = float(health_interval)
@@ -307,31 +324,41 @@ class Router:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
-               max_hops: Optional[int] = None) -> FleetHandle:
+               max_hops: Optional[int] = None,
+               req_id: Optional[str] = None) -> FleetHandle:
         """Place a request on the least-loaded healthy replica.  Raises
         FleetQueueFull when EVERY healthy replica refuses (min
         Retry-After attached), NoHealthyReplica when rotation is empty,
         RouterStopped while draining, ValueError for requests no replica
-        could ever serve."""
+        could ever serve.  req_id: optional trace id (serve_fleet passes
+        the client's); the handle's `req_id` keys the request's
+        cross-replica timeline (`GET /debug/request/<id>`)."""
         if self._stopping:
             raise RouterStopped("router is draining/stopped")
         fh = FleetHandle(self, prompt, max_new_tokens, eos_id, deadline,
-                         self.max_hops if max_hops is None else max_hops)
+                         self.max_hops if max_hops is None else max_hops,
+                         req_id=req_id)
+        self._rq_event(fh, "fleet_submit",
+                       prompt_tokens=len(fh.prompt),
+                       max_new_tokens=fh.max_new_tokens)
         t0 = time.monotonic()
         try:
             placed, retry_after, saw_queue_full = self._try_place(
                 fh, count_accepted=True)
         except ValueError:
             self.stats.inc("rejected")   # malformed for EVERY replica
+            self._rq_event(fh, "fleet_reject", reason="invalid")
             raise
         self._h_placement.observe(time.monotonic() - t0)
         if placed:
             return fh
         self.stats.inc("rejected")
         if saw_queue_full:
+            self._rq_event(fh, "fleet_reject", reason="queue_full")
             raise FleetQueueFull(
                 "every healthy replica is at queue capacity",
                 retry_after=retry_after if retry_after else 1.0)
+        self._rq_event(fh, "fleet_reject", reason="no_healthy_replica")
         raise NoHealthyReplica(
             "no healthy replica available (all ejected, dead, or dying)")
 
@@ -372,6 +399,14 @@ class Router:
             # kill the tick thread — degrade it to the typed fault every
             # fire site already handles.
             raise _faults.InjectedFault(str(e)) from e
+
+    def _rq_event(self, fh: FleetHandle, name: str, **attrs) -> None:
+        """One fleet-level edge on the request's timeline, stamped
+        "router" (engine-level events carry the replica name instead)."""
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            rt.event(fh.req_id, name, replica="router",
+                     hop=len(fh.hops) - 1 if fh.hops else None, **attrs)
 
     def _score(self, r: Replica):
         """Least-loaded placement score, SMALLER is better: (queue depth
@@ -438,7 +473,8 @@ class Router:
             try:
                 hop = r.engine.submit(
                     fh.prompt, fh.max_new_tokens, fh.eos_id,
-                    deadline=fh.remaining_deadline())
+                    deadline=fh.remaining_deadline(),
+                    req_id=fh.req_id, hop=len(fh.hops))
             except QueueFull as e:
                 retry_after = (e.retry_after if retry_after is None
                                else min(retry_after, e.retry_after))
@@ -491,12 +527,16 @@ class Router:
             fh.tokens = list(req.tokens)
             fh._resolve()
             self.stats.inc("completed")
+            self._rq_event(fh, "fleet_resolve", outcome="completed",
+                           tokens=len(fh.tokens), hops=list(fh.hops))
         elif isinstance(err, RequestCancelled):
             fh._resolve(err)
             self.stats.inc("cancelled")
+            self._rq_event(fh, "fleet_resolve", outcome="cancelled")
         elif isinstance(err, DeadlineExceeded):
             fh._resolve(err)
             self.stats.inc("timed_out")
+            self._rq_event(fh, "fleet_resolve", outcome="timed_out")
         elif isinstance(err, EngineStopped):
             self._retry_or_fail(fh, r, req)
         else:
@@ -505,6 +545,7 @@ class Router:
             # the replica itself already recovered
             fh._resolve(err)
             self.stats.inc("failed")
+            self._rq_event(fh, "fleet_resolve", outcome="failed")
 
     def _retry_or_fail(self, fh: FleetHandle, r: Replica, req) -> None:
         """Replica death resolution.  The retry-safety rules, in order:
@@ -517,10 +558,13 @@ class Router:
                 f"replica {r.rid} died after {len(req.tokens)} token(s) "
                 "were resolved; not safely retryable"))
             self.stats.inc("failed")
+            self._rq_event(fh, "fleet_resolve", outcome="replica_died",
+                           replica_id=r.rid, tokens=len(req.tokens))
             return
         if fh.cancelled:
             fh._resolve(RequestCancelled("request cancelled"))
             self.stats.inc("cancelled")
+            self._rq_event(fh, "fleet_resolve", outcome="cancelled")
             return
         rem = fh.remaining_deadline()
         if rem is not None and rem <= 0:
@@ -528,19 +572,25 @@ class Router:
                 f"deadline expired during replica-death retry "
                 f"(hops={fh.hops})"))
             self.stats.inc("timed_out")
+            self._rq_event(fh, "fleet_resolve", outcome="timed_out")
             return
         if self._stopping:
             fh._resolve(EngineStopped("fleet shut down"))
             self.stats.inc("failed")
+            self._rq_event(fh, "fleet_resolve", outcome="fleet_stopped")
             return
         if fh.hops_left <= 0:
             fh._resolve(RetriesExhausted(
                 f"replica died and the retry budget is exhausted "
                 f"(hops={fh.hops})"))
             self.stats.inc("failed")
+            self._rq_event(fh, "fleet_resolve",
+                           outcome="retries_exhausted")
             return
         fh.hops_left -= 1
         self.stats.inc("retries")
+        self._rq_event(fh, "retry", dead_replica=r.rid,
+                       hops_left=fh.hops_left)
         try:
             placed, _, _ = self._try_place(fh)
         except ValueError as e:
@@ -558,6 +608,7 @@ class Router:
             fh._is_parked = True
             self._parked.append(fh)
             self.stats.inc("parked")
+        self._rq_event(fh, "park")
 
     def _drain_parked(self) -> None:
         with self._lock:
@@ -677,6 +728,11 @@ class Router:
             r.ejected_until = now + r.backoff
             r.state = EJECTED
             self.stats.inc("ejections")
+        # black-box the ejected replica: the state that failed the probe
+        # is what a 3am post-mortem needs (dump() is best-effort/no-raise)
+        fl = getattr(r.engine, "flight", None)
+        if fl is not None:
+            fl.dump("health_ejection")
 
     def _launch_canary(self, r: Replica, now: float) -> None:
         """Reinstatement is earned: a 1-token probe must COMPLETE through
@@ -715,6 +771,15 @@ class Router:
             self.stats.inc("ejections")
             inflight = list(r.inflight)
             r.inflight.clear()
+        # black-box the dead replica BEFORE teardown: shutdown() resolves
+        # handles and releases slots, and the dump must show the
+        # pre-crash occupancy, not the post-shutdown rubble.  (A threaded
+        # engine's dying step thread already dumped "step_thread_death";
+        # a pump-mode crash is caught outside the engine, so this is the
+        # only dump that replica gets.)
+        fl = getattr(r.engine, "flight", None)
+        if fl is not None:
+            fl.dump("replica_death")
         # capture each stranded request's hop on THIS replica before
         # teardown: shutdown resolutions trigger the retry path, which
         # can re-place a handle onto a healthy replica and swap fh._hop
@@ -743,6 +808,8 @@ class Router:
         if new is None:
             return                  # rebuild budget exhausted: stays dead
         now = time.monotonic()
+        new.replica_name = str(r.rid)   # keep timelines keyed by rid
+        new.reqtrace = self.reqtrace    # ...and in the fleet's registry
         with self._lock:
             r.engine = new
             r.dead = False
@@ -876,7 +943,12 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
     """HTTP entry over a fleet Router (the multi-replica serve_llm).
 
     POST / with {"prompt": [...], "max_new_tokens": N, "eos_id"?,
-    "deadline"?} returns {"tokens": [...], "hops": [replica ids]}.
+    "deadline"?, "request_id"?} returns {"tokens": [...], "hops":
+    [replica ids], "request_id": "..."}.  `GET /debug/request/<id>`
+    returns the request's cross-replica timeline from the router's
+    RequestRegistry — fleet placement/retry edges stamped "router",
+    engine lifecycle edges stamped with each hop's replica id — or 404
+    once evicted from the LRU window.
     Failure surface: fleet backpressure (every replica QueueFull) and an
     empty rotation reply 503 with Retry-After; deadline/timeout replies
     504 AND cancels fleet-wide; a terminal replica-death error
@@ -923,6 +995,15 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                         str(r.rid): r.engine.stats_snapshot()
                         for r in router.replicas},
                 })
+            elif path.startswith("/debug/request/"):
+                rid = path.rsplit("/", 1)[1]
+                tl = router.reqtrace.to_dict(rid)
+                if tl is None:
+                    self._reply(404, {"error": f"unknown request id "
+                                               f"{rid!r} (never traced, "
+                                               "or evicted)"})
+                else:
+                    self._reply(200, tl)
             elif path == "/metrics":
                 text = router.metrics.render() + obs_metrics.render_merged(
                     [(str(r.rid), r.engine.metrics)
@@ -958,13 +1039,17 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                     max_new = int(req.get("max_new_tokens", 16))
                     eos_id = req.get("eos_id")
                     deadline = req.get("deadline")
+                    req_id = req.get("request_id")
+                    if req_id is not None:
+                        req_id = str(req_id)
                 except (json.JSONDecodeError, KeyError, TypeError,
                         ValueError) as e:
                     self._reply(400, {"error": f"bad request body: {e!r}"})
                     return
                 try:
                     handle = router.submit(prompt, max_new, eos_id,
-                                           deadline=deadline)
+                                           deadline=deadline,
+                                           req_id=req_id)
                 except (FleetQueueFull, NoHealthyReplica) as e:
                     retry = max(1, int(-(-getattr(e, "retry_after", 1.0)
                                          // 1)))
@@ -997,7 +1082,8 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                 except RequestCancelled as e:
                     self._reply(409, {"error": str(e)})
                     return
-                self._reply(200, {"tokens": toks, "hops": handle.hops})
+                self._reply(200, {"tokens": toks, "hops": handle.hops,
+                                  "request_id": handle.req_id})
             except Exception as e:  # noqa: BLE001 — server-side fault
                 self._reply(500, {"error": repr(e)})
 

@@ -30,8 +30,11 @@ from __future__ import annotations
 from . import trace  # noqa: F401
 from . import metrics  # noqa: F401
 from . import mfu  # noqa: F401
+from . import reqtrace  # noqa: F401
+from . import flight  # noqa: F401
+from . import slo  # noqa: F401
 from .trace import (  # noqa: F401
-    Tracer, get_tracer, load_trace, summarize,
+    Tracer, get_tracer, load_trace, summarize, export_merged,
 )
 from .metrics import (  # noqa: F401
     Registry, Counter, Gauge, Histogram, render_merged,
@@ -39,11 +42,19 @@ from .metrics import (  # noqa: F401
 from .mfu import (  # noqa: F401
     RecompileSentinel, RecompileWarning, device_peak_flops, runtime_report,
 )
+from .reqtrace import (  # noqa: F401
+    RequestRegistry, get_request_registry, new_request_id,
+)
+from .flight import FlightRecorder, load_dump  # noqa: F401
+from .slo import Objective, SLOEngine  # noqa: F401
 
 __all__ = [
-    "trace", "metrics", "mfu", "Tracer", "get_tracer", "load_trace",
-    "summarize", "Registry", "Counter", "Gauge", "Histogram",
-    "render_merged",
+    "trace", "metrics", "mfu", "reqtrace", "flight", "slo",
+    "Tracer", "get_tracer", "load_trace",
+    "summarize", "export_merged", "Registry", "Counter", "Gauge",
+    "Histogram", "render_merged",
     "RecompileSentinel", "RecompileWarning", "device_peak_flops",
     "runtime_report",
+    "RequestRegistry", "get_request_registry", "new_request_id",
+    "FlightRecorder", "load_dump", "Objective", "SLOEngine",
 ]

@@ -1,0 +1,237 @@
+"""Flight recorder: a black-box that dumps on crash, loadable later.
+
+A soak run that dies at 3am leaves you a stack trace and nothing else —
+the spans, counters, and engine state that explain the death lived in
+the dead process.  The flight recorder is the rolling black-box: it
+holds references to the obs sources (span tracer ring, metrics
+registry, request-timeline registry, an engine-state digest callable)
+and, when something dies, writes ONE JSON dump of all of them —
+atomically (tmp + os.replace), never raising into the failure path that
+triggered it.
+
+Dump triggers, wired where the failures happen:
+
+  * step-thread death — `LLMEngine._loop`'s BaseException path dumps
+    before the thread exits (the InjectedCrash / segfaulting-kernel
+    shape);
+  * replica death / health ejection — the Router dumps the dead or
+    ejected replica's recorder BEFORE tearing the engine down, so the
+    digest shows the pre-crash slots, not the post-shutdown rubble;
+  * invariant violation — `faults.check_invariants` dumps when a chaos
+    schedule finds a leak, capturing the state that leaked;
+  * SIGTERM — `install_sigterm()` chains a dump in front of the
+    previous handler (opt-in: tools arm it, libraries never touch
+    process signal state).
+
+`load_dump(path)` reads a dump back and validates the schema — the
+chaos tools (`--flight-dir`) fail a soak when a crash produced no
+loadable dump, which keeps the recorder honest under the exact storms
+it exists for.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["FlightRecorder", "load_dump", "install_sigterm",
+           "SCHEMA"]
+
+SCHEMA = "paddle_tpu.flight/v1"
+
+# the keys every dump carries; load_dump validates them so a truncated
+# or foreign file fails loudly instead of half-parsing
+_REQUIRED = ("schema", "reason", "name", "wall_time", "spans", "metrics",
+             "engine", "requests", "error")
+
+
+class FlightRecorder:
+    """Rolling black-box over one engine's obs sources.
+
+    dir: dump directory (created on first dump).  None = in-memory only:
+    `dump()` still snapshots into `self.last` (tests and embedders read
+    it) but writes nothing.
+    name: stamped into dumps and filenames (the router uses replica ids).
+    max_spans / max_requests: bound the dump size — the most recent
+    window, which is the one that explains a crash.
+    """
+
+    _seq_lock = threading.Lock()
+    _seq = 0
+
+    def __init__(self, dir: Optional[str] = None, name: str = "engine",
+                 max_spans: int = 2048, max_requests: int = 32):
+        self.dir = dir
+        self.name = str(name)
+        self.max_spans = int(max_spans)
+        self.max_requests = int(max_requests)
+        self._tracer = None
+        self._registry = None
+        self._reqtrace = None
+        self._state_fn: Optional[Callable[[], dict]] = None
+        self.last: Optional[dict] = None      # most recent snapshot
+        self.dumps: List[str] = []            # paths written (dir mode)
+        self._lock = threading.Lock()
+
+    # -- wiring -------------------------------------------------------------
+
+    def attach(self, tracer=None, registry=None, reqtrace=None,
+               state_fn: Optional[Callable[[], dict]] = None
+               ) -> "FlightRecorder":
+        """Attach obs sources piecemeal (any subset; later calls only
+        overwrite what they pass)."""
+        if tracer is not None:
+            self._tracer = tracer
+        if registry is not None:
+            self._registry = registry
+        if reqtrace is not None:
+            self._reqtrace = reqtrace
+        if state_fn is not None:
+            self._state_fn = state_fn
+        return self
+
+    def attach_engine(self, engine, name: Optional[str] = None
+                      ) -> "FlightRecorder":
+        """Wire an LLMEngine: its tracer, metrics registry, request
+        registry, and `state_digest` become the dump sources, and
+        `engine.flight = self` arms the engine's own death trigger."""
+        if name is not None:
+            self.name = str(name)
+        self.attach(tracer=getattr(engine, "tracer", None),
+                    registry=getattr(engine, "metrics", None),
+                    reqtrace=getattr(engine, "reqtrace", None),
+                    state_fn=getattr(engine, "state_digest", None))
+        engine.flight = self
+        return self
+
+    # -- snapshot / dump ----------------------------------------------------
+
+    def snapshot(self, reason: str, error: Optional[BaseException] = None
+                 ) -> dict:
+        """One black-box frame: recent spans, metrics text + counter
+        values, the engine state digest, recent request timelines.
+        Every source is read best-effort — a half-dead engine must not
+        turn its own post-mortem into a second crash."""
+        snap = {
+            "schema": SCHEMA,
+            "reason": str(reason),
+            "name": self.name,
+            "wall_time": time.time(),
+            "perf_time": time.perf_counter(),
+            "error": None if error is None else repr(error),
+            "spans": [],
+            "metrics": None,
+            "engine": None,
+            "requests": None,
+        }
+        try:
+            if self._tracer is not None:
+                evs = self._tracer.events()[-self.max_spans:]
+                snap["spans"] = [
+                    {"name": e.name, "t0": e.t0, "t1": e.t1, "ph": e.ph,
+                     "step": e.step,
+                     **({"attrs": dict(e.attrs)} if e.attrs else {})}
+                    for e in evs]
+        except Exception:  # noqa: BLE001 — best-effort post-mortem
+            pass
+        try:
+            if self._registry is not None:
+                snap["metrics"] = self._registry.render()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            if self._state_fn is not None:
+                snap["engine"] = self._state_fn()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            if self._reqtrace is not None:
+                snap["requests"] = self._reqtrace.snapshot(
+                    limit=self.max_requests)
+        except Exception:  # noqa: BLE001
+            pass
+        return snap
+
+    def dump(self, reason: str, error: Optional[BaseException] = None
+             ) -> Optional[str]:
+        """Snapshot and (when `dir` is set) write atomically.  Returns
+        the path written, or None in in-memory mode.  NEVER raises —
+        this runs inside dying threads and signal handlers."""
+        try:
+            snap = self.snapshot(reason, error)
+        except Exception:  # noqa: BLE001 — even snapshot() failing must
+            return None    # not escalate the crash being recorded
+        self.last = snap
+        if self.dir is None:
+            return None
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            with FlightRecorder._seq_lock:
+                FlightRecorder._seq += 1
+                seq = FlightRecorder._seq
+            fname = (f"flight_{self.name}_{os.getpid()}_{seq:04d}"
+                     f"_{reason}.json")
+            path = os.path.join(self.dir, fname)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(snap, f)
+            os.replace(tmp, path)       # atomic: never a torn dump
+            with self._lock:
+                self.dumps.append(path)
+            return path
+        except Exception:  # noqa: BLE001
+            return None
+
+
+def load_dump(path: str) -> dict:
+    """Read a flight dump back, validating the schema — the assertion
+    surface the chaos tools use ("this crash left a loadable black
+    box").  Raises ValueError on a foreign/truncated file."""
+    with open(path) as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or data.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path!r} is not a flight dump (schema="
+            f"{data.get('schema') if isinstance(data, dict) else None!r}, "
+            f"want {SCHEMA!r})")
+    missing = [k for k in _REQUIRED if k not in data]
+    if missing:
+        raise ValueError(f"flight dump {path!r} missing keys: {missing}")
+    return data
+
+
+def install_sigterm(recorders, chain: bool = True):
+    """Arm SIGTERM: dump every recorder, then run (or restore) the
+    previous disposition.  Opt-in, main-thread only — tools call this;
+    library code never touches process signal state.  `recorders` is
+    read LIVE at fire time (a sequence the caller may keep appending to
+    as schedules build engines).  Returns the handler installed (tests
+    invoke it directly)."""
+    prev = signal.getsignal(signal.SIGTERM)
+
+    def _handler(signum, frame):
+        # the handler interrupts the MAIN thread mid-bytecode — it may
+        # already hold a registry/tracer lock snapshot() needs, and a
+        # plain dump() here would deadlock against our own frame.  Dump
+        # from a helper thread with a bounded join instead: the worst
+        # case (signal landed inside a locked region) degrades to a
+        # partial dump after the timeout, never a hung termination.
+        def _dump_all():
+            for r in list(recorders):
+                r.dump("sigterm")
+
+        t = threading.Thread(target=_dump_all, daemon=True)
+        t.start()
+        t.join(timeout=10.0)
+        if chain and callable(prev):
+            prev(signum, frame)
+        elif chain and prev == signal.SIG_DFL:
+            signal.signal(signal.SIGTERM, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    signal.signal(signal.SIGTERM, _handler)
+    return _handler

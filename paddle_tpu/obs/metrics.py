@@ -47,10 +47,19 @@ def _fmt(v: float) -> str:
     return repr(int(f)) if f == int(f) else repr(f)
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text-format label escaping: backslash, double-quote,
+    and newline must be escaped or a replica named `a"b` corrupts every
+    sample line it labels (scrapers reject the whole exposition)."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
 def _fmt_labels(labels: Optional[dict]) -> str:
     if not labels:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"'
+                     for k, v in sorted(labels.items()))
     return "{" + inner + "}"
 
 

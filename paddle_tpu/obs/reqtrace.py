@@ -1,0 +1,228 @@
+"""Per-request trace context: bounded event rings keyed by request id.
+
+The span tracer (obs.trace) answers "where does a STEP spend its time";
+it cannot answer "where did REQUEST 7f3a spend its time", because a
+request's life crosses threads (HTTP handler -> engine step thread),
+components (router -> engine), and — in a fleet — replicas (placed on
+replica 0, replica 0 dies, retried on replica 1).  This module is that
+second axis: every lifecycle edge of a request appends one `ReqEvent`
+to the request's own bounded ring, and the registry holds the rings for
+the most recent requests.
+
+Design constraints mirror the tracer's:
+
+  1. Disabled cost ~ zero: `RequestRegistry.event()` is ONE branch when
+     disabled.  Enabled cost is one lock + two dict/deque ops — small
+     enough to leave on in soak runs (bench.py `extra.obs_overhead`
+     pins the full-engine overhead under 2% of decode ITL).
+  2. Bounded memory twice over: each timeline is a
+     `deque(maxlen=events_per_request)` (a 10k-token decode keeps its
+     most recent edges, not all of them — `dropped` counts the rest),
+     and the registry itself is an LRU of `max_requests` timelines.
+  3. One registry per FLEET, not per engine: the router and every
+     replica engine default to the shared process registry
+     (`get_request_registry()`), so a request's hop from a dead replica
+     to its successor lands in ONE timeline.  `replica` on each event
+     says who wrote it.
+
+Timestamps are `time.perf_counter()` — the same clock the span tracer
+uses, so `trace.export_merged` can place request events on the replica
+tracks and stitch hops with Perfetto flow arrows.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+import uuid
+from typing import Dict, List, Optional
+
+__all__ = ["ReqEvent", "RequestTimeline", "RequestRegistry",
+           "get_request_registry", "set_request_registry",
+           "new_request_id"]
+
+
+def new_request_id() -> str:
+    """A fresh request id: 16 hex chars, unique enough for a fleet's
+    LRU window.  Callers (HTTP ingress) may supply their own instead —
+    any non-empty string keys a timeline."""
+    return uuid.uuid4().hex[:16]
+
+
+class ReqEvent:
+    """One lifecycle edge of one request.  `t` is perf_counter seconds
+    (the span tracer's clock); `replica` is the writing component's name
+    (a replica id, or "router"); `hop` is the request's engine-level
+    placement count at the time (0 = first placement)."""
+
+    __slots__ = ("name", "t", "replica", "hop", "attrs")
+
+    def __init__(self, name: str, t: float, replica: Optional[str],
+                 hop: Optional[int], attrs: Optional[dict]):
+        self.name = name
+        self.t = t
+        self.replica = replica
+        self.hop = hop
+        self.attrs = attrs
+
+    def to_dict(self) -> dict:
+        d = {"name": self.name, "t": self.t}
+        if self.replica is not None:
+            d["replica"] = self.replica
+        if self.hop is not None:
+            d["hop"] = self.hop
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        return d
+
+    def __repr__(self):
+        return (f"ReqEvent({self.name!r}, replica={self.replica!r}, "
+                f"hop={self.hop})")
+
+
+class RequestTimeline:
+    """One request's bounded event ring."""
+
+    __slots__ = ("req_id", "events", "dropped", "t_first")
+
+    def __init__(self, req_id: str, maxlen: int):
+        self.req_id = req_id
+        self.events: collections.deque = collections.deque(maxlen=maxlen)
+        self.dropped = 0        # events the ring overwrote
+        self.t_first: Optional[float] = None
+
+    def append(self, ev: ReqEvent) -> None:
+        if self.t_first is None:
+            self.t_first = ev.t
+        if len(self.events) == self.events.maxlen:
+            self.dropped += 1
+        self.events.append(ev)
+
+    @property
+    def replicas(self) -> List[str]:
+        """Distinct replica names in first-touch order — the request's
+        journey across the fleet."""
+        seen: List[str] = []
+        for e in self.events:
+            if e.replica is not None and e.replica not in seen:
+                seen.append(e.replica)
+        return seen
+
+    def to_dict(self) -> dict:
+        evs = list(self.events)
+        return {
+            "request_id": self.req_id,
+            "events": [e.to_dict() for e in evs],
+            "dropped": self.dropped,
+            "replicas": self.replicas,
+            "duration_s": (evs[-1].t - self.t_first
+                           if evs and self.t_first is not None else 0.0),
+        }
+
+
+class RequestRegistry:
+    """LRU map request id -> RequestTimeline; the queryable store behind
+    `GET /debug/request/<id>` and the flight recorder's request section.
+
+    Thread-safe: HTTP handler threads, engine step threads, and the
+    router health tick all write concurrently.  `event()` is one branch
+    while disabled."""
+
+    def __init__(self, max_requests: int = 1024,
+                 events_per_request: int = 256, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self.max_requests = int(max_requests)
+        self.events_per_request = int(events_per_request)
+        self._timelines: "collections.OrderedDict[str, RequestTimeline]" = \
+            collections.OrderedDict()
+        self._lock = threading.Lock()
+
+    # -- control ------------------------------------------------------------
+
+    def enable(self) -> "RequestRegistry":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "RequestRegistry":
+        self.enabled = False
+        return self
+
+    def clear(self) -> None:
+        with self._lock:
+            self._timelines.clear()
+
+    # -- recording ----------------------------------------------------------
+
+    def event(self, req_id: Optional[str], name: str,
+              replica: Optional[str] = None, hop: Optional[int] = None,
+              **attrs) -> None:
+        """Append one lifecycle edge to `req_id`'s ring.  No-op when
+        disabled or req_id is falsy (an untraced request costs one
+        branch, never an allocation)."""
+        if not self.enabled or not req_id:
+            return
+        ev = ReqEvent(name, time.perf_counter(), replica, hop,
+                      attrs or None)
+        with self._lock:
+            tl = self._timelines.get(req_id)
+            if tl is None:
+                tl = self._timelines[req_id] = RequestTimeline(
+                    req_id, self.events_per_request)
+                while len(self._timelines) > self.max_requests:
+                    self._timelines.popitem(last=False)   # LRU eviction
+            else:
+                self._timelines.move_to_end(req_id)
+            tl.append(ev)
+
+    # -- reading ------------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._timelines)
+
+    def ids(self) -> List[str]:
+        """Request ids, oldest-touched first."""
+        with self._lock:
+            return list(self._timelines)
+
+    def timeline(self, req_id: str) -> Optional[RequestTimeline]:
+        with self._lock:
+            return self._timelines.get(req_id)
+
+    def to_dict(self, req_id: str) -> Optional[dict]:
+        """The `GET /debug/request/<id>` payload (None when unknown —
+        evicted, or never traced)."""
+        tl = self.timeline(req_id)
+        return None if tl is None else tl.to_dict()
+
+    def snapshot(self, limit: Optional[int] = 32) -> List[dict]:
+        """The most recently touched `limit` timelines as dicts — the
+        flight recorder's request section."""
+        with self._lock:
+            ids = list(self._timelines)
+        if limit is not None:
+            ids = ids[-int(limit):]
+        out = []
+        for rid in ids:
+            d = self.to_dict(rid)
+            if d is not None:
+                out.append(d)
+        return out
+
+
+# one registry per FLEET by default: router + all replica engines write
+# here unless handed their own, so a retried request's hops share a ring
+_default = RequestRegistry()
+
+
+def get_request_registry() -> RequestRegistry:
+    return _default
+
+
+def set_request_registry(registry: RequestRegistry) -> RequestRegistry:
+    """Swap the process default (tests isolate themselves with this).
+    Returns the previous registry."""
+    global _default
+    prev, _default = _default, registry
+    return prev

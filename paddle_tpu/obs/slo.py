@@ -1,0 +1,219 @@
+"""SLO engine: rolling-window latency objectives + burn-rate counters.
+
+The latency histograms (obs.metrics) answer "what IS the p99"; an SLO
+answers "is the p95 where we PROMISED, and how fast are we spending the
+error budget".  This module holds the objectives and does the rolling
+arithmetic:
+
+  * an `Objective` is (metric, quantile, threshold): "p95 of TTFT stays
+    under 2s".  The error budget is the quantile's complement — a p95
+    objective tolerates 5% of requests over the threshold.
+  * the `SLOEngine` keeps a TIMESTAMPED rolling window of samples per
+    metric (the histograms' raw rings are count-bounded, not
+    time-bounded — an SLO over "the last 60 seconds" needs its own
+    clock), plus a cumulative violation counter per objective.
+  * `burn_rate` is the SRE convention: observed error fraction in the
+    window divided by the budget fraction.  1.0 = spending the budget
+    exactly as fast as allowed; 10 = alarm.  0 while the window is
+    empty — no traffic is not an outage.
+
+Surfaces: `register(registry)` exposes per-objective gauges
+(`slo_<metric>_p<q>_seconds`, `..._target_seconds`, `..._burn_rate`,
+`..._ok`) and a violations counter in the same Prometheus registry the
+engine already renders, so `/metrics` and `/stats` (via `report()`)
+show objective health next to the raw histograms.  The LLMEngine
+constructs one per engine and feeds it alongside the histograms, so the
+observation cost is one deque append + one compare per sample.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from . import metrics as obs_metrics
+
+__all__ = ["Objective", "SLOEngine", "DEFAULT_OBJECTIVES"]
+
+
+class Objective:
+    """One latency objective: quantile `q` of `metric` stays under
+    `threshold_s`.  `metric` names a sample stream the feeding engine
+    observes ("ttft", "inter_token", "queue_wait" in the LLMEngine)."""
+
+    def __init__(self, metric: str, q: float, threshold_s: float,
+                 name: Optional[str] = None):
+        if not 0.0 < float(q) < 1.0:
+            raise ValueError(f"objective quantile must be in (0, 1), "
+                             f"got {q}")
+        if float(threshold_s) <= 0.0:
+            raise ValueError("objective threshold must be > 0")
+        self.metric = str(metric)
+        self.q = float(q)
+        self.threshold_s = float(threshold_s)
+        # "ttft_p95" — the slug metric names and report keys build on
+        self.name = name or f"{self.metric}_p{round(self.q * 100)}"
+
+    @property
+    def budget(self) -> float:
+        """Error budget fraction: a p95 objective tolerates 5% over."""
+        return 1.0 - self.q
+
+    def __repr__(self):
+        return (f"Objective({self.metric} p{self.q * 100:g} < "
+                f"{self.threshold_s}s)")
+
+
+# serving defaults: generous enough that a healthy CPU-interpret test
+# engine meets them, tight enough that a wedged fleet burns visibly
+DEFAULT_OBJECTIVES = (
+    Objective("ttft", 0.95, 2.0),
+    Objective("inter_token", 0.95, 0.5),
+    Objective("queue_wait", 0.95, 2.0),
+)
+
+
+class SLOEngine:
+    """Rolling-window objective evaluation over pushed samples.
+
+    window_s: the rolling horizon for percentiles and burn rates.
+    max_samples: per-metric ring bound (memory cap under bursts).
+    Thread-safe; `observe()` is cheap enough for the decode loop."""
+
+    def __init__(self,
+                 objectives: Sequence[Objective] = DEFAULT_OBJECTIVES,
+                 window_s: float = 60.0, max_samples: int = 4096,
+                 enabled: bool = True):
+        self.objectives: Tuple[Objective, ...] = tuple(objectives)
+        self.window_s = float(window_s)
+        self.enabled = bool(enabled)
+        self._samples: Dict[str, collections.deque] = {}
+        self._violations: Dict[str, int] = {}
+        self._bound: Dict[str, obs_metrics.Counter] = {}
+        self._lock = threading.Lock()
+        self._max_samples = int(max_samples)
+        for o in self.objectives:
+            self._samples.setdefault(
+                o.metric, collections.deque(maxlen=self._max_samples))
+            self._violations[o.name] = 0
+
+    # -- feeding ------------------------------------------------------------
+
+    def observe(self, metric: str, value: float,
+                t: Optional[float] = None) -> None:
+        """Record one sample for `metric` (seconds).  One branch while
+        disabled; unknown metrics — no objective watches them — are
+        dropped in one dict probe."""
+        if not self.enabled:
+            return
+        ring = self._samples.get(metric)
+        if ring is None:
+            return
+        v = float(value)
+        if t is None:
+            t = time.monotonic()
+        bump: List[str] = []
+        with self._lock:
+            ring.append((t, v))
+            for o in self.objectives:
+                if o.metric == metric and v > o.threshold_s:
+                    self._violations[o.name] += 1
+                    bump.append(o.name)
+        for name in bump:       # registry counters have their own lock
+            c = self._bound.get(name)
+            if c is not None:
+                c.inc()
+
+    # -- reading ------------------------------------------------------------
+
+    def _window(self, metric: str, now: float) -> List[float]:
+        ring = self._samples.get(metric)
+        if not ring:
+            return []
+        cut = now - self.window_s
+        with self._lock:
+            return [v for (t, v) in ring if t >= cut]
+
+    def report(self, now: Optional[float] = None) -> dict:
+        """Per-objective verdicts over the rolling window:
+        {name: {metric, quantile, target_s, window_value_s, ok,
+        window_n, over_threshold_n, burn_rate, violations_total}}."""
+        if now is None:
+            now = time.monotonic()
+        out: dict = {"window_s": self.window_s, "objectives": {}}
+        for o in self.objectives:
+            vals = self._window(o.metric, now)
+            n = len(vals)
+            value = obs_metrics.percentile(vals, o.q) if n else 0.0
+            over = sum(1 for v in vals if v > o.threshold_s)
+            # no traffic is not an outage: empty window reports ok with
+            # zero burn instead of dividing by nothing
+            burn = (over / n) / o.budget if n else 0.0
+            out["objectives"][o.name] = {
+                "metric": o.metric,
+                "quantile": o.q,
+                "target_s": o.threshold_s,
+                "window_value_s": value,
+                "ok": (value <= o.threshold_s) if n else True,
+                "window_n": n,
+                "over_threshold_n": over,
+                "burn_rate": burn,
+                "violations_total": self._violations[o.name],
+            }
+        return out
+
+    def register(self, registry: obs_metrics.Registry) -> "SLOEngine":
+        """Expose every objective on a Prometheus registry.  Gauges read
+        lazily at render time (`Gauge.set_function`), so a scrape always
+        sees the current window without the engine pushing per step."""
+        registry.gauge("slo_window_seconds",
+                       "rolling window the SLO gauges evaluate over"
+                       ).set(self.window_s)
+        for o in self.objectives:
+            def _value(o=o):
+                vals = self._window(o.metric, time.monotonic())
+                return (obs_metrics.percentile(vals, o.q)
+                        if vals else 0.0)
+
+            def _burn(o=o):
+                vals = self._window(o.metric, time.monotonic())
+                if not vals:
+                    return 0.0
+                over = sum(1 for v in vals if v > o.threshold_s)
+                return (over / len(vals)) / o.budget
+
+            def _ok(o=o):
+                vals = self._window(o.metric, time.monotonic())
+                if not vals:
+                    return 1.0
+                return float(obs_metrics.percentile(vals, o.q)
+                             <= o.threshold_s)
+
+            registry.gauge(
+                f"slo_{o.name}_seconds",
+                f"rolling q={o.q:g} of {o.metric} (window "
+                f"{self.window_s:g}s)").set_function(_value)
+            registry.gauge(
+                f"slo_{o.name}_target_seconds",
+                f"objective: q={o.q:g} of {o.metric} stays under this"
+                ).set(o.threshold_s)
+            registry.gauge(
+                f"slo_{o.name}_burn_rate",
+                "windowed error fraction / error budget (1.0 = spending "
+                "the budget exactly at the allowed rate)"
+                ).set_function(_burn)
+            registry.gauge(
+                f"slo_{o.name}_ok",
+                "1 while the windowed quantile meets the objective"
+                ).set_function(_ok)
+            counter = registry.counter(
+                f"slo_{o.name}_violations_total",
+                f"samples of {o.metric} over the {o.threshold_s:g}s "
+                "objective threshold (cumulative)")
+            counter.set(self._violations[o.name])
+            # counters are push-model: observe() bumps the bound counter
+            # so /metrics tracks violations without a lazy read
+            self._bound[o.name] = counter
+        return self

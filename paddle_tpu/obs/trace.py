@@ -38,7 +38,11 @@ import time
 from typing import Dict, List, Optional, Union
 
 __all__ = ["SpanEvent", "Tracer", "get_tracer", "set_tracer", "load_trace",
-           "summarize", "format_summary"]
+           "summarize", "format_summary", "export_merged", "REQUEST_LANE"]
+
+# the synthetic per-process lane merged exports place request lifecycle
+# events on (one "requests" track per replica, below its thread lanes)
+REQUEST_LANE = 2 ** 31 - 1
 
 
 class SpanEvent:
@@ -211,28 +215,7 @@ class Tracer:
         viewer shows one lane per profiler step; un-stepped spans keep
         their real thread id.  Returns the path (when given) or the
         trace dict."""
-        pid = os.getpid()
-        events = []
-        lanes: Dict[int, str] = {}
-        for e in self.events():
-            if e.step is not None:
-                tid, lane = int(e.step), f"step {e.step}"
-            else:
-                tid, lane = int(e.tid % 2 ** 31), f"thread {e.tid}"
-            lanes.setdefault(tid, lane)
-            ev = {"name": e.name, "ph": e.ph, "cat": "host",
-                  "ts": e.t0 * 1e6, "pid": pid, "tid": tid}
-            if e.ph == "X":
-                ev["dur"] = (e.t1 - e.t0) * 1e6
-            else:
-                ev["s"] = "t"      # instant scope: thread
-            if e.attrs:
-                ev["args"] = dict(e.attrs)
-            events.append(ev)
-        for tid, lane in sorted(lanes.items()):
-            events.append({"name": "thread_name", "ph": "M", "pid": pid,
-                           "tid": tid, "args": {"name": lane}})
-        trace = {"traceEvents": events}
+        trace = {"traceEvents": _chrome_events(self.events(), os.getpid())}
         if extra:
             trace.update(extra)
         if path is None:
@@ -240,6 +223,128 @@ class Tracer:
         with open(path, "w") as f:
             json.dump(trace, f)
         return path
+
+
+def _chrome_events(span_events, pid: int) -> List[dict]:
+    """Chrome trace events (plus thread_name metadata) for one tracer's
+    SpanEvents under process `pid` — shared by the single-tracer export
+    and the merged fleet export."""
+    events: List[dict] = []
+    lanes: Dict[int, str] = {}
+    for e in span_events:
+        if e.step is not None:
+            tid, lane = int(e.step), f"step {e.step}"
+        else:
+            tid, lane = int(e.tid % 2 ** 31), f"thread {e.tid}"
+        lanes.setdefault(tid, lane)
+        ev = {"name": e.name, "ph": e.ph, "cat": "host",
+              "ts": e.t0 * 1e6, "pid": pid, "tid": tid}
+        if e.ph == "X":
+            ev["dur"] = (e.t1 - e.t0) * 1e6
+        else:
+            ev["s"] = "t"      # instant scope: thread
+        if e.attrs:
+            ev["args"] = dict(e.attrs)
+        events.append(ev)
+    for tid, lane in sorted(lanes.items()):
+        events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": lane}})
+    return events
+
+
+def export_merged(tracers, path: Optional[str] = None, requests=None,
+                  extra: Optional[dict] = None) -> Union[str, dict]:
+    """ONE Perfetto trace over a fleet: every replica's tracer becomes
+    its own process track (pid = registration order, process_name =
+    "replica <name>"), and — when a `RequestRegistry` (or its
+    `snapshot()` list) is given — each request's lifecycle events land
+    on the owning replica's "requests" lane with Perfetto FLOW events
+    (`ph` s/t/f sharing `id=request_id`) stitching the hops, so a
+    request retried from a dead replica to its successor renders as one
+    arrow across the two process tracks.
+
+    `tracers`: {name: Tracer} dict or (name, Tracer) iterable.  Names
+    must match the `replica` field request events carry (the router
+    stamps engines with `replica_name=str(rid)`); events whose replica
+    is unknown here (e.g. the router's own) go to a synthetic "router"
+    process track.  Returns the path (when given) or the trace dict."""
+    items = tracers.items() if hasattr(tracers, "items") else list(tracers)
+    events: List[dict] = []
+    pid_of: Dict[str, int] = {}
+    for name, tr in items:
+        pid = len(pid_of) + 1
+        pid_of[str(name)] = pid
+        events.append({"name": "process_name", "ph": "M", "pid": pid,
+                       "args": {"name": f"replica {name}"}})
+        events.extend(_chrome_events(tr.events(), pid))
+
+    if requests is not None:
+        timelines = (requests.snapshot(limit=None)
+                     if hasattr(requests, "snapshot") else list(requests))
+        router_pid = None
+        req_lanes = set()
+
+        def _pid_for(replica: Optional[str]) -> int:
+            nonlocal router_pid
+            if replica is not None and str(replica) in pid_of:
+                return pid_of[str(replica)]
+            if router_pid is None:
+                router_pid = len(pid_of) + 1
+                events.append({"name": "process_name", "ph": "M",
+                               "pid": router_pid,
+                               "args": {"name": "router"}})
+            return router_pid
+
+        for tl in timelines:
+            rid = tl["request_id"]
+            evs = tl["events"]
+            for i, ev in enumerate(evs):
+                pid = _pid_for(ev.get("replica"))
+                req_lanes.add(pid)
+                ts = ev["t"] * 1e6
+                args = {"req": rid, **ev.get("attrs", {})}
+                if ev.get("hop") is not None:
+                    args["hop"] = ev["hop"]
+                # request events are THIN SLICES (ph "X"), not bare
+                # instants: flow arrows only render when they bind to a
+                # duration slice at the same pid/tid/ts — an instants-
+                # only lane would silently drop every arrow in the
+                # viewer.  Duration = gap to the next event on this
+                # timeline, capped so a slice never paints over the
+                # request's whole residency.
+                if i + 1 < len(evs):
+                    dur = max(1.0, min((evs[i + 1]["t"] - ev["t"]) * 1e6,
+                                       1000.0))
+                else:
+                    dur = 1.0
+                events.append({"name": ev["name"], "ph": "X",
+                               "cat": "req", "ts": ts, "dur": dur,
+                               "pid": pid, "tid": REQUEST_LANE,
+                               "args": args})
+                # flow chain: start at the first event, step through the
+                # middle, finish at the last — Perfetto draws the arrows
+                # that make a cross-replica hop visible as one journey
+                last = i == len(evs) - 1
+                ph = "s" if i == 0 else ("f" if last else "t")
+                flow = {"name": "req", "ph": ph, "cat": "req", "id": rid,
+                        "ts": ts, "pid": pid, "tid": REQUEST_LANE}
+                if ph == "f":
+                    flow["bp"] = "e"
+                if len(evs) > 1:
+                    events.append(flow)
+        for pid in sorted(req_lanes):
+            events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                           "tid": REQUEST_LANE,
+                           "args": {"name": "requests"}})
+
+    trace = {"traceEvents": events}
+    if extra:
+        trace.update(extra)
+    if path is None:
+        return trace
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return path
 
 
 # the process-wide default tracer: the engine, the profiler, and the hapi

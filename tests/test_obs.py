@@ -209,6 +209,38 @@ class TestMetrics:
         with pytest.raises(ValueError):
             reg.gauge("x_total")
 
+    def test_label_values_escaped(self):
+        # Prometheus text format: backslash, double-quote, and newline
+        # in a label VALUE must be escaped or the whole exposition is
+        # rejected by scrapers
+        reg = obs.Registry()
+        reg.counter("c_total", "help",
+                    labels={"fn": 'a"b\\c\nd'}).inc(1)
+        text = reg.render()
+        assert r'c_total{fn="a\"b\\c\nd"} 1' in text
+        assert all("\n" not in line or line == ""   # no raw newline leaks
+                   for line in text.split("\n"))
+
+    def test_render_merged_escapes_odd_replica_labels(self):
+        # a fleet /metrics scrape labels every replica's samples with
+        # {replica="<name>"}; an odd replica name (quotes, backslashes,
+        # embedded newline) must still yield valid exposition lines
+        odd = 'rep"lica\\0\nx'
+        reg = obs.Registry()
+        reg.counter("llm_x_total", "count").inc(3)
+        reg.gauge("llm_depth", "gauge").set(2)
+        text = obs_metrics.render_merged({odd: reg}, label="replica")
+        assert 'llm_x_total{replica="rep\\"lica\\\\0\\nx"} 3' in text
+        # every sample line is one physical line with balanced quoting:
+        # label values match the escaped-value grammar, not raw dumps
+        esc_re = re.compile(
+            r'^[a-zA-Z_:][a-zA-Z0-9_:]*'
+            r'(\{[a-zA-Z0-9_]+="(?:[^"\\\n]|\\.)*"'
+            r'(,[a-zA-Z0-9_]+="(?:[^"\\\n]|\\.)*")*\})? \S+$')
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert esc_re.match(line), f"bad sample line: {line!r}"
+
     def test_histogram_raw_percentiles(self):
         h = obs_metrics.Histogram("h", buckets=(1e9,), sample_window=512)
         for v in range(1, 101):

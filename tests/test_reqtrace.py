@@ -1,0 +1,597 @@
+"""Fleet-wide request tracing, the flight recorder, and the SLO engine.
+
+The observability layer's three new pieces (PR 13), tier-1 and
+deterministic:
+
+  * per-request timelines (obs.reqtrace): bounded rings keyed by
+    request id, written concurrently from HTTP / step / health-tick
+    threads, threaded through `serve_llm` -> Router -> LLMEngine so a
+    retried request's cross-replica journey shares ONE ring;
+  * merged Perfetto export (obs.trace.export_merged): one process
+    track per replica + flow events stitching a request's hops — the
+    acceptance test kills a replica mid-request and asserts the hop
+    from the dead replica to its successor is visible in the trace;
+  * flight recorder (obs.flight): black-box dumps on step-thread
+    death, replica death, health ejection, invariant violation, and
+    SIGTERM — loadable, schema-checked, carrying the pre-crash engine
+    state digest;
+  * SLO engine (obs.slo): rolling-window percentile objectives + burn
+    rates on /metrics and /stats.
+
+Everything runs on ScriptedEngine (the real scheduler, scripted
+compute) so whole-fleet schedules stay tier-1 fast."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.inference import faults as F
+from paddle_tpu.inference.llm_engine import serve_llm
+from paddle_tpu.inference.router import Router, serve_fleet
+from paddle_tpu.inference.supervisor import EngineSupervisor
+
+
+def _mk_engine(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    return F.ScriptedEngine(**kw)
+
+
+def _ref(h):
+    return F.ScriptedEngine.reference_tokens(h.prompt, h.max_new_tokens,
+                                             h.eos_id)
+
+
+# ---------------------------------------------------------------------------
+# request registry
+# ---------------------------------------------------------------------------
+
+
+class TestRequestRegistry:
+    def test_event_timeline_roundtrip(self):
+        reg = obs.RequestRegistry()
+        reg.event("r1", "submit", replica="0", hop=0, queue_depth=2)
+        reg.event("r1", "decode", replica="0", hop=0)
+        reg.event("r2", "submit", replica="1", hop=0)
+        tl = reg.to_dict("r1")
+        assert [e["name"] for e in tl["events"]] == ["submit", "decode"]
+        assert tl["events"][0]["attrs"] == {"queue_depth": 2}
+        assert tl["replicas"] == ["0"]
+        assert tl["duration_s"] >= 0
+        assert reg.to_dict("unknown") is None
+        assert len(reg) == 2
+
+    def test_disabled_is_noop(self):
+        reg = obs.RequestRegistry(enabled=False)
+        reg.event("r1", "submit")
+        assert len(reg) == 0 and reg.to_dict("r1") is None
+        reg.enable()
+        reg.event("r1", "submit")
+        assert len(reg) == 1
+
+    def test_lru_bounds_requests(self):
+        reg = obs.RequestRegistry(max_requests=4)
+        for i in range(10):
+            reg.event(f"r{i}", "submit")
+        assert len(reg) == 4
+        assert reg.to_dict("r0") is None       # evicted
+        assert reg.to_dict("r9") is not None   # most recent survives
+        # touching an old id keeps it alive across later inserts
+        reg.event("r6", "decode")
+        reg.event("rX", "submit")
+        assert reg.to_dict("r6") is not None
+
+    def test_per_request_ring_bounds_events(self):
+        reg = obs.RequestRegistry(events_per_request=8)
+        for i in range(20):
+            reg.event("r1", f"e{i}")
+        tl = reg.to_dict("r1")
+        assert len(tl["events"]) == 8
+        assert tl["events"][-1]["name"] == "e19"
+        assert tl["dropped"] == 12
+
+    def test_snapshot_recent_window(self):
+        reg = obs.RequestRegistry()
+        for i in range(5):
+            reg.event(f"r{i}", "submit")
+        snap = reg.snapshot(limit=3)
+        assert [d["request_id"] for d in snap] == ["r2", "r3", "r4"]
+
+
+# ---------------------------------------------------------------------------
+# concurrent tracer + registry use (HTTP / step / health-tick threads)
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrentTracing:
+    N = 200
+
+    def test_spans_from_three_threads_roundtrip_uncorrupted(self, tmp_path):
+        """Spans emitted simultaneously from threads shaped like the
+        serving stack's (HTTP handler, engine step, health tick) must
+        round-trip through export without interleaving corruption:
+        every span lands exactly once, with ITS OWN attrs."""
+        tr = obs.Tracer(enabled=True, capacity=4 * self.N)
+        reg = obs.RequestRegistry()
+        barrier = threading.Barrier(3)
+
+        def worker(name):
+            barrier.wait()          # maximal overlap
+            for i in range(self.N):
+                with tr.span(f"{name}_span", owner=name, i=i):
+                    pass
+                reg.event(f"req-{name}", f"{name}_e{i}", replica=name)
+
+        threads = [threading.Thread(target=worker, args=(n,))
+                   for n in ("http", "step", "tick")]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        path = tr.export_chrome(str(tmp_path / "conc.json"))
+        events = [e for e in obs.load_trace(path) if e.get("ph") == "X"]
+        by_name = {}
+        for e in events:
+            by_name.setdefault(e["name"], []).append(e)
+        assert set(by_name) == {"http_span", "step_span", "tick_span"}
+        for name, evs in by_name.items():
+            owner = name[:-len("_span")]
+            assert len(evs) == self.N            # none lost, none doubled
+            # attrs stayed glued to their span (no cross-thread tearing)
+            assert all(e["args"]["owner"] == owner for e in evs)
+            assert sorted(e["args"]["i"] for e in evs) == list(
+                range(self.N))
+        # request rings: each thread's ring holds ITS events, in order
+        for name in ("http", "step", "tick"):
+            tl = reg.to_dict(f"req-{name}")
+            assert [e["name"] for e in tl["events"]] == \
+                [f"{name}_e{i}" for i in range(self.N)]
+            assert tl["replicas"] == [name]
+
+
+# ---------------------------------------------------------------------------
+# engine-level timelines + the /debug/request endpoint
+# ---------------------------------------------------------------------------
+
+
+class TestEngineRequestTimeline:
+    def test_lifecycle_events_in_order(self):
+        reg = obs.RequestRegistry()
+        eng = _mk_engine(reqtrace=reg, name="solo")
+        h = eng.submit([1, 2, 3], max_new_tokens=4)
+        F.drive(eng, [h])
+        assert h.result(timeout=0)
+        names = [e["name"] for e in reg.to_dict(h.req_id)["events"]]
+        assert names[0] == "submit"
+        assert "admit" in names and "prefill_chunk" in names
+        assert "prefill_done" in names and "decode" in names
+        assert names[-1] == "resolve"
+        # decode events: one per post-first token
+        assert names.count("decode") == 3
+        ev = reg.to_dict(h.req_id)["events"][-1]
+        assert ev["attrs"]["outcome"] == "completed"
+        assert ev["replica"] == "solo" and ev["hop"] == 0
+
+    def test_preempt_resume_events(self):
+        reg = obs.RequestRegistry()
+        # pool below the 2-slot worst case -> preemption under load
+        # (8 new tokens push each context into a third page; two slots
+        # need 6 pages against the 4 usable ones)
+        eng = _mk_engine(reqtrace=reg, num_pages=5)
+        hs = [eng.submit([i + 1, i + 2, i + 3], max_new_tokens=8)
+              for i in range(3)]
+        F.drive(eng, hs)
+        for h in hs:
+            assert h.result(timeout=0) == _ref(h)
+        all_names = [e["name"] for h in hs
+                     for e in reg.to_dict(h.req_id)["events"]]
+        assert "preempt" in all_names and "resume" in all_names
+
+    def test_custom_req_id_and_explicit_registry(self):
+        reg = obs.RequestRegistry()
+        eng = _mk_engine(reqtrace=reg)
+        h = eng.submit([1, 2], max_new_tokens=2, req_id="my-trace-id")
+        assert h.req_id == "my-trace-id"
+        F.drive(eng, [h])
+        assert reg.to_dict("my-trace-id") is not None
+
+    def test_serve_llm_debug_request_endpoint(self):
+        reg = obs.RequestRegistry()
+        eng = _mk_engine(reqtrace=reg)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            body = json.dumps({"prompt": [1, 2, 3], "max_new_tokens": 3,
+                               "request_id": "http-req-1"}).encode()
+            with urllib.request.urlopen(
+                    urllib.request.Request(url, data=body),
+                    timeout=60) as r:
+                out = json.loads(r.read())
+            assert out["tokens"] and out["request_id"] == "http-req-1"
+            with urllib.request.urlopen(
+                    url + "debug/request/http-req-1", timeout=30) as r:
+                assert r.headers["Content-Type"] == "application/json"
+                tl = json.loads(r.read())
+            names = [e["name"] for e in tl["events"]]
+            assert names[0] == "submit" and names[-1] == "resolve"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "debug/request/nope",
+                                       timeout=30)
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance criterion: replica death mid-request -> merged trace
+# showing the hop + loadable flight dump with the pre-crash digest
+# ---------------------------------------------------------------------------
+
+
+class TestFleetDeathTraceAndFlight:
+    def test_death_mid_request_merged_trace_and_flight_dump(self, tmp_path):
+        reg = obs.RequestRegistry()
+        flight_dir = str(tmp_path / "flight")
+        tracers = {}
+
+        def mk(i):
+            tr = obs.Tracer(enabled=True)
+            tracers[str(i)] = tr
+            eng = _mk_engine(tracer=tr, reqtrace=reg)
+            obs.FlightRecorder(dir=flight_dir, name=f"r{i}"
+                               ).attach_engine(eng)
+            return eng
+
+        engines = [mk(0), mk(1)]
+        # replica 0 dies at its FIRST ragged dispatch: the request is
+        # admitted (slot occupied, zero tokens) when the crash lands —
+        # retryable, and the pre-crash digest must show the occupancy
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("decode", nth=1, crash=True)])
+        router = Router(
+            engines,
+            supervisor=EngineSupervisor(lambda: _mk_engine(reqtrace=reg)),
+            threaded=False, reqtrace=reg)
+        h = router.submit([1, 2, 3], 4)
+        F.drive_fleet(router, [h])
+        assert h.result(timeout=0) == _ref(h)
+        assert h.hops == [0, 1]                 # died on 0, finished on 1
+
+        # (a) ONE merged Perfetto trace shows the hop: both replica
+        # process tracks, request events on each, and a flow chain
+        # (ph s/.../f sharing id=req_id) crossing the two pids
+        path = obs.export_merged(tracers, str(tmp_path / "merged.json"),
+                                 requests=reg)
+        events = obs.load_trace(path)
+        procs = {e["pid"]: e["args"]["name"] for e in events
+                 if e.get("ph") == "M" and e["name"] == "process_name"}
+        assert {"replica 0", "replica 1"} <= set(procs.values())
+        req_evs = [e for e in events
+                   if e.get("cat") == "req" and e.get("ph") == "X"
+                   and e["args"].get("req") == h.req_id]
+        # lifecycle events are SLICES so flow arrows can bind to them
+        assert all(e.get("dur", 0) > 0 for e in req_evs)
+        pids = {procs[e["pid"]] for e in req_evs}
+        assert {"replica 0", "replica 1"} <= pids
+        flow = [e for e in events
+                if e.get("cat") == "req" and e.get("ph") in "stf"
+                and e.get("id") == h.req_id]
+        assert any(e["ph"] == "s" for e in flow)
+        assert any(e["ph"] == "f" for e in flow)
+        assert len({e["pid"] for e in flow}) >= 2   # the hop is stitched
+        # the registry's own view of the journey agrees
+        tl = reg.to_dict(h.req_id)
+        assert "0" in tl["replicas"] and "1" in tl["replicas"]
+        hop_of = {e["replica"]: e["hop"] for e in tl["events"]
+                  if e["replica"] in ("0", "1")}
+        assert hop_of == {"0": 0, "1": 1}
+
+        # (b) the dead replica left a loadable flight dump carrying the
+        # last pre-crash engine state digest (the slot that held the
+        # request, zero tokens resolved)
+        dumps = sorted(os.listdir(flight_dir))
+        death = [d for d in dumps if "replica_death" in d
+                 and d.startswith("flight_r0_")]
+        assert death, dumps
+        data = obs.load_dump(os.path.join(flight_dir, death[0]))
+        assert data["reason"] == "replica_death"
+        digest = data["engine"]
+        assert digest is not None and digest["replica"] == "0"
+        held = {s["req_id"]: s for s in digest["slots"].values()}
+        assert h.req_id in held                # pre-crash occupancy
+        assert held[h.req_id]["tokens"] == 0   # died before any token
+        assert digest["counters"]["accepted"] >= 1
+        # and the dump's span section saw the engine at work
+        assert any(s["name"] == "engine_step" for s in data["spans"])
+        router.shutdown()
+
+    def test_serve_fleet_debug_request_and_request_id(self):
+        reg = obs.RequestRegistry()
+        engines = [_mk_engine(reqtrace=reg) for _ in range(2)]
+        router = Router(engines, threaded=True, health_interval=0.01,
+                        reqtrace=reg)
+        srv, _ = serve_fleet(router)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            body = json.dumps({"prompt": [5, 6, 7], "max_new_tokens": 2,
+                               "request_id": "fleet-req-9"}).encode()
+            with urllib.request.urlopen(
+                    urllib.request.Request(url, data=body),
+                    timeout=60) as r:
+                out = json.loads(r.read())
+            assert out["request_id"] == "fleet-req-9" and out["tokens"]
+            with urllib.request.urlopen(
+                    url + "debug/request/fleet-req-9", timeout=30) as r:
+                tl = json.loads(r.read())
+            names = [e["name"] for e in tl["events"]]
+            assert names[0] == "fleet_submit"
+            assert names[-1] == "fleet_resolve"
+            assert "router" in tl["replicas"]
+            assert any(rep in tl["replicas"] for rep in ("0", "1"))
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(url + "debug/request/ghost",
+                                       timeout=30)
+            assert ei.value.code == 404
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+
+
+class TestFlightRecorder:
+    def test_dump_load_roundtrip_and_schema_guard(self, tmp_path):
+        tr = obs.Tracer(enabled=True)
+        with tr.span("work"):
+            pass
+        reg = obs.Registry()
+        reg.counter("c_total", "help").inc(2)
+        rr = obs.RequestRegistry()
+        rr.event("r1", "submit")
+        fr = obs.FlightRecorder(dir=str(tmp_path), name="unit")
+        fr.attach(tracer=tr, registry=reg, reqtrace=rr,
+                  state_fn=lambda: {"pending": 3})
+        path = fr.dump("unit_test", error=RuntimeError("boom"))
+        data = obs.load_dump(path)
+        assert data["reason"] == "unit_test"
+        assert "boom" in data["error"]
+        assert data["engine"] == {"pending": 3}
+        assert any(s["name"] == "work" for s in data["spans"])
+        assert "c_total 2" in data["metrics"]
+        assert data["requests"][0]["request_id"] == "r1"
+        # foreign/truncated files fail loudly
+        bad = tmp_path / "not_a_dump.json"
+        bad.write_text('{"schema": "something/else"}')
+        with pytest.raises(ValueError, match="not a flight dump"):
+            obs.load_dump(str(bad))
+
+    def test_in_memory_mode_keeps_last(self):
+        fr = obs.FlightRecorder(name="mem")
+        fr.attach(state_fn=lambda: {"x": 1})
+        assert fr.dump("reason_a") is None      # nothing written
+        assert fr.last["reason"] == "reason_a"
+        assert fr.last["engine"] == {"x": 1}
+
+    def test_step_thread_death_dumps(self, tmp_path):
+        """The dying step thread itself drops the black box (threaded
+        engines; pump-mode deaths dump via the router instead)."""
+        eng = _mk_engine(faults=F.FaultInjector(
+            [F.FaultRule("step", nth=2, crash=True)]))
+        fr = obs.FlightRecorder(dir=str(tmp_path), name="dying"
+                                ).attach_engine(eng)
+        eng.start()
+        eng.submit([1, 2, 3], max_new_tokens=4)
+        eng._thread.join(timeout=30)    # the crash kills the loop
+        assert not eng.alive()
+        assert fr.dumps and "step_thread_death" in fr.dumps[0]
+        data = obs.load_dump(fr.dumps[0])
+        assert data["reason"] == "step_thread_death"
+        assert "InjectedCrash" in data["error"]
+        assert data["engine"]["replica"] == "engine"
+        eng.shutdown()              # resolve the strands
+
+    def test_invariant_violation_dumps(self, tmp_path):
+        eng = _mk_engine()
+        fr = obs.FlightRecorder(dir=str(tmp_path), name="leaky"
+                                ).attach_engine(eng)
+        h = eng.submit([1, 2, 3], max_new_tokens=2)
+        F.drive(eng, [h])
+        assert F.check_invariants(eng, [h], probe=False)["ok"]
+        assert not fr.dumps                     # clean run: no dump
+        eng.stats["completed"] += 1             # seed a counter drift
+        with pytest.raises(F.InvariantViolation):
+            F.check_invariants(eng, [h], probe=False)
+        assert fr.dumps
+        data = obs.load_dump(fr.dumps[-1])
+        assert data["reason"] == "invariant_violation"
+        assert "metrics identity" in data["error"]
+
+    def test_health_ejection_dumps(self, tmp_path):
+        eng0, eng1 = _mk_engine(), _mk_engine()
+        fr = obs.FlightRecorder(dir=str(tmp_path), name="flappy"
+                                ).attach_engine(eng0)
+        router = Router(
+            [eng0, eng1], threaded=False,
+            faults=F.FaultInjector(
+                [F.FaultRule("health_flap", replica=0, nth=1)]))
+        router.pump()               # the flap ejects replica 0
+        assert router.replicas[0].state != "healthy"
+        assert fr.dumps and "health_ejection" in fr.dumps[0]
+        assert obs.load_dump(fr.dumps[0])["reason"] == "health_ejection"
+        router.shutdown()
+
+    def test_sigterm_handler_dumps(self):
+        fr = obs.FlightRecorder(name="term")
+        fr.attach(state_fn=lambda: {"armed": True})
+        handler = obs.flight.install_sigterm([fr], chain=False)
+        handler(15, None)           # invoke directly: no process games
+        assert fr.last["reason"] == "sigterm"
+
+
+# ---------------------------------------------------------------------------
+# SLO engine
+# ---------------------------------------------------------------------------
+
+
+class TestSLO:
+    def test_objective_math_and_burn_rate(self):
+        slo = obs.SLOEngine(
+            objectives=[obs.Objective("ttft", 0.9, 1.0)], window_s=60.0)
+        for v in [0.1] * 18 + [5.0] * 2:        # 10% over threshold
+            slo.observe("ttft", v)
+        rep = slo.report()["objectives"]["ttft_p90"]
+        assert rep["window_n"] == 20
+        assert rep["over_threshold_n"] == 2
+        # 10% error rate / 10% budget = burn 1.0 (on the edge)
+        assert rep["burn_rate"] == pytest.approx(1.0)
+        assert rep["violations_total"] == 2
+        assert rep["target_s"] == 1.0
+
+    def test_empty_window_is_ok_not_outage(self):
+        slo = obs.SLOEngine()
+        rep = slo.report()["objectives"]["ttft_p95"]
+        assert rep["ok"] is True and rep["burn_rate"] == 0.0
+        assert rep["window_n"] == 0
+
+    def test_window_expires_old_samples(self):
+        slo = obs.SLOEngine(
+            objectives=[obs.Objective("ttft", 0.5, 1.0)], window_s=10.0)
+        import time as _t
+
+        now = _t.monotonic()
+        slo.observe("ttft", 9.0, t=now - 60.0)  # outside the window
+        slo.observe("ttft", 0.2, t=now)
+        rep = slo.report(now=now)["objectives"]["ttft_p50"]
+        assert rep["window_n"] == 1
+        assert rep["window_value_s"] == pytest.approx(0.2)
+        assert rep["ok"] is True
+        # the cumulative violation counter still remembers the old one
+        assert rep["violations_total"] == 1
+
+    def test_unknown_metric_dropped(self):
+        slo = obs.SLOEngine()
+        slo.observe("nonsense", 99.0)           # no objective watches it
+        assert all(o["window_n"] == 0
+                   for o in slo.report()["objectives"].values())
+
+    def test_engine_surfaces_slo_on_metrics_and_stats(self):
+        eng = _mk_engine()
+        h = eng.submit([1, 2, 3], max_new_tokens=4)
+        F.drive(eng, [h])
+        assert h.result(timeout=0)
+        snap = eng.stats_snapshot()
+        objs = snap["slo"]["objectives"]
+        assert objs["ttft_p95"]["window_n"] == 1
+        assert objs["inter_token_p95"]["window_n"] == 3
+        assert objs["queue_wait_p95"]["window_n"] == 1
+        assert all(o["ok"] for o in objs.values())  # scripted = fast
+        text = eng.metrics.render()
+        assert "# TYPE slo_ttft_p95_seconds gauge" in text
+        assert "slo_ttft_p95_burn_rate 0" in text
+        assert "slo_ttft_p95_target_seconds 2" in text
+        assert "slo_inter_token_p95_violations_total 0" in text
+
+    def test_violations_counter_reaches_registry(self):
+        eng = _mk_engine(slo_objectives=[
+            obs.Objective("ttft", 0.95, 1e-9)])  # impossible objective
+        h = eng.submit([1, 2, 3], max_new_tokens=2)
+        F.drive(eng, [h])
+        c = eng.metrics.get("slo_ttft_p95_violations_total")
+        assert c is not None and c.value >= 1
+        rep = eng.slo.report()["objectives"]["ttft_p95"]
+        assert rep["ok"] is False and rep["burn_rate"] > 1.0
+
+
+# ---------------------------------------------------------------------------
+# trace_summary CLI over merged / multiple traces
+# ---------------------------------------------------------------------------
+
+
+def _load_tool(name):
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestTraceSummaryFleet:
+    @pytest.fixture()
+    def merged(self, tmp_path):
+        reg = obs.RequestRegistry()
+        tracers = {}
+
+        def mk(i):
+            tr = obs.Tracer(enabled=True)
+            tracers[str(i)] = tr
+            return _mk_engine(tracer=tr, reqtrace=reg)
+
+        engines = [mk(0), mk(1)]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("decode", nth=1, crash=True)])
+        router = Router(
+            engines,
+            supervisor=EngineSupervisor(lambda: _mk_engine(reqtrace=reg)),
+            threaded=False, reqtrace=reg)
+        h = router.submit([1, 2, 3], 3, req_id="survivor")
+        F.drive_fleet(router, [h])
+        assert h.hops == [0, 1]
+        path = str(tmp_path / "merged.json")
+        obs.export_merged(tracers, path, requests=reg)
+        router.shutdown()
+        return path, tracers
+
+    def test_by_replica_tables(self, merged, capsys):
+        path, _ = merged
+        tool = _load_tool("trace_summary")
+        assert tool.main([path, "--by-replica"]) == 0
+        out = capsys.readouterr().out
+        assert "== replica 0 ==" in out and "== replica 1 ==" in out
+        assert tool.main([path, "--by-replica", "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert "replica 0" in d and "replica 1" in d
+        assert "engine_step" in d["replica 1"]
+
+    def test_requests_breakdown_and_single_request(self, merged, capsys):
+        path, _ = merged
+        tool = _load_tool("trace_summary")
+        assert tool.main([path, "--requests", "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert "survivor" in d
+        assert d["survivor"]["hops"] == 2
+        assert "replica 0" in d["survivor"]["replicas"]
+        assert "replica 1" in d["survivor"]["replicas"]
+        assert tool.main([path, "--request", "survivor"]) == 0
+        out = capsys.readouterr().out
+        assert "2 hop(s)" in out and "fleet_submit" in out
+        assert tool.main([path, "--request", "ghost"]) == 1
+
+    def test_multiple_single_replica_files_merge(self, tmp_path, capsys):
+        paths = []
+        for name in ("alpha", "beta"):
+            tr = obs.Tracer(enabled=True)
+            tr.record(f"{name}_work", 0.0, 0.25)
+            p = str(tmp_path / f"{name}.json")
+            tr.export_chrome(p)
+            paths.append(p)
+        tool = _load_tool("trace_summary")
+        assert tool.main(paths) == 0               # merged aggregate
+        out = capsys.readouterr().out
+        assert "alpha_work" in out and "beta_work" in out
+        assert tool.main(paths + ["--by-replica", "--json"]) == 0
+        d = json.loads(capsys.readouterr().out)
+        assert "alpha" in d and "beta" in d        # file basename = track
+        assert "alpha_work" in d["alpha"]
+        assert "beta_work" not in d["alpha"]

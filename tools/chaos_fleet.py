@@ -14,6 +14,9 @@ Usage:
     python tools/chaos_fleet.py                    # 25 schedules, seed 0
     python tools/chaos_fleet.py --schedules 200 --replicas 3
     python tools/chaos_fleet.py --threaded         # background-thread mode
+    python tools/chaos_fleet.py --flight-dir /tmp/flight  # black-box armed:
+                                                   # every replica death must
+                                                   # leave a loadable dump
     python tools/chaos_fleet.py --bench --json     # router micro-bench
                                                    # (bench.py extra.router)
 
@@ -117,6 +120,10 @@ def main():
                     help="prefill_chunk_tokens for every replica engine "
                          "(small default -> multi-chunk prefills, so "
                          "replica death mid-chunk is actually exercised)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm a flight recorder on every replica: a "
+                         "replica death MUST leave a loadable dump here "
+                         "or the soak fails (SIGTERM dumps too)")
     ap.add_argument("--json", action="store_true")
     args = ap.parse_args()
 
@@ -125,14 +132,38 @@ def main():
         print(json.dumps(out, indent=None if args.json else 2))
         return 0
 
+    import glob
+    import itertools
+
     import numpy as np
 
     from paddle_tpu.inference import faults as F
 
+    recorders = []
+    rec_seq = itertools.count()
+    if args.flight_dir:
+        from paddle_tpu.obs import flight as obs_flight
+
+        obs_flight.install_sigterm(recorders)
+
+    def _dumps():
+        if not args.flight_dir:
+            return []
+        return sorted(glob.glob(os.path.join(args.flight_dir,
+                                             "flight_*.json")))
+
     def mk():
-        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
-                                prefill_chunk_tokens=args.prefill_chunk,
-                                block_q=2)
+        eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
+                               prefill_chunk_tokens=args.prefill_chunk,
+                               block_q=2)
+        if args.flight_dir:
+            from paddle_tpu.obs import flight as obs_flight
+
+            rec = obs_flight.FlightRecorder(
+                dir=args.flight_dir, name=f"e{next(rec_seq)}")
+            rec.attach_engine(eng)
+            recorders.append(rec)
+        return eng
 
     def ref(h):
         return F.ScriptedEngine.reference_tokens(
@@ -150,6 +181,7 @@ def main():
                                   int(rng.integers(2, 9))).tolist(),
                      int(rng.integers(2, 7)))
                     for _ in range(args.requests)]
+        dumps_before = set(_dumps())
         try:
             report = F.fleet_run_schedule(
                 mk, engine_rules, router_rules, workload,
@@ -163,6 +195,34 @@ def main():
                                       for r, rules in engine_rules.items()},
                           "router": [x.to_dict() for x in router_rules]}}
         report["seed"] = seed
+        # the black-box contract: every induced replica death leaves at
+        # least one NEW, LOADABLE crash dump (step_thread_death from the
+        # dying thread, or replica_death from the router's death tick)
+        if args.flight_dir and report.get("ok") \
+                and report["stats"]["deaths"] > 0:
+            from paddle_tpu.obs import flight as obs_flight
+
+            new = sorted(set(_dumps()) - dumps_before)
+            crash = []
+            for p in new:
+                try:
+                    d = obs_flight.load_dump(p)
+                except Exception as e:  # noqa: BLE001 — unloadable dump
+                    violations += 1
+                    report["ok"] = False
+                    report["violations"] = f"unloadable flight dump " \
+                                           f"{p}: {e!r}"
+                    break
+                if d["reason"] in ("step_thread_death", "replica_death"):
+                    crash.append(p)
+            else:
+                if not crash:
+                    violations += 1
+                    report["ok"] = False
+                    report["violations"] = (
+                        f"{report['stats']['deaths']} replica death(s) "
+                        "left no loadable crash dump")
+                report["flight_dumps"] = len(new)
         reports.append(report)
         if report["ok"]:
             for k in ("completed", "failed", "retried"):

@@ -11,6 +11,7 @@ serving.
 Usage:
     python tools/chaos_llm.py                      # 25 schedules, seed 0
     python tools/chaos_llm.py --schedules 200 --seed 7 --mode recompute
+    python tools/chaos_llm.py --flight-dir /tmp/flight   # black-box armed
     python tools/chaos_llm.py --json               # machine-readable report
 
 Exit code 1 when any schedule violates an invariant.  CPU-only (the
@@ -24,6 +25,13 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _flight_dumps(flight_dir):
+    import glob
+    if not flight_dir:
+        return []
+    return sorted(glob.glob(os.path.join(flight_dir, "flight_*.json")))
 
 
 def main():
@@ -53,6 +61,11 @@ def main():
     ap.add_argument("--probe-every", type=int, default=5,
                     help="run the fresh-request serving probe every Nth "
                          "schedule (1 = always; probes dominate runtime)")
+    ap.add_argument("--flight-dir", default=None, metavar="DIR",
+                    help="arm a flight recorder on every engine: dumps "
+                         "land here on invariant violations and SIGTERM, "
+                         "and the soak FAILS if any dump is unloadable "
+                         "or a violation produced none")
     ap.add_argument("--json", action="store_true",
                     help="print the full per-schedule reports as JSON")
     args = ap.parse_args()
@@ -70,12 +83,29 @@ def main():
 
     drafter = F.EchoDrafter() if args.spec_k else None
 
-    def make_engine(mode):
-        return lambda: LLMEngine(
-            params, cfg, num_slots=args.slots, page_size=4, max_seq_len=16,
-            num_pages=args.num_pages, preempt_mode=mode,
-            prefill_chunk_tokens=args.prefill_chunk, block_q=2,
-            spec_k=args.spec_k, drafter=drafter)
+    recorders = []
+    if args.flight_dir:
+        from paddle_tpu.obs import flight as obs_flight
+
+        obs_flight.install_sigterm(recorders)
+
+    def make_engine(mode, tag):
+        def make():
+            eng = LLMEngine(
+                params, cfg, num_slots=args.slots, page_size=4,
+                max_seq_len=16, num_pages=args.num_pages,
+                preempt_mode=mode,
+                prefill_chunk_tokens=args.prefill_chunk, block_q=2,
+                spec_k=args.spec_k, drafter=drafter)
+            if args.flight_dir:
+                from paddle_tpu.obs import flight as obs_flight
+
+                rec = obs_flight.FlightRecorder(
+                    dir=args.flight_dir, name=tag)
+                rec.attach_engine(eng)
+                recorders.append(rec)
+            return eng
+        return make
 
     reports, violations = [], 0
     totals = {"fired": 0, "completed": 0, "failed": 0, "preemptions": 0,
@@ -90,13 +120,22 @@ def main():
                                   int(rng.integers(2, 9))).tolist(),
                      int(rng.integers(2, 7)))
                     for _ in range(args.requests)]
+        dumps_before = len(_flight_dumps(args.flight_dir))
         try:
-            report = F.run_schedule(make_engine(mode), rules, workload,
+            report = F.run_schedule(make_engine(mode, f"s{seed}"), rules,
+                                    workload,
                                     probe=i % args.probe_every == 0)
         except F.InvariantViolation as e:
             violations += 1
             report = {"ok": False, "violations": str(e),
                       "schedule": [r.to_dict() for r in rules]}
+            # an invariant violation must leave a loadable black box —
+            # that is what the flight recorder is FOR
+            if args.flight_dir and \
+                    len(_flight_dumps(args.flight_dir)) <= dumps_before:
+                report["flight_missing"] = True
+                print(f"[FLIGHT] seed={seed}: violation produced no "
+                      "flight dump")
         report["seed"] = seed
         report["mode"] = mode
         reports.append(report)
@@ -117,6 +156,23 @@ def main():
         else:
             line += f" violations={report['violations']}"
         print(line)
+
+    flight_bad = 0
+    if args.flight_dir:
+        from paddle_tpu.obs import flight as obs_flight
+
+        paths = _flight_dumps(args.flight_dir)
+        for p in paths:
+            try:
+                obs_flight.load_dump(p)
+            except Exception as e:  # noqa: BLE001 — unloadable dump
+                flight_bad += 1
+                print(f"[FLIGHT] unloadable dump {p}: {e!r}")
+        flight_missing = sum(1 for r in reports
+                             if r.get("flight_missing"))
+        violations += flight_bad + flight_missing
+        print(f"flight recorder: {len(paths)} dump(s), "
+              f"{flight_bad} unloadable, {flight_missing} missing")
 
     summary = {"schedules": args.schedules, "violations": violations,
                **totals}

@@ -1,18 +1,26 @@
 #!/usr/bin/env python
-"""trace_summary — per-span time/percentile table from an exported trace.
+"""trace_summary — span/replica/request breakdowns of exported traces.
 
-Consumes the Chrome/Perfetto JSON the obs tracer writes (engine spans via
-`LLMEngine(tracer=...)`, training spans via the hapi ObsCallback /
-`examples/train_llama.py --trace`, profiler spans via
-`profiler.export_chrome_tracing`) and prints count / total / mean / p50 /
-p90 / p99 / max per span name, heaviest total first.
+Consumes the Chrome/Perfetto JSON the obs tracer writes — a single
+export (`Tracer.export_chrome`), SEVERAL of them (one per replica), or
+one merged fleet trace (`obs.trace.export_merged`, which carries a
+process track per replica plus request flow events) — and prints:
+
+  * the per-span time/percentile table (count / total / mean / p50 /
+    p90 / p99 / max, heaviest total first) — the default;
+  * `--by-replica`: one table per replica (process tracks in a merged
+    trace; one file = one replica when several files are given);
+  * `--requests`: the per-request breakdown from the request lifecycle
+    events a merged export embeds (id, hop count, replicas visited,
+    event count, wall duration);
+  * `--request ID`: one request's full timeline, event by event.
 
 Usage:
-  python tools/trace_summary.py TRACE.json [--unit ms|us|s] [--json]
-          [--top N]
+  python tools/trace_summary.py TRACE.json [MORE.json ...]
+          [--unit ms|us|s] [--json] [--top N]
+          [--by-replica] [--requests] [--request ID]
 
---json emits the aggregate as one machine-readable object instead of the
-table (same shape as paddle_tpu.obs.summarize)."""
+--json emits the chosen aggregate as one machine-readable object."""
 
 from __future__ import annotations
 
@@ -24,21 +32,164 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _load_many(paths):
+    """Load several traces into one event list.  Each file's pids are
+    namespaced (pid -> (file_index, pid)) so two single-replica exports
+    from the same process never collide; process_name metadata (merged
+    traces) or the file basename names each track."""
+    from paddle_tpu.obs import trace as obs_trace
+
+    events = []
+    names = {}           # (file_idx, pid) -> replica/track name
+    for fi, path in enumerate(paths):
+        default = os.path.splitext(os.path.basename(path))[0]
+        for e in obs_trace.load_trace(path):
+            key = (fi, e.get("pid", 0))
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                names[key] = e["args"]["name"]
+                continue
+            names.setdefault(key, default)
+            ev = dict(e)
+            ev["_track"] = key
+            events.append(ev)
+    return events, names
+
+
+def _requests_index(events, names):
+    """Per-request breakdown from the lifecycle events a merged export
+    embeds (cat="req" instants carrying args.req)."""
+    reqs = {}
+    for e in events:
+        if e.get("cat") != "req" or e.get("ph") != "X":
+            continue
+        rid = (e.get("args") or {}).get("req")
+        if rid is None:
+            continue
+        r = reqs.setdefault(rid, {"events": []})
+        r["events"].append(e)
+    out = {}
+    for rid, r in reqs.items():
+        evs = sorted(r["events"], key=lambda e: e["ts"])
+        replicas = []
+        hops = set()
+        for e in evs:
+            name = names.get(e["_track"], str(e.get("pid")))
+            if name not in replicas:
+                replicas.append(name)
+            hop = (e.get("args") or {}).get("hop")
+            if hop is not None:
+                hops.add(int(hop))
+        out[rid] = {
+            "events": len(evs),
+            "replicas": replicas,
+            "hops": len(hops) if hops else 1,
+            "first": evs[0]["name"],
+            "last": evs[-1]["name"],
+            "duration_s": (evs[-1]["ts"] - evs[0]["ts"]) * 1e-6,
+            "timeline": [{"t_s": e["ts"] * 1e-6, "name": e["name"],
+                          "track": names.get(e["_track"],
+                                             str(e.get("pid"))),
+                          "args": {k: v for k, v in
+                                   (e.get("args") or {}).items()
+                                   if k != "req"}}
+                         for e in evs],
+        }
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
-        description="per-span summary table of an exported chrome trace")
-    ap.add_argument("trace", help="trace JSON written by "
-                    "Tracer.export_chrome / export_chrome_tracing")
+        description="span/replica/request summary of exported traces")
+    ap.add_argument("traces", nargs="+", metavar="TRACE",
+                    help="trace JSON written by Tracer.export_chrome / "
+                         "export_merged / export_chrome_tracing; several "
+                         "files merge (one replica per file)")
     ap.add_argument("--unit", default="ms", choices=["s", "ms", "us"])
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON object instead of the table")
     ap.add_argument("--top", type=int, default=None, metavar="N",
                     help="only the N heaviest span names by total time")
+    ap.add_argument("--by-replica", action="store_true",
+                    help="one span table per replica/process track")
+    ap.add_argument("--requests", action="store_true", dest="by_request",
+                    help="per-request breakdown (merged fleet traces)")
+    ap.add_argument("--request", default=None, metavar="ID",
+                    help="print one request's full timeline")
     args = ap.parse_args(argv)
 
     from paddle_tpu.obs import trace as obs_trace
 
-    summary = obs_trace.summarize(args.trace)
+    events, names = _load_many(args.traces)
+
+    if args.request is not None or args.by_request:
+        reqs = _requests_index(events, names)
+        if args.request is not None:
+            r = reqs.get(args.request)
+            if r is None:
+                print(f"no request {args.request!r} in "
+                      f"{', '.join(args.traces)} (known: "
+                      f"{sorted(reqs) if reqs else 'none'})")
+                return 1
+            if args.as_json:
+                print(json.dumps({args.request: r}, sort_keys=True))
+                return 0
+            print(f"request {args.request}: {r['events']} events, "
+                  f"{r['hops']} hop(s), replicas "
+                  f"{' -> '.join(r['replicas'])}, "
+                  f"{r['duration_s'] * 1e3:.3f} ms")
+            t0 = r["timeline"][0]["t_s"]
+            for e in r["timeline"]:
+                extra = (" " + json.dumps(e["args"], sort_keys=True)
+                         if e["args"] else "")
+                print(f"  +{(e['t_s'] - t0) * 1e3:10.3f} ms  "
+                      f"[{e['track']:>12}] {e['name']}{extra}")
+            return 0
+        if args.as_json:
+            slim = {rid: {k: v for k, v in r.items() if k != "timeline"}
+                    for rid, r in reqs.items()}
+            print(json.dumps(slim, sort_keys=True))
+            return 0
+        if not reqs:
+            print("no request events in trace (export_merged with a "
+                  "RequestRegistry embeds them)")
+            return 0
+        print(f"{'request':18}  {'hops':>4}  {'events':>6}  "
+              f"{'dur(ms)':>10}  journey")
+        for rid, r in sorted(reqs.items(),
+                             key=lambda kv: -kv[1]["duration_s"]):
+            print(f"{rid[:18]:18}  {r['hops']:>4}  {r['events']:>6}  "
+                  f"{r['duration_s'] * 1e3:>10.3f}  "
+                  f"{' -> '.join(r['replicas'])}")
+        return 0
+
+    span_events = [e for e in events if e.get("cat") != "req"]
+    if args.by_replica:
+        groups = {}
+        for e in span_events:
+            groups.setdefault(names.get(e["_track"],
+                                        str(e.get("pid"))), []).append(e)
+        out = {}
+        for name in sorted(groups):
+            summary = obs_trace.summarize(groups[name])
+            if args.top is not None:
+                keep = sorted(summary,
+                              key=lambda k: -summary[k]["total_s"])
+                summary = {k: summary[k] for k in keep[: args.top]}
+            out[name] = summary
+        if args.as_json:
+            print(json.dumps(out, sort_keys=True))
+            return 0
+        for name, summary in out.items():
+            print(f"== {name} ==")
+            if summary:
+                print(obs_trace.format_summary(summary,
+                                               time_unit=args.unit))
+            else:
+                print("(no complete spans)")
+            print()
+        return 0
+
+    summary = obs_trace.summarize(span_events)
     if args.top is not None:
         keep = sorted(summary, key=lambda k: -summary[k]["total_s"])
         summary = {k: summary[k] for k in keep[: args.top]}
