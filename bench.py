@@ -576,14 +576,18 @@ def bench_obs_overhead(dev, on_tpu):
     """extra.obs_overhead: what leaving the FULL observability layer on
     costs the decode hot path — span tracer enabled, per-request
     timeline registry enabled (one event per token per request), SLO
-    engine observing — vs everything disabled, same engine, same
-    workload.  Reported as the p50 inter-token latency ratio over
-    paired alternating trials (median of per-trial p50s, so one noisy
-    trial cannot fake a regression either way).  The acceptance pin is
-    < 2%: below that, request tracing is safe to leave on in soak runs
-    and production fleets, which is what makes `GET /debug/request/<id>`
-    and the flight recorder always-available rather than
-    opt-in-when-debugging."""
+    engine observing, step-phase profiler recording, pool-telemetry
+    counter tracks sampling, anomaly watchdog armed — vs everything
+    disabled, same engine, same workload.  Reported as the p50
+    inter-token latency ratio over paired alternating trials (median of
+    the PAIRED per-trial ratios, so one noisy trial — or load drift
+    across the bench — cannot fake a regression either way).  The
+    acceptance pin is < 2%: below that, the whole
+    attribution layer is safe to leave on in soak runs and production
+    fleets.  Also reports the traced leg's phase-share table and the
+    ragged dispatch's PER-PHASE cost_model_ratio keyed by shape class
+    (obs.stepprof.cost_join — the number the kernel autotuner reads;
+    None on CPU, where no peak FLOP/s is defined)."""
     import statistics
     import time as _time
     import jax as _jax
@@ -608,47 +612,91 @@ def bench_obs_overhead(dev, on_tpu):
     rng = np.random.default_rng(0)
     prompts = [rng.integers(0, cfg.vocab_size, 3).tolist()
                for _ in range(streams)]
+    attribution = {}                # last traced leg's phase verdicts
 
-    def run(traced: bool) -> float:
+    def run(traced: bool, attribute: bool = False) -> float:
         # traced = the WHOLE layer on: span tracer recording, request
         # registry recording every lifecycle edge, SLO engine
-        # observing; off = all three disabled (the single-branch no-op
+        # observing, phase profiler + pool counter tracks + watchdog
+        # armed; off = everything disabled (the single-branch no-op
         # paths production would pay anyway)
+        import gc
+        gc.collect()    # each leg starts from the same GC state
         tracer = _obs.Tracer(enabled=traced, capacity=1 << 15)
         reqreg = _obs.RequestRegistry(enabled=traced)
         eng = LLMEngine(params, cfg, num_slots=streams,
                         page_size=page_size, max_seq_len=max_seq,
                         prefill_chunk_tokens=4, block_q=4,
-                        tracer=tracer, reqtrace=reqreg)
+                        tracer=tracer, reqtrace=reqreg,
+                        stepprof=_obs.StepProfiler(enabled=traced),
+                        watchdog=_obs.Watchdog(enabled=traced))
         eng.slo.enabled = traced
         eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the executable
         hs = [eng.submit(p, max_new_tokens=new_tokens) for p in prompts]
         while not all(h.done() for h in hs):
             eng.step()
         itl = eng.latency_snapshot()["inter_token_s"]["p50"]
+        if attribute:
+            rep = eng.stepprof.report()
+            attribution["phase_shares"] = {
+                name: round(p["share"], 4)
+                for name, p in sorted(rep["phases"].items())}
+            attribution["step_p50_ms"] = round(
+                rep["step"]["p50_s"] * 1e3, 4)
+            attribution["watchdog_anomalies"] = \
+                eng.watchdog.anomalies_total
+            try:
+                flops = _obs.mfu.static_flops(
+                    eng._ragged, *eng.ragged_probe_args())
+                joined = eng.stepprof.cost_join("dispatch", flops)
+                attribution["dispatch_cost_model_ratio"] = {
+                    cls or "untagged": {
+                        "measured_mean_ms": round(
+                            r["measured_step_s"] * 1e3, 4),
+                        "cost_model_ratio": (
+                            None if r["cost_model_ratio"] is None
+                            else round(r["cost_model_ratio"], 3)),
+                    } for cls, r in joined.items()}
+            except Exception as e:  # noqa: BLE001 — cost join must not
+                attribution["dispatch_cost_model_ratio"] = {
+                    "error": repr(e)[:200]}    # kill the bench
         eng.shutdown()
         return itl or 0.0
 
     run(True)                       # warm both code paths once
     run(False)
-    on_p50, off_p50 = [], []
+    on_p50, off_p50, pair_ratios = [], [], []
     for _ in range(trials):         # alternate so drift hits both legs
-        on_p50.append(run(True))
-        off_p50.append(run(False))
+        on = run(True)
+        off = run(False)
+        on_p50.append(on)
+        off_p50.append(off)
+        if off:
+            pair_ratios.append(on / off)
+    # the attribution tables come from a DEDICATED traced run after the
+    # A/B loop: tracing the dispatch jaxpr for the static cost join is
+    # heavy enough to perturb the paired timing runs
+    run(True, attribute=True)
     on_med = statistics.median(on_p50)
     off_med = statistics.median(off_p50)
-    ratio = (on_med / off_med) if off_med else None
+    # the headline ratio is the MEDIAN OF PAIRED RATIOS: each on/off
+    # pair runs back to back, so machine-load drift across the bench
+    # cancels within a pair instead of landing on one leg's median
+    ratio = statistics.median(pair_ratios) if pair_ratios else None
     return {
         "workload": {"streams": streams, "new_tokens": new_tokens,
                      "trials": trials},
         "itl_p50_traced_ms": round(on_med * 1e3, 4),
         "itl_p50_untraced_ms": round(off_med * 1e3, 4),
-        # the acceptance pin: < 1.02 means full request tracing costs
-        # under 2% of decode ITL — safe to leave on in soaks
+        # the acceptance pin: < 1.02 means the full attribution layer
+        # costs under 2% of decode ITL — safe to leave on in soaks
         "itl_p50_ratio": (None if ratio is None else round(ratio, 4)),
         "overhead_pct": (None if ratio is None
                          else round((ratio - 1.0) * 100, 2)),
         "bound_pct": 2.0,
+        # the traced leg's attribution verdicts: per-phase step shares
+        # and the ragged dispatch's per-shape-class cost-model join
+        **attribution,
     }
 
 

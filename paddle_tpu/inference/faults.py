@@ -35,8 +35,8 @@ from . import llm_engine as _llm
 __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
            "InjectedCrash", "InvariantViolation", "FaultRule",
            "FaultInjector", "random_schedule", "drive", "check_invariants",
-           "run_schedule", "ScriptedEngine", "EchoDrafter",
-           "fleet_random_schedule", "drive_fleet",
+           "check_telemetry", "run_schedule", "ScriptedEngine",
+           "EchoDrafter", "fleet_random_schedule", "drive_fleet",
            "fleet_check_invariants", "fleet_run_schedule"]
 
 # the engine's named injection points, in rough lifecycle order ("step"
@@ -245,6 +245,42 @@ def drive(engine, handles: Sequence = (), max_steps: int = 5000) -> int:
     return steps
 
 
+def check_telemetry(engine) -> List[str]:
+    """Cross-check the TELEMETRY surface against engine ground truth:
+    every pool/queue/slot gauge the /metrics scrape (and the fleet
+    router's placement score) reads must agree with the allocator state
+    `check_invariants` verifies directly.  A mismatch means a gauge was
+    rebound, its callback broke (NaN), or the telemetry layer drifted
+    from the engine — leak detection via gauges only works if the two
+    agree, so the chaos soaks fail on disagreement.  Returns mismatch
+    strings ([] when the surfaces agree)."""
+    reg = getattr(engine, "metrics", None)
+    if reg is None:
+        return []
+    cache = engine.cache
+    expect = {
+        "llm_free_pages": cache.free_page_count,
+        "llm_free_slots": cache.free_slot_count,
+        "llm_pool_used_pages":
+            cache.num_pages - 1 - cache.free_page_count,
+        "llm_queue_depth": len(engine._pending),
+        "llm_slots_in_flight": len(engine._slots),
+    }
+    mismatches = []
+    for name, truth in expect.items():
+        g = reg.get(name)
+        if g is None:
+            mismatches.append(f"telemetry gauge {name} is not registered")
+            continue
+        v = g.value
+        if v != v or int(v) != int(truth):   # NaN-safe compare
+            mismatches.append(
+                f"telemetry drift: gauge {name}={v} but engine ground "
+                f"truth is {truth} (leak detection via gauges would "
+                "lie)")
+    return mismatches
+
+
 def check_invariants(engine, handles: Sequence = (), probe: bool = True,
                      raise_on_violation: bool = True,
                      probe_timeout: float = 120.0) -> dict:
@@ -401,6 +437,14 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         finally:
             engine.faults = saved
 
+    # telemetry cross-check at quiescence: the gauges /metrics scrapes
+    # (and the router places on) must agree with the allocator ground
+    # truth just verified above — the chaos soaks use this as
+    # gauge-based leak detection, and it only works if the two surfaces
+    # cannot disagree silently
+    telemetry = check_telemetry(engine)
+    violations.extend(telemetry)
+
     report = {
         "ok": not violations,
         "violations": violations,
@@ -408,6 +452,7 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         "free_slots": cache.free_slot_count,
         "num_pages": cache.num_pages,
         "probe_tokens": probe_tokens,
+        "telemetry": {"ok": not telemetry, "mismatches": telemetry},
         "stats": engine.stats_snapshot(),
     }
     if violations:
@@ -690,6 +735,7 @@ def fleet_check_invariants(router, handles: Sequence = (), reference=None,
                         f"single-engine reference (hops={h.hops}): "
                         f"got {list(h.tokens)} want {want}")
 
+    telemetry: Dict[int, List[str]] = {}
     for r in router.replicas:
         if r.dead:
             continue
@@ -698,6 +744,7 @@ def fleet_check_invariants(router, handles: Sequence = (), reference=None,
         if not rep["ok"]:
             violations.append(f"replica {r.rid}: "
                               f"{'; '.join(rep['violations'])}")
+        telemetry[r.rid] = rep["telemetry"]["mismatches"]
 
     snap = router.stats_snapshot()
     outcomes = (snap["completed"] + snap["cancelled"] + snap["timed_out"]
@@ -742,6 +789,10 @@ def fleet_check_invariants(router, handles: Sequence = (), reference=None,
         "violations": violations,
         "probe_tokens": probe_tokens,
         "stats": snap,
+        # per-live-replica gauge-vs-invariants cross-check (mismatches
+        # are already violations; the soak CLIs surface this tally)
+        "telemetry": {"ok": not any(telemetry.values()),
+                      "replicas": telemetry},
         "replicas": {r.rid: {"state": r.state, "dead": r.dead,
                              "rebuilds": r.rebuilds}
                      for r in router.replicas},

@@ -85,7 +85,9 @@ from ..models import generation
 from ..obs import metrics as obs_metrics
 from ..obs import reqtrace as obs_reqtrace
 from ..obs import slo as obs_slo
+from ..obs import stepprof as obs_stepprof
 from ..obs import trace as obs_trace
+from ..obs import watchdog as obs_watchdog
 
 __all__ = ["LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
            "DeadlineExceeded", "EngineStopped"]
@@ -290,6 +292,9 @@ class _StatsDict(collections.abc.MutableMapping):
                         "eos/max_new_tokens)",
         "preemptions": "victims evicted under page pressure",
         "swapped_in": "preempted requests resumed via host-KV scatter",
+        "swap_out_pages": "KV pages gathered to host RAM at preemption",
+        "swap_in_pages": "KV pages scattered back from host RAM on "
+                         "resume",
         "resumed": "preempted requests re-admitted (either mode)",
         "cancelled": "requests resolved by cancellation",
         "timed_out": "requests resolved by deadline expiry",
@@ -369,6 +374,15 @@ class LLMEngine:
     slo_objectives / slo_window_s: latency objectives for the per-
     engine SLO engine (default obs.slo.DEFAULT_OBJECTIVES over a 60s
     window); its gauges/burn rates render on /metrics and /stats.
+    stepprof: a paddle_tpu.obs.StepProfiler (default: a fresh armed
+    one) — per-step phase attribution (schedule / build_batch /
+    dispatch / sample / verify / commit / swap + other); its rolling
+    shares ride /stats ("step_phases") and per-phase gauges render on
+    /metrics.  Disable with StepProfiler(enabled=False).
+    watchdog: a paddle_tpu.obs.Watchdog (default: a fresh armed one) —
+    rolling-baseline spike detection over step time and inter-token
+    latency; on a sustained spike it names the guilty phase(s) and
+    drops a `step_anomaly` flight dump through `flight`.
 
     prefill_chunk_tokens: the per-step TOKEN BUDGET for prefill chunks
     riding the unified ragged batch alongside decode spans.  Smaller =
@@ -416,7 +430,9 @@ class LLMEngine:
                  reqtrace: Optional[obs_reqtrace.RequestRegistry] = None,
                  flight=None,
                  slo_objectives=None,
-                 slo_window_s: float = 60.0):
+                 slo_window_s: float = 60.0,
+                 stepprof: Optional[obs_stepprof.StepProfiler] = None,
+                 watchdog: Optional[obs_watchdog.Watchdog] = None):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -500,6 +516,7 @@ class LLMEngine:
             "ragged_batch_tokens", "verify_tokens", "spec_steps",
             "spec_drafted", "spec_accepted", "spec_rejected", "spec_bonus",
             "spec_emitted", "preemptions", "swapped_in", "resumed",
+            "swap_out_pages", "swap_in_pages",
             "cancelled", "timed_out", "failed", "steps_total"))
         reg = self.metrics
         self._h_queue_wait = reg.histogram(
@@ -554,6 +571,65 @@ class LLMEngine:
             objectives=(slo_objectives if slo_objectives is not None
                         else obs_slo.DEFAULT_OBJECTIVES),
             window_s=slo_window_s).register(reg)
+        # per-step phase attribution + the anomaly watchdog feeding on
+        # it: both default-armed (bench extra.obs_overhead pins the
+        # whole layer, profiler + pool telemetry + watchdog, < 2% of
+        # decode ITL)
+        self.stepprof = stepprof if stepprof is not None \
+            else obs_stepprof.StepProfiler()
+        self.stepprof.register_gauges(reg)
+        self.watchdog = watchdog if watchdog is not None \
+            else obs_watchdog.Watchdog()
+        self.watchdog.bind(tracer=self.tracer, registry=reg)
+        # the dispatch phase's shape class: the fixed ragged-batch
+        # geometry (query rows x spans x out rows) — the key a
+        # per-generation kernel autotuner caches tuned winners under
+        self._shape_class = (f"T{self._num_blocks * self.block_q}"
+                             f"xS{self._num_spans}xO{self._num_out}")
+        # KV-pool & scheduler memory telemetry, sampled every step:
+        # watermarks and fragmentation are step-thread-owned floats the
+        # gauges read lazily (same freshness contract as the pool
+        # gauges above)
+        self._pool_free_low_wm = self.cache.free_page_count
+        self._pool_used_high_wm = 0
+        self._frag_max_run = self.cache.free_page_count
+        self._frag_ratio = 1.0
+        self._frag_stale = 0        # traced counter-track refresh cadence
+        self._last_batch_tokens = 0
+        reg.gauge("llm_pool_pages_total",
+                  "allocatable KV pages (page 0 is reserved scratch)"
+                  ).set(self.cache.num_pages - 1)
+        reg.gauge("llm_pool_used_pages", "KV pages held by slots"
+                  ).set_function(
+            lambda: self.cache.num_pages - 1 - self.cache.free_page_count)
+        reg.gauge("llm_pool_free_low_watermark",
+                  "fewest free pages ever observed at a step boundary"
+                  ).set_function(lambda: self._pool_free_low_wm)
+        reg.gauge("llm_pool_used_high_watermark",
+                  "most pages ever held at a step boundary"
+                  ).set_function(lambda: self._pool_used_high_wm)
+        reg.gauge("llm_pool_frag_max_run",
+                  "longest contiguous run of free page ids (computed "
+                  "at scrape time)").set_function(
+            lambda: self._compute_frag())
+        def _frag_ratio_read():
+            self._compute_frag()
+            return self._frag_ratio
+
+        reg.gauge("llm_pool_frag_ratio",
+                  "max contiguous free run / free total (1.0 = "
+                  "unfragmented; pages are random-access, so this "
+                  "tracks allocator churn, not correctness)"
+                  ).set_function(_frag_ratio_read)
+        reg.gauge("llm_batch_tokens",
+                  "valid tokens in the most recent ragged batch "
+                  "(decode + prefill + verify rows)").set_function(
+            lambda: self._last_batch_tokens)
+        reg.gauge("llm_slot_pages_max",
+                  "largest per-slot page count right now"
+                  ).set_function(lambda: max(
+                      (len(p) for p in
+                       list(self.cache._slot_pages.values())), default=0))
         if flight is not None:
             flight.attach_engine(self)
 
@@ -735,6 +811,13 @@ class LLMEngine:
         # Prometheus twin) — computed outside _cv: the SLO engine has
         # its own lock and never touches engine state
         snap["slo"] = self.slo.report()
+        # the attribution layer: per-phase time shares over the
+        # profiler window, pool/fragmentation telemetry, and the
+        # watchdog's verdict — /stats and /metrics expose the same
+        # phase table on both serve paths
+        snap["step_phases"] = self.stepprof.report()
+        snap["pool"] = self.pool_snapshot()
+        snap["watchdog"] = self.watchdog.report()
         return snap
 
     def state_digest(self) -> dict:
@@ -782,6 +865,100 @@ class LLMEngine:
                 "error": f"digest raced a live step thread "
                          f"({last_err!r:.120})",
                 "alive": self.alive()}
+
+    def _compute_frag(self) -> int:
+        """Fragmentation: the longest contiguous run of free page IDS
+        (cached into the fields the gauges read; also returns it).
+        Paged attention is random-access so this is allocator-churn
+        signal (how shuffled the free list got), not a correctness
+        hazard.  O(free·log free), so it runs at SCRAPE/trace time, not
+        unconditionally per step — a 100k-page production pool must not
+        pay a sort per decode token.  Guarded: a scrape thread can race
+        the step thread mutating the free list; a torn read returns the
+        last cached figure rather than crashing the render."""
+        cache = self.cache
+        try:
+            free_pages = sorted(cache._free_pages)
+        except Exception:  # noqa: BLE001 — raced a live step thread
+            return self._frag_max_run
+        run = best = 0
+        prev = None
+        for p in free_pages:
+            run = run + 1 if (prev is not None and p == prev + 1) else 1
+            if run > best:
+                best = run
+            prev = p
+        self._frag_max_run = best
+        self._frag_ratio = \
+            (best / len(free_pages)) if free_pages else 1.0
+        return best
+
+    def _sample_telemetry(self) -> None:
+        """KV-pool & scheduler memory telemetry, once per step: update
+        the pool watermarks (O(1)) and, while the tracer is enabled,
+        drop one sample on each Perfetto COUNTER track — free pages
+        collapsing render UNDER the span that caused it.  Runs on the
+        step thread (which owns the cache), so the reads are exact."""
+        cache = self.cache
+        free = cache.free_page_count
+        used = cache.num_pages - 1 - free
+        if free < self._pool_free_low_wm:
+            self._pool_free_low_wm = free
+        if used > self._pool_used_high_wm:
+            self._pool_used_high_wm = used
+        tr = self.tracer
+        if tr.enabled:
+            # two multi-series counter tracks per step (not one event
+            # per gauge): the decode loop's allocation rate is part of
+            # the obs_overhead budget.  The frag series refreshes every
+            # 32 steps, not per step — honoring _compute_frag's
+            # no-sort-per-token contract even with tracing left on
+            self._frag_stale -= 1
+            if self._frag_stale <= 0:
+                self._compute_frag()
+                self._frag_stale = 32
+            tr.counter("pool_pages", {"free": free, "used": used,
+                                      "frag_run": self._frag_max_run})
+            sched = {"queue": len(self._pending),
+                     "slots": len(self._slots),
+                     "batch_tokens": self._last_batch_tokens}
+            if self.spec_k:
+                drafted = self.stats["spec_drafted"]
+                sched["spec_acceptance"] = (
+                    (self.stats["spec_accepted"] / drafted)
+                    if drafted else 1.0)
+            tr.counter("sched", sched)
+
+    def pool_snapshot(self) -> dict:
+        """The memory-telemetry section of /stats: pool occupancy,
+        watermarks, fragmentation, per-slot page counts, and the last
+        ragged batch's token count.  Instantaneous lock-free reads
+        (same freshness contract as the gauges); the slot-page table is
+        step-thread-owned, so (like state_digest) reading it from a
+        scrape thread retries the mutated-during-iteration race instead
+        of failing the /stats request."""
+        cache = self.cache
+        slot_pages: dict = {}
+        for _ in range(4):
+            try:
+                slot_pages = {str(s): len(p) for s, p in
+                              list(cache._slot_pages.items())}
+                break
+            except RuntimeError:        # raced a live step thread
+                continue
+        free = cache.free_page_count
+        return {
+            "pages_total": cache.num_pages - 1,
+            "page_size": cache.page_size,
+            "free_pages": free,
+            "used_pages": cache.num_pages - 1 - free,
+            "free_low_watermark": self._pool_free_low_wm,
+            "used_high_watermark": self._pool_used_high_wm,
+            "frag_max_run": self._compute_frag(),
+            "frag_ratio": round(self._frag_ratio, 4),
+            "slot_pages": slot_pages,
+            "batch_tokens_last": self._last_batch_tokens,
+        }
 
     def _rq_event(self, req: _Request, name: str, **attrs) -> None:
         """One request-timeline edge, stamped with this replica's name
@@ -840,10 +1017,29 @@ class LLMEngine:
         # the step thread with handles stranded and slots held — the
         # replica-death shape the fleet tier must survive
         self._fire("step")
+        prof = self.stepprof
+        # an armed watchdog must keep evaluating even with the profiler
+        # off (no phase record to feed on) — it then times the step
+        # itself and attribution degrades to an empty guilty list
+        t0 = (time.perf_counter()
+              if self.watchdog.enabled and not prof.enabled else None)
         with self.tracer.span("engine_step"):
-            reaped = self._reap()
-            admitted = self._admit()
-            stepped = self._ragged_step()
+            with prof.step() as pstep:
+                with prof.phase("schedule"):
+                    reaped = self._reap()
+                    admitted = self._admit()
+                stepped = self._ragged_step()
+        self._sample_telemetry()
+        rec = getattr(pstep, "record", None)
+        if rec is not None:
+            # the watchdog feeds on the frame the profiler just closed;
+            # a sustained spike drops a step_anomaly dump through the
+            # flight seam with the per-phase deltas attached
+            self.watchdog.observe_step(rec["total_s"], rec["phases"],
+                                       flight=self.flight)
+        elif t0 is not None:
+            self.watchdog.observe_step(time.perf_counter() - t0, None,
+                                       flight=self.flight)
         return reaped or admitted or stepped
 
     def start(self):
@@ -1056,7 +1252,8 @@ class LLMEngine:
         try:
             if self.preempt_mode == "swap" and pages:
                 with self.tracer.span("swap_out", slot=slot,
-                                      pages=len(pages)):
+                                      pages=len(pages)), \
+                     self.stepprof.phase("swap"):
                     self._fire("swap_out", slot=slot, pools=cache.pools)
                     idx = np.zeros((cache.pages_per_seq,), np.int32)
                     idx[:len(pages)] = pages
@@ -1065,6 +1262,8 @@ class LLMEngine:
                                             jnp.asarray(idx))
                     rs.host_k = np.asarray(hk)   # device -> host RAM
                     rs.host_v = np.asarray(hv)
+                with self._cv:
+                    self.stats["swap_out_pages"] += len(pages)
         except Exception as e:  # noqa: BLE001 — a failed swap-out loses the
             # victim's KV: fail that request, keep the engine serving
             cache.release_slot(slot)
@@ -1164,7 +1363,8 @@ class LLMEngine:
                        n_tokens=rs.n_pages * cache.page_size)
             cache.ensure_capacity(slot, rs.n_pages * cache.page_size)
             with self.tracer.span("swap_in", slot=slot,
-                                  pages=rs.n_pages) as sp:
+                                  pages=rs.n_pages) as sp, \
+                 self.stepprof.phase("swap") as ph:
                 self._fire("swap_in", slot=slot, pools=cache.pools)
                 idx = np.zeros((cache.pages_per_seq,), np.int32)
                 pages = cache._slot_pages[slot]
@@ -1173,9 +1373,11 @@ class LLMEngine:
                     cache.pools["k"], cache.pools["v"], jnp.asarray(idx),
                     jnp.asarray(rs.host_k), jnp.asarray(rs.host_v))
                 sp.fence(k_pool)
+                ph.fence(k_pool)
             cache.pools = {"k": k_pool, "v": v_pool}
             with self._cv:
                 self.stats["swapped_in"] += 1
+                self.stats["swap_in_pages"] += rs.n_pages
         with self._cv:
             self.stats["resumed"] += 1
         req._resume = None
@@ -1256,98 +1458,107 @@ class LLMEngine:
         if not self._slots:
             return False
         cache = self.cache
-        # -- 1. decode/verify spans: draft, then allocate the span's pages
-        decode_slots: List[tuple] = []      # (slot, draft-or-None)
-        for slot in sorted(self._slots):
-            st = self._slots.get(slot)
-            if st is None or st.prefilling:
-                continue        # preempted earlier in the pass / chunked
-            try:
-                self._fire("draft", slot=slot, pools=cache.pools)
-                draft = self._draft_for(slot, st)
-            except Exception as e:  # noqa: BLE001 — a drafting fault
-                # fails THIS request; the batch and engine keep going (a
-                # consume_pools rule still surfaces at the dispatch
-                # below and fails the whole step)
-                if slot in self._slots:
-                    self._evict(slot, e, "failed")
-                continue
-            n_new = 1 + (0 if draft is None else int(draft.size))
-            if self._alloc_with_preemption(slot, st.ctx + n_new):
-                decode_slots.append((slot, draft))
-        # -- 2. prefill chunks under the token budget ---------------------
-        # blocks are the real capacity: each decode span takes
-        # ceil(rows / block_q) (1 row, or 1+k for a verify span), each
-        # chunk ceil(n / block_q); scheduling in admission order
-        blocks_free = self._num_blocks \
-            - sum(-(-(1 + (0 if d is None else d.size)) // self.block_q)
-                  for s, d in decode_slots if s in self._slots)
-        budget = self.prefill_chunk_tokens
-        sched: dict[int, int] = {}
-        for slot in sorted((s for s in self._slots
-                            if self._slots[s].prefilling),
-                           key=lambda s: self._slots[s].admit_seq):
-            if budget <= 0 or blocks_free <= 0:
-                break
-            st = self._slots.get(slot)
-            if st is None or not st.prefilling:
-                continue
-            remaining = st.pending.size - st.ctx
-            n = min(remaining, budget, blocks_free * self.block_q)
-            try:
-                with self.tracer.span("prefill", slot=slot, tokens=n,
-                                      start=st.ctx):
-                    self._fire("prefill", slot=slot, pools=cache.pools)
-                    self._fire("prefill_chunk", slot=slot, tokens=n,
-                               start=st.ctx, pools=cache.pools)
-                    if not self._alloc_with_preemption(slot, st.ctx + n):
-                        continue
-            except Exception as e:  # noqa: BLE001 — a per-chunk injected
-                # fault fails THIS request; the rest of the batch and the
-                # engine keep going (a consume_pools rule still surfaces
-                # at the dispatch below and fails the whole step)
-                if slot in self._slots:
-                    self._evict(slot, e, "failed")
-                continue
-            sched[slot] = n
-            blocks_free -= -(-n // self.block_q)
-            budget -= n
-        # preemption during scheduling may have evicted earlier spans
-        decode_slots = [(s, d) for s, d in decode_slots
-                        if s in self._slots]
-        sched = {s: n for s, n in sched.items() if s in self._slots}
-        if not decode_slots and not sched:
-            return True     # allocation alone changed state this pass
-        # -- 3. build the fixed-shape ragged batch ------------------------
-        spans: List[generation.RaggedSpan] = []
-        self._batch_spans = []
-        self._batch_drafts = {}
-        for slot, draft in decode_slots:
-            st = self._slots[slot]
-            if draft is None:
+        prof = self.stepprof
+        with prof.phase("build_batch"):
+            # -- 1. decode/verify spans: draft, then allocate the span's
+            # pages
+            decode_slots: List[tuple] = []      # (slot, draft-or-None)
+            for slot in sorted(self._slots):
+                st = self._slots.get(slot)
+                if st is None or st.prefilling:
+                    continue    # preempted earlier in the pass / chunked
+                try:
+                    self._fire("draft", slot=slot, pools=cache.pools)
+                    draft = self._draft_for(slot, st)
+                except Exception as e:  # noqa: BLE001 — a drafting fault
+                    # fails THIS request; the batch and engine keep going
+                    # (a consume_pools rule still surfaces at the
+                    # dispatch below and fails the whole step)
+                    if slot in self._slots:
+                        self._evict(slot, e, "failed")
+                    continue
+                n_new = 1 + (0 if draft is None else int(draft.size))
+                if self._alloc_with_preemption(slot, st.ctx + n_new):
+                    decode_slots.append((slot, draft))
+            # -- 2. prefill chunks under the token budget -----------------
+            # blocks are the real capacity: each decode span takes
+            # ceil(rows / block_q) (1 row, or 1+k for a verify span), each
+            # chunk ceil(n / block_q); scheduling in admission order
+            blocks_free = self._num_blocks \
+                - sum(-(-(1 + (0 if d is None else d.size)) // self.block_q)
+                      for s, d in decode_slots if s in self._slots)
+            budget = self.prefill_chunk_tokens
+            sched: dict[int, int] = {}
+            for slot in sorted((s for s in self._slots
+                                if self._slots[s].prefilling),
+                               key=lambda s: self._slots[s].admit_seq):
+                if budget <= 0 or blocks_free <= 0:
+                    break
+                st = self._slots.get(slot)
+                if st is None or not st.prefilling:
+                    continue
+                remaining = st.pending.size - st.ctx
+                n = min(remaining, budget, blocks_free * self.block_q)
+                try:
+                    with self.tracer.span("prefill", slot=slot, tokens=n,
+                                          start=st.ctx):
+                        self._fire("prefill", slot=slot, pools=cache.pools)
+                        self._fire("prefill_chunk", slot=slot, tokens=n,
+                                   start=st.ctx, pools=cache.pools)
+                        if not self._alloc_with_preemption(slot,
+                                                           st.ctx + n):
+                            continue
+                except Exception as e:  # noqa: BLE001 — a per-chunk
+                    # injected fault fails THIS request; the rest of the
+                    # batch and the engine keep going (a consume_pools
+                    # rule still surfaces at the dispatch below and fails
+                    # the whole step)
+                    if slot in self._slots:
+                        self._evict(slot, e, "failed")
+                    continue
+                sched[slot] = n
+                blocks_free -= -(-n // self.block_q)
+                budget -= n
+            # preemption during scheduling may have evicted earlier spans
+            decode_slots = [(s, d) for s, d in decode_slots
+                            if s in self._slots]
+            sched = {s: n for s, n in sched.items() if s in self._slots}
+            if not decode_slots and not sched:
+                return True     # allocation alone changed state this pass
+            # -- 3. build the fixed-shape ragged batch --------------------
+            spans: List[generation.RaggedSpan] = []
+            self._batch_spans = []
+            self._batch_drafts = {}
+            for slot, draft in decode_slots:
+                st = self._slots[slot]
+                if draft is None:
+                    spans.append(generation.RaggedSpan(
+                        [st.last_tok], st.ctx + 1,
+                        cache._slot_pages[slot]))
+                    self._batch_spans.append((slot, "decode", 1))
+                else:
+                    # verify span: [last_tok] + drafts, logits for EVERY
+                    # row (row j scores the target's next token after
+                    # draft[:j])
+                    rows = 1 + int(draft.size)
+                    spans.append(generation.RaggedSpan(
+                        np.concatenate([[st.last_tok], draft]),
+                        st.ctx + rows, cache._slot_pages[slot],
+                        n_out=rows))
+                    self._batch_spans.append((slot, "verify", rows))
+                    self._batch_drafts[slot] = draft
+            for slot, n in sched.items():
+                st = self._slots[slot]
                 spans.append(generation.RaggedSpan(
-                    [st.last_tok], st.ctx + 1, cache._slot_pages[slot]))
-                self._batch_spans.append((slot, "decode", 1))
-            else:
-                # verify span: [last_tok] + drafts, logits for EVERY row
-                # (row j scores the target's next token after draft[:j])
-                rows = 1 + int(draft.size)
-                spans.append(generation.RaggedSpan(
-                    np.concatenate([[st.last_tok], draft]),
-                    st.ctx + rows, cache._slot_pages[slot], n_out=rows))
-                self._batch_spans.append((slot, "verify", rows))
-                self._batch_drafts[slot] = draft
-        for slot, n in sched.items():
-            st = self._slots[slot]
-            spans.append(generation.RaggedSpan(
-                st.pending[st.ctx:st.ctx + n], st.ctx + n,
-                cache._slot_pages[slot]))
-            self._batch_spans.append((slot, "chunk", n))
-        batch = generation.build_ragged_batch(
-            spans, self._num_blocks, self._num_spans, self.block_q,
-            cache.page_size, cache.pages_per_seq, num_out=self._num_out)
-        self._batch_out = list(zip(batch["out_start"][:len(spans)],
-                                   batch["out_len"][:len(spans)]))
+                    st.pending[st.ctx:st.ctx + n], st.ctx + n,
+                    cache._slot_pages[slot]))
+                self._batch_spans.append((slot, "chunk", n))
+            batch = generation.build_ragged_batch(
+                spans, self._num_blocks, self._num_spans, self.block_q,
+                cache.page_size, cache.pages_per_seq,
+                num_out=self._num_out)
+            self._batch_out = list(zip(batch["out_start"][:len(spans)],
+                                       batch["out_len"][:len(spans)]))
         # -- 4. ONE dispatch for the whole mixed batch --------------------
         n_verify = sum(1 for _s, k, _n in self._batch_spans
                        if k == "verify")
@@ -1355,7 +1566,9 @@ class LLMEngine:
             with self.tracer.span("decode_step", active=len(spans),
                                   decode=len(decode_slots) - n_verify,
                                   verify=n_verify,
-                                  chunks=len(sched)) as sp:
+                                  chunks=len(sched)) as sp, \
+                 prof.phase("dispatch",
+                            shape_class=self._shape_class) as ph:
                 self._fire("decode", pools=cache.pools)
                 logits, k_pool, v_pool = self._ragged(
                     self.params, jnp.asarray(batch["tok"]),
@@ -1370,13 +1583,14 @@ class LLMEngine:
                     jnp.asarray(batch["out_rows"]),
                     cache.pools["k"], cache.pools["v"])
                 sp.fence(logits)
+                ph.fence(logits)
             cache.pools = {"k": k_pool, "v": v_pool}
             # the verify point wraps the accept/reject pass's input: a
             # fault here (incl. consume_pools on the freshly-swapped
             # pools) fails the step exactly like a dispatch fault
             if n_verify:
                 self._fire("verify", pools=cache.pools)
-            with self.tracer.span("sample"):
+            with self.tracer.span("sample"), prof.phase("sample"):
                 self._fire("sample")
                 if n_verify == 0:
                     # no verify spans this step (speculation off, or the
@@ -1399,6 +1613,8 @@ class LLMEngine:
         n_prefill_tokens = sum(sched.values())
         n_verify_rows = sum(n for _s, _k, n in self._batch_spans
                             if _k == "verify")
+        batch_tokens = (len(decode_slots) - n_verify + n_verify_rows
+                        + n_prefill_tokens)
         with self._cv:
             # verify_tokens lands in the SAME locked block as
             # ragged_batch_tokens so check_invariants' ragged identity
@@ -1414,40 +1630,41 @@ class LLMEngine:
             if sched:
                 self.stats["prefill_chunks"] += len(sched)
                 self.stats["prefill_tokens"] += n_prefill_tokens
-            self.stats["ragged_batch_tokens"] += (
-                len(decode_slots) - n_verify + n_verify_rows
-                + n_prefill_tokens)
+            self.stats["ragged_batch_tokens"] += batch_tokens
+        self._last_batch_tokens = batch_tokens
         # -- 5. post-process each span's outcome --------------------------
         now = time.monotonic()
-        for i, (slot, kind, n) in enumerate(self._batch_spans):
-            st = self._slots.get(slot)
-            if st is None:
-                continue
-            o0, on = self._batch_out[i]
-            if kind == "verify":
-                self._commit_verify(slot, st, lg[o0:o0 + on],
-                                    self._batch_drafts[slot], now)
-                continue
-            if kind == "chunk":
-                st.ctx += n
-                self._rq_event(st.req, "prefill_chunk", tokens=n,
-                               ctx=st.ctx)
-                if st.prefilling:
-                    continue            # more chunks on later steps
-                if not st.sample_on_finish:
-                    # recompute-resume: its next token was sampled before
-                    # the preemption; decode continues with last_tok
-                    st.pending = None
+        with prof.phase("commit"):
+            for i, (slot, kind, n) in enumerate(self._batch_spans):
+                st = self._slots.get(slot)
+                if st is None:
                     continue
-                st.pending = None
-                tok = self._row_token(nxt, lg, o0)
-                self._rq_event(st.req, "prefill_done", ctx=st.ctx)
-            else:
-                st.ctx += 1
-                tok = self._row_token(nxt, lg, o0)
-                self._rq_event(st.req, "decode", ctx=st.ctx)
-            st.last_tok = tok
-            self._emit_tokens(slot, st, [tok], now)
+                o0, on = self._batch_out[i]
+                if kind == "verify":
+                    self._commit_verify(slot, st, lg[o0:o0 + on],
+                                        self._batch_drafts[slot], now)
+                    continue
+                if kind == "chunk":
+                    st.ctx += n
+                    self._rq_event(st.req, "prefill_chunk", tokens=n,
+                                   ctx=st.ctx)
+                    if st.prefilling:
+                        continue        # more chunks on later steps
+                    if not st.sample_on_finish:
+                        # recompute-resume: its next token was sampled
+                        # before the preemption; decode continues with
+                        # last_tok
+                        st.pending = None
+                        continue
+                    st.pending = None
+                    tok = self._row_token(nxt, lg, o0)
+                    self._rq_event(st.req, "prefill_done", ctx=st.ctx)
+                else:
+                    st.ctx += 1
+                    tok = self._row_token(nxt, lg, o0)
+                    self._rq_event(st.req, "decode", ctx=st.ctx)
+                st.last_tok = tok
+                self._emit_tokens(slot, st, [tok], now)
         return True
 
     def _row_token(self, nxt, lg, row: int) -> int:
@@ -1471,13 +1688,14 @@ class LLMEngine:
         ctx_len masking never reads past the sequence length, and the
         next span overwrites the stale rows in place)."""
         k = int(draft.size)
-        if self.temperature == 0.0:
-            emitted, m = generation.verify_greedy(rows, draft)
-        else:
-            probs = generation.filtered_probs(
-                rows, self.temperature, self.top_k, self.top_p)
-            emitted, m = generation.verify_rejection(
-                probs, draft, self._spec_rng)
+        with self.stepprof.phase("verify"):
+            if self.temperature == 0.0:
+                emitted, m = generation.verify_greedy(rows, draft)
+            else:
+                probs = generation.filtered_probs(
+                    rows, self.temperature, self.top_k, self.top_p)
+                emitted, m = generation.verify_rejection(
+                    probs, draft, self._spec_rng)
         # adaptive k: grow on full acceptance, shrink on a bad span
         if m == k:
             st.spec_k = min(st.spec_k + 1, self.spec_k)
@@ -1517,6 +1735,13 @@ class LLMEngine:
                 self._h_itl.observe(now - st.req.t_last_token)
                 self.slo.observe("inter_token",
                                  now - st.req.t_last_token, t=now)
+                # only the FIRST gap of a multi-token span feeds the
+                # watchdog: the rest share `now` and their 0.0 gaps
+                # would drive the ITL baseline median to zero,
+                # permanently disarming spike detection on exactly the
+                # speculating engines it watches
+                if j == 0:
+                    self.watchdog.observe_itl(now - st.req.t_last_token)
             st.req.t_last_token = now
             if (st.req.eos_id is not None and tok == st.req.eos_id) \
                     or len(st.req.tokens) >= st.req.max_new_tokens:

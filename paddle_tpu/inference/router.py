@@ -312,6 +312,19 @@ class Router:
                       if r.state == HEALTHY and not r.dead))
         reg.gauge("fleet_parked_now", "retries currently awaiting capacity"
                   ).set_function(lambda: len(self._parked))
+        reg.gauge("fleet_inflight_now",
+                  "fleet handles currently placed on a replica"
+                  ).set_function(lambda: sum(
+                      len(r.inflight) for r in self.replicas))
+        # fleet-wide pool headroom: the sum the per-replica
+        # llm_free_pages gauges render individually — one number for
+        # dashboards and the capacity-planning view of the memory
+        # telemetry each engine samples per step
+        reg.gauge("fleet_free_pages_total",
+                  "free KV pages summed over live replicas"
+                  ).set_function(lambda: sum(
+                      r.engine.cache.free_page_count
+                      for r in self.replicas if not r.dead))
         if self.threaded:
             for r in self.replicas:
                 r.engine.start()
@@ -1005,9 +1018,17 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                 else:
                     self._reply(200, tl)
             elif path == "/metrics":
-                text = router.metrics.render() + obs_metrics.render_merged(
-                    [(str(r.rid), r.engine.metrics)
-                     for r in router.replicas], label="replica")
+                # the router render omits its obs_render_errors_total
+                # block and passes the count into the merged family —
+                # a metric family must be declared ONCE per scrape or
+                # Prometheus parsers reject the whole exposition
+                text = router.metrics.render(errors_family=False) \
+                    + obs_metrics.render_merged(
+                        [(str(r.rid), r.engine.metrics)
+                         for r in router.replicas], label="replica",
+                        extra_error_counts={
+                            "router":
+                                router.metrics.render_errors_total})
                 self._reply_text(200, text,
                                  "text/plain; version=0.0.4; charset=utf-8")
             elif path == "/healthz":

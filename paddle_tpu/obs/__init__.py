@@ -16,9 +16,17 @@ measures what it *actually* costs, on one shared event spine:
     two surfaces cannot drift.
   * `obs.mfu` — closes the static/measured loop: runtime MFU from
     measured step time + the cost pass's FLOPs, `cost_model_ratio`
-    (measured / predicted) per jitted target, and a `RecompileSentinel`
-    that counts compile-cache misses per fn and warns when a target
-    recompiles after warmup.
+    (measured / predicted) per jitted target — and per PHASE via
+    `phase_runtime_report` — and a `RecompileSentinel` that counts
+    compile-cache misses per fn and warns when a target recompiles
+    after warmup.
+  * `obs.stepprof` — per-step phase attribution: disjoint self-time
+    phases (schedule/build_batch/dispatch/sample/verify/commit/swap),
+    rolling shares on /stats + /metrics, and a per-shape-class
+    cost-model join for the dispatch (the autotuner's table).
+  * `obs.watchdog` — rolling-baseline anomaly detection over step time
+    and ITL; a sustained spike is attributed to the phase(s) whose
+    time grew and dumped as a `step_anomaly` flight-recorder frame.
 
 When tracing is disabled (the default) every instrumentation point is a
 single attribute check returning a shared no-op span — safe to leave in
@@ -33,6 +41,8 @@ from . import mfu  # noqa: F401
 from . import reqtrace  # noqa: F401
 from . import flight  # noqa: F401
 from . import slo  # noqa: F401
+from . import stepprof  # noqa: F401
+from . import watchdog  # noqa: F401
 from .trace import (  # noqa: F401
     Tracer, get_tracer, load_trace, summarize, export_merged,
 )
@@ -47,9 +57,12 @@ from .reqtrace import (  # noqa: F401
 )
 from .flight import FlightRecorder, load_dump  # noqa: F401
 from .slo import Objective, SLOEngine  # noqa: F401
+from .stepprof import StepProfiler  # noqa: F401
+from .watchdog import Watchdog  # noqa: F401
 
 __all__ = [
     "trace", "metrics", "mfu", "reqtrace", "flight", "slo",
+    "stepprof", "watchdog",
     "Tracer", "get_tracer", "load_trace",
     "summarize", "export_merged", "Registry", "Counter", "Gauge",
     "Histogram", "render_merged",
@@ -57,4 +70,5 @@ __all__ = [
     "runtime_report",
     "RequestRegistry", "get_request_registry", "new_request_id",
     "FlightRecorder", "load_dump", "Objective", "SLOEngine",
+    "StepProfiler", "Watchdog",
 ]

@@ -110,12 +110,14 @@ class FlightRecorder:
 
     # -- snapshot / dump ----------------------------------------------------
 
-    def snapshot(self, reason: str, error: Optional[BaseException] = None
-                 ) -> dict:
+    def snapshot(self, reason: str, error: Optional[BaseException] = None,
+                 extra: Optional[dict] = None) -> dict:
         """One black-box frame: recent spans, metrics text + counter
         values, the engine state digest, recent request timelines.
-        Every source is read best-effort — a half-dead engine must not
-        turn its own post-mortem into a second crash."""
+        `extra` is a caller-supplied JSON-safe section (the anomaly
+        watchdog attaches its phase deltas here).  Every source is read
+        best-effort — a half-dead engine must not turn its own
+        post-mortem into a second crash."""
         snap = {
             "schema": SCHEMA,
             "reason": str(reason),
@@ -123,6 +125,7 @@ class FlightRecorder:
             "wall_time": time.time(),
             "perf_time": time.perf_counter(),
             "error": None if error is None else repr(error),
+            "extra": extra,
             "spans": [],
             "metrics": None,
             "engine": None,
@@ -156,13 +159,13 @@ class FlightRecorder:
             pass
         return snap
 
-    def dump(self, reason: str, error: Optional[BaseException] = None
-             ) -> Optional[str]:
+    def dump(self, reason: str, error: Optional[BaseException] = None,
+             extra: Optional[dict] = None) -> Optional[str]:
         """Snapshot and (when `dir` is set) write atomically.  Returns
         the path written, or None in in-memory mode.  NEVER raises —
         this runs inside dying threads and signal handlers."""
         try:
-            snap = self.snapshot(reason, error)
+            snap = self.snapshot(reason, error, extra=extra)
         except Exception:  # noqa: BLE001 — even snapshot() failing must
             return None    # not escalate the crash being recorded
         self.last = snap

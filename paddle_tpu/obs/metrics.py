@@ -132,18 +132,24 @@ class Gauge(_Metric):
         self._fn = fn
         return self
 
+    def _read(self) -> float:
+        """The raw read — PROPAGATES a callback's exception.  The render
+        layer catches it, skips this metric, and counts the error; the
+        `value` property degrades it to NaN for in-process readers."""
+        if self._fn is not None:
+            return float(self._fn())
+        return self._value
+
     @property
     def value(self) -> float:
-        if self._fn is not None:
-            try:
-                return float(self._fn())
-            except Exception:  # noqa: BLE001 — a dying engine must not
-                return float("nan")  # take /metrics down with it
-        return self._value
+        try:
+            return self._read()
+        except Exception:  # noqa: BLE001 — a dying engine must not
+            return float("nan")  # crash a router's score read
 
     def sample_lines(self, extra_labels: Optional[dict] = None) -> List[str]:
         labels = _merge_labels(self.labels, extra_labels)
-        return [f"{self.name}{_fmt_labels(labels)} {_fmt(self.value)}"]
+        return [f"{self.name}{_fmt_labels(labels)} {_fmt(self._read())}"]
 
 
 class Histogram(_Metric):
@@ -238,7 +244,13 @@ def percentile(values: Iterable[float], q: float) -> float:
     return vals[lo] + (vals[hi] - vals[lo]) * (k - lo)
 
 
-def render_merged(registries, label: str = "replica") -> str:
+_RENDER_ERRORS_NAME = "obs_render_errors_total"
+_RENDER_ERRORS_HELP = ("metrics skipped from a render because their "
+                       "read/callback raised (the scrape survived)")
+
+
+def render_merged(registries, label: str = "replica",
+                  extra_error_counts: Optional[dict] = None) -> str:
     """One Prometheus text blob over SEVERAL registries: every sample line
     from registry `name` gains a `{label="name"}` label, and families
     sharing a metric name across registries emit HELP/TYPE exactly once.
@@ -248,24 +260,50 @@ def render_merged(registries, label: str = "replica") -> str:
     keeps exclusive ownership of its counters — aggregation happens at
     render time, never at write time).  `registries` is a dict (or
     (name, Registry) iterable); names become label values, so keep them
-    low-cardinality (replica ids, not request ids)."""
+    low-cardinality (replica ids, not request ids).
+
+    A metric whose read raises (a gauge callback into a dying engine) is
+    SKIPPED, not fatal: the rest of the fleet still renders, and the
+    owning registry's `obs_render_errors_total` counts the skip — one
+    bad callback must never take down the whole fleet scrape.
+    `extra_error_counts` ({name: count}) adds labeled samples to that
+    family for registries rendered OUTSIDE this call (the fleet handler
+    concatenates the router's own `render(errors_family=False)` in
+    front, so the family is declared exactly once per scrape — a second
+    TYPE line for the same name makes parsers reject the exposition)."""
     items = registries.items() if hasattr(registries, "items") \
         else list(registries)
     families: "collections.OrderedDict[str, list]" = \
         collections.OrderedDict()
+    err_lines = []
     for rname, reg in items:
         extra = {label: rname}
         for m in reg.collect():
+            try:
+                samples = m.sample_lines(extra_labels=extra)
+            except Exception:  # noqa: BLE001 — skip, count, render on
+                reg._note_render_error()
+                continue
             fam = families.get(m.name)
             if fam is None:
                 fam = families[m.name] = [m.help, m.kind, []]
-            fam[2].extend(m.sample_lines(extra_labels=extra))
+            fam[2].extend(samples)
+        err_lines.append(
+            f"{_RENDER_ERRORS_NAME}{_fmt_labels(extra)} "
+            f"{reg.render_errors_total}")
+    for name, count in (extra_error_counts or {}).items():
+        err_lines.append(
+            f"{_RENDER_ERRORS_NAME}{_fmt_labels({label: name})} "
+            f"{int(count)}")
     lines = []
     for name, (help_text, kind, samples) in families.items():
         if help_text:
             lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} {kind}")
         lines.extend(samples)
+    lines.append(f"# HELP {_RENDER_ERRORS_NAME} {_RENDER_ERRORS_HELP}")
+    lines.append(f"# TYPE {_RENDER_ERRORS_NAME} counter")
+    lines.extend(err_lines)
     return "\n".join(lines) + "\n"
 
 
@@ -278,6 +316,17 @@ class Registry:
         self._metrics: "collections.OrderedDict[tuple, _Metric]" = \
             collections.OrderedDict()
         self._lock = threading.Lock()
+        self._render_errors = 0
+
+    def _note_render_error(self) -> None:
+        with self._lock:
+            self._render_errors += 1
+
+    @property
+    def render_errors_total(self) -> int:
+        """Metrics skipped from render() / render_merged() because their
+        read raised — rendered as `obs_render_errors_total`."""
+        return self._render_errors
 
     def _get_or_make(self, cls, name, help, labels, **kw):
         key = (name, tuple(sorted((labels or {}).items())))
@@ -315,19 +364,37 @@ class Registry:
         with self._lock:
             return list(self._metrics.values())
 
-    def render(self) -> str:
+    def render(self, errors_family: bool = True) -> str:
         """Prometheus text exposition format 0.0.4.  Families sharing a
-        name emit HELP/TYPE once, then every child's samples."""
+        name emit HELP/TYPE once, then every child's samples.  A metric
+        whose read raises (a gauge callback into torn-down state) is
+        SKIPPED and counted in `obs_render_errors_total` — the scrape
+        always returns the rest.  errors_family=False omits that
+        family's block (callers concatenating this render with
+        `render_merged` pass the count through `extra_error_counts`
+        instead, so the family is declared once per scrape)."""
         by_family: "collections.OrderedDict[str, List[_Metric]]" = \
             collections.OrderedDict()
         for m in self.collect():
             by_family.setdefault(m.name, []).append(m)
         lines = []
         for name, family in by_family.items():
+            samples = []
+            for m in family:
+                try:
+                    samples.extend(m.sample_lines())
+                except Exception:  # noqa: BLE001 — skip, count, go on
+                    self._note_render_error()
+            if not samples:
+                continue
             head = family[0]
             if head.help:
                 lines.append(f"# HELP {name} {head.help}")
             lines.append(f"# TYPE {name} {head.kind}")
-            for m in family:
-                lines.extend(m.sample_lines())
+            lines.extend(samples)
+        if errors_family:
+            lines.append(f"# HELP {_RENDER_ERRORS_NAME} "
+                         f"{_RENDER_ERRORS_HELP}")
+            lines.append(f"# TYPE {_RENDER_ERRORS_NAME} counter")
+            lines.append(f"{_RENDER_ERRORS_NAME} {self._render_errors}")
         return "\n".join(lines) + "\n"

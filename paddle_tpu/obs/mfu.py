@@ -26,7 +26,7 @@ import warnings
 from typing import Callable, Dict, Optional
 
 __all__ = ["PEAK_FLOPS_BY_KIND", "device_peak_flops", "runtime_report",
-           "RecompileSentinel", "RecompileWarning"]
+           "phase_runtime_report", "RecompileSentinel", "RecompileWarning"]
 
 # bf16 peak FLOP/s per chip; ordered most-specific-first for substring
 # match on device_kind (bench.py delegates here — one table, one truth)
@@ -78,6 +78,28 @@ def runtime_report(measured_step_s: float, flops_per_step: float,
         if predicted > 0:
             out["cost_model_ratio"] = measured_step_s / predicted
     return out
+
+
+def phase_runtime_report(phase_times_s: Dict[str, float],
+                         phase_flops: Dict[str, float],
+                         peak_flops: Optional[float] = None,
+                         device=None) -> Dict[str, dict]:
+    """Per-PHASE measured-vs-static join: `runtime_report` for every
+    phase that has both a measured time and a static FLOPs count —
+    `cost_model_ratio` stops being a whole-step verdict and becomes a
+    per-phase one (the ragged dispatch can be model-faithful while the
+    host-side commit pass isn't priced at all).  Phases with no static
+    entry are skipped: the cost model prices device dispatches, not
+    scheduler host time, and a fabricated 0-FLOPs ratio would read as
+    "infinitely slower than predicted"."""
+    if peak_flops is None:
+        peak_flops = device_peak_flops(device)
+    return {
+        phase: runtime_report(phase_times_s[phase], flops,
+                              peak_flops=peak_flops)
+        for phase, flops in phase_flops.items()
+        if phase in phase_times_s
+    }
 
 
 def static_flops(fn, *args, **kwargs) -> float:

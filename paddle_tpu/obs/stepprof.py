@@ -1,0 +1,353 @@
+"""Per-step phase profiler: where inside a STEP did the time go.
+
+The span tracer (obs.trace) answers "what spans ran"; the request
+registry answers "where did request X go".  Neither answers the
+question the autotuner, prefix-reuse, and mega-kernel roadmap items
+consume: *what share of a steady-state engine step is scheduler host
+time vs ragged dispatch vs sampling vs commit* — and how does the
+dispatch's measured time compare to the static cost model, per shape
+class.  This module is that attribution layer:
+
+  * `StepProfiler.step()` opens one step frame; `phase(name)` context
+    managers inside it record SELF time per phase (a nested phase's
+    duration is subtracted from its parent, so `verify` inside
+    `commit` and `swap` inside `schedule` never double-count and the
+    per-step shares sum to ~1.0).  Whatever the phases did not cover
+    lands in the synthetic `other` phase.
+  * phases accept a `fence=`-style `.fence(arrays)` exactly like
+    tracer spans: jax dispatch is async, and the `dispatch` phase must
+    time the compute, not the enqueue.
+  * phases may carry a `shape_class` tag — the dispatch phase is keyed
+    by its batch geometry (`T48xS4` = 48 query rows, 4 spans), which
+    is the key a per-generation kernel autotuner caches winners under.
+  * frames land in a bounded rolling window; `report()` aggregates
+    per-phase totals/means/percentiles and SHARES over that window
+    (the `/stats` surface), `record_window()` hands the raw per-step
+    frames to the anomaly watchdog, and `cost_join(phase, flops)`
+    joins a phase's measured mean against the static cost model via
+    `obs.mfu.runtime_report` — `cost_model_ratio` per phase per shape
+    class instead of whole-step only.
+
+Disabled cost ~ zero: `step()`/`phase()` return shared no-op context
+managers behind one branch, so the instrumentation lives permanently
+inside `LLMEngine.step()`.  Enabled cost is a few `perf_counter`
+reads and dict adds per step — bench.py `extra.obs_overhead` pins the
+whole layer (profiler + pool telemetry + watchdog) under 2% of decode
+ITL.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional
+
+from . import metrics as obs_metrics
+
+__all__ = ["StepProfiler", "ENGINE_PHASES"]
+
+# the engine's step decomposition, in execution order.  "other" is the
+# synthetic remainder (step total minus every recorded phase) — a
+# growing "other" share means the step loop gained un-attributed work.
+ENGINE_PHASES = ("schedule", "build_batch", "dispatch", "sample",
+                 "verify", "commit", "swap", "other")
+
+
+class _NoopPhase:
+    """Shared do-nothing frame/phase while the profiler is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def fence(self, value):
+        return self
+
+
+_NOOP = _NoopPhase()
+
+
+class _Frame:
+    """One step's accounting: per-phase self time + shape-class time."""
+
+    __slots__ = ("t0", "child_s", "phases", "classes")
+
+    def __init__(self, t0: float):
+        self.t0 = t0
+        self.child_s = 0.0                  # time covered by phases
+        self.phases: Dict[str, float] = {}
+        self.classes: Dict[tuple, float] = {}
+
+
+class _Phase:
+    __slots__ = ("_prof", "name", "shape_class", "_t0", "_fence",
+                 "_child_s")
+
+    def __init__(self, prof: "StepProfiler", name: str,
+                 shape_class: Optional[str]):
+        self._prof = prof
+        self.name = name
+        self.shape_class = shape_class
+        self._fence = None
+        self._child_s = 0.0
+
+    def fence(self, value) -> "_Phase":
+        """Block on `value` before the closing timestamp so the phase
+        covers the device compute, not the enqueue (same contract as
+        tracer spans; a no-op on CPU interpret paths)."""
+        self._fence = value
+        return self
+
+    def __enter__(self):
+        self._prof._stack().append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._fence is not None:
+            try:
+                import jax
+
+                jax.block_until_ready(self._fence)
+            except Exception:  # noqa: BLE001 — a deleted/donated buffer
+                pass           # must not turn a timing into a crash
+        dur = time.perf_counter() - self._t0
+        prof = self._prof
+        stack = prof._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        # full duration charges the parent's child account; SELF time
+        # (minus nested phases) lands on this phase — shares stay
+        # disjoint however phases nest
+        parent = stack[-1] if stack else None
+        if parent is not None:
+            parent._child_s += dur
+        self_s = max(0.0, dur - self._child_s)
+        frame = prof._frame()
+        if frame is not None:
+            frame.child_s += 0.0 if parent is not None else dur
+            frame.phases[self.name] = \
+                frame.phases.get(self.name, 0.0) + self_s
+            if self.shape_class is not None:
+                key = (self.name, str(self.shape_class))
+                frame.classes[key] = frame.classes.get(key, 0.0) + self_s
+        return False
+
+
+class _StepCtx:
+    __slots__ = ("_prof", "record")
+
+    def __init__(self, prof: "StepProfiler"):
+        self._prof = prof
+        self.record = None      # filled on exit: the frame's dict form
+
+    def __enter__(self):
+        self._prof._open_frame()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.record = self._prof._close_frame()
+        return False
+
+
+class StepProfiler:
+    """Rolling per-step phase attribution.  One per engine (the frame
+    stack is per-thread, so a shared instance would still attribute
+    correctly, but the window would mix engines)."""
+
+    def __init__(self, window: int = 256, enabled: bool = True):
+        self.enabled = bool(enabled)
+        self._records: collections.deque = collections.deque(
+            maxlen=int(window))
+        self._lock = threading.Lock()
+        self._tls = threading.local()
+        self.steps_total = 0
+
+    # -- control ------------------------------------------------------------
+
+    def enable(self) -> "StepProfiler":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "StepProfiler":
+        self.enabled = False
+        return self
+
+    # -- recording ----------------------------------------------------------
+
+    def _stack(self) -> List[_Phase]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _frame(self) -> Optional[_Frame]:
+        return getattr(self._tls, "frame", None)
+
+    def _open_frame(self) -> None:
+        self._tls.frame = _Frame(time.perf_counter())
+        self._tls.stack = []
+
+    def _close_frame(self) -> Optional[dict]:
+        frame = self._frame()
+        if frame is None:
+            return None
+        self._tls.frame = None
+        total = time.perf_counter() - frame.t0
+        other = max(0.0, total - frame.child_s)
+        if other > 0.0:
+            frame.phases["other"] = \
+                frame.phases.get("other", 0.0) + other
+        rec = {"t": time.perf_counter(), "total_s": total,
+               "phases": frame.phases, "classes": frame.classes}
+        with self._lock:
+            self._records.append(rec)
+            self.steps_total += 1
+        return rec
+
+    def step(self):
+        """Context manager for ONE engine step; every `phase()` entered
+        inside it lands on this step's frame.  `.record` holds the
+        frame dict after exit (the watchdog's input)."""
+        if not self.enabled:
+            return _NOOP
+        return _StepCtx(self)
+
+    def phase(self, name: str, shape_class: Optional[str] = None):
+        """Context manager for one phase inside the current step.  A
+        phase entered with no open step frame records nothing (still a
+        valid no-op).  Phases nest: a child's time is charged to the
+        child only."""
+        if not self.enabled or self._frame() is None:
+            return _NOOP
+        return _Phase(self, name, shape_class)
+
+    # -- reading ------------------------------------------------------------
+
+    def record_window(self) -> List[dict]:
+        """The raw per-step frames in the rolling window (oldest
+        first) — the anomaly watchdog's baseline feed."""
+        with self._lock:
+            return list(self._records)
+
+    def report(self) -> dict:
+        """Windowed aggregate — the `/stats` phase table:
+        {steps_total, window, step: {count, mean_s, p50_s, p99_s},
+        phases: {name: {count, total_s, mean_s, share}},
+        shape_classes: {phase: {cls: {count, total_s, mean_s}}}}.
+        `share` = phase total / step total over the window; shares sum
+        to ~1.0 because nested phases record self time only."""
+        recs = self.record_window()
+        totals = sorted(r["total_s"] for r in recs)
+        out = {
+            "steps_total": self.steps_total,
+            "window": len(recs),
+            "step": {
+                "count": len(recs),
+                "mean_s": (sum(totals) / len(totals)) if totals else 0.0,
+                "p50_s": obs_metrics.percentile(totals, 0.50),
+                "p99_s": obs_metrics.percentile(totals, 0.99),
+            },
+            "phases": {},
+            "shape_classes": {},
+        }
+        window_total = sum(totals)
+        agg: Dict[str, List[float]] = {}
+        cls_agg: Dict[tuple, List[float]] = {}
+        for r in recs:
+            for name, s in r["phases"].items():
+                agg.setdefault(name, []).append(s)
+            for key, s in r["classes"].items():
+                cls_agg.setdefault(key, []).append(s)
+        for name, vals in agg.items():
+            tot = sum(vals)
+            out["phases"][name] = {
+                "count": len(vals),
+                "total_s": tot,
+                "mean_s": tot / len(vals),
+                "share": (tot / window_total) if window_total else 0.0,
+            }
+        for (name, cls), vals in cls_agg.items():
+            tot = sum(vals)
+            out["shape_classes"].setdefault(name, {})[cls] = {
+                "count": len(vals),
+                "total_s": tot,
+                "mean_s": tot / len(vals),
+            }
+        return out
+
+    def share(self, name: str) -> float:
+        """One phase's windowed time share (the per-phase gauges read
+        this lazily at scrape time)."""
+        total = 0.0
+        phase = 0.0
+        for r in self.record_window():
+            total += r["total_s"]
+            phase += r["phases"].get(name, 0.0)
+        return (phase / total) if total else 0.0
+
+    def mean_s(self, name: str) -> float:
+        vals = [r["phases"][name] for r in self.record_window()
+                if name in r["phases"]]
+        return (sum(vals) / len(vals)) if vals else 0.0
+
+    def cost_join(self, phase: str, flops: float,
+                  peak_flops: Optional[float] = None,
+                  device=None) -> Dict[str, dict]:
+        """Join one phase's measured mean time against its static FLOPs
+        count, PER SHAPE CLASS: {shape_class: runtime_report dict} —
+        `cost_model_ratio` per (phase, shape class) instead of per
+        whole step.  Phases recorded without a shape class key under
+        "".  This is the table the per-generation autotuner reads:
+        measured time by shape class, calibrated against the static
+        model's prediction."""
+        from . import mfu as obs_mfu
+
+        by_cls: Dict[str, List[float]] = {}
+        for r in self.record_window():
+            untagged = r["phases"].get(phase, 0.0)
+            for (name, cls), s in r["classes"].items():
+                if name != phase:
+                    continue
+                by_cls.setdefault(cls, []).append(s)
+                untagged -= s
+            if phase in r["phases"] and untagged > 1e-12:
+                by_cls.setdefault("", []).append(untagged)
+        out = {}
+        for cls, vals in by_cls.items():
+            measured = sum(vals) / len(vals)
+            out[cls] = obs_mfu.runtime_report(
+                measured, flops, peak_flops=peak_flops, device=device)
+        return out
+
+    def register_gauges(self, registry: obs_metrics.Registry,
+                        prefix: str = "llm_step",
+                        phases=ENGINE_PHASES) -> "StepProfiler":
+        """Expose the windowed phase table on a Prometheus registry:
+        `<prefix>_seconds` (mean step time), `<prefix>_phase_seconds` /
+        `<prefix>_phase_share` per {phase=...} label.  Gauges read
+        lazily at scrape time — the step thread never pushes."""
+        registry.gauge(
+            f"{prefix}_seconds",
+            "mean engine step wall time over the profiler window"
+        ).set_function(lambda: (
+            (lambda recs: sum(r["total_s"] for r in recs) / len(recs)
+             if recs else 0.0)(self.record_window())))
+        for name in phases:
+            registry.gauge(
+                f"{prefix}_phase_seconds",
+                "mean SELF time of one step phase over the window",
+                labels={"phase": name}
+            ).set_function(lambda n=name: self.mean_s(n))
+            registry.gauge(
+                f"{prefix}_phase_share",
+                "phase share of total step time over the window "
+                "(self-time attribution: shares sum to ~1)",
+                labels={"phase": name}
+            ).set_function(lambda n=name: self.share(n))
+        return self

@@ -179,6 +179,23 @@ class Tracer:
         with self._lock:
             self._events.append(ev)
 
+    def counter(self, name: str, value) -> None:
+        """One sample on a Perfetto COUNTER track (`ph:"C"`).  `value`
+        is a number (series "value") or a {series: number} dict (the
+        viewer stacks multi-series counters on one track).  The engine
+        samples its pool/queue/batch gauges here every step, so a trace
+        shows free-pages collapsing UNDER the span that caused it —
+        counters and spans share the timeline.  No-op when disabled."""
+        if not self.enabled:
+            return
+        series = (value if isinstance(value, dict) else {"value": value})
+        series = {str(k): float(v) for k, v in series.items()}
+        t = time.perf_counter()
+        ev = SpanEvent(name, t, t, threading.get_ident(), self._step,
+                       series, ph="C")
+        with self._lock:
+            self._events.append(ev)
+
     def record(self, name: str, t0: float, t1: float,
                attrs: Optional[dict] = None) -> None:
         """Record an externally-timed span (profiler RecordEvent feeds
@@ -237,12 +254,14 @@ def _chrome_events(span_events, pid: int) -> List[dict]:
         else:
             tid, lane = int(e.tid % 2 ** 31), f"thread {e.tid}"
         lanes.setdefault(tid, lane)
-        ev = {"name": e.name, "ph": e.ph, "cat": "host",
+        ev = {"name": e.name, "ph": e.ph,
+              "cat": "counter" if e.ph == "C" else "host",
               "ts": e.t0 * 1e6, "pid": pid, "tid": tid}
         if e.ph == "X":
             ev["dur"] = (e.t1 - e.t0) * 1e6
-        else:
+        elif e.ph == "i":
             ev["s"] = "t"      # instant scope: thread
+        # ph "C": args IS the series dict — no dur, no scope
         if e.attrs:
             ev["args"] = dict(e.attrs)
         events.append(ev)

@@ -1,0 +1,282 @@
+"""Anomaly watchdog: rolling-baseline spike detection with phase blame.
+
+The SLO engine (obs.slo) answers "is the p95 where we promised"; the
+step profiler (obs.stepprof) answers "where does a step spend time".
+The watchdog closes the loop between them: it watches step time and
+inter-token latency against their own ROLLING BASELINE, and when a
+spike SUSTAINS, it attributes the regression to the phase(s) whose
+time grew and drops a `step_anomaly` black-box dump through the
+existing flight-recorder seam — so a 3am latency cliff leaves behind
+not just "steps got slow" but "steps got slow because `dispatch` went
+from 2.1ms to 19.8ms while everything else held".
+
+Baseline math (documented here because the dump carries its inputs):
+
+  * per metric ("step", "itl") keep a ring of the last
+    `baseline_window + recent_window` samples; the OLD part is the
+    baseline, the newest `recent_window` are the probe.
+  * spike condition: `median(recent) > threshold * median(baseline)`,
+    evaluated only once the baseline holds >= `min_baseline` samples
+    (medians, not means: one GC pause in either window must not arm
+    or mask the detector).
+  * a spike must hold for `sustain` consecutive evaluations before
+    firing — transient jitter never dumps.
+  * attribution: per phase, `delta = median(recent self time) -
+    median(baseline self time)` over the step-phase ring; phases are
+    ranked by delta and the guilty set is every phase carrying >= 25%
+    of the total positive delta (at least the top one).
+  * after firing, the detector holds off for `cooldown` observations
+    (the spike that fired would otherwise re-fire every step while it
+    drains into the baseline).
+
+The dump rides `FlightRecorder.dump("step_anomaly", extra=...)`: the
+standard black-box frame (spans, metrics render, request timelines,
+engine digest) plus an `extra` section carrying the metric, the
+baseline/recent medians, and the per-phase deltas with the guilty
+list.  Without an armed recorder the watchdog still counts
+(`llm_step_anomalies_total`) and marks the tracer ("step_anomaly"
+instant), so /metrics shows anomalies even on engines that never
+configured a dump directory.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, List, Optional
+
+from . import metrics as obs_metrics
+
+__all__ = ["Watchdog"]
+
+
+def _median(vals: List[float]) -> float:
+    return obs_metrics.percentile(vals, 0.5)
+
+
+class _Track:
+    """One watched metric's rings + sustain/cooldown state."""
+
+    __slots__ = ("samples", "phases", "sustained", "cooldown_left",
+                 "baseline_med", "stale")
+
+    def __init__(self, capacity: int, keep_phases: bool):
+        self.samples: collections.deque = collections.deque(
+            maxlen=capacity)
+        # parallel ring of per-step phase dicts (step track only)
+        self.phases: Optional[collections.deque] = (
+            collections.deque(maxlen=capacity) if keep_phases else None)
+        self.sustained = 0
+        self.cooldown_left = 0
+        # baseline median cache: the baseline shifts by ONE sample per
+        # observation, so its median is recomputed lazily every
+        # recent_window appends instead of sorting the whole ring per
+        # step (the hot-loop cost is then one 8-sample median)
+        self.baseline_med: Optional[float] = None
+        self.stale = 0
+
+
+class Watchdog:
+    """Rolling-baseline anomaly detector over step time and ITL.
+
+    The engine feeds it from the step loop: `observe_step(total_s,
+    phases, flight=...)` once per step (evaluates both tracks) and
+    `observe_itl(gap_s)` per inter-token gap (records only — ITL
+    spikes are evaluated at the next step boundary, where the flight
+    recorder reference is in hand).  Thread-safety: records take the
+    lock; evaluation runs on the step thread only."""
+
+    def __init__(self, baseline_window: int = 128,
+                 recent_window: int = 8, threshold: float = 3.0,
+                 min_baseline: int = 32, sustain: int = 3,
+                 cooldown: Optional[int] = None, enabled: bool = True):
+        if recent_window < 1 or baseline_window < 1:
+            raise ValueError("windows must be >= 1")
+        if threshold <= 1.0:
+            raise ValueError("threshold must be > 1 (a spike is a "
+                             "multiple of the baseline)")
+        self.enabled = bool(enabled)
+        self.baseline_window = int(baseline_window)
+        self.recent_window = int(recent_window)
+        self.threshold = float(threshold)
+        self.min_baseline = int(min_baseline)
+        self.sustain = int(sustain)
+        self.cooldown = (2 * self.recent_window if cooldown is None
+                         else int(cooldown))
+        cap = self.baseline_window + self.recent_window
+        self._tracks: Dict[str, _Track] = {
+            "step": _Track(cap, keep_phases=True),
+            "itl": _Track(cap, keep_phases=False),
+        }
+        self._lock = threading.Lock()
+        self.anomalies_total = 0
+        self.last_anomaly: Optional[dict] = None
+        self._tracer = None
+        self._counter = None
+
+    def bind(self, tracer=None, registry=None) -> "Watchdog":
+        """Attach the obs surfaces the watchdog marks on fire: a tracer
+        (one "step_anomaly" instant per fire) and a metrics registry
+        (`llm_step_anomalies_total` counter + `llm_watchdog_armed`
+        gauge)."""
+        if tracer is not None:
+            self._tracer = tracer
+        if registry is not None:
+            self._counter = registry.counter(
+                "llm_step_anomalies_total",
+                "sustained step-time/ITL spikes the watchdog attributed "
+                "and dumped")
+            registry.gauge(
+                "llm_watchdog_armed",
+                "1 while the anomaly watchdog has a full enough "
+                "baseline to fire").set_function(
+                lambda: float(self.armed()))
+        return self
+
+    # -- feeding ------------------------------------------------------------
+
+    def observe_itl(self, gap_s: float) -> None:
+        """Record one inter-token gap.  Evaluation happens at the next
+        observe_step (the step boundary owns the flight reference)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            t = self._tracks["itl"]
+            t.samples.append(float(gap_s))
+            t.stale += 1
+
+    def observe_step(self, total_s: float,
+                     phases: Optional[Dict[str, float]] = None,
+                     flight=None) -> Optional[dict]:
+        """Record one step and evaluate both tracks.  Returns the
+        anomaly dict when one fired this call (tests read it), else
+        None."""
+        if not self.enabled:
+            return None
+        tr = self._tracks["step"]
+        with self._lock:
+            tr.samples.append(float(total_s))
+            tr.phases.append(dict(phases or {}))
+            tr.stale += 1
+        fired = self._evaluate("step", flight)
+        if fired is None:
+            fired = self._evaluate("itl", flight)
+        return fired
+
+    # -- detection ----------------------------------------------------------
+
+    def _split(self, track: _Track):
+        samples = list(track.samples)
+        if len(samples) < self.min_baseline + self.recent_window:
+            return None, None
+        return (samples[:-self.recent_window],
+                samples[-self.recent_window:])
+
+    def armed(self, metric: str = "step") -> bool:
+        track = self._tracks[metric]
+        with self._lock:
+            baseline, _ = self._split(track)
+        return baseline is not None
+
+    def _evaluate(self, metric: str, flight) -> Optional[dict]:
+        track = self._tracks[metric]
+        with self._lock:
+            if track.cooldown_left > 0:
+                track.cooldown_left -= 1
+                return None
+            baseline, recent = self._split(track)
+            if baseline is None:
+                return None
+            if track.baseline_med is None \
+                    or track.stale >= self.recent_window:
+                track.baseline_med = _median(baseline)
+                track.stale = 0
+            base_med = track.baseline_med
+            rec_med = _median(recent)
+            spiking = (base_med > 0.0
+                       and rec_med > self.threshold * base_med)
+            if not spiking:
+                track.sustained = 0
+                return None
+            track.sustained += 1
+            if track.sustained < self.sustain:
+                return None
+            # firing: reset sustain, open the cooldown window
+            track.sustained = 0
+            track.cooldown_left = self.cooldown
+            deltas, guilty = self._attribute()
+            self.anomalies_total += 1
+            anomaly = {
+                "metric": metric,
+                "baseline_median_s": base_med,
+                "recent_median_s": rec_med,
+                "ratio": (rec_med / base_med) if base_med else None,
+                "threshold": self.threshold,
+                "baseline_n": len(baseline),
+                "recent_n": len(recent),
+                "phase_deltas_s": deltas,
+                "guilty_phases": guilty,
+            }
+            self.last_anomaly = anomaly
+        # side effects OUTSIDE the lock: the flight dump renders the
+        # registry, whose gauges may read back into this watchdog
+        if self._counter is not None:
+            self._counter.inc()
+        if self._tracer is not None:
+            self._tracer.instant("step_anomaly", metric=metric,
+                                 ratio=anomaly["ratio"],
+                                 guilty=",".join(guilty))
+        if flight is not None:
+            try:
+                flight.dump("step_anomaly", extra=anomaly)
+            except Exception:  # noqa: BLE001 — a recorder bug must not
+                pass           # fail the step loop
+        return anomaly
+
+    def _attribute(self) -> tuple:
+        """Per-phase blame over the step-phase ring: delta of medians
+        (recent - baseline) per phase; guilty = every phase carrying
+        >= 25% of the total positive delta, at least the top one.
+        Called under the lock."""
+        track = self._tracks["step"]
+        frames = list(track.phases)
+        if len(frames) < self.min_baseline + self.recent_window:
+            return {}, []
+        base_frames = frames[:-self.recent_window]
+        rec_frames = frames[-self.recent_window:]
+        names = set()
+        for f in base_frames + rec_frames:
+            names.update(f)
+        deltas: Dict[str, float] = {}
+        for name in names:
+            base = _median([f.get(name, 0.0) for f in base_frames])
+            rec = _median([f.get(name, 0.0) for f in rec_frames])
+            deltas[name] = rec - base
+        positive = sum(d for d in deltas.values() if d > 0.0)
+        ranked = sorted(deltas.items(), key=lambda kv: -kv[1])
+        guilty = [name for name, d in ranked
+                  if d > 0.0 and positive > 0.0 and d >= 0.25 * positive]
+        if not guilty and ranked and ranked[0][1] > 0.0:
+            guilty = [ranked[0][0]]
+        return deltas, guilty
+
+    # -- reading ------------------------------------------------------------
+
+    def report(self) -> dict:
+        """The `/stats` watchdog section: armed state, fire count, and
+        the last anomaly (None until one fires)."""
+        with self._lock:
+            step_n = len(self._tracks["step"].samples)
+            itl_n = len(self._tracks["itl"].samples)
+        armed = (self.enabled
+                 and step_n >= self.min_baseline + self.recent_window)
+        return {
+            "enabled": self.enabled,
+            "armed": armed,
+            "threshold": self.threshold,
+            "sustain": self.sustain,
+            "step_samples": step_n,
+            "itl_samples": itl_n,
+            "anomalies_total": self.anomalies_total,
+            "last_anomaly": self.last_anomaly,
+        }

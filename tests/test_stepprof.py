@@ -1,0 +1,579 @@
+"""Step-time & memory attribution (PR 14): the per-step phase profiler
+(obs.stepprof), KV-pool/scheduler memory telemetry + Perfetto counter
+tracks, the rolling-baseline anomaly watchdog (obs.watchdog) with its
+step_anomaly flight dump, the bench_diff regression gate, and the
+/metrics render-robustness satellite."""
+
+import importlib.util
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu import obs
+from paddle_tpu.obs import flight as obs_flight
+from paddle_tpu.obs import metrics as obs_metrics
+from paddle_tpu.obs import mfu as obs_mfu
+from paddle_tpu.obs import stepprof as obs_stepprof
+from paddle_tpu.obs import trace as obs_trace
+from paddle_tpu.inference import faults as F
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    path = os.path.join(_REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _scripted(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    kw.setdefault("prefill_chunk_tokens", 6)
+    kw.setdefault("block_q", 2)
+    return F.ScriptedEngine(**kw)
+
+
+# ---------------------------------------------------------------------------
+# step profiler
+# ---------------------------------------------------------------------------
+
+
+class TestStepProfiler:
+    def test_disabled_is_shared_noop(self):
+        prof = obs.StepProfiler(enabled=False)
+        s1, s2 = prof.step(), prof.phase("dispatch")
+        assert s1 is s2            # ONE shared no-op object
+        with prof.step() as st:
+            with prof.phase("dispatch") as ph:
+                ph.fence(None)
+        assert getattr(st, "record", None) is None
+        assert prof.record_window() == []
+
+    def test_phase_outside_step_records_nothing(self):
+        prof = obs.StepProfiler()
+        with prof.phase("dispatch"):
+            pass                   # no open frame: a valid no-op
+        assert prof.record_window() == []
+
+    def test_self_time_nesting_and_other(self):
+        prof = obs.StepProfiler()
+        with prof.step() as st:
+            with prof.phase("commit"):
+                time.sleep(0.010)
+                with prof.phase("verify"):
+                    time.sleep(0.010)
+            time.sleep(0.005)      # uncovered -> "other"
+        rec = st.record
+        # verify's time must NOT double-count inside commit (self-time
+        # attribution), and the un-phased tail lands in "other"
+        assert rec["phases"]["verify"] >= 0.008
+        assert 0.008 <= rec["phases"]["commit"] <= 0.018
+        assert rec["phases"]["other"] >= 0.003
+        assert rec["total_s"] >= 0.024
+        # shares over the window sum to ~1 because phases are disjoint
+        rep = prof.report()
+        assert sum(p["share"] for p in rep["phases"].values()) == \
+            pytest.approx(1.0, abs=1e-6)
+
+    def test_window_bounds_and_steps_total(self):
+        prof = obs.StepProfiler(window=4)
+        for _ in range(10):
+            with prof.step():
+                with prof.phase("dispatch"):
+                    pass
+        rep = prof.report()
+        assert rep["window"] == 4 and rep["steps_total"] == 10
+
+    def test_shape_class_and_cost_join(self):
+        prof = obs.StepProfiler()
+        for _ in range(5):
+            with prof.step():
+                with prof.phase("dispatch", shape_class="T16xS4"):
+                    time.sleep(0.002)
+        rep = prof.report()
+        assert "T16xS4" in rep["shape_classes"]["dispatch"]
+        # static model: 1e9 flops at 1e12 flop/s peak -> predicted 1ms;
+        # measured ~2ms -> cost_model_ratio ~2 per shape class
+        joined = prof.cost_join("dispatch", 1e9, peak_flops=1e12)
+        r = joined["T16xS4"]
+        assert r["predicted_step_s"] == pytest.approx(1e-3)
+        assert 1.0 < r["cost_model_ratio"] < 30.0
+
+    def test_phase_runtime_report_skips_unpriced_phases(self):
+        out = obs_mfu.phase_runtime_report(
+            {"dispatch": 2e-3, "schedule": 1e-3},
+            {"dispatch": 1e9, "sample": 1e6}, peak_flops=1e12)
+        assert set(out) == {"dispatch"}     # sample has no measured time
+        assert out["dispatch"]["cost_model_ratio"] == pytest.approx(2.0)
+
+    def test_register_gauges_render(self):
+        prof = obs.StepProfiler()
+        with prof.step():
+            with prof.phase("dispatch"):
+                time.sleep(0.001)
+        reg = obs.Registry()
+        prof.register_gauges(reg)
+        text = reg.render()
+        assert 'llm_step_phase_share{phase="dispatch"}' in text
+        assert 'llm_step_phase_seconds{phase="dispatch"}' in text
+        assert prof.share("dispatch") > 0.5
+
+
+# ---------------------------------------------------------------------------
+# engine integration: phases, pool telemetry, counter tracks
+# ---------------------------------------------------------------------------
+
+
+class TestEngineAttribution:
+    def test_stats_surface_carries_phases_pool_watchdog(self):
+        eng = _scripted()
+        eng.generate([[1, 2, 3], [4, 5]], max_new_tokens=4)
+        snap = eng.stats_snapshot()
+        phases = snap["step_phases"]["phases"]
+        assert {"schedule", "build_batch", "dispatch", "sample",
+                "commit"} <= set(phases)
+        assert sum(p["share"] for p in phases.values()) == \
+            pytest.approx(1.0, abs=1e-6)
+        pool = snap["pool"]
+        assert pool["free_pages"] == pool["pages_total"]   # quiesced
+        assert pool["used_high_watermark"] > 0
+        assert pool["free_low_watermark"] < pool["pages_total"]
+        assert snap["watchdog"]["enabled"] is True
+        json.dumps(snap)           # the whole /stats payload stays JSON
+        text = eng.metrics.render()
+        assert 'llm_step_phase_share{phase="dispatch"}' in text
+        assert "llm_pool_free_low_watermark" in text
+        assert "llm_pool_frag_ratio" in text
+
+    def test_swap_phase_and_page_counters(self):
+        # pool below the 2-slot worst case -> preemption + host swap
+        eng = _scripted(num_pages=5, preempt_mode="swap")
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, 97, 8).tolist() for _ in range(3)]
+        eng.generate(prompts, max_new_tokens=4)
+        snap = eng.stats_snapshot()
+        assert snap["preemptions"] > 0
+        assert snap["swap_out_pages"] > 0
+        assert snap["swap_in_pages"] > 0
+        assert "swap" in snap["step_phases"]["phases"]
+
+    def test_engine_emits_counter_tracks(self):
+        tr = obs.Tracer(enabled=True)
+        eng = _scripted(tracer=tr)
+        eng.generate([[1, 2, 3]], max_new_tokens=3)
+        counters = [e for e in tr.events() if e.ph == "C"]
+        names = {e.name for e in counters}
+        assert {"pool_pages", "sched"} <= names
+        pool = [e for e in counters if e.name == "pool_pages"]
+        assert {"free", "used", "frag_run"} <= set(pool[-1].attrs)
+        # quiesced: the last sample must read back to baseline — the
+        # telemetry-based leak check the chaos soaks rely on
+        assert pool[-1].attrs["free"] == eng.cache.num_pages - 1
+        assert pool[-1].attrs["used"] == 0
+
+    def test_check_telemetry_clean_and_detects_drift(self):
+        eng = _scripted()
+        eng.generate([[1, 2, 3]], max_new_tokens=2)
+        assert F.check_telemetry(eng) == []
+        rep = F.check_invariants(eng, probe=False)
+        assert rep["telemetry"]["ok"]
+        # now break a gauge: the cross-check must catch the drift and
+        # check_invariants must fail the schedule
+        eng.metrics.get("llm_free_pages").set_function(lambda: 999)
+        mism = F.check_telemetry(eng)
+        assert mism and "llm_free_pages" in mism[0]
+        with pytest.raises(F.InvariantViolation):
+            F.check_invariants(eng, probe=False)
+
+    def test_both_serve_paths_expose_attribution(self):
+        from paddle_tpu.inference.llm_engine import serve_llm
+        from paddle_tpu.inference.router import Router, serve_fleet
+
+        eng = _scripted()
+        srv, _ = serve_llm(eng, port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            body = json.dumps({"prompt": [1, 2, 3],
+                               "max_new_tokens": 2}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                base, data=body, method="POST"), timeout=30).read()
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=10).read())
+            assert "dispatch" in stats["step_phases"]["phases"]
+            assert "free_low_watermark" in stats["pool"]
+            metrics = urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode()
+            assert "llm_step_phase_share" in metrics
+            assert "llm_pool_used_pages" in metrics
+        finally:
+            srv.shutdown()
+
+        router = Router([_scripted()], threaded=True,
+                        health_interval=0.01)
+        srv, _ = serve_fleet(router, port=0)
+        try:
+            base = f"http://127.0.0.1:{srv.server_address[1]}"
+            body = json.dumps({"prompt": [1, 2, 3],
+                               "max_new_tokens": 2}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                base, data=body, method="POST"), timeout=30).read()
+            stats = json.loads(urllib.request.urlopen(
+                base + "/stats", timeout=10).read())
+            rep0 = stats["replicas"]["0"]
+            assert "dispatch" in rep0["step_phases"]["phases"]
+            assert "pool" in rep0
+            metrics = urllib.request.urlopen(
+                base + "/metrics", timeout=10).read().decode()
+            assert 'llm_step_phase_share' in metrics
+            assert 'replica="0"' in metrics
+            assert "fleet_free_pages_total" in metrics
+            # the concatenated fleet scrape must declare each family
+            # exactly once — a duplicate TYPE line makes Prometheus
+            # parsers reject the whole exposition
+            assert metrics.count(
+                "# TYPE obs_render_errors_total") == 1
+            assert 'obs_render_errors_total{replica="router"} 0' \
+                in metrics
+        finally:
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Perfetto counter tracks: export / merged export / load round-trip
+# ---------------------------------------------------------------------------
+
+
+class TestCounterTracks:
+    def test_counter_roundtrip_single_export(self, tmp_path):
+        tr = obs.Tracer(enabled=True)
+        tr.counter("pool_pages", {"free": 5.0, "used": 3.0})
+        tr.counter("queue_depth", 2)
+        with tr.span("decode_step"):
+            pass
+        path = str(tmp_path / "t.json")
+        tr.export_chrome(path)
+        evs = obs_trace.load_trace(path)
+        cs = [e for e in evs if e.get("ph") == "C"]
+        assert len(cs) == 2
+        by_name = {e["name"]: e for e in cs}
+        assert by_name["pool_pages"]["args"] == {"free": 5.0, "used": 3.0}
+        assert by_name["queue_depth"]["args"] == {"value": 2.0}
+        assert by_name["pool_pages"]["cat"] == "counter"
+        assert "dur" not in by_name["pool_pages"]
+        # counters never pollute the span summary
+        assert set(obs_trace.summarize(evs)) == {"decode_step"}
+
+    def test_merged_export_counters_per_replica(self, tmp_path):
+        trs = {}
+        for name, free in (("0", 7.0), ("1", 2.0)):
+            t = obs.Tracer(enabled=True)
+            t.counter("pool_pages", {"free": free})
+            trs[name] = t
+        path = str(tmp_path / "merged.json")
+        obs_trace.export_merged(trs, path)
+        evs = obs_trace.load_trace(path)
+        cs = [e for e in evs if e.get("ph") == "C"]
+        assert {e["pid"] for e in cs} == {1, 2}   # one track per replica
+        names = {e["pid"]: e["args"]["name"] for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        by_replica = {names[e["pid"]]: e["args"]["free"] for e in cs}
+        assert by_replica == {"replica 0": 7.0, "replica 1": 2.0}
+
+    def test_trace_summary_counters_table_and_json(self, tmp_path,
+                                                   capsys):
+        ts = _load_tool("trace_summary")
+        tr = obs.Tracer(enabled=True)
+        for v in (8.0, 3.0, 5.0):
+            tr.counter("pool_pages", {"free": v})
+        path = str(tmp_path / "c.json")
+        obs_trace.export_merged({"0": tr}, path)
+        assert ts.main(["--counters", path, "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        s = out["replica 0"]["pool_pages"]["free"]
+        assert (s["n"], s["min"], s["max"], s["last"]) == (3, 3.0, 8.0,
+                                                           5.0)
+        assert ts.main(["--counters", path]) == 0
+        table = capsys.readouterr().out
+        assert "pool_pages" in table and "free" in table
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+
+
+def _feed(wd, n, total, phases):
+    out = None
+    for _ in range(n):
+        out = wd.observe_step(total, phases) or out
+    return out
+
+
+class TestWatchdog:
+    def test_sustained_spike_fires_with_phase_blame(self):
+        wd = obs.Watchdog(baseline_window=32, recent_window=4,
+                          threshold=2.0, min_baseline=8, sustain=2,
+                          cooldown=6)
+        base = {"dispatch": 0.0008, "commit": 0.0002}
+        assert _feed(wd, 20, 0.001, base) is None
+        assert wd.armed()
+        spike = {"dispatch": 0.0195, "commit": 0.0002}
+        anomaly = _feed(wd, 10, 0.020, spike)
+        assert anomaly is not None
+        assert anomaly["metric"] == "step"
+        assert anomaly["guilty_phases"] == ["dispatch"]
+        assert anomaly["ratio"] > 2.0
+        assert anomaly["phase_deltas_s"]["dispatch"] > 0.01
+        assert abs(anomaly["phase_deltas_s"]["commit"]) < 1e-4
+        assert wd.anomalies_total >= 1
+        assert wd.report()["last_anomaly"]["guilty_phases"] == \
+            ["dispatch"]
+
+    def test_transient_spike_never_fires(self):
+        wd = obs.Watchdog(baseline_window=32, recent_window=4,
+                          threshold=2.0, min_baseline=8, sustain=3)
+        _feed(wd, 20, 0.001, {"dispatch": 0.001})
+        # one wild step inside an otherwise calm stream
+        assert wd.observe_step(0.050, {"dispatch": 0.050}) is None
+        assert _feed(wd, 10, 0.001, {"dispatch": 0.001}) is None
+        assert wd.anomalies_total == 0
+
+    def test_cooldown_blocks_refire(self):
+        wd = obs.Watchdog(baseline_window=32, recent_window=4,
+                          threshold=2.0, min_baseline=8, sustain=1,
+                          cooldown=50)
+        _feed(wd, 20, 0.001, {"dispatch": 0.001})
+        a = _feed(wd, 6, 0.02, {"dispatch": 0.02})
+        assert a is not None and wd.anomalies_total == 1
+        # still spiking, but inside the cooldown window: no second dump
+        assert _feed(wd, 10, 0.02, {"dispatch": 0.02}) is None
+        assert wd.anomalies_total == 1
+
+    def test_itl_track_spikes(self):
+        wd = obs.Watchdog(baseline_window=32, recent_window=4,
+                          threshold=2.0, min_baseline=8, sustain=1)
+        for _ in range(20):
+            wd.observe_itl(0.002)
+            wd.observe_step(0.001, {"dispatch": 0.001})
+        got = None
+        for _ in range(8):
+            wd.observe_itl(0.050)
+            got = wd.observe_step(0.001, {"dispatch": 0.001}) or got
+        assert got is not None and got["metric"] == "itl"
+
+    def test_watchdog_still_evaluates_with_profiler_disabled(
+            self, tmp_path):
+        # disabling the PROFILER must not silently starve the watchdog:
+        # the engine times the step itself; attribution degrades to an
+        # empty guilty list, the dump still fires
+        eng = _scripted(
+            max_seq_len=64,
+            stepprof=obs.StepProfiler(enabled=False),
+            watchdog=obs.Watchdog(baseline_window=32, recent_window=4,
+                                  threshold=2.5, min_baseline=12,
+                                  sustain=2))
+        obs_flight.FlightRecorder(dir=str(tmp_path),
+                                  name="np").attach_engine(eng)
+        eng.generate([[1, 2, 3]], max_new_tokens=20)
+        assert eng.watchdog.armed()
+        eng.faults = F.FaultInjector(
+            [F.FaultRule("decode", always=True, delay=0.05)])
+        eng.generate([[4, 5, 6]], max_new_tokens=30)
+        assert eng.watchdog.anomalies_total >= 1
+        assert any("step_anomaly" in p for p in os.listdir(str(tmp_path)))
+
+    def test_disabled_watchdog_costs_one_branch(self):
+        wd = obs.Watchdog(enabled=False)
+        assert wd.observe_step(5.0, {"dispatch": 5.0}) is None
+        wd.observe_itl(5.0)
+        assert wd.report()["armed"] is False
+
+    def test_registry_counter_binds(self):
+        reg = obs.Registry()
+        wd = obs.Watchdog(baseline_window=16, recent_window=2,
+                          threshold=2.0, min_baseline=4,
+                          sustain=1).bind(registry=reg)
+        _feed(wd, 10, 0.001, {"dispatch": 0.001})
+        _feed(wd, 4, 0.02, {"dispatch": 0.02})
+        text = reg.render()
+        assert "llm_step_anomalies_total 1" in text
+        assert "llm_watchdog_armed 1" in text
+
+    def test_engine_decode_delay_fires_loadable_step_anomaly_dump(
+            self, tmp_path):
+        """THE acceptance test: a fault-injected delay on the decode
+        dispatch induces a deterministic step-time spike; the watchdog
+        must fire a LOADABLE step_anomaly flight dump naming the guilty
+        phase (dispatch)."""
+        eng = _scripted(
+            max_seq_len=64,
+            watchdog=obs.Watchdog(baseline_window=32, recent_window=4,
+                                  threshold=2.5, min_baseline=12,
+                                  sustain=2, cooldown=6))
+        rec = obs_flight.FlightRecorder(dir=str(tmp_path), name="wd")
+        rec.attach_engine(eng)
+        # phase 1: fault-free baseline — arm the watchdog
+        eng.generate([[1, 2, 3]], max_new_tokens=20)
+        assert eng.watchdog.armed()
+        # phase 2: every ragged dispatch now stalls 50ms (a slow, not
+        # broken, replica) — a sustained spike the baseline never saw.
+        # Both tracks legitimately spike (ITL ~= step time here), so
+        # the assertions scan ALL dumps for the step-metric verdict.
+        eng.faults = F.FaultInjector(
+            [F.FaultRule("decode", always=True, delay=0.05)])
+        eng.generate([[4, 5, 6]], max_new_tokens=30)
+        assert eng.watchdog.anomalies_total >= 1
+        dumps = sorted(p for p in os.listdir(str(tmp_path))
+                       if "step_anomaly" in p)
+        assert dumps, "watchdog fired but left no step_anomaly dump"
+        loaded = [obs_flight.load_dump(os.path.join(str(tmp_path), p))
+                  for p in dumps]
+        assert all(d["reason"] == "step_anomaly" for d in loaded)
+        step_dumps = [d for d in loaded
+                      if d["extra"]["metric"] == "step"]
+        assert step_dumps, \
+            f"no step-metric dump among {[d['extra'] for d in loaded]}"
+        d = step_dumps[0]
+        assert "dispatch" in d["extra"]["guilty_phases"]
+        assert d["extra"]["ratio"] > 2.5
+        assert d["extra"]["phase_deltas_s"]["dispatch"] > 0.02
+        # the dump is a full black box, not just the verdict
+        assert d["metrics"] and d["engine"]["replica"] == "engine"
+        # and the engine still serves cleanly afterwards
+        eng.faults = None
+        F.check_invariants(eng)
+
+
+# ---------------------------------------------------------------------------
+# bench_diff
+# ---------------------------------------------------------------------------
+
+
+class TestBenchDiff:
+    def test_shipped_snapshots_no_regression(self, capsys):
+        bd = _load_tool("bench_diff")
+        old = os.path.join(_REPO, "BENCH_r02.json")
+        new = os.path.join(_REPO, "BENCH_r05.json")
+        rc = bd.main([old, new, "--metrics", "value,extra.mfu"])
+        capsys.readouterr()
+        assert rc == 0             # r02 -> r05 improved the headline
+
+    def test_synthetic_regression_fails_ci(self, tmp_path, capsys):
+        bd = _load_tool("bench_diff")
+        new = os.path.join(_REPO, "BENCH_r05.json")
+        with open(new) as f:
+            snap = json.load(f)
+        snap["parsed"]["value"] *= 0.8        # -20% throughput
+        bad = str(tmp_path / "bad.json")
+        with open(bad, "w") as f:
+            json.dump(snap, f)
+        rc = bd.main([new, bad, "--metrics", "value", "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 1
+        assert [r["metric"] for r in out["regressions"]] == ["value"]
+        # the same drop is fine under a generous per-metric rule
+        assert bd.main([new, bad, "--metrics", "value",
+                        "--rule", "value=0.5"]) == 0
+
+    def test_direction_classification(self):
+        bd = _load_tool("bench_diff")
+        assert bd.classify("value") == "higher"
+        assert bd.classify("extra.mfu") == "higher"
+        # throughputs stay higher-better despite the "_s"-ish tail — a
+        # substring match here would INVERT the CI gate for them
+        assert bd.classify("extra.decode.decode_tokens_per_sec") == \
+            "higher"
+        assert bd.classify("extra.specdec.repetitive.spec."
+                           "tokens_per_sec") == "higher"
+        assert bd.classify("extra.decode.baseline_first_token_s") == \
+            "lower"
+        assert bd.classify("extra.ragged.itl_chunked_p99_ms") == "lower"
+        assert bd.classify("extra.obs_overhead.overhead_pct") == "lower"
+        assert bd.classify("extra.graphlint_mem_peak_bytes.llama") == \
+            "lower"
+        assert bd.classify("extra.batch") == "skip"
+        assert bd.classify("extra.specdec.workload.streams") == "skip"
+        assert bd.classify("extra.cost_model_ratio") == "skip"
+        # the attribution leaves this PR adds to bench output: shares
+        # are zero-sum (not orderable), anomaly counts lower-better
+        assert bd.classify(
+            "extra.obs_overhead.phase_shares.dispatch") == "skip"
+        assert bd.classify(
+            "extra.obs_overhead.watchdog_anomalies") == "lower"
+
+    def test_lower_better_regression_detected(self):
+        bd = _load_tool("bench_diff")
+        old = {"value": 100.0, "extra": {"itl_p50_ms": 2.0}}
+        new = {"value": 100.0, "extra": {"itl_p50_ms": 2.4}}
+        rep = bd.diff(old, new, threshold=0.05)
+        assert [r["metric"] for r in rep["regressions"]] == \
+            ["extra.itl_p50_ms"]
+        # and the reverse direction is an improvement, not a regression
+        rep = bd.diff(new, old, threshold=0.05)
+        assert not rep["regressions"] and rep["improvements"]
+
+    def test_missing_metric_surfaced(self, tmp_path):
+        bd = _load_tool("bench_diff")
+        old = {"value": 10.0, "extra": {"mfu": 0.5}}
+        new = {"value": 10.0}
+        rep = bd.diff(old, new)
+        assert rep["missing_in_new"] == ["extra.mfu"]
+
+
+# ---------------------------------------------------------------------------
+# /metrics render robustness
+# ---------------------------------------------------------------------------
+
+
+class TestRenderRobustness:
+    def test_bad_gauge_callback_skipped_not_fatal(self):
+        reg = obs.Registry()
+        reg.counter("good_total", "fine").inc(3)
+        reg.gauge("bad_gauge", "raises").set_function(
+            lambda: 1 // 0)
+        text = reg.render()
+        assert "good_total 3" in text
+        assert "bad_gauge" not in text.replace(
+            "obs_render_errors_total", "")
+        assert "obs_render_errors_total 1" in text
+        # errors accumulate per render — a rate() over them alarms
+        text = reg.render()
+        assert "obs_render_errors_total 2" in text
+        assert reg.render_errors_total == 2
+
+    def test_value_still_degrades_to_nan_for_scorers(self):
+        # the router's placement score reads .value and treats NaN as
+        # stale-but-placeable; that contract survives the render change
+        import math
+        g = obs.Registry().gauge("g").set_function(lambda: 1 // 0)
+        assert math.isnan(g.value)
+
+    def test_render_merged_survives_one_bad_replica(self):
+        good, bad = obs.Registry(), obs.Registry()
+        good.gauge("llm_free_pages").set(7)
+        bad.gauge("llm_free_pages").set_function(lambda: 1 // 0)
+        bad.counter("llm_accepted_total").inc(2)
+        text = obs_metrics.render_merged({"0": good, "1": bad})
+        assert 'llm_free_pages{replica="0"} 7' in text
+        assert 'llm_accepted_total{replica="1"} 2' in text
+        assert 'llm_free_pages{replica="1"}' not in text
+        assert 'obs_render_errors_total{replica="0"} 0' in text
+        assert 'obs_render_errors_total{replica="1"} 1' in text
+
+    def test_engine_scrape_survives_poisoned_gauge(self):
+        eng = _scripted()
+        eng.generate([[1, 2]], max_new_tokens=2)
+        eng.metrics.gauge("llm_custom_probe").set_function(
+            lambda: (_ for _ in ()).throw(RuntimeError("dead")))
+        text = eng.metrics.render()     # must not raise
+        assert "llm_accepted_total" in text
+        assert "obs_render_errors_total 1" in text

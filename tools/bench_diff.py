@@ -1,0 +1,232 @@
+#!/usr/bin/env python
+"""bench_diff — CI regression gate between two BENCH snapshots.
+
+The repo accumulates one `BENCH_r*.json` per round (the driver saves
+`python bench.py`'s one-line JSON under `parsed`), but until now nothing
+DIFFED them — a 10% ITL regression only surfaced if a human eyeballed
+two blobs.  This tool compares every numeric metric two snapshots
+share, classifies each as higher-better (throughput, MFU, speedups) or
+lower-better (latencies, overheads, bytes, recompiles), and fails with
+a CI-able exit code when any metric regressed past its threshold.
+
+Usage:
+  python tools/bench_diff.py OLD.json NEW.json
+          [--threshold 0.05]            # default regression tolerance
+          [--rule PATH=FRAC ...]        # per-metric override, e.g.
+                                        #   --rule extra.mfu=0.02
+          [--metrics GLOB[,GLOB...]]    # only compare matching paths
+          [--json]                      # machine-readable report
+
+Inputs may be driver snapshots ({"parsed": {...}}) or bare bench lines
+({"metric": ..., "value": ..., "extra": {...}}).  Metric paths are
+dot-joined ("value", "extra.mfu", "extra.ragged.itl_chunked_p99_ms").
+Config-shaped leaves (batch/seq/steps/trial counts...) are ignored:
+they describe the workload, not its performance.
+
+Exit codes: 0 = no regression, 1 = regression(s) past threshold,
+2 = unusable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+
+# config-shaped leaf names: equality is not a goal, so never diff them
+_SKIP_LEAVES = {
+    "batch", "seq", "steps", "n", "trials", "model_params", "vocab",
+    "page_size", "spec_k", "num_pages", "streams", "new_tokens",
+    "prompt", "prompt_len", "requests", "schedules", "replicas", "seed",
+    "count", "window", "bound_pct", "failover_trials", "block_q",
+    "chunk", "hops", "num_slots", "max_seq", "quantile", "target_s",
+    # measured/predicted step time: 1.0 is best, so neither direction
+    # is a regression — not diffable as a scalar ordering
+    "cost_model_ratio",
+}
+
+# time/size units marking a LOWER-is-better metric — matched as leaf
+# SUFFIXES only ("decode_tokens_per_sec" must NOT match "_s")
+_LOWER_SUFFIXES = ("_ms", "_s", "_us", "_ns", "_bytes", "_pct")
+# whole-word-ish markers, safe as substrings of the leaf
+_LOWER_SUBSTR = (
+    "seconds", "latency", "overhead", "recompile", "loss", "itl",
+    "ttft", "violations", "dropped", "failed", "errors", "frag",
+    "preemptions", "anomal",
+)
+
+
+def classify(path: str) -> str:
+    """'higher' | 'lower' | 'skip' for one dot-joined metric path."""
+    leaf = path.rsplit(".", 1)[-1]
+    dotted = f".{path}."
+    if leaf in _SKIP_LEAVES or ".workload." in dotted \
+            or ".schedule." in dotted or ".phase_shares." in dotted:
+        # phase SHARES are zero-sum fractions: one phase speeding up
+        # shifts every other share — not orderable as better/worse
+        return "skip"
+    # throughputs are higher-better NO MATTER what unit suffix they
+    # carry ("tokens_per_sec" ends in neither _s nor _sec by suffix
+    # matching, but be explicit — an inverted gate passes regressions)
+    if "per_sec" in leaf or "throughput" in leaf:
+        return "higher"
+    if leaf.endswith(_LOWER_SUFFIXES):
+        return "lower"
+    for sub in _LOWER_SUBSTR:
+        if sub in leaf:
+            return "lower"
+    # containers whose CHILDREN are the metrics (mem-peak tables keyed
+    # by model name, latency tables keyed by percentile)
+    for sub in ("bytes", "mem_peak", "latency", "overhead"):
+        if sub in path:
+            return "lower"
+    return "higher"
+
+
+def flatten(d, prefix: str = "") -> dict:
+    """Numeric leaves of a nested dict as {dot.path: float}.  Bools,
+    strings, lists, and nulls are not metrics."""
+    out = {}
+    if not isinstance(d, dict):
+        return out
+    for k, v in d.items():
+        path = f"{prefix}.{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(flatten(v, path))
+        elif isinstance(v, bool) or v is None:
+            continue
+        elif isinstance(v, (int, float)):
+            out[path] = float(v)
+    return out
+
+
+def load_bench(path: str) -> dict:
+    """One snapshot's metric dict: the driver envelope's `parsed`, or
+    the bare bench line itself."""
+    with open(path) as f:
+        d = json.load(f)
+    if isinstance(d, dict) and isinstance(d.get("parsed"), dict):
+        d = d["parsed"]
+    if not isinstance(d, dict):
+        raise ValueError(f"{path!r} is not a bench snapshot")
+    return d
+
+
+def diff(old: dict, new: dict, threshold: float = 0.05,
+         rules: dict = None, metrics=None) -> dict:
+    """Compare two flattened-able bench dicts.  Returns {compared,
+    regressions, improvements, skipped, missing} where `regressions`
+    is the CI verdict list."""
+    rules = rules or {}
+    fo, fn = flatten(old), flatten(new)
+    compared, regressions, improvements, skipped = [], [], [], []
+    for path in sorted(set(fo) & set(fn)):
+        if metrics and not any(fnmatch.fnmatch(path, g) for g in metrics):
+            continue
+        direction = classify(path)
+        if direction == "skip":
+            skipped.append(path)
+            continue
+        ov, nv = fo[path], fn[path]
+        if ov == 0.0:
+            skipped.append(path)    # no ratio against a zero baseline
+            continue
+        change = (nv - ov) / abs(ov)
+        thr = rules.get(path, threshold)
+        worse = (change < -thr) if direction == "higher" \
+            else (change > thr)
+        row = {"metric": path, "old": ov, "new": nv,
+               "change_pct": round(change * 100, 2),
+               "direction": direction, "threshold_pct": thr * 100}
+        compared.append(row)
+        if worse:
+            regressions.append(row)
+        elif (change > thr) if direction == "higher" else (change < -thr):
+            improvements.append(row)
+    missing = sorted((set(fo) - set(fn)))
+    if metrics:
+        missing = [p for p in missing
+                   if any(fnmatch.fnmatch(p, g) for g in metrics)]
+    missing = [p for p in missing if classify(p) != "skip"]
+    return {"compared": compared, "regressions": regressions,
+            "improvements": improvements, "skipped": skipped,
+            "missing_in_new": missing}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="regression gate between two BENCH snapshots")
+    ap.add_argument("old", metavar="OLD.json")
+    ap.add_argument("new", metavar="NEW.json")
+    ap.add_argument("--threshold", type=float, default=0.05,
+                    help="default regression tolerance as a fraction "
+                         "(0.05 = 5%%)")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="PATH=FRAC",
+                    help="per-metric threshold override (repeatable)")
+    ap.add_argument("--metrics", default=None, metavar="GLOBS",
+                    help="comma-separated path globs to compare "
+                         "(default: everything classifiable)")
+    ap.add_argument("--fail-on-missing", action="store_true",
+                    help="also exit 1 when a metric in OLD is absent "
+                         "from NEW (a silently dropped benchmark)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    rules = {}
+    for spec in args.rule:
+        try:
+            path, frac = spec.split("=", 1)
+            rules[path] = float(frac)
+        except ValueError:
+            print(f"bad --rule {spec!r} (want PATH=FRACTION)",
+                  file=sys.stderr)
+            return 2
+    metrics = ([g.strip() for g in args.metrics.split(",") if g.strip()]
+               if args.metrics else None)
+
+    try:
+        old, new = load_bench(args.old), load_bench(args.new)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"cannot load snapshots: {e!r}", file=sys.stderr)
+        return 2
+
+    report = diff(old, new, threshold=args.threshold, rules=rules,
+                  metrics=metrics)
+    failed = bool(report["regressions"]) or \
+        (args.fail_on_missing and report["missing_in_new"])
+
+    if args.as_json:
+        report["ok"] = not failed
+        print(json.dumps(report, sort_keys=True))
+        return 1 if failed else 0
+
+    if report["compared"]:
+        print(f"{'metric':44}  {'old':>12}  {'new':>12}  {'change':>8}  "
+              f"verdict")
+        for row in report["compared"]:
+            if row in report["regressions"]:
+                verdict = "REGRESSED"
+            elif row in report["improvements"]:
+                verdict = "improved"
+            else:
+                verdict = "ok"
+            arrow = "v" if row["direction"] == "lower" else "^"
+            print(f"{row['metric'][:44]:44}  {row['old']:>12.4g}  "
+                  f"{row['new']:>12.4g}  {row['change_pct']:>7.2f}%  "
+                  f"{verdict} ({arrow} better"
+                  f"{'' if row['threshold_pct'] == args.threshold * 100 else ', thr %.1f%%' % row['threshold_pct']})")
+    else:
+        print("no comparable metrics between the two snapshots")
+    if report["missing_in_new"]:
+        print(f"missing in NEW: {', '.join(report['missing_in_new'][:20])}"
+              + (" ..." if len(report["missing_in_new"]) > 20 else ""))
+    print(f"{len(report['compared'])} compared, "
+          f"{len(report['regressions'])} regressed, "
+          f"{len(report['improvements'])} improved")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -243,8 +243,21 @@ def main():
             line += f" violations={report['violations']}"
         print(line)
 
+    # end-of-soak telemetry verdict: per live replica, the pool/slot
+    # gauges must have read back to baseline at quiescence and agreed
+    # with faults.check_invariants (mismatches already fail the soak as
+    # violations; this makes the gauge-based leak detector visible)
+    telemetry_checked = sum(1 for r in reports if "telemetry" in r)
+    telemetry_bad = sum(1 for r in reports
+                        if r.get("telemetry")
+                        and not r["telemetry"]["ok"])
+    print(f"telemetry: replica gauges agreed with the invariant checker "
+          f"in {telemetry_checked - telemetry_bad}/{telemetry_checked} "
+          f"checked schedule(s)")
+
     summary = {"schedules": args.schedules, "replicas": args.replicas,
-               "violations": violations, **totals}
+               "violations": violations,
+               "telemetry_mismatches": telemetry_bad, **totals}
     if args.json:
         print(json.dumps({"summary": summary, "reports": reports},
                          indent=2, default=str))

@@ -174,8 +174,20 @@ def main():
         print(f"flight recorder: {len(paths)} dump(s), "
               f"{flight_bad} unloadable, {flight_missing} missing")
 
+    # end-of-soak telemetry verdict: at every schedule's quiescence the
+    # pool/slot GAUGES must have read back to baseline and agreed with
+    # faults.check_invariants' direct allocator checks (a mismatch is
+    # already a violation — this line makes the cross-check visible)
+    telemetry_checked = sum(1 for r in reports if "telemetry" in r)
+    telemetry_bad = sum(1 for r in reports
+                        if r.get("telemetry")
+                        and not r["telemetry"]["ok"])
+    print(f"telemetry: gauges agreed with the invariant checker in "
+          f"{telemetry_checked - telemetry_bad}/{telemetry_checked} "
+          f"checked schedule(s)")
+
     summary = {"schedules": args.schedules, "violations": violations,
-               **totals}
+               "telemetry_mismatches": telemetry_bad, **totals}
     if args.json:
         print(json.dumps({"summary": summary, "reports": reports},
                          indent=2, default=str))

@@ -13,12 +13,15 @@ process track per replica plus request flow events) — and prints:
   * `--requests`: the per-request breakdown from the request lifecycle
     events a merged export embeds (id, hop count, replicas visited,
     event count, wall duration);
-  * `--request ID`: one request's full timeline, event by event.
+  * `--request ID`: one request's full timeline, event by event;
+  * `--counters`: the counter-track table (`ph:"C"` events the engine
+    emits for its pool/queue/batch gauges): min / max / last / samples
+    per counter series per replica.
 
 Usage:
   python tools/trace_summary.py TRACE.json [MORE.json ...]
           [--unit ms|us|s] [--json] [--top N]
-          [--by-replica] [--requests] [--request ID]
+          [--by-replica] [--requests] [--request ID] [--counters]
 
 --json emits the chosen aggregate as one machine-readable object."""
 
@@ -97,6 +100,34 @@ def _requests_index(events, names):
     return out
 
 
+def counters_index(events, names):
+    """Counter-track aggregate over `ph:"C"` events: {replica: {counter:
+    {series: {n, min, max, last}}}}.  `last` follows the latest ts, so
+    "did free_pages read back to baseline by trace end" is one lookup."""
+    out = {}
+    last_ts = {}
+    for e in events:
+        if e.get("ph") != "C":
+            continue
+        track = names.get(e.get("_track"), str(e.get("pid")))
+        for series, v in (e.get("args") or {}).items():
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            key = (track, e["name"], series)
+            s = out.setdefault(track, {}).setdefault(
+                e["name"], {}).setdefault(
+                series, {"n": 0, "min": v, "max": v, "last": v})
+            s["n"] += 1
+            s["min"] = min(s["min"], v)
+            s["max"] = max(s["max"], v)
+            if e["ts"] >= last_ts.get(key, float("-inf")):
+                s["last"] = v
+                last_ts[key] = e["ts"]
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="span/replica/request summary of exported traces")
@@ -115,11 +146,35 @@ def main(argv=None) -> int:
                     help="per-request breakdown (merged fleet traces)")
     ap.add_argument("--request", default=None, metavar="ID",
                     help="print one request's full timeline")
+    ap.add_argument("--counters", action="store_true", dest="by_counter",
+                    help="counter-track table (min/max/last per counter "
+                         "series per replica)")
     args = ap.parse_args(argv)
 
     from paddle_tpu.obs import trace as obs_trace
 
     events, names = _load_many(args.traces)
+
+    if args.by_counter:
+        idx = counters_index(events, names)
+        if args.as_json:
+            print(json.dumps(idx, sort_keys=True))
+            return 0
+        if not idx:
+            print("no counter events in trace (the engine emits ph:\"C\" "
+                  "samples for its pool/queue/batch gauges each step "
+                  "while its tracer is enabled)")
+            return 0
+        print(f"{'replica':>12}  {'counter':22}  {'series':10}  "
+              f"{'n':>6}  {'min':>10}  {'max':>10}  {'last':>10}")
+        for track in sorted(idx):
+            for counter in sorted(idx[track]):
+                for series, s in sorted(idx[track][counter].items()):
+                    print(f"{track[:12]:>12}  {counter[:22]:22}  "
+                          f"{series[:10]:10}  {s['n']:>6}  "
+                          f"{s['min']:>10g}  {s['max']:>10g}  "
+                          f"{s['last']:>10g}")
+        return 0
 
     if args.request is not None or args.by_request:
         reqs = _requests_index(events, names)
