@@ -572,6 +572,117 @@ def bench_specdec(dev, on_tpu):
     return out
 
 
+def bench_prefix_reuse(dev, on_tpu):
+    """extra.prefix_reuse: cross-user prefix caching A/B — TTFT at 0% /
+    50% / 95% prefix-hit mixes over a SHARED long system prompt (>= 75%
+    of each prompt's length), plus hit rate and the fraction of prefill
+    pages served by splicing instead of compute.
+
+    A hit admission splices the cached prefix's pages into the slot's
+    page table (no dispatch) and chunk-prefills only the unshared
+    suffix, so TTFT at a 95% hit mix should be <= 0.5x the 0%-mix
+    baseline and per-request prefill work should scale with the suffix
+    alone.  Requests run one at a time so TTFT isolates admission +
+    prefill, not queueing."""
+    import time as _time
+    import jax as _jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            dtype=jnp.bfloat16, remat=False)
+        shared_len, suffix_len, page_size, chunk, max_seq, n_req = \
+            1536, 512, 64, 256, 4096, 12
+    else:
+        cfg = LlamaConfig.tiny()
+        # shared 24 of 32 tokens = 75%; chunk 8 -> a cold prefill takes
+        # 4 chunked steps, a full hit exactly 1
+        shared_len, suffix_len, page_size, chunk, max_seq, n_req = \
+            24, 8, 4, 8, 64, 12
+
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(4))
+    rng = np.random.default_rng(0)
+    shared = rng.integers(0, cfg.vocab_size, shared_len).tolist()
+
+    def run(mix_pct):
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=page_size,
+                        max_seq_len=max_seq, prefill_chunk_tokens=chunk,
+                        block_q=4)
+        eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the executable
+        if mix_pct:
+            # seed the cache once (untimed): the fleet-scale analog is
+            # the FIRST user of a system prompt paying the cold prefill
+            h = eng.submit(
+                shared + rng.integers(0, cfg.vocab_size,
+                                      suffix_len).tolist(), 2)
+            while not h.done():
+                eng.step()
+        base = eng.stats_snapshot()
+        n_hit = round(n_req * mix_pct / 100)
+        flags = np.zeros(n_req, bool)
+        flags[:n_hit] = True
+        rng.shuffle(flags)
+        ttfts = []
+        for hit in flags:
+            head = shared if hit else \
+                rng.integers(0, cfg.vocab_size, shared_len).tolist()
+            prompt = head + rng.integers(0, cfg.vocab_size,
+                                         suffix_len).tolist()
+            h = eng.submit(prompt, max_new_tokens=2)
+            while not h.done():
+                eng.step()
+            ttfts.append(h.t_first_token - h.t_submit)
+        snap = eng.stats_snapshot()
+        eng.shutdown()
+        spliced = snap["prefix_spliced_pages"] - base["prefix_spliced_pages"]
+        prefilled = -(-(snap["prefill_tokens"] - base["prefill_tokens"])
+                      // page_size)
+        lookups = (snap["prefix_hits"] + snap["prefix_misses"]
+                   - base["prefix_hits"] - base["prefix_misses"])
+        return {
+            "mix": mix_pct,
+            "ttft_p50_ms": round(float(np.median(ttfts)) * 1e3, 3),
+            "ttft_mean_ms": round(float(np.mean(ttfts)) * 1e3, 3),
+            "hit_rate": round((snap["prefix_hits"] - base["prefix_hits"])
+                              / lookups, 4) if lookups else 0.0,
+            "spliced_page_fraction": round(
+                spliced / (spliced + prefilled), 4)
+            if spliced + prefilled else 0.0,
+            "prefill_tokens_mean": round(
+                (snap["prefill_tokens"] - base["prefill_tokens"])
+                / n_req, 2),
+            "cow_copies": snap["prefix_cow_copies"]
+                          - base["prefix_cow_copies"],
+        }
+
+    mixes = {f"mix_{m}": run(m) for m in (0, 50, 95)}
+    cold, hot = mixes["mix_0"], mixes["mix_95"]
+    return {
+        "workload": {"shared_prefix": shared_len, "suffix": suffix_len,
+                     "prompt": shared_len + suffix_len,
+                     "requests": n_req,
+                     "shared_fraction": round(
+                         shared_len / (shared_len + suffix_len), 3)},
+        **mixes,
+        # the acceptance gate: a 95%-hit mix's median TTFT vs the 0%-hit
+        # baseline (bound <= 0.5 for a >= 75%-shared prompt)
+        "ttft_hit95_vs_cold": (round(hot["ttft_p50_ms"]
+                                     / cold["ttft_p50_ms"], 3)
+                               if cold["ttft_p50_ms"] else None),
+        # chunked-prefill work must scale with the SUFFIX only: tokens
+        # actually prefilled per request at 95% hits vs cold
+        "prefill_tokens_hit95_vs_cold": (
+            round(hot["prefill_tokens_mean"]
+                  / cold["prefill_tokens_mean"], 3)
+            if cold["prefill_tokens_mean"] else None),
+    }
+
+
 def bench_obs_overhead(dev, on_tpu):
     """extra.obs_overhead: what leaving the FULL observability layer on
     costs the decode hot path — span tracer enabled, per-request
@@ -865,6 +976,7 @@ def _sub_main(name: str) -> None:
     on_tpu = dev.platform == "tpu"
     fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode,
           "ragged": bench_ragged, "specdec": bench_specdec,
+          "prefix_reuse": bench_prefix_reuse,
           "obs_overhead": bench_obs_overhead}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
@@ -955,6 +1067,7 @@ def main():
     decode_extra = _run_sub("decode")
     ragged_extra = _run_sub("ragged")
     specdec_extra = _run_sub("specdec")
+    prefix_extra = _run_sub("prefix_reuse")
     obs_overhead_extra = _run_sub("obs_overhead")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
@@ -1005,6 +1118,10 @@ def main():
             # spans vs plain decode): emitted tokens/sec speedup +
             # acceptance rate on repetitive and adversarial workloads
             "specdec": specdec_extra,
+            # cross-user prefix reuse A/B: TTFT at 0/50/95% hit mixes
+            # over a shared system prompt + spliced-page fraction (the
+            # page-table-splice admission vs cold chunked prefill)
+            "prefix_reuse": prefix_extra,
             # observability-layer cost: decode ITL with full request
             # tracing (span tracer + per-request timelines + SLO) on vs
             # off — pinned < 2% so the layer stays on in soak runs

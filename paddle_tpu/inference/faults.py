@@ -266,6 +266,9 @@ def check_telemetry(engine) -> List[str]:
         "llm_queue_depth": len(engine._pending),
         "llm_slots_in_flight": len(engine._slots),
     }
+    idx = getattr(engine, "prefix_index", None)
+    if idx is not None:
+        expect["llm_prefix_cached_pages"] = idx.cached_pages
     mismatches = []
     for name, truth in expect.items():
         g = reg.get(name)
@@ -289,8 +292,17 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
 
       * zero leaked slots: no in-flight slots, no pending requests, every
         decode slot back in the free list;
-      * zero leaked pages: free pages + slot-held pages are EXACTLY pages
-        1..num_pages-1, each once (page 0 reserved, never allocated);
+      * zero leaked pages: free pages + slot-held pages + prefix-index
+        pages are EXACTLY pages 1..num_pages-1, no page both free and
+        referenced (page 0 reserved, never allocated);
+      * refcount proofs: every allocated page's refcount equals its
+        page-table occupancy (slot-list appearances + index references);
+        no page sits in the free pool while its refcount is nonzero, and
+        no refcount survives without a holder — so a shared page can
+        never be freed out from under a co-holder, and a cached prefix
+        can never point at a recycled page (the "no prefix survives pool
+        deallocation" guarantee: pool recovery clears the index, and any
+        stale reference would trip this identity);
       * pools live: the k/v buffers were not donated away and lost;
       * every submitted handle resolved exactly once;
       * metrics registry consistency: every accepted request landed in
@@ -313,11 +325,41 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
     if cache._slot_pages:
         violations.append(
             f"slot page lists not reclaimed: {dict(cache._slot_pages)}")
-    pages = sorted(cache._free_pages + held)
+    idx = getattr(engine, "prefix_index", None)
+    idx_refs = {} if idx is None else dict(idx.page_refs())
+    # page accounting under sharing: every allocatable page is either
+    # free or referenced (never both, never neither), and a shared page
+    # appears once per holder in the refcount identity below
+    referenced = set(held) | set(idx_refs)
+    free_set = set(cache._free_pages)
+    if len(cache._free_pages) != len(free_set):
+        violations.append(
+            f"free list holds duplicates (double-free): "
+            f"{sorted(cache._free_pages)}")
+    both = free_set & referenced
+    if both:
+        violations.append(
+            f"pages {sorted(both)} are in the free pool AND referenced "
+            "(freed while refcount > 0 — a co-holder's KV can be "
+            "recycled under it)")
+    pages = sorted(free_set | referenced)
     if pages != list(range(1, cache.num_pages)):
         violations.append(
-            f"page accounting broken: free+held={pages} != "
+            f"page accounting broken: free+held+cached={pages} != "
             f"1..{cache.num_pages - 1} (leak or double-free)")
+    # refcount == page-table occupancy: slot-list appearances plus
+    # prefix-index references, for every allocated page
+    want_refs = collections.Counter(held)
+    for p, n in idx_refs.items():
+        want_refs[p] += n
+    for p in range(1, cache.num_pages):
+        have = cache.refcount(p)
+        want = want_refs.get(p, 0)
+        if have != want:
+            violations.append(
+                f"refcount identity broken: page {p} has refcount "
+                f"{have} but {want} holder(s) (slot lists + prefix "
+                "index) — shared-page bookkeeping drifted")
     slots = sorted(cache._free_slots + list(cache._slot_pages))
     if slots != list(range(cache.max_slots)):
         violations.append(
@@ -602,6 +644,9 @@ class ScriptedEngine(_llm.LLMEngine):
         self._swap_out = lambda k, v, idx: (np.zeros((1,), np.float32),
                                             np.zeros((1,), np.float32))
         self._swap_in = lambda k, v, idx, hk, hv: (k, v)
+        # copy-on-write bookkeeping (refcounts, page swaps) is the real
+        # allocator's; only the device page copy is scripted away
+        self._cow = lambda k, v, src, dst: (k, v)
         self._sample = lambda logits: np.argmax(np.asarray(logits), axis=-1)
 
     @staticmethod

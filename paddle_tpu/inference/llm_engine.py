@@ -81,6 +81,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import prefix as _prefix
 from ..models import generation
 from ..obs import metrics as obs_metrics
 from ..obs import reqtrace as obs_reqtrace
@@ -280,6 +281,14 @@ class _StatsDict(collections.abc.MutableMapping):
                                "spans)",
         "verify_tokens": "rows dispatched in speculative verify spans "
                          "(last token + drafts)",
+        "prefix_hits": "admissions that spliced a cached prefix",
+        "prefix_misses": "admissions that found no cached prefix",
+        "prefix_spliced_pages": "KV pages spliced from the prefix index "
+                                "instead of re-prefilled",
+        "prefix_cow_copies": "shared pages copied privately before a "
+                             "slot appended into them (copy-on-write)",
+        "prefix_evictions": "cached prefix pages evicted under page "
+                            "pressure (LRU)",
         "spec_steps": "speculative verify spans dispatched",
         "spec_drafted": "draft tokens proposed into verify spans",
         "spec_accepted": "draft tokens accepted by the verify pass",
@@ -410,6 +419,19 @@ class LLMEngine:
     so varying k never changes the compiled signature.
     drafter: a generation.Drafter (default: NGramDrafter prompt-lookup —
     no second model); ignored when spec_k == 0.
+
+    prefix_cache: cross-user prefix reuse (default ON).  The page pool is
+    refcounted with copy-on-write; a radix prefix index (inference/
+    prefix.py) remembers where every finished prefill's KV lives.
+    Admission looks up the longest cached prefix of a new prompt and
+    SPLICES its pages into the slot (page-table bookkeeping, zero
+    dispatch), so chunked prefill shrinks to the unshared suffix; a slot
+    that must append into a partially-filled shared page first copies it
+    privately through ONE compiled page-copy executable.  Cached-but-
+    unreferenced prefixes are LRU-evicted only under page pressure,
+    before any live sequence is preempted; pool recovery invalidates the
+    whole index (a prefix must not outlive its KV).  False disables the
+    index (no lookups, no retention — refcounts stay all-1).
     """
 
     def __init__(self, params, config, num_slots: int = 4,
@@ -424,6 +446,7 @@ class LLMEngine:
                  block_q: int = 8,
                  spec_k: int = 0,
                  drafter=None,
+                 prefix_cache: bool = True,
                  tracer: Optional[obs_trace.Tracer] = None,
                  metrics: Optional[obs_metrics.Registry] = None,
                  name: Optional[str] = None,
@@ -486,6 +509,11 @@ class LLMEngine:
         self.cache = generation.PagedKVCache(
             config, num_pages=num_pages, page_size=page_size,
             max_slots=num_slots, pages_per_seq=pages_per_seq)
+        # cross-user prefix reuse: the radix index holds refcounts on
+        # pages whose KV outlives the slot that computed it
+        self.prefix_index = (_prefix.PrefixIndex(self.cache)
+                             if prefix_cache else None)
+        self._prefix_evicted_seen = 0   # evictions already counted
         self._pending: collections.deque = collections.deque()
         self._slots: dict[int, _SlotState] = {}
         self._admit_seq = 0
@@ -517,6 +545,8 @@ class LLMEngine:
             "spec_drafted", "spec_accepted", "spec_rejected", "spec_bonus",
             "spec_emitted", "preemptions", "swapped_in", "resumed",
             "swap_out_pages", "swap_in_pages",
+            "prefix_hits", "prefix_misses", "prefix_spliced_pages",
+            "prefix_cow_copies", "prefix_evictions",
             "cancelled", "timed_out", "failed", "steps_total"))
         reg = self.metrics
         self._h_queue_wait = reg.histogram(
@@ -630,6 +660,12 @@ class LLMEngine:
                   ).set_function(lambda: max(
                       (len(p) for p in
                        list(self.cache._slot_pages.values())), default=0))
+        reg.gauge("llm_prefix_cached_pages",
+                  "KV pages the prefix index holds a reference on "
+                  "(reclaimable under pressure, shareable on a hit)"
+                  ).set_function(
+            lambda: (0 if self.prefix_index is None
+                     else self.prefix_index.cached_pages))
         if flight is not None:
             flight.attach_engine(self)
 
@@ -687,6 +723,17 @@ class LLMEngine:
             return pools["k"], pools["v"]
 
         self._swap_in = _swap_in
+
+        # copy-on-write page clone: src/dst are traced int32 scalars, so
+        # every COW rides ONE compiled executable (donated like decode —
+        # the caller replaces cache.pools with the result)
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def _cow(k_pool, v_pool, src, dst):
+            pools = generation.copy_kv_page(
+                {"k": k_pool, "v": v_pool}, src, dst)
+            return pools["k"], pools["v"]
+
+        self._cow = _cow
 
     def ragged_probe_args(self) -> tuple:
         """The ONE abstract `_ragged` arg tuple — the Graph Doctor's
@@ -818,7 +865,28 @@ class LLMEngine:
         snap["step_phases"] = self.stepprof.report()
         snap["pool"] = self.pool_snapshot()
         snap["watchdog"] = self.watchdog.report()
+        snap["prefix"] = self.prefix_snapshot()
         return snap
+
+    def prefix_snapshot(self) -> dict:
+        """The prefix-reuse section of /stats (both serve paths render
+        it): hit/miss/splice/COW/eviction counters plus the index's
+        live footprint.  hit_rate is cumulative hits / lookups."""
+        idx = self.prefix_index
+        hits = self.stats["prefix_hits"]
+        misses = self.stats["prefix_misses"]
+        total = hits + misses
+        return {
+            "enabled": idx is not None,
+            "hits": hits,
+            "misses": misses,
+            "hit_rate": round(hits / total, 4) if total else 0.0,
+            "spliced_pages": self.stats["prefix_spliced_pages"],
+            "cow_copies": self.stats["prefix_cow_copies"],
+            "evictions": self.stats["prefix_evictions"],
+            "cached_pages": 0 if idx is None else idx.cached_pages,
+            "cached_prefixes": 0 if idx is None else idx.leaf_count,
+        }
 
     def state_digest(self) -> dict:
         """A compact, JSON-safe digest of live engine state — the
@@ -928,6 +996,15 @@ class LLMEngine:
                     (self.stats["spec_accepted"] / drafted)
                     if drafted else 1.0)
             tr.counter("sched", sched)
+            if self.prefix_index is not None:
+                # cached-page footprint next to the pool track: splices
+                # and COW copies render under the step that caused them
+                tr.counter("prefix", {
+                    "cached_pages": self.prefix_index.cached_pages,
+                    "hits": self.stats["prefix_hits"],
+                    "spliced_pages": self.stats["prefix_spliced_pages"],
+                    "cow_copies": self.stats["prefix_cow_copies"],
+                })
 
     def pool_snapshot(self) -> dict:
         """The memory-telemetry section of /stats: pool occupancy,
@@ -1144,6 +1221,11 @@ class LLMEngine:
                            f"({cause!r:.120}); slot state was reset")
         for slot in list(self._slots):
             self._evict(slot, err, "failed")
+        # NO cached prefix survives pool deallocation: the index's pages
+        # are about to hold zeroed KV — serving a splice from them would
+        # be silent corruption.  Drop every reference before re-zeroing.
+        if self.prefix_index is not None:
+            self.prefix_index.clear()
         cache.pools = generation.init_paged_kv_pools(
             self.config, cache.num_pages, cache.page_size)
         return True
@@ -1298,6 +1380,10 @@ class LLMEngine:
                     need = cache.pages_needed(
                         min(pend.size, self.prefill_chunk_tokens))
                 if need > cache.free_page_count:
+                    # cached-but-unreferenced prefixes count as admission
+                    # headroom: reclaim before stalling the queue on them
+                    self._reclaim_pages(need - cache.free_page_count)
+                if need > cache.free_page_count:
                     break  # head-of-line waits for pages (no reordering)
                 self._pending.popleft()
             slot = cache.acquire_slot()
@@ -1320,13 +1406,18 @@ class LLMEngine:
                             wait = req.t_admit - req.t_submit
                             self._h_queue_wait.observe(wait)
                             self.slo.observe("queue_wait", wait)
+                        # prefix-hit admission: splice the cached pages
+                        # and start ctx past them — the next ragged
+                        # batches chunk-prefill only the unshared suffix
+                        ctx0 = self._splice_prefix(slot, req.prompt)
                         self._slots[slot] = _SlotState(
-                            req, self._admit_seq, ctx=0,
+                            req, self._admit_seq, ctx=ctx0,
                             pending=req.prompt, sample_on_finish=True,
                             spec_k=self.spec_k)
                         with self._cv:
                             self.stats["admitted"] += 1
-                        self._rq_event(req, "admit", slot=slot)
+                        self._rq_event(req, "admit", slot=slot,
+                                       prefix_tokens=ctx0)
             except Exception as e:  # noqa: BLE001 — admission must not leak
                 # the request left _pending but never (or only briefly)
                 # reached _slots: without cleanup the slot and its pages
@@ -1381,19 +1472,51 @@ class LLMEngine:
         with self._cv:
             self.stats["resumed"] += 1
         req._resume = None
+        ctx0 = rs.ctx
+        if rs.host_k is None and ctx0 == 0 and rs.pending is not None:
+            # recompute-resume re-prefills the whole context — a cached
+            # prefix (usually its own prompt, registered before the
+            # preemption) shrinks that to the unshared suffix, token-
+            # exactly: spliced pages hold the identical positions' KV
+            ctx0 = self._splice_prefix(slot, rs.pending)
         self._slots[slot] = _SlotState(
-            req, self._admit_seq, ctx=rs.ctx, last_tok=rs.last_tok,
+            req, self._admit_seq, ctx=ctx0, last_tok=rs.last_tok,
             pending=rs.pending, sample_on_finish=rs.sample_on_finish,
             spec_k=self.spec_k)
         self._rq_event(req, "resume", slot=slot, ctx=rs.ctx,
                        mode=("swap" if rs.host_k is not None
                              else "recompute"))
 
+    def _reclaim_pages(self, need: int, prefer_page: Optional[int] = None
+                       ) -> int:
+        """Evict cached-but-unreferenced prefixes (LRU) to free `need`
+        pages — ALWAYS tried before preempting a live sequence, so the
+        prefix cache rides slack capacity and never costs anyone real
+        work.  prefer_page: under copy-on-write pressure, first drop the
+        index's own ref on that page (making it private beats copying
+        it).  Returns pages actually returned to the free pool."""
+        idx = self.prefix_index
+        if idx is None:
+            return 0
+        freed = 0
+        if prefer_page is not None:
+            freed += idx.evict_subtree_holding(prefer_page)
+        if freed < need:
+            freed += idx.evict(need - freed)
+        evicted = idx.evicted_pages_total - self._prefix_evicted_seen
+        if evicted > 0:
+            self._prefix_evicted_seen = idx.evicted_pages_total
+            with self._cv:
+                self.stats["prefix_evictions"] += evicted
+            self.tracer.instant("prefix_evict", pages=evicted)
+        return freed
+
     def _alloc_with_preemption(self, slot: int, n_tokens: int) -> bool:
-        """Grow `slot`'s pages to cover n_tokens, preempting victims under
-        pressure.  Never preempts the last runnable sequence (its worst
-        case was validated at submit), so a lone request always completes.
-        Returns False when `slot` itself was preempted or evicted."""
+        """Grow `slot`'s pages to cover n_tokens, reclaiming cached
+        prefixes and then preempting victims under pressure.  Never
+        preempts the last runnable sequence (its worst case was validated
+        at submit), so a lone request always completes.  Returns False
+        when `slot` itself was preempted or evicted."""
         cache = self.cache
         while True:
             try:
@@ -1401,6 +1524,13 @@ class LLMEngine:
                 cache.ensure_capacity(slot, n_tokens)
                 return True
             except RuntimeError as e:
+                # cached prefixes are the cheapest memory on the machine:
+                # evict them (LRU) before touching a live sequence
+                if self._reclaim_pages(
+                        max(1, cache.pages_needed(n_tokens)
+                            - len(cache._slot_pages.get(slot, ()))
+                            - cache.free_page_count)):
+                    continue
                 if len(self._slots) == 1:
                     # last runnable: a pool too small for one sequence is
                     # rejected at submit(), so this is an injected or
@@ -1414,6 +1544,104 @@ class LLMEngine:
                     # preempted ourselves — or a failed swap-out
                     # recovered the pools and failed this slot too
                     return False
+
+    def _splice_prefix(self, slot: int, tokens) -> int:
+        """Admission-time prefix splice: look the prompt/context up in
+        the radix index and install the longest cached prefix's pages
+        into the fresh slot — page-table bookkeeping only, NO dispatch.
+        At least one token is always left to prefill (the finishing span
+        must produce logits).  Returns the spliced token count (the
+        slot's starting ctx)."""
+        idx = self.prefix_index
+        if idx is None:
+            return 0
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        matched, pages = (0, []) if tokens.size < 2 else \
+            idx.lookup(tokens, tokens.size - 1)
+        # a sub-page match is a net loss: the splice would save < one
+        # page of prefill but cost a whole-page copy the moment the
+        # slot appends into the shared page — treat it as a miss
+        if matched < self.cache.page_size or not pages:
+            with self._cv:
+                self.stats["prefix_misses"] += 1
+            return 0
+        self.cache.splice_pages(slot, pages)
+        with self._cv:
+            self.stats["prefix_hits"] += 1
+            self.stats["prefix_spliced_pages"] += len(pages)
+        self.tracer.instant("prefix_splice", slot=slot, tokens=matched,
+                            pages=len(pages))
+        return matched
+
+    def _register_prefix(self, slot: int, st: "_SlotState") -> None:
+        """A slot just finished prefilling: its FULL pages become cached
+        prefix — the index takes a reference on each, so the KV survives
+        this slot's release and later admissions splice it.  The partial
+        tail page is deliberately NOT registered here: the slot itself
+        appends into it on its very next decode step, and sharing it now
+        would force a copy-on-write the request pays for its own page —
+        it registers at completion instead (`_finish`), when no more
+        appends can land in it."""
+        idx = self.prefix_index
+        if idx is None or st.pending is None:
+            return
+        ps = self.cache.page_size
+        n_full = st.ctx - st.ctx % ps
+        if n_full:
+            idx.insert(st.pending, n_full,
+                       self.cache._slot_pages[slot][:n_full // ps])
+
+    def _make_writable(self, slot: int, st: "_SlotState") -> bool:
+        """Copy-on-write before the slot's next span writes at position
+        st.ctx: if the page holding that position is SHARED (spliced
+        prefix / index-retained), clone it privately through the one
+        compiled `_cow` executable.  Under pool pressure the copy first
+        reclaims cached prefixes (dropping the index's ref on the very
+        source page makes it private for free), then preempts like any
+        allocation.  Returns False when `slot` was evicted/preempted."""
+        cache = self.cache
+        i = st.ctx // cache.page_size
+        pages = cache._slot_pages.get(slot)
+        if pages is None or i >= len(pages) \
+                or cache.refcount(pages[i]) <= 1:
+            return True
+        while True:
+            try:
+                plan = cache.cow_page(slot, i)
+                break
+            except RuntimeError as e:
+                freed = self._reclaim_pages(1, prefer_page=pages[i])
+                if cache.refcount(pages[i]) <= 1:
+                    # the index dropped its ref on the source: the page
+                    # is private now even if nothing returned to the
+                    # pool — no copy needed at all
+                    return True
+                if freed:
+                    continue
+                if len(self._slots) == 1:
+                    self._evict(slot, e, "failed")
+                    return False
+                victim = self._pick_victim()
+                self._preempt(victim)
+                if victim == slot or slot not in self._slots:
+                    return False
+        if plan is None:
+            return True
+        src, dst = plan
+        try:
+            k_pool, v_pool = self._cow(
+                cache.pools["k"], cache.pools["v"],
+                jnp.int32(src), jnp.int32(dst))
+        except Exception as e:  # noqa: BLE001 — a failed donated copy is
+            # a dispatch fault: the pools may be consumed, so fail
+            # in-flight work and recover, exactly like the ragged step
+            self._fail_inflight(e)
+            return False
+        cache.pools = {"k": k_pool, "v": v_pool}
+        with self._cv:
+            self.stats["prefix_cow_copies"] += 1
+        self.tracer.instant("cow_copy", slot=slot, src=src, dst=dst)
+        return True
 
     def _draft_for(self, slot: int, st: _SlotState) -> Optional[np.ndarray]:
         """Ask the drafter for this decoding slot's proposal, capped by
@@ -1471,14 +1699,22 @@ class LLMEngine:
                     self._fire("draft", slot=slot, pools=cache.pools)
                     draft = self._draft_for(slot, st)
                 except Exception as e:  # noqa: BLE001 — a drafting fault
-                    # fails THIS request; the batch and engine keep going
-                    # (a consume_pools rule still surfaces at the
-                    # dispatch below and fails the whole step)
+                    # fails THIS request; the batch and engine keep going.
+                    # A consume_pools rule is handled HERE: recover the
+                    # pools now rather than relying on the dispatch below
+                    # to trip on the deleted buffers (a scripted/fake
+                    # dispatch never would, and the pools must not stay
+                    # silently dead)
                     if slot in self._slots:
                         self._evict(slot, e, "failed")
+                    self._recover_pools(e)
                     continue
                 n_new = 1 + (0 if draft is None else int(draft.size))
-                if self._alloc_with_preemption(slot, st.ctx + n_new):
+                # the span writes k/v at positions [ctx, ctx+n): allocate
+                # them, then copy-on-write the shared page holding ctx
+                # (a spliced prefix's partially-filled tail) if any
+                if self._alloc_with_preemption(slot, st.ctx + n_new) \
+                        and self._make_writable(slot, st):
                     decode_slots.append((slot, draft))
             # -- 2. prefill chunks under the token budget -----------------
             # blocks are the real capacity: each decode span takes
@@ -1508,13 +1744,19 @@ class LLMEngine:
                         if not self._alloc_with_preemption(slot,
                                                            st.ctx + n):
                             continue
+                        # a spliced slot's first chunk may start inside
+                        # the shared tail page: clone it before writing
+                        if not self._make_writable(slot, st):
+                            continue
                 except Exception as e:  # noqa: BLE001 — a per-chunk
                     # injected fault fails THIS request; the rest of the
-                    # batch and the engine keep going (a consume_pools
-                    # rule still surfaces at the dispatch below and fails
-                    # the whole step)
+                    # batch and the engine keep going.  consume_pools is
+                    # recovered HERE (see the draft-fault branch) so the
+                    # pools never stay silently dead behind a dispatch
+                    # that does not read them
                     if slot in self._slots:
                         self._evict(slot, e, "failed")
+                    self._recover_pools(e)
                     continue
                 sched[slot] = n
                 blocks_free -= -(-n // self.block_q)
@@ -1650,6 +1892,10 @@ class LLMEngine:
                                    ctx=st.ctx)
                     if st.prefilling:
                         continue        # more chunks on later steps
+                    # prefill finished: its pages become cached prefix —
+                    # the index takes refs so the KV survives this slot
+                    # and later admissions splice instead of re-prefilling
+                    self._register_prefix(slot, st)
                     if not st.sample_on_finish:
                         # recompute-resume: its next token was sampled
                         # before the preemption; decode continues with
@@ -1756,6 +2002,16 @@ class LLMEngine:
         self._recover_pools(e)
 
     def _finish(self, slot: int, req: _Request):
+        idx = self.prefix_index
+        if idx is not None:
+            # the prompt's partial tail page is shareable NOW: the slot
+            # is done appending, so the index can reference it without
+            # ever forcing a copy on the request that computed it (a
+            # later splicer copy-on-writes its own private clone)
+            pages = self.cache._slot_pages[slot]
+            need = self.cache.pages_needed(req.prompt.size)
+            if 0 < need <= len(pages):
+                idx.insert(req.prompt, req.prompt.size, pages[:need])
         self.cache.release_slot(slot)
         with self._cv:
             self.stats["completed"] += 1

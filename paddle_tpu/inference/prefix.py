@@ -1,0 +1,294 @@
+"""Radix prefix index: cross-user KV reuse over the refcounted page pool.
+
+Millions of requests share a handful of system prompts and few-shot
+preambles, yet a cache-less engine re-prefills them from token zero every
+time.  This index remembers WHERE a prefix's KV already lives: a radix
+tree keyed on token ids with PAGE-GRANULAR nodes — every node is exactly
+one page of the `PagedKVCache` pool, carrying the token ids cached in it
+(a full `page_size` tokens for interior nodes; the last node of an
+inserted prefix may be partial).  The index holds ONE refcount on each
+node's page (`cache.add_ref`), so a cached prefix survives the slot that
+computed it.
+
+Admission lookup walks the tree for the longest cached prefix of a new
+prompt and returns its page chain; the engine then SPLICES those pages
+into the fresh slot (`cache.splice_pages` — refcount bookkeeping only, no
+dispatch) and chunk-prefills just the unshared suffix.  A lookup may
+claim a node partially (the first j of its tokens): the page holds valid
+KV for every cached position and the kernel's ctx_len masking never reads
+past the claimed length.  Matches are capped at `max_tokens` (callers
+pass len(prompt) - 1: at least one token must prefill so the finishing
+span has logits to sample from).
+
+Insertion happens when a slot finishes prefilling: its pages become
+nodes.  Pages already cached for the same tokens are deduped (the slot
+keeps its own copy; it frees on release); a partial node is UPGRADED in
+place when a longer insert extends it (the index swaps to the fuller
+page and drops its ref on the old one — co-holding slots keep it alive
+until they release).
+
+Eviction is LRU over evictable leaves, and only under page pressure —
+the engine calls `evict(n)` when allocation fails before it considers
+preempting a live sequence.  A leaf is evictable iff the index is its
+page's ONLY holder (refcount 1); evicting it returns the page to the
+free pool, and may expose its parent as the next evictable leaf.
+`clear()` drops every reference — the engine calls it when the pools are
+deallocated/re-zeroed (`_recover_pools`), because a cached prefix must
+never outlive the KV it points at.
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["PrefixIndex"]
+
+
+class _Node:
+    """One cached page: `tokens` (the ids cached in it, oldest first),
+    `page` (its pool page id), children keyed by their full token tuple,
+    and an LRU clock stamp."""
+
+    __slots__ = ("tokens", "page", "children", "parent", "last_used")
+
+    def __init__(self, tokens: tuple, page: int,
+                 parent: Optional["_Node"]):
+        self.tokens = tokens
+        self.page = int(page)
+        self.children: dict = {}
+        self.parent = parent
+        self.last_used = 0
+
+    @property
+    def n_tokens(self) -> int:
+        return len(self.tokens)
+
+
+class PrefixIndex:
+    """Page-granular radix tree over a `PagedKVCache` (see module doc)."""
+
+    def __init__(self, cache):
+        self._cache = cache
+        self.page_size = int(cache.page_size)
+        self._root: dict = {}            # token tuple -> _Node
+        self._by_page: dict = {}         # page id -> _Node
+        self._clock = 0
+        self.evicted_pages_total = 0
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._by_page)
+
+    @property
+    def node_count(self) -> int:
+        return len(self._by_page)
+
+    @property
+    def leaf_count(self) -> int:
+        """Distinct cached prefixes (chains sharing pages count once per
+        ENDPOINT): the /stats "cached_prefixes" figure."""
+        return sum(1 for n in self._by_page.values() if not n.children)
+
+    def pages(self) -> set:
+        """The set of pool pages the index currently holds a ref on."""
+        return set(self._by_page)
+
+    def page_refs(self) -> dict:
+        """page -> index-held reference count (always 1 per cached page;
+        the invariant checker joins this with slot page lists against
+        `cache._refcount`)."""
+        return {p: 1 for p in self._by_page}
+
+    def first_chunks(self) -> tuple:
+        """Token tuples of the FULL-page root children — the per-replica
+        prefix digest the Router's affinity score matches request heads
+        against.  Partial root nodes (a cached prompt shorter than one
+        page) are excluded: the engine's splice floor treats sub-page
+        matches as misses, so steering traffic toward them would
+        discount load for zero benefit."""
+        return tuple(t for t in self._root if len(t) == self.page_size)
+
+    # -- lookup / insert ----------------------------------------------------
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def lookup(self, tokens, max_tokens: int) -> Tuple[int, List[int]]:
+        """Longest cached prefix of `tokens`, capped at `max_tokens`.
+        Returns (matched_token_count, page_chain); (0, []) on a miss.
+        The last page of the chain may be claimed partially (matched not
+        page-aligned) — the splicing slot must copy-on-write it before
+        appending.  Every node on the hit path is LRU-touched."""
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        limit = min(int(max_tokens), len(toks))
+        matched = 0
+        pages: List[int] = []
+        children = self._root
+        now = self._tick()
+        while matched < limit:
+            best = None
+            best_common = 0
+            chunk = toks[matched:matched + self.page_size]
+            exact = children.get(tuple(chunk))
+            if exact is not None and matched + exact.n_tokens <= limit:
+                best, best_common = exact, exact.n_tokens
+            else:
+                for node in children.values():
+                    common = 0
+                    cap = min(node.n_tokens, limit - matched)
+                    for a, b in zip(node.tokens[:cap], chunk):
+                        if a != b:
+                            break
+                        common += 1
+                    if common > best_common:
+                        best, best_common = node, common
+            if best is None or best_common == 0:
+                break
+            best.last_used = now
+            pages.append(best.page)
+            matched += best_common
+            if best_common < best.n_tokens or best.n_tokens < self.page_size:
+                break               # partial claim / partial node: no deeper
+            children = best.children
+        return matched, pages
+
+    def insert(self, tokens, n_tokens: int, pages: Sequence[int]) -> int:
+        """Register a freshly prefilled prefix: `tokens[:n_tokens]` is
+        cached in `pages` (page i holds tokens [i*ps, (i+1)*ps)).  Walks
+        the tree creating nodes for uncached pages (taking one refcount
+        each), dedupes against existing ones, and upgrades a partial node
+        when this insert extends it.  Returns the number of pages newly
+        referenced by the index."""
+        toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
+        n_tokens = min(int(n_tokens), len(toks))
+        children = self._root
+        parent = None
+        added = 0
+        now = self._tick()
+        pos = 0
+        for page in pages:
+            n = min(self.page_size, n_tokens - pos)
+            if n <= 0:
+                break
+            chunk = tuple(toks[pos:pos + n])
+            node = children.get(chunk)
+            if node is None:
+                # a partial node this chunk extends? upgrade it in place:
+                # swap the index's ref to the fuller page; co-holding
+                # slots keep the old page alive until they release it
+                partial = next(
+                    (c for c in children.values()
+                     if c.n_tokens < n and chunk[:c.n_tokens] == c.tokens),
+                    None)
+                if partial is not None:
+                    del children[partial.tokens]
+                    del self._by_page[partial.page]
+                    self._cache.add_ref(page)
+                    self._cache.drop_ref(partial.page)
+                    partial.tokens = chunk
+                    partial.page = int(page)
+                    children[chunk] = partial
+                    self._by_page[int(page)] = partial
+                    node = partial
+                    added += 1
+                else:
+                    # an existing LONGER node already covers this chunk?
+                    # nothing to add (we cannot hang children off a
+                    # partial insert anyway)
+                    covered = any(
+                        c.n_tokens >= n and c.tokens[:n] == chunk
+                        for c in children.values())
+                    if covered:
+                        break
+                    node = _Node(chunk, page, parent)
+                    self._cache.add_ref(page)
+                    children[chunk] = node
+                    self._by_page[int(page)] = node
+                    added += 1
+            node.last_used = now
+            if node.n_tokens < self.page_size:
+                break               # partial tail: nothing hangs below it
+            children = node.children
+            parent = node
+            pos += n
+        return added
+
+    # -- eviction -----------------------------------------------------------
+
+    def _drop_node(self, node: _Node) -> bool:
+        """Remove one childless node, releasing the index's page ref.
+        Returns True iff the page went back to the free pool."""
+        siblings = node.parent.children if node.parent is not None \
+            else self._root
+        del siblings[node.tokens]
+        del self._by_page[node.page]
+        self.evicted_pages_total += 1
+        return self._cache.drop_ref(node.page)
+
+    def evict(self, n_pages: int) -> int:
+        """LRU-evict unreferenced cached prefixes until `n_pages` pages
+        returned to the free pool (or nothing evictable remains).  Only
+        leaves whose page the index holds EXCLUSIVELY (refcount 1) are
+        candidates — a prefix a live slot still reads is never evicted;
+        dropping a leaf may expose its parent next (pushed onto the
+        candidate heap, so one call scans the index ONCE rather than
+        once per freed page — this runs on the admission hot path).
+        Returns pages actually freed to the pool."""
+        heap = [(n.last_used, n.page, n) for n in self._by_page.values()
+                if not n.children and self._cache.refcount(n.page) == 1]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < n_pages:
+            _, _, node = heapq.heappop(heap)
+            if self._by_page.get(node.page) is not node or node.children \
+                    or self._cache.refcount(node.page) != 1:
+                continue            # stale heap entry
+            parent = node.parent
+            if self._drop_node(node):
+                freed += 1
+            if parent is not None and not parent.children \
+                    and self._by_page.get(parent.page) is parent \
+                    and self._cache.refcount(parent.page) == 1:
+                heapq.heappush(
+                    heap, (parent.last_used, parent.page, parent))
+        return freed
+
+    def evict_subtree_holding(self, page: int) -> int:
+        """Drop the node caching `page` AND its whole subtree (children
+        are unreachable without their parent on the lookup path).  Used
+        under extreme pressure when the very page a slot must
+        copy-on-write is only shared with the index — releasing the
+        index's ref makes the page private and the copy unnecessary.
+        Returns pages freed to the pool."""
+        node = self._by_page.get(int(page))
+        if node is None:
+            return 0
+        freed = 0
+        stack = [node]
+        order: List[_Node] = []
+        while stack:
+            n = stack.pop()
+            order.append(n)
+            stack.extend(n.children.values())
+        for n in reversed(order):       # children before parents
+            if self._drop_node(n):
+                freed += 1
+        return freed
+
+    def clear(self) -> int:
+        """Drop every cached prefix (pool deallocation / recovery: the
+        pages' KV is gone, so no prefix may survive).  Returns pages
+        freed to the pool."""
+        freed = 0
+        for node in self._by_page.values():
+            if self._cache.drop_ref(node.page):
+                freed += 1
+        self._by_page.clear()
+        self._root.clear()
+        return freed

@@ -10,6 +10,16 @@ TPU-native answer here is one Router object and three rules:
                 free pages as the tiebreak) — the same numbers
                 `GET /metrics` exposes, read from the registry, never
                 re-derived (the PR 6 signal plane is the source of truth).
+                PREFIX AFFINITY biases the load score: each replica's
+                prefix-index digest (the root token chunks of its radix
+                index) is cached per health tick, and a request whose
+                leading tokens match a replica's cached prefix gets a
+                sub-unit load discount there — ties (and only mild
+                imbalance) break toward the replica already holding the
+                prefix, so fleet-wide hit rate compounds instead of
+                spraying identical system prompts across replicas.
+                Affinity NEVER outvotes health: ejected/dead replicas
+                are not candidates at all.
   health      — every replica is probed on a tick (step-thread liveness +
                 supervisor pool checks); a failing probe EJECTS the
                 replica from placement.  Reinstatement must be EARNED:
@@ -253,6 +263,7 @@ class Router:
                  factory=None, num_replicas: Optional[int] = None,
                  supervisor: Optional[EngineSupervisor] = None,
                  faults=None, max_hops: int = 3,
+                 prefix_affinity: float = 0.5,
                  health_interval: float = 0.05,
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
                  canary_timeout: float = 30.0,
@@ -283,6 +294,12 @@ class Router:
             r.engine.reqtrace = self.reqtrace
         self.faults = faults
         self.max_hops = int(max_hops)
+        # sub-unit by default: with integer queue/slot loads, affinity
+        # breaks ties toward the prefix-holding replica but a replica
+        # one whole request busier still wins — and it can never outvote
+        # health ejection, which removes a replica from candidacy
+        self.prefix_affinity = float(prefix_affinity)
+        self._prefix_digests: dict = {}     # rid -> root token chunks
         self.health_interval = float(health_interval)
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
@@ -325,6 +342,12 @@ class Router:
                   ).set_function(lambda: sum(
                       r.engine.cache.free_page_count
                       for r in self.replicas if not r.dead))
+        # fleet-wide prefix hit rate: the compounding signal the
+        # affinity score exists to maximize — cumulative hits / lookups
+        # summed over live replicas (0.0 before any admission looked up)
+        reg.gauge("fleet_prefix_hit_rate",
+                  "cumulative prefix-cache hits / lookups across live "
+                  "replicas").set_function(self._prefix_hit_rate)
         if self.threaded:
             for r in self.replicas:
                 r.engine.start()
@@ -421,18 +444,62 @@ class Router:
             rt.event(fh.req_id, name, replica="router",
                      hop=len(fh.hops) - 1 if fh.hops else None, **attrs)
 
-    def _score(self, r: Replica):
+    def _refresh_prefix_digest(self, r: Replica) -> None:
+        """Cache the replica's prefix-index digest (root token chunks)
+        for the affinity score.  Refreshed per health tick — placement
+        tolerates a tick of staleness the same way it tolerates gauge
+        staleness."""
+        idx = getattr(r.engine, "prefix_index", None)
+        try:
+            self._prefix_digests[r.rid] = \
+                () if idx is None else idx.first_chunks()
+        except Exception:  # noqa: BLE001 — raced a live step thread
+            pass
+
+    def _prefix_hit_rate(self) -> float:
+        hits = total = 0
+        for r in self.replicas:
+            if r.dead:
+                continue
+            try:
+                h = r.engine.stats["prefix_hits"]
+                total += h + r.engine.stats["prefix_misses"]
+                hits += h
+            except Exception:  # noqa: BLE001 — engine without the counters
+                pass
+        return hits / total if total else 0.0
+
+    def _prefix_affinity_hit(self, r: Replica, prompt) -> bool:
+        """Does this replica's cached-prefix digest cover the request's
+        leading tokens?  True when any root chunk of its radix index is
+        a prefix of the prompt — the page-granular condition under which
+        admission there would splice at least one page."""
+        if not prompt:
+            return False
+        digest = self._prefix_digests.get(r.rid)
+        if digest is None:
+            self._refresh_prefix_digest(r)
+            digest = self._prefix_digests.get(r.rid, ())
+        head = tuple(prompt[:max((len(t) for t in digest), default=0)])
+        return any(t and head[:len(t)] == t for t in digest)
+
+    def _score(self, r: Replica, prompt=None):
         """Least-loaded placement score, SMALLER is better: (queue depth
-        + in-flight slots, -speculative acceptance rate, -free pages),
-        read from the replica's metrics GAUGES — the same storage its
-        /metrics endpoint renders.  Acceptance breaks load ties: a
-        low-acceptance replica burns more verify rows per emitted token
-        (its workload drafts badly there), so among equally-loaded
-        replicas the fleet learns to place where drafting works.
-        Replicas that never drafted read the neutral 1.0.  A replica
-        whose stats are unreadable/stale (fault-injected or a dying
-        engine rendering NaN) scores worst-but-placeable: stale
-        telemetry must degrade placement, not crash it."""
+        + in-flight slots - prefix affinity, -speculative acceptance
+        rate, -free pages), read from the replica's metrics GAUGES — the
+        same storage its /metrics endpoint renders.  A replica whose
+        prefix digest covers the request's leading tokens earns a
+        `prefix_affinity` discount on its load (sub-unit: it decides
+        ties and mild imbalance, never outvotes a genuinely busier
+        queue, and never resurrects an ejected replica — those are not
+        candidates).  Acceptance breaks remaining ties: a low-acceptance
+        replica burns more verify rows per emitted token (its workload
+        drafts badly there), so among equally-loaded replicas the fleet
+        learns to place where drafting works.  Replicas that never
+        drafted read the neutral 1.0.  A replica whose stats are
+        unreadable/stale (fault-injected or a dying engine rendering
+        NaN) scores worst-but-placeable: stale telemetry must degrade
+        placement, not crash it."""
         stale = (math.inf, 0.0, 0.0)
         try:
             # a slow_replica delay rule stalls HERE — the price of a slow
@@ -459,13 +526,17 @@ class Router:
                     accept = v
         except Exception:  # noqa: BLE001 — acceptance is advisory only
             pass
-        return (q + infl, -accept, -free_p)
+        load = q + infl
+        if prompt is not None and self.prefix_affinity \
+                and self._prefix_affinity_hit(r, prompt):
+            load -= self.prefix_affinity
+        return (load, -accept, -free_p)
 
-    def _candidates(self) -> List[Replica]:
+    def _candidates(self, prompt=None) -> List[Replica]:
         with self._lock:
             cands = [r for r in self.replicas
                      if r.state == HEALTHY and not r.dead]
-        return sorted(cands, key=self._score)
+        return sorted(cands, key=lambda r: self._score(r, prompt))
 
     def _try_place(self, fh: FleetHandle, count_accepted: bool = False):
         """Try each healthy replica best-score-first.  Returns (placed,
@@ -482,7 +553,7 @@ class Router:
         counter stays monotonic for Prometheus rate())."""
         retry_after = None
         value_error = None
-        for r in self._candidates():
+        for r in self._candidates(prompt=fh.prompt):
             try:
                 hop = r.engine.submit(
                     fh.prompt, fh.max_new_tokens, fh.eos_id,
@@ -667,6 +738,8 @@ class Router:
         for r in list(self.replicas):
             self._maybe_inject_death(r)
             self._tick_replica(r, now)
+            if not r.dead:
+                self._refresh_prefix_digest(r)
         self._drain_parked()
 
     def _maybe_inject_death(self, r: Replica) -> None:

@@ -239,6 +239,16 @@ class PagedKVCache:
     reclaimed on eviction (`release_slot`) — memory scales with the tokens
     actually resident, not num_slots * max_len.
 
+    Pages are REFCOUNTED with copy-on-write semantics (cross-user prefix
+    reuse): a page may appear in many slots' page lists and in the prefix
+    index at once, each holder counted in `_refcount`.  `splice_pages`
+    installs an already-prefilled prefix into a fresh slot (refcount + 1
+    per page, no KV computed); release/truncate only return a page to the
+    free pool when its count drops to zero; and a slot that must APPEND
+    into a shared page first copies it privately (`cow_page` does the
+    bookkeeping, the engine runs the device copy).  A page is writable by
+    a slot iff its refcount is exactly 1.
+
     Page-table invariants (the Pallas kernel relies on these):
       * page 0 is RESERVED scratch — never allocated; empty slots point
         every entry (and their writes) at it;
@@ -261,6 +271,7 @@ class PagedKVCache:
         self._free_pages = list(range(num_pages - 1, 0, -1))  # page 0 reserved
         self._free_slots = list(range(max_slots - 1, -1, -1))
         self._slot_pages: dict[int, list] = {}
+        self._refcount: dict[int, int] = {}   # page -> holders (slots+index)
 
     @property
     def free_page_count(self) -> int:
@@ -280,6 +291,51 @@ class PagedKVCache:
         self._slot_pages[slot] = []
         return slot
 
+    # -- refcount primitives (prefix sharing rides these) -------------------
+
+    def refcount(self, page: int) -> int:
+        """Current holder count for `page` (0 = free or never allocated)."""
+        return self._refcount.get(int(page), 0)
+
+    def add_ref(self, page: int) -> None:
+        """Take one more reference on an ALLOCATED page (a prefix-index
+        node or a splicing slot becoming a co-holder)."""
+        page = int(page)
+        rc = self._refcount.get(page, 0)
+        if page == 0 or rc < 1:
+            raise RuntimeError(
+                f"add_ref on page {page} with refcount {rc} (free, "
+                "reserved, or never allocated)")
+        self._refcount[page] = rc + 1
+
+    def drop_ref(self, page: int) -> bool:
+        """Release one reference; returns the page to the free pool when
+        the count hits zero.  Returns True iff the page was freed."""
+        page = int(page)
+        rc = self._refcount.get(page, 0)
+        if rc < 1:
+            raise RuntimeError(
+                f"drop_ref on page {page} with refcount {rc} "
+                "(double free)")
+        if rc == 1:
+            del self._refcount[page]
+            self._free_pages.append(page)
+            return True
+        self._refcount[page] = rc - 1
+        return False
+
+    def _alloc_page(self) -> int:
+        page = self._free_pages.pop()
+        self._refcount[page] = 1
+        return page
+
+    def _write_row(self, slot: int) -> None:
+        pages = self._slot_pages[slot]
+        row = pages + [pages[-1] if pages else 0] * \
+            (self.pages_per_seq - len(pages))
+        self.page_table = self.page_table.at[slot].set(
+            jnp.asarray(row, jnp.int32))
+
     def ensure_capacity(self, slot: int, n_tokens: int) -> None:
         """Grow slot's page list to cover n_tokens, updating its page-table
         row.  Raises RuntimeError when the pool is exhausted (callers queue
@@ -295,10 +351,45 @@ class PagedKVCache:
         if need - len(pages) > len(self._free_pages):
             raise RuntimeError("page pool exhausted")
         while len(pages) < need:
-            pages.append(self._free_pages.pop())
-        row = pages + [pages[-1]] * (self.pages_per_seq - len(pages))
-        self.page_table = self.page_table.at[slot].set(
-            jnp.asarray(row, jnp.int32))
+            pages.append(self._alloc_page())
+        self._write_row(slot)
+
+    def splice_pages(self, slot: int, pages) -> None:
+        """Install an already-prefilled page chain into an EMPTY slot (the
+        prefix-index hit path): each page gains one reference; no KV is
+        computed or copied.  The splicing slot must treat any page whose
+        refcount exceeds 1 as read-only (`cow_page` before appending)."""
+        lst = self._slot_pages[slot]
+        if lst:
+            raise RuntimeError(
+                f"splice into slot {slot} that already holds pages {lst}")
+        if len(pages) > self.pages_per_seq:
+            raise RuntimeError(
+                f"cannot splice {len(pages)} pages (pages_per_seq="
+                f"{self.pages_per_seq})")
+        for p in pages:
+            self.add_ref(p)
+        lst.extend(int(p) for p in pages)
+        self._write_row(slot)
+
+    def cow_page(self, slot: int, index: int):
+        """Copy-on-write bookkeeping for the slot's `index`-th page: if it
+        is shared (refcount > 1), allocate a private replacement, swap it
+        into the slot's list/page-table row, and return (src, dst) so the
+        caller can run the device page copy.  Returns None when the page
+        is already exclusively owned.  Raises RuntimeError when the pool
+        has no page for the copy (callers reclaim/preempt and retry)."""
+        pages = self._slot_pages[slot]
+        src = pages[index]
+        if self._refcount.get(src, 0) <= 1:
+            return None
+        if not self._free_pages:
+            raise RuntimeError("page pool exhausted (copy-on-write)")
+        dst = self._alloc_page()
+        pages[index] = dst
+        self.drop_ref(src)
+        self._write_row(slot)
+        return src, dst
 
     def truncate_slot(self, slot: int, n_tokens: int) -> int:
         """Logically retire cached tokens past `n_tokens`: release the
@@ -307,22 +398,23 @@ class PagedKVCache:
         append-only by position, so rejected draft tokens are retired by
         pure length bookkeeping: the kernel's ctx_len masking already
         guarantees slots past the sequence length are never read, and the
-        next span overwrites them in place.  Returns the number of pages
-        released."""
+        next span overwrites them in place.  A released page returns to
+        the free pool only once its LAST holder lets go (a spliced prefix
+        page survives in the index and its co-holders).  Returns the
+        number of pages this slot released."""
         pages = self._slot_pages[slot]
         need = self.pages_needed(n_tokens)
         freed = 0
         while len(pages) > max(need, 1) and pages:
-            self._free_pages.append(pages.pop())
+            self.drop_ref(pages.pop())
             freed += 1
         if freed:
-            row = pages + [pages[-1]] * (self.pages_per_seq - len(pages))
-            self.page_table = self.page_table.at[slot].set(
-                jnp.asarray(row, jnp.int32))
+            self._write_row(slot)
         return freed
 
     def release_slot(self, slot: int) -> None:
-        self._free_pages.extend(self._slot_pages.pop(slot))
+        for p in self._slot_pages.pop(slot):
+            self.drop_ref(p)
         self._free_slots.append(slot)
         self.page_table = self.page_table.at[slot].set(0)
 
@@ -369,6 +461,23 @@ def scatter_kv_pages(pools, page_idx, page_kv):
             page_kv["k"].astype(pools["k"].dtype)),
         "v": pools["v"].at[:, page_idx].set(
             page_kv["v"].astype(pools["v"].dtype)),
+    }
+
+
+def copy_kv_page(pools, src, dst):
+    """Duplicate one page inside the pools: dst <- src across every layer
+    (the device half of copy-on-write — a slot appending into a shared
+    prefix page first clones it privately).  src/dst are int32 scalars so
+    the jitted copy is ONE compiled executable for every page pair."""
+    src = jnp.asarray(src, jnp.int32)
+    dst = jnp.asarray(dst, jnp.int32)
+    return {
+        "k": jax.lax.dynamic_update_index_in_dim(
+            pools["k"], jax.lax.dynamic_index_in_dim(
+                pools["k"], src, axis=1, keepdims=False), dst, axis=1),
+        "v": jax.lax.dynamic_update_index_in_dim(
+            pools["v"], jax.lax.dynamic_index_in_dim(
+                pools["v"], src, axis=1, keepdims=False), dst, axis=1),
     }
 
 

@@ -190,8 +190,10 @@ class TestLLMEngine:
                 max_new_tokens=6))[0].tolist()
             assert got == want
         assert eng.stats["completed"] == 3
-        # all pages reclaimed after eviction
-        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        # all pages reclaimed after eviction (minus what the prefix
+        # index retains for cross-request reuse)
+        assert eng.cache.free_page_count \
+            + eng.prefix_index.cached_pages == eng.cache.num_pages - 1
         assert eng.cache.free_slot_count == 2
 
     def test_admit_and_evict_mid_decode(self, tiny):
@@ -217,15 +219,19 @@ class TestLLMEngine:
             eng.step()
         assert len(a.result(timeout=0)) == 6
         assert not b.done()              # B still decoding after A evicted
-        # A's pages are back in the pool while B keeps decoding
-        assert eng.cache.free_page_count > free_both_active
+        # A's pages are back in the pool while B keeps decoding (pages
+        # the prefix index retained are reclaimable headroom: LRU-evicted
+        # on demand before anyone is preempted)
+        assert eng.cache.free_page_count \
+            + eng.prefix_index.cached_pages > free_both_active
         c = eng.submit(rng.integers(0, cfg.vocab_size, 4).tolist(),
                        max_new_tokens=2)
         while not (b.done() and c.done()):
             eng.step()
         assert len(b.result(timeout=0)) == 8
         assert len(c.result(timeout=0)) == 2
-        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        assert eng.cache.free_page_count \
+            + eng.prefix_index.cached_pages == eng.cache.num_pages - 1
 
     def test_eos_stops_stream(self, tiny):
         from paddle_tpu.inference import LLMEngine

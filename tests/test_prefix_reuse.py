@@ -1,0 +1,525 @@
+"""Cross-user prefix reuse: refcounted copy-on-write paged KV, the radix
+prefix index, prefix-splice admission, and prefix-affinity routing.
+
+Covers the PR-15 acceptance criteria: allocator refcount/COW units, index
+lookup/insert/LRU-eviction semantics, TOKEN-EXACT generation through
+spliced admissions (hit / partial hit / miss, and after preempt+resume in
+both swap and recompute modes) vs dense `generate()`, zero post-warmup
+recompiles across admission kinds, index invalidation on pool recovery,
+LRU eviction under page pressure, the /stats + /metrics prefix surfaces
+on both serve paths, and the Router's prefix-affinity placement."""
+
+import json
+import urllib.request
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.obs as obs
+from paddle_tpu.inference import LLMEngine, serve_llm
+from paddle_tpu.inference import faults as F
+from paddle_tpu.inference.prefix import PrefixIndex
+from paddle_tpu.inference.router import Router, serve_fleet
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _cache(num_pages=9, page_size=4, max_slots=3, pages_per_seq=4):
+    return generation.PagedKVCache(
+        F._ScriptedConfig(), num_pages=num_pages, page_size=page_size,
+        max_slots=max_slots, pages_per_seq=pages_per_seq)
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("prefill_chunk_tokens", 4)
+    kw.setdefault("block_q", 2)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _ref_tokens(params, cfg, prompt, n):
+    return np.asarray(generation.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n))[0].tolist()
+
+
+class TestRefcountedAllocator:
+    def test_alloc_release_roundtrip_refcounts(self):
+        cache = _cache()
+        slot = cache.acquire_slot()
+        cache.ensure_capacity(slot, 10)          # 3 pages
+        pages = list(cache._slot_pages[slot])
+        assert all(cache.refcount(p) == 1 for p in pages)
+        cache.release_slot(slot)
+        assert all(cache.refcount(p) == 0 for p in pages)
+        assert sorted(cache._free_pages) == list(range(1, cache.num_pages))
+
+    def test_shared_page_survives_first_release(self):
+        cache = _cache()
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 8)
+        shared = list(cache._slot_pages[a])
+        b = cache.acquire_slot()
+        cache.splice_pages(b, shared)
+        assert [cache.refcount(p) for p in shared] == [2, 2]
+        assert list(np.asarray(cache.page_table[b][:2])) == shared
+        cache.release_slot(a)
+        # still referenced by b: NOT freed
+        assert all(p not in cache._free_pages for p in shared)
+        assert [cache.refcount(p) for p in shared] == [1, 1]
+        cache.release_slot(b)
+        assert all(p in cache._free_pages for p in shared)
+
+    def test_cow_private_page_is_noop(self):
+        cache = _cache()
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 4)
+        assert cache.cow_page(a, 0) is None
+
+    def test_cow_shared_page_swaps_and_rebalances(self):
+        cache = _cache()
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 4)
+        src = cache._slot_pages[a][0]
+        b = cache.acquire_slot()
+        cache.splice_pages(b, [src])
+        plan = cache.cow_page(b, 0)
+        assert plan is not None and plan[0] == src
+        dst = plan[1]
+        assert cache._slot_pages[b] == [dst]
+        assert cache.refcount(src) == 1 and cache.refcount(dst) == 1
+        assert int(cache.page_table[b][0]) == dst
+
+    def test_cow_raises_when_pool_empty(self):
+        cache = _cache(num_pages=3)              # 2 allocatable
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 8)              # takes both
+        b = cache.acquire_slot()
+        cache.splice_pages(b, cache._slot_pages[a][:1])   # shared, 0 free
+        with pytest.raises(RuntimeError, match="copy-on-write"):
+            cache.cow_page(b, 0)
+        cache.release_slot(b)
+        cache.release_slot(a)
+        assert sorted(cache._free_pages) == [1, 2]
+
+    def test_truncate_respects_shared_refs(self):
+        cache = _cache()
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 12)             # 3 pages
+        tail = cache._slot_pages[a][-1]
+        cache._refcount[tail] += 1               # an index-style co-holder
+        freed = cache.truncate_slot(a, 4)        # drop 2 trailing pages
+        assert freed == 2
+        assert tail not in cache._free_pages     # still index-held
+        assert cache.refcount(tail) == 1
+        cache._refcount[tail] -= 1               # tidy the fake ref
+        cache._free_pages.append(tail)
+        cache.release_slot(a)
+
+    def test_double_free_raises(self):
+        cache = _cache()
+        a = cache.acquire_slot()
+        cache.ensure_capacity(a, 4)
+        p = cache._slot_pages[a][0]
+        cache.release_slot(a)
+        with pytest.raises(RuntimeError, match="double free"):
+            cache.drop_ref(p)
+
+
+class TestPrefixIndex:
+    def _seed(self, cache, tokens, n=None):
+        """Allocate pages for `tokens` through a slot and insert them."""
+        idx = PrefixIndex(cache)
+        slot = cache.acquire_slot()
+        n = len(tokens) if n is None else n
+        cache.ensure_capacity(slot, n)
+        idx.insert(tokens, n, cache._slot_pages[slot])
+        pages = list(cache._slot_pages[slot])
+        cache.release_slot(slot)
+        return idx, pages
+
+    def test_insert_lookup_full_and_partial(self):
+        cache = _cache()
+        toks = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10]   # 2 full pages + tail(2)
+        idx, pages = self._seed(cache, toks)
+        assert idx.cached_pages == 3
+        # exact prompt, capped at len-1: claims the tail partially
+        m, got = idx.lookup(toks, len(toks) - 1)
+        assert m == 9 and got == pages
+        # longer prompt with same head: full 10-token hit
+        m, got = idx.lookup(toks + [99, 98], 11)
+        assert m == 10 and got == pages
+        # diverging after one page
+        m, got = idx.lookup([1, 2, 3, 4, 77, 78], 5)
+        assert m == 4 and got == pages[:1]
+        # total miss
+        assert idx.lookup([50, 51, 52], 2) == (0, [])
+
+    def test_partial_node_upgrade(self):
+        cache = _cache()
+        idx = PrefixIndex(cache)
+        s1 = cache.acquire_slot()
+        cache.ensure_capacity(s1, 2)
+        idx.insert([7, 8], 2, cache._slot_pages[s1])        # partial node
+        old_page = cache._slot_pages[s1][0]
+        s2 = cache.acquire_slot()
+        cache.ensure_capacity(s2, 6)
+        idx.insert([7, 8, 9, 6, 5, 4], 6, cache._slot_pages[s2])
+        new_pages = list(cache._slot_pages[s2])
+        # the partial node upgraded to s2's fuller page; deeper node added
+        m, got = idx.lookup([7, 8, 9, 6, 5], 5)
+        assert m == 5 and got == new_pages
+        cache.release_slot(s1)
+        cache.release_slot(s2)
+        assert old_page in cache._free_pages     # index dropped its ref
+        assert all(p not in cache._free_pages for p in new_pages)
+
+    def test_lru_eviction_skips_pinned_pages(self):
+        """A prefix a live slot still reads is NEVER evicted, no matter
+        how stale — only index-exclusive pages are candidates."""
+        cache = _cache(num_pages=12)
+        toks_a = [1, 2, 3, 4, 5, 6, 7, 8]
+        idx, pages_a = self._seed(cache, toks_a)
+        slot = cache.acquire_slot()
+        cache.ensure_capacity(slot, 8)
+        idx.insert([9, 9, 9, 9, 8, 8, 8, 8], 8, cache._slot_pages[slot])
+        pages_b = list(cache._slot_pages[slot])   # pinned by the slot
+        freed = idx.evict(10)                     # ask for everything
+        assert freed == len(pages_a)              # only A was evictable
+        assert all(p in cache._free_pages for p in pages_a)
+        assert all(p not in cache._free_pages for p in pages_b)
+        cache.release_slot(slot)
+
+    def test_lru_eviction_takes_oldest_first(self):
+        cache = _cache(num_pages=12)
+        toks_a = [1, 2, 3, 4, 5, 6, 7, 8]
+        idx, pages_a = self._seed(cache, toks_a)
+        slot = cache.acquire_slot()
+        cache.ensure_capacity(slot, 8)
+        idx.insert([9, 9, 9, 9, 8, 8, 8, 8], 8, cache._slot_pages[slot])
+        pages_b = list(cache._slot_pages[slot])
+        cache.release_slot(slot)                  # B unpinned, older? no:
+        idx.lookup(toks_a, 7)                     # ...touch A: B is LRU
+        freed = idx.evict(2)
+        assert freed == 2
+        # B (staler last_used) went first: its pages are free, A's not
+        assert all(p in cache._free_pages for p in pages_b)
+        assert any(p not in cache._free_pages for p in pages_a)
+
+    def test_clear_releases_everything(self):
+        cache = _cache()
+        idx, pages = self._seed(cache, [1, 2, 3, 4, 5, 6])
+        assert idx.clear() == len(pages)
+        assert idx.cached_pages == 0
+        assert sorted(cache._free_pages) == list(range(1, cache.num_pages))
+
+
+class TestEngineSplice:
+    def test_hit_and_partial_hit_token_exact(self, tiny):
+        """The tentpole proof: a warm prefix cache serves exact-repeat
+        and extended prompts token-identically to dense generate(),
+        while prefill work shrinks to the unshared suffix."""
+        cfg, params = tiny
+        rng = np.random.default_rng(0)
+        base = rng.integers(0, cfg.vocab_size, 8).tolist()
+        eng = _engine(params, cfg)
+        p1 = base + [3, 1]
+        h1 = eng.submit(p1, max_new_tokens=4)
+        while not h1.done():
+            eng.step()
+        assert h1.result(timeout=0) == _ref_tokens(params, cfg, p1, 4)
+        prefill_cold = eng.stats["prefill_tokens"]
+        assert prefill_cold == len(p1)
+        # exact repeat: everything but the last token splices
+        h2 = eng.submit(p1, max_new_tokens=4)
+        # extension: shares the 10-token prefix, adds its own suffix
+        p3 = p1 + [9, 9, 2]
+        h3 = eng.submit(p3, max_new_tokens=4)
+        while not (h2.done() and h3.done()):
+            eng.step()
+        assert h2.result(timeout=0) == _ref_tokens(params, cfg, p1, 4)
+        assert h3.result(timeout=0) == _ref_tokens(params, cfg, p3, 4)
+        snap = eng.stats_snapshot()
+        assert snap["prefix"]["hits"] == 2
+        assert snap["prefix"]["misses"] == 1
+        assert snap["prefix"]["spliced_pages"] >= 4
+        assert snap["prefix"]["cow_copies"] >= 1
+        # chunked-prefill work scales with the SUFFIX only: both warm
+        # requests together prefilled far less than one cold prompt
+        warm_prefill = snap["prefill_tokens"] - prefill_cold
+        assert warm_prefill <= 1 + len(p3) - 8
+        F.check_invariants(eng, [h1, h2, h3])
+
+    def test_miss_stays_token_exact(self, tiny):
+        cfg, params = tiny
+        rng = np.random.default_rng(3)
+        eng = _engine(params, cfg)
+        p1 = rng.integers(0, cfg.vocab_size, 9).tolist()
+        p2 = rng.integers(0, cfg.vocab_size, 9).tolist()
+        outs = eng.generate([p1, p2], max_new_tokens=3)
+        assert outs[0] == _ref_tokens(params, cfg, p1, 3)
+        assert outs[1] == _ref_tokens(params, cfg, p2, 3)
+        F.check_invariants(eng)
+
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_preempt_resume_with_splices_token_exact(self, tiny, mode):
+        """Preemption must respect refcounts in both modes: an
+        undersized pool forces splice-holding slots through preempt +
+        resume (recompute-resume even re-splices its own prompt), and
+        every chain still matches dense generate()."""
+        cfg, params = tiny
+        rng = np.random.default_rng(1)
+        base = rng.integers(0, cfg.vocab_size, 8).tolist()
+        # 5 allocatable pages < the two slots' 3-page prefills: victims
+        # are taken while the pool cannot be saved by prefix eviction
+        eng = _engine(params, cfg, num_pages=6, max_seq_len=16,
+                      preempt_mode=mode)
+        prompts = [base + [int(t)] for t in rng.integers(
+            0, cfg.vocab_size, 3)]
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for p, got in zip(prompts, outs):
+            assert got == _ref_tokens(params, cfg, p, 4)
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["prefix_hits"] >= 1
+        F.check_invariants(eng)
+
+    def test_zero_postwarmup_compiles_across_admission_kinds(self, tiny):
+        """Spliced admission reuses the ONE `_ragged` executable and the
+        ONE `_cow` executable: after a warmup that exercises both, hit /
+        miss / partial-hit admissions must not compile anything."""
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        rng = np.random.default_rng(5)
+        base = rng.integers(0, cfg.vocab_size, 8).tolist()
+        # warmup: one cold admission (compiles _ragged), then a hit
+        # whose match ends MID-page (8 full + 1 token of the cached
+        # tail), so the suffix append copy-on-writes (compiles _cow)
+        for prompt in (base + [1], base + [1, 2]):
+            h = eng.submit(prompt, max_new_tokens=2)
+            while not h.done():
+                eng.step()
+        assert eng.stats["prefix_cow_copies"] >= 1
+        sent = obs.RecompileSentinel(tracer=eng.tracer,
+                                     registry=obs.Registry())
+        sent.watch("ragged_step", eng._ragged)
+        sent.watch("cow_copy", eng._cow)
+        assert sent.check() == {}
+        handles = [
+            eng.submit(base + [1], max_new_tokens=2),             # hit
+            eng.submit(rng.integers(0, cfg.vocab_size, 9).tolist(),
+                       max_new_tokens=2),                         # miss
+            eng.submit(base + [1, 7, 7], max_new_tokens=2),  # partial hit
+        ]
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", obs.RecompileWarning)
+            steps = 0
+            while any(not h.done() for h in handles) and steps < 300:
+                eng.step()
+                assert sent.check() == {}, \
+                    "post-warmup recompile across prefix admissions"
+                steps += 1
+        assert all(h.done() for h in handles)
+        assert eng.stats["prefix_hits"] >= 3
+        assert sent.counts() == {"ragged_step": 0, "cow_copy": 0}
+
+    def test_recover_pools_clears_index(self, tiny):
+        """No cached prefix survives pool deallocation: recovery from a
+        consumed-donation failure re-zeros the pools, so every index
+        entry must be dropped (a stale splice would serve zeroed KV)."""
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        h = eng.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        assert eng.prefix_index.cached_pages >= 1
+        eng.cache.pools["k"].delete()
+        eng.cache.pools["v"].delete()
+        assert eng._recover_pools(RuntimeError("boom"))
+        assert eng.prefix_index.cached_pages == 0
+        assert eng.cache.free_page_count == eng.cache.num_pages - 1
+        # and the engine serves (and re-caches) afresh
+        out = eng.generate([[1, 2, 3, 4, 5]], max_new_tokens=2)
+        assert out[0] == _ref_tokens(params, cfg, [1, 2, 3, 4, 5], 2)
+        F.check_invariants(eng)
+
+    def test_eviction_under_pressure(self):
+        """Cached-but-unreferenced prefixes are LRU-evicted when
+        admission/allocation needs pages — BEFORE any live sequence is
+        preempted — and the refcount invariants hold throughout."""
+        eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
+                               num_pages=5)
+        rng = np.random.default_rng(2)
+        handles = []
+        for _ in range(4):           # distinct prompts: the index fills
+            p = rng.integers(0, 97, 8).tolist()
+            handles.append(eng.submit(p, max_new_tokens=3))
+        while any(not h.done() for h in handles):
+            eng.step()
+        assert eng.stats["prefix_evictions"] >= 1
+        F.check_invariants(eng, handles)
+
+
+class TestInvariantChecker:
+    def test_detects_refcount_drift(self):
+        eng = F.ScriptedEngine()
+        h = eng.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        page = next(iter(eng.prefix_index.pages()))
+        eng.cache._refcount[page] += 1           # seed the drift
+        with pytest.raises(F.InvariantViolation, match="refcount"):
+            F.check_invariants(eng, [h])
+
+    def test_detects_freed_while_shared(self):
+        eng = F.ScriptedEngine()
+        h = eng.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        page = next(iter(eng.prefix_index.pages()))
+        eng.cache._free_pages.append(page)       # freed under the index
+        with pytest.raises(F.InvariantViolation,
+                           match="free pool AND referenced"):
+            F.check_invariants(eng, [h], probe=False)
+
+    def test_telemetry_catches_prefix_gauge_drift(self):
+        eng = F.ScriptedEngine()
+        h = eng.submit([1, 2, 3, 4, 5], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        assert F.check_telemetry(eng) == []
+        eng.metrics.get("llm_prefix_cached_pages").set_function(
+            lambda: 999)
+        mism = F.check_telemetry(eng)
+        assert mism and "llm_prefix_cached_pages" in mism[0]
+
+
+class TestServeSurfaces:
+    def test_stats_and_metrics_carry_prefix_section(self, tiny):
+        cfg, params = tiny
+        eng = _engine(params, cfg)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            body = json.dumps({"prompt": [1, 2, 3, 4, 5, 6],
+                               "max_new_tokens": 2}).encode()
+            for _ in range(2):       # second request hits the cache
+                urllib.request.urlopen(
+                    urllib.request.Request(url + "/", data=body),
+                    timeout=120).read()
+            with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                snap = json.loads(r.read())
+            assert snap["prefix"]["hits"] >= 1
+            assert snap["prefix"]["cached_pages"] >= 1
+            with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert "llm_prefix_hits_total" in text
+            assert "llm_prefix_cached_pages" in text
+        finally:
+            srv.shutdown()
+
+    def test_fleet_metrics_carry_prefix_hit_rate(self):
+        def mk():
+            return F.ScriptedEngine(num_slots=2, page_size=4,
+                                    max_seq_len=16)
+        router = Router([mk(), mk()], supervisor=None, threaded=True,
+                        health_interval=0.01)
+        srv, _ = serve_fleet(router)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}"
+            body = json.dumps({"prompt": [4, 4, 4, 4, 5, 6],
+                               "max_new_tokens": 2}).encode()
+            for _ in range(3):
+                urllib.request.urlopen(
+                    urllib.request.Request(url + "/", data=body),
+                    timeout=60).read()
+            with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+                text = r.read().decode()
+            assert "fleet_prefix_hit_rate" in text
+            rate = float([ln for ln in text.splitlines()
+                          if ln.startswith("fleet_prefix_hit_rate")]
+                         [0].split()[-1])
+            assert 0.0 <= rate <= 1.0
+            with urllib.request.urlopen(url + "/stats", timeout=30) as r:
+                snap = json.loads(r.read())
+            assert all("prefix" in rep
+                       for rep in snap["replicas"].values())
+        finally:
+            srv.shutdown()
+
+
+class TestRouterAffinity:
+    def _mk(self):
+        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+
+    def _warm(self, eng, prompt):
+        h = eng.submit(prompt, max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        return h
+
+    def test_affinity_pins_prefix_holder_among_equals(self):
+        """Two equal-load replicas, one already holding the prefix: the
+        request lands there (and the cold replica still wins for a
+        foreign prompt when it has more free pages)."""
+        base = [6, 6, 6, 6]          # one full page: a digest root chunk
+        engines = [self._mk(), self._mk()]
+        self._warm(engines[1], base + [1, 2])
+        assert engines[1].prefix_index.first_chunks() == (tuple(base),)
+        router = Router(engines, supervisor=None, threaded=False)
+        h = router.submit(base + [7, 8], max_new_tokens=2)
+        assert h.hops == [1]
+        F.drive_fleet(router, [h])
+        assert h.result(timeout=0) == F.ScriptedEngine.reference_tokens(
+            base + [7, 8], 2)
+        # engine 1's admission actually spliced
+        assert engines[1].stats["prefix_hits"] >= 1
+        # a prompt neither replica holds: replica 0 (more free pages,
+        # no affinity anywhere) wins the tie
+        h2 = router.submit([9, 8, 7, 6, 5], max_new_tokens=2)
+        assert h2.hops == [0]
+        F.drive_fleet(router, [h2])
+        router.shutdown()
+
+    def test_affinity_never_outvotes_health_ejection(self):
+        """The prefix-holding replica is EJECTED: affinity must not
+        resurrect it — placement goes to the healthy replica."""
+        from paddle_tpu.inference.router import EJECTED
+        base = [3, 3, 3, 3]
+        engines = [self._mk(), self._mk()]
+        self._warm(engines[1], base + [1, 2])
+        router = Router(engines, supervisor=None, threaded=False)
+        router.replicas[1].state = EJECTED
+        h = router.submit(base + [7, 8], max_new_tokens=2)
+        assert h.hops == [0]
+        F.drive_fleet(router, [h])
+        assert h.result(timeout=0) == F.ScriptedEngine.reference_tokens(
+            base + [7, 8], 2)
+        router.shutdown()
+
+    def test_affinity_never_outvotes_real_load(self):
+        """A replica one whole request busier loses to the idle one even
+        with prefix affinity on its side (sub-unit discount)."""
+        base = [2, 2, 2, 2]
+        engines = [self._mk(), self._mk()]
+        self._warm(engines[1], base + [1, 2])
+        # preload replica 1 with real queue depth
+        engines[1].submit(base + [5], max_new_tokens=2)
+        engines[1].submit(base + [6], max_new_tokens=2)
+        router = Router(engines, supervisor=None, threaded=False)
+        h = router.submit(base + [7, 8], max_new_tokens=2)
+        assert h.hops == [0]
+        F.drive_fleet(router, [h])
+        router.shutdown()

@@ -144,7 +144,9 @@ class TestEngineAttribution:
         assert sum(p["share"] for p in phases.values()) == \
             pytest.approx(1.0, abs=1e-6)
         pool = snap["pool"]
-        assert pool["free_pages"] == pool["pages_total"]   # quiesced
+        # quiesced: everything not retained by the prefix index is free
+        assert pool["free_pages"] \
+            + snap["prefix"]["cached_pages"] == pool["pages_total"]
         assert pool["used_high_watermark"] > 0
         assert pool["free_low_watermark"] < pool["pages_total"]
         assert snap["watchdog"]["enabled"] is True
@@ -175,10 +177,12 @@ class TestEngineAttribution:
         assert {"pool_pages", "sched"} <= names
         pool = [e for e in counters if e.name == "pool_pages"]
         assert {"free", "used", "frag_run"} <= set(pool[-1].attrs)
-        # quiesced: the last sample must read back to baseline — the
-        # telemetry-based leak check the chaos soaks rely on
-        assert pool[-1].attrs["free"] == eng.cache.num_pages - 1
-        assert pool[-1].attrs["used"] == 0
+        # quiesced: the last sample must read back to baseline — free +
+        # prefix-index-retained = everything — the telemetry-based leak
+        # check the chaos soaks rely on
+        cached = eng.prefix_index.cached_pages
+        assert pool[-1].attrs["free"] == eng.cache.num_pages - 1 - cached
+        assert pool[-1].attrs["used"] == cached
 
     def test_check_telemetry_clean_and_detects_drift(self):
         eng = _scripted()
@@ -509,6 +513,26 @@ class TestBenchDiff:
             "extra.obs_overhead.phase_shares.dispatch") == "skip"
         assert bd.classify(
             "extra.obs_overhead.watchdog_anomalies") == "lower"
+        # prefix_reuse gates: TTFT (abs + ratio) and per-request prefill
+        # work are lower-better, hit rate / spliced fraction higher, and
+        # the workload-shape + neutral footprint leaves are not metrics
+        assert bd.classify(
+            "extra.prefix_reuse.mix_95.ttft_p50_ms") == "lower"
+        assert bd.classify(
+            "extra.prefix_reuse.ttft_hit95_vs_cold") == "lower"
+        assert bd.classify(
+            "extra.prefix_reuse.prefill_tokens_hit95_vs_cold") == "lower"
+        assert bd.classify(
+            "extra.prefix_reuse.mix_95.prefill_tokens_mean") == "lower"
+        assert bd.classify(
+            "extra.prefix_reuse.mix_95.hit_rate") == "higher"
+        assert bd.classify(
+            "extra.prefix_reuse.mix_95.spliced_page_fraction") == "higher"
+        assert bd.classify("extra.prefix_reuse.mix_95.mix") == "skip"
+        assert bd.classify(
+            "extra.prefix_reuse.mix_95.cow_copies") == "skip"
+        assert bd.classify(
+            "extra.prefix_reuse.workload.shared_fraction") == "skip"
 
     def test_lower_better_regression_detected(self):
         bd = _load_tool("bench_diff")

@@ -41,9 +41,20 @@ _SKIP_LEAVES = {
     "prompt", "prompt_len", "requests", "schedules", "replicas", "seed",
     "count", "window", "bound_pct", "failover_trials", "block_q",
     "chunk", "hops", "num_slots", "max_seq", "quantile", "target_s",
+    # prefix_reuse workload shape + neutral footprint counters (a COW
+    # copy count or cache size has no better/worse direction)
+    "mix", "shared_prefix", "suffix", "shared_fraction", "cow_copies",
+    "cached_pages",
     # measured/predicted step time: 1.0 is best, so neither direction
     # is a regression — not diffable as a scalar ordering
     "cost_model_ratio",
+}
+
+# exact leaves that are lower-better but carry no unit suffix — the
+# prefix_reuse gates: prefill work per request must SHRINK as splicing
+# serves more of each prompt
+_LOWER_LEAVES = {
+    "prefill_tokens_mean", "prefill_tokens_hit95_vs_cold",
 }
 
 # time/size units marking a LOWER-is-better metric — matched as leaf
@@ -71,6 +82,8 @@ def classify(path: str) -> str:
     # matching, but be explicit — an inverted gate passes regressions)
     if "per_sec" in leaf or "throughput" in leaf:
         return "higher"
+    if leaf in _LOWER_LEAVES:
+        return "lower"
     if leaf.endswith(_LOWER_SUFFIXES):
         return "lower"
     for sub in _LOWER_SUBSTR:

@@ -53,6 +53,13 @@ def main():
                          "prefill chunks riding the unified ragged batch "
                          "(small by default so multi-chunk prefills — and "
                          "mid-prefill faults/preemptions — actually occur)")
+    ap.add_argument("--prefix-mix", type=float, default=0.0,
+                    metavar="FRAC",
+                    help="fraction of each schedule's requests sharing "
+                         "one base prompt (0..1): hit admissions SPLICE "
+                         "cached prefix pages, so faults/preemption land "
+                         "on refcounted shared pages and the COW + "
+                         "LRU-eviction paths soak under pressure")
     ap.add_argument("--spec-k", type=int, default=0,
                     help="speculative draft depth (0 = off): soak the "
                          "draft->verify->commit path — an always-propose "
@@ -109,17 +116,26 @@ def main():
 
     reports, violations = [], 0
     totals = {"fired": 0, "completed": 0, "failed": 0, "preemptions": 0,
-              "swapped_in": 0}
+              "swapped_in": 0, "prefix_hits": 0, "prefix_cow_copies": 0,
+              "prefix_evictions": 0}
     for i in range(args.schedules):
         seed = args.seed + i
         mode = (args.mode if args.mode != "alternate"
                 else ("swap" if i % 2 == 0 else "recompute"))
         rules = F.random_schedule(seed)
         rng = np.random.default_rng(seed)
-        workload = [(rng.integers(0, cfg.vocab_size,
-                                  int(rng.integers(2, 9))).tolist(),
-                     int(rng.integers(2, 7)))
-                    for _ in range(args.requests)]
+        base = rng.integers(0, cfg.vocab_size, 6).tolist()
+        workload = []
+        for _ in range(args.requests):
+            if rng.random() < args.prefix_mix:
+                # shared base + short unique suffix: a prefix-cache hit
+                # once any sibling's prefill registered the base
+                prompt = base + rng.integers(
+                    0, cfg.vocab_size, int(rng.integers(1, 4))).tolist()
+            else:
+                prompt = rng.integers(0, cfg.vocab_size,
+                                      int(rng.integers(2, 9))).tolist()
+            workload.append((prompt, int(rng.integers(2, 7))))
         dumps_before = len(_flight_dumps(args.flight_dir))
         try:
             report = F.run_schedule(make_engine(mode, f"s{seed}"), rules,
@@ -145,6 +161,11 @@ def main():
             totals["failed"] += report["failed"]
             totals["preemptions"] += report["stats"]["preemptions"]
             totals["swapped_in"] += report["stats"]["swapped_in"]
+            totals["prefix_hits"] += report["stats"].get("prefix_hits", 0)
+            totals["prefix_cow_copies"] += \
+                report["stats"].get("prefix_cow_copies", 0)
+            totals["prefix_evictions"] += \
+                report["stats"].get("prefix_evictions", 0)
         status = "ok " if report["ok"] else "LEAK"
         line = (f"[{status}] seed={seed} mode={mode:9s} "
                 f"rules={[repr(r) for r in rules]}")
