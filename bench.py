@@ -393,7 +393,19 @@ def bench_ragged(dev, on_tpu):
         stall, reproduced inside the unified step for the A/B.
 
     All three run ONE attention dispatch per step — there is no bucket
-    menu and no separate prefill executable to compile."""
+    menu and no separate prefill executable to compile.
+
+    Plus the fused-decode A/B: a SAMPLED decode-only workload
+    (temperature/top-k/top-p on — the epilogue the fusion folds into the
+    dispatch) with `fused_decode` on (sampling inside the dispatch,
+    token ids cross the host boundary) vs off (logits pulled, the eager
+    filter+categorical chain runs as a second hop per step).  Paired
+    alternating trials, median of the per-pair ratios, so load drift
+    cannot fake the verdict either way.  `itl_fused_p50_ms` /
+    `itl_unfused_p50_ms` and their ratio are the nightly-diff keys;
+    `dispatch_sample_ms` per leg is the stepprof attribution the win
+    must show up in (absolute per-step time of the dispatch+sample
+    phases — shares alone renormalize and hide it)."""
     import time as _time
     import jax as _jax
     from paddle_tpu.inference import LLMEngine
@@ -421,14 +433,18 @@ def bench_ragged(dev, on_tpu):
     shorts = [rng.integers(0, cfg.vocab_size, 3).tolist()
               for _ in range(3 if not on_tpu else 2)]
 
-    def run(chunk_tokens, inject_long):
+    def run(chunk_tokens, inject_long, fused=True, sampled=False):
+        knobs = ({"temperature": 0.8, "top_k": 8, "top_p": 0.9,
+                  "seed": 7} if sampled else {})
         eng = LLMEngine(params, cfg, num_slots=4, page_size=page_size,
                         max_seq_len=max_seq,
-                        prefill_chunk_tokens=chunk_tokens, block_q=4)
+                        prefill_chunk_tokens=chunk_tokens, block_q=4,
+                        fused_decode=fused, **knobs)
         eng.generate([[1, 2, 3]], max_new_tokens=2)   # warm the executable
         hs = [eng.submit(p, max_new_tokens=new_tokens) for p in shorts]
         for _ in range(3):
             eng.step()               # streams decoding before the burst
+        eng.stepprof.reset_window()  # drop warmup/compile-bearing steps
         t0 = _time.perf_counter()
         if inject_long:
             hs.append(eng.submit(long_prompt, max_new_tokens=2))
@@ -437,6 +453,9 @@ def bench_ragged(dev, on_tpu):
         dt = _time.perf_counter() - t0
         snap = eng.stats_snapshot()
         itl = eng.latency_snapshot()["inter_token_s"]
+        ph = eng.stepprof.report()["phases"]
+        disp_sample = sum(ph.get(n, {}).get("mean_s", 0.0)
+                          for n in ("dispatch", "sample"))
         eng.shutdown()
         return {
             "chunk_tokens": chunk_tokens,
@@ -445,11 +464,31 @@ def bench_ragged(dev, on_tpu):
             "itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
             "prefill_chunks": snap["prefill_chunks"],
             "dispatches": snap["steps_total"],
+            "fused_decode_steps": snap["fused_decode_steps"],
+            # per-step dispatch+sample time: where the fused win lands
+            "dispatch_sample_ms": round(disp_sample * 1e3, 4),
         }
 
     decode_only = run(chunk, inject_long=False)
     chunked = run(chunk, inject_long=True)
     one_shot = run(long_len, inject_long=True)
+    # fused A/B: sampled decode-only, alternating pairs (both legs
+    # emit the IDENTICAL token stream — the fused kernel's Gumbel-max
+    # draw reproduces jax.random.categorical under the shared key
+    # chain — so this is purely a latency diff)
+    import statistics as _stats
+    run(chunk, inject_long=False, sampled=True, fused=True)   # warm
+    run(chunk, inject_long=False, sampled=True, fused=False)
+    pairs = []
+    for _ in range(3):
+        pairs.append((run(chunk, inject_long=False, sampled=True),
+                      run(chunk, inject_long=False, sampled=True,
+                          fused=False)))
+    fused_leg, unfused_leg = pairs[-1]
+    fused50 = _stats.median(f["itl_p50_ms"] for f, _u in pairs)
+    unfused50 = _stats.median(u["itl_p50_ms"] for _f, u in pairs)
+    ratios = [f["itl_p50_ms"] / u["itl_p50_ms"]
+              for f, u in pairs if u["itl_p50_ms"]]
     base99 = decode_only["itl_p99_ms"]
     chunk99 = chunked["itl_p99_ms"]
     return {
@@ -458,6 +497,8 @@ def bench_ragged(dev, on_tpu):
         "decode_only": decode_only,
         "chunked": chunked,
         "one_shot": one_shot,
+        "fused": fused_leg,
+        "unfused": unfused_leg,
         # acceptance bound: p99 under concurrent prefill vs decode-only
         # (<= 1.5 means a long prompt cannot wreck in-flight latency)
         "itl_p99_vs_decode_only": (round(chunk99 / base99, 3)
@@ -467,6 +508,14 @@ def bench_ragged(dev, on_tpu):
         "one_shot_vs_chunked_p99": (round(one_shot["itl_p99_ms"]
                                           / chunk99, 3)
                                     if chunk99 else None),
+        "itl_fused_p50_ms": round(fused50, 3),
+        "itl_unfused_p50_ms": round(unfused50, 3),
+        # acceptance bound: fused p50 <= 0.9x unfused (median of the
+        # paired per-trial ratios)
+        "itl_fused_vs_unfused": (round(_stats.median(ratios), 3)
+                                 if ratios else None),
+        "dispatch_sample_fused_ms": fused_leg["dispatch_sample_ms"],
+        "dispatch_sample_unfused_ms": unfused_leg["dispatch_sample_ms"],
     }
 
 
@@ -757,8 +806,16 @@ def bench_obs_overhead(dev, on_tpu):
             attribution["watchdog_anomalies"] = \
                 eng.watchdog.anomalies_total
             try:
-                flops = _obs.mfu.static_flops(
-                    eng._ragged, *eng.ragged_probe_args())
+                # join against the executable the dispatch phase is
+                # actually running: with fused_decode on the plain steps
+                # profile under the "+fused" shape class and the fused
+                # target's flops (sampling epilogue included)
+                if eng.fused_decode:
+                    flops = _obs.mfu.static_flops(
+                        eng._ragged_fused, *eng.ragged_fused_probe_args())
+                else:
+                    flops = _obs.mfu.static_flops(
+                        eng._ragged, *eng.ragged_probe_args())
                 joined = eng.stepprof.cost_join("dispatch", flops)
                 attribution["dispatch_cost_model_ratio"] = {
                     cls or "untagged": {

@@ -32,7 +32,8 @@ import jax.numpy as jnp
 
 from .core import is_array_var
 
-__all__ = ["EquivResult", "make_probes", "verify", "tolerance_for"]
+__all__ = ["EquivResult", "make_probes", "verify", "tolerance_for",
+           "chi2_sf", "verify_sampled"]
 
 
 # rtol/atol per float dtype — the narrower side of a comparison picks the
@@ -278,3 +279,106 @@ def verify(original, rewritten, probes: Optional[Sequence] = None,
     res.grads_checked = True
     res.max_grad_err = g_err
     return res
+
+
+# ---------------------------------------------------------------------------
+# distribution equality — the gate for SAMPLED rewrites.  A kernel that
+# fuses categorical sampling cannot be verified value-exactly (two correct
+# implementations may draw different tokens from the same distribution);
+# the right bar is "draws are indistinguishable from the target
+# distribution", checked with a Pearson chi-square goodness-of-fit test.
+# ---------------------------------------------------------------------------
+
+
+def chi2_sf(stat: float, dof: int) -> float:
+    """Chi-square survival function P(X >= stat) via the Wilson–Hilferty
+    cube-root normal approximation — no scipy in the container, and a
+    rewrite gate needs a decision-grade p-value, not 12 digits.  Accurate
+    to ~1e-3 for dof >= 3, conservative enough below that."""
+    import math
+
+    if dof <= 0:
+        return 1.0
+    if stat <= 0.0:
+        return 1.0
+    x = (stat / dof) ** (1.0 / 3.0)
+    mu = 1.0 - 2.0 / (9.0 * dof)
+    sigma = math.sqrt(2.0 / (9.0 * dof))
+    z = (x - mu) / sigma
+    return 0.5 * math.erfc(z / math.sqrt(2.0))
+
+
+def verify_sampled(draw_fn, expected_probs, n_draws: int = 4000,
+                   seed: int = 0, alpha: float = 1e-3,
+                   min_expected: float = 5.0) -> EquivResult:
+    """Goodness-of-fit gate: do `draw_fn`'s draws follow
+    `expected_probs`?  `draw_fn(key) -> int32 token(s)` (scalar or
+    array — a batched sampler contributes every element); `expected_probs`
+    is the (V,) target distribution (e.g. `generation.filtered_probs` of
+    the same logits the sampler saw).  Bins with expected count below
+    `min_expected` are pooled (the chi-square approximation breaks on
+    sparse bins); accepts when the p-value >= `alpha`.
+
+    alpha is deliberately small: the gate must not flake in CI on a
+    correct sampler (false-rejection rate == alpha) while still rejecting
+    any systematic distribution shift, which drives the statistic up
+    linearly in n_draws.  Reported via EquivResult with the statistic in
+    `max_abs_err` (grads are meaningless for a sampler)."""
+    probs = np.asarray(expected_probs, np.float64).reshape(-1)
+    total = probs.sum()
+    if not np.isfinite(total) or total <= 0:
+        return EquivResult(False, reason="expected_probs do not sum > 0")
+    probs = probs / total
+    V = probs.size
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), n_draws)
+    try:
+        toks = np.asarray(jax.vmap(draw_fn)(keys)).reshape(-1)
+    except Exception:  # noqa: BLE001 — draw_fn may not be vmappable
+        try:
+            toks = np.concatenate(
+                [np.asarray(draw_fn(k)).reshape(-1) for k in keys])
+        except Exception as e:  # noqa: BLE001 — sampler must run
+            return EquivResult(False, reason=(
+                f"draw_fn failed: {type(e).__name__}: {e}"))
+    toks = toks.astype(np.int64)
+    if toks.size == 0:
+        return EquivResult(False, reason="draw_fn produced no draws")
+    if (toks < 0).any() or (toks >= V).any():
+        return EquivResult(False, reason=(
+            f"draw outside [0, {V}): draws from a different support are "
+            f"never distribution-equal"))
+
+    counts = np.zeros(V, np.float64)
+    np.add.at(counts, toks, 1.0)
+    expected = probs * toks.size
+
+    # zero-probability tokens must never be drawn — that is an exactness
+    # violation (top-k/top-p masking broke), not a statistical question
+    dead = expected == 0.0
+    if counts[dead].sum() > 0:
+        bad = int(np.flatnonzero(dead & (counts > 0))[0])
+        return EquivResult(False, reason=(
+            f"token {bad} drawn but has zero probability under the "
+            f"target distribution"))
+
+    big = expected >= min_expected
+    obs = counts[big]
+    exp = expected[big]
+    tail_exp = expected[~big & ~dead].sum()
+    if tail_exp > 0:
+        obs = np.append(obs, counts[~big & ~dead].sum())
+        exp = np.append(exp, tail_exp)
+    if exp.size < 2:
+        # everything pooled into one bin: nothing to test beyond support
+        return EquivResult(True, n_outputs=1, reason="")
+    stat = float(((obs - exp) ** 2 / exp).sum())
+    dof = exp.size - 1
+    p = chi2_sf(stat, dof)
+    ok = p >= alpha
+    return EquivResult(
+        ok, n_outputs=1, max_abs_err=stat,
+        reason="" if ok else (
+            f"chi-square rejects distribution equality: stat={stat:.2f} "
+            f"dof={dof} p={p:.3e} < alpha={alpha:g} over {toks.size} "
+            f"draws"))

@@ -21,6 +21,15 @@ rewritten jaxpr with a structured `RewriteAction` log.
                                              fused call (generated Pallas
                                              kernel on TPU, jitted closure
                                              or interpret-mode kernel off)
+    inline_fusion consumes FUSION_BREAK      same stitching, but FIRST
+                                             inlines worthwhile pjit
+                                             edges so chains that today
+                                             stop at a container boundary
+                                             (the decode step body) become
+                                             contiguous and fuse; runs
+                                             ahead of `fusion`, which
+                                             stays as the fallback when
+                                             inlining finds nothing
 
 The VERIFICATION GATE (the part the reference pipeline gets by code
 review and we get by machine): every candidate rewrite must pass
@@ -200,10 +209,13 @@ class _RewritePass:
 REWRITE_REGISTRY: Dict[str, _RewritePass] = {}
 
 # default order: shrink first (dce), then retype, then restructure, then
-# annotate — shard_constraint before donation (it rebuilds pjit bodies),
-# donation last so it sees the final pjit structure
-_DEFAULT_PASSES = ("dce", "dtype_cast", "fusion", "shard_constraint",
-                   "donation")
+# annotate — inline_fusion ahead of fusion (when it applies it consumes
+# the FUSION_BREAK findings, so the boundary-limited pass is skipped;
+# when it rolls back or no-ops, plain fusion still runs), then
+# shard_constraint before donation (it rebuilds pjit bodies), donation
+# last so it sees the final pjit structure
+_DEFAULT_PASSES = ("dce", "dtype_cast", "inline_fusion", "fusion",
+                   "shard_constraint", "donation")
 
 
 def register_rewrite(name: str, consumes: Sequence[str]):
@@ -867,6 +879,23 @@ def _detect_chains(jaxpr, min_len: int, min_bytes: int,
     return chains
 
 
+def _fusion_site(path, chain_eqns, ordinal: int) -> str:
+    """Short stable tag of ONE fusion site (eqn path + chain prims +
+    tail shape + per-retrace ordinal), baked into the generated kernel's
+    name: two equal-length chains fused in one target would otherwise
+    emit name-identical kernels, silently aliasing their cost-formula
+    and stepprof shape-class attribution."""
+    import hashlib
+
+    h = hashlib.blake2s(digest_size=4)
+    h.update("/".join(str(s) for s in path).encode())
+    h.update(b"|")
+    h.update("->".join(e.primitive.name for e in chain_eqns).encode())
+    ov = chain_eqns[-1].outvars[0].aval
+    h.update(f"|{tuple(ov.shape)}|{ov.dtype}|{ordinal}".encode())
+    return h.hexdigest()
+
+
 class _FusionRules(_RetraceRules):
     def __init__(self, ctx: RewriteContext, finding_prims: List[set]):
         self.ctx = ctx
@@ -920,8 +949,9 @@ class _FusionRules(_RetraceRules):
 
         def compute():
             from ..kernels import pallas_fused_chain as pfc
+            site = _fusion_site(path, chain_eqns, self.fused_count)
             fused = pfc.fused_elementwise_chain(
-                chain_fn, n_ops=len(chain_eqns), mode=self.emit)
+                chain_fn, n_ops=len(chain_eqns), mode=self.emit, site=site)
             self.fused_count += 1
             head = chain_eqns[0]
             self.ctx.act(
@@ -933,7 +963,7 @@ class _FusionRules(_RetraceRules):
                 f"{fmt_bytes(aval_bytes(head.outvars[0].aval))}/op saved "
                 "per elided round-trip)",
                 chain=[e.primitive.name for e in chain_eqns],
-                n_inputs=len(ext))
+                n_inputs=len(ext), site=site)
             return [fused(*[read(v) for v in ext])]
 
         return ("compute", compute)
@@ -957,6 +987,115 @@ def rewrite_fusion(ctx: RewriteContext):
     new_closed = _retrace(ctx.closed_jaxpr, rules)
     if not rules.fused_count:
         ctx.actions.clear()
+        return None
+    return new_closed
+
+
+# ---------------------------------------------------------------------------
+# pass 4b: cross-container fusion (inline pjit edges, THEN stitch chains)
+# ---------------------------------------------------------------------------
+
+
+class _InlineRules(_RetraceRules):
+    """Flatten worthwhile pjit edges during retrace so elementwise chains
+    that today STOP at the container boundary (`_detect_chains` works one
+    scope at a time) become contiguous in the caller and fusable.  Only
+    pjit is inlined — flattening a scan would unroll the loop, and cond
+    branches are control flow, not a boundary between chain halves.  A
+    pjit is worthwhile when its body is small and carries at least one
+    chain-eligible elementwise eqn (directly or through a nested pjit);
+    pjits with donated invars are left alone — inlining would silently
+    drop the buffer-aliasing hint."""
+
+    def __init__(self, ctx: RewriteContext):
+        self.ctx = ctx
+        self.min_bytes = int(ctx.opt("fusion_min_bytes"))
+        self.max_eqns = int(ctx.opt("inline_fusion_max_eqns", 64))
+        self.inlined = 0
+
+    def _worthwhile(self, eqn, depth: int = 3) -> bool:
+        if eqn.primitive.name != "pjit":
+            return False
+        if any(eqn.params.get("donated_invars") or ()):
+            return False
+        body = eqn.params["jaxpr"].jaxpr
+        if len(body.eqns) > self.max_eqns:
+            return False
+        if any(_chain_eligible(e, self.min_bytes) for e in body.eqns):
+            return True
+        return depth > 0 and any(
+            e.primitive.name == "pjit" and self._worthwhile(e, depth - 1)
+            for e in body.eqns)
+
+    def _contains_worthwhile(self, jaxpr, depth: int = 4) -> bool:
+        if depth <= 0:
+            return False
+        for e in jaxpr.eqns:
+            if self._worthwhile(e):
+                return True
+            if e.primitive.name in _REBUILDABLE:
+                for _lbl, _k, _i, s in _sub_closed_params(e):
+                    if self._contains_worthwhile(_as_open(s), depth - 1):
+                        return True
+        return False
+
+    def wants(self, sub_jaxpr, path) -> bool:
+        # True for containers hiding a worthwhile pjit at ANY depth, so
+        # a scan body's pjit edges flatten while the scan itself (and
+        # its loop structure) is preserved by _rebuild_container
+        return self._contains_worthwhile(sub_jaxpr)
+
+    def on_eqn(self, eqn, path, invals, plan, read):
+        if not self._worthwhile(eqn):
+            return None
+        inner = eqn.params["jaxpr"]
+        p = format_path(path, eqn)
+        inner_path = path + (_eqn_label(eqn), "jaxpr")
+
+        def compute():
+            # boundary pins like the container path does: the inner body
+            # was typed against the original invar dtypes
+            vals = [_cast_like(read(v), v.aval) for v in eqn.invars]
+            self.inlined += 1
+            self.ctx.act(
+                "FUSION_BREAK", p,
+                f"inlined jitted fn {eqn.params.get('name', '?')!r} "
+                f"({len(inner.jaxpr.eqns)} eqn(s)) across the container "
+                "edge so its elementwise chain is contiguous with the "
+                "caller's")
+            return list(_interp(inner.jaxpr, inner.consts, vals,
+                                inner_path, self))
+
+        return ("compute", compute)
+
+
+@register_rewrite("inline_fusion", consumes=("FUSION_BREAK",))
+def rewrite_inline_fusion(ctx: RewriteContext):
+    """Cross-container chain stitching: retrace #1 inlines worthwhile
+    pjit edges (`_InlineRules`), retrace #2 runs the SAME chain detection
+    and kernel emission as the `fusion` pass over the flattened jaxpr —
+    chains that previously died at a pjit boundary are now contiguous.
+
+    The finding op-set filter is intentionally dropped for retrace #2:
+    FUSION_BREAK chains were reported against the ORIGINAL program's HLO
+    computations, and the whole point of inlining is to form chains that
+    crossed those computation boundaries, so the old op sets cannot be
+    matched back.  Applying consumes FUSION_BREAK (the later `fusion`
+    pass is then skipped); a rollback or no-op leaves the findings for
+    plain `fusion` to consume — the gate ladder never loses a fusion the
+    old pass could do.  Pure inlining with zero resulting fusions is
+    NEVER kept: flattening alone just discards container structure."""
+    inline_rules = _InlineRules(ctx)
+    flat = _retrace(ctx.closed_jaxpr, inline_rules)
+    if not inline_rules.inlined:
+        ctx.actions.clear()
+        ctx.notes.append("no worthwhile pjit edge to inline")
+        return None
+    fusion_rules = _FusionRules(ctx, finding_prims=[])
+    new_closed = _retrace(flat, fusion_rules)
+    if not fusion_rules.fused_count:
+        ctx.actions.clear()
+        ctx.notes.append("inlining produced no fusable chain")
         return None
     return new_closed
 

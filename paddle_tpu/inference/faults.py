@@ -51,8 +51,13 @@ __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
 # rule poisons the step's dispatch) and "verify" (once per dispatch
 # carrying >= 1 verify span, before the accept/reject pass — a fault
 # there fails the step like a dispatch fault, mid-speculation).
+# "fused_decode" fires right after "decode" on steps routed through the
+# fused single-dispatch path (sampling inside the dispatch): a fault
+# there lands at the exact point where the fused executable would
+# consume the donated pools, the failure shape fused serving adds.
 FAULT_POINTS = ("step", "prefill", "prefill_chunk", "draft", "decode",
-                "verify", "page_alloc", "sample", "swap_out", "swap_in")
+                "fused_decode", "verify", "page_alloc", "sample",
+                "swap_out", "swap_in")
 
 # the Router's named injection points — fleet-tier failure shapes.
 #   replica_death:    fired per replica on each health tick; a match makes
@@ -74,7 +79,7 @@ FLEET_FAULT_POINTS = ("replica_death", "slow_replica", "health_flap",
 # points where a `consume_pools` rule is meaningful: the engine passes its
 # (to-be-donated or read) pools in the fire() context there
 _DISPATCH_POINTS = ("prefill", "prefill_chunk", "draft", "decode",
-                    "verify", "swap_out", "swap_in")
+                    "fused_decode", "verify", "swap_out", "swap_in")
 
 
 class InjectedFault(RuntimeError):
@@ -590,8 +595,9 @@ class ScriptedEngine(_llm.LLMEngine):
     Everything the fleet tier exercises is the genuine article: admission,
     chunked ragged scheduling, page allocation, preemption (swap and
     recompute, including mid-prefill victims), deadlines, cancellation,
-    shutdown, the metrics registry, and every fault point.  Only the four
-    compute callables (_ragged/_swap_out/_swap_in/_sample) are replaced,
+    shutdown, the metrics registry, and every fault point.  Only the
+    compute callables (_ragged/_ragged_fused/_swap_out/_swap_in/_sample)
+    are replaced,
     which makes a step pure python — fast enough that tier-1 can afford
     whole-fleet chaos schedules.
 
@@ -609,9 +615,7 @@ class ScriptedEngine(_llm.LLMEngine):
                          **kw)
         V = cfg.vocab_size
 
-        def fake_ragged(params, tok, row_page, row_off, row_pos,
-                        block_seq, block_qpos, span_len, ctx_len, span_pt,
-                        out_rows, k_pool, v_pool):
+        def _fake_logits():
             # logits rows [out_start, out_start+out_len) belong to span i
             # of engine._batch_spans; only spans that SAMPLE (decode, a
             # chunk completing a fresh prefill, or every row of a verify
@@ -638,9 +642,28 @@ class ScriptedEngine(_llm.LLMEngine):
                     seqs = [[int(t) for t in st.pending[:st.ctx + n]]]
                 for j, seq in enumerate(seqs):
                     logits[o0 + j, _script_next(seq, V)] = 1.0
-            return logits, k_pool, v_pool
+            return logits
+
+        def fake_ragged(params, tok, row_page, row_off, row_pos,
+                        block_seq, block_qpos, span_len, ctx_len, span_pt,
+                        out_rows, k_pool, v_pool):
+            return _fake_logits(), k_pool, v_pool
+
+        def fake_ragged_fused(params, tok, row_page, row_off, row_pos,
+                              block_seq, block_qpos, span_len, ctx_len,
+                              span_pt, out_rows, key, k_pool, v_pool):
+            # the scripted model is deterministic (one-hot logits), so
+            # device-side sampling degenerates to the same argmax the
+            # scripted _sample performs — fused and unfused scripted
+            # engines emit identical chains, like the real ones
+            toks = np.argmax(_fake_logits(), axis=-1).astype(np.int32)
+            return toks, k_pool, v_pool
 
         self._ragged = fake_ragged
+        self._ragged_fused = fake_ragged_fused
+        # keep scripted steps pure python: the fused route threads a key
+        # per step and the scripted compute ignores it
+        self._next_key = lambda: None
         self._swap_out = lambda k, v, idx: (np.zeros((1,), np.float32),
                                             np.zeros((1,), np.float32))
         self._swap_in = lambda k, v, idx, hk, hv: (k, v)

@@ -74,6 +74,7 @@ import collections.abc
 import functools
 import threading
 import time
+import warnings
 from typing import List, Optional, Sequence
 
 import numpy as np
@@ -82,6 +83,7 @@ import jax
 import jax.numpy as jnp
 
 from . import prefix as _prefix
+from .. import kernels
 from ..models import generation
 from ..obs import metrics as obs_metrics
 from ..obs import reqtrace as obs_reqtrace
@@ -455,7 +457,8 @@ class LLMEngine:
                  slo_objectives=None,
                  slo_window_s: float = 60.0,
                  stepprof: Optional[obs_stepprof.StepProfiler] = None,
-                 watchdog: Optional[obs_watchdog.Watchdog] = None):
+                 watchdog: Optional[obs_watchdog.Watchdog] = None,
+                 fused_decode: bool = True):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -540,7 +543,8 @@ class LLMEngine:
                 "give each engine its own Registry")
         self.stats = _StatsDict(self.metrics, (
             "accepted", "admitted", "completed", "decode_steps",
-            "decode_tokens", "prefill_chunks", "prefill_tokens",
+            "decode_tokens", "fused_decode_steps",
+            "prefill_chunks", "prefill_tokens",
             "ragged_batch_tokens", "verify_tokens", "spec_steps",
             "spec_drafted", "spec_accepted", "spec_rejected", "spec_bonus",
             "spec_emitted", "preemptions", "swapped_in", "resumed",
@@ -616,6 +620,11 @@ class LLMEngine:
         # per-generation kernel autotuner caches tuned winners under
         self._shape_class = (f"T{self._num_blocks * self.block_q}"
                              f"xS{self._num_spans}xO{self._num_out}")
+        # the fused single-dispatch decode step profiles under its own
+        # key: the same batch geometry but a different executable (the
+        # sampling epilogue rides inside), so an autotuner/stepprof
+        # track must never mix the two dispatch shapes
+        self._shape_class_fused = self._shape_class + "+fused"
         # KV-pool & scheduler memory telemetry, sampled every step:
         # watermarks and fragmentation are step-thread-owned floats the
         # gauges read lazily (same freshness contract as the pool
@@ -690,6 +699,43 @@ class LLMEngine:
             return logits, pools["k"], pools["v"]
 
         self._ragged = _ragged
+
+        # THE fused variant: same trunk, but the lm_head matmul +
+        # temperature/top-k/top-p filtering + categorical sampling run
+        # INSIDE the dispatch (kernels.fused_decode_step), so a plain
+        # decode step pulls (num_out,) int32 token ids instead of the
+        # (num_out, V) f32 logits block.  The PRNG key is a traced ARG
+        # (the knobs are engine-lifetime statics), so this too compiles
+        # exactly once; pools donated the same way.
+        t_, tk_, tp_ = self.temperature, self.top_k, self.top_p
+
+        @functools.partial(jax.jit, donate_argnums=(12, 13))
+        def _ragged_fused(params, tok, row_page, row_off, row_pos,
+                          block_seq, block_qpos, span_len, ctx_len,
+                          span_pt, out_rows, key, k_pool, v_pool):
+            toks, pools = generation.forward_ragged_sample(
+                params, tok, cfg, {"k": k_pool, "v": v_pool}, row_page,
+                row_off, row_pos, block_seq, block_qpos, span_len,
+                ctx_len, span_pt, out_rows, key, temperature=t_,
+                top_k=tk_, top_p=tp_)
+            return toks, pools["k"], pools["v"]
+
+        self._ragged_fused = _ragged_fused
+        # verify-or-rollback, never silent: the fused epilogue must
+        # prove itself token-exact (greedy) / chi-square-clean (sampled)
+        # against the unfused reference before any traffic routes
+        # through it.  self_check is memoized per knob set, so fleets of
+        # engines pay once per process.
+        self.fused_decode = bool(fused_decode)
+        if self.fused_decode:
+            ok, why = kernels.fused_decode_self_check(
+                self.temperature, self.top_k, self.top_p)
+            if not ok:
+                warnings.warn(
+                    f"fused decode step disabled, falling back to the "
+                    f"unfused dispatch+sample path: {why}",
+                    RuntimeWarning, stacklevel=2)
+                self.fused_decode = False
         # the span descriptors of the batch being dispatched, in logits
         # row order: (slot, kind, n_tokens) — ScriptedEngine's fake
         # compute and the one-dispatch tests read this.  _batch_out is
@@ -764,6 +810,16 @@ class LLMEngine:
             jax.ShapeDtypeStruct(pools["k"].shape, pools["k"].dtype),
             jax.ShapeDtypeStruct(pools["v"].shape, pools["v"].dtype),
         )
+
+    def ragged_fused_probe_args(self) -> tuple:
+        """`ragged_probe_args` plus the threaded PRNG key, in
+        `_ragged_fused` arg order — the graphlint probe for the fused
+        single-dispatch decode step.  Same single-signature contract:
+        the fused executable must also compile exactly once."""
+        base = self.ragged_probe_args()
+        key = np.asarray(self._key)
+        return base[:11] + (
+            jax.ShapeDtypeStruct(key.shape, key.dtype),) + base[11:]
 
     # -- client surface -----------------------------------------------------
 
@@ -1804,28 +1860,59 @@ class LLMEngine:
         # -- 4. ONE dispatch for the whole mixed batch --------------------
         n_verify = sum(1 for _s, k, _n in self._batch_spans
                        if k == "verify")
+        # plain steps (no verify spans) route through the fused
+        # single-dispatch executable: sampling happens device-side
+        # inside the SAME dispatch and only token ids cross the host
+        # boundary.  Verify steps need the full logits block host-side
+        # for accept/reject, so they keep the unfused path.  Both paths
+        # advance the engine key exactly once per plain step, and the
+        # fused kernel's Gumbel-max construction reproduces
+        # jax.random.categorical draw-for-draw — so toggling
+        # `fused_decode` never changes the emitted token stream.
+        use_fused = self.fused_decode and n_verify == 0
         try:
             with self.tracer.span("decode_step", active=len(spans),
                                   decode=len(decode_slots) - n_verify,
                                   verify=n_verify,
                                   chunks=len(sched)) as sp, \
                  prof.phase("dispatch",
-                            shape_class=self._shape_class) as ph:
+                            shape_class=(self._shape_class_fused
+                                         if use_fused
+                                         else self._shape_class)) as ph:
                 self._fire("decode", pools=cache.pools)
-                logits, k_pool, v_pool = self._ragged(
-                    self.params, jnp.asarray(batch["tok"]),
-                    jnp.asarray(batch["row_page"]),
-                    jnp.asarray(batch["row_off"]),
-                    jnp.asarray(batch["row_pos"]),
-                    jnp.asarray(batch["block_seq"]),
-                    jnp.asarray(batch["block_qpos"]),
-                    jnp.asarray(batch["span_len"]),
-                    jnp.asarray(batch["ctx_len"]),
-                    jnp.asarray(batch["span_pt"]),
-                    jnp.asarray(batch["out_rows"]),
-                    cache.pools["k"], cache.pools["v"])
-                sp.fence(logits)
-                ph.fence(logits)
+                if use_fused:
+                    self._fire("fused_decode", pools=cache.pools)
+                    toks, k_pool, v_pool = self._ragged_fused(
+                        self.params, jnp.asarray(batch["tok"]),
+                        jnp.asarray(batch["row_page"]),
+                        jnp.asarray(batch["row_off"]),
+                        jnp.asarray(batch["row_pos"]),
+                        jnp.asarray(batch["block_seq"]),
+                        jnp.asarray(batch["block_qpos"]),
+                        jnp.asarray(batch["span_len"]),
+                        jnp.asarray(batch["ctx_len"]),
+                        jnp.asarray(batch["span_pt"]),
+                        jnp.asarray(batch["out_rows"]),
+                        self._next_key(),
+                        cache.pools["k"], cache.pools["v"])
+                    logits = None
+                    sp.fence(toks)
+                    ph.fence(toks)
+                else:
+                    logits, k_pool, v_pool = self._ragged(
+                        self.params, jnp.asarray(batch["tok"]),
+                        jnp.asarray(batch["row_page"]),
+                        jnp.asarray(batch["row_off"]),
+                        jnp.asarray(batch["row_pos"]),
+                        jnp.asarray(batch["block_seq"]),
+                        jnp.asarray(batch["block_qpos"]),
+                        jnp.asarray(batch["span_len"]),
+                        jnp.asarray(batch["ctx_len"]),
+                        jnp.asarray(batch["span_pt"]),
+                        jnp.asarray(batch["out_rows"]),
+                        cache.pools["k"], cache.pools["v"])
+                    sp.fence(logits)
+                    ph.fence(logits)
             cache.pools = {"k": k_pool, "v": v_pool}
             # the verify point wraps the accept/reject pass's input: a
             # fault here (incl. consume_pools on the freshly-swapped
@@ -1834,7 +1921,12 @@ class LLMEngine:
                 self._fire("verify", pools=cache.pools)
             with self.tracer.span("sample"), prof.phase("sample"):
                 self._fire("sample")
-                if n_verify == 0:
+                if use_fused:
+                    # tokens were sampled inside the dispatch; the
+                    # sample phase is just the (num_out,) int32 pull
+                    nxt = np.asarray(toks)
+                    lg = None
+                elif n_verify == 0:
                     # no verify spans this step (speculation off, or the
                     # drafter proposed nothing): sample on device — do
                     # not pull the full (num_out, V) logits block to
@@ -1867,6 +1959,8 @@ class LLMEngine:
             if decode_slots:
                 self.stats["decode_steps"] += 1
                 self.stats["decode_tokens"] += len(decode_slots) - n_verify
+            if use_fused:
+                self.stats["fused_decode_steps"] += 1
             if n_verify:
                 self.stats["verify_tokens"] += n_verify_rows
             if sched:

@@ -194,6 +194,49 @@ def ragged_attention(q, k_pool, v_pool, span_pt, block_seq, block_qpos,
 
 
 # ---------------------------------------------------------------------------
+# Fused decode step (serving path: lm_head matmul + filter + sample in one
+# Pallas dispatch — the engine's plain-decode epilogue)
+# ---------------------------------------------------------------------------
+
+
+def fused_decode_step(sel, head, key, temperature: float = 0.0,
+                      top_k: int = 0, top_p: float = 1.0):
+    """Fused decode epilogue: sel (R, E) out-row hiddens @ head (E, V),
+    temperature/top-k/top-p filtering, categorical sampling (Gumbel-max,
+    draw-for-draw identical to `generation.sample_logits` under the same
+    key) — ONE pallas_call returning (R,) int32 token ids.
+
+    Pallas kernel on TPU; the SAME kernel through the Pallas interpreter
+    on CPU; the unfused matmul+sample_logits reference as the fallback.
+    Greedy is token-exact vs the reference on every path."""
+    from .pallas_decode_step import (decode_step_reference,
+                                     fused_decode_step_pallas)
+
+    if framework.get_state().flags.get("FLAGS_use_fused_kernels", True):
+        try:
+            return fused_decode_step_pallas(sel, head, key,
+                                            temperature=temperature,
+                                            top_k=top_k, top_p=top_p,
+                                            interpret=not _on_tpu())
+        except Exception:  # noqa: BLE001 — fall back on any lowering issue
+            _warn_pallas_fallback("fused_decode_step")
+    return decode_step_reference(sel, head, key, temperature=temperature,
+                                 top_k=top_k, top_p=top_p)
+
+
+def fused_decode_self_check(temperature: float = 0.0, top_k: int = 0,
+                            top_p: float = 1.0):
+    """(ok, reason) verify-or-rollback gate for the fused decode kernel:
+    greedy token-exact + chi-square sampled equality vs the reference
+    epilogue (memoized per knob set).  The engine consults this before
+    routing plain decode through the fused dispatch."""
+    from .pallas_decode_step import self_check
+
+    return self_check(float(temperature), int(top_k), float(top_p),
+                      interpret=not _on_tpu())
+
+
+# ---------------------------------------------------------------------------
 # Grouped matmul (dropless MoE dispatch: ragged per-expert FFN)
 # ---------------------------------------------------------------------------
 

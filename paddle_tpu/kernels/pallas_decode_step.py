@@ -1,0 +1,185 @@
+"""Fused decode-step epilogue: lm_head matmul + temperature/top-k/top-p
+filtering + categorical sampling in ONE Pallas dispatch.
+
+The decode inner loop's tail used to be three hops: the ragged forward
+dispatch returned `(rows, V)` f32 logits, the host pulled them, and a
+SECOND device round-trip (or eager op chain) sampled — per token.  This
+kernel folds the tail into the forward dispatch itself: the engine's
+fused path calls `forward_ragged_sample`, which ends in this kernel, and
+the host pulls `(rows,)` int32 token ids.  One dispatch, no per-token
+`(rows, V)` host transfer.
+
+Sampling is device-side via the Gumbel-max construction — argmax over
+`filtered_logits + gumbel(key)` — which is EXACTLY what
+`jax.random.categorical` computes for a given key (same noise shape,
+same key), so the fused path is not merely distribution-equal to
+`generation.sample_logits`, it is draw-for-draw identical under the same
+threaded PRNG key.  Greedy (temperature == 0) is a plain argmax: token-
+exact vs the unfused epilogue by construction.  The filtering math is
+`generation.filter_logits` — the SAME function the unfused sampler uses,
+traced into the kernel body, so fused and unfused can only diverge in
+the matmul (f32 on the interpret path: bit-identical).
+
+Gate: `self_check()` runs the kernel against the reference epilogue on
+random probes (greedy token-exact always; a chi-square
+`equiv.verify_sampled` pass when a sampled config is given) and the
+engine refuses to route through the fused path unless it passes —
+verify-or-rollback, never silent (`llm_engine` warns when it falls
+back).
+
+Cost hooks: `_decode_step_kernel` registers whole-call FLOPs/bytes
+formulas so graphlint's cost roll-up ranks the fused dispatch alongside
+plain XLA eqns instead of scoring the opaque pallas_call zero.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..analysis import cost as _cost
+
+__all__ = ["fused_decode_step_pallas", "decode_step_reference",
+           "self_check"]
+
+
+def _filter_logits(logits, temperature, top_k, top_p):
+    # lazy import: models.generation imports kernels at module top
+    from ..models import generation
+
+    return generation.filter_logits(logits, temperature, top_k, top_p)
+
+
+def _make_kernel(temperature: float, top_k: int, top_p: float):
+    """Kernel over full blocks: sel (R, E), head (E, V), gumbel (R, V)
+    f32 ((1, 1) dummy for greedy — never read), out (R, 1) i32.  The
+    sampling knobs are STATIC (engine-lifetime constants), closed over so
+    the traced body contains only the live branch."""
+
+    def _decode_step_kernel(sel_ref, head_ref, g_ref, tok_ref):
+        logits = jnp.dot(sel_ref[...], head_ref[...],
+                         preferred_element_type=jnp.float32)
+        if temperature != 0.0:
+            logits = _filter_logits(logits, temperature, top_k, top_p)
+            # Gumbel-max: -inf stays -inf (masked tokens never win)
+            logits = logits + g_ref[...]
+        tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        tok_ref[...] = tok[:, None]
+
+    return _decode_step_kernel
+
+
+def fused_decode_step_pallas(sel, head, key, temperature: float = 0.0,
+                             top_k: int = 0, top_p: float = 1.0,
+                             interpret: bool = True):
+    """sel: (R, E) hidden rows at the out positions; head: (E, V) lm
+    head; key: threaded PRNG key (ignored for greedy).  Returns (R,)
+    int32 sampled/argmax token ids — the ONLY thing the host needs."""
+    R, _E = sel.shape
+    V = head.shape[-1]
+    head = head.astype(sel.dtype)
+    if temperature == 0.0:
+        gumbel = jnp.zeros((1, 1), jnp.float32)
+    else:
+        # same construction jax.random.categorical uses internally, so a
+        # caller holding the same key gets the identical draw
+        gumbel = jax.random.gumbel(key, (R, V), jnp.float32)
+    out = pl.pallas_call(
+        _make_kernel(float(temperature), int(top_k), float(top_p)),
+        out_shape=jax.ShapeDtypeStruct((R, 1), jnp.int32),
+        interpret=interpret,
+    )(sel, head, gumbel)
+    return out[:, 0]
+
+
+def decode_step_reference(sel, head, key, temperature: float = 0.0,
+                          top_k: int = 0, top_p: float = 1.0):
+    """Unfused epilogue — exactly the `forward_ragged` tail followed by
+    `generation.sample_logits`: the ground truth the kernel is gated
+    against, and the fallback when the kernel cannot lower."""
+    from ..models import generation
+
+    logits = (sel @ head.astype(sel.dtype)).astype(jnp.float32)
+    return generation.sample_logits(logits, key, temperature, top_k, top_p)
+
+
+# ---------------------------------------------------------------------------
+# verify-or-rollback self-check (memoized per (knobs, backend) per process)
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=16)
+def self_check(temperature: float = 0.0, top_k: int = 0,
+               top_p: float = 1.0, interpret: bool = True,
+               seed: int = 0):
+    """(ok, reason) for the fused kernel vs the reference epilogue on
+    random probes.  Greedy must be TOKEN-EXACT (int outputs, the equiv.py
+    bar); a sampled config additionally passes `equiv.verify_sampled`'s
+    chi-square gate against `generation.filtered_probs` of the same
+    logits.  Cached: the engine calls this at construction, every
+    process pays for it once per knob set."""
+    from ..analysis import equiv
+    from ..models import generation
+
+    R, E, V = 4, 16, 64
+    kg = jax.random.PRNGKey(seed)
+    k_sel, k_head, k_draw = jax.random.split(kg, 3)
+    sel = jax.random.normal(k_sel, (R, E), jnp.float32)
+    head = jax.random.normal(k_head, (E, V), jnp.float32)
+    try:
+        fused = np.asarray(fused_decode_step_pallas(
+            sel, head, k_draw, temperature=0.0, interpret=interpret))
+        ref = np.asarray(decode_step_reference(sel, head, k_draw,
+                                               temperature=0.0))
+    except Exception as e:  # noqa: BLE001 — lowering failure = rollback
+        return False, f"fused decode kernel failed: {type(e).__name__}: {e}"
+    if fused.shape != ref.shape or not (fused == ref).all():
+        return False, ("fused decode kernel not token-exact vs reference "
+                       "on greedy probes (integer outputs must be exact)")
+    if temperature == 0.0:
+        return True, ""
+
+    logits = np.asarray((sel @ head).astype(jnp.float32))
+    probs = generation.filtered_probs(logits, float(temperature),
+                                      int(top_k), float(top_p))[0]
+
+    def draw(k):
+        return fused_decode_step_pallas(
+            sel[:1], head, k, temperature=temperature, top_k=top_k,
+            top_p=top_p, interpret=interpret)[0]
+
+    res = equiv.verify_sampled(draw, probs, n_draws=2000, seed=seed)
+    if not res.ok:
+        return False, f"fused decode sampling gate failed: {res.reason}"
+    return True, ""
+
+
+# ---------------------------------------------------------------------------
+# graphlint cost hooks: invars reach the kernel as (sel, head, gumbel)
+# ---------------------------------------------------------------------------
+
+
+def _decode_flops(eqn) -> float:
+    sel, head = eqn.invars[0].aval, eqn.invars[1].aval
+    R, E = sel.shape
+    V = head.shape[-1]
+    # lm_head matmul dominates; filtering/sampling epilogue ~ a few
+    # elementwise+sort passes over (R, V)
+    return 2.0 * R * E * V + 8.0 * R * V
+
+
+def _decode_bytes(eqn) -> float:
+    total = 0
+    for v in list(eqn.invars) + list(eqn.outvars):
+        if hasattr(v, "aval") and v.aval.shape is not None:
+            total += int(np.prod(v.aval.shape, dtype=np.int64)) \
+                * np.dtype(v.aval.dtype).itemsize
+    return float(total)
+
+
+_cost.register_pallas_flops("_decode_step_kernel", _decode_flops)
+_cost.register_pallas_bytes("_decode_step_kernel", _decode_bytes)

@@ -16,10 +16,14 @@ to jaxpr eqn spans and hands this module the span as a pure closure
                          available but its pjit eqn re-enters the
                          donation checker's field of view).
 
-The kernel name carries the chain length (``_fused_chain<N>_kernel``) so
-the registered cost formula stays truthful: N flops per output element;
-bytes fall out of the generic operand+result rule, which for a fused
-elementwise call IS the real HBM traffic.
+The kernel name carries the chain length and a caller-supplied SITE tag
+(``_fused_chain<N>_s<site>_kernel``) so the registered cost formula
+stays truthful — N flops per output element — and two equal-length
+chains fused in ONE target never alias: without the site tag their
+kernels are name-identical, so per-kernel cost attribution and stepprof
+shape-class keys silently merge.  Bytes fall out of the generic
+operand+result rule, which for a fused elementwise call IS the real HBM
+traffic.
 
 Differentiation: `jax.custom_vjp` around the pallas path — forward runs
 the kernel, backward runs `jax.vjp` of the pure chain closure (exact,
@@ -52,23 +56,29 @@ def _rows_block(n_rows: int) -> int:
     return max(block, 1)
 
 
-def _make_kernel(chain_fn, n_inputs: int, n_ops: int):
+def _chain_name(n_ops: int, site: str) -> str:
+    # chain length FIRST (the `fused_chain(\d+)` cost key parses it),
+    # site tag second; empty site keeps the historical name
+    return f"fused_chain{n_ops}" + (f"_s{site}" if site else "")
+
+
+def _make_kernel(chain_fn, n_inputs: int, n_ops: int, site: str = ""):
     def kernel(*refs):
         ins, o_ref = refs[:n_inputs], refs[n_inputs]
         o_ref[...] = chain_fn(*(r[...] for r in ins))
 
-    kernel.__name__ = f"_fused_chain{n_ops}_kernel"
+    kernel.__name__ = f"_{_chain_name(n_ops, site)}_kernel"
     return kernel
 
 
-def _pallas_chain(chain_fn, n_ops: int, interpret: bool):
+def _pallas_chain(chain_fn, n_ops: int, interpret: bool, site: str = ""):
     def call(*xs):
         shape, dtype = xs[0].shape, xs[0].dtype
         last = shape[-1] if len(shape) else 1
         flat = [x.reshape(-1, last) for x in xs]
         rows = flat[0].shape[0]
         br = _rows_block(rows)
-        kernel = _make_kernel(chain_fn, len(xs), n_ops)
+        kernel = _make_kernel(chain_fn, len(xs), n_ops, site)
         out = pl.pallas_call(
             kernel,
             grid=(rows // br,),
@@ -83,7 +93,8 @@ def _pallas_chain(chain_fn, n_ops: int, interpret: bool):
     return call
 
 
-def fused_elementwise_chain(chain_fn, n_ops: int, mode: str = "auto"):
+def fused_elementwise_chain(chain_fn, n_ops: int, mode: str = "auto",
+                            site: str = ""):
     """One fused call for an elementwise chain.
 
     chain_fn: pure closure over same-shape/same-dtype arrays returning
@@ -93,6 +104,9 @@ def fused_elementwise_chain(chain_fn, n_ops: int, mode: str = "auto"):
     or "jit" (a named jitted closure; NOTE the resulting pjit eqn is
     visible to the donation checker, so the rewrite engine's re-lint
     gate may reject it when the chain input aval-matches the output).
+    site: short stable tag of the fusion SITE (the rewrite engine hashes
+    the eqn path) baked into the kernel name, so equal-length chains in
+    one target stay distinguishable to cost/stepprof attribution.
     """
     if mode not in ("auto", "pallas", "jit"):
         raise ValueError(f"fused chain mode must be auto/pallas/jit, "
@@ -101,10 +115,11 @@ def fused_elementwise_chain(chain_fn, n_ops: int, mode: str = "auto"):
     if mode == "auto":
         mode = "pallas"
     if mode == "jit":
-        chain_fn.__name__ = f"fused_chain{n_ops}"
+        chain_fn.__name__ = _chain_name(n_ops, site)
         return jax.jit(chain_fn)
 
-    pallas_fwd = _pallas_chain(chain_fn, n_ops, interpret=not on_tpu)
+    pallas_fwd = _pallas_chain(chain_fn, n_ops, interpret=not on_tpu,
+                               site=site)
 
     @jax.custom_vjp
     def fused(*xs):

@@ -131,12 +131,18 @@ def forward_with_cache(params, input_ids, config, cache, pos, ffn_fn=None,
     return logits, {"k": ck_new, "v": cv_new}
 
 
-def sample_logits(logits, key, temperature: float = 1.0, top_k: int = 0,
+def filter_logits(logits, temperature: float = 1.0, top_k: int = 0,
                   top_p: float = 1.0):
-    """Jittable sampling: greedy (temperature == 0) / temperature /
-    top-k / nucleus.  logits: (B, V) f32 -> (B,) int32."""
+    """Jittable temperature / top-k / nucleus filtering — the ONE
+    device-side definition of the sampling distribution, shared by
+    `sample_logits`, the fused decode-step kernel
+    (kernels/pallas_decode_step.py) and its fallback, so the fused and
+    unfused engine paths sample from identical logits by construction.
+    logits: (B, V) f32 -> (B, V) f32 with masked entries at -inf.
+    temperature == 0 is the caller's greedy case: filtering is an
+    identity there (argmax ignores scale)."""
     if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return logits
     logits = logits / jnp.float32(max(temperature, 1e-6))
     V = logits.shape[-1]
     if top_k and top_k < V:
@@ -150,7 +156,17 @@ def sample_logits(logits, key, temperature: float = 1.0, top_k: int = 0,
         keep = (cum - probs) < top_p
         cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1)[:, None]
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+    return logits
+
+
+def sample_logits(logits, key, temperature: float = 1.0, top_k: int = 0,
+                  top_p: float = 1.0):
+    """Jittable sampling: greedy (temperature == 0) / temperature /
+    top-k / nucleus.  logits: (B, V) f32 -> (B,) int32."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filtered = filter_logits(logits, temperature, top_k, top_p)
+    return jax.random.categorical(key, filtered, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -695,17 +711,15 @@ def _block_ragged(c, x, lp, cos, sin, kp, vp, row_page, row_off, span_pt,
     return x + mlp.astype(x.dtype), kp, vp
 
 
-def forward_ragged(params, tok, config, pools, row_page, row_off, row_pos,
-                   block_seq, block_qpos, span_len, ctx_len, span_pt,
-                   out_rows, ffn_fn=None):
-    """ONE unified dispatch over a ragged batch of per-seq spans: decode
-    tokens (span_len 1) and prefill chunks (span_len > 1) together.  tok:
-    (T,) span-packed token ids; row_page/row_off/row_pos: (T,) per-row
-    scatter/rope metadata; block/span arrays as built by
-    `build_ragged_batch`; pools: the paged {"k","v"} pools.
-
-    Returns (logits (num_spans, V) f32 — one row per span, taken at its
-    LAST valid token (out_rows) — and the updated pools)."""
+def _forward_ragged_trunk(params, tok, config, pools, row_page, row_off,
+                          row_pos, block_seq, block_qpos, span_len,
+                          ctx_len, span_pt, out_rows, ffn_fn=None):
+    """Shared layer pipeline of the ragged dispatch: embed -> scanned
+    blocks (with per-row KV scatter) -> final norm -> out-row gather.
+    Returns (sel (num_out, E), head (E, V), updated pools) — the logits
+    matmul is left to the caller so `forward_ragged` (host pulls the
+    (rows, V) logits) and `forward_ragged_sample` (fused on-device
+    epilogue, tokens only) stay bit-for-bit the same up to the tail."""
     c = config
     x = jnp.take(params["embed"]["weight"], tok, axis=0)           # (T, E)
     cos_f, sin_f = llama_lib._rope_tables(c.hd, c.max_position_embeddings,
@@ -727,8 +741,46 @@ def forward_ragged(params, tok, config, pools, row_page, row_off, row_pos,
     sel = jnp.take(x, out_rows, axis=0)                 # (num_spans, E)
     head = (params["embed"]["weight"].T if c.tie_word_embeddings
             else params["lm_head"])
+    return sel, head, {"k": k_new, "v": v_new}
+
+
+def forward_ragged(params, tok, config, pools, row_page, row_off, row_pos,
+                   block_seq, block_qpos, span_len, ctx_len, span_pt,
+                   out_rows, ffn_fn=None):
+    """ONE unified dispatch over a ragged batch of per-seq spans: decode
+    tokens (span_len 1) and prefill chunks (span_len > 1) together.  tok:
+    (T,) span-packed token ids; row_page/row_off/row_pos: (T,) per-row
+    scatter/rope metadata; block/span arrays as built by
+    `build_ragged_batch`; pools: the paged {"k","v"} pools.
+
+    Returns (logits (num_spans, V) f32 — one row per span, taken at its
+    LAST valid token (out_rows) — and the updated pools)."""
+    sel, head, pools = _forward_ragged_trunk(
+        params, tok, config, pools, row_page, row_off, row_pos, block_seq,
+        block_qpos, span_len, ctx_len, span_pt, out_rows, ffn_fn=ffn_fn)
     logits = (sel @ head.astype(sel.dtype)).astype(jnp.float32)
-    return logits, {"k": k_new, "v": v_new}
+    return logits, pools
+
+
+def forward_ragged_sample(params, tok, config, pools, row_page, row_off,
+                          row_pos, block_seq, block_qpos, span_len,
+                          ctx_len, span_pt, out_rows, key,
+                          temperature: float = 0.0, top_k: int = 0,
+                          top_p: float = 1.0, ffn_fn=None):
+    """`forward_ragged` with the sampling epilogue fused on-device: the
+    lm_head matmul, temperature/top-k/top-p filtering and categorical
+    sampling run in ONE Pallas dispatch (kernels.fused_decode_step), so
+    plain-decode steps pull (num_out,) int32 token ids off the device
+    instead of (num_out, V) f32 logits.  `key` is a threaded PRNG key —
+    sampling happens device-side; greedy (temperature == 0) ignores it.
+
+    Returns (tokens (num_out,) int32, updated pools)."""
+    sel, head, pools = _forward_ragged_trunk(
+        params, tok, config, pools, row_page, row_off, row_pos, block_seq,
+        block_qpos, span_len, ctx_len, span_pt, out_rows, ffn_fn=ffn_fn)
+    toks = kernels.fused_decode_step(sel, head, key, temperature=temperature,
+                                     top_k=top_k, top_p=top_p)
+    return toks, pools
 
 
 def generate_ragged(params, input_ids, config, max_new_tokens: int,

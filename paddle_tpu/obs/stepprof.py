@@ -178,6 +178,14 @@ class StepProfiler:
         self.enabled = False
         return self
 
+    def reset_window(self) -> "StepProfiler":
+        """Drop the rolling window (steps_total keeps counting).  Benches
+        call this after warmup so one compile-bearing step cannot skew
+        the per-phase means of a short measurement window."""
+        with self._lock:
+            self._records.clear()
+        return self
+
     # -- recording ----------------------------------------------------------
 
     def _stack(self) -> List[_Phase]:

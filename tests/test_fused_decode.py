@@ -1,0 +1,318 @@
+"""Fused decode step (single-dispatch inner loop): kernel-level
+equivalence gates (greedy token-exact, sampled draw-for-draw identical
+to `generation.sample_logits`), the chi-square verify gate's negative
+control, engine routing parity (fused vs `generate()` and fused vs
+unfused), verify-or-rollback never-silent fallback, preemption/resume
+over the fused path, the `fused_decode` fault point in the chaos
+harness, and the one-compile sentinel across mixed
+decode/prefill/spec steps."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.obs as obs
+from paddle_tpu import kernels
+from paddle_tpu.analysis import equiv
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.inference import faults as F
+from paddle_tpu.kernels import pallas_decode_step as pds
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _probe(R=4, E=16, V=64, seed=0):
+    kg = jax.random.PRNGKey(seed)
+    k_sel, k_head, k_draw = jax.random.split(kg, 3)
+    sel = jax.random.normal(k_sel, (R, E), jnp.float32)
+    head = jax.random.normal(k_head, (E, V), jnp.float32)
+    return sel, head, k_draw
+
+
+def _want(tiny, prompt, n, **kw):
+    cfg, params = tiny
+    return np.asarray(generation.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n, **kw))[0].tolist()
+
+
+SAMPLED = dict(temperature=0.8, top_k=8, top_p=0.9)
+
+
+# -- the kernel against its reference epilogue ------------------------------
+
+class TestKernel:
+    @pytest.mark.parametrize("R,E,V", [(1, 8, 32), (4, 16, 64),
+                                       (7, 16, 128)])
+    def test_greedy_token_exact(self, R, E, V):
+        sel, head, key = _probe(R, E, V, seed=R)
+        fused = np.asarray(pds.fused_decode_step_pallas(sel, head, key))
+        ref = np.asarray(pds.decode_step_reference(sel, head, key))
+        assert fused.shape == (R,) and fused.dtype == np.int32
+        assert (fused == ref).all()
+
+    @pytest.mark.parametrize("knobs", [
+        dict(temperature=1.0), dict(temperature=0.8, top_k=8),
+        SAMPLED, dict(temperature=1.3, top_p=0.7)],
+        ids=["temp", "temp+topk", "temp+topk+topp", "temp+topp"])
+    def test_sampled_draw_for_draw_identical(self, knobs):
+        """Not merely distribution-equal: the Gumbel-max construction
+        with the same key yields the IDENTICAL draw the unfused
+        `sample_logits` epilogue produces — every trial, every row."""
+        sel, head, _ = _probe(R=5)
+        for s in range(6):
+            key = jax.random.PRNGKey(100 + s)
+            fused = np.asarray(pds.fused_decode_step_pallas(
+                sel, head, key, **knobs))
+            ref = np.asarray(pds.decode_step_reference(
+                sel, head, key, **knobs))
+            assert (fused == ref).all(), (s, knobs)
+
+    def test_sampled_matches_sample_logits_directly(self):
+        """decode_step_reference is itself gated above; also pin the
+        fused kernel straight against `generation.sample_logits` on the
+        explicit logits so the chain of equalities has no gap."""
+        sel, head, _ = _probe(R=3)
+        logits = (sel @ head).astype(jnp.float32)
+        for s in range(4):
+            key = jax.random.PRNGKey(s)
+            fused = np.asarray(pds.fused_decode_step_pallas(
+                sel, head, key, **SAMPLED))
+            direct = np.asarray(generation.sample_logits(
+                logits, key, **SAMPLED))
+            assert (fused == direct).all(), s
+
+    @pytest.mark.parametrize("knobs", [
+        dict(), dict(temperature=1.0), SAMPLED],
+        ids=["greedy", "temp", "temp+topk+topp"])
+    def test_self_check_passes(self, knobs):
+        ok, why = kernels.fused_decode_self_check(
+            knobs.get("temperature", 0.0), knobs.get("top_k", 0),
+            knobs.get("top_p", 1.0))
+        assert ok, why
+
+    def test_verify_sampled_negative_control(self):
+        """The chi-square gate must REJECT a sampler whose distribution
+        is wrong — a gate that passes everything gates nothing.  Feed it
+        the fused kernel's (correct) draws against deliberately wrong
+        expected probs (uniform over the vocab, while top-k/top-p mask
+        most of it)."""
+        sel, head, _ = _probe(R=1)
+        V = head.shape[-1]
+
+        def draw(k):
+            return pds.fused_decode_step_pallas(sel, head, k, **SAMPLED)[0]
+
+        res = equiv.verify_sampled(draw, np.full(V, 1.0 / V),
+                                   n_draws=2000, seed=0)
+        assert not res.ok
+
+    def test_verify_sampled_positive(self):
+        sel, head, _ = _probe(R=1)
+        logits = np.asarray((sel @ head).astype(jnp.float32))
+        probs = generation.filtered_probs(
+            logits, SAMPLED["temperature"], SAMPLED["top_k"],
+            SAMPLED["top_p"])[0]
+
+        def draw(k):
+            return pds.fused_decode_step_pallas(sel, head, k, **SAMPLED)[0]
+
+        res = equiv.verify_sampled(draw, probs, n_draws=2000, seed=1)
+        assert res.ok, res.reason
+
+
+# -- engine routing: parity, rollback, attribution --------------------------
+
+def _engine(tiny, **kw):
+    cfg, params = tiny
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    return LLMEngine(params, cfg, **kw)
+
+
+class TestEngineRouting:
+    def test_greedy_token_exact_vs_generate(self, tiny):
+        cfg, params = tiny
+        eng = _engine(tiny)
+        rng = np.random.default_rng(0)
+        prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+                   for n in (3, 5, 2)]
+        outs = eng.generate(prompts, max_new_tokens=6)
+        for p, got in zip(prompts, outs):
+            assert got == _want(tiny, p, 6)
+        assert eng.stats["fused_decode_steps"] >= 1
+        assert eng.fused_decode
+
+    @pytest.mark.parametrize("knobs", [dict(), SAMPLED],
+                             ids=["greedy", "sampled"])
+    def test_fused_vs_unfused_identical_streams(self, tiny, knobs):
+        """Same seed, same workload: the fused engine's token streams
+        must equal the unfused engine's draw for draw (key-stream
+        parity + Gumbel-max identity), not just statistically."""
+        cfg, _ = tiny
+        rng = np.random.default_rng(1)
+        prompts = [rng.integers(0, cfg.vocab_size, n).tolist()
+                   for n in (4, 2, 6)]
+        outs = {}
+        for fused in (True, False):
+            eng = _engine(tiny, fused_decode=fused, seed=7, **knobs)
+            outs[fused] = eng.generate(prompts, max_new_tokens=8)
+            steps = eng.stats["fused_decode_steps"]
+            assert (steps >= 1) if fused else (steps == 0)
+        assert outs[True] == outs[False]
+
+    def test_rollback_never_silent(self, tiny, monkeypatch):
+        """A failing self-check must WARN and fall back to the unfused
+        path — and the fallback engine must still serve correct
+        tokens."""
+        monkeypatch.setattr(kernels, "fused_decode_self_check",
+                            lambda *a, **kw: (False, "forced by test"))
+        with pytest.warns(RuntimeWarning, match="forced by test"):
+            eng = _engine(tiny)
+        assert eng.fused_decode is False
+        prompt = [1, 2, 3]
+        assert eng.generate([prompt], max_new_tokens=4)[0] == \
+            _want(tiny, prompt, 4)
+        assert eng.stats["fused_decode_steps"] == 0
+
+    def test_fused_dispatch_has_own_shape_class(self, tiny):
+        """Stepprof attribution: fused dispatches land under their own
+        shape-class key so the fused-vs-unfused win is visible in the
+        phase table, not averaged away."""
+        eng = _engine(tiny)
+        assert eng._shape_class_fused == eng._shape_class + "+fused"
+        eng.generate([[1, 2, 3]], max_new_tokens=4)
+        classes = eng.stepprof.report()["shape_classes"]["dispatch"]
+        assert eng._shape_class_fused in classes
+        assert classes[eng._shape_class_fused]["count"] == \
+            eng.stats["fused_decode_steps"]
+
+    def test_probe_args_match_fused_signature(self, tiny):
+        """ragged_fused_probe_args() must abstract-match the compiled
+        fused executable (graphlint and MFU costing depend on it)."""
+        eng = _engine(tiny)
+        eng.generate([[1, 2]], max_new_tokens=2)     # compile it
+        args = eng.ragged_fused_probe_args()
+        jaxpr = jax.make_jaxpr(
+            lambda *a: eng._ragged_fused(*a))(*[
+                jnp.zeros(a.shape, a.dtype) if hasattr(a, "dtype") else a
+                for a in args])
+        assert jaxpr is not None
+        flops = obs.mfu.static_flops(eng._ragged_fused, *args)
+        assert flops > 0
+
+
+# -- preemption/resume over the fused path ----------------------------------
+
+class TestPreemptResume:
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_fused_tokens_exact_under_preemption(self, tiny, mode):
+        """Pool pressure forces preempt-then-resume while every plain
+        decode rides the fused dispatch; streams must still be token-
+        exact vs the unpaged reference."""
+        cfg, params = tiny
+        rng = np.random.default_rng(2)
+        eng = _engine(tiny, num_pages=5, preempt_mode=mode)
+        prompts = [rng.integers(0, cfg.vocab_size, 9).tolist()
+                   for _ in range(3)]
+        outs = eng.generate(prompts, max_new_tokens=4)
+        for p, got in zip(prompts, outs):
+            assert got == _want(tiny, p, 4)
+        assert eng.stats["preemptions"] >= 1
+        assert eng.stats["fused_decode_steps"] >= 1
+        F.check_invariants(eng)
+
+
+# -- chaos: the fused dispatch fault point ----------------------------------
+
+class TestChaosFused:
+    def test_fault_point_registered(self):
+        assert "fused_decode" in F.FAULT_POINTS
+        assert "fused_decode" in F._DISPATCH_POINTS
+
+    def test_random_schedule_can_arm_fused(self):
+        assert any(r.point == "fused_decode"
+                   for seed in range(60)
+                   for r in F.random_schedule(seed))
+
+    @pytest.mark.parametrize("consume", [False, True],
+                             ids=["plain", "consumes_donated_pools"])
+    def test_scripted_fused_fault(self, consume):
+        report = F.run_schedule(
+            lambda: F.ScriptedEngine(num_slots=2),
+            [F.FaultRule("fused_decode", nth=2, consume_pools=consume)],
+            [([1, 2, 3], 6), ([9, 8], 6)])
+        assert report["ok"], report["violations"]
+        assert any(f["point"] == "fused_decode" for f in report["fired"])
+        assert report["completed"] + report["failed"] == report["requests"]
+
+    @pytest.mark.parametrize("mode", ["swap", "recompute"])
+    def test_real_engine_fused_fault(self, tiny, mode):
+        """The fault lands exactly where the fused executable would
+        consume the donated pools; the engine must recover (rebuild
+        pools, re-serve) with zero leaks."""
+        cfg, params = tiny
+        rng = np.random.default_rng(3)
+        requests = [(rng.integers(0, cfg.vocab_size, 4).tolist(), 4)
+                    for _ in range(3)]
+        report = F.run_schedule(
+            lambda: _engine(tiny, num_pages=5, preempt_mode=mode),
+            [F.FaultRule("fused_decode", nth=2, consume_pools=True)],
+            requests)
+        assert report["ok"], report["violations"]
+        assert any(f["point"] == "fused_decode" for f in report["fired"])
+
+
+# -- one-compile sentinel across mixed decode/prefill/spec steps ------------
+
+class TestSentinel:
+    def test_fused_compiles_exactly_once_across_mixed_steps(self, tiny):
+        """Tier-1 acceptance: across plain decode, chunked prefill, and
+        speculative verify steps the fused executable compiles exactly
+        once (at warmup) and never again."""
+        cfg, params = tiny
+        eng = LLMEngine(params, cfg, num_slots=3, page_size=4,
+                        max_seq_len=64, prefill_chunk_tokens=4,
+                        block_q=2, spec_k=4)
+        # warm BOTH executables: a repetitive prompt drafts (verify
+        # steps -> _ragged), its plain steps ride _ragged_fused
+        wh = eng.submit([7, 8, 9, 7, 8, 9, 7, 8], max_new_tokens=16)
+        while not wh.done():
+            eng.step()
+        assert eng.stats["spec_steps"] >= 1
+        assert eng.stats["fused_decode_steps"] >= 1
+        sent = obs.RecompileSentinel(tracer=eng.tracer,
+                                     registry=obs.Registry())
+        sent.watch("ragged_step", eng._ragged)
+        sent.watch("ragged_step_fused", eng._ragged_fused)
+        assert sent.check() == {}
+        rng = np.random.default_rng(4)
+        handles = []
+        for n in (8, 3, 9, 5):           # mixed: drafting + random, some
+            handles.append(eng.submit(   # longer than the chunk budget
+                ([7, 8, 9] * 4)[:n] if n % 2 else
+                rng.integers(0, cfg.vocab_size, n).tolist(),
+                max_new_tokens=10))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", obs.RecompileWarning)
+            steps = 0
+            while any(not x.done() for x in handles) and steps < 500:
+                eng.step()
+                assert sent.check() == {}, \
+                    "post-warmup recompile in the fused decode step"
+                steps += 1
+        assert all(x.done() for x in handles)
+        assert sent.counts() == {"ragged_step": 0,
+                                 "ragged_step_fused": 0}

@@ -434,7 +434,11 @@ class TestEngineHardening:
         def boom(*a, **k):
             raise RuntimeError("ragged step exploded")
 
+        # plain decode routes through the fused dispatch by default;
+        # fail BOTH executables so the test covers whichever path the
+        # step picks
         eng._ragged = boom
+        eng._ragged_fused = boom
         req = eng.submit([1, 2, 3], max_new_tokens=4)
         eng.step()   # admit + the failing unified dispatch
         with pytest.raises(RuntimeError, match="ragged step exploded"):
@@ -446,15 +450,19 @@ class TestEngineHardening:
     def test_dispatch_failure_does_not_wedge_later_requests(self):
         eng = self._engine()
         real_ragged = eng._ragged
+        real_fused = eng._ragged_fused
         calls = {"n": 0}
 
-        def flaky(*a, **k):
-            calls["n"] += 1
-            if calls["n"] == 1:
-                raise RuntimeError("transient")
-            return real_ragged(*a, **k)
+        def _flaky(real):
+            def wrapper(*a, **k):
+                calls["n"] += 1
+                if calls["n"] == 1:
+                    raise RuntimeError("transient")
+                return real(*a, **k)
+            return wrapper
 
-        eng._ragged = flaky
+        eng._ragged = _flaky(real_ragged)
+        eng._ragged_fused = _flaky(real_fused)
         bad = eng.submit([1, 2, 3], max_new_tokens=2)
         eng.step()   # bad rides the failing dispatch alone
         good = eng.submit([4, 5], max_new_tokens=2)

@@ -315,6 +315,7 @@ class TestEngineSplice:
         sent = obs.RecompileSentinel(tracer=eng.tracer,
                                      registry=obs.Registry())
         sent.watch("ragged_step", eng._ragged)
+        sent.watch("ragged_step_fused", eng._ragged_fused)
         sent.watch("cow_copy", eng._cow)
         assert sent.check() == {}
         handles = [
@@ -333,7 +334,8 @@ class TestEngineSplice:
                 steps += 1
         assert all(h.done() for h in handles)
         assert eng.stats["prefix_hits"] >= 3
-        assert sent.counts() == {"ragged_step": 0, "cow_copy": 0}
+        assert sent.counts() == {"ragged_step": 0,
+                                 "ragged_step_fused": 0, "cow_copy": 0}
 
     def test_recover_pools_clears_index(self, tiny):
         """No cached prefix survives pool deallocation: recovery from a

@@ -258,12 +258,18 @@ class TestEngineRagged:
                        max_new_tokens=4)
         calls = {"n": 0}
         real = eng._ragged
+        real_fused = eng._ragged_fused
 
-        def counting(*args, **kw):
-            calls["n"] += 1
-            return real(*args, **kw)
+        def _counting(fn):
+            def wrapper(*args, **kw):
+                calls["n"] += 1
+                return fn(*args, **kw)
+            return wrapper
 
-        eng._ragged = counting
+        # plain steps route the fused dispatch by default; count BOTH
+        # executables so the one-dispatch bar holds whichever path runs
+        eng._ragged = _counting(real)
+        eng._ragged_fused = _counting(real_fused)
         snap0 = eng.stats_snapshot()
         eng.step()                 # A's decode span + B's first chunk
         assert calls["n"] == 1
@@ -276,6 +282,7 @@ class TestEngineRagged:
         assert (snap1["ragged_batch_tokens"]
                 - snap0["ragged_batch_tokens"]) == 5
         eng._ragged = real
+        eng._ragged_fused = real_fused
         while not (a.done() and b.done()):
             eng.step()
         for h in (a, b):
@@ -318,6 +325,7 @@ class TestEngineRagged:
         sent = obs.RecompileSentinel(tracer=eng.tracer,
                                      registry=obs.Registry())
         sent.watch("ragged_step", eng._ragged)
+        sent.watch("ragged_step_fused", eng._ragged_fused)
         rng = np.random.default_rng(2)
         h = eng.submit(rng.integers(0, cfg.vocab_size, 2).tolist(),
                        max_new_tokens=2)
@@ -338,4 +346,5 @@ class TestEngineRagged:
                 steps += 1
         assert all(x.done() for x in handles)
         assert eng.stats["preemptions"] >= 1   # the workload DID churn
-        assert sent.counts() == {"ragged_step": 0}
+        assert sent.counts() == {"ragged_step": 0,
+                                 "ragged_step_fused": 0}

@@ -222,6 +222,115 @@ class TestFusionPass:
                                    report=_fusion_report(), options=OPTS)
         assert rep.outcomes[0].status == "no-op"
 
+    def test_equal_length_chains_get_distinct_kernel_names(self):
+        """Two equal-length chains fused in ONE target must not emit
+        name-identical kernels: the site hash keeps their cost-formula
+        and stepprof attribution separate."""
+        def two_chains(x, z):
+            a = jnp.tanh(x)
+            a = a * a
+            a = jnp.tanh(a)
+            b = jnp.sin(z)
+            b = b * 3.0
+            return a + jnp.sin(b)
+
+        rep_in = Report([
+            Finding(Severity.WARNING, "FUSION_BREAK", "hlo:main",
+                    "chain of 3 UNFUSED elementwise ops", checker="fusion",
+                    data={"chain": ["tanh", "multiply", "tanh"],
+                          "bytes": 65536}),
+            Finding(Severity.WARNING, "FUSION_BREAK", "hlo:main",
+                    "chain of 3 UNFUSED elementwise ops", checker="fusion",
+                    data={"chain": ["sine", "multiply", "sine"],
+                          "bytes": 65536})])
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        z = jnp.linspace(-2, 2, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(two_chains, x, z, passes=["fusion"],
+                                   report=rep_in, options=OPTS)
+        assert rep.ok
+        names = [analysis.cost._pallas_kernel_name(e)
+                 for e, _p, _w in analysis.iter_eqns(fn.rewritten_jaxpr)
+                 if e.primitive.name == "pallas_call"]
+        assert len(names) == 2
+        assert names[0] != names[1]
+        assert all("_s" in n for n in names)    # the site tag is present
+        np.testing.assert_allclose(np.asarray(fn(x, z)),
+                                   np.asarray(two_chains(x, z)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# inline_fusion: chains stitched ACROSS a pjit container edge
+# ---------------------------------------------------------------------------
+
+
+@jax.jit
+def _jitted_half(y):
+    y = jnp.tanh(y)
+    return y * 2.0
+
+
+def _split_chain_fn(x):
+    # 2 eqns in the caller + 2 inside the pjit + 1 after: no single
+    # scope holds a >= 3 chain until the pjit edge is inlined
+    y = jnp.tanh(x)
+    y = y * y
+    return jnp.tanh(_jitted_half(y))
+
+
+class TestInlineFusionPass:
+    def test_plain_fusion_stops_at_the_container_edge(self):
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(_split_chain_fn, x, passes=["fusion"],
+                                   report=_fusion_report(), options=OPTS)
+        assert rep.outcomes[0].status in ("no-op", "skipped")
+        assert "pallas_call" not in _eqn_prims(fn.rewritten_jaxpr)
+
+    def test_inline_then_fuse_stitches_across_the_edge(self):
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(_split_chain_fn, x,
+                                   passes=["inline_fusion"],
+                                   report=_fusion_report(), options=OPTS)
+        (o,) = rep.outcomes
+        assert o.status == "applied" and rep.ok
+        prims = _eqn_prims(fn.rewritten_jaxpr)
+        assert "pallas_call" in prims
+        assert "pjit" not in prims          # the edge itself is gone
+        np.testing.assert_allclose(np.asarray(fn(x)),
+                                   np.asarray(_split_chain_fn(x)),
+                                   rtol=1e-6)
+        g1 = jax.grad(lambda z: _split_chain_fn(z).sum())(x)
+        g2 = jax.grad(lambda z: fn(z).sum())(x)
+        np.testing.assert_allclose(np.asarray(g2), np.asarray(g1),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_applying_consumes_the_finding_before_plain_fusion(self):
+        """Gate ladder: when inline_fusion applies it consumes
+        FUSION_BREAK, so the later plain `fusion` pass is skipped — one
+        finding never drives two rewrites."""
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(_split_chain_fn, x,
+                                   passes=["inline_fusion", "fusion"],
+                                   report=_fusion_report(), options=OPTS)
+        by_name = {o.name: o for o in rep.outcomes}
+        assert by_name["inline_fusion"].status == "applied"
+        assert by_name["fusion"].status == "skipped"
+
+    def test_no_pjit_edge_is_noop_for_inline_fusion(self):
+        """A chain already contiguous in one scope is plain `fusion`'s
+        job; inline_fusion must not claim it (pure inlining with no new
+        fusion is never kept)."""
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(_chain_fn, x, passes=["inline_fusion"],
+                                   report=_fusion_report(), options=OPTS)
+        assert rep.outcomes[0].status in ("no-op", "skipped")
+        assert "pallas_call" not in _eqn_prims(fn.rewritten_jaxpr)
+
 
 # ---------------------------------------------------------------------------
 # donation: flips donated_invars where the checker flagged
@@ -363,7 +472,7 @@ _graphlint = _load_graphlint()
 # code exercises the recursive DCE); the full sweep runs in the bench
 # round
 _GATE_TARGETS = ["llama", "moe_llama_gmm", "engine_ragged",
-                 "generate_paged"]
+                 "engine_ragged_fused", "generate_paged"]
 
 
 def test_rewrite_baseline_gate(capsys):

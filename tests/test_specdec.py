@@ -422,13 +422,21 @@ class TestRecompileAndProbe:
         recompile the unified step once."""
         cfg, params = tiny
         eng = _spec_engine(tiny, spec_k=4, num_slots=3)
+        # warmup must touch BOTH executables: plain steps (prefill and
+        # draft-less decode) ride the fused single-dispatch step, verify
+        # steps the unfused one — a repetitive prompt drafts, so driving
+        # it to completion compiles both before the sentinel baselines
+        wh = eng.submit([7, 8, 9, 7, 8, 9, 7, 8], max_new_tokens=16)
+        while not wh.done():
+            eng.step()
+        assert eng.stats["spec_steps"] >= 1      # the verify path ran
+        assert eng.stats["fused_decode_steps"] >= 1  # the fused one too
         sent = obs.RecompileSentinel(tracer=eng.tracer,
                                      registry=obs.Registry())
         sent.watch("ragged_step", eng._ragged)
-        h = eng.submit([1, 2], max_new_tokens=2)
-        eng.step()                       # warmup: the one compile
+        sent.watch("ragged_step_fused", eng._ragged_fused)
         assert sent.check() == {}
-        handles = [h]
+        handles = []
         rng = np.random.default_rng(3)
         for n in (8, 3, 9, 5):
             handles.append(eng.submit(
@@ -445,7 +453,8 @@ class TestRecompileAndProbe:
                 steps += 1
         assert all(x.done() for x in handles)
         assert eng.stats["spec_steps"] >= 1
-        assert sent.counts() == {"ragged_step": 0}
+        assert sent.counts() == {"ragged_step": 0,
+                                 "ragged_step_fused": 0}
 
     def test_probe_args_cover_verify_spans(self, tiny):
         """ragged_probe_args() reflects the speculative geometry (wider

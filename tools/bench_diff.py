@@ -48,13 +48,22 @@ _SKIP_LEAVES = {
     # measured/predicted step time: 1.0 is best, so neither direction
     # is a regression — not diffable as a scalar ordering
     "cost_model_ratio",
+    # fused-decode A/B bookkeeping: how many steps routed fused is
+    # routing shape, not a performance ordering; chunk_tokens is the
+    # per-leg workload knob
+    "fused_decode_steps", "chunk_tokens",
 }
 
 # exact leaves that are lower-better but carry no unit suffix — the
 # prefix_reuse gates: prefill work per request must SHRINK as splicing
-# serves more of each prompt
+# serves more of each prompt, and the fused-decode A/B ratio: fused
+# p50 over unfused p50, gated <= 0.9 (its _ms legs and the
+# dispatch_sample_*_ms attribution keys classify lower by suffix; the
+# ratio carries no unit, so pin it here — "itl" in the leaf would
+# already catch it, but an A/B gate must not hang off a substring)
 _LOWER_LEAVES = {
     "prefill_tokens_mean", "prefill_tokens_hit95_vs_cold",
+    "itl_fused_vs_unfused",
 }
 
 # time/size units marking a LOWER-is-better metric — matched as leaf

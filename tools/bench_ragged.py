@@ -3,6 +3,7 @@ manually; bench.py's extra.ragged stays the driver's single-line A/B).
 
 Usage:  python tools/bench_ragged.py [--budgets 4,8,16,40] [--long 40]
                                      [--streams 2] [--new-tokens 16]
+                                     [--fused on|off|ab] [--temperature T]
 
 Workload per point: `--streams` short requests decode continuously while
 one `--long`-token prompt prefills through the SAME unified ragged
@@ -22,6 +23,15 @@ Every point is ONE compiled executable regardless of prompt length (the
 batch arrays are fixed-shape) — the sweep never recompiles mid-workload,
 which is the point of killing the bucket menu.  Prints one JSON line per
 budget; nothing here is driver-consumed.
+
+`--fused` picks the decode inner loop: `on` (the shipped default — the
+fused single-dispatch step, sampling inside the dispatch), `off` (the
+unfused dispatch+sample path), or `ab` (each point runs BOTH and prints
+a line per leg tagged `"fused": true/false` — the token streams are
+identical by construction, so the diff is purely latency).  Set
+`--temperature` > 0 to make the A/B exercise the sampled epilogue the
+fusion folds in; greedy keeps the epilogue to a single argmax and the
+legs nearly tie.
 """
 
 from __future__ import annotations
@@ -49,6 +59,13 @@ def main():
     ap.add_argument("--page-size", type=int, default=4)
     ap.add_argument("--block-q", type=int, default=4)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--fused", choices=("on", "off", "ab"), default="on",
+                    help="decode inner loop: the fused single-dispatch "
+                         "step (on, default), the unfused dispatch+"
+                         "sample path (off), or both per point (ab)")
+    ap.add_argument("--temperature", type=float, default=0.0,
+                    help="sampling temperature (0 = greedy); > 0 makes "
+                         "the --fused A/B exercise the sampled epilogue")
     ap.add_argument("--spec-k", default="",
                     help="comma-separated spec_k points (e.g. 0,2,4,8): "
                          "sweep speculative draft depth instead of the "
@@ -113,37 +130,43 @@ def main():
             }))
         return 0
 
+    legs = {"on": (True,), "off": (False,), "ab": (True, False)}[args.fused]
     for budget in (int(b) for b in args.budgets.split(",")):
-        eng = LLMEngine(params, cfg, num_slots=args.streams + 2,
-                        page_size=args.page_size, max_seq_len=max_seq,
-                        prefill_chunk_tokens=budget,
-                        block_q=args.block_q)
-        eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the executable
-        hs = [eng.submit(p, max_new_tokens=args.new_tokens)
-              for p in shorts]
-        for _ in range(3):
-            eng.step()               # streams decoding before the burst
-        t0 = time.perf_counter()
-        lh = eng.submit(long_prompt, max_new_tokens=2)
-        while not lh.done() or not all(h.done() for h in hs):
-            eng.step()
-        dt = time.perf_counter() - t0
-        snap = eng.stats_snapshot()
-        lat = eng.latency_snapshot()
-        itl = lat["inter_token_s"]
-        eng.shutdown()
-        print(json.dumps({
-            "prefill_chunk_tokens": budget,
-            "long_ttft_ms": round((lh.t_first_token - lh.t_submit) * 1e3,
-                                  2),
-            "stream_itl_p50_ms": round((itl["p50"] or 0.0) * 1e3, 3),
-            "stream_itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
-            "decode_tokens_per_sec": round(snap["decode_tokens"] / dt, 2),
-            "prefill_chunks": snap["prefill_chunks"],
-            "ragged_batch_tokens": snap["ragged_batch_tokens"],
-            "steps": snap["steps_total"],
-            "wall_s": round(dt, 3),
-        }))
+        for fused in legs:
+            eng = LLMEngine(params, cfg, num_slots=args.streams + 2,
+                            page_size=args.page_size, max_seq_len=max_seq,
+                            prefill_chunk_tokens=budget,
+                            block_q=args.block_q, fused_decode=fused,
+                            temperature=args.temperature, seed=args.seed)
+            eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm the
+            hs = [eng.submit(p, max_new_tokens=args.new_tokens)
+                  for p in shorts]
+            for _ in range(3):
+                eng.step()           # streams decoding before the burst
+            t0 = time.perf_counter()
+            lh = eng.submit(long_prompt, max_new_tokens=2)
+            while not lh.done() or not all(h.done() for h in hs):
+                eng.step()
+            dt = time.perf_counter() - t0
+            snap = eng.stats_snapshot()
+            lat = eng.latency_snapshot()
+            itl = lat["inter_token_s"]
+            eng.shutdown()
+            print(json.dumps({
+                "prefill_chunk_tokens": budget,
+                "fused": bool(fused),
+                "long_ttft_ms": round(
+                    (lh.t_first_token - lh.t_submit) * 1e3, 2),
+                "stream_itl_p50_ms": round((itl["p50"] or 0.0) * 1e3, 3),
+                "stream_itl_p99_ms": round((itl["p99"] or 0.0) * 1e3, 3),
+                "decode_tokens_per_sec": round(
+                    snap["decode_tokens"] / dt, 2),
+                "fused_decode_steps": snap["fused_decode_steps"],
+                "prefill_chunks": snap["prefill_chunks"],
+                "ragged_batch_tokens": snap["ragged_batch_tokens"],
+                "steps": snap["steps_total"],
+                "wall_s": round(dt, 3),
+            }))
     return 0
 
 

@@ -12,6 +12,9 @@ Targets (default: all):
   engine_ragged      LLMEngine's ONE jitted unified step: decode spans and
                      prefill chunks in the same ragged batch (single
                      signature — expected_signatures defaults to 1)
+  engine_ragged_fused  the fused single-dispatch decode step (sampling
+                     epilogue inside the dispatch) plain decode routes
+                     through by default — same one-signature contract
   engine_swap_out    LLMEngine's preemption page-gather (KV -> host)
   engine_swap_in     LLMEngine's resume page-scatter (host -> fresh pages)
 
@@ -194,6 +197,16 @@ def target_engine_ragged():
     return eng._ragged, eng.ragged_probe_args(), {}
 
 
+def target_engine_ragged_fused():
+    # the fused single-dispatch decode step: the SAME trunk plus the
+    # lm_head matmul + filter + sample epilogue inside the dispatch
+    # (kernels/pallas_decode_step.py); plain decode steps route through
+    # it by default, so it must lint as clean as the unfused step and
+    # hold the same one-signature contract
+    eng, params = _engine()
+    return eng._ragged_fused, eng.ragged_fused_probe_args(), {}
+
+
 def target_engine_swap_out():
     # preemption swap path: gather a victim's KV pages for the host copy
     # (reads the pools — correctly NOT donated)
@@ -225,6 +238,7 @@ TARGETS = {
     "moe_llama_scatter": target_moe_llama_scatter,
     "generate_paged": target_generate_paged,
     "engine_ragged": target_engine_ragged,
+    "engine_ragged_fused": target_engine_ragged_fused,
     "engine_swap_out": target_engine_swap_out,
     "engine_swap_in": target_engine_swap_in,
 }
