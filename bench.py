@@ -732,6 +732,198 @@ def bench_prefix_reuse(dev, on_tpu):
     }
 
 
+def bench_disagg(dev, on_tpu):
+    """extra.disagg: disaggregated prefill/decode serving A/B plus the
+    tiered prefix store's warm-start win.
+
+    Leg 1 — decode ITL under a prefill burst: a 1-prefill/2-decode
+    fleet vs a 3-mixed fleet (same engines, same workload, pump-driven
+    so the measurement is deterministic in structure).  Streaming
+    requests decode while a burst of LONG prompts arrives; in the
+    disagg fleet the burst's chunked prefills land on the prefill-class
+    replica only (streams were handed off to decode-class replicas
+    whose steps stay all-decode), in the mixed fleet the burst
+    interleaves into every replica's unified step.
+
+    The structural win being priced is PER-CLASS batch geometry: every
+    mixed replica must size its unified ragged batch for the compromise
+    chunk budget (large enough that a burst's TTFT doesn't crawl), and
+    that budget's rows ride EVERY dispatch — pure-decode steps
+    included, because the batch is fixed-shape by design.  A
+    decode-class replica runs a small chunk budget (its only local
+    prefills are spliced continuations' sub-page tails and canaries),
+    so its compiled dispatch is genuinely smaller; only the
+    prefill-class replica carries the wide geometry.
+
+    ITL is measured as per-STEP time of the stream-serving replicas
+    (stepprof frames, window reset at burst submit): in the deployed
+    fleet every replica owns its accelerator, so a stream's inter-token
+    latency IS its replica's step time — while on this bench's shared
+    host, wall-clock between tokens would just re-measure how the
+    replicas timeshare one device and hide the isolation entirely.
+    Gate: `itl_burst_disagg_vs_mixed` (p99 step time of decode-class
+    replicas over p99 of the mixed replicas' steps) <= 0.8.
+
+    Leg 2 — host-tier warm start: one engine prefills a long prompt,
+    its pages are LRU-demoted into a shared TieredPrefixStore, and a
+    FRESH engine attached to the same store serves the same prompt by
+    PROMOTING the pages back (one scatter) instead of re-prefilling.
+    Gate: `ttft_warm_vs_cold` <= 0.6."""
+    import time as _time
+    import jax as _jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.kvstore import TieredPrefixStore
+    from paddle_tpu.inference.router import Router
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=8, max_position_embeddings=8192,
+            dtype=jnp.bfloat16, remat=False)
+        long_len, stream_tokens, page_size, max_seq = 2048, 48, 64, 4096
+        chunk_wide, chunk_narrow = 256, 64
+        n_streams, n_burst = 4, 4
+    else:
+        cfg = LlamaConfig.tiny()
+        # the mixed fleet (and the prefill-class replica) runs chunk=16
+        # so the 40-token bursts land in 3 chunks; decode-class replicas
+        # run chunk=4 — the sub-page continuation tails and canaries are
+        # the only prefill they ever see
+        long_len, stream_tokens, page_size, max_seq = 40, 24, 4, 64
+        chunk_wide, chunk_narrow = 16, 4
+        n_streams, n_burst = 6, 4
+
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(5))
+    rng = np.random.default_rng(0)
+    # stream prompts span TWO full pages: the warmup handoffs must carry
+    # real pages so the gather (prefill class) and scatter (decode
+    # class) executables compile during warmup, not under measurement —
+    # a zero-page handoff skips the transfer entirely
+    streams = [rng.integers(0, cfg.vocab_size, 2 * page_size).tolist()
+               for _ in range(n_streams)]
+    bursts = [rng.integers(0, cfg.vocab_size, long_len).tolist()
+              for _ in range(n_burst)]
+
+    # pool sized so burst imports never force evictions mid-measurement:
+    # an LRU demotion gathers pages to host inside the decode step, and
+    # that cost is the tiered store's price under MEMORY pressure — this
+    # leg isolates the prefill-interference question instead
+    pool_pages = 8 * (max_seq // page_size)
+
+    def mk(chunk):
+        return LLMEngine(params, cfg, num_slots=4, page_size=page_size,
+                         max_seq_len=max_seq, prefill_chunk_tokens=chunk,
+                         num_pages=pool_pages, block_q=4)
+
+    def run_fleet(roles):
+        engines = [mk(chunk_wide),
+                   mk(chunk_narrow if roles else chunk_wide),
+                   mk(chunk_narrow if roles else chunk_wide)]
+        for e in engines:
+            e.generate([[1, 2, 3]], max_new_tokens=2)  # warm executables
+        # Role flips frozen: the admission burst is exactly the
+        # transient the flip hysteresis exists to ride out, and in pump
+        # mode every pump is a tick so even long hysteresis would
+        # thrash mid-measurement.
+        router = Router(engines=engines, roles=roles,
+                        kvstore=TieredPrefixStore() if roles else None,
+                        role_flip_ticks=10 ** 9, threaded=False)
+        hs = [router.submit(p, stream_tokens) for p in streams]
+        # pump until every stream is past admission (and, disagg, past
+        # handoff) and actually decoding — the swap executables compile
+        # during THIS window, never under measurement
+        for _ in range(2000):
+            if all((len(h._hop.tokens) if h._hop is not None else 0) >= 2
+                   for h in hs):
+                break
+            router.pump()
+        burst_h = [router.submit(p, 2) for p in bursts]
+        for e in engines:
+            e.stepprof.reset_window()
+        all_h = hs + burst_h
+        for _ in range(20000):
+            if all(h.done() for h in all_h):
+                break
+            router.pump()
+        # decode ITL proxy: every step frame of the replicas that serve
+        # the streams during the burst — decode-class only (r1, r2) in
+        # the disagg fleet (imports and burst continuations ride those
+        # same steps and are deliberately charged), all three in mixed
+        stream_rids = {1, 2} if roles else {0, 1, 2}
+        step_s = [f["total_s"]
+                  for r in router.replicas if r.rid in stream_rids
+                  for f in r.engine.stepprof.record_window()]
+        snap = router.stats_snapshot()
+        router.shutdown()
+        return {
+            "itl_p50_ms": round(float(np.percentile(step_s, 50)) * 1e3, 3)
+            if step_s else None,
+            "itl_p99_ms": round(float(np.percentile(step_s, 99)) * 1e3, 3)
+            if step_s else None,
+            "steps": len(step_s),
+            "handoffs": snap["handoffs"],
+            "completed": snap["completed"],
+        }
+
+    mixed = run_fleet(None)
+    disagg = run_fleet("prefill=1,decode=2")
+
+    # -- leg 2: warm-start TTFT from the host tier ---------------------------
+    store = TieredPrefixStore()
+
+    def ttft_once(warm_store):
+        eng = mk(chunk_wide)
+        eng.attach_kvstore(store)
+        eng.generate([[1, 2, 3]], max_new_tokens=2)
+        # warm the gather/scatter executables on BOTH legs (demote
+        # compiles _swap_out, promote compiles _swap_in): the measured
+        # TTFT must compare prefill compute vs one promote scatter, not
+        # a first-use compile
+        junk = [7] * (2 * page_size)
+        eng.generate([junk], max_new_tokens=2)
+        eng.prefix_index.evict(10 ** 6)
+        eng.generate([junk], max_new_tokens=2)
+        h = eng.submit(bursts[0], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        ttft = h.t_first_token - h.t_submit
+        if warm_store:
+            # LRU-demote everything the request registered: the hook
+            # copies each still-valid page into the store as it drops
+            eng.prefix_index.evict(10 ** 6)
+        snap = eng.stats_snapshot()
+        eng.shutdown()
+        return ttft, snap
+
+    ttft_cold, cold_snap = ttft_once(warm_store=True)
+    ttft_warm, warm_snap = ttft_once(warm_store=False)
+
+    return {
+        "workload": {"streams": n_streams, "stream_tokens": stream_tokens,
+                     "burst_prompts": n_burst, "burst_len": long_len},
+        "mixed": mixed,
+        "disagg": disagg,
+        # acceptance gate: streaming p99 ITL under the burst, disagg
+        # fleet over mixed fleet (<= 0.8: isolating decode-class steps
+        # from prefill chunks must buy at least 20% tail latency)
+        "itl_burst_disagg_vs_mixed": (
+            round(disagg["itl_p99_ms"] / mixed["itl_p99_ms"], 3)
+            if mixed["itl_p99_ms"] and disagg["itl_p99_ms"] else None),
+        "ttft_cold_ms": round(ttft_cold * 1e3, 3),
+        "ttft_warm_ms": round(ttft_warm * 1e3, 3),
+        # acceptance gate: TTFT on a fresh engine promoting from the
+        # host tier vs the cold chunked prefill (<= 0.6)
+        "ttft_warm_vs_cold": (round(ttft_warm / ttft_cold, 3)
+                              if ttft_cold else None),
+        "demoted_pages": cold_snap["kv_demoted_pages"],
+        "promoted_pages": warm_snap["kv_promoted_pages"],
+        "tier_hits": warm_snap["prefix_tier_hits"],
+    }
+
+
 def bench_obs_overhead(dev, on_tpu):
     """extra.obs_overhead: what leaving the FULL observability layer on
     costs the decode hot path — span tracer enabled, per-request
@@ -1034,7 +1226,8 @@ def _sub_main(name: str) -> None:
     fn = {"dit": bench_dit, "moe": bench_moe, "decode": bench_decode,
           "ragged": bench_ragged, "specdec": bench_specdec,
           "prefix_reuse": bench_prefix_reuse,
-          "obs_overhead": bench_obs_overhead}[name]
+          "obs_overhead": bench_obs_overhead,
+          "disagg": bench_disagg}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
     except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
@@ -1126,6 +1319,7 @@ def main():
     specdec_extra = _run_sub("specdec")
     prefix_extra = _run_sub("prefix_reuse")
     obs_overhead_extra = _run_sub("obs_overhead")
+    disagg_extra = _run_sub("disagg")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
@@ -1183,6 +1377,11 @@ def main():
             # tracing (span tracer + per-request timelines + SLO) on vs
             # off — pinned < 2% so the layer stays on in soak runs
             "obs_overhead": obs_overhead_extra,
+            # disaggregated prefill/decode A/B: streaming decode p99 ITL
+            # under a long-prompt burst on a 1-prefill/2-decode fleet vs
+            # 3-mixed, plus warm-start TTFT promoting a demoted prefix
+            # from the tiered host store vs a cold chunked prefill
+            "disagg": disagg_extra,
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
             "graphlint": graphlint_extra,

@@ -13,7 +13,14 @@ model-agnostic and the point here is the robustness choreography.  Pass
 --real to run the same fleet over tiny-llama LLMEngines (slower: each
 replica compiles its own programs).
 
+Pass --roles to disaggregate the fleet: prefill-class replicas run the
+prompt and hand the finished KV pages to a decode-class replica over
+the host-staged transfer path, a shared tiered prefix store keeps
+demoted prefixes warm, and the same death choreography applies per
+class.
+
 Usage:  python examples/serve_fleet.py [--real]
+        python examples/serve_fleet.py --roles prefill=1,decode=2
 """
 import os
 import sys
@@ -33,6 +40,10 @@ def main():
     ap.add_argument("--real", action="store_true",
                     help="tiny-llama LLMEngine replicas instead of "
                          "scripted ones")
+    ap.add_argument("--roles", default=None, metavar="SPEC",
+                    help="disaggregate the fleet, e.g. "
+                         "'prefill=1,decode=2' (replica count follows "
+                         "from the spec; default stays 2 mixed)")
     args = ap.parse_args()
 
     from paddle_tpu.inference import faults as F
@@ -69,9 +80,22 @@ def main():
         def reference(prompt, n):
             return F.ScriptedEngine.reference_tokens(prompt, n)
 
-    router = Router(factory=factory, num_replicas=2, threaded=True,
+    fleet_kw = {"num_replicas": 2}
+    if args.roles:
+        # replica count follows from the spec ("prefill=1,decode=2" ->
+        # 3); the shared store is what lets a decode replica serve a
+        # prefix its prefill peer demoted
+        from paddle_tpu.inference.kvstore import TieredPrefixStore
+
+        n = sum(int(part.split("=", 1)[1]) if "=" in part else 1
+                for part in args.roles.split(",") if part.strip())
+        fleet_kw = {"num_replicas": max(n, 2), "roles": args.roles,
+                    "kvstore": TieredPrefixStore()}
+    router = Router(factory=factory, threaded=True,
                     supervisor=EngineSupervisor(factory),
-                    health_interval=0.01, backoff_base=0.05)
+                    health_interval=0.01, backoff_base=0.05, **fleet_kw)
+    if args.roles:
+        print("replica roles:", router.stats_snapshot()["replica_roles"])
     srv, _ = serve_fleet(router)
     url = f"http://127.0.0.1:{srv.server_address[1]}"
     print("fleet serving on", url)

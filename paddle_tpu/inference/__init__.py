@@ -27,16 +27,20 @@ __all__ = ["Config", "Predictor", "create_predictor", "InferTensor",
            "RequestCancelled", "DeadlineExceeded", "EngineStopped",
            "Router", "FleetHandle", "serve_fleet", "FleetQueueFull",
            "NoHealthyReplica", "ReplicaDied", "RetriesExhausted",
-           "RouterStopped", "EngineSupervisor", "faults"]
+           "RouterStopped", "EngineSupervisor", "faults",
+           "PrefillHandoff", "TieredPrefixStore", "KVHandoff"]
 
 
 def __getattr__(name):
     # lazy: the LLM engine / fleet tier pull in the model stack, which
     # plain Config/Predictor users never touch
     if name in ("LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
-                "DeadlineExceeded", "EngineStopped"):
+                "DeadlineExceeded", "EngineStopped", "PrefillHandoff"):
         from . import llm_engine
         return getattr(llm_engine, name)
+    if name in ("TieredPrefixStore", "KVHandoff"):
+        from . import kvstore
+        return getattr(kvstore, name)
     if name in ("Router", "FleetHandle", "serve_fleet", "FleetQueueFull",
                 "NoHealthyReplica", "ReplicaDied", "RetriesExhausted",
                 "RouterStopped"):

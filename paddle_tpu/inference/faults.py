@@ -55,9 +55,15 @@ __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
 # fused single-dispatch path (sampling inside the dispatch): a fault
 # there lands at the exact point where the fused executable would
 # consume the donated pools, the failure shape fused serving adds.
+# "kv_transfer" fires on every tier/handoff movement of KV pages —
+# prefill-side export, decode-side import, and host-tier promotion
+# (fire-context `direction` says which).  Dispatch-class: a
+# consume_pools rule poisons the gather/scatter exactly like a swap
+# fault; a crash rule kills a prefill replica MID-TRANSFER, the
+# zero-tokens-stranded shape the disaggregated fleet must retry.
 FAULT_POINTS = ("step", "prefill", "prefill_chunk", "draft", "decode",
                 "fused_decode", "verify", "page_alloc", "sample",
-                "swap_out", "swap_in")
+                "swap_out", "swap_in", "kv_transfer")
 
 # the Router's named injection points — fleet-tier failure shapes.
 #   replica_death:    fired per replica on each health tick; a match makes
@@ -79,7 +85,8 @@ FLEET_FAULT_POINTS = ("replica_death", "slow_replica", "health_flap",
 # points where a `consume_pools` rule is meaningful: the engine passes its
 # (to-be-donated or read) pools in the fire() context there
 _DISPATCH_POINTS = ("prefill", "prefill_chunk", "draft", "decode",
-                    "fused_decode", "verify", "swap_out", "swap_in")
+                    "fused_decode", "verify", "swap_out", "swap_in",
+                    "kv_transfer")
 
 
 class InjectedFault(RuntimeError):
@@ -392,19 +399,23 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         if registry is not None:
             for key in ("accepted", "admitted", "completed", "cancelled",
                         "timed_out", "failed", "preemptions",
-                        "spec_drafted", "spec_accepted"):
+                        "spec_drafted", "spec_accepted", "handoffs"):
                 counter = registry.get(f"llm_{key}_total")
                 reg_vals[key] = (None if counter is None
                                  else int(counter.value))
     if "accepted" in snap and quiesced:
+        # a handoff is a terminal outcome at THIS engine: the request
+        # resolved here with PrefillHandoff (zero tokens) and continues
+        # life as a fresh submit on a decode replica
         outcomes = (snap["completed"] + snap["cancelled"]
-                    + snap["timed_out"] + snap["failed"])
+                    + snap["timed_out"] + snap["failed"]
+                    + snap.get("handoffs", 0))
         if snap["accepted"] != outcomes:
             violations.append(
                 f"metrics identity broken: accepted={snap['accepted']} != "
-                f"completed+cancelled+timed_out+failed={outcomes} (a "
-                "request leaked out of, or was double-counted into, the "
-                "terminal counters)")
+                f"completed+cancelled+timed_out+failed+handoffs="
+                f"{outcomes} (a request leaked out of, or was "
+                "double-counted into, the terminal counters)")
     if "ragged_batch_tokens" in snap:
         # every valid token of every ragged dispatch is either a decode
         # span's token, part of a prefill chunk, or a speculative verify
@@ -470,7 +481,10 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
     if probe and not violations:
         saved, engine.faults = engine.faults, None
         try:
-            h = engine.submit([1], max_new_tokens=1)
+            # handoff=False: on a prefill-class replica the probe must
+            # decode locally — a PrefillHandoff resolution would be a
+            # false "cannot serve" verdict
+            h = engine.submit([1], max_new_tokens=1, handoff=False)
             if engine._thread is not None:
                 probe_tokens = h.result(timeout=probe_timeout)
             else:
@@ -706,8 +720,12 @@ def fleet_random_schedule(seed: int, n_replicas: int = 2,
         roll = rng.random()
         rid = rng.randrange(n_replicas)
         if roll < 0.35:
-            # replica death mid-step / mid-prefill / mid-decode
-            point = rng.choice(("step", "prefill", "decode"))
+            # replica death mid-step / mid-prefill / mid-decode / mid-
+            # transfer (the disaggregated handoff's stranded shape; the
+            # point only fires on fleets running kv movement — a no-op
+            # rule on mixed fleets, harmless)
+            point = rng.choice(("step", "prefill", "decode",
+                                "kv_transfer"))
             engine_rules[rid].append(
                 FaultRule(point, nth=rng.randint(1, 6), crash=True))
         elif roll < 0.55:

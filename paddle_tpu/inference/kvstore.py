@@ -1,0 +1,264 @@
+"""Tiered KV prefix store: host-RAM (+ optional disk) tier under the
+radix prefix index.
+
+The device-resident prefix index (inference/prefix.py) holds KV pages in
+HBM — the scarcest memory on the machine — so LRU eviction under page
+pressure used to DISCARD a prefix's KV and the next hit re-prefilled it
+from token zero.  This module adds the tier below: when the index drops
+a page whose KV is still valid, the engine demotes the page's contents
+to a `TieredPrefixStore` (host RAM, spilling to disk past
+`capacity_bytes`), and admission-time splicing extends a device-tier
+match by PROMOTING pages back (one fixed-shape scatter through the
+engine's existing `_swap_in` executable — zero new compiled programs).
+
+Keying: one entry per PAGE, keyed by the full token prefix from
+position 0 through the end of that page (a tuple of ints) — the same
+granularity as the radix index, so a host-tier chain is walked with
+plain dict lookups page by page.  Entries are whole-page only: the
+engine's splice floor already treats sub-page matches as misses.
+
+The store is deliberately ENGINE-AGNOSTIC and reattachable: it binds to
+no registry and holds no device state, so a fleet Router can share one
+store across every replica (thread-safe under one lock), reattach it to
+a rebuilt replica after a crash (warm restart), and `_recover_pools` —
+which must invalidate every DEVICE-tier prefix because the pool's KV is
+gone — never touches it: host copies were taken while the KV was live.
+
+Disk spill: past `capacity_bytes` the LRU RAM entry is written to
+`spill_dir` as one `.npz` (token key stored inside the file), and a
+fresh store pointed at the same directory re-indexes the spilled
+entries — cached prefixes survive a full process restart.
+
+`KVHandoff` is the disaggregated-serving transfer record: a finished
+prefill's pages gathered to host staging on the prefill-class replica,
+brokered by the Router to a decode-class replica, and scattered into
+its pool there (`LLMEngine.import_prefix`).  It rides the same padded
+fixed-shape host arrays the preempt/resume swap path uses.
+"""
+
+from __future__ import annotations
+
+import collections
+import glob
+import os
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["TieredPrefixStore", "KVHandoff"]
+
+
+class KVHandoff:
+    """One prefill-to-decode KV transfer: `tokens` (the full prompt),
+    `n_tokens` cached in `n_pages` full pages, and the padded host
+    staging arrays `host_k`/`host_v` as gathered by the prefill
+    replica's `_swap_out` (page i of the transfer at host index i;
+    indices past n_pages hold scratch-page garbage that only ever
+    scatters back into the reserved page 0)."""
+
+    __slots__ = ("tokens", "n_tokens", "n_pages", "host_k", "host_v",
+                 "src_replica")
+
+    def __init__(self, tokens, n_tokens: int, n_pages: int,
+                 host_k, host_v, src_replica: Optional[str] = None):
+        self.tokens = np.asarray(tokens, np.int32).reshape(-1)
+        self.n_tokens = int(n_tokens)
+        self.n_pages = int(n_pages)
+        self.host_k = host_k
+        self.host_v = host_v
+        self.src_replica = src_replica
+
+    @property
+    def nbytes(self) -> int:
+        """Real payload bytes (the n_pages transferred, not the fixed
+        padded staging shape)."""
+        if self.n_pages == 0 or self.host_k is None:
+            return 0
+        slots = self.host_k.shape[1] if self.host_k.ndim > 1 else 1
+        per_page = (self.host_k.nbytes + self.host_v.nbytes) \
+            // max(1, slots)
+        return per_page * min(self.n_pages, slots)
+
+
+class TieredPrefixStore:
+    """Host-RAM page store keyed by full token-prefix tuples, LRU under
+    `capacity_bytes`, optionally spilling to `spill_dir` (see module
+    doc).  Thread-safe: one lock guards the index — page payloads are
+    immutable numpy arrays, so readers never see torn data."""
+
+    def __init__(self, capacity_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None,
+                 page_size: Optional[int] = None):
+        self.capacity_bytes = capacity_bytes
+        self.spill_dir = spill_dir
+        # set by the first engine that attaches (cache.page_size); used
+        # only by first_chunks() for the router's host-tier digest
+        self.page_size = page_size
+        self._lock = threading.Lock()
+        self._ram: "collections.OrderedDict[tuple, tuple]" = \
+            collections.OrderedDict()       # key -> (k_page, v_page)
+        self._disk: dict = {}               # key -> npz path
+        self._bytes = 0
+        self._seq = 0
+        # counters (plain ints under the lock; engines mirror the ones
+        # they care about into their own registries)
+        self.demoted_pages = 0
+        self.promoted_pages = 0
+        self.spilled_pages = 0
+        self.loaded_pages = 0
+        self.hits = 0
+        self.misses = 0
+        if spill_dir:
+            os.makedirs(spill_dir, exist_ok=True)
+            self._reindex_spill()
+
+    # -- introspection ------------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ram) + len(self._disk)
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._bytes
+
+    def keys(self) -> List[tuple]:
+        with self._lock:
+            return list(self._ram) + list(self._disk)
+
+    def first_chunks(self) -> tuple:
+        """Token tuples of the cached FIRST pages — the host-tier analog
+        of `PrefixIndex.first_chunks()`, matched by the Router's
+        prefix-affinity score so a demoted-but-warm prefix still
+        attracts placement.  Empty until an engine attaches and stamps
+        `page_size` (key length alone cannot identify depth-0 pages)."""
+        ps = self.page_size
+        if not ps:
+            return ()
+        with self._lock:
+            return tuple(k for k in list(self._ram) + list(self._disk)
+                         if len(k) == ps)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "ram_pages": len(self._ram),
+                "disk_pages": len(self._disk),
+                "resident_bytes": self._bytes,
+                "demoted_pages": self.demoted_pages,
+                "promoted_pages": self.promoted_pages,
+                "spilled_pages": self.spilled_pages,
+                "loaded_pages": self.loaded_pages,
+                "hits": self.hits,
+                "misses": self.misses,
+            }
+
+    # -- put / get ----------------------------------------------------------
+
+    def put(self, prefix, k_page, v_page) -> bool:
+        """Demote one page: cache its KV under the full token prefix
+        ending at this page's last token.  Copies are taken (the caller
+        may reuse its staging buffer).  Returns False when the entry
+        already exists (RAM or disk) — demotion is idempotent."""
+        key = tuple(int(t) for t in np.asarray(prefix).reshape(-1))
+        k_page = np.array(k_page, copy=True)
+        v_page = np.array(v_page, copy=True)
+        with self._lock:
+            if key in self._ram:
+                self._ram.move_to_end(key)
+                return False
+            if key in self._disk:
+                return False
+            self._ram[key] = (k_page, v_page)
+            self._bytes += k_page.nbytes + v_page.nbytes
+            self.demoted_pages += 1
+            self._enforce_capacity()
+        return True
+
+    def get(self, prefix) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """One page's (k, v) for the full prefix key, or None.  A RAM
+        hit is LRU-touched; a disk hit is loaded (and stays on disk —
+        re-promotion to device is the caller's job, re-admission to RAM
+        would just re-spill it)."""
+        key = tuple(int(t) for t in np.asarray(prefix).reshape(-1))
+        with self._lock:
+            hit = self._ram.get(key)
+            if hit is not None:
+                self._ram.move_to_end(key)
+                self.hits += 1
+                self.promoted_pages += 1
+                return hit
+            path = self._disk.get(key)
+        if path is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        try:
+            with np.load(path) as z:
+                k_page, v_page = z["k"], z["v"]
+        except Exception:  # noqa: BLE001 — a corrupt spill file is a miss
+            with self._lock:
+                self._disk.pop(key, None)
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+            self.promoted_pages += 1
+            self.loaded_pages += 1
+        return k_page, v_page
+
+    def contains(self, prefix) -> bool:
+        key = tuple(int(t) for t in np.asarray(prefix).reshape(-1))
+        with self._lock:
+            return key in self._ram or key in self._disk
+
+    def clear(self) -> None:
+        """Drop every entry, RAM and disk."""
+        with self._lock:
+            self._ram.clear()
+            self._bytes = 0
+            for path in self._disk.values():
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            self._disk.clear()
+
+    # -- internals ----------------------------------------------------------
+
+    def _enforce_capacity(self) -> None:
+        """Under self._lock: spill (or drop) LRU RAM entries past
+        capacity_bytes."""
+        if self.capacity_bytes is None:
+            return
+        while self._bytes > self.capacity_bytes and self._ram:
+            key, (k_page, v_page) = self._ram.popitem(last=False)
+            self._bytes -= k_page.nbytes + v_page.nbytes
+            if not self.spill_dir:
+                continue            # no disk tier: LRU entry is dropped
+            self._seq += 1
+            path = os.path.join(self.spill_dir,
+                                f"kvp_{self._seq:08d}.npz")
+            try:
+                np.savez(path, k=k_page, v=v_page,
+                         tokens=np.asarray(key, np.int64))
+                self._disk[key] = path
+                self.spilled_pages += 1
+            except OSError:
+                pass                # disk full: degrade to drop
+
+    def _reindex_spill(self) -> None:
+        """Rebuild the disk index from spill_dir (process restart: a
+        fresh store reopened on the same directory serves the spilled
+        prefixes again)."""
+        for path in sorted(glob.glob(
+                os.path.join(self.spill_dir, "kvp_*.npz"))):
+            try:
+                with np.load(path) as z:
+                    key = tuple(int(t) for t in z["tokens"])
+            except Exception:  # noqa: BLE001 — skip corrupt files
+                continue
+            self._disk[key] = path
+            self._seq = max(self._seq, int(
+                os.path.basename(path)[4:-4] or 0))

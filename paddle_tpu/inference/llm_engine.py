@@ -82,6 +82,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import kvstore as _kvstore
 from . import prefix as _prefix
 from .. import kernels
 from ..models import generation
@@ -93,7 +94,7 @@ from ..obs import trace as obs_trace
 from ..obs import watchdog as obs_watchdog
 
 __all__ = ["LLMEngine", "serve_llm", "QueueFull", "RequestCancelled",
-           "DeadlineExceeded", "EngineStopped"]
+           "DeadlineExceeded", "EngineStopped", "PrefillHandoff"]
 
 
 class EngineStopped(RuntimeError):
@@ -119,6 +120,24 @@ class RequestCancelled(RuntimeError):
 
 class DeadlineExceeded(TimeoutError):
     """The request's deadline expired before it finished."""
+
+
+class PrefillHandoff(RuntimeError):
+    """NOT a failure: a prefill-class replica resolved this request at
+    `prefill_done` with ZERO tokens emitted and its prompt's KV pages
+    staged host-side for transfer (`.handoff`, a `kvstore.KVHandoff`).
+    The fleet Router brokers the payload to a decode-class replica and
+    re-places the request there — zero tokens means the retry rule
+    (re-place iff nothing was emitted) always applies, so a prefill
+    replica dying mid-transfer is safely retryable with the request's
+    remaining deadline.  A direct caller seeing this from `result()`
+    submitted to a prefill-class engine without a router; submit with
+    `handoff=False` to make such an engine decode locally instead."""
+
+    def __init__(self, handoff: "_kvstore.KVHandoff"):
+        super().__init__(
+            "prefill complete; KV staged for decode-replica handoff")
+        self.handoff = handoff
 
 
 class _ResumeState:
@@ -165,6 +184,9 @@ class _Request:
         self.eos_id = eos_id
         self.req_id = req_id or obs_reqtrace.new_request_id()
         self.hop = int(hop)
+        # may a prefill-class engine resolve this request at prefill_done
+        # with a KV handoff instead of decoding?  Stamped by submit()
+        self.allow_handoff = False
         self.deadline = (None if deadline is None
                          else time.monotonic() + float(deadline))
         # lifecycle timestamps (monotonic): the per-request latency
@@ -311,6 +333,18 @@ class _StatsDict(collections.abc.MutableMapping):
         "timed_out": "requests resolved by deadline expiry",
         "failed": "requests resolved with an engine/dispatch error",
         "steps_total": "engine step() iterations",
+        "handoffs": "requests resolved at prefill_done with a KV "
+                    "handoff (disaggregated prefill->decode transfer)",
+        "kv_transfer_pages": "KV pages moved over the prefill->decode "
+                             "transfer path (export + import)",
+        "kv_transfer_bytes": "payload bytes moved over the "
+                             "prefill->decode transfer path",
+        "kv_demoted_pages": "evicted prefix pages demoted to the host "
+                            "tier instead of discarded",
+        "kv_promoted_pages": "host-tier pages promoted back to the "
+                             "device prefix index at admission",
+        "prefix_tier_hits": "admissions whose splice extended past the "
+                            "device tier via host-tier promotion",
     }
 
     def __init__(self, registry: obs_metrics.Registry,
@@ -458,7 +492,9 @@ class LLMEngine:
                  slo_window_s: float = 60.0,
                  stepprof: Optional[obs_stepprof.StepProfiler] = None,
                  watchdog: Optional[obs_watchdog.Watchdog] = None,
-                 fused_decode: bool = True):
+                 fused_decode: bool = True,
+                 role: str = "mixed",
+                 kvstore=None):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -475,6 +511,18 @@ class LLMEngine:
             raise ValueError(f"unknown victim_policy {victim_policy!r}")
         self.preempt_mode = preempt_mode
         self.victim_policy = victim_policy
+        # disaggregated serving: a "prefill"-class engine resolves
+        # handoff-eligible requests at prefill_done with their KV staged
+        # for a decode-class replica; "decode" marks the engine a
+        # continuation target for the Router's role-aware placement (it
+        # still runs prefill for the unshared suffix of a handoff, and
+        # everything when no handoff arrived); "mixed" is the classic
+        # single-engine behaviour.  A Router may FLIP the role between
+        # steps under sustained load imbalance — nothing here is baked
+        # into a compiled program, so flipping costs zero recompiles.
+        if role not in ("mixed", "prefill", "decode"):
+            raise ValueError(f"unknown role {role!r}")
+        self.role = role
         self.max_pending = None if max_pending is None else int(max_pending)
         self.faults = faults
         self.prefill_chunk_tokens = int(prefill_chunk_tokens)
@@ -517,6 +565,14 @@ class LLMEngine:
         self.prefix_index = (_prefix.PrefixIndex(self.cache)
                              if prefix_cache else None)
         self._prefix_evicted_seen = 0   # evictions already counted
+        # host-tier prefix store (kvstore.TieredPrefixStore): demotions
+        # flow out on LRU eviction, promotions flow in at admission
+        self.kvstore = None
+        # KV handoffs queued for import (router -> step thread): the
+        # import's pool mutation runs ONLY on the step thread
+        self._kv_imports: collections.deque = collections.deque()
+        if kvstore is not None:
+            self.attach_kvstore(kvstore)
         self._pending: collections.deque = collections.deque()
         self._slots: dict[int, _SlotState] = {}
         self._admit_seq = 0
@@ -551,7 +607,9 @@ class LLMEngine:
             "swap_out_pages", "swap_in_pages",
             "prefix_hits", "prefix_misses", "prefix_spliced_pages",
             "prefix_cow_copies", "prefix_evictions",
-            "cancelled", "timed_out", "failed", "steps_total"))
+            "cancelled", "timed_out", "failed", "steps_total",
+            "handoffs", "kv_transfer_pages", "kv_transfer_bytes",
+            "kv_demoted_pages", "kv_promoted_pages", "prefix_tier_hits"))
         reg = self.metrics
         self._h_queue_wait = reg.histogram(
             "llm_queue_wait_seconds", "submit() -> slot admission")
@@ -826,16 +884,25 @@ class LLMEngine:
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
-               req_id: Optional[str] = None, hop: int = 0) -> _Request:
+               req_id: Optional[str] = None, hop: int = 0,
+               handoff: Optional[bool] = None) -> _Request:
         """Queue a request.  deadline: seconds from now; once expired the
         request resolves with DeadlineExceeded at the next step() boundary,
         whether still queued or mid-decode.  Raises QueueFull when the
         bounded pending queue is at capacity.  req_id/hop: the fleet
         trace context — the Router threads a request's id and placement
         count through retries so its cross-replica timeline stays one
-        ring; direct callers may omit both (a fresh id is generated)."""
+        ring; direct callers may omit both (a fresh id is generated).
+        handoff: may a prefill-class engine resolve this request at
+        prefill_done with PrefillHandoff instead of decoding?  Defaults
+        to True iff this engine's role is "prefill"; a Router passes
+        False when re-placing a handoff's decode continuation (and for
+        canaries), so a continuation landing on a prefill-class replica
+        decodes locally instead of ping-ponging forever."""
         req = _Request(prompt, max_new_tokens, eos_id, deadline=deadline,
                        req_id=req_id, hop=hop)
+        req.allow_handoff = (self.role == "prefill") if handoff is None \
+            else bool(handoff)
         total = req.prompt.size + req.max_new_tokens
         if total > self.max_seq_len:
             raise ValueError(
@@ -922,6 +989,9 @@ class LLMEngine:
         snap["pool"] = self.pool_snapshot()
         snap["watchdog"] = self.watchdog.report()
         snap["prefix"] = self.prefix_snapshot()
+        snap["role"] = self.role
+        snap["kvstore"] = (None if self.kvstore is None
+                           else self.kvstore.snapshot())
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -942,6 +1012,9 @@ class LLMEngine:
             "evictions": self.stats["prefix_evictions"],
             "cached_pages": 0 if idx is None else idx.cached_pages,
             "cached_prefixes": 0 if idx is None else idx.leaf_count,
+            "tier_hits": self.stats["prefix_tier_hits"],
+            "promoted_pages": self.stats["kv_promoted_pages"],
+            "demoted_pages": self.stats["kv_demoted_pages"],
         }
 
     def state_digest(self) -> dict:
@@ -974,6 +1047,7 @@ class LLMEngine:
                 pending_ids = [r.req_id for r in list(self._pending)]
                 return {
                     "replica": self.replica_name,
+                    "role": self.role,
                     "slots": slots,
                     "pending": len(pending_ids),
                     "pending_req_ids": pending_ids,
@@ -1061,6 +1135,15 @@ class LLMEngine:
                     "spliced_pages": self.stats["prefix_spliced_pages"],
                     "cow_copies": self.stats["prefix_cow_copies"],
                 })
+            if self.kvstore is not None or self.role != "mixed":
+                # the disaggregation/tier track: handoff traffic and
+                # host-tier flow render under the transfer phase spans
+                tr.counter("transfer", {
+                    "pages": self.stats["kv_transfer_pages"],
+                    "bytes": self.stats["kv_transfer_bytes"],
+                    "demoted": self.stats["kv_demoted_pages"],
+                    "promoted": self.stats["kv_promoted_pages"],
+                })
 
     def pool_snapshot(self) -> dict:
         """The memory-telemetry section of /stats: pool occupancy,
@@ -1122,7 +1205,7 @@ class LLMEngine:
     # -- engine loop --------------------------------------------------------
 
     def has_work(self) -> bool:
-        return bool(self._pending or self._slots)
+        return bool(self._pending or self._slots or self._kv_imports)
 
     def alive(self) -> bool:
         """Step-thread liveness, the signal the fleet Router's health
@@ -1158,6 +1241,11 @@ class LLMEngine:
               if self.watchdog.enabled and not prof.enabled else None)
         with self.tracer.span("engine_step"):
             with prof.step() as pstep:
+                # drain queued KV imports FIRST: a handoff's pages must
+                # be in the prefix index before its continuation request
+                # (queued right behind the import) reaches _admit's
+                # splice — same step, zero extra latency
+                imported = self._drain_imports()
                 with prof.phase("schedule"):
                     reaped = self._reap()
                     admitted = self._admit()
@@ -1173,7 +1261,7 @@ class LLMEngine:
         elif t0 is not None:
             self.watchdog.observe_step(time.perf_counter() - t0, None,
                                        flight=self.flight)
-        return reaped or admitted or stepped
+        return reaped or admitted or stepped or imported
 
     def start(self):
         """Run the engine loop in a background thread (serving mode)."""
@@ -1393,8 +1481,8 @@ class LLMEngine:
                                       pages=len(pages)), \
                      self.stepprof.phase("swap"):
                     self._fire("swap_out", slot=slot, pools=cache.pools)
-                    idx = np.zeros((cache.pages_per_seq,), np.int32)
-                    idx[:len(pages)] = pages
+                    idx = generation.pad_page_idx(pages,
+                                                  cache.pages_per_seq)
                     hk, hv = self._swap_out(cache.pools["k"],
                                             cache.pools["v"],
                                             jnp.asarray(idx))
@@ -1513,9 +1601,8 @@ class LLMEngine:
                                   pages=rs.n_pages) as sp, \
                  self.stepprof.phase("swap") as ph:
                 self._fire("swap_in", slot=slot, pools=cache.pools)
-                idx = np.zeros((cache.pages_per_seq,), np.int32)
-                pages = cache._slot_pages[slot]
-                idx[:len(pages)] = pages
+                idx = generation.pad_page_idx(cache._slot_pages[slot],
+                                              cache.pages_per_seq)
                 k_pool, v_pool = self._swap_in(
                     cache.pools["k"], cache.pools["v"], jnp.asarray(idx),
                     jnp.asarray(rs.host_k), jnp.asarray(rs.host_v))
@@ -1614,6 +1701,13 @@ class LLMEngine:
         tokens = np.asarray(tokens, np.int32).reshape(-1)
         matched, pages = (0, []) if tokens.size < 2 else \
             idx.lookup(tokens, tokens.size - 1)
+        # host-tier extension: where the device chain ends PAGE-ALIGNED,
+        # demoted-but-warm pages can extend it — promoted back through
+        # the one compiled _swap_in scatter (no new executables)
+        if self.kvstore is not None \
+                and matched % self.cache.page_size == 0:
+            matched, pages = self._promote_from_host(tokens, matched,
+                                                     pages)
         # a sub-page match is a net loss: the splice would save < one
         # page of prefill but cost a whole-page copy the moment the
         # slot appends into the shared page — treat it as a miss
@@ -1646,6 +1740,259 @@ class LLMEngine:
         if n_full:
             idx.insert(st.pending, n_full,
                        self.cache._slot_pages[slot][:n_full // ps])
+
+    # -- disaggregation & the tiered prefix store ---------------------------
+
+    def attach_kvstore(self, store) -> None:
+        """Bind a `kvstore.TieredPrefixStore` as the host tier under the
+        device prefix index: LRU eviction DEMOTES a dying page's KV into
+        it instead of discarding, and admission-time splicing PROMOTES
+        warm pages back.  Reattachable on purpose — the fleet Router
+        shares one store across replicas and re-binds it to a rebuilt
+        replica after a crash, which is exactly how a cold-restarted
+        replica warms its device cache from prefixes its predecessor
+        demoted."""
+        self.kvstore = store
+        if store is None:
+            if self.prefix_index is not None:
+                self.prefix_index.on_evict = None
+            return
+        if store.page_size is None:
+            store.page_size = self.cache.page_size
+        elif int(store.page_size) != self.cache.page_size:
+            raise ValueError(
+                f"kvstore page_size={store.page_size} does not match "
+                f"engine page_size={self.cache.page_size}")
+        if self.prefix_index is not None:
+            self.prefix_index.on_evict = self._demote_node
+
+    def _demote_node(self, node) -> None:
+        """PrefixIndex.on_evict hook: the index is about to release its
+        LAST reference on `node`'s page — gather the page's KV to host
+        through the one compiled `_swap_out` executable and hand it to
+        the tiered store, keyed by the full token prefix.  Best-effort
+        by contract (the index swallows exceptions and frees the page
+        regardless); runs on the step thread, which owns the pools."""
+        store = self.kvstore
+        if store is None:
+            return
+        cache = self.cache
+        prefix_full = self.prefix_index.full_prefix(node)
+        with self.tracer.span("kv_demote", page=node.page), \
+             self.stepprof.phase("transfer"):
+            idx = generation.pad_page_idx([node.page],
+                                          cache.pages_per_seq)
+            hk, hv = self._swap_out(cache.pools["k"], cache.pools["v"],
+                                    jnp.asarray(idx))
+            hk, hv = np.asarray(hk), np.asarray(hv)
+            # slice the single real page out of the fixed staging shape
+            # (axis 1 is the page axis; scripted engines return opaque
+            # 1-D stubs, stored as-is)
+            k_page = hk[:, 0] if hk.ndim > 1 else hk
+            v_page = hv[:, 0] if hv.ndim > 1 else hv
+            if store.put(prefix_full, k_page, v_page):
+                with self._cv:
+                    self.stats["kv_demoted_pages"] += 1
+
+    def _promote_from_host(self, tokens, matched: int, pages: list):
+        """Extend a page-aligned device-tier match with host-tier pages:
+        walk the store key-by-key past `matched`, scatter every page
+        found through ONE `_swap_in` dispatch (the same compiled
+        executable the preempt/resume path uses — zero new programs),
+        and register the extended chain in the device index so later
+        admissions hit it directly.  Returns the (possibly extended)
+        (matched, pages); on any failure it degrades to the device-tier
+        result — promotion must never fail an admission."""
+        store = self.kvstore
+        cache = self.cache
+        ps = cache.page_size
+        limit = tokens.size - 1     # >= 1 token must remain to prefill
+        toks = [int(t) for t in tokens]
+        found: list = []
+        pos = matched
+        while pos + ps <= limit \
+                and len(pages) + len(found) < cache.pages_per_seq:
+            kv = store.get(tuple(toks[:pos + ps]))
+            if kv is None:
+                break
+            found.append(kv)
+            pos += ps
+        if not found:
+            return matched, pages
+        n = len(found)
+        new_pages: list = []
+        try:
+            with self.tracer.span("kv_promote", pages=n), \
+                 self.stepprof.phase("transfer") as ph:
+                self._fire("kv_transfer", pools=cache.pools, pages=n,
+                           direction="promote")
+                if n > cache.free_page_count:
+                    self._reclaim_pages(n - cache.free_page_count)
+                new_pages = cache.alloc_pages(n)
+                pk = cache.pools["k"]
+                stage = (pk.shape[0], cache.pages_per_seq) \
+                    + tuple(pk.shape[2:])
+                hk = np.zeros(stage, pk.dtype)
+                hv = np.zeros(stage, pk.dtype)
+                for i, (kp, vp) in enumerate(found):
+                    hk[:, i] = kp
+                    hv[:, i] = vp
+                idx = generation.pad_page_idx(new_pages,
+                                              cache.pages_per_seq)
+                k_pool, v_pool = self._swap_in(
+                    cache.pools["k"], cache.pools["v"],
+                    jnp.asarray(idx), jnp.asarray(hk), jnp.asarray(hv))
+                ph.fence(k_pool)
+                cache.pools = {"k": k_pool, "v": v_pool}
+        except Exception as e:  # noqa: BLE001 — degrade, never fail
+            for p in new_pages:
+                try:
+                    cache.drop_ref(p)
+                except Exception:  # noqa: BLE001
+                    pass
+            if self._recover_pools(e):
+                # recovery cleared the prefix index: the device-tier
+                # pages we matched were freed with it — cold prefill
+                return 0, []
+            return matched, pages
+        # hand ownership to the index: insert refs every page of the
+        # extended chain, then drop the allocation refs — promoted
+        # pages end index-owned exactly like demote's inverse
+        all_pages = list(pages) + new_pages
+        self.prefix_index.insert(tokens, pos, all_pages)
+        for p in new_pages:
+            cache.drop_ref(p)
+        with self._cv:
+            self.stats["kv_promoted_pages"] += n
+            self.stats["prefix_tier_hits"] += 1
+            self.stats["kv_transfer_pages"] += n
+        self.tracer.instant("kv_promoted", pages=n,
+                            tokens=pos - matched)
+        return pos, all_pages
+
+    def import_prefix(self, handoff) -> None:
+        """Queue a `kvstore.KVHandoff` for import into this engine's
+        prefix index.  Thread-safe and non-blocking: the payload rides
+        host RAM until the STEP THREAD drains it (pool mutation is
+        step-thread-owned), which happens at the top of the next step —
+        before admission, so a continuation request submitted right
+        after this call splices the imported pages.  Import failure
+        degrades to a cold prefill; it never fails a request."""
+        with self._cv:
+            if self._stop:
+                raise EngineStopped("engine is stopped")
+            self._kv_imports.append(handoff)
+            self._cv.notify()
+
+    def _drain_imports(self) -> bool:
+        """Step thread: import every queued KV handoff."""
+        if not self._kv_imports:
+            return False
+        did = False
+        while True:
+            with self._cv:
+                if not self._kv_imports:
+                    break
+                h = self._kv_imports.popleft()
+            self._import_handoff(h)
+            did = True
+        return did
+
+    def _import_handoff(self, h) -> int:
+        """Scatter one handoff's pages into the pool and register them
+        in the prefix index (the decode half of a prefill->decode
+        transfer).  Returns pages imported; 0 means the continuation
+        cold-prefills — correct, only slower."""
+        idx_obj = self.prefix_index
+        if idx_obj is None or h.n_pages == 0:
+            return 0
+        cache = self.cache
+        pages: list = []
+        try:
+            with self.tracer.span("kv_transfer_in", pages=h.n_pages,
+                                  src=h.src_replica or ""), \
+                 self.stepprof.phase("transfer") as ph:
+                self._fire("kv_transfer", pools=cache.pools,
+                           pages=h.n_pages, direction="import")
+                if h.n_pages > cache.free_page_count:
+                    self._reclaim_pages(h.n_pages
+                                        - cache.free_page_count)
+                pages = cache.alloc_pages(h.n_pages)
+                idx = generation.pad_page_idx(pages,
+                                              cache.pages_per_seq)
+                k_pool, v_pool = self._swap_in(
+                    cache.pools["k"], cache.pools["v"],
+                    jnp.asarray(idx),
+                    jnp.asarray(h.host_k), jnp.asarray(h.host_v))
+                ph.fence(k_pool)
+                cache.pools = {"k": k_pool, "v": v_pool}
+        except Exception as e:  # noqa: BLE001 — degrade to cold prefill
+            for p in pages:
+                try:
+                    cache.drop_ref(p)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._recover_pools(e)
+            self.tracer.instant("kv_transfer_in_failed",
+                                pages=h.n_pages)
+            return 0
+        # ownership handshake mirrors _promote_from_host: insert refs
+        # the registered pages, the allocation refs then drop — a page
+        # DEDUPED against an existing node frees right here instead of
+        # leaking with refcount 1
+        idx_obj.insert(h.tokens, h.n_tokens, pages)
+        for p in pages:
+            cache.drop_ref(p)
+        with self._cv:
+            self.stats["kv_transfer_pages"] += h.n_pages
+            self.stats["kv_transfer_bytes"] += h.nbytes
+        return h.n_pages
+
+    def _handoff_slot(self, slot: int, st: "_SlotState") -> None:
+        """Prefill-class resolution: the slot just finished prefilling —
+        gather its full pages to host staging (`_swap_out`, the same
+        compiled executable preemption uses), release the slot, and
+        resolve the request with `PrefillHandoff` carrying the payload.
+        ZERO tokens are emitted (sampling happens decode-side), so the
+        Router's retry rule covers every failure mode: this replica
+        dying mid-transfer strands nothing the fleet cannot re-place."""
+        cache = self.cache
+        req = st.req
+        ps = cache.page_size
+        n_full = st.ctx - st.ctx % ps
+        n_pages = n_full // ps
+        pages = list(cache._slot_pages[slot][:n_pages])
+        hk = hv = None
+        try:
+            with self.tracer.span("kv_transfer_out", slot=slot,
+                                  pages=n_pages), \
+                 self.stepprof.phase("transfer") as ph:
+                self._fire("kv_transfer", slot=slot, pools=cache.pools,
+                           pages=n_pages, direction="export")
+                if n_pages:
+                    idx = generation.pad_page_idx(
+                        pages, cache.pages_per_seq)
+                    dk, dv = self._swap_out(cache.pools["k"],
+                                            cache.pools["v"],
+                                            jnp.asarray(idx))
+                    ph.fence(dk)
+                    hk, hv = np.asarray(dk), np.asarray(dv)
+        except Exception as e:  # noqa: BLE001 — a failed export fails
+            # THIS request like any dispatch fault; the engine serves on
+            self._evict(slot, e, "failed")
+            self._recover_pools(e)
+            return
+        h = _kvstore.KVHandoff(req.prompt, n_full, n_pages, hk, hv,
+                               src_replica=self.replica_name)
+        del self._slots[slot]
+        cache.release_slot(slot)
+        with self._cv:
+            self.stats["handoffs"] += 1
+            self.stats["kv_transfer_pages"] += n_pages
+            self.stats["kv_transfer_bytes"] += h.nbytes
+        self._rq_event(req, "handoff", slot=slot, pages=n_pages,
+                       tokens=n_full)
+        req._resolve(PrefillHandoff(h))
 
     def _make_writable(self, slot: int, st: "_SlotState") -> bool:
         """Copy-on-write before the slot's next span writes at position
@@ -1990,6 +2337,17 @@ class LLMEngine:
                     # the index takes refs so the KV survives this slot
                     # and later admissions splice instead of re-prefilling
                     self._register_prefix(slot, st)
+                    if self.role == "prefill" and st.req.allow_handoff \
+                            and st.sample_on_finish:
+                        # disaggregated serving: resolve here with the
+                        # KV staged for a decode replica — no token is
+                        # sampled on this class (the decode side owns
+                        # the whole sampling chain, so the handed-off
+                        # stream is token-exact vs a mixed engine)
+                        self._rq_event(st.req, "prefill_done",
+                                       ctx=st.ctx)
+                        self._handoff_slot(slot, st)
+                        continue
                     if not st.sample_on_finish:
                         # recompute-resume: its next token was sampled
                         # before the preemption; decode continues with

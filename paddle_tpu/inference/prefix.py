@@ -70,13 +70,20 @@ class _Node:
 class PrefixIndex:
     """Page-granular radix tree over a `PagedKVCache` (see module doc)."""
 
-    def __init__(self, cache):
+    def __init__(self, cache, on_evict=None):
         self._cache = cache
         self.page_size = int(cache.page_size)
         self._root: dict = {}            # token tuple -> _Node
         self._by_page: dict = {}         # page id -> _Node
         self._clock = 0
         self.evicted_pages_total = 0
+        # demotion hook: called with the node being dropped WHILE its
+        # page's KV is still valid (before the index's ref is released),
+        # and only when the index is the page's last holder — the tiered
+        # host store (inference/kvstore.py) copies the page out here.
+        # `clear()` deliberately bypasses it: pool recovery drops dead
+        # KV, and demoting garbage would serve silent corruption later.
+        self.on_evict = on_evict
 
     # -- introspection ------------------------------------------------------
 
@@ -229,7 +236,30 @@ class PrefixIndex:
         del siblings[node.tokens]
         del self._by_page[node.page]
         self.evicted_pages_total += 1
+        if self.on_evict is not None \
+                and self._cache.refcount(node.page) == 1:
+            # last holder: the page frees on the drop_ref below, so this
+            # is the only moment its KV can still be demoted.  A shared
+            # page (a live slot co-holds it) survives anyway — demoting
+            # it too would just duplicate bytes the device still serves.
+            try:
+                self.on_evict(node)
+            except Exception:  # noqa: BLE001 — demotion is best-effort;
+                pass           # eviction must free the page regardless
         return self._cache.drop_ref(node.page)
+
+    def full_prefix(self, node: _Node) -> tuple:
+        """The token prefix from the root through `node` (the tiered
+        store's key for this node's page)."""
+        chain: List[tuple] = []
+        n: Optional[_Node] = node
+        while n is not None:
+            chain.append(n.tokens)
+            n = n.parent
+        out: tuple = ()
+        for t in reversed(chain):
+            out = out + t
+        return out
 
     def evict(self, n_pages: int) -> int:
         """LRU-evict unreferenced cached prefixes until `n_pages` pages

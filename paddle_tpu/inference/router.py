@@ -65,6 +65,24 @@ Threading modes: `threaded=True` (serving) starts every engine's step
 thread plus a router health-tick thread; `threaded=False` (deterministic
 chaos schedules) runs nothing in the background — `pump()` executes one
 health tick and one step of every live replica.
+
+DISAGGREGATED serving (`roles="prefill=1,decode=2"`): replicas are
+classed prefill/decode, placement is role-aware (fresh requests steer to
+prefill-class replicas, handoff continuations to decode-class — a large
+but FINITE penalty, so a sole surviving wrong-class replica still
+serves), and a prefill replica resolving a hop with `PrefillHandoff`
+makes the router BROKER the staged KV pages to a decode replica
+(`import_prefix`) and re-place the request there with `handoff=False`.
+The handoff resolves with ZERO tokens by construction, so every
+mid-transfer death falls under the existing retry rule: re-place with
+the remaining deadline, nothing stranded.  Under sustained per-class
+load imbalance the health tick FLIPS a replica's role (hysteresis:
+`role_flip_ticks` consecutive imbalanced ticks, donor class keeps >= 1
+replica) — roles live outside every compiled program, so a flip costs
+zero recompiles.  A shared `kvstore=` (TieredPrefixStore) rides along:
+evicted prefixes demote into it, admissions promote from it, and the
+affinity score learns its digest so a demoted-but-warm prefix still
+attracts placement (at half the device-tier discount).
 """
 
 from __future__ import annotations
@@ -77,7 +95,8 @@ from typing import List, Optional, Sequence
 
 from . import faults as _faults
 from .llm_engine import (DeadlineExceeded, EngineStopped, LLMEngine,
-                         QueueFull, RequestCancelled, _StatsDict)
+                         PrefillHandoff, QueueFull, RequestCancelled,
+                         _StatsDict)
 from .supervisor import EngineSupervisor
 from ..obs import metrics as obs_metrics
 from ..obs import reqtrace as obs_reqtrace
@@ -157,6 +176,13 @@ class FleetHandle:
         self._hop = None            # current engine-level _Request
         self._handled = None        # last hop whose resolution we consumed
         self._is_parked = False
+        # disaggregation: once a prefill replica resolves with
+        # PrefillHandoff, the payload rides the handle (it survives
+        # parking and decode-side retries) and every later placement is
+        # a CONTINUATION — imported into the target, submitted with
+        # handoff=False so it can never ping-pong back
+        self._handoff = None
+        self._continuation = False
 
     def remaining_deadline(self) -> Optional[float]:
         if self._deadline is None:
@@ -206,6 +232,10 @@ class Replica:
     def __init__(self, rid: int, engine: LLMEngine):
         self.rid = int(rid)
         self.engine = engine
+        # the replica's CLASS ("mixed"/"prefill"/"decode") — the fleet-
+        # durable copy: a rebuilt engine is re-stamped from this, and a
+        # role flip updates both
+        self.role = getattr(engine, "role", "mixed")
         self.state = HEALTHY
         self.dead = False          # torn down, awaiting rebuild/permanent
         self.crashed = False       # manual-mode: step() raised InjectedCrash
@@ -225,6 +255,41 @@ class Replica:
         return t is not None and not t.is_alive() and not e._stop
 
 
+def _parse_roles(roles, n: int) -> List[str]:
+    """Normalize a fleet role spec to one role string per replica.
+    Accepts "prefill=1,decode=2" (class counts, assigned to replicas in
+    order, remainder "mixed") or a per-replica sequence like
+    ("prefill", "decode", "decode")."""
+    valid = ("mixed", "prefill", "decode")
+    if isinstance(roles, str):
+        out: List[str] = []
+        for part in roles.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, cnt = part.partition("=")
+            name = name.strip()
+            if name not in valid:
+                raise ValueError(
+                    f"unknown replica role {name!r}; valid: {valid}")
+            out.extend([name] * int(cnt or 1))
+        if len(out) > n:
+            raise ValueError(
+                f"role spec names {len(out)} replicas, fleet has {n}")
+        out.extend(["mixed"] * (n - len(out)))
+        return out
+    out = [str(x) for x in roles]
+    if len(out) != n:
+        raise ValueError(
+            f"per-replica role list has {len(out)} entries, "
+            f"fleet has {n}")
+    for name in out:
+        if name not in valid:
+            raise ValueError(
+                f"unknown replica role {name!r}; valid: {valid}")
+    return out
+
+
 class Router:
     """Least-loaded router over N LLMEngine replicas.  See the module
     docstring for the placement/health/retry rules.
@@ -241,8 +306,10 @@ class Router:
     _STATS_KEYS = (
         "accepted", "rejected", "placed", "retries", "parked", "completed",
         "failed", "cancelled", "timed_out", "ejections", "reinstatements",
-        "canaries", "deaths", "rebuilds")
+        "canaries", "deaths", "rebuilds", "handoffs", "role_flips")
     _STATS_HELP = {
+        "handoffs": "prefill->decode KV handoffs brokered",
+        "role_flips": "replica role flips under sustained load imbalance",
         "accepted": "fleet requests accepted (a FleetHandle exists)",
         "rejected": "fleet submits refused (backpressure / no replica)",
         "placed": "engine-level placements (hops), incl. retries",
@@ -259,11 +326,19 @@ class Router:
         "rebuilds": "replicas rebuilt from the supervisor's factory",
     }
 
+    # role-aware placement: a LARGE but FINITE load penalty for placing
+    # on the wrong class (fresh work on a decode replica, a handoff
+    # continuation on a prefill replica) — finite so a sole surviving
+    # wrong-class replica still beats rejecting the request outright
+    ROLE_PENALTY = 1000.0
+
     def __init__(self, engines: Optional[Sequence[LLMEngine]] = None, *,
                  factory=None, num_replicas: Optional[int] = None,
                  supervisor: Optional[EngineSupervisor] = None,
                  faults=None, max_hops: int = 3,
                  prefix_affinity: float = 0.5,
+                 roles=None, kvstore=None,
+                 role_flip_ticks: int = 3, role_flip_ratio: float = 2.0,
                  health_interval: float = 0.05,
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
                  canary_timeout: float = 30.0,
@@ -300,6 +375,30 @@ class Router:
         # health ejection, which removes a replica from candidacy
         self.prefix_affinity = float(prefix_affinity)
         self._prefix_digests: dict = {}     # rid -> root token chunks
+        # -- disaggregation: replica classes + the shared host KV tier.
+        # `roles` is "prefill=1,decode=2" (counts, remainder mixed) or a
+        # per-replica sequence; role lives on the Replica (fleet-durable
+        # across rebuilds) and is mirrored onto the engine, which is
+        # what actually changes behavior (auto-handoff at prefill_done).
+        if roles is not None:
+            for r, role in zip(self.replicas,
+                               _parse_roles(roles, len(self.replicas))):
+                r.role = role
+                r.engine.role = role
+        self.kvstore = kvstore
+        if kvstore is not None:
+            for r in self.replicas:
+                if hasattr(r.engine, "attach_kvstore"):
+                    r.engine.attach_kvstore(kvstore)
+        self._host_digest: tuple = ()       # kvstore root chunks, per tick
+        self._tier_hits = {"device": 0, "host": 0}
+        # role-flip hysteresis: flip only after `role_flip_ticks`
+        # CONSECUTIVE ticks of >`role_flip_ratio`x per-replica class
+        # load imbalance, and only while the donor class keeps >= 1
+        self.role_flip_ticks = int(role_flip_ticks)
+        self.role_flip_ratio = float(role_flip_ratio)
+        self._flip_streak = 0
+        self._flip_toward = None
         self.health_interval = float(health_interval)
         self.backoff_base = float(backoff_base)
         self.backoff_max = float(backoff_max)
@@ -348,6 +447,15 @@ class Router:
         reg.gauge("fleet_prefix_hit_rate",
                   "cumulative prefix-cache hits / lookups across live "
                   "replicas").set_function(self._prefix_hit_rate)
+        # which TIER earned the affinity discount at scoring time: a
+        # rising host share means placement is being steered by
+        # demoted-but-warm prefixes (device evicted, host tier intact)
+        reg.gauge("fleet_prefix_tier_hit_rate",
+                  "share of placement affinity hits served by the host "
+                  "KV tier").set_function(lambda: (
+                      self._tier_hits["host"]
+                      / max(1, self._tier_hits["host"]
+                            + self._tier_hits["device"])))
         if self.threaded:
             for r in self.replicas:
                 r.engine.start()
@@ -419,6 +527,11 @@ class Router:
             snap["replica_states"] = {
                 r.rid: ("dead" if r.dead else r.state)
                 for r in self.replicas}
+            snap["replica_roles"] = {r.rid: r.role
+                                     for r in self.replicas}
+            snap["affinity_tier_hits"] = dict(self._tier_hits)
+            if self.kvstore is not None:
+                snap["kvstore"] = self.kvstore.snapshot()
         return snap
 
     # -- placement ----------------------------------------------------------
@@ -469,21 +582,31 @@ class Router:
                 pass
         return hits / total if total else 0.0
 
-    def _prefix_affinity_hit(self, r: Replica, prompt) -> bool:
-        """Does this replica's cached-prefix digest cover the request's
-        leading tokens?  True when any root chunk of its radix index is
-        a prefix of the prompt — the page-granular condition under which
-        admission there would splice at least one page."""
+    def _prefix_affinity_hit(self, r: Replica, prompt):
+        """Which cache TIER covers the request's leading tokens on this
+        replica: "device" when a root chunk of its radix index is a
+        prefix of the prompt (admission there splices at least one page
+        directly), "host" when the shared kvstore's digest covers it and
+        the replica is attached to the store (admission there PROMOTES
+        the demoted pages back — one scatter instead of a re-prefill),
+        None otherwise."""
         if not prompt:
-            return False
+            return None
         digest = self._prefix_digests.get(r.rid)
         if digest is None:
             self._refresh_prefix_digest(r)
             digest = self._prefix_digests.get(r.rid, ())
         head = tuple(prompt[:max((len(t) for t in digest), default=0)])
-        return any(t and head[:len(t)] == t for t in digest)
+        if any(t and head[:len(t)] == t for t in digest):
+            return "device"
+        hd = self._host_digest
+        if hd and getattr(r.engine, "kvstore", None) is not None:
+            head = tuple(prompt[:max(len(t) for t in hd)])
+            if any(t and head[:len(t)] == t for t in hd):
+                return "host"
+        return None
 
-    def _score(self, r: Replica, prompt=None):
+    def _score(self, r: Replica, prompt=None, continuation=False):
         """Least-loaded placement score, SMALLER is better: (queue depth
         + in-flight slots - prefix affinity, -speculative acceptance
         rate, -free pages), read from the replica's metrics GAUGES — the
@@ -527,16 +650,34 @@ class Router:
         except Exception:  # noqa: BLE001 — acceptance is advisory only
             pass
         load = q + infl
-        if prompt is not None and self.prefix_affinity \
-                and self._prefix_affinity_hit(r, prompt):
-            load -= self.prefix_affinity
+        # role-aware steering: fresh work wants a prefill-class replica,
+        # a handoff continuation wants a decode-class one; mixed is
+        # always neutral.  The penalty rides the LOAD term so health
+        # ejection (not a candidate at all) still dominates it.
+        role = r.role
+        if role != "mixed":
+            want = "decode" if continuation else "prefill"
+            if role != want:
+                load += self.ROLE_PENALTY
+        if prompt is not None and self.prefix_affinity:
+            tier = self._prefix_affinity_hit(r, prompt)
+            if tier == "device":
+                load -= self.prefix_affinity
+            elif tier == "host":
+                # a demoted-but-warm prefix still attracts placement,
+                # at half weight: a device-tier splice beats a promote
+                load -= 0.5 * self.prefix_affinity
+            if tier is not None:
+                self._tier_hits[tier] += 1
         return (load, -accept, -free_p)
 
-    def _candidates(self, prompt=None) -> List[Replica]:
+    def _candidates(self, prompt=None,
+                    continuation=False) -> List[Replica]:
         with self._lock:
             cands = [r for r in self.replicas
                      if r.state == HEALTHY and not r.dead]
-        return sorted(cands, key=lambda r: self._score(r, prompt))
+        return sorted(cands,
+                      key=lambda r: self._score(r, prompt, continuation))
 
     def _try_place(self, fh: FleetHandle, count_accepted: bool = False):
         """Try each healthy replica best-score-first.  Returns (placed,
@@ -553,12 +694,26 @@ class Router:
         counter stays monotonic for Prometheus rate())."""
         retry_after = None
         value_error = None
-        for r in self._candidates(prompt=fh.prompt):
+        for r in self._candidates(prompt=fh.prompt,
+                                  continuation=fh._continuation):
+            if fh._continuation and fh._handoff is not None:
+                # import the staged KV pages BEFORE submitting: in a
+                # threaded fleet the step thread could otherwise admit
+                # the continuation ahead of the import and re-prefill
+                # from token zero.  If the submit below is then refused
+                # (QueueFull) the pages simply stay cached on that
+                # replica — warmth, not a leak (the index owns them and
+                # LRU/demotion applies as usual).
+                try:
+                    r.engine.import_prefix(fh._handoff)
+                except Exception:  # noqa: BLE001 — stopped/dying replica
+                    continue
             try:
+                kw = {"handoff": False} if fh._continuation else {}
                 hop = r.engine.submit(
                     fh.prompt, fh.max_new_tokens, fh.eos_id,
                     deadline=fh.remaining_deadline(),
-                    req_id=fh.req_id, hop=len(fh.hops))
+                    req_id=fh.req_id, hop=len(fh.hops), **kw)
             except QueueFull as e:
                 retry_after = (e.retry_after if retry_after is None
                                else min(retry_after, e.retry_after))
@@ -621,6 +776,10 @@ class Router:
             fh._resolve(err)
             self.stats.inc("timed_out")
             self._rq_event(fh, "fleet_resolve", outcome="timed_out")
+        elif isinstance(err, PrefillHandoff):
+            # NOT a failure: a prefill-class replica finished the prefill
+            # and exported the KV — broker it to a decode-class replica
+            self._broker_handoff(fh, r, err.handoff)
         elif isinstance(err, EngineStopped):
             self._retry_or_fail(fh, r, req)
         else:
@@ -630,6 +789,52 @@ class Router:
             fh._resolve(err)
             self.stats.inc("failed")
             self._rq_event(fh, "fleet_resolve", outcome="failed")
+
+    def _broker_handoff(self, fh: FleetHandle, r: Replica,
+                        handoff) -> None:
+        """Route a finished prefill's KV pages to a decode-class replica
+        and re-place the request there as a CONTINUATION.  The payload
+        rides the handle (it survives parking and later retries), the
+        continuation flag flips placement scoring toward decode-class
+        and forces `handoff=False` on the next submit (no ping-pong).
+        Deliberately NOT charged against hops_left: a handoff is
+        forward progress, not a failure — the retry budget stays
+        reserved for deaths.  The zero-token handoff contract means a
+        decode replica dying later re-enters `_retry_or_fail` with the
+        handle still continuation-marked: the pages are re-imported on
+        the next placement from the host copy, nothing is stranded."""
+        self.stats.inc("handoffs")
+        fh._handoff = handoff
+        fh._continuation = True
+        self._rq_event(fh, "fleet_handoff", src_replica=r.rid,
+                       pages=handoff.n_pages, bytes=handoff.nbytes)
+        if fh.cancelled:
+            fh._resolve(RequestCancelled("request cancelled"))
+            self.stats.inc("cancelled")
+            self._rq_event(fh, "fleet_resolve", outcome="cancelled")
+            return
+        rem = fh.remaining_deadline()
+        if rem is not None and rem <= 0:
+            fh._resolve(DeadlineExceeded(
+                f"deadline expired at prefill->decode handoff "
+                f"(hops={fh.hops})"))
+            self.stats.inc("timed_out")
+            self._rq_event(fh, "fleet_resolve", outcome="timed_out")
+            return
+        if self._stopping:
+            fh._resolve(EngineStopped("fleet shut down"))
+            self.stats.inc("failed")
+            self._rq_event(fh, "fleet_resolve", outcome="fleet_stopped")
+            return
+        try:
+            placed, _, _ = self._try_place(fh)
+        except ValueError as e:
+            fh._resolve(e)          # no candidate can ever hold it
+            self.stats.inc("failed")
+            self._rq_event(fh, "fleet_resolve", outcome="failed")
+            return
+        if not placed:
+            self._park(fh)
 
     def _retry_or_fail(self, fh: FleetHandle, r: Replica, req) -> None:
         """Replica death resolution.  The retry-safety rules, in order:
@@ -740,7 +945,79 @@ class Router:
             self._tick_replica(r, now)
             if not r.dead:
                 self._refresh_prefix_digest(r)
+        if self.kvstore is not None:
+            try:
+                self._host_digest = self.kvstore.first_chunks()
+            except Exception:  # noqa: BLE001 — digest is advisory
+                pass
+        self._maybe_flip_roles()
         self._drain_parked()
+
+    def _maybe_flip_roles(self) -> None:
+        """Flip one replica's class under SUSTAINED load imbalance: when
+        one class's per-replica load exceeds `role_flip_ratio`x the
+        other's for `role_flip_ticks` consecutive ticks and the donor
+        class has more than one replica, the donor's least-loaded
+        replica joins the hot class.  A role lives entirely outside the
+        compiled programs (it only changes where requests are steered
+        and whether prefill_done hands off), so a flip costs zero
+        recompiles.  Mixed fleets have no classed replicas — no-op."""
+        if self._stopping:
+            return
+        groups = {"prefill": [], "decode": []}
+        for r in self.replicas:
+            if r.dead or r.state != HEALTHY:
+                continue
+            if r.role in groups:
+                groups[r.role].append(r)
+        pre, dec = groups["prefill"], groups["decode"]
+        if not pre or not dec:
+            self._flip_streak = 0
+            self._flip_toward = None
+            return
+
+        def group_load(rs):
+            tot = 0.0
+            for r in rs:
+                try:
+                    reg = r.engine.metrics
+                    q = reg.get("llm_queue_depth").value
+                    infl = reg.get("llm_slots_in_flight").value
+                    if not (math.isnan(q) or math.isnan(infl)):
+                        tot += q + infl
+                except Exception:  # noqa: BLE001 — stale stats read as 0
+                    pass
+            return tot / max(1, len(rs))
+
+        lp, ld = group_load(pre), group_load(dec)
+        # max(.., 1.0) floor: two near-idle classes never look imbalanced
+        hot = None
+        if lp > self.role_flip_ratio * max(ld, 1.0) and len(dec) > 1:
+            hot = "prefill"
+        elif ld > self.role_flip_ratio * max(lp, 1.0) and len(pre) > 1:
+            hot = "decode"
+        if hot is None:
+            self._flip_streak = 0
+            self._flip_toward = None
+            return
+        if hot != self._flip_toward:
+            self._flip_toward = hot
+            self._flip_streak = 1
+            return
+        self._flip_streak += 1
+        if self._flip_streak < self.role_flip_ticks:
+            return
+        donor = dec if hot == "prefill" else pre
+        r = min(donor, key=lambda x: self._score(x))
+        with self._lock:
+            r.role = hot
+            try:
+                r.engine.role = hot
+            except Exception:  # noqa: BLE001 — dying engine: next tick
+                pass           # re-stamps via _handle_death anyway
+            self.stats.inc("role_flips")
+        self._flip_streak = 0
+        self._flip_toward = None
 
     def _maybe_inject_death(self, r: Replica) -> None:
         try:
@@ -824,7 +1101,12 @@ class Router:
         """Reinstatement is earned: a 1-token probe must COMPLETE through
         the ejected replica before it re-enters rotation."""
         try:
-            hop = r.engine.submit([1], max_new_tokens=1)
+            # a prefill-class replica must DECODE the canary locally: a
+            # handoff resolves with zero tokens and would read as
+            # failure here forever (the ping-pong trap)
+            kw = {"handoff": False} \
+                if getattr(r.engine, "role", "mixed") != "mixed" else {}
+            hop = r.engine.submit([1], max_new_tokens=1, **kw)
         except Exception:  # noqa: BLE001 — refused/stopped: deeper backoff
             self._eject(r, now, double=True)
             return
@@ -896,6 +1178,18 @@ class Router:
         now = time.monotonic()
         new.replica_name = str(r.rid)   # keep timelines keyed by rid
         new.reqtrace = self.reqtrace    # ...and in the fleet's registry
+        # a rebuilt engine must rejoin its CLASS and the shared host
+        # tier — role and store are fleet-side state precisely so a
+        # crash can neither demote a replica to mixed nor orphan it
+        # from the warm prefixes (cold replica warm-start: its first
+        # admissions PROMOTE hot prefixes straight back from the store)
+        if r.role != "mixed":
+            new.role = r.role
+        if self.kvstore is not None and hasattr(new, "attach_kvstore"):
+            try:
+                new.attach_kvstore(self.kvstore)
+            except Exception:  # noqa: BLE001 — page-size mismatch on a
+                pass           # heterogeneous rebuild: skip, don't die
         with self._lock:
             r.engine = new
             r.dead = False

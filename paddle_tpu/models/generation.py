@@ -345,6 +345,21 @@ class PagedKVCache:
         self._refcount[page] = 1
         return page
 
+    def alloc_pages(self, n: int) -> list:
+        """Allocate `n` caller-owned pages (refcount 1 each) outside any
+        slot — the KV import path (prefix promotion / disaggregated
+        handoff) scatters host KV into them and hands ownership to the
+        prefix index.  All-or-nothing: raises RuntimeError without
+        allocating when the pool cannot cover `n`.  The caller MUST end
+        every page's life with `drop_ref` (directly, or via the index
+        after `insert` took its own refs)."""
+        n = int(n)
+        if n > len(self._free_pages):
+            raise RuntimeError(
+                f"page pool exhausted ({n} pages requested, "
+                f"{len(self._free_pages)} free)")
+        return [self._alloc_page() for _ in range(n)]
+
     def _write_row(self, slot: int) -> None:
         pages = self._slot_pages[slot]
         row = pages + [pages[-1] if pages else 0] * \
@@ -456,6 +471,23 @@ def scatter_prefill_into_pages(cache, pools, page_table, seq_len: int,
         "v": pools["v"].at[:, pidx, poff].set(
             cache["v"].astype(pools["v"].dtype)),
     }
+
+
+def pad_page_idx(pages, pages_per_seq: int) -> np.ndarray:
+    """The fixed-shape page-index vector every batched page transfer
+    (preempt swap-out, resume swap-in, prefix demotion/promotion, the
+    disaggregated prefill->decode handoff) feeds the jitted gather/
+    scatter executables: `pages` zero-padded to `pages_per_seq`.  The
+    padding aliases the reserved scratch page 0 — gathered as garbage
+    nobody reads, scattered back only onto page 0 itself — so ONE
+    compiled program covers every page count."""
+    idx = np.zeros((int(pages_per_seq),), np.int32)
+    n = len(pages)
+    if n > pages_per_seq:
+        raise ValueError(
+            f"{n} pages exceed pages_per_seq={pages_per_seq}")
+    idx[:n] = pages
+    return idx
 
 
 def gather_kv_pages(pools, page_idx):

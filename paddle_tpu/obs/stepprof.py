@@ -51,7 +51,7 @@ __all__ = ["StepProfiler", "ENGINE_PHASES"]
 # synthetic remainder (step total minus every recorded phase) — a
 # growing "other" share means the step loop gained un-attributed work.
 ENGINE_PHASES = ("schedule", "build_batch", "dispatch", "sample",
-                 "verify", "commit", "swap", "other")
+                 "verify", "commit", "swap", "transfer", "other")
 
 
 class _NoopPhase:

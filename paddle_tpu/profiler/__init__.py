@@ -92,7 +92,8 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
 
 
 def export_protobuf(dir_name: str, worker_name: Optional[str] = None):
-    # the TPU-native "protobuf" is the XPlane dump jax.profiler writes
+    # the TPU-native "protobuf" is the XPlane dump jax.profiler writes —
+    # construct the Profiler with xplane=True or the dump is skipped
     def handler(prof: "Profiler"):
         os.makedirs(dir_name, exist_ok=True)
         prof._last_export = dir_name
@@ -104,11 +105,18 @@ class Profiler:
     """Scheduler-driven profiler (profiler.py:349).
 
     targets are advisory (XLA traces whatever backend runs); `timer_only=True`
-    reproduces the lightweight ips benchmark mode."""
+    reproduces the lightweight ips benchmark mode.
+
+    `xplane=True` additionally captures a jax.profiler XPlane dump per
+    RECORD window (the device timeline for export_protobuf).  Off by
+    default: stop_trace serializes metadata for EVERY executable alive in
+    the process, which in a long-lived session costs tens of seconds and
+    the chrome export reads only the host-event ring anyway."""
 
     def __init__(self, *, targets=None, scheduler=None, on_trace_ready=None,
                  record_shapes=False, profile_memory=False, timer_only=False,
-                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+                 emit_nvtx=False, custom_device_types=None, with_flops=False,
+                 xplane=False):
         if scheduler is None:
             self._scheduler = lambda step: ProfilerState.RECORD
         elif isinstance(scheduler, (tuple, list)):
@@ -122,6 +130,7 @@ class Profiler:
             self._scheduler = scheduler
         self._on_trace_ready = on_trace_ready
         self.timer_only = timer_only
+        self._xplane = bool(xplane)
         self._step = 0
         self._state = ProfilerState.CLOSED
         self._jax_tracing = False
@@ -179,7 +188,7 @@ class Profiler:
         if not self._tracer_was_enabled:
             _host_events.clear()    # fresh profiler session owns the ring
         _host_events.enable()
-        if self._jax_tracing:
+        if self._jax_tracing or not self._xplane:
             return
         try:
             import tempfile

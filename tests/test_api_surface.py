@@ -199,6 +199,9 @@ class TestNnExtras:
 
 
 class TestVisionExtras:
+    # model-zoo forwards run under --full (see test_vision.TestModels);
+    # lenet_trains is the default-suite conv smoke
+    @pytest.mark.slow
     def test_mobilenet_v3_small(self):
         from paddle_tpu.vision.models import mobilenet_v3_small
         m = mobilenet_v3_small(num_classes=9)

@@ -1,0 +1,503 @@
+"""Disaggregated prefill/decode serving: role-classed replicas, the
+prefill->decode KV handoff over the fixed-shape swap path, role-aware
+placement and role flips, host-tier prefix affinity, the kv_transfer
+fault seam, and the transfer observability surfaces.
+
+Covers the PR-17 tentpole acceptance criteria: disaggregated
+completions token-exact vs a single mixed engine (greedy AND sampled,
+including preempt/resume on the decode side), RecompileSentinel proving
+zero post-warmup compiles on both replica classes across handoffs, a
+prefill replica killed mid-transfer stranding zero pages while the
+request retries with its remaining deadline (flight dump asserted), and
+a seeded disagg fleet soak with kv_transfer faults armed."""
+
+import importlib.util
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu.obs as obs
+from paddle_tpu.inference import LLMEngine, PrefillHandoff
+from paddle_tpu.inference import faults as F
+from paddle_tpu.inference.kvstore import TieredPrefixStore
+from paddle_tpu.inference.router import Router, _parse_roles
+from paddle_tpu.inference.supervisor import EngineSupervisor
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    path = os.path.join(_REPO, "tools", f"{name}.py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("prefill_chunk_tokens", 4)
+    kw.setdefault("block_q", 2)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _ref_tokens(params, cfg, prompt, n):
+    return np.asarray(generation.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n))[0].tolist()
+
+
+def _scripted(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    kw.setdefault("prefill_chunk_tokens", 4)
+    kw.setdefault("block_q", 2)
+    return F.ScriptedEngine(**kw)
+
+
+class TestRoleSpec:
+    def test_parse_counts_and_remainder(self):
+        assert _parse_roles("prefill=1,decode=2", 3) == \
+            ["prefill", "decode", "decode"]
+        assert _parse_roles("prefill=1", 3) == \
+            ["prefill", "mixed", "mixed"]
+
+    def test_parse_sequence_must_match_length(self):
+        assert _parse_roles(["decode", "prefill"], 2) == \
+            ["decode", "prefill"]
+        with pytest.raises(ValueError):
+            _parse_roles(["decode"], 2)
+        with pytest.raises(ValueError):
+            _parse_roles("prefill=4", 2)
+        with pytest.raises(ValueError):
+            _parse_roles("verifier=1", 1)
+
+    def test_engine_rejects_unknown_role(self):
+        with pytest.raises(ValueError, match="role"):
+            _scripted(role="verifier")
+
+
+class TestScriptedDisagg:
+    """Fleet-tier choreography at chaos-suite speed: the REAL engine
+    scheduler and transfer seam, scripted compute."""
+
+    def test_token_exact_with_handoff_hops(self):
+        r = Router(engines=[_scripted(), _scripted()],
+                   roles="prefill=1,decode=1",
+                   kvstore=TieredPrefixStore(), threaded=False)
+        prompts = [[5, 6, 7, 8, 9, 1], [2, 4, 6, 8, 1, 3, 5],
+                   [9, 9, 9, 9, 2]]
+        hs = [r.submit(p, 3) for p in prompts]
+        F.drive_fleet(r, hs)
+        for h, p in zip(hs, prompts):
+            assert h.result() == F.ScriptedEngine.reference_tokens(p, 3)
+            # every request prefilled on replica 0, decoded on replica 1
+            assert h.hops == [0, 1], h.hops
+        snap = r.stats_snapshot()
+        assert snap["handoffs"] == len(prompts)
+        assert snap["replica_roles"] == {0: "prefill", 1: "decode"}
+        # a brokered handoff is ONE accepted request, not two
+        assert snap["accepted"] == len(prompts)
+        assert snap["completed"] == len(prompts)
+        F.fleet_check_invariants(r, hs, probe=True)
+        r.shutdown()
+
+    def test_sub_page_prompt_hands_off_with_zero_pages(self):
+        """A prompt shorter than one page produces an empty-payload
+        handoff (nothing page-aligned to transfer) — the decode side
+        must cold-prefill it token-exactly."""
+        r = Router(engines=[_scripted(), _scripted()],
+                   roles="prefill=1,decode=1",
+                   kvstore=TieredPrefixStore(), threaded=False)
+        h = r.submit([7, 3], 3)
+        F.drive_fleet(r, [h])
+        assert h.result() == F.ScriptedEngine.reference_tokens([7, 3], 3)
+        assert h.hops == [0, 1]
+        assert r.replicas[1].engine.stats["kv_transfer_pages"] == 0
+        F.fleet_check_invariants(r, [h], probe=True)
+        r.shutdown()
+
+    def test_mid_transfer_kill_retries_with_remaining_deadline(
+            self, tmp_path):
+        """The stranded-transfer invariant: a prefill replica killed at
+        the kv_transfer point resolved ZERO tokens, so the fleet retry
+        rule re-places the request — with its ORIGINAL deadline, not a
+        fresh one — and the death leaves a loadable flight dump.  The
+        invariant checker proves no page leaked across the seam."""
+        import time
+
+        from paddle_tpu.obs import flight as obs_flight
+
+        engines = [_scripted(), _scripted()]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("kv_transfer", nth=1, crash=True)])
+        rec = obs_flight.FlightRecorder(dir=str(tmp_path), name="p0")
+        rec.attach_engine(engines[0])
+        r = Router(engines, supervisor=EngineSupervisor(_scripted),
+                   roles="prefill=1,decode=1",
+                   kvstore=TieredPrefixStore(), threaded=False)
+        t0 = time.monotonic()
+        h = r.submit([9, 8, 7, 6, 5, 4], 3, deadline=30)
+        F.drive_fleet(r, [h])
+        assert h.result() == \
+            F.ScriptedEngine.reference_tokens([9, 8, 7, 6, 5, 4], 3)
+        assert h.hops == [0, 1]
+        assert r.stats["deaths"] == 1
+        # remaining deadline carried over: the engine-level request's
+        # absolute deadline still anchors at the ORIGINAL submit
+        assert h._hop.deadline is not None
+        assert abs(h._hop.deadline - (t0 + 30)) < 5.0
+        dumps = sorted(tmp_path.glob("flight_*.json"))
+        assert dumps, "replica death left no flight dump"
+        d = obs_flight.load_dump(str(dumps[-1]))
+        assert d["reason"] in ("step_thread_death", "replica_death")
+        F.fleet_check_invariants(r, [h], probe=True)
+        r.shutdown()
+
+    def test_kv_transfer_consume_pools_recovers_and_serves(self):
+        """The nastiest transfer failure: the fault consumes the donated
+        pools mid-export.  That fails THIS request like any dispatch
+        fault (exactly-once: it already charged a terminal outcome), but
+        `_recover_pools` re-zeros the pools and the fleet keeps serving
+        the transfer path token-exactly."""
+        engines = [_scripted(), _scripted()]
+        engines[0].faults = F.FaultInjector(
+            [F.FaultRule("kv_transfer", nth=1, consume_pools=True)])
+        r = Router(engines, supervisor=EngineSupervisor(_scripted),
+                   roles="prefill=1,decode=1",
+                   kvstore=TieredPrefixStore(), threaded=False)
+        h = r.submit([1, 2, 3, 4, 5, 6], 3)
+        F.drive_fleet(r, [h])
+        with pytest.raises(F.InjectedFault):
+            h.result()
+        h2 = r.submit([2, 2, 3, 4, 5, 6], 3)
+        F.drive_fleet(r, [h2])
+        assert h2.result() == \
+            F.ScriptedEngine.reference_tokens([2, 2, 3, 4, 5, 6], 3)
+        assert h2.hops == [0, 1]
+        F.fleet_check_invariants(r, [h, h2], probe=True)
+        r.shutdown()
+
+    def test_role_flip_under_sustained_imbalance(self):
+        """Sustained per-class load imbalance flips the least-loaded
+        replica of the oversubscribed-against class — without touching
+        any compiled program.  The donor class must keep one replica."""
+        r = Router(engines=[_scripted(max_pending=64) for _ in range(3)],
+                   roles="prefill=1,decode=2",
+                   kvstore=TieredPrefixStore(), threaded=False,
+                   role_flip_ticks=2, role_flip_ratio=1.5)
+        hs = [r.submit([1 + i, 2, 3, 4, 5, 6], 2) for i in range(12)]
+        for _ in range(200):
+            r.pump()
+            if r.stats["role_flips"]:
+                break
+        assert r.stats["role_flips"] >= 1
+        roles = list(r.stats_snapshot()["replica_roles"].values())
+        assert roles.count("prefill") == 2      # a decode donor flipped
+        assert roles.count("decode") == 1       # ...but not the last one
+        F.drive_fleet(r, hs)
+        assert all(h.result() == F.ScriptedEngine.reference_tokens(
+            h.prompt, 2) for h in hs)
+        F.fleet_check_invariants(r, hs, probe=True)
+        r.shutdown()
+
+    def test_rebuilt_replica_keeps_role_and_store(self):
+        """Replica death in a disagg fleet: the supervisor's rebuild
+        inherits the dead replica's ROLE and re-attaches the shared
+        store — a cold restart warms from tier-demoted prefixes."""
+        engines = [_scripted(), _scripted()]
+        store = TieredPrefixStore()
+        r = Router(engines, supervisor=EngineSupervisor(_scripted),
+                   roles="prefill=1,decode=1", kvstore=store,
+                   threaded=False)
+        r.kill(r.replicas[0])
+        hs = [r.submit([4, 4, 4, 4, 2, 2], 2)]
+        F.drive_fleet(r, hs)
+        assert hs[0].result() == \
+            F.ScriptedEngine.reference_tokens([4, 4, 4, 4, 2, 2], 2)
+        new = r.replicas[0]
+        assert new.role == "prefill" and new.engine.role == "prefill"
+        assert new.engine.kvstore is store
+        r.shutdown()
+
+
+class TestRealDisagg:
+    """Tiny-llama engines end to end: real compiled programs, real KV
+    bytes across the handoff."""
+
+    def test_greedy_token_exact_with_decode_preemption(self, tiny):
+        """1-prefill/1-decode fleet vs the dense reference chain; the
+        decode replica's pool is sized below the in-flight worst case so
+        continuations preempt (swap out/in) mid-decode — the transfer
+        seam and the preemption path share one executable pair and must
+        compose token-exactly."""
+        cfg, params = tiny
+        pe = _engine(params, cfg, role="prefill")
+        de = _engine(params, cfg, role="decode", num_pages=6,
+                     preempt_mode="swap")
+        r = Router([pe, de], roles=["prefill", "decode"],
+                   kvstore=TieredPrefixStore(), threaded=False)
+        prompts = [list(range(1, 11)), list(range(3, 12)),
+                   [7, 7, 2, 9, 4, 4, 1, 3, 8]]
+        hs = [r.submit(p, 6) for p in prompts]
+        F.drive_fleet(r, hs)
+        for h, p in zip(hs, prompts):
+            assert h.result() == _ref_tokens(params, cfg, p, 6)
+            assert h.hops == [0, 1]
+        assert de.stats["preemptions"] >= 1
+        assert pe.stats["handoffs"] == len(prompts)
+        assert de.stats["kv_transfer_pages"] >= 2
+        F.fleet_check_invariants(r, hs, probe=True)
+        r.shutdown()
+
+    def test_sampled_token_exact_aligned_seed(self, tiny):
+        """Sampled equivalence: the engine PRNG key advances one split
+        per dispatched step, so a mixed engine that prefills the whole
+        prompt in ONE chunk consumes the same key stream as the decode
+        continuation (one suffix chunk + the same decode steps).  With
+        aligned streams the sampled tokens match bit-for-bit — any KV
+        corruption across the transfer would diverge the logits and,
+        at temperature, the sampled chain."""
+        cfg, params = tiny
+        prompt = list(range(1, 11))
+        kw = dict(temperature=0.8, top_k=20, seed=42)
+        mixed = _engine(params, cfg, prefill_chunk_tokens=16, **kw)
+        hm = mixed.submit(prompt, max_new_tokens=5)
+        while not hm.done():
+            mixed.step()
+        ref = list(hm.tokens)
+
+        pe = _engine(params, cfg, role="prefill")
+        hp = pe.submit(prompt, max_new_tokens=5)
+        while not hp.done():
+            pe.step()
+        with pytest.raises(PrefillHandoff) as exc:
+            hp.result()
+        handoff = exc.value.handoff
+        assert handoff.n_pages == 2 and handoff.n_tokens == 8
+
+        de = _engine(params, cfg, role="decode", **kw)
+        de.import_prefix(handoff)
+        hd = de.submit(prompt, max_new_tokens=5, handoff=False)
+        while not hd.done():
+            de.step()
+        assert list(hd.tokens) == ref
+        assert de.stats["prefix_hits"] == 1
+        assert de.stats["kv_transfer_pages"] == 2
+        F.check_invariants(pe)
+        F.check_invariants(de)
+
+    def test_zero_postwarmup_compiles_both_classes(self, tiny):
+        """After one warmup request has crossed the handoff (compiling
+        _swap_out on the prefill class and _swap_in on the decode
+        class), further disagg traffic must compile NOTHING on either
+        replica — the transfer rides the same fixed-shape executables
+        as preempt/resume."""
+        cfg, params = tiny
+        pe = _engine(params, cfg, role="prefill")
+        de = _engine(params, cfg, role="decode")
+        r = Router([pe, de], roles=["prefill", "decode"],
+                   kvstore=TieredPrefixStore(), threaded=False)
+        warm = r.submit(list(range(1, 11)), 3)
+        F.drive_fleet(r, [warm])
+        assert warm.result() == _ref_tokens(params, cfg,
+                                            list(range(1, 11)), 3)
+        sents = []
+        for eng in (pe, de):
+            s = obs.RecompileSentinel(tracer=eng.tracer,
+                                      registry=obs.Registry())
+            s.watch("ragged", eng._ragged)
+            s.watch("fused", eng._ragged_fused)
+            s.watch("swap_out", eng._swap_out)
+            s.watch("swap_in", eng._swap_in)
+            s.watch("cow", eng._cow)
+            assert s.check() == {}
+            sents.append(s)
+        prompts = [[2, 4, 6, 8, 10, 12, 14, 16, 1],
+                   [5, 5, 5, 5, 9, 9, 9, 9, 2, 6]]
+        hs = [r.submit(p, 5) for p in prompts]
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", obs.RecompileWarning)
+            F.drive_fleet(r, hs)
+        for h, p in zip(hs, prompts):
+            assert h.result() == _ref_tokens(params, cfg, p, 5)
+        for s in sents:
+            assert s.check() == {}
+            assert set(s.counts().values()) == {0}
+        r.shutdown()
+
+
+class TestHostTierAffinity:
+    def test_affinity_hit_distinguishes_tiers(self):
+        """A demoted-but-warm prefix (host tier only) still attracts
+        placement — at HALF the device-tier discount — and the router
+        counts the two tiers distinctly."""
+        store = TieredPrefixStore()
+        r = Router(engines=[_scripted(kvstore=store), _scripted()],
+                   kvstore=store, threaded=False, prefix_affinity=0.5)
+        prompt = [5, 6, 7, 8, 9, 1, 2]
+        h = r.submit(prompt, 2)
+        F.drive_fleet(r, [h])
+        holder = r.replicas[h.hops[-1]]
+        # demote the cached prefix off the device tier entirely
+        holder.engine.prefix_index.evict(10 ** 6)
+        assert store.first_chunks()
+        r.pump()                      # refresh device + host digests
+        rep = r.replicas[0]
+        assert r._prefix_affinity_hit(rep, prompt + [3]) == "host"
+        assert r._prefix_affinity_hit(rep, [8, 8, 8, 8, 8]) is None
+        base = r._score(rep)
+        warm = r._score(rep, prompt=prompt + [3])
+        # the load component earns HALF the device-tier discount
+        assert warm[0] == pytest.approx(base[0] - 0.25)
+        assert r._tier_hits["host"] >= 1
+        snap = r.stats_snapshot()
+        assert snap["affinity_tier_hits"]["host"] >= 1
+        assert r.metrics.get("fleet_prefix_tier_hit_rate").value > 0
+        r.shutdown()
+
+    def test_device_tier_outranks_host_tier(self):
+        """The replica still HOLDING the prefix on device wins over a
+        peer that could only promote it from the shared host tier."""
+        store = TieredPrefixStore()
+        r = Router(engines=[_scripted(), _scripted()], kvstore=store,
+                   threaded=False, prefix_affinity=0.5)
+        prompt = [3, 1, 4, 1, 5, 9, 2]
+        h = r.submit(prompt, 2)
+        F.drive_fleet(r, [h])
+        holder = r.replicas[h.hops[-1]]
+        other = r.replicas[1 - h.hops[-1]]
+        # seed the host tier WITHOUT evicting the device copy
+        store.put(tuple(prompt[:4]), np.ones(4, np.float32),
+                  np.ones(4, np.float32))
+        r.pump()
+        assert r._prefix_affinity_hit(holder, prompt + [7]) == "device"
+        assert r._prefix_affinity_hit(other, prompt + [7]) == "host"
+        assert r._score(holder, prompt=prompt + [7]) \
+            < r._score(other, prompt=prompt + [7])
+        r.shutdown()
+
+
+class TestTransferObservability:
+    def test_metrics_and_phase_surface(self, tiny):
+        """One handoff lights every transfer surface: the llm_kv_
+        transfer_{pages,bytes}_total counters, the `transfer` stepprof
+        phase on both classes, and the engine stats mirror."""
+        cfg, params = tiny
+        pe = _engine(params, cfg, role="prefill")
+        de = _engine(params, cfg, role="decode")
+        hp = pe.submit(list(range(1, 11)), max_new_tokens=3)
+        while not hp.done():
+            pe.step()
+        with pytest.raises(PrefillHandoff) as exc:
+            hp.result()
+        de.import_prefix(exc.value.handoff)
+        hd = de.submit(list(range(1, 11)), 3, handoff=False)
+        while not hd.done():
+            de.step()
+        assert hd.result() == _ref_tokens(params, cfg,
+                                          list(range(1, 11)), 3)
+        for eng in (pe, de):
+            assert eng.stats["kv_transfer_pages"] == 2
+            assert eng.stats["kv_transfer_bytes"] > 0
+            text = eng.metrics.render()
+            assert "llm_kv_transfer_pages_total 2" in text
+            assert "llm_kv_transfer_bytes_total" in text
+            phases = eng.stats_snapshot()["step_phases"]["phases"]
+            assert "transfer" in phases
+            assert phases["transfer"]["total_s"] > 0
+
+    def test_transfer_counter_track_through_trace_summary(
+            self, tmp_path, capsys):
+        """The `transfer` Perfetto counter track survives export_merged
+        and `trace_summary --counters` tabulates its series."""
+        import json
+
+        from paddle_tpu.obs import trace as obs_trace
+
+        tr = obs.Tracer(enabled=True)
+        store = TieredPrefixStore()
+        eng = _scripted(tracer=tr, kvstore=store, role="prefill")
+        h = eng.submit([5, 6, 7, 8, 9, 1], max_new_tokens=2)
+        while not h.done():
+            eng.step()
+        with pytest.raises(PrefillHandoff):
+            h.result()
+        counters = [e for e in tr.events() if e.ph == "C"
+                    and e.name == "transfer"]
+        assert counters
+        assert {"pages", "bytes", "demoted", "promoted"} \
+            <= set(counters[-1].attrs)
+        assert counters[-1].attrs["pages"] >= 1
+        path = str(tmp_path / "t.json")
+        obs_trace.export_merged({"0": tr}, path)
+        ts = _load_tool("trace_summary")
+        assert ts.main(["--counters", path, "--json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        series = out["replica 0"]["transfer"]
+        assert series["pages"]["last"] >= 1
+
+    def test_bench_diff_classifies_disagg_gates_lower_better(self):
+        """The two extra.disagg A/B gates must not hang off substring
+        heuristics: both ratios classify lower-better, so a rising
+        ratio (disagg losing its win) fails CI."""
+        bd = _load_tool("bench_diff")
+        for leaf in ("itl_burst_disagg_vs_mixed", "ttft_warm_vs_cold"):
+            assert bd.classify(f"extra.disagg.{leaf}") == "lower"
+        old = {"extra": {"disagg": {"itl_burst_disagg_vs_mixed": 0.7}}}
+        new = {"extra": {"disagg": {"itl_burst_disagg_vs_mixed": 0.9}}}
+        rep = bd.diff(old, new, threshold=0.05)
+        assert [r["metric"] for r in rep["regressions"]] == \
+            ["extra.disagg.itl_burst_disagg_vs_mixed"]
+
+
+def _disagg_soak(seeds):
+    """Seeded random fleet schedules against a DISAGGREGATED scripted
+    fleet: every multi-page request crosses the transfer seam while
+    replicas die (including at kv_transfer), and the fleet invariant
+    checker (exact-once resolution, token-exact retries, zero leaked
+    pages, gauge agreement) must stay green."""
+    for seed in seeds:
+        n_replicas = 2 + seed % 2
+        engine_rules, router_rules = F.fleet_random_schedule(
+            seed, n_replicas=n_replicas)
+        rng = np.random.default_rng(seed)
+        workload = [(rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
+                                  int(rng.integers(2, 9))).tolist(),
+                     int(rng.integers(2, 7)))
+                    for _ in range(6)]
+        report = F.fleet_run_schedule(
+            _scripted, engine_rules, router_rules, workload,
+            n_replicas=n_replicas, threaded=False,
+            reference=lambda h: F.ScriptedEngine.reference_tokens(
+                h.prompt, h.max_new_tokens, h.eos_id),
+            probe=seed % 5 == 0,
+            router_kw={"roles": f"prefill=1,decode={n_replicas - 1}",
+                       "kvstore": TieredPrefixStore()})
+        assert report["ok"], report
+
+
+class TestDisaggSoak:
+    def test_eight_seed_disagg_soak(self):
+        _disagg_soak(range(8))
+
+    @pytest.mark.slow
+    def test_two_hundred_seed_disagg_soak(self):
+        _disagg_soak(range(200))

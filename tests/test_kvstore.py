@@ -1,0 +1,246 @@
+"""Tiered KV prefix store (inference/kvstore.py): host-RAM tier under
+the radix prefix index, with optional disk spill.
+
+Covers the PR-17 tiered-store satellite: store-level put/get semantics
+(byte-exact copies, idempotent demotion, LRU capacity with spill-or-
+drop), the engine demote/promote round trip being BYTE-exact in the
+device pool, disk spill surviving a process restart (fresh store
+reopened on the same directory still serves a token-exact splice), and
+`_recover_pools` invalidating only the device tier — host copies were
+taken while the KV was live, so they stay warm."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.inference import faults as F
+from paddle_tpu.inference.kvstore import KVHandoff, TieredPrefixStore
+from paddle_tpu.models import generation, llama
+from paddle_tpu.models.llama import LlamaConfig
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    cfg = LlamaConfig.tiny()
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    return cfg, params
+
+
+def _engine(params, cfg, **kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 32)
+    kw.setdefault("prefill_chunk_tokens", 4)
+    kw.setdefault("block_q", 2)
+    return LLMEngine(params, cfg, **kw)
+
+
+def _ref_tokens(params, cfg, prompt, n):
+    return np.asarray(generation.generate(
+        params, jnp.asarray([prompt], jnp.int32), cfg,
+        max_new_tokens=n))[0].tolist()
+
+
+class TestStoreUnit:
+    def test_put_get_byte_exact_and_isolated(self):
+        store = TieredPrefixStore()
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((2, 4, 8)).astype(np.float32)
+        v = rng.standard_normal((2, 4, 8)).astype(np.float32)
+        assert store.put((1, 2, 3, 4), k, v)
+        # the store copied: mutating the caller's buffer after put must
+        # not corrupt the cached page
+        k_orig = k.copy()
+        k[:] = -1.0
+        got_k, got_v = store.get([1, 2, 3, 4])
+        assert np.array_equal(got_k, k_orig)
+        assert np.array_equal(got_v, v)
+        assert store.hits == 1 and store.promoted_pages == 1
+
+    def test_put_is_idempotent(self):
+        store = TieredPrefixStore()
+        k = np.ones((2, 4), np.float32)
+        assert store.put((9, 9, 9, 9), k, k)
+        assert not store.put((9, 9, 9, 9), k, k)
+        assert len(store) == 1 and store.demoted_pages == 1
+
+    def test_miss_counts_and_returns_none(self):
+        store = TieredPrefixStore()
+        assert store.get((5, 5)) is None
+        assert store.misses == 1 and store.hits == 0
+
+    def test_lru_capacity_drops_oldest_without_spill_dir(self):
+        page = np.ones((4, 4), np.float32)          # 64 bytes each
+        store = TieredPrefixStore(capacity_bytes=3 * 2 * page.nbytes)
+        for i in range(4):
+            store.put((i, i, i, i), page, page)
+        # oldest entry dropped (no disk tier), newest three retained
+        assert not store.contains((0, 0, 0, 0))
+        assert all(store.contains((i, i, i, i)) for i in (1, 2, 3))
+        assert store.resident_bytes <= 3 * 2 * page.nbytes
+
+    def test_get_refreshes_lru_order(self):
+        page = np.ones((4, 4), np.float32)
+        store = TieredPrefixStore(capacity_bytes=2 * 2 * page.nbytes)
+        store.put((0,) * 4, page, page)
+        store.put((1,) * 4, page, page)
+        assert store.get((0,) * 4) is not None     # touch: 0 is now MRU
+        store.put((2,) * 4, page, page)
+        assert store.contains((0,) * 4)
+        assert not store.contains((1,) * 4)
+
+    def test_spill_to_disk_past_capacity(self, tmp_path):
+        page = np.arange(16, dtype=np.float32).reshape(4, 4)
+        store = TieredPrefixStore(capacity_bytes=0,
+                                  spill_dir=str(tmp_path))
+        store.put((3, 1, 4, 1), page, 2 * page)
+        snap = store.snapshot()
+        assert snap["ram_pages"] == 0 and snap["disk_pages"] == 1
+        assert snap["spilled_pages"] == 1
+        got_k, got_v = store.get((3, 1, 4, 1))
+        assert np.array_equal(got_k, page)
+        assert np.array_equal(got_v, 2 * page)
+        assert store.loaded_pages == 1
+
+    def test_reopened_store_reindexes_spill(self, tmp_path):
+        page = np.full((2, 4), 7.0, np.float32)
+        a = TieredPrefixStore(capacity_bytes=0, spill_dir=str(tmp_path))
+        a.put((8, 6, 7, 5), page, page)
+        # "process restart": a FRESH store on the same directory
+        b = TieredPrefixStore(spill_dir=str(tmp_path))
+        assert b.contains((8, 6, 7, 5))
+        got_k, _ = b.get((8, 6, 7, 5))
+        assert np.array_equal(got_k, page)
+
+    def test_clear_removes_ram_and_disk(self, tmp_path):
+        page = np.ones((2, 4), np.float32)
+        store = TieredPrefixStore(capacity_bytes=0,
+                                  spill_dir=str(tmp_path))
+        store.put((1, 1, 1, 1), page, page)
+        store.put((2, 2, 2, 2), page, page)
+        store.clear()
+        assert len(store) == 0
+        assert not list(tmp_path.glob("kvp_*.npz"))
+
+    def test_first_chunks_needs_page_size(self):
+        store = TieredPrefixStore()
+        page = np.ones((2, 4), np.float32)
+        store.put((1, 2, 3, 4), page, page)
+        store.put((1, 2, 3, 4, 5, 6, 7, 8), page, page)
+        assert store.first_chunks() == ()        # no page_size stamped
+        store.page_size = 4
+        assert store.first_chunks() == ((1, 2, 3, 4),)
+
+    def test_handoff_nbytes_counts_real_pages_only(self):
+        hk = np.zeros((2, 8, 4, 2, 16), np.float32)   # 8-page staging
+        h = KVHandoff([1, 2, 3], 8, 2, hk, hk.copy())
+        per_page = 2 * hk.nbytes // 8
+        assert h.nbytes == 2 * per_page
+        assert KVHandoff([1], 0, 0, None, None).nbytes == 0
+
+
+class TestEngineTier:
+    def test_demote_promote_round_trip_byte_exact(self, tiny):
+        """LRU eviction gathers the dying pages' KV to the host tier;
+        the next admission of the same prompt promotes them back — and
+        the promoted device pages hold bit-identical KV, proven by
+        comparing pool contents across the round trip (token-exactness
+        alone would survive small numeric drift; the tier must not
+        introduce ANY)."""
+        cfg, params = tiny
+        store = TieredPrefixStore()
+        eng = _engine(params, cfg, kvstore=store)
+        prompt = list(range(1, 10))
+        ref = _ref_tokens(params, cfg, prompt, 2)
+        assert eng.generate([prompt], max_new_tokens=2)[0] == ref
+        probe = np.asarray(prompt + [0], np.int32)
+        matched, pages = eng.prefix_index.lookup(probe, len(prompt))
+        assert matched >= eng.cache.page_size and pages
+        pool_k = np.asarray(eng.cache.pools["k"])
+        saved = {p: pool_k[:, p].copy() for p in pages}
+        evicted = eng.prefix_index.evict(10 ** 6)
+        assert evicted == len(pages)
+        assert eng.stats["kv_demoted_pages"] >= 2
+        assert store.demoted_pages == evicted
+        # same prompt again: page-aligned promotion through _swap_in
+        assert eng.generate([prompt], max_new_tokens=2)[0] == ref
+        assert eng.stats["kv_promoted_pages"] >= 2
+        assert eng.stats["prefix_tier_hits"] >= 1
+        m2, pages2 = eng.prefix_index.lookup(probe, len(prompt))
+        assert m2 == matched
+        pool_k2 = np.asarray(eng.cache.pools["k"])
+        for old, new in zip(pages, pages2):
+            assert np.array_equal(pool_k2[:, new], saved[old])
+
+    def test_disk_spill_survives_process_restart(self, tiny, tmp_path):
+        """capacity_bytes=0 forces every demotion straight to disk; a
+        FRESH store reopened on the same spill_dir, attached to a FRESH
+        engine, must serve a token-exact spliced admission — cached
+        prefixes outlive the process."""
+        cfg, params = tiny
+        prompt = list(range(2, 12))
+        ref = _ref_tokens(params, cfg, prompt, 3)
+        store = TieredPrefixStore(capacity_bytes=0,
+                                  spill_dir=str(tmp_path))
+        eng = _engine(params, cfg, kvstore=store)
+        assert eng.generate([prompt], max_new_tokens=3)[0] == ref
+        eng.prefix_index.evict(10 ** 6)
+        assert store.snapshot()["disk_pages"] >= 2
+        # restart: new store, new engine, same directory
+        store2 = TieredPrefixStore(spill_dir=str(tmp_path))
+        eng2 = _engine(params, cfg, kvstore=store2)
+        assert eng2.generate([prompt], max_new_tokens=3)[0] == ref
+        assert eng2.stats["kv_promoted_pages"] >= 2
+        assert store2.loaded_pages >= 2
+        assert eng2.stats["prefix_hits"] >= 1
+        F.check_invariants(eng2)
+
+    def test_recover_pools_leaves_host_tier_intact(self, tiny):
+        """Pool recovery must clear the DEVICE index (its pages now hold
+        zeroed KV) but never the host tier — those copies were gathered
+        while the KV was live, and re-warming from them is the whole
+        point of a tiered store."""
+        cfg, params = tiny
+        store = TieredPrefixStore()
+        eng = _engine(params, cfg, kvstore=store)
+        prompt = list(range(1, 10))
+        ref = _ref_tokens(params, cfg, prompt, 2)
+        assert eng.generate([prompt], max_new_tokens=2)[0] == ref
+        eng.prefix_index.evict(10 ** 6)
+        host_keys = set(store.keys())
+        assert host_keys
+        eng.cache.pools["k"].delete()
+        eng.cache.pools["v"].delete()
+        assert eng._recover_pools(RuntimeError("boom"))
+        assert eng.prefix_index.cached_pages == 0
+        assert set(store.keys()) == host_keys
+        # the recovered engine warms straight from the host tier
+        assert eng.generate([prompt], max_new_tokens=2)[0] == ref
+        assert eng.stats["kv_promoted_pages"] >= 2
+        F.check_invariants(eng)
+
+    def test_attach_rejects_page_size_mismatch(self, tiny):
+        cfg, params = tiny
+        store = TieredPrefixStore(page_size=8)
+        with pytest.raises(ValueError, match="page_size"):
+            _engine(params, cfg, kvstore=store)       # engine uses 4
+
+    def test_scripted_engine_demotes_and_promotes(self):
+        """The tier also runs under ScriptedEngines (opaque 1-D KV
+        stubs) — that is what lets the chaos soaks exercise it at
+        chaos-suite speed."""
+        store = TieredPrefixStore()
+        eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
+                               prefill_chunk_tokens=4, block_q=2,
+                               kvstore=store)
+        prompt = [5, 6, 7, 8, 9, 1, 2]
+        ref = F.ScriptedEngine.reference_tokens(prompt, 3)
+        assert eng.generate([prompt], max_new_tokens=3)[0] == ref
+        eng.prefix_index.evict(10 ** 6)
+        assert len(store) >= 1
+        assert eng.generate([prompt], max_new_tokens=3)[0] == ref
+        assert eng.stats["kv_promoted_pages"] >= 1
+        F.check_invariants(eng)

@@ -64,6 +64,10 @@ _SKIP_LEAVES = {
 _LOWER_LEAVES = {
     "prefill_tokens_mean", "prefill_tokens_hit95_vs_cold",
     "itl_fused_vs_unfused",
+    # disagg gates: decode-tail A/B ratio under a prefill burst and the
+    # host-tier warm-start TTFT ratio ("itl"/"ttft" substrings would
+    # already classify these, but A/B gates must not hang off substrings)
+    "itl_burst_disagg_vs_mixed", "ttft_warm_vs_cold",
 }
 
 # time/size units marking a LOWER-is-better metric — matched as leaf

@@ -17,6 +17,12 @@ Usage:
     python tools/chaos_fleet.py --flight-dir /tmp/flight  # black-box armed:
                                                    # every replica death must
                                                    # leave a loadable dump
+    python tools/chaos_fleet.py --disagg           # disaggregated fleet:
+                                                   # replica 0 prefill-class,
+                                                   # rest decode-class, shared
+                                                   # tiered prefix store; the
+                                                   # kv_transfer fault point
+                                                   # fires on real handoffs
     python tools/chaos_fleet.py --bench --json     # router micro-bench
                                                    # (bench.py extra.router)
 
@@ -120,6 +126,13 @@ def main():
                     help="prefill_chunk_tokens for every replica engine "
                          "(small default -> multi-chunk prefills, so "
                          "replica death mid-chunk is actually exercised)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the soak against a disaggregated fleet "
+                         "(roles prefill=1,decode=N-1 plus a shared "
+                         "TieredPrefixStore) so every multi-chunk "
+                         "request crosses a real prefill->decode KV "
+                         "handoff while the schedules kill replicas — "
+                         "including mid-kv_transfer")
     ap.add_argument("--flight-dir", default=None, metavar="DIR",
                     help="arm a flight recorder on every replica: a "
                          "replica death MUST leave a loadable dump here "
@@ -169,9 +182,15 @@ def main():
         return F.ScriptedEngine.reference_tokens(
             h.prompt, h.max_new_tokens, h.eos_id)
 
+    if args.disagg and args.replicas < 2:
+        print("--disagg needs --replicas >= 2 (one prefill class, at "
+              "least one decode class)", file=sys.stderr)
+        return 2
+
     reports, violations = [], 0
     totals = {"fired": 0, "completed": 0, "failed": 0, "retried": 0,
-              "deaths": 0, "rebuilds": 0, "ejections": 0}
+              "deaths": 0, "rebuilds": 0, "ejections": 0,
+              "handoffs": 0, "role_flips": 0}
     for i in range(args.schedules):
         seed = args.seed + i
         engine_rules, router_rules = F.fleet_random_schedule(
@@ -181,12 +200,21 @@ def main():
                                   int(rng.integers(2, 9))).tolist(),
                      int(rng.integers(2, 7)))
                     for _ in range(args.requests)]
+        router_kw = None
+        if args.disagg:
+            # fresh store per schedule: cross-schedule warmth would make
+            # the token-exactness oracle depend on schedule ORDER
+            from paddle_tpu.inference.kvstore import TieredPrefixStore
+
+            router_kw = {"roles": f"prefill=1,decode={args.replicas - 1}",
+                         "kvstore": TieredPrefixStore()}
         dumps_before = set(_dumps())
         try:
             report = F.fleet_run_schedule(
                 mk, engine_rules, router_rules, workload,
                 n_replicas=args.replicas, threaded=args.threaded,
-                reference=ref, probe=i % args.probe_every == 0)
+                reference=ref, probe=i % args.probe_every == 0,
+                router_kw=router_kw)
         except F.InvariantViolation as e:
             violations += 1
             report = {"ok": False, "violations": str(e),
@@ -228,8 +256,9 @@ def main():
             for k in ("completed", "failed", "retried"):
                 totals[k] += report[k]
             totals["fired"] += len(report["fired"])
-            for k in ("deaths", "rebuilds", "ejections"):
-                totals[k] += report["stats"][k]
+            for k in ("deaths", "rebuilds", "ejections",
+                      "handoffs", "role_flips"):
+                totals[k] += report["stats"].get(k, 0)
         status = "ok " if report["ok"] else "LEAK"
         line = f"[{status}] seed={seed}"
         if report["ok"]:
@@ -239,6 +268,8 @@ def main():
                      f" retried={report['retried']}"
                      f" deaths={report['stats']['deaths']}"
                      f" rebuilds={report['stats']['rebuilds']}")
+            if args.disagg:
+                line += f" handoffs={report['stats'].get('handoffs', 0)}"
         else:
             line += f" violations={report['violations']}"
         print(line)
@@ -256,7 +287,7 @@ def main():
           f"checked schedule(s)")
 
     summary = {"schedules": args.schedules, "replicas": args.replicas,
-               "violations": violations,
+               "disagg": bool(args.disagg), "violations": violations,
                "telemetry_mismatches": telemetry_bad, **totals}
     if args.json:
         print(json.dumps({"summary": summary, "reports": reports},
