@@ -1141,6 +1141,35 @@ def _run_graphlint(timeout: float = 900.0, rewrite_tier: bool = True,
         return {"error": repr(e)[:300]}
 
 
+def _run_threadlint(timeout: float = 300.0) -> dict:
+    """extra.threadlint: the lock-discipline tier's verdict on the
+    serving stack (tools/graphlint.py --threads --json, CPU
+    subprocess) — per-module severity counts over paddle_tpu.inference
+    and paddle_tpu.obs.  Static only (AST walk, nothing imports the
+    engine); BENCH rounds track race-finding drift the way model-lint
+    drift is tracked, and tools/bench_diff.py treats every threadlint
+    counter as lower-is-better."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "graphlint.py")
+    argv = [sys.executable, script, "--threads", "--json"]
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if out.returncode not in (0, 1):
+            return {"error": f"rc={out.returncode} "
+                             f"{out.stderr.strip()[-300:]}"}
+        d = json.loads(out.stdout.strip().splitlines()[-1])
+        counts = d.get("counts", {})
+        return {"ok": d.get("ok", False), "counts": counts,
+                "findings_total": sum(sum(c.values())
+                                      for c in counts.values())}
+    except subprocess.TimeoutExpired:
+        return {"error": f"threadlint timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — lint must not kill the bench
+        return {"error": repr(e)[:300]}
+
+
 def _run_spmd(timeout: float = 600.0) -> dict:
     """extra.spmd: the SPMD propagation tier's verdict on the sharded
     llama train step under a 2x2 (dp x tp) mesh — per-eqn sharding
@@ -1323,6 +1352,7 @@ def main():
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
+    threadlint_extra = _run_threadlint()
     spmd_extra = _run_spmd()
     router_extra = _run_router()
 
@@ -1385,6 +1415,10 @@ def main():
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
             "graphlint": graphlint_extra,
+            # lock-discipline tier over the serving stack (graphlint
+            # --threads): per-module race/lock-order/blocking/leak
+            # finding counts — all lower-is-better in bench_diff
+            "threadlint": threadlint_extra,
             # per-model static memory peak (jaxpr liveness walker) so
             # BENCH_*.json tracks the footprint trend round over round
             "graphlint_mem_peak_bytes": graphlint_mem_peaks,

@@ -22,6 +22,14 @@ rebuilt where it belongs under XLA — in TWO tiers:
                    prices every implied collective (`comm_cost.py`) —
                    SHARD_RESHARD / mesh-aware SHARD_REPLICATED /
                    COLLECTIVE_BOUND roofline.
+  tier 5 (threads):`threadlint.analyze_modules()` walks the SERVING
+                   stack's Python ASTs instead of jaxprs — per-class
+                   lock protection maps, RACE_UNGUARDED_WRITE/READ,
+                   LOCK_ORDER_CYCLE, LOCK_BLOCKING_CALL, THREAD_LEAK —
+                   confirmed at runtime by `inference/faults.
+                   LockWitness` (the chaos soaks' lock-order witness),
+                   the same static-predicts/dynamic-confirms contract
+                   `equiv.py` gives the rewrite tier.
 
 On top of findings, `fixes.suggest_fixes(report)` emits concrete patch
 suggestions (exact donate_argnums, constraint insertion points, dtype
@@ -51,6 +59,7 @@ from . import comm_cost  # noqa: F401 — static collective cost model
 from . import checkers as _checkers  # noqa: F401 — registers the jaxpr set
 from . import memory  # noqa: F401 — registers the memory checker
 from . import spmd  # noqa: F401 — registers the mesh-aware SPMD tier
+from . import threadlint  # noqa: F401 — the lock-discipline tier (v5)
 from .hlo import (  # noqa: F401
     analyze_hlo, lint_bucket_menu, list_hlo_checkers, register_hlo_checker,
 )
@@ -72,4 +81,5 @@ __all__ = [
     "merge_reports", "register_checker", "register_hlo_checker",
     "register_rewrite", "rewrite", "rewrite_jaxpr", "rewrite_lib",
     "suppressions", "cost", "comm_cost", "memory", "hlo", "fixes", "spmd",
+    "threadlint",
 ]

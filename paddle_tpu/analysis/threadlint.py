@@ -1,0 +1,1251 @@
+"""threadlint — Graph Doctor v5: lock-discipline static analysis.
+
+The jaxpr/HLO/rewrite/SPMD tiers lint the *compiled* program; this tier
+lints the *concurrent* one.  The serving stack is a real multi-threaded
+system — engine step thread, HTTP handler threads, router health tick,
+supervisor rebuilds — and every shipped race (PR 9's lockless stats
+`inc`, PR 10's post-teardown death sweep, PR 11's `verify_tokens`
+identity tear) had the same shape: a `self._x` field touched both under
+a lock and outside it.  threadlint walks the package ASTs and infers,
+per class, a *lock protection map* — which fields are read/written
+under which held locks — then emits graphlint-style `Finding`s:
+
+  RACE_UNGUARDED_WRITE  field mutated both under a lock and outside it
+                        (or outside its annotated owner thread)
+  RACE_UNGUARDED_READ   multi-word read of lock-protected state outside
+                        the lock (the PR 11 identity-tear shape), or
+                        iteration over a protected container
+  LOCK_ORDER_CYCLE      the static lock-acquisition graph has a cycle
+                        (router lock vs engine lock vs registry lock)
+  LOCK_BLOCKING_CALL    device dispatch / `.result()` / `time.sleep` /
+                        HTTP I/O while holding a lock
+  THREAD_LEAK           non-daemon Thread started with no join path
+
+Opt-outs are in-source annotations, VERIFIED rather than trusted:
+
+  self._slots = []   # threadlint: owned=_loop  <why it is safe>
+      field-level (on the `__init__` assignment): the field is owned by
+      the thread entering at method `<name>`.  Every non-init write
+      site must be reachable from that method through the intra-class
+      call graph — a lying `owned=` (a write from a second entry point)
+      still fires, unless that site carries its own line annotation.
+
+  # threadlint: atomic  <why it is safe>
+      field-level in `__init__`: single-word/intentionally racy field,
+      no write/read findings.  On any other line (including a `def`
+      line): acknowledges the finding anchored at that line/method.
+
+The dynamic half lives in `inference/faults.LockWitness`: an
+instrumented lock wrapper armed by the chaos soaks that records the
+per-thread acquisition order at runtime and fails the soak on any order
+inversion or a lock held across a fenced dispatch — the dynamic tier
+confirms what this static tier predicts, same contract as `equiv.py`
+for the rewrite tier.  Both surface through `tools/graphlint.py
+--threads` with baseline-diff CI semantics (schema v4).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import importlib.util
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import CheckContext, Finding, Report, Severity, finalize_findings
+
+__all__ = [
+    "DEFAULT_MODULES", "analyze_modules", "analyze_source", "inventory",
+    "scan_modules",
+]
+
+DEFAULT_MODULES = ("paddle_tpu.inference", "paddle_tpu.obs")
+
+CHECKER = "threadlint"
+
+# threading constructors -> lock kind.  "lock"/"rlock"/"condition"/
+# "semaphore" are holdable (context managers that block); Event is
+# inventoried but never "held".
+_LOCK_CTORS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "Semaphore": "semaphore", "BoundedSemaphore": "semaphore",
+    "Event": "event",
+}
+_HOLDABLE = {"lock", "rlock", "condition", "semaphore"}
+
+# method calls that mutate a container in place — `self._x.append(...)`
+# is a write to `_x`
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "insert", "pop", "popleft",
+    "popitem", "remove", "discard", "clear", "update", "add",
+    "setdefault", "move_to_end", "rotate", "sort", "reverse",
+})
+
+# attribute calls that block (or dispatch to the device) — forbidden
+# while holding any lock.  `.join` is gated on a thread-ish receiver so
+# `", ".join(parts)` under a lock stays silent.
+_BLOCKING_ATTRS = frozenset({
+    "result", "serve_forever", "urlopen", "getresponse",
+    "block_until_ready",
+})
+# jitted dispatch callables on the engine: a device dispatch under a
+# lock serializes every other thread behind device latency
+_DISPATCH_ATTRS = frozenset({
+    "_ragged", "_ragged_fused", "_swap_out", "_swap_in", "_cow",
+    "device_put",
+})
+
+_ANN_RE = re.compile(r"#\s*threadlint:\s*(\S+)")
+
+# container/stdlib method names never treated as cross-class call
+# targets (a `q.get()` under a lock is not a call into TieredPrefixStore
+# just because the store also defines `get`)
+_GENERIC_METHOD_NAMES = frozenset(_MUTATORS) | frozenset({
+    "get", "keys", "values", "items", "copy", "put", "join", "start",
+    "wait", "wait_for", "notify", "notify_all", "acquire", "release",
+    "set", "is_set", "close", "open", "read", "write", "encode",
+    "decode", "format", "split", "strip", "is_alive", "count",
+    "tolist", "item", "sum", "mean", "any", "all",
+})
+
+
+# ---------------------------------------------------------------------------
+# collected facts
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _LockDef:
+    owner: str          # qualified class name (or "<module>")
+    attr: str
+    kind: str           # lock|rlock|condition|semaphore|event
+    file: str
+    line: int
+
+    @property
+    def node(self) -> str:
+        return f"{self.owner}.{self.attr}"
+
+
+@dataclasses.dataclass
+class _Write:
+    field: str
+    line: int
+    locks: Tuple[str, ...]
+    method: str
+    acked: bool
+
+
+@dataclasses.dataclass
+class _Read:
+    field: str
+    line: int
+    locks: Tuple[str, ...]
+    method: str
+    iterated: bool
+    closure: bool
+
+
+@dataclasses.dataclass
+class _Acquire:
+    lock: str
+    line: int
+    held: Tuple[str, ...]
+    method: str
+
+
+@dataclasses.dataclass
+class _CallSite:
+    name: str           # callee method name
+    held: Tuple[str, ...]
+    line: int
+    method: str
+    on_self: bool
+
+
+@dataclasses.dataclass
+class _Blocking:
+    what: str
+    line: int
+    held: Tuple[str, ...]
+    method: str
+    acked: bool
+
+
+@dataclasses.dataclass
+class _ThreadUse:
+    target: str         # "self._loop", "srv.serve_forever", "?"
+    line: int
+    daemon: bool
+    assigned: str       # storage expr ("self._thread", "t", "")
+    method: str
+    file: str
+
+
+class _ClassInfo:
+    def __init__(self, name: str, root: str, mod: str, file: str):
+        self.name = name          # qualified class name
+        self.root = root          # requested root module
+        self.mod = mod            # full module name (for messages)
+        self.file = file
+        self.locks: Dict[str, _LockDef] = {}
+        self.methods: Set[str] = set()
+        self.field_ann: Dict[str, str] = {}   # field -> "atomic"|"owned=M"
+        self.writes: List[_Write] = []
+        self.reads: List[_Read] = []
+        self.acquires: List[_Acquire] = []
+        self.calls: List[_CallSite] = []
+        self.blocking: List[_Blocking] = []
+        self.threads: List[_ThreadUse] = []
+        self.def_acked: Set[str] = set()      # methods with an acked def line
+        self.acked_lines: Set[int] = set()    # annotated lines in this file
+
+    def clear_method(self, m: str):
+        for lst in (self.writes, self.reads, self.acquires, self.calls,
+                    self.blocking, self.threads):
+            lst[:] = [x for x in lst if x.method != m]
+
+    @property
+    def is_module(self) -> bool:
+        return self.name == "<module>"
+
+
+class _Program:
+    """Every class (and module-level pseudo-class) across the analyzed
+    modules, plus the module-wide join/daemon evidence for THREAD_LEAK."""
+
+    def __init__(self):
+        self.classes: List[_ClassInfo] = []
+        self.joins: Set[str] = set()        # unparsed join receivers
+        self.join_attrs: Set[str] = set()   # last attr of join receivers
+        self.module_locks: Dict[str, _LockDef] = {}   # bare name -> def
+
+    # name resolution ------------------------------------------------------
+    def lock_owner_classes(self) -> Dict[str, List[_ClassInfo]]:
+        out: Dict[str, List[_ClassInfo]] = {}
+        for c in self.classes:
+            for attr in c.locks:
+                out.setdefault(attr, []).append(c)
+        return out
+
+    def method_owners(self, name: str) -> List[_ClassInfo]:
+        return [c for c in self.classes
+                if not c.is_module and name in c.methods]
+
+
+# ---------------------------------------------------------------------------
+# per-file AST walk
+# ---------------------------------------------------------------------------
+
+def _annotations(src: str) -> Dict[int, str]:
+    """line -> annotation spec ("atomic" / "owned=M") for every
+    `# threadlint:` comment in the source."""
+    out: Dict[int, str] = {}
+    for i, line in enumerate(src.splitlines(), start=1):
+        m = _ANN_RE.search(line)
+        if m:
+            out[i] = m.group(1)
+    return out
+
+
+def _self_attr(node) -> Optional[str]:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _lock_ctor_kind(call: ast.AST) -> Optional[str]:
+    if not isinstance(call, ast.Call):
+        return None
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading":
+        return _LOCK_CTORS.get(f.attr)
+    if isinstance(f, ast.Name):
+        return _LOCK_CTORS.get(f.id)
+    return None
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread" and \
+            isinstance(f.value, ast.Name) and f.value.id == "threading":
+        return True
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+class _FileWalker:
+    """Walks one parsed file, filling `_ClassInfo`s into the program."""
+
+    def __init__(self, prog: _Program, tree: ast.Module, src: str,
+                 root: str, mod: str, file: str):
+        self.prog = prog
+        self.src = src
+        self.root = root
+        self.mod = mod
+        self.file = file
+        self.ann = _annotations(src)
+        self.tree = tree
+        self._src_lines = src.splitlines()
+
+    def _ann_at(self, line: int) -> Optional[str]:
+        """Annotation on the line itself, or in the contiguous comment
+        block directly above it (multi-line justifications)."""
+        if line in self.ann:
+            return self.ann[line]
+        lines = self._src_lines
+        i = line - 1
+        while i >= 1 and i <= len(lines) and \
+                lines[i - 1].strip().startswith("#"):
+            if i in self.ann:
+                return self.ann[i]
+            i -= 1
+        return None
+
+    # -- pass 1: discover classes, locks, methods, field annotations ------
+    def collect(self):
+        self._klass_nodes: List[Tuple[ast.ClassDef, _ClassInfo]] = []
+        mod_cls = _ClassInfo("<module>", self.root, self.mod, self.file)
+        self._collect_into(self.tree.body, mod_cls, top=True)
+        self.prog.classes.append(mod_cls)
+        for c in self.prog.classes:
+            if c.file == self.file:
+                c.acked_lines = set(self.ann)
+
+    def _collect_into(self, body, mod_cls: _ClassInfo, top: bool,
+                      prefix: str = ""):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._collect_class(node, prefix)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mod_cls.methods.add(node.name)
+                # classes nested in functions (serve_llm's handler)
+                self._collect_into(node.body, mod_cls, top=False,
+                                   prefix=prefix)
+            elif top and isinstance(node, ast.Assign) and \
+                    len(node.targets) == 1 and \
+                    isinstance(node.targets[0], ast.Name):
+                kind = _lock_ctor_kind(node.value)
+                if kind:
+                    name = node.targets[0].id
+                    self.prog.module_locks[name] = _LockDef(
+                        self.mod, name, kind, self.file, node.lineno)
+
+    def _collect_class(self, node: ast.ClassDef, prefix: str):
+        qname = f"{prefix}{node.name}"
+        info = _ClassInfo(qname, self.root, self.mod, self.file)
+        for st in node.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods.add(st.name)
+            elif isinstance(st, ast.Assign):
+                # class-level lock (flight.FlightRecorder._seq_lock)
+                kind = _lock_ctor_kind(st.value)
+                if kind:
+                    for t in st.targets:
+                        if isinstance(t, ast.Name):
+                            info.locks[t.id] = _LockDef(
+                                qname, t.id, kind, self.file, st.lineno)
+        def _assigned_attr(sub):
+            if isinstance(sub, ast.Assign) and sub.targets:
+                return _self_attr(sub.targets[0]), sub.value
+            if isinstance(sub, ast.AnnAssign):
+                return _self_attr(sub.target), sub.value
+            return None, None
+
+        # instance locks + field annotations from every method (locks are
+        # created in __init__ in practice, but attach_engine-style late
+        # binds exist)
+        for st in ast.walk(node):
+            attr, value = _assigned_attr(st)
+            if attr is None:
+                continue
+            kind = _lock_ctor_kind(value)
+            if kind:
+                info.locks.setdefault(attr, _LockDef(
+                    qname, attr, kind, self.file, st.lineno))
+        # field-level annotations: only on __init__ assignment lines
+        for st in node.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and st.name == "__init__":
+                for sub in ast.walk(st):
+                    attr, _value = _assigned_attr(sub)
+                    spec = self._ann_at(sub.lineno) if attr else None
+                    if attr and spec:
+                        info.field_ann[attr] = spec
+        self.prog.classes.append(info)
+        self._klass_nodes.append((node, info))
+        # nested classes
+        for st in node.body:
+            if isinstance(st, ast.ClassDef):
+                self._collect_class(st, prefix=f"{qname}.")
+
+    # -- pass 2: walk method bodies ---------------------------------------
+    def walk(self):
+        for node, info in self._klass_nodes:
+            defs = {st.name: st for st in node.body
+                    if isinstance(st, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef))}
+            for name, st in defs.items():
+                if self._ann_at(st.lineno):
+                    info.def_acked.add(name)
+            # lock propagation through the intra-class call graph: a
+            # private helper only ever called with L held effectively
+            # runs under L — re-walk it with that baseline until the
+            # baselines stabilize (put -> _enforce_capacity chains)
+            baselines = {name: () for name in defs}
+            for _round in range(4):
+                for name in defs:
+                    info.clear_method(name)
+                for name, st in defs.items():
+                    _MethodWalker(self, info, name).run(
+                        st.body, baselines[name])
+                new = self._baselines(info, defs, baselines)
+                if new == baselines:
+                    break
+                baselines = new
+        # module-level functions as methods of the pseudo-class
+        mod_cls = next(c for c in self.prog.classes
+                       if c.file == self.file and c.is_module)
+        for st in self.tree.body:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if self._ann_at(st.lineno):
+                    mod_cls.def_acked.add(st.name)
+                _MethodWalker(self, mod_cls, st.name).run(st.body, ())
+
+    def _baselines(self, info: _ClassInfo, defs, prev) -> dict:
+        """Entry-held baseline per method: the intersection of held sets
+        across every intra-class call site — private methods only, and
+        never thread entry points (they start with nothing held)."""
+        entries = {tu.target.split(".")[-1] for tu in info.threads}
+        sites: Dict[str, List[Tuple[str, ...]]] = {}
+        for cs in info.calls:
+            if cs.on_self and cs.name in defs:
+                sites.setdefault(cs.name, []).append(cs.held)
+        out = {}
+        for name in defs:
+            base = ()
+            if name.startswith("_") and not name.startswith("__") \
+                    and name not in entries and sites.get(name):
+                common = None
+                for held in sites[name]:
+                    s = set(held)
+                    common = s if common is None else (common & s)
+                base = tuple(sorted(common or ()))
+            out[name] = base
+        return out
+
+
+class _MethodWalker:
+    """Walks one method body tracking the held-lock set through
+    `with self._lock:` regions."""
+
+    def __init__(self, fw: _FileWalker, info: _ClassInfo, method: str):
+        self.fw = fw
+        self.info = info
+        self.method = method
+        self.closure = 0
+        self.daemon_sets: Set[str] = set()   # "<expr>.daemon = True"
+
+    def run(self, body, baseline: Tuple[str, ...] = ()):
+        self._stmts(body, baseline)
+        # flush daemon post-assignments onto thread uses of this method
+        for tu in self.info.threads:
+            if tu.method == self.method and not tu.daemon and tu.assigned \
+                    and tu.assigned in self.daemon_sets:
+                tu.daemon = True
+
+    # -- statements --------------------------------------------------------
+    def _stmts(self, body, held):
+        for st in body:
+            self._stmt(st, held)
+
+    def _stmt(self, st, held):
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            new = held
+            for item in st.items:
+                lock = self._lock_of(item.context_expr)
+                if lock is not None:
+                    self._acquire(lock, item.context_expr.lineno, new)
+                    new = new + (lock,)
+                else:
+                    self._expr(item.context_expr, held)
+                if item.optional_vars is not None:
+                    self._expr(item.optional_vars, held)
+            self._stmts(st.body, new)
+            return
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def: runs later, not under these locks
+            self.closure += 1
+            self._stmts(st.body, ())
+            self.closure -= 1
+            return
+        if isinstance(st, ast.ClassDef):
+            return      # handled at collection
+        if isinstance(st, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assign(st, held)
+            return
+        if isinstance(st, ast.Delete):
+            for t in st.targets:
+                self._write_target(t, st.lineno, held)
+            return
+        if isinstance(st, ast.For):
+            self._expr(st.iter, held, iterated=True)
+            self._expr(st.target, held)
+            self._stmts(st.body, held)
+            self._stmts(st.orelse, held)
+            return
+        if isinstance(st, ast.Try):
+            self._stmts(st.body, held)
+            for h in st.handlers:
+                self._stmts(h.body, held)
+            self._stmts(st.orelse, held)
+            self._stmts(st.finalbody, held)
+            return
+        # generic: walk child statements/exprs with the same held set
+        for _f, value in ast.iter_fields(st):
+            if isinstance(value, list):
+                for v in value:
+                    if isinstance(v, ast.stmt):
+                        self._stmt(v, held)
+                    elif isinstance(v, ast.expr):
+                        self._expr(v, held)
+            elif isinstance(value, ast.expr):
+                self._expr(value, held)
+
+    def _assign(self, st, held):
+        value = getattr(st, "value", None)
+        targets = st.targets if isinstance(st, ast.Assign) else [st.target]
+        assigned = ""
+        if isinstance(st, ast.Assign) and len(targets) == 1:
+            try:
+                assigned = ast.unparse(targets[0])
+            except Exception:   # noqa: BLE001
+                assigned = ""
+        # `X.daemon = True` marks a thread daemon post-hoc
+        if isinstance(targets[0], ast.Attribute) and \
+                targets[0].attr == "daemon" and \
+                isinstance(value, ast.Constant) and value.value is True:
+            try:
+                self.daemon_sets.add(ast.unparse(targets[0].value))
+            except Exception:   # noqa: BLE001
+                pass
+        for t in targets:
+            self._write_target(t, st.lineno, held)
+        if isinstance(st, ast.AugAssign):
+            # += reads then writes
+            self._expr(st.target, held)
+        if value is not None:
+            self._expr(value, held, assigned_to=assigned)
+
+    def _write_target(self, t, line, held):
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._write_target(e, line, held)
+            return
+        if isinstance(t, ast.Starred):
+            self._write_target(t.value, line, held)
+            return
+        attr = _self_attr(t)
+        if attr is not None:
+            self._record_write(attr, line, held)
+            return
+        if isinstance(t, ast.Subscript):
+            base = _self_attr(t.value)
+            if base is not None:
+                self._record_write(base, line, held)
+            else:
+                self._expr(t.value, held)
+            self._expr(t.slice, held)
+            return
+        if isinstance(t, ast.Attribute):
+            self._expr(t.value, held)
+
+    # -- expressions -------------------------------------------------------
+    def _expr(self, e, held, iterated=False, assigned_to=""):
+        if e is None:
+            return
+        if isinstance(e, ast.Lambda):
+            self.closure += 1
+            self._expr(e.body, ())
+            self.closure -= 1
+            return
+        if isinstance(e, ast.Call):
+            self._call(e, held, assigned_to=assigned_to)
+            return
+        attr = _self_attr(e)
+        if attr is not None and isinstance(e.ctx, ast.Load):
+            self._record_read(attr, e.lineno, held, iterated)
+            return
+        for child in ast.iter_child_nodes(e):
+            if isinstance(child, ast.comprehension):
+                self._expr(child.iter, held, iterated=True)
+                self._expr(child.target, held)
+                for cond in child.ifs:
+                    self._expr(cond, held)
+            elif isinstance(child, ast.keyword):
+                self._expr(child.value, held)
+            elif isinstance(child, ast.expr):
+                self._expr(child, held, iterated=iterated)
+
+    def _call(self, call: ast.Call, held, assigned_to=""):
+        f = call.func
+        if _is_thread_ctor(call):
+            self._thread_ctor(call, assigned_to)
+        elif _lock_ctor_kind(call) is None:
+            self._check_blocking(call, held)
+            self._record_callsite(call, held)
+        # receiver + args are reads — except a mutating container call
+        # (`self._pending.append(x)`), which is a WRITE to the field
+        if isinstance(f, ast.Attribute):
+            base = _self_attr(f.value)
+            if base is not None and f.attr in _MUTATORS:
+                self._record_write(base, call.lineno, held)
+            elif isinstance(f.value, ast.Name) and f.value.id == "self":
+                if f.attr not in self.info.methods:
+                    # callable field (self._ragged(...)) — a read of it
+                    self._record_read(f.attr, call.lineno, held, False)
+            else:
+                self._expr(f.value, held)
+        elif not isinstance(f, ast.Name):
+            self._expr(f, held)
+        for a in call.args:
+            self._expr(a, held)
+        for kw in call.keywords:
+            self._expr(kw.value, held)
+
+    def _thread_ctor(self, call: ast.Call, assigned_to: str):
+        target, daemon = "?", False
+        for kw in call.keywords:
+            if kw.arg == "target":
+                try:
+                    target = ast.unparse(kw.value)
+                except Exception:   # noqa: BLE001
+                    target = "?"
+            elif kw.arg == "daemon" and \
+                    isinstance(kw.value, ast.Constant) and \
+                    kw.value.value is True:
+                daemon = True
+        self.info.threads.append(_ThreadUse(
+            target, call.lineno, daemon, assigned_to, self.method,
+            self.fw.file))
+
+    def _check_blocking(self, call: ast.Call, held):
+        if not held:
+            return
+        f = call.func
+        what = None
+        if isinstance(f, ast.Attribute):
+            recv = f.value
+            if f.attr == "sleep" and isinstance(recv, ast.Name) and \
+                    recv.id == "time":
+                what = "time.sleep"
+            elif f.attr in ("wait", "wait_for"):
+                # Condition.wait on a HELD lock releases it — exempt;
+                # everything else (Event.wait, handle.result-ish waits)
+                # blocks while we hold our locks
+                lock = self._lock_of(recv)
+                if lock is None or lock not in held:
+                    try:
+                        what = f"{ast.unparse(recv)}.{f.attr}"
+                    except Exception:   # noqa: BLE001
+                        what = f".{f.attr}"
+            elif f.attr == "join":
+                try:
+                    rtxt = ast.unparse(recv)
+                except Exception:   # noqa: BLE001
+                    rtxt = ""
+                if "thread" in rtxt.lower():
+                    what = f"{rtxt}.join"
+            elif f.attr in _BLOCKING_ATTRS:
+                what = f".{f.attr}"
+            elif f.attr in _DISPATCH_ATTRS:
+                what = f"device dispatch .{f.attr}"
+        elif isinstance(f, ast.Name) and f.id in ("sleep", "urlopen"):
+            what = f.id
+        if what:
+            acked = self.fw._ann_at(call.lineno) is not None
+            self.info.blocking.append(_Blocking(
+                what, call.lineno, held, self.method, acked))
+
+    def _record_callsite(self, call: ast.Call, held):
+        f = call.func
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self":
+                self.info.calls.append(_CallSite(
+                    f.attr, held, call.lineno, self.method, True))
+            elif held and isinstance(f.value, ast.Name) and \
+                    f.attr not in _GENERIC_METHOD_NAMES and \
+                    not f.attr.startswith("__"):
+                # `eng.submit(...)` under a held lock: resolved later by
+                # method-name uniqueness across the analyzed classes
+                self.info.calls.append(_CallSite(
+                    f.attr, held, call.lineno, self.method, False))
+        # module-level functions call each other by bare name
+        elif isinstance(f, ast.Name) and self.info.is_module and \
+                f.id in self.info.methods:
+            self.info.calls.append(_CallSite(
+                f.id, held, call.lineno, self.method, True))
+
+    # -- fact recording ----------------------------------------------------
+    def _record_write(self, field, line, held):
+        if field in self.info.locks:
+            return
+        acked = self.fw._ann_at(line) is not None
+        self.info.writes.append(_Write(
+            field, line, tuple(held), self.method, acked))
+
+    def _record_read(self, field, line, held, iterated):
+        if not field or field in self.info.locks or \
+                field in self.info.methods:
+            return
+        self.info.reads.append(_Read(
+            field, line, tuple(held), self.method, iterated,
+            self.closure > 0))
+
+    def _acquire(self, lock, line, held):
+        self.info.acquires.append(_Acquire(
+            lock, line, tuple(held), self.method))
+
+    def _lock_of(self, expr) -> Optional[str]:
+        """Lock-graph node id for an acquisition expression, or None.
+        Unresolvable non-self receivers get a "?"-prefixed id: still
+        HELD (so blocking calls under them fire) but excluded from the
+        cycle graph."""
+        attr = _self_attr(expr)
+        if attr is not None:
+            ld = self.info.locks.get(attr)
+            if ld is not None and ld.kind in _HOLDABLE:
+                return ld.node
+            return None
+        if isinstance(expr, ast.Name):
+            ld = self.fw.prog.module_locks.get(expr.id)
+            if ld is not None and ld.kind in _HOLDABLE:
+                return f"{self.fw.mod}.{expr.id}"
+            return None
+        if isinstance(expr, ast.Attribute):
+            # ClassName._seq_lock, or other.attr resolved by uniqueness
+            if isinstance(expr.value, ast.Name):
+                for c in self.fw.prog.classes:
+                    if c.name == expr.value.id and expr.attr in c.locks \
+                            and c.locks[expr.attr].kind in _HOLDABLE:
+                        return c.locks[expr.attr].node
+            owners = [c for c in self.fw.prog.classes
+                      if expr.attr in c.locks
+                      and c.locks[expr.attr].kind in _HOLDABLE]
+            if len(owners) == 1:
+                return owners[0].locks[expr.attr].node
+            if owners:
+                return f"?.{expr.attr}"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# program-level rules
+# ---------------------------------------------------------------------------
+
+def _reach(info: _ClassInfo, start: str) -> Set[str]:
+    """Methods reachable from `start` through self-calls (the owner
+    thread's intra-class footprint)."""
+    out, frontier = {start}, [start]
+    callmap: Dict[str, Set[str]] = {}
+    for cs in info.calls:
+        if cs.on_self:
+            callmap.setdefault(cs.method, set()).add(cs.name)
+    while frontier:
+        m = frontier.pop()
+        for n in callmap.get(m, ()):
+            if n not in out and n in info.methods:
+                out.add(n)
+                frontier.append(n)
+    return out
+
+
+def _init_only(info: _ClassInfo) -> Set[str]:
+    """Private methods reachable ONLY from __init__ (construction-time
+    helpers like a spill-dir reindex): their writes are init writes."""
+    entries = {tu.target.split(".")[-1] for tu in info.threads}
+    callers: Dict[str, Set[str]] = {}
+    for cs in info.calls:
+        if cs.on_self:
+            callers.setdefault(cs.name, set()).add(cs.method)
+    out: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for name, frm in callers.items():
+            if name in out or name in entries or \
+                    not name.startswith("_") or name.startswith("__"):
+                continue
+            if all(m == "__init__" or m in out for m in frm):
+                out.add(name)
+                changed = True
+    return out
+
+
+def _check_writes(info: _ClassInfo) -> List[Finding]:
+    findings = []
+    init_m = _init_only(info) | {"__init__"}
+    by_field: Dict[str, List[_Write]] = {}
+    for w in info.writes:
+        if w.method in init_m:
+            continue
+        by_field.setdefault(w.field, []).append(w)
+    for field, writes in sorted(by_field.items()):
+        ann = info.field_ann.get(field, "")
+        if ann == "atomic":
+            continue
+        path = f"{info.root}.{info.name}.{field}"
+        if ann.startswith("owned="):
+            owner = ann.split("=", 1)[1]
+            ok = _reach(info, owner)
+            bad = [w for w in writes
+                   if w.method not in ok and not w.acked]
+            if bad:
+                sites = ", ".join(f"{w.method}:{w.line}" for w in bad[:4])
+                findings.append(Finding(
+                    Severity.WARNING, "RACE_UNGUARDED_WRITE", path,
+                    f"annotation `owned={owner}` violated: `self.{field}` "
+                    f"is written outside the owner's call graph at "
+                    f"{sites} ({os.path.basename(info.file)})",
+                    "move the write onto the owning thread, or "
+                    "acknowledge the site with a line-level "
+                    "`# threadlint:` annotation explaining why it is "
+                    "safe", CHECKER,
+                    {"file": info.file, "field": field,
+                     "owner": owner,
+                     "lines": [w.line for w in bad]}))
+            continue
+        live = [w for w in writes if not w.acked]
+        locked = [w for w in live if any(not h.startswith("?")
+                                         for h in w.locks)]
+        unlocked = [w for w in live if not w.locks]
+        if locked and unlocked:
+            lock = sorted({h for w in locked for h in w.locks})[0]
+            findings.append(Finding(
+                Severity.WARNING, "RACE_UNGUARDED_WRITE", path,
+                f"`self.{field}` is written under {lock} at "
+                f"{locked[0].method}:{locked[0].line} but also with no "
+                f"lock held at "
+                + ", ".join(f"{w.method}:{w.line}" for w in unlocked[:4])
+                + f" ({os.path.basename(info.file)})",
+                f"take {lock} around every write, or annotate the "
+                "field `# threadlint: owned=<method>|atomic` if the "
+                "discipline is intentional", CHECKER,
+                {"file": info.file, "field": field, "lock": lock,
+                 "locked_lines": [w.line for w in locked],
+                 "unlocked_lines": [w.line for w in unlocked]}))
+    return findings
+
+
+def _protected_fields(info: _ClassInfo) -> Dict[str, str]:
+    """field -> lock node, for fields whose every live non-init write
+    holds that lock (the inferred protection map)."""
+    init_m = _init_only(info) | {"__init__"}
+    by_field: Dict[str, List[_Write]] = {}
+    for w in info.writes:
+        if w.method in init_m or w.acked:
+            continue
+        by_field.setdefault(w.field, []).append(w)
+    out = {}
+    for field, writes in by_field.items():
+        if info.field_ann.get(field):
+            continue
+        common = None
+        for w in writes:
+            locks = {h for h in w.locks if not h.startswith("?")}
+            common = locks if common is None else (common & locks)
+            if not common:
+                break
+        if common:
+            out[field] = sorted(common)[0]
+    return out
+
+
+def _check_reads(info: _ClassInfo) -> List[Finding]:
+    findings = []
+    prot = _protected_fields(info)
+    if not prot:
+        return findings
+    # group unprotected reads per method
+    init_m = _init_only(info) | {"__init__"}
+    per_method: Dict[str, List[_Read]] = {}
+    for r in info.reads:
+        if r.method in init_m:
+            continue
+        lock = prot.get(r.field)
+        if lock is None or lock in r.locks:
+            continue
+        per_method.setdefault(r.method, []).append(r)
+    for method, reads in sorted(per_method.items()):
+        if method in info.def_acked:
+            continue
+        live = [r for r in reads if r.line not in info.acked_lines and
+                (r.line - 1) not in info.acked_lines]
+        if not live:
+            continue
+        fields = sorted({r.field for r in live})
+        iters = [r for r in live if r.iterated]
+        path = f"{info.root}.{info.name}.{method}"
+        if len(fields) >= 2:
+            lock = prot[fields[0]]
+            findings.append(Finding(
+                Severity.WARNING, "RACE_UNGUARDED_READ", path,
+                f"reads {len(fields)} {lock}-protected fields "
+                f"({', '.join('self.' + f for f in fields[:5])}) without "
+                f"holding it — a writer between the reads tears the "
+                f"multi-word view (the PR 11 identity-tear shape) "
+                f"({os.path.basename(info.file)}:{live[0].line})",
+                f"snapshot the fields under one `with {lock.split('.')[-1]}:` "
+                "block, or annotate the method "
+                "`# threadlint: atomic` with why torn reads are "
+                "acceptable", CHECKER,
+                {"file": info.file, "fields": fields,
+                 "lines": sorted({r.line for r in live})}))
+        elif iters:
+            r = iters[0]
+            lock = prot[r.field]
+            findings.append(Finding(
+                Severity.WARNING, "RACE_UNGUARDED_READ", path,
+                f"iterates `self.{r.field}` ({lock}-protected) without "
+                f"holding the lock — a concurrent writer mutates the "
+                f"container mid-iteration "
+                f"({os.path.basename(info.file)}:{r.line})",
+                f"copy it under the lock first "
+                f"(`with {lock.split('.')[-1]}: snap = list(...)`)",
+                CHECKER,
+                {"file": info.file, "field": r.field, "line": r.line}))
+    return findings
+
+
+def _check_blocking(info: _ClassInfo) -> List[Finding]:
+    findings = []
+    for b in info.blocking:
+        if b.acked or b.method in info.def_acked:
+            continue
+        path = f"{info.root}.{info.name}.{b.method}"
+        held = ", ".join(h for h in b.held)
+        findings.append(Finding(
+            Severity.WARNING, "LOCK_BLOCKING_CALL", path,
+            f"calls {b.what} while holding {held} — every thread "
+            f"contending for the lock stalls behind the blocking call "
+            f"({os.path.basename(info.file)}:{b.line})",
+            "move the blocking call outside the locked region "
+            "(snapshot state under the lock, block after), or "
+            "acknowledge with `# threadlint:` and a reason", CHECKER,
+            {"file": info.file, "line": b.line, "held": list(b.held),
+             "call": b.what}))
+    return findings
+
+
+def _check_threads(prog: _Program) -> List[Finding]:
+    findings = []
+    for info in prog.classes:
+        for tu in info.threads:
+            if tu.daemon:
+                continue
+            joined = tu.assigned and (
+                tu.assigned in prog.joins
+                or tu.assigned.rsplit(".", 1)[-1] in prog.join_attrs)
+            if joined:
+                continue
+            path = f"{info.root}.{info.name}.{tu.method}"
+            findings.append(Finding(
+                Severity.WARNING, "THREAD_LEAK", path,
+                f"non-daemon Thread(target={tu.target}) started at "
+                f"{os.path.basename(tu.file)}:{tu.line} with no join "
+                f"path — it outlives shutdown() and wedges interpreter "
+                f"exit",
+                "join it on shutdown, or mark it daemon=True if it "
+                "holds no state that must flush", CHECKER,
+                {"file": tu.file, "line": tu.line, "target": tu.target,
+                 "assigned": tu.assigned}))
+    return findings
+
+
+def _lock_graph(prog: _Program):
+    """edges: (a, b) -> example site string, from syntactic nesting plus
+    call-graph propagation (a held while b is acquired)."""
+    # direct + effective acquisitions per (class, method)
+    direct: Dict[Tuple[str, str], Set[str]] = {}
+    calls: Dict[Tuple[str, str], List[_CallSite]] = {}
+    keyed: Dict[Tuple[str, str], _ClassInfo] = {}
+    for info in prog.classes:
+        for a in info.acquires:
+            if not a.lock.startswith("?"):
+                direct.setdefault((info.name, a.method), set()).add(a.lock)
+        for cs in info.calls:
+            calls.setdefault((info.name, cs.method), []).append(cs)
+        for m in info.methods:
+            keyed[(info.name, m)] = info
+    def resolve(info: _ClassInfo, cs: _CallSite):
+        if cs.on_self and cs.name in info.methods and not info.is_module:
+            return (info.name, cs.name)
+        owners = prog.method_owners(cs.name)
+        if len(owners) == 1:
+            return (owners[0].name, cs.name)
+        return None
+    eff = {k: set(v) for k, v in direct.items()}
+    for _ in range(20):
+        changed = False
+        for k, sites in calls.items():
+            info = keyed.get(k)
+            if info is None:
+                continue
+            acc = eff.setdefault(k, set())
+            before = len(acc)
+            for cs in sites:
+                tgt = resolve(info, cs)
+                if tgt and tgt in eff:
+                    acc |= eff[tgt]
+            if len(acc) != before:
+                changed = True
+        if not changed:
+            break
+    edges: Dict[Tuple[str, str], str] = {}
+    kind_of = {}
+    for info in prog.classes:
+        for ld in info.locks.values():
+            kind_of[ld.node] = ld.kind
+    for ld in prog.module_locks.values():
+        kind_of[ld.node] = ld.kind
+    def add(a, b, site):
+        if a.startswith("?") or b.startswith("?"):
+            return
+        if a == b and kind_of.get(a) in ("rlock", "condition"):
+            return      # legal reentrancy
+        edges.setdefault((a, b), site)
+    for info in prog.classes:
+        for a in info.acquires:
+            site = f"{info.name}.{a.method} " \
+                   f"({os.path.basename(info.file)}:{a.line})"
+            for h in a.held:
+                add(h, a.lock, site)
+    for k, sites in calls.items():
+        info = keyed.get(k)
+        if info is None:
+            continue
+        for cs in sites:
+            if not cs.held:
+                continue
+            tgt = resolve(info, cs)
+            if not tgt:
+                continue
+            for acq in eff.get(tgt, ()):
+                site = f"{info.name}.{cs.method} -> {tgt[0]}.{tgt[1]} " \
+                       f"({os.path.basename(info.file)}:{cs.line})"
+                for h in cs.held:
+                    add(h, acq, site)
+    return edges
+
+
+def _check_cycles(prog: _Program) -> List[Finding]:
+    edges = _lock_graph(prog)
+    adj: Dict[str, List[str]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, []).append(b)
+    # Tarjan SCC
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    onstack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v):
+        work = [(v, iter(adj.get(v, ())))]
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        onstack.add(v)
+        while work:
+            node, it = work[-1]
+            advanced = False
+            for w in it:
+                if w not in index:
+                    index[w] = low[w] = counter[0]
+                    counter[0] += 1
+                    stack.append(w)
+                    onstack.add(w)
+                    work.append((w, iter(adj.get(w, ()))))
+                    advanced = True
+                    break
+                elif w in onstack:
+                    low[node] = min(low[node], index[w])
+            if advanced:
+                continue
+            work.pop()
+            if work:
+                low[work[-1][0]] = min(low[work[-1][0]], low[node])
+            if low[node] == index[node]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    onstack.discard(w)
+                    scc.append(w)
+                    if w == node:
+                        break
+                sccs.append(scc)
+
+    nodes = set(adj) | {b for bs in adj.values() for b in bs}
+    for v in sorted(nodes):
+        if v not in index:
+            strongconnect(v)
+
+    findings = []
+    for scc in sccs:
+        cyclic = len(scc) > 1 or (scc[0], scc[0]) in edges
+        if not cyclic:
+            continue
+        scc = sorted(scc)
+        examples = [f"{a} -> {b} via {site}"
+                    for (a, b), site in sorted(edges.items())
+                    if a in scc and b in scc]
+        findings.append(Finding(
+            Severity.WARNING, "LOCK_ORDER_CYCLE",
+            " -> ".join(scc + [scc[0]]),
+            f"lock-acquisition cycle: {'; '.join(examples[:4])} — two "
+            f"threads taking these locks in opposite orders deadlock",
+            "pick one canonical order (document it in ARCHITECTURE's "
+            "threading model) and release the first lock before taking "
+            "the second on the reversed path", CHECKER,
+            {"locks": scc, "edges": examples}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------------
+
+def _package_files(modname: str) -> List[Tuple[str, str]]:
+    """(module, file) for a module or package (non-recursive)."""
+    spec = importlib.util.find_spec(modname)
+    if spec is None:
+        raise ImportError(f"cannot locate module {modname!r}")
+    if spec.submodule_search_locations:
+        out = []
+        for d in spec.submodule_search_locations:
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".py"):
+                    sub = fn[:-3]
+                    sub = modname if sub == "__init__" \
+                        else f"{modname}.{sub}"
+                    out.append((sub, os.path.join(d, fn)))
+        return out
+    if not spec.origin or not spec.origin.endswith(".py"):
+        raise ImportError(f"{modname!r} has no python source to lint")
+    return [(modname, spec.origin)]
+
+
+def _scan_sources(sources, prog: Optional[_Program] = None) -> _Program:
+    """sources: iterable of (root, mod, file, src)."""
+    prog = prog or _Program()
+    walkers = []
+    for root, mod, file, src in sources:
+        tree = ast.parse(src, filename=file)
+        fw = _FileWalker(prog, tree, src, root, mod, file)
+        fw.collect()
+        walkers.append(fw)
+        # module-wide join evidence (for THREAD_LEAK)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "join":
+                try:
+                    recv = ast.unparse(node.func.value)
+                except Exception:   # noqa: BLE001
+                    continue
+                prog.joins.add(recv)
+                prog.join_attrs.add(recv.rsplit(".", 1)[-1])
+    for fw in walkers:
+        fw.walk()
+    return prog
+
+
+def scan_modules(modules: Sequence[str] = DEFAULT_MODULES) -> _Program:
+    sources = []
+    for root in modules:
+        for mod, file in _package_files(root):
+            with open(file) as f:
+                sources.append((root, mod, file, f.read()))
+    return _scan_sources(sources)
+
+
+def _program_findings(prog: _Program) -> List[Finding]:
+    findings: List[Finding] = []
+    for info in prog.classes:
+        findings.extend(_check_writes(info))
+        findings.extend(_check_reads(info))
+        findings.extend(_check_blocking(info))
+    findings.extend(_check_threads(prog))
+    findings.extend(_check_cycles(prog))
+    return findings
+
+
+def _to_reports(prog: _Program, roots: Sequence[str], suppress=(),
+                config=None, options=None) -> Dict[str, Report]:
+    findings = _program_findings(prog)
+    ctx = CheckContext(closed_jaxpr=None, options=dict(options or {}))
+    by_root: Dict[str, List[Finding]] = {r: [] for r in roots}
+    root_of_class = {}
+    for info in prog.classes:
+        root_of_class[info.name] = info.root
+    for f in findings:
+        root = None
+        for r in roots:
+            if f.eqn_path.startswith(r + "."):
+                root = r
+                break
+        if root is None and f.code == "LOCK_ORDER_CYCLE":
+            # cycles span modules: file them under the first lock's class
+            first = f.data.get("locks", [""])[0].split(".")[0]
+            root = root_of_class.get(first, roots[0])
+        by_root.setdefault(root or roots[0], []).append(f)
+    return {r: finalize_findings(fs, [CHECKER], ctx, suppress, config)
+            for r, fs in by_root.items()}
+
+
+def analyze_modules(modules: Sequence[str] = DEFAULT_MODULES,
+                    suppress: Sequence[str] = (), config=None,
+                    options=None) -> Dict[str, Report]:
+    """Lint modules/packages; one Report per requested root.  Classes
+    across all roots are resolved TOGETHER (cross-module lock-order
+    edges, e.g. router lock vs engine lock)."""
+    prog = scan_modules(tuple(modules))
+    return _to_reports(prog, tuple(modules), suppress, config, options)
+
+
+def analyze_source(src: str, modname: str = "<memory>",
+                   suppress: Sequence[str] = (), config=None,
+                   options=None) -> Report:
+    """Lint one source string (fixtures/tests)."""
+    prog = _scan_sources([(modname, modname, f"<{modname}>", src)])
+    return _to_reports(prog, (modname,), suppress, config, options)[modname]
+
+
+def inventory(modules: Sequence[str] = DEFAULT_MODULES) -> dict:
+    """Thread/lock inventory for docs and `graphlint --threads -v`."""
+    prog = scan_modules(tuple(modules))
+    locks, threads = [], []
+    for info in prog.classes:
+        for ld in sorted(info.locks.values(), key=lambda x: x.attr):
+            locks.append({"lock": ld.node, "kind": ld.kind,
+                          "module": info.mod,
+                          "file": os.path.basename(ld.file),
+                          "line": ld.line})
+        for tu in info.threads:
+            threads.append({"where": f"{info.mod}.{info.name}."
+                                     f"{tu.method}",
+                            "target": tu.target, "daemon": tu.daemon,
+                            "stored_as": tu.assigned,
+                            "file": os.path.basename(tu.file),
+                            "line": tu.line})
+    for _name, ld in sorted(prog.module_locks.items()):
+        locks.append({"lock": ld.node, "kind": ld.kind,
+                      "module": ld.owner,
+                      "file": os.path.basename(ld.file),
+                      "line": ld.line})
+    edges = _lock_graph(prog)
+    return {"locks": locks, "threads": threads,
+            "lock_order_edges": sorted(f"{a} -> {b}"
+                                       for (a, b) in edges)}

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import random
+import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -34,7 +35,8 @@ from . import llm_engine as _llm
 
 __all__ = ["FAULT_POINTS", "FLEET_FAULT_POINTS", "InjectedFault",
            "InjectedCrash", "InvariantViolation", "FaultRule",
-           "FaultInjector", "random_schedule", "drive", "check_invariants",
+           "FaultInjector", "LockWitness", "arm_witness",
+           "random_schedule", "drive", "check_invariants",
            "check_telemetry", "run_schedule", "ScriptedEngine",
            "EchoDrafter", "fleet_random_schedule", "drive_fleet",
            "fleet_check_invariants", "fleet_run_schedule"]
@@ -191,8 +193,13 @@ class FaultInjector:
         self.rules = list(rules)
         self.visits: collections.Counter = collections.Counter()
         self.fired: List[dict] = []
+        # armed by the chaos soaks: a LockWitness records the firing
+        # thread's held witnessed locks at every dispatch-class point
+        self.witness = None
 
     def fire(self, point: str, engine=None, pools=None, **ctx) -> None:
+        if self.witness is not None and point in _DISPATCH_POINTS:
+            self.witness.check_dispatch(point)
         self.visits[point] += 1
         for rule in self.rules:
             if not rule.matches(point, ctx):
@@ -294,6 +301,252 @@ def check_telemetry(engine) -> List[str]:
                 f"truth is {truth} (leak detection via gauges would "
                 "lie)")
     return mismatches
+
+
+# -- dynamic lock-order witness --------------------------------------------
+#
+# analysis.threadlint PREDICTS the serving stack's lock discipline from
+# the ASTs; the witness CONFIRMS it at runtime — the same static-
+# predicts/dynamic-confirms contract analysis.equiv gives the rewrite
+# tier.  The soaks arm it (run_schedule/fleet_run_schedule witness=True,
+# the tools/chaos_* default) and fail on any witnessed violation.
+
+class _WitnessedLock:
+    """Delegating wrapper around a Lock/RLock/Condition that reports
+    every acquire/release to its `LockWitness`.  The full Condition
+    surface is forwarded; `wait`/`wait_for` pop the held stack for the
+    duration (the condition releases its lock inside) and re-check the
+    re-acquire as a fresh ordering event.  A `with` statement binds the
+    wrapper object itself, so swapping an attribute mid-run can never
+    orphan an acquired inner lock."""
+
+    __slots__ = ("_w", "_inner", "_name")
+
+    def __init__(self, witness: "LockWitness", inner, name: str):
+        self._w = witness
+        self._inner = inner
+        self._name = name
+
+    def acquire(self, *args, **kwargs):
+        # order is noted BEFORE blocking: an acquisition that would
+        # deadlock still records the inversion that caused it
+        self._w.note_order(self._name)
+        got = self._inner.acquire(*args, **kwargs)
+        if got:
+            self._w.push(self._name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        self._w.pop(self._name)
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    # Condition surface
+    def wait(self, timeout=None):
+        self._w.pop(self._name)
+        try:
+            return self._inner.wait(timeout)
+        finally:
+            self._w.note_order(self._name)
+            self._w.push(self._name)
+
+    def wait_for(self, predicate, timeout=None):
+        self._w.pop(self._name)
+        try:
+            return self._inner.wait_for(predicate, timeout)
+        finally:
+            self._w.note_order(self._name)
+            self._w.push(self._name)
+
+    def notify(self, n: int = 1):
+        return self._inner.notify(n)
+
+    def notify_all(self):
+        return self._inner.notify_all()
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+    def __repr__(self):
+        return f"<witnessed {self._name}: {self._inner!r}>"
+
+
+class LockWitness:
+    """Per-thread lock-acquisition-order recorder over the serving
+    stack's witnessed locks.  One global edge graph (A -> B: some thread
+    acquired B while holding A); two violation shapes:
+
+      * ORDER INVERSION — a new edge closes a cycle in the graph; the
+        violation names the full cycle (`A -> B -> A`), which is exactly
+        the deadlock schedule two threads can now interleave into.
+        Re-entrant re-acquisition (RLock, Condition re-acquire after
+        wait) is not an ordering event and never self-edges.
+      * LOCK HELD ACROSS A FENCED DISPATCH — the thread firing a
+        dispatch-class injection point (`_DISPATCH_POINTS`) holds a
+        witnessed lock: a device dispatch under a Python lock serializes
+        every other thread behind device latency.
+
+    Violations are deduplicated (one per new edge / per held-set+point),
+    so a soak's report stays readable; `check_invariants` folds them
+    into the soak verdict."""
+
+    def __init__(self):
+        self._mu = threading.Lock()          # guards graph + violations
+        self._tls = threading.local()        # per-thread held stack
+        self._edges: Dict[str, set] = {}
+        self._dispatch_seen: set = set()
+        self.acquisitions = 0
+        self.violations: List[str] = []
+        self._names: set = set()
+        self._wrapped: List[Tuple[object, str, object]] = []
+
+    # -- arming -------------------------------------------------------------
+
+    def wrap(self, owner, attr: str, name: str) -> _WitnessedLock:
+        """Replace `owner.attr` with a witnessed wrapper named `name`
+        (idempotent).  `name` uses the static tier's lock ids
+        ("LLMEngine._cv", "Router._lock"), so a witnessed cycle names
+        the same nodes a threadlint LOCK_ORDER_CYCLE would."""
+        inner = getattr(owner, attr)
+        if isinstance(inner, _WitnessedLock):
+            return inner
+        wrapped = _WitnessedLock(self, inner, name)
+        setattr(owner, attr, wrapped)
+        self._names.add(name)
+        self._wrapped.append((owner, attr, inner))
+        return wrapped
+
+    def unwrap_all(self) -> None:
+        """Restore every wrapped attribute (tests clean up with this)."""
+        for owner, attr, inner in self._wrapped:
+            setattr(owner, attr, inner)
+        self._wrapped.clear()
+
+    # -- per-thread held stack ----------------------------------------------
+
+    def _held(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def push(self, name: str) -> None:
+        self._held().append(name)
+
+    def pop(self, name: str) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == name:
+                del held[i]
+                return
+
+    # -- events -------------------------------------------------------------
+
+    def note_order(self, name: str) -> None:
+        held = self._held()
+        with self._mu:
+            self.acquisitions += 1
+            for h in dict.fromkeys(held):     # distinct, order-kept
+                if h == name:
+                    continue                  # re-entrant, not ordering
+                succ = self._edges.setdefault(h, set())
+                if name in succ:
+                    continue                  # edge known (and checked)
+                path = self._path(name, h)    # existing name ~> h?
+                if path is not None:
+                    cycle = " -> ".join([h] + path)
+                    self.violations.append(
+                        f"lock-order inversion: thread "
+                        f"{threading.current_thread().name!r} acquired "
+                        f"{name} while holding {h}, but the order "
+                        f"{' -> '.join(path)} was already witnessed — "
+                        f"cycle {cycle}")
+                succ.add(name)
+
+    def _path(self, src: str, dst: str) -> Optional[List[str]]:
+        """BFS over the edge graph; [src, ..., dst] or None.  Called
+        under _mu."""
+        prev = {src: None}
+        queue = collections.deque([src])
+        while queue:
+            node = queue.popleft()
+            if node == dst:
+                out = []
+                while node is not None:
+                    out.append(node)
+                    node = prev[node]
+                return out[::-1]
+            for nxt in self._edges.get(node, ()):
+                if nxt not in prev:
+                    prev[nxt] = node
+                    queue.append(nxt)
+        return None
+
+    def check_dispatch(self, point: str) -> None:
+        """Called by FaultInjector.fire at dispatch-class points."""
+        held = tuple(dict.fromkeys(self._held()))
+        if not held:
+            return
+        with self._mu:
+            key = (held, point)
+            if key in self._dispatch_seen:
+                return
+            self._dispatch_seen.add(key)
+            self.violations.append(
+                f"lock held across fenced dispatch: thread "
+                f"{threading.current_thread().name!r} holds "
+                f"{', '.join(held)} at injection point {point!r} — a "
+                "device dispatch under a Python lock serializes the "
+                "stack behind device latency")
+
+    # -- reading ------------------------------------------------------------
+
+    def report(self) -> dict:
+        with self._mu:
+            edges = sorted(f"{a} -> {b}"
+                           for a, succ in self._edges.items()
+                           for b in succ)
+            locks = sorted(self._names
+                           | set(self._edges)
+                           | {b for s in self._edges.values() for b in s})
+            return {"ok": not self.violations,
+                    "acquisitions": self.acquisitions,
+                    "locks": locks,
+                    "edges": edges,
+                    "violations": list(self.violations)}
+
+
+def arm_witness(engine, witness: Optional[LockWitness] = None,
+                attach: bool = True) -> LockWitness:
+    """Wrap one engine's serving locks (`_cv`, and the attached
+    kvstore's `_lock` if any) under a LockWitness.  `attach=True` also
+    sets `engine._lock_witness` so `check_invariants` folds the
+    witness's verdicts into its threads section — fleet runs pass
+    attach=False and keep ONE shared witness at the fleet level instead
+    (the edge graph must span router + every replica to see cross-
+    component cycles).  An installed FaultInjector gets the witness for
+    its dispatch-point check."""
+    w = witness if witness is not None else LockWitness()
+    w.wrap(engine, "_cv", "LLMEngine._cv")
+    store = getattr(engine, "kvstore", None)
+    if store is not None and hasattr(store, "_lock"):
+        w.wrap(store, "_lock", "TieredPrefixStore._lock")
+    if attach:
+        engine._lock_witness = w
+    inj = getattr(engine, "faults", None)
+    if inj is not None:
+        inj.witness = w
+    return w
 
 
 def check_invariants(engine, handles: Sequence = (), probe: bool = True,
@@ -506,6 +759,30 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
     telemetry = check_telemetry(engine)
     violations.extend(telemetry)
 
+    # threads section: step-thread liveness discipline plus the dynamic
+    # lock-order witness's verdicts (armed by the chaos soaks).  The
+    # step thread is daemon, but daemon-ness is a crash cushion, not a
+    # lifecycle: once _stop is set the thread must JOIN, or slots/pages
+    # it owns outlive the engine that accounts for them.
+    th = getattr(engine, "_thread", None)
+    threads = {
+        "step_thread_alive": bool(th is not None and th.is_alive()),
+        "stopped": bool(getattr(engine, "_stop", False)),
+    }
+    if th is not None and getattr(engine, "_stop", False):
+        th.join(timeout=5.0)
+        if th.is_alive():
+            violations.append(
+                "step thread still alive after _stop was set — "
+                "shutdown() must join it before the engine is abandoned "
+                "(a leaked step thread owns slots and pages)")
+    witness = getattr(engine, "_lock_witness", None)
+    if witness is not None:
+        wrep = witness.report()
+        threads["witness"] = wrep
+        violations.extend(f"lock witness: {v}"
+                          for v in wrep["violations"])
+
     report = {
         "ok": not violations,
         "violations": violations,
@@ -514,6 +791,7 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
         "num_pages": cache.num_pages,
         "probe_tokens": probe_tokens,
         "telemetry": {"ok": not telemetry, "mismatches": telemetry},
+        "threads": threads,
         "stats": engine.stats_snapshot(),
     }
     if violations:
@@ -532,15 +810,22 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
 def run_schedule(make_engine: Callable[[], object],
                  rules: Sequence[FaultRule],
                  requests: Sequence[Tuple[Sequence[int], int]],
-                 probe: bool = True, max_steps: int = 5000) -> dict:
+                 probe: bool = True, max_steps: int = 5000,
+                 witness: bool = False) -> dict:
     """Build a fresh engine, install the schedule, submit the workload
     ((prompt, max_new_tokens) pairs), drive to quiescence, and run the
-    invariant checker.  Returns the invariant report extended with the
-    schedule, the faults actually fired, and the final counters.  Raises
-    InvariantViolation on any leak."""
+    invariant checker.  `witness=True` arms the LockWitness on the
+    engine's locks (order inversions and locks-across-dispatch become
+    invariant violations) and proves the schedule leaked no threads.
+    Returns the invariant report extended with the schedule, the faults
+    actually fired, and the final counters.  Raises InvariantViolation
+    on any leak."""
+    before_threads = set(threading.enumerate())
     injector = FaultInjector(rules)
     engine = make_engine()
     engine.faults = injector
+    if witness:
+        arm_witness(engine)
     handles = []
     rejected = 0
     for prompt, max_new in requests:
@@ -550,6 +835,20 @@ def run_schedule(make_engine: Callable[[], object],
             rejected += 1      # QueueFull / validation — resolved by refusal
     steps = drive(engine, handles, max_steps=max_steps)
     report = check_invariants(engine, handles, probe=probe)
+    # thread-leak proof: a schedule must not leave threads behind (the
+    # factory may have started a step thread or helpers; everything must
+    # be joinable within grace once the run quiesced)
+    leaked = [t for t in threading.enumerate()
+              if t not in before_threads and t.is_alive()]
+    for t in leaked:
+        t.join(timeout=1.0)
+    leaked = [t for t in leaked if t.is_alive()]
+    report.setdefault("threads", {})["leaked"] = \
+        [f"{t.name} (daemon={t.daemon})" for t in leaked]
+    if any(not t.daemon for t in leaked):
+        raise InvariantViolation(
+            "non-daemon thread(s) leaked past the schedule: "
+            + ", ".join(t.name for t in leaked if not t.daemon))
     report.update({
         "schedule": [r.to_dict() for r in rules],
         "fired": list(injector.fired),
@@ -895,32 +1194,57 @@ def fleet_run_schedule(make_engine: Callable[[], object],
                        n_replicas: int = 2, max_hops: int = 3,
                        probe: bool = True, threaded: bool = False,
                        reference=None, max_steps: int = 20000,
-                       router_kw: Optional[dict] = None) -> dict:
+                       router_kw: Optional[dict] = None,
+                       witness: bool = False) -> dict:
     """Build a fresh N-replica fleet (Router + EngineSupervisor over
     `make_engine`), install the per-replica and router-level schedules,
     submit the workload, drive to quiescence, and run the fleet
     invariant checker.  Rebuilt replicas come from the same factory,
-    fault-free.  Returns the invariant report extended with schedule,
-    fired faults, retry/death counts.  Raises InvariantViolation on any
-    breach.  The router is shut down before returning."""
+    fault-free.  `witness=True` arms ONE shared LockWitness across the
+    router lock and every replica's locks (rebuilds included, via a
+    wrapped factory) — its edge graph must span components to see an
+    engine-lock/router-lock cycle — and proves shutdown joined every
+    thread the run started.  Returns the invariant report extended with
+    schedule, fired faults, retry/death counts.  Raises
+    InvariantViolation on any breach.  The router is shut down before
+    returning."""
     from .router import (Router, FleetQueueFull, NoHealthyReplica,
                          RouterStopped)
     from .supervisor import EngineSupervisor
 
+    before_threads = set(threading.enumerate())
+    w = LockWitness() if witness else None
+    factory = make_engine
+    if w is not None:
+        def factory():
+            eng = make_engine()
+            # attach=False: check_invariants runs per-replica inside
+            # fleet_check_invariants, and folding the SHARED witness
+            # there would repeat its violations once per replica — the
+            # fleet layer reports them once, below
+            arm_witness(eng, w, attach=False)
+            return eng
+
     engines = []
     injectors = []
     for i in range(n_replicas):
-        eng = make_engine()
+        eng = factory()
         inj = FaultInjector(list(engine_rules.get(i, ())))
+        inj.witness = w
         eng.faults = inj
         injectors.append(inj)
         engines.append(eng)
     router_injector = FaultInjector(list(router_rules))
+    router_injector.witness = w
     kw = dict(max_hops=max_hops, backoff_base=0.01, backoff_max=0.25,
               health_interval=0.005)
     kw.update(router_kw or {})
-    router = Router(engines, supervisor=EngineSupervisor(make_engine),
+    router = Router(engines, supervisor=EngineSupervisor(factory),
                     faults=router_injector, threaded=threaded, **kw)
+    if w is not None:
+        # safe mid-run swap: `with` holds the object it acquired, so a
+        # health tick that grabbed the raw lock releases the raw lock
+        w.wrap(router, "_lock", "Router._lock")
     handles, rejected = [], 0
     try:
         for prompt, max_new in requests:
@@ -936,6 +1260,35 @@ def fleet_run_schedule(make_engine: Callable[[], object],
                                         reference=reference, probe=probe)
     finally:
         router.shutdown(timeout=10.0)
+    # post-shutdown proofs: shutdown() must have JOINED every thread the
+    # run started (step threads, the health loop), and the shared
+    # witness must have seen a clean lock discipline fleet-wide
+    leaked = [t for t in threading.enumerate()
+              if t not in before_threads and t.is_alive()]
+    for t in leaked:
+        t.join(timeout=2.0)
+    leaked = [t for t in leaked if t.is_alive()]
+    threads = {"leaked": [f"{t.name} (daemon={t.daemon})"
+                          for t in leaked]}
+    post_violations: List[str] = []
+    if leaked:
+        post_violations.append(
+            "thread(s) leaked past router.shutdown(): "
+            + ", ".join(threads["leaked"]))
+    if w is not None:
+        wrep = w.report()
+        threads["witness"] = wrep
+        post_violations.extend(f"lock witness: {v}"
+                               for v in wrep["violations"])
+    report["threads"] = threads
+    if post_violations:
+        report["ok"] = False
+        report["violations"] = list(report["violations"]) + post_violations
+        fl = getattr(router, "flight", None)
+        if fl is not None:
+            fl.dump("invariant_violation",
+                    error=InvariantViolation("; ".join(post_violations)))
+        raise InvariantViolation("; ".join(post_violations))
     fired = list(router_injector.fired)
     for i, inj in enumerate(injectors):
         fired.extend({**f, "replica": i} for f in inj.fired)

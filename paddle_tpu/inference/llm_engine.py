@@ -574,6 +574,9 @@ class LLMEngine:
         if kvstore is not None:
             self.attach_kvstore(kvstore)
         self._pending: collections.deque = collections.deque()
+        # threadlint: owned=_loop — the slot table is step-thread-owned,
+        # mutated lock-free on the hot path; shutdown() touches it only
+        # AFTER joining the step thread (line-acknowledged there)
         self._slots: dict[int, _SlotState] = {}
         self._admit_seq = 0
         self._key = jax.random.PRNGKey(seed)
@@ -597,6 +600,9 @@ class LLMEngine:
             raise ValueError(
                 "metrics registry already serves another LLMEngine; "
                 "give each engine its own Registry")
+        # threadlint: atomic — _StatsDict routes every mutation through
+        # the backing registry Counter's own lock (the PR 9 fix), so
+        # step-thread bumps vs submit-path bumps under _cv never race
         self.stats = _StatsDict(self.metrics, (
             "accepted", "admitted", "completed", "decode_steps",
             "decode_tokens", "fused_decode_steps",
@@ -1204,6 +1210,9 @@ class LLMEngine:
 
     # -- engine loop --------------------------------------------------------
 
+    # threadlint: atomic — advisory lock-free peek: routers and the idle
+    # wait use it as a wakeup hint; _loop re-checks under _cv before
+    # acting, so a torn _pending/_kv_imports view only costs a spin
     def has_work(self) -> bool:
         return bool(self._pending or self._slots or self._kv_imports)
 
@@ -1312,6 +1321,8 @@ class LLMEngine:
                 req._resolve(err)
             self._pending.clear()
             for slot in list(self._slots):
+                # threadlint: atomic — safe off the owner thread: the
+                # step thread is joined (or never ran) by this point
                 st = self._slots.pop(slot)
                 self.stats["failed"] += 1
                 self._rq_event(st.req, "resolve",

@@ -192,23 +192,28 @@ class RequestRegistry:
 
     def to_dict(self, req_id: str) -> Optional[dict]:
         """The `GET /debug/request/<id>` payload (None when unknown —
-        evicted, or never traced)."""
-        tl = self.timeline(req_id)
-        return None if tl is None else tl.to_dict()
+        evicted, or never traced).  Converted UNDER the registry lock:
+        a live timeline's ring is appended to by step threads, and
+        iterating it outside the lock is a deque-mutated-during-
+        iteration crash on a busy engine (threadlint: the reqtrace
+        ring-append vs /debug-read race)."""
+        with self._lock:
+            tl = self._timelines.get(req_id)
+            return None if tl is None else tl.to_dict()
 
     def snapshot(self, limit: Optional[int] = 32) -> List[dict]:
         """The most recently touched `limit` timelines as dicts — the
         flight recorder's request section."""
         with self._lock:
             ids = list(self._timelines)
-        if limit is not None:
-            ids = ids[-int(limit):]
-        out = []
-        for rid in ids:
-            d = self.to_dict(rid)
-            if d is not None:
-                out.append(d)
-        return out
+            if limit is not None:
+                ids = ids[-int(limit):]
+            out = []
+            for rid in ids:
+                tl = self._timelines.get(rid)
+                if tl is not None:
+                    out.append(tl.to_dict())
+            return out
 
 
 # one registry per FLEET by default: router + all replica engines write

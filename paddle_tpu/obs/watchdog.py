@@ -268,6 +268,11 @@ class Watchdog:
         with self._lock:
             step_n = len(self._tracks["step"].samples)
             itl_n = len(self._tracks["itl"].samples)
+            # count + last-anomaly snapshot under the same lock as the
+            # writer (_note_anomaly): read outside it, a fire between
+            # the two reads reports total=N+1 with anomaly N-1's detail
+            anomalies_total = self.anomalies_total
+            last_anomaly = self.last_anomaly
         armed = (self.enabled
                  and step_n >= self.min_baseline + self.recent_window)
         return {
@@ -277,6 +282,6 @@ class Watchdog:
             "sustain": self.sustain,
             "step_samples": step_n,
             "itl_samples": itl_n,
-            "anomalies_total": self.anomalies_total,
-            "last_anomaly": self.last_anomaly,
+            "anomalies_total": anomalies_total,
+            "last_anomaly": last_anomaly,
         }

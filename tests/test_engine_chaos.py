@@ -388,8 +388,12 @@ class TestChaos:
             rules = F.random_schedule(seed)
             mode = "swap" if seed % 2 else "recompute"
             report = F.run_schedule(self._make(params, cfg, mode), rules,
-                                    _workload(cfg, seed=seed))
+                                    _workload(cfg, seed=seed),
+                                    witness=True)
             assert report["ok"], (seed, report["violations"])
+            # witness armed: order inversions / locks-across-dispatch /
+            # leaked threads would have failed above; prove it watched
+            assert report["threads"]["witness"]["acquisitions"] > 0
 
     @pytest.mark.slow
     def test_random_schedules_soak(self, tiny):
@@ -400,7 +404,8 @@ class TestChaos:
             rules = F.random_schedule(seed)
             mode = "swap" if seed % 2 else "recompute"
             report = F.run_schedule(self._make(params, cfg, mode), rules,
-                                    _workload(cfg, seed=seed))
+                                    _workload(cfg, seed=seed),
+                                    witness=True)
             assert report["ok"], (seed, report["violations"])
 
     def test_injected_oom_respects_last_runnable(self, tiny):

@@ -573,12 +573,19 @@ def test_baseline_gate_tier1(capsys):
     round.  Mesh-less, so it gates in EVERY session (including
     PADDLE_HOST_DEVICES=1); the SPMD tier's gate is the multidevice
     test below.  jaxpr tier only — the HLO tier's compile budget lives
-    in test_graphlint_hlo.py."""
+    in test_graphlint_hlo.py; the threads tier's gate (--threads against
+    the same file's v4 `threads` section) lives in test_threadlint.py."""
     rc = _graphlint.main(["--baseline", _baseline_path(), "--no-hlo",
                           "--json"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert rc == 0, ("new graphlint finding codes vs baseline:\n"
                      + "\n".join(out["new_vs_baseline"]))
+    # one shipped doc gates every tier: the model-tier run above must
+    # coexist with the v4 threads section (merge-written, never dropped)
+    with open(_baseline_path()) as f:
+        doc = json.load(f)
+    assert doc["schema_version"] == _graphlint.BASELINE_SCHEMA_VERSION
+    assert "threads" in doc
 
 
 @pytest.mark.multidevice(4)

@@ -152,8 +152,12 @@ class TestFleetChaos:
             seed, n_replicas=2)
         report = F.fleet_run_schedule(
             _mk(), engine_rules, router_rules, _workload(seed=seed),
-            n_replicas=2, reference=_ref)
+            n_replicas=2, reference=_ref, witness=True)
         assert report["ok"], (seed, report["violations"])
+        # ONE fleet-wide witness watched router + replica locks and the
+        # shutdown join proof ran — any breach failed the assert above
+        assert report["threads"]["leaked"] == []
+        assert report["threads"]["witness"]["acquisitions"] > 0
 
     @pytest.mark.slow
     def test_random_fleet_schedules_soak(self):
@@ -165,7 +169,7 @@ class TestFleetChaos:
             report = F.fleet_run_schedule(
                 _mk(), engine_rules, router_rules, _workload(seed=seed),
                 n_replicas=2 + seed % 2, reference=_ref,
-                probe=seed % 5 == 0)
+                probe=seed % 5 == 0, witness=True)
             assert report["ok"], (seed, report["violations"])
 
 

@@ -1,0 +1,547 @@
+"""Graph Doctor tier 5 (threadlint): the lock-discipline race detector
+over the serving stack, its annotation verifier, the schema-v4 baseline
+gate, and the dynamic lock-order witness that CONFIRMS the static tier
+under chaos (order inversions, locks held across fenced dispatches,
+leaked threads).
+
+Each seeded-bad fixture below reproduces exactly one finding code; the
+tier-1 acceptance bar is that the SHIPPED inference + obs modules lint
+thread-clean and the chaos harness stays green with the witness armed."""
+
+import importlib.util
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import threadlint as T
+from paddle_tpu.analysis.core import Severity
+from paddle_tpu.inference import faults as F
+
+
+def _codes(report):
+    return sorted(f.code for f in report.findings)
+
+
+# ---------------------------------------------------------------------------
+# seeded-bad fixtures: one module per finding code
+# ---------------------------------------------------------------------------
+
+# `_pending` is written under the lock in submit() but bare in poke()
+# (RACE_UNGUARDED_WRITE); peek() reads two lock-protected counters
+# without it — a writer between the reads tears the pair
+# (RACE_UNGUARDED_READ, the PR 11 identity-tear shape).
+RACY_SRC = '''
+import threading
+class MiniEngine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._pending = []
+        self._done = 0
+        self._epoch = 0
+    def submit(self, r):
+        with self._lock:
+            self._pending.append(r)
+            self._done += 1
+            self._epoch += 1
+    def poke(self, r):
+        self._pending.append(r)
+    def peek(self):
+        return (self._done, self._epoch)
+'''
+
+# iterating a lock-protected container outside the lock: a concurrent
+# append resizes the list mid-iteration
+ITER_SRC = '''
+import threading
+class Ring:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+    def push(self, e):
+        with self._lock:
+            self._events.append(e)
+    def dump(self):
+        return [e for e in self._events]
+'''
+
+# A.step holds _a_lock and calls B.poke (takes _b_lock); B.reverse holds
+# _b_lock and calls A.step — two threads on opposite paths deadlock
+CYCLE_SRC = '''
+import threading
+class A:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+    def step(self, b):
+        with self._a_lock:
+            b.poke()
+class B:
+    def __init__(self):
+        self._b_lock = threading.Lock()
+    def poke(self):
+        with self._b_lock:
+            pass
+    def reverse(self, a):
+        with self._b_lock:
+            a.step(self)
+'''
+
+# sleep + future-result under a held lock: every other thread queues
+# behind wall-clock latency
+BLOCK_SRC = '''
+import threading, time
+class Slow:
+    def __init__(self):
+        self._lock = threading.Lock()
+    def tick(self, fut):
+        with self._lock:
+            time.sleep(0.1)
+            fut.result()
+'''
+
+# non-daemon thread started and never joined anywhere in the class
+LEAK_SRC = '''
+import threading
+class Spawner:
+    def __init__(self):
+        self._t = None
+    def start(self):
+        self._t = threading.Thread(target=self._work)
+        self._t.start()
+    def _work(self):
+        pass
+'''
+
+LEAK_JOINED_SRC = LEAK_SRC + '''
+    def stop(self):
+        self._t.join()
+'''
+
+# the owned= annotation claims _slots is touched only from _loop's call
+# graph — reset() violates the claim, so the annotation must FIRE, not
+# suppress
+OWNED_LIE_SRC = '''
+import threading
+class Owned:
+    def __init__(self):
+        self._slots = []  # threadlint: owned=_loop
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+    def _loop(self):
+        self._slots.append(1)
+    def reset(self):
+        self._slots.clear()
+'''
+
+OWNED_OK_SRC = '''
+import threading
+class Owned:
+    def __init__(self):
+        self._slots = []  # threadlint: owned=_loop
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+    def _loop(self):
+        self._slots.append(1)
+'''
+
+ATOMIC_SRC = '''
+import threading
+class Counted:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0  # threadlint: atomic
+    def bump(self):
+        with self._lock:
+            self._n += 1
+    def poke(self):
+        self._n += 1
+'''
+
+
+class TestSeededFindings:
+    def test_unguarded_write(self):
+        report = T.analyze_source(RACY_SRC, "racy")
+        writes = [f for f in report.findings
+                  if f.code == "RACE_UNGUARDED_WRITE"]
+        assert len(writes) == 1
+        # the finding names the guarded site AND the bare one
+        assert "submit" in writes[0].message
+        assert "poke" in writes[0].message
+        assert "_pending" in writes[0].eqn_path
+
+    def test_unguarded_multiword_read(self):
+        report = T.analyze_source(RACY_SRC, "racy")
+        reads = [f for f in report.findings
+                 if f.code == "RACE_UNGUARDED_READ"]
+        assert len(reads) == 1
+        assert "peek" in reads[0].eqn_path
+        assert "_done" in reads[0].message
+        assert "_epoch" in reads[0].message
+
+    def test_iteration_over_protected_container(self):
+        report = T.analyze_source(ITER_SRC, "ring")
+        assert _codes(report) == ["RACE_UNGUARDED_READ"]
+        assert "dump" in report.findings[0].eqn_path
+
+    def test_lock_order_cycle(self):
+        report = T.analyze_source(CYCLE_SRC, "cycle")
+        cycles = [f for f in report.findings
+                  if f.code == "LOCK_ORDER_CYCLE"]
+        assert len(cycles) == 1
+        msg = cycles[0].message
+        assert "A._a_lock" in msg and "B._b_lock" in msg
+        # both directed edges of the deadlock are named with their paths
+        assert "A.step" in msg and "B.reverse" in msg
+
+    def test_blocking_call_under_lock(self):
+        report = T.analyze_source(BLOCK_SRC, "slow")
+        blocks = [f for f in report.findings
+                  if f.code == "LOCK_BLOCKING_CALL"]
+        # one for time.sleep, one for fut.result
+        assert len(blocks) == 2
+        joined = " ".join(f.message for f in blocks)
+        assert "sleep" in joined and "result" in joined
+
+    def test_thread_leak(self):
+        report = T.analyze_source(LEAK_SRC, "spawn")
+        assert _codes(report) == ["THREAD_LEAK"]
+
+    def test_joined_thread_is_not_a_leak(self):
+        report = T.analyze_source(LEAK_JOINED_SRC, "spawn")
+        assert "THREAD_LEAK" not in _codes(report)
+
+    def test_daemon_thread_is_not_a_leak(self):
+        src = LEAK_SRC.replace("target=self._work",
+                               "target=self._work, daemon=True")
+        report = T.analyze_source(src, "spawn")
+        assert "THREAD_LEAK" not in _codes(report)
+
+
+class TestAnnotations:
+    def test_owned_annotation_suppresses_when_true(self):
+        report = T.analyze_source(OWNED_OK_SRC, "owned")
+        assert _codes(report) == []
+
+    def test_lying_owned_annotation_fires(self):
+        report = T.analyze_source(OWNED_LIE_SRC, "owned")
+        writes = [f for f in report.findings
+                  if f.code == "RACE_UNGUARDED_WRITE"]
+        assert len(writes) == 1
+        # the verifier names the method OUTSIDE the claimed owner's
+        # call graph — a lying annotation is worse than none
+        assert "owned=_loop" in writes[0].message
+        assert "reset" in writes[0].message
+
+    def test_atomic_annotation_suppresses(self):
+        assert _codes(T.analyze_source(ATOMIC_SRC, "at")) == []
+
+    def test_without_annotation_the_same_shape_fires(self):
+        bare = ATOMIC_SRC.replace("  # threadlint: atomic", "")
+        report = T.analyze_source(bare, "at")
+        assert "RACE_UNGUARDED_WRITE" in _codes(report)
+
+    def test_suppression_globs_still_work(self):
+        report = T.analyze_source(RACY_SRC, "racy", suppress=["RACE_*"])
+        assert report.ok(Severity.WARNING)
+        assert report.suppressed == 2
+
+
+class TestShippedStack:
+    def test_inventory_covers_the_serving_locks(self):
+        inv = T.inventory(T.DEFAULT_MODULES)
+        lock_names = {e["lock"] for e in inv["locks"]}
+        assert "LLMEngine._cv" in lock_names
+        assert "Router._lock" in lock_names
+        # every shipped stack thread is a daemon (non-daemon would hang
+        # interpreter shutdown); threadlint's own leak check agrees
+        assert inv["threads"], "no thread entry points inventoried"
+        assert all(e["daemon"] for e in inv["threads"])
+
+    def test_shipped_stack_is_thread_clean_tier1(self):
+        """The acceptance bar: inference + obs lint clean at WARNING
+        under schema v4 — every intentional exception is annotated
+        in-source, not baselined away."""
+        reports = T.analyze_modules()
+        for mod, report in reports.items():
+            bad = [str(f) for f in report.findings
+                   if f.severity >= Severity.WARNING]
+            assert report.ok(Severity.WARNING), \
+                f"{mod} has unsuppressed thread findings:\n" + \
+                "\n".join(bad)
+
+
+# ---------------------------------------------------------------------------
+# graphlint --threads CLI + schema-v4 baseline semantics
+# ---------------------------------------------------------------------------
+
+
+def _load_graphlint():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "graphlint.py")
+    spec = importlib.util.spec_from_file_location("graphlint_t5", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_graphlint = _load_graphlint()
+
+
+def _baseline_path():
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "GRAPHLINT_BASELINE.json")
+
+
+class TestBaselineGate:
+    def test_threads_baseline_gate_tier1(self, capsys):
+        """CI shape: the shipped baseline admits ZERO thread findings,
+        so any new race/cycle/leak in inference or obs fails the gate."""
+        rc = _graphlint.main(["--threads", "--baseline",
+                              _baseline_path(), "--json"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0, "\n".join(out["new_vs_baseline"])
+        assert out["ok"]
+        for mod in T.DEFAULT_MODULES:
+            counts = out["threads"][mod]["counts"]
+            assert all(n == 0 for n in counts.values()), counts
+
+    def test_shipped_baseline_is_schema_v4(self):
+        with open(_baseline_path()) as f:
+            doc = json.load(f)
+        assert doc["schema_version"] == _graphlint.BASELINE_SCHEMA_VERSION
+        assert set(doc["threads"]) == set(T.DEFAULT_MODULES)
+
+    def test_diff_flags_new_code_escalation_and_count_growth(self):
+        base = {"threads": {"m": {
+            "codes": {"LOCK_BLOCKING_CALL": "info",
+                      "RACE_UNGUARDED_WRITE": "warning"},
+            "counts": {"LOCK_BLOCKING_CALL": 1,
+                       "RACE_UNGUARDED_WRITE": 1}}}}
+        cur = {"m": {
+            "codes": {"LOCK_BLOCKING_CALL": "warning",   # escalated
+                      "RACE_UNGUARDED_WRITE": "warning",  # count grew
+                      "THREAD_LEAK": "warning"},          # new
+            "counts": {"LOCK_BLOCKING_CALL": 1,
+                       "RACE_UNGUARDED_WRITE": 2,
+                       "THREAD_LEAK": 1}}}
+        news = _graphlint._threads_diff(cur, base)
+        assert any("NEW code THREAD_LEAK" in n for n in news)
+        assert any("escalated" in n for n in news)
+        assert any("count grew 1 -> 2" in n for n in news)
+        # identical snapshot: clean diff
+        assert _graphlint._threads_diff(
+            {"m": base["threads"]["m"]}, base) == []
+
+    def test_loader_warns_not_crashes_on_unknown_keys(self, tmp_path,
+                                                      capsys):
+        doc = {"schema_version": 99, "future_section": {},
+               "targets": {},
+               "threads": {"m": {"codes": {}, "counts": {},
+                                 "future_counter": 7}}}
+        p = tmp_path / "base.json"
+        p.write_text(json.dumps(doc))
+        loaded = _graphlint._load_baseline(str(p))
+        err = capsys.readouterr().err
+        assert loaded["threads"]["m"]["codes"] == {}
+        assert "future_section" in err and "future_counter" in err
+        assert "warning" in err
+
+    def test_write_baseline_merges_sections(self, tmp_path):
+        """A --threads --write-baseline must not drop the model-target
+        snapshot (one shipped doc gates both surfaces)."""
+        p = tmp_path / "base.json"
+        p.write_text(json.dumps(
+            {"schema_version": 3,
+             "targets": {"llama": {"codes": {"DEAD_CODE": "warning"}}},
+             "mesh": "data=2,model=2"}))
+        _graphlint._write_baseline_doc(
+            str(p), threads={"m": {"codes": {}, "counts": {}}})
+        doc = json.loads(p.read_text())
+        assert doc["schema_version"] == \
+            _graphlint.BASELINE_SCHEMA_VERSION
+        assert doc["targets"]["llama"]["codes"] == {
+            "DEAD_CODE": "warning"}
+        assert doc["mesh"] == "data=2,model=2"
+        assert doc["threads"] == {"m": {"codes": {}, "counts": {}}}
+
+
+# ---------------------------------------------------------------------------
+# dynamic witness: the chaos-side confirmation of the static tier
+# ---------------------------------------------------------------------------
+
+
+class _Box:
+    """Bare lock holder for witness wrap tests."""
+
+    def __init__(self, lock=None):
+        self.lock = lock if lock is not None else threading.Lock()
+
+
+class TestLockWitness:
+    def test_order_inversion_names_the_cycle(self):
+        w = F.LockWitness()
+        a, b = _Box(), _Box()
+        w.wrap(a, "lock", "A")
+        w.wrap(b, "lock", "B")
+        with a.lock:
+            with b.lock:
+                pass
+
+        def inverse():
+            with b.lock:
+                with a.lock:
+                    pass
+
+        t = threading.Thread(target=inverse, name="t-inv")
+        t.start()
+        t.join()
+        rep = w.report()
+        assert not rep["ok"]
+        assert len(rep["violations"]) == 1
+        v = rep["violations"][0]
+        # the edge B -> A completes the witnessed A -> B path: the
+        # cycle is reported rotated from the closing lock
+        assert "lock-order inversion" in v
+        assert "t-inv" in v
+        assert "cycle B -> A -> B" in v
+
+    def test_consistent_order_is_clean(self):
+        w = F.LockWitness()
+        a, b = _Box(), _Box()
+        w.wrap(a, "lock", "A")
+        w.wrap(b, "lock", "B")
+        for _ in range(3):
+            with a.lock:
+                with b.lock:
+                    pass
+        rep = w.report()
+        assert rep["ok"] and rep["violations"] == []
+        assert rep["edges"] == ["A -> B"]
+        assert rep["acquisitions"] >= 6
+
+    def test_condition_wait_is_not_an_ordering_event(self):
+        """wait() releases the condition; re-acquiring on wakeup while
+        the waiter holds another lock must not record a false edge."""
+        w = F.LockWitness()
+        box = _Box(threading.Condition())
+        w.wrap(box, "lock", "CV")
+        done = []
+
+        def waiter():
+            with box.lock:
+                box.lock.wait_for(lambda: done, timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        with box.lock:
+            done.append(1)
+            box.lock.notify_all()
+        t.join()
+        rep = w.report()
+        assert rep["ok"], rep["violations"]
+        assert rep["acquisitions"] >= 2
+
+    def test_unwrap_all_restores_raw_locks(self):
+        w = F.LockWitness()
+        box = _Box()
+        raw = box.lock
+        w.wrap(box, "lock", "A")
+        assert box.lock is not raw
+        w.unwrap_all()
+        assert box.lock is raw
+
+    def test_dispatch_under_lock_fires_once(self):
+        eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+        eng.faults = F.FaultInjector([])
+        w = F.arm_witness(eng)
+        with eng._cv:
+            eng.faults.fire("decode", engine=eng)
+            eng.faults.fire("decode", engine=eng)   # deduped
+        rep = w.report()
+        assert len(rep["violations"]) == 1
+        assert "fenced dispatch" in rep["violations"][0]
+        assert "LLMEngine._cv" in rep["violations"][0]
+        # check_invariants folds the witness verdict into the report
+        inv = F.check_invariants(eng, probe=False,
+                                 raise_on_violation=False)
+        assert any("lock witness" in v for v in inv["violations"])
+
+    def test_dispatch_without_lock_is_clean(self):
+        eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+        eng.faults = F.FaultInjector([])
+        w = F.arm_witness(eng)
+        eng.faults.fire("decode", engine=eng)
+        assert w.report()["ok"]
+
+    def test_seeded_inversion_fails_the_soak(self):
+        """The acceptance criterion: an engine-lock/router-lock order
+        inversion armed during a soak FAILS check_invariants with the
+        cycle named."""
+        eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16)
+        w = F.arm_witness(eng)
+        router = _Box()
+        w.wrap(router, "lock", "Router._lock")
+        with eng._cv:          # canonical order: engine then router
+            with router.lock:
+                pass
+
+        def inverted():        # the seeded-bad schedule: reverse order
+            with router.lock:
+                with eng._cv:
+                    pass
+
+        t = threading.Thread(target=inverted)
+        t.start()
+        t.join()
+        with pytest.raises(F.InvariantViolation) as ei:
+            F.check_invariants(eng, probe=False)
+        msg = str(ei.value)
+        assert "lock-order inversion" in msg
+        assert "cycle Router._lock -> LLMEngine._cv -> Router._lock" \
+            in msg
+
+
+def _workload(seed=1, n=4):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
+                          int(rng.integers(2, 9))).tolist(),
+             int(rng.integers(2, 7))) for _ in range(n)]
+
+
+class TestWitnessedChaos:
+    def test_run_schedule_witnessed_clean(self):
+        def mk():
+            return F.ScriptedEngine(num_slots=2, page_size=4,
+                                    max_seq_len=16)
+
+        report = F.run_schedule(mk, F.random_schedule(7), _workload(),
+                                witness=True)
+        assert report["ok"]
+        threads = report["threads"]
+        assert threads["leaked"] == []
+        assert threads["witness"]["ok"]
+        assert threads["witness"]["acquisitions"] > 0
+        assert "LLMEngine._cv" in threads["witness"]["locks"]
+
+    def test_fleet_witnessed_clean_threaded(self):
+        def mk():
+            return F.ScriptedEngine(num_slots=2, page_size=4,
+                                    max_seq_len=16)
+
+        eng_rules, rtr_rules = F.fleet_random_schedule(3, n_replicas=2)
+        report = F.fleet_run_schedule(
+            mk, eng_rules, rtr_rules, _workload(n=6), n_replicas=2,
+            threaded=True, witness=True,
+            reference=lambda h: F.ScriptedEngine.reference_tokens(
+                h.prompt, h.max_new_tokens, h.eos_id))
+        assert report["ok"]
+        threads = report["threads"]
+        # shutdown joined every thread the run started, and the ONE
+        # fleet-wide witness saw router + replica locks with no
+        # inversion
+        assert threads["leaked"] == []
+        assert threads["witness"]["ok"]
+        assert "Router._lock" in threads["witness"]["locks"]
+        assert threads["witness"]["acquisitions"] > 0

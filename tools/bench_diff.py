@@ -103,8 +103,9 @@ def classify(path: str) -> str:
         if sub in leaf:
             return "lower"
     # containers whose CHILDREN are the metrics (mem-peak tables keyed
-    # by model name, latency tables keyed by percentile)
-    for sub in ("bytes", "mem_peak", "latency", "overhead"):
+    # by model name, latency tables keyed by percentile, threadlint
+    # severity counts keyed by module — every race finding is a defect)
+    for sub in ("bytes", "mem_peak", "latency", "overhead", "threadlint"):
         if sub in path:
             return "lower"
     return "higher"

@@ -23,6 +23,18 @@ Usage:
                             [--apply] [--suppress CODE[@pathglob]]...
                             [--fail-on LVL] [--no-hlo] [--config RC]
                             [--baseline B.json | --write-baseline B.json]
+  python tools/graphlint.py --threads [modules...] [--json] [--verbose]
+                            [--baseline B.json | --write-baseline B.json]
+
+--threads flips to the lock-discipline tier (analysis.threadlint): the
+positionals become MODULE names (default: paddle_tpu.inference and
+paddle_tpu.obs), linted for unguarded shared-field writes/reads, static
+lock-order cycles, blocking calls under locks, and leaked threads —
+`# threadlint:` annotations suppress findings in-source and are
+VERIFIED, not trusted.  --verbose adds the full lock/thread inventory.
+The baseline's "threads" section (schema v4) diffs per-module finding
+codes AND counts; --write-baseline merges into the shared snapshot
+without touching the model targets' section.
 
 Exit code is 0 when every target is clean at --fail-on (default: warning)
 after suppressions, 1 otherwise.  --json emits one machine-readable object
@@ -283,10 +295,14 @@ def _spmd_summary(report) -> "dict | None":
 
 # bump when the snapshot schema changes; readers WARN (not crash) on
 # keys they don't know, so a newer tool's baseline still gates an older
-# checkout and vice versa.  v3: per-target "spmd" counters (--mesh runs)
-BASELINE_SCHEMA_VERSION = 3
-_KNOWN_BASELINE_KEYS = {"schema_version", "targets", "mesh"}
+# checkout and vice versa.  v3: per-target "spmd" counters (--mesh
+# runs).  v4: top-level "threads" — per-module threadlint code/count
+# snapshots (--threads runs); --write-baseline MERGES into an existing
+# file, so the model targets and the threads section share one doc.
+BASELINE_SCHEMA_VERSION = 4
+_KNOWN_BASELINE_KEYS = {"schema_version", "targets", "mesh", "threads"}
 _KNOWN_TARGET_KEYS = {"codes", "rewrite", "spmd"}
+_KNOWN_THREADS_KEYS = {"codes", "counts"}
 
 
 def _baseline_snapshot(out: dict) -> dict:
@@ -330,7 +346,35 @@ def _load_baseline(path: str) -> dict:
             for k in sorted(set(tsnap) - _KNOWN_TARGET_KEYS):
                 print(f"graphlint: warning: unknown baseline key "
                       f"{tname}.{k!r} — ignored", file=sys.stderr)
+    for mname, msnap in baseline.get("threads", {}).items():
+        if isinstance(msnap, dict):
+            for k in sorted(set(msnap) - _KNOWN_THREADS_KEYS):
+                print(f"graphlint: warning: unknown baseline key "
+                      f"threads.{mname}.{k!r} — ignored", file=sys.stderr)
     return baseline
+
+
+def _write_baseline_doc(path: str, targets=None, mesh=None,
+                        threads=None) -> None:
+    """MERGE one section into the baseline file: a --threads run must
+    not drop the model-target snapshot and vice versa (one shipped doc
+    gates both surfaces)."""
+    doc = {}
+    if os.path.isfile(path):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = {}
+    doc["schema_version"] = BASELINE_SCHEMA_VERSION
+    if targets is not None:
+        doc["targets"] = targets
+    if mesh is not None:
+        doc["mesh"] = mesh
+    if threads is not None:
+        doc["threads"] = threads
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
 
 
 def _baseline_diff(current: dict, baseline: dict) -> list:
@@ -360,11 +404,123 @@ def _baseline_diff(current: dict, baseline: dict) -> list:
     return news
 
 
+def _threads_snapshot(reports: dict) -> dict:
+    """{module: {"codes": {code: worst_sev}, "counts": {code: n}}} —
+    the v4 baseline's threads section.  Counts matter here (unlike the
+    model tiers): a second unguarded write to the same field is a
+    second race, so count growth fails the diff."""
+    snap = {}
+    for mod, rep in reports.items():
+        codes: dict = {}
+        counts: dict = {}
+        for f in rep.findings:
+            sev = f.severity.name.lower()
+            if _severity_rank(sev) > _severity_rank(codes.get(f.code, "")):
+                codes[f.code] = sev
+            counts[f.code] = counts.get(f.code, 0) + 1
+        snap[mod] = {"codes": codes, "counts": counts}
+    return snap
+
+
+def _threads_diff(current: dict, baseline: dict) -> list:
+    """New codes, severity escalations, or count growth vs the
+    baseline's threads section."""
+    base_all = baseline.get("threads", {})
+    news = []
+    for mod, cur in current.items():
+        base = base_all.get(mod, {})
+        bcodes = base.get("codes", {})
+        bcounts = base.get("counts", {})
+        for code, sev in cur["codes"].items():
+            if code not in bcodes:
+                news.append(f"{mod}: NEW code {code} ({sev})")
+            elif _severity_rank(sev) > _severity_rank(bcodes[code]):
+                news.append(f"{mod}: {code} escalated "
+                            f"{bcodes[code]} -> {sev}")
+            elif cur["counts"].get(code, 0) > int(bcounts.get(code, 0)):
+                news.append(f"{mod}: {code} count grew "
+                            f"{bcounts.get(code, 0)} -> "
+                            f"{cur['counts'][code]}")
+    return news
+
+
+def _threads_main(args, analysis, config) -> int:
+    """--threads mode: the lock-discipline tier over serving modules
+    (positionals are MODULE names, not bench targets)."""
+    from paddle_tpu.analysis import threadlint
+
+    modules = list(args.targets) or list(threadlint.DEFAULT_MODULES)
+    fail_on = analysis.Severity[args.fail_on.upper()]
+    suppress = list(args.suppress)
+    reports = threadlint.analyze_modules(
+        tuple(modules), suppress=suppress, config=config)
+    out, all_ok = {}, True
+    for mod, rep in reports.items():
+        ok = rep.ok(fail_on)
+        all_ok &= ok
+        out[mod] = dict(rep.to_json(), ok=ok)
+        if not args.as_json:
+            shown = [f for f in rep
+                     if args.verbose
+                     or f.severity >= analysis.Severity.WARNING]
+            print(f"== {mod}: {'clean' if ok else 'FINDINGS'} "
+                  f"({rep.counts()}, {rep.suppressed} suppressed)")
+            for f in shown:
+                print(f"   {f}")
+    if args.verbose and not args.as_json:
+        inv = threadlint.inventory(tuple(modules))
+        print(f"-- inventory: {len(inv['locks'])} lock(s), "
+              f"{len(inv['threads'])} thread entry point(s), "
+              f"{len(inv['lock_order_edges'])} static lock-order "
+              "edge(s)")
+        for lk in inv["locks"]:
+            print(f"   lock   {lk['lock']:<34} {lk['kind']:<10} "
+                  f"{lk['file']}:{lk['line']}")
+        for th in inv["threads"]:
+            print(f"   thread {th['where']} -> {th['target']} "
+                  f"(daemon={th['daemon']}, stored as "
+                  f"{th['stored_as']}) {th['file']}:{th['line']}")
+        for edge in inv["lock_order_edges"]:
+            print(f"   order  {edge}")
+    snap = _threads_snapshot(reports)
+    if args.write_baseline:
+        _write_baseline_doc(args.write_baseline, threads=snap)
+        if not args.as_json:
+            print(f"graphlint: threads baseline written to "
+                  f"{args.write_baseline}")
+    if args.baseline:
+        baseline = _load_baseline(args.baseline)
+        news = _threads_diff(snap, baseline)
+        if args.as_json:
+            print(json.dumps({"threads": out, "new_vs_baseline": news,
+                              "ok": not news}))
+        else:
+            for n in news:
+                print(f"baseline: {n}")
+            print(f"graphlint: "
+                  f"{'no new threadlint findings' if not news else f'{len(news)} NEW threadlint finding(s)'} "
+                  f"vs {args.baseline}")
+        return 1 if news else 0
+    if args.as_json:
+        counts = {k: out[k]["counts"] for k in out}
+        print(json.dumps({"threads": out, "counts": counts,
+                          "ok": all_ok}))
+    elif all_ok:
+        print(f"graphlint: {len(modules)} module(s) thread-clean at "
+              f">={args.fail_on}")
+    return 0 if all_ok else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="lint the shipped bench models with paddle_tpu.analysis")
-    ap.add_argument("targets", nargs="*", choices=[[], *TARGETS],
-                    default=[], help="targets (default: all)")
+    ap.add_argument("targets", nargs="*", default=[],
+                    help="bench targets (default: all); with --threads: "
+                         "module names (default: the serving stack)")
+    ap.add_argument("--threads", action="store_true",
+                    help="run the lock-discipline tier "
+                         "(analysis.threadlint) over serving MODULES "
+                         "instead of linting bench models")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON object instead of text")
     ap.add_argument("--verbose", action="store_true",
@@ -399,6 +555,13 @@ def main(argv=None) -> int:
                     help="store the current findings as the snapshot")
     args = ap.parse_args(argv)
 
+    if not args.threads:
+        bad = sorted(set(args.targets) - set(TARGETS))
+        if bad:
+            ap.error(f"unknown target(s) {', '.join(bad)} (choose from "
+                     f"{', '.join(TARGETS)}; module names need "
+                     "--threads)")
+
     global MESH_SIZES
     MESH_SIZES = None
     if args.mesh:
@@ -426,6 +589,9 @@ def main(argv=None) -> int:
         ".graphlintrc")
     config = analysis.load_rcfile(rc_path) if os.path.isfile(rc_path) \
         else None
+
+    if args.threads:
+        return _threads_main(args, analysis, config)
 
     if args.apply:
         args.fix = True
@@ -499,11 +665,8 @@ def main(argv=None) -> int:
 
     snap = _baseline_snapshot(out)
     if args.write_baseline:
-        doc = {"schema_version": BASELINE_SCHEMA_VERSION, "targets": snap}
-        if args.mesh:
-            doc["mesh"] = args.mesh
-        with open(args.write_baseline, "w") as f:
-            json.dump(doc, f, indent=1, sort_keys=True)
+        _write_baseline_doc(args.write_baseline, targets=snap,
+                            mesh=args.mesh or None)
         if not args.as_json:
             print(f"graphlint: baseline written to {args.write_baseline}")
     if args.baseline:
