@@ -924,6 +924,140 @@ def bench_disagg(dev, on_tpu):
     }
 
 
+def bench_qos(dev, on_tpu):
+    """extra.qos: multi-tenant QoS A/B under a hostile mix — what the
+    weighted-fair/priority admission path buys a paced high-priority
+    tenant while a bulk tenant floods the queue.
+
+    One pump-driven engine, two legs, same workload and pool:
+
+      * OFF — no tenant table, every request untagged: the single
+        default FIFO deque, exactly the pre-QoS engine.  A burst of
+        bulk requests lands first, so each paced "gold" request waits
+        behind the whole backlog for a slot.
+      * ON — two-tier table (gold: priority 0, weight 4; bulk:
+        priority 3, weight 1), requests tagged: WFQ puts every gold
+        arrival at the head of admission, so it takes the next slot
+        that frees instead of draining the flood first.
+
+    Gates (lower-is-better ratios, ON over OFF, for the GOLD tenant
+    only): `ttft_hipri_qos_on_vs_off` <= 0.8 on p99 time-to-first-token
+    and `itl_hipri_qos_on_vs_off` <= 0.8 on p99 END-TO-END per-emitted-
+    token latency ((t_done - t_submit) / tokens — queueing and
+    preemption delay included; pure step time would be identical in
+    both legs because the compiled dispatch doesn't know about tenants,
+    BY DESIGN).  Also reported: Jain fairness index over weight-
+    normalized per-tenant emitted tokens/sec in the ON leg (1.0 =
+    allocation exactly proportional to configured weights)."""
+    import time as _time
+    import jax as _jax
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import llama as _llama
+    from paddle_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.tiny()
+    page_size, max_seq = 4, 32
+    n_bulk, bulk_new = 16, 12
+    n_gold, gold_new = 6, 8
+    gold_every = 8   # pump steps between gold arrivals (the pacing)
+
+    params = _llama.init_params(cfg, _jax.random.PRNGKey(7))
+    rng = np.random.default_rng(3)
+    bulk_prompts = [rng.integers(0, cfg.vocab_size, 8).tolist()
+                    for _ in range(n_bulk)]
+    gold_prompts = [rng.integers(0, cfg.vocab_size, 8).tolist()
+                    for _ in range(n_gold)]
+
+    QOS_ON = {"gold": {"priority": 0, "weight": 4.0},
+              "bulk": {"priority": 3, "weight": 1.0}}
+
+    def run_leg(table):
+        tagged = table is not None
+        # pool sized so the flood never forces preemption: the gate
+        # must price ADMISSION ORDER alone, identically in both legs
+        eng = LLMEngine(params, cfg, num_slots=2, page_size=page_size,
+                        max_seq_len=max_seq, prefill_chunk_tokens=8,
+                        num_pages=3 * (max_seq // page_size),
+                        block_q=4, tenants=table)
+        eng.generate([[1, 2, 3]], max_new_tokens=2)  # warm executables
+        t_start = _time.monotonic()
+        bulk_kw = {"tenant": "bulk"} if tagged else {}
+        gold_kw = {"tenant": "gold"} if tagged else {}
+        bulk_h = [eng.submit(p, bulk_new, **bulk_kw)
+                  for p in bulk_prompts]
+        gold_h, done_t = [], {}
+        all_h = list(bulk_h)
+        steps = gi = 0
+        while steps < 50000:
+            if gi < n_gold and steps % gold_every == 0:
+                h = eng.submit(gold_prompts[gi], gold_new, **gold_kw)
+                gold_h.append(h)
+                all_h.append(h)
+                gi += 1
+            eng.step()
+            steps += 1
+            now = _time.monotonic()
+            for h in all_h:
+                if h.done() and id(h) not in done_t:
+                    done_t[id(h)] = now
+            if gi >= n_gold and all(h.done() for h in all_h):
+                break
+        elapsed = _time.monotonic() - t_start
+        snap = eng.stats_snapshot()
+        eng.shutdown()
+        ttfts = [h.t_first_token - h.t_submit for h in gold_h
+                 if h.t_first_token is not None]
+        e2e = [(done_t[id(h)] - h.t_submit) / max(1, len(h.tokens))
+               for h in gold_h if id(h) in done_t and not h.error]
+        rates = {
+            "gold": sum(len(h.tokens) for h in gold_h
+                        if not h.error) / elapsed,
+            "bulk": sum(len(h.tokens) for h in bulk_h
+                        if not h.error) / elapsed,
+        }
+        fairness = None
+        if tagged:
+            # Jain over weight-normalized rates: x_t = rate_t / w_t;
+            # 1.0 means throughput split exactly as the weights demand
+            xs = [rates[t] / table[t]["weight"] for t in ("gold", "bulk")]
+            sq = sum(x * x for x in xs)
+            fairness = (sum(xs) ** 2) / (len(xs) * sq) if sq else None
+        return {
+            "gold_ttft_p99_ms":
+                round(float(np.percentile(ttfts, 99)) * 1e3, 3)
+                if ttfts else None,
+            "gold_e2e_per_token_p99_ms":
+                round(float(np.percentile(e2e, 99)) * 1e3, 3)
+                if e2e else None,
+            "tokens_per_sec": {t: round(v, 2) for t, v in rates.items()},
+            "steps": steps,
+            "preemptions": snap["preemptions"],
+            "completed": snap["completed"],
+            "fairness_index": round(fairness, 4) if fairness else None,
+        }
+
+    off = run_leg(None)
+    on = run_leg(QOS_ON)
+
+    def ratio(key):
+        a, b = on[key], off[key]
+        return round(a / b, 3) if a and b else None
+
+    return {
+        "workload": {"bulk": n_bulk, "bulk_new": bulk_new,
+                     "gold": n_gold, "gold_new": gold_new,
+                     "gold_every_steps": gold_every},
+        "qos_off": off,
+        "qos_on": on,
+        # acceptance gates: the paced high-priority tenant's tail
+        # latency with QoS on over the untagged-FIFO baseline (<= 0.8:
+        # priority admission must buy at least 20% under the flood)
+        "ttft_hipri_qos_on_vs_off": ratio("gold_ttft_p99_ms"),
+        "itl_hipri_qos_on_vs_off": ratio("gold_e2e_per_token_p99_ms"),
+        "fairness_index": on["fairness_index"],
+    }
+
+
 def bench_obs_overhead(dev, on_tpu):
     """extra.obs_overhead: what leaving the FULL observability layer on
     costs the decode hot path — span tracer enabled, per-request
@@ -1256,7 +1390,7 @@ def _sub_main(name: str) -> None:
           "ragged": bench_ragged, "specdec": bench_specdec,
           "prefix_reuse": bench_prefix_reuse,
           "obs_overhead": bench_obs_overhead,
-          "disagg": bench_disagg}[name]
+          "disagg": bench_disagg, "qos": bench_qos}[name]
     try:
         print(json.dumps(fn(dev, on_tpu)))
     except Exception as e:  # noqa: BLE001 — emit one parseable line anyway
@@ -1349,6 +1483,7 @@ def main():
     prefix_extra = _run_sub("prefix_reuse")
     obs_overhead_extra = _run_sub("obs_overhead")
     disagg_extra = _run_sub("disagg")
+    qos_extra = _run_sub("qos")
     graphlint_extra = _run_graphlint()
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
@@ -1412,6 +1547,12 @@ def main():
             # 3-mixed, plus warm-start TTFT promoting a demoted prefix
             # from the tiered host store vs a cold chunked prefill
             "disagg": disagg_extra,
+            # multi-tenant QoS A/B: paced high-priority tenant's p99
+            # TTFT and end-to-end per-token latency under a bulk-tenant
+            # flood, WFQ/priority admission on vs untagged FIFO (both
+            # gates <= 0.8), plus the weight-normalized Jain fairness
+            # index over per-tenant emitted tokens/sec
+            "qos": qos_extra,
             # Graph Doctor finding counts over the shipped models
             # (tools/graphlint.py --json; tracks lint drift across rounds)
             "graphlint": graphlint_extra,

@@ -27,8 +27,9 @@ __all__ = ["Config", "Predictor", "create_predictor", "InferTensor",
            "RequestCancelled", "DeadlineExceeded", "EngineStopped",
            "Router", "FleetHandle", "serve_fleet", "FleetQueueFull",
            "NoHealthyReplica", "ReplicaDied", "RetriesExhausted",
-           "RouterStopped", "EngineSupervisor", "faults",
-           "PrefillHandoff", "TieredPrefixStore", "KVHandoff"]
+           "RouterStopped", "EngineSupervisor", "BurnRateAutoscaler",
+           "faults", "PrefillHandoff", "TieredPrefixStore", "KVHandoff",
+           "TenantConfig", "QoSPolicy", "UnknownTenant"]
 
 
 def __getattr__(name):
@@ -46,9 +47,12 @@ def __getattr__(name):
                 "RouterStopped"):
         from . import router
         return getattr(router, name)
-    if name == "EngineSupervisor":
+    if name in ("EngineSupervisor", "BurnRateAutoscaler"):
         from . import supervisor
-        return supervisor.EngineSupervisor
+        return getattr(supervisor, name)
+    if name in ("TenantConfig", "QoSPolicy", "UnknownTenant"):
+        from . import qos
+        return getattr(qos, name)
     if name == "faults":
         import importlib
         return importlib.import_module(".faults", __name__)

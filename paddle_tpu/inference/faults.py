@@ -721,6 +721,69 @@ def check_invariants(engine, handles: Sequence = (), probe: bool = True,
                     f"/stats and /metrics drifted: {key}={snap[key]} vs "
                     f"llm_{key}_total={val}")
 
+    # per-tenant QoS identities: every untagged counter the engine keeps
+    # is the SUM of its per-tenant twins (each global inc carries a
+    # tenant inc at the same site, under the same lock), and the
+    # per-tenant queue-depth gauges must match a ground-truth recount of
+    # the WFQ queue — a tenant counter that drifts from the allocator
+    # truth would let a flooding tenant hide inside the aggregate
+    tenant_stats = getattr(engine, "_tenant_stats", None)
+    if tenant_stats is not None:
+        with engine._cv:
+            per_tenant = {t: dict(st) for t, st in tenant_stats.items()}
+            tsnap = dict(engine.stats)
+            tquiesced = not engine._pending and not engine._slots
+            depths_kept = (engine._pending.depths()
+                           if hasattr(engine._pending, "depths") else {})
+            recount: Dict[str, int] = {}
+            for req in engine._pending:
+                t = getattr(req, "tenant", "default")
+                recount[t] = recount.get(t, 0) + 1
+            pending_total = len(engine._pending)
+        if tquiesced:
+            for tkey, gkey in (("accepted", "accepted"),
+                               ("admitted", "admitted"),
+                               ("completed", "completed"),
+                               ("preempted", "preemptions"),
+                               ("emitted_tokens", "emitted_tokens")):
+                if gkey not in tsnap:
+                    continue
+                total = sum(st.get(tkey, 0)
+                            for st in per_tenant.values())
+                if total != tsnap[gkey]:
+                    violations.append(
+                        f"per-tenant identity broken: sum of tenant "
+                        f"{tkey}={total} != llm_{gkey}_total="
+                        f"{tsnap[gkey]} (a request was counted under "
+                        "the wrong tenant, or not at all)")
+        kept_nonzero = {t: d for t, d in depths_kept.items() if d}
+        if kept_nonzero != recount:
+            violations.append(
+                f"per-tenant queue depth drifted: WFQ bookkeeping says "
+                f"{kept_nonzero} but a recount of the pending queue "
+                f"says {recount}")
+        if sum(depths_kept.values()) != pending_total:
+            violations.append(
+                f"per-tenant queue depths sum to "
+                f"{sum(depths_kept.values())} but len(engine._pending)="
+                f"{pending_total}")
+        reg2 = getattr(engine, "metrics", None)
+        if reg2 is not None:
+            label_of = getattr(engine, "_tenant_label", lambda s: s)
+            for t in per_tenant:
+                g = reg2.get(f"llm_tenant_{label_of(t)}_queue_depth")
+                if g is None:
+                    violations.append(
+                        f"tenant {t!r} has counters but no queue-depth "
+                        "gauge")
+                    continue
+                v = g.value
+                truth = recount.get(t, 0)
+                if v != v or int(v) != int(truth):
+                    violations.append(
+                        f"tenant {t!r} queue-depth gauge={v} but ground "
+                        f"truth is {truth}")
+
     for i, h in enumerate(handles):
         if not h.done():
             violations.append(f"handle {i} never resolved")
@@ -813,13 +876,15 @@ def run_schedule(make_engine: Callable[[], object],
                  probe: bool = True, max_steps: int = 5000,
                  witness: bool = False) -> dict:
     """Build a fresh engine, install the schedule, submit the workload
-    ((prompt, max_new_tokens) pairs), drive to quiescence, and run the
-    invariant checker.  `witness=True` arms the LockWitness on the
-    engine's locks (order inversions and locks-across-dispatch become
-    invariant violations) and proves the schedule leaked no threads.
-    Returns the invariant report extended with the schedule, the faults
-    actually fired, and the final counters.  Raises InvariantViolation
-    on any leak."""
+    ((prompt, max_new_tokens) pairs, optionally (prompt, max_new_tokens,
+    submit_kwargs) triples — the kwargs dict passes through to
+    engine.submit, which is how tenant-labeled chaos schedules tag their
+    traffic), drive to quiescence, and run the invariant checker.
+    `witness=True` arms the LockWitness on the engine's locks (order
+    inversions and locks-across-dispatch become invariant violations)
+    and proves the schedule leaked no threads.  Returns the invariant
+    report extended with the schedule, the faults actually fired, and
+    the final counters.  Raises InvariantViolation on any leak."""
     before_threads = set(threading.enumerate())
     injector = FaultInjector(rules)
     engine = make_engine()
@@ -828,9 +893,11 @@ def run_schedule(make_engine: Callable[[], object],
         arm_witness(engine)
     handles = []
     rejected = 0
-    for prompt, max_new in requests:
+    for item in requests:
+        prompt, max_new = item[0], item[1]
+        kw = item[2] if len(item) > 2 else {}
         try:
-            handles.append(engine.submit(prompt, max_new))
+            handles.append(engine.submit(prompt, max_new, **kw))
         except (ValueError, RuntimeError):
             rejected += 1      # QueueFull / validation — resolved by refusal
     steps = drive(engine, handles, max_steps=max_steps)
@@ -1198,8 +1265,10 @@ def fleet_run_schedule(make_engine: Callable[[], object],
                        witness: bool = False) -> dict:
     """Build a fresh N-replica fleet (Router + EngineSupervisor over
     `make_engine`), install the per-replica and router-level schedules,
-    submit the workload, drive to quiescence, and run the fleet
-    invariant checker.  Rebuilt replicas come from the same factory,
+    submit the workload ((prompt, max_new) pairs, or triples whose third
+    element is a kwargs dict for Router.submit — tenant/priority-tagged
+    fleet schedules), drive to quiescence, and run the fleet invariant
+    checker.  Rebuilt replicas come from the same factory,
     fault-free.  `witness=True` arms ONE shared LockWitness across the
     router lock and every replica's locks (rebuilds included, via a
     wrapped factory) — its edge graph must span components to see an
@@ -1247,9 +1316,11 @@ def fleet_run_schedule(make_engine: Callable[[], object],
         w.wrap(router, "_lock", "Router._lock")
     handles, rejected = [], 0
     try:
-        for prompt, max_new in requests:
+        for item in requests:
+            prompt, max_new = item[0], item[1]
+            skw = item[2] if len(item) > 2 else {}
             try:
-                handles.append(router.submit(prompt, max_new))
+                handles.append(router.submit(prompt, max_new, **skw))
             except (FleetQueueFull, NoHealthyReplica, RouterStopped,
                     ValueError):
                 rejected += 1   # resolved by refusal, never accepted

@@ -98,6 +98,9 @@ class TieredPrefixStore:
         self._lock = threading.Lock()
         self._ram: "collections.OrderedDict[tuple, tuple]" = \
             collections.OrderedDict()       # key -> (k_page, v_page)
+        # key -> QoS tier (lower = more important); capacity eviction
+        # drains the least important tier first, LRU within a tier
+        self._tiers: dict = {}
         self._disk: dict = {}               # key -> npz path
         self._bytes = 0
         self._seq = 0
@@ -156,21 +159,27 @@ class TieredPrefixStore:
 
     # -- put / get ----------------------------------------------------------
 
-    def put(self, prefix, k_page, v_page) -> bool:
+    def put(self, prefix, k_page, v_page, tier: int = 1) -> bool:
         """Demote one page: cache its KV under the full token prefix
         ending at this page's last token.  Copies are taken (the caller
         may reuse its staging buffer).  Returns False when the entry
-        already exists (RAM or disk) — demotion is idempotent."""
+        already exists (RAM or disk) — demotion is idempotent (a
+        re-demotion still refreshes the entry's QoS tier toward the
+        MORE important claimant).  `tier` orders capacity eviction:
+        least important (highest number) spills/drops first."""
         key = tuple(int(t) for t in np.asarray(prefix).reshape(-1))
         k_page = np.array(k_page, copy=True)
         v_page = np.array(v_page, copy=True)
+        tier = int(tier)
         with self._lock:
             if key in self._ram:
                 self._ram.move_to_end(key)
+                self._tiers[key] = min(self._tiers.get(key, tier), tier)
                 return False
             if key in self._disk:
                 return False
             self._ram[key] = (k_page, v_page)
+            self._tiers[key] = tier
             self._bytes += k_page.nbytes + v_page.nbytes
             self.demoted_pages += 1
             self._enforce_capacity()
@@ -217,6 +226,7 @@ class TieredPrefixStore:
         """Drop every entry, RAM and disk."""
         with self._lock:
             self._ram.clear()
+            self._tiers.clear()
             self._bytes = 0
             for path in self._disk.values():
                 try:
@@ -228,12 +238,18 @@ class TieredPrefixStore:
     # -- internals ----------------------------------------------------------
 
     def _enforce_capacity(self) -> None:
-        """Under self._lock: spill (or drop) LRU RAM entries past
-        capacity_bytes."""
+        """Under self._lock: spill (or drop) RAM entries past
+        capacity_bytes — least important QoS tier first, LRU within a
+        tier (the OrderedDict runs oldest-touched first, so the first
+        key of the worst tier IS that tier's LRU entry)."""
         if self.capacity_bytes is None:
             return
         while self._bytes > self.capacity_bytes and self._ram:
-            key, (k_page, v_page) = self._ram.popitem(last=False)
+            worst = max(self._tiers.get(k, 1) for k in self._ram)
+            key = next(k for k in self._ram
+                       if self._tiers.get(k, 1) == worst)
+            k_page, v_page = self._ram.pop(key)
+            self._tiers.pop(key, None)
             self._bytes -= k_page.nbytes + v_page.nbytes
             if not self.spill_dir:
                 continue            # no disk tier: LRU entry is dropped

@@ -72,6 +72,7 @@ from __future__ import annotations
 import collections
 import collections.abc
 import functools
+import re
 import threading
 import time
 import warnings
@@ -84,6 +85,7 @@ import jax.numpy as jnp
 
 from . import kvstore as _kvstore
 from . import prefix as _prefix
+from . import qos as _qos
 from .. import kernels
 from ..models import generation
 from ..obs import metrics as obs_metrics
@@ -174,7 +176,8 @@ class _Request:
 
     def __init__(self, prompt, max_new_tokens: int, eos_id: Optional[int],
                  deadline: Optional[float] = None,
-                 req_id: Optional[str] = None, hop: int = 0):
+                 req_id: Optional[str] = None, hop: int = 0,
+                 tenant: str = _qos.DEFAULT_TENANT, priority: int = 1):
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
             raise ValueError("prompt must hold at least one token")
@@ -184,6 +187,12 @@ class _Request:
         self.eos_id = eos_id
         self.req_id = req_id or obs_reqtrace.new_request_id()
         self.hop = int(hop)
+        # QoS labels, resolved by submit() through the engine's policy:
+        # the tenant keys WFQ lanes / per-tenant counters, the EFFECTIVE
+        # priority tier (lower = more important) orders admission and
+        # the preemption/eviction ladder
+        self.tenant = str(tenant)
+        self.priority = int(priority)
         # may a prefill-class engine resolve this request at prefill_done
         # with a KV handoff instead of decoding?  Stamped by submit()
         self.allow_handoff = False
@@ -323,6 +332,9 @@ class _StatsDict(collections.abc.MutableMapping):
         "spec_emitted": "tokens emitted by verify spans (accepted drafts "
                         "+ the bonus/correction, minus any cut by "
                         "eos/max_new_tokens)",
+        "emitted_tokens": "tokens appended to request streams (decode + "
+                          "verify emissions; the per-tenant twins must "
+                          "sum to this)",
         "preemptions": "victims evicted under page pressure",
         "swapped_in": "preempted requests resumed via host-KV scatter",
         "swap_out_pages": "KV pages gathered to host RAM at preemption",
@@ -494,7 +506,8 @@ class LLMEngine:
                  watchdog: Optional[obs_watchdog.Watchdog] = None,
                  fused_decode: bool = True,
                  role: str = "mixed",
-                 kvstore=None):
+                 kvstore=None,
+                 tenants=None):
         self.params = params
         self.config = config
         self.temperature, self.top_k, self.top_p = temperature, top_k, top_p
@@ -524,6 +537,14 @@ class LLMEngine:
             raise ValueError(f"unknown role {role!r}")
         self.role = role
         self.max_pending = None if max_pending is None else int(max_pending)
+        # multi-tenant QoS: the tenant table + resolution rules
+        # (inference/qos.py).  tenants=None builds the implicit
+        # single-"default"-tenant policy — FIFO-equivalent, zero cost;
+        # an explicit table turns admission into weighted-fair queueing
+        # with per-tenant caps and makes the preemption/eviction ladder
+        # priority-aware.  Tenancy is entirely host-side scheduling: no
+        # compiled program ever sees a tenant label.
+        self.qos = _qos.QoSPolicy.build(tenants)
         self.faults = faults
         self.prefill_chunk_tokens = int(prefill_chunk_tokens)
         if self.prefill_chunk_tokens < 1:
@@ -573,7 +594,11 @@ class LLMEngine:
         self._kv_imports: collections.deque = collections.deque()
         if kvstore is not None:
             self.attach_kvstore(kvstore)
-        self._pending: collections.deque = collections.deque()
+        # the pending queue: weighted-fair per-tenant lanes behind the
+        # same deque API the checkers/cancellation path consume.  With
+        # the implicit single-tenant policy its behaviour is exactly the
+        # FIFO deque it replaced.
+        self._pending: _qos.WFQQueue = _qos.WFQQueue(self.qos)
         # threadlint: owned=_loop — the slot table is step-thread-owned,
         # mutated lock-free on the hot path; shutdown() touches it only
         # AFTER joining the step thread (line-acknowledged there)
@@ -609,7 +634,8 @@ class LLMEngine:
             "prefill_chunks", "prefill_tokens",
             "ragged_batch_tokens", "verify_tokens", "spec_steps",
             "spec_drafted", "spec_accepted", "spec_rejected", "spec_bonus",
-            "spec_emitted", "preemptions", "swapped_in", "resumed",
+            "spec_emitted", "emitted_tokens",
+            "preemptions", "swapped_in", "resumed",
             "swap_out_pages", "swap_in_pages",
             "prefix_hits", "prefix_misses", "prefix_spliced_pages",
             "prefix_cow_copies", "prefix_evictions",
@@ -669,6 +695,17 @@ class LLMEngine:
             objectives=(slo_objectives if slo_objectives is not None
                         else obs_slo.DEFAULT_OBJECTIVES),
             window_s=slo_window_s).register(reg)
+        # per-tenant accounting: counters, queue-depth gauge, and an SLO
+        # engine (objectives cloned under tenant_<t>_* names so burn
+        # rates per tenant render on /metrics next to the engine-wide
+        # ones).  Explicit tenant tables materialize eagerly so their
+        # gauges exist before traffic; auto-vivified labels materialize
+        # on first submit.
+        self._slo_window_s = float(slo_window_s)
+        self._tenant_stats: dict = {}
+        self._tenant_slo: dict = {}
+        for _t in self.qos.tenants():
+            self._tenant_state(_t)
         # per-step phase attribution + the anomaly watchdog feeding on
         # it: both default-armed (bench extra.obs_overhead pins the
         # whole layer, profiler + pool telemetry + watchdog, < 2% of
@@ -891,7 +928,9 @@ class LLMEngine:
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
                req_id: Optional[str] = None, hop: int = 0,
-               handoff: Optional[bool] = None) -> _Request:
+               handoff: Optional[bool] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None) -> _Request:
         """Queue a request.  deadline: seconds from now; once expired the
         request resolves with DeadlineExceeded at the next step() boundary,
         whether still queued or mid-decode.  Raises QueueFull when the
@@ -904,9 +943,16 @@ class LLMEngine:
         to True iff this engine's role is "prefill"; a Router passes
         False when re-placing a handoff's decode continuation (and for
         canaries), so a continuation landing on a prefill-class replica
-        decodes locally instead of ping-ponging forever."""
+        decodes locally instead of ping-ponging forever.
+        tenant/priority: QoS labels.  The tenant keys a WFQ lane,
+        per-tenant counters/SLOs and (when its config sets one) a
+        per-tenant queue cap; an unknown tenant under an explicit table
+        raises qos.UnknownTenant (a ValueError).  priority is clamped to
+        max(request, tenant tier) — lower number = more important."""
+        tname, eff_priority, tcfg = self.qos.resolve(tenant, priority)
         req = _Request(prompt, max_new_tokens, eos_id, deadline=deadline,
-                       req_id=req_id, hop=hop)
+                       req_id=req_id, hop=hop, tenant=tname,
+                       priority=eff_priority)
         req.allow_handoff = (self.role == "prefill") if handoff is None \
             else bool(handoff)
         total = req.prompt.size + req.max_new_tokens
@@ -935,19 +981,33 @@ class LLMEngine:
                     "until a supervisor rebuilds it")
             if (self.max_pending is not None
                     and len(self._pending) >= self.max_pending):
-                self._rq_event(req, "reject", reason="queue_full")
+                self._rq_event(req, "reject", reason="queue_full",
+                               tenant=tname)
                 raise QueueFull(
                     f"pending queue is full ({self.max_pending} requests)",
                     retry_after=1.0)
+            tstats = self._tenant_state(tname)
+            if (tcfg.max_pending is not None
+                    and self._pending.depth(tname) >= tcfg.max_pending):
+                # per-tenant verdict: ONE flooding tenant hits its own
+                # cap while everyone else keeps submitting
+                tstats.inc("rejected_queue_full")
+                self._rq_event(req, "reject", reason="tenant_queue_full",
+                               tenant=tname)
+                raise QueueFull(
+                    f"tenant {tname!r} pending queue is full "
+                    f"({tcfg.max_pending} requests)", retry_after=1.0)
             req._engine = self
             self._pending.append(req)
             # every accepted request ends in EXACTLY one terminal counter
             # (completed/cancelled/timed_out/failed) — the registry
             # identity faults.check_invariants asserts
             self.stats["accepted"] += 1
+            tstats.inc("accepted")
             self._rq_event(req, "submit", prompt_tokens=int(req.prompt.size),
                            max_new_tokens=req.max_new_tokens,
-                           queue_depth=len(self._pending))
+                           queue_depth=len(self._pending),
+                           tenant=tname, priority=eff_priority)
             self._cv.notify()
         return req
 
@@ -998,6 +1058,7 @@ class LLMEngine:
         snap["role"] = self.role
         snap["kvstore"] = (None if self.kvstore is None
                            else self.kvstore.snapshot())
+        snap["tenants"] = self.tenant_snapshot()
         return snap
 
     def prefix_snapshot(self) -> dict:
@@ -1022,6 +1083,92 @@ class LLMEngine:
             "promoted_pages": self.stats["kv_promoted_pages"],
             "demoted_pages": self.stats["kv_demoted_pages"],
         }
+
+    # -- multi-tenant QoS surface -------------------------------------------
+
+    _TENANT_STAT_KEYS = ("accepted", "admitted", "completed",
+                         "preempted", "emitted_tokens",
+                         "rejected_queue_full")
+    _TENANT_STAT_HELP = {
+        "accepted": "requests this tenant got past submit()",
+        "admitted": "fresh admissions of this tenant into a slot",
+        "completed": "this tenant's requests finished with tokens",
+        "preempted": "this tenant's slots evicted under page pressure",
+        "emitted_tokens": "tokens appended to this tenant's streams",
+        "rejected_queue_full": "submits refused by this tenant's "
+                               "queue cap",
+    }
+
+    @staticmethod
+    def _tenant_label(name: str) -> str:
+        """Metric-name-safe tenant slug (labels arrive from HTTP)."""
+        return re.sub(r"[^A-Za-z0-9_]", "_", str(name))
+
+    def _tenant_state(self, name: str) -> _StatsDict:
+        """This tenant's counter dict, creating its counters, queue-depth
+        gauge, and per-tenant SLO engine on first sight.  Safe from any
+        thread: the registry serializes metric creation, and a racing
+        double-create just wins with one of two identical objects."""
+        st = self._tenant_stats.get(name)
+        if st is not None:
+            return st
+        reg = self.metrics
+        label = self._tenant_label(name)
+        st = _StatsDict(reg, self._TENANT_STAT_KEYS,
+                        prefix=f"llm_tenant_{label}",
+                        help=self._TENANT_STAT_HELP)
+        reg.gauge(f"llm_tenant_{label}_queue_depth",
+                  f"pending requests of tenant {name!r}").set_function(
+            lambda t=name: self._pending.depth(t))
+        # clone the engine's objectives under tenant-scoped names so one
+        # registry carries every tenant's burn-rate gauges side by side
+        objs = tuple(obs_slo.Objective(
+            o.metric, o.q, o.threshold_s,
+            name=f"tenant_{label}_{o.name}") for o in self.slo.objectives)
+        self._tenant_slo[name] = obs_slo.SLOEngine(
+            objectives=objs, window_s=self._slo_window_s).register(reg)
+        self._tenant_stats[name] = st
+        return st
+
+    def _tenant_slo_observe(self, tenant: str, metric: str, value: float,
+                            t=None) -> None:
+        slo = self._tenant_slo.get(tenant)
+        if slo is not None:
+            slo.observe(metric, value, t=t)
+
+    def tenant_snapshot(self) -> dict:
+        """The per-tenant section of /stats: config, live queue depth,
+        counters, and the tenant-scoped SLO report."""
+        out: dict = {}
+        for name in list(self._tenant_stats):
+            cfg = self.qos.get(name)
+            slo = self._tenant_slo.get(name)
+            out[name] = {
+                "priority": cfg.priority,
+                "weight": cfg.weight,
+                "max_pending": cfg.max_pending,
+                "queue_depth": self._pending.depth(name),
+                "counters": dict(self._tenant_stats[name]),
+                "slo": {} if slo is None else slo.report(),
+            }
+        return out
+
+    def tenant_burn_rates(self, max_priority: Optional[int] = None
+                          ) -> dict:
+        """{tenant: max burn rate across its objectives} over the
+        rolling SLO window — the autoscaler's control signal.  With
+        max_priority set, only tenants AT LEAST that important (tier
+        number <= max_priority) are reported."""
+        out: dict = {}
+        for name, slo in list(self._tenant_slo.items()):
+            if max_priority is not None \
+                    and self.qos.get(name).priority > max_priority:
+                continue
+            rep = slo.report()
+            out[name] = max(
+                (o["burn_rate"] for o in rep["objectives"].values()),
+                default=0.0)
+        return out
 
     def state_digest(self) -> dict:
         """A compact, JSON-safe digest of live engine state — the
@@ -1446,11 +1593,20 @@ class LLMEngine:
         st.req._resolve(err)
 
     def _pick_victim(self) -> int:
+        """Preemption ladder: victims come from the LEAST important
+        priority tier first (higher tier number), and only within a tier
+        does the configured policy pick — so a flooding low-priority
+        tenant's slots absorb all the page pressure before any
+        high-priority slot is touched.  (Cached prefixes were already
+        reclaimed before this runs, lowest tier first — see
+        PrefixIndex.evict.)"""
         if self.victim_policy == "fewest_tokens":
             # least work lost; tie -> latest admitted
             return min(self._slots, key=lambda s: (
+                -self._slots[s].req.priority,
                 len(self._slots[s].req.tokens), -self._slots[s].admit_seq))
-        return max(self._slots, key=lambda s: self._slots[s].admit_seq)
+        return max(self._slots, key=lambda s: (
+            self._slots[s].req.priority, self._slots[s].admit_seq))
 
     def _preempt(self, slot: int) -> None:
         """Release a victim's pages and re-queue it at the HEAD of the
@@ -1514,6 +1670,7 @@ class LLMEngine:
         with self._cv:
             self._pending.appendleft(st.req)
             self.stats["preemptions"] += 1
+            self._tenant_state(st.req.tenant).inc("preempted")
 
     def _admit(self) -> bool:
         """Move pending requests into free slots.  Admission itself
@@ -1561,6 +1718,8 @@ class LLMEngine:
                             wait = req.t_admit - req.t_submit
                             self._h_queue_wait.observe(wait)
                             self.slo.observe("queue_wait", wait)
+                            self._tenant_slo_observe(
+                                req.tenant, "queue_wait", wait)
                         # prefix-hit admission: splice the cached pages
                         # and start ctx past them — the next ragged
                         # batches chunk-prefill only the unshared suffix
@@ -1571,8 +1730,11 @@ class LLMEngine:
                             spec_k=self.spec_k)
                         with self._cv:
                             self.stats["admitted"] += 1
+                            self._tenant_state(req.tenant).inc("admitted")
                         self._rq_event(req, "admit", slot=slot,
-                                       prefix_tokens=ctx0)
+                                       prefix_tokens=ctx0,
+                                       tenant=req.tenant,
+                                       priority=req.priority)
             except Exception as e:  # noqa: BLE001 — admission must not leak
                 # the request left _pending but never (or only briefly)
                 # reached _slots: without cleanup the slot and its pages
@@ -1750,7 +1912,8 @@ class LLMEngine:
         n_full = st.ctx - st.ctx % ps
         if n_full:
             idx.insert(st.pending, n_full,
-                       self.cache._slot_pages[slot][:n_full // ps])
+                       self.cache._slot_pages[slot][:n_full // ps],
+                       tier=st.req.priority)
 
     # -- disaggregation & the tiered prefix store ---------------------------
 
@@ -1801,7 +1964,8 @@ class LLMEngine:
             # 1-D stubs, stored as-is)
             k_page = hk[:, 0] if hk.ndim > 1 else hk
             v_page = hv[:, 0] if hv.ndim > 1 else hv
-            if store.put(prefix_full, k_page, v_page):
+            if store.put(prefix_full, k_page, v_page,
+                         tier=getattr(node, "tier", 1)):
                 with self._cv:
                     self.stats["kv_demoted_pages"] += 1
 
@@ -2434,16 +2598,21 @@ class LLMEngine:
         """Append tokens to the request (same timestamp: they arrived in
         one step), finishing at eos/max_new_tokens — any remaining
         tokens are dropped.  Returns (finished, n_appended)."""
+        tstats = self._tenant_state(st.req.tenant)
         for j, tok in enumerate(toks):
             st.req.tokens.append(int(tok))
             if st.req.t_first_token is None:
                 st.req.t_first_token = now
                 self._h_ttft.observe(now - st.req.t_submit)
                 self.slo.observe("ttft", now - st.req.t_submit, t=now)
+                self._tenant_slo_observe(st.req.tenant, "ttft",
+                                         now - st.req.t_submit, t=now)
             elif st.req.t_last_token is not None:
                 self._h_itl.observe(now - st.req.t_last_token)
                 self.slo.observe("inter_token",
                                  now - st.req.t_last_token, t=now)
+                self._tenant_slo_observe(st.req.tenant, "inter_token",
+                                         now - st.req.t_last_token, t=now)
                 # only the FIRST gap of a multi-token span feeds the
                 # watchdog: the rest share `now` and their 0.0 gaps
                 # would drive the ITL baseline median to zero,
@@ -2454,9 +2623,16 @@ class LLMEngine:
             st.req.t_last_token = now
             if (st.req.eos_id is not None and tok == st.req.eos_id) \
                     or len(st.req.tokens) >= st.req.max_new_tokens:
+                # the tagged/untagged emission counters move together so
+                # the per-tenant identity (sum of tenant emitted ==
+                # llm_emitted_tokens) holds at every quiescent point
+                self.stats.inc("emitted_tokens", j + 1)
+                tstats.inc("emitted_tokens", j + 1)
                 del self._slots[slot]
                 self._finish(slot, st.req)
                 return True, j + 1
+        self.stats.inc("emitted_tokens", len(toks))
+        tstats.inc("emitted_tokens", len(toks))
         return False, len(toks)
 
     def _fail_inflight(self, e: BaseException) -> None:
@@ -2474,10 +2650,12 @@ class LLMEngine:
             pages = self.cache._slot_pages[slot]
             need = self.cache.pages_needed(req.prompt.size)
             if 0 < need <= len(pages):
-                idx.insert(req.prompt, req.prompt.size, pages[:need])
+                idx.insert(req.prompt, req.prompt.size, pages[:need],
+                           tier=req.priority)
         self.cache.release_slot(slot)
         with self._cv:
             self.stats["completed"] += 1
+            self._tenant_state(req.tenant).inc("completed")
         if req.t_admit is not None and req.tokens:
             dur = time.monotonic() - req.t_admit
             if dur > 0:
@@ -2567,6 +2745,13 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
             else:
                 self._reply(404, {"error": "unknown path"})
 
+        # the POST contract is a closed schema: an unrecognized field is
+        # a 400 with a typed error, not a silent drop — a client that
+        # misspells "tenant" must not silently run as the default tenant
+        _POST_FIELDS = frozenset((
+            "prompt", "max_new_tokens", "eos_id", "deadline",
+            "request_id", "tenant", "priority"))
+
         def do_POST(self):
             try:
                 n = int(self.headers.get("Content-Length", "0"))
@@ -2575,6 +2760,21 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
                     return
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        self._reply(400, {
+                            "error": "bad_body",
+                            "detail": "request body must be a JSON "
+                                      "object"})
+                        return
+                    unknown = sorted(set(req) - self._POST_FIELDS)
+                    if unknown:
+                        self._reply(400, {
+                            "error": "unknown_field",
+                            "fields": unknown,
+                            "detail": f"unrecognized field(s) "
+                                      f"{unknown}; allowed: "
+                                      f"{sorted(self._POST_FIELDS)}"})
+                        return
                     prompt = req["prompt"]
                     max_new = int(req.get("max_new_tokens", 16))
                     eos_id = req.get("eos_id")
@@ -2582,18 +2782,29 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
                     req_id = req.get("request_id")
                     if req_id is not None:
                         req_id = str(req_id)
+                    tenant = req.get("tenant")
+                    priority = req.get("priority")
                 except (json.JSONDecodeError, KeyError, TypeError,
                         ValueError) as e:
-                    self._reply(400, {"error": f"bad request body: {e!r}"})
+                    self._reply(400, {"error": "bad_body",
+                                      "detail": f"bad request body: "
+                                                f"{e!r}"})
                     return
                 try:
                     handle = engine.submit(prompt, max_new, eos_id,
                                            deadline=deadline,
-                                           req_id=req_id)
+                                           req_id=req_id,
+                                           tenant=tenant,
+                                           priority=priority)
                 except QueueFull as e:
                     retry = max(1, int(-(-e.retry_after // 1)))
                     self._reply(503, {"error": str(e)},
                                 headers={"Retry-After": str(retry)})
+                    return
+                except _qos.UnknownTenant as e:
+                    self._reply(400, {"error": "unknown_tenant",
+                                      "tenant": e.tenant,
+                                      "detail": str(e)})
                     return
                 except (ValueError, RuntimeError) as e:
                     self._reply(400, {"error": str(e)})
@@ -2609,8 +2820,13 @@ def serve_llm(engine: LLMEngine, host: str = "127.0.0.1", port: int = 0,
                 except RequestCancelled as e:
                     self._reply(409, {"error": str(e)})
                     return
+                # the RESOLVED labels echo back (tenant defaulting and
+                # priority clamping happened in submit), matching the
+                # submit event on the request's /debug timeline
                 self._reply(200, {"tokens": toks,
-                                  "request_id": handle.req_id})
+                                  "request_id": handle.req_id,
+                                  "tenant": handle.tenant,
+                                  "priority": handle.priority})
             except Exception as e:  # noqa: BLE001 — server-side fault
                 self._reply(500, {"error": repr(e)})
 

@@ -50,17 +50,21 @@ __all__ = ["PrefixIndex"]
 class _Node:
     """One cached page: `tokens` (the ids cached in it, oldest first),
     `page` (its pool page id), children keyed by their full token tuple,
-    and an LRU clock stamp."""
+    an LRU clock stamp, and a QoS `tier` (the lowest priority number —
+    i.e. the MOST important tenant — that ever cached or re-cached this
+    prefix; eviction drains high-number tiers first)."""
 
-    __slots__ = ("tokens", "page", "children", "parent", "last_used")
+    __slots__ = ("tokens", "page", "children", "parent", "last_used",
+                 "tier")
 
     def __init__(self, tokens: tuple, page: int,
-                 parent: Optional["_Node"]):
+                 parent: Optional["_Node"], tier: int = 1):
         self.tokens = tokens
         self.page = int(page)
         self.children: dict = {}
         self.parent = parent
         self.last_used = 0
+        self.tier = int(tier)
 
     @property
     def n_tokens(self) -> int:
@@ -165,15 +169,20 @@ class PrefixIndex:
             children = best.children
         return matched, pages
 
-    def insert(self, tokens, n_tokens: int, pages: Sequence[int]) -> int:
+    def insert(self, tokens, n_tokens: int, pages: Sequence[int],
+               tier: int = 1) -> int:
         """Register a freshly prefilled prefix: `tokens[:n_tokens]` is
         cached in `pages` (page i holds tokens [i*ps, (i+1)*ps)).  Walks
         the tree creating nodes for uncached pages (taking one refcount
         each), dedupes against existing ones, and upgrades a partial node
         when this insert extends it.  Returns the number of pages newly
-        referenced by the index."""
+        referenced by the index.  `tier` is the inserting request's QoS
+        priority (lower = more important); a node shared across tiers
+        keeps its MOST important one, so a prefix a premium tenant also
+        uses never evicts on a flooding tenant's ladder rung."""
         toks = [int(t) for t in np.asarray(tokens).reshape(-1)]
         n_tokens = min(int(n_tokens), len(toks))
+        tier = int(tier)
         children = self._root
         parent = None
         added = 0
@@ -213,12 +222,14 @@ class PrefixIndex:
                         for c in children.values())
                     if covered:
                         break
-                    node = _Node(chunk, page, parent)
+                    node = _Node(chunk, page, parent, tier=tier)
                     self._cache.add_ref(page)
                     children[chunk] = node
                     self._by_page[int(page)] = node
                     added += 1
             node.last_used = now
+            # shared across tiers: keep the most important claimant
+            node.tier = min(node.tier, tier)
             if node.n_tokens < self.page_size:
                 break               # partial tail: nothing hangs below it
             children = node.children
@@ -262,20 +273,25 @@ class PrefixIndex:
         return out
 
     def evict(self, n_pages: int) -> int:
-        """LRU-evict unreferenced cached prefixes until `n_pages` pages
-        returned to the free pool (or nothing evictable remains).  Only
+        """Tier-then-LRU evict unreferenced cached prefixes until
+        `n_pages` pages returned to the free pool (or nothing evictable
+        remains).  The eviction ladder drains the LEAST important QoS
+        tier first (highest tier number — see _Node.tier), and only
+        within a tier falls back to LRU — a premium tenant's warm
+        prefixes survive a flooding tenant's page pressure.  Only
         leaves whose page the index holds EXCLUSIVELY (refcount 1) are
         candidates — a prefix a live slot still reads is never evicted;
         dropping a leaf may expose its parent next (pushed onto the
         candidate heap, so one call scans the index ONCE rather than
         once per freed page — this runs on the admission hot path).
         Returns pages actually freed to the pool."""
-        heap = [(n.last_used, n.page, n) for n in self._by_page.values()
+        heap = [(-n.tier, n.last_used, n.page, n)
+                for n in self._by_page.values()
                 if not n.children and self._cache.refcount(n.page) == 1]
         heapq.heapify(heap)
         freed = 0
         while heap and freed < n_pages:
-            _, _, node = heapq.heappop(heap)
+            _, _, _, node = heapq.heappop(heap)
             if self._by_page.get(node.page) is not node or node.children \
                     or self._cache.refcount(node.page) != 1:
                 continue            # stale heap entry
@@ -286,7 +302,8 @@ class PrefixIndex:
                     and self._by_page.get(parent.page) is parent \
                     and self._cache.refcount(parent.page) == 1:
                 heapq.heappush(
-                    heap, (parent.last_used, parent.page, parent))
+                    heap, (-parent.tier, parent.last_used,
+                           parent.page, parent))
         return freed
 
     def evict_subtree_holding(self, page: int) -> int:

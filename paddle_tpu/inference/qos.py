@@ -1,0 +1,367 @@
+"""Multi-tenant QoS: tenant config, weighted-fair admission, priority tiers.
+
+Production traffic is not one queue.  A single flooding client on a FIFO
+engine degrades every other client's p99 identically; the fix is to make
+every contended resource *priority-aware* while keeping tenancy entirely
+OUTSIDE the compiled programs (the fixed-shape ragged dispatch never sees
+a tenant label — all of this is host-side scheduling).
+
+Three pieces live here:
+
+``TenantConfig``
+    One tenant's share of the engine: a WFQ ``weight`` (relative service
+    share among same-priority tenants), a ``priority`` tier (LOWER number
+    = MORE important; tier 0 preempts tier 1 work under pressure), and an
+    optional per-tenant ``max_pending`` queue cap so ``QueueFull`` is a
+    per-tenant verdict rather than a fleet-wide one.
+
+``QoSPolicy``
+    The tenant table plus resolution rules.  Explicitly configured
+    policies are STRICT: an unknown tenant label raises ``UnknownTenant``
+    (a ``ValueError``, so the serve paths map it to HTTP 400).  The
+    default policy (engine built with ``tenants=None``) auto-vivifies a
+    config per new label so single-tenant deployments pay nothing.  A
+    request may ask for a priority, but it is clamped to
+    ``max(request_priority, tenant.priority)`` — a tenant cannot claim
+    more importance than its table row grants.
+
+``WFQQueue``
+    The engine's pending queue: per-tenant FIFO deques selected by
+    (priority tier asc, virtual time asc).  Each tenant's virtual time
+    advances by ``cost / weight`` when one of its requests is admitted
+    (cost = prompt tokens + max_new_tokens — the work the request can
+    consume), so a 2x-weight tenant drains twice the tokens per unit of
+    virtual time.  A tenant going from idle to active has its clock
+    jumped forward to the minimum active virtual time so it cannot bank
+    service while idle and then starve everyone with the accumulated
+    credit.  The class is deque-API compatible (``append``,
+    ``appendleft``, ``popleft``, ``remove``, ``clear``, ``[0]`` peek,
+    iteration, ``len``/``bool``) because the engine's invariant checkers
+    and cancellation path treat ``engine._pending`` as a deque.
+    ``appendleft`` feeds a separate RESUME lane with absolute precedence:
+    preempted requests already paid their queueing (and their virtual
+    time) once, so they re-enter at the head regardless of tenant clocks.
+
+threadlint: every mutating method on ``WFQQueue`` must be called under
+``LLMEngine._cv`` — the class adds no lock of its own, exactly like the
+deque it replaces.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+from typing import Dict, Iterable, Optional
+
+__all__ = [
+    "DEFAULT_TENANT",
+    "TenantConfig",
+    "UnknownTenant",
+    "QoSPolicy",
+    "WFQQueue",
+]
+
+DEFAULT_TENANT = "default"
+
+
+class UnknownTenant(ValueError):
+    """A request named a tenant the strict policy has no row for.
+
+    Subclasses ``ValueError`` so the HTTP serve paths map it to a 400
+    without a dedicated handler."""
+
+    def __init__(self, tenant: str):
+        super().__init__(f"unknown tenant {tenant!r}")
+        self.tenant = str(tenant)
+
+
+class TenantConfig:
+    """One tenant's QoS row (see module doc).  ``priority`` is a tier:
+    lower number = more important.  ``weight`` must be positive;
+    ``max_pending`` of None defers to the engine-wide cap."""
+
+    __slots__ = ("name", "weight", "priority", "max_pending")
+
+    def __init__(self, name: str, weight: float = 1.0, priority: int = 1,
+                 max_pending: Optional[int] = None):
+        self.name = str(name)
+        if not self.name:
+            raise ValueError("tenant name must be non-empty")
+        self.weight = float(weight)
+        if not math.isfinite(self.weight) or self.weight <= 0.0:
+            raise ValueError(
+                f"tenant {name!r}: weight must be finite and > 0, "
+                f"got {weight!r}")
+        self.priority = int(priority)
+        if self.priority < 0:
+            raise ValueError(
+                f"tenant {name!r}: priority must be >= 0, got {priority!r}")
+        if max_pending is not None:
+            max_pending = int(max_pending)
+            if max_pending < 1:
+                raise ValueError(
+                    f"tenant {name!r}: max_pending must be >= 1, "
+                    f"got {max_pending!r}")
+        self.max_pending = max_pending
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return (f"TenantConfig({self.name!r}, weight={self.weight}, "
+                f"priority={self.priority}, max_pending={self.max_pending})")
+
+
+class QoSPolicy:
+    """Tenant table + label resolution (see module doc)."""
+
+    def __init__(self, tenants: Optional[Iterable[TenantConfig]] = None,
+                 strict: Optional[bool] = None):
+        self._tenants: Dict[str, TenantConfig] = {}
+        explicit = tenants is not None
+        for cfg in (tenants or ()):
+            if not isinstance(cfg, TenantConfig):
+                raise TypeError(
+                    f"tenants must be TenantConfig instances, got {cfg!r}")
+            if cfg.name in self._tenants:
+                raise ValueError(f"duplicate tenant {cfg.name!r}")
+            self._tenants[cfg.name] = cfg
+        # Explicit tables are strict: a label outside the table is a
+        # client error, not an invitation to mint a row.  The implicit
+        # single-tenant policy auto-vivifies instead.
+        self.strict = bool(strict) if strict is not None else explicit
+        # The default tenant ALWAYS exists, strict or not: untagged
+        # traffic (router canaries, invariant probes, legacy clients)
+        # resolves to it — strictness rejects unknown NAMED tenants, it
+        # must not reject the absence of a name.  An explicit table may
+        # still override the default row's weight/priority/cap.
+        if DEFAULT_TENANT not in self._tenants:
+            self._tenants[DEFAULT_TENANT] = TenantConfig(DEFAULT_TENANT)
+
+    @classmethod
+    def build(cls, spec) -> "QoSPolicy":
+        """Coerce the engine's ``tenants=`` kwarg: an existing policy, an
+        iterable of ``TenantConfig``, a ``{name: dict-of-kwargs}``
+        mapping, or None (implicit single-tenant)."""
+        if spec is None:
+            return cls()
+        if isinstance(spec, QoSPolicy):
+            return spec
+        if isinstance(spec, dict):
+            rows = []
+            for name, kw in spec.items():
+                if isinstance(kw, TenantConfig):
+                    rows.append(kw)
+                else:
+                    rows.append(TenantConfig(name, **dict(kw or {})))
+            return cls(rows)
+        return cls(list(spec))
+
+    def tenants(self) -> Dict[str, TenantConfig]:
+        return dict(self._tenants)
+
+    def get(self, name: str) -> TenantConfig:
+        cfg = self._tenants.get(str(name))
+        if cfg is None:
+            if self.strict:
+                raise UnknownTenant(str(name))
+            cfg = TenantConfig(str(name))
+            self._tenants[str(name)] = cfg
+        return cfg
+
+    def resolve(self, tenant, priority):
+        """Resolve a request's (tenant, priority) labels to
+        ``(name, effective_priority, TenantConfig)``.
+
+        ``None`` tenant maps to the default label.  A request priority is
+        clamped to ``max(request, tenant.priority)`` — requests can make
+        themselves LESS important than their tenant tier, never more."""
+        name = DEFAULT_TENANT if tenant is None else str(tenant)
+        if not name:
+            raise ValueError("tenant must be a non-empty string")
+        cfg = self.get(name)
+        if priority is None:
+            eff = cfg.priority
+        else:
+            try:
+                eff = int(priority)
+            except (TypeError, ValueError):
+                raise ValueError(
+                    f"priority must be an integer, got {priority!r}")
+            if eff < 0:
+                raise ValueError(f"priority must be >= 0, got {priority!r}")
+            eff = max(eff, cfg.priority)
+        return name, eff, cfg
+
+
+def _cost(req) -> int:
+    """Virtual-time cost of admitting one request: the tokens it can
+    consume (prompt prefill + generation budget)."""
+    try:
+        return max(1, int(req.prompt.size) + int(req.max_new_tokens))
+    except Exception:  # noqa: BLE001 - foreign request objects cost 1
+        return 1
+
+
+class WFQQueue:
+    """Weighted-fair pending queue, deque-API compatible (module doc).
+
+    threadlint: caller holds ``LLMEngine._cv`` for every method."""
+
+    def __init__(self, policy: Optional[QoSPolicy] = None):
+        self.policy = policy or QoSPolicy()
+        self._resume: collections.deque = collections.deque()
+        self._queues: Dict[str, collections.deque] = {}
+        self._vtime: Dict[str, float] = {}
+        self._resume_counts: Dict[str, int] = {}
+
+    # -- sizing / iteration (checker + digest surface) ----------------------
+
+    def __len__(self) -> int:
+        return len(self._resume) + sum(
+            len(q) for q in self._queues.values())
+
+    def __bool__(self) -> bool:
+        if self._resume:
+            return True
+        return any(self._queues.values())
+
+    def __iter__(self):
+        # Resume lane first (it pops first), then tenants in table order.
+        for r in self._resume:
+            yield r
+        for q in self._queues.values():
+            yield from q
+
+    def __getitem__(self, idx):
+        # The engine only ever peeks the head ([0]); it must agree with
+        # what the next popleft returns.
+        if idx != 0:
+            raise IndexError("WFQQueue supports head peek only")
+        head = self._peek()
+        if head is None:
+            raise IndexError("peek from an empty WFQQueue")
+        return head
+
+    # -- tenant bookkeeping --------------------------------------------------
+
+    def _tenant_of(self, req) -> str:
+        return getattr(req, "tenant", DEFAULT_TENANT) or DEFAULT_TENANT
+
+    def depth(self, tenant: str) -> int:
+        """Pending requests carrying this tenant label, resume lane
+        included (the per-tenant queue-depth gauge and cap check)."""
+        q = self._queues.get(tenant)
+        return (len(q) if q is not None else 0) \
+            + self._resume_counts.get(tenant, 0)
+
+    def depths(self) -> Dict[str, int]:
+        out = {t: len(q) for t, q in self._queues.items() if q}
+        for t, n in self._resume_counts.items():
+            if n:
+                out[t] = out.get(t, 0) + n
+        return out
+
+    def virtual_times(self) -> Dict[str, float]:
+        return dict(self._vtime)
+
+    # -- deque API -----------------------------------------------------------
+
+    def append(self, req) -> None:
+        t = self._tenant_of(req)
+        q = self._queues.get(t)
+        if q is None:
+            q = self._queues[t] = collections.deque()
+        if not q:
+            # Idle -> active: jump the clock forward to the minimum
+            # active virtual time so idle periods bank no credit.
+            active = [self._vtime[o] for o, oq in self._queues.items()
+                      if oq and o != t and o in self._vtime]
+            floor = min(active) if active else 0.0
+            self._vtime[t] = max(self._vtime.get(t, 0.0), floor)
+        q.append(req)
+
+    def appendleft(self, req) -> None:
+        # Preemption resume lane: already admitted once, already charged
+        # to its tenant's clock — absolute precedence, no re-billing.
+        t = self._tenant_of(req)
+        self._resume.appendleft(req)
+        self._resume_counts[t] = self._resume_counts.get(t, 0) + 1
+
+    def _select(self) -> Optional[str]:
+        """The tenant the next popleft serves: lowest priority tier
+        first (lower number = more important), then lowest virtual time,
+        then name for determinism."""
+        best = None
+        best_key = None
+        for t, q in self._queues.items():
+            if not q:
+                continue
+            head = q[0]
+            key = (int(getattr(head, "priority", 1)),
+                   self._vtime.get(t, 0.0), t)
+            if best_key is None or key < best_key:
+                best, best_key = t, key
+        return best
+
+    def _peek(self):
+        if self._resume:
+            return self._resume[0]
+        t = self._select()
+        return self._queues[t][0] if t is not None else None
+
+    def popleft(self):
+        if self._resume:
+            req = self._resume.popleft()
+            t = self._tenant_of(req)
+            n = self._resume_counts.get(t, 0) - 1
+            if n > 0:
+                self._resume_counts[t] = n
+            else:
+                self._resume_counts.pop(t, None)
+            return req
+        t = self._select()
+        if t is None:
+            raise IndexError("pop from an empty WFQQueue")
+        req = self._queues[t].popleft()
+        weight = self.policy.get(t).weight
+        self._vtime[t] = self._vtime.get(t, 0.0) + _cost(req) / weight
+        return req
+
+    def remove(self, req) -> None:
+        """Remove a specific request (cancellation path).  Raises
+        ``ValueError`` when absent, exactly like ``deque.remove`` —
+        ``_Request.cancel`` relies on that to fall back to slot-side
+        cancellation."""
+        try:
+            self._resume.remove(req)
+        except ValueError:
+            pass
+        else:
+            t = self._tenant_of(req)
+            n = self._resume_counts.get(t, 0) - 1
+            if n > 0:
+                self._resume_counts[t] = n
+            else:
+                self._resume_counts.pop(t, None)
+            return
+        t = self._tenant_of(req)
+        q = self._queues.get(t)
+        if q is not None:
+            try:
+                q.remove(req)
+                return
+            except ValueError:
+                pass
+        # Label drifted (foreign req object): scan every lane before
+        # declaring it absent.
+        for q in self._queues.values():
+            try:
+                q.remove(req)
+                return
+            except ValueError:
+                continue
+        raise ValueError("request not in pending queue")
+
+    def clear(self) -> None:
+        self._resume.clear()
+        self._resume_counts.clear()
+        for q in self._queues.values():
+            q.clear()

@@ -94,6 +94,7 @@ import time
 from typing import List, Optional, Sequence
 
 from . import faults as _faults
+from . import qos as _qos
 from .llm_engine import (DeadlineExceeded, EngineStopped, LLMEngine,
                          PrefillHandoff, QueueFull, RequestCancelled,
                          _StatsDict)
@@ -152,10 +153,16 @@ class FleetHandle:
     def __init__(self, router: "Router", prompt: Sequence[int],
                  max_new_tokens: int, eos_id: Optional[int],
                  deadline: Optional[float], max_hops: int,
-                 req_id: Optional[str] = None):
+                 req_id: Optional[str] = None,
+                 tenant: str = _qos.DEFAULT_TENANT, priority: int = 1):
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
         self.eos_id = eos_id
+        # multi-tenant QoS labels: resolved ONCE at fleet submission and
+        # carried on every hop, so a retry re-places under the same
+        # weight/tier as the original admission
+        self.tenant = str(tenant)
+        self.priority = int(priority)
         # the fleet trace context: every engine-level hop carries this
         # id (and its hop index), so the request's whole cross-replica
         # journey shares ONE timeline in the obs request registry
@@ -306,10 +313,13 @@ class Router:
     _STATS_KEYS = (
         "accepted", "rejected", "placed", "retries", "parked", "completed",
         "failed", "cancelled", "timed_out", "ejections", "reinstatements",
-        "canaries", "deaths", "rebuilds", "handoffs", "role_flips")
+        "canaries", "deaths", "rebuilds", "handoffs", "role_flips",
+        "autoscale_ups", "autoscale_downs")
     _STATS_HELP = {
         "handoffs": "prefill->decode KV handoffs brokered",
         "role_flips": "replica role flips under sustained load imbalance",
+        "autoscale_ups": "replicas spawned by the burn-rate autoscaler",
+        "autoscale_downs": "autoscaled replicas drained and released",
         "accepted": "fleet requests accepted (a FleetHandle exists)",
         "rejected": "fleet submits refused (backpressure / no replica)",
         "placed": "engine-level placements (hops), incl. retries",
@@ -337,7 +347,7 @@ class Router:
                  supervisor: Optional[EngineSupervisor] = None,
                  faults=None, max_hops: int = 3,
                  prefix_affinity: float = 0.5,
-                 roles=None, kvstore=None,
+                 roles=None, kvstore=None, autoscaler=None,
                  role_flip_ticks: int = 3, role_flip_ratio: float = 2.0,
                  health_interval: float = 0.05,
                  backoff_base: float = 0.1, backoff_max: float = 5.0,
@@ -390,6 +400,11 @@ class Router:
             for r in self.replicas:
                 if hasattr(r.engine, "attach_kvstore"):
                     r.engine.attach_kvstore(kvstore)
+        # burn-rate autoscaler (supervisor.BurnRateAutoscaler or any
+        # object with observe(router)): consulted once per health tick,
+        # AFTER probes/deaths so it sees post-recovery burn.  None = the
+        # fleet size is static.
+        self.autoscaler = autoscaler
         self._host_digest: tuple = ()       # kvstore root chunks, per tick
         self._tier_hits = {"device": 0, "host": 0}
         # role-flip hysteresis: flip only after `role_flip_ticks`
@@ -469,22 +484,40 @@ class Router:
                eos_id: Optional[int] = None,
                deadline: Optional[float] = None,
                max_hops: Optional[int] = None,
-               req_id: Optional[str] = None) -> FleetHandle:
+               req_id: Optional[str] = None,
+               tenant: Optional[str] = None,
+               priority: Optional[int] = None) -> FleetHandle:
         """Place a request on the least-loaded healthy replica.  Raises
         FleetQueueFull when EVERY healthy replica refuses (min
         Retry-After attached), NoHealthyReplica when rotation is empty,
         RouterStopped while draining, ValueError for requests no replica
-        could ever serve.  req_id: optional trace id (serve_fleet passes
-        the client's); the handle's `req_id` keys the request's
-        cross-replica timeline (`GET /debug/request/<id>`)."""
+        could ever serve — including a non-positive or non-finite
+        `deadline` (validated HERE, at submission: a deadline that could
+        never be met must fail typed at the front door, not burn a
+        placement only to be reaped in some engine's admission sweep).
+        tenant/priority: QoS labels resolved against the fleet's policy
+        (replica 0's table — one factory builds every replica, so the
+        tables agree) and carried across every hop and retry.  req_id:
+        optional trace id (serve_fleet passes the client's); the
+        handle's `req_id` keys the request's cross-replica timeline
+        (`GET /debug/request/<id>`)."""
         if self._stopping:
             raise RouterStopped("router is draining/stopped")
+        if deadline is not None:
+            d = float(deadline)
+            if not math.isfinite(d) or d <= 0.0:
+                raise ValueError(
+                    f"deadline must be a finite number of seconds > 0, "
+                    f"got {deadline!r}")
+        tname, eff_priority, _ = self._resolve_qos(tenant, priority)
         fh = FleetHandle(self, prompt, max_new_tokens, eos_id, deadline,
                          self.max_hops if max_hops is None else max_hops,
-                         req_id=req_id)
+                         req_id=req_id, tenant=tname,
+                         priority=eff_priority)
         self._rq_event(fh, "fleet_submit",
                        prompt_tokens=len(fh.prompt),
-                       max_new_tokens=fh.max_new_tokens)
+                       max_new_tokens=fh.max_new_tokens,
+                       tenant=fh.tenant, priority=fh.priority)
         t0 = time.monotonic()
         try:
             placed, retry_after, saw_queue_full = self._try_place(
@@ -505,6 +538,23 @@ class Router:
         self._rq_event(fh, "fleet_reject", reason="no_healthy_replica")
         raise NoHealthyReplica(
             "no healthy replica available (all ejected, dead, or dying)")
+
+    def _resolve_qos(self, tenant, priority):
+        """Resolve QoS labels against the fleet's tenant table: replica
+        0's engine policy (every replica comes from one factory, so the
+        tables agree).  UnknownTenant/ValueError propagate to submit()'s
+        caller BEFORE a FleetHandle exists — a mislabeled request never
+        burns a placement attempt."""
+        policy = None
+        with self._lock:       # register/release mutate the list live
+            replicas = list(self.replicas)
+        for r in replicas:
+            policy = getattr(r.engine, "qos", None)
+            if policy is not None:
+                break
+        if policy is None:
+            policy = _qos.QoSPolicy()
+        return policy.resolve(tenant, priority)
 
     def generate(self, prompts: Sequence[Sequence[int]],
                  max_new_tokens: int, eos_id: Optional[int] = None,
@@ -564,14 +614,17 @@ class Router:
         staleness."""
         idx = getattr(r.engine, "prefix_index", None)
         try:
-            self._prefix_digests[r.rid] = \
-                () if idx is None else idx.first_chunks()
+            digest = () if idx is None else idx.first_chunks()
         except Exception:  # noqa: BLE001 — raced a live step thread
-            pass
+            return
+        with self._lock:       # release() drops entries under the lock
+            self._prefix_digests[r.rid] = digest
 
     def _prefix_hit_rate(self) -> float:
         hits = total = 0
-        for r in self.replicas:
+        with self._lock:       # register/release mutate the list live
+            replicas = list(self.replicas)
+        for r in replicas:
             if r.dead:
                 continue
             try:
@@ -713,7 +766,8 @@ class Router:
                 hop = r.engine.submit(
                     fh.prompt, fh.max_new_tokens, fh.eos_id,
                     deadline=fh.remaining_deadline(),
-                    req_id=fh.req_id, hop=len(fh.hops), **kw)
+                    req_id=fh.req_id, hop=len(fh.hops),
+                    tenant=fh.tenant, priority=fh.priority, **kw)
             except QueueFull as e:
                 retry_after = (e.retry_after if retry_after is None
                                else min(retry_after, e.retry_after))
@@ -951,6 +1005,11 @@ class Router:
             except Exception:  # noqa: BLE001 — digest is advisory
                 pass
         self._maybe_flip_roles()
+        if self.autoscaler is not None and not self._stopping:
+            try:
+                self.autoscaler.observe(self)
+            except Exception:  # noqa: BLE001 — a broken control loop
+                pass           # must never take the health tick with it
         self._drain_parked()
 
     def _maybe_flip_roles(self) -> None:
@@ -965,7 +1024,9 @@ class Router:
         if self._stopping:
             return
         groups = {"prefill": [], "decode": []}
-        for r in self.replicas:
+        with self._lock:       # register/release mutate the list live
+            replicas = list(self.replicas)
+        for r in replicas:
             if r.dead or r.state != HEALTHY:
                 continue
             if r.role in groups:
@@ -1200,6 +1261,91 @@ class Router:
             r.ejected_until = now + self.backoff_base
             self.stats.inc("rebuilds")
 
+    # -- elastic fleet: autoscaler add/remove -------------------------------
+
+    def register(self, engine: LLMEngine) -> Replica:
+        """Add a NEW replica to the fleet at runtime (the autoscaler's
+        scale-up primitive; also a test hook).  The engine is stamped
+        exactly like a supervisor rebuild — replica name, fleet request
+        registry, shared kvstore — started when the fleet is threaded,
+        and enters rotation HEALTHY immediately: a freshly built engine
+        has nothing to prove to a canary (it never failed a probe), and
+        the whole point of scaling up is capacity NOW."""
+        with self._lock:
+            rid = 1 + max((r.rid for r in self.replicas), default=-1)
+            r = Replica(rid, engine)
+            engine.replica_name = str(rid)
+            engine.reqtrace = self.reqtrace
+            self.replicas.append(r)
+            self.stats.inc("autoscale_ups")
+        if self.kvstore is not None and hasattr(engine, "attach_kvstore"):
+            try:
+                engine.attach_kvstore(self.kvstore)
+            except Exception:  # noqa: BLE001 — page-size mismatch on a
+                pass           # heterogeneous spawn: skip, don't die
+        if self.threaded:
+            engine.start()
+        self._rq_event_fleet("autoscale_up", replica_id=rid)
+        return r
+
+    def release(self, rid: int, timeout: Optional[float] = None) -> bool:
+        """Remove replica `rid` from the fleet (the autoscaler's
+        scale-down primitive).  The replica leaves rotation immediately
+        (no new placements), in-flight hops get `timeout` seconds to
+        finish (default: the engine shutdown timeout), then the engine
+        is shut down — its shutdown resolves any stragglers as
+        EngineStopped and the zero-token retry rule re-places them on
+        the surviving replicas.  Returns False for an unknown rid or
+        when it would empty the fleet."""
+        if timeout is None:
+            timeout = self.engine_shutdown_timeout
+        with self._lock:
+            live = [x for x in self.replicas if not x.dead]
+            r = next((x for x in self.replicas if x.rid == int(rid)),
+                     None)
+            if r is None or (not r.dead and len(live) <= 1):
+                return False
+            r.state = EJECTED           # out of rotation, not a failure
+            r.ejected_until = float("inf")
+        deadline = time.monotonic() + float(timeout)
+        while r.inflight and time.monotonic() < deadline:
+            if self.threaded:
+                time.sleep(min(0.01, self.health_interval))
+            else:
+                break       # manual mode: the caller pumps; don't spin
+        try:
+            r.engine.shutdown(timeout=self.engine_shutdown_timeout)
+        except Exception:  # noqa: BLE001 — wedged thread: handles were
+            pass           # already failed; proceed to removal
+        with self._lock:
+            stranded = [(fh, fh._hop) for fh in r.inflight]
+            r.inflight.clear()
+            try:
+                self.replicas.remove(r)
+            except ValueError:
+                pass
+            self._prefix_digests.pop(r.rid, None)
+            self.stats.inc("autoscale_downs")
+        for fh, hop in stranded:
+            if hop is not None and not hop.done():
+                hop._resolve(EngineStopped(
+                    f"replica {r.rid} released by the autoscaler"))
+        self._rq_event_fleet("autoscale_down", replica_id=r.rid)
+        return True
+
+    def _rq_event_fleet(self, name: str, **attrs) -> None:
+        """A fleet-level trace edge with no request attached (autoscale
+        up/down): stamped on a synthetic per-event id so the registry
+        keeps an inspectable record without polluting any request's
+        timeline."""
+        rt = self.reqtrace
+        if rt is not None and rt.enabled:
+            try:
+                rt.event(f"fleet-{name}-{attrs.get('replica_id')}",
+                         name, replica="router", **attrs)
+            except Exception:  # noqa: BLE001 — tracing is advisory
+                pass
+
     # -- driving ------------------------------------------------------------
 
     def _health_loop(self) -> None:
@@ -1291,7 +1437,9 @@ class Router:
         if self._health_thread is not None:
             self._health_thread.join(timeout=5.0)
             self._health_thread = None
-        for r in self.replicas:
+        with self._lock:       # register/release mutate the list live
+            replicas = list(self.replicas)
+        for r in replicas:
             try:
                 r.engine.shutdown(timeout=self.engine_shutdown_timeout)
             except Exception:  # noqa: BLE001
@@ -1323,8 +1471,14 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
     """HTTP entry over a fleet Router (the multi-replica serve_llm).
 
     POST / with {"prompt": [...], "max_new_tokens": N, "eos_id"?,
-    "deadline"?, "request_id"?} returns {"tokens": [...], "hops":
-    [replica ids], "request_id": "..."}.  `GET /debug/request/<id>`
+    "deadline"?, "request_id"?, "tenant"?, "priority"?} returns
+    {"tokens": [...], "hops": [replica ids], "request_id": "...",
+    "tenant": "...", "priority": N} — tenant/priority echo the RESOLVED
+    QoS labels (effective tier after the tenant floor).  The schema is
+    CLOSED: an unknown field replies 400 {"error": "unknown_field"}
+    instead of being silently dropped, and an unknown tenant under a
+    strict policy replies 400 {"error": "unknown_tenant"}.
+    `GET /debug/request/<id>`
     returns the request's cross-replica timeline from the router's
     RequestRegistry — fleet placement/retry edges stamped "router",
     engine lifecycle edges stamped with each hop's replica id — or 404
@@ -1352,6 +1506,12 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                          "(Router(..., threaded=True))")
 
     class Handler(BaseHTTPRequestHandler):
+        # the CLOSED request schema: an unknown field is a 400, never a
+        # silent drop (a typo'd "prioriti" must not demote a request)
+        _POST_FIELDS = frozenset((
+            "prompt", "max_new_tokens", "eos_id", "deadline",
+            "request_id", "tenant", "priority"))
+
         def _reply_text(self, status, text, content_type, headers=None):
             body = text.encode()
             self.send_response(status)
@@ -1423,6 +1583,20 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                     return
                 try:
                     req = json.loads(self.rfile.read(n) or b"{}")
+                    if not isinstance(req, dict):
+                        self._reply(400, {
+                            "error": "bad_body",
+                            "detail": "request body must be a JSON "
+                                      "object"})
+                        return
+                    unknown = sorted(set(req) - self._POST_FIELDS)
+                    if unknown:
+                        self._reply(400, {
+                            "error": "unknown_field",
+                            "fields": unknown,
+                            "detail": f"unknown request field(s): "
+                                      f"{', '.join(unknown)}"})
+                        return
                     prompt = req["prompt"]
                     max_new = int(req.get("max_new_tokens", 16))
                     eos_id = req.get("eos_id")
@@ -1430,14 +1604,28 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                     req_id = req.get("request_id")
                     if req_id is not None:
                         req_id = str(req_id)
+                    tenant = req.get("tenant")
+                    if tenant is not None:
+                        tenant = str(tenant)
+                    priority = req.get("priority")
+                    if priority is not None:
+                        priority = int(priority)
                 except (json.JSONDecodeError, KeyError, TypeError,
                         ValueError) as e:
-                    self._reply(400, {"error": f"bad request body: {e!r}"})
+                    self._reply(400, {"error": "bad_body",
+                                      "detail": f"bad request body: "
+                                                f"{e!r}"})
                     return
                 try:
                     handle = router.submit(prompt, max_new, eos_id,
                                            deadline=deadline,
-                                           req_id=req_id)
+                                           req_id=req_id, tenant=tenant,
+                                           priority=priority)
+                except _qos.UnknownTenant as e:
+                    self._reply(400, {"error": "unknown_tenant",
+                                      "tenant": e.tenant,
+                                      "detail": str(e)})
+                    return
                 except (FleetQueueFull, NoHealthyReplica) as e:
                     retry = max(1, int(-(-getattr(e, "retry_after", 1.0)
                                          // 1)))
@@ -1471,7 +1659,9 @@ def serve_fleet(router: Router, host: str = "127.0.0.1", port: int = 0,
                     self._reply(409, {"error": str(e)})
                     return
                 self._reply(200, {"tokens": toks, "hops": handle.hops,
-                                  "request_id": handle.req_id})
+                                  "request_id": handle.req_id,
+                                  "tenant": handle.tenant,
+                                  "priority": handle.priority})
             except Exception as e:  # noqa: BLE001 — server-side fault
                 self._reply(500, {"error": repr(e)})
 

@@ -22,14 +22,25 @@ analog:
 The fleet Router calls these from its health loop and re-registers the
 replacement under the same replica id; `supervise()` is the standalone
 one-shot (no router) convenience the tests exercise directly.
+
+`BurnRateAutoscaler` closes the QoS control loop on the same factory:
+the Router's health tick feeds it the fleet's per-tenant SLO burn rates
+(inference/qos.py tenancy -> obs/slo.py burn gauges), and sustained
+high-priority burn above `high_burn` for `sustain_ticks` consecutive
+ticks SPAWNS a replica (factory() + Router.register); sustained
+recovery below `low_burn` drains and releases the most recently
+spawned one (Router.release).  Hysteresis on both edges — an
+oscillating burn signal must not thrash replicas — and only replicas
+the autoscaler spawned are ever released: the base fleet is the
+operator's, not the control loop's.
 """
 
 from __future__ import annotations
 
 import time
-from typing import Callable, Optional
+from typing import Callable, List, Optional
 
-__all__ = ["EngineSupervisor"]
+__all__ = ["EngineSupervisor", "BurnRateAutoscaler"]
 
 
 class EngineSupervisor:
@@ -123,3 +134,150 @@ class EngineSupervisor:
             if new is not None:
                 return verdict, new
         return verdict, engine
+
+
+class BurnRateAutoscaler:
+    """Per-tenant SLO burn -> fleet size, with hysteresis on both edges.
+
+    Control signal: the WORST burn rate over every high-priority tenant
+    (priority <= `max_priority`) on every live replica, read from
+    `engine.tenant_burn_rates()` — the same windowed numbers the
+    per-tenant `/metrics` gauges render, never re-derived.  Low-tier
+    tenants never scale the fleet: a flooding bulk tenant is the WFQ
+    queue's problem, not a reason to buy hardware.
+
+    Policy: burn >= `high_burn` for `sustain_ticks` CONSECUTIVE router
+    ticks spawns one replica from `factory` (falling back to the
+    router's supervisor factory) and registers it into rotation, up to
+    `max_extra` beyond the base fleet; burn <= `low_burn` for
+    `sustain_ticks` consecutive ticks drains and releases the most
+    recently spawned replica.  The band between the thresholds holds
+    steady (and resets both streaks), so a burn signal oscillating
+    around one threshold cannot thrash replicas.  Only replicas this
+    loop spawned are ever released — the operator's base fleet is not
+    the control loop's to shrink.
+
+    A factory that RAISES at spawn time black-boxes the fleet (best-
+    effort FlightRecorder dump on a live replica, tagged
+    `autoscale_spawn_failed`) and leaves the fleet at its current size:
+    a broken scale-up path must be diagnosable from the dump, never a
+    crashed health tick.
+
+    Wire-up: `Router(..., autoscaler=BurnRateAutoscaler(...))`; the
+    router calls `observe(router)` once per health tick after probes
+    and death handling, so the loop always sees post-recovery burn."""
+
+    def __init__(self, factory: Optional[Callable[[], object]] = None,
+                 high_burn: float = 2.0, low_burn: float = 0.5,
+                 sustain_ticks: int = 3, max_extra: int = 2,
+                 max_priority: int = 0):
+        if float(low_burn) >= float(high_burn):
+            raise ValueError(
+                f"low_burn ({low_burn}) must be < high_burn "
+                f"({high_burn}) — the hysteresis band cannot be empty")
+        if int(sustain_ticks) < 1:
+            raise ValueError("sustain_ticks must be >= 1")
+        self.factory = factory
+        self.high_burn = float(high_burn)
+        self.low_burn = float(low_burn)
+        self.sustain_ticks = int(sustain_ticks)
+        self.max_extra = int(max_extra)
+        self.max_priority = int(max_priority)
+        self._hot_streak = 0
+        self._cool_streak = 0
+        self._spawned: List[int] = []   # rids we registered, newest last
+        self.spawns = 0
+        self.releases = 0
+        self.spawn_failures = 0
+        self.last_burn = 0.0
+
+    # -- signal -------------------------------------------------------------
+
+    def _fleet_burn(self, router) -> float:
+        """Worst high-priority tenant burn across live replicas.  A
+        replica whose accessor is missing or raises contributes nothing
+        (stale telemetry degrades the signal, never crashes the tick)."""
+        worst = 0.0
+        for r in router.replicas:
+            if r.dead:
+                continue
+            fn = getattr(r.engine, "tenant_burn_rates", None)
+            if fn is None:
+                continue
+            try:
+                rates = fn(max_priority=self.max_priority)
+            except Exception:  # noqa: BLE001 — dying replica mid-read
+                continue
+            for v in rates.values():
+                if v > worst:
+                    worst = v
+        return worst
+
+    def snapshot(self) -> dict:
+        return {
+            "last_burn": self.last_burn,
+            "spawned_rids": list(self._spawned),
+            "spawns": self.spawns,
+            "releases": self.releases,
+            "spawn_failures": self.spawn_failures,
+            "hot_streak": self._hot_streak,
+            "cool_streak": self._cool_streak,
+        }
+
+    # -- control loop -------------------------------------------------------
+
+    def observe(self, router) -> None:
+        """One control-loop step; called by Router.tick()."""
+        burn = self._fleet_burn(router)
+        self.last_burn = burn
+        if burn >= self.high_burn:
+            self._cool_streak = 0
+            self._hot_streak += 1
+            if self._hot_streak >= self.sustain_ticks \
+                    and len(self._spawned) < self.max_extra:
+                self._hot_streak = 0
+                self._spawn(router)
+        elif burn <= self.low_burn:
+            self._hot_streak = 0
+            self._cool_streak += 1
+            if self._cool_streak >= self.sustain_ticks \
+                    and self._spawned:
+                self._cool_streak = 0
+                self._release(router)
+        else:
+            # inside the hysteresis band: hold fleet size, reset both
+            # streaks — sustained means CONSECUTIVE, not cumulative
+            self._hot_streak = 0
+            self._cool_streak = 0
+
+    def _spawn(self, router) -> None:
+        factory = self.factory
+        if factory is None and router.supervisor is not None:
+            factory = router.supervisor.factory
+        if factory is None:
+            return
+        try:
+            engine = factory()
+        except Exception:  # noqa: BLE001 — broken scale-up path: dump
+            self.spawn_failures += 1
+            for r in router.replicas:
+                fl = getattr(r.engine, "flight", None)
+                if fl is not None:
+                    try:
+                        fl.dump("autoscale_spawn_failed")
+                    except Exception:  # noqa: BLE001 — best effort
+                        pass
+                    break
+            return
+        rep = router.register(engine)
+        self._spawned.append(rep.rid)
+        self.spawns += 1
+
+    def _release(self, router) -> None:
+        rid = self._spawned.pop()
+        if router.release(rid):
+            self.releases += 1
+        else:
+            # refused (unknown rid after an operator removal, or the
+            # fleet would empty): keep tracking it, retry next cycle
+            self._spawned.append(rid)

@@ -45,8 +45,11 @@ class Objective:
 
     def __init__(self, metric: str, q: float, threshold_s: float,
                  name: Optional[str] = None):
-        if not 0.0 < float(q) < 1.0:
-            raise ValueError(f"objective quantile must be in (0, 1), "
+        # q == 1.0 is a legal (if brutal) objective: "NO sample may
+        # exceed the threshold".  Its error budget is zero, so burn is
+        # inf the moment one sample goes over — see report().
+        if not 0.0 < float(q) <= 1.0:
+            raise ValueError(f"objective quantile must be in (0, 1], "
                              f"got {q}")
         if float(threshold_s) <= 0.0:
             raise ValueError("objective threshold must be > 0")
@@ -149,8 +152,15 @@ class SLOEngine:
             value = obs_metrics.percentile(vals, o.q) if n else 0.0
             over = sum(1 for v in vals if v > o.threshold_s)
             # no traffic is not an outage: empty window reports ok with
-            # zero burn instead of dividing by nothing
-            burn = (over / n) / o.budget if n else 0.0
+            # zero burn instead of dividing by nothing.  A q=1.0
+            # objective has ZERO budget — one violation is infinite
+            # burn, not a ZeroDivisionError.
+            if not n:
+                burn = 0.0
+            elif o.budget > 0.0:
+                burn = (over / n) / o.budget
+            else:
+                burn = float("inf") if over else 0.0
             out["objectives"][o.name] = {
                 "metric": o.metric,
                 "quantile": o.q,
@@ -182,6 +192,8 @@ class SLOEngine:
                 if not vals:
                     return 0.0
                 over = sum(1 for v in vals if v > o.threshold_s)
+                if o.budget <= 0.0:     # q=1.0: zero error budget
+                    return float("inf") if over else 0.0
                 return (over / len(vals)) / o.budget
 
             def _ok(o=o):

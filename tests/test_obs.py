@@ -435,3 +435,117 @@ class TestObsCallback:
         assert cb.sentinel.counts() == {"f": 0}
         summary = obs.summarize(obs.load_trace(path))
         assert summary["train_step"]["count"] == 3
+
+
+# ---------------------------------------------------------------------------
+# SLO engine edge cases (obs/slo.py)
+# ---------------------------------------------------------------------------
+
+
+class TestSLOEdgeCases:
+    def test_empty_window_reports_zero_burn_and_ok(self):
+        """No traffic is not an outage: an empty window must report ok
+        with zero burn, never divide by nothing."""
+        from paddle_tpu.obs import slo as obs_slo
+
+        eng = obs_slo.SLOEngine([obs_slo.Objective("ttft", 0.95, 1.0)])
+        o = eng.report(now=1000.0)["objectives"]["ttft_p95"]
+        assert o["window_n"] == 0
+        assert o["burn_rate"] == 0.0
+        assert o["window_value_s"] == 0.0
+        assert o["ok"] is True
+
+    def test_objective_validation_is_typed(self):
+        from paddle_tpu.obs import slo as obs_slo
+
+        for bad_q in (0.0, -0.5, 1.5):
+            with pytest.raises(ValueError):
+                obs_slo.Objective("ttft", bad_q, 1.0)
+        with pytest.raises(ValueError):
+            obs_slo.Objective("ttft", 0.95, 0.0)
+
+    def test_q_one_objective_has_zero_budget_infinite_burn(self):
+        """q=1.0 is legal — 'NO sample may exceed the threshold'.  Its
+        error budget is zero, so one violation is INFINITE burn, not a
+        ZeroDivisionError."""
+        from paddle_tpu.obs import slo as obs_slo
+
+        o = obs_slo.Objective("ttft", 1.0, 0.5)
+        assert o.budget == 0.0
+        eng = obs_slo.SLOEngine([o], window_s=60.0)
+        eng.observe("ttft", 0.4, t=100.0)
+        rep = eng.report(now=100.0)["objectives"]["ttft_p100"]
+        assert rep["burn_rate"] == 0.0 and rep["ok"] is True
+        eng.observe("ttft", 0.6, t=100.0)
+        rep = eng.report(now=100.0)["objectives"]["ttft_p100"]
+        assert rep["burn_rate"] == float("inf")
+        assert rep["over_threshold_n"] == 1
+        assert rep["violations_total"] == 1
+
+    def test_identical_timestamps_and_window_edge(self):
+        """Samples sharing one timestamp all live or die together at the
+        window cut, and a sample AT the cut is still inside (t >= cut,
+        closed boundary)."""
+        from paddle_tpu.obs import slo as obs_slo
+
+        eng = obs_slo.SLOEngine([obs_slo.Objective("ttft", 0.5, 1.0)],
+                                window_s=60.0)
+        for v in (0.1, 0.2, 0.3):
+            eng.observe("ttft", v, t=50.0)
+        rep = eng.report(now=50.0)["objectives"]["ttft_p50"]
+        assert rep["window_n"] == 3
+        assert rep["window_value_s"] == pytest.approx(0.2)
+        # now=110 puts the cut exactly at t=50: closed boundary keeps all
+        rep = eng.report(now=110.0)["objectives"]["ttft_p50"]
+        assert rep["window_n"] == 3
+        # one window further on, every sample has aged out together
+        rep = eng.report(now=200.0)["objectives"]["ttft_p50"]
+        assert rep["window_n"] == 0
+        assert rep["burn_rate"] == 0.0 and rep["ok"] is True
+
+    def test_report_stable_under_concurrent_writer(self):
+        """report() races a hammering observe() thread without torn
+        reads: every snapshot stays internally consistent and the
+        engine's lock passes a lock-order witness (the same threadlint
+        discipline the serving soaks arm)."""
+        import threading
+
+        from paddle_tpu.inference import faults as F
+        from paddle_tpu.obs import slo as obs_slo
+
+        eng = obs_slo.SLOEngine([obs_slo.Objective("ttft", 0.95, 0.5)],
+                                window_s=60.0)
+        witness = F.LockWitness()
+        witness.wrap(eng, "_lock", "SLOEngine._lock")
+        stop = threading.Event()
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                # alternate under/over threshold so violation counters
+                # and burn both move while we read
+                eng.observe("ttft", 0.1 if i % 2 else 0.9)
+                i += 1
+
+        th = threading.Thread(target=writer, name="slo-writer")
+        th.start()
+        try:
+            last_violations = 0
+            deadline = time.monotonic() + 0.5
+            while time.monotonic() < deadline:
+                rep = eng.report()["objectives"]["ttft_p95"]
+                assert 0 <= rep["over_threshold_n"] <= rep["window_n"]
+                assert rep["burn_rate"] >= 0.0
+                # cumulative counter must never run backwards
+                assert rep["violations_total"] >= last_violations
+                last_violations = rep["violations_total"]
+        finally:
+            stop.set()
+            th.join(timeout=5)
+        assert not th.is_alive()
+        assert last_violations > 0, "the writer never crossed the " \
+                                    "threshold — the race never happened"
+        wrep = witness.report()
+        witness.unwrap_all()
+        assert wrep["ok"], wrep["violations"]
+        assert wrep["acquisitions"] > 0

@@ -1,0 +1,640 @@
+"""Multi-tenant QoS tier: WFQ admission, priority resolution, tiered
+eviction, the burn-rate autoscaler control loop, and the serve surfaces'
+closed tenant schema.
+
+Everything here runs on ScriptedEngine (the real LLMEngine scheduler
+with scripted compute) or on the QoS primitives directly — no weights,
+no jit, tier-1 fast.  Tenancy is host-side by design: none of these
+tests touch a compiled signature.
+"""
+
+import glob
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from paddle_tpu.inference import (
+    BurnRateAutoscaler,
+    QoSPolicy,
+    QueueFull,
+    Router,
+    TenantConfig,
+    TieredPrefixStore,
+    UnknownTenant,
+    serve_fleet,
+    serve_llm,
+)
+from paddle_tpu.inference import faults as F
+from paddle_tpu.inference import qos
+from paddle_tpu.inference.prefix import PrefixIndex
+from paddle_tpu.inference.router import HEALTHY
+from paddle_tpu.obs import flight as obs_flight
+
+
+def _eng(**kw):
+    kw.setdefault("num_slots", 2)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_seq_len", 16)
+    return F.ScriptedEngine(**kw)
+
+
+def _ref(h):
+    return F.ScriptedEngine.reference_tokens(h.prompt, h.max_new_tokens,
+                                             h.eos_id)
+
+
+def _drain(eng, handles, budget=20000):
+    for _ in range(budget):
+        if all(h.done() for h in handles):
+            return
+        eng.step()
+    raise AssertionError("engine did not drain the workload")
+
+
+def _req(tenant, priority, n_prompt=4, max_new=4):
+    """A request-shaped object for WFQQueue unit tests: the queue only
+    reads .tenant, .priority, .prompt.size and .max_new_tokens."""
+    return SimpleNamespace(prompt=np.arange(n_prompt), tenant=tenant,
+                           priority=priority, max_new_tokens=max_new)
+
+
+_TWO_TIER = {
+    "gold": {"priority": 0, "weight": 4.0},
+    "bulk": {"priority": 3, "weight": 1.0},
+}
+
+
+def _post(url, body, timeout=60):
+    """POST json, return (status, payload) — HTTPError bodies included,
+    so 400s assert on their typed error payloads."""
+    data = body if isinstance(body, bytes) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+# ---------------------------------------------------------------------------
+# WFQQueue
+# ---------------------------------------------------------------------------
+
+
+class TestWFQQueue:
+    def _queue(self, table=_TWO_TIER):
+        return qos.WFQQueue(QoSPolicy.build(table))
+
+    def test_priority_tier_beats_virtual_time(self):
+        """A tier-0 head is served before a tier-3 head even when the
+        tier-0 tenant's clock is far ahead (priority is the FIRST key)."""
+        q = self._queue()
+        q.append(_req("gold", 0))
+        q.append(_req("gold", 0))
+        q.popleft()
+        q.popleft()                  # gold vtime now 8/4 * 2 = 4.0
+        assert q.virtual_times()["gold"] > 0.0
+        q.append(_req("bulk", 3))    # bulk clock at 0.0
+        q.append(_req("gold", 0))
+        assert q[0].tenant == "gold"
+        assert q.popleft().tenant == "gold"
+        assert q.popleft().tenant == "bulk"
+
+    def test_weighted_service_ratio(self):
+        """Equal-cost, equal-priority streams: a weight-2 tenant drains
+        twice as many requests per unit of virtual time."""
+        q = self._queue({"a": {"weight": 2.0, "priority": 1},
+                         "b": {"weight": 1.0, "priority": 1}})
+        for _ in range(12):
+            q.append(_req("a", 1))
+            q.append(_req("b", 1))
+        served = [q.popleft().tenant for _ in range(9)]
+        assert served.count("a") == 6 and served.count("b") == 3
+
+    def test_idle_tenant_banks_no_credit(self):
+        """A tenant going idle->active has its clock jumped to the
+        minimum ACTIVE virtual time — idle periods earn no backlog of
+        service credit to starve others with."""
+        q = self._queue({"a": {"weight": 1.0, "priority": 1},
+                         "b": {"weight": 1.0, "priority": 1}})
+        for _ in range(3):
+            q.append(_req("a", 1))
+        q.popleft()
+        q.popleft()                  # a's clock advanced, queue non-empty
+        va = q.virtual_times()["a"]
+        assert va > 0.0
+        q.append(_req("b", 1))       # fresh tenant joins mid-stream
+        assert q.virtual_times()["b"] == pytest.approx(va)
+
+    def test_resume_lane_has_absolute_precedence_and_no_rebilling(self):
+        """appendleft is the preemption resume path: it pops before any
+        tenant lane regardless of tier, and does not re-charge the
+        tenant's clock (the request paid at first admission)."""
+        q = self._queue()
+        q.append(_req("gold", 0))
+        resumed = _req("bulk", 3)
+        q.appendleft(resumed)
+        assert q.depth("bulk") == 1          # resume lane counts
+        assert q[0] is resumed
+        before = q.virtual_times().get("bulk", 0.0)
+        assert q.popleft() is resumed
+        assert q.virtual_times().get("bulk", 0.0) == before
+        assert q.depth("bulk") == 0
+        assert q.popleft().tenant == "gold"
+
+    def test_remove_matches_deque_semantics(self):
+        q = self._queue()
+        r1, r2 = _req("gold", 0), _req("bulk", 3)
+        q.append(r1)
+        q.appendleft(r2)
+        assert len(q) == 2 and bool(q)
+        q.remove(r2)                 # out of the resume lane
+        assert q.depth("bulk") == 0
+        q.remove(r1)
+        assert len(q) == 0 and not q
+        with pytest.raises(ValueError):
+            q.remove(r1)
+        with pytest.raises(IndexError):
+            q.popleft()
+
+    def test_depths_cover_both_lanes(self):
+        q = self._queue()
+        q.append(_req("gold", 0))
+        q.append(_req("gold", 0))
+        q.appendleft(_req("bulk", 3))
+        assert q.depths() == {"gold": 2, "bulk": 1}
+        assert sorted(q.depths()) == sorted(
+            t for t in ("gold", "bulk"))
+        assert len(list(iter(q))) == 3
+
+
+# ---------------------------------------------------------------------------
+# QoSPolicy / TenantConfig
+# ---------------------------------------------------------------------------
+
+
+class TestQoSPolicy:
+    def test_resolve_clamps_to_tenant_floor(self):
+        pol = QoSPolicy.build(_TWO_TIER)
+        # a bulk request cannot claim more importance than its tier
+        assert pol.resolve("bulk", 1)[1] == 3
+        # a gold request may demote itself
+        assert pol.resolve("gold", 2)[1] == 2
+        # no request priority: the tenant tier applies
+        assert pol.resolve("gold", None)[1] == 0
+        name, eff, cfg = pol.resolve(None, None)
+        assert name == qos.DEFAULT_TENANT and cfg.name == name
+
+    def test_strict_table_rejects_unknown_named_tenant_only(self):
+        pol = QoSPolicy.build(_TWO_TIER)
+        assert pol.strict
+        with pytest.raises(UnknownTenant) as ei:
+            pol.resolve("nobody", None)
+        assert ei.value.tenant == "nobody"
+        # untagged traffic (canaries, probes, legacy clients) must still
+        # resolve: strictness rejects unknown NAMES, not the absence of one
+        assert pol.resolve(None, None)[0] == qos.DEFAULT_TENANT
+
+    def test_implicit_policy_auto_vivifies(self):
+        pol = QoSPolicy()
+        assert not pol.strict
+        cfg = pol.get("fresh-label")
+        assert cfg.weight == 1.0 and cfg.priority == 1
+
+    def test_bad_request_labels_are_typed(self):
+        pol = QoSPolicy.build(_TWO_TIER)
+        with pytest.raises(ValueError):
+            pol.resolve("gold", -1)
+        with pytest.raises(ValueError):
+            pol.resolve("gold", "high")
+        with pytest.raises(ValueError):
+            pol.resolve("", None)
+
+    def test_tenant_config_validation(self):
+        for bad in (dict(weight=0.0), dict(weight=-2.0),
+                    dict(weight=float("inf")), dict(weight=float("nan")),
+                    dict(priority=-1), dict(max_pending=0)):
+            with pytest.raises(ValueError):
+                TenantConfig("t", **bad)
+        with pytest.raises(ValueError, match="duplicate"):
+            QoSPolicy([TenantConfig("t"), TenantConfig("t")])
+        with pytest.raises(TypeError):
+            QoSPolicy(["not-a-config"])
+
+
+# ---------------------------------------------------------------------------
+# tier-aware eviction ladders (prefix index + host store)
+# ---------------------------------------------------------------------------
+
+
+class _FakeCache:
+    """The minimal surface PrefixIndex needs: page_size plus refcounts."""
+
+    page_size = 4
+
+    def __init__(self):
+        self._refs = {}
+
+    def add_ref(self, page):
+        self._refs[page] = self._refs.get(page, 0) + 1
+
+    def drop_ref(self, page):
+        n = self._refs.get(page, 0) - 1
+        if n <= 0:
+            self._refs.pop(page, None)
+            return True
+        self._refs[page] = n
+        return False
+
+    def refcount(self, page):
+        return self._refs.get(page, 0)
+
+
+class TestTieredEviction:
+    def test_prefix_eviction_drains_worst_tier_before_lru(self):
+        """A bulk (tier-3) prefix evicts before a gold (tier-0) one even
+        when the bulk prefix was used more recently — tier outranks
+        recency on the eviction ladder."""
+        idx = PrefixIndex(_FakeCache())
+        idx.insert([1, 2, 3, 4], 4, [10], tier=0)     # gold, older
+        idx.insert([5, 6, 7, 8], 4, [11], tier=3)     # bulk, fresher LRU
+        assert idx.evict(1) == 1
+        assert idx.pages() == {10}                    # bulk page went
+
+    def test_shared_prefix_keeps_most_important_tier(self):
+        """A prefix a premium tenant also touched min-merges to the
+        premium tier: the flooding tenant's ladder rung can no longer
+        claim it first."""
+        idx = PrefixIndex(_FakeCache())
+        idx.insert([1, 2, 3, 4], 4, [10], tier=3)     # bulk caches it
+        idx.insert([5, 6, 7, 8], 4, [11], tier=1)
+        idx.insert([1, 2, 3, 4], 4, [10], tier=0)     # gold re-caches
+        assert idx._by_page[10].tier == 0
+        assert idx.evict(1) == 1
+        assert idx.pages() == {10}                    # tier-1 page went
+
+    def test_host_store_capacity_evicts_worst_tier_lru_within(self):
+        page = np.zeros((2, 2), np.float32)           # 32 bytes per put
+        store = TieredPrefixStore(capacity_bytes=3 * page.nbytes * 2)
+        store.put((1,), page, page, tier=0)           # gold
+        store.put((2,), page, page, tier=3)           # bulk, oldest bulk
+        store.put((3,), page, page, tier=3)           # bulk, newer
+        store.put((4,), page, page, tier=1)           # over capacity now
+        keys = set(store.keys())
+        assert (2,) not in keys                       # worst tier's LRU
+        assert {(1,), (3,), (4,)} <= keys
+
+    def test_host_store_put_min_merges_tier_on_duplicate(self):
+        page = np.zeros((2, 2), np.float32)
+        store = TieredPrefixStore(capacity_bytes=None)
+        assert store.put((1,), page, page, tier=3)
+        assert store.put((1,), page, page, tier=0) is False
+        assert store._tiers[(1,)] == 0                # refreshed upward
+
+
+# ---------------------------------------------------------------------------
+# engine admission: caps, queue-jump, preemption ladder
+# ---------------------------------------------------------------------------
+
+
+class TestEngineQoS:
+    def test_per_tenant_cap_is_a_per_tenant_verdict(self):
+        eng = _eng(num_slots=1, tenants={
+            "gold": {"priority": 0, "weight": 4.0},
+            "bulk": {"priority": 3, "weight": 1.0, "max_pending": 2},
+        })
+        handles = [eng.submit([1, 2, 3], 2, tenant="bulk")
+                   for _ in range(2)]
+        with pytest.raises(QueueFull):
+            eng.submit([1, 2, 3], 2, tenant="bulk")
+        # the cap is bulk's, not the engine's: gold still submits
+        handles.append(eng.submit([4, 5, 6], 2, tenant="gold"))
+        _drain(eng, handles)
+        snap = eng.tenant_snapshot()
+        assert snap["bulk"]["counters"]["rejected_queue_full"] == 1
+        assert snap["gold"]["counters"]["rejected_queue_full"] == 0
+        assert snap["bulk"]["counters"]["completed"] == 2
+        assert snap["gold"]["counters"]["completed"] == 1
+        F.check_invariants(eng, handles)
+        eng.shutdown()
+
+    def test_unknown_tenant_rejected_before_any_state_changes(self):
+        eng = _eng(tenants=_TWO_TIER)
+        with pytest.raises(UnknownTenant):
+            eng.submit([1, 2, 3], 2, tenant="nobody")
+        assert eng.stats["accepted"] == 0
+        assert "nobody" not in eng.tenant_snapshot()
+        eng.shutdown()
+
+    def test_gold_jumps_the_bulk_queue(self):
+        """One slot, three queued bulk requests, then one gold: WFQ
+        priority admission serves gold as soon as the slot frees —
+        before every still-queued bulk request."""
+        eng = _eng(num_slots=1, tenants=_TWO_TIER)
+        rng = np.random.default_rng(0)
+        bulk = [eng.submit(rng.integers(0, 97, 5).tolist(), 3,
+                           tenant="bulk") for _ in range(3)]
+        gold = eng.submit(rng.integers(0, 97, 5).tolist(), 3,
+                          tenant="gold")
+        order = []
+        pending = {id(h): name for h, name in
+                   zip(bulk + [gold], ["b0", "b1", "b2", "g"])}
+        for _ in range(20000):
+            if not pending:
+                break
+            eng.step()
+            for h in list(bulk) + [gold]:
+                if id(h) in pending and h.done():
+                    order.append(pending.pop(id(h)))
+        assert not pending
+        # b0 holds the slot at submission time; gold admits next
+        assert order.index("g") <= 1
+        assert order.index("g") < order.index("b1")
+        assert order.index("g") < order.index("b2")
+        for h in bulk + [gold]:
+            assert h.result(timeout=0) == _ref(h)
+        F.check_invariants(eng, bulk + [gold])
+        eng.shutdown()
+
+    def test_preemption_ladder_victimizes_bulk_first(self):
+        """Undersized page pool, gold + bulk live together: every
+        preemption under pressure lands on the least important tier —
+        gold is never the victim while a bulk slot exists."""
+        eng = _eng(num_slots=2, max_seq_len=16, num_pages=5,
+                   tenants=_TWO_TIER)
+        rng = np.random.default_rng(1)
+        handles = [
+            eng.submit(rng.integers(0, 97, 6).tolist(), 8, tenant="bulk"),
+            eng.submit(rng.integers(0, 97, 6).tolist(), 8, tenant="bulk"),
+            eng.submit(rng.integers(0, 97, 6).tolist(), 8, tenant="gold"),
+        ]
+        _drain(eng, handles)
+        snap = eng.tenant_snapshot()
+        assert eng.stats["preemptions"] >= 1
+        assert snap["bulk"]["counters"]["preempted"] \
+            == eng.stats["preemptions"]
+        assert snap["gold"]["counters"]["preempted"] == 0
+        for h in handles:
+            assert h.result(timeout=0) == _ref(h)
+        F.check_invariants(eng, handles)
+        eng.shutdown()
+
+    def test_per_tenant_counters_feed_the_invariant_checker(self):
+        """check_invariants cross-checks tenant counters against the
+        untagged totals; a seeded drift must be caught."""
+        eng = _eng(tenants=_TWO_TIER)
+        h = eng.submit([1, 2, 3, 4], 2, tenant="gold")
+        _drain(eng, [h])
+        F.check_invariants(eng, [h])
+        eng._tenant_stats["gold"]["completed"] += 1   # seed the drift
+        with pytest.raises(F.InvariantViolation, match="tenant"):
+            F.check_invariants(eng, [h], probe=False)
+        eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# burn-rate autoscaler
+# ---------------------------------------------------------------------------
+
+
+def _qos_engine_factory(window_s=0.4):
+    def mk():
+        return _eng(tenants={"gold": {"priority": 0, "weight": 4.0}},
+                    slo_window_s=window_s)
+    return mk
+
+
+def _prime_gold_burn(eng, n=10):
+    """Feed the gold tenant's SLO engine TTFT samples far over
+    threshold: its burn rate saturates immediately."""
+    eng._tenant_state("gold")
+    for _ in range(n):
+        eng._tenant_slo_observe("gold", "ttft", 60.0)
+
+
+class TestBurnRateAutoscaler:
+    def test_closed_loop_spawn_place_recover_release(self):
+        """The acceptance loop: sustained high-priority burn spawns a
+        replica from the factory, the router places real work onto it,
+        and when the burn recovers the autoscaler drains and releases
+        exactly the replica it spawned."""
+        mk = _qos_engine_factory(window_s=0.4)
+        auto = BurnRateAutoscaler(factory=mk, high_burn=2.0,
+                                  low_burn=0.5, sustain_ticks=2,
+                                  max_extra=1, max_priority=0)
+        router = Router([mk()], supervisor=None, threaded=False,
+                        autoscaler=auto)
+        try:
+            base = router.replicas[0].engine
+            _prime_gold_burn(base)
+            assert base.tenant_burn_rates(max_priority=0)["gold"] >= 2.0
+            router.tick()
+            assert len(router.replicas) == 1      # sustain: 1 tick is not
+            router.tick()
+            assert len(router.replicas) == 2 and auto.spawns == 1
+            spawned_rid = auto.snapshot()["spawned_rids"][0]
+            spawned = next(r for r in router.replicas
+                           if r.rid == spawned_rid)
+            assert spawned.state == HEALTHY and not spawned.dead
+
+            # the fleet actually uses the capacity: the empty spawned
+            # replica wins least-loaded placement for fresh work
+            rng = np.random.default_rng(2)
+            handles = [router.submit(rng.integers(0, 97, 5).tolist(), 3)
+                       for _ in range(6)]
+            F.drive_fleet(router, handles)
+            assert any(h.hops and h.hops[0] == spawned_rid
+                       for h in handles)
+            for h in handles:
+                assert h.result(timeout=0) == _ref(h)
+
+            # recovery: the hot samples age out of the window, burn
+            # drops under low_burn, and the SPAWNED replica releases
+            time.sleep(0.5)
+            assert base.tenant_burn_rates(max_priority=0)["gold"] == 0.0
+            router.tick()
+            router.tick()
+            assert auto.releases == 1
+            assert auto.snapshot()["spawned_rids"] == []
+            live = [r for r in router.replicas if not r.dead]
+            assert len(live) == 1 and live[0].rid == 0
+        finally:
+            router.shutdown(timeout=10)
+
+    def test_hysteresis_band_resets_streaks(self):
+        mk = _qos_engine_factory()
+        auto = BurnRateAutoscaler(factory=mk, high_burn=2.0,
+                                  low_burn=0.5, sustain_ticks=2,
+                                  max_extra=1, max_priority=0)
+        auto.last_burn = 0.0
+        fake = SimpleNamespace(replicas=[], supervisor=None)
+        # one hot observation, then a mid-band one: the streak must die
+        auto._hot_streak = 1
+        auto._cool_streak = 1
+        auto._fleet_burn = lambda router: 1.0     # inside the band
+        auto.observe(fake)
+        assert auto._hot_streak == 0 and auto._cool_streak == 0
+        assert auto.spawns == 0 and auto.releases == 0
+
+    def test_low_burn_never_releases_operator_replicas(self):
+        """Only self-spawned replicas are the loop's to shrink: a cool
+        fleet with no spawned rids holds size forever."""
+        mk = _qos_engine_factory()
+        router = Router([mk(), mk()], supervisor=None, threaded=False,
+                        autoscaler=BurnRateAutoscaler(
+                            factory=mk, sustain_ticks=1, max_priority=0))
+        try:
+            for _ in range(5):
+                router.tick()                      # burn 0 <= low_burn
+            assert router.autoscaler.releases == 0
+            assert len([r for r in router.replicas if not r.dead]) == 2
+        finally:
+            router.shutdown(timeout=10)
+
+    def test_spawn_failure_black_boxes_and_holds_fleet_size(self, tmp_path):
+        def broken_factory():
+            raise RuntimeError("no capacity at the provider")
+
+        mk = _qos_engine_factory()
+        eng = mk()
+        rec = obs_flight.FlightRecorder(dir=str(tmp_path), name="qos")
+        rec.attach_engine(eng)
+        auto = BurnRateAutoscaler(factory=broken_factory, high_burn=2.0,
+                                  low_burn=0.5, sustain_ticks=1,
+                                  max_extra=1, max_priority=0)
+        router = Router([eng], supervisor=None, threaded=False,
+                        autoscaler=auto)
+        try:
+            _prime_gold_burn(eng)
+            router.tick()
+            assert auto.spawn_failures == 1
+            assert auto.spawns == 0
+            assert len(router.replicas) == 1      # size held, tick alive
+            dumps = sorted(glob.glob(
+                os.path.join(str(tmp_path), "flight_*.json")))
+            assert dumps, "spawn failure left no flight dump"
+            loaded = obs_flight.load_dump(dumps[-1])
+            assert loaded["reason"] == "autoscale_spawn_failed"
+        finally:
+            router.shutdown(timeout=10)
+
+
+class TestRouterElastics:
+    def test_register_enters_rotation_healthy(self):
+        mk = _qos_engine_factory()
+        router = Router([mk(), mk()], supervisor=None, threaded=False)
+        try:
+            rep = router.register(mk())
+            assert rep.rid == 2                   # 1 + max existing rid
+            assert rep.state == HEALTHY and not rep.dead
+            assert rep.engine.replica_name == "2"
+            h = router.submit([1, 2, 3], 2)
+            F.drive_fleet(router, [h])
+            assert h.result(timeout=0) == _ref(h)
+        finally:
+            router.shutdown(timeout=10)
+
+    def test_release_refuses_to_empty_the_fleet(self):
+        mk = _qos_engine_factory()
+        router = Router([mk()], supervisor=None, threaded=False)
+        try:
+            assert router.release(0) is False
+            assert router.release(99) is False    # unknown rid
+            assert len([r for r in router.replicas if not r.dead]) == 1
+        finally:
+            router.shutdown(timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# HTTP serve surfaces: closed schema + resolved-label echo
+# ---------------------------------------------------------------------------
+
+
+class TestServeLLMQoS:
+    def test_closed_schema_and_echo(self):
+        eng = _eng(tenants=_TWO_TIER)
+        srv, _ = serve_llm(eng)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            # non-object body
+            status, payload = _post(url, json.dumps([1, 2]).encode())
+            assert status == 400 and payload["error"] == "bad_body"
+            # typo'd field: typed 400, never a silent drop
+            status, payload = _post(url, {"prompt": [1, 2, 3],
+                                          "max_new": 2})
+            assert status == 400
+            assert payload["error"] == "unknown_field"
+            assert payload["fields"] == ["max_new"]
+            # unknown tenant under the strict table
+            status, payload = _post(url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2,
+                                          "tenant": "nobody"})
+            assert status == 400
+            assert payload["error"] == "unknown_tenant"
+            assert payload["tenant"] == "nobody"
+            # success echoes the RESOLVED labels: bulk's priority floor
+            # clamps the request's optimistic 1 up to tier 3
+            status, payload = _post(url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2,
+                                          "tenant": "bulk",
+                                          "priority": 1,
+                                          "request_id": "qos-llm-1"})
+            assert status == 200
+            assert payload["tenant"] == "bulk"
+            assert payload["priority"] == 3
+            assert payload["tokens"] == F.ScriptedEngine.reference_tokens(
+                [1, 2, 3], 2, None)
+            # the debug timeline carries the submit edge for the id
+            with urllib.request.urlopen(
+                    url + "debug/request/qos-llm-1", timeout=30) as resp:
+                tl = json.loads(resp.read())
+            assert resp.status == 200 and tl
+        finally:
+            srv.shutdown()
+
+
+class TestServeFleetQoS:
+    def test_closed_schema_and_echo(self):
+        mk = lambda: _eng(tenants=_TWO_TIER)  # noqa: E731
+        router = Router([mk(), mk()], supervisor=None, threaded=True,
+                        health_interval=0.01)
+        srv, _ = serve_fleet(router)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/"
+            status, payload = _post(url, json.dumps("nope").encode())
+            assert status == 400 and payload["error"] == "bad_body"
+            status, payload = _post(url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2,
+                                          "prioriti": 0})
+            assert status == 400
+            assert payload["error"] == "unknown_field"
+            assert payload["fields"] == ["prioriti"]
+            status, payload = _post(url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2,
+                                          "tenant": "nobody"})
+            assert status == 400
+            assert payload["error"] == "unknown_tenant"
+            assert payload["tenant"] == "nobody"
+            status, payload = _post(url, {"prompt": [1, 2, 3],
+                                          "max_new_tokens": 2,
+                                          "tenant": "gold",
+                                          "request_id": "qos-fleet-1"})
+            assert status == 200
+            assert payload["tenant"] == "gold"
+            assert payload["priority"] == 0
+            assert payload["tokens"] == F.ScriptedEngine.reference_tokens(
+                [1, 2, 3], 2, None)
+            assert payload["hops"]
+            with urllib.request.urlopen(
+                    url + "debug/request/qos-fleet-1",
+                    timeout=30) as resp:
+                tl = json.loads(resp.read())
+            assert resp.status == 200 and tl
+        finally:
+            srv.shutdown()

@@ -359,12 +359,20 @@ class TestDeadlinePropagation:
         assert router.stats["timed_out"] == 1
         router.shutdown()
 
-    def test_expired_before_placement_times_out_in_engine(self):
+    def test_nonpositive_deadline_rejected_typed_at_submission(self):
+        """A deadline that could never be met fails typed at the fleet
+        front door — no placement burned, no handle created (the ENGINE
+        still accepts deadline=0.0 and reaps it as DeadlineExceeded:
+        test_engine_chaos covers that path)."""
         router = Router(factory=_mk(), num_replicas=1, threaded=False)
-        h = router.submit([1, 2], 4, deadline=0.0)
+        for bad in (0.0, -1.0, float("nan"), float("inf")):
+            with pytest.raises(ValueError):
+                router.submit([1, 2], 4, deadline=bad)
+        assert router.stats["accepted"] == 0
+        # a valid deadline still flows through to the engine
+        h = router.submit([1, 2], 4, deadline=30.0)
         F.drive_fleet(router, [h])
-        assert isinstance(h.error, DeadlineExceeded)
-        assert h.resolutions == 1
+        assert h.result(timeout=0) == _ref(h)
         router.shutdown()
 
 

@@ -68,6 +68,10 @@ _LOWER_LEAVES = {
     # host-tier warm-start TTFT ratio ("itl"/"ttft" substrings would
     # already classify these, but A/B gates must not hang off substrings)
     "itl_burst_disagg_vs_mixed", "ttft_warm_vs_cold",
+    # QoS gates: the paced high-priority tenant's p99 TTFT and
+    # end-to-end per-token latency with WFQ/priority admission on vs
+    # the untagged-FIFO baseline, both <= 0.8 (same no-substring rule)
+    "ttft_hipri_qos_on_vs_off", "itl_hipri_qos_on_vs_off",
 }
 
 # time/size units marking a LOWER-is-better metric — matched as leaf

@@ -107,6 +107,128 @@ def run_bench(trials: int = 15, prefill_chunk: int = 6) -> dict:
     }
 
 
+def run_hostile_tenants(args) -> dict:
+    """Hostile-tenant tier: a flooding low-priority tenant (bursts of
+    `--flood-factor` bulk requests, its per-tenant queue cap turning the
+    excess into typed FleetQueueFull backpressure) against a paced
+    high-priority gold tenant, on a threaded fleet that loses replica 0
+    mid-mix (EngineSupervisor rebuilds it).  The verdict: gold's p99
+    TTFT and inter-token latency — read from the per-tenant SLO windows
+    the engines already keep, never re-derived — must stay under the
+    `--hipri-*-bound` limits, every handle must resolve exactly once
+    token-exact, and no per-tenant counter may drift from the allocator
+    ground truth (fleet_check_invariants arms those identities on every
+    live replica)."""
+    import numpy as np
+
+    from paddle_tpu.inference import faults as F
+    from paddle_tpu.inference.router import FleetQueueFull, Router
+    from paddle_tpu.inference.supervisor import EngineSupervisor
+    from paddle_tpu.obs import metrics as obs_metrics
+
+    tenant_table = {
+        "gold": {"priority": 0, "weight": 4.0},
+        "bulk": {"priority": 3, "weight": 1.0,
+                 "max_pending": max(2, args.flood_factor // 2)},
+    }
+
+    def mk():
+        return F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
+                                prefill_chunk_tokens=args.prefill_chunk,
+                                block_q=2, tenants=tenant_table)
+
+    def ref(h):
+        return F.ScriptedEngine.reference_tokens(
+            h.prompt, h.max_new_tokens, h.eos_id)
+
+    rng = np.random.default_rng(args.seed)
+    engines = [mk() for _ in range(max(2, args.replicas))]
+    # the fault schedule: replica 0 crashes partway through the mix, so
+    # gold's latency bound holds ACROSS a death+rebuild, not just in
+    # steady state
+    engines[0].faults = F.FaultInjector(
+        [F.FaultRule("prefill", nth=10, crash=True)])
+    router = Router(engines, supervisor=EngineSupervisor(mk),
+                    threaded=True, health_interval=0.01,
+                    backoff_base=0.05)
+    handles, rejected = [], 0
+    violations = []
+    try:
+        for _ in range(args.bursts):
+            for _ in range(args.flood_factor):
+                prompt = rng.integers(
+                    0, F.ScriptedEngine.DEFAULT_VOCAB,
+                    int(rng.integers(2, 9))).tolist()
+                try:
+                    handles.append(router.submit(
+                        prompt, int(rng.integers(2, 7)), tenant="bulk"))
+                except FleetQueueFull:
+                    rejected += 1   # the cap working, not a failure
+            prompt = rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
+                                  int(rng.integers(2, 9))).tolist()
+            handles.append(router.submit(
+                prompt, int(rng.integers(2, 7)), tenant="gold"))
+            time.sleep(args.pace)
+        for h in handles:
+            try:
+                h.result(timeout=120)
+            except Exception:  # noqa: BLE001 — terminal typed errors
+                pass           # (death mid-decode) are legal outcomes;
+                               # exactly-once is checked below
+        # gold latency verdict from the per-tenant SLO windows
+        ttft, itl = [], []
+        for r in router.replicas:
+            if r.dead:
+                continue
+            slo = getattr(r.engine, "_tenant_slo", {}).get("gold")
+            if slo is None:
+                continue
+            ttft.extend(v for _, v in slo._samples.get("ttft", ()))
+            itl.extend(v for _, v in slo._samples.get("inter_token", ()))
+        p99_ttft = obs_metrics.percentile(ttft, 0.99) if ttft else 0.0
+        p99_itl = obs_metrics.percentile(itl, 0.99) if itl else 0.0
+        if not ttft:
+            violations.append("hostile tier: no gold TTFT samples "
+                              "survived — the paced tenant never ran")
+        if p99_ttft > args.hipri_ttft_bound:
+            violations.append(
+                f"hostile tier: gold p99 TTFT {p99_ttft:.3f}s exceeds "
+                f"the {args.hipri_ttft_bound}s bound under the flood")
+        if p99_itl > args.hipri_itl_bound:
+            violations.append(
+                f"hostile tier: gold p99 ITL {p99_itl:.3f}s exceeds "
+                f"the {args.hipri_itl_bound}s bound under the flood")
+        # exactly-once + token-exactness + per-replica zero leaks +
+        # per-tenant counter identities vs allocator ground truth
+        inv = F.fleet_check_invariants(router, handles, reference=ref,
+                                       raise_on_violation=False)
+        violations.extend(inv["violations"])
+        per_tenant = {}
+        for r in router.replicas:
+            if r.dead:
+                continue
+            for t, snap in r.engine.tenant_snapshot().items():
+                agg = per_tenant.setdefault(
+                    t, dict.fromkeys(snap["counters"], 0))
+                for k, v in snap["counters"].items():
+                    agg[k] = agg.get(k, 0) + v
+        return {
+            "ok": not violations,
+            "violations": violations,
+            "submitted": len(handles),
+            "rejected_backpressure": rejected,
+            "gold_p99_ttft_s": round(p99_ttft, 5),
+            "gold_p99_itl_s": round(p99_itl, 5),
+            "ttft_bound_s": args.hipri_ttft_bound,
+            "itl_bound_s": args.hipri_itl_bound,
+            "deaths": inv["stats"].get("deaths", 0),
+            "rebuilds": inv["stats"].get("rebuilds", 0),
+            "tenants": per_tenant,
+        }
+    finally:
+        router.shutdown(timeout=10)
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--schedules", type=int, default=25)
@@ -133,6 +255,33 @@ def main():
                          "request crosses a real prefill->decode KV "
                          "handoff while the schedules kill replicas — "
                          "including mid-kv_transfer")
+    ap.add_argument("--tenants", action="store_true",
+                    help="multi-tenant QoS tier: every replica gets a "
+                         "two-tier tenant table and each schedule's "
+                         "workload arrives tagged ~70%%/30%% bulk/gold "
+                         "(per-tenant counter identities arm inside "
+                         "every invariant check); after the seeded "
+                         "soak, a hostile-mix pass floods the bulk "
+                         "tenant while pacing gold under an injected "
+                         "replica death and FAILS if gold p99 "
+                         "TTFT/ITL degrades past the bounds below or "
+                         "any per-tenant counter drifts from the "
+                         "allocator ground truth")
+    ap.add_argument("--bursts", type=int, default=12,
+                    help="hostile tier: number of flood+paced bursts")
+    ap.add_argument("--flood-factor", type=int, default=10,
+                    help="hostile tier: bulk requests per burst (the "
+                         "flooding tenant; per-tenant caps turn the "
+                         "excess into typed backpressure)")
+    ap.add_argument("--pace", type=float, default=0.02,
+                    help="hostile tier: sleep between gold requests "
+                         "(the paced high-priority tenant)")
+    ap.add_argument("--hipri-ttft-bound", type=float, default=2.0,
+                    help="hostile tier: max tolerated gold p99 TTFT "
+                         "seconds (CPU-generous default)")
+    ap.add_argument("--hipri-itl-bound", type=float, default=1.0,
+                    help="hostile tier: max tolerated gold p99 "
+                         "inter-token seconds (CPU-generous default)")
     ap.add_argument("--flight-dir", default=None, metavar="DIR",
                     help="arm a flight recorder on every replica: a "
                          "replica death MUST leave a loadable dump here "
@@ -172,10 +321,18 @@ def main():
         return sorted(glob.glob(os.path.join(args.flight_dir,
                                              "flight_*.json")))
 
+    # soak-mode tenant table: two tiers, NO bulk queue cap — a capped
+    # tenant would turn fleet_run_schedule's submits into FleetQueueFull
+    # mid-schedule; the hostile tier below is where caps bite
+    soak_tenants = {
+        "gold": {"priority": 0, "weight": 4.0},
+        "bulk": {"priority": 3, "weight": 1.0},
+    } if args.tenants else None
+
     def mk():
         eng = F.ScriptedEngine(num_slots=2, page_size=4, max_seq_len=16,
                                prefill_chunk_tokens=args.prefill_chunk,
-                               block_q=2)
+                               block_q=2, tenants=soak_tenants)
         if args.flight_dir:
             from paddle_tpu.obs import flight as obs_flight
 
@@ -204,10 +361,16 @@ def main():
         engine_rules, router_rules = F.fleet_random_schedule(
             seed, n_replicas=args.replicas)
         rng = np.random.default_rng(seed)
-        workload = [(rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
-                                  int(rng.integers(2, 9))).tolist(),
-                     int(rng.integers(2, 7)))
-                    for _ in range(args.requests)]
+        workload = []
+        for _ in range(args.requests):
+            prompt = rng.integers(0, F.ScriptedEngine.DEFAULT_VOCAB,
+                                  int(rng.integers(2, 9))).tolist()
+            max_new = int(rng.integers(2, 7))
+            if args.tenants:
+                tenant = "bulk" if rng.random() < 0.7 else "gold"
+                workload.append((prompt, max_new, {"tenant": tenant}))
+            else:
+                workload.append((prompt, max_new))
         router_kw = None
         if args.disagg:
             # fresh store per schedule: cross-schedule warmth would make
@@ -307,10 +470,32 @@ def main():
               f"fleet-wide, {totals['thread_leaks']} thread leak(s) "
               "past shutdown")
 
+    hostile = None
+    if args.tenants:
+        # the hostile-mix pass: flood bulk, pace gold, kill a replica —
+        # gold's p99 bounds and the per-tenant drift identities are the
+        # soak verdict, same exit-code contract as the schedules above
+        hostile = run_hostile_tenants(args)
+        if not hostile["ok"]:
+            violations += len(hostile["violations"])
+            for v in hostile["violations"]:
+                print(f"[QOS ] {v}")
+        print(f"hostile tenants: gold p99 ttft="
+              f"{hostile['gold_p99_ttft_s']}s "
+              f"(bound {hostile['ttft_bound_s']}s) p99 itl="
+              f"{hostile['gold_p99_itl_s']}s "
+              f"(bound {hostile['itl_bound_s']}s) "
+              f"submitted={hostile['submitted']} "
+              f"backpressured={hostile['rejected_backpressure']} "
+              f"deaths={hostile['deaths']}")
+
     summary = {"schedules": args.schedules, "replicas": args.replicas,
                "disagg": bool(args.disagg), "violations": violations,
                "telemetry_mismatches": telemetry_bad,
-               "witness_armed": bool(args.witness), **totals}
+               "witness_armed": bool(args.witness),
+               "tenants_armed": bool(args.tenants), **totals}
+    if hostile is not None:
+        summary["hostile_tenants"] = hostile
     if args.json:
         print(json.dumps({"summary": summary, "reports": reports},
                          indent=2, default=str))

@@ -73,6 +73,14 @@ def main():
                          "land here on invariant violations and SIGTERM, "
                          "and the soak FAILS if any dump is unloadable "
                          "or a violation produced none")
+    ap.add_argument("--tenants", action="store_true",
+                    help="multi-tenant QoS tier: engines get a two-tier "
+                         "tenant table (gold: priority 0, weight 4; "
+                         "bulk: priority 3, weight 1, capped queue) and "
+                         "~70%% of each schedule's requests arrive "
+                         "tagged bulk vs ~30%% gold, so WFQ admission, "
+                         "tier-aware preemption and the per-tenant "
+                         "counter identities all soak under faults")
     ap.add_argument("--no-witness", dest="witness", action="store_false",
                     help="disarm the lock-order witness (armed by "
                          "default: every schedule's locks are wrapped, "
@@ -102,6 +110,15 @@ def main():
 
         obs_flight.install_sigterm(recorders)
 
+    # hostile-tenant tier: a heavyweight high-priority tenant next to a
+    # capped bulk tenant — the per-tenant invariant identities in
+    # faults.check_invariants arm automatically once the engine carries
+    # a tenant table
+    tenant_table = {
+        "gold": {"priority": 0, "weight": 4.0},
+        "bulk": {"priority": 3, "weight": 1.0, "max_pending": 6},
+    } if args.tenants else None
+
     def make_engine(mode, tag):
         def make():
             eng = LLMEngine(
@@ -109,7 +126,8 @@ def main():
                 max_seq_len=16, num_pages=args.num_pages,
                 preempt_mode=mode,
                 prefill_chunk_tokens=args.prefill_chunk, block_q=2,
-                spec_k=args.spec_k, drafter=drafter)
+                spec_k=args.spec_k, drafter=drafter,
+                tenants=tenant_table)
             if args.flight_dir:
                 from paddle_tpu.obs import flight as obs_flight
 
@@ -125,6 +143,7 @@ def main():
               "swapped_in": 0, "prefix_hits": 0, "prefix_cow_copies": 0,
               "prefix_evictions": 0, "lock_acquisitions": 0,
               "thread_leaks": 0}
+    tenant_totals = {}  # tenant -> summed counters across schedules
     for i in range(args.schedules):
         seed = args.seed + i
         mode = (args.mode if args.mode != "alternate"
@@ -142,7 +161,12 @@ def main():
             else:
                 prompt = rng.integers(0, cfg.vocab_size,
                                       int(rng.integers(2, 9))).tolist()
-            workload.append((prompt, int(rng.integers(2, 7))))
+            if args.tenants:
+                tenant = "bulk" if rng.random() < 0.7 else "gold"
+                workload.append((prompt, int(rng.integers(2, 7)),
+                                 {"tenant": tenant}))
+            else:
+                workload.append((prompt, int(rng.integers(2, 7))))
         dumps_before = len(_flight_dumps(args.flight_dir))
         try:
             report = F.run_schedule(make_engine(mode, f"s{seed}"), rules,
@@ -178,6 +202,12 @@ def main():
             totals["thread_leaks"] += len(threads.get("leaked", ()))
             totals["lock_acquisitions"] += threads.get(
                 "witness", {}).get("acquisitions", 0)
+            for tname, tsnap in report["stats"].get("tenants",
+                                                    {}).items():
+                agg = tenant_totals.setdefault(
+                    tname, dict.fromkeys(tsnap["counters"], 0))
+                for k, v in tsnap["counters"].items():
+                    agg[k] = agg.get(k, 0) + v
         status = "ok " if report["ok"] else "LEAK"
         line = (f"[{status}] seed={seed} mode={mode:9s} "
                 f"rules={[repr(r) for r in rules]}")
@@ -186,6 +216,11 @@ def main():
                      f" completed={report['completed']}"
                      f" failed={report['failed']}"
                      f" preemptions={report['stats']['preemptions']}")
+            if args.tenants:
+                tn = report["stats"].get("tenants", {})
+                line += " tenants=" + ",".join(
+                    f"{t}:{s['counters']['completed']}"
+                    for t, s in sorted(tn.items()))
         else:
             line += f" violations={report['violations']}"
         print(line)
@@ -227,9 +262,19 @@ def main():
               f"{totals['lock_acquisitions']} lock acquisition(s), "
               f"{totals['thread_leaks']} thread leak(s)")
 
+    if args.tenants:
+        # per-tenant QoS verdict: these counters were already checked
+        # against the untagged totals (sum identities) and the queue
+        # ground truth inside every schedule's check_invariants — the
+        # line makes the coverage visible in the soak output
+        print("tenants: " + json.dumps(tenant_totals, sort_keys=True))
+
     summary = {"schedules": args.schedules, "violations": violations,
                "telemetry_mismatches": telemetry_bad,
-               "witness_armed": bool(args.witness), **totals}
+               "witness_armed": bool(args.witness),
+               "tenants_armed": bool(args.tenants), **totals}
+    if args.tenants:
+        summary["tenant_totals"] = tenant_totals
     if args.json:
         print(json.dumps({"summary": summary, "reports": reports},
                          indent=2, default=str))
