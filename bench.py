@@ -1304,6 +1304,35 @@ def _run_threadlint(timeout: float = 300.0) -> dict:
         return {"error": repr(e)[:300]}
 
 
+def _run_kernellint(timeout: float = 300.0) -> dict:
+    """extra.kernellint: the Pallas kernel verifier's verdict on every
+    shipped kernel plus a generated fused-chain kernel
+    (tools/graphlint.py --kernels --json, CPU subprocess) — per-kernel
+    severity counts from the static block-index/coverage/VMEM/dtype
+    proofs.  Static only (tracing, no kernel executes); BENCH rounds
+    track kernel-contract drift, and tools/bench_diff.py treats every
+    kernellint counter as lower-is-better."""
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "graphlint.py")
+    argv = [sys.executable, script, "--kernels", "--json"]
+    try:
+        out = subprocess.run(
+            argv, capture_output=True, text=True, timeout=timeout,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"})
+        if out.returncode not in (0, 1):
+            return {"error": f"rc={out.returncode} "
+                             f"{out.stderr.strip()[-300:]}"}
+        d = json.loads(out.stdout.strip().splitlines()[-1])
+        counts = d.get("counts", {})
+        return {"ok": d.get("ok", False), "counts": counts,
+                "findings_total": sum(sum(c.values())
+                                      for c in counts.values())}
+    except subprocess.TimeoutExpired:
+        return {"error": f"kernellint timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001 — lint must not kill the bench
+        return {"error": repr(e)[:300]}
+
+
 def _run_spmd(timeout: float = 600.0) -> dict:
     """extra.spmd: the SPMD propagation tier's verdict on the sharded
     llama train step under a 2x2 (dp x tp) mesh — per-eqn sharding
@@ -1488,6 +1517,7 @@ def main():
     graphlint_mem_peaks = graphlint_extra.pop("mem_peak_bytes", None)
     rewrite_extra = graphlint_extra.pop("rewrite", None)
     threadlint_extra = _run_threadlint()
+    kernellint_extra = _run_kernellint()
     spmd_extra = _run_spmd()
     router_extra = _run_router()
 
@@ -1560,6 +1590,10 @@ def main():
             # --threads): per-module race/lock-order/blocking/leak
             # finding counts — all lower-is-better in bench_diff
             "threadlint": threadlint_extra,
+            # Pallas kernel verifier (graphlint --kernels): per-kernel
+            # OOB/coverage/VMEM/dtype finding counts over the shipped
+            # kernels + a generated fused chain — lower-is-better
+            "kernellint": kernellint_extra,
             # per-model static memory peak (jaxpr liveness walker) so
             # BENCH_*.json tracks the footprint trend round over round
             "graphlint_mem_peak_bytes": graphlint_mem_peaks,

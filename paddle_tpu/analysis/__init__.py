@@ -30,6 +30,19 @@ rebuilt where it belongs under XLA — in TWO tiers:
                    LockWitness` (the chaos soaks' lock-order witness),
                    the same static-predicts/dynamic-confirms contract
                    `equiv.py` gives the rewrite tier.
+  tier 6 (kernels):`kernellint.analyze_kernels()` opens every
+                   `pallas_call` eqn the other tiers treat as opaque —
+                   interval arithmetic over BlockSpec index maps proves
+                   in-bounds block reads and exactly-once output
+                   coverage (KERNEL_OOB_BLOCK / KERNEL_OUT_UNCOVERED /
+                   KERNEL_OUT_OVERLAP / KERNEL_DEAD_GRID_CELL), a
+                   per-chip VMEM footprint model predicts OOMs
+                   (KERNEL_VMEM_OVERFLOW, exported as
+                   `kernellint.vmem_bytes` for the autotuner), and
+                   dtype discipline catches low-precision accumulators
+                   (KERNEL_LOWP_ACCUM / KERNEL_DTYPE_MISMATCH).  Runs
+                   inside every analyze call too, so the rewrite tier's
+                   re-lint gate rejects generated kernels that fail it.
 
 On top of findings, `fixes.suggest_fixes(report)` emits concrete patch
 suggestions (exact donate_argnums, constraint insertion points, dtype
@@ -60,6 +73,7 @@ from . import checkers as _checkers  # noqa: F401 — registers the jaxpr set
 from . import memory  # noqa: F401 — registers the memory checker
 from . import spmd  # noqa: F401 — registers the mesh-aware SPMD tier
 from . import threadlint  # noqa: F401 — the lock-discipline tier (v5)
+from . import kernellint  # noqa: F401 — the Pallas kernel verifier (v6)
 from .hlo import (  # noqa: F401
     analyze_hlo, lint_bucket_menu, list_hlo_checkers, register_hlo_checker,
 )
@@ -81,5 +95,5 @@ __all__ = [
     "merge_reports", "register_checker", "register_hlo_checker",
     "register_rewrite", "rewrite", "rewrite_jaxpr", "rewrite_lib",
     "suppressions", "cost", "comm_cost", "memory", "hlo", "fixes", "spmd",
-    "threadlint",
+    "threadlint", "kernellint",
 ]

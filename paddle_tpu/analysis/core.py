@@ -449,6 +449,11 @@ _DEFAULT_OPTIONS = {
     # edge "straddle" the edge (a near-duplicate compile + pad waste)
     "bucket_straddle_slack": 1.25,
     "bucket_align": 4,
+    # kernellint tier (analysis/kernellint.py) ------------------------
+    # chip kind for the VMEM budget (None = the v5e default fleet chip);
+    # an explicit byte budget overrides the table entirely
+    "kernellint_chip": None,
+    "kernellint_vmem_budget_bytes": None,
 }
 
 

@@ -62,6 +62,20 @@ def _pallas_kernel_name(eqn) -> str:
     return f"{name if isinstance(name, str) else ''} {info}"
 
 
+def _match_pallas_formula(table: Dict[str, Callable],
+                          name: str) -> Optional[Callable]:
+    """Longest-match-wins over the registered name substrings: '_ragged'
+    must not swallow a '_ragged_fused' registration (dict order made the
+    winner depend on import order, silently aliasing cost attribution
+    between kernels)."""
+    best = None
+    best_len = -1
+    for sub, fn in table.items():
+        if sub in name and len(sub) > best_len:
+            best, best_len = fn, len(sub)
+    return best
+
+
 def _numel(aval) -> int:
     shape = getattr(aval, "shape", None)
     if shape is None:
@@ -103,9 +117,9 @@ def eqn_flops(eqn) -> float:
             if ce is not None and getattr(ce, "flops", None):
                 return float(ce.flops)
             name = _pallas_kernel_name(eqn)
-            for sub, fn in _PALLAS_FLOPS.items():
-                if sub in name:
-                    return float(fn(eqn))
+            fn = _match_pallas_formula(_PALLAS_FLOPS, name)
+            if fn is not None:
+                return float(fn(eqn))
             return 0.0
         if prim in ("pjit", "scan", "while", "cond", "custom_jvp_call",
                     "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
@@ -155,9 +169,9 @@ def eqn_bytes(eqn) -> int:
         prim = eqn.primitive.name
         if prim == "pallas_call":
             name = _pallas_kernel_name(eqn)
-            for sub, fn in _PALLAS_BYTES.items():
-                if sub in name:
-                    return int(fn(eqn))
+            fn = _match_pallas_formula(_PALLAS_BYTES, name)
+            if fn is not None:
+                return int(fn(eqn))
         elif prim in _DATA_MOVEMENT_PRIMS:
             return _moved_bytes(eqn)
         return sum(aval_bytes(v.aval) for v in list(eqn.invars)

@@ -1,0 +1,1066 @@
+"""kernellint — Graph Doctor tier 6: static verification of Pallas kernels.
+
+Every other tier stops at the `pallas_call` boundary and trusts the kernel
+body blindly — only runtime interpret-mode tests catch index bugs.  This
+tier opens the eqn's params (`grid_mapping`, the kernel jaxpr) and proves
+what it can about the kernel CONTRACT before anything runs:
+
+  * an interval-arithmetic evaluator over each BlockSpec index-map jaxpr
+    and the grid proves in-bounds block reads/writes and exactly-once
+    output coverage;
+  * a per-chip-generation VMEM footprint model (double-buffered blocks +
+    scratch, keyed on the same v3..v6e table style `comm_cost.py` uses)
+    predicts OOMs statically and is exported as `vmem_bytes(...)` so the
+    autotuner item can prune invalid block-shape sweep points before
+    ever compiling them;
+  * dtype discipline inside the kernel jaxpr: low-precision dots without
+    an f32 accumulator and scratch/output precision laundering.
+
+Finding codes:
+  KERNEL_OOB_BLOCK      an index map emits a block index outside
+                        [0, ceil(dim/block)-1] for some grid cell (ERROR)
+  KERNEL_OUT_UNCOVERED  an output dimension has blocks no grid cell
+                        writes (ERROR)
+  KERNEL_OUT_OVERLAP    grid dims unused by an output index map are not
+                        the innermost suffix — revisits of the same
+                        output block are non-consecutive, so the
+                        accumulate-then-flush idiom cannot apply (WARNING)
+  KERNEL_DEAD_GRID_CELL a `pl.when` predicate is statically false for
+                        EVERY grid cell — the guarded body never runs
+                        (WARNING)
+  KERNEL_VMEM_OVERFLOW  static footprint exceeds the chip's VMEM budget
+                        (WARNING; budget from `VMEM_BYTES_BY_KIND` or the
+                        `kernellint_vmem_budget_bytes` option)
+  KERNEL_LOWP_ACCUM     bf16/f16 dot whose result stays low-precision, or
+                        a low-precision scratch ref that is both read and
+                        written (a running sum losing mantissa) (WARNING)
+  KERNEL_DTYPE_MISMATCH float scratch strictly narrower than a float
+                        output — accumulating below output precision
+                        (WARNING)
+  KERNEL_ASSUME         (INFO) sites where in-bounds/coverage is ASSUMED,
+                        not proven: data-dependent prefetch indices (the
+                        PagedKVCache invariant that page-table entries are
+                        valid pool indices), unproven surjectivity,
+                        trailing-dim accumulate revisits
+  KERNEL_VMEM_FOOTPRINT (INFO) the static footprint with a per-operand
+                        breakdown — bench and the CLI surface it
+
+Soundness: intervals over-approximate, so OOB/UNCOVERED fire only when
+the violating endpoint is *attained* (tracked by `Ival.exact`: constants,
+grid vars, +,-,*, //const and %const preserve attainment) or the WHOLE
+interval is out of range.  Approximate bounds that merely straddle the
+limit are demoted to KERNEL_ASSUME.  Correlated subexpressions (``i-i``)
+can defeat the attainment claim in principle; real index maps are affine
+and the shipped-kernel suite pins zero false positives.
+
+Two surfaces: the registered checker ``kernellint`` runs inside every
+`analyze`/`analyze_jaxpr` call — which makes the rewrite tier's re-lint
+gate reject generated kernels that fail these checks (rollback for free)
+— and `analyze_kernels()` traces the shipped kernel wrappers directly
+(grad traces pull in the backward kernels) for `tools/graphlint.py
+--kernels`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .core import (
+    CheckContext, Finding, Report, Severity, aval_bytes, finalize_findings,
+    format_path, iter_eqns, register_checker, sub_jaxprs,
+)
+
+__all__ = [
+    "VMEM_BYTES_BY_KIND", "vmem_budget", "vmem_bytes", "kernel_id",
+    "lint_pallas_eqn", "analyze_kernels", "shipped_kernel_targets",
+    "Ival",
+]
+
+# ---------------------------------------------------------------------------
+# per-chip VMEM budgets (bytes) — most-specific-first substring match on the
+# device-kind string, same convention as comm_cost.LINK_BW_BY_KIND and
+# obs.mfu.PEAK_FLOPS_BY_KIND.  Conservative usable budgets (~16 MB/core per
+# the TPU memory hierarchy; newer parts carry more): the point is a STATIC
+# OOM predictor, so erring low turns a compile-time Mosaic failure into a
+# lint finding.  The `kernellint_vmem_budget_bytes` option overrides.
+VMEM_BYTES_BY_KIND: Tuple[Tuple[str, int], ...] = (
+    ("v6e", 32 << 20), ("v6", 32 << 20),
+    ("v5 lite", 16 << 20), ("v5e", 16 << 20), ("v5litepod", 16 << 20),
+    ("v5p", 32 << 20), ("v5", 32 << 20),
+    ("v4", 16 << 20),
+    ("v3", 16 << 20),
+)
+
+_DEFAULT_CHIP = "v5e"
+
+
+def vmem_budget(chip: Optional[str] = None) -> int:
+    """VMEM byte budget for a chip-kind string ("TPU v5 lite", "v4", ...).
+    Unknown/CPU chips budget at the v5e number so CPU lint runs still
+    predict what the default fleet chip would fit."""
+    kind = (chip or _DEFAULT_CHIP).lower()
+    for k, b in VMEM_BYTES_BY_KIND:
+        if k in kind:
+            return b
+    return dict(VMEM_BYTES_BY_KIND)["v5e"]
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic
+# ---------------------------------------------------------------------------
+
+_INF = float("inf")
+
+
+@dataclasses.dataclass(frozen=True)
+class Ival:
+    """Closed integer interval [lo, hi] with two provenance bits.
+
+    `exact`: both endpoints are attained by some grid cell — the license
+    to report `hi > max` as a REAL out-of-bounds access instead of an
+    artifact of over-approximation.  `from_prefetch`: the value depends
+    on an SMEM scalar-prefetch load (page tables, group offsets) — never
+    provable statically, always reported as an assumption."""
+
+    lo: float
+    hi: float
+    exact: bool = True
+    from_prefetch: bool = False
+
+    @property
+    def bounded(self) -> bool:
+        return self.lo > -_INF and self.hi < _INF
+
+    @property
+    def singleton(self) -> bool:
+        # lo == hi means the VALUE is known (bounds are always sound),
+        # independent of the attainment flag
+        return self.lo == self.hi
+
+
+TOP = Ival(-_INF, _INF, exact=False)
+PREFETCH_TOP = Ival(-_INF, _INF, exact=False, from_prefetch=True)
+
+
+def _const(v) -> Ival:
+    return Ival(float(v), float(v))
+
+
+def _taint(*xs: Ival) -> bool:
+    return any(x.from_prefetch for x in xs)
+
+
+def _mulc(x: float, y: float) -> float:
+    return 0.0 if (x == 0 or y == 0) else x * y  # kill inf*0 NaNs
+
+
+def _add(a: Ival, b: Ival) -> Ival:
+    return Ival(a.lo + b.lo, a.hi + b.hi, a.exact and b.exact, _taint(a, b))
+
+
+def _sub(a: Ival, b: Ival) -> Ival:
+    return Ival(a.lo - b.hi, a.hi - b.lo, a.exact and b.exact, _taint(a, b))
+
+
+def _mul(a: Ival, b: Ival) -> Ival:
+    c = (_mulc(a.lo, b.lo), _mulc(a.lo, b.hi),
+         _mulc(a.hi, b.lo), _mulc(a.hi, b.hi))
+    return Ival(min(c), max(c), a.exact and b.exact, _taint(a, b))
+
+
+def _neg(a: Ival) -> Ival:
+    return Ival(-a.hi, -a.lo, a.exact, a.from_prefetch)
+
+
+def _tdiv1(x: float, d: float) -> float:
+    if abs(x) == _INF:
+        return x if d > 0 else -x
+    return float(math.trunc(x / d))
+
+
+def _tdiv(a: Ival, b: Ival) -> Ival:
+    """lax.div — truncating integer division."""
+    t = _taint(a, b)
+    if b.singleton and b.lo != 0:
+        d = b.lo
+        c = (_tdiv1(a.lo, d), _tdiv1(a.hi, d))
+        # trunc-div by a constant is monotonic: endpoints stay attained
+        return Ival(min(c), max(c), a.exact and b.exact, t)
+    if a.bounded and b.bounded and (b.lo > 0 or b.hi < 0):
+        c = [_tdiv1(x, d) for x in (a.lo, a.hi) for d in (b.lo, b.hi)]
+        return Ival(min(c), max(c), False, t)
+    return dataclasses.replace(TOP, from_prefetch=t)
+
+
+def _trem(a: Ival, b: Ival) -> Ival:
+    """lax.rem — C-style remainder (sign of the dividend)."""
+    t = _taint(a, b)
+    if b.singleton and b.lo != 0:
+        d = abs(b.lo)
+        if a.lo >= 0 and a.hi < d:
+            return dataclasses.replace(a, from_prefetch=t)  # identity
+        if a.lo >= 0:
+            full = a.bounded and (a.hi - a.lo + 1) >= d
+            return Ival(0.0, d - 1.0, a.exact and full, t)
+        return Ival(-(d - 1.0), d - 1.0, False, t)
+    return dataclasses.replace(TOP, from_prefetch=t)
+
+
+def _floordiv(a: Ival, b: Ival) -> Optional[Ival]:
+    """jnp floor_divide (the `pjit[name=floor_divide]` wrapper)."""
+    if b.singleton and b.lo != 0 and a.bounded:
+        d = b.lo
+        c = (math.floor(a.lo / d), math.floor(a.hi / d))
+        return Ival(float(min(c)), float(max(c)), a.exact and b.exact,
+                    _taint(a, b))
+    return None
+
+
+def _pymod(a: Ival, b: Ival) -> Optional[Ival]:
+    """jnp remainder/mod (Python semantics: sign of the divisor)."""
+    if b.singleton and b.lo > 0:
+        d = b.lo
+        t = _taint(a, b)
+        if a.lo >= 0 and a.hi < d:
+            return dataclasses.replace(a, from_prefetch=t)
+        full = a.bounded and (a.hi - a.lo + 1) >= d
+        return Ival(0.0, d - 1.0, a.exact and full, t)
+    return None
+
+
+def _cmp(prim: str, a: Ival, b: Ival) -> Ival:
+    t = _taint(a, b)
+
+    def definite(v: int) -> Ival:
+        return Ival(float(v), float(v), True, t)
+
+    if prim == "lt":
+        if a.hi < b.lo:
+            return definite(1)
+        if a.lo >= b.hi:
+            return definite(0)
+    elif prim == "le":
+        if a.hi <= b.lo:
+            return definite(1)
+        if a.lo > b.hi:
+            return definite(0)
+    elif prim == "gt":
+        if a.lo > b.hi:
+            return definite(1)
+        if a.hi <= b.lo:
+            return definite(0)
+    elif prim == "ge":
+        if a.lo >= b.hi:
+            return definite(1)
+        if a.hi < b.lo:
+            return definite(0)
+    elif prim == "eq":
+        if a.hi < b.lo or b.hi < a.lo:
+            return definite(0)
+        if a.singleton and b.singleton and a.lo == b.lo:
+            return definite(1)
+    elif prim == "ne":
+        if a.hi < b.lo or b.hi < a.lo:
+            return definite(1)
+        if a.singleton and b.singleton and a.lo == b.lo:
+            return definite(0)
+    return Ival(0.0, 1.0, False, t)
+
+
+def _bool_and(a: Ival, b: Ival) -> Ival:
+    t = _taint(a, b)
+    if a.hi == 0 or b.hi == 0:
+        return Ival(0.0, 0.0, True, t)
+    if a.lo >= 1 and b.lo >= 1:
+        return Ival(1.0, 1.0, True, t)
+    return Ival(0.0, 1.0, False, t)
+
+
+def _bool_or(a: Ival, b: Ival) -> Ival:
+    t = _taint(a, b)
+    if a.lo >= 1 or b.lo >= 1:
+        return Ival(1.0, 1.0, True, t)
+    if a.hi == 0 and b.hi == 0:
+        return Ival(0.0, 0.0, True, t)
+    return Ival(0.0, 1.0, False, t)
+
+
+def _sign(a: Ival) -> Ival:
+    if a.lo > 0:
+        return Ival(1.0, 1.0, True, a.from_prefetch)
+    if a.hi < 0:
+        return Ival(-1.0, -1.0, True, a.from_prefetch)
+    lo = -1.0 if a.lo < 0 else 0.0
+    hi = 1.0 if a.hi > 0 else 0.0
+    return Ival(lo, hi, a.exact, a.from_prefetch)
+
+
+_IDENTITY_PRIMS = frozenset({
+    "convert_element_type", "stop_gradient", "squeeze", "reshape",
+    "broadcast_in_dim", "copy",
+})
+
+
+def _apply_prim(prim: str, params: dict, ins: List[Ival],
+                grid: Optional[Tuple[int, ...]]) -> Optional[List[Ival]]:
+    """Interval transfer function for one primitive over scalar int/bool
+    operands.  None = unhandled (caller defaults the outputs to TOP)."""
+    if prim == "program_id":
+        ax = int(params.get("axis", 0))
+        if grid is not None and 0 <= ax < len(grid):
+            return [Ival(0.0, float(int(grid[ax])) - 1.0)]
+        return [TOP]
+    if prim in _IDENTITY_PRIMS and len(ins) == 1:
+        return [ins[0]]
+    if len(ins) == 2:
+        a, b = ins
+        if prim == "add":
+            return [_add(a, b)]
+        if prim == "sub":
+            return [_sub(a, b)]
+        if prim == "mul":
+            return [_mul(a, b)]
+        if prim == "div":
+            return [_tdiv(a, b)]
+        if prim == "rem":
+            return [_trem(a, b)]
+        if prim == "max":
+            return [Ival(max(a.lo, b.lo), max(a.hi, b.hi),
+                         a.exact and b.exact, _taint(a, b))]
+        if prim == "min":
+            return [Ival(min(a.lo, b.lo), min(a.hi, b.hi),
+                         a.exact and b.exact, _taint(a, b))]
+        if prim in ("lt", "le", "gt", "ge", "eq", "ne"):
+            return [_cmp(prim, a, b)]
+        if prim == "and":
+            return [_bool_and(a, b)]
+        if prim == "or":
+            return [_bool_or(a, b)]
+    if len(ins) == 1:
+        a = ins[0]
+        if prim == "neg":
+            return [_neg(a)]
+        if prim == "sign":
+            return [_sign(a)]
+        if prim == "abs":
+            c = (abs(a.lo), abs(a.hi), 0.0 if a.lo <= 0 <= a.hi else _INF)
+            lo = min(abs(a.lo), abs(a.hi)) if not (a.lo <= 0 <= a.hi) else 0.0
+            return [Ival(lo, max(abs(a.lo), abs(a.hi)), a.exact,
+                         a.from_prefetch)]
+        if prim == "not":
+            return [Ival(1.0 - a.hi, 1.0 - a.lo, a.exact, a.from_prefetch)]
+    if prim == "select_n" and len(ins) >= 2:
+        pred, cases = ins[0], ins[1:]
+        if pred.singleton and 0 <= int(pred.lo) < len(cases):
+            return [cases[int(pred.lo)]]
+        return [Ival(min(c.lo for c in cases), max(c.hi for c in cases),
+                     False, _taint(*ins))]
+    return None
+
+
+def _read(env: dict, atom) -> Ival:
+    """Atom -> interval: Literals become singletons, unknown vars TOP."""
+    val = getattr(atom, "val", None)
+    if val is not None or type(atom).__name__ == "Literal":
+        try:
+            arr = np.asarray(val)
+            if arr.ndim == 0 and arr.dtype.kind in "iub":
+                return _const(int(arr))
+        except Exception:  # noqa: BLE001 — opaque literal payloads
+            pass
+        return TOP
+    return env.get(atom, TOP)
+
+
+def _eval_jaxpr(jaxpr_or_closed, in_ivals: Sequence[Ival],
+                grid: Optional[Tuple[int, ...]] = None) -> List[Ival]:
+    """Evaluate a (Closed)Jaxpr of scalar index arithmetic over intervals.
+    `get` (an SMEM scalar-prefetch load in an index map) yields
+    PREFETCH_TOP; unhandled primitives yield TOP — both sound."""
+    closed = jaxpr_or_closed
+    jaxpr = getattr(closed, "jaxpr", closed)
+    consts = list(getattr(closed, "consts", ()) or ())
+    env: dict = {}
+    for v, iv in zip(jaxpr.invars, in_ivals):
+        env[v] = iv
+    for v, c in zip(jaxpr.constvars, consts):
+        try:
+            arr = np.asarray(c)
+            if arr.ndim == 0 and arr.dtype.kind in "iub":
+                env[v] = _const(int(arr))
+        except Exception:  # noqa: BLE001 — non-scalar consts stay TOP
+            pass
+    for eqn in jaxpr.eqns:
+        prim = eqn.primitive.name
+        ins = [_read(env, a) for a in eqn.invars]
+        if prim == "get":
+            outs: Optional[List[Ival]] = [PREFETCH_TOP] * len(eqn.outvars)
+        elif prim == "pjit":
+            name = str(eqn.params.get("name", ""))
+            special = None
+            if len(ins) == 2:
+                if name == "floor_divide":
+                    special = _floordiv(ins[0], ins[1])
+                elif name in ("remainder", "mod", "floor_remainder"):
+                    special = _pymod(ins[0], ins[1])
+            if special is not None:
+                outs = [special]
+            else:
+                outs = _eval_jaxpr(eqn.params["jaxpr"], ins, grid)
+        else:
+            outs = _apply_prim(prim, eqn.params, ins, grid)
+        if outs is None:
+            outs = [TOP] * len(eqn.outvars)
+        for ov, iv in zip(eqn.outvars, outs):
+            env[ov] = iv
+    return [_read(env, ov) for ov in jaxpr.outvars]
+
+
+# ---------------------------------------------------------------------------
+# structural helpers over the index-map jaxpr
+# ---------------------------------------------------------------------------
+
+
+def _is_literal(atom) -> bool:
+    return type(atom).__name__ == "Literal" or hasattr(atom, "val")
+
+
+def _grid_deps(jaxpr, n_grid: int) -> Dict[Any, Tuple[set, bool]]:
+    """var -> (set of grid-invar indices it depends on, prefetch bit)."""
+    deps: Dict[Any, Tuple[set, bool]] = {}
+    for i, v in enumerate(jaxpr.invars):
+        deps[v] = ({i}, False) if i < n_grid else (set(), True)
+    for v in jaxpr.constvars:
+        deps[v] = (set(), False)
+    for eqn in jaxpr.eqns:
+        g: set = set()
+        pf = False
+        for a in eqn.invars:
+            if _is_literal(a):
+                continue
+            dg, dp = deps.get(a, (set(), False))
+            g |= dg
+            pf |= dp
+        if eqn.primitive.name == "get":
+            pf = True
+        for ov in eqn.outvars:
+            deps[ov] = (g, pf)
+    return deps
+
+
+def _resolve_identity(jaxpr, atom):
+    """Follow single-input identity eqns (convert_element_type & co) back
+    to the underlying atom, so `i32(i)` still reads as the grid var i."""
+    defs = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            defs[ov] = eqn
+    seen = 0
+    while not _is_literal(atom) and atom in defs and seen < 8:
+        eqn = defs[atom]
+        if eqn.primitive.name in _IDENTITY_PRIMS and len(eqn.invars) == 1:
+            atom = eqn.invars[0]
+            seen += 1
+        else:
+            break
+    return atom
+
+
+# ---------------------------------------------------------------------------
+# kernel identity + VMEM footprint
+# ---------------------------------------------------------------------------
+
+_CHAIN_NAME_RE = re.compile(r"fused_chain\d+")
+
+
+def kernel_id(eqn) -> str:
+    """Stable `module.kernel_name` identity for baselines: resolves the
+    `_fwd_kernel` name collision between modules, and normalizes
+    generated `fused_chain<N>_s<site>` kernels to one id (site tags and
+    chain lengths are rewrite-run-unstable)."""
+    info = str(eqn.params.get("name_and_src_info")
+               or eqn.params.get("name") or "pallas_kernel")
+    name = info.split(" at ", 1)[0].strip() or "pallas_kernel"
+    mod = ""
+    if " at " in info:
+        src = info.split(" at ", 1)[1].split(":", 1)[0]
+        base = src.replace("\\", "/").rsplit("/", 1)[-1]
+        mod = base[:-3] if base.endswith(".py") else base
+    if _CHAIN_NAME_RE.search(name):
+        return f"{mod or 'pallas_fused_chain'}.fused_chain"
+    return f"{mod}.{name}" if mod else name
+
+
+def _block_numel(block_shape) -> int:
+    n = 1
+    for b in block_shape:
+        n *= int(b) if isinstance(b, (int, np.integer)) else 1
+    return n
+
+
+def _eqn_vmem_breakdown(eqn) -> Tuple[int, Dict[str, int]]:
+    """(total_bytes, {operand: bytes}) for one pallas_call eqn: every
+    block-mapped operand double-buffered (Mosaic pipelines the grid) plus
+    the scratch/accumulator refs at full size."""
+    gm = eqn.params.get("grid_mapping")
+    kj = eqn.params.get("jaxpr")
+    total = 0
+    rows: Dict[str, int] = {}
+    for idx, bm in enumerate(getattr(gm, "block_mappings", ()) or ()):
+        arr = getattr(bm, "array_shape_dtype", None)
+        if arr is None:
+            continue
+        try:
+            item = np.dtype(arr.dtype).itemsize
+        except Exception:  # noqa: BLE001 — opaque dtypes price at 0
+            item = 0
+        n = _block_numel(getattr(bm, "block_shape", ()) or ())
+        b = n * item * 2
+        rows[str(getattr(bm, "origin", f"operand[{idx}]"))] = b
+        total += b
+    num_scratch = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    if kj is not None and num_scratch:
+        for i, v in enumerate(kj.invars[len(kj.invars) - num_scratch:]):
+            b = aval_bytes(v.aval)
+            rows[f"scratch[{i}]"] = b
+            total += b
+    return total, rows
+
+
+def vmem_bytes(kernel, shapes: Sequence = (), chip: Optional[str] = None,
+               **kwargs) -> int:
+    """Static VMEM footprint (bytes) of the pallas_call(s) a callable
+    traces to — the autotuner's sweep-point pruner: compare against
+    `vmem_budget(chip)` before compiling a candidate block shape.
+
+    kernel: a callable (traced at `shapes`, which may be arrays or
+    ShapeDtypeStructs), an already-traced ClosedJaxpr, or a pallas_call
+    eqn.  Returns the MAX footprint across the pallas_calls found; the
+    `chip` argument is accepted for call-site symmetry with
+    `vmem_budget` and future per-chip packing rules."""
+    del chip  # the byte count is chip-independent; the budget is not
+    if hasattr(kernel, "primitive"):            # a pallas_call eqn
+        return _eqn_vmem_breakdown(kernel)[0]
+    closed = kernel
+    if callable(kernel) and not hasattr(kernel, "jaxpr"):
+        import jax
+
+        closed = jax.make_jaxpr(
+            lambda *a: kernel(*a, **kwargs))(*shapes)
+    sizes = [
+        _eqn_vmem_breakdown(eqn)[0]
+        for eqn, _path, _w in iter_eqns(closed)
+        if eqn.primitive.name == "pallas_call"
+    ]
+    if not sizes:
+        raise ValueError("no pallas_call found in the traced kernel")
+    return max(sizes)
+
+
+# ---------------------------------------------------------------------------
+# the linter proper
+# ---------------------------------------------------------------------------
+
+_LOW_FLOATS = ("bfloat16", "float16")
+
+
+def _dtype_name(dt) -> str:
+    try:
+        return np.dtype(dt).name
+    except Exception:  # noqa: BLE001 — opaque dtypes never match
+        return str(dt)
+
+
+def _is_float(dt) -> bool:
+    try:
+        d = np.dtype(dt)
+    except Exception:  # noqa: BLE001
+        return False
+    return d.kind == "f" or d.name in _LOW_FLOATS
+
+
+def _opt(ctx, key: str, default=None):
+    if ctx is not None:
+        return ctx.opt(key, default)
+    from .core import _DEFAULT_OPTIONS
+
+    return _DEFAULT_OPTIONS.get(key, default)
+
+
+def lint_pallas_eqn(eqn, path: Tuple[str, ...] = (),
+                    ctx=None) -> List[Finding]:
+    """All kernellint findings for ONE pallas_call eqn."""
+    p = eqn.params if isinstance(eqn.params, dict) else {}
+    gm = p.get("grid_mapping")
+    kj = p.get("jaxpr")
+    if gm is None or kj is None:
+        return []
+    kid = kernel_id(eqn)
+    loc = f"{format_path(tuple(path), eqn)}[{kid}]"
+    findings: List[Finding] = []
+    assumes: List[str] = []
+
+    grid = tuple(getattr(gm, "grid", ()) or ())
+    static_grid = all(isinstance(g, (int, np.integer)) for g in grid)
+    igrid: Optional[Tuple[int, ...]] = \
+        tuple(int(g) for g in grid) if static_grid else None
+    if not static_grid:
+        assumes.append("dynamic grid: block bounds/coverage not provable")
+    n_grid = len(grid)
+    num_inputs = int(getattr(gm, "num_inputs", 0) or 0)
+    num_scratch = int(getattr(gm, "num_scratch_operands", 0) or 0)
+    bms = tuple(getattr(gm, "block_mappings", ()) or ())
+
+    out_dtypes: List[Any] = []
+    for idx, bm in enumerate(bms):
+        is_out = idx >= num_inputs
+        arr = getattr(bm, "array_shape_dtype", None)
+        imj = getattr(bm, "index_map_jaxpr", None)
+        block = tuple(getattr(bm, "block_shape", ()) or ())
+        origin = str(getattr(bm, "origin", "") or f"operand[{idx}]")
+        if arr is None or imj is None:
+            continue
+        if is_out:
+            out_dtypes.append(arr.dtype)
+        if "unblocked" in str(getattr(bm, "indexing_mode", "")).lower():
+            assumes.append(f"{origin}: Unblocked indexing not modeled")
+            continue
+        dims = tuple(int(d) for d in getattr(arr, "shape", ()))
+        if igrid is None:
+            continue
+        jx = getattr(imj, "jaxpr", imj)
+        in_ivals = [Ival(0.0, float(g) - 1.0) for g in igrid]
+        in_ivals += [PREFETCH_TOP] * max(0, len(jx.invars) - n_grid)
+        out_ivals = _eval_jaxpr(imj, in_ivals, igrid)
+        nblocks_by_dim = []
+        for d in range(min(len(dims), len(block), len(out_ivals))):
+            bsz = block[d] if isinstance(block[d], (int, np.integer)) else 1
+            nblocks = -(-dims[d] // max(int(bsz), 1))
+            nblocks_by_dim.append(nblocks)
+            iv = out_ivals[d]
+            mx = nblocks - 1
+            tag = f"{origin} dim{d}"
+            rng = f"[{iv.lo:g}, {iv.hi:g}]"
+            if iv.from_prefetch:
+                assumes.append(
+                    f"{tag}: data-dependent block index (scalar prefetch); "
+                    "in-bounds assumed — the caller's table invariant")
+            elif not iv.bounded:
+                assumes.append(f"{tag}: unbounded block index; "
+                               "in-bounds assumed")
+            elif iv.lo > mx or iv.hi < 0:
+                findings.append(Finding(
+                    Severity.ERROR, "KERNEL_OOB_BLOCK", loc,
+                    f"{tag}: every grid cell reads block index {rng}, "
+                    f"entirely outside [0, {mx}] "
+                    f"({dims[d]} elements / block {block[d]})",
+                    suggestion="fix the BlockSpec index map or the grid",
+                    data={"kernel": kid, "operand": origin, "dim": d,
+                          "index_lo": iv.lo, "index_hi": iv.hi,
+                          "nblocks": nblocks}))
+            elif iv.exact and (iv.hi > mx or iv.lo < 0):
+                findings.append(Finding(
+                    Severity.ERROR, "KERNEL_OOB_BLOCK", loc,
+                    f"{tag}: index map emits block index {rng} for some "
+                    f"grid cell; valid range is [0, {mx}] "
+                    f"({dims[d]} elements / block {block[d]})",
+                    suggestion="fix the BlockSpec index map or the grid",
+                    data={"kernel": kid, "operand": origin, "dim": d,
+                          "index_lo": iv.lo, "index_hi": iv.hi,
+                          "nblocks": nblocks}))
+            elif iv.hi > mx or iv.lo < 0:
+                assumes.append(
+                    f"{tag}: approximate bounds {rng} straddle [0, {mx}]; "
+                    "not provably OOB")
+        if is_out:
+            findings += _coverage_findings(
+                jx, igrid, n_grid, nblocks_by_dim, origin, kid, loc, assumes)
+
+    # VMEM footprint vs the chip budget ---------------------------------
+    fp, rows = _eqn_vmem_breakdown(eqn)
+    chip = _opt(ctx, "kernellint_chip") or _DEFAULT_CHIP
+    budget = _opt(ctx, "kernellint_vmem_budget_bytes") or vmem_budget(chip)
+    if fp > budget:
+        findings.append(Finding(
+            Severity.WARNING, "KERNEL_VMEM_OVERFLOW", loc,
+            f"static VMEM footprint {fp} B (double-buffered blocks + "
+            f"scratch) exceeds the {chip} budget {int(budget)} B",
+            suggestion="shrink block shapes or scratch accumulators",
+            data={"kernel": kid, "vmem_bytes": fp,
+                  "budget_bytes": int(budget), "chip": chip,
+                  "breakdown": rows}))
+    findings.append(Finding(
+        Severity.INFO, "KERNEL_VMEM_FOOTPRINT", loc,
+        f"static VMEM footprint {fp} B of {int(budget)} B ({chip})",
+        data={"kernel": kid, "vmem_bytes": fp, "budget_bytes": int(budget),
+              "chip": chip, "breakdown": rows,
+              "grid": [int(g) if isinstance(g, (int, np.integer)) else -1
+                       for g in grid]}))
+
+    # kernel-body checks: dead pl.when cells + dtype discipline ---------
+    findings += _lint_kernel_body(eqn, kj, igrid, num_scratch,
+                                  out_dtypes, kid, loc)
+
+    if assumes:
+        shown = "; ".join(assumes[:3]) + ("; ..." if len(assumes) > 3 else "")
+        findings.append(Finding(
+            Severity.INFO, "KERNEL_ASSUME", loc,
+            f"{len(assumes)} unproven assumption(s): {shown}",
+            data={"kernel": kid, "assumptions": assumes}))
+    return findings
+
+
+def _coverage_findings(jx, igrid, n_grid, nblocks_by_dim, origin, kid,
+                       loc, assumes) -> List[Finding]:
+    """Exactly-once output coverage: every output dim must be a bare grid
+    var of matching extent or a constant over a single block; grid dims
+    unused by the map must be the innermost suffix (accumulate idiom)."""
+    findings: List[Finding] = []
+    deps = _grid_deps(jx, n_grid)
+    used: set = set()
+    for d, nblocks in enumerate(nblocks_by_dim):
+        if d >= len(jx.outvars):
+            break
+        ov = _resolve_identity(jx, jx.outvars[d])
+        if _is_literal(ov):
+            if nblocks > 1:
+                findings.append(Finding(
+                    Severity.ERROR, "KERNEL_OUT_UNCOVERED", loc,
+                    f"{origin} dim{d}: constant block index writes 1 of "
+                    f"{nblocks} blocks — the rest are never written",
+                    suggestion="index the dim with a grid variable",
+                    data={"kernel": kid, "dim": d, "nblocks": nblocks}))
+            continue
+        k = next((i for i in range(n_grid) if jx.invars[i] is ov), None)
+        if k is not None:
+            used.add(k)
+            if igrid[k] < nblocks:
+                findings.append(Finding(
+                    Severity.ERROR, "KERNEL_OUT_UNCOVERED", loc,
+                    f"{origin} dim{d}: grid dim {k} spans "
+                    f"{igrid[k]} block(s) but the output needs {nblocks} "
+                    f"— blocks [{igrid[k]}, {nblocks - 1}] never written",
+                    suggestion="grow the grid dim to ceil(dim/block)",
+                    data={"kernel": kid, "dim": d, "grid_dim": k,
+                          "grid_size": igrid[k], "nblocks": nblocks}))
+            continue
+        g, pf = deps.get(ov, (set(), False))
+        used |= g
+        why = "data-dependent (prefetch)" if pf else "computed"
+        assumes.append(f"{origin} dim{d}: {why} output index; "
+                       "exactly-once coverage assumed")
+    nontrivial = {d for d in range(n_grid) if igrid[d] > 1}
+    unused = nontrivial - used
+    used_nt = used & nontrivial
+    if unused:
+        if used_nt and min(unused) < max(used_nt):
+            findings.append(Finding(
+                Severity.WARNING, "KERNEL_OUT_OVERLAP", loc,
+                f"{origin}: grid dim(s) {sorted(unused)} revisit the same "
+                f"output block NON-consecutively (a used dim "
+                f"{max(used_nt)} iterates inside them) — the "
+                "accumulate-then-flush idiom cannot apply; later visits "
+                "overwrite finished blocks",
+                suggestion="move reduction dims innermost (last) in the "
+                           "grid",
+                data={"kernel": kid, "unused_dims": sorted(unused),
+                      "used_dims": sorted(used_nt)}))
+        else:
+            assumes.append(
+                f"{origin}: revisited over trailing grid dim(s) "
+                f"{sorted(unused)}; accumulate-then-flush assumed")
+    return findings
+
+
+def _lint_kernel_body(eqn, kj, igrid, num_scratch, out_dtypes, kid,
+                      loc) -> List[Finding]:
+    findings: List[Finding] = []
+    scratch_vars = list(kj.invars[len(kj.invars) - num_scratch:]) \
+        if num_scratch else []
+    ops: List[set] = [set() for _ in scratch_vars]
+    refmap = {v: i for i, v in enumerate(scratch_vars)}
+    dead_paths: List[str] = []
+    lowp_dots: List[str] = []
+
+    def walk(jaxpr, env, rmap, depth):
+        if depth > 12:
+            return
+        for e in jaxpr.eqns:
+            pn = e.primitive.name
+            ins = [_read(env, a) for a in e.invars]
+            if pn in ("get", "swap", "addupdate"):
+                tgt = e.invars[0]
+                if not _is_literal(tgt) and tgt in rmap:
+                    ops[rmap[tgt]].add(
+                        {"get": "r", "swap": "w", "addupdate": "acc"}[pn])
+                for ov in e.outvars:
+                    env[ov] = PREFETCH_TOP
+                continue
+            if pn == "cond":
+                branches = e.params.get("branches", ())
+                idx = ins[0] if ins else TOP
+                if (igrid is not None and idx.singleton and idx.lo == 0
+                        and len(branches) >= 2):
+                    live = [getattr(b, "jaxpr", b) for b in branches[1:]]
+                    if any(b.eqns for b in live):
+                        dead_paths.append(
+                            "pl.when predicate statically false for every "
+                            "grid cell")
+                for b in branches:
+                    bj = getattr(b, "jaxpr", b)
+                    sub_env, sub_rmap = {}, {}
+                    for bv, av in zip(bj.invars, e.invars[1:]):
+                        if not _is_literal(av) and av in rmap:
+                            sub_rmap[bv] = rmap[av]
+                        sub_env[bv] = _read(env, av)
+                    walk(bj, sub_env, sub_rmap, depth + 1)
+                continue
+            if pn == "dot_general":
+                ldt = _dtype_name(getattr(e.invars[0].aval, "dtype", ""))
+                odt = _dtype_name(getattr(e.outvars[0].aval, "dtype", ""))
+                if ldt in _LOW_FLOATS and odt in _LOW_FLOATS:
+                    lowp_dots.append(f"{ldt} dot accumulating in {odt}")
+            outs = _apply_prim(pn, e.params, ins, igrid)
+            if outs is not None:
+                for ov, iv in zip(e.outvars, outs):
+                    env[ov] = iv
+            for _label, sub, _w in sub_jaxprs(e):
+                sj = getattr(sub, "jaxpr", sub)
+                sub_env, sub_rmap = {}, {}
+                for bv, av in zip(sj.invars, e.invars):
+                    if not _is_literal(av) and av in rmap:
+                        sub_rmap[bv] = rmap[av]
+                    sub_env[bv] = _read(env, av)
+                walk(sj, sub_env, sub_rmap, depth + 1)
+
+    walk(kj, {}, refmap, 0)
+
+    for msg in dead_paths[:4]:
+        findings.append(Finding(
+            Severity.WARNING, "KERNEL_DEAD_GRID_CELL", loc,
+            f"{msg} — the guarded body never runs on any of the "
+            f"{int(np.prod(igrid or [1]))} grid cell(s)",
+            suggestion="drop the pl.when or fix its predicate",
+            data={"kernel": kid, "grid": list(igrid or ())}))
+    for msg in lowp_dots[:4]:
+        findings.append(Finding(
+            Severity.WARNING, "KERNEL_LOWP_ACCUM", loc,
+            f"{msg} — partial products lose mantissa before the reduce",
+            suggestion="pass preferred_element_type=jnp.float32 to the dot",
+            data={"kernel": kid}))
+    for i, v in enumerate(scratch_vars):
+        dt = _dtype_name(getattr(v.aval, "dtype", ""))
+        if dt in _LOW_FLOATS and ("acc" in ops[i]
+                                  or {"r", "w"} <= ops[i]):
+            findings.append(Finding(
+                Severity.WARNING, "KERNEL_LOWP_ACCUM", loc,
+                f"scratch[{i}] is {dt} and is read AND written — a "
+                "running sum accumulating below f32",
+                suggestion="allocate the accumulator as f32 scratch and "
+                           "cast on the final flush",
+                data={"kernel": kid, "scratch": i, "dtype": dt}))
+    out_f = [np.dtype(d) for d in out_dtypes if _is_float(d)]
+    scr_f = [np.dtype(getattr(v.aval, "dtype", "O"))
+             for v in scratch_vars
+             if _is_float(getattr(v.aval, "dtype", None))]
+    if out_f and scr_f:
+        smin = min(scr_f, key=lambda d: d.itemsize)
+        omax = max(out_f, key=lambda d: d.itemsize)
+        if smin.itemsize < omax.itemsize:
+            findings.append(Finding(
+                Severity.WARNING, "KERNEL_DTYPE_MISMATCH", loc,
+                f"float scratch {smin.name} is narrower than the "
+                f"{omax.name} output it feeds — the extra output "
+                "precision is laundered, not computed",
+                suggestion="widen the scratch dtype to the output dtype",
+                data={"kernel": kid, "scratch_dtype": smin.name,
+                      "out_dtype": omax.name}))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# surfaces: the registered checker + the standalone shipped-kernel sweep
+# ---------------------------------------------------------------------------
+
+
+@register_checker("kernellint")
+def check_pallas_kernels(ctx: CheckContext):
+    """Tier-6 registered checker: walks every pallas_call eqn (pjit/scan
+    included — iter_eqns recurses; only the pallas body itself is opaque
+    to the OTHER tiers).  Running inside analyze_jaxpr means the rewrite
+    tier's re-lint gate rejects generated kernels that fail kernellint."""
+    for eqn, path, _w in iter_eqns(ctx.closed_jaxpr):
+        if eqn.primitive.name == "pallas_call":
+            yield from lint_pallas_eqn(eqn, path, ctx)
+
+
+def _t_flash():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_attention import flash_attention_pallas
+
+    B, S, Hq, Hkv, D = 1, 256, 2, 1, 64
+    q = jnp.zeros((B, S, Hq, D), jnp.float32)
+    k = jnp.zeros((B, S, Hkv, D), jnp.float32)
+    v = jnp.zeros((B, S, Hkv, D), jnp.float32)
+
+    def loss(q, k, v):
+        return flash_attention_pallas(q, k, v, causal=True).sum()
+
+    return jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+
+def _t_gmm():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_grouped_matmul import grouped_matmul
+
+    lhs = jnp.zeros((256, 128), jnp.float32)
+    rhs = jnp.zeros((2, 128, 128), jnp.float32)
+    gs = jnp.array([128, 128], jnp.int32)
+
+    def loss(lhs, rhs):
+        return grouped_matmul(lhs, rhs, gs, impl="interpret").sum()
+
+    return jax.make_jaxpr(jax.grad(loss, argnums=(0, 1)))(lhs, rhs)
+
+
+def _t_ragged():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_ragged_attention import ragged_attention_pallas
+
+    T, Hq, Hkv, D, ps = 8, 2, 1, 128, 4
+    q = jnp.zeros((T, Hq, D), jnp.float32)
+    kp = jnp.zeros((4, ps, Hkv, D), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    span_pt = jnp.array([[0, 1], [2, 3]], jnp.int32)
+    block_seq = jnp.array([0, 1], jnp.int32)
+    block_qpos = jnp.array([0, 0], jnp.int32)
+    span_len = jnp.array([4, 4], jnp.int32)
+    ctx_len = jnp.array([8, 8], jnp.int32)
+    return jax.make_jaxpr(
+        lambda *a: ragged_attention_pallas(*a, interpret=True))(
+            q, kp, vp, span_pt, block_seq, block_qpos, span_len, ctx_len)
+
+
+def _t_paged():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_paged_attention import paged_attention_pallas
+
+    q = jnp.zeros((2, 2, 128), jnp.float32)
+    kp = jnp.zeros((4, 4, 1, 128), jnp.float32)
+    vp = jnp.zeros_like(kp)
+    pt = jnp.array([[0, 1], [2, 3]], jnp.int32)
+    lengths = jnp.array([8, 6], jnp.int32)
+    return jax.make_jaxpr(
+        lambda *a: paged_attention_pallas(*a, interpret=True))(
+            q, kp, vp, pt, lengths)
+
+
+def _t_norm():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_norm import rms_norm_pallas
+
+    x = jnp.zeros((64, 128), jnp.float32)
+    w = jnp.ones((128,), jnp.float32)
+    return jax.make_jaxpr(rms_norm_pallas)(x, w)
+
+
+def _t_adaln():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_norm import adaln_modulate_pallas
+
+    x = jnp.zeros((2, 256, 128), jnp.float32)
+    shift = jnp.zeros((2, 128), jnp.float32)
+    scale = jnp.zeros((2, 128), jnp.float32)
+    return jax.make_jaxpr(adaln_modulate_pallas)(x, shift, scale)
+
+
+def _t_decode():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_decode_step import fused_decode_step_pallas
+
+    sel = jnp.zeros((8, 128), jnp.float32)
+    head = jnp.zeros((128, 256), jnp.float32)
+    key = jax.random.PRNGKey(0)
+    return jax.make_jaxpr(
+        lambda s, h, k: fused_decode_step_pallas(
+            s, h, k, temperature=0.0, interpret=True))(sel, head, key)
+
+
+def _t_chain():
+    import jax
+    import jax.numpy as jnp
+
+    from ..kernels.pallas_fused_chain import fused_elementwise_chain
+
+    fn = fused_elementwise_chain(
+        lambda a, b: jnp.tanh(a) * b + a, n_ops=3, mode="pallas",
+        site="kernellint")
+    x = jnp.zeros((512, 128), jnp.float32)
+    y = jnp.ones((512, 128), jnp.float32)
+    return jax.make_jaxpr(fn)(x, y)
+
+
+def shipped_kernel_targets() -> Dict[str, Callable[[], Any]]:
+    """name -> zero-arg builder returning a traced ClosedJaxpr containing
+    the shipped Pallas kernels.  Grad traces pull in the backward kernels
+    (_dq/_dkv via flash, _tgmm via grouped_matmul); `fused_chain` is a
+    GENERATED kernel — the same emission path the rewrite tier uses."""
+    return {
+        "flash_attention": _t_flash,
+        "grouped_matmul": _t_gmm,
+        "ragged_attention": _t_ragged,
+        "paged_attention": _t_paged,
+        "rms_norm": _t_norm,
+        "adaln": _t_adaln,
+        "decode_step": _t_decode,
+        "fused_chain": _t_chain,
+    }
+
+
+def analyze_kernels(targets: Optional[Sequence[str]] = None,
+                    options: Optional[dict] = None,
+                    suppress: Sequence[str] = (),
+                    config: Optional[dict] = None) -> Dict[str, Report]:
+    """Standalone tier-6 sweep: trace each shipped kernel target and lint
+    every pallas_call found.  Returns {kernel_id: Report}, aggregated
+    across targets (one kernel reached from several traces reports
+    once per reaching eqn)."""
+    builders = shipped_kernel_targets()
+    names = list(targets) if targets else list(builders)
+    unknown = sorted(set(names) - set(builders))
+    if unknown:
+        raise ValueError(f"unknown kernel target(s) {unknown}; "
+                         f"available: {sorted(builders)}")
+    ctx = CheckContext(closed_jaxpr=None, options=dict(options or {}))
+    per: Dict[str, List[Finding]] = {}
+    for tname in names:
+        closed = builders[tname]()
+        for eqn, path, _w in iter_eqns(closed):
+            if eqn.primitive.name != "pallas_call":
+                continue
+            per.setdefault(kernel_id(eqn), []).extend(
+                lint_pallas_eqn(eqn, (tname,) + tuple(path), ctx))
+    return {
+        kid: finalize_findings(list(fs), ["kernellint"], ctx, suppress,
+                               config)
+        for kid, fs in sorted(per.items())
+    }

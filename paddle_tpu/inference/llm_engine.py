@@ -836,6 +836,13 @@ class LLMEngine:
                     f"fused decode step disabled, falling back to the "
                     f"unfused dispatch+sample path: {why}",
                     RuntimeWarning, stacklevel=2)
+                # a warning is per-process noise; the counter makes a
+                # fleet-wide silent fallback visible on /metrics
+                self.metrics.counter(
+                    "graph_rewrite_fallbacks_total",
+                    "verified-rewrite paths (fused decode) that failed "
+                    "self-check and fell back to the reference path",
+                ).inc()
                 self.fused_decode = False
         # the span descriptors of the batch being dispatched, in logits
         # row order: (slot, kind, n_tokens) — ScriptedEngine's fake

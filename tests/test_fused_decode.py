@@ -174,14 +174,22 @@ class TestEngineRouting:
         assert outs[True] == outs[False]
 
     def test_rollback_never_silent(self, tiny, monkeypatch):
-        """A failing self-check must WARN and fall back to the unfused
-        path — and the fallback engine must still serve correct
-        tokens."""
+        """A failing self-check must WARN, increment the fleet-visible
+        fallback counter, and fall back to the unfused path — and the
+        fallback engine must still serve correct tokens."""
+        # the healthy path must NOT touch the counter (built before the
+        # monkeypatch below forces every self-check to fail)
+        healthy = _engine(tiny)
+        assert healthy.fused_decode
+        assert healthy.metrics.get("graph_rewrite_fallbacks_total") is None
         monkeypatch.setattr(kernels, "fused_decode_self_check",
                             lambda *a, **kw: (False, "forced by test"))
         with pytest.warns(RuntimeWarning, match="forced by test"):
             eng = _engine(tiny)
         assert eng.fused_decode is False
+        # the warn alone is per-process noise; /metrics must see it
+        ctr = eng.metrics.get("graph_rewrite_fallbacks_total")
+        assert ctr is not None and ctr.value == 1
         prompt = [1, 2, 3]
         assert eng.generate([prompt], max_new_tokens=4)[0] == \
             _want(tiny, prompt, 4)

@@ -581,11 +581,13 @@ def test_baseline_gate_tier1(capsys):
     assert rc == 0, ("new graphlint finding codes vs baseline:\n"
                      + "\n".join(out["new_vs_baseline"]))
     # one shipped doc gates every tier: the model-tier run above must
-    # coexist with the v4 threads section (merge-written, never dropped)
+    # coexist with the v4 threads and v5 kernels sections (merge-written,
+    # never dropped)
     with open(_baseline_path()) as f:
         doc = json.load(f)
     assert doc["schema_version"] == _graphlint.BASELINE_SCHEMA_VERSION
     assert "threads" in doc
+    assert "kernels" in doc
 
 
 @pytest.mark.multidevice(4)

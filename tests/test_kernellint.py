@@ -1,0 +1,625 @@
+"""Graph Doctor tier 6 tests: the Pallas kernel verifier.
+
+Seeded-bad kernels per finding code (OOB index map, uncovered /
+overlapping output coverage, dead pl.when cells, VMEM overflow at a
+tiny fake budget, low-precision accumulators, scratch/output dtype
+mismatch), the shipped-kernel sweep staying clean at >= WARNING, the
+`vmem_bytes` export the autotuner will prune sweep points with, and THE
+acceptance bar: a corrupted generated kernel injected under the rewrite
+tier is rejected by the re-lint gate and rolled back.  The satellite
+mechanics ride along: the cost-table longest-match regression and the
+baseline loader's warn-not-crash tolerance of the v5 kernels section.
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+import paddle_tpu  # noqa: F401 — x64 on, same dtype world as the library
+from paddle_tpu import analysis
+from paddle_tpu.analysis import Finding, Report, Severity, kernellint
+from paddle_tpu.analysis.core import iter_eqns
+
+_0 = np.int32(0)
+
+
+class _Ctx:
+    """Minimal CheckContext stand-in: just the options kernellint reads."""
+
+    def __init__(self, **opts):
+        self._opts = opts
+
+    def opt(self, key, default=None):
+        return self._opts.get(key, default)
+
+
+def _lint(fn, *args, **opts):
+    closed = jax.make_jaxpr(fn)(*args)
+    out = []
+    for eqn, path, _w in iter_eqns(closed):
+        if eqn.primitive.name == "pallas_call":
+            out.extend(kernellint.lint_pallas_eqn(eqn, path, _Ctx(**opts)))
+    return out
+
+
+def _codes(findings, min_sev=Severity.WARNING):
+    return sorted({f.code for f in findings if f.severity >= min_sev})
+
+
+def _copy_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...]
+
+
+def _call(kernel, in_maps, out_map, grid=(2,), block=(128, 128),
+          arr=(256, 128), dtype=jnp.float32, out_shape=None,
+          scratch=()):
+    return pl.pallas_call(
+        kernel, grid=grid,
+        in_specs=[pl.BlockSpec(block, m) for m in in_maps],
+        out_specs=pl.BlockSpec(block, out_map),
+        out_shape=jax.ShapeDtypeStruct(out_shape or arr, dtype),
+        scratch_shapes=list(scratch), interpret=True)
+
+
+# ---------------------------------------------------------------------------
+# seeded-bad fixtures: one kernel per finding code
+# ---------------------------------------------------------------------------
+
+
+class TestSeededBad:
+    def test_oob_index_map(self):
+        """`i + 1` overruns the last block: a definite, attained OOB."""
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (i + 1, _0)], lambda i: (i, _0))
+        fs = _lint(f, x)
+        assert _codes(fs) == ["KERNEL_OOB_BLOCK"]
+        (bad,) = [f for f in fs if f.code == "KERNEL_OOB_BLOCK"]
+        assert bad.severity == Severity.ERROR
+        assert bad.data["index_hi"] == 2 and bad.data["nblocks"] == 2
+
+    def test_oob_negative_index(self):
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (i - 1, _0)], lambda i: (i, _0))
+        assert "KERNEL_OOB_BLOCK" in _codes(_lint(f, x))
+
+    def test_uncovered_constant_output_row(self):
+        """A constant output index writes 1 of 2 blocks — the other row
+        of blocks is never written."""
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (i, _0)], lambda i: (_0, _0))
+        fs = _lint(f, x)
+        (bad,) = [f for f in fs if f.code == "KERNEL_OUT_UNCOVERED"]
+        assert bad.severity == Severity.ERROR
+
+    def test_uncovered_grid_too_short(self):
+        """grid=(1,) over a 2-block output: block 1 never written."""
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (_0, _0)], lambda i: (i, _0),
+                  grid=(1,), block=(128, 128), arr=(256, 128))
+        fs = [f for f in _lint(f, x) if f.code == "KERNEL_OUT_UNCOVERED"]
+        assert fs and "never written" in fs[0].message
+
+    def test_overlap_non_consecutive_revisit(self):
+        """The output ignores grid dim 0 while dim 1 (inner) is used:
+        revisits of the same output block are non-consecutive, so the
+        accumulate-then-flush idiom cannot apply."""
+        x = jnp.zeros((128, 128), jnp.float32)
+        f = pl.pallas_call(
+            _copy_kernel, grid=(2, 2),
+            in_specs=[pl.BlockSpec((64, 64), lambda i, j: (i, j))],
+            out_specs=pl.BlockSpec((64, 64), lambda i, j: (_0, j)),
+            out_shape=jax.ShapeDtypeStruct((64, 128), jnp.float32),
+            interpret=True)
+        assert "KERNEL_OUT_OVERLAP" in _codes(_lint(f, x))
+
+    def test_trailing_reduce_dim_is_assumption_not_overlap(self):
+        """The accumulate idiom itself — unused TRAILING grid dim — must
+        NOT warn (every shipped matmul-style kernel uses it)."""
+        x = jnp.zeros((128, 128), jnp.float32)
+        f = pl.pallas_call(
+            _copy_kernel, grid=(2, 2),
+            in_specs=[pl.BlockSpec((64, 64), lambda i, j: (i, j))],
+            out_specs=pl.BlockSpec((64, 64), lambda i, j: (i, _0)),
+            out_shape=jax.ShapeDtypeStruct((128, 64), jnp.float32),
+            interpret=True)
+        fs = _lint(f, x)
+        assert "KERNEL_OUT_OVERLAP" not in _codes(fs)
+        assume = [f for f in fs if f.code == "KERNEL_ASSUME"]
+        assert assume and "accumulate" in assume[0].data["assumptions"][-1]
+
+    def test_dead_grid_cell(self):
+        """A pl.when predicate statically false on EVERY grid cell."""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+            @pl.when(pl.program_id(0) < 0)
+            def _():
+                o_ref[...] = x_ref[...] * 2
+
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel, [lambda i: (i, _0)], lambda i: (i, _0))
+        fs = [f for f in _lint(f, x) if f.code == "KERNEL_DEAD_GRID_CELL"]
+        assert fs and fs[0].severity == Severity.WARNING
+
+    def test_live_when_is_not_flagged(self):
+        """`pl.when(i == 0)` runs on SOME cell — no finding (the shipped
+        ragged/gmm kernels' first-visit init idiom)."""
+        def kernel(x_ref, o_ref):
+            @pl.when(pl.program_id(0) == 0)
+            def _():
+                o_ref[...] = jnp.zeros_like(o_ref)
+            o_ref[...] += x_ref[...]
+
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel, [lambda i: (i, _0)], lambda i: (_0, _0),
+                  out_shape=(128, 128))
+        assert "KERNEL_DEAD_GRID_CELL" not in _codes(_lint(f, x))
+
+    def test_vmem_overflow_at_tiny_budget(self):
+        """The same kernel passes at the real chip budget and overflows
+        at a seeded 1 KiB budget — the static OOM predictor."""
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (i, _0)], lambda i: (i, _0))
+        assert _codes(_lint(f, x)) == []
+        fs = _lint(f, x, kernellint_vmem_budget_bytes=1024)
+        (bad,) = [f for f in fs if f.code == "KERNEL_VMEM_OVERFLOW"]
+        assert bad.severity == Severity.WARNING
+        assert bad.data["vmem_bytes"] > 1024
+
+    def test_lowp_accum_dot(self):
+        """bf16 x bf16 dot accumulating in bf16 (no f32 accumulator)."""
+        def kernel(a_ref, b_ref, o_ref):
+            o_ref[...] = jnp.dot(a_ref[...], b_ref[...])
+
+        xb = jnp.zeros((128, 128), jnp.bfloat16)
+        f = pl.pallas_call(
+            kernel, grid=(1,),
+            in_specs=[pl.BlockSpec((128, 128), lambda i: (_0, _0))] * 2,
+            out_specs=pl.BlockSpec((128, 128), lambda i: (_0, _0)),
+            out_shape=jax.ShapeDtypeStruct((128, 128), jnp.bfloat16),
+            interpret=True)
+        fs = [f for f in _lint(f, xb, xb) if f.code == "KERNEL_LOWP_ACCUM"]
+        assert fs and "preferred_element_type" in fs[0].suggestion
+
+    def test_lowp_accum_scratch_running_sum(self):
+        """A bf16 scratch ref read AND written across grid steps — a
+        running sum losing mantissa."""
+        def kernel(x_ref, o_ref, acc_ref):
+            acc_ref[...] = acc_ref[...] + x_ref[...].astype(jnp.bfloat16)
+            o_ref[...] = acc_ref[...]
+
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel, [lambda i: (i, _0)], lambda i: (_0, _0),
+                  dtype=jnp.bfloat16, out_shape=(128, 128),
+                  scratch=[pltpu.VMEM((128, 128), jnp.bfloat16)])
+        assert "KERNEL_LOWP_ACCUM" in _codes(_lint(f, x))
+
+    def test_dtype_mismatch_scratch_narrower_than_output(self):
+        """bf16 scratch feeding an f32 output: the output precision is
+        laundered, not computed."""
+        def kernel(x_ref, o_ref, acc_ref):
+            acc_ref[...] = x_ref[...].astype(jnp.bfloat16)
+            o_ref[...] = acc_ref[...].astype(jnp.float32)
+
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel, [lambda i: (i, _0)], lambda i: (i, _0),
+                  scratch=[pltpu.VMEM((128, 128), jnp.bfloat16)])
+        assert "KERNEL_DTYPE_MISMATCH" in _codes(_lint(f, x))
+
+    def test_f32_scratch_is_clean(self):
+        """The blessed pattern — f32 scratch accumulator, cast on the
+        final flush — produces no dtype findings."""
+        def kernel(x_ref, o_ref, acc_ref):
+            acc_ref[...] = acc_ref[...] + x_ref[...].astype(jnp.float32)
+            o_ref[...] = acc_ref[...].astype(jnp.bfloat16)
+
+        x = jnp.zeros((256, 128), jnp.bfloat16)
+        f = _call(kernel, [lambda i: (i, _0)], lambda i: (_0, _0),
+                  dtype=jnp.bfloat16, out_shape=(128, 128),
+                  scratch=[pltpu.VMEM((128, 128), jnp.float32)])
+        assert _codes(_lint(f, x)) == []
+
+
+# ---------------------------------------------------------------------------
+# the interval evaluator's exactness on the shipped index-map shapes
+# ---------------------------------------------------------------------------
+
+
+class TestIntervalProofs:
+    def test_floordiv_mod_maps_prove_exact(self):
+        """The flash dkv shape — `b*r + t//nq` and `t % nq` — must be
+        proven in-bounds EXACTLY (no assumption fallback): the pjit
+        floor_divide/remainder special cases carry attainment."""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        _r, _nq = np.int32(2), np.int32(2)
+        x = jnp.zeros((4, 2, 128), jnp.float32)
+        f = pl.pallas_call(
+            kernel, grid=(2, 4),
+            in_specs=[pl.BlockSpec(
+                (1, 1, 128),
+                lambda b, t: (b * _r + t // _nq, t % _nq, _0))],
+            out_specs=pl.BlockSpec(
+                (1, 1, 128),
+                lambda b, t: (b * _r + t // _nq, t % _nq, _0)),
+            out_shape=jax.ShapeDtypeStruct((4, 2, 128), jnp.float32),
+            interpret=True)
+        fs = _lint(f, x)
+        assert "KERNEL_OOB_BLOCK" not in _codes(fs)
+        # no in-bounds assumptions either: the proof is exact
+        assume = [a for f in fs if f.code == "KERNEL_ASSUME"
+                  for a in f.data["assumptions"] if "in-bounds" in a]
+        assert assume == []
+
+    def test_floordiv_overrun_is_caught(self):
+        """The same shape with a grid one step too long: `b // r` walks
+        past the last block and the OOB endpoint is attained."""
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        _r = np.int32(2)
+        x = jnp.zeros((2, 128), jnp.float32)
+        f = pl.pallas_call(
+            kernel, grid=(6,),
+            in_specs=[pl.BlockSpec((1, 128), lambda b: (b // _r, _0))],
+            out_specs=pl.BlockSpec((1, 128), lambda b: (b // _r, _0)),
+            out_shape=jax.ShapeDtypeStruct((2, 128), jnp.float32),
+            interpret=True)
+        assert "KERNEL_OOB_BLOCK" in _codes(_lint(f, x))
+
+    def test_prefetch_index_is_assumed_not_flagged(self):
+        """Data-dependent block indices (the paged page-table load) are
+        an ASSUMPTION, never an OOB error — the caller's invariant."""
+        reports = kernellint.analyze_kernels(["paged_attention"])
+        rep = reports["pallas_paged_attention._paged_kernel"]
+        assert rep.ok(Severity.WARNING)
+        assume = [a for f in rep.findings if f.code == "KERNEL_ASSUME"
+                  for a in f.data["assumptions"]]
+        assert any("prefetch" in a for a in assume)
+
+
+# ---------------------------------------------------------------------------
+# the shipped-kernel sweep: everything we ship proves clean
+# ---------------------------------------------------------------------------
+
+
+SHIPPED = sorted(kernellint.shipped_kernel_targets())
+
+
+class TestShippedKernels:
+    @pytest.mark.parametrize("target", SHIPPED)
+    def test_shipped_kernel_is_clean(self, target):
+        """THE bar: all seven shipped kernels (backward kernels included
+        via grad traces) AND a generated fused-chain kernel carry zero
+        >= WARNING findings."""
+        reports = kernellint.analyze_kernels([target])
+        assert reports, f"{target}: no pallas_call found"
+        for kid, rep in reports.items():
+            bad = [str(f) for f in rep if f.severity >= Severity.WARNING]
+            assert rep.ok(Severity.WARNING), \
+                f"{kid} has kernel findings:\n" + "\n".join(bad)
+
+    def test_generated_chain_is_covered(self):
+        """The generated fused_chain target exercises the SAME emission
+        path the rewrite tier uses (fused_elementwise_chain)."""
+        reports = kernellint.analyze_kernels(["fused_chain"])
+        assert "pallas_fused_chain.fused_chain" in reports
+
+    def test_every_kernel_reports_a_footprint(self):
+        reports = kernellint.analyze_kernels()
+        assert len(reports) >= 8    # 7 shipped modules' kernels + chain
+        for kid, rep in reports.items():
+            fp = [f for f in rep.findings
+                  if f.code == "KERNEL_VMEM_FOOTPRINT"]
+            assert fp, f"{kid}: no footprint finding"
+            assert fp[0].data["vmem_bytes"] > 0
+
+    def test_unknown_target_raises(self):
+        with pytest.raises(ValueError, match="unknown kernel target"):
+            kernellint.analyze_kernels(["nope"])
+
+    def test_registered_checker_runs_inside_analyze(self):
+        """The tier rides every analyze() call: kernels reached through
+        a model trace get the same findings (INFO footprint here)."""
+        from paddle_tpu.kernels.pallas_norm import rms_norm_pallas
+
+        x = jnp.zeros((64, 128), jnp.float32)
+        w = jnp.ones((128,), jnp.float32)
+        rep = analysis.analyze(rms_norm_pallas, x, w)
+        assert "kernellint" in analysis.list_checkers()
+        fp = [f for f in rep.findings if f.code == "KERNEL_VMEM_FOOTPRINT"]
+        assert fp and fp[0].severity == Severity.INFO
+
+
+# ---------------------------------------------------------------------------
+# the vmem_bytes export (the autotuner's sweep-point pruner)
+# ---------------------------------------------------------------------------
+
+
+class TestVmemModel:
+    def test_vmem_bytes_counts_double_buffered_blocks(self):
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (i, _0)], lambda i: (i, _0))
+        # in block + out block, each (128, 128) f32 double-buffered
+        assert kernellint.vmem_bytes(f, (x,)) == 2 * (128 * 128 * 4 * 2)
+
+    def test_vmem_bytes_counts_scratch_once(self):
+        def kernel(x_ref, o_ref, acc_ref):
+            acc_ref[...] = x_ref[...]
+            o_ref[...] = acc_ref[...]
+
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel, [lambda i: (i, _0)], lambda i: (i, _0),
+                  scratch=[pltpu.VMEM((128, 128), jnp.float32)])
+        base = 2 * (128 * 128 * 4 * 2)
+        assert kernellint.vmem_bytes(f, (x,)) == base + 128 * 128 * 4
+
+    def test_vmem_bytes_accepts_closed_jaxpr_and_eqn(self):
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(_copy_kernel, [lambda i: (i, _0)], lambda i: (i, _0))
+        closed = jax.make_jaxpr(f)(x)
+        want = kernellint.vmem_bytes(f, (x,))
+        assert kernellint.vmem_bytes(closed) == want
+        (eqn,) = [e for e, _p, _w in iter_eqns(closed)
+                  if e.primitive.name == "pallas_call"]
+        assert kernellint.vmem_bytes(eqn) == want
+
+    def test_vmem_bytes_no_pallas_raises(self):
+        with pytest.raises(ValueError, match="no pallas_call"):
+            kernellint.vmem_bytes(jnp.tanh, (jnp.zeros((4,)),))
+
+    def test_budget_table_most_specific_wins(self):
+        assert kernellint.vmem_budget("TPU v5 lite") == 16 << 20
+        assert kernellint.vmem_budget("TPU v5p") == 32 << 20
+        assert kernellint.vmem_budget("v6e") == 32 << 20
+        assert kernellint.vmem_budget("v3") == 16 << 20
+        # unknown chips price at the default fleet chip (v5e)
+        assert kernellint.vmem_budget("cpu") == 16 << 20
+        assert kernellint.vmem_budget(None) == 16 << 20
+
+
+class TestKernelId:
+    def test_fused_chain_names_normalize(self):
+        """Generated chain kernels carry run-unstable site/length tags;
+        the baseline identity must collapse them."""
+        from paddle_tpu.kernels.pallas_fused_chain import (
+            fused_elementwise_chain,
+        )
+
+        for n_ops, site in ((3, "a"), (4, "b")):
+            fn = fused_elementwise_chain(
+                lambda a: jnp.tanh(a) * 2.0, n_ops=n_ops, mode="pallas",
+                site=site)
+            closed = jax.make_jaxpr(fn)(jnp.zeros((512, 128), jnp.float32))
+            (eqn,) = [e for e, _p, _w in iter_eqns(closed)
+                      if e.primitive.name == "pallas_call"]
+            assert kernellint.kernel_id(eqn) == \
+                "pallas_fused_chain.fused_chain"
+
+    def test_module_disambiguates_fwd_kernels(self):
+        """pallas_attention and pallas_norm both define `_fwd_kernel`;
+        the module prefix keeps their baselines separate."""
+        ids = set(kernellint.analyze_kernels(["flash_attention",
+                                              "rms_norm"]))
+        assert "pallas_attention._fwd_kernel" in ids
+        assert "pallas_norm._fwd_kernel" in ids
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance bar: the rewrite tier's re-lint gate rejects corrupted
+# generated kernels and rolls back
+# ---------------------------------------------------------------------------
+
+
+_REWRITE_OPTS = {
+    "fusion_min_bytes": 1 << 10,
+    "fusion_chain_min": 3,
+    "fusion_emit": "pallas",
+}
+
+
+def _chain_fn(x):
+    y = jnp.tanh(x)
+    y = y * y
+    y = jnp.tanh(y)
+    y = y * 2.0
+    return jnp.tanh(y)
+
+
+def _fusion_report():
+    return Report([Finding(
+        Severity.WARNING, "FUSION_BREAK", "hlo:main",
+        "chain of 5 UNFUSED elementwise ops", checker="fusion",
+        data={"chain": ["tanh", "multiply", "tanh", "multiply", "tanh"],
+              "bytes": 65536})])
+
+
+class TestRewriteGate:
+    def test_corrupted_generated_kernel_rolls_back(self, monkeypatch):
+        """Inject a numerically-EXACT but statically-bad kernel into the
+        fusion emitter (a dead pl.when branch — the equiv gate cannot
+        see it, only kernellint can) and prove the re-lint gate rejects
+        it and rolls the pass back."""
+        from paddle_tpu.kernels import pallas_fused_chain as pfc
+
+        real_make = pfc._make_kernel
+
+        def corrupt_make(chain_fn, n_inputs, n_ops, site=""):
+            kernel = real_make(chain_fn, n_inputs, n_ops, site)
+
+            def bad(*refs):
+                kernel(*refs)
+
+                @pl.when(pl.program_id(0) < 0)   # never true: dead body
+                def _():
+                    refs[n_inputs][...] = refs[0][...]
+
+            bad.__name__ = kernel.__name__
+            return bad
+
+        monkeypatch.setattr(pfc, "_make_kernel", corrupt_make)
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(
+            _chain_fn, x, passes=["fusion"], report=_fusion_report(),
+            options=dict(_REWRITE_OPTS))
+        (o,) = rep.outcomes
+        assert o.status == "rolled_back"
+        assert "re-lint" in o.reason
+        assert "KERNEL_DEAD_GRID_CELL" in o.reason
+        # the rolled-back jaxpr is the ORIGINAL: no pallas_call survives
+        prims = [e.primitive.name
+                 for e, _p, _w in iter_eqns(fn.rewritten_jaxpr)]
+        assert "pallas_call" not in prims
+        np.testing.assert_allclose(np.asarray(fn(x)),
+                                   np.asarray(_chain_fn(x)), rtol=1e-6)
+
+    def test_vmem_overflow_rolls_back(self):
+        """A HEALTHY generated kernel still rolls back when the VMEM
+        budget says it cannot fit — the static OOM predictor as a gate
+        (options thread through the re-lint analyze_jaxpr calls)."""
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        _fn, rep = analysis.rewrite(
+            _chain_fn, x, passes=["fusion"], report=_fusion_report(),
+            options=dict(_REWRITE_OPTS,
+                         kernellint_vmem_budget_bytes=1024))
+        (o,) = rep.outcomes
+        assert o.status == "rolled_back"
+        assert "KERNEL_VMEM_OVERFLOW" in o.reason
+
+    def test_clean_generated_kernel_still_applies(self):
+        """INFO-only kernellint findings (footprint, assumptions) must
+        NOT trip the gate: legit fusion keeps applying."""
+        x = jnp.linspace(-1, 1, 128 * 128,
+                         dtype=jnp.float32).reshape(128, 128)
+        fn, rep = analysis.rewrite(
+            _chain_fn, x, passes=["fusion"], report=_fusion_report(),
+            options=dict(_REWRITE_OPTS))
+        (o,) = rep.outcomes
+        assert o.status == "applied" and rep.ok
+        prims = [e.primitive.name
+                 for e, _p, _w in iter_eqns(fn.rewritten_jaxpr)]
+        assert "pallas_call" in prims
+
+
+# ---------------------------------------------------------------------------
+# satellites: cost-table longest-match + baseline v5 mechanics
+# ---------------------------------------------------------------------------
+
+
+class TestCostLongestMatch:
+    def test_longest_substring_wins_both_orders(self):
+        """'_ragged' must not swallow a '_ragged_fused' registration —
+        in EITHER registration order (dict order used to decide)."""
+        from paddle_tpu.analysis import cost
+
+        def kernel_ragged_fused_probe(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        kernel_ragged_fused_probe.__name__ = "_ragged_fused_probe_kernel"
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel_ragged_fused_probe, [lambda i: (i, _0)],
+                  lambda i: (i, _0))
+        closed = jax.make_jaxpr(f)(x)
+        (eqn,) = [e for e, _p, _w in iter_eqns(closed)
+                  if e.primitive.name == "pallas_call"]
+        for order in (("_ragged_probe_nope", "_ragged_fused_probe"),
+                      ("_ragged_fused_probe", "_ragged_probe_nope")):
+            keys = {"_ragged": lambda e: 111.0, order[0]: None,
+                    order[1]: None}
+            try:
+                cost.register_pallas_flops("_ragged", lambda e: 111.0)
+                cost.register_pallas_bytes("_ragged", lambda e: 111)
+                for sub in order:
+                    val = 999.0 if "fused" in sub else 555.0
+                    cost.register_pallas_flops(
+                        sub, (lambda v: lambda e: v)(val))
+                    cost.register_pallas_bytes(
+                        sub, (lambda v: lambda e: int(v))(val))
+                assert cost.eqn_flops(eqn) == 999.0
+                assert cost.eqn_bytes(eqn) == 999
+            finally:
+                for k in keys:
+                    cost._PALLAS_FLOPS.pop(k, None)
+                    cost._PALLAS_BYTES.pop(k, None)
+
+    def test_no_match_falls_back_to_zero(self):
+        from paddle_tpu.analysis import cost
+
+        def kernel_unregistered(x_ref, o_ref):
+            o_ref[...] = x_ref[...]
+
+        kernel_unregistered.__name__ = "_totally_unregistered_kernel"
+        x = jnp.zeros((256, 128), jnp.float32)
+        f = _call(kernel_unregistered, [lambda i: (i, _0)],
+                  lambda i: (i, _0))
+        closed = jax.make_jaxpr(f)(x)
+        (eqn,) = [e for e, _p, _w in iter_eqns(closed)
+                  if e.primitive.name == "pallas_call"]
+        assert cost.eqn_flops(eqn) == 0.0
+
+
+def _load_graphlint():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "graphlint.py")
+    spec = importlib.util.spec_from_file_location("graphlint_k", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBaselineV5:
+    def test_loader_warns_not_crashes_on_unknown_sections(self, tmp_path,
+                                                          capsys):
+        """Older-code forward compatibility: a baseline written by a
+        NEWER tool (v6 sections, extra kernels keys) must load with
+        warnings, never crash — threadlint's v4 contract, extended."""
+        gl = _load_graphlint()
+        doc = {"schema_version": 99,
+               "targets": {"llama": {"codes": {}}},
+               "kernels": {"pallas_norm._fwd_kernel": {
+                   "codes": {}, "counts": {}, "future_field": 1}},
+               "some_v6_section": {"x": 1}}
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps(doc))
+        loaded = gl._load_baseline(str(p))
+        err = capsys.readouterr().err
+        assert "some_v6_section" in err and "future_field" in err
+        assert loaded["schema_version"] == 99
+
+    def test_kernels_diff_fails_on_new_code_and_count_growth(self):
+        gl = _load_graphlint()
+        base = {"kernels": {"k": {"codes": {"KERNEL_ASSUME": "info"},
+                                  "counts": {"KERNEL_ASSUME": 1}}}}
+        same = {"k": {"codes": {"KERNEL_ASSUME": "info"},
+                      "counts": {"KERNEL_ASSUME": 1}}}
+        assert gl._kernels_diff(same, base) == []
+        grown = {"k": {"codes": {"KERNEL_ASSUME": "info"},
+                       "counts": {"KERNEL_ASSUME": 2}}}
+        assert any("count grew" in n
+                   for n in gl._kernels_diff(grown, base))
+        new = {"k": {"codes": {"KERNEL_OOB_BLOCK": "error"},
+                     "counts": {"KERNEL_OOB_BLOCK": 1}}}
+        assert any("NEW code" in n for n in gl._kernels_diff(new, base))
+
+    def test_shipped_baseline_gates_kernels(self, capsys):
+        """graphlint --kernels --baseline against the SHIPPED doc rides
+        tier-1: a kernel change that grows a finding fails here."""
+        gl = _load_graphlint()
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "GRAPHLINT_BASELINE.json")
+        rc = gl.main(["--kernels", "--baseline", path, "--json"])
+        out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+        assert rc == 0, ("new kernellint findings vs baseline:\n"
+                         + "\n".join(out["new_vs_baseline"]))
+        assert "tier_seconds" in out and "kernels" in out["tier_seconds"]

@@ -138,6 +138,60 @@ class TestRaggedKernel:
             a["span_len"], a["ctx_len"]))
         assert (ref[3] == 0).all() and (ref[5:] == 0).all()
 
+    def test_span_exactly_fills_last_block(self):
+        """Mask boundary: a span whose length is an exact multiple of
+        block_q leaves NO padding rows in its last block — the row mask
+        (qpos + r < span_len) must keep every row of that block live,
+        and the block after it must belong to the next span.  This is
+        the exactly-once coverage geometry kernellint's prover models;
+        pin interpret-mode parity on it."""
+        Hkv, rep, D, ps, bq = 2, 2, 8, 4, 4
+        # span 0: 8 tokens / block_q 4 = two FULL blocks (ctx == len,
+        # fresh prefill); span 1 starts on the very next block
+        spec = [(8, 8), (3, 5)]
+        q, k, v, a = _kernel_case(21, spec, Hkv * rep, Hkv, D, ps, 3, bq)
+        got = np.asarray(pra.ragged_attention_pallas(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"], interpret=True))
+        want = np.asarray(pra.ragged_attention_reference(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"]))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        # every row of span 0's two blocks is LIVE (no zero padding rows
+        # inside a full block) and finite
+        assert np.isfinite(got).all()
+        assert (np.abs(got[:8]).sum(axis=(1, 2)) > 0).all()
+
+    def test_single_token_span_on_block_boundary(self):
+        """Mask boundary: a single-token decode span whose context ends
+        exactly on a page boundary (ctx_len == k * page_size) — the
+        kv-page loop's last page is FULL, so an off-by-one in the page
+        mask (pos < ctx vs pos <= ctx) flips the boundary key's
+        contribution.  Pin parity against the gather reference."""
+        Hkv, rep, D, ps, bq = 2, 2, 8, 4, 2
+        # ctx 8 = exactly 2 full pages; sibling spans keep the batch
+        # from degenerating to one block
+        spec = [(1, 2 * ps), (1, ps), (3, 3)]
+        q, k, v, a = _kernel_case(22, spec, Hkv * rep, Hkv, D, ps, 3, bq)
+        got = np.asarray(pra.ragged_attention_pallas(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"], interpret=True))
+        want = np.asarray(pra.ragged_attention_reference(
+            q, k, v, a["span_pt"], a["block_seq"], a["block_qpos"],
+            a["span_len"], a["ctx_len"]))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+        # oracle for span 0's decode row: dense softmax over ALL 8
+        # context keys — dropping the boundary key is the bug this pins
+        pt = np.asarray(a["span_pt"])[0][:2]
+        ck = np.asarray(k)[pt].reshape(-1, Hkv, D)       # (8, Hkv, D)
+        cv = np.asarray(v)[pt].reshape(-1, Hkv, D)
+        qf = np.asarray(q)[0].reshape(Hkv, rep, D) / np.sqrt(D)
+        s = np.einsum("hrd,mhd->hrm", qf, ck)
+        p = np.exp(s - s.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        oracle = np.einsum("hrm,mhd->hrd", p, cv).reshape(Hkv * rep, D)
+        np.testing.assert_allclose(got[0], oracle, rtol=2e-5, atol=2e-5)
+
     def test_dispatcher_reference_fallback(self):
         """kernels.ragged_attention with fused kernels disabled routes to
         the gather reference."""

@@ -107,9 +107,11 @@ def classify(path: str) -> str:
         if sub in leaf:
             return "lower"
     # containers whose CHILDREN are the metrics (mem-peak tables keyed
-    # by model name, latency tables keyed by percentile, threadlint
-    # severity counts keyed by module — every race finding is a defect)
-    for sub in ("bytes", "mem_peak", "latency", "overhead", "threadlint"):
+    # by model name, latency tables keyed by percentile, threadlint /
+    # kernellint severity counts keyed by module or kernel — every race
+    # or kernel-contract finding is a defect)
+    for sub in ("bytes", "mem_peak", "latency", "overhead", "threadlint",
+                "kernellint"):
         if sub in path:
             return "lower"
     return "higher"

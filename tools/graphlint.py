@@ -25,6 +25,23 @@ Usage:
                             [--baseline B.json | --write-baseline B.json]
   python tools/graphlint.py --threads [modules...] [--json] [--verbose]
                             [--baseline B.json | --write-baseline B.json]
+  python tools/graphlint.py --kernels [kernel-targets...] [--json]
+                            [--verbose] [--chip KIND]
+                            [--baseline B.json | --write-baseline B.json]
+
+--kernels flips to the Pallas kernel verifier (analysis.kernellint): the
+positionals become KERNEL TARGET names (default: every shipped kernel —
+flash_attention, grouped_matmul, ragged_attention, paged_attention,
+rms_norm, adaln, decode_step, plus the GENERATED fused_chain, i.e. the
+same emission path the rewrite tier uses).  Each target is traced (grad
+traces pull in the backward kernels) and every pallas_call is statically
+verified: block index maps proven in-bounds and outputs covered
+exactly once (KERNEL_OOB_BLOCK / KERNEL_OUT_UNCOVERED /
+KERNEL_OUT_OVERLAP / KERNEL_DEAD_GRID_CELL), the VMEM footprint priced
+against the --chip budget (KERNEL_VMEM_OVERFLOW), and accumulator
+dtypes checked (KERNEL_LOWP_ACCUM / KERNEL_DTYPE_MISMATCH).  The
+baseline's "kernels" section (schema v5) diffs per-kernel finding codes
+AND counts, merged into the same shared snapshot doc.
 
 --threads flips to the lock-discipline tier (analysis.threadlint): the
 positionals become MODULE names (default: paddle_tpu.inference and
@@ -76,6 +93,7 @@ import functools
 import json
 import os
 import sys
+import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
@@ -299,10 +317,14 @@ def _spmd_summary(report) -> "dict | None":
 # runs).  v4: top-level "threads" — per-module threadlint code/count
 # snapshots (--threads runs); --write-baseline MERGES into an existing
 # file, so the model targets and the threads section share one doc.
-BASELINE_SCHEMA_VERSION = 4
-_KNOWN_BASELINE_KEYS = {"schema_version", "targets", "mesh", "threads"}
+# v5: top-level "kernels" — per-kernel kernellint code/count snapshots
+# (--kernels runs), same merge semantics.
+BASELINE_SCHEMA_VERSION = 5
+_KNOWN_BASELINE_KEYS = {"schema_version", "targets", "mesh", "threads",
+                        "kernels"}
 _KNOWN_TARGET_KEYS = {"codes", "rewrite", "spmd"}
 _KNOWN_THREADS_KEYS = {"codes", "counts"}
+_KNOWN_KERNELS_KEYS = {"codes", "counts"}
 
 
 def _baseline_snapshot(out: dict) -> dict:
@@ -351,14 +373,19 @@ def _load_baseline(path: str) -> dict:
             for k in sorted(set(msnap) - _KNOWN_THREADS_KEYS):
                 print(f"graphlint: warning: unknown baseline key "
                       f"threads.{mname}.{k!r} — ignored", file=sys.stderr)
+    for kname, ksnap in baseline.get("kernels", {}).items():
+        if isinstance(ksnap, dict):
+            for k in sorted(set(ksnap) - _KNOWN_KERNELS_KEYS):
+                print(f"graphlint: warning: unknown baseline key "
+                      f"kernels.{kname}.{k!r} — ignored", file=sys.stderr)
     return baseline
 
 
 def _write_baseline_doc(path: str, targets=None, mesh=None,
-                        threads=None) -> None:
-    """MERGE one section into the baseline file: a --threads run must
-    not drop the model-target snapshot and vice versa (one shipped doc
-    gates both surfaces)."""
+                        threads=None, kernels=None) -> None:
+    """MERGE one section into the baseline file: a --threads or
+    --kernels run must not drop the model-target snapshot and vice
+    versa (one shipped doc gates all three surfaces)."""
     doc = {}
     if os.path.isfile(path):
         try:
@@ -373,6 +400,8 @@ def _write_baseline_doc(path: str, targets=None, mesh=None,
         doc["mesh"] = mesh
     if threads is not None:
         doc["threads"] = threads
+    if kernels is not None:
+        doc["kernels"] = kernels
     with open(path, "w") as f:
         json.dump(doc, f, indent=1, sort_keys=True)
 
@@ -449,11 +478,13 @@ def _threads_main(args, analysis, config) -> int:
     (positionals are MODULE names, not bench targets)."""
     from paddle_tpu.analysis import threadlint
 
+    t0 = time.perf_counter()
     modules = list(args.targets) or list(threadlint.DEFAULT_MODULES)
     fail_on = analysis.Severity[args.fail_on.upper()]
     suppress = list(args.suppress)
     reports = threadlint.analyze_modules(
         tuple(modules), suppress=suppress, config=config)
+    tier_seconds = {"threads": time.perf_counter() - t0}
     out, all_ok = {}, True
     for mod, rep in reports.items():
         ok = rep.ok(fail_on)
@@ -493,6 +524,7 @@ def _threads_main(args, analysis, config) -> int:
         news = _threads_diff(snap, baseline)
         if args.as_json:
             print(json.dumps({"threads": out, "new_vs_baseline": news,
+                              "tier_seconds": tier_seconds,
                               "ok": not news}))
         else:
             for n in news:
@@ -504,9 +536,119 @@ def _threads_main(args, analysis, config) -> int:
     if args.as_json:
         counts = {k: out[k]["counts"] for k in out}
         print(json.dumps({"threads": out, "counts": counts,
-                          "ok": all_ok}))
+                          "tier_seconds": tier_seconds, "ok": all_ok}))
     elif all_ok:
         print(f"graphlint: {len(modules)} module(s) thread-clean at "
+              f">={args.fail_on}")
+    return 0 if all_ok else 1
+
+
+def _kernels_snapshot(reports: dict) -> dict:
+    """{kernel_id: {"codes": {code: worst_sev}, "counts": {code: n}}} —
+    the v5 baseline's kernels section.  Counts matter (as in threads):
+    a second OOB operand is a second bug, so count growth fails."""
+    snap = {}
+    for kid, rep in reports.items():
+        codes: dict = {}
+        counts: dict = {}
+        for f in rep.findings:
+            sev = f.severity.name.lower()
+            if _severity_rank(sev) > _severity_rank(codes.get(f.code, "")):
+                codes[f.code] = sev
+            counts[f.code] = counts.get(f.code, 0) + 1
+        snap[kid] = {"codes": codes, "counts": counts}
+    return snap
+
+
+def _kernels_diff(current: dict, baseline: dict) -> list:
+    """New codes, severity escalations, or count growth vs the
+    baseline's kernels section."""
+    base_all = baseline.get("kernels", {})
+    news = []
+    for kid, cur in current.items():
+        base = base_all.get(kid, {})
+        bcodes = base.get("codes", {})
+        bcounts = base.get("counts", {})
+        for code, sev in cur["codes"].items():
+            if code not in bcodes:
+                news.append(f"{kid}: NEW code {code} ({sev})")
+            elif _severity_rank(sev) > _severity_rank(bcodes[code]):
+                news.append(f"{kid}: {code} escalated "
+                            f"{bcodes[code]} -> {sev}")
+            elif cur["counts"].get(code, 0) > int(bcounts.get(code, 0)):
+                news.append(f"{kid}: {code} count grew "
+                            f"{bcounts.get(code, 0)} -> "
+                            f"{cur['counts'][code]}")
+    return news
+
+
+def _kernels_main(args, analysis, config) -> int:
+    """--kernels mode: the Pallas kernel verifier over shipped kernel
+    targets (positionals are KERNEL TARGET names, not bench targets)."""
+    import time
+
+    from paddle_tpu.analysis import kernellint
+
+    t0 = time.perf_counter()
+    targets = list(args.targets) or None
+    fail_on = analysis.Severity[args.fail_on.upper()]
+    suppress = list(args.suppress)
+    options = {}
+    if args.chip:
+        options["kernellint_chip"] = args.chip
+    try:
+        reports = kernellint.analyze_kernels(
+            targets, options=options, suppress=suppress, config=config)
+    except ValueError as e:
+        print(f"graphlint: {e}", file=sys.stderr)
+        return 2
+    tier_seconds = {"kernels": time.perf_counter() - t0}
+    out, all_ok = {}, True
+    for kid, rep in reports.items():
+        ok = rep.ok(fail_on)
+        all_ok &= ok
+        out[kid] = dict(rep.to_json(), ok=ok)
+        for f in rep.by_code("KERNEL_VMEM_FOOTPRINT"):
+            out[kid]["vmem_bytes"] = int(f.data.get("vmem_bytes", 0))
+            out[kid]["vmem_budget_bytes"] = int(
+                f.data.get("budget_bytes", 0))
+            break
+        if not args.as_json:
+            shown = [f for f in rep
+                     if args.verbose
+                     or f.severity >= analysis.Severity.WARNING]
+            vm = out[kid].get("vmem_bytes")
+            vm_s = f", vmem {vm / (1 << 10):.0f} KiB" if vm else ""
+            print(f"== {kid}: {'clean' if ok else 'FINDINGS'} "
+                  f"({rep.counts()}, {rep.suppressed} suppressed{vm_s})")
+            for f in shown:
+                print(f"   {f}")
+    snap = _kernels_snapshot(reports)
+    if args.write_baseline:
+        _write_baseline_doc(args.write_baseline, kernels=snap)
+        if not args.as_json:
+            print(f"graphlint: kernels baseline written to "
+                  f"{args.write_baseline}")
+    if args.baseline:
+        baseline = _load_baseline(args.baseline)
+        news = _kernels_diff(snap, baseline)
+        if args.as_json:
+            print(json.dumps({"kernels": out, "new_vs_baseline": news,
+                              "tier_seconds": tier_seconds,
+                              "ok": not news}))
+        else:
+            for n in news:
+                print(f"baseline: {n}")
+            print(f"graphlint: "
+                  f"{'no new kernellint findings' if not news else f'{len(news)} NEW kernellint finding(s)'} "
+                  f"vs {args.baseline}")
+        return 1 if news else 0
+    if args.as_json:
+        counts = {k: out[k]["counts"] for k in out}
+        print(json.dumps({"kernels": out, "counts": counts,
+                          "tier_seconds": tier_seconds, "ok": all_ok}))
+    elif all_ok:
+        print(f"graphlint: {len(reports)} kernel(s) clean at "
               f">={args.fail_on}")
     return 0 if all_ok else 1
 
@@ -521,6 +663,13 @@ def main(argv=None) -> int:
                     help="run the lock-discipline tier "
                          "(analysis.threadlint) over serving MODULES "
                          "instead of linting bench models")
+    ap.add_argument("--kernels", action="store_true",
+                    help="run the Pallas kernel verifier "
+                         "(analysis.kernellint) over shipped KERNEL "
+                         "targets instead of linting bench models")
+    ap.add_argument("--chip", default=None, metavar="KIND",
+                    help="with --kernels: chip kind for the VMEM "
+                         "budget (v3/v4/v5e/v5p/v6e; default v5e)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit one JSON object instead of text")
     ap.add_argument("--verbose", action="store_true",
@@ -555,12 +704,12 @@ def main(argv=None) -> int:
                     help="store the current findings as the snapshot")
     args = ap.parse_args(argv)
 
-    if not args.threads:
+    if not args.threads and not args.kernels:
         bad = sorted(set(args.targets) - set(TARGETS))
         if bad:
             ap.error(f"unknown target(s) {', '.join(bad)} (choose from "
                      f"{', '.join(TARGETS)}; module names need "
-                     "--threads)")
+                     "--threads, kernel targets need --kernels)")
 
     global MESH_SIZES
     MESH_SIZES = None
@@ -592,6 +741,8 @@ def main(argv=None) -> int:
 
     if args.threads:
         return _threads_main(args, analysis, config)
+    if args.kernels:
+        return _kernels_main(args, analysis, config)
 
     if args.apply:
         args.fix = True
@@ -599,16 +750,29 @@ def main(argv=None) -> int:
     suppress = list(SHIPPED_SUPPRESSIONS) + list(args.suppress)
     names = list(args.targets) or list(TARGETS)
     out, mem_peaks, all_ok, apply_ok = {}, {}, True, True
+    # per-tier wall time (satellite of the kernellint PR): CI reads
+    # tier_seconds from the --json report to see WHICH tier regressed
+    # when the lint step slows down
+    tier_seconds: dict = {}
+
+    def _tick(bucket, t0):
+        tier_seconds[bucket] = (tier_seconds.get(bucket, 0.0)
+                                + time.perf_counter() - t0)
+
     for name in names:
         fn, call_args, extra = TARGETS[name]()
+        t0 = time.perf_counter()
         report = analysis.analyze(
             fn, *call_args, suppress=suppress, mesh=extra.get("mesh"),
             probe_args=extra.get("probe_args"),
             options=extra.get("options"), config=config)
+        _tick("spmd" if extra.get("mesh") is not None else "jaxpr", t0)
         if not args.no_hlo:
+            t0 = time.perf_counter()
             report = analysis.merge_reports(report, analysis.analyze_hlo(
                 fn, *call_args, suppress=suppress,
                 options=extra.get("options"), config=config))
+            _tick("hlo", t0)
         ok = report.ok(fail_on)
         all_ok &= ok
         # jaxpr-tier static memory peak (the attributable estimate; the
@@ -630,10 +794,12 @@ def main(argv=None) -> int:
             # the rewrite tier, gated by the equivalence harness: grads
             # are skipped here for CLI budget (tests/test_rewrite.py
             # covers grad equivalence per pass); a rollback = regression
+            t0 = time.perf_counter()
             _newfn, rw = analysis.rewrite(
                 fn, *call_args, report=report, mesh=extra.get("mesh"),
                 options=extra.get("options"), suppress=suppress,
                 config=config, verify_grads=False)
+            _tick("rewrite", t0)
             apply_ok &= rw.ok
             out[name]["rewrite"] = rw.to_json()
         if not args.as_json:
@@ -674,6 +840,7 @@ def main(argv=None) -> int:
         news = _baseline_diff(snap, baseline)
         if args.as_json:
             print(json.dumps({"targets": out, "new_vs_baseline": news,
+                              "tier_seconds": tier_seconds,
                               "ok": not news and apply_ok}))
         else:
             for n in news:
@@ -688,6 +855,7 @@ def main(argv=None) -> int:
         counts = {k: out[k]["counts"] for k in out}
         print(json.dumps({"targets": out, "counts": counts,
                           "mem_peak_bytes": mem_peaks,
+                          "tier_seconds": tier_seconds,
                           "ok": all_ok and apply_ok}))
     elif all_ok and apply_ok:
         print(f"graphlint: all {len(names)} target(s) clean at "
